@@ -1,0 +1,43 @@
+"""Needle checksum: CRC32-Castagnoli with the masked-value transform.
+
+The reference computes crc32c over the needle Data and stores
+``value = rotr15(crc) + 0xa282ead8`` (weed/storage/needle/crc.go:24-26) —
+the same masking scheme leveldb/snappy use so that CRCs of CRCs stay
+well-distributed.  Uses google_crc32c (hardware SSE4.2) with a pure-python
+table fallback.
+"""
+
+from __future__ import annotations
+
+try:
+    import google_crc32c
+
+    def crc32c(data, initial: int = 0) -> int:
+        return google_crc32c.extend(initial, bytes(data))
+
+except ImportError:  # pragma: no cover - fallback for exotic environments
+    _POLY = 0x82F63B78  # reversed Castagnoli
+    _TABLE = []
+    for _i in range(256):
+        _c = _i
+        for _ in range(8):
+            _c = (_c >> 1) ^ _POLY if _c & 1 else _c >> 1
+        _TABLE.append(_c)
+
+    def crc32c(data, initial: int = 0) -> int:
+        c = initial ^ 0xFFFFFFFF
+        for b in bytes(data):
+            c = _TABLE[(c ^ b) & 0xFF] ^ (c >> 8)
+        return c ^ 0xFFFFFFFF
+
+
+def masked_value(crc: int) -> int:
+    """crc.go:24-26: uint32(c>>15|c<<17) + 0xa282ead8."""
+    crc &= 0xFFFFFFFF
+    rot = ((crc >> 15) | (crc << 17)) & 0xFFFFFFFF
+    return (rot + 0xA282EAD8) & 0xFFFFFFFF
+
+
+def needle_checksum(data) -> int:
+    """The u32 stored after the needle body."""
+    return masked_value(crc32c(data))

@@ -1,0 +1,58 @@
+"""`.idx` / `.ecx` index-file entries: 16-byte (key u64, offset u32, size i32).
+
+Byte-compatible with weed/storage/idx/walk.go.  Offsets are stored in units of
+NEEDLE_PADDING_SIZE (8 bytes); a zero offset means "never written", size==-1
+means tombstone.  Parsing is vectorized with numpy — an index of millions of
+entries decodes in milliseconds.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Iterator
+
+import numpy as np
+
+from .types import NEEDLE_MAP_ENTRY_SIZE, NEEDLE_PADDING_SIZE
+
+# big-endian struct dtype matching IdxFileEntry (idx/walk.go:45-50)
+IDX_DTYPE = np.dtype([("key", ">u8"), ("offset", ">u4"), ("size", ">i4")])
+
+
+def pack_entry(key: int, actual_offset: int, size: int) -> bytes:
+    arr = np.zeros(1, dtype=IDX_DTYPE)
+    arr[0] = (key, actual_offset // NEEDLE_PADDING_SIZE, size)
+    return arr.tobytes()
+
+
+def parse_entries(buf: bytes) -> np.ndarray:
+    """Decode a whole index file at once -> structured array (key,offset,size).
+    Offset is left in padding units; multiply by 8 for byte offsets."""
+    usable = len(buf) - (len(buf) % NEEDLE_MAP_ENTRY_SIZE)
+    return np.frombuffer(buf[:usable], dtype=IDX_DTYPE)
+
+
+def walk_index_blob(buf: bytes, fn: Callable[[int, int, int], None]) -> None:
+    """WalkIndexFile semantics over an in-memory blob: fn(key, byte_offset, size)."""
+    entries = parse_entries(buf)
+    offsets = entries["offset"].astype(np.int64) * NEEDLE_PADDING_SIZE
+    for i in range(len(entries)):
+        fn(int(entries["key"][i]), int(offsets[i]), int(entries["size"][i]))
+
+
+def walk_index_file(path: str, fn: Callable[[int, int, int], None]) -> None:
+    with open(path, "rb") as f:
+        walk_index_blob(f.read(), fn)
+
+
+def iter_index_file(path: str) -> Iterator[tuple[int, int, int]]:
+    if not os.path.exists(path):
+        return
+    with open(path, "rb") as f:
+        entries = parse_entries(f.read())
+    for i in range(len(entries)):
+        yield (
+            int(entries["key"][i]),
+            int(entries["offset"][i]) * NEEDLE_PADDING_SIZE,
+            int(entries["size"][i]),
+        )

@@ -1,0 +1,98 @@
+"""Core on-disk scalar types for the needle store.
+
+Byte-compatible with the reference formats:
+  - needle id: u64 big-endian        (weed/storage/types/needle_id_type.go)
+  - offset: u32 big-endian, in units of NEEDLE_PADDING_SIZE (8 bytes)
+                                     (weed/storage/types/offset_4bytes.go:78-85)
+  - size: i32 big-endian, -1 == tombstone (weed/storage/types/needle_types.go:15-22)
+  - cookie: u32 big-endian
+All multi-byte integers in every file format are big-endian
+(weed/util/bytes.go "// big endian").
+"""
+
+from __future__ import annotations
+
+import struct
+from enum import IntEnum
+
+COOKIE_SIZE = 4
+NEEDLE_ID_SIZE = 8
+SIZE_SIZE = 4
+NEEDLE_HEADER_SIZE = COOKIE_SIZE + NEEDLE_ID_SIZE + SIZE_SIZE  # 16
+OFFSET_SIZE = 4
+NEEDLE_MAP_ENTRY_SIZE = NEEDLE_ID_SIZE + OFFSET_SIZE + SIZE_SIZE  # 16
+TIMESTAMP_SIZE = 8
+NEEDLE_PADDING_SIZE = 8
+NEEDLE_CHECKSUM_SIZE = 4
+TOMBSTONE_FILE_SIZE = -1
+# 4-byte offsets x 8-byte padding => 32GB addressable (offset_4bytes.go:84)
+MAX_POSSIBLE_VOLUME_SIZE = 4 * 1024 * 1024 * 1024 * 8
+
+
+class Version(IntEnum):
+    V1 = 1
+    V2 = 2
+    V3 = 3
+
+
+CURRENT_VERSION = Version.V3
+
+_U32 = struct.Struct(">I")
+_U64 = struct.Struct(">Q")
+_U16 = struct.Struct(">H")
+
+
+def u32_to_bytes(v: int) -> bytes:
+    return _U32.pack(v & 0xFFFFFFFF)
+
+
+def bytes_to_u32(b: bytes) -> int:
+    return _U32.unpack_from(b)[0]
+
+
+def u64_to_bytes(v: int) -> bytes:
+    return _U64.pack(v & 0xFFFFFFFFFFFFFFFF)
+
+
+def bytes_to_u64(b: bytes) -> int:
+    return _U64.unpack_from(b)[0]
+
+
+def u16_to_bytes(v: int) -> bytes:
+    return _U16.pack(v & 0xFFFF)
+
+
+def bytes_to_u16(b: bytes) -> int:
+    return _U16.unpack_from(b)[0]
+
+
+def size_is_deleted(size: int) -> bool:
+    return size < 0 or size == TOMBSTONE_FILE_SIZE
+
+
+def size_is_valid(size: int) -> bool:
+    return size > 0 and size != TOMBSTONE_FILE_SIZE
+
+
+def size_to_bytes(size: int) -> bytes:
+    return _U32.pack(size & 0xFFFFFFFF)
+
+
+def bytes_to_size(b: bytes) -> int:
+    v = _U32.unpack_from(b)[0]
+    # Size is a signed int32 on disk; tombstones read back as -1.
+    return v - (1 << 32) if v >= (1 << 31) else v
+
+
+def offset_to_bytes(actual_offset: int) -> bytes:
+    """Encode a byte offset (must be 8-byte aligned) as a 4-byte unit offset."""
+    return _U32.pack((actual_offset // NEEDLE_PADDING_SIZE) & 0xFFFFFFFF)
+
+
+def bytes_to_offset(b: bytes) -> int:
+    """Decode a 4-byte unit offset to the actual byte offset."""
+    return _U32.unpack_from(b)[0] * NEEDLE_PADDING_SIZE
+
+
+def offset_is_zero(b: bytes) -> bool:
+    return b[:OFFSET_SIZE] == b"\x00\x00\x00\x00"

@@ -1,0 +1,341 @@
+"""Volume: one append-only `.dat` + `.idx` pair with superblock and needle map.
+
+Equivalent of weed/storage/volume.go + volume_write.go + volume_read.go +
+volume_vacuum.go + volume_checking.go.  The write path here is the serialized
+`syncWrite` flavor (volume_write.go:94); the group-commit async worker lives in
+volume_server (it batches at the server layer, where concurrency exists in
+this architecture).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Callable, Optional
+
+from .needle import Needle, get_actual_size, needle_body_length
+from .needle_map import MemoryNeedleMap, NeedleValue
+from .super_block import SUPER_BLOCK_SIZE, ReplicaPlacement, SuperBlock
+from .ttl import TTL
+from .types import (
+    MAX_POSSIBLE_VOLUME_SIZE,
+    NEEDLE_HEADER_SIZE,
+    NEEDLE_MAP_ENTRY_SIZE,
+    Version,
+    size_is_valid,
+)
+
+
+class NotFoundError(KeyError):
+    pass
+
+
+class DeletedError(KeyError):
+    pass
+
+
+class CookieMismatchError(ValueError):
+    pass
+
+
+def volume_file_prefix(directory: str, collection: str, vid: int) -> str:
+    name = f"{collection}_{vid}" if collection else str(vid)
+    return os.path.join(directory, name)
+
+
+class Volume:
+    def __init__(self, directory: str, collection: str, vid: int,
+                 replica_placement: ReplicaPlacement | None = None,
+                 ttl: TTL | None = None,
+                 version: Version = Version.V3,
+                 volume_size_limit: int = 30 * 1000 * 1000 * 1000):
+        self.directory = directory
+        self.collection = collection
+        self.id = vid
+        self.version = version
+        self.volume_size_limit = volume_size_limit
+        self.read_only = False
+        self.last_append_at_ns = 0
+        self.last_modified_ts_seconds = 0
+        self.file_prefix = volume_file_prefix(directory, collection, vid)
+        self.super_block = SuperBlock(
+            version=version,
+            replica_placement=replica_placement or ReplicaPlacement(),
+            ttl=ttl or TTL(),
+        )
+        self._dat: Optional[object] = None
+        self.nm: Optional[MemoryNeedleMap] = None
+        self._load_or_create()
+
+    # --- naming -------------------------------------------------------
+    @property
+    def dat_path(self) -> str:
+        return self.file_prefix + ".dat"
+
+    @property
+    def idx_path(self) -> str:
+        return self.file_prefix + ".idx"
+
+    # --- lifecycle ----------------------------------------------------
+    def _load_or_create(self) -> None:
+        exists = os.path.exists(self.dat_path)
+        # unbuffered handle + pread-style reads: no stale read-buffer if the
+        # file is touched by another handle (EC tooling, replication copy)
+        self._dat = open(self.dat_path, "r+b" if exists else "w+b", buffering=0)
+        if exists and os.path.getsize(self.dat_path) >= SUPER_BLOCK_SIZE:
+            self.super_block = SuperBlock.from_bytes(
+                os.pread(self._dat.fileno(), SUPER_BLOCK_SIZE + 0xFFFF, 0))
+            self.version = self.super_block.version
+        else:
+            self._dat.write(self.super_block.to_bytes())
+            self._dat.flush()
+        self._check_integrity()
+        self.nm = MemoryNeedleMap.load(self.idx_path)
+
+    def _check_integrity(self) -> None:
+        """CheckAndFixVolumeDataIntegrity (volume_checking.go:17): verify the
+        last index entry points at a healthy needle; truncate torn writes."""
+        if not os.path.exists(self.idx_path):
+            return
+        idx_size = os.path.getsize(self.idx_path)
+        if idx_size % NEEDLE_MAP_ENTRY_SIZE != 0:
+            # torn index append: truncate to the last full entry
+            with open(self.idx_path, "r+b") as f:
+                f.truncate(idx_size - idx_size % NEEDLE_MAP_ENTRY_SIZE)
+            idx_size -= idx_size % NEEDLE_MAP_ENTRY_SIZE
+        if idx_size == 0:
+            return
+        with open(self.idx_path, "rb") as f:
+            f.seek(idx_size - NEEDLE_MAP_ENTRY_SIZE)
+            from .idx import parse_entries
+
+            entry = parse_entries(f.read(NEEDLE_MAP_ENTRY_SIZE))[0]
+        offset = int(entry["offset"]) * 8
+        size = int(entry["size"])
+        if offset == 0:
+            return
+        body = needle_body_length(size, self.version) if size_is_valid(size) else \
+            needle_body_length(0, self.version)
+        expected_end = offset + NEEDLE_HEADER_SIZE + body
+        dat_size = os.path.getsize(self.dat_path)
+        if dat_size > expected_end:
+            # torn write past the last indexed needle: truncate
+            self._dat.truncate(expected_end)
+            self._dat.flush()
+
+    def close(self) -> None:
+        if self.nm is not None:
+            self.nm.close()
+        if self._dat is not None:
+            self._dat.flush()
+            self._dat.close()
+            self._dat = None
+
+    def destroy(self) -> None:
+        self.close()
+        for ext in (".dat", ".idx", ".vif", ".cpd", ".cpx", ".note"):
+            p = self.file_prefix + ext
+            if os.path.exists(p):
+                os.remove(p)
+
+    # --- geometry -----------------------------------------------------
+    @property
+    def data_size(self) -> int:
+        return os.fstat(self._dat.fileno()).st_size
+
+    @property
+    def content_size(self) -> int:
+        return self.nm.content_size
+
+    def is_full(self) -> bool:
+        return self.data_size >= self.volume_size_limit
+
+    # --- write path (volume_write.go) ---------------------------------
+    def _append_record(self, blob: bytes) -> int:
+        """Append raw record bytes at EOF, returning the start offset.
+        Truncates back on failure (needle_read_write.go:136-166)."""
+        end = self.data_size
+        try:
+            written = os.pwrite(self._dat.fileno(), blob, end)
+            if written != len(blob):
+                raise OSError(f"short write {written} != {len(blob)}")
+        except OSError:
+            os.ftruncate(self._dat.fileno(), end)
+            raise
+        return end
+
+    def is_file_unchanged(self, n: Needle) -> bool:
+        if str(self.super_block.ttl):
+            return False
+        nv = self.nm.get(n.id)
+        if nv is None or nv.offset == 0 or not size_is_valid(nv.size):
+            return False
+        try:
+            old = self._read_needle_at(nv.offset, nv.size)
+        except Exception:
+            return False
+        return old.cookie == n.cookie and old.data == n.data
+
+    def write_needle(self, n: Needle, check_cookie: bool = True) -> tuple[int, int, bool]:
+        """doWriteRequest (volume_write.go:130-178).
+        Returns (offset, size, is_unchanged)."""
+        if self.read_only:
+            raise PermissionError(f"volume {self.id} is read only")
+        actual = get_actual_size(len(n.data), self.version)
+        if MAX_POSSIBLE_VOLUME_SIZE < self.nm.content_size + actual:
+            raise OSError(f"volume size limit {MAX_POSSIBLE_VOLUME_SIZE} exceeded")
+        if self.is_file_unchanged(n):
+            return 0, len(n.data), True
+        nv = self.nm.get(n.id)
+        if nv is not None:
+            existing = self._read_needle_header(nv.offset)
+            if n.cookie == 0 and not check_cookie:
+                n.cookie = existing.cookie
+            if existing.cookie != n.cookie:
+                raise CookieMismatchError(f"mismatching cookie {n.cookie:x}")
+        if not n.append_at_ns:
+            n.append_at_ns = time.time_ns()
+        blob = n.to_bytes(self.version)
+        offset = self._append_record(blob)
+        self.last_append_at_ns = n.append_at_ns
+        if nv is None or nv.offset < offset:
+            self.nm.put(n.id, offset, n.size)
+        if self.last_modified_ts_seconds < n.last_modified:
+            self.last_modified_ts_seconds = n.last_modified
+        return offset, n.size, False
+
+    def delete_needle(self, n: Needle) -> int:
+        """doDeleteRequest (volume_write.go:212-240): append a zero-data
+        tombstone needle, then log the tombstone in the index."""
+        if self.read_only:
+            raise PermissionError(f"volume {self.id} is read only")
+        nv = self.nm.get(n.id)
+        if nv is None or not size_is_valid(nv.size):
+            return 0
+        size = nv.size
+        n.data = b""
+        n.append_at_ns = time.time_ns()
+        blob = n.to_bytes(self.version)
+        offset = self._append_record(blob)
+        self.last_append_at_ns = n.append_at_ns
+        self.nm.delete(n.id, offset)
+        return size
+
+    # --- read path (volume_read.go) ------------------------------------
+    def _read_at(self, offset: int, length: int) -> bytes:
+        return os.pread(self._dat.fileno(), length, offset)
+
+    def _read_needle_at(self, offset: int, size: int) -> Needle:
+        blob = self._read_at(offset, get_actual_size(size, self.version))
+        return Needle.from_bytes(blob, size, self.version)
+
+    def _read_needle_header(self, offset: int) -> Needle:
+        n = Needle()
+        n.parse_header(self._read_at(offset, NEEDLE_HEADER_SIZE))
+        return n
+
+    def read_needle(self, key: int, cookie: Optional[int] = None,
+                    read_deleted: bool = False) -> Needle:
+        """readNeedle (volume_read.go:16-63) + handler-level cookie check."""
+        nv = self.nm.get(key)
+        if nv is None or nv.offset == 0:
+            raise NotFoundError(key)
+        read_size = nv.size
+        if not size_is_valid(read_size):
+            if read_deleted and read_size != -1:
+                read_size = -read_size
+            else:
+                raise DeletedError(key)
+        n = self._read_needle_at(nv.offset, read_size)
+        if cookie is not None and n.cookie != cookie:
+            raise CookieMismatchError(f"cookie mismatch for {key}")
+        if n.ttl is not None and n.ttl.minutes and n.last_modified:
+            expire_ns = n.append_at_ns + n.ttl.minutes * 60 * 1_000_000_000
+            if time.time_ns() >= expire_ns:
+                raise NotFoundError(key)
+        return n
+
+    def read_needle_blob(self, offset: int, size: int) -> bytes:
+        return self._read_at(offset, get_actual_size(size, self.version))
+
+    # --- scan (volume_read.go:72-130) ----------------------------------
+    def scan(self, visit: Callable[[Needle, int], None]) -> None:
+        """Visit every needle record in file order: visit(needle, offset)."""
+        offset = self.super_block.block_size
+        end = self.data_size
+        while offset + NEEDLE_HEADER_SIZE <= end:
+            header = self._read_at(offset, NEEDLE_HEADER_SIZE)
+            n = Needle()
+            n.parse_header(header)
+            size = n.size if size_is_valid(n.size) else 0
+            body_len = needle_body_length(size, self.version)
+            body = self._read_at(offset + NEEDLE_HEADER_SIZE, body_len)
+            if len(body) < body_len:
+                break
+            n.read_body_bytes(body, self.version)
+            visit(n, offset)
+            offset += NEEDLE_HEADER_SIZE + body_len
+
+    # --- vacuum (volume_vacuum.go) --------------------------------------
+    def garbage_ratio(self) -> float:
+        cs = self.content_size
+        if cs == 0:
+            return 0.0
+        return self.nm.deletion_byte_counter / cs
+
+    def compact(self) -> None:
+        """Compact2-style copy of live needles into .cpd/.cpx
+        (volume_vacuum.go:396-470 copyDataBasedOnIndexFile)."""
+        cpd, cpx = self.file_prefix + ".cpd", self.file_prefix + ".cpx"
+        new_sb = SuperBlock(
+            version=self.super_block.version,
+            replica_placement=self.super_block.replica_placement,
+            ttl=self.super_block.ttl,
+            compaction_revision=(self.super_block.compaction_revision + 1) & 0xFFFF,
+            extra=self.super_block.extra,
+        )
+        from . import idx as idx_mod
+
+        with open(cpd, "wb") as dat_out, open(cpx, "wb") as idx_out:
+            dat_out.write(new_sb.to_bytes())
+            new_offset = new_sb.block_size
+            live = sorted(self.nm, key=lambda nv: nv.offset)
+            for nv in live:
+                blob = self.read_needle_blob(nv.offset, nv.size)
+                dat_out.write(blob)
+                idx_out.write(idx_mod.pack_entry(nv.key, new_offset, nv.size))
+                new_offset += len(blob)
+
+    def commit_compact(self) -> None:
+        """CommitCompact (volume_vacuum.go:91-160): swap in the compacted
+        files and reload."""
+        cpd, cpx = self.file_prefix + ".cpd", self.file_prefix + ".cpx"
+        if not (os.path.exists(cpd) and os.path.exists(cpx)):
+            raise FileNotFoundError("no compacted files to commit")
+        self.close()
+        os.replace(cpd, self.dat_path)
+        os.replace(cpx, self.idx_path)
+        self._load_or_create()
+
+    def cleanup_compact(self) -> None:
+        for ext in (".cpd", ".cpx"):
+            p = self.file_prefix + ext
+            if os.path.exists(p):
+                os.remove(p)
+
+    # --- info -----------------------------------------------------------
+    def to_volume_information(self) -> dict:
+        return {
+            "id": self.id,
+            "size": self.data_size,
+            "collection": self.collection,
+            "file_count": self.nm.file_counter,
+            "delete_count": self.nm.deletion_counter,
+            "deleted_byte_count": self.nm.deletion_byte_counter,
+            "read_only": self.read_only,
+            "replica_placement": self.super_block.replica_placement.to_byte(),
+            "version": int(self.version),
+            "ttl": self.super_block.ttl.to_u32(),
+            "compact_revision": self.super_block.compaction_revision,
+            "modified_at_second": self.last_modified_ts_seconds,
+        }

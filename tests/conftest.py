@@ -1,0 +1,13 @@
+"""Test config: force JAX onto a virtual 8-device CPU mesh.
+
+Tests must exercise the multi-chip sharding path without TPU hardware
+(the driver separately dry-runs the multi-chip path); real-TPU benching
+happens only via bench.py.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()

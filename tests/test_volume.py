@@ -1,0 +1,158 @@
+"""Volume engine tests: write/read/delete/reload/compact/integrity."""
+
+import os
+
+import pytest
+
+from seaweedfs_tpu.storage.needle import Needle
+from seaweedfs_tpu.storage.needle_map import MemDb, MemoryNeedleMap
+from seaweedfs_tpu.storage.volume import (
+    CookieMismatchError,
+    DeletedError,
+    NotFoundError,
+    Volume,
+)
+
+
+def make_volume(tmp_path, vid=1, collection=""):
+    return Volume(str(tmp_path), collection, vid)
+
+
+def test_write_read_roundtrip(tmp_path):
+    v = make_volume(tmp_path)
+    n = Needle(cookie=0x11, id=1, data=b"alpha")
+    offset, size, unchanged = v.write_needle(n)
+    assert not unchanged
+    assert offset == 8  # directly after superblock
+    got = v.read_needle(1, cookie=0x11)
+    assert got.data == b"alpha"
+    v.close()
+
+
+def test_write_is_8_byte_aligned(tmp_path):
+    v = make_volume(tmp_path)
+    offsets = []
+    for i in range(1, 20):
+        n = Needle(cookie=i, id=i, data=b"x" * i)
+        offset, _, _ = v.write_needle(n)
+        offsets.append(offset)
+    assert all(o % 8 == 0 for o in offsets)
+    v.close()
+
+
+def test_unchanged_write_dedupe(tmp_path):
+    v = make_volume(tmp_path)
+    v.write_needle(Needle(cookie=5, id=9, data=b"same"))
+    size_before = v.data_size
+    _, _, unchanged = v.write_needle(Needle(cookie=5, id=9, data=b"same"))
+    assert unchanged
+    assert v.data_size == size_before
+    v.close()
+
+
+def test_overwrite_cookie_check(tmp_path):
+    v = make_volume(tmp_path)
+    v.write_needle(Needle(cookie=5, id=9, data=b"one"))
+    with pytest.raises(CookieMismatchError):
+        v.write_needle(Needle(cookie=6, id=9, data=b"two"))
+    v.close()
+
+
+def test_delete_and_tombstone(tmp_path):
+    v = make_volume(tmp_path)
+    v.write_needle(Needle(cookie=1, id=7, data=b"doomed"))
+    deleted_size = v.delete_needle(Needle(cookie=1, id=7))
+    assert deleted_size > 0
+    with pytest.raises((DeletedError, NotFoundError)):
+        v.read_needle(7)
+    v.close()
+
+
+def test_reload_from_disk(tmp_path):
+    v = make_volume(tmp_path)
+    for i in range(1, 10):
+        v.write_needle(Needle(cookie=i, id=i, data=bytes([i]) * 100))
+    v.delete_needle(Needle(cookie=3, id=3))
+    v.close()
+
+    v2 = make_volume(tmp_path)
+    assert v2.read_needle(5).data == b"\x05" * 100
+    with pytest.raises((DeletedError, NotFoundError)):
+        v2.read_needle(3)
+    assert v2.nm.file_counter == 9
+    assert v2.nm.deletion_counter == 1
+    v2.close()
+
+
+def test_compact_reclaims_space(tmp_path):
+    v = make_volume(tmp_path)
+    for i in range(1, 11):
+        v.write_needle(Needle(cookie=i, id=i, data=bytes([i]) * 1000))
+    for i in range(1, 6):
+        v.delete_needle(Needle(cookie=i, id=i))
+    size_before = v.data_size
+    assert v.garbage_ratio() > 0
+    v.compact()
+    v.commit_compact()
+    assert v.data_size < size_before
+    assert v.super_block.compaction_revision == 1
+    for i in range(6, 11):
+        assert v.read_needle(i).data == bytes([i]) * 1000
+    for i in range(1, 6):
+        with pytest.raises((DeletedError, NotFoundError)):
+            v.read_needle(i)
+    v.close()
+
+
+def test_torn_write_truncation(tmp_path):
+    v = make_volume(tmp_path)
+    v.write_needle(Needle(cookie=1, id=1, data=b"full record"))
+    good_size = v.data_size
+    v.close()
+    # simulate a torn write: garbage appended past the last indexed needle
+    with open(os.path.join(str(tmp_path), "1.dat"), "ab") as f:
+        f.write(b"\xde\xad\xbe\xef")
+    v2 = make_volume(tmp_path)
+    assert v2.data_size == good_size
+    assert v2.read_needle(1).data == b"full record"
+    v2.close()
+
+
+def test_scan_visits_all_records(tmp_path):
+    v = make_volume(tmp_path)
+    for i in range(1, 6):
+        v.write_needle(Needle(cookie=i, id=i, data=bytes([i]) * 10))
+    seen = []
+    v.scan(lambda n, off: seen.append((n.id, off)))
+    assert [s[0] for s in seen] == [1, 2, 3, 4, 5]
+    v.close()
+
+
+def test_needle_map_replay_counters(tmp_path):
+    idx_path = str(tmp_path / "x.idx")
+    nm = MemoryNeedleMap(idx_path)
+    nm.put(1, 8, 100)
+    nm.put(2, 120, 200)
+    nm.put(1, 328, 150)  # overwrite
+    nm.delete(2, 536)
+    nm.close()
+
+    nm2 = MemoryNeedleMap.load(idx_path)
+    assert nm2.get(1).size == 150
+    assert nm2.get(2) is None
+    assert nm2.file_counter == 3
+    assert nm2.deletion_counter == 2
+    assert nm2.max_file_key == 2
+    nm2.close()
+
+
+def test_memdb_sorted_file(tmp_path):
+    db = MemDb()
+    for key in (5, 1, 9, 3):
+        db.set(key, key * 8, 10)
+    out = str(tmp_path / "sorted.ecx")
+    db.write_sorted_file(out)
+    from seaweedfs_tpu.storage.idx import iter_index_file
+
+    keys = [k for k, _, _ in iter_index_file(out)]
+    assert keys == [1, 3, 5, 9]
