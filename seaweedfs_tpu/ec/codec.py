@@ -1,0 +1,135 @@
+"""Reed-Solomon codec over GF(2^8) — CPU (numpy) engine + engine protocol.
+
+Semantics-compatible with the reference's klauspost/reedsolomon usage:
+``Encode`` fills parity shards (ec_encoder.go:179), ``Reconstruct`` fills any
+missing shards from >= data_shards survivors (ec_encoder.go:270,
+store_ec.go:331), ``ReconstructData`` only restores data shards
+(store_ec.go:367).  The heavy operation in all three is one GF matmul
+``out[R,B] = M[R,K] . shards[K,B]``; engines provide that matmul:
+
+  - CpuEngine: numpy 256x256-LUT gather + XOR reduction
+  - TpuEngine (seaweedfs_tpu.ops.gf_matmul): bit-plane XLA/Pallas matmul
+
+Both produce byte-identical output; tests enforce it.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Protocol, Sequence
+
+import numpy as np
+
+from .gf256 import MUL_TABLE, build_cauchy_matrix, build_encoding_matrix, mat_invert
+
+
+class GfMatmulEngine(Protocol):
+    name: str
+
+    def matmul(self, m: np.ndarray, shards: np.ndarray) -> np.ndarray:
+        """out[R, B] = m[R, K] . shards[K, B] over GF(2^8); all uint8."""
+        ...
+
+
+class CpuEngine:
+    """Vectorized numpy GF matmul: R*K gathers through the 64KB mul table."""
+
+    name = "cpu"
+
+    def matmul(self, m: np.ndarray, shards: np.ndarray) -> np.ndarray:
+        r, k = m.shape
+        out = np.zeros((r, shards.shape[1]), dtype=np.uint8)
+        for j in range(k):
+            # MUL_TABLE[m[:, j]] is [R, 256]; fancy-index by the data column
+            out ^= MUL_TABLE[m[:, j][:, None], shards[j][None, :]]
+        return out
+
+
+class ReedSolomon:
+    """One (data, parity) geometry with its cached encoding matrix."""
+
+    def __init__(self, data_shards: int, parity_shards: int,
+                 matrix_kind: str = "vandermonde",
+                 engine: Optional[GfMatmulEngine] = None):
+        if data_shards <= 0 or parity_shards <= 0:
+            raise ValueError("shard counts must be positive")
+        if data_shards + parity_shards > 256:
+            raise ValueError("too many shards for GF(2^8)")
+        self.data_shards = data_shards
+        self.parity_shards = parity_shards
+        self.total_shards = data_shards + parity_shards
+        self.matrix_kind = matrix_kind
+        if matrix_kind == "cauchy":
+            self.matrix = build_cauchy_matrix(data_shards, self.total_shards)
+        else:
+            self.matrix = build_encoding_matrix(data_shards, self.total_shards)
+        self.parity_matrix = self.matrix[data_shards:]
+        self.engine: GfMatmulEngine = engine or CpuEngine()
+
+    # --- core ---------------------------------------------------------
+    def encode(self, data: np.ndarray) -> np.ndarray:
+        """data[data_shards, B] -> parity[parity_shards, B]."""
+        if data.shape[0] != self.data_shards:
+            raise ValueError(f"expected {self.data_shards} data shards")
+        return self.engine.matmul(self.parity_matrix, np.ascontiguousarray(data))
+
+    def encode_shards(self, shards: list[np.ndarray]) -> None:
+        """klauspost Encode: shards[0:data] in, shards[data:total] overwritten."""
+        data = np.stack(shards[: self.data_shards])
+        parity = self.encode(data)
+        for i in range(self.parity_shards):
+            shards[self.data_shards + i][:] = parity[i]
+
+    def verify(self, shards: Sequence[np.ndarray]) -> bool:
+        data = np.stack(shards[: self.data_shards])
+        parity = self.encode(data)
+        return all(
+            np.array_equal(parity[i], shards[self.data_shards + i])
+            for i in range(self.parity_shards)
+        )
+
+    def reconstruct(self, shards: list[Optional[np.ndarray]],
+                    data_only: bool = False) -> None:
+        """Fill None entries in-place from >= data_shards survivors.
+
+        Mirrors klauspost Reconstruct/ReconstructData: build the decode
+        matrix from the first data_shards present shards' encoding-matrix
+        rows, invert, recover missing data, then (unless data_only)
+        recompute missing parity from the restored data rows.
+        """
+        if len(shards) != self.total_shards:
+            raise ValueError(f"expected {self.total_shards} shards")
+        present = [i for i, s in enumerate(shards) if s is not None]
+        if len(present) == self.total_shards:
+            return
+        if len(present) < self.data_shards:
+            raise ValueError("too few shards to reconstruct")
+        size = next(len(shards[i]) for i in present)
+
+        sub_rows = present[: self.data_shards]
+        missing_data = [i for i in range(self.data_shards) if shards[i] is None]
+        if missing_data:
+            sub = [list(int(v) for v in self.matrix[i]) for i in sub_rows]
+            decode = np.array(mat_invert(sub), dtype=np.uint8)
+            survivors = np.stack([shards[i] for i in sub_rows])
+            rows = np.stack([decode[i] for i in missing_data])
+            restored = self.engine.matmul(rows, survivors)
+            for out_i, shard_i in enumerate(missing_data):
+                shards[shard_i] = restored[out_i]
+
+        if data_only:
+            return
+        missing_parity = [i for i in range(self.data_shards, self.total_shards)
+                          if shards[i] is None]
+        if missing_parity:
+            data = np.stack(shards[: self.data_shards])
+            rows = np.stack([self.matrix[i] for i in missing_parity])
+            restored = self.engine.matmul(rows, data)
+            for out_i, shard_i in enumerate(missing_parity):
+                shards[shard_i] = restored[out_i]
+        # keep sizes consistent
+        for i in range(self.total_shards):
+            if shards[i] is not None and len(shards[i]) != size:
+                raise ValueError("inconsistent shard sizes")
+
+    def reconstruct_data(self, shards: list[Optional[np.ndarray]]) -> None:
+        self.reconstruct(shards, data_only=True)

@@ -1,0 +1,168 @@
+"""GF(2^8) arithmetic and Reed-Solomon matrix construction.
+
+Field-compatible with the reference's RS codec dependency
+(github.com/klauspost/reedsolomon, used at weed/storage/erasure_coding/
+ec_encoder.go:198): the Backblaze field with generating polynomial 29
+(modulus x^8+x^4+x^3+x^2+1 = 0x11D, generator element 2), and the same
+systematic-Vandermonde encoding matrix construction
+(``vandermonde(total, data)`` rows ``[r^0, r^1, ...]`` multiplied by the
+inverse of its top square), so parity bytes are bit-identical to the
+reference's shards for every geometry.
+
+Everything here is host-side setup math (tiny matrices); the bulk encode
+runs through numpy LUTs (CPU engine) or the TPU bit-plane matmul kernels in
+seaweedfs_tpu.ops.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+_POLY = 0x11D  # x^8 + x^4 + x^3 + x^2 + 1 (generating polynomial 29)
+
+
+def _build_tables() -> tuple[np.ndarray, np.ndarray]:
+    exp = np.zeros(512, dtype=np.uint8)
+    log = np.zeros(256, dtype=np.int32)
+    x = 1
+    for i in range(255):
+        exp[i] = x
+        log[x] = i
+        x <<= 1
+        if x & 0x100:
+            x ^= _POLY
+    exp[255:510] = exp[0:255]  # wraparound so exp[a+b] needs no mod
+    return exp, log
+
+
+EXP_TABLE, LOG_TABLE = _build_tables()
+
+# full 256x256 multiplication table — the CPU engine's LUT and the source of
+# per-constant bit-matrices for the TPU kernel
+_a = np.arange(256, dtype=np.int32)
+MUL_TABLE = np.zeros((256, 256), dtype=np.uint8)
+_nz = _a[1:]
+MUL_TABLE[1:, 1:] = EXP_TABLE[(LOG_TABLE[_nz][:, None] + LOG_TABLE[_nz][None, :])]
+
+
+def gf_mul(a: int, b: int) -> int:
+    if a == 0 or b == 0:
+        return 0
+    return int(EXP_TABLE[LOG_TABLE[a] + LOG_TABLE[b]])
+
+
+def gf_div(a: int, b: int) -> int:
+    if b == 0:
+        raise ZeroDivisionError("GF division by zero")
+    if a == 0:
+        return 0
+    return int(EXP_TABLE[(LOG_TABLE[a] - LOG_TABLE[b]) % 255])
+
+
+def gf_inv(a: int) -> int:
+    return gf_div(1, a)
+
+
+def gf_exp(a: int, n: int) -> int:
+    """a**n — galExp semantics (n==0 -> 1 even for a==0)."""
+    if n == 0:
+        return 1
+    if a == 0:
+        return 0
+    return int(EXP_TABLE[(LOG_TABLE[a] * n) % 255])
+
+
+# --- matrices (lists of lists of int; tiny) ---------------------------------
+
+def mat_mul(a: list[list[int]], b: list[list[int]]) -> list[list[int]]:
+    rows, inner, cols = len(a), len(b), len(b[0])
+    out = [[0] * cols for _ in range(rows)]
+    for r in range(rows):
+        ar = a[r]
+        for c in range(cols):
+            v = 0
+            for k in range(inner):
+                v ^= int(MUL_TABLE[ar[k], b[k][c]])
+            out[r][c] = v
+    return out
+
+
+def mat_identity(n: int) -> list[list[int]]:
+    return [[1 if i == j else 0 for j in range(n)] for i in range(n)]
+
+
+def mat_invert(m: list[list[int]]) -> list[list[int]]:
+    """Gauss-Jordan over GF(2^8).  Raises ValueError on singular input."""
+    n = len(m)
+    aug = [list(row) + ident for row, ident in zip(m, mat_identity(n))]
+    for col in range(n):
+        pivot = next((r for r in range(col, n) if aug[r][col] != 0), None)
+        if pivot is None:
+            raise ValueError("matrix is singular")
+        aug[col], aug[pivot] = aug[pivot], aug[col]
+        inv_p = gf_inv(aug[col][col])
+        aug[col] = [int(MUL_TABLE[inv_p, v]) for v in aug[col]]
+        for r in range(n):
+            if r != col and aug[r][col] != 0:
+                f = aug[r][col]
+                aug[r] = [v ^ int(MUL_TABLE[f, w]) for v, w in zip(aug[r], aug[col])]
+    return [row[n:] for row in aug]
+
+
+def vandermonde(rows: int, cols: int) -> list[list[int]]:
+    return [[gf_exp(r, c) for c in range(cols)] for r in range(rows)]
+
+
+def build_encoding_matrix(data_shards: int, total_shards: int) -> np.ndarray:
+    """klauspost buildMatrix: systematic Vandermonde.  Returns
+    [total_shards, data_shards] u8 with the identity on top."""
+    vm = vandermonde(total_shards, data_shards)
+    top = [row[:] for row in vm[:data_shards]]
+    m = mat_mul(vm, mat_invert(top))
+    return np.array(m, dtype=np.uint8)
+
+
+def build_cauchy_matrix(data_shards: int, total_shards: int) -> np.ndarray:
+    """klauspost WithCauchyMatrix option: identity on top, Cauchy rows
+    1/(r ^ c) below."""
+    m = [[0] * data_shards for _ in range(total_shards)]
+    for r in range(data_shards):
+        m[r][r] = 1
+    for r in range(data_shards, total_shards):
+        for c in range(data_shards):
+            m[r][c] = gf_inv(r ^ c)
+    return np.array(m, dtype=np.uint8)
+
+
+def parity_rows(data_shards: int, parity_shards: int,
+                matrix_kind: str = "vandermonde") -> np.ndarray:
+    total = data_shards + parity_shards
+    if matrix_kind == "cauchy":
+        m = build_cauchy_matrix(data_shards, total)
+    else:
+        m = build_encoding_matrix(data_shards, total)
+    return m[data_shards:]
+
+
+# --- bit-plane decomposition for the TPU kernel -----------------------------
+
+def constant_bit_matrix(c: int) -> np.ndarray:
+    """The 8x8 GF(2) matrix M with (c*x)_i = XOR_j M[i,j]*x_j.
+    Column j of M is the byte c * 2^j."""
+    cols = [gf_mul(c, 1 << j) for j in range(8)]
+    m = np.zeros((8, 8), dtype=np.uint8)
+    for j, v in enumerate(cols):
+        for i in range(8):
+            m[i, j] = (v >> i) & 1
+    return m
+
+
+def expand_matrix_to_bits(gmat: np.ndarray) -> np.ndarray:
+    """[P, D] u8 GF matrix -> [8P, 8D] GF(2) matrix for the bit-plane matmul:
+    parity_bits = (A @ data_bits) mod 2 with bytes unpacked LSB-first."""
+    p, d = gmat.shape
+    out = np.zeros((8 * p, 8 * d), dtype=np.uint8)
+    for i in range(p):
+        for j in range(d):
+            out[8 * i : 8 * i + 8, 8 * j : 8 * j + 8] = constant_bit_matrix(int(gmat[i, j]))
+    return out

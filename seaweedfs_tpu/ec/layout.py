@@ -1,0 +1,94 @@
+"""EC striping geometry: map volume offsets to shard intervals.
+
+Pure address arithmetic, semantics ported 1:1 from
+weed/storage/erasure_coding/ec_locate.go (the easiest place to break
+byte-parity — see SURVEY.md hard-parts list).
+
+A volume `.dat` is striped row-major into DataShardsCount interleaved block
+columns: first `nLargeBlockRows` rows of (data_shards x 1GB) large blocks,
+then rows of (data_shards x 1MB) small blocks (ec_encoder.go:194-231).
+Shard i = the concatenation of column i.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+DATA_SHARDS_COUNT = 10
+PARITY_SHARDS_COUNT = 4
+TOTAL_SHARDS_COUNT = DATA_SHARDS_COUNT + PARITY_SHARDS_COUNT
+LARGE_BLOCK_SIZE = 1024 * 1024 * 1024  # 1GB (ec_encoder.go:21)
+SMALL_BLOCK_SIZE = 1024 * 1024  # 1MB (ec_encoder.go:22)
+EC_BUFFER_SIZE = 256 * 1024  # per-batch IO buffer (ec_encoder.go:58)
+
+
+def to_ext(ec_index: int) -> str:
+    return ".ec%02d" % ec_index
+
+
+@dataclass(frozen=True)
+class Interval:
+    block_index: int
+    inner_block_offset: int
+    size: int
+    is_large_block: bool
+    large_block_rows_count: int
+
+    def to_shard_id_and_offset(self, large_block_size: int,
+                               small_block_size: int,
+                               data_shards: int = DATA_SHARDS_COUNT) -> tuple[int, int]:
+        """ec_locate.go:77-87."""
+        ec_file_offset = self.inner_block_offset
+        row_index = self.block_index // data_shards
+        if self.is_large_block:
+            ec_file_offset += row_index * large_block_size
+        else:
+            ec_file_offset += (self.large_block_rows_count * large_block_size
+                               + row_index * small_block_size)
+        ec_file_index = self.block_index % data_shards
+        return ec_file_index, ec_file_offset
+
+
+def locate_offset_within_blocks(block_length: int, offset: int) -> tuple[int, int]:
+    return offset // block_length, offset % block_length
+
+
+def locate_offset(large_block_length: int, small_block_length: int,
+                  dat_size: int, offset: int,
+                  data_shards: int = DATA_SHARDS_COUNT) -> tuple[int, bool, int]:
+    """ec_locate.go:54-69 -> (block_index, is_large_block, inner_offset)."""
+    large_row_size = large_block_length * data_shards
+    n_large_block_rows = dat_size // (large_block_length * data_shards)
+    if offset < n_large_block_rows * large_row_size:
+        block_index, inner = locate_offset_within_blocks(large_block_length, offset)
+        return block_index, True, inner
+    offset -= n_large_block_rows * large_row_size
+    block_index, inner = locate_offset_within_blocks(small_block_length, offset)
+    return block_index, False, inner
+
+
+def locate_data(large_block_length: int, small_block_length: int,
+                dat_size: int, offset: int, size: int,
+                data_shards: int = DATA_SHARDS_COUNT) -> list[Interval]:
+    """ec_locate.go:15-52: split (offset, size) into per-block intervals."""
+    block_index, is_large, inner = locate_offset(
+        large_block_length, small_block_length, dat_size, offset, data_shards)
+    # +data_shards*small ensures shard size derives the large-row count
+    # (ec_locate.go:18-19)
+    n_large_block_rows = (dat_size + data_shards * small_block_length) // (
+        large_block_length * data_shards)
+
+    intervals: list[Interval] = []
+    while size > 0:
+        block_remaining = (large_block_length if is_large else small_block_length) - inner
+        take = min(size, block_remaining)
+        intervals.append(Interval(block_index, inner, take, is_large, n_large_block_rows))
+        if size <= block_remaining:
+            return intervals
+        size -= take
+        block_index += 1
+        if is_large and block_index == n_large_block_rows * data_shards:
+            is_large = False
+            block_index = 0
+        inner = 0
+    return intervals

@@ -131,7 +131,12 @@ class Needle:
         if self.has(FLAG_HAS_NAME):
             size += 1 + min(len(self.name), 0xFF)
         if self.has(FLAG_HAS_MIME):
-            size += 1 + len(self.mime)
+            # NOTE: divergence from the reference, which wraps MimeSize with
+            # uint8() but writes the FULL mime bytes (needle_read_write.go:
+            # 67,101-105) — a >=256-byte mime there produces a self-
+            # inconsistent record.  We truncate to 255 (like name) instead;
+            # real mime types never approach the limit.
+            size += 1 + min(len(self.mime), 0xFF)
         if self.has(FLAG_HAS_LAST_MODIFIED):
             size += LAST_MODIFIED_BYTES
         if self.has(FLAG_HAS_TTL):
@@ -173,8 +178,9 @@ class Needle:
                 out += bytes([len(name)])
                 out += name
             if self.has(FLAG_HAS_MIME):
-                out += bytes([len(self.mime) & 0xFF])
-                out += self.mime
+                mime = self.mime[: min(len(self.mime), 0xFF)]
+                out += bytes([len(mime)])
+                out += mime
             if self.has(FLAG_HAS_LAST_MODIFIED):
                 out += u64_to_bytes(self.last_modified)[8 - LAST_MODIFIED_BYTES:]
             if self.has(FLAG_HAS_TTL):

@@ -28,7 +28,7 @@ class MemoryNeedleMap:
     """NeedleMapper (storage/needle_map.go:22-36) — memory kind, with the
     `.idx` append log as the persistence mechanism."""
 
-    def __init__(self, index_path: Optional[str] = None):
+    def __init__(self, index_path: Optional[str] = None, replay: bool = False):
         self._m: dict[int, NeedleValue] = {}
         self.index_path = index_path
         self._index_file = None
@@ -38,25 +38,15 @@ class MemoryNeedleMap:
         self.deletion_byte_counter = 0
         self.max_file_key = 0
         if index_path is not None:
+            if replay and os.path.exists(index_path):
+                for key, offset, size in idx_mod.iter_index_file(index_path):
+                    self._replay(key, offset, size)
             self._index_file = open(index_path, "ab")
 
     # --- loading ------------------------------------------------------
     @classmethod
     def load(cls, index_path: str) -> "MemoryNeedleMap":
-        nm = cls.__new__(cls)
-        nm._m = {}
-        nm.index_path = index_path
-        nm._index_file = None
-        nm.file_counter = 0
-        nm.file_byte_counter = 0
-        nm.deletion_counter = 0
-        nm.deletion_byte_counter = 0
-        nm.max_file_key = 0
-        if os.path.exists(index_path):
-            for key, offset, size in idx_mod.iter_index_file(index_path):
-                nm._replay(key, offset, size)
-        nm._index_file = open(index_path, "ab")
-        return nm
+        return cls(index_path, replay=True)
 
     def _replay(self, key: int, offset: int, size: int) -> None:
         """doLoading semantics (needle_map_memory.go:35-56)."""

@@ -21,6 +21,7 @@ from .types import (
     MAX_POSSIBLE_VOLUME_SIZE,
     NEEDLE_HEADER_SIZE,
     NEEDLE_MAP_ENTRY_SIZE,
+    NEEDLE_PADDING_SIZE,
     Version,
     size_is_valid,
 )
@@ -92,9 +93,29 @@ class Volume:
         self._check_integrity()
         self.nm = MemoryNeedleMap.load(self.idx_path)
 
+    def _entry_is_healthy(self, key: int, offset: int, size: int, dat_size: int) -> bool:
+        """Does this idx entry point at a fully-written, matching needle?"""
+        if offset == 0:
+            return True  # no dat record to verify
+        body = needle_body_length(size if size_is_valid(size) else 0, self.version)
+        if offset + NEEDLE_HEADER_SIZE + body > dat_size:
+            return False  # torn .dat tail: record truncated
+        header = os.pread(self._dat.fileno(), NEEDLE_HEADER_SIZE, offset)
+        if len(header) < NEEDLE_HEADER_SIZE:
+            return False
+        n = Needle()
+        n.parse_header(header)
+        if n.id != key:
+            return False
+        if size_is_valid(size) and n.size != size:
+            return False
+        return True
+
     def _check_integrity(self) -> None:
-        """CheckAndFixVolumeDataIntegrity (volume_checking.go:17): verify the
-        last index entry points at a healthy needle; truncate torn writes."""
+        """CheckAndFixVolumeDataIntegrity (volume_checking.go:17-45): walk
+        index entries from the tail, dropping any that point at torn or
+        mismatched needles (e.g. the .idx append survived a crash but the
+        .dat pages didn't), then truncate .dat past the last healthy record."""
         if not os.path.exists(self.idx_path):
             return
         idx_size = os.path.getsize(self.idx_path)
@@ -103,25 +124,37 @@ class Volume:
             with open(self.idx_path, "r+b") as f:
                 f.truncate(idx_size - idx_size % NEEDLE_MAP_ENTRY_SIZE)
             idx_size -= idx_size % NEEDLE_MAP_ENTRY_SIZE
-        if idx_size == 0:
-            return
-        with open(self.idx_path, "rb") as f:
-            f.seek(idx_size - NEEDLE_MAP_ENTRY_SIZE)
-            from .idx import parse_entries
 
-            entry = parse_entries(f.read(NEEDLE_MAP_ENTRY_SIZE))[0]
-        offset = int(entry["offset"]) * 8
-        size = int(entry["size"])
-        if offset == 0:
-            return
-        body = needle_body_length(size, self.version) if size_is_valid(size) else \
-            needle_body_length(0, self.version)
-        expected_end = offset + NEEDLE_HEADER_SIZE + body
-        dat_size = os.path.getsize(self.dat_path)
-        if dat_size > expected_end:
-            # torn write past the last indexed needle: truncate
-            self._dat.truncate(expected_end)
-            self._dat.flush()
+        from .idx import parse_entries
+
+        dat_size = os.fstat(self._dat.fileno()).st_size
+        healthy_idx_size = idx_size
+        last_healthy = None
+        while healthy_idx_size > 0:
+            with open(self.idx_path, "rb") as f:
+                f.seek(healthy_idx_size - NEEDLE_MAP_ENTRY_SIZE)
+                entry = parse_entries(f.read(NEEDLE_MAP_ENTRY_SIZE))[0]
+            key = int(entry["key"])
+            offset = int(entry["offset"]) * NEEDLE_PADDING_SIZE
+            size = int(entry["size"])
+            if self._entry_is_healthy(key, offset, size, dat_size):
+                last_healthy = (key, offset, size)
+                break
+            healthy_idx_size -= NEEDLE_MAP_ENTRY_SIZE
+        if healthy_idx_size != idx_size:
+            with open(self.idx_path, "r+b") as f:
+                f.truncate(healthy_idx_size)
+        if last_healthy is not None:
+            _, offset, size = last_healthy
+            if offset:
+                body = needle_body_length(size if size_is_valid(size) else 0, self.version)
+                expected_end = offset + NEEDLE_HEADER_SIZE + body
+                if dat_size > expected_end:
+                    # torn write past the last indexed needle: truncate
+                    os.ftruncate(self._dat.fileno(), expected_end)
+        elif healthy_idx_size == 0:
+            # nothing indexed: keep only the superblock
+            os.ftruncate(self._dat.fileno(), min(dat_size, self.super_block.block_size))
 
     def close(self) -> None:
         if self.nm is not None:

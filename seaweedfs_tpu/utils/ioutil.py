@@ -1,0 +1,17 @@
+"""Shared low-level IO helpers."""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+
+def pread_padded(f, length: int, offset: int) -> np.ndarray:
+    """Read `length` bytes at `offset` from file object `f`, zero-padding past
+    EOF (the EC tail-block rule, ec_encoder.go:172-176)."""
+    buf = os.pread(f.fileno(), length, offset)
+    arr = np.zeros(length, dtype=np.uint8)
+    if buf:
+        arr[: len(buf)] = np.frombuffer(buf, dtype=np.uint8)
+    return arr

@@ -118,6 +118,29 @@ def test_torn_write_truncation(tmp_path):
     v2.close()
 
 
+def test_torn_dat_tail_with_persisted_idx(tmp_path):
+    """Crash where the .idx append survived but the .dat pages didn't:
+    reopen must drop the orphaned index entry and keep the volume healthy
+    (volume_checking.go:17-45 semantics)."""
+    v = make_volume(tmp_path)
+    v.write_needle(Needle(cookie=1, id=1, data=b"survivor"))
+    survivor_end = v.data_size
+    v.write_needle(Needle(cookie=2, id=2, data=b"lost in the crash"))
+    v.close()
+    # lose the second needle's dat bytes but keep its idx entry
+    with open(os.path.join(str(tmp_path), "1.dat"), "r+b") as f:
+        f.truncate(survivor_end + 10)  # partial record
+    v2 = make_volume(tmp_path)
+    assert v2.read_needle(1).data == b"survivor"
+    with pytest.raises((NotFoundError, DeletedError)):
+        v2.read_needle(2)
+    assert v2.data_size == survivor_end
+    # and the volume accepts new writes cleanly after healing
+    v2.write_needle(Needle(cookie=3, id=3, data=b"after recovery"))
+    assert v2.read_needle(3).data == b"after recovery"
+    v2.close()
+
+
 def test_scan_visits_all_records(tmp_path):
     v = make_volume(tmp_path)
     for i in range(1, 6):
