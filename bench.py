@@ -1,0 +1,143 @@
+#!/usr/bin/env python
+"""Headline benchmark: RS(10,4) ec.encode throughput per chip.
+
+Prints ONE JSON line:
+  value       = sustained TPU encode throughput with data resident in HBM
+                (MB of volume data encoded per second; the chip-side number a
+                production pipeline with overlapped IO converges to)
+  vs_baseline = value / CPU-SIMD engine throughput on this host (the
+                equivalent of the reference's klauspost/reedsolomon AVX2
+                path, which SeaweedFS publishes no EC numbers for —
+                BASELINE.json.published = {})
+
+detail carries every sub-measurement, including the honest end-to-end
+number through this environment's host<->chip tunnel (device_get here runs
+at ~13 MB/s, which bounds any tunneled e2e figure; on directly-attached
+TPU hosts the PCIe path is 3 orders of magnitude faster).
+
+Methodology: the TPU kernel is timed as one jitted fori_loop of N
+data-dependent encodes (each iteration XOR-perturbs the input and the
+parity folds into a scalar), so per-dispatch tunnel latency and lazy
+dispatch cannot distort the figure.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def time_cpu(engine, data, reps=3):
+    from seaweedfs_tpu.ec.codec import ReedSolomon
+
+    rs = ReedSolomon(10, 4, engine=engine)
+    rs.encode(data[:, :1024])  # warm tables
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        rs.encode(data)
+        best = min(best, time.perf_counter() - t0)
+    return data.nbytes / best / 1e6
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from seaweedfs_tpu.ec.codec import CpuEngine, ReedSolomon, best_cpu_engine
+    from seaweedfs_tpu.ec.gf256 import parity_rows
+    from seaweedfs_tpu.ops.gf_matmul import (
+        TpuEngine,
+        expand_matrix_bitplanes,
+        gf_matmul_pallas,
+        gf_matmul_xla,
+    )
+
+    rng = np.random.default_rng(0xBE)
+    detail: dict = {"device": str(jax.devices()[0]), "backend": jax.default_backend()}
+
+    # --- CPU baselines ----------------------------------------------------
+    cpu_data = rng.integers(0, 256, (10, 1 << 24), dtype=np.uint8)  # 160MB
+    simd = best_cpu_engine()
+    detail["cpu_engine"] = simd.name
+    cpu_simd_mbps = time_cpu(simd, cpu_data)
+    detail["cpu_simd_mbps"] = round(cpu_simd_mbps, 1)
+    detail["cpu_numpy_mbps"] = round(time_cpu(CpuEngine(), cpu_data, reps=1), 1)
+
+    # --- TPU in-HBM sustained --------------------------------------------
+    # The Pallas kernel never materializes the 8x bit expansion in HBM, so
+    # the sustained loop runs on a full 640MB-resident encode; the XLA-fused
+    # variant (which does materialize bits) is measured at a smaller size.
+    a_planes = jnp.asarray(expand_matrix_bitplanes(parity_rows(10, 4)))
+
+    # block_until_ready is not reliably synchronous through the remote-chip
+    # tunnel, so completion is forced by device_get of a scalar that depends
+    # on every parity byte, and the fixed tunnel latency cancels by
+    # differencing two iteration counts (slope = time per iteration).
+    def make_loop(encode, n):
+        @jax.jit
+        def bench_loop(a, d):
+            def body(i, acc):
+                di = d ^ i.astype(jnp.uint8)
+                p = encode(a, di)
+                return acc + p.astype(jnp.uint32).sum()
+
+            return jax.lax.fori_loop(0, n, body, jnp.uint32(0))
+
+        return bench_loop
+
+    def run_loop(encode, b, n_lo=10, n_hi=40, planes=None):
+        planes = a_planes if planes is None else planes
+        data = jax.device_put(rng.integers(0, 256, (10, b), dtype=np.uint8))
+        data.block_until_ready()
+        times = {}
+        for n in (n_lo, n_hi):
+            loop = make_loop(encode, n)
+            jax.device_get(loop(planes, data))  # compile + warm
+            t0 = time.perf_counter()
+            jax.device_get(loop(planes, data))
+            times[n] = time.perf_counter() - t0
+        per_iter = (times[n_hi] - times[n_lo]) / (n_hi - n_lo)
+        return data.nbytes / per_iter / 1e6
+
+    tpu_hbm_mbps = run_loop(gf_matmul_pallas, 1 << 26)  # 640MB resident
+    detail["tpu_inhbm_pallas_mbps"] = round(tpu_hbm_mbps, 1)
+    detail["tpu_inhbm_xla_mbps"] = round(run_loop(gf_matmul_xla, 1 << 23), 1)
+
+    # single-shard rebuild latency, 1GB volume: shards are 100MB, decode of
+    # the missing one is a [8,80]x[80,100M] matmul over the 10 survivors
+    shard_b = 100 * (1 << 20)
+    dec_planes = jnp.asarray(expand_matrix_bitplanes(parity_rows(10, 1)))
+    dec_mbps = run_loop(gf_matmul_pallas, shard_b, n_lo=4, n_hi=12,
+                        planes=dec_planes)
+    detail["rebuild_1gb_inhbm_ms"] = round(10 * shard_b / (dec_mbps * 1e6) * 1e3, 2)
+
+    # --- parity check + tunneled e2e -------------------------------------
+    sample = rng.integers(0, 256, (10, 1 << 22), dtype=np.uint8)  # 40MB
+    want = ReedSolomon(10, 4, engine=simd).encode(sample)
+    rs_xla = ReedSolomon(10, 4, engine=TpuEngine(mode="xla"))
+    rs_xla.encode(sample)  # untimed warm-up: jit compile happens here
+    t0 = time.perf_counter()
+    got_xla = rs_xla.encode(sample)
+    e2e_dt = time.perf_counter() - t0
+    got_pallas = ReedSolomon(10, 4, engine=TpuEngine(mode="pallas")).encode(sample)
+    parity_match = bool(np.array_equal(want, got_xla) and np.array_equal(want, got_pallas))
+    detail["parity_match_cpu_xla_pallas"] = parity_match
+    detail["tpu_e2e_tunneled_mbps"] = round(sample.nbytes / e2e_dt / 1e6, 1)
+    detail["note"] = ("value is in-HBM sustained; e2e here is bounded by the "
+                      "dev-tunnel's ~13MB/s device_get readback")
+
+    value = round(tpu_hbm_mbps, 1)
+    print(json.dumps({
+        "metric": "ec.encode MB/s/chip (RS(10,4), in-HBM sustained)",
+        "value": value,
+        "unit": "MB/s",
+        "vs_baseline": round(value / cpu_simd_mbps, 2),
+        "detail": detail,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -44,6 +44,33 @@ class CpuEngine:
         return out
 
 
+class NativeEngine:
+    """C++ AVX2 PSHUFB engine (seaweedfs_tpu/native) — the equivalent of the
+    reference's klauspost/reedsolomon assembly path and the default CPU
+    engine when the toolchain is available."""
+
+    name = "cpu-simd"
+
+    def __init__(self):
+        from .. import native
+
+        if native.load() is None:
+            raise RuntimeError("native gf256 library unavailable")
+        self._matmul = native.gf_matmul
+
+    def matmul(self, m: np.ndarray, shards: np.ndarray) -> np.ndarray:
+        return self._matmul(m, np.ascontiguousarray(shards))
+
+
+def best_cpu_engine() -> GfMatmulEngine:
+    """Native SIMD if buildable, else numpy — mirroring the reference's
+    'assembly when available' behavior."""
+    try:
+        return NativeEngine()
+    except Exception:
+        return CpuEngine()
+
+
 class ReedSolomon:
     """One (data, parity) geometry with its cached encoding matrix."""
 

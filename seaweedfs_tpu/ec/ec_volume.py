@@ -31,7 +31,6 @@ from .layout import (
     LARGE_BLOCK_SIZE,
     PARITY_SHARDS_COUNT,
     SMALL_BLOCK_SIZE,
-    TOTAL_SHARDS_COUNT,
     Interval,
     locate_data,
     to_ext,

@@ -26,13 +26,13 @@ import numpy as np
 
 from ..storage.needle_map import MemDb
 from ..storage.types import NEEDLE_ID_SIZE
+from ..utils.ioutil import pread_padded as _pread_padded
 from .codec import ReedSolomon
 from .layout import (
     DATA_SHARDS_COUNT,
     LARGE_BLOCK_SIZE,
     PARITY_SHARDS_COUNT,
     SMALL_BLOCK_SIZE,
-    TOTAL_SHARDS_COUNT,
     to_ext,
 )
 
@@ -44,8 +44,6 @@ def write_sorted_file_from_idx(base_file_name: str, ext: str = ".ecx") -> None:
     db = MemDb.from_idx_file(base_file_name + ".idx")
     db.write_sorted_file(base_file_name + ext)
 
-
-from ..utils.ioutil import pread_padded as _pread_padded
 
 
 def _encode_row(dat_file, rs: ReedSolomon, start_offset: int, block_size: int,

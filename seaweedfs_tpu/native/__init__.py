@@ -1,0 +1,78 @@
+"""ctypes loader for the native GF(2^8) SIMD library.
+
+Builds lazily with make on first import (cached as libgf256.so); callers
+fall back to the numpy engine when no C++ toolchain is available.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_LIB_PATH = os.path.join(_DIR, "libgf256.so")
+_lib = None
+
+
+def _build() -> bool:
+    try:
+        subprocess.run(["make", "-s", "-C", _DIR], check=True,
+                       capture_output=True, timeout=120)
+        return os.path.exists(_LIB_PATH)
+    except Exception:
+        return False
+
+
+def load():
+    """Returns the ctypes lib or None if unavailable."""
+    global _lib
+    if _lib is not None:
+        return _lib
+    src = os.path.join(_DIR, "gf256_simd.cpp")
+    if not os.path.exists(_LIB_PATH) or (
+        os.path.exists(src) and os.path.getmtime(src) > os.path.getmtime(_LIB_PATH)
+    ):
+        if not _build():
+            return None
+    try:
+        lib = ctypes.CDLL(_LIB_PATH)
+    except OSError:
+        return None
+    lib.gf_init.argtypes = [ctypes.c_char_p]
+    lib.gf_matmul.argtypes = [
+        ctypes.POINTER(ctypes.c_uint8), ctypes.c_int, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_uint8),
+        ctypes.c_long,
+    ]
+    lib.gf_has_avx2.restype = ctypes.c_int
+
+    from ..ec.gf256 import MUL_TABLE
+
+    lib.gf_init(MUL_TABLE.tobytes())
+    _lib = lib
+    return lib
+
+
+def has_avx2() -> bool:
+    lib = load()
+    return bool(lib and lib.gf_has_avx2())
+
+
+def gf_matmul(mat: np.ndarray, data: np.ndarray) -> np.ndarray:
+    """out[R, B] = mat[R, K] . data[K, B] over GF(2^8) via the native lib."""
+    lib = load()
+    if lib is None:
+        raise RuntimeError("native gf256 library unavailable")
+    mat = np.ascontiguousarray(mat, dtype=np.uint8)
+    data = np.ascontiguousarray(data, dtype=np.uint8)
+    r, k = mat.shape
+    n = data.shape[1]
+    out = np.empty((r, n), dtype=np.uint8)
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    lib.gf_matmul(mat.ctypes.data_as(u8p), r, k,
+                  data.ctypes.data_as(u8p), out.ctypes.data_as(u8p),
+                  ctypes.c_long(n))
+    return out

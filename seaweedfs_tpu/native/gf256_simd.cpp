@@ -1,0 +1,119 @@
+// GF(2^8) region multiply-accumulate — the CPU default engine.
+//
+// The TPU-native rebuild still needs a first-class CPU path (the reference's
+// default is klauspost/reedsolomon's AVX2 assembly, weed/storage/
+// erasure_coding/ec_encoder.go:198).  This is the same technique: split each
+// byte into nibbles and use two 16-entry PSHUFB lookup tables per constant,
+// processing 32 bytes per instruction on AVX2, with a plain table fallback.
+// Tables are injected from Python (seaweedfs_tpu.ec.gf256) so field/matrix
+// construction lives in exactly one place.
+//
+// Build: see Makefile (g++ -O3, per-function target attributes; no global
+// -mavx2 so the scalar path stays runnable on any x86_64).
+
+#include <cstdint>
+#include <cstring>
+
+#if defined(__x86_64__) || defined(_M_X64)
+#include <immintrin.h>
+#define HAVE_X86 1
+#endif
+
+static uint8_t MUL_LO[256][16]; // MUL_LO[c][x]  = c * x        (low nibble)
+static uint8_t MUL_HI[256][16]; // MUL_HI[c][x]  = c * (x<<4)   (high nibble)
+static uint8_t MUL[256][256];   // full table for the scalar path
+
+extern "C" void gf_init(const uint8_t *mul_table /* [256][256] */) {
+    std::memcpy(MUL, mul_table, 256 * 256);
+    for (int c = 0; c < 256; c++) {
+        for (int x = 0; x < 16; x++) {
+            MUL_LO[c][x] = mul_table[c * 256 + x];
+            MUL_HI[c][x] = mul_table[c * 256 + (x << 4)];
+        }
+    }
+}
+
+static void mul_add_region_scalar(uint8_t c, const uint8_t *in, uint8_t *out,
+                                  long n) {
+    const uint8_t *row = MUL[c];
+    for (long i = 0; i < n; i++)
+        out[i] ^= row[in[i]];
+}
+
+#if HAVE_X86
+__attribute__((target("avx2"))) static void
+mul_add_region_avx2(uint8_t c, const uint8_t *in, uint8_t *out, long n) {
+    const __m256i lo_tbl =
+        _mm256_broadcastsi128_si256(_mm_loadu_si128((const __m128i *)MUL_LO[c]));
+    const __m256i hi_tbl =
+        _mm256_broadcastsi128_si256(_mm_loadu_si128((const __m128i *)MUL_HI[c]));
+    const __m256i nib = _mm256_set1_epi8(0x0f);
+    long i = 0;
+    for (; i + 32 <= n; i += 32) {
+        __m256i v = _mm256_loadu_si256((const __m256i *)(in + i));
+        __m256i lo = _mm256_and_si256(v, nib);
+        __m256i hi = _mm256_and_si256(_mm256_srli_epi16(v, 4), nib);
+        __m256i r = _mm256_xor_si256(_mm256_shuffle_epi8(lo_tbl, lo),
+                                     _mm256_shuffle_epi8(hi_tbl, hi));
+        __m256i o = _mm256_loadu_si256((const __m256i *)(out + i));
+        _mm256_storeu_si256((__m256i *)(out + i), _mm256_xor_si256(o, r));
+    }
+    if (i < n)
+        mul_add_region_scalar(c, in + i, out + i, n - i);
+}
+#endif
+
+static bool has_avx2() {
+#if HAVE_X86
+    return __builtin_cpu_supports("avx2");
+#else
+    return false;
+#endif
+}
+
+static void mul_add_region(uint8_t c, const uint8_t *in, uint8_t *out, long n) {
+#if HAVE_X86
+    static const bool avx2 = has_avx2();
+    if (avx2) {
+        mul_add_region_avx2(c, in, out, n);
+        return;
+    }
+#endif
+    mul_add_region_scalar(c, in, out, n);
+}
+
+static void xor_region(const uint8_t *in, uint8_t *out, long n) {
+    long i = 0;
+    for (; i + 8 <= n; i += 8)
+        *(uint64_t *)(out + i) ^= *(const uint64_t *)(in + i);
+    for (; i < n; i++)
+        out[i] ^= in[i];
+}
+
+// out[R, n] = mat[R, K] . data[K, n] over GF(2^8).
+// data rows are contiguous [K][n]; out rows [R][n] are overwritten.
+// Tiled over n so a K-row input block stays L2-resident across all R output
+// rows instead of re-streaming from DRAM per row.
+extern "C" void gf_matmul(const uint8_t *mat, int rows, int k,
+                          const uint8_t *data, uint8_t *out, long n) {
+    const long TILE = 1 << 16; // 64KB per row-chunk; K*TILE fits in L2
+    for (long off = 0; off < n; off += TILE) {
+        long len = (n - off < TILE) ? (n - off) : TILE;
+        for (int r = 0; r < rows; r++) {
+            uint8_t *orow = out + (long)r * n + off;
+            std::memset(orow, 0, len);
+            for (int j = 0; j < k; j++) {
+                uint8_t c = mat[r * k + j];
+                const uint8_t *irow = data + (long)j * n + off;
+                if (c == 0)
+                    continue;
+                if (c == 1)
+                    xor_region(irow, orow, len);
+                else
+                    mul_add_region(c, irow, orow, len);
+            }
+        }
+    }
+}
+
+extern "C" int gf_has_avx2() { return has_avx2() ? 1 : 0; }

@@ -35,7 +35,7 @@ from jax.experimental.pallas import tpu as pltpu
 from ..ec.gf256 import expand_matrix_to_bits
 
 LANE = 128
-DEFAULT_TILE_B = 2048
+DEFAULT_TILE_B = 8192  # best measured on v5e (48GB/s sustained loop)
 
 
 def expand_matrix_bitplanes(gmat: np.ndarray) -> np.ndarray:
@@ -71,19 +71,19 @@ def gf_matmul_xla(a_planes: jnp.ndarray, data: jnp.ndarray) -> jnp.ndarray:
     """a_planes [8R, 8K] u8 (from expand_matrix_bitplanes), data [K, B] u8
     -> [R, B] u8."""
     r8 = a_planes.shape[0]
-    bits = _unpack_bitplanes(data).astype(jnp.bfloat16)
-    acc = jnp.dot(a_planes.astype(jnp.int32).astype(jnp.bfloat16), bits,
-                  preferred_element_type=jnp.float32)
-    return _pack_bits(acc.astype(jnp.int32) & 1, r8 // 8)
+    bits = _unpack_bitplanes(data).astype(jnp.int8)
+    acc = jnp.dot(a_planes.astype(jnp.int8), bits,
+                  preferred_element_type=jnp.int32)
+    return _pack_bits(acc & 1, r8 // 8)
 
 
 def _gf_kernel(a_ref, d_ref, o_ref):
-    # Mosaic has no direct u8->bf16 cast; go through i32 -> f32 -> bf16
+    # v5e MXU does native int8 x int8 -> int32; unpack must go through i32
+    # (Mosaic has no packed u8 shifts), the dot runs in i8
     bits = _unpack_bitplanes(d_ref[:])  # [8K, TB] i32
-    a = a_ref[:].astype(jnp.int32).astype(jnp.float32).astype(jnp.bfloat16)
-    b = bits.astype(jnp.float32).astype(jnp.bfloat16)
-    acc = jnp.dot(a, b, preferred_element_type=jnp.float32)  # [8R, TB]
-    o_ref[:] = _pack_bits(acc.astype(jnp.int32) & 1, o_ref.shape[0])
+    acc = jnp.dot(a_ref[:].astype(jnp.int8), bits.astype(jnp.int8),
+                  preferred_element_type=jnp.int32)  # [8R, TB]
+    o_ref[:] = _pack_bits(acc & 1, o_ref.shape[0])
 
 
 @functools.partial(jax.jit, static_argnames=("tile_b", "interpret"))

@@ -1,0 +1,100 @@
+"""Multi-chip sharded GF(2^8) encode over a jax.sharding.Mesh.
+
+The distribution story for the EC pipeline (SURVEY.md §2.11): batch many
+stripes per launch and shard them across chips.  Three mesh axes, all real:
+
+  - "dp"  — stripe-batch data parallel: independent volumes/rows
+  - "sp"  — byte-stream parallel: the B axis within a stripe (the
+            sequence-parallel analog for a storage workload)
+  - "tp"  — tensor parallel over the CONTRACTION: the 8K bit-plane rows are
+            split across chips, each computes a partial popcount, and a
+            psum over "tp" folds them before the mod-2.  This works because
+            XOR == mod-2 addition: counts add across devices, parity is the
+            sum's low bit.
+
+Collectives ride the mesh exactly like a sharded matmul's — psum over tp —
+so XLA lays them on ICI.  dp/sp need no communication (parity is pointwise
+in the byte-stream).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from ..ops.gf_matmul import _pack_bits, _unpack_bitplanes
+
+
+def make_mesh(dp: int = 1, sp: int = 1, tp: int = 1,
+              devices: list | None = None) -> Mesh:
+    devices = devices if devices is not None else jax.devices()
+    n = dp * sp * tp
+    if len(devices) < n:
+        raise ValueError(f"need {n} devices, have {len(devices)}")
+    dev = np.array(devices[:n]).reshape(dp, sp, tp)
+    return Mesh(dev, axis_names=("dp", "sp", "tp"))
+
+
+def _local_gf_matmul(a_loc: jnp.ndarray, data_loc: jnp.ndarray) -> jnp.ndarray:
+    """Per-device shard of the bit-plane matmul.
+
+    a_loc   [8R, 8K/tp] — this device's slice of contraction columns
+    data_loc[K, S/dp, B/sp] — this device's stripe/byte block (full K)
+    returns [R, S/dp, B/sp] parity block (replicated over tp)
+    """
+    k, s, b = data_loc.shape
+    flat = data_loc.reshape(k, s * b)
+    bits = _unpack_bitplanes(flat)  # [8K, s*b] bit-plane-major rows
+    # slice this device's contraction rows to match a_loc's columns
+    tp_idx = jax.lax.axis_index("tp")
+    rows = a_loc.shape[1]
+    my_bits = jax.lax.dynamic_slice_in_dim(bits, tp_idx * rows, rows, axis=0)
+    acc = jnp.dot(a_loc.astype(jnp.int8), my_bits.astype(jnp.int8),
+                  preferred_element_type=jnp.int32)
+    acc = jax.lax.psum(acc, "tp")  # fold partial popcounts across tp
+    out = _pack_bits(acc & 1, a_loc.shape[0] // 8)
+    return out.reshape(-1, s, b)
+
+
+def sharded_encode_fn(mesh: Mesh):
+    """Build a jitted sharded encode: (a_planes [8R, 8K], data [K, S, B])
+    -> parity [R, S, B], with S sharded over dp, B over sp, and the
+    contraction over tp."""
+
+    shmap = jax.shard_map(
+        _local_gf_matmul,
+        mesh=mesh,
+        in_specs=(P(None, "tp"), P(None, "dp", "sp")),
+        out_specs=P(None, "dp", "sp"),
+    )
+    return jax.jit(shmap)
+
+
+def training_step_fn(mesh: Mesh):
+    """The 'full step' the driver dry-runs: sharded encode + sharded
+    self-check (re-derive one data shard from parity + the rest, the
+    degraded-read path) + a psum'd mismatch metric.  Exercises every mesh
+    axis and the tp collective in one jitted program."""
+
+    encode = sharded_encode_fn(mesh)
+
+    def step(a_planes, decode_planes, data):
+        parity = encode(a_planes, data)
+        # degraded-read check: reconstruct data shard 0 from shards 1..K-1
+        # plus parity row 0, using the precomputed decode matrix planes
+        recon_in = jnp.concatenate([data[1:], parity[:1]], axis=0)
+        recovered = encode(decode_planes, recon_in)
+        mismatches = jnp.sum((recovered[0] != data[0]).astype(jnp.int32))
+        return parity, mismatches
+
+    return jax.jit(step)
+
+
+def shard_data(mesh: Mesh, data: np.ndarray) -> jax.Array:
+    """Place [K, S, B] host data onto the mesh with the encode sharding."""
+    return jax.device_put(data, NamedSharding(mesh, P(None, "dp", "sp")))

@@ -130,17 +130,21 @@ class Volume:
         dat_size = os.fstat(self._dat.fileno()).st_size
         healthy_idx_size = idx_size
         last_healthy = None
-        while healthy_idx_size > 0:
-            with open(self.idx_path, "rb") as f:
-                f.seek(healthy_idx_size - NEEDLE_MAP_ENTRY_SIZE)
-                entry = parse_entries(f.read(NEEDLE_MAP_ENTRY_SIZE))[0]
-            key = int(entry["key"])
-            offset = int(entry["offset"]) * NEEDLE_PADDING_SIZE
-            size = int(entry["size"])
-            if self._entry_is_healthy(key, offset, size, dat_size):
-                last_healthy = (key, offset, size)
-                break
-            healthy_idx_size -= NEEDLE_MAP_ENTRY_SIZE
+        # walk the tail in blocks, newest entry first, vectorized parse
+        block_entries = 1024
+        with open(self.idx_path, "rb") as f:
+            while healthy_idx_size > 0 and last_healthy is None:
+                start = max(0, healthy_idx_size - block_entries * NEEDLE_MAP_ENTRY_SIZE)
+                f.seek(start)
+                entries = parse_entries(f.read(healthy_idx_size - start))
+                for i in range(len(entries) - 1, -1, -1):
+                    key = int(entries["key"][i])
+                    offset = int(entries["offset"][i]) * NEEDLE_PADDING_SIZE
+                    size = int(entries["size"][i])
+                    if self._entry_is_healthy(key, offset, size, dat_size):
+                        last_healthy = (key, offset, size)
+                        break
+                    healthy_idx_size -= NEEDLE_MAP_ENTRY_SIZE
         if healthy_idx_size != idx_size:
             with open(self.idx_path, "r+b") as f:
                 f.truncate(healthy_idx_size)
@@ -152,9 +156,10 @@ class Volume:
                 if dat_size > expected_end:
                     # torn write past the last indexed needle: truncate
                     os.ftruncate(self._dat.fileno(), expected_end)
-        elif healthy_idx_size == 0:
-            # nothing indexed: keep only the superblock
-            os.ftruncate(self._dat.fileno(), min(dat_size, self.super_block.block_size))
+        # NOTE: when no healthy entry remains (empty or fully-torn .idx) the
+        # .dat is deliberately left untouched — it may hold recoverable
+        # needles that a scan() pass can re-index (reference leaves .dat
+        # intact in this case too).
 
     def close(self) -> None:
         if self.nm is not None:

@@ -119,7 +119,26 @@ def test_too_few_shards():
 def _engines():
     from seaweedfs_tpu.ops.gf_matmul import TpuEngine
 
-    return [TpuEngine(mode="xla"), TpuEngine(mode="pallas")]
+    engines = [TpuEngine(mode="xla"), TpuEngine(mode="pallas")]
+    try:
+        from seaweedfs_tpu.ec.codec import NativeEngine
+
+        engines.append(NativeEngine())
+    except Exception:
+        pass  # no C++ toolchain in this environment
+    return engines
+
+
+def test_native_engine_available():
+    """The C++ SIMD engine must build wherever a toolchain exists — it is
+    the default CPU path and the bench baseline."""
+    import shutil
+
+    if shutil.which("make") is None or shutil.which("g++") is None:
+        pytest.skip("no C++ toolchain")
+    from seaweedfs_tpu.ec.codec import NativeEngine, best_cpu_engine
+
+    assert isinstance(best_cpu_engine(), NativeEngine)
 
 
 @pytest.mark.parametrize("d,p", [(10, 4), (6, 3), (12, 4)])
