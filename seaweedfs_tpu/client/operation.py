@@ -1,0 +1,113 @@
+"""Client SDK verbs: assign, upload, lookup, delete, submit.
+
+Equivalent of weed/operation/ (assign_file_id.go:37, upload_content.go,
+lookup.go, delete_content.go, submit.go) + wdclient's vid->location cache
+(wdclient/vid_map.go).
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+from ..utils.httpd import HttpError, http_bytes, http_json
+
+
+@dataclass
+class Assignment:
+    fid: str
+    url: str
+    public_url: str
+    count: int
+
+
+class MasterClient:
+    """vid -> locations cache with TTL (wdclient/vid_map.go:44-160)."""
+
+    def __init__(self, master_url: str, cache_seconds: float = 10.0):
+        self.master_url = master_url
+        self.cache_seconds = cache_seconds
+        self._cache: dict[int, tuple[float, list[str]]] = {}
+
+    def assign(self, count: int = 1, collection: str = "",
+               replication: str = "", ttl: str = "",
+               data_center: str = "") -> Assignment:
+        import urllib.parse
+
+        q = urllib.parse.urlencode({
+            "count": count, "collection": collection,
+            "replication": replication, "ttl": ttl,
+            "dataCenter": data_center})
+        r = http_json("GET", f"http://{self.master_url}/dir/assign?{q}")
+        if "error" in r and r["error"]:
+            raise HttpError(500, r["error"])
+        return Assignment(r["fid"], r["url"], r.get("publicUrl", r["url"]),
+                          int(r.get("count", count)))
+
+    def lookup(self, vid: int) -> list[str]:
+        cached = self._cache.get(vid)
+        now = time.time()
+        if cached and now - cached[0] < self.cache_seconds:
+            return cached[1]
+        r = http_json("GET",
+                      f"http://{self.master_url}/dir/lookup?volumeId={vid}")
+        urls = [loc["url"] for loc in r.get("locations", [])]
+        self._cache[vid] = (now, urls)
+        return urls
+
+    def invalidate(self, vid: int) -> None:
+        self._cache.pop(vid, None)
+
+
+class WeedClient:
+    """High-level one-shot operations (operation/submit.go flavor)."""
+
+    def __init__(self, master_url: str):
+        self.master = MasterClient(master_url)
+
+    def upload(self, data: bytes, name: str = "", mime: str = "",
+               collection: str = "", replication: str = "",
+               ttl: str = "") -> str:
+        """Assign + PUT; returns the fid."""
+        import urllib.parse
+
+        a = self.master.assign(collection=collection, replication=replication,
+                               ttl=ttl)
+        params = {}
+        if name:
+            params["name"] = name
+        if ttl:
+            params["ttl"] = ttl
+        q = "?" + urllib.parse.urlencode(params) if params else ""
+        status, body, _ = http_bytes(
+            "POST", f"http://{a.url}/{a.fid}{q}", data,
+            headers={"Content-Type": mime} if mime else None)
+        if status not in (200, 201):
+            raise HttpError(status, body.decode(errors="replace"))
+        return a.fid
+
+    def download(self, fid: str) -> bytes:
+        vid = int(fid.split(",")[0])
+        urls = self.master.lookup(vid)
+        if not urls:
+            raise HttpError(404, f"volume {vid} has no locations")
+        last_err = None
+        for url in random.sample(urls, len(urls)):
+            status, body, _ = http_bytes("GET", f"http://{url}/{fid}")
+            if status == 200:
+                return body
+            if status == 302:
+                continue
+            if status == 0:  # dead server: fail over to the next replica
+                self.master.invalidate(vid)
+            last_err = HttpError(status or 503, body.decode(errors="replace"))
+        raise last_err or HttpError(404, "not found")
+
+    def delete(self, fid: str) -> None:
+        vid = int(fid.split(",")[0])
+        for url in self.master.lookup(vid):
+            http_bytes("DELETE", f"http://{url}/{fid}")
+            return
+        raise HttpError(404, f"volume {vid} has no locations")

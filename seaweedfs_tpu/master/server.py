@@ -1,0 +1,243 @@
+"""Master server: assign/lookup/grow/vacuum + heartbeat ingest + admin lock.
+
+HTTP equivalent of weed/server/master_server*.go + master_grpc_server*.go:
+  GET  /dir/assign     — fid allocation (PickForWrite or trigger growth)
+  GET  /dir/lookup     — vid -> locations (normal + EC volumes)
+  GET  /dir/status     — topology dump
+  POST /heartbeat      — volume-server full sync (volumes + EC shards)
+  GET  /vol/grow       — force growth
+  GET  /vol/vacuum     — trigger cluster vacuum
+  GET  /cluster/status — leader info (single-master for now; the raft seam
+                         is MasterServer.is_leader/leader_url)
+  POST /admin/lease, /admin/release — exclusive shell lock
+                         (master_grpc_server_admin.go:73-150)
+"""
+
+from __future__ import annotations
+
+import random
+import secrets
+import threading
+import time
+from typing import Optional
+
+from ..storage.file_id import format_needle_id_cookie
+from ..storage.super_block import ReplicaPlacement
+from ..storage.ttl import TTL
+from ..utils.httpd import HttpError, Request, Response, Router, http_json, serve
+from .sequence import MemorySequencer, SnowflakeSequencer
+from .topology import EcVolumeInfo, ShardBits, Topology, VolumeInfo
+from .volume_growth import grow_volume
+
+
+class MasterServer:
+    def __init__(self, host: str = "127.0.0.1", port: int = 9333,
+                 volume_size_limit_mb: int = 30000,
+                 default_replication: str = "000",
+                 sequencer: str = "memory",
+                 garbage_threshold: float = 0.3,
+                 pulse_seconds: float = 5.0):
+        self.host, self.port = host, port
+        self.topo = Topology(volume_size_limit_mb * 1024 * 1024, pulse_seconds)
+        self.default_replication = default_replication
+        self.garbage_threshold = garbage_threshold
+        self.seq = (SnowflakeSequencer() if sequencer == "snowflake"
+                    else MemorySequencer())
+        self.router = Router("master")
+        self._register_routes()
+        self._server = None
+        self._stop = threading.Event()
+        # admin lock (shell exclusivity)
+        self._admin_token: Optional[int] = None
+        self._admin_lock_ts = 0.0
+        self._admin_client = ""
+
+    # --- lifecycle --------------------------------------------------------
+    @property
+    def url(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def start(self) -> "MasterServer":
+        self._server = serve(self.router, self.host, self.port)
+        threading.Thread(target=self._janitor_loop, daemon=True,
+                         name="master-janitor").start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._server:
+            self._server.shutdown()
+
+    def _janitor_loop(self) -> None:
+        while not self._stop.wait(self.topo.pulse_seconds):
+            for node in self.topo.dead_nodes():
+                self.topo.unregister_node(node)
+
+    # --- routes -----------------------------------------------------------
+    def _register_routes(self) -> None:
+        r = self.router
+
+        @r.route("GET", "/dir/assign")
+        def assign(req: Request) -> Response:
+            count = int(req.query.get("count", 1))
+            collection = req.query.get("collection", "")
+            replication = req.query.get("replication") or self.default_replication
+            ttl = TTL.parse(req.query.get("ttl", ""))
+            rp = ReplicaPlacement.parse(replication)
+            layout = self.topo.get_layout(collection, rp, ttl)
+            try:
+                vid, nodes = layout.pick_for_write()
+            except LookupError:
+                grow_volume(self.topo, collection, rp, ttl, self._allocate_rpc,
+                            preferred_dc=req.query.get("dataCenter", ""))
+                vid, nodes = layout.pick_for_write()
+            key = self.seq.next_file_id(count)
+            cookie = secrets.randbits(32)
+            node = random.choice(nodes)
+            return Response({
+                "fid": f"{vid},{format_needle_id_cookie(key, cookie)}",
+                "url": node.url,
+                "publicUrl": node.public_url,
+                "count": count,
+            })
+
+        @r.route("GET", "/dir/lookup")
+        def lookup(req: Request) -> Response:
+            vid_str = req.query.get("volumeId", "")
+            vid = int(vid_str.split(",")[0])
+            nodes = self.topo.lookup(vid, req.query.get("collection", ""))
+            if not nodes:
+                return Response({"volumeId": vid_str,
+                                 "error": "volume id not found"}, status=404)
+            return Response({
+                "volumeId": vid_str,
+                "locations": [{"url": n.url, "publicUrl": n.public_url}
+                              for n in nodes],
+            })
+
+        @r.route("GET", "/dir/lookup_ec")
+        def lookup_ec(req: Request) -> Response:
+            vid = int(req.query["volumeId"])
+            locs = self.topo.lookup_ec_shards(vid)
+            if locs is None:
+                raise HttpError(404, f"ec volume {vid} not found")
+            return Response({
+                "volumeId": vid,
+                "collection": self.topo.ec_collections.get(vid, ""),
+                "shards": {str(sid): [n.url for n in nodes]
+                           for sid, nodes in locs.items()},
+            })
+
+        @r.route("GET", "/dir/status")
+        def dir_status(req: Request) -> Response:
+            return Response({"Topology": self.topo.to_map(),
+                             "Version": "seaweedfs-tpu 0.1"})
+
+        @r.route("GET", "/cluster/status")
+        def cluster_status(req: Request) -> Response:
+            return Response({"IsLeader": True, "Leader": self.url, "Peers": []})
+
+        @r.route("POST", "/heartbeat")
+        def heartbeat(req: Request) -> Response:
+            hb = req.json()
+            node = self.topo.register_node(
+                hb["ip"], int(hb["port"]), hb.get("public_url", ""),
+                hb.get("data_center") or "DefaultDataCenter",
+                hb.get("rack") or "DefaultRack",
+                int(hb.get("max_volume_count", 8)))
+            volumes = [VolumeInfo.from_dict(v) for v in hb.get("volumes", [])]
+            self.topo.sync_node_volumes(node, volumes)
+            ec_infos = [
+                EcVolumeInfo(int(e["volume_id"]), e.get("collection", ""),
+                             ShardBits(int(e["ec_index_bits"])))
+                for e in hb.get("ec_shards", [])
+            ]
+            self.topo.sync_node_ec_shards(node, ec_infos)
+            # re-seed the key sequencer from the largest needle key seen, so
+            # a master restart never re-issues existing keys (data loss)
+            max_key = max((int(v.get("max_file_key", 0))
+                           for v in hb.get("volumes", [])), default=0)
+            if max_key:
+                self.seq.set_max(max_key)
+            return Response({"volumeSizeLimit": self.topo.volume_size_limit,
+                             "leader": self.url})
+
+        @r.route("GET", "/vol/grow")
+        def vol_grow(req: Request) -> Response:
+            collection = req.query.get("collection", "")
+            replication = req.query.get("replication") or self.default_replication
+            rp = ReplicaPlacement.parse(replication)
+            ttl = TTL.parse(req.query.get("ttl", ""))
+            count = int(req.query.get("count", 1))
+            grown = grow_volume(self.topo, collection, rp, ttl,
+                                self._allocate_rpc, count=count)
+            return Response({"count": len(grown), "volumeIds": grown})
+
+        @r.route("GET", "/vol/vacuum")
+        def vol_vacuum(req: Request) -> Response:
+            threshold = float(req.query.get("garbageThreshold",
+                                            self.garbage_threshold))
+            return Response({"compacted": self.vacuum(threshold)})
+
+        @r.route("POST", "/admin/lease")
+        def admin_lease(req: Request) -> Response:
+            body = req.json()
+            now = time.time()
+            prev = body.get("previous_token") or None
+            with self.topo.lock:
+                expired = now - self._admin_lock_ts > 60
+                if self._admin_token is None or expired or prev == self._admin_token:
+                    self._admin_token = secrets.randbits(63)
+                    self._admin_lock_ts = now
+                    self._admin_client = body.get("client_name", "")
+                    return Response({"token": self._admin_token,
+                                     "lock_ts_ns": int(now * 1e9)})
+            raise HttpError(423, f"already locked by {self._admin_client}")
+
+        @r.route("POST", "/admin/release")
+        def admin_release(req: Request) -> Response:
+            with self.topo.lock:
+                if req.json().get("previous_token") == self._admin_token:
+                    self._admin_token = None
+            return Response({})
+
+    # --- volume server RPCs ----------------------------------------------
+    def _allocate_rpc(self, node, vid: int, collection: str,
+                      replication: str, ttl: str) -> None:
+        http_json("POST", f"http://{node.url}/admin/assign_volume", {
+            "volume_id": vid, "collection": collection,
+            "replication": replication, "ttl": ttl,
+        })
+
+    def vacuum(self, threshold: float) -> list[int]:
+        """topology_vacuum.go: ask each replica its garbage ratio, then
+        compact+commit everywhere if over threshold."""
+        compacted = []
+        with self.topo.lock:
+            layouts = list(self.topo.layouts.values())
+        for layout in layouts:
+            for vid, nodes in list(layout.vid_to_nodes.items()):
+                try:
+                    ratios = [
+                        http_json("POST", f"http://{n.url}/admin/vacuum_check",
+                                  {"volume_id": vid})["garbage_ratio"]
+                        for n in nodes
+                    ]
+                    if not ratios or min(ratios) < threshold:
+                        continue
+                    layout.set_readonly(vid, True)
+                    try:
+                        for n in nodes:
+                            http_json("POST",
+                                      f"http://{n.url}/admin/vacuum_compact",
+                                      {"volume_id": vid}, timeout=600)
+                        for n in nodes:
+                            http_json("POST",
+                                      f"http://{n.url}/admin/vacuum_commit",
+                                      {"volume_id": vid}, timeout=600)
+                        compacted.append(vid)
+                    finally:
+                        layout.set_readonly(vid, False)
+                except HttpError:
+                    continue
+        return compacted

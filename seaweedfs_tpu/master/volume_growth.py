@@ -1,0 +1,93 @@
+"""Volume growth: choose servers honoring replica placement, allocate.
+
+Equivalent of weed/topology/volume_growth.go:123-219
+(findEmptySlotsForOneVolume): pick a main server, then spread the remaining
+copies across other DCs / other racks / same rack per the xyz digits.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Callable
+
+from ..storage.super_block import ReplicaPlacement
+from ..storage.ttl import TTL
+from .topology import DataNode, Topology
+
+
+def find_empty_slots(topo: Topology, rp: ReplicaPlacement,
+                     preferred_dc: str = "") -> list[DataNode]:
+    """Returns rp.copy_count nodes honoring the placement, or raises."""
+    with topo.lock:
+        candidates = [n for n in topo.all_nodes() if n.free_space() > 0]
+        if preferred_dc:
+            main_pool = [n for n in candidates if n.dc and n.dc.name == preferred_dc]
+        else:
+            main_pool = candidates
+        if not main_pool:
+            raise LookupError("no free volume slots")
+        random.shuffle(main_pool)
+
+        for main in main_pool:
+            picked = _pick_replicas(main, candidates, rp)
+            if picked is not None:
+                return picked
+        raise LookupError(
+            f"cannot satisfy replica placement {rp} with available nodes")
+
+
+def _pick_replicas(main: DataNode, candidates: list[DataNode],
+                   rp: ReplicaPlacement) -> list[DataNode] | None:
+    picked = [main]
+    used = {main.url}
+
+    def take(pool: list[DataNode], count: int) -> bool:
+        pool = [n for n in pool if n.url not in used and n.free_space() > 0]
+        if len(pool) < count:
+            return False
+        random.shuffle(pool)
+        for n in pool[:count]:
+            picked.append(n)
+            used.add(n.url)
+        return True
+
+    # same rack copies (digit 3)
+    if rp.same_rack and not take(list(main.rack.nodes.values()), rp.same_rack):
+        return None
+    # other racks, same DC (digit 2)
+    if rp.diff_rack:
+        pool = [n for r in main.dc.racks.values() if r is not main.rack
+                for n in r.nodes.values()]
+        if not take(pool, rp.diff_rack):
+            return None
+    # other DCs (digit 1)
+    if rp.diff_dc:
+        pool = [n for n in candidates if n.dc is not main.dc]
+        if not take(pool, rp.diff_dc):
+            return None
+    return picked
+
+
+def grow_volume(topo: Topology, collection: str, rp: ReplicaPlacement,
+                ttl: TTL, allocate: Callable[[DataNode, int, str, str, str], None],
+                preferred_dc: str = "", count: int = 1) -> list[int]:
+    """VolumeGrowth.grow (volume_growth.go:221): allocate `count` new volumes
+    on chosen servers via the supplied RPC callable, then register them."""
+    grown = []
+    for _ in range(count):
+        nodes = find_empty_slots(topo, rp, preferred_dc)
+        vid = topo.next_volume_id()
+        for node in nodes:
+            allocate(node, vid, collection, str(rp), str(ttl))
+        # optimistic local registration; heartbeats confirm
+        from .topology import VolumeInfo
+
+        info = VolumeInfo(id=vid, collection=collection,
+                          replica_placement=rp.to_byte(), ttl=ttl.to_u32())
+        layout = topo.get_layout(collection, rp, ttl)
+        with topo.lock:
+            for node in nodes:
+                node.volumes[vid] = info
+                layout.register(info, node)
+        grown.append(vid)
+    return grown

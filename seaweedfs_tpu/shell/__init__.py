@@ -1,0 +1,5 @@
+"""Admin shell package — importing registers all commands."""
+
+from . import commands as commands  # noqa: F401
+from . import ec_commands as ec_commands  # noqa: F401
+from .commands import COMMANDS, CommandEnv, repl, run_command  # noqa: F401

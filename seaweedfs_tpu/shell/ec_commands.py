@@ -1,0 +1,288 @@
+"""EC command family: ec.encode / ec.rebuild / ec.balance / ec.decode.
+
+Equivalent of weed/shell/command_ec_encode.go, command_ec_rebuild.go,
+command_ec_balance.go, command_ec_decode.go, command_ec_common.go.  The
+`-engine tpu` flag routes shard generation/rebuild through the volume
+server's TPU Pallas codec (the `-ec.engine=tpu` surface from BASELINE.json).
+"""
+
+from __future__ import annotations
+
+from ..ec.layout import TOTAL_SHARDS_COUNT
+from .commands import CommandEnv, command
+
+
+def _ec_nodes(env: CommandEnv) -> list[dict]:
+    """collectEcNodes (command_ec_common.go:205): nodes sorted by free slots
+    descending."""
+    topo = env.topology()
+    nodes = [n for dc in topo["DataCenters"] for rack in dc["Racks"]
+             for n in rack["DataNodes"]]
+    return sorted(nodes, key=lambda n: -n["Free"])
+
+
+def _shard_map(env: CommandEnv, vid: int) -> dict[int, list[str]]:
+    r = env.master_get(f"/dir/lookup_ec?volumeId={vid}")
+    return {int(sid): urls for sid, urls in r.get("shards", {}).items()}
+
+
+def _balanced_distribution(nodes: list[dict], n_shards: int) -> dict[str, list[int]]:
+    """balancedEcDistribution (command_ec_encode.go:249-265): round-robin
+    shards onto the nodes with the most free slots."""
+    if not nodes:
+        raise RuntimeError("no volume servers with free slots")
+    alloc: dict[str, list[int]] = {n["Url"]: [] for n in nodes}
+    free = {n["Url"]: max(n["Free"], 0) for n in nodes}
+    order = [n["Url"] for n in nodes]
+    sid = 0
+    while sid < n_shards:
+        placed = False
+        for url in order:
+            if sid >= n_shards:
+                break
+            if free[url] > 0 or all(f <= 0 for f in free.values()):
+                alloc[url].append(sid)
+                free[url] -= 1 / 10  # ec shards are fractional slots
+                sid += 1
+                placed = True
+        if not placed:
+            for url in order:  # no free slots anywhere: spread anyway
+                if sid >= n_shards:
+                    break
+                alloc[url].append(sid)
+                sid += 1
+    return {u: s for u, s in alloc.items() if s}
+
+
+def _refresh_heartbeats(env: CommandEnv, servers: set[str]) -> None:
+    for url in servers:
+        try:
+            env.volume_post(url, "/admin/heartbeat_now", {}, timeout=30)
+        except Exception:
+            pass
+
+
+@command("ec.encode")
+def cmd_ec_encode(env: CommandEnv, flags: dict) -> str:
+    """ec.encode -volumeId <id> [-collection c] [-engine cpu|tpu]
+    # erasure-code a volume: generate RS(10,4) shards, spread them across
+    # servers, delete the original replicas (command_ec_encode.go:95-184)"""
+    env.confirm_is_locked()
+    vid = int(flags["volumeId"])
+    collection = flags.get("collection", "")
+    engine = flags.get("engine", "cpu")
+
+    locations = env.master.lookup(vid)
+    if not locations:
+        raise RuntimeError(f"volume {vid} not found")
+    source = locations[0]
+
+    # 1. mark all replicas readonly (markVolumeReplicasWritable false)
+    for url in locations:
+        env.volume_post(url, "/admin/readonly",
+                        {"volume_id": vid, "readonly": True})
+    # 2. generate shards on the source replica
+    env.volume_post(source, "/admin/ec/generate",
+                    {"volume_id": vid, "collection": collection,
+                     "engine": engine})
+    # 3. spread shards with round-robin free-slot allocation
+    alloc = _balanced_distribution(_ec_nodes(env), TOTAL_SHARDS_COUNT)
+    for target, shard_ids in alloc.items():
+        if target != source:
+            env.volume_post(target, "/admin/ec/copy", {
+                "volume_id": vid, "collection": collection,
+                "shard_ids": shard_ids, "source_data_node": source,
+            })
+        env.volume_post(target, "/admin/ec/mount",
+                        {"volume_id": vid, "collection": collection})
+    # 4. delete shards the source no longer owns, then the original volume
+    keep = set(alloc.get(source, []))
+    drop = [s for s in range(TOTAL_SHARDS_COUNT) if s not in keep]
+    if drop:
+        env.volume_post(source, "/admin/ec/delete",
+                        {"volume_id": vid, "collection": collection,
+                         "shard_ids": drop})
+        if keep:
+            env.volume_post(source, "/admin/ec/mount",
+                            {"volume_id": vid, "collection": collection})
+    for url in locations:
+        env.volume_post(url, "/admin/delete_volume", {"volume_id": vid})
+    _refresh_heartbeats(env, set(alloc) | set(locations))
+    env.master.invalidate(vid)
+    placed = {u: s for u, s in alloc.items()}
+    return f"ec encoded volume {vid} via {engine} engine; shards: {placed}"
+
+
+@command("ec.rebuild")
+def cmd_ec_rebuild(env: CommandEnv, flags: dict) -> str:
+    """ec.rebuild [-volumeId <id>] [-collection c] [-engine cpu|tpu]
+    # regenerate missing EC shards (command_ec_rebuild.go)"""
+    env.confirm_is_locked()
+    engine = flags.get("engine", "cpu")
+    topo = env.topology()
+    vids = ([int(flags["volumeId"])] if "volumeId" in flags
+            else [int(v) for v in topo.get("EcVolumes", {})])
+    results = []
+    for vid in vids:
+        shard_map = _shard_map(env, vid)
+        collection = env.master_get(
+            f"/dir/lookup_ec?volumeId={vid}").get("collection", "")
+        present = {sid for sid, urls in shard_map.items() if urls}
+        missing = [s for s in range(TOTAL_SHARDS_COUNT) if s not in present]
+        if not missing:
+            results.append(f"volume {vid}: all shards present")
+            continue
+        if len(present) < 10:
+            results.append(f"volume {vid}: unrepairable, only "
+                           f"{len(present)} shards")
+            continue
+        rebuilder = _ec_nodes(env)[0]["Url"]
+        # copy survivors the rebuilder lacks (prepareDataToRecover)
+        copied = []
+        for sid in sorted(present):
+            holders = shard_map[sid]
+            if rebuilder in holders:
+                continue
+            env.volume_post(rebuilder, "/admin/ec/copy", {
+                "volume_id": vid, "collection": collection,
+                "shard_ids": [sid], "source_data_node": holders[0],
+                "copy_ecx_file": True, "copy_ecj_file": True,
+            })
+            copied.append(sid)
+        r = env.volume_post(rebuilder, "/admin/ec/rebuild",
+                            {"volume_id": vid, "collection": collection,
+                             "engine": engine})
+        rebuilt = r.get("rebuilt_shard_ids", [])
+        # drop the temporarily copied survivors, keep + mount the rebuilt
+        if copied:
+            env.volume_post(rebuilder, "/admin/ec/delete",
+                            {"volume_id": vid, "collection": collection,
+                             "shard_ids": copied})
+        env.volume_post(rebuilder, "/admin/ec/mount",
+                        {"volume_id": vid, "collection": collection})
+        _refresh_heartbeats(env, {rebuilder})
+        results.append(f"volume {vid}: rebuilt shards {rebuilt} on {rebuilder}")
+    return "\n".join(results)
+
+
+@command("ec.balance")
+def cmd_ec_balance(env: CommandEnv, flags: dict) -> str:
+    """ec.balance [-collection c]
+    # dedupe shard copies and spread shards evenly (command_ec_balance.go)"""
+    env.confirm_is_locked()
+    topo = env.topology()
+    moves = []
+    counts: dict[str, int] = {}
+    for dc in topo["DataCenters"]:
+        for rack in dc["Racks"]:
+            for n in rack["DataNodes"]:
+                counts[n["Url"]] = n["EcShards"]
+
+    for vid_str in topo.get("EcVolumes", {}):
+        vid = int(vid_str)
+        info = env.master_get(f"/dir/lookup_ec?volumeId={vid}")
+        collection = info.get("collection", "")
+        shard_map = {int(s): urls for s, urls in info.get("shards", {}).items()}
+
+        # 1. dedupe: keep the copy on the least-loaded holder
+        for sid, holders in shard_map.items():
+            if len(holders) <= 1:
+                continue
+            keep = min(holders, key=lambda u: counts.get(u, 0))
+            for url in holders:
+                if url == keep:
+                    continue
+                env.volume_post(url, "/admin/ec/delete",
+                                {"volume_id": vid, "collection": collection,
+                                 "shard_ids": [sid]})
+                # only remount if the node still holds other shards of this
+                # volume (deleting the last one also removes its .ecx)
+                still_holds = any(url in us for s2, us in shard_map.items()
+                                  if s2 != sid)
+                if still_holds:
+                    env.volume_post(url, "/admin/ec/mount",
+                                    {"volume_id": vid, "collection": collection})
+                else:
+                    env.volume_post(url, "/admin/ec/unmount",
+                                    {"volume_id": vid})
+                counts[url] = counts.get(url, 1) - 1
+                moves.append(f"dedupe {vid}.{sid} from {url}")
+            shard_map[sid] = [keep]
+
+        # 2. spread: move shards from overloaded to underloaded servers
+        all_urls = sorted(counts)
+        if not all_urls:
+            continue
+        avg = (sum(counts.values()) + len(all_urls) - 1) // len(all_urls)
+        for sid, holders in sorted(shard_map.items()):
+            if not holders:
+                continue
+            src = holders[0]
+            if counts.get(src, 0) <= avg:
+                continue
+            per_vid = {u for s, us in shard_map.items() for u in us}
+            targets = [u for u in all_urls
+                       if counts.get(u, 0) < avg and u not in per_vid]
+            if not targets:
+                continue
+            dst = min(targets, key=lambda u: counts.get(u, 0))
+            env.volume_post(dst, "/admin/ec/copy", {
+                "volume_id": vid, "collection": collection,
+                "shard_ids": [sid], "source_data_node": src})
+            env.volume_post(dst, "/admin/ec/mount",
+                            {"volume_id": vid, "collection": collection})
+            env.volume_post(src, "/admin/ec/delete",
+                            {"volume_id": vid, "collection": collection,
+                             "shard_ids": [sid]})
+            if any(src in us for s2, us in shard_map.items() if s2 != sid):
+                env.volume_post(src, "/admin/ec/mount",
+                                {"volume_id": vid, "collection": collection})
+            else:
+                env.volume_post(src, "/admin/ec/unmount", {"volume_id": vid})
+            counts[src] -= 1
+            counts[dst] = counts.get(dst, 0) + 1
+            shard_map[sid] = [dst]
+            moves.append(f"move {vid}.{sid} {src} -> {dst}")
+        _refresh_heartbeats(env, set(all_urls))
+    return "\n".join(moves) or "already balanced"
+
+
+@command("ec.decode")
+def cmd_ec_decode(env: CommandEnv, flags: dict) -> str:
+    """ec.decode -volumeId <id> [-collection c]
+    # convert an EC volume back to a normal volume (command_ec_decode.go)"""
+    env.confirm_is_locked()
+    vid = int(flags["volumeId"])
+    info = env.master_get(f"/dir/lookup_ec?volumeId={vid}")
+    collection = info.get("collection", "")
+    shard_map = {int(s): urls for s, urls in info.get("shards", {}).items()}
+
+    # choose the server already holding the most shards
+    holder_counts: dict[str, int] = {}
+    for sid, urls in shard_map.items():
+        for u in urls:
+            holder_counts[u] = holder_counts.get(u, 0) + 1
+    if not holder_counts:
+        raise RuntimeError(f"ec volume {vid} has no shards")
+    target = max(holder_counts, key=holder_counts.get)
+
+    # collect the data shards (0..9) it lacks
+    for sid in range(10):
+        holders = shard_map.get(sid, [])
+        if not holders:
+            raise RuntimeError(f"data shard {sid} lost; run ec.rebuild first")
+        if target not in holders:
+            env.volume_post(target, "/admin/ec/copy", {
+                "volume_id": vid, "collection": collection,
+                "shard_ids": [sid], "source_data_node": holders[0]})
+    env.volume_post(target, "/admin/ec/to_volume",
+                    {"volume_id": vid, "collection": collection})
+    # drop ec shards everywhere else
+    for url in {u for urls in shard_map.values() for u in urls}:
+        if url != target:
+            env.volume_post(url, "/admin/ec/delete", {
+                "volume_id": vid, "collection": collection,
+                "shard_ids": list(range(TOTAL_SHARDS_COUNT))})
+    _refresh_heartbeats(env, set(holder_counts) | {target})
+    env.master.invalidate(vid)
+    return f"decoded ec volume {vid} back to a normal volume on {target}"

@@ -323,7 +323,11 @@ class Volume:
 
     def compact(self) -> None:
         """Compact2-style copy of live needles into .cpd/.cpx
-        (volume_vacuum.go:396-470 copyDataBasedOnIndexFile)."""
+        (volume_vacuum.go:396-470 copyDataBasedOnIndexFile).  Records the
+        index size at compaction start so commit_compact can replay writes
+        that land in between (makeupDiff, volume_vacuum.go:181)."""
+        self._last_compact_idx_size = os.path.getsize(self.idx_path) \
+            if os.path.exists(self.idx_path) else 0
         cpd, cpx = self.file_prefix + ".cpd", self.file_prefix + ".cpx"
         new_sb = SuperBlock(
             version=self.super_block.version,
@@ -344,12 +348,43 @@ class Volume:
                 idx_out.write(idx_mod.pack_entry(nv.key, new_offset, nv.size))
                 new_offset += len(blob)
 
+    def _makeup_diff(self, cpd: str, cpx: str) -> None:
+        """makeupDiff (volume_vacuum.go:181): replay idx entries appended
+        after compact() started into the compacted files, so writes landing
+        between compact and commit are not lost."""
+        start = getattr(self, "_last_compact_idx_size", None)
+        if start is None:
+            return
+        idx_size = os.path.getsize(self.idx_path)
+        if idx_size <= start:
+            return
+        from . import idx as idx_mod
+
+        with open(self.idx_path, "rb") as f:
+            f.seek(start)
+            entries = idx_mod.parse_entries(f.read(idx_size - start))
+        with open(cpd, "r+b") as dat_out, open(cpx, "ab") as idx_out:
+            dat_out.seek(0, os.SEEK_END)
+            new_offset = dat_out.tell()
+            for i in range(len(entries)):
+                key = int(entries["key"][i])
+                offset = int(entries["offset"][i]) * NEEDLE_PADDING_SIZE
+                size = int(entries["size"][i])
+                if offset != 0 and size_is_valid(size):
+                    blob = self.read_needle_blob(offset, size)
+                    dat_out.write(blob)
+                    idx_out.write(idx_mod.pack_entry(key, new_offset, size))
+                    new_offset += len(blob)
+                else:
+                    idx_out.write(idx_mod.pack_entry(key, 0, -1))
+
     def commit_compact(self) -> None:
-        """CommitCompact (volume_vacuum.go:91-160): swap in the compacted
-        files and reload."""
+        """CommitCompact (volume_vacuum.go:91-160): catch up on post-compact
+        appends, then swap in the compacted files and reload."""
         cpd, cpx = self.file_prefix + ".cpd", self.file_prefix + ".cpx"
         if not (os.path.exists(cpd) and os.path.exists(cpx)):
             raise FileNotFoundError("no compacted files to commit")
+        self._makeup_diff(cpd, cpx)
         self.close()
         os.replace(cpd, self.dat_path)
         os.replace(cpx, self.idx_path)
@@ -376,4 +411,5 @@ class Volume:
             "ttl": self.super_block.ttl.to_u32(),
             "compact_revision": self.super_block.compaction_revision,
             "modified_at_second": self.last_modified_ts_seconds,
+            "max_file_key": self.nm.max_file_key,
         }

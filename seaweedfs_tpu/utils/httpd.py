@@ -1,0 +1,190 @@
+"""Tiny threaded HTTP server framework + JSON client helpers.
+
+The control-plane transport for this rebuild: the reference exposes HTTP for
+object IO and /dir/* master endpoints (weed/server/*_handlers*.go) plus gRPC
+for admin; here the admin RPCs are HTTP POST endpoints named after their
+reference RPCs (a protobuf/gRPC transport can slot in behind the same
+handler functions later).
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+import urllib.error
+import urllib.parse
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Optional
+
+
+class HttpError(Exception):
+    def __init__(self, status: int, message: str = ""):
+        super().__init__(message or f"HTTP {status}")
+        self.status = status
+        self.message = message
+
+
+class Request:
+    def __init__(self, handler: BaseHTTPRequestHandler, match: re.Match):
+        self.handler = handler
+        self.match = match
+        parsed = urllib.parse.urlparse(handler.path)
+        self.path = parsed.path
+        self.query = {k: v[0] for k, v in urllib.parse.parse_qs(parsed.query).items()}
+        self.headers = handler.headers
+        self._body: Optional[bytes] = None
+
+    @property
+    def body(self) -> bytes:
+        if self._body is None:
+            length = int(self.headers.get("Content-Length") or 0)
+            self._body = self.handler.rfile.read(length) if length else b""
+        return self._body
+
+    def json(self) -> dict:
+        return json.loads(self.body or b"{}")
+
+
+class Response:
+    def __init__(self, data=None, status: int = 200, raw: Optional[bytes] = None,
+                 headers: Optional[dict] = None):
+        self.data = data
+        self.status = status
+        self.raw = raw
+        self.headers = headers or {}
+
+
+class Router:
+    """Method+regex route table shared by master/volume/filer servers."""
+
+    def __init__(self, name: str = "httpd"):
+        self.name = name
+        self.routes: list[tuple[str, re.Pattern, Callable]] = []
+
+    def route(self, method: str, pattern: str):
+        compiled = re.compile("^" + pattern + "$")
+
+        def deco(fn):
+            self.routes.append((method, compiled, fn))
+            return fn
+
+        return deco
+
+    def dispatch(self, handler: BaseHTTPRequestHandler, method: str) -> None:
+        path = urllib.parse.urlparse(handler.path).path
+        for m, pattern, fn in self.routes:
+            if m != method:
+                continue
+            match = pattern.match(path)
+            if match:
+                try:
+                    resp = fn(Request(handler, match))
+                except HttpError as e:
+                    resp = Response({"error": e.message or str(e)}, status=e.status)
+                except (KeyError, LookupError) as e:
+                    resp = Response({"error": str(e)}, status=404)
+                except Exception as e:  # noqa: BLE001 — server must not die
+                    resp = Response({"error": f"{type(e).__name__}: {e}"}, status=500)
+                self._send(handler, resp)
+                return
+        self._send(handler, Response({"error": f"no route {method} {path}"}, status=404))
+
+    @staticmethod
+    def _send(handler: BaseHTTPRequestHandler, resp: Response) -> None:
+        try:
+            if resp.raw is not None:
+                body = resp.raw
+                ctype = resp.headers.pop("Content-Type", "application/octet-stream")
+            else:
+                body = json.dumps(resp.data if resp.data is not None else {}).encode()
+                ctype = "application/json"
+            handler.send_response(resp.status)
+            handler.send_header("Content-Type", ctype)
+            handler.send_header("Content-Length", str(len(body)))
+            for k, v in resp.headers.items():
+                handler.send_header(k, v)
+            handler.end_headers()
+            if handler.command != "HEAD":
+                handler.wfile.write(body)
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+
+
+def serve(router: Router, host: str, port: int) -> ThreadingHTTPServer:
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):  # quiet by default
+            pass
+
+        def do_GET(self):
+            router.dispatch(self, "GET")
+
+        def do_HEAD(self):
+            router.dispatch(self, "HEAD")
+
+        def do_POST(self):
+            router.dispatch(self, "POST")
+
+        def do_PUT(self):
+            router.dispatch(self, "PUT")
+
+        def do_DELETE(self):
+            router.dispatch(self, "DELETE")
+
+    server = ThreadingHTTPServer((host, port), Handler)
+    server.daemon_threads = True
+    thread = threading.Thread(target=server.serve_forever, daemon=True,
+                              name=f"{router.name}:{port}")
+    thread.start()
+    return server
+
+
+# --- client helpers ---------------------------------------------------------
+
+def http_json(method: str, url: str, payload: Optional[dict] = None,
+              timeout: float = 30.0) -> dict:
+    data = json.dumps(payload).encode() if payload is not None else None
+    req = urllib.request.Request(url, data=data, method=method)
+    if data is not None:
+        req.add_header("Content-Type", "application/json")
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            body = r.read()
+    except urllib.error.HTTPError as e:
+        body = e.read()
+        try:
+            err = json.loads(body).get("error", body.decode(errors="replace"))
+        except Exception:
+            err = body.decode(errors="replace")
+        raise HttpError(e.code, err) from None
+    except (urllib.error.URLError, ConnectionError, TimeoutError, OSError) as e:
+        raise HttpError(503, f"{url} unreachable: {e}") from None
+    return json.loads(body) if body else {}
+
+
+class _NoRedirect(urllib.request.HTTPRedirectHandler):
+    def redirect_request(self, *args, **kwargs):
+        return None
+
+
+_no_redirect_opener = urllib.request.build_opener(_NoRedirect)
+
+
+def http_bytes(method: str, url: str, payload: Optional[bytes] = None,
+               headers: Optional[dict] = None, timeout: float = 60.0,
+               follow_redirects: bool = True) -> tuple[int, bytes, dict]:
+    req = urllib.request.Request(url, data=payload, method=method)
+    for k, v in (headers or {}).items():
+        req.add_header(k, v)
+    opener = urllib.request.urlopen if follow_redirects else _no_redirect_opener.open
+    try:
+        with opener(req, timeout=timeout) as r:
+            return r.status, r.read(), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, e.read(), dict(e.headers)
+    except (urllib.error.URLError, ConnectionError, TimeoutError, OSError) as e:
+        # dead/unreachable server: synthetic status 0 so callers fail over
+        return 0, str(e).encode(), {}

@@ -1,0 +1,314 @@
+"""Volume-server store: disk locations, volumes, EC volumes, heartbeats.
+
+Equivalent of weed/storage/store.go + disk_location.go + store_ec.go.  One
+Store owns N data directories, loads existing volumes/EC shards on startup,
+routes needle operations by volume id, and builds master heartbeats.
+Serialization: one RLock per volume for the write path (the reference's
+dataFileAccessLock); reads are lock-free preads.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import re
+import threading
+from typing import Callable, Optional
+
+import numpy as np
+
+from ..ec.codec import ReedSolomon, best_cpu_engine
+from ..ec.ec_volume import EcVolume, NeedleNotFoundError
+from ..ec.layout import to_ext
+from ..ec import encoder as ec_encoder
+from ..storage.needle import Needle
+from ..storage.super_block import ReplicaPlacement
+from ..storage.ttl import TTL
+from ..storage.types import Version
+from ..storage.volume import Volume, volume_file_prefix
+from ..utils import ioutil  # noqa: F401  (re-exported for tooling)
+
+
+def parse_volume_file_name(name: str) -> tuple[str, int]:
+    """'collection_vid' or 'vid' -> (collection, vid)."""
+    base = name
+    if "_" in base:
+        collection, vid_str = base.rsplit("_", 1)
+    else:
+        collection, vid_str = "", base
+    return collection, int(vid_str)
+
+
+class DiskLocation:
+    """One data directory (disk_location.go)."""
+
+    def __init__(self, directory: str):
+        self.directory = directory
+        os.makedirs(directory, exist_ok=True)
+
+    def discover_volumes(self) -> list[tuple[str, int]]:
+        found = []
+        for path in glob.glob(os.path.join(self.directory, "*.dat")):
+            name = os.path.basename(path)[:-4]
+            if re.fullmatch(r"(?:[\w.-]+_)?\d+", name):
+                found.append(parse_volume_file_name(name))
+        return found
+
+    def discover_ec_volumes(self) -> list[tuple[str, int]]:
+        found = set()
+        for path in glob.glob(os.path.join(self.directory, "*.ecx")):
+            name = os.path.basename(path)[:-4]
+            if re.fullmatch(r"(?:[\w.-]+_)?\d+", name):
+                found.add(parse_volume_file_name(name))
+        return sorted(found)
+
+
+class Store:
+    def __init__(self, directories: list[str], ip: str = "127.0.0.1",
+                 port: int = 8080, public_url: str = "",
+                 max_volume_count: int = 8,
+                 ec_engine: str = "cpu"):
+        self.ip, self.port = ip, port
+        self.public_url = public_url or f"{ip}:{port}"
+        self.locations = [DiskLocation(d) for d in directories]
+        self.max_volume_count = max_volume_count
+        self.volumes: dict[int, Volume] = {}
+        self.volume_locks: dict[int, threading.RLock] = {}
+        self.ec_volumes: dict[int, EcVolume] = {}
+        self.ec_collections: dict[int, str] = {}
+        self.volume_size_limit = 30 * 1000 * 1000 * 1000
+        self.ec_engine_name = ec_engine
+        self._rs_cache: dict[str, ReedSolomon] = {}
+        self.load_existing()
+
+    # --- engine selection (-ec.engine={cpu,tpu}) --------------------------
+    def rs(self, engine: Optional[str] = None) -> ReedSolomon:
+        name = engine or self.ec_engine_name
+        rs = self._rs_cache.get(name)
+        if rs is None:
+            if name == "tpu":
+                from ..ops.gf_matmul import TpuEngine
+
+                rs = ReedSolomon(10, 4, engine=TpuEngine())
+            else:
+                rs = ReedSolomon(10, 4, engine=best_cpu_engine())
+            self._rs_cache[name] = rs
+        return rs
+
+    # --- loading ----------------------------------------------------------
+    def load_existing(self) -> None:
+        for loc in self.locations:
+            for collection, vid in loc.discover_volumes():
+                if vid not in self.volumes:
+                    self._open_volume(loc.directory, collection, vid)
+            for collection, vid in loc.discover_ec_volumes():
+                if vid not in self.ec_volumes:
+                    self._open_ec_volume(loc.directory, collection, vid)
+
+    def _open_volume(self, directory: str, collection: str, vid: int) -> Volume:
+        v = Volume(directory, collection, vid,
+                   volume_size_limit=self.volume_size_limit)
+        self.volumes[vid] = v
+        self.volume_locks[vid] = threading.RLock()
+        return v
+
+    def _open_ec_volume(self, directory: str, collection: str, vid: int) -> EcVolume:
+        base = volume_file_prefix(directory, collection, vid)
+        ev = EcVolume(base, vid)
+        self.ec_volumes[vid] = ev
+        self.ec_collections[vid] = collection
+        return ev
+
+    # --- volume admin -----------------------------------------------------
+    def add_volume(self, vid: int, collection: str = "",
+                   replication: str = "000", ttl: str = "") -> Volume:
+        if vid in self.volumes:
+            return self.volumes[vid]
+        loc = min(self.locations,
+                  key=lambda l: sum(1 for v in self.volumes.values()
+                                    if v.directory == l.directory))
+        v = Volume(loc.directory, collection, vid,
+                   replica_placement=ReplicaPlacement.parse(replication),
+                   ttl=TTL.parse(ttl),
+                   volume_size_limit=self.volume_size_limit)
+        self.volumes[vid] = v
+        self.volume_locks[vid] = threading.RLock()
+        return v
+
+    def delete_volume(self, vid: int) -> None:
+        v = self.volumes.pop(vid, None)
+        self.volume_locks.pop(vid, None)
+        if v is not None:
+            v.destroy()
+
+    def unmount_volume(self, vid: int) -> None:
+        v = self.volumes.pop(vid, None)
+        self.volume_locks.pop(vid, None)
+        if v is not None:
+            v.close()
+
+    def mount_volume(self, vid: int) -> None:
+        for loc in self.locations:
+            for collection, found_vid in loc.discover_volumes():
+                if found_vid == vid:
+                    self._open_volume(loc.directory, collection, vid)
+                    return
+        raise KeyError(f"volume {vid} not found on disk")
+
+    def get_volume(self, vid: int) -> Volume:
+        v = self.volumes.get(vid)
+        if v is None:
+            raise KeyError(f"volume {vid} not found")
+        return v
+
+    # --- needle ops (store.go:338,362) ------------------------------------
+    def write_needle(self, vid: int, n: Needle, fsync: bool = False) -> tuple[int, bool]:
+        v = self.get_volume(vid)
+        with self.volume_locks[vid]:
+            _, size, unchanged = v.write_needle(n)
+            if fsync:
+                os.fsync(v._dat.fileno())
+        return size, unchanged
+
+    def delete_needle(self, vid: int, n: Needle) -> int:
+        v = self.get_volume(vid)
+        with self.volume_locks[vid]:
+            return v.delete_needle(n)
+
+    def read_needle(self, vid: int, key: int, cookie: Optional[int] = None) -> Needle:
+        return self.get_volume(vid).read_needle(key, cookie)
+
+    # --- EC (store_ec.go + volume_grpc_erasure_coding.go backends) --------
+    def ec_generate(self, vid: int, collection: str = "",
+                    engine: Optional[str] = None) -> None:
+        """VolumeEcShardsGenerate: .dat -> .ec00..13 + .ecx + mark readonly."""
+        v = self.get_volume(vid)
+        base = v.file_prefix
+        with self.volume_locks[vid]:
+            v.read_only = True
+            ec_encoder.write_ec_files(base, self.rs(engine))
+            ec_encoder.write_sorted_file_from_idx(base)
+
+    def ec_rebuild(self, vid: int, collection: str = "",
+                   engine: Optional[str] = None) -> list[int]:
+        """VolumeEcShardsRebuild: regenerate missing local shards."""
+        base = self._ec_base(vid, collection)
+        return ec_encoder.rebuild_ec_files(base, self.rs(engine))
+
+    def _ec_base(self, vid: int, collection: str = "") -> str:
+        ev = self.ec_volumes.get(vid)
+        if ev is not None:
+            return ev.base_file_name
+        for loc in self.locations:
+            base = volume_file_prefix(loc.directory, collection, vid)
+            if (glob.glob(base + ".ec[0-9][0-9]") or os.path.exists(base + ".ecx")
+                    or os.path.exists(base + ".dat")):
+                return base
+        return volume_file_prefix(self.locations[0].directory, collection, vid)
+
+    def ec_mount(self, vid: int, collection: str = "") -> None:
+        if vid in self.ec_volumes:
+            self.ec_volumes[vid].close()
+            del self.ec_volumes[vid]
+        base = self._ec_base(vid, collection)
+        directory = os.path.dirname(base)
+        self._open_ec_volume(directory, collection, vid)
+
+    def ec_unmount(self, vid: int) -> None:
+        ev = self.ec_volumes.pop(vid, None)
+        self.ec_collections.pop(vid, None)
+        if ev is not None:
+            ev.close()
+
+    def ec_delete_shards(self, vid: int, shard_ids: list[int],
+                         collection: str = "") -> None:
+        base = self._ec_base(vid, collection)
+        was_mounted = vid in self.ec_volumes
+        if was_mounted:
+            self.ec_unmount(vid)
+        for sid in shard_ids:
+            p = base + to_ext(sid)
+            if os.path.exists(p):
+                os.remove(p)
+        if not glob.glob(base + ".ec[0-9][0-9]"):
+            for ext in (".ecx", ".ecj"):
+                if os.path.exists(base + ext):
+                    os.remove(base + ext)
+        elif was_mounted:
+            self.ec_mount(vid, collection)
+
+    def ec_shard_read(self, vid: int, shard_id: int, offset: int,
+                      length: int) -> bytes:
+        ev = self.ec_volumes.get(vid)
+        if ev is None or shard_id not in ev.shards:
+            raise NeedleNotFoundError(f"shard {vid}.{shard_id} not here")
+        return ev.shards[shard_id].read_at(length, offset)
+
+    def read_ec_needle(self, vid: int, key: int,
+                       fetch_remote: Optional[Callable[[int, int, int, int], bytes]] = None,
+                       ) -> tuple[bytes, int]:
+        """ReadEcShardNeedle (store_ec.go:125-163): local shards first, then
+        remote shard reads, then on-the-fly reconstruction via fetch_remote
+        (vid, shard_id, offset, length) -> bytes.  Returns (record, size)."""
+        ev = self.ec_volumes.get(vid)
+        if ev is None:
+            raise KeyError(f"ec volume {vid} not found")
+        offset, size, intervals = ev.locate_ec_shard_needle(key)
+        from ..storage.types import size_is_deleted
+
+        if size_is_deleted(size):
+            raise NeedleNotFoundError(f"needle {key} deleted")
+        out = []
+        for iv in intervals:
+            shard_id, shard_offset = iv.to_shard_id_and_offset(
+                ev.large_block_size, ev.small_block_size, ev.data_shards)
+            if shard_id in ev.shards:
+                out.append(ev.shards[shard_id].read_at(iv.size, shard_offset))
+            elif fetch_remote is not None:
+                out.append(fetch_remote(vid, shard_id, shard_offset, iv.size))
+            else:
+                out.append(ev.reconstruct_interval(shard_id, shard_offset,
+                                                   iv.size, self.rs()))
+        return b"".join(out), size
+
+    def ec_delete_needle(self, vid: int, key: int) -> None:
+        ev = self.ec_volumes.get(vid)
+        if ev is None:
+            raise KeyError(f"ec volume {vid} not found")
+        ev.delete_needle(key)
+
+    def ec_to_volume(self, vid: int, collection: str = "") -> None:
+        """VolumeEcShardsToVolume: decode .ec00-09 + .ecx/.ecj back into a
+        normal volume (volume_grpc_erasure_coding.go:382-413)."""
+        base = self._ec_base(vid, collection)
+        dat_size = ec_encoder.find_dat_file_size(base, base)
+        ec_encoder.write_dat_file(base, dat_size)
+        ec_encoder.write_idx_file_from_ec_index(base)
+        self.ec_unmount(vid)
+        directory = os.path.dirname(base)
+        self._open_volume(directory, collection, vid)
+
+    # --- heartbeat (store.go:216 CollectHeartbeat) ------------------------
+    def collect_heartbeat(self) -> dict:
+        from ..master.topology import ShardBits
+
+        volumes = [v.to_volume_information() for v in self.volumes.values()]
+        ec_shards = []
+        for vid, ev in self.ec_volumes.items():
+            bits = ShardBits()
+            for sid in ev.shards:
+                bits = bits.add(sid)
+            ec_shards.append({"volume_id": vid,
+                              "collection": self.ec_collections.get(vid, ""),
+                              "ec_index_bits": bits.bits})
+        return {
+            "ip": self.ip, "port": self.port, "public_url": self.public_url,
+            "max_volume_count": self.max_volume_count,
+            "volumes": volumes, "ec_shards": ec_shards,
+        }
+
+    def close(self) -> None:
+        for v in self.volumes.values():
+            v.close()
+        for ev in self.ec_volumes.values():
+            ev.close()

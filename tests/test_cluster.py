@@ -1,0 +1,216 @@
+"""Cluster integration tests: master + volume servers + shell, in-process.
+
+The SURVEY.md §7 minimum end-to-end slice: assign -> PUT needles ->
+ec.encode (engine selectable) -> lose shards -> degraded reads ->
+ec.rebuild -> reads -> ec.decode -> reads.  Servers are real HTTP processes
+(threads) on localhost ports; the shell drives them like an operator would.
+"""
+
+from __future__ import annotations
+
+import socket
+import time
+
+import pytest
+
+from seaweedfs_tpu.client.operation import WeedClient
+from seaweedfs_tpu.master.server import MasterServer
+from seaweedfs_tpu.shell import CommandEnv, run_command
+from seaweedfs_tpu.utils.httpd import http_bytes, http_json
+from seaweedfs_tpu.volume_server.server import VolumeServer
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    master = MasterServer(port=free_port(), volume_size_limit_mb=64,
+                          pulse_seconds=0.4).start()
+    servers = []
+    for i in range(4):
+        d = tmp_path / f"vs{i}"
+        d.mkdir()
+        vs = VolumeServer([str(d)], master.url, port=free_port(),
+                          max_volume_count=10, pulse_seconds=0.4).start()
+        servers.append(vs)
+    # wait for first heartbeats
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        if len(master.topo.all_nodes()) == 4:
+            break
+        time.sleep(0.05)
+    assert len(master.topo.all_nodes()) == 4
+    yield master, servers
+    for vs in servers:
+        vs.stop()
+    master.stop()
+
+
+def sync_heartbeats(servers):
+    for vs in servers:
+        vs.heartbeat_now()
+
+
+def test_assign_put_get_delete(cluster):
+    master, servers = cluster
+    client = WeedClient(master.url)
+    fid = client.upload(b"hello cluster", name="hi.txt", mime="text/plain")
+    assert client.download(fid) == b"hello cluster"
+    client.delete(fid)
+    with pytest.raises(Exception):
+        client.download(fid)
+
+
+def test_replicated_write(cluster):
+    master, servers = cluster
+    client = WeedClient(master.url)
+    fid = client.upload(b"replicated data", replication="001")
+    vid = int(fid.split(",")[0])
+    time.sleep(0.1)
+    holders = [vs for vs in servers if vid in vs.store.volumes]
+    assert len(holders) == 2
+    for vs in holders:
+        status, body, _ = http_bytes("GET", f"http://{vs.url}/{fid}")
+        assert status == 200 and body == b"replicated data"
+    # delete propagates to both replicas
+    client.delete(fid)
+    for vs in holders:
+        status, _, _ = http_bytes("GET", f"http://{vs.url}/{fid}")
+        assert status == 404
+
+
+def test_read_redirects_from_wrong_server(cluster):
+    master, servers = cluster
+    client = WeedClient(master.url)
+    fid = client.upload(b"redirect me")
+    vid = int(fid.split(",")[0])
+    wrong = next(vs for vs in servers if vid not in vs.store.volumes)
+    status, _, headers = http_bytes("GET", f"http://{wrong.url}/{fid}",
+                                    follow_redirects=False)
+    assert status == 302
+    assert headers.get("Location", "").endswith(f"/{fid}")
+    # and a normal client transparently follows to the right server
+    status, body, _ = http_bytes("GET", f"http://{wrong.url}/{fid}")
+    assert status == 200 and body == b"redirect me"
+
+
+def test_vacuum_via_master(cluster):
+    master, servers = cluster
+    client = WeedClient(master.url)
+    fids = [client.upload(bytes([i]) * 2000) for i in range(20)]
+    for fid in fids[:15]:
+        client.delete(fid)
+    sync_heartbeats(servers)
+    r = http_json("GET", f"http://{master.url}/vol/vacuum?garbageThreshold=0.3")
+    assert r["compacted"]
+    # survivors still readable with correct content
+    for i, fid in enumerate(fids):
+        if i < 15:
+            continue
+        assert client.download(fid) == bytes([i]) * 2000
+
+
+@pytest.mark.parametrize("engine", ["cpu", "tpu"])
+def test_ec_lifecycle_end_to_end(cluster, engine):
+    """The north-star slice (SURVEY.md §7): encode -> degraded read ->
+    rebuild -> read -> decode -> read."""
+    master, servers = cluster
+    client = WeedClient(master.url)
+
+    payloads = {}
+    fids = []
+    for i in range(60):
+        data = bytes([i % 251]) * (500 + i * 37)
+        fid = client.upload(data, name=f"obj{i}.bin")
+        payloads[fid] = data
+        fids.append(fid)
+    vid = int(fids[0].split(",")[0])
+    sync_heartbeats(servers)
+
+    env = CommandEnv(master.url)
+    env.lock()
+    out = run_command(env, f"ec.encode -volumeId {vid} -engine {engine}")
+    assert f"ec encoded volume {vid}" in out
+
+    # the normal volume is gone everywhere; reads go through EC
+    assert all(vid not in vs.store.volumes for vs in servers)
+    for fid, data in payloads.items():
+        assert client.download(fid) == data, fid
+
+    # lose one holder's shards (<= 4 of 14) -> degraded reads still work
+    holders = [vs for vs in servers if vs.store.ec_volumes.get(vid)]
+    victim = holders[0]
+    lost = list(victim.store.ec_volumes[vid].shards)[:4]
+    victim.store.ec_delete_shards(vid, lost)
+    assert lost
+    sync_heartbeats(servers)
+    for fid in fids[:10]:
+        assert client.download(fid) == payloads[fid]
+
+    # rebuild restores the missing shards
+    out = run_command(env, f"ec.rebuild -volumeId {vid} -engine {engine}")
+    assert "rebuilt shards" in out
+    sync_heartbeats(servers)
+    shard_map = http_json(
+        "GET", f"http://{master.url}/dir/lookup_ec?volumeId={vid}")["shards"]
+    present = {int(s) for s, urls in shard_map.items() if urls}
+    assert present == set(range(14))
+    for fid in fids[:10]:
+        assert client.download(fid) == payloads[fid]
+
+    # decode back to a normal volume
+    out = run_command(env, f"ec.decode -volumeId {vid}")
+    assert "decoded ec volume" in out
+    sync_heartbeats(servers)
+    for fid, data in payloads.items():
+        assert client.download(fid) == data
+    env.unlock()
+
+
+def test_ec_balance_dedupes_and_spreads(cluster):
+    master, servers = cluster
+    client = WeedClient(master.url)
+    for i in range(30):
+        client.upload(bytes([i]) * 1000)
+    vid = 1
+    sync_heartbeats(servers)
+    env = CommandEnv(master.url)
+    env.lock()
+    run_command(env, f"ec.encode -volumeId {vid}")
+    # duplicate a shard on a second server to exercise dedupe
+    info = http_json("GET", f"http://{master.url}/dir/lookup_ec?volumeId={vid}")
+    shard_map = {int(s): urls for s, urls in info["shards"].items()}
+    sid, holders = next((s, u) for s, u in sorted(shard_map.items()) if u)
+    other = next(vs.url for vs in servers if vs.url not in holders)
+    http_json("POST", f"http://{other}/admin/ec/copy", {
+        "volume_id": vid, "shard_ids": [sid], "source_data_node": holders[0]})
+    http_json("POST", f"http://{other}/admin/ec/mount", {"volume_id": vid})
+    sync_heartbeats(servers)
+    info = http_json("GET", f"http://{master.url}/dir/lookup_ec?volumeId={vid}")
+    assert len(info["shards"][str(sid)]) == 2
+    run_command(env, "ec.balance")
+    sync_heartbeats(servers)
+    info = http_json("GET", f"http://{master.url}/dir/lookup_ec?volumeId={vid}")
+    assert all(len(urls) == 1 for urls in info["shards"].values())
+    env.unlock()
+
+
+def test_shell_lock_required(cluster):
+    master, _ = cluster
+    env = CommandEnv(master.url)
+    with pytest.raises(RuntimeError, match="lock"):
+        run_command(env, "ec.encode -volumeId 1")
+
+
+def test_shell_listing_commands(cluster):
+    master, servers = cluster
+    client = WeedClient(master.url)
+    client.upload(b"x")
+    sync_heartbeats(servers)
+    env = CommandEnv(master.url)
+    assert "volume server" in run_command(env, "cluster.ps")
+    assert "DataNode" in run_command(env, "volume.list")

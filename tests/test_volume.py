@@ -104,6 +104,26 @@ def test_compact_reclaims_space(tmp_path):
     v.close()
 
 
+def test_write_during_compaction_survives_commit(tmp_path):
+    """makeupDiff (volume_vacuum.go:181): a write (and a delete) landing
+    between compact() and commit_compact() must survive the swap."""
+    v = make_volume(tmp_path)
+    for i in range(1, 6):
+        v.write_needle(Needle(cookie=i, id=i, data=bytes([i]) * 500))
+    v.delete_needle(Needle(cookie=1, id=1))
+    v.compact()
+    # in-between mutations
+    v.write_needle(Needle(cookie=99, id=99, data=b"landed mid-vacuum"))
+    v.delete_needle(Needle(cookie=2, id=2))
+    v.commit_compact()
+    assert v.read_needle(99).data == b"landed mid-vacuum"
+    with pytest.raises((DeletedError, NotFoundError)):
+        v.read_needle(2)
+    for i in (3, 4, 5):
+        assert v.read_needle(i).data == bytes([i]) * 500
+    v.close()
+
+
 def test_torn_write_truncation(tmp_path):
     v = make_volume(tmp_path)
     v.write_needle(Needle(cookie=1, id=1, data=b"full record"))

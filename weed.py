@@ -1,0 +1,203 @@
+#!/usr/bin/env python
+"""seaweedfs-tpu CLI — one binary, subcommand picks the role.
+
+Equivalent of weed/weed.go + weed/command/ (the `weed` binary): master,
+volume, server (all-in-one), shell, upload, download, delete, benchmark.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import signal
+import sys
+import time
+
+
+def cmd_master(args) -> None:
+    from seaweedfs_tpu.master.server import MasterServer
+
+    m = MasterServer(host=args.ip, port=args.port,
+                     volume_size_limit_mb=args.volumeSizeLimitMB,
+                     default_replication=args.defaultReplication).start()
+    print(f"master listening on {m.url}")
+    _wait_forever()
+
+
+def cmd_volume(args) -> None:
+    from seaweedfs_tpu.volume_server.server import VolumeServer
+
+    vs = VolumeServer(args.dir.split(","), args.mserver, host=args.ip,
+                      port=args.port, data_center=args.dataCenter,
+                      rack=args.rack, max_volume_count=args.max,
+                      ec_engine=args.ec_engine).start()
+    print(f"volume server listening on {vs.url}, dirs {args.dir}")
+    _wait_forever()
+
+
+def cmd_server(args) -> None:
+    """All-in-one: master + one volume server (command/server.go)."""
+    from seaweedfs_tpu.master.server import MasterServer
+    from seaweedfs_tpu.volume_server.server import VolumeServer
+
+    m = MasterServer(host=args.ip, port=args.masterPort).start()
+    vs = VolumeServer(args.dir.split(","), m.url, host=args.ip,
+                      port=args.port, ec_engine=args.ec_engine).start()
+    print(f"master on {m.url}, volume server on {vs.url}")
+    _wait_forever()
+
+
+def cmd_shell(args) -> None:
+    from seaweedfs_tpu.shell import CommandEnv, repl, run_command
+
+    if args.c:
+        env = CommandEnv(args.master)
+        env.lock()
+        try:
+            for line in args.c.split(";"):
+                out = run_command(env, line.strip())
+                if out is not None:
+                    print(out)
+        finally:
+            env.unlock()
+    else:
+        repl(args.master)
+
+
+def cmd_upload(args) -> None:
+    from seaweedfs_tpu.client.operation import WeedClient
+
+    client = WeedClient(args.master)
+    for path in args.files:
+        with open(path, "rb") as f:
+            fid = client.upload(f.read(), name=path.split("/")[-1],
+                                collection=args.collection,
+                                replication=args.replication)
+        print(json.dumps({"file": path, "fid": fid}))
+
+
+def cmd_download(args) -> None:
+    from seaweedfs_tpu.client.operation import WeedClient
+
+    client = WeedClient(args.master)
+    data = client.download(args.fid)
+    if args.output == "-":
+        sys.stdout.buffer.write(data)
+    else:
+        with open(args.output, "wb") as f:
+            f.write(data)
+        print(f"wrote {len(data)} bytes to {args.output}")
+
+
+def cmd_benchmark(args) -> None:
+    """weed benchmark (command/benchmark.go): write then read N files."""
+    import concurrent.futures
+    import random
+
+    from seaweedfs_tpu.client.operation import WeedClient
+
+    client = WeedClient(args.master)
+    payload = bytes(random.getrandbits(8) for _ in range(args.size))
+    fids: list[str] = []
+
+    def write_one(i: int) -> float:
+        t0 = time.perf_counter()
+        fid = client.upload(payload, name=f"bench{i}")
+        fids.append(fid)
+        return time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    with concurrent.futures.ThreadPoolExecutor(args.c) as ex:
+        lat = sorted(ex.map(write_one, range(args.n)))
+    wall = time.perf_counter() - t0
+    print(f"write: {args.n} x {args.size}B in {wall:.2f}s = "
+          f"{args.n / wall:.0f} req/s, "
+          f"avg {sum(lat) / len(lat) * 1e3:.1f}ms "
+          f"p99 {lat[int(len(lat) * 0.99) - 1] * 1e3:.1f}ms")
+
+    def read_one(fid: str) -> float:
+        t0 = time.perf_counter()
+        assert client.download(fid) == payload
+        return time.perf_counter() - t0
+
+    random.shuffle(fids)
+    t0 = time.perf_counter()
+    with concurrent.futures.ThreadPoolExecutor(args.c) as ex:
+        lat = sorted(ex.map(read_one, fids))
+    wall = time.perf_counter() - t0
+    print(f"read: {args.n} in {wall:.2f}s = {args.n / wall:.0f} req/s, "
+          f"avg {sum(lat) / len(lat) * 1e3:.1f}ms "
+          f"p99 {lat[int(len(lat) * 0.99) - 1] * 1e3:.1f}ms")
+
+
+def _wait_forever() -> None:
+    try:
+        signal.pause()
+    except (KeyboardInterrupt, AttributeError):
+        while True:
+            time.sleep(3600)
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(prog="weed.py", description=__doc__)
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    m = sub.add_parser("master")
+    m.add_argument("-ip", default="127.0.0.1")
+    m.add_argument("-port", type=int, default=9333)
+    m.add_argument("-volumeSizeLimitMB", type=int, default=30000)
+    m.add_argument("-defaultReplication", default="000")
+    m.set_defaults(fn=cmd_master)
+
+    v = sub.add_parser("volume")
+    v.add_argument("-dir", default="./data")
+    v.add_argument("-ip", default="127.0.0.1")
+    v.add_argument("-port", type=int, default=8080)
+    v.add_argument("-mserver", default="127.0.0.1:9333")
+    v.add_argument("-dataCenter", default="")
+    v.add_argument("-rack", default="")
+    v.add_argument("-max", type=int, default=8)
+    v.add_argument("-ec.engine", dest="ec_engine", default="cpu",
+                   choices=["cpu", "tpu"])
+    v.set_defaults(fn=cmd_volume)
+
+    s = sub.add_parser("server")
+    s.add_argument("-dir", default="./data")
+    s.add_argument("-ip", default="127.0.0.1")
+    s.add_argument("-masterPort", type=int, default=9333)
+    s.add_argument("-port", type=int, default=8080)
+    s.add_argument("-ec.engine", dest="ec_engine", default="cpu",
+                   choices=["cpu", "tpu"])
+    s.set_defaults(fn=cmd_server)
+
+    sh = sub.add_parser("shell")
+    sh.add_argument("-master", default="127.0.0.1:9333")
+    sh.add_argument("-c", default="", help="run commands and exit ( ; separated)")
+    sh.set_defaults(fn=cmd_shell)
+
+    up = sub.add_parser("upload")
+    up.add_argument("-master", default="127.0.0.1:9333")
+    up.add_argument("-collection", default="")
+    up.add_argument("-replication", default="")
+    up.add_argument("files", nargs="+")
+    up.set_defaults(fn=cmd_upload)
+
+    dl = sub.add_parser("download")
+    dl.add_argument("-master", default="127.0.0.1:9333")
+    dl.add_argument("-o", dest="output", default="-")
+    dl.add_argument("fid")
+    dl.set_defaults(fn=cmd_download)
+
+    b = sub.add_parser("benchmark")
+    b.add_argument("-master", default="127.0.0.1:9333")
+    b.add_argument("-n", type=int, default=1000)
+    b.add_argument("-size", type=int, default=1024)
+    b.add_argument("-c", type=int, default=16)
+    b.set_defaults(fn=cmd_benchmark)
+
+    args = p.parse_args(argv)
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main()
