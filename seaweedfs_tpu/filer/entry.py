@@ -1,0 +1,121 @@
+"""Filer entry model: directory tree nodes with chunk lists.
+
+Equivalent of weed/filer/entry.go + the FileChunk message
+(pb/filer.proto:121-170).  Entries serialize to/from JSON dicts (the wire
+format of this rebuild's filer API; protobuf can replace the codec without
+touching callers).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+
+@dataclass
+class FileChunk:
+    """One stored chunk of a file (filer.proto FileChunk)."""
+    file_id: str
+    offset: int
+    size: int
+    modified_ts_ns: int = 0
+    etag: str = ""
+    is_chunk_manifest: bool = False
+
+    def to_dict(self) -> dict:
+        return {
+            "file_id": self.file_id, "offset": self.offset, "size": self.size,
+            "modified_ts_ns": self.modified_ts_ns, "etag": self.etag,
+            "is_chunk_manifest": self.is_chunk_manifest,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "FileChunk":
+        return cls(d["file_id"], int(d["offset"]), int(d["size"]),
+                   int(d.get("modified_ts_ns", 0)), d.get("etag", ""),
+                   bool(d.get("is_chunk_manifest", False)))
+
+
+@dataclass
+class Attr:
+    """File attributes (filer/entry.go Attr)."""
+    mtime: float = field(default_factory=time.time)
+    crtime: float = field(default_factory=time.time)
+    mode: int = 0o660
+    uid: int = 0
+    gid: int = 0
+    mime: str = ""
+    replication: str = ""
+    collection: str = ""
+    ttl_seconds: int = 0
+    user_name: str = ""
+    symlink_target: str = ""
+    md5: str = ""
+
+    def to_dict(self) -> dict:
+        return {
+            "mtime": self.mtime, "crtime": self.crtime, "mode": self.mode,
+            "uid": self.uid, "gid": self.gid, "mime": self.mime,
+            "replication": self.replication, "collection": self.collection,
+            "ttl_seconds": self.ttl_seconds, "user_name": self.user_name,
+            "symlink_target": self.symlink_target, "md5": self.md5,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Attr":
+        return cls(**{k: d[k] for k in cls.__dataclass_fields__ if k in d})
+
+
+DIRECTORY_MODE_BIT = 0o20000000000  # os.ModeDir in Go's fs.FileMode
+
+
+@dataclass
+class Entry:
+    full_path: str
+    attr: Attr = field(default_factory=Attr)
+    chunks: list[FileChunk] = field(default_factory=list)
+    extended: dict[str, str] = field(default_factory=dict)
+    hard_link_id: str = ""
+    hard_link_counter: int = 0
+
+    @property
+    def is_directory(self) -> bool:
+        return bool(self.attr.mode & DIRECTORY_MODE_BIT)
+
+    @property
+    def name(self) -> str:
+        return self.full_path.rsplit("/", 1)[-1]
+
+    @property
+    def parent(self) -> str:
+        p = self.full_path.rsplit("/", 1)[0]
+        return p or "/"
+
+    @property
+    def file_size(self) -> int:
+        return max((c.offset + c.size for c in self.chunks), default=0)
+
+    def to_dict(self) -> dict:
+        return {
+            "full_path": self.full_path,
+            "attr": self.attr.to_dict(),
+            "chunks": [c.to_dict() for c in self.chunks],
+            "extended": self.extended,
+            "hard_link_id": self.hard_link_id,
+            "hard_link_counter": self.hard_link_counter,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Entry":
+        return cls(
+            full_path=d["full_path"],
+            attr=Attr.from_dict(d.get("attr", {})),
+            chunks=[FileChunk.from_dict(c) for c in d.get("chunks", [])],
+            extended=d.get("extended", {}),
+            hard_link_id=d.get("hard_link_id", ""),
+            hard_link_counter=int(d.get("hard_link_counter", 0)),
+        )
+
+
+def new_directory_entry(path: str, mode: int = 0o770) -> Entry:
+    return Entry(full_path=path, attr=Attr(mode=mode | DIRECTORY_MODE_BIT))

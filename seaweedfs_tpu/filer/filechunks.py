@@ -1,0 +1,91 @@
+"""Chunk interval resolution: which chunk serves which byte range.
+
+Equivalent of weed/filer/filechunks.go — later-written chunks (higher
+modified_ts_ns) shadow earlier ones where they overlap; reads plan a list of
+ChunkViews covering [offset, offset+size).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass
+
+from .entry import FileChunk
+
+
+@dataclass
+class VisibleInterval:
+    start: int
+    stop: int
+    file_id: str
+    modified_ts_ns: int
+    chunk_offset: int  # where this interval starts inside the chunk
+
+
+@dataclass
+class ChunkView:
+    file_id: str
+    offset_in_chunk: int  # byte offset inside the stored chunk blob
+    size: int
+    logic_offset: int  # offset in the file
+
+
+def non_overlapping_visible_intervals(chunks: list[FileChunk]) -> list[VisibleInterval]:
+    """filechunks.go readResolvedChunks: sort by mtime, newer chunks punch
+    holes into older intervals."""
+    visibles: list[VisibleInterval] = []
+    for chunk in sorted(chunks, key=lambda c: (c.modified_ts_ns, c.file_id)):
+        new_v = VisibleInterval(chunk.offset, chunk.offset + chunk.size,
+                                chunk.file_id, chunk.modified_ts_ns, 0)
+        out: list[VisibleInterval] = []
+        for v in visibles:
+            if v.stop <= new_v.start or v.start >= new_v.stop:
+                out.append(v)
+                continue
+            if v.start < new_v.start:
+                out.append(VisibleInterval(v.start, new_v.start, v.file_id,
+                                           v.modified_ts_ns, v.chunk_offset))
+            if v.stop > new_v.stop:
+                out.append(VisibleInterval(
+                    new_v.stop, v.stop, v.file_id, v.modified_ts_ns,
+                    v.chunk_offset + (new_v.stop - v.start)))
+        out.append(new_v)
+        visibles = sorted(out, key=lambda v: v.start)
+    return visibles
+
+
+def view_from_visibles(visibles: list[VisibleInterval], offset: int,
+                       size: int) -> list[ChunkView]:
+    views: list[ChunkView] = []
+    stop = offset + size
+    for v in visibles:
+        if v.stop <= offset or v.start >= stop:
+            continue
+        start = max(offset, v.start)
+        end = min(stop, v.stop)
+        views.append(ChunkView(
+            file_id=v.file_id,
+            offset_in_chunk=v.chunk_offset + (start - v.start),
+            size=end - start,
+            logic_offset=start,
+        ))
+    return views
+
+
+def read_plan(chunks: list[FileChunk], offset: int, size: int) -> list[ChunkView]:
+    return view_from_visibles(non_overlapping_visible_intervals(chunks), offset, size)
+
+
+def total_size(chunks: list[FileChunk]) -> int:
+    return max((c.offset + c.size for c in chunks), default=0)
+
+
+def etag_of_chunks(chunks: list[FileChunk]) -> str:
+    """filer.ETagChunks: single chunk -> its etag; else md5-of-md5s with
+    chunk-count suffix (S3 multipart convention)."""
+    if len(chunks) == 1:
+        return chunks[0].etag
+    h = hashlib.md5()
+    for c in sorted(chunks, key=lambda c: c.offset):
+        h.update(bytes.fromhex(c.etag) if len(c.etag) == 32 else c.etag.encode())
+    return f"{h.hexdigest()}-{len(chunks)}"

@@ -1,0 +1,257 @@
+"""Filer core: the directory tree over a FilerStore, with meta-log events
+and async chunk garbage collection.
+
+Equivalent of weed/filer/filer.go (CreateEntry :154, FindEntry, ListDirectory)
++ filer_delete_entry.go (recursive delete with chunk collection) +
+filer_deletion.go (async chunk GC loop) + filer_notify.go (meta log append +
+subscription) — the meta log here is an in-process ring + on-store persisted
+event stream under /topics/.system/log, replayable for subscribers.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Callable, Iterator, Optional
+
+from .entry import Attr, Entry, new_directory_entry
+from .filer_store import FilerStore, MemoryStore
+
+LOG_DIR = "/topics/.system/log"
+
+
+class FilerError(Exception):
+    pass
+
+
+class NotFoundError(FilerError, KeyError):
+    """KeyError subclass so HTTP routers map it to 404, not 500."""
+
+
+class NotEmptyError(FilerError):
+    pass
+
+
+class Filer:
+    def __init__(self, store: Optional[FilerStore] = None,
+                 delete_chunks_fn: Optional[Callable[[list[str]], None]] = None):
+        self.store = store or MemoryStore()
+        self._lock = threading.RLock()
+        self._delete_chunks_fn = delete_chunks_fn
+        self._gc_queue: list[str] = []
+        self._gc_event = threading.Event()
+        self._stop = threading.Event()
+        # meta log: monotonically increasing ts_ns events
+        self._log: list[dict] = []
+        self._log_lock = threading.Lock()
+        self._subscribers: list[Callable[[dict], None]] = []
+        if self.store.find_entry("/") is None:
+            self.store.insert_entry(new_directory_entry("/", 0o755))
+        threading.Thread(target=self._gc_loop, daemon=True,
+                         name="filer-chunk-gc").start()
+
+    # --- entry CRUD (filer.go) -------------------------------------------
+    def create_entry(self, entry: Entry, o_excl: bool = False) -> Entry:
+        with self._lock:
+            self._ensure_parents(entry.parent)
+            old = self.store.find_entry(entry.full_path)
+            if old is not None:
+                if o_excl:
+                    raise FilerError(f"{entry.full_path} already exists")
+                # overwritten file: old chunks become garbage
+                if not old.is_directory:
+                    self._collect_chunks(old, keep=entry.chunks)
+            self.store.insert_entry(entry)
+        self._notify("create" if old is None else "update", old, entry)
+        return entry
+
+    def update_entry(self, entry: Entry) -> Entry:
+        with self._lock:
+            old = self.store.find_entry(entry.full_path)
+            if old is None:
+                raise NotFoundError(entry.full_path)
+            self.store.update_entry(entry)
+        self._notify("update", old, entry)
+        return entry
+
+    def find_entry(self, path: str) -> Entry:
+        e = self.store.find_entry(_norm(path))
+        if e is None:
+            raise NotFoundError(path)
+        return e
+
+    def exists(self, path: str) -> bool:
+        return self.store.find_entry(_norm(path)) is not None
+
+    def mkdir(self, path: str, mode: int = 0o770) -> Entry:
+        with self._lock:
+            self._ensure_parents(_norm(path))
+            return self.find_entry(path)
+
+    def _ensure_parents(self, dir_path: str) -> None:
+        """CreateEntry's parent auto-create walk (filer.go:154-200)."""
+        dir_path = _norm(dir_path)
+        missing = []
+        p = dir_path
+        while p != "/" and self.store.find_entry(p) is None:
+            missing.append(p)
+            p = p.rsplit("/", 1)[0] or "/"
+        for p in reversed(missing):
+            d = new_directory_entry(p)
+            self.store.insert_entry(d)
+            self._notify("create", None, d)
+
+    def delete_entry(self, path: str, recursive: bool = False,
+                     ignore_recursive_error: bool = False) -> None:
+        """filer_delete_entry.go: collect descendant chunks, then remove."""
+        path = _norm(path)
+        entry = self.find_entry(path)
+        with self._lock:
+            if entry.is_directory:
+                children = list(self.store.list_directory_entries(path, limit=2))
+                if children and not recursive:
+                    raise NotEmptyError(f"{path}: folder not empty")
+                self._delete_tree(path)
+                self.store.delete_folder_children(path)
+            else:
+                self._collect_chunks(entry)
+            self.store.delete_entry(path)
+        self._notify("delete", entry, None)
+
+    def _delete_tree(self, dir_path: str) -> None:
+        start = ""
+        while True:
+            batch = list(self.store.list_directory_entries(dir_path, start, False, 1000))
+            if not batch:
+                return
+            for child in batch:
+                if child.is_directory:
+                    self._delete_tree(child.full_path)
+                else:
+                    self._collect_chunks(child)
+                self._notify("delete", child, None)
+            start = batch[-1].name
+
+    def list_directory(self, path: str, start_file: str = "",
+                       include_start: bool = False, limit: int = 1000,
+                       prefix: str = "") -> list[Entry]:
+        return list(self.store.list_directory_entries(
+            _norm(path), start_file, include_start, limit, prefix))
+
+    def iterate_tree(self, path: str = "/") -> Iterator[Entry]:
+        for child in self.store.list_directory_entries(path, limit=1_000_000):
+            yield child
+            if child.is_directory:
+                yield from self.iterate_tree(child.full_path)
+
+    # --- rename (filer_grpc_server_rename.go: atomic subtree move) --------
+    def rename(self, old_path: str, new_path: str) -> Entry:
+        old_path, new_path = _norm(old_path), _norm(new_path)
+        if new_path == old_path or new_path.startswith(old_path + "/"):
+            raise FilerError(
+                f"cannot move {old_path} into its own subtree {new_path}")
+        with self._lock:
+            entry = self.find_entry(old_path)
+            existing = self.store.find_entry(new_path)
+            if existing is not None and not existing.is_directory:
+                self._collect_chunks(existing)  # overwritten target's chunks
+            self._ensure_parents(new_path.rsplit("/", 1)[0] or "/")
+            moved = self._move_subtree(entry, old_path, new_path)
+        return moved
+
+    def _move_subtree(self, entry: Entry, old_path: str, new_path: str) -> Entry:
+        # list children BEFORE inserting the new entry, so a rename that
+        # lands inside the listed directory can never see itself
+        children = list(self.store.list_directory_entries(
+            old_path, limit=1_000_000)) if entry.is_directory else []
+        new_entry = Entry(full_path=new_path, attr=entry.attr,
+                          chunks=entry.chunks, extended=entry.extended,
+                          hard_link_id=entry.hard_link_id,
+                          hard_link_counter=entry.hard_link_counter)
+        self.store.insert_entry(new_entry)
+        for child in children:
+            self._move_subtree(child, child.full_path,
+                               f"{new_path}/{child.name}")
+        self.store.delete_entry(old_path)
+        self._notify("rename", entry, new_entry)
+        return new_entry
+
+    # --- chunk GC (filer_deletion.go) -------------------------------------
+    def _collect_chunks(self, entry: Entry, keep: list = ()) -> None:
+        keep_ids = {c.file_id for c in keep}
+        with self._lock:
+            for c in entry.chunks:
+                if c.file_id not in keep_ids:
+                    self._gc_queue.append(c.file_id)
+        self._gc_event.set()
+
+    def _gc_loop(self) -> None:
+        while not self._stop.is_set():
+            self._gc_event.wait(1.0)
+            self._gc_event.clear()
+            with self._lock:
+                batch, self._gc_queue = self._gc_queue[:1000], self._gc_queue[1000:]
+            if batch and self._delete_chunks_fn is not None:
+                try:
+                    self._delete_chunks_fn(batch)
+                except Exception:
+                    pass  # chunk GC is best-effort; orphans are re-collectable
+
+    def flush_gc(self) -> None:
+        """Synchronously drain the chunk GC queue (for tests/shutdown)."""
+        with self._lock:
+            batch, self._gc_queue = self._gc_queue, []
+        if batch and self._delete_chunks_fn is not None:
+            self._delete_chunks_fn(batch)
+
+    # --- meta log + subscribe (filer_notify.go) ---------------------------
+    def _notify(self, op: str, old: Optional[Entry], new: Optional[Entry]) -> None:
+        event = {
+            "ts_ns": time.time_ns(),
+            "op": op,
+            "directory": (new or old).parent,
+            "old_entry": old.to_dict() if old else None,
+            "new_entry": new.to_dict() if new else None,
+        }
+        with self._log_lock:
+            self._log.append(event)
+            subs = list(self._subscribers)
+            # persist append-only: one kv record per event, keyed by day+ts
+            # (O(1) per mutation, race-free — filer_notify_append.go analog)
+            day = time.strftime("%Y-%m-%d", time.gmtime())
+            key = f"{LOG_DIR}/{day}/{event['ts_ns']:020d}".encode()
+            self.store.kv_put(key, json.dumps(event).encode())
+        for fn in subs:
+            try:
+                fn(event)
+            except Exception:
+                pass
+
+    def subscribe(self, fn: Callable[[dict], None],
+                  since_ns: int = 0) -> Callable[[], None]:
+        """SubscribeMetadata: replay history then tail live events."""
+        with self._log_lock:
+            history = [e for e in self._log if e["ts_ns"] >= since_ns]
+            self._subscribers.append(fn)
+        for e in history:
+            fn(e)
+
+        def cancel() -> None:
+            with self._log_lock:
+                if fn in self._subscribers:
+                    self._subscribers.remove(fn)
+
+        return cancel
+
+    def close(self) -> None:
+        self._stop.set()
+        self.flush_gc()
+
+
+def _norm(path: str) -> str:
+    if not path.startswith("/"):
+        path = "/" + path
+    while "//" in path:
+        path = path.replace("//", "/")
+    return path.rstrip("/") or "/"

@@ -1,0 +1,194 @@
+"""FilerStore interface + embedded backends (memory, sqlite).
+
+Equivalent of weed/filer/filerstore.go:19-42 and the abstract_sql family —
+the sqlite backend is the rebuild's counterpart of the reference's
+leveldb/sql embedded stores (goleveldb has no Python equivalent in this
+environment; sqlite is the stdlib-native durable KV with range scans).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sqlite3
+import threading
+from typing import Iterator, Optional, Protocol
+
+from .entry import Entry
+
+
+class FilerStore(Protocol):
+    name: str
+
+    def insert_entry(self, entry: Entry) -> None: ...
+
+    def update_entry(self, entry: Entry) -> None: ...
+
+    def find_entry(self, path: str) -> Optional[Entry]: ...
+
+    def delete_entry(self, path: str) -> None: ...
+
+    def delete_folder_children(self, path: str) -> None: ...
+
+    def list_directory_entries(self, dir_path: str, start_file: str = "",
+                               include_start: bool = False, limit: int = 1000,
+                               prefix: str = "") -> Iterator[Entry]: ...
+
+    def kv_put(self, key: bytes, value: bytes) -> None: ...
+
+    def kv_get(self, key: bytes) -> Optional[bytes]: ...
+
+    def kv_delete(self, key: bytes) -> None: ...
+
+
+class MemoryStore:
+    """Dict-backed store for tests and ephemeral filers."""
+
+    name = "memory"
+
+    def __init__(self):
+        self._entries: dict[str, Entry] = {}
+        self._kv: dict[bytes, bytes] = {}
+        self._lock = threading.RLock()
+
+    def insert_entry(self, entry: Entry) -> None:
+        with self._lock:
+            self._entries[entry.full_path] = entry
+
+    update_entry = insert_entry
+
+    def find_entry(self, path: str) -> Optional[Entry]:
+        return self._entries.get(path)
+
+    def delete_entry(self, path: str) -> None:
+        with self._lock:
+            self._entries.pop(path, None)
+
+    def delete_folder_children(self, path: str) -> None:
+        prefix = path.rstrip("/") + "/"
+        with self._lock:
+            for p in [p for p in self._entries if p.startswith(prefix)]:
+                del self._entries[p]
+
+    def list_directory_entries(self, dir_path: str, start_file: str = "",
+                               include_start: bool = False, limit: int = 1000,
+                               prefix: str = "") -> Iterator[Entry]:
+        dir_prefix = dir_path.rstrip("/") + "/"
+        names = []
+        for p, e in self._entries.items():
+            if not p.startswith(dir_prefix):
+                continue
+            name = p[len(dir_prefix):]
+            if "/" in name or not name:
+                continue
+            if prefix and not name.startswith(prefix):
+                continue
+            if start_file:
+                if name < start_file or (name == start_file and not include_start):
+                    continue
+            names.append((name, e))
+        for _, e in sorted(names)[:limit]:
+            yield e
+
+    def kv_put(self, key: bytes, value: bytes) -> None:
+        self._kv[key] = value
+
+    def kv_get(self, key: bytes) -> Optional[bytes]:
+        return self._kv.get(key)
+
+    def kv_delete(self, key: bytes) -> None:
+        self._kv.pop(key, None)
+
+
+class SqliteStore:
+    """Durable embedded store (abstract_sql semantics: one row per entry,
+    keyed by (dirhash, name) equivalent — here (dir, name))."""
+
+    name = "sqlite"
+
+    def __init__(self, path: str):
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._path = path
+        self._local = threading.local()
+        con = self._con()
+        con.execute("""CREATE TABLE IF NOT EXISTS entries (
+            dir TEXT NOT NULL, name TEXT NOT NULL, meta TEXT NOT NULL,
+            PRIMARY KEY (dir, name))""")
+        con.execute("""CREATE TABLE IF NOT EXISTS kv (
+            k BLOB PRIMARY KEY, v BLOB NOT NULL)""")
+        con.commit()
+
+    def _con(self) -> sqlite3.Connection:
+        con = getattr(self._local, "con", None)
+        if con is None:
+            con = sqlite3.connect(self._path, timeout=30)
+            con.execute("PRAGMA journal_mode=WAL")
+            self._local.con = con
+        return con
+
+    @staticmethod
+    def _split(path: str) -> tuple[str, str]:
+        if path == "/":
+            return "", "/"
+        d, _, name = path.rstrip("/").rpartition("/")
+        return d or "/", name
+
+    def insert_entry(self, entry: Entry) -> None:
+        d, name = self._split(entry.full_path)
+        con = self._con()
+        con.execute("INSERT OR REPLACE INTO entries VALUES (?,?,?)",
+                    (d, name, json.dumps(entry.to_dict())))
+        con.commit()
+
+    update_entry = insert_entry
+
+    def find_entry(self, path: str) -> Optional[Entry]:
+        d, name = self._split(path)
+        row = self._con().execute(
+            "SELECT meta FROM entries WHERE dir=? AND name=?",
+            (d, name)).fetchone()
+        return Entry.from_dict(json.loads(row[0])) if row else None
+
+    def delete_entry(self, path: str) -> None:
+        d, name = self._split(path)
+        con = self._con()
+        con.execute("DELETE FROM entries WHERE dir=? AND name=?", (d, name))
+        con.commit()
+
+    def delete_folder_children(self, path: str) -> None:
+        base = path.rstrip("/")
+        con = self._con()
+        con.execute("DELETE FROM entries WHERE dir=? OR dir LIKE ?",
+                    (base or "/", base + "/%"))
+        con.commit()
+
+    def list_directory_entries(self, dir_path: str, start_file: str = "",
+                               include_start: bool = False, limit: int = 1000,
+                               prefix: str = "") -> Iterator[Entry]:
+        d = dir_path.rstrip("/") or "/"
+        q = "SELECT meta FROM entries WHERE dir=?"
+        args: list = [d]
+        if start_file:
+            q += f" AND name {'>=' if include_start else '>'} ?"
+            args.append(start_file)
+        if prefix:
+            q += " AND name LIKE ?"
+            args.append(prefix.replace("%", r"\%") + "%")
+        q += " ORDER BY name LIMIT ?"
+        args.append(limit)
+        for (meta,) in self._con().execute(q, args):
+            yield Entry.from_dict(json.loads(meta))
+
+    def kv_put(self, key: bytes, value: bytes) -> None:
+        con = self._con()
+        con.execute("INSERT OR REPLACE INTO kv VALUES (?,?)", (key, value))
+        con.commit()
+
+    def kv_get(self, key: bytes) -> Optional[bytes]:
+        row = self._con().execute("SELECT v FROM kv WHERE k=?", (key,)).fetchone()
+        return row[0] if row else None
+
+    def kv_delete(self, key: bytes) -> None:
+        con = self._con()
+        con.execute("DELETE FROM kv WHERE k=?", (key,))
+        con.commit()

@@ -1,0 +1,219 @@
+"""Filer HTTP server: path-addressed file API with auto-chunking.
+
+Equivalent of weed/server/filer_server*.go: uploads split into chunks at
+-maxMB boundaries, each chunk assigned + stored on volume servers
+(filer_server_handlers_write_autochunk.go:24-271); reads plan ChunkViews and
+stream from volume servers (filer/stream.go); directory GETs return JSON
+listings; /api/* carries the rename/mkdir/stat verbs (the gRPC surface of
+the reference).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+import time
+from typing import Optional
+
+from ..client.operation import WeedClient
+from ..utils.httpd import HttpError, Request, Response, Router, http_bytes, serve
+from .entry import Attr, Entry, FileChunk
+from .filechunks import etag_of_chunks, read_plan, total_size
+from .filer import Filer, FilerError, NotEmptyError
+from .filer import NotFoundError as FilerNotFound
+from .filer_store import FilerStore
+
+
+class FilerServer:
+    def __init__(self, master_url: str, store: Optional[FilerStore] = None,
+                 host: str = "127.0.0.1", port: int = 8888,
+                 max_chunk_mb: int = 8, collection: str = "",
+                 replication: str = ""):
+        self.master_url = master_url
+        self.client = WeedClient(master_url)
+        self.filer = Filer(store, delete_chunks_fn=self._delete_chunks)
+        self.host, self.port = host, port
+        self.max_chunk_size = max_chunk_mb * 1024 * 1024
+        self.collection = collection
+        self.replication = replication
+        self.router = Router("filer")
+        self._register_routes()
+        self._server = None
+
+    @property
+    def url(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def start(self) -> "FilerServer":
+        self._server = serve(self.router, self.host, self.port)
+        return self
+
+    def stop(self) -> None:
+        if self._server:
+            self._server.shutdown()
+        self.filer.close()
+
+    # --- chunk IO ---------------------------------------------------------
+    def _delete_chunks(self, fids: list[str]) -> None:
+        for fid in fids:
+            try:
+                self.client.delete(fid)
+            except Exception:
+                pass
+
+    def write_chunks(self, data: bytes, collection: str = "",
+                     ttl: str = "") -> list[FileChunk]:
+        """Auto-chunking upload: split at max_chunk_size, one fid each."""
+        if not data:
+            return []
+        chunks: list[FileChunk] = []
+        now = time.time_ns()
+        for off in range(0, len(data), self.max_chunk_size):
+            piece = data[off : off + self.max_chunk_size]
+            fid = self.client.upload(
+                piece, collection=collection or self.collection,
+                replication=self.replication, ttl=ttl)
+            chunks.append(FileChunk(
+                file_id=fid, offset=off, size=len(piece),
+                modified_ts_ns=now,
+                etag=hashlib.md5(piece).hexdigest()))
+        return chunks
+
+    def read_chunks(self, entry: Entry, offset: int = 0,
+                    size: Optional[int] = None) -> bytes:
+        file_size = total_size(entry.chunks)
+        if size is None:
+            size = file_size - offset
+        size = max(0, min(size, file_size - offset))
+        if size == 0:
+            return b""
+        out = bytearray(size)
+        for view in read_plan(entry.chunks, offset, size):
+            blob = self.client.download(view.file_id)
+            piece = blob[view.offset_in_chunk : view.offset_in_chunk + view.size]
+            start = view.logic_offset - offset
+            out[start : start + len(piece)] = piece
+        return bytes(out)
+
+    # --- file API ---------------------------------------------------------
+    def put_file(self, path: str, data: bytes, mime: str = "",
+                 collection: str = "", ttl: str = "",
+                 mode: int = 0o660) -> Entry:
+        chunks = self.write_chunks(data, collection, ttl)
+        entry = Entry(full_path=path, attr=Attr(
+            mtime=time.time(), crtime=time.time(), mode=mode, mime=mime,
+            collection=collection or self.collection,
+            replication=self.replication,
+            md5=hashlib.md5(data).hexdigest()), chunks=chunks)
+        return self.filer.create_entry(entry)
+
+    def get_file(self, path: str) -> tuple[Entry, bytes]:
+        entry = self.filer.find_entry(path)
+        if entry.is_directory:
+            raise IsADirectoryError(path)
+        return entry, self.read_chunks(entry)
+
+    # --- routes -----------------------------------------------------------
+    def _register_routes(self) -> None:
+        r = self.router
+
+        @r.route("GET", "/api/stat(/.*)")
+        def api_stat(req: Request) -> Response:
+            entry = self.filer.find_entry(req.match.group(1))
+            d = entry.to_dict()
+            d["file_size"] = entry.file_size
+            d["is_directory"] = entry.is_directory
+            return Response(d)
+
+        @r.route("POST", "/api/rename")
+        def api_rename(req: Request) -> Response:
+            b = req.json()
+            moved = self.filer.rename(b["from"], b["to"])
+            return Response({"path": moved.full_path})
+
+        @r.route("POST", "/api/mkdir")
+        def api_mkdir(req: Request) -> Response:
+            path = req.json()["path"].rstrip("/") or "/"
+            self.filer._ensure_parents(path)
+            return Response({"path": path})
+
+        @r.route("GET", "(/.*)")
+        @r.route("HEAD", "(/.*)")
+        def read(req: Request) -> Response:
+            path = req.match.group(1) or "/"
+            try:
+                entry = self.filer.find_entry(path)
+            except FilerNotFound:
+                raise HttpError(404, f"{path} not found")
+            if entry.is_directory:
+                limit = int(req.query.get("limit") or 1000)
+                listing = self.filer.list_directory(
+                    path, start_file=req.query.get("lastFileName", ""),
+                    limit=limit, prefix=req.query.get("prefix", ""))
+                return Response({
+                    "Path": path,
+                    "Entries": [self._entry_json(e) for e in listing],
+                    "ShouldDisplayLoadMore": len(listing) >= limit,
+                })
+            from ..utils.httpd import parse_range
+
+            file_size = entry.file_size
+            rng = parse_range(req.headers.get("Range", ""), file_size)
+            offset, size = rng if rng else (0, file_size)
+            status = 206 if rng else 200
+            is_head = req.handler.command == "HEAD"
+            body = b"" if is_head else self.read_chunks(entry, offset, size)
+            headers = {
+                "Content-Type": entry.attr.mime or "application/octet-stream",
+                "ETag": f'"{etag_of_chunks(entry.chunks)}"' if entry.chunks else '""',
+                "Last-Modified": time.strftime(
+                    "%a, %d %b %Y %H:%M:%S GMT", time.gmtime(entry.attr.mtime)),
+                "Accept-Ranges": "bytes",
+            }
+            if is_head:
+                headers["Content-Length"] = str(size)
+            if status == 206:
+                headers["Content-Range"] = \
+                    f"bytes {offset}-{offset + size - 1}/{file_size}"
+            return Response(raw=body, status=status, headers=headers)
+
+        @r.route("POST", "(/.*)")
+        @r.route("PUT", "(/.*)")
+        def write(req: Request) -> Response:
+            path = req.match.group(1)
+            if path.endswith("/"):
+                self.filer._ensure_parents(path.rstrip("/") or "/")
+                return Response({"name": path}, status=201)
+            mime = req.headers.get("Content-Type", "")
+            if mime in ("application/x-www-form-urlencoded", ""):
+                mime = ""
+            entry = self.put_file(path, req.body, mime=mime,
+                                  collection=req.query.get("collection", ""),
+                                  ttl=req.query.get("ttl", ""))
+            return Response({"name": entry.name, "size": entry.file_size},
+                            status=201)
+
+        @r.route("DELETE", "(/.*)")
+        def delete(req: Request) -> Response:
+            path = req.match.group(1)
+            try:
+                self.filer.delete_entry(
+                    path, recursive=req.query.get("recursive") == "true")
+            except FilerNotFound:
+                raise HttpError(404, f"{path} not found")
+            except NotEmptyError as e:
+                raise HttpError(409, str(e))
+            return Response(None, status=204, raw=b"")
+
+    @staticmethod
+    def _entry_json(e: Entry) -> dict:
+        return {
+            "FullPath": e.full_path,
+            "Mtime": e.attr.mtime,
+            "Crtime": e.attr.crtime,
+            "Mode": e.attr.mode,
+            "Mime": e.attr.mime,
+            "FileSize": e.file_size,
+            "IsDirectory": e.is_directory,
+            "chunks": len(e.chunks),
+        }

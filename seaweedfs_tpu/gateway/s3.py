@@ -1,0 +1,371 @@
+"""S3 API gateway: buckets/objects as filer entries under /buckets/<name>.
+
+Equivalent of weed/s3api/ (s3api_server.go router + object/bucket/multipart
+handlers): path-style requests, ListObjectsV2 with prefix/delimiter/
+continuation, multipart uploads staged under /buckets/.uploads/<id>/ whose
+completed object concatenates the part chunk lists without copying data
+(filer_multipart.go semantics).  Auth is anonymous in this round; the
+identity/signature layer slots into `authenticate`.
+"""
+
+from __future__ import annotations
+
+import secrets
+import time
+import urllib.parse
+import xml.etree.ElementTree as ET
+from typing import Optional
+
+from ..filer.entry import Attr, Entry, FileChunk
+from ..filer.filechunks import etag_of_chunks, total_size
+from ..filer.filer import NotEmptyError
+from ..filer.filer import NotFoundError as FilerNotFound
+from ..filer.server import FilerServer
+from ..utils.httpd import HttpError, Request, Response, Router, serve
+
+BUCKETS_PATH = "/buckets"
+UPLOADS_PATH = "/buckets/.uploads"
+S3_NS = "http://s3.amazonaws.com/doc/2006-03-01/"
+
+
+def _xml(root: ET.Element) -> Response:
+    body = b'<?xml version="1.0" encoding="UTF-8"?>' + ET.tostring(root)
+    return Response(raw=body, headers={"Content-Type": "application/xml"})
+
+
+def _err(status: int, code: str, message: str) -> Response:
+    root = ET.Element("Error")
+    ET.SubElement(root, "Code").text = code
+    ET.SubElement(root, "Message").text = message
+    body = b'<?xml version="1.0" encoding="UTF-8"?>' + ET.tostring(root)
+    return Response(raw=body, status=status,
+                    headers={"Content-Type": "application/xml"})
+
+
+class S3ApiServer:
+    def __init__(self, filer_server: FilerServer, host: str = "127.0.0.1",
+                 port: int = 8333):
+        self.fs = filer_server
+        self.host, self.port = host, port
+        self.router = Router("s3")
+        self._register_routes()
+        self._server = None
+        self.fs.filer._ensure_parents(BUCKETS_PATH)
+
+    @property
+    def url(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def start(self) -> "S3ApiServer":
+        self._server = serve(self.router, self.host, self.port)
+        return self
+
+    def stop(self) -> None:
+        if self._server:
+            self._server.shutdown()
+
+    def authenticate(self, req: Request) -> str:
+        return "anonymous"
+
+    # --- helpers ----------------------------------------------------------
+    def _bucket_path(self, bucket: str) -> str:
+        return f"{BUCKETS_PATH}/{bucket}"
+
+    def _object_path(self, bucket: str, key: str) -> str:
+        return f"{BUCKETS_PATH}/{bucket}/{key}"
+
+    def _require_bucket(self, bucket: str) -> Entry:
+        try:
+            return self.fs.filer.find_entry(self._bucket_path(bucket))
+        except FilerNotFound:
+            raise HttpError(404, "NoSuchBucket")
+
+    # --- routes -----------------------------------------------------------
+    def _register_routes(self) -> None:
+        r = self.router
+
+        @r.route("GET", "/")
+        def list_buckets(req: Request) -> Response:
+            root = ET.Element("ListAllMyBucketsResult", xmlns=S3_NS)
+            owner = ET.SubElement(root, "Owner")
+            ET.SubElement(owner, "ID").text = self.authenticate(req)
+            buckets = ET.SubElement(root, "Buckets")
+            for e in self.fs.filer.list_directory(BUCKETS_PATH):
+                if not e.is_directory or e.name.startswith("."):
+                    continue
+                b = ET.SubElement(buckets, "Bucket")
+                ET.SubElement(b, "Name").text = e.name
+                ET.SubElement(b, "CreationDate").text = _iso(e.attr.crtime)
+            return _xml(root)
+
+        @r.route("PUT", "/([a-z0-9][a-z0-9.-]+)")
+        def put_bucket(req: Request) -> Response:
+            self.fs.filer._ensure_parents(self._bucket_path(req.match.group(1)))
+            return Response(raw=b"", headers={"Location": "/" + req.match.group(1)})
+
+        @r.route("HEAD", "/([a-z0-9][a-z0-9.-]+)")
+        def head_bucket(req: Request) -> Response:
+            self._require_bucket(req.match.group(1))
+            return Response(raw=b"")
+
+        @r.route("DELETE", "/([a-z0-9][a-z0-9.-]+)")
+        def delete_bucket(req: Request) -> Response:
+            bucket = req.match.group(1)
+            self._require_bucket(bucket)
+            try:
+                self.fs.filer.delete_entry(self._bucket_path(bucket),
+                                           recursive=False)
+            except NotEmptyError:
+                return _err(409, "BucketNotEmpty",
+                            "The bucket you tried to delete is not empty")
+            return Response(raw=b"", status=204)
+
+        @r.route("GET", "/([a-z0-9][a-z0-9.-]+)")
+        def list_objects(req: Request) -> Response:
+            bucket = req.match.group(1)
+            self._require_bucket(bucket)
+            prefix = req.query.get("prefix", "")
+            delimiter = req.query.get("delimiter", "")
+            max_keys = int(req.query.get("max-keys", 1000))
+            start_after = req.query.get("start-after", "")
+            token = req.query.get("continuation-token", "")
+            marker = urllib.parse.unquote(token) if token else start_after
+
+            contents, common_prefixes, truncated, next_token = self._walk(
+                bucket, prefix, delimiter, marker, max_keys)
+
+            root = ET.Element("ListBucketResult", xmlns=S3_NS)
+            ET.SubElement(root, "Name").text = bucket
+            ET.SubElement(root, "Prefix").text = prefix
+            ET.SubElement(root, "Delimiter").text = delimiter
+            ET.SubElement(root, "MaxKeys").text = str(max_keys)
+            ET.SubElement(root, "KeyCount").text = str(
+                len(contents) + len(common_prefixes))
+            ET.SubElement(root, "IsTruncated").text = \
+                "true" if truncated else "false"
+            if truncated:
+                ET.SubElement(root, "NextContinuationToken").text = \
+                    urllib.parse.quote(next_token)
+            for key, entry in contents:
+                c = ET.SubElement(root, "Contents")
+                ET.SubElement(c, "Key").text = key
+                ET.SubElement(c, "LastModified").text = _iso(entry.attr.mtime)
+                ET.SubElement(c, "ETag").text = \
+                    f'"{etag_of_chunks(entry.chunks)}"' if entry.chunks else '""'
+                ET.SubElement(c, "Size").text = str(entry.file_size)
+                ET.SubElement(c, "StorageClass").text = "STANDARD"
+            for p in sorted(common_prefixes):
+                cp = ET.SubElement(root, "CommonPrefixes")
+                ET.SubElement(cp, "Prefix").text = p
+            return _xml(root)
+
+        @r.route("POST", "/([a-z0-9][a-z0-9.-]+)/(.+)")
+        def post_object(req: Request) -> Response:
+            bucket, key = req.match.group(1), req.match.group(2)
+            self._require_bucket(bucket)
+            if "uploads" in req.query:
+                return self._initiate_multipart(bucket, key)
+            if "uploadId" in req.query:
+                return self._complete_multipart(req, bucket, key)
+            raise HttpError(400, "unsupported POST")
+
+        @r.route("PUT", "/([a-z0-9][a-z0-9.-]+)/(.+)")
+        def put_object(req: Request) -> Response:
+            bucket, key = req.match.group(1), req.match.group(2)
+            self._require_bucket(bucket)
+            if "partNumber" in req.query and "uploadId" in req.query:
+                return self._upload_part(req, bucket, key)
+            copy_source = req.headers.get("X-Amz-Copy-Source", "")
+            if copy_source:
+                return self._copy_object(req, bucket, key, copy_source)
+            mime = req.headers.get("Content-Type", "")
+            entry = self.fs.put_file(self._object_path(bucket, key), req.body,
+                                     mime=mime)
+            etag = entry.attr.md5
+            return Response(raw=b"", headers={"ETag": f'"{etag}"'})
+
+        @r.route("GET", "/([a-z0-9][a-z0-9.-]+)/(.+)")
+        @r.route("HEAD", "/([a-z0-9][a-z0-9.-]+)/(.+)")
+        def get_object(req: Request) -> Response:
+            bucket, key = req.match.group(1), req.match.group(2)
+            try:
+                entry = self.fs.filer.find_entry(self._object_path(bucket, key))
+            except FilerNotFound:
+                return _err(404, "NoSuchKey", key)
+            if entry.is_directory:
+                return _err(404, "NoSuchKey", key)
+            from ..utils.httpd import parse_range
+
+            file_size = entry.file_size
+            rng = parse_range(req.headers.get("Range", ""), file_size)
+            offset, size = rng if rng else (0, file_size)
+            status = 206 if rng else 200
+            is_head = req.handler.command == "HEAD"
+            body = b"" if is_head else self.fs.read_chunks(entry, offset, size)
+            headers = {
+                "Content-Type": entry.attr.mime or "binary/octet-stream",
+                "ETag": f'"{entry.attr.md5 or etag_of_chunks(entry.chunks)}"',
+                "Last-Modified": time.strftime(
+                    "%a, %d %b %Y %H:%M:%S GMT", time.gmtime(entry.attr.mtime)),
+                "Accept-Ranges": "bytes",
+            }
+            if is_head:
+                headers["Content-Length"] = str(size)
+            if status == 206:
+                headers["Content-Range"] = \
+                    f"bytes {offset}-{offset + size - 1}/{file_size}"
+            return Response(raw=body, status=status, headers=headers)
+
+        @r.route("DELETE", "/([a-z0-9][a-z0-9.-]+)/(.+)")
+        def delete_object(req: Request) -> Response:
+            bucket, key = req.match.group(1), req.match.group(2)
+            if "uploadId" in req.query:
+                return self._abort_multipart(req, bucket, key)
+            try:
+                self.fs.filer.delete_entry(self._object_path(bucket, key))
+            except FilerNotFound:
+                pass  # S3 delete is idempotent
+            return Response(raw=b"", status=204)
+
+    # --- listing ----------------------------------------------------------
+    def _walk(self, bucket: str, prefix: str, delimiter: str, marker: str,
+              max_keys: int) -> tuple[list, set, bool, str]:
+        """Flatten the filer tree into S3 keys in strict key order.
+
+        Children are visited sorted by their KEY representation (dirs sort
+        as "name/"), which makes the emitted stream globally lexicographic —
+        e.g. "docs.txt" ('.'=0x2E) comes before anything under "docs/"
+        (0x2F) — so the continuation marker never skips keys."""
+        base = self._bucket_path(bucket)
+        contents: list[tuple[str, Entry]] = []
+        common: set[str] = set()
+        truncated = False
+        next_token = ""
+
+        def recurse(dir_path: str) -> bool:
+            nonlocal truncated, next_token
+            rel_dir = dir_path[len(base):].lstrip("/")
+            children = self.fs.filer.list_directory(dir_path, limit=100_000)
+            for e in sorted(children,
+                            key=lambda e: e.name + ("/" if e.is_directory else "")):
+                key = f"{rel_dir}/{e.name}" if rel_dir else e.name
+                if e.is_directory:
+                    dir_key = key + "/"
+                    if prefix and not (dir_key.startswith(prefix)
+                                       or prefix.startswith(dir_key)):
+                        continue
+                    # every key under dir_key is < marker: prune the subtree
+                    if marker and dir_key < marker and \
+                            not marker.startswith(dir_key):
+                        continue
+                    if delimiter == "/" and dir_key.startswith(prefix):
+                        rest = dir_key[len(prefix):]
+                        if rest:
+                            common.add(prefix + rest.split("/")[0] + "/")
+                            continue
+                    if not recurse(e.full_path):
+                        return False
+                    continue
+                if prefix and not key.startswith(prefix):
+                    continue
+                if marker and key <= marker:
+                    continue
+                if delimiter and delimiter in key[len(prefix):]:
+                    rest = key[len(prefix):]
+                    common.add(prefix + rest.split(delimiter)[0] + delimiter)
+                    continue
+                if len(contents) >= max_keys:
+                    truncated = True
+                    next_token = contents[-1][0] if contents else key
+                    return False
+                contents.append((key, e))
+            return True
+
+        recurse(base)
+        return contents, common, truncated, next_token
+
+    # --- multipart (filer_multipart.go) -----------------------------------
+    def _initiate_multipart(self, bucket: str, key: str) -> Response:
+        upload_id = secrets.token_hex(16)
+        meta = Entry(full_path=f"{UPLOADS_PATH}/{upload_id}/.meta",
+                     attr=Attr(mime="application/json"),
+                     extended={"bucket": bucket, "key": key})
+        self.fs.filer.create_entry(meta)
+        root = ET.Element("InitiateMultipartUploadResult", xmlns=S3_NS)
+        ET.SubElement(root, "Bucket").text = bucket
+        ET.SubElement(root, "Key").text = key
+        ET.SubElement(root, "UploadId").text = upload_id
+        return _xml(root)
+
+    def _upload_meta(self, req: Request) -> Entry:
+        upload_id = req.query["uploadId"]
+        try:
+            return self.fs.filer.find_entry(f"{UPLOADS_PATH}/{upload_id}/.meta")
+        except FilerNotFound:
+            raise HttpError(404, "NoSuchUpload")
+
+    def _upload_part(self, req: Request, bucket: str, key: str) -> Response:
+        self._upload_meta(req)
+        upload_id = req.query["uploadId"]
+        part = int(req.query["partNumber"])
+        entry = self.fs.put_file(f"{UPLOADS_PATH}/{upload_id}/{part:05d}.part",
+                                 req.body)
+        return Response(raw=b"", headers={"ETag": f'"{entry.attr.md5}"'})
+
+    def _complete_multipart(self, req: Request, bucket: str, key: str) -> Response:
+        meta = self._upload_meta(req)
+        upload_id = req.query["uploadId"]
+        parts = sorted(
+            (e for e in self.fs.filer.list_directory(
+                f"{UPLOADS_PATH}/{upload_id}") if e.name.endswith(".part")),
+            key=lambda e: e.name)
+        # concatenate part chunk lists — no data copying
+        chunks: list[FileChunk] = []
+        offset = 0
+        for p in parts:
+            for c in sorted(p.chunks, key=lambda c: c.offset):
+                chunks.append(FileChunk(
+                    file_id=c.file_id, offset=offset + c.offset, size=c.size,
+                    modified_ts_ns=c.modified_ts_ns, etag=c.etag))
+            offset += total_size(p.chunks)
+        entry = Entry(full_path=self._object_path(bucket, key),
+                      attr=Attr(mime="binary/octet-stream"), chunks=chunks)
+        self.fs.filer.create_entry(entry)
+        # drop the staging dir WITHOUT freeing the chunks we reused
+        for p in parts:
+            p.chunks = []
+            self.fs.filer.update_entry(p)
+        self.fs.filer.delete_entry(f"{UPLOADS_PATH}/{upload_id}", recursive=True)
+        etag = etag_of_chunks(chunks)
+        root = ET.Element("CompleteMultipartUploadResult", xmlns=S3_NS)
+        ET.SubElement(root, "Bucket").text = bucket
+        ET.SubElement(root, "Key").text = key
+        ET.SubElement(root, "ETag").text = f'"{etag}"'
+        return _xml(root)
+
+    def _abort_multipart(self, req: Request, bucket: str, key: str) -> Response:
+        self._upload_meta(req)
+        self.fs.filer.delete_entry(f"{UPLOADS_PATH}/{req.query['uploadId']}",
+                                   recursive=True)
+        return Response(raw=b"", status=204)
+
+    def _copy_object(self, req: Request, bucket: str, key: str,
+                     copy_source: str) -> Response:
+        src = urllib.parse.unquote(copy_source).lstrip("/")
+        src_bucket, _, src_key = src.partition("/")
+        try:
+            src_entry = self.fs.filer.find_entry(
+                self._object_path(src_bucket, src_key))
+        except FilerNotFound:
+            return _err(404, "NoSuchKey", src)
+        data = self.fs.read_chunks(src_entry)
+        entry = self.fs.put_file(self._object_path(bucket, key), data,
+                                 mime=src_entry.attr.mime)
+        root = ET.Element("CopyObjectResult", xmlns=S3_NS)
+        ET.SubElement(root, "ETag").text = f'"{entry.attr.md5}"'
+        ET.SubElement(root, "LastModified").text = _iso(entry.attr.mtime)
+        return _xml(root)
+
+
+def _iso(ts: float) -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%S.000Z", time.gmtime(ts))

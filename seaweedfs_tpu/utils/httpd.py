@@ -32,7 +32,8 @@ class Request:
         self.match = match
         parsed = urllib.parse.urlparse(handler.path)
         self.path = parsed.path
-        self.query = {k: v[0] for k, v in urllib.parse.parse_qs(parsed.query).items()}
+        self.query = {k: v[0] for k, v in urllib.parse.parse_qs(
+            parsed.query, keep_blank_values=True).items()}
         self.headers = handler.headers
         self._body: Optional[bytes] = None
 
@@ -102,7 +103,11 @@ class Router:
                 ctype = "application/json"
             handler.send_response(resp.status)
             handler.send_header("Content-Type", ctype)
-            handler.send_header("Content-Length", str(len(body)))
+            # HEAD responses may declare the real entity size explicitly
+            explicit_len = resp.headers.pop("Content-Length", None)
+            handler.send_header("Content-Length",
+                                explicit_len if explicit_len is not None
+                                else str(len(body)))
             for k, v in resp.headers.items():
                 handler.send_header(k, v)
             handler.end_headers()
@@ -163,6 +168,30 @@ def http_json(method: str, url: str, payload: Optional[dict] = None,
     except (urllib.error.URLError, ConnectionError, TimeoutError, OSError) as e:
         raise HttpError(503, f"{url} unreachable: {e}") from None
     return json.loads(body) if body else {}
+
+
+def parse_range(range_header: str, file_size: int) -> Optional[tuple[int, int]]:
+    """Parse an RFC 7233 single range against file_size -> (offset, size),
+    or None for no/invalid range.  Handles bytes=N-, bytes=N-M, bytes=-N."""
+    if not range_header.startswith("bytes="):
+        return None
+    lo, dash, hi = range_header[6:].partition("-")
+    if not dash:
+        return None
+    try:
+        if lo == "":  # suffix range: last N bytes
+            n = int(hi)
+            offset = max(0, file_size - n)
+            return offset, file_size - offset
+        offset = int(lo)
+        if offset >= file_size:
+            return None
+        if hi == "":
+            return offset, file_size - offset
+        end = min(int(hi), file_size - 1)
+        return offset, end - offset + 1
+    except ValueError:
+        return None
 
 
 class _NoRedirect(urllib.request.HTTPRedirectHandler):

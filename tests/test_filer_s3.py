@@ -1,0 +1,341 @@
+"""Filer + S3 gateway integration tests over a live mini-cluster."""
+
+from __future__ import annotations
+
+import socket
+import time
+import xml.etree.ElementTree as ET
+
+import pytest
+
+from seaweedfs_tpu.filer.entry import FileChunk
+from seaweedfs_tpu.filer.filechunks import read_plan, total_size
+from seaweedfs_tpu.filer.filer_store import MemoryStore, SqliteStore
+from seaweedfs_tpu.filer.server import FilerServer
+from seaweedfs_tpu.gateway.s3 import S3ApiServer
+from seaweedfs_tpu.master.server import MasterServer
+from seaweedfs_tpu.utils.httpd import http_bytes
+from seaweedfs_tpu.volume_server.server import VolumeServer
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.fixture
+def stack(tmp_path):
+    master = MasterServer(port=free_port(), pulse_seconds=0.4).start()
+    vols = []
+    for i in range(2):
+        d = tmp_path / f"vs{i}"
+        d.mkdir()
+        vols.append(VolumeServer([str(d)], master.url, port=free_port(),
+                                 pulse_seconds=0.4).start())
+    deadline = time.time() + 5
+    while time.time() < deadline and len(master.topo.all_nodes()) < 2:
+        time.sleep(0.05)
+    filer = FilerServer(master.url, SqliteStore(str(tmp_path / "filer.db")),
+                        port=free_port(), max_chunk_mb=1).start()
+    s3 = S3ApiServer(filer, port=free_port()).start()
+    yield master, vols, filer, s3
+    s3.stop()
+    filer.stop()
+    for v in vols:
+        v.stop()
+    master.stop()
+
+
+# --- chunk math unit tests -------------------------------------------------
+
+def test_chunk_overlap_resolution():
+    chunks = [
+        FileChunk("1,a", 0, 100, modified_ts_ns=1),
+        FileChunk("1,b", 50, 100, modified_ts_ns=2),  # newer, shadows 50-100
+        FileChunk("1,c", 20, 10, modified_ts_ns=3),  # newest, shadows 20-30
+    ]
+    views = read_plan(chunks, 0, total_size(chunks))
+    covered = [(v.logic_offset, v.logic_offset + v.size, v.file_id) for v in views]
+    assert covered == [(0, 20, "1,a"), (20, 30, "1,c"), (30, 50, "1,a"),
+                       (50, 150, "1,b")]
+    # offsets within chunks account for shadowed prefixes
+    v_b = next(v for v in views if v.file_id == "1,b")
+    assert v_b.offset_in_chunk == 0
+    v_a2 = next(v for v in views if v.logic_offset == 30)
+    assert v_a2.offset_in_chunk == 30
+
+
+def test_chunk_partial_range():
+    chunks = [FileChunk("1,a", 0, 1000, modified_ts_ns=1)]
+    views = read_plan(chunks, 100, 50)
+    assert len(views) == 1
+    assert views[0].offset_in_chunk == 100 and views[0].size == 50
+
+
+# --- filer over HTTP --------------------------------------------------------
+
+def test_filer_put_get_multichunk(stack):
+    _, _, filer, _ = stack
+    payload = bytes(range(256)) * 8192  # 2MB -> 2 chunks at 1MB
+    status, _, _ = http_bytes("PUT", f"http://{filer.url}/docs/big.bin", payload)
+    assert status == 201
+    entry = filer.filer.find_entry("/docs/big.bin")
+    assert len(entry.chunks) == 2
+    status, body, headers = http_bytes("GET", f"http://{filer.url}/docs/big.bin")
+    assert status == 200 and body == payload
+
+    # range read across the chunk boundary
+    status, body, headers = http_bytes(
+        "GET", f"http://{filer.url}/docs/big.bin",
+        headers={"Range": "bytes=1048570-1048589"})
+    assert status == 206
+    assert body == payload[1048570:1048590]
+
+
+def test_filer_listing_and_mkdir(stack):
+    _, _, filer, _ = stack
+    import json
+
+    for name in ("a.txt", "b.txt", "sub/c.txt"):
+        http_bytes("PUT", f"http://{filer.url}/dir1/{name}", b"x")
+    status, body, _ = http_bytes("GET", f"http://{filer.url}/dir1")
+    listing = json.loads(body)
+    names = sorted(e["FullPath"] for e in listing["Entries"])
+    assert names == ["/dir1/a.txt", "/dir1/b.txt", "/dir1/sub"]
+
+
+def test_filer_rename_subtree(stack):
+    _, _, filer, _ = stack
+    http_bytes("PUT", f"http://{filer.url}/old/deep/file.txt", b"content")
+    status, _, _ = http_bytes(
+        "POST", f"http://{filer.url}/api/rename",
+        b'{"from": "/old", "to": "/new"}',
+        headers={"Content-Type": "application/json"})
+    assert status == 200
+    status, body, _ = http_bytes("GET", f"http://{filer.url}/new/deep/file.txt")
+    assert status == 200 and body == b"content"
+    status, _, _ = http_bytes("GET", f"http://{filer.url}/old/deep/file.txt")
+    assert status == 404
+
+
+def test_filer_delete_frees_chunks(stack):
+    master, vols, filer, _ = stack
+    payload = b"z" * 100_000
+    http_bytes("PUT", f"http://{filer.url}/gc/target.bin", payload)
+    entry = filer.filer.find_entry("/gc/target.bin")
+    fid = entry.chunks[0].file_id
+    status, body, _ = http_bytes("GET", f"http://{filer.client.master.lookup(int(fid.split(',')[0]))[0]}/{fid}")
+    assert status == 200
+    http_bytes("DELETE", f"http://{filer.url}/gc/target.bin")
+    filer.filer.flush_gc()
+    url = filer.client.master.lookup(int(fid.split(",")[0]))[0]
+    status, _, _ = http_bytes("GET", f"http://{url}/{fid}")
+    assert status == 404  # chunk physically gone
+
+
+def test_filer_overwrite_frees_old_chunks(stack):
+    _, _, filer, _ = stack
+    http_bytes("PUT", f"http://{filer.url}/ow/f.bin", b"version one")
+    old_fid = filer.filer.find_entry("/ow/f.bin").chunks[0].file_id
+    http_bytes("PUT", f"http://{filer.url}/ow/f.bin", b"version two!")
+    filer.filer.flush_gc()
+    status, body, _ = http_bytes("GET", f"http://{filer.url}/ow/f.bin")
+    assert body == b"version two!"
+    url = filer.client.master.lookup(int(old_fid.split(",")[0]))[0]
+    status, _, _ = http_bytes("GET", f"http://{url}/{old_fid}")
+    assert status == 404
+
+
+def test_filer_rename_into_own_subtree_rejected(stack):
+    _, _, filer, _ = stack
+    http_bytes("PUT", f"http://{filer.url}/tree/file.txt", b"x")
+    status, body, _ = http_bytes(
+        "POST", f"http://{filer.url}/api/rename",
+        b'{"from": "/tree", "to": "/tree/sub"}',
+        headers={"Content-Type": "application/json"})
+    assert status == 500 or status == 400
+    assert b"subtree" in body
+    # tree untouched
+    status, body, _ = http_bytes("GET", f"http://{filer.url}/tree/file.txt")
+    assert status == 200 and body == b"x"
+
+
+def test_filer_suffix_range_and_head(stack):
+    _, _, filer, _ = stack
+    payload = bytes(range(200))
+    http_bytes("PUT", f"http://{filer.url}/r/f.bin", payload)
+    status, body, headers = http_bytes(
+        "GET", f"http://{filer.url}/r/f.bin", headers={"Range": "bytes=-10"})
+    assert status == 206 and body == payload[-10:]
+    assert headers["Content-Range"] == "bytes 190-199/200"
+    status, body, headers = http_bytes(
+        "GET", f"http://{filer.url}/r/f.bin", headers={"Range": "bytes=50-"})
+    assert status == 206 and body == payload[50:]
+    status, body, headers = http_bytes("HEAD", f"http://{filer.url}/r/f.bin")
+    assert status == 200 and body == b""
+    assert headers["Content-Length"] == "200"
+
+
+def test_api_stat_missing_is_404(stack):
+    _, _, filer, _ = stack
+    status, _, _ = http_bytes("GET", f"http://{filer.url}/api/stat/nope")
+    assert status == 404
+
+
+# --- S3 gateway -------------------------------------------------------------
+
+def _s3(stack):
+    return stack[3]
+
+
+def test_s3_bucket_lifecycle(stack):
+    s3 = _s3(stack)
+    assert http_bytes("PUT", f"http://{s3.url}/mybucket", b"")[0] == 200
+    assert http_bytes("HEAD", f"http://{s3.url}/mybucket")[0] == 200
+    status, body, _ = http_bytes("GET", f"http://{s3.url}/")
+    assert b"<Name>mybucket</Name>" in body
+    assert http_bytes("DELETE", f"http://{s3.url}/mybucket")[0] == 204
+    assert http_bytes("HEAD", f"http://{s3.url}/mybucket")[0] == 404
+
+
+def test_s3_object_roundtrip(stack):
+    s3 = _s3(stack)
+    http_bytes("PUT", f"http://{s3.url}/data", b"")
+    status, _, headers = http_bytes(
+        "PUT", f"http://{s3.url}/data/hello.txt", b"hello s3",
+        headers={"Content-Type": "text/plain"})
+    assert status == 200 and headers.get("ETag")
+    status, body, headers = http_bytes("GET", f"http://{s3.url}/data/hello.txt")
+    assert status == 200 and body == b"hello s3"
+    assert headers["Content-Type"] == "text/plain"
+    # range
+    status, body, _ = http_bytes("GET", f"http://{s3.url}/data/hello.txt",
+                                 headers={"Range": "bytes=6-7"})
+    assert status == 206 and body == b"s3"
+    assert http_bytes("DELETE", f"http://{s3.url}/data/hello.txt")[0] == 204
+    status, body, _ = http_bytes("GET", f"http://{s3.url}/data/hello.txt")
+    assert status == 404 and b"NoSuchKey" in body
+
+
+def test_s3_list_objects_v2(stack):
+    s3 = _s3(stack)
+    http_bytes("PUT", f"http://{s3.url}/listing", b"")
+    for key in ("a.txt", "docs/one.txt", "docs/two.txt", "img/pic.png"):
+        http_bytes("PUT", f"http://{s3.url}/listing/{key}", b"content")
+    status, body, _ = http_bytes(
+        "GET", f"http://{s3.url}/listing?delimiter=%2F")
+    root = ET.fromstring(body)
+    ns = {"s3": S3NS} if (S3NS := root.tag.split("}")[0].strip("{")) else {}
+    keys = [e.find("s3:Key", ns).text for e in root.findall("s3:Contents", ns)]
+    prefixes = [e.find("s3:Prefix", ns).text
+                for e in root.findall("s3:CommonPrefixes", ns)]
+    assert keys == ["a.txt"]
+    assert sorted(prefixes) == ["docs/", "img/"]
+    # prefix listing
+    status, body, _ = http_bytes(
+        "GET", f"http://{s3.url}/listing?prefix=docs%2F")
+    root = ET.fromstring(body)
+    keys = [e.find("s3:Key", ns).text for e in root.findall("s3:Contents", ns)]
+    assert keys == ["docs/one.txt", "docs/two.txt"]
+
+
+def test_s3_list_key_order_and_pagination(stack):
+    """'docs.txt' must sort before 'docs/…' keys, and pagination with a
+    continuation token must not skip it."""
+    s3 = _s3(stack)
+    http_bytes("PUT", f"http://{s3.url}/pg", b"")
+    keys = ["docs/a.txt", "docs/b.txt", "docs.txt", "apple.txt"]
+    for k in keys:
+        http_bytes("PUT", f"http://{s3.url}/pg/{k}", b"x")
+    got, token = [], ""
+    for _ in range(10):
+        url = f"http://{s3.url}/pg?max-keys=1"
+        if token:
+            url += f"&continuation-token={token}"
+        _, body, _ = http_bytes("GET", url)
+        root = ET.fromstring(body)
+        ns = {"s3": root.tag.split("}")[0].strip("{")}
+        got += [e.findtext("s3:Key", namespaces=ns)
+                for e in root.findall("s3:Contents", ns)]
+        if root.findtext("s3:IsTruncated", namespaces=ns) != "true":
+            break
+        token = root.findtext("s3:NextContinuationToken", namespaces=ns)
+    assert got == ["apple.txt", "docs.txt", "docs/a.txt", "docs/b.txt"]
+
+
+def test_s3_head_reports_real_length(stack):
+    s3 = _s3(stack)
+    http_bytes("PUT", f"http://{s3.url}/hd", b"")
+    http_bytes("PUT", f"http://{s3.url}/hd/obj.bin", b"q" * 4242)
+    status, body, headers = http_bytes("HEAD", f"http://{s3.url}/hd/obj.bin")
+    assert status == 200 and body == b""
+    assert headers["Content-Length"] == "4242"
+
+
+def test_s3_multipart_upload(stack):
+    s3 = _s3(stack)
+    http_bytes("PUT", f"http://{s3.url}/mp", b"")
+    status, body, _ = http_bytes("POST", f"http://{s3.url}/mp/big.bin?uploads", b"")
+    upload_id = ET.fromstring(body).findtext(
+        "{http://s3.amazonaws.com/doc/2006-03-01/}UploadId")
+    assert upload_id
+    parts = [b"A" * 1_500_000, b"B" * 1_500_000, b"C" * 10]
+    for i, part in enumerate(parts, start=1):
+        status, _, _ = http_bytes(
+            "PUT",
+            f"http://{s3.url}/mp/big.bin?partNumber={i}&uploadId={upload_id}",
+            part)
+        assert status == 200
+    status, body, _ = http_bytes(
+        "POST", f"http://{s3.url}/mp/big.bin?uploadId={upload_id}", b"")
+    assert status == 200 and b"CompleteMultipartUploadResult" in body
+    status, body, _ = http_bytes("GET", f"http://{s3.url}/mp/big.bin")
+    assert status == 200 and body == b"".join(parts)
+
+
+def test_s3_copy_object(stack):
+    s3 = _s3(stack)
+    http_bytes("PUT", f"http://{s3.url}/cp", b"")
+    http_bytes("PUT", f"http://{s3.url}/cp/src.txt", b"copy me")
+    status, body, _ = http_bytes(
+        "PUT", f"http://{s3.url}/cp/dst.txt", b"",
+        headers={"X-Amz-Copy-Source": "/cp/src.txt"})
+    assert status == 200 and b"CopyObjectResult" in body
+    status, body, _ = http_bytes("GET", f"http://{s3.url}/cp/dst.txt")
+    assert body == b"copy me"
+
+
+def test_s3_bucket_not_empty(stack):
+    s3 = _s3(stack)
+    http_bytes("PUT", f"http://{s3.url}/full", b"")
+    http_bytes("PUT", f"http://{s3.url}/full/x.txt", b"x")
+    status, body, _ = http_bytes("DELETE", f"http://{s3.url}/full")
+    assert status == 409 and b"BucketNotEmpty" in body
+
+
+# --- store backends ---------------------------------------------------------
+
+@pytest.mark.parametrize("store_cls", [MemoryStore, "sqlite"])
+def test_store_backend_semantics(tmp_path, store_cls):
+    from seaweedfs_tpu.filer.entry import Attr, Entry
+
+    store = (SqliteStore(str(tmp_path / "s.db")) if store_cls == "sqlite"
+             else store_cls())
+    e = Entry("/d/x.txt", Attr(mime="text/plain"))
+    store.insert_entry(e)
+    store.insert_entry(Entry("/d/y.txt"))
+    store.insert_entry(Entry("/d/sub"))
+    assert store.find_entry("/d/x.txt").attr.mime == "text/plain"
+    listed = [x.name for x in store.list_directory_entries("/d")]
+    assert listed == ["sub", "x.txt", "y.txt"]
+    listed = [x.name for x in store.list_directory_entries("/d", prefix="x")]
+    assert listed == ["x.txt"]
+    listed = [x.name for x in store.list_directory_entries("/d", start_file="sub")]
+    assert listed == ["x.txt", "y.txt"]
+    store.delete_entry("/d/x.txt")
+    assert store.find_entry("/d/x.txt") is None
+    store.kv_put(b"k", b"v")
+    assert store.kv_get(b"k") == b"v"
+    store.kv_delete(b"k")
+    assert store.kv_get(b"k") is None

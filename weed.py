@@ -35,8 +35,26 @@ def cmd_volume(args) -> None:
     _wait_forever()
 
 
+def cmd_filer(args) -> None:
+    from seaweedfs_tpu.filer.filer_store import SqliteStore
+    from seaweedfs_tpu.filer.server import FilerServer
+    from seaweedfs_tpu.gateway.s3 import S3ApiServer
+
+    store = SqliteStore(args.db) if args.db else None
+    f = FilerServer(args.master, store, host=args.ip, port=args.port,
+                    max_chunk_mb=args.maxMB).start()
+    print(f"filer listening on {f.url}")
+    if args.s3:
+        s3 = S3ApiServer(f, host=args.ip, port=args.s3_port).start()
+        print(f"s3 gateway listening on {s3.url}")
+    _wait_forever()
+
+
 def cmd_server(args) -> None:
-    """All-in-one: master + one volume server (command/server.go)."""
+    """All-in-one: master + volume server + filer + s3 (command/server.go)."""
+    from seaweedfs_tpu.filer.filer_store import SqliteStore
+    from seaweedfs_tpu.filer.server import FilerServer
+    from seaweedfs_tpu.gateway.s3 import S3ApiServer
     from seaweedfs_tpu.master.server import MasterServer
     from seaweedfs_tpu.volume_server.server import VolumeServer
 
@@ -44,6 +62,13 @@ def cmd_server(args) -> None:
     vs = VolumeServer(args.dir.split(","), m.url, host=args.ip,
                       port=args.port, ec_engine=args.ec_engine).start()
     print(f"master on {m.url}, volume server on {vs.url}")
+    if args.filer:
+        store = SqliteStore(args.dir.split(",")[0] + "/filer.db")
+        f = FilerServer(m.url, store, host=args.ip, port=args.filerPort).start()
+        print(f"filer on {f.url}")
+        if args.s3:
+            s3 = S3ApiServer(f, host=args.ip, port=args.s3Port).start()
+            print(f"s3 on {s3.url}")
     _wait_forever()
 
 
@@ -166,9 +191,23 @@ def main(argv=None) -> None:
     s.add_argument("-ip", default="127.0.0.1")
     s.add_argument("-masterPort", type=int, default=9333)
     s.add_argument("-port", type=int, default=8080)
+    s.add_argument("-filer", action="store_true")
+    s.add_argument("-filerPort", type=int, default=8888)
+    s.add_argument("-s3", action="store_true")
+    s.add_argument("-s3Port", type=int, default=8333)
     s.add_argument("-ec.engine", dest="ec_engine", default="cpu",
                    choices=["cpu", "tpu"])
     s.set_defaults(fn=cmd_server)
+
+    fl = sub.add_parser("filer")
+    fl.add_argument("-master", default="127.0.0.1:9333")
+    fl.add_argument("-ip", default="127.0.0.1")
+    fl.add_argument("-port", type=int, default=8888)
+    fl.add_argument("-db", default="", help="sqlite store path (default: memory)")
+    fl.add_argument("-maxMB", type=int, default=8)
+    fl.add_argument("-s3", action="store_true")
+    fl.add_argument("-s3.port", dest="s3_port", type=int, default=8333)
+    fl.set_defaults(fn=cmd_filer)
 
     sh = sub.add_parser("shell")
     sh.add_argument("-master", default="127.0.0.1:9333")
