@@ -42,8 +42,10 @@ class Filer:
         self._gc_queue: list[str] = []
         self._gc_event = threading.Event()
         self._stop = threading.Event()
-        # meta log: monotonically increasing ts_ns events
-        self._log: list[dict] = []
+        # meta log ring: recent events in memory; full history in the store
+        import collections
+
+        self._log: collections.deque = collections.deque(maxlen=10_000)
         self._log_lock = threading.Lock()
         self._subscribers: list[Callable[[dict], None]] = []
         if self.store.find_entry("/") is None:
@@ -59,6 +61,13 @@ class Filer:
             if old is not None:
                 if o_excl:
                     raise FilerError(f"{entry.full_path} already exists")
+                if old.is_directory != entry.is_directory:
+                    # a file may not replace a directory or vice versa —
+                    # replacing a dir would orphan its children and leak
+                    # their chunks (reference rejects this too)
+                    kind = "directory" if old.is_directory else "file"
+                    raise FilerError(
+                        f"{entry.full_path}: existing entry is a {kind}")
                 # overwritten file: old chunks become garbage
                 if not old.is_directory:
                     self._collect_chunks(old, keep=entry.chunks)
@@ -94,7 +103,13 @@ class Filer:
         dir_path = _norm(dir_path)
         missing = []
         p = dir_path
-        while p != "/" and self.store.find_entry(p) is None:
+        while p != "/":
+            existing = self.store.find_entry(p)
+            if existing is not None:
+                if not existing.is_directory:
+                    raise FilerError(f"{p}: existing entry is a file, "
+                                     f"cannot be a parent directory")
+                break
             missing.append(p)
             p = p.rsplit("/", 1)[0] or "/"
         for p in reversed(missing):
@@ -228,11 +243,20 @@ class Filer:
             except Exception:
                 pass
 
+    def read_persisted_log(self, since_ns: int = 0) -> list[dict]:
+        """Replay the durable event stream (survives restarts)."""
+        out = []
+        for _, value in self.store.kv_scan(f"{LOG_DIR}/".encode()):
+            event = json.loads(value)
+            if event["ts_ns"] >= since_ns:
+                out.append(event)
+        return sorted(out, key=lambda e: e["ts_ns"])
+
     def subscribe(self, fn: Callable[[dict], None],
                   since_ns: int = 0) -> Callable[[], None]:
-        """SubscribeMetadata: replay history then tail live events."""
+        """SubscribeMetadata: replay persisted history then tail live."""
         with self._log_lock:
-            history = [e for e in self._log if e["ts_ns"] >= since_ns]
+            history = self.read_persisted_log(since_ns)
             self._subscribers.append(fn)
         for e in history:
             fn(e)

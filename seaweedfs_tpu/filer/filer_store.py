@@ -40,6 +40,8 @@ class FilerStore(Protocol):
 
     def kv_delete(self, key: bytes) -> None: ...
 
+    def kv_scan(self, prefix: bytes) -> "Iterator[tuple[bytes, bytes]]": ...
+
 
 class MemoryStore:
     """Dict-backed store for tests and ephemeral filers."""
@@ -99,6 +101,11 @@ class MemoryStore:
     def kv_delete(self, key: bytes) -> None:
         self._kv.pop(key, None)
 
+    def kv_scan(self, prefix: bytes):
+        for k in sorted(self._kv):
+            if k.startswith(prefix):
+                yield k, self._kv[k]
+
 
 class SqliteStore:
     """Durable embedded store (abstract_sql semantics: one row per entry,
@@ -155,11 +162,16 @@ class SqliteStore:
         con.execute("DELETE FROM entries WHERE dir=? AND name=?", (d, name))
         con.commit()
 
+    @staticmethod
+    def _like_escape(s: str) -> str:
+        return s.replace("\\", "\\\\").replace("%", r"\%").replace("_", r"\_")
+
     def delete_folder_children(self, path: str) -> None:
         base = path.rstrip("/")
         con = self._con()
-        con.execute("DELETE FROM entries WHERE dir=? OR dir LIKE ?",
-                    (base or "/", base + "/%"))
+        con.execute(
+            r"DELETE FROM entries WHERE dir=? OR dir LIKE ? ESCAPE '\'",
+            (base or "/", self._like_escape(base) + "/%"))
         con.commit()
 
     def list_directory_entries(self, dir_path: str, start_file: str = "",
@@ -172,8 +184,8 @@ class SqliteStore:
             q += f" AND name {'>=' if include_start else '>'} ?"
             args.append(start_file)
         if prefix:
-            q += " AND name LIKE ?"
-            args.append(prefix.replace("%", r"\%") + "%")
+            q += r" AND name LIKE ? ESCAPE '\'"
+            args.append(self._like_escape(prefix) + "%")
         q += " ORDER BY name LIMIT ?"
         args.append(limit)
         for (meta,) in self._con().execute(q, args):
@@ -192,3 +204,10 @@ class SqliteStore:
         con = self._con()
         con.execute("DELETE FROM kv WHERE k=?", (key,))
         con.commit()
+
+    def kv_scan(self, prefix: bytes):
+        hi = prefix + b"\xff" * 8
+        for k, v in self._con().execute(
+                "SELECT k, v FROM kv WHERE k >= ? AND k < ? ORDER BY k",
+                (prefix, hi)):
+            yield bytes(k), bytes(v)

@@ -55,11 +55,25 @@ class FilerServer:
 
     # --- chunk IO ---------------------------------------------------------
     def _delete_chunks(self, fids: list[str]) -> None:
+        """Batch chunk GC: one /admin/batch_delete per volume server
+        (operation/delete_content.go DeleteFiles semantics)."""
+        from ..utils.httpd import http_json
+
+        by_server: dict[str, list[str]] = {}
         for fid in fids:
             try:
-                self.client.delete(fid)
+                vid = int(fid.split(",")[0])
+                urls = self.client.master.lookup(vid)
+                if urls:
+                    by_server.setdefault(urls[0], []).append(fid)
             except Exception:
                 pass
+        for url, batch in by_server.items():
+            try:
+                http_json("POST", f"http://{url}/admin/batch_delete",
+                          {"fids": batch})
+            except Exception:
+                pass  # best-effort; orphans are re-collectable
 
     def write_chunks(self, data: bytes, collection: str = "",
                      ttl: str = "") -> list[FileChunk]:
@@ -155,10 +169,13 @@ class FilerServer:
                     "Entries": [self._entry_json(e) for e in listing],
                     "ShouldDisplayLoadMore": len(listing) >= limit,
                 })
-            from ..utils.httpd import parse_range
+            from ..utils.httpd import UNSATISFIABLE_RANGE, parse_range
 
             file_size = entry.file_size
             rng = parse_range(req.headers.get("Range", ""), file_size)
+            if rng == UNSATISFIABLE_RANGE:
+                return Response(raw=b"", status=416,
+                                headers={"Content-Range": f"bytes */{file_size}"})
             offset, size = rng if rng else (0, file_size)
             status = 206 if rng else 200
             is_head = req.handler.command == "HEAD"

@@ -194,10 +194,13 @@ class S3ApiServer:
                 return _err(404, "NoSuchKey", key)
             if entry.is_directory:
                 return _err(404, "NoSuchKey", key)
-            from ..utils.httpd import parse_range
+            from ..utils.httpd import UNSATISFIABLE_RANGE, parse_range
 
             file_size = entry.file_size
             rng = parse_range(req.headers.get("Range", ""), file_size)
+            if rng == UNSATISFIABLE_RANGE:
+                return Response(raw=b"", status=416,
+                                headers={"Content-Range": f"bytes */{file_size}"})
             offset, size = rng if rng else (0, file_size)
             status = 206 if rng else 200
             is_head = req.handler.command == "HEAD"

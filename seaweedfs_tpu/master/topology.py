@@ -392,6 +392,7 @@ class Topology:
                         "replication": key[1],
                         "ttl": key[2],
                         "writables": sorted(layout.writables),
+                        "volumes": sorted(layout.vid_to_nodes),
                     }
                     for key, layout in self.layouts.items()
                 ],

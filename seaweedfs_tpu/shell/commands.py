@@ -27,8 +27,9 @@ def command(name: str):
 
 
 class CommandEnv:
-    def __init__(self, master_url: str):
+    def __init__(self, master_url: str, filer_url: str = ""):
         self.master_url = master_url
+        self.filer_url = filer_url
         self.master = MasterClient(master_url)
         self.admin_token: Optional[int] = None
 
@@ -65,18 +66,26 @@ class CommandEnv:
                 "lock is needed: run `lock` before mutating commands")
 
 
+# flags that never take a value (so `fs.rm -r /path` keeps /path positional)
+BOOL_FLAGS = {"r", "rf", "l", "f", "force", "writable", "readonly", "apply",
+              "recursive", "v"}
+
+
 def parse_flags(args: list[str]) -> dict[str, str]:
-    """-volumeId 1 -collection x  plus bare -force flags."""
+    """-volumeId 1 -collection x  plus boolean -force/-r flags; the first
+    bare token lands under the '' key (the positional path argument)."""
     out: dict[str, str] = {}
     i = 0
     while i < len(args):
         a = args[i]
         if a.startswith("-"):
-            if i + 1 < len(args) and not args[i + 1].startswith("-"):
-                out[a.lstrip("-")] = args[i + 1]
+            name = a.lstrip("-")
+            if (name not in BOOL_FLAGS and i + 1 < len(args)
+                    and not args[i + 1].startswith("-")):
+                out[name] = args[i + 1]
                 i += 2
             else:
-                out[a.lstrip("-")] = "true"
+                out[name] = "true"
                 i += 1
         else:
             out.setdefault("", a)
@@ -99,8 +108,8 @@ def run_command(env: CommandEnv, line: str) -> object:
     return fn(env, parse_flags(args))
 
 
-def repl(master_url: str) -> None:
-    env = CommandEnv(master_url)
+def repl(master_url: str, filer_url: str = "") -> None:
+    env = CommandEnv(master_url, filer_url)
     print(f"connected to master {master_url}; `help` lists commands")
     while True:
         try:

@@ -1,0 +1,116 @@
+"""fs.* shell commands against a filer (weed/shell/command_fs_*.go)."""
+
+from __future__ import annotations
+
+import json
+
+from ..utils.httpd import HttpError, http_bytes, http_json
+from .commands import CommandEnv, command
+
+
+def _filer(env: CommandEnv) -> str:
+    if not getattr(env, "filer_url", ""):
+        raise RuntimeError("no filer configured: start shell with -filer host:port")
+    return env.filer_url
+
+
+def _listing(env: CommandEnv, path: str) -> list[dict]:
+    status, body, _ = http_bytes("GET", f"http://{_filer(env)}{path}")
+    if status != 200:
+        raise HttpError(status, body.decode(errors="replace"))
+    data = json.loads(body)
+    if "Entries" not in data:
+        raise NotADirectoryError(path)
+    return data["Entries"]
+
+
+@command("fs.ls")
+def cmd_fs_ls(env: CommandEnv, flags: dict) -> str:
+    """fs.ls [-l] /dir  # list a filer directory"""
+    path = flags.get("", "/")
+    entries = _listing(env, path)
+    if "l" in flags:
+        return "\n".join(
+            f"{'d' if e['IsDirectory'] else '-'} {e['FileSize']:>12} "
+            f"{e['FullPath']}" for e in entries)
+    return "\n".join(e["FullPath"].rsplit("/", 1)[-1]
+                     + ("/" if e["IsDirectory"] else "") for e in entries)
+
+
+@command("fs.cat")
+def cmd_fs_cat(env: CommandEnv, flags: dict) -> str:
+    """fs.cat /path/to/file  # print file content"""
+    path = flags.get("", "")
+    status, body, _ = http_bytes("GET", f"http://{_filer(env)}{path}")
+    if status != 200:
+        raise HttpError(status, body.decode(errors="replace"))
+    return body.decode(errors="replace")
+
+
+@command("fs.du")
+def cmd_fs_du(env: CommandEnv, flags: dict) -> str:
+    """fs.du /dir  # disk usage of a subtree"""
+    path = flags.get("", "/")
+
+    def walk(p: str) -> tuple[int, int]:
+        size, files = 0, 0
+        for e in _listing(env, p):
+            if e["IsDirectory"]:
+                s, f = walk(e["FullPath"])
+                size, files = size + s, files + f
+            else:
+                size += e["FileSize"]
+                files += 1
+        return size, files
+
+    size, files = walk(path)
+    return f"{size} bytes\t{files} files\t{path}"
+
+
+@command("fs.tree")
+def cmd_fs_tree(env: CommandEnv, flags: dict) -> str:
+    """fs.tree /dir  # recursive listing"""
+    path = flags.get("", "/")
+    lines: list[str] = []
+
+    def walk(p: str, depth: int) -> None:
+        for e in _listing(env, p):
+            name = e["FullPath"].rsplit("/", 1)[-1]
+            lines.append("  " * depth + name + ("/" if e["IsDirectory"] else ""))
+            if e["IsDirectory"]:
+                walk(e["FullPath"], depth + 1)
+
+    walk(path, 0)
+    return "\n".join(lines) or "(empty)"
+
+
+@command("fs.mkdir")
+def cmd_fs_mkdir(env: CommandEnv, flags: dict) -> str:
+    """fs.mkdir /dir"""
+    path = flags.get("", "")
+    http_json("POST", f"http://{_filer(env)}/api/mkdir", {"path": path})
+    return path
+
+
+@command("fs.rm")
+def cmd_fs_rm(env: CommandEnv, flags: dict) -> str:
+    """fs.rm [-r] /path"""
+    path = flags.get("", "")
+    recursive = "true" if "r" in flags or "rf" in flags else "false"
+    status, body, _ = http_bytes(
+        "DELETE", f"http://{_filer(env)}{path}?recursive={recursive}")
+    if status not in (204, 200):
+        raise HttpError(status, body.decode(errors="replace"))
+    return f"removed {path}"
+
+
+@command("fs.mv")
+def cmd_fs_mv(env: CommandEnv, flags: dict) -> str:
+    """fs.mv /src /dst"""
+    src = flags.get("", "")
+    dst = flags.get("to", "")
+    if not dst:
+        raise RuntimeError("usage: fs.mv /src -to /dst")
+    http_json("POST", f"http://{_filer(env)}/api/rename",
+              {"from": src, "to": dst})
+    return f"moved {src} -> {dst}"

@@ -1,0 +1,253 @@
+"""Volume maintenance commands: copy/move/delete/balance/fix.replication/fsck.
+
+Equivalent of weed/shell/command_volume_copy.go, _move.go, _delete.go,
+_balance.go, _fix_replication.go, _fsck.go, command_collection_delete.go.
+"""
+
+from __future__ import annotations
+
+from ..storage.super_block import ReplicaPlacement
+from .commands import CommandEnv, command
+
+
+def _nodes_with_volumes(env: CommandEnv) -> list[dict]:
+    topo = env.topology()
+    return [n for dc in topo["DataCenters"] for rack in dc["Racks"]
+            for n in rack["DataNodes"]]
+
+
+def _volume_locations(env: CommandEnv, vid: int) -> list[str]:
+    env.master.invalidate(vid)
+    return env.master.lookup(vid)
+
+
+def _collection_of(env: CommandEnv, vid: int) -> str:
+    for layout in env.topology().get("Layouts", []):
+        if vid in layout.get("volumes", []):
+            return layout.get("collection", "")
+    return ""
+
+
+@command("volume.copy")
+def cmd_volume_copy(env: CommandEnv, flags: dict) -> str:
+    """volume.copy -volumeId <id> -source <host:port> -target <host:port>
+    # copy a volume replica between servers"""
+    env.confirm_is_locked()
+    vid = int(flags["volumeId"])
+    collection = flags.get("collection")
+    if collection is None:
+        collection = _collection_of(env, vid)
+    env.volume_post(flags["target"], "/admin/volume_copy", {
+        "volume_id": vid, "collection": collection,
+        "source_data_node": flags["source"]})
+    env.volume_post(flags["target"], "/admin/heartbeat_now", {}, timeout=30)
+    return f"copied volume {vid} from {flags['source']} to {flags['target']}"
+
+
+@command("volume.move")
+def cmd_volume_move(env: CommandEnv, flags: dict) -> str:
+    """volume.move -volumeId <id> -source <host:port> -target <host:port>
+    # copy then delete from the source (crash-safe ordering)"""
+    env.confirm_is_locked()
+    vid = int(flags["volumeId"])
+    cmd_volume_copy(env, flags)
+    env.volume_post(flags["source"], "/admin/delete_volume", {"volume_id": vid})
+    env.volume_post(flags["source"], "/admin/heartbeat_now", {}, timeout=30)
+    env.master.invalidate(vid)
+    return f"moved volume {vid} from {flags['source']} to {flags['target']}"
+
+
+@command("volume.delete")
+def cmd_volume_delete(env: CommandEnv, flags: dict) -> str:
+    """volume.delete -volumeId <id> [-node <host:port>]
+    # delete a volume replica (or all replicas if -node omitted)"""
+    env.confirm_is_locked()
+    vid = int(flags["volumeId"])
+    targets = [flags["node"]] if "node" in flags else _volume_locations(env, vid)
+    for url in targets:
+        env.volume_post(url, "/admin/delete_volume", {"volume_id": vid})
+        env.volume_post(url, "/admin/heartbeat_now", {}, timeout=30)
+    env.master.invalidate(vid)
+    return f"deleted volume {vid} on {targets}"
+
+
+@command("volume.mount")
+def cmd_volume_mount(env: CommandEnv, flags: dict) -> str:
+    """volume.mount -volumeId <id> -node <host:port>"""
+    env.confirm_is_locked()
+    env.volume_post(flags["node"], "/admin/mount",
+                    {"volume_id": int(flags["volumeId"])})
+    env.volume_post(flags["node"], "/admin/heartbeat_now", {}, timeout=30)
+    return "mounted"
+
+
+@command("volume.unmount")
+def cmd_volume_unmount(env: CommandEnv, flags: dict) -> str:
+    """volume.unmount -volumeId <id> -node <host:port>"""
+    env.confirm_is_locked()
+    env.volume_post(flags["node"], "/admin/unmount",
+                    {"volume_id": int(flags["volumeId"])})
+    env.volume_post(flags["node"], "/admin/heartbeat_now", {}, timeout=30)
+    return "unmounted"
+
+
+@command("volume.mark")
+def cmd_volume_mark(env: CommandEnv, flags: dict) -> str:
+    """volume.mark -volumeId <id> -node <host:port> [-writable|-readonly]"""
+    env.confirm_is_locked()
+    readonly = "writable" not in flags
+    env.volume_post(flags["node"], "/admin/readonly",
+                    {"volume_id": int(flags["volumeId"]),
+                     "readonly": readonly})
+    return f"marked {'readonly' if readonly else 'writable'}"
+
+
+@command("volume.balance")
+def cmd_volume_balance(env: CommandEnv, flags: dict) -> str:
+    """volume.balance [-force]
+    # move volumes from overloaded to underloaded servers
+    (command_volume_balance.go simplified: even out volume counts)"""
+    env.confirm_is_locked()
+    nodes = _nodes_with_volumes(env)
+    if not nodes:
+        return "no servers"
+    counts = {n["Url"]: len(n["VolumeIds"]) for n in nodes}
+    vol_map = {n["Url"]: list(n["VolumeIds"]) for n in nodes}
+    avg = sum(counts.values()) / len(counts)
+    moves = []
+    for src in sorted(counts, key=counts.get, reverse=True):
+        while counts[src] > avg + 0.5 and vol_map[src]:
+            dst = min(counts, key=counts.get)
+            if counts[dst] >= avg:
+                break
+            # pick a volume the destination doesn't already hold
+            candidates = [v for v in vol_map[src]
+                          if v not in vol_map.get(dst, [])]
+            if not candidates:
+                break
+            vid = candidates[0]
+            cmd_volume_move(env, {"volumeId": str(vid), "source": src,
+                                  "target": dst})
+            vol_map[src].remove(vid)
+            vol_map[dst].append(vid)
+            counts[src] -= 1
+            counts[dst] += 1
+            moves.append(f"{vid}: {src} -> {dst}")
+    return "\n".join(moves) or "already balanced"
+
+
+@command("volume.fix.replication")
+def cmd_fix_replication(env: CommandEnv, flags: dict) -> str:
+    """volume.fix.replication
+    # re-replicate under-replicated volumes to meet their placement"""
+    env.confirm_is_locked()
+    topo = env.topology()
+    nodes = _nodes_with_volumes(env)
+    actions = []
+    # volume -> holders
+    holders: dict[int, list[str]] = {}
+    for n in nodes:
+        for vid in n["VolumeIds"]:
+            holders.setdefault(vid, []).append(n["Url"])
+    for layout in topo.get("Layouts", []):
+        rp = ReplicaPlacement.parse(layout["replication"] or "000")
+        want = rp.copy_count
+        for vid in layout.get("volumes", []):
+            have = holders.get(vid, [])
+            if 0 < len(have) < want:
+                targets = [n["Url"] for n in nodes
+                           if n["Url"] not in have and n["Free"] > 0]
+                for target in targets[: want - len(have)]:
+                    cmd_volume_copy(env, {
+                        "volumeId": str(vid), "source": have[0],
+                        "target": target,
+                        "collection": layout.get("collection", "")})
+                    actions.append(f"replicated {vid} -> {target}")
+    return "\n".join(actions) or "all volumes sufficiently replicated"
+
+
+@command("volume.fsck")
+def cmd_volume_fsck(env: CommandEnv, flags: dict) -> str:
+    """volume.fsck [-volumeId <id>]
+    # scan volumes, verify needle CRCs against the index"""
+    nodes = _nodes_with_volumes(env)
+    lines = []
+    for n in nodes:
+        for vid in n["VolumeIds"]:
+            if "volumeId" in flags and vid != int(flags["volumeId"]):
+                continue
+            r = env.volume_post(n["Url"], "/admin/volume_check",
+                                {"volume_id": vid})
+            status = "OK" if r["crc_errors"] == 0 else "CORRUPT"
+            lines.append(f"volume {vid} @ {n['Url']}: indexed={r['indexed']} "
+                         f"live={r['scanned_live']} crc_errors={r['crc_errors']} "
+                         f"{status}")
+    return "\n".join(lines) or "no volumes"
+
+
+@command("collection.delete")
+def cmd_collection_delete(env: CommandEnv, flags: dict) -> str:
+    """collection.delete -collection <name>
+    # delete every volume of a collection"""
+    env.confirm_is_locked()
+    name = flags["collection"]
+    topo = env.topology()
+    deleted = []
+    for layout in topo.get("Layouts", []):
+        if layout["collection"] != name:
+            continue
+        for vid in layout.get("writables", []):
+            for url in _volume_locations(env, vid):
+                env.volume_post(url, "/admin/delete_volume", {"volume_id": vid})
+            deleted.append(vid)
+    for n in _nodes_with_volumes(env):
+        env.volume_post(n["Url"], "/admin/heartbeat_now", {}, timeout=30)
+    return f"deleted collection {name}: volumes {deleted}"
+
+
+@command("volume.server.evacuate")
+def cmd_evacuate(env: CommandEnv, flags: dict) -> str:
+    """volume.server.evacuate -node <host:port>
+    # move every volume + ec shard off a server before decommissioning"""
+    env.confirm_is_locked()
+    node = flags["node"]
+    nodes = _nodes_with_volumes(env)
+    me = next((n for n in nodes if n["Url"] == node), None)
+    if me is None:
+        raise RuntimeError(f"{node} not found in topology")
+    others = [n for n in nodes if n["Url"] != node and n["Free"] > 0]
+    if not others:
+        raise RuntimeError("no destination servers with free slots")
+    # urls that already hold each volume (skip as destinations)
+    holders: dict[int, set[str]] = {}
+    for n in nodes:
+        for vid in n["VolumeIds"]:
+            holders.setdefault(vid, set()).add(n["Url"])
+    moves = []
+    for i, vid in enumerate(list(me["VolumeIds"])):
+        candidates = [n for n in others
+                      if n["Url"] not in holders.get(vid, set())]
+        if not candidates:
+            moves.append(f"volume {vid}: no replica-free destination, skipped")
+            continue
+        dst = candidates[i % len(candidates)]["Url"]
+        cmd_volume_move(env, {"volumeId": str(vid), "source": node,
+                              "target": dst})
+        moves.append(f"volume {vid} -> {dst}")
+    # ec shards
+    info = env.topology().get("EcVolumes", {})
+    for vid_str, shards in info.items():
+        for sid, urls in shards.items():
+            if node not in urls:
+                continue
+            dst = others[int(sid) % len(others)]["Url"]
+            env.volume_post(dst, "/admin/ec/copy", {
+                "volume_id": int(vid_str), "shard_ids": [int(sid)],
+                "source_data_node": node})
+            env.volume_post(dst, "/admin/ec/mount", {"volume_id": int(vid_str)})
+            env.volume_post(node, "/admin/ec/delete",
+                            {"volume_id": int(vid_str), "shard_ids": [int(sid)]})
+            moves.append(f"ec {vid_str}.{sid} -> {dst}")
+    for n in nodes:
+        env.volume_post(n["Url"], "/admin/heartbeat_now", {}, timeout=30)
+    return "\n".join(moves) or "nothing to evacuate"

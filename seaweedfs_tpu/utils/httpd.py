@@ -170,9 +170,14 @@ def http_json(method: str, url: str, payload: Optional[dict] = None,
     return json.loads(body) if body else {}
 
 
+UNSATISFIABLE_RANGE = (-1, 0)
+
+
 def parse_range(range_header: str, file_size: int) -> Optional[tuple[int, int]]:
-    """Parse an RFC 7233 single range against file_size -> (offset, size),
-    or None for no/invalid range.  Handles bytes=N-, bytes=N-M, bytes=-N."""
+    """Parse an RFC 7233 single range against file_size -> (offset, size);
+    None for absent/invalid headers (serve the whole body), or
+    UNSATISFIABLE_RANGE when the range starts past EOF (serve 416).
+    Handles bytes=N-, bytes=N-M, bytes=-N."""
     if not range_header.startswith("bytes="):
         return None
     lo, dash, hi = range_header[6:].partition("-")
@@ -185,7 +190,7 @@ def parse_range(range_header: str, file_size: int) -> Optional[tuple[int, int]]:
             return offset, file_size - offset
         offset = int(lo)
         if offset >= file_size:
-            return None
+            return UNSATISFIABLE_RANGE
         if hi == "":
             return offset, file_size - offset
         end = min(int(hi), file_size - 1)

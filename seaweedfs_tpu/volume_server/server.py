@@ -347,6 +347,107 @@ class VolumeServer:
             self.store.get_volume(vid).cleanup_compact()
             return Response({})
 
+        # --- admin: volume copy/move (volume_grpc_copy.go) -------------
+        @r.route("GET", "/admin/volume_download")
+        def volume_download(req: Request) -> Response:
+            vid = int(req.query["volume_id"])
+            ext = req.query["ext"]
+            if ext not in (".dat", ".idx", ".vif"):
+                raise HttpError(400, f"bad ext {ext}")
+            v = self.store.get_volume(vid)
+            path = v.file_prefix + ext
+            if not os.path.exists(path):
+                raise HttpError(404, f"{path} not found")
+            with self.store.volume_locks[vid]:
+                with open(path, "rb") as f:
+                    return Response(raw=f.read())
+
+        @r.route("POST", "/admin/volume_copy")
+        def volume_copy(req: Request) -> Response:
+            """VolumeCopy: pull .dat/.idx from the source server, then mount.
+            The source is marked readonly for a consistent snapshot."""
+            b = req.json()
+            vid = int(b["volume_id"])
+            collection = b.get("collection", "")
+            source = b["source_data_node"]
+            if vid in self.store.volumes:
+                raise HttpError(409, f"volume {vid} already here")
+            # remember the source's current readonly state and restore it —
+            # an operator-fenced volume must stay fenced after the copy
+            src_status = http_json("GET", f"http://{source}/status")
+            was_readonly = any(v["id"] == vid and v["read_only"]
+                               for v in src_status.get("Volumes", []))
+            http_json("POST", f"http://{source}/admin/readonly",
+                      {"volume_id": vid, "readonly": True})
+            try:
+                base = volume_file_prefix(self.store.locations[0].directory,
+                                          collection, vid)
+                for ext in (".dat", ".idx"):
+                    status, body, _ = http_bytes(
+                        "GET", f"http://{source}/admin/volume_download"
+                               f"?volume_id={vid}&ext={ext}", timeout=3600)
+                    if status != 200:
+                        raise HttpError(500, f"download {ext}: {status}")
+                    with open(base + ext, "wb") as f:
+                        f.write(body)
+                self.store._open_volume(
+                    os.path.dirname(base), collection, vid)
+            finally:
+                http_json("POST", f"http://{source}/admin/readonly",
+                          {"volume_id": vid, "readonly": was_readonly})
+            return Response({})
+
+        @r.route("POST", "/admin/batch_delete")
+        def batch_delete(req: Request) -> Response:
+            """POST /delete multi-fid (volume_grpc_batch_delete.go), with
+            replica fan-out unless the request is itself a replicate."""
+            body = req.json()
+            is_replicate = bool(body.get("replicate"))
+            results = []
+            fanned: dict[str, list[str]] = {}
+            for fid_str in body.get("fids", []):
+                try:
+                    fid = FileId.parse(fid_str)
+                    if fid.volume_id in self.store.ec_volumes:
+                        self.store.ec_delete_needle(fid.volume_id, fid.key)
+                        size = 0
+                    else:
+                        size = self.store.delete_needle(
+                            fid.volume_id,
+                            Needle(cookie=fid.cookie, id=fid.key))
+                    results.append({"fid": fid_str, "status": 202, "size": size})
+                    if not is_replicate:
+                        for url in self._lookup_replicas(fid.volume_id):
+                            if url != self.url:
+                                fanned.setdefault(url, []).append(fid_str)
+                except Exception as e:
+                    results.append({"fid": fid_str, "status": 404,
+                                    "error": str(e)})
+            for url, fids in fanned.items():
+                http_json("POST", f"http://{url}/admin/batch_delete",
+                          {"fids": fids, "replicate": True})
+            return Response({"results": results})
+
+        @r.route("POST", "/admin/volume_check")
+        def volume_check(req: Request) -> Response:
+            """fsck backend: scan the volume, verify needle CRCs against the
+            index (volume.fsck / volume.check.disk analog)."""
+            vid = int(req.json()["volume_id"])
+            v = self.store.get_volume(vid)
+            indexed = len(v.nm)
+            scanned, crc_errors = 0, 0
+            with self.store.volume_locks[vid]:
+                for nv in list(v.nm):
+                    scanned += 1
+                    try:
+                        # full record parse verifies the STORED crc against
+                        # the data bytes (needle_read_write.go:238-244)
+                        v._read_needle_at(nv.offset, nv.size)
+                    except Exception:
+                        crc_errors += 1
+            return Response({"indexed": indexed, "scanned_live": scanned,
+                             "crc_errors": crc_errors})
+
         # --- admin: EC (volume_grpc_erasure_coding.go) ----------------
         @r.route("POST", "/admin/ec/generate")
         def ec_generate(req: Request) -> Response:

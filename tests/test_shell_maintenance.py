@@ -1,0 +1,164 @@
+"""Maintenance-plane tests: volume copy/move/balance/fix.replication/fsck,
+collection.delete, evacuate, fs.* commands."""
+
+from __future__ import annotations
+
+import socket
+import time
+
+import pytest
+
+from seaweedfs_tpu.client.operation import WeedClient
+from seaweedfs_tpu.filer.server import FilerServer
+from seaweedfs_tpu.master.server import MasterServer
+from seaweedfs_tpu.shell import CommandEnv, run_command
+from seaweedfs_tpu.utils.httpd import http_bytes
+from seaweedfs_tpu.volume_server.server import VolumeServer
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    master = MasterServer(port=free_port(), pulse_seconds=0.4).start()
+    servers = []
+    for i in range(3):
+        d = tmp_path / f"vs{i}"
+        d.mkdir()
+        servers.append(VolumeServer([str(d)], master.url, port=free_port(),
+                                    max_volume_count=20,
+                                    pulse_seconds=0.4).start())
+    deadline = time.time() + 5
+    while time.time() < deadline and len(master.topo.all_nodes()) < 3:
+        time.sleep(0.05)
+    filer = FilerServer(master.url, port=free_port()).start()
+    env = CommandEnv(master.url, filer.url)
+    env.lock()
+    yield master, servers, filer, env
+    env.unlock()
+    filer.stop()
+    for vs in servers:
+        vs.stop()
+    master.stop()
+
+
+def sync(servers):
+    for vs in servers:
+        vs.heartbeat_now()
+
+
+def test_volume_copy_move_delete(cluster):
+    master, servers, _, env = cluster
+    client = WeedClient(master.url)
+    fid = client.upload(b"movable data")
+    vid = int(fid.split(",")[0])
+    sync(servers)
+    src = next(vs.url for vs in servers if vid in vs.store.volumes)
+    dst = next(vs.url for vs in servers if vid not in vs.store.volumes)
+
+    out = run_command(env, f"volume.copy -volumeId {vid} -source {src} -target {dst}")
+    assert "copied" in out
+    dst_vs = next(vs for vs in servers if vs.url == dst)
+    assert vid in dst_vs.store.volumes
+    # both replicas serve the object
+    status, body, _ = http_bytes("GET", f"http://{dst}/{fid}")
+    assert status == 200 and body == b"movable data"
+
+    out = run_command(env, f"volume.delete -volumeId {vid} -node {dst}")
+    assert "deleted" in out
+    assert vid not in dst_vs.store.volumes
+
+    out = run_command(env, f"volume.move -volumeId {vid} -source {src} -target {dst}")
+    assert "moved" in out
+    sync(servers)
+    assert vid in dst_vs.store.volumes
+    assert client.download(fid) == b"movable data"
+
+
+def test_volume_fsck_detects_corruption(cluster):
+    master, servers, _, env = cluster
+    client = WeedClient(master.url)
+    fid = client.upload(b"pristine bytes here")
+    vid = int(fid.split(",")[0])
+    sync(servers)
+    out = run_command(env, f"volume.fsck -volumeId {vid}")
+    assert "OK" in out and "crc_errors=0" in out
+    # corrupt a byte on disk
+    vs = next(vs for vs in servers if vid in vs.store.volumes)
+    v = vs.store.volumes[vid]
+    import os
+
+    nv = next(iter(v.nm))
+    with open(v.dat_path, "r+b") as f:
+        f.seek(nv.offset + 20)
+        b = f.read(1)
+        f.seek(nv.offset + 20)
+        f.write(bytes([b[0] ^ 0xFF]))
+    out = run_command(env, f"volume.fsck -volumeId {vid}")
+    assert "CORRUPT" in out
+
+
+def test_fix_replication(cluster):
+    master, servers, _, env = cluster
+    client = WeedClient(master.url)
+    fid = client.upload(b"needs two copies", replication="001")
+    vid = int(fid.split(",")[0])
+    time.sleep(0.2)
+    sync(servers)
+    holders = [vs for vs in servers if vid in vs.store.volumes]
+    assert len(holders) == 2
+    # lose one replica
+    holders[1].store.delete_volume(vid)
+    sync(servers)
+    out = run_command(env, "volume.fix.replication")
+    assert f"replicated {vid}" in out
+    sync(servers)
+    assert sum(1 for vs in servers if vid in vs.store.volumes) == 2
+
+
+def test_collection_delete(cluster):
+    master, servers, _, env = cluster
+    client = WeedClient(master.url)
+    fid = client.upload(b"collected", collection="scratch")
+    vid = int(fid.split(",")[0])
+    sync(servers)
+    out = run_command(env, "collection.delete -collection scratch")
+    assert str(vid) in out
+    assert all(vid not in vs.store.volumes for vs in servers)
+
+
+def test_evacuate(cluster):
+    master, servers, _, env = cluster
+    client = WeedClient(master.url)
+    fids = [client.upload(bytes([i]) * 100) for i in range(5)]
+    sync(servers)
+    victim = next(vs for vs in servers if vs.store.volumes)
+    out = run_command(env, f"volume.server.evacuate -node {victim.url}")
+    assert "->" in out
+    sync(servers)
+    assert not victim.store.volumes
+    for i, fid in enumerate(fids):
+        assert client.download(fid) == bytes([i]) * 100
+
+
+def test_fs_commands(cluster):
+    _, _, filer, env = cluster
+    http_bytes("PUT", f"http://{filer.url}/projects/a/readme.txt", b"hello fs")
+    http_bytes("PUT", f"http://{filer.url}/projects/b/data.bin", b"12345")
+
+    assert "a/" in run_command(env, "fs.ls /projects")
+    assert "hello fs" == run_command(env, "fs.cat /projects/a/readme.txt")
+    out = run_command(env, "fs.du /projects")
+    assert "13 bytes" in out and "2 files" in out
+    tree = run_command(env, "fs.tree /projects")
+    assert "readme.txt" in tree and "data.bin" in tree
+    run_command(env, "fs.mkdir /projects/c")
+    assert "c/" in run_command(env, "fs.ls /projects")
+    run_command(env, "fs.mv /projects/a -to /projects/renamed")
+    assert "hello fs" == run_command(env, "fs.cat /projects/renamed/readme.txt")
+    run_command(env, "fs.rm -r /projects/b")
+    assert "data.bin" not in run_command(env, "fs.tree /projects")

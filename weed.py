@@ -76,7 +76,7 @@ def cmd_shell(args) -> None:
     from seaweedfs_tpu.shell import CommandEnv, repl, run_command
 
     if args.c:
-        env = CommandEnv(args.master)
+        env = CommandEnv(args.master, args.filer)
         env.lock()
         try:
             for line in args.c.split(";"):
@@ -86,7 +86,7 @@ def cmd_shell(args) -> None:
         finally:
             env.unlock()
     else:
-        repl(args.master)
+        repl(args.master, args.filer)
 
 
 def cmd_upload(args) -> None:
@@ -211,6 +211,7 @@ def main(argv=None) -> None:
 
     sh = sub.add_parser("shell")
     sh.add_argument("-master", default="127.0.0.1:9333")
+    sh.add_argument("-filer", default="", help="filer host:port for fs.* commands")
     sh.add_argument("-c", default="", help="run commands and exit ( ; separated)")
     sh.set_defaults(fn=cmd_shell)
 
