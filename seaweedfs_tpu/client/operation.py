@@ -21,6 +21,7 @@ class Assignment:
     url: str
     public_url: str
     count: int
+    auth: str = ""  # write JWT signed by the master for exactly this fid
 
 
 class MasterClient:
@@ -44,18 +45,33 @@ class MasterClient:
         if "error" in r and r["error"]:
             raise HttpError(500, r["error"])
         return Assignment(r["fid"], r["url"], r.get("publicUrl", r["url"]),
-                          int(r.get("count", count)))
+                          int(r.get("count", count)), r.get("auth", ""))
 
     def lookup(self, vid: int) -> list[str]:
+        return self.lookup_with_auth(vid)[0]
+
+    def lookup_with_auth(self, vid: int) -> tuple[list[str], str]:
+        """(urls, read_auth) — read_auth is non-empty on secured clusters."""
         cached = self._cache.get(vid)
         now = time.time()
         if cached and now - cached[0] < self.cache_seconds:
-            return cached[1]
+            return cached[1], cached[2]
         r = http_json("GET",
                       f"http://{self.master_url}/dir/lookup?volumeId={vid}")
         urls = [loc["url"] for loc in r.get("locations", [])]
-        self._cache[vid] = (now, urls)
-        return urls
+        auth = r.get("auth", "")
+        self._cache[vid] = (now, urls, auth)
+        return urls, auth
+
+    def lookup_file(self, fid: str) -> tuple[list[str], str, str]:
+        """(urls, read_auth, write_auth) for one fid — write_auth lets the
+        holder delete/overwrite exactly this file on secured clusters."""
+        vid = int(fid.split(",")[0])
+        r = http_json(
+            "GET", f"http://{self.master_url}/dir/lookup?"
+            f"volumeId={vid}&fileId={fid}")
+        urls = [loc["url"] for loc in r.get("locations", [])]
+        return urls, r.get("auth", ""), r.get("writeAuth", "")
 
     def invalidate(self, vid: int) -> None:
         self._cache.pop(vid, None)
@@ -81,21 +97,26 @@ class WeedClient:
         if ttl:
             params["ttl"] = ttl
         q = "?" + urllib.parse.urlencode(params) if params else ""
+        headers = {"Content-Type": mime} if mime else {}
+        if a.auth:
+            headers["Authorization"] = f"BEARER {a.auth}"
         status, body, _ = http_bytes(
             "POST", f"http://{a.url}/{a.fid}{q}", data,
-            headers={"Content-Type": mime} if mime else None)
+            headers=headers or None)
         if status not in (200, 201):
             raise HttpError(status, body.decode(errors="replace"))
         return a.fid
 
     def download(self, fid: str) -> bytes:
         vid = int(fid.split(",")[0])
-        urls = self.master.lookup(vid)
+        urls, auth = self.master.lookup_with_auth(vid)
         if not urls:
             raise HttpError(404, f"volume {vid} has no locations")
+        headers = {"Authorization": f"BEARER {auth}"} if auth else None
         last_err = None
         for url in random.sample(urls, len(urls)):
-            status, body, _ = http_bytes("GET", f"http://{url}/{fid}")
+            status, body, _ = http_bytes("GET", f"http://{url}/{fid}",
+                                         headers=headers)
             if status == 200:
                 return body
             if status == 302:
@@ -106,8 +127,11 @@ class WeedClient:
         raise last_err or HttpError(404, "not found")
 
     def delete(self, fid: str) -> None:
-        vid = int(fid.split(",")[0])
-        for url in self.master.lookup(vid):
-            http_bytes("DELETE", f"http://{url}/{fid}")
+        urls, _, write_auth = self.master.lookup_file(fid)
+        headers = ({"Authorization": f"BEARER {write_auth}"}
+                   if write_auth else None)
+        for url in urls:
+            http_bytes("DELETE", f"http://{url}/{fid}", headers=headers)
             return
-        raise HttpError(404, f"volume {vid} has no locations")
+        raise HttpError(404,
+                        f"volume {fid.split(',')[0]} has no locations")

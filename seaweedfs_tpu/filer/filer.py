@@ -42,10 +42,8 @@ class Filer:
         self._gc_queue: list[str] = []
         self._gc_event = threading.Event()
         self._stop = threading.Event()
-        # meta log ring: recent events in memory; full history in the store
-        import collections
-
-        self._log: collections.deque = collections.deque(maxlen=10_000)
+        # meta log: full history persisted in the store; _log_lock guards
+        # only the subscriber list (never held across store IO)
         self._log_lock = threading.Lock()
         self._subscribers: list[Callable[[dict], None]] = []
         if self.store.find_entry("/") is None:
@@ -229,19 +227,31 @@ class Filer:
             "old_entry": old.to_dict() if old else None,
             "new_entry": new.to_dict() if new else None,
         }
+        # persist append-only: one kv record per event, keyed by day+ts
+        # (O(1) per mutation — filer_notify_append.go analog). Store IO is
+        # outside the subscriber lock so mutations never serialize on it.
+        day = time.strftime("%Y-%m-%d", time.gmtime())
+        key = f"{LOG_DIR}/{day}/{event['ts_ns']:020d}".encode()
+        self.store.kv_put(key, json.dumps(event).encode())
         with self._log_lock:
-            self._log.append(event)
             subs = list(self._subscribers)
-            # persist append-only: one kv record per event, keyed by day+ts
-            # (O(1) per mutation, race-free — filer_notify_append.go analog)
-            day = time.strftime("%Y-%m-%d", time.gmtime())
-            key = f"{LOG_DIR}/{day}/{event['ts_ns']:020d}".encode()
-            self.store.kv_put(key, json.dumps(event).encode())
         for fn in subs:
             try:
                 fn(event)
             except Exception:
                 pass
+
+    def truncate_log(self, before_ns: int) -> int:
+        """Prune persisted meta-log events older than before_ns (the
+        reference bounds the log by writing day-files that operators
+        delete; here pruning is a first-class call). Returns count."""
+        doomed = []
+        for key, value in self.store.kv_scan(f"{LOG_DIR}/".encode()):
+            if json.loads(value)["ts_ns"] < before_ns:
+                doomed.append(key)
+        for key in doomed:
+            self.store.kv_delete(key)
+        return len(doomed)
 
     def read_persisted_log(self, since_ns: int = 0) -> list[dict]:
         """Replay the durable event stream (survives restarts)."""
@@ -254,11 +264,12 @@ class Filer:
 
     def subscribe(self, fn: Callable[[dict], None],
                   since_ns: int = 0) -> Callable[[], None]:
-        """SubscribeMetadata: replay persisted history then tail live."""
+        """SubscribeMetadata: replay persisted history then tail live.
+        Delivery is at-least-once: an event landing between registration
+        and the history read can arrive twice (dedupe on ts_ns)."""
         with self._log_lock:
-            history = self.read_persisted_log(since_ns)
             self._subscribers.append(fn)
-        for e in history:
+        for e in self.read_persisted_log(since_ns):
             fn(e)
 
         def cancel() -> None:

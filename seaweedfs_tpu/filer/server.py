@@ -28,7 +28,10 @@ class FilerServer:
     def __init__(self, master_url: str, store: Optional[FilerStore] = None,
                  host: str = "127.0.0.1", port: int = 8888,
                  max_chunk_mb: int = 8, collection: str = "",
-                 replication: str = ""):
+                 replication: str = "", guard=None):
+        from ..security import Guard
+
+        self.guard = guard or Guard()
         self.master_url = master_url
         self.client = WeedClient(master_url)
         self.filer = Filer(store, delete_chunks_fn=self._delete_chunks)
@@ -36,7 +39,10 @@ class FilerServer:
         self.max_chunk_size = max_chunk_mb * 1024 * 1024
         self.collection = collection
         self.replication = replication
-        self.router = Router("filer")
+        from ..stats import filer_metrics
+
+        self.metrics = filer_metrics()
+        self.router = Router("filer", metrics=self.metrics)
         self._register_routes()
         self._server = None
 
@@ -60,18 +66,30 @@ class FilerServer:
         from ..utils.httpd import http_json
 
         by_server: dict[str, list[str]] = {}
+        jwts: dict[str, str] = {}
+        secured: Optional[bool] = None
         for fid in fids:
             try:
-                vid = int(fid.split(",")[0])
-                urls = self.client.master.lookup(vid)
+                if secured is not False:
+                    # secured cluster: every fid needs a master-signed write
+                    # token; one probe decides, then fetch per fid
+                    urls, _, write_auth = self.client.master.lookup_file(fid)
+                    if secured is None:
+                        secured = bool(write_auth)
+                    if write_auth:
+                        jwts[fid] = write_auth
+                else:
+                    urls = self.client.master.lookup(int(fid.split(",")[0]))
                 if urls:
                     by_server.setdefault(urls[0], []).append(fid)
             except Exception:
                 pass
         for url, batch in by_server.items():
             try:
-                http_json("POST", f"http://{url}/admin/batch_delete",
-                          {"fids": batch})
+                payload = {"fids": batch}
+                if jwts:
+                    payload["jwts"] = {f: jwts[f] for f in batch if f in jwts}
+                http_json("POST", f"http://{url}/admin/batch_delete", payload)
             except Exception:
                 pass  # best-effort; orphans are re-collectable
 
@@ -131,6 +149,13 @@ class FilerServer:
     def _register_routes(self) -> None:
         r = self.router
 
+        @r.route("GET", "/metrics")
+        def metrics(req: Request) -> Response:
+            from ..stats import REGISTRY
+
+            return Response(raw=REGISTRY.expose().encode(), headers={
+                "Content-Type": "text/plain; version=0.0.4; charset=utf-8"})
+
         @r.route("GET", "/api/stat(/.*)")
         def api_stat(req: Request) -> Response:
             entry = self.filer.find_entry(req.match.group(1))
@@ -141,12 +166,18 @@ class FilerServer:
 
         @r.route("POST", "/api/rename")
         def api_rename(req: Request) -> Response:
+            err = self.guard.check_filer_jwt(req)
+            if err:
+                raise HttpError(401, err)
             b = req.json()
             moved = self.filer.rename(b["from"], b["to"])
             return Response({"path": moved.full_path})
 
         @r.route("POST", "/api/mkdir")
         def api_mkdir(req: Request) -> Response:
+            err = self.guard.check_filer_jwt(req)
+            if err:
+                raise HttpError(401, err)
             path = req.json()["path"].rstrip("/") or "/"
             self.filer._ensure_parents(path)
             return Response({"path": path})
@@ -168,6 +199,7 @@ class FilerServer:
                     "Path": path,
                     "Entries": [self._entry_json(e) for e in listing],
                     "ShouldDisplayLoadMore": len(listing) >= limit,
+                    "LastFileName": listing[-1].name if listing else "",
                 })
             from ..utils.httpd import UNSATISFIABLE_RANGE, parse_range
 
@@ -197,6 +229,11 @@ class FilerServer:
         @r.route("POST", "(/.*)")
         @r.route("PUT", "(/.*)")
         def write(req: Request) -> Response:
+            if not self.guard.white_list_ok(req):
+                raise HttpError(401, "not in whitelist")
+            err = self.guard.check_filer_jwt(req)
+            if err:
+                raise HttpError(401, err)
             path = req.match.group(1)
             if path.endswith("/"):
                 self.filer._ensure_parents(path.rstrip("/") or "/")
@@ -212,6 +249,11 @@ class FilerServer:
 
         @r.route("DELETE", "(/.*)")
         def delete(req: Request) -> Response:
+            if not self.guard.white_list_ok(req):
+                raise HttpError(401, "not in whitelist")
+            err = self.guard.check_filer_jwt(req)
+            if err:
+                raise HttpError(401, err)
             path = req.match.group(1)
             try:
                 self.filer.delete_entry(

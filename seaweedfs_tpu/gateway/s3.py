@@ -47,7 +47,10 @@ class S3ApiServer:
                  port: int = 8333):
         self.fs = filer_server
         self.host, self.port = host, port
-        self.router = Router("s3")
+        from ..stats import s3_metrics
+
+        self.metrics = s3_metrics()
+        self.router = Router("s3", metrics=self.metrics)
         self._register_routes()
         self._server = None
         self.fs.filer._ensure_parents(BUCKETS_PATH)

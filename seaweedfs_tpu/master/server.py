@@ -21,6 +21,7 @@ import threading
 import time
 from typing import Optional
 
+from ..security import Guard, gen_jwt_for_volume_server
 from ..storage.file_id import format_needle_id_cookie
 from ..storage.super_block import ReplicaPlacement
 from ..storage.ttl import TTL
@@ -36,14 +37,20 @@ class MasterServer:
                  default_replication: str = "000",
                  sequencer: str = "memory",
                  garbage_threshold: float = 0.3,
-                 pulse_seconds: float = 5.0):
+                 pulse_seconds: float = 5.0,
+                 guard: Optional[Guard] = None):
         self.host, self.port = host, port
+        self.guard = guard or Guard()
         self.topo = Topology(volume_size_limit_mb * 1024 * 1024, pulse_seconds)
         self.default_replication = default_replication
         self.garbage_threshold = garbage_threshold
         self.seq = (SnowflakeSequencer() if sequencer == "snowflake"
                     else MemorySequencer())
-        self.router = Router("master")
+        from ..stats import master_metrics
+
+        self.metrics = master_metrics()
+        self.metrics.leader_gauge.set(1)
+        self.router = Router("master", metrics=self.metrics)
         self._register_routes()
         self._server = None
         self._stop = threading.Event()
@@ -94,12 +101,19 @@ class MasterServer:
             key = self.seq.next_file_id(count)
             cookie = secrets.randbits(32)
             node = random.choice(nodes)
-            return Response({
-                "fid": f"{vid},{format_needle_id_cookie(key, cookie)}",
+            fid = f"{vid},{format_needle_id_cookie(key, cookie)}"
+            result = {
+                "fid": fid,
                 "url": node.url,
                 "publicUrl": node.public_url,
                 "count": count,
-            })
+            }
+            # write authorization: sign the fid so only this assignment can
+            # be written (security/jwt.go:30, master_server_handlers.go)
+            if self.guard.signing_key:
+                result["auth"] = gen_jwt_for_volume_server(
+                    self.guard.signing_key, self.guard.expires_after_sec, fid)
+            return Response(result)
 
         @r.route("GET", "/dir/lookup")
         def lookup(req: Request) -> Response:
@@ -109,11 +123,21 @@ class MasterServer:
             if not nodes:
                 return Response({"volumeId": vid_str,
                                  "error": "volume id not found"}, status=404)
-            return Response({
+            result = {
                 "volumeId": vid_str,
                 "locations": [{"url": n.url, "publicUrl": n.public_url}
                               for n in nodes],
-            })
+            }
+            # secured reads: a bare read token; secured deletes: a per-fid
+            # write token when the caller names the file id
+            if self.guard.read_signing_key:
+                result["auth"] = self.guard.gen_read_token()
+            file_id = req.query.get("fileId", "")
+            if file_id and self.guard.signing_key:
+                result["writeAuth"] = gen_jwt_for_volume_server(
+                    self.guard.signing_key, self.guard.expires_after_sec,
+                    file_id)
+            return Response(result)
 
         @r.route("GET", "/dir/lookup_ec")
         def lookup_ec(req: Request) -> Response:
@@ -137,9 +161,17 @@ class MasterServer:
         def cluster_status(req: Request) -> Response:
             return Response({"IsLeader": True, "Leader": self.url, "Peers": []})
 
+        @r.route("GET", "/metrics")
+        def metrics(req: Request) -> Response:
+            from ..stats import REGISTRY
+
+            return Response(raw=REGISTRY.expose().encode(), headers={
+                "Content-Type": "text/plain; version=0.0.4; charset=utf-8"})
+
         @r.route("POST", "/heartbeat")
         def heartbeat(req: Request) -> Response:
             hb = req.json()
+            self.metrics.received_heartbeats.inc("total")
             node = self.topo.register_node(
                 hb["ip"], int(hb["port"]), hb.get("public_url", ""),
                 hb.get("data_center") or "DefaultDataCenter",

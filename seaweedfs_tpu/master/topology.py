@@ -401,4 +401,7 @@ class Topology:
                                for sid, nodes in locs.items()}
                     for vid, locs in self.ec_shard_locations.items()
                 },
+                "EcCollections": {
+                    str(vid): c for vid, c in self.ec_collections.items()
+                },
             }

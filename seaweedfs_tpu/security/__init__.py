@@ -1,0 +1,8 @@
+from .jwt import (EncodedJwt, SigningKey, decode_jwt, gen_jwt_for_filer_server,
+                  gen_jwt_for_volume_server, get_jwt, JwtError)
+from .guard import Guard
+
+__all__ = [
+    "EncodedJwt", "SigningKey", "decode_jwt", "gen_jwt_for_filer_server",
+    "gen_jwt_for_volume_server", "get_jwt", "Guard", "JwtError",
+]

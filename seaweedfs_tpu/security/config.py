@@ -1,0 +1,44 @@
+"""security.toml -> Guard construction.
+
+Equivalent of the reference's security.toml scaffold
+(weed/command/scaffold/security.toml) consumed by every server command:
+
+    [jwt.signing]          key, expires_after_seconds   — volume write JWT
+    [jwt.signing.read]     key, expires_after_seconds   — volume read JWT
+    [jwt.filer_signing]    key, expires_after_seconds   — filer API JWT
+    [guard]                white_list = ["ip", "cidr"]
+"""
+
+from __future__ import annotations
+
+from ..utils.config import Configuration, load_configuration
+from .guard import Guard
+
+
+def load_security_configuration(search_dirs=None) -> Configuration:
+    return load_configuration("security", search_dirs=search_dirs)
+
+
+def volume_guard(conf: Configuration) -> Guard:
+    return Guard(
+        white_list=conf.get("guard.white_list", []) or [],
+        signing_key=conf.get_string("jwt.signing.key"),
+        expires_after_sec=conf.get_int("jwt.signing.expires_after_seconds", 10),
+        read_signing_key=conf.get_string("jwt.signing.read.key"),
+        read_expires_after_sec=conf.get_int(
+            "jwt.signing.read.expires_after_seconds", 60),
+    )
+
+
+def master_guard(conf: Configuration) -> Guard:
+    # master signs with the volume write key (it mints assign tokens)
+    return volume_guard(conf)
+
+
+def filer_guard(conf: Configuration) -> Guard:
+    return Guard(
+        white_list=conf.get("guard.white_list", []) or [],
+        signing_key=conf.get_string("jwt.filer_signing.key"),
+        expires_after_sec=conf.get_int(
+            "jwt.filer_signing.expires_after_seconds", 10),
+    )

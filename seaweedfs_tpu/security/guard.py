@@ -1,0 +1,109 @@
+"""Request guard: IP whitelist + JWT enforcement middleware.
+
+Equivalent of weed/security/guard.go:53-120 — a server wraps its mutating
+handlers in `guard.white_list(...)` and its JWT-protected handlers in
+`guard.secure(...)`. Inactive guards (no whitelist, no key) pass requests
+through untouched, exactly like the reference's isWriteActive short-circuit.
+"""
+
+from __future__ import annotations
+
+import ipaddress
+from typing import Optional
+
+from .jwt import JwtError, decode_jwt, get_jwt
+
+
+class Guard:
+    def __init__(self, white_list: Optional[list[str]] = None,
+                 signing_key: str = "", expires_after_sec: int = 10,
+                 read_signing_key: str = "", read_expires_after_sec: int = 60):
+        self.white_list = [w for w in (white_list or []) if w]
+        self.signing_key = signing_key
+        self.expires_after_sec = expires_after_sec
+        self.read_signing_key = read_signing_key
+        self.read_expires_after_sec = read_expires_after_sec
+        self.is_write_active = bool(self.white_list or self.signing_key)
+
+    # --- whitelist (guard.go:65-130) --------------------------------------
+    def check_white_list(self, remote_host: str) -> bool:
+        if not self.white_list:
+            return True
+        for entry in self.white_list:
+            if "/" in entry:
+                try:
+                    if (ipaddress.ip_address(remote_host)
+                            in ipaddress.ip_network(entry, strict=False)):
+                        return True
+                except ValueError:
+                    continue
+            elif entry == remote_host:
+                return True
+        return False
+
+    @staticmethod
+    def actual_remote_host(req) -> str:
+        """The TCP peer address. Divergence from guard.go:79-92 (which
+        trusts X-Forwarded-For outright): a client-supplied header must not
+        widen access, so the socket peer is authoritative. Proxied
+        deployments whitelist the proxy address instead."""
+        return req.handler.client_address[0]
+
+    def white_list_ok(self, req) -> bool:
+        if not self.is_write_active:
+            return True
+        return self.check_white_list(self.actual_remote_host(req))
+
+    # --- jwt --------------------------------------------------------------
+    def check_write_jwt(self, req, fid: str) -> Optional[str]:
+        """Volume-server write check: returns an error string or None.
+        The claim must carry the exact fid being written (the master signed
+        it at assign time)."""
+        if not self.signing_key:
+            return None
+        token = get_jwt(req.headers, req.query)
+        if not token:
+            return "missing jwt"
+        try:
+            claims = decode_jwt(self.signing_key, token)
+        except JwtError as e:
+            return str(e)
+        if claims.get("fid") != fid:
+            return f"jwt fid mismatch: {claims.get('fid')} != {fid}"
+        return None
+
+    def check_read_jwt(self, req, fid: str) -> Optional[str]:
+        if not self.read_signing_key:
+            return None
+        token = get_jwt(req.headers, req.query)
+        if not token:
+            return "missing jwt"
+        try:
+            claims = decode_jwt(self.read_signing_key, token)
+        except JwtError as e:
+            return str(e)
+        if claims.get("fid") not in (None, fid):
+            return "jwt fid mismatch"
+        return None
+
+    def check_filer_jwt(self, req) -> Optional[str]:
+        """Filer API check: any validly-signed token passes (bare claims)."""
+        if not self.signing_key:
+            return None
+        token = get_jwt(req.headers, req.query)
+        if not token:
+            return "missing jwt"
+        try:
+            decode_jwt(self.signing_key, token)
+        except JwtError as e:
+            return str(e)
+        return None
+
+    def gen_read_token(self) -> str:
+        """Mint a bare read token (no fid claim: valid for any read) with
+        the read key — the master attaches this to /dir/lookup responses so
+        secured reads are actually possible."""
+        from .jwt import gen_jwt_for_filer_server
+
+        return gen_jwt_for_filer_server(self.read_signing_key,
+                                        self.read_expires_after_sec)

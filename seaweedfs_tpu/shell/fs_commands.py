@@ -3,6 +3,7 @@
 from __future__ import annotations
 
 import json
+import urllib.parse
 
 from ..utils.httpd import HttpError, http_bytes, http_json
 from .commands import CommandEnv, command
@@ -15,13 +16,22 @@ def _filer(env: CommandEnv) -> str:
 
 
 def _listing(env: CommandEnv, path: str) -> list[dict]:
-    status, body, _ = http_bytes("GET", f"http://{_filer(env)}{path}")
-    if status != 200:
-        raise HttpError(status, body.decode(errors="replace"))
-    data = json.loads(body)
-    if "Entries" not in data:
-        raise NotADirectoryError(path)
-    return data["Entries"]
+    """Full directory listing, following lastFileName pagination so
+    directories over one page (1000 entries) are not silently truncated."""
+    entries: list[dict] = []
+    last = ""
+    while True:
+        q = f"?lastFileName={urllib.parse.quote(last)}" if last else ""
+        status, body, _ = http_bytes("GET", f"http://{_filer(env)}{path}{q}")
+        if status != 200:
+            raise HttpError(status, body.decode(errors="replace"))
+        data = json.loads(body)
+        if "Entries" not in data:
+            raise NotADirectoryError(path)
+        entries.extend(data["Entries"])
+        if not data.get("ShouldDisplayLoadMore") or not data.get("LastFileName"):
+            return entries
+        last = data["LastFileName"]
 
 
 @command("fs.ls")

@@ -196,7 +196,9 @@ def cmd_collection_delete(env: CommandEnv, flags: dict) -> str:
     for layout in topo.get("Layouts", []):
         if layout["collection"] != name:
             continue
-        for vid in layout.get("writables", []):
+        # "volumes" is the full vid list; "writables" would miss full or
+        # readonly volumes and leave their data behind
+        for vid in layout.get("volumes", layout.get("writables", [])):
             for url in _volume_locations(env, vid):
                 env.volume_post(url, "/admin/delete_volume", {"volume_id": vid})
             deleted.append(vid)
@@ -234,17 +236,22 @@ def cmd_evacuate(env: CommandEnv, flags: dict) -> str:
         cmd_volume_move(env, {"volumeId": str(vid), "source": node,
                               "target": dst})
         moves.append(f"volume {vid} -> {dst}")
-    # ec shards
-    info = env.topology().get("EcVolumes", {})
+    # ec shards — carry the collection, or the target re-registers the
+    # shard under the default collection and scoped ops miss it
+    topo = env.topology()
+    info = topo.get("EcVolumes", {})
+    ec_collections = topo.get("EcCollections", {})
     for vid_str, shards in info.items():
+        collection = ec_collections.get(vid_str, "")
         for sid, urls in shards.items():
             if node not in urls:
                 continue
             dst = others[int(sid) % len(others)]["Url"]
             env.volume_post(dst, "/admin/ec/copy", {
                 "volume_id": int(vid_str), "shard_ids": [int(sid)],
-                "source_data_node": node})
-            env.volume_post(dst, "/admin/ec/mount", {"volume_id": int(vid_str)})
+                "collection": collection, "source_data_node": node})
+            env.volume_post(dst, "/admin/ec/mount", {
+                "volume_id": int(vid_str), "collection": collection})
             env.volume_post(node, "/admin/ec/delete",
                             {"volume_id": int(vid_str), "shard_ids": [int(sid)]})
             moves.append(f"ec {vid_str}.{sid} -> {dst}")

@@ -1,0 +1,279 @@
+"""Prometheus-format metrics: counters, gauges, histograms + exposition.
+
+Equivalent of weed/stats/metrics.go:23-330 — the same collector families
+(MasterReceivedHeartbeatCounter, VolumeServerRequestCounter/Histogram,
+FilerRequestCounter/Histogram, S3RequestCounter, volume/EC-shard gauges),
+exposed as text/plain; version=0.0.4 on each server's /metrics and
+optionally pushed to a pushgateway (stats/metrics.go:300+). Implemented on
+stdlib only; the exposition format is the wire contract, so any Prometheus
+scraper works unchanged.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from bisect import bisect_left
+from typing import Optional
+
+DEFAULT_BUCKETS = (0.0001, 0.0003, 0.001, 0.003, 0.01, 0.03,
+                   0.1, 0.3, 1.0, 3.0, 10.0)
+
+
+def _fmt_labels(label_names: tuple, label_values: tuple) -> str:
+    if not label_names:
+        return ""
+    pairs = ",".join(f'{k}="{v}"' for k, v in zip(label_names, label_values))
+    return "{" + pairs + "}"
+
+
+class Counter:
+    def __init__(self, name: str, help_: str = "", labels: tuple = ()):
+        self.name, self.help = name, help_
+        self.label_names = tuple(labels)
+        self._values: dict[tuple, float] = {}
+        self._lock = threading.Lock()
+
+    def inc(self, *label_values, amount: float = 1.0) -> None:
+        key = tuple(str(v) for v in label_values)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def value(self, *label_values) -> float:
+        return self._values.get(tuple(str(v) for v in label_values), 0.0)
+
+    def expose(self) -> list[str]:
+        out = [f"# HELP {self.name} {self.help}",
+               f"# TYPE {self.name} counter"]
+        for lv, v in sorted(self._values.items()):
+            out.append(f"{self.name}{_fmt_labels(self.label_names, lv)} {_num(v)}")
+        return out
+
+
+class Gauge:
+    def __init__(self, name: str, help_: str = "", labels: tuple = ()):
+        self.name, self.help = name, help_
+        self.label_names = tuple(labels)
+        self._values: dict[tuple, float] = {}
+        self._lock = threading.Lock()
+
+    def set(self, *label_values_then_value) -> None:
+        *label_values, value = label_values_then_value
+        key = tuple(str(v) for v in label_values)
+        with self._lock:
+            self._values[key] = float(value)
+
+    def add(self, *label_values_then_delta) -> None:
+        *label_values, delta = label_values_then_delta
+        key = tuple(str(v) for v in label_values)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + float(delta)
+
+    def value(self, *label_values) -> float:
+        return self._values.get(tuple(str(v) for v in label_values), 0.0)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._values.clear()
+
+    def expose(self) -> list[str]:
+        out = [f"# HELP {self.name} {self.help}",
+               f"# TYPE {self.name} gauge"]
+        for lv, v in sorted(self._values.items()):
+            out.append(f"{self.name}{_fmt_labels(self.label_names, lv)} {_num(v)}")
+        return out
+
+
+class Histogram:
+    def __init__(self, name: str, help_: str = "", labels: tuple = (),
+                 buckets: tuple = DEFAULT_BUCKETS):
+        self.name, self.help = name, help_
+        self.label_names = tuple(labels)
+        self.buckets = tuple(buckets)
+        self._counts: dict[tuple, list[int]] = {}
+        self._sums: dict[tuple, float] = {}
+        self._totals: dict[tuple, int] = {}
+        self._lock = threading.Lock()
+
+    def observe(self, *label_values_then_obs) -> None:
+        *label_values, obs = label_values_then_obs
+        key = tuple(str(v) for v in label_values)
+        with self._lock:
+            counts = self._counts.setdefault(key, [0] * len(self.buckets))
+            # smallest bucket whose le >= obs owns the observation; the
+            # cumulative (le-inclusive) form is computed at exposition time
+            i = bisect_left(self.buckets, obs)
+            if i < len(self.buckets):
+                counts[i] += 1
+            self._sums[key] = self._sums.get(key, 0.0) + obs
+            self._totals[key] = self._totals.get(key, 0) + 1
+
+    def time(self, *label_values):
+        """Context manager: observes elapsed seconds."""
+        hist = self
+
+        class _Timer:
+            def __enter__(self):
+                self.t0 = time.perf_counter()
+                return self
+
+            def __exit__(self, *exc):
+                hist.observe(*label_values, time.perf_counter() - self.t0)
+                return False
+
+        return _Timer()
+
+    def expose(self) -> list[str]:
+        out = [f"# HELP {self.name} {self.help}",
+               f"# TYPE {self.name} histogram"]
+        for lv in sorted(self._counts):
+            cumulative = 0
+            for i, bound in enumerate(self.buckets):
+                cumulative += self._counts[lv][i]
+                labels = dict(zip(self.label_names, lv))
+                labels["le"] = _num(bound)
+                pairs = ",".join(f'{k}="{v}"' for k, v in labels.items())
+                out.append(f"{self.name}_bucket{{{pairs}}} {cumulative}")
+            labels = dict(zip(self.label_names, lv))
+            labels["le"] = "+Inf"
+            pairs = ",".join(f'{k}="{v}"' for k, v in labels.items())
+            out.append(f"{self.name}_bucket{{{pairs}}} {self._totals[lv]}")
+            plain = _fmt_labels(self.label_names, lv)
+            out.append(f"{self.name}_sum{plain} {_num(self._sums[lv])}")
+            out.append(f"{self.name}_count{plain} {self._totals[lv]}")
+        return out
+
+
+def _num(v: float) -> str:
+    return str(int(v)) if float(v).is_integer() else repr(float(v))
+
+
+class Registry:
+    def __init__(self):
+        self._collectors: list = []
+        self._lock = threading.Lock()
+
+    def register(self, collector):
+        with self._lock:
+            self._collectors.append(collector)
+        return collector
+
+    def counter(self, name, help_="", labels=()):
+        return self.register(Counter(name, help_, labels))
+
+    def gauge(self, name, help_="", labels=()):
+        return self.register(Gauge(name, help_, labels))
+
+    def histogram(self, name, help_="", labels=(), buckets=DEFAULT_BUCKETS):
+        return self.register(Histogram(name, help_, labels, buckets))
+
+    def expose(self) -> str:
+        lines: list[str] = []
+        with self._lock:
+            collectors = list(self._collectors)
+        for c in collectors:
+            lines.extend(c.expose())
+        return "\n".join(lines) + "\n"
+
+
+REGISTRY = Registry()
+
+
+# --- the reference's collector families (stats/metrics.go:23-130) -----------
+
+class _ServerMetrics:
+    """Per-role bundle; namespaced like SeaweedFS_{master,volumeServer,...}."""
+
+    def __init__(self, subsystem: str, registry: Registry):
+        ns = f"SeaweedFS_{subsystem}"
+        self.request_counter = registry.counter(
+            f"{ns}_request_total", f"Counter of {subsystem} requests.",
+            labels=("type",))
+        self.request_histogram = registry.histogram(
+            f"{ns}_request_seconds", f"Bucketed {subsystem} request latency.",
+            labels=("type",))
+
+
+class MasterMetrics(_ServerMetrics):
+    def __init__(self, registry: Registry = REGISTRY):
+        super().__init__("master", registry)
+        self.received_heartbeats = registry.counter(
+            "SeaweedFS_master_received_heartbeats",
+            "Counter of master received heartbeat.", labels=("type",))
+        self.leader_gauge = registry.gauge(
+            "SeaweedFS_master_is_leader", "1 if this master is raft leader.")
+
+
+class VolumeServerMetrics(_ServerMetrics):
+    def __init__(self, registry: Registry = REGISTRY):
+        super().__init__("volumeServer", registry)
+        self.volume_counter = registry.gauge(
+            "SeaweedFS_volumeServer_volumes",
+            "Number of volumes or EC shards.",
+            labels=("collection", "type"))
+        self.max_volume_counter = registry.gauge(
+            "SeaweedFS_volumeServer_max_volumes", "Maximum volume count.")
+        self.disk_size_gauge = registry.gauge(
+            "SeaweedFS_volumeServer_total_disk_size",
+            "Actual disk size used by volumes.",
+            labels=("collection", "type"))
+
+
+class FilerMetrics(_ServerMetrics):
+    def __init__(self, registry: Registry = REGISTRY):
+        super().__init__("filer", registry)
+
+
+class S3Metrics(_ServerMetrics):
+    def __init__(self, registry: Registry = REGISTRY):
+        super().__init__("s3", registry)
+
+
+_singletons: dict[str, object] = {}
+_singleton_lock = threading.Lock()
+
+
+def _singleton(name, cls):
+    with _singleton_lock:
+        if name not in _singletons:
+            _singletons[name] = cls()
+        return _singletons[name]
+
+
+def master_metrics() -> MasterMetrics:
+    return _singleton("master", MasterMetrics)
+
+
+def volume_server_metrics() -> VolumeServerMetrics:
+    return _singleton("volume", VolumeServerMetrics)
+
+
+def filer_metrics() -> FilerMetrics:
+    return _singleton("filer", FilerMetrics)
+
+
+def s3_metrics() -> S3Metrics:
+    return _singleton("s3", S3Metrics)
+
+
+def start_push_loop(gateway_url: str, job: str,
+                    interval_seconds: float = 15.0,
+                    registry: Registry = REGISTRY,
+                    stop_event: Optional[threading.Event] = None) -> threading.Thread:
+    """stats/metrics.go push mode: PUT the exposition to a pushgateway."""
+    stop = stop_event or threading.Event()
+
+    def loop():
+        from ..utils.httpd import http_bytes
+
+        while not stop.wait(interval_seconds):
+            try:
+                http_bytes("PUT", f"{gateway_url}/metrics/job/{job}",
+                           registry.expose().encode(),
+                           headers={"Content-Type": "text/plain"})
+            except Exception:
+                pass
+
+    t = threading.Thread(target=loop, daemon=True, name="metrics-push")
+    t.start()
+    return t

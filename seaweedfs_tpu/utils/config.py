@@ -1,0 +1,72 @@
+"""TOML configuration loader with env-var overrides.
+
+Equivalent of weed/util/config.go:20-47 (viper): look for <name>.toml in
+".", "~/.seaweedfs", "/etc/seaweedfs" (first hit wins), then let
+WEED_<SECTION>_<KEY> environment variables override file values — the same
+convention the reference's docker compose files rely on.
+"""
+
+from __future__ import annotations
+
+import os
+import tomllib
+from typing import Any, Optional
+
+SEARCH_DIRS = [".", os.path.expanduser("~/.seaweedfs"), "/etc/seaweedfs"]
+
+
+class Configuration:
+    def __init__(self, data: Optional[dict] = None, source: str = ""):
+        self.data = data or {}
+        self.source = source
+
+    def get(self, dotted_key: str, default: Any = None) -> Any:
+        """viper-style lookup: 'jwt.signing.key' walks nested tables, and a
+        WEED_JWT_SIGNING_KEY env var overrides whatever the file says."""
+        env = "WEED_" + dotted_key.upper().replace(".", "_").replace("-", "_")
+        if env in os.environ:
+            return _coerce(os.environ[env], default)
+        node: Any = self.data
+        for part in dotted_key.split("."):
+            if not isinstance(node, dict) or part not in node:
+                return default
+            node = node[part]
+        return node
+
+    def get_string(self, key: str, default: str = "") -> str:
+        return str(self.get(key, default))
+
+    def get_bool(self, key: str, default: bool = False) -> bool:
+        v = self.get(key, default)
+        return v if isinstance(v, bool) else str(v).lower() in ("1", "true", "yes")
+
+    def get_int(self, key: str, default: int = 0) -> int:
+        try:
+            return int(self.get(key, default))
+        except (TypeError, ValueError):
+            return default
+
+
+def _coerce(value: str, default: Any) -> Any:
+    if isinstance(default, bool):
+        return value.lower() in ("1", "true", "yes")
+    if isinstance(default, int):
+        try:
+            return int(value)
+        except ValueError:
+            return default
+    return value
+
+
+def load_configuration(name: str, required: bool = False,
+                       search_dirs: Optional[list[str]] = None) -> Configuration:
+    """util/config.go LoadConfiguration: <name>.toml from the search path."""
+    for d in (search_dirs if search_dirs is not None else SEARCH_DIRS):
+        path = os.path.join(d, f"{name}.toml")
+        if os.path.isfile(path):
+            with open(path, "rb") as f:
+                return Configuration(tomllib.load(f), source=path)
+    if required:
+        raise FileNotFoundError(
+            f"{name}.toml not found in {search_dirs or SEARCH_DIRS}")
+    return Configuration()

@@ -1,0 +1,66 @@
+"""Leveled logging in the style of the reference's vendored glog.
+
+Equivalent of weed/glog: `V(level)` gates verbose logs on the process-wide
+verbosity (set by the -v flag, weed/weed.go:46 wires MaxSize etc.);
+Infof/Warningf/Errorf always emit. Output goes through the stdlib logging
+root so tests can capture it and services can add file rotation handlers.
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+import threading
+
+_logger = logging.getLogger("weed")
+_verbosity = 0
+_lock = threading.Lock()
+
+
+def init(verbosity: int = 0, to_stderr: bool = True) -> None:
+    global _verbosity
+    _verbosity = verbosity
+    if to_stderr and not _logger.handlers:
+        h = logging.StreamHandler(sys.stderr)
+        h.setFormatter(logging.Formatter(
+            "%(levelname).1s%(asctime)s %(threadName)s %(message)s",
+            datefmt="%m%d %H:%M:%S"))
+        _logger.addHandler(h)
+        _logger.setLevel(logging.DEBUG)
+
+
+def set_verbosity(v: int) -> None:
+    global _verbosity
+    _verbosity = v
+
+
+class _V:
+    """glog.V(n).Infof(...) — emits only when n <= verbosity."""
+
+    def __init__(self, enabled: bool):
+        self.enabled = enabled
+
+    def infof(self, fmt: str, *args) -> None:
+        if self.enabled:
+            _logger.info(fmt % args if args else fmt)
+
+
+def V(level: int) -> _V:  # noqa: N802 — matches glog.V
+    return _V(level <= _verbosity)
+
+
+def infof(fmt: str, *args) -> None:
+    _logger.info(fmt % args if args else fmt)
+
+
+def warningf(fmt: str, *args) -> None:
+    _logger.warning(fmt % args if args else fmt)
+
+
+def errorf(fmt: str, *args) -> None:
+    _logger.error(fmt % args if args else fmt)
+
+
+def fatalf(fmt: str, *args) -> None:
+    _logger.critical(fmt % args if args else fmt)
+    raise SystemExit(255)

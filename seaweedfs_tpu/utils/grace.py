@@ -1,0 +1,76 @@
+"""Graceful-shutdown hooks + profiling setup.
+
+Equivalent of weed/util/grace/signal_handling.go:17-39 (ordered shutdown
+callbacks on SIGINT/SIGTERM) and pprof.go:11 (CPU/mem profiles behind
+flags — here cProfile/tracemalloc).
+"""
+
+from __future__ import annotations
+
+import atexit
+import signal
+import threading
+from typing import Callable
+
+_hooks: list[Callable[[], None]] = []
+_lock = threading.Lock()
+_installed = False
+
+
+def on_interrupt(hook: Callable[[], None]) -> None:
+    """Register a shutdown hook; hooks run LIFO like the reference's list."""
+    global _installed
+    with _lock:
+        _hooks.append(hook)
+        if not _installed:
+            _installed = True
+            try:
+                signal.signal(signal.SIGTERM, _run_hooks_and_exit)
+                signal.signal(signal.SIGINT, _run_hooks_and_exit)
+            except ValueError:
+                pass  # not the main thread (tests) — atexit still covers us
+            atexit.register(_run_hooks)
+
+
+def _run_hooks(*_args) -> None:
+    with _lock:
+        hooks, _hooks[:] = _hooks[::-1], []
+    for h in hooks:
+        try:
+            h()
+        except Exception:
+            pass
+
+
+def _run_hooks_and_exit(signum, _frame) -> None:
+    _run_hooks()
+    raise SystemExit(128 + signum)
+
+
+_profiler = None
+
+
+def setup_profiling(cpu_profile: str = "", mem_profile: str = "") -> None:
+    """grace/pprof.go: start CPU profiling now, dump at exit."""
+    global _profiler
+    if cpu_profile:
+        import cProfile
+
+        _profiler = cProfile.Profile()
+        _profiler.enable()
+
+        def dump_cpu():
+            _profiler.disable()
+            _profiler.dump_stats(cpu_profile)
+
+        on_interrupt(dump_cpu)
+    if mem_profile:
+        import tracemalloc
+
+        tracemalloc.start()
+
+        def dump_mem():
+            snap = tracemalloc.take_snapshot()
+            snap.dump(mem_profile)
+
+        on_interrupt(dump_mem)

@@ -12,6 +12,7 @@ from __future__ import annotations
 import json
 import re
 import threading
+import time as _time
 import urllib.error
 import urllib.parse
 import urllib.request
@@ -58,10 +59,15 @@ class Response:
 
 
 class Router:
-    """Method+regex route table shared by master/volume/filer servers."""
+    """Method+regex route table shared by master/volume/filer servers.
 
-    def __init__(self, name: str = "httpd"):
+    When `metrics` is set (a stats._ServerMetrics bundle), every dispatch
+    increments the request counter and observes latency, labeled by handler
+    name — the per-operation labeling of stats/metrics.go collectors."""
+
+    def __init__(self, name: str = "httpd", metrics=None):
         self.name = name
+        self.metrics = metrics
         self.routes: list[tuple[str, re.Pattern, Callable]] = []
 
     def route(self, method: str, pattern: str):
@@ -80,6 +86,7 @@ class Router:
                 continue
             match = pattern.match(path)
             if match:
+                t0 = _time.perf_counter()
                 try:
                     resp = fn(Request(handler, match))
                 except HttpError as e:
@@ -88,6 +95,10 @@ class Router:
                     resp = Response({"error": str(e)}, status=404)
                 except Exception as e:  # noqa: BLE001 — server must not die
                     resp = Response({"error": f"{type(e).__name__}: {e}"}, status=500)
+                if self.metrics is not None:
+                    self.metrics.request_counter.inc(fn.__name__)
+                    self.metrics.request_histogram.observe(
+                        fn.__name__, _time.perf_counter() - t0)
                 self._send(handler, resp)
                 return
         self._send(handler, Response({"error": f"no route {method} {path}"}, status=404))
@@ -186,6 +197,10 @@ def parse_range(range_header: str, file_size: int) -> Optional[tuple[int, int]]:
     try:
         if lo == "":  # suffix range: last N bytes
             n = int(hi)
+            if n == 0 or file_size == 0:
+                # RFC 7233: a zero-length suffix (or any suffix of an empty
+                # file) has no satisfiable byte range
+                return UNSATISFIABLE_RANGE
             offset = max(0, file_size - n)
             return offset, file_size - offset
         offset = int(lo)

@@ -53,14 +53,22 @@ class VolumeServer:
                  host: str = "127.0.0.1", port: int = 8080,
                  public_url: str = "", data_center: str = "",
                  rack: str = "", max_volume_count: int = 8,
-                 pulse_seconds: float = 5.0, ec_engine: str = "cpu"):
+                 pulse_seconds: float = 5.0, ec_engine: str = "cpu",
+                 guard: Optional["Guard"] = None):
+        from ..security import Guard
+
         self.master_url = master_url
         self.data_center = data_center
         self.rack = rack
         self.pulse_seconds = pulse_seconds
+        self.guard = guard or Guard()
         self.store = Store(directories, host, port, public_url,
                            max_volume_count, ec_engine=ec_engine)
-        self.router = Router("volume")
+        from ..stats import volume_server_metrics
+
+        self.metrics = volume_server_metrics()
+        self.metrics.max_volume_counter.set(max_volume_count)
+        self.router = Router("volume", metrics=self.metrics)
         self._register_routes()
         self._server = None
         self._stop = threading.Event()
@@ -171,6 +179,25 @@ class VolumeServer:
             self.heartbeat_now()
             return Response({})
 
+        @r.route("GET", "/metrics")
+        def metrics(req: Request) -> Response:
+            from ..stats import REGISTRY
+
+            # refresh gauges from the live store (volume + EC-shard counts,
+            # disk usage per collection — stats/metrics.go gauge family)
+            self.metrics.volume_counter.clear()
+            self.metrics.disk_size_gauge.clear()
+            for v in self.store.volumes.values():
+                self.metrics.volume_counter.add(v.collection, "volume", 1)
+                self.metrics.disk_size_gauge.add(
+                    v.collection, "volume", v.data_size)
+            for vid, ev in self.store.ec_volumes.items():
+                self.metrics.volume_counter.add(
+                    self.store.ec_collections.get(vid, ""), "ec_shards",
+                    len(ev.shards))
+            return Response(raw=REGISTRY.expose().encode(), headers={
+                "Content-Type": "text/plain; version=0.0.4; charset=utf-8"})
+
         @r.route("GET", "/status")
         def status(req: Request) -> Response:
             return Response({
@@ -184,6 +211,10 @@ class VolumeServer:
         @r.route("HEAD", FID_PATTERN)
         def read_object(req: Request) -> Response:
             fid = FileId.parse(f"{req.match.group(1)},{req.match.group(2)}")
+            err = self.guard.check_read_jwt(
+                req, f"{req.match.group(1)},{req.match.group(2)}")
+            if err:
+                raise HttpError(401, err)
             vid = fid.volume_id
             if vid in self.store.volumes:
                 try:
@@ -221,7 +252,16 @@ class VolumeServer:
         @r.route("POST", FID_PATTERN)
         @r.route("PUT", FID_PATTERN)
         def write_object(req: Request) -> Response:
-            fid = FileId.parse(f"{req.match.group(1)},{req.match.group(2)}")
+            if not self.guard.white_list_ok(req):
+                raise HttpError(401, "not in whitelist")
+            err = self.guard.check_write_jwt(
+                req, f"{req.match.group(1)},{req.match.group(2)}")
+            if err:
+                raise HttpError(401, err)
+            try:
+                fid = FileId.parse(f"{req.match.group(1)},{req.match.group(2)}")
+            except ValueError as e:
+                raise HttpError(400, str(e))
             n = Needle(cookie=fid.cookie, id=fid.key, data=req.body)
             name = req.query.get("name") or req.headers.get("X-File-Name")
             if name:
@@ -258,6 +298,12 @@ class VolumeServer:
 
                 params = {k: v for k, v in req.query.items() if k != "type"}
                 params["type"] = "replicate"
+                # forward the signed fid token so replicas pass their guard
+                from ..security import get_jwt
+
+                token = get_jwt(req.headers, req.query)
+                if token:
+                    params["jwt"] = token
                 qs = urllib.parse.urlencode(params)
                 for url in self._lookup_replicas(fid.volume_id):
                     if url == self.url:
@@ -273,6 +319,13 @@ class VolumeServer:
 
         @r.route("DELETE", FID_PATTERN)
         def delete_object(req: Request) -> Response:
+            if not self.guard.white_list_ok(req):
+                raise HttpError(401, "not in whitelist")
+            # deletes are mutations: same per-fid write token as POST
+            err = self.guard.check_write_jwt(
+                req, f"{req.match.group(1)},{req.match.group(2)}")
+            if err:
+                raise HttpError(401, err)
             fid = FileId.parse(f"{req.match.group(1)},{req.match.group(2)}")
             vid = fid.volume_id
             if vid in self.store.ec_volumes:
@@ -285,10 +338,14 @@ class VolumeServer:
                 except KeyError:
                     raise HttpError(404, f"volume {vid} not found")
             if req.query.get("type") != "replicate":
+                from ..security import get_jwt
+
+                token = get_jwt(req.headers, req.query)
+                qs = "?type=replicate" + (f"&jwt={token}" if token else "")
                 for url in self._lookup_replicas(vid):
                     if url == self.url:
                         continue
-                    http_bytes("DELETE", f"http://{url}{req.path}?type=replicate")
+                    http_bytes("DELETE", f"http://{url}{req.path}{qs}")
             return Response({"size": size})
 
         # --- admin: volume lifecycle ---------------------------------
@@ -400,12 +457,30 @@ class VolumeServer:
         @r.route("POST", "/admin/batch_delete")
         def batch_delete(req: Request) -> Response:
             """POST /delete multi-fid (volume_grpc_batch_delete.go), with
-            replica fan-out unless the request is itself a replicate."""
+            replica fan-out unless the request is itself a replicate.
+            On secured clusters each fid must carry a master-signed write
+            token (body "jwts": {fid: token}) — same per-fid authorization
+            as single DELETE, so this endpoint cannot bypass it."""
+            if not self.guard.white_list_ok(req):
+                raise HttpError(401, "not in whitelist")
             body = req.json()
             is_replicate = bool(body.get("replicate"))
+            jwts = body.get("jwts", {})
             results = []
             fanned: dict[str, list[str]] = {}
             for fid_str in body.get("fids", []):
+                if self.guard.signing_key:
+                    from ..security.jwt import JwtError, decode_jwt
+
+                    try:
+                        claims = decode_jwt(self.guard.signing_key,
+                                            jwts.get(fid_str, ""))
+                        if claims.get("fid") != fid_str:
+                            raise JwtError("fid mismatch")
+                    except JwtError as e:
+                        results.append({"fid": fid_str, "error": str(e),
+                                        "status": 401})
+                        continue
                 try:
                     fid = FileId.parse(fid_str)
                     if fid.volume_id in self.store.ec_volumes:
@@ -425,7 +500,8 @@ class VolumeServer:
                                     "error": str(e)})
             for url, fids in fanned.items():
                 http_json("POST", f"http://{url}/admin/batch_delete",
-                          {"fids": fids, "replicate": True})
+                          {"fids": fids, "replicate": True,
+                           "jwts": {f: jwts[f] for f in fids if f in jwts}})
             return Response({"results": results})
 
         @r.route("POST", "/admin/volume_check")

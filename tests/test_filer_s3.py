@@ -18,10 +18,7 @@ from seaweedfs_tpu.utils.httpd import http_bytes
 from seaweedfs_tpu.volume_server.server import VolumeServer
 
 
-def free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
+from tests.conftest import free_port  # noqa: E402
 
 
 @pytest.fixture

@@ -1,0 +1,107 @@
+"""Stats plane: Prometheus collectors + /metrics exposition on live servers.
+
+Mirrors the collector families of weed/stats/metrics.go:23-330.
+"""
+
+import time
+
+import pytest
+
+from seaweedfs_tpu.stats.metrics import Counter, Gauge, Histogram, Registry
+
+
+class TestCollectors:
+    def test_counter_labels(self):
+        c = Counter("reqs_total", "requests", labels=("type",))
+        c.inc("assign")
+        c.inc("assign")
+        c.inc("lookup", amount=3)
+        assert c.value("assign") == 2
+        assert c.value("lookup") == 3
+        text = "\n".join(c.expose())
+        assert '# TYPE reqs_total counter' in text
+        assert 'reqs_total{type="assign"} 2' in text
+
+    def test_gauge_set_add_clear(self):
+        g = Gauge("vols", "volumes", labels=("collection", "type"))
+        g.set("", "volume", 5)
+        g.add("", "volume", 2)
+        assert g.value("", "volume") == 7
+        g.clear()
+        assert g.value("", "volume") == 0
+
+    def test_histogram_buckets_cumulative(self):
+        h = Histogram("lat", "latency", labels=("op",), buckets=(0.01, 0.1, 1))
+        for obs in (0.005, 0.05, 0.05, 0.5, 5.0):
+            h.observe("read", obs)
+        text = "\n".join(h.expose())
+        assert 'lat_bucket{op="read",le="0.01"} 1' in text
+        assert 'lat_bucket{op="read",le="0.1"} 3' in text
+        assert 'lat_bucket{op="read",le="1"} 4' in text
+        assert 'lat_bucket{op="read",le="+Inf"} 5' in text
+        assert 'lat_count{op="read"} 5' in text
+
+    def test_histogram_le_inclusive(self):
+        h = Histogram("x", buckets=(1.0, 2.0))
+        h.observe(2.0)  # le="2" is inclusive per Prometheus semantics
+        text = "\n".join(h.expose())
+        assert 'x_bucket{le="2"} 1' in text
+        assert 'x_bucket{le="1"} 0' in text
+
+    def test_histogram_timer(self):
+        h = Histogram("t", labels=("op",))
+        with h.time("w"):
+            time.sleep(0.01)
+        assert h._totals[("w",)] == 1
+        assert h._sums[("w",)] >= 0.01
+
+    def test_registry_exposition(self):
+        reg = Registry()
+        reg.counter("a_total").inc()
+        reg.gauge("b").set(4)
+        text = reg.expose()
+        assert "a_total 1" in text and "b 4" in text
+
+
+class TestServerMetricsEndpoints:
+    @pytest.fixture()
+    def cluster(self, tmp_path):
+        from seaweedfs_tpu.master.server import MasterServer
+        from seaweedfs_tpu.utils.httpd import http_json
+        from seaweedfs_tpu.volume_server.server import VolumeServer
+        from tests.conftest import free_port
+
+        m = MasterServer(port=free_port()).start()
+        vs = VolumeServer([str(tmp_path / "v")], m.url, port=free_port()).start()
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            if http_json("GET", f"http://{m.url}/dir/status")[
+                    "Topology"]["Max"] > 0:
+                break
+            time.sleep(0.05)
+        yield m, vs
+        vs.stop()
+        m.stop()
+
+    def test_metrics_exposed_and_instrumented(self, cluster):
+        from seaweedfs_tpu.client.operation import WeedClient
+        from seaweedfs_tpu.utils.httpd import http_bytes
+
+        m, vs = cluster
+        c = WeedClient(m.url)
+        fid = c.upload(b"metric me")
+        assert c.download(fid) == b"metric me"
+
+        status, body, headers = http_bytes("GET", f"http://{m.url}/metrics")
+        assert status == 200
+        text = body.decode()
+        assert "text/plain" in headers.get("Content-Type", "")
+        assert "SeaweedFS_master_received_heartbeats" in text
+        assert 'SeaweedFS_master_request_total{type="assign"}' in text
+        assert "SeaweedFS_master_is_leader 1" in text
+
+        status, body, _ = http_bytes("GET", f"http://{vs.url}/metrics")
+        text = body.decode()
+        assert 'SeaweedFS_volumeServer_request_total{type="write_object"}' in text
+        assert 'SeaweedFS_volumeServer_request_seconds_bucket' in text
+        assert 'SeaweedFS_volumeServer_volumes{collection="",type="volume"}' in text

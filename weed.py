@@ -14,24 +14,36 @@ import sys
 import time
 
 
+def _security():
+    from seaweedfs_tpu.security.config import load_security_configuration
+
+    return load_security_configuration()
+
+
 def cmd_master(args) -> None:
     from seaweedfs_tpu.master.server import MasterServer
+    from seaweedfs_tpu.security.config import master_guard
 
     m = MasterServer(host=args.ip, port=args.port,
                      volume_size_limit_mb=args.volumeSizeLimitMB,
-                     default_replication=args.defaultReplication).start()
+                     default_replication=args.defaultReplication,
+                     guard=master_guard(_security())).start()
     print(f"master listening on {m.url}")
+    _on_interrupt(m.stop)
     _wait_forever()
 
 
 def cmd_volume(args) -> None:
+    from seaweedfs_tpu.security.config import volume_guard
     from seaweedfs_tpu.volume_server.server import VolumeServer
 
     vs = VolumeServer(args.dir.split(","), args.mserver, host=args.ip,
                       port=args.port, data_center=args.dataCenter,
                       rack=args.rack, max_volume_count=args.max,
-                      ec_engine=args.ec_engine).start()
+                      ec_engine=args.ec_engine,
+                      guard=volume_guard(_security())).start()
     print(f"volume server listening on {vs.url}, dirs {args.dir}")
+    _on_interrupt(vs.stop)
     _wait_forever()
 
 
@@ -39,10 +51,12 @@ def cmd_filer(args) -> None:
     from seaweedfs_tpu.filer.filer_store import SqliteStore
     from seaweedfs_tpu.filer.server import FilerServer
     from seaweedfs_tpu.gateway.s3 import S3ApiServer
+    from seaweedfs_tpu.security.config import filer_guard
 
     store = SqliteStore(args.db) if args.db else None
     f = FilerServer(args.master, store, host=args.ip, port=args.port,
-                    max_chunk_mb=args.maxMB).start()
+                    max_chunk_mb=args.maxMB,
+                    guard=filer_guard(_security())).start()
     print(f"filer listening on {f.url}")
     if args.s3:
         s3 = S3ApiServer(f, host=args.ip, port=args.s3_port).start()
@@ -155,6 +169,12 @@ def cmd_benchmark(args) -> None:
           f"p99 {lat[int(len(lat) * 0.99) - 1] * 1e3:.1f}ms")
 
 
+def _on_interrupt(hook) -> None:
+    from seaweedfs_tpu.utils import grace
+
+    grace.on_interrupt(hook)
+
+
 def _wait_forever() -> None:
     try:
         signal.pause()
@@ -165,6 +185,10 @@ def _wait_forever() -> None:
 
 def main(argv=None) -> None:
     p = argparse.ArgumentParser(prog="weed.py", description=__doc__)
+    p.add_argument("-v", type=int, default=0, metavar="LEVEL",
+                   help="glog verbosity level")
+    p.add_argument("-cpuprofile", default="", help="write CPU profile here")
+    p.add_argument("-memprofile", default="", help="write memory profile here")
     sub = p.add_subparsers(dest="cmd", required=True)
 
     m = sub.add_parser("master")
@@ -236,6 +260,11 @@ def main(argv=None) -> None:
     b.set_defaults(fn=cmd_benchmark)
 
     args = p.parse_args(argv)
+    from seaweedfs_tpu.utils import glog, grace
+
+    glog.init(args.v)
+    if args.cpuprofile or args.memprofile:
+        grace.setup_profiling(args.cpuprofile, args.memprofile)
     args.fn(args)
 
 
