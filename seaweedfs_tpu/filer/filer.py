@@ -41,6 +41,7 @@ class Filer:
         self._delete_chunks_fn = delete_chunks_fn
         self._gc_queue: list[str] = []
         self._gc_event = threading.Event()
+        self._gc_busy = threading.Lock()
         self._stop = threading.Event()
         # meta log: full history persisted in the store; _log_lock guards
         # only the subscriber list (never held across store IO)
@@ -206,17 +207,21 @@ class Filer:
             with self._lock:
                 batch, self._gc_queue = self._gc_queue[:1000], self._gc_queue[1000:]
             if batch and self._delete_chunks_fn is not None:
-                try:
-                    self._delete_chunks_fn(batch)
-                except Exception:
-                    pass  # chunk GC is best-effort; orphans are re-collectable
+                with self._gc_busy:
+                    try:
+                        self._delete_chunks_fn(batch)
+                    except Exception:
+                        pass  # best-effort; orphans are re-collectable
 
     def flush_gc(self) -> None:
-        """Synchronously drain the chunk GC queue (for tests/shutdown)."""
+        """Synchronously drain the chunk GC queue, waiting out any batch
+        the background loop already has in flight (tests/shutdown)."""
         with self._lock:
             batch, self._gc_queue = self._gc_queue, []
         if batch and self._delete_chunks_fn is not None:
             self._delete_chunks_fn(batch)
+        with self._gc_busy:  # barrier: in-flight async batch finished
+            pass
 
     # --- meta log + subscribe (filer_notify.go) ---------------------------
     def _notify(self, op: str, old: Optional[Entry], new: Optional[Entry]) -> None:

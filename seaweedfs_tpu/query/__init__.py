@@ -1,0 +1,3 @@
+from .json_query import execute_query, project, match_filter
+
+__all__ = ["execute_query", "project", "match_filter"]

@@ -1,0 +1,233 @@
+"""Backend storage abstraction: local disk files + tiered remote objects.
+
+Equivalent of weed/storage/backend/backend.go:15-46 (`BackendStorageFile`
+{ReadAt, WriteAt, Truncate, Sync} + `BackendStorage` factory) and
+backend/s3_backend/s3_backend.go:23-111 (a volume's `.dat` living in an
+object store while `.idx` stays local).  The cloud store here is a
+directory-rooted object store ("dir" type) — the S3 wire adapter is gated
+on boto3, which this environment does not ship; the dir backend exercises
+the identical tiering protocol (upload, ranged reads, delete).
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import threading
+from typing import Optional, Protocol
+
+
+class BackendStorageFile(Protocol):
+    """What a Volume needs from its `.dat`: positional IO + size."""
+
+    def read_at(self, length: int, offset: int) -> bytes: ...
+
+    def write_at(self, data: bytes, offset: int) -> int: ...
+
+    def truncate(self, size: int) -> None: ...
+
+    def sync(self) -> None: ...
+
+    def close(self) -> None: ...
+
+    @property
+    def size(self) -> int: ...
+
+
+class DiskFile:
+    """Local unbuffered file (backend/disk_file.go)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        exists = os.path.exists(path)
+        self._f = open(path, "r+b" if exists else "w+b", buffering=0)
+
+    def read_at(self, length: int, offset: int) -> bytes:
+        return os.pread(self._f.fileno(), length, offset)
+
+    def write_at(self, data: bytes, offset: int) -> int:
+        return os.pwrite(self._f.fileno(), data, offset)
+
+    def truncate(self, size: int) -> None:
+        os.ftruncate(self._f.fileno(), size)
+
+    def sync(self) -> None:
+        os.fsync(self._f.fileno())
+
+    def close(self) -> None:
+        self._f.close()
+
+    @property
+    def size(self) -> int:
+        return os.fstat(self._f.fileno()).st_size
+
+    def fileno(self) -> int:
+        return self._f.fileno()
+
+
+class BackendStorage(Protocol):
+    """A remote object store holding tiered volume files
+    (backend/backend.go:25-46 factory interface)."""
+
+    name: str
+    kind: str
+
+    def upload_file(self, local_path: str, key: str) -> int: ...
+
+    def download_file(self, key: str, local_path: str) -> int: ...
+
+    def read_range(self, key: str, offset: int, length: int) -> bytes: ...
+
+    def delete_file(self, key: str) -> None: ...
+
+    def object_size(self, key: str) -> int: ...
+
+
+class DirBackendStorage:
+    """Object store emulation rooted at a directory: objects are files,
+    keys are relative paths.  Carries the full tiering contract so the
+    volume/tier logic is backend-agnostic."""
+
+    kind = "dir"
+
+    def __init__(self, name: str, root: str):
+        self.name = name
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def _path(self, key: str) -> str:
+        p = os.path.normpath(os.path.join(self.root, key.lstrip("/")))
+        if not p.startswith(os.path.abspath(self.root) + os.sep) \
+                and p != os.path.abspath(self.root):
+            p = os.path.join(self.root, key.replace("/", "_"))
+        return p
+
+    def upload_file(self, local_path: str, key: str) -> int:
+        dest = self._path(key)
+        os.makedirs(os.path.dirname(dest), exist_ok=True)
+        shutil.copyfile(local_path, dest)
+        return os.path.getsize(dest)
+
+    def download_file(self, key: str, local_path: str) -> int:
+        shutil.copyfile(self._path(key), local_path)
+        return os.path.getsize(local_path)
+
+    def read_range(self, key: str, offset: int, length: int) -> bytes:
+        with open(self._path(key), "rb") as f:
+            return os.pread(f.fileno(), length, offset)
+
+    def delete_file(self, key: str) -> None:
+        p = self._path(key)
+        if os.path.exists(p):
+            os.remove(p)
+
+    def object_size(self, key: str) -> int:
+        return os.path.getsize(self._path(key))
+
+
+class S3BackendStorage:
+    """Real S3 adapter — functional only where boto3 exists (not in this
+    image); the protocol and call sites are identical to DirBackendStorage
+    (reference: backend/s3_backend/s3_backend.go)."""
+
+    kind = "s3"
+
+    def __init__(self, name: str, bucket: str, region: str = "",
+                 endpoint: str = ""):
+        try:
+            import boto3  # noqa: F401
+        except ImportError:
+            raise RuntimeError(
+                "s3 backend requires boto3, which is not installed; "
+                "use the 'dir' backend or install boto3") from None
+        import boto3
+
+        self.name = name
+        self.bucket = bucket
+        self._s3 = boto3.client("s3", region_name=region or None,
+                                endpoint_url=endpoint or None)
+
+    def upload_file(self, local_path: str, key: str) -> int:
+        self._s3.upload_file(local_path, self.bucket, key)
+        return os.path.getsize(local_path)
+
+    def download_file(self, key: str, local_path: str) -> int:
+        self._s3.download_file(self.bucket, key, local_path)
+        return os.path.getsize(local_path)
+
+    def read_range(self, key: str, offset: int, length: int) -> bytes:
+        r = self._s3.get_object(Bucket=self.bucket, Key=key,
+                                Range=f"bytes={offset}-{offset + length - 1}")
+        return r["Body"].read()
+
+    def delete_file(self, key: str) -> None:
+        self._s3.delete_object(Bucket=self.bucket, Key=key)
+
+    def object_size(self, key: str) -> int:
+        return self._s3.head_object(Bucket=self.bucket, Key=key)["ContentLength"]
+
+
+class RemoteFile:
+    """Read-only BackendStorageFile over a tiered object: every read_at is
+    a ranged request to the backend (s3_backend.go S3BackendStorageFile).
+    Tiered volumes are read-only, so writes raise."""
+
+    def __init__(self, backend: BackendStorage, key: str,
+                 file_size: Optional[int] = None):
+        self.backend = backend
+        self.key = key
+        self._size = file_size if file_size is not None \
+            else backend.object_size(key)
+
+    def read_at(self, length: int, offset: int) -> bytes:
+        if offset >= self._size:
+            return b""
+        length = min(length, self._size - offset)
+        return self.backend.read_range(self.key, offset, length)
+
+    def write_at(self, data: bytes, offset: int) -> int:
+        raise PermissionError("tiered volume is read-only")
+
+    def truncate(self, size: int) -> None:
+        raise PermissionError("tiered volume is read-only")
+
+    def sync(self) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+    @property
+    def size(self) -> int:
+        return self._size
+
+
+_registry: dict[str, BackendStorage] = {}
+_registry_lock = threading.Lock()
+
+
+def register_backend(storage: BackendStorage) -> BackendStorage:
+    with _registry_lock:
+        _registry[storage.name] = storage
+    return storage
+
+
+def get_backend(name: str) -> BackendStorage:
+    with _registry_lock:
+        if name not in _registry:
+            raise KeyError(f"backend storage {name!r} not configured")
+        return _registry[name]
+
+
+def configure_backends(conf: dict) -> None:
+    """Build backends from config: {name: {"type": "dir", "root": ...}}."""
+    for name, spec in conf.items():
+        kind = spec.get("type", "dir")
+        if kind == "dir":
+            register_backend(DirBackendStorage(name, spec["root"]))
+        elif kind == "s3":
+            register_backend(S3BackendStorage(
+                name, spec["bucket"], spec.get("region", ""),
+                spec.get("endpoint", "")))
+        else:
+            raise ValueError(f"unknown backend type {kind!r}")

@@ -13,10 +13,13 @@ import os
 import time
 from typing import Callable, Optional
 
+from .backend import DiskFile, RemoteFile, get_backend
 from .needle import Needle, get_actual_size, needle_body_length
 from .needle_map import MemoryNeedleMap, NeedleValue
 from .super_block import SUPER_BLOCK_SIZE, ReplicaPlacement, SuperBlock
 from .ttl import TTL
+from .volume_info import (RemoteFileInfo, VolumeInfo, maybe_load_volume_info,
+                          save_volume_info, vif_path)
 from .types import (
     MAX_POSSIBLE_VOLUME_SIZE,
     NEEDLE_HEADER_SIZE,
@@ -51,6 +54,7 @@ class Volume:
                  version: Version = Version.V3,
                  volume_size_limit: int = 30 * 1000 * 1000 * 1000):
         self.directory = directory
+        os.makedirs(directory, exist_ok=True)
         self.collection = collection
         self.id = vid
         self.version = version
@@ -79,18 +83,30 @@ class Volume:
 
     # --- lifecycle ----------------------------------------------------
     def _load_or_create(self) -> None:
-        exists = os.path.exists(self.dat_path)
-        # unbuffered handle + pread-style reads: no stale read-buffer if the
-        # file is touched by another handle (EC tooling, replication copy)
-        self._dat = open(self.dat_path, "r+b" if exists else "w+b", buffering=0)
-        if exists and os.path.getsize(self.dat_path) >= SUPER_BLOCK_SIZE:
-            self.super_block = SuperBlock.from_bytes(
-                os.pread(self._dat.fileno(), SUPER_BLOCK_SIZE + 0xFFFF, 0))
-            self.version = self.super_block.version
+        # a `.vif` naming a remote file means the `.dat` lives in an object
+        # store (tiered volume, volume_info.go:84 + s3_backend.go): open it
+        # through the backend, read-only; the `.idx` always stays local
+        info = maybe_load_volume_info(self.file_prefix)
+        remote = info.remote_file if info else None
+        if remote is not None and not os.path.exists(self.dat_path):
+            self.tiered = True
+            self._dat = RemoteFile(get_backend(remote.backend_id),
+                                   remote.key, remote.file_size)
+            self.read_only = True
         else:
-            self._dat.write(self.super_block.to_bytes())
-            self._dat.flush()
-        self._check_integrity()
+            self.tiered = False
+            exists = os.path.exists(self.dat_path)
+            # unbuffered handle + pread-style reads: no stale read-buffer if
+            # the file is touched by another handle (EC tooling, replication)
+            self._dat = DiskFile(self.dat_path)
+            if not exists or self._dat.size < SUPER_BLOCK_SIZE:
+                self._dat.write_at(self.super_block.to_bytes(), 0)
+        if self._dat.size >= SUPER_BLOCK_SIZE:
+            self.super_block = SuperBlock.from_bytes(
+                self._dat.read_at(SUPER_BLOCK_SIZE + 0xFFFF, 0))
+            self.version = self.super_block.version
+        if not self.tiered:
+            self._check_integrity()
         self.nm = MemoryNeedleMap.load(self.idx_path)
 
     def _entry_is_healthy(self, key: int, offset: int, size: int, dat_size: int) -> bool:
@@ -100,7 +116,7 @@ class Volume:
         body = needle_body_length(size if size_is_valid(size) else 0, self.version)
         if offset + NEEDLE_HEADER_SIZE + body > dat_size:
             return False  # torn .dat tail: record truncated
-        header = os.pread(self._dat.fileno(), NEEDLE_HEADER_SIZE, offset)
+        header = self._dat.read_at(NEEDLE_HEADER_SIZE, offset)
         if len(header) < NEEDLE_HEADER_SIZE:
             return False
         n = Needle()
@@ -127,7 +143,7 @@ class Volume:
 
         from .idx import parse_entries
 
-        dat_size = os.fstat(self._dat.fileno()).st_size
+        dat_size = self._dat.size
         healthy_idx_size = idx_size
         last_healthy = None
         # walk the tail in blocks, newest entry first, vectorized parse
@@ -155,7 +171,7 @@ class Volume:
                 expected_end = offset + NEEDLE_HEADER_SIZE + body
                 if dat_size > expected_end:
                     # torn write past the last indexed needle: truncate
-                    os.ftruncate(self._dat.fileno(), expected_end)
+                    self._dat.truncate(expected_end)
         # NOTE: when no healthy entry remains (empty or fully-torn .idx) the
         # .dat is deliberately left untouched — it may hold recoverable
         # needles that a scan() pass can re-index (reference leaves .dat
@@ -165,11 +181,15 @@ class Volume:
         if self.nm is not None:
             self.nm.close()
         if self._dat is not None:
-            self._dat.flush()
+            self._dat.sync()
             self._dat.close()
             self._dat = None
 
     def destroy(self) -> None:
+        try:
+            self.tier_delete_remote()  # before the .vif (the only record
+        except Exception:              # of the remote key) is removed
+            pass
         self.close()
         for ext in (".dat", ".idx", ".vif", ".cpd", ".cpx", ".note"):
             p = self.file_prefix + ext
@@ -179,7 +199,7 @@ class Volume:
     # --- geometry -----------------------------------------------------
     @property
     def data_size(self) -> int:
-        return os.fstat(self._dat.fileno()).st_size
+        return self._dat.size
 
     @property
     def content_size(self) -> int:
@@ -194,11 +214,11 @@ class Volume:
         Truncates back on failure (needle_read_write.go:136-166)."""
         end = self.data_size
         try:
-            written = os.pwrite(self._dat.fileno(), blob, end)
+            written = self._dat.write_at(blob, end)
             if written != len(blob):
                 raise OSError(f"short write {written} != {len(blob)}")
         except OSError:
-            os.ftruncate(self._dat.fileno(), end)
+            self._dat.truncate(end)
             raise
         return end
 
@@ -252,7 +272,10 @@ class Volume:
             return 0
         size = nv.size
         n.data = b""
-        n.append_at_ns = time.time_ns()
+        # a replayed tombstone (tail/incremental backup) carries the source
+        # timestamp; restamping it would corrupt the follower's resume cursor
+        if not n.append_at_ns:
+            n.append_at_ns = time.time_ns()
         blob = n.to_bytes(self.version)
         offset = self._append_record(blob)
         self.last_append_at_ns = n.append_at_ns
@@ -261,7 +284,7 @@ class Volume:
 
     # --- read path (volume_read.go) ------------------------------------
     def _read_at(self, offset: int, length: int) -> bytes:
-        return os.pread(self._dat.fileno(), length, offset)
+        return self._dat.read_at(length, offset)
 
     def _read_needle_at(self, offset: int, size: int) -> Needle:
         blob = self._read_at(offset, get_actual_size(size, self.version))
@@ -395,6 +418,55 @@ class Volume:
             p = self.file_prefix + ext
             if os.path.exists(p):
                 os.remove(p)
+
+    # --- tiering (volume_grpc_tier_upload.go / _download.go) -------------
+    def tier_upload(self, backend_id: str, keep_local: bool = False) -> dict:
+        """Move the `.dat` into an object store: upload, record it in the
+        `.vif` sidecar, drop the local copy, and reopen tiered (read-only).
+        The `.idx`/needle map stay local so lookups remain in-memory."""
+        if self.tiered:
+            raise PermissionError(f"volume {self.id} is already tiered")
+        backend = get_backend(backend_id)
+        self._dat.sync()
+        # same naming scheme as local files ("5.dat" / "photos_5.dat") —
+        # volume ids are cluster-unique, and a collection named "default"
+        # must not collide with the empty collection
+        key = f"{self.collection}_{self.id}.dat" if self.collection \
+            else f"{self.id}.dat"
+        size = backend.upload_file(self.dat_path, key)
+        info = VolumeInfo(version=int(self.version), files=[RemoteFileInfo(
+            backend_type=backend.kind, backend_id=backend_id, key=key,
+            file_size=size, modified_time=int(time.time()))])
+        save_volume_info(self.file_prefix, info)
+        self.close()
+        if not keep_local:
+            os.remove(self.dat_path)
+        self._load_or_create()
+        if keep_local:
+            # both copies exist; freeze writes so the remote object (and
+            # the .vif's file_size) can never go stale vs the local .dat
+            self.read_only = True
+        return info.files[0].to_dict()
+
+    def tier_download(self) -> None:
+        """Bring a tiered `.dat` back to local disk and drop the sidecar."""
+        info = maybe_load_volume_info(self.file_prefix)
+        remote = info.remote_file if info else None
+        if remote is None:
+            raise FileNotFoundError(f"volume {self.id} is not tiered")
+        backend = get_backend(remote.backend_id)
+        self.close()
+        backend.download_file(remote.key, self.dat_path)
+        os.remove(vif_path(self.file_prefix))
+        self.read_only = False
+        self._load_or_create()
+
+    def tier_delete_remote(self) -> None:
+        """Delete the remote object after a tier.download (or on destroy)."""
+        info = maybe_load_volume_info(self.file_prefix)
+        remote = info.remote_file if info else None
+        if remote is not None:
+            get_backend(remote.backend_id).delete_file(remote.key)
 
     # --- info -----------------------------------------------------------
     def to_volume_information(self) -> dict:

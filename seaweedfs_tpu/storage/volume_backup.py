@@ -1,0 +1,155 @@
+"""Incremental volume sync: tail a volume's `.dat` by AppendAtNs.
+
+Equivalent of weed/storage/volume_backup.go — `BinarySearchByAppendAtNs`
+(:171) finds the first index entry whose needle was appended after a given
+timestamp (idx entries are in append order, so the timestamps they point at
+are non-decreasing), and `IncrementalBackup` (:66) streams every record
+from that point to EOF so a follower volume can catch up.  Records travel
+in the on-disk needle format — self-describing given the volume version —
+so the receiver appends them through the normal needle codec.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Iterator, Optional
+
+from .idx import parse_entries
+from .needle import Needle, get_actual_size, needle_body_length
+from .types import (NEEDLE_HEADER_SIZE, NEEDLE_MAP_ENTRY_SIZE,
+                    NEEDLE_PADDING_SIZE, size_is_valid)
+from .volume import Volume
+
+
+def _entry_append_at_ns(volume: Volume, offset: int, size: int) -> int:
+    """AppendAtNs of the record an idx entry points at (v3 carries it in
+    the needle tail; earlier versions report 0 = 'always include')."""
+    if offset == 0:
+        return 0
+    read_size = size if size_is_valid(size) else 0
+    blob = volume.read_needle_blob(offset, read_size)
+    n = Needle.from_bytes(blob, read_size, volume.version,
+                          verify_checksum=False)
+    return n.append_at_ns
+
+
+def binary_search_by_append_at_ns(volume: Volume,
+                                  since_ns: int) -> Optional[int]:
+    """First idx entry index whose needle has append_at_ns > since_ns, or
+    None when the volume has nothing newer (volume_backup.go:171-209).
+    Entries with offset==0 (never-written tombstones) carry no timestamp;
+    the search treats them as old (they sort with their neighbors in
+    append order anyway)."""
+    if not os.path.exists(volume.idx_path):
+        return None
+    with open(volume.idx_path, "rb") as f:
+        entries = parse_entries(f.read())
+    lo, hi = 0, len(entries)
+    while lo < hi:
+        mid = (lo + hi) // 2
+        # walk left over offset-0 entries to find a timestamped probe
+        probe = mid
+        ts = 0
+        while probe >= lo:
+            off = int(entries["offset"][probe]) * NEEDLE_PADDING_SIZE
+            if off != 0:
+                ts = _entry_append_at_ns(volume, off,
+                                         int(entries["size"][probe]))
+                break
+            probe -= 1
+        if ts > since_ns:
+            hi = probe if probe < mid else mid
+        else:
+            lo = mid + 1
+    return lo if lo < len(entries) else None
+
+
+def records_since(volume: Volume, since_ns: int,
+                  max_bytes: int = 64 * 1024 * 1024) -> tuple[bytes, int]:
+    """Concatenated raw needle records appended after since_ns, capped at
+    max_bytes per call; returns (blob, last_append_at_ns_sent). The caller
+    re-requests with the returned timestamp until the blob comes back
+    empty (IncrementalBackup's follow loop)."""
+    start = binary_search_by_append_at_ns(volume, since_ns)
+    if start is None:
+        return b"", since_ns
+    with open(volume.idx_path, "rb") as f:
+        f.seek(start * NEEDLE_MAP_ENTRY_SIZE)
+        entries = parse_entries(f.read())
+    out = bytearray()
+    last_ts = since_ns
+    for i in range(len(entries)):
+        offset = int(entries["offset"][i]) * NEEDLE_PADDING_SIZE
+        size = int(entries["size"][i])
+        if offset == 0:
+            continue
+        read_size = size if size_is_valid(size) else 0
+        blob = volume.read_needle_blob(offset, read_size)
+        n = Needle.from_bytes(blob, read_size, volume.version,
+                              verify_checksum=False)
+        if n.append_at_ns <= since_ns:
+            continue
+        if out and len(out) + len(blob) > max_bytes:
+            break
+        out += blob
+        last_ts = n.append_at_ns
+    return bytes(out), last_ts
+
+
+def iter_records(blob: bytes, version) -> Iterator[Needle]:
+    """Parse a records_since() blob back into needles (receiver side)."""
+    offset = 0
+    while offset + NEEDLE_HEADER_SIZE <= len(blob):
+        n = Needle()
+        n.parse_header(blob[offset:offset + NEEDLE_HEADER_SIZE])
+        size = n.size if size_is_valid(n.size) else 0
+        body_len = needle_body_length(size, version)
+        end = offset + NEEDLE_HEADER_SIZE + body_len
+        if end > len(blob):
+            break
+        n.read_body_bytes(blob[offset + NEEDLE_HEADER_SIZE:end], version)
+        yield n
+        offset = end
+
+
+def apply_records(volume: Volume, blob: bytes) -> int:
+    """Append tailed records into a follower volume: live needles are
+    re-written, zero-data records replay as deletes. Returns count."""
+    count = 0
+    for n in iter_records(blob, volume.version):
+        if n.size > 0:
+            volume.write_needle(n, check_cookie=False)
+        else:
+            # zero-size record = tombstone replay (volume_backup.go applies
+            # them as deletes on the follower)
+            volume.delete_needle(n)
+        count += 1
+    return count
+
+
+def last_appended_ns(volume: Volume) -> int:
+    """AppendAtNs of the newest record in the volume, derived from the
+    index tail (so a freshly reopened follower can resume where it left
+    off — volume.last_append_at_ns only tracks the live process)."""
+    if not os.path.exists(volume.idx_path):
+        return 0
+    with open(volume.idx_path, "rb") as f:
+        entries = parse_entries(f.read())
+    for i in range(len(entries) - 1, -1, -1):
+        off = int(entries["offset"][i]) * NEEDLE_PADDING_SIZE
+        if off != 0:
+            return _entry_append_at_ns(volume, off, int(entries["size"][i]))
+    return 0
+
+
+def incremental_backup(follower: Volume, fetch) -> int:
+    """Pull loop: fetch(since_ns) -> (blob, last_ts) repeatedly until no
+    new records; returns total records applied (volume_backup.go:66)."""
+    total = 0
+    since = max(follower.last_append_at_ns, last_appended_ns(follower))
+    while True:
+        blob, last_ts = fetch(since)
+        if not blob:
+            return total
+        total += apply_records(follower, blob)
+        since = last_ts

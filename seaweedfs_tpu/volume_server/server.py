@@ -54,9 +54,14 @@ class VolumeServer:
                  public_url: str = "", data_center: str = "",
                  rack: str = "", max_volume_count: int = 8,
                  pulse_seconds: float = 5.0, ec_engine: str = "cpu",
-                 guard: Optional["Guard"] = None):
+                 guard: Optional["Guard"] = None,
+                 backends: Optional[dict] = None):
         from ..security import Guard
 
+        if backends:
+            from ..storage.backend import configure_backends
+
+            configure_backends(backends)
         self.master_url = master_url
         self.data_center = data_center
         self.rack = rack
@@ -503,6 +508,73 @@ class VolumeServer:
                           {"fids": fids, "replicate": True,
                            "jwts": {f: jwts[f] for f in fids if f in jwts}})
             return Response({"results": results})
+
+        @r.route("GET", "/admin/tail")
+        def tail(req: Request) -> Response:
+            """VolumeIncrementalCopy / VolumeTailSender: raw needle records
+            appended after ?since_ns (volume_backup.go:66, the follower
+            re-requests with the returned X-Last-Append-At-Ns until empty)."""
+            from ..storage.volume_backup import records_since
+
+            vid = int(req.query["volume_id"])
+            since_ns = int(req.query.get("since_ns", 0))
+            try:
+                v = self.store.get_volume(vid)
+            except KeyError:
+                raise HttpError(404, f"volume {vid} not found")
+            blob, last_ts = records_since(
+                v, since_ns,
+                max_bytes=int(req.query.get("max_bytes", 64 << 20)))
+            return Response(raw=blob, headers={
+                "X-Last-Append-At-Ns": str(last_ts),
+                "X-Volume-Version": str(int(v.version))})
+
+        @r.route("POST", "/admin/tier_upload")
+        def tier_upload(req: Request) -> Response:
+            """VolumeTierMoveDatToRemote (volume_grpc_tier_upload.go)."""
+            b = req.json()
+            vid = int(b["volume_id"])
+            try:
+                v = self.store.get_volume(vid)
+            except KeyError:
+                raise HttpError(404, f"volume {vid} not found")
+            with self.store.volume_locks[vid]:
+                remote = v.tier_upload(b["backend"],
+                                       keep_local=bool(b.get("keep_local")))
+            return Response({"remote": remote})
+
+        @r.route("POST", "/admin/tier_download")
+        def tier_download(req: Request) -> Response:
+            """VolumeTierMoveDatFromRemote (volume_grpc_tier_download.go)."""
+            vid = int(req.json()["volume_id"])
+            try:
+                v = self.store.get_volume(vid)
+            except KeyError:
+                raise HttpError(404, f"volume {vid} not found")
+            with self.store.volume_locks[vid]:
+                v.tier_download()
+            return Response({})
+
+        @r.route("POST", "/query")
+        def query(req: Request) -> Response:
+            """Query RPC (volume_grpc_query.go): filter + project stored
+            JSON/CSV objects server-side; body carries from_file_ids,
+            selection, filter, and input serialization."""
+            from ..query import execute_query
+
+            b = req.json()
+            rows = []
+            for fid_str in b.get("from_file_ids", []):
+                fid = FileId.parse(fid_str)
+                try:
+                    n = self.store.read_needle(fid.volume_id, fid.key,
+                                               fid.cookie)
+                except Exception as e:
+                    raise HttpError(404, f"{fid_str}: {e}")
+                rows.extend(execute_query(
+                    n.data, b.get("selections"), b.get("filter"),
+                    b.get("input_format", "json")))
+            return Response({"rows": rows})
 
         @r.route("POST", "/admin/volume_check")
         def volume_check(req: Request) -> Response:

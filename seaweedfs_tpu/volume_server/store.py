@@ -48,10 +48,15 @@ class DiskLocation:
 
     def discover_volumes(self) -> list[tuple[str, int]]:
         found = []
-        for path in glob.glob(os.path.join(self.directory, "*.dat")):
-            name = os.path.basename(path)[:-4]
-            if re.fullmatch(r"(?:[\w.-]+_)?\d+", name):
-                found.append(parse_volume_file_name(name))
+        # a tiered volume has no local .dat — only .vif + .idx — so both
+        # extensions mark a volume (disk_location.go loads .vif'd volumes)
+        for ext in ("*.dat", "*.vif"):
+            for path in glob.glob(os.path.join(self.directory, ext)):
+                name = os.path.basename(path)[:-4]
+                if re.fullmatch(r"(?:[\w.-]+_)?\d+", name):
+                    parsed = parse_volume_file_name(name)
+                    if parsed not in found:
+                        found.append(parsed)
         return found
 
     def discover_ec_volumes(self) -> list[tuple[str, int]]:
@@ -167,7 +172,7 @@ class Store:
         with self.volume_locks[vid]:
             _, size, unchanged = v.write_needle(n)
             if fsync:
-                os.fsync(v._dat.fileno())
+                v._dat.sync()
         return size, unchanged
 
     def delete_needle(self, vid: int, n: Needle) -> int:

@@ -1,0 +1,224 @@
+"""Volume server extras: tiering (.vif + backends), tail/incremental
+backup, and the query engine.
+
+Covers weed/storage/backend (tiered .dat), volume_info (.vif sidecar),
+volume_backup.go (BinarySearchByAppendAtNs + IncrementalBackup), and
+weed/query (Query RPC semantics).
+"""
+
+import json
+import os
+import time
+
+import pytest
+
+from seaweedfs_tpu.storage.backend import (DirBackendStorage, get_backend,
+                                           register_backend)
+from seaweedfs_tpu.storage.needle import Needle
+from seaweedfs_tpu.storage.volume import Volume
+from seaweedfs_tpu.storage.volume_backup import (apply_records,
+                                                 incremental_backup,
+                                                 last_appended_ns,
+                                                 records_since)
+from seaweedfs_tpu.storage.volume_info import maybe_load_volume_info
+
+
+def fill(v: Volume, n: int, start: int = 1, prefix: bytes = b"data-"):
+    for i in range(start, start + n):
+        v.write_needle(Needle(cookie=0xABC0 + i, id=i,
+                              data=prefix + str(i).encode()))
+
+
+class TestTiering:
+    def test_tier_upload_read_download(self, tmp_path):
+        register_backend(DirBackendStorage("cloud1", str(tmp_path / "cloud")))
+        v = Volume(str(tmp_path / "v"), "", 7)
+        fill(v, 20)
+        assert not v.tiered
+        remote = v.tier_upload("cloud1")
+        # local .dat gone, .vif present, volume reopened tiered + read-only
+        assert not os.path.exists(v.dat_path)
+        assert maybe_load_volume_info(v.file_prefix).remote_file.key == \
+            remote["key"]
+        assert v.tiered and v.read_only
+        # reads go through ranged requests against the backend
+        n = v.read_needle(5)
+        assert n.data == b"data-5"
+        # writes refuse
+        with pytest.raises(PermissionError):
+            v.write_needle(Needle(cookie=1, id=99, data=b"x"))
+        # a fresh open (new process) also comes up tiered
+        v.close()
+        v2 = Volume(str(tmp_path / "v"), "", 7)
+        assert v2.tiered
+        assert v2.read_needle(17).data == b"data-17"
+        # bring it back local
+        v2.tier_download()
+        assert not v2.tiered and os.path.exists(v2.dat_path)
+        assert v2.read_needle(5).data == b"data-5"
+        v2.write_needle(Needle(cookie=1, id=99, data=b"writable again"))
+        v2.close()
+
+    def test_double_tier_upload_rejected(self, tmp_path):
+        register_backend(DirBackendStorage("cloud2", str(tmp_path / "c2")))
+        v = Volume(str(tmp_path / "v"), "", 8)
+        fill(v, 3)
+        v.tier_upload("cloud2")
+        with pytest.raises(PermissionError):
+            v.tier_upload("cloud2")
+        v.close()
+
+    def test_unknown_backend(self, tmp_path):
+        v = Volume(str(tmp_path / "v"), "", 9)
+        fill(v, 1)
+        with pytest.raises(KeyError):
+            v.tier_upload("nope")
+        v.close()
+
+
+class TestTail:
+    def test_records_since_and_binary_search(self, tmp_path):
+        v = Volume(str(tmp_path / "v"), "", 3)
+        fill(v, 10)
+        t_mid = time.time_ns()
+        time.sleep(0.002)
+        fill(v, 5, start=11)
+        blob, last_ts = records_since(v, t_mid)
+        follower = Volume(str(tmp_path / "f"), "", 3)
+        assert apply_records(follower, blob) == 5
+        for i in range(11, 16):
+            assert follower.read_needle(i).data == v.read_needle(i).data
+        with pytest.raises(KeyError):
+            follower.read_needle(1)  # older records not shipped
+        assert last_ts == v.last_append_at_ns
+        # nothing newer -> empty
+        blob2, _ = records_since(v, last_ts)
+        assert blob2 == b""
+        v.close()
+        follower.close()
+
+    def test_incremental_backup_with_deletes_and_resume(self, tmp_path):
+        v = Volume(str(tmp_path / "v"), "", 4)
+        follower = Volume(str(tmp_path / "f"), "", 4)
+
+        def fetch(since_ns):
+            return records_since(v, since_ns)
+
+        fill(v, 8)
+        assert incremental_backup(follower, fetch) == 8
+        v.delete_needle(Needle(id=3))
+        fill(v, 2, start=9)
+        # reopen follower (fresh process): resume point derived from idx
+        follower.close()
+        follower = Volume(str(tmp_path / "f"), "", 4)
+        assert last_appended_ns(follower) > 0
+        assert incremental_backup(follower, fetch) == 3
+        with pytest.raises(KeyError):
+            follower.read_needle(3)  # tombstone replayed
+        assert follower.read_needle(10).data == b"data-10"
+        v.close()
+        follower.close()
+
+
+class TestQuery:
+    def test_json_select_where(self):
+        from seaweedfs_tpu.query import execute_query
+
+        data = json.dumps([
+            {"name": "a", "meta": {"size": 10}},
+            {"name": "b", "meta": {"size": 25}},
+            {"name": "c", "meta": {"size": 31}},
+        ]).encode()
+        rows = execute_query(data, select=["name"],
+                             filt={"field": "meta.size", "operand": ">",
+                                   "value": 20})
+        assert rows == [{"name": "b"}, {"name": "c"}]
+
+    def test_jsonl_and_prefix(self):
+        from seaweedfs_tpu.query import execute_query
+
+        data = b'{"k": "apple"}\n{"k": "apricot"}\n{"k": "banana"}\n'
+        rows = execute_query(data, filt={"field": "k", "operand": "prefix",
+                                         "value": "ap"},
+                             input_format="jsonl")
+        assert [r["k"] for r in rows] == ["apple", "apricot"]
+
+    def test_csv(self):
+        from seaweedfs_tpu.query import execute_query
+
+        data = b"name,qty\nbolt,4\nnut,9\n"
+        rows = execute_query(data, select=["qty"],
+                             filt={"field": "name", "operand": "=",
+                                   "value": "nut"},
+                             input_format="csv")
+        assert rows == [{"qty": "9"}]
+
+    def test_query_endpoint(self, tmp_path):
+        import time as _t
+
+        from seaweedfs_tpu.client.operation import WeedClient
+        from seaweedfs_tpu.master.server import MasterServer
+        from seaweedfs_tpu.utils.httpd import http_json
+        from seaweedfs_tpu.volume_server.server import VolumeServer
+        from tests.conftest import free_port
+
+        m = MasterServer(port=free_port()).start()
+        vs = VolumeServer([str(tmp_path / "v")], m.url,
+                          port=free_port()).start()
+        try:
+            deadline = _t.time() + 5
+            while _t.time() < deadline:
+                if http_json("GET", f"http://{m.url}/dir/status")[
+                        "Topology"]["Max"] > 0:
+                    break
+                _t.sleep(0.05)
+            c = WeedClient(m.url)
+            fid = c.upload(json.dumps(
+                {"user": "zoe", "score": 41}).encode())
+            r = http_json("POST", f"http://{vs.url}/query", {
+                "from_file_ids": [fid],
+                "selections": ["user"],
+                "filter": {"field": "score", "operand": ">=", "value": 40},
+            })
+            assert r["rows"] == [{"user": "zoe"}]
+        finally:
+            vs.stop()
+            m.stop()
+
+
+class TestTierEndpoint:
+    def test_tier_upload_download_via_http(self, tmp_path):
+        import time as _t
+
+        from seaweedfs_tpu.client.operation import WeedClient
+        from seaweedfs_tpu.master.server import MasterServer
+        from seaweedfs_tpu.utils.httpd import http_json
+        from seaweedfs_tpu.volume_server.server import VolumeServer
+        from tests.conftest import free_port
+
+        m = MasterServer(port=free_port()).start()
+        vs = VolumeServer(
+            [str(tmp_path / "v")], m.url, port=free_port(),
+            backends={"cloudX": {"type": "dir",
+                                 "root": str(tmp_path / "remote")}}).start()
+        try:
+            deadline = _t.time() + 5
+            while _t.time() < deadline:
+                if http_json("GET", f"http://{m.url}/dir/status")[
+                        "Topology"]["Max"] > 0:
+                    break
+                _t.sleep(0.05)
+            c = WeedClient(m.url)
+            fid = c.upload(b"tier me out")
+            vid = int(fid.split(",")[0])
+            r = http_json("POST", f"http://{vs.url}/admin/tier_upload",
+                          {"volume_id": vid, "backend": "cloudX"})
+            assert r["remote"]["backend_id"] == "cloudX"
+            # reads still served (through the backend)
+            assert c.download(fid) == b"tier me out"
+            http_json("POST", f"http://{vs.url}/admin/tier_download",
+                      {"volume_id": vid})
+            assert c.download(fid) == b"tier me out"
+        finally:
+            vs.stop()
+            m.stop()

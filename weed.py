@@ -86,6 +86,45 @@ def cmd_server(args) -> None:
     _wait_forever()
 
 
+def cmd_backup(args) -> None:
+    """Volume-level incremental backup to local disk (command/backup.go):
+    tail the remote volume by AppendAtNs into a local follower volume."""
+    from seaweedfs_tpu.client.operation import MasterClient
+    from seaweedfs_tpu.storage.volume import Volume
+    from seaweedfs_tpu.storage.volume_backup import incremental_backup
+    from seaweedfs_tpu.utils.httpd import HttpError, http_bytes
+
+    from seaweedfs_tpu.storage.types import Version
+    from seaweedfs_tpu.utils.httpd import http_json
+
+    vid = args.volumeId
+    urls = MasterClient(args.master).lookup(vid)
+    if not urls:
+        raise SystemExit(f"volume {vid} has no locations")
+    src = urls[0]
+    # the follower must use the source's on-disk version: tail records are
+    # raw needle bytes in that framing
+    src_version = next(
+        (int(v["version"]) for v in http_json(
+            "GET", f"http://{src}/status").get("Volumes", [])
+         if int(v["id"]) == vid), 3)
+    follower = Volume(args.dir, args.collection, vid,
+                      version=Version(src_version))
+
+    def fetch(since_ns: int):
+        status, body, headers = http_bytes(
+            "GET", f"http://{src}/admin/tail?volume_id={vid}"
+            f"&since_ns={since_ns}")
+        if status != 200:
+            raise HttpError(status, body.decode(errors="replace"))
+        return body, int(headers.get("X-Last-Append-At-Ns", since_ns))
+
+    applied = incremental_backup(follower, fetch)
+    follower.close()
+    print(f"volume {vid}: applied {applied} records from {src} "
+          f"into {args.dir}")
+
+
 def cmd_shell(args) -> None:
     from seaweedfs_tpu.shell import CommandEnv, repl, run_command
 
@@ -232,6 +271,13 @@ def main(argv=None) -> None:
     fl.add_argument("-s3", action="store_true")
     fl.add_argument("-s3.port", dest="s3_port", type=int, default=8333)
     fl.set_defaults(fn=cmd_filer)
+
+    bk = sub.add_parser("backup")
+    bk.add_argument("-master", default="127.0.0.1:9333")
+    bk.add_argument("-volumeId", type=int, required=True)
+    bk.add_argument("-dir", default=".")
+    bk.add_argument("-collection", default="")
+    bk.set_defaults(fn=cmd_backup)
 
     sh = sub.add_parser("shell")
     sh.add_argument("-master", default="127.0.0.1:9333")
