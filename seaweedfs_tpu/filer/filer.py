@@ -204,10 +204,14 @@ class Filer:
         while not self._stop.is_set():
             self._gc_event.wait(1.0)
             self._gc_event.clear()
-            with self._lock:
-                batch, self._gc_queue = self._gc_queue[:1000], self._gc_queue[1000:]
-            if batch and self._delete_chunks_fn is not None:
-                with self._gc_busy:
+            # pop the batch only while holding _gc_busy, so flush_gc's
+            # barrier can never observe an empty queue while a popped batch
+            # is still waiting to be deleted
+            with self._gc_busy:
+                with self._lock:
+                    batch, self._gc_queue = \
+                        self._gc_queue[:1000], self._gc_queue[1000:]
+                if batch and self._delete_chunks_fn is not None:
                     try:
                         self._delete_chunks_fn(batch)
                     except Exception:

@@ -94,6 +94,11 @@ class Volume:
                                    remote.key, remote.file_size)
             self.read_only = True
         else:
+            if remote is not None:
+                # tier_upload(keep_local=True) survivor: both copies exist,
+                # so writes stay frozen across restarts or the local .dat
+                # would silently diverge from the remote object
+                self.read_only = True
             self.tiered = False
             exists = os.path.exists(self.dat_path)
             # unbuffered handle + pread-style reads: no stale read-buffer if
@@ -284,7 +289,21 @@ class Volume:
 
     # --- read path (volume_read.go) ------------------------------------
     def _read_at(self, offset: int, length: int) -> bytes:
-        return self._dat.read_at(length, offset)
+        # tier transitions (and compaction commit) close + reopen the .dat
+        # under the store's volume lock while readers run lock-free; retry
+        # briefly through the swap window instead of surfacing a spurious
+        # error for a read that will succeed against the new handle
+        deadline = time.monotonic() + 2.0
+        while True:
+            dat = self._dat
+            try:
+                if dat is None:
+                    raise ValueError("volume handle mid-swap")
+                return dat.read_at(length, offset)
+            except ValueError:
+                if time.monotonic() >= deadline:
+                    raise
+                time.sleep(0.01)
 
     def _read_needle_at(self, offset: int, size: int) -> Needle:
         blob = self._read_at(offset, get_actual_size(size, self.version))
@@ -457,12 +476,19 @@ class Volume:
         backend = get_backend(remote.backend_id)
         self.close()
         backend.download_file(remote.key, self.dat_path)
+        # the remote object is deleted while the .vif still records it —
+        # removing the .vif first would orphan the (billed) remote copy
+        # forever, since the key exists nowhere else
+        try:
+            backend.delete_file(remote.key)
+        except Exception:
+            pass  # remote copy stays; .vif removal below still un-tiers
         os.remove(vif_path(self.file_prefix))
         self.read_only = False
         self._load_or_create()
 
     def tier_delete_remote(self) -> None:
-        """Delete the remote object after a tier.download (or on destroy)."""
+        """Delete the remote object for a still-tiered volume (destroy)."""
         info = maybe_load_volume_info(self.file_prefix)
         remote = info.remote_file if info else None
         if remote is not None:

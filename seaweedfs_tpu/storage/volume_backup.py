@@ -15,35 +15,38 @@ import os
 from typing import Iterator, Optional
 
 from .idx import parse_entries
-from .needle import Needle, get_actual_size, needle_body_length
-from .types import (NEEDLE_HEADER_SIZE, NEEDLE_MAP_ENTRY_SIZE,
-                    NEEDLE_PADDING_SIZE, size_is_valid)
+from .needle import Needle, needle_body_length
+from .types import (NEEDLE_CHECKSUM_SIZE, NEEDLE_HEADER_SIZE,
+                    NEEDLE_PADDING_SIZE, TIMESTAMP_SIZE, Version,
+                    bytes_to_u64, size_is_valid)
 from .volume import Volume
 
 
 def _entry_append_at_ns(volume: Volume, offset: int, size: int) -> int:
     """AppendAtNs of the record an idx entry points at (v3 carries it in
-    the needle tail; earlier versions report 0 = 'always include')."""
-    if offset == 0:
+    the needle tail; earlier versions report 0 = 'always include').
+    Reads only the 8-byte timestamp, not the needle body — the binary
+    search probes large needles and must not pull their data off disk."""
+    if offset == 0 or volume.version < Version.V3:
         return 0
     read_size = size if size_is_valid(size) else 0
-    blob = volume.read_needle_blob(offset, read_size)
-    n = Needle.from_bytes(blob, read_size, volume.version,
-                          verify_checksum=False)
-    return n.append_at_ns
+    ts_pos = offset + NEEDLE_HEADER_SIZE + read_size + NEEDLE_CHECKSUM_SIZE
+    tail = volume._read_at(ts_pos, TIMESTAMP_SIZE)
+    return bytes_to_u64(tail) if len(tail) == TIMESTAMP_SIZE else 0
 
 
-def binary_search_by_append_at_ns(volume: Volume,
-                                  since_ns: int) -> Optional[int]:
+def binary_search_by_append_at_ns(volume: Volume, since_ns: int,
+                                  entries=None) -> Optional[int]:
     """First idx entry index whose needle has append_at_ns > since_ns, or
     None when the volume has nothing newer (volume_backup.go:171-209).
     Entries with offset==0 (never-written tombstones) carry no timestamp;
     the search treats them as old (they sort with their neighbors in
     append order anyway)."""
-    if not os.path.exists(volume.idx_path):
-        return None
-    with open(volume.idx_path, "rb") as f:
-        entries = parse_entries(f.read())
+    if entries is None:
+        if not os.path.exists(volume.idx_path):
+            return None
+        with open(volume.idx_path, "rb") as f:
+            entries = parse_entries(f.read())
     lo, hi = 0, len(entries)
     while lo < hi:
         mid = (lo + hi) // 2
@@ -70,12 +73,14 @@ def records_since(volume: Volume, since_ns: int,
     max_bytes per call; returns (blob, last_append_at_ns_sent). The caller
     re-requests with the returned timestamp until the blob comes back
     empty (IncrementalBackup's follow loop)."""
-    start = binary_search_by_append_at_ns(volume, since_ns)
-    if start is None:
+    if not os.path.exists(volume.idx_path):
         return b"", since_ns
     with open(volume.idx_path, "rb") as f:
-        f.seek(start * NEEDLE_MAP_ENTRY_SIZE)
-        entries = parse_entries(f.read())
+        all_entries = parse_entries(f.read())
+    start = binary_search_by_append_at_ns(volume, since_ns, all_entries)
+    if start is None:
+        return b"", since_ns
+    entries = all_entries[start:]
     out = bytearray()
     last_ts = since_ns
     for i in range(len(entries)):

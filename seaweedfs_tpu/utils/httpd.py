@@ -87,8 +87,9 @@ class Router:
             match = pattern.match(path)
             if match:
                 t0 = _time.perf_counter()
+                req = Request(handler, match)
                 try:
-                    resp = fn(Request(handler, match))
+                    resp = fn(req)
                 except HttpError as e:
                     resp = Response({"error": e.message or str(e)}, status=e.status)
                 except (KeyError, LookupError) as e:
@@ -99,6 +100,21 @@ class Router:
                     self.metrics.request_counter.inc(fn.__name__)
                     self.metrics.request_histogram.observe(
                         fn.__name__, _time.perf_counter() - t0)
+                # drain any unread request body first: responding while the
+                # client is still mid-upload resets the connection and the
+                # client never sees the (often 4xx) status. Discard in
+                # bounded chunks — never buffer a rejected upload.
+                try:
+                    if req._body is None:
+                        left = int(handler.headers.get("Content-Length") or 0)
+                        while left > 0:
+                            n = len(handler.rfile.read(min(left, 1 << 16)) or b"")
+                            if n == 0:
+                                break
+                            left -= n
+                        req._body = b""
+                except Exception:
+                    pass
                 self._send(handler, resp)
                 return
         self._send(handler, Response({"error": f"no route {method} {path}"}, status=404))
@@ -128,6 +144,12 @@ class Router:
             pass
 
 
+# the extra verbs beyond the big five are the WebDAV set (RFC 4918) used by
+# the webdav gateway; BaseHTTPRequestHandler dispatches by do_<METHOD> name
+EXTRA_METHODS = ("OPTIONS", "PROPFIND", "PROPPATCH", "MKCOL", "MOVE", "COPY",
+                 "LOCK", "UNLOCK")
+
+
 def serve(router: Router, host: str, port: int) -> ThreadingHTTPServer:
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
@@ -149,6 +171,10 @@ def serve(router: Router, host: str, port: int) -> ThreadingHTTPServer:
 
         def do_DELETE(self):
             router.dispatch(self, "DELETE")
+
+    for _m in EXTRA_METHODS:
+        setattr(Handler, f"do_{_m}",
+                (lambda m: lambda self: router.dispatch(self, m))(_m))
 
     server = ThreadingHTTPServer((host, port), Handler)
     server.daemon_threads = True

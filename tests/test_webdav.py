@@ -1,0 +1,154 @@
+"""WebDAV gateway tests: RFC 4918 verbs over a live mini-cluster."""
+
+from __future__ import annotations
+
+import time
+import xml.etree.ElementTree as ET
+
+import pytest
+
+from seaweedfs_tpu.filer.filer_store import MemoryStore
+from seaweedfs_tpu.filer.server import FilerServer
+from seaweedfs_tpu.gateway.webdav import WebDavServer
+from seaweedfs_tpu.master.server import MasterServer
+from seaweedfs_tpu.utils.httpd import http_bytes
+from seaweedfs_tpu.volume_server.server import VolumeServer
+
+from tests.conftest import free_port  # noqa: E402
+
+DAV = "{DAV:}"
+
+
+@pytest.fixture
+def dav_stack(tmp_path):
+    master = MasterServer(port=free_port(), pulse_seconds=0.4).start()
+    d = tmp_path / "vs0"
+    d.mkdir()
+    vol = VolumeServer([str(d)], master.url, port=free_port(),
+                       pulse_seconds=0.4).start()
+    deadline = time.time() + 5
+    while time.time() < deadline and not master.topo.all_nodes():
+        time.sleep(0.05)
+    filer = FilerServer(master.url, MemoryStore(), port=free_port(),
+                        max_chunk_mb=1).start()
+    dav = WebDavServer(filer, port=free_port()).start()
+    yield dav
+    dav.stop()
+    filer.stop()
+    vol.stop()
+    master.stop()
+
+
+def _url(dav, path):
+    return f"http://{dav.url}{path}"
+
+
+def test_options_advertises_dav(dav_stack):
+    status, _, headers = http_bytes("OPTIONS", _url(dav_stack, "/"))
+    assert status == 200
+    assert "1, 2" in headers["DAV"]
+    assert "PROPFIND" in headers["Allow"]
+
+
+def test_put_get_roundtrip_and_propfind(dav_stack):
+    payload = b"x" * (3 * 1024 * 1024 + 17)  # multi-chunk
+    status, _, _ = http_bytes("PUT", _url(dav_stack, "/docs/a.bin"), payload)
+    assert status == 409  # parent missing: RFC 4918 9.7.1
+    status, _, _ = http_bytes("MKCOL", _url(dav_stack, "/docs"))
+    assert status == 201
+    status, _, _ = http_bytes(
+        "PUT", _url(dav_stack, "/docs/a.bin"), payload,
+        headers={"Content-Type": "application/x-test"})
+    assert status == 201
+    status, body, headers = http_bytes("GET", _url(dav_stack, "/docs/a.bin"))
+    assert status == 200 and body == payload
+    assert headers["Content-Type"] == "application/x-test"
+
+    status, body, _ = http_bytes(
+        "PROPFIND", _url(dav_stack, "/docs"), headers={"Depth": "1"})
+    assert status == 207
+    ms = ET.fromstring(body)
+    hrefs = [e.text for e in ms.iter(f"{DAV}href")]
+    assert "/docs/" in hrefs and "/docs/a.bin" in hrefs
+    size = next(e.text for e in ms.iter(f"{DAV}getcontentlength"))
+    assert int(size) == len(payload)
+    # the collection itself carries <collection/> resourcetype
+    assert any(rt.find(f"{DAV}collection") is not None
+               for rt in ms.iter(f"{DAV}resourcetype"))
+
+
+def test_propfind_depth_zero(dav_stack):
+    http_bytes("MKCOL", _url(dav_stack, "/d0"))
+    http_bytes("PUT", _url(dav_stack, "/d0/f.txt"), b"hi")
+    status, body, _ = http_bytes(
+        "PROPFIND", _url(dav_stack, "/d0"), headers={"Depth": "0"})
+    ms = ET.fromstring(body)
+    assert len(list(ms.iter(f"{DAV}response"))) == 1
+
+
+def test_move_and_copy(dav_stack):
+    http_bytes("MKCOL", _url(dav_stack, "/src"))
+    http_bytes("PUT", _url(dav_stack, "/src/f.txt"), b"hello webdav")
+    base = f"http://{dav_stack.url}"
+
+    status, _, _ = http_bytes(
+        "COPY", _url(dav_stack, "/src/f.txt"),
+        headers={"Destination": f"{base}/src/copy.txt"})
+    assert status == 201
+    _, body, _ = http_bytes("GET", _url(dav_stack, "/src/copy.txt"))
+    assert body == b"hello webdav"
+    # source intact after COPY
+    assert http_bytes("GET", _url(dav_stack, "/src/f.txt"))[0] == 200
+
+    status, _, _ = http_bytes(
+        "MOVE", _url(dav_stack, "/src/f.txt"),
+        headers={"Destination": f"{base}/src/moved.txt"})
+    assert status == 201
+    assert http_bytes("GET", _url(dav_stack, "/src/f.txt"))[0] == 404
+    assert http_bytes("GET", _url(dav_stack, "/src/moved.txt"))[1] == b"hello webdav"
+
+    # Overwrite: F refuses to clobber
+    status, _, _ = http_bytes(
+        "MOVE", _url(dav_stack, "/src/moved.txt"),
+        headers={"Destination": f"{base}/src/copy.txt", "Overwrite": "F"})
+    assert status == 412
+
+
+def test_delete_collection_recursive(dav_stack):
+    http_bytes("MKCOL", _url(dav_stack, "/tree"))
+    http_bytes("PUT", _url(dav_stack, "/tree/a"), b"1")
+    http_bytes("PUT", _url(dav_stack, "/tree/b"), b"2")
+    status, _, _ = http_bytes("DELETE", _url(dav_stack, "/tree"))
+    assert status == 204
+    assert http_bytes("GET", _url(dav_stack, "/tree"))[0] == 404
+
+
+def test_lock_unlock_cycle(dav_stack):
+    http_bytes("MKCOL", _url(dav_stack, "/lk"))
+    http_bytes("PUT", _url(dav_stack, "/lk/f"), b"v1")
+    status, body, headers = http_bytes("LOCK", _url(dav_stack, "/lk/f"))
+    assert status == 200
+    token = headers["Lock-Token"].strip("<>")
+    assert token.startswith("opaquelocktoken:")
+
+    # writes without the token are refused
+    status, _, _ = http_bytes("PUT", _url(dav_stack, "/lk/f"), b"v2")
+    assert status == 423
+    # with the token in If, the write goes through
+    status, _, _ = http_bytes("PUT", _url(dav_stack, "/lk/f"), b"v2",
+                              headers={"If": f"(<{token}>)"})
+    assert status == 204
+    assert http_bytes("GET", _url(dav_stack, "/lk/f"))[1] == b"v2"
+
+    status, _, _ = http_bytes("UNLOCK", _url(dav_stack, "/lk/f"),
+                              headers={"Lock-Token": f"<{token}>"})
+    assert status == 204
+    # lock gone: plain writes work again
+    status, _, _ = http_bytes("PUT", _url(dav_stack, "/lk/f"), b"v3")
+    assert status == 204
+
+
+def test_mkcol_conflicts(dav_stack):
+    assert http_bytes("MKCOL", _url(dav_stack, "/a/b/c"))[0] == 409
+    http_bytes("MKCOL", _url(dav_stack, "/a"))
+    assert http_bytes("MKCOL", _url(dav_stack, "/a"))[0] == 405

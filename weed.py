@@ -51,6 +51,7 @@ def cmd_filer(args) -> None:
     from seaweedfs_tpu.filer.filer_store import SqliteStore
     from seaweedfs_tpu.filer.server import FilerServer
     from seaweedfs_tpu.gateway.s3 import S3ApiServer
+    from seaweedfs_tpu.gateway.webdav import WebDavServer
     from seaweedfs_tpu.security.config import filer_guard
 
     store = SqliteStore(args.db) if args.db else None
@@ -61,6 +62,9 @@ def cmd_filer(args) -> None:
     if args.s3:
         s3 = S3ApiServer(f, host=args.ip, port=args.s3_port).start()
         print(f"s3 gateway listening on {s3.url}")
+    if args.webdav:
+        dav = WebDavServer(f, host=args.ip, port=args.webdav_port).start()
+        print(f"webdav gateway listening on {dav.url}")
     _wait_forever()
 
 
@@ -83,6 +87,11 @@ def cmd_server(args) -> None:
         if args.s3:
             s3 = S3ApiServer(f, host=args.ip, port=args.s3Port).start()
             print(f"s3 on {s3.url}")
+        if args.webdav:
+            from seaweedfs_tpu.gateway.webdav import WebDavServer
+
+            dav = WebDavServer(f, host=args.ip, port=args.webdavPort).start()
+            print(f"webdav on {dav.url}")
     _wait_forever()
 
 
@@ -258,6 +267,8 @@ def main(argv=None) -> None:
     s.add_argument("-filerPort", type=int, default=8888)
     s.add_argument("-s3", action="store_true")
     s.add_argument("-s3Port", type=int, default=8333)
+    s.add_argument("-webdav", action="store_true")
+    s.add_argument("-webdavPort", type=int, default=7333)
     s.add_argument("-ec.engine", dest="ec_engine", default="cpu",
                    choices=["cpu", "tpu"])
     s.set_defaults(fn=cmd_server)
@@ -270,6 +281,8 @@ def main(argv=None) -> None:
     fl.add_argument("-maxMB", type=int, default=8)
     fl.add_argument("-s3", action="store_true")
     fl.add_argument("-s3.port", dest="s3_port", type=int, default=8333)
+    fl.add_argument("-webdav", action="store_true")
+    fl.add_argument("-webdav.port", dest="webdav_port", type=int, default=7333)
     fl.set_defaults(fn=cmd_filer)
 
     bk = sub.add_parser("backup")
