@@ -1,0 +1,338 @@
+"""IAM API gateway: minimal AWS IAM query protocol managing S3 identities.
+
+Equivalent of weed/iamapi/ (iamapi_server.go:49 + iamapi_management_
+handlers.go): CreateUser/DeleteUser/ListUsers/GetUser, CreateAccessKey/
+DeleteAccessKey/ListAccessKeys, CreatePolicy/PutUserPolicy/GetUserPolicy/
+DeleteUserPolicy over the form-encoded Action= protocol.  All mutations
+rewrite the identity file at /etc/seaweedfs/identity.json through the
+filer, which every S3 gateway hot-reloads — the same config round-trip
+the reference does through its filer-stored s3 config.
+
+Policy statements map to identity actions the way the reference's
+iamapi_management_handlers.go GetActions does: s3:Get*->Read,
+s3:List*->List, s3:Put*/s3:Delete*->Write, s3:Tagging->Tagging, *->Admin;
+resource arn:aws:s3:::bucket/prefix scopes the grant.
+"""
+
+from __future__ import annotations
+
+import json
+import secrets
+import string
+import threading
+import urllib.parse
+import xml.etree.ElementTree as ET
+from typing import Optional
+
+from ..filer.filer import NotFoundError as FilerNotFound
+from ..filer.server import FilerServer
+from ..utils.httpd import Request, Response, Router, serve
+from .s3_auth import (IDENTITY_PATH, AuthError, Identity,
+                      IdentityAccessManagement)
+
+IAM_NS = "https://iam.amazonaws.com/doc/2010-05-08/"
+
+_ACTION_MAP = (
+    ("s3:Get", "Read"),
+    ("s3:List", "List"),
+    ("s3:Put", "Write"),
+    ("s3:Delete", "Write"),
+    ("s3:Tagging", "Tagging"),
+)
+
+
+def policy_to_actions(policy_document: dict) -> list[str]:
+    """AWS policy statements -> identity action grants."""
+    actions: list[str] = []
+    for st in policy_document.get("Statement", []):
+        if st.get("Effect") != "Allow":
+            continue
+        acts = st.get("Action", [])
+        acts = [acts] if isinstance(acts, str) else acts
+        resources = st.get("Resource", ["*"])
+        resources = [resources] if isinstance(resources, str) else resources
+        scopes = []
+        for res in resources:
+            arn = res.replace("arn:aws:s3:::", "")
+            if arn in ("*", ""):
+                scopes.append("")
+            else:
+                scopes.append(arn.rstrip("*").rstrip("/"))
+        for a in acts:
+            if a in ("*", "s3:*"):
+                mapped = ["Admin"]
+            elif "Tagging" in a:
+                mapped = ["Tagging"]  # before Get/Put prefixes claim it
+            else:
+                mapped = [tag for prefix, tag in _ACTION_MAP
+                          if a.startswith(prefix)]
+            for m in mapped:
+                for scope in scopes:
+                    actions.append(f"{m}:{scope}" if scope else m)
+    return sorted(set(actions))
+
+
+class IamApiServer:
+    def __init__(self, filer_server: FilerServer, host: str = "127.0.0.1",
+                 port: int = 8111):
+        self.fs = filer_server
+        self.host, self.port = host, port
+        self.router = Router("iam")
+        self._policies: dict[str, dict] = {}
+        # serializes every load->mutate->save span: concurrent mutations
+        # would otherwise lose updates (last-writer-wins on the json file)
+        self._mu = threading.Lock()
+        self._register_routes()
+        self._server = None
+
+    @property
+    def url(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def start(self) -> "IamApiServer":
+        self._server = serve(self.router, self.host, self.port)
+        return self
+
+    def stop(self) -> None:
+        if self._server:
+            self._server.shutdown()
+
+    # --- identity file round-trip ----------------------------------------
+    def _load(self) -> IdentityAccessManagement:
+        iam = IdentityAccessManagement()
+        try:
+            _, blob = self.fs.get_file(IDENTITY_PATH)
+            iam.load_json(blob)
+        except (FilerNotFound, IsADirectoryError):
+            pass
+        return iam
+
+    def _save(self, iam: IdentityAccessManagement) -> None:
+        blob = json.dumps(iam.dump_config(), indent=2).encode()
+        self.fs.put_file(IDENTITY_PATH, blob, mime="application/json")
+
+    @staticmethod
+    def _find_user(iam: IdentityAccessManagement,
+                   name: str) -> Optional[Identity]:
+        return next((i for i in iam._identities if i.name == name), None)
+
+    # --- protocol ---------------------------------------------------------
+    def _register_routes(self) -> None:
+        r = self.router
+
+        @r.route("POST", "/")
+        def dispatch(req: Request) -> Response:
+            form = {k: v[0] for k, v in urllib.parse.parse_qs(
+                req.body.decode(errors="replace"),
+                keep_blank_values=True).items()}
+            action = form.get("Action", "")
+            fn = getattr(self, f"_do_{action}", None)
+            if fn is None:
+                return self._error("400", "InvalidAction",
+                                   f"unsupported action {action!r}")
+            try:
+                self._authenticate(req)
+            except AuthError as e:
+                return self._error(str(e.status), e.code, str(e))
+            with self._mu:
+                return fn(form)
+
+    def _authenticate(self, req: Request) -> None:
+        """The management API signs with the SAME credential table it
+        manages (iamapi_server.go wires the s3 IAM into its auth).  Until
+        some identity holds Admin the table is still being bootstrapped
+        and calls are open; once an administrator exists, every call must
+        be SigV4-signed by one."""
+        iam = self._load()
+        if not any(i.can_do("Admin") for i in iam._identities):
+            return
+        ident = iam.authenticate(req.handler.command, req.path, req.query,
+                                 req.headers, req.body)
+        if not ident.can_do("Admin"):
+            raise AuthError("AccessDenied",
+                            f"{ident.name} is not an IAM administrator")
+
+    @staticmethod
+    def _response(action: str, fill=None) -> Response:
+        root = ET.Element(f"{action}Response", xmlns=IAM_NS)
+        result = ET.SubElement(root, f"{action}Result")
+        if fill is not None:
+            fill(result)
+        meta = ET.SubElement(root, "ResponseMetadata")
+        ET.SubElement(meta, "RequestId").text = secrets.token_hex(8)
+        body = b'<?xml version="1.0" encoding="UTF-8"?>' + ET.tostring(root)
+        return Response(raw=body, headers={"Content-Type": "application/xml"})
+
+    @staticmethod
+    def _error(status: str, code: str, message: str) -> Response:
+        root = ET.Element("ErrorResponse", xmlns=IAM_NS)
+        err = ET.SubElement(root, "Error")
+        ET.SubElement(err, "Code").text = code
+        ET.SubElement(err, "Message").text = message
+        body = b'<?xml version="1.0" encoding="UTF-8"?>' + ET.tostring(root)
+        return Response(raw=body, status=int(status),
+                        headers={"Content-Type": "application/xml"})
+
+    # --- user management --------------------------------------------------
+    def _do_CreateUser(self, form: dict) -> Response:
+        name = form.get("UserName", "")
+        iam = self._load()
+        if self._find_user(iam, name) is not None:
+            return self._error("409", "EntityAlreadyExists", name)
+        iam._identities.append(Identity(name, [], []))
+        self._save(iam)
+
+        def fill(result):
+            user = ET.SubElement(result, "User")
+            ET.SubElement(user, "UserName").text = name
+            ET.SubElement(user, "UserId").text = name
+            ET.SubElement(user, "Arn").text = f"arn:aws:iam:::user/{name}"
+
+        return self._response("CreateUser", fill)
+
+    def _do_GetUser(self, form: dict) -> Response:
+        name = form.get("UserName", "")
+        user = self._find_user(self._load(), name)
+        if user is None:
+            return self._error("404", "NoSuchEntity", name)
+
+        def fill(result):
+            u = ET.SubElement(result, "User")
+            ET.SubElement(u, "UserName").text = name
+            ET.SubElement(u, "Arn").text = f"arn:aws:iam:::user/{name}"
+
+        return self._response("GetUser", fill)
+
+    def _do_ListUsers(self, form: dict) -> Response:
+        iam = self._load()
+
+        def fill(result):
+            users = ET.SubElement(result, "Users")
+            for ident in iam._identities:
+                m = ET.SubElement(users, "member")
+                ET.SubElement(m, "UserName").text = ident.name
+            ET.SubElement(result, "IsTruncated").text = "false"
+
+        return self._response("ListUsers", fill)
+
+    def _do_DeleteUser(self, form: dict) -> Response:
+        name = form.get("UserName", "")
+        iam = self._load()
+        user = self._find_user(iam, name)
+        if user is None:
+            return self._error("404", "NoSuchEntity", name)
+        iam._identities.remove(user)
+        self._save(iam)
+        return self._response("DeleteUser")
+
+    # --- access keys ------------------------------------------------------
+    def _do_CreateAccessKey(self, form: dict) -> Response:
+        name = form.get("UserName", "")
+        iam = self._load()
+        user = self._find_user(iam, name)
+        if user is None:
+            user = Identity(name, [], [])
+            iam._identities.append(user)
+        alphabet = string.ascii_uppercase + string.digits
+        access_key = "AKIA" + "".join(secrets.choice(alphabet)
+                                      for _ in range(16))
+        secret_key = secrets.token_urlsafe(30)[:40]
+        user.credentials.append((access_key, secret_key))
+        self._save(iam)
+
+        def fill(result):
+            k = ET.SubElement(result, "AccessKey")
+            ET.SubElement(k, "UserName").text = name
+            ET.SubElement(k, "AccessKeyId").text = access_key
+            ET.SubElement(k, "SecretAccessKey").text = secret_key
+            ET.SubElement(k, "Status").text = "Active"
+
+        return self._response("CreateAccessKey", fill)
+
+    def _do_ListAccessKeys(self, form: dict) -> Response:
+        name = form.get("UserName", "")
+        user = self._find_user(self._load(), name)
+        if user is None:
+            return self._error("404", "NoSuchEntity", name)
+
+        def fill(result):
+            keys = ET.SubElement(result, "AccessKeyMetadata")
+            for ak, _ in user.credentials:
+                m = ET.SubElement(keys, "member")
+                ET.SubElement(m, "UserName").text = name
+                ET.SubElement(m, "AccessKeyId").text = ak
+                ET.SubElement(m, "Status").text = "Active"
+
+        return self._response("ListAccessKeys", fill)
+
+    def _do_DeleteAccessKey(self, form: dict) -> Response:
+        name = form.get("UserName", "")
+        key_id = form.get("AccessKeyId", "")
+        iam = self._load()
+        user = self._find_user(iam, name)
+        if user is None:
+            return self._error("404", "NoSuchEntity", name)
+        user.credentials = [(ak, sk) for ak, sk in user.credentials
+                            if ak != key_id]
+        self._save(iam)
+        return self._response("DeleteAccessKey")
+
+    # --- policies ---------------------------------------------------------
+    def _do_CreatePolicy(self, form: dict) -> Response:
+        name = form.get("PolicyName", "")
+        doc = json.loads(form.get("PolicyDocument", "{}"))
+        self._policies[name] = doc
+
+        def fill(result):
+            pol = ET.SubElement(result, "Policy")
+            ET.SubElement(pol, "PolicyName").text = name
+            ET.SubElement(pol, "Arn").text = f"arn:aws:iam:::policy/{name}"
+
+        return self._response("CreatePolicy", fill)
+
+    def _do_PutUserPolicy(self, form: dict) -> Response:
+        name = form.get("UserName", "")
+        doc = json.loads(form.get("PolicyDocument", "{}"))
+        iam = self._load()
+        user = self._find_user(iam, name)
+        if user is None:
+            return self._error("404", "NoSuchEntity", name)
+        user.actions = policy_to_actions(doc)
+        self._save(iam)
+        return self._response("PutUserPolicy")
+
+    def _do_GetUserPolicy(self, form: dict) -> Response:
+        name = form.get("UserName", "")
+        user = self._find_user(self._load(), name)
+        if user is None:
+            return self._error("404", "NoSuchEntity", name)
+
+        # grants render as real s3 actions so the document round-trips
+        # through PutUserPolicy/policy_to_actions without loss
+        tag_to_s3 = {"Read": ["s3:Get*"], "List": ["s3:List*"],
+                     "Write": ["s3:Put*", "s3:Delete*"],
+                     "Tagging": ["s3:PutObjectTagging"], "Admin": ["s3:*"]}
+
+        def fill(result):
+            ET.SubElement(result, "UserName").text = name
+            ET.SubElement(result, "PolicyName").text = f"{name}-policy"
+            statements = [{
+                "Effect": "Allow",
+                "Action": tag_to_s3.get(a.split(":")[0], ["s3:*"]),
+                "Resource": [
+                    f"arn:aws:s3:::{a.partition(':')[2] or '*'}"],
+            } for a in user.actions]
+            ET.SubElement(result, "PolicyDocument").text = json.dumps(
+                {"Version": "2012-10-17", "Statement": statements})
+
+        return self._response("GetUserPolicy", fill)
+
+    def _do_DeleteUserPolicy(self, form: dict) -> Response:
+        name = form.get("UserName", "")
+        iam = self._load()
+        user = self._find_user(iam, name)
+        if user is None:
+            return self._error("404", "NoSuchEntity", name)
+        user.actions = []
+        self._save(iam)
+        return self._response("DeleteUserPolicy")

@@ -4,8 +4,11 @@ Equivalent of weed/s3api/ (s3api_server.go router + object/bucket/multipart
 handlers): path-style requests, ListObjectsV2 with prefix/delimiter/
 continuation, multipart uploads staged under /buckets/.uploads/<id>/ whose
 completed object concatenates the part chunk lists without copying data
-(filer_multipart.go semantics).  Auth is anonymous in this round; the
-identity/signature layer slots into `authenticate`.
+(filer_multipart.go semantics).  Requests are authenticated by the
+SigV4/SigV2 layer in s3_auth.py against identities stored in the filer at
+/etc/seaweedfs/identity.json, hot-reloaded on change via the filer meta
+subscription (auth_credentials_subscribe.go); an empty identity table
+means an open gateway, the reference's no-config behavior.
 """
 
 from __future__ import annotations
@@ -22,6 +25,8 @@ from ..filer.filer import NotEmptyError
 from ..filer.filer import NotFoundError as FilerNotFound
 from ..filer.server import FilerServer
 from ..utils.httpd import HttpError, Request, Response, Router, serve
+from .s3_auth import (ACTION_ADMIN, ACTION_LIST, ACTION_READ, ACTION_WRITE,
+                      AuthError)
 
 BUCKETS_PATH = "/buckets"
 UPLOADS_PATH = "/buckets/.uploads"
@@ -45,15 +50,40 @@ def _err(status: int, code: str, message: str) -> Response:
 class S3ApiServer:
     def __init__(self, filer_server: FilerServer, host: str = "127.0.0.1",
                  port: int = 8333):
+        from .s3_auth import IDENTITY_PATH, IdentityAccessManagement
+
         self.fs = filer_server
         self.host, self.port = host, port
         from ..stats import s3_metrics
 
         self.metrics = s3_metrics()
         self.router = Router("s3", metrics=self.metrics)
+        self.router.error_handler = self._map_error
         self._register_routes()
         self._server = None
         self.fs.filer._ensure_parents(BUCKETS_PATH)
+        self.iam = IdentityAccessManagement()
+        self._load_identities()
+        # hot reload on config change, via the filer meta subscription
+        self._cancel_sub = self.fs.filer.subscribe(self._on_meta_event)
+
+    def _load_identities(self) -> None:
+        from .s3_auth import IDENTITY_PATH
+
+        try:
+            _, blob = self.fs.get_file(IDENTITY_PATH)
+            self.iam.load_json(blob)
+        except Exception:
+            pass  # no config yet: gateway stays open
+
+    def _on_meta_event(self, event: dict) -> None:
+        from .s3_auth import IDENTITY_PATH
+
+        for side in ("new_entry", "old_entry"):
+            e = event.get(side)
+            if e and e.get("full_path") == IDENTITY_PATH:
+                self._load_identities()
+                return
 
     @property
     def url(self) -> str:
@@ -66,9 +96,60 @@ class S3ApiServer:
     def stop(self) -> None:
         if self._server:
             self._server.shutdown()
+        self._cancel_sub()
+
+    @staticmethod
+    def _map_error(e: Exception):
+        """Router hook: protocol errors leave as S3 XML, not JSON."""
+        if isinstance(e, AuthError):
+            return _err(e.status, e.code, str(e))
+        if isinstance(e, FilerNotFound):
+            return _err(404, "NoSuchKey", str(e))
+        return None  # default JSON mapping
 
     def authenticate(self, req: Request) -> str:
-        return "anonymous"
+        """Identity name for display fields (no authorization check)."""
+        if not self.iam.enabled():
+            return "anonymous"
+        try:
+            return self._identity(req).name
+        except AuthError:
+            return "anonymous"
+
+    @staticmethod
+    def _maybe_decode_streaming(req: Request) -> None:
+        """Strip aws-chunked framing whenever the header announces it —
+        independent of auth state, or an open gateway would persist the
+        framing bytes into the object."""
+        from .s3_auth import STREAMING_PAYLOAD, decode_streaming_chunks
+
+        content_sha = req.headers.get("X-Amz-Content-Sha256") or ""
+        if content_sha.startswith(STREAMING_PAYLOAD) and \
+                not getattr(req, "_streaming_decoded", False):
+            req._body = decode_streaming_chunks(req.body)
+            req._streaming_decoded = True
+
+    def _identity(self, req: Request):
+        method = req.handler.command
+        body = req.body if method in ("PUT", "POST") else b""
+        ident = self.iam.authenticate(method, req.path, req.query,
+                                      req.headers, body)
+        self._maybe_decode_streaming(req)
+        return ident
+
+    def _auth(self, req: Request, action: str, bucket: str = "",
+              obj: str = "") -> str:
+        """Authenticate + authorize, returning the identity name.
+        AuthError propagates to the router's error handler, which renders
+        the S3 XML error body."""
+        if not self.iam.enabled():
+            self._maybe_decode_streaming(req)
+            return "anonymous"
+        ident = self._identity(req)
+        if not ident.can_do(action, bucket, obj):
+            raise AuthError("AccessDenied",
+                            f"{ident.name} may not {action} on {bucket or '*'}")
+        return ident.name
 
     # --- helpers ----------------------------------------------------------
     def _bucket_path(self, bucket: str) -> str:
@@ -89,12 +170,22 @@ class S3ApiServer:
 
         @r.route("GET", "/")
         def list_buckets(req: Request) -> Response:
+            # authn required on a secured gateway; each bucket shows only
+            # if the identity holds some grant on it (the reference
+            # filters ListBuckets by identity the same way)
+            ident = None
+            if self.iam.enabled():
+                ident = self._identity(req)
             root = ET.Element("ListAllMyBucketsResult", xmlns=S3_NS)
             owner = ET.SubElement(root, "Owner")
-            ET.SubElement(owner, "ID").text = self.authenticate(req)
+            ET.SubElement(owner, "ID").text = ident.name if ident else "anonymous"
             buckets = ET.SubElement(root, "Buckets")
             for e in self.fs.filer.list_directory(BUCKETS_PATH):
                 if not e.is_directory or e.name.startswith("."):
+                    continue
+                if ident is not None and not any(
+                        ident.can_do(a, e.name) for a in
+                        (ACTION_LIST, ACTION_READ, ACTION_WRITE, ACTION_ADMIN)):
                     continue
                 b = ET.SubElement(buckets, "Bucket")
                 ET.SubElement(b, "Name").text = e.name
@@ -103,17 +194,20 @@ class S3ApiServer:
 
         @r.route("PUT", "/([a-z0-9][a-z0-9.-]+)")
         def put_bucket(req: Request) -> Response:
+            self._auth(req, ACTION_ADMIN, req.match.group(1))
             self.fs.filer._ensure_parents(self._bucket_path(req.match.group(1)))
             return Response(raw=b"", headers={"Location": "/" + req.match.group(1)})
 
         @r.route("HEAD", "/([a-z0-9][a-z0-9.-]+)")
         def head_bucket(req: Request) -> Response:
+            self._auth(req, ACTION_READ, req.match.group(1))
             self._require_bucket(req.match.group(1))
             return Response(raw=b"")
 
         @r.route("DELETE", "/([a-z0-9][a-z0-9.-]+)")
         def delete_bucket(req: Request) -> Response:
             bucket = req.match.group(1)
+            self._auth(req, ACTION_ADMIN, bucket)
             self._require_bucket(bucket)
             try:
                 self.fs.filer.delete_entry(self._bucket_path(bucket),
@@ -126,6 +220,7 @@ class S3ApiServer:
         @r.route("GET", "/([a-z0-9][a-z0-9.-]+)")
         def list_objects(req: Request) -> Response:
             bucket = req.match.group(1)
+            self._auth(req, ACTION_LIST, bucket)
             self._require_bucket(bucket)
             prefix = req.query.get("prefix", "")
             delimiter = req.query.get("delimiter", "")
@@ -164,6 +259,8 @@ class S3ApiServer:
 
         @r.route("POST", "/([a-z0-9][a-z0-9.-]+)/(.+)")
         def post_object(req: Request) -> Response:
+            self._auth(req, ACTION_WRITE, req.match.group(1),
+                       req.match.group(2))
             bucket, key = req.match.group(1), req.match.group(2)
             self._require_bucket(bucket)
             if "uploads" in req.query:
@@ -174,6 +271,8 @@ class S3ApiServer:
 
         @r.route("PUT", "/([a-z0-9][a-z0-9.-]+)/(.+)")
         def put_object(req: Request) -> Response:
+            self._auth(req, ACTION_WRITE, req.match.group(1),
+                       req.match.group(2))
             bucket, key = req.match.group(1), req.match.group(2)
             self._require_bucket(bucket)
             if "partNumber" in req.query and "uploadId" in req.query:
@@ -190,6 +289,8 @@ class S3ApiServer:
         @r.route("GET", "/([a-z0-9][a-z0-9.-]+)/(.+)")
         @r.route("HEAD", "/([a-z0-9][a-z0-9.-]+)/(.+)")
         def get_object(req: Request) -> Response:
+            self._auth(req, ACTION_READ, req.match.group(1),
+                       req.match.group(2))
             bucket, key = req.match.group(1), req.match.group(2)
             try:
                 entry = self.fs.filer.find_entry(self._object_path(bucket, key))
@@ -224,6 +325,8 @@ class S3ApiServer:
 
         @r.route("DELETE", "/([a-z0-9][a-z0-9.-]+)/(.+)")
         def delete_object(req: Request) -> Response:
+            self._auth(req, ACTION_WRITE, req.match.group(1),
+                       req.match.group(2))
             bucket, key = req.match.group(1), req.match.group(2)
             if "uploadId" in req.query:
                 return self._abort_multipart(req, bucket, key)

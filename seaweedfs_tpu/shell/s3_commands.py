@@ -1,0 +1,131 @@
+"""s3.* shell commands (weed/shell/command_s3_*.go).
+
+Buckets are filer directories under /buckets; identities live in the
+in-FS config file /etc/seaweedfs/identity.json that the S3 gateways
+hot-reload (command_s3_configure.go edits the same stored config in the
+reference).  All commands talk to the filer over its HTTP API.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.parse
+
+from ..gateway.s3_auth import IDENTITY_PATH
+from ..utils.httpd import HttpError, http_bytes, http_json
+from .commands import CommandEnv, command
+from .fs_commands import _filer, _listing
+
+BUCKETS_PATH = "/buckets"
+UPLOADS_PATH = "/buckets/.uploads"
+
+
+def _read_identities(env: CommandEnv) -> dict:
+    status, body, _ = http_bytes(
+        "GET", f"http://{_filer(env)}{IDENTITY_PATH}")
+    if status != 200:
+        return {"identities": []}
+    return json.loads(body)
+
+
+def _write_identities(env: CommandEnv, config: dict) -> None:
+    status, body, _ = http_bytes(
+        "PUT", f"http://{_filer(env)}{IDENTITY_PATH}",
+        json.dumps(config, indent=2).encode(),
+        headers={"Content-Type": "application/json"})
+    if status not in (200, 201):
+        raise HttpError(status, body.decode(errors="replace"))
+
+
+@command("s3.bucket.list")
+def cmd_s3_bucket_list(env: CommandEnv, flags: dict) -> str:
+    """s3.bucket.list  # list all buckets"""
+    try:
+        entries = _listing(env, BUCKETS_PATH)
+    except HttpError:
+        return ""
+    return "\n".join(e["FullPath"].rsplit("/", 1)[-1] for e in entries
+                     if e["IsDirectory"]
+                     and not e["FullPath"].rsplit("/", 1)[-1].startswith("."))
+
+
+@command("s3.bucket.create")
+def cmd_s3_bucket_create(env: CommandEnv, flags: dict) -> str:
+    """s3.bucket.create -name <bucket>"""
+    name = flags.get("name") or flags.get("")
+    if not name:
+        raise ValueError("usage: s3.bucket.create -name <bucket>")
+    env.confirm_is_locked()
+    http_json("POST", f"http://{_filer(env)}/api/mkdir",
+              {"path": f"{BUCKETS_PATH}/{name}"})
+    return f"created bucket {name}"
+
+
+@command("s3.bucket.delete")
+def cmd_s3_bucket_delete(env: CommandEnv, flags: dict) -> str:
+    """s3.bucket.delete -name <bucket>  # removes the bucket and its objects"""
+    name = flags.get("name") or flags.get("")
+    if not name:
+        raise ValueError("usage: s3.bucket.delete -name <bucket>")
+    env.confirm_is_locked()
+    status, body, _ = http_bytes(
+        "DELETE", f"http://{_filer(env)}{BUCKETS_PATH}/{name}?recursive=true")
+    if status not in (204, 200):
+        raise HttpError(status, body.decode(errors="replace"))
+    return f"deleted bucket {name}"
+
+
+@command("s3.clean.uploads")
+def cmd_s3_clean_uploads(env: CommandEnv, flags: dict) -> str:
+    """s3.clean.uploads -timeAgo 24h  # abort stale multipart uploads"""
+    age = _parse_duration(flags.get("timeAgo", "24h"))
+    cutoff = time.time() - age
+    try:
+        uploads = _listing(env, UPLOADS_PATH)
+    except (HttpError, NotADirectoryError):
+        return "no stale uploads"
+    doomed = [u for u in uploads if u.get("Mtime", 0) < cutoff]
+    for u in doomed:
+        path = u["FullPath"]
+        http_bytes("DELETE", f"http://{_filer(env)}{path}?recursive=true")
+    return f"removed {len(doomed)} stale multipart uploads"
+
+
+@command("s3.configure")
+def cmd_s3_configure(env: CommandEnv, flags: dict) -> str:
+    """s3.configure -user <name> [-access_key k -secret_key s]
+    [-actions Read,Write:bucket] [-delete] [-apply]
+    # edit the S3 identity table; without -apply, prints the result"""
+    config = _read_identities(env)
+    identities = config.setdefault("identities", [])
+    user = flags.get("user", "")
+    if user:
+        ident = next((i for i in identities if i.get("name") == user), None)
+        if "delete" in flags:
+            if ident is not None:
+                identities.remove(ident)
+        else:
+            if ident is None:
+                ident = {"name": user, "credentials": [], "actions": []}
+                identities.append(ident)
+            if flags.get("access_key") and flags.get("secret_key"):
+                creds = [c for c in ident["credentials"]
+                         if c["accessKey"] != flags["access_key"]]
+                creds.append({"accessKey": flags["access_key"],
+                              "secretKey": flags["secret_key"]})
+                ident["credentials"] = creds
+            if flags.get("actions"):
+                ident["actions"] = flags["actions"].split(",")
+    if "apply" in flags:
+        env.confirm_is_locked()
+        _write_identities(env, config)
+        return f"applied: {len(identities)} identities"
+    return json.dumps(config, indent=2)
+
+
+def _parse_duration(s: str) -> float:
+    units = {"s": 1, "m": 60, "h": 3600, "d": 86400}
+    if s and s[-1] in units:
+        return float(s[:-1]) * units[s[-1]]
+    return float(s)

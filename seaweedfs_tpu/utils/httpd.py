@@ -69,6 +69,10 @@ class Router:
         self.name = name
         self.metrics = metrics
         self.routes: list[tuple[str, re.Pattern, Callable]] = []
+        # optional exception -> Response mapper, consulted before the
+        # default JSON error mapping (the S3 gateway uses it to emit
+        # protocol-correct XML errors)
+        self.error_handler: Optional[Callable[[Exception], Optional[Response]]] = None
 
     def route(self, method: str, pattern: str):
         compiled = re.compile("^" + pattern + "$")
@@ -90,12 +94,22 @@ class Router:
                 req = Request(handler, match)
                 try:
                     resp = fn(req)
-                except HttpError as e:
-                    resp = Response({"error": e.message or str(e)}, status=e.status)
-                except (KeyError, LookupError) as e:
-                    resp = Response({"error": str(e)}, status=404)
                 except Exception as e:  # noqa: BLE001 — server must not die
-                    resp = Response({"error": f"{type(e).__name__}: {e}"}, status=500)
+                    resp = None
+                    if self.error_handler is not None:
+                        try:
+                            resp = self.error_handler(e)
+                        except Exception:
+                            resp = None
+                    if resp is None:
+                        if isinstance(e, HttpError):
+                            resp = Response({"error": e.message or str(e)},
+                                            status=e.status)
+                        elif isinstance(e, (KeyError, LookupError)):
+                            resp = Response({"error": str(e)}, status=404)
+                        else:
+                            resp = Response(
+                                {"error": f"{type(e).__name__}: {e}"}, status=500)
                 if self.metrics is not None:
                     self.metrics.request_counter.inc(fn.__name__)
                     self.metrics.request_histogram.observe(

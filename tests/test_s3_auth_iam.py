@@ -1,0 +1,297 @@
+"""S3 signature auth + IAM gateway tests."""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.parse
+import xml.etree.ElementTree as ET
+
+import pytest
+
+from seaweedfs_tpu.filer.filer_store import MemoryStore
+from seaweedfs_tpu.filer.server import FilerServer
+from seaweedfs_tpu.gateway.iam import IamApiServer, policy_to_actions
+from seaweedfs_tpu.gateway.s3 import S3ApiServer
+from seaweedfs_tpu.gateway.s3_auth import (
+    IDENTITY_PATH, AuthError, Identity, IdentityAccessManagement,
+    decode_streaming_chunks, presign_v4, sign_v4)
+from seaweedfs_tpu.master.server import MasterServer
+from seaweedfs_tpu.utils.httpd import http_bytes
+from seaweedfs_tpu.volume_server.server import VolumeServer
+
+from tests.conftest import free_port  # noqa: E402
+
+
+# --- unit: identity authorization ------------------------------------------
+
+def test_can_do_scoping():
+    ident = Identity("u", [("AK", "SK")],
+                     ["Read:photos", "Write:photos/staging", "List"])
+    assert ident.can_do("Read", "photos")
+    assert ident.can_do("Read", "photos", "x/y.jpg")
+    assert not ident.can_do("Read", "other")
+    assert not ident.can_do("Write", "photos", "final/a")
+    assert ident.can_do("Write", "photos", "staging/a")
+    assert ident.can_do("List", "anything")
+    admin = Identity("root", [], ["Admin"])
+    assert admin.can_do("Write", "any", "thing")
+    scoped_admin = Identity("ops", [], ["Admin:infra"])
+    assert scoped_admin.can_do("Write", "infra", "x")
+    assert not scoped_admin.can_do("Read", "photos")
+
+
+def test_can_do_no_prefix_bypass():
+    """A grant on bucket "photos" must not leak into "photos-backup",
+    nor "photos/staging" into "photos/staging2"; only trailing-* grants
+    opt into raw prefix matching."""
+    ident = Identity("u", [], ["Read:photos", "Write:photos/staging"])
+    assert not ident.can_do("Read", "photos-backup")
+    assert not ident.can_do("Read", "photos-backup", "secret.txt")
+    assert not ident.can_do("Write", "photos", "staging2/x")
+    star = Identity("s", [], ["Read:photos*"])
+    assert star.can_do("Read", "photos-backup")
+
+
+def test_policy_to_actions():
+    doc = {"Statement": [
+        {"Effect": "Allow", "Action": ["s3:GetObject", "s3:ListBucket"],
+         "Resource": "arn:aws:s3:::photos/*"},
+        {"Effect": "Allow", "Action": "s3:*", "Resource": "*"},
+        {"Effect": "Deny", "Action": "s3:PutObject", "Resource": "*"},
+    ]}
+    acts = policy_to_actions(doc)
+    assert "Read:photos" in acts and "List:photos" in acts
+    assert "Admin" in acts
+    assert not any(a.startswith("Write") for a in acts)  # Deny not mapped
+
+
+# --- unit: sigv4 round-trip -------------------------------------------------
+
+def test_sigv4_sign_and_verify():
+    iam = IdentityAccessManagement()
+    iam.load_config({"identities": [
+        {"name": "u", "credentials": [
+            {"accessKey": "AK123", "secretKey": "SECRET"}],
+         "actions": ["Admin"]}]})
+    url = "http://localhost:8333/bucket/key.txt?partNumber=1&uploadId=x"
+    body = b"hello world"
+    headers = sign_v4("PUT", url, "AK123", "SECRET", body)
+    parsed = urllib.parse.urlparse(url)
+    query = {k: v[0] for k, v in urllib.parse.parse_qs(
+        parsed.query, keep_blank_values=True).items()}
+    ident = iam.authenticate("PUT", parsed.path, query, headers, body)
+    assert ident.name == "u"
+
+    # tampered body fails the content-sha check
+    from seaweedfs_tpu.gateway.s3_auth import AuthError
+    with pytest.raises(AuthError):
+        iam.authenticate("PUT", parsed.path, query, headers, b"evil")
+
+    # wrong secret fails signature
+    bad = sign_v4("PUT", url, "AK123", "WRONG", body)
+    with pytest.raises(AuthError):
+        iam.authenticate("PUT", parsed.path, query, bad, body)
+
+
+def test_presigned_url_verify_and_expiry():
+    iam = IdentityAccessManagement()
+    iam.load_config({"identities": [
+        {"name": "u", "credentials": [
+            {"accessKey": "AK123", "secretKey": "SECRET"}],
+         "actions": ["Admin"]}]})
+
+    def check(url):
+        parsed = urllib.parse.urlparse(url)
+        query = {k: v[0] for k, v in urllib.parse.parse_qs(
+            parsed.query, keep_blank_values=True).items()}
+        return iam.authenticate("GET", parsed.path, query,
+                                {"Host": parsed.netloc}, b"")
+
+    fresh = presign_v4("GET", "http://host:1/b/k.txt", "AK123", "SECRET",
+                       expires=300)
+    assert check(fresh).name == "u"
+
+    stale_date = time.strftime("%Y%m%dT%H%M%SZ",
+                               time.gmtime(time.time() - 7200))
+    stale = presign_v4("GET", "http://host:1/b/k.txt", "AK123", "SECRET",
+                       expires=60, amz_date=stale_date)
+    with pytest.raises(AuthError, match="expired"):
+        check(stale)
+
+    # stale header-signature is rejected too (15-minute skew window)
+    old_hdrs = sign_v4("GET", "http://host:1/b/k.txt", "AK123", "SECRET",
+                       amz_date=stale_date)
+    with pytest.raises(AuthError) as ei:
+        iam.authenticate("GET", "/b/k.txt", {}, old_hdrs, b"")
+    assert ei.value.code == "RequestTimeTooSkewed"
+
+
+def test_streaming_chunk_decode():
+    chunk1 = b"a" * 10
+    chunk2 = b"bb"
+    framed = (b"a;chunk-signature=deadbeef\r\n" + chunk1 + b"\r\n"
+              b"2;chunk-signature=cafe\r\n" + chunk2 + b"\r\n"
+              b"0;chunk-signature=00\r\n\r\n")
+    assert decode_streaming_chunks(framed) == chunk1 + chunk2
+
+
+# --- integration: secured gateway + IAM api --------------------------------
+
+@pytest.fixture
+def stack(tmp_path):
+    master = MasterServer(port=free_port(), pulse_seconds=0.4).start()
+    d = tmp_path / "vs0"
+    d.mkdir()
+    vol = VolumeServer([str(d)], master.url, port=free_port(),
+                       pulse_seconds=0.4).start()
+    deadline = time.time() + 5
+    while time.time() < deadline and not master.topo.all_nodes():
+        time.sleep(0.05)
+    filer = FilerServer(master.url, MemoryStore(), port=free_port(),
+                        max_chunk_mb=1).start()
+    s3 = S3ApiServer(filer, port=free_port()).start()
+    iam = IamApiServer(filer, port=free_port()).start()
+    yield filer, s3, iam
+    iam.stop()
+    s3.stop()
+    filer.stop()
+    vol.stop()
+    master.stop()
+
+
+def _iam_call(iam, action: str, **params) -> ET.Element:
+    form = urllib.parse.urlencode({"Action": action, **params})
+    status, body, _ = http_bytes(
+        "POST", f"http://{iam.url}/", form.encode(),
+        headers={"Content-Type": "application/x-www-form-urlencoded"})
+    assert status == 200, body
+    return ET.fromstring(body)
+
+
+def test_iam_lifecycle_and_s3_enforcement(stack):
+    filer, s3, iam = stack
+    ns = "{https://iam.amazonaws.com/doc/2010-05-08/}"
+
+    # open gateway before any identity exists
+    status, _, _ = http_bytes("PUT", f"http://{s3.url}/openbucket")
+    assert status == 200
+
+    _iam_call(iam, "CreateUser", UserName="alice")
+    resp = _iam_call(iam, "CreateAccessKey", UserName="alice")
+    ak = resp.find(f".//{ns}AccessKeyId").text
+    sk = resp.find(f".//{ns}SecretAccessKey").text
+    policy = json.dumps({"Statement": [
+        {"Effect": "Allow",
+         "Action": ["s3:GetObject", "s3:ListBucket", "s3:PutObject"],
+         "Resource": "arn:aws:s3:::openbucket/*"}]})
+    _iam_call(iam, "PutUserPolicy", UserName="alice", PolicyDocument=policy)
+
+    # wait for the gateway to hot-reload the identity file
+    deadline = time.time() + 5
+    while time.time() < deadline and not s3.iam.enabled():
+        time.sleep(0.05)
+    assert s3.iam.enabled()
+
+    # unsigned requests are now rejected
+    status, body, _ = http_bytes("PUT", f"http://{s3.url}/openbucket/f.txt",
+                                 b"data")
+    assert status == 403
+
+    # signed with alice's key: object PUT/GET succeeds in her bucket
+    url = f"http://{s3.url}/openbucket/f.txt"
+    headers = sign_v4("PUT", url, ak, sk, b"data")
+    status, _, _ = http_bytes("PUT", url, b"data", headers=headers)
+    assert status == 200
+    headers = sign_v4("GET", url, ak, sk)
+    status, body, _ = http_bytes("GET", url, headers=headers)
+    assert status == 200 and body == b"data"
+
+    # but she may not write another bucket
+    url2 = f"http://{s3.url}/otherbucket"
+    headers = sign_v4("PUT", url2, ak, sk)
+    status, _, _ = http_bytes("PUT", url2, headers=headers)
+    assert status == 403
+
+    # wrong secret is rejected
+    headers = sign_v4("GET", url, ak, "bogus")
+    status, _, _ = http_bytes("GET", url, headers=headers)
+    assert status == 403
+
+    # ListAccessKeys shows the key; DeleteAccessKey revokes access
+    resp = _iam_call(iam, "ListAccessKeys", UserName="alice")
+    assert resp.find(f".//{ns}AccessKeyId").text == ak
+    _iam_call(iam, "DeleteAccessKey", UserName="alice", AccessKeyId=ak)
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        headers = sign_v4("GET", url, ak, sk)
+        if http_bytes("GET", url, headers=headers)[0] == 403:
+            break
+        time.sleep(0.05)
+    headers = sign_v4("GET", url, ak, sk)
+    assert http_bytes("GET", url, headers=headers)[0] == 403
+
+
+def test_streaming_upload_decoded_on_open_gateway(stack):
+    """aws-chunked framing must be stripped even with auth disabled."""
+    filer, s3, iam = stack
+    payload = b"plain object bytes"
+    framed = (b"12;chunk-signature=00\r\n" + payload + b"\r\n"
+              b"0;chunk-signature=00\r\n\r\n")
+    url = f"http://{s3.url}/openb"
+    assert http_bytes("PUT", url)[0] == 200
+    status, _, _ = http_bytes(
+        "PUT", f"{url}/s.bin", framed,
+        headers={"X-Amz-Content-Sha256":
+                 "STREAMING-AWS4-HMAC-SHA256-PAYLOAD"})
+    assert status == 200
+    status, body, _ = http_bytes("GET", f"{url}/s.bin")
+    assert status == 200 and body == payload
+
+
+def test_iam_requires_admin_signature_once_admin_exists(stack):
+    filer, s3, iam = stack
+    ns = "{https://iam.amazonaws.com/doc/2010-05-08/}"
+    # bootstrap an administrator (open while no admin exists)
+    _iam_call(iam, "CreateUser", UserName="root")
+    resp = _iam_call(iam, "CreateAccessKey", UserName="root")
+    ak = resp.find(f".//{ns}AccessKeyId").text
+    sk = resp.find(f".//{ns}SecretAccessKey").text
+    _iam_call(iam, "PutUserPolicy", UserName="root", PolicyDocument=json.dumps(
+        {"Statement": [{"Effect": "Allow", "Action": "s3:*",
+                        "Resource": "*"}]}))
+    # unsigned mutation now rejected
+    form = urllib.parse.urlencode(
+        {"Action": "CreateUser", "UserName": "mallory"}).encode()
+    status, body, _ = http_bytes(
+        "POST", f"http://{iam.url}/", form,
+        headers={"Content-Type": "application/x-www-form-urlencoded"})
+    assert status == 403, body
+    # signed by root: accepted
+    headers = sign_v4("POST", f"http://{iam.url}/", ak, sk, form)
+    headers["Content-Type"] = "application/x-www-form-urlencoded"
+    status, body, _ = http_bytes("POST", f"http://{iam.url}/", form,
+                                 headers=headers)
+    assert status == 200, body
+    assert b"mallory" not in body or b"CreateUserResponse" in body
+
+
+def test_shell_s3_commands(stack):
+    filer, s3, iam = stack
+    from seaweedfs_tpu.shell import CommandEnv, run_command
+
+    env = CommandEnv("127.0.0.1:1", filer.url)  # master not needed here
+    env.admin_token = 1  # pretend-locked for mutating cmds
+
+    assert "created bucket b1" in run_command(env, "s3.bucket.create -name b1")
+    listing = run_command(env, "s3.bucket.list")
+    assert "b1" in listing
+    out = run_command(
+        env, "s3.configure -user bob -access_key BK -secret_key BS "
+             "-actions Read:b1,Write:b1 -apply")
+    assert "1 identities" in out
+    cfg = json.loads(run_command(env, "s3.configure"))
+    assert cfg["identities"][0]["name"] == "bob"
+    assert "deleted bucket b1" in run_command(env, "s3.bucket.delete -name b1")
+    out = run_command(env, "s3.clean.uploads -timeAgo 0s")
+    assert "stale" in out

@@ -65,6 +65,11 @@ def cmd_filer(args) -> None:
     if args.webdav:
         dav = WebDavServer(f, host=args.ip, port=args.webdav_port).start()
         print(f"webdav gateway listening on {dav.url}")
+    if args.iam:
+        from seaweedfs_tpu.gateway.iam import IamApiServer
+
+        iam = IamApiServer(f, host=args.ip, port=args.iam_port).start()
+        print(f"iam api listening on {iam.url}")
     _wait_forever()
 
 
@@ -283,6 +288,8 @@ def main(argv=None) -> None:
     fl.add_argument("-s3.port", dest="s3_port", type=int, default=8333)
     fl.add_argument("-webdav", action="store_true")
     fl.add_argument("-webdav.port", dest="webdav_port", type=int, default=7333)
+    fl.add_argument("-iam", action="store_true")
+    fl.add_argument("-iam.port", dest="iam_port", type=int, default=8111)
     fl.set_defaults(fn=cmd_filer)
 
     bk = sub.add_parser("backup")
