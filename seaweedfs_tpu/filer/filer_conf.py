@@ -1,0 +1,90 @@
+"""Per-path-prefix filer configuration, stored inside the filesystem.
+
+Equivalent of weed/filer/filer_conf.go: config records live at
+/etc/seaweedfs/filer.conf *inside the filer tree itself*, one rule per
+location prefix (collection, replication, ttl, fsync, disk_type,
+volume_growth_count, read_only), matched by longest prefix at write time
+and hot-reloaded when the entry changes (the reference reloads via its own
+meta subscription; FilerServer wires the same here).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field, fields
+from typing import Optional
+
+FILER_CONF_PATH = "/etc/seaweedfs/filer.conf"
+
+
+@dataclass
+class PathConf:
+    """One rule (filer.proto FilerConf.PathConf)."""
+    location_prefix: str = ""
+    collection: str = ""
+    replication: str = ""
+    ttl: str = ""
+    disk_type: str = ""
+    fsync: bool = False
+    volume_growth_count: int = 0
+    read_only: bool = False
+    data_center: str = ""
+    rack: str = ""
+
+    def to_dict(self) -> dict:
+        return {f.name: getattr(self, f.name) for f in fields(self)}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "PathConf":
+        known = {f.name for f in fields(cls)}
+        return cls(**{k: v for k, v in d.items() if k in known})
+
+    def merge_over(self, other: "PathConf") -> "PathConf":
+        """Longer-prefix rule wins field-by-field where it sets a value
+        (filer_conf.go mergePathConf)."""
+        out = PathConf(**other.to_dict())
+        for f in fields(self):
+            v = getattr(self, f.name)
+            if v not in ("", 0, False):
+                setattr(out, f.name, v)
+        return out
+
+
+class FilerConf:
+    """Prefix-trie of PathConf rules (reference uses a ptrie; a sorted
+    prefix scan is equivalent at these rule counts)."""
+
+    def __init__(self) -> None:
+        self.rules: dict[str, PathConf] = {}
+
+    # --- rule management --------------------------------------------------
+    def set_rule(self, rule: PathConf) -> None:
+        if not rule.location_prefix:
+            raise ValueError("rule needs a location_prefix")
+        self.rules[rule.location_prefix] = rule
+
+    def delete_rule(self, location_prefix: str) -> bool:
+        return self.rules.pop(location_prefix, None) is not None
+
+    def match_storage_rule(self, path: str) -> PathConf:
+        """Fold every matching prefix shortest→longest so longer prefixes
+        override (filer_conf.go MatchStorageRule)."""
+        out = PathConf()
+        for prefix in sorted(self.rules):
+            if path.startswith(prefix):
+                out = self.rules[prefix].merge_over(out)
+        out.location_prefix = path
+        return out
+
+    # --- codec ------------------------------------------------------------
+    def to_bytes(self) -> bytes:
+        doc = {"locations": [self.rules[p].to_dict() for p in sorted(self.rules)]}
+        return json.dumps(doc, indent=2).encode()
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "FilerConf":
+        fc = cls()
+        if data.strip():
+            for d in json.loads(data).get("locations", []):
+                fc.set_rule(PathConf.from_dict(d))
+        return fc

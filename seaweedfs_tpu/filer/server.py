@@ -21,7 +21,19 @@ from .entry import Attr, Entry, FileChunk
 from .filechunks import etag_of_chunks, read_plan, total_size
 from .filer import Filer, FilerError, NotEmptyError
 from .filer import NotFoundError as FilerNotFound
+from .filer_conf import FILER_CONF_PATH, FilerConf
 from .filer_store import FilerStore
+
+
+def _ttl_seconds(ttl: str) -> int:
+    if not ttl:
+        return 0
+    from ..storage.ttl import TTL
+
+    try:
+        return TTL.parse(ttl).minutes * 60
+    except ValueError:
+        return 0
 
 
 class FilerServer:
@@ -45,6 +57,37 @@ class FilerServer:
         self.router = Router("filer", metrics=self.metrics)
         self._register_routes()
         self._server = None
+        # path-prefix config (filer_conf.go): reload lazily when the
+        # in-FS conf entry mutates, detected via our own meta subscription
+        self._conf = FilerConf()
+        self._conf_dirty = True
+        self.filer.subscribe(self._maybe_mark_conf_dirty, since_ns=time.time_ns())
+
+    def _maybe_mark_conf_dirty(self, event: dict) -> None:
+        for e in (event.get("new_entry"), event.get("old_entry")):
+            if e and e.get("full_path") == FILER_CONF_PATH:
+                self._conf_dirty = True
+
+    def filer_conf(self) -> FilerConf:
+        if self._conf_dirty:
+            # clear BEFORE reading: a concurrent conf update re-marks dirty
+            # and the next call re-reads, instead of the mark being lost
+            self._conf_dirty = False
+            try:
+                entry = self.filer.find_entry(FILER_CONF_PATH)
+                self._conf = FilerConf.from_bytes(self.read_chunks(entry))
+            except (FilerNotFound, ValueError):
+                self._conf = FilerConf()
+        return self._conf
+
+    def _check_writable(self, path: str) -> None:
+        """read_only filer.conf rules gate every mutation — except under
+        /etc/seaweedfs, or a blanket rule would lock operators out of
+        editing the rules themselves."""
+        if path.startswith("/etc/seaweedfs"):
+            return
+        if self.filer_conf().match_storage_rule(path).read_only:
+            raise HttpError(403, f"{path}: read-only by filer.conf rule")
 
     @property
     def url(self) -> str:
@@ -94,7 +137,7 @@ class FilerServer:
                 pass  # best-effort; orphans are re-collectable
 
     def write_chunks(self, data: bytes, collection: str = "",
-                     ttl: str = "") -> list[FileChunk]:
+                     ttl: str = "", replication: str = "") -> list[FileChunk]:
         """Auto-chunking upload: split at max_chunk_size, one fid each."""
         if not data:
             return []
@@ -104,7 +147,7 @@ class FilerServer:
             piece = data[off : off + self.max_chunk_size]
             fid = self.client.upload(
                 piece, collection=collection or self.collection,
-                replication=self.replication, ttl=ttl)
+                replication=replication or self.replication, ttl=ttl)
             chunks.append(FileChunk(
                 file_id=fid, offset=off, size=len(piece),
                 modified_ts_ns=now,
@@ -131,11 +174,18 @@ class FilerServer:
     def put_file(self, path: str, data: bytes, mime: str = "",
                  collection: str = "", ttl: str = "",
                  mode: int = 0o660) -> Entry:
-        chunks = self.write_chunks(data, collection, ttl)
+        # longest-prefix storage rule fills unset knobs
+        # (filer_server_handlers_write.go → fs.configure rules)
+        self._check_writable(path)
+        rule = self.filer_conf().match_storage_rule(path)
+        collection = collection or rule.collection or self.collection
+        replication = rule.replication or self.replication
+        ttl = ttl or rule.ttl
+        chunks = self.write_chunks(data, collection, ttl, replication)
         entry = Entry(full_path=path, attr=Attr(
             mtime=time.time(), crtime=time.time(), mode=mode, mime=mime,
-            collection=collection or self.collection,
-            replication=self.replication,
+            collection=collection, replication=replication,
+            ttl_seconds=_ttl_seconds(ttl),
             md5=hashlib.md5(data).hexdigest()), chunks=chunks)
         return self.filer.create_entry(entry)
 
@@ -170,8 +220,73 @@ class FilerServer:
             if err:
                 raise HttpError(401, err)
             b = req.json()
+            self._check_writable(b["from"])
+            self._check_writable(b["to"])
             moved = self.filer.rename(b["from"], b["to"])
             return Response({"path": moved.full_path})
+
+        @r.route("GET", "/api/meta/log")
+        def api_meta_log(req: Request) -> Response:
+            """Persisted meta-event tail (SubscribeMetadata poll form:
+            filer_grpc_server_sub_meta.go). Returns events >= since_ns,
+            plus a cursor for the next poll."""
+            since = int(req.query.get("since_ns") or 0)
+            prefix = req.query.get("path_prefix", "")
+            limit = int(req.query.get("limit") or 10_000)
+            # page BEFORE filtering so the cursor always advances past
+            # examined events — a quiet prefix must not re-scan the log
+            events = self.filer.read_persisted_log(since)[:limit]
+            next_ns = events[-1]["ts_ns"] + 1 if events else since
+            if prefix and prefix != "/":
+                prefix = prefix.rstrip("/")
+                events = [e for e in events if e["directory"] == prefix
+                          or e["directory"].startswith(prefix + "/")]
+            return Response({"events": events, "next_ns": next_ns})
+
+        @r.route("GET", "/api/meta/tree")
+        def api_meta_tree(req: Request) -> Response:
+            """Full entries of a subtree (fs.meta.save / backup source)."""
+            root = req.query.get("path", "/")
+            out = []
+            try:
+                root_entry = self.filer.find_entry(root)
+            except FilerNotFound:
+                raise HttpError(404, f"{root} not found")
+            if not root_entry.is_directory:
+                out.append(root_entry.to_dict())
+            else:
+                for e in self.filer.iterate_tree(root):
+                    out.append(e.to_dict())
+            return Response({"entries": out})
+
+        @r.route("POST", "/api/meta/notify")
+        def api_meta_notify(req: Request) -> Response:
+            """Republish a subtree's entries as create events
+            (command_fs_meta_notify.go)."""
+            root = req.json().get("path", "/")
+            count = 0
+            try:
+                root_entry = self.filer.find_entry(root)
+            except FilerNotFound:
+                raise HttpError(404, f"{root} not found")
+            entries = ([root_entry] if not root_entry.is_directory
+                       else self.filer.iterate_tree(root))
+            for e in entries:
+                self.filer._notify("create", None, e)
+                count += 1
+            return Response({"count": count})
+
+        @r.route("POST", "/api/entry")
+        def api_entry(req: Request) -> Response:
+            """Raw CreateEntry/UpdateEntry with caller-provided chunks
+            (the filer gRPC CreateEntry surface — fs.meta.load,
+            filer.sync and mount use this)."""
+            err = self.guard.check_filer_jwt(req)
+            if err:
+                raise HttpError(401, err)
+            entry = Entry.from_dict(req.json())
+            self.filer.create_entry(entry)
+            return Response({"path": entry.full_path}, status=201)
 
         @r.route("POST", "/api/mkdir")
         def api_mkdir(req: Request) -> Response:
@@ -179,6 +294,7 @@ class FilerServer:
             if err:
                 raise HttpError(401, err)
             path = req.json()["path"].rstrip("/") or "/"
+            self._check_writable(path)
             self.filer._ensure_parents(path)
             return Response({"path": path})
 
@@ -236,6 +352,7 @@ class FilerServer:
                 raise HttpError(401, err)
             path = req.match.group(1)
             if path.endswith("/"):
+                self._check_writable(path.rstrip("/") or "/")
                 self.filer._ensure_parents(path.rstrip("/") or "/")
                 return Response({"name": path}, status=201)
             mime = req.headers.get("Content-Type", "")
@@ -255,6 +372,7 @@ class FilerServer:
             if err:
                 raise HttpError(401, err)
             path = req.match.group(1)
+            self._check_writable(path)
             try:
                 self.filer.delete_entry(
                     path, recursive=req.query.get("recursive") == "true")

@@ -31,6 +31,7 @@ from .s3_auth import (IDENTITY_PATH, AuthError, Identity,
                       IdentityAccessManagement)
 
 IAM_NS = "https://iam.amazonaws.com/doc/2010-05-08/"
+POLICIES_PATH = "/etc/seaweedfs/iam_policies.json"
 
 _ACTION_MAP = (
     ("s3:Get", "Read"),
@@ -78,7 +79,6 @@ class IamApiServer:
         self.fs = filer_server
         self.host, self.port = host, port
         self.router = Router("iam")
-        self._policies: dict[str, dict] = {}
         # serializes every load->mutate->save span: concurrent mutations
         # would otherwise lose updates (last-writer-wins on the json file)
         self._mu = threading.Lock()
@@ -105,6 +105,13 @@ class IamApiServer:
             iam.load_json(blob)
         except (FilerNotFound, IsADirectoryError):
             pass
+        except ValueError:
+            # corrupt identity.json: treat as empty so the management API
+            # stays usable to repair it (a blanket 500 would wedge IAM)
+            from ..utils.glog import warningf
+
+            warningf("iamapi: malformed %s, serving empty table",
+                     IDENTITY_PATH)
         return iam
 
     def _save(self, iam: IdentityAccessManagement) -> None:
@@ -278,10 +285,23 @@ class IamApiServer:
         return self._response("DeleteAccessKey")
 
     # --- policies ---------------------------------------------------------
+    def _policies_load(self) -> dict[str, dict]:
+        """Managed policies persist in the filer next to identity.json
+        so they survive restarts and are shared across gateways."""
+        try:
+            _, blob = self.fs.get_file(POLICIES_PATH)
+            return json.loads(blob)
+        except (FilerNotFound, IsADirectoryError, ValueError):
+            return {}
+
     def _do_CreatePolicy(self, form: dict) -> Response:
         name = form.get("PolicyName", "")
         doc = json.loads(form.get("PolicyDocument", "{}"))
-        self._policies[name] = doc
+        policies = self._policies_load()
+        policies[name] = doc
+        self.fs.put_file(POLICIES_PATH,
+                         json.dumps(policies, indent=2).encode(),
+                         mime="application/json")
 
         def fill(result):
             pol = ET.SubElement(result, "Policy")
@@ -292,7 +312,14 @@ class IamApiServer:
 
     def _do_PutUserPolicy(self, form: dict) -> Response:
         name = form.get("UserName", "")
-        doc = json.loads(form.get("PolicyDocument", "{}"))
+        if form.get("PolicyDocument"):
+            doc = json.loads(form["PolicyDocument"])
+        else:
+            # reference a managed policy created via CreatePolicy
+            pol_name = form.get("PolicyName", "")
+            doc = self._policies_load().get(pol_name)
+            if doc is None:
+                return self._error("404", "NoSuchEntity", pol_name)
         iam = self._load()
         user = self._find_user(iam, name)
         if user is None:

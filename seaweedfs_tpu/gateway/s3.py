@@ -65,7 +65,12 @@ class S3ApiServer:
         self.iam = IdentityAccessManagement()
         self._load_identities()
         # hot reload on config change, via the filer meta subscription
-        self._cancel_sub = self.fs.filer.subscribe(self._on_meta_event)
+        # (live tail only: identities were just loaded, so replaying the
+        # persisted history would only repeat that work)
+        import time as _time
+
+        self._cancel_sub = self.fs.filer.subscribe(
+            self._on_meta_event, since_ns=_time.time_ns())
 
     def _load_identities(self) -> None:
         from .s3_auth import IDENTITY_PATH
@@ -121,10 +126,13 @@ class S3ApiServer:
         """Strip aws-chunked framing whenever the header announces it —
         independent of auth state, or an open gateway would persist the
         framing bytes into the object."""
-        from .s3_auth import STREAMING_PAYLOAD, decode_streaming_chunks
+        from .s3_auth import decode_streaming_chunks
 
+        # any STREAMING-* payload uses aws-chunked framing — including
+        # STREAMING-UNSIGNED-PAYLOAD-TRAILER (modern SDK default); the
+        # decoder stops at the 0-chunk so trailer headers are dropped
         content_sha = req.headers.get("X-Amz-Content-Sha256") or ""
-        if content_sha.startswith(STREAMING_PAYLOAD) and \
+        if content_sha.startswith("STREAMING-") and \
                 not getattr(req, "_streaming_decoded", False):
             req._body = decode_streaming_chunks(req.body)
             req._streaming_decoded = True

@@ -15,6 +15,25 @@ def _filer(env: CommandEnv) -> str:
     return env.filer_url
 
 
+def _resolve(env: CommandEnv, path: str) -> str:
+    """Resolve against the shell cwd (fs.cd state, command_fs_cd.go)."""
+    cwd = getattr(env, "cwd", "/")
+    if not path:
+        return cwd
+    if not path.startswith("/"):
+        path = cwd.rstrip("/") + "/" + path
+    parts: list[str] = []
+    for seg in path.split("/"):
+        if seg in ("", "."):
+            continue
+        if seg == "..":
+            if parts:
+                parts.pop()
+        else:
+            parts.append(seg)
+    return "/" + "/".join(parts)
+
+
 def _listing(env: CommandEnv, path: str) -> list[dict]:
     """Full directory listing, following lastFileName pagination so
     directories over one page (1000 entries) are not silently truncated."""
@@ -37,7 +56,7 @@ def _listing(env: CommandEnv, path: str) -> list[dict]:
 @command("fs.ls")
 def cmd_fs_ls(env: CommandEnv, flags: dict) -> str:
     """fs.ls [-l] /dir  # list a filer directory"""
-    path = flags.get("", "/")
+    path = _resolve(env, flags.get("", ""))
     entries = _listing(env, path)
     if "l" in flags:
         return "\n".join(
@@ -50,7 +69,7 @@ def cmd_fs_ls(env: CommandEnv, flags: dict) -> str:
 @command("fs.cat")
 def cmd_fs_cat(env: CommandEnv, flags: dict) -> str:
     """fs.cat /path/to/file  # print file content"""
-    path = flags.get("", "")
+    path = _resolve(env, flags.get("", ""))
     status, body, _ = http_bytes("GET", f"http://{_filer(env)}{path}")
     if status != 200:
         raise HttpError(status, body.decode(errors="replace"))
@@ -60,7 +79,7 @@ def cmd_fs_cat(env: CommandEnv, flags: dict) -> str:
 @command("fs.du")
 def cmd_fs_du(env: CommandEnv, flags: dict) -> str:
     """fs.du /dir  # disk usage of a subtree"""
-    path = flags.get("", "/")
+    path = _resolve(env, flags.get("", ""))
 
     def walk(p: str) -> tuple[int, int]:
         size, files = 0, 0
@@ -80,7 +99,7 @@ def cmd_fs_du(env: CommandEnv, flags: dict) -> str:
 @command("fs.tree")
 def cmd_fs_tree(env: CommandEnv, flags: dict) -> str:
     """fs.tree /dir  # recursive listing"""
-    path = flags.get("", "/")
+    path = _resolve(env, flags.get("", ""))
     lines: list[str] = []
 
     def walk(p: str, depth: int) -> None:
@@ -97,7 +116,7 @@ def cmd_fs_tree(env: CommandEnv, flags: dict) -> str:
 @command("fs.mkdir")
 def cmd_fs_mkdir(env: CommandEnv, flags: dict) -> str:
     """fs.mkdir /dir"""
-    path = flags.get("", "")
+    path = _resolve(env, flags.get("", ""))
     http_json("POST", f"http://{_filer(env)}/api/mkdir", {"path": path})
     return path
 
@@ -105,7 +124,7 @@ def cmd_fs_mkdir(env: CommandEnv, flags: dict) -> str:
 @command("fs.rm")
 def cmd_fs_rm(env: CommandEnv, flags: dict) -> str:
     """fs.rm [-r] /path"""
-    path = flags.get("", "")
+    path = _resolve(env, flags.get("", ""))
     recursive = "true" if "r" in flags or "rf" in flags else "false"
     status, body, _ = http_bytes(
         "DELETE", f"http://{_filer(env)}{path}?recursive={recursive}")
@@ -117,10 +136,112 @@ def cmd_fs_rm(env: CommandEnv, flags: dict) -> str:
 @command("fs.mv")
 def cmd_fs_mv(env: CommandEnv, flags: dict) -> str:
     """fs.mv /src /dst"""
-    src = flags.get("", "")
-    dst = flags.get("to", "")
-    if not dst:
+    src = _resolve(env, flags.get("", ""))
+    if not flags.get("to"):
         raise RuntimeError("usage: fs.mv /src -to /dst")
+    dst = _resolve(env, flags["to"])
     http_json("POST", f"http://{_filer(env)}/api/rename",
               {"from": src, "to": dst})
     return f"moved {src} -> {dst}"
+
+
+@command("fs.cd")
+def cmd_fs_cd(env: CommandEnv, flags: dict) -> str:
+    """fs.cd /dir  # change the shell working directory"""
+    path = _resolve(env, flags.get("", "/"))
+    if path != "/":  # verify it lists as a directory
+        _listing(env, path)
+    env.cwd = path
+    return path
+
+
+@command("fs.pwd")
+def cmd_fs_pwd(env: CommandEnv, flags: dict) -> str:
+    """fs.pwd  # print the shell working directory"""
+    return getattr(env, "cwd", "/")
+
+
+# --- fs.configure (command_fs_configure.go → filer_conf.go rules) -----------
+
+@command("fs.configure")
+def cmd_fs_configure(env: CommandEnv, flags: dict) -> str:
+    """fs.configure [-locationPrefix /p [-collection c] [-replication 001]
+    [-ttl 7d] [-disk ssd] [-fsync] [-readOnly] [-volumeGrowthCount 2]
+    [-isDelete] -apply]  # show or edit per-path storage rules"""
+    from ..filer.filer_conf import FILER_CONF_PATH, FilerConf, PathConf
+
+    url = f"http://{_filer(env)}{FILER_CONF_PATH}"
+    status, body, _ = http_bytes("GET", url)
+    conf = FilerConf.from_bytes(body if status == 200 else b"")
+    prefix = flags.get("locationPrefix", "")
+    if prefix:
+        if "isDelete" in flags:
+            if not conf.delete_rule(prefix):
+                return f"no rule for {prefix}"
+        else:
+            conf.set_rule(PathConf(
+                location_prefix=prefix,
+                collection=flags.get("collection", ""),
+                replication=flags.get("replication", ""),
+                ttl=flags.get("ttl", ""),
+                disk_type=flags.get("disk", ""),
+                fsync="fsync" in flags,
+                read_only="readOnly" in flags,
+                volume_growth_count=int(flags.get("volumeGrowthCount", "0")),
+                data_center=flags.get("dataCenter", ""),
+                rack=flags.get("rack", "")))
+        if "apply" in flags:
+            status, body, _ = http_bytes("PUT", url, conf.to_bytes())
+            if status not in (200, 201):
+                raise HttpError(status, body.decode(errors="replace"))
+    return conf.to_bytes().decode()
+
+
+# --- fs.meta.* (command_fs_meta_{cat,save,load,notify}.go) ------------------
+
+@command("fs.meta.cat")
+def cmd_fs_meta_cat(env: CommandEnv, flags: dict) -> str:
+    """fs.meta.cat /path  # print an entry's full metadata"""
+    path = _resolve(env, flags.get("", ""))
+    return json.dumps(
+        http_json("GET", f"http://{_filer(env)}/api/stat{path}"), indent=2)
+
+
+@command("fs.meta.save")
+def cmd_fs_meta_save(env: CommandEnv, flags: dict) -> str:
+    """fs.meta.save [-o meta.jsonl] [/dir]  # dump subtree metadata to a
+    local file, one entry per line (reference writes a pb stream)"""
+    path = _resolve(env, flags.get("", ""))
+    out_file = flags.get("o", "filer_meta.jsonl")
+    tree = http_json(
+        "GET", f"http://{_filer(env)}/api/meta/tree?path="
+        + urllib.parse.quote(path))
+    with open(out_file, "w") as f:
+        for d in tree["entries"]:
+            f.write(json.dumps(d) + "\n")
+    return f"saved {len(tree['entries'])} entries to {out_file}"
+
+
+@command("fs.meta.load")
+def cmd_fs_meta_load(env: CommandEnv, flags: dict) -> str:
+    """fs.meta.load meta.jsonl  # recreate entries from a metadata dump"""
+    in_file = flags.get("", "")
+    n = 0
+    with open(in_file) as f:
+        for line in f:
+            if not line.strip():
+                continue
+            http_json("POST", f"http://{_filer(env)}/api/entry",
+                      json.loads(line))
+            n += 1
+    return f"loaded {n} entries"
+
+
+@command("fs.meta.notify")
+def cmd_fs_meta_notify(env: CommandEnv, flags: dict) -> str:
+    """fs.meta.notify [/dir]  # republish subtree metadata as create
+    events into the meta log / notification queue"""
+    path = _resolve(env, flags.get("", ""))
+    r = http_json("POST", f"http://{_filer(env)}/api/meta/notify",
+                  {"path": path})
+    return f"notified {r['count']} entries"

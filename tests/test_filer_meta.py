@@ -1,0 +1,217 @@
+"""FilerConf path rules, meta log APIs, fs.meta.* / fs.configure / fs.cd
+shell commands — the metadata plane of the filer.
+
+Reference behaviors: filer/filer_conf.go (longest-prefix rules, in-FS
+config hot-reload), filer_grpc_server_sub_meta.go (SubscribeMetadata),
+shell/command_fs_meta_{cat,save,load}.go, command_fs_configure.go.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.parse
+
+import pytest
+
+from seaweedfs_tpu.filer.filer_conf import FILER_CONF_PATH, FilerConf, PathConf
+from seaweedfs_tpu.filer.filer_store import SqliteStore
+from seaweedfs_tpu.filer.server import FilerServer
+from seaweedfs_tpu.master.server import MasterServer
+from seaweedfs_tpu.shell.commands import CommandEnv, run_command
+from seaweedfs_tpu.utils.httpd import http_bytes, http_json
+from seaweedfs_tpu.volume_server.server import VolumeServer
+from tests.conftest import free_port
+
+
+@pytest.fixture
+def stack(tmp_path):
+    master = MasterServer(port=free_port(), pulse_seconds=0.4).start()
+    d = tmp_path / "vs0"
+    d.mkdir()
+    vol = VolumeServer([str(d)], master.url, port=free_port(),
+                       pulse_seconds=0.4).start()
+    deadline = time.time() + 5
+    while time.time() < deadline and len(master.topo.all_nodes()) < 1:
+        time.sleep(0.05)
+    filer = FilerServer(master.url, SqliteStore(str(tmp_path / "filer.db")),
+                        port=free_port(), max_chunk_mb=1).start()
+    yield master, vol, filer
+    filer.stop()
+    vol.stop()
+    master.stop()
+
+
+# --- FilerConf unit tests ---------------------------------------------------
+
+def test_filer_conf_longest_prefix_merge():
+    fc = FilerConf()
+    fc.set_rule(PathConf(location_prefix="/buckets", collection="b",
+                         replication="001"))
+    fc.set_rule(PathConf(location_prefix="/buckets/hot", ttl="7d",
+                         collection="hot"))
+    rule = fc.match_storage_rule("/buckets/hot/x.bin")
+    assert rule.collection == "hot"          # longer prefix wins
+    assert rule.replication == "001"         # inherited from shorter prefix
+    assert rule.ttl == "7d"
+    assert fc.match_storage_rule("/other/x").collection == ""
+
+
+def test_filer_conf_roundtrip():
+    fc = FilerConf()
+    fc.set_rule(PathConf(location_prefix="/a", read_only=True,
+                         volume_growth_count=2))
+    fc2 = FilerConf.from_bytes(fc.to_bytes())
+    assert fc2.rules["/a"].read_only is True
+    assert fc2.rules["/a"].volume_growth_count == 2
+    assert FilerConf.from_bytes(b"").rules == {}
+
+
+# --- live server behavior ---------------------------------------------------
+
+def test_conf_read_only_rule_enforced_and_hot_reloaded(stack):
+    _, _, filer = stack
+    base = f"http://{filer.url}"
+    fc = FilerConf()
+    fc.set_rule(PathConf(location_prefix="/frozen", read_only=True))
+    status, _, _ = http_bytes("PUT", base + FILER_CONF_PATH, fc.to_bytes())
+    assert status == 201
+    status, body, _ = http_bytes("PUT", base + "/frozen/x.txt", b"nope")
+    assert status == 403
+    status, _, _ = http_bytes("PUT", base + "/ok/x.txt", b"yes")
+    assert status == 201
+    # delete the rule -> writes allowed again (hot reload via meta event)
+    fc2 = FilerConf()
+    http_bytes("PUT", base + FILER_CONF_PATH, fc2.to_bytes())
+    status, _, _ = http_bytes("PUT", base + "/frozen/x.txt", b"now ok")
+    assert status == 201
+
+
+def test_conf_collection_ttl_applied_to_entry(stack):
+    _, _, filer = stack
+    base = f"http://{filer.url}"
+    fc = FilerConf()
+    fc.set_rule(PathConf(location_prefix="/tagged", collection="mycoll",
+                         ttl="5m"))
+    http_bytes("PUT", base + FILER_CONF_PATH, fc.to_bytes())
+    http_bytes("PUT", base + "/tagged/f.bin", b"data")
+    stat = http_json("GET", base + "/api/stat/tagged/f.bin")
+    assert stat["attr"]["collection"] == "mycoll"
+    assert stat["attr"]["ttl_seconds"] == 300
+
+
+def test_meta_log_tail_and_prefix_filter(stack):
+    _, _, filer = stack
+    base = f"http://{filer.url}"
+    t0 = time.time_ns()
+    http_bytes("PUT", base + "/logs/a.txt", b"a")
+    http_bytes("PUT", base + "/other/b.txt", b"b")
+    r = http_json("GET", base + f"/api/meta/log?since_ns={t0}")
+    ops = [(e["op"], (e["new_entry"] or e["old_entry"])["full_path"])
+           for e in r["events"]]
+    assert ("create", "/logs/a.txt") in ops
+    assert ("create", "/other/b.txt") in ops
+    # prefix filter
+    r2 = http_json("GET", base
+                   + f"/api/meta/log?since_ns={t0}&path_prefix=/logs")
+    paths = [(e["new_entry"] or e["old_entry"])["full_path"]
+             for e in r2["events"]]
+    assert "/logs/a.txt" in paths
+    assert all(p.startswith("/logs") for p in paths)
+    # cursor advances past the last event
+    r3 = http_json("GET", base + f"/api/meta/log?since_ns={r['next_ns']}")
+    assert r3["events"] == []
+
+
+def test_meta_tree_and_raw_entry_create(stack):
+    _, _, filer = stack
+    base = f"http://{filer.url}"
+    http_bytes("PUT", base + "/t/sub/one.txt", b"1")
+    http_bytes("PUT", base + "/t/two.txt", b"22")
+    tree = http_json("GET", base + "/api/meta/tree?path=/t")
+    paths = {e["full_path"] for e in tree["entries"]}
+    assert paths == {"/t/sub", "/t/sub/one.txt", "/t/two.txt"}
+    # raw create with the same chunks = a metadata-level copy
+    src = next(e for e in tree["entries"]
+               if e["full_path"] == "/t/two.txt")
+    clone = dict(src, full_path="/t/clone.txt")
+    http_json("POST", base + "/api/entry", clone)
+    status, body, _ = http_bytes("GET", base + "/t/clone.txt")
+    assert (status, body) == (200, b"22")
+
+
+# --- shell commands ---------------------------------------------------------
+
+def test_shell_fs_cd_pwd_and_meta_family(stack, tmp_path):
+    master, _, filer = stack
+    env = CommandEnv(master.url, filer.url)
+    http_bytes("PUT", f"http://{filer.url}/w/d/file.txt", b"hello")
+    assert run_command(env, "fs.pwd") == "/"
+    run_command(env, "fs.cd /w")
+    assert run_command(env, "fs.pwd") == "/w"
+    assert "file.txt" in run_command(env, "fs.ls d")
+    meta = json.loads(run_command(env, "fs.meta.cat d/file.txt"))
+    assert meta["full_path"] == "/w/d/file.txt"
+    # save + load roundtrip into a new location
+    out = tmp_path / "meta.jsonl"
+    run_command(env, f"fs.meta.save -o {out} /w")
+    lines = [json.loads(l) for l in out.read_text().splitlines()]
+    assert {e["full_path"] for e in lines} == {"/w/d", "/w/d/file.txt"}
+    # metadata-only restore: move the tree aside (chunks stay live), then
+    # load the dump — the reference's fs.meta.load is metadata-only too
+    run_command(env, "fs.mv /w -to /w_aside")
+    msg = run_command(env, f"fs.meta.load {out}")
+    assert msg == "loaded 2 entries"
+    status, body, _ = http_bytes("GET", f"http://{filer.url}/w/d/file.txt")
+    assert (status, body) == (200, b"hello")
+
+
+def test_shell_fs_configure_apply(stack):
+    master, _, filer = stack
+    env = CommandEnv(master.url, filer.url)
+    out = run_command(
+        env, "fs.configure -locationPrefix /pix -collection pictures "
+             "-volumeGrowthCount 2 -apply")
+    assert "pictures" in out
+    rule = filer.filer_conf().match_storage_rule("/pix/cat.jpg")
+    assert rule.collection == "pictures"
+    assert rule.volume_growth_count == 2
+    # non-apply run just prints
+    out2 = run_command(env, "fs.configure -locationPrefix /tmp2 -ttl 1d")
+    assert "/tmp2" in out2
+    assert filer.filer_conf().match_storage_rule("/tmp2/a").ttl == ""
+
+
+def test_read_only_rule_blocks_delete_and_rename(stack):
+    _, _, filer = stack
+    base = f"http://{filer.url}"
+    http_bytes("PUT", base + "/ro/keep.txt", b"data")
+    fc = FilerConf()
+    fc.set_rule(PathConf(location_prefix="/ro", read_only=True))
+    http_bytes("PUT", base + FILER_CONF_PATH, fc.to_bytes())
+    status, _, _ = http_bytes("DELETE", base + "/ro/keep.txt")
+    assert status == 403
+    status, body, _ = http_bytes(
+        "POST", base + "/api/rename",
+        json.dumps({"from": "/ro/keep.txt", "to": "/ro/x.txt"}).encode(),
+        headers={"Content-Type": "application/json"})
+    assert status == 403
+    # the conf file itself stays editable even under a blanket rule
+    fc.set_rule(PathConf(location_prefix="/", read_only=True))
+    status, _, _ = http_bytes("PUT", base + FILER_CONF_PATH, fc.to_bytes())
+    assert status == 201
+    status, _, _ = http_bytes("PUT", base + FILER_CONF_PATH,
+                              FilerConf().to_bytes())
+    assert status == 201
+
+
+def test_meta_notify_republishes(stack):
+    _, _, filer = stack
+    base = f"http://{filer.url}"
+    http_bytes("PUT", base + "/n/a.txt", b"a")
+    t0 = time.time_ns()
+    r = http_json("POST", base + "/api/meta/notify", {"path": "/n"})
+    assert r["count"] == 1
+    r2 = http_json("GET", base + f"/api/meta/log?since_ns={t0}")
+    assert any((e["new_entry"] or {}).get("full_path") == "/n/a.txt"
+               for e in r2["events"])

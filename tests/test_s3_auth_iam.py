@@ -249,6 +249,25 @@ def test_streaming_upload_decoded_on_open_gateway(stack):
     assert status == 200 and body == payload
 
 
+def test_streaming_unsigned_trailer_upload_decoded(stack):
+    """STREAMING-UNSIGNED-PAYLOAD-TRAILER (modern SDK default): unsigned
+    chunks + trailer headers after the 0-chunk must also be unframed."""
+    filer, s3, iam = stack
+    payload = b"trailer-framed bytes"
+    framed = (b"14\r\n" + payload + b"\r\n"
+              b"0\r\n"
+              b"x-amz-checksum-crc32c:AAAAAA==\r\n\r\n")
+    url = f"http://{s3.url}/trailerb"
+    assert http_bytes("PUT", url)[0] == 200
+    status, _, _ = http_bytes(
+        "PUT", f"{url}/t.bin", framed,
+        headers={"X-Amz-Content-Sha256":
+                 "STREAMING-UNSIGNED-PAYLOAD-TRAILER"})
+    assert status == 200
+    status, body, _ = http_bytes("GET", f"{url}/t.bin")
+    assert status == 200 and body == payload
+
+
 def test_iam_requires_admin_signature_once_admin_exists(stack):
     filer, s3, iam = stack
     ns = "{https://iam.amazonaws.com/doc/2010-05-08/}"
