@@ -78,10 +78,42 @@ class MasterClient:
 
 
 class WeedClient:
-    """High-level one-shot operations (operation/submit.go flavor)."""
+    """High-level one-shot operations (operation/submit.go flavor).
 
-    def __init__(self, master_url: str):
+    With keep_connected=True, lookups ride a wdclient push-updated VidMap
+    (zero RPCs steady-state) instead of the TTL lookup cache; on secured
+    clusters one probe discovers that JWTs are needed and reads fall back
+    to the auth-carrying /dir/lookup."""
+
+    def __init__(self, master_url: str, keep_connected: bool = False,
+                 data_center: str = ""):
         self.master = MasterClient(master_url)
+        self.wd = None
+        self._secured: Optional[bool] = None
+        if keep_connected:
+            from .wdclient import WdClient
+
+            self.wd = WdClient(master_url, data_center=data_center).start()
+
+    def close(self) -> None:
+        if self.wd is not None:
+            self.wd.stop()
+
+    def _locate(self, vid: int) -> tuple[list[str], str]:
+        """(urls, read_auth), preferring the push map on open clusters."""
+        if self._secured is None:
+            urls, auth = self.master.lookup_with_auth(vid)
+            self._secured = bool(auth)
+            if self._secured and self.wd is not None:
+                # secured cluster never consults the push map; don't keep
+                # a long-poll parked on the master for nothing
+                self.wd.stop()
+                self.wd = None
+            return urls, auth
+        if self._secured or self.wd is None \
+                or not self.wd._synced.is_set():
+            return self.master.lookup_with_auth(vid)
+        return self.wd.lookup(vid), ""
 
     def upload(self, data: bytes, name: str = "", mime: str = "",
                collection: str = "", replication: str = "",
@@ -109,7 +141,7 @@ class WeedClient:
 
     def download(self, fid: str) -> bytes:
         vid = int(fid.split(",")[0])
-        urls, auth = self.master.lookup_with_auth(vid)
+        urls, auth = self._locate(vid)
         if not urls:
             raise HttpError(404, f"volume {vid} has no locations")
         headers = {"Authorization": f"BEARER {auth}"} if auth else None

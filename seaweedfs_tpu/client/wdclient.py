@@ -1,0 +1,135 @@
+"""wdclient: push-updated vid->location cache with same-DC preference.
+
+Equivalent of weed/wdclient/ (masterclient.go:29-200 KeepConnected loop,
+vid_map.go:44-160).  A background thread long-polls the master's
+/cluster/watch surface (the KeepConnected stream of the reference) and
+applies snapshot + deltas into a VidMap; lookups then cost zero RPCs.
+On master loss the thread backs off and resyncs from a fresh snapshot.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from dataclasses import dataclass
+
+from ..utils.httpd import HttpError, http_json
+
+
+@dataclass(frozen=True)
+class Location:
+    url: str
+    public_url: str
+    data_center: str = ""
+
+
+class VidMap:
+    """vid -> [Location] (+ EC volumes), same-DC results first
+    (vid_map.go GetLocations / sameDcLocations)."""
+
+    def __init__(self, data_center: str = ""):
+        self.data_center = data_center
+        self._lock = threading.Lock()
+        self._vols: dict[int, list[Location]] = {}
+        self._ecs: dict[int, list[Location]] = {}
+
+    def apply_snapshot(self, snap: dict) -> None:
+        def parse(m: dict) -> dict[int, list[Location]]:
+            return {int(vid): [Location(l["url"], l.get("public_url", l["url"]),
+                                        l.get("data_center", ""))
+                               for l in locs] for vid, locs in m.items()}
+
+        with self._lock:
+            self._vols = parse(snap.get("volumes", {}))
+            self._ecs = parse(snap.get("ec_volumes", {}))
+
+    def apply_event(self, e: dict) -> None:
+        loc = Location(e["url"], e.get("public_url", e["url"]),
+                       e.get("data_center", ""))
+        table = self._ecs if e.get("kind") == "ec" else self._vols
+        with self._lock:
+            locs = table.setdefault(e["vid"], [])
+            if e["op"] == "add":
+                if loc not in locs:
+                    locs.append(loc)
+            else:
+                table[e["vid"]] = [l for l in locs if l.url != loc.url]
+                if not table[e["vid"]]:
+                    del table[e["vid"]]
+
+    def lookup(self, vid: int) -> list[Location]:
+        with self._lock:
+            locs = list(self._vols.get(vid) or self._ecs.get(vid) or [])
+        random.shuffle(locs)
+        if self.data_center:
+            locs.sort(key=lambda l: l.data_center != self.data_center)
+        return locs
+
+    def lookup_file_id(self, fid: str) -> list[str]:
+        return [l.url for l in self.lookup(int(fid.split(",")[0]))]
+
+    def has(self, vid: int) -> bool:
+        with self._lock:
+            return vid in self._vols or vid in self._ecs
+
+
+class WdClient:
+    """Maintains a live VidMap via the master watch long-poll; falls back
+    to /dir/lookup for vids not (yet) in the map."""
+
+    def __init__(self, master_url: str, data_center: str = "",
+                 poll_timeout: float = 14.0):
+        self.master_url = master_url
+        self.vid_map = VidMap(data_center)
+        self.poll_timeout = poll_timeout
+        self._seq = 0
+        self._stop = threading.Event()
+        self._synced = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # --- lifecycle --------------------------------------------------------
+    def start(self) -> "WdClient":
+        self._thread = threading.Thread(
+            target=self._keep_connected, daemon=True, name="wdclient")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def wait_synced(self, timeout: float = 5.0) -> bool:
+        return self._synced.wait(timeout)
+
+    def _keep_connected(self) -> None:
+        while not self._stop.is_set():
+            try:
+                r = http_json(
+                    "GET", f"http://{self.master_url}/cluster/watch?"
+                    f"since_seq={self._seq}&timeout={self.poll_timeout}",
+                    timeout=self.poll_timeout + 10)
+                if "volumes" in r:
+                    self.vid_map.apply_snapshot(r)
+                for e in r.get("events", []):
+                    self.vid_map.apply_event(e)
+                self._seq = r.get("seq", self._seq)
+                self._synced.set()
+            except Exception:
+                # ANY failure (transport, malformed body, bad event) must
+                # not kill the loop with _synced set — that would freeze
+                # the map and serve stale locations forever
+                self._synced.clear()
+                self._seq = 0  # resync from snapshot on reconnect
+                self._stop.wait(1.0)
+
+    # --- lookups ----------------------------------------------------------
+    def lookup(self, vid: int) -> list[str]:
+        urls = [l.url for l in self.vid_map.lookup(vid)]
+        if urls:
+            return urls
+        # miss: the volume may predate our snapshot or be EC-only
+        r = http_json("GET", f"http://{self.master_url}/dir/lookup?"
+                      f"volumeId={vid}")
+        return [loc["url"] for loc in r.get("locations", [])]
+
+    def lookup_file_id(self, fid: str) -> list[str]:
+        return self.lookup(int(fid.split(",")[0]))

@@ -45,7 +45,7 @@ class FilerServer:
 
         self.guard = guard or Guard()
         self.master_url = master_url
-        self.client = WeedClient(master_url)
+        self.client = WeedClient(master_url, keep_connected=True)
         self.filer = Filer(store, delete_chunks_fn=self._delete_chunks)
         self.host, self.port = host, port
         self.max_chunk_size = max_chunk_mb * 1024 * 1024
@@ -101,6 +101,7 @@ class FilerServer:
         if self._server:
             self._server.shutdown()
         self.filer.close()
+        self.client.close()
 
     # --- chunk IO ---------------------------------------------------------
     def _delete_chunks(self, fids: list[str]) -> None:

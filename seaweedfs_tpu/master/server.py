@@ -161,6 +161,14 @@ class MasterServer:
         def cluster_status(req: Request) -> Response:
             return Response({"IsLeader": True, "Leader": self.url, "Peers": []})
 
+        @r.route("GET", "/cluster/watch")
+        def cluster_watch(req: Request) -> Response:
+            """KeepConnected push surface: long-poll for vid->location
+            deltas (master_grpc_server.go:185)."""
+            since = int(req.query.get("since_seq") or 0)
+            timeout = min(float(req.query.get("timeout") or 14.0), 55.0)
+            return Response(self.topo.watch_locations(since, timeout))
+
         @r.route("GET", "/metrics")
         def metrics(req: Request) -> Response:
             from ..stats import REGISTRY

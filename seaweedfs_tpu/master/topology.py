@@ -14,6 +14,7 @@ from __future__ import annotations
 import random
 import threading
 import time
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Optional
 
@@ -246,6 +247,12 @@ class Topology:
         # EC registry: vid -> {shard_id -> [DataNode]} (topology_ec.go:69)
         self.ec_shard_locations: dict[int, dict[int, list[DataNode]]] = {}
         self.ec_collections: dict[int, str] = {}
+        # location-delta broadcast (master_grpc_server.go KeepConnected:
+        # every vid->server add/remove is pushed to connected clients);
+        # long-pollers wait on _watch_cond, bounded history in _loc_events
+        self._watch_cond = threading.Condition(self.lock)
+        self._loc_events: deque[dict] = deque(maxlen=10_000)
+        self.location_seq = 0
 
     # --- registration -----------------------------------------------------
     def get_or_create_dc(self, name: str) -> DataCenter:
@@ -277,7 +284,10 @@ class Topology:
                 if vid not in new_ids:
                     old = node.volumes.pop(vid)
                     self._layout_for_volume(old).unregister(vid, node)
+                    self._emit_location(vid, node, "del")
             for v in volumes:
+                if v.id not in node.volumes:
+                    self._emit_location(v.id, node, "add")
                 node.volumes[v.id] = v
                 self.max_volume_id = max(self.max_volume_id, v.id)
                 self._layout_for_volume(v).register(v, node)
@@ -298,6 +308,8 @@ class Topology:
             for e in ec_infos:
                 old = node.ec_shards.get(e.volume_id)
                 if old is not None:
+                    if old.shard_bits.bits == e.shard_bits.bits:
+                        continue  # unchanged: no churn, no spurious events
                     self._unregister_ec(old, node)
                 node.ec_shards[e.volume_id] = e
                 self._register_ec(e, node)
@@ -305,19 +317,90 @@ class Topology:
     def _register_ec(self, e: EcVolumeInfo, node: DataNode) -> None:
         locs = self.ec_shard_locations.setdefault(e.volume_id, {})
         self.ec_collections[e.volume_id] = e.collection
+        held_before = any(node in ns for ns in locs.values())
         for sid in e.shard_bits.shard_ids():
             nodes = locs.setdefault(sid, [])
             if node not in nodes:
                 nodes.append(node)
+        if not held_before and e.shard_bits.count():
+            self._emit_location(e.volume_id, node, "add", kind="ec")
 
     def _unregister_ec(self, e: EcVolumeInfo, node: DataNode) -> None:
         locs = self.ec_shard_locations.get(e.volume_id, {})
         for sid in e.shard_bits.shard_ids():
             if node in locs.get(sid, []):
                 locs[sid].remove(node)
+        if not any(node in ns for ns in locs.values()):
+            self._emit_location(e.volume_id, node, "del", kind="ec")
         if not any(locs.values()):
             self.ec_shard_locations.pop(e.volume_id, None)
             self.ec_collections.pop(e.volume_id, None)
+
+    # --- location broadcast (wdclient KeepConnected push) -----------------
+    def _emit_location(self, vid: int, node: DataNode, op: str,
+                       kind: str = "volume") -> None:
+        """Called under self.lock."""
+        self.location_seq += 1
+        self._loc_events.append({
+            "seq": self.location_seq, "op": op, "kind": kind, "vid": vid,
+            "url": node.url, "public_url": node.public_url or node.url,
+            "data_center": node.rack.dc.name if node.rack else ""})
+        self._watch_cond.notify_all()
+
+    def location_snapshot(self) -> dict:
+        """Full vid -> locations map (vid_map.go contents)."""
+        with self.lock:
+            vols: dict[str, list[dict]] = {}
+            for node in self.all_nodes():
+                dc = node.rack.dc.name if node.rack else ""
+                loc = {"url": node.url,
+                       "public_url": node.public_url or node.url,
+                       "data_center": dc}
+                for vid in node.volumes:
+                    vols.setdefault(str(vid), []).append(dict(loc))
+            ecs: dict[str, list[dict]] = {}
+            for vid, shards in self.ec_shard_locations.items():
+                seen: dict[str, dict] = {}
+                for nodes in shards.values():
+                    for node in nodes:
+                        seen[node.url] = {
+                            "url": node.url,
+                            "public_url": node.public_url or node.url,
+                            "data_center":
+                                node.rack.dc.name if node.rack else ""}
+                ecs[str(vid)] = list(seen.values())
+            return {"volumes": vols, "ec_volumes": ecs,
+                    "seq": self.location_seq}
+
+    def watch_locations(self, since_seq: int, timeout: float = 14.0) -> dict:
+        """Long-poll: deltas after since_seq, or a snapshot when the
+        client is new / has fallen off the retained history."""
+        deadline = time.time() + timeout
+        with self._watch_cond:
+            oldest = self._loc_events[0]["seq"] if self._loc_events else \
+                self.location_seq + 1
+            # snapshot for new clients (unless the cluster is empty — then
+            # snapshotting would busy-loop them), for cursors that fell
+            # off the retained history, and for cursors AHEAD of us (a
+            # master restart reset the seq; the client must resync)
+            if (since_seq == 0 and self.location_seq > 0) \
+                    or since_seq + 1 < oldest \
+                    or since_seq > self.location_seq:
+                return self.location_snapshot()
+            while self.location_seq <= since_seq:
+                remaining = deadline - time.time()
+                if remaining <= 0:
+                    return {"events": [], "seq": self.location_seq}
+                self._watch_cond.wait(remaining)
+            # events may have been evicted while waiting — never skip
+            # silently, hand back a snapshot instead
+            oldest = self._loc_events[0]["seq"] if self._loc_events else \
+                self.location_seq + 1
+            if since_seq + 1 < oldest:
+                return self.location_snapshot()
+            return {"events": [e for e in self._loc_events
+                               if e["seq"] > since_seq],
+                    "seq": self.location_seq}
 
     def unregister_node(self, node: DataNode) -> None:
         with self.lock:
