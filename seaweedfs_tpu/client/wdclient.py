@@ -94,8 +94,14 @@ class WdClient:
         self._thread.start()
         return self
 
-    def stop(self) -> None:
+    def stop(self, wait: bool = False) -> None:
+        """Signal the poll loop to exit; the daemon thread unparks at the
+        latest when the current long-poll returns (<= poll_timeout).
+        wait=True blocks until it has actually exited."""
         self._stop.set()
+        self._synced.clear()
+        if wait and self._thread is not None:
+            self._thread.join(self.poll_timeout + 11)
 
     def wait_synced(self, timeout: float = 5.0) -> bool:
         return self._synced.wait(timeout)

@@ -10,7 +10,9 @@ event stream under /topics/.system/log, replayable for subscribers.
 
 from __future__ import annotations
 
+import contextlib
 import json
+import random
 import threading
 import time
 from typing import Callable, Iterator, Optional
@@ -47,6 +49,11 @@ class Filer:
         # only the subscriber list (never held across store IO)
         self._log_lock = threading.Lock()
         self._subscribers: list[Callable[[dict], None]] = []
+        # filer signature for sync loop prevention (filer.go Signature:
+        # random id carried in every meta event; filer.sync skips events
+        # already stamped by the peer it would replicate to)
+        self.signature = random.getrandbits(31)
+        self._op_sigs = threading.local()
         if self.store.find_entry("/") is None:
             self.store.insert_entry(new_directory_entry("/", 0o755))
         threading.Thread(target=self._gc_loop, daemon=True,
@@ -228,6 +235,17 @@ class Filer:
             pass
 
     # --- meta log + subscribe (filer_notify.go) ---------------------------
+    @contextlib.contextmanager
+    def op_signatures(self, sigs: list[int]):
+        """Stamp every mutation in this block with extra signatures —
+        used by filer.sync appliers so the resulting events carry the
+        origin filer's signature and are not echoed back."""
+        self._op_sigs.value = list(sigs)
+        try:
+            yield
+        finally:
+            self._op_sigs.value = []
+
     def _notify(self, op: str, old: Optional[Entry], new: Optional[Entry]) -> None:
         event = {
             "ts_ns": time.time_ns(),
@@ -235,6 +253,8 @@ class Filer:
             "directory": (new or old).parent,
             "old_entry": old.to_dict() if old else None,
             "new_entry": new.to_dict() if new else None,
+            "signatures": [self.signature,
+                           *getattr(self._op_sigs, "value", [])],
         }
         # persist append-only: one kv record per event, keyed by day+ts
         # (O(1) per mutation — filer_notify_append.go analog). Store IO is

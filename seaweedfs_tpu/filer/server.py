@@ -40,7 +40,8 @@ class FilerServer:
     def __init__(self, master_url: str, store: Optional[FilerStore] = None,
                  host: str = "127.0.0.1", port: int = 8888,
                  max_chunk_mb: int = 8, collection: str = "",
-                 replication: str = "", guard=None):
+                 replication: str = "", guard=None,
+                 notification_queue=None):
         from ..security import Guard
 
         self.guard = guard or Guard()
@@ -62,6 +63,14 @@ class FilerServer:
         self._conf = FilerConf()
         self._conf_dirty = True
         self.filer.subscribe(self._maybe_mark_conf_dirty, since_ns=time.time_ns())
+        # external notification queue (notification/configuration.go):
+        # every mutation event is published as (path, event)
+        if notification_queue is not None:
+            self.filer.subscribe(
+                lambda ev: notification_queue.send_message(
+                    ((ev.get("new_entry") or ev.get("old_entry"))
+                     or {}).get("full_path", ""), ev),
+                since_ns=time.time_ns())
 
     def _maybe_mark_conf_dirty(self, event: dict) -> None:
         for e in (event.get("new_entry"), event.get("old_entry")):
@@ -79,6 +88,22 @@ class FilerServer:
             except (FilerNotFound, ValueError):
                 self._conf = FilerConf()
         return self._conf
+
+    @staticmethod
+    def _sigs(req) -> list[int]:
+        """Replication signatures from the applier (filer.sync), carried
+        into the resulting meta events for loop prevention."""
+        h = req.headers.get("X-Sync-Signatures", "")
+        sigs = []
+        for x in h.split(","):
+            x = x.strip()
+            if x:
+                try:
+                    sigs.append(int(x))
+                except ValueError:
+                    raise HttpError(400,
+                                    f"bad X-Sync-Signatures value {x!r}")
+        return sigs
 
     def _check_writable(self, path: str) -> None:
         """read_only filer.conf rules gate every mutation — except under
@@ -223,8 +248,15 @@ class FilerServer:
             b = req.json()
             self._check_writable(b["from"])
             self._check_writable(b["to"])
-            moved = self.filer.rename(b["from"], b["to"])
+            with self.filer.op_signatures(self._sigs(req)):
+                moved = self.filer.rename(b["from"], b["to"])
             return Response({"path": moved.full_path})
+
+        @r.route("GET", "/api/info")
+        def api_info(req: Request) -> Response:
+            return Response({"signature": self.filer.signature,
+                             "master": self.master_url,
+                             "version": "seaweedfs-tpu"})
 
         @r.route("GET", "/api/meta/log")
         def api_meta_log(req: Request) -> Response:
@@ -286,7 +318,8 @@ class FilerServer:
             if err:
                 raise HttpError(401, err)
             entry = Entry.from_dict(req.json())
-            self.filer.create_entry(entry)
+            with self.filer.op_signatures(self._sigs(req)):
+                self.filer.create_entry(entry)
             return Response({"path": entry.full_path}, status=201)
 
         @r.route("POST", "/api/mkdir")
@@ -296,7 +329,8 @@ class FilerServer:
                 raise HttpError(401, err)
             path = req.json()["path"].rstrip("/") or "/"
             self._check_writable(path)
-            self.filer._ensure_parents(path)
+            with self.filer.op_signatures(self._sigs(req)):
+                self.filer._ensure_parents(path)
             return Response({"path": path})
 
         @r.route("GET", "(/.*)")
@@ -354,14 +388,16 @@ class FilerServer:
             path = req.match.group(1)
             if path.endswith("/"):
                 self._check_writable(path.rstrip("/") or "/")
-                self.filer._ensure_parents(path.rstrip("/") or "/")
+                with self.filer.op_signatures(self._sigs(req)):
+                    self.filer._ensure_parents(path.rstrip("/") or "/")
                 return Response({"name": path}, status=201)
             mime = req.headers.get("Content-Type", "")
             if mime in ("application/x-www-form-urlencoded", ""):
                 mime = ""
-            entry = self.put_file(path, req.body, mime=mime,
-                                  collection=req.query.get("collection", ""),
-                                  ttl=req.query.get("ttl", ""))
+            with self.filer.op_signatures(self._sigs(req)):
+                entry = self.put_file(path, req.body, mime=mime,
+                                      collection=req.query.get("collection", ""),
+                                      ttl=req.query.get("ttl", ""))
             return Response({"name": entry.name, "size": entry.file_size},
                             status=201)
 
@@ -375,8 +411,9 @@ class FilerServer:
             path = req.match.group(1)
             self._check_writable(path)
             try:
-                self.filer.delete_entry(
-                    path, recursive=req.query.get("recursive") == "true")
+                with self.filer.op_signatures(self._sigs(req)):
+                    self.filer.delete_entry(
+                        path, recursive=req.query.get("recursive") == "true")
             except FilerNotFound:
                 raise HttpError(404, f"{path} not found")
             except NotEmptyError as e:

@@ -1,0 +1,17 @@
+"""Replication / sync / notification plane.
+
+Equivalent of weed/notification/ (pluggable event queues),
+weed/replication/ (replicator + sinks), and the command-level
+filer.sync / filer.backup / filer.meta.backup loops (SURVEY.md §2.8).
+"""
+
+from .notification import (FileQueue, LogQueue, MemoryQueue,
+                           NotificationQueue, load_notification_queue)
+from .replicator import Replicator
+from .sink import FilerSink, LocalSink, ReplicationSink
+
+__all__ = [
+    "NotificationQueue", "MemoryQueue", "FileQueue", "LogQueue",
+    "load_notification_queue", "Replicator", "ReplicationSink",
+    "LocalSink", "FilerSink",
+]

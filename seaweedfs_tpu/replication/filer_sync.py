@@ -1,0 +1,194 @@
+"""filer.sync / filer.backup / filer.meta.backup loops.
+
+Equivalent of weed/command/filer_sync.go (continuous bidirectional
+filer<->filer sync over SubscribeMetadata with signature loop
+prevention), filer_backup.go (one-way data backup to a sink), and
+filer_meta_backup.go (metadata-only backup).  All tail the source
+filer's /api/meta/log poll surface (the reference's gRPC subscribe) and
+checkpoint progress so restarts resume where they left off.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Optional
+
+from ..utils.httpd import HttpError, http_json
+from .replicator import Replicator
+from .sink import FilerSink, ReplicationSink
+
+
+class MetaTailer:
+    """Poll one filer's meta log from a checkpoint, feeding a Replicator."""
+
+    def __init__(self, source_url: str, replicator: Replicator,
+                 checkpoint_path: str = "", since_ns: int = 0,
+                 poll_interval: float = 0.5, path_prefix: str = ""):
+        self.source_url = source_url
+        self.replicator = replicator
+        self.checkpoint_path = checkpoint_path
+        self.poll_interval = poll_interval
+        self.path_prefix = path_prefix
+        self.since_ns = self._load_checkpoint() or since_ns
+        self.applied = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def _load_checkpoint(self) -> int:
+        if self.checkpoint_path and os.path.exists(self.checkpoint_path):
+            with open(self.checkpoint_path) as f:
+                return int(f.read().strip() or 0)
+        return 0
+
+    def _save_checkpoint(self) -> None:
+        if self.checkpoint_path:
+            tmp = self.checkpoint_path + ".tmp"
+            with open(tmp, "w") as f:
+                f.write(str(self.since_ns))
+            os.replace(tmp, self.checkpoint_path)
+
+    def poll_once(self) -> int:
+        """One tail step; returns number of events applied."""
+        q = f"since_ns={self.since_ns}"
+        if self.path_prefix:
+            q += f"&path_prefix={self.path_prefix}"
+        r = http_json("GET",
+                      f"http://{self.source_url}/api/meta/log?{q}")
+        n = 0
+        for event in r["events"]:
+            try:
+                if self.replicator.replicate(event):
+                    n += 1
+            except HttpError:
+                # sink temporarily down: stop here, retry from this event
+                self.since_ns = event["ts_ns"]
+                self._save_checkpoint()
+                raise
+        self.since_ns = r["next_ns"]
+        self.applied += n
+        self._save_checkpoint()
+        return n
+
+    def run_until_caught_up(self, timeout: float = 30.0) -> int:
+        """Apply everything currently in the log (tests / one-shot)."""
+        total = 0
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            n = self.poll_once()
+            total += n
+            if n == 0:
+                return total
+        return total
+
+    def start(self) -> "MetaTailer":
+        def loop() -> None:
+            while not self._stop.is_set():
+                try:
+                    self.poll_once()
+                except Exception:
+                    pass
+                self._stop.wait(self.poll_interval)
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name=f"meta-tail-{self.source_url}")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
+def _filer_signature(url: str) -> int:
+    return int(http_json("GET", f"http://{url}/api/info")["signature"])
+
+
+def make_sync_tailer(source_url: str, target_url: str,
+                     path_prefix: str = "/", checkpoint_dir: str = "",
+                     since_ns: Optional[int] = None) -> MetaTailer:
+    """One direction of filer.sync: tail source, apply to target, stamped
+    with the source's signature so the target's events are not echoed
+    back by the opposite tailer."""
+    source_sig = _filer_signature(source_url)
+    target_sig = _filer_signature(target_url)
+    sink = FilerSink(target_url, signatures=[source_sig])
+    repl = Replicator(sink, source_filer_url=source_url,
+                      path_prefix=path_prefix,
+                      exclude_signatures=[target_sig])
+    ckpt = os.path.join(
+        checkpoint_dir,
+        f"sync.{source_sig}.to.{target_sig}.ckpt") if checkpoint_dir else ""
+    return MetaTailer(
+        source_url, repl, checkpoint_path=ckpt,
+        since_ns=time.time_ns() if since_ns is None else since_ns)
+
+
+def make_backup_tailer(source_url: str, sink: ReplicationSink,
+                       path_prefix: str = "/", checkpoint_path: str = "",
+                       since_ns: int = 0) -> MetaTailer:
+    """filer.backup: one-way continuous data backup (defaults to
+    replaying the full history so the sink converges to a mirror)."""
+    repl = Replicator(sink, source_filer_url=source_url,
+                      path_prefix=path_prefix)
+    return MetaTailer(source_url, repl, checkpoint_path=checkpoint_path,
+                      since_ns=since_ns)
+
+
+class MetaBackup:
+    """filer.meta.backup: metadata-only mirror into a local JSONL store,
+    full snapshot then incremental via the meta log."""
+
+    def __init__(self, source_url: str, store_path: str,
+                 path_prefix: str = "/"):
+        self.source_url = source_url
+        self.store_path = store_path
+        self.path_prefix = path_prefix
+        self.since_ns = 0
+        self.entries: dict[str, dict] = {}
+        self._load()
+
+    def _load(self) -> None:
+        if not os.path.exists(self.store_path):
+            return
+        with open(self.store_path) as f:
+            d = json.load(f)
+        self.since_ns = d.get("since_ns", 0)
+        self.entries = d.get("entries", {})
+
+    def _save(self) -> None:
+        tmp = self.store_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"since_ns": self.since_ns,
+                       "entries": self.entries}, f)
+        os.replace(tmp, self.store_path)
+
+    def full_snapshot(self) -> int:
+        import urllib.parse
+
+        r = http_json(
+            "GET", f"http://{self.source_url}/api/meta/tree?path="
+            + urllib.parse.quote(self.path_prefix))
+        self.entries = {e["full_path"]: e for e in r["entries"]}
+        self.since_ns = time.time_ns()
+        self._save()
+        return len(self.entries)
+
+    def incremental(self) -> int:
+        r = http_json(
+            "GET", f"http://{self.source_url}/api/meta/log?"
+            f"since_ns={self.since_ns}")
+        n = 0
+        for ev in r["events"]:
+            old, new = ev.get("old_entry"), ev.get("new_entry")
+            if old and not new:
+                self.entries.pop(old["full_path"], None)
+            elif new:
+                if old and old["full_path"] != new["full_path"]:
+                    self.entries.pop(old["full_path"], None)
+                self.entries[new["full_path"]] = new
+            n += 1
+        self.since_ns = r["next_ns"]
+        self._save()
+        return n

@@ -1,0 +1,133 @@
+"""Pluggable notification queues for filer meta events.
+
+Equivalent of weed/notification/configuration.go + the plugin dirs
+(log, kafka, aws_sqs, google_pub_sub, gocdk_pub_sub): on every filer
+mutation the (key, EventNotification) pair is published to the
+configured queue.  In this rebuild a queue is anything with
+send_message(key, event); cloud broker clients are gated on their SDKs
+being present (none are baked into this environment — the FileQueue is
+the durable offline equivalent, and MemoryQueue serves in-process
+consumers/tests).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Callable, Iterator, Optional
+
+
+class NotificationQueue:
+    """Interface (notification/configuration.go QueueInterface)."""
+
+    def send_message(self, key: str, event: dict) -> None:
+        raise NotImplementedError
+
+
+class LogQueue(NotificationQueue):
+    """notification/log: just glog the event."""
+
+    def send_message(self, key: str, event: dict) -> None:
+        from ..utils.glog import V
+
+        V(0).infof("notify %s: %s", key, event.get("op", "?"))
+
+
+class MemoryQueue(NotificationQueue):
+    """In-process queue with subscriber fan-out (tests + same-process
+    replicators)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.messages: list[tuple[str, dict]] = []
+        self._subs: list[Callable[[str, dict], None]] = []
+
+    def send_message(self, key: str, event: dict) -> None:
+        with self._lock:
+            self.messages.append((key, event))
+            subs = list(self._subs)
+        for fn in subs:
+            try:
+                fn(key, event)
+            except Exception:
+                pass
+
+    def subscribe(self, fn: Callable[[str, dict], None]) -> None:
+        with self._lock:
+            self._subs.append(fn)
+
+
+class FileQueue(NotificationQueue):
+    """Durable append-only JSONL queue on local disk — the offline
+    stand-in for kafka/sqs topics; filer.replicate consumes it."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._lock = threading.Lock()
+
+    def send_message(self, key: str, event: dict) -> None:
+        line = json.dumps({"key": key, "event": event})
+        with self._lock:
+            with open(self.path, "a") as f:
+                f.write(line + "\n")
+
+    def consume(self, offset: int = 0) -> Iterator[tuple[int, str, dict]]:
+        """Yield (next_offset, key, event) from byte offset."""
+        try:
+            f = open(self.path, "r")
+        except FileNotFoundError:
+            return
+        with f:
+            f.seek(offset)
+            while True:
+                line = f.readline()
+                if not line:
+                    return
+                if line.endswith("\n"):
+                    d = json.loads(line)
+                    yield f.tell(), d["key"], d["event"]
+
+
+class KafkaQueue(NotificationQueue):  # pragma: no cover - SDK not in image
+    """Gated: requires a kafka client library (not baked in)."""
+
+    def __init__(self, hosts: list[str], topic: str):
+        try:
+            import kafka  # noqa: F401
+        except ImportError as e:
+            raise RuntimeError(
+                "kafka notification requires the kafka-python package, "
+                "which is not available in this environment") from e
+
+
+class SqsQueue(NotificationQueue):  # pragma: no cover - SDK not in image
+    """Gated: requires boto3 (not baked in)."""
+
+    def __init__(self, region: str, queue_url: str):
+        try:
+            import boto3  # noqa: F401
+        except ImportError as e:
+            raise RuntimeError(
+                "aws_sqs notification requires boto3, which is not "
+                "available in this environment") from e
+
+
+def load_notification_queue(conf: dict) -> Optional[NotificationQueue]:
+    """notification/configuration.go LoadConfiguration: pick the first
+    enabled section of the notification config."""
+    if not conf or not conf.get("notification", {}).get("enabled", True):
+        return None
+    n = conf.get("notification", conf)
+    if n.get("log", {}).get("enabled"):
+        return LogQueue()
+    if n.get("file", {}).get("enabled"):
+        return FileQueue(n["file"]["path"])
+    if n.get("memory", {}).get("enabled"):
+        return MemoryQueue()
+    if n.get("kafka", {}).get("enabled"):
+        return KafkaQueue(n["kafka"].get("hosts", []),
+                          n["kafka"].get("topic", "seaweedfs"))
+    if n.get("aws_sqs", {}).get("enabled"):
+        return SqsQueue(n["aws_sqs"].get("region", ""),
+                        n["aws_sqs"].get("sqs_queue_name", ""))
+    return None

@@ -1,0 +1,85 @@
+"""Replicator: apply filer meta events to a sink.
+
+Equivalent of weed/replication/replicator.go:23-83 — routes each
+EventNotification to sink create/update/delete, fetching file content
+from the source filer so the sink is cluster-independent.  Also the
+shared engine for filer.backup (sink=LocalSink) and filer.sync
+(sink=FilerSink with signature stamping).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from ..utils.httpd import HttpError, http_bytes
+from .sink import ReplicationSink
+
+
+class Replicator:
+    def __init__(self, sink: ReplicationSink, source_filer_url: str = "",
+                 path_prefix: str = "/",
+                 fetch: Optional[Callable[[str], bytes]] = None,
+                 exclude_signatures: Optional[list[int]] = None):
+        self.sink = sink
+        self.source_filer_url = source_filer_url
+        self.path_prefix = path_prefix.rstrip("/") or "/"
+        self._fetch = fetch
+        # events already stamped by these signatures are skipped
+        # (filer.sync loop prevention, command/filer_sync.go)
+        self.exclude_signatures = set(exclude_signatures or [])
+
+    def _in_scope(self, path: str) -> bool:
+        if self.path_prefix == "/":
+            # system internals never replicate (reference skips
+            # /topics and /etc system dirs in filer.sync/replicate)
+            return not (path.startswith("/topics/")
+                        or path.startswith("/etc/seaweedfs"))
+        return path == self.path_prefix \
+            or path.startswith(self.path_prefix + "/")
+
+    def fetch_content(self, path: str) -> bytes:
+        if self._fetch is not None:
+            return self._fetch(path)
+        status, body, _ = http_bytes(
+            "GET", f"http://{self.source_filer_url}{path}")
+        if status != 200:
+            raise HttpError(status, body.decode(errors="replace"))
+        return body
+
+    def replicate(self, event: dict) -> bool:
+        """Apply one meta event; returns True if it was applied."""
+        if self.exclude_signatures & set(event.get("signatures", [])):
+            return False
+        old, new = event.get("old_entry"), event.get("new_entry")
+        op = event["op"]
+        path = (new or old)["full_path"]
+        if not self._in_scope(path):
+            # a rename may still move data INTO or OUT of scope
+            if not (op == "rename" and old and new
+                    and (self._in_scope(old["full_path"])
+                         or self._in_scope(new["full_path"]))):
+                return False
+        is_dir_bit = 0o20000000000
+        if op == "create":
+            data = None if new["attr"]["mode"] & is_dir_bit \
+                else self.fetch_content(new["full_path"])
+            self.sink.create_entry(new["full_path"], new, data)
+        elif op == "update":
+            data = None if new["attr"]["mode"] & is_dir_bit \
+                else self.fetch_content(new["full_path"])
+            self.sink.update_entry(new["full_path"], new, data)
+        elif op == "delete":
+            self.sink.delete_entry(old["full_path"],
+                                   bool(old["attr"]["mode"] & is_dir_bit))
+        elif op == "rename":
+            if old and self._in_scope(old["full_path"]):
+                self.sink.delete_entry(
+                    old["full_path"],
+                    bool(old["attr"]["mode"] & is_dir_bit))
+            if new and self._in_scope(new["full_path"]):
+                data = None if new["attr"]["mode"] & is_dir_bit \
+                    else self.fetch_content(new["full_path"])
+                self.sink.create_entry(new["full_path"], new, data)
+        else:
+            return False
+        return True

@@ -1,0 +1,161 @@
+"""Replication sinks: targets that filer events are applied to.
+
+Equivalent of weed/replication/sink/ (filersink, localsink, s3sink,
+azuresink/gcssink/b2sink are SDK-gated stubs here).  A sink receives the
+fully-resolved file CONTENT (the replicator fetches chunk bytes from the
+source cluster) — sinks never see source fids, so they work across
+clusters with disjoint volume servers.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+from typing import Optional
+
+from ..utils.httpd import HttpError, http_bytes
+
+
+class ReplicationSink:
+    """sink.ReplicationSink interface (replication/sink/replication_sink.go)."""
+
+    def create_entry(self, key: str, entry: dict,
+                     data: Optional[bytes]) -> None:
+        raise NotImplementedError
+
+    def update_entry(self, key: str, entry: dict,
+                     data: Optional[bytes]) -> None:
+        self.create_entry(key, entry, data)
+
+    def delete_entry(self, key: str, is_directory: bool) -> None:
+        raise NotImplementedError
+
+
+class LocalSink(ReplicationSink):
+    """replication/sink/localsink: mirror into a local directory tree."""
+
+    def __init__(self, root: str):
+        self.root = root.rstrip("/")
+        os.makedirs(self.root, exist_ok=True)
+
+    def _abs(self, key: str) -> str:
+        rel = key.lstrip("/")
+        path = os.path.normpath(os.path.join(self.root, rel))
+        if not (path + "/").startswith(self.root + "/"):
+            raise ValueError(f"path escape: {key!r}")
+        return path
+
+    def create_entry(self, key: str, entry: dict,
+                     data: Optional[bytes]) -> None:
+        path = self._abs(key)
+        if entry.get("attr", {}).get("mode", 0) & 0o20000000000:  # dir bit
+            os.makedirs(path, exist_ok=True)
+            return
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "wb") as f:
+            f.write(data or b"")
+
+    def delete_entry(self, key: str, is_directory: bool) -> None:
+        path = self._abs(key)
+        if is_directory:
+            shutil.rmtree(path, ignore_errors=True)
+        else:
+            try:
+                os.remove(path)
+            except FileNotFoundError:
+                pass
+
+
+class FilerSink(ReplicationSink):
+    """replication/sink/filersink: apply to another filer over HTTP,
+    stamping the origin signatures for sync loop prevention."""
+
+    def __init__(self, filer_url: str, path_prefix: str = "",
+                 signatures: Optional[list[int]] = None):
+        self.filer_url = filer_url
+        self.path_prefix = path_prefix.rstrip("/")
+        self.signatures = signatures or []
+
+    def _headers(self) -> Optional[dict]:
+        if not self.signatures:
+            return None
+        return {"X-Sync-Signatures":
+                ",".join(str(s) for s in self.signatures)}
+
+    def _url(self, key: str) -> str:
+        return f"http://{self.filer_url}{self.path_prefix}{key}"
+
+    def create_entry(self, key: str, entry: dict,
+                     data: Optional[bytes]) -> None:
+        if entry.get("attr", {}).get("mode", 0) & 0o20000000000:
+            status, body, _ = http_bytes(
+                "PUT", self._url(key) + "/", b"", headers=self._headers())
+        else:
+            headers = self._headers() or {}
+            mime = entry.get("attr", {}).get("mime", "")
+            if mime:
+                headers["Content-Type"] = mime
+            status, body, _ = http_bytes(
+                "PUT", self._url(key), data or b"", headers=headers or None)
+        if status not in (200, 201):
+            raise HttpError(status, body.decode(errors="replace"))
+
+    def delete_entry(self, key: str, is_directory: bool) -> None:
+        url = self._url(key) + "?recursive=true"
+        status, body, _ = http_bytes("DELETE", url, headers=self._headers())
+        if status not in (200, 204, 404):
+            raise HttpError(status, body.decode(errors="replace"))
+
+
+class S3Sink(ReplicationSink):
+    """replication/sink/s3sink: PUT objects into an S3 endpoint (ours or
+    any compatible).  SigV4-signed when keys are configured."""
+
+    def __init__(self, endpoint: str, bucket: str, directory: str = "",
+                 access_key: str = "", secret_key: str = ""):
+        self.endpoint = endpoint
+        self.bucket = bucket
+        self.directory = directory.strip("/")
+        self.access_key, self.secret_key = access_key, secret_key
+
+    def _url(self, key: str) -> str:
+        obj = f"{self.directory}{key}" if self.directory else key.lstrip("/")
+        return f"http://{self.endpoint}/{self.bucket}/{obj.lstrip('/')}"
+
+    def _signed(self, method: str, url: str) -> str:
+        if not self.access_key:
+            return url
+        from ..gateway.s3_auth import presign_v4
+
+        return presign_v4(method, url, self.access_key, self.secret_key)
+
+    def create_entry(self, key: str, entry: dict,
+                     data: Optional[bytes]) -> None:
+        if entry.get("attr", {}).get("mode", 0) & 0o20000000000:
+            return  # S3 has no directories
+        url = self._signed("PUT", self._url(key))
+        status, body, _ = http_bytes("PUT", url, data or b"")
+        if status not in (200, 201):
+            raise HttpError(status, body.decode(errors="replace"))
+
+    def delete_entry(self, key: str, is_directory: bool) -> None:
+        if is_directory:
+            return
+        url = self._signed("DELETE", self._url(key))
+        http_bytes("DELETE", url)
+
+
+def load_sink(conf: dict) -> ReplicationSink:
+    """replication/replicator.go sink selection from replication.toml."""
+    if conf.get("sink.local", {}).get("enabled"):
+        return LocalSink(conf["sink.local"]["directory"])
+    if conf.get("sink.filer", {}).get("enabled"):
+        c = conf["sink.filer"]
+        return FilerSink(c["grpcAddress"] if "grpcAddress" in c
+                         else c["address"], c.get("directory", ""))
+    if conf.get("sink.s3", {}).get("enabled"):
+        c = conf["sink.s3"]
+        return S3Sink(c["endpoint"], c["bucket"], c.get("directory", ""),
+                      c.get("aws_access_key_id", ""),
+                      c.get("aws_secret_access_key", ""))
+    raise ValueError("no enabled sink in replication config")

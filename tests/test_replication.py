@@ -1,0 +1,219 @@
+"""Replication plane: notification queues, sinks, replicator,
+bidirectional filer.sync with loop prevention, meta backup.
+
+Reference behaviors: weed/notification/, weed/replication/,
+command/filer_sync.go, command/filer_backup.go, filer_meta_backup.go.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+import pytest
+
+from seaweedfs_tpu.filer.server import FilerServer
+from seaweedfs_tpu.master.server import MasterServer
+from seaweedfs_tpu.replication.filer_sync import (MetaBackup, MetaTailer,
+                                                  make_backup_tailer,
+                                                  make_sync_tailer)
+from seaweedfs_tpu.replication.notification import (FileQueue, MemoryQueue,
+                                                    load_notification_queue)
+from seaweedfs_tpu.replication.replicator import Replicator
+from seaweedfs_tpu.replication.sink import LocalSink, S3Sink, load_sink
+from seaweedfs_tpu.utils.httpd import http_bytes, http_json
+from seaweedfs_tpu.volume_server.server import VolumeServer
+from tests.conftest import free_port
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    """One master, one volume server, TWO filers (for sync tests)."""
+    master = MasterServer(port=free_port(), pulse_seconds=0.4).start()
+    d = tmp_path / "vs0"
+    d.mkdir()
+    vol = VolumeServer([str(d)], master.url, port=free_port(),
+                       pulse_seconds=0.4).start()
+    deadline = time.time() + 5
+    while time.time() < deadline and len(master.topo.all_nodes()) < 1:
+        time.sleep(0.05)
+    queue = MemoryQueue()
+    filer_a = FilerServer(master.url, port=free_port(), max_chunk_mb=1,
+                          notification_queue=queue).start()
+    filer_b = FilerServer(master.url, port=free_port(), max_chunk_mb=1).start()
+    yield master, vol, filer_a, filer_b, queue
+    filer_a.stop()
+    filer_b.stop()
+    vol.stop()
+    master.stop()
+
+
+# --- notification -----------------------------------------------------------
+
+def test_notification_queue_receives_filer_events(cluster):
+    _, _, fa, _, queue = cluster
+    http_bytes("PUT", f"http://{fa.url}/q/a.txt", b"hello")
+    http_bytes("DELETE", f"http://{fa.url}/q/a.txt")
+    keys = [k for k, _ in queue.messages]
+    assert "/q/a.txt" in keys
+    ops = [e["op"] for k, e in queue.messages if k == "/q/a.txt"]
+    assert "create" in ops and "delete" in ops
+
+
+def test_file_queue_roundtrip(tmp_path):
+    q = FileQueue(str(tmp_path / "queue.jsonl"))
+    q.send_message("/a", {"op": "create", "x": 1})
+    q.send_message("/b", {"op": "delete"})
+    got = list(q.consume(0))
+    assert [(k, e["op"]) for _, k, e in got] == \
+        [("/a", "create"), ("/b", "delete")]
+    # resume from offset skips consumed messages
+    mid_offset = got[0][0]
+    rest = list(q.consume(mid_offset))
+    assert [(k) for _, k, _ in rest] == ["/b"]
+
+
+def test_load_notification_queue_selection(tmp_path):
+    q = load_notification_queue({"notification": {
+        "file": {"enabled": True, "path": str(tmp_path / "q.jsonl")}}})
+    assert isinstance(q, FileQueue)
+    assert load_notification_queue({}) is None
+
+
+# --- sinks + replicator -----------------------------------------------------
+
+def test_backup_tailer_mirrors_to_local_dir(cluster, tmp_path):
+    _, _, fa, _, _ = cluster
+    base = f"http://{fa.url}"
+    http_bytes("PUT", base + "/data/sub/one.bin", b"1" * 100)
+    http_bytes("PUT", base + "/data/two.bin", b"22")
+    backup_dir = tmp_path / "mirror"
+    tailer = make_backup_tailer(fa.url, LocalSink(str(backup_dir)),
+                                path_prefix="/data")
+    tailer.run_until_caught_up()
+    assert (backup_dir / "data/sub/one.bin").read_bytes() == b"1" * 100
+    assert (backup_dir / "data/two.bin").read_bytes() == b"22"
+    # incremental: update + delete flow through
+    http_bytes("PUT", base + "/data/two.bin", b"new")
+    http_bytes("DELETE", base + "/data/sub/one.bin")
+    tailer.run_until_caught_up()
+    assert (backup_dir / "data/two.bin").read_bytes() == b"new"
+    assert not (backup_dir / "data/sub/one.bin").exists()
+
+
+def test_backup_tailer_checkpoint_resume(cluster, tmp_path):
+    _, _, fa, _, _ = cluster
+    base = f"http://{fa.url}"
+    ckpt = str(tmp_path / "bk.ckpt")
+    mirror = tmp_path / "m"
+    http_bytes("PUT", base + "/ck/a.txt", b"a")
+    t1 = make_backup_tailer(fa.url, LocalSink(str(mirror)),
+                            path_prefix="/ck", checkpoint_path=ckpt)
+    t1.run_until_caught_up()
+    applied_first = t1.applied
+    assert applied_first >= 1
+    # a new tailer with the same checkpoint must not re-apply history
+    http_bytes("PUT", base + "/ck/b.txt", b"b")
+    t2 = make_backup_tailer(fa.url, LocalSink(str(mirror)),
+                            path_prefix="/ck", checkpoint_path=ckpt)
+    t2.run_until_caught_up()
+    assert t2.applied == 1  # only b.txt
+    assert (mirror / "ck/b.txt").read_bytes() == b"b"
+
+
+def test_local_sink_rejects_path_escape(tmp_path):
+    sink = LocalSink(str(tmp_path / "root"))
+    with pytest.raises(ValueError):
+        sink.create_entry("/../evil.txt", {"attr": {"mode": 0}}, b"x")
+
+
+def test_replicator_skips_system_paths_and_signatures(tmp_path):
+    sink = LocalSink(str(tmp_path / "root"))
+    repl = Replicator(sink, fetch=lambda p: b"data",
+                      exclude_signatures=[42])
+    ev = {"op": "create", "signatures": [7],
+          "new_entry": {"full_path": "/topics/.system/log/x",
+                        "attr": {"mode": 0o660}}, "old_entry": None}
+    assert repl.replicate(ev) is False  # system path
+    ev2 = {"op": "create", "signatures": [7, 42],
+           "new_entry": {"full_path": "/ok.txt", "attr": {"mode": 0o660}},
+           "old_entry": None}
+    assert repl.replicate(ev2) is False  # excluded signature
+    ev3 = dict(ev2, signatures=[7])
+    assert repl.replicate(ev3) is True
+    assert (tmp_path / "root/ok.txt").read_bytes() == b"data"
+
+
+def test_load_sink_selection(tmp_path):
+    sink = load_sink({"sink.local": {"enabled": True,
+                                     "directory": str(tmp_path / "d")}})
+    assert isinstance(sink, LocalSink)
+    s3 = load_sink({"sink.s3": {"enabled": True, "endpoint": "h:1",
+                                "bucket": "b"}})
+    assert isinstance(s3, S3Sink)
+    with pytest.raises(ValueError):
+        load_sink({})
+
+
+# --- filer.sync -------------------------------------------------------------
+
+def test_filer_sync_bidirectional_no_loop(cluster, tmp_path):
+    _, _, fa, fb, _ = cluster
+    a, b = f"http://{fa.url}", f"http://{fb.url}"
+    a2b = make_sync_tailer(fa.url, fb.url, since_ns=1)
+    b2a = make_sync_tailer(fb.url, fa.url, since_ns=1)
+
+    http_bytes("PUT", a + "/s/from_a.txt", b"A")
+    http_bytes("PUT", b + "/s/from_b.txt", b"B")
+    # run both directions to quiescence
+    for _ in range(4):
+        a2b.run_until_caught_up()
+        b2a.run_until_caught_up()
+    st, body, _ = http_bytes("GET", b + "/s/from_a.txt")
+    assert (st, body) == (200, b"A")
+    st, body, _ = http_bytes("GET", a + "/s/from_b.txt")
+    assert (st, body) == (200, b"B")
+    # loop prevention: a fully-caught-up pass applies zero events
+    n1 = a2b.run_until_caught_up()
+    n2 = b2a.run_until_caught_up()
+    assert (n1, n2) == (0, 0)
+    # delete propagates A -> B and does not echo back
+    http_bytes("DELETE", a + "/s/from_a.txt")
+    for _ in range(3):
+        a2b.run_until_caught_up()
+        b2a.run_until_caught_up()
+    assert http_bytes("GET", b + "/s/from_a.txt")[0] == 404
+    assert http_bytes("GET", a + "/s/from_b.txt")[0] == 200
+
+
+def test_filer_sync_rename_propagates(cluster):
+    _, _, fa, fb, _ = cluster
+    a, b = f"http://{fa.url}", f"http://{fb.url}"
+    a2b = make_sync_tailer(fa.url, fb.url, since_ns=1)
+    http_bytes("PUT", a + "/r/old.txt", b"X")
+    a2b.run_until_caught_up()
+    http_json("POST", a + "/api/rename",
+              {"from": "/r/old.txt", "to": "/r/new.txt"})
+    a2b.run_until_caught_up()
+    assert http_bytes("GET", b + "/r/old.txt")[0] == 404
+    st, body, _ = http_bytes("GET", b + "/r/new.txt")
+    assert (st, body) == (200, b"X")
+
+
+# --- meta backup ------------------------------------------------------------
+
+def test_meta_backup_snapshot_and_incremental(cluster, tmp_path):
+    _, _, fa, _, _ = cluster
+    base = f"http://{fa.url}"
+    http_bytes("PUT", base + "/mb/a.txt", b"a")
+    mb = MetaBackup(fa.url, str(tmp_path / "meta.json"), path_prefix="/mb")
+    n = mb.full_snapshot()
+    assert n == 1  # the subtree below /mb: just a.txt
+    http_bytes("PUT", base + "/mb/b.txt", b"b")
+    http_bytes("DELETE", base + "/mb/a.txt")
+    mb.incremental()
+    assert "/mb/b.txt" in mb.entries
+    assert "/mb/a.txt" not in mb.entries
+    # store survives reload
+    mb2 = MetaBackup(fa.url, str(tmp_path / "meta.json"))
+    assert "/mb/b.txt" in mb2.entries

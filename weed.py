@@ -139,6 +139,78 @@ def cmd_backup(args) -> None:
           f"into {args.dir}")
 
 
+def cmd_filer_sync(args) -> None:
+    """Continuous bidirectional filer<->filer sync (command/filer_sync.go)
+    with signature loop prevention."""
+    from seaweedfs_tpu.replication.filer_sync import make_sync_tailer
+
+    a2b = make_sync_tailer(args.a, args.b, path_prefix=args.a_path,
+                           checkpoint_dir=args.ckptDir, since_ns=args.since)
+    tailers = [a2b.start()]
+    if not args.isActivePassive:
+        b2a = make_sync_tailer(args.b, args.a, path_prefix=args.b_path,
+                               checkpoint_dir=args.ckptDir,
+                               since_ns=args.since)
+        tailers.append(b2a.start())
+    mode = "active-passive" if args.isActivePassive else "bidirectional"
+    print(f"filer.sync {mode}: {args.a} <-> {args.b}")
+    _on_interrupt(lambda: [t.stop() for t in tailers])
+    _wait_forever()
+
+
+def cmd_filer_replicate(args) -> None:
+    """Consume filer notifications and apply to a sink
+    (command/filer_replicate.go + replication/replicator.go)."""
+    import tomllib
+
+    from seaweedfs_tpu.replication.filer_sync import make_backup_tailer
+    from seaweedfs_tpu.replication.sink import load_sink
+
+    with open(args.config, "rb") as f:
+        conf = tomllib.load(f)
+    sink = load_sink(conf)
+    tailer = make_backup_tailer(
+        args.filer, sink, path_prefix=args.filerPath,
+        checkpoint_path=args.ckpt, since_ns=args.since).start()
+    print(f"filer.replicate: {args.filer}{args.filerPath} -> {sink.__class__.__name__}")
+    _on_interrupt(tailer.stop)
+    _wait_forever()
+
+
+def cmd_filer_backup(args) -> None:
+    """One-way continuous data backup of a filer path to a local dir
+    (command/filer_backup.go with the localsink)."""
+    from seaweedfs_tpu.replication.filer_sync import make_backup_tailer
+    from seaweedfs_tpu.replication.sink import LocalSink
+
+    tailer = make_backup_tailer(
+        args.filer, LocalSink(args.dir), path_prefix=args.filerPath,
+        checkpoint_path=args.ckpt, since_ns=args.since).start()
+    print(f"filer.backup: {args.filer}{args.filerPath} -> {args.dir}")
+    _on_interrupt(tailer.stop)
+    _wait_forever()
+
+
+def cmd_filer_meta_backup(args) -> None:
+    """Metadata-only backup: snapshot + incremental tail into a local
+    JSON store (command/filer_meta_backup.go)."""
+    from seaweedfs_tpu.replication.filer_sync import MetaBackup
+
+    mb = MetaBackup(args.filer, args.store, path_prefix=args.filerPath)
+    if args.restart or mb.since_ns == 0:
+        n = mb.full_snapshot()
+        print(f"full snapshot: {n} entries")
+    while True:
+        try:
+            n = mb.incremental()
+            if n:
+                print(f"applied {n} meta events")
+        except Exception as e:
+            # transient filer outage must not kill the backup loop
+            print(f"meta.backup poll failed (will retry): {e}")
+        time.sleep(args.pollSeconds)
+
+
 def cmd_shell(args) -> None:
     from seaweedfs_tpu.shell import CommandEnv, repl, run_command
 
@@ -298,6 +370,44 @@ def main(argv=None) -> None:
     bk.add_argument("-dir", default=".")
     bk.add_argument("-collection", default="")
     bk.set_defaults(fn=cmd_backup)
+
+    fsync = sub.add_parser("filer.sync")
+    fsync.add_argument("-a", required=True, help="filer A host:port")
+    fsync.add_argument("-b", required=True, help="filer B host:port")
+    fsync.add_argument("-a.path", dest="a_path", default="/")
+    fsync.add_argument("-b.path", dest="b_path", default="/")
+    fsync.add_argument("-isActivePassive", action="store_true",
+                       help="only sync A -> B")
+    fsync.add_argument("-ckptDir", default=".")
+    fsync.add_argument("-since", type=int, default=None,
+                       help="replay from this ns timestamp (default: now)")
+    fsync.set_defaults(fn=cmd_filer_sync)
+
+    frep = sub.add_parser("filer.replicate")
+    frep.add_argument("-filer", required=True)
+    frep.add_argument("-filerPath", default="/")
+    frep.add_argument("-config", required=True,
+                      help="replication.toml with an enabled sink")
+    frep.add_argument("-ckpt", default="replicate.ckpt")
+    frep.add_argument("-since", type=int, default=0)
+    frep.set_defaults(fn=cmd_filer_replicate)
+
+    fbk = sub.add_parser("filer.backup")
+    fbk.add_argument("-filer", required=True)
+    fbk.add_argument("-filerPath", default="/")
+    fbk.add_argument("-dir", required=True, help="local backup directory")
+    fbk.add_argument("-ckpt", default="filer_backup.ckpt")
+    fbk.add_argument("-since", type=int, default=0)
+    fbk.set_defaults(fn=cmd_filer_backup)
+
+    fmb = sub.add_parser("filer.meta.backup")
+    fmb.add_argument("-filer", required=True)
+    fmb.add_argument("-filerPath", default="/")
+    fmb.add_argument("-store", default="filer_meta_backup.json")
+    fmb.add_argument("-restart", action="store_true",
+                     help="force a fresh full snapshot")
+    fmb.add_argument("-pollSeconds", type=float, default=2.0)
+    fmb.set_defaults(fn=cmd_filer_meta_backup)
 
     sh = sub.add_parser("shell")
     sh.add_argument("-master", default="127.0.0.1:9333")
