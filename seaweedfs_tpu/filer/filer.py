@@ -50,9 +50,14 @@ class Filer:
         self._log_lock = threading.Lock()
         self._subscribers: list[Callable[[dict], None]] = []
         # filer signature for sync loop prevention (filer.go Signature:
-        # random id carried in every meta event; filer.sync skips events
-        # already stamped by the peer it would replicate to)
-        self.signature = random.getrandbits(31)
+        # id carried in every meta event; filer.sync skips events already
+        # stamped by the peer it would replicate to).  Persisted in the
+        # store so sync checkpoints keyed on it survive restarts.
+        sig = self.store.kv_get(b"filer.store.signature")
+        if sig is None:
+            sig = str(random.getrandbits(31)).encode()
+            self.store.kv_put(b"filer.store.signature", sig)
+        self.signature = int(sig)
         self._op_sigs = threading.local()
         if self.store.find_entry("/") is None:
             self.store.insert_entry(new_directory_entry("/", 0o755))

@@ -272,8 +272,18 @@ class FilerServer:
             next_ns = events[-1]["ts_ns"] + 1 if events else since
             if prefix and prefix != "/":
                 prefix = prefix.rstrip("/")
-                events = [e for e in events if e["directory"] == prefix
-                          or e["directory"].startswith(prefix + "/")]
+
+                def _in(e: dict) -> bool:
+                    # match either side so renames across the prefix
+                    # boundary still reach scoped tailers
+                    for ent in (e.get("old_entry"), e.get("new_entry")):
+                        if ent:
+                            p = ent["full_path"]
+                            if p == prefix or p.startswith(prefix + "/"):
+                                return True
+                    return False
+
+                events = [e for e in events if _in(e)]
             return Response({"events": events, "next_ns": next_ns})
 
         @r.route("GET", "/api/meta/tree")

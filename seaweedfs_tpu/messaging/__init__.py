@@ -1,0 +1,10 @@
+"""Messaging broker: pub/sub over filer-persisted topic partitions.
+
+Equivalent of weed/messaging/broker/ (broker_server.go, topic_manager.go,
+broker_append.go, consistent_distribution.go).
+"""
+
+from .broker import BrokerServer, TopicManager
+from .consistent import ConsistentDistribution
+
+__all__ = ["BrokerServer", "TopicManager", "ConsistentDistribution"]

@@ -1,0 +1,329 @@
+"""Message broker: topics -> partitions -> filer-persisted segments with
+in-memory fan-out to live subscribers.
+
+Equivalent of weed/messaging/broker/:
+- broker_server.go:16-48  — server wiring against filer + master
+- topic_manager.go        — per-topic-partition lock with cond broadcast
+- broker_append.go        — messages appended to filer files per partition
+- consistent_distribution.go — partition ownership across brokers
+  (see consistent.py; publish to a non-owner redirects to the owner)
+
+Messages are JSON {key, value(base64), headers, ts_ns, offset}; each
+partition persists segments under
+/topics/<namespace>/<topic>/<partition>/ in the filer, so a broker
+restart replays history (the reference's files-as-log design).
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import threading
+import time
+from typing import Optional
+
+from ..utils.httpd import (HttpError, Request, Response, Router, http_bytes,
+                           http_json, serve)
+from .consistent import ConsistentDistribution
+
+TOPICS_ROOT = "/topics"
+SEGMENT_FLUSH_COUNT = 256
+
+
+def partition_of(key: str, partition_count: int) -> int:
+    """Stable key -> partition routing (broker_grpc_server_publish.go
+    uses a hash of the message key)."""
+    import hashlib
+
+    if not key:
+        return 0
+    digest = hashlib.md5(key.encode()).digest()
+    return int.from_bytes(digest[:4], "big") % partition_count
+
+
+class Partition:
+    """One topic partition: in-memory tail + persisted segments."""
+
+    def __init__(self, flush_fn=None):
+        self.lock = threading.Lock()
+        self.cond = threading.Condition(self.lock)
+        self.messages: list[dict] = []  # full in-memory history
+        self.flushed_upto = 0
+        self._flush_fn = flush_fn
+
+    def publish(self, msg: dict) -> int:
+        with self.lock:
+            msg["offset"] = len(self.messages)
+            self.messages.append(msg)
+            self.cond.notify_all()
+            need_flush = (len(self.messages) - self.flushed_upto
+                          >= SEGMENT_FLUSH_COUNT)
+        if need_flush and self._flush_fn is not None:
+            self._flush_fn()
+        return msg["offset"]
+
+    def read(self, offset: int, timeout: float = 0.0,
+             max_messages: int = 1000) -> list[dict]:
+        deadline = time.time() + timeout
+        with self.lock:
+            while len(self.messages) <= offset:
+                remaining = deadline - time.time()
+                if remaining <= 0:
+                    return []
+                self.cond.wait(remaining)
+            return self.messages[offset:offset + max_messages]
+
+
+class TopicManager:
+    """topic_manager.go: lazily-created TopicControl per
+    (namespace, topic, partition)."""
+
+    def __init__(self, persist=None):
+        self._lock = threading.Lock()
+        self._partitions: dict[tuple[str, str, int], Partition] = {}
+        self._persist = persist  # callable(ns, topic, p, messages)
+
+    def partition(self, ns: str, topic: str, p: int) -> Partition:
+        key = (ns, topic, p)
+        with self._lock:
+            part = self._partitions.get(key)
+            if part is None:
+                flush = (lambda k=key: self.flush_partition(*k)) \
+                    if self._persist else None
+                part = self._partitions[key] = Partition(flush)
+            return part
+
+    def topics(self) -> list[tuple[str, str, int]]:
+        with self._lock:
+            return sorted(self._partitions)
+
+    def flush_partition(self, ns: str, topic: str, p: int) -> int:
+        """Persist the unflushed tail as one segment file."""
+        part = self.partition(ns, topic, p)
+        with part.lock:
+            tail = part.messages[part.flushed_upto:]
+            start = part.flushed_upto
+            if not tail:
+                return 0
+            part.flushed_upto = len(part.messages)
+        try:
+            self._persist(ns, topic, p, start, tail)
+        except Exception:
+            with part.lock:  # roll back so a later flush retries
+                part.flushed_upto = min(part.flushed_upto, start)
+            raise
+        return len(tail)
+
+    def flush_all(self) -> None:
+        for key in self.topics():
+            try:
+                self.flush_partition(*key)
+            except Exception:
+                pass
+
+
+class BrokerServer:
+    """HTTP pub/sub broker backed by a filer for persistence.
+
+    Endpoints:
+      POST /publish   {namespace, topic, key, value(b64), headers}
+      GET  /subscribe ?namespace=&topic=&partition=&offset=&timeout=
+      GET  /status
+    With peers configured, partition ownership rides the consistent ring
+    and a publish/subscribe for a partition owned elsewhere answers 307
+    with the owner's address.
+    """
+
+    def __init__(self, filer_url: str = "", host: str = "127.0.0.1",
+                 port: int = 9777, partition_count: int = 4,
+                 peers: Optional[list[str]] = None,
+                 flush_interval: float = 1.0):
+        self.filer_url = filer_url
+        self.host, self.port = host, port
+        self.partition_count = partition_count
+        self.topic_manager = TopicManager(
+            persist=self._persist_segment if filer_url else None)
+        self.ring = ConsistentDistribution(
+            [*(peers or []), f"{host}:{port}"])
+        self.router = Router("broker")
+        self._register_routes()
+        self._server = None
+        self._stop = threading.Event()
+        self._flush_interval = flush_interval
+        self._loaded: set[tuple[str, str, int]] = set()
+
+    @property
+    def url(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def start(self) -> "BrokerServer":
+        self._server = serve(self.router, self.host, self.port)
+        threading.Thread(target=self._flush_loop, daemon=True,
+                         name="broker-flush").start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._server:
+            self._server.shutdown()
+        self.topic_manager.flush_all()
+
+    def _flush_loop(self) -> None:
+        while not self._stop.wait(self._flush_interval):
+            self.topic_manager.flush_all()
+
+    # --- persistence (broker_append.go) -----------------------------------
+    def _segment_dir(self, ns: str, topic: str, p: int) -> str:
+        return f"{TOPICS_ROOT}/{ns}/{topic}/{p:04d}"
+
+    def _persist_segment(self, ns: str, topic: str, p: int,
+                         start_offset: int, messages: list[dict]) -> None:
+        body = "\n".join(json.dumps(m) for m in messages).encode()
+        path = (f"{self._segment_dir(ns, topic, p)}/"
+                f"{start_offset:012d}.seg")
+        status, out, _ = http_bytes(
+            "PUT", f"http://{self.filer_url}{path}", body)
+        if status not in (200, 201):
+            raise HttpError(status, out.decode(errors="replace"))
+
+    def _maybe_load(self, ns: str, topic: str, p: int) -> Partition:
+        """Replay persisted segments on first touch after a restart."""
+        part = self.topic_manager.partition(ns, topic, p)
+        key = (ns, topic, p)
+        if key in self._loaded or not self.filer_url:
+            return part
+        self._loaded.add(key)
+        listing_url = (f"http://{self.filer_url}"
+                       f"{self._segment_dir(ns, topic, p)}")
+        status, body, _ = http_bytes("GET", listing_url)
+        if status != 200:
+            return part  # nothing persisted yet
+        names = sorted(e["FullPath"] for e in json.loads(body)["Entries"]
+                       if e["FullPath"].endswith(".seg"))
+        with part.lock:
+            if part.messages:
+                return part  # raced a concurrent publish; keep live data
+            for seg in names:
+                s, blob, _ = http_bytes("GET",
+                                        f"http://{self.filer_url}{seg}")
+                if s != 200:
+                    continue
+                for line in blob.decode().splitlines():
+                    if line.strip():
+                        part.messages.append(json.loads(line))
+            part.flushed_upto = len(part.messages)
+            # offsets are re-derived from position after replay
+            for i, m in enumerate(part.messages):
+                m["offset"] = i
+        return part
+
+    # --- ownership --------------------------------------------------------
+    def _owner(self, ns: str, topic: str, p: int) -> str:
+        return self.ring.locate(f"{ns}/{topic}/{p}")
+
+    # --- routes -----------------------------------------------------------
+    def _register_routes(self) -> None:
+        r = self.router
+
+        @r.route("POST", "/publish")
+        def publish(req: Request) -> Response:
+            b = req.json()
+            ns = b.get("namespace", "default")
+            topic = b["topic"]
+            key = b.get("key", "")
+            p = b.get("partition")
+            if p is None:
+                p = partition_of(key, self.partition_count)
+            owner = self._owner(ns, topic, p)
+            if owner != self.url:
+                return Response({"owner": owner}, status=307,
+                                headers={"Location": f"http://{owner}/publish"})
+            part = self._maybe_load(ns, topic, p)
+            msg = {"key": key, "value": b.get("value", ""),
+                   "headers": b.get("headers", {}),
+                   "ts_ns": time.time_ns()}
+            offset = part.publish(msg)
+            return Response({"partition": p, "offset": offset})
+
+        @r.route("GET", "/subscribe")
+        def subscribe(req: Request) -> Response:
+            ns = req.query.get("namespace", "default")
+            topic = req.query.get("topic", "")
+            if not topic:
+                raise HttpError(400, "topic required")
+            p = int(req.query.get("partition") or 0)
+            offset = int(req.query.get("offset") or 0)
+            timeout = min(float(req.query.get("timeout") or 0), 55.0)
+            owner = self._owner(ns, topic, p)
+            if owner != self.url:
+                return Response({"owner": owner}, status=307, headers={
+                    "Location": f"http://{owner}/subscribe"})
+            part = self._maybe_load(ns, topic, p)
+            msgs = part.read(offset, timeout=timeout)
+            next_offset = msgs[-1]["offset"] + 1 if msgs else offset
+            return Response({"messages": msgs, "next_offset": next_offset})
+
+        @r.route("GET", "/status")
+        def status(req: Request) -> Response:
+            return Response({
+                "brokers": self.ring.members(),
+                "partition_count": self.partition_count,
+                "topics": [
+                    {"namespace": ns, "topic": t, "partition": p,
+                     "messages": len(self.topic_manager
+                                     .partition(ns, t, p).messages)}
+                    for ns, t, p in self.topic_manager.topics()],
+            })
+
+
+class MessagingClient:
+    """Minimal publisher/subscriber following 307 ownership redirects
+    (messaging/msgclient of the reference)."""
+
+    def __init__(self, broker_url: str, partition_count: int = 4):
+        self.broker_url = broker_url
+        self.partition_count = partition_count
+
+    def publish(self, topic: str, value: bytes, key: str = "",
+                namespace: str = "default",
+                headers: Optional[dict] = None) -> tuple[int, int]:
+        payload = {"namespace": namespace, "topic": topic, "key": key,
+                   "value": base64.b64encode(value).decode(),
+                   "headers": headers or {}}
+        url = f"http://{self.broker_url}/publish"
+        for _ in range(3):
+            status, body, hdrs = http_bytes(
+                "POST", url, json.dumps(payload).encode(),
+                follow_redirects=False)
+            if status == 307:
+                url = hdrs.get("Location", url)
+                continue
+            if status != 200:
+                raise HttpError(status, body.decode(errors="replace"))
+            d = json.loads(body)
+            return d["partition"], d["offset"]
+        raise HttpError(508, "redirect loop resolving partition owner")
+
+    def subscribe(self, topic: str, partition: int = 0, offset: int = 0,
+                  namespace: str = "default",
+                  timeout: float = 0.0) -> tuple[list[dict], int]:
+        url = (f"http://{self.broker_url}/subscribe?namespace={namespace}"
+               f"&topic={topic}&partition={partition}&offset={offset}"
+               f"&timeout={timeout}")
+        for _ in range(3):
+            status, body, hdrs = http_bytes("GET", url,
+                                            follow_redirects=False)
+            if status == 307:
+                loc = hdrs.get("Location", "")
+                url = (f"{loc}?namespace={namespace}&topic={topic}"
+                       f"&partition={partition}&offset={offset}"
+                       f"&timeout={timeout}")
+                continue
+            if status != 200:
+                raise HttpError(status, body.decode(errors="replace"))
+            d = json.loads(body)
+            msgs = d["messages"]
+            for m in msgs:
+                m["value_bytes"] = base64.b64decode(m["value"])
+            return msgs, d["next_offset"]
+        raise HttpError(508, "redirect loop resolving partition owner")

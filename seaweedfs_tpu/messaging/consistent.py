@@ -1,0 +1,50 @@
+"""Consistent hashing for topic-partition -> broker placement.
+
+Equivalent of weed/messaging/broker/consistent_distribution.go (which
+wraps buraksezer/consistent with bounded loads): a hash ring with
+virtual nodes; adding/removing a broker only remaps the partitions that
+hashed to it.
+"""
+
+from __future__ import annotations
+
+import bisect
+import hashlib
+
+
+def _hash(key: str) -> int:
+    return int.from_bytes(hashlib.md5(key.encode()).digest()[:8], "big")
+
+
+class ConsistentDistribution:
+    def __init__(self, members: list[str] = (), replicas: int = 100):
+        self.replicas = replicas
+        self._ring: list[tuple[int, str]] = []
+        self._members: set[str] = set()
+        for m in members:
+            self.add(m)
+
+    def add(self, member: str) -> None:
+        if member in self._members:
+            return
+        self._members.add(member)
+        for i in range(self.replicas):
+            point = (_hash(f"{member}#{i}"), member)
+            bisect.insort(self._ring, point)
+
+    def remove(self, member: str) -> None:
+        if member not in self._members:
+            return
+        self._members.discard(member)
+        self._ring = [(h, m) for h, m in self._ring if m != member]
+
+    def members(self) -> list[str]:
+        return sorted(self._members)
+
+    def locate(self, key: str) -> str:
+        """Owner broker for a partition key."""
+        if not self._ring:
+            raise ValueError("no brokers in the ring")
+        h = _hash(key)
+        idx = bisect.bisect_right(self._ring, (h, "￿")) % len(self._ring)
+        return self._ring[idx][1]

@@ -122,7 +122,8 @@ def make_sync_tailer(source_url: str, target_url: str,
         f"sync.{source_sig}.to.{target_sig}.ckpt") if checkpoint_dir else ""
     return MetaTailer(
         source_url, repl, checkpoint_path=ckpt,
-        since_ns=time.time_ns() if since_ns is None else since_ns)
+        since_ns=time.time_ns() if since_ns is None else since_ns,
+        path_prefix=path_prefix if path_prefix != "/" else "")
 
 
 def make_backup_tailer(source_url: str, sink: ReplicationSink,
@@ -133,7 +134,8 @@ def make_backup_tailer(source_url: str, sink: ReplicationSink,
     repl = Replicator(sink, source_filer_url=source_url,
                       path_prefix=path_prefix)
     return MetaTailer(source_url, repl, checkpoint_path=checkpoint_path,
-                      since_ns=since_ns)
+                      since_ns=since_ns,
+                      path_prefix=path_prefix if path_prefix != "/" else "")
 
 
 class MetaBackup:
@@ -164,29 +166,42 @@ class MetaBackup:
                        "entries": self.entries}, f)
         os.replace(tmp, self.store_path)
 
+    def _in_scope(self, path: str) -> bool:
+        if self.path_prefix in ("", "/"):
+            return True
+        p = self.path_prefix.rstrip("/")
+        return path == p or path.startswith(p + "/")
+
     def full_snapshot(self) -> int:
         import urllib.parse
 
+        # stamp BEFORE the walk: entries created mid-walk may be missed
+        # by the tree fetch but their events replay via incremental()
+        start_ns = time.time_ns()
         r = http_json(
             "GET", f"http://{self.source_url}/api/meta/tree?path="
             + urllib.parse.quote(self.path_prefix))
         self.entries = {e["full_path"]: e for e in r["entries"]}
-        self.since_ns = time.time_ns()
+        self.since_ns = start_ns
         self._save()
         return len(self.entries)
 
     def incremental(self) -> int:
+        import urllib.parse
+
+        q = f"since_ns={self.since_ns}"
+        if self.path_prefix not in ("", "/"):
+            q += ("&path_prefix="
+                  + urllib.parse.quote(self.path_prefix.rstrip("/")))
         r = http_json(
-            "GET", f"http://{self.source_url}/api/meta/log?"
-            f"since_ns={self.since_ns}")
+            "GET", f"http://{self.source_url}/api/meta/log?{q}")
         n = 0
         for ev in r["events"]:
             old, new = ev.get("old_entry"), ev.get("new_entry")
-            if old and not new:
+            if old and self._in_scope(old["full_path"]) \
+                    and (not new or old["full_path"] != new["full_path"]):
                 self.entries.pop(old["full_path"], None)
-            elif new:
-                if old and old["full_path"] != new["full_path"]:
-                    self.entries.pop(old["full_path"], None)
+            if new and self._in_scope(new["full_path"]):
                 self.entries[new["full_path"]] = new
             n += 1
         self.since_ns = r["next_ns"]

@@ -9,8 +9,10 @@ shared engine for filer.backup (sink=LocalSink) and filer.sync
 
 from __future__ import annotations
 
+import urllib.parse
 from typing import Callable, Optional
 
+from ..filer.entry import DIRECTORY_MODE_BIT
 from ..utils.httpd import HttpError, http_bytes
 from .sink import ReplicationSink
 
@@ -41,7 +43,8 @@ class Replicator:
         if self._fetch is not None:
             return self._fetch(path)
         status, body, _ = http_bytes(
-            "GET", f"http://{self.source_filer_url}{path}")
+            "GET", f"http://{self.source_filer_url}"
+            + urllib.parse.quote(path))
         if status != 200:
             raise HttpError(status, body.decode(errors="replace"))
         return body
@@ -59,27 +62,44 @@ class Replicator:
                     and (self._in_scope(old["full_path"])
                          or self._in_scope(new["full_path"]))):
                 return False
-        is_dir_bit = 0o20000000000
-        if op == "create":
-            data = None if new["attr"]["mode"] & is_dir_bit \
-                else self.fetch_content(new["full_path"])
-            self.sink.create_entry(new["full_path"], new, data)
-        elif op == "update":
-            data = None if new["attr"]["mode"] & is_dir_bit \
-                else self.fetch_content(new["full_path"])
-            self.sink.update_entry(new["full_path"], new, data)
+        return self.replicate_op(op, old, new)
+
+    def _content_or_none(self, entry: dict) -> tuple[Optional[bytes], bool]:
+        """(data, gone): fetch file content; gone=True when the source
+        entry vanished (a later delete event handles it — retrying a 404
+        forever would wedge the tailer behind this event)."""
+        if entry["attr"]["mode"] & DIRECTORY_MODE_BIT:
+            return None, False
+        try:
+            return self.fetch_content(entry["full_path"]), False
+        except HttpError as e:
+            if e.status == 404:
+                return None, True
+            raise
+
+    def replicate_op(self, op: str, old: Optional[dict],
+                     new: Optional[dict]) -> bool:
+        if op == "create" or op == "update":
+            data, gone = self._content_or_none(new)
+            if gone:
+                return False
+            if op == "create":
+                self.sink.create_entry(new["full_path"], new, data)
+            else:
+                self.sink.update_entry(new["full_path"], new, data)
         elif op == "delete":
-            self.sink.delete_entry(old["full_path"],
-                                   bool(old["attr"]["mode"] & is_dir_bit))
+            self.sink.delete_entry(
+                old["full_path"],
+                bool(old["attr"]["mode"] & DIRECTORY_MODE_BIT))
         elif op == "rename":
             if old and self._in_scope(old["full_path"]):
                 self.sink.delete_entry(
                     old["full_path"],
-                    bool(old["attr"]["mode"] & is_dir_bit))
+                    bool(old["attr"]["mode"] & DIRECTORY_MODE_BIT))
             if new and self._in_scope(new["full_path"]):
-                data = None if new["attr"]["mode"] & is_dir_bit \
-                    else self.fetch_content(new["full_path"])
-                self.sink.create_entry(new["full_path"], new, data)
+                data, gone = self._content_or_none(new)
+                if not gone:
+                    self.sink.create_entry(new["full_path"], new, data)
         else:
             return False
         return True

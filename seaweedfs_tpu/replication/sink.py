@@ -11,9 +11,15 @@ from __future__ import annotations
 
 import os
 import shutil
+import urllib.parse
 from typing import Optional
 
+from ..filer.entry import DIRECTORY_MODE_BIT
 from ..utils.httpd import HttpError, http_bytes
+
+
+def _is_dir(entry: dict) -> bool:
+    return bool(entry.get("attr", {}).get("mode", 0) & DIRECTORY_MODE_BIT)
 
 
 class ReplicationSink:
@@ -48,7 +54,7 @@ class LocalSink(ReplicationSink):
     def create_entry(self, key: str, entry: dict,
                      data: Optional[bytes]) -> None:
         path = self._abs(key)
-        if entry.get("attr", {}).get("mode", 0) & 0o20000000000:  # dir bit
+        if _is_dir(entry):
             os.makedirs(path, exist_ok=True)
             return
         os.makedirs(os.path.dirname(path), exist_ok=True)
@@ -83,11 +89,12 @@ class FilerSink(ReplicationSink):
                 ",".join(str(s) for s in self.signatures)}
 
     def _url(self, key: str) -> str:
-        return f"http://{self.filer_url}{self.path_prefix}{key}"
+        return (f"http://{self.filer_url}"
+                + urllib.parse.quote(f"{self.path_prefix}{key}"))
 
     def create_entry(self, key: str, entry: dict,
                      data: Optional[bytes]) -> None:
-        if entry.get("attr", {}).get("mode", 0) & 0o20000000000:
+        if _is_dir(entry):
             status, body, _ = http_bytes(
                 "PUT", self._url(key) + "/", b"", headers=self._headers())
         else:
@@ -120,7 +127,8 @@ class S3Sink(ReplicationSink):
 
     def _url(self, key: str) -> str:
         obj = f"{self.directory}{key}" if self.directory else key.lstrip("/")
-        return f"http://{self.endpoint}/{self.bucket}/{obj.lstrip('/')}"
+        return (f"http://{self.endpoint}/{self.bucket}/"
+                + urllib.parse.quote(obj.lstrip("/")))
 
     def _signed(self, method: str, url: str) -> str:
         if not self.access_key:
@@ -131,7 +139,7 @@ class S3Sink(ReplicationSink):
 
     def create_entry(self, key: str, entry: dict,
                      data: Optional[bytes]) -> None:
-        if entry.get("attr", {}).get("mode", 0) & 0o20000000000:
+        if _is_dir(entry):
             return  # S3 has no directories
         url = self._signed("PUT", self._url(key))
         status, body, _ = http_bytes("PUT", url, data or b"")

@@ -1,0 +1,158 @@
+"""Messaging broker: consistent hashing, pub/sub, filer persistence.
+
+Reference behaviors: weed/messaging/broker/ (topic_manager.go cond
+broadcast, broker_append.go files-as-log, consistent_distribution.go).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from seaweedfs_tpu.filer.server import FilerServer
+from seaweedfs_tpu.master.server import MasterServer
+from seaweedfs_tpu.messaging.broker import (BrokerServer, MessagingClient,
+                                            partition_of)
+from seaweedfs_tpu.messaging.consistent import ConsistentDistribution
+from seaweedfs_tpu.volume_server.server import VolumeServer
+from tests.conftest import free_port
+
+
+# --- consistent hashing -----------------------------------------------------
+
+def test_consistent_distribution_stability():
+    ring = ConsistentDistribution(["b1:1", "b2:1", "b3:1"])
+    keys = [f"topic/{i}" for i in range(1000)]
+    before = {k: ring.locate(k) for k in keys}
+    # all members used
+    assert set(before.values()) == {"b1:1", "b2:1", "b3:1"}
+    # adding a member moves only a minority of keys
+    ring.add("b4:1")
+    after = {k: ring.locate(k) for k in keys}
+    moved = sum(1 for k in keys if before[k] != after[k])
+    assert 0 < moved < 500
+    # every moved key moved TO the new member
+    assert all(after[k] == "b4:1" for k in keys if before[k] != after[k])
+    # removing it restores the original mapping exactly
+    ring.remove("b4:1")
+    assert {k: ring.locate(k) for k in keys} == before
+
+
+def test_partition_of_stable_and_in_range():
+    assert partition_of("", 4) == 0
+    ps = {partition_of(f"k{i}", 4) for i in range(100)}
+    assert ps <= {0, 1, 2, 3} and len(ps) > 1
+    assert partition_of("samekey", 4) == partition_of("samekey", 4)
+
+
+# --- in-memory pub/sub ------------------------------------------------------
+
+@pytest.fixture
+def broker():
+    b = BrokerServer(port=free_port(), partition_count=4).start()
+    yield b
+    b.stop()
+
+
+def test_publish_subscribe_roundtrip(broker):
+    c = MessagingClient(broker.url)
+    p1, o1 = c.publish("events", b"one", key="k")
+    p2, o2 = c.publish("events", b"two", key="k")
+    assert p1 == p2 and o2 == o1 + 1  # same key -> same partition, ordered
+    msgs, next_off = c.subscribe("events", partition=p1, offset=o1)
+    assert [m["value_bytes"] for m in msgs] == [b"one", b"two"]
+    assert next_off == o2 + 1
+    # offset resume
+    msgs2, _ = c.subscribe("events", partition=p1, offset=next_off)
+    assert msgs2 == []
+
+
+def test_subscribe_longpoll_wakes_on_publish(broker):
+    c = MessagingClient(broker.url)
+    p, _ = c.publish("wake", b"seed", key="x")
+    got: list = []
+
+    def waiter():
+        msgs, _ = c.subscribe("wake", partition=p, offset=1, timeout=5.0)
+        got.extend(msgs)
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.3)
+    c.publish("wake", b"ping", key="x")
+    t.join(6)
+    assert [m["value_bytes"] for m in got] == [b"ping"]
+
+
+# --- filer persistence ------------------------------------------------------
+
+@pytest.fixture
+def stack(tmp_path):
+    master = MasterServer(port=free_port(), pulse_seconds=0.4).start()
+    d = tmp_path / "vs0"
+    d.mkdir()
+    vol = VolumeServer([str(d)], master.url, port=free_port(),
+                       pulse_seconds=0.4).start()
+    deadline = time.time() + 5
+    while time.time() < deadline and len(master.topo.all_nodes()) < 1:
+        time.sleep(0.05)
+    filer = FilerServer(master.url, port=free_port(), max_chunk_mb=1).start()
+    yield master, vol, filer
+    filer.stop()
+    vol.stop()
+    master.stop()
+
+
+def test_broker_persists_and_replays_from_filer(stack):
+    _, _, filer = stack
+    port = free_port()
+    b1 = BrokerServer(filer_url=filer.url, port=port,
+                      partition_count=2).start()
+    c = MessagingClient(b1.url)
+    p, _ = c.publish("orders", b"m1", key="a")
+    c.publish("orders", b"m2", key="a")
+    b1.stop()  # flushes segments to the filer
+
+    # a fresh broker on the same filer replays history
+    b2 = BrokerServer(filer_url=filer.url, port=free_port(),
+                      partition_count=2).start()
+    try:
+        c2 = MessagingClient(b2.url)
+        msgs, next_off = c2.subscribe("orders", partition=p, offset=0)
+        assert [m["value_bytes"] for m in msgs] == [b"m1", b"m2"]
+        # continue publishing; offsets continue from replayed history
+        p3, o3 = c2.publish("orders", b"m3", key="a")
+        assert (p3, o3) == (p, next_off)
+    finally:
+        b2.stop()
+
+
+def test_broker_ownership_redirect():
+    portA, portB = free_port(), free_port()
+    a = BrokerServer(port=portA, partition_count=8,
+                     peers=[f"127.0.0.1:{portB}"]).start()
+    b = BrokerServer(port=portB, partition_count=8,
+                     peers=[f"127.0.0.1:{portA}"]).start()
+    try:
+        c = MessagingClient(a.url)
+        # publish enough keys that both brokers own some partitions
+        owners = {a.url: 0, b.url: 0}
+        for i in range(16):
+            p = i % 8
+            owner = a.ring.locate(f"default/spread/{p}")
+            owners[owner] += 1
+        assert all(v > 0 for v in owners.values()), owners
+        # client-side redirect: publishing via A lands on the right owner
+        for i in range(8):
+            part, off = c.publish("spread", f"v{i}".encode(),
+                                  key=f"key{i}")
+            owner = a.ring.locate(f"default/spread/{part}")
+            owner_broker = a if owner == a.url else b
+            msgs = owner_broker.topic_manager.partition(
+                "default", "spread", part).messages
+            assert any(m["key"] == f"key{i}" for m in msgs)
+    finally:
+        a.stop()
+        b.stop()

@@ -211,6 +211,21 @@ def cmd_filer_meta_backup(args) -> None:
         time.sleep(args.pollSeconds)
 
 
+def cmd_msg_broker(args) -> None:
+    """Pub/sub message broker backed by the filer
+    (command/msg_broker.go)."""
+    from seaweedfs_tpu.messaging.broker import BrokerServer
+
+    peers = [p for p in args.peers.split(",") if p]
+    b = BrokerServer(filer_url=args.filer, port=args.port,
+                     partition_count=args.partitionCount,
+                     peers=peers).start()
+    print(f"msgBroker on :{args.port} "
+          f"(filer={args.filer or 'none: in-memory only'})")
+    _on_interrupt(b.stop)
+    _wait_forever()
+
+
 def cmd_shell(args) -> None:
     from seaweedfs_tpu.shell import CommandEnv, repl, run_command
 
@@ -408,6 +423,13 @@ def main(argv=None) -> None:
                      help="force a fresh full snapshot")
     fmb.add_argument("-pollSeconds", type=float, default=2.0)
     fmb.set_defaults(fn=cmd_filer_meta_backup)
+
+    mb = sub.add_parser("msgBroker")
+    mb.add_argument("-filer", default="", help="filer host:port for persistence")
+    mb.add_argument("-port", type=int, default=9777)
+    mb.add_argument("-partitionCount", type=int, default=4)
+    mb.add_argument("-peers", default="", help="other broker host:ports")
+    mb.set_defaults(fn=cmd_msg_broker)
 
     sh = sub.add_parser("shell")
     sh.add_argument("-master", default="127.0.0.1:9333")
