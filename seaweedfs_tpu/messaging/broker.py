@@ -118,8 +118,11 @@ class TopicManager:
         for key in self.topics():
             try:
                 self.flush_partition(*key)
-            except Exception:
-                pass
+            except Exception as e:
+                from ..utils.glog import warningf
+
+                warningf("broker: flush of %s failed (will retry): %s",
+                         "/".join(map(str, key)), e)
 
 
 class BrokerServer:
@@ -151,6 +154,11 @@ class BrokerServer:
         self._stop = threading.Event()
         self._flush_interval = flush_interval
         self._loaded: set[tuple[str, str, int]] = set()
+        # serializes first-touch replay per partition: publishers and
+        # subscribers both enter _maybe_load, so holding this lock keeps
+        # the partition quiescent until history is spliced in
+        self._load_lock = threading.Lock()
+        self._load_locks: dict[tuple[str, str, int], threading.Lock] = {}
 
     @property
     def url(self) -> str:
@@ -172,6 +180,27 @@ class BrokerServer:
         while not self._stop.wait(self._flush_interval):
             self.topic_manager.flush_all()
 
+    def _list_segments(self, ns: str, topic: str, p: int) -> list[str]:
+        """Full, paginated segment listing (a first page alone silently
+        drops history past 1000 segments)."""
+        names: list[str] = []
+        last = ""
+        import urllib.parse
+
+        while True:
+            q = f"?limit=1000&lastFileName={urllib.parse.quote(last)}"
+            status, body, _ = http_bytes(
+                "GET", f"http://{self.filer_url}"
+                f"{self._segment_dir(ns, topic, p)}{q}")
+            if status != 200:
+                return names
+            d = json.loads(body)
+            names.extend(e["FullPath"] for e in d.get("Entries", [])
+                         if e["FullPath"].endswith(".seg"))
+            if not d.get("ShouldDisplayLoadMore") or not d.get("LastFileName"):
+                return sorted(names)
+            last = d["LastFileName"]
+
     # --- persistence (broker_append.go) -----------------------------------
     def _segment_dir(self, ns: str, topic: str, p: int) -> str:
         return f"{TOPICS_ROOT}/{ns}/{topic}/{p:04d}"
@@ -187,34 +216,35 @@ class BrokerServer:
             raise HttpError(status, out.decode(errors="replace"))
 
     def _maybe_load(self, ns: str, topic: str, p: int) -> Partition:
-        """Replay persisted segments on first touch after a restart."""
+        """Replay persisted segments on first touch after a restart.
+        Every publish/subscribe passes through here, so the per-partition
+        load lock keeps the partition quiescent until history is in —
+        a concurrent publish waits instead of racing to offset 0."""
         part = self.topic_manager.partition(ns, topic, p)
         key = (ns, topic, p)
         if key in self._loaded or not self.filer_url:
             return part
-        self._loaded.add(key)
-        listing_url = (f"http://{self.filer_url}"
-                       f"{self._segment_dir(ns, topic, p)}")
-        status, body, _ = http_bytes("GET", listing_url)
-        if status != 200:
-            return part  # nothing persisted yet
-        names = sorted(e["FullPath"] for e in json.loads(body)["Entries"]
-                       if e["FullPath"].endswith(".seg"))
-        with part.lock:
-            if part.messages:
-                return part  # raced a concurrent publish; keep live data
-            for seg in names:
+        with self._load_lock:
+            lock = self._load_locks.setdefault(key, threading.Lock())
+        with lock:
+            if key in self._loaded:
+                return part
+            replayed: list[dict] = []
+            for seg in self._list_segments(ns, topic, p):
                 s, blob, _ = http_bytes("GET",
                                         f"http://{self.filer_url}{seg}")
                 if s != 200:
                     continue
                 for line in blob.decode().splitlines():
                     if line.strip():
-                        part.messages.append(json.loads(line))
-            part.flushed_upto = len(part.messages)
-            # offsets are re-derived from position after replay
-            for i, m in enumerate(part.messages):
-                m["offset"] = i
+                        replayed.append(json.loads(line))
+            with part.lock:
+                part.messages[:0] = replayed
+                part.flushed_upto = len(replayed)
+                # offsets re-derive from position after replay
+                for i, m in enumerate(part.messages):
+                    m["offset"] = i
+            self._loaded.add(key)
         return part
 
     # --- ownership --------------------------------------------------------
@@ -234,6 +264,13 @@ class BrokerServer:
             p = b.get("partition")
             if p is None:
                 p = partition_of(key, self.partition_count)
+            try:
+                p = int(p)
+            except (TypeError, ValueError):
+                raise HttpError(400, f"bad partition {p!r}")
+            if not 0 <= p < self.partition_count:
+                raise HttpError(400, f"partition {p} out of range "
+                                f"[0, {self.partition_count})")
             owner = self._owner(ns, topic, p)
             if owner != self.url:
                 return Response({"owner": owner}, status=307,
@@ -254,10 +291,16 @@ class BrokerServer:
             p = int(req.query.get("partition") or 0)
             offset = int(req.query.get("offset") or 0)
             timeout = min(float(req.query.get("timeout") or 0), 55.0)
+            if not 0 <= p < self.partition_count:
+                raise HttpError(400, f"partition {p} out of range "
+                                f"[0, {self.partition_count})")
             owner = self._owner(ns, topic, p)
             if owner != self.url:
                 return Response({"owner": owner}, status=307, headers={
-                    "Location": f"http://{owner}/subscribe"})
+                    "Location": f"http://{owner}/subscribe?"
+                                f"namespace={ns}&topic={topic}"
+                                f"&partition={p}&offset={offset}"
+                                f"&timeout={timeout}"})
             part = self._maybe_load(ns, topic, p)
             msgs = part.read(offset, timeout=timeout)
             next_offset = msgs[-1]["offset"] + 1 if msgs else offset

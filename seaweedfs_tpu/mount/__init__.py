@@ -1,0 +1,14 @@
+"""FUSE mount over the filer.
+
+Equivalent of weed/mount/ (weedfs.go + per-op files, inode_to_path.go,
+meta_cache/, page_writer/).  The op layer (WFS) is kernel-independent
+and fully testable in-process; the libfuse2 ctypes bridge
+(fuse_bridge.py) wires it to the kernel when /dev/fuse is usable.
+"""
+
+from .inode_to_path import InodeToPath
+from .meta_cache import MetaCache
+from .page_writer import PageWriter
+from .weedfs import WFS
+
+__all__ = ["InodeToPath", "MetaCache", "PageWriter", "WFS"]

@@ -1,0 +1,382 @@
+"""ctypes bridge: WFS -> libfuse2 high-level API -> kernel.
+
+Equivalent of the kernel boundary the reference crosses via
+github.com/hanwen/go-fuse (weed/mount/weedfs.go raw bridge).  The
+environment ships libfuse.so.2 (2.9, FUSE_USE_VERSION 26) but no
+Python binding, so this binds the high-level path-based API directly:
+a fuse_operations struct of ctypes callbacks delegating to a WFS.
+
+Gated: import succeeds everywhere; mount() raises RuntimeError when
+libfuse or /dev/fuse is unusable.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import ctypes.util
+import errno
+from typing import Optional
+
+from .weedfs import WFS, FuseError
+
+c_off_t = ctypes.c_int64
+c_mode_t = ctypes.c_uint32
+
+
+class Timespec(ctypes.Structure):
+    _fields_ = [("tv_sec", ctypes.c_long), ("tv_nsec", ctypes.c_long)]
+
+
+class Stat(ctypes.Structure):
+    """struct stat, x86-64 linux layout."""
+    _fields_ = [
+        ("st_dev", ctypes.c_uint64),
+        ("st_ino", ctypes.c_uint64),
+        ("st_nlink", ctypes.c_uint64),
+        ("st_mode", ctypes.c_uint32),
+        ("st_uid", ctypes.c_uint32),
+        ("st_gid", ctypes.c_uint32),
+        ("_pad0", ctypes.c_int),
+        ("st_rdev", ctypes.c_uint64),
+        ("st_size", ctypes.c_int64),
+        ("st_blksize", ctypes.c_int64),
+        ("st_blocks", ctypes.c_int64),
+        ("st_atim", Timespec),
+        ("st_mtim", Timespec),
+        ("st_ctim", Timespec),
+        ("_reserved", ctypes.c_int64 * 3),
+    ]
+
+
+class StatVfs(ctypes.Structure):
+    _fields_ = [
+        ("f_bsize", ctypes.c_ulong),
+        ("f_frsize", ctypes.c_ulong),
+        ("f_blocks", ctypes.c_uint64),
+        ("f_bfree", ctypes.c_uint64),
+        ("f_bavail", ctypes.c_uint64),
+        ("f_files", ctypes.c_uint64),
+        ("f_ffree", ctypes.c_uint64),
+        ("f_favail", ctypes.c_uint64),
+        ("f_fsid", ctypes.c_ulong),
+        ("f_flag", ctypes.c_ulong),
+        ("f_namemax", ctypes.c_ulong),
+        ("_spare", ctypes.c_int * 6),
+    ]
+
+
+class FuseFileInfo(ctypes.Structure):
+    """struct fuse_file_info (libfuse 2.9)."""
+    _fields_ = [
+        ("flags", ctypes.c_int),
+        ("fh_old", ctypes.c_ulong),
+        ("writepage", ctypes.c_int),
+        ("flags_bits", ctypes.c_uint),
+        ("fh", ctypes.c_uint64),
+        ("lock_owner", ctypes.c_uint64),
+    ]
+
+
+VOIDP = ctypes.c_void_p
+CHARP = ctypes.c_char_p
+
+FILL_DIR_T = ctypes.CFUNCTYPE(ctypes.c_int, VOIDP, CHARP,
+                              ctypes.POINTER(Stat), c_off_t)
+
+_OP_GETATTR = ctypes.CFUNCTYPE(ctypes.c_int, CHARP, ctypes.POINTER(Stat))
+_OP_READLINK = ctypes.CFUNCTYPE(ctypes.c_int, CHARP, CHARP, ctypes.c_size_t)
+_OP_MKNOD = ctypes.CFUNCTYPE(ctypes.c_int, CHARP, c_mode_t, ctypes.c_uint64)
+_OP_MKDIR = ctypes.CFUNCTYPE(ctypes.c_int, CHARP, c_mode_t)
+_OP_PATH = ctypes.CFUNCTYPE(ctypes.c_int, CHARP)
+_OP_PATH2 = ctypes.CFUNCTYPE(ctypes.c_int, CHARP, CHARP)
+_OP_CHMOD = ctypes.CFUNCTYPE(ctypes.c_int, CHARP, c_mode_t)
+_OP_CHOWN = ctypes.CFUNCTYPE(ctypes.c_int, CHARP, ctypes.c_uint32,
+                             ctypes.c_uint32)
+_OP_TRUNCATE = ctypes.CFUNCTYPE(ctypes.c_int, CHARP, c_off_t)
+_OP_UTIME = ctypes.CFUNCTYPE(ctypes.c_int, CHARP, VOIDP)
+_OP_OPEN = ctypes.CFUNCTYPE(ctypes.c_int, CHARP,
+                            ctypes.POINTER(FuseFileInfo))
+# data buffers MUST be void* — declaring them c_char_p makes ctypes hand
+# the callback an immutable bytes copy, so memmove would corrupt the heap
+_OP_READ = ctypes.CFUNCTYPE(ctypes.c_int, CHARP, VOIDP, ctypes.c_size_t,
+                            c_off_t, ctypes.POINTER(FuseFileInfo))
+_OP_WRITE = ctypes.CFUNCTYPE(ctypes.c_int, CHARP, VOIDP, ctypes.c_size_t,
+                             c_off_t, ctypes.POINTER(FuseFileInfo))
+_OP_STATFS = ctypes.CFUNCTYPE(ctypes.c_int, CHARP, ctypes.POINTER(StatVfs))
+_OP_FSYNC = ctypes.CFUNCTYPE(ctypes.c_int, CHARP, ctypes.c_int,
+                             ctypes.POINTER(FuseFileInfo))
+_OP_READDIR = ctypes.CFUNCTYPE(ctypes.c_int, CHARP, VOIDP, FILL_DIR_T,
+                               c_off_t, ctypes.POINTER(FuseFileInfo))
+_OP_INIT = ctypes.CFUNCTYPE(VOIDP, VOIDP)
+_OP_DESTROY = ctypes.CFUNCTYPE(None, VOIDP)
+_OP_ACCESS = ctypes.CFUNCTYPE(ctypes.c_int, CHARP, ctypes.c_int)
+_OP_CREATE = ctypes.CFUNCTYPE(ctypes.c_int, CHARP, c_mode_t,
+                              ctypes.POINTER(FuseFileInfo))
+_OP_FTRUNCATE = ctypes.CFUNCTYPE(ctypes.c_int, CHARP, c_off_t,
+                                 ctypes.POINTER(FuseFileInfo))
+_OP_FGETATTR = ctypes.CFUNCTYPE(ctypes.c_int, CHARP, ctypes.POINTER(Stat),
+                                ctypes.POINTER(FuseFileInfo))
+_OP_UTIMENS = ctypes.CFUNCTYPE(ctypes.c_int, CHARP,
+                               ctypes.POINTER(Timespec * 2))
+
+
+class FuseOperations(ctypes.Structure):
+    """struct fuse_operations, libfuse 2.9 (FUSE_USE_VERSION 26)."""
+    _fields_ = [
+        ("getattr", _OP_GETATTR),
+        ("readlink", _OP_READLINK),
+        ("getdir", VOIDP),  # deprecated
+        ("mknod", _OP_MKNOD),
+        ("mkdir", _OP_MKDIR),
+        ("unlink", _OP_PATH),
+        ("rmdir", _OP_PATH),
+        ("symlink", _OP_PATH2),
+        ("rename", _OP_PATH2),
+        ("link", _OP_PATH2),
+        ("chmod", _OP_CHMOD),
+        ("chown", _OP_CHOWN),
+        ("truncate", _OP_TRUNCATE),
+        ("utime", _OP_UTIME),
+        ("open", _OP_OPEN),
+        ("read", _OP_READ),
+        ("write", _OP_WRITE),
+        ("statfs", _OP_STATFS),
+        ("flush", _OP_OPEN),
+        ("release", _OP_OPEN),
+        ("fsync", _OP_FSYNC),
+        ("setxattr", VOIDP),
+        ("getxattr", VOIDP),
+        ("listxattr", VOIDP),
+        ("removexattr", VOIDP),
+        ("opendir", _OP_OPEN),
+        ("readdir", _OP_READDIR),
+        ("releasedir", _OP_OPEN),
+        ("fsyncdir", _OP_FSYNC),
+        ("init", _OP_INIT),
+        ("destroy", _OP_DESTROY),
+        ("access", _OP_ACCESS),
+        ("create", _OP_CREATE),
+        ("ftruncate", _OP_FTRUNCATE),
+        ("fgetattr", _OP_FGETATTR),
+        ("lock", VOIDP),
+        ("utimens", _OP_UTIMENS),
+        ("bmap", VOIDP),
+        ("flags", ctypes.c_uint),  # nullpath_ok etc. bitfields
+        ("ioctl", VOIDP),
+        ("poll", VOIDP),
+        ("write_buf", VOIDP),
+        ("read_buf", VOIDP),
+        ("flock", VOIDP),
+        ("fallocate", VOIDP),
+    ]
+
+
+def _load_libfuse():
+    name = ctypes.util.find_library("fuse") or "libfuse.so.2"
+    try:
+        return ctypes.CDLL(name)
+    except OSError as e:
+        raise RuntimeError(f"libfuse not available: {e}") from None
+
+
+class FuseMount:
+    """Run a WFS under a kernel mountpoint (weed mount)."""
+
+    def __init__(self, wfs: WFS, mountpoint: str):
+        self.wfs = wfs
+        self.mountpoint = mountpoint
+        self._keepalive: list = []  # callback refs must outlive fuse_main
+
+    # --- op wrappers ------------------------------------------------------
+    def _guard(self, fn):
+        def wrapper(*args):
+            try:
+                return fn(*args) or 0
+            except FuseError as e:
+                return -e.errno
+            except Exception:
+                return -errno.EIO
+
+        return wrapper
+
+    def _fill_stat(self, st, d: dict) -> None:
+        ctypes.memset(ctypes.byref(st), 0, ctypes.sizeof(st))
+        st.st_mode = d["st_mode"]
+        st.st_size = d["st_size"]
+        st.st_nlink = d["st_nlink"]
+        st.st_uid = d["st_uid"]
+        st.st_gid = d["st_gid"]
+        st.st_mtim.tv_sec = int(d["st_mtime"])
+        st.st_ctim.tv_sec = int(d["st_ctime"])
+        st.st_atim.tv_sec = int(d["st_mtime"])
+        st.st_blksize = 4096
+        st.st_blocks = (d["st_size"] + 511) // 512
+
+    def _build_ops(self) -> FuseOperations:
+        wfs = self.wfs
+        ops = FuseOperations()
+
+        @self._guard
+        def op_getattr(path, stp):
+            self._fill_stat(stp.contents, wfs.getattr(path.decode()))
+
+        @self._guard
+        def op_readdir(path, buf, fill, off, fi):
+            fill(buf, b".", None, 0)
+            fill(buf, b"..", None, 0)
+            for e in wfs.readdir(path.decode()):
+                fill(buf, e.name.encode(), None, 0)
+
+        @self._guard
+        def op_mkdir(path, mode):
+            wfs.mkdir(path.decode(), mode)
+
+        @self._guard
+        def op_unlink(path):
+            wfs.unlink(path.decode())
+
+        @self._guard
+        def op_rmdir(path):
+            wfs.rmdir(path.decode())
+
+        @self._guard
+        def op_rename(old, new):
+            wfs.rename(old.decode(), new.decode())
+
+        @self._guard
+        def op_chmod(path, mode):
+            wfs.setattr(path.decode(), mode=mode)
+
+        @self._guard
+        def op_chown(path, uid, gid):
+            wfs.setattr(path.decode(), uid=uid, gid=gid)
+
+        @self._guard
+        def op_truncate(path, size):
+            wfs.truncate(path.decode(), size)
+
+        @self._guard
+        def op_ftruncate(path, size, fi):
+            wfs.flush(fi.contents.fh)
+            wfs.truncate(path.decode(), size)
+
+        @self._guard
+        def op_utimens(path, times):
+            mtime = None
+            if times:
+                ts = times.contents[1]
+                mtime = ts.tv_sec + ts.tv_nsec / 1e9
+            wfs.setattr(path.decode(), mtime=mtime)
+
+        @self._guard
+        def op_open(path, fi):
+            fi.contents.fh = wfs.open(path.decode()).fh
+
+        @self._guard
+        def op_create(path, mode, fi):
+            fi.contents.fh = wfs.create(path.decode(), mode).fh
+
+        @self._guard
+        def op_read(path, buf, size, off, fi):
+            data = wfs.read(fi.contents.fh, off, size)
+            ctypes.memmove(buf, data, len(data))
+            return len(data)
+
+        @self._guard
+        def op_write(path, buf, size, off, fi):
+            data = ctypes.string_at(buf, size)
+            return wfs.write(fi.contents.fh, off, data)
+
+        @self._guard
+        def op_flush(path, fi):
+            wfs.flush(fi.contents.fh)
+
+        @self._guard
+        def op_release(path, fi):
+            wfs.release(fi.contents.fh)
+
+        @self._guard
+        def op_fsync(path, datasync, fi):
+            wfs.flush(fi.contents.fh)
+
+        @self._guard
+        def op_statfs(path, sv):
+            d = wfs.statfs()
+            ctypes.memset(ctypes.byref(sv.contents), 0,
+                          ctypes.sizeof(sv.contents))
+            for k, v in d.items():
+                if hasattr(sv.contents, k):
+                    setattr(sv.contents, k, v)
+            sv.contents.f_frsize = d["f_bsize"]
+
+        @self._guard
+        def op_access(path, mask):
+            wfs.getattr(path.decode())
+
+        @self._guard
+        def op_opendir(path, fi):
+            pass
+
+        @self._guard
+        def op_releasedir(path, fi):
+            pass
+
+        assigns = [
+            ("getattr", _OP_GETATTR(op_getattr)),
+            ("readdir", _OP_READDIR(op_readdir)),
+            ("mkdir", _OP_MKDIR(op_mkdir)),
+            ("unlink", _OP_PATH(op_unlink)),
+            ("rmdir", _OP_PATH(op_rmdir)),
+            ("rename", _OP_PATH2(op_rename)),
+            ("chmod", _OP_CHMOD(op_chmod)),
+            ("chown", _OP_CHOWN(op_chown)),
+            ("truncate", _OP_TRUNCATE(op_truncate)),
+            ("ftruncate", _OP_FTRUNCATE(op_ftruncate)),
+            ("utimens", _OP_UTIMENS(op_utimens)),
+            ("open", _OP_OPEN(op_open)),
+            ("create", _OP_CREATE(op_create)),
+            ("read", _OP_READ(op_read)),
+            ("write", _OP_WRITE(op_write)),
+            ("flush", _OP_OPEN(op_flush)),
+            ("release", _OP_OPEN(op_release)),
+            ("fsync", _OP_FSYNC(op_fsync)),
+            ("statfs", _OP_STATFS(op_statfs)),
+            ("access", _OP_ACCESS(op_access)),
+            ("opendir", _OP_OPEN(op_opendir)),
+            ("releasedir", _OP_OPEN(op_releasedir)),
+        ]
+        for name, cb in assigns:
+            setattr(ops, name, cb)
+            self._keepalive.append(cb)
+        return ops
+
+    def run(self, foreground: bool = True, allow_other: bool = False,
+            debug: bool = False) -> int:
+        """Blocks in fuse_main until unmounted (fusermount -u)."""
+        lib = _load_libfuse()
+        ops = self._build_ops()
+        args = [b"weed-mount", self.mountpoint.encode(), b"-s"]
+        if foreground:
+            args.append(b"-f")
+        if debug:
+            args.append(b"-d")
+        opts = [b"big_writes", b"default_permissions"]
+        if allow_other:
+            opts.append(b"allow_other")
+        args += [b"-o", b",".join(opts)]
+        argv = (ctypes.c_char_p * len(args))(*args)
+        return lib.fuse_main_real(len(args), argv, ctypes.byref(ops),
+                                  ctypes.sizeof(ops), None)
+
+
+def mount(filer_url: str, mountpoint: str, filer_path: str = "/",
+          collection: str = "", replication: str = "",
+          chunk_size_mb: int = 8, allow_other: bool = False,
+          debug: bool = False) -> int:
+    wfs = WFS(filer_url, filer_path, chunk_size_mb=chunk_size_mb,
+              collection=collection, replication=replication)
+    try:
+        return FuseMount(wfs, mountpoint).run(
+            foreground=True, allow_other=allow_other, debug=debug)
+    finally:
+        wfs.close()

@@ -1,0 +1,94 @@
+"""Inode <-> path bimap for the FUSE low-level protocol.
+
+Equivalent of weed/mount/inode_to_path.go: paths get stable inode
+numbers (root=1); renames move the path under the same inode; forget
+drops entries when the kernel's lookup count reaches zero.
+"""
+
+from __future__ import annotations
+
+import threading
+
+ROOT_INODE = 1
+
+
+class InodeEntry:
+    __slots__ = ("paths", "nlookup", "is_directory")
+
+    def __init__(self, path: str, is_directory: bool):
+        self.paths = [path]
+        self.nlookup = 1
+        self.is_directory = is_directory
+
+
+class InodeToPath:
+    def __init__(self, root: str = "/"):
+        self._lock = threading.Lock()
+        self._path2inode: dict[str, int] = {root: ROOT_INODE}
+        self._inode2entry: dict[int, InodeEntry] = {
+            ROOT_INODE: InodeEntry(root, True)}
+        self._next = ROOT_INODE + 1
+
+    def lookup(self, path: str, is_directory: bool = False) -> int:
+        """Assign (or bump) the inode for a path (inode_to_path.go Lookup)."""
+        with self._lock:
+            ino = self._path2inode.get(path)
+            if ino is not None:
+                self._inode2entry[ino].nlookup += 1
+                return ino
+            ino = self._next
+            self._next += 1
+            self._path2inode[path] = ino
+            self._inode2entry[ino] = InodeEntry(path, is_directory)
+            return ino
+
+    def get_path(self, inode: int) -> str:
+        with self._lock:
+            entry = self._inode2entry.get(inode)
+            if entry is None or not entry.paths:
+                raise KeyError(f"inode {inode} not found")
+            return entry.paths[0]
+
+    def get_inode(self, path: str) -> int:
+        with self._lock:
+            ino = self._path2inode.get(path)
+            if ino is None:
+                raise KeyError(f"path {path} has no inode")
+            return ino
+
+    def has_path(self, path: str) -> bool:
+        with self._lock:
+            return path in self._path2inode
+
+    def move_path(self, old: str, new: str) -> None:
+        """Rename keeps the inode stable (inode_to_path.go MovePath)."""
+        with self._lock:
+            ino = self._path2inode.pop(old, None)
+            if ino is None:
+                return
+            # target may already have an inode (overwrite): drop it
+            displaced = self._path2inode.pop(new, None)
+            if displaced is not None and displaced != ino:
+                self._inode2entry.pop(displaced, None)
+            self._path2inode[new] = ino
+            entry = self._inode2entry[ino]
+            entry.paths = [new if p == old else p for p in entry.paths]
+
+    def remove_path(self, path: str) -> None:
+        with self._lock:
+            ino = self._path2inode.pop(path, None)
+            if ino is not None:
+                self._inode2entry.pop(ino, None)
+
+    def forget(self, inode: int, nlookup: int) -> None:
+        """Kernel forget: drop when the lookup count hits zero."""
+        with self._lock:
+            entry = self._inode2entry.get(inode)
+            if entry is None:
+                return
+            entry.nlookup -= nlookup
+            if entry.nlookup <= 0 and inode != ROOT_INODE:
+                self._inode2entry.pop(inode, None)
+                for p in entry.paths:
+                    if self._path2inode.get(p) == inode:
+                        self._path2inode.pop(p, None)

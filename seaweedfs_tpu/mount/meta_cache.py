@@ -1,0 +1,104 @@
+"""Local metadata cache for the mount, invalidated by the filer's meta
+subscription.
+
+Equivalent of weed/mount/meta_cache/ (meta_cache.go + subscription
+invalidation): directory listings and entry stats are cached locally;
+a background tailer of /api/meta/log (the reference's SubscribeMetadata
+stream) applies remote mutations so other clients' changes become
+visible without re-statting.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional
+
+from ..filer.entry import Entry
+from ..utils.httpd import http_json
+
+
+class MetaCache:
+    def __init__(self, filer_url: str, poll_interval: float = 0.5):
+        self.filer_url = filer_url
+        self.poll_interval = poll_interval
+        self._lock = threading.Lock()
+        self._entries: dict[str, Entry] = {}
+        self._listed_dirs: set[str] = set()
+        self._since_ns = time.time_ns()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.invalidation_fn: Optional[Callable[[str], None]] = None
+
+    # --- cache ops --------------------------------------------------------
+    def get(self, path: str) -> Optional[Entry]:
+        with self._lock:
+            return self._entries.get(path)
+
+    def put(self, entry: Entry) -> None:
+        with self._lock:
+            self._entries[entry.full_path] = entry
+
+    def delete(self, path: str) -> None:
+        with self._lock:
+            self._entries.pop(path, None)
+            self._listed_dirs.discard(path)
+
+    def mark_listed(self, dir_path: str) -> None:
+        with self._lock:
+            self._listed_dirs.add(dir_path)
+
+    def is_listed(self, dir_path: str) -> bool:
+        with self._lock:
+            return dir_path in self._listed_dirs
+
+    def list_cached(self, dir_path: str) -> list[Entry]:
+        prefix = dir_path.rstrip("/") + "/"
+        with self._lock:
+            return sorted(
+                (e for p, e in self._entries.items()
+                 if p.startswith(prefix) and "/" not in p[len(prefix):]),
+                key=lambda e: e.full_path)
+
+    # --- subscription (meta_cache_subscribe.go) ---------------------------
+    def apply_event(self, event: dict) -> None:
+        old, new = event.get("old_entry"), event.get("new_entry")
+        with self._lock:
+            if old:
+                self._entries.pop(old["full_path"], None)
+            if new:
+                e = Entry.from_dict(new)
+                # only refresh paths we already track, or children of
+                # dirs we have fully listed (others fault in on lookup)
+                parent = e.parent
+                if e.full_path in self._entries \
+                        or parent in self._listed_dirs:
+                    self._entries[e.full_path] = e
+        for ent in (old, new):
+            if ent and self.invalidation_fn:
+                try:
+                    self.invalidation_fn(ent["full_path"])
+                except Exception:
+                    pass
+
+    def _tail_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                r = http_json(
+                    "GET", f"http://{self.filer_url}/api/meta/log?"
+                    f"since_ns={self._since_ns}")
+                for ev in r["events"]:
+                    self.apply_event(ev)
+                self._since_ns = r["next_ns"]
+            except Exception:
+                pass
+            self._stop.wait(self.poll_interval)
+
+    def start(self) -> "MetaCache":
+        self._thread = threading.Thread(target=self._tail_loop, daemon=True,
+                                        name="mount-meta-cache")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()

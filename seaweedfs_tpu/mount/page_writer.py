@@ -1,0 +1,142 @@
+"""Write-back page cache for one open file.
+
+Equivalent of weed/mount/page_writer/ (upload_pipeline.go,
+page_chunk_mem.go, dirty_pages_chunked.go): writes land in fixed-size
+in-memory chunk buffers aligned to the filer chunk size; a chunk seals
+when fully written past or on flush, and sealed chunks upload through
+the supplied uploader.  Reads at unflushed offsets are served from the
+dirty pages so read-your-writes holds before flush.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Optional
+
+
+class _DirtyChunk:
+    __slots__ = ("index", "buf", "intervals")
+
+    def __init__(self, index: int, chunk_size: int):
+        self.index = index
+        self.buf = bytearray(chunk_size)
+        self.intervals: list[tuple[int, int]] = []  # sorted (start, stop)
+
+    def write(self, off: int, data: bytes) -> None:
+        self.buf[off:off + len(data)] = data
+        self.intervals = _merge(self.intervals, (off, off + len(data)))
+
+    def read(self, off: int, size: int) -> Optional[bytes]:
+        """Bytes if fully covered by written intervals, else None."""
+        stop = off + size
+        for a, b in self.intervals:
+            if a <= off and stop <= b:
+                return bytes(self.buf[off:stop])
+        return None
+
+    @property
+    def written_span(self) -> tuple[int, int]:
+        return (self.intervals[0][0], self.intervals[-1][1]) \
+            if self.intervals else (0, 0)
+
+    def is_complete(self, chunk_size: int) -> bool:
+        return self.intervals == [(0, chunk_size)]
+
+
+def _merge(ivs: list[tuple[int, int]],
+           new: tuple[int, int]) -> list[tuple[int, int]]:
+    out: list[tuple[int, int]] = []
+    a, b = new
+    for x, y in ivs:
+        if y < a or x > b:
+            out.append((x, y))
+        else:
+            a, b = min(a, x), max(b, y)
+    out.append((a, b))
+    out.sort()
+    return out
+
+
+class PageWriter:
+    """Dirty pages for one file handle.
+
+    uploader(chunk_logical_offset, data) -> chunk dict (FileChunk.to_dict
+    shape); flush() returns every uploaded chunk in offset order.
+    """
+
+    def __init__(self, uploader: Callable[[int, bytes], dict],
+                 chunk_size: int = 8 * 1024 * 1024):
+        self.chunk_size = chunk_size
+        self.uploader = uploader
+        self._lock = threading.Lock()
+        self._chunks: dict[int, _DirtyChunk] = {}
+        self._uploaded: list[dict] = []
+        self.file_size_hint = 0
+
+    def write(self, offset: int, data: bytes) -> int:
+        """Buffer a write; seals+uploads any chunk that becomes full."""
+        written = len(data)
+        with self._lock:
+            self.file_size_hint = max(self.file_size_hint,
+                                      offset + written)
+            pos = 0
+            sealed: list[_DirtyChunk] = []
+            while pos < len(data):
+                idx = (offset + pos) // self.chunk_size
+                in_off = (offset + pos) % self.chunk_size
+                can = min(len(data) - pos, self.chunk_size - in_off)
+                chunk = self._chunks.get(idx)
+                if chunk is None:
+                    chunk = self._chunks[idx] = _DirtyChunk(
+                        idx, self.chunk_size)
+                chunk.write(in_off, data[pos:pos + can])
+                if chunk.is_complete(self.chunk_size):
+                    sealed.append(self._chunks.pop(idx))
+                pos += can
+            for chunk in sealed:
+                self._upload_locked(chunk)
+        return written
+
+    def _upload_locked(self, chunk: _DirtyChunk) -> None:
+        start, stop = chunk.written_span
+        base = chunk.index * self.chunk_size
+        uploaded = self.uploader(base + start, bytes(chunk.buf[start:stop]))
+        self._uploaded.append(uploaded)
+
+    def read_dirty(self, offset: int, size: int) -> Optional[bytes]:
+        """Serve a read from unflushed pages when fully covered."""
+        with self._lock:
+            idx = offset // self.chunk_size
+            in_off = offset % self.chunk_size
+            if in_off + size <= self.chunk_size:
+                chunk = self._chunks.get(idx)
+                return chunk.read(in_off, size) if chunk else None
+            # spans chunks: assemble or give up
+            parts: list[bytes] = []
+            pos = 0
+            while pos < size:
+                idx = (offset + pos) // self.chunk_size
+                in_off = (offset + pos) % self.chunk_size
+                can = min(size - pos, self.chunk_size - in_off)
+                chunk = self._chunks.get(idx)
+                piece = chunk.read(in_off, can) if chunk else None
+                if piece is None:
+                    return None
+                parts.append(piece)
+                pos += can
+            return b"".join(parts)
+
+    def flush(self) -> list[dict]:
+        """Seal + upload every dirty chunk; returns all uploaded chunk
+        dicts (offset order) and resets the uploaded list."""
+        with self._lock:
+            for idx in sorted(self._chunks):
+                self._upload_locked(self._chunks.pop(idx))
+            out, self._uploaded = self._uploaded, []
+            out.sort(key=lambda c: c["offset"])
+            return out
+
+    @property
+    def has_dirty(self) -> bool:
+        with self._lock:
+            return bool(self._chunks) or bool(self._uploaded)

@@ -52,9 +52,11 @@ class MetaTailer:
 
     def poll_once(self) -> int:
         """One tail step; returns number of events applied."""
+        import urllib.parse
+
         q = f"since_ns={self.since_ns}"
         if self.path_prefix:
-            q += f"&path_prefix={self.path_prefix}"
+            q += "&path_prefix=" + urllib.parse.quote(self.path_prefix)
         r = http_json("GET",
                       f"http://{self.source_url}/api/meta/log?{q}")
         n = 0

@@ -211,6 +211,19 @@ def cmd_filer_meta_backup(args) -> None:
         time.sleep(args.pollSeconds)
 
 
+def cmd_mount(args) -> None:
+    """FUSE-mount a filer path (weed mount, mount/weedfs.go)."""
+    from seaweedfs_tpu.mount.fuse_bridge import mount
+
+    print(f"mounting {args.filer}{args.filerPath} on {args.dir} "
+          f"(unmount: fusermount -u {args.dir})")
+    code = mount(args.filer, args.dir, filer_path=args.filerPath,
+                 collection=args.collection, replication=args.replication,
+                 chunk_size_mb=args.chunkSizeLimitMB,
+                 allow_other=args.allowOthers, debug=args.debug)
+    raise SystemExit(code)
+
+
 def cmd_msg_broker(args) -> None:
     """Pub/sub message broker backed by the filer
     (command/msg_broker.go)."""
@@ -423,6 +436,18 @@ def main(argv=None) -> None:
                      help="force a fresh full snapshot")
     fmb.add_argument("-pollSeconds", type=float, default=2.0)
     fmb.set_defaults(fn=cmd_filer_meta_backup)
+
+    mt = sub.add_parser("mount")
+    mt.add_argument("-filer", default="127.0.0.1:8888")
+    mt.add_argument("-dir", required=True, help="local mountpoint")
+    mt.add_argument("-filerPath", default="/", dest="filerPath",
+                    help="filer subtree to mount")
+    mt.add_argument("-collection", default="")
+    mt.add_argument("-replication", default="")
+    mt.add_argument("-chunkSizeLimitMB", type=int, default=8)
+    mt.add_argument("-allowOthers", action="store_true")
+    mt.add_argument("-debug", action="store_true")
+    mt.set_defaults(fn=cmd_mount)
 
     mb = sub.add_parser("msgBroker")
     mb.add_argument("-filer", default="", help="filer host:port for persistence")
