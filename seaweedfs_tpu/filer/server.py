@@ -246,7 +246,8 @@ class FilerServer:
             if err:
                 raise HttpError(401, err)
             b = req.json()
-            self._check_writable(b["from"])
+            # only the destination is write-gated: a rename out of a
+            # read-only prefix (like a delete) frees space and is allowed
             self._check_writable(b["to"])
             with self.filer.op_signatures(self._sigs(req)):
                 moved = self.filer.rename(b["from"], b["to"])
@@ -419,7 +420,9 @@ class FilerServer:
             if err:
                 raise HttpError(401, err)
             path = req.match.group(1)
-            self._check_writable(path)
+            # deletes are NOT gated by read_only rules (reference filer
+            # checks rules on writes only) — quota-marked buckets must
+            # stay deletable so users can reclaim space
             try:
                 with self.filer.op_signatures(self._sigs(req)):
                     self.filer.delete_entry(

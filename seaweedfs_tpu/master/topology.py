@@ -126,6 +126,13 @@ class DataNode:
             "Max": self.max_volume_count,
             "Free": self.free_space(),
             "VolumeIds": sorted(self.volumes),
+            "VolumeInfos": [{
+                "id": v.id, "collection": v.collection,
+                "size": v.size, "file_count": v.file_count,
+                "delete_count": v.delete_count,
+                "modified_at": v.modified_at_second,
+                "read_only": v.read_only,
+            } for _, v in sorted(self.volumes.items())],
         }
 
 

@@ -21,21 +21,33 @@ BUCKETS_PATH = "/buckets"
 UPLOADS_PATH = "/buckets/.uploads"
 
 
-def _read_identities(env: CommandEnv) -> dict:
-    status, body, _ = http_bytes(
-        "GET", f"http://{_filer(env)}{IDENTITY_PATH}")
+def _read_json_conf(env: CommandEnv, path: str, default):
+    """GET a JSON config file from the filer.  Only a clean 404 maps to
+    the default — a transient 5xx must raise, or the caller's
+    read-modify-write would wipe the whole file."""
+    status, body, _ = http_bytes("GET", f"http://{_filer(env)}{path}")
+    if status == 404:
+        return default
     if status != 200:
-        return {"identities": []}
+        raise HttpError(status, body.decode(errors="replace"))
     return json.loads(body)
 
 
-def _write_identities(env: CommandEnv, config: dict) -> None:
+def _write_json_conf(env: CommandEnv, path: str, config) -> None:
     status, body, _ = http_bytes(
-        "PUT", f"http://{_filer(env)}{IDENTITY_PATH}",
+        "PUT", f"http://{_filer(env)}{path}",
         json.dumps(config, indent=2).encode(),
         headers={"Content-Type": "application/json"})
     if status not in (200, 201):
         raise HttpError(status, body.decode(errors="replace"))
+
+
+def _read_identities(env: CommandEnv) -> dict:
+    return _read_json_conf(env, IDENTITY_PATH, {"identities": []})
+
+
+def _write_identities(env: CommandEnv, config: dict) -> None:
+    _write_json_conf(env, IDENTITY_PATH, config)
 
 
 @command("s3.bucket.list")
@@ -129,3 +141,120 @@ def _parse_duration(s: str) -> float:
     if s and s[-1] in units:
         return float(s[:-1]) * units[s[-1]]
     return float(s)
+
+
+QUOTA_PATH = "/etc/seaweedfs/bucket_quotas.json"
+
+
+def _read_quota_conf(env: CommandEnv) -> dict:
+    d = _read_json_conf(env, QUOTA_PATH, {})
+    # layout: {"quotas": {bucket: bytes}, "marked": [bucket...]} —
+    # "marked" records which read-only rules WE set, so quota.check
+    # never lifts an operator's manual rule
+    if "quotas" not in d:
+        d = {"quotas": d, "marked": []}
+    return d
+
+
+def _write_quota_conf(env: CommandEnv, conf: dict) -> None:
+    _write_json_conf(env, QUOTA_PATH, conf)
+
+
+def _bucket_size(env: CommandEnv, name: str) -> int:
+    def walk(p: str) -> int:
+        size = 0
+        for e in _listing(env, p):
+            size += walk(e["FullPath"]) if e["IsDirectory"] \
+                else e["FileSize"]
+        return size
+
+    return walk(f"{BUCKETS_PATH}/{name}")
+
+
+@command("s3.bucket.quota")
+def cmd_s3_bucket_quota(env: CommandEnv, flags: dict) -> str:
+    """s3.bucket.quota -name <bucket> [-sizeMB <n> | -remove]
+    # set/show/remove a bucket size quota (command_s3_bucket_quota.go)"""
+    name = flags.get("name") or flags.get("")
+    qc = _read_quota_conf(env)
+    quotas = qc["quotas"]
+    if not name:
+        return json.dumps(quotas, indent=2) or "{}"
+    if "remove" in flags:
+        env.confirm_is_locked()
+        quotas.pop(name, None)
+        _write_quota_conf(env, qc)
+        return f"removed quota of bucket {name}"
+    if "sizeMB" in flags:
+        env.confirm_is_locked()
+        quotas[name] = int(flags["sizeMB"]) * 1024 * 1024
+        _write_quota_conf(env, qc)
+        return f"bucket {name} quota = {flags['sizeMB']}MB"
+    return f"bucket {name} quota = {quotas.get(name, 'none')}"
+
+
+@command("s3.bucket.quota.enforce")
+@command("s3.bucket.quota.check")
+def cmd_s3_bucket_quota_check(env: CommandEnv, flags: dict) -> str:
+    """s3.bucket.quota.check [-apply]
+    # compare bucket sizes against quotas; with -apply, mark exceeded
+    buckets read-only via a filer.conf rule (and lift the mark when back
+    under quota) — the s3 gateway then rejects writes (command_s3_bucket_
+    quota_check.go marks the bucket entry; same effect here)"""
+    from ..filer.filer_conf import FILER_CONF_PATH, FilerConf, PathConf
+
+    qc = _read_quota_conf(env)
+    quotas = qc["quotas"]
+    if not quotas:
+        return "no bucket quotas configured"
+    status, body, _ = http_bytes(
+        "GET", f"http://{_filer(env)}{FILER_CONF_PATH}")
+    conf = FilerConf.from_bytes(body if status == 200 else b"")
+    lines, changed = [], False
+    marked_by_us = set(qc.get("marked", []))
+    for name, limit in sorted(quotas.items()):
+        prefix = f"{BUCKETS_PATH}/{name}"
+        try:
+            used = _bucket_size(env, name)
+        except (HttpError, NotADirectoryError):
+            # bucket gone but quota entry remains: skip, keep enforcing
+            # the others
+            lines.append(f"bucket {name}: missing (stale quota entry)")
+            continue
+        over = used > limit
+        marked = prefix in conf.rules and conf.rules[prefix].read_only
+        lines.append(f"bucket {name}: used={used} quota={limit} "
+                     f"{'OVER' if over else 'ok'}"
+                     f"{' (read-only)' if marked else ''}")
+        if "apply" in flags and over and not marked:
+            env.confirm_is_locked()
+            rule = conf.rules.get(prefix) or PathConf(location_prefix=prefix)
+            rule.read_only = True
+            conf.set_rule(rule)
+            marked_by_us.add(name)
+            lines.append(f"  -> marked {prefix} read-only")
+            changed = True
+        elif "apply" in flags and not over and marked:
+            # only lift marks WE set — an operator's manual read-only
+            # rule must survive quota checks
+            if name not in marked_by_us:
+                lines.append(f"  (read-only set manually; not lifting)")
+                continue
+            env.confirm_is_locked()
+            rule = conf.rules[prefix]
+            rule.read_only = False
+            if rule.to_dict() == PathConf(
+                    location_prefix=prefix).to_dict():
+                conf.delete_rule(prefix)  # nothing else set: drop it
+            marked_by_us.discard(name)
+            lines.append(f"  -> lifted read-only on {prefix}")
+            changed = True
+    if changed:
+        qc["marked"] = sorted(marked_by_us)
+        _write_quota_conf(env, qc)
+        status, body, _ = http_bytes(
+            "PUT", f"http://{_filer(env)}{FILER_CONF_PATH}",
+            conf.to_bytes())
+        if status not in (200, 201):
+            raise HttpError(status, body.decode(errors="replace"))
+    return "\n".join(lines)

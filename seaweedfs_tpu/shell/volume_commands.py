@@ -258,3 +258,130 @@ def cmd_evacuate(env: CommandEnv, flags: dict) -> str:
     for n in nodes:
         env.volume_post(n["Url"], "/admin/heartbeat_now", {}, timeout=30)
     return "\n".join(moves) or "nothing to evacuate"
+
+
+@command("volume.tier.upload")
+def cmd_tier_upload(env: CommandEnv, flags: dict) -> str:
+    """volume.tier.upload -volumeId <id> [-dest <backend>] [-keepLocalDatFile]
+    # move a volume's .dat to a tiered backend (command_volume_tier_upload.go)"""
+    env.confirm_is_locked()
+    vid = int(flags["volumeId"])
+    backend = flags.get("dest", "s3.default")
+    results = []
+    for url in _volume_locations(env, vid):
+        r = env.volume_post(url, "/admin/tier_upload", {
+            "volume_id": vid, "backend": backend,
+            "keep_local": "keepLocalDatFile" in flags})
+        results.append(f"{url}: {r['remote']}")
+    if not results:
+        raise RuntimeError(f"volume {vid} has no locations")
+    return "\n".join(results)
+
+
+@command("volume.tier.download")
+def cmd_tier_download(env: CommandEnv, flags: dict) -> str:
+    """volume.tier.download -volumeId <id>
+    # bring a tiered volume's .dat back to local disk"""
+    env.confirm_is_locked()
+    vid = int(flags["volumeId"])
+    urls = _volume_locations(env, vid)
+    if not urls:
+        raise RuntimeError(f"volume {vid} has no locations")
+    for url in urls:
+        env.volume_post(url, "/admin/tier_download", {"volume_id": vid})
+    return f"volume {vid} downloaded on {', '.join(urls)}"
+
+
+@command("volume.tier.move")
+def cmd_tier_move(env: CommandEnv, flags: dict) -> str:
+    """volume.tier.move -volumeId <id> -dest <backend>
+    # tier.upload without keeping the local copy"""
+    flags.pop("keepLocalDatFile", None)
+    return cmd_tier_upload(env, flags)
+
+
+@command("volume.check.disk")
+def cmd_volume_check_disk(env: CommandEnv, flags: dict) -> str:
+    """volume.check.disk [-volumeId <id>]
+    # compare replicas of each volume pairwise and report divergence
+    (command_volume_check_disk.go syncs missing needles; here divergent
+    replicas are reported for volume.fix.replication to rebuild)"""
+    nodes = _nodes_with_volumes(env)
+    holders: dict[int, list[str]] = {}
+    for n in nodes:
+        for vid in n["VolumeIds"]:
+            holders.setdefault(vid, []).append(n["Url"])
+    lines = []
+    for vid, urls in sorted(holders.items()):
+        if "volumeId" in flags and vid != int(flags["volumeId"]):
+            continue
+        if len(urls) < 2:
+            continue
+        counts = {}
+        for url in urls:
+            r = env.volume_post(url, "/admin/volume_check",
+                                {"volume_id": vid})
+            counts[url] = (r["indexed"], r["crc_errors"])
+        distinct = {c for c, _ in counts.values()}
+        corrupt = any(errs for _, errs in counts.values())
+        state = "DIVERGED" if len(distinct) != 1 \
+            else ("CORRUPT" if corrupt else "in sync")
+        lines.append(f"volume {vid}: " + ", ".join(
+            f"{u}={c[0]} needles,{c[1]} crc_errors"
+            for u, c in counts.items()) + f" [{state}]")
+    return "\n".join(lines) or "no replicated volumes"
+
+
+@command("volume.configure.replication")
+def cmd_configure_replication(env: CommandEnv, flags: dict) -> str:
+    """volume.configure.replication -volumeId <id> -replication <xyz>
+    # rewrite a volume's replica placement in its superblock"""
+    env.confirm_is_locked()
+    vid = int(flags["volumeId"])
+    rp = flags["replication"]
+    ReplicaPlacement.parse(rp)  # validate before touching servers
+    urls = _volume_locations(env, vid)
+    if not urls:
+        raise RuntimeError(f"volume {vid} has no locations")
+    for url in urls:
+        env.volume_post(url, "/admin/configure_replication",
+                        {"volume_id": vid, "replication": rp})
+    return f"volume {vid} replication set to {rp} on {', '.join(urls)}"
+
+
+@command("volume.deleteEmpty")
+def cmd_volume_delete_empty(env: CommandEnv, flags: dict) -> str:
+    """volume.deleteEmpty [-quietFor 86400] [-force]
+    # delete volumes that hold no live data (command_volume_delete_empty.go)"""
+    env.confirm_is_locked()
+    import time as _time
+
+    quiet_for = float(flags.get("quietFor", "86400"))
+    deleted = []
+    nodes = _nodes_with_volumes(env)
+    for n in nodes:
+        for v in n.get("VolumeInfos", []):
+            vid = v["id"]
+            live = v.get("file_count", 0) - v.get("delete_count", 0)
+            quiet = _time.time() - v.get("modified_at", 0) >= quiet_for
+            if live <= 0 and (quiet or "force" in flags):
+                env.volume_post(n["Url"], "/admin/delete_volume",
+                                {"volume_id": vid})
+                deleted.append(f"{vid}@{n['Url']}")
+    if deleted:
+        for n in nodes:
+            env.volume_post(n["Url"], "/admin/heartbeat_now", {},
+                            timeout=30)
+    return f"deleted empty volumes: {deleted}" if deleted \
+        else "no empty volumes"
+
+
+@command("volume.server.leave")
+def cmd_volume_server_leave(env: CommandEnv, flags: dict) -> str:
+    """volume.server.leave -node <host:port>
+    # ask a volume server to stop heartbeating and detach from the cluster
+    (command_volume_server_leave.go; data stays on disk)"""
+    env.confirm_is_locked()
+    node = flags["node"]
+    env.volume_post(node, "/admin/leave", {})
+    return f"{node} left the cluster (process still running; data intact)"

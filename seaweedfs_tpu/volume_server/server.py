@@ -179,6 +179,14 @@ class VolumeServer:
     def _register_routes(self) -> None:
         r = self.router
 
+        @r.route("POST", "/admin/leave")
+        def leave(req: Request) -> Response:
+            """volume.server.leave: stop heartbeating so the master's
+            janitor unregisters this node; data and the HTTP surface stay
+            up until the process exits (VolumeServerLeave RPC)."""
+            self._stop.set()
+            return Response({"left": True})
+
         @r.route("POST", "/admin/heartbeat_now")
         def heartbeat_now(req: Request) -> Response:
             self.heartbeat_now()
@@ -554,6 +562,36 @@ class VolumeServer:
             with self.store.volume_locks[vid]:
                 v.tier_download()
             return Response({})
+
+        @r.route("POST", "/admin/configure_replication")
+        def configure_replication(req: Request) -> Response:
+            """VolumeConfigure (volume_grpc_admin.go): rewrite the
+            superblock's replica placement in place."""
+            from ..storage.super_block import ReplicaPlacement
+
+            b = req.json()
+            vid = int(b["volume_id"])
+            try:
+                v = self.store.get_volume(vid)
+            except KeyError:
+                raise HttpError(404, f"volume {vid} not found")
+            rp = ReplicaPlacement.parse(b["replication"])
+            with self.store.volume_locks[vid]:
+                if v.tiered:
+                    raise HttpError(
+                        409, f"volume {vid} is tiered (read-only); "
+                        "tier.download before reconfiguring")
+                # persist FIRST: if the write fails, memory still matches
+                # what is on disk
+                old_rp = v.super_block.replica_placement
+                v.super_block.replica_placement = rp
+                try:
+                    v._dat.write_at(v.super_block.to_bytes(), 0)
+                except Exception:
+                    v.super_block.replica_placement = old_rp
+                    raise
+            self.heartbeat_now()
+            return Response({"replication": str(rp)})
 
         @r.route("POST", "/query")
         def query(req: Request) -> Response:

@@ -186,16 +186,18 @@ def test_read_only_rule_blocks_delete_and_rename(stack):
     _, _, filer = stack
     base = f"http://{filer.url}"
     http_bytes("PUT", base + "/ro/keep.txt", b"data")
+    http_bytes("PUT", base + "/ok2/a.txt", b"data")
     fc = FilerConf()
     fc.set_rule(PathConf(location_prefix="/ro", read_only=True))
     http_bytes("PUT", base + FILER_CONF_PATH, fc.to_bytes())
-    status, _, _ = http_bytes("DELETE", base + "/ro/keep.txt")
-    assert status == 403
     status, body, _ = http_bytes(
         "POST", base + "/api/rename",
-        json.dumps({"from": "/ro/keep.txt", "to": "/ro/x.txt"}).encode(),
+        json.dumps({"from": "/ok2/a.txt", "to": "/ro/x.txt"}).encode(),
         headers={"Content-Type": "application/json"})
-    assert status == 403
+    assert status == 403  # rename INTO a read-only prefix is a write
+    # deletes are allowed (space reclamation, quota semantics)
+    status, _, _ = http_bytes("DELETE", base + "/ro/keep.txt")
+    assert status == 204
     # the conf file itself stays editable even under a blanket rule
     fc.set_rule(PathConf(location_prefix="/", read_only=True))
     status, _, _ = http_bytes("PUT", base + FILER_CONF_PATH, fc.to_bytes())

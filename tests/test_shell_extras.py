@@ -1,0 +1,151 @@
+"""Shell completeness: volume.tier.*, volume.check.disk,
+volume.configure.replication, volume.deleteEmpty, volume.server.leave,
+s3.bucket.quota{,.check}.
+
+Reference behaviors: shell/command_volume_tier_{upload,download,move}.go,
+command_volume_check_disk.go, command_volume_configure_replication.go,
+command_volume_delete_empty.go, command_volume_server_leave.go,
+command_s3_bucket_quota{,_check}.go.
+"""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from seaweedfs_tpu.filer.server import FilerServer
+from seaweedfs_tpu.master.server import MasterServer
+from seaweedfs_tpu.shell.commands import CommandEnv, run_command
+from seaweedfs_tpu.storage.backend import DirBackendStorage, register_backend
+from seaweedfs_tpu.utils.httpd import http_bytes, http_json
+from seaweedfs_tpu.volume_server.server import VolumeServer
+from tests.conftest import free_port
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    register_backend(DirBackendStorage("cloudx", str(tmp_path / "cloud")))
+    master = MasterServer(port=free_port(), pulse_seconds=0.3).start()
+    vols = []
+    for i in range(2):
+        d = tmp_path / f"vs{i}"
+        d.mkdir()
+        vols.append(VolumeServer([str(d)], master.url, port=free_port(),
+                                 pulse_seconds=0.3).start())
+    deadline = time.time() + 5
+    while time.time() < deadline and len(master.topo.all_nodes()) < 2:
+        time.sleep(0.05)
+    env = CommandEnv(master.url)
+    env.lock()
+    yield master, vols, env
+    for v in vols:
+        v.stop()
+    master.stop()
+
+
+def _upload(master_url: str, data: bytes, replication: str = "") -> str:
+    from seaweedfs_tpu.client.operation import WeedClient
+
+    return WeedClient(master_url).upload(data, replication=replication)
+
+
+def test_tier_upload_and_download(cluster, tmp_path):
+    master, vols, env = cluster
+    fid = _upload(master.url, b"tiered-bytes" * 100)
+    vid = int(fid.split(",")[0])
+    out = run_command(env, f"volume.tier.upload -volumeId {vid} -dest cloudx")
+    assert "cloudx" in out
+    # reads still work through the tiered backend
+    from seaweedfs_tpu.client.operation import WeedClient
+
+    assert WeedClient(master.url).download(fid) == b"tiered-bytes" * 100
+    out = run_command(env, f"volume.tier.download -volumeId {vid}")
+    assert "downloaded" in out
+    assert WeedClient(master.url).download(fid) == b"tiered-bytes" * 100
+
+
+def test_check_disk_reports_divergence(cluster):
+    master, vols, env = cluster
+    fid = _upload(master.url, b"replicated", replication="001")
+    vid = int(fid.split(",")[0])
+    out = run_command(env, f"volume.check.disk -volumeId {vid}")
+    assert "in sync" in out
+    # delete the needle on ONE replica only -> diverged
+    urls = env.master.lookup(vid)
+    assert len(urls) == 2
+    http_bytes("DELETE", f"http://{urls[0]}/{fid}?type=replicate")
+    out = run_command(env, f"volume.check.disk -volumeId {vid}")
+    assert "DIVERGED" in out
+
+
+def test_configure_replication_rewrites_superblock(cluster):
+    master, vols, env = cluster
+    fid = _upload(master.url, b"rp-data")
+    vid = int(fid.split(",")[0])
+    out = run_command(
+        env, f"volume.configure.replication -volumeId {vid} -replication 001")
+    assert "001" in out
+    holder = next(vs for vs in vols if vid in vs.store.volumes)
+    v = holder.store.get_volume(vid)
+    assert str(v.super_block.replica_placement) == "001"
+
+
+def test_delete_empty_volumes(cluster):
+    master, vols, env = cluster
+    run_command(env, "volume.grow -count 2")
+    fid = _upload(master.url, b"keepme")
+    used_vid = int(fid.split(",")[0])
+    time.sleep(0.8)  # let heartbeats refresh VolumeInfos
+    out = run_command(env, "volume.deleteEmpty -quietFor 0 -force")
+    assert "deleted empty volumes" in out
+    time.sleep(0.8)
+    nodes = [n for dc in env.topology()["DataCenters"]
+             for r in dc["Racks"] for n in r["DataNodes"]]
+    remaining = [vid for n in nodes for vid in n["VolumeIds"]]
+    assert used_vid in remaining
+    assert all(vid == used_vid for vid in remaining)
+
+
+def test_volume_server_leave(cluster):
+    master, vols, env = cluster
+    out = run_command(env, f"volume.server.leave -node {vols[1].url}")
+    assert "left the cluster" in out
+    deadline = time.time() + 8
+    while time.time() < deadline:
+        nodes = [n for dc in env.topology()["DataCenters"]
+                 for r in dc["Racks"] for n in r["DataNodes"]]
+        if vols[1].url not in [n["Url"] for n in nodes]:
+            break
+        time.sleep(0.2)
+    assert vols[1].url not in [n["Url"] for n in nodes]
+
+
+def test_s3_bucket_quota_and_check(cluster, tmp_path):
+    master, vols, env = cluster
+    filer = FilerServer(master.url, port=free_port(), max_chunk_mb=1).start()
+    try:
+        env.filer_url = filer.url
+        base = f"http://{filer.url}"
+        run_command(env, "s3.bucket.create -name qb")
+        http_bytes("PUT", base + "/buckets/qb/big.bin", b"x" * (2 << 20))
+        run_command(env, "s3.bucket.quota -name qb -sizeMB 1")
+        out = run_command(env, "s3.bucket.quota -name qb")
+        assert str(1 << 20) in out
+        out = run_command(env, "s3.bucket.quota.check -apply")
+        assert "OVER" in out and "read-only" in out.replace("read-only", "read-only")
+        # bucket writes now rejected...
+        status, _, _ = http_bytes("PUT", base + "/buckets/qb/more.bin", b"y")
+        assert status == 403
+        # ...but deletes still allowed (reclaim space)
+        status, _, _ = http_bytes("DELETE", base + "/buckets/qb/big.bin")
+        assert status == 204
+        out = run_command(env, "s3.bucket.quota.check -apply")
+        assert "lifted read-only" in out
+        status, _, _ = http_bytes("PUT", base + "/buckets/qb/more.bin", b"y")
+        assert status == 201
+        # remove quota
+        out = run_command(env, "s3.bucket.quota -name qb -remove")
+        assert "removed" in out
+    finally:
+        filer.stop()
