@@ -25,6 +25,18 @@ from .filer_conf import FILER_CONF_PATH, FilerConf
 from .filer_store import FilerStore
 
 
+def _effective_size(entry: Entry) -> int:
+    """Chunkless remote-mounted entries report their remote size."""
+    if not entry.chunks and "remote.entry" in entry.extended:
+        import json as _json
+
+        try:
+            return int(_json.loads(entry.extended["remote.entry"])["size"])
+        except (ValueError, KeyError, TypeError):
+            return 0
+    return entry.file_size
+
+
 def _ttl_seconds(ttl: str) -> int:
     if not ttl:
         return 0
@@ -236,7 +248,7 @@ class FilerServer:
         def api_stat(req: Request) -> Response:
             entry = self.filer.find_entry(req.match.group(1))
             d = entry.to_dict()
-            d["file_size"] = entry.file_size
+            d["file_size"] = _effective_size(entry)
             d["is_directory"] = entry.is_directory
             return Response(d)
 
@@ -320,6 +332,26 @@ class FilerServer:
                 count += 1
             return Response({"count": count})
 
+        @r.route("POST", "/api/remote/uncache")
+        def api_remote_uncache(req: Request) -> Response:
+            """Drop local chunks of a remote-mounted entry
+            (command_remote_uncache.go)."""
+            if not self.guard.white_list_ok(req):
+                raise HttpError(401, "not in whitelist")
+            err = self.guard.check_filer_jwt(req)
+            if err:
+                raise HttpError(401, err)
+            path = req.json()["path"]
+            try:
+                entry = self.filer.find_entry(path)
+            except FilerNotFound:
+                raise HttpError(404, f"{path} not found")
+            from ..remote_storage.mounts import uncache_entry
+
+            had = bool(entry.chunks)
+            uncache_entry(self, entry)
+            return Response({"uncached": had})
+
         @r.route("POST", "/api/entry")
         def api_entry(req: Request) -> Response:
             """Raw CreateEntry/UpdateEntry with caller-provided chunks
@@ -365,7 +397,15 @@ class FilerServer:
                 })
             from ..utils.httpd import UNSATISFIABLE_RANGE, parse_range
 
-            file_size = entry.file_size
+            # uncached remote-mounted object: pull from the remote and
+            # persist as local chunks (filer/read_remote.go)
+            if not entry.chunks and "remote.entry" in entry.extended \
+                    and req.handler.command != "HEAD":
+                from ..remote_storage.mounts import cache_remote_object
+
+                cache_remote_object(self, entry)
+                entry = self.filer.find_entry(path)
+            file_size = _effective_size(entry)
             rng = parse_range(req.headers.get("Range", ""), file_size)
             if rng == UNSATISFIABLE_RANGE:
                 return Response(raw=b"", status=416,
@@ -441,7 +481,8 @@ class FilerServer:
             "Crtime": e.attr.crtime,
             "Mode": e.attr.mode,
             "Mime": e.attr.mime,
-            "FileSize": e.file_size,
+            "FileSize": _effective_size(e),
             "IsDirectory": e.is_directory,
             "chunks": len(e.chunks),
+            "Remote": "remote.entry" in e.extended,
         }

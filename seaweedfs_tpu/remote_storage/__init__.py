@@ -1,0 +1,22 @@
+"""Remote storage: mount cloud buckets as filer directories.
+
+Equivalent of weed/remote_storage/ (per-vendor clients), pb/remote.proto
+(RemoteConf / RemoteStorageLocation / RemoteEntry), filer/read_remote.go
+(CacheRemoteObjectToLocalCluster) and the remote.* shell family.
+
+Vendors: "local" (a directory posing as a bucket — the offline dev/test
+backend), "s3" (any S3-compatible endpoint over HTTP, including this
+framework's own gateway); gcs/azure/hdfs are SDK-gated stubs.
+"""
+
+from .client import (LocalRemoteStorage, RemoteConf, RemoteLocation,
+                     RemoteStorageClient, S3RemoteStorage, make_client)
+from .mounts import (MOUNTS_PATH, REMOTE_CONF_PATH, RemoteMounts,
+                     cache_remote_object, read_mounts, read_remote_conf)
+
+__all__ = [
+    "RemoteStorageClient", "RemoteConf", "RemoteLocation",
+    "LocalRemoteStorage", "S3RemoteStorage", "make_client",
+    "RemoteMounts", "read_mounts", "read_remote_conf",
+    "cache_remote_object", "MOUNTS_PATH", "REMOTE_CONF_PATH",
+]

@@ -1,0 +1,277 @@
+"""Remote storage clients (weed/remote_storage/*/).
+
+Interface mirrors remote_storage.RemoteStorageClient: Traverse,
+ReadFile, WriteFile, DeleteFile, write/remove directory are no-ops for
+object stores.
+"""
+
+from __future__ import annotations
+
+import os
+import urllib.parse
+from dataclasses import dataclass, field
+from typing import Callable, Iterator, Optional
+
+from ..utils.httpd import HttpError, http_bytes
+
+
+@dataclass
+class RemoteConf:
+    """pb/remote.proto RemoteConf: named credentials + vendor type."""
+    name: str
+    type: str = "local"
+    # vendor-specific settings
+    root: str = ""                # local: the directory posing as cloud
+    endpoint: str = ""            # s3
+    access_key: str = ""
+    secret_key: str = ""
+    extra: dict = field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        return {"name": self.name, "type": self.type, "root": self.root,
+                "endpoint": self.endpoint, "access_key": self.access_key,
+                "secret_key": self.secret_key, "extra": self.extra}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "RemoteConf":
+        return cls(**{k: d.get(k, "") for k in
+                      ("name", "type", "root", "endpoint", "access_key",
+                       "secret_key")} | {"extra": d.get("extra", {})})
+
+
+@dataclass
+class RemoteLocation:
+    """pb/remote.proto RemoteStorageLocation: conf name + bucket + path."""
+    conf_name: str
+    bucket: str = ""
+    path: str = "/"
+
+    def to_dict(self) -> dict:
+        return {"conf_name": self.conf_name, "bucket": self.bucket,
+                "path": self.path}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "RemoteLocation":
+        return cls(d["conf_name"], d.get("bucket", ""),
+                   d.get("path", "/") or "/")
+
+    def child(self, rel: str) -> str:
+        """Remote key for a path relative to the mount."""
+        base = self.path.rstrip("/")
+        return f"{base}/{rel.lstrip('/')}" if rel.strip("/") else base or "/"
+
+
+@dataclass
+class RemoteObject:
+    """RemoteEntry essentials: what the filer stores about one object."""
+    key: str            # path within the bucket
+    size: int
+    mtime: float
+    etag: str = ""
+
+    def to_extended(self) -> dict:
+        import json
+
+        return {"remote.entry": json.dumps(
+            {"key": self.key, "size": self.size, "mtime": self.mtime,
+             "etag": self.etag})}
+
+
+class RemoteStorageClient:
+    def traverse(self, loc: RemoteLocation) -> Iterator[RemoteObject]:
+        raise NotImplementedError
+
+    def read_file(self, loc: RemoteLocation, key: str,
+                  offset: int = 0, size: int = -1) -> bytes:
+        raise NotImplementedError
+
+    def write_file(self, loc: RemoteLocation, key: str,
+                   data: bytes) -> RemoteObject:
+        raise NotImplementedError
+
+    def delete_file(self, loc: RemoteLocation, key: str) -> None:
+        raise NotImplementedError
+
+    def list_buckets(self) -> list[str]:
+        raise NotImplementedError
+
+
+class LocalRemoteStorage(RemoteStorageClient):
+    """remote_storage for a plain directory — 'bucket' = subdirectory."""
+
+    def __init__(self, conf: RemoteConf):
+        self.root = conf.root
+        os.makedirs(self.root, exist_ok=True)
+
+    def _abs(self, loc: RemoteLocation, key: str) -> str:
+        path = os.path.normpath(
+            os.path.join(self.root, loc.bucket, key.lstrip("/")))
+        if not (path + "/").startswith(os.path.normpath(self.root) + "/"):
+            raise ValueError(f"path escape: {key!r}")
+        return path
+
+    def traverse(self, loc: RemoteLocation) -> Iterator[RemoteObject]:
+        base = self._abs(loc, loc.path)
+        if not os.path.isdir(base):
+            return
+        for dirpath, _, files in os.walk(base):
+            for f in sorted(files):
+                p = os.path.join(dirpath, f)
+                rel = os.path.relpath(
+                    p, os.path.join(self.root, loc.bucket))
+                st = os.stat(p)
+                yield RemoteObject("/" + rel.replace(os.sep, "/"),
+                                  st.st_size, st.st_mtime)
+
+    def read_file(self, loc: RemoteLocation, key: str,
+                  offset: int = 0, size: int = -1) -> bytes:
+        with open(self._abs(loc, key), "rb") as f:
+            f.seek(offset)
+            return f.read() if size < 0 else f.read(size)
+
+    def write_file(self, loc: RemoteLocation, key: str,
+                   data: bytes) -> RemoteObject:
+        path = self._abs(loc, key)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "wb") as f:
+            f.write(data)
+        st = os.stat(path)
+        return RemoteObject(key, st.st_size, st.st_mtime)
+
+    def delete_file(self, loc: RemoteLocation, key: str) -> None:
+        try:
+            os.remove(self._abs(loc, key))
+        except FileNotFoundError:
+            pass
+
+    def list_buckets(self) -> list[str]:
+        return sorted(d for d in os.listdir(self.root)
+                      if os.path.isdir(os.path.join(self.root, d)))
+
+
+class S3RemoteStorage(RemoteStorageClient):
+    """S3-compatible endpoint over plain HTTP (+SigV4 when keyed) —
+    works against this framework's own gateway or any other."""
+
+    def __init__(self, conf: RemoteConf):
+        self.endpoint = conf.endpoint
+        self.access_key, self.secret_key = conf.access_key, conf.secret_key
+
+    def _url(self, loc: RemoteLocation, key: str = "",
+             query: str = "") -> str:
+        u = f"http://{self.endpoint}/{loc.bucket}"
+        if key:
+            u += "/" + urllib.parse.quote(key.lstrip("/"))
+        if query:
+            u += "?" + query
+        return u
+
+    def _signed(self, method: str, url: str) -> str:
+        if not self.access_key:
+            return url
+        from ..gateway.s3_auth import presign_v4
+
+        return presign_v4(method, url, self.access_key, self.secret_key)
+
+    def traverse(self, loc: RemoteLocation) -> Iterator[RemoteObject]:
+        import xml.etree.ElementTree as ET
+
+        token = ""
+        prefix = loc.path.strip("/")
+        while True:
+            q = "list-type=2"
+            if prefix:
+                q += "&prefix=" + urllib.parse.quote(prefix + "/")
+            if token:
+                q += "&continuation-token=" + urllib.parse.quote(token)
+            status, body, _ = http_bytes(
+                "GET", self._signed("GET", self._url(loc, query=q)))
+            if status != 200:
+                raise HttpError(status, body.decode(errors="replace"))
+            ns = {"s3": body.split(b"xmlns=", 1)[1].split(b'"')[1].decode()} \
+                if b"xmlns=" in body else {}
+            root = ET.fromstring(body)
+
+            def find_all(tag):
+                return root.findall(f"s3:{tag}", ns) if ns \
+                    else root.findall(tag)
+
+            for item in find_all("Contents"):
+                def text(tag, default=""):
+                    el = item.find(f"s3:{tag}", ns) if ns else item.find(tag)
+                    return el.text if el is not None and el.text else default
+
+                import email.utils
+
+                mtime_s = text("LastModified")
+                try:
+                    import datetime
+
+                    mtime = datetime.datetime.fromisoformat(
+                        mtime_s.replace("Z", "+00:00")).timestamp()
+                except ValueError:
+                    mtime = 0.0
+                yield RemoteObject("/" + text("Key"), int(text("Size", "0")),
+                                  mtime, text("ETag").strip('"'))
+            tok_el = (root.find("s3:NextContinuationToken", ns) if ns
+                      else root.find("NextContinuationToken"))
+            if tok_el is None or not tok_el.text:
+                return
+            token = tok_el.text
+
+    def read_file(self, loc: RemoteLocation, key: str,
+                  offset: int = 0, size: int = -1) -> bytes:
+        headers = {}
+        if offset or size >= 0:
+            end = "" if size < 0 else str(offset + size - 1)
+            headers["Range"] = f"bytes={offset}-{end}"
+        status, body, _ = http_bytes(
+            "GET", self._signed("GET", self._url(loc, key)),
+            headers=headers or None)
+        if status not in (200, 206):
+            raise HttpError(status, body.decode(errors="replace"))
+        return body
+
+    def write_file(self, loc: RemoteLocation, key: str,
+                   data: bytes) -> RemoteObject:
+        import time
+
+        status, body, _ = http_bytes(
+            "PUT", self._signed("PUT", self._url(loc, key)), data)
+        if status not in (200, 201):
+            raise HttpError(status, body.decode(errors="replace"))
+        return RemoteObject(key, len(data), time.time())
+
+    def delete_file(self, loc: RemoteLocation, key: str) -> None:
+        http_bytes("DELETE", self._signed("DELETE", self._url(loc, key)))
+
+    def list_buckets(self) -> list[str]:
+        import xml.etree.ElementTree as ET
+
+        status, body, _ = http_bytes(
+            "GET", self._signed("GET", f"http://{self.endpoint}/"))
+        if status != 200:
+            raise HttpError(status, body.decode(errors="replace"))
+        root = ET.fromstring(body)
+        names = [el.text for el in root.iter()
+                 if el.tag.endswith("Name") and el.text]
+        return sorted(n for n in names if n)
+
+
+_GATED = {
+    "gcs": "google-cloud-storage",
+    "azure": "azure-storage-blob",
+    "hdfs": "pyarrow/hdfs",
+}
+
+
+def make_client(conf: RemoteConf) -> RemoteStorageClient:
+    if conf.type == "local":
+        return LocalRemoteStorage(conf)
+    if conf.type == "s3":
+        return S3RemoteStorage(conf)
+    if conf.type in _GATED:
+        raise RuntimeError(
+            f"remote storage type {conf.type!r} requires {_GATED[conf.type]}"
+            " which is not available in this environment")
+    raise ValueError(f"unknown remote storage type {conf.type!r}")

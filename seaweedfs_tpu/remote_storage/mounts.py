@@ -1,0 +1,144 @@
+"""Mount bookkeeping + remote object caching.
+
+Equivalent of filer/remote_storage.go (mappings read from in-FS config)
+and filer/read_remote.go CacheRemoteObjectToLocalCluster: remote confs
+live at /etc/remote.conf, mount mappings at /etc/remote.mount — both
+ordinary filer files, so every filer/gateway sees the same view.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.parse
+from typing import Optional
+
+from ..utils.httpd import HttpError, http_bytes
+from ..utils.jsonconf import read_json_conf as _read_json
+from ..utils.jsonconf import write_json_conf as _write_json
+from .client import (RemoteConf, RemoteLocation, RemoteObject,
+                     RemoteStorageClient, make_client)
+
+REMOTE_CONF_PATH = "/etc/remote.conf"
+MOUNTS_PATH = "/etc/remote.mount"
+
+
+def read_remote_conf(filer_url: str) -> dict[str, RemoteConf]:
+    d = _read_json(filer_url, REMOTE_CONF_PATH, {})
+    return {name: RemoteConf.from_dict(c) for name, c in d.items()}
+
+
+def write_remote_conf(filer_url: str, confs: dict[str, RemoteConf]) -> None:
+    _write_json(filer_url, REMOTE_CONF_PATH,
+                {n: c.to_dict() for n, c in confs.items()})
+
+
+class RemoteMounts:
+    """dir -> RemoteLocation mapping (filer/remote_storage.go)."""
+
+    def __init__(self, mounts: dict[str, RemoteLocation]):
+        self.mounts = mounts
+
+    @classmethod
+    def read(cls, filer_url: str) -> "RemoteMounts":
+        d = _read_json(filer_url, MOUNTS_PATH, {})
+        return cls({p: RemoteLocation.from_dict(l) for p, l in d.items()})
+
+    def write(self, filer_url: str) -> None:
+        _write_json(filer_url, MOUNTS_PATH,
+                    {p: l.to_dict() for p, l in self.mounts.items()})
+
+    def mount_of(self, path: str) -> Optional[tuple[str, RemoteLocation]]:
+        """Longest mount-dir prefix covering path."""
+        best = None
+        for d, loc in self.mounts.items():
+            if path == d or path.startswith(d.rstrip("/") + "/"):
+                if best is None or len(d) > len(best[0]):
+                    best = (d, loc)
+        return best
+
+
+def read_mounts(filer_url: str) -> RemoteMounts:
+    return RemoteMounts.read(filer_url)
+
+
+def remote_key_for(mount_dir: str, loc: RemoteLocation, path: str) -> str:
+    rel = path[len(mount_dir.rstrip("/")):]
+    return loc.child(rel)
+
+
+def sync_metadata(filer_url: str, mount_dir: str, loc: RemoteLocation,
+                  client: RemoteStorageClient) -> int:
+    """remote.meta.sync: import the remote listing as chunkless entries
+    carrying RemoteEntry metadata (filer/remote_storage.go pull)."""
+    count = 0
+    base_key = loc.path.rstrip("/")
+    for obj in client.traverse(loc):
+        rel = obj.key[len(base_key):] if base_key and \
+            obj.key.startswith(base_key) else obj.key
+        fpath = mount_dir.rstrip("/") + "/" + rel.lstrip("/")
+        stamp = obj.to_extended()["remote.entry"]
+        status, body, _ = http_bytes(
+            "GET", f"http://{filer_url}/api/stat"
+            + urllib.parse.quote(fpath))
+        if status == 200:
+            existing = json.loads(body)
+            marker = existing.get("extended", {}).get("remote.entry")
+            if marker == stamp:
+                continue  # unchanged on the remote
+            if marker is None and existing.get("chunks"):
+                # locally-created file not yet pushed to the remote:
+                # never destroy it with chunkless remote metadata
+                continue
+        entry = {
+            "full_path": fpath,
+            "attr": {"mtime": obj.mtime, "crtime": obj.mtime,
+                     "mode": 0o644, "mime": ""},
+            "chunks": [],
+            "extended": obj.to_extended(),
+        }
+        status, body, _ = http_bytes(
+            "POST", f"http://{filer_url}/api/entry",
+            json.dumps(entry).encode(),
+            headers={"Content-Type": "application/json"})
+        if status not in (200, 201):
+            raise HttpError(status, body.decode(errors="replace"))
+        count += 1
+    return count
+
+
+def cache_remote_object(filer_server, entry) -> bytes:
+    """CacheRemoteObjectToLocalCluster (filer/read_remote.go): fetch the
+    object from its remote, write it as local chunks, update the entry.
+    Returns the content."""
+    meta = json.loads(entry.extended["remote.entry"])
+    mounts = RemoteMounts.read(filer_server.url)
+    hit = mounts.mount_of(entry.full_path)
+    if hit is None:
+        raise HttpError(404, f"{entry.full_path}: no remote mount")
+    mount_dir, loc = hit
+    confs = read_remote_conf(filer_server.url)
+    conf = confs.get(loc.conf_name)
+    if conf is None:
+        raise HttpError(500, f"remote conf {loc.conf_name!r} missing")
+    client = make_client(conf)
+    data = client.read_file(loc, meta["key"])
+    # persist as local chunks so subsequent reads are cluster-local
+    chunks = filer_server.write_chunks(data)
+    from ..filer.entry import Entry
+
+    cached = Entry(full_path=entry.full_path, attr=entry.attr,
+                   chunks=chunks, extended=dict(entry.extended))
+    filer_server.filer.create_entry(cached)
+    return data
+
+
+def uncache_entry(filer_server, entry) -> None:
+    """remote.uncache: drop local chunks, keep the remote metadata."""
+    from ..filer.entry import Entry
+
+    if not entry.chunks or "remote.entry" not in entry.extended:
+        return
+    bare = Entry(full_path=entry.full_path, attr=entry.attr, chunks=[],
+                 extended=dict(entry.extended))
+    filer_server.filer.create_entry(bare)

@@ -1,0 +1,193 @@
+"""filer.remote.sync: push local changes under a remote mount back to
+the cloud.
+
+Equivalent of weed/command/filer_remote_sync.go: tails the filer meta
+log scoped to the mount directory and applies local mutations to the
+remote (uploads on create/update, deletes on delete/rename-out).
+Cache/uncache events — where the entry's RemoteEntry metadata is
+unchanged — are skipped, so remote.cache does not echo an upload.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.parse
+from typing import Optional
+
+from ..utils.httpd import HttpError, http_bytes, http_json
+from .client import RemoteLocation, make_client
+from .mounts import (RemoteMounts, read_remote_conf, remote_key_for)
+
+
+class RemoteSyncer:
+    def __init__(self, filer_url: str, mount_dir: str,
+                 since_ns: Optional[int] = None,
+                 poll_interval: float = 0.5):
+        self.filer_url = filer_url
+        self.mount_dir = mount_dir.rstrip("/")
+        mounts = RemoteMounts.read(filer_url)
+        loc = mounts.mounts.get(self.mount_dir)
+        if loc is None:
+            raise ValueError(f"{mount_dir} is not a remote mount")
+        self.loc = loc
+        conf = read_remote_conf(filer_url).get(loc.conf_name)
+        if conf is None:
+            raise ValueError(f"remote conf {loc.conf_name!r} missing")
+        self.client = make_client(conf)
+        self.since_ns = time.time_ns() if since_ns is None else since_ns
+        self.poll_interval = poll_interval
+        self.pushed = 0
+        self._stop = threading.Event()
+        # remote.entry values we stamped ourselves: the resulting update
+        # events must not trigger re-uploads (would loop forever)
+        self._stamped: set[tuple[str, str]] = set()
+
+    # --- event application ------------------------------------------------
+    def _key_for(self, path: str) -> str:
+        return remote_key_for(self.mount_dir, self.loc, path)
+
+    def _in_mount(self, path: str) -> bool:
+        return path == self.mount_dir \
+            or path.startswith(self.mount_dir + "/")
+
+    @staticmethod
+    def _is_cache_event(old: Optional[dict], new: Optional[dict]) -> bool:
+        """remote.cache / remote.uncache only toggle local chunks; the
+        RemoteEntry metadata stays identical — nothing to push."""
+        if not old or not new:
+            return False
+        if old["full_path"] != new["full_path"]:
+            return False  # a rename copies extended; it is NOT a cache op
+        o = old.get("extended", {}).get("remote.entry")
+        n = new.get("extended", {}).get("remote.entry")
+        return o is not None and o == n
+
+    def apply(self, event: dict) -> bool:
+        old, new = event.get("old_entry"), event.get("new_entry")
+        op = event["op"]
+        dirbit = 0o20000000000
+        if self._is_cache_event(old, new):
+            return False
+        if new:
+            marker = new.get("extended", {}).get("remote.entry")
+            if marker and (new["full_path"], marker) in self._stamped:
+                self._stamped.discard((new["full_path"], marker))
+                return False  # our own stamp echoing back
+        if op in ("create", "update") and new:
+            if not self._in_mount(new["full_path"]):
+                return False
+            if new["attr"]["mode"] & dirbit:
+                return False
+            # metadata import (meta.sync) creates chunkless entries WITH
+            # remote metadata — those came FROM the remote; skip
+            if not new.get("chunks") and \
+                    "remote.entry" in new.get("extended", {}):
+                return False
+            data = self._fetch(new["full_path"])
+            if data is None:
+                return False
+            obj = self.client.write_file(
+                self.loc, self._key_for(new["full_path"]), data)
+            self._stamp(new, obj)
+            self.pushed += 1
+            return True
+        if op == "delete" and old:
+            if not self._in_mount(old["full_path"]) \
+                    or old["attr"]["mode"] & dirbit:
+                return False
+            self.client.delete_file(self.loc,
+                                    self._key_for(old["full_path"]))
+            self.pushed += 1
+            return True
+        if op == "rename" and old and new:
+            applied = False
+            if self._in_mount(old["full_path"]) \
+                    and not old["attr"]["mode"] & dirbit:
+                self.client.delete_file(self.loc,
+                                        self._key_for(old["full_path"]))
+                applied = True
+            if self._in_mount(new["full_path"]) \
+                    and not new["attr"]["mode"] & dirbit:
+                data = self._fetch(new["full_path"])
+                if data is not None:
+                    obj = self.client.write_file(
+                        self.loc, self._key_for(new["full_path"]), data)
+                    self._stamp(new, obj)
+                    applied = True
+            if applied:
+                self.pushed += 1
+            return applied
+        return False
+
+    def _fetch(self, path: str) -> Optional[bytes]:
+        status, body, _ = http_bytes(
+            "GET", f"http://{self.filer_url}" + urllib.parse.quote(path))
+        if status == 404:
+            return None
+        if status != 200:
+            raise HttpError(status, body.decode(errors="replace"))
+        return body
+
+    def _stamp(self, entry_dict: dict, obj) -> None:
+        """Record the new RemoteEntry on the filer entry so subsequent
+        syncs recognize it as up to date.  The CURRENT entry is re-read
+        and merged — posting the (possibly stale) event snapshot back
+        would roll back a newer write and GC its chunks."""
+        path = entry_dict["full_path"]
+        status, body, _ = http_bytes(
+            "GET", f"http://{self.filer_url}/api/stat"
+            + urllib.parse.quote(path))
+        if status != 200:
+            return  # entry vanished; nothing to stamp
+        current = json.loads(body)
+        current.pop("file_size", None)
+        current.pop("is_directory", None)
+        extended = dict(current.get("extended", {}))
+        stamp = obj.to_extended()
+        extended.update(stamp)
+        current["extended"] = extended
+        self._stamped.add((path, stamp["remote.entry"]))
+        http_bytes("POST", f"http://{self.filer_url}/api/entry",
+                   json.dumps(current).encode(),
+                   headers={"Content-Type": "application/json"})
+
+    # --- loop -------------------------------------------------------------
+    def poll_once(self) -> int:
+        r = http_json(
+            "GET", f"http://{self.filer_url}/api/meta/log?"
+            f"since_ns={self.since_ns}&path_prefix="
+            + urllib.parse.quote(self.mount_dir))
+        n = 0
+        for ev in r["events"]:
+            if self.apply(ev):
+                n += 1
+        self.since_ns = r["next_ns"]
+        return n
+
+    def run_until_caught_up(self, timeout: float = 30.0) -> int:
+        total = 0
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            n = self.poll_once()
+            total += n
+            if n == 0:
+                return total
+        return total
+
+    def start(self) -> "RemoteSyncer":
+        def loop() -> None:
+            while not self._stop.is_set():
+                try:
+                    self.poll_once()
+                except Exception:
+                    pass
+                self._stop.wait(self.poll_interval)
+
+        threading.Thread(target=loop, daemon=True,
+                         name=f"remote-sync-{self.mount_dir}").start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()

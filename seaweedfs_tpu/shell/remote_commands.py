@@ -1,0 +1,190 @@
+"""remote.* shell commands (weed/shell/command_remote_*.go).
+
+remote.configure    — save named remote storage credentials
+remote.mount        — map a filer directory to a remote bucket/path
+remote.mount.buckets— mount every bucket of a remote
+remote.meta.sync    — re-pull the remote listing into filer metadata
+remote.cache        — pull object content into local chunks
+remote.uncache      — drop local chunks, keep remote metadata
+remote.unmount      — remove the mapping (and its imported metadata)
+"""
+
+from __future__ import annotations
+
+import json
+
+from ..remote_storage.client import RemoteConf, RemoteLocation, make_client
+from ..remote_storage.mounts import (REMOTE_CONF_PATH, RemoteMounts,
+                                     read_remote_conf, remote_key_for,
+                                     sync_metadata, write_remote_conf)
+from ..utils.httpd import HttpError, http_bytes, http_json
+from .commands import CommandEnv, command
+from .fs_commands import _filer, _listing
+
+
+def _loc_parse(s: str) -> RemoteLocation:
+    """conf_name/bucket/path/in/bucket"""
+    parts = s.strip("/").split("/", 2)
+    if not parts or not parts[0]:
+        raise ValueError("remote location must be <conf>/<bucket>[/path]")
+    return RemoteLocation(parts[0], parts[1] if len(parts) > 1 else "",
+                          "/" + parts[2] if len(parts) > 2 else "/")
+
+
+@command("remote.configure")
+def cmd_remote_configure(env: CommandEnv, flags: dict) -> str:
+    """remote.configure [-name n -type local|s3 [-root /dir]
+    [-endpoint host:port] [-accessKey k -secretKey s] | -delete -name n]
+    # create/update/delete named remote storage configurations"""
+    confs = read_remote_conf(_filer(env))
+    name = flags.get("name", "")
+    if not name:
+        return json.dumps({n: c.to_dict() for n, c in confs.items()},
+                          indent=2)
+    env.confirm_is_locked()
+    if "delete" in flags:
+        if confs.pop(name, None) is None:
+            return f"no remote configuration {name!r}"
+        write_remote_conf(_filer(env), confs)
+        return f"deleted remote configuration {name}"
+    conf = RemoteConf(name=name, type=flags.get("type", "local"),
+                      root=flags.get("root", ""),
+                      endpoint=flags.get("endpoint", ""),
+                      access_key=flags.get("accessKey", ""),
+                      secret_key=flags.get("secretKey", ""))
+    make_client(conf)  # validate type/SDK availability before saving
+    confs[name] = conf
+    write_remote_conf(_filer(env), confs)
+    return f"configured remote {name} ({conf.type})"
+
+
+@command("remote.mount")
+def cmd_remote_mount(env: CommandEnv, flags: dict) -> str:
+    """remote.mount -dir /buckets/b -remote conf/bucket[/path]
+    # map a filer directory to remote storage and import its metadata"""
+    env.confirm_is_locked()
+    dir_path = flags["dir"]
+    loc = _loc_parse(flags["remote"])
+    confs = read_remote_conf(_filer(env))
+    conf = confs.get(loc.conf_name)
+    if conf is None:
+        raise RuntimeError(f"unknown remote configuration {loc.conf_name!r};"
+                           " run remote.configure first")
+    client = make_client(conf)
+    http_json("POST", f"http://{_filer(env)}/api/mkdir",
+              {"path": dir_path})
+    mounts = RemoteMounts.read(_filer(env))
+    mounts.mounts[dir_path] = loc
+    mounts.write(_filer(env))
+    n = sync_metadata(_filer(env), dir_path, loc, client)
+    return f"mounted {flags['remote']} on {dir_path} ({n} entries)"
+
+
+@command("remote.mount.buckets")
+def cmd_remote_mount_buckets(env: CommandEnv, flags: dict) -> str:
+    """remote.mount.buckets -remote conf [-bucketPattern *]
+    # mount every bucket of a remote under /buckets/<name>"""
+    env.confirm_is_locked()
+    import fnmatch
+
+    conf_name = flags["remote"].strip("/")
+    confs = read_remote_conf(_filer(env))
+    conf = confs.get(conf_name)
+    if conf is None:
+        raise RuntimeError(f"unknown remote configuration {conf_name!r}")
+    client = make_client(conf)
+    pattern = flags.get("bucketPattern", "*")
+    out = []
+    for bucket in client.list_buckets():
+        if not fnmatch.fnmatch(bucket, pattern):
+            continue
+        out.append(cmd_remote_mount(env, {
+            "dir": f"/buckets/{bucket}",
+            "remote": f"{conf_name}/{bucket}"}))
+    return "\n".join(out) or "no buckets matched"
+
+
+@command("remote.meta.sync")
+def cmd_remote_meta_sync(env: CommandEnv, flags: dict) -> str:
+    """remote.meta.sync -dir /buckets/b  # re-pull the remote listing"""
+    env.confirm_is_locked()
+    dir_path = flags["dir"]
+    mounts = RemoteMounts.read(_filer(env))
+    loc = mounts.mounts.get(dir_path)
+    if loc is None:
+        raise RuntimeError(f"{dir_path} is not a remote mount")
+    conf = read_remote_conf(_filer(env))[loc.conf_name]
+    n = sync_metadata(_filer(env), dir_path, loc, make_client(conf))
+    return f"synced {n} entries into {dir_path}"
+
+
+def _walk_files(env: CommandEnv, path: str):
+    for e in _listing(env, path):
+        if e["IsDirectory"]:
+            yield from _walk_files(env, e["FullPath"])
+        else:
+            yield e
+
+
+@command("remote.cache")
+def cmd_remote_cache(env: CommandEnv, flags: dict) -> str:
+    """remote.cache -dir /buckets/b [-include *.pdf]
+    # pull remote object content into local chunks"""
+    env.confirm_is_locked()
+    import fnmatch
+
+    dir_path = flags["dir"]
+    include = flags.get("include", "*")
+    cached = 0
+    for e in _walk_files(env, dir_path):
+        name = e["FullPath"].rsplit("/", 1)[-1]
+        if not fnmatch.fnmatch(name, include):
+            continue
+        if not e.get("Remote") or e.get("chunks"):
+            continue
+        # a plain GET triggers CacheRemoteObjectToLocalCluster
+        status, body, _ = http_bytes(
+            "GET", f"http://{_filer(env)}{e['FullPath']}")
+        if status == 200:
+            cached += 1
+    return f"cached {cached} objects under {dir_path}"
+
+
+@command("remote.uncache")
+def cmd_remote_uncache(env: CommandEnv, flags: dict) -> str:
+    """remote.uncache -dir /buckets/b [-include *.bin]
+    # drop local chunk copies, keep remote metadata"""
+    env.confirm_is_locked()
+    import fnmatch
+
+    dir_path = flags["dir"]
+    include = flags.get("include", "*")
+    n = 0
+    for e in _walk_files(env, dir_path):
+        name = e["FullPath"].rsplit("/", 1)[-1]
+        if not fnmatch.fnmatch(name, include):
+            continue
+        if not e.get("Remote") or not e.get("chunks"):
+            continue
+        r = http_json("POST", f"http://{_filer(env)}/api/remote/uncache",
+                      {"path": e["FullPath"]})
+        n += 1 if r.get("uncached") else 0
+    return f"uncached {n} objects under {dir_path}"
+
+
+@command("remote.unmount")
+def cmd_remote_unmount(env: CommandEnv, flags: dict) -> str:
+    """remote.unmount -dir /buckets/b
+    # remove the mapping and the imported metadata tree"""
+    env.confirm_is_locked()
+    dir_path = flags["dir"]
+    mounts = RemoteMounts.read(_filer(env))
+    if dir_path not in mounts.mounts:
+        raise RuntimeError(f"{dir_path} is not a remote mount")
+    del mounts.mounts[dir_path]
+    mounts.write(_filer(env))
+    status, body, _ = http_bytes(
+        "DELETE", f"http://{_filer(env)}{dir_path}?recursive=true")
+    if status not in (200, 204, 404):
+        raise HttpError(status, body.decode(errors="replace"))
+    return f"unmounted {dir_path}"

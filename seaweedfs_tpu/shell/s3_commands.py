@@ -22,24 +22,15 @@ UPLOADS_PATH = "/buckets/.uploads"
 
 
 def _read_json_conf(env: CommandEnv, path: str, default):
-    """GET a JSON config file from the filer.  Only a clean 404 maps to
-    the default — a transient 5xx must raise, or the caller's
-    read-modify-write would wipe the whole file."""
-    status, body, _ = http_bytes("GET", f"http://{_filer(env)}{path}")
-    if status == 404:
-        return default
-    if status != 200:
-        raise HttpError(status, body.decode(errors="replace"))
-    return json.loads(body)
+    from ..utils.jsonconf import read_json_conf
+
+    return read_json_conf(_filer(env), path, default)
 
 
 def _write_json_conf(env: CommandEnv, path: str, config) -> None:
-    status, body, _ = http_bytes(
-        "PUT", f"http://{_filer(env)}{path}",
-        json.dumps(config, indent=2).encode(),
-        headers={"Content-Type": "application/json"})
-    if status not in (200, 201):
-        raise HttpError(status, body.decode(errors="replace"))
+    from ..utils.jsonconf import write_json_conf
+
+    write_json_conf(_filer(env), path, config)
 
 
 def _read_identities(env: CommandEnv) -> dict:
@@ -183,8 +174,25 @@ def cmd_s3_bucket_quota(env: CommandEnv, flags: dict) -> str:
     if "remove" in flags:
         env.confirm_is_locked()
         quotas.pop(name, None)
+        out = [f"removed quota of bucket {name}"]
+        if name in qc.get("marked", []):
+            # lift the read-only mark we set, or the bucket stays frozen
+            # with no quota to ever clear it
+            from ..filer.filer_conf import FILER_CONF_PATH, FilerConf
+
+            status, body, _ = http_bytes(
+                "GET", f"http://{_filer(env)}{FILER_CONF_PATH}")
+            conf = FilerConf.from_bytes(body if status == 200 else b"")
+            prefix = f"{BUCKETS_PATH}/{name}"
+            rule = conf.rules.get(prefix)
+            if rule is not None and rule.read_only:
+                conf.delete_rule(prefix)
+                _write_json_conf(env, FILER_CONF_PATH,
+                                 json.loads(conf.to_bytes()))
+            qc["marked"] = [m for m in qc["marked"] if m != name]
+            out.append(f"lifted read-only on {prefix}")
         _write_quota_conf(env, qc)
-        return f"removed quota of bucket {name}"
+        return "\n".join(out)
     if "sizeMB" in flags:
         env.confirm_is_locked()
         quotas[name] = int(flags["sizeMB"]) * 1024 * 1024

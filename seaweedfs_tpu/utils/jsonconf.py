@@ -1,0 +1,31 @@
+"""Shared JSON-config-file-in-the-filer helpers.
+
+Several planes store small JSON config documents as ordinary filer files
+(/etc/seaweedfs/identity.json, bucket_quotas.json, /etc/remote.conf,
+/etc/remote.mount).  Only a clean 404 maps to the default — transient
+5xx must raise, or a caller's read-modify-write would wipe the file.
+"""
+
+from __future__ import annotations
+
+import json
+
+from .httpd import HttpError, http_bytes
+
+
+def read_json_conf(filer_url: str, path: str, default):
+    status, body, _ = http_bytes("GET", f"http://{filer_url}{path}")
+    if status == 404:
+        return default
+    if status != 200:
+        raise HttpError(status, body.decode(errors="replace"))
+    return json.loads(body)
+
+
+def write_json_conf(filer_url: str, path: str, obj) -> None:
+    status, body, _ = http_bytes(
+        "PUT", f"http://{filer_url}{path}",
+        json.dumps(obj, indent=2).encode(),
+        headers={"Content-Type": "application/json"})
+    if status not in (200, 201):
+        raise HttpError(status, body.decode(errors="replace"))

@@ -1,0 +1,134 @@
+"""Remote storage: configure/mount/cache/uncache/unmount + remote sync.
+
+Reference behaviors: weed/remote_storage/, filer/read_remote.go,
+shell/command_remote_*.go, command/filer_remote_sync.go.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import pytest
+
+from seaweedfs_tpu.filer.server import FilerServer
+from seaweedfs_tpu.master.server import MasterServer
+from seaweedfs_tpu.remote_storage.client import (LocalRemoteStorage,
+                                                 RemoteConf, RemoteLocation)
+from seaweedfs_tpu.remote_storage.sync import RemoteSyncer
+from seaweedfs_tpu.shell.commands import CommandEnv, run_command
+from seaweedfs_tpu.utils.httpd import http_bytes, http_json
+from seaweedfs_tpu.volume_server.server import VolumeServer
+from tests.conftest import free_port
+
+
+@pytest.fixture
+def stack(tmp_path):
+    master = MasterServer(port=free_port(), pulse_seconds=0.3).start()
+    d = tmp_path / "vs0"
+    d.mkdir()
+    vol = VolumeServer([str(d)], master.url, port=free_port(),
+                       pulse_seconds=0.3).start()
+    deadline = time.time() + 5
+    while time.time() < deadline and len(master.topo.all_nodes()) < 1:
+        time.sleep(0.05)
+    filer = FilerServer(master.url, port=free_port(), max_chunk_mb=1).start()
+    env = CommandEnv(master.url, filer.url)
+    env.lock()
+    # a "cloud": local dir with one bucket and two objects
+    cloud = tmp_path / "cloud"
+    (cloud / "bkt/photos").mkdir(parents=True)
+    (cloud / "bkt/photos/a.jpg").write_bytes(b"JPEGDATA" * 100)
+    (cloud / "bkt/readme.txt").write_bytes(b"read me")
+    yield master, vol, filer, env, cloud
+    filer.stop()
+    vol.stop()
+    master.stop()
+
+
+def test_local_client_traverse_and_io(tmp_path):
+    conf = RemoteConf("c", type="local", root=str(tmp_path / "r"))
+    c = LocalRemoteStorage(conf)
+    loc = RemoteLocation("c", "b", "/")
+    c.write_file(loc, "/x/y.bin", b"hello")
+    objs = list(c.traverse(loc))
+    assert [(o.key, o.size) for o in objs] == [("/x/y.bin", 5)]
+    assert c.read_file(loc, "/x/y.bin") == b"hello"
+    assert c.read_file(loc, "/x/y.bin", offset=1, size=3) == b"ell"
+    assert c.list_buckets() == ["b"]
+    c.delete_file(loc, "/x/y.bin")
+    assert list(c.traverse(loc)) == []
+    with pytest.raises(ValueError):
+        c.read_file(loc, "/../../etc/passwd")
+
+
+def test_remote_mount_lazy_cache_and_uncache(stack, tmp_path):
+    master, vol, filer, env, cloud = stack
+    base = f"http://{filer.url}"
+    run_command(env, f"remote.configure -name mycloud -type local "
+                     f"-root {cloud}")
+    out = run_command(env, "remote.mount -dir /clouddata -remote mycloud/bkt")
+    assert "2 entries" in out
+    # metadata imported: size visible without content being local
+    stat = http_json("GET", base + "/api/stat/clouddata/photos/a.jpg")
+    assert stat["file_size"] == 800
+    assert stat["chunks"] == []
+    # first read faults the content in (CacheRemoteObjectToLocalCluster)
+    status, body, _ = http_bytes("GET", base + "/clouddata/photos/a.jpg")
+    assert (status, body) == (200, b"JPEGDATA" * 100)
+    stat = http_json("GET", base + "/api/stat/clouddata/photos/a.jpg")
+    assert len(stat["chunks"]) >= 1
+    # uncache drops the chunks but keeps the metadata
+    out = run_command(env, "remote.uncache -dir /clouddata")
+    assert "uncached 1" in out
+    stat = http_json("GET", base + "/api/stat/clouddata/photos/a.jpg")
+    assert stat["chunks"] == [] and stat["file_size"] == 800
+    # remote.cache pulls everything matching
+    out = run_command(env, "remote.cache -dir /clouddata -include *.txt")
+    assert "cached 1" in out
+    # unmount removes mapping + metadata
+    run_command(env, "remote.unmount -dir /clouddata")
+    assert http_bytes("GET", base + "/clouddata/readme.txt")[0] == 404
+
+
+def test_remote_mount_buckets(stack, tmp_path):
+    master, vol, filer, env, cloud = stack
+    (cloud / "second").mkdir()
+    (cloud / "second/s.txt").write_bytes(b"s")
+    run_command(env, f"remote.configure -name rc -type local -root {cloud}")
+    out = run_command(env, "remote.mount.buckets -remote rc")
+    assert "/buckets/bkt" in out and "/buckets/second" in out
+    status, body, _ = http_bytes(
+        "GET", f"http://{filer.url}/buckets/second/s.txt")
+    assert (status, body) == (200, b"s")
+
+
+def test_remote_sync_pushes_local_changes(stack, tmp_path):
+    master, vol, filer, env, cloud = stack
+    base = f"http://{filer.url}"
+    run_command(env, f"remote.configure -name mc -type local -root {cloud}")
+    run_command(env, "remote.mount -dir /rs -remote mc/bkt")
+    syncer = RemoteSyncer(filer.url, "/rs")
+    # local create propagates to the cloud
+    http_bytes("PUT", base + "/rs/new.bin", b"fresh-bytes")
+    n = syncer.run_until_caught_up()
+    assert n == 1
+    assert (cloud / "bkt/new.bin").read_bytes() == b"fresh-bytes"
+    # the stamp echo does not re-upload
+    assert syncer.run_until_caught_up() == 0
+    # caching a remote object does not echo an upload
+    http_bytes("GET", base + "/rs/readme.txt")
+    assert syncer.run_until_caught_up() == 0
+    # local delete propagates
+    http_bytes("DELETE", base + "/rs/new.bin")
+    assert syncer.run_until_caught_up() == 1
+    assert not (cloud / "bkt/new.bin").exists()
+    # rename moves the remote object
+    http_bytes("PUT", base + "/rs/old.txt", b"mv-me")
+    syncer.run_until_caught_up()
+    http_json("POST", base + "/api/rename",
+              {"from": "/rs/old.txt", "to": "/rs/new2.txt"})
+    syncer.run_until_caught_up()
+    assert not (cloud / "bkt/old.txt").exists()
+    assert (cloud / "bkt/new2.txt").read_bytes() == b"mv-me"

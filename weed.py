@@ -211,6 +211,18 @@ def cmd_filer_meta_backup(args) -> None:
         time.sleep(args.pollSeconds)
 
 
+def cmd_filer_remote_sync(args) -> None:
+    """Push local changes under remote mounts back to the cloud
+    (command/filer_remote_sync.go)."""
+    from seaweedfs_tpu.remote_storage.sync import RemoteSyncer
+
+    syncers = [RemoteSyncer(args.filer, d).start()
+               for d in args.dir.split(",") if d]
+    print(f"filer.remote.sync: {args.filer} dirs={args.dir}")
+    _on_interrupt(lambda: [s.stop() for s in syncers])
+    _wait_forever()
+
+
 def cmd_mount(args) -> None:
     """FUSE-mount a filer path (weed mount, mount/weedfs.go)."""
     from seaweedfs_tpu.mount.fuse_bridge import mount
@@ -436,6 +448,12 @@ def main(argv=None) -> None:
                      help="force a fresh full snapshot")
     fmb.add_argument("-pollSeconds", type=float, default=2.0)
     fmb.set_defaults(fn=cmd_filer_meta_backup)
+
+    frs = sub.add_parser("filer.remote.sync")
+    frs.add_argument("-filer", default="127.0.0.1:8888")
+    frs.add_argument("-dir", required=True,
+                     help="comma-separated remote-mounted directories")
+    frs.set_defaults(fn=cmd_filer_remote_sync)
 
     mt = sub.add_parser("mount")
     mt.add_argument("-filer", default="127.0.0.1:8888")
