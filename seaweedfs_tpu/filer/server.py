@@ -136,7 +136,9 @@ class FilerServer:
 
     def stop(self) -> None:
         if self._server:
-            self._server.shutdown()
+            from ..utils.httpd import stop_server
+
+            stop_server(self._server)
         self.filer.close()
         self.client.close()
 

@@ -95,7 +95,9 @@ class IamApiServer:
 
     def stop(self) -> None:
         if self._server:
-            self._server.shutdown()
+            from ..utils.httpd import stop_server
+
+            stop_server(self._server)
 
     # --- identity file round-trip ----------------------------------------
     def _load(self) -> IdentityAccessManagement:

@@ -100,7 +100,9 @@ class S3ApiServer:
 
     def stop(self) -> None:
         if self._server:
-            self._server.shutdown()
+            from ..utils.httpd import stop_server
+
+            stop_server(self._server)
         self._cancel_sub()
 
     @staticmethod

@@ -58,7 +58,9 @@ class WebDavServer:
 
     def stop(self) -> None:
         if self._server:
-            self._server.shutdown()
+            from ..utils.httpd import stop_server
+
+            stop_server(self._server)
 
     # --- helpers ----------------------------------------------------------
     def _fs_path(self, dav_path: str) -> str:

@@ -1,0 +1,219 @@
+"""Multi-master consensus: Raft-style leader election over HTTP.
+
+Equivalent of weed/server/raft_server.go + the chrislusf/raft dependency
+as used by the reference: the replicated state machine is tiny (just
+MaxVolumeId — topology is rebuilt from volume-server heartbeats), so
+this implements exactly what the reference relies on: terms, votes,
+majority election, leader heartbeats carrying the state, and a
+persisted snapshot (term/voted_for/max_volume_id — the -mdir /
+-resumeState analog).  Followers redirect control-plane writes to the
+leader; volume servers re-target their heartbeats on redirect.
+
+Single-node clusters (no peers) are leaders immediately, so the default
+deployment needs no election round-trips.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import threading
+import time
+from typing import Callable, Optional
+
+from ..utils.httpd import http_json
+
+HEARTBEAT_INTERVAL = 0.4
+ELECTION_TIMEOUT = (1.2, 2.4)
+
+
+class RaftNode:
+    def __init__(self, me: str, peers: list[str], state_dir: str = "",
+                 apply_state: Optional[Callable[[dict], None]] = None,
+                 read_state: Optional[Callable[[], dict]] = None):
+        self.me = me
+        self.peers = [p for p in peers if p and p != me]
+        self.state_dir = state_dir
+        self.apply_state = apply_state or (lambda s: None)
+        self.read_state = read_state or (lambda: {})
+        self.lock = threading.RLock()
+        self.term = 0
+        self.voted_for: Optional[str] = None
+        self.role = "follower" if self.peers else "leader"
+        self.leader: Optional[str] = None if self.peers else me
+        self._last_heard = time.time()
+        self._timeout = random.uniform(*ELECTION_TIMEOUT)
+        self._stop = threading.Event()
+        if state_dir:
+            os.makedirs(state_dir, exist_ok=True)
+            self._load()
+
+    # --- persistence (-mdir snapshot) -------------------------------------
+    def _state_path(self) -> str:
+        return os.path.join(self.state_dir, "raft_state.json")
+
+    def _load(self) -> None:
+        try:
+            with open(self._state_path()) as f:
+                d = json.load(f)
+            self.term = d.get("term", 0)
+            self.voted_for = d.get("voted_for")
+            if d.get("state"):
+                self.apply_state(d["state"])
+        except (FileNotFoundError, ValueError):
+            pass
+
+    def persist(self) -> None:
+        if not self.state_dir:
+            return
+        tmp = self._state_path() + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"term": self.term, "voted_for": self.voted_for,
+                       "state": self.read_state()}, f)
+        os.replace(tmp, self._state_path())
+
+    # --- role helpers -----------------------------------------------------
+    @property
+    def is_leader(self) -> bool:
+        with self.lock:
+            return self.role == "leader"
+
+    def quorum(self) -> int:
+        return (len(self.peers) + 1) // 2 + 1
+
+    # --- RPC handlers (the /raft/* routes call these) ---------------------
+    def handle_vote(self, term: int, candidate: str) -> dict:
+        with self.lock:
+            if term < self.term:
+                return {"term": self.term, "granted": False}
+            if term > self.term:
+                self.term = term
+                self.voted_for = None
+                self._become_follower(None)
+            granted = self.voted_for in (None, candidate)
+            if granted:
+                self.voted_for = candidate
+                self._last_heard = time.time()
+            self.persist()
+            return {"term": self.term, "granted": granted}
+
+    def handle_append(self, term: int, leader: str, state: dict) -> dict:
+        with self.lock:
+            if term < self.term:
+                return {"term": self.term, "ok": False}
+            if term > self.term:
+                self.term = term
+                self.voted_for = None
+            self._become_follower(leader)
+            self._last_heard = time.time()
+            if state:
+                self.apply_state(state)
+            self.persist()
+            return {"term": self.term, "ok": True}
+
+    def _become_follower(self, leader: Optional[str]) -> None:
+        if self.role != "follower" or (leader and self.leader != leader):
+            self.role = "follower"
+        if leader:
+            self.leader = leader
+        elif self.role != "leader":
+            pass  # keep last known leader for redirects until told better
+
+    # --- main loop --------------------------------------------------------
+    def start(self) -> "RaftNode":
+        if self.peers:
+            threading.Thread(target=self._run, daemon=True,
+                             name=f"raft-{self.me}").start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.persist()
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            with self.lock:
+                role = self.role
+                overdue = time.time() - self._last_heard > self._timeout
+            if role == "leader":
+                self._broadcast_append()
+                self._stop.wait(HEARTBEAT_INTERVAL)
+            elif overdue:
+                self._campaign()
+            else:
+                self._stop.wait(0.05)
+
+    def _campaign(self) -> None:
+        with self.lock:
+            self.role = "candidate"
+            self.term += 1
+            term = self.term
+            self.voted_for = self.me
+            self._last_heard = time.time()
+            self._timeout = random.uniform(*ELECTION_TIMEOUT)
+            self.persist()
+        votes = 1
+        for p in self.peers:
+            try:
+                r = http_json("POST", f"http://{p}/raft/vote",
+                              {"term": term, "candidate": self.me},
+                              timeout=1.0)
+            except Exception:
+                continue
+            with self.lock:
+                if r.get("term", 0) > self.term:
+                    self.term = r["term"]
+                    self._become_follower(None)
+                    self.persist()
+                    return
+            if r.get("granted"):
+                votes += 1
+        with self.lock:
+            if self.role == "candidate" and self.term == term \
+                    and votes >= self.quorum():
+                self.role = "leader"
+                self.leader = self.me
+        if self.is_leader:
+            self._broadcast_append()
+
+    def _broadcast_append(self) -> None:
+        with self.lock:
+            term = self.term
+            state = self.read_state()
+        results: list[dict] = []
+
+        def send(p: str) -> None:
+            try:
+                results.append(http_json(
+                    "POST", f"http://{p}/raft/append",
+                    {"term": term, "leader": self.me, "state": state},
+                    timeout=1.0))
+            except Exception:
+                pass
+
+        # parallel: one dead peer must not delay the live ones past the
+        # election timeout (serial 1s timeouts would cause flapping)
+        threads = [threading.Thread(target=send, args=(p,), daemon=True)
+                   for p in self.peers]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(1.5)
+        acked = 1
+        for r in results:
+            with self.lock:
+                if r.get("term", 0) > self.term:
+                    self.term = r["term"]
+                    self._become_follower(None)
+                    self.persist()
+                    return
+            if r.get("ok"):
+                acked += 1
+        # a leader partitioned from the quorum steps down so clients
+        # fail over instead of writing to a stale master
+        if self.peers and acked < self.quorum():
+            with self.lock:
+                if self.role == "leader":
+                    self._last_heard = time.time()
+                    self.role = "follower"

@@ -62,3 +62,6 @@ class SnowflakeSequencer:
 
     def set_max(self, seen: int) -> None:
         pass  # time-ordered; nothing to do
+
+    def peek(self) -> int:
+        return 0  # time-ordered; no replicable counter state

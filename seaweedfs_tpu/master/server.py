@@ -38,7 +38,8 @@ class MasterServer:
                  sequencer: str = "memory",
                  garbage_threshold: float = 0.3,
                  pulse_seconds: float = 5.0,
-                 guard: Optional[Guard] = None):
+                 guard: Optional[Guard] = None,
+                 peers: Optional[list[str]] = None, mdir: str = ""):
         self.host, self.port = host, port
         self.guard = guard or Guard()
         self.topo = Topology(volume_size_limit_mb * 1024 * 1024, pulse_seconds)
@@ -49,7 +50,14 @@ class MasterServer:
         from ..stats import master_metrics
 
         self.metrics = master_metrics()
-        self.metrics.leader_gauge.set(1)
+        from .consensus import RaftNode
+
+        self.raft = RaftNode(
+            f"{host}:{port}", peers or [], state_dir=mdir,
+            apply_state=self._apply_raft_state,
+            read_state=lambda: {"max_volume_id": self.topo.max_volume_id,
+                                "max_file_key": self.seq.peek()})
+        self.metrics.leader_gauge.set(1 if self.raft.is_leader else 0)
         self.router = Router("master", metrics=self.metrics)
         self._register_routes()
         self._server = None
@@ -66,14 +74,50 @@ class MasterServer:
 
     def start(self) -> "MasterServer":
         self._server = serve(self.router, self.host, self.port)
+        self.raft.start()
         threading.Thread(target=self._janitor_loop, daemon=True,
                          name="master-janitor").start()
         return self
 
     def stop(self) -> None:
         self._stop.set()
+        self.raft.stop()
         if self._server:
-            self._server.shutdown()
+            from ..utils.httpd import stop_server
+
+            stop_server(self._server)
+
+    # --- consensus (raft_server.go; state machine = MaxVolumeId) ----------
+    def _apply_raft_state(self, state: dict) -> None:
+        vid = int(state.get("max_volume_id", 0))
+        with self.topo.lock:
+            self.topo.max_volume_id = max(self.topo.max_volume_id, vid)
+        key = int(state.get("max_file_key", 0))
+        if key:
+            self.seq.set_max(key)
+
+    @property
+    def is_leader(self) -> bool:
+        return self.raft.is_leader
+
+    @property
+    def leader_url(self) -> str:
+        return self.raft.leader or self.url
+
+    def _require_leader(self, req: Optional[Request] = None) -> None:
+        """Control-plane calls happen on the leader; followers redirect
+        (master_server.go proxyToLeader), preserving path + query.  With
+        no elected leader, answer 503 so clients retry instead of being
+        redirect-looped back to this follower."""
+        if self.is_leader:
+            return
+        if not self.raft.leader or self.raft.leader == self.url:
+            raise HttpError(503, "no leader elected yet; retry")
+        target = req.handler.path if req is not None else ""
+        raise HttpError(307, f"not the leader; leader is "
+                        f"{self.leader_url}",
+                        headers={"Location":
+                                 f"http://{self.leader_url}{target}"})
 
     def _janitor_loop(self) -> None:
         while not self._stop.wait(self.topo.pulse_seconds):
@@ -84,8 +128,23 @@ class MasterServer:
     def _register_routes(self) -> None:
         r = self.router
 
+        @r.route("POST", "/raft/vote")
+        def raft_vote(req: Request) -> Response:
+            b = req.json()
+            return Response(self.raft.handle_vote(int(b["term"]),
+                                                  b["candidate"]))
+
+        @r.route("POST", "/raft/append")
+        def raft_append(req: Request) -> Response:
+            b = req.json()
+            r_ = self.raft.handle_append(int(b["term"]), b["leader"],
+                                         b.get("state") or {})
+            self.metrics.leader_gauge.set(1 if self.raft.is_leader else 0)
+            return Response(r_)
+
         @r.route("GET", "/dir/assign")
         def assign(req: Request) -> Response:
+            self._require_leader(req)
             count = int(req.query.get("count", 1))
             collection = req.query.get("collection", "")
             replication = req.query.get("replication") or self.default_replication
@@ -117,6 +176,7 @@ class MasterServer:
 
         @r.route("GET", "/dir/lookup")
         def lookup(req: Request) -> Response:
+            self._require_leader(req)
             vid_str = req.query.get("volumeId", "")
             vid = int(vid_str.split(",")[0])
             nodes = self.topo.lookup(vid, req.query.get("collection", ""))
@@ -141,6 +201,7 @@ class MasterServer:
 
         @r.route("GET", "/dir/lookup_ec")
         def lookup_ec(req: Request) -> Response:
+            self._require_leader(req)
             vid = int(req.query["volumeId"])
             locs = self.topo.lookup_ec_shards(vid)
             if locs is None:
@@ -154,17 +215,24 @@ class MasterServer:
 
         @r.route("GET", "/dir/status")
         def dir_status(req: Request) -> Response:
+            self._require_leader(req)
             return Response({"Topology": self.topo.to_map(),
                              "Version": "seaweedfs-tpu 0.1"})
 
         @r.route("GET", "/cluster/status")
         def cluster_status(req: Request) -> Response:
-            return Response({"IsLeader": True, "Leader": self.url, "Peers": []})
+            return Response({"IsLeader": self.is_leader,
+                             "Leader": self.leader_url,
+                             "Peers": self.raft.peers,
+                             "Term": self.raft.term})
 
         @r.route("GET", "/cluster/watch")
         def cluster_watch(req: Request) -> Response:
             """KeepConnected push surface: long-poll for vid->location
-            deltas (master_grpc_server.go:185)."""
+            deltas (master_grpc_server.go:185).  Leader-only: follower
+            topologies are empty, so watchers redirect (urllib follows
+            GET 307s transparently)."""
+            self._require_leader(req)
             since = int(req.query.get("since_seq") or 0)
             timeout = min(float(req.query.get("timeout") or 14.0), 55.0)
             return Response(self.topo.watch_locations(since, timeout))
@@ -179,6 +247,12 @@ class MasterServer:
         @r.route("POST", "/heartbeat")
         def heartbeat(req: Request) -> Response:
             hb = req.json()
+            if not self.is_leader:
+                # the volume server should re-target the leader
+                # (volume_grpc_client_to_master.go leader redirect)
+                known = self.raft.leader if self.raft.leader != self.url \
+                    else None
+                return Response({"leader": known, "not_leader": True})
             self.metrics.received_heartbeats.inc("total")
             node = self.topo.register_node(
                 hb["ip"], int(hb["port"]), hb.get("public_url", ""),
@@ -204,6 +278,7 @@ class MasterServer:
 
         @r.route("GET", "/vol/grow")
         def vol_grow(req: Request) -> Response:
+            self._require_leader(req)
             collection = req.query.get("collection", "")
             replication = req.query.get("replication") or self.default_replication
             rp = ReplicaPlacement.parse(replication)
@@ -215,12 +290,14 @@ class MasterServer:
 
         @r.route("GET", "/vol/vacuum")
         def vol_vacuum(req: Request) -> Response:
+            self._require_leader(req)
             threshold = float(req.query.get("garbageThreshold",
                                             self.garbage_threshold))
             return Response({"compacted": self.vacuum(threshold)})
 
         @r.route("POST", "/admin/lease")
         def admin_lease(req: Request) -> Response:
+            self._require_leader(req)
             body = req.json()
             now = time.time()
             prev = body.get("previous_token") or None

@@ -173,7 +173,9 @@ class BrokerServer:
     def stop(self) -> None:
         self._stop.set()
         if self._server:
-            self._server.shutdown()
+            from ..utils.httpd import stop_server
+
+            stop_server(self._server)
         self.topic_manager.flush_all()
 
     def _flush_loop(self) -> None:

@@ -21,10 +21,12 @@ from typing import Callable, Optional
 
 
 class HttpError(Exception):
-    def __init__(self, status: int, message: str = ""):
+    def __init__(self, status: int, message: str = "",
+                 headers: Optional[dict] = None):
         super().__init__(message or f"HTTP {status}")
         self.status = status
         self.message = message
+        self.headers = headers or {}
 
 
 class Request:
@@ -104,7 +106,8 @@ class Router:
                     if resp is None:
                         if isinstance(e, HttpError):
                             resp = Response({"error": e.message or str(e)},
-                                            status=e.status)
+                                            status=e.status,
+                                            headers=e.headers or None)
                         elif isinstance(e, (KeyError, LookupError)):
                             resp = Response({"error": str(e)}, status=404)
                         else:
@@ -199,6 +202,14 @@ def serve(router: Router, host: str, port: int) -> ThreadingHTTPServer:
 
 
 # --- client helpers ---------------------------------------------------------
+
+def stop_server(server) -> None:
+    """Shut down a serve() result: stop the loop AND close the listening
+    socket — otherwise clients queue in the accept backlog and hang
+    instead of failing over."""
+    server.shutdown()
+    server.server_close()
+
 
 def http_json(method: str, url: str, payload: Optional[dict] = None,
               timeout: float = 30.0) -> dict:

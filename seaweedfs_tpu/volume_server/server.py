@@ -62,7 +62,9 @@ class VolumeServer:
             from ..storage.backend import configure_backends
 
             configure_backends(backends)
-        self.master_url = master_url
+        # comma-separated master list; heartbeats follow the raft leader
+        self.masters = [m.strip() for m in master_url.split(",") if m.strip()]
+        self.master_url = self.masters[0]
         self.data_center = data_center
         self.rack = rack
         self.pulse_seconds = pulse_seconds
@@ -92,18 +94,38 @@ class VolumeServer:
     def stop(self) -> None:
         self._stop.set()
         if self._server:
-            self._server.shutdown()
+            from ..utils.httpd import stop_server
+
+            stop_server(self._server)
         self.store.close()
 
     def _heartbeat_loop(self) -> None:
         while not self._stop.is_set():
             try:
                 resp = http_json("POST", f"http://{self.master_url}/heartbeat",
-                                 self.heartbeat_payload())
+                                 self.heartbeat_payload(),
+                                 timeout=max(3.0, self.pulse_seconds * 2))
+                if resp.get("not_leader"):
+                    leader = resp.get("leader")
+                    if leader and leader != self.master_url:
+                        # follower redirect: re-target without waiting
+                        self.master_url = leader
+                        continue
+                    # leaderless cluster: rotate and wait out the pulse
+                    if len(self.masters) > 1:
+                        i = (self.masters.index(self.master_url) + 1) \
+                            if self.master_url in self.masters else 0
+                        self.master_url = self.masters[i % len(self.masters)]
+                    self._stop.wait(self.pulse_seconds)
+                    continue
                 self.store.volume_size_limit = int(
                     resp.get("volumeSizeLimit", self.store.volume_size_limit))
             except Exception:
-                pass
+                # master down: rotate through the configured list
+                if len(self.masters) > 1:
+                    i = (self.masters.index(self.master_url) + 1) \
+                        if self.master_url in self.masters else 0
+                    self.master_url = self.masters[i % len(self.masters)]
             self._stop.wait(self.pulse_seconds)
 
     def heartbeat_payload(self) -> dict:
@@ -113,8 +135,12 @@ class VolumeServer:
         return hb
 
     def heartbeat_now(self) -> None:
-        http_json("POST", f"http://{self.master_url}/heartbeat",
-                  self.heartbeat_payload())
+        resp = http_json("POST", f"http://{self.master_url}/heartbeat",
+                         self.heartbeat_payload())
+        if resp.get("not_leader") and resp.get("leader"):
+            self.master_url = resp["leader"]
+            http_json("POST", f"http://{self.master_url}/heartbeat",
+                      self.heartbeat_payload())
 
     # --- helpers ----------------------------------------------------------
     def _lookup_replicas(self, vid: int) -> list[str]:

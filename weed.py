@@ -24,9 +24,11 @@ def cmd_master(args) -> None:
     from seaweedfs_tpu.master.server import MasterServer
     from seaweedfs_tpu.security.config import master_guard
 
+    peers = [x for x in args.peers.split(",") if x]
     m = MasterServer(host=args.ip, port=args.port,
                      volume_size_limit_mb=args.volumeSizeLimitMB,
                      default_replication=args.defaultReplication,
+                     peers=peers, mdir=args.mdir,
                      guard=master_guard(_security())).start()
     print(f"master listening on {m.url}")
     _on_interrupt(m.stop)
@@ -361,6 +363,10 @@ def main(argv=None) -> None:
     m.add_argument("-port", type=int, default=9333)
     m.add_argument("-volumeSizeLimitMB", type=int, default=30000)
     m.add_argument("-defaultReplication", default="000")
+    m.add_argument("-peers", default="",
+                   help="comma-separated other master host:ports")
+    m.add_argument("-mdir", default="",
+                   help="dir for raft state persistence (-resumeState)")
     m.set_defaults(fn=cmd_master)
 
     v = sub.add_parser("volume")
