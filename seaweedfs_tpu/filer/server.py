@@ -53,7 +53,8 @@ class FilerServer:
                  host: str = "127.0.0.1", port: int = 8888,
                  max_chunk_mb: int = 8, collection: str = "",
                  replication: str = "", guard=None,
-                 notification_queue=None):
+                 notification_queue=None, chunk_cache_dir: str = "",
+                 chunk_cache_mem_mb: int = 64):
         from ..security import Guard
 
         self.guard = guard or Guard()
@@ -67,6 +68,13 @@ class FilerServer:
         from ..stats import filer_metrics
 
         self.metrics = filer_metrics()
+        # hot-chunk cache (util/chunk_cache): mem tier always on, disk
+        # tier when a cache dir is configured (-cacheDir)
+        from ..utils.chunk_cache import TieredChunkCache
+
+        self.chunk_cache = TieredChunkCache(
+            mem_limit=chunk_cache_mem_mb * 1024 * 1024,
+            disk_dir=chunk_cache_dir)
         self.router = Router("filer", metrics=self.metrics)
         self._register_routes()
         self._server = None
@@ -152,6 +160,7 @@ class FilerServer:
         jwts: dict[str, str] = {}
         secured: Optional[bool] = None
         for fid in fids:
+            self.chunk_cache.delete(fid)
             try:
                 if secured is not False:
                     # secured cluster: every fid needs a master-signed write
@@ -204,7 +213,10 @@ class FilerServer:
             return b""
         out = bytearray(size)
         for view in read_plan(entry.chunks, offset, size):
-            blob = self.client.download(view.file_id)
+            blob = self.chunk_cache.get(view.file_id)
+            if blob is None:
+                blob = self.client.download(view.file_id)
+                self.chunk_cache.set(view.file_id, blob)
             piece = blob[view.offset_in_chunk : view.offset_in_chunk + view.size]
             start = view.logic_offset - offset
             out[start : start + len(piece)] = piece

@@ -1,0 +1,13 @@
+"""Image resize + EXIF orientation on volume reads.
+
+Equivalent of weed/images/ (resizing.go, orientation.go): a GET on a
+volume server with ?width=/?height= and an image mime resizes on the
+fly.  Gated on Pillow being importable — this environment ships no
+image codec, so the volume server serves originals when unavailable
+(resized() returns the input unchanged, like the reference does for
+non-image content).
+"""
+
+from .resizing import resized, resizing_available
+
+__all__ = ["resized", "resizing_available"]

@@ -45,6 +45,8 @@ class RaftNode:
         self._last_heard = time.time()
         self._timeout = random.uniform(*ELECTION_TIMEOUT)
         self._stop = threading.Event()
+        self.on_role_change: Optional[Callable[[str], None]] = None
+        self._last_persisted: Optional[str] = None
         if state_dir:
             os.makedirs(state_dir, exist_ok=True)
             self._load()
@@ -67,11 +69,15 @@ class RaftNode:
     def persist(self) -> None:
         if not self.state_dir:
             return
+        doc = json.dumps({"term": self.term, "voted_for": self.voted_for,
+                          "state": self.read_state()}, sort_keys=True)
+        if doc == self._last_persisted:
+            return  # heartbeats with unchanged state skip the disk write
         tmp = self._state_path() + ".tmp"
         with open(tmp, "w") as f:
-            json.dump({"term": self.term, "voted_for": self.voted_for,
-                       "state": self.read_state()}, f)
+            f.write(doc)
         os.replace(tmp, self._state_path())
+        self._last_persisted = doc
 
     # --- role helpers -----------------------------------------------------
     @property
@@ -113,12 +119,20 @@ class RaftNode:
             return {"term": self.term, "ok": True}
 
     def _become_follower(self, leader: Optional[str]) -> None:
+        was = self.role
         if self.role != "follower" or (leader and self.leader != leader):
             self.role = "follower"
         if leader:
             self.leader = leader
-        elif self.role != "leader":
-            pass  # keep last known leader for redirects until told better
+        if was != self.role:
+            self._notify_role()
+
+    def _notify_role(self) -> None:
+        if self.on_role_change is not None:
+            try:
+                self.on_role_change(self.role)
+            except Exception:
+                pass
 
     # --- main loop --------------------------------------------------------
     def start(self) -> "RaftNode":
@@ -169,15 +183,30 @@ class RaftNode:
                     return
             if r.get("granted"):
                 votes += 1
+        won = False
         with self.lock:
             if self.role == "candidate" and self.term == term \
                     and votes >= self.quorum():
                 self.role = "leader"
                 self.leader = self.me
-        if self.is_leader:
+                won = True
+        if won:
+            self._notify_role()
             self._broadcast_append()
 
-    def _broadcast_append(self) -> None:
+    def commit_state(self) -> bool:
+        """Synchronously replicate the current state to a quorum — used
+        before acking volume-id allocations, so a leader crash cannot
+        let the next leader re-issue the same ids (the reference commits
+        MaxVolumeId through the raft log the same way)."""
+        if not self.peers:
+            self.persist()
+            return True
+        if not self.is_leader:
+            return False
+        return self._broadcast_append() >= self.quorum()
+
+    def _broadcast_append(self) -> int:
         with self.lock:
             term = self.term
             state = self.read_state()
@@ -207,7 +236,7 @@ class RaftNode:
                     self.term = r["term"]
                     self._become_follower(None)
                     self.persist()
-                    return
+                    return 0
             if r.get("ok"):
                 acked += 1
         # a leader partitioned from the quorum steps down so clients
@@ -217,3 +246,5 @@ class RaftNode:
                 if self.role == "leader":
                     self._last_heard = time.time()
                     self.role = "follower"
+            self._notify_role()
+        return acked

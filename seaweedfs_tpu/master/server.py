@@ -58,6 +58,8 @@ class MasterServer:
             read_state=lambda: {"max_volume_id": self.topo.max_volume_id,
                                 "max_file_key": self.seq.peek()})
         self.metrics.leader_gauge.set(1 if self.raft.is_leader else 0)
+        self.raft.on_role_change = lambda role: \
+            self.metrics.leader_gauge.set(1 if role == "leader" else 0)
         self.router = Router("master", metrics=self.metrics)
         self._register_routes()
         self._server = None
@@ -103,6 +105,21 @@ class MasterServer:
     @property
     def leader_url(self) -> str:
         return self.raft.leader or self.url
+
+    def _commit_volume_ids(self) -> None:
+        """Quorum-replicate MaxVolumeId BEFORE acking an allocation
+        (raft log commit in the reference)."""
+        if not self.raft.commit_state():
+            raise HttpError(500, "cannot replicate volume id allocation "
+                            "to a quorum; retry")
+
+    def _proxy_to_leader(self, req: Request) -> Response:
+        """POSTs cannot ride a 307 through urllib; forward to the leader
+        and relay the answer (master_server.go proxyToLeader)."""
+        r = http_json("POST",
+                      f"http://{self.leader_url}{req.handler.path}",
+                      req.json() if req.body else None)
+        return Response(r)
 
     def _require_leader(self, req: Optional[Request] = None) -> None:
         """Control-plane calls happen on the leader; followers redirect
@@ -156,6 +173,7 @@ class MasterServer:
             except LookupError:
                 grow_volume(self.topo, collection, rp, ttl, self._allocate_rpc,
                             preferred_dc=req.query.get("dataCenter", ""))
+                self._commit_volume_ids()
                 vid, nodes = layout.pick_for_write()
             key = self.seq.next_file_id(count)
             cookie = secrets.randbits(32)
@@ -286,6 +304,8 @@ class MasterServer:
             count = int(req.query.get("count", 1))
             grown = grow_volume(self.topo, collection, rp, ttl,
                                 self._allocate_rpc, count=count)
+            if grown:
+                self._commit_volume_ids()
             return Response({"count": len(grown), "volumeIds": grown})
 
         @r.route("GET", "/vol/vacuum")
@@ -297,7 +317,8 @@ class MasterServer:
 
         @r.route("POST", "/admin/lease")
         def admin_lease(req: Request) -> Response:
-            self._require_leader(req)
+            if not self.is_leader:
+                return self._proxy_to_leader(req)
             body = req.json()
             now = time.time()
             prev = body.get("previous_token") or None
@@ -313,6 +334,8 @@ class MasterServer:
 
         @r.route("POST", "/admin/release")
         def admin_release(req: Request) -> Response:
+            if not self.is_leader:
+                return self._proxy_to_leader(req)
             with self.topo.lock:
                 if req.json().get("previous_token") == self._admin_token:
                     self._admin_token = None

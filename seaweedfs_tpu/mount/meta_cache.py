@@ -15,6 +15,7 @@ import time
 from typing import Callable, Optional
 
 from ..filer.entry import Entry
+from ..utils.bounded_tree import BoundedTree
 from ..utils.httpd import http_json
 
 
@@ -24,7 +25,8 @@ class MetaCache:
         self.poll_interval = poll_interval
         self._lock = threading.Lock()
         self._entries: dict[str, Entry] = {}
-        self._listed_dirs: set[str] = set()
+        # bounded: least-recently-listed dirs are forgotten and re-list
+        self._listed_dirs = BoundedTree(limit=100_000)
         self._since_ns = time.time_ns()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -42,15 +44,13 @@ class MetaCache:
     def delete(self, path: str) -> None:
         with self._lock:
             self._entries.pop(path, None)
-            self._listed_dirs.discard(path)
+        self._listed_dirs.ensure_invalidated(path)
 
     def mark_listed(self, dir_path: str) -> None:
-        with self._lock:
-            self._listed_dirs.add(dir_path)
+        self._listed_dirs.mark_visited(dir_path)
 
     def is_listed(self, dir_path: str) -> bool:
-        with self._lock:
-            return dir_path in self._listed_dirs
+        return self._listed_dirs.has_visited(dir_path)
 
     def list_cached(self, dir_path: str) -> list[Entry]:
         prefix = dir_path.rstrip("/") + "/"
@@ -72,7 +72,7 @@ class MetaCache:
                 # dirs we have fully listed (others fault in on lookup)
                 parent = e.parent
                 if e.full_path in self._entries \
-                        or parent in self._listed_dirs:
+                        or self._listed_dirs.has_visited(parent):
                     self._entries[e.full_path] = e
         for ent in (old, new):
             if ent and self.invalidation_fn:

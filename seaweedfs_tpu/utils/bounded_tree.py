@@ -1,0 +1,41 @@
+"""Bounded tree of visited directories.
+
+Equivalent of weed/util/bounded_tree/: the mount meta cache remembers
+which directories have been fully listed; the node count is bounded and
+least-recently-visited subtrees are forgotten first (they just re-list
+on next access).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+
+
+class BoundedTree:
+    def __init__(self, limit: int = 100_000):
+        self.limit = limit
+        self._lock = threading.Lock()
+        self._visited: OrderedDict[str, None] = OrderedDict()
+
+    def mark_visited(self, path: str) -> None:
+        with self._lock:
+            self._visited.pop(path, None)
+            self._visited[path] = None
+            while len(self._visited) > self.limit:
+                self._visited.popitem(last=False)
+
+    def has_visited(self, path: str) -> bool:
+        with self._lock:
+            if path in self._visited:
+                self._visited.move_to_end(path)
+                return True
+            return False
+
+    def ensure_invalidated(self, path: str) -> None:
+        """Drop a subtree: the path and everything below it."""
+        with self._lock:
+            doomed = [p for p in self._visited
+                      if p == path or p.startswith(path.rstrip("/") + "/")]
+            for p in doomed:
+                del self._visited[p]

@@ -286,7 +286,23 @@ class VolumeServer:
             if n.has(FLAG_HAS_MIME) and n.mime:
                 ctype = n.mime.decode(errors="replace")
             headers["Content-Type"] = ctype
-            return Response(raw=n.data, headers=headers)
+            body = n.data
+            # on-the-fly image resize (volume_server_handlers_read.go
+            # ?width/?height hook -> images/resizing.go; no-op when
+            # Pillow is absent or the content is not an image)
+            if req.query.get("width") or req.query.get("height"):
+                from ..images import resized
+
+                def _dim(name: str):
+                    try:
+                        return int(req.query.get(name) or 0) or None
+                    except ValueError:
+                        return None  # bad value: serve the original
+
+                body, _, _ = resized(body, ctype, _dim("width"),
+                                     _dim("height"),
+                                     req.query.get("mode", ""))
+            return Response(raw=body, headers=headers)
 
         @r.route("POST", FID_PATTERN)
         @r.route("PUT", FID_PATTERN)

@@ -1,0 +1,148 @@
+"""Chunk cache tiers, bounded tree, image resize gating, FTP stub.
+
+Reference behaviors: util/chunk_cache/, util/bounded_tree/,
+images/resizing.go, ftpd/ftp_server.go.
+"""
+
+from __future__ import annotations
+
+import socket
+import time
+
+import pytest
+
+from seaweedfs_tpu.gateway.ftp import FtpServer
+from seaweedfs_tpu.images import resized, resizing_available
+from seaweedfs_tpu.utils.bounded_tree import BoundedTree
+from seaweedfs_tpu.utils.chunk_cache import (DiskChunkCache, MemChunkCache,
+                                             TieredChunkCache)
+from tests.conftest import free_port
+
+
+# --- chunk cache ------------------------------------------------------------
+
+def test_mem_cache_lru_eviction():
+    c = MemChunkCache(limit_bytes=100)
+    c.set("a", b"x" * 40)
+    c.set("b", b"y" * 40)
+    assert c.get("a") == b"x" * 40  # touch a -> b is now LRU
+    c.set("c", b"z" * 40)           # evicts b
+    assert c.get("b") is None
+    assert c.get("a") and c.get("c")
+    c.set("huge", b"q" * 200)       # over limit: not cached
+    assert c.get("huge") is None
+    c.delete("a")
+    assert c.get("a") is None
+
+
+def test_disk_cache_roundtrip_and_eviction(tmp_path):
+    c = DiskChunkCache(str(tmp_path / "cache"), limit_bytes=100)
+    c.set("1,abc", b"d" * 60)
+    assert c.get("1,abc") == b"d" * 60
+    time.sleep(0.02)
+    c.set("2,def", b"e" * 60)  # over limit -> oldest evicted
+    assert c.get("2,def") == b"e" * 60
+    assert c.get("1,abc") is None
+    # restart rebuilds size accounting from disk
+    c2 = DiskChunkCache(str(tmp_path / "cache"), limit_bytes=100)
+    assert c2.get("2,def") == b"e" * 60
+
+
+def test_tiered_cache_promotion(tmp_path):
+    c = TieredChunkCache(mem_limit=1024, disk_dir=str(tmp_path / "d"),
+                         disk_limit=1 << 20, mem_chunk_max=100)
+    small, big = b"s" * 50, b"B" * 500
+    c.set("small", small)
+    c.set("big", big)
+    assert c.mem.get("small") == small
+    assert c.mem.get("big") is None       # too big for mem tier
+    assert c.get("big") == big            # served from disk
+    # drop mem copy; get() must promote from disk back into mem
+    c.mem.delete("small")
+    assert c.get("small") == small
+    assert c.mem.get("small") == small
+    c.delete("big")
+    assert c.get("big") is None
+
+
+def test_filer_uses_chunk_cache(tmp_path):
+    from seaweedfs_tpu.filer.server import FilerServer
+    from seaweedfs_tpu.master.server import MasterServer
+    from seaweedfs_tpu.utils.httpd import http_bytes
+    from seaweedfs_tpu.volume_server.server import VolumeServer
+
+    master = MasterServer(port=free_port(), pulse_seconds=0.3).start()
+    d = tmp_path / "vs"
+    d.mkdir()
+    vol = VolumeServer([str(d)], master.url, port=free_port(),
+                       pulse_seconds=0.3).start()
+    while len(master.topo.all_nodes()) < 1:
+        time.sleep(0.05)
+    filer = FilerServer(master.url, port=free_port(), max_chunk_mb=1).start()
+    try:
+        base = f"http://{filer.url}"
+        http_bytes("PUT", base + "/c.bin", b"cachable" * 1000)
+        http_bytes("GET", base + "/c.bin")
+        misses = filer.chunk_cache.mem.misses
+        hits0 = filer.chunk_cache.mem.hits
+        http_bytes("GET", base + "/c.bin")
+        assert filer.chunk_cache.mem.hits > hits0
+        assert filer.chunk_cache.mem.misses == misses
+        # overwrite invalidates via chunk GC
+        old_fids = [c.file_id
+                    for c in filer.filer.find_entry("/c.bin").chunks]
+        http_bytes("PUT", base + "/c.bin", b"new")
+        filer.filer.flush_gc()
+        assert all(filer.chunk_cache.get(f) is None for f in old_fids)
+        _, body, _ = http_bytes("GET", base + "/c.bin")
+        assert body == b"new"
+    finally:
+        filer.stop()
+        vol.stop()
+        master.stop()
+
+
+# --- bounded tree -----------------------------------------------------------
+
+def test_bounded_tree_visit_and_invalidate():
+    t = BoundedTree(limit=3)
+    for p in ("/a", "/a/b", "/c"):
+        t.mark_visited(p)
+    assert t.has_visited("/a/b")
+    t.mark_visited("/d")  # evicts LRU (/a — /a/b was refreshed by has_visited)
+    assert not t.has_visited("/a")
+    t.ensure_invalidated("/a")
+    assert not t.has_visited("/a/b")
+    assert t.has_visited("/c")
+
+
+# --- images -----------------------------------------------------------------
+
+def test_resized_passthrough_without_pillow():
+    # environment has no Pillow: resized() must be a safe no-op
+    data = b"\xff\xd8\xff\xe0 fake jpeg"
+    out, w, h = resized(data, "image/jpeg", 100, 100)
+    if resizing_available():  # pragma: no cover - env-dependent
+        assert isinstance(out, bytes)
+    else:
+        assert (out, w, h) == (data, 0, 0)
+    # non-image content always passes through
+    out, w, h = resized(b"text", "text/plain", 10, 10)
+    assert (out, w, h) == (b"text", 0, 0)
+
+
+# --- ftp stub ---------------------------------------------------------------
+
+def test_ftp_scaffold_greets_and_quits():
+    srv = FtpServer(port=free_port()).start()
+    try:
+        s = socket.create_connection(("127.0.0.1", srv.port), timeout=3)
+        f = s.makefile("rb")
+        assert f.readline().startswith(b"220")
+        s.sendall(b"LIST\r\n")
+        assert f.readline().startswith(b"202")
+        s.sendall(b"QUIT\r\n")
+        assert f.readline().startswith(b"221")
+        s.close()
+    finally:
+        srv.stop()

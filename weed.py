@@ -59,6 +59,8 @@ def cmd_filer(args) -> None:
     store = SqliteStore(args.db) if args.db else None
     f = FilerServer(args.master, store, host=args.ip, port=args.port,
                     max_chunk_mb=args.maxMB,
+                    chunk_cache_dir=args.cacheDir,
+                    chunk_cache_mem_mb=args.cacheSizeMB,
                     guard=filer_guard(_security())).start()
     print(f"filer listening on {f.url}")
     if args.s3:
@@ -402,6 +404,10 @@ def main(argv=None) -> None:
     fl.add_argument("-port", type=int, default=8888)
     fl.add_argument("-db", default="", help="sqlite store path (default: memory)")
     fl.add_argument("-maxMB", type=int, default=8)
+    fl.add_argument("-cacheDir", default="",
+                    help="directory for the on-disk chunk cache tier")
+    fl.add_argument("-cacheSizeMB", type=int, default=64,
+                    help="in-memory chunk cache size")
     fl.add_argument("-s3", action="store_true")
     fl.add_argument("-s3.port", dest="s3_port", type=int, default=8333)
     fl.add_argument("-webdav", action="store_true")
