@@ -35,7 +35,12 @@ from jax.experimental.pallas import tpu as pltpu
 from ..ec.gf256 import expand_matrix_to_bits
 
 LANE = 128
-DEFAULT_TILE_B = 8192  # best measured on v5e (48GB/s sustained loop)
+# tile sweep on v5e (fori-loop sustained, 640MB resident): 8192=47.1GB/s,
+# 16384=54.4, 32768=55.9, 65536=57.1 (best), 131072=55.8
+DEFAULT_TILE_B = 65536
+# the Pallas interpreter (CPU tests/dryrun) grinds on 64K tiles; use the
+# small tile there — correctness paths only, never a perf surface
+INTERPRET_TILE_B = 1024
 
 
 def expand_matrix_bitplanes(gmat: np.ndarray) -> np.ndarray:
@@ -118,10 +123,11 @@ class TpuEngine:
     xla elsewhere — pallas-on-CPU uses the interpreter, which is only for
     tests)."""
 
-    def __init__(self, mode: str = "auto", tile_b: int = DEFAULT_TILE_B):
-        self.tile_b = tile_b
+    def __init__(self, mode: str = "auto", tile_b: int = 0):
         backend = jax.default_backend()
         self.on_tpu = backend not in ("cpu", "gpu")
+        self.tile_b = tile_b or (DEFAULT_TILE_B if self.on_tpu
+                                 else INTERPRET_TILE_B)
         if mode == "auto":
             mode = "pallas" if self.on_tpu else "xla"
         self.mode = mode
