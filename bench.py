@@ -1,48 +1,77 @@
 #!/usr/bin/env python
 """Headline benchmark: RS(10,4) ec.encode throughput per chip.
 
-Prints ONE JSON line:
+Prints ONE JSON line and ALWAYS exits 0:
   value       = sustained TPU encode throughput with data resident in HBM
                 (MB of volume data encoded per second; the chip-side number a
                 production pipeline with overlapped IO converges to)
   vs_baseline = value / CPU-SIMD engine throughput on this host (the
                 equivalent of the reference's klauspost/reedsolomon AVX2
-                path, which SeaweedFS publishes no EC numbers for —
-                BASELINE.json.published = {})
+                path — SeaweedFS publishes no EC numbers, so the CPU engine
+                measured on the same host is the baseline;
+                ref: weed/storage/erasure_coding/ec_encoder.go:120)
 
-detail carries every sub-measurement, including the honest end-to-end
-number through this environment's host<->chip tunnel (device_get here runs
-at ~13 MB/s, which bounds any tunneled e2e figure; on directly-attached
-TPU hosts the PCIe path is 3 orders of magnitude faster).
+Robustness contract (the round-1 artifact was rc=1 because jax.devices()
+hung/crashed when the remote-TPU tunnel was down):
+  - the PARENT process never imports jax;
+  - backend init is probed in a subprocess with a bounded timeout, retried
+    once;
+  - the measurement itself runs in a subprocess with a bounded timeout and
+    checkpoints partial results to a scratch file after every section, so a
+    mid-bench hang still surfaces the completed sections;
+  - on TPU failure it falls back to CPU-backend jax, and failing that to a
+    pure-numpy measurement — the JSON line is emitted no matter what, with
+    an "error" detail explaining any degradation.
 
 Methodology: the TPU kernel is timed as one jitted fori_loop of N
 data-dependent encodes (each iteration XOR-perturbs the input and the
 parity folds into a scalar), so per-dispatch tunnel latency and lazy
-dispatch cannot distort the figure.
+dispatch cannot distort the figure; differencing two loop lengths cancels
+the fixed launch+readback cost.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import subprocess
+import sys
+import tempfile
 import time
 
-import numpy as np
+PROBE_TIMEOUT_S = 240       # first TPU compile can take ~40s; tunnel flaps longer
+BENCH_TIMEOUT_S = 1500
+CPU_BENCH_TIMEOUT_S = 900
 
 
-def time_cpu(engine, data, reps=3):
-    from seaweedfs_tpu.ec.codec import ReedSolomon
+# --------------------------------------------------------------------------
+# child: the actual measurements (runs with jax importable, any backend)
+# --------------------------------------------------------------------------
 
-    rs = ReedSolomon(10, 4, engine=engine)
-    rs.encode(data[:, :1024])  # warm tables
-    best = float("inf")
-    for _ in range(reps):
-        t0 = time.perf_counter()
-        rs.encode(data)
-        best = min(best, time.perf_counter() - t0)
-    return data.nbytes / best / 1e6
+def _child(scratch_path: str, platform: str = "") -> None:
+    import numpy as np
 
+    if platform == "cpu":
+        # the axon integration force-sets jax_platforms="axon,cpu" from
+        # sitecustomize, overriding the JAX_PLATFORMS env var — the config
+        # write is the only way to actually pin the CPU backend
+        import jax as _jax
 
-def main():
+        _jax.config.update("jax_platforms", "cpu")
+
+    detail: dict = {}
+
+    def checkpoint():
+        with open(scratch_path, "w") as f:
+            json.dump(detail, f)
+
+    def section(name, fn):
+        try:
+            fn()
+        except Exception as e:  # record and continue: partial > nothing
+            detail[f"error_{name}"] = f"{type(e).__name__}: {e}"[:500]
+        checkpoint()
+
     import jax
     import jax.numpy as jnp
 
@@ -56,26 +85,35 @@ def main():
     )
 
     rng = np.random.default_rng(0xBE)
-    detail: dict = {"device": str(jax.devices()[0]), "backend": jax.default_backend()}
+    detail["device"] = str(jax.devices()[0])
+    detail["backend"] = jax.default_backend()
+    on_tpu = detail["backend"] not in ("cpu", "gpu")
+    checkpoint()
 
     # --- CPU baselines ----------------------------------------------------
-    cpu_data = rng.integers(0, 256, (10, 1 << 24), dtype=np.uint8)  # 160MB
-    simd = best_cpu_engine()
-    detail["cpu_engine"] = simd.name
-    cpu_simd_mbps = time_cpu(simd, cpu_data)
-    detail["cpu_simd_mbps"] = round(cpu_simd_mbps, 1)
-    detail["cpu_numpy_mbps"] = round(time_cpu(CpuEngine(), cpu_data, reps=1), 1)
+    def time_cpu(engine, data, reps=3):
+        rs = ReedSolomon(10, 4, engine=engine)
+        rs.encode(data[:, :1024])  # warm tables
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            rs.encode(data)
+            best = min(best, time.perf_counter() - t0)
+        return data.nbytes / best / 1e6
 
-    # --- TPU in-HBM sustained --------------------------------------------
-    # The Pallas kernel never materializes the 8x bit expansion in HBM, so
-    # the sustained loop runs on a full 640MB-resident encode; the XLA-fused
-    # variant (which does materialize bits) is measured at a smaller size.
+    cpu_data = rng.integers(0, 256, (10, 1 << 24), dtype=np.uint8)  # 160MB
+
+    def meas_cpu():
+        simd = best_cpu_engine()
+        detail["cpu_engine"] = simd.name
+        detail["cpu_simd_mbps"] = round(time_cpu(simd, cpu_data), 1)
+        detail["cpu_numpy_mbps"] = round(time_cpu(CpuEngine(), cpu_data, reps=1), 1)
+
+    section("cpu_baseline", meas_cpu)
+
+    # --- in-HBM sustained kernel loop ------------------------------------
     a_planes = jnp.asarray(expand_matrix_bitplanes(parity_rows(10, 4)))
 
-    # block_until_ready is not reliably synchronous through the remote-chip
-    # tunnel, so completion is forced by device_get of a scalar that depends
-    # on every parity byte, and the fixed tunnel latency cancels by
-    # differencing two iteration counts (slope = time per iteration).
     def make_loop(encode, n):
         @jax.jit
         def bench_loop(a, d):
@@ -102,42 +140,220 @@ def main():
         per_iter = (times[n_hi] - times[n_lo]) / (n_hi - n_lo)
         return data.nbytes / per_iter / 1e6
 
-    tpu_hbm_mbps = run_loop(gf_matmul_pallas, 1 << 26)  # 640MB resident
-    detail["tpu_inhbm_pallas_mbps"] = round(tpu_hbm_mbps, 1)
-    detail["tpu_inhbm_xla_mbps"] = round(run_loop(gf_matmul_xla, 1 << 23), 1)
+    # smaller resident set + fewer iters on CPU backend: the interpreter /
+    # XLA:CPU path is a correctness fallback, not a perf surface
+    hbm_b = (1 << 26) if on_tpu else (1 << 22)
+    xla_b = (1 << 23) if on_tpu else (1 << 22)
+    loop_counts = dict(n_lo=10, n_hi=40) if on_tpu else dict(n_lo=2, n_hi=6)
 
-    # single-shard rebuild latency, 1GB volume: shards are 100MB, decode of
-    # the missing one is a [8,80]x[80,100M] matmul over the 10 survivors
-    shard_b = 100 * (1 << 20)
-    dec_planes = jnp.asarray(expand_matrix_bitplanes(parity_rows(10, 1)))
-    dec_mbps = run_loop(gf_matmul_pallas, shard_b, n_lo=4, n_hi=12,
-                        planes=dec_planes)
-    detail["rebuild_1gb_inhbm_ms"] = round(10 * shard_b / (dec_mbps * 1e6) * 1e3, 2)
+    def meas_hbm():
+        # key names state what ran: tpu_* only when the TPU backend ran it
+        if on_tpu:
+            detail["tpu_inhbm_pallas_mbps"] = round(
+                run_loop(gf_matmul_pallas, hbm_b, **loop_counts), 1)
+            detail["tpu_inhbm_xla_mbps"] = round(
+                run_loop(gf_matmul_xla, xla_b, **loop_counts), 1)
+        else:
+            detail["cpu_backend_xla_mbps"] = round(
+                run_loop(gf_matmul_xla, xla_b, **loop_counts), 1)
 
-    # --- parity check + tunneled e2e -------------------------------------
-    sample = rng.integers(0, 256, (10, 1 << 22), dtype=np.uint8)  # 40MB
-    want = ReedSolomon(10, 4, engine=simd).encode(sample)
-    rs_xla = ReedSolomon(10, 4, engine=TpuEngine(mode="xla"))
-    rs_xla.encode(sample)  # untimed warm-up: jit compile happens here
+    section("inhbm", meas_hbm)
+
+    # --- single-shard rebuild latency, 1GB volume -------------------------
+    # shards are 100MB; decoding the missing one is a [8,80]x[80,100M]
+    # bit-plane matmul over the 10 survivors
+    def meas_rebuild():
+        if not on_tpu:
+            return
+        shard_b = 100 * (1 << 20)
+        dec_planes = jnp.asarray(expand_matrix_bitplanes(parity_rows(10, 1)))
+        dec_mbps = run_loop(gf_matmul_pallas, shard_b, n_lo=4, n_hi=12,
+                            planes=dec_planes)
+        detail["rebuild_1gb_inhbm_ms"] = round(
+            10 * shard_b / (dec_mbps * 1e6) * 1e3, 2)
+
+    section("rebuild", meas_rebuild)
+
+    # --- e2e streaming file encode (overlapped pipeline) ------------------
+    def meas_e2e():
+        from seaweedfs_tpu.ec.streaming import StreamingEncoder
+
+        size_mb = 512 if on_tpu else 32
+        raw = rng.integers(0, 256, size_mb << 20, dtype=np.uint8).tobytes()
+        with tempfile.TemporaryDirectory() as td:
+            dat = os.path.join(td, "1.dat")
+            with open(dat, "wb") as f:
+                f.write(raw)
+            enc = StreamingEncoder(10, 4)
+            enc.encode_file(dat, os.path.join(td, "warm"))  # warm compile
+            t0 = time.perf_counter()
+            enc.encode_file(dat, os.path.join(td, "1"))
+            dt = time.perf_counter() - t0
+        detail["e2e_file_encode_mbps"] = round(len(raw) / dt / 1e6, 1)
+        detail["e2e_file_size_mb"] = size_mb
+
+    section("e2e_stream", meas_e2e)
+
+    # --- parity check ------------------------------------------------------
+    def meas_parity():
+        sample = rng.integers(0, 256, (10, 1 << 20), dtype=np.uint8)
+        want = ReedSolomon(10, 4, engine=best_cpu_engine()).encode(sample)
+        got_xla = ReedSolomon(10, 4, engine=TpuEngine(mode="xla")).encode(sample)
+        got_pal = ReedSolomon(10, 4, engine=TpuEngine(mode="pallas")).encode(sample)
+        detail["parity_match_cpu_xla_pallas"] = bool(
+            np.array_equal(want, got_xla) and np.array_equal(want, got_pal))
+
+    section("parity", meas_parity)
+
+    checkpoint()
+    print("BENCH_CHILD_RESULT " + json.dumps(detail), flush=True)
+
+
+# --------------------------------------------------------------------------
+# parent: orchestration; NEVER imports jax
+# --------------------------------------------------------------------------
+
+def _run_sub(argv, timeout, env=None):
+    try:
+        p = subprocess.run(argv, capture_output=True, text=True,
+                           timeout=timeout, env=env)
+        return p.returncode, p.stdout, p.stderr
+    except subprocess.TimeoutExpired as e:
+        # TimeoutExpired carries captured output as bytes even under text=True
+        out = e.stdout or ""
+        if isinstance(out, bytes):
+            out = out.decode(errors="replace")
+        return -9, out, f"timeout after {timeout}s"
+    except Exception as e:  # pragma: no cover - os-level failure
+        return -1, "", str(e)
+
+
+def _probe_backend(timeout=PROBE_TIMEOUT_S):
+    """Bounded subprocess probe of jax backend init; returns backend name or
+    None. Retries once (tunnel flaps are transient)."""
+    code = ("import jax; d = jax.devices(); "
+            "print('PROBE_OK', jax.default_backend(), len(d))")
+    for attempt in range(2):
+        rc, out, err = _run_sub([sys.executable, "-c", code], timeout)
+        for line in out.splitlines():
+            if line.startswith("PROBE_OK"):
+                _, backend, n = line.split()
+                return backend, int(n), attempt
+    return None, 0, 2
+
+
+def _run_child(timeout, platform=""):
+    """Run the measurement child; returns (detail dict or None, error)."""
+    with tempfile.NamedTemporaryFile(mode="r", suffix=".json",
+                                     delete=False) as scratch:
+        scratch_path = scratch.name
+    try:
+        argv = [sys.executable, os.path.abspath(__file__), "--child",
+                scratch_path]
+        if platform:
+            argv.append(platform)
+        rc, out, err = _run_sub(argv, timeout)
+        for line in out.splitlines():
+            if line.startswith("BENCH_CHILD_RESULT "):
+                return json.loads(line[len("BENCH_CHILD_RESULT "):]), None
+        # died or hung mid-run: salvage the checkpointed partial sections
+        partial = None
+        try:
+            with open(scratch_path) as f:
+                txt = f.read()
+            if txt.strip():
+                partial = json.loads(txt)
+        except Exception:
+            pass
+        tail = (err or out or "").strip().splitlines()
+        return partial, f"child rc={rc}: {tail[-1][:300] if tail else 'no output'}"
+    finally:
+        try:
+            os.unlink(scratch_path)
+        except OSError:
+            pass
+
+
+def _numpy_last_resort():
+    """Pure-numpy measurement if even CPU-backend jax is broken."""
+    import numpy as np
+
+    from seaweedfs_tpu.ec.codec import ReedSolomon, best_cpu_engine
+
+    rng = np.random.default_rng(0xBE)
+    data = rng.integers(0, 256, (10, 1 << 23), dtype=np.uint8)
+    simd = best_cpu_engine()
+    rs = ReedSolomon(10, 4, engine=simd)
+    rs.encode(data[:, :1024])
     t0 = time.perf_counter()
-    got_xla = rs_xla.encode(sample)
-    e2e_dt = time.perf_counter() - t0
-    got_pallas = ReedSolomon(10, 4, engine=TpuEngine(mode="pallas")).encode(sample)
-    parity_match = bool(np.array_equal(want, got_xla) and np.array_equal(want, got_pallas))
-    detail["parity_match_cpu_xla_pallas"] = parity_match
-    detail["tpu_e2e_tunneled_mbps"] = round(sample.nbytes / e2e_dt / 1e6, 1)
-    detail["note"] = ("value is in-HBM sustained; e2e here is bounded by the "
-                      "dev-tunnel's ~13MB/s device_get readback")
+    rs.encode(data)
+    dt = time.perf_counter() - t0
+    return {"cpu_engine": simd.name,
+            "cpu_simd_mbps": round(data.nbytes / dt / 1e6, 1)}
 
-    value = round(tpu_hbm_mbps, 1)
+
+def main() -> None:
+    detail: dict = {}
+    errors: list[str] = []
+
+    backend, ndev, attempts = _probe_backend()
+    detail["probe"] = {"backend": backend, "devices": ndev,
+                       "attempts": attempts + 1}
+
+    result_detail = None
+    if backend is not None:
+        result_detail, err = _run_child(BENCH_TIMEOUT_S)
+        if err:
+            errors.append(f"bench({backend}): {err}")
+
+    if result_detail is None or "cpu_simd_mbps" not in result_detail:
+        # TPU probe failed or the bench died before the baseline: CPU fallback
+        cpu_detail, err = _run_child(CPU_BENCH_TIMEOUT_S, platform="cpu")
+        if err:
+            errors.append(f"bench(cpu-fallback): {err}")
+        if cpu_detail is not None:
+            # TPU-child keys win the merge: a TPU run whose CPU-baseline
+            # section failed must still be reported as a TPU result
+            merged = dict(cpu_detail)
+            if result_detail:
+                merged["fallback_backend"] = cpu_detail.get("backend")
+                merged.update(result_detail)
+            result_detail = merged
+
+    if result_detail is None:
+        try:
+            result_detail = _numpy_last_resort()
+            errors.append("jax unusable on every backend; pure-numpy baseline only")
+        except Exception as e:  # pragma: no cover
+            result_detail = {}
+            errors.append(f"numpy fallback failed: {type(e).__name__}: {e}")
+
+    detail.update(result_detail)
+    if errors:
+        detail["error"] = "; ".join(errors)[:1000]
+
+    cpu = detail.get("cpu_simd_mbps") or detail.get("cpu_numpy_mbps") or 0.0
+    tpu = detail.get("tpu_inhbm_pallas_mbps") or detail.get("tpu_inhbm_xla_mbps")
+    on_tpu = detail.get("backend") not in (None, "cpu", "gpu")
+    if on_tpu and tpu:
+        value, unit = float(tpu), "MB/s"
+        metric = "ec.encode MB/s/chip (RS(10,4), in-HBM sustained)"
+    else:
+        value, unit = float(cpu), "MB/s"
+        metric = "ec.encode MB/s (RS(10,4), CPU fallback — TPU unavailable)"
+    vs_baseline = round(value / cpu, 2) if cpu else 0.0
+
     print(json.dumps({
-        "metric": "ec.encode MB/s/chip (RS(10,4), in-HBM sustained)",
-        "value": value,
-        "unit": "MB/s",
-        "vs_baseline": round(value / cpu_simd_mbps, 2),
+        "metric": metric,
+        "value": round(value, 1),
+        "unit": unit,
+        "vs_baseline": vs_baseline,
         "detail": detail,
     }))
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) >= 3 and sys.argv[1] == "--child":
+        _child(sys.argv[2], sys.argv[3] if len(sys.argv) > 3 else "")
+    else:
+        main()

@@ -172,8 +172,8 @@ class MasterServer:
                 vid, nodes = layout.pick_for_write()
             except LookupError:
                 grow_volume(self.topo, collection, rp, ttl, self._allocate_rpc,
-                            preferred_dc=req.query.get("dataCenter", ""))
-                self._commit_volume_ids()
+                            preferred_dc=req.query.get("dataCenter", ""),
+                            commit_ids=self._commit_volume_ids)
                 vid, nodes = layout.pick_for_write()
             key = self.seq.next_file_id(count)
             cookie = secrets.randbits(32)
@@ -302,11 +302,25 @@ class MasterServer:
             rp = ReplicaPlacement.parse(replication)
             ttl = TTL.parse(req.query.get("ttl", ""))
             count = int(req.query.get("count", 1))
-            grown = grow_volume(self.topo, collection, rp, ttl,
-                                self._allocate_rpc, count=count)
-            if grown:
-                self._commit_volume_ids()
-            return Response({"count": len(grown), "volumeIds": grown})
+            # grow one at a time so a mid-batch quorum failure still
+            # reports the volumes that DID grow (they are live on the
+            # volume servers; losing the ids would over-provision on retry)
+            grown: list[int] = []
+            grow_err = None
+            for _ in range(count):
+                try:
+                    grown += grow_volume(self.topo, collection, rp, ttl,
+                                         self._allocate_rpc,
+                                         commit_ids=self._commit_volume_ids)
+                except HttpError as e:
+                    if not grown:
+                        raise
+                    grow_err = e.message or str(e)
+                    break
+            result = {"count": len(grown), "volumeIds": grown}
+            if grow_err:
+                result["error"] = grow_err
+            return Response(result)
 
         @r.route("GET", "/vol/vacuum")
         def vol_vacuum(req: Request) -> Response:

@@ -70,13 +70,22 @@ def _pick_replicas(main: DataNode, candidates: list[DataNode],
 
 def grow_volume(topo: Topology, collection: str, rp: ReplicaPlacement,
                 ttl: TTL, allocate: Callable[[DataNode, int, str, str, str], None],
-                preferred_dc: str = "", count: int = 1) -> list[int]:
+                preferred_dc: str = "", count: int = 1,
+                commit_ids: Callable[[], None] | None = None) -> list[int]:
     """VolumeGrowth.grow (volume_growth.go:221): allocate `count` new volumes
-    on chosen servers via the supplied RPC callable, then register them."""
+    on chosen servers via the supplied RPC callable, then register them.
+
+    `commit_ids` quorum-replicates the reserved max_volume_id BEFORE any
+    allocate RPC runs (the reference commits MaxVolumeId through the raft
+    log first, topology.go NextVolumeId); if the commit cannot reach
+    quorum the grow fails with no volume created, so a new leader can
+    never re-issue the same vid to other servers."""
     grown = []
     for _ in range(count):
         nodes = find_empty_slots(topo, rp, preferred_dc)
         vid = topo.next_volume_id()
+        if commit_ids is not None:
+            commit_ids()
         for node in nodes:
             allocate(node, vid, collection, str(rp), str(ttl))
         # optimistic local registration; heartbeats confirm

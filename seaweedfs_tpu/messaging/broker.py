@@ -359,10 +359,8 @@ class MessagingClient:
             status, body, hdrs = http_bytes("GET", url,
                                             follow_redirects=False)
             if status == 307:
-                loc = hdrs.get("Location", "")
-                url = (f"{loc}?namespace={namespace}&topic={topic}"
-                       f"&partition={partition}&offset={offset}"
-                       f"&timeout={timeout}")
+                # the Location already carries the full query string
+                url = hdrs.get("Location", url)
                 continue
             if status != 200:
                 raise HttpError(status, body.decode(errors="replace"))

@@ -284,6 +284,20 @@ class WFS:
         self.meta.delete(self._abs(old))
         self.meta.delete(self._abs(new))
         self.inodes.move_path(self._abs(old), self._abs(new))
+        # retarget open handles (like the reference's inode-based handles):
+        # flush/release after rename-while-open must hit the new path, or the
+        # dirty pages are silently dropped against a 404
+        old_prefix = old.rstrip("/") + "/"
+        with self._hlock:
+            for h in self._handles.values():
+                if h.path == old:
+                    h.path = new
+                elif h.path.startswith(old_prefix):
+                    h.path = new.rstrip("/") + "/" + h.path[len(old_prefix):]
+                else:
+                    continue
+                if h.entry is not None:
+                    h.entry.full_path = self._abs(h.path)
 
     def truncate(self, path: str, size: int) -> None:
         """weedfs_attr.go setattr size change: trim/drop chunks."""

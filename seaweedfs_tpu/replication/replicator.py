@@ -33,9 +33,13 @@ class Replicator:
     def _in_scope(self, path: str) -> bool:
         if self.path_prefix == "/":
             # system internals never replicate (reference skips
-            # /topics and /etc system dirs in filer.sync/replicate)
+            # /topics and /etc system dirs in filer.sync/replicate);
+            # the whole /etc prefix is excluded so cloud credentials in
+            # /etc/remote.conf and mount state in /etc/remote.mount never
+            # leak into sync targets or third-party sinks
             return not (path.startswith("/topics/")
-                        or path.startswith("/etc/seaweedfs"))
+                        or path == "/etc"
+                        or path.startswith("/etc/"))
         return path == self.path_prefix \
             or path.startswith(self.path_prefix + "/")
 

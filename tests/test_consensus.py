@@ -138,3 +138,29 @@ def test_raft_state_persists_across_restart(tmp_path):
         assert m2.topo.max_volume_id >= 41
     finally:
         m2.stop()
+
+
+def test_grow_fails_closed_when_quorum_commit_fails(tmp_path):
+    """The reserved max_volume_id must quorum-commit BEFORE any allocate
+    RPC: if the commit cannot reach quorum, the grow fails with zero
+    volumes created, so a new leader can never re-issue the same vid."""
+    m = MasterServer(port=free_port(), pulse_seconds=0.3).start()
+    d = tmp_path / "vs"
+    d.mkdir()
+    vs = VolumeServer([str(d)], m.url, port=free_port(),
+                      pulse_seconds=0.3).start()
+    try:
+        deadline = time.time() + 8
+        while time.time() < deadline and len(m.topo.all_nodes()) < 1:
+            time.sleep(0.1)
+        calls = []
+        m._allocate_rpc = lambda *a, **k: calls.append(a)
+        m.raft.commit_state = lambda: False  # quorum unreachable
+        status, body, _ = http_bytes(
+            "GET", f"http://{m.url}/vol/grow?count=1",
+            follow_redirects=False)
+        assert status == 500
+        assert calls == [], "allocate RPC issued before the failed commit"
+    finally:
+        vs.stop()
+        m.stop()

@@ -156,3 +156,32 @@ def test_broker_ownership_redirect():
     finally:
         a.stop()
         b.stop()
+
+
+def test_subscribe_through_ownership_redirect():
+    """Cross-broker subscribe follows the 307 Location verbatim (the
+    Location already carries the full query string; appending a second
+    '?query' broke timeout parsing on the owner broker)."""
+    portA, portB = free_port(), free_port()
+    a = BrokerServer(port=portA, partition_count=8,
+                     peers=[f"127.0.0.1:{portB}"]).start()
+    b = BrokerServer(port=portB, partition_count=8,
+                     peers=[f"127.0.0.1:{portA}"]).start()
+    try:
+        c = MessagingClient(a.url)
+        hit = None
+        for i in range(64):
+            part, off = c.publish("redir", f"v{i}".encode(), key=f"k{i}")
+            if a.ring.locate(f"default/redir/{part}") == b.url:
+                hit = (part, off, f"v{i}".encode())
+                break
+        assert hit is not None, "no key hashed to a B-owned partition"
+        part, off, val = hit
+        # subscribe via the NON-owner broker; must follow the redirect
+        msgs, next_off = c.subscribe("redir", partition=part, offset=off,
+                                     timeout=5.0)
+        assert msgs and msgs[0]["value_bytes"] == val
+        assert next_off == off + 1
+    finally:
+        a.stop()
+        b.stop()

@@ -238,3 +238,31 @@ def test_wfs_subtree_mount_root(wfs):
         sub.release(h.fh)
     finally:
         sub.close()
+
+
+def test_wfs_rename_while_open_keeps_dirty_pages(wfs):
+    """Open handles must retarget on rename: flush/release after a
+    rename-while-open writes to the new path instead of 404ing on the old
+    one and silently dropping the dirty pages."""
+    fs, _ = wfs
+    h = fs.create("/a.txt")
+    fs.write(h.fh, 0, b"payload")
+    fs.rename("/a.txt", "/b.txt")
+    fs.release(h.fh)  # flush lands on /b.txt
+    h2 = fs.open("/b.txt")
+    assert fs.read(h2.fh, 0, 7) == b"payload"
+    fs.release(h2.fh)
+    with pytest.raises(FuseError):
+        fs.getattr("/a.txt")
+
+
+def test_wfs_dir_rename_retargets_open_child_handles(wfs):
+    fs, _ = wfs
+    fs.mkdir("/dir1")
+    h = fs.create("/dir1/f.txt")
+    fs.write(h.fh, 0, b"inner")
+    fs.rename("/dir1", "/dir2")
+    fs.release(h.fh)
+    h2 = fs.open("/dir2/f.txt")
+    assert fs.read(h2.fh, 0, 5) == b"inner"
+    fs.release(h2.fh)

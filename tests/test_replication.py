@@ -217,3 +217,17 @@ def test_meta_backup_snapshot_and_incremental(cluster, tmp_path):
     # store survives reload
     mb2 = MetaBackup(fa.url, str(tmp_path / "meta.json"))
     assert "/mb/b.txt" in mb2.entries
+
+
+def test_replicator_excludes_etc_credentials(tmp_path):
+    """Default-scope replication must never copy /etc/* — in particular
+    /etc/remote.conf (cloud access/secret keys) and /etc/remote.mount."""
+    sink = LocalSink(str(tmp_path / "root"))
+    repl = Replicator(sink, fetch=lambda p: b"secret")
+    for p in ("/etc/remote.conf", "/etc/remote.mount",
+              "/etc/seaweedfs/filer.conf", "/etc"):
+        ev = {"op": "create", "signatures": [],
+              "new_entry": {"full_path": p, "attr": {"mode": 0o660}},
+              "old_entry": None}
+        assert repl.replicate(ev) is False, p
+    assert not (tmp_path / "root/etc").exists()
