@@ -299,6 +299,9 @@ def main() -> None:
     backend, ndev, attempts = _probe_backend()
     detail["probe"] = {"backend": backend, "devices": ndev,
                        "attempts": attempts + 1}
+    if backend is None:
+        errors.append("TPU backend probe timed out/failed on both attempts; "
+                      "falling back to CPU")
 
     result_detail = None
     if backend is not None:

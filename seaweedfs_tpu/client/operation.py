@@ -117,8 +117,13 @@ class WeedClient:
 
     def upload(self, data: bytes, name: str = "", mime: str = "",
                collection: str = "", replication: str = "",
-               ttl: str = "") -> str:
-        """Assign + PUT; returns the fid."""
+               ttl: str = "", compress: Optional[bool] = None) -> str:
+        """Assign + PUT; returns the fid.
+
+        compress=None sniffs the name/mime the way the reference client
+        does (upload_content.go:116, IsCompressableFileType); a gzip win
+        is conveyed via Content-Encoding so the volume server sets
+        FLAG_IS_COMPRESSED on the needle."""
         import urllib.parse
 
         a = self.master.assign(collection=collection, replication=replication,
@@ -130,6 +135,20 @@ class WeedClient:
             params["ttl"] = ttl
         q = "?" + urllib.parse.urlencode(params) if params else ""
         headers = {"Content-Type": mime} if mime else {}
+        if compress is None and (name or mime):
+            import os as _os
+
+            from ..utils.compression import is_compressable_file_type
+
+            ext = _os.path.splitext(name)[1] if name else ""
+            compress, _ = is_compressable_file_type(ext, mime)
+        if compress:
+            from ..utils.compression import maybe_gzip_data
+
+            gz = maybe_gzip_data(data)
+            if gz is not data:
+                data = gz
+                headers["Content-Encoding"] = "gzip"
         if a.auth:
             headers["Authorization"] = f"BEARER {a.auth}"
         status, body, _ = http_bytes(
@@ -140,17 +159,40 @@ class WeedClient:
         return a.fid
 
     def download(self, fid: str) -> bytes:
+        """Full-blob GET; transparently decompresses a gzip-encoded reply
+        (upload_content.go stores compressible uploads gzipped)."""
+        body, headers = self._get(fid, None)
+        if headers.get("Content-Encoding") == "gzip":
+            from ..utils.compression import maybe_decompress_data
+
+            return maybe_decompress_data(body)
+        return body
+
+    def download_range(self, fid: str, offset: int, size: int) -> bytes:
+        """Ranged GET: only [offset, offset+size) travels the wire
+        (volume_server_handlers_read.go Range support)."""
+        if size <= 0:
+            return b""
+        body, _ = self._get(
+            fid, {"Range": f"bytes={offset}-{offset + size - 1}"},
+            ok=(200, 206))
+        return body
+
+    def _get(self, fid: str, extra_headers: Optional[dict],
+             ok: tuple = (200,)) -> tuple[bytes, dict]:
         vid = int(fid.split(",")[0])
         urls, auth = self._locate(vid)
         if not urls:
             raise HttpError(404, f"volume {vid} has no locations")
-        headers = {"Authorization": f"BEARER {auth}"} if auth else None
+        headers = dict(extra_headers or {})
+        if auth:
+            headers["Authorization"] = f"BEARER {auth}"
         last_err = None
         for url in random.sample(urls, len(urls)):
-            status, body, _ = http_bytes("GET", f"http://{url}/{fid}",
-                                         headers=headers)
-            if status == 200:
-                return body
+            status, body, rhdrs = http_bytes("GET", f"http://{url}/{fid}",
+                                             headers=headers or None)
+            if status in ok:
+                return body, rhdrs
             if status == 302:
                 continue
             if status == 0:  # dead server: fail over to the next replica

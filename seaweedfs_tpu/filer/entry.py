@@ -14,26 +14,39 @@ from dataclasses import dataclass, field
 
 @dataclass
 class FileChunk:
-    """One stored chunk of a file (filer.proto FileChunk)."""
+    """One stored chunk of a file (filer.proto FileChunk).
+
+    cipher_key (hex str): per-chunk AES-256-GCM key; the stored blob is
+    ciphertext only the filer metadata can open (upload_content.go:150).
+    is_compressed: blob is gzipped (before encryption, if both)."""
     file_id: str
     offset: int
     size: int
     modified_ts_ns: int = 0
     etag: str = ""
     is_chunk_manifest: bool = False
+    cipher_key: str = ""
+    is_compressed: bool = False
 
     def to_dict(self) -> dict:
-        return {
+        d = {
             "file_id": self.file_id, "offset": self.offset, "size": self.size,
             "modified_ts_ns": self.modified_ts_ns, "etag": self.etag,
             "is_chunk_manifest": self.is_chunk_manifest,
         }
+        if self.cipher_key:
+            d["cipher_key"] = self.cipher_key
+        if self.is_compressed:
+            d["is_compressed"] = True
+        return d
 
     @classmethod
     def from_dict(cls, d: dict) -> "FileChunk":
         return cls(d["file_id"], int(d["offset"]), int(d["size"]),
                    int(d.get("modified_ts_ns", 0)), d.get("etag", ""),
-                   bool(d.get("is_chunk_manifest", False)))
+                   bool(d.get("is_chunk_manifest", False)),
+                   d.get("cipher_key", ""),
+                   bool(d.get("is_compressed", False)))
 
 
 @dataclass

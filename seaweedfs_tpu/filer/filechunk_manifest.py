@@ -1,0 +1,116 @@
+"""Chunk manifests: batch huge chunk lists into recursive manifest chunks.
+
+Equivalent of weed/filer/filechunk_manifest.go: every ManifestBatch data
+chunks are serialized into one manifest blob stored as a regular chunk
+whose FileChunk carries is_chunk_manifest=True and spans
+[min(offset), max(offset+size)) of its children.  Reads resolve manifests
+recursively (10k files of maxMB each per manifest level); entry metadata
+stays O(chunks/10000) no matter how large the file grows.
+
+Manifest blob format: JSON {"chunks": [FileChunk dicts]} — the pb-free
+wire convention of this rebuild (filer.proto FileChunkManifest in the
+reference).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import time
+from typing import Callable, Iterable
+
+from .entry import FileChunk
+
+MANIFEST_BATCH = 10000
+
+# fetch_fn(chunk) -> plaintext blob bytes (decrypted/decompressed)
+FetchFn = Callable[[FileChunk], bytes]
+# save_fn(data) -> FileChunk for the stored manifest blob
+SaveFn = Callable[[bytes], FileChunk]
+
+
+def has_chunk_manifest(chunks: Iterable[FileChunk]) -> bool:
+    return any(c.is_chunk_manifest for c in chunks)
+
+
+def separate_manifest_chunks(chunks: list[FileChunk]) \
+        -> tuple[list[FileChunk], list[FileChunk]]:
+    manifest = [c for c in chunks if c.is_chunk_manifest]
+    data = [c for c in chunks if not c.is_chunk_manifest]
+    return manifest, data
+
+
+def resolve_chunk_manifest(fetch_fn: FetchFn, chunks: list[FileChunk],
+                           start_offset: int = 0,
+                           stop_offset: int = 2**63 - 1) \
+        -> tuple[list[FileChunk], list[FileChunk]]:
+    """ResolveChunkManifest (filechunk_manifest.go:44-73): expand manifest
+    chunks overlapping [start_offset, stop_offset) recursively.  Returns
+    (data_chunks, manifest_chunks)."""
+    data_chunks: list[FileChunk] = []
+    manifest_chunks: list[FileChunk] = []
+    for chunk in chunks:
+        if max(chunk.offset, start_offset) >= \
+                min(chunk.offset + chunk.size, stop_offset):
+            continue
+        if not chunk.is_chunk_manifest:
+            data_chunks.append(chunk)
+            continue
+        resolved = resolve_one_chunk_manifest(fetch_fn, chunk)
+        manifest_chunks.append(chunk)
+        sub_data, sub_manifest = resolve_chunk_manifest(
+            fetch_fn, resolved, start_offset, stop_offset)
+        data_chunks.extend(sub_data)
+        manifest_chunks.extend(sub_manifest)
+    return data_chunks, manifest_chunks
+
+
+def resolve_one_chunk_manifest(fetch_fn: FetchFn,
+                               chunk: FileChunk) -> list[FileChunk]:
+    if not chunk.is_chunk_manifest:
+        return []
+    blob = fetch_fn(chunk)
+    try:
+        doc = json.loads(blob)
+    except ValueError as e:
+        raise ValueError(
+            f"unreadable chunk manifest {chunk.file_id}: {e}") from e
+    return [FileChunk.from_dict(d) for d in doc["chunks"]]
+
+
+def maybe_manifestize(save_fn: SaveFn, chunks: list[FileChunk],
+                      merge_factor: int = MANIFEST_BATCH) -> list[FileChunk]:
+    """MaybeManifestize (filechunk_manifest.go:192-221): every full batch
+    of merge_factor NON-manifest chunks collapses into one manifest chunk;
+    the ragged tail stays inline.  Existing manifest chunks pass through,
+    so repeated application yields recursive manifest levels."""
+    out: list[FileChunk] = []
+    data_chunks: list[FileChunk] = []
+    for c in chunks:
+        (data_chunks if not c.is_chunk_manifest else out).append(c)
+
+    full_end = (len(data_chunks) // merge_factor) * merge_factor
+    for i in range(0, full_end, merge_factor):
+        out.append(_merge_into_manifest(save_fn,
+                                        data_chunks[i:i + merge_factor]))
+    out.extend(data_chunks[full_end:])
+    return out
+
+
+def _merge_into_manifest(save_fn: SaveFn,
+                         data_chunks: list[FileChunk]) -> FileChunk:
+    """mergeIntoManifest (filechunk_manifest.go:223-260)."""
+    blob = json.dumps(
+        {"chunks": [c.to_dict() for c in data_chunks]},
+        separators=(",", ":")).encode()
+    min_offset = min(c.offset for c in data_chunks)
+    max_offset = max(c.offset + c.size for c in data_chunks)
+    manifest = save_fn(blob)
+    manifest.is_chunk_manifest = True
+    manifest.offset = min_offset
+    manifest.size = max_offset - min_offset
+    if not manifest.modified_ts_ns:
+        manifest.modified_ts_ns = time.time_ns()
+    if not manifest.etag:
+        manifest.etag = hashlib.md5(blob).hexdigest()
+    return manifest

@@ -41,6 +41,9 @@ class Filer:
         self.store = store or MemoryStore()
         self._lock = threading.RLock()
         self._delete_chunks_fn = delete_chunks_fn
+        # set by FilerServer: expands manifest chunks so GC reclaims the
+        # children too (filer_delete_entry.go resolves manifests first)
+        self.resolve_chunks_for_gc: Optional[Callable[[list], list]] = None
         self._gc_queue: list[str] = []
         self._gc_event = threading.Event()
         self._gc_busy = threading.Lock()
@@ -205,9 +208,21 @@ class Filer:
 
     # --- chunk GC (filer_deletion.go) -------------------------------------
     def _collect_chunks(self, entry: Entry, keep: list = ()) -> None:
+        chunks = list(entry.chunks)
+        keep = list(keep)
+        if self.resolve_chunks_for_gc is not None and (
+                any(c.is_chunk_manifest for c in chunks)
+                or any(c.is_chunk_manifest for c in keep)):
+            try:
+                chunks = self.resolve_chunks_for_gc(chunks)
+                # a metadata-only update can carry the same manifest in
+                # keep: its children must count as kept too
+                keep = self.resolve_chunks_for_gc(keep)
+            except Exception:
+                pass  # best effort: still GC the top-level ids
         keep_ids = {c.file_id for c in keep}
         with self._lock:
-            for c in entry.chunks:
+            for c in chunks:
                 if c.file_id not in keep_ids:
                     self._gc_queue.append(c.file_id)
         self._gc_event.set()

@@ -54,13 +54,20 @@ class FilerServer:
                  max_chunk_mb: int = 8, collection: str = "",
                  replication: str = "", guard=None,
                  notification_queue=None, chunk_cache_dir: str = "",
-                 chunk_cache_mem_mb: int = 64):
+                 chunk_cache_mem_mb: int = 64, cipher: bool = False):
         from ..security import Guard
 
         self.guard = guard or Guard()
+        # -encryptVolumeData: chunks stored as AES-256-GCM ciphertext with
+        # per-chunk keys living only in filer metadata
+        self.cipher = cipher
+        from .filechunk_manifest import MANIFEST_BATCH
+
+        self.manifest_batch = MANIFEST_BATCH
         self.master_url = master_url
         self.client = WeedClient(master_url, keep_connected=True)
         self.filer = Filer(store, delete_chunks_fn=self._delete_chunks)
+        self.filer.resolve_chunks_for_gc = self._resolve_for_gc
         self.host, self.port = host, port
         self.max_chunk_size = max_chunk_mb * 1024 * 1024
         self.collection = collection
@@ -185,23 +192,121 @@ class FilerServer:
             except Exception:
                 pass  # best-effort; orphans are re-collectable
 
+    def _store_blob(self, piece: bytes, collection: str, ttl: str,
+                    replication: str, compress: bool) -> tuple[str, str, bool]:
+        """Transform + upload one chunk blob: gzip (if worth it), then
+        AES-GCM when cipher is on (upload_content.go:116-210 order).
+        Returns (fid, cipher_key_hex, is_compressed)."""
+        from ..utils.compression import maybe_gzip_data
+
+        blob = piece
+        is_compressed = False
+        if compress:
+            gz = maybe_gzip_data(piece)
+            if gz is not piece:
+                blob, is_compressed = gz, True
+        key_hex = ""
+        if self.cipher:
+            from ..utils.cipher import encrypt, gen_cipher_key
+
+            key = gen_cipher_key()
+            blob = encrypt(blob, key)
+            key_hex = key.hex()
+        fid = self.client.upload(
+            blob, collection=collection or self.collection,
+            replication=replication or self.replication, ttl=ttl,
+            compress=False)  # transformations already applied here
+        return fid, key_hex, is_compressed
+
     def write_chunks(self, data: bytes, collection: str = "",
-                     ttl: str = "", replication: str = "") -> list[FileChunk]:
-        """Auto-chunking upload: split at max_chunk_size, one fid each."""
+                     ttl: str = "", replication: str = "",
+                     mime: str = "", path: str = "") -> list[FileChunk]:
+        """Auto-chunking upload: split at max_chunk_size, one fid each;
+        compressible mimes/extensions are stored gzipped and ciphered
+        clusters get per-chunk AES keys (FileChunk.cipher_key)."""
         if not data:
             return []
+        import os as _os
+
+        from ..utils.compression import is_compressable_file_type
+
+        ext = _os.path.splitext(path)[1] if path else ""
+        compress, _ = is_compressable_file_type(ext, mime)
         chunks: list[FileChunk] = []
         now = time.time_ns()
         for off in range(0, len(data), self.max_chunk_size):
             piece = data[off : off + self.max_chunk_size]
-            fid = self.client.upload(
-                piece, collection=collection or self.collection,
-                replication=replication or self.replication, ttl=ttl)
+            fid, key_hex, is_compressed = self._store_blob(
+                piece, collection, ttl, replication, compress)
             chunks.append(FileChunk(
                 file_id=fid, offset=off, size=len(piece),
                 modified_ts_ns=now,
-                etag=hashlib.md5(piece).hexdigest()))
+                etag=hashlib.md5(piece).hexdigest(),
+                cipher_key=key_hex, is_compressed=is_compressed))
         return chunks
+
+    def fetch_chunk(self, chunk: FileChunk) -> bytes:
+        """Whole-chunk plaintext: download (cache the stored blob as-is —
+        ciphertext never lands in the cache dir unencrypted), then
+        decrypt + decompress."""
+        blob = self.chunk_cache.get(chunk.file_id)
+        if blob is None:
+            blob = self.client.download(chunk.file_id)
+            self.chunk_cache.set(chunk.file_id, blob)
+        return self._open_blob(chunk, blob)
+
+    def _open_blob(self, chunk: FileChunk, blob: bytes) -> bytes:
+        if chunk.cipher_key:
+            from ..utils.cipher import decrypt
+
+            blob = decrypt(blob, bytes.fromhex(chunk.cipher_key))
+        if chunk.is_compressed:
+            from ..utils.compression import ungzip_data
+
+            blob = ungzip_data(blob)
+        return blob
+
+    def fetch_chunk_range(self, chunk: FileChunk, offset_in_chunk: int,
+                          size: int) -> bytes:
+        """Sub-range of a chunk.  Plain chunks ride an HTTP Range GET so
+        only the needed bytes leave the volume server (stream.go ChunkView
+        reads); ciphered/compressed blobs must be fetched whole."""
+        if chunk.cipher_key or chunk.is_compressed:
+            data = self.fetch_chunk(chunk)
+            return data[offset_in_chunk:offset_in_chunk + size]
+        cached = self.chunk_cache.get(chunk.file_id)
+        if cached is not None:
+            return cached[offset_in_chunk:offset_in_chunk + size]
+        if offset_in_chunk == 0 and size >= chunk.size:
+            # full-chunk read: fetch + populate the cache.  Still slice —
+            # the stored blob can be LARGER than chunk.size (a truncate
+            # trims the FileChunk without rewriting the blob)
+            return self.fetch_chunk(chunk)[:size]
+        return self.client.download_range(chunk.file_id, offset_in_chunk,
+                                          size)
+
+    def _resolve_for_gc(self, chunks: list[FileChunk]) -> list[FileChunk]:
+        """GC view of a chunk list: manifest children AND the manifest
+        blobs themselves (both must be reclaimed on delete/overwrite)."""
+        from .filechunk_manifest import has_chunk_manifest, resolve_chunk_manifest
+
+        if not has_chunk_manifest(chunks):
+            return chunks
+        data, manifests = resolve_chunk_manifest(self.fetch_chunk, chunks)
+        return data + manifests
+
+    def resolve_chunks(self, chunks: list[FileChunk],
+                       start: int = 0,
+                       stop: int = 2**63 - 1) -> list[FileChunk]:
+        """Expand manifest chunks overlapping [start, stop)
+        (filechunk_manifest.go ResolveChunkManifest)."""
+        from .filechunk_manifest import has_chunk_manifest, resolve_chunk_manifest
+
+        if not has_chunk_manifest(chunks):
+            return chunks
+        data_chunks, _ = resolve_chunk_manifest(self.fetch_chunk, chunks,
+                                                start, stop)
+        return data_chunks
 
     def read_chunks(self, entry: Entry, offset: int = 0,
                     size: Optional[int] = None) -> bytes:
@@ -211,16 +316,31 @@ class FilerServer:
         size = max(0, min(size, file_size - offset))
         if size == 0:
             return b""
+        chunks = self.resolve_chunks(entry.chunks, offset, offset + size)
+        by_fid = {c.file_id: c for c in chunks}
         out = bytearray(size)
-        for view in read_plan(entry.chunks, offset, size):
-            blob = self.chunk_cache.get(view.file_id)
-            if blob is None:
-                blob = self.client.download(view.file_id)
-                self.chunk_cache.set(view.file_id, blob)
-            piece = blob[view.offset_in_chunk : view.offset_in_chunk + view.size]
+        for view in read_plan(chunks, offset, size):
+            piece = self.fetch_chunk_range(
+                by_fid[view.file_id], view.offset_in_chunk, view.size)
             start = view.logic_offset - offset
             out[start : start + len(piece)] = piece
         return bytes(out)
+
+    def manifestize(self, chunks: list[FileChunk], collection: str = "",
+                    ttl: str = "", replication: str = "") -> list[FileChunk]:
+        """Collapse every 10k chunks into a manifest chunk
+        (MaybeManifestize, filechunk_manifest.go:192) — manifest blobs ride
+        the same gzip+cipher pipeline as data (they contain chunk keys)."""
+        from .filechunk_manifest import maybe_manifestize
+
+        def save(blob: bytes) -> FileChunk:
+            fid, key_hex, is_compressed = self._store_blob(
+                blob, collection, ttl, replication, compress=True)
+            return FileChunk(file_id=fid, offset=0, size=len(blob),
+                             modified_ts_ns=time.time_ns(),
+                             cipher_key=key_hex, is_compressed=is_compressed)
+
+        return maybe_manifestize(save, chunks, self.manifest_batch)
 
     # --- file API ---------------------------------------------------------
     def put_file(self, path: str, data: bytes, mime: str = "",
@@ -233,7 +353,9 @@ class FilerServer:
         collection = collection or rule.collection or self.collection
         replication = rule.replication or self.replication
         ttl = ttl or rule.ttl
-        chunks = self.write_chunks(data, collection, ttl, replication)
+        chunks = self.write_chunks(data, collection, ttl, replication,
+                                   mime=mime, path=path)
+        chunks = self.manifestize(chunks, collection, ttl, replication)
         entry = Entry(full_path=path, attr=Attr(
             mtime=time.time(), crtime=time.time(), mode=mode, mime=mime,
             collection=collection, replication=replication,

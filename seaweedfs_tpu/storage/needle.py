@@ -87,6 +87,30 @@ def get_actual_size(size: int, version: Version) -> int:
     return NEEDLE_HEADER_SIZE + needle_body_length(size, version)
 
 
+def parse_needle_tail(tail: bytes) -> tuple[int, bytes, bytes]:
+    """Parse the post-data metadata (flags | name | mime) of a v2/v3 body.
+    `tail` starts at the flags byte and may run long (over-read into the
+    next record is fine — only declared lengths are consumed).  Lets a
+    ranged read learn flags/name/mime without touching the data bytes."""
+    if not tail:
+        return 0, b"", b""
+    i = 0
+    flags = tail[i]
+    i += 1
+    name = mime = b""
+    if flags & FLAG_HAS_NAME and i < len(tail):
+        ln = tail[i]
+        i += 1
+        name = tail[i:i + ln]
+        i += ln
+    if flags & FLAG_HAS_MIME and i < len(tail):
+        lm = tail[i]
+        i += 1
+        mime = tail[i:i + lm]
+        i += lm
+    return flags, name, mime
+
+
 @dataclass
 class Needle:
     cookie: int = 0

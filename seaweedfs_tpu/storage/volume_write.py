@@ -1,0 +1,226 @@
+"""Group-commit write worker: batched appends with one fsync per batch.
+
+Equivalent of weed/storage/volume_write.go:94-305 (syncWrite vs the
+asyncRequestsChan worker) + needle/async_request.go.  Concurrent writers
+submit requests to a queue; a single worker thread drains it into batches
+of <= 4MB payload or <= 128 requests, appends every record, then issues
+ONE fsync for the whole batch before completing the requests.  Durability
+cost is amortized across the batch — this is what the reference's 15.7k
+writes/s benchmark figure rides on.
+
+Failure semantics (startWorker, volume_write.go:280-300): if the batch
+fsync (or an append) fails, the `.dat` is truncated back to the batch
+start offset and every request in the batch fails.  Unlike the reference
+(which leaves the needle map dirty and relies on restart integrity
+checking — the "this may generate dirty data" TODO at volume_write.go:284),
+the rollback here also truncates the `.idx` log back and reloads the
+in-memory map, so a running server stays consistent without a restart.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+from typing import Optional
+
+from .needle import Needle
+from .needle_map import MemoryNeedleMap
+
+MAX_BATCH_BYTES = 4 * 1024 * 1024
+MAX_BATCH_REQUESTS = 128
+
+
+class AsyncRequest:
+    """needle/async_request.go: one queued write/delete with its result."""
+
+    __slots__ = ("needle", "is_write", "check_cookie", "_done",
+                 "offset", "size", "unchanged", "error")
+
+    def __init__(self, needle: Needle, is_write: bool,
+                 check_cookie: bool = True):
+        self.needle = needle
+        self.is_write = is_write
+        self.check_cookie = check_cookie
+        self._done = threading.Event()
+        self.offset = 0
+        self.size = 0
+        self.unchanged = False
+        self.error: Optional[BaseException] = None
+
+    def complete(self, offset: int, size: int, unchanged: bool) -> None:
+        if self._done.is_set():
+            return
+        self.offset, self.size, self.unchanged = offset, size, unchanged
+        self._done.set()
+
+    def fail(self, err: BaseException) -> None:
+        if self._done.is_set():  # first outcome wins
+            return
+        self.error = err
+        self._done.set()
+
+    def wait(self, timeout: Optional[float] = None):
+        """Blocks until the batch containing this request commits.
+        Returns (offset, size, unchanged) or raises the request's error."""
+        if not self._done.wait(timeout):
+            raise TimeoutError("group-commit request timed out")
+        if self.error is not None:
+            raise self.error
+        return self.offset, self.size, self.unchanged
+
+    def estimated_bytes(self) -> int:
+        return len(self.needle.data) + 256
+
+
+class GroupCommitWorker:
+    """Single writer thread for one Volume; submit() is thread-safe."""
+
+    def __init__(self, volume, max_batch_bytes: int = MAX_BATCH_BYTES,
+                 max_batch_requests: int = MAX_BATCH_REQUESTS):
+        self.volume = volume
+        self.max_batch_bytes = max_batch_bytes
+        self.max_batch_requests = max_batch_requests
+        self._q: queue.Queue[Optional[AsyncRequest]] = queue.Queue()
+        self._stopped = False
+        # observability (stats/metrics wiring reads these)
+        self.request_count = 0
+        self.batch_count = 0
+        self.fsync_count = 0
+        self.rollback_count = 0
+        self._thread = threading.Thread(
+            target=self._run, name=f"group-commit-{volume.id}", daemon=True)
+        self._thread.start()
+
+    # --- producer side ----------------------------------------------------
+    def submit_write(self, n: Needle, check_cookie: bool = True) -> AsyncRequest:
+        return self._submit(AsyncRequest(n, is_write=True,
+                                         check_cookie=check_cookie))
+
+    def submit_delete(self, n: Needle) -> AsyncRequest:
+        return self._submit(AsyncRequest(n, is_write=False))
+
+    def _submit(self, req: AsyncRequest) -> AsyncRequest:
+        if self._stopped or not self._thread.is_alive():
+            req.fail(RuntimeError("group-commit worker stopped"))
+            return req
+        self._q.put(req)
+        return req
+
+    def stop(self) -> None:
+        """Drain outstanding requests, then stop the thread."""
+        if self._stopped:
+            return
+        self._stopped = True
+        self._q.put(None)
+        self._thread.join(timeout=30)
+
+    # --- worker side ------------------------------------------------------
+    def _next_batch(self) -> tuple[list[AsyncRequest], bool]:
+        """Block for the first request, then greedily take whatever is
+        already queued up to the batch limits (startWorker's
+        currentBytesToWrite accumulation, volume_write.go:246-270)."""
+        first = self._q.get()
+        if first is None:
+            return [], True
+        batch = [first]
+        total = first.estimated_bytes()
+        while (len(batch) < self.max_batch_requests
+               and total < self.max_batch_bytes):
+            try:
+                req = self._q.get_nowait()
+            except queue.Empty:
+                break
+            if req is None:
+                return batch, True
+            batch.append(req)
+            total += req.estimated_bytes()
+        return batch, False
+
+    def _run(self) -> None:
+        while True:
+            batch, stopping = self._next_batch()
+            if batch:
+                try:
+                    self._commit_batch(batch)
+                except BaseException as e:  # last-ditch: keep the thread up
+                    for req in batch:
+                        req.fail(e)
+            if stopping:
+                # fail anything submitted after the sentinel
+                while True:
+                    try:
+                        req = self._q.get_nowait()
+                    except queue.Empty:
+                        return
+                    if req is not None:
+                        req.fail(RuntimeError("group-commit worker stopped"))
+
+    def _commit_batch(self, batch: list[AsyncRequest]) -> None:
+        v = self.volume
+        applied: list[tuple[AsyncRequest, tuple]] = []
+        failed_early: list[AsyncRequest] = []
+        # the whole batch (snapshot -> appends -> fsync -> maybe rollback)
+        # runs under the volume write lock so direct-path writes can never
+        # interleave into the rollback window
+        with v.write_lock:
+            dat_start = v.data_size
+            idx_start = self._idx_size()
+            try:
+                for req in batch:
+                    try:
+                        if req.is_write:
+                            result = v._do_write(req.needle, req.check_cookie)
+                        else:
+                            result = (0, v._do_delete(req.needle), False)
+                        applied.append((req, result))
+                    except (KeyError, ValueError, PermissionError) as e:
+                        # per-request logical errors (cookie mismatch,
+                        # read-only) fail that request only, not the batch
+                        req.fail(e)
+                        failed_early.append(req)
+                v._dat.sync()
+                self.fsync_count += 1
+            except Exception as e:
+                # broad on purpose: ANY unexpected failure (e.g. the .dat
+                # handle mid-swap during tiering) must roll back and fail
+                # the batch — a dead worker thread would hang every
+                # subsequent fsync writer forever
+                self._rollback(dat_start, idx_start)
+                for req in batch:
+                    if req not in failed_early:
+                        req.fail(e)
+                return
+        self.batch_count += 1
+        self.request_count += len(batch)
+        for req, (offset, size, unchanged) in applied:
+            if req.is_write:
+                req.complete(offset, size, unchanged)
+            else:
+                req.complete(0, size, False)
+
+    def _idx_size(self) -> int:
+        nm = self.volume.nm
+        if nm is not None and nm._index_file is not None:
+            nm._index_file.flush()
+        path = self.volume.idx_path
+        return os.path.getsize(path) if os.path.exists(path) else 0
+
+    def _rollback(self, dat_start: int, idx_start: int) -> None:
+        """Truncate-on-sync-failure (volume_write.go:284-290), extended to
+        roll the index log + in-memory map back too."""
+        self.rollback_count += 1
+        v = self.volume
+        try:
+            v._dat.truncate(dat_start)
+        except OSError:
+            pass
+        nm = v.nm
+        if nm is not None:
+            nm.close()
+        try:
+            with open(v.idx_path, "r+b") as f:
+                f.truncate(idx_start)
+        except OSError:
+            pass
+        v.nm = MemoryNeedleMap.load(v.idx_path)

@@ -1,0 +1,32 @@
+"""AES-256-GCM chunk encryption, wire-compatible with weed/util/cipher.go.
+
+The reference seals with a random 12-byte nonce prepended to the
+ciphertext (gcm.Seal(nonce, nonce, plaintext, nil)); keys are 32 random
+bytes generated per chunk and stored only in the filer's FileChunk
+metadata — volume servers hold ciphertext they cannot read.
+"""
+
+from __future__ import annotations
+
+import os
+
+from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+
+NONCE_SIZE = 12  # Go's gcm.NonceSize() default
+KEY_SIZE = 32
+
+
+def gen_cipher_key() -> bytes:
+    return os.urandom(KEY_SIZE)
+
+
+def encrypt(plaintext: bytes, key: bytes) -> bytes:
+    nonce = os.urandom(NONCE_SIZE)
+    return nonce + AESGCM(key).encrypt(nonce, plaintext, None)
+
+
+def decrypt(ciphertext: bytes, key: bytes) -> bytes:
+    if len(ciphertext) < NONCE_SIZE:
+        raise ValueError("ciphertext too short")
+    return AESGCM(key).decrypt(ciphertext[:NONCE_SIZE],
+                               ciphertext[NONCE_SIZE:], None)

@@ -201,6 +201,52 @@ class VolumeServer:
         rs.reconstruct(bufs)
         return bufs[shard_id].tobytes()
 
+    def _try_partial_read(self, req, fid, rng_hdr: str):
+        """Serve a Range GET by preading ONLY the requested data bytes off
+        disk (read_needle_meta/read_needle_data split) — no whole-needle
+        read, no CRC pass.  Returns None to fall back to the full-read path
+        (v1 volumes, compressed or TTL'd needles, empty bodies, malformed
+        range specs)."""
+        from ..storage.needle import (FLAG_HAS_MIME, FLAG_HAS_TTL,
+                                      FLAG_IS_COMPRESSED)
+        from ..storage.types import Version
+        from ..utils.httpd import UNSATISFIABLE_RANGE, parse_range
+
+        v = self.store.volumes[fid.volume_id]
+        if v.version == Version.V1:
+            return None
+        try:
+            nv, data_size, flags, name, mime = v.read_needle_meta(
+                fid.key, fid.cookie)
+        except (NotFoundError, DeletedError):
+            raise HttpError(404, "not found")
+        except CookieMismatchError:
+            raise HttpError(404, "cookie mismatch")
+        except ValueError:
+            return None
+        if flags & (FLAG_IS_COMPRESSED | FLAG_HAS_TTL) or data_size == 0:
+            return None  # need the full body (decompress / expiry check)
+        rng = parse_range(rng_hdr, data_size)
+        if rng == UNSATISFIABLE_RANGE:
+            return Response(raw=b"", status=416, headers={
+                "Content-Range": f"bytes */{data_size}"})
+        if rng is None:
+            return None
+        off, sz = rng
+        headers = {
+            "Accept-Ranges": "bytes",
+            "Content-Range": f"bytes {off}-{off + sz - 1}/{data_size}",
+            "Content-Type": (mime.decode(errors="replace")
+                             if flags & FLAG_HAS_MIME and mime
+                             else "application/octet-stream"),
+        }
+        if name:
+            headers["Content-Disposition"] = \
+                f'inline; filename="{name.decode(errors="replace")}"'
+        body = b"" if req.handler.command == "HEAD" \
+            else v.read_needle_data(nv, off, sz)
+        return Response(raw=body, status=206, headers=headers)
+
     # --- routes -----------------------------------------------------------
     def _register_routes(self) -> None:
         r = self.router
@@ -255,6 +301,13 @@ class VolumeServer:
             if err:
                 raise HttpError(401, err)
             vid = fid.volume_id
+            wants_resize = bool(req.query.get("width")
+                                or req.query.get("height"))
+            rng_hdr = req.headers.get("Range", "")
+            if rng_hdr and not wants_resize and vid in self.store.volumes:
+                partial = self._try_partial_read(req, fid, rng_hdr)
+                if partial is not None:
+                    return partial
             if vid in self.store.volumes:
                 try:
                     n = self.store.read_needle(vid, fid.key, fid.cookie)
@@ -279,7 +332,10 @@ class VolumeServer:
                 return Response(None, status=302,
                                 headers={"Location": f"http://{others[0]}{req.path}"},
                                 raw=b"")
-            headers = {"ETag": f'"{n.etag()}"'}
+            etag = f'"{n.etag()}"'
+            if req.headers.get("If-None-Match") == etag:
+                return Response(None, status=304, raw=b"")
+            headers = {"ETag": etag, "Accept-Ranges": "bytes"}
             if n.has(FLAG_HAS_NAME) and n.name:
                 headers["Content-Disposition"] = f'inline; filename="{n.name.decode(errors="replace")}"'
             ctype = "application/octet-stream"
@@ -287,10 +343,20 @@ class VolumeServer:
                 ctype = n.mime.decode(errors="replace")
             headers["Content-Type"] = ctype
             body = n.data
+            # FLAG_IS_COMPRESSED needles are stored gzipped: serve raw with
+            # Content-Encoding to clients that accept gzip, else decompress
+            # (volume_server_handlers_read.go:122-137)
+            if n.is_compressed:
+                if "gzip" in req.headers.get("Accept-Encoding", ""):
+                    headers["Content-Encoding"] = "gzip"
+                else:
+                    from ..utils.compression import ungzip_data
+
+                    body = ungzip_data(body)
             # on-the-fly image resize (volume_server_handlers_read.go
             # ?width/?height hook -> images/resizing.go; no-op when
             # Pillow is absent or the content is not an image)
-            if req.query.get("width") or req.query.get("height"):
+            if wants_resize:
                 from ..images import resized
 
                 def _dim(name: str):
@@ -302,6 +368,19 @@ class VolumeServer:
                 body, _, _ = resized(body, ctype, _dim("width"),
                                      _dim("height"),
                                      req.query.get("mode", ""))
+            if rng_hdr and "Content-Encoding" not in headers:
+                from ..utils.httpd import UNSATISFIABLE_RANGE, parse_range
+
+                rng = parse_range(rng_hdr, len(body))
+                if rng == UNSATISFIABLE_RANGE:
+                    return Response(raw=b"", status=416, headers={
+                        "Content-Range": f"bytes */{len(body)}"})
+                if rng is not None:
+                    off, sz = rng
+                    headers["Content-Range"] = \
+                        f"bytes {off}-{off + sz - 1}/{len(body)}"
+                    return Response(raw=body[off:off + sz], status=206,
+                                    headers=headers)
             return Response(raw=body, headers=headers)
 
         @r.route("POST", FID_PATTERN)
@@ -318,6 +397,12 @@ class VolumeServer:
             except ValueError as e:
                 raise HttpError(400, str(e))
             n = Needle(cookie=fid.cookie, id=fid.key, data=req.body)
+            # client pre-gzipped the payload (upload_content.go:116):
+            # remember it in the needle flags so reads can undo it
+            if req.headers.get("Content-Encoding") == "gzip":
+                from ..storage.needle import FLAG_IS_COMPRESSED
+
+                n.set_flag(FLAG_IS_COMPRESSED)
             name = req.query.get("name") or req.headers.get("X-File-Name")
             if name:
                 n.set_flag(FLAG_HAS_NAME)
@@ -360,12 +445,16 @@ class VolumeServer:
                 if token:
                     params["jwt"] = token
                 qs = urllib.parse.urlencode(params)
+                fwd_headers = {"Content-Type": mime or ""}
+                if req.headers.get("Content-Encoding"):
+                    fwd_headers["Content-Encoding"] = \
+                        req.headers["Content-Encoding"]
                 for url in self._lookup_replicas(fid.volume_id):
                     if url == self.url:
                         continue
                     status, body, _ = http_bytes(
                         "POST", f"http://{url}{req.path}?{qs}",
-                        req.body, headers={"Content-Type": mime or ""})
+                        req.body, headers=fwd_headers)
                     if status != 200 and status != 201:
                         raise HttpError(500,
                                         f"replication to {url} failed: {status}")
@@ -389,7 +478,8 @@ class VolumeServer:
             else:
                 try:
                     size = self.store.delete_needle(
-                        vid, Needle(cookie=fid.cookie, id=fid.key))
+                        vid, Needle(cookie=fid.cookie, id=fid.key),
+                        fsync=req.query.get("fsync") == "true")
                 except KeyError:
                     raise HttpError(404, f"volume {vid} not found")
             if req.query.get("type") != "replicate":

@@ -169,14 +169,20 @@ class Store:
     # --- needle ops (store.go:338,362) ------------------------------------
     def write_needle(self, vid: int, n: Needle, fsync: bool = False) -> tuple[int, bool]:
         v = self.get_volume(vid)
+        if fsync:
+            # group-commit worker (volume_write.py): the store lock is NOT
+            # held while waiting, so concurrent fsync writers batch into one
+            # fsync (writeNeedle2, volume_write.go:110-128)
+            _, size, unchanged = v.write_needle2(n, fsync=True)
+            return size, unchanged
         with self.volume_locks[vid]:
             _, size, unchanged = v.write_needle(n)
-            if fsync:
-                v._dat.sync()
         return size, unchanged
 
-    def delete_needle(self, vid: int, n: Needle) -> int:
+    def delete_needle(self, vid: int, n: Needle, fsync: bool = False) -> int:
         v = self.get_volume(vid)
+        if fsync:
+            return v.delete_needle2(n, fsync=True)
         with self.volume_locks[vid]:
             return v.delete_needle(n)
 
@@ -191,14 +197,29 @@ class Store:
         base = v.file_prefix
         with self.volume_locks[vid]:
             v.read_only = True
-            ec_encoder.write_ec_files(base, self.rs(engine))
+            if (engine or self.ec_engine_name) == "tpu":
+                # overlapped device pipeline (ec/streaming.py), not the
+                # serial read->matmul->write loop
+                self._streaming_encoder().encode_file(base + ".dat", base)
+            else:
+                ec_encoder.write_ec_files(base, self.rs(engine))
             ec_encoder.write_sorted_file_from_idx(base)
 
     def ec_rebuild(self, vid: int, collection: str = "",
                    engine: Optional[str] = None) -> list[int]:
         """VolumeEcShardsRebuild: regenerate missing local shards."""
         base = self._ec_base(vid, collection)
+        if (engine or self.ec_engine_name) == "tpu":
+            return self._streaming_encoder().rebuild_files(base)
         return ec_encoder.rebuild_ec_files(base, self.rs(engine))
+
+    def _streaming_encoder(self):
+        enc = getattr(self, "_stream_enc", None)
+        if enc is None:
+            from ..ec.streaming import StreamingEncoder
+
+            enc = self._stream_enc = StreamingEncoder()
+        return enc
 
     def _ec_base(self, vid: int, collection: str = "") -> str:
         ev = self.ec_volumes.get(vid)

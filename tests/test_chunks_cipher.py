@@ -1,0 +1,349 @@
+"""Compression + cipher on the upload path, chunk manifests, Range reads.
+
+Reference behaviors: weed/util/compression.go (MaybeGzipData 10/9 rule,
+IsCompressableFileType), weed/util/cipher.go (AES-256-GCM, nonce-prefixed),
+weed/filer/filechunk_manifest.go (10k-batch recursive manifests),
+weed/server/volume_server_handlers_read.go (Range / If-None-Match /
+Content-Encoding), weed/operation/upload_content.go (client-side gzip).
+"""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from seaweedfs_tpu.client.operation import WeedClient
+from seaweedfs_tpu.filer.entry import FileChunk
+from seaweedfs_tpu.filer.filechunk_manifest import (
+    has_chunk_manifest,
+    maybe_manifestize,
+    resolve_chunk_manifest,
+)
+from seaweedfs_tpu.filer.server import FilerServer
+from seaweedfs_tpu.master.server import MasterServer
+from seaweedfs_tpu.utils.cipher import decrypt, encrypt, gen_cipher_key
+from seaweedfs_tpu.utils.compression import (
+    is_compressable_file_type,
+    is_gzipped_content,
+    maybe_gzip_data,
+    ungzip_data,
+)
+from seaweedfs_tpu.utils.httpd import http_bytes
+from seaweedfs_tpu.volume_server.server import VolumeServer
+from tests.conftest import free_port
+
+
+# --- pure helpers -----------------------------------------------------------
+
+def test_cipher_roundtrip_and_tamper():
+    key = gen_cipher_key()
+    ct = encrypt(b"secret payload", key)
+    assert ct != b"secret payload" and len(ct) > 14
+    assert decrypt(ct, key) == b"secret payload"
+    with pytest.raises(Exception):
+        decrypt(ct[:-1] + bytes([ct[-1] ^ 1]), key)  # GCM auth must fail
+    with pytest.raises(Exception):
+        decrypt(ct, gen_cipher_key())
+
+
+def test_maybe_gzip_win_rule():
+    text = b"the quick brown fox " * 200
+    gz = maybe_gzip_data(text)
+    assert is_gzipped_content(gz) and ungzip_data(gz) == text
+    # already-gzipped and incompressible data pass through untouched
+    assert maybe_gzip_data(gz) is gz
+    import os
+
+    rnd = os.urandom(4096)
+    assert maybe_gzip_data(rnd) is rnd
+
+
+def test_compressable_file_type_table():
+    assert is_compressable_file_type("", "text/plain") == (True, True)
+    assert is_compressable_file_type(".txt", "") == (True, True)
+    assert is_compressable_file_type(".zip", "") == (False, True)
+    assert is_compressable_file_type(".jpg", "image/jpeg") == (False, True)
+    assert is_compressable_file_type("", "application/xml") == (True, True)
+    assert is_compressable_file_type("", "audio/wav") == (True, True)
+    assert is_compressable_file_type(".bin", "") == (False, False)
+
+
+# --- manifest unit logic ----------------------------------------------------
+
+def _mk_chunks(n, size=10):
+    return [FileChunk(file_id=f"1,{i:08x}", offset=i * size, size=size,
+                      modified_ts_ns=i + 1) for i in range(n)]
+
+
+def test_maybe_manifestize_batches_and_tail():
+    stored: dict[str, bytes] = {}
+
+    def save(blob: bytes) -> FileChunk:
+        fid = f"9,{len(stored):08x}"
+        stored[fid] = blob
+        return FileChunk(file_id=fid, offset=0, size=len(blob),
+                         modified_ts_ns=time.time_ns())
+
+    chunks = _mk_chunks(10)
+    out = maybe_manifestize(save, chunks, merge_factor=4)
+    # 10 chunks -> 2 manifests of 4 + 2 inline
+    manifests = [c for c in out if c.is_chunk_manifest]
+    inline = [c for c in out if not c.is_chunk_manifest]
+    assert len(manifests) == 2 and len(inline) == 2
+    assert manifests[0].offset == 0 and manifests[0].size == 4 * 10
+    # resolution restores the full flat list
+    data, mchunks = resolve_chunk_manifest(
+        lambda c: stored[c.file_id], out)
+    assert sorted(c.offset for c in data) == [i * 10 for i in range(10)]
+    assert len(mchunks) == 2
+    # under the factor: untouched
+    small = _mk_chunks(3)
+    assert maybe_manifestize(save, small, merge_factor=4) == small
+
+
+def test_manifest_recursion_two_levels():
+    stored: dict[str, bytes] = {}
+
+    def save(blob: bytes) -> FileChunk:
+        fid = f"9,{len(stored):08x}"
+        stored[fid] = blob
+        return FileChunk(file_id=fid, offset=0, size=len(blob),
+                         modified_ts_ns=time.time_ns())
+
+    level1 = maybe_manifestize(save, _mk_chunks(16), merge_factor=4)
+    level2 = maybe_manifestize(save, level1, merge_factor=4)
+    # level1: 4 manifests; level2 collapses those... manifests pass through,
+    # so level2 == level1 (manifest chunks are never re-batched)
+    assert level2 == level1
+    data, _ = resolve_chunk_manifest(lambda c: stored[c.file_id], level2)
+    assert len(data) == 16
+
+
+# --- cluster fixtures -------------------------------------------------------
+
+@pytest.fixture
+def cluster(tmp_path):
+    master = MasterServer(port=free_port(), pulse_seconds=0.4).start()
+    d = tmp_path / "v"
+    d.mkdir()
+    vol = VolumeServer([str(d)], master.url, port=free_port(),
+                       pulse_seconds=0.4).start()
+    deadline = time.time() + 5
+    while time.time() < deadline and len(master.topo.all_nodes()) < 1:
+        time.sleep(0.05)
+    yield master, vol
+    vol.stop()
+    master.stop()
+
+
+def _mk_filer(cluster, **kw):
+    master, _ = cluster
+    return FilerServer(master.url, port=free_port(), **kw).start()
+
+
+# --- filer compression ------------------------------------------------------
+
+def test_filer_compressible_upload_roundtrip_and_range(cluster):
+    f = _mk_filer(cluster, max_chunk_mb=1)
+    try:
+        text = (b"line of text %d\n" % 7) * 20_000  # ~300KB, compressible
+        http_bytes("PUT", f"http://{f.url}/logs/a.txt", text,
+                   headers={"Content-Type": "text/plain"})
+        entry = f.filer.find_entry("/logs/a.txt")
+        assert entry.chunks and all(c.is_compressed for c in entry.chunks)
+        status, body, _ = http_bytes("GET", f"http://{f.url}/logs/a.txt")
+        assert status == 200 and body == text
+        status, body, hdrs = http_bytes(
+            "GET", f"http://{f.url}/logs/a.txt",
+            headers={"Range": "bytes=100000-100099"})
+        assert status == 206 and body == text[100000:100100]
+        # stored blob on the volume server is actually gzipped
+        blob, _ = f.client._get(entry.chunks[0].file_id, None)
+        assert is_gzipped_content(blob)
+        assert len(blob) < entry.chunks[0].size
+    finally:
+        f.stop()
+
+
+def test_filer_incompressible_stays_raw(cluster):
+    import os
+
+    f = _mk_filer(cluster)
+    try:
+        data = os.urandom(50_000)
+        http_bytes("PUT", f"http://{f.url}/b.bin", data)
+        entry = f.filer.find_entry("/b.bin")
+        assert all(not c.is_compressed for c in entry.chunks)
+        _, body, _ = http_bytes("GET", f"http://{f.url}/b.bin")
+        assert body == data
+    finally:
+        f.stop()
+
+
+# --- filer cipher -----------------------------------------------------------
+
+def test_filer_cipher_roundtrip_and_opaque_storage(cluster):
+    f = _mk_filer(cluster, cipher=True, max_chunk_mb=1)
+    try:
+        secret = b"top secret bytes " * 10_000  # multi-chunk at 1MB? ~170KB
+        http_bytes("PUT", f"http://{f.url}/vault/s.txt", secret,
+                   headers={"Content-Type": "text/plain"})
+        entry = f.filer.find_entry("/vault/s.txt")
+        assert entry.chunks and all(c.cipher_key for c in entry.chunks)
+        assert all(c.is_compressed for c in entry.chunks)  # gzip-then-seal
+        # volume server holds ciphertext: neither plaintext nor gzip
+        blob, _ = f.client._get(entry.chunks[0].file_id, None)
+        assert secret[:64] not in blob
+        assert not is_gzipped_content(blob)
+        # full + ranged reads decrypt transparently
+        _, body, _ = http_bytes("GET", f"http://{f.url}/vault/s.txt")
+        assert body == secret
+        status, body, _ = http_bytes(
+            "GET", f"http://{f.url}/vault/s.txt",
+            headers={"Range": "bytes=5000-5099"})
+        assert status == 206 and body == secret[5000:5100]
+    finally:
+        f.stop()
+
+
+# --- filer manifests end-to-end ---------------------------------------------
+
+def test_filer_manifest_file_roundtrips(cluster):
+    f = _mk_filer(cluster)
+    try:
+        f.max_chunk_size = 1024  # tiny chunks
+        f.manifest_batch = 8
+        data = bytes(i % 251 for i in range(40 * 1024))  # 40 chunks
+        http_bytes("PUT", f"http://{f.url}/big.bin", data)
+        entry = f.filer.find_entry("/big.bin")
+        assert has_chunk_manifest(entry.chunks)
+        assert len(entry.chunks) < 40  # collapsed
+        assert entry.file_size == len(data)
+        _, body, _ = http_bytes("GET", f"http://{f.url}/big.bin")
+        assert body == data
+        status, body, _ = http_bytes(
+            "GET", f"http://{f.url}/big.bin",
+            headers={"Range": "bytes=10000-20479"})
+        assert status == 206 and body == data[10000:20480]
+        # overwrite part of the file: new chunk shadows manifest content
+        http_bytes("PUT", f"http://{f.url}/big.bin?op=append", b"")
+    finally:
+        f.stop()
+
+
+def test_filer_manifest_with_cipher(cluster):
+    f = _mk_filer(cluster, cipher=True)
+    try:
+        f.max_chunk_size = 1024
+        f.manifest_batch = 4
+        data = bytes((i * 7) % 256 for i in range(12 * 1024))
+        http_bytes("PUT", f"http://{f.url}/mc.bin", data)
+        entry = f.filer.find_entry("/mc.bin")
+        assert has_chunk_manifest(entry.chunks)
+        manifest = next(c for c in entry.chunks if c.is_chunk_manifest)
+        assert manifest.cipher_key  # manifests are sealed too (they hold keys)
+        _, body, _ = http_bytes("GET", f"http://{f.url}/mc.bin")
+        assert body == data
+    finally:
+        f.stop()
+
+
+# --- volume server Range / If-None-Match / client gzip ----------------------
+
+@pytest.fixture
+def weed(cluster):
+    master, _ = cluster
+    c = WeedClient(master.url)
+    yield c
+    c.close()
+
+
+def test_volume_range_reads_exact_bytes(cluster, weed):
+    data = bytes(i % 256 for i in range(100_000))
+    fid = weed.upload(data)
+    urls, _ = weed.master.lookup_with_auth(int(fid.split(",")[0]))
+    url = urls[0]
+    status, body, hdrs = http_bytes(
+        "GET", f"http://{url}/{fid}",
+        headers={"Range": "bytes=5000-5999"})
+    assert status == 206
+    assert body == data[5000:6000]
+    assert hdrs.get("Content-Range") == "bytes 5000-5999/100000"
+    # suffix range
+    status, body, hdrs = http_bytes(
+        "GET", f"http://{url}/{fid}", headers={"Range": "bytes=-100"})
+    assert status == 206 and body == data[-100:]
+    # unsatisfiable
+    status, _, hdrs = http_bytes(
+        "GET", f"http://{url}/{fid}",
+        headers={"Range": "bytes=200000-200009"})
+    assert status == 416 and hdrs.get("Content-Range") == "bytes */100000"
+    assert weed.download_range(fid, 12345, 678) == data[12345:13023]
+
+
+def test_volume_if_none_match_304(cluster, weed):
+    fid = weed.upload(b"etag me")
+    urls, _ = weed.master.lookup_with_auth(int(fid.split(",")[0]))
+    url = urls[0]
+    status, _, hdrs = http_bytes("GET", f"http://{url}/{fid}")
+    etag = hdrs.get("ETag")
+    assert status == 200 and etag
+    status, body, _ = http_bytes("GET", f"http://{url}/{fid}",
+                                 headers={"If-None-Match": etag})
+    assert status == 304 and body == b""
+
+
+def test_client_gzip_upload_sets_needle_flag(cluster, weed):
+    text = b"compress me please " * 5000
+    fid = weed.upload(text, name="doc.txt", mime="text/plain")
+    # plain client gets plaintext back
+    assert weed.download(fid) == text
+    urls, _ = weed.master.lookup_with_auth(int(fid.split(",")[0]))
+    url = urls[0]
+    # gzip-accepting client gets the stored gzip + header
+    status, body, hdrs = http_bytes(
+        "GET", f"http://{url}/{fid}",
+        headers={"Accept-Encoding": "gzip"})
+    assert status == 200
+    assert hdrs.get("Content-Encoding") == "gzip"
+    assert is_gzipped_content(body) and ungzip_data(body) == text
+    # non-gzip client gets server-side decompression
+    status, body, hdrs = http_bytes("GET", f"http://{url}/{fid}")
+    assert status == 200 and body == text
+    assert hdrs.get("Content-Encoding") != "gzip"
+
+
+def test_manifest_delete_reclaims_child_chunks(cluster):
+    """Deleting a manifestized file must GC the manifest blob AND every
+    child chunk it references (filer_delete_entry.go resolves manifests
+    before queueing chunk deletion)."""
+    f = _mk_filer(cluster)
+    try:
+        f.max_chunk_size = 1024
+        f.manifest_batch = 4
+        data = bytes(i % 256 for i in range(8 * 1024))  # 8 chunks
+        http_bytes("PUT", f"http://{f.url}/doomed.bin", data)
+        entry = f.filer.find_entry("/doomed.bin")
+        assert has_chunk_manifest(entry.chunks)
+        children, manifests = resolve_chunk_manifest(
+            f.fetch_chunk, entry.chunks)
+        all_fids = [c.file_id for c in children + manifests]
+        assert len(children) == 8
+        f.chunk_cache._mem.clear() if hasattr(f.chunk_cache, "_mem") else None
+        http_bytes("DELETE", f"http://{f.url}/doomed.bin")
+        deadline = time.time() + 10
+        gone = set()
+        while time.time() < deadline and len(gone) < len(all_fids):
+            for fid in all_fids:
+                if fid in gone:
+                    continue
+                try:
+                    f.client.download(fid)
+                except Exception:
+                    gone.add(fid)
+            time.sleep(0.2)
+        assert gone == set(all_fids), \
+            f"leaked chunks: {set(all_fids) - gone}"
+    finally:
+        f.stop()
