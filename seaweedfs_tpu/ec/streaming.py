@@ -72,7 +72,7 @@ class StreamingEncoder:
     def __init__(self, data_shards: int = DATA_SHARDS_COUNT,
                  parity_shards: int = PARITY_SHARDS_COUNT,
                  matrix_kind: str = "vandermonde",
-                 dispatch_mb: int = 8, depth: int = 2):
+                 dispatch_mb: int = 8, depth: int = 3):
         import jax
 
         from ..ops.gf_matmul import DEFAULT_TILE_B, expand_matrix_bitplanes
@@ -106,13 +106,29 @@ class StreamingEncoder:
         return p
 
     def _dispatch(self, planes, buf: np.ndarray):
-        """Async: returns an unfetched device array [R, dispatch_b]."""
-        from ..ops.gf_matmul import gf_matmul_pallas, gf_matmul_xla
+        """Async: returns an unfetched device array [R, dispatch_b//4] u32
+        (the transfer packing — see _pack_u32_lanes) with the D2H copy
+        already queued behind the kernel, so the fetch streams down while
+        later dispatches compute."""
+        from ..ops.gf_matmul import gf_matmul_pallas_packed, gf_matmul_xla_packed
 
         dev = self._jax.device_put(buf)
         if self.on_tpu:
-            return gf_matmul_pallas(planes, dev)
-        return gf_matmul_xla(planes, dev)
+            out = gf_matmul_pallas_packed(planes, dev)
+        else:
+            out = gf_matmul_xla_packed(planes, dev)
+        try:
+            out.copy_to_host_async()
+        except Exception:  # pragma: no cover - backend without async D2H
+            pass
+        return out
+
+    def _fetch(self, out_dev) -> np.ndarray:
+        """Blocking fetch + host-side unpack back to [R, dispatch-width] u8."""
+        from ..ops.gf_matmul import unpack_u32_host
+
+        words = np.asarray(out_dev)
+        return unpack_u32_host(words, words.shape[1] * 4)
 
     # --- encode -----------------------------------------------------------
     def encode_file(self, dat_path: str, out_base: str,
@@ -130,7 +146,7 @@ class StreamingEncoder:
 
         def drain_one():
             parity_dev, entries, bi = pending.popleft()
-            parity = np.asarray(parity_dev)
+            parity = self._fetch(parity_dev)
             for col, n in entries:
                 for j in range(r):
                     outputs[k + j].write(parity[j, col:col + n])
@@ -223,7 +239,7 @@ class StreamingEncoder:
 
         def drain_one():
             out_dev, n, bi = pending.popleft()
-            out = np.asarray(out_dev)
+            out = self._fetch(out_dev)
             for row_i, m in enumerate(missing):
                 outputs[m].write(out[row_i, :n])
             free.append(bi)

@@ -214,12 +214,15 @@ class Filer:
                 any(c.is_chunk_manifest for c in chunks)
                 or any(c.is_chunk_manifest for c in keep)):
             try:
-                chunks = self.resolve_chunks_for_gc(chunks)
-                # a metadata-only update can carry the same manifest in
-                # keep: its children must count as kept too
-                keep = self.resolve_chunks_for_gc(keep)
+                # resolve BOTH lists before committing either: if only the
+                # old side expanded, live children of a still-kept manifest
+                # would look unreferenced and get deleted
+                resolved_chunks = self.resolve_chunks_for_gc(chunks)
+                resolved_keep = self.resolve_chunks_for_gc(keep)
             except Exception:
                 pass  # best effort: still GC the top-level ids
+            else:
+                chunks, keep = resolved_chunks, resolved_keep
         keep_ids = {c.file_id for c in keep}
         with self._lock:
             for c in chunks:

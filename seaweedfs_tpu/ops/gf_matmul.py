@@ -115,6 +115,44 @@ def gf_matmul_pallas(a_planes: jnp.ndarray, data: jnp.ndarray,
     )(a_planes, data)
 
 
+def _pack_u32_lanes(p: jnp.ndarray) -> jnp.ndarray:
+    """[R, B] u8 -> [R, B//4] u32: 4 consecutive lane bytes per word,
+    little-endian, so a host-side ``.view(uint8)`` restores the exact byte
+    stream.  Fetching over a remote-TPU link costs per *element*, not per
+    byte (measured 6x faster than fetching u8 directly), so the streaming
+    pipeline always pulls parity through this packing.  Strided lane slices
+    (not a [R, B/4, 4] reshape+bitcast) on purpose: the 3-D intermediate
+    picks up a T(8,128) tiled layout with 32x padding and OOMs HBM."""
+    w = p.astype(jnp.uint32)
+    return (w[:, 0::4] | (w[:, 1::4] << 8) | (w[:, 2::4] << 16)
+            | (w[:, 3::4] << 24))
+
+
+@functools.partial(jax.jit, static_argnames=("tile_b", "interpret"))
+def gf_matmul_pallas_packed(a_planes: jnp.ndarray, data: jnp.ndarray,
+                            tile_b: int = DEFAULT_TILE_B,
+                            interpret: bool = False) -> jnp.ndarray:
+    """gf_matmul_pallas fused with the u32 transfer packing; B % 4 == 0."""
+    return _pack_u32_lanes(
+        gf_matmul_pallas(a_planes, data, tile_b=tile_b, interpret=interpret))
+
+
+@jax.jit
+def gf_matmul_xla_packed(a_planes: jnp.ndarray,
+                         data: jnp.ndarray) -> jnp.ndarray:
+    """gf_matmul_xla fused with the u32 transfer packing; B % 4 == 0."""
+    return _pack_u32_lanes(gf_matmul_xla(a_planes, data))
+
+
+def unpack_u32_host(words: np.ndarray, width: int) -> np.ndarray:
+    """Host-side inverse of _pack_u32_lanes: [R, width//4] u32 -> [R, width]
+    u8 (no copy beyond the fetch buffer when already little-endian)."""
+    arr = np.ascontiguousarray(words)
+    if arr.dtype.byteorder == ">":  # pragma: no cover - TPU hosts are LE
+        arr = arr.astype("<u4")
+    return arr.view(np.uint8).reshape(arr.shape[0], width)
+
+
 class TpuEngine:
     """GfMatmulEngine backed by the bit-plane kernels.
 

@@ -78,6 +78,7 @@ class Volume:
         # (dataFileAccessLock in the reference)
         self.write_lock = threading.RLock()
         self._group_commit = None
+        self._worker_parked = False
         self._load_or_create()
 
     # --- naming -------------------------------------------------------
@@ -190,10 +191,24 @@ class Volume:
         # needles that a scan() pass can re-index (reference leaves .dat
         # intact in this case too).
 
-    def close(self) -> None:
-        if self._group_commit is not None:
-            self._group_commit.stop()  # drains queued writes first
+    def _park_worker(self) -> None:
+        """Stop the group-commit worker AND forbid its recreation until
+        _unpark_worker.  Must be called before a stop→acquire(write_lock)
+        sequence: without the parked flag, a concurrent fsync writer could
+        spin up a fresh worker in that window, and its thread would then
+        block on the write_lock we are about to hold — making the join in
+        close() stall for its full timeout."""
+        self._worker_parked = True
+        w = self._group_commit
+        if w is not None:
+            w.stop()  # drains queued writes first
             self._group_commit = None
+
+    def _unpark_worker(self) -> None:
+        self._worker_parked = False
+
+    def close(self) -> None:
+        self._park_worker()
         with self.write_lock:
             if self.nm is not None:
                 self.nm.close()
@@ -252,9 +267,13 @@ class Volume:
         return old.cookie == n.cookie and old.data == n.data
 
     def group_commit_worker(self):
+        """Returns the live worker, or None while a stop→lock sequence has
+        writes parked (callers fall back to a direct durable write)."""
         w = self._group_commit
         if w is None:
             with self.write_lock:  # concurrent first writers race here
+                if self._worker_parked:
+                    return None
                 w = self._group_commit
                 if w is None:
                     from .volume_write import GroupCommitWorker
@@ -269,12 +288,24 @@ class Volume:
         batch worker (one fsync per batch)."""
         if not fsync:
             return self.write_needle(n, check_cookie)
-        return self.group_commit_worker().submit_write(n, check_cookie).wait()
+        w = self.group_commit_worker()
+        if w is None:  # parked (compaction commit / tiering in progress)
+            with self.write_lock:
+                res = self._do_write(n, check_cookie)
+                self._dat.sync()
+                return res
+        return w.submit_write(n, check_cookie).wait()
 
     def delete_needle2(self, n: Needle, fsync: bool = False) -> int:
         if not fsync:
             return self.delete_needle(n)
-        _, size, _ = self.group_commit_worker().submit_delete(n).wait()
+        w = self.group_commit_worker()
+        if w is None:
+            with self.write_lock:
+                size = self._do_delete(n)
+                self._dat.sync()
+                return size
+        _, size, _ = w.submit_delete(n).wait()
         return size
 
     def write_needle(self, n: Needle, check_cookie: bool = True) -> tuple[int, int, bool]:
@@ -508,17 +539,18 @@ class Volume:
         cpd, cpx = self.file_prefix + ".cpd", self.file_prefix + ".cpx"
         if not (os.path.exists(cpd) and os.path.exists(cpx)):
             raise FileNotFoundError("no compacted files to commit")
-        # stop the worker BEFORE taking write_lock: close() joins the worker
+        # park the worker BEFORE taking write_lock: close() joins the worker
         # thread, which may itself be waiting on write_lock for a batch
-        if self._group_commit is not None:
-            self._group_commit.stop()
-            self._group_commit = None
-        with self.write_lock:
-            self._makeup_diff(cpd, cpx)
-            self.close()
-            os.replace(cpd, self.dat_path)
-            os.replace(cpx, self.idx_path)
-            self._load_or_create()
+        self._park_worker()
+        try:
+            with self.write_lock:
+                self._makeup_diff(cpd, cpx)
+                self.close()
+                os.replace(cpd, self.dat_path)
+                os.replace(cpx, self.idx_path)
+                self._load_or_create()
+        finally:
+            self._unpark_worker()
 
     def cleanup_compact(self) -> None:
         for ext in (".cpd", ".cpx"):
@@ -533,13 +565,12 @@ class Volume:
         The `.idx`/needle map stay local so lookups remain in-memory."""
         if self.tiered:
             raise PermissionError(f"volume {self.id} is already tiered")
-        # drain + stop the group-commit worker BEFORE taking write_lock
+        # drain + park the group-commit worker BEFORE taking write_lock
         # (close() joins the worker thread, which may be waiting on it),
         # then hold the lock for the whole snapshot->upload->swap so an
-        # acked fsync write can never land between snapshot and close
-        if self._group_commit is not None:
-            self._group_commit.stop()
-            self._group_commit = None
+        # acked fsync write can never land between snapshot and close.
+        # Stays parked: the volume reopens tiered (read-only .dat).
+        self._park_worker()
         with self.write_lock:
             backend = get_backend(backend_id)
             self._dat.sync()
@@ -570,10 +601,7 @@ class Volume:
         if remote is None:
             raise FileNotFoundError(f"volume {self.id} is not tiered")
         backend = get_backend(remote.backend_id)
-        if self._group_commit is not None:
-            self._group_commit.stop()
-            self._group_commit = None
-        self.close()
+        self.close()  # parks the worker
         backend.download_file(remote.key, self.dat_path)
         # the remote object is deleted while the .vif still records it —
         # removing the .vif first would orphan the (billed) remote copy
@@ -585,6 +613,7 @@ class Volume:
         os.remove(vif_path(self.file_prefix))
         self.read_only = False
         self._load_or_create()
+        self._unpark_worker()  # writable again -> group commit allowed
 
     def tier_delete_remote(self) -> None:
         """Delete the remote object for a still-tiered volume (destroy)."""

@@ -183,3 +183,41 @@ def test_worker_respects_batch_limits(tmp_path):
         assert w.batch_count >= 4  # 16 requests can't fit fewer batches
     finally:
         v.close()
+
+
+def test_parked_worker_falls_back_to_direct_durable_write(tmp_path):
+    """While commit_compact/tiering has the worker parked, fsync writes must
+    not spin up a fresh worker (whose thread would block on the held
+    write_lock and stall close()'s join) — they take the direct path."""
+    v = Volume(str(tmp_path), "", 5)
+    try:
+        v.write_needle2(Needle(cookie=1, id=1, data=b"a"), fsync=True)
+        assert v._group_commit is not None
+        v._park_worker()
+        assert v.group_commit_worker() is None
+        off, size, _ = v.write_needle2(Needle(cookie=2, id=2, data=b"bb"),
+                                       fsync=True)
+        assert v._group_commit is None
+        assert v.read_needle(2).data == b"bb"
+        assert v.delete_needle2(Needle(cookie=2, id=2), fsync=True) == size
+        v._unpark_worker()
+        assert v.group_commit_worker() is not None
+        assert v.read_needle(1).data == b"a"
+    finally:
+        v.close()
+
+
+def test_commit_compact_unparks_worker(tmp_path):
+    v = Volume(str(tmp_path), "", 6)
+    try:
+        for i in range(5):
+            v.write_needle(Needle(cookie=i, id=i + 1, data=b"d%d" % i))
+        v.delete_needle(Needle(cookie=0, id=1))
+        v.compact()
+        v.commit_compact()
+        assert v._worker_parked is False
+        v.write_needle2(Needle(cookie=9, id=9, data=b"post"), fsync=True)
+        assert v._group_commit is not None
+        assert v.read_needle(9).data == b"post"
+    finally:
+        v.close()
