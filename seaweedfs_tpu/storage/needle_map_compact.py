@@ -1,0 +1,606 @@
+"""Compact + persisted needle-map kinds.
+
+Three NeedleMapper kinds beyond the plain-dict MemoryNeedleMap, mirroring
+the reference's needle-map plurality (storage/needle_map.go:13-20):
+
+- CompactNeedleMap — the CompactMap analog (needle_map/compact_map.go:28):
+  sorted numpy sections of 16-byte entries (key u64, offset-in-8B-units
+  u32, size i32), binary-searched.  ~16 bytes of RAM per live file instead
+  of the ~400 a Python dict entry costs, restoring the reference's
+  40-bytes-per-file story.  Loading replays the whole `.idx` VECTORIZED
+  (one numpy pass, no per-entry Python), so a multi-million-entry volume
+  opens in milliseconds.
+
+- CheckpointedNeedleMap — the leveldb-kind analog
+  (needle_map_leveldb.go): a CompactNeedleMap that checkpoints its arrays
+  plus an `.idx` watermark to a `.ldb` snapshot file; restart loads the
+  snapshot with one read and replays only the `.idx` bytes appended after
+  the watermark — no full idx replay.  The snapshot is written
+  atomically (tmp+rename) and discarded if the `.idx` shrank beneath the
+  watermark (integrity truncation).
+
+- SortedFileNeedleMap — the sorted-file kind
+  (needle_map_sorted_file.go): the map IS a sorted `.sdx` file,
+  binary-searched with pread per lookup, nothing resident.  Read-only
+  volumes only (EC decode targets): put raises, delete marks the entry's
+  size negative in place, exactly like the reference.
+
+All kinds share MemoryNeedleMap's observable API and counter semantics
+(needle_map_memory.go:35-56 doLoading bookkeeping).
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+from bisect import bisect_left
+from typing import Callable, Iterator, Optional
+
+import numpy as np
+
+from . import idx as idx_mod
+from .needle_map import NeedleValue
+from .types import (
+    NEEDLE_MAP_ENTRY_SIZE,
+    NEEDLE_PADDING_SIZE,
+    TOMBSTONE_FILE_SIZE,
+    size_is_valid,
+)
+
+_SECTION = 1 << 20          # entries per immutable section
+_TAIL_FLUSH = 1 << 16       # ascending appends buffered before sectioning
+_OVERFLOW_MERGE = 50_000    # out-of-order entries tolerated before rebuild
+
+
+def _replay_arrays(entries: np.ndarray) -> tuple[dict, np.ndarray, np.ndarray,
+                                                 np.ndarray]:
+    """Vectorized doLoading (needle_map_memory.go:35-56): one pass over the
+    parsed idx entries -> (counters, live sorted keys/offset-units/sizes).
+
+    Order semantics are exact: within a key, later entries win; a put over
+    a live put and any event over a live predecessor count into the
+    deletion counters; a delete always increments deletion_counter even if
+    the key was never live.
+    """
+    counters = dict(file_counter=0, file_byte_counter=0, deletion_counter=0,
+                    deletion_byte_counter=0, max_file_key=0)
+    n = len(entries)
+    if n == 0:
+        empty_k = np.empty(0, dtype=np.uint64)
+        return (counters, empty_k, np.empty(0, dtype=np.uint32),
+                np.empty(0, dtype=np.int32))
+    keys = entries["key"].astype(np.uint64)
+    offs = entries["offset"].astype(np.uint32)   # padding units
+    sizes = entries["size"].astype(np.int32)
+    is_put = (offs != 0) & (sizes > 0)  # vector form of size_is_valid
+    counters["max_file_key"] = int(keys.max())
+    counters["file_counter"] = int(is_put.sum())
+    counters["file_byte_counter"] = int(sizes[is_put].astype(np.int64).sum())
+
+    order = np.argsort(keys, kind="stable")
+    sk, so, ss, sp = keys[order], offs[order], sizes[order], is_put[order]
+    same_prev = np.zeros(n, dtype=bool)
+    same_prev[1:] = sk[1:] == sk[:-1]
+    prev_live = np.zeros(n, dtype=bool)
+    prev_live[1:] = sp[:-1]
+    consumed = same_prev & prev_live           # this event replaced a live put
+    counters["deletion_counter"] = int((consumed & sp).sum()
+                                       + (~sp).sum())
+    prev_sizes = np.zeros(n, dtype=np.int64)
+    prev_sizes[1:] = ss[:-1]
+    counters["deletion_byte_counter"] = int(prev_sizes[consumed].sum())
+
+    # final state: last event per key, kept only if it is a put
+    last = np.zeros(n, dtype=bool)
+    last[:-1] = sk[:-1] != sk[1:]
+    last[-1] = True
+    live = last & sp
+    return counters, sk[live], so[live], ss[live]
+
+
+class _Section:
+    """Immutable-key sorted run; offsets/sizes mutate in place."""
+
+    __slots__ = ("keys", "offs", "sizes")
+
+    def __init__(self, keys: np.ndarray, offs: np.ndarray, sizes: np.ndarray):
+        self.keys = keys
+        self.offs = offs
+        self.sizes = sizes
+
+    def find(self, key: int) -> int:
+        i = int(np.searchsorted(self.keys, np.uint64(key)))
+        if i < len(self.keys) and int(self.keys[i]) == key:
+            return i
+        return -1
+
+
+class CompactNeedleMap:
+    """Numpy-sectioned needle map; see module docstring."""
+
+    def __init__(self, index_path: Optional[str] = None, replay: bool = False):
+        import threading
+
+        # readers (volume read path) are lock-free w.r.t. the volume's
+        # write_lock, so structural mutations here need their own mutex —
+        # the dict-based kind gets this for free from the GIL
+        self._mu = threading.RLock()
+        self._sections: list[_Section] = []
+        self._section_maxes: list[int] = []   # max key per section
+        self._tail_k: list[int] = []          # strictly ascending appends
+        self._tail_o: list[int] = []          # padding units
+        self._tail_s: list[int] = []
+        self._over: dict[int, tuple[int, int]] = {}  # out-of-order (units, size)
+        self.index_path = index_path
+        self._index_file = None
+        self.file_counter = 0
+        self.file_byte_counter = 0
+        self.deletion_counter = 0
+        self.deletion_byte_counter = 0
+        self.max_file_key = 0
+        if index_path is not None:
+            if replay and os.path.exists(index_path):
+                with open(index_path, "rb") as f:
+                    self._ingest_replay(f.read())
+            self._index_file = open(index_path, "ab")
+
+    @classmethod
+    def load(cls, index_path: str) -> "CompactNeedleMap":
+        return cls(index_path, replay=True)
+
+    def _ingest_replay(self, blob: bytes) -> None:
+        counters, k, o, s = _replay_arrays(idx_mod.parse_entries(blob))
+        for name, v in counters.items():
+            setattr(self, name, getattr(self, name) + v
+                    if name != "max_file_key" else max(self.max_file_key, v))
+        self._install_arrays(k, o, s)
+
+    def _install_arrays(self, k: np.ndarray, o: np.ndarray,
+                        s: np.ndarray) -> None:
+        for start in range(0, len(k), _SECTION):
+            sec = _Section(k[start:start + _SECTION].copy(),
+                           o[start:start + _SECTION].copy(),
+                           s[start:start + _SECTION].copy())
+            self._sections.append(sec)
+            self._section_maxes.append(int(sec.keys[-1]))
+
+    # --- lookup internals -------------------------------------------------
+    def _find_section(self, key: int) -> tuple[Optional[_Section], int]:
+        si = bisect_left(self._section_maxes, key)
+        if si < len(self._sections):
+            i = self._sections[si].find(key)
+            if i >= 0:
+                return self._sections[si], i
+        return None, -1
+
+    def _lookup(self, key: int) -> tuple[str, object, int, int]:
+        """-> (where, container, index, size); where '' if absent."""
+        if key in self._over:
+            units, size = self._over[key]
+            return "over", None, units, size
+        if self._tail_k:
+            j = bisect_left(self._tail_k, key)
+            if j < len(self._tail_k) and self._tail_k[j] == key:
+                return "tail", j, self._tail_o[j], self._tail_s[j]
+        sec, i = self._find_section(key)
+        if sec is not None:
+            return "sec", (sec, i), int(sec.offs[i]), int(sec.sizes[i])
+        return "", None, 0, 0
+
+    def get(self, key: int) -> Optional[NeedleValue]:
+        with self._mu:
+            where, _, units, size = self._lookup(key)
+        if not where or not size_is_valid(size) or units == 0:
+            return None
+        return NeedleValue(key, units * NEEDLE_PADDING_SIZE, size)
+
+    # --- mutation ---------------------------------------------------------
+    def _set(self, key: int, units: int, size: int) -> None:
+        with self._mu:
+            self._set_locked(key, units, size)
+
+    def _set_locked(self, key: int, units: int, size: int) -> None:
+        where, ref, _, _ = self._lookup(key)
+        if where == "over":
+            self._over[key] = (units, size)
+        elif where == "tail":
+            self._tail_o[ref] = units
+            self._tail_s[ref] = size
+        elif where == "sec":
+            sec, i = ref
+            sec.offs[i] = units
+            sec.sizes[i] = size
+        else:
+            last = self._tail_k[-1] if self._tail_k else (
+                self._section_maxes[-1] if self._section_maxes else -1)
+            if key > last:
+                self._tail_k.append(key)
+                self._tail_o.append(units)
+                self._tail_s.append(size)
+                if len(self._tail_k) >= _TAIL_FLUSH:
+                    self._flush_tail()
+            else:
+                self._over[key] = (units, size)
+                if len(self._over) >= _OVERFLOW_MERGE:
+                    self._rebuild()
+
+    def _flush_tail(self) -> None:
+        if not self._tail_k:
+            return
+        self._install_arrays(np.array(self._tail_k, dtype=np.uint64),
+                             np.array(self._tail_o, dtype=np.uint32),
+                             np.array(self._tail_s, dtype=np.int32))
+        self._tail_k, self._tail_o, self._tail_s = [], [], []
+
+    def _rebuild(self) -> None:
+        with self._mu:
+            self._rebuild_locked()
+
+    def _rebuild_locked(self) -> None:
+        """Merge overflow + tail + sections into fresh sorted sections."""
+        self._flush_tail()
+        parts_k = [s.keys for s in self._sections]
+        parts_o = [s.offs for s in self._sections]
+        parts_s = [s.sizes for s in self._sections]
+        if self._over:
+            ok = np.fromiter(self._over.keys(), dtype=np.uint64,
+                             count=len(self._over))
+            vals = list(self._over.values())
+            oo = np.array([v[0] for v in vals], dtype=np.uint32)
+            os_ = np.array([v[1] for v in vals], dtype=np.int32)
+            parts_k.append(ok)
+            parts_o.append(oo)
+            parts_s.append(os_)
+        k = np.concatenate(parts_k) if parts_k else np.empty(0, np.uint64)
+        o = np.concatenate(parts_o) if parts_o else np.empty(0, np.uint32)
+        s = np.concatenate(parts_s) if parts_s else np.empty(0, np.int32)
+        order = np.argsort(k, kind="stable")
+        # overflow entries were appended last, so stable-sort + keep-last
+        # gives overflow precedence on duplicate keys (none should exist)
+        k, o, s = k[order], o[order], s[order]
+        if len(k):
+            last = np.ones(len(k), dtype=bool)
+            last[:-1] = k[:-1] != k[1:]
+            k, o, s = k[last], o[last], s[last]
+        self._sections, self._section_maxes, self._over = [], [], {}
+        self._install_arrays(k, o, s)
+
+    def put(self, key: int, offset: int, size: int) -> None:
+        old = self.get(key)
+        self._set(key, offset // NEEDLE_PADDING_SIZE, size)
+        self.max_file_key = max(self.max_file_key, key)
+        self.file_counter += 1
+        self.file_byte_counter += size
+        if old is not None and size_is_valid(old.size):
+            self.deletion_counter += 1
+            self.deletion_byte_counter += old.size
+        self._append_index(key, offset, size)
+
+    def delete(self, key: int, tombstone_offset: int) -> None:
+        # counters mirror MemoryNeedleMap.delete: only a LIVE needle counts
+        # (the unconditional increment exists only in idx replay)
+        old = self.get(key)
+        if old is not None:
+            self._set(key, old.offset // NEEDLE_PADDING_SIZE,
+                      TOMBSTONE_FILE_SIZE)
+            self.deletion_counter += 1
+            self.deletion_byte_counter += old.size
+        self._append_index(key, tombstone_offset, TOMBSTONE_FILE_SIZE)
+
+    def _append_index(self, key: int, offset: int, size: int) -> None:
+        if self._index_file is not None:
+            self._index_file.write(idx_mod.pack_entry(key, offset, size))
+            self._index_file.flush()
+
+    # --- iteration ---------------------------------------------------------
+    def _iter_main(self, sections, tail_k, tail_o, tail_s) -> Iterator[NeedleValue]:
+        for sec in sections:
+            for i in range(len(sec.keys)):
+                yield NeedleValue(int(sec.keys[i]),
+                                  int(sec.offs[i]) * NEEDLE_PADDING_SIZE,
+                                  int(sec.sizes[i]))
+        for j in range(len(tail_k)):
+            yield NeedleValue(tail_k[j], tail_o[j] * NEEDLE_PADDING_SIZE,
+                              tail_s[j])
+
+    def __iter__(self) -> Iterator[NeedleValue]:
+        with self._mu:  # snapshot structure; offsets/sizes may still mutate
+            sections = list(self._sections)
+            tail_k, tail_o, tail_s = (list(self._tail_k), list(self._tail_o),
+                                      list(self._tail_s))
+            over = sorted((k, v[0], v[1]) for k, v in self._over.items())
+        oi = 0
+        for nv in self._iter_main(sections, tail_k, tail_o, tail_s):
+            while oi < len(over) and over[oi][0] < nv.key:
+                k, u, s = over[oi]
+                oi += 1
+                if size_is_valid(s):
+                    yield NeedleValue(k, u * NEEDLE_PADDING_SIZE, s)
+            if size_is_valid(nv.size):
+                yield nv
+        while oi < len(over):
+            k, u, s = over[oi]
+            oi += 1
+            if size_is_valid(s):
+                yield NeedleValue(k, u * NEEDLE_PADDING_SIZE, s)
+
+    def ascending_visit(self, fn: Callable[[NeedleValue], None]) -> None:
+        for nv in self:
+            fn(nv)
+
+    def __len__(self) -> int:
+        return sum(1 for _ in self)
+
+    @property
+    def content_size(self) -> int:
+        return self.file_byte_counter
+
+    def sync(self) -> None:
+        if self._index_file is not None:
+            self._index_file.flush()
+            os.fsync(self._index_file.fileno())
+
+    def close(self) -> None:
+        if self._index_file is not None:
+            self._index_file.flush()
+            self._index_file.close()
+            self._index_file = None
+
+    def destroy(self) -> None:
+        self.close()
+        if self.index_path and os.path.exists(self.index_path):
+            os.remove(self.index_path)
+
+
+_LDB_MAGIC = b"SWTPUNM1"
+_LDB_HEADER = struct.Struct(">8sQQQQQQQ")  # magic, watermark, n, 5 counters
+
+
+class CheckpointedNeedleMap(CompactNeedleMap):
+    """leveldb-kind analog (needle_map_leveldb.go): CompactNeedleMap whose
+    state checkpoints to `<idx minus .idx>.ldb`; restart = snapshot read +
+    replay of only the idx tail past the snapshot's watermark."""
+
+    CHECKPOINT_EVERY = 100_000  # appends between automatic checkpoints
+
+    def __init__(self, index_path: str, replay: bool = True):
+        self.snapshot_path = os.path.splitext(index_path)[0] + ".ldb"
+        self._since_checkpoint = 0
+        self._loaded_from_snapshot = False
+        super().__init__(index_path, replay=False)
+        if replay:
+            self._load_with_snapshot()
+
+    @classmethod
+    def load(cls, index_path: str) -> "CheckpointedNeedleMap":
+        return cls(index_path, replay=True)
+
+    def _load_with_snapshot(self) -> None:
+        idx_size = (os.path.getsize(self.index_path)
+                    if os.path.exists(self.index_path) else 0)
+        watermark = 0
+        if os.path.exists(self.snapshot_path):
+            try:
+                watermark = self._read_snapshot()
+                self._loaded_from_snapshot = True
+            except Exception:
+                watermark = 0  # corrupt snapshot: fall back to full replay
+        if watermark > idx_size:
+            # idx was truncated (torn-write fix) below the snapshot: the
+            # snapshot describes a future that no longer exists
+            self._sections, self._section_maxes = [], []
+            self._tail_k, self._tail_o, self._tail_s = [], [], []
+            self._over = {}
+            self.file_counter = self.file_byte_counter = 0
+            self.deletion_counter = self.deletion_byte_counter = 0
+            self.max_file_key = 0
+            watermark = 0
+            self._loaded_from_snapshot = False
+        if idx_size > watermark and os.path.exists(self.index_path):
+            with open(self.index_path, "rb") as f:
+                f.seek(watermark)
+                tail = f.read(idx_size - watermark)
+            # replay the tail through the scalar path: events must apply
+            # over snapshot state, not as an independent vectorized pass
+            for e in idx_mod.parse_entries(tail):
+                key, units, size = int(e["key"]), int(e["offset"]), int(e["size"])
+                self.max_file_key = max(self.max_file_key, key)
+                old = self.get(key)
+                if units != 0 and size_is_valid(size):
+                    self._set(key, units, size)
+                    self.file_counter += 1
+                    self.file_byte_counter += size
+                    if old is not None:
+                        self.deletion_counter += 1
+                        self.deletion_byte_counter += old.size
+                else:
+                    if old is not None:
+                        self._set(key, old.offset // NEEDLE_PADDING_SIZE,
+                                  TOMBSTONE_FILE_SIZE)
+                        self.deletion_byte_counter += old.size
+                    self.deletion_counter += 1
+
+    def _read_snapshot(self) -> int:
+        with open(self.snapshot_path, "rb") as f:
+            hdr = f.read(_LDB_HEADER.size)
+            magic, watermark, n, fc, fbc, dc, dbc, mfk = _LDB_HEADER.unpack(hdr)
+            if magic != _LDB_MAGIC:
+                raise ValueError("bad snapshot magic")
+            k = np.frombuffer(f.read(8 * n), dtype="<u8")
+            o = np.frombuffer(f.read(4 * n), dtype="<u4")
+            s = np.frombuffer(f.read(4 * n), dtype="<i4")
+            if len(k) != n or len(o) != n or len(s) != n:
+                raise ValueError("short snapshot")
+        self.file_counter, self.file_byte_counter = fc, fbc
+        self.deletion_counter, self.deletion_byte_counter = dc, dbc
+        self.max_file_key = mfk
+        self._install_arrays(k.astype(np.uint64), o.astype(np.uint32),
+                             s.astype(np.int32))
+        return watermark
+
+    def checkpoint(self) -> None:
+        """Atomically persist state + idx watermark (idx synced first so the
+        watermark can never describe bytes that aren't durable)."""
+        self.sync()
+        watermark = (os.path.getsize(self.index_path)
+                     if self.index_path and os.path.exists(self.index_path)
+                     else 0)
+        self._rebuild()  # fold tail+overflow into sections for a flat dump
+        ks = ([s.keys for s in self._sections]
+              or [np.empty(0, np.uint64)])
+        os_ = ([s.offs for s in self._sections]
+               or [np.empty(0, np.uint32)])
+        ss = ([s.sizes for s in self._sections]
+              or [np.empty(0, np.int32)])
+        k = np.concatenate(ks)
+        o = np.concatenate(os_)
+        s = np.concatenate(ss)
+        tmp = self.snapshot_path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(_LDB_HEADER.pack(
+                _LDB_MAGIC, watermark, len(k), self.file_counter,
+                self.file_byte_counter, self.deletion_counter,
+                self.deletion_byte_counter, self.max_file_key))
+            f.write(k.astype("<u8").tobytes())
+            f.write(o.astype("<u4").tobytes())
+            f.write(s.astype("<i4").tobytes())
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.snapshot_path)
+        self._since_checkpoint = 0
+
+    def _append_index(self, key: int, offset: int, size: int) -> None:
+        super()._append_index(key, offset, size)
+        self._since_checkpoint += 1
+        if self._since_checkpoint >= self.CHECKPOINT_EVERY:
+            self.checkpoint()
+
+    def close(self) -> None:
+        if self._index_file is not None:
+            self.checkpoint()
+        super().close()
+
+    def destroy(self) -> None:
+        super().destroy()
+        if os.path.exists(self.snapshot_path):
+            os.remove(self.snapshot_path)
+
+
+class SortedFileNeedleMap:
+    """sorted-file kind (needle_map_sorted_file.go): lookups binary-search
+    a sorted `.sdx` file with pread; nothing resident in memory.  For
+    read-only volumes (EC decode targets): put raises, delete negates the
+    entry's size in place and logs the tombstone to the `.idx`."""
+
+    def __init__(self, index_path: str):
+        from .needle_map import MemoryNeedleMap
+
+        self.index_path = index_path
+        self.sorted_path = os.path.splitext(index_path)[0] + ".sdx"
+        if not os.path.exists(self.sorted_path):
+            from .needle_map import MemDb
+
+            MemDb.from_idx_file(index_path).write_sorted_file(self.sorted_path)
+        self._f = open(self.sorted_path, "r+b")
+        self._n = os.path.getsize(self.sorted_path) // NEEDLE_MAP_ENTRY_SIZE
+        self._index_file = open(index_path, "ab")
+        # counters come from a one-shot scan of the sorted file
+        m = MemoryNeedleMap()
+        for nv in self:
+            if size_is_valid(nv.size):
+                m.put(nv.key, nv.offset, nv.size)
+        self.file_counter = m.file_counter
+        self.file_byte_counter = m.file_byte_counter
+        self.deletion_counter = 0
+        self.deletion_byte_counter = 0
+        self.max_file_key = m.max_file_key
+
+    @classmethod
+    def load(cls, index_path: str) -> "SortedFileNeedleMap":
+        return cls(index_path)
+
+    def _entry_at(self, i: int) -> tuple[int, int, int]:
+        buf = os.pread(self._f.fileno(), NEEDLE_MAP_ENTRY_SIZE,
+                       i * NEEDLE_MAP_ENTRY_SIZE)
+        e = idx_mod.parse_entries(buf)[0]
+        return int(e["key"]), int(e["offset"]), int(e["size"])
+
+    def _search(self, key: int) -> int:
+        lo, hi = 0, self._n
+        while lo < hi:
+            mid = (lo + hi) // 2
+            k, _, _ = self._entry_at(mid)
+            if k < key:
+                lo = mid + 1
+            else:
+                hi = mid
+        if lo < self._n and self._entry_at(lo)[0] == key:
+            return lo
+        return -1
+
+    def get(self, key: int) -> Optional[NeedleValue]:
+        i = self._search(key)
+        if i < 0:
+            return None
+        _, units, size = self._entry_at(i)
+        if units == 0 or not size_is_valid(size):
+            return None
+        return NeedleValue(key, units * NEEDLE_PADDING_SIZE, size)
+
+    def put(self, key: int, offset: int, size: int) -> None:
+        raise PermissionError(
+            "sorted-file needle map is read-only (needle_map_sorted_file.go)")
+
+    def delete(self, key: int, tombstone_offset: int) -> None:
+        i = self._search(key)
+        if i >= 0:
+            k, units, size = self._entry_at(i)
+            if size_is_valid(size):
+                # mark deleted in place: size -> -size (or tombstone for 0)
+                newsize = -size if size > 0 else TOMBSTONE_FILE_SIZE
+                self._f.seek(i * NEEDLE_MAP_ENTRY_SIZE)
+                self._f.write(idx_mod.pack_entry(
+                    k, units * NEEDLE_PADDING_SIZE, newsize))
+                self._f.flush()
+                self.deletion_counter += 1
+                self.deletion_byte_counter += size
+        self._index_file.write(idx_mod.pack_entry(
+            key, tombstone_offset, TOMBSTONE_FILE_SIZE))
+        self._index_file.flush()
+
+    def __iter__(self) -> Iterator[NeedleValue]:
+        for i in range(self._n):
+            k, units, size = self._entry_at(i)
+            yield NeedleValue(k, units * NEEDLE_PADDING_SIZE, size)
+
+    def ascending_visit(self, fn: Callable[[NeedleValue], None]) -> None:
+        for nv in self:
+            if size_is_valid(nv.size):
+                fn(nv)
+
+    def __len__(self) -> int:
+        return sum(1 for nv in self if size_is_valid(nv.size))
+
+    @property
+    def content_size(self) -> int:
+        return self.file_byte_counter
+
+    def sync(self) -> None:
+        self._f.flush()
+        os.fsync(self._f.fileno())
+        self._index_file.flush()
+        os.fsync(self._index_file.fileno())
+
+    def close(self) -> None:
+        if self._index_file is not None:
+            self._index_file.close()
+            self._index_file = None
+        if self._f is not None:
+            self._f.close()
+            self._f = None
+
+    def destroy(self) -> None:
+        self.close()
+        for p in (self.index_path, self.sorted_path):
+            if os.path.exists(p):
+                os.remove(p)

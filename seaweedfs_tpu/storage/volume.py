@@ -19,6 +19,18 @@ from typing import Callable, Optional
 from .backend import DiskFile, RemoteFile, get_backend
 from .needle import Needle, get_actual_size, needle_body_length
 from .needle_map import MemoryNeedleMap, NeedleValue
+from .needle_map_compact import (
+    CheckpointedNeedleMap,
+    CompactNeedleMap,
+    SortedFileNeedleMap,
+)
+
+_NEEDLE_MAP_KINDS = {
+    "memory": MemoryNeedleMap,
+    "compact": CompactNeedleMap,
+    "ldb": CheckpointedNeedleMap,
+    "sorted": SortedFileNeedleMap,
+}
 from .super_block import SUPER_BLOCK_SIZE, ReplicaPlacement, SuperBlock
 from .ttl import TTL
 from .volume_info import (RemoteFileInfo, VolumeInfo, maybe_load_volume_info,
@@ -55,13 +67,18 @@ class Volume:
                  replica_placement: ReplicaPlacement | None = None,
                  ttl: TTL | None = None,
                  version: Version = Version.V3,
-                 volume_size_limit: int = 30 * 1000 * 1000 * 1000):
+                 volume_size_limit: int = 30 * 1000 * 1000 * 1000,
+                 needle_map_kind: str = "compact"):
         self.directory = directory
         os.makedirs(directory, exist_ok=True)
         self.collection = collection
         self.id = vid
         self.version = version
         self.volume_size_limit = volume_size_limit
+        # needle-map kind (storage/needle_map.go:13-20): "compact" (numpy
+        # sections, default), "memory" (plain dict), "ldb" (checkpointed —
+        # restart replays only the idx tail), "sorted" (on-disk .sdx)
+        self.needle_map_kind = needle_map_kind
         self.read_only = False
         self.last_append_at_ns = 0
         self.last_modified_ts_seconds = 0
@@ -121,7 +138,8 @@ class Volume:
             self.version = self.super_block.version
         if not self.tiered:
             self._check_integrity()
-        self.nm = MemoryNeedleMap.load(self.idx_path)
+        self.nm = _NEEDLE_MAP_KINDS.get(
+            self.needle_map_kind, MemoryNeedleMap).load(self.idx_path)
 
     def _entry_is_healthy(self, key: int, offset: int, size: int, dat_size: int) -> bool:
         """Does this idx entry point at a fully-written, matching needle?"""
@@ -223,7 +241,8 @@ class Volume:
         except Exception:              # of the remote key) is removed
             pass
         self.close()
-        for ext in (".dat", ".idx", ".vif", ".cpd", ".cpx", ".note"):
+        for ext in (".dat", ".idx", ".vif", ".cpd", ".cpx", ".note",
+                    ".ldb", ".sdx"):
             p = self.file_prefix + ext
             if os.path.exists(p):
                 os.remove(p)
@@ -548,6 +567,12 @@ class Volume:
                 self.close()
                 os.replace(cpd, self.dat_path)
                 os.replace(cpx, self.idx_path)
+                # the compacted .idx is a different history: a surviving
+                # .ldb snapshot (watermark into the OLD idx) must never be
+                # applied over it
+                snap = self.file_prefix + ".ldb"
+                if os.path.exists(snap):
+                    os.remove(snap)
                 self._load_or_create()
         finally:
             self._unpark_worker()
