@@ -39,7 +39,10 @@ class MasterServer:
                  garbage_threshold: float = 0.3,
                  pulse_seconds: float = 5.0,
                  guard: Optional[Guard] = None,
-                 peers: Optional[list[str]] = None, mdir: str = ""):
+                 peers: Optional[list[str]] = None, mdir: str = "",
+                 vacuum_scan_seconds: float = 900.0,
+                 maintenance_scripts: str = "",
+                 maintenance_interval_seconds: float = 900.0):
         self.host, self.port = host, port
         self.guard = guard or Guard()
         self.topo = Topology(volume_size_limit_mb * 1024 * 1024, pulse_seconds)
@@ -64,6 +67,16 @@ class MasterServer:
         self._register_routes()
         self._server = None
         self._stop = threading.Event()
+        # periodic maintenance (topology_event_handling.go ticker +
+        # master_server.go:212 startAdminScripts): leader-only background
+        # vacuum scans and scripted shell commands
+        self.vacuum_scan_seconds = vacuum_scan_seconds
+        self.maintenance_scripts = [
+            line.strip() for line in maintenance_scripts.splitlines()
+            if line.strip() and not line.strip().startswith("#")]
+        self.maintenance_interval_seconds = maintenance_interval_seconds
+        self.maintenance_runs = 0       # observability for tests/status
+        self.maintenance_errors: list[str] = []
         # admin lock (shell exclusivity)
         self._admin_token: Optional[int] = None
         self._admin_lock_ts = 0.0
@@ -79,6 +92,12 @@ class MasterServer:
         self.raft.start()
         threading.Thread(target=self._janitor_loop, daemon=True,
                          name="master-janitor").start()
+        if self.vacuum_scan_seconds > 0:
+            threading.Thread(target=self._vacuum_scan_loop, daemon=True,
+                             name="master-vacuum-scan").start()
+        if self.maintenance_scripts:
+            threading.Thread(target=self._maintenance_loop, daemon=True,
+                             name="master-maintenance").start()
         return self
 
     def stop(self) -> None:
@@ -140,6 +159,48 @@ class MasterServer:
         while not self._stop.wait(self.topo.pulse_seconds):
             for node in self.topo.dead_nodes():
                 self.topo.unregister_node(node)
+
+    def _vacuum_scan_loop(self) -> None:
+        """Periodic garbage scan (topology_event_handling.go ticker): the
+        leader checks every volume's garbage ratio and compacts those past
+        the threshold — repair cadence without operator involvement."""
+        while not self._stop.wait(self.vacuum_scan_seconds):
+            if not self.is_leader:
+                continue
+            try:
+                self.vacuum(self.garbage_threshold)
+            except Exception as e:  # keep scanning; surface in /dir/status
+                self._note_maintenance_error(f"vacuum-scan: {e}")
+
+    def _maintenance_loop(self) -> None:
+        """master.maintenance scripts (master_server.go:212-263): run the
+        configured shell command lines on the leader under the admin lock."""
+        while not self._stop.wait(self.maintenance_interval_seconds):
+            if not self.is_leader:
+                continue
+            # package import registers every command family
+            from ..shell import CommandEnv, run_command
+
+            env = CommandEnv(self.url)
+            try:
+                env.lock()
+                for line in self.maintenance_scripts:
+                    try:
+                        run_command(env, line)
+                    except Exception as e:
+                        self._note_maintenance_error(f"{line!r}: {e}")
+                self.maintenance_runs += 1
+            except Exception as e:
+                self._note_maintenance_error(f"lock: {e}")
+            finally:
+                try:
+                    env.unlock()
+                except Exception:
+                    pass
+
+    def _note_maintenance_error(self, msg: str) -> None:
+        self.maintenance_errors.append(msg)
+        del self.maintenance_errors[:-20]  # keep the most recent few
 
     # --- routes -----------------------------------------------------------
     def _register_routes(self) -> None:
@@ -272,6 +333,32 @@ class MasterServer:
                     else None
                 return Response({"leader": known, "not_leader": True})
             self.metrics.received_heartbeats.inc("total")
+            if hb.get("delta"):
+                # incremental pulse (master_grpc_server.go:21-180 delta
+                # branch): only valid against a node we already know — a
+                # fresh leader must ask for a full resync first
+                node = self.topo.find_node(hb["ip"], int(hb["port"]))
+                if node is None:
+                    return Response({"resync": True, "leader": self.url})
+                self.topo.apply_volume_deltas(
+                    node,
+                    [VolumeInfo.from_dict(v)
+                     for v in hb.get("new_volumes", [])],
+                    [int(v) for v in hb.get("deleted_volumes", [])])
+                self.topo.apply_ec_deltas(
+                    node,
+                    [EcVolumeInfo(int(e["volume_id"]),
+                                  e.get("collection", ""),
+                                  ShardBits(int(e["ec_index_bits"])))
+                     for e in hb.get("new_ec_shards", [])],
+                    [int(v) for v in hb.get("deleted_ec_shards", [])])
+                max_key = max((int(v.get("max_file_key", 0))
+                               for v in hb.get("new_volumes", [])), default=0)
+                if max_key:
+                    self.seq.set_max(max_key)
+                return Response({
+                    "volumeSizeLimit": self.topo.volume_size_limit,
+                    "leader": self.url})
             node = self.topo.register_node(
                 hb["ip"], int(hb["port"]), hb.get("public_url", ""),
                 hb.get("data_center") or "DefaultDataCenter",

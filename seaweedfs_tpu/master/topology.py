@@ -275,6 +275,56 @@ class Topology:
                     .get_or_create_rack(rack)
                     .get_or_create_node(ip, port, public_url, max_volume_count))
 
+    def find_node(self, ip: str, port: int) -> Optional[DataNode]:
+        """Known node lookup for delta heartbeats: a delta from an unknown
+        node means THIS master is missing state (restart / new leader) and
+        must request a full resync instead of guessing."""
+        with self.lock:
+            for dc in self.data_centers.values():
+                for rack in dc.racks.values():
+                    for node in rack.nodes.values():
+                        if node.ip == ip and node.port == port:
+                            return node
+        return None
+
+    def apply_volume_deltas(self, node: DataNode,
+                            new_volumes: list[VolumeInfo],
+                            deleted_vids: list[int]) -> None:
+        """Incremental heartbeat ingest (master_grpc_server.go delta branch):
+        new/changed volumes register, deleted ones unregister — no full-list
+        diffing, O(changes) instead of O(volumes)."""
+        with self.lock:
+            for vid in deleted_vids:
+                old = node.volumes.pop(vid, None)
+                if old is not None:
+                    self._layout_for_volume(old).unregister(vid, node)
+                    self._emit_location(vid, node, "del")
+            for v in new_volumes:
+                if v.id not in node.volumes:
+                    self._emit_location(v.id, node, "add")
+                node.volumes[v.id] = v
+                self.max_volume_id = max(self.max_volume_id, v.id)
+                self._layout_for_volume(v).register(v, node)
+            node.last_seen = time.time()
+
+    def apply_ec_deltas(self, node: DataNode,
+                        new_ec: list[EcVolumeInfo],
+                        deleted_vids: list[int]) -> None:
+        with self.lock:
+            for vid in deleted_vids:
+                old = node.ec_shards.pop(vid, None)
+                if old is not None:
+                    self._unregister_ec(old, node)
+            for e in new_ec:
+                old = node.ec_shards.get(e.volume_id)
+                if old is not None:
+                    if old.shard_bits.bits == e.shard_bits.bits:
+                        continue
+                    self._unregister_ec(old, node)
+                node.ec_shards[e.volume_id] = e
+                self._register_ec(e, node)
+            node.last_seen = time.time()
+
     def get_layout(self, collection: str, rp: ReplicaPlacement,
                    ttl: TTL) -> VolumeLayout:
         key = layout_key(collection, rp, ttl)

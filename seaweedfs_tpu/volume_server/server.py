@@ -55,7 +55,8 @@ class VolumeServer:
                  rack: str = "", max_volume_count: int = 8,
                  pulse_seconds: float = 5.0, ec_engine: str = "cpu",
                  guard: Optional["Guard"] = None,
-                 backends: Optional[dict] = None):
+                 backends: Optional[dict] = None,
+                 full_sync_every: int = 12):
         from ..security import Guard
 
         if backends:
@@ -68,6 +69,9 @@ class VolumeServer:
         self.data_center = data_center
         self.rack = rack
         self.pulse_seconds = pulse_seconds
+        # delta heartbeats between full syncs; every Nth pulse resends the
+        # whole state so stat drift (sizes, counters) converges
+        self.full_sync_every = max(1, full_sync_every)
         self.guard = guard or Guard()
         self.store = Store(directories, host, port, public_url,
                            max_volume_count, ec_engine=ec_engine)
@@ -100,32 +104,63 @@ class VolumeServer:
         self.store.close()
 
     def _heartbeat_loop(self) -> None:
+        """Full state on the first pulse (and every full_sync_every after,
+        or when the master asks to resync); tiny DELTA heartbeats in
+        between (volume_grpc_client_to_master.go:48-120 streams incremental
+        new/deleted lists instead of O(volumes) payloads every pulse)."""
+        pulse = 0
         while not self._stop.is_set():
+            full = pulse % self.full_sync_every == 0
+            delta = None
+            if full:
+                payload = self.heartbeat_payload()
+            else:
+                delta = self.store.pop_heartbeat_delta()
+                payload = {"ip": self.store.ip, "port": self.store.port,
+                           "public_url": self.store.public_url,
+                           "delta": True, **(delta or {})}
             try:
                 resp = http_json("POST", f"http://{self.master_url}/heartbeat",
-                                 self.heartbeat_payload(),
+                                 payload,
                                  timeout=max(3.0, self.pulse_seconds * 2))
                 if resp.get("not_leader"):
+                    if delta:
+                        self.store.requeue_heartbeat_delta(delta)
                     leader = resp.get("leader")
                     if leader and leader != self.master_url:
-                        # follower redirect: re-target without waiting
+                        # follower redirect: re-target without waiting, and
+                        # open with a full sync (the new leader may be fresh)
                         self.master_url = leader
+                        pulse = 0
                         continue
                     # leaderless cluster: rotate and wait out the pulse
                     if len(self.masters) > 1:
                         i = (self.masters.index(self.master_url) + 1) \
                             if self.master_url in self.masters else 0
                         self.master_url = self.masters[i % len(self.masters)]
+                    pulse = 0
                     self._stop.wait(self.pulse_seconds)
+                    continue
+                if resp.get("resync"):
+                    # master doesn't know us (restart): full sync right away
+                    if delta:
+                        self.store.requeue_heartbeat_delta(delta)
+                    pulse = 0
                     continue
                 self.store.volume_size_limit = int(
                     resp.get("volumeSizeLimit", self.store.volume_size_limit))
             except Exception:
+                if delta:
+                    self.store.requeue_heartbeat_delta(delta)
                 # master down: rotate through the configured list
                 if len(self.masters) > 1:
                     i = (self.masters.index(self.master_url) + 1) \
                         if self.master_url in self.masters else 0
                     self.master_url = self.masters[i % len(self.masters)]
+                pulse = 0
+                self._stop.wait(self.pulse_seconds)
+                continue
+            pulse += 1
             self._stop.wait(self.pulse_seconds)
 
     def heartbeat_payload(self) -> dict:
@@ -519,8 +554,12 @@ class VolumeServer:
         @r.route("POST", "/admin/readonly")
         def readonly(req: Request) -> Response:
             b = req.json()
-            self.store.get_volume(int(b["volume_id"])).read_only = bool(
+            vid = int(b["volume_id"])
+            self.store.get_volume(vid).read_only = bool(
                 b.get("readonly", True))
+            # writable-set change must reach the master within one pulse,
+            # not wait for the next periodic full sync
+            self.store.note_volume_change(vid)
             return Response({})
 
         # --- admin: vacuum -------------------------------------------

@@ -84,6 +84,14 @@ class Store:
         self.volume_size_limit = 30 * 1000 * 1000 * 1000
         self.ec_engine_name = ec_engine
         self._rs_cache: dict[str, ReedSolomon] = {}
+        # delta-heartbeat bookkeeping (volume_grpc_client_to_master.go:48
+        # streams incremental new/deleted volume + EC-shard lists between
+        # periodic full syncs)
+        self._delta_lock = threading.Lock()
+        self._new_vids: set[int] = set()
+        self._gone_vids: set[int] = set()
+        self._new_ec_vids: set[int] = set()
+        self._gone_ec_vids: set[int] = set()
         self.load_existing()
 
     # --- engine selection (-ec.engine={cpu,tpu}) --------------------------
@@ -115,6 +123,7 @@ class Store:
                    volume_size_limit=self.volume_size_limit)
         self.volumes[vid] = v
         self.volume_locks[vid] = threading.RLock()
+        self.note_volume_change(vid)
         return v
 
     def _open_ec_volume(self, directory: str, collection: str, vid: int) -> EcVolume:
@@ -122,7 +131,75 @@ class Store:
         ev = EcVolume(base, vid)
         self.ec_volumes[vid] = ev
         self.ec_collections[vid] = collection
+        self.note_ec_change(vid)
         return ev
+
+    # --- delta heartbeat ---------------------------------------------------
+    def note_volume_change(self, vid: int, gone: bool = False) -> None:
+        with self._delta_lock:
+            if gone:
+                self._new_vids.discard(vid)
+                self._gone_vids.add(vid)
+            else:
+                self._gone_vids.discard(vid)
+                self._new_vids.add(vid)
+
+    def note_ec_change(self, vid: int, gone: bool = False) -> None:
+        with self._delta_lock:
+            if gone:
+                self._new_ec_vids.discard(vid)
+                self._gone_ec_vids.add(vid)
+            else:
+                self._gone_ec_vids.discard(vid)
+                self._new_ec_vids.add(vid)
+
+    def pop_heartbeat_delta(self) -> Optional[dict]:
+        """Pending changes since the last pop, as an incremental heartbeat
+        body; None when nothing changed.  On send failure the caller must
+        requeue_heartbeat_delta so no change is ever lost."""
+        from ..master.topology import ShardBits
+
+        with self._delta_lock:
+            if not (self._new_vids or self._gone_vids
+                    or self._new_ec_vids or self._gone_ec_vids):
+                return None
+            new_vids, self._new_vids = self._new_vids, set()
+            gone_vids, self._gone_vids = self._gone_vids, set()
+            new_ec, self._new_ec_vids = self._new_ec_vids, set()
+            gone_ec, self._gone_ec_vids = self._gone_ec_vids, set()
+        new_volumes = []
+        for vid in sorted(new_vids):
+            v = self.volumes.get(vid)
+            if v is None:  # raced with a delete after the note
+                gone_vids.add(vid)
+            else:
+                new_volumes.append(v.to_volume_information())
+        new_ec_shards = []
+        for vid in sorted(new_ec):
+            ev = self.ec_volumes.get(vid)
+            if ev is None:
+                gone_ec.add(vid)
+            else:
+                bits = ShardBits()
+                for sid in ev.shards:
+                    bits = bits.add(sid)
+                new_ec_shards.append({
+                    "volume_id": vid,
+                    "collection": self.ec_collections.get(vid, ""),
+                    "ec_index_bits": bits.bits})
+        return {"new_volumes": new_volumes,
+                "deleted_volumes": sorted(gone_vids),
+                "new_ec_shards": new_ec_shards,
+                "deleted_ec_shards": sorted(gone_ec)}
+
+    def requeue_heartbeat_delta(self, delta: dict) -> None:
+        with self._delta_lock:
+            for v in delta.get("new_volumes", []):
+                self._new_vids.add(int(v["id"]))
+            self._gone_vids.update(delta.get("deleted_volumes", []))
+            for e in delta.get("new_ec_shards", []):
+                self._new_ec_vids.add(int(e["volume_id"]))
+            self._gone_ec_vids.update(delta.get("deleted_ec_shards", []))
 
     # --- volume admin -----------------------------------------------------
     def add_volume(self, vid: int, collection: str = "",
@@ -145,12 +222,14 @@ class Store:
         self.volume_locks.pop(vid, None)
         if v is not None:
             v.destroy()
+            self.note_volume_change(vid, gone=True)
 
     def unmount_volume(self, vid: int) -> None:
         v = self.volumes.pop(vid, None)
         self.volume_locks.pop(vid, None)
         if v is not None:
             v.close()
+            self.note_volume_change(vid, gone=True)
 
     def mount_volume(self, vid: int) -> None:
         for loc in self.locations:
@@ -174,17 +253,23 @@ class Store:
             # held while waiting, so concurrent fsync writers batch into one
             # fsync (writeNeedle2, volume_write.go:110-128)
             _, size, unchanged = v.write_needle2(n, fsync=True)
-            return size, unchanged
-        with self.volume_locks[vid]:
-            _, size, unchanged = v.write_needle(n)
+        else:
+            with self.volume_locks[vid]:
+                _, size, unchanged = v.write_needle(n)
+        # stats changed: the next delta pulse refreshes this volume's
+        # counters on the master (idle volumes cost nothing)
+        self.note_volume_change(vid)
         return size, unchanged
 
     def delete_needle(self, vid: int, n: Needle, fsync: bool = False) -> int:
         v = self.get_volume(vid)
         if fsync:
-            return v.delete_needle2(n, fsync=True)
-        with self.volume_locks[vid]:
-            return v.delete_needle(n)
+            size = v.delete_needle2(n, fsync=True)
+        else:
+            with self.volume_locks[vid]:
+                size = v.delete_needle(n)
+        self.note_volume_change(vid)
+        return size
 
     def read_needle(self, vid: int, key: int, cookie: Optional[int] = None) -> Needle:
         return self.get_volume(vid).read_needle(key, cookie)
@@ -245,6 +330,7 @@ class Store:
         self.ec_collections.pop(vid, None)
         if ev is not None:
             ev.close()
+            self.note_ec_change(vid, gone=True)
 
     def ec_delete_shards(self, vid: int, shard_ids: list[int],
                          collection: str = "") -> None:

@@ -1,0 +1,156 @@
+"""Delta heartbeats + periodic master maintenance.
+
+The gate: between periodic full syncs a volume server sends O(changes)
+delta pulses that keep the master's topology exact (add/remove volumes,
+EC shard movement), an unknown node's delta triggers a full resync, and
+the leader runs vacuum scans / maintenance scripts on its own cadence.
+"""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from seaweedfs_tpu.client.operation import WeedClient
+from seaweedfs_tpu.master.server import MasterServer
+from seaweedfs_tpu.utils.httpd import http_json
+from seaweedfs_tpu.volume_server.server import VolumeServer
+from tests.conftest import free_port
+
+
+def _wait(cond, timeout=5.0, every=0.05):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(every)
+    return cond()
+
+
+@pytest.fixture
+def pair(tmp_path):
+    master = MasterServer(port=free_port(), volume_size_limit_mb=64,
+                          pulse_seconds=0.2).start()
+    d = tmp_path / "vs"
+    d.mkdir()
+    vs = VolumeServer([str(d)], master.url, port=free_port(),
+                      max_volume_count=10, pulse_seconds=0.2,
+                      full_sync_every=1000).start()  # deltas only after #1
+    assert _wait(lambda: len(master.topo.all_nodes()) == 1)
+    yield master, vs
+    vs.stop()
+    master.stop()
+
+
+def test_delta_heartbeat_propagates_changes(pair):
+    master, vs = pair
+    node = master.topo.all_nodes()[0]
+    # grow a volume through the master: the VS learns via the allocate RPC,
+    # and the MASTER topo must converge via a DELTA pulse (full sync is
+    # effectively disabled by full_sync_every=1000)
+    http_json("GET", f"http://{master.url}/vol/grow?count=1")
+    assert _wait(lambda: len(node.volumes) >= 1)
+
+    # local unmount (not via master RPC): only the delta can tell the master
+    vid = next(iter(vs.store.volumes))
+    vs.store.unmount_volume(vid)
+    assert _wait(lambda: vid not in node.volumes)
+
+    # remount: delta again
+    vs.store.mount_volume(vid)
+    assert _wait(lambda: vid in node.volumes)
+
+
+def test_delta_payload_is_small_and_delta_flagged(pair):
+    master, vs = pair
+    assert vs.store.pop_heartbeat_delta() is None or True  # drain
+    vs.store.pop_heartbeat_delta()
+    assert vs.store.pop_heartbeat_delta() is None  # no changes -> no body
+    vs.store.note_volume_change(12345, gone=True)
+    d = vs.store.pop_heartbeat_delta()
+    assert d == {"new_volumes": [], "deleted_volumes": [12345],
+                 "new_ec_shards": [], "deleted_ec_shards": []}
+    # requeue merges back losslessly
+    vs.store.requeue_heartbeat_delta(d)
+    assert vs.store.pop_heartbeat_delta()["deleted_volumes"] == [12345]
+
+
+def test_unknown_node_delta_gets_resync(pair):
+    master, vs = pair
+    resp = http_json("POST", f"http://{master.url}/heartbeat",
+                     {"ip": "10.9.9.9", "port": 1234, "delta": True,
+                      "new_volumes": [], "deleted_volumes": [],
+                      "new_ec_shards": [], "deleted_ec_shards": []})
+    assert resp.get("resync") is True
+
+
+def test_master_restart_converges_via_resync(tmp_path):
+    mport = free_port()
+    master = MasterServer(port=mport, volume_size_limit_mb=64,
+                          pulse_seconds=0.2).start()
+    d = tmp_path / "vs"
+    d.mkdir()
+    vs = VolumeServer([str(d)], master.url, port=free_port(),
+                      max_volume_count=10, pulse_seconds=0.2,
+                      full_sync_every=1000).start()
+    try:
+        assert _wait(lambda: len(master.topo.all_nodes()) == 1)
+        http_json("GET", f"http://{master.url}/vol/grow?count=1")
+        assert _wait(lambda: sum(
+            len(n.volumes) for n in master.topo.all_nodes()) >= 1)
+        master.stop()
+        # fresh master, same address: first delta pulse must be answered
+        # with resync and the follow-up full sync restores the volumes
+        master2 = MasterServer(port=mport, volume_size_limit_mb=64,
+                               pulse_seconds=0.2).start()
+        try:
+            assert _wait(lambda: sum(
+                len(n.volumes) for n in master2.topo.all_nodes()) >= 1,
+                timeout=8.0)
+        finally:
+            master2.stop()
+    finally:
+        vs.stop()
+
+
+def test_vacuum_scan_loop_compacts_garbage(tmp_path):
+    master = MasterServer(port=free_port(), volume_size_limit_mb=64,
+                          pulse_seconds=0.2, garbage_threshold=0.3,
+                          vacuum_scan_seconds=0.5).start()
+    d = tmp_path / "vs"
+    d.mkdir()
+    vs = VolumeServer([str(d)], master.url, port=free_port(),
+                      max_volume_count=10, pulse_seconds=0.2).start()
+    try:
+        assert _wait(lambda: len(master.topo.all_nodes()) == 1)
+        client = WeedClient(master.url)
+        fids = [client.upload(b"g" * 2000, name=f"f{i}") for i in range(10)]
+        for fid in fids[:8]:
+            client.delete(fid)
+        vs.heartbeat_now()
+        vid = next(iter(vs.store.volumes))
+        v = vs.store.volumes[vid]
+        before = v.data_size
+        # the scan loop (no operator trigger!) must compact within ~2 ticks
+        assert _wait(lambda: vs.store.volumes[vid].data_size < before,
+                     timeout=6.0)
+    finally:
+        vs.stop()
+        master.stop()
+
+
+def test_maintenance_scripts_run_on_leader(tmp_path):
+    master = MasterServer(port=free_port(), volume_size_limit_mb=64,
+                          pulse_seconds=0.2,
+                          maintenance_scripts="volume.list\n# comment\n",
+                          maintenance_interval_seconds=0.4).start()
+    try:
+        assert _wait(lambda: master.maintenance_runs >= 2, timeout=6.0)
+        assert master.maintenance_errors == []
+        # the admin lock is released between runs: an operator can lock
+        r = http_json("POST", f"http://{master.url}/admin/lease",
+                      {"client_name": "op", "previous_token": None})
+        assert "token" in r
+    finally:
+        master.stop()
