@@ -19,6 +19,7 @@ from typing import Callable, Iterator, Optional
 
 from .entry import Attr, Entry, new_directory_entry
 from .filer_store import FilerStore, MemoryStore
+from .filerstore_hardlink import HardLinkAwareStore
 
 LOG_DIR = "/topics/.system/log"
 
@@ -38,7 +39,9 @@ class NotEmptyError(FilerError):
 class Filer:
     def __init__(self, store: Optional[FilerStore] = None,
                  delete_chunks_fn: Optional[Callable[[list[str]], None]] = None):
-        self.store = store or MemoryStore()
+        # every store rides the hardlink wrapper (filerstore_hardlink.go):
+        # entries carrying a hard_link_id resolve through KV content records
+        self.store = HardLinkAwareStore(store or MemoryStore())
         self._lock = threading.RLock()
         self._delete_chunks_fn = delete_chunks_fn
         # set by FilerServer: expands manifest chunks so GC reclaims the
@@ -84,7 +87,7 @@ class Filer:
                         f"{entry.full_path}: existing entry is a {kind}")
                 # overwritten file: old chunks become garbage
                 if not old.is_directory:
-                    self._collect_chunks(old, keep=entry.chunks)
+                    self._release_entry(old, keep=entry.chunks)
             self.store.insert_entry(entry)
         self._notify("create" if old is None else "update", old, entry)
         return entry
@@ -144,7 +147,7 @@ class Filer:
                 self._delete_tree(path)
                 self.store.delete_folder_children(path)
             else:
-                self._collect_chunks(entry)
+                self._release_entry(entry)
             self.store.delete_entry(path)
         self._notify("delete", entry, None)
 
@@ -158,7 +161,7 @@ class Filer:
                 if child.is_directory:
                     self._delete_tree(child.full_path)
                 else:
-                    self._collect_chunks(child)
+                    self._release_entry(child)
                 self._notify("delete", child, None)
             start = batch[-1].name
 
@@ -174,6 +177,46 @@ class Filer:
             if child.is_directory:
                 yield from self.iterate_tree(child.full_path)
 
+    # --- hardlinks (filerstore_hardlink.go) -------------------------------
+    def _release_entry(self, entry: Entry, keep: list = ()) -> None:
+        """Drop one reference to a file's chunks: hardlinked entries GC
+        only when the LAST link goes away."""
+        if entry.hard_link_id:
+            if self.store.adjust_counter(entry.hard_link_id, -1) == 0:
+                self._collect_chunks(entry, keep=keep)
+        else:
+            self._collect_chunks(entry, keep=keep)
+
+    def hardlink(self, target_path: str, link_path: str) -> Entry:
+        """Create link_path sharing target's content record (the FUSE Link
+        op's filer side).  Both paths then resolve to one attr+chunks
+        record; deleting either just drops the counter."""
+        import secrets
+
+        target_path, link_path = _norm(target_path), _norm(link_path)
+        with self._lock:
+            target = self.find_entry(target_path)
+            if target.is_directory:
+                raise FilerError(f"{target_path}: cannot hardlink a directory")
+            if self.store.find_entry(link_path) is not None:
+                raise FilerError(f"{link_path} already exists")
+            self._ensure_parents(link_path.rsplit("/", 1)[0] or "/")
+            if not target.hard_link_id:
+                # first link: migrate the content into the shared record
+                target.hard_link_id = secrets.token_hex(8)
+                target.hard_link_counter = 2
+            else:
+                target.hard_link_counter = self.store.link_counter(
+                    target.hard_link_id) + 1
+            self.store.update_entry(target)  # saves content w/ new counter
+            link = Entry(full_path=link_path, attr=target.attr,
+                         chunks=target.chunks,
+                         hard_link_id=target.hard_link_id,
+                         hard_link_counter=target.hard_link_counter)
+            self.store.insert_entry(link)
+        self._notify("create", None, link)
+        return link
+
     # --- rename (filer_grpc_server_rename.go: atomic subtree move) --------
     def rename(self, old_path: str, new_path: str) -> Entry:
         old_path, new_path = _norm(old_path), _norm(new_path)
@@ -184,7 +227,7 @@ class Filer:
             entry = self.find_entry(old_path)
             existing = self.store.find_entry(new_path)
             if existing is not None and not existing.is_directory:
-                self._collect_chunks(existing)  # overwritten target's chunks
+                self._release_entry(existing)  # overwritten target's chunks
             self._ensure_parents(new_path.rsplit("/", 1)[0] or "/")
             moved = self._move_subtree(entry, old_path, new_path)
         return moved
@@ -285,6 +328,20 @@ class Filer:
         day = time.strftime("%Y-%m-%d", time.gmtime())
         key = f"{LOG_DIR}/{day}/{event['ts_ns']:020d}".encode()
         self.store.kv_put(key, json.dumps(event).encode())
+        with self._log_lock:
+            subs = list(self._subscribers)
+        for fn in subs:
+            try:
+                fn(event)
+            except Exception:
+                pass
+
+    def publish_peer_event(self, peer: str, event: dict) -> None:
+        """Fan a PEER filer's meta event into local subscribers
+        (meta_aggregator.go).  Not persisted locally — the peer owns its
+        history; re-persisting would duplicate events when tailed back."""
+        event = dict(event)
+        event["peer"] = peer
         with self._log_lock:
             subs = list(self._subscribers)
         for fn in subs:

@@ -1,0 +1,124 @@
+"""Hardlink indirection for any FilerStore.
+
+Equivalent of weed/filer/filerstore_hardlink.go: an entry with a
+hard_link_id stores its CONTENT (attr, chunks, counter) once in the KV
+space under a marker key; the per-path entry is just a pointer.  Every
+find resolves the pointer, so N links to one file share attributes and
+chunks, and chunk GC happens only when the last link goes away.
+
+The wrapper is transparent: entries without hard_link_id pass straight
+through to the underlying store.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Iterator, Optional
+
+from .entry import Entry
+
+HARDLINK_PREFIX = b"hardlink/"  # + hard_link_id -> content json
+
+
+def _content_key(hard_link_id: str) -> bytes:
+    return HARDLINK_PREFIX + hard_link_id.encode()
+
+
+class HardLinkAwareStore:
+    """FilerStore wrapper adding hardlink content indirection."""
+
+    def __init__(self, store):
+        self.store = store
+        self.name = getattr(store, "name", "store") + "+hardlink"
+
+    # --- content records --------------------------------------------------
+    def _save_content(self, entry: Entry) -> None:
+        content = {
+            "attr": entry.attr.to_dict(),
+            "chunks": [c.to_dict() for c in entry.chunks],
+            "hard_link_counter": entry.hard_link_counter,
+        }
+        self.store.kv_put(_content_key(entry.hard_link_id),
+                          json.dumps(content).encode())
+
+    def _load_content(self, entry: Entry) -> Entry:
+        blob = self.store.kv_get(_content_key(entry.hard_link_id))
+        if blob is None:  # dangling pointer: serve the pointer as-is
+            return entry
+        resolved = Entry.from_dict({
+            "full_path": entry.full_path,
+            **json.loads(blob.decode()),
+            "hard_link_id": entry.hard_link_id,
+        })
+        return resolved
+
+    def link_counter(self, hard_link_id: str) -> int:
+        blob = self.store.kv_get(_content_key(hard_link_id))
+        return json.loads(blob)["hard_link_counter"] if blob else 0
+
+    def adjust_counter(self, hard_link_id: str, delta: int) -> int:
+        """Returns the counter AFTER adjustment; at 0 the content record is
+        removed (the caller GCs the chunks it read beforehand)."""
+        key = _content_key(hard_link_id)
+        blob = self.store.kv_get(key)
+        if blob is None:
+            return 0
+        content = json.loads(blob)
+        content["hard_link_counter"] += delta
+        if content["hard_link_counter"] <= 0:
+            self.store.kv_delete(key)
+            return 0
+        self.store.kv_put(key, json.dumps(content).encode())
+        return content["hard_link_counter"]
+
+    # --- FilerStore surface ------------------------------------------------
+    def insert_entry(self, entry: Entry) -> None:
+        if entry.hard_link_id:
+            self._save_content(entry)
+            pointer = Entry(full_path=entry.full_path, attr=entry.attr,
+                            chunks=[], hard_link_id=entry.hard_link_id)
+            self.store.insert_entry(pointer)
+        else:
+            self.store.insert_entry(entry)
+
+    def update_entry(self, entry: Entry) -> None:
+        if entry.hard_link_id:
+            self._save_content(entry)
+            pointer = Entry(full_path=entry.full_path, attr=entry.attr,
+                            chunks=[], hard_link_id=entry.hard_link_id)
+            self.store.update_entry(pointer)
+        else:
+            self.store.update_entry(entry)
+
+    def find_entry(self, path: str) -> Optional[Entry]:
+        e = self.store.find_entry(path)
+        if e is not None and e.hard_link_id:
+            return self._load_content(e)
+        return e
+
+    def delete_entry(self, path: str) -> None:
+        self.store.delete_entry(path)
+
+    def delete_folder_children(self, path: str) -> None:
+        self.store.delete_folder_children(path)
+
+    def list_directory_entries(self, dir_path: str, start_file: str = "",
+                               include_start: bool = False, limit: int = 1000,
+                               prefix: str = "") -> Iterator[Entry]:
+        for e in self.store.list_directory_entries(dir_path, start_file,
+                                                   include_start, limit,
+                                                   prefix):
+            yield self._load_content(e) if e.hard_link_id else e
+
+    # --- kv passthrough ----------------------------------------------------
+    def kv_put(self, key: bytes, value: bytes) -> None:
+        self.store.kv_put(key, value)
+
+    def kv_get(self, key: bytes) -> Optional[bytes]:
+        return self.store.kv_get(key)
+
+    def kv_delete(self, key: bytes) -> None:
+        self.store.kv_delete(key)
+
+    def kv_scan(self, prefix: bytes):
+        return self.store.kv_scan(prefix)

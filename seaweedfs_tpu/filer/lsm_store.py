@@ -1,0 +1,302 @@
+"""LSM-tree filer store: WAL + memtable + sorted string tables.
+
+The reference's server-class embedded stores are goleveldb/rocksdb
+(weed/filer/leveldb*, filer/rocksdb — LSM trees).  This is the rebuild's
+own LSM over one keyspace:
+
+  - every mutation appends to a WAL (crash recovery) and lands in an
+    in-memory sorted dict (the memtable);
+  - at `memtable_limit` entries the memtable flushes to an immutable
+    SSTable file: sorted key/value records + a footer index, written
+    atomically (tmp+rename), then the WAL is truncated;
+  - lookups go memtable -> SSTables newest-first; range scans merge all
+    levels with last-writer-wins and tombstone suppression;
+  - when SSTables pile past `compact_trigger`, they merge into one.
+
+Keyspace layout (big-endian-sortable by design):
+  b"E" + dir + b"\\x00" + name  -> entry JSON   (directory scans are a
+                                  contiguous range: one dir, sorted names)
+  b"K" + user_key               -> kv blobs
+
+Entries are keyed (dir, name) rather than full path so that
+list_directory_entries is a single range scan, exactly the trick
+abstract_sql uses with its (dirhash, name) primary key.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+import threading
+from typing import Iterator, Optional
+
+from .entry import Entry
+
+TOMBSTONE = b"\x00__tombstone__"
+_LEN = struct.Struct(">II")
+
+
+def _entry_key(path: str) -> bytes:
+    if path == "/":
+        return b"E\x00/"
+    d, _, name = path.rstrip("/").rpartition("/")
+    return b"E" + (d or "/").encode() + b"\x00" + name.encode()
+
+
+def _dir_prefix(dir_path: str) -> bytes:
+    return b"E" + (dir_path.rstrip("/") or "/").encode() + b"\x00"
+
+
+class _SSTable:
+    """Immutable sorted table: [records][index][footer].  The key index
+    stays in memory (keys only); values pread on demand."""
+
+    def __init__(self, path: str):
+        self.path = path
+        with open(path, "rb") as f:
+            f.seek(-8, os.SEEK_END)
+            index_off = struct.unpack(">Q", f.read(8))[0]
+            f.seek(index_off)
+            blob = f.read()[:-8]
+        self.keys: list[bytes] = []
+        self.offsets: list[tuple[int, int]] = []  # (value_off, value_len)
+        pos = 0
+        while pos < len(blob):
+            klen, voff = struct.unpack_from(">IQ", blob, pos)
+            pos += 12
+            vlen = struct.unpack_from(">I", blob, pos)[0]
+            pos += 4
+            self.keys.append(blob[pos:pos + klen])
+            pos += klen
+            self.offsets.append((voff, vlen))
+        self._f = open(path, "rb")
+        self._lock = threading.Lock()
+
+    @classmethod
+    def write(cls, path: str, items: list[tuple[bytes, bytes]]) -> "_SSTable":
+        tmp = path + ".tmp"
+        index = bytearray()
+        with open(tmp, "wb") as f:
+            for k, v in items:
+                off = f.tell()
+                f.write(v)
+                index += struct.pack(">IQI", len(k), off, len(v)) + k
+            index_off = f.tell()
+            f.write(index)
+            f.write(struct.pack(">Q", index_off))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        return cls(path)
+
+    def _bisect(self, key: bytes) -> int:
+        lo, hi = 0, len(self.keys)
+        while lo < hi:
+            mid = (lo + hi) // 2
+            if self.keys[mid] < key:
+                lo = mid + 1
+            else:
+                hi = mid
+        return lo
+
+    def get(self, key: bytes) -> Optional[bytes]:
+        i = self._bisect(key)
+        if i < len(self.keys) and self.keys[i] == key:
+            off, vlen = self.offsets[i]
+            return os.pread(self._f.fileno(), vlen, off)
+        return None
+
+    def scan(self, prefix: bytes) -> Iterator[tuple[bytes, bytes]]:
+        i = self._bisect(prefix)
+        while i < len(self.keys) and self.keys[i].startswith(prefix):
+            off, vlen = self.offsets[i]
+            yield self.keys[i], os.pread(self._f.fileno(), vlen, off)
+            i += 1
+
+    def items(self) -> Iterator[tuple[bytes, bytes]]:
+        for i, k in enumerate(self.keys):
+            off, vlen = self.offsets[i]
+            yield k, os.pread(self._f.fileno(), vlen, off)
+
+    def close(self) -> None:
+        self._f.close()
+
+
+class LsmStore:
+    """FilerStore over the LSM; see module docstring."""
+
+    name = "lsm"
+
+    def __init__(self, directory: str, memtable_limit: int = 8192,
+                 compact_trigger: int = 8, fsync_wal: bool = False):
+        self.dir = directory
+        os.makedirs(directory, exist_ok=True)
+        self.memtable_limit = memtable_limit
+        self.compact_trigger = compact_trigger
+        self.fsync_wal = fsync_wal
+        self._lock = threading.RLock()
+        self._mem: dict[bytes, bytes] = {}
+        self._tables: list[_SSTable] = []  # oldest..newest
+        self._seq = 0
+        for fname in sorted(os.listdir(directory)):
+            if fname.endswith(".sst"):
+                self._tables.append(_SSTable(os.path.join(directory, fname)))
+                self._seq = max(self._seq, int(fname.split(".")[0]) + 1)
+        self._wal_path = os.path.join(directory, "wal.log")
+        self._replay_wal()
+        self._wal = open(self._wal_path, "ab")
+
+    # --- WAL ---------------------------------------------------------------
+    def _replay_wal(self) -> None:
+        if not os.path.exists(self._wal_path):
+            return
+        with open(self._wal_path, "rb") as f:
+            blob = f.read()
+        pos = 0
+        while pos + _LEN.size <= len(blob):
+            klen, vlen = _LEN.unpack_from(blob, pos)
+            end = pos + _LEN.size + klen + vlen
+            if end > len(blob):
+                break  # torn tail record: drop it
+            key = blob[pos + _LEN.size:pos + _LEN.size + klen]
+            val = blob[pos + _LEN.size + klen:end]
+            self._mem[key] = val
+            pos = end
+
+    def _wal_append(self, key: bytes, value: bytes) -> None:
+        self._wal.write(_LEN.pack(len(key), len(value)) + key + value)
+        self._wal.flush()
+        if self.fsync_wal:
+            os.fsync(self._wal.fileno())
+
+    # --- write path ---------------------------------------------------------
+    def _put(self, key: bytes, value: bytes) -> None:
+        with self._lock:
+            self._wal_append(key, value)
+            self._mem[key] = value
+            if len(self._mem) >= self.memtable_limit:
+                self._flush_memtable()
+
+    def _flush_memtable(self) -> None:
+        """Called under lock: memtable -> new SSTable, truncate WAL."""
+        if not self._mem:
+            return
+        items = sorted(self._mem.items())
+        path = os.path.join(self.dir, f"{self._seq:08d}.sst")
+        self._seq += 1
+        self._tables.append(_SSTable.write(path, items))
+        self._mem.clear()
+        self._wal.close()
+        self._wal = open(self._wal_path, "wb")  # truncate
+        if len(self._tables) >= self.compact_trigger:
+            self._compact()
+
+    def _compact(self) -> None:
+        """Merge every SSTable into one (newest wins), dropping tombstones
+        (full merge = the only level, so a tombstone has nothing older to
+        shadow)."""
+        merged: dict[bytes, bytes] = {}
+        for t in self._tables:  # oldest..newest: later overwrite earlier
+            for k, v in t.items():
+                merged[k] = v
+        items = [(k, v) for k, v in sorted(merged.items()) if v != TOMBSTONE]
+        path = os.path.join(self.dir, f"{self._seq:08d}.sst")
+        self._seq += 1
+        new_table = _SSTable.write(path, items)
+        old = self._tables
+        self._tables = [new_table]
+        for t in old:
+            t.close()
+            os.remove(t.path)
+
+    def flush(self) -> None:
+        with self._lock:
+            self._flush_memtable()
+
+    # --- read path ----------------------------------------------------------
+    def _get(self, key: bytes) -> Optional[bytes]:
+        with self._lock:
+            v = self._mem.get(key)
+            tables = list(self._tables)
+        if v is None:
+            for t in reversed(tables):  # newest first
+                v = t.get(key)
+                if v is not None:
+                    break
+        return None if v is None or v == TOMBSTONE else v
+
+    def _scan(self, prefix: bytes) -> Iterator[tuple[bytes, bytes]]:
+        """Merged ascending scan with last-writer-wins."""
+        with self._lock:
+            mem = {k: v for k, v in self._mem.items() if k.startswith(prefix)}
+            tables = list(self._tables)
+        merged: dict[bytes, bytes] = {}
+        for t in tables:
+            for k, v in t.scan(prefix):
+                merged[k] = v
+        merged.update(mem)
+        for k in sorted(merged):
+            if merged[k] != TOMBSTONE:
+                yield k, merged[k]
+
+    # --- FilerStore: entries -------------------------------------------------
+    def insert_entry(self, entry: Entry) -> None:
+        self._put(_entry_key(entry.full_path),
+                  json.dumps(entry.to_dict()).encode())
+
+    update_entry = insert_entry
+
+    def find_entry(self, path: str) -> Optional[Entry]:
+        blob = self._get(_entry_key(path))
+        return Entry.from_dict(json.loads(blob)) if blob else None
+
+    def delete_entry(self, path: str) -> None:
+        self._put(_entry_key(path), TOMBSTONE)
+
+    def delete_folder_children(self, path: str) -> None:
+        base = path.rstrip("/") or "/"
+        doomed = [k for k, _ in self._scan(_dir_prefix(base))]
+        # grandchildren live under deeper dir keys: scan the dir-name space
+        deep_prefix = b"E" + base.encode() + b"/"
+        doomed += [k for k, _ in self._scan(deep_prefix)]
+        for k in doomed:
+            self._put(k, TOMBSTONE)
+
+    def list_directory_entries(self, dir_path: str, start_file: str = "",
+                               include_start: bool = False, limit: int = 1000,
+                               prefix: str = "") -> Iterator[Entry]:
+        n = 0
+        for k, v in self._scan(_dir_prefix(dir_path)):
+            if n >= limit:
+                return
+            name = k.rsplit(b"\x00", 1)[1].decode()
+            if prefix and not name.startswith(prefix):
+                continue
+            if start_file:
+                if name < start_file or (name == start_file
+                                         and not include_start):
+                    continue
+            yield Entry.from_dict(json.loads(v))
+            n += 1
+
+    # --- FilerStore: kv ------------------------------------------------------
+    def kv_put(self, key: bytes, value: bytes) -> None:
+        self._put(b"K" + key, value)
+
+    def kv_get(self, key: bytes) -> Optional[bytes]:
+        return self._get(b"K" + key)
+
+    def kv_delete(self, key: bytes) -> None:
+        self._put(b"K" + key, TOMBSTONE)
+
+    def kv_scan(self, prefix: bytes):
+        for k, v in self._scan(b"K" + prefix):
+            yield k[1:], v
+
+    def close(self) -> None:
+        with self._lock:
+            self._flush_memtable()
+            self._wal.close()
+            for t in self._tables:
+                t.close()

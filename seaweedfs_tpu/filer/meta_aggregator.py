@@ -1,0 +1,87 @@
+"""MetaAggregator: leaderless multi-filer metadata merging.
+
+Equivalent of weed/filer/meta_aggregator.go: every filer tails its PEERS'
+meta logs (here the /api/meta/log poll endpoint) and folds their events
+into the local subscription stream, so a subscriber of ANY filer sees the
+cluster-wide mutation stream.  Loop prevention is by filer signature:
+events already stamped with the local filer's signature are its own echo
+and are skipped.  Per-peer cursors persist in the local store's KV space,
+so a filer restart resumes tailing where it left off instead of replaying
+a peer's whole history.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Optional
+
+from ..utils.httpd import http_json
+
+CURSOR_PREFIX = b"meta.aggregator.peer/"
+
+
+class MetaAggregator:
+    def __init__(self, filer, peers: list[str],
+                 poll_seconds: float = 1.0,
+                 on_event: Optional[Callable[[str, dict], None]] = None):
+        self.filer = filer
+        self.peers = [p for p in peers if p]
+        self.poll_seconds = poll_seconds
+        self.on_event = on_event
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+        # visible counters for status/debugging
+        self.applied = 0
+        self.skipped_own = 0
+
+    def start(self) -> "MetaAggregator":
+        for peer in self.peers:
+            t = threading.Thread(target=self._tail_peer, args=(peer,),
+                                 daemon=True, name=f"meta-agg:{peer}")
+            t.start()
+            self._threads.append(t)
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    # --- per-peer tail loop ------------------------------------------------
+    def _cursor_key(self, peer: str) -> bytes:
+        return CURSOR_PREFIX + peer.encode()
+
+    def _load_cursor(self, peer: str) -> int:
+        raw = self.filer.store.kv_get(self._cursor_key(peer))
+        return int(raw) if raw else 0
+
+    def _save_cursor(self, peer: str, ns: int) -> None:
+        self.filer.store.kv_put(self._cursor_key(peer), str(ns).encode())
+
+    def _tail_peer(self, peer: str) -> None:
+        cursor = self._load_cursor(peer)
+        while not self._stop.is_set():
+            try:
+                r = http_json(
+                    "GET",
+                    f"http://{peer}/api/meta/log?since_ns={cursor}",
+                    timeout=10.0)
+            except Exception:
+                self._stop.wait(self.poll_seconds)
+                continue
+            events = r.get("events", [])
+            for event in events:
+                if self.filer.signature in event.get("signatures", []):
+                    self.skipped_own += 1
+                    continue
+                self.filer.publish_peer_event(peer, event)
+                if self.on_event is not None:
+                    try:
+                        self.on_event(peer, event)
+                    except Exception:
+                        pass
+                self.applied += 1
+            new_cursor = int(r.get("next_ns", cursor))
+            if new_cursor != cursor:
+                cursor = new_cursor
+                self._save_cursor(peer, cursor)
+            if not events:
+                self._stop.wait(self.poll_seconds)

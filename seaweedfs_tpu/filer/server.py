@@ -54,7 +54,9 @@ class FilerServer:
                  max_chunk_mb: int = 8, collection: str = "",
                  replication: str = "", guard=None,
                  notification_queue=None, chunk_cache_dir: str = "",
-                 chunk_cache_mem_mb: int = 64, cipher: bool = False):
+                 chunk_cache_mem_mb: int = 64, cipher: bool = False,
+                 peers: Optional[list[str]] = None,
+                 peer_poll_seconds: float = 1.0):
         from ..security import Guard
 
         self.guard = guard or Guard()
@@ -98,6 +100,15 @@ class FilerServer:
                     ((ev.get("new_entry") or ev.get("old_entry"))
                      or {}).get("full_path", ""), ev),
                 since_ns=time.time_ns())
+        # multi-filer: tail peers' meta logs into the local subscription
+        # stream (meta_aggregator.go) — leaderless merged view
+        from .meta_aggregator import MetaAggregator
+
+        self_url = f"{host}:{port}"
+        self.meta_aggregator = MetaAggregator(
+            self.filer,
+            [p for p in (peers or []) if p and p != self_url],
+            poll_seconds=peer_poll_seconds)
 
     def _maybe_mark_conf_dirty(self, event: dict) -> None:
         for e in (event.get("new_entry"), event.get("old_entry")):
@@ -147,9 +158,11 @@ class FilerServer:
 
     def start(self) -> "FilerServer":
         self._server = serve(self.router, self.host, self.port)
+        self.meta_aggregator.start()
         return self
 
     def stop(self) -> None:
+        self.meta_aggregator.stop()
         if self._server:
             from ..utils.httpd import stop_server
 
@@ -400,6 +413,21 @@ class FilerServer:
             with self.filer.op_signatures(self._sigs(req)):
                 moved = self.filer.rename(b["from"], b["to"])
             return Response({"path": moved.full_path})
+
+        @r.route("POST", "/api/link")
+        def api_link(req: Request) -> Response:
+            """Hardlink: link shares target's content record
+            (filerstore_hardlink.go through Filer.hardlink)."""
+            err = self.guard.check_filer_jwt(req)
+            if err:
+                raise HttpError(401, err)
+            b = req.json()
+            self._check_writable(b["link"])
+            with self.filer.op_signatures(self._sigs(req)):
+                link = self.filer.hardlink(b["target"], b["link"])
+            return Response({"path": link.full_path,
+                             "hard_link_id": link.hard_link_id,
+                             "count": link.hard_link_counter})
 
         @r.route("GET", "/api/info")
         def api_info(req: Request) -> Response:

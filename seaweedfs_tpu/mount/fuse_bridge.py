@@ -244,6 +244,10 @@ class FuseMount:
             wfs.rename(old.decode(), new.decode())
 
         @self._guard
+        def op_link(target, link):
+            wfs.link(target.decode(), link.decode())
+
+        @self._guard
         def op_chmod(path, mode):
             wfs.setattr(path.decode(), mode=mode)
 
@@ -328,6 +332,7 @@ class FuseMount:
             ("unlink", _OP_PATH(op_unlink)),
             ("rmdir", _OP_PATH(op_rmdir)),
             ("rename", _OP_PATH2(op_rename)),
+            ("link", _OP_PATH2(op_link)),
             ("chmod", _OP_CHMOD(op_chmod)),
             ("chown", _OP_CHOWN(op_chown)),
             ("truncate", _OP_TRUNCATE(op_truncate)),

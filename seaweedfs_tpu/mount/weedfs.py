@@ -273,6 +273,19 @@ class WFS:
             raise FuseError(errno.ENOTEMPTY, path)
         self.unlink(path)
 
+    def link(self, target: str, link: str) -> None:
+        """Hardlink (mount/weedfs_link.go): filer-side content sharing."""
+        status, body, _ = http_bytes(
+            "POST", f"http://{self.filer_url}/api/link",
+            __import__("json").dumps(
+                {"target": self._abs(target),
+                 "link": self._abs(link)}).encode(),
+            headers={"Content-Type": "application/json"})
+        if status != 200:
+            raise FuseError(errno.EIO, body.decode(errors="replace"))
+        self.meta.delete(self._abs(target))
+        self.meta.delete(self._abs(link))
+
     def rename(self, old: str, new: str) -> None:
         status, body, _ = http_bytes(
             "POST", f"http://{self.filer_url}/api/rename",

@@ -1,0 +1,244 @@
+"""Hardlinks, LSM filer store, and the MetaAggregator.
+
+Gates:
+- hardlinks share one content record; chunks GC only at the last unlink
+  (filerstore_hardlink.go)
+- the LSM store is observably identical to MemoryStore under randomized
+  ops, and survives crash (WAL replay), flush, and compaction
+- a filer tails its peers' meta logs into the local subscription stream
+  with signature-based echo suppression (meta_aggregator.go)
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu.filer.entry import Attr, Entry, FileChunk
+from seaweedfs_tpu.filer.filer import Filer, NotFoundError
+from seaweedfs_tpu.filer.filer_store import MemoryStore
+from seaweedfs_tpu.filer.lsm_store import LsmStore
+
+RNG = np.random.default_rng(0x11A)
+
+
+def _file(path: str, fids: list[str]) -> Entry:
+    chunks = [FileChunk(file_id=f, offset=i * 10, size=10)
+              for i, f in enumerate(fids)]
+    return Entry(full_path=path, attr=Attr(mode=0o660), chunks=chunks)
+
+
+# --------------------------------------------------------------------------
+# hardlinks
+# --------------------------------------------------------------------------
+
+def test_hardlink_shares_content_and_gc_at_last_unlink():
+    deleted: list[str] = []
+    f = Filer(delete_chunks_fn=deleted.extend)
+    f.create_entry(_file("/a.txt", ["3,01"]))
+    link = f.hardlink("/a.txt", "/b.txt")
+    assert link.hard_link_counter == 2
+    # both resolve to the same chunks
+    assert [c.file_id for c in f.find_entry("/b.txt").chunks] == ["3,01"]
+    assert [c.file_id for c in f.find_entry("/a.txt").chunks] == ["3,01"]
+    # first unlink: no GC
+    f.delete_entry("/a.txt")
+    f.flush_gc()
+    assert deleted == []
+    with pytest.raises(NotFoundError):
+        f.find_entry("/a.txt")
+    assert f.find_entry("/b.txt").chunks[0].file_id == "3,01"
+    # last unlink: chunks reclaimed
+    f.delete_entry("/b.txt")
+    f.flush_gc()
+    assert deleted == ["3,01"]
+    f.close()
+
+
+def test_hardlink_three_links_and_update_propagates():
+    f = Filer()
+    f.create_entry(_file("/x", ["5,aa"]))
+    f.hardlink("/x", "/y")
+    z = f.hardlink("/x", "/d/z")
+    assert z.hard_link_counter == 3
+    # updating content through one path is visible through the others
+    e = f.find_entry("/y")
+    e.chunks = [FileChunk(file_id="5,bb", offset=0, size=4)]
+    f.update_entry(e)
+    assert [c.file_id for c in f.find_entry("/d/z").chunks] == ["5,bb"]
+    assert [c.file_id for c in f.find_entry("/x").chunks] == ["5,bb"]
+    f.close()
+
+
+def test_hardlink_rename_keeps_counter():
+    deleted: list[str] = []
+    f = Filer(delete_chunks_fn=deleted.extend)
+    f.create_entry(_file("/p", ["7,cc"]))
+    f.hardlink("/p", "/q")
+    f.rename("/q", "/q2")
+    f.delete_entry("/p")
+    f.flush_gc()
+    assert deleted == []  # /q2 still holds the content
+    assert [c.file_id for c in f.find_entry("/q2").chunks] == ["7,cc"]
+    f.delete_entry("/q2")
+    f.flush_gc()
+    assert deleted == ["7,cc"]
+    f.close()
+
+
+def test_hardlink_rejects_directories_and_existing_targets():
+    f = Filer()
+    f.mkdir("/d")
+    f.create_entry(_file("/f", ["1,00"]))
+    with pytest.raises(Exception):
+        f.hardlink("/d", "/link")
+    with pytest.raises(Exception):
+        f.hardlink("/f", "/d")  # target exists
+    f.close()
+
+
+# --------------------------------------------------------------------------
+# LSM store
+# --------------------------------------------------------------------------
+
+def _random_paths(n):
+    dirs = ["/", "/a", "/a/b", "/c"]
+    out = []
+    for i in range(int(n)):
+        d = dirs[int(RNG.integers(0, len(dirs)))]
+        name = f"f{int(RNG.integers(0, 40)):02d}"
+        out.append((d.rstrip("/") or "") + "/" + name)
+    return out
+
+
+def test_lsm_matches_memory_randomized(tmp_path):
+    lsm = LsmStore(str(tmp_path / "lsm"), memtable_limit=32,
+                   compact_trigger=3)
+    mem = MemoryStore()
+    for i, p in enumerate(_random_paths(500)):
+        if RNG.random() < 0.2:
+            lsm.delete_entry(p)
+            mem.delete_entry(p)
+        else:
+            e = _file(p, [f"1,{i:04x}"])
+            lsm.insert_entry(e)
+            mem.insert_entry(e)
+    for d in ("/", "/a", "/a/b", "/c"):
+        got = [e.full_path for e in lsm.list_directory_entries(d, limit=100)]
+        want = [e.full_path for e in mem.list_directory_entries(d, limit=100)]
+        assert got == want, d
+    # point lookups agree
+    for p in _random_paths(100):
+        a, b = lsm.find_entry(p), mem.find_entry(p)
+        assert (a is None) == (b is None)
+        if a:
+            assert a.to_dict() == b.to_dict()
+    lsm.close()
+
+
+def test_lsm_wal_crash_recovery(tmp_path):
+    d = str(tmp_path / "lsm")
+    lsm = LsmStore(d, memtable_limit=1000)  # nothing flushes
+    lsm.insert_entry(_file("/crash/a", ["2,01"]))
+    lsm.kv_put(b"k1", b"v1")
+    lsm.delete_entry("/crash/a")
+    lsm._wal.flush()  # simulate crash: no close(), no flush_memtable
+    lsm2 = LsmStore(d)
+    assert lsm2.find_entry("/crash/a") is None
+    assert lsm2.kv_get(b"k1") == b"v1"
+    # torn tail record is dropped, earlier records survive
+    lsm2.kv_put(b"k2", b"v2")
+    lsm2._wal.flush()
+    with open(os.path.join(d, "wal.log"), "r+b") as f:
+        f.truncate(os.path.getsize(os.path.join(d, "wal.log")) - 1)
+    lsm3 = LsmStore(d)
+    assert lsm3.kv_get(b"k1") == b"v1"
+    assert lsm3.kv_get(b"k2") is None
+    lsm3.close()
+
+
+def test_lsm_flush_compact_and_reopen(tmp_path):
+    d = str(tmp_path / "lsm")
+    lsm = LsmStore(d, memtable_limit=8, compact_trigger=3)
+    for i in range(100):
+        lsm.insert_entry(_file(f"/m/f{i:03d}", [f"4,{i:02x}"]))
+    for i in range(0, 100, 2):
+        lsm.delete_entry(f"/m/f{i:03d}")
+    lsm.close()
+    assert any(f.endswith(".sst") for f in os.listdir(d))
+    lsm2 = LsmStore(d)
+    names = [e.name for e in lsm2.list_directory_entries("/m", limit=1000)]
+    assert names == [f"f{i:03d}" for i in range(1, 100, 2)]
+    # kv scan ordering across levels
+    for i in (5, 1, 9):
+        lsm2.kv_put(b"scan/%d" % i, b"%d" % i)
+    assert [k for k, _ in lsm2.kv_scan(b"scan/")] == \
+        [b"scan/1", b"scan/5", b"scan/9"]
+    lsm2.close()
+
+
+def test_lsm_backs_a_filer(tmp_path):
+    f = Filer(store=LsmStore(str(tmp_path / "lsm")))
+    f.create_entry(_file("/docs/readme", ["8,01"]))
+    f.hardlink("/docs/readme", "/docs/copy")
+    assert [e.name for e in f.list_directory("/docs")] == ["copy", "readme"]
+    assert f.find_entry("/docs/copy").chunks[0].file_id == "8,01"
+    f.close()
+
+
+# --------------------------------------------------------------------------
+# MetaAggregator
+# --------------------------------------------------------------------------
+
+def test_meta_aggregator_merges_peer_events(tmp_path):
+    from seaweedfs_tpu.filer.server import FilerServer
+    from seaweedfs_tpu.master.server import MasterServer
+    from seaweedfs_tpu.volume_server.server import VolumeServer
+    from tests.conftest import free_port
+
+    master = MasterServer(port=free_port(), volume_size_limit_mb=64,
+                          pulse_seconds=0.3).start()
+    vdir = tmp_path / "v"
+    vdir.mkdir()
+    vs = VolumeServer([str(vdir)], master.url, port=free_port(),
+                      pulse_seconds=0.3).start()
+    deadline = time.time() + 5
+    while time.time() < deadline and not master.topo.all_nodes():
+        time.sleep(0.05)
+    fa = FilerServer(master.url, port=free_port(),
+                     peer_poll_seconds=0.2).start()
+    fb = FilerServer(master.url, port=free_port(),
+                     peers=[fa.url], peer_poll_seconds=0.2).start()
+    try:
+        seen: list[dict] = []
+        fb.filer.subscribe(seen.append, since_ns=time.time_ns())
+        # a mutation on filer A must reach a subscriber of filer B
+        fa.put_file("/shared/hello.txt", b"hi from A")
+        deadline = time.time() + 5
+        while time.time() < deadline and not any(
+                (e.get("new_entry") or {}).get("full_path")
+                == "/shared/hello.txt" for e in seen):
+            time.sleep(0.05)
+        assert any((e.get("new_entry") or {}).get("full_path")
+                   == "/shared/hello.txt" for e in seen)
+        assert all(e.get("peer") == fa.url for e in seen
+                   if (e.get("new_entry") or {}).get("full_path")
+                   == "/shared/hello.txt")
+        # filer B's own events do NOT bounce: A tails nobody, and B skips
+        # events stamped with its own signature when tailing A
+        before = fb.meta_aggregator.applied
+        fb.put_file("/shared/from-b.txt", b"hi from B")
+        time.sleep(1.0)
+        assert fb.meta_aggregator.skipped_own == 0  # A carries no B events
+        # cursor persisted: restart-style aggregator resumes, not replays
+        cur = fb.filer.store.kv_get(b"meta.aggregator.peer/" +
+                                    fa.url.encode())
+        assert cur is not None and int(cur) > 0
+    finally:
+        fb.stop()
+        fa.stop()
+        vs.stop()
+        master.stop()

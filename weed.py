@@ -56,12 +56,18 @@ def cmd_filer(args) -> None:
     from seaweedfs_tpu.gateway.webdav import WebDavServer
     from seaweedfs_tpu.security.config import filer_guard
 
-    store = SqliteStore(args.db) if args.db else None
+    if args.db and args.db.endswith(".lsm"):
+        from seaweedfs_tpu.filer.lsm_store import LsmStore
+
+        store = LsmStore(args.db)
+    else:
+        store = SqliteStore(args.db) if args.db else None
     f = FilerServer(args.master, store, host=args.ip, port=args.port,
                     max_chunk_mb=args.maxMB,
                     chunk_cache_dir=args.cacheDir,
                     chunk_cache_mem_mb=args.cacheSizeMB,
-                    guard=filer_guard(_security())).start()
+                    guard=filer_guard(_security()),
+                    peers=[p for p in args.peers.split(",") if p]).start()
     print(f"filer listening on {f.url}")
     if args.s3:
         s3 = S3ApiServer(f, host=args.ip, port=args.s3_port).start()
@@ -402,7 +408,11 @@ def main(argv=None) -> None:
     fl.add_argument("-master", default="127.0.0.1:9333")
     fl.add_argument("-ip", default="127.0.0.1")
     fl.add_argument("-port", type=int, default=8888)
-    fl.add_argument("-db", default="", help="sqlite store path (default: memory)")
+    fl.add_argument("-db", default="",
+                    help="store path: *.lsm -> LSM store dir, else sqlite "
+                         "(default: memory)")
+    fl.add_argument("-peers", default="",
+                    help="other filer host:ports to aggregate meta from")
     fl.add_argument("-maxMB", type=int, default=8)
     fl.add_argument("-cacheDir", default="",
                     help="directory for the on-disk chunk cache tier")
