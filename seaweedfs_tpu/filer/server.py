@@ -358,7 +358,8 @@ class FilerServer:
     # --- file API ---------------------------------------------------------
     def put_file(self, path: str, data: bytes, mime: str = "",
                  collection: str = "", ttl: str = "",
-                 mode: int = 0o660) -> Entry:
+                 mode: int = 0o660,
+                 extended: Optional[dict] = None) -> Entry:
         # longest-prefix storage rule fills unset knobs
         # (filer_server_handlers_write.go → fs.configure rules)
         self._check_writable(path)
@@ -373,7 +374,8 @@ class FilerServer:
             mtime=time.time(), crtime=time.time(), mode=mode, mime=mime,
             collection=collection, replication=replication,
             ttl_seconds=_ttl_seconds(ttl),
-            md5=hashlib.md5(data).hexdigest()), chunks=chunks)
+            md5=hashlib.md5(data).hexdigest()), chunks=chunks,
+            extended=dict(extended or {}))
         return self.filer.create_entry(entry)
 
     def get_file(self, path: str) -> tuple[Entry, bytes]:

@@ -142,9 +142,16 @@ class S3ApiServer:
     def _identity(self, req: Request):
         method = req.handler.command
         body = req.body if method in ("PUT", "POST") else b""
-        ident = self.iam.authenticate(method, req.path, req.query,
-                                      req.headers, body)
-        self._maybe_decode_streaming(req)
+        ident, stream_ctx = self.iam.authenticate_with_context(
+            method, req.path, req.query, req.headers, body)
+        if stream_ctx is not None and \
+                not getattr(req, "_streaming_decoded", False):
+            # signed streaming upload: verify EVERY chunk signature while
+            # stripping the framing (auth_signature_v4.go streaming path)
+            req._body = self.iam.verify_streaming_chunks(req.body, stream_ctx)
+            req._streaming_decoded = True
+        else:
+            self._maybe_decode_streaming(req)
         return ident
 
     def _auth(self, req: Request, action: str, bucket: str = "",
@@ -232,12 +239,22 @@ class S3ApiServer:
             bucket = req.match.group(1)
             self._auth(req, ACTION_LIST, bucket)
             self._require_bucket(bucket)
+            if "location" in req.query:
+                # GetBucketLocation: SDKs call this before anything else
+                root = ET.Element("LocationConstraint", xmlns=S3_NS)
+                return _xml(root)
+            if "uploads" in req.query:
+                return self._list_multipart_uploads(bucket)
             prefix = req.query.get("prefix", "")
             delimiter = req.query.get("delimiter", "")
             max_keys = int(req.query.get("max-keys", 1000))
-            start_after = req.query.get("start-after", "")
-            token = req.query.get("continuation-token", "")
-            marker = urllib.parse.unquote(token) if token else start_after
+            if req.query.get("list-type") == "2":
+                start_after = req.query.get("start-after", "")
+                token = req.query.get("continuation-token", "")
+                marker = urllib.parse.unquote(token) if token else start_after
+            else:
+                # ListObjects v1 pages with `marker`
+                marker = req.query.get("marker", "")
 
             contents, common_prefixes, truncated, next_token = self._walk(
                 bucket, prefix, delimiter, marker, max_keys)
@@ -252,8 +269,11 @@ class S3ApiServer:
             ET.SubElement(root, "IsTruncated").text = \
                 "true" if truncated else "false"
             if truncated:
-                ET.SubElement(root, "NextContinuationToken").text = \
-                    urllib.parse.quote(next_token)
+                if req.query.get("list-type") == "2":
+                    ET.SubElement(root, "NextContinuationToken").text = \
+                        urllib.parse.quote(next_token)
+                else:
+                    ET.SubElement(root, "NextMarker").text = next_token
             for key, entry in contents:
                 c = ET.SubElement(root, "Contents")
                 ET.SubElement(c, "Key").text = key
@@ -265,6 +285,41 @@ class S3ApiServer:
             for p in sorted(common_prefixes):
                 cp = ET.SubElement(root, "CommonPrefixes")
                 ET.SubElement(cp, "Prefix").text = p
+            return _xml(root)
+
+        @r.route("POST", "/([a-z0-9][a-z0-9.-]+)")
+        def post_bucket(req: Request) -> Response:
+            bucket = req.match.group(1)
+            if "delete" not in req.query:
+                raise HttpError(400, "unsupported bucket POST")
+            # DeleteObjects: batch delete, per-key result entries
+            # (s3api_object_handlers.go DeleteMultipleObjectsHandler)
+            self._auth(req, ACTION_WRITE, bucket)
+            self._require_bucket(bucket)
+            try:
+                doc = ET.fromstring(req.body)
+            except ET.ParseError:
+                return _err(400, "MalformedXML", "cannot parse Delete body")
+            quiet = (doc.findtext("{*}Quiet") or doc.findtext("Quiet")
+                     or "false") == "true"
+            root = ET.Element("DeleteResult", xmlns=S3_NS)
+            for obj in (doc.findall("{*}Object") or doc.findall("Object")):
+                key = obj.findtext("{*}Key") or obj.findtext("Key") or ""
+                if not key:
+                    continue
+                try:
+                    self.fs.filer.delete_entry(self._object_path(bucket, key))
+                except FilerNotFound:
+                    pass  # idempotent, still reported Deleted
+                except Exception as e:
+                    err = ET.SubElement(root, "Error")
+                    ET.SubElement(err, "Key").text = key
+                    ET.SubElement(err, "Code").text = "InternalError"
+                    ET.SubElement(err, "Message").text = str(e)
+                    continue
+                if not quiet:
+                    d = ET.SubElement(root, "Deleted")
+                    ET.SubElement(d, "Key").text = key
             return _xml(root)
 
         @r.route("POST", "/([a-z0-9][a-z0-9.-]+)/(.+)")
@@ -291,8 +346,12 @@ class S3ApiServer:
             if copy_source:
                 return self._copy_object(req, bucket, key, copy_source)
             mime = req.headers.get("Content-Type", "")
+            # x-amz-meta-* user metadata persists in entry.extended and
+            # round-trips on GET/HEAD (s3api PutObject SaveAmzMetaData)
+            meta = {k.lower(): v for k, v in req.headers.items()
+                    if k.lower().startswith("x-amz-meta-")}
             entry = self.fs.put_file(self._object_path(bucket, key), req.body,
-                                     mime=mime)
+                                     mime=mime, extended=meta)
             etag = entry.attr.md5
             return Response(raw=b"", headers={"ETag": f'"{etag}"'})
 
@@ -308,6 +367,11 @@ class S3ApiServer:
                 return _err(404, "NoSuchKey", key)
             if entry.is_directory:
                 return _err(404, "NoSuchKey", key)
+            etag_now = entry.attr.md5 or etag_of_chunks(entry.chunks)
+            inm = req.headers.get("If-None-Match", "")
+            if inm and inm.strip('"') in (etag_now, "*"):
+                return Response(raw=b"", status=304,
+                                headers={"ETag": f'"{etag_now}"'})
             from ..utils.httpd import UNSATISFIABLE_RANGE, parse_range
 
             file_size = entry.file_size
@@ -321,11 +385,14 @@ class S3ApiServer:
             body = b"" if is_head else self.fs.read_chunks(entry, offset, size)
             headers = {
                 "Content-Type": entry.attr.mime or "binary/octet-stream",
-                "ETag": f'"{entry.attr.md5 or etag_of_chunks(entry.chunks)}"',
+                "ETag": f'"{etag_now}"',
                 "Last-Modified": time.strftime(
                     "%a, %d %b %Y %H:%M:%S GMT", time.gmtime(entry.attr.mtime)),
                 "Accept-Ranges": "bytes",
             }
+            for mk, mv in entry.extended.items():
+                if mk.startswith("x-amz-meta-"):
+                    headers[mk] = mv
             if is_head:
                 headers["Content-Length"] = str(size)
             if status == 206:
@@ -462,6 +529,31 @@ class S3ApiServer:
         ET.SubElement(root, "ETag").text = f'"{etag}"'
         return _xml(root)
 
+    def _list_multipart_uploads(self, bucket: str) -> Response:
+        """ListMultipartUploads (s3.clean.uploads depends on it):
+        in-progress uploads for this bucket from the staging area."""
+        root = ET.Element("ListMultipartUploadsResult", xmlns=S3_NS)
+        ET.SubElement(root, "Bucket").text = bucket
+        ET.SubElement(root, "IsTruncated").text = "false"
+        try:
+            staged = self.fs.filer.list_directory(UPLOADS_PATH)
+        except FilerNotFound:
+            staged = []
+        for d in staged:
+            if not d.is_directory:
+                continue
+            try:
+                meta = self.fs.filer.find_entry(f"{d.full_path}/.meta")
+            except FilerNotFound:
+                continue
+            if meta.extended.get("bucket") != bucket:
+                continue
+            u = ET.SubElement(root, "Upload")
+            ET.SubElement(u, "Key").text = meta.extended.get("key", "")
+            ET.SubElement(u, "UploadId").text = d.name
+            ET.SubElement(u, "Initiated").text = _iso(meta.attr.crtime)
+        return _xml(root)
+
     def _abort_multipart(self, req: Request, bucket: str, key: str) -> Response:
         self._upload_meta(req)
         self.fs.filer.delete_entry(f"{UPLOADS_PATH}/{req.query['uploadId']}",
@@ -478,8 +570,16 @@ class S3ApiServer:
         except FilerNotFound:
             return _err(404, "NoSuchKey", src)
         data = self.fs.read_chunks(src_entry)
+        # metadata directive: COPY (default) carries the source's
+        # x-amz-meta-*, REPLACE takes the request's headers instead
+        if req.headers.get("X-Amz-Metadata-Directive", "COPY") == "REPLACE":
+            meta = {k.lower(): v for k, v in req.headers.items()
+                    if k.lower().startswith("x-amz-meta-")}
+        else:
+            meta = {k: v for k, v in src_entry.extended.items()
+                    if k.startswith("x-amz-meta-")}
         entry = self.fs.put_file(self._object_path(bucket, key), data,
-                                 mime=src_entry.attr.mime)
+                                 mime=src_entry.attr.mime, extended=meta)
         root = ET.Element("CopyObjectResult", xmlns=S3_NS)
         ET.SubElement(root, "ETag").text = f'"{entry.attr.md5}"'
         ET.SubElement(root, "LastModified").text = _iso(entry.attr.mtime)

@@ -145,20 +145,78 @@ class IdentityAccessManagement:
     # --- request authentication ------------------------------------------
     def authenticate(self, method: str, path: str, query: dict,
                      headers, body: bytes) -> Identity:
-        """Verify the request signature and return its identity.
+        ident, _ = self.authenticate_with_context(method, path, query,
+                                                  headers, body)
+        return ident
+
+    def authenticate_with_context(self, method: str, path: str, query: dict,
+                                  headers, body: bytes
+                                  ) -> tuple[Identity, Optional[dict]]:
+        """Verify the request signature and return (identity, stream_ctx).
         Dispatches on the auth style exactly like auth_credentials.go's
-        authRequest: v4 header, v4 presigned, v2 header, else anonymous."""
+        authRequest: v4 header, v4 presigned, v2 header, else anonymous.
+        stream_ctx is non-None for STREAMING-AWS4-HMAC-SHA256-PAYLOAD
+        bodies and carries what verify_streaming_chunks needs (the seed
+        signature chains the per-chunk signatures)."""
         auth = headers.get("Authorization") or ""
         if auth.startswith("AWS4-HMAC-SHA256"):
             return self._verify_v4_header(method, path, query, headers, body)
         if query.get("X-Amz-Algorithm") == "AWS4-HMAC-SHA256":
-            return self._verify_v4_presigned(method, path, query, headers)
+            return self._verify_v4_presigned(method, path, query, headers), None
         if auth.startswith("AWS "):
-            return self._verify_v2_header(method, path, query, headers, auth)
+            return self._verify_v2_header(method, path, query, headers,
+                                          auth), None
         anon = self.lookup_anonymous()
         if anon is not None:
-            return anon
+            return anon, None
         raise AuthError("AccessDenied", "Request is not signed")
+
+    def verify_streaming_chunks(self, body: bytes, ctx: dict) -> bytes:
+        """Decode aws-chunked framing AND verify every chunk signature
+        (auth_signature_v4.go's streaming path): each chunk signs
+        AWS4-HMAC-SHA256-PAYLOAD over (amz_date, scope, previous
+        signature, sha256(""), sha256(chunk)), seeded by the request
+        signature — a tampered, reordered, or truncated chunk fails.
+        The -TRAILER variant chains chunks identically; trailer headers
+        after the final 0-chunk are dropped (their own signature only
+        covers checksum headers we do not consume)."""
+        date, region, service, _ = ctx["scope"].split("/")
+        key = self._signing_key(ctx["secret"], date, region, service)
+        prev_sig = ctx["seed_signature"]
+        empty_hash = hashlib.sha256(b"").hexdigest()
+        out = bytearray()
+        saw_final = False
+        for size, given_sig, chunk, malformed in _iter_aws_chunks(body):
+            if malformed:
+                raise AuthError("InvalidRequest",
+                                "malformed streaming chunk header", 400)
+            if len(chunk) != size:
+                raise AuthError("IncompleteBody",
+                                "streaming chunk shorter than declared", 400)
+            if not given_sig:
+                if size == 0 and ctx.get("trailer"):
+                    saw_final = True  # trailer-variant final chunk
+                    break
+                raise AuthError("SignatureDoesNotMatch",
+                                "streaming chunk missing chunk-signature")
+            string_to_sign = "\n".join([
+                "AWS4-HMAC-SHA256-PAYLOAD", ctx["amz_date"], ctx["scope"],
+                prev_sig, empty_hash,
+                hashlib.sha256(chunk).hexdigest()])
+            expect = hmac.new(key, string_to_sign.encode(),
+                              hashlib.sha256).hexdigest()
+            if not hmac.compare_digest(expect, given_sig):
+                raise AuthError("SignatureDoesNotMatch",
+                                "chunk signature does not match")
+            prev_sig = expect
+            if size == 0:
+                saw_final = True
+                break
+            out += chunk
+        if not saw_final:
+            raise AuthError("IncompleteBody",
+                            "streaming upload missing final chunk", 400)
+        return bytes(out)
 
     # --- SigV4 ------------------------------------------------------------
     @staticmethod
@@ -245,7 +303,15 @@ class IdentityAccessManagement:
             raise AuthError("SignatureDoesNotMatch",
                             "The request signature we calculated does not "
                             "match the signature you provided")
-        return identity
+        ctx = None
+        if payload_hash in (STREAMING_PAYLOAD, STREAMING_PAYLOAD + "-TRAILER"):
+            # both SIGNED streaming variants chain per-chunk signatures
+            # off the seed; only STREAMING-UNSIGNED-PAYLOAD-TRAILER has
+            # none to verify
+            ctx = {"secret": secret, "scope": scope, "amz_date": amz_date,
+                   "seed_signature": given_sig,
+                   "trailer": payload_hash.endswith("-TRAILER")}
+        return identity, ctx
 
     def _verify_v4_presigned(self, method: str, path: str, query: dict,
                              headers) -> Identity:
@@ -312,33 +378,94 @@ class IdentityAccessManagement:
         return identity
 
 
-def decode_streaming_chunks(body: bytes) -> bytes:
-    """Strip aws-chunked framing: `hex-size;chunk-signature=...\\r\\n data
-    \\r\\n` repeated, terminated by a zero-size chunk (the V4 streaming
-    upload format, auth_signature_v4.go's streaming reader). Per-chunk
-    signatures are not re-verified — the seed signature already
-    authenticated the request headers."""
-    out = bytearray()
+def _iter_aws_chunks(body: bytes):
+    """Shared aws-chunked frame parser: yields (size, chunk_signature,
+    chunk_bytes, malformed) per frame and stops after the 0-size frame.
+    Both the verifying and the unsigned decoder consume this, so the
+    framing state machine exists exactly once."""
     pos = 0
     while pos < len(body):
         nl = body.find(b"\r\n", pos)
         if nl < 0:
-            break
+            return
         header = body[pos:nl].decode(errors="replace")
-        size_hex = header.split(";")[0].strip()
+        size_hex, _, rest = header.partition(";")
         try:
-            size = int(size_hex, 16)
+            size = int(size_hex.strip(), 16)
         except ValueError:
-            break
+            yield 0, "", b"", True
+            return
+        sig = ""
+        for part in rest.split(";"):
+            k, _, v = part.partition("=")
+            if k.strip() == "chunk-signature":
+                sig = v.strip()
         pos = nl + 2
+        chunk = body[pos:pos + size]
+        yield size, sig, chunk, False
         if size == 0:
-            break
-        out += body[pos:pos + size]
+            return
         pos += size + 2  # skip chunk payload + trailing \r\n
+
+
+def decode_streaming_chunks(body: bytes) -> bytes:
+    """Strip aws-chunked framing WITHOUT signature checks — only for
+    STREAMING-UNSIGNED-PAYLOAD-TRAILER bodies (no signatures exist) and
+    open (IAM-disabled) gateways; signed streaming goes through
+    verify_streaming_chunks."""
+    out = bytearray()
+    for size, _, chunk, malformed in _iter_aws_chunks(body):
+        if malformed or size == 0:
+            break
+        out += chunk
     return bytes(out)
 
 
 # --- client-side signer (tests + in-framework S3 clients) ------------------
+
+def sign_v4_streaming(method: str, url: str, access_key: str,
+                      secret_key: str, chunks: list[bytes],
+                      amz_date: str = "", region: str = "us-east-1",
+                      payload_marker: str = STREAMING_PAYLOAD
+                      ) -> tuple[dict, bytes]:
+    """Client side of the V4 streaming upload: returns (headers, framed
+    aws-chunked body) with a valid seed signature and per-chunk signature
+    chain — the format verify_streaming_chunks checks."""
+    if not amz_date:
+        amz_date = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
+    parsed = urllib.parse.urlparse(url)
+    query = {k: v[0] for k, v in
+             urllib.parse.parse_qs(parsed.query,
+                                   keep_blank_values=True).items()}
+    decoded_len = sum(len(c) for c in chunks)
+    headers = {"Host": parsed.netloc, "X-Amz-Date": amz_date,
+               "X-Amz-Content-Sha256": payload_marker,
+               "Content-Encoding": "aws-chunked",
+               "X-Amz-Decoded-Content-Length": str(decoded_len)}
+    signed = sorted(h.lower() for h in headers)
+    scope = f"{amz_date[:8]}/{region}/s3/aws4_request"
+    iam = IdentityAccessManagement()
+    lookup = {h.lower(): v for h, v in headers.items()}
+    creq = iam._canonical_request(method, parsed.path or "/", query,
+                                  lookup, signed, payload_marker)
+    seed = iam._v4_signature(secret_key, scope, amz_date, creq)
+    headers["Authorization"] = (
+        f"AWS4-HMAC-SHA256 Credential={access_key}/{scope}, "
+        f"SignedHeaders={';'.join(signed)}, Signature={seed}")
+
+    key = iam._signing_key(secret_key, amz_date[:8], region, "s3")
+    empty_hash = hashlib.sha256(b"").hexdigest()
+    prev = seed
+    framed = bytearray()
+    for chunk in [*chunks, b""]:
+        sts = "\n".join(["AWS4-HMAC-SHA256-PAYLOAD", amz_date, scope, prev,
+                         empty_hash, hashlib.sha256(chunk).hexdigest()])
+        sig = hmac.new(key, sts.encode(), hashlib.sha256).hexdigest()
+        framed += (f"{len(chunk):x};chunk-signature={sig}\r\n".encode()
+                   + chunk + b"\r\n")
+        prev = sig
+    return headers, bytes(framed)
+
 
 def presign_v4(method: str, url: str, access_key: str, secret_key: str,
                expires: int = 3600, amz_date: str = "",

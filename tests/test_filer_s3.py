@@ -247,7 +247,7 @@ def test_s3_list_key_order_and_pagination(stack):
         http_bytes("PUT", f"http://{s3.url}/pg/{k}", b"x")
     got, token = [], ""
     for _ in range(10):
-        url = f"http://{s3.url}/pg?max-keys=1"
+        url = f"http://{s3.url}/pg?list-type=2&max-keys=1"
         if token:
             url += f"&continuation-token={token}"
         _, body, _ = http_bytes("GET", url)

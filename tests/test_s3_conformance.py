@@ -1,0 +1,387 @@
+"""Curated ceph/s3-tests-style conformance subset against a live gateway.
+
+Each test mirrors a behavior the ceph s3-tests suite (the reference's
+conformance gate, docker/compose/local-s3tests-compose.yml) checks:
+bucket lifecycle error codes, list-objects v1/v2 paging and delimiters,
+object round-trips with metadata and conditional/range GETs, batch
+delete, multipart, copy, presigned URLs, and V4 streaming-chunked
+uploads with per-chunk signature verification.
+
+All requests ride SigV4 (header or presigned) against an IAM-enabled
+gateway — the auth path is exercised by every call.
+"""
+
+from __future__ import annotations
+
+import time
+import urllib.parse
+import xml.etree.ElementTree as ET
+
+import pytest
+
+from seaweedfs_tpu.filer.filer_store import MemoryStore
+from seaweedfs_tpu.filer.server import FilerServer
+from seaweedfs_tpu.gateway.s3 import S3ApiServer
+from seaweedfs_tpu.gateway.s3_auth import (
+    IDENTITY_PATH,
+    presign_v4,
+    sign_v4,
+    sign_v4_streaming,
+)
+from seaweedfs_tpu.master.server import MasterServer
+from seaweedfs_tpu.utils.httpd import http_bytes
+from seaweedfs_tpu.volume_server.server import VolumeServer
+from tests.conftest import free_port
+
+AK, SK = "AKCONF", "SKCONF"
+NS = "{http://s3.amazonaws.com/doc/2006-03-01/}"
+
+
+@pytest.fixture(scope="module")
+def s3(tmp_path_factory):
+    tmp_path = tmp_path_factory.mktemp("s3conf")
+    master = MasterServer(port=free_port(), pulse_seconds=0.4).start()
+    d = tmp_path / "vs0"
+    d.mkdir()
+    vol = VolumeServer([str(d)], master.url, port=free_port(),
+                       pulse_seconds=0.4).start()
+    deadline = time.time() + 5
+    while time.time() < deadline and not master.topo.all_nodes():
+        time.sleep(0.05)
+    filer = FilerServer(master.url, MemoryStore(), port=free_port(),
+                        max_chunk_mb=1).start()
+    gw = S3ApiServer(filer, port=free_port()).start()
+    # enable IAM with one admin identity (s3.configure analog)
+    filer.put_file(IDENTITY_PATH, (
+        '{"identities": [{"name": "conf", "credentials":'
+        ' [{"accessKey": "%s", "secretKey": "%s"}],'
+        ' "actions": ["Admin"]}]}' % (AK, SK)).encode())
+    gw._load_identities()
+    yield gw
+    gw.stop()
+    filer.stop()
+    vol.stop()
+    master.stop()
+
+
+def _req(s3, method, path, body=b"", headers=None, unsigned=False):
+    url = f"http://{s3.url}{path}"
+    if unsigned:
+        hdrs = dict(headers or {})
+    else:
+        hdrs = sign_v4(method, url, AK, SK, body,
+                       extra_headers=headers or {})
+    return http_bytes(method, url, body or None, headers=hdrs)
+
+
+def _xml(body: bytes) -> ET.Element:
+    return ET.fromstring(body)
+
+
+# --- bucket lifecycle -------------------------------------------------------
+
+def test_bucket_lifecycle_and_error_codes(s3):
+    st, _, _ = _req(s3, "PUT", "/lifec")
+    assert st == 200
+    st, _, _ = _req(s3, "HEAD", "/lifec")
+    assert st == 200
+    # missing bucket: NoSuchBucket code in the XML error
+    st, body, _ = _req(s3, "GET", "/nosuchbucket-xyz?list-type=2")
+    assert st == 404 and b"NoSuchBucket" in body
+    st, _, _ = _req(s3, "HEAD", "/nosuchbucket-xyz")
+    assert st == 404
+    # delete non-empty -> 409 BucketNotEmpty
+    st, _, _ = _req(s3, "PUT", "/lifec/x.txt", b"x")
+    assert st == 200
+    st, body, _ = _req(s3, "DELETE", "/lifec")
+    assert st == 409 and b"BucketNotEmpty" in body
+    st, _, _ = _req(s3, "DELETE", "/lifec/x.txt")
+    assert st == 204
+    st, _, _ = _req(s3, "DELETE", "/lifec")
+    assert st == 204
+    # buckets list does not show it anymore
+    st, body, _ = _req(s3, "GET", "/")
+    assert st == 200 and b"<Name>lifec</Name>" not in body
+
+
+def test_get_bucket_location(s3):
+    _req(s3, "PUT", "/locb")
+    st, body, _ = _req(s3, "GET", "/locb?location")
+    assert st == 200
+    assert _xml(body).tag.endswith("LocationConstraint")
+
+
+# --- object round-trip ------------------------------------------------------
+
+def test_object_roundtrip_metadata_etag_conditional(s3):
+    _req(s3, "PUT", "/objb")
+    st, _, h = _req(s3, "PUT", "/objb/doc.txt", b"hello conformance",
+                    headers={"Content-Type": "text/plain",
+                             "x-amz-meta-owner": "alice"})
+    assert st == 200
+    etag = h["ETag"]
+    assert etag.startswith('"') and etag.endswith('"')
+
+    st, body, h = _req(s3, "GET", "/objb/doc.txt")
+    assert st == 200 and body == b"hello conformance"
+    assert h["Content-Type"] == "text/plain"
+    assert h["ETag"] == etag
+    assert h.get("x-amz-meta-owner") == "alice"
+
+    # HEAD: same headers, no body, correct length
+    st, body, h = _req(s3, "HEAD", "/objb/doc.txt")
+    assert st == 200 and body == b""
+    assert h["Content-Length"] == str(len(b"hello conformance"))
+
+    # conditional GET
+    st, _, _ = _req(s3, "GET", "/objb/doc.txt",
+                    headers={"If-None-Match": etag})
+    assert st == 304
+
+    # overwrite changes the ETag
+    _req(s3, "PUT", "/objb/doc.txt", b"v2")
+    st, body, h2 = _req(s3, "GET", "/objb/doc.txt")
+    assert body == b"v2" and h2["ETag"] != etag
+
+
+def test_object_range_requests(s3):
+    _req(s3, "PUT", "/rngb")
+    payload = bytes(range(256)) * 40  # 10240 bytes, > 1 chunk at 1MB? no,
+    _req(s3, "PUT", "/rngb/bin", payload)
+    for rng, want in [("bytes=0-99", payload[:100]),
+                      ("bytes=100-199", payload[100:200]),
+                      ("bytes=-100", payload[-100:]),
+                      ("bytes=10200-", payload[10200:])]:
+        st, body, h = _req(s3, "GET", "/rngb/bin", headers={"Range": rng})
+        assert st == 206 and body == want, rng
+        assert h["Content-Range"].startswith("bytes ")
+    st, _, h = _req(s3, "GET", "/rngb/bin",
+                    headers={"Range": "bytes=99999-"})
+    assert st == 416 and h["Content-Range"] == f"bytes */{len(payload)}"
+
+
+def test_nosuchkey(s3):
+    _req(s3, "PUT", "/nskb")
+    st, body, _ = _req(s3, "GET", "/nskb/missing.txt")
+    assert st == 404 and b"NoSuchKey" in body
+    # delete of a missing key is idempotent 204
+    st, _, _ = _req(s3, "DELETE", "/nskb/missing.txt")
+    assert st == 204
+
+
+# --- listing ----------------------------------------------------------------
+
+def _put_tree(s3, bucket):
+    _req(s3, "PUT", f"/{bucket}")
+    for k in ("a.txt", "b/1.txt", "b/2.txt", "c/d/deep.txt", "z.txt"):
+        _req(s3, "PUT", f"/{bucket}/{k}", b"x")
+
+
+def test_list_v2_delimiter_and_prefix(s3):
+    _put_tree(s3, "lv2")
+    st, body, _ = _req(s3, "GET", "/lv2?list-type=2&delimiter=/")
+    doc = _xml(body)
+    keys = [e.findtext(f"{NS}Key") for e in doc.findall(f"{NS}Contents")]
+    cps = [e.findtext(f"{NS}Prefix")
+           for e in doc.findall(f"{NS}CommonPrefixes")]
+    assert keys == ["a.txt", "z.txt"]
+    assert cps == ["b/", "c/"]
+    # prefix descends
+    st, body, _ = _req(s3, "GET", "/lv2?list-type=2&prefix=b/")
+    keys = [e.findtext(f"{NS}Key")
+            for e in _xml(body).findall(f"{NS}Contents")]
+    assert keys == ["b/1.txt", "b/2.txt"]
+
+
+def test_list_v2_pagination(s3):
+    _put_tree(s3, "lpag")
+    keys, token = [], ""
+    for _ in range(10):
+        q = f"/lpag?list-type=2&max-keys=2" + (
+            f"&continuation-token={token}" if token else "")
+        st, body, _ = _req(s3, "GET", q)
+        doc = _xml(body)
+        keys += [e.findtext(f"{NS}Key") for e in doc.findall(f"{NS}Contents")]
+        if doc.findtext(f"{NS}IsTruncated") != "true":
+            break
+        token = doc.findtext(f"{NS}NextContinuationToken")
+    assert keys == ["a.txt", "b/1.txt", "b/2.txt", "c/d/deep.txt", "z.txt"]
+
+
+def test_list_v1_marker_paging(s3):
+    _put_tree(s3, "lv1")
+    st, body, _ = _req(s3, "GET", "/lv1?max-keys=3")
+    doc = _xml(body)
+    keys = [e.findtext(f"{NS}Key") for e in doc.findall(f"{NS}Contents")]
+    assert keys == ["a.txt", "b/1.txt", "b/2.txt"]
+    assert doc.findtext(f"{NS}IsTruncated") == "true"
+    marker = doc.findtext(f"{NS}NextMarker")
+    st, body, _ = _req(s3, "GET",
+                       f"/lv1?marker={urllib.parse.quote(marker)}")
+    keys = [e.findtext(f"{NS}Key")
+            for e in _xml(body).findall(f"{NS}Contents")]
+    assert keys == ["c/d/deep.txt", "z.txt"]
+
+
+# --- batch delete -----------------------------------------------------------
+
+def test_delete_objects_batch(s3):
+    _put_tree(s3, "bdel")
+    body = (b"<Delete>"
+            b"<Object><Key>a.txt</Key></Object>"
+            b"<Object><Key>b/1.txt</Key></Object>"
+            b"<Object><Key>ghost.txt</Key></Object>"
+            b"</Delete>")
+    st, resp, _ = _req(s3, "POST", "/bdel?delete=", body)
+    assert st == 200
+    deleted = [e.findtext(f"{NS}Key")
+               for e in _xml(resp).findall(f"{NS}Deleted")]
+    assert sorted(deleted) == ["a.txt", "b/1.txt", "ghost.txt"]
+    st, body, _ = _req(s3, "GET", "/bdel?list-type=2")
+    keys = [e.findtext(f"{NS}Key")
+            for e in _xml(body).findall(f"{NS}Contents")]
+    assert keys == ["b/2.txt", "c/d/deep.txt", "z.txt"]
+
+
+# --- multipart --------------------------------------------------------------
+
+def test_multipart_upload_and_list_uploads(s3):
+    _req(s3, "PUT", "/mpb")
+    st, body, _ = _req(s3, "POST", "/mpb/big.bin?uploads=")
+    upload_id = _xml(body).findtext(f"{NS}UploadId")
+    assert upload_id
+    # shows in ListMultipartUploads
+    st, body, _ = _req(s3, "GET", "/mpb?uploads=")
+    assert upload_id in body.decode()
+    part1, part2 = b"A" * 70_000, b"B" * 50_000
+    for n, data in ((1, part1), (2, part2)):
+        st, _, _ = _req(
+            s3, "PUT",
+            f"/mpb/big.bin?partNumber={n}&uploadId={upload_id}", data)
+        assert st == 200
+    st, body, _ = _req(
+        s3, "POST", f"/mpb/big.bin?uploadId={upload_id}",
+        b"<CompleteMultipartUpload></CompleteMultipartUpload>")
+    assert st == 200
+    st, body, _ = _req(s3, "GET", "/mpb/big.bin")
+    assert body == part1 + part2
+    # ranged read across the part boundary
+    st, body, _ = _req(s3, "GET", "/mpb/big.bin",
+                       headers={"Range": "bytes=69998-70001"})
+    assert body == b"AABB"
+    # staging area is gone
+    st, body, _ = _req(s3, "GET", "/mpb?uploads=")
+    assert upload_id not in body.decode()
+
+
+def test_multipart_abort(s3):
+    _req(s3, "PUT", "/mpab")
+    st, body, _ = _req(s3, "POST", "/mpab/x.bin?uploads=")
+    upload_id = _xml(body).findtext(f"{NS}UploadId")
+    _req(s3, "PUT", f"/mpab/x.bin?partNumber=1&uploadId={upload_id}", b"zz")
+    st, _, _ = _req(s3, "DELETE", f"/mpab/x.bin?uploadId={upload_id}")
+    assert st == 204
+    st, body, _ = _req(
+        s3, "POST", f"/mpab/x.bin?uploadId={upload_id}",
+        b"<CompleteMultipartUpload></CompleteMultipartUpload>")
+    assert st == 404 and b"NoSuchUpload" in body
+
+
+# --- copy -------------------------------------------------------------------
+
+def test_copy_object(s3):
+    _req(s3, "PUT", "/cpb")
+    _req(s3, "PUT", "/cpb/src.txt", b"copy me",
+         headers={"Content-Type": "text/plain",
+                  "x-amz-meta-color": "blue"})
+    st, body, _ = _req(s3, "PUT", "/cpb/dst.txt",
+                       headers={"X-Amz-Copy-Source": "/cpb/src.txt"})
+    assert st == 200 and b"CopyObjectResult" in body
+    st, body, h = _req(s3, "GET", "/cpb/dst.txt")
+    assert body == b"copy me"
+    # default COPY directive carries user metadata
+    assert h.get("x-amz-meta-color") == "blue"
+    # REPLACE swaps it for the request's headers
+    st, _, _ = _req(s3, "PUT", "/cpb/dst2.txt",
+                    headers={"X-Amz-Copy-Source": "/cpb/src.txt",
+                             "X-Amz-Metadata-Directive": "REPLACE",
+                             "x-amz-meta-shape": "round"})
+    st, _, h = _req(s3, "HEAD", "/cpb/dst2.txt")
+    assert h.get("x-amz-meta-shape") == "round"
+    assert h.get("x-amz-meta-color") is None
+
+
+# --- auth behaviors ---------------------------------------------------------
+
+def test_anonymous_denied_when_iam_enabled(s3):
+    st, body, _ = _req(s3, "GET", "/objb/doc.txt", unsigned=True)
+    assert st == 403 and b"AccessDenied" in body
+
+
+def test_bad_signature_rejected(s3):
+    url = f"http://{s3.url}/objb/doc.txt"
+    hdrs = sign_v4("GET", url, AK, "WRONGSECRET", b"")
+    st, body, _ = http_bytes("GET", url, headers=hdrs)
+    assert st == 403 and b"SignatureDoesNotMatch" in body
+
+
+def test_presigned_get_and_expiry(s3):
+    _req(s3, "PUT", "/psb")
+    _req(s3, "PUT", "/psb/p.txt", b"presigned!")
+    url = presign_v4("GET", f"http://{s3.url}/psb/p.txt", AK, SK,
+                     expires=120)
+    st, body, _ = http_bytes("GET", url)
+    assert st == 200 and body == b"presigned!"
+    stale = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime(time.time() - 400))
+    url = presign_v4("GET", f"http://{s3.url}/psb/p.txt", AK, SK,
+                     expires=60, amz_date=stale)
+    st, body, _ = http_bytes("GET", url)
+    assert st == 403
+
+
+# --- streaming chunked signing ----------------------------------------------
+
+def test_streaming_chunked_upload_verified(s3):
+    _req(s3, "PUT", "/strb")
+    chunks = [b"stream-one-", b"stream-two-", b"stream-three"]
+    url = f"http://{s3.url}/strb/streamed.txt"
+    headers, framed = sign_v4_streaming("PUT", url, AK, SK, chunks)
+    st, body, _ = http_bytes("PUT", url, framed, headers=headers)
+    assert st == 200, body
+    st, body, _ = _req(s3, "GET", "/strb/streamed.txt")
+    assert body == b"".join(chunks)
+
+
+def test_streaming_trailer_variant_also_verified(s3):
+    """STREAMING-AWS4-HMAC-SHA256-PAYLOAD-TRAILER (botocore's default with
+    checksums over plain HTTP) must go through chunk verification too —
+    not fall back to the unverified decoder."""
+    from seaweedfs_tpu.gateway.s3_auth import STREAMING_PAYLOAD
+
+    _req(s3, "PUT", "/strb")
+    url = f"http://{s3.url}/strb/trailered.txt"
+    headers, framed = sign_v4_streaming(
+        "PUT", url, AK, SK, [b"trailer data"],
+        payload_marker=STREAMING_PAYLOAD + "-TRAILER")
+    st, body, _ = http_bytes("PUT", url, framed, headers=headers)
+    assert st == 200, body
+    st, body, _ = _req(s3, "GET", "/strb/trailered.txt")
+    assert body == b"trailer data"
+    # tampering is caught on this variant too
+    bad = framed.replace(b"trailer data", b"tampered dat")
+    st, body, _ = http_bytes("PUT", url, bad, headers=headers)
+    assert st == 403 and b"SignatureDoesNotMatch" in body
+
+
+def test_streaming_chunked_tamper_rejected(s3):
+    _req(s3, "PUT", "/strb")
+    url = f"http://{s3.url}/strb/tampered.txt"
+    headers, framed = sign_v4_streaming("PUT", url, AK, SK,
+                                        [b"honest data"])
+    bad = framed.replace(b"honest", b"hacked")
+    st, body, _ = http_bytes("PUT", url, bad, headers=headers)
+    assert st == 403 and b"SignatureDoesNotMatch" in body
+    # truncating the final 0-chunk is IncompleteBody
+    cut = framed[:framed.rfind(b"0;chunk-signature")]
+    st, body, _ = http_bytes("PUT", url, cut, headers=headers)
+    assert st == 400 and b"IncompleteBody" in body
