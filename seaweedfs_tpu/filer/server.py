@@ -56,7 +56,8 @@ class FilerServer:
                  notification_queue=None, chunk_cache_dir: str = "",
                  chunk_cache_mem_mb: int = 64, cipher: bool = False,
                  peers: Optional[list[str]] = None,
-                 peer_poll_seconds: float = 1.0):
+                 peer_poll_seconds: float = 1.0,
+                 tls_context=None):
         from ..security import Guard
 
         self.guard = guard or Guard()
@@ -85,6 +86,7 @@ class FilerServer:
             mem_limit=chunk_cache_mem_mb * 1024 * 1024,
             disk_dir=chunk_cache_dir)
         self.router = Router("filer", metrics=self.metrics)
+        self._tls_context = tls_context
         self._register_routes()
         self._server = None
         # path-prefix config (filer_conf.go): reload lazily when the
@@ -157,7 +159,8 @@ class FilerServer:
         return f"{self.host}:{self.port}"
 
     def start(self) -> "FilerServer":
-        self._server = serve(self.router, self.host, self.port)
+        self._server = serve(self.router, self.host, self.port,
+                             tls_context=self._tls_context)
         self.meta_aggregator.start()
         return self
 

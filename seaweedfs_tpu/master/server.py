@@ -42,7 +42,8 @@ class MasterServer:
                  peers: Optional[list[str]] = None, mdir: str = "",
                  vacuum_scan_seconds: float = 900.0,
                  maintenance_scripts: str = "",
-                 maintenance_interval_seconds: float = 900.0):
+                 maintenance_interval_seconds: float = 900.0,
+                 tls_context=None):
         self.host, self.port = host, port
         self.guard = guard or Guard()
         self.topo = Topology(volume_size_limit_mb * 1024 * 1024, pulse_seconds)
@@ -66,6 +67,7 @@ class MasterServer:
         self.router = Router("master", metrics=self.metrics)
         self._register_routes()
         self._server = None
+        self._tls_context = tls_context
         self._stop = threading.Event()
         # periodic maintenance (topology_event_handling.go ticker +
         # master_server.go:212 startAdminScripts): leader-only background
@@ -88,7 +90,8 @@ class MasterServer:
         return f"{self.host}:{self.port}"
 
     def start(self) -> "MasterServer":
-        self._server = serve(self.router, self.host, self.port)
+        self._server = serve(self.router, self.host, self.port,
+                             tls_context=self._tls_context)
         self.raft.start()
         threading.Thread(target=self._janitor_loop, daemon=True,
                          name="master-janitor").start()

@@ -1,0 +1,84 @@
+"""Cluster TLS/mTLS: ssl contexts from security.toml [tls] settings.
+
+Equivalent of weed/security/tls.go (LoadServerTLS/LoadClientTLS): the
+reference wraps every gRPC connection in mutual TLS when security.toml
+carries ca/cert/key paths; here the same three files wrap every
+inter-server HTTP socket.  One call to `enable_cluster_tls` flips the
+whole process: servers listen with HTTPS (requiring client certs when a
+CA is given) and every outgoing http:// URL is upgraded + verified.
+
+    [tls]
+    ca          = "/etc/seaweedfs/ca.crt"     # peer verification root
+    cert        = "/etc/seaweedfs/node.crt"   # this node's certificate
+    key         = "/etc/seaweedfs/node.key"
+    verify_client = true                       # mTLS (default when ca set)
+"""
+
+from __future__ import annotations
+
+import ssl
+from dataclasses import dataclass
+from typing import Optional
+
+from ..utils.httpd import set_client_tls
+
+
+@dataclass
+class TlsConfig:
+    ca_file: str = ""
+    cert_file: str = ""
+    key_file: str = ""
+    verify_client: bool = True
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.cert_file and self.key_file)
+
+
+def from_configuration(conf) -> TlsConfig:
+    """security.toml [tls] section -> TlsConfig (absent section = off)."""
+    return TlsConfig(
+        ca_file=conf.get_string("tls.ca") or "",
+        cert_file=conf.get_string("tls.cert") or "",
+        key_file=conf.get_string("tls.key") or "",
+        verify_client=bool(conf.get("tls.verify_client", True)),
+    )
+
+
+def server_context(cfg: TlsConfig) -> Optional[ssl.SSLContext]:
+    """ssl context for `serve(..., tls_context=...)`; None when TLS off."""
+    if not cfg.enabled:
+        return None
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    ctx.load_cert_chain(cfg.cert_file, cfg.key_file)
+    if cfg.ca_file:
+        ctx.load_verify_locations(cfg.ca_file)
+        if cfg.verify_client:
+            ctx.verify_mode = ssl.CERT_REQUIRED  # mutual TLS
+    return ctx
+
+
+def client_context(cfg: TlsConfig) -> Optional[ssl.SSLContext]:
+    """ssl context for outgoing requests: verifies the server against the
+    CA and presents this node's cert (the mTLS client half)."""
+    if not cfg.enabled:
+        return None
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+    if cfg.ca_file:
+        ctx.load_verify_locations(cfg.ca_file)
+    else:  # pragma: no cover - cert without CA: trust it directly
+        ctx.load_verify_locations(cfg.cert_file)
+    # cluster certs are issued to node names, not necessarily the IPs
+    # servers dial each other by — the CA signature is the trust anchor
+    ctx.check_hostname = False
+    ctx.load_cert_chain(cfg.cert_file, cfg.key_file)
+    return ctx
+
+
+def enable_cluster_tls(cfg: TlsConfig) -> Optional[ssl.SSLContext]:
+    """Install the client side process-wide and return the server context
+    for `serve`.  Returns None (and installs nothing) when cfg is off."""
+    if not cfg.enabled:
+        return None
+    set_client_tls(client_context(cfg))
+    return server_context(cfg)

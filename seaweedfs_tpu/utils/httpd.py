@@ -10,6 +10,7 @@ handler functions later).
 from __future__ import annotations
 
 import json
+import os
 import re
 import threading
 import time as _time
@@ -53,10 +54,17 @@ class Request:
 
 class Response:
     def __init__(self, data=None, status: int = 200, raw: Optional[bytes] = None,
-                 headers: Optional[dict] = None):
+                 headers: Optional[dict] = None,
+                 file_path: Optional[str] = None,
+                 file_range: Optional[tuple[int, int]] = None):
         self.data = data
         self.status = status
         self.raw = raw
+        # file streaming: the body is (a range of) a file on disk, sent in
+        # bounded chunks — a 30GB .dat copy never materializes in memory
+        # (the streaming VolumeEcShardRead / CopyFile analog)
+        self.file_path = file_path
+        self.file_range = file_range  # (offset, length) or None = whole file
         self.headers = headers or {}
 
 
@@ -139,6 +147,31 @@ class Router:
     @staticmethod
     def _send(handler: BaseHTTPRequestHandler, resp: Response) -> None:
         try:
+            if resp.file_path is not None:
+                import os as _os
+
+                size = _os.path.getsize(resp.file_path)
+                off, length = resp.file_range or (0, size)
+                length = max(0, min(length, size - off))
+                ctype = resp.headers.pop("Content-Type",
+                                         "application/octet-stream")
+                handler.send_response(resp.status)
+                handler.send_header("Content-Type", ctype)
+                handler.send_header("Content-Length", str(length))
+                for k, v in resp.headers.items():
+                    handler.send_header(k, v)
+                handler.end_headers()
+                if handler.command != "HEAD":
+                    with open(resp.file_path, "rb") as f:
+                        f.seek(off)
+                        left = length
+                        while left > 0:
+                            piece = f.read(min(left, 1 << 20))
+                            if not piece:
+                                break
+                            handler.wfile.write(piece)
+                            left -= len(piece)
+                return
             if resp.raw is not None:
                 body = resp.raw
                 ctype = resp.headers.pop("Content-Type", "application/octet-stream")
@@ -167,7 +200,11 @@ EXTRA_METHODS = ("OPTIONS", "PROPFIND", "PROPPATCH", "MKCOL", "MOVE", "COPY",
                  "LOCK", "UNLOCK")
 
 
-def serve(router: Router, host: str, port: int) -> ThreadingHTTPServer:
+def serve(router: Router, host: str, port: int,
+          tls_context=None) -> ThreadingHTTPServer:
+    """Start the threaded server; with tls_context (an ssl.SSLContext from
+    security.tls.server_context) the listening socket speaks HTTPS and —
+    when the context demands client certs — enforces mTLS."""
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
 
@@ -194,11 +231,34 @@ def serve(router: Router, host: str, port: int) -> ThreadingHTTPServer:
                 (lambda m: lambda self: router.dispatch(self, m))(_m))
 
     server = ThreadingHTTPServer((host, port), Handler)
+    if tls_context is not None:
+        server.socket = tls_context.wrap_socket(server.socket,
+                                                server_side=True)
     server.daemon_threads = True
     thread = threading.Thread(target=server.serve_forever, daemon=True,
                               name=f"{router.name}:{port}")
     thread.start()
     return server
+
+
+# --- cluster TLS ------------------------------------------------------------
+# One switch for the whole process (security.toml [tls] analog): when a
+# client SSL context is installed, every inter-server URL is upgraded from
+# http:// to https:// and verified (optionally with a client cert = mTLS).
+_client_tls = None
+
+
+def set_client_tls(context) -> None:
+    """Install (or clear, with None) the process-wide client SSL context."""
+    global _client_tls
+    _client_tls = context
+
+
+def _prep_url(url: str):
+    """Returns (url, ssl_context) with the scheme upgraded when TLS is on."""
+    if _client_tls is not None and url.startswith("http://"):
+        return "https://" + url[len("http://"):], _client_tls
+    return url, (_client_tls if url.startswith("https://") else None)
 
 
 # --- client helpers ---------------------------------------------------------
@@ -214,11 +274,13 @@ def stop_server(server) -> None:
 def http_json(method: str, url: str, payload: Optional[dict] = None,
               timeout: float = 30.0) -> dict:
     data = json.dumps(payload).encode() if payload is not None else None
+    url, ssl_ctx = _prep_url(url)
     req = urllib.request.Request(url, data=data, method=method)
     if data is not None:
         req.add_header("Content-Type", "application/json")
     try:
-        with urllib.request.urlopen(req, timeout=timeout) as r:
+        with urllib.request.urlopen(req, timeout=timeout,
+                                    context=ssl_ctx) as r:
             body = r.read()
     except urllib.error.HTTPError as e:
         body = e.read()
@@ -276,15 +338,58 @@ _no_redirect_opener = urllib.request.build_opener(_NoRedirect)
 def http_bytes(method: str, url: str, payload: Optional[bytes] = None,
                headers: Optional[dict] = None, timeout: float = 60.0,
                follow_redirects: bool = True) -> tuple[int, bytes, dict]:
+    url, ssl_ctx = _prep_url(url)
     req = urllib.request.Request(url, data=payload, method=method)
     for k, v in (headers or {}).items():
         req.add_header(k, v)
-    opener = urllib.request.urlopen if follow_redirects else _no_redirect_opener.open
     try:
-        with opener(req, timeout=timeout) as r:
+        if follow_redirects:
+            r_ctx = urllib.request.urlopen(req, timeout=timeout,
+                                           context=ssl_ctx)
+        elif ssl_ctx is not None:
+            opener = urllib.request.build_opener(
+                _NoRedirect, urllib.request.HTTPSHandler(context=ssl_ctx))
+            r_ctx = opener.open(req, timeout=timeout)
+        else:
+            r_ctx = _no_redirect_opener.open(req, timeout=timeout)
+        with r_ctx as r:
             return r.status, r.read(), dict(r.headers)
     except urllib.error.HTTPError as e:
         return e.code, e.read(), dict(e.headers)
     except (urllib.error.URLError, ConnectionError, TimeoutError, OSError) as e:
         # dead/unreachable server: synthetic status 0 so callers fail over
         return 0, str(e).encode(), {}
+
+
+def http_download(method: str, url: str, dest_path: str,
+                  timeout: float = 3600.0,
+                  piece_bytes: int = 1 << 20) -> int:
+    """Stream a (possibly huge) response body straight to dest_path in
+    bounded pieces — the client half of Response(file_path=...) streaming.
+    Writes to dest_path.part and renames on success so a dropped transfer
+    never leaves a torn file under the final name.  Returns the HTTP
+    status (0 = unreachable)."""
+    url, ssl_ctx = _prep_url(url)
+    req = urllib.request.Request(url, method=method)
+    tmp = dest_path + ".part"
+    try:
+        with urllib.request.urlopen(req, timeout=timeout,
+                                    context=ssl_ctx) as r:
+            with open(tmp, "wb") as f:
+                while True:
+                    piece = r.read(piece_bytes)
+                    if not piece:
+                        break
+                    f.write(piece)
+            os.replace(tmp, dest_path)
+            return r.status
+    except urllib.error.HTTPError as e:
+        return e.code
+    except (urllib.error.URLError, ConnectionError, TimeoutError, OSError):
+        return 0
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass

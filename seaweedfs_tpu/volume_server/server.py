@@ -56,7 +56,8 @@ class VolumeServer:
                  pulse_seconds: float = 5.0, ec_engine: str = "cpu",
                  guard: Optional["Guard"] = None,
                  backends: Optional[dict] = None,
-                 full_sync_every: int = 12):
+                 full_sync_every: int = 12,
+                 tls_context=None):
         from ..security import Guard
 
         if backends:
@@ -82,6 +83,7 @@ class VolumeServer:
         self.router = Router("volume", metrics=self.metrics)
         self._register_routes()
         self._server = None
+        self._tls_context = tls_context
         self._stop = threading.Event()
 
     @property
@@ -90,7 +92,8 @@ class VolumeServer:
 
     # --- lifecycle --------------------------------------------------------
     def start(self) -> "VolumeServer":
-        self._server = serve(self.router, self.store.ip, self.store.port)
+        self._server = serve(self.router, self.store.ip, self.store.port,
+                             tls_context=self._tls_context)
         threading.Thread(target=self._heartbeat_loop, daemon=True,
                          name=f"heartbeat:{self.url}").start()
         return self
@@ -599,9 +602,11 @@ class VolumeServer:
             path = v.file_prefix + ext
             if not os.path.exists(path):
                 raise HttpError(404, f"{path} not found")
-            with self.store.volume_locks[vid]:
-                with open(path, "rb") as f:
-                    return Response(raw=f.read())
+            # streamed in bounded chunks (the CopyFile streaming RPC,
+            # volume_grpc_copy.go): a 30GB .dat never lands in memory.
+            # The source is readonly during copies, so no lock is held
+            # across the transfer.
+            return Response(file_path=path)
 
         @r.route("POST", "/admin/volume_copy")
         def volume_copy(req: Request) -> Response:
@@ -621,16 +626,17 @@ class VolumeServer:
             http_json("POST", f"http://{source}/admin/readonly",
                       {"volume_id": vid, "readonly": True})
             try:
+                from ..utils.httpd import http_download
+
                 base = volume_file_prefix(self.store.locations[0].directory,
                                           collection, vid)
                 for ext in (".dat", ".idx"):
-                    status, body, _ = http_bytes(
+                    status = http_download(
                         "GET", f"http://{source}/admin/volume_download"
-                               f"?volume_id={vid}&ext={ext}", timeout=3600)
+                               f"?volume_id={vid}&ext={ext}",
+                        base + ext, timeout=3600)
                     if status != 200:
                         raise HttpError(500, f"download {ext}: {status}")
-                    with open(base + ext, "wb") as f:
-                        f.write(body)
                 self.store._open_volume(
                     os.path.dirname(base), collection, vid)
             finally:
@@ -835,14 +841,14 @@ class VolumeServer:
                 exts.append(".ecx")
             if b.get("copy_ecj_file", True):
                 exts.append(".ecj")
+            from ..utils.httpd import http_download
+
             for ext in exts:
-                status, body, _ = http_bytes(
+                status = http_download(
                     "GET", f"http://{source}/admin/ec/download?volume_id={vid}"
-                           f"&collection={collection}&ext={ext}", timeout=600)
-                if status == 200:
-                    with open(base + ext, "wb") as f:
-                        f.write(body)
-                elif ext not in (".ecj",):  # missing journal is fine
+                           f"&collection={collection}&ext={ext}",
+                    base + ext, timeout=3600)
+                if status != 200 and ext not in (".ecj",):  # no journal is ok
                     raise HttpError(500, f"copy {ext} from {source}: {status}")
             return Response({})
 
@@ -853,8 +859,9 @@ class VolumeServer:
             path = base + req.query["ext"]
             if not os.path.exists(path):
                 raise HttpError(404, f"{path} not found")
-            with open(path, "rb") as f:
-                return Response(raw=f.read())
+            # streamed (VolumeEcShardRead streaming semantics,
+            # volume_grpc_erasure_coding.go:284-350)
+            return Response(file_path=path)
 
         @r.route("POST", "/admin/ec/delete")
         def ec_delete(req: Request) -> Response:

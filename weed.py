@@ -20,6 +20,14 @@ def _security():
     return load_security_configuration()
 
 
+def _cluster_tls():
+    """security.toml [tls] -> server ssl context (also installs the
+    process-wide mTLS client side); None when TLS is not configured."""
+    from seaweedfs_tpu.security.tls import enable_cluster_tls, from_configuration
+
+    return enable_cluster_tls(from_configuration(_security()))
+
+
 def cmd_master(args) -> None:
     from seaweedfs_tpu.master.server import MasterServer
     from seaweedfs_tpu.security.config import master_guard
@@ -29,7 +37,8 @@ def cmd_master(args) -> None:
                      volume_size_limit_mb=args.volumeSizeLimitMB,
                      default_replication=args.defaultReplication,
                      peers=peers, mdir=args.mdir,
-                     guard=master_guard(_security())).start()
+                     guard=master_guard(_security()),
+                     tls_context=_cluster_tls()).start()
     print(f"master listening on {m.url}")
     _on_interrupt(m.stop)
     _wait_forever()
@@ -43,7 +52,8 @@ def cmd_volume(args) -> None:
                       port=args.port, data_center=args.dataCenter,
                       rack=args.rack, max_volume_count=args.max,
                       ec_engine=args.ec_engine,
-                      guard=volume_guard(_security())).start()
+                      guard=volume_guard(_security()),
+                      tls_context=_cluster_tls()).start()
     print(f"volume server listening on {vs.url}, dirs {args.dir}")
     _on_interrupt(vs.stop)
     _wait_forever()
@@ -67,7 +77,8 @@ def cmd_filer(args) -> None:
                     chunk_cache_dir=args.cacheDir,
                     chunk_cache_mem_mb=args.cacheSizeMB,
                     guard=filer_guard(_security()),
-                    peers=[p for p in args.peers.split(",") if p]).start()
+                    peers=[p for p in args.peers.split(",") if p],
+                    tls_context=_cluster_tls()).start()
     print(f"filer listening on {f.url}")
     if args.s3:
         s3 = S3ApiServer(f, host=args.ip, port=args.s3_port).start()
