@@ -398,6 +398,17 @@ class FilerServer:
             return Response(raw=REGISTRY.expose().encode(), headers={
                 "Content-Type": "text/plain; version=0.0.4; charset=utf-8"})
 
+        from ..utils.debug import register_debug_routes
+
+        register_debug_routes(r, name=f"filer {self.url}", status_fn=lambda: {
+            "Version": "seaweedfs-tpu 0.1",
+            "Master": self.master_url,
+            "Store": self.filer.store.name,
+            "Signature": self.filer.signature,
+            "PeersAggregated": self.meta_aggregator.peers,
+            "PeerEventsApplied": self.meta_aggregator.applied,
+        })
+
         @r.route("GET", "/api/stat(/.*)")
         def api_stat(req: Request) -> Response:
             entry = self.filer.find_entry(req.match.group(1))

@@ -301,6 +301,18 @@ class MasterServer:
             return Response({"Topology": self.topo.to_map(),
                              "Version": "seaweedfs-tpu 0.1"})
 
+        from ..utils.debug import register_debug_routes
+
+        register_debug_routes(r, name=f"master {self.url}", status_fn=lambda: {
+            "Version": "seaweedfs-tpu 0.1",
+            "IsLeader": self.is_leader,
+            "Leader": self.leader_url,
+            "MaxVolumeId": self.topo.max_volume_id,
+            "MaintenanceRuns": self.maintenance_runs,
+            "MaintenanceErrors": self.maintenance_errors,
+            "Topology": self.topo.to_map(),
+        })
+
         @r.route("GET", "/cluster/status")
         def cluster_status(req: Request) -> Response:
             return Response({"IsLeader": self.is_leader,

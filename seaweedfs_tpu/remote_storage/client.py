@@ -95,6 +95,14 @@ class RemoteStorageClient:
     def list_buckets(self) -> list[str]:
         raise NotImplementedError
 
+    def create_bucket(self, bucket: str) -> None:
+        """Optional: backends without bucket semantics may leave this
+        unimplemented (filer.remote.gateway maps by prefix then)."""
+        raise NotImplementedError
+
+    def delete_bucket(self, bucket: str) -> None:
+        raise NotImplementedError
+
 
 class LocalRemoteStorage(RemoteStorageClient):
     """remote_storage for a plain directory — 'bucket' = subdirectory."""
@@ -147,6 +155,16 @@ class LocalRemoteStorage(RemoteStorageClient):
     def list_buckets(self) -> list[str]:
         return sorted(d for d in os.listdir(self.root)
                       if os.path.isdir(os.path.join(self.root, d)))
+
+    def create_bucket(self, bucket: str) -> None:
+        os.makedirs(os.path.join(self.root, bucket), exist_ok=True)
+
+    def delete_bucket(self, bucket: str) -> None:
+        import shutil
+
+        path = os.path.join(self.root, bucket)
+        if os.path.isdir(path):
+            shutil.rmtree(path)
 
 
 class S3RemoteStorage(RemoteStorageClient):
@@ -256,6 +274,18 @@ class S3RemoteStorage(RemoteStorageClient):
         names = [el.text for el in root.iter()
                  if el.tag.endswith("Name") and el.text]
         return sorted(n for n in names if n)
+
+    def create_bucket(self, bucket: str) -> None:
+        url = f"http://{self.endpoint}/{bucket}"
+        status, body, _ = http_bytes("PUT", self._signed("PUT", url))
+        if status not in (200, 409):  # 409 = already exists
+            raise HttpError(status, body.decode(errors="replace"))
+
+    def delete_bucket(self, bucket: str) -> None:
+        url = f"http://{self.endpoint}/{bucket}"
+        status, body, _ = http_bytes("DELETE", self._signed("DELETE", url))
+        if status not in (204, 404):
+            raise HttpError(status, body.decode(errors="replace"))
 
 
 _GATED = {

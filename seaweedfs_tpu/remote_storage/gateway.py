@@ -1,0 +1,147 @@
+"""filer.remote.gateway: mirror S3 bucket lifecycle into cloud storage.
+
+Equivalent of weed/command/filer_remote_gateway*.go: tails the filer's
+meta log scoped to /buckets and keeps a configured remote storage in
+step — a newly created bucket becomes a remote mount (and, where the
+backend supports it, a remote bucket); a deleted bucket unmounts (and
+optionally deletes remotely); object mutations inside mapped buckets
+ride one RemoteSyncer per bucket, exactly the filer.remote.sync engine.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+from ..utils.httpd import http_json
+from .client import RemoteLocation, make_client
+from .mounts import RemoteMounts, read_remote_conf
+from .sync import RemoteSyncer
+
+BUCKETS_DIR = "/buckets"
+
+
+class RemoteGateway:
+    def __init__(self, filer_url: str, remote_conf_name: str,
+                 bucket_prefix: str = "",
+                 delete_remote_buckets: bool = False,
+                 poll_interval: float = 0.5,
+                 since_ns: Optional[int] = None):
+        self.filer_url = filer_url
+        self.conf_name = remote_conf_name
+        conf = read_remote_conf(filer_url).get(remote_conf_name)
+        if conf is None:
+            raise ValueError(f"remote conf {remote_conf_name!r} missing")
+        self.client = make_client(conf)
+        self.bucket_prefix = bucket_prefix
+        self.delete_remote_buckets = delete_remote_buckets
+        self.poll_interval = poll_interval
+        self.since_ns = time.time_ns() if since_ns is None else since_ns
+        self.mapped = 0
+        self.unmapped = 0
+        self._syncers: dict[str, RemoteSyncer] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # buckets mounted before the gateway started keep syncing
+        for d, loc in RemoteMounts.read(filer_url).mounts.items():
+            if d.startswith(BUCKETS_DIR + "/") and \
+                    loc.conf_name == remote_conf_name:
+                self._start_syncer(d)
+
+    # --- bucket lifecycle -------------------------------------------------
+    def _remote_bucket(self, name: str) -> str:
+        return f"{self.bucket_prefix}{name}" if self.bucket_prefix else name
+
+    def _map_bucket(self, name: str) -> None:
+        mount_dir = f"{BUCKETS_DIR}/{name}"
+        mounts = RemoteMounts.read(self.filer_url)
+        if mount_dir in mounts.mounts:
+            return
+        remote = self._remote_bucket(name)
+        try:
+            self.client.create_bucket(remote)
+        except (AttributeError, NotImplementedError):
+            pass  # backend without bucket semantics: prefix-only mapping
+        mounts.mounts[mount_dir] = RemoteLocation(
+            conf_name=self.conf_name, bucket=remote, path="/")
+        mounts.write(self.filer_url)
+        self._start_syncer(mount_dir)
+        self.mapped += 1
+
+    def _unmap_bucket(self, name: str) -> None:
+        mount_dir = f"{BUCKETS_DIR}/{name}"
+        syncer = self._syncers.pop(mount_dir, None)
+        if syncer is not None:
+            syncer.stop()
+        mounts = RemoteMounts.read(self.filer_url)
+        loc = mounts.mounts.pop(mount_dir, None)
+        if loc is not None:
+            mounts.write(self.filer_url)
+            if self.delete_remote_buckets:
+                try:
+                    self.client.delete_bucket(loc.bucket)
+                except (AttributeError, NotImplementedError):
+                    pass
+        self.unmapped += 1
+
+    def _start_syncer(self, mount_dir: str) -> None:
+        try:
+            self._syncers[mount_dir] = RemoteSyncer(
+                self.filer_url, mount_dir,
+                poll_interval=self.poll_interval).start()
+        except ValueError:
+            pass  # mount raced away
+
+    # --- event loop --------------------------------------------------------
+    def poll_once(self) -> int:
+        r = http_json(
+            "GET", f"http://{self.filer_url}/api/meta/log"
+                   f"?since_ns={self.since_ns}&path_prefix={BUCKETS_DIR}")
+        n = 0
+        for event in r.get("events", []):
+            entry = event.get("new_entry") or event.get("old_entry") or {}
+            path = entry.get("full_path", "")
+            # bucket-level events only: /buckets/<name> exactly
+            if not path.startswith(BUCKETS_DIR + "/"):
+                continue
+            name = path[len(BUCKETS_DIR) + 1:]
+            if "/" in name or not name:
+                continue
+            if event["op"] == "create" and event.get("new_entry"):
+                self._map_bucket(name)
+                n += 1
+            elif event["op"] == "delete" and not event.get("new_entry"):
+                self._unmap_bucket(name)
+                n += 1
+        self.since_ns = int(r.get("next_ns", self.since_ns))
+        return n
+
+    def run_until_caught_up(self, timeout: float = 30.0) -> int:
+        deadline = time.time() + timeout
+        total = 0
+        while time.time() < deadline:
+            n = self.poll_once()
+            total += n
+            if n == 0:
+                return total
+        return total
+
+    def start(self) -> "RemoteGateway":
+        def loop() -> None:
+            while not self._stop.is_set():
+                try:
+                    self.poll_once()
+                except Exception:
+                    pass
+                self._stop.wait(self.poll_interval)
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="remote-gateway")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        for syncer in self._syncers.values():
+            syncer.stop()

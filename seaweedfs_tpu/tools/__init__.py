@@ -1,0 +1,2 @@
+"""Standalone debug tools (the reference's unmaintained/ directory):
+see_dat, see_idx, see_meta — run as `python -m seaweedfs_tpu.tools.see_dat`."""

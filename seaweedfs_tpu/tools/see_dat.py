@@ -1,0 +1,68 @@
+"""see_dat: walk a raw `.dat` volume file and print every needle record.
+
+Equivalent of /root/reference/unmaintained/see_dat/see_dat.go — points a
+human at exactly what is on disk (offsets, ids, cookies, sizes, flags,
+timestamps) without needing a running server or an `.idx`.
+
+    python -m seaweedfs_tpu.tools.see_dat /path/to/1.dat [-v]
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from ..storage.needle import NEEDLE_HEADER_SIZE, Needle, needle_body_length
+from ..storage.super_block import SUPER_BLOCK_SIZE, SuperBlock
+from ..storage.types import size_is_valid
+
+
+def walk_dat(path: str):
+    """Yields (offset, needle) for every record; raises on a malformed
+    superblock, stops cleanly at a torn tail."""
+    with open(path, "rb") as f:
+        blob = f.read()
+    sb = SuperBlock.from_bytes(blob[:SUPER_BLOCK_SIZE + 0xFFFF])
+    yield 0, sb
+    offset = sb.block_size
+    while offset + NEEDLE_HEADER_SIZE <= len(blob):
+        n = Needle()
+        n.parse_header(blob[offset:offset + NEEDLE_HEADER_SIZE])
+        size = n.size if size_is_valid(n.size) else 0
+        body_len = needle_body_length(size, sb.version)
+        body = blob[offset + NEEDLE_HEADER_SIZE:
+                    offset + NEEDLE_HEADER_SIZE + body_len]
+        if len(body) < body_len:
+            print(f"torn tail at offset {offset}", file=sys.stderr)
+            return
+        n.read_body_bytes(body, sb.version)
+        yield offset, n
+        offset += NEEDLE_HEADER_SIZE + body_len
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("dat", help="path to a .dat volume file")
+    ap.add_argument("-v", action="store_true", help="also print names/mimes")
+    args = ap.parse_args(argv)
+    count = 0
+    for offset, rec in walk_dat(args.dat):
+        if isinstance(rec, SuperBlock):
+            print(f"superblock: version={int(rec.version)} "
+                  f"replica={rec.replica_placement} ttl={rec.ttl} "
+                  f"compact_revision={rec.compaction_revision}")
+            continue
+        n = rec
+        line = (f"offset {offset:>12} id {n.id:>8} cookie {n.cookie:08x} "
+                f"size {n.size:>8} flags {n.flags:02x} "
+                f"append_ns {n.append_at_ns}")
+        if args.v and (n.name or n.mime):
+            line += f" name={n.name!r} mime={n.mime!r}"
+        print(line)
+        count += 1
+    print(f"{count} needle records")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,31 @@
+"""see_idx: print every 16-byte entry of a `.idx` / `.ecx` index file.
+
+Equivalent of /root/reference/unmaintained/see_idx/see_idx.go.
+
+    python -m seaweedfs_tpu.tools.see_idx /path/to/1.idx
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from ..storage import idx as idx_mod
+from ..storage.types import TOMBSTONE_FILE_SIZE
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("idx", help="path to a .idx or .ecx file")
+    args = ap.parse_args(argv)
+    n = 0
+    for key, offset, size in idx_mod.iter_index_file(args.idx):
+        mark = " TOMBSTONE" if size == TOMBSTONE_FILE_SIZE else ""
+        print(f"key {key:>12} offset {offset:>12} size {size:>10}{mark}")
+        n += 1
+    print(f"{n} entries")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,136 @@
+"""/debug/pprof analog + server status UI.
+
+Equivalent of the reference's profiling/observability surface:
+util/grace/pprof.go (-cpuprofile/-memprofile) and the per-server status
+UIs (server/master_ui, volume_server_ui, filer_ui).  Python-native
+counterparts:
+
+  GET /debug/pprof/profile?seconds=N  — cProfile over a live window,
+                                        cumulative-time text report
+  GET /debug/pprof/goroutine          — all thread stacks (the goroutine
+                                        dump analog)
+  GET /debug/pprof/heap               — tracemalloc top allocations
+                                        (first call enables tracing)
+  GET /ui                             — minimal HTML status page built
+                                        from the server's /status JSON
+
+register_debug_routes(router, status_fn) wires all four onto any Router.
+"""
+
+from __future__ import annotations
+
+import cProfile
+import html
+import io
+import json
+import pstats
+import sys
+import threading
+import time
+import traceback
+from typing import Callable, Optional
+
+from .httpd import Request, Response, Router
+
+
+def _profile_text(seconds: float) -> str:
+    prof = cProfile.Profile()
+    prof.enable()
+    time.sleep(seconds)
+    prof.disable()
+    buf = io.StringIO()
+    stats = pstats.Stats(prof, stream=buf)
+    stats.sort_stats("cumulative").print_stats(60)
+    return buf.getvalue()
+
+
+def _thread_dump() -> str:
+    frames = sys._current_frames()
+    names = {t.ident: t for t in threading.enumerate()}
+    out = []
+    for ident, frame in frames.items():
+        t = names.get(ident)
+        label = f"{t.name} daemon={t.daemon}" if t else f"thread-{ident}"
+        out.append(f"--- {label} ---")
+        out.extend(line.rstrip()
+                   for line in traceback.format_stack(frame))
+        out.append("")
+    return "\n".join(out)
+
+
+def _heap_text(limit: int = 40) -> str:
+    import tracemalloc
+
+    if not tracemalloc.is_tracing():
+        tracemalloc.start()
+        return ("tracemalloc just enabled — allocations made from now on "
+                "will appear on the next call\n")
+    snap = tracemalloc.take_snapshot()
+    lines = [f"heap: {len(snap.traces)} traced blocks"]
+    for stat in snap.statistics("lineno")[:limit]:
+        lines.append(str(stat))
+    return "\n".join(lines) + "\n"
+
+
+def _render_status_html(name: str, status: dict) -> str:
+    """One dependency-free HTML page: every scalar becomes a stat row,
+    every list/dict a pretty-printed JSON block (the reference's server
+    UI templates show the same /status content)."""
+    rows, blocks = [], []
+    for k, v in status.items():
+        if isinstance(v, (str, int, float, bool)) or v is None:
+            rows.append(f"<tr><th>{html.escape(str(k))}</th>"
+                        f"<td>{html.escape(str(v))}</td></tr>")
+        else:
+            blocks.append(
+                f"<h2>{html.escape(str(k))}</h2>"
+                f"<pre>{html.escape(json.dumps(v, indent=2, default=str))}"
+                f"</pre>")
+    return f"""<!doctype html><html><head><title>{html.escape(name)}</title>
+<style>
+ body {{ font-family: sans-serif; margin: 2em; color: #222; }}
+ table {{ border-collapse: collapse; }}
+ th, td {{ text-align: left; padding: 4px 12px; border-bottom: 1px solid #ddd; }}
+ pre {{ background: #f6f6f6; padding: 1em; overflow-x: auto; }}
+ .links a {{ margin-right: 1em; }}
+</style></head><body>
+<h1>{html.escape(name)}</h1>
+<div class="links">
+ <a href="/status">status json</a>
+ <a href="/metrics">metrics</a>
+ <a href="/debug/pprof/goroutine">threads</a>
+ <a href="/debug/pprof/heap">heap</a>
+</div>
+<table>{''.join(rows)}</table>
+{''.join(blocks)}
+</body></html>"""
+
+
+def register_debug_routes(router: Router,
+                          status_fn: Optional[Callable[[], dict]] = None,
+                          name: str = "") -> None:
+    """Mount /debug/pprof/* (+ /ui when status_fn is given) on a Router."""
+
+    @router.route("GET", "/debug/pprof/profile")
+    def pprof_profile(req: Request) -> Response:
+        seconds = min(float(req.query.get("seconds", 2)), 60.0)
+        return Response(raw=_profile_text(seconds).encode(),
+                        headers={"Content-Type": "text/plain; charset=utf-8"})
+
+    @router.route("GET", "/debug/pprof/goroutine")
+    def pprof_goroutine(req: Request) -> Response:
+        return Response(raw=_thread_dump().encode(),
+                        headers={"Content-Type": "text/plain; charset=utf-8"})
+
+    @router.route("GET", "/debug/pprof/heap")
+    def pprof_heap(req: Request) -> Response:
+        return Response(raw=_heap_text().encode(),
+                        headers={"Content-Type": "text/plain; charset=utf-8"})
+
+    if status_fn is not None:
+        @router.route("GET", "/ui")
+        def status_ui(req: Request) -> Response:
+            page = _render_status_html(name or router.name, status_fn())
+            return Response(raw=page.encode(),
+                            headers={"Content-Type":
+                                     "text/html; charset=utf-8"})

@@ -330,6 +330,20 @@ class VolumeServer:
                 "EcVolumes": sorted(self.store.ec_volumes),
             })
 
+        from ..utils.debug import register_debug_routes
+
+        register_debug_routes(r, name=f"volume server {self.url}",
+                              status_fn=lambda: {
+                                  "Version": "seaweedfs-tpu 0.1",
+                                  "Master": self.master_url,
+                                  "DataCenter": self.data_center,
+                                  "Rack": self.rack,
+                                  "Volumes": [v.to_volume_information()
+                                              for v in
+                                              self.store.volumes.values()],
+                                  "EcVolumes": sorted(self.store.ec_volumes),
+                              })
+
         @r.route("GET", FID_PATTERN)
         @r.route("HEAD", FID_PATTERN)
         def read_object(req: Request) -> Response:

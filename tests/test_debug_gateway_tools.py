@@ -1,0 +1,116 @@
+"""Aux subsystems: /debug/pprof analog, status UIs, debug tools,
+filer.remote.gateway."""
+
+from __future__ import annotations
+
+import io
+import json
+import time
+from contextlib import redirect_stdout
+
+import pytest
+
+from seaweedfs_tpu.filer.filer_store import MemoryStore
+from seaweedfs_tpu.filer.server import FilerServer
+from seaweedfs_tpu.master.server import MasterServer
+from seaweedfs_tpu.utils.httpd import http_bytes, http_json
+from seaweedfs_tpu.volume_server.server import VolumeServer
+from tests.conftest import free_port
+
+
+@pytest.fixture
+def trio(tmp_path):
+    master = MasterServer(port=free_port(), pulse_seconds=0.3).start()
+    d = tmp_path / "v"
+    d.mkdir()
+    vs = VolumeServer([str(d)], master.url, port=free_port(),
+                      pulse_seconds=0.3).start()
+    deadline = time.time() + 5
+    while time.time() < deadline and not master.topo.all_nodes():
+        time.sleep(0.05)
+    filer = FilerServer(master.url, MemoryStore(), port=free_port()).start()
+    yield master, vs, filer
+    filer.stop()
+    vs.stop()
+    master.stop()
+
+
+def test_debug_endpoints_on_every_server(trio):
+    master, vs, filer = trio
+    for url in (master.url, vs.url, filer.url):
+        st, body, h = http_bytes("GET", f"http://{url}/debug/pprof/goroutine")
+        assert st == 200 and b"--- " in body  # thread stacks
+        st, body, _ = http_bytes("GET", f"http://{url}/debug/pprof/heap")
+        assert st == 200
+        st, body, h = http_bytes("GET", f"http://{url}/ui")
+        assert st == 200 and h["Content-Type"].startswith("text/html")
+        assert b"seaweedfs-tpu" in body
+
+
+def test_pprof_profile_window(trio):
+    master, _, _ = trio
+    t0 = time.time()
+    st, body, _ = http_bytes(
+        "GET", f"http://{master.url}/debug/pprof/profile?seconds=0.2")
+    assert st == 200 and time.time() - t0 >= 0.2
+    assert b"cumulative" in body  # pstats report
+
+
+def test_see_dat_and_see_idx_on_reference_fixture(capsys):
+    from seaweedfs_tpu.tools import see_dat, see_idx
+
+    assert see_idx.main(
+        ["/root/reference/weed/storage/erasure_coding/1.idx"]) == 0
+    out = capsys.readouterr().out
+    assert "entries" in out and "key" in out
+    assert see_dat.main(
+        ["/root/reference/weed/storage/erasure_coding/1.dat"]) == 0
+    out = capsys.readouterr().out
+    assert "superblock: version=3" in out
+    assert "needle records" in out
+
+
+def test_remote_gateway_maps_buckets(trio, tmp_path):
+    from seaweedfs_tpu.gateway.s3 import S3ApiServer
+    from seaweedfs_tpu.remote_storage.gateway import RemoteGateway
+    from seaweedfs_tpu.remote_storage.mounts import (
+        MOUNTS_PATH,
+        RemoteMounts,
+        write_remote_conf,
+    )
+    from seaweedfs_tpu.remote_storage.client import RemoteConf
+
+    master, vs, filer = trio
+    s3 = S3ApiServer(filer, port=free_port()).start()
+    cloud = tmp_path / "cloud"
+    cloud.mkdir()
+    write_remote_conf(filer.url, {"mycloud": RemoteConf(
+        type="local", name="mycloud", root=str(cloud))})
+    gw = RemoteGateway(filer.url, "mycloud", poll_interval=0.1)
+    try:
+        # bucket creation through the S3 gateway -> remote mapping appears
+        st, _, _ = http_bytes("PUT", f"http://{s3.url}/gwbucket")
+        assert st == 200
+        gw.run_until_caught_up()
+        mounts = RemoteMounts.read(filer.url)
+        assert "/buckets/gwbucket" in mounts.mounts
+        assert (cloud / "gwbucket").is_dir()  # remote bucket created
+        # an object PUT is pushed to the remote by the per-bucket syncer
+        st, _, _ = http_bytes("PUT", f"http://{s3.url}/gwbucket/hello.txt",
+                              b"gateway sync")
+        assert st == 200
+        deadline = time.time() + 5
+        target = cloud / "gwbucket" / "hello.txt"
+        while time.time() < deadline and not target.exists():
+            time.sleep(0.05)
+        assert target.read_bytes() == b"gateway sync"
+        # bucket deletion unmaps (remote bucket kept: deleteBucket=False)
+        http_bytes("DELETE", f"http://{s3.url}/gwbucket/hello.txt")
+        st, _, _ = http_bytes("DELETE", f"http://{s3.url}/gwbucket")
+        assert st == 204
+        gw.run_until_caught_up()
+        assert "/buckets/gwbucket" not in RemoteMounts.read(filer.url).mounts
+        assert (cloud / "gwbucket").is_dir()
+    finally:
+        gw.stop()
+        s3.stop()

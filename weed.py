@@ -244,6 +244,19 @@ def cmd_filer_remote_sync(args) -> None:
     _wait_forever()
 
 
+def cmd_filer_remote_gateway(args) -> None:
+    """Mirror /buckets lifecycle + objects into a configured remote
+    storage (command/filer_remote_gateway*.go)."""
+    from seaweedfs_tpu.remote_storage.gateway import RemoteGateway
+
+    gw = RemoteGateway(args.filer, args.remote,
+                       bucket_prefix=args.createBucketWithPrefix,
+                       delete_remote_buckets=args.deleteBucket).start()
+    print(f"filer.remote.gateway: {args.filer} /buckets -> {args.remote}")
+    _on_interrupt(gw.stop)
+    _wait_forever()
+
+
 def cmd_mount(args) -> None:
     """FUSE-mount a filer path (weed mount, mount/weedfs.go)."""
     from seaweedfs_tpu.mount.fuse_bridge import mount
@@ -487,6 +500,16 @@ def main(argv=None) -> None:
     frs.add_argument("-dir", required=True,
                      help="comma-separated remote-mounted directories")
     frs.set_defaults(fn=cmd_filer_remote_sync)
+
+    frg = sub.add_parser("filer.remote.gateway")
+    frg.add_argument("-filer", default="127.0.0.1:8888")
+    frg.add_argument("-remote", required=True,
+                     help="remote conf name from /etc/remote.conf")
+    frg.add_argument("-createBucketWithPrefix", default="",
+                     help="prefix for remote bucket names")
+    frg.add_argument("-deleteBucket", action="store_true",
+                     help="also delete the remote bucket on local delete")
+    frg.set_defaults(fn=cmd_filer_remote_gateway)
 
     mt = sub.add_parser("mount")
     mt.add_argument("-filer", default="127.0.0.1:8888")
