@@ -230,6 +230,66 @@ def _child(scratch_path: str, platform: str = "") -> None:
 
     section("e2e_stream", meas_e2e)
 
+    # --- cluster write/read req/s (weed benchmark analog) ------------------
+    def meas_cluster():
+        """Bounded in-process cluster microbench: assign -> PUT -> GET of
+        1KB needles at c=16, the shape of the reference's README numbers
+        (command/benchmark.go: 15.7k w/s, 47k r/s on a 2012 MacBook)."""
+        import concurrent.futures
+        import socket
+        import tempfile as _tempfile
+        import threading
+
+        from seaweedfs_tpu.client.operation import WeedClient
+        from seaweedfs_tpu.master.server import MasterServer
+        from seaweedfs_tpu.volume_server.server import VolumeServer
+
+        def free_port():
+            s = socket.socket()
+            s.bind(("127.0.0.1", 0))
+            p = s.getsockname()[1]
+            s.close()
+            return p
+
+        td = _tempfile.mkdtemp()
+        m = MasterServer(port=free_port(), pulse_seconds=0.5).start()
+        vs = VolumeServer([td], m.url, port=free_port(), pulse_seconds=0.5,
+                          max_volume_count=16).start()
+        try:
+            deadline = time.time() + 10
+            while time.time() < deadline and not m.topo.all_nodes():
+                time.sleep(0.05)
+            client = WeedClient(m.url)
+            payload = rng.integers(0, 256, 1024, dtype=np.uint8).tobytes()
+            n, c = 4000, 16
+            fids: list = []
+            lock = threading.Lock()
+
+            def w(i):
+                fid = client.upload(payload, name=f"b{i}")
+                with lock:
+                    fids.append(fid)
+
+            t0 = time.perf_counter()
+            with concurrent.futures.ThreadPoolExecutor(c) as ex:
+                list(ex.map(w, range(n)))
+            detail["cluster_write_rps"] = round(
+                n / (time.perf_counter() - t0), 1)
+
+            def r(fid):
+                assert client.download(fid) == payload
+
+            t0 = time.perf_counter()
+            with concurrent.futures.ThreadPoolExecutor(c) as ex:
+                list(ex.map(r, list(fids)))
+            detail["cluster_read_rps"] = round(
+                n / (time.perf_counter() - t0), 1)
+        finally:
+            vs.stop()
+            m.stop()
+
+    section("cluster", meas_cluster)
+
     # --- parity check ------------------------------------------------------
     def meas_parity():
         sample = rng.integers(0, 256, (10, 1 << 20), dtype=np.uint8)

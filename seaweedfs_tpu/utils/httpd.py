@@ -94,6 +94,16 @@ class Router:
         return deco
 
     def dispatch(self, handler: BaseHTTPRequestHandler, method: str) -> None:
+        if getattr(handler.server, "_stopping", False):
+            # a stopped server's keep-alive connections outlive
+            # server_close(); without this, a client pinned to such a
+            # connection keeps talking to the ZOMBIE server object while a
+            # fresh server owns the port (master-restart convergence bug)
+            handler.close_connection = True
+            self._send(handler, Response({"error": "server shutting down"},
+                                         status=503,
+                                         headers={"Connection": "close"}))
+            return
         path = urllib.parse.urlparse(handler.path).path
         for m, pattern, fn in self.routes:
             if m != method:
@@ -207,6 +217,10 @@ def serve(router: Router, host: str, port: int,
     when the context demands client certs — enforces mTLS."""
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
+        # headers and body flush as separate segments; with Nagle on, the
+        # client's delayed ACK stalls every keep-alive exchange ~40ms —
+        # the difference between ~20 and ~1000 req/s per connection
+        disable_nagle_algorithm = True
 
         def log_message(self, fmt, *args):  # quiet by default
             pass
@@ -261,12 +275,103 @@ def _prep_url(url: str):
     return url, (_client_tls if url.startswith("https://") else None)
 
 
+# --- pooled keep-alive client ------------------------------------------------
+# One persistent TCP_NODELAY connection per (thread, scheme, netloc).  A
+# fresh TCP connection per request costs handshake + slow-start and (with
+# the tiny request/response segments the control plane sends) falls into
+# Nagle/delayed-ACK stalls; pooling is the difference between ~400 and
+# many thousands of cluster req/s.
+
+import http.client as _http_client
+
+
+class _ConnPool(threading.local):
+    def __init__(self):
+        self.conns: dict = {}
+
+
+_pool = _ConnPool()
+
+
+def _pool_connect(scheme: str, netloc: str, timeout: float, ssl_ctx):
+    if scheme == "https":
+        conn = _http_client.HTTPSConnection(netloc, timeout=timeout,
+                                            context=ssl_ctx)
+    else:
+        conn = _http_client.HTTPConnection(netloc, timeout=timeout)
+    conn.connect()
+    try:
+        conn.sock.setsockopt(__import__("socket").IPPROTO_TCP,
+                             __import__("socket").TCP_NODELAY, 1)
+    except OSError:  # pragma: no cover
+        pass
+    return conn
+
+
+def _pooled_request(method: str, url: str, body: Optional[bytes],
+                    headers: Optional[dict], timeout: float
+                    ) -> tuple[int, bytes, dict]:
+    """One request over the pool; raises OSError family on failure.
+    A request that fails on a REUSED connection retries once on a fresh
+    one (the server closed the idle keep-alive — it never saw the
+    request); a failure on a brand-new connection propagates."""
+    url, ssl_ctx = _prep_url(url)
+    parsed = urllib.parse.urlsplit(url)
+    key = (parsed.scheme, parsed.netloc)
+    target = (parsed.path or "/") + (f"?{parsed.query}" if parsed.query
+                                     else "")
+    for _ in range(2):
+        conn = _pool.conns.get(key)
+        reused = conn is not None
+        if conn is None:
+            conn = _pool_connect(parsed.scheme, parsed.netloc, timeout,
+                                 ssl_ctx)
+            _pool.conns[key] = conn
+        try:
+            if conn.sock is not None:
+                conn.sock.settimeout(timeout)
+            conn.request(method, target, body, headers or {})
+            resp = conn.getresponse()
+            data = resp.read()
+            hdrs = dict(resp.headers)
+            if resp.will_close:
+                conn.close()
+                _pool.conns.pop(key, None)
+            return resp.status, data, hdrs
+        except Exception:
+            conn.close()
+            _pool.conns.pop(key, None)
+            if not reused:
+                raise
+    raise OSError("unreachable")  # pragma: no cover
+
+
+def _pooled_with_redirects(method: str, url: str, body: Optional[bytes],
+                           headers: Optional[dict], timeout: float,
+                           follow_redirects: bool
+                           ) -> tuple[int, bytes, dict]:
+    for _ in range(5):
+        status, data, hdrs = _pooled_request(method, url, body, headers,
+                                             timeout)
+        if follow_redirects and status in (301, 302, 303, 307, 308) \
+                and hdrs.get("Location"):
+            url = urllib.parse.urljoin(url, hdrs["Location"])
+            if status == 303:
+                method, body = "GET", None
+            continue
+        return status, data, hdrs
+    return status, data, hdrs
+
+
 # --- client helpers ---------------------------------------------------------
 
 def stop_server(server) -> None:
     """Shut down a serve() result: stop the loop AND close the listening
     socket — otherwise clients queue in the accept backlog and hang
-    instead of failing over."""
+    instead of failing over.  Surviving keep-alive handler threads see
+    _stopping and answer 503 + Connection: close, so pooled clients
+    migrate to whoever owns the port next."""
+    server._stopping = True
     server.shutdown()
     server.server_close()
 
@@ -274,23 +379,18 @@ def stop_server(server) -> None:
 def http_json(method: str, url: str, payload: Optional[dict] = None,
               timeout: float = 30.0) -> dict:
     data = json.dumps(payload).encode() if payload is not None else None
-    url, ssl_ctx = _prep_url(url)
-    req = urllib.request.Request(url, data=data, method=method)
-    if data is not None:
-        req.add_header("Content-Type", "application/json")
+    headers = {"Content-Type": "application/json"} if data is not None else {}
     try:
-        with urllib.request.urlopen(req, timeout=timeout,
-                                    context=ssl_ctx) as r:
-            body = r.read()
-    except urllib.error.HTTPError as e:
-        body = e.read()
+        status, body, _ = _pooled_with_redirects(method, url, data, headers,
+                                                 timeout, True)
+    except (ConnectionError, TimeoutError, OSError) as e:
+        raise HttpError(503, f"{url} unreachable: {e}") from None
+    if status >= 400:
         try:
             err = json.loads(body).get("error", body.decode(errors="replace"))
         except Exception:
             err = body.decode(errors="replace")
-        raise HttpError(e.code, err) from None
-    except (urllib.error.URLError, ConnectionError, TimeoutError, OSError) as e:
-        raise HttpError(503, f"{url} unreachable: {e}") from None
+        raise HttpError(status, err) from None
     return json.loads(body) if body else {}
 
 
@@ -327,36 +427,13 @@ def parse_range(range_header: str, file_size: int) -> Optional[tuple[int, int]]:
         return None
 
 
-class _NoRedirect(urllib.request.HTTPRedirectHandler):
-    def redirect_request(self, *args, **kwargs):
-        return None
-
-
-_no_redirect_opener = urllib.request.build_opener(_NoRedirect)
-
-
 def http_bytes(method: str, url: str, payload: Optional[bytes] = None,
                headers: Optional[dict] = None, timeout: float = 60.0,
                follow_redirects: bool = True) -> tuple[int, bytes, dict]:
-    url, ssl_ctx = _prep_url(url)
-    req = urllib.request.Request(url, data=payload, method=method)
-    for k, v in (headers or {}).items():
-        req.add_header(k, v)
     try:
-        if follow_redirects:
-            r_ctx = urllib.request.urlopen(req, timeout=timeout,
-                                           context=ssl_ctx)
-        elif ssl_ctx is not None:
-            opener = urllib.request.build_opener(
-                _NoRedirect, urllib.request.HTTPSHandler(context=ssl_ctx))
-            r_ctx = opener.open(req, timeout=timeout)
-        else:
-            r_ctx = _no_redirect_opener.open(req, timeout=timeout)
-        with r_ctx as r:
-            return r.status, r.read(), dict(r.headers)
-    except urllib.error.HTTPError as e:
-        return e.code, e.read(), dict(e.headers)
-    except (urllib.error.URLError, ConnectionError, TimeoutError, OSError) as e:
+        return _pooled_with_redirects(method, url, payload, headers,
+                                      timeout, follow_redirects)
+    except (ConnectionError, TimeoutError, OSError) as e:
         # dead/unreachable server: synthetic status 0 so callers fail over
         return 0, str(e).encode(), {}
 

@@ -85,6 +85,9 @@ class VolumeServer:
         self._server = None
         self._tls_context = tls_context
         self._stop = threading.Event()
+        # vid -> (replica urls, expiry); see _lookup_replicas
+        self._vid_cache: dict[int, tuple[list, float]] = {}
+        self.vid_cache_ttl = 10.0
 
     @property
     def url(self) -> str:
@@ -182,12 +185,26 @@ class VolumeServer:
 
     # --- helpers ----------------------------------------------------------
     def _lookup_replicas(self, vid: int) -> list[str]:
+        """Replica locations with a short TTL cache
+        (operation/lookup_vid_cache.go — the reference caches for 10min;
+        shorter here because membership changes propagate by heartbeat
+        pulses).  Without the cache EVERY replicated write pays a master
+        round trip, which caps cluster write throughput at the master."""
+        now = time.monotonic()
+        hit = self._vid_cache.get(vid)
+        if hit is not None and hit[1] > now:
+            return hit[0]
         try:
             r = http_json("GET",
                           f"http://{self.master_url}/dir/lookup?volumeId={vid}")
-            return [loc["url"] for loc in r.get("locations", [])]
+            locs = [loc["url"] for loc in r.get("locations", [])]
         except HttpError:
             return []
+        self._vid_cache[vid] = (locs, now + self.vid_cache_ttl)
+        if len(self._vid_cache) > 10_000:  # bound growth on churny clusters
+            self._vid_cache = {k: v for k, v in self._vid_cache.items()
+                               if v[1] > now}
+        return locs
 
     def _fetch_remote_shard(self, vid: int, shard_id: int, offset: int,
                             length: int) -> bytes:
