@@ -284,6 +284,29 @@ def _child(scratch_path: str, platform: str = "") -> None:
                 list(ex.map(r, list(fids)))
             detail["cluster_read_rps"] = round(
                 n / (time.perf_counter() - t0), 1)
+
+            # framed-TCP data path (benchmark -useTcp)
+            tcp_fids: list = []
+
+            def wt(i):
+                fid = client.upload_tcp(payload)
+                with lock:
+                    tcp_fids.append(fid)
+
+            t0 = time.perf_counter()
+            with concurrent.futures.ThreadPoolExecutor(c) as ex:
+                list(ex.map(wt, range(n)))
+            detail["cluster_tcp_write_rps"] = round(
+                n / (time.perf_counter() - t0), 1)
+
+            def rt(fid):
+                assert client.download_tcp(fid) == payload
+
+            t0 = time.perf_counter()
+            with concurrent.futures.ThreadPoolExecutor(c) as ex:
+                list(ex.map(rt, list(tcp_fids)))
+            detail["cluster_tcp_read_rps"] = round(
+                n / (time.perf_counter() - t0), 1)
         finally:
             vs.stop()
             m.stop()

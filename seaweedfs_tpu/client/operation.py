@@ -89,6 +89,7 @@ class WeedClient:
                  data_center: str = ""):
         self.master = MasterClient(master_url)
         self.wd = None
+        self._tcp = None  # framed-TCP client pool, created on first use
         self._secured: Optional[bool] = None
         if keep_connected:
             from .wdclient import WdClient
@@ -157,6 +158,30 @@ class WeedClient:
         if status not in (200, 201):
             raise HttpError(status, body.decode(errors="replace"))
         return a.fid
+
+    def upload_tcp(self, data: bytes, collection: str = "",
+                   replication: str = "", ttl: str = "") -> str:
+        """Assign (HTTP) + write over the framed-TCP data path
+        (benchmark -useTcp; volume_server_tcp_handlers_write.go)."""
+        from ..volume_server.tcp import TcpVolumeClient, tcp_address
+
+        if self._tcp is None:
+            self._tcp = TcpVolumeClient()
+        a = self.master.assign(collection=collection,
+                               replication=replication, ttl=ttl)
+        self._tcp.write(tcp_address(a.url), a.fid, data)
+        return a.fid
+
+    def download_tcp(self, fid: str) -> bytes:
+        from ..volume_server.tcp import TcpVolumeClient, tcp_address
+
+        if self._tcp is None:
+            self._tcp = TcpVolumeClient()
+        vid = int(fid.split(",")[0])
+        urls, _ = self._locate(vid)
+        if not urls:
+            raise HttpError(404, f"volume {vid} has no locations")
+        return self._tcp.read(tcp_address(urls[0]), fid)
 
     def download(self, fid: str) -> bytes:
         """Full-blob GET; transparently decompresses a gzip-encoded reply

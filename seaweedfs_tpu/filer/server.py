@@ -542,7 +542,13 @@ class FilerServer:
                 raise HttpError(401, err)
             entry = Entry.from_dict(req.json())
             with self.filer.op_signatures(self._sigs(req)):
-                self.filer.create_entry(entry)
+                if req.query.get("update_only") == "true":
+                    # metadata stampers (filer.remote.sync) must never
+                    # resurrect an entry deleted between their read and
+                    # write — update-only turns that race into a 404
+                    self.filer.update_entry(entry)
+                else:
+                    self.filer.create_entry(entry)
             return Response({"path": entry.full_path}, status=201)
 
         @r.route("POST", "/api/mkdir")

@@ -149,7 +149,10 @@ class RemoteSyncer:
         extended.update(stamp)
         current["extended"] = extended
         self._stamped.add((path, stamp["remote.entry"]))
-        http_bytes("POST", f"http://{self.filer_url}/api/entry",
+        # update_only: a delete landing between the stat above and this
+        # write must NOT be resurrected as a chunkless ghost entry
+        http_bytes("POST",
+                   f"http://{self.filer_url}/api/entry?update_only=true",
                    json.dumps(current).encode(),
                    headers={"Content-Type": "application/json"})
 

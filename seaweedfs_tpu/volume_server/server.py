@@ -57,7 +57,8 @@ class VolumeServer:
                  guard: Optional["Guard"] = None,
                  backends: Optional[dict] = None,
                  full_sync_every: int = 12,
-                 tls_context=None):
+                 tls_context=None,
+                 tcp: bool = True):
         from ..security import Guard
 
         if backends:
@@ -88,6 +89,8 @@ class VolumeServer:
         # vid -> (replica urls, expiry); see _lookup_replicas
         self._vid_cache: dict[int, tuple[list, float]] = {}
         self.vid_cache_ttl = 10.0
+        self._tcp_enabled = tcp
+        self._tcp_server = None
 
     @property
     def url(self) -> str:
@@ -97,12 +100,23 @@ class VolumeServer:
     def start(self) -> "VolumeServer":
         self._server = serve(self.router, self.store.ip, self.store.port,
                              tls_context=self._tls_context)
+        # the framed-TCP path has no JWT slot, so it must not open a write
+        # bypass on a JWT-secured cluster (IP whitelists still apply)
+        if self._tcp_enabled and not self.guard.signing_key:
+            from .tcp import TcpVolumeServer
+
+            self._tcp_server = TcpVolumeServer(
+                self.store, self.store.ip,
+                whitelist_ok=(self.guard.check_white_list
+                              if self.guard.is_write_active else None)).start()
         threading.Thread(target=self._heartbeat_loop, daemon=True,
                          name=f"heartbeat:{self.url}").start()
         return self
 
     def stop(self) -> None:
         self._stop.set()
+        if self._tcp_server is not None:
+            self._tcp_server.stop()
         if self._server:
             from ..utils.httpd import stop_server
 

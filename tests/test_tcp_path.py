@@ -1,0 +1,85 @@
+"""Framed-TCP data path (volume_server_tcp_handlers_write.go analog)."""
+
+from __future__ import annotations
+
+import os
+import time
+
+import pytest
+
+from seaweedfs_tpu.client.operation import WeedClient
+from seaweedfs_tpu.master.server import MasterServer
+from seaweedfs_tpu.security.guard import Guard
+from seaweedfs_tpu.volume_server.server import VolumeServer
+from seaweedfs_tpu.volume_server.tcp import TcpVolumeClient, tcp_address
+from tests.conftest import free_port
+
+
+@pytest.fixture
+def pair(tmp_path):
+    master = MasterServer(port=free_port(), pulse_seconds=0.3).start()
+    d = tmp_path / "v"
+    d.mkdir()
+    vs = VolumeServer([str(d)], master.url, port=free_port(),
+                      pulse_seconds=0.3).start()
+    deadline = time.time() + 5
+    while time.time() < deadline and not master.topo.all_nodes():
+        time.sleep(0.05)
+    yield master, vs
+    vs.stop()
+    master.stop()
+
+
+def test_tcp_write_read_delete_roundtrip(pair):
+    master, vs = pair
+    client = WeedClient(master.url)
+    payload = os.urandom(4096)
+    fid = client.upload_tcp(payload)
+    # readable over BOTH planes: the TCP write landed in the same store
+    assert client.download_tcp(fid) == payload
+    assert client.download(fid) == payload
+    # delete over TCP, then both planes 404
+    tcp = TcpVolumeClient()
+    assert tcp.delete(tcp_address(vs.url), fid) > 0
+    with pytest.raises(Exception):
+        client.download_tcp(fid)
+
+
+def test_tcp_errors_keep_connection_alive(pair):
+    master, vs = pair
+    tcp = TcpVolumeClient()
+    addr = tcp_address(vs.url)
+    with pytest.raises(OSError, match="not found|KeyError"):
+        tcp.read(addr, "999,0000deadbeef")
+    # the same pooled connection still serves the next request
+    client = WeedClient(master.url)
+    fid = client.upload_tcp(b"still alive")
+    assert tcp.read(addr, fid) == b"still alive"
+
+
+def test_tcp_disabled_on_jwt_secured_cluster(tmp_path):
+    master = MasterServer(port=free_port(), pulse_seconds=0.3).start()
+    d = tmp_path / "v"
+    d.mkdir()
+    vs = VolumeServer([str(d)], master.url, port=free_port(),
+                      pulse_seconds=0.3,
+                      guard=Guard(signing_key="sekrit")).start()
+    try:
+        assert vs._tcp_server is None  # no JWT slot on TCP -> stays closed
+    finally:
+        vs.stop()
+        master.stop()
+
+
+def test_tcp_interleaved_ops_on_one_connection(pair):
+    master, vs = pair
+    client = WeedClient(master.url)
+    tcp = TcpVolumeClient()
+    addr = tcp_address(vs.url)
+    blobs = {client.upload_tcp(os.urandom(100 + i)): None
+             for i in range(50)}
+    for fid in blobs:
+        data = tcp.read(addr, fid)
+        assert len(data) >= 100
+        tcp.write(addr, fid, data + b"!")  # overwrite same needle
+        assert tcp.read(addr, fid) == data + b"!"

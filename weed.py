@@ -338,9 +338,14 @@ def cmd_benchmark(args) -> None:
     payload = bytes(random.getrandbits(8) for _ in range(args.size))
     fids: list[str] = []
 
+    use_tcp = getattr(args, "useTcp", False)
+
     def write_one(i: int) -> float:
         t0 = time.perf_counter()
-        fid = client.upload(payload, name=f"bench{i}")
+        if use_tcp:
+            fid = client.upload_tcp(payload)
+        else:
+            fid = client.upload(payload, name=f"bench{i}")
         fids.append(fid)
         return time.perf_counter() - t0
 
@@ -355,7 +360,8 @@ def cmd_benchmark(args) -> None:
 
     def read_one(fid: str) -> float:
         t0 = time.perf_counter()
-        assert client.download(fid) == payload
+        got = client.download_tcp(fid) if use_tcp else client.download(fid)
+        assert got == payload
         return time.perf_counter() - t0
 
     random.shuffle(fids)
@@ -554,6 +560,8 @@ def main(argv=None) -> None:
     b.add_argument("-n", type=int, default=1000)
     b.add_argument("-size", type=int, default=1024)
     b.add_argument("-c", type=int, default=16)
+    b.add_argument("-useTcp", action="store_true",
+                   help="write/read over the framed-TCP data path")
     b.set_defaults(fn=cmd_benchmark)
 
     args = p.parse_args(argv)
