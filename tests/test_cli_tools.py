@@ -1,0 +1,85 @@
+"""weed fix / compact / export CLI commands (command/{fix,compact,export}.go)."""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import tarfile
+
+from seaweedfs_tpu.storage.needle import Needle
+from seaweedfs_tpu.storage.volume import Volume
+
+WEED = os.path.join(os.path.dirname(os.path.dirname(__file__)), "weed.py")
+
+
+def _run(*argv):
+    return subprocess.run([sys.executable, WEED, *argv],
+                          capture_output=True, text=True, timeout=120,
+                          env={**os.environ,
+                               "PYTHONPATH": os.path.dirname(WEED)})
+
+
+def _make_volume(tmp_path, vid=7):
+    v = Volume(str(tmp_path), "", vid)
+    for i in range(1, 6):
+        n = Needle(cookie=i, id=i, data=b"data-%d" % i)
+        n.name = b"file%d.txt" % i
+        from seaweedfs_tpu.storage.needle import FLAG_HAS_NAME
+
+        n.set_flag(FLAG_HAS_NAME)
+        v.write_needle(n)
+    v.delete_needle(Needle(cookie=2, id=2))
+    v.close()
+    return vid
+
+
+def test_fix_rebuilds_idx(tmp_path):
+    vid = _make_volume(tmp_path)
+    idx = tmp_path / f"{vid}.idx"
+    original = idx.read_bytes()
+    idx.write_bytes(b"garbage!")  # corrupt the index
+    r = _run("fix", "-dir", str(tmp_path), "-volumeId", str(vid))
+    assert r.returncode == 0, r.stderr
+    assert "wrote 4 live entries" in r.stdout
+    # the volume opens and serves from the rebuilt index
+    v = Volume(str(tmp_path), "", vid)
+    try:
+        assert v.read_needle(1).data == b"data-1"
+        assert v.read_needle(5).data == b"data-5"
+        import pytest
+
+        with pytest.raises(KeyError):
+            v.read_needle(2)
+    finally:
+        v.close()
+    assert len(idx.read_bytes()) % 16 == 0 and idx.read_bytes() != original
+
+
+def test_compact_command(tmp_path):
+    vid = _make_volume(tmp_path)
+    before = (tmp_path / f"{vid}.dat").stat().st_size
+    r = _run("compact", "-dir", str(tmp_path), "-volumeId", str(vid))
+    assert r.returncode == 0, r.stderr
+    assert (tmp_path / f"{vid}.dat").stat().st_size < before
+    v = Volume(str(tmp_path), "", vid)
+    try:
+        assert v.read_needle(3).data == b"data-3"
+    finally:
+        v.close()
+
+
+def test_export_list_and_tar(tmp_path):
+    vid = _make_volume(tmp_path)
+    r = _run("export", "-dir", str(tmp_path), "-volumeId", str(vid))
+    assert r.returncode == 0, r.stderr
+    assert "file3.txt" in r.stdout
+    assert "id 2" not in r.stdout  # deleted: hidden by default
+    out = str(tmp_path / "vol.tar")
+    r = _run("export", "-dir", str(tmp_path), "-volumeId", str(vid),
+             "-o", out)
+    assert r.returncode == 0, r.stderr
+    with tarfile.open(out) as t:
+        names = t.getnames()
+        assert "1_file1.txt" in names and len(names) == 4
+        assert t.extractfile("5_file5.txt").read() == b"data-5"

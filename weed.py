@@ -244,6 +244,94 @@ def cmd_filer_remote_sync(args) -> None:
     _wait_forever()
 
 
+def cmd_fix(args) -> None:
+    """Re-create a volume's .idx from its .dat (command/fix.go): scan
+    every needle record, live puts win, tombstones delete."""
+    from seaweedfs_tpu.storage import idx as idx_mod
+    from seaweedfs_tpu.storage.needle_map import MemDb
+    from seaweedfs_tpu.storage.super_block import SuperBlock
+    from seaweedfs_tpu.storage.types import size_is_valid
+    from seaweedfs_tpu.storage.volume import volume_file_prefix
+    from seaweedfs_tpu.tools.see_dat import walk_dat
+
+    base = volume_file_prefix(args.dir, args.collection, args.volumeId)
+    db = MemDb()
+    count = 0
+    for offset, rec in walk_dat(base + ".dat"):
+        if isinstance(rec, SuperBlock):
+            continue
+        if size_is_valid(rec.size):
+            db.set(rec.id, offset, rec.size)
+        else:
+            db.unset(rec.id)
+        count += 1
+    with open(base + ".idx", "wb") as f:
+        for nv in db:
+            f.write(idx_mod.pack_entry(nv.key, nv.offset, nv.size))
+    print(f"fix: scanned {count} records, wrote {len(db)} live entries "
+          f"to {base}.idx")
+
+
+def cmd_compact(args) -> None:
+    """Offline vacuum of one volume (command/compact.go)."""
+    from seaweedfs_tpu.storage.volume import Volume
+
+    v = Volume(args.dir, args.collection, args.volumeId)
+    try:
+        before = v.data_size
+        v.compact()
+        v.commit_compact()
+        print(f"compact: volume {args.volumeId} {before} -> {v.data_size} "
+              f"bytes")
+    finally:
+        v.close()
+
+
+def cmd_export(args) -> None:
+    """List or tar-export a volume's files (command/export.go)."""
+    import tarfile
+
+    from seaweedfs_tpu.storage.types import size_is_valid
+    from seaweedfs_tpu.storage.volume import Volume
+
+    v = Volume(args.dir, args.collection, args.volumeId)
+    tar_out = tarfile.open(args.o, "w") if args.o else None
+    n_shown = 0
+    try:
+        live_keys = {nv.key for nv in v.nm}
+
+        def visit(needle, offset):
+            nonlocal n_shown
+            if args.limit and n_shown >= args.limit:
+                return
+            deleted = needle.id not in live_keys \
+                or not size_is_valid(needle.size)
+            if deleted and not (args.deleted and tar_out is None):
+                return
+            name = (needle.name or b"").decode(errors="replace")
+            if tar_out is not None:
+                info = tarfile.TarInfo(name=f"{needle.id}_{name}"
+                                       if name else str(needle.id))
+                info.size = len(needle.data)
+                info.mtime = needle.last_modified or 0
+                import io as _io
+
+                tar_out.addfile(info, _io.BytesIO(needle.data))
+            else:
+                mark = " DELETED" if deleted else ""
+                print(f"id {needle.id} size {needle.size} "
+                      f"name {name!r}{mark}")
+            n_shown += 1
+
+        v.scan(visit)
+        if tar_out is not None:
+            print(f"export: wrote {n_shown} files to {args.o}")
+    finally:
+        if tar_out is not None:
+            tar_out.close()
+        v.close()
+
+
 def cmd_filer_remote_gateway(args) -> None:
     """Mirror /buckets lifecycle + objects into a configured remote
     storage (command/filer_remote_gateway*.go)."""
@@ -506,6 +594,28 @@ def main(argv=None) -> None:
     frs.add_argument("-dir", required=True,
                      help="comma-separated remote-mounted directories")
     frs.set_defaults(fn=cmd_filer_remote_sync)
+
+    fx = sub.add_parser("fix")
+    fx.add_argument("-dir", default=".")
+    fx.add_argument("-collection", default="")
+    fx.add_argument("-volumeId", type=int, required=True)
+    fx.set_defaults(fn=cmd_fix)
+
+    cp = sub.add_parser("compact")
+    cp.add_argument("-dir", default=".")
+    cp.add_argument("-collection", default="")
+    cp.add_argument("-volumeId", type=int, required=True)
+    cp.set_defaults(fn=cmd_compact)
+
+    ex = sub.add_parser("export")
+    ex.add_argument("-dir", default=".")
+    ex.add_argument("-collection", default="")
+    ex.add_argument("-volumeId", type=int, required=True)
+    ex.add_argument("-o", default="", help="output .tar path (default: list)")
+    ex.add_argument("-limit", type=int, default=0)
+    ex.add_argument("-deleted", action="store_true",
+                    help="also list deleted records")
+    ex.set_defaults(fn=cmd_export)
 
     frg = sub.add_parser("filer.remote.gateway")
     frg.add_argument("-filer", default="127.0.0.1:8888")
