@@ -83,3 +83,50 @@ def test_export_list_and_tar(tmp_path):
         names = t.getnames()
         assert "1_file1.txt" in names and len(names) == 4
         assert t.extractfile("5_file5.txt").read() == b"data-5"
+
+
+def test_filer_cat_copy_meta_tail(tmp_path):
+    """filer.copy uploads a tree, filer.cat reads it back, scaffold emits
+    templates (command/{filer_copy,filer_cat,scaffold}.go)."""
+    import time
+
+    from seaweedfs_tpu.filer.filer_store import MemoryStore
+    from seaweedfs_tpu.filer.server import FilerServer
+    from seaweedfs_tpu.master.server import MasterServer
+    from seaweedfs_tpu.volume_server.server import VolumeServer
+    from tests.conftest import free_port
+
+    master = MasterServer(port=free_port(), pulse_seconds=0.3).start()
+    d = tmp_path / "v"
+    d.mkdir()
+    vs = VolumeServer([str(d)], master.url, port=free_port(),
+                      pulse_seconds=0.3).start()
+    deadline = time.time() + 5
+    while time.time() < deadline and not master.topo.all_nodes():
+        time.sleep(0.05)
+    filer = FilerServer(master.url, MemoryStore(), port=free_port()).start()
+    try:
+        src = tmp_path / "tree"
+        (src / "sub").mkdir(parents=True)
+        (src / "a.txt").write_bytes(b"alpha")
+        (src / "sub" / "b.txt").write_bytes(b"beta")
+        r = _run("filer.copy", "-filer", filer.url, str(src), "/imported")
+        assert r.returncode == 0, r.stderr
+        r = _run("filer.cat", "-filer", filer.url, "/imported/tree/a.txt")
+        assert r.returncode == 0 and r.stdout == "alpha"
+        r = _run("filer.cat", "-filer", filer.url,
+                 "/imported/tree/sub/b.txt")
+        assert r.stdout == "beta"
+    finally:
+        filer.stop()
+        vs.stop()
+        master.stop()
+
+
+def test_scaffold_and_version():
+    r = _run("version")
+    assert r.returncode == 0 and "seaweedfs-tpu" in r.stdout
+    for name in ("security", "filer", "replication", "master",
+                 "notification", "shell"):
+        r = _run("scaffold", "-config", name)
+        assert r.returncode == 0 and r.stdout.strip(), name

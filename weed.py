@@ -244,6 +244,181 @@ def cmd_filer_remote_sync(args) -> None:
     _wait_forever()
 
 
+VERSION = "seaweedfs-tpu 0.2"
+
+_SCAFFOLDS = {
+    "security": '''\
+# security.toml — put in ., ~/.seaweedfs/, or /etc/seaweedfs/
+# (scaffold/security.toml analog)
+
+[jwt.signing]
+# key = "blahblahblahblah"          # volume write tokens
+# expires_after_seconds = 10
+
+[jwt.signing.read]
+# key = ""                          # volume read tokens
+
+[jwt.filer_signing]
+# key = ""                          # filer API tokens
+
+[guard]
+# white_list = ["127.0.0.1", "10.0.0.0/8"]
+
+[tls]
+# ca   = "/etc/seaweedfs/ca.crt"    # enables cluster mTLS
+# cert = "/etc/seaweedfs/node.crt"
+# key  = "/etc/seaweedfs/node.key"
+# verify_client = true
+''',
+    "filer": '''\
+# filer.toml — store selection happens via the -db flag:
+#   (absent)        in-memory store
+#   /path/filer.db  sqlite store
+#   /path/store.lsm log-structured store (WAL + memtable + SSTables)
+# Per-path rules (collection, replication, ttl, fsync) live IN the
+# filesystem at /etc/seaweedfs/filer.conf — edit with `fs.configure`.
+''',
+    "replication": '''\
+# replication.toml — consumed by `weed filer.replicate`
+# (scaffold/replication.toml analog)
+
+[sink.local]
+# enabled = true
+# directory = "/backup"
+
+[sink.filer]
+# enabled = true
+# url = "host:8888"
+# path = "/backup"
+
+[sink.s3]
+# enabled = true
+# endpoint = "host:8333"
+# bucket = "backup"
+# access_key = ""
+# secret_key = ""
+''',
+    "master": '''\
+# master.toml — maintenance scripts run on the leader under the admin
+# lock (master_server.go:212 startAdminScripts analog); configure via
+# MasterServer(maintenance_scripts=..., maintenance_interval_seconds=...)
+
+# scripts = """
+#   volume.deleteEmpty -quietFor 86400 -force
+#   volume.fix.replication
+#   volume.balance -force
+#   ec.rebuild -force
+#   ec.balance -force
+# """
+''',
+    "notification": '''\
+# notification.toml — filer mutation events to an external queue
+# (scaffold/notification.toml analog). Built-in queues: log, memory,
+# file; kafka/sqs gated on their SDKs.
+
+[notification.log]
+# enabled = true
+''',
+    "shell": '''\
+# shell.toml — initial commands for `weed shell`
+# [cluster]
+# default = "localhost:9333"
+''',
+}
+
+
+def cmd_version(args) -> None:
+    print(VERSION)
+
+
+def cmd_scaffold(args) -> None:
+    """Emit commented config templates (command/scaffold.go)."""
+    conf = _SCAFFOLDS.get(args.config)
+    if conf is None:
+        raise SystemExit(f"unknown config {args.config!r}; "
+                         f"one of {sorted(_SCAFFOLDS)}")
+    if args.output:
+        with open(f"{args.output}/{args.config}.toml", "w") as f:
+            f.write(conf)
+        print(f"wrote {args.output}/{args.config}.toml")
+    else:
+        print(conf, end="")
+
+
+def cmd_filer_cat(args) -> None:
+    """Stream one filer file to stdout (command/filer_cat.go)."""
+    import urllib.parse
+
+    from seaweedfs_tpu.utils.httpd import http_bytes
+
+    status, body, _ = http_bytes(
+        "GET", f"http://{args.filer}" + urllib.parse.quote(args.path))
+    if status != 200:
+        raise SystemExit(f"HTTP {status}: {body.decode(errors='replace')}")
+    sys.stdout.buffer.write(body)
+
+
+def cmd_filer_copy(args) -> None:
+    """Upload local files/directories into the filer
+    (command/filer_copy.go)."""
+    import os
+    import urllib.parse
+
+    from seaweedfs_tpu.utils.httpd import http_bytes
+
+    def put(local: str, remote: str) -> None:
+        with open(local, "rb") as f:
+            data = f.read()
+        status, body, _ = http_bytes(
+            "POST", f"http://{args.filer}" + urllib.parse.quote(remote),
+            data)
+        if status not in (200, 201):
+            raise SystemExit(f"{remote}: HTTP {status}")
+        print(f"{local} -> {remote} ({len(data)} bytes)")
+
+    dest = args.dest.rstrip("/")
+    for src in args.src:
+        if os.path.isdir(src):
+            base = os.path.basename(src.rstrip("/"))
+            for dirpath, _, files in os.walk(src):
+                rel = os.path.relpath(dirpath, src)
+                for name in files:
+                    remote = f"{dest}/{base}" + (
+                        f"/{rel}" if rel != "." else "") + f"/{name}"
+                    put(os.path.join(dirpath, name),
+                        remote.replace("//", "/"))
+        else:
+            put(src, f"{dest}/{os.path.basename(src)}")
+
+
+def cmd_filer_meta_tail(args) -> None:
+    """Follow the filer's meta-event stream (command/filer_meta_tail.go)."""
+    import urllib.parse
+
+    from seaweedfs_tpu.utils.httpd import http_json
+
+    cursor = args.since
+    print(f"tailing {args.filer}{args.pathPrefix} from ts {cursor} ...")
+    try:
+        while True:
+            r = http_json(
+                "GET", f"http://{args.filer}/api/meta/log?since_ns={cursor}"
+                       f"&path_prefix={urllib.parse.quote(args.pathPrefix)}")
+            for event in r.get("events", []):
+                entry = (event.get("new_entry")
+                         or event.get("old_entry") or {})
+                print(json.dumps({
+                    "ts_ns": event["ts_ns"], "op": event["op"],
+                    "path": entry.get("full_path", ""),
+                    "size": sum(c.get("size", 0)
+                                for c in entry.get("chunks", []))}))
+            cursor = int(r.get("next_ns", cursor))
+            if not r.get("events"):
+                time.sleep(args.pollSeconds)
+    except KeyboardInterrupt:
+        pass
+
+
 def cmd_fix(args) -> None:
     """Re-create a volume's .idx from its .dat (command/fix.go): scan
     every needle record, live puts win, tombstones delete."""
@@ -594,6 +769,33 @@ def main(argv=None) -> None:
     frs.add_argument("-dir", required=True,
                      help="comma-separated remote-mounted directories")
     frs.set_defaults(fn=cmd_filer_remote_sync)
+
+    ver = sub.add_parser("version")
+    ver.set_defaults(fn=cmd_version)
+
+    sc = sub.add_parser("scaffold")
+    sc.add_argument("-config", default="security",
+                    help="security|filer|replication|master|notification|shell")
+    sc.add_argument("-output", default="", help="directory to write into")
+    sc.set_defaults(fn=cmd_scaffold)
+
+    fcat = sub.add_parser("filer.cat")
+    fcat.add_argument("-filer", default="127.0.0.1:8888")
+    fcat.add_argument("path")
+    fcat.set_defaults(fn=cmd_filer_cat)
+
+    fcp = sub.add_parser("filer.copy")
+    fcp.add_argument("-filer", default="127.0.0.1:8888")
+    fcp.add_argument("src", nargs="+")
+    fcp.add_argument("dest", help="filer destination directory")
+    fcp.set_defaults(fn=cmd_filer_copy)
+
+    fmt_ = sub.add_parser("filer.meta.tail")
+    fmt_.add_argument("-filer", default="127.0.0.1:8888")
+    fmt_.add_argument("-pathPrefix", default="/")
+    fmt_.add_argument("-since", type=int, default=0)
+    fmt_.add_argument("-pollSeconds", type=float, default=1.0)
+    fmt_.set_defaults(fn=cmd_filer_meta_tail)
 
     fx = sub.add_parser("fix")
     fx.add_argument("-dir", default=".")
