@@ -575,9 +575,15 @@ class FilerServer:
                 listing = self.filer.list_directory(
                     path, start_file=req.query.get("lastFileName", ""),
                     limit=limit, prefix=req.query.get("prefix", ""))
+                # full=true returns complete entry dicts (chunks included)
+                # for API consumers like the remote-gateway facade; the
+                # default stays the compact human/UI form
+                render = ((lambda e: e.to_dict())
+                          if req.query.get("full") == "true"
+                          else self._entry_json)
                 return Response({
                     "Path": path,
-                    "Entries": [self._entry_json(e) for e in listing],
+                    "Entries": [render(e) for e in listing],
                     "ShouldDisplayLoadMore": len(listing) >= limit,
                     "LastFileName": listing[-1].name if listing else "",
                 })

@@ -1,0 +1,215 @@
+"""Remote-filer facade: run gateways as their own processes.
+
+The reference's S3/WebDAV/IAM gateways are standalone commands that talk
+to a filer over gRPC (s3api_server.go dials -filer).  Here the gateways
+are written against the in-process FilerServer surface; this module
+provides the same surface over the filer's HTTP API, so
+
+    weed s3     -filer host:8888
+    weed webdav -filer host:8888
+    weed iam    -filer host:8888
+
+run anywhere.  Two objects mirror the in-process pair:
+
+  RemoteFilerFacade   ~ FilerServer  (put_file/get_file/read_chunks)
+  RemoteFilerFacade.filer ~ Filer    (entry CRUD, listing, rename,
+                                      subscribe via meta-log polling)
+
+Entries travel as their JSON dicts; subscriptions poll /api/meta/log on
+a background thread, which is the same event stream the in-process
+subscribe taps.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.parse
+from typing import Callable, Iterator, Optional
+
+from ..filer.entry import Entry
+from ..filer.filer import NotEmptyError, NotFoundError
+from ..utils.httpd import HttpError, http_bytes, http_json
+
+
+def _q(path: str) -> str:
+    return urllib.parse.quote(path)
+
+
+class RemoteFiler:
+    """The `Filer` surface over HTTP (find/create/update/delete/list/
+    rename/mkdir/subscribe)."""
+
+    def __init__(self, filer_url: str, poll_seconds: float = 0.5):
+        self.filer_url = filer_url
+        self.poll_seconds = poll_seconds
+        self._subs: list[tuple[Callable, threading.Event]] = []
+        info = http_json("GET", f"http://{filer_url}/api/info")
+        self.signature = int(info.get("signature", 0))
+
+    # --- entry CRUD -------------------------------------------------------
+    def find_entry(self, path: str) -> Entry:
+        status, body, _ = http_bytes(
+            "GET", f"http://{self.filer_url}/api/stat" + _q(path))
+        if status == 404:
+            raise NotFoundError(path)
+        if status != 200:
+            raise HttpError(status, body.decode(errors="replace"))
+        return Entry.from_dict(json.loads(body))
+
+    def exists(self, path: str) -> bool:
+        try:
+            self.find_entry(path)
+            return True
+        except NotFoundError:
+            return False
+
+    def create_entry(self, entry: Entry, o_excl: bool = False) -> Entry:
+        if o_excl and self.exists(entry.full_path):
+            raise HttpError(409, f"{entry.full_path} already exists")
+        status, body, _ = http_bytes(
+            "POST", f"http://{self.filer_url}/api/entry",
+            json.dumps(entry.to_dict()).encode(),
+            headers={"Content-Type": "application/json"})
+        if status not in (200, 201):
+            raise HttpError(status, body.decode(errors="replace"))
+        return entry
+
+    def update_entry(self, entry: Entry) -> Entry:
+        status, body, _ = http_bytes(
+            "POST", f"http://{self.filer_url}/api/entry?update_only=true",
+            json.dumps(entry.to_dict()).encode(),
+            headers={"Content-Type": "application/json"})
+        if status == 404:
+            raise NotFoundError(entry.full_path)
+        if status not in (200, 201):
+            raise HttpError(status, body.decode(errors="replace"))
+        return entry
+
+    def delete_entry(self, path: str, recursive: bool = False,
+                     ignore_recursive_error: bool = False) -> None:
+        status, body, _ = http_bytes(
+            "DELETE", f"http://{self.filer_url}{_q(path)}"
+                      f"?recursive={'true' if recursive else 'false'}")
+        if status == 404:
+            raise NotFoundError(path)
+        if status == 409:
+            raise NotEmptyError(body.decode(errors="replace"))
+        if status not in (200, 204):
+            raise HttpError(status, body.decode(errors="replace"))
+
+    def mkdir(self, path: str, mode: int = 0o770) -> Entry:
+        http_json("POST", f"http://{self.filer_url}/api/mkdir",
+                  {"path": path})
+        return self.find_entry(path)
+
+    def _ensure_parents(self, dir_path: str) -> None:
+        self.mkdir(dir_path)
+
+    def rename(self, old_path: str, new_path: str) -> Entry:
+        http_json("POST", f"http://{self.filer_url}/api/rename",
+                  {"from": old_path, "to": new_path})
+        return self.find_entry(new_path)
+
+    # --- listing ----------------------------------------------------------
+    def list_directory(self, path: str, start_file: str = "",
+                       include_start: bool = False, limit: int = 1000,
+                       prefix: str = "") -> list[Entry]:
+        q = urllib.parse.urlencode({
+            "limit": limit, "lastFileName": start_file, "prefix": prefix,
+            "full": "true"})
+        status, body, _ = http_bytes(
+            "GET", f"http://{self.filer_url}{_q(path or '/')}?{q}",
+            headers={"Accept": "application/json"})
+        if status == 404:
+            raise NotFoundError(path)
+        if status != 200:
+            raise HttpError(status, body.decode(errors="replace"))
+        doc = json.loads(body)
+        out = []
+        for d in doc.get("Entries", []):
+            name = d.get("full_path", "").rsplit("/", 1)[-1]
+            if start_file and not include_start and name == start_file:
+                continue
+            out.append(Entry.from_dict(d))
+        return out
+
+    def iterate_tree(self, path: str = "/") -> Iterator[Entry]:
+        for child in self.list_directory(path, limit=1_000_000):
+            yield child
+            if child.is_directory:
+                yield from self.iterate_tree(child.full_path)
+
+    # --- meta subscription -------------------------------------------------
+    def subscribe(self, fn: Callable[[dict], None],
+                  since_ns: int = 0) -> Callable[[], None]:
+        stop = threading.Event()
+
+        def loop():
+            cursor = since_ns
+            while not stop.is_set():
+                try:
+                    r = http_json(
+                        "GET", f"http://{self.filer_url}/api/meta/log"
+                               f"?since_ns={cursor}")
+                    for event in r.get("events", []):
+                        try:
+                            fn(event)
+                        except Exception:
+                            pass
+                    cursor = int(r.get("next_ns", cursor))
+                except Exception:
+                    pass
+                stop.wait(self.poll_seconds)
+
+        threading.Thread(target=loop, daemon=True,
+                         name=f"remote-filer-sub:{self.filer_url}").start()
+        return stop.set
+
+
+class RemoteFilerFacade:
+    """The `FilerServer` surface over HTTP (what gateways consume)."""
+
+    def __init__(self, filer_url: str, poll_seconds: float = 0.5):
+        self.filer_url = filer_url
+        self.filer = RemoteFiler(filer_url, poll_seconds)
+
+    @property
+    def url(self) -> str:
+        return self.filer_url
+
+    def put_file(self, path: str, data: bytes, mime: str = "",
+                 collection: str = "", ttl: str = "",
+                 mode: int = 0o660,
+                 extended: Optional[dict] = None) -> Entry:
+        q = urllib.parse.urlencode({"collection": collection, "ttl": ttl})
+        status, body, _ = http_bytes(
+            "POST", f"http://{self.filer_url}{_q(path)}?{q}", data,
+            headers={"Content-Type": mime} if mime else None)
+        if status not in (200, 201):
+            raise HttpError(status, body.decode(errors="replace"))
+        entry = self.filer.find_entry(path)
+        if extended:
+            entry.extended.update(extended)
+            self.filer.update_entry(entry)
+        return entry
+
+    def get_file(self, path: str) -> tuple[Entry, bytes]:
+        entry = self.filer.find_entry(path)
+        if entry.is_directory:
+            raise IsADirectoryError(path)
+        return entry, self.read_chunks(entry)
+
+    def read_chunks(self, entry: Entry, offset: int = 0,
+                    size: Optional[int] = None) -> bytes:
+        headers = None
+        if offset or size is not None:
+            end = "" if size is None else str(offset + size - 1)
+            headers = {"Range": f"bytes={offset}-{end}"}
+        status, body, _ = http_bytes(
+            "GET", f"http://{self.filer_url}{_q(entry.full_path)}",
+            headers=headers)
+        if status not in (200, 206):
+            raise HttpError(status, body.decode(errors="replace"))
+        return body

@@ -1,0 +1,90 @@
+"""Standalone gateways over a REMOTE filer (command/{s3,webdav,iam}.go):
+the S3 gateway runs against the filer's HTTP API through
+RemoteFilerFacade instead of an in-process FilerServer object."""
+
+from __future__ import annotations
+
+import time
+import xml.etree.ElementTree as ET
+
+import pytest
+
+from seaweedfs_tpu.filer.filer_store import MemoryStore
+from seaweedfs_tpu.filer.server import FilerServer
+from seaweedfs_tpu.gateway.remote_filer import RemoteFilerFacade
+from seaweedfs_tpu.gateway.s3 import S3ApiServer
+from seaweedfs_tpu.gateway.webdav import WebDavServer
+from seaweedfs_tpu.master.server import MasterServer
+from seaweedfs_tpu.utils.httpd import http_bytes
+from seaweedfs_tpu.volume_server.server import VolumeServer
+from tests.conftest import free_port
+
+NS = "{http://s3.amazonaws.com/doc/2006-03-01/}"
+
+
+@pytest.fixture(scope="module")
+def stack(tmp_path_factory):
+    tmp_path = tmp_path_factory.mktemp("remote-gw")
+    master = MasterServer(port=free_port(), pulse_seconds=0.3).start()
+    d = tmp_path / "v"
+    d.mkdir()
+    vs = VolumeServer([str(d)], master.url, port=free_port(),
+                      pulse_seconds=0.3).start()
+    deadline = time.time() + 5
+    while time.time() < deadline and not master.topo.all_nodes():
+        time.sleep(0.05)
+    filer = FilerServer(master.url, MemoryStore(), port=free_port()).start()
+    # gateways built ONLY from the filer's URL — nothing in-process shared
+    s3 = S3ApiServer(RemoteFilerFacade(filer.url), port=free_port()).start()
+    dav = WebDavServer(RemoteFilerFacade(filer.url),
+                       port=free_port()).start()
+    yield filer, s3, dav
+    dav.stop()
+    s3.stop()
+    filer.stop()
+    vs.stop()
+    master.stop()
+
+
+def test_s3_over_remote_filer(stack):
+    filer, s3, _ = stack
+    st, _, _ = http_bytes("PUT", f"http://{s3.url}/remoteb")
+    assert st == 200
+    st, _, h = http_bytes("PUT", f"http://{s3.url}/remoteb/k/doc.txt",
+                          b"through the facade",
+                          headers={"Content-Type": "text/plain"})
+    assert st == 200 and h["ETag"]
+    st, body, h = http_bytes("GET", f"http://{s3.url}/remoteb/k/doc.txt")
+    assert st == 200 and body == b"through the facade"
+    assert h["Content-Type"] == "text/plain"
+    # ranged GET rides the filer's Range support through the facade
+    st, body, _ = http_bytes("GET", f"http://{s3.url}/remoteb/k/doc.txt",
+                             headers={"Range": "bytes=8-10"})
+    assert st == 206 and body == b"the"
+    # listing
+    st, body, _ = http_bytes(
+        "GET", f"http://{s3.url}/remoteb?list-type=2")
+    keys = [e.findtext(f"{NS}Key")
+            for e in ET.fromstring(body).findall(f"{NS}Contents")]
+    assert keys == ["k/doc.txt"]
+    # the object is REALLY in the filer (not gateway-local state)
+    st, body, _ = http_bytes(
+        "GET", f"http://{filer.url}/buckets/remoteb/k/doc.txt")
+    assert st == 200 and body == b"through the facade"
+    # delete through S3, gone from the filer
+    st, _, _ = http_bytes("DELETE", f"http://{s3.url}/remoteb/k/doc.txt")
+    assert st == 204
+    st, _, _ = http_bytes(
+        "GET", f"http://{filer.url}/buckets/remoteb/k/doc.txt")
+    assert st == 404
+
+
+def test_webdav_over_remote_filer(stack):
+    filer, _, dav = stack
+    st, _, _ = http_bytes("PUT", f"http://{dav.url}/dav-file.txt",
+                          b"webdav remote")
+    assert st in (200, 201, 204)
+    st, body, _ = http_bytes("GET", f"http://{dav.url}/dav-file.txt")
+    assert st == 200 and body == b"webdav remote"
+    st, body, _ = http_bytes("GET", f"http://{filer.url}/dav-file.txt")
+    assert st == 200 and body == b"webdav remote"

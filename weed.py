@@ -507,6 +507,42 @@ def cmd_export(args) -> None:
         v.close()
 
 
+def cmd_s3(args) -> None:
+    """Standalone S3 gateway over a remote filer (command/s3.go)."""
+    from seaweedfs_tpu.gateway.remote_filer import RemoteFilerFacade
+    from seaweedfs_tpu.gateway.s3 import S3ApiServer
+
+    s3 = S3ApiServer(RemoteFilerFacade(args.filer), host=args.ip,
+                     port=args.port).start()
+    print(f"s3 gateway on {s3.url} -> filer {args.filer}")
+    _on_interrupt(s3.stop)
+    _wait_forever()
+
+
+def cmd_webdav(args) -> None:
+    """Standalone WebDAV gateway over a remote filer (command/webdav.go)."""
+    from seaweedfs_tpu.gateway.remote_filer import RemoteFilerFacade
+    from seaweedfs_tpu.gateway.webdav import WebDavServer
+
+    dav = WebDavServer(RemoteFilerFacade(args.filer), host=args.ip,
+                       port=args.port).start()
+    print(f"webdav gateway on {dav.url} -> filer {args.filer}")
+    _on_interrupt(dav.stop)
+    _wait_forever()
+
+
+def cmd_iam(args) -> None:
+    """Standalone IAM API over a remote filer (command/iam.go)."""
+    from seaweedfs_tpu.gateway.iam import IamApiServer
+    from seaweedfs_tpu.gateway.remote_filer import RemoteFilerFacade
+
+    iam = IamApiServer(RemoteFilerFacade(args.filer), host=args.ip,
+                       port=args.port).start()
+    print(f"iam api on {iam.url} -> filer {args.filer}")
+    _on_interrupt(iam.stop)
+    _wait_forever()
+
+
 def cmd_filer_remote_gateway(args) -> None:
     """Mirror /buckets lifecycle + objects into a configured remote
     storage (command/filer_remote_gateway*.go)."""
@@ -769,6 +805,24 @@ def main(argv=None) -> None:
     frs.add_argument("-dir", required=True,
                      help="comma-separated remote-mounted directories")
     frs.set_defaults(fn=cmd_filer_remote_sync)
+
+    s3p = sub.add_parser("s3")
+    s3p.add_argument("-filer", default="127.0.0.1:8888")
+    s3p.add_argument("-ip", default="127.0.0.1")
+    s3p.add_argument("-port", type=int, default=8333)
+    s3p.set_defaults(fn=cmd_s3)
+
+    wd = sub.add_parser("webdav")
+    wd.add_argument("-filer", default="127.0.0.1:8888")
+    wd.add_argument("-ip", default="127.0.0.1")
+    wd.add_argument("-port", type=int, default=7333)
+    wd.set_defaults(fn=cmd_webdav)
+
+    ia = sub.add_parser("iam")
+    ia.add_argument("-filer", default="127.0.0.1:8888")
+    ia.add_argument("-ip", default="127.0.0.1")
+    ia.add_argument("-port", type=int, default=8111)
+    ia.set_defaults(fn=cmd_iam)
 
     ver = sub.add_parser("version")
     ver.set_defaults(fn=cmd_version)
