@@ -90,6 +90,7 @@ class WeedClient:
         self.master = MasterClient(master_url)
         self.wd = None
         self._tcp = None  # framed-TCP client pool, created on first use
+        self._tcp_assign_ok: Optional[bool] = None  # master TCP front probed?
         self._secured: Optional[bool] = None
         if keep_connected:
             from .wdclient import WdClient
@@ -161,14 +162,33 @@ class WeedClient:
 
     def upload_tcp(self, data: bytes, collection: str = "",
                    replication: str = "", ttl: str = "") -> str:
-        """Assign (HTTP) + write over the framed-TCP data path
-        (benchmark -useTcp; volume_server_tcp_handlers_write.go)."""
+        """Framed-TCP assign + write (benchmark -useTcp): both the master
+        round trip and the data write skip HTTP parsing.  Falls back to
+        the HTTP assign when the master's TCP front is unreachable
+        (follower, port collision) and remembers the answer."""
+        import json as _json
+
         from ..volume_server.tcp import TcpVolumeClient, tcp_address
 
         if self._tcp is None:
             self._tcp = TcpVolumeClient()
-        a = self.master.assign(collection=collection,
-                               replication=replication, ttl=ttl)
+        a = None
+        if self._tcp_assign_ok is not False:
+            try:
+                r = _json.loads(self._tcp.request(
+                    tcp_address(self.master.master_url), b"A", "",
+                    _json.dumps({"collection": collection,
+                                 "replication": replication,
+                                 "ttl": ttl}).encode()))
+                a = Assignment(r["fid"], r["url"],
+                               r.get("publicUrl", r["url"]),
+                               int(r.get("count", 1)), r.get("auth", ""))
+                self._tcp_assign_ok = True
+            except (OSError, ValueError, KeyError):
+                self._tcp_assign_ok = False
+        if a is None:
+            a = self.master.assign(collection=collection,
+                                   replication=replication, ttl=ttl)
         self._tcp.write(tcp_address(a.url), a.fid, data)
         return a.fid
 

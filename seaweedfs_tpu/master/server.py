@@ -67,6 +67,7 @@ class MasterServer:
         self.router = Router("master", metrics=self.metrics)
         self._register_routes()
         self._server = None
+        self._tcp_server = None
         self._tls_context = tls_context
         self._stop = threading.Event()
         # periodic maintenance (topology_event_handling.go ticker +
@@ -92,6 +93,29 @@ class MasterServer:
     def start(self) -> "MasterServer":
         self._server = serve(self.router, self.host, self.port,
                              tls_context=self._tls_context)
+        # framed-TCP assign front (op 'A'): the write hot loop does one
+        # assign per file, and HTTP parsing caps it; leader-only — a
+        # follower refuses so clients fall back to HTTP redirects
+        import json as _json
+
+        from ..utils.framing import FramedServer, tcp_port_for
+
+        def _tcp_handle(op: bytes, key: str, body: bytes) -> bytes:
+            if op != b"A":
+                raise ValueError(f"unknown op {op!r}")
+            if not self.is_leader:
+                raise PermissionError("not the leader")
+            params = _json.loads(body) if body else {}
+            return _json.dumps(self.assign_fid(
+                count=int(params.get("count", 1)),
+                collection=params.get("collection", ""),
+                replication=params.get("replication", ""),
+                ttl_str=params.get("ttl", ""),
+                preferred_dc=params.get("dataCenter", ""))).encode()
+
+        self._tcp_server = FramedServer(
+            _tcp_handle, self.host, tcp_port_for(self.port),
+            name="tcp-master").start()
         self.raft.start()
         threading.Thread(target=self._janitor_loop, daemon=True,
                          name="master-janitor").start()
@@ -105,6 +129,8 @@ class MasterServer:
 
     def stop(self) -> None:
         self._stop.set()
+        if self._tcp_server is not None:
+            self._tcp_server.stop()
         self.raft.stop()
         if self._server:
             from ..utils.httpd import stop_server
@@ -127,6 +153,40 @@ class MasterServer:
     @property
     def leader_url(self) -> str:
         return self.raft.leader or self.url
+
+    def assign_fid(self, count: int = 1, collection: str = "",
+                   replication: str = "", ttl_str: str = "",
+                   preferred_dc: str = "") -> dict:
+        """fid allocation (master_grpc_server_volume.go:102 Assign):
+        pick a writable volume — growing one when none — and mint a
+        signed fid.  Shared by the HTTP and framed-TCP fronts."""
+        replication = replication or self.default_replication
+        ttl = TTL.parse(ttl_str)
+        rp = ReplicaPlacement.parse(replication)
+        layout = self.topo.get_layout(collection, rp, ttl)
+        try:
+            vid, nodes = layout.pick_for_write()
+        except LookupError:
+            grow_volume(self.topo, collection, rp, ttl, self._allocate_rpc,
+                        preferred_dc=preferred_dc,
+                        commit_ids=self._commit_volume_ids)
+            vid, nodes = layout.pick_for_write()
+        key = self.seq.next_file_id(count)
+        cookie = secrets.randbits(32)
+        node = random.choice(nodes)
+        fid = f"{vid},{format_needle_id_cookie(key, cookie)}"
+        result = {
+            "fid": fid,
+            "url": node.url,
+            "publicUrl": node.public_url,
+            "count": count,
+        }
+        # write authorization: sign the fid so only this assignment can
+        # be written (security/jwt.go:30, master_server_handlers.go)
+        if self.guard.signing_key:
+            result["auth"] = gen_jwt_for_volume_server(
+                self.guard.signing_key, self.guard.expires_after_sec, fid)
+        return result
 
     def _commit_volume_ids(self) -> None:
         """Quorum-replicate MaxVolumeId BEFORE acking an allocation
@@ -226,35 +286,12 @@ class MasterServer:
         @r.route("GET", "/dir/assign")
         def assign(req: Request) -> Response:
             self._require_leader(req)
-            count = int(req.query.get("count", 1))
-            collection = req.query.get("collection", "")
-            replication = req.query.get("replication") or self.default_replication
-            ttl = TTL.parse(req.query.get("ttl", ""))
-            rp = ReplicaPlacement.parse(replication)
-            layout = self.topo.get_layout(collection, rp, ttl)
-            try:
-                vid, nodes = layout.pick_for_write()
-            except LookupError:
-                grow_volume(self.topo, collection, rp, ttl, self._allocate_rpc,
-                            preferred_dc=req.query.get("dataCenter", ""),
-                            commit_ids=self._commit_volume_ids)
-                vid, nodes = layout.pick_for_write()
-            key = self.seq.next_file_id(count)
-            cookie = secrets.randbits(32)
-            node = random.choice(nodes)
-            fid = f"{vid},{format_needle_id_cookie(key, cookie)}"
-            result = {
-                "fid": fid,
-                "url": node.url,
-                "publicUrl": node.public_url,
-                "count": count,
-            }
-            # write authorization: sign the fid so only this assignment can
-            # be written (security/jwt.go:30, master_server_handlers.go)
-            if self.guard.signing_key:
-                result["auth"] = gen_jwt_for_volume_server(
-                    self.guard.signing_key, self.guard.expires_after_sec, fid)
-            return Response(result)
+            return Response(self.assign_fid(
+                count=int(req.query.get("count", 1)),
+                collection=req.query.get("collection", ""),
+                replication=req.query.get("replication", ""),
+                ttl_str=req.query.get("ttl", ""),
+                preferred_dc=req.query.get("dataCenter", "")))
 
         @r.route("GET", "/dir/lookup")
         def lookup(req: Request) -> Response:

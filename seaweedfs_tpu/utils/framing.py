@@ -1,0 +1,171 @@
+"""Shared framed-TCP RPC plumbing for the raw-TCP planes.
+
+One frame shape serves every TCP front end (volume needle IO, master
+assign):
+
+  request:  op(1) | key_len(u16) | key utf8 | body_len(u32) | body
+  response: status(1, 0=ok)      | payload_len(u32) | payload
+
+FramedServer runs an accept loop with a thread per connection and calls
+`handler(op, key, body) -> payload`; any exception becomes a status-1
+frame with the message, and the connection survives.  FramedClient keeps
+one TCP_NODELAY connection per (thread, address) with a single retry on
+stale reuse.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+from typing import Callable, Optional
+
+TCP_PORT_OFFSET = 20000
+U16 = struct.Struct(">H")
+U32 = struct.Struct(">I")
+
+
+def tcp_port_for(http_port: int) -> int:
+    """http port + 20000, wrapping DOWN when that leaves the valid range
+    (test servers sit on high ephemeral ports)."""
+    p = http_port + TCP_PORT_OFFSET
+    return p if p <= 65535 else http_port - TCP_PORT_OFFSET
+
+
+def tcp_address(http_url: str) -> str:
+    """host:port -> host:tcp_port_for(port), the address convention."""
+    host, _, port = http_url.partition(":")
+    return f"{host}:{tcp_port_for(int(port))}"
+
+
+def recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        piece = sock.recv(n - len(buf))
+        if not piece:
+            raise ConnectionError("peer closed")
+        buf += piece
+    return bytes(buf)
+
+
+class FramedServer:
+    def __init__(self, handler: Callable[[bytes, str, bytes], bytes],
+                 host: str = "127.0.0.1", port: int = 0,
+                 whitelist_ok: Optional[Callable[[str], bool]] = None,
+                 name: str = "framed"):
+        self.handler = handler
+        self.host, self.port = host, port
+        self._whitelist_ok = whitelist_ok
+        self.name = name
+        self._sock: Optional[socket.socket] = None
+        self._stop = threading.Event()
+
+    @property
+    def alive(self) -> bool:
+        return self._sock is not None
+
+    def start(self) -> "FramedServer":
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        try:
+            self._sock.bind((self.host, self.port))
+        except OSError:
+            # conventional port taken (ephemeral-port test clusters can
+            # collide): the HTTP plane still serves everything
+            self._sock.close()
+            self._sock = None
+            return self
+        self._sock.listen(64)
+        threading.Thread(target=self._accept_loop, daemon=True,
+                         name=f"{self.name}:{self.port}").start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, addr = self._sock.accept()
+            except OSError:
+                return  # listener closed
+            if self._whitelist_ok is not None and \
+                    not self._whitelist_ok(addr[0]):
+                conn.close()
+                continue
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             daemon=True,
+                             name=f"{self.name}-conn:{addr[1]}").start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        try:
+            while not self._stop.is_set():
+                try:
+                    op = recv_exact(conn, 1)
+                except ConnectionError:
+                    return
+                key_len = U16.unpack(recv_exact(conn, 2))[0]
+                key = recv_exact(conn, key_len).decode()
+                body_len = U32.unpack(recv_exact(conn, 4))[0]
+                body = recv_exact(conn, body_len) if body_len else b""
+                try:
+                    payload = self.handler(op, key, body)
+                    conn.sendall(b"\x00" + U32.pack(len(payload)) + payload)
+                except Exception as e:  # noqa: BLE001 - conn must survive
+                    msg = f"{type(e).__name__}: {e}".encode()[:65536]
+                    conn.sendall(b"\x01" + U32.pack(len(msg)) + msg)
+        finally:
+            conn.close()
+
+
+class FramedClient(threading.local):
+    """Per-thread persistent framed-TCP connections, one per server."""
+
+    def __init__(self):
+        self._conns: dict[str, socket.socket] = {}
+
+    def _conn(self, addr: str) -> socket.socket:
+        sock = self._conns.get(addr)
+        if sock is None:
+            host, _, port = addr.partition(":")
+            sock = socket.create_connection((host, int(port)), timeout=30)
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._conns[addr] = sock
+        return sock
+
+    def _drop(self, addr: str) -> None:
+        sock = self._conns.pop(addr, None)
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def request(self, addr: str, op: bytes, key: str,
+                body: bytes = b"") -> bytes:
+        """One framed op; retries once on a stale pooled connection."""
+        key_b = key.encode()
+        frame = (op + U16.pack(len(key_b)) + key_b
+                 + U32.pack(len(body)) + body)
+        for attempt in (0, 1):
+            reused = addr in self._conns
+            sock = self._conn(addr)
+            try:
+                sock.sendall(frame)
+                status = recv_exact(sock, 1)
+                n = U32.unpack(recv_exact(sock, 4))[0]
+                payload = recv_exact(sock, n) if n else b""
+            except (ConnectionError, OSError):
+                self._drop(addr)
+                if not reused:
+                    raise
+                continue
+            if status != b"\x00":
+                raise OSError(payload.decode(errors="replace"))
+            return payload

@@ -7,17 +7,13 @@ request-line parsing, no header blocks, no chunked framing.  On this
 Python stack HTTP parsing dominates small-object cost, so the TCP path
 is the high-throughput option, not just an experiment.
 
-Frame format (all integers big-endian):
+Frame format: utils/framing.py.  Ops here:
 
-  request:  op(1) | fid_len(u16) | fid utf8 | body_len(u32) | body
-  response: status(1, 0=ok)      | payload_len(u32) | payload
+  op 'W': write needle (key=fid, body=data); ok payload = u32 stored size
+  op 'R': read needle  (key=fid);            ok payload = needle data
+  op 'D': delete       (key=fid);            ok payload = u32 size
 
-  op 'W': write needle; ok payload = u32 stored size
-  op 'R': read needle;  ok payload = needle data
-  op 'D': delete;       ok payload = u32 reclaimed size
-  error payload = utf8 message
-
-The TCP port rides the HTTP port + TCP_PORT_OFFSET convention (like the
+The TCP port rides the HTTP port + 20000 convention (like the
 reference's grpc = http + 10000 rule, pb/server_address.go).  Writes are
 LOCAL only — replication stays an HTTP-plane concern, mirroring the
 reference's TCP experiment.
@@ -25,180 +21,50 @@ reference's TCP experiment.
 
 from __future__ import annotations
 
-import socket
-import struct
-import threading
-from typing import Optional
-
 from ..storage.file_id import FileId
 from ..storage.needle import Needle
-
-TCP_PORT_OFFSET = 20000
-_U16 = struct.Struct(">H")
-_U32 = struct.Struct(">I")
-
-
-def tcp_port_for(http_port: int) -> int:
-    """http port + 20000, wrapping DOWN when that leaves the valid range
-    (test servers sit on high ephemeral ports)."""
-    p = http_port + TCP_PORT_OFFSET
-    return p if p <= 65535 else http_port - TCP_PORT_OFFSET
+from ..utils.framing import (  # noqa: F401 - re-exported for callers
+    TCP_PORT_OFFSET,
+    U32,
+    FramedClient,
+    FramedServer,
+    tcp_address,
+    tcp_port_for,
+)
 
 
-def _recv_exact(sock: socket.socket, n: int) -> bytes:
-    buf = bytearray()
-    while len(buf) < n:
-        piece = sock.recv(n - len(buf))
-        if not piece:
-            raise ConnectionError("peer closed")
-        buf += piece
-    return bytes(buf)
-
-
-class TcpVolumeServer:
+class TcpVolumeServer(FramedServer):
     """Framed-TCP front end over a Store (thread per connection)."""
 
     def __init__(self, store, host: str = "127.0.0.1", port: int = 0,
                  whitelist_ok=None):
+        super().__init__(self._handle, host,
+                         port or tcp_port_for(store.port),
+                         whitelist_ok=whitelist_ok, name="tcp-volume")
         self.store = store
-        self.host = host
-        self.port = port or tcp_port_for(store.port)
-        self._whitelist_ok = whitelist_ok  # optional (ip) -> bool gate
-        self._sock: Optional[socket.socket] = None
-        self._stop = threading.Event()
-
-    def start(self) -> "TcpVolumeServer":
-        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        try:
-            self._sock.bind((self.host, self.port))
-        except OSError:
-            # conventional port taken (ephemeral-port test clusters can
-            # collide): stay HTTP-only rather than fail the whole server
-            self._sock.close()
-            self._sock = None
-            return self
-        self._sock.listen(64)
-        threading.Thread(target=self._accept_loop, daemon=True,
-                         name=f"tcp-volume:{self.port}").start()
-        return self
-
-    def stop(self) -> None:
-        self._stop.set()
-        if self._sock is not None:
-            try:
-                self._sock.close()
-            except OSError:
-                pass
-
-    def _accept_loop(self) -> None:
-        while not self._stop.is_set():
-            try:
-                conn, addr = self._sock.accept()
-            except OSError:
-                return  # listener closed
-            if self._whitelist_ok is not None and \
-                    not self._whitelist_ok(addr[0]):
-                conn.close()
-                continue
-            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            threading.Thread(target=self._serve_conn, args=(conn,),
-                             daemon=True,
-                             name=f"tcp-volume-conn:{addr[1]}").start()
-
-    def _serve_conn(self, conn: socket.socket) -> None:
-        try:
-            while not self._stop.is_set():
-                try:
-                    op = _recv_exact(conn, 1)
-                except ConnectionError:
-                    return
-                fid_len = _U16.unpack(_recv_exact(conn, 2))[0]
-                fid_str = _recv_exact(conn, fid_len).decode()
-                body_len = _U32.unpack(_recv_exact(conn, 4))[0]
-                body = _recv_exact(conn, body_len) if body_len else b""
-                try:
-                    payload = self._handle(op, fid_str, body)
-                    conn.sendall(b"\x00" + _U32.pack(len(payload)) + payload)
-                except Exception as e:  # noqa: BLE001 - conn must survive
-                    msg = f"{type(e).__name__}: {e}".encode()[:65536]
-                    conn.sendall(b"\x01" + _U32.pack(len(msg)) + msg)
-        finally:
-            conn.close()
 
     def _handle(self, op: bytes, fid_str: str, body: bytes) -> bytes:
         fid = FileId.parse(fid_str)
         if op == b"W":
             n = Needle(cookie=fid.cookie, id=fid.key, data=body)
             size, _ = self.store.write_needle(fid.volume_id, n)
-            return _U32.pack(size & 0xFFFFFFFF)
+            return U32.pack(size & 0xFFFFFFFF)
         if op == b"R":
             n = self.store.read_needle(fid.volume_id, fid.key, fid.cookie)
             return n.data
         if op == b"D":
             n = Needle(cookie=fid.cookie, id=fid.key)
             size = self.store.delete_needle(fid.volume_id, n)
-            return _U32.pack(size & 0xFFFFFFFF)
+            return U32.pack(size & 0xFFFFFFFF)
         raise ValueError(f"unknown op {op!r}")
 
 
-class TcpVolumeClient(threading.local):
-    """Per-thread persistent framed-TCP connections, one per server."""
-
-    def __init__(self):
-        self._conns: dict[str, socket.socket] = {}
-
-    def _conn(self, addr: str) -> socket.socket:
-        sock = self._conns.get(addr)
-        if sock is None:
-            host, _, port = addr.partition(":")
-            sock = socket.create_connection((host, int(port)), timeout=30)
-            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            self._conns[addr] = sock
-        return sock
-
-    def _drop(self, addr: str) -> None:
-        sock = self._conns.pop(addr, None)
-        if sock is not None:
-            try:
-                sock.close()
-            except OSError:
-                pass
-
-    def request(self, addr: str, op: bytes, fid: str,
-                body: bytes = b"") -> bytes:
-        """One framed op; retries once on a stale pooled connection."""
-        fid_b = fid.encode()
-        frame = (op + _U16.pack(len(fid_b)) + fid_b
-                 + _U32.pack(len(body)) + body)
-        for attempt in (0, 1):
-            reused = addr in self._conns
-            sock = self._conn(addr)
-            try:
-                sock.sendall(frame)
-                status = _recv_exact(sock, 1)
-                n = _U32.unpack(_recv_exact(sock, 4))[0]
-                payload = _recv_exact(sock, n) if n else b""
-            except (ConnectionError, OSError):
-                self._drop(addr)
-                if not reused:
-                    raise
-                continue
-            if status != b"\x00":
-                raise OSError(payload.decode(errors="replace"))
-            return payload
-
+class TcpVolumeClient(FramedClient):
     def write(self, addr: str, fid: str, data: bytes) -> int:
-        return _U32.unpack(self.request(addr, b"W", fid, data))[0]
+        return U32.unpack(self.request(addr, b"W", fid, data))[0]
 
     def read(self, addr: str, fid: str) -> bytes:
         return self.request(addr, b"R", fid)
 
     def delete(self, addr: str, fid: str) -> int:
-        return _U32.unpack(self.request(addr, b"D", fid))[0]
-
-
-def tcp_address(http_url: str) -> str:
-    """host:port -> host:tcp_port_for(port), the address convention."""
-    host, _, port = http_url.partition(":")
-    return f"{host}:{tcp_port_for(int(port))}"
+        return U32.unpack(self.request(addr, b"D", fid))[0]
