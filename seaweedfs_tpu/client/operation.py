@@ -189,7 +189,18 @@ class WeedClient:
         if a is None:
             a = self.master.assign(collection=collection,
                                    replication=replication, ttl=ttl)
-        self._tcp.write(tcp_address(a.url), a.fid, data)
+        try:
+            self._tcp.write(tcp_address(a.url), a.fid, data)
+        except (ConnectionError, OSError):
+            # TCP plane closed on this server (secured cluster, port
+            # collision): the assignment is still valid — finish the
+            # write over HTTP, which can carry the JWT
+            headers = {"Authorization": f"BEARER {a.auth}"} if a.auth \
+                else None
+            status, body, _ = http_bytes(
+                "POST", f"http://{a.url}/{a.fid}", data, headers=headers)
+            if status not in (200, 201):
+                raise HttpError(status, body.decode(errors="replace"))
         return a.fid
 
     def download_tcp(self, fid: str) -> bytes:

@@ -210,7 +210,7 @@ class Filer:
                     target.hard_link_id) + 1
             self.store.update_entry(target)  # saves content w/ new counter
             link = Entry(full_path=link_path, attr=target.attr,
-                         chunks=target.chunks,
+                         chunks=target.chunks, extended=target.extended,
                          hard_link_id=target.hard_link_id,
                          hard_link_counter=target.hard_link_counter)
             self.store.insert_entry(link)

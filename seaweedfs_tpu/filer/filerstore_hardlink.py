@@ -36,6 +36,7 @@ class HardLinkAwareStore:
         content = {
             "attr": entry.attr.to_dict(),
             "chunks": [c.to_dict() for c in entry.chunks],
+            "extended": entry.extended,  # xattrs/x-amz-meta are content too
             "hard_link_counter": entry.hard_link_counter,
         }
         self.store.kv_put(_content_key(entry.hard_link_id),

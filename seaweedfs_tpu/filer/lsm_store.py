@@ -207,7 +207,10 @@ class LsmStore:
         old = self._tables
         self._tables = [new_table]
         for t in old:
-            t.close()
+            # unlink WITHOUT closing: concurrent readers snapshot the
+            # table list outside the lock and keep preading through their
+            # open fds; POSIX keeps the unlinked inode alive until the
+            # last reference (the table object) is garbage collected
             os.remove(t.path)
 
     def flush(self) -> None:

@@ -194,9 +194,9 @@ class IdentityAccessManagement:
                 raise AuthError("IncompleteBody",
                                 "streaming chunk shorter than declared", 400)
             if not given_sig:
-                if size == 0 and ctx.get("trailer"):
-                    saw_final = True  # trailer-variant final chunk
-                    break
+                # AWS signs EVERY chunk in both signed variants, including
+                # the final 0-chunk — an unsigned final frame would let an
+                # attacker truncate the stream undetected
                 raise AuthError("SignatureDoesNotMatch",
                                 "streaming chunk missing chunk-signature")
             string_to_sign = "\n".join([
@@ -216,6 +216,13 @@ class IdentityAccessManagement:
         if not saw_final:
             raise AuthError("IncompleteBody",
                             "streaming upload missing final chunk", 400)
+        declared = ctx.get("decoded_length")
+        if declared is not None and len(out) != declared:
+            # the signed x-amz-decoded-content-length must match what the
+            # verified chunks actually carried
+            raise AuthError("IncompleteBody",
+                            f"decoded {len(out)} bytes != declared "
+                            f"{declared}", 400)
         return bytes(out)
 
     # --- SigV4 ------------------------------------------------------------
@@ -308,9 +315,11 @@ class IdentityAccessManagement:
             # both SIGNED streaming variants chain per-chunk signatures
             # off the seed; only STREAMING-UNSIGNED-PAYLOAD-TRAILER has
             # none to verify
+            declared = headers.get("X-Amz-Decoded-Content-Length")
             ctx = {"secret": secret, "scope": scope, "amz_date": amz_date,
                    "seed_signature": given_sig,
-                   "trailer": payload_hash.endswith("-TRAILER")}
+                   "trailer": payload_hash.endswith("-TRAILER"),
+                   "decoded_length": int(declared) if declared else None}
         return identity, ctx
 
     def _verify_v4_presigned(self, method: str, path: str, query: dict,

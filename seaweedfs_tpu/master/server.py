@@ -113,9 +113,13 @@ class MasterServer:
                 ttl_str=params.get("ttl", ""),
                 preferred_dc=params.get("dataCenter", ""))).encode()
 
-        self._tcp_server = FramedServer(
-            _tcp_handle, self.host, tcp_port_for(self.port),
-            name="tcp-master").start()
+        # plaintext and unauthenticated by design — so it must not run on
+        # secured clusters (mTLS or JWT-minting masters); clients fall
+        # back to the HTTPS/JWT HTTP assign transparently
+        if self._tls_context is None and not self.guard.signing_key:
+            self._tcp_server = FramedServer(
+                _tcp_handle, self.host, tcp_port_for(self.port),
+                name="tcp-master").start()
         self.raft.start()
         threading.Thread(target=self._janitor_loop, daemon=True,
                          name="master-janitor").start()

@@ -190,7 +190,9 @@ class CompactNeedleMap:
     def get(self, key: int) -> Optional[NeedleValue]:
         with self._mu:
             where, _, units, size = self._lookup(key)
-        if not where or not size_is_valid(size) or units == 0:
+        # absent = never set, tombstoned, or never-written; a size-0 put
+        # is LIVE (MemoryNeedleMap serves it — the dict stores it as-is)
+        if not where or size == TOMBSTONE_FILE_SIZE or units == 0:
             return None
         return NeedleValue(key, units * NEEDLE_PADDING_SIZE, size)
 
@@ -310,18 +312,20 @@ class CompactNeedleMap:
                                       list(self._tail_s))
             over = sorted((k, v[0], v[1]) for k, v in self._over.items())
         oi = 0
+        # iteration yields every live entry incl. size-0 (dict-map parity);
+        # only tombstones are skipped
         for nv in self._iter_main(sections, tail_k, tail_o, tail_s):
             while oi < len(over) and over[oi][0] < nv.key:
                 k, u, s = over[oi]
                 oi += 1
-                if size_is_valid(s):
+                if s != TOMBSTONE_FILE_SIZE:
                     yield NeedleValue(k, u * NEEDLE_PADDING_SIZE, s)
-            if size_is_valid(nv.size):
+            if nv.size != TOMBSTONE_FILE_SIZE:
                 yield nv
         while oi < len(over):
             k, u, s = over[oi]
             oi += 1
-            if size_is_valid(s):
+            if s != TOMBSTONE_FILE_SIZE:
                 yield NeedleValue(k, u * NEEDLE_PADDING_SIZE, s)
 
     def ascending_visit(self, fn: Callable[[NeedleValue], None]) -> None:

@@ -19,11 +19,8 @@ register_debug_routes(router, status_fn) wires all four onto any Router.
 
 from __future__ import annotations
 
-import cProfile
 import html
-import io
 import json
-import pstats
 import sys
 import threading
 import time
@@ -33,15 +30,49 @@ from typing import Callable, Optional
 from .httpd import Request, Response, Router
 
 
-def _profile_text(seconds: float) -> str:
-    prof = cProfile.Profile()
-    prof.enable()
-    time.sleep(seconds)
-    prof.disable()
-    buf = io.StringIO()
-    stats = pstats.Stats(prof, stream=buf)
-    stats.sort_stats("cumulative").print_stats(60)
-    return buf.getvalue()
+def _profile_text(seconds: float, interval: float = 0.005) -> str:
+    """Sampling profiler across ALL threads (cProfile instruments only the
+    calling thread, which here would just be sleeping): sample
+    sys._current_frames() every `interval` and aggregate self/cumulative
+    hits per frame — a py-spy-style statistical profile of real server
+    work under load."""
+    self_hits: dict[tuple, int] = {}
+    cum_hits: dict[tuple, int] = {}
+    own = threading.get_ident()
+    samples = 0
+    deadline = time.monotonic() + seconds
+    while time.monotonic() < deadline:
+        for ident, frame in sys._current_frames().items():
+            if ident == own:
+                continue
+            leaf = True
+            seen_in_stack = set()
+            while frame is not None:
+                key = (frame.f_code.co_filename, frame.f_lineno,
+                       frame.f_code.co_name)
+                if leaf:
+                    self_hits[key] = self_hits.get(key, 0) + 1
+                    leaf = False
+                ckey = (frame.f_code.co_filename, frame.f_code.co_name)
+                if ckey not in seen_in_stack:  # recursion counts once
+                    cum_hits[ckey] = cum_hits.get(ckey, 0) + 1
+                    seen_in_stack.add(ckey)
+                frame = frame.f_back
+        samples += 1
+        time.sleep(interval)
+    lines = [f"sampling profile: {samples} samples over {seconds}s "
+             f"({interval * 1e3:.0f}ms interval), all threads",
+             "", "-- self time (leaf frames) --"]
+    for (fname, lineno, func), n in sorted(self_hits.items(),
+                                           key=lambda kv: -kv[1])[:40]:
+        lines.append(f"{n:>6} {100 * n / max(samples, 1):5.1f}% "
+                     f"{func} ({fname}:{lineno})")
+    lines += ["", "-- cumulative (anywhere on stack) --"]
+    for (fname, func), n in sorted(cum_hits.items(),
+                                   key=lambda kv: -kv[1])[:40]:
+        lines.append(f"{n:>6} {100 * n / max(samples, 1):5.1f}% "
+                     f"{func} ({fname})")
+    return "\n".join(lines) + "\n"
 
 
 def _thread_dump() -> str:

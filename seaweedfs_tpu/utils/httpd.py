@@ -312,9 +312,14 @@ def _pooled_request(method: str, url: str, body: Optional[bytes],
                     headers: Optional[dict], timeout: float
                     ) -> tuple[int, bytes, dict]:
     """One request over the pool; raises OSError family on failure.
-    A request that fails on a REUSED connection retries once on a fresh
-    one (the server closed the idle keep-alive — it never saw the
-    request); a failure on a brand-new connection propagates."""
+    A request that fails with a CONNECTION-staleness error on a REUSED
+    connection retries once on a fresh one (the server closed the idle
+    keep-alive — it never saw the request).  Timeouts NEVER retry: the
+    server may be mid-mutation, and transparently resending a POST
+    would execute it twice.  Failures on a brand-new connection
+    propagate."""
+    import socket as _socket
+
     url, ssl_ctx = _prep_url(url)
     parsed = urllib.parse.urlsplit(url)
     key = (parsed.scheme, parsed.netloc)
@@ -338,6 +343,10 @@ def _pooled_request(method: str, url: str, body: Optional[bytes],
                 conn.close()
                 _pool.conns.pop(key, None)
             return resp.status, data, hdrs
+        except (TimeoutError, _socket.timeout):
+            conn.close()
+            _pool.conns.pop(key, None)
+            raise
         except Exception:
             conn.close()
             _pool.conns.pop(key, None)

@@ -100,15 +100,21 @@ class VolumeServer:
     def start(self) -> "VolumeServer":
         self._server = serve(self.router, self.store.ip, self.store.port,
                              tls_context=self._tls_context)
-        # the framed-TCP path has no JWT slot, so it must not open a write
-        # bypass on a JWT-secured cluster (IP whitelists still apply)
-        if self._tcp_enabled and not self.guard.signing_key:
+        # the framed-TCP path has no JWT or TLS slot, so it must never
+        # open an unauthenticated side door: it stays closed when write
+        # OR read JWTs are configured, and when cluster mTLS is on
+        # (IP whitelists still apply when it does run)
+        if self._tcp_enabled and not self.guard.signing_key \
+                and not self.guard.read_signing_key \
+                and self._tls_context is None:
             from .tcp import TcpVolumeServer
 
             self._tcp_server = TcpVolumeServer(
                 self.store, self.store.ip,
                 whitelist_ok=(self.guard.check_white_list
-                              if self.guard.is_write_active else None)).start()
+                              if self.guard.is_write_active else None),
+                replicate_write=self._tcp_replicate_write,
+                replicate_delete=self._tcp_replicate_delete).start()
         threading.Thread(target=self._heartbeat_loop, daemon=True,
                          name=f"heartbeat:{self.url}").start()
         return self
@@ -198,6 +204,25 @@ class VolumeServer:
                       self.heartbeat_payload())
 
     # --- helpers ----------------------------------------------------------
+    def _tcp_replicate_write(self, fid_str: str, data: bytes) -> None:
+        """Replica fan-out for the TCP plane (store_replicate.go:23-140
+        semantics, carried over HTTP with the replicate loop guard)."""
+        vid = int(fid_str.split(",")[0])
+        for url in self._lookup_replicas(vid):
+            if url == self.url:
+                continue
+            status, body, _ = http_bytes(
+                "POST", f"http://{url}/{fid_str}?type=replicate", data)
+            if status not in (200, 201):
+                raise OSError(f"replication to {url} failed: {status}")
+
+    def _tcp_replicate_delete(self, fid_str: str) -> None:
+        vid = int(fid_str.split(",")[0])
+        for url in self._lookup_replicas(vid):
+            if url == self.url:
+                continue
+            http_bytes("DELETE", f"http://{url}/{fid_str}?type=replicate")
+
     def _lookup_replicas(self, vid: int) -> list[str]:
         """Replica locations with a short TTL cache
         (operation/lookup_vid_cache.go — the reference caches for 10min;

@@ -34,27 +34,44 @@ from ..utils.framing import (  # noqa: F401 - re-exported for callers
 
 
 class TcpVolumeServer(FramedServer):
-    """Framed-TCP front end over a Store (thread per connection)."""
+    """Framed-TCP front end over a Store (thread per connection).
+    replicate_write/replicate_delete hooks fan the mutation out to the
+    volume's other replicas (the HTTP plane's ReplicatedWrite), so a
+    TCP write to a replicated volume cannot silently diverge."""
 
     def __init__(self, store, host: str = "127.0.0.1", port: int = 0,
-                 whitelist_ok=None):
+                 whitelist_ok=None, replicate_write=None,
+                 replicate_delete=None):
         super().__init__(self._handle, host,
                          port or tcp_port_for(store.port),
                          whitelist_ok=whitelist_ok, name="tcp-volume")
         self.store = store
+        self.replicate_write = replicate_write
+        self.replicate_delete = replicate_delete
 
     def _handle(self, op: bytes, fid_str: str, body: bytes) -> bytes:
         fid = FileId.parse(fid_str)
         if op == b"W":
             n = Needle(cookie=fid.cookie, id=fid.key, data=body)
             size, _ = self.store.write_needle(fid.volume_id, n)
+            if self.replicate_write is not None:
+                self.replicate_write(fid_str, body)
             return U32.pack(size & 0xFFFFFFFF)
         if op == b"R":
             n = self.store.read_needle(fid.volume_id, fid.key, fid.cookie)
+            if n.is_compressed:
+                # HTTP-written compressible objects are stored gzipped
+                # (Content-Encoding negotiation); the frame protocol has
+                # no encoding slot, so serve the original bytes
+                from ..utils.compression import ungzip_data
+
+                return ungzip_data(n.data)
             return n.data
         if op == b"D":
             n = Needle(cookie=fid.cookie, id=fid.key)
             size = self.store.delete_needle(fid.volume_id, n)
+            if self.replicate_delete is not None:
+                self.replicate_delete(fid_str)
             return U32.pack(size & 0xFFFFFFFF)
         raise ValueError(f"unknown op {op!r}")
 

@@ -242,3 +242,20 @@ def test_meta_aggregator_merges_peer_events(tmp_path):
         fa.stop()
         vs.stop()
         master.stop()
+
+
+def test_hardlink_preserves_extended_metadata():
+    f = Filer()
+    e = _file("/meta.bin", ["9,aa"])
+    e.extended = {"x-amz-meta-owner": "carol", "xattr.user.tag": "blue"}
+    f.create_entry(e)
+    f.hardlink("/meta.bin", "/meta-link.bin")
+    for path in ("/meta.bin", "/meta-link.bin"):
+        got = f.find_entry(path)
+        assert got.extended.get("x-amz-meta-owner") == "carol", path
+    # updating extended through one name is visible through the other
+    got = f.find_entry("/meta-link.bin")
+    got.extended["x-amz-meta-owner"] = "dave"
+    f.update_entry(got)
+    assert f.find_entry("/meta.bin").extended["x-amz-meta-owner"] == "dave"
+    f.close()

@@ -198,3 +198,19 @@ def test_volume_roundtrip_each_kind(tmp_path, kind):
         assert v2.read_needle(20).data == b"x" * 20
     finally:
         v2.close()
+
+
+def test_zero_size_needles_are_live_in_every_runtime_kind(tmp_path):
+    """A 0-byte PUT is a live needle: the dict map serves it, so the
+    compact kinds must too (get AND iteration)."""
+    for kind, cls in (("memory", MemoryNeedleMap),
+                      ("compact", CompactNeedleMap),
+                      ("ldb", CheckpointedNeedleMap)):
+        m = cls(str(tmp_path / f"{kind}.idx"))
+        m.put(5, 80, 0)
+        nv = m.get(5)
+        assert nv is not None and nv.size == 0, kind
+        assert [v.key for v in m] == [5], kind
+        m.delete(5, 8)
+        assert m.get(5) is None, kind
+        m.close()

@@ -83,3 +83,14 @@ def test_tcp_interleaved_ops_on_one_connection(pair):
         assert len(data) >= 100
         tcp.write(addr, fid, data + b"!")  # overwrite same needle
         assert tcp.read(addr, fid) == data + b"!"
+
+
+def test_tcp_read_decompresses_http_written_objects(pair):
+    """An HTTP upload of compressible content stores gzip bytes with
+    FLAG_IS_COMPRESSED; the TCP read op must serve the ORIGINAL bytes."""
+    master, vs = pair
+    client = WeedClient(master.url)
+    text = b"compress me " * 1000
+    fid = client.upload(text, name="doc.txt", mime="text/plain")
+    assert client.download(fid) == text        # HTTP plane
+    assert client.download_tcp(fid) == text    # TCP plane must match
