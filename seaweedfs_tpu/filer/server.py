@@ -37,6 +37,16 @@ def _effective_size(entry: Entry) -> int:
     return entry.file_size
 
 
+def _trap(fn, *args):
+    """Run fn, returning the exception instead of raising (executor.map
+    would otherwise hide which view failed until iteration)."""
+    try:
+        fn(*args)
+        return None
+    except Exception as e:  # noqa: BLE001
+        return e
+
+
 def _ttl_seconds(ttl: str) -> int:
     if not ttl:
         return 0
@@ -335,11 +345,28 @@ class FilerServer:
         chunks = self.resolve_chunks(entry.chunks, offset, offset + size)
         by_fid = {c.file_id: c for c in chunks}
         out = bytearray(size)
-        for view in read_plan(chunks, offset, size):
+        plan = read_plan(chunks, offset, size)
+
+        def fill(view) -> None:
             piece = self.fetch_chunk_range(
                 by_fid[view.file_id], view.offset_in_chunk, view.size)
             start = view.logic_offset - offset
             out[start : start + len(piece)] = piece
+
+        if len(plan) <= 1:
+            for view in plan:
+                fill(view)
+        else:
+            # chunks live on different volume servers: fetch them in
+            # parallel (filer/stream.go drives ChunkViews concurrently);
+            # each worker writes a disjoint slice of `out`
+            import concurrent.futures
+
+            with concurrent.futures.ThreadPoolExecutor(
+                    min(8, len(plan))) as ex:
+                for err in ex.map(lambda v: _trap(fill, v), plan):
+                    if err is not None:
+                        raise err
         return bytes(out)
 
     def manifestize(self, chunks: list[FileChunk], collection: str = "",

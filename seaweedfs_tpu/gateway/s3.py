@@ -361,6 +361,8 @@ class S3ApiServer:
             self._auth(req, ACTION_READ, req.match.group(1),
                        req.match.group(2))
             bucket, key = req.match.group(1), req.match.group(2)
+            if "uploadId" in req.query and req.handler.command == "GET":
+                return self._list_parts(req, bucket, key)
             try:
                 entry = self.fs.filer.find_entry(self._object_path(bucket, key))
             except FilerNotFound:
@@ -552,6 +554,28 @@ class S3ApiServer:
             ET.SubElement(u, "Key").text = meta.extended.get("key", "")
             ET.SubElement(u, "UploadId").text = d.name
             ET.SubElement(u, "Initiated").text = _iso(meta.attr.crtime)
+        return _xml(root)
+
+    def _list_parts(self, req: Request, bucket: str, key: str) -> Response:
+        """ListParts (s3api_object_multipart_handlers.go): uploaded parts
+        of an in-progress multipart upload."""
+        self._upload_meta(req)
+        upload_id = req.query["uploadId"]
+        root = ET.Element("ListPartsResult", xmlns=S3_NS)
+        ET.SubElement(root, "Bucket").text = bucket
+        ET.SubElement(root, "Key").text = key
+        ET.SubElement(root, "UploadId").text = upload_id
+        ET.SubElement(root, "IsTruncated").text = "false"
+        for e in sorted(self.fs.filer.list_directory(
+                f"{UPLOADS_PATH}/{upload_id}"), key=lambda e: e.name):
+            if not e.name.endswith(".part"):
+                continue
+            p = ET.SubElement(root, "Part")
+            ET.SubElement(p, "PartNumber").text = str(
+                int(e.name[:-len(".part")]))
+            ET.SubElement(p, "LastModified").text = _iso(e.attr.mtime)
+            ET.SubElement(p, "ETag").text = f'"{e.attr.md5}"'
+            ET.SubElement(p, "Size").text = str(e.file_size)
         return _xml(root)
 
     def _abort_multipart(self, req: Request, bucket: str, key: str) -> Response:

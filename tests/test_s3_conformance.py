@@ -274,6 +274,24 @@ def test_multipart_upload_and_list_uploads(s3):
     assert upload_id not in body.decode()
 
 
+def test_multipart_list_parts(s3):
+    _req(s3, "PUT", "/mplp")
+    st, body, _ = _req(s3, "POST", "/mplp/parts.bin?uploads=")
+    upload_id = _xml(body).findtext(f"{NS}UploadId")
+    for n, data in ((1, b"P" * 1000), (2, b"Q" * 2000)):
+        _req(s3, "PUT",
+             f"/mplp/parts.bin?partNumber={n}&uploadId={upload_id}", data)
+    st, body, _ = _req(s3, "GET", f"/mplp/parts.bin?uploadId={upload_id}")
+    assert st == 200
+    doc = _xml(body)
+    parts = doc.findall(f"{NS}Part")
+    assert [p.findtext(f"{NS}PartNumber") for p in parts] == ["1", "2"]
+    assert [p.findtext(f"{NS}Size") for p in parts] == ["1000", "2000"]
+    _req(s3, "DELETE", f"/mplp/parts.bin?uploadId={upload_id}")
+    st, body, _ = _req(s3, "GET", f"/mplp/parts.bin?uploadId={upload_id}")
+    assert st == 404 and b"NoSuchUpload" in body
+
+
 def test_multipart_abort(s3):
     _req(s3, "PUT", "/mpab")
     st, body, _ = _req(s3, "POST", "/mpab/x.bin?uploads=")
