@@ -74,6 +74,10 @@ class EcVolumeShard:
         self.size = os.fstat(self._f.fileno()).st_size
 
     def read_at(self, length: int, offset: int) -> bytes:
+        from ..utils import faultinject as fi
+
+        if fi._points:
+            fi.hit("shard.read")
         return os.pread(self._f.fileno(), length, offset)
 
     def close(self) -> None:

@@ -17,6 +17,8 @@ import shutil
 import threading
 from typing import Optional, Protocol
 
+from ..utils import faultinject as fi
+
 
 class BackendStorageFile(Protocol):
     """What a Volume needs from its `.dat`: positional IO + size."""
@@ -44,15 +46,21 @@ class DiskFile:
         self._f = open(path, "r+b" if exists else "w+b", buffering=0)
 
     def read_at(self, length: int, offset: int) -> bytes:
+        if fi._points:
+            fi.hit("disk.read")
         return os.pread(self._f.fileno(), length, offset)
 
     def write_at(self, data: bytes, offset: int) -> int:
+        if fi._points:
+            fi.hit("disk.write")
         return os.pwrite(self._f.fileno(), data, offset)
 
     def truncate(self, size: int) -> None:
         os.ftruncate(self._f.fileno(), size)
 
     def sync(self) -> None:
+        if fi._points:
+            fi.hit("disk.sync")
         os.fsync(self._f.fileno())
 
     def close(self) -> None:

@@ -1,0 +1,94 @@
+"""Fault injection: named fault points with configurable errors/delays.
+
+SURVEY.md §5 notes the reference has no fault-injection framework and
+that the rebuild should carry one.  Fault points are free when disabled
+(one dict-emptiness check); tests and chaos drills arm them:
+
+    from seaweedfs_tpu.utils import faultinject as fi
+
+    fi.enable("disk.read", error_rate=0.3)         # 30% of reads raise
+    fi.enable("net.request", delay=0.05)           # +50ms per request
+    with fi.scoped("disk.sync", error_rate=1.0):   # scoped arming
+        ...
+    fi.clear()
+
+Instrumented sites (grep for fi.hit to find them all):
+    disk.read / disk.write / disk.sync   — DiskFile positional IO
+    shard.read                           — EC shard pread
+    net.request                          — pooled HTTP client sends
+"""
+
+from __future__ import annotations
+
+import contextlib
+import random
+import threading
+import time
+from typing import Optional
+
+_lock = threading.Lock()
+_points: dict[str, dict] = {}
+_counts: dict[str, int] = {}
+
+
+def enable(name: str, error_rate: float = 0.0,
+           error: Optional[BaseException] = None,
+           delay: float = 0.0, max_hits: int = 0) -> None:
+    """Arm a fault point.  error_rate in [0,1]; max_hits>0 auto-disarms
+    after that many injected faults (deterministic crash tests)."""
+    with _lock:
+        _points[name] = {
+            "error_rate": error_rate,
+            "error": error or OSError(f"fault injected at {name}"),
+            "delay": delay,
+            "max_hits": max_hits,
+            "hits": 0,
+        }
+
+
+def disable(name: str) -> None:
+    with _lock:
+        _points.pop(name, None)
+
+
+def clear() -> None:
+    with _lock:
+        _points.clear()
+        _counts.clear()
+
+
+def fired(name: str) -> int:
+    """How many times this point actually injected a fault."""
+    return _counts.get(name, 0)
+
+
+@contextlib.contextmanager
+def scoped(name: str, **kwargs):
+    enable(name, **kwargs)
+    try:
+        yield
+    finally:
+        disable(name)
+
+
+def hit(name: str) -> None:
+    """The instrumented call: no-op unless armed (callers guard with
+    `if faultinject._points:` for true zero cost on hot paths)."""
+    if not _points:
+        return
+    with _lock:
+        p = _points.get(name)
+        if p is None:
+            return
+        if p["max_hits"] and p["hits"] >= p["max_hits"]:
+            return
+        inject_error = p["error_rate"] and random.random() < p["error_rate"]
+        delay = p["delay"]
+        if inject_error or delay:
+            p["hits"] += 1
+            _counts[name] = _counts.get(name, 0) + 1
+        err = p["error"] if inject_error else None
+    if delay:
+        time.sleep(delay)
+    if err is not None:
+        raise err

@@ -325,6 +325,10 @@ def _pooled_request(method: str, url: str, body: Optional[bytes],
     key = (parsed.scheme, parsed.netloc)
     target = (parsed.path or "/") + (f"?{parsed.query}" if parsed.query
                                      else "")
+    from . import faultinject as fi
+
+    if fi._points:
+        fi.hit("net.request")
     for _ in range(2):
         conn = _pool.conns.get(key)
         reused = conn is not None

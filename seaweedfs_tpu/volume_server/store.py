@@ -374,13 +374,23 @@ class Store:
         for iv in intervals:
             shard_id, shard_offset = iv.to_shard_id_and_offset(
                 ev.large_block_size, ev.small_block_size, ev.data_shards)
+            piece = None
             if shard_id in ev.shards:
-                out.append(ev.shards[shard_id].read_at(iv.size, shard_offset))
-            elif fetch_remote is not None:
-                out.append(fetch_remote(vid, shard_id, shard_offset, iv.size))
-            else:
-                out.append(ev.reconstruct_interval(shard_id, shard_offset,
-                                                   iv.size, self.rs()))
+                try:
+                    piece = ev.shards[shard_id].read_at(iv.size, shard_offset)
+                except OSError:
+                    # bad sector/dying disk: treat the shard as absent and
+                    # self-heal through the degraded-read paths below
+                    piece = None
+            if piece is None and fetch_remote is not None:
+                try:
+                    piece = fetch_remote(vid, shard_id, shard_offset, iv.size)
+                except Exception:
+                    piece = None
+            if piece is None:
+                piece = ev.reconstruct_interval(shard_id, shard_offset,
+                                                iv.size, self.rs())
+            out.append(piece)
         return b"".join(out), size
 
     def ec_delete_needle(self, vid: int, key: int) -> None:
