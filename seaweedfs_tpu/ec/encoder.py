@@ -103,12 +103,20 @@ def rebuild_ec_files(base_file_name: str, rs: Optional[ReedSolomon] = None,
 
     inputs = {i: open(base_file_name + to_ext(i), "rb")
               for i in range(rs.total_shards) if has_data[i]}
-    outputs = {i: open(base_file_name + to_ext(i), "wb") for i in generated}
+    # validate survivors BEFORE creating outputs: an empty .ecNN left by a
+    # failed rebuild would count as "present" next time and mask the gap
     try:
         shard_size = os.fstat(next(iter(inputs.values())).fileno()).st_size
         for f in inputs.values():
             if os.fstat(f.fileno()).st_size != shard_size:
                 raise ValueError("ec shard size mismatch")
+    except BaseException:
+        for f in inputs.values():
+            f.close()
+        raise
+    outputs = {i: open(base_file_name + to_ext(i), "wb") for i in generated}
+    ok = False
+    try:
         offset = 0
         while offset < shard_size:
             n = min(chunk, shard_size - offset)
@@ -119,11 +127,18 @@ def rebuild_ec_files(base_file_name: str, rs: Optional[ReedSolomon] = None,
             for i in generated:
                 outputs[i].write(shards[i].tobytes())
             offset += n
+        ok = True
     finally:
         for f in inputs.values():
             f.close()
         for f in outputs.values():
             f.close()
+        if not ok:
+            for i in generated:  # no partial shards under the final names
+                try:
+                    os.remove(base_file_name + to_ext(i))
+                except OSError:
+                    pass
     return generated
 
 

@@ -230,6 +230,18 @@ class StreamingEncoder:
 
         inputs = {i: open(base_file_name + to_ext(i), "rb")
                   for i in survivors}
+        # validate survivors BEFORE creating any output file: an empty
+        # .ecNN left behind by a failed rebuild would count as "present"
+        # on the next call and mask the still-missing shard
+        try:
+            shard_size = os.fstat(inputs[survivors[0]].fileno()).st_size
+            for f in inputs.values():
+                if os.fstat(f.fileno()).st_size != shard_size:
+                    raise ValueError("ec shard size mismatch")
+        except BaseException:
+            for f in inputs.values():
+                f.close()
+            raise
         outputs = {m: open(base_file_name + to_ext(m), "wb")
                    for m in missing}
         bufs = [np.zeros((k, b), dtype=np.uint8)
@@ -244,11 +256,8 @@ class StreamingEncoder:
                 outputs[m].write(out[row_i, :n])
             free.append(bi)
 
+        ok = False
         try:
-            shard_size = os.fstat(inputs[survivors[0]].fileno()).st_size
-            for f in inputs.values():
-                if os.fstat(f.fileno()).st_size != shard_size:
-                    raise ValueError("ec shard size mismatch")
             for offset in range(0, shard_size, b):
                 n = min(b, shard_size - offset)
                 if not free:
@@ -264,9 +273,18 @@ class StreamingEncoder:
                     drain_one()
             while pending:
                 drain_one()
+            ok = True
         finally:
             for f in inputs.values():
                 f.close()
             for f in outputs.values():
                 f.close()
+            if not ok:
+                # partial outputs must not survive: the next rebuild would
+                # see them as present shards
+                for m in missing:
+                    try:
+                        os.remove(base_file_name + to_ext(m))
+                    except OSError:
+                        pass
         return missing

@@ -72,10 +72,14 @@ def resolve_one_chunk_manifest(fetch_fn: FetchFn,
     blob = fetch_fn(chunk)
     try:
         doc = json.loads(blob)
-    except ValueError as e:
+        # the extraction stays inside the guard: JSON-parsable garbage
+        # (bad decrypt, partial write) must surface as the diagnostic
+        # ValueError, not a bare KeyError — which the filer's NotFoundError
+        # subclasses, so it would misreport corruption as file-not-found
+        return [FileChunk.from_dict(d) for d in doc["chunks"]]
+    except (ValueError, KeyError, TypeError, AttributeError) as e:
         raise ValueError(
             f"unreadable chunk manifest {chunk.file_id}: {e}") from e
-    return [FileChunk.from_dict(d) for d in doc["chunks"]]
 
 
 def maybe_manifestize(save_fn: SaveFn, chunks: list[FileChunk],

@@ -83,6 +83,7 @@ class GroupCommitWorker:
         self.max_batch_requests = max_batch_requests
         self._q: queue.Queue[Optional[AsyncRequest]] = queue.Queue()
         self._stopped = False
+        self._submit_lock = threading.Lock()
         # observability (stats/metrics wiring reads these)
         self.request_count = 0
         self.batch_count = 0
@@ -101,19 +102,27 @@ class GroupCommitWorker:
         return self._submit(AsyncRequest(n, is_write=False))
 
     def _submit(self, req: AsyncRequest) -> AsyncRequest:
-        if self._stopped or not self._thread.is_alive():
-            req.fail(RuntimeError("group-commit worker stopped"))
-            return req
-        self._q.put(req)
+        # the check and the put must be one atomic step against stop():
+        # otherwise a request enqueued after the worker drained the
+        # sentinel is never read and its wait() blocks forever
+        with self._submit_lock:
+            if self._stopped or not self._thread.is_alive():
+                req.fail(RuntimeError("group-commit worker stopped"))
+                return req
+            self._q.put(req)
         return req
 
     def stop(self) -> None:
         """Drain outstanding requests, then stop the thread."""
-        if self._stopped:
-            return
-        self._stopped = True
-        self._q.put(None)
-        self._thread.join(timeout=30)
+        with self._submit_lock:
+            if self._stopped:
+                stopped_already = True
+            else:
+                stopped_already = False
+                self._stopped = True
+                self._q.put(None)
+        if not stopped_already:
+            self._thread.join(timeout=30)
 
     # --- worker side ------------------------------------------------------
     def _next_batch(self) -> tuple[list[AsyncRequest], bool]:
@@ -218,9 +227,24 @@ class GroupCommitWorker:
         nm = v.nm
         if nm is not None:
             nm.close()
+        # a checkpointed kind may have just snapshotted state that still
+        # contains the rolled-back puts; the snapshot must die with them
+        # or reopen resurrects entries pointing past the truncated .dat
+        snap = os.path.splitext(v.idx_path)[0] + ".ldb"
+        if os.path.exists(snap):
+            try:
+                os.remove(snap)
+            except OSError:
+                pass
         try:
             with open(v.idx_path, "r+b") as f:
                 f.truncate(idx_start)
         except OSError:
             pass
-        v.nm = MemoryNeedleMap.load(v.idx_path)
+        # reload with the volume's CONFIGURED kind, not a hardcoded one —
+        # silently switching a compact/ldb volume to the dict map would
+        # defeat the reason that kind was chosen
+        from .volume import _NEEDLE_MAP_KINDS
+
+        v.nm = _NEEDLE_MAP_KINDS.get(
+            v.needle_map_kind, MemoryNeedleMap).load(v.idx_path)

@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import gzip
 import io
+import zlib
 
 _COMPRESS_EXT = {
     ".svg", ".bmp", ".wav", ".pdf", ".txt", ".html", ".htm", ".css",
@@ -56,7 +57,10 @@ def maybe_decompress_data(data: bytes) -> bytes:
     if is_gzipped_content(data):
         try:
             return ungzip_data(data)
-        except OSError:
+        except (OSError, EOFError, zlib.error):
+            # gzip raises BadGzipFile (an OSError) for bad headers but
+            # EOFError for truncation and zlib.error for corrupt deflate
+            # bodies — all three mean "not really gzip, serve raw"
             return data
     return data
 

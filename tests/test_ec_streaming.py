@@ -134,3 +134,25 @@ def test_plan_entries_covers_file_exactly():
     assert seen == padded
     # rows tile the file: last row start + k*block >= size
     assert max(rs + k * b for rs, b in rows) >= size
+
+
+@pytest.mark.parametrize("make", [
+    lambda: StreamingEncoder(10, 4),
+    None,  # CPU path exercised via encoder.rebuild_ec_files
+])
+def test_failed_rebuild_leaves_no_empty_shards(tmp_path, make):
+    """A rebuild aborted by a survivor size mismatch must NOT leave
+    zero-length .ecNN files that mask the missing shards on retry."""
+    base = _write_dat(tmp_path, 50_000, name="fr")
+    encoder.write_ec_files(base, ReedSolomon(10, 4),
+                           large_block_size=10_000, small_block_size=100)
+    os.unlink(base + to_ext(2))
+    # corrupt a survivor's size so validation fails
+    with open(base + to_ext(5), "ab") as f:
+        f.write(b"extra")
+    with pytest.raises(ValueError, match="size mismatch"):
+        if make is None:
+            encoder.rebuild_ec_files(base, ReedSolomon(10, 4), chunk=512)
+        else:
+            make().rebuild_files(base)
+    assert not os.path.exists(base + to_ext(2))  # no empty ghost shard

@@ -221,3 +221,26 @@ def test_commit_compact_unparks_worker(tmp_path):
         assert v.read_needle(9).data == b"post"
     finally:
         v.close()
+
+
+def test_rollback_preserves_needle_map_kind(tmp_path):
+    """A sync-failure rollback must reload the volume's CONFIGURED map
+    kind (and kill any stale .ldb snapshot), not silently switch to the
+    dict map."""
+    from seaweedfs_tpu.storage.needle_map_compact import CheckpointedNeedleMap
+    from seaweedfs_tpu.utils import faultinject as fi
+
+    v = Volume(str(tmp_path), "", 11, needle_map_kind="ldb")
+    try:
+        v.write_needle2(Needle(cookie=1, id=1, data=b"ok"), fsync=True)
+        fi.enable("disk.sync", error_rate=1.0, max_hits=1)
+        with pytest.raises(Exception):
+            v.write_needle2(Needle(cookie=2, id=2, data=b"fails"),
+                            fsync=True)
+        assert isinstance(v.nm, CheckpointedNeedleMap), type(v.nm)
+        assert v.read_needle(1).data == b"ok"
+        with pytest.raises(KeyError):
+            v.read_needle(2)
+    finally:
+        fi.clear()
+        v.close()
