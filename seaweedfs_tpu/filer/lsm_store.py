@@ -303,3 +303,77 @@ class LsmStore:
             self._wal.close()
             for t in self._tables:
                 t.close()
+
+
+class NativeLsmStore:
+    """FilerStore over the C++ LSM engine (native/lsmkv.cpp) — the same
+    on-disk format as LsmStore (either engine opens the other's
+    directory), with the memtable/SSTable machinery in native code.  The
+    keyspace layout is identical; tombstone suppression happens inside
+    the engine."""
+
+    name = "lsm-native"
+
+    def __init__(self, directory: str, memtable_limit: int = 8192,
+                 compact_trigger: int = 8):
+        from ..native import NativeKv
+
+        self._kv = NativeKv(directory, memtable_limit, compact_trigger)
+
+    # --- entries ----------------------------------------------------------
+    def insert_entry(self, entry: Entry) -> None:
+        self._kv.put(_entry_key(entry.full_path),
+                     json.dumps(entry.to_dict()).encode())
+
+    update_entry = insert_entry
+
+    def find_entry(self, path: str) -> Optional[Entry]:
+        blob = self._kv.get(_entry_key(path))
+        return Entry.from_dict(json.loads(blob)) if blob else None
+
+    def delete_entry(self, path: str) -> None:
+        self._kv.delete(_entry_key(path))
+
+    def delete_folder_children(self, path: str) -> None:
+        base = path.rstrip("/") or "/"
+        doomed = [k for k, _ in self._kv.scan(_dir_prefix(base))]
+        doomed += [k for k, _ in self._kv.scan(b"E" + base.encode() + b"/")]
+        for k in doomed:
+            self._kv.delete(k)
+
+    def list_directory_entries(self, dir_path: str, start_file: str = "",
+                               include_start: bool = False, limit: int = 1000,
+                               prefix: str = "") -> Iterator[Entry]:
+        n = 0
+        for k, v in self._kv.scan(_dir_prefix(dir_path)):
+            if n >= limit:
+                return
+            name = k.rsplit(b"\x00", 1)[1].decode()
+            if prefix and not name.startswith(prefix):
+                continue
+            if start_file:
+                if name < start_file or (name == start_file
+                                         and not include_start):
+                    continue
+            yield Entry.from_dict(json.loads(v))
+            n += 1
+
+    # --- kv ---------------------------------------------------------------
+    def kv_put(self, key: bytes, value: bytes) -> None:
+        self._kv.put(b"K" + key, value)
+
+    def kv_get(self, key: bytes) -> Optional[bytes]:
+        return self._kv.get(b"K" + key)
+
+    def kv_delete(self, key: bytes) -> None:
+        self._kv.delete(b"K" + key)
+
+    def kv_scan(self, prefix: bytes):
+        for k, v in self._kv.scan(b"K" + prefix):
+            yield k[1:], v
+
+    def flush(self) -> None:
+        self._kv.flush()
+
+    def close(self) -> None:
+        self._kv.close()

@@ -61,6 +61,109 @@ def has_avx2() -> bool:
     return bool(lib and lib.gf_has_avx2())
 
 
+# --- native LSM KV (lsmkv.cpp) ----------------------------------------------
+
+_LSM_LIB_PATH = os.path.join(_DIR, "liblsmkv.so")
+_lsm_lib = None
+
+
+def load_lsm():
+    """Returns the lsmkv ctypes lib or None if unavailable."""
+    global _lsm_lib
+    if _lsm_lib is not None:
+        return _lsm_lib
+    src = os.path.join(_DIR, "lsmkv.cpp")
+    if not os.path.exists(_LSM_LIB_PATH) or (
+        os.path.exists(src)
+        and os.path.getmtime(src) > os.path.getmtime(_LSM_LIB_PATH)
+    ):
+        if not _build():
+            return None
+    try:
+        lib = ctypes.CDLL(_LSM_LIB_PATH)
+    except OSError:
+        return None
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    # inputs are c_char_p: Python bytes pass by pointer with NO copy
+    # (length travels separately, so embedded NULs are fine)
+    lib.lsm_open.argtypes = [ctypes.c_char_p, ctypes.c_int, ctypes.c_int]
+    lib.lsm_open.restype = ctypes.c_void_p
+    lib.lsm_close.argtypes = [ctypes.c_void_p]
+    lib.lsm_put.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int,
+                            ctypes.c_char_p, ctypes.c_long]
+    lib.lsm_delete.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int]
+    lib.lsm_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int,
+                            ctypes.POINTER(u8p)]
+    lib.lsm_get.restype = ctypes.c_long
+    lib.lsm_free.argtypes = [u8p]
+    lib.lsm_flush.argtypes = [ctypes.c_void_p]
+    lib.lsm_scan.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int]
+    lib.lsm_scan.restype = ctypes.c_void_p
+    lib.lsm_scan_next.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(u8p), ctypes.POINTER(ctypes.c_int),
+        ctypes.POINTER(u8p), ctypes.POINTER(ctypes.c_long)]
+    lib.lsm_scan_next.restype = ctypes.c_int
+    lib.lsm_scan_close.argtypes = [ctypes.c_void_p]
+    _lsm_lib = lib
+    return lib
+
+
+class NativeKv:
+    """Thin pythonic handle over the C++ LSM (byte-format compatible with
+    filer/lsm_store.py — the two engines open each other's directories)."""
+
+    def __init__(self, directory: str, memtable_limit: int = 8192,
+                 compact_trigger: int = 8):
+        lib = load_lsm()
+        if lib is None:
+            raise RuntimeError("native lsmkv library unavailable")
+        self._lib = lib
+        self._db = lib.lsm_open(directory.encode(), memtable_limit,
+                                compact_trigger)
+        if not self._db:
+            raise OSError(f"lsm_open failed for {directory!r}")
+
+    def put(self, key: bytes, value: bytes) -> None:
+        self._lib.lsm_put(self._db, key, len(key), value, len(value))
+
+    def get(self, key: bytes):
+        out = ctypes.POINTER(ctypes.c_uint8)()
+        n = self._lib.lsm_get(self._db, key, len(key), ctypes.byref(out))
+        if n < 0:
+            return None
+        try:
+            return ctypes.string_at(out, n)
+        finally:
+            self._lib.lsm_free(out)
+
+    def delete(self, key: bytes) -> None:
+        self._lib.lsm_delete(self._db, key, len(key))
+
+    def scan(self, prefix: bytes):
+        it = self._lib.lsm_scan(self._db, prefix, len(prefix))
+        try:
+            kp = ctypes.POINTER(ctypes.c_uint8)()
+            vp = ctypes.POINTER(ctypes.c_uint8)()
+            klen = ctypes.c_int()
+            vlen = ctypes.c_long()
+            while self._lib.lsm_scan_next(it, ctypes.byref(kp),
+                                          ctypes.byref(klen),
+                                          ctypes.byref(vp),
+                                          ctypes.byref(vlen)):
+                yield (ctypes.string_at(kp, klen.value),
+                       ctypes.string_at(vp, vlen.value))
+        finally:
+            self._lib.lsm_scan_close(it)
+
+    def flush(self) -> None:
+        self._lib.lsm_flush(self._db)
+
+    def close(self) -> None:
+        if self._db:
+            self._lib.lsm_close(self._db)
+            self._db = None
+
+
 def gf_matmul(mat: np.ndarray, data: np.ndarray) -> np.ndarray:
     """out[R, B] = mat[R, K] . data[K, B] over GF(2^8) via the native lib."""
     lib = load()

@@ -1,0 +1,357 @@
+// Native LSM KV engine — the C++ LevelDB-class store SURVEY §2.9 maps the
+// reference's goleveldb/rocksdb dependency onto.
+//
+// BYTE-FORMAT COMPATIBLE with the Python engine (filer/lsm_store.py): the
+// same WAL record framing (>II klen vlen | key | value), the same SSTable
+// layout ([values][index: >IQI klen voff vlen + key][footer: >Q index_off]),
+// the same 8-digit sequence filenames and tombstone sentinel — so a store
+// directory written by either engine opens under the other, and the two are
+// differential-tested against each other on identical directories.
+//
+// C ABI (ctypes consumer: seaweedfs_tpu/native/__init__.py):
+//   lsm_open/lsm_close, lsm_put/lsm_get/lsm_delete, lsm_scan*, lsm_flush
+// All operations are serialized by one mutex per DB; get/scan copy out.
+
+#include <algorithm>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <dirent.h>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <sys/stat.h>
+#include <vector>
+
+namespace {
+
+const std::string kTombstone = std::string("\x00__tombstone__", 14);
+
+uint32_t be32(const uint8_t* p) {
+  return (uint32_t(p[0]) << 24) | (uint32_t(p[1]) << 16) |
+         (uint32_t(p[2]) << 8) | p[3];
+}
+uint64_t be64(const uint8_t* p) {
+  return (uint64_t(be32(p)) << 32) | be32(p + 4);
+}
+void put32(std::string& out, uint32_t v) {
+  out.push_back(char(v >> 24)); out.push_back(char(v >> 16));
+  out.push_back(char(v >> 8)); out.push_back(char(v));
+}
+void put64(std::string& out, uint64_t v) {
+  put32(out, uint32_t(v >> 32)); put32(out, uint32_t(v));
+}
+
+struct SSTable {
+  std::string path;
+  FILE* f = nullptr;
+  std::vector<std::string> keys;
+  std::vector<std::pair<uint64_t, uint32_t>> offs;  // (value_off, value_len)
+
+  bool load(const std::string& p) {
+    path = p;
+    f = fopen(p.c_str(), "rb");
+    if (!f) return false;
+    fseek(f, 0, SEEK_END);
+    long size = ftell(f);
+    if (size < 8) return false;
+    fseek(f, size - 8, SEEK_SET);
+    uint8_t foot[8];
+    if (fread(foot, 1, 8, f) != 8) return false;
+    uint64_t index_off = be64(foot);
+    long index_len = size - 8 - long(index_off);
+    if (index_len < 0) return false;
+    std::vector<uint8_t> blob(index_len);
+    fseek(f, long(index_off), SEEK_SET);
+    if (index_len && fread(blob.data(), 1, index_len, f) != size_t(index_len))
+      return false;
+    size_t pos = 0;
+    while (pos + 16 <= blob.size()) {
+      uint32_t klen = be32(&blob[pos]);
+      uint64_t voff = be64(&blob[pos + 4]);
+      uint32_t vlen = be32(&blob[pos + 12]);
+      pos += 16;
+      if (pos + klen > blob.size()) break;
+      keys.emplace_back(reinterpret_cast<char*>(&blob[pos]), klen);
+      offs.emplace_back(voff, vlen);
+      pos += klen;
+    }
+    return true;
+  }
+
+  bool get(const std::string& key, std::string* out) const {
+    auto it = std::lower_bound(keys.begin(), keys.end(), key);
+    if (it == keys.end() || *it != key) return false;
+    size_t i = it - keys.begin();
+    out->resize(offs[i].second);
+    fseek(f, long(offs[i].first), SEEK_SET);
+    if (offs[i].second &&
+        fread(&(*out)[0], 1, offs[i].second, f) != offs[i].second)
+      return false;
+    return true;
+  }
+
+  void items(std::map<std::string, std::string>* into) const {
+    for (size_t i = 0; i < keys.size(); i++) {
+      std::string v;
+      get(keys[i], &v);
+      (*into)[keys[i]] = v;
+    }
+  }
+
+  ~SSTable() { if (f) fclose(f); }
+};
+
+struct DB {
+  std::mutex mu;
+  std::string dir;
+  int memtable_limit = 8192;
+  int compact_trigger = 8;
+  std::map<std::string, std::string> mem;
+  std::vector<std::unique_ptr<SSTable>> tables;  // oldest..newest
+  long seq = 0;
+  FILE* wal = nullptr;
+
+  std::string wal_path() const { return dir + "/wal.log"; }
+
+  void replay_wal() {
+    FILE* f = fopen(wal_path().c_str(), "rb");
+    if (!f) return;
+    for (;;) {
+      uint8_t hdr[8];
+      if (fread(hdr, 1, 8, f) != 8) break;
+      uint32_t klen = be32(hdr), vlen = be32(hdr + 4);
+      std::string k(klen, '\0'), v(vlen, '\0');
+      if (klen && fread(&k[0], 1, klen, f) != klen) break;  // torn tail
+      if (vlen && fread(&v[0], 1, vlen, f) != vlen) break;
+      mem[k] = v;
+    }
+    fclose(f);
+  }
+
+  bool open(const char* d, int mlimit, int ctrigger) {
+    dir = d;
+    memtable_limit = mlimit;
+    compact_trigger = ctrigger;
+    mkdir(d, 0755);
+    std::vector<std::string> names;
+    if (DIR* dp = opendir(d)) {
+      while (dirent* e = readdir(dp)) {
+        std::string n = e->d_name;
+        if (n.size() > 4 && n.substr(n.size() - 4) == ".sst")
+          names.push_back(n);
+      }
+      closedir(dp);
+    }
+    std::sort(names.begin(), names.end());
+    for (auto& n : names) {
+      auto t = std::make_unique<SSTable>();
+      if (t->load(dir + "/" + n)) {
+        long s = atol(n.substr(0, n.size() - 4).c_str());
+        if (s + 1 > seq) seq = s + 1;
+        tables.push_back(std::move(t));
+      }
+    }
+    replay_wal();
+    wal = fopen(wal_path().c_str(), "ab");
+    return wal != nullptr;
+  }
+
+  void wal_append(const std::string& k, const std::string& v) {
+    std::string rec;
+    put32(rec, uint32_t(k.size()));
+    put32(rec, uint32_t(v.size()));
+    rec += k;
+    rec += v;
+    fwrite(rec.data(), 1, rec.size(), wal);
+    fflush(wal);
+  }
+
+  void write_sst(const std::map<std::string, std::string>& items,
+                 const std::string& path) {
+    std::string tmp = path + ".tmp";
+    FILE* f = fopen(tmp.c_str(), "wb");
+    std::string index;
+    uint64_t off = 0;
+    for (auto& kv : items) {
+      fwrite(kv.second.data(), 1, kv.second.size(), f);
+      put32(index, uint32_t(kv.first.size()));
+      put64(index, off);
+      put32(index, uint32_t(kv.second.size()));
+      index += kv.first;
+      off += kv.second.size();
+    }
+    std::string foot;
+    put64(foot, off);
+    fwrite(index.data(), 1, index.size(), f);
+    fwrite(foot.data(), 1, foot.size(), f);
+    fflush(f);
+    fclose(f);
+    rename(tmp.c_str(), path.c_str());
+  }
+
+  std::string next_sst_path() {
+    char buf[32];
+    snprintf(buf, sizeof buf, "%08ld.sst", seq++);
+    return dir + "/" + buf;
+  }
+
+  void flush_memtable() {  // caller holds mu
+    if (mem.empty()) return;
+    std::string path = next_sst_path();
+    write_sst(mem, path);
+    auto t = std::make_unique<SSTable>();
+    t->load(path);
+    tables.push_back(std::move(t));
+    mem.clear();
+    fclose(wal);
+    wal = fopen(wal_path().c_str(), "wb");  // truncate
+    if (int(tables.size()) >= compact_trigger) compact();
+  }
+
+  void compact() {  // caller holds mu
+    std::map<std::string, std::string> merged;
+    for (auto& t : tables) t->items(&merged);  // oldest..newest: later wins
+    for (auto it = merged.begin(); it != merged.end();)
+      it = (it->second == kTombstone) ? merged.erase(it) : std::next(it);
+    std::string path = next_sst_path();
+    write_sst(merged, path);
+    for (auto& t : tables) {
+      std::string old = t->path;
+      t.reset();
+      remove(old.c_str());
+    }
+    tables.clear();
+    auto nt = std::make_unique<SSTable>();
+    nt->load(path);
+    tables.push_back(std::move(nt));
+  }
+
+  void put(const std::string& k, const std::string& v) {
+    std::lock_guard<std::mutex> g(mu);
+    wal_append(k, v);
+    mem[k] = v;
+    if (int(mem.size()) >= memtable_limit) flush_memtable();
+  }
+
+  bool get(const std::string& k, std::string* out) {
+    std::lock_guard<std::mutex> g(mu);
+    auto it = mem.find(k);
+    if (it != mem.end()) {
+      if (it->second == kTombstone) return false;
+      *out = it->second;
+      return true;
+    }
+    for (auto t = tables.rbegin(); t != tables.rend(); ++t) {  // newest first
+      if ((*t)->get(k, out)) return *out != kTombstone;
+    }
+    return false;
+  }
+
+  void scan(const std::string& prefix,
+            std::vector<std::pair<std::string, std::string>>* out) {
+    std::lock_guard<std::mutex> g(mu);
+    std::map<std::string, std::string> merged;
+    for (auto& t : tables) {
+      auto it = std::lower_bound(t->keys.begin(), t->keys.end(), prefix);
+      for (size_t i = it - t->keys.begin(); i < t->keys.size(); i++) {
+        if (t->keys[i].compare(0, prefix.size(), prefix) != 0) break;
+        std::string v;
+        t->get(t->keys[i], &v);
+        merged[t->keys[i]] = v;
+      }
+    }
+    for (auto it = mem.lower_bound(prefix); it != mem.end(); ++it) {
+      if (it->first.compare(0, prefix.size(), prefix) != 0) break;
+      merged[it->first] = it->second;
+    }
+    for (auto& kv : merged)
+      if (kv.second != kTombstone) out->push_back(kv);
+  }
+
+  void close() {
+    std::lock_guard<std::mutex> g(mu);
+    flush_memtable();
+    if (wal) { fclose(wal); wal = nullptr; }
+    tables.clear();
+  }
+};
+
+struct ScanIter {
+  std::vector<std::pair<std::string, std::string>> items;
+  size_t pos = 0;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* lsm_open(const char* dir, int memtable_limit, int compact_trigger) {
+  auto* db = new DB();
+  if (!db->open(dir, memtable_limit, compact_trigger)) {
+    delete db;
+    return nullptr;
+  }
+  return db;
+}
+
+void lsm_close(void* h) {
+  auto* db = static_cast<DB*>(h);
+  db->close();
+  delete db;
+}
+
+void lsm_put(void* h, const uint8_t* k, int klen, const uint8_t* v,
+             long vlen) {
+  static_cast<DB*>(h)->put(
+      std::string(reinterpret_cast<const char*>(k), klen),
+      std::string(reinterpret_cast<const char*>(v), vlen));
+}
+
+void lsm_delete(void* h, const uint8_t* k, int klen) {
+  static_cast<DB*>(h)->put(
+      std::string(reinterpret_cast<const char*>(k), klen), kTombstone);
+}
+
+// returns value length, or -1 when absent; *out is malloc'd (lsm_free)
+long lsm_get(void* h, const uint8_t* k, int klen, uint8_t** out) {
+  std::string v;
+  if (!static_cast<DB*>(h)->get(
+          std::string(reinterpret_cast<const char*>(k), klen), &v))
+    return -1;
+  *out = static_cast<uint8_t*>(malloc(v.size() ? v.size() : 1));
+  memcpy(*out, v.data(), v.size());
+  return long(v.size());
+}
+
+void lsm_free(uint8_t* p) { free(p); }
+
+void lsm_flush(void* h) {
+  auto* db = static_cast<DB*>(h);
+  std::lock_guard<std::mutex> g(db->mu);
+  db->flush_memtable();
+}
+
+void* lsm_scan(void* h, const uint8_t* prefix, int plen) {
+  auto* it = new ScanIter();
+  static_cast<DB*>(h)->scan(
+      std::string(reinterpret_cast<const char*>(prefix), plen), &it->items);
+  return it;
+}
+
+int lsm_scan_next(void* hi, const uint8_t** k, int* klen, const uint8_t** v,
+                  long* vlen) {
+  auto* it = static_cast<ScanIter*>(hi);
+  if (it->pos >= it->items.size()) return 0;
+  auto& kv = it->items[it->pos++];
+  *k = reinterpret_cast<const uint8_t*>(kv.first.data());
+  *klen = int(kv.first.size());
+  *v = reinterpret_cast<const uint8_t*>(kv.second.data());
+  *vlen = long(kv.second.size());
+  return 1;
+}
+
+void lsm_scan_close(void* hi) { delete static_cast<ScanIter*>(hi); }
+
+}  // extern "C"

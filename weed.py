@@ -67,9 +67,16 @@ def cmd_filer(args) -> None:
     from seaweedfs_tpu.security.config import filer_guard
 
     if args.db and args.db.endswith(".lsm"):
-        from seaweedfs_tpu.filer.lsm_store import LsmStore
+        # prefer the native C++ engine; the Python engine shares the
+        # on-disk format, so falling back never strands a directory
+        try:
+            from seaweedfs_tpu.filer.lsm_store import NativeLsmStore
 
-        store = LsmStore(args.db)
+            store = NativeLsmStore(args.db)
+        except (RuntimeError, OSError):
+            from seaweedfs_tpu.filer.lsm_store import LsmStore
+
+            store = LsmStore(args.db)
     else:
         store = SqliteStore(args.db) if args.db else None
     f = FilerServer(args.master, store, host=args.ip, port=args.port,
