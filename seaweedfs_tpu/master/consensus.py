@@ -89,7 +89,22 @@ class RaftNode:
         return (len(self.peers) + 1) // 2 + 1
 
     # --- RPC handlers (the /raft/* routes call these) ---------------------
-    def handle_vote(self, term: int, candidate: str) -> dict:
+    def _candidate_up_to_date(self, candidate_state: Optional[dict]) -> bool:
+        """Raft's election restriction, adapted to the monotonic-counter
+        state machine: a vote goes only to candidates whose state is at
+        least as advanced as ours — otherwise a node that missed a
+        quorum-committed max_volume_id could win and re-issue ids."""
+        if candidate_state is None:
+            return True  # pre-upgrade peer: preserve liveness
+        mine = self.read_state()
+        for key, value in mine.items():
+            if isinstance(value, (int, float)) and \
+                    candidate_state.get(key, 0) < value:
+                return False
+        return True
+
+    def handle_vote(self, term: int, candidate: str,
+                    candidate_state: Optional[dict] = None) -> dict:
         with self.lock:
             if term < self.term:
                 return {"term": self.term, "granted": False}
@@ -97,7 +112,8 @@ class RaftNode:
                 self.term = term
                 self.voted_for = None
                 self._become_follower(None)
-            granted = self.voted_for in (None, candidate)
+            granted = self.voted_for in (None, candidate) \
+                and self._candidate_up_to_date(candidate_state)
             if granted:
                 self.voted_for = candidate
                 self._last_heard = time.time()
@@ -146,14 +162,32 @@ class RaftNode:
         self.persist()
 
     def _run(self) -> None:
+        hb_misses = 0
         while not self._stop.is_set():
             with self.lock:
                 role = self.role
                 overdue = time.time() - self._last_heard > self._timeout
             if role == "leader":
-                self._broadcast_append()
-                self._stop.wait(HEARTBEAT_INTERVAL)
+                # short RPC/join budget + elapsed-aware sleep: the worst-
+                # case heartbeat PERIOD must stay well under the minimum
+                # election timeout, or a single dead peer makes healthy
+                # followers campaign (the flapping this loop exists to
+                # prevent)
+                t0 = time.monotonic()
+                acked = self._broadcast_append(rpc_timeout=0.3,
+                                               join_timeout=0.45)
+                if self.is_leader:
+                    # quorum loss steps down only after consecutive
+                    # misses: one slow join must not depose a healthy
+                    # leader (commit_state stays strict)
+                    hb_misses = hb_misses + 1 if acked < self.quorum() else 0
+                    if hb_misses >= 3:
+                        hb_misses = 0
+                        self._step_down()
+                elapsed = time.monotonic() - t0
+                self._stop.wait(max(0.05, HEARTBEAT_INTERVAL - elapsed))
             elif overdue:
+                hb_misses = 0
                 self._campaign()
             else:
                 self._stop.wait(0.05)
@@ -166,15 +200,29 @@ class RaftNode:
             self.voted_for = self.me
             self._last_heard = time.time()
             self._timeout = random.uniform(*ELECTION_TIMEOUT)
+            my_state = self.read_state()
             self.persist()
-        votes = 1
-        for p in self.peers:
+        results: list[dict] = []
+
+        def ask(p: str) -> None:
             try:
-                r = http_json("POST", f"http://{p}/raft/vote",
-                              {"term": term, "candidate": self.me},
-                              timeout=1.0)
+                results.append(http_json(
+                    "POST", f"http://{p}/raft/vote",
+                    {"term": term, "candidate": self.me,
+                     "state": my_state}, timeout=1.0))
             except Exception:
-                continue
+                pass
+
+        # parallel like _broadcast_append: serial 1s timeouts to dead
+        # peers would outlast the election timeout and churn terms
+        threads = [threading.Thread(target=ask, args=(p,), daemon=True)
+                   for p in self.peers]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(1.2)
+        votes = 1
+        for r in results:
             with self.lock:
                 if r.get("term", 0) > self.term:
                     self.term = r["term"]
@@ -204,9 +252,26 @@ class RaftNode:
             return True
         if not self.is_leader:
             return False
-        return self._broadcast_append() >= self.quorum()
+        acked = self._broadcast_append()
+        if acked < self.quorum():
+            # a leader that cannot reach a quorum for a COMMIT is
+            # partitioned: step down immediately so clients fail over
+            # instead of writing to a stale master
+            self._step_down()
+        return acked >= self.quorum()
 
-    def _broadcast_append(self) -> int:
+    def _step_down(self) -> None:
+        changed = False
+        with self.lock:
+            if self.role == "leader":
+                self._last_heard = time.time()
+                self.role = "follower"
+                changed = True
+        if changed:
+            self._notify_role()
+
+    def _broadcast_append(self, rpc_timeout: float = 1.0,
+                          join_timeout: float = 1.5) -> int:
         with self.lock:
             term = self.term
             state = self.read_state()
@@ -217,7 +282,7 @@ class RaftNode:
                 results.append(http_json(
                     "POST", f"http://{p}/raft/append",
                     {"term": term, "leader": self.me, "state": state},
-                    timeout=1.0))
+                    timeout=rpc_timeout))
             except Exception:
                 pass
 
@@ -228,7 +293,7 @@ class RaftNode:
         for t in threads:
             t.start()
         for t in threads:
-            t.join(1.5)
+            t.join(join_timeout)
         acked = 1
         for r in results:
             with self.lock:
@@ -239,12 +304,4 @@ class RaftNode:
                     return 0
             if r.get("ok"):
                 acked += 1
-        # a leader partitioned from the quorum steps down so clients
-        # fail over instead of writing to a stale master
-        if self.peers and acked < self.quorum():
-            with self.lock:
-                if self.role == "leader":
-                    self._last_heard = time.time()
-                    self.role = "follower"
-            self._notify_role()
         return acked

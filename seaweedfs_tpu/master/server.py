@@ -277,7 +277,8 @@ class MasterServer:
         def raft_vote(req: Request) -> Response:
             b = req.json()
             return Response(self.raft.handle_vote(int(b["term"]),
-                                                  b["candidate"]))
+                                                  b["candidate"],
+                                                  b.get("state")))
 
         @r.route("POST", "/raft/append")
         def raft_append(req: Request) -> Response:

@@ -49,6 +49,10 @@ class Partition:
         self.cond = threading.Condition(self.lock)
         self.messages: list[dict] = []  # full in-memory history
         self.flushed_upto = 0
+        # serializes whole flushes (timer + publish-triggered): two
+        # interleaved flushes with rollback-on-failure could persist
+        # OVERLAPPING segments, duplicating messages on replay
+        self.flush_lock = threading.Lock()
         self._flush_fn = flush_fn
 
     def publish(self, msg: dict) -> int:
@@ -100,19 +104,20 @@ class TopicManager:
     def flush_partition(self, ns: str, topic: str, p: int) -> int:
         """Persist the unflushed tail as one segment file."""
         part = self.partition(ns, topic, p)
-        with part.lock:
-            tail = part.messages[part.flushed_upto:]
-            start = part.flushed_upto
-            if not tail:
-                return 0
-            part.flushed_upto = len(part.messages)
-        try:
-            self._persist(ns, topic, p, start, tail)
-        except Exception:
-            with part.lock:  # roll back so a later flush retries
-                part.flushed_upto = min(part.flushed_upto, start)
-            raise
-        return len(tail)
+        with part.flush_lock:  # one flush in flight per partition
+            with part.lock:
+                tail = part.messages[part.flushed_upto:]
+                start = part.flushed_upto
+                if not tail:
+                    return 0
+                part.flushed_upto = len(part.messages)
+            try:
+                self._persist(ns, topic, p, start, tail)
+            except Exception:
+                with part.lock:  # roll back so a later flush retries
+                    part.flushed_upto = min(part.flushed_upto, start)
+                raise
+            return len(tail)
 
     def flush_all(self) -> None:
         for key in self.topics():
@@ -194,8 +199,14 @@ class BrokerServer:
             status, body, _ = http_bytes(
                 "GET", f"http://{self.filer_url}"
                 f"{self._segment_dir(ns, topic, p)}{q}")
+            if status == 404:
+                return sorted(names)  # no history yet
             if status != 200:
-                return names
+                # a partial listing would replay truncated/unsorted
+                # history, renumber offsets, and let later flushes
+                # overwrite surviving segments — abort the load instead
+                raise HttpError(status,
+                                f"segment listing failed: {body[:200]!r}")
             d = json.loads(body)
             names.extend(e["FullPath"] for e in d.get("Entries", [])
                          if e["FullPath"].endswith(".seg"))
@@ -236,7 +247,10 @@ class BrokerServer:
                 s, blob, _ = http_bytes("GET",
                                         f"http://{self.filer_url}{seg}")
                 if s != 200:
-                    continue
+                    # skipping would shift every later offset and let a
+                    # future flush OVERWRITE this segment; fail the load
+                    # (the next touch retries) rather than lose data
+                    raise HttpError(s, f"segment read {seg} failed")
                 for line in blob.decode().splitlines():
                     if line.strip():
                         replayed.append(json.loads(line))
@@ -298,11 +312,13 @@ class BrokerServer:
                                 f"[0, {self.partition_count})")
             owner = self._owner(ns, topic, p)
             if owner != self.url:
+                import urllib.parse
+
+                q = urllib.parse.urlencode({
+                    "namespace": ns, "topic": topic, "partition": p,
+                    "offset": offset, "timeout": timeout})
                 return Response({"owner": owner}, status=307, headers={
-                    "Location": f"http://{owner}/subscribe?"
-                                f"namespace={ns}&topic={topic}"
-                                f"&partition={p}&offset={offset}"
-                                f"&timeout={timeout}"})
+                    "Location": f"http://{owner}/subscribe?{q}"})
             part = self._maybe_load(ns, topic, p)
             msgs = part.read(offset, timeout=timeout)
             next_offset = msgs[-1]["offset"] + 1 if msgs else offset
@@ -352,9 +368,12 @@ class MessagingClient:
     def subscribe(self, topic: str, partition: int = 0, offset: int = 0,
                   namespace: str = "default",
                   timeout: float = 0.0) -> tuple[list[dict], int]:
-        url = (f"http://{self.broker_url}/subscribe?namespace={namespace}"
-               f"&topic={topic}&partition={partition}&offset={offset}"
-               f"&timeout={timeout}")
+        import urllib.parse
+
+        q = urllib.parse.urlencode({
+            "namespace": namespace, "topic": topic, "partition": partition,
+            "offset": offset, "timeout": timeout})
+        url = f"http://{self.broker_url}/subscribe?{q}"
         for _ in range(3):
             status, body, hdrs = http_bytes("GET", url,
                                             follow_redirects=False)

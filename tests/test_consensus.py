@@ -164,3 +164,27 @@ def test_grow_fails_closed_when_quorum_commit_fails(tmp_path):
     finally:
         vs.stop()
         m.stop()
+
+
+def test_vote_denied_to_stale_candidate():
+    """Election restriction: a node that missed a quorum-committed
+    max_volume_id must not win an election (it would re-issue the id)."""
+    from seaweedfs_tpu.master.consensus import RaftNode
+
+    state = {"max_volume_id": 5, "max_file_key": 100}
+    voter = RaftNode("127.0.0.1:1", ["127.0.0.1:2"],
+                     read_state=lambda: dict(state))
+    # candidate behind on max_volume_id: denied
+    r = voter.handle_vote(7, "127.0.0.1:2",
+                          {"max_volume_id": 4, "max_file_key": 100})
+    assert r["granted"] is False
+    # term advanced anyway (raft semantics)
+    assert voter.term == 7
+    # up-to-date candidate: granted
+    r = voter.handle_vote(8, "127.0.0.1:2",
+                          {"max_volume_id": 5, "max_file_key": 100})
+    assert r["granted"] is True
+    # pre-upgrade candidate without state: liveness preserved
+    voter2 = RaftNode("127.0.0.1:3", ["127.0.0.1:4"],
+                      read_state=lambda: dict(state))
+    assert voter2.handle_vote(3, "127.0.0.1:4")["granted"] is True
