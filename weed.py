@@ -20,6 +20,18 @@ def _security():
     return load_security_configuration()
 
 
+def _maybe_push_metrics(args) -> None:
+    """-metricsPushUrl: push the Prometheus exposition to a pushgateway
+    on an interval (stats/metrics.go push mode)."""
+    url = getattr(args, "metricsPushUrl", "")
+    if url:
+        from seaweedfs_tpu.stats.metrics import start_push_loop
+
+        start_push_loop(url.rstrip("/"), job=args.cmd,
+                        interval_seconds=getattr(args, "metricsPushSeconds",
+                                                 15.0))
+
+
 def _cluster_tls():
     """security.toml [tls] -> server ssl context (also installs the
     process-wide mTLS client side); None when TLS is not configured."""
@@ -700,6 +712,9 @@ def main(argv=None) -> None:
                    help="glog verbosity level")
     p.add_argument("-cpuprofile", default="", help="write CPU profile here")
     p.add_argument("-memprofile", default="", help="write memory profile here")
+    p.add_argument("-metricsPushUrl", default="",
+                   help="prometheus pushgateway base url (push mode)")
+    p.add_argument("-metricsPushSeconds", type=float, default=15.0)
     sub = p.add_subparsers(dest="cmd", required=True)
 
     m = sub.add_parser("master")
@@ -943,6 +958,7 @@ def main(argv=None) -> None:
     glog.init(args.v)
     if args.cpuprofile or args.memprofile:
         grace.setup_profiling(args.cpuprofile, args.memprofile)
+    _maybe_push_metrics(args)
     args.fn(args)
 
 
