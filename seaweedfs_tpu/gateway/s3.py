@@ -340,6 +340,10 @@ class S3ApiServer:
                        req.match.group(2))
             bucket, key = req.match.group(1), req.match.group(2)
             self._require_bucket(bucket)
+            if "tagging" in req.query:
+                return self._put_tagging(req, bucket, key)
+            if "acl" in req.query:
+                return Response(raw=b"")  # accepted, canned (ref stubs too)
             if "partNumber" in req.query and "uploadId" in req.query:
                 return self._upload_part(req, bucket, key)
             copy_source = req.headers.get("X-Amz-Copy-Source", "")
@@ -363,6 +367,23 @@ class S3ApiServer:
             bucket, key = req.match.group(1), req.match.group(2)
             if "uploadId" in req.query and req.handler.command == "GET":
                 return self._list_parts(req, bucket, key)
+            if "tagging" in req.query:
+                return self._get_tagging(bucket, key)
+            if "acl" in req.query:
+                # canned ACL (the reference's ACL handlers are stubs too):
+                # SDKs call this during sync/cp; FULL_CONTROL for the owner
+                root = ET.Element("AccessControlPolicy", xmlns=S3_NS)
+                owner = ET.SubElement(root, "Owner")
+                ET.SubElement(owner, "ID").text = "seaweedfs-tpu"
+                acl = ET.SubElement(root, "AccessControlList")
+                grant = ET.SubElement(acl, "Grant")
+                grantee = ET.SubElement(grant, "Grantee")
+                grantee.set("xmlns:xsi",
+                            "http://www.w3.org/2001/XMLSchema-instance")
+                grantee.set("xsi:type", "CanonicalUser")
+                ET.SubElement(grantee, "ID").text = "seaweedfs-tpu"
+                ET.SubElement(grant, "Permission").text = "FULL_CONTROL"
+                return _xml(root)
             try:
                 entry = self.fs.filer.find_entry(self._object_path(bucket, key))
             except FilerNotFound:
@@ -407,6 +428,8 @@ class S3ApiServer:
             self._auth(req, ACTION_WRITE, req.match.group(1),
                        req.match.group(2))
             bucket, key = req.match.group(1), req.match.group(2)
+            if "tagging" in req.query:
+                return self._delete_tagging(bucket, key)
             if "uploadId" in req.query:
                 return self._abort_multipart(req, bucket, key)
             try:
@@ -555,6 +578,58 @@ class S3ApiServer:
             ET.SubElement(u, "UploadId").text = d.name
             ET.SubElement(u, "Initiated").text = _iso(meta.attr.crtime)
         return _xml(root)
+
+    # --- tagging (s3api_object_tagging_handlers.go) -------------------------
+    TAG_PREFIX = "x-amz-tag-"
+
+    def _tag_entry(self, bucket: str, key: str) -> Entry:
+        try:
+            entry = self.fs.filer.find_entry(self._object_path(bucket, key))
+        except FilerNotFound:
+            raise HttpError(404, "NoSuchKey")
+        if entry.is_directory:
+            raise HttpError(404, "NoSuchKey")
+        return entry
+
+    def _put_tagging(self, req: Request, bucket: str, key: str) -> Response:
+        entry = self._tag_entry(bucket, key)
+        try:
+            doc = ET.fromstring(req.body)
+        except ET.ParseError:
+            return _err(400, "MalformedXML", "cannot parse Tagging body")
+        tags = {}
+        for t in doc.iter():
+            if t.tag.endswith("Tag"):
+                k = t.findtext("{*}Key") or t.findtext("Key") or ""
+                v = t.findtext("{*}Value") or t.findtext("Value") or ""
+                if k:
+                    tags[k] = v
+        if len(tags) > 10:
+            return _err(400, "BadRequest", "at most 10 tags per object")
+        entry.extended = {k: v for k, v in entry.extended.items()
+                         if not k.startswith(self.TAG_PREFIX)}
+        for k, v in tags.items():
+            entry.extended[self.TAG_PREFIX + k] = v
+        self.fs.filer.update_entry(entry)
+        return Response(raw=b"")
+
+    def _get_tagging(self, bucket: str, key: str) -> Response:
+        entry = self._tag_entry(bucket, key)
+        root = ET.Element("Tagging", xmlns=S3_NS)
+        ts = ET.SubElement(root, "TagSet")
+        for k, v in sorted(entry.extended.items()):
+            if k.startswith(self.TAG_PREFIX):
+                t = ET.SubElement(ts, "Tag")
+                ET.SubElement(t, "Key").text = k[len(self.TAG_PREFIX):]
+                ET.SubElement(t, "Value").text = v
+        return _xml(root)
+
+    def _delete_tagging(self, bucket: str, key: str) -> Response:
+        entry = self._tag_entry(bucket, key)
+        entry.extended = {k: v for k, v in entry.extended.items()
+                         if not k.startswith(self.TAG_PREFIX)}
+        self.fs.filer.update_entry(entry)
+        return Response(raw=b"", status=204)
 
     def _list_parts(self, req: Request, bucket: str, key: str) -> Response:
         """ListParts (s3api_object_multipart_handlers.go): uploaded parts

@@ -329,6 +329,43 @@ def test_copy_object(s3):
     assert h.get("x-amz-meta-color") is None
 
 
+# --- tagging + acl ----------------------------------------------------------
+
+def test_object_tagging_roundtrip(s3):
+    _req(s3, "PUT", "/tagb")
+    _req(s3, "PUT", "/tagb/obj", b"tagged")
+    body = (b"<Tagging><TagSet>"
+            b"<Tag><Key>env</Key><Value>prod</Value></Tag>"
+            b"<Tag><Key>team</Key><Value>storage</Value></Tag>"
+            b"</TagSet></Tagging>")
+    st, _, _ = _req(s3, "PUT", "/tagb/obj?tagging=", body)
+    assert st == 200
+    st, resp, _ = _req(s3, "GET", "/tagb/obj?tagging=")
+    assert st == 200
+    tags = {t.findtext(f"{NS}Key"): t.findtext(f"{NS}Value")
+            for t in _xml(resp).iter(f"{NS}Tag")}
+    assert tags == {"env": "prod", "team": "storage"}
+    # object data untouched by tagging ops
+    st, data, _ = _req(s3, "GET", "/tagb/obj")
+    assert data == b"tagged"
+    st, _, _ = _req(s3, "DELETE", "/tagb/obj?tagging=")
+    assert st == 204
+    st, resp, _ = _req(s3, "GET", "/tagb/obj?tagging=")
+    assert not list(_xml(resp).iter(f"{NS}Tag"))
+    # tagging a missing key is NoSuchKey
+    st, resp, _ = _req(s3, "PUT", "/tagb/ghost?tagging=", body)
+    assert st == 404 and b"NoSuchKey" in resp
+
+
+def test_object_acl_canned(s3):
+    _req(s3, "PUT", "/aclb")
+    _req(s3, "PUT", "/aclb/obj", b"x")
+    st, resp, _ = _req(s3, "GET", "/aclb/obj?acl=")
+    assert st == 200 and b"FULL_CONTROL" in resp
+    st, _, _ = _req(s3, "PUT", "/aclb/obj?acl=", b"")
+    assert st == 200
+
+
 # --- auth behaviors ---------------------------------------------------------
 
 def test_anonymous_denied_when_iam_enabled(s3):
