@@ -151,14 +151,28 @@ class WeedClient:
             if gz is not data:
                 data = gz
                 headers["Content-Encoding"] = "gzip"
-        if a.auth:
-            headers["Authorization"] = f"BEARER {a.auth}"
-        status, body, _ = http_bytes(
-            "POST", f"http://{a.url}/{a.fid}{q}", data,
-            headers=headers or None)
-        if status not in (200, 201):
-            raise HttpError(status, body.decode(errors="replace"))
-        return a.fid
+        last_err = None
+        for _ in range(3):
+            hdrs = dict(headers)
+            if a.auth:
+                hdrs["Authorization"] = f"BEARER {a.auth}"
+            status, body, _ = http_bytes(
+                "POST", f"http://{a.url}/{a.fid}{q}", data,
+                headers=hdrs or None)
+            if status in (200, 201):
+                return a.fid
+            last_err = HttpError(status, body.decode(errors="replace"))
+            if status == 409 or b"read only" in body:
+                # the volume went readonly (operator fence, ec.encode,
+                # tiering) between assign and write: a FRESH assignment
+                # routes to a writable volume (brief wait: the readonly
+                # delta reaches the master within one heartbeat pulse)
+                time.sleep(0.15)
+                a = self.master.assign(collection=collection,
+                                       replication=replication, ttl=ttl)
+                continue
+            break
+        raise last_err
 
     def upload_tcp(self, data: bytes, collection: str = "",
                    replication: str = "", ttl: str = "") -> str:
@@ -189,19 +203,29 @@ class WeedClient:
         if a is None:
             a = self.master.assign(collection=collection,
                                    replication=replication, ttl=ttl)
-        try:
-            self._tcp.write(tcp_address(a.url), a.fid, data)
-        except (ConnectionError, OSError):
-            # TCP plane closed on this server (secured cluster, port
-            # collision): the assignment is still valid — finish the
-            # write over HTTP, which can carry the JWT
-            headers = {"Authorization": f"BEARER {a.auth}"} if a.auth \
-                else None
-            status, body, _ = http_bytes(
-                "POST", f"http://{a.url}/{a.fid}", data, headers=headers)
-            if status not in (200, 201):
-                raise HttpError(status, body.decode(errors="replace"))
-        return a.fid
+        for attempt in range(3):
+            try:
+                self._tcp.write(tcp_address(a.url), a.fid, data)
+                return a.fid
+            except (ConnectionError, OSError) as e:
+                if "read only" in str(e) and attempt < 2:
+                    # volume fenced between assign and write: re-assign
+                    # after the readonly delta reaches the master
+                    time.sleep(0.15)
+                    a = self.master.assign(collection=collection,
+                                           replication=replication, ttl=ttl)
+                    continue
+                # TCP plane closed on this server (secured cluster, port
+                # collision): the assignment is still valid — finish the
+                # write over HTTP, which can carry the JWT
+                headers = {"Authorization": f"BEARER {a.auth}"} if a.auth \
+                    else None
+                status, body, _ = http_bytes(
+                    "POST", f"http://{a.url}/{a.fid}", data, headers=headers)
+                if status not in (200, 201):
+                    raise HttpError(status, body.decode(errors="replace"))
+                return a.fid
+        return a.fid  # pragma: no cover
 
     def download_tcp(self, fid: str) -> bytes:
         from ..volume_server.tcp import TcpVolumeClient, tcp_address
@@ -209,7 +233,7 @@ class WeedClient:
         if self._tcp is None:
             self._tcp = TcpVolumeClient()
         vid = int(fid.split(",")[0])
-        urls, _ = self._locate(vid)
+        urls, _ = self._locate_retry(vid)
         if not urls:
             raise HttpError(404, f"volume {vid} has no locations")
         return self._tcp.read(tcp_address(urls[0]), fid)
@@ -234,10 +258,28 @@ class WeedClient:
             ok=(200, 206))
         return body
 
+    def _locate_retry(self, vid: int) -> tuple[list[str], str]:
+        """_locate, riding out transient unregistration: a starved
+        heartbeat can drop the node from the master for a pulse; the next
+        pulse re-registers it — wait it out rather than failing an
+        operation on a volume that exists."""
+        for attempt in range(3):
+            try:
+                urls, auth = self._locate(vid)
+            except HttpError as e:
+                if e.status != 404 or attempt == 2:
+                    raise
+                urls, auth = [], ""
+            if urls:
+                return urls, auth
+            time.sleep(0.3)
+            self.master.invalidate(vid)
+        return [], ""
+
     def _get(self, fid: str, extra_headers: Optional[dict],
              ok: tuple = (200,)) -> tuple[bytes, dict]:
         vid = int(fid.split(",")[0])
-        urls, auth = self._locate(vid)
+        urls, auth = self._locate_retry(vid)
         if not urls:
             raise HttpError(404, f"volume {vid} has no locations")
         headers = dict(extra_headers or {})
@@ -257,7 +299,17 @@ class WeedClient:
         raise last_err or HttpError(404, "not found")
 
     def delete(self, fid: str) -> None:
-        urls, _, write_auth = self.master.lookup_file(fid)
+        urls: list = []
+        write_auth = ""
+        for attempt in range(3):
+            try:
+                urls, _, write_auth = self.master.lookup_file(fid)
+            except HttpError as e:
+                if e.status != 404 or attempt == 2:
+                    raise
+            if urls:
+                break
+            time.sleep(0.3)  # transient unregistration: next pulse heals
         headers = ({"Authorization": f"BEARER {write_auth}"}
                    if write_auth else None)
         for url in urls:

@@ -499,7 +499,11 @@ class Topology:
                 out.extend(rack.nodes.values())
         return out
 
-    def dead_nodes(self, timeout_factor: float = 5.0) -> list[DataNode]:
+    def dead_nodes(self, timeout_factor: float = 10.0) -> list[DataNode]:
+        """The reference unregisters on gRPC stream break, not a timer;
+        this poll-based analog must tolerate heartbeat threads starved by
+        load, so the cutoff errs long — a dead node's volumes fail fast at
+        the data plane anyway and clients fail over by replica."""
         cutoff = time.time() - self.pulse_seconds * timeout_factor
         return [n for n in self.all_nodes() if n.last_seen < cutoff]
 

@@ -12,12 +12,19 @@ which amortizes one fsync across <=4MB/<=128 queued requests.
 from __future__ import annotations
 
 import os
+import struct
 import threading
 import time
 from typing import Callable, Optional
 
 from .backend import DiskFile, RemoteFile, get_backend
-from .needle import Needle, get_actual_size, needle_body_length
+from .needle import (
+    CRCError,
+    Needle,
+    SizeMismatchError,
+    get_actual_size,
+    needle_body_length,
+)
 from .needle_map import MemoryNeedleMap, NeedleValue
 from .needle_map_compact import (
     CheckpointedNeedleMap,
@@ -400,6 +407,14 @@ class Volume:
                 if time.monotonic() >= deadline:
                     raise
                 time.sleep(0.01)
+            except OSError as e:
+                # EBADF: we raced the close itself — the handle died under
+                # the pread; same swap window, same retry
+                import errno
+
+                if e.errno != errno.EBADF or time.monotonic() >= deadline:
+                    raise
+                time.sleep(0.01)
 
     def _read_needle_at(self, offset: int, size: int) -> Needle:
         blob = self._read_at(offset, get_actual_size(size, self.version))
@@ -412,24 +427,46 @@ class Volume:
 
     def read_needle(self, key: int, cookie: Optional[int] = None,
                     read_deleted: bool = False) -> Needle:
-        """readNeedle (volume_read.go:16-63) + handler-level cookie check."""
-        nv = self.nm.get(key)
-        if nv is None or nv.offset == 0:
-            raise NotFoundError(key)
-        read_size = nv.size
-        if not size_is_valid(read_size):
-            if read_deleted and read_size != -1:
-                read_size = -read_size
-            else:
-                raise DeletedError(key)
-        n = self._read_needle_at(nv.offset, read_size)
-        if cookie is not None and n.cookie != cookie:
-            raise CookieMismatchError(f"cookie mismatch for {key}")
-        if n.ttl is not None and n.ttl.minutes and n.last_modified:
-            expire_ns = n.append_at_ns + n.ttl.minutes * 60 * 1_000_000_000
-            if time.time_ns() >= expire_ns:
+        """readNeedle (volume_read.go:16-63) + handler-level cookie check.
+
+        Reads are lock-free against the write path (the reference holds
+        dataFileAccessLock.RLock; serializing Python reads behind batch
+        fsyncs would be far worse), so a compaction commit can move a
+        needle between the map lookup and the pread.  The read is
+        OPTIMISTIC instead: a stale offset fails validation (embedded id
+        mismatch, size header, CRC) and the retry re-reads the map, which
+        post-commit points at the compacted location."""
+        last_exc: Optional[Exception] = None
+        for attempt in range(3):
+            nv = self.nm.get(key)
+            if nv is None or nv.offset == 0:
                 raise NotFoundError(key)
-        return n
+            read_size = nv.size
+            if not size_is_valid(read_size):
+                if read_deleted and read_size != -1:
+                    read_size = -read_size
+                else:
+                    raise DeletedError(key)
+            try:
+                n = self._read_needle_at(nv.offset, read_size)
+                if n.id != key:
+                    raise SizeMismatchError(
+                        f"stale offset: found needle {n.id}, wanted {key}")
+            except (SizeMismatchError, CRCError, struct.error) as e:
+                # struct.error = truncated buffer: the stale offset can
+                # also point PAST the compacted .dat's EOF
+                last_exc = e
+                time.sleep(0.02 * (attempt + 1))  # let the swap settle
+                continue
+            if cookie is not None and n.cookie != cookie:
+                raise CookieMismatchError(f"cookie mismatch for {key}")
+            if n.ttl is not None and n.ttl.minutes and n.last_modified:
+                expire_ns = n.append_at_ns \
+                    + n.ttl.minutes * 60 * 1_000_000_000
+                if time.time_ns() >= expire_ns:
+                    raise NotFoundError(key)
+            return n
+        raise last_exc
 
     def read_needle_blob(self, offset: int, size: int) -> bytes:
         return self._read_at(offset, get_actual_size(size, self.version))
@@ -443,25 +480,37 @@ class Volume:
 
         if self.version == Version.V1:
             raise ValueError("no meta fields in v1 needles")
-        nv = self.nm.get(key)
-        if nv is None or nv.offset == 0:
-            raise NotFoundError(key)
-        if not size_is_valid(nv.size):
-            raise DeletedError(key)
-        hdr = self._read_at(nv.offset, NEEDLE_HEADER_SIZE + 4)
-        n = Needle()
-        n.parse_header(hdr[:NEEDLE_HEADER_SIZE])
-        if cookie is not None and n.cookie != cookie:
-            raise CookieMismatchError(f"cookie mismatch for {key}")
-        if n.size == 0:  # empty body: no data_size/flags fields at all
-            return nv, 0, 0, b"", b""
-        from .types import bytes_to_u32
+        last_exc: Optional[Exception] = None
+        for attempt in range(3):  # optimistic vs compaction, like read_needle
+            nv = self.nm.get(key)
+            if nv is None or nv.offset == 0:
+                raise NotFoundError(key)
+            if not size_is_valid(nv.size):
+                raise DeletedError(key)
+            try:
+                hdr = self._read_at(nv.offset, NEEDLE_HEADER_SIZE + 4)
+                n = Needle()
+                n.parse_header(hdr[:NEEDLE_HEADER_SIZE])
+                if n.id != key:
+                    raise SizeMismatchError(
+                        f"stale offset: found needle {n.id}, wanted {key}")
+                if cookie is not None and n.cookie != cookie:
+                    raise CookieMismatchError(f"cookie mismatch for {key}")
+                if n.size == 0:  # empty body: no data_size/flags fields
+                    return nv, 0, 0, b"", b""
+                from .types import bytes_to_u32
 
-        data_size = bytes_to_u32(hdr[NEEDLE_HEADER_SIZE:NEEDLE_HEADER_SIZE + 4])
-        tail_off = nv.offset + NEEDLE_HEADER_SIZE + 4 + data_size
-        # flags + worst-case name/mime = 1 + 1+255 + 1+255
-        flags, name, mime = parse_needle_tail(self._read_at(tail_off, 513))
-        return nv, data_size, flags, name, mime
+                data_size = bytes_to_u32(
+                    hdr[NEEDLE_HEADER_SIZE:NEEDLE_HEADER_SIZE + 4])
+                tail_off = nv.offset + NEEDLE_HEADER_SIZE + 4 + data_size
+                # flags + worst-case name/mime = 1 + 1+255 + 1+255
+                flags, name, mime = parse_needle_tail(
+                    self._read_at(tail_off, 513))
+                return nv, data_size, flags, name, mime
+            except (SizeMismatchError, struct.error) as e:
+                last_exc = e
+                time.sleep(0.02 * (attempt + 1))
+        raise last_exc
 
     def read_needle_data(self, nv, data_off: int, length: int) -> bytes:
         """pread exactly [data_off, data_off+length) of the needle's data

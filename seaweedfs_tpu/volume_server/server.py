@@ -138,13 +138,20 @@ class VolumeServer:
         while not self._stop.is_set():
             full = pulse % self.full_sync_every == 0
             delta = None
-            if full:
-                payload = self.heartbeat_payload()
-            else:
-                delta = self.store.pop_heartbeat_delta()
-                payload = {"ip": self.store.ip, "port": self.store.port,
-                           "public_url": self.store.public_url,
-                           "delta": True, **(delta or {})}
+            try:
+                # payload building races volume swaps (compaction/tier
+                # commits close+reopen .dat); a crash here would kill the
+                # heartbeat thread and unregister the whole node
+                if full:
+                    payload = self.heartbeat_payload()
+                else:
+                    delta = self.store.pop_heartbeat_delta()
+                    payload = {"ip": self.store.ip, "port": self.store.port,
+                               "public_url": self.store.public_url,
+                               "delta": True, **(delta or {})}
+            except Exception:
+                self._stop.wait(self.pulse_seconds)
+                continue
             try:
                 resp = http_json("POST", f"http://{self.master_url}/heartbeat",
                                  payload,

@@ -173,7 +173,13 @@ class Store:
             if v is None:  # raced with a delete after the note
                 gone_vids.add(vid)
             else:
-                new_volumes.append(v.to_volume_information())
+                try:
+                    new_volumes.append(v.to_volume_information())
+                except Exception:
+                    # mid-compaction-commit swap window (closed .dat):
+                    # re-queue for the next pulse instead of crashing
+                    # the heartbeat thread
+                    self.note_volume_change(vid)
         new_ec_shards = []
         for vid in sorted(new_ec):
             ev = self.ec_volumes.get(vid)
@@ -414,7 +420,12 @@ class Store:
     def collect_heartbeat(self) -> dict:
         from ..master.topology import ShardBits
 
-        volumes = [v.to_volume_information() for v in self.volumes.values()]
+        volumes = []
+        for v in list(self.volumes.values()):
+            try:
+                volumes.append(v.to_volume_information())
+            except Exception:
+                pass  # mid-swap (compaction/tier commit): next pulse
         ec_shards = []
         for vid, ev in self.ec_volumes.items():
             bits = ShardBits()

@@ -1,0 +1,151 @@
+"""Concurrency soak: mixed workload + maintenance churn on a live cluster.
+
+SURVEY §5 notes the reference leans on `go test -race`; Python has no
+race detector, so this drill is the closest analog: many client threads
+hammer both data planes while vacuum, readonly flips, and injected
+network latency churn underneath.  The gate is strict: no unexpected
+errors, and every acknowledged write is readable afterward with exactly
+its payload.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import os
+import random
+import threading
+import time
+
+import pytest
+
+from seaweedfs_tpu.client.operation import WeedClient
+from seaweedfs_tpu.master.server import MasterServer
+from seaweedfs_tpu.utils import faultinject as fi
+from seaweedfs_tpu.utils.httpd import HttpError, http_json
+from seaweedfs_tpu.volume_server.server import VolumeServer
+from tests.conftest import free_port
+
+SOAK_SECONDS = 8.0
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    fi.clear()
+    yield
+    fi.clear()
+
+
+def test_soak_mixed_workload_with_churn(tmp_path):
+    master = MasterServer(port=free_port(), volume_size_limit_mb=64,
+                          pulse_seconds=0.3, garbage_threshold=0.2,
+                          vacuum_scan_seconds=2.0).start()
+    servers = []
+    for i in range(3):
+        d = tmp_path / f"vs{i}"
+        d.mkdir()
+        servers.append(VolumeServer([str(d)], master.url, port=free_port(),
+                                    max_volume_count=12,
+                                    pulse_seconds=0.3).start())
+    deadline = time.time() + 5
+    while time.time() < deadline and len(master.topo.all_nodes()) < 3:
+        time.sleep(0.05)
+    assert len(master.topo.all_nodes()) == 3
+
+    written: dict[str, bytes] = {}
+    deleted: set[str] = set()
+    wlock = threading.Lock()
+    unexpected: list[str] = []
+    stop = threading.Event()
+    rng = random.Random(0x50AC)
+
+    def worker(wid: int) -> None:
+        client = WeedClient(master.url)
+        local_rng = random.Random(wid)
+        while not stop.is_set():
+            try:
+                dice = local_rng.random()
+                if dice < 0.45:  # write (alternate planes)
+                    data = os.urandom(local_rng.randint(1, 4000))
+                    if local_rng.random() < 0.5:
+                        fid = client.upload_tcp(data)
+                    else:
+                        fid = client.upload(data, name=f"s{wid}.bin")
+                    with wlock:
+                        written[fid] = data
+                elif dice < 0.85:  # read back something acknowledged
+                    with wlock:
+                        if not written:
+                            continue
+                        fid, want = local_rng.choice(list(written.items()))
+                        if fid in deleted:
+                            continue
+                    try:
+                        got = (client.download_tcp(fid)
+                               if local_rng.random() < 0.5
+                               else client.download(fid))
+                    except (HttpError, OSError) as e:
+                        with wlock:
+                            if fid in deleted:
+                                continue  # raced a delete: expected
+                        raise AssertionError(f"read {fid}: {e}")
+                    with wlock:
+                        if fid in deleted:
+                            continue
+                    assert got == want, f"payload mismatch for {fid}"
+                else:  # delete
+                    with wlock:
+                        live = [f for f in written if f not in deleted]
+                        if not live:
+                            continue
+                        fid = local_rng.choice(live)
+                        deleted.add(fid)
+                    client.delete(fid)
+            except AssertionError as e:
+                unexpected.append(str(e))
+                return
+            except Exception as e:  # noqa: BLE001
+                unexpected.append(f"worker {wid}: {type(e).__name__}: {e}")
+                return
+
+    def churn() -> None:
+        while not stop.is_set():
+            time.sleep(1.0)
+            try:
+                vs = rng.choice(servers)
+                if not vs.store.volumes:
+                    continue
+                vid = rng.choice(list(vs.store.volumes))
+                # readonly flip: assign must route around it, reads keep
+                # working; flip back so capacity returns
+                http_json("POST", f"http://{vs.url}/admin/readonly",
+                          {"volume_id": vid, "readonly": True})
+                time.sleep(0.3)
+                http_json("POST", f"http://{vs.url}/admin/readonly",
+                          {"volume_id": vid, "readonly": False})
+            except Exception:
+                pass  # churn is best-effort; workers are the gate
+
+    fi.enable("net.request", delay=0.002)  # mild universal latency
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(8)]
+    churner = threading.Thread(target=churn, daemon=True)
+    for t in threads:
+        t.start()
+    churner.start()
+    time.sleep(SOAK_SECONDS)
+    stop.set()
+    for t in threads:
+        t.join(20)
+    fi.clear()
+
+    assert not unexpected, unexpected[:5]
+    with wlock:
+        survivors = {f: d for f, d in written.items() if f not in deleted}
+    assert len(written) > 100, f"soak too shallow: {len(written)} writes"
+    # final verification: every acknowledged, undeleted write is intact
+    client = WeedClient(master.url)
+    for fid, want in survivors.items():
+        assert client.download(fid) == want, fid
+
+    for vs in servers:
+        vs.stop()
+    master.stop()
