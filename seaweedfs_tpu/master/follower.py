@@ -1,0 +1,81 @@
+"""master.follower: a read-only lookup server scaling out /dir/lookup.
+
+Equivalent of weed/command/master_follower.go: a process that follows
+the leader's volume-location push stream (the wdclient KeepConnected
+analog) and serves /dir/lookup from its local map, so read-heavy
+clients don't hammer the raft leader.  Assign and every mutation still
+answer 307 to the real master.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from ..client.wdclient import WdClient
+from ..utils.httpd import HttpError, Request, Response, Router, serve
+
+
+class MasterFollower:
+    def __init__(self, master_url: str, host: str = "127.0.0.1",
+                 port: int = 9334):
+        self.master_url = master_url
+        self.host, self.port = host, port
+        self.wd = WdClient(master_url)
+        self.router = Router("master-follower")
+        self._register_routes()
+        self._server = None
+
+    @property
+    def url(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def start(self) -> "MasterFollower":
+        self.wd.start()
+        self._server = serve(self.router, self.host, self.port)
+        return self
+
+    def stop(self) -> None:
+        if self._server:
+            from ..utils.httpd import stop_server
+
+            stop_server(self._server)
+        self.wd.stop()
+
+    def _register_routes(self) -> None:
+        r = self.router
+
+        @r.route("GET", "/dir/lookup")
+        def lookup(req: Request) -> Response:
+            vid_str = req.query.get("volumeId", "")
+            vid = int(vid_str.split(",")[0])
+            locs = self.wd.vid_map.lookup(vid)
+            if not locs:
+                # miss: forward once to the real master (pre-snapshot vid)
+                urls = self.wd.lookup(vid)
+                if not urls:
+                    return Response({"volumeId": vid_str,
+                                     "error": "volume id not found"},
+                                    status=404)
+                return Response({"volumeId": vid_str, "locations": [
+                    {"url": u, "publicUrl": u} for u in urls]})
+            return Response({"volumeId": vid_str, "locations": [
+                {"url": l.url, "publicUrl": l.public_url} for l in locs]})
+
+        @r.route("GET", "/dir/status")
+        def status(req: Request) -> Response:
+            return Response({
+                "IsFollower": True,
+                "Leader": self.master_url,
+                "Synced": self.wd._synced.is_set(),
+            })
+
+        # every other master call belongs on the real master
+        @r.route("GET", "/dir/assign")
+        @r.route("GET", "/vol/grow")
+        @r.route("GET", "/vol/vacuum")
+        def redirect(req: Request) -> Response:
+            raise HttpError(307, "read-only follower; ask the master",
+                            headers={"Location":
+                                     f"http://{self.master_url}"
+                                     f"{req.handler.path}"})

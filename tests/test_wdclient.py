@@ -123,3 +123,51 @@ def test_watch_snapshot_fallback_when_history_pruned(cluster):
     r3 = http_json("GET", f"http://{master.url}/cluster/watch?"
                    f"since_seq={r['seq']}&timeout=0.5")
     assert r3.get("events") == [] and time.time() - t0 >= 0.4
+
+
+def test_master_follower_serves_lookups(tmp_path):
+    """master.follower (command/master_follower.go): lookups answered
+    from the pushed location map; mutations 307 to the real master."""
+    import time
+
+    from seaweedfs_tpu.client.operation import WeedClient
+    from seaweedfs_tpu.master.follower import MasterFollower
+    from seaweedfs_tpu.master.server import MasterServer
+    from seaweedfs_tpu.utils.httpd import http_bytes, http_json
+    from seaweedfs_tpu.volume_server.server import VolumeServer
+    from tests.conftest import free_port
+
+    master = MasterServer(port=free_port(), pulse_seconds=0.3).start()
+    d = tmp_path / "v"
+    d.mkdir()
+    vs = VolumeServer([str(d)], master.url, port=free_port(),
+                      pulse_seconds=0.3).start()
+    deadline = time.time() + 5
+    while time.time() < deadline and not master.topo.all_nodes():
+        time.sleep(0.05)
+    client = WeedClient(master.url)
+    fid = client.upload(b"follow me")
+    vid = fid.split(",")[0]
+
+    follower = MasterFollower(master.url, port=free_port()).start()
+    try:
+        assert follower.wd.wait_synced(5.0)
+        r = http_json("GET",
+                      f"http://{follower.url}/dir/lookup?volumeId={vid}")
+        assert r["locations"][0]["url"] == vs.url
+        # unknown volume: 404 like the master
+        st, body, _ = http_bytes(
+            "GET", f"http://{follower.url}/dir/lookup?volumeId=999999")
+        assert st == 404
+        # mutations redirect to the real master
+        st, _, hdrs = http_bytes("GET",
+                                 f"http://{follower.url}/dir/assign",
+                                 follow_redirects=False)
+        assert st == 307 and master.url in hdrs.get("Location", "")
+        # and FOLLOWING the redirect works end to end
+        r = http_json("GET", f"http://{follower.url}/dir/assign")
+        assert "fid" in r
+    finally:
+        follower.stop()
+        vs.stop()
+        master.stop()

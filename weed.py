@@ -526,6 +526,17 @@ def cmd_export(args) -> None:
         v.close()
 
 
+def cmd_master_follower(args) -> None:
+    """Read-only lookup server following the leader's location stream
+    (command/master_follower.go)."""
+    from seaweedfs_tpu.master.follower import MasterFollower
+
+    f = MasterFollower(args.masters, host=args.ip, port=args.port).start()
+    print(f"master.follower on {f.url} -> {args.masters}")
+    _on_interrupt(f.stop)
+    _wait_forever()
+
+
 def cmd_s3(args) -> None:
     """Standalone S3 gateway over a remote filer (command/s3.go)."""
     from seaweedfs_tpu.gateway.remote_filer import RemoteFilerFacade
@@ -827,6 +838,12 @@ def main(argv=None) -> None:
     frs.add_argument("-dir", required=True,
                      help="comma-separated remote-mounted directories")
     frs.set_defaults(fn=cmd_filer_remote_sync)
+
+    mf = sub.add_parser("master.follower")
+    mf.add_argument("-masters", default="127.0.0.1:9333")
+    mf.add_argument("-ip", default="127.0.0.1")
+    mf.add_argument("-port", type=int, default=9334)
+    mf.set_defaults(fn=cmd_master_follower)
 
     s3p = sub.add_parser("s3")
     s3p.add_argument("-filer", default="127.0.0.1:8888")
