@@ -149,3 +149,61 @@ def test_soak_mixed_workload_with_churn(tmp_path):
     for vs in servers:
         vs.stop()
     master.stop()
+
+
+def test_ec_soak_degraded_reads_under_faults(tmp_path):
+    """EC chaos drill: encode a populated volume, delete shards to the
+    repair threshold, hammer degraded reads from many threads WITH
+    intermittent shard-read faults, then rebuild and verify every needle
+    byte-for-byte."""
+    from seaweedfs_tpu.ec.layout import to_ext
+    from seaweedfs_tpu.storage.needle import Needle
+    from seaweedfs_tpu.volume_server.store import Store
+
+    store = Store([str(tmp_path)], max_volume_count=4)
+    v = store.add_volume(3)
+    payloads = {i: os.urandom(random.Random(i).randint(100, 8000))
+                for i in range(1, 60)}
+    for i, data in payloads.items():
+        v.write_needle(Needle(cookie=i, id=i, data=data))
+    store.ec_generate(3)
+    store.ec_mount(3)
+    base = store._ec_base(3)
+    for sid in (0, 4, 11, 13):  # 4 erasures: worst repairable case
+        os.remove(base + to_ext(sid))
+    store.ec_unmount(3)
+    store.ec_mount(3)
+
+    errors: list[str] = []
+    fi.enable("shard.read", error_rate=0.05)  # 5% of preads die
+
+    def reader(rid: int) -> None:
+        lr = random.Random(rid)
+        for _ in range(30):
+            key = lr.choice(list(payloads))
+            try:
+                record, _ = store.read_ec_needle(3, key)
+                if payloads[key] not in record:
+                    errors.append(f"payload mismatch for {key}")
+                    return
+            except Exception as e:  # noqa: BLE001
+                errors.append(f"reader {rid} key {key}: "
+                              f"{type(e).__name__}: {e}")
+                return
+
+    threads = [threading.Thread(target=reader, args=(i,)) for i in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(60)
+    fi.clear()
+    assert not errors, errors[:3]
+
+    # rebuild the 4 missing shards and verify all needles again
+    store.ec_rebuild(3)
+    store.ec_unmount(3)
+    store.ec_mount(3)
+    for key, want in payloads.items():
+        record, _ = store.read_ec_needle(3, key)
+        assert want in record, key
+    store.close()
