@@ -221,6 +221,10 @@ class WebDavServer:
                 raise HttpError(404, f"{path} not found")
             except NotEmptyError as e:
                 raise HttpError(409, str(e))
+            # RFC 4918: deleting the resource removes its locks — a stale
+            # entry would 423 the recreation for up to an hour
+            with self._lock_mu:
+                self._locks.pop(path, None)
             return Response(raw=b"", status=204)
 
         @r.route("MOVE", "(/.*)")
@@ -236,9 +240,18 @@ class WebDavServer:
             existed = self.fs.filer.exists(dst)
             if existed and not overwrite:
                 raise HttpError(412, f"{dst} exists and Overwrite: F")
+            # the DESTINATION is mutated too: its lock must gate the op
+            self._check_lock(req, dst)
+            if existed and overwrite:
+                # RFC 4918 9.8.4/9.9.3: overwrite deletes the destination
+                # first — replacing a directory entry in place would
+                # orphan its children in the store and leak their chunks
+                self.fs.filer.delete_entry(dst, recursive=True)
             if req.handler.command == "MOVE":
                 self._check_lock(req, src)
                 self.fs.filer.rename(src, dst)
+                with self._lock_mu:
+                    self._locks.pop(src, None)  # lock dies with the path
             else:
                 self._copy_tree(entry, dst)
             return Response(raw=b"", status=204 if existed else 201)

@@ -126,6 +126,34 @@ class PageWriter:
                 pos += can
             return b"".join(parts)
 
+    def truncate(self, size: int) -> None:
+        """Drop dirty state at/past the new size — data buffered beyond a
+        truncate point must never resurface when the handle flushes
+        (POSIX write-then-ftruncate).  Already-uploaded chunk dicts are
+        trimmed the same way; partially-covered dirty chunks are trimmed
+        by shrinking their written span."""
+        with self._lock:
+            self.file_size_hint = min(self.file_size_hint, size)
+            for idx in [i for i in self._chunks
+                        if i * self.chunk_size >= size]:
+                del self._chunks[idx]
+            cut = size % self.chunk_size
+            boundary_idx = size // self.chunk_size
+            chunk = self._chunks.get(boundary_idx)
+            if chunk is not None:
+                chunk.intervals = [
+                    (a, min(b, cut)) for a, b in chunk.intervals if a < cut]
+                if not chunk.intervals:
+                    del self._chunks[boundary_idx]
+            kept = []
+            for c in self._uploaded:
+                if c["offset"] >= size:
+                    continue
+                if c["offset"] + c["size"] > size:
+                    c = dict(c, size=size - c["offset"])
+                kept.append(c)
+            self._uploaded = kept
+
     def flush(self) -> list[dict]:
         """Seal + upload every dirty chunk; returns all uploaded chunk
         dicts (offset order) and resets the uploaded list."""

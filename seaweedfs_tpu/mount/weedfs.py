@@ -146,7 +146,10 @@ class WFS:
         entries: list[Entry] = []
         last = ""
         while True:
-            q = f"?limit=1000&lastFileName={urllib.parse.quote(last)}"
+            # full=true returns complete entry dicts in the listing: one
+            # request per page instead of one /api/stat per child
+            q = (f"?limit=1000&full=true"
+                 f"&lastFileName={urllib.parse.quote(last)}")
             status, body, _ = http_bytes(
                 "GET", f"http://{self.filer_url}"
                 + urllib.parse.quote(apath or "/") + q)
@@ -158,8 +161,8 @@ class WFS:
             if "Entries" not in d:
                 raise FuseError(errno.ENOTDIR, path)
             for item in d["Entries"]:
-                e = self.get_entry(
-                    item["FullPath"][len(self.root):] or "/")
+                e = Entry.from_dict(item)
+                self.meta.put(e)
                 entries.append(e)
             if not d.get("ShouldDisplayLoadMore") or not d.get("LastFileName"):
                 break
@@ -251,9 +254,13 @@ class WFS:
             h.entry = entry
 
     def release(self, fh: int) -> None:
-        self.flush(fh)
-        with self._hlock:
-            self._handles.pop(fh, None)
+        try:
+            self.flush(fh)
+        finally:
+            # the kernel never retries release: a flush failure must not
+            # leak the handle (and its dirty pages) forever
+            with self._hlock:
+                self._handles.pop(fh, None)
 
     def unlink(self, path: str) -> None:
         status, body, _ = http_bytes(
@@ -330,10 +337,13 @@ class WFS:
                           chunks=chunks, extended=entry.extended)
         new_entry.attr.mtime = time.time()
         self._put_entry(new_entry)
-        for h in list(self._handles.values()):
-            if h.path == path:
-                h.writer.file_size_hint = size
-                h.entry = new_entry
+        with self._hlock:
+            for h in list(self._handles.values()):
+                if h.path == path:
+                    # dirty pages past the truncate point must die with it
+                    # or they resurface on flush (write-then-ftruncate)
+                    h.writer.truncate(size)
+                    h.entry = new_entry
 
     def setattr(self, path: str, mode: Optional[int] = None,
                 uid: Optional[int] = None, gid: Optional[int] = None,

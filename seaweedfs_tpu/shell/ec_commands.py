@@ -178,6 +178,7 @@ def cmd_ec_balance(env: CommandEnv, flags: dict) -> str:
             for n in rack["DataNodes"]:
                 counts[n["Url"]] = n["EcShards"]
 
+    touched: set[str] = set()
     for vid_str in topo.get("EcVolumes", {}):
         vid = int(vid_str)
         info = env.master_get(f"/dir/lookup_ec?volumeId={vid}")
@@ -206,6 +207,7 @@ def cmd_ec_balance(env: CommandEnv, flags: dict) -> str:
                     env.volume_post(url, "/admin/ec/unmount",
                                     {"volume_id": vid})
                 counts[url] = counts.get(url, 1) - 1
+                touched.add(url)
                 moves.append(f"dedupe {vid}.{sid} from {url}")
             shard_map[sid] = [keep]
 
@@ -243,7 +245,12 @@ def cmd_ec_balance(env: CommandEnv, flags: dict) -> str:
             counts[dst] = counts.get(dst, 0) + 1
             shard_map[sid] = [dst]
             moves.append(f"move {vid}.{sid} {src} -> {dst}")
-        _refresh_heartbeats(env, set(all_urls))
+            touched.update((src, dst))
+    # one refresh after the whole pass, and only for servers that actually
+    # moved shards: refreshing every server per volume is O(volumes x
+    # servers) heartbeat RPCs for clusters that are already balanced
+    if touched:
+        _refresh_heartbeats(env, touched)
     return "\n".join(moves) or "already balanced"
 
 

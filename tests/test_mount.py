@@ -266,3 +266,36 @@ def test_wfs_dir_rename_retargets_open_child_handles(wfs):
     h2 = fs.open("/dir2/f.txt")
     assert fs.read(h2.fh, 0, 5) == b"inner"
     fs.release(h2.fh)
+
+
+def test_truncate_discards_dirty_pages(wfs):
+    """POSIX write-then-ftruncate: buffered pages past the truncate point
+    must not resurface when the handle flushes."""
+    fs, _ = wfs
+    h = fs.create("/trunc.bin")
+    fs.write(h.fh, 0, b"A" * 50)
+    fs.truncate("/trunc.bin", 0)
+    fs.release(h.fh)
+    assert fs.get_entry("/trunc.bin").file_size == 0
+    # partial truncate keeps the prefix only
+    h = fs.create("/trunc2.bin")
+    fs.write(h.fh, 0, b"B" * 100)
+    fs.truncate("/trunc2.bin", 40)
+    fs.release(h.fh)
+    h = fs.open("/trunc2.bin")
+    assert fs.read(h.fh, 0, 200) == b"B" * 40
+    fs.release(h.fh)
+
+
+def test_release_drops_handle_even_when_flush_fails(wfs):
+    fs, _ = wfs
+    h = fs.create("/leak.bin")
+    fs.write(h.fh, 0, b"x")
+    real = fs.filer_url
+    fs.filer_url = "127.0.0.1:1"  # unreachable: flush will fail
+    try:
+        with pytest.raises(Exception):
+            fs.release(h.fh)
+    finally:
+        fs.filer_url = real
+    assert h.fh not in fs._handles  # no leak

@@ -152,3 +152,50 @@ def test_mkcol_conflicts(dav_stack):
     assert http_bytes("MKCOL", _url(dav_stack, "/a/b/c"))[0] == 409
     http_bytes("MKCOL", _url(dav_stack, "/a"))
     assert http_bytes("MKCOL", _url(dav_stack, "/a"))[0] == 405
+
+
+def test_move_respects_destination_lock(dav_stack):
+    dav = dav_stack
+    http_bytes("PUT", _url(dav, "/locked.txt"), b"precious")
+    st, body, hdrs = http_bytes("LOCK", _url(dav, "/locked.txt"))
+    assert st == 200
+    http_bytes("PUT", _url(dav, "/intruder.txt"), b"overwrite you",
+               headers={"If": hdrs["Lock-Token"]})
+    # wait — intruder has no lock; move onto the LOCKED destination
+    st, _, _ = http_bytes(
+        "MOVE", _url(dav, "/intruder.txt"),
+        headers={"Destination": _url(dav, "/locked.txt")})
+    assert st == 423  # destination lock gates the move
+    st, body, _ = http_bytes("GET", _url(dav, "/locked.txt"))
+    assert body == b"precious"
+
+
+def test_delete_removes_lock(dav_stack):
+    dav = dav_stack
+    http_bytes("PUT", _url(dav, "/gone.txt"), b"x")
+    st, _, hdrs = http_bytes("LOCK", _url(dav, "/gone.txt"))
+    token = hdrs["Lock-Token"].strip("<>")
+    st, _, _ = http_bytes("DELETE", _url(dav, "/gone.txt"),
+                          headers={"If": f"<{token}>"})
+    assert st == 204
+    # recreation is NOT blocked by a stale lock entry
+    st, _, _ = http_bytes("PUT", _url(dav, "/gone.txt"), b"fresh")
+    assert st in (200, 201, 204)
+    st, body, _ = http_bytes("GET", _url(dav, "/gone.txt"))
+    assert body == b"fresh"
+
+
+def test_move_overwrite_onto_directory_removes_children(dav_stack):
+    dav = dav_stack
+    http_bytes("MKCOL", _url(dav, "/dir"))
+    http_bytes("PUT", _url(dav, "/dir/child.txt"), b"orphan?")
+    http_bytes("PUT", _url(dav, "/file.txt"), b"the file")
+    st, _, _ = http_bytes("MOVE", _url(dav, "/file.txt"),
+                          headers={"Destination": _url(dav, "/dir"),
+                                   "Overwrite": "T"})
+    assert st == 204
+    st, body, _ = http_bytes("GET", _url(dav, "/dir"))
+    assert st == 200 and body == b"the file"
+    # the directory's children are gone, not orphaned under a file path
+    st, _, _ = http_bytes("GET", _url(dav, "/dir/child.txt"))
+    assert st == 404
