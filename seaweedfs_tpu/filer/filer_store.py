@@ -17,6 +17,15 @@ from typing import Iterator, Optional, Protocol
 from .entry import Entry
 
 
+def split_dir_name(path: str) -> tuple[str, str]:
+    """Split a full path into (parent dir, name); "/" -> ("", "/").
+    Shared by every (dir, name)-keyed store."""
+    if path == "/":
+        return "", "/"
+    d, _, name = path.rstrip("/").rpartition("/")
+    return d or "/", name
+
+
 class FilerStore(Protocol):
     name: str
 
@@ -133,12 +142,7 @@ class SqliteStore:
             self._local.con = con
         return con
 
-    @staticmethod
-    def _split(path: str) -> tuple[str, str]:
-        if path == "/":
-            return "", "/"
-        d, _, name = path.rstrip("/").rpartition("/")
-        return d or "/", name
+    _split = staticmethod(split_dir_name)
 
     def insert_entry(self, entry: Entry) -> None:
         d, name = self._split(entry.full_path)

@@ -1,0 +1,166 @@
+"""In-process RESP2 server implementing the command subset RedisStore uses.
+
+Test double for a real Redis (the image has no redis server or redis-py);
+semantics follow the Redis docs for: PING, AUTH, SELECT, SET, GET, DEL,
+ZADD, ZREM, ZRANGEBYLEX (with LIMIT), MGET.  Single-threaded per connection,
+shared dict state under a lock — plenty for protocol-level store tests.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+
+
+class MiniRedis:
+    def __init__(self, password: str = ""):
+        self.password = password
+        self.kv: dict[bytes, bytes] = {}
+        self.zsets: dict[bytes, set[bytes]] = {}
+        self.lock = threading.Lock()
+        self._srv = socket.socket()
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind(("127.0.0.1", 0))
+        self._srv.listen(16)
+        self.port = self._srv.getsockname()[1]
+        self._stop = False
+        self._thread = threading.Thread(target=self._accept_loop, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop = True
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+
+    # -- server loop --------------------------------------------------------
+    def _accept_loop(self) -> None:
+        while not self._stop:
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def _serve(self, conn: socket.socket) -> None:
+        buf = b""
+
+        def read_line():
+            nonlocal buf
+            while b"\r\n" not in buf:
+                chunk = conn.recv(65536)
+                if not chunk:
+                    raise ConnectionError
+                buf += chunk
+            line, buf = buf.split(b"\r\n", 1)
+            return line
+
+        def read_exact(n):
+            nonlocal buf
+            while len(buf) < n:
+                chunk = conn.recv(65536)
+                if not chunk:
+                    raise ConnectionError
+                buf += chunk
+            data, buf = buf[:n], buf[n:]
+            return data
+
+        authed = not self.password
+        try:
+            while True:
+                line = read_line()
+                if not line.startswith(b"*"):
+                    conn.sendall(b"-ERR protocol\r\n")
+                    return
+                parts = []
+                for _ in range(int(line[1:])):
+                    hdr = read_line()
+                    assert hdr.startswith(b"$")
+                    parts.append(read_exact(int(hdr[1:])))
+                    read_exact(2)
+                cmd = parts[0].upper()
+                if cmd == b"AUTH":
+                    authed = parts[1].decode() == self.password
+                    conn.sendall(b"+OK\r\n" if authed
+                                 else b"-ERR invalid password\r\n")
+                    continue
+                if not authed:
+                    conn.sendall(b"-NOAUTH Authentication required.\r\n")
+                    continue
+                conn.sendall(self._dispatch(cmd, parts[1:]))
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            conn.close()
+
+    # -- commands -----------------------------------------------------------
+    @staticmethod
+    def _bulk(v: bytes | None) -> bytes:
+        if v is None:
+            return b"$-1\r\n"
+        return b"$%d\r\n%s\r\n" % (len(v), v)
+
+    def _dispatch(self, cmd: bytes, args: list[bytes]) -> bytes:
+        with self.lock:
+            if cmd == b"PING":
+                return b"+PONG\r\n"
+            if cmd == b"SELECT":
+                return b"+OK\r\n"
+            if cmd == b"SET":
+                self.kv[args[0]] = args[1]
+                return b"+OK\r\n"
+            if cmd == b"GET":
+                return self._bulk(self.kv.get(args[0]))
+            if cmd == b"MGET":
+                return b"*%d\r\n%s" % (len(args), b"".join(
+                    self._bulk(self.kv.get(k)) for k in args))
+            if cmd == b"DEL":
+                n = 0
+                for k in args:
+                    n += self.kv.pop(k, None) is not None
+                    n += self.zsets.pop(k, None) is not None
+                return b":%d\r\n" % n
+            if cmd == b"ZADD":
+                z = self.zsets.setdefault(args[0], set())
+                added = 0
+                for m in args[2::2]:  # (score, member) pairs, scores ignored
+                    added += m not in z
+                    z.add(m)
+                return b":%d\r\n" % added
+            if cmd == b"ZREM":
+                z = self.zsets.get(args[0], set())
+                n = 0
+                for m in args[1:]:
+                    n += m in z
+                    z.discard(m)
+                return b":%d\r\n" % n
+            if cmd == b"ZRANGEBYLEX":
+                members = sorted(self.zsets.get(args[0], set()))
+                lo, hi = args[1], args[2]
+                off, cnt = 0, len(members)
+                if len(args) >= 6 and args[3].upper() == b"LIMIT":
+                    off, cnt = int(args[4]), int(args[5])
+                    if cnt < 0:
+                        cnt = len(members)
+
+                def ok(m: bytes) -> bool:
+                    if lo == b"-":
+                        lo_ok = True
+                    elif lo.startswith(b"["):
+                        lo_ok = m >= lo[1:]
+                    else:  # (
+                        lo_ok = m > lo[1:]
+                    if hi == b"+":
+                        hi_ok = True
+                    elif hi.startswith(b"["):
+                        hi_ok = m <= hi[1:]
+                    else:
+                        hi_ok = m < hi[1:]
+                    return lo_ok and hi_ok
+
+                sel = [m for m in members if ok(m)][off:off + cnt]
+                return b"*%d\r\n%s" % (
+                    len(sel), b"".join(self._bulk(m) for m in sel))
+            return b"-ERR unknown command '%s'\r\n" % cmd

@@ -1,0 +1,163 @@
+"""Redis-protocol filer store against an in-process RESP server.
+
+Gates:
+- RedisStore is observably identical to MemoryStore under randomized ops
+  (same differential harness the LSM store passes)
+- listing pagination, prefix filtering, and resume markers work over
+  ZRANGEBYLEX
+- kv family round-trips with byte-prefix scans via the hex index
+- AUTH and redis:// URL parsing
+- a Filer runs end-to-end on top of it
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu.filer.entry import Attr, Entry, FileChunk
+from seaweedfs_tpu.filer.filer import Filer, NotFoundError
+from seaweedfs_tpu.filer.filer_store import MemoryStore
+from seaweedfs_tpu.filer.redis_store import RedisStore, RespError
+
+from .miniredis import MiniRedis
+
+RNG = np.random.default_rng(0xED15)
+
+
+@pytest.fixture()
+def server():
+    s = MiniRedis()
+    yield s
+    s.stop()
+
+
+@pytest.fixture()
+def store(server):
+    return RedisStore(port=server.port)
+
+
+def _file(path: str, n: int = 1) -> Entry:
+    chunks = [FileChunk(file_id=f"3,{i:02x}", offset=i * 10, size=10)
+              for i in range(n)]
+    return Entry(full_path=path, attr=Attr(mode=0o660), chunks=chunks)
+
+
+def test_crud_and_listing(store):
+    store.insert_entry(_file("/d/a.txt"))
+    store.insert_entry(_file("/d/b.txt", 3))
+    store.insert_entry(_file("/d/c.txt"))
+    got = store.find_entry("/d/b.txt")
+    assert got is not None and len(got.chunks) == 3
+    assert [e.full_path for e in store.list_directory_entries("/d")] == [
+        "/d/a.txt", "/d/b.txt", "/d/c.txt"]
+    # resume after a.txt, exclusive
+    assert [e.full_path for e in store.list_directory_entries(
+        "/d", start_file="a.txt")] == ["/d/b.txt", "/d/c.txt"]
+    # inclusive resume + limit
+    assert [e.full_path for e in store.list_directory_entries(
+        "/d", start_file="b.txt", include_start=True, limit=1)] == ["/d/b.txt"]
+    store.delete_entry("/d/b.txt")
+    assert store.find_entry("/d/b.txt") is None
+    assert [e.full_path for e in store.list_directory_entries("/d")] == [
+        "/d/a.txt", "/d/c.txt"]
+
+
+def test_prefix_listing(store):
+    for name in ("apple", "apricot", "banana", "cherry"):
+        store.insert_entry(_file(f"/fruit/{name}"))
+    assert [e.full_path for e in store.list_directory_entries(
+        "/fruit", prefix="ap")] == ["/fruit/apple", "/fruit/apricot"]
+    assert [e.full_path for e in store.list_directory_entries(
+        "/fruit", prefix="z")] == []
+
+
+def test_delete_folder_children_recursive(store):
+    for p in ("/t/x", "/t/sub/y", "/t/sub/deep/z", "/other/keep"):
+        store.insert_entry(_file(p))
+    store.delete_folder_children("/t")
+    for p in ("/t/x", "/t/sub/y", "/t/sub/deep/z"):
+        assert store.find_entry(p) is None
+    assert store.find_entry("/other/keep") is not None
+    assert list(store.list_directory_entries("/t")) == []
+
+
+def test_kv_roundtrip_and_prefix_scan(store):
+    store.kv_put(b"sig/alpha", b"1")
+    store.kv_put(b"sig/beta", b"2")
+    store.kv_put(b"other", b"3")
+    assert store.kv_get(b"sig/alpha") == b"1"
+    assert store.kv_get(b"missing") is None
+    got = dict(store.kv_scan(b"sig/"))
+    assert got == {b"sig/alpha": b"1", b"sig/beta": b"2"}
+    assert len(dict(store.kv_scan(b""))) == 3
+    store.kv_delete(b"sig/alpha")
+    assert store.kv_get(b"sig/alpha") is None
+    assert dict(store.kv_scan(b"sig/")) == {b"sig/beta": b"2"}
+
+
+def test_matches_memory_randomized(store):
+    """Differential: RedisStore behaves like MemoryStore (same harness the
+    LSM store passes)."""
+    mem = MemoryStore()
+    dirs = ["/a", "/a/b", "/c"]
+    names = [f"f{i:02d}" for i in range(12)]
+    for _ in range(400):
+        op = RNG.integers(0, 4)
+        d = dirs[RNG.integers(0, len(dirs))]
+        n = names[RNG.integers(0, len(names))]
+        path = f"{d}/{n}"
+        if op == 0:
+            e = _file(path, int(RNG.integers(1, 4)))
+            mem.insert_entry(e)
+            store.insert_entry(e)
+        elif op == 1:
+            mem.delete_entry(path)
+            store.delete_entry(path)
+        elif op == 2:
+            a, b = mem.find_entry(path), store.find_entry(path)
+            assert (a is None) == (b is None)
+            if a is not None:
+                assert a.to_dict() == b.to_dict()
+        else:
+            la = [e.full_path for e in mem.list_directory_entries(d)]
+            lb = [e.full_path for e in store.list_directory_entries(d)]
+            assert la == lb
+
+
+def test_auth_and_url_parse():
+    s = MiniRedis(password="hunter2")
+    try:
+        with pytest.raises(RespError):
+            RedisStore(port=s.port)  # no password
+        st = RedisStore.from_url(f"redis://:hunter2@127.0.0.1:{s.port}/0")
+        st.insert_entry(_file("/x"))
+        assert st.find_entry("/x") is not None
+    finally:
+        s.stop()
+    conf = RedisStore.from_url
+    # pure-parse checks (no connection): inspect parsed fields via a failure
+    with pytest.raises(OSError):
+        conf("redis://127.0.0.1:1/3")  # nothing listens on port 1
+
+
+def test_filer_on_redis(server, store):
+    deleted: list[str] = []
+    f = Filer(store=store, delete_chunks_fn=deleted.extend)
+    f.mkdir("/docs")
+    f.create_entry(_file("/docs/readme.md", 2))
+    assert [c.file_id for c in f.find_entry("/docs/readme.md").chunks] == [
+        "3,00", "3,01"]
+    # hardlink wrapper rides on top of any store, including this one
+    f.hardlink("/docs/readme.md", "/docs/link.md")
+    assert [c.file_id for c in f.find_entry("/docs/link.md").chunks] == [
+        "3,00", "3,01"]
+    f.delete_entry("/docs/readme.md")
+    f.flush_gc()
+    assert deleted == []  # still linked
+    f.delete_entry("/docs/link.md")
+    f.flush_gc()
+    assert sorted(deleted) == ["3,00", "3,01"]
+    with pytest.raises(NotFoundError):
+        f.find_entry("/docs/readme.md")
+    f.close()

@@ -71,26 +71,39 @@ def cmd_volume(args) -> None:
     _wait_forever()
 
 
-def cmd_filer(args) -> None:
-    from seaweedfs_tpu.filer.filer_store import SqliteStore
-    from seaweedfs_tpu.filer.server import FilerServer
-    from seaweedfs_tpu.gateway.s3 import S3ApiServer
-    from seaweedfs_tpu.gateway.webdav import WebDavServer
-    from seaweedfs_tpu.security.config import filer_guard
+def _make_filer_store(db: str):
+    """Store selection by -db value (the rebuild's filer.toml analog):
+    ``redis://…`` -> RedisStore, ``*.lsm`` -> LSM store, other path ->
+    sqlite, empty -> memory."""
+    if not db:
+        return None
+    if db.startswith("redis://"):
+        from seaweedfs_tpu.filer.redis_store import RedisStore
 
-    if args.db and args.db.endswith(".lsm"):
+        return RedisStore.from_url(db)
+    if db.endswith(".lsm"):
         # prefer the native C++ engine; the Python engine shares the
         # on-disk format, so falling back never strands a directory
         try:
             from seaweedfs_tpu.filer.lsm_store import NativeLsmStore
 
-            store = NativeLsmStore(args.db)
+            return NativeLsmStore(db)
         except (RuntimeError, OSError):
             from seaweedfs_tpu.filer.lsm_store import LsmStore
 
-            store = LsmStore(args.db)
-    else:
-        store = SqliteStore(args.db) if args.db else None
+            return LsmStore(db)
+    from seaweedfs_tpu.filer.filer_store import SqliteStore
+
+    return SqliteStore(db)
+
+
+def cmd_filer(args) -> None:
+    from seaweedfs_tpu.filer.server import FilerServer
+    from seaweedfs_tpu.gateway.s3 import S3ApiServer
+    from seaweedfs_tpu.gateway.webdav import WebDavServer
+    from seaweedfs_tpu.security.config import filer_guard
+
+    store = _make_filer_store(args.db)
     f = FilerServer(args.master, store, host=args.ip, port=args.port,
                     max_chunk_mb=args.maxMB,
                     chunk_cache_dir=args.cacheDir,
@@ -291,9 +304,10 @@ _SCAFFOLDS = {
 ''',
     "filer": '''\
 # filer.toml — store selection happens via the -db flag:
-#   (absent)        in-memory store
-#   /path/filer.db  sqlite store
-#   /path/store.lsm log-structured store (WAL + memtable + SSTables)
+#   (absent)          in-memory store
+#   /path/filer.db    sqlite store
+#   /path/store.lsm   log-structured store (WAL + memtable + SSTables)
+#   redis://host:port redis-protocol server store (any RESP2 server)
 # Per-path rules (collection, replication, ttl, fsync) live IN the
 # filesystem at /etc/seaweedfs/filer.conf — edit with `fs.configure`.
 ''',
@@ -771,8 +785,8 @@ def main(argv=None) -> None:
     fl.add_argument("-ip", default="127.0.0.1")
     fl.add_argument("-port", type=int, default=8888)
     fl.add_argument("-db", default="",
-                    help="store path: *.lsm -> LSM store dir, else sqlite "
-                         "(default: memory)")
+                    help="store: redis://[:pw@]host:port[/db], *.lsm -> LSM "
+                         "store dir, else sqlite path (default: memory)")
     fl.add_argument("-peers", default="",
                     help="other filer host:ports to aggregate meta from")
     fl.add_argument("-maxMB", type=int, default=8)
