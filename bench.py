@@ -134,10 +134,18 @@ def _child(scratch_path: str, platform: str = "") -> None:
         for n in (n_lo, n_hi):
             loop = make_loop(encode, n)
             jax.device_get(loop(planes, data))  # compile + warm
-            t0 = time.perf_counter()
-            jax.device_get(loop(planes, data))
-            times[n] = time.perf_counter() - t0
+            best = float("inf")
+            for _ in range(2):  # min-of-2: absorb scheduler noise
+                t0 = time.perf_counter()
+                jax.device_get(loop(planes, data))
+                best = min(best, time.perf_counter() - t0)
+            times[n] = best
         per_iter = (times[n_hi] - times[n_lo]) / (n_hi - n_lo)
+        if per_iter <= 0:
+            # noise swamped the differencing (seen on the CPU backend):
+            # fall back to the raw long-loop rate, which still includes
+            # the fixed launch cost and so only understates throughput
+            per_iter = times[n_hi] / n_hi
         return data.nbytes / per_iter / 1e6
 
     # smaller resident set + fewer iters on CPU backend: the interpreter /

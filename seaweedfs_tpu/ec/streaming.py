@@ -72,21 +72,44 @@ class StreamingEncoder:
     def __init__(self, data_shards: int = DATA_SHARDS_COUNT,
                  parity_shards: int = PARITY_SHARDS_COUNT,
                  matrix_kind: str = "vandermonde",
-                 dispatch_mb: int = 8, depth: int = 3):
-        import jax
+                 dispatch_mb: int = 8, depth: int = 3,
+                 engine: str = "auto"):
+        """engine: 'auto' uses the jax device path on a real accelerator
+        and the host SIMD codec otherwise (jax-on-CPU is a correctness
+        surface, ~200x slower than the AVX2 codec); 'device' forces the
+        jax path (tests exercise the XLA kernels with it); 'host' forces
+        the SIMD codec."""
+        from .codec import ReedSolomon, best_cpu_engine
 
-        from ..ops.gf_matmul import DEFAULT_TILE_B, expand_matrix_bitplanes
-        from .codec import ReedSolomon
-
-        self._jax = jax
-        self._expand = expand_matrix_bitplanes
         self.k = data_shards
         self.r = parity_shards
-        self.on_tpu = jax.default_backend() not in ("cpu", "gpu")
-        # one fixed dispatch width: multiple of the pallas tile on TPU
-        b = dispatch_mb << 20
-        if self.on_tpu:
-            b = max(DEFAULT_TILE_B, (b // DEFAULT_TILE_B) * DEFAULT_TILE_B)
+        if engine == "auto":
+            import jax
+
+            on_tpu = jax.default_backend() not in ("cpu", "gpu")
+            engine = "device" if on_tpu else "host"
+        if engine not in ("host", "device"):
+            # catch the -ec.engine vocabulary ("cpu"/"tpu") early rather
+            # than silently taking the jax path
+            raise ValueError(f"engine must be auto/host/device, got {engine!r}")
+        self.engine = engine
+        self._host_engine = None
+        if engine == "host":
+            self.on_tpu = False
+            self._host_engine = best_cpu_engine()
+            b = dispatch_mb << 20
+        else:
+            import jax
+
+            from ..ops.gf_matmul import DEFAULT_TILE_B, expand_matrix_bitplanes
+
+            self._jax = jax
+            self._expand = expand_matrix_bitplanes
+            self.on_tpu = jax.default_backend() not in ("cpu", "gpu")
+            # one fixed dispatch width: multiple of the pallas tile on TPU
+            b = dispatch_mb << 20
+            if self.on_tpu:
+                b = max(DEFAULT_TILE_B, (b // DEFAULT_TILE_B) * DEFAULT_TILE_B)
         self.dispatch_b = b
         self.depth = depth
         # same matrix family as ReedSolomon so shards are byte-identical
@@ -96,20 +119,28 @@ class StreamingEncoder:
 
     # --- kernel dispatch --------------------------------------------------
     def _planes(self, rows: np.ndarray):
+        """Device mode: cached bit-plane expansion resident in HBM.
+        Host mode: the raw GF(2^8) rows, consumed by the SIMD codec."""
+        rows = np.ascontiguousarray(rows)
+        if self.engine == "host":
+            return rows
         key = rows.tobytes() + bytes([rows.shape[0]])
         p = self._plane_cache.get(key)
         if p is None:
             import jax.numpy as jnp
 
-            p = jnp.asarray(self._expand(np.ascontiguousarray(rows)))
+            p = jnp.asarray(self._expand(rows))
             self._plane_cache[key] = p
         return p
 
     def _dispatch(self, planes, buf: np.ndarray):
-        """Async: returns an unfetched device array [R, dispatch_b//4] u32
-        (the transfer packing — see _pack_u32_lanes) with the D2H copy
-        already queued behind the kernel, so the fetch streams down while
-        later dispatches compute."""
+        """Device mode, async: returns an unfetched device array
+        [R, dispatch_b//4] u32 (the transfer packing — see _pack_u32_lanes)
+        with the D2H copy already queued behind the kernel, so the fetch
+        streams down while later dispatches compute.  Host mode: the SIMD
+        codec runs synchronously and the parity comes back finished."""
+        if self.engine == "host":
+            return self._host_engine.matmul(planes, buf)
         from ..ops.gf_matmul import gf_matmul_pallas_packed, gf_matmul_xla_packed
 
         dev = self._jax.device_put(buf)
@@ -125,6 +156,8 @@ class StreamingEncoder:
 
     def _fetch(self, out_dev) -> np.ndarray:
         """Blocking fetch + host-side unpack back to [R, dispatch-width] u8."""
+        if isinstance(out_dev, np.ndarray):  # host mode: already finished
+            return out_dev
         from ..ops.gf_matmul import unpack_u32_host
 
         words = np.asarray(out_dev)

@@ -126,15 +126,38 @@ class RespClient:
         self._sock.sendall(self._encode(parts))
         return self._read_reply()
 
+    @staticmethod
+    def _enc_parts(parts) -> tuple[bytes, ...]:
+        return tuple(p if isinstance(p, bytes) else str(p).encode()
+                     for p in parts)
+
     def command(self, *parts: bytes | str | int):
-        enc = tuple(
-            p if isinstance(p, bytes)
-            else str(p).encode() for p in parts)
+        return self.pipeline(parts)[0]
+
+    def pipeline(self, *commands):
+        """Send every command in one write, then read all replies — one
+        round trip for the whole batch (a RespError in any reply is
+        raised after the remaining replies are drained)."""
+        wire = b"".join(self._encode(self._enc_parts(c)) for c in commands)
+
+        def run():
+            self._sock.sendall(wire)
+            replies, err = [], None
+            for _ in commands:
+                try:
+                    replies.append(self._read_reply())
+                except RespError as e:
+                    replies.append(None)
+                    err = err or e
+            if err is not None:
+                raise err
+            return replies
+
         with self._lock:
             if self._sock is None:
                 self._connect()
             try:
-                return self._exchange(*enc)
+                return run()
             except (ConnectionError, OSError):
                 # one transparent reconnect: redis restarts are routine
                 try:
@@ -143,7 +166,7 @@ class RespClient:
                 finally:
                     self._sock = None
                 self._connect()
-                return self._exchange(*enc)
+                return run()
 
 
 class RedisStore:
@@ -180,13 +203,14 @@ class RedisStore:
     def insert_entry(self, entry: Entry) -> None:
         d, name = _split(entry.full_path)
         blob = json.dumps(entry.to_dict()).encode()
-        self.client.command("SET", entry.full_path.encode(), blob)
+        cmds = [("SET", entry.full_path.encode(), blob)]
         if d:  # "/" itself has no parent listing
-            self.client.command("ZADD", self._dir_key(d), "0", name.encode())
+            cmds.append(("ZADD", self._dir_key(d), "0", name.encode()))
             # global directory index: lets delete_folder_children find
             # descendant directories even when intermediate directory
             # entries were never materialized
-            self.client.command("ZADD", b"d.index", "0", d.encode())
+            cmds.append(("ZADD", b"d.index", "0", d.encode()))
+        self.client.pipeline(*cmds)
 
     update_entry = insert_entry
 
@@ -198,9 +222,10 @@ class RedisStore:
 
     def delete_entry(self, path: str) -> None:
         d, name = _split(path)
-        self.client.command("DEL", path.encode())
+        cmds = [("DEL", path.encode())]
         if d:
-            self.client.command("ZREM", self._dir_key(d), name.encode())
+            cmds.append(("ZREM", self._dir_key(d), name.encode()))
+        self.client.pipeline(*cmds)
 
     def delete_folder_children(self, path: str) -> None:
         """Redis has no prefix-delete: resolve every descendant directory
@@ -226,9 +251,13 @@ class RedisStore:
                                include_start: bool = False, limit: int = 1000,
                                prefix: str = "") -> Iterator[Entry]:
         base = dir_path.rstrip("/") or "/"
-        if start_file:
+        if start_file and (not prefix or start_file >= prefix):
             lo = ("[" if include_start else "(") + start_file
         elif prefix:
+            # when start_file sorts below the prefix range, the prefix is
+            # the tighter bound — otherwise LIMIT would count (and then
+            # client-side drop) members below the prefix, under-filling
+            # the page
             lo = "[" + prefix
         else:
             lo = "-"
@@ -253,16 +282,16 @@ class RedisStore:
     # -- kv -----------------------------------------------------------------
     def kv_put(self, key: bytes, value: bytes) -> None:
         h = key.hex().encode()
-        self.client.command("SET", b"k:" + h, value)
-        self.client.command("ZADD", b"k.index", "0", h)
+        self.client.pipeline(("SET", b"k:" + h, value),
+                             ("ZADD", b"k.index", "0", h))
 
     def kv_get(self, key: bytes) -> Optional[bytes]:
         return self.client.command("GET", b"k:" + key.hex().encode())
 
     def kv_delete(self, key: bytes) -> None:
         h = key.hex().encode()
-        self.client.command("DEL", b"k:" + h)
-        self.client.command("ZREM", b"k.index", h)
+        self.client.pipeline(("DEL", b"k:" + h),
+                             ("ZREM", b"k.index", h))
 
     def kv_scan(self, prefix: bytes) -> Iterator[tuple[bytes, bytes]]:
         # hex is byte-wise: a byte prefix maps to a lex prefix of the index

@@ -309,7 +309,10 @@ class Store:
         if enc is None:
             from ..ec.streaming import StreamingEncoder
 
-            enc = self._stream_enc = StreamingEncoder()
+            # explicit device engine: this path is only reached when the
+            # operator selected -ec.engine=tpu, so jax backend init is
+            # intended (auto-detection could hang on a downed TPU tunnel)
+            enc = self._stream_enc = StreamingEncoder(engine="device")
         return enc
 
     def _ec_base(self, vid: int, collection: str = "") -> str:

@@ -45,6 +45,7 @@ def npchunk(small):
     return max(64, small // 3 * 2)
 
 
+@pytest.mark.parametrize("engine", ["host", "device"])
 @pytest.mark.parametrize("size,large,small", [
     (0, 10_000, 100),              # empty volume
     (999, 10_000, 100),            # sub-single-row tail
@@ -53,10 +54,10 @@ def npchunk(small):
     (10 * 10_000, 10_000, 100),    # exact large-row multiple -> all small rows
     (3 * 10 * 10_000 + 7, 10_000, 100),
 ])
-def test_streaming_encode_byte_identical(tmp_path, size, large, small):
+def test_streaming_encode_byte_identical(tmp_path, size, large, small, engine):
     base = _write_dat(tmp_path, size)
     ref = _cpu_reference(tmp_path, base, large, small)
-    enc = StreamingEncoder(10, 4, dispatch_mb=1)
+    enc = StreamingEncoder(10, 4, dispatch_mb=1, engine=engine)
     enc.dispatch_b = 4096  # force multi-dispatch packing paths
     enc.encode_file(base + ".dat", base,
                     large_block_size=large, small_block_size=small)
@@ -75,12 +76,13 @@ def test_streaming_encode_default_geometry_small_dispatch(tmp_path):
     assert _shards(base, 14) == _shards(ref, 14)
 
 
+@pytest.mark.parametrize("engine", ["host", "device"])
 @pytest.mark.parametrize("kill", [
     [0],            # one data shard
     [11],           # one parity shard
     [0, 3, 11, 13],  # worst case: 4 erasures mixed data+parity
 ])
-def test_streaming_rebuild_byte_identical(tmp_path, kill):
+def test_streaming_rebuild_byte_identical(tmp_path, kill, engine):
     large, small = 10_000, 100
     base = _write_dat(tmp_path, 123_457)
     encoder.write_ec_files(base, ReedSolomon(10, 4),
@@ -88,7 +90,7 @@ def test_streaming_rebuild_byte_identical(tmp_path, kill):
     want = _shards(base, 14)
     for i in kill:
         os.unlink(base + to_ext(i))
-    enc = StreamingEncoder(10, 4)
+    enc = StreamingEncoder(10, 4, engine=engine)
     enc.dispatch_b = 4096
     got_ids = enc.rebuild_files(base)
     assert got_ids == sorted(kill)
@@ -105,15 +107,16 @@ def test_streaming_rebuild_unrepairable(tmp_path):
         StreamingEncoder(10, 4).rebuild_files(base)
 
 
-def test_streaming_alt_geometries(tmp_path):
+@pytest.mark.parametrize("engine", ["host", "device"])
+def test_streaming_alt_geometries(tmp_path, engine):
     for k, r in ((6, 3), (12, 4)):
-        base = _write_dat(tmp_path, 77_777, name=f"g{k}{r}")
-        ref = str(tmp_path / f"ref{k}{r}")
+        base = _write_dat(tmp_path, 77_777, name=f"g{k}{r}{engine[0]}")
+        ref = str(tmp_path / f"ref{k}{r}{engine[0]}")
         os.link(base + ".dat", ref + ".dat")
         encoder.write_ec_files(ref, ReedSolomon(k, r),
                                large_block_size=10_000,
                                small_block_size=100, chunk=512)
-        enc = StreamingEncoder(k, r)
+        enc = StreamingEncoder(k, r, engine=engine)
         enc.dispatch_b = 2048
         enc.encode_file(base + ".dat", base,
                         large_block_size=10_000, small_block_size=100)

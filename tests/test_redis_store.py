@@ -72,6 +72,21 @@ def test_prefix_listing(store):
         "/fruit", prefix="z")] == []
 
 
+def test_prefix_with_low_start_file_fills_page(store):
+    """start_file below the prefix range must not under-fill the page:
+    LIMIT is applied server-side, so the lower bound has to be the
+    tighter of (start_file, prefix)."""
+    for name in ("aa", "ab", "ba", "bb"):
+        store.insert_entry(_file(f"/p/{name}"))
+    got = [e.full_path for e in store.list_directory_entries(
+        "/p", start_file="aa", prefix="b", limit=2)]
+    assert got == ["/p/ba", "/p/bb"]
+    # and a resume inside the prefix range still respects start_file
+    got = [e.full_path for e in store.list_directory_entries(
+        "/p", start_file="ba", prefix="b", limit=2)]
+    assert got == ["/p/bb"]
+
+
 def test_delete_folder_children_recursive(store):
     for p in ("/t/x", "/t/sub/y", "/t/sub/deep/z", "/other/keep"):
         store.insert_entry(_file(p))
