@@ -83,6 +83,7 @@ class StreamingEncoder:
 
         self.k = data_shards
         self.r = parity_shards
+        on_tpu = None
         if engine == "auto":
             import jax
 
@@ -94,10 +95,10 @@ class StreamingEncoder:
             raise ValueError(f"engine must be auto/host/device, got {engine!r}")
         self.engine = engine
         self._host_engine = None
+        b = dispatch_mb << 20
         if engine == "host":
             self.on_tpu = False
             self._host_engine = best_cpu_engine()
-            b = dispatch_mb << 20
         else:
             import jax
 
@@ -105,9 +106,9 @@ class StreamingEncoder:
 
             self._jax = jax
             self._expand = expand_matrix_bitplanes
-            self.on_tpu = jax.default_backend() not in ("cpu", "gpu")
+            self.on_tpu = (jax.default_backend() not in ("cpu", "gpu")
+                           if on_tpu is None else on_tpu)
             # one fixed dispatch width: multiple of the pallas tile on TPU
-            b = dispatch_mb << 20
             if self.on_tpu:
                 b = max(DEFAULT_TILE_B, (b // DEFAULT_TILE_B) * DEFAULT_TILE_B)
         self.dispatch_b = b
