@@ -74,6 +74,106 @@ class DiskFile:
         return self._f.fileno()
 
 
+class MemoryMappedFile:
+    """mmap-READ `.dat` (backend/memory_map/, the -memoryMapSizeMB analog):
+    reads are zero-syscall page-cache slices through a shared mapping;
+    writes stay plain pwrite so the on-disk size is always exactly the
+    logical content (external readers — tier upload, EC encode, volume
+    copy — see the same bytes DiskFile would produce) and fsync gives the
+    same durability contract.  Linux's unified buffer cache keeps the
+    mapping coherent with pwrite; the mapping is grown lazily when a read
+    lands past it (mapping beyond EOF would SIGBUS, so it always covers
+    exactly the current file size)."""
+
+    def __init__(self, path: str):
+        import mmap as _mmap
+
+        self._mmap_mod = _mmap
+        self.path = path
+        exists = os.path.exists(path)
+        self._f = open(path, "r+b" if exists else "w+b", buffering=0)
+        self._size = os.fstat(self._f.fileno()).st_size
+        self._mm = None
+        self._mapped = 0
+        self._closed = False
+        self._map_lock = threading.Lock()  # lock-free readers may race here
+        if self._size:
+            self._remap()
+
+    def _remap(self) -> None:
+        """Map the file at its current size; the old mapping is only
+        replaced after the new one exists, so a failure here leaves reads
+        working on the old range."""
+        with self._map_lock:
+            if self._mapped == self._size or self._closed:
+                return  # another reader already remapped (or close() won)
+            new = self._mmap_mod.mmap(self._f.fileno(), self._size,
+                                      access=self._mmap_mod.ACCESS_READ)
+            old, self._mm, self._mapped = self._mm, new, self._size
+            if old is not None:
+                old.close()
+
+    def read_at(self, length: int, offset: int) -> bytes:
+        if fi._points:
+            fi.hit("disk.read")
+        if self._closed:
+            # same failure family as a closed fd so the volume's
+            # lock-free reader retry loop handles the swap race
+            raise OSError("mmap file closed")
+        end = min(offset + length, self._size)
+        if offset >= end:
+            return b""
+        if end > self._mapped:
+            self._remap()
+        return bytes(self._mm[offset:end])
+
+    def write_at(self, data: bytes, offset: int) -> int:
+        if fi._points:
+            fi.hit("disk.write")
+        if self._closed:
+            raise OSError("mmap file closed")
+        n = os.pwrite(self._f.fileno(), data, offset)
+        if offset + n > self._size:
+            self._size = offset + n
+        return n
+
+    def truncate(self, size: int) -> None:
+        with self._map_lock:
+            os.ftruncate(self._f.fileno(), size)
+            self._size = size
+            if self._mapped > size:
+                # shrink the mapping too: pages past EOF would SIGBUS
+                if self._mm is not None:
+                    self._mm.close()
+                    self._mm = None
+                self._mapped = 0
+                if size:
+                    new = self._mmap_mod.mmap(
+                        self._f.fileno(), size,
+                        access=self._mmap_mod.ACCESS_READ)
+                    self._mm, self._mapped = new, size
+
+    def sync(self) -> None:
+        if fi._points:
+            fi.hit("disk.sync")
+        os.fsync(self._f.fileno())
+
+    def close(self) -> None:
+        with self._map_lock:
+            self._closed = True
+            if self._mm is not None:
+                self._mm.close()
+                self._mm = None
+        self._f.close()
+
+    @property
+    def size(self) -> int:
+        return self._size
+
+    def fileno(self) -> int:
+        return self._f.fileno()
+
+
 class BackendStorage(Protocol):
     """A remote object store holding tiered volume files
     (backend/backend.go:25-46 factory interface)."""

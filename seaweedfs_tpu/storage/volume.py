@@ -75,7 +75,8 @@ class Volume:
                  ttl: TTL | None = None,
                  version: Version = Version.V3,
                  volume_size_limit: int = 30 * 1000 * 1000 * 1000,
-                 needle_map_kind: str = "compact"):
+                 needle_map_kind: str = "compact",
+                 use_mmap: bool = False):
         self.directory = directory
         os.makedirs(directory, exist_ok=True)
         self.collection = collection
@@ -86,6 +87,8 @@ class Volume:
         # sections, default), "memory" (plain dict), "ldb" (checkpointed —
         # restart replays only the idx tail), "sorted" (on-disk .sdx)
         self.needle_map_kind = needle_map_kind
+        # mmap-backed .dat (backend/memory_map, -memoryMapSizeMB analog)
+        self.use_mmap = use_mmap
         self.read_only = False
         self.last_append_at_ns = 0
         self.last_modified_ts_seconds = 0
@@ -136,7 +139,12 @@ class Volume:
             exists = os.path.exists(self.dat_path)
             # unbuffered handle + pread-style reads: no stale read-buffer if
             # the file is touched by another handle (EC tooling, replication)
-            self._dat = DiskFile(self.dat_path)
+            if self.use_mmap:
+                from .backend import MemoryMappedFile
+
+                self._dat = MemoryMappedFile(self.dat_path)
+            else:
+                self._dat = DiskFile(self.dat_path)
             if not exists or self._dat.size < SUPER_BLOCK_SIZE:
                 self._dat.write_at(self.super_block.to_bytes(), 0)
         if self._dat.size >= SUPER_BLOCK_SIZE:

@@ -58,7 +58,7 @@ class VolumeServer:
                  backends: Optional[dict] = None,
                  full_sync_every: int = 12,
                  tls_context=None,
-                 tcp: bool = True):
+                 tcp: bool = True, use_mmap: bool = False):
         from ..security import Guard
 
         if backends:
@@ -76,7 +76,8 @@ class VolumeServer:
         self.full_sync_every = max(1, full_sync_every)
         self.guard = guard or Guard()
         self.store = Store(directories, host, port, public_url,
-                           max_volume_count, ec_engine=ec_engine)
+                           max_volume_count, ec_engine=ec_engine,
+                           use_mmap=use_mmap)
         from ..stats import volume_server_metrics
 
         self.metrics = volume_server_metrics()

@@ -72,7 +72,7 @@ class Store:
     def __init__(self, directories: list[str], ip: str = "127.0.0.1",
                  port: int = 8080, public_url: str = "",
                  max_volume_count: int = 8,
-                 ec_engine: str = "cpu"):
+                 ec_engine: str = "cpu", use_mmap: bool = False):
         self.ip, self.port = ip, port
         self.public_url = public_url or f"{ip}:{port}"
         self.locations = [DiskLocation(d) for d in directories]
@@ -83,6 +83,8 @@ class Store:
         self.ec_collections: dict[int, str] = {}
         self.volume_size_limit = 30 * 1000 * 1000 * 1000
         self.ec_engine_name = ec_engine
+        # mmap-backed .dat files (-memoryMapSizeMB analog, backend/memory_map)
+        self.use_mmap = use_mmap
         self._rs_cache: dict[str, ReedSolomon] = {}
         # delta-heartbeat bookkeeping (volume_grpc_client_to_master.go:48
         # streams incremental new/deleted volume + EC-shard lists between
@@ -120,7 +122,8 @@ class Store:
 
     def _open_volume(self, directory: str, collection: str, vid: int) -> Volume:
         v = Volume(directory, collection, vid,
-                   volume_size_limit=self.volume_size_limit)
+                   volume_size_limit=self.volume_size_limit,
+                   use_mmap=self.use_mmap)
         self.volumes[vid] = v
         self.volume_locks[vid] = threading.RLock()
         self.note_volume_change(vid)
@@ -218,7 +221,8 @@ class Store:
         v = Volume(loc.directory, collection, vid,
                    replica_placement=ReplicaPlacement.parse(replication),
                    ttl=TTL.parse(ttl),
-                   volume_size_limit=self.volume_size_limit)
+                   volume_size_limit=self.volume_size_limit,
+                   use_mmap=self.use_mmap)
         self.volumes[vid] = v
         self.volume_locks[vid] = threading.RLock()
         return v

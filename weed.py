@@ -65,7 +65,8 @@ def cmd_volume(args) -> None:
                       rack=args.rack, max_volume_count=args.max,
                       ec_engine=args.ec_engine,
                       guard=volume_guard(_security()),
-                      tls_context=_cluster_tls()).start()
+                      tls_context=_cluster_tls(),
+                      use_mmap=args.mmap).start()
     print(f"volume server listening on {vs.url}, dirs {args.dir}")
     _on_interrupt(vs.stop)
     _wait_forever()
@@ -136,7 +137,8 @@ def cmd_server(args) -> None:
 
     m = MasterServer(host=args.ip, port=args.masterPort).start()
     vs = VolumeServer(args.dir.split(","), m.url, host=args.ip,
-                      port=args.port, ec_engine=args.ec_engine).start()
+                      port=args.port, ec_engine=args.ec_engine,
+                      use_mmap=args.mmap).start()
     print(f"master on {m.url}, volume server on {vs.url}")
     if args.filer:
         store = SqliteStore(args.dir.split(",")[0] + "/filer.db")
@@ -763,6 +765,8 @@ def main(argv=None) -> None:
     v.add_argument("-max", type=int, default=8)
     v.add_argument("-ec.engine", dest="ec_engine", default="cpu",
                    choices=["cpu", "tpu"])
+    v.add_argument("-mmap", action="store_true",
+                   help="mmap-backed .dat files (backend/memory_map analog)")
     v.set_defaults(fn=cmd_volume)
 
     s = sub.add_parser("server")
@@ -778,6 +782,8 @@ def main(argv=None) -> None:
     s.add_argument("-webdavPort", type=int, default=7333)
     s.add_argument("-ec.engine", dest="ec_engine", default="cpu",
                    choices=["cpu", "tpu"])
+    s.add_argument("-mmap", action="store_true",
+                   help="mmap-backed .dat files (backend/memory_map analog)")
     s.set_defaults(fn=cmd_server)
 
     fl = sub.add_parser("filer")
