@@ -239,49 +239,48 @@ def _child(scratch_path: str, platform: str = "") -> None:
     section("e2e_stream", meas_e2e)
 
     # --- cluster write/read req/s (weed benchmark analog) ------------------
-    def meas_cluster():
-        """Cluster microbench with REAL process separation: master and
-        volume server run as their own processes and the load generator
-        (`weed.py benchmark`, command/benchmark.go analog) as a third, so
-        no GIL is shared between client and servers — the shape of the
-        reference's README numbers (15.7k w/s, 47k r/s, 1KB files, c=16).
-        On a 1-core host this measures the same as in-process; on the
-        many-core TPU host it measures actual server capacity."""
-        import re as _re
-        import socket
-        import tempfile as _tempfile
+    import contextlib
+    import re as _re
+    import socket as _socket
+    import tempfile as _tempfile
 
-        repo = os.path.dirname(os.path.abspath(__file__))
-        weed = os.path.join(repo, "weed.py")
-        # server procs must never probe the TPU; prepend (not overwrite)
-        # PYTHONPATH — TPU VMs often supply deps through it
-        env = dict(os.environ, JAX_PLATFORMS="cpu")
-        env["PYTHONPATH"] = repo + (
-            os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
+    repo_dir = os.path.dirname(os.path.abspath(__file__))
+    weed_py = os.path.join(repo_dir, "weed.py")
+    # server procs must never probe the TPU; prepend (not overwrite)
+    # PYTHONPATH — TPU VMs often supply deps through it
+    cluster_env = dict(os.environ, JAX_PLATFORMS="cpu")
+    cluster_env["PYTHONPATH"] = repo_dir + (
+        os.pathsep + cluster_env["PYTHONPATH"]
+        if cluster_env.get("PYTHONPATH") else "")
 
-        def free_port():
-            s = socket.socket()
-            s.bind(("127.0.0.1", 0))
-            p = s.getsockname()[1]
-            s.close()
-            return p
+    def _free_port():
+        s = _socket.socket()
+        s.bind(("127.0.0.1", 0))
+        p = s.getsockname()[1]
+        s.close()
+        return p
 
-        td = _tempfile.mkdtemp()
-        mport, vport = free_port(), free_port()
-        procs = []
+    @contextlib.contextmanager
+    def spawn_cluster(n_vols):
+        """Master + n_vols volume servers as separate processes; yields
+        (master_port, scratch_root) once an assign succeeds."""
+        import urllib.request
+
+        root = _tempfile.mkdtemp()
+        mport = _free_port()
+        procs = [subprocess.Popen(
+            [sys.executable, weed_py, "master", "-port", str(mport)],
+            env=cluster_env, stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL)]
         try:
-            procs.append(subprocess.Popen(
-                [sys.executable, weed, "master", "-port", str(mport)],
-                env=env, stdout=subprocess.DEVNULL,
-                stderr=subprocess.DEVNULL))
-            procs.append(subprocess.Popen(
-                [sys.executable, weed, "volume", "-dir", td,
-                 "-port", str(vport), "-mserver", f"127.0.0.1:{mport}",
-                 "-max", "16"],
-                env=env, stdout=subprocess.DEVNULL,
-                stderr=subprocess.DEVNULL))
-            # ready when an assign succeeds (volume registered)
-            import urllib.request
+            for i in range(n_vols):
+                procs.append(subprocess.Popen(
+                    [sys.executable, weed_py, "volume",
+                     "-dir", os.path.join(root, f"v{i}"),
+                     "-port", str(_free_port()),
+                     "-mserver", f"127.0.0.1:{mport}", "-max", "16"],
+                    env=cluster_env, stdout=subprocess.DEVNULL,
+                    stderr=subprocess.DEVNULL))
             deadline = time.time() + 30
             while time.time() < deadline:
                 try:
@@ -294,15 +293,34 @@ def _child(scratch_path: str, platform: str = "") -> None:
                     time.sleep(0.2)
             else:
                 raise RuntimeError("cluster did not become ready")
+            yield mport, root
+        finally:
+            for p in procs:
+                p.terminate()
+            for p in procs:
+                try:
+                    p.wait(timeout=5)
+                except subprocess.TimeoutExpired:
+                    p.kill()
 
+    def meas_cluster():
+        """Cluster microbench with REAL process separation: master and
+        volume server run as their own processes and the load generator
+        (`weed.py benchmark`, command/benchmark.go analog) as a third, so
+        no GIL is shared between client and servers — the shape of the
+        reference's README numbers (15.7k w/s, 47k r/s, 1KB files, c=16).
+        On a 1-core host this measures the same as in-process; on the
+        many-core TPU host it measures actual server capacity."""
+        with spawn_cluster(1) as (mport, _root):
             def run_bench(n, use_tcp):
-                argv = [sys.executable, weed, "benchmark",
+                argv = [sys.executable, weed_py, "benchmark",
                         "-master", f"127.0.0.1:{mport}",
                         "-n", str(n), "-c", "16", "-size", "1024"]
                 if use_tcp:
                     argv.append("-useTcp")
-                p = subprocess.run(argv, env=env, capture_output=True,
-                                   text=True, timeout=300)
+                p = subprocess.run(argv, env=cluster_env,
+                                   capture_output=True, text=True,
+                                   timeout=300)
                 rates = {}
                 for phase in ("write", "read"):
                     mo = _re.search(rf"{phase}: .* = (\d+) req/s", p.stdout)
@@ -323,16 +341,72 @@ def _child(scratch_path: str, platform: str = "") -> None:
             tcp_rates = run_bench(4000, use_tcp=True)
             detail["cluster_tcp_write_rps"] = tcp_rates.get("write", 0.0)
             detail["cluster_tcp_read_rps"] = tcp_rates.get("read", 0.0)
-        finally:
-            for p in procs:
-                p.terminate()
-            for p in procs:
-                try:
-                    p.wait(timeout=5)
-                except subprocess.TimeoutExpired:
-                    p.kill()
 
     section("cluster", meas_cluster)
+
+    # --- scaled cluster: N volume servers, M client procs ------------------
+    def meas_cluster_scaled():
+        """Horizontal capacity on a many-core host: several volume-server
+        processes behind one master, loaded by several client processes
+        whose phase-aligned rates sum (each runs `weed benchmark -phase`).
+        Skipped below 6 cores — there the processes just fight for the
+        same cycles and the plain cluster numbers are the honest ones."""
+        cores = os.cpu_count() or 1
+        if cores < 6:
+            detail["cluster_scaled_skipped"] = f"{cores} cores"
+            return
+        n_vols = max(2, min(6, cores // 4))
+        n_clients = max(2, min(6, cores // 4))
+        per_client = 4000
+
+        with spawn_cluster(n_vols) as (mport, root):
+            def phase_rate(phase, use_tcp):
+                """Run n_clients aligned single-phase benchmarks; their
+                rates sum (all started together, same op count each)."""
+                cps = []
+                try:
+                    for ci in range(n_clients):
+                        argv = [sys.executable, weed_py, "benchmark",
+                                "-master", f"127.0.0.1:{mport}",
+                                "-n", str(per_client), "-c", "8",
+                                "-size", "1024", "-phase", phase,
+                                "-fidsFile",
+                                os.path.join(root, f"fids{use_tcp}{ci}")]
+                        if use_tcp:
+                            argv.append("-useTcp")
+                        cps.append(subprocess.Popen(
+                            argv, env=cluster_env, stdout=subprocess.PIPE,
+                            stderr=subprocess.DEVNULL, text=True))
+                    total = 0.0
+                    for p in cps:
+                        out, _ = p.communicate(timeout=300)
+                        mo = _re.search(rf"{phase}: .* = (\d+) req/s", out)
+                        if p.returncode != 0 or not mo:
+                            raise RuntimeError(
+                                f"scaled client rc={p.returncode}")
+                        total += float(mo.group(1))
+                    return round(total, 1)
+                finally:
+                    # a failed/hung client must not leave its siblings
+                    # spinning against servers we are about to kill
+                    for p in cps:
+                        if p.poll() is None:
+                            p.kill()
+                            p.wait(timeout=5)
+
+            detail["cluster_scaled_config"] = (
+                f"{n_vols} volume servers, {n_clients} clients, "
+                f"{cores} cores")
+            detail["cluster_scaled_tcp_write_rps"] = phase_rate(
+                "write", use_tcp=True)
+            detail["cluster_scaled_tcp_read_rps"] = phase_rate(
+                "read", use_tcp=True)
+            detail["cluster_scaled_write_rps"] = phase_rate(
+                "write", use_tcp=False)
+            detail["cluster_scaled_read_rps"] = phase_rate(
+                "read", use_tcp=False)
+
+    section("cluster_scaled", meas_cluster_scaled)
 
     # --- parity check ------------------------------------------------------
     def meas_parity():

@@ -12,6 +12,7 @@ this framework's own gateway.
 
 from __future__ import annotations
 
+import errno
 import os
 import shutil
 import threading
@@ -105,33 +106,44 @@ class MemoryMappedFile:
         replaced after the new one exists, so a failure here leaves reads
         working on the old range."""
         with self._map_lock:
-            if self._mapped == self._size or self._closed:
+            # snapshot the size ONCE: a concurrent append may bump
+            # self._size mid-remap, and recording _mapped larger than
+            # the actual mapping would let later reads slice short
+            size = self._size
+            if self._mapped == size or self._closed:
                 return  # another reader already remapped (or close() won)
-            new = self._mmap_mod.mmap(self._f.fileno(), self._size,
+            new = self._mmap_mod.mmap(self._f.fileno(), size,
                                       access=self._mmap_mod.ACCESS_READ)
-            old, self._mm, self._mapped = self._mm, new, self._size
+            old, self._mm, self._mapped = self._mm, new, size
             if old is not None:
                 old.close()
 
     def read_at(self, length: int, offset: int) -> bytes:
         if fi._points:
             fi.hit("disk.read")
-        if self._closed:
-            # same failure family as a closed fd so the volume's
-            # lock-free reader retry loop handles the swap race
-            raise OSError("mmap file closed")
         end = min(offset + length, self._size)
         if offset >= end:
             return b""
         if end > self._mapped:
             self._remap()
-        return bytes(self._mm[offset:end])
+        # local ref: close() may null the attribute between check and
+        # slice; EBADF is what the volume's lock-free reader retry loop
+        # treats as "the .dat was swapped under me, re-resolve and retry"
+        mm = self._mm
+        if self._closed or mm is None:
+            raise OSError(errno.EBADF, "mmap file closed")
+        data = bytes(mm[offset:end])
+        if len(data) < end - offset:
+            # mapping raced a concurrent append shorter than _mapped
+            # claims: force a fresh map on the next attempt
+            raise OSError(errno.EBADF, "mmap shorter than expected")
+        return data
 
     def write_at(self, data: bytes, offset: int) -> int:
         if fi._points:
             fi.hit("disk.write")
         if self._closed:
-            raise OSError("mmap file closed")
+            raise OSError(errno.EBADF, "mmap file closed")
         n = os.pwrite(self._f.fileno(), data, offset)
         if offset + n > self._size:
             self._size = offset + n

@@ -673,17 +673,25 @@ def cmd_download(args) -> None:
 
 
 def cmd_benchmark(args) -> None:
-    """weed benchmark (command/benchmark.go): write then read N files."""
+    """weed benchmark (command/benchmark.go): write then read N files.
+
+    -phase write|read|both splits the run so many client processes can
+    execute aligned phases concurrently (the scaled cluster bench);
+    -fidsFile carries the written fids from a write pass to a read pass."""
     import concurrent.futures
     import random
 
     from seaweedfs_tpu.client.operation import WeedClient
 
     client = WeedClient(args.master)
-    payload = bytes(random.getrandbits(8) for _ in range(args.size))
+    # deterministic payload: a -phase read process must reproduce the
+    # bytes its sibling -phase write process stored
+    payload = random.Random(0xBE).randbytes(args.size)
     fids: list[str] = []
 
     use_tcp = getattr(args, "useTcp", False)
+    phase = getattr(args, "phase", "both")
+    fids_file = getattr(args, "fidsFile", "")
 
     def write_one(i: int) -> float:
         t0 = time.perf_counter()
@@ -694,14 +702,26 @@ def cmd_benchmark(args) -> None:
         fids.append(fid)
         return time.perf_counter() - t0
 
-    t0 = time.perf_counter()
-    with concurrent.futures.ThreadPoolExecutor(args.c) as ex:
-        lat = sorted(ex.map(write_one, range(args.n)))
-    wall = time.perf_counter() - t0
-    print(f"write: {args.n} x {args.size}B in {wall:.2f}s = "
-          f"{args.n / wall:.0f} req/s, "
-          f"avg {sum(lat) / len(lat) * 1e3:.1f}ms "
-          f"p99 {lat[int(len(lat) * 0.99) - 1] * 1e3:.1f}ms")
+    if phase in ("both", "write"):
+        t0 = time.perf_counter()
+        with concurrent.futures.ThreadPoolExecutor(args.c) as ex:
+            lat = sorted(ex.map(write_one, range(args.n)))
+        wall = time.perf_counter() - t0
+        print(f"write: {args.n} x {args.size}B in {wall:.2f}s = "
+              f"{args.n / wall:.0f} req/s, "
+              f"avg {sum(lat) / len(lat) * 1e3:.1f}ms "
+              f"p99 {lat[int(len(lat) * 0.99) - 1] * 1e3:.1f}ms")
+        if fids_file:
+            with open(fids_file, "w") as f:
+                f.write("\n".join(fids))
+
+    if phase == "read":
+        if not fids_file:
+            raise SystemExit("-phase read requires -fidsFile "
+                             "(produced by a -phase write run)")
+        fids = [line for line in open(fids_file).read().splitlines() if line]
+        if not fids:
+            raise SystemExit(f"no fids in {fids_file}")
 
     def read_one(fid: str) -> float:
         t0 = time.perf_counter()
@@ -709,14 +729,16 @@ def cmd_benchmark(args) -> None:
         assert got == payload
         return time.perf_counter() - t0
 
-    random.shuffle(fids)
-    t0 = time.perf_counter()
-    with concurrent.futures.ThreadPoolExecutor(args.c) as ex:
-        lat = sorted(ex.map(read_one, fids))
-    wall = time.perf_counter() - t0
-    print(f"read: {args.n} in {wall:.2f}s = {args.n / wall:.0f} req/s, "
-          f"avg {sum(lat) / len(lat) * 1e3:.1f}ms "
-          f"p99 {lat[int(len(lat) * 0.99) - 1] * 1e3:.1f}ms")
+    if phase in ("both", "read") and fids:
+        random.shuffle(fids)
+        t0 = time.perf_counter()
+        with concurrent.futures.ThreadPoolExecutor(args.c) as ex:
+            lat = sorted(ex.map(read_one, fids))
+        wall = time.perf_counter() - t0
+        print(f"read: {len(fids)} in {wall:.2f}s = "
+              f"{len(fids) / wall:.0f} req/s, "
+              f"avg {sum(lat) / len(lat) * 1e3:.1f}ms "
+              f"p99 {lat[int(len(lat) * 0.99) - 1] * 1e3:.1f}ms")
 
 
 def _on_interrupt(hook) -> None:
@@ -987,6 +1009,10 @@ def main(argv=None) -> None:
     b.add_argument("-c", type=int, default=16)
     b.add_argument("-useTcp", action="store_true",
                    help="write/read over the framed-TCP data path")
+    b.add_argument("-phase", default="both", choices=["both", "write", "read"],
+                   help="run only one phase (scaled multi-client benches)")
+    b.add_argument("-fidsFile", default="",
+                   help="write: save fids here; read: load fids from here")
     b.set_defaults(fn=cmd_benchmark)
 
     args = p.parse_args(argv)
