@@ -261,7 +261,7 @@ def _child(scratch_path: str, platform: str = "") -> None:
         return p
 
     @contextlib.contextmanager
-    def spawn_cluster(n_vols):
+    def spawn_cluster(n_vols, extra_vol_args=()):
         """Master + n_vols volume servers as separate processes; yields
         (master_port, scratch_root) once an assign succeeds."""
         import urllib.request
@@ -278,7 +278,8 @@ def _child(scratch_path: str, platform: str = "") -> None:
                     [sys.executable, weed_py, "volume",
                      "-dir", os.path.join(root, f"v{i}"),
                      "-port", str(_free_port()),
-                     "-mserver", f"127.0.0.1:{mport}", "-max", "16"],
+                     "-mserver", f"127.0.0.1:{mport}", "-max", "16",
+                     *extra_vol_args],
                     env=cluster_env, stdout=subprocess.DEVNULL,
                     stderr=subprocess.DEVNULL))
             deadline = time.time() + 30
@@ -303,6 +304,28 @@ def _child(scratch_path: str, platform: str = "") -> None:
                 except subprocess.TimeoutExpired:
                     p.kill()
 
+    def run_bench(mport, n, use_tcp):
+        argv = [sys.executable, weed_py, "benchmark",
+                "-master", f"127.0.0.1:{mport}",
+                "-n", str(n), "-c", "16", "-size", "1024"]
+        if use_tcp:
+            argv.append("-useTcp")
+        p = subprocess.run(argv, env=cluster_env, capture_output=True,
+                           text=True, timeout=300)
+        rates = {}
+        for phase in ("write", "read"):
+            mo = _re.search(rf"{phase}: .* = (\d+) req/s", p.stdout)
+            if mo:
+                rates[phase] = float(mo.group(1))
+        if p.returncode != 0 or len(rates) != 2:
+            # a dead server / failed client must surface as an
+            # error_cluster marker, not a fake 0.0 measurement
+            tail = (p.stderr or p.stdout).strip().splitlines()
+            raise RuntimeError(
+                f"benchmark rc={p.returncode}: "
+                f"{tail[-1][:200] if tail else 'no output'}")
+        return rates
+
     def meas_cluster():
         """Cluster microbench with REAL process separation: master and
         volume server run as their own processes and the load generator
@@ -312,37 +335,32 @@ def _child(scratch_path: str, platform: str = "") -> None:
         On a 1-core host this measures the same as in-process; on the
         many-core TPU host it measures actual server capacity."""
         with spawn_cluster(1) as (mport, _root):
-            def run_bench(n, use_tcp):
-                argv = [sys.executable, weed_py, "benchmark",
-                        "-master", f"127.0.0.1:{mport}",
-                        "-n", str(n), "-c", "16", "-size", "1024"]
-                if use_tcp:
-                    argv.append("-useTcp")
-                p = subprocess.run(argv, env=cluster_env,
-                                   capture_output=True, text=True,
-                                   timeout=300)
-                rates = {}
-                for phase in ("write", "read"):
-                    mo = _re.search(rf"{phase}: .* = (\d+) req/s", p.stdout)
-                    if mo:
-                        rates[phase] = float(mo.group(1))
-                if p.returncode != 0 or len(rates) != 2:
-                    # a dead server / failed client must surface as an
-                    # error_cluster marker, not a fake 0.0 measurement
-                    tail = (p.stderr or p.stdout).strip().splitlines()
-                    raise RuntimeError(
-                        f"benchmark rc={p.returncode}: "
-                        f"{tail[-1][:200] if tail else 'no output'}")
-                return rates
-
-            http_rates = run_bench(4000, use_tcp=False)
+            http_rates = run_bench(mport, 4000, use_tcp=False)
             detail["cluster_write_rps"] = http_rates.get("write", 0.0)
             detail["cluster_read_rps"] = http_rates.get("read", 0.0)
-            tcp_rates = run_bench(4000, use_tcp=True)
+            tcp_rates = run_bench(mport, 4000, use_tcp=True)
             detail["cluster_tcp_write_rps"] = tcp_rates.get("write", 0.0)
             detail["cluster_tcp_read_rps"] = tcp_rates.get("read", 0.0)
 
     section("cluster", meas_cluster)
+
+    # --- native C++ data plane (GIL-free needle IO) -------------------------
+    def meas_cluster_native():
+        """Same single-server shape, with the volume server's needle IO
+        served by the C++ data plane (native/dataplane.cpp) — the
+        rebuild's production fast path for the reference's -useTcp
+        experiment."""
+        from seaweedfs_tpu.volume_server.dataplane import load_dataplane
+
+        if load_dataplane() is None:
+            detail["cluster_native_skipped"] = "no C++ toolchain"
+            return
+        with spawn_cluster(1, ("-dataplane", "native")) as (mport, _root):
+            rates = run_bench(mport, 4000, use_tcp=True)
+            detail["cluster_native_tcp_write_rps"] = rates.get("write", 0.0)
+            detail["cluster_native_tcp_read_rps"] = rates.get("read", 0.0)
+
+    section("cluster_native", meas_cluster_native)
 
     # --- scaled cluster: N volume servers, M client procs ------------------
     def meas_cluster_scaled():
@@ -358,8 +376,12 @@ def _child(scratch_path: str, platform: str = "") -> None:
         n_vols = max(2, min(6, cores // 4))
         n_clients = max(2, min(6, cores // 4))
         per_client = 4000
+        from seaweedfs_tpu.volume_server.dataplane import load_dataplane
 
-        with spawn_cluster(n_vols) as (mport, root):
+        native = load_dataplane() is not None
+        extra = ("-dataplane", "native") if native else ()
+
+        with spawn_cluster(n_vols, extra) as (mport, root):
             def phase_rate(phase, use_tcp):
                 """Run n_clients aligned single-phase benchmarks; their
                 rates sum (all started together, same op count each)."""
@@ -396,7 +418,8 @@ def _child(scratch_path: str, platform: str = "") -> None:
 
             detail["cluster_scaled_config"] = (
                 f"{n_vols} volume servers, {n_clients} clients, "
-                f"{cores} cores")
+                f"{cores} cores, "
+                f"{'native' if native else 'python'} data plane")
             detail["cluster_scaled_tcp_write_rps"] = phase_rate(
                 "write", use_tcp=True)
             detail["cluster_scaled_tcp_read_rps"] = phase_rate(

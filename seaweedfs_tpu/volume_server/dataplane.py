@@ -1,0 +1,216 @@
+"""ctypes wrapper for the native C++ data plane (native/dataplane.cpp).
+
+The volume server's framed-TCP needle IO served GIL-free by C++ threads,
+with the Python Store routing its own needle ops through the same engine
+so there is exactly ONE writer per volume.  Quiesce protocol: maintenance
+(vacuum, EC encode, copy, tier) calls Store.native_quiesced(vid), which
+detaches the volume from the plane, reopens the Python Volume (full idx
+replay, so its needle map sees everything the plane appended), runs the
+operation, and re-attaches.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                    "native")
+_LIB_PATH = os.path.join(_DIR, "libdataplane.so")
+
+# error codes (dataplane.cpp enum)
+DP_OK = 0
+DP_NOT_FOUND = -2
+DP_COOKIE = -3
+DP_DELETED = -4
+DP_READONLY = -5
+DP_NO_VOLUME = -6
+DP_IO = -7
+DP_CRC = -8
+
+_lib = None
+_lib_lock = threading.Lock()
+
+
+def load_dataplane():
+    """Build (if stale) + load the library; None when unavailable."""
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        src = os.path.join(_DIR, "dataplane.cpp")
+        if not os.path.exists(_LIB_PATH) or (
+                os.path.exists(src)
+                and os.path.getmtime(src) > os.path.getmtime(_LIB_PATH)):
+            try:
+                subprocess.run(["make", "-s", "-C", _DIR], check=True,
+                               capture_output=True, timeout=120)
+            except Exception:
+                return None
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+        except OSError:
+            return None
+        u8p = ctypes.POINTER(ctypes.c_ubyte)
+        lib.dp_start.restype = ctypes.c_void_p
+        lib.dp_start.argtypes = [ctypes.c_char_p, ctypes.c_int]
+        lib.dp_port.argtypes = [ctypes.c_void_p]
+        lib.dp_add_volume.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint, ctypes.c_char_p,
+            ctypes.c_char_p, ctypes.c_int]
+        lib.dp_remove_volume.argtypes = [ctypes.c_void_p, ctypes.c_uint]
+        lib.dp_write.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint, ctypes.c_ulonglong,
+            ctypes.c_uint, u8p, ctypes.c_uint,
+            ctypes.POINTER(ctypes.c_uint)]
+        lib.dp_append.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint, ctypes.c_ulonglong,
+            ctypes.c_uint, u8p, ctypes.c_ulonglong, ctypes.c_int]
+        lib.dp_delete.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint, ctypes.c_ulonglong,
+            ctypes.c_uint, ctypes.POINTER(ctypes.c_uint)]
+        lib.dp_read.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint, ctypes.c_ulonglong,
+            ctypes.c_uint, ctypes.POINTER(u8p),
+            ctypes.POINTER(ctypes.c_uint)]
+        lib.dp_read_record.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint, ctypes.c_ulonglong,
+            ctypes.c_uint, ctypes.c_int, ctypes.POINTER(u8p),
+            ctypes.POINTER(ctypes.c_ulonglong),
+            ctypes.POINTER(ctypes.c_int)]
+        lib.dp_free.argtypes = [ctypes.c_void_p]
+        lib.dp_stat.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint,
+            ctypes.POINTER(ctypes.c_ulonglong),
+            ctypes.POINTER(ctypes.c_ulonglong),
+            ctypes.POINTER(ctypes.c_ulonglong)]
+        lib.dp_sync.argtypes = [ctypes.c_void_p, ctypes.c_uint]
+        lib.dp_stop.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return lib
+
+
+class DataPlaneError(OSError):
+    def __init__(self, code: int, message: str):
+        super().__init__(message)
+        self.code = code
+
+
+def _raise(code: int, context: str = ""):
+    from ..storage.volume import (CookieMismatchError, DeletedError,
+                                  NotFoundError)
+
+    if code == DP_NOT_FOUND:
+        raise NotFoundError(context)
+    if code == DP_COOKIE:
+        raise CookieMismatchError(context)
+    if code == DP_DELETED:
+        raise DeletedError(context)
+    if code == DP_READONLY:
+        raise PermissionError(f"volume is read only {context}")
+    raise DataPlaneError(code, f"data plane error {code} {context}")
+
+
+class NativeDataPlane:
+    """One running C++ server + its registered volumes."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        lib = load_dataplane()
+        if lib is None:
+            raise RuntimeError("native data plane unavailable (no toolchain)")
+        self._lib = lib
+        self._h = lib.dp_start(host.encode(), port)
+        if not self._h:
+            raise RuntimeError(f"data plane could not bind {host}:{port}")
+        self.port = lib.dp_port(self._h)
+        self.vids: set[int] = set()
+        self._lock = threading.Lock()
+
+    def add_volume(self, vid: int, dat_path: str, idx_path: str,
+                   read_only: bool = False) -> None:
+        rc = self._lib.dp_add_volume(
+            self._h, vid, dat_path.encode(), idx_path.encode(),
+            1 if read_only else 0)
+        if rc != DP_OK:
+            _raise(rc, f"add_volume {vid}")
+        with self._lock:
+            self.vids.add(vid)
+
+    def remove_volume(self, vid: int) -> None:
+        with self._lock:
+            self.vids.discard(vid)
+        self._lib.dp_remove_volume(self._h, vid)
+
+    def has(self, vid: int) -> bool:
+        return vid in self.vids
+
+    def append(self, vid: int, key: int, cookie: int, record: bytes,
+               size: int) -> None:
+        buf = (ctypes.c_ubyte * len(record)).from_buffer_copy(record)
+        rc = self._lib.dp_append(self._h, vid, key, cookie, buf,
+                                 len(record), size)
+        if rc != DP_OK:
+            _raise(rc, f"append {vid},{key:x}")
+
+    def write(self, vid: int, key: int, cookie: int, data: bytes) -> int:
+        out = ctypes.c_uint()
+        buf = (ctypes.c_ubyte * len(data)).from_buffer_copy(data)
+        rc = self._lib.dp_write(self._h, vid, key, cookie, buf, len(data),
+                                ctypes.byref(out))
+        if rc != DP_OK:
+            _raise(rc, f"write {vid},{key:x}")
+        return out.value
+
+    def delete(self, vid: int, key: int, cookie: int) -> int:
+        out = ctypes.c_uint()
+        rc = self._lib.dp_delete(self._h, vid, key, cookie,
+                                 ctypes.byref(out))
+        if rc != DP_OK:
+            _raise(rc, f"delete {vid},{key:x}")
+        return out.value
+
+    def read_record(self, vid: int, key: int,
+                    cookie: Optional[int]) -> tuple[bytes, int]:
+        """(raw record bytes, stored size) — parse with Needle.from_bytes.
+        cookie=None skips the cookie check (read_needle semantics)."""
+        u8p = ctypes.POINTER(ctypes.c_ubyte)
+        out = u8p()
+        out_len = ctypes.c_ulonglong()
+        out_size = ctypes.c_int()
+        rc = self._lib.dp_read_record(self._h, vid, key, cookie or 0,
+                                      0 if cookie is None else 1,
+                                      ctypes.byref(out),
+                                      ctypes.byref(out_len),
+                                      ctypes.byref(out_size))
+        if rc != DP_OK:
+            _raise(rc, f"read {vid},{key:x}")
+        try:
+            blob = ctypes.string_at(out, out_len.value)
+        finally:
+            self._lib.dp_free(out)
+        return blob, out_size.value
+
+    def stat(self, vid: int) -> Optional[tuple[int, int, int]]:
+        """(dat_size, live file_count, max_file_key), or None if the
+        volume is not registered."""
+        ds = ctypes.c_ulonglong()
+        fc = ctypes.c_ulonglong()
+        mk = ctypes.c_ulonglong()
+        rc = self._lib.dp_stat(self._h, vid, ctypes.byref(ds),
+                               ctypes.byref(fc), ctypes.byref(mk))
+        if rc != DP_OK:
+            return None
+        return ds.value, fc.value, mk.value
+
+    def sync(self, vid: int) -> None:
+        rc = self._lib.dp_sync(self._h, vid)
+        if rc != DP_OK:
+            _raise(rc, f"sync {vid}")
+
+    def stop(self) -> None:
+        if self._h:
+            self._lib.dp_stop(self._h)
+            self._h = None

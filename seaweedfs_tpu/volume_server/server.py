@@ -58,7 +58,8 @@ class VolumeServer:
                  backends: Optional[dict] = None,
                  full_sync_every: int = 12,
                  tls_context=None,
-                 tcp: bool = True, use_mmap: bool = False):
+                 tcp: bool = True, use_mmap: bool = False,
+                 dataplane: str = "python"):
         from ..security import Guard
 
         if backends:
@@ -92,6 +93,10 @@ class VolumeServer:
         self.vid_cache_ttl = 10.0
         self._tcp_enabled = tcp
         self._tcp_server = None
+        # "native": the C++ data plane owns the framed-TCP port and every
+        # registered volume's needle IO (native/dataplane.cpp)
+        self.dataplane = dataplane
+        self._native_plane = None
 
     @property
     def url(self) -> str:
@@ -108,14 +113,26 @@ class VolumeServer:
         if self._tcp_enabled and not self.guard.signing_key \
                 and not self.guard.read_signing_key \
                 and self._tls_context is None:
-            from .tcp import TcpVolumeServer
+            if self.dataplane == "native":
+                # the C++ plane binds the TCP port itself and the store
+                # funnels needle ops through it; TCP writes are local-only
+                # (like the reference's -useTcp experiment), so use it
+                # with replication 000 or HTTP-plane writes
+                from ..utils.framing import tcp_port_for
+                from .dataplane import NativeDataPlane
 
-            self._tcp_server = TcpVolumeServer(
-                self.store, self.store.ip,
-                whitelist_ok=(self.guard.check_white_list
-                              if self.guard.is_write_active else None),
-                replicate_write=self._tcp_replicate_write,
-                replicate_delete=self._tcp_replicate_delete).start()
+                self._native_plane = NativeDataPlane(
+                    self.store.ip, tcp_port_for(self.store.port))
+                self.store.attach_native_plane(self._native_plane)
+            else:
+                from .tcp import TcpVolumeServer
+
+                self._tcp_server = TcpVolumeServer(
+                    self.store, self.store.ip,
+                    whitelist_ok=(self.guard.check_white_list
+                                  if self.guard.is_write_active else None),
+                    replicate_write=self._tcp_replicate_write,
+                    replicate_delete=self._tcp_replicate_delete).start()
         threading.Thread(target=self._heartbeat_loop, daemon=True,
                          name=f"heartbeat:{self.url}").start()
         return self
@@ -124,6 +141,9 @@ class VolumeServer:
         self._stop.set()
         if self._tcp_server is not None:
             self._tcp_server.stop()
+        if self._native_plane is not None:
+            self._native_plane.stop()
+            self._native_plane = None
         if self._server:
             from ..utils.httpd import stop_server
 
@@ -314,6 +334,12 @@ class VolumeServer:
         from ..storage.types import Version
         from ..utils.httpd import UNSATISFIABLE_RANGE, parse_range
 
+        if self.store.native_plane is not None \
+                and self.store.native_plane.has(fid.volume_id):
+            # the Python volume's needle map is stale while the native
+            # plane owns the volume: fall back to the full-read path,
+            # which routes through the plane and slices host-side
+            return None
         v = self.store.volumes[fid.volume_id]
         if v.version == Version.V1:
             return None
@@ -641,6 +667,12 @@ class VolumeServer:
             # writable-set change must reach the master within one pulse,
             # not wait for the next periodic full sync
             self.store.note_volume_change(vid)
+            # refresh the native plane's read_only flag (re-registering
+            # replays the idx, so this is also a consistency point)
+            if self.store.native_plane is not None \
+                    and self.store.native_plane.has(vid):
+                self.store.native_detach(vid)
+                self.store.native_reattach(vid)
             return Response({})
 
         # --- admin: vacuum -------------------------------------------
@@ -652,6 +684,10 @@ class VolumeServer:
         @r.route("POST", "/admin/vacuum_compact")
         def vacuum_compact(req: Request) -> Response:
             vid = int(req.json()["volume_id"])
+            # quiesce the native plane for the whole compact->commit
+            # window; writes fall back to the reopened Python engine so
+            # the makeup-diff replay sees them
+            self.store.native_detach(vid)
             with self.store.volume_locks[vid]:
                 self.store.get_volume(vid).compact()
             return Response({})
@@ -661,12 +697,14 @@ class VolumeServer:
             vid = int(req.json()["volume_id"])
             with self.store.volume_locks[vid]:
                 self.store.get_volume(vid).commit_compact()
+            self.store.native_reattach(vid)
             return Response({})
 
         @r.route("POST", "/admin/vacuum_cleanup")
         def vacuum_cleanup(req: Request) -> Response:
             vid = int(req.json()["volume_id"])
             self.store.get_volume(vid).cleanup_compact()
+            self.store.native_reattach(vid)
             return Response({})
 
         # --- admin: volume copy/move (volume_grpc_copy.go) -------------
@@ -797,6 +835,7 @@ class VolumeServer:
             """VolumeTierMoveDatToRemote (volume_grpc_tier_upload.go)."""
             b = req.json()
             vid = int(b["volume_id"])
+            self.store.native_detach(vid)  # tiered .dat leaves the plane
             try:
                 v = self.store.get_volume(vid)
             except KeyError:
@@ -816,6 +855,7 @@ class VolumeServer:
                 raise HttpError(404, f"volume {vid} not found")
             with self.store.volume_locks[vid]:
                 v.tier_download()
+            self.store.native_reattach(vid)  # local .dat again
             return Response({})
 
         @r.route("POST", "/admin/configure_replication")

@@ -85,6 +85,9 @@ class Store:
         self.ec_engine_name = ec_engine
         # mmap-backed .dat files (-memoryMapSizeMB analog, backend/memory_map)
         self.use_mmap = use_mmap
+        # native C++ data plane (native/dataplane.cpp): when attached, it
+        # is the single writer/reader for registered volumes' needles
+        self.native_plane = None
         self._rs_cache: dict[str, ReedSolomon] = {}
         # delta-heartbeat bookkeeping (volume_grpc_client_to_master.go:48
         # streams incremental new/deleted volume + EC-shard lists between
@@ -177,7 +180,7 @@ class Store:
                 gone_vids.add(vid)
             else:
                 try:
-                    new_volumes.append(v.to_volume_information())
+                    new_volumes.append(self._volume_info(v))
                 except Exception:
                     # mid-compaction-commit swap window (closed .dat):
                     # re-queue for the next pulse instead of crashing
@@ -225,9 +228,12 @@ class Store:
                    use_mmap=self.use_mmap)
         self.volumes[vid] = v
         self.volume_locks[vid] = threading.RLock()
+        self._native_add(vid, v)
         return v
 
     def delete_volume(self, vid: int) -> None:
+        if self.native_plane is not None:
+            self.native_plane.remove_volume(vid)
         v = self.volumes.pop(vid, None)
         self.volume_locks.pop(vid, None)
         if v is not None:
@@ -235,6 +241,8 @@ class Store:
             self.note_volume_change(vid, gone=True)
 
     def unmount_volume(self, vid: int) -> None:
+        if self.native_plane is not None:
+            self.native_plane.remove_volume(vid)
         v = self.volumes.pop(vid, None)
         self.volume_locks.pop(vid, None)
         if v is not None:
@@ -245,7 +253,8 @@ class Store:
         for loc in self.locations:
             for collection, found_vid in loc.discover_volumes():
                 if found_vid == vid:
-                    self._open_volume(loc.directory, collection, vid)
+                    v = self._open_volume(loc.directory, collection, vid)
+                    self._native_add(vid, v)
                     return
         raise KeyError(f"volume {vid} not found on disk")
 
@@ -255,9 +264,91 @@ class Store:
             raise KeyError(f"volume {vid} not found")
         return v
 
+    # --- native data plane (native/dataplane.cpp) -------------------------
+    def attach_native_plane(self, plane) -> None:
+        """Register every eligible volume; from here every needle op on
+        those volumes funnels through the C++ engine (single writer)."""
+        self.native_plane = plane
+        for vid, v in self.volumes.items():
+            self._native_add(vid, v)
+
+    def _native_add(self, vid: int, v: Volume) -> None:
+        if self.native_plane is None or v.tiered or v.version != Version.V3:
+            return
+        self.native_plane.add_volume(vid, v.dat_path, v.idx_path,
+                                     read_only=v.read_only)
+
+    def native_detach(self, vid: int) -> None:
+        """Quiesce: unregister from the plane and REOPEN the Python volume
+        so its needle map replays everything the plane appended.  Needle
+        ops fall back to the Python engine until native_reattach."""
+        plane = self.native_plane
+        if plane is None or not plane.has(vid):
+            return
+        plane.remove_volume(vid)
+        v = self.volumes.get(vid)
+        if v is None:
+            return
+        with self.volume_locks[vid]:
+            directory, collection, ro = v.directory, v.collection, v.read_only
+            v.close()
+            v2 = Volume(directory, collection, vid,
+                        volume_size_limit=self.volume_size_limit,
+                        use_mmap=self.use_mmap)
+            v2.read_only = ro
+            self.volumes[vid] = v2
+
+    def native_reattach(self, vid: int) -> None:
+        v = self.volumes.get(vid)
+        if v is not None and self.native_plane is not None \
+                and not self.native_plane.has(vid):
+            self._native_add(vid, v)
+
+    def native_quiesced(self, vid: int):
+        """Context manager around maintenance that touches volume files."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def _ctx():
+            self.native_detach(vid)
+            try:
+                yield
+            finally:
+                self.native_reattach(vid)
+
+        return _ctx()
+
     # --- needle ops (store.go:338,362) ------------------------------------
+    @staticmethod
+    def _plane_gone(exc) -> bool:
+        # True when the plane dropped the volume between has() and the
+        # call (quiesce race): fall back to the Python engine
+        from .dataplane import DP_NO_VOLUME, DataPlaneError
+
+        return isinstance(exc, DataPlaneError) and exc.code == DP_NO_VOLUME
+
     def write_needle(self, vid: int, n: Needle, fsync: bool = False) -> tuple[int, bool]:
         v = self.get_volume(vid)
+        plane = self.native_plane
+        if plane is not None and plane.has(vid):
+            # single-writer funnel: Python serializes (rich needles keep
+            # name/mime/flags/cipher), C++ appends under its volume lock.
+            # Divergence from the Python path: no unchanged-write dedupe.
+            import time as _time
+
+            if not n.append_at_ns:
+                n.append_at_ns = _time.time_ns()
+            blob = n.to_bytes(v.version)
+            try:
+                plane.append(vid, n.id, n.cookie, blob, n.size)
+                if fsync:
+                    plane.sync(vid)
+                self.note_volume_change(vid)
+                return n.size, False
+            except OSError as e:
+                if not self._plane_gone(e):
+                    raise
+                v = self.get_volume(vid)  # reopened by native_detach
         if fsync:
             # group-commit worker (volume_write.py): the store lock is NOT
             # held while waiting, so concurrent fsync writers batch into one
@@ -272,6 +363,17 @@ class Store:
         return size, unchanged
 
     def delete_needle(self, vid: int, n: Needle, fsync: bool = False) -> int:
+        plane = self.native_plane
+        if plane is not None and plane.has(vid):
+            try:
+                size = plane.delete(vid, n.id, n.cookie)
+                if fsync:
+                    plane.sync(vid)
+                self.note_volume_change(vid)
+                return size
+            except OSError as e:
+                if not self._plane_gone(e):
+                    raise
         v = self.get_volume(vid)
         if fsync:
             size = v.delete_needle2(n, fsync=True)
@@ -282,12 +384,24 @@ class Store:
         return size
 
     def read_needle(self, vid: int, key: int, cookie: Optional[int] = None) -> Needle:
+        plane = self.native_plane
+        if plane is not None and plane.has(vid):
+            try:
+                v = self.get_volume(vid)
+                blob, size = plane.read_record(vid, key, cookie)
+                return Needle.from_bytes(blob, size, v.version)
+            except OSError as e:
+                if not self._plane_gone(e):
+                    raise
         return self.get_volume(vid).read_needle(key, cookie)
 
     # --- EC (store_ec.go + volume_grpc_erasure_coding.go backends) --------
     def ec_generate(self, vid: int, collection: str = "",
                     engine: Optional[str] = None) -> None:
         """VolumeEcShardsGenerate: .dat -> .ec00..13 + .ecx + mark readonly."""
+        # quiesce the native plane for the encode: writes fall back to the
+        # (reopened, idx-replayed) Python engine; reads keep working
+        self.native_detach(vid)
         v = self.get_volume(vid)
         base = v.file_prefix
         with self.volume_locks[vid]:
@@ -421,16 +535,33 @@ class Store:
         ec_encoder.write_idx_file_from_ec_index(base)
         self.ec_unmount(vid)
         directory = os.path.dirname(base)
-        self._open_volume(directory, collection, vid)
+        v = self._open_volume(directory, collection, vid)
+        self._native_add(vid, v)
 
     # --- heartbeat (store.go:216 CollectHeartbeat) ------------------------
+    def _volume_info(self, v: Volume) -> dict:
+        """to_volume_information with native-plane stats overlaid: while
+        the plane owns the volume, the Python map is stale — size,
+        file_count, and max_file_key (the master reseeds its sequencer
+        from it) must come from the plane."""
+        info = v.to_volume_information()
+        plane = self.native_plane
+        if plane is not None and plane.has(v.id):
+            st = plane.stat(v.id)
+            if st is not None:
+                dat_size, file_count, max_key = st
+                info["size"] = dat_size
+                info["file_count"] = max(info["file_count"], file_count)
+                info["max_file_key"] = max(info["max_file_key"], max_key)
+        return info
+
     def collect_heartbeat(self) -> dict:
         from ..master.topology import ShardBits
 
         volumes = []
         for v in list(self.volumes.values()):
             try:
-                volumes.append(v.to_volume_information())
+                volumes.append(self._volume_info(v))
             except Exception:
                 pass  # mid-swap (compaction/tier commit): next pulse
         ec_shards = []

@@ -66,7 +66,8 @@ def cmd_volume(args) -> None:
                       ec_engine=args.ec_engine,
                       guard=volume_guard(_security()),
                       tls_context=_cluster_tls(),
-                      use_mmap=args.mmap).start()
+                      use_mmap=args.mmap,
+                      dataplane=args.dataplane).start()
     print(f"volume server listening on {vs.url}, dirs {args.dir}")
     _on_interrupt(vs.stop)
     _wait_forever()
@@ -789,6 +790,9 @@ def main(argv=None) -> None:
                    choices=["cpu", "tpu"])
     v.add_argument("-mmap", action="store_true",
                    help="mmap-backed .dat files (backend/memory_map analog)")
+    v.add_argument("-dataplane", default="python",
+                   choices=["python", "native"],
+                   help="native: C++ GIL-free framed-TCP needle IO")
     v.set_defaults(fn=cmd_volume)
 
     s = sub.add_parser("server")
