@@ -44,6 +44,7 @@
 #include <string>
 #include <sys/socket.h>
 #include <sys/stat.h>
+#include <atomic>
 #include <thread>
 #include <unistd.h>
 #include <unordered_map>
@@ -132,6 +133,7 @@ struct Volume {
     int idx_fd = -1;
     uint64_t dat_size = 0;   // append offset
     uint64_t max_key = 0;    // highest needle id seen (heartbeat reseed)
+    uint64_t deleted_bytes = 0;  // stored sizes of dead needles (vacuum)
     bool read_only = false;
     bool retired = false;    // set under write_mu by dp_remove_volume
     std::unordered_map<uint64_t, NeedleVal> map;
@@ -176,9 +178,13 @@ struct Server {
     std::thread accept_thread;
     std::mutex vol_mu;
     std::unordered_map<uint32_t, VolumeRef> volumes;
+    struct ConnThread {
+        std::thread t;
+        std::shared_ptr<std::atomic<bool>> done;
+    };
     std::mutex conn_mu;
     std::unordered_set<int> conns;
-    std::vector<std::thread> conn_threads;  // joined in dp_stop
+    std::vector<ConnThread> conn_threads;  // reaped on accept + dp_stop
     volatile bool stopping = false;
 };
 
@@ -256,6 +262,9 @@ static int vol_write(Volume* v, uint64_t id, uint32_t cookie,
     if (id > v->max_key) v->max_key = id;
     {
         std::lock_guard<std::mutex> m(v->map_mu);
+        auto it = v->map.find(id);
+        if (it != v->map.end() && it->second.size >= 0)
+            v->deleted_bytes += (uint64_t)it->second.size;  // overwritten
         v->map[id] = NeedleVal{off, size};
     }
     *out_size = (uint32_t)size;
@@ -299,6 +308,7 @@ static int vol_delete(Volume* v, uint64_t id, uint32_t cookie,
     put_u32(ie + 12, (uint32_t)TOMBSTONE);
     if (write(v->idx_fd, ie, 16) != 16) return DP_IO;
     v->dat_size = off + rec_len;
+    v->deleted_bytes += (uint64_t)nv.size;
     {
         std::lock_guard<std::mutex> m(v->map_mu);
         v->map.erase(id);
@@ -432,7 +442,8 @@ static bool send_frame(int fd, uint8_t status, const uint8_t* payload,
     return n == 0 || send_all(fd, payload, n);
 }
 
-static void serve_conn(Server* s, int fd) {
+static void serve_conn(Server* s, int fd,
+                       std::shared_ptr<std::atomic<bool>> done) {
     int one = 1;
     setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
     std::vector<uint8_t> body;
@@ -483,8 +494,11 @@ static void serve_conn(Server* s, int fd) {
         if (!ok) break;
     }
     close(fd);
-    std::lock_guard<std::mutex> g(s->conn_mu);
-    s->conns.erase(fd);
+    {
+        std::lock_guard<std::mutex> g(s->conn_mu);
+        s->conns.erase(fd);
+    }
+    done->store(true);
 }
 
 static void accept_loop(Server* s) {
@@ -497,8 +511,22 @@ static void accept_loop(Server* s) {
         }
         {
             std::lock_guard<std::mutex> g(s->conn_mu);
+            // reap finished connection threads so the registry stays
+            // bounded by the number of LIVE connections
+            for (auto it = s->conn_threads.begin();
+                 it != s->conn_threads.end();) {
+                if (it->done->load()) {
+                    it->t.join();
+                    it = s->conn_threads.erase(it);
+                } else {
+                    ++it;
+                }
+            }
             s->conns.insert(fd);
-            s->conn_threads.emplace_back(serve_conn, s, fd);
+            auto done = std::make_shared<std::atomic<bool>>(false);
+            s->conn_threads.push_back(
+                Server::ConnThread{std::thread(serve_conn, s, fd, done),
+                                   done});
         }
     }
 }
@@ -568,6 +596,9 @@ int dp_add_volume(void* h, unsigned vid, const char* dat_path,
             uint64_t off = (uint64_t)get_u32(e + 8) * 8;
             int32_t size = (int32_t)get_u32(e + 12);
             if (key > v->max_key) v->max_key = key;
+            auto old = v->map.find(key);
+            if (old != v->map.end() && old->second.size >= 0)
+                v->deleted_bytes += (uint64_t)old->second.size;
             if (off != 0 && size >= 0)
                 v->map[key] = NeedleVal{off, size};
             else
@@ -650,6 +681,9 @@ int dp_append(void* h, unsigned vid, unsigned long long id, unsigned cookie,
     if (id > v->max_key) v->max_key = id;
     {
         std::lock_guard<std::mutex> m(v->map_mu);
+        auto it = v->map.find(id);
+        if (it != v->map.end() && it->second.size >= 0)
+            v->deleted_bytes += (uint64_t)it->second.size;
         if (size >= 0)
             v->map[id] = NeedleVal{off, size};
         else
@@ -716,11 +750,13 @@ void dp_free(void* p) { free(p); }
 
 int dp_stat(void* h, unsigned vid, unsigned long long* dat_size,
             unsigned long long* file_count,
-            unsigned long long* max_file_key) {
+            unsigned long long* max_file_key,
+            unsigned long long* deleted_bytes) {
     VolumeRef v = find_volume((Server*)h, vid);
     if (!v) return DP_NO_VOLUME;
     *dat_size = v->dat_size;
     *max_file_key = v->max_key;
+    *deleted_bytes = v->deleted_bytes;
     std::lock_guard<std::mutex> m(v->map_mu);
     *file_count = v->map.size();
     return DP_OK;
@@ -745,20 +781,28 @@ void dp_stop(void* h) {
         for (int fd : s->conns) shutdown(fd, SHUT_RDWR);
     }
     if (s->accept_thread.joinable()) s->accept_thread.join();
-    // join every connection thread before tearing the Server down: a
-    // fixed sleep would race a thread still in its epilogue
-    std::vector<std::thread> threads;
+    // a connection accepted in the shutdown window is only registered
+    // AFTER the first pass above; with the accept thread joined the
+    // registry is final, so one more pass closes any straggler
+    {
+        std::lock_guard<std::mutex> g(s->conn_mu);
+        for (int fd : s->conns) shutdown(fd, SHUT_RDWR);
+    }
+    std::vector<Server::ConnThread> threads;
     {
         std::lock_guard<std::mutex> g(s->conn_mu);
         threads.swap(s->conn_threads);
     }
-    for (auto& t : threads)
-        if (t.joinable()) t.join();
+    for (auto& ct : threads)
+        if (ct.t.joinable()) ct.t.join();
     {
         std::lock_guard<std::mutex> g(s->vol_mu);
         s->volumes.clear();  // shared_ptr closes fds on release
     }
-    delete s;
+    // the Server shell itself is intentionally NOT freed: a Python
+    // thread that raced stop() may still hold the handle, and dp_* on a
+    // drained Server safely answers DP_NO_VOLUME (a few hundred bytes
+    // leak once per plane, at process teardown in practice)
 }
 
 }  // extern "C"

@@ -86,6 +86,7 @@ def load_dataplane():
             ctypes.c_void_p, ctypes.c_uint,
             ctypes.POINTER(ctypes.c_ulonglong),
             ctypes.POINTER(ctypes.c_ulonglong),
+            ctypes.POINTER(ctypes.c_ulonglong),
             ctypes.POINTER(ctypes.c_ulonglong)]
         lib.dp_sync.argtypes = [ctypes.c_void_p, ctypes.c_uint]
         lib.dp_stop.argtypes = [ctypes.c_void_p]
@@ -147,10 +148,16 @@ class NativeDataPlane:
     def has(self, vid: int) -> bool:
         return vid in self.vids
 
+    def _handle(self):
+        h = self._h
+        if h is None:  # stopped: report "not mine" so callers fall back
+            raise DataPlaneError(DP_NO_VOLUME, "data plane stopped")
+        return h
+
     def append(self, vid: int, key: int, cookie: int, record: bytes,
                size: int) -> None:
         buf = (ctypes.c_ubyte * len(record)).from_buffer_copy(record)
-        rc = self._lib.dp_append(self._h, vid, key, cookie, buf,
+        rc = self._lib.dp_append(self._handle(), vid, key, cookie, buf,
                                  len(record), size)
         if rc != DP_OK:
             _raise(rc, f"append {vid},{key:x}")
@@ -158,7 +165,7 @@ class NativeDataPlane:
     def write(self, vid: int, key: int, cookie: int, data: bytes) -> int:
         out = ctypes.c_uint()
         buf = (ctypes.c_ubyte * len(data)).from_buffer_copy(data)
-        rc = self._lib.dp_write(self._h, vid, key, cookie, buf, len(data),
+        rc = self._lib.dp_write(self._handle(), vid, key, cookie, buf, len(data),
                                 ctypes.byref(out))
         if rc != DP_OK:
             _raise(rc, f"write {vid},{key:x}")
@@ -166,7 +173,7 @@ class NativeDataPlane:
 
     def delete(self, vid: int, key: int, cookie: int) -> int:
         out = ctypes.c_uint()
-        rc = self._lib.dp_delete(self._h, vid, key, cookie,
+        rc = self._lib.dp_delete(self._handle(), vid, key, cookie,
                                  ctypes.byref(out))
         if rc != DP_OK:
             _raise(rc, f"delete {vid},{key:x}")
@@ -180,7 +187,7 @@ class NativeDataPlane:
         out = u8p()
         out_len = ctypes.c_ulonglong()
         out_size = ctypes.c_int()
-        rc = self._lib.dp_read_record(self._h, vid, key, cookie or 0,
+        rc = self._lib.dp_read_record(self._handle(), vid, key, cookie or 0,
                                       0 if cookie is None else 1,
                                       ctypes.byref(out),
                                       ctypes.byref(out_len),
@@ -193,20 +200,24 @@ class NativeDataPlane:
             self._lib.dp_free(out)
         return blob, out_size.value
 
-    def stat(self, vid: int) -> Optional[tuple[int, int, int]]:
-        """(dat_size, live file_count, max_file_key), or None if the
-        volume is not registered."""
+    def stat(self, vid: int) -> Optional[tuple[int, int, int, int]]:
+        """(dat_size, live file_count, max_file_key, deleted_bytes), or
+        None if the volume is not registered."""
+        if self._h is None:
+            return None
         ds = ctypes.c_ulonglong()
         fc = ctypes.c_ulonglong()
         mk = ctypes.c_ulonglong()
+        db = ctypes.c_ulonglong()
         rc = self._lib.dp_stat(self._h, vid, ctypes.byref(ds),
-                               ctypes.byref(fc), ctypes.byref(mk))
+                               ctypes.byref(fc), ctypes.byref(mk),
+                               ctypes.byref(db))
         if rc != DP_OK:
             return None
-        return ds.value, fc.value, mk.value
+        return ds.value, fc.value, mk.value, db.value
 
     def sync(self, vid: int) -> None:
-        rc = self._lib.dp_sync(self._h, vid)
+        rc = self._lib.dp_sync(self._handle(), vid)
         if rc != DP_OK:
             _raise(rc, f"sync {vid}")
 

@@ -141,13 +141,17 @@ class VolumeServer:
         self._stop.set()
         if self._tcp_server is not None:
             self._tcp_server.stop()
-        if self._native_plane is not None:
-            self._native_plane.stop()
-            self._native_plane = None
         if self._server:
             from ..utils.httpd import stop_server
 
             stop_server(self._server)
+        if self._native_plane is not None:
+            # unroute BEFORE tearing the plane down: an in-flight handler
+            # that already passed has(vid) hits a drained (never freed)
+            # C++ server and falls back to the Python engine
+            self.store.native_plane = None
+            self._native_plane.stop()
+            self._native_plane = None
         self.store.close()
 
     def _heartbeat_loop(self) -> None:
@@ -678,7 +682,18 @@ class VolumeServer:
         # --- admin: vacuum -------------------------------------------
         @r.route("POST", "/admin/vacuum_check")
         def vacuum_check(req: Request) -> Response:
-            v = self.store.get_volume(int(req.json()["volume_id"]))
+            vid = int(req.json()["volume_id"])
+            v = self.store.get_volume(vid)
+            plane = self.store.native_plane
+            if plane is not None and plane.has(vid):
+                # the Python map's deletion counters are frozen while the
+                # plane owns the volume; its own counters know the truth
+                st = plane.stat(vid)
+                if st is not None:
+                    dat_size, _fc, _mk, deleted_bytes = st
+                    return Response({"garbage_ratio":
+                                     deleted_bytes / dat_size
+                                     if dat_size else 0.0})
             return Response({"garbage_ratio": v.garbage_ratio()})
 
         @r.route("POST", "/admin/vacuum_compact")
@@ -688,8 +703,15 @@ class VolumeServer:
             # window; writes fall back to the reopened Python engine so
             # the makeup-diff replay sees them
             self.store.native_detach(vid)
-            with self.store.volume_locks[vid]:
-                self.store.get_volume(vid).compact()
+            try:
+                with self.store.volume_locks[vid]:
+                    self.store.get_volume(vid).compact()
+            except BaseException:
+                # a failed compact gets no commit/cleanup from the
+                # master: reattach here or the volume is stuck on the
+                # slow path until restart
+                self.store.native_reattach(vid)
+                raise
             return Response({})
 
         @r.route("POST", "/admin/vacuum_commit")
@@ -837,12 +859,17 @@ class VolumeServer:
             vid = int(b["volume_id"])
             self.store.native_detach(vid)  # tiered .dat leaves the plane
             try:
-                v = self.store.get_volume(vid)
-            except KeyError:
-                raise HttpError(404, f"volume {vid} not found")
-            with self.store.volume_locks[vid]:
-                remote = v.tier_upload(b["backend"],
-                                       keep_local=bool(b.get("keep_local")))
+                try:
+                    v = self.store.get_volume(vid)
+                except KeyError:
+                    raise HttpError(404, f"volume {vid} not found")
+                with self.store.volume_locks[vid]:
+                    remote = v.tier_upload(
+                        b["backend"], keep_local=bool(b.get("keep_local")))
+            finally:
+                # no-op when the upload succeeded (the volume is now
+                # tiered, which _native_add skips); a failure reattaches
+                self.store.native_reattach(vid)
             return Response({"remote": remote})
 
         @r.route("POST", "/admin/tier_download")

@@ -353,10 +353,13 @@ class Store:
             # group-commit worker (volume_write.py): the store lock is NOT
             # held while waiting, so concurrent fsync writers batch into one
             # fsync (writeNeedle2, volume_write.go:110-128)
-            _, size, unchanged = v.write_needle2(n, fsync=True)
+            _, size, unchanged = self.get_volume(vid).write_needle2(
+                n, fsync=True)
         else:
             with self.volume_locks[vid]:
-                _, size, unchanged = v.write_needle(n)
+                # refetch under the lock: native_detach swaps the volume
+                # object under this same lock
+                _, size, unchanged = self.get_volume(vid).write_needle(n)
         # stats changed: the next delta pulse refreshes this volume's
         # counters on the master (idle volumes cost nothing)
         self.note_volume_change(vid)
@@ -374,12 +377,11 @@ class Store:
             except OSError as e:
                 if not self._plane_gone(e):
                     raise
-        v = self.get_volume(vid)
         if fsync:
-            size = v.delete_needle2(n, fsync=True)
+            size = self.get_volume(vid).delete_needle2(n, fsync=True)
         else:
             with self.volume_locks[vid]:
-                size = v.delete_needle(n)
+                size = self.get_volume(vid).delete_needle(n)
         self.note_volume_change(vid)
         return size
 
@@ -549,10 +551,12 @@ class Store:
         if plane is not None and plane.has(v.id):
             st = plane.stat(v.id)
             if st is not None:
-                dat_size, file_count, max_key = st
+                dat_size, file_count, max_key, deleted_bytes = st
                 info["size"] = dat_size
                 info["file_count"] = max(info["file_count"], file_count)
                 info["max_file_key"] = max(info["max_file_key"], max_key)
+                info["deleted_byte_count"] = max(
+                    info["deleted_byte_count"], deleted_bytes)
         return info
 
     def collect_heartbeat(self) -> dict:

@@ -1,0 +1,102 @@
+"""In-process etcd v3 JSON-gateway double for EtcdStore tests.
+
+Implements the gateway subset the store uses — POST /v3/kv/put, /range,
+/deleterange with base64 keys/values, range_end interval semantics,
+KEY-ascending sort, and limit — over a sorted dict.  Semantics follow
+the etcd API docs; no auth, single revision counter.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+class MiniEtcd:
+    def __init__(self):
+        self.kv: dict[bytes, bytes] = {}
+        self.lock = threading.Lock()
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                pass
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length") or 0)
+                body = json.loads(self.rfile.read(n) or b"{}")
+                if self.path == "/v3/kv/put":
+                    resp = outer._put(body)
+                elif self.path == "/v3/kv/range":
+                    resp = outer._range(body)
+                elif self.path == "/v3/kv/deleterange":
+                    resp = outer._deleterange(body)
+                else:
+                    self.send_response(404)
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                    return
+                payload = json.dumps(resp).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+        self._srv = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self._srv.daemon_threads = True
+        self.port = self._srv.server_address[1]
+        threading.Thread(target=self._srv.serve_forever, daemon=True).start()
+
+    def stop(self) -> None:
+        self._srv.shutdown()
+        self._srv.server_close()
+
+    # -- ops ----------------------------------------------------------------
+    @staticmethod
+    def _interval(body):
+        key = base64.b64decode(body.get("key", ""))
+        end_s = body.get("range_end")
+        end = base64.b64decode(end_s) if end_s else None
+        return key, end
+
+    @staticmethod
+    def _in_range(k: bytes, key: bytes, end) -> bool:
+        if end is None:
+            return k == key
+        if end == b"\x00":  # "from key to end of keyspace"
+            return k >= key
+        return key <= k < end
+
+    def _put(self, body):
+        with self.lock:
+            self.kv[base64.b64decode(body["key"])] = \
+                base64.b64decode(body.get("value", ""))
+        return {"header": {}}
+
+    def _range(self, body):
+        key, end = self._interval(body)
+        limit = int(body.get("limit") or 0)
+        with self.lock:
+            ks = sorted(k for k in self.kv
+                        if self._in_range(k, key, end))
+        more = False
+        if limit and len(ks) > limit:
+            ks, more = ks[:limit], True
+        kvs = [{"key": base64.b64encode(k).decode(),
+                "value": base64.b64encode(self.kv[k]).decode()}
+               for k in ks]
+        return {"header": {}, "kvs": kvs, "more": more,
+                "count": str(len(kvs))}
+
+    def _deleterange(self, body):
+        key, end = self._interval(body)
+        with self.lock:
+            victims = [k for k in self.kv if self._in_range(k, key, end)]
+            for k in victims:
+                del self.kv[k]
+        return {"header": {}, "deleted": str(len(victims))}

@@ -1,0 +1,131 @@
+"""etcd-protocol filer store against an in-process v3 JSON gateway double.
+
+Gates mirror the redis-store suite: CRUD + listing pagination/prefix,
+recursive folder delete via DeleteRange intervals, kv prefix scans,
+randomized differential vs MemoryStore, and a Filer riding on top.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu.filer.entry import Attr, Entry, FileChunk
+from seaweedfs_tpu.filer.etcd_store import EtcdStore, _prefix_end
+from seaweedfs_tpu.filer.filer import Filer, NotFoundError
+from seaweedfs_tpu.filer.filer_store import MemoryStore
+
+from .minietcd import MiniEtcd
+
+RNG = np.random.default_rng(0xE7CD)
+
+
+@pytest.fixture()
+def server():
+    s = MiniEtcd()
+    yield s
+    s.stop()
+
+
+@pytest.fixture()
+def store(server):
+    return EtcdStore.from_url(f"etcd://127.0.0.1:{server.port}")
+
+
+def _file(path: str, n: int = 1) -> Entry:
+    chunks = [FileChunk(file_id=f"3,{i:02x}", offset=i * 10, size=10)
+              for i in range(n)]
+    return Entry(full_path=path, attr=Attr(mode=0o660), chunks=chunks)
+
+
+def test_prefix_end_math():
+    assert _prefix_end(b"abc") == b"abd"
+    assert _prefix_end(b"a\xff") == b"b"
+    assert _prefix_end(b"\xff\xff") == b"\x00"  # to end of keyspace
+
+
+def test_crud_listing_pagination(store):
+    for name in ("a.txt", "b.txt", "c.txt"):
+        store.insert_entry(_file(f"/d/{name}", 2))
+    assert len(store.find_entry("/d/b.txt").chunks) == 2
+    assert [e.full_path for e in store.list_directory_entries("/d")] == [
+        "/d/a.txt", "/d/b.txt", "/d/c.txt"]
+    # exclusive resume must still fill the page (the +1 overfetch)
+    assert [e.full_path for e in store.list_directory_entries(
+        "/d", start_file="a.txt", limit=2)] == ["/d/b.txt", "/d/c.txt"]
+    assert [e.full_path for e in store.list_directory_entries(
+        "/d", start_file="a.txt", include_start=True, limit=2)] == [
+        "/d/a.txt", "/d/b.txt"]
+    store.delete_entry("/d/b.txt")
+    assert store.find_entry("/d/b.txt") is None
+
+
+def test_prefix_listing(store):
+    for name in ("apple", "apricot", "banana"):
+        store.insert_entry(_file(f"/f/{name}"))
+    assert [e.full_path for e in store.list_directory_entries(
+        "/f", prefix="ap")] == ["/f/apple", "/f/apricot"]
+    assert list(store.list_directory_entries("/f", prefix="z")) == []
+
+
+def test_delete_folder_children_recursive(store):
+    for p in ("/t/x", "/t/sub/y", "/t/sub/deep/z", "/other/keep",
+              "/tx/decoy"):
+        store.insert_entry(_file(p))
+    store.delete_folder_children("/t")
+    for p in ("/t/x", "/t/sub/y", "/t/sub/deep/z"):
+        assert store.find_entry(p) is None
+    assert store.find_entry("/other/keep") is not None
+    assert store.find_entry("/tx/decoy") is not None  # sibling untouched
+
+
+def test_kv_and_prefix_scan(store):
+    store.kv_put(b"sig/a", b"1")
+    store.kv_put(b"sig/b", b"2")
+    store.kv_put(b"other", b"3")
+    assert store.kv_get(b"sig/a") == b"1"
+    assert store.kv_get(b"nope") is None
+    assert dict(store.kv_scan(b"sig/")) == {b"sig/a": b"1", b"sig/b": b"2"}
+    store.kv_delete(b"sig/a")
+    assert dict(store.kv_scan(b"sig/")) == {b"sig/b": b"2"}
+
+
+def test_matches_memory_randomized(store):
+    mem = MemoryStore()
+    dirs = ["/a", "/a/b", "/c"]
+    names = [f"f{i:02d}" for i in range(10)]
+    for _ in range(300):
+        op = RNG.integers(0, 4)
+        d = dirs[RNG.integers(0, len(dirs))]
+        n = names[RNG.integers(0, len(names))]
+        path = f"{d}/{n}"
+        if op == 0:
+            e = _file(path, int(RNG.integers(1, 4)))
+            mem.insert_entry(e)
+            store.insert_entry(e)
+        elif op == 1:
+            mem.delete_entry(path)
+            store.delete_entry(path)
+        elif op == 2:
+            a, b = mem.find_entry(path), store.find_entry(path)
+            assert (a is None) == (b is None)
+            if a is not None:
+                assert a.to_dict() == b.to_dict()
+        else:
+            assert [e.full_path for e in mem.list_directory_entries(d)] == \
+                [e.full_path for e in store.list_directory_entries(d)]
+
+
+def test_filer_on_etcd(store):
+    deleted: list[str] = []
+    f = Filer(store=store, delete_chunks_fn=deleted.extend)
+    f.mkdir("/docs")
+    f.create_entry(_file("/docs/readme.md", 2))
+    assert [c.file_id for c in f.find_entry("/docs/readme.md").chunks] == [
+        "3,00", "3,01"]
+    f.delete_entry("/docs/readme.md")
+    f.flush_gc()
+    assert sorted(deleted) == ["3,00", "3,01"]
+    with pytest.raises(NotFoundError):
+        f.find_entry("/docs/readme.md")
+    f.close()

@@ -75,14 +75,18 @@ def cmd_volume(args) -> None:
 
 def _make_filer_store(db: str):
     """Store selection by -db value (the rebuild's filer.toml analog):
-    ``redis://…`` -> RedisStore, ``*.lsm`` -> LSM store, other path ->
-    sqlite, empty -> memory."""
+    ``redis://…`` -> RedisStore, ``etcd://…`` -> EtcdStore, ``*.lsm`` ->
+    LSM store, other path -> sqlite, empty -> memory."""
     if not db:
         return None
     if db.startswith("redis://"):
         from seaweedfs_tpu.filer.redis_store import RedisStore
 
         return RedisStore.from_url(db)
+    if db.startswith("etcd://"):
+        from seaweedfs_tpu.filer.etcd_store import EtcdStore
+
+        return EtcdStore.from_url(db)
     if db.endswith(".lsm"):
         # prefer the native C++ engine; the Python engine shares the
         # on-disk format, so falling back never strands a directory
@@ -311,6 +315,7 @@ _SCAFFOLDS = {
 #   /path/filer.db    sqlite store
 #   /path/store.lsm   log-structured store (WAL + memtable + SSTables)
 #   redis://host:port redis-protocol server store (any RESP2 server)
+#   etcd://host:port  etcd v3 store (JSON gateway, any etcd >= 3.4)
 # Per-path rules (collection, replication, ttl, fsync) live IN the
 # filesystem at /etc/seaweedfs/filer.conf — edit with `fs.configure`.
 ''',
@@ -817,8 +822,9 @@ def main(argv=None) -> None:
     fl.add_argument("-ip", default="127.0.0.1")
     fl.add_argument("-port", type=int, default=8888)
     fl.add_argument("-db", default="",
-                    help="store: redis://[:pw@]host:port[/db], *.lsm -> LSM "
-                         "store dir, else sqlite path (default: memory)")
+                    help="store: redis://[:pw@]host:port[/db], "
+                         "etcd://host:port, *.lsm -> LSM store dir, else "
+                         "sqlite path (default: memory)")
     fl.add_argument("-peers", default="",
                     help="other filer host:ports to aggregate meta from")
     fl.add_argument("-maxMB", type=int, default=8)
