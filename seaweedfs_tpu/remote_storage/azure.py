@@ -1,0 +1,190 @@
+"""Azure Blob Storage remote-storage client over the REST API.
+
+Equivalent of weed/remote_storage/azure/azure_storage_client.go — the
+reference uses the Azure SDK; this rebuild speaks the Blob service REST
+API directly (SharedKey authorization, x-ms-version 2020-10-02) so any
+Azure account or azurite/compatible emulator works with zero SDK
+dependencies.
+
+Operations used: List Containers, Create/Delete Container, List Blobs
+(flat, marker paging), Put Blob (BlockBlob), Get Blob (with Range),
+Delete Blob.  SharedKey signing follows the documented canonicalization:
+HMAC-SHA256 of the verb + standard headers + canonicalized x-ms-*
+headers + canonicalized resource, keyed by the base64-decoded account
+key.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import urllib.parse
+import xml.etree.ElementTree as ET
+from email.utils import formatdate, parsedate_to_datetime
+from typing import Iterator
+
+from ..utils.httpd import HttpError, http_bytes
+from .client import (
+    RemoteConf,
+    RemoteLocation,
+    RemoteObject,
+    RemoteStorageClient,
+)
+
+API_VERSION = "2020-10-02"
+
+
+class AzureRemoteStorage(RemoteStorageClient):
+    """conf fields: access_key = account name, secret_key = base64
+    account key, endpoint = host[:port] (defaults to
+    ``{account}.blob.core.windows.net``; set it for azurite-style
+    emulators, where the account name becomes the first path segment)."""
+
+    def __init__(self, conf: RemoteConf):
+        self.account = conf.access_key
+        self.key = base64.b64decode(conf.secret_key) if conf.secret_key \
+            else b""
+        self.endpoint = conf.endpoint or f"{self.account}.blob.core.windows.net"
+        # emulator convention: custom endpoint paths are /{account}/...
+        self.path_style = bool(conf.endpoint)
+
+    # -- signing ------------------------------------------------------------
+    def _canonical_resource(self, path: str, query: dict) -> str:
+        # canonicalized resource = "/" + account + URI path.  With a
+        # custom (emulator) endpoint the URI path itself starts with
+        # /{account}, so the account appears TWICE — matching azurite's
+        # documented canonicalization.
+        uri_path = f"/{self.account}{path}" if self.path_style else path
+        res = f"/{self.account}{uri_path}"
+        for k in sorted(query):
+            res += f"\n{k.lower()}:{query[k]}"
+        return res
+
+    def _request(self, method: str, path: str, query: dict | None = None,
+                 body: bytes = b"", headers: dict | None = None):
+        query = query or {}
+        headers = dict(headers or {})
+        headers["x-ms-date"] = formatdate(usegmt=True)
+        headers["x-ms-version"] = API_VERSION
+        if method == "PUT" and "x-ms-blob-type" not in headers and body:
+            headers["x-ms-blob-type"] = "BlockBlob"
+        canon_headers = "".join(
+            f"{k}:{v}\n" for k, v in sorted(
+                (k.lower(), v) for k, v in headers.items()
+                if k.lower().startswith("x-ms-")))
+        length = str(len(body)) if body else ""
+        string_to_sign = "\n".join([
+            method,
+            "",                      # Content-Encoding
+            "",                      # Content-Language
+            length,                  # Content-Length ("" when 0)
+            "",                      # Content-MD5
+            headers.get("Content-Type", ""),
+            "",                      # Date (x-ms-date is used instead)
+            "",                      # If-Modified-Since
+            "",                      # If-Match
+            "",                      # If-None-Match
+            "",                      # If-Unmodified-Since
+            headers.get("Range", ""),
+        ]) + "\n" + canon_headers + self._canonical_resource(path, query)
+        if self.key:
+            sig = base64.b64encode(hmac.new(
+                self.key, string_to_sign.encode(), hashlib.sha256).digest())
+            headers["Authorization"] = \
+                f"SharedKey {self.account}:{sig.decode()}"
+        url_path = (f"/{self.account}{path}" if self.path_style else path)
+        q = urllib.parse.urlencode(sorted(query.items()))
+        url = f"http://{self.endpoint}{urllib.parse.quote(url_path)}" + (
+            f"?{q}" if q else "")
+        return http_bytes(method, url, body or None, headers=headers)
+
+    # -- helpers ------------------------------------------------------------
+    @staticmethod
+    def _blob_path(loc: RemoteLocation, key: str) -> str:
+        return f"/{loc.bucket}/{key.lstrip('/')}"
+
+    # -- RemoteStorageClient ------------------------------------------------
+    def traverse(self, loc: RemoteLocation) -> Iterator[RemoteObject]:
+        marker = ""
+        prefix = loc.path.strip("/")
+        while True:
+            query = {"restype": "container", "comp": "list"}
+            if prefix:
+                query["prefix"] = prefix + "/"
+            if marker:
+                query["marker"] = marker
+            status, body, _ = self._request(
+                "GET", f"/{loc.bucket}", query)
+            if status != 200:
+                raise HttpError(status, body.decode(errors="replace"))
+            root = ET.fromstring(body)
+            for blob in root.iter("Blob"):
+                name = blob.findtext("Name", "")
+                props = blob.find("Properties")
+                size = int(props.findtext("Content-Length", "0")) \
+                    if props is not None else 0
+                mtime_s = props.findtext("Last-Modified", "") \
+                    if props is not None else ""
+                try:
+                    mtime = parsedate_to_datetime(mtime_s).timestamp()
+                except (TypeError, ValueError):
+                    mtime = 0.0
+                etag = (props.findtext("Etag", "")
+                        if props is not None else "").strip('"')
+                yield RemoteObject("/" + name, size, mtime, etag)
+            marker = root.findtext("NextMarker", "") or ""
+            if not marker:
+                return
+
+    def read_file(self, loc: RemoteLocation, key: str,
+                  offset: int = 0, size: int = -1) -> bytes:
+        if size == 0:
+            return b""  # an inverted Range header would draw a 416
+        headers = {}
+        if offset or size >= 0:
+            end = "" if size < 0 else str(offset + size - 1)
+            headers["Range"] = f"bytes={offset}-{end}"
+        status, body, _ = self._request(
+            "GET", self._blob_path(loc, key), headers=headers)
+        if status not in (200, 206):
+            raise HttpError(status, body.decode(errors="replace"))
+        return body
+
+    def write_file(self, loc: RemoteLocation, key: str,
+                   data: bytes) -> RemoteObject:
+        import time
+
+        status, body, _ = self._request(
+            "PUT", self._blob_path(loc, key), body=data,
+            headers={"x-ms-blob-type": "BlockBlob"})
+        if status not in (200, 201):
+            raise HttpError(status, body.decode(errors="replace"))
+        return RemoteObject(key, len(data), time.time(),
+                            hashlib.md5(data).hexdigest())
+
+    def delete_file(self, loc: RemoteLocation, key: str) -> None:
+        status, body, _ = self._request(
+            "DELETE", self._blob_path(loc, key))
+        if status not in (202, 404):
+            raise HttpError(status, body.decode(errors="replace"))
+
+    def list_buckets(self) -> list[str]:
+        status, body, _ = self._request("GET", "/", {"comp": "list"})
+        if status != 200:
+            raise HttpError(status, body.decode(errors="replace"))
+        root = ET.fromstring(body)
+        return sorted(c.findtext("Name", "")
+                      for c in root.iter("Container"))
+
+    def create_bucket(self, bucket: str) -> None:
+        status, body, _ = self._request(
+            "PUT", f"/{bucket}", {"restype": "container"})
+        if status not in (201, 409):  # 409 = already exists
+            raise HttpError(status, body.decode(errors="replace"))
+
+    def delete_bucket(self, bucket: str) -> None:
+        status, body, _ = self._request(
+            "DELETE", f"/{bucket}", {"restype": "container"})
+        if status not in (202, 404):
+            raise HttpError(status, body.decode(errors="replace"))

@@ -239,6 +239,8 @@ class S3RemoteStorage(RemoteStorageClient):
 
     def read_file(self, loc: RemoteLocation, key: str,
                   offset: int = 0, size: int = -1) -> bytes:
+        if size == 0:
+            return b""  # an inverted Range header would draw a 416
         headers = {}
         if offset or size >= 0:
             end = "" if size < 0 else str(offset + size - 1)
@@ -289,8 +291,6 @@ class S3RemoteStorage(RemoteStorageClient):
 
 
 _GATED = {
-    "gcs": "google-cloud-storage",
-    "azure": "azure-storage-blob",
     "hdfs": "pyarrow/hdfs",
 }
 
@@ -299,6 +299,19 @@ def make_client(conf: RemoteConf) -> RemoteStorageClient:
     if conf.type == "local":
         return LocalRemoteStorage(conf)
     if conf.type == "s3":
+        return S3RemoteStorage(conf)
+    if conf.type == "azure":
+        from .azure import AzureRemoteStorage
+
+        return AzureRemoteStorage(conf)
+    if conf.type == "gcs":
+        # GCS interoperability mode speaks the S3 XML API with HMAC keys
+        # — same client, defaulting the host to the interop endpoint
+        import dataclasses
+
+        if not conf.endpoint:
+            conf = dataclasses.replace(conf,
+                                       endpoint="storage.googleapis.com")
         return S3RemoteStorage(conf)
     if conf.type in _GATED:
         raise RuntimeError(

