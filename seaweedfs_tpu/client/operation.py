@@ -152,7 +152,7 @@ class WeedClient:
                 data = gz
                 headers["Content-Encoding"] = "gzip"
         last_err = None
-        for _ in range(3):
+        for attempt in range(5):
             hdrs = dict(headers)
             if a.auth:
                 hdrs["Authorization"] = f"BEARER {a.auth}"
@@ -165,9 +165,11 @@ class WeedClient:
             if status == 409 or b"read only" in body:
                 # the volume went readonly (operator fence, ec.encode,
                 # tiering) between assign and write: a FRESH assignment
-                # routes to a writable volume (brief wait: the readonly
-                # delta reaches the master within one heartbeat pulse)
-                time.sleep(0.15)
+                # routes to a writable volume.  Escalating wait: the
+                # readonly delta reaches the master within one heartbeat
+                # pulse, but a reassign inside the window lands on the
+                # same volume again
+                time.sleep(0.15 * (attempt + 1))
                 a = self.master.assign(collection=collection,
                                        replication=replication, ttl=ttl)
                 continue
@@ -203,28 +205,36 @@ class WeedClient:
         if a is None:
             a = self.master.assign(collection=collection,
                                    replication=replication, ttl=ttl)
-        for attempt in range(3):
+        for attempt in range(5):
             try:
                 self._tcp.write(tcp_address(a.url), a.fid, data)
                 return a.fid
             except (ConnectionError, OSError) as e:
-                if "read only" in str(e) and attempt < 2:
+                if "read only" in str(e) and attempt < 4:
                     # volume fenced between assign and write: re-assign
                     # after the readonly delta reaches the master
-                    time.sleep(0.15)
+                    time.sleep(0.15 * (attempt + 1))
                     a = self.master.assign(collection=collection,
                                            replication=replication, ttl=ttl)
                     continue
                 # TCP plane closed on this server (secured cluster, port
-                # collision): the assignment is still valid — finish the
-                # write over HTTP, which can carry the JWT
+                # collision, volume quiesced off the native plane): the
+                # assignment is still valid — finish the write over HTTP,
+                # which can carry the JWT
                 headers = {"Authorization": f"BEARER {a.auth}"} if a.auth \
                     else None
                 status, body, _ = http_bytes(
                     "POST", f"http://{a.url}/{a.fid}", data, headers=headers)
-                if status not in (200, 201):
-                    raise HttpError(status, body.decode(errors="replace"))
-                return a.fid
+                if status in (200, 201):
+                    return a.fid
+                if (status == 409 or b"read only" in body) and attempt < 4:
+                    # the volume went readonly between the assign and the
+                    # HTTP fallback: re-assign like the direct paths do
+                    time.sleep(0.15 * (attempt + 1))
+                    a = self.master.assign(collection=collection,
+                                           replication=replication, ttl=ttl)
+                    continue
+                raise HttpError(status, body.decode(errors="replace"))
         return a.fid  # pragma: no cover
 
     def download_tcp(self, fid: str) -> bytes:
@@ -236,7 +246,17 @@ class WeedClient:
         urls, _ = self._locate_retry(vid)
         if not urls:
             raise HttpError(404, f"volume {vid} has no locations")
-        return self._tcp.read(tcp_address(urls[0]), fid)
+        try:
+            return self._tcp.read(tcp_address(urls[0]), fid)
+        except OSError as e:
+            msg = str(e)
+            if "not on native plane" in msg or isinstance(
+                    e, ConnectionError):
+                # the volume is quiesced off the native plane (vacuum,
+                # EC, readonly flip) or the TCP front is closed: the
+                # HTTP plane serves it from the Python engine
+                return self.download(fid)
+            raise
 
     def download(self, fid: str) -> bytes:
         """Full-blob GET; transparently decompresses a gzip-encoded reply

@@ -45,9 +45,17 @@ class AzureRemoteStorage(RemoteStorageClient):
         self.account = conf.access_key
         self.key = base64.b64decode(conf.secret_key) if conf.secret_key \
             else b""
-        self.endpoint = conf.endpoint or f"{self.account}.blob.core.windows.net"
+        ep = conf.endpoint
+        if ep and "://" in ep:
+            self.scheme, ep = ep.split("://", 1)
+        else:
+            # real service: always https (accounts default to
+            # secure-transfer-required); explicit host:port endpoints
+            # (emulators) default to http
+            self.scheme = "http" if ep else "https"
+        self.endpoint = ep or f"{self.account}.blob.core.windows.net"
         # emulator convention: custom endpoint paths are /{account}/...
-        self.path_style = bool(conf.endpoint)
+        self.path_style = bool(ep)
 
     # -- signing ------------------------------------------------------------
     def _canonical_resource(self, path: str, query: dict) -> str:
@@ -95,7 +103,8 @@ class AzureRemoteStorage(RemoteStorageClient):
                 f"SharedKey {self.account}:{sig.decode()}"
         url_path = (f"/{self.account}{path}" if self.path_style else path)
         q = urllib.parse.urlencode(sorted(query.items()))
-        url = f"http://{self.endpoint}{urllib.parse.quote(url_path)}" + (
+        url = (f"{self.scheme}://{self.endpoint}"
+               f"{urllib.parse.quote(url_path)}") + (
             f"?{q}" if q else "")
         return http_bytes(method, url, body or None, headers=headers)
 

@@ -100,16 +100,72 @@ class KafkaQueue(NotificationQueue):  # pragma: no cover - SDK not in image
                 "which is not available in this environment") from e
 
 
-class SqsQueue(NotificationQueue):  # pragma: no cover - SDK not in image
-    """Gated: requires boto3 (not baked in)."""
+class SqsQueue(NotificationQueue):
+    """AWS SQS over the query API with SigV4 header signing — stdlib
+    only, works against real SQS or any compatible endpoint
+    (notification/aws_sqs/aws_sqs_pub.go, minus the SDK)."""
 
-    def __init__(self, region: str, queue_url: str):
-        try:
-            import boto3  # noqa: F401
-        except ImportError as e:
-            raise RuntimeError(
-                "aws_sqs notification requires boto3, which is not "
-                "available in this environment") from e
+    def __init__(self, queue_url: str, region: str = "us-east-1",
+                 access_key: str = "", secret_key: str = ""):
+        import urllib.parse
+
+        if "://" not in queue_url:
+            raise ValueError(
+                f"aws_sqs needs a full queue URL "
+                f"(https://sqs.<region>.amazonaws.com/<acct>/<name>), "
+                f"got {queue_url!r}")
+        self.queue_url = queue_url
+        self.region = region
+        self.access_key, self.secret_key = access_key, secret_key
+        p = urllib.parse.urlparse(queue_url)
+        self.host, self.path = p.netloc, (p.path or "/")
+        self.scheme = p.scheme or "http"
+
+    def _sign(self, body: bytes, amz_date: str) -> str:
+        """SigV4 Authorization header for service=sqs."""
+        import hashlib
+        import hmac
+
+        date = amz_date[:8]
+        payload_hash = hashlib.sha256(body).hexdigest()
+        canonical_headers = (
+            f"content-type:application/x-www-form-urlencoded\n"
+            f"host:{self.host}\nx-amz-date:{amz_date}\n")
+        signed = "content-type;host;x-amz-date"
+        creq = "\n".join(["POST", self.path, "", canonical_headers,
+                          signed, payload_hash])
+        scope = f"{date}/{self.region}/sqs/aws4_request"
+        sts = "\n".join(["AWS4-HMAC-SHA256", amz_date, scope,
+                         hashlib.sha256(creq.encode()).hexdigest()])
+        key = b"AWS4" + self.secret_key.encode()
+        for part in (date, self.region, "sqs", "aws4_request"):
+            key = hmac.new(key, part.encode(), hashlib.sha256).digest()
+        sig = hmac.new(key, sts.encode(), hashlib.sha256).hexdigest()
+        return (f"AWS4-HMAC-SHA256 Credential={self.access_key}/{scope}, "
+                f"SignedHeaders={signed}, Signature={sig}")
+
+    def send_message(self, key: str, event: dict) -> None:
+        import time
+        import urllib.parse
+
+        from ..utils.httpd import HttpError, http_bytes
+
+        body = urllib.parse.urlencode({
+            "Action": "SendMessage", "Version": "2012-11-05",
+            "MessageBody": json.dumps({"key": key, "event": event}),
+        }).encode()
+        amz_date = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
+        headers = {
+            "Content-Type": "application/x-www-form-urlencoded",
+            "X-Amz-Date": amz_date,
+        }
+        if self.access_key:
+            headers["Authorization"] = self._sign(body, amz_date)
+        status, resp, _ = http_bytes(
+            "POST", f"{self.scheme}://{self.host}{self.path}", body,
+            headers=headers)
+        if status != 200:
+            raise HttpError(status, resp.decode(errors="replace"))
 
 
 def load_notification_queue(conf: dict) -> Optional[NotificationQueue]:
@@ -128,6 +184,9 @@ def load_notification_queue(conf: dict) -> Optional[NotificationQueue]:
         return KafkaQueue(n["kafka"].get("hosts", []),
                           n["kafka"].get("topic", "seaweedfs"))
     if n.get("aws_sqs", {}).get("enabled"):
-        return SqsQueue(n["aws_sqs"].get("region", ""),
-                        n["aws_sqs"].get("sqs_queue_name", ""))
+        s = n["aws_sqs"]
+        return SqsQueue(s.get("queue_url", s.get("sqs_queue_name", "")),
+                        region=s.get("region", "us-east-1"),
+                        access_key=s.get("aws_access_key_id", ""),
+                        secret_key=s.get("aws_secret_access_key", ""))
     return None

@@ -671,12 +671,10 @@ class VolumeServer:
             # writable-set change must reach the master within one pulse,
             # not wait for the next periodic full sync
             self.store.note_volume_change(vid)
-            # refresh the native plane's read_only flag (re-registering
-            # replays the idx, so this is also a consistency point)
-            if self.store.native_plane is not None \
-                    and self.store.native_plane.has(vid):
-                self.store.native_detach(vid)
-                self.store.native_reattach(vid)
+            # refresh the native plane's read_only flag (no-op while a
+            # vacuum/tier hold is outstanding — re-registering mid-compact
+            # would put the plane back under files about to be swapped)
+            self.store.native_refresh(vid)
             return Response({})
 
         # --- admin: vacuum -------------------------------------------
@@ -882,7 +880,7 @@ class VolumeServer:
                 raise HttpError(404, f"volume {vid} not found")
             with self.store.volume_locks[vid]:
                 v.tier_download()
-            self.store.native_reattach(vid)  # local .dat again
+            self.store.native_register(vid)  # local .dat again
             return Response({})
 
         @r.route("POST", "/admin/configure_replication")

@@ -25,7 +25,13 @@ from ..storage.needle import Needle
 from ..storage.super_block import ReplicaPlacement
 from ..storage.ttl import TTL
 from ..storage.types import Version
-from ..storage.volume import Volume, volume_file_prefix
+from ..storage.volume import (
+    CookieMismatchError,
+    DeletedError,
+    NotFoundError,
+    Volume,
+    volume_file_prefix,
+)
 from ..utils import ioutil  # noqa: F401  (re-exported for tooling)
 
 
@@ -86,8 +92,13 @@ class Store:
         # mmap-backed .dat files (-memoryMapSizeMB analog, backend/memory_map)
         self.use_mmap = use_mmap
         # native C++ data plane (native/dataplane.cpp): when attached, it
-        # is the single writer/reader for registered volumes' needles
+        # is the single writer/reader for registered volumes' needles.
+        # _native_holds counts outstanding native_detach()s per volume so
+        # overlapping maintenance (vacuum + readonly flip + tier) cannot
+        # re-register the plane while any of them still owns the files
         self.native_plane = None
+        self._native_holds: dict[int, int] = {}
+        self._native_hold_lock = threading.Lock()
         self._rs_cache: dict[str, ReedSolomon] = {}
         # delta-heartbeat bookkeeping (volume_grpc_client_to_master.go:48
         # streams incremental new/deleted volume + EC-shard lists between
@@ -234,6 +245,8 @@ class Store:
     def delete_volume(self, vid: int) -> None:
         if self.native_plane is not None:
             self.native_plane.remove_volume(vid)
+            with self._native_hold_lock:
+                self._native_holds.pop(vid, None)
         v = self.volumes.pop(vid, None)
         self.volume_locks.pop(vid, None)
         if v is not None:
@@ -243,6 +256,8 @@ class Store:
     def unmount_volume(self, vid: int) -> None:
         if self.native_plane is not None:
             self.native_plane.remove_volume(vid)
+            with self._native_hold_lock:
+                self._native_holds.pop(vid, None)
         v = self.volumes.pop(vid, None)
         self.volume_locks.pop(vid, None)
         if v is not None:
@@ -253,8 +268,8 @@ class Store:
         for loc in self.locations:
             for collection, found_vid in loc.discover_volumes():
                 if found_vid == vid:
-                    v = self._open_volume(loc.directory, collection, vid)
-                    self._native_add(vid, v)
+                    self._open_volume(loc.directory, collection, vid)
+                    self.native_register(vid)
                     return
         raise KeyError(f"volume {vid} not found on disk")
 
@@ -281,15 +296,28 @@ class Store:
     def native_detach(self, vid: int) -> None:
         """Quiesce: unregister from the plane and REOPEN the Python volume
         so its needle map replays everything the plane appended.  Needle
-        ops fall back to the Python engine until native_reattach."""
+        ops fall back to the Python engine until native_reattach.  Holds
+        nest: each detach must be paired with a reattach, and the plane
+        only re-registers when the LAST hold releases.
+
+        Both the plane removal and the volume swap happen under the
+        volume lock so they can never interleave with a reattach's
+        re-registration or a Python-engine fallback write."""
         plane = self.native_plane
-        if plane is None or not plane.has(vid):
+        if plane is None:
             return
-        plane.remove_volume(vid)
-        v = self.volumes.get(vid)
-        if v is None:
+        with self._native_hold_lock:
+            self._native_holds[vid] = self._native_holds.get(vid, 0) + 1
+        lock = self.volume_locks.get(vid)
+        if lock is None:
             return
-        with self.volume_locks[vid]:
+        with lock:
+            if not plane.has(vid):
+                return
+            plane.remove_volume(vid)
+            v = self.volumes.get(vid)
+            if v is None:
+                return
             directory, collection, ro = v.directory, v.collection, v.read_only
             v.close()
             v2 = Volume(directory, collection, vid,
@@ -299,10 +327,73 @@ class Store:
             self.volumes[vid] = v2
 
     def native_reattach(self, vid: int) -> None:
-        v = self.volumes.get(vid)
-        if v is not None and self.native_plane is not None \
-                and not self.native_plane.has(vid):
-            self._native_add(vid, v)
+        """Release one hold; the LAST release re-registers the plane.
+        Strictly paired with native_detach: an unpaired call (no hold
+        outstanding) is a no-op, so it can never steal a concurrent
+        maintenance op's hold and re-register over files it still owns."""
+        plane = self.native_plane
+        if plane is None:
+            return
+        lock = self.volume_locks.get(vid)
+        if lock is None:
+            with self._native_hold_lock:
+                if self._native_holds.get(vid, 0):
+                    n = self._native_holds[vid]
+                    if n <= 1:
+                        self._native_holds.pop(vid, None)
+                    else:
+                        self._native_holds[vid] = n - 1
+            return
+        with lock:
+            with self._native_hold_lock:
+                n = self._native_holds.get(vid, 0)
+                if n == 0:
+                    return  # unpaired: someone else's hold logic governs
+                if n > 1:
+                    self._native_holds[vid] = n - 1
+                    return  # another maintenance op still owns the files
+                # n == 1: last hold — keep it visible until re-added so
+                # readers missing on a stale pre-swap object still settle
+            v = self.volumes.get(vid)
+            if v is not None and not plane.has(vid):
+                self._native_add(vid, v)
+            with self._native_hold_lock:
+                n = self._native_holds.get(vid, 0)
+                if n <= 1:
+                    self._native_holds.pop(vid, None)
+                else:  # a new detach arrived while re-registering
+                    self._native_holds[vid] = n - 1
+
+    def native_register(self, vid: int) -> None:
+        """Register a volume that newly became plane-eligible (tier
+        download, ec.decode restore, mount) — a no-op while any
+        maintenance hold is outstanding or the plane already has it."""
+        plane = self.native_plane
+        if plane is None or plane.has(vid):
+            return
+        lock = self.volume_locks.get(vid)
+        if lock is None:
+            return
+        with lock:
+            with self._native_hold_lock:
+                if self._native_holds.get(vid, 0):
+                    return
+            v = self.volumes.get(vid)
+            if v is not None and not plane.has(vid):
+                self._native_add(vid, v)
+
+    def native_refresh(self, vid: int) -> None:
+        """Re-register with current flags (read_only) — a no-op while any
+        maintenance hold is outstanding; that hold's reattach will pick
+        the flags up."""
+        plane = self.native_plane
+        if plane is None or not plane.has(vid):
+            return
+        with self._native_hold_lock:
+            if self._native_holds.get(vid, 0):
+                return
+        self.native_detach(vid)
+        self.native_reattach(vid)
 
     def native_quiesced(self, vid: int):
         """Context manager around maintenance that touches volume files."""
@@ -327,28 +418,61 @@ class Store:
 
         return isinstance(exc, DataPlaneError) and exc.code == DP_NO_VOLUME
 
-    def write_needle(self, vid: int, n: Needle, fsync: bool = False) -> tuple[int, bool]:
-        v = self.get_volume(vid)
-        plane = self.native_plane
-        if plane is not None and plane.has(vid):
-            # single-writer funnel: Python serializes (rich needles keep
-            # name/mime/flags/cipher), C++ appends under its volume lock.
-            # Divergence from the Python path: no unchanged-write dedupe.
-            import time as _time
+    def _native_append(self, plane, vid: int, n: Needle,
+                       fsync: bool) -> tuple[int, bool]:
+        """Single-writer funnel: Python serializes (rich needles keep
+        name/mime/flags/cipher), C++ appends under its volume lock.
+        Divergence from the Python path: no unchanged-write dedupe."""
+        import time as _time
 
-            if not n.append_at_ns:
-                n.append_at_ns = _time.time_ns()
-            blob = n.to_bytes(v.version)
-            try:
-                plane.append(vid, n.id, n.cookie, blob, n.size)
+        v = self.get_volume(vid)
+        if not n.append_at_ns:
+            n.append_at_ns = _time.time_ns()
+        blob = n.to_bytes(v.version)
+        plane.append(vid, n.id, n.cookie, blob, n.size)
+        if fsync:
+            plane.sync(vid)
+        self.note_volume_change(vid)
+        return n.size, False
+
+    def _plane_eligible(self, vid: int) -> bool:
+        v = self.volumes.get(vid)
+        return (v is not None and not v.tiered
+                and v.version == Version.V3)
+
+    def write_needle(self, vid: int, n: Needle, fsync: bool = False) -> tuple[int, bool]:
+        plane = self.native_plane
+        if plane is not None and not plane.has(vid) \
+                and not self._native_holds.get(vid) \
+                and not self._plane_eligible(vid):
+            # never on the plane (tiered / non-v3): plain engine semantics
+            plane = None
+        if plane is not None:
+            if plane.has(vid):
+                try:
+                    return self._native_append(plane, vid, n, fsync)
+                except OSError as e:
+                    if not self._plane_gone(e):
+                        raise
+            # quiesce window: the volume lock serializes this fallback
+            # against native_reattach, and the has() RE-CHECK inside it
+            # routes back to the plane if re-registration won the race —
+            # a Python append after dp_add would be invisible to the
+            # plane's map and overwritten by its next stale-offset write
+            with self.volume_locks[vid]:
+                if plane.has(vid):
+                    try:
+                        return self._native_append(plane, vid, n, fsync)
+                    except OSError as e:
+                        if not self._plane_gone(e):
+                            raise
+                v = self.get_volume(vid)
                 if fsync:
-                    plane.sync(vid)
-                self.note_volume_change(vid)
-                return n.size, False
-            except OSError as e:
-                if not self._plane_gone(e):
-                    raise
-                v = self.get_volume(vid)  # reopened by native_detach
+                    _, size, unchanged = v.write_needle2(n, fsync=True)
+                else:
+                    _, size, unchanged = v.write_needle(n)
+            self.note_volume_change(vid)
+            return size, unchanged
         if fsync:
             # group-commit worker (volume_write.py): the store lock is NOT
             # held while waiting, so concurrent fsync writers batch into one
@@ -357,8 +481,8 @@ class Store:
                 n, fsync=True)
         else:
             with self.volume_locks[vid]:
-                # refetch under the lock: native_detach swaps the volume
-                # object under this same lock
+                # refetch under the lock: compaction commit swaps the
+                # volume object under this same lock
                 _, size, unchanged = self.get_volume(vid).write_needle(n)
         # stats changed: the next delta pulse refreshes this volume's
         # counters on the master (idle volumes cost nothing)
@@ -367,16 +491,38 @@ class Store:
 
     def delete_needle(self, vid: int, n: Needle, fsync: bool = False) -> int:
         plane = self.native_plane
-        if plane is not None and plane.has(vid):
-            try:
-                size = plane.delete(vid, n.id, n.cookie)
-                if fsync:
-                    plane.sync(vid)
-                self.note_volume_change(vid)
-                return size
-            except OSError as e:
-                if not self._plane_gone(e):
-                    raise
+        if plane is not None and not plane.has(vid) \
+                and not self._native_holds.get(vid) \
+                and not self._plane_eligible(vid):
+            plane = None  # never on the plane: plain engine semantics
+        if plane is not None:
+            if plane.has(vid):
+                try:
+                    size = plane.delete(vid, n.id, n.cookie)
+                    if fsync:
+                        plane.sync(vid)
+                    self.note_volume_change(vid)
+                    return size
+                except OSError as e:
+                    if not self._plane_gone(e):
+                        raise
+            # same lock + re-check discipline as write_needle
+            with self.volume_locks[vid]:
+                if plane.has(vid):
+                    try:
+                        size = plane.delete(vid, n.id, n.cookie)
+                        if fsync:
+                            plane.sync(vid)
+                        self.note_volume_change(vid)
+                        return size
+                    except OSError as e:
+                        if not self._plane_gone(e):
+                            raise
+                v = self.get_volume(vid)
+                size = v.delete_needle2(n, fsync=True) if fsync \
+                    else v.delete_needle(n)
+            self.note_volume_change(vid)
+            return size
         if fsync:
             size = self.get_volume(vid).delete_needle2(n, fsync=True)
         else:
@@ -387,7 +533,14 @@ class Store:
 
     def read_needle(self, vid: int, key: int, cookie: Optional[int] = None) -> Needle:
         plane = self.native_plane
-        if plane is not None and plane.has(vid):
+        if plane is None:
+            return self.get_volume(vid).read_needle(key, cookie)
+        # two rounds: a plane_gone in round 1 may mean "mid-reattach";
+        # round 2 re-checks has() so a just-re-registered plane serves the
+        # read (its map is fresher than the quiesce-era Python volume's)
+        for _ in range(2):
+            if not plane.has(vid):
+                break
             try:
                 v = self.get_volume(vid)
                 blob, size = plane.read_record(vid, key, cookie)
@@ -395,15 +548,46 @@ class Store:
             except OSError as e:
                 if not self._plane_gone(e):
                     raise
-        return self.get_volume(vid).read_needle(key, cookie)
+        try:
+            return self.get_volume(vid).read_needle(key, cookie)
+        except (NotFoundError, DeletedError, CookieMismatchError,
+                ValueError, OSError):
+            # possibly a stale volume object mid-quiesce-swap (its map is
+            # frozen at the last attach, and its closed .dat handle never
+            # comes back): settle under the volume lock, which serializes
+            # with the swap, and ask both engines again.  A miss with no
+            # hold outstanding and no registration is a PLAIN miss
+            # (ineligible or permanently detached volume) — don't tax
+            # every 404 with the write lock
+            if not self._native_holds.get(vid) and not plane.has(vid):
+                raise
+            with self.volume_locks[vid]:
+                if plane.has(vid):
+                    try:
+                        v = self.get_volume(vid)
+                        blob, size = plane.read_record(vid, key, cookie)
+                        return Needle.from_bytes(blob, size, v.version)
+                    except OSError as e:
+                        if not self._plane_gone(e):
+                            raise
+                return self.get_volume(vid).read_needle(key, cookie)
 
     # --- EC (store_ec.go + volume_grpc_erasure_coding.go backends) --------
     def ec_generate(self, vid: int, collection: str = "",
                     engine: Optional[str] = None) -> None:
         """VolumeEcShardsGenerate: .dat -> .ec00..13 + .ecx + mark readonly."""
         # quiesce the native plane for the encode: writes fall back to the
-        # (reopened, idx-replayed) Python engine; reads keep working
+        # (reopened, idx-replayed) Python engine; reads keep working.
+        # The finally-reattach re-registers read_only, so the plane keeps
+        # serving reads of the frozen volume while shards spread
         self.native_detach(vid)
+        try:
+            self._ec_generate_locked(vid, engine)
+        finally:
+            self.native_reattach(vid)
+
+    def _ec_generate_locked(self, vid: int,
+                            engine: Optional[str] = None) -> None:
         v = self.get_volume(vid)
         base = v.file_prefix
         with self.volume_locks[vid]:
@@ -537,8 +721,8 @@ class Store:
         ec_encoder.write_idx_file_from_ec_index(base)
         self.ec_unmount(vid)
         directory = os.path.dirname(base)
-        v = self._open_volume(directory, collection, vid)
-        self._native_add(vid, v)
+        self._open_volume(directory, collection, vid)
+        self.native_register(vid)
 
     # --- heartbeat (store.go:216 CollectHeartbeat) ------------------------
     def _volume_info(self, v: Volume) -> dict:

@@ -231,3 +231,130 @@ def test_replicator_excludes_etc_credentials(tmp_path):
               "old_entry": None}
         assert repl.replicate(ev) is False, p
     assert not (tmp_path / "root/etc").exists()
+
+
+# --------------------------------------------------------------------------
+# SQS notification queue (SigV4 query API, no SDK)
+# --------------------------------------------------------------------------
+
+class _MiniSqs:
+    """SQS double: verifies the SigV4 signature server-side, records
+    SendMessage bodies."""
+
+    def __init__(self, access_key="AK", secret_key="SK",
+                 region="us-east-1"):
+        import threading
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        self.access_key, self.secret_key, self.region = \
+            access_key, secret_key, region
+        self.messages = []
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                pass
+
+            def do_POST(self):
+                import hashlib
+                import hmac as _hmac
+                import urllib.parse
+
+                n = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(n)
+                amz_date = self.headers.get("X-Amz-Date", "")
+                date = amz_date[:8]
+                canonical_headers = (
+                    f"content-type:{self.headers.get('Content-Type','')}\n"
+                    f"host:{self.headers.get('Host','')}\n"
+                    f"x-amz-date:{amz_date}\n")
+                creq = "\n".join([
+                    "POST", self.path, "", canonical_headers,
+                    "content-type;host;x-amz-date",
+                    hashlib.sha256(body).hexdigest()])
+                scope = f"{date}/{outer.region}/sqs/aws4_request"
+                sts = "\n".join(["AWS4-HMAC-SHA256", amz_date, scope,
+                                 hashlib.sha256(creq.encode()).hexdigest()])
+                key = b"AWS4" + outer.secret_key.encode()
+                for part in (date, outer.region, "sqs", "aws4_request"):
+                    key = _hmac.new(key, part.encode(),
+                                    hashlib.sha256).digest()
+                want = _hmac.new(key, sts.encode(),
+                                 hashlib.sha256).hexdigest()
+                auth = self.headers.get("Authorization", "")
+                if f"Signature={want}" not in auth \
+                        or f"Credential={outer.access_key}/" not in auth:
+                    payload = b"<ErrorResponse>SignatureDoesNotMatch</ErrorResponse>"
+                    self.send_response(403)
+                else:
+                    form = dict(urllib.parse.parse_qsl(body.decode()))
+                    outer.messages.append(form)
+                    payload = (b"<SendMessageResponse><SendMessageResult>"
+                               b"<MessageId>x</MessageId>"
+                               b"</SendMessageResult></SendMessageResponse>")
+                    self.send_response(200)
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+        self._srv = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self._srv.daemon_threads = True
+        self.port = self._srv.server_address[1]
+        threading.Thread(target=self._srv.serve_forever,
+                         daemon=True).start()
+
+    def stop(self):
+        self._srv.shutdown()
+        self._srv.server_close()
+
+
+def test_sqs_queue_signed_send():
+    import json as _json
+
+    from seaweedfs_tpu.replication.notification import SqsQueue
+
+    srv = _MiniSqs()
+    try:
+        q = SqsQueue(f"http://127.0.0.1:{srv.port}/123/events",
+                     region=srv.region, access_key="AK", secret_key="SK")
+        q.send_message("/buckets/b/k.txt", {"op": "create"})
+        assert len(srv.messages) == 1
+        form = srv.messages[0]
+        assert form["Action"] == "SendMessage"
+        payload = _json.loads(form["MessageBody"])
+        assert payload["key"] == "/buckets/b/k.txt"
+        assert payload["event"]["op"] == "create"
+    finally:
+        srv.stop()
+
+
+def test_sqs_queue_bad_key_rejected():
+    import pytest as _pytest
+
+    from seaweedfs_tpu.replication.notification import SqsQueue
+    from seaweedfs_tpu.utils.httpd import HttpError
+
+    srv = _MiniSqs()
+    try:
+        q = SqsQueue(f"http://127.0.0.1:{srv.port}/123/events",
+                     region=srv.region, access_key="AK",
+                     secret_key="WRONG")
+        with _pytest.raises(HttpError):
+            q.send_message("/k", {"op": "create"})
+        assert srv.messages == []
+    finally:
+        srv.stop()
+
+
+def test_sqs_queue_from_config():
+    from seaweedfs_tpu.replication.notification import (
+        SqsQueue, load_notification_queue)
+
+    q = load_notification_queue({"notification": {"aws_sqs": {
+        "enabled": True, "queue_url": "http://sqs.local/1/q",
+        "region": "eu-west-1", "aws_access_key_id": "A",
+        "aws_secret_access_key": "S"}}})
+    assert isinstance(q, SqsQueue)
+    assert q.region == "eu-west-1" and q.path == "/1/q"

@@ -35,7 +35,13 @@ def _clean_faults():
     fi.clear()
 
 
-def test_soak_mixed_workload_with_churn(tmp_path):
+@pytest.mark.parametrize("dataplane", ["python", "native"])
+def test_soak_mixed_workload_with_churn(tmp_path, dataplane):
+    if dataplane == "native":
+        from seaweedfs_tpu.volume_server.dataplane import load_dataplane
+
+        if load_dataplane() is None:
+            pytest.skip("no C++ toolchain")
     master = MasterServer(port=free_port(), volume_size_limit_mb=64,
                           pulse_seconds=0.3, garbage_threshold=0.2,
                           vacuum_scan_seconds=2.0).start()
@@ -45,6 +51,7 @@ def test_soak_mixed_workload_with_churn(tmp_path):
         d.mkdir()
         servers.append(VolumeServer([str(d)], master.url, port=free_port(),
                                     max_volume_count=12,
+                                    dataplane=dataplane,
                                     pulse_seconds=0.3).start())
     deadline = time.time() + 5
     while time.time() < deadline and len(master.topo.all_nodes()) < 3:
