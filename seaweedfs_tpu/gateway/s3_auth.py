@@ -435,8 +435,8 @@ def decode_streaming_chunks(body: bytes) -> bytes:
 def sign_v4_streaming(method: str, url: str, access_key: str,
                       secret_key: str, chunks: list[bytes],
                       amz_date: str = "", region: str = "us-east-1",
-                      payload_marker: str = STREAMING_PAYLOAD
-                      ) -> tuple[dict, bytes]:
+                      payload_marker: str = STREAMING_PAYLOAD,
+                      service: str = "s3") -> tuple[dict, bytes]:
     """Client side of the V4 streaming upload: returns (headers, framed
     aws-chunked body) with a valid seed signature and per-chunk signature
     chain — the format verify_streaming_chunks checks."""
@@ -452,7 +452,7 @@ def sign_v4_streaming(method: str, url: str, access_key: str,
                "Content-Encoding": "aws-chunked",
                "X-Amz-Decoded-Content-Length": str(decoded_len)}
     signed = sorted(h.lower() for h in headers)
-    scope = f"{amz_date[:8]}/{region}/s3/aws4_request"
+    scope = f"{amz_date[:8]}/{region}/{service}/aws4_request"
     iam = IdentityAccessManagement()
     lookup = {h.lower(): v for h, v in headers.items()}
     creq = iam._canonical_request(method, parsed.path or "/", query,
@@ -478,12 +478,12 @@ def sign_v4_streaming(method: str, url: str, access_key: str,
 
 def presign_v4(method: str, url: str, access_key: str, secret_key: str,
                expires: int = 3600, amz_date: str = "",
-               region: str = "us-east-1") -> str:
+               region: str = "us-east-1", service: str = "s3") -> str:
     """Produce a presigned URL (query-string auth) for the given request."""
     if not amz_date:
         amz_date = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
     parsed = urllib.parse.urlparse(url)
-    scope = f"{amz_date[:8]}/{region}/s3/aws4_request"
+    scope = f"{amz_date[:8]}/{region}/{service}/aws4_request"
     query = {k: v[0] for k, v in
              urllib.parse.parse_qs(parsed.query, keep_blank_values=True).items()}
     query.update({
@@ -506,9 +506,11 @@ def presign_v4(method: str, url: str, access_key: str, secret_key: str,
 def sign_v4(method: str, url: str, access_key: str, secret_key: str,
             body: bytes = b"", amz_date: str = "",
             region: str = "us-east-1",
-            extra_headers: Optional[dict] = None) -> dict:
+            extra_headers: Optional[dict] = None,
+            service: str = "s3") -> dict:
     """Produce the headers for a SigV4 header-signed request (the moto/
-    botocore algorithm, self-contained so tests need no SDK)."""
+    botocore algorithm, self-contained so tests need no SDK).  `service`
+    generalizes the credential scope beyond s3 (sqs, etc.)."""
     if not amz_date:
         amz_date = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
     parsed = urllib.parse.urlparse(url)
@@ -519,7 +521,7 @@ def sign_v4(method: str, url: str, access_key: str, secret_key: str,
                "X-Amz-Content-Sha256": payload_hash}
     headers.update(extra_headers or {})
     signed = sorted(h.lower() for h in headers)
-    scope = f"{amz_date[:8]}/{region}/s3/aws4_request"
+    scope = f"{amz_date[:8]}/{region}/{service}/aws4_request"
     iam = IdentityAccessManagement()
     lookup = {h.lower(): v for h, v in headers.items()}
     creq = iam._canonical_request(method, parsed.path or "/", query,

@@ -88,16 +88,23 @@ class FileQueue(NotificationQueue):
                     yield f.tell(), d["key"], d["event"]
 
 
-class KafkaQueue(NotificationQueue):  # pragma: no cover - SDK not in image
-    """Gated: requires a kafka client library (not baked in)."""
+class KafkaQueue(NotificationQueue):
+    """Kafka topic publisher over the wire protocol — no SDK
+    (notification/kafka/kafka_queue.go, minus sarama).  Messages are
+    keyed by the filer path so one path's events stay ordered within a
+    partition."""
 
     def __init__(self, hosts: list[str], topic: str):
-        try:
-            import kafka  # noqa: F401
-        except ImportError as e:
-            raise RuntimeError(
-                "kafka notification requires the kafka-python package, "
-                "which is not available in this environment") from e
+        from .kafka import KafkaProducer
+
+        if not hosts:
+            raise ValueError("kafka notification needs bootstrap hosts")
+        self.topic = topic
+        self.producer = KafkaProducer(hosts)
+
+    def send_message(self, key: str, event: dict) -> None:
+        self.producer.send(self.topic, key.encode(),
+                           json.dumps({"key": key, "event": event}).encode())
 
 
 class SqsQueue(NotificationQueue):
@@ -121,51 +128,76 @@ class SqsQueue(NotificationQueue):
         self.host, self.path = p.netloc, (p.path or "/")
         self.scheme = p.scheme or "http"
 
-    def _sign(self, body: bytes, amz_date: str) -> str:
-        """SigV4 Authorization header for service=sqs."""
-        import hashlib
-        import hmac
-
-        date = amz_date[:8]
-        payload_hash = hashlib.sha256(body).hexdigest()
-        canonical_headers = (
-            f"content-type:application/x-www-form-urlencoded\n"
-            f"host:{self.host}\nx-amz-date:{amz_date}\n")
-        signed = "content-type;host;x-amz-date"
-        creq = "\n".join(["POST", self.path, "", canonical_headers,
-                          signed, payload_hash])
-        scope = f"{date}/{self.region}/sqs/aws4_request"
-        sts = "\n".join(["AWS4-HMAC-SHA256", amz_date, scope,
-                         hashlib.sha256(creq.encode()).hexdigest()])
-        key = b"AWS4" + self.secret_key.encode()
-        for part in (date, self.region, "sqs", "aws4_request"):
-            key = hmac.new(key, part.encode(), hashlib.sha256).digest()
-        sig = hmac.new(key, sts.encode(), hashlib.sha256).hexdigest()
-        return (f"AWS4-HMAC-SHA256 Credential={self.access_key}/{scope}, "
-                f"SignedHeaders={signed}, Signature={sig}")
-
     def send_message(self, key: str, event: dict) -> None:
-        import time
         import urllib.parse
 
+        from ..gateway.s3_auth import sign_v4
         from ..utils.httpd import HttpError, http_bytes
 
         body = urllib.parse.urlencode({
             "Action": "SendMessage", "Version": "2012-11-05",
             "MessageBody": json.dumps({"key": key, "event": event}),
         }).encode()
-        amz_date = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
-        headers = {
-            "Content-Type": "application/x-www-form-urlencoded",
-            "X-Amz-Date": amz_date,
-        }
+        url = f"{self.scheme}://{self.host}{self.path}"
+        headers = {"Content-Type": "application/x-www-form-urlencoded"}
         if self.access_key:
-            headers["Authorization"] = self._sign(body, amz_date)
-        status, resp, _ = http_bytes(
-            "POST", f"{self.scheme}://{self.host}{self.path}", body,
-            headers=headers)
+            headers = sign_v4(
+                "POST", url, self.access_key, self.secret_key, body=body,
+                region=self.region, service="sqs", extra_headers=headers)
+        status, resp, _ = http_bytes("POST", url, body, headers=headers)
         if status != 200:
             raise HttpError(status, resp.decode(errors="replace"))
+
+
+class AsyncPublisher(NotificationQueue):
+    """Bounded background publisher: a slow or unreachable broker must
+    never stall the filer mutation path (the reference publishes via
+    sarama's async producer for the same reason).  Overflow drops the
+    oldest pending event; drops and send failures are glogged (rate
+    limited) and counted."""
+
+    def __init__(self, inner: NotificationQueue, maxsize: int = 4096):
+        import queue as _queue
+
+        self.inner = inner
+        self._q: "_queue.Queue" = _queue.Queue(maxsize)
+        self.dropped = 0
+        self.errors = 0
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="notify-publisher")
+        self._thread.start()
+
+    def send_message(self, key: str, event: dict) -> None:
+        import queue as _queue
+
+        while True:
+            try:
+                self._q.put_nowait((key, event))
+                return
+            except _queue.Full:
+                try:  # drop the oldest so fresh events keep flowing
+                    self._q.get_nowait()
+                    self.dropped += 1
+                    if self.dropped in (1, 100) or self.dropped % 1000 == 0:
+                        from ..utils.glog import V
+
+                        V(0).infof("notification queue overflow: "
+                                   "%d events dropped", self.dropped)
+                except _queue.Empty:
+                    pass
+
+    def _run(self) -> None:
+        while True:
+            key, event = self._q.get()
+            try:
+                self.inner.send_message(key, event)
+            except Exception as e:  # noqa: BLE001 - keep publishing
+                self.errors += 1
+                if self.errors in (1, 10) or self.errors % 1000 == 0:
+                    from ..utils.glog import V
+
+                    V(0).infof("notification publish failed (%d so far): "
+                               "%s: %s", self.errors, type(e).__name__, e)
 
 
 def load_notification_queue(conf: dict) -> Optional[NotificationQueue]:
@@ -181,12 +213,16 @@ def load_notification_queue(conf: dict) -> Optional[NotificationQueue]:
     if n.get("memory", {}).get("enabled"):
         return MemoryQueue()
     if n.get("kafka", {}).get("enabled"):
-        return KafkaQueue(n["kafka"].get("hosts", []),
-                          n["kafka"].get("topic", "seaweedfs"))
+        # network queues publish asynchronously: filer mutations must
+        # not block on broker round trips or outages
+        return AsyncPublisher(KafkaQueue(n["kafka"].get("hosts", []),
+                                         n["kafka"].get("topic",
+                                                        "seaweedfs")))
     if n.get("aws_sqs", {}).get("enabled"):
         s = n["aws_sqs"]
-        return SqsQueue(s.get("queue_url", s.get("sqs_queue_name", "")),
-                        region=s.get("region", "us-east-1"),
-                        access_key=s.get("aws_access_key_id", ""),
-                        secret_key=s.get("aws_secret_access_key", ""))
+        return AsyncPublisher(SqsQueue(
+            s.get("queue_url", s.get("sqs_queue_name", "")),
+            region=s.get("region", "us-east-1"),
+            access_key=s.get("aws_access_key_id", ""),
+            secret_key=s.get("aws_secret_access_key", "")))
     return None

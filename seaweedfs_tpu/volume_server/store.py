@@ -74,6 +74,68 @@ class DiskLocation:
         return sorted(found)
 
 
+class _SwapLock:
+    """Many concurrent needle ops (shared) OR one plane swap (exclusive).
+    Quiesce-window fsync writes and miss-path reads take the shared side,
+    so they serialize against detach/reattach swaps WITHOUT serializing
+    against each other (group-commit batching survives) or against a
+    compaction that holds the per-volume lock for seconds."""
+
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._shared = 0
+        self._exclusive = False
+
+    def acquire_shared(self):
+        with self._cond:
+            while self._exclusive:
+                self._cond.wait()
+            self._shared += 1
+
+    def release_shared(self):
+        with self._cond:
+            self._shared -= 1
+            if self._shared == 0:
+                self._cond.notify_all()
+
+    def acquire_exclusive(self):
+        with self._cond:
+            while self._exclusive or self._shared:
+                self._cond.wait()
+            self._exclusive = True
+
+    def release_exclusive(self):
+        with self._cond:
+            self._exclusive = False
+            self._cond.notify_all()
+
+    def shared(self):
+        import contextlib
+
+        @contextlib.contextmanager
+        def _ctx():
+            self.acquire_shared()
+            try:
+                yield
+            finally:
+                self.release_shared()
+
+        return _ctx()
+
+    def exclusive(self):
+        import contextlib
+
+        @contextlib.contextmanager
+        def _ctx():
+            self.acquire_exclusive()
+            try:
+                yield
+            finally:
+                self.release_exclusive()
+
+        return _ctx()
+
+
 class Store:
     def __init__(self, directories: list[str], ip: str = "127.0.0.1",
                  port: int = 8080, public_url: str = "",
@@ -99,6 +161,7 @@ class Store:
         self.native_plane = None
         self._native_holds: dict[int, int] = {}
         self._native_hold_lock = threading.Lock()
+        self._swap_locks: dict[int, _SwapLock] = {}
         self._rs_cache: dict[str, ReedSolomon] = {}
         # delta-heartbeat bookkeeping (volume_grpc_client_to_master.go:48
         # streams incremental new/deleted volume + EC-shard lists between
@@ -279,6 +342,13 @@ class Store:
             raise KeyError(f"volume {vid} not found")
         return v
 
+    def _swap_lock(self, vid: int) -> _SwapLock:
+        with self._native_hold_lock:
+            sl = self._swap_locks.get(vid)
+            if sl is None:
+                sl = self._swap_locks[vid] = _SwapLock()
+            return sl
+
     # --- native data plane (native/dataplane.cpp) -------------------------
     def attach_native_plane(self, plane) -> None:
         """Register every eligible volume; from here every needle op on
@@ -310,8 +380,16 @@ class Store:
             self._native_holds[vid] = self._native_holds.get(vid, 0) + 1
         lock = self.volume_locks.get(vid)
         if lock is None:
+            # volume raced a delete/unmount: the hold must not leak, or a
+            # reused vid could never register on the plane again
+            with self._native_hold_lock:
+                n = self._native_holds.get(vid, 0)
+                if n <= 1:
+                    self._native_holds.pop(vid, None)
+                else:
+                    self._native_holds[vid] = n - 1
             return
-        with lock:
+        with lock, self._swap_lock(vid).exclusive():
             if not plane.has(vid):
                 return
             plane.remove_volume(vid)
@@ -344,7 +422,7 @@ class Store:
                     else:
                         self._native_holds[vid] = n - 1
             return
-        with lock:
+        with lock, self._swap_lock(vid).exclusive():
             with self._native_hold_lock:
                 n = self._native_holds.get(vid, 0)
                 if n == 0:
@@ -374,7 +452,7 @@ class Store:
         lock = self.volume_locks.get(vid)
         if lock is None:
             return
-        with lock:
+        with lock, self._swap_lock(vid).exclusive():
             with self._native_hold_lock:
                 if self._native_holds.get(vid, 0):
                     return
@@ -454,12 +532,14 @@ class Store:
                 except OSError as e:
                     if not self._plane_gone(e):
                         raise
-            # quiesce window: the volume lock serializes this fallback
-            # against native_reattach, and the has() RE-CHECK inside it
-            # routes back to the plane if re-registration won the race —
-            # a Python append after dp_add would be invisible to the
-            # plane's map and overwritten by its next stale-offset write
-            with self.volume_locks[vid]:
+            # quiesce window: the SHARED side of the swap lock serializes
+            # this fallback against detach/reattach swaps (dp_add must
+            # never snapshot around an in-flight Python append) without
+            # serializing fallback writers against EACH OTHER — fsync
+            # writers keep group-commit batching — or against a
+            # compaction holding the per-volume lock.  The has() RE-CHECK
+            # routes back to the plane if re-registration won the race.
+            with self._swap_lock(vid).shared():
                 if plane.has(vid):
                     try:
                         return self._native_append(plane, vid, n, fsync)
@@ -506,8 +586,8 @@ class Store:
                 except OSError as e:
                     if not self._plane_gone(e):
                         raise
-            # same lock + re-check discipline as write_needle
-            with self.volume_locks[vid]:
+            # same shared-lock + re-check discipline as write_needle
+            with self._swap_lock(vid).shared():
                 if plane.has(vid):
                     try:
                         size = plane.delete(vid, n.id, n.cookie)
@@ -561,7 +641,7 @@ class Store:
             # every 404 with the write lock
             if not self._native_holds.get(vid) and not plane.has(vid):
                 raise
-            with self.volume_locks[vid]:
+            with self._swap_lock(vid).shared():
                 if plane.has(vid):
                     try:
                         v = self.get_volume(vid)

@@ -1,0 +1,182 @@
+"""In-process Kafka broker double for the wire-protocol producer tests.
+
+Serves Metadata v1 (reporting itself leader for `partitions` partitions)
+and Produce v3, fully decoding RecordBatch v2 — header layout, castagnoli
+CRC over the batch body, and zigzag-varint records — so the producer's
+bytes are verified exactly as a real >= 0.11 broker would.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+
+from seaweedfs_tpu.replication.kafka import I16, I32, I64, U32, dec_varint
+from seaweedfs_tpu.storage.crc import crc32c
+
+
+class MiniKafka:
+    def __init__(self, partitions: int = 2, fail_produce_times: int = 0):
+        self.partitions = partitions
+        self.records: dict[tuple[str, int], list[tuple[bytes, bytes]]] = {}
+        self.crc_errors = 0
+        self.fail_produce_times = fail_produce_times  # NOT_LEADER replies
+        self.lock = threading.Lock()
+        self._srv = socket.socket()
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind(("127.0.0.1", 0))
+        self._srv.listen(8)
+        self.port = self._srv.getsockname()[1]
+        self._stop = False
+        threading.Thread(target=self._accept, daemon=True).start()
+
+    def stop(self) -> None:
+        self._stop = True
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+
+    def _accept(self) -> None:
+        while not self._stop:
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    @staticmethod
+    def _recv_exact(conn, n):
+        buf = bytearray()
+        while len(buf) < n:
+            piece = conn.recv(n - len(buf))
+            if not piece:
+                raise ConnectionError
+            buf += piece
+        return bytes(buf)
+
+    def _serve(self, conn: socket.socket) -> None:
+        try:
+            while True:
+                n = I32.unpack(self._recv_exact(conn, 4))[0]
+                req = self._recv_exact(conn, n)
+                api_key, api_version, corr = struct.unpack(">hhi", req[:8])
+                i = 8
+                cid_len = I16.unpack_from(req, i)[0]
+                i += 2 + max(0, cid_len)
+                if api_key == 3:
+                    resp = self._metadata(req, i)
+                elif api_key == 0:
+                    resp = self._produce(req, i)
+                else:
+                    resp = b""
+                payload = I32.pack(corr) + resp
+                conn.sendall(I32.pack(len(payload)) + payload)
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            conn.close()
+
+    # -- Metadata v1 ---------------------------------------------------------
+    def _metadata(self, req: bytes, i: int) -> bytes:
+        n_topics = I32.unpack_from(req, i)[0]
+        i += 4
+        topics = []
+        for _ in range(n_topics):
+            tl = I16.unpack_from(req, i)[0]
+            i += 2
+            topics.append(req[i:i + tl].decode())
+            i += tl
+        out = bytearray()
+        out += I32.pack(1)                      # one broker: us
+        out += I32.pack(0)                      # node id
+        out += I16.pack(9) + b"127.0.0.1"
+        out += I32.pack(self.port)
+        out += I16.pack(-1)                     # rack null
+        out += I32.pack(0)                      # controller id
+        out += I32.pack(len(topics))
+        for t in topics:
+            out += I16.pack(0)                  # error
+            out += I16.pack(len(t)) + t.encode()
+            out += b"\x00"                      # is_internal
+            out += I32.pack(self.partitions)
+            for p in range(self.partitions):
+                out += I16.pack(0)              # error
+                out += I32.pack(p)
+                out += I32.pack(0)              # leader = us
+                out += I32.pack(1) + I32.pack(0)  # replicas
+                out += I32.pack(1) + I32.pack(0)  # isr
+        return bytes(out)
+
+    # -- Produce v3 ----------------------------------------------------------
+    def _produce(self, req: bytes, i: int) -> bytes:
+        tx_len = I16.unpack_from(req, i)[0]
+        i += 2 + max(0, tx_len)
+        i += 2 + 4                              # acks, timeout
+        n_topics = I32.unpack_from(req, i)[0]
+        i += 4
+        out_topics = bytearray()
+        for _ in range(n_topics):
+            tl = I16.unpack_from(req, i)[0]
+            i += 2
+            topic = req[i:i + tl].decode()
+            i += tl
+            n_parts = I32.unpack_from(req, i)[0]
+            i += 4
+            parts_out = bytearray()
+            for _ in range(n_parts):
+                pid = I32.unpack_from(req, i)[0]
+                i += 4
+                blen = I32.unpack_from(req, i)[0]
+                i += 4
+                batch = req[i:i + blen]
+                i += blen
+                err = self._ingest(topic, pid, batch)
+                parts_out += I32.pack(pid) + I16.pack(err)
+                parts_out += I64.pack(0)        # base offset
+                parts_out += I64.pack(-1)       # log append time
+            out_topics += (I16.pack(len(topic)) + topic.encode()
+                           + I32.pack(n_parts) + parts_out)
+        return (I32.pack(n_topics) + bytes(out_topics)
+                + I32.pack(0))                  # throttle_time_ms
+
+    def _ingest(self, topic: str, pid: int, batch: bytes) -> int:
+        with self.lock:
+            if self.fail_produce_times > 0:
+                self.fail_produce_times -= 1
+                return 6  # NOT_LEADER_FOR_PARTITION
+        # RecordBatch v2 header
+        # 0:8 baseOffset | 8:12 batchLength | 12:16 leaderEpoch |
+        # 16 magic | 17:21 crc | 21.. crc-covered body
+        if batch[16] != 2:
+            return 87  # INVALID_RECORD
+        stored_crc = U32.unpack_from(batch, 17)[0]
+        body = batch[21:]
+        if crc32c(body) != stored_crc:
+            with self.lock:
+                self.crc_errors += 1
+            return 87
+        r = 2 + 4 + 8 + 8 + 8 + 2 + 4          # attrs..baseSequence
+        count = I32.unpack_from(body, r)[0]
+        j = r + 4
+        got = []
+        for _ in range(count):
+            rec_len, j = dec_varint(body, j)
+            end = j + rec_len
+            j += 1                              # attributes
+            _, j = dec_varint(body, j)          # timestampDelta
+            _, j = dec_varint(body, j)          # offsetDelta
+            klen, j = dec_varint(body, j)
+            key = body[j:j + klen]
+            j += klen
+            vlen, j = dec_varint(body, j)
+            value = body[j:j + vlen]
+            j += vlen
+            nh, j = dec_varint(body, j)
+            assert nh == 0 and j == end
+            got.append((bytes(key), bytes(value)))
+        with self.lock:
+            self.records.setdefault((topic, pid), []).extend(got)
+        return 0

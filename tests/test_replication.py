@@ -266,13 +266,18 @@ class _MiniSqs:
                 body = self.rfile.read(n)
                 amz_date = self.headers.get("X-Amz-Date", "")
                 date = amz_date[:8]
-                canonical_headers = (
-                    f"content-type:{self.headers.get('Content-Type','')}\n"
-                    f"host:{self.headers.get('Host','')}\n"
-                    f"x-amz-date:{amz_date}\n")
+                # generic SigV4 verification: canonicalize exactly the
+                # headers the client declared in SignedHeaders
+                auth = self.headers.get("Authorization", "")
+                signed = ""
+                for part in auth.split(", "):
+                    if part.startswith("SignedHeaders="):
+                        signed = part[len("SignedHeaders="):]
+                canonical_headers = "".join(
+                    f"{h}:{(self.headers.get(h) or '').strip()}\n"
+                    for h in signed.split(";") if h)
                 creq = "\n".join([
-                    "POST", self.path, "", canonical_headers,
-                    "content-type;host;x-amz-date",
+                    "POST", self.path, "", canonical_headers, signed,
                     hashlib.sha256(body).hexdigest()])
                 scope = f"{date}/{outer.region}/sqs/aws4_request"
                 sts = "\n".join(["AWS4-HMAC-SHA256", amz_date, scope,
@@ -283,7 +288,6 @@ class _MiniSqs:
                                     hashlib.sha256).digest()
                 want = _hmac.new(key, sts.encode(),
                                  hashlib.sha256).hexdigest()
-                auth = self.headers.get("Authorization", "")
                 if f"Signature={want}" not in auth \
                         or f"Credential={outer.access_key}/" not in auth:
                     payload = b"<ErrorResponse>SignatureDoesNotMatch</ErrorResponse>"
@@ -356,5 +360,9 @@ def test_sqs_queue_from_config():
         "enabled": True, "queue_url": "http://sqs.local/1/q",
         "region": "eu-west-1", "aws_access_key_id": "A",
         "aws_secret_access_key": "S"}}})
-    assert isinstance(q, SqsQueue)
-    assert q.region == "eu-west-1" and q.path == "/1/q"
+    # network queues ride the async publisher so filer mutations never
+    # block on broker round trips
+    from seaweedfs_tpu.replication.notification import AsyncPublisher
+    assert isinstance(q, AsyncPublisher)
+    assert isinstance(q.inner, SqsQueue)
+    assert q.inner.region == "eu-west-1" and q.inner.path == "/1/q"

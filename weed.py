@@ -103,6 +103,15 @@ def _make_filer_store(db: str):
     return SqliteStore(db)
 
 
+def _notification_queue():
+    """notification.toml -> queue (log/file/memory/kafka/aws_sqs), or
+    None when no section is enabled."""
+    from seaweedfs_tpu.replication.notification import load_notification_queue
+    from seaweedfs_tpu.utils.config import load_configuration
+
+    return load_notification_queue(load_configuration("notification").data)
+
+
 def cmd_filer(args) -> None:
     from seaweedfs_tpu.filer.server import FilerServer
     from seaweedfs_tpu.gateway.s3 import S3ApiServer
@@ -116,6 +125,7 @@ def cmd_filer(args) -> None:
                     chunk_cache_mem_mb=args.cacheSizeMB,
                     guard=filer_guard(_security()),
                     peers=[p for p in args.peers.split(",") if p],
+                    notification_queue=_notification_queue(),
                     tls_context=_cluster_tls()).start()
     print(f"filer listening on {f.url}")
     if args.s3:
@@ -147,7 +157,8 @@ def cmd_server(args) -> None:
     print(f"master on {m.url}, volume server on {vs.url}")
     if args.filer:
         store = SqliteStore(args.dir.split(",")[0] + "/filer.db")
-        f = FilerServer(m.url, store, host=args.ip, port=args.filerPort).start()
+        f = FilerServer(m.url, store, host=args.ip, port=args.filerPort,
+                        notification_queue=_notification_queue()).start()
         print(f"filer on {f.url}")
         if args.s3:
             s3 = S3ApiServer(f, host=args.ip, port=args.s3Port).start()
@@ -354,11 +365,23 @@ _SCAFFOLDS = {
 ''',
     "notification": '''\
 # notification.toml — filer mutation events to an external queue
-# (scaffold/notification.toml analog). Built-in queues: log, memory,
-# file; kafka/sqs gated on their SDKs.
-
-[notification.log]
+# (scaffold/notification.toml analog).
+#
+# [notification.log]
 # enabled = true
+# [notification.file]
+# enabled = true
+# path = "/var/log/weed-events.jsonl"
+# [notification.kafka]          # wire-protocol producer, no SDK needed
+# enabled = true
+# hosts = ["broker1:9092"]
+# topic = "seaweedfs"
+# [notification.aws_sqs]        # stdlib SigV4 client
+# enabled = true
+# queue_url = "https://sqs.us-east-1.amazonaws.com/123/weed-events"
+# region = "us-east-1"
+# aws_access_key_id = ""
+# aws_secret_access_key = ""
 ''',
     "shell": '''\
 # shell.toml — initial commands for `weed shell`
