@@ -106,6 +106,7 @@ class FilerServer:
         self.filer.subscribe(self._maybe_mark_conf_dirty, since_ns=time.time_ns())
         # external notification queue (notification/configuration.go):
         # every mutation event is published as (path, event)
+        self._notification_queue = notification_queue
         if notification_queue is not None:
             self.filer.subscribe(
                 lambda ev: notification_queue.send_message(
@@ -181,6 +182,11 @@ class FilerServer:
 
             stop_server(self._server)
         self.filer.close()
+        # drain async notification publishers so a clean shutdown does
+        # not lose the tail of accepted events
+        q = self._notification_queue
+        if q is not None and hasattr(q, "close"):
+            q.close()
         self.client.close()
 
     # --- chunk IO ---------------------------------------------------------

@@ -290,9 +290,7 @@ class S3RemoteStorage(RemoteStorageClient):
             raise HttpError(status, body.decode(errors="replace"))
 
 
-_GATED = {
-    "hdfs": "pyarrow/hdfs",
-}
+
 
 
 def make_client(conf: RemoteConf) -> RemoteStorageClient:
@@ -304,6 +302,10 @@ def make_client(conf: RemoteConf) -> RemoteStorageClient:
         from .azure import AzureRemoteStorage
 
         return AzureRemoteStorage(conf)
+    if conf.type == "hdfs":
+        from .hdfs import HdfsRemoteStorage
+
+        return HdfsRemoteStorage(conf)
     if conf.type == "gcs":
         # GCS interoperability mode speaks the S3 XML API with HMAC keys
         # — same client, defaulting the host to the interop endpoint
@@ -313,8 +315,4 @@ def make_client(conf: RemoteConf) -> RemoteStorageClient:
             conf = dataclasses.replace(conf,
                                        endpoint="storage.googleapis.com")
         return S3RemoteStorage(conf)
-    if conf.type in _GATED:
-        raise RuntimeError(
-            f"remote storage type {conf.type!r} requires {_GATED[conf.type]}"
-            " which is not available in this environment")
     raise ValueError(f"unknown remote storage type {conf.type!r}")

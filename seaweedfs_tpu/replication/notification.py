@@ -163,6 +163,7 @@ class AsyncPublisher(NotificationQueue):
         self._q: "_queue.Queue" = _queue.Queue(maxsize)
         self.dropped = 0
         self.errors = 0
+        self._closed = False
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="notify-publisher")
         self._thread.start()
@@ -188,7 +189,10 @@ class AsyncPublisher(NotificationQueue):
 
     def _run(self) -> None:
         while True:
-            key, event = self._q.get()
+            item = self._q.get()
+            if item is None:  # close() sentinel after a drain
+                return
+            key, event = item
             try:
                 self.inner.send_message(key, event)
             except Exception as e:  # noqa: BLE001 - keep publishing
@@ -198,6 +202,20 @@ class AsyncPublisher(NotificationQueue):
 
                     V(0).infof("notification publish failed (%d so far): "
                                "%s: %s", self.errors, type(e).__name__, e)
+
+    def close(self, timeout: float = 10.0) -> None:
+        """Drain pending events (bounded) so a clean filer shutdown does
+        not silently lose the tail of accepted notifications."""
+        if self._closed:
+            return
+        self._closed = True
+        self._q.put(None)  # sentinel: everything queued before it drains
+        self._thread.join(timeout)
+        if self._thread.is_alive():
+            from ..utils.glog import V
+
+            V(0).infof("notification publisher close timed out with "
+                       "~%d events pending", self._q.qsize())
 
 
 def load_notification_queue(conf: dict) -> Optional[NotificationQueue]:

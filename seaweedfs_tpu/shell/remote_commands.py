@@ -33,7 +33,7 @@ def _loc_parse(s: str) -> RemoteLocation:
 
 @command("remote.configure")
 def cmd_remote_configure(env: CommandEnv, flags: dict) -> str:
-    """remote.configure [-name n -type local|s3|azure|gcs [-root /dir]
+    """remote.configure [-name n -type local|s3|azure|gcs|hdfs [-root /dir]
     [-endpoint host:port] [-accessKey k -secretKey s] | -delete -name n]
     # create/update/delete named remote storage configurations"""
     confs = read_remote_conf(_filer(env))

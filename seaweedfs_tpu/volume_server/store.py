@@ -85,10 +85,15 @@ class _SwapLock:
         self._cond = threading.Condition()
         self._shared = 0
         self._exclusive = False
+        self._writers_waiting = 0
 
     def acquire_shared(self):
         with self._cond:
-            while self._exclusive:
+            # writer preference: new readers also wait while a swap is
+            # QUEUED, or sustained write traffic would starve
+            # reattach/detach forever (which hold volume_locks -> every
+            # maintenance path would hang behind them)
+            while self._exclusive or self._writers_waiting:
                 self._cond.wait()
             self._shared += 1
 
@@ -100,9 +105,13 @@ class _SwapLock:
 
     def acquire_exclusive(self):
         with self._cond:
-            while self._exclusive or self._shared:
-                self._cond.wait()
-            self._exclusive = True
+            self._writers_waiting += 1
+            try:
+                while self._exclusive or self._shared:
+                    self._cond.wait()
+                self._exclusive = True
+            finally:
+                self._writers_waiting -= 1
 
     def release_exclusive(self):
         with self._cond:
@@ -634,11 +643,11 @@ class Store:
                 ValueError, OSError):
             # possibly a stale volume object mid-quiesce-swap (its map is
             # frozen at the last attach, and its closed .dat handle never
-            # comes back): settle under the volume lock, which serializes
-            # with the swap, and ask both engines again.  A miss with no
-            # hold outstanding and no registration is a PLAIN miss
-            # (ineligible or permanently detached volume) — don't tax
-            # every 404 with the write lock
+            # comes back): settle on the SHARED swap lock — serializing
+            # with detach/reattach swaps but not with compaction or other
+            # readers — and ask both engines again.  A miss with no hold
+            # outstanding and no registration is a PLAIN miss (ineligible
+            # or permanently detached volume): skip the settle entirely
             if not self._native_holds.get(vid) and not plane.has(vid):
                 raise
             with self._swap_lock(vid).shared():

@@ -1,0 +1,147 @@
+"""In-process WebHDFS namenode/datanode double for HdfsRemoteStorage.
+
+Implements the REST subset the client uses — LISTSTATUS, OPEN (with
+offset/length), the two-step 307-redirect CREATE, DELETE (recursive),
+MKDIRS — over an in-memory tree, mirroring the response JSON shapes the
+Hadoop docs specify.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+class MiniHdfs:
+    def __init__(self):
+        self.files: dict[str, bytes] = {}       # absolute path -> bytes
+        self.dirs: set[str] = {"/"}
+        self.lock = threading.Lock()
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                pass
+
+            def _reply(self, status, body=b"", headers=None):
+                self.send_response(status)
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                if body:
+                    self.wfile.write(body)
+
+            def _parts(self):
+                parsed = urllib.parse.urlparse(self.path)
+                assert parsed.path.startswith("/webhdfs/v1")
+                fs_path = urllib.parse.unquote(
+                    parsed.path[len("/webhdfs/v1"):]) or "/"
+                query = dict(urllib.parse.parse_qsl(parsed.query))
+                return fs_path, query
+
+            def do_GET(self):
+                fs_path, q = self._parts()
+                op = q.get("op", "").upper()
+                with outer.lock:
+                    if op == "LISTSTATUS":
+                        if fs_path not in outer.dirs:
+                            self._reply(404, json.dumps({
+                                "RemoteException": {
+                                    "exception": "FileNotFoundException"
+                                }}).encode())
+                            return
+                        entries = []
+                        prefix = fs_path.rstrip("/") + "/"
+                        seen = set()
+                        for p in sorted(outer.files):
+                            if p.startswith(prefix):
+                                rest = p[len(prefix):]
+                                name = rest.split("/", 1)[0]
+                                if "/" not in rest and name not in seen:
+                                    seen.add(name)
+                                    entries.append({
+                                        "pathSuffix": name, "type": "FILE",
+                                        "length": len(outer.files[p]),
+                                        "modificationTime": 1700000000000})
+                        for d in sorted(outer.dirs):
+                            if d.startswith(prefix):
+                                rest = d[len(prefix):]
+                                if rest and "/" not in rest \
+                                        and rest not in seen:
+                                    seen.add(rest)
+                                    entries.append({
+                                        "pathSuffix": rest,
+                                        "type": "DIRECTORY", "length": 0,
+                                        "modificationTime": 1700000000000})
+                        self._reply(200, json.dumps({"FileStatuses": {
+                            "FileStatus": entries}}).encode())
+                    elif op == "OPEN":
+                        data = outer.files.get(fs_path)
+                        if data is None:
+                            self._reply(404, b'{"RemoteException":{}}')
+                            return
+                        off = int(q.get("offset", 0))
+                        length = int(q.get("length", len(data) - off))
+                        self._reply(200, data[off:off + length])
+                    else:
+                        self._reply(400)
+
+            def do_PUT(self):
+                fs_path, q = self._parts()
+                op = q.get("op", "").upper()
+                n = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(n) if n else b""
+                with outer.lock:
+                    if op == "CREATE":
+                        if "redirected" not in q:
+                            # namenode step: redirect to "the datanode"
+                            loc = (f"http://127.0.0.1:{outer.port}"
+                                   f"{urllib.parse.quote('/webhdfs/v1' + fs_path)}"
+                                   f"?op=CREATE&redirected=1")
+                            self._reply(307, headers={"Location": loc})
+                            return
+                        outer.files[fs_path] = body
+                        d = fs_path.rsplit("/", 1)[0] or "/"
+                        while d and d not in outer.dirs:
+                            outer.dirs.add(d)
+                            d = d.rsplit("/", 1)[0] or "/"
+                        self._reply(201)
+                    elif op == "MKDIRS":
+                        d = fs_path
+                        while d and d not in outer.dirs:
+                            outer.dirs.add(d)
+                            d = d.rsplit("/", 1)[0] or "/"
+                        self._reply(200, b'{"boolean": true}')
+                    else:
+                        self._reply(400)
+
+            def do_DELETE(self):
+                fs_path, q = self._parts()
+                with outer.lock:
+                    existed = outer.files.pop(fs_path, None) is not None
+                    if q.get("recursive") == "true":
+                        pref = fs_path.rstrip("/") + "/"
+                        for p in [p for p in outer.files
+                                  if p.startswith(pref)]:
+                            del outer.files[p]
+                            existed = True
+                        for d in [d for d in outer.dirs
+                                  if d.startswith(pref) or d == fs_path]:
+                            outer.dirs.discard(d)
+                            existed = True
+                    self._reply(200, json.dumps(
+                        {"boolean": existed}).encode())
+
+        self._srv = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self._srv.daemon_threads = True
+        self.port = self._srv.server_address[1]
+        threading.Thread(target=self._srv.serve_forever, daemon=True).start()
+
+    def stop(self):
+        self._srv.shutdown()
+        self._srv.server_close()

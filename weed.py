@@ -153,7 +153,8 @@ def cmd_server(args) -> None:
     m = MasterServer(host=args.ip, port=args.masterPort).start()
     vs = VolumeServer(args.dir.split(","), m.url, host=args.ip,
                       port=args.port, ec_engine=args.ec_engine,
-                      use_mmap=args.mmap).start()
+                      use_mmap=args.mmap,
+                      dataplane=args.dataplane).start()
     print(f"master on {m.url}, volume server on {vs.url}")
     if args.filer:
         store = SqliteStore(args.dir.split(",")[0] + "/filer.db")
@@ -838,6 +839,9 @@ def main(argv=None) -> None:
                    choices=["cpu", "tpu"])
     s.add_argument("-mmap", action="store_true",
                    help="mmap-backed .dat files (backend/memory_map analog)")
+    s.add_argument("-dataplane", default="python",
+                   choices=["python", "native"],
+                   help="native: C++ GIL-free framed-TCP needle IO")
     s.set_defaults(fn=cmd_server)
 
     fl = sub.add_parser("filer")
