@@ -209,7 +209,20 @@ class AsyncPublisher(NotificationQueue):
         if self._closed:
             return
         self._closed = True
-        self._q.put(None)  # sentinel: everything queued before it drains
+        import queue as _queue
+
+        try:  # non-blocking: a full queue must not stall shutdown
+            self._q.put_nowait(None)
+        except _queue.Full:
+            try:  # drop the oldest so the sentinel fits
+                self._q.get_nowait()
+                self.dropped += 1
+            except _queue.Empty:
+                pass
+            try:
+                self._q.put_nowait(None)
+            except _queue.Full:
+                pass  # worker is wedged; join() below stays bounded
         self._thread.join(timeout)
         if self._thread.is_alive():
             from ..utils.glog import V

@@ -1,10 +1,11 @@
 """Replication sinks: targets that filer events are applied to.
 
-Equivalent of weed/replication/sink/ (filersink, localsink, s3sink,
-azuresink/gcssink/b2sink are SDK-gated stubs here).  A sink receives the
-fully-resolved file CONTENT (the replicator fetches chunk bytes from the
-source cluster) — sinks never see source fids, so they work across
-clusters with disjoint volume servers.
+Equivalent of weed/replication/sink/ — localsink, filersink, s3sink
+(also serving gcs and b2 through their S3-compatible endpoints), an
+azuresink over the REST SharedKey client, and an hdfssink over WebHDFS.
+A sink receives the fully-resolved file CONTENT (the replicator fetches
+chunk bytes from the source cluster) — sinks never see source fids, so
+they work across clusters with disjoint volume servers.
 """
 
 from __future__ import annotations
@@ -153,6 +154,32 @@ class S3Sink(ReplicationSink):
         http_bytes("DELETE", url)
 
 
+class RemoteStorageSink(ReplicationSink):
+    """Adapter: any remote_storage client (azure, hdfs, gcs, s3) as a
+    replication sink — azuresink/gcssink analog without new wire code."""
+
+    def __init__(self, client, bucket: str, directory: str = ""):
+        from ..remote_storage.client import RemoteLocation
+
+        self.client = client
+        self.loc = RemoteLocation(conf_name="sink", bucket=bucket)
+        self.directory = "/" + directory.strip("/") if directory else ""
+
+    def _key(self, key: str) -> str:
+        return f"{self.directory}/{key.lstrip('/')}"
+
+    def create_entry(self, key: str, entry: dict,
+                     data: Optional[bytes]) -> None:
+        if _is_dir(entry):
+            return  # object stores have no directories
+        self.client.write_file(self.loc, self._key(key), data or b"")
+
+    def delete_entry(self, key: str, is_directory: bool) -> None:
+        if is_directory:
+            return
+        self.client.delete_file(self.loc, self._key(key))
+
+
 def load_sink(conf: dict) -> ReplicationSink:
     """replication/replicator.go sink selection from replication.toml."""
     if conf.get("sink.local", {}).get("enabled"):
@@ -166,4 +193,22 @@ def load_sink(conf: dict) -> ReplicationSink:
         return S3Sink(c["endpoint"], c["bucket"], c.get("directory", ""),
                       c.get("aws_access_key_id", ""),
                       c.get("aws_secret_access_key", ""))
+    if conf.get("sink.azure", {}).get("enabled"):
+        from ..remote_storage.client import RemoteConf, make_client
+
+        c = conf["sink.azure"]
+        client = make_client(RemoteConf(
+            name="sink", type="azure", endpoint=c.get("endpoint", ""),
+            access_key=c.get("account_name", ""),
+            secret_key=c.get("account_key", "")))
+        return RemoteStorageSink(client, c["container"],
+                                 c.get("directory", ""))
+    if conf.get("sink.hdfs", {}).get("enabled"):
+        from ..remote_storage.client import RemoteConf, make_client
+
+        c = conf["sink.hdfs"]
+        client = make_client(RemoteConf(
+            name="sink", type="hdfs", endpoint=c["namenode"],
+            root=c.get("root", "/"), access_key=c.get("username", "")))
+        return RemoteStorageSink(client, c.get("directory", "weed"))
     raise ValueError("no enabled sink in replication config")

@@ -366,3 +366,50 @@ def test_sqs_queue_from_config():
     assert isinstance(q, AsyncPublisher)
     assert isinstance(q.inner, SqsQueue)
     assert q.inner.region == "eu-west-1" and q.inner.path == "/1/q"
+
+
+# --------------------------------------------------------------------------
+# azure / hdfs sinks via the remote-storage adapter
+# --------------------------------------------------------------------------
+
+def test_azure_sink_end_to_end():
+    import base64
+
+    from seaweedfs_tpu.replication.sink import RemoteStorageSink, load_sink
+    from .miniazure import MiniAzure
+
+    srv = MiniAzure()
+    try:
+        sink = load_sink({"sink.azure": {
+            "enabled": True, "endpoint": f"127.0.0.1:{srv.port}",
+            "account_name": srv.account,
+            "account_key": base64.b64encode(srv.key).decode(),
+            "container": "backup", "directory": "mirror"}})
+        assert isinstance(sink, RemoteStorageSink)
+        sink.client.create_bucket("backup")
+        entry = {"attr": {"mode": 0o644}}
+        sink.create_entry("/docs/a.txt", entry, b"azure mirror")
+        assert srv.containers["backup"]["mirror/docs/a.txt"] == b"azure mirror"
+        sink.delete_entry("/docs/a.txt", is_directory=False)
+        assert "mirror/docs/a.txt" not in srv.containers["backup"]
+    finally:
+        srv.stop()
+
+
+def test_hdfs_sink_end_to_end():
+    from seaweedfs_tpu.replication.sink import RemoteStorageSink, load_sink
+    from .minihdfs import MiniHdfs
+
+    srv = MiniHdfs()
+    try:
+        sink = load_sink({"sink.hdfs": {
+            "enabled": True, "namenode": f"127.0.0.1:{srv.port}",
+            "directory": "weed-backup"}})
+        assert isinstance(sink, RemoteStorageSink)
+        entry = {"attr": {"mode": 0o644}}
+        sink.create_entry("/logs/x.log", entry, b"hdfs mirror")
+        assert srv.files["/weed-backup/logs/x.log"] == b"hdfs mirror"
+        sink.delete_entry("/logs/x.log", is_directory=False)
+        assert "/weed-backup/logs/x.log" not in srv.files
+    finally:
+        srv.stop()

@@ -350,6 +350,20 @@ _SCAFFOLDS = {
 # bucket = "backup"
 # access_key = ""
 # secret_key = ""
+
+[sink.azure]                    # REST SharedKey, no SDK
+# enabled = true
+# account_name = ""
+# account_key = ""              # base64
+# container = "backup"
+# directory = "mirror"
+# endpoint = ""                 # leave empty for real Azure (https)
+
+[sink.hdfs]                     # WebHDFS
+# enabled = true
+# namenode = "namenode:9870"
+# username = ""
+# directory = "weed-backup"
 ''',
     "master": '''\
 # master.toml — maintenance scripts run on the leader under the admin
