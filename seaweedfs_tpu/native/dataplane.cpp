@@ -45,6 +45,7 @@
 #include <sys/socket.h>
 #include <sys/stat.h>
 #include <atomic>
+#include <condition_variable>
 #include <thread>
 #include <unistd.h>
 #include <unordered_map>
@@ -125,6 +126,13 @@ static uint64_t get_u64(const uint8_t* p) {
     return v;
 }
 
+// error codes surfaced to Python / the wire
+enum {
+    DP_OK = 0, DP_NOT_FOUND = -2, DP_COOKIE = -3, DP_DELETED = -4,
+    DP_READONLY = -5, DP_NO_VOLUME = -6, DP_IO = -7, DP_CRC = -8,
+    DP_BAD_REQ = -9, DP_FULL = -10,
+};
+
 // ------------------------------------------------------------- volume
 struct NeedleVal { uint64_t offset; int32_t size; };
 
@@ -139,6 +147,19 @@ struct Volume {
     std::unordered_map<uint64_t, NeedleVal> map;
     std::mutex write_mu;     // serializes append (.dat + .idx + map)
     std::mutex map_mu;       // guards map for lock-free-ish readers
+    // group-commit fsync (volume_write.go's batch worker analog): many
+    // concurrent durable writers share one fsync pass
+    std::mutex sync_mu;
+    std::condition_variable sync_cv;
+    uint64_t sync_pending = 0;   // highest requested generation
+    uint64_t sync_done = 0;      // highest completed generation
+    uint64_t sync_passes = 0;    // actual fsync() pairs performed
+    uint64_t sync_fail_gen = 0;  // highest generation covered by a FAILED
+                                 // pass — failures stay sticky for every
+                                 // waiter they covered (Linux fsync drops
+                                 // dirty pages on error; a later clean
+                                 // pass does not make that data durable)
+    bool sync_running = false;
 
     ~Volume() {
         if (dat_fd >= 0) close(dat_fd);
@@ -150,13 +171,6 @@ using VolumeRef = std::shared_ptr<Volume>;
 
 constexpr int32_t TOMBSTONE = -1;
 constexpr uint8_t FLAG_IS_COMPRESSED = 0x01;
-
-// error codes surfaced to Python / the wire
-enum {
-    DP_OK = 0, DP_NOT_FOUND = -2, DP_COOKIE = -3, DP_DELETED = -4,
-    DP_READONLY = -5, DP_NO_VOLUME = -6, DP_IO = -7, DP_CRC = -8,
-    DP_BAD_REQ = -9, DP_FULL = -10,
-};
 
 static const char* dp_strerror(int code) {
     switch (code) {
@@ -196,6 +210,35 @@ static VolumeRef find_volume(Server* s, uint32_t vid) {
     std::lock_guard<std::mutex> g(s->vol_mu);
     auto it = s->volumes.find(vid);
     return it == s->volumes.end() ? nullptr : it->second;
+}
+
+// Group-commit durable sync: every caller whose appends happened before
+// its generation is covered by ONE fsync pass; appends are NOT blocked
+// while the pass runs (fsync happens outside write_mu).
+static int vol_group_sync(Volume* v) {
+    std::unique_lock<std::mutex> lk(v->sync_mu);
+    uint64_t my_gen = ++v->sync_pending;
+    for (;;) {
+        if (v->sync_done >= my_gen)
+            return my_gen <= v->sync_fail_gen ? DP_IO : DP_OK;
+        if (!v->sync_running) {
+            v->sync_running = true;
+            uint64_t target = v->sync_pending;
+            lk.unlock();
+            int rc = DP_OK;
+            if (fsync(v->dat_fd) != 0 || fsync(v->idx_fd) != 0)
+                rc = DP_IO;
+            lk.lock();
+            v->sync_running = false;
+            v->sync_done = target;
+            if (rc != DP_OK && target > v->sync_fail_gen)
+                v->sync_fail_gen = target;
+            v->sync_passes++;
+            v->sync_cv.notify_all();
+            continue;  // loop observes sync_done >= my_gen
+        }
+        v->sync_cv.wait(lk);
+    }
 }
 
 // needle record size on disk for a stored `size` (types.go GetActualSize)
@@ -751,12 +794,17 @@ void dp_free(void* p) { free(p); }
 int dp_stat(void* h, unsigned vid, unsigned long long* dat_size,
             unsigned long long* file_count,
             unsigned long long* max_file_key,
-            unsigned long long* deleted_bytes) {
+            unsigned long long* deleted_bytes,
+            unsigned long long* sync_passes) {
     VolumeRef v = find_volume((Server*)h, vid);
     if (!v) return DP_NO_VOLUME;
     *dat_size = v->dat_size;
     *max_file_key = v->max_key;
     *deleted_bytes = v->deleted_bytes;
+    {
+        std::lock_guard<std::mutex> s(v->sync_mu);
+        *sync_passes = v->sync_passes;
+    }
     std::lock_guard<std::mutex> m(v->map_mu);
     *file_count = v->map.size();
     return DP_OK;
@@ -765,10 +813,10 @@ int dp_stat(void* h, unsigned vid, unsigned long long* dat_size,
 int dp_sync(void* h, unsigned vid) {
     VolumeRef v = find_volume((Server*)h, vid);
     if (!v) return DP_NO_VOLUME;
-    std::lock_guard<std::mutex> g(v->write_mu);
-    if (v->retired) return DP_NO_VOLUME;
-    if (fsync(v->dat_fd) != 0 || fsync(v->idx_fd) != 0) return DP_IO;
-    return DP_OK;
+    // group commit: concurrent durable writers share one fsync pass, and
+    // appends keep flowing while it runs (the VolumeRef keeps fds alive
+    // across a concurrent retire)
+    return vol_group_sync(v.get());
 }
 
 void dp_stop(void* h) {

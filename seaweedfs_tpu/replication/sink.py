@@ -171,7 +171,9 @@ class RemoteStorageSink(ReplicationSink):
     def create_entry(self, key: str, entry: dict,
                      data: Optional[bytes]) -> None:
         if _is_dir(entry):
-            return  # object stores have no directories
+            # object stores have no directories, and WebHDFS creates
+            # parent directories implicitly on CREATE
+            return
         self.client.write_file(self.loc, self._key(key), data or b"")
 
     def delete_entry(self, key: str, is_directory: bool) -> None:
@@ -210,5 +212,7 @@ def load_sink(conf: dict) -> ReplicationSink:
         client = make_client(RemoteConf(
             name="sink", type="hdfs", endpoint=c["namenode"],
             root=c.get("root", "/"), access_key=c.get("username", "")))
+        # the target directory plays the bucket role (a top-level dir
+        # under the configured root), mirroring the hdfs bucket mapping
         return RemoteStorageSink(client, c.get("directory", "weed"))
     raise ValueError("no enabled sink in replication config")

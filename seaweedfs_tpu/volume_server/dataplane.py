@@ -87,6 +87,7 @@ def load_dataplane():
             ctypes.POINTER(ctypes.c_ulonglong),
             ctypes.POINTER(ctypes.c_ulonglong),
             ctypes.POINTER(ctypes.c_ulonglong),
+            ctypes.POINTER(ctypes.c_ulonglong),
             ctypes.POINTER(ctypes.c_ulonglong)]
         lib.dp_sync.argtypes = [ctypes.c_void_p, ctypes.c_uint]
         lib.dp_stop.argtypes = [ctypes.c_void_p]
@@ -203,18 +204,24 @@ class NativeDataPlane:
     def stat(self, vid: int) -> Optional[tuple[int, int, int, int]]:
         """(dat_size, live file_count, max_file_key, deleted_bytes), or
         None if the volume is not registered."""
+        full = self.stat_full(vid)
+        return None if full is None else full[:4]
+
+    def stat_full(self, vid: int) -> Optional[tuple[int, int, int, int, int]]:
+        """stat() plus the group-commit fsync pass count."""
         if self._h is None:
             return None
         ds = ctypes.c_ulonglong()
         fc = ctypes.c_ulonglong()
         mk = ctypes.c_ulonglong()
         db = ctypes.c_ulonglong()
+        sp = ctypes.c_ulonglong()
         rc = self._lib.dp_stat(self._h, vid, ctypes.byref(ds),
                                ctypes.byref(fc), ctypes.byref(mk),
-                               ctypes.byref(db))
+                               ctypes.byref(db), ctypes.byref(sp))
         if rc != DP_OK:
             return None
-        return ds.value, fc.value, mk.value, db.value
+        return ds.value, fc.value, mk.value, db.value, sp.value
 
     def sync(self, vid: int) -> None:
         rc = self._lib.dp_sync(self._handle(), vid)
