@@ -154,11 +154,13 @@ struct Volume {
     uint64_t sync_pending = 0;   // highest requested generation
     uint64_t sync_done = 0;      // highest completed generation
     uint64_t sync_passes = 0;    // actual fsync() pairs performed
-    uint64_t sync_fail_gen = 0;  // highest generation covered by a FAILED
-                                 // pass — failures stay sticky for every
-                                 // waiter they covered (Linux fsync drops
-                                 // dirty pages on error; a later clean
-                                 // pass does not make that data durable)
+    bool sync_failed = false;    // PERMANENTLY sticky: a failed fsync
+                                 // drops dirty pages (appends from ANY
+                                 // generation) and clears the kernel
+                                 // error, so no later pass can prove
+                                 // durability — every durable write on
+                                 // this registration fails until the
+                                 // operator re-registers the volume
     bool sync_running = false;
 
     ~Volume() {
@@ -217,10 +219,11 @@ static VolumeRef find_volume(Server* s, uint32_t vid) {
 // while the pass runs (fsync happens outside write_mu).
 static int vol_group_sync(Volume* v) {
     std::unique_lock<std::mutex> lk(v->sync_mu);
+    if (v->sync_failed) return DP_IO;
     uint64_t my_gen = ++v->sync_pending;
     for (;;) {
-        if (v->sync_done >= my_gen)
-            return my_gen <= v->sync_fail_gen ? DP_IO : DP_OK;
+        if (v->sync_failed) return DP_IO;
+        if (v->sync_done >= my_gen) return DP_OK;
         if (!v->sync_running) {
             v->sync_running = true;
             uint64_t target = v->sync_pending;
@@ -231,11 +234,11 @@ static int vol_group_sync(Volume* v) {
             lk.lock();
             v->sync_running = false;
             v->sync_done = target;
-            if (rc != DP_OK && target > v->sync_fail_gen)
-                v->sync_fail_gen = target;
+            if (rc != DP_OK)
+                v->sync_failed = true;
             v->sync_passes++;
             v->sync_cv.notify_all();
-            continue;  // loop observes sync_done >= my_gen
+            continue;  // loop observes sync_done / sync_failed
         }
         v->sync_cv.wait(lk);
     }

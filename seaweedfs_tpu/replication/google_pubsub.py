@@ -1,0 +1,119 @@
+"""Google Cloud Pub/Sub notification queue over REST — no SDK.
+
+Equivalent of weed/notification/google_pub_sub/google_pub_sub.go (the
+reference links the cloud.google.com/go/pubsub client).  This rebuild
+speaks the JSON API directly:
+
+  - service-account auth: an RS256-signed JWT grant exchanged at the
+    OAuth token endpoint for a bearer token (cached until near expiry);
+  - publish: ``POST /v1/projects/{p}/topics/{t}:publish`` with base64
+    message data and the filer path as an attribute.
+
+RS256 signing uses the ``cryptography`` package (present in this
+environment as a transitive dependency).  Setting ``endpoint`` switches
+to emulator mode (the standard Pub/Sub emulator takes no auth).
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import time
+from typing import Optional
+
+from ..utils.httpd import HttpError, http_bytes
+
+TOKEN_URL = "https://oauth2.googleapis.com/token"
+PUBSUB_HOST = "pubsub.googleapis.com"
+SCOPE = "https://www.googleapis.com/auth/pubsub"
+
+
+def _b64url(b: bytes) -> str:
+    return base64.urlsafe_b64encode(b).rstrip(b"=").decode()
+
+
+def sign_jwt_rs256(claims: dict, private_key_pem: str,
+                   headers: Optional[dict] = None) -> str:
+    """Compact JWT with an RS256 signature (service-account grants)."""
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import padding
+
+    header = {"alg": "RS256", "typ": "JWT", **(headers or {})}
+    signing_input = (_b64url(json.dumps(header).encode()) + "."
+                     + _b64url(json.dumps(claims).encode()))
+    key = serialization.load_pem_private_key(
+        private_key_pem.encode(), password=None)
+    sig = key.sign(signing_input.encode(), padding.PKCS1v15(),
+                   hashes.SHA256())
+    return signing_input + "." + _b64url(sig)
+
+
+class GooglePubSubQueue:
+    """NotificationQueue over the Pub/Sub JSON API."""
+
+    def __init__(self, project_id: str, topic: str,
+                 google_application_credentials: str = "",
+                 endpoint: str = ""):
+        """credentials: path to a service-account JSON file (client_email
+        + private_key).  endpoint: host:port of an emulator (no auth)."""
+        self.project = project_id
+        self.topic = topic
+        self.endpoint = endpoint
+        self.creds: Optional[dict] = None
+        if not endpoint:
+            if not google_application_credentials:
+                raise ValueError(
+                    "google_pub_sub needs google_application_credentials "
+                    "(service-account JSON) or an emulator endpoint")
+            with open(google_application_credentials) as f:
+                self.creds = json.load(f)
+        self._token = ""
+        self._token_expiry = 0.0
+
+    # -- auth ---------------------------------------------------------------
+    def _bearer(self) -> str:
+        now = time.time()
+        if self._token and now < self._token_expiry - 60:
+            return self._token
+        claims = {
+            "iss": self.creds["client_email"],
+            "scope": SCOPE,
+            "aud": self.creds.get("token_uri", TOKEN_URL),
+            "iat": int(now),
+            "exp": int(now) + 3600,
+        }
+        assertion = sign_jwt_rs256(claims, self.creds["private_key"])
+        import urllib.parse
+
+        body = urllib.parse.urlencode({
+            "grant_type": "urn:ietf:params:oauth:grant-type:jwt-bearer",
+            "assertion": assertion}).encode()
+        status, resp, _ = http_bytes(
+            "POST", self.creds.get("token_uri", TOKEN_URL), body,
+            headers={"Content-Type": "application/x-www-form-urlencoded"})
+        if status != 200:
+            raise HttpError(status, resp.decode(errors="replace"))
+        tok = json.loads(resp)
+        self._token = tok["access_token"]
+        self._token_expiry = now + float(tok.get("expires_in", 3600))
+        return self._token
+
+    # -- publish ------------------------------------------------------------
+    def send_message(self, key: str, event: dict) -> None:
+        payload = json.dumps({"key": key, "event": event}).encode()
+        body = json.dumps({"messages": [{
+            "data": base64.b64encode(payload).decode(),
+            "attributes": {"key": key},
+        }]}).encode()
+        if self.endpoint:
+            url = (f"http://{self.endpoint}/v1/projects/{self.project}"
+                   f"/topics/{self.topic}:publish")
+            headers = {"Content-Type": "application/json"}
+        else:
+            url = (f"https://{PUBSUB_HOST}/v1/projects/{self.project}"
+                   f"/topics/{self.topic}:publish")
+            headers = {"Content-Type": "application/json",
+                       "Authorization": f"Bearer {self._bearer()}"}
+        status, resp, _ = http_bytes("POST", url, body, headers=headers)
+        if status != 200:
+            raise HttpError(status, resp.decode(errors="replace"))

@@ -3,11 +3,10 @@
 Equivalent of weed/notification/configuration.go + the plugin dirs
 (log, kafka, aws_sqs, google_pub_sub, gocdk_pub_sub): on every filer
 mutation the (key, EventNotification) pair is published to the
-configured queue.  In this rebuild a queue is anything with
-send_message(key, event); cloud broker clients are gated on their SDKs
-being present (none are baked into this environment — the FileQueue is
-the durable offline equivalent, and MemoryQueue serves in-process
-consumers/tests).
+configured queue.  All broker clients are SDK-free: kafka speaks the
+wire protocol, aws_sqs the SigV4 query API, google_pub_sub the JSON
+API with an RS256 service-account grant; FileQueue is the durable
+offline queue and MemoryQueue serves in-process consumers/tests.
 """
 
 from __future__ import annotations
@@ -249,6 +248,15 @@ def load_notification_queue(conf: dict) -> Optional[NotificationQueue]:
         return AsyncPublisher(KafkaQueue(n["kafka"].get("hosts", []),
                                          n["kafka"].get("topic",
                                                         "seaweedfs")))
+    if n.get("google_pub_sub", {}).get("enabled"):
+        from .google_pubsub import GooglePubSubQueue
+
+        g = n["google_pub_sub"]
+        return AsyncPublisher(GooglePubSubQueue(
+            g.get("project_id", ""), g.get("topic", "seaweedfs"),
+            google_application_credentials=g.get(
+                "google_application_credentials", ""),
+            endpoint=g.get("endpoint", "")))
     if n.get("aws_sqs", {}).get("enabled"):
         s = n["aws_sqs"]
         return AsyncPublisher(SqsQueue(

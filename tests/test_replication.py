@@ -413,3 +413,170 @@ def test_hdfs_sink_end_to_end():
         assert "/weed-backup/logs/x.log" not in srv.files
     finally:
         srv.stop()
+
+
+# --------------------------------------------------------------------------
+# google pub/sub queue (REST + RS256 service-account grant)
+# --------------------------------------------------------------------------
+
+class _MiniPubSub:
+    """Double acting as BOTH the OAuth token endpoint and the Pub/Sub
+    publish endpoint; verifies the RS256 JWT grant with the service
+    account's public key before issuing a token, and checks the bearer
+    on publish."""
+
+    def __init__(self):
+        import json as _json
+        import threading
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        from cryptography.hazmat.primitives import hashes, serialization
+        from cryptography.hazmat.primitives.asymmetric import padding, rsa
+
+        key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+        self.private_pem = key.private_bytes(
+            serialization.Encoding.PEM,
+            serialization.PrivateFormat.PKCS8,
+            serialization.NoEncryption()).decode()
+        public = key.public_key()
+        self.messages = []
+        self.token = "tok-123"
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                pass
+
+            def do_POST(self):
+                import base64 as _b64
+                import urllib.parse
+
+                n = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(n)
+                if self.path == "/token":
+                    form = dict(urllib.parse.parse_qsl(body.decode()))
+                    jwt = form.get("assertion", "")
+                    signing_input, _, sig_b64 = jwt.rpartition(".")
+                    sig = _b64.urlsafe_b64decode(
+                        sig_b64 + "=" * (-len(sig_b64) % 4))
+                    try:
+                        public.verify(sig, signing_input.encode(),
+                                      padding.PKCS1v15(), hashes.SHA256())
+                    except Exception:
+                        self._reply(401, b'{"error":"bad signature"}')
+                        return
+                    claims = _json.loads(_b64.urlsafe_b64decode(
+                        signing_input.split(".")[1] + "=="))
+                    assert claims["iss"] == "svc@proj.iam.example"
+                    self._reply(200, _json.dumps({
+                        "access_token": outer.token,
+                        "expires_in": 3600}).encode())
+                elif self.path.endswith(":publish"):
+                    # emulator mode (token None): no Authorization header
+                    want = (None if outer.token is None
+                            else f"Bearer {outer.token}")
+                    if self.headers.get("Authorization") != want:
+                        self._reply(401, b'{"error":"bad auth"}')
+                        return
+                    doc = _json.loads(body)
+                    for m in doc["messages"]:
+                        outer.messages.append(
+                            (_b64.standard_b64decode(m["data"]),
+                             m.get("attributes", {})))
+                    self._reply(200, b'{"messageIds":["1"]}')
+                else:
+                    self._reply(404)
+
+            def _reply(self, status, body=b""):
+                self.send_response(status)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                if body:
+                    self.wfile.write(body)
+
+        self._srv = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self._srv.daemon_threads = True
+        self.port = self._srv.server_address[1]
+        threading.Thread(target=self._srv.serve_forever,
+                         daemon=True).start()
+
+    def stop(self):
+        self._srv.shutdown()
+        self._srv.server_close()
+
+
+def test_google_pubsub_signed_grant_and_publish(tmp_path):
+    import json as _json
+
+    from seaweedfs_tpu.replication.google_pubsub import GooglePubSubQueue
+
+    srv = _MiniPubSub()
+    try:
+        creds = tmp_path / "sa.json"
+        creds.write_text(_json.dumps({
+            "client_email": "svc@proj.iam.example",
+            "private_key": srv.private_pem,
+            "token_uri": f"http://127.0.0.1:{srv.port}/token"}))
+        q = GooglePubSubQueue("proj", "events",
+                              google_application_credentials=str(creds))
+        # point publishes at the double (keep the OAuth path real)
+        import seaweedfs_tpu.replication.google_pubsub as gp
+        orig_send = q.send_message
+
+        def send(key, event):
+            # swap the production host for the double, keeping auth
+            import seaweedfs_tpu.utils.httpd as hh
+            real = hh.http_bytes
+
+            def fake(method, url, body=None, headers=None, **kw):
+                url = url.replace(f"https://{gp.PUBSUB_HOST}",
+                                  f"http://127.0.0.1:{srv.port}")
+                return real(method, url, body, headers=headers, **kw)
+
+            gp.http_bytes, keep = fake, gp.http_bytes
+            try:
+                orig_send(key, event)
+            finally:
+                gp.http_bytes = keep
+
+        send("/b/k.txt", {"op": "create"})
+        assert len(srv.messages) == 1
+        data, attrs = srv.messages[0]
+        assert attrs["key"] == "/b/k.txt"
+        assert _json.loads(data)["event"]["op"] == "create"
+        # token is cached: a second publish does not re-grant
+        tok = q._token
+        send("/b/k2.txt", {"op": "delete"})
+        assert q._token == tok and len(srv.messages) == 2
+    finally:
+        srv.stop()
+
+
+def test_google_pubsub_emulator_mode():
+    import json as _json
+    import time as _time
+
+    from seaweedfs_tpu.replication.google_pubsub import GooglePubSubQueue
+    from seaweedfs_tpu.replication.notification import (
+        AsyncPublisher, load_notification_queue)
+
+    srv = _MiniPubSub()
+    srv.token = None  # emulator mode: requests must carry NO bearer
+    try:
+        q = load_notification_queue({"notification": {"google_pub_sub": {
+            "enabled": True, "project_id": "proj", "topic": "t",
+            "endpoint": f"127.0.0.1:{srv.port}"}}})
+        assert isinstance(q, AsyncPublisher)
+        assert isinstance(q.inner, GooglePubSubQueue)
+        q.send_message("/e.txt", {"op": "create"})
+        deadline = _time.time() + 5
+        while _time.time() < deadline and not srv.messages:
+            _time.sleep(0.02)
+        data, attrs = srv.messages[0]
+        assert attrs["key"] == "/e.txt"
+        assert _json.loads(data)["event"]["op"] == "create"
+        q.close()
+    finally:
+        srv.stop()

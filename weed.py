@@ -397,6 +397,12 @@ _SCAFFOLDS = {
 # region = "us-east-1"
 # aws_access_key_id = ""
 # aws_secret_access_key = ""
+# [notification.google_pub_sub] # JSON API + RS256 service-account grant
+# enabled = true
+# project_id = "my-project"
+# topic = "seaweedfs"
+# google_application_credentials = "/etc/seaweedfs/sa.json"
+# endpoint = ""                 # set host:port for the emulator (no auth)
 ''',
     "shell": '''\
 # shell.toml — initial commands for `weed shell`
