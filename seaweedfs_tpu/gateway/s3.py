@@ -47,6 +47,46 @@ def _err(status: int, code: str, message: str) -> Response:
                     headers={"Content-Type": "application/xml"})
 
 
+def parse_form_data(body: bytes, content_type: str) -> dict:
+    """Minimal multipart/form-data parser for POST uploads: returns
+    {field: str} plus {"file": bytes, "file.name": str} for the file
+    part.  Per the S3 contract, fields after `file` are ignored."""
+    import re as _re
+
+    m = _re.search(r'boundary="?([^";]+)"?', content_type)
+    if not m:
+        raise ValueError("no multipart boundary")
+    sep = b"--" + m.group(1).encode()
+    fields: dict = {}
+    for part in body.split(sep)[1:]:
+        if part in (b"--", b"--\r\n") or not part.strip():
+            continue
+        part = part.lstrip(b"\r\n")
+        head, _, payload = part.partition(b"\r\n\r\n")
+        # exactly ONE trailing \r\n belongs to the framing; any others
+        # are file content (a text file's own newline must survive)
+        payload = payload.removesuffix(b"\r\n")
+        disp = ""
+        ptype = ""
+        for line in head.split(b"\r\n"):
+            low = line.lower()
+            if low.startswith(b"content-disposition:"):
+                disp = line.decode(errors="replace")
+            elif low.startswith(b"content-type:"):
+                ptype = line.split(b":", 1)[1].strip().decode(errors="replace")
+        nm = _re.search(r'name="([^"]*)"', disp)
+        name = nm.group(1) if nm else ""
+        if name.lower() == "file":
+            fn = _re.search(r'filename="([^"]*)"', disp)
+            fields["file"] = payload
+            fields["file.name"] = fn.group(1) if fn else ""
+            if ptype:
+                fields.setdefault("content-type", ptype)
+            break  # everything after the file part is ignored
+        fields[name.lower()] = payload.decode(errors="replace")
+    return fields
+
+
 class S3ApiServer:
     def __init__(self, filer_server: FilerServer, host: str = "127.0.0.1",
                  port: int = 8333):
@@ -290,7 +330,10 @@ class S3ApiServer:
         @r.route("POST", "/([a-z0-9][a-z0-9.-]+)")
         def post_bucket(req: Request) -> Response:
             bucket = req.match.group(1)
+            ctype = req.headers.get("Content-Type", "")
             if "delete" not in req.query:
+                if ctype.startswith("multipart/form-data"):
+                    return self._post_policy_upload(req, bucket, ctype)
                 raise HttpError(400, "unsupported bucket POST")
             # DeleteObjects: batch delete, per-key result entries
             # (s3api_object_handlers.go DeleteMultipleObjectsHandler)
@@ -590,6 +633,61 @@ class S3ApiServer:
         if entry.is_directory:
             raise HttpError(404, "NoSuchKey")
         return entry
+
+    def _post_policy_upload(self, req, bucket: str, ctype: str):
+        """Browser form upload (s3api_object_handlers_postpolicy.go):
+        multipart/form-data with a base64 policy document signed by the
+        uploader's SigV4 signing key; conditions gate bucket, key and
+        size.  ${filename} in the key field expands to the uploaded
+        file's name."""
+        from .s3_auth import AuthError, check_policy_conditions
+
+        self._require_bucket(bucket)
+        try:
+            form = parse_form_data(req.body, ctype)
+        except ValueError as e:
+            return _err(400, "MalformedPOSTRequest", str(e))
+        if "file" not in form:
+            return _err(400, "MalformedPOSTRequest", "no file part")
+        data = form["file"]
+        key = form.get("key", "")
+        if not key:
+            return _err(400, "InvalidArgument", "missing key field")
+        key = key.replace("${filename}", form.get("file.name", ""))
+        if any(c in key for c in "\r\n\x00"):
+            return _err(400, "InvalidArgument", "control bytes in key")
+        if self.iam.enabled():
+            try:
+                ident, policy = self.iam.verify_post_policy(form)
+            except AuthError as e:
+                return _err(e.status, e.code, str(e))
+            if not ident.can_do(ACTION_WRITE, bucket, key):
+                return _err(403, "AccessDenied", "not allowed")
+            problem = check_policy_conditions(policy, bucket, key,
+                                              len(data), form)
+            if problem:
+                return _err(403, "AccessDenied", problem)
+        mime = form.get("content-type", "")
+        entry = self.fs.put_file(self._object_path(bucket, key), data,
+                                 mime=mime)
+        etag = entry.attr.md5
+        import urllib.parse as _up
+
+        status_field = form.get("success_action_status", "204")
+        status = {"200": 200, "201": 201}.get(status_field, 204)
+        headers = {"ETag": f'"{etag}"',
+                   "Location": f"/{bucket}/{_up.quote(key)}"}
+        if status == 201:
+            root = ET.Element("PostResponse")
+            ET.SubElement(root, "Location").text = f"/{bucket}/{key}"
+            ET.SubElement(root, "Bucket").text = bucket
+            ET.SubElement(root, "Key").text = key
+            ET.SubElement(root, "ETag").text = f'"{etag}"'
+            resp = _xml(root)
+            resp.status = 201
+            resp.headers.update(headers)
+            return resp
+        return Response(raw=b"", status=status, headers=headers)
 
     def _put_tagging(self, req: Request, bucket: str, key: str) -> Response:
         entry = self._tag_entry(bucket, key)

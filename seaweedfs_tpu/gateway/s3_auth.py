@@ -142,6 +142,37 @@ class IdentityAccessManagement:
             return next((i for i in self._identities
                          if i.name == "anonymous"), None)
 
+    def verify_post_policy(self, form: dict) -> tuple["Identity", dict]:
+        """Authenticate a browser POST upload: the form carries the
+        base64 policy and a SigV4 signature of it (auth_signature_v4.go
+        DoesPolicySignatureMatch).  Returns (identity, decoded policy)."""
+        policy_b64 = form.get("policy", "")
+        cred = form.get("x-amz-credential", "")
+        amz_date = form.get("x-amz-date", "")
+        sig = form.get("x-amz-signature", "")
+        if not (policy_b64 and cred and amz_date and sig):
+            raise AuthError("AccessDenied", "missing POST policy fields")
+        ts = _parse_amz_date(amz_date)
+        if ts is None or abs(time.time() - ts) > 15 * 60:
+            raise AuthError("RequestTimeTooSkewed", "x-amz-date skew")
+        try:
+            access_key, date, region, service, term = cred.split("/")
+        except ValueError:
+            raise AuthError("AccessDenied", "malformed credential")
+        if term != "aws4_request" or date != amz_date[:8]:
+            raise AuthError("AccessDenied", "malformed credential scope")
+        ident, secret = self.lookup(access_key)
+        key = self._signing_key(secret, date, region, service)
+        want = hmac.new(key, policy_b64.encode(), hashlib.sha256).hexdigest()
+        if not hmac.compare_digest(want, sig):
+            raise AuthError("SignatureDoesNotMatch",
+                            "policy signature mismatch")
+        try:
+            policy = json.loads(base64.b64decode(policy_b64))
+        except (ValueError, TypeError):
+            raise AuthError("InvalidPolicyDocument", "cannot decode policy")
+        return ident, policy
+
     # --- request authentication ------------------------------------------
     def authenticate(self, method: str, path: str, query: dict,
                      headers, body: bytes) -> Identity:
@@ -531,3 +562,71 @@ def sign_v4(method: str, url: str, access_key: str, secret_key: str,
         f"AWS4-HMAC-SHA256 Credential={access_key}/{scope}, "
         f"SignedHeaders={';'.join(signed)}, Signature={sig}")
     return headers
+
+
+# --- POST form-upload policy (browser uploads) -----------------------------
+
+def sign_post_policy(policy_b64: str, secret_key: str, amz_date: str,
+                     region: str = "us-east-1") -> str:
+    """Client-side signature for a POST policy (SigV4 signing key over the
+    base64 policy document) — what a browser-upload form carries in
+    x-amz-signature."""
+    key = IdentityAccessManagement._signing_key(secret_key, amz_date[:8],
+                                                region, "s3")
+    return hmac.new(key, policy_b64.encode(), hashlib.sha256).hexdigest()
+
+
+def check_policy_conditions(policy: dict, bucket: str, key: str,
+                            size: int, fields: dict) -> Optional[str]:
+    """Evaluate a decoded POST policy against the upload; returns an error
+    string or None (s3api PostPolicyBucketHandler condition subset:
+    eq / starts-with on bucket, key and form fields, plus
+    content-length-range)."""
+    exp = policy.get("expiration", "")
+    if exp:
+        try:
+            import datetime
+
+            when = datetime.datetime.fromisoformat(
+                exp.replace("Z", "+00:00")).timestamp()
+            if time.time() > when:
+                return "policy expired"
+        except ValueError:
+            return "malformed expiration"
+    # form fields participate in conditions, but the SERVER-derived
+    # bucket and expanded key always win — a client-supplied "bucket"
+    # or raw "key" field must never shadow where the object actually
+    # lands (that would void the policy's whole restriction)
+    values = {k.lower(): v for k, v in fields.items()
+              if isinstance(v, str)}
+    values["bucket"] = bucket
+    values["key"] = key
+    try:
+        for cond in policy.get("conditions", []):
+            if isinstance(cond, dict):
+                for name, want in cond.items():
+                    if values.get(name.lower(), "") != want:
+                        return f"condition failed: {name}"
+            elif isinstance(cond, list) and len(cond) == 3:
+                op = str(cond[0]).lower()
+                if op == "eq":
+                    name = str(cond[1]).lstrip("$").lower()
+                    if values.get(name, "") != cond[2]:
+                        return f"condition failed: eq {name}"
+                elif op == "starts-with":
+                    name = str(cond[1]).lstrip("$").lower()
+                    if not values.get(name, "").startswith(str(cond[2])):
+                        return f"condition failed: starts-with {name}"
+                elif op == "content-length-range":
+                    lo, hi = int(cond[1]), int(cond[2])
+                    if not lo <= size <= hi:
+                        return "content-length out of range"
+                else:
+                    # an op we do not enforce must fail closed, or a
+                    # typo silently voids the author's restriction
+                    return f"unsupported condition {op!r}"
+            else:
+                return "malformed condition"
+    except (TypeError, ValueError):
+        return "malformed condition value"
+    return None

@@ -440,3 +440,141 @@ def test_streaming_chunked_tamper_rejected(s3):
     cut = framed[:framed.rfind(b"0;chunk-signature")]
     st, body, _ = http_bytes("PUT", url, cut, headers=headers)
     assert st == 400 and b"IncompleteBody" in body
+
+
+# --- browser POST form uploads (post policy) --------------------------------
+
+def _post_form(s3, bucket, fields, file_bytes, filename="up.bin",
+               file_ctype="application/octet-stream"):
+    boundary = "----weedform1234"
+    parts = []
+    for name, value in fields.items():
+        parts.append(
+            f'--{boundary}\r\nContent-Disposition: form-data; '
+            f'name="{name}"\r\n\r\n{value}\r\n'.encode())
+    parts.append(
+        f'--{boundary}\r\nContent-Disposition: form-data; name="file"; '
+        f'filename="{filename}"\r\nContent-Type: {file_ctype}\r\n\r\n'
+        .encode() + file_bytes + b"\r\n")
+    parts.append(f"--{boundary}--\r\n".encode())
+    body = b"".join(parts)
+    return http_bytes(
+        "POST", f"http://{s3.url}/{bucket}", body,
+        headers={"Content-Type":
+                 f"multipart/form-data; boundary={boundary}"})
+
+
+def _signed_policy_fields(bucket, key_prefix, max_len=1 << 20,
+                          expire_s=300):
+    import base64
+    import json as _json
+
+    from seaweedfs_tpu.gateway.s3_auth import sign_post_policy
+
+    amz_date = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
+    cred = f"{AK}/{amz_date[:8]}/us-east-1/s3/aws4_request"
+    policy = {
+        "expiration": time.strftime(
+            "%Y-%m-%dT%H:%M:%SZ", time.gmtime(time.time() + expire_s)),
+        "conditions": [
+            {"bucket": bucket},
+            ["starts-with", "$key", key_prefix],
+            ["content-length-range", 0, max_len],
+            {"x-amz-credential": cred},
+            {"x-amz-date": amz_date},
+        ],
+    }
+    policy_b64 = base64.b64encode(_json.dumps(policy).encode()).decode()
+    return {
+        "policy": policy_b64,
+        "x-amz-credential": cred,
+        "x-amz-date": amz_date,
+        "x-amz-signature": sign_post_policy(policy_b64, SK, amz_date),
+    }
+
+
+def test_post_policy_upload_roundtrip(s3):
+    _req(s3, "PUT", "/postbkt")
+    fields = {"key": "uploads/${filename}",
+              **_signed_policy_fields("postbkt", "uploads/")}
+    status, body, hdrs = _post_form(s3, "postbkt", fields,
+                                    b"browser bytes", filename="photo.jpg")
+    assert status == 204, body
+    status, body, _ = _req(s3, "GET", "/postbkt/uploads/photo.jpg")
+    assert status == 200 and body == b"browser bytes"
+
+
+def test_post_policy_success_action_status_201(s3):
+    fields = {"key": "uploads/x.bin", "success_action_status": "201",
+              **_signed_policy_fields("postbkt", "uploads/")}
+    status, body, _ = _post_form(s3, "postbkt", fields, b"abc")
+    assert status == 201
+    doc = ET.fromstring(body)
+    assert doc.findtext("Key") == "uploads/x.bin"
+    assert doc.findtext("Bucket") == "postbkt"
+
+
+def test_post_policy_rejects_bad_signature(s3):
+    fields = {"key": "uploads/evil.bin",
+              **_signed_policy_fields("postbkt", "uploads/")}
+    fields["x-amz-signature"] = "0" * 64
+    status, body, _ = _post_form(s3, "postbkt", fields, b"nope")
+    assert status == 403
+    assert b"SignatureDoesNotMatch" in body
+
+
+def test_post_policy_enforces_conditions(s3):
+    # key outside the starts-with prefix
+    fields = {"key": "elsewhere/esc.bin",
+              **_signed_policy_fields("postbkt", "uploads/")}
+    status, body, _ = _post_form(s3, "postbkt", fields, b"x")
+    assert status == 403 and b"AccessDenied" in body
+    # payload above content-length-range
+    fields = {"key": "uploads/big.bin",
+              **_signed_policy_fields("postbkt", "uploads/", max_len=4)}
+    status, body, _ = _post_form(s3, "postbkt", fields, b"12345")
+    assert status == 403
+    # tampered policy document (signature no longer matches)
+    fields = {"key": "uploads/t.bin",
+              **_signed_policy_fields("postbkt", "uploads/")}
+    import base64
+    import json as _json
+
+    doc = _json.loads(base64.b64decode(fields["policy"]))
+    doc["conditions"][2] = ["content-length-range", 0, 1 << 30]
+    fields["policy"] = base64.b64encode(_json.dumps(doc).encode()).decode()
+    status, body, _ = _post_form(s3, "postbkt", fields, b"x")
+    assert status == 403 and b"SignatureDoesNotMatch" in body
+
+
+def test_post_policy_expired(s3):
+    fields = {"key": "uploads/old.bin",
+              **_signed_policy_fields("postbkt", "uploads/", expire_s=-60)}
+    status, body, _ = _post_form(s3, "postbkt", fields, b"x")
+    assert status == 403 and b"policy expired" in body
+
+
+def test_post_policy_bucket_field_cannot_shadow_target(s3):
+    """A form 'bucket' field must not satisfy the policy's bucket
+    condition for a DIFFERENT target bucket."""
+    _req(s3, "PUT", "/otherbkt")
+    fields = {"key": "uploads/sneak.bin", "bucket": "postbkt",
+              **_signed_policy_fields("postbkt", "uploads/")}
+    status, body, _ = _post_form(s3, "otherbkt", fields, b"x")
+    assert status == 403 and b"condition failed: bucket" in body
+
+
+def test_post_policy_preserves_trailing_newlines(s3):
+    fields = {"key": "uploads/text.txt",
+              **_signed_policy_fields("postbkt", "uploads/")}
+    status, _, _ = _post_form(s3, "postbkt", fields, b"line one\n\r\n")
+    assert status == 204
+    status, body, _ = _req(s3, "GET", "/postbkt/uploads/text.txt")
+    assert status == 200 and body == b"line one\n\r\n"
+
+
+def test_post_policy_rejects_crlf_key(s3):
+    fields = {"key": "uploads/a\r\nSet-Cookie: evil=1",
+              **_signed_policy_fields("postbkt", "uploads/")}
+    status, body, _ = _post_form(s3, "postbkt", fields, b"x")
+    assert status == 400
