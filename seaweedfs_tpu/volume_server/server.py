@@ -417,12 +417,34 @@ class VolumeServer:
 
         @r.route("GET", "/status")
         def status(req: Request) -> Response:
-            return Response({
+            volumes = []
+            for v in list(self.store.volumes.values()):  # snapshot: races
+                try:                                     # assign/delete
+                    volumes.append(self.store._volume_info(v))
+                except Exception:
+                    pass  # mid-swap (compaction/tier commit): skip one
+            doc = {
                 "Version": "seaweedfs-tpu 0.1",
-                "Volumes": [v.to_volume_information()
-                            for v in self.store.volumes.values()],
+                "Volumes": volumes,
                 "EcVolumes": sorted(self.store.ec_volumes),
-            })
+            }
+            plane = self.store.native_plane
+            if plane is not None:
+                with plane._lock:  # vids mutates under this lock
+                    vids = sorted(plane.vids)
+                per_vol = {}
+                for vid in vids:
+                    st = plane.stat_full(vid)
+                    if st is not None:
+                        ds, fc, mk, db, sp = st
+                        per_vol[vid] = {"size": ds, "file_count": fc,
+                                        "deleted_bytes": db,
+                                        "fsync_passes": sp}
+                doc["NativeDataPlane"] = {
+                    "tcp_port": plane.port,
+                    "volumes": per_vol,
+                }
+            return Response(doc)
 
         from ..utils.debug import register_debug_routes
 

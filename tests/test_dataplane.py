@@ -276,3 +276,41 @@ def test_group_commit_fsync_batches(tmp_path, plane):
     for i in (1, n // 2, n):
         assert store.read_needle(1, i, i).data == b"d" * 100
     store.close()
+
+
+def test_status_reports_native_plane(tmp_path):
+    from seaweedfs_tpu.master.server import MasterServer
+    from seaweedfs_tpu.utils.httpd import http_json
+    from seaweedfs_tpu.volume_server.server import VolumeServer
+
+    def free_port():
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        p = s.getsockname()[1]
+        s.close()
+        return p
+
+    m = MasterServer(port=free_port(), pulse_seconds=0.3).start()
+    vs = VolumeServer([str(tmp_path)], m.url, port=free_port(),
+                      pulse_seconds=0.3, dataplane="native").start()
+    try:
+        deadline = time.time() + 10
+        while time.time() < deadline and not m.topo.all_nodes():
+            time.sleep(0.05)
+        from seaweedfs_tpu.client.operation import WeedClient
+
+        client = WeedClient(m.url)
+        fid = client.upload(b"status probe", name="p.bin")
+        doc = http_json("GET", f"http://{vs.url}/status")
+        plane = doc["NativeDataPlane"]
+        assert plane["tcp_port"] > 0
+        vols = plane["volumes"]
+        vid = fid.split(",")[0]
+        assert vols[vid]["file_count"] == 1
+        assert vols[vid]["size"] > 0
+        # heartbeat-facing info rides the overlay too
+        info = next(v for v in doc["Volumes"] if str(v["id"]) == vid)
+        assert info["file_count"] == 1
+    finally:
+        vs.stop()
+        m.stop()
