@@ -217,6 +217,10 @@ class VolumeServerMetrics(_ServerMetrics):
             "SeaweedFS_volumeServer_total_disk_size",
             "Actual disk size used by volumes.",
             labels=("collection", "type"))
+        self.native_plane_gauge = registry.gauge(
+            "SeaweedFS_volumeServer_native_plane",
+            "Native C++ data plane per-volume state.",
+            labels=("volume", "stat"))
 
 
 class FilerMetrics(_ServerMetrics):

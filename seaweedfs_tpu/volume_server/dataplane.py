@@ -209,14 +209,15 @@ class NativeDataPlane:
 
     def stat_full(self, vid: int) -> Optional[tuple[int, int, int, int, int]]:
         """stat() plus the group-commit fsync pass count."""
-        if self._h is None:
+        h = self._h  # read once: stop() nulls it concurrently, and a
+        if h is None:  # NULL handle through ctypes would segfault
             return None
         ds = ctypes.c_ulonglong()
         fc = ctypes.c_ulonglong()
         mk = ctypes.c_ulonglong()
         db = ctypes.c_ulonglong()
         sp = ctypes.c_ulonglong()
-        rc = self._lib.dp_stat(self._h, vid, ctypes.byref(ds),
+        rc = self._lib.dp_stat(h, vid, ctypes.byref(ds),
                                ctypes.byref(fc), ctypes.byref(mk),
                                ctypes.byref(db), ctypes.byref(sp))
         if rc != DP_OK:
@@ -227,6 +228,19 @@ class NativeDataPlane:
         rc = self._lib.dp_sync(self._handle(), vid)
         if rc != DP_OK:
             _raise(rc, f"sync {vid}")
+
+    def stats_all(self) -> dict[int, tuple[int, int, int, int, int]]:
+        """Snapshot of per-volume (size, live_files, max_key,
+        deleted_bytes, fsync_passes) for every registered volume — owns
+        the registry lock so callers never touch plane internals."""
+        with self._lock:
+            vids = sorted(self.vids)
+        out = {}
+        for vid in vids:
+            st = self.stat_full(vid)
+            if st is not None:
+                out[vid] = st
+        return out
 
     def stop(self) -> None:
         if self._h:

@@ -404,60 +404,69 @@ class VolumeServer:
             # disk usage per collection — stats/metrics.go gauge family)
             self.metrics.volume_counter.clear()
             self.metrics.disk_size_gauge.clear()
-            for v in self.store.volumes.values():
+            for v in list(self.store.volumes.values()):
                 self.metrics.volume_counter.add(v.collection, "volume", 1)
                 self.metrics.disk_size_gauge.add(
                     v.collection, "volume", v.data_size)
-            for vid, ev in self.store.ec_volumes.items():
+            for vid, ev in list(self.store.ec_volumes.items()):
                 self.metrics.volume_counter.add(
                     self.store.ec_collections.get(vid, ""), "ec_shards",
                     len(ev.shards))
+            plane = self.store.native_plane
+            self.metrics.native_plane_gauge.clear()
+            if plane is not None:
+                for vid, (ds, fc, _mk, db, sp) in \
+                        plane.stats_all().items():
+                    g = self.metrics.native_plane_gauge
+                    g.set(str(vid), "size_bytes", ds)
+                    g.set(str(vid), "live_files", fc)
+                    g.set(str(vid), "deleted_bytes", db)
+                    g.set(str(vid), "fsync_passes", sp)
             return Response(raw=REGISTRY.expose().encode(), headers={
                 "Content-Type": "text/plain; version=0.0.4; charset=utf-8"})
 
-        @r.route("GET", "/status")
-        def status(req: Request) -> Response:
+        def status_doc() -> dict:
             volumes = []
             for v in list(self.store.volumes.values()):  # snapshot: races
                 try:                                     # assign/delete
                     volumes.append(self.store._volume_info(v))
                 except Exception:
-                    pass  # mid-swap (compaction/tier commit): skip one
+                    # mid-swap (compaction/tier commit): report the plain
+                    # attributes rather than dropping the volume — the
+                    # copy protocol's was_readonly probe must still see
+                    # an operator fence
+                    volumes.append({"id": v.id, "collection": v.collection,
+                                    "read_only": v.read_only,
+                                    "mid_swap": True})
             doc = {
                 "Version": "seaweedfs-tpu 0.1",
                 "Volumes": volumes,
-                "EcVolumes": sorted(self.store.ec_volumes),
+                "EcVolumes": sorted(list(self.store.ec_volumes)),
             }
             plane = self.store.native_plane
             if plane is not None:
-                with plane._lock:  # vids mutates under this lock
-                    vids = sorted(plane.vids)
-                per_vol = {}
-                for vid in vids:
-                    st = plane.stat_full(vid)
-                    if st is not None:
-                        ds, fc, mk, db, sp = st
-                        per_vol[vid] = {"size": ds, "file_count": fc,
-                                        "deleted_bytes": db,
-                                        "fsync_passes": sp}
                 doc["NativeDataPlane"] = {
                     "tcp_port": plane.port,
-                    "volumes": per_vol,
+                    "volumes": {
+                        vid: {"size": ds, "file_count": fc,
+                              "deleted_bytes": db, "fsync_passes": sp}
+                        for vid, (ds, fc, _mk, db, sp)
+                        in plane.stats_all().items()},
                 }
-            return Response(doc)
+            return doc
+
+        @r.route("GET", "/status")
+        def status(req: Request) -> Response:
+            return Response(status_doc())
 
         from ..utils.debug import register_debug_routes
 
         register_debug_routes(r, name=f"volume server {self.url}",
                               status_fn=lambda: {
-                                  "Version": "seaweedfs-tpu 0.1",
+                                  **status_doc(),
                                   "Master": self.master_url,
                                   "DataCenter": self.data_center,
                                   "Rack": self.rack,
-                                  "Volumes": [v.to_volume_information()
-                                              for v in
-                                              self.store.volumes.values()],
-                                  "EcVolumes": sorted(self.store.ec_volumes),
                               })
 
         @r.route("GET", FID_PATTERN)

@@ -311,6 +311,15 @@ def test_status_reports_native_plane(tmp_path):
         # heartbeat-facing info rides the overlay too
         info = next(v for v in doc["Volumes"] if str(v["id"]) == vid)
         assert info["file_count"] == 1
+        # prometheus exposition carries per-volume plane gauges
+        from seaweedfs_tpu.utils.httpd import http_bytes
+
+        status_code, body, _ = http_bytes(
+            "GET", f"http://{vs.url}/metrics")
+        assert status_code == 200
+        text = body.decode()
+        assert ('SeaweedFS_volumeServer_native_plane{volume="%s",'
+                'stat="live_files"} 1' % vid) in text
     finally:
         vs.stop()
         m.stop()
