@@ -114,7 +114,13 @@ class EtcdStore:
                                prefix: str = "") -> Iterator[Entry]:
         base = dir_path.rstrip("/") or "/"
         dpref = _dir_prefix(base)
-        lo = dpref + (start_file or prefix).encode()
+        # when start_file sorts below the prefix range, the prefix is the
+        # tighter lower bound (RedisStore guards this same case) — else
+        # the `break` below ends the page before any match is reached
+        if start_file and (not prefix or start_file >= prefix):
+            lo = dpref + start_file.encode()
+        else:
+            lo = dpref + prefix.encode()
         r = self._call("range", {
             "key": _b64(lo),
             "range_end": _b64(_prefix_end(dpref)),

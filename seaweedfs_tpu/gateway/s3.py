@@ -56,16 +56,21 @@ def parse_form_data(body: bytes, content_type: str) -> dict:
     m = _re.search(r'boundary="?([^";]+)"?', content_type)
     if not m:
         raise ValueError("no multipart boundary")
-    sep = b"--" + m.group(1).encode()
+    # RFC 2046 delimiters are CRLF--boundary, NOT the bare boundary
+    # bytes — a file whose CONTENT contains the boundary string must
+    # survive.  Prefixing CRLF makes the first (dashless) delimiter
+    # uniform with the rest.
+    sep = b"\r\n--" + m.group(1).encode()
     fields: dict = {}
-    for part in body.split(sep)[1:]:
-        if part in (b"--", b"--\r\n") or not part.strip():
+    for part in (b"\r\n" + body).split(sep)[1:]:
+        if part.startswith(b"--"):
+            break  # closing delimiter
+        part = part.lstrip(b" \t")  # transport padding after boundary
+        if part.startswith(b"\r\n"):
+            part = part[2:]
+        head, hsep, payload = part.partition(b"\r\n\r\n")
+        if not hsep and not head.strip():
             continue
-        part = part.lstrip(b"\r\n")
-        head, _, payload = part.partition(b"\r\n\r\n")
-        # exactly ONE trailing \r\n belongs to the framing; any others
-        # are file content (a text file's own newline must survive)
-        payload = payload.removesuffix(b"\r\n")
         disp = ""
         ptype = ""
         for line in head.split(b"\r\n"):

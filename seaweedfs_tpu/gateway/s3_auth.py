@@ -582,17 +582,21 @@ def check_policy_conditions(policy: dict, bucket: str, key: str,
     string or None (s3api PostPolicyBucketHandler condition subset:
     eq / starts-with on bucket, key and form fields, plus
     content-length-range)."""
+    # no expiration fails CLOSED (ref CheckPostPolicy treats the zero
+    # time as already expired, policy/postpolicyform.go:222) — a leaked
+    # signed policy without one must not be valid forever
     exp = policy.get("expiration", "")
-    if exp:
-        try:
-            import datetime
+    if not exp:
+        return "policy expired"
+    try:
+        import datetime
 
-            when = datetime.datetime.fromisoformat(
-                exp.replace("Z", "+00:00")).timestamp()
-            if time.time() > when:
-                return "policy expired"
-        except ValueError:
-            return "malformed expiration"
+        when = datetime.datetime.fromisoformat(
+            exp.replace("Z", "+00:00")).timestamp()
+        if time.time() > when:
+            return "policy expired"
+    except ValueError:
+        return "malformed expiration"
     # form fields participate in conditions, but the SERVER-derived
     # bucket and expanded key always win — a client-supplied "bucket"
     # or raw "key" field must never shadow where the object actually
@@ -601,6 +605,19 @@ def check_policy_conditions(policy: dict, bucket: str, key: str,
               if isinstance(v, str)}
     values["bucket"] = bucket
     values["key"] = key
+    # every x-amz-meta-* form field must be covered by some condition
+    # (ref CheckPostPolicy "Extra input fields",
+    # policy/postpolicyform.go:234-240) — unvalidated metadata must not
+    # ride a signed policy
+    covered = set()
+    for cond in policy.get("conditions", []):
+        if isinstance(cond, dict):
+            covered.update(k.lower() for k in cond)
+        elif isinstance(cond, list) and len(cond) == 3:
+            covered.add(str(cond[1]).lstrip("$").lower())
+    for name in values:
+        if name.startswith("x-amz-meta-") and name not in covered:
+            return f"extra input field: {name}"
     try:
         for cond in policy.get("conditions", []):
             if isinstance(cond, dict):

@@ -130,7 +130,7 @@ static uint64_t get_u64(const uint8_t* p) {
 enum {
     DP_OK = 0, DP_NOT_FOUND = -2, DP_COOKIE = -3, DP_DELETED = -4,
     DP_READONLY = -5, DP_NO_VOLUME = -6, DP_IO = -7, DP_CRC = -8,
-    DP_BAD_REQ = -9, DP_FULL = -10,
+    DP_BAD_REQ = -9, DP_FULL = -10, DP_TCP_FORBIDDEN = -11,
 };
 
 // ------------------------------------------------------------- volume
@@ -140,9 +140,17 @@ struct Volume {
     int dat_fd = -1;
     int idx_fd = -1;
     uint64_t dat_size = 0;   // append offset
+    uint64_t idx_size = 0;   // idx append offset (rollback anchor)
     uint64_t max_key = 0;    // highest needle id seen (heartbeat reseed)
     uint64_t deleted_bytes = 0;  // stored sizes of dead needles (vacuum)
     bool read_only = false;
+    // W/D frames arriving over TCP are rejected unless this is set: the
+    // TCP plane has no IP-whitelist slot and no replication fan-out, so
+    // the Python side only enables it for replication-000 volumes on
+    // servers with no whitelist configured.  Local C-API calls
+    // (dp_write/dp_append/dp_delete, the HTTP plane's funnel) are never
+    // gated by it — the HTTP layer already enforced whitelist+fan-out.
+    bool tcp_writable = true;
     bool retired = false;    // set under write_mu by dp_remove_volume
     std::unordered_map<uint64_t, NeedleVal> map;
     std::mutex write_mu;     // serializes append (.dat + .idx + map)
@@ -184,6 +192,8 @@ static const char* dp_strerror(int code) {
         case DP_IO:        return "io error";
         case DP_CRC:       return "crc mismatch";
         case DP_FULL:      return "volume size limit exceeded";
+        case DP_TCP_FORBIDDEN:
+            return "tcp writes not allowed for this volume";
         default:           return "bad request";
     }
 }
@@ -251,6 +261,21 @@ static uint64_t actual_size(int32_t size) {
     return used + pad;
 }
 
+// Append one 16-byte idx entry (caller holds write_mu).  On a failed or
+// short write (ENOSPC) roll BOTH files back: a torn, 16-misaligned idx
+// tail would misparse every later entry on the next replay, and the .dat
+// record at `dat_off` would have no idx entry and resurface as a torn
+// tail — mirror the .dat rollback the write paths already do.
+static int idx_append(Volume* v, const uint8_t* ie, uint64_t dat_off) {
+    if (write(v->idx_fd, ie, 16) != 16) {
+        (void)!ftruncate(v->idx_fd, (off_t)v->idx_size);
+        (void)!ftruncate(v->dat_fd, (off_t)dat_off);
+        return DP_IO;
+    }
+    v->idx_size += 16;
+    return DP_OK;
+}
+
 // ------------------------------------------------------------- ops
 constexpr uint64_t MAX_VOLUME_BYTES = 8ull * 0xFFFFFFFFull;  // u32 off/8
 
@@ -303,7 +328,7 @@ static int vol_write(Volume* v, uint64_t id, uint32_t cookie,
     uint8_t ie[16];
     put_u64(ie, id); put_u32(ie + 8, (uint32_t)(off / 8));
     put_u32(ie + 12, (uint32_t)size);
-    if (write(v->idx_fd, ie, 16) != 16) return DP_IO;
+    if (idx_append(v, ie, off) != DP_OK) return DP_IO;
     v->dat_size = off + rec_len;
     if (id > v->max_key) v->max_key = id;
     {
@@ -352,7 +377,7 @@ static int vol_delete(Volume* v, uint64_t id, uint32_t cookie,
     uint8_t ie[16];
     put_u64(ie, id); put_u32(ie + 8, (uint32_t)(off / 8));
     put_u32(ie + 12, (uint32_t)TOMBSTONE);
-    if (write(v->idx_fd, ie, 16) != 16) return DP_IO;
+    if (idx_append(v, ie, off) != DP_OK) return DP_IO;
     v->dat_size = off + rec_len;
     v->deleted_bytes += (uint64_t)nv.size;
     {
@@ -517,12 +542,16 @@ static void serve_conn(Server* s, int fd,
             if (v == nullptr) {
                 rc = DP_NO_VOLUME;
             } else if (op == 'W') {
-                rc = vol_write(v.get(), id, cookie, body.data(), blen,
-                               &out_size);
+                rc = v->tcp_writable
+                         ? vol_write(v.get(), id, cookie, body.data(), blen,
+                                     &out_size)
+                         : DP_TCP_FORBIDDEN;
             } else if (op == 'R') {
                 rc = vol_read(v.get(), id, cookie, &out);
             } else if (op == 'D') {
-                rc = vol_delete(v.get(), id, cookie, &out_size);
+                rc = v->tcp_writable
+                         ? vol_delete(v.get(), id, cookie, &out_size)
+                         : DP_TCP_FORBIDDEN;
             }
         }
         bool ok;
@@ -590,6 +619,16 @@ void* dp_start(const char* host, int port) {
         g_hw_crc = has_sse42();
 #endif
     });
+    if (port < 0) {
+        // engine-only mode: no TCP listener at all (whitelist-guarded
+        // servers — the plane has no whitelist slot, and the Python TCP
+        // plane likewise refuses non-whitelisted connections outright,
+        // reads included).  The C API keeps serving the HTTP funnel.
+        Server* s = new Server();
+        s->listen_fd = -1;
+        s->port = 0;
+        return s;
+    }
     int fd = socket(AF_INET, SOCK_STREAM, 0);
     if (fd < 0) return nullptr;
     int one = 1;
@@ -615,10 +654,11 @@ void* dp_start(const char* host, int port) {
 int dp_port(void* h) { return ((Server*)h)->port; }
 
 int dp_add_volume(void* h, unsigned vid, const char* dat_path,
-                  const char* idx_path, int read_only) {
+                  const char* idx_path, int read_only, int tcp_writable) {
     Server* s = (Server*)h;
     auto v = std::make_shared<Volume>();
     v->read_only = read_only != 0;
+    v->tcp_writable = tcp_writable != 0;
     v->dat_fd = open(dat_path, read_only ? O_RDONLY : O_RDWR);
     if (v->dat_fd < 0) return DP_IO;
     v->idx_fd = open(idx_path, O_RDWR | O_CREAT | O_APPEND, 0644);
@@ -630,6 +670,11 @@ int dp_add_volume(void* h, unsigned vid, const char* dat_path,
     struct stat ist;
     fstat(v->idx_fd, &ist);
     uint64_t n = (uint64_t)ist.st_size / 16;
+    // drop a torn (16-misaligned) tail before appending after it — the
+    // Python open path truncates the same way
+    if ((uint64_t)ist.st_size != n * 16)
+        (void)!ftruncate(v->idx_fd, (off_t)(n * 16));
+    v->idx_size = n * 16;
     std::vector<uint8_t> buf(1 << 20);
     uint64_t done = 0;
     while (done < n) {
@@ -722,7 +767,7 @@ int dp_append(void* h, unsigned vid, unsigned long long id, unsigned cookie,
     uint8_t ie[16];
     put_u64(ie, id); put_u32(ie + 8, (uint32_t)(off / 8));
     put_u32(ie + 12, (uint32_t)size);
-    if (write(v->idx_fd, ie, 16) != 16) return DP_IO;
+    if (idx_append(v.get(), ie, off) != DP_OK) return DP_IO;
     v->dat_size = off + rec_len;
     if (id > v->max_key) v->max_key = id;
     {
@@ -825,8 +870,10 @@ int dp_sync(void* h, unsigned vid) {
 void dp_stop(void* h) {
     Server* s = (Server*)h;
     s->stopping = true;
-    shutdown(s->listen_fd, SHUT_RDWR);
-    close(s->listen_fd);
+    if (s->listen_fd >= 0) {
+        shutdown(s->listen_fd, SHUT_RDWR);
+        close(s->listen_fd);
+    }
     {
         std::lock_guard<std::mutex> g(s->conn_mu);
         for (int fd : s->conns) shutdown(fd, SHUT_RDWR);

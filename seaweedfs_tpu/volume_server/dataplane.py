@@ -30,6 +30,7 @@ DP_READONLY = -5
 DP_NO_VOLUME = -6
 DP_IO = -7
 DP_CRC = -8
+DP_TCP_FORBIDDEN = -11
 
 _lib = None
 _lib_lock = threading.Lock()
@@ -60,7 +61,7 @@ def load_dataplane():
         lib.dp_port.argtypes = [ctypes.c_void_p]
         lib.dp_add_volume.argtypes = [
             ctypes.c_void_p, ctypes.c_uint, ctypes.c_char_p,
-            ctypes.c_char_p, ctypes.c_int]
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_int]
         lib.dp_remove_volume.argtypes = [ctypes.c_void_p, ctypes.c_uint]
         lib.dp_write.argtypes = [
             ctypes.c_void_p, ctypes.c_uint, ctypes.c_ulonglong,
@@ -120,22 +121,33 @@ class NativeDataPlane:
     """One running C++ server + its registered volumes."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        """port=-1 starts the engine with NO TCP listener (engine-only:
+        whitelist-guarded servers keep native needle IO through the HTTP
+        funnel while exposing no unguarded port; self.port reads 0)."""
         lib = load_dataplane()
         if lib is None:
             raise RuntimeError("native data plane unavailable (no toolchain)")
         self._lib = lib
         self._h = lib.dp_start(host.encode(), port)
         if not self._h:
-            raise RuntimeError(f"data plane could not bind {host}:{port}")
+            # OSError (not RuntimeError) so callers can retry transient
+            # bind races without also retrying "no toolchain" above
+            raise OSError(f"data plane could not bind {host}:{port}")
         self.port = lib.dp_port(self._h)
         self.vids: set[int] = set()
         self._lock = threading.Lock()
 
     def add_volume(self, vid: int, dat_path: str, idx_path: str,
-                   read_only: bool = False) -> None:
+                   read_only: bool = False,
+                   tcp_writable: bool = True) -> None:
+        """tcp_writable=False rejects W/D frames arriving over the plane's
+        TCP port (reads still serve): set for replicated volumes — direct
+        TCP writes would bypass fan-out — and whitelist-guarded servers,
+        since the plane has no whitelist slot.  Local funnel calls
+        (append/write/delete below) are unaffected."""
         rc = self._lib.dp_add_volume(
             self._h, vid, dat_path.encode(), idx_path.encode(),
-            1 if read_only else 0)
+            1 if read_only else 0, 1 if tcp_writable else 0)
         if rc != DP_OK:
             _raise(rc, f"add_volume {vid}")
         with self._lock:

@@ -48,6 +48,30 @@ from .store import Store
 FID_PATTERN = r"/(\d+),([0-9a-f]+)"
 
 
+def _bind_with_retry(factory, timeout: float = 3.0, pause: float = 0.15):
+    """The TCP data plane binds the DERIVED port tcp_port_for(http_port),
+    so a prior server instance draining its listener (restart, test
+    teardown, TIME_WAIT without reuse) races the bind — retry briefly
+    before giving up.  Only bind failures retry: OSError, or a degraded
+    FramedServer (its start() swallows the bind error and comes back
+    with alive=False).  Anything else — e.g. the native plane's
+    RuntimeError when there is no C++ toolchain — fails fast."""
+    deadline = time.monotonic() + timeout
+    while True:
+        exc, srv = None, None
+        try:
+            srv = factory()
+            if getattr(srv, "alive", True):
+                return srv
+        except OSError as e:
+            exc = e
+        if time.monotonic() >= deadline:
+            if exc is not None:
+                raise exc
+            return srv  # degraded server: the HTTP plane still serves
+        time.sleep(pause)
+
+
 class VolumeServer:
     def __init__(self, directories: list[str], master_url: str,
                  host: str = "127.0.0.1", port: int = 8080,
@@ -115,24 +139,34 @@ class VolumeServer:
                 and self._tls_context is None:
             if self.dataplane == "native":
                 # the C++ plane binds the TCP port itself and the store
-                # funnels needle ops through it; TCP writes are local-only
-                # (like the reference's -useTcp experiment), so use it
-                # with replication 000 or HTTP-plane writes
+                # funnels needle ops through it.  The plane has no
+                # IP-whitelist slot and no replication fan-out, so:
+                # with a whitelist configured it runs engine-only (no
+                # listener at all — the Python TCP plane likewise drops
+                # non-whitelisted connections, reads included), and W/D
+                # frames are only accepted for replication-000 volumes
+                # (store._native_add gates per volume).  Everything else
+                # still gets native needle IO through the HTTP plane's
+                # local funnel.
                 from ..utils.framing import tcp_port_for
                 from .dataplane import NativeDataPlane
 
-                self._native_plane = NativeDataPlane(
-                    self.store.ip, tcp_port_for(self.store.port))
+                self.store.native_tcp_writes_ok = not self.guard.white_list
+                tcp_port = (-1 if self.guard.white_list
+                            else tcp_port_for(self.store.port))
+                self._native_plane = _bind_with_retry(
+                    lambda: NativeDataPlane(self.store.ip, tcp_port))
                 self.store.attach_native_plane(self._native_plane)
             else:
                 from .tcp import TcpVolumeServer
 
-                self._tcp_server = TcpVolumeServer(
-                    self.store, self.store.ip,
-                    whitelist_ok=(self.guard.check_white_list
-                                  if self.guard.is_write_active else None),
-                    replicate_write=self._tcp_replicate_write,
-                    replicate_delete=self._tcp_replicate_delete).start()
+                self._tcp_server = _bind_with_retry(
+                    lambda: TcpVolumeServer(
+                        self.store, self.store.ip,
+                        whitelist_ok=(self.guard.check_white_list
+                                      if self.guard.is_write_active else None),
+                        replicate_write=self._tcp_replicate_write,
+                        replicate_delete=self._tcp_replicate_delete).start())
         threading.Thread(target=self._heartbeat_loop, daemon=True,
                          name=f"heartbeat:{self.url}").start()
         return self

@@ -168,6 +168,11 @@ class Store:
         # overlapping maintenance (vacuum + readonly flip + tier) cannot
         # re-register the plane while any of them still owns the files
         self.native_plane = None
+        # False when the server has an IP whitelist configured: the plane
+        # has no whitelist slot, so its TCP port must not accept W/D at
+        # all (HTTP writes, which the whitelist does guard, still funnel
+        # through the plane via the local C API)
+        self.native_tcp_writes_ok = True
         self._native_holds: dict[int, int] = {}
         self._native_hold_lock = threading.Lock()
         self._swap_locks: dict[int, _SwapLock] = {}
@@ -369,8 +374,15 @@ class Store:
     def _native_add(self, vid: int, v: Volume) -> None:
         if self.native_plane is None or v.tiered or v.version != Version.V3:
             return
+        # direct TCP writes bypass the HTTP layer's replication fan-out,
+        # so only replication-000 volumes take them (the reference's
+        # -useTcp experiment is likewise local-only,
+        # ref: weed/server/volume_server_tcp_handlers_write.go)
+        tcp_ok = (self.native_tcp_writes_ok
+                  and v.super_block.replica_placement.to_byte() == 0)
         self.native_plane.add_volume(vid, v.dat_path, v.idx_path,
-                                     read_only=v.read_only)
+                                     read_only=v.read_only,
+                                     tcp_writable=tcp_ok)
 
     def native_detach(self, vid: int) -> None:
         """Quiesce: unregister from the plane and REOPEN the Python volume

@@ -23,6 +23,20 @@ import socket  # noqa: E402
 
 
 def free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
+    """A free ephemeral port whose DERIVED framed-TCP port (tcp_port_for:
+    ±20000) is also currently free — volume servers bind both, so a
+    picker that only checks the HTTP port can hand out a port whose TCP
+    sibling is held by a still-draining server from an earlier test."""
+    from seaweedfs_tpu.utils.framing import tcp_port_for
+
+    for _ in range(64):
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            p = s.getsockname()[1]
+        try:
+            with socket.socket() as t:
+                t.bind(("127.0.0.1", tcp_port_for(p)))
+            return p
+        except OSError:
+            continue
+    raise RuntimeError("no ephemeral port with a free derived TCP port")

@@ -193,12 +193,7 @@ def test_volume_server_native_end_to_end(tmp_path):
     from seaweedfs_tpu.master.server import MasterServer
     from seaweedfs_tpu.volume_server.server import VolumeServer
 
-    def free_port():
-        s = socket.socket()
-        s.bind(("127.0.0.1", 0))
-        p = s.getsockname()[1]
-        s.close()
-        return p
+    from .conftest import free_port
 
     m = MasterServer(port=free_port(), pulse_seconds=0.3).start()
     vs = VolumeServer([str(tmp_path)], m.url, port=free_port(),
@@ -283,12 +278,7 @@ def test_status_reports_native_plane(tmp_path):
     from seaweedfs_tpu.utils.httpd import http_json
     from seaweedfs_tpu.volume_server.server import VolumeServer
 
-    def free_port():
-        s = socket.socket()
-        s.bind(("127.0.0.1", 0))
-        p = s.getsockname()[1]
-        s.close()
-        return p
+    from .conftest import free_port
 
     m = MasterServer(port=free_port(), pulse_seconds=0.3).start()
     vs = VolumeServer([str(tmp_path)], m.url, port=free_port(),
@@ -320,6 +310,106 @@ def test_status_reports_native_plane(tmp_path):
         text = body.decode()
         assert ('SeaweedFS_volumeServer_native_plane{volume="%s",'
                 'stat="live_files"} 1' % vid) in text
+    finally:
+        vs.stop()
+        m.stop()
+
+
+def test_tcp_write_gate_per_volume(tmp_path, plane):
+    """tcp_writable=False volumes reject W/D frames over TCP (no
+    whitelist slot, no replication fan-out on that port) but still serve
+    reads, and the local C-API funnel keeps writing."""
+    from seaweedfs_tpu.volume_server.tcp import TcpVolumeClient
+
+    v = _mk_volume(tmp_path)
+    v.close()
+    plane.add_volume(1, str(tmp_path / "1.dat"), str(tmp_path / "1.idx"),
+                     tcp_writable=False)
+    plane.write(1, 100, 0xAA, b"local funnel")  # C API is not gated
+    addr = f"127.0.0.1:{plane.port}"
+    c = TcpVolumeClient()
+    fid = "1,00000064000000aa"
+    assert c.read(addr, fid) == b"local funnel"
+    with pytest.raises(OSError, match="tcp writes not allowed"):
+        c.write(addr, fid, b"remote bypass")
+    with pytest.raises(OSError, match="tcp writes not allowed"):
+        c.delete(addr, fid)
+    assert c.read(addr, fid) == b"local funnel"  # nothing changed
+    plane.remove_volume(1)
+
+
+def test_store_gates_tcp_writes(tmp_path, plane):
+    """Replicated volumes and whitelist-guarded servers register on the
+    plane with TCP writes off; plain 000 volumes keep them on."""
+    from seaweedfs_tpu.volume_server.store import Store
+    from seaweedfs_tpu.volume_server.tcp import TcpVolumeClient
+
+    store = Store([str(tmp_path)], max_volume_count=4)
+    store.add_volume(1, replication="000")
+    store.add_volume(2, replication="001")
+    store.attach_native_plane(plane)
+    addr = f"127.0.0.1:{plane.port}"
+    c = TcpVolumeClient()
+    assert c.write(addr, "1,00000064000000aa", b"ok") > 0
+    with pytest.raises(OSError, match="tcp writes not allowed"):
+        c.write(addr, "2,00000064000000aa", b"bypasses fan-out")
+    store.close()
+
+    store2 = Store([str(tmp_path / "wl")], max_volume_count=4)
+    store2.add_volume(3, replication="000")
+    store2.native_tcp_writes_ok = False  # server has a whitelist
+    plane2 = NativeDataPlane("127.0.0.1", 0)
+    try:
+        store2.attach_native_plane(plane2)
+        addr2 = f"127.0.0.1:{plane2.port}"
+        with pytest.raises(OSError, match="tcp writes not allowed"):
+            c.write(addr2, "3,00000064000000aa", b"no whitelist slot")
+        # store-side (HTTP plane) writes still funnel natively
+        n = Needle(cookie=0xAA, id=100, data=b"via http plane")
+        store2.write_needle(3, n)
+        assert c.read(addr2, "3,00000064000000aa") == b"via http plane"
+    finally:
+        plane2.stop()
+        store2.close()
+
+
+def test_engine_only_mode_no_listener(tmp_path):
+    """port=-1: no TCP listener at all (whitelist-guarded servers), but
+    the local C-API engine works end to end."""
+    v = _mk_volume(tmp_path)
+    v.close()
+    plane = NativeDataPlane("127.0.0.1", -1)
+    try:
+        assert plane.port == 0
+        plane.add_volume(1, str(tmp_path / "1.dat"), str(tmp_path / "1.idx"))
+        plane.write(1, 100, 0xAA, b"engine only")
+        blob, size = plane.read_record(1, 100, 0xAA)
+        assert b"engine only" in blob
+    finally:
+        plane.stop()
+
+
+def test_whitelisted_server_exposes_no_tcp_port(tmp_path):
+    """A whitelist-guarded volume server with -dataplane native must not
+    listen on the derived TCP port at all — the Python TCP plane drops
+    non-whitelisted connections outright, reads included."""
+    from seaweedfs_tpu.master.server import MasterServer
+    from seaweedfs_tpu.security.guard import Guard
+    from seaweedfs_tpu.utils.framing import tcp_port_for
+    from seaweedfs_tpu.volume_server.server import VolumeServer
+
+    from .conftest import free_port
+
+    m = MasterServer(port=free_port(), pulse_seconds=0.3).start()
+    vs = VolumeServer([str(tmp_path)], m.url, port=free_port(),
+                      pulse_seconds=0.3, dataplane="native",
+                      guard=Guard(white_list=["10.255.255.1"])).start()
+    try:
+        assert vs._native_plane is not None  # engine still native
+        assert vs._native_plane.port == 0
+        with pytest.raises(OSError):
+            socket.create_connection(
+                ("127.0.0.1", tcp_port_for(vs.store.port)), timeout=0.5)
     finally:
         vs.stop()
         m.stop()

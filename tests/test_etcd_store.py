@@ -129,3 +129,19 @@ def test_filer_on_etcd(store):
     with pytest.raises(NotFoundError):
         f.find_entry("/docs/readme.md")
     f.close()
+
+
+def test_prefix_with_low_start_file_fills_page(store):
+    """start_file below the prefix range must not return an empty page:
+    the range lower bound is the tighter of (start_file, prefix), like
+    RedisStore (the first non-matching name would otherwise `break`
+    before any match was reached)."""
+    for name in ("aa", "ab", "ba", "bb"):
+        store.insert_entry(_file(f"/p/{name}"))
+    got = [e.full_path for e in store.list_directory_entries(
+        "/p", start_file="aa", prefix="b", limit=2)]
+    assert got == ["/p/ba", "/p/bb"]
+    # a resume inside the prefix range still respects start_file
+    got = [e.full_path for e in store.list_directory_entries(
+        "/p", start_file="ba", prefix="b", limit=2)]
+    assert got == ["/p/bb"]

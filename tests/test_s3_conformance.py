@@ -578,3 +578,46 @@ def test_post_policy_rejects_crlf_key(s3):
               **_signed_policy_fields("postbkt", "uploads/")}
     status, body, _ = _post_form(s3, "postbkt", fields, b"x")
     assert status == 400
+
+
+def test_post_form_file_containing_boundary_bytes(s3):
+    """RFC 2046: the delimiter is CRLF--boundary; a file whose CONTENT
+    contains the bare boundary string must survive byte-for-byte."""
+    payload = b"before ----weedform1234 middle\n--more--\nafter"
+    fields = {"key": "uploads/tricky.bin",
+              **_signed_policy_fields("postbkt", "uploads/")}
+    status, body, _ = _post_form(s3, "postbkt", fields, payload)
+    assert status == 204, body
+    status, body, _ = _req(s3, "GET", "/postbkt/uploads/tricky.bin")
+    assert status == 200 and body == payload
+
+
+def test_post_policy_missing_expiration_fails_closed(s3):
+    """A signed policy without an expiration is treated as already
+    expired (ref policy/postpolicyform.go:222), not valid forever."""
+    import base64
+    import json as _json
+
+    from seaweedfs_tpu.gateway.s3_auth import sign_post_policy
+
+    amz_date = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
+    cred = f"{AK}/{amz_date[:8]}/us-east-1/s3/aws4_request"
+    policy = {"conditions": [{"bucket": "postbkt"},
+                             ["starts-with", "$key", "uploads/"],
+                             {"x-amz-credential": cred},
+                             {"x-amz-date": amz_date}]}
+    policy_b64 = base64.b64encode(_json.dumps(policy).encode()).decode()
+    fields = {"key": "uploads/forever.bin", "policy": policy_b64,
+              "x-amz-credential": cred, "x-amz-date": amz_date,
+              "x-amz-signature": sign_post_policy(policy_b64, SK, amz_date)}
+    status, body, _ = _post_form(s3, "postbkt", fields, b"x")
+    assert status == 403 and b"policy expired" in body
+
+
+def test_post_policy_rejects_uncovered_meta_field(s3):
+    """x-amz-meta-* form fields not covered by any policy condition are
+    'extra input fields' (ref policy/postpolicyform.go:234-240)."""
+    fields = {"key": "uploads/meta.bin", "x-amz-meta-sneaky": "1",
+              **_signed_policy_fields("postbkt", "uploads/")}
+    status, body, _ = _post_form(s3, "postbkt", fields, b"x")
+    assert status == 403 and b"extra input field" in body
