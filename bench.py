@@ -208,22 +208,55 @@ def _child(scratch_path: str, platform: str = "") -> None:
     section("transfer", meas_transfer)
 
     # --- e2e streaming file encode (overlapped pipeline) ------------------
-    def meas_e2e():
+    # run on BOTH a tmpfs and the default scratch disk: the delta
+    # separates pipeline cost from storage-medium cost (round-2 verdict:
+    # "nothing separates disk-bound from pipeline-overhead-bound")
+    def _e2e_one(base_dir, size_mb, reps=2):
         from seaweedfs_tpu.ec.streaming import StreamingEncoder
 
-        size_mb = 512 if on_tpu else 32
         raw = rng.integers(0, 256, size_mb << 20, dtype=np.uint8).tobytes()
-        with tempfile.TemporaryDirectory() as td:
+        with tempfile.TemporaryDirectory(dir=base_dir) as td:
             dat = os.path.join(td, "1.dat")
             with open(dat, "wb") as f:
                 f.write(raw)
             enc = StreamingEncoder(10, 4)
-            enc.encode_file(dat, os.path.join(td, "warm"))  # warm compile
-            t0 = time.perf_counter()
-            enc.encode_file(dat, os.path.join(td, "1"))
-            dt = time.perf_counter() - t0
-        detail["e2e_file_encode_mbps"] = round(len(raw) / dt / 1e6, 1)
-        detail["e2e_file_size_mb"] = size_mb
+            enc.encode_file(dat, os.path.join(td, "1"))  # warm compile+pages
+            best_dt, stats = float("inf"), None
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                enc.encode_file(dat, os.path.join(td, "1"))
+                dt = time.perf_counter() - t0
+                if dt < best_dt:
+                    best_dt, stats = dt, dict(enc.stats)
+            mbps = round(len(raw) / best_dt / 1e6, 1)
+            wall = stats.get("wall_s") or best_dt
+            pipe = {k: round(v, 3) if isinstance(v, float) else v
+                    for k, v in stats.items()}
+            # fraction of the wall the host was NOT blocked on the device
+            pipe["overlap_efficiency"] = round(
+                1.0 - stats.get("drain_wait_s", 0.0) / wall, 3)
+            return mbps, pipe
+
+    def meas_e2e():
+        size_mb = 512 if on_tpu else 256
+        shm = "/dev/shm" if os.path.isdir("/dev/shm") else None
+        if shm:
+            mbps, pipe = _e2e_one(shm, size_mb)
+            pipe["size_mb"] = size_mb
+            detail["e2e_file_encode_tmpfs_mbps"] = mbps
+            detail["e2e_pipeline_tmpfs"] = pipe
+            # pipeline efficiency vs the pure kernel number: > ~0.25 on a
+            # 1-core host means the serial fill+compute+write sum is the
+            # floor, not python overhead
+            kern = detail.get("cpu_simd_mbps")
+            if kern and not on_tpu:
+                detail["e2e_tmpfs_vs_kernel"] = round(mbps / kern, 3)
+        disk_mb = size_mb if on_tpu else 32
+        mbps, pipe = _e2e_one(None, disk_mb)
+        pipe["size_mb"] = disk_mb
+        detail["e2e_file_encode_mbps"] = mbps
+        detail["e2e_pipeline_disk"] = pipe
+        detail["e2e_file_size_mb"] = disk_mb
         # On a tunneled remote TPU the e2e rate is bound by pulling parity
         # (r/k of the data) back over the link; report the ceiling so the
         # pipeline's efficiency is separable from the link it ran over.
@@ -237,6 +270,85 @@ def _child(scratch_path: str, platform: str = "") -> None:
                 detail["e2e_file_encode_mbps"] / ceiling, 3)
 
     section("e2e_stream", meas_e2e)
+
+    # --- e2e rebuild latency (streaming, from files) ----------------------
+    def meas_e2e_rebuild():
+        from seaweedfs_tpu.ec.streaming import StreamingEncoder
+
+        # 1GB volume -> 100MB shards on TPU hosts; scaled down on the
+        # 1-core CPU box (the per-byte rate is what transfers)
+        vol_mb = 1024 if on_tpu else 256
+        shm = "/dev/shm" if os.path.isdir("/dev/shm") else None
+        raw = rng.integers(0, 256, vol_mb << 20, dtype=np.uint8).tobytes()
+        with tempfile.TemporaryDirectory(dir=shm) as td:
+            dat = os.path.join(td, "1.dat")
+            with open(dat, "wb") as f:
+                f.write(raw)
+            enc = StreamingEncoder(10, 4)
+            enc.encode_file(dat, os.path.join(td, "1"))
+            shard0 = os.path.join(td, "1.ec00")
+            os.remove(shard0)
+            enc.rebuild_files(os.path.join(td, "1"))  # warm
+            os.remove(shard0)
+            t0 = time.perf_counter()
+            enc.rebuild_files(os.path.join(td, "1"))
+            dt = time.perf_counter() - t0
+        detail["e2e_rebuild_volume_mb"] = vol_mb
+        detail["e2e_rebuild_ms"] = round(dt * 1e3, 1)
+        detail["e2e_rebuild_1gb_est_ms"] = round(dt * 1e3 * 1024 / vol_mb, 1)
+
+    section("e2e_rebuild", meas_e2e_rebuild)
+
+    # --- roofline: achieved vs memory-bandwidth ceiling -------------------
+    # RS(10,4) encode is memory-bound: the kernel must move at least
+    # (k+r)/k bytes per data byte (read k rows, write r rows).  The
+    # MFU-analog for this op is achieved_bytes_moved / peak_memory_BW.
+    TPU_HBM_GBPS = {  # public per-chip HBM bandwidth numbers
+        "v2": 700, "v3": 900, "v4": 1228, "v5e": 819, "v5p": 2765,
+        "v6e": 1640, "v6p": 7400,
+    }
+
+    def _host_mem_gbps():
+        # big-array copy bandwidth (counting read+write traffic) as the
+        # host roofline denominator
+        a = rng.integers(0, 256, 1 << 28, dtype=np.uint8)  # 256MB
+        b_ = np.empty_like(a)
+        np.copyto(b_, a)  # warm
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            np.copyto(b_, a)
+            best = min(best, time.perf_counter() - t0)
+        return 2 * a.nbytes / best / 1e9
+
+    def meas_roofline():
+        move_ratio = (10 + 4) / 10  # bytes moved per data byte
+        roof = {}
+        if on_tpu:
+            kind = str(jax.devices()[0].device_kind).lower()
+            peak = next((v for k, v in TPU_HBM_GBPS.items() if k in kind),
+                        None)
+            roof["device_kind"] = kind
+            roof["peak_hbm_gbps"] = peak
+            ach = detail.get("tpu_inhbm_pallas_mbps") \
+                or detail.get("tpu_inhbm_xla_mbps")
+            if ach and peak:
+                roof["achieved_moved_gbps"] = round(
+                    ach * move_ratio / 1e3, 1)
+                roof["hbm_fraction"] = round(
+                    ach * move_ratio / 1e3 / peak, 3)
+        else:
+            peak = round(_host_mem_gbps(), 1)
+            roof["host_copy_gbps"] = peak
+            ach = detail.get("cpu_simd_mbps")
+            if ach and peak:
+                roof["achieved_moved_gbps"] = round(
+                    ach * move_ratio / 1e3, 1)
+                roof["mem_bw_fraction"] = round(
+                    ach * move_ratio / 1e3 / peak, 3)
+        detail["roofline"] = roof
+
+    section("roofline", meas_roofline)
 
     # --- cluster write/read req/s (weed benchmark analog) ------------------
     import contextlib

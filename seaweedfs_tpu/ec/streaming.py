@@ -29,12 +29,13 @@ tests enforce byte-identical shards against the CPU path.
 from __future__ import annotations
 
 import os
-from collections import deque
+import time
+from collections import OrderedDict, deque
 from typing import Iterator, Optional
 
 import numpy as np
 
-from ..utils.ioutil import pread_padded
+from ..utils.ioutil import pread_padded, preadv_into
 from .gf256 import mat_invert, mat_mul
 from .layout import (
     DATA_SHARDS_COUNT,
@@ -73,12 +74,17 @@ class StreamingEncoder:
                  parity_shards: int = PARITY_SHARDS_COUNT,
                  matrix_kind: str = "vandermonde",
                  dispatch_mb: int = 8, depth: int = 3,
-                 engine: str = "auto"):
+                 engine: str = "auto", mesh: Optional[bool] = None):
         """engine: 'auto' uses the jax device path on a real accelerator
         and the host SIMD codec otherwise (jax-on-CPU is a correctness
         surface, ~200x slower than the AVX2 codec); 'device' forces the
         jax path (tests exercise the XLA kernels with it); 'host' forces
-        the SIMD codec."""
+        the SIMD codec.
+
+        mesh: None shards each dispatch over ALL visible devices
+        (parallel/mesh.py dp x sp x tp shard_map) whenever more than one
+        is present, so `-ec.engine=tpu` on a multi-chip host uses every
+        chip; True forces the mesh path, False forces single-device."""
         from .codec import ReedSolomon, best_cpu_engine
 
         self.k = data_shards
@@ -95,10 +101,31 @@ class StreamingEncoder:
             raise ValueError(f"engine must be auto/host/device, got {engine!r}")
         self.engine = engine
         self._host_engine = None
+        self._mesh = None
+        self._mesh_encode = None
         b = dispatch_mb << 20
         if engine == "host":
             self.on_tpu = False
             self._host_engine = best_cpu_engine()
+            # one worker thread gives the host codec the same overlap the
+            # device path gets for free: the SIMD matmul (a ctypes call,
+            # GIL released) computes dispatch d while the main thread
+            # fills and writes dispatch d+1.  ONE worker: dispatch order
+            # must match drain order, and the codec is already
+            # memory-bound so more threads would just thrash cache.  On a
+            # single core the thread only adds GIL convoying (measured
+            # ~7x WORSE than serial) — stay synchronous there.
+            self._host_pool = None
+            if (os.cpu_count() or 1) > 1:
+                import concurrent.futures
+                import weakref
+
+                self._host_pool = concurrent.futures.ThreadPoolExecutor(
+                    max_workers=1, thread_name_prefix="ec-host")
+                # encoders are sometimes created per-operation: the
+                # worker must not outlive its encoder
+                weakref.finalize(self, self._host_pool.shutdown,
+                                 wait=False)
         else:
             import jax
 
@@ -111,12 +138,45 @@ class StreamingEncoder:
             # one fixed dispatch width: multiple of the pallas tile on TPU
             if self.on_tpu:
                 b = max(DEFAULT_TILE_B, (b // DEFAULT_TILE_B) * DEFAULT_TILE_B)
+            # multi-chip: shard every dispatch over the full device mesh
+            # (dp over stripe rows, sp over byte columns, psum over the
+            # tp contraction) — `-ec.engine=tpu` must use every chip
+            ndev = len(jax.devices())
+            if mesh is None:
+                mesh = ndev > 1
+            if mesh:
+                from ..parallel.mesh import (factor_mesh, make_mesh,
+                                             sharded_encode_fn)
+
+                dp, sp, tp = factor_mesh(ndev)
+                self._mesh = make_mesh(dp, sp, tp)
+                self._mesh_dims = (dp, sp, tp)
+                self._mesh_encode = sharded_encode_fn(self._mesh)
+                # the dispatch width must split evenly over dp*sp
+                q = dp * sp * (DEFAULT_TILE_B if self.on_tpu else 64)
+                b = max(q, (b // q) * q)
         self.dispatch_b = b
         self.depth = depth
         # same matrix family as ReedSolomon so shards are byte-identical
         self.matrix = ReedSolomon(data_shards, parity_shards,
                                   matrix_kind=matrix_kind).matrix
-        self._plane_cache: dict[bytes, object] = {}
+        # LRU: a long-lived volume server cycles geometries and rebuild
+        # matrices (every distinct erasure pattern is a distinct key) —
+        # unbounded growth would pin HBM-resident plane arrays forever
+        self._plane_cache: OrderedDict[bytes, object] = OrderedDict()
+        self._plane_cache_max = 8
+        # per-call pipeline counters (read by bench.py's roofline section):
+        #   fill_s       host time filling input buffers from disk
+        #   write_s      host time writing shard outputs
+        #   drain_wait_s host time BLOCKED waiting for results — device
+        #                D2H fetches, or (host mode WITH the worker pool)
+        #                the not-yet-overlapped tail of the SIMD compute
+        #   dispatch_s   kernel submission; in SERIAL host mode (no pool,
+        #                single-core hosts) the whole SIMD compute lands
+        #                here instead
+        #   wall_s       whole-call wall clock
+        # overlap efficiency ~= 1 - drain_wait_s / wall_s
+        self.stats: dict[str, float] = {}
 
     # --- kernel dispatch --------------------------------------------------
     def _planes(self, rows: np.ndarray):
@@ -130,8 +190,22 @@ class StreamingEncoder:
         if p is None:
             import jax.numpy as jnp
 
-            p = jnp.asarray(self._expand(rows))
+            if self._mesh is not None:
+                # pre-place with the shard_map's in_spec sharding so the
+                # jitted call never reshards the (hot, cached) planes
+                from jax.sharding import NamedSharding
+                from jax.sharding import PartitionSpec as P
+
+                p = self._jax.device_put(
+                    self._expand(rows),
+                    NamedSharding(self._mesh, P(None, "tp")))
+            else:
+                p = jnp.asarray(self._expand(rows))
             self._plane_cache[key] = p
+            if len(self._plane_cache) > self._plane_cache_max:
+                self._plane_cache.popitem(last=False)
+        else:
+            self._plane_cache.move_to_end(key)
         return p
 
     def _dispatch(self, planes, buf: np.ndarray):
@@ -141,14 +215,29 @@ class StreamingEncoder:
         streams down while later dispatches compute.  Host mode: the SIMD
         codec runs synchronously and the parity comes back finished."""
         if self.engine == "host":
-            return self._host_engine.matmul(planes, buf)
-        from ..ops.gf_matmul import gf_matmul_pallas_packed, gf_matmul_xla_packed
+            if self._host_pool is None:
+                return self._host_engine.matmul(planes, buf)
+            return self._host_pool.submit(self._host_engine.matmul,
+                                          planes, buf)
+        if self._mesh_encode is not None:
+            # multi-chip: view the byte stream as a [dp, b/dp] stripe
+            # grid and let the shard_map place dp x sp blocks per chip
+            from ..parallel.mesh import shard_data
 
-        dev = self._jax.device_put(buf)
-        if self.on_tpu:
-            out = gf_matmul_pallas_packed(planes, dev)
+            dp, sp, tp = self._mesh_dims
+            k = buf.shape[0]
+            dev = shard_data(self._mesh,
+                             buf.reshape(k, dp, self.dispatch_b // dp))
+            out = self._mesh_encode(planes, dev)  # [R, dp, b/dp] u8
         else:
-            out = gf_matmul_xla_packed(planes, dev)
+            from ..ops.gf_matmul import (gf_matmul_pallas_packed,
+                                         gf_matmul_xla_packed)
+
+            dev = self._jax.device_put(buf)
+            if self.on_tpu:
+                out = gf_matmul_pallas_packed(planes, dev)
+            else:
+                out = gf_matmul_xla_packed(planes, dev)
         try:
             out.copy_to_host_async()
         except Exception:  # pragma: no cover - backend without async D2H
@@ -157,61 +246,100 @@ class StreamingEncoder:
 
     def _fetch(self, out_dev) -> np.ndarray:
         """Blocking fetch + host-side unpack back to [R, dispatch-width] u8."""
+        import concurrent.futures
+
+        if isinstance(out_dev, concurrent.futures.Future):  # host worker
+            return out_dev.result()
         if isinstance(out_dev, np.ndarray):  # host mode: already finished
             return out_dev
         from ..ops.gf_matmul import unpack_u32_host
 
         words = np.asarray(out_dev)
+        if words.ndim == 3:  # mesh path: unpacked u8 [R, dp, b/dp]
+            return words.reshape(words.shape[0], -1)
         return unpack_u32_host(words, words.shape[1] * 4)
 
     # --- encode -----------------------------------------------------------
+    def _reset_stats(self) -> dict:
+        self.stats = {"dispatches": 0, "fill_s": 0.0, "dispatch_s": 0.0,
+                      "write_s": 0.0, "drain_wait_s": 0.0, "wall_s": 0.0,
+                      "bytes_in": 0}
+        return self.stats
+
     def encode_file(self, dat_path: str, out_base: str,
                     large_block_size: int = LARGE_BLOCK_SIZE,
                     small_block_size: int = SMALL_BLOCK_SIZE) -> None:
         """dat_path -> out_base.ec00..ecNN, byte-identical to
         encoder.write_ec_files (WriteEcFiles, ec_encoder.go:57)."""
         k, r, b = self.k, self.r, self.dispatch_b
+        st = self._reset_stats()
+        clock = time.perf_counter
+        t_start = clock()
         planes = self._planes(self.matrix[k:])
         file_size = os.path.getsize(dat_path)
         outputs = [open(out_base + to_ext(i), "wb") for i in range(k + r)]
         bufs = [np.zeros((k, b), dtype=np.uint8) for _ in range(self.depth + 1)]
         free: deque[int] = deque(range(len(bufs)))
-        pending: deque[tuple[object, list, int]] = deque()
+        # (device parity, packed width, buffer index)
+        pending: deque[tuple[object, int, int]] = deque()
 
         def drain_one():
-            parity_dev, entries, bi = pending.popleft()
+            parity_dev, u, bi = pending.popleft()
+            t0 = clock()
             parity = self._fetch(parity_dev)
-            for col, n in entries:
-                for j in range(r):
-                    outputs[k + j].write(parity[j, col:col + n])
+            st["drain_wait_s"] += clock() - t0
+            t0 = clock()
+            # entries pack side by side, so each parity row's bytes for
+            # this dispatch are one contiguous slice
+            for j in range(r):
+                outputs[k + j].write(memoryview(parity[j, :u]))
+            st["write_s"] += clock() - t0
             free.append(bi)
 
         try:
             with open(dat_path, "rb") as dat:
-                cur: list[tuple[int, int]] = []   # (col, n) per entry
                 fills: list[tuple[int, int, int, int, int]] = []
                 used = 0
                 bi = free.popleft()
 
                 def flush():
-                    nonlocal bi, used, cur, fills
-                    if not cur:
+                    nonlocal bi, used, fills
+                    if not used:
                         return
                     buf = bufs[bi]
+                    t0 = clock()
                     for col, n, row_start, block, off in fills:
-                        for i in range(k):
-                            buf[i, col:col + n] = pread_padded(
-                                dat, n, row_start + i * block + off)
+                        if off == 0 and n == block:
+                            # whole-block entry: the k per-shard reads are
+                            # CONTIGUOUS in the file ([row_start, +k*block))
+                            # — one vectored read straight into the k
+                            # strided buffer slices, no intermediate copy
+                            # (small rows always take this path; chunked
+                            # 1GB rows fall through)
+                            preadv_into(
+                                dat, [buf[i, col:col + n] for i in range(k)],
+                                row_start)
+                        else:
+                            for i in range(k):
+                                buf[i, col:col + n] = pread_padded(
+                                    dat, n, row_start + i * block + off)
                     if used < b:
                         buf[:, used:] = 0
+                    st["fill_s"] += clock() - t0
+                    t0 = clock()
                     parity_dev = self._dispatch(planes, buf)
+                    st["dispatch_s"] += clock() - t0
+                    st["dispatches"] += 1
+                    st["bytes_in"] += k * used
                     # data shards pass through from the host buffer while
-                    # the device computes parity
-                    for col, n in cur:
-                        for i in range(k):
-                            outputs[i].write(buf[i, col:col + n])
-                    pending.append((parity_dev, cur, bi))
-                    cur, fills, used = [], [], 0
+                    # the device computes parity; packed entries make each
+                    # shard's bytes one contiguous slice
+                    t0 = clock()
+                    for i in range(k):
+                        outputs[i].write(memoryview(buf[i, :used]))
+                    st["write_s"] += clock() - t0
+                    pending.append((parity_dev, used, bi))
+                    fills, used = [], 0
                     if len(pending) > self.depth:
                         drain_one()
                     if not free:
@@ -222,7 +350,6 @@ class StreamingEncoder:
                         file_size, k, large_block_size, small_block_size, b):
                     if used + n > b:
                         flush()
-                    cur.append((used, n))
                     fills.append((used, n, row_start, block, off))
                     used += n
                 flush()
@@ -231,6 +358,7 @@ class StreamingEncoder:
         finally:
             for f in outputs:
                 f.close()
+            st["wall_s"] = clock() - t_start
 
     # --- rebuild ----------------------------------------------------------
     def rebuild_files(self, base_file_name: str) -> list[int]:
@@ -283,11 +411,19 @@ class StreamingEncoder:
         free: deque[int] = deque(range(len(bufs)))
         pending: deque[tuple[object, int, int]] = deque()
 
+        st = self._reset_stats()
+        clock = time.perf_counter
+        t_start = clock()
+
         def drain_one():
             out_dev, n, bi = pending.popleft()
+            t0 = clock()
             out = self._fetch(out_dev)
+            st["drain_wait_s"] += clock() - t0
+            t0 = clock()
             for row_i, m in enumerate(missing):
                 outputs[m].write(out[row_i, :n])
+            st["write_s"] += clock() - t0
             free.append(bi)
 
         ok = False
@@ -298,11 +434,17 @@ class StreamingEncoder:
                     drain_one()
                 bi = free.popleft()
                 buf = bufs[bi]
+                t0 = clock()
                 for row_i, s in enumerate(survivors):
-                    buf[row_i, :n] = pread_padded(inputs[s], n, offset)
+                    preadv_into(inputs[s], [buf[row_i, :n]], offset)
                 if n < b:
                     buf[:, n:] = 0
+                st["fill_s"] += clock() - t0
+                t0 = clock()
                 pending.append((self._dispatch(planes, buf), n, bi))
+                st["dispatch_s"] += clock() - t0
+                st["dispatches"] += 1
+                st["bytes_in"] += len(survivors) * n
                 if len(pending) > self.depth:
                     drain_one()
             while pending:
@@ -321,4 +463,5 @@ class StreamingEncoder:
                         os.remove(base_file_name + to_ext(m))
                     except OSError:
                         pass
+            st["wall_s"] = clock() - t_start
         return missing

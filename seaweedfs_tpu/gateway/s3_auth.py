@@ -595,7 +595,9 @@ def check_policy_conditions(policy: dict, bucket: str, key: str,
             exp.replace("Z", "+00:00")).timestamp()
         if time.time() > when:
             return "policy expired"
-    except ValueError:
+    except (ValueError, AttributeError, TypeError):
+        # non-string expiration (a signed-but-bogus document) is a 403,
+        # not a 500
         return "malformed expiration"
     # form fields participate in conditions, but the SERVER-derived
     # bucket and expanded key always win — a client-supplied "bucket"

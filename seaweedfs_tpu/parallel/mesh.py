@@ -30,6 +30,15 @@ from jax.sharding import PartitionSpec as P
 from ..ops.gf_matmul import _pack_bits, _unpack_bitplanes
 
 
+def factor_mesh(n_devices: int) -> tuple[int, int, int]:
+    """Factor n into (dp, sp, tp), preferring all three axes real."""
+    tp = 2 if n_devices % 2 == 0 else 1
+    rem = n_devices // tp
+    sp = 2 if rem % 2 == 0 else 1
+    dp = rem // sp
+    return dp, sp, tp
+
+
 def make_mesh(dp: int = 1, sp: int = 1, tp: int = 1,
               devices: list | None = None) -> Mesh:
     devices = devices if devices is not None else jax.devices()

@@ -15,3 +15,25 @@ def pread_padded(f, length: int, offset: int) -> np.ndarray:
     if buf:
         arr[: len(buf)] = np.frombuffer(buf, dtype=np.uint8)
     return arr
+
+
+def preadv_into(f, views: list, offset: int) -> None:
+    """Scatter one contiguous file span at `offset` directly into `views`
+    (writable buffers, consumed in order) with vectored reads — no
+    intermediate bytes object.  Zero-fills everything past EOF (the same
+    EC tail rule as pread_padded).  Loops on short reads."""
+    fd = f.fileno()
+    filled = 0
+    pending = [memoryview(v) for v in views]
+    while pending:
+        got = os.preadv(fd, pending, offset + filled)
+        if got <= 0:
+            break  # EOF
+        filled += got
+        while pending and got >= len(pending[0]):
+            got -= len(pending[0])
+            pending.pop(0)
+        if pending and got:
+            pending[0] = pending[0][got:]
+    for v in pending:
+        v[:] = bytes(len(v))
