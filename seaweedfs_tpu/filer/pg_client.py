@@ -1,0 +1,259 @@
+"""Pure-stdlib PostgreSQL v3 wire-protocol client.
+
+The no-SDK transport for the postgres filer store (the same pattern as
+`filer/redis_store.py`'s RESP2 client): TCP + the frontend/backend
+protocol, nothing else.  Supports trust, cleartext, md5 and
+SCRAM-SHA-256 auth, and parameterized queries through the extended
+protocol (Parse/Bind/Execute/Sync) with text-format values — no
+client-side SQL escaping anywhere.
+
+Counterpart of the reference's database/sql + lib/pq layer behind
+weed/filer/postgres/postgres_store.go.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import os
+import socket
+import struct
+import threading
+from typing import Optional
+
+
+class PgError(Exception):
+    def __init__(self, fields: dict):
+        self.fields = fields
+        super().__init__(fields.get("M", "postgres error"))
+
+    @property
+    def code(self) -> str:
+        return self.fields.get("C", "")
+
+
+def _msg(tag: bytes, payload: bytes) -> bytes:
+    return tag + struct.pack(">I", len(payload) + 4) + payload
+
+
+def _cstr(s: str) -> bytes:
+    return s.encode() + b"\x00"
+
+
+class PgConn:
+    """One connection; a lock serializes whole round-trips so the filer's
+    handler threads can share it (queries are short)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 5432,
+                 user: str = "seaweed", password: str = "",
+                 database: str = "seaweedfs", timeout: float = 10.0):
+        self.host, self.port = host, port
+        self.user, self.password, self.database = user, password, database
+        self.timeout = timeout
+        self._lock = threading.Lock()
+        self._sock: Optional[socket.socket] = None
+        self._buf = b""
+        self._connect()
+
+    # --- transport --------------------------------------------------------
+    def _connect(self) -> None:
+        s = socket.create_connection((self.host, self.port), self.timeout)
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._sock, self._buf = s, b""
+        params = (_cstr("user") + _cstr(self.user) +
+                  _cstr("database") + _cstr(self.database) + b"\x00")
+        body = struct.pack(">I", 196608) + params  # protocol 3.0
+        s.sendall(struct.pack(">I", len(body) + 4) + body)
+        self._auth()
+        # drain ParameterStatus/BackendKeyData until ReadyForQuery
+        while True:
+            tag, payload = self._recv()
+            if tag == b"Z":
+                return
+            if tag == b"E":
+                raise PgError(self._err_fields(payload))
+
+    def _recv(self) -> tuple[bytes, bytes]:
+        while len(self._buf) < 5:
+            chunk = self._sock.recv(65536)
+            if not chunk:
+                raise ConnectionError("postgres connection closed")
+            self._buf += chunk
+        tag = self._buf[:1]
+        (ln,) = struct.unpack(">I", self._buf[1:5])
+        while len(self._buf) < 1 + ln:
+            chunk = self._sock.recv(65536)
+            if not chunk:
+                raise ConnectionError("postgres connection closed")
+            self._buf += chunk
+        payload = self._buf[5:1 + ln]
+        self._buf = self._buf[1 + ln:]
+        return tag, payload
+
+    @staticmethod
+    def _err_fields(payload: bytes) -> dict:
+        fields = {}
+        for part in payload.split(b"\x00"):
+            if part:
+                fields[chr(part[0])] = part[1:].decode(errors="replace")
+        return fields
+
+    # --- auth -------------------------------------------------------------
+    def _auth(self) -> None:
+        while True:
+            tag, payload = self._recv()
+            if tag == b"E":
+                raise PgError(self._err_fields(payload))
+            if tag != b"R":
+                continue
+            (kind,) = struct.unpack(">I", payload[:4])
+            if kind == 0:  # AuthenticationOk
+                return
+            if kind == 3:  # CleartextPassword
+                self._sock.sendall(_msg(b"p", _cstr(self.password)))
+            elif kind == 5:  # MD5Password
+                salt = payload[4:8]
+                inner = hashlib.md5(
+                    (self.password + self.user).encode()).hexdigest()
+                outer = hashlib.md5(inner.encode() + salt).hexdigest()
+                self._sock.sendall(_msg(b"p", _cstr("md5" + outer)))
+            elif kind == 10:  # SASL: SCRAM-SHA-256
+                self._scram()
+            elif kind in (11, 12):
+                pass  # SASLContinue/Final handled inside _scram
+            else:
+                raise PgError({"M": f"unsupported auth method {kind}"})
+
+    def _scram(self) -> None:
+        nonce = base64.b64encode(os.urandom(18)).decode()
+        first_bare = f"n=,r={nonce}"
+        init = b"n,," + first_bare.encode()
+        body = _cstr("SCRAM-SHA-256") + struct.pack(">I", len(init)) + init
+        self._sock.sendall(_msg(b"p", body))
+        tag, payload = self._recv()
+        if tag == b"E":
+            raise PgError(self._err_fields(payload))
+        (kind,) = struct.unpack(">I", payload[:4])
+        if kind != 11:
+            raise PgError({"M": f"unexpected SASL response {kind}"})
+        server_first = payload[4:].decode()
+        parts = dict(p.split("=", 1) for p in server_first.split(","))
+        r, s, i = parts["r"], parts["s"], int(parts["i"])
+        if not r.startswith(nonce):
+            raise PgError({"M": "SCRAM nonce mismatch"})
+        salted = hashlib.pbkdf2_hmac("sha256", self.password.encode(),
+                                     base64.b64decode(s), i)
+        client_key = hmac.new(salted, b"Client Key", hashlib.sha256).digest()
+        stored_key = hashlib.sha256(client_key).digest()
+        without_proof = f"c={base64.b64encode(b'n,,').decode()},r={r}"
+        auth_message = f"{first_bare},{server_first},{without_proof}"
+        sig = hmac.new(stored_key, auth_message.encode(),
+                       hashlib.sha256).digest()
+        proof = bytes(a ^ b for a, b in zip(client_key, sig))
+        final = f"{without_proof},p={base64.b64encode(proof).decode()}"
+        self._sock.sendall(_msg(b"p", final.encode()))
+        tag, payload = self._recv()
+        if tag == b"E":
+            raise PgError(self._err_fields(payload))
+        (kind,) = struct.unpack(">I", payload[:4])
+        if kind != 12:  # SASLFinal
+            raise PgError({"M": f"SCRAM did not complete ({kind})"})
+        server_key = hmac.new(salted, b"Server Key", hashlib.sha256).digest()
+        want = hmac.new(server_key, auth_message.encode(),
+                        hashlib.sha256).digest()
+        got = dict(p.split("=", 1)
+                   for p in payload[4:].decode().split(",")).get("v", "")
+        if base64.b64decode(got) != want:
+            raise PgError({"M": "SCRAM server signature mismatch"})
+
+    # --- queries ----------------------------------------------------------
+    def _with_reconnect(self, fn):
+        """One reconnect-and-retry on a dropped connection (server
+        restart, idle timeout).  Safe here because every store statement
+        is idempotent (upserts, deletes, selects); without it a single
+        TCP failure would brick the shared connection for every filer
+        handler thread until a process restart."""
+        if self._sock is None:
+            self._connect()
+        try:
+            return fn()
+        except (ConnectionError, OSError):
+            try:
+                self._sock.close()
+            except (OSError, AttributeError):
+                pass
+            self._sock = None
+            self._connect()
+            return fn()
+
+    def execute(self, sql: str, params: tuple = ()) -> list[tuple]:
+        """Extended-protocol parameterized query; returns text-decoded
+        rows (None for SQL NULL)."""
+        with self._lock:
+            return self._with_reconnect(
+                lambda: self._execute_locked(sql, params))
+
+    def _execute_locked(self, sql: str, params: tuple) -> list[tuple]:
+        vals = [None if p is None else str(p).encode() for p in params]
+        parse = _cstr("") + _cstr(sql) + struct.pack(">H", 0)
+        bind = _cstr("") + _cstr("") + struct.pack(">H", 0)
+        bind += struct.pack(">H", len(vals))
+        for v in vals:
+            bind += struct.pack(">i", -1) if v is None else \
+                struct.pack(">I", len(v)) + v
+        bind += struct.pack(">H", 0)  # result columns in text format
+        execute = _cstr("") + struct.pack(">I", 0)
+        self._sock.sendall(_msg(b"P", parse) + _msg(b"B", bind) +
+                           _msg(b"E", execute) + _msg(b"S", b""))
+        rows: list[tuple] = []
+        err: Optional[PgError] = None
+        while True:
+            tag, payload = self._recv()
+            if tag == b"D":
+                (ncols,) = struct.unpack(">H", payload[:2])
+                off, row = 2, []
+                for _ in range(ncols):
+                    (ln,) = struct.unpack(">i", payload[off:off + 4])
+                    off += 4
+                    if ln < 0:
+                        row.append(None)
+                    else:
+                        row.append(payload[off:off + ln].decode())
+                        off += ln
+                rows.append(tuple(row))
+            elif tag == b"E":
+                err = PgError(self._err_fields(payload))
+            elif tag == b"Z":
+                if err is not None:
+                    raise err
+                return rows
+            # ParseComplete/BindComplete/CommandComplete/NoData: skip
+
+    def executescript(self, sql: str) -> None:
+        """Simple-protocol query for DDL (no parameters)."""
+
+        def run():
+            self._sock.sendall(_msg(b"Q", _cstr(sql)))
+            err: Optional[PgError] = None
+            while True:
+                tag, payload = self._recv()
+                if tag == b"E":
+                    err = PgError(self._err_fields(payload))
+                elif tag == b"Z":
+                    if err is not None:
+                        raise err
+                    return
+
+        with self._lock:
+            self._with_reconnect(run)
+
+    def close(self) -> None:
+        with self._lock:
+            if self._sock is not None:
+                try:
+                    self._sock.sendall(_msg(b"X", b""))
+                    self._sock.close()
+                except OSError:
+                    pass
+                self._sock = None

@@ -1,0 +1,397 @@
+"""Abstract-SQL filer store: one shared engine, pluggable dialects.
+
+Equivalent of weed/filer/abstract_sql/abstract_sql_store.go — the shared
+SQL engine the reference puts behind mysql/mysql2/postgres/postgres2.
+Rows are (dirhash BIGINT, name, directory, meta) keyed on
+(dirhash, name), with dirhash = signed-int64 of the md5 of the directory
+(util.HashStringToLong, ref: weed/util/bytes.go:77) so the hot index is
+fixed-width.  `/buckets/<bucket>/...` paths get their own table when the
+bucket option is on (ref: abstract_sql_store.go:96-145), making bucket
+deletion a DROP TABLE.
+
+Dialects supply placeholders + upsert syntax only; every query shape is
+shared:
+  - sqlite   — `?`,   INSERT .. ON CONFLICT DO UPDATE (embedded engine)
+  - postgres — `$N`,  INSERT .. ON CONFLICT DO UPDATE
+               (ref: weed/filer/postgres/postgres_sql_gen.go)
+  - mysql    — `%s`,  INSERT .. ON DUPLICATE KEY UPDATE
+               (ref: weed/filer/mysql/mysql_sql_gen.go)
+
+The connection is anything with `execute(sql, params) -> rows` and
+`executescript(sql)`: `SqliteConn` (stdlib) or `PgConn`
+(`filer/pg_client.py`, a pure-stdlib wire-protocol client — the same
+no-SDK pattern as the redis RESP2 store).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import re
+import sqlite3
+import struct
+import threading
+from typing import Iterator, Optional
+
+from .entry import Entry
+from .filer_store import split_dir_name
+
+DEFAULT_TABLE = "filemeta"
+_BUCKET_RE = re.compile(r"^[a-z0-9][a-z0-9._-]{1,62}$")
+
+
+def _bucket_table(bucket: str) -> str:
+    """Injective bucket -> identifier mapping: 'my-bucket', 'my.bucket'
+    and 'my_bucket' must NOT share a table (a shared table would let one
+    bucket's deletion drop another's data)."""
+    return "bucket_" + (bucket.replace("_", "_u")
+                        .replace(".", "_d").replace("-", "_h"))
+
+
+def hash_string_to_long(s: str) -> int:
+    """Signed int64 of md5(s) — ref weed/util/bytes.go:77 semantics (a
+    stable 64-bit directory key; exact bit layout is internal to each
+    implementation, only stability matters)."""
+    h = hashlib.md5(s.encode()).digest()
+    return struct.unpack(">q", h[:8])[0]
+
+
+class SqlDialect:
+    """Query text per backend; the engine only varies placeholders and
+    the upsert clause."""
+
+    name = "sqlite"
+    # SQL text `ESCAPE '\'` — mysql overrides: its backslash string
+    # escaping needs the backslash doubled inside the literal
+    escape_sql = "ESCAPE '\\'"
+
+    def ph(self, n: int) -> list[str]:
+        return ["?"] * n
+
+    def create_table(self, table: str) -> str:
+        return (f"CREATE TABLE IF NOT EXISTS {table} ("
+                "dirhash BIGINT NOT NULL, name TEXT NOT NULL, "
+                "directory TEXT NOT NULL, meta TEXT NOT NULL, "
+                "PRIMARY KEY (dirhash, name))")
+
+    def drop_table(self, table: str) -> str:
+        return f"DROP TABLE IF EXISTS {table}"
+
+    def upsert(self, table: str) -> str:
+        p = self.ph(4)
+        return (f"INSERT INTO {table} (dirhash,name,directory,meta) "
+                f"VALUES ({p[0]},{p[1]},{p[2]},{p[3]}) "
+                "ON CONFLICT (dirhash,name) DO UPDATE SET "
+                "meta = excluded.meta, directory = excluded.directory")
+
+    def find(self, table: str) -> str:
+        p = self.ph(2)
+        return (f"SELECT meta FROM {table} "
+                f"WHERE dirhash={p[0]} AND name={p[1]}")
+
+    def delete(self, table: str) -> str:
+        p = self.ph(2)
+        return f"DELETE FROM {table} WHERE dirhash={p[0]} AND name={p[1]}"
+
+    def delete_children(self, table: str) -> str:
+        p = self.ph(2)
+        return (f"DELETE FROM {table} "
+                f"WHERE directory={p[0]} OR directory LIKE {p[1]} "
+                f"{self.escape_sql}")
+
+    def list(self, table: str, inclusive: bool) -> str:
+        p = self.ph(4)
+        op = ">=" if inclusive else ">"
+        return (f"SELECT name, meta FROM {table} "
+                f"WHERE dirhash={p[0]} AND name {op} {p[1]} "
+                f"AND name LIKE {p[2]} {self.escape_sql} "
+                f"ORDER BY name ASC LIMIT {p[3]}")
+
+    # kv on a side table (ref abstract_sql KvPut/KvGet reuse filemeta
+    # with a synthetic dir; a dedicated table keeps scans cheap)
+    def create_kv_table(self) -> str:
+        return ("CREATE TABLE IF NOT EXISTS filekv ("
+                "k TEXT PRIMARY KEY, v TEXT NOT NULL)")
+
+    def kv_upsert(self) -> str:
+        p = self.ph(2)
+        return (f"INSERT INTO filekv (k,v) VALUES ({p[0]},{p[1]}) "
+                "ON CONFLICT (k) DO UPDATE SET v = excluded.v")
+
+    def kv_get(self) -> str:
+        return f"SELECT v FROM filekv WHERE k={self.ph(1)[0]}"
+
+    def kv_delete(self) -> str:
+        return f"DELETE FROM filekv WHERE k={self.ph(1)[0]}"
+
+    def kv_scan(self) -> str:
+        p = self.ph(2)
+        return (f"SELECT k, v FROM filekv WHERE k >= {p[0]} AND k < {p[1]} "
+                "ORDER BY k ASC")
+
+
+class PostgresDialect(SqlDialect):
+    name = "postgres"
+
+    def ph(self, n: int) -> list[str]:
+        return [f"${i + 1}" for i in range(n)]
+
+
+class MysqlDialect(SqlDialect):
+    name = "mysql"
+    escape_sql = "ESCAPE '\\\\'"  # mysql lexes '\\' as one backslash
+
+    def ph(self, n: int) -> list[str]:
+        return ["%s"] * n
+
+    def create_table(self, table: str) -> str:
+        return (f"CREATE TABLE IF NOT EXISTS `{table}` ("
+                "dirhash BIGINT NOT NULL, name VARCHAR(766) NOT NULL, "
+                "directory TEXT NOT NULL, meta LONGBLOB, "
+                "PRIMARY KEY (dirhash, name)) DEFAULT CHARSET utf8mb4")
+
+    def create_kv_table(self) -> str:
+        # TEXT cannot be a mysql primary key without a length; keys are
+        # hex so latin1 VARCHAR is exact
+        return ("CREATE TABLE IF NOT EXISTS filekv ("
+                "k VARCHAR(766) NOT NULL, v LONGTEXT NOT NULL, "
+                "PRIMARY KEY (k)) DEFAULT CHARSET latin1")
+
+    def upsert(self, table: str) -> str:
+        return (f"INSERT INTO `{table}` (dirhash,name,directory,meta) "
+                "VALUES (%s,%s,%s,%s) "
+                "ON DUPLICATE KEY UPDATE meta = VALUES(meta), "
+                "directory = VALUES(directory)")
+
+    def kv_upsert(self) -> str:
+        return ("INSERT INTO filekv (k,v) VALUES (%s,%s) "
+                "ON DUPLICATE KEY UPDATE v = VALUES(v)")
+
+
+DIALECTS = {"sqlite": SqlDialect, "postgres": PostgresDialect,
+            "mysql": MysqlDialect}
+
+
+class SqliteConn:
+    """Thread-local sqlite3 connections behind the engine's tiny
+    connection protocol."""
+
+    def __init__(self, path: str):
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._path = path
+        self._local = threading.local()
+        self._all: list[sqlite3.Connection] = []
+        self._all_lock = threading.Lock()
+        self._gen = 0  # bumped by close(): other threads' cached
+        #                connections are stale and must be reopened
+
+    def _con(self) -> sqlite3.Connection:
+        con = getattr(self._local, "con", None)
+        if con is None or getattr(self._local, "gen", -1) != self._gen:
+            # check_same_thread=False ONLY so close() can shut every
+            # thread's connection down; use stays per-thread via the
+            # threading.local
+            con = sqlite3.connect(self._path, timeout=30,
+                                  check_same_thread=False)
+            con.execute("PRAGMA journal_mode=WAL")
+            self._local.con = con
+            self._local.gen = self._gen
+            with self._all_lock:
+                self._all.append(con)
+        return con
+
+    def execute(self, sql: str, params: tuple = ()) -> list[tuple]:
+        con = self._con()
+        cur = con.execute(sql, params)
+        rows = cur.fetchall() if cur.description else []
+        con.commit()
+        return rows
+
+    def executescript(self, sql: str) -> None:
+        con = self._con()
+        con.execute(sql)
+        con.commit()
+
+    def close(self) -> None:
+        """Close EVERY thread's connection (handler threads each hold
+        one; leaving theirs open pins the WAL files past shutdown).
+        The generation bump makes other threads' cached handles stale —
+        a late request reopens instead of hitting a closed handle."""
+        with self._all_lock:
+            cons, self._all = self._all, []
+            self._gen += 1
+        for con in cons:
+            try:
+                con.close()
+            except sqlite3.Error:
+                pass
+        self._local.con = None
+
+
+def _like_escape(s: str) -> str:
+    return s.replace("\\", "\\\\").replace("%", r"\%").replace("_", r"\_")
+
+
+class AbstractSqlStore:
+    """FilerStore over any SQL backend through a dialect + connection."""
+
+    def __init__(self, conn, dialect: str = "sqlite",
+                 bucket_tables: bool = False):
+        self.conn = conn
+        self.dialect: SqlDialect = DIALECTS[dialect]()
+        self.name = f"sql-{self.dialect.name}"
+        self.bucket_tables = bucket_tables
+        self._tables: set[str] = set()
+        self._tables_lock = threading.Lock()
+        self.conn.executescript(self.dialect.create_table(DEFAULT_TABLE))
+        self.conn.executescript(self.dialect.create_kv_table())
+        self._tables.add(DEFAULT_TABLE)
+
+    # --- bucket-table routing (abstract_sql_store.go:96-145) --------------
+    def _route(self, path: str, for_children: bool = False,
+               create: bool = False) -> tuple[str, str]:
+        """(table, short_path): /buckets/<b>/... lands in table <b>.
+        Tables are created ONLY on write paths (`create=True`) — a read
+        of a nonexistent bucket must be side-effect-free; readers of a
+        never-created table get a missing-table error the callers map to
+        not-found/empty."""
+        if self.bucket_tables and path.startswith("/buckets/"):
+            rest = path[len("/buckets/"):]
+            bucket, slash, short = rest.partition("/")
+            if (slash or for_children) and _BUCKET_RE.match(bucket):
+                table = _bucket_table(bucket)
+                if create:
+                    with self._tables_lock:
+                        if table not in self._tables:
+                            self.conn.executescript(
+                                self.dialect.create_table(table))
+                            self._tables.add(table)
+                return table, "/" + short
+        return DEFAULT_TABLE, path
+
+    def on_bucket_deletion(self, bucket: str) -> None:
+        if not self.bucket_tables or not _BUCKET_RE.match(bucket):
+            return
+        table = _bucket_table(bucket)
+        with self._tables_lock:
+            self.conn.executescript(self.dialect.drop_table(table))
+            self._tables.discard(table)
+
+    @staticmethod
+    def _missing_table(exc: Exception) -> bool:
+        from .pg_client import PgError
+
+        if isinstance(exc, sqlite3.OperationalError):
+            return "no such table" in str(exc)
+        if isinstance(exc, PgError):
+            return exc.code == "42P01"  # undefined_table
+        return False
+
+    # --- entries ----------------------------------------------------------
+    def insert_entry(self, entry: Entry) -> None:
+        table, short = self._route(entry.full_path, create=True)
+        d, name = split_dir_name(short)
+        self.conn.execute(
+            self.dialect.upsert(table),
+            (hash_string_to_long(d), name, d,
+             json.dumps(entry.to_dict())))
+
+    update_entry = insert_entry
+
+    def find_entry(self, path: str) -> Optional[Entry]:
+        table, short = self._route(path)
+        d, name = split_dir_name(short)
+        try:
+            rows = self.conn.execute(self.dialect.find(table),
+                                     (hash_string_to_long(d), name))
+        except Exception as e:
+            if self._missing_table(e):
+                return None  # bucket never written: plain miss
+            raise
+        if not rows:
+            return None
+        e = Entry.from_dict(json.loads(rows[0][0]))
+        e.full_path = path  # bucket tables store the SHORT path
+        return e
+
+    def delete_entry(self, path: str) -> None:
+        table, short = self._route(path)
+        d, name = split_dir_name(short)
+        try:
+            self.conn.execute(self.dialect.delete(table),
+                              (hash_string_to_long(d), name))
+        except Exception as e:
+            if not self._missing_table(e):
+                raise
+
+    def delete_folder_children(self, path: str) -> None:
+        # deleting a bucket root IS the table drop (the point of
+        # bucket tables: O(1) bucket deletion, CanDropWholeBucket)
+        if self.bucket_tables and path.startswith("/buckets/"):
+            bucket = path[len("/buckets/"):].strip("/")
+            if "/" not in bucket and _BUCKET_RE.match(bucket):
+                self.on_bucket_deletion(bucket)
+                return
+        table, short = self._route(path, for_children=True)
+        base = short.rstrip("/") or "/"
+        try:
+            self.conn.execute(self.dialect.delete_children(table),
+                              (base, _like_escape(base.rstrip("/")) + "/%"))
+        except Exception as e:
+            if not self._missing_table(e):
+                raise
+
+    def list_directory_entries(self, dir_path: str, start_file: str = "",
+                               include_start: bool = False,
+                               limit: int = 1000,
+                               prefix: str = "") -> Iterator[Entry]:
+        table, short = self._route(dir_path, for_children=True)
+        d = short.rstrip("/") or "/"
+        full_base = dir_path.rstrip("/")
+        try:
+            rows = self.conn.execute(
+                self.dialect.list(table, include_start),
+                (hash_string_to_long(d), start_file,
+                 _like_escape(prefix) + "%", limit))
+        except Exception as e:
+            if self._missing_table(e):
+                return  # bucket never written: empty listing
+            raise
+        for name, meta in rows:
+            e = Entry.from_dict(json.loads(meta))
+            e.full_path = f"{full_base}/{name}"
+            yield e
+
+    # --- kv ---------------------------------------------------------------
+    # keys/values ride hex-encoded TEXT so every dialect/transport treats
+    # them identically (no bytea/BLOB format negotiation)
+    def kv_put(self, key: bytes, value: bytes) -> None:
+        self.conn.execute(self.dialect.kv_upsert(),
+                          (key.hex(), value.hex()))
+
+    def kv_get(self, key: bytes) -> Optional[bytes]:
+        rows = self.conn.execute(self.dialect.kv_get(), (key.hex(),))
+        return bytes.fromhex(rows[0][0]) if rows else None
+
+    def kv_delete(self, key: bytes) -> None:
+        self.conn.execute(self.dialect.kv_delete(), (key.hex(),))
+
+    def kv_scan(self, prefix: bytes) -> Iterator[tuple[bytes, bytes]]:
+        lo = prefix.hex()
+        # keys are hex text ([0-9a-f]*): appending 'g' gives a bound
+        # strictly above EVERY extension of the prefix, with no
+        # byte-carry edge cases (0xff runs included)
+        hi = lo + "g"
+        for k, v in self.conn.execute(self.dialect.kv_scan(), (lo, hi)):
+            yield bytes.fromhex(k), bytes.fromhex(v)
+
+    def close(self) -> None:
+        close = getattr(self.conn, "close", None)
+        if close:
+            close()
+
+
+def sqlite_sql_store(path: str, bucket_tables: bool = False) -> AbstractSqlStore:
+    return AbstractSqlStore(SqliteConn(path), "sqlite",
+                            bucket_tables=bucket_tables)

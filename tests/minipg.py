@@ -1,0 +1,252 @@
+"""Minimal PostgreSQL v3 wire-protocol server for tests.
+
+Speaks enough of the frontend/backend protocol for `filer/pg_client.py`:
+startup (+SSLRequest refusal), trust / cleartext / md5 / SCRAM-SHA-256
+auth, the extended query protocol (Parse/Bind/Execute/Sync) and simple
+Query.  SQL executes against an in-memory sqlite database after
+translating $N placeholders to ? — the postgres dialect's query shapes
+(ON CONFLICT upsert, LIKE ESCAPE, LIMIT) are sqlite-compatible, so the
+double exercises the real wire path with real SQL semantics.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import os
+import re
+import socket
+import sqlite3
+import struct
+import threading
+
+_PH = re.compile(r"\$(\d+)")
+
+
+def _msg(tag: bytes, payload: bytes) -> bytes:
+    return tag + struct.pack(">I", len(payload) + 4) + payload
+
+
+def _cstr(s: str) -> bytes:
+    return s.encode() + b"\x00"
+
+
+def _sql_err(e: Exception) -> bytes:
+    """Map sqlite errors to postgres SQLSTATEs the client keys on."""
+    code = b"42P01" if "no such table" in str(e) else b"42601"
+    return (b"SERROR\x00C" + code + b"\x00M" + str(e).encode() +
+            b"\x00\x00")
+
+
+class MiniPg:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 password: str = "", auth: str = "trust"):
+        """auth: trust | cleartext | md5 | scram"""
+        self.password = password
+        self.auth = auth
+        self._db = sqlite3.connect(":memory:", check_same_thread=False)
+        self._db_lock = threading.Lock()
+        self._sock = socket.socket()
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(8)
+        self.port = self._sock.getsockname()[1]
+        self._stop = False
+        threading.Thread(target=self._accept, daemon=True,
+                         name="minipg").start()
+
+    def stop(self) -> None:
+        self._stop = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    # --- plumbing ---------------------------------------------------------
+    def _accept(self) -> None:
+        while not self._stop:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    @staticmethod
+    def _read_exact(conn, n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            chunk = conn.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError
+            buf += chunk
+        return buf
+
+    def _read_startup(self, conn) -> dict:
+        while True:
+            (ln,) = struct.unpack(">I", self._read_exact(conn, 4))
+            body = self._read_exact(conn, ln - 4)
+            (code,) = struct.unpack(">I", body[:4])
+            if code == 80877103:  # SSLRequest
+                conn.sendall(b"N")
+                continue
+            if code == 196608:
+                parts = body[4:].split(b"\x00")
+                kv = {}
+                for i in range(0, len(parts) - 1, 2):
+                    if parts[i]:
+                        kv[parts[i].decode()] = parts[i + 1].decode()
+                return kv
+            raise ConnectionError(f"unexpected startup code {code}")
+
+    def _read_msg(self, conn) -> tuple[bytes, bytes]:
+        tag = self._read_exact(conn, 1)
+        (ln,) = struct.unpack(">I", self._read_exact(conn, 4))
+        return tag, self._read_exact(conn, ln - 4)
+
+    # --- auth -------------------------------------------------------------
+    def _do_auth(self, conn, user: str) -> bool:
+        if self.auth == "trust":
+            conn.sendall(_msg(b"R", struct.pack(">I", 0)))
+            return True
+        if self.auth == "cleartext":
+            conn.sendall(_msg(b"R", struct.pack(">I", 3)))
+            tag, payload = self._read_msg(conn)
+            ok = (tag == b"p"
+                  and payload.rstrip(b"\x00").decode() == self.password)
+        elif self.auth == "md5":
+            salt = os.urandom(4)
+            conn.sendall(_msg(b"R", struct.pack(">I", 5) + salt))
+            tag, payload = self._read_msg(conn)
+            inner = hashlib.md5((self.password + user).encode()).hexdigest()
+            want = "md5" + hashlib.md5(inner.encode() + salt).hexdigest()
+            ok = tag == b"p" and payload.rstrip(b"\x00").decode() == want
+        else:  # scram
+            ok = self._do_scram(conn)
+        if ok:
+            conn.sendall(_msg(b"R", struct.pack(">I", 0)))
+            return True
+        conn.sendall(_msg(b"E", b"SFATAL\x00C28P01\x00"
+                          b"Mpassword authentication failed\x00\x00"))
+        return False
+
+    def _do_scram(self, conn) -> bool:
+        conn.sendall(_msg(b"R", struct.pack(">I", 10) +
+                          _cstr("SCRAM-SHA-256") + b"\x00"))
+        tag, payload = self._read_msg(conn)
+        if tag != b"p":
+            return False
+        # SASLInitialResponse: mechanism cstr + int32 len + body
+        mech_end = payload.index(b"\x00")
+        body = payload[mech_end + 5:].decode()
+        client_first_bare = body.split(",", 2)[2]
+        client_nonce = dict(p.split("=", 1)
+                            for p in client_first_bare.split(","))["r"]
+        salt, iters = os.urandom(16), 4096
+        server_nonce = client_nonce + base64.b64encode(os.urandom(9)).decode()
+        server_first = (f"r={server_nonce},"
+                        f"s={base64.b64encode(salt).decode()},i={iters}")
+        conn.sendall(_msg(b"R", struct.pack(">I", 11) + server_first.encode()))
+        tag, payload = self._read_msg(conn)
+        if tag != b"p":
+            return False
+        final = payload.decode()
+        fparts = dict(p.split("=", 1) for p in final.split(","))
+        salted = hashlib.pbkdf2_hmac("sha256", self.password.encode(),
+                                     salt, iters)
+        client_key = hmac.new(salted, b"Client Key", hashlib.sha256).digest()
+        stored = hashlib.sha256(client_key).digest()
+        without_proof = final[:final.rindex(",p=")]
+        auth_msg = f"{client_first_bare},{server_first},{without_proof}"
+        sig = hmac.new(stored, auth_msg.encode(), hashlib.sha256).digest()
+        want = bytes(a ^ b for a, b in zip(client_key, sig))
+        if base64.b64decode(fparts["p"]) != want:
+            return False
+        skey = hmac.new(salted, b"Server Key", hashlib.sha256).digest()
+        v = hmac.new(skey, auth_msg.encode(), hashlib.sha256).digest()
+        conn.sendall(_msg(b"R", struct.pack(">I", 12) +
+                          b"v=" + base64.b64encode(v)))
+        return True
+
+    # --- SQL --------------------------------------------------------------
+    def _run(self, sql: str, params: list) -> tuple[list[tuple], int]:
+        q = _PH.sub("?", sql)
+        with self._db_lock:
+            cur = self._db.execute(q, params)
+            rows = cur.fetchall() if cur.description else []
+            self._db.commit()
+            return rows, cur.rowcount
+
+    @staticmethod
+    def _send_rows(conn, rows) -> None:
+        for row in rows:
+            out = struct.pack(">H", len(row))
+            for v in row:
+                if v is None:
+                    out += struct.pack(">i", -1)
+                else:
+                    b = str(v).encode()
+                    out += struct.pack(">I", len(b)) + b
+            conn.sendall(_msg(b"D", out))
+
+    def _serve(self, conn) -> None:
+        try:
+            kv = self._read_startup(conn)
+            if not self._do_auth(conn, kv.get("user", "")):
+                conn.close()
+                return
+            conn.sendall(_msg(b"S", _cstr("server_version") + _cstr("14.0")))
+            conn.sendall(_msg(b"Z", b"I"))
+            sql, params = "", []
+            while True:
+                tag, payload = self._read_msg(conn)
+                if tag == b"X":
+                    break
+                if tag == b"P":  # Parse: "" + sql + n_types
+                    end = payload.index(b"\x00")
+                    sql_end = payload.index(b"\x00", end + 1)
+                    sql = payload[end + 1:sql_end].decode()
+                    conn.sendall(_msg(b"1", b""))
+                elif tag == b"B":  # Bind
+                    off = payload.index(b"\x00") + 1
+                    off = payload.index(b"\x00", off) + 1
+                    (nfmt,) = struct.unpack(">H", payload[off:off + 2])
+                    off += 2 + 2 * nfmt
+                    (nparams,) = struct.unpack(">H", payload[off:off + 2])
+                    off += 2
+                    params = []
+                    for _ in range(nparams):
+                        (ln,) = struct.unpack(">i", payload[off:off + 4])
+                        off += 4
+                        if ln < 0:
+                            params.append(None)
+                        else:
+                            params.append(payload[off:off + ln].decode())
+                            off += ln
+                    conn.sendall(_msg(b"2", b""))
+                elif tag == b"E":  # Execute
+                    try:
+                        rows, count = self._run(sql, params)
+                        self._send_rows(conn, rows)
+                        conn.sendall(_msg(b"C", _cstr(f"SELECT {count}")))
+                    except sqlite3.Error as e:
+                        conn.sendall(_msg(b"E", _sql_err(e)))
+                elif tag == b"S":  # Sync
+                    conn.sendall(_msg(b"Z", b"I"))
+                elif tag == b"Q":  # simple query (DDL)
+                    try:
+                        rows, count = self._run(
+                            payload.rstrip(b"\x00").decode(), [])
+                        self._send_rows(conn, rows)
+                        conn.sendall(_msg(b"C", _cstr(f"OK {count}")))
+                    except sqlite3.Error as e:
+                        conn.sendall(_msg(b"E", _sql_err(e)))
+                    conn.sendall(_msg(b"Z", b"I"))
+        except (ConnectionError, OSError, struct.error, ValueError):
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
