@@ -1,15 +1,26 @@
-"""Write-back page cache for one open file.
+"""Write-back page cache + bounded async upload pipeline for one file.
 
 Equivalent of weed/mount/page_writer/ (upload_pipeline.go,
 page_chunk_mem.go, dirty_pages_chunked.go): writes land in fixed-size
-in-memory chunk buffers aligned to the filer chunk size; a chunk seals
-when fully written past or on flush, and sealed chunks upload through
-the supplied uploader.  Reads at unflushed offsets are served from the
-dirty pages so read-your-writes holds before flush.
+in-memory chunk buffers aligned to the filer chunk size, tracked as
+merged dirty intervals so random writes upload only what was dirtied.
+A chunk SEALS when fully written, when memory pressure evicts the
+oldest dirty chunk, or on flush; sealed chunks upload concurrently on a
+small worker pool (ref upload_pipeline.go's bounded uploaders) while
+later writes keep landing.  Reads at unflushed offsets are served from
+dirty AND sealed-uploading buffers, so read-your-writes holds before
+flush; once a sealed buffer's upload completes it is freed (the chunk
+dict is collected by flush()).
+
+Back-pressure: writes block when too many sealed uploads are in flight
+(oldest-future wait), and the oldest dirty chunk is force-sealed when
+the dirty set outgrows its budget — a random writer to a huge file
+holds O(budget) memory, not O(file).
 """
 
 from __future__ import annotations
 
+import concurrent.futures
 import threading
 from typing import Callable, Optional
 
@@ -57,30 +68,72 @@ def _merge(ivs: list[tuple[int, int]],
     return out
 
 
+class _SealedChunk:
+    """A chunk handed to the upload pool: its buffer stays readable
+    (read-your-writes during the upload) until the worker finishes."""
+
+    __slots__ = ("index", "buf", "intervals", "future", "seq")
+
+    def __init__(self, chunk: _DirtyChunk, seq: int):
+        self.index = chunk.index
+        self.buf = chunk.buf
+        self.intervals = chunk.intervals
+        self.future: Optional[concurrent.futures.Future] = None
+        self.seq = seq  # seal-time ns: write order survives out-of-order
+        #                 upload completion (overlap shadowing)
+
+    def read(self, off: int, size: int) -> Optional[bytes]:
+        if self.buf is None:  # upload done, buffer released
+            return None
+        stop = off + size
+        for a, b in self.intervals:
+            if a <= off and stop <= b:
+                return bytes(self.buf[off:stop])
+        return None
+
+
 class PageWriter:
-    """Dirty pages for one file handle.
+    """Dirty pages + upload pipeline for one file handle.
 
     uploader(chunk_logical_offset, data) -> chunk dict (FileChunk.to_dict
     shape); flush() returns every uploaded chunk in offset order.
     """
 
     def __init__(self, uploader: Callable[[int, bytes], dict],
-                 chunk_size: int = 8 * 1024 * 1024):
+                 chunk_size: int = 8 * 1024 * 1024,
+                 concurrency: int = 4, max_dirty_chunks: int = 8):
         self.chunk_size = chunk_size
         self.uploader = uploader
+        self.concurrency = concurrency
+        self.max_dirty_chunks = max_dirty_chunks
         self._lock = threading.Lock()
         self._chunks: dict[int, _DirtyChunk] = {}
-        self._uploaded: list[dict] = []
+        self._order: list[int] = []  # dirty chunk LRU (insertion order)
+        self._sealed: list[_SealedChunk] = []
+        self._uploaded: list[tuple[int, dict]] = []  # (seal seq, chunk)
+        self._errors: list[Exception] = []  # failed uploads, raised at flush
+        self._last_seal_ns = 0
+        self._pool: Optional[concurrent.futures.ThreadPoolExecutor] = None
         self.file_size_hint = 0
 
+    def _ensure_pool(self) -> concurrent.futures.ThreadPoolExecutor:
+        if self._pool is None:
+            self._pool = concurrent.futures.ThreadPoolExecutor(
+                max_workers=self.concurrency,
+                thread_name_prefix="page-upload")
+        return self._pool
+
+    # --- write path -------------------------------------------------------
     def write(self, offset: int, data: bytes) -> int:
-        """Buffer a write; seals+uploads any chunk that becomes full."""
+        """Buffer a write; seals any chunk that becomes full and hands it
+        to the upload pool.  Blocks (back-pressure) when too many uploads
+        are already in flight."""
         written = len(data)
+        wait_on: list[concurrent.futures.Future] = []
         with self._lock:
             self.file_size_hint = max(self.file_size_hint,
                                       offset + written)
             pos = 0
-            sealed: list[_DirtyChunk] = []
             while pos < len(data):
                 idx = (offset + pos) // self.chunk_size
                 in_off = (offset + pos) % self.chunk_size
@@ -89,54 +142,107 @@ class PageWriter:
                 if chunk is None:
                     chunk = self._chunks[idx] = _DirtyChunk(
                         idx, self.chunk_size)
+                    self._order.append(idx)
                 chunk.write(in_off, data[pos:pos + can])
                 if chunk.is_complete(self.chunk_size):
-                    sealed.append(self._chunks.pop(idx))
+                    self._seal_locked(idx)
                 pos += can
-            for chunk in sealed:
-                self._upload_locked(chunk)
+            # memory budget: a random writer dirties many chunks that
+            # never complete — seal the OLDEST so memory stays O(budget)
+            while len(self._chunks) > self.max_dirty_chunks:
+                self._seal_locked(self._order[0])
+            self._reap_locked()
+            # back-pressure: bound in-flight uploads
+            inflight = [s.future for s in self._sealed
+                        if not s.future.done()]
+            if len(inflight) > 2 * self.concurrency:
+                wait_on = inflight[:len(inflight) - 2 * self.concurrency]
+        for f in wait_on:  # outside the lock: readers stay unblocked
+            f.exception()  # stashed by _reap; raised at flush, not here
         return written
 
-    def _upload_locked(self, chunk: _DirtyChunk) -> None:
-        start, stop = chunk.written_span
-        base = chunk.index * self.chunk_size
-        uploaded = self.uploader(base + start, bytes(chunk.buf[start:stop]))
-        self._uploaded.append(uploaded)
+    def _reap_locked(self) -> None:
+        """Drop sealed chunks whose upload finished (their buffer is
+        already freed), stashing any upload exception for flush()."""
+        keep = []
+        for s in self._sealed:
+            if s.future.done():
+                exc = s.future.exception()
+                if exc is not None:
+                    self._errors.append(exc)
+            else:
+                keep.append(s)
+        self._sealed = keep
 
-    def read_dirty(self, offset: int, size: int) -> Optional[bytes]:
-        """Serve a read from unflushed pages when fully covered."""
+    def _seal_locked(self, idx: int) -> None:
+        import time as _time
+
+        chunk = self._chunks.pop(idx)
+        self._order.remove(idx)
+        if not chunk.intervals:
+            return
+        self._last_seal_ns = seq = max(_time.time_ns(),
+                                       self._last_seal_ns + 1)
+        sealed = _SealedChunk(chunk, seq)
+        self._sealed.append(sealed)
+        sealed.future = self._ensure_pool().submit(self._do_upload, sealed)
+
+    def _do_upload(self, sealed: _SealedChunk) -> None:
+        start, stop = sealed.intervals[0][0], sealed.intervals[-1][1]
+        base = sealed.index * self.chunk_size
+        uploaded = self.uploader(base + start,
+                                 bytes(sealed.buf[start:stop]))
+        if "modified_ts_ns" in uploaded:
+            # overlap resolution keys on mtime: write (seal) order must
+            # win, not upload COMPLETION order across pool workers
+            uploaded["modified_ts_ns"] = sealed.seq
         with self._lock:
-            idx = offset // self.chunk_size
-            in_off = offset % self.chunk_size
-            if in_off + size <= self.chunk_size:
-                chunk = self._chunks.get(idx)
-                return chunk.read(in_off, size) if chunk else None
-            # spans chunks: assemble or give up
+            self._uploaded.append((sealed.seq, uploaded))
+            sealed.buf = None  # readable window ends; memory released
+
+    # --- read path --------------------------------------------------------
+    def read_dirty(self, offset: int, size: int) -> Optional[bytes]:
+        """Serve a read from unflushed pages (dirty or sealed-uploading)
+        when fully covered."""
+        with self._lock:
             parts: list[bytes] = []
             pos = 0
             while pos < size:
                 idx = (offset + pos) // self.chunk_size
                 in_off = (offset + pos) % self.chunk_size
                 can = min(size - pos, self.chunk_size - in_off)
+                piece = None
                 chunk = self._chunks.get(idx)
-                piece = chunk.read(in_off, can) if chunk else None
+                if chunk is not None:
+                    piece = chunk.read(in_off, can)
+                if piece is None:
+                    for s in reversed(self._sealed):  # newest seal wins
+                        if s.index == idx:
+                            piece = s.read(in_off, can)
+                            if piece is not None:
+                                break
                 if piece is None:
                     return None
                 parts.append(piece)
                 pos += can
             return b"".join(parts)
 
+    # --- truncate ---------------------------------------------------------
     def truncate(self, size: int) -> None:
         """Drop dirty state at/past the new size — data buffered beyond a
         truncate point must never resurface when the handle flushes
-        (POSIX write-then-ftruncate).  Already-uploaded chunk dicts are
-        trimmed the same way; partially-covered dirty chunks are trimmed
-        by shrinking their written span."""
+        (POSIX write-then-ftruncate).  In-flight uploads drain first so
+        their chunk dicts can be trimmed synchronously too."""
+        errors = self._drain()
         with self._lock:
+            # upload failures must still surface at the next flush —
+            # truncation doesn't absolve lost chunks below the cut
+            self._errors.extend(errors)
             self.file_size_hint = min(self.file_size_hint, size)
             for idx in [i for i in self._chunks
                         if i * self.chunk_size >= size]:
                 del self._chunks[idx]
+                self._order.remove(idx)
             cut = size % self.chunk_size
             boundary_idx = size // self.chunk_size
             chunk = self._chunks.get(boundary_idx)
@@ -145,26 +251,51 @@ class PageWriter:
                     (a, min(b, cut)) for a, b in chunk.intervals if a < cut]
                 if not chunk.intervals:
                     del self._chunks[boundary_idx]
+                    self._order.remove(boundary_idx)
             kept = []
-            for c in self._uploaded:
+            for seq, c in self._uploaded:
                 if c["offset"] >= size:
                     continue
                 if c["offset"] + c["size"] > size:
                     c = dict(c, size=size - c["offset"])
-                kept.append(c)
+                kept.append((seq, c))
             self._uploaded = kept
 
+    # --- flush ------------------------------------------------------------
+    def _drain(self) -> list[Exception]:
+        """Wait for every in-flight upload; sealed chunks stay readable
+        (and listed) until their future completes.  Returns accumulated
+        upload errors."""
+        while True:
+            with self._lock:
+                pending = list(self._sealed)
+                if not pending:
+                    errors, self._errors = self._errors, []
+                    return errors
+            for s in pending:
+                s.future.exception()  # wait; error stashed by reap below
+            with self._lock:
+                self._reap_locked()
+
     def flush(self) -> list[dict]:
-        """Seal + upload every dirty chunk; returns all uploaded chunk
-        dicts (offset order) and resets the uploaded list."""
+        """Seal + upload every dirty chunk, wait for the pipeline, and
+        return all uploaded chunk dicts (offset order).  Upload failures
+        surface here (the kernel's flush/fsync gets the EIO)."""
         with self._lock:
             for idx in sorted(self._chunks):
-                self._upload_locked(self._chunks.pop(idx))
-            out, self._uploaded = self._uploaded, []
-            out.sort(key=lambda c: c["offset"])
-            return out
+                self._seal_locked(idx)
+        errors = self._drain()
+        if errors:
+            raise errors[0]
+        with self._lock:
+            pairs, self._uploaded = self._uploaded, []
+            # entry chunk-list order carries overlap shadowing: same
+            # range rewritten later must append later
+            pairs.sort(key=lambda p: (p[1]["offset"], p[0]))
+            return [c for _, c in pairs]
 
     @property
     def has_dirty(self) -> bool:
         with self._lock:
-            return bool(self._chunks) or bool(self._uploaded)
+            return bool(self._chunks) or bool(self._sealed) \
+                or bool(self._uploaded)

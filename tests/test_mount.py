@@ -8,8 +8,10 @@ kernel boundary is exercised separately (gated on /dev/fuse).
 from __future__ import annotations
 
 import errno
+import threading
 import time
 
+import numpy as np
 import pytest
 
 from seaweedfs_tpu.filer.server import FilerServer
@@ -89,10 +91,13 @@ def test_page_writer_cross_chunk_write_seals_middles():
     payload = bytes(i % 256 for i in range(256))
     w.write(10, payload)  # spans chunks 0..4; middles 1,2,3 seal+upload
     assert [off for off, _ in uploads] == [64, 128, 192]
-    # sealed chunks are no longer dirty-readable; the edges still are
+    # the edges are dirty-readable; the sealed middles stay readable
+    # only until their async upload completes, so a full-span read is
+    # either correct or a miss (never stale)
     assert w.read_dirty(10, 54) == payload[:54]
     assert w.read_dirty(256, 10) == payload[246:]
-    assert w.read_dirty(10, len(payload)) is None
+    full = w.read_dirty(10, len(payload))
+    assert full is None or full == payload
     assert w.file_size_hint == 10 + len(payload)
     chunks = w.flush()
     # edges flush too: full coverage of the written span
@@ -299,3 +304,137 @@ def test_release_drops_handle_even_when_flush_fails(wfs):
     finally:
         fs.filer_url = real
     assert h.fh not in fs._handles  # no leak
+
+
+def _mk_uploader(uploads, delay_fn=None):
+    import threading as _t
+
+    lock = _t.Lock()
+
+    def uploader(off: int, data: bytes) -> dict:
+        if delay_fn is not None:
+            delay_fn(off)
+        with lock:
+            uploads.append((off, bytes(data)))
+            n = len(uploads)
+        return {"file_id": f"f{n}", "offset": off, "size": len(data),
+                "modified_ts_ns": time.time_ns(), "etag": "",
+                "is_chunk_manifest": False}
+
+    return uploader
+
+
+def test_page_writer_memory_budget_seals_oldest():
+    """A random writer dirtying many chunks holds O(budget) memory: the
+    oldest dirty chunk force-seals and uploads before any flush."""
+    uploads = []
+    w = PageWriter(_mk_uploader(uploads), chunk_size=100,
+                   max_dirty_chunks=4)
+    for i in range(10):  # 10 distinct partially-written chunks
+        w.write(i * 100 + 7, b"x" * 10)
+    w._drain()
+    assert len(uploads) >= 6  # 10 dirtied - 4 budget
+    chunks = w.flush()
+    assert len(chunks) == 10
+    # only the dirtied spans uploaded: 10 bytes each, never whole chunks
+    assert all(len(d) == 10 for _, d in uploads)
+
+
+def test_page_writer_rewrite_order_survives_slow_uploads():
+    """Rewriting the same range must win even when the FIRST upload
+    finishes LAST (out-of-order pool completion): seal order rides
+    modified_ts_ns and the flush list order."""
+    uploads = []
+    first_done = threading.Event()
+
+    def delay(off):
+        if not uploads:  # first upload stalls until the second lands
+            first_done.wait(timeout=5)
+
+    w = PageWriter(_mk_uploader(uploads, delay), chunk_size=100)
+    w.write(0, (b"old" * 34)[:100])
+    w.write(0, (b"NEW" * 34)[:100])
+    first_done.set()
+    chunks = w.flush()
+    offsets = [(c["offset"], c["modified_ts_ns"]) for c in chunks]
+    assert len(chunks) == 2
+    # same offset: the later seal sorts later and carries the larger ts
+    assert offsets[0][0] == offsets[1][0] == 0
+    assert offsets[0][1] < offsets[1][1]
+
+
+def test_page_writer_sealed_chunk_readable_during_upload():
+    uploads = []
+    gate = threading.Event()
+
+    def delay(off):
+        gate.wait(timeout=5)
+
+    w = PageWriter(_mk_uploader(uploads, delay), chunk_size=100)
+    w.write(0, b"z" * 100)  # seals; upload blocked on the gate
+    assert w.read_dirty(20, 30) == b"z" * 30  # served from sealed buffer
+    gate.set()
+    assert [c["offset"] for c in w.flush()] == [0]
+
+
+def test_page_writer_upload_error_surfaces_at_flush():
+    def uploader(off, data):
+        raise OSError("volume down")
+
+    w = PageWriter(uploader, chunk_size=100)
+    w.write(0, b"a" * 100)  # seal + async upload fails
+    w.write(300, b"b")
+    with pytest.raises(OSError, match="volume down"):
+        w.flush()
+
+
+def test_wfs_random_access_writes_upload_only_dirtied_chunks(wfs):
+    """VERDICT r2 #6: random writes into a large (64MB) mounted file
+    must upload only the dirtied chunks, byte-verified."""
+    fs, filer = wfs
+    rng = np.random.default_rng(0xF5)
+    size = 64 << 20
+    fh = fs.create("/big.bin", 0o644).fh
+
+    # count uploads at the wire: every chunk upload goes through the
+    # weed client exactly once
+    calls = []
+    orig = fs.client.upload
+
+    def counting_upload(data, **kw):
+        calls.append(len(data))
+        return orig(data, **kw)
+
+    fs.client.upload = counting_upload
+    # establish the file size with one byte at the end, then dirty 12
+    # random 100KB regions
+    fs.write(fh, size - 1, b"\x00")
+    regions = []
+    for _ in range(12):
+        off = int(rng.integers(0, size - (100 << 10)))
+        data = rng.integers(0, 256, 100 << 10, dtype=np.uint8).tobytes()
+        fs.write(fh, off, data)
+        regions.append((off, data))
+    fs.flush(fh)
+    uploaded_mb = sum(calls) / (1 << 20)
+    assert uploaded_mb < 16, f"uploaded {uploaded_mb:.0f}MB for ~1.2MB dirty"
+    # byte-verify every region through the read path (later writes win
+    # on overlap)
+    merged = {}
+    for off, data in regions:
+        merged[off] = data
+    for off, data in merged.items():
+        got = fs.read(fh, off, len(data))
+        want = bytearray(data)
+        # apply any LATER region overlapping this one
+        seen = False
+        for o2, d2 in regions:
+            if (o2, d2[:1]) == (off, data[:1]) and not seen:
+                seen = True
+                continue
+            if seen and o2 < off + len(data) and o2 + len(d2) > off:
+                lo = max(off, o2)
+                hi = min(off + len(data), o2 + len(d2))
+                want[lo - off:hi - off] = d2[lo - o2:hi - o2]
+        assert got == bytes(want), f"mismatch at {off}"
+    fs.release(fh)
