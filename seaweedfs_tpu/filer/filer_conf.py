@@ -66,6 +66,13 @@ class FilerConf:
     def delete_rule(self, location_prefix: str) -> bool:
         return self.rules.pop(location_prefix, None) is not None
 
+    def get_collection_ttls(self, collection: str) -> dict[str, str]:
+        """{location_prefix: ttl} for every rule targeting `collection`
+        (filer_conf.go GetCollectionTtls — feeds the S3 lifecycle GET:
+        ref weed/s3api/s3api_bucket_handlers.go:260)."""
+        return {p: r.ttl for p, r in self.rules.items()
+                if r.collection == collection and r.ttl}
+
     def match_storage_rule(self, path: str) -> PathConf:
         """Fold every matching prefix shortest→longest so longer prefixes
         override (filer_conf.go MatchStorageRule)."""

@@ -257,6 +257,12 @@ class S3ApiServer:
         @r.route("PUT", "/([a-z0-9][a-z0-9.-]+)")
         def put_bucket(req: Request) -> Response:
             self._auth(req, ACTION_ADMIN, req.match.group(1))
+            for sub in ("lifecycle", "cors", "policy"):
+                if sub in req.query:
+                    # reference parity: write sides are NotImplemented
+                    # (s3api_bucket_handlers.go:301, skip_handlers)
+                    return _err(501, "NotImplemented",
+                                f"Put bucket {sub} is not implemented")
             self.fs.filer._ensure_parents(self._bucket_path(req.match.group(1)))
             return Response(raw=b"", headers={"Location": "/" + req.match.group(1)})
 
@@ -270,6 +276,14 @@ class S3ApiServer:
         def delete_bucket(req: Request) -> Response:
             bucket = req.match.group(1)
             self._auth(req, ACTION_ADMIN, bucket)
+            if "lifecycle" in req.query:
+                self._require_bucket(bucket)
+                return self._delete_lifecycle(bucket)
+            if "cors" in req.query or "policy" in req.query:
+                # nothing stored to delete: succeeds quietly (ref skip
+                # handlers answer 204 the same way)
+                self._require_bucket(bucket)
+                return Response(raw=b"", status=204)
             self._require_bucket(bucket)
             try:
                 self.fs.filer.delete_entry(self._bucket_path(bucket),
@@ -287,6 +301,21 @@ class S3ApiServer:
             if "location" in req.query:
                 # GetBucketLocation: SDKs call this before anything else
                 root = ET.Element("LocationConstraint", xmlns=S3_NS)
+                return _xml(root)
+            if "lifecycle" in req.query:
+                return self._get_lifecycle(bucket)
+            if "cors" in req.query:
+                # parity with the reference's unimplemented CORS store
+                # (s3api_bucket_skip_handlers.go:11)
+                return _err(404, "NoSuchCORSConfiguration",
+                            "The CORS configuration does not exist")
+            if "policy" in req.query:
+                return _err(404, "NoSuchBucketPolicy",
+                            "The bucket policy does not exist")
+            if "requestPayment" in req.query:
+                root = ET.Element("RequestPaymentConfiguration",
+                                  xmlns=S3_NS)
+                ET.SubElement(root, "Payer").text = "BucketOwner"
                 return _xml(root)
             if "uploads" in req.query:
                 return self._list_multipart_uploads(bucket)
@@ -733,6 +762,57 @@ class S3ApiServer:
                          if not k.startswith(self.TAG_PREFIX)}
         self.fs.filer.update_entry(entry)
         return Response(raw=b"", status=204)
+
+    def _delete_lifecycle(self, bucket: str) -> Response:
+        """DeleteBucketLifecycle: since the GET side derives rules from
+        filer.conf TTLs, deletion clears the TTL on every rule targeting
+        the bucket's collection (the ref answers a bare 204 without
+        deleting — with a real GET, a no-op 204 would lie)."""
+        from ..filer.filer_conf import FILER_CONF_PATH
+
+        fc = self.fs.filer_conf()
+        changed = False
+        for prefix, rule in list(fc.rules.items()):
+            if rule.collection == bucket and rule.ttl:
+                rule.ttl = ""
+                changed = True
+        if changed:
+            self.fs.put_file(FILER_CONF_PATH, fc.to_bytes())
+        return Response(raw=b"", status=204)
+
+    def _get_lifecycle(self, bucket: str) -> Response:
+        """GetBucketLifecycleConfiguration derived from filer.conf TTL
+        rules targeting the bucket's collection — the reference's only
+        REAL lifecycle surface (s3api_bucket_handlers.go:260: expiry
+        comes from TTLs, not stored lifecycle documents)."""
+        from ..storage.ttl import TTL
+
+        self._require_bucket(bucket)
+        ttls = self.fs.filer_conf().get_collection_ttls(bucket)
+        if not ttls:
+            return _err(404, "NoSuchLifecycleConfiguration",
+                        "The lifecycle configuration does not exist")
+        rules = []
+        for prefix, ttl_s in sorted(ttls.items()):
+            days = TTL.parse(ttl_s).minutes // (60 * 24)
+            if days == 0:
+                # sub-day TTLs have no lifecycle-Days representation;
+                # the ref skips them the same way but still answers 200
+                # (s3api_bucket_handlers.go:288)
+                continue
+            rules.append((prefix, days))
+        root = ET.Element("LifecycleConfiguration", xmlns=S3_NS)
+        bucket_prefix = f"{BUCKETS_PATH}/{bucket}/"
+        for prefix, days in rules:
+            rule = ET.SubElement(root, "Rule")
+            ET.SubElement(rule, "Status").text = "Enabled"
+            filt = ET.SubElement(rule, "Filter")
+            p = prefix[len(bucket_prefix):] if prefix.startswith(
+                bucket_prefix) else prefix
+            ET.SubElement(filt, "Prefix").text = p
+            exp = ET.SubElement(rule, "Expiration")
+            ET.SubElement(exp, "Days").text = str(days)
+        return _xml(root)
 
     def _list_parts(self, req: Request, bucket: str, key: str) -> Response:
         """ListParts (s3api_object_multipart_handlers.go): uploaded parts

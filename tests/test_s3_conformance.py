@@ -621,3 +621,89 @@ def test_post_policy_rejects_uncovered_meta_field(s3):
               **_signed_policy_fields("postbkt", "uploads/")}
     status, body, _ = _post_form(s3, "postbkt", fields, b"x")
     assert status == 403 and b"extra input field" in body
+
+
+# --- bucket subresources: lifecycle / cors / policy -------------------------
+
+def test_get_lifecycle_from_filer_conf_ttl(s3):
+    """GetBucketLifecycleConfiguration derives rules from filer.conf
+    TTLs for the bucket collection (ref s3api_bucket_handlers.go:260);
+    no TTL rule -> NoSuchLifecycleConfiguration."""
+    _req(s3, "PUT", "/lcbkt")
+    status, body, _ = _req(s3, "GET", "/lcbkt?lifecycle")
+    assert status == 404 and b"NoSuchLifecycleConfiguration" in body
+
+    from seaweedfs_tpu.filer.filer_conf import FILER_CONF_PATH, FilerConf, PathConf
+
+    fc = FilerConf()
+    fc.set_rule(PathConf(location_prefix="/buckets/lcbkt/logs/",
+                         collection="lcbkt", ttl="7d"))
+    fc.set_rule(PathConf(location_prefix="/buckets/lcbkt/",
+                         collection="lcbkt", ttl="48h"))
+    fc.set_rule(PathConf(location_prefix="/buckets/other/",
+                         collection="other", ttl="1d"))
+    s3.fs.put_file(FILER_CONF_PATH, fc.to_bytes())
+    status, body, _ = _req(s3, "GET", "/lcbkt?lifecycle")
+    assert status == 200, body
+    doc = ET.fromstring(body)
+    rules = doc.findall(f"{NS}Rule")
+    got = {r.findtext(f"{NS}Filter/{NS}Prefix"):
+           r.findtext(f"{NS}Expiration/{NS}Days") for r in rules}
+    assert got == {"": "2", "logs/": "7"}
+    assert all(r.findtext(f"{NS}Status") == "Enabled" for r in rules)
+    # cleanup: later tests must not inherit the TTL rules
+    s3.fs.put_file(FILER_CONF_PATH, FilerConf().to_bytes())
+
+
+def test_bucket_cors_and_policy_parity(s3):
+    """Reference parity (s3api_bucket_skip_handlers.go:11-41): GETs are
+    NoSuch* 404s, PUTs are NotImplemented, DELETEs succeed quietly."""
+    _req(s3, "PUT", "/skipbkt")
+    for sub, code in (("cors", b"NoSuchCORSConfiguration"),
+                      ("policy", b"NoSuchBucketPolicy")):
+        status, body, _ = _req(s3, "GET", f"/skipbkt?{sub}")
+        assert status == 404 and code in body, (sub, body)
+    for sub in ("lifecycle", "cors", "policy"):
+        status, body, _ = _req(s3, "PUT", f"/skipbkt?{sub}",
+                               body=b"<Configuration/>")
+        assert status == 501 and b"NotImplemented" in body, (sub, body)
+        status, _, _ = _req(s3, "DELETE", f"/skipbkt?{sub}")
+        assert status == 204, sub
+
+
+def test_request_payment_configuration(s3):
+    status, body, _ = _req(s3, "GET", "/skipbkt?requestPayment")
+    assert status == 200
+    assert ET.fromstring(body).findtext(f"{NS}Payer") == "BucketOwner"
+
+
+def test_lifecycle_delete_clears_ttl_rules(s3):
+    """DeleteBucketLifecycle clears the bucket collection's TTLs, and a
+    bucket whose only TTLs are sub-day still answers 200 (ref returns an
+    empty rule list, not NoSuchLifecycleConfiguration)."""
+    from seaweedfs_tpu.filer.filer_conf import FILER_CONF_PATH, FilerConf, PathConf
+
+    _req(s3, "PUT", "/lcdel")
+    fc = FilerConf()
+    fc.set_rule(PathConf(location_prefix="/buckets/lcdel/",
+                         collection="lcdel", ttl="3d"))
+    fc.set_rule(PathConf(location_prefix="/buckets/lcdel/tmp/",
+                         collection="lcdel", ttl="12h"))
+    s3.fs.put_file(FILER_CONF_PATH, fc.to_bytes())
+    status, body, _ = _req(s3, "GET", "/lcdel?lifecycle")
+    assert status == 200 and b"<Days>3</Days>" in body
+    status, _, _ = _req(s3, "DELETE", "/lcdel?lifecycle")
+    assert status == 204
+    status, body, _ = _req(s3, "GET", "/lcdel?lifecycle")
+    assert status == 404 and b"NoSuchLifecycleConfiguration" in body
+    # sub-day-only TTLs: 200 with zero rules (never 404)
+    fc2 = FilerConf()
+    fc2.set_rule(PathConf(location_prefix="/buckets/lcdel/",
+                          collection="lcdel", ttl="12h"))
+    s3.fs.put_file(FILER_CONF_PATH, fc2.to_bytes())
+    status, body, _ = _req(s3, "GET", "/lcdel?lifecycle")
+    assert status == 200 and b"<Rule>" not in body
+    s3.fs.put_file(FILER_CONF_PATH, FilerConf().to_bytes())
+    # absent bucket: subresource deletes are 404, not a quiet 204
+    status, body, _ = _req(s3, "DELETE", "/nosuchbkt?lifecycle")
+    assert status == 404
