@@ -68,9 +68,12 @@ def _replay_arrays(entries: np.ndarray) -> tuple[dict, np.ndarray, np.ndarray,
     if n == 0:
         empty_k = np.empty(0, dtype=np.uint64)
         return (counters, empty_k, np.empty(0, dtype=np.uint32),
-                np.empty(0, dtype=np.int32))
+                np.empty(0, dtype=np.int32))  # empty: width irrelevant
     keys = entries["key"].astype(np.uint64)
-    offs = entries["offset"].astype(np.uint32)   # padding units
+    # padding units; 5-byte volumes parse to u64 offsets
+    units_dtype = (np.uint64 if entries["offset"].dtype.itemsize == 8
+                   else np.uint32)
+    offs = entries["offset"].astype(units_dtype)
     sizes = entries["size"].astype(np.int32)
     is_put = (offs != 0) & (sizes > 0)  # vector form of size_is_valid
     counters["max_file_key"] = int(keys.max())
@@ -118,8 +121,12 @@ class _Section:
 class CompactNeedleMap:
     """Numpy-sectioned needle map; see module docstring."""
 
-    def __init__(self, index_path: Optional[str] = None, replay: bool = False):
+    def __init__(self, index_path: Optional[str] = None, replay: bool = False,
+                 offset_size: int = 4):
         import threading
+
+        self.offset_size = offset_size
+        self._units_dtype = np.uint64 if offset_size == 5 else np.uint32
 
         # readers (volume read path) are lock-free w.r.t. the volume's
         # write_lock, so structural mutations here need their own mutex —
@@ -145,11 +152,12 @@ class CompactNeedleMap:
             self._index_file = open(index_path, "ab")
 
     @classmethod
-    def load(cls, index_path: str) -> "CompactNeedleMap":
-        return cls(index_path, replay=True)
+    def load(cls, index_path: str, offset_size: int = 4) -> "CompactNeedleMap":
+        return cls(index_path, replay=True, offset_size=offset_size)
 
     def _ingest_replay(self, blob: bytes) -> None:
-        counters, k, o, s = _replay_arrays(idx_mod.parse_entries(blob))
+        counters, k, o, s = _replay_arrays(
+            idx_mod.parse_entries(blob, self.offset_size))
         for name, v in counters.items():
             setattr(self, name, getattr(self, name) + v
                     if name != "max_file_key" else max(self.max_file_key, v))
@@ -230,7 +238,7 @@ class CompactNeedleMap:
         if not self._tail_k:
             return
         self._install_arrays(np.array(self._tail_k, dtype=np.uint64),
-                             np.array(self._tail_o, dtype=np.uint32),
+                             np.array(self._tail_o, dtype=self._units_dtype),
                              np.array(self._tail_s, dtype=np.int32))
         self._tail_k, self._tail_o, self._tail_s = [], [], []
 
@@ -248,13 +256,14 @@ class CompactNeedleMap:
             ok = np.fromiter(self._over.keys(), dtype=np.uint64,
                              count=len(self._over))
             vals = list(self._over.values())
-            oo = np.array([v[0] for v in vals], dtype=np.uint32)
+            oo = np.array([v[0] for v in vals], dtype=self._units_dtype)
             os_ = np.array([v[1] for v in vals], dtype=np.int32)
             parts_k.append(ok)
             parts_o.append(oo)
             parts_s.append(os_)
         k = np.concatenate(parts_k) if parts_k else np.empty(0, np.uint64)
-        o = np.concatenate(parts_o) if parts_o else np.empty(0, np.uint32)
+        o = (np.concatenate(parts_o) if parts_o
+             else np.empty(0, self._units_dtype))
         s = np.concatenate(parts_s) if parts_s else np.empty(0, np.int32)
         order = np.argsort(k, kind="stable")
         # overflow entries were appended last, so stable-sort + keep-last
@@ -291,7 +300,8 @@ class CompactNeedleMap:
 
     def _append_index(self, key: int, offset: int, size: int) -> None:
         if self._index_file is not None:
-            self._index_file.write(idx_mod.pack_entry(key, offset, size))
+            self._index_file.write(
+                idx_mod.pack_entry(key, offset, size, self.offset_size))
             self._index_file.flush()
 
     # --- iteration ---------------------------------------------------------
@@ -367,17 +377,19 @@ class CheckpointedNeedleMap(CompactNeedleMap):
 
     CHECKPOINT_EVERY = 100_000  # appends between automatic checkpoints
 
-    def __init__(self, index_path: str, replay: bool = True):
+    def __init__(self, index_path: str, replay: bool = True,
+                 offset_size: int = 4):
         self.snapshot_path = os.path.splitext(index_path)[0] + ".ldb"
         self._since_checkpoint = 0
         self._loaded_from_snapshot = False
-        super().__init__(index_path, replay=False)
+        super().__init__(index_path, replay=False, offset_size=offset_size)
         if replay:
             self._load_with_snapshot()
 
     @classmethod
-    def load(cls, index_path: str) -> "CheckpointedNeedleMap":
-        return cls(index_path, replay=True)
+    def load(cls, index_path: str,
+             offset_size: int = 4) -> "CheckpointedNeedleMap":
+        return cls(index_path, replay=True, offset_size=offset_size)
 
     def _load_with_snapshot(self) -> None:
         idx_size = (os.path.getsize(self.index_path)
@@ -406,7 +418,7 @@ class CheckpointedNeedleMap(CompactNeedleMap):
                 tail = f.read(idx_size - watermark)
             # replay the tail through the scalar path: events must apply
             # over snapshot state, not as an independent vectorized pass
-            for e in idx_mod.parse_entries(tail):
+            for e in idx_mod.parse_entries(tail, self.offset_size):
                 key, units, size = int(e["key"]), int(e["offset"]), int(e["size"])
                 self.max_file_key = max(self.max_file_key, key)
                 old = self.get(key)
@@ -430,15 +442,17 @@ class CheckpointedNeedleMap(CompactNeedleMap):
             magic, watermark, n, fc, fbc, dc, dbc, mfk = _LDB_HEADER.unpack(hdr)
             if magic != _LDB_MAGIC:
                 raise ValueError("bad snapshot magic")
+            ow = 8 if self.offset_size == 5 else 4
             k = np.frombuffer(f.read(8 * n), dtype="<u8")
-            o = np.frombuffer(f.read(4 * n), dtype="<u4")
+            o = np.frombuffer(f.read(ow * n), dtype=f"<u{ow}")
             s = np.frombuffer(f.read(4 * n), dtype="<i4")
             if len(k) != n or len(o) != n or len(s) != n:
                 raise ValueError("short snapshot")
         self.file_counter, self.file_byte_counter = fc, fbc
         self.deletion_counter, self.deletion_byte_counter = dc, dbc
         self.max_file_key = mfk
-        self._install_arrays(k.astype(np.uint64), o.astype(np.uint32),
+        self._install_arrays(k.astype(np.uint64),
+                             o.astype(self._units_dtype),
                              s.astype(np.int32))
         return watermark
 
@@ -453,7 +467,7 @@ class CheckpointedNeedleMap(CompactNeedleMap):
         ks = ([s.keys for s in self._sections]
               or [np.empty(0, np.uint64)])
         os_ = ([s.offs for s in self._sections]
-               or [np.empty(0, np.uint32)])
+               or [np.empty(0, self._units_dtype)])
         ss = ([s.sizes for s in self._sections]
               or [np.empty(0, np.int32)])
         k = np.concatenate(ks)
@@ -465,8 +479,9 @@ class CheckpointedNeedleMap(CompactNeedleMap):
                 _LDB_MAGIC, watermark, len(k), self.file_counter,
                 self.file_byte_counter, self.deletion_counter,
                 self.deletion_byte_counter, self.max_file_key))
+            ow = 8 if self.offset_size == 5 else 4
             f.write(k.astype("<u8").tobytes())
-            f.write(o.astype("<u4").tobytes())
+            f.write(o.astype(f"<u{ow}").tobytes())
             f.write(s.astype("<i4").tobytes())
             f.flush()
             os.fsync(f.fileno())
@@ -496,17 +511,20 @@ class SortedFileNeedleMap:
     read-only volumes (EC decode targets): put raises, delete negates the
     entry's size in place and logs the tombstone to the `.idx`."""
 
-    def __init__(self, index_path: str):
+    def __init__(self, index_path: str, offset_size: int = 4):
         from .needle_map import MemoryNeedleMap
 
         self.index_path = index_path
+        self.offset_size = offset_size
+        self._es = idx_mod.entry_size(offset_size)
         self.sorted_path = os.path.splitext(index_path)[0] + ".sdx"
         if not os.path.exists(self.sorted_path):
             from .needle_map import MemDb
 
-            MemDb.from_idx_file(index_path).write_sorted_file(self.sorted_path)
+            MemDb.from_idx_file(index_path, offset_size).write_sorted_file(
+                self.sorted_path, offset_size)
         self._f = open(self.sorted_path, "r+b")
-        self._n = os.path.getsize(self.sorted_path) // NEEDLE_MAP_ENTRY_SIZE
+        self._n = os.path.getsize(self.sorted_path) // self._es
         self._index_file = open(index_path, "ab")
         # counters come from a one-shot scan of the sorted file
         m = MemoryNeedleMap()
@@ -520,13 +538,13 @@ class SortedFileNeedleMap:
         self.max_file_key = m.max_file_key
 
     @classmethod
-    def load(cls, index_path: str) -> "SortedFileNeedleMap":
-        return cls(index_path)
+    def load(cls, index_path: str,
+             offset_size: int = 4) -> "SortedFileNeedleMap":
+        return cls(index_path, offset_size=offset_size)
 
     def _entry_at(self, i: int) -> tuple[int, int, int]:
-        buf = os.pread(self._f.fileno(), NEEDLE_MAP_ENTRY_SIZE,
-                       i * NEEDLE_MAP_ENTRY_SIZE)
-        e = idx_mod.parse_entries(buf)[0]
+        buf = os.pread(self._f.fileno(), self._es, i * self._es)
+        e = idx_mod.parse_entries(buf, self.offset_size)[0]
         return int(e["key"]), int(e["offset"]), int(e["size"])
 
     def _search(self, key: int) -> int:
@@ -562,14 +580,15 @@ class SortedFileNeedleMap:
             if size_is_valid(size):
                 # mark deleted in place: size -> -size (or tombstone for 0)
                 newsize = -size if size > 0 else TOMBSTONE_FILE_SIZE
-                self._f.seek(i * NEEDLE_MAP_ENTRY_SIZE)
+                self._f.seek(i * self._es)
                 self._f.write(idx_mod.pack_entry(
-                    k, units * NEEDLE_PADDING_SIZE, newsize))
+                    k, units * NEEDLE_PADDING_SIZE, newsize,
+                    self.offset_size))
                 self._f.flush()
                 self.deletion_counter += 1
                 self.deletion_byte_counter += size
         self._index_file.write(idx_mod.pack_entry(
-            key, tombstone_offset, TOMBSTONE_FILE_SIZE))
+            key, tombstone_offset, TOMBSTONE_FILE_SIZE, self.offset_size))
         self._index_file.flush()
 
     def __iter__(self) -> Iterator[NeedleValue]:

@@ -76,7 +76,7 @@ class Volume:
                  version: Version = Version.V3,
                  volume_size_limit: int = 30 * 1000 * 1000 * 1000,
                  needle_map_kind: str = "compact",
-                 use_mmap: bool = False):
+                 use_mmap: bool = False, offset_5: bool = False):
         self.directory = directory
         os.makedirs(directory, exist_ok=True)
         self.collection = collection
@@ -97,7 +97,13 @@ class Volume:
             version=version,
             replica_placement=replica_placement or ReplicaPlacement(),
             ttl=ttl or TTL(),
+            # superblock-extra flag byte: bit0 = 5-byte idx offsets
+            # (the reference's 5BytesOffset BUILD TAG made per-volume;
+            # ref: weed/storage/types/offset_5bytes.go) — >32GB volumes.
+            # Padded to 8 bytes so needle offsets stay 8-aligned.
+            extra=b"\x01" + b"\x00" * 7 if offset_5 else b"",
         )
+        self.offset_size = 5 if offset_5 else 4
         self._dat: Optional[object] = None
         self.nm: Optional[MemoryNeedleMap] = None
         # serializes all mutations of .dat/.idx/nm across the direct write
@@ -151,10 +157,15 @@ class Volume:
             self.super_block = SuperBlock.from_bytes(
                 self._dat.read_at(SUPER_BLOCK_SIZE + 0xFFFF, 0))
             self.version = self.super_block.version
+            # the offset width is a persisted property of the volume: an
+            # existing superblock overrides the constructor argument
+            extra = self.super_block.extra
+            self.offset_size = 5 if (extra and extra[0] & 1) else 4
         if not self.tiered:
             self._check_integrity()
         self.nm = _NEEDLE_MAP_KINDS.get(
-            self.needle_map_kind, MemoryNeedleMap).load(self.idx_path)
+            self.needle_map_kind, MemoryNeedleMap).load(
+                self.idx_path, offset_size=self.offset_size)
 
     def _entry_is_healthy(self, key: int, offset: int, size: int, dat_size: int) -> bool:
         """Does this idx entry point at a fully-written, matching needle?"""
@@ -181,12 +192,15 @@ class Volume:
         .dat pages didn't), then truncate .dat past the last healthy record."""
         if not os.path.exists(self.idx_path):
             return
+        from . import idx as idx_mod
+
+        es = idx_mod.entry_size(self.offset_size)
         idx_size = os.path.getsize(self.idx_path)
-        if idx_size % NEEDLE_MAP_ENTRY_SIZE != 0:
+        if idx_size % es != 0:
             # torn index append: truncate to the last full entry
             with open(self.idx_path, "r+b") as f:
-                f.truncate(idx_size - idx_size % NEEDLE_MAP_ENTRY_SIZE)
-            idx_size -= idx_size % NEEDLE_MAP_ENTRY_SIZE
+                f.truncate(idx_size - idx_size % es)
+            idx_size -= idx_size % es
 
         from .idx import parse_entries
 
@@ -197,9 +211,10 @@ class Volume:
         block_entries = 1024
         with open(self.idx_path, "rb") as f:
             while healthy_idx_size > 0 and last_healthy is None:
-                start = max(0, healthy_idx_size - block_entries * NEEDLE_MAP_ENTRY_SIZE)
+                start = max(0, healthy_idx_size - block_entries * es)
                 f.seek(start)
-                entries = parse_entries(f.read(healthy_idx_size - start))
+                entries = parse_entries(f.read(healthy_idx_size - start),
+                                        self.offset_size)
                 for i in range(len(entries) - 1, -1, -1):
                     key = int(entries["key"][i])
                     offset = int(entries["offset"][i]) * NEEDLE_PADDING_SIZE
@@ -207,7 +222,7 @@ class Volume:
                     if self._entry_is_healthy(key, offset, size, dat_size):
                         last_healthy = (key, offset, size)
                         break
-                    healthy_idx_size -= NEEDLE_MAP_ENTRY_SIZE
+                    healthy_idx_size -= es
         if healthy_idx_size != idx_size:
             with open(self.idx_path, "r+b") as f:
                 f.truncate(healthy_idx_size)
@@ -352,8 +367,9 @@ class Volume:
         if self.read_only:
             raise PermissionError(f"volume {self.id} is read only")
         actual = get_actual_size(len(n.data), self.version)
-        if MAX_POSSIBLE_VOLUME_SIZE < self.nm.content_size + actual:
-            raise OSError(f"volume size limit {MAX_POSSIBLE_VOLUME_SIZE} exceeded")
+        cap = MAX_POSSIBLE_VOLUME_SIZE * (256 if self.offset_size == 5 else 1)
+        if cap < self.nm.content_size + actual:
+            raise OSError(f"volume size limit {cap} exceeded")
         if self.is_file_unchanged(n):
             return 0, len(n.data), True
         nv = self.nm.get(n.id)
@@ -576,7 +592,8 @@ class Volume:
             for nv in live:
                 blob = self.read_needle_blob(nv.offset, nv.size)
                 dat_out.write(blob)
-                idx_out.write(idx_mod.pack_entry(nv.key, new_offset, nv.size))
+                idx_out.write(idx_mod.pack_entry(nv.key, new_offset, nv.size,
+                                                 self.offset_size))
                 new_offset += len(blob)
 
     def _makeup_diff(self, cpd: str, cpx: str) -> None:
@@ -593,7 +610,8 @@ class Volume:
 
         with open(self.idx_path, "rb") as f:
             f.seek(start)
-            entries = idx_mod.parse_entries(f.read(idx_size - start))
+            entries = idx_mod.parse_entries(f.read(idx_size - start),
+                                            self.offset_size)
         with open(cpd, "r+b") as dat_out, open(cpx, "ab") as idx_out:
             dat_out.seek(0, os.SEEK_END)
             new_offset = dat_out.tell()
@@ -604,10 +622,12 @@ class Volume:
                 if offset != 0 and size_is_valid(size):
                     blob = self.read_needle_blob(offset, size)
                     dat_out.write(blob)
-                    idx_out.write(idx_mod.pack_entry(key, new_offset, size))
+                    idx_out.write(idx_mod.pack_entry(key, new_offset, size,
+                                                     self.offset_size))
                     new_offset += len(blob)
                 else:
-                    idx_out.write(idx_mod.pack_entry(key, 0, -1))
+                    idx_out.write(idx_mod.pack_entry(key, 0, -1,
+                                                     self.offset_size))
 
     def commit_compact(self) -> None:
         """CommitCompact (volume_vacuum.go:91-160): catch up on post-compact

@@ -46,7 +46,7 @@ def binary_search_by_append_at_ns(volume: Volume, since_ns: int,
         if not os.path.exists(volume.idx_path):
             return None
         with open(volume.idx_path, "rb") as f:
-            entries = parse_entries(f.read())
+            entries = parse_entries(f.read(), volume.offset_size)
     lo, hi = 0, len(entries)
     while lo < hi:
         mid = (lo + hi) // 2
@@ -76,7 +76,7 @@ def records_since(volume: Volume, since_ns: int,
     if not os.path.exists(volume.idx_path):
         return b"", since_ns
     with open(volume.idx_path, "rb") as f:
-        all_entries = parse_entries(f.read())
+        all_entries = parse_entries(f.read(), volume.offset_size)
     start = binary_search_by_append_at_ns(volume, since_ns, all_entries)
     if start is None:
         return b"", since_ns
@@ -139,7 +139,7 @@ def last_appended_ns(volume: Volume) -> int:
     if not os.path.exists(volume.idx_path):
         return 0
     with open(volume.idx_path, "rb") as f:
-        entries = parse_entries(f.read())
+        entries = parse_entries(f.read(), volume.offset_size)
     for i in range(len(entries) - 1, -1, -1):
         off = int(entries["offset"][i]) * NEEDLE_PADDING_SIZE
         if off != 0:

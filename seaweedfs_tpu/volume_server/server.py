@@ -709,7 +709,8 @@ class VolumeServer:
         def assign_volume(req: Request) -> Response:
             b = req.json()
             self.store.add_volume(int(b["volume_id"]), b.get("collection", ""),
-                                  b.get("replication", "000"), b.get("ttl", ""))
+                                  b.get("replication", "000"), b.get("ttl", ""),
+                                  offset_5=bool(b.get("offset_5", False)))
             return Response({})
 
         @r.route("POST", "/admin/delete_volume")

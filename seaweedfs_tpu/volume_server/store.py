@@ -303,7 +303,8 @@ class Store:
 
     # --- volume admin -----------------------------------------------------
     def add_volume(self, vid: int, collection: str = "",
-                   replication: str = "000", ttl: str = "") -> Volume:
+                   replication: str = "000", ttl: str = "",
+                   offset_5: bool = False) -> Volume:
         if vid in self.volumes:
             return self.volumes[vid]
         loc = min(self.locations,
@@ -313,7 +314,7 @@ class Store:
                    replica_placement=ReplicaPlacement.parse(replication),
                    ttl=TTL.parse(ttl),
                    volume_size_limit=self.volume_size_limit,
-                   use_mmap=self.use_mmap)
+                   use_mmap=self.use_mmap, offset_5=offset_5)
         self.volumes[vid] = v
         self.volume_locks[vid] = threading.RLock()
         self._native_add(vid, v)
@@ -372,7 +373,11 @@ class Store:
             self._native_add(vid, v)
 
     def _native_add(self, vid: int, v: Volume) -> None:
-        if self.native_plane is None or v.tiered or v.version != Version.V3:
+        # the C++ plane speaks 16-byte (4-byte-offset) idx entries only:
+        # 5-byte-offset volumes stay on the Python engine
+        if self.native_plane is None or v.tiered \
+                or v.version != Version.V3 \
+                or getattr(v, "offset_size", 4) != 4:
             return
         # direct TCP writes bypass the HTTP layer's replication fan-out,
         # so only replication-000 volumes take them (the reference's
@@ -537,7 +542,8 @@ class Store:
     def _plane_eligible(self, vid: int) -> bool:
         v = self.volumes.get(vid)
         return (v is not None and not v.tiered
-                and v.version == Version.V3)
+                and v.version == Version.V3
+                and getattr(v, "offset_size", 4) == 4)
 
     def write_needle(self, vid: int, n: Needle, fsync: bool = False) -> tuple[int, bool]:
         plane = self.native_plane
@@ -690,6 +696,15 @@ class Store:
     def _ec_generate_locked(self, vid: int,
                             engine: Optional[str] = None) -> None:
         v = self.get_volume(vid)
+        if getattr(v, "offset_size", 4) != 4:
+            # the EC surface (.ecx entries, shard serving) is 16-byte /
+            # 4-byte-offset only — parsing a 17-byte idx as 16-byte
+            # would write a corrupt .ecx.  The reference has the same
+            # global-width coupling (5BytesOffset is a whole-binary
+            # build tag); a >32GB volume must be split before encoding.
+            raise ValueError(
+                f"volume {vid} uses 5-byte offsets; EC encoding "
+                "supports 4-byte-offset volumes only")
         base = v.file_prefix
         with self.volume_locks[vid]:
             v.read_only = True

@@ -413,3 +413,20 @@ def test_whitelisted_server_exposes_no_tcp_port(tmp_path):
     finally:
         vs.stop()
         m.stop()
+
+
+def test_5byte_volume_stays_off_native_plane(tmp_path, plane):
+    """The C++ plane speaks 16-byte idx entries only: a 5-byte-offset
+    volume must keep using the Python engine (and still work)."""
+    from seaweedfs_tpu.volume_server.store import Store
+
+    store = Store([str(tmp_path)], max_volume_count=4)
+    store.add_volume(1, offset_5=True)
+    store.add_volume(2)
+    store.attach_native_plane(plane)
+    assert not plane.has(1)
+    assert plane.has(2)
+    n = Needle(cookie=9, id=9, data=b"python engine path")
+    store.write_needle(1, n)
+    assert store.read_needle(1, 9, cookie=9).data == b"python engine path"
+    store.close()

@@ -214,3 +214,121 @@ def test_zero_size_needles_are_live_in_every_runtime_kind(tmp_path):
         m.delete(5, 8)
         assert m.get(5) is None, kind
         m.close()
+
+
+# --- 5-byte offsets (offset_5bytes.go as a per-volume option) ---------------
+
+def test_idx_5byte_entry_layout_and_roundtrip():
+    """17-byte entries: BE low u32 at [8:12], HIGH byte at [12]
+    (offset_5bytes.go OffsetToBytes), size at [13:17]."""
+    from seaweedfs_tpu.storage import idx as idx_mod
+
+    off = (0x03_12345678) * 8  # needs the 5th byte
+    b = idx_mod.pack_entry(0xDEAD, off, 1234, offset_size=5)
+    assert len(b) == 17 == idx_mod.entry_size(5)
+    assert b[8:12] == bytes.fromhex("12345678")
+    assert b[12] == 0x03
+    e = idx_mod.parse_entries(b, offset_size=5)[0]
+    assert (int(e["key"]), int(e["offset"]) * 8, int(e["size"])) == \
+        (0xDEAD, off, 1234)
+    # 4-byte packing is unchanged byte-for-byte
+    assert idx_mod.pack_entry(1, 80, 2) == idx_mod.pack_entry(1, 80, 2, 4)
+    assert len(idx_mod.pack_entry(1, 80, 2)) == 16
+
+
+@pytest.mark.parametrize("kind", ["memory", "compact", "ldb"])
+def test_needle_map_kinds_5byte_offsets_roundtrip(tmp_path, kind):
+    """Every writable map kind must round-trip offsets past the 32GB
+    line when the volume is in 5-byte mode."""
+    from seaweedfs_tpu.storage.needle_map import MemoryNeedleMap
+    from seaweedfs_tpu.storage.needle_map_compact import (
+        CheckpointedNeedleMap,
+        CompactNeedleMap,
+    )
+
+    cls = {"memory": MemoryNeedleMap, "compact": CompactNeedleMap,
+           "ldb": CheckpointedNeedleMap}[kind]
+    path = str(tmp_path / "v.idx")
+    big = 40 * (1 << 30)  # 40GB: unrepresentable in u32 units
+    m = cls(path, replay=False, offset_size=5) \
+        if kind != "ldb" else cls(path, replay=True, offset_size=5)
+    m.put(1, 8, 100)
+    m.put(2, big, 2000)
+    m.put(3, big + 4096, 300)
+    m.delete(3, big + 8192)
+    assert m.get(2).offset == big
+    assert m.get(3) is None
+    m.close()
+    # reopen: replay the 17-byte idx
+    m2 = cls.load(path, offset_size=5)
+    assert m2.get(1).offset == 8
+    assert m2.get(2).offset == big
+    assert m2.get(2).size == 2000
+    assert m2.get(3) is None
+    m2.close()
+
+
+def test_sorted_file_kind_5byte(tmp_path):
+    from seaweedfs_tpu.storage import idx as idx_mod
+    from seaweedfs_tpu.storage.needle_map_compact import SortedFileNeedleMap
+
+    path = str(tmp_path / "v.idx")
+    big = 50 * (1 << 30)
+    with open(path, "wb") as f:
+        f.write(idx_mod.pack_entry(5, 8, 10, 5))
+        f.write(idx_mod.pack_entry(9, big, 20, 5))
+    m = SortedFileNeedleMap.load(path, offset_size=5)
+    assert m.get(9).offset == big
+    m.delete(9, big + 64)
+    assert m.get(9) is None
+    m.close()
+
+
+def test_volume_5byte_offsets_persisted_and_roundtrip(tmp_path):
+    """A volume created with offset_5=True persists the mode in its
+    superblock (reopen WITHOUT the flag keeps 5-byte mode) and
+    round-trips needles; 4-byte volumes keep byte-identical formats."""
+    from seaweedfs_tpu.storage.needle import Needle
+    from seaweedfs_tpu.storage.volume import Volume
+
+    v = Volume(str(tmp_path / "five"), "", 7, offset_5=True)
+    assert v.offset_size == 5
+    v.write_needle(Needle(cookie=1, id=1, data=b"x" * 100))
+    v.write_needle(Needle(cookie=2, id=2, data=b"y" * 5000))
+    v.close()
+    assert os.path.getsize(str(tmp_path / "five" / "7.idx")) % 17 == 0
+
+    v2 = Volume(str(tmp_path / "five"), "", 7)  # flag comes from disk
+    assert v2.offset_size == 5
+    assert v2.read_needle(1, cookie=1).data == b"x" * 100
+    assert v2.read_needle(2, cookie=2).data == b"y" * 5000
+    # compaction keeps the mode
+    v2.delete_needle(Needle(cookie=1, id=1))
+    v2.compact()
+    v2.commit_compact()
+    assert v2.offset_size == 5
+    assert v2.read_needle(2, cookie=2).data == b"y" * 5000
+    with pytest.raises(Exception):
+        v2.read_needle(1, cookie=1)
+    v2.close()
+
+    # a plain volume is unchanged: 16-byte idx entries, empty extra
+    v4 = Volume(str(tmp_path / "four"), "", 8)
+    v4.write_needle(Needle(cookie=3, id=3, data=b"z" * 64))
+    v4.close()
+    assert os.path.getsize(str(tmp_path / "four" / "8.idx")) == 16
+    assert v4.super_block.extra == b""
+
+
+def test_ec_generate_refuses_5byte_volume(tmp_path):
+    """EC (.ecx) is a 16-byte-entry surface: encoding a 5-byte-offset
+    volume must fail loudly, not write a corrupt index."""
+    from seaweedfs_tpu.storage.needle import Needle
+    from seaweedfs_tpu.volume_server.store import Store
+
+    store = Store([str(tmp_path)], max_volume_count=2)
+    store.add_volume(1, offset_5=True)
+    store.write_needle(1, Needle(cookie=1, id=1, data=b"d" * 100))
+    with pytest.raises(ValueError, match="5-byte"):
+        store.ec_generate(1)
+    store.close()
