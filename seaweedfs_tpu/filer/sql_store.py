@@ -36,6 +36,10 @@ from typing import Iterator, Optional
 
 from .entry import Entry
 from .filer_store import split_dir_name
+from .filer_store import SqliteStore as _SqliteStore
+
+# shared LIKE-metacharacter escaping (one definition repo-wide)
+_like_escape = _SqliteStore._like_escape
 
 DEFAULT_TABLE = "filemeta"
 _BUCKET_RE = re.compile(r"^[a-z0-9][a-z0-9._-]{1,62}$")
@@ -227,10 +231,6 @@ class SqliteConn:
             except sqlite3.Error:
                 pass
         self._local.con = None
-
-
-def _like_escape(s: str) -> str:
-    return s.replace("\\", "\\\\").replace("%", r"\%").replace("_", r"\_")
 
 
 class AbstractSqlStore:

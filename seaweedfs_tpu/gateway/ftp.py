@@ -1,25 +1,78 @@
-"""FTP gateway scaffold.
+"""FTP gateway: a filer-backed FTP server (passive mode).
 
-Equivalent of weed/ftpd/ftp_server.go — which is itself an 81-line stub
-not registered as a command in the reference.  This mirrors that state:
-a server shell that accepts control connections, greets, and answers
-202 for everything else; the filer-backed data plane is future work in
-both codebases.  Cited so the judge can match the inventory row
-(SURVEY.md §2.6 FTP).
+Goes past the reference's 81-line unregistered stub
+(ref: weed/ftpd/ftp_server.go) to a WORKING minimal server: USER/PASS
+(accept-all unless a password is configured), PWD/CWD/CDUP, TYPE,
+PASV/EPSV passive data connections, LIST/NLST, RETR with REST, STOR,
+DELE, MKD/RMD, SIZE, MDTM, RNFR/RNTO and QUIT — enough for standard
+clients (curl, lftp, Python ftplib) to browse, upload and download
+through the filer.  Active mode (PORT) is intentionally absent: passive
+is what NAT'd clients use, and the data plane stays inbound-only.
 """
 
 from __future__ import annotations
 
+import posixpath
 import socket
 import threading
+import time
 from typing import Optional
 
 
+class _Session:
+    def __init__(self, conn: socket.socket, server: "FtpServer"):
+        self.conn = conn
+        self.server = server
+        self.cwd = "/"
+        self.user = ""
+        self.authed = False
+        self.binary = True
+        self.rest = 0
+        self.rnfr: Optional[str] = None
+        self._pasv: Optional[socket.socket] = None
+
+    # --- helpers ----------------------------------------------------------
+    def send(self, line: str) -> None:
+        self.conn.sendall(line.encode() + b"\r\n")
+
+    def path(self, arg: str) -> str:
+        p = arg if arg.startswith("/") else posixpath.join(self.cwd, arg)
+        p = posixpath.normpath(p)
+        return p if p.startswith("/") else "/" + p
+
+    def open_data(self) -> Optional[socket.socket]:
+        if self._pasv is None:
+            self.send("425 use PASV first")
+            return None
+        lsock, self._pasv = self._pasv, None
+        try:
+            lsock.settimeout(20)
+            data, _ = lsock.accept()
+            return data
+        except OSError:
+            self.send("425 data connection failed")
+            return None
+        finally:
+            lsock.close()
+
+    def close_pasv(self) -> None:
+        if self._pasv is not None:
+            try:
+                self._pasv.close()
+            except OSError:
+                pass
+            self._pasv = None
+
+
 class FtpServer:
-    def __init__(self, filer_url: str = "", host: str = "127.0.0.1",
-                 port: int = 8021):
-        self.filer_url = filer_url
+    """One filer-backed FTP endpoint; `fs` is the in-process FilerServer
+    (same wiring as the WebDAV gateway)."""
+
+    def __init__(self, filer_server=None, host: str = "127.0.0.1",
+                 port: int = 8021, password: str = ""):
+        self.fs = filer_server
         self.host, self.port = host, port
+        self.password = password  # empty: any USER/PASS accepted
         self._sock: Optional[socket.socket] = None
         self._stop = threading.Event()
 
@@ -31,6 +84,7 @@ class FtpServer:
         self._sock = socket.socket()
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((self.host, self.port))
+        self.port = self._sock.getsockname()[1]
         self._sock.listen(8)
         threading.Thread(target=self._accept_loop, daemon=True,
                          name="ftpd").start()
@@ -50,20 +104,224 @@ class FtpServer:
             threading.Thread(target=self._serve, args=(conn,),
                              daemon=True).start()
 
+    # --- session ----------------------------------------------------------
     def _serve(self, conn: socket.socket) -> None:
+        s = _Session(conn, self)
         with conn:
             try:
-                conn.sendall(b"220 seaweedfs-tpu FTP scaffold "
-                             b"(not implemented)\r\n")
+                s.send("220 seaweedfs-tpu FTP")
                 f = conn.makefile("rb")
                 while not self._stop.is_set():
                     line = f.readline()
                     if not line:
                         return
-                    cmd = line.split()[0].upper() if line.split() else b""
-                    if cmd == b"QUIT":
-                        conn.sendall(b"221 bye\r\n")
+                    parts = line.decode(errors="replace").rstrip("\r\n") \
+                        .split(" ", 1)
+                    cmd = parts[0].upper()
+                    arg = parts[1] if len(parts) > 1 else ""
+                    if cmd == "QUIT":
+                        s.send("221 bye")
                         return
-                    conn.sendall(b"202 command not implemented\r\n")
+                    handler = getattr(self, f"_cmd_{cmd.lower()}", None)
+                    if handler is None:
+                        s.send("502 command not implemented")
+                        continue
+                    if not s.authed and cmd not in ("USER", "PASS"):
+                        s.send("530 please login")
+                        continue
+                    try:
+                        handler(s, arg)
+                    except FileNotFoundError:
+                        s.send("550 not found")
+                    except Exception as e:  # any filer error -> 550
+                        s.send(f"550 {type(e).__name__}")
             except OSError:
                 pass
+            finally:
+                s.close_pasv()
+
+    # --- auth + state -----------------------------------------------------
+    def _cmd_user(self, s: _Session, arg: str) -> None:
+        s.user = arg
+        s.send("331 password please")
+
+    def _cmd_pass(self, s: _Session, arg: str) -> None:
+        if self.password and arg != self.password:
+            s.send("530 login incorrect")
+            return
+        s.authed = True
+        s.send("230 logged in")
+
+    def _cmd_syst(self, s: _Session, arg: str) -> None:
+        s.send("215 UNIX Type: L8")
+
+    def _cmd_feat(self, s: _Session, arg: str) -> None:
+        s.conn.sendall(b"211-features\r\n SIZE\r\n MDTM\r\n REST STREAM\r\n"
+                       b" EPSV\r\n211 end\r\n")
+
+    def _cmd_noop(self, s: _Session, arg: str) -> None:
+        s.send("200 ok")
+
+    def _cmd_type(self, s: _Session, arg: str) -> None:
+        s.binary = arg.upper().startswith("I")
+        s.send("200 ok")
+
+    def _cmd_pwd(self, s: _Session, arg: str) -> None:
+        s.send(f'257 "{s.cwd}"')
+
+    def _cmd_cwd(self, s: _Session, arg: str) -> None:
+        p = s.path(arg)
+        e = self.fs.filer.find_entry(p) if p != "/" else None
+        if p != "/" and (e is None or not e.is_directory):
+            s.send("550 no such directory")
+            return
+        s.cwd = p
+        s.send("250 ok")
+
+    def _cmd_cdup(self, s: _Session, arg: str) -> None:
+        self._cmd_cwd(s, "..")
+
+    # --- passive data plane -----------------------------------------------
+    def _pasv_listener(self, s: _Session) -> socket.socket:
+        s.close_pasv()
+        lsock = socket.socket()
+        lsock.bind((self.host, 0))
+        lsock.listen(1)
+        s._pasv = lsock
+        return lsock
+
+    def _cmd_pasv(self, s: _Session, arg: str) -> None:
+        lsock = self._pasv_listener(s)
+        port = lsock.getsockname()[1]
+        # advertise the CONTROL connection's local address — self.host
+        # may be 0.0.0.0 or a hostname, neither parseable in a 227 reply
+        ip = s.conn.getsockname()[0]
+        h = ip.replace(".", ",")
+        s.send(f"227 entering passive mode ({h},{port >> 8},{port & 0xFF})")
+
+    def _cmd_epsv(self, s: _Session, arg: str) -> None:
+        lsock = self._pasv_listener(s)
+        s.send(f"229 entering extended passive mode "
+               f"(|||{lsock.getsockname()[1]}|)")
+
+    # --- listings ---------------------------------------------------------
+    def _cmd_list(self, s: _Session, arg: str) -> None:
+        self._listing(s, arg, long=True)
+
+    def _cmd_nlst(self, s: _Session, arg: str) -> None:
+        self._listing(s, arg, long=False)
+
+    def _listing(self, s: _Session, arg: str, long: bool) -> None:
+        target = s.path(arg) if arg and not arg.startswith("-") else s.cwd
+        lines = []
+        for e in self.fs.filer.list_directory(target):
+            if long:
+                kind = "d" if e.is_directory else "-"
+                mode = e.attr.mode & 0o777
+                perms = "".join(
+                    c if mode & bit else "-"
+                    for c, bit in zip("rwxrwxrwx",
+                                      (0o400, 0o200, 0o100, 0o40, 0o20,
+                                       0o10, 4, 2, 1)))
+                when = time.strftime("%b %d %H:%M",
+                                     time.localtime(e.attr.mtime or 0))
+                lines.append(f"{kind}{perms} 1 weed weed "
+                             f"{e.file_size:>12} {when} {e.name}")
+            else:
+                lines.append(e.name)
+        data = s.open_data()
+        if data is None:
+            return
+        s.send("150 listing")
+        with data:
+            data.sendall("\r\n".join(lines).encode() + b"\r\n")
+        s.send("226 done")
+
+    # --- files ------------------------------------------------------------
+    def _cmd_size(self, s: _Session, arg: str) -> None:
+        e = self.fs.filer.find_entry(s.path(arg))
+        if e is None or e.is_directory:
+            s.send("550 not a file")
+            return
+        s.send(f"213 {e.file_size}")
+
+    def _cmd_mdtm(self, s: _Session, arg: str) -> None:
+        e = self.fs.filer.find_entry(s.path(arg))
+        if e is None:
+            s.send("550 not found")
+            return
+        s.send("213 " + time.strftime("%Y%m%d%H%M%S",
+                                      time.gmtime(e.attr.mtime or 0)))
+
+    def _cmd_rest(self, s: _Session, arg: str) -> None:
+        s.rest = int(arg or 0)
+        s.send(f"350 restarting at {s.rest}")
+
+    def _cmd_retr(self, s: _Session, arg: str) -> None:
+        e = self.fs.filer.find_entry(s.path(arg))
+        if e is None or e.is_directory:
+            s.send("550 not a file")
+            return
+        body = self.fs.read_chunks(e)
+        offset, s.rest = s.rest, 0
+        data = s.open_data()
+        if data is None:
+            return
+        s.send("150 sending")
+        with data:
+            data.sendall(body[offset:])
+        s.send("226 done")
+
+    def _cmd_stor(self, s: _Session, arg: str) -> None:
+        path = s.path(arg)
+        offset, s.rest = s.rest, 0
+        data = s.open_data()
+        if data is None:
+            return
+        s.send("150 receiving")
+        chunks = []
+        with data:
+            while True:
+                buf = data.recv(1 << 16)
+                if not buf:
+                    break
+                chunks.append(buf)
+        body = b"".join(chunks)
+        if offset:
+            # resumed upload (REST n + STOR): splice over the existing
+            # bytes instead of replacing the file with just the tail
+            e = self.fs.filer.find_entry(path)
+            old = self.fs.read_chunks(e) if e is not None \
+                and not e.is_directory else b""
+            body = old[:offset].ljust(offset, b"\x00") + body
+        self.fs.put_file(path, body)
+        s.send("226 stored")
+
+    def _cmd_dele(self, s: _Session, arg: str) -> None:
+        self.fs.filer.delete_entry(s.path(arg))
+        s.send("250 deleted")
+
+    def _cmd_mkd(self, s: _Session, arg: str) -> None:
+        p = s.path(arg)
+        self.fs.filer.mkdir(p)
+        s.send(f'257 "{p}" created')
+
+    def _cmd_rmd(self, s: _Session, arg: str) -> None:
+        self.fs.filer.delete_entry(s.path(arg), recursive=False)
+        s.send("250 removed")
+
+    def _cmd_rnfr(self, s: _Session, arg: str) -> None:
+        p = s.path(arg)
+        if self.fs.filer.find_entry(p) is None:
+            s.send("550 not found")
+            return
+        s.rnfr = p
+        s.send("350 ready for RNTO")
+
+    def _cmd_rnto(self, s: _Session, arg: str) -> None:
+        if not s.rnfr:
+            s.send("503 RNFR first")
+            return
+        self.fs.filer.rename(s.rnfr, s.path(arg))
+        s.rnfr = None
+        s.send("250 renamed")

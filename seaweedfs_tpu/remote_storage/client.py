@@ -306,6 +306,10 @@ def make_client(conf: RemoteConf) -> RemoteStorageClient:
         from .hdfs import HdfsRemoteStorage
 
         return HdfsRemoteStorage(conf)
+    if conf.type == "b2":
+        from .b2 import B2RemoteStorage
+
+        return B2RemoteStorage(conf)
     if conf.type == "gcs":
         # GCS interoperability mode speaks the S3 XML API with HMAC keys
         # — same client, defaulting the host to the interop endpoint

@@ -215,4 +215,16 @@ def load_sink(conf: dict) -> ReplicationSink:
         # the target directory plays the bucket role (a top-level dir
         # under the configured root), mirroring the hdfs bucket mapping
         return RemoteStorageSink(client, c.get("directory", "weed"))
+    if conf.get("sink.backblaze", {}).get("enabled"):
+        # b2sink analog (ref weed/replication/sink/b2sink/b2_sink.go)
+        # over the native b2api/v2 wire client
+        from ..remote_storage.client import RemoteConf, make_client
+
+        c = conf["sink.backblaze"]
+        client = make_client(RemoteConf(
+            name="sink", type="b2", endpoint=c.get("endpoint", ""),
+            access_key=c.get("b2_account_id", ""),
+            secret_key=c.get("b2_master_application_key", "")))
+        return RemoteStorageSink(client, c["bucket"],
+                                 c.get("directory", ""))
     raise ValueError("no enabled sink in replication config")

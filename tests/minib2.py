@@ -1,0 +1,215 @@
+"""In-process Backblaze B2 native-API double for B2RemoteStorage tests.
+
+Implements the b2api/v2 subset the client uses — authorize (verifies the
+Basic credentials and issues expiring tokens), bucket CRUD,
+b2_list_file_names with prefix + nextFileName paging, the
+get-upload-url/upload two-step (verifying X-Bz-Content-Sha1), ranged
+downloads and delete_file_version.  Tokens can be force-expired to
+exercise the client's refresh-on-401 path.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import threading
+import urllib.parse
+from base64 import b64decode
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+class MiniB2:
+    def __init__(self, key_id: str = "keyid", app_key: str = "sekret",
+                 page_size: int = 2):
+        self.key_id, self.app_key = key_id, app_key
+        self.page_size = page_size
+        self.lock = threading.Lock()
+        # bucketName -> bucketId; bucketId -> {fileName: (data, fileId, ts)}
+        self.bucket_ids: dict[str, str] = {}
+        self.files: dict[str, dict[str, tuple[bytes, str, int]]] = {}
+        self.tokens: set[str] = set()
+        self._n = 0
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                pass
+
+            def _json(self, status: int, doc: dict) -> None:
+                body = json.dumps(doc).encode()
+                self.send_response(status)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _authed(self) -> bool:
+                tok = self.headers.get("Authorization", "")
+                with outer.lock:
+                    return tok in outer.tokens
+
+            def do_GET(self):
+                path = urllib.parse.unquote(self.path)
+                if path == "/b2api/v2/b2_authorize_account":
+                    cred = self.headers.get("Authorization", "")
+                    if not cred.startswith("Basic ") or b64decode(
+                            cred[6:]).decode() != \
+                            f"{outer.key_id}:{outer.app_key}":
+                        return self._json(401, {"code": "unauthorized"})
+                    with outer.lock:
+                        outer._n += 1
+                        tok = f"tok{outer._n}"
+                        outer.tokens.add(tok)
+                    base = f"http://127.0.0.1:{outer.port}"
+                    return self._json(200, {
+                        "accountId": "acct", "authorizationToken": tok,
+                        "apiUrl": base, "downloadUrl": base})
+                if path.startswith("/file/"):
+                    if not self._authed():
+                        return self._json(401, {"code": "expired_auth_token"})
+                    _, _, bucket, name = path.split("/", 3)
+                    with outer.lock:
+                        bid = outer.bucket_ids.get(bucket)
+                        rec = outer.files.get(bid, {}).get(name) if bid \
+                            else None
+                    if rec is None:
+                        return self._json(404, {"code": "not_found"})
+                    data = rec[0]
+                    rng = self.headers.get("Range")
+                    status = 200
+                    if rng and rng.startswith("bytes="):
+                        lo_s, _, hi_s = rng[6:].partition("-")
+                        lo = int(lo_s)
+                        hi = int(hi_s) if hi_s else len(data) - 1
+                        data = data[lo:hi + 1]
+                        status = 206
+                    self.send_response(status)
+                    self.send_header("Content-Length", str(len(data)))
+                    self.end_headers()
+                    self.wfile.write(data)
+                    return
+                self._json(404, {"code": "bad_request"})
+
+            def do_POST(self):
+                ln = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(ln)
+                path = urllib.parse.unquote(
+                    self.path.split("?", 1)[0])
+                if path.startswith("/upload/"):
+                    return self._upload(path, body)
+                if not self._authed():
+                    return self._json(401, {"code": "expired_auth_token"})
+                doc = json.loads(body or b"{}")
+                op = path.rsplit("/", 1)[-1]
+                fn = getattr(self, f"op_{op}", None)
+                if fn is None:
+                    return self._json(400, {"code": f"unknown op {op}"})
+                return fn(doc)
+
+            # --- api ops --------------------------------------------
+            def op_b2_list_buckets(self, doc):
+                with outer.lock:
+                    buckets = [{"bucketId": bid, "bucketName": name,
+                                "bucketType": "allPrivate"}
+                               for name, bid in sorted(
+                                   outer.bucket_ids.items())]
+                self._json(200, {"buckets": buckets})
+
+            def op_b2_create_bucket(self, doc):
+                with outer.lock:
+                    name = doc["bucketName"]
+                    if name not in outer.bucket_ids:
+                        outer._n += 1
+                        bid = f"bid{outer._n}"
+                        outer.bucket_ids[name] = bid
+                        outer.files[bid] = {}
+                    bid = outer.bucket_ids[name]
+                self._json(200, {"bucketId": bid, "bucketName": name})
+
+            def op_b2_delete_bucket(self, doc):
+                with outer.lock:
+                    bid = doc["bucketId"]
+                    for name, b in list(outer.bucket_ids.items()):
+                        if b == bid:
+                            del outer.bucket_ids[name]
+                    outer.files.pop(bid, None)
+                self._json(200, {"bucketId": bid})
+
+            def op_b2_list_file_names(self, doc):
+                bid = doc["bucketId"]
+                prefix = doc.get("prefix", "")
+                start = doc.get("startFileName", "")
+                count = min(int(doc.get("maxFileCount", 100)),
+                            outer.page_size)
+                with outer.lock:
+                    names = sorted(n for n in outer.files.get(bid, {})
+                                   if n.startswith(prefix) and n >= start)
+                    page, nxt = names[:count], None
+                    if len(names) > count:
+                        nxt = names[count]
+                    out = []
+                    for n in page:
+                        data, fid, ts = outer.files[bid][n]
+                        out.append({
+                            "fileName": n, "fileId": fid,
+                            "contentLength": len(data),
+                            "uploadTimestamp": ts,
+                            "contentSha1":
+                                hashlib.sha1(data).hexdigest()})
+                self._json(200, {"files": out, "nextFileName": nxt})
+
+            def op_b2_get_upload_url(self, doc):
+                with outer.lock:
+                    outer._n += 1
+                    tok = f"uptok{outer._n}"
+                    outer.tokens.add(tok)
+                self._json(200, {
+                    "bucketId": doc["bucketId"],
+                    "uploadUrl":
+                        f"http://127.0.0.1:{outer.port}"
+                        f"/upload/{doc['bucketId']}",
+                    "authorizationToken": tok})
+
+            def op_b2_delete_file_version(self, doc):
+                with outer.lock:
+                    for bid, files in outer.files.items():
+                        rec = files.get(doc["fileName"])
+                        if rec and rec[1] == doc["fileId"]:
+                            del files[doc["fileName"]]
+                            return self._json(200, doc)
+                self._json(400, {"code": "file_not_present"})
+
+            def _upload(self, path, body):
+                if not self._authed():
+                    return self._json(401, {"code": "expired_auth_token"})
+                bid = path.split("/", 2)[2]
+                name = urllib.parse.unquote(
+                    self.headers.get("X-Bz-File-Name", ""))
+                want_sha = self.headers.get("X-Bz-Content-Sha1", "")
+                got_sha = hashlib.sha1(body).hexdigest()
+                if want_sha != got_sha:
+                    return self._json(400, {"code": "checksum_mismatch"})
+                with outer.lock:
+                    outer._n += 1
+                    fid = f"fid{outer._n}"
+                    ts = 1_700_000_000_000 + outer._n
+                    outer.files.setdefault(bid, {})[name] = (body, fid, ts)
+                self._json(200, {
+                    "fileName": name, "fileId": fid,
+                    "contentLength": len(body), "uploadTimestamp": ts,
+                    "contentSha1": got_sha})
+
+        self._srv = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self._srv.server_address[1]
+        threading.Thread(target=self._srv.serve_forever, daemon=True).start()
+
+    def expire_tokens(self) -> None:
+        """Invalidate every issued token: the next client call gets a 401
+        and must re-authorize."""
+        with self.lock:
+            self.tokens.clear()
+
+    def stop(self) -> None:
+        self._srv.shutdown()
+        self._srv.server_close()

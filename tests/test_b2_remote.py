@@ -1,0 +1,93 @@
+"""B2 native-API remote storage against an in-process b2api/v2 double.
+
+Gates mirror the azure-remote suite: auth (incl. refresh after token
+expiry), bucket + file lifecycle, prefix traverse with nextFileName
+paging, ranged reads, sha1-verified uploads, and the replication-sink
+adapter on top.  Ref: weed/replication/sink/b2sink/b2_sink.go.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from seaweedfs_tpu.remote_storage.client import (
+    RemoteConf,
+    RemoteLocation,
+    make_client,
+)
+
+from .minib2 import MiniB2
+
+
+@pytest.fixture()
+def server():
+    s = MiniB2()
+    yield s
+    s.stop()
+
+
+def _conf(server, key="sekret") -> RemoteConf:
+    return RemoteConf(name="b2t", type="b2", access_key="keyid",
+                      secret_key=key,
+                      endpoint=f"http://127.0.0.1:{server.port}")
+
+
+@pytest.fixture()
+def client(server):
+    return make_client(_conf(server))
+
+
+def test_bucket_and_file_lifecycle(server, client):
+    client.create_bucket("bkt")
+    assert client.list_buckets() == ["bkt"]
+    loc = RemoteLocation(conf_name="b2t", bucket="bkt")
+    obj = client.write_file(loc, "/dir/a.bin", b"hello b2")
+    assert obj.size == 8 and obj.key == "/dir/a.bin"
+    assert client.read_file(loc, "/dir/a.bin") == b"hello b2"
+    assert client.read_file(loc, "/dir/a.bin", offset=6, size=2) == b"b2"
+    client.delete_file(loc, "/dir/a.bin")
+    with pytest.raises(FileNotFoundError):
+        client.read_file(loc, "/dir/a.bin")
+    client.delete_file(loc, "/dir/a.bin")  # idempotent
+    client.delete_bucket("bkt")
+    assert client.list_buckets() == []
+
+
+def test_traverse_prefix_and_paging(server, client):
+    client.create_bucket("pkt")
+    loc = RemoteLocation(conf_name="b2t", bucket="pkt", path="/logs")
+    for i in range(5):
+        client.write_file(loc, f"/logs/f{i}.txt", bytes([i]) * (i + 1))
+    client.write_file(loc, "/other/x.txt", b"outside prefix")
+    got = list(client.traverse(loc))  # double pages at 2 entries
+    assert [o.key for o in got] == [f"/logs/f{i}.txt" for i in range(5)]
+    assert [o.size for o in got] == [1, 2, 3, 4, 5]
+
+
+def test_bad_credentials_rejected(server):
+    bad = make_client(_conf(server, key="wrong"))
+    with pytest.raises(PermissionError):
+        bad.list_buckets()
+
+
+def test_token_refresh_on_expiry(server, client):
+    client.create_bucket("tok")
+    loc = RemoteLocation(conf_name="b2t", bucket="tok")
+    client.write_file(loc, "/a", b"1")
+    server.expire_tokens()  # server-side expiry -> client must re-auth
+    client.write_file(loc, "/b", b"2")
+    assert sorted(o.key for o in client.traverse(loc)) == ["/a", "/b"]
+
+
+def test_b2_as_replication_sink(server, client):
+    from seaweedfs_tpu.replication.sink import RemoteStorageSink
+
+    client.create_bucket("sinkb")
+    sink = RemoteStorageSink(client, "sinkb")
+    loc = RemoteLocation(conf_name="sink", bucket="sinkb")
+    sink.create_entry("/d/file.txt", {"attr": {"mode": 0o644}},
+                      b"replicated to b2")
+    assert client.read_file(loc, "/d/file.txt") == b"replicated to b2"
+    sink.delete_entry("/d/file.txt", is_directory=False)
+    with pytest.raises(FileNotFoundError):
+        client.read_file(loc, "/d/file.txt")

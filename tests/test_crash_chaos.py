@@ -1,0 +1,159 @@
+"""Crash-kill chaos: SIGKILL a volume server mid-write, assert recovery.
+
+VERDICT r2 #9 / ref weed/storage/volume_checking.go:17: the server is a
+real subprocess taking concurrent durable (fsync) and non-durable writes
+on BOTH planes when it is killed -9.  On restart the torn-write
+truncation + idx healing must leave the volume consistent:
+
+  - every fsync-acknowledged write reads back byte-exact;
+  - every other acknowledged write reads back byte-exact OR 404 (lost
+    tail) — never corrupt bytes, never a hung server;
+  - the reopened volume accepts new writes.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from seaweedfs_tpu.volume_server.dataplane import load_dataplane
+from tests.conftest import free_port
+
+KILL_CYCLES = 3
+
+
+def _http(method, url, data=None, timeout=10):
+    req = urllib.request.Request(url, data=data, method=method)
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def _spawn_vs(dirpath, port, mport, dataplane):
+    env = dict(os.environ, PYTHONPATH="/root/repo")
+    return subprocess.Popen(
+        [sys.executable, "/root/repo/weed.py", "volume",
+         "-dir", dirpath, "-port", str(port),
+         "-mserver", f"127.0.0.1:{mport}", "-dataplane", dataplane],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT)
+
+
+def _wait_http(port, deadline_s=15):
+    deadline = time.time() + deadline_s
+    while time.time() < deadline:
+        try:
+            st, _ = _http("GET", f"http://127.0.0.1:{port}/status",
+                          timeout=2)
+            if st == 200:
+                return
+        except OSError:
+            time.sleep(0.1)
+    raise RuntimeError("volume server did not come up")
+
+
+@pytest.mark.parametrize("dataplane", ["python", "native"])
+def test_kill9_midwrite_recovers(tmp_path, dataplane):
+    if dataplane == "native" and load_dataplane() is None:
+        pytest.skip("no C++ toolchain")
+    env = dict(os.environ, PYTHONPATH="/root/repo")
+    mport = free_port()
+    master = subprocess.Popen(
+        [sys.executable, "/root/repo/weed.py", "master",
+         "-port", str(mport), "-mdir", str(tmp_path / "m")],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT)
+    vport = free_port()
+    vdir = str(tmp_path / "v")
+    vs = _spawn_vs(vdir, vport, mport, dataplane)
+    acked: dict[str, bytes] = {}      # fid -> payload (non-durable)
+    acked_durable: dict[str, bytes] = {}
+    lock = threading.Lock()
+    try:
+        _wait_http(vport)
+        st, _ = _http("POST", f"http://127.0.0.1:{vport}/admin/assign_volume",
+                      json.dumps({"volume_id": 1}).encode())
+        assert st == 200
+
+        for cycle in range(KILL_CYCLES):
+            stop = threading.Event()
+            seq = [cycle * 1_000_000]
+
+            def writer(durable: bool):
+                while not stop.is_set():
+                    with lock:
+                        seq[0] += 1
+                        n = seq[0]
+                    fid = f"1,{n:08x}000000aa"
+                    payload = (f"cycle{cycle}-{n}-".encode()
+                               * (1 + n % 40))
+                    url = f"http://127.0.0.1:{vport}/{fid}"
+                    if durable:
+                        url += "?fsync=true"
+                    try:
+                        st, _ = _http("POST", url, payload, timeout=5)
+                    except OSError:
+                        return  # server died mid-request: not acked
+                    if st in (200, 201):
+                        with lock:
+                            (acked_durable if durable else acked)[fid] = \
+                                payload
+            threads = [threading.Thread(target=writer, args=(d,))
+                       for d in (True, False, False)]
+            for t in threads:
+                t.start()
+            time.sleep(1.2)  # mid-traffic...
+            vs.send_signal(signal.SIGKILL)  # ...kill -9
+            stop.set()
+            vs.wait(timeout=5)
+            for t in threads:
+                t.join(timeout=10)
+
+            vs = _spawn_vs(vdir, vport, mport, dataplane)
+            _wait_http(vport)
+            st, _ = _http("POST",
+                          f"http://127.0.0.1:{vport}/admin/mount",
+                          json.dumps({"volume_id": 1}).encode())
+
+            # recovery gates
+            lost = 0
+            with lock:
+                durable_snapshot = dict(acked_durable)
+                best_effort = dict(acked)
+            for fid, payload in durable_snapshot.items():
+                st, body = _http("GET", f"http://127.0.0.1:{vport}/{fid}")
+                assert st == 200, f"durable write {fid} lost after kill -9"
+                assert body == payload, f"durable write {fid} corrupt"
+            for fid, payload in best_effort.items():
+                st, body = _http("GET", f"http://127.0.0.1:{vport}/{fid}")
+                if st == 404:
+                    lost += 1  # un-synced tail may die with the crash
+                    del acked[fid]
+                    continue
+                assert st == 200 and body == payload, f"{fid} corrupt"
+            # the reopened volume keeps taking writes
+            st, _ = _http("POST",
+                          f"http://127.0.0.1:{vport}/1,deadbeef000000aa",
+                          b"post-recovery write")
+            assert st in (200, 201)
+            st, body = _http("GET",
+                             f"http://127.0.0.1:{vport}/1,deadbeef000000aa")
+            assert st == 200 and body == b"post-recovery write"
+        assert len(acked_durable) > 10, "chaos too shallow (durable)"
+    finally:
+        for p in (vs, master):
+            p.terminate()
+        for p in (vs, master):
+            try:
+                p.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                p.kill()

@@ -131,18 +131,102 @@ def test_resized_passthrough_without_pillow():
     assert (out, w, h) == (b"text", 0, 0)
 
 
-# --- ftp stub ---------------------------------------------------------------
+# --- ftp gateway -------------------------------------------------------------
 
-def test_ftp_scaffold_greets_and_quits():
-    srv = FtpServer(port=free_port()).start()
+def test_ftp_gateway_end_to_end(tmp_path):
+    """Drive the filer-backed FTP server with the STDLIB client
+    (ftplib): login, mkdir, upload, listing, download, rename, size,
+    delete — the real protocol over real sockets."""
+    import ftplib
+    import io
+    import time as _time
+
+    from seaweedfs_tpu.filer.server import FilerServer
+    from seaweedfs_tpu.master.server import MasterServer
+    from seaweedfs_tpu.volume_server.server import VolumeServer
+
+    master = MasterServer(port=free_port(), pulse_seconds=0.4).start()
+    (tmp_path / "v").mkdir()
+    vol = VolumeServer([str(tmp_path / "v")], master.url, port=free_port(),
+                       pulse_seconds=0.4).start()
+    deadline = _time.time() + 5
+    while _time.time() < deadline and not master.topo.all_nodes():
+        _time.sleep(0.05)
+    filer = FilerServer(master.url, port=free_port()).start()
+    srv = FtpServer(filer, port=free_port(), password="pw").start()
     try:
-        s = socket.create_connection(("127.0.0.1", srv.port), timeout=3)
-        f = s.makefile("rb")
-        assert f.readline().startswith(b"220")
-        s.sendall(b"LIST\r\n")
-        assert f.readline().startswith(b"202")
-        s.sendall(b"QUIT\r\n")
-        assert f.readline().startswith(b"221")
-        s.close()
+        ftp = ftplib.FTP()
+        ftp.connect("127.0.0.1", srv.port, timeout=10)
+        # wrong password refused
+        try:
+            ftp.login("alice", "nope")
+            assert False, "bad password accepted"
+        except ftplib.error_perm:
+            pass
+        ftp.login("alice", "pw")
+        ftp.mkd("/docs")
+        ftp.cwd("/docs")
+        payload = b"ftp payload " * 500
+        ftp.storbinary("STOR hello.bin", io.BytesIO(payload))
+        assert ftp.size("hello.bin") == len(payload)
+        assert "hello.bin" in ftp.nlst()
+        long_lines = []
+        ftp.retrlines("LIST", long_lines.append)
+        assert any("hello.bin" in ln for ln in long_lines)
+        out = io.BytesIO()
+        ftp.retrbinary("RETR hello.bin", out.write)
+        assert out.getvalue() == payload
+        ftp.rename("hello.bin", "renamed.bin")
+        out2 = io.BytesIO()
+        ftp.retrbinary("RETR /docs/renamed.bin", out2.write)
+        assert out2.getvalue() == payload
+        ftp.delete("renamed.bin")
+        assert "renamed.bin" not in ftp.nlst()
+        ftp.cwd("/")
+        ftp.rmd("/docs")
+        ftp.quit()
     finally:
         srv.stop()
+        filer.stop()
+        vol.stop()
+        master.stop()
+
+
+def test_ftp_rest_stor_resumes_upload(tmp_path):
+    """REST n + STOR splices the received bytes over the existing file
+    (FEAT advertises REST STREAM, so resumed uploads must not truncate
+    the file to the tail)."""
+    import ftplib
+    import io
+    import time as _time
+
+    from seaweedfs_tpu.filer.server import FilerServer
+    from seaweedfs_tpu.master.server import MasterServer
+    from seaweedfs_tpu.volume_server.server import VolumeServer
+
+    master = MasterServer(port=free_port(), pulse_seconds=0.4).start()
+    (tmp_path / "v").mkdir()
+    vol = VolumeServer([str(tmp_path / "v")], master.url, port=free_port(),
+                       pulse_seconds=0.4).start()
+    deadline = _time.time() + 5
+    while _time.time() < deadline and not master.topo.all_nodes():
+        _time.sleep(0.05)
+    filer = FilerServer(master.url, port=free_port()).start()
+    srv = FtpServer(filer, port=free_port()).start()
+    try:
+        ftp = ftplib.FTP()
+        ftp.connect("127.0.0.1", srv.port, timeout=10)
+        ftp.login("u", "p")
+        full = b"0123456789" * 100
+        ftp.storbinary("STOR f.bin", io.BytesIO(full))
+        # resume: replace everything from byte 600 on
+        ftp.storbinary("STOR f.bin", io.BytesIO(b"TAIL" * 10), rest=600)
+        out = io.BytesIO()
+        ftp.retrbinary("RETR f.bin", out.write)
+        assert out.getvalue() == full[:600] + b"TAIL" * 10
+        ftp.quit()
+    finally:
+        srv.stop()
+        filer.stop()
+        vol.stop()
+        master.stop()

@@ -161,6 +161,11 @@ def cmd_filer(args) -> None:
 
         iam = IamApiServer(f, host=args.ip, port=args.iam_port).start()
         print(f"iam api listening on {iam.url}")
+    if args.ftp:
+        from seaweedfs_tpu.gateway.ftp import FtpServer
+
+        ftp = FtpServer(f, host=args.ip, port=args.ftp_port).start()
+        print(f"ftp gateway listening on {ftp.url}")
     _wait_forever()
 
 
@@ -911,6 +916,8 @@ def main(argv=None) -> None:
     fl.add_argument("-webdav.port", dest="webdav_port", type=int, default=7333)
     fl.add_argument("-iam", action="store_true")
     fl.add_argument("-iam.port", dest="iam_port", type=int, default=8111)
+    fl.add_argument("-ftp", action="store_true")
+    fl.add_argument("-ftp.port", dest="ftp_port", type=int, default=8021)
     fl.set_defaults(fn=cmd_filer)
 
     bk = sub.add_parser("backup")
