@@ -1,0 +1,97 @@
+"""Minimal BSON codec for the mongo wire client (filer/mongo_store.py).
+
+Covers the types the filer store traffics in: document, array, utf-8
+string, binary (subtype 0), bool, null, int32/int64, double.  Ints
+encode as int64 when out of int32 range.  No external deps — the same
+no-SDK rule as the redis/postgres/etcd clients.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Any
+
+_I32 = struct.Struct("<i")
+_I64 = struct.Struct("<q")
+_F64 = struct.Struct("<d")
+
+INT32_MIN, INT32_MAX = -(1 << 31), (1 << 31) - 1
+
+
+def _enc_elem(key: str, v: Any) -> bytes:
+    k = key.encode() + b"\x00"
+    if isinstance(v, bool):  # before int: bool is an int subclass
+        return b"\x08" + k + (b"\x01" if v else b"\x00")
+    if isinstance(v, int):
+        if INT32_MIN <= v <= INT32_MAX:
+            return b"\x10" + k + _I32.pack(v)
+        return b"\x12" + k + _I64.pack(v)
+    if isinstance(v, float):
+        return b"\x01" + k + _F64.pack(v)
+    if isinstance(v, str):
+        b = v.encode()
+        return b"\x02" + k + _I32.pack(len(b) + 1) + b + b"\x00"
+    if isinstance(v, (bytes, bytearray, memoryview)):
+        b = bytes(v)
+        return b"\x05" + k + _I32.pack(len(b)) + b"\x00" + b
+    if v is None:
+        return b"\x0a" + k
+    if isinstance(v, dict):
+        return b"\x03" + k + encode(v)
+    if isinstance(v, (list, tuple)):
+        doc = {str(i): x for i, x in enumerate(v)}
+        return b"\x04" + k + encode(doc)
+    raise TypeError(f"bson: unsupported type {type(v).__name__}")
+
+
+def encode(doc: dict) -> bytes:
+    body = b"".join(_enc_elem(k, v) for k, v in doc.items())
+    return _I32.pack(len(body) + 5) + body + b"\x00"
+
+
+def _dec_elem(buf: bytes, off: int) -> tuple[str, Any, int]:
+    t = buf[off]
+    off += 1
+    end = buf.index(b"\x00", off)
+    key = buf[off:end].decode()
+    off = end + 1
+    if t == 0x01:
+        return key, _F64.unpack_from(buf, off)[0], off + 8
+    if t == 0x02:
+        (n,) = _I32.unpack_from(buf, off)
+        s = buf[off + 4:off + 4 + n - 1].decode()
+        return key, s, off + 4 + n
+    if t in (0x03, 0x04):
+        (n,) = _I32.unpack_from(buf, off)
+        inner = decode(buf[off:off + n])
+        if t == 0x04:
+            return key, [inner[str(i)] for i in range(len(inner))], off + n
+        return key, inner, off + n
+    if t == 0x05:
+        (n,) = _I32.unpack_from(buf, off)
+        return key, bytes(buf[off + 5:off + 5 + n]), off + 5 + n
+    if t == 0x08:
+        return key, buf[off] != 0, off + 1
+    if t == 0x0A:
+        return key, None, off
+    if t == 0x10:
+        return key, _I32.unpack_from(buf, off)[0], off + 4
+    if t == 0x11:  # timestamp: opaque u64 (mongo internals)
+        return key, _I64.unpack_from(buf, off)[0], off + 8
+    if t == 0x12:
+        return key, _I64.unpack_from(buf, off)[0], off + 8
+    if t == 0x07:  # ObjectId (mongo _id defaults): keep raw bytes
+        return key, bytes(buf[off:off + 12]), off + 12
+    if t == 0x09:  # UTC datetime (ms since epoch)
+        return key, _I64.unpack_from(buf, off)[0], off + 8
+    raise ValueError(f"bson: unsupported element type 0x{t:02x}")
+
+
+def decode(buf: bytes) -> dict:
+    (n,) = _I32.unpack_from(buf, 0)
+    out: dict = {}
+    off = 4
+    while off < n - 1:
+        key, v, off = _dec_elem(buf, off)
+        out[key] = v
+    return out

@@ -1,0 +1,180 @@
+"""Elasticsearch filer store over the plain REST/JSON API.
+
+Equivalent of weed/filer/elastic/v7/elastic_store.go, SDK-free (the
+reference rides olivere/elastic; this speaks the documented HTTP API
+directly).  Same layout decisions as the reference: one index per
+top-level directory (`.seaweedfs_<first path component>`, so dropping a
+whole tree is a DeleteIndex), documents keyed by md5(full_path) with
+ParentId = md5(parent dir), plus a dedicated KV index.  Listings are a
+term query on ParentId sorted by name with search_after paging — done
+server-side here (the reference marks prefixed listing unsupported and
+filters client-side; this store filters with a prefix query instead).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import urllib.parse
+from typing import Iterator, Optional
+
+from ..utils.httpd import http_bytes
+from .entry import Entry
+from .filer_store import split_dir_name
+
+INDEX_PREFIX = ".seaweedfs_"
+KV_INDEX = ".seaweedfs_kv_entries"
+PAGE = 1000
+
+
+def _md5(s: str) -> str:
+    return hashlib.md5(s.encode()).hexdigest()
+
+
+def _index_of(path: str) -> str:
+    """One index per top-level directory (elastic_store.go getIndex)."""
+    parts = path.strip("/").split("/", 1)
+    top = parts[0] if parts and parts[0] else "root"
+    return INDEX_PREFIX + top.lower()
+
+
+class ElasticStore:
+    name = "elastic"
+
+    def __init__(self, base_url: str, username: str = "",
+                 password: str = ""):
+        self.base = base_url.rstrip("/")
+        self._headers = {"Content-Type": "application/json"}
+        if username:
+            import base64
+
+            cred = base64.b64encode(
+                f"{username}:{password}".encode()).decode()
+            self._headers["Authorization"] = f"Basic {cred}"
+
+    @classmethod
+    def from_url(cls, url: str) -> "ElasticStore":
+        """elastic://[user:pass@]host:port"""
+        u = urllib.parse.urlparse(url)
+        return cls(f"http://{u.hostname}:{u.port or 9200}",
+                   username=urllib.parse.unquote(u.username or ""),
+                   password=urllib.parse.unquote(u.password or ""))
+
+    # --- plumbing ---------------------------------------------------------
+    def _req(self, method: str, path: str,
+             doc: Optional[dict] = None) -> tuple[int, dict]:
+        body = json.dumps(doc).encode() if doc is not None else b""
+        status, out, _ = http_bytes(method, self.base + path, body,
+                                    headers=self._headers)
+        return status, (json.loads(out) if out else {})
+
+    # --- entries ----------------------------------------------------------
+    def insert_entry(self, entry: Entry) -> None:
+        d, name = split_dir_name(entry.full_path)
+        doc = {"ParentId": _md5(d), "Dir": d, "Name": name,
+               "Meta": entry.to_dict()}
+        status, out = self._req(
+            "PUT",
+            f"/{_index_of(entry.full_path)}/_doc/{_md5(entry.full_path)}"
+            "?refresh=true", doc)
+        if status not in (200, 201):
+            raise OSError(f"elastic insert {entry.full_path}: {status} {out}")
+
+    update_entry = insert_entry
+
+    def find_entry(self, path: str) -> Optional[Entry]:
+        status, out = self._req(
+            "GET", f"/{_index_of(path)}/_doc/{_md5(path)}")
+        if status == 404 or not out.get("found"):
+            return None
+        e = Entry.from_dict(out["_source"]["Meta"])
+        e.full_path = path
+        return e
+
+    def delete_entry(self, path: str) -> None:
+        if path.strip("/") and "/" not in path.strip("/"):
+            # top-level directory: its subtree IS the index
+            # (elastic_store.go DeleteEntry -> deleteIndex)
+            self._req("DELETE", f"/{_index_of(path)}")
+            return
+        self._req("DELETE",
+                  f"/{_index_of(path)}/_doc/{_md5(path)}?refresh=true")
+
+    def delete_folder_children(self, path: str) -> None:
+        base = path.rstrip("/") or "/"
+        for e in list(self.list_directory_entries(base, limit=1 << 31)):
+            if e.is_directory:
+                self.delete_folder_children(e.full_path)
+            self.delete_entry(e.full_path)
+
+    def list_directory_entries(self, dir_path: str, start_file: str = "",
+                               include_start: bool = False,
+                               limit: int = 1000,
+                               prefix: str = "") -> Iterator[Entry]:
+        d = dir_path.rstrip("/") or "/"
+        full_base = dir_path.rstrip("/")
+        served = 0
+        after = None
+        while served < limit:
+            musts: list[dict] = [{"term": {"ParentId": _md5(d)}}]
+            if prefix:
+                musts.append({"prefix": {"Name": prefix}})
+            if start_file:
+                op = "gte" if include_start else "gt"
+                musts.append({"range": {"Name": {op: start_file}}})
+            query: dict = {
+                "query": {"bool": {"must": musts}},
+                "sort": [{"Name": "asc"}],
+                "size": min(PAGE, limit - served),
+            }
+            if after is not None:
+                query["search_after"] = after
+            # root's children are spread over one index per top-level
+            # name — search every .seaweedfs_* index for them
+            index = (INDEX_PREFIX + "*") if d == "/" \
+                else _index_of(d + "/x")
+            status, out = self._req("POST", f"/{index}/_search", query)
+            if status == 404:
+                return  # index never created: empty directory
+            hits = out.get("hits", {}).get("hits", [])
+            if not hits:
+                return
+            for h in hits:
+                src = h["_source"]
+                e = Entry.from_dict(src["Meta"])
+                e.full_path = f"{full_base}/{src['Name']}"
+                served += 1
+                yield e
+                if served >= limit:
+                    return
+            after = hits[-1].get("sort") or [hits[-1]["_source"]["Name"]]
+
+    # --- kv ---------------------------------------------------------------
+    def kv_put(self, key: bytes, value: bytes) -> None:
+        status, out = self._req(
+            "PUT", f"/{KV_INDEX}/_doc/{key.hex()}?refresh=true",
+            {"Value": value.hex(), "Key": key.hex()})
+        if status not in (200, 201):
+            raise OSError(f"elastic kv_put: {status} {out}")
+
+    def kv_get(self, key: bytes) -> Optional[bytes]:
+        status, out = self._req("GET", f"/{KV_INDEX}/_doc/{key.hex()}")
+        if status == 404 or not out.get("found"):
+            return None
+        return bytes.fromhex(out["_source"]["Value"])
+
+    def kv_delete(self, key: bytes) -> None:
+        self._req("DELETE", f"/{KV_INDEX}/_doc/{key.hex()}?refresh=true")
+
+    def kv_scan(self, prefix: bytes) -> Iterator[tuple[bytes, bytes]]:
+        lo = prefix.hex()
+        musts: list[dict] = [{"prefix": {"Key": lo}}] if lo else []
+        query = {"query": {"bool": {"must": musts}} if musts
+                 else {"match_all": {}},
+                 "sort": [{"Key": "asc"}], "size": 10000}
+        status, out = self._req("POST", f"/{KV_INDEX}/_search", query)
+        if status == 404:
+            return
+        for h in out.get("hits", {}).get("hits", []):
+            src = h["_source"]
+            yield bytes.fromhex(src["Key"]), bytes.fromhex(src["Value"])

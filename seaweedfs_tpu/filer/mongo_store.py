@@ -1,0 +1,283 @@
+"""MongoDB filer store over the native OP_MSG wire protocol.
+
+Equivalent of weed/filer/mongodb/mongodb_store.go, SDK-free: TCP +
+OP_MSG (opcode 2013, MongoDB 3.6+) framing with the bson_lite codec,
+plus optional SCRAM-SHA-256 auth (saslStart/saslContinue).  Same
+document shape as the reference: {directory, name, meta} in one
+collection, upserted on (directory, name); kv entries ride the same
+collection under a reserved directory."""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+import threading
+import urllib.parse
+from typing import Iterator, Optional
+
+from . import bson_lite as bson
+from .entry import Entry
+from .filer_store import split_dir_name
+
+OP_MSG = 2013
+KV_DIR = "\x00kv"  # reserved: no real path starts with NUL
+
+
+class MongoError(OSError):
+    pass
+
+
+class MongoClient:
+    """One connection, lock-serialized request/response (store queries
+    are short; the filer's handler threads share it)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 27017,
+                 username: str = "", password: str = "",
+                 timeout: float = 10.0):
+        self.host, self.port = host, port
+        self.username, self.password = username, password
+        self.timeout = timeout
+        self._lock = threading.Lock()
+        self._sock: Optional[socket.socket] = None
+        self._req_id = 0
+        self._connect()
+
+    def _connect(self) -> None:
+        s = socket.create_connection((self.host, self.port), self.timeout)
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._sock = s
+        if self.username:
+            self._scram_auth()
+
+    def _roundtrip_locked(self, doc: dict) -> dict:
+        self._req_id += 1
+        body = bson.encode(doc)
+        payload = struct.pack("<I", 0) + b"\x00" + body  # flags, kind 0
+        header = struct.pack("<iiii", 16 + len(payload), self._req_id,
+                             0, OP_MSG)
+        self._sock.sendall(header + payload)
+        raw = self._recv_exact(16)
+        (ln, _, _, opcode) = struct.unpack("<iiii", raw)
+        rest = self._recv_exact(ln - 16)
+        if opcode != OP_MSG:
+            raise MongoError(f"unexpected opcode {opcode}")
+        # flags u32, then one kind-0 section (the reply document)
+        if rest[4] != 0:
+            raise MongoError("unexpected section kind")
+        reply = bson.decode(rest[5:])
+        if reply.get("ok") != 1 and reply.get("ok") != 1.0:
+            raise MongoError(reply.get("errmsg", str(reply)))
+        return reply
+
+    def _recv_exact(self, n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            chunk = self._sock.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("mongo connection closed")
+            buf += chunk
+        return buf
+
+    def command(self, doc: dict) -> dict:
+        with self._lock:
+            if self._sock is None:
+                self._connect()
+            try:
+                return self._roundtrip_locked(doc)
+            except (ConnectionError, OSError) as e:
+                if isinstance(e, MongoError):
+                    raise
+                # one reconnect-and-retry: store ops are idempotent
+                try:
+                    self._sock.close()
+                except (OSError, AttributeError):
+                    pass
+                self._sock = None
+                self._connect()
+                return self._roundtrip_locked(doc)
+
+    def close(self) -> None:
+        with self._lock:
+            if self._sock is not None:
+                try:
+                    self._sock.close()
+                except OSError:
+                    pass
+                self._sock = None
+
+    # --- SCRAM-SHA-256 (saslStart/saslContinue on $db=admin) --------------
+    def _scram_auth(self) -> None:
+        import base64
+        import hashlib
+        import hmac
+        import os as _os
+
+        nonce = base64.b64encode(_os.urandom(18)).decode()
+        user = self.username.replace("=", "=3D").replace(",", "=2C")
+        first_bare = f"n={user},r={nonce}"
+        start = self._roundtrip_locked({
+            "saslStart": 1, "mechanism": "SCRAM-SHA-256",
+            "payload": ("n,," + first_bare).encode(), "$db": "admin",
+            "options": {"skipEmptyExchange": True}})
+        server_first = bytes(start["payload"]).decode()
+        parts = dict(p.split("=", 1) for p in server_first.split(","))
+        r, s, i = parts["r"], parts["s"], int(parts["i"])
+        if not r.startswith(nonce):
+            raise MongoError("SCRAM nonce mismatch")
+        salted = hashlib.pbkdf2_hmac("sha256", self.password.encode(),
+                                     base64.b64decode(s), i)
+        ckey = hmac.new(salted, b"Client Key", hashlib.sha256).digest()
+        stored = hashlib.sha256(ckey).digest()
+        without_proof = f"c={base64.b64encode(b'n,,').decode()},r={r}"
+        auth_msg = f"{first_bare},{server_first},{without_proof}"
+        sig = hmac.new(stored, auth_msg.encode(), hashlib.sha256).digest()
+        proof = bytes(a ^ b for a, b in zip(ckey, sig))
+        final = f"{without_proof},p={base64.b64encode(proof).decode()}"
+        cont = self._roundtrip_locked({
+            "saslContinue": 1, "conversationId":
+                start.get("conversationId", 1),
+            "payload": final.encode(), "$db": "admin"})
+        sparts = dict(p.split("=", 1)
+                      for p in bytes(cont["payload"]).decode().split(","))
+        skey = hmac.new(salted, b"Server Key", hashlib.sha256).digest()
+        want = hmac.new(skey, auth_msg.encode(), hashlib.sha256).digest()
+        if base64.b64decode(sparts.get("v", "")) != want:
+            raise MongoError("SCRAM server signature mismatch")
+
+
+class MongoStore:
+    name = "mongodb"
+
+    def __init__(self, client: MongoClient, database: str = "seaweedfs",
+                 collection: str = "filemeta"):
+        self.client = client
+        self.db = database
+        self.coll = collection
+
+    @classmethod
+    def from_url(cls, url: str) -> "MongoStore":
+        """mongodb://[user:pass@]host:port[/database]"""
+        u = urllib.parse.urlparse(url)
+        client = MongoClient(
+            u.hostname or "127.0.0.1", u.port or 27017,
+            username=urllib.parse.unquote(u.username or ""),
+            password=urllib.parse.unquote(u.password or ""))
+        db = urllib.parse.unquote((u.path or "").lstrip("/")) or "seaweedfs"
+        return cls(client, db)
+
+    def _cmd(self, doc: dict) -> dict:
+        doc["$db"] = self.db
+        return self.client.command(doc)
+
+    def _find_docs(self, cmd: dict):
+        """find + getMore cursor follow: against a real mongod a large
+        listing spans multiple batches (16MB reply cap) — reading only
+        firstBatch would silently truncate it."""
+        out = self._cmd(cmd)
+        cur = out["cursor"]
+        yield from cur["firstBatch"]
+        while cur.get("id"):
+            out = self._cmd({"getMore": cur["id"],
+                             "collection": cmd["find"]})
+            cur = out["cursor"]
+            yield from cur["nextBatch"]
+
+    # --- entries ----------------------------------------------------------
+    def insert_entry(self, entry: Entry) -> None:
+        d, name = split_dir_name(entry.full_path)
+        self._cmd({"update": self.coll, "updates": [{
+            "q": {"directory": d, "name": name},
+            "u": {"directory": d, "name": name,
+                  "meta": json.dumps(entry.to_dict())},
+            "upsert": True}]})
+
+    update_entry = insert_entry
+
+    def find_entry(self, path: str) -> Optional[Entry]:
+        d, name = split_dir_name(path)
+        batch = list(self._find_docs({
+            "find": self.coll,
+            "filter": {"directory": d, "name": name}, "limit": 1}))
+        if not batch:
+            return None
+        e = Entry.from_dict(json.loads(batch[0]["meta"]))
+        e.full_path = path
+        return e
+
+    def delete_entry(self, path: str) -> None:
+        d, name = split_dir_name(path)
+        self._cmd({"delete": self.coll, "deletes": [{
+            "q": {"directory": d, "name": name}, "limit": 1}]})
+
+    def delete_folder_children(self, path: str) -> None:
+        base = path.rstrip("/") or "/"
+        # the reference deletes only the direct children
+        # (mongodb_store.go:172 where directory == path); recursing keeps
+        # every store's observable semantics identical
+        for e in list(self.list_directory_entries(base, limit=1 << 31)):
+            if e.is_directory:
+                self.delete_folder_children(e.full_path)
+            self.delete_entry(e.full_path)
+
+    def list_directory_entries(self, dir_path: str, start_file: str = "",
+                               include_start: bool = False,
+                               limit: int = 1000,
+                               prefix: str = "") -> Iterator[Entry]:
+        d = dir_path.rstrip("/") or "/"
+        full_base = dir_path.rstrip("/")
+        name_cond: dict = {}
+        lo = start_file if (start_file and
+                            (not prefix or start_file >= prefix)) else prefix
+        if lo:
+            name_cond["$gte" if (include_start or lo != start_file)
+                      else "$gt"] = lo
+        filt: dict = {"directory": d}
+        if name_cond:
+            filt["name"] = name_cond
+        served = 0
+        for docd in self._find_docs({"find": self.coll, "filter": filt,
+                                     "sort": {"name": 1},
+                                     "limit": limit + 1}):
+            name = docd["name"]
+            if start_file and name == start_file and not include_start:
+                continue
+            if prefix and not name.startswith(prefix):
+                break  # sorted: past the prefix range
+            if served >= limit:
+                break
+            served += 1
+            e = Entry.from_dict(json.loads(docd["meta"]))
+            e.full_path = f"{full_base}/{name}"
+            yield e
+
+    # --- kv ---------------------------------------------------------------
+    def kv_put(self, key: bytes, value: bytes) -> None:
+        self._cmd({"update": self.coll, "updates": [{
+            "q": {"directory": KV_DIR, "name": key.hex()},
+            "u": {"directory": KV_DIR, "name": key.hex(),
+                  "meta": value.hex()},
+            "upsert": True}]})
+
+    def kv_get(self, key: bytes) -> Optional[bytes]:
+        batch = list(self._find_docs({
+            "find": self.coll,
+            "filter": {"directory": KV_DIR, "name": key.hex()},
+            "limit": 1}))
+        return bytes.fromhex(batch[0]["meta"]) if batch else None
+
+    def kv_delete(self, key: bytes) -> None:
+        self._cmd({"delete": self.coll, "deletes": [{
+            "q": {"directory": KV_DIR, "name": key.hex()}, "limit": 1}]})
+
+    def kv_scan(self, prefix: bytes) -> Iterator[tuple[bytes, bytes]]:
+        lo = prefix.hex()
+        filt: dict = {"directory": KV_DIR}
+        if lo:
+            filt["name"] = {"$gte": lo, "$lt": lo + "g"}
+        for docd in self._find_docs({"find": self.coll, "filter": filt,
+                                     "sort": {"name": 1}}):
+            yield bytes.fromhex(docd["name"]), bytes.fromhex(docd["meta"])
+
+    def close(self) -> None:
+        self.client.close()

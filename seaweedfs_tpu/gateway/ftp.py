@@ -45,10 +45,19 @@ class _Session:
             self.send("425 use PASV first")
             return None
         lsock, self._pasv = self._pasv, None
+        control_peer = self.conn.getpeername()[0]
         try:
             lsock.settimeout(20)
-            data, _ = lsock.accept()
-            return data
+            deadline = time.monotonic() + 20
+            while time.monotonic() < deadline:
+                data, addr = lsock.accept()
+                # data-connection hijack guard: only the control
+                # connection's host may claim the advertised port
+                if addr[0] == control_peer:
+                    return data
+                data.close()
+            self.send("425 data connection failed")
+            return None
         except OSError:
             self.send("425 data connection failed")
             return None
@@ -262,14 +271,16 @@ class FtpServer:
         if e is None or e.is_directory:
             s.send("550 not a file")
             return
-        body = self.fs.read_chunks(e)
         offset, s.rest = s.rest, 0
+        # ranged chunk resolution: a resume must not fetch and discard
+        # the skipped prefix from the volume servers
+        body = self.fs.read_chunks(e, offset=offset)
         data = s.open_data()
         if data is None:
             return
         s.send("150 sending")
         with data:
-            data.sendall(body[offset:])
+            data.sendall(body)
         s.send("226 done")
 
     def _cmd_stor(self, s: _Session, arg: str) -> None:

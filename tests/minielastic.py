@@ -1,0 +1,153 @@
+"""In-process Elasticsearch REST double for ElasticStore tests.
+
+Implements the API subset the client uses: document PUT/GET/DELETE per
+index, DELETE index, and _search with bool/term/prefix/range queries,
+single-field asc sort, size and search_after paging — enough to prove
+the store's wire requests and paging against real HTTP.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+def _matches(doc: dict, clause: dict) -> bool:
+    kind, body = next(iter(clause.items()))
+    if kind == "term":
+        f, v = next(iter(body.items()))
+        return doc.get(f) == v
+    if kind == "prefix":
+        f, v = next(iter(body.items()))
+        return str(doc.get(f, "")).startswith(v)
+    if kind == "range":
+        f, conds = next(iter(body.items()))
+        val = doc.get(f)
+        for op, bound in conds.items():
+            if op == "gt" and not val > bound:
+                return False
+            if op == "gte" and not val >= bound:
+                return False
+            if op == "lt" and not val < bound:
+                return False
+            if op == "lte" and not val <= bound:
+                return False
+        return True
+    raise ValueError(f"unsupported clause {kind}")
+
+
+class MiniElastic:
+    def __init__(self):
+        # index -> {doc id -> source}
+        self.indices: dict[str, dict[str, dict]] = {}
+        self.lock = threading.Lock()
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                pass
+
+            def _json(self, status: int, doc: dict) -> None:
+                body = json.dumps(doc).encode()
+                self.send_response(status)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _parts(self):
+                path = urllib.parse.urlparse(self.path).path
+                return [p for p in path.split("/") if p]
+
+            def do_PUT(self):
+                ln = int(self.headers.get("Content-Length", 0))
+                doc = json.loads(self.rfile.read(ln) or b"{}")
+                parts = self._parts()
+                if len(parts) == 3 and parts[1] == "_doc":
+                    with outer.lock:
+                        idx = outer.indices.setdefault(parts[0], {})
+                        created = parts[2] not in idx
+                        idx[parts[2]] = doc
+                    return self._json(201 if created else 200,
+                                      {"result": "created" if created
+                                       else "updated"})
+                if len(parts) == 1:  # create index
+                    with outer.lock:
+                        outer.indices.setdefault(parts[0], {})
+                    return self._json(200, {"acknowledged": True})
+                self._json(400, {"error": "bad put"})
+
+            def do_GET(self):
+                parts = self._parts()
+                if len(parts) == 3 and parts[1] == "_doc":
+                    with outer.lock:
+                        src = outer.indices.get(parts[0], {}).get(parts[2])
+                    if src is None:
+                        return self._json(404, {"found": False})
+                    return self._json(200, {"found": True, "_id": parts[2],
+                                            "_source": src})
+                self._json(400, {"error": "bad get"})
+
+            def do_DELETE(self):
+                parts = self._parts()
+                with outer.lock:
+                    if len(parts) == 1:
+                        existed = parts[0] in outer.indices
+                        outer.indices.pop(parts[0], None)
+                        return self._json(200 if existed else 404,
+                                          {"acknowledged": existed})
+                    if len(parts) == 3 and parts[1] == "_doc":
+                        existed = outer.indices.get(
+                            parts[0], {}).pop(parts[2], None) is not None
+                        return self._json(
+                            200 if existed else 404,
+                            {"result": "deleted" if existed
+                             else "not_found"})
+                self._json(400, {"error": "bad delete"})
+
+            def do_POST(self):
+                ln = int(self.headers.get("Content-Length", 0))
+                q = json.loads(self.rfile.read(ln) or b"{}")
+                parts = self._parts()
+                if len(parts) != 2 or parts[1] != "_search":
+                    return self._json(400, {"error": "bad post"})
+                with outer.lock:
+                    if parts[0].endswith("*"):  # wildcard index search
+                        pref = parts[0][:-1]
+                        docs = [d for name, idx in outer.indices.items()
+                                if name.startswith(pref)
+                                for d in idx.values()]
+                    elif parts[0] not in outer.indices:
+                        return self._json(404, {"error": "no index"})
+                    else:
+                        docs = list(outer.indices[parts[0]].values())
+                query = q.get("query", {})
+                clauses = query.get("bool", {}).get("must", []) \
+                    if "bool" in query else []
+                hits = [d for d in docs
+                        if all(_matches(d, c) for c in clauses)]
+                sort_field = None
+                for s in q.get("sort", []):
+                    sort_field = next(iter(s))
+                if sort_field:
+                    hits.sort(key=lambda d: d.get(sort_field, ""))
+                after = q.get("search_after")
+                if after and sort_field:
+                    hits = [d for d in hits
+                            if d.get(sort_field, "") > after[0]]
+                hits = hits[:int(q.get("size", 10))]
+                self._json(200, {"hits": {"hits": [
+                    {"_source": d, "sort": [d.get(sort_field, "")]
+                     if sort_field else []}
+                    for d in hits]}})
+
+        self._srv = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self._srv.server_address[1]
+        threading.Thread(target=self._srv.serve_forever, daemon=True).start()
+
+    def stop(self) -> None:
+        self._srv.shutdown()
+        self._srv.server_close()

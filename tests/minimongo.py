@@ -1,0 +1,215 @@
+"""In-process MongoDB OP_MSG double for MongoStore tests.
+
+Speaks the wire format the client uses — OP_MSG framing with kind-0
+BSON sections — and implements find (equality + $gt/$gte/$lt/$lte on
+one field, sort, limit), update with upsert, delete, and the
+SCRAM-SHA-256 saslStart/saslContinue exchange when a password is
+configured.  Storage is a list of dicts per (db, collection).
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import os
+import socket
+import struct
+import threading
+
+from seaweedfs_tpu.filer import bson_lite as bson
+
+OP_MSG = 2013
+
+
+def _match(doc: dict, filt: dict) -> bool:
+    for k, cond in filt.items():
+        v = doc.get(k)
+        if isinstance(cond, dict):
+            for op, bound in cond.items():
+                if op == "$gt" and not (v is not None and v > bound):
+                    return False
+                elif op == "$gte" and not (v is not None and v >= bound):
+                    return False
+                elif op == "$lt" and not (v is not None and v < bound):
+                    return False
+                elif op == "$lte" and not (v is not None and v <= bound):
+                    return False
+                elif op not in ("$gt", "$gte", "$lt", "$lte"):
+                    raise ValueError(f"unsupported op {op}")
+        elif v != cond:
+            return False
+    return True
+
+
+class MiniMongo:
+    def __init__(self, username: str = "", password: str = ""):
+        self.username, self.password = username, password
+        self.colls: dict[tuple[str, str], list[dict]] = {}
+        self.cursors: dict[int, list[dict]] = {}
+        self._cursor_id = 0
+        self.batch_cap = 4  # small: forces the client's getMore path
+        self.lock = threading.Lock()
+        self._sock = socket.socket()
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind(("127.0.0.1", 0))
+        self._sock.listen(8)
+        self.port = self._sock.getsockname()[1]
+        self._stop = False
+        threading.Thread(target=self._accept, daemon=True,
+                         name="minimongo").start()
+
+    def stop(self) -> None:
+        self._stop = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def _accept(self) -> None:
+        while not self._stop:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    @staticmethod
+    def _read_exact(conn, n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            chunk = conn.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError
+            buf += chunk
+        return buf
+
+    def _serve(self, conn) -> None:
+        state = {"authed": not self.username, "scram": None}
+        try:
+            with conn:
+                while True:
+                    hdr = self._read_exact(conn, 16)
+                    ln, req_id, _, opcode = struct.unpack("<iiii", hdr)
+                    payload = self._read_exact(conn, ln - 16)
+                    if opcode != OP_MSG or payload[4] != 0:
+                        return
+                    doc = bson.decode(payload[5:])
+                    reply = self._handle(doc, state)
+                    body = bson.encode(reply)
+                    out = struct.pack("<I", 0) + b"\x00" + body
+                    conn.sendall(struct.pack(
+                        "<iiii", 16 + len(out), 0, req_id, OP_MSG) + out)
+        except (ConnectionError, OSError, ValueError, struct.error):
+            pass
+
+    # --- commands ---------------------------------------------------------
+    def _handle(self, doc: dict, state: dict) -> dict:
+        op = next(iter(doc))
+        if op == "saslStart":
+            return self._sasl_start(doc, state)
+        if op == "saslContinue":
+            return self._sasl_continue(doc, state)
+        if not state["authed"]:
+            return {"ok": 0, "errmsg": "authentication required",
+                    "code": 13}
+        db = doc.get("$db", "test")
+        if op == "find":
+            key = (db, doc["find"])
+            with self.lock:
+                docs = [d for d in self.colls.get(key, [])
+                        if _match(d, doc.get("filter", {}))]
+            for field, direction in (doc.get("sort") or {}).items():
+                docs.sort(key=lambda d: d.get(field),
+                          reverse=direction < 0)
+            limit = doc.get("limit") or len(docs)
+            docs = [dict(d) for d in docs[:limit]]
+            first, rest = docs[:self.batch_cap], docs[self.batch_cap:]
+            cid = 0
+            if rest:
+                with self.lock:
+                    self._cursor_id += 1
+                    cid = self._cursor_id
+                    self.cursors[cid] = rest
+            return {"ok": 1, "cursor": {
+                "id": cid, "ns": f"{db}.{doc['find']}",
+                "firstBatch": first}}
+        if op == "getMore":
+            cid = doc["getMore"]
+            with self.lock:
+                rest = self.cursors.get(cid, [])
+                batch, rest = rest[:self.batch_cap], rest[self.batch_cap:]
+                if rest:
+                    self.cursors[cid] = rest
+                else:
+                    self.cursors.pop(cid, None)
+                    cid = 0
+            return {"ok": 1, "cursor": {
+                "id": cid, "ns": "", "nextBatch": batch}}
+        if op == "update":
+            key = (db, doc["update"])
+            n = upserted = 0
+            with self.lock:
+                coll = self.colls.setdefault(key, [])
+                for u in doc["updates"]:
+                    hit = [d for d in coll if _match(d, u["q"])]
+                    if hit:
+                        hit[0].clear()
+                        hit[0].update(u["u"])
+                        n += 1
+                    elif u.get("upsert"):
+                        coll.append(dict(u["u"]))
+                        upserted += 1
+            return {"ok": 1, "n": n + upserted, "nModified": n}
+        if op == "delete":
+            key = (db, doc["delete"])
+            n = 0
+            with self.lock:
+                coll = self.colls.setdefault(key, [])
+                for dl in doc["deletes"]:
+                    hits = [d for d in coll if _match(d, dl["q"])]
+                    lim = dl.get("limit", 0) or len(hits)
+                    for h in hits[:lim]:
+                        coll.remove(h)
+                        n += 1
+            return {"ok": 1, "n": n}
+        return {"ok": 0, "errmsg": f"no such command: {op}"}
+
+    # --- SCRAM-SHA-256 ----------------------------------------------------
+    def _sasl_start(self, doc: dict, state: dict) -> dict:
+        body = bytes(doc["payload"]).decode()
+        client_first_bare = body.split(",", 2)[2]
+        client_nonce = dict(p.split("=", 1)
+                            for p in client_first_bare.split(","))["r"]
+        salt, iters = os.urandom(16), 4096
+        server_nonce = client_nonce + \
+            base64.b64encode(os.urandom(9)).decode()
+        server_first = (f"r={server_nonce},"
+                        f"s={base64.b64encode(salt).decode()},i={iters}")
+        state["scram"] = (client_first_bare, server_first, salt, iters)
+        return {"ok": 1, "conversationId": 1, "done": False,
+                "payload": server_first.encode()}
+
+    def _sasl_continue(self, doc: dict, state: dict) -> dict:
+        if state["scram"] is None:
+            return {"ok": 0, "errmsg": "no sasl in progress"}
+        client_first_bare, server_first, salt, iters = state["scram"]
+        final = bytes(doc["payload"]).decode()
+        fparts = dict(p.split("=", 1) for p in final.split(","))
+        salted = hashlib.pbkdf2_hmac("sha256", self.password.encode(),
+                                     salt, iters)
+        ckey = hmac.new(salted, b"Client Key", hashlib.sha256).digest()
+        stored = hashlib.sha256(ckey).digest()
+        without_proof = final[:final.rindex(",p=")]
+        auth_msg = f"{client_first_bare},{server_first},{without_proof}"
+        sig = hmac.new(stored, auth_msg.encode(), hashlib.sha256).digest()
+        want = bytes(a ^ b for a, b in zip(ckey, sig))
+        if base64.b64decode(fparts["p"]) != want:
+            return {"ok": 0, "errmsg": "authentication failed", "code": 18}
+        state["authed"] = True
+        state["scram"] = None
+        skey = hmac.new(salted, b"Server Key", hashlib.sha256).digest()
+        v = hmac.new(skey, auth_msg.encode(), hashlib.sha256).digest()
+        return {"ok": 1, "conversationId": 1, "done": True,
+                "payload": b"v=" + base64.b64encode(v)}

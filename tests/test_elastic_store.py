@@ -1,0 +1,159 @@
+"""Elasticsearch filer store against an in-process REST double.
+
+Gates mirror the redis/etcd suites: CRUD + listing pagination/prefix +
+low-start_file bound, per-top-level-index deletion, kv scans, randomized
+differential vs MemoryStore, and a Filer riding on top.
+Ref: weed/filer/elastic/v7/elastic_store.go.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu.filer.elastic_store import ElasticStore
+from seaweedfs_tpu.filer.entry import Attr, Entry, FileChunk
+from seaweedfs_tpu.filer.filer import Filer
+from seaweedfs_tpu.filer.filer_store import MemoryStore
+
+from .minielastic import MiniElastic
+
+
+@pytest.fixture()
+def server():
+    s = MiniElastic()
+    yield s
+    s.stop()
+
+
+@pytest.fixture()
+def store(server):
+    return ElasticStore.from_url(f"elastic://127.0.0.1:{server.port}")
+
+
+def _file(path: str, n: int = 1) -> Entry:
+    chunks = [FileChunk(file_id=f"3,{i:02x}", offset=i * 10, size=10)
+              for i in range(n)]
+    return Entry(full_path=path, attr=Attr(mode=0o660), chunks=chunks)
+
+
+def test_crud_listing_pagination(store):
+    for name in ("a.txt", "b.txt", "c.txt"):
+        store.insert_entry(_file(f"/d/{name}", n=2))
+    got = store.find_entry("/d/b.txt")
+    assert got is not None and len(got.chunks) == 2
+    assert store.find_entry("/d/zz") is None
+    assert [e.full_path for e in store.list_directory_entries("/d")] == [
+        "/d/a.txt", "/d/b.txt", "/d/c.txt"]
+    assert [e.full_path for e in store.list_directory_entries(
+        "/d", start_file="a.txt", limit=2)] == ["/d/b.txt", "/d/c.txt"]
+    assert [e.full_path for e in store.list_directory_entries(
+        "/d", start_file="b.txt", include_start=True, limit=1)] == [
+        "/d/b.txt"]
+    store.delete_entry("/d/b.txt")
+    assert store.find_entry("/d/b.txt") is None
+
+
+def test_prefix_and_low_start_file(store):
+    for name in ("aa", "ab", "ba", "bb"):
+        store.insert_entry(_file(f"/p/{name}"))
+    assert [e.name for e in store.list_directory_entries(
+        "/p", prefix="a")] == ["aa", "ab"]
+    got = [e.full_path for e in store.list_directory_entries(
+        "/p", start_file="aa", prefix="b", limit=2)]
+    assert got == ["/p/ba", "/p/bb"]
+
+
+def test_search_after_paging(store):
+    for i in range(25):
+        store.insert_entry(_file(f"/pg/f{i:03d}"))
+    import seaweedfs_tpu.filer.elastic_store as es_mod
+
+    old_page, es_mod.PAGE = es_mod.PAGE, 10  # force 3 pages
+    try:
+        names = [e.name for e in store.list_directory_entries(
+            "/pg", limit=1000)]
+    finally:
+        es_mod.PAGE = old_page
+    assert names == [f"f{i:03d}" for i in range(25)]
+
+
+def test_top_level_delete_drops_index(store):
+    store.insert_entry(_file("/tree/a"))
+    store.insert_entry(_file("/tree/sub/b"))
+    store.insert_entry(_file("/other/c"))
+    store.delete_entry("/tree")  # top-level: whole index drops
+    assert store.find_entry("/tree/a") is None
+    assert store.find_entry("/tree/sub/b") is None
+    assert store.find_entry("/other/c") is not None
+
+
+def test_delete_folder_children_recursive(store):
+    for p in ("/top/f1", "/top/sub/f2", "/other/f4"):
+        store.insert_entry(_file(p))
+    from seaweedfs_tpu.filer.entry import DIRECTORY_MODE_BIT
+
+    store.insert_entry(Entry(full_path="/top/sub",
+                             attr=Attr(mode=DIRECTORY_MODE_BIT | 0o755)))
+    store.delete_folder_children("/top")
+    assert store.find_entry("/top/f1") is None
+    assert store.find_entry("/top/sub/f2") is None
+    assert store.find_entry("/other/f4") is not None
+
+
+def test_kv_roundtrip_and_scan(store):
+    store.kv_put(b"k1", b"\x00\xffbin")
+    store.kv_put(b"k2", b"v2")
+    store.kv_put(b"other", b"v3")
+    assert store.kv_get(b"k1") == b"\x00\xffbin"
+    assert store.kv_get(b"nope") is None
+    assert [(k, v) for k, v in store.kv_scan(b"k")] == [
+        (b"k1", b"\x00\xffbin"), (b"k2", b"v2")]
+    store.kv_delete(b"k1")
+    assert store.kv_get(b"k1") is None
+
+
+def test_differential_vs_memory_store(store):
+    mem = MemoryStore()
+    rng = np.random.default_rng(23)
+    names = [f"f{i:02d}" for i in range(15)]
+    for _ in range(200):
+        op = rng.integers(0, 4)
+        path = f"/r/{names[rng.integers(0, 15)]}"
+        if op == 0:
+            e = _file(path, n=int(rng.integers(1, 4)))
+            store.insert_entry(e)
+            mem.insert_entry(e)
+        elif op == 1:
+            store.delete_entry(path)
+            mem.delete_entry(path)
+        elif op == 2:
+            assert (store.find_entry(path) is None) == \
+                (mem.find_entry(path) is None)
+        else:
+            got = [e.full_path for e in store.list_directory_entries("/r")]
+            want = [e.full_path for e in mem.list_directory_entries("/r")]
+            assert got == want
+
+
+def test_filer_on_elastic(store):
+    f = Filer(store)
+    f.create_entry(_file("/docs/readme.md"))
+    assert f.find_entry("/docs/readme.md") is not None
+    assert [e.name for e in f.list_directory("/docs")] == ["readme.md"]
+
+
+def test_root_listing_spans_top_level_indices(store):
+    """Children of '/' live in one index per top-level name — the root
+    listing must search across .seaweedfs_* (review repro: it returned
+    [] while /docs existed)."""
+    from seaweedfs_tpu.filer.entry import DIRECTORY_MODE_BIT
+
+    for top in ("docs", "logs"):
+        store.insert_entry(Entry(
+            full_path=f"/{top}",
+            attr=Attr(mode=DIRECTORY_MODE_BIT | 0o755)))
+        store.insert_entry(_file(f"/{top}/f.txt"))
+    store.kv_put(b"noise", b"x")  # kv index must not leak into listings
+    assert [e.full_path for e in store.list_directory_entries("/")] == [
+        "/docs", "/logs"]

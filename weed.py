@@ -109,6 +109,14 @@ def _make_filer_store(db: str):
         from seaweedfs_tpu.filer.sql_store import sqlite_sql_store
 
         return sqlite_sql_store(db[len("sql:"):], bucket_tables=True)
+    if db.startswith("elastic://"):
+        from seaweedfs_tpu.filer.elastic_store import ElasticStore
+
+        return ElasticStore.from_url(db)
+    if db.startswith("mongodb://"):
+        from seaweedfs_tpu.filer.mongo_store import MongoStore
+
+        return MongoStore.from_url(db)
     if db.endswith(".lsm"):
         # prefer the native C++ engine; the Python engine shares the
         # on-disk format, so falling back never strands a directory
@@ -164,7 +172,8 @@ def cmd_filer(args) -> None:
     if args.ftp:
         from seaweedfs_tpu.gateway.ftp import FtpServer
 
-        ftp = FtpServer(f, host=args.ip, port=args.ftp_port).start()
+        ftp = FtpServer(f, host=args.ip, port=args.ftp_port,
+                        password=args.ftp_password).start()
         print(f"ftp gateway listening on {ftp.url}")
     _wait_forever()
 
@@ -357,6 +366,8 @@ _SCAFFOLDS = {
 #   etcd://host:port  etcd v3 store (JSON gateway, any etcd >= 3.4)
 #   postgres://user:pw@host:port/db  abstract-SQL over the v3 wire protocol
 #   sql:/path.db      abstract-SQL engine on embedded sqlite (bucket tables)
+#   elastic://host:port              elasticsearch REST (index per top dir)
+#   mongodb://[user:pw@]host:port/db mongo OP_MSG wire protocol
 # Per-path rules (collection, replication, ttl, fsync) live IN the
 # filesystem at /etc/seaweedfs/filer.conf — edit with `fs.configure`.
 ''',
@@ -901,6 +912,7 @@ def main(argv=None) -> None:
                     help="store: redis://[:pw@]host:port[/db], "
                          "etcd://host:port, postgres://user:pw@host:port/db, "
                          "sql:/path.db -> abstract-SQL sqlite, "
+                         "elastic://host:port, mongodb://host:port/db, "
                          "*.lsm -> LSM store dir, else "
                          "sqlite path (default: memory)")
     fl.add_argument("-peers", default="",
@@ -918,6 +930,9 @@ def main(argv=None) -> None:
     fl.add_argument("-iam.port", dest="iam_port", type=int, default=8111)
     fl.add_argument("-ftp", action="store_true")
     fl.add_argument("-ftp.port", dest="ftp_port", type=int, default=8021)
+    fl.add_argument("-ftp.password", dest="ftp_password", default="",
+                    help="require this password on FTP logins "
+                         "(empty: accept any — local use only)")
     fl.set_defaults(fn=cmd_filer)
 
     bk = sub.add_parser("backup")
