@@ -1,0 +1,337 @@
+"""Cassandra filer store over the native CQL binary protocol (v4).
+
+Equivalent of weed/filer/cassandra/cassandra_store.go, SDK-free (the
+reference rides gocql): TCP + CQL v4 framing — STARTUP/READY, PASSWORD
+authentication (AUTHENTICATE/AUTH_RESPONSE/AUTH_SUCCESS), and QUERY
+messages with bound values.  Identical data model to the reference:
+one `filemeta` table partitioned by directory and clustered by name
+(so listings are a sorted partition slice and DeleteFolderChildren is
+ONE partition delete, ref cassandra_store.go:174); kv entries ride a
+reserved partition.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import socket
+import struct
+import threading
+import urllib.parse
+from typing import Iterator, Optional
+
+from ..utils.framing import recv_exact
+from .entry import Entry
+from .filer_store import split_dir_name
+
+KV_DIR = "\x00kv"
+
+# opcodes (CQL v4 spec §2.4)
+OP_ERROR = 0x00
+OP_STARTUP = 0x01
+OP_READY = 0x02
+OP_AUTHENTICATE = 0x03
+OP_QUERY = 0x07
+OP_RESULT = 0x08
+OP_AUTH_RESPONSE = 0x0F
+OP_AUTH_SUCCESS = 0x10
+
+RESULT_ROWS = 0x0002
+
+CONSISTENCY_ONE = 0x0001
+
+
+class CqlError(OSError):
+    pass
+
+
+def _string(s: str) -> bytes:
+    b = s.encode()
+    return struct.pack(">H", len(b)) + b
+
+
+def _string_map(m: dict) -> bytes:
+    out = struct.pack(">H", len(m))
+    for k, v in m.items():
+        out += _string(k) + _string(v)
+    return out
+
+
+class CqlClient:
+    """One connection, lock-serialized (stream id 0 only)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 9042,
+                 username: str = "", password: str = "",
+                 keyspace: str = "seaweedfs", timeout: float = 10.0):
+        self.host, self.port = host, port
+        self.username, self.password = username, password
+        self.keyspace = keyspace
+        self.timeout = timeout
+        self._lock = threading.Lock()
+        self._sock: Optional[socket.socket] = None
+        self._connect()
+
+    # --- framing ----------------------------------------------------------
+    def _send_frame(self, opcode: int, body: bytes) -> None:
+        self._sock.sendall(struct.pack(">BBhBI", 0x04, 0, 0, opcode,
+                                       len(body)) + body)
+
+    def _recv_frame(self) -> tuple[int, bytes]:
+        hdr = recv_exact(self._sock, 9)
+        _, _, _, opcode, ln = struct.unpack(">BBhBI", hdr)
+        return opcode, recv_exact(self._sock, ln)
+
+    # --- session ----------------------------------------------------------
+    def _connect(self) -> None:
+        s = socket.create_connection((self.host, self.port), self.timeout)
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._sock = s
+        self._send_frame(OP_STARTUP, _string_map({"CQL_VERSION": "3.0.0"}))
+        opcode, body = self._recv_frame()
+        if opcode == OP_AUTHENTICATE:
+            token = b"\x00" + self.username.encode() + \
+                b"\x00" + self.password.encode()
+            self._send_frame(OP_AUTH_RESPONSE,
+                             struct.pack(">i", len(token)) + token)
+            opcode, body = self._recv_frame()
+            if opcode != OP_AUTH_SUCCESS:
+                raise CqlError(self._err(opcode, body))
+        elif opcode != OP_READY:
+            raise CqlError(self._err(opcode, body))
+        # keyspace from the URL must actually take effect: create it if
+        # absent, then switch the session (unqualified `filemeta` in
+        # every later statement resolves against it)
+        if not re.fullmatch(r"[A-Za-z][A-Za-z0-9_]*", self.keyspace):
+            raise CqlError(f"invalid keyspace {self.keyspace!r}")
+        self._query_locked(
+            f"CREATE KEYSPACE IF NOT EXISTS {self.keyspace} WITH "
+            "replication = {'class': 'SimpleStrategy', "
+            "'replication_factor': 1}", ())
+        self._query_locked(f"USE {self.keyspace}", ())
+
+    @staticmethod
+    def _err(opcode: int, body: bytes) -> str:
+        if opcode == OP_ERROR and len(body) >= 6:
+            (code,) = struct.unpack(">i", body[:4])
+            (n,) = struct.unpack(">H", body[4:6])
+            return f"cql error {code:#x}: {body[6:6 + n].decode()}"
+        return f"unexpected opcode {opcode}"
+
+    # --- queries ----------------------------------------------------------
+    def query(self, cql: str, values: tuple = ()) -> list[tuple]:
+        with self._lock:
+            if self._sock is None:
+                self._connect()
+            try:
+                return self._query_locked(cql, values)
+            except (ConnectionError, OSError) as e:
+                if isinstance(e, CqlError):
+                    raise
+                try:
+                    self._sock.close()
+                except (OSError, AttributeError):
+                    pass
+                self._sock = None
+                self._connect()  # one retry: statements are idempotent
+                return self._query_locked(cql, values)
+
+    def _query_locked(self, cql: str, values: tuple) -> list[tuple]:
+        q = cql.encode()
+        body = struct.pack(">I", len(q)) + q
+        body += struct.pack(">H", CONSISTENCY_ONE)
+        if values:
+            body += struct.pack(">BH", 0x01, len(values))  # flags: VALUES
+            for v in values:
+                if isinstance(v, bool):
+                    raise TypeError("no bool binds in this store")
+                if isinstance(v, int):
+                    b = struct.pack(">i", v)  # CQL int: 4-byte BE
+                elif isinstance(v, bytes):
+                    b = v
+                else:
+                    b = str(v).encode()
+                body += struct.pack(">i", len(b)) + b
+        else:
+            body += b"\x00"
+        self._send_frame(OP_QUERY, body)
+        opcode, rbody = self._recv_frame()
+        if opcode != OP_RESULT:
+            raise CqlError(self._err(opcode, rbody))
+        (kind,) = struct.unpack(">i", rbody[:4])
+        if kind != RESULT_ROWS:
+            return []
+        return self._parse_rows(rbody[4:])
+
+    @staticmethod
+    def _parse_rows(b: bytes) -> list[tuple]:
+        flags, cols = struct.unpack(">iI", b[:8])
+        off = 8
+        if flags & 0x0002:  # has_more_pages: paging state
+            (n,) = struct.unpack(">i", b[off:off + 4])
+            off += 4 + max(n, 0)
+        if not flags & 0x0001:  # no global table spec
+            pass
+        else:
+            for _ in range(2):  # keyspace + table
+                (n,) = struct.unpack(">H", b[off:off + 2])
+                off += 2 + n
+        for _ in range(cols):  # column specs: name + type
+            if not flags & 0x0001:
+                for _ in range(2):
+                    (n,) = struct.unpack(">H", b[off:off + 2])
+                    off += 2 + n
+            (n,) = struct.unpack(">H", b[off:off + 2])
+            off += 2 + n
+            (t,) = struct.unpack(">H", b[off:off + 2])
+            off += 2
+            if t == 0x0000:  # custom type: class name string
+                (n,) = struct.unpack(">H", b[off:off + 2])
+                off += 2 + n
+        (nrows,) = struct.unpack(">I", b[off:off + 4])
+        off += 4
+        rows = []
+        for _ in range(nrows):
+            row = []
+            for _ in range(cols):
+                (n,) = struct.unpack(">i", b[off:off + 4])
+                off += 4
+                if n < 0:
+                    row.append(None)
+                else:
+                    row.append(b[off:off + n])
+                    off += n
+            rows.append(tuple(row))
+        return rows
+
+    def close(self) -> None:
+        with self._lock:
+            if self._sock is not None:
+                try:
+                    self._sock.close()
+                except OSError:
+                    pass
+                self._sock = None
+
+
+class CassandraStore:
+    name = "cassandra"
+
+    def __init__(self, client: CqlClient):
+        self.client = client
+        self.client.query(
+            "CREATE TABLE IF NOT EXISTS filemeta (directory text, "
+            "name text, meta blob, PRIMARY KEY (directory, name))")
+
+    @classmethod
+    def from_url(cls, url: str) -> "CassandraStore":
+        """cassandra://[user:pass@]host:port[/keyspace]"""
+        u = urllib.parse.urlparse(url)
+        return cls(CqlClient(
+            u.hostname or "127.0.0.1", u.port or 9042,
+            username=urllib.parse.unquote(u.username or ""),
+            password=urllib.parse.unquote(u.password or ""),
+            keyspace=urllib.parse.unquote(
+                (u.path or "").lstrip("/")) or "seaweedfs"))
+
+    # --- entries ----------------------------------------------------------
+    def insert_entry(self, entry: Entry) -> None:
+        d, name = split_dir_name(entry.full_path)
+        self.client.query(
+            "INSERT INTO filemeta (directory,name,meta) VALUES (?,?,?)",
+            (d, name, json.dumps(entry.to_dict()).encode()))
+
+    update_entry = insert_entry  # CQL inserts are upserts
+
+    def find_entry(self, path: str) -> Optional[Entry]:
+        d, name = split_dir_name(path)
+        rows = self.client.query(
+            "SELECT meta FROM filemeta WHERE directory=? AND name=?",
+            (d, name))
+        if not rows:
+            return None
+        e = Entry.from_dict(json.loads(rows[0][0]))
+        e.full_path = path
+        return e
+
+    def delete_entry(self, path: str) -> None:
+        d, name = split_dir_name(path)
+        self.client.query(
+            "DELETE FROM filemeta WHERE directory=? AND name=?", (d, name))
+
+    def delete_folder_children(self, path: str) -> None:
+        base = path.rstrip("/") or "/"
+        # recurse into subdirectories FIRST (each is its own partition),
+        # then drop this directory's whole partition in one statement
+        # (ref cassandra_store.go:174)
+        for e in list(self.list_directory_entries(base,
+                                                  limit=(1 << 31) - 1)):
+            if e.is_directory:
+                self.delete_folder_children(e.full_path)
+        self.client.query(
+            "DELETE FROM filemeta WHERE directory=?", (base,))
+
+    def list_directory_entries(self, dir_path: str, start_file: str = "",
+                               include_start: bool = False,
+                               limit: int = 1000,
+                               prefix: str = "") -> Iterator[Entry]:
+        d = dir_path.rstrip("/") or "/"
+        full_base = dir_path.rstrip("/")
+        lo = start_file if (start_file and
+                            (not prefix or start_file >= prefix)) else prefix
+        # every branch already excludes the exact start_file row when
+        # include_start is false, so no client-side re-filter is needed
+        op = ">=" if (include_start or lo != start_file or not lo) else ">"
+        if lo:
+            rows = self.client.query(
+                f"SELECT name, meta FROM filemeta WHERE directory=? "
+                f"AND name{op}? ORDER BY name ASC LIMIT ?",
+                (d, lo, limit))
+        else:
+            rows = self.client.query(
+                "SELECT name, meta FROM filemeta WHERE directory=? "
+                "ORDER BY name ASC LIMIT ?", (d, limit))
+        served = 0
+        for name_b, meta in rows:
+            name = name_b.decode()
+            if prefix and not name.startswith(prefix):
+                break  # clustered ascending: past the prefix range
+            if served >= limit:
+                break
+            served += 1
+            e = Entry.from_dict(json.loads(meta))
+            e.full_path = f"{full_base}/{name}"
+            yield e
+
+    # --- kv ---------------------------------------------------------------
+    def kv_put(self, key: bytes, value: bytes) -> None:
+        self.client.query(
+            "INSERT INTO filemeta (directory,name,meta) VALUES (?,?,?)",
+            (KV_DIR, key.hex(), value))
+
+    def kv_get(self, key: bytes) -> Optional[bytes]:
+        rows = self.client.query(
+            "SELECT meta FROM filemeta WHERE directory=? AND name=?",
+            (KV_DIR, key.hex()))
+        return bytes(rows[0][0]) if rows else None
+
+    def kv_delete(self, key: bytes) -> None:
+        self.client.query(
+            "DELETE FROM filemeta WHERE directory=? AND name=?",
+            (KV_DIR, key.hex()))
+
+    def kv_scan(self, prefix: bytes) -> Iterator[tuple[bytes, bytes]]:
+        lo = prefix.hex()
+        if lo:
+            rows = self.client.query(
+                "SELECT name, meta FROM filemeta WHERE directory=? "
+                "AND name>=? AND name<? ORDER BY name ASC LIMIT ?",
+                (KV_DIR, lo, lo + "g", 1 << 30))
+        else:
+            rows = self.client.query(
+                "SELECT name, meta FROM filemeta WHERE directory=? "
+                "ORDER BY name ASC LIMIT ?", (KV_DIR, 1 << 30))
+        for name_b, meta in rows:
+            yield bytes.fromhex(name_b.decode()), bytes(meta)
+
+    def close(self) -> None:
+        self.client.close()

@@ -1,0 +1,200 @@
+"""In-process CQL v4 binary-protocol double for CassandraStore tests.
+
+Speaks the frame subset the client uses — STARTUP/READY (or
+AUTHENTICATE + PASSWORD auth when configured) and QUERY with bound
+values — and executes the store's fixed statement shapes against
+in-memory dict partitions: upsert INSERT, point SELECT/DELETE,
+partition-slice SELECT with name bounds + LIMIT, whole-partition
+DELETE, CREATE TABLE no-op.
+"""
+
+from __future__ import annotations
+
+import re
+import socket
+import struct
+import threading
+
+OP_ERROR = 0x00
+OP_STARTUP = 0x01
+OP_READY = 0x02
+OP_AUTHENTICATE = 0x03
+OP_QUERY = 0x07
+OP_RESULT = 0x08
+OP_AUTH_RESPONSE = 0x0F
+OP_AUTH_SUCCESS = 0x10
+
+
+def _rows_body(cols: list[str], rows: list[tuple]) -> bytes:
+    # kind=Rows, flags=global_tables_spec, col specs, then rows
+    out = struct.pack(">i", 0x0002)
+    out += struct.pack(">iI", 0x0001, len(cols))
+
+    def s(x: str) -> bytes:
+        b = x.encode()
+        return struct.pack(">H", len(b)) + b
+
+    out += s("ks") + s("filemeta")
+    for c in cols:
+        out += s(c) + struct.pack(">H", 0x000D)  # varchar
+    out += struct.pack(">I", len(rows))
+    for row in rows:
+        for v in row:
+            out += struct.pack(">i", len(v)) + v
+    return out
+
+
+class MiniCassandra:
+    def __init__(self, username: str = "", password: str = ""):
+        self.username, self.password = username, password
+        # directory -> {name: meta bytes}
+        self.parts: dict[str, dict[str, bytes]] = {}
+        self.lock = threading.Lock()
+        self._sock = socket.socket()
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind(("127.0.0.1", 0))
+        self._sock.listen(8)
+        self.port = self._sock.getsockname()[1]
+        self._stop = False
+        threading.Thread(target=self._accept, daemon=True,
+                         name="minicql").start()
+
+    def stop(self) -> None:
+        self._stop = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def _accept(self) -> None:
+        while not self._stop:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    @staticmethod
+    def _read_exact(conn, n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            chunk = conn.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError
+            buf += chunk
+        return buf
+
+    def _serve(self, conn) -> None:
+        def send(opcode: int, body: bytes) -> None:
+            conn.sendall(struct.pack(">BBhBI", 0x84, 0, 0, opcode,
+                                     len(body)) + body)
+
+        def err(msg: str) -> None:
+            b = msg.encode()
+            send(OP_ERROR, struct.pack(">i", 0x2200) +
+                 struct.pack(">H", len(b)) + b)
+
+        try:
+            with conn:
+                authed = not self.username
+                while True:
+                    hdr = self._read_exact(conn, 9)
+                    _, _, _, opcode, ln = struct.unpack(">BBhBI", hdr)
+                    body = self._read_exact(conn, ln)
+                    if opcode == OP_STARTUP:
+                        if authed:
+                            send(OP_READY, b"")
+                        else:
+                            mech = "org.apache.cassandra.auth.PasswordAuthenticator"
+                            send(OP_AUTHENTICATE,
+                                 struct.pack(">H", len(mech)) +
+                                 mech.encode())
+                    elif opcode == OP_AUTH_RESPONSE:
+                        (n,) = struct.unpack(">i", body[:4])
+                        parts = body[4:4 + n].split(b"\x00")
+                        if (len(parts) >= 3
+                                and parts[1].decode() == self.username
+                                and parts[2].decode() == self.password):
+                            authed = True
+                            send(OP_AUTH_SUCCESS, struct.pack(">i", -1))
+                        else:
+                            err("bad credentials")
+                            return
+                    elif opcode == OP_QUERY:
+                        if not authed:
+                            err("not authenticated")
+                            return
+                        self._query(send, err, body)
+                    else:
+                        err(f"unsupported opcode {opcode}")
+        except (ConnectionError, OSError, struct.error, ValueError):
+            pass
+
+    def _query(self, send, err, body: bytes) -> None:
+        (qlen,) = struct.unpack(">I", body[:4])
+        cql = body[4:4 + qlen].decode()
+        off = 4 + qlen + 2  # consistency
+        values: list[bytes] = []
+        if off < len(body) and body[off] & 0x01:
+            (n,) = struct.unpack(">H", body[off + 1:off + 3])
+            off += 3
+            for _ in range(n):
+                (ln,) = struct.unpack(">i", body[off:off + 4])
+                off += 4
+                values.append(body[off:off + max(ln, 0)])
+                off += max(ln, 0)
+        q = " ".join(cql.split())
+        with self.lock:
+            if q.startswith("CREATE TABLE") or q.startswith("CREATE KEYSPACE"):
+                return send(OP_RESULT, struct.pack(">i", 0x0001))  # Void
+            if q.startswith("USE "):
+                ks = q[4:].strip().encode()
+                return send(OP_RESULT, struct.pack(">i", 0x0003) +
+                            struct.pack(">H", len(ks)) + ks)
+            if q.startswith("INSERT INTO filemeta"):
+                d, name, meta = values
+                self.parts.setdefault(d.decode(), {})[name.decode()] = meta
+                return send(OP_RESULT, struct.pack(">i", 0x0001))
+            m = re.fullmatch(
+                r"SELECT meta FROM filemeta WHERE directory=\? AND name=\?",
+                q)
+            if m:
+                part = self.parts.get(values[0].decode(), {})
+                meta = part.get(values[1].decode())
+                rows = [(meta,)] if meta is not None else []
+                return send(OP_RESULT, _rows_body(["meta"], rows))
+            m = re.fullmatch(
+                r"SELECT name, meta FROM filemeta WHERE directory=\?"
+                r"(?: AND name(>=|>)\?)?(?: AND name<\?)? "
+                r"ORDER BY name ASC LIMIT \?", q)
+            if m:
+                part = self.parts.get(values[0].decode(), {})
+                vi = 1
+                lo_op = m.group(1)
+                lo = hi = None
+                if lo_op:
+                    lo = values[vi].decode()
+                    vi += 1
+                if " AND name<?" in q:
+                    hi = values[vi].decode()
+                    vi += 1
+                # LIMIT binds arrive as CQL int (4-byte big-endian)
+                limit = int.from_bytes(values[vi], "big")
+                names = sorted(part)
+                if lo is not None:
+                    names = [n for n in names
+                             if (n >= lo if lo_op == ">=" else n > lo)]
+                if hi is not None:
+                    names = [n for n in names if n < hi]
+                rows = [(n.encode(), part[n]) for n in names[:limit]]
+                return send(OP_RESULT, _rows_body(["name", "meta"], rows))
+            if re.fullmatch(r"DELETE FROM filemeta WHERE directory=\?"
+                            r" AND name=\?", q):
+                self.parts.get(values[0].decode(), {}).pop(
+                    values[1].decode(), None)
+                return send(OP_RESULT, struct.pack(">i", 0x0001))
+            if re.fullmatch(r"DELETE FROM filemeta WHERE directory=\?", q):
+                self.parts.pop(values[0].decode(), None)
+                return send(OP_RESULT, struct.pack(">i", 0x0001))
+        err(f"unsupported query: {q}")

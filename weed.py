@@ -117,6 +117,10 @@ def _make_filer_store(db: str):
         from seaweedfs_tpu.filer.mongo_store import MongoStore
 
         return MongoStore.from_url(db)
+    if db.startswith("cassandra://"):
+        from seaweedfs_tpu.filer.cassandra_store import CassandraStore
+
+        return CassandraStore.from_url(db)
     if db.endswith(".lsm"):
         # prefer the native C++ engine; the Python engine shares the
         # on-disk format, so falling back never strands a directory
@@ -368,6 +372,7 @@ _SCAFFOLDS = {
 #   sql:/path.db      abstract-SQL engine on embedded sqlite (bucket tables)
 #   elastic://host:port              elasticsearch REST (index per top dir)
 #   mongodb://[user:pw@]host:port/db mongo OP_MSG wire protocol
+#   cassandra://[user:pw@]host:port  CQL v4 binary protocol
 # Per-path rules (collection, replication, ttl, fsync) live IN the
 # filesystem at /etc/seaweedfs/filer.conf — edit with `fs.configure`.
 ''',
@@ -913,6 +918,7 @@ def main(argv=None) -> None:
                          "etcd://host:port, postgres://user:pw@host:port/db, "
                          "sql:/path.db -> abstract-SQL sqlite, "
                          "elastic://host:port, mongodb://host:port/db, "
+                         "cassandra://host:port, "
                          "*.lsm -> LSM store dir, else "
                          "sqlite path (default: memory)")
     fl.add_argument("-peers", default="",
