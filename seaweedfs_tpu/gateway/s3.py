@@ -13,6 +13,7 @@ means an open gateway, the reference's no-config behavior.
 
 from __future__ import annotations
 
+import re as _re
 import secrets
 import time
 import urllib.parse
@@ -51,8 +52,6 @@ def parse_form_data(body: bytes, content_type: str) -> dict:
     """Minimal multipart/form-data parser for POST uploads: returns
     {field: str} plus {"file": bytes, "file.name": str} for the file
     part.  Per the S3 contract, fields after `file` are ignored."""
-    import re as _re
-
     m = _re.search(r'boundary="?([^";]+)"?', content_type)
     if not m:
         raise ValueError("no multipart boundary")
@@ -257,12 +256,17 @@ class S3ApiServer:
         @r.route("PUT", "/([a-z0-9][a-z0-9.-]+)")
         def put_bucket(req: Request) -> Response:
             self._auth(req, ACTION_ADMIN, req.match.group(1))
-            for sub in ("lifecycle", "cors", "policy"):
+            for sub in ("lifecycle", "cors", "policy", "object-lock"):
                 if sub in req.query:
                     # reference parity: write sides are NotImplemented
                     # (s3api_bucket_handlers.go:301, skip_handlers)
                     return _err(501, "NotImplemented",
                                 f"Put bucket {sub} is not implemented")
+            if "acl" in req.query:
+                # accepted, canned (ref stubs) — but never on a bucket
+                # that does not exist, and never creating one
+                self._require_bucket(req.match.group(1))
+                return Response(raw=b"")
             self.fs.filer._ensure_parents(self._bucket_path(req.match.group(1)))
             return Response(raw=b"", headers={"Location": "/" + req.match.group(1)})
 
@@ -317,6 +321,14 @@ class S3ApiServer:
                                   xmlns=S3_NS)
                 ET.SubElement(root, "Payer").text = "BucketOwner"
                 return _xml(root)
+            if "acl" in req.query:
+                # canned FULL_CONTROL, like the reference's
+                # GetBucketAclHandler
+                return _canned_acl()
+            if "object-lock" in req.query:
+                return _err(404, "ObjectLockConfigurationNotFoundError",
+                            "Object Lock configuration does not exist "
+                            "for this bucket")
             if "uploads" in req.query:
                 return self._list_multipart_uploads(bucket)
             prefix = req.query.get("prefix", "")
@@ -421,9 +433,18 @@ class S3ApiServer:
                 return self._put_tagging(req, bucket, key)
             if "acl" in req.query:
                 return Response(raw=b"")  # accepted, canned (ref stubs too)
-            if "partNumber" in req.query and "uploadId" in req.query:
-                return self._upload_part(req, bucket, key)
+            if any(sub in req.query for sub in
+                   ("retention", "legal-hold")):
+                # reference parity: object-lock surfaces are
+                # NotImplemented (s3api_object_skip_handlers.go:25-47)
+                return _err(501, "NotImplemented",
+                            "object lock is not implemented")
             copy_source = req.headers.get("X-Amz-Copy-Source", "")
+            if "partNumber" in req.query and "uploadId" in req.query:
+                if copy_source:
+                    return self._upload_part_copy(req, bucket, key,
+                                                  copy_source)
+                return self._upload_part(req, bucket, key)
             if copy_source:
                 return self._copy_object(req, bucket, key, copy_source)
             mime = req.headers.get("Content-Type", "")
@@ -442,6 +463,10 @@ class S3ApiServer:
             self._auth(req, ACTION_READ, req.match.group(1),
                        req.match.group(2))
             bucket, key = req.match.group(1), req.match.group(2)
+            if any(sub in req.query for sub in
+                   ("retention", "legal-hold")):
+                return _err(501, "NotImplemented",
+                            "object lock is not implemented")
             if "uploadId" in req.query and req.handler.command == "GET":
                 return self._list_parts(req, bucket, key)
             if "tagging" in req.query:
@@ -449,18 +474,7 @@ class S3ApiServer:
             if "acl" in req.query:
                 # canned ACL (the reference's ACL handlers are stubs too):
                 # SDKs call this during sync/cp; FULL_CONTROL for the owner
-                root = ET.Element("AccessControlPolicy", xmlns=S3_NS)
-                owner = ET.SubElement(root, "Owner")
-                ET.SubElement(owner, "ID").text = "seaweedfs-tpu"
-                acl = ET.SubElement(root, "AccessControlList")
-                grant = ET.SubElement(acl, "Grant")
-                grantee = ET.SubElement(grant, "Grantee")
-                grantee.set("xmlns:xsi",
-                            "http://www.w3.org/2001/XMLSchema-instance")
-                grantee.set("xsi:type", "CanonicalUser")
-                ET.SubElement(grantee, "ID").text = "seaweedfs-tpu"
-                ET.SubElement(grant, "Permission").text = "FULL_CONTROL"
-                return _xml(root)
+                return _canned_acl()
             try:
                 entry = self.fs.filer.find_entry(self._object_path(bucket, key))
             except FilerNotFound:
@@ -599,6 +613,44 @@ class S3ApiServer:
         entry = self.fs.put_file(f"{UPLOADS_PATH}/{upload_id}/{part:05d}.part",
                                  req.body)
         return Response(raw=b"", headers={"ETag": f'"{entry.attr.md5}"'})
+
+    def _upload_part_copy(self, req: Request, bucket: str, key: str,
+                          copy_source: str) -> Response:
+        """UploadPartCopy (ref s3api_object_copy_handlers.go:116
+        CopyObjectPartHandler): a multipart part sourced from an
+        existing object, optionally a byte range."""
+        self._upload_meta(req)
+        upload_id = req.query["uploadId"]
+        part = int(req.query["partNumber"])
+        src = urllib.parse.unquote(copy_source).lstrip("/")
+        src_bucket, _, src_key = src.partition("/")
+        # the SOURCE needs its own read grant, or write access to one
+        # bucket exfiltrates any other bucket's data through a copy
+        self._auth(req, ACTION_READ, src_bucket, src_key)
+        try:
+            src_entry = self.fs.filer.find_entry(
+                self._object_path(src_bucket, src_key))
+        except FilerNotFound:
+            return _err(404, "NoSuchKey", src)
+        rng = req.headers.get("X-Amz-Copy-Source-Range", "")
+        if rng:
+            m = _re.fullmatch(r"bytes=(\d+)-(\d+)", rng.strip())
+            if not m:
+                return _err(400, "InvalidArgument",
+                            f"bad copy source range {rng!r}")
+            lo, hi = int(m.group(1)), int(m.group(2))
+            if lo > hi or hi >= src_entry.file_size:
+                return _err(416, "InvalidRange", rng)
+            data = self.fs.read_chunks(src_entry, offset=lo,
+                                       size=hi - lo + 1)
+        else:
+            data = self.fs.read_chunks(src_entry)
+        entry = self.fs.put_file(
+            f"{UPLOADS_PATH}/{upload_id}/{part:05d}.part", data)
+        root = ET.Element("CopyPartResult", xmlns=S3_NS)
+        ET.SubElement(root, "ETag").text = f'"{entry.attr.md5}"'
+        ET.SubElement(root, "LastModified").text = _iso(entry.attr.mtime)
+        return _xml(root)
 
     def _complete_multipart(self, req: Request, bucket: str, key: str) -> Response:
         meta = self._upload_meta(req)
@@ -846,6 +898,8 @@ class S3ApiServer:
                      copy_source: str) -> Response:
         src = urllib.parse.unquote(copy_source).lstrip("/")
         src_bucket, _, src_key = src.partition("/")
+        # read grant on the SOURCE bucket too (see _upload_part_copy)
+        self._auth(req, ACTION_READ, src_bucket, src_key)
         try:
             src_entry = self.fs.filer.find_entry(
                 self._object_path(src_bucket, src_key))
@@ -870,3 +924,19 @@ class S3ApiServer:
 
 def _iso(ts: float) -> str:
     return time.strftime("%Y-%m-%dT%H:%M:%S.000Z", time.gmtime(ts))
+
+
+def _canned_acl() -> Response:
+    """FULL_CONTROL-for-owner ACL document (the reference's bucket and
+    object ACL handlers serve the same canned shape)."""
+    root = ET.Element("AccessControlPolicy", xmlns=S3_NS)
+    owner = ET.SubElement(root, "Owner")
+    ET.SubElement(owner, "ID").text = "seaweedfs-tpu"
+    acl = ET.SubElement(root, "AccessControlList")
+    grant = ET.SubElement(acl, "Grant")
+    grantee = ET.SubElement(grant, "Grantee")
+    grantee.set("xmlns:xsi", "http://www.w3.org/2001/XMLSchema-instance")
+    grantee.set("xsi:type", "CanonicalUser")
+    ET.SubElement(grantee, "ID").text = "seaweedfs-tpu"
+    ET.SubElement(grant, "Permission").text = "FULL_CONTROL"
+    return _xml(root)

@@ -707,3 +707,74 @@ def test_lifecycle_delete_clears_ttl_rules(s3):
     # absent bucket: subresource deletes are 404, not a quiet 204
     status, body, _ = _req(s3, "DELETE", "/nosuchbkt?lifecycle")
     assert status == 404
+
+
+def test_upload_part_copy_with_range(s3):
+    """UploadPartCopy: multipart parts sourced from an existing object,
+    including byte ranges (ref CopyObjectPartHandler)."""
+    _req(s3, "PUT", "/upc")
+    payload = bytes(range(256)) * 40  # 10240 bytes
+    _req(s3, "PUT", "/upc/source.bin", body=payload)
+    st, body, _ = _req(s3, "POST", "/upc/target.bin?uploads")
+    upload_id = ET.fromstring(body).findtext(f"{NS}UploadId")
+    # part 1: first half via range copy; part 2: rest via range copy
+    st, body, _ = _req(
+        s3, "PUT", f"/upc/target.bin?partNumber=1&uploadId={upload_id}",
+        headers={"X-Amz-Copy-Source": "/upc/source.bin",
+                 "X-Amz-Copy-Source-Range": "bytes=0-5119"})
+    assert st == 200 and b"CopyPartResult" in body, body
+    st, body, _ = _req(
+        s3, "PUT", f"/upc/target.bin?partNumber=2&uploadId={upload_id}",
+        headers={"X-Amz-Copy-Source": "/upc/source.bin",
+                 "X-Amz-Copy-Source-Range": "bytes=5120-10239"})
+    assert st == 200 and b"CopyPartResult" in body
+    # bad range is a 416
+    st, body, _ = _req(
+        s3, "PUT", f"/upc/target.bin?partNumber=3&uploadId={upload_id}",
+        headers={"X-Amz-Copy-Source": "/upc/source.bin",
+                 "X-Amz-Copy-Source-Range": "bytes=9000-99999"})
+    assert st == 416
+    complete = (
+        '<CompleteMultipartUpload>'
+        '<Part><PartNumber>1</PartNumber></Part>'
+        '<Part><PartNumber>2</PartNumber></Part>'
+        '</CompleteMultipartUpload>')
+    st, body, _ = _req(
+        s3, "POST", f"/upc/target.bin?uploadId={upload_id}",
+        body=complete.encode())
+    assert st == 200, body
+    st, got, _ = _req(s3, "GET", "/upc/target.bin")
+    assert st == 200 and got == payload
+
+
+def test_object_lock_surfaces_not_implemented(s3):
+    _req(s3, "PUT", "/olk")
+    _req(s3, "PUT", "/olk/obj.bin", body=b"data")
+    for sub in ("retention", "legal-hold"):
+        st, body, _ = _req(s3, "PUT", f"/olk/obj.bin?{sub}", body=b"<X/>")
+        assert st == 501 and b"NotImplemented" in body, (sub, body)
+        # GET sides must not fall through to serving the object body
+        st, body, _ = _req(s3, "GET", f"/olk/obj.bin?{sub}")
+        assert st == 501 and b"NotImplemented" in body, (sub, body)
+    # object-lock is a BUCKET subresource
+    st, body, _ = _req(s3, "PUT", "/olk?object-lock", body=b"<X/>")
+    assert st == 501 and b"NotImplemented" in body
+    st, body, _ = _req(s3, "GET", "/olk?object-lock")
+    assert st == 404 and b"ObjectLockConfigurationNotFoundError" in body
+
+
+def test_bucket_acl_get_and_put(s3):
+    _req(s3, "PUT", "/aclbkt")
+    st, body, _ = _req(s3, "GET", "/aclbkt?acl")
+    assert st == 200
+    doc = ET.fromstring(body)
+    assert doc.findtext(
+        f"{NS}AccessControlList/{NS}Grant/{NS}Permission") == "FULL_CONTROL"
+    st, _, _ = _req(s3, "PUT", "/aclbkt?acl",
+                    body=b"<AccessControlPolicy/>")
+    assert st == 200
+    # ?acl must never fall through to the object listing, and must not
+    # conjure missing buckets into existence
+    assert b"ListBucketResult" not in body
+    st, body, _ = _req(s3, "PUT", "/nosuchacl?acl", body=b"<X/>")
+    assert st == 404
