@@ -614,6 +614,25 @@ class S3ApiServer:
                                  req.body)
         return Response(raw=b"", headers={"ETag": f'"{entry.attr.md5}"'})
 
+    def _resolve_copy_source(self, req: Request, copy_source: str):
+        """(src_bucket, src_key, entry) for an X-Amz-Copy-Source header,
+        with the source's own READ authorization.  Raises/returns the
+        S3-shaped errors; copies always RE-UPLOAD the bytes — sharing
+        the source's chunk fids would break the moment either object is
+        deleted (no chunk refcounting; the reference proxies bytes for
+        the same reason)."""
+        src = urllib.parse.unquote(copy_source).lstrip("/")
+        src_bucket, _, src_key = src.partition("/")
+        # the SOURCE needs its own read grant, or write access to one
+        # bucket exfiltrates any other bucket's data through a copy
+        self._auth(req, ACTION_READ, src_bucket, src_key)
+        try:
+            entry = self.fs.filer.find_entry(
+                self._object_path(src_bucket, src_key))
+        except FilerNotFound:
+            raise HttpError(404, "NoSuchKey")
+        return src_bucket, src_key, entry
+
     def _upload_part_copy(self, req: Request, bucket: str, key: str,
                           copy_source: str) -> Response:
         """UploadPartCopy (ref s3api_object_copy_handlers.go:116
@@ -622,16 +641,7 @@ class S3ApiServer:
         self._upload_meta(req)
         upload_id = req.query["uploadId"]
         part = int(req.query["partNumber"])
-        src = urllib.parse.unquote(copy_source).lstrip("/")
-        src_bucket, _, src_key = src.partition("/")
-        # the SOURCE needs its own read grant, or write access to one
-        # bucket exfiltrates any other bucket's data through a copy
-        self._auth(req, ACTION_READ, src_bucket, src_key)
-        try:
-            src_entry = self.fs.filer.find_entry(
-                self._object_path(src_bucket, src_key))
-        except FilerNotFound:
-            return _err(404, "NoSuchKey", src)
+        _, _, src_entry = self._resolve_copy_source(req, copy_source)
         rng = req.headers.get("X-Amz-Copy-Source-Range", "")
         if rng:
             m = _re.fullmatch(r"bytes=(\d+)-(\d+)", rng.strip())
@@ -896,15 +906,7 @@ class S3ApiServer:
 
     def _copy_object(self, req: Request, bucket: str, key: str,
                      copy_source: str) -> Response:
-        src = urllib.parse.unquote(copy_source).lstrip("/")
-        src_bucket, _, src_key = src.partition("/")
-        # read grant on the SOURCE bucket too (see _upload_part_copy)
-        self._auth(req, ACTION_READ, src_bucket, src_key)
-        try:
-            src_entry = self.fs.filer.find_entry(
-                self._object_path(src_bucket, src_key))
-        except FilerNotFound:
-            return _err(404, "NoSuchKey", src)
+        _, _, src_entry = self._resolve_copy_source(req, copy_source)
         data = self.fs.read_chunks(src_entry)
         # metadata directive: COPY (default) carries the source's
         # x-amz-meta-*, REPLACE takes the request's headers instead

@@ -130,3 +130,16 @@ def test_scaffold_and_version():
                  "notification", "shell"):
         r = _run("scaffold", "-config", name)
         assert r.returncode == 0 and r.stdout.strip(), name
+
+
+def test_autocomplete_emits_bash_completion(capsys):
+    import weed
+
+    try:
+        weed.main(["autocomplete"])
+    except SystemExit:
+        pass
+    out = capsys.readouterr().out
+    assert "complete -F _weed_complete" in out
+    for cmd in ("master", "volume", "filer", "benchmark", "shell"):
+        assert cmd in out

@@ -770,11 +770,11 @@ def test_bucket_acl_get_and_put(s3):
     doc = ET.fromstring(body)
     assert doc.findtext(
         f"{NS}AccessControlList/{NS}Grant/{NS}Permission") == "FULL_CONTROL"
-    st, _, _ = _req(s3, "PUT", "/aclbkt?acl",
-                    body=b"<AccessControlPolicy/>")
+    st, put_body, _ = _req(s3, "PUT", "/aclbkt?acl",
+                           body=b"<AccessControlPolicy/>")
     assert st == 200
     # ?acl must never fall through to the object listing, and must not
     # conjure missing buckets into existence
-    assert b"ListBucketResult" not in body
+    assert b"ListBucketResult" not in put_body
     st, body, _ = _req(s3, "PUT", "/nosuchacl?acl", body=b"<X/>")
     assert st == 404

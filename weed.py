@@ -461,6 +461,20 @@ def cmd_version(args) -> None:
     print(VERSION)
 
 
+def cmd_autocomplete(args, subcommands=None) -> None:
+    """Print a bash completion script for the CLI (command/autocomplete.go
+    analog; `source <(python weed.py autocomplete)` to enable)."""
+    cmds = " ".join(sorted(subcommands or _SUBCOMMANDS))
+    print(f"""\
+_weed_complete() {{
+    local cur="${{COMP_WORDS[COMP_CWORD]}}"
+    if [ "$COMP_CWORD" -eq 1 ]; then
+        COMPREPLY=( $(compgen -W "{cmds}" -- "$cur") )
+    fi
+}}
+complete -F _weed_complete weed.py weed""")
+
+
 def cmd_scaffold(args) -> None:
     """Emit commented config templates (command/scaffold.go)."""
     conf = _SCAFFOLDS.get(args.config)
@@ -850,6 +864,9 @@ def _wait_forever() -> None:
             time.sleep(3600)
 
 
+_SUBCOMMANDS: list = []
+
+
 def main(argv=None) -> None:
     p = argparse.ArgumentParser(prog="weed.py", description=__doc__)
     p.add_argument("-v", type=int, default=0, metavar="LEVEL",
@@ -1094,6 +1111,11 @@ def main(argv=None) -> None:
     mb.add_argument("-peers", default="", help="other broker host:ports")
     mb.set_defaults(fn=cmd_msg_broker)
 
+    ac = sub.add_parser("autocomplete")
+    # bind the live choices dict: it reflects every parser registered
+    # by dispatch time, with no reliance on the module-global side set
+    ac.set_defaults(fn=lambda a: cmd_autocomplete(a, list(sub.choices)))
+
     sh = sub.add_parser("shell")
     sh.add_argument("-master", default="127.0.0.1:9333")
     sh.add_argument("-filer", default="", help="filer host:port for fs.* commands")
@@ -1126,6 +1148,7 @@ def main(argv=None) -> None:
                    help="write: save fids here; read: load fids from here")
     b.set_defaults(fn=cmd_benchmark)
 
+    _SUBCOMMANDS[:] = list(sub.choices)
     args = p.parse_args(argv)
     from seaweedfs_tpu.utils import glog, grace
 
