@@ -209,6 +209,16 @@ def cmd_server(args) -> None:
 
             dav = WebDavServer(f, host=args.ip, port=args.webdavPort).start()
             print(f"webdav on {dav.url}")
+        if args.iam:
+            from seaweedfs_tpu.gateway.iam import IamApiServer
+
+            iam = IamApiServer(f, host=args.ip, port=args.iamPort).start()
+            print(f"iam on {iam.url}")
+        if args.ftp:
+            from seaweedfs_tpu.gateway.ftp import FtpServer
+
+            ftp = FtpServer(f, host=args.ip, port=args.ftpPort).start()
+            print(f"ftp on {ftp.url}")
     _wait_forever()
 
 
@@ -917,6 +927,10 @@ def main(argv=None) -> None:
     s.add_argument("-s3Port", type=int, default=8333)
     s.add_argument("-webdav", action="store_true")
     s.add_argument("-webdavPort", type=int, default=7333)
+    s.add_argument("-iam", action="store_true")
+    s.add_argument("-iamPort", type=int, default=8111)
+    s.add_argument("-ftp", action="store_true")
+    s.add_argument("-ftpPort", type=int, default=8021)
     s.add_argument("-ec.engine", dest="ec_engine", default="cpu",
                    choices=["cpu", "tpu"])
     s.add_argument("-mmap", action="store_true",
