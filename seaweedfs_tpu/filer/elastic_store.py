@@ -167,14 +167,26 @@ class ElasticStore:
         self._req("DELETE", f"/{KV_INDEX}/_doc/{key.hex()}?refresh=true")
 
     def kv_scan(self, prefix: bytes) -> Iterator[tuple[bytes, bytes]]:
+        # search_after paging, same shape as list_directory_entries —
+        # a single capped _search would silently truncate past 10k keys
         lo = prefix.hex()
         musts: list[dict] = [{"prefix": {"Key": lo}}] if lo else []
-        query = {"query": {"bool": {"must": musts}} if musts
-                 else {"match_all": {}},
-                 "sort": [{"Key": "asc"}], "size": 10000}
-        status, out = self._req("POST", f"/{KV_INDEX}/_search", query)
-        if status == 404:
-            return
-        for h in out.get("hits", {}).get("hits", []):
-            src = h["_source"]
-            yield bytes.fromhex(src["Key"]), bytes.fromhex(src["Value"])
+        after = None
+        while True:
+            query = {"query": {"bool": {"must": musts}} if musts
+                     else {"match_all": {}},
+                     "sort": [{"Key": "asc"}], "size": PAGE}
+            if after is not None:
+                query["search_after"] = after
+            status, out = self._req("POST", f"/{KV_INDEX}/_search", query)
+            if status == 404:
+                return
+            hits = out.get("hits", {}).get("hits", [])
+            if not hits:
+                return
+            for h in hits:
+                src = h["_source"]
+                yield bytes.fromhex(src["Key"]), bytes.fromhex(src["Value"])
+            if len(hits) < PAGE:
+                return  # short page: exhausted, skip the empty round-trip
+            after = hits[-1].get("sort") or [hits[-1]["_source"]["Key"]]

@@ -89,6 +89,17 @@ class FtpServer:
     def url(self) -> str:
         return f"{self.host}:{self.port}"
 
+    def _find(self, path: str):
+        """find_entry raises NotFoundError rather than returning None;
+        flatten that to None so command handlers can render their own
+        550 message (550 codes differ per verb in the RFC)."""
+        from ..filer.filer import NotFoundError
+
+        try:
+            return self.fs.filer.find_entry(path)
+        except NotFoundError:
+            return None
+
     def start(self) -> "FtpServer":
         self._sock = socket.socket()
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -180,7 +191,7 @@ class FtpServer:
 
     def _cmd_cwd(self, s: _Session, arg: str) -> None:
         p = s.path(arg)
-        e = self.fs.filer.find_entry(p) if p != "/" else None
+        e = self._find(p) if p != "/" else None
         if p != "/" and (e is None or not e.is_directory):
             s.send("550 no such directory")
             return
@@ -248,14 +259,14 @@ class FtpServer:
 
     # --- files ------------------------------------------------------------
     def _cmd_size(self, s: _Session, arg: str) -> None:
-        e = self.fs.filer.find_entry(s.path(arg))
+        e = self._find(s.path(arg))
         if e is None or e.is_directory:
             s.send("550 not a file")
             return
         s.send(f"213 {e.file_size}")
 
     def _cmd_mdtm(self, s: _Session, arg: str) -> None:
-        e = self.fs.filer.find_entry(s.path(arg))
+        e = self._find(s.path(arg))
         if e is None:
             s.send("550 not found")
             return
@@ -267,7 +278,7 @@ class FtpServer:
         s.send(f"350 restarting at {s.rest}")
 
     def _cmd_retr(self, s: _Session, arg: str) -> None:
-        e = self.fs.filer.find_entry(s.path(arg))
+        e = self._find(s.path(arg))
         if e is None or e.is_directory:
             s.send("550 not a file")
             return
@@ -301,7 +312,7 @@ class FtpServer:
         if offset:
             # resumed upload (REST n + STOR): splice over the existing
             # bytes instead of replacing the file with just the tail
-            e = self.fs.filer.find_entry(path)
+            e = self._find(path)
             old = self.fs.read_chunks(e) if e is not None \
                 and not e.is_directory else b""
             body = old[:offset].ljust(offset, b"\x00") + body
@@ -323,7 +334,7 @@ class FtpServer:
 
     def _cmd_rnfr(self, s: _Session, arg: str) -> None:
         p = s.path(arg)
-        if self.fs.filer.find_entry(p) is None:
+        if self._find(p) is None:
             s.send("550 not found")
             return
         s.rnfr = p

@@ -156,6 +156,20 @@ class S3ApiServer:
             return _err(e.status, e.code, str(e))
         if isinstance(e, FilerNotFound):
             return _err(404, "NoSuchKey", str(e))
+        if isinstance(e, HttpError):
+            # every handler-raised HttpError is an S3 protocol error —
+            # the router's default JSON rendering breaks strict clients
+            # that parse <Error><Code> (e.g. ?tagging on a missing key,
+            # NoSuchUpload on a bad uploadId)
+            default = {400: "InvalidRequest", 403: "AccessDenied",
+                       404: "NoSuchKey"}.get(e.status, "InternalError")
+            # handlers raise either a bare S3 code ("NoSuchUpload") or
+            # prose; only code-shaped tokens pass through as <Code> —
+            # lower layers (etcd_store) raise HttpError with arbitrary
+            # response bodies that must not become the code element
+            code = e.message if e.message and \
+                _re.fullmatch(r"[A-Za-z]{1,64}", e.message) else default
+            return _err(e.status, code, str(e))
         return None  # default JSON mapping
 
     def authenticate(self, req: Request) -> str:
@@ -626,11 +640,10 @@ class S3ApiServer:
         # the SOURCE needs its own read grant, or write access to one
         # bucket exfiltrates any other bucket's data through a copy
         self._auth(req, ACTION_READ, src_bucket, src_key)
-        try:
-            entry = self.fs.filer.find_entry(
-                self._object_path(src_bucket, src_key))
-        except FilerNotFound:
-            raise HttpError(404, "NoSuchKey")
+        # FilerNotFound propagates: _map_error renders it as the S3
+        # <Error><Code>NoSuchKey</Code> XML document strict clients parse
+        entry = self.fs.filer.find_entry(
+            self._object_path(src_bucket, src_key))
         return src_bucket, src_key, entry
 
     def _upload_part_copy(self, req: Request, bucket: str, key: str,

@@ -64,6 +64,15 @@ class SuperBlock:
             return SUPER_BLOCK_SIZE + len(self.extra)
         return SUPER_BLOCK_SIZE
 
+    @property
+    def offset_size(self) -> int:
+        """4 or 5: idx/needle-map offset width.  Extra-byte bit0 is the
+        per-volume 5-byte-offset flag (the reference's 5BytesOffset
+        build tag made per-volume; ref: weed/storage/types/
+        offset_5bytes.go) — the single decode point for volume load and
+        the debug tools."""
+        return 5 if (self.extra and self.extra[0] & 1) else 4
+
     def to_bytes(self) -> bytes:
         header = bytearray(SUPER_BLOCK_SIZE)
         header[0] = int(self.version)

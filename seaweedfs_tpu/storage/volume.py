@@ -159,8 +159,7 @@ class Volume:
             self.version = self.super_block.version
             # the offset width is a persisted property of the volume: an
             # existing superblock overrides the constructor argument
-            extra = self.super_block.extra
-            self.offset_size = 5 if (extra and extra[0] & 1) else 4
+            self.offset_size = self.super_block.offset_size
         if not self.tiered:
             self._check_integrity()
         self.nm = _NEEDLE_MAP_KINDS.get(

@@ -1,6 +1,11 @@
-"""see_idx: print every 16-byte entry of a `.idx` / `.ecx` index file.
+"""see_idx: print every entry of a `.idx` / `.ecx` index file.
 
 Equivalent of /root/reference/unmaintained/see_idx/see_idx.go.
+
+Entries are 16 bytes (4-byte offsets) or 17 bytes (5-byte offsets for
+>32GB volumes).  The 5-byte flag lives in the sibling `.dat` superblock
+extra byte; when the `.dat` is present it is sniffed automatically, and
+`-offset5` forces it for orphaned index files.
 
     python -m seaweedfs_tpu.tools.see_idx /path/to/1.idx
 """
@@ -8,22 +13,41 @@ Equivalent of /root/reference/unmaintained/see_idx/see_idx.go.
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 
 from ..storage import idx as idx_mod
 from ..storage.types import TOMBSTONE_FILE_SIZE
 
 
+def sniff_offset_size(idx_path: str) -> int:
+    """4 or 5, from the sibling .dat superblock extra flag (volume.py
+    load path reads the same bit); 4 when no .dat is present."""
+    dat = os.path.splitext(idx_path)[0] + ".dat"
+    try:
+        from ..storage.super_block import SuperBlock
+
+        with open(dat, "rb") as f:
+            return SuperBlock.from_bytes(f.read(1024)).offset_size
+    except (OSError, ValueError):
+        return 4
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("idx", help="path to a .idx or .ecx file")
+    ap.add_argument("-offset5", action="store_true",
+                    help="force 5-byte offsets (17-byte entries); "
+                         "default sniffs the sibling .dat superblock")
     args = ap.parse_args(argv)
+    offset_size = 5 if args.offset5 else sniff_offset_size(args.idx)
     n = 0
-    for key, offset, size in idx_mod.iter_index_file(args.idx):
+    for key, offset, size in idx_mod.iter_index_file(
+            args.idx, offset_size=offset_size):
         mark = " TOMBSTONE" if size == TOMBSTONE_FILE_SIZE else ""
         print(f"key {key:>12} offset {offset:>12} size {size:>10}{mark}")
         n += 1
-    print(f"{n} entries")
+    print(f"{n} entries ({offset_size}-byte offsets)")
     return 0
 
 

@@ -70,6 +70,29 @@ def test_see_dat_and_see_idx_on_reference_fixture(capsys):
     assert "needle records" in out
 
 
+def test_see_idx_five_byte_offsets(tmp_path, capsys):
+    """17-byte entries from a 5-byte-offset volume parse correctly: via
+    the -offset5 flag and via auto-sniff of the sibling .dat superblock
+    extra flag (the 4-byte default would print garbage keys)."""
+    from seaweedfs_tpu.storage.needle import Needle
+    from seaweedfs_tpu.storage.volume import Volume
+    from seaweedfs_tpu.tools import see_idx
+
+    v = Volume(str(tmp_path), "", 7, offset_5=True)
+    for k in (11, 22, 33):
+        v.write_needle(Needle(cookie=k, id=k, data=b"x" * 32))
+    v.close()
+    idx_path = str(tmp_path / "7.idx")
+    assert see_idx.main([idx_path, "-offset5"]) == 0
+    out = capsys.readouterr().out
+    assert "3 entries (5-byte offsets)" in out
+    for k in (11, 22, 33):
+        assert f"key {k:>12}" in out
+    # sniffed from the sibling .dat, no flag needed
+    assert see_idx.main([idx_path]) == 0
+    assert "3 entries (5-byte offsets)" in capsys.readouterr().out
+
+
 def test_remote_gateway_maps_buckets(trio, tmp_path):
     from seaweedfs_tpu.gateway.s3 import S3ApiServer
     from seaweedfs_tpu.remote_storage.gateway import RemoteGateway

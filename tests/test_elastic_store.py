@@ -101,6 +101,22 @@ def test_delete_folder_children_recursive(store):
     assert store.find_entry("/other/f4") is not None
 
 
+def test_kv_scan_pages_past_search_cap(store):
+    """kv_scan uses the same search_after loop as directory listings —
+    a single capped _search would silently truncate large scans."""
+    import seaweedfs_tpu.filer.elastic_store as es_mod
+
+    for i in range(25):
+        store.kv_put(f"pk{i:03d}".encode(), f"v{i}".encode())
+    old_page, es_mod.PAGE = es_mod.PAGE, 10  # force 3 pages
+    try:
+        got = list(store.kv_scan(b"pk"))
+    finally:
+        es_mod.PAGE = old_page
+    assert got == [(f"pk{i:03d}".encode(), f"v{i}".encode())
+                   for i in range(25)]
+
+
 def test_kv_roundtrip_and_scan(store):
     store.kv_put(b"k1", b"\x00\xffbin")
     store.kv_put(b"k2", b"v2")

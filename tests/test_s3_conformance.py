@@ -327,6 +327,13 @@ def test_copy_object(s3):
     st, _, h = _req(s3, "HEAD", "/cpb/dst2.txt")
     assert h.get("x-amz-meta-shape") == "round"
     assert h.get("x-amz-meta-color") is None
+    # a missing copy source renders the S3 XML error document (strict
+    # clients parse <Error><Code> on CopyObject failures), not JSON
+    st, body, _ = _req(s3, "PUT", "/cpb/dst3.txt",
+                       headers={"X-Amz-Copy-Source": "/cpb/missing.txt"})
+    assert st == 404
+    assert body.lstrip().startswith(b"<?xml") or body.lstrip().startswith(b"<Error")
+    assert b"<Code>NoSuchKey</Code>" in body
 
 
 # --- tagging + acl ----------------------------------------------------------

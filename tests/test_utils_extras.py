@@ -224,6 +224,24 @@ def test_ftp_rest_stor_resumes_upload(tmp_path):
         out = io.BytesIO()
         ftp.retrbinary("RETR f.bin", out.write)
         assert out.getvalue() == full[:600] + b"TAIL" * 10
+        # REST+STOR to a file that does not exist yet: the splice path
+        # zero-pads the gap instead of 550ing (find_entry raises
+        # NotFoundError; the handler must flatten it, not crash on it)
+        ftp.storbinary("STOR fresh.bin", io.BytesIO(b"XY"), rest=4)
+        out3 = io.BytesIO()
+        ftp.retrbinary("RETR fresh.bin", out3.write)
+        assert out3.getvalue() == b"\x00\x00\x00\x00XY"
+        # missing paths get the handler's own 550 text, not a generic
+        # exception-name fallback
+        import pytest as _pytest
+        with _pytest.raises(ftplib.error_perm, match="550 no such directory"):
+            ftp.cwd("/nope")
+        with _pytest.raises(ftplib.error_perm, match="550 not a file"):
+            ftp.size("missing.bin")
+        with _pytest.raises(ftplib.error_perm, match="550 not found"):
+            ftp.sendcmd("MDTM missing.bin")
+        with _pytest.raises(ftplib.error_perm, match="550 not found"):
+            ftp.rename("missing.bin", "x.bin")
         ftp.quit()
     finally:
         srv.stop()
