@@ -211,7 +211,7 @@ def _child(scratch_path: str, platform: str = "") -> None:
     # run on BOTH a tmpfs and the default scratch disk: the delta
     # separates pipeline cost from storage-medium cost (round-2 verdict:
     # "nothing separates disk-bound from pipeline-overhead-bound")
-    def _e2e_one(base_dir, size_mb, reps=2):
+    def _e2e_one(base_dir, size_mb, reps=2, **enc_kw):
         from seaweedfs_tpu.ec.streaming import StreamingEncoder
 
         raw = rng.integers(0, 256, size_mb << 20, dtype=np.uint8).tobytes()
@@ -219,7 +219,7 @@ def _child(scratch_path: str, platform: str = "") -> None:
             dat = os.path.join(td, "1.dat")
             with open(dat, "wb") as f:
                 f.write(raw)
-            enc = StreamingEncoder(10, 4)
+            enc = StreamingEncoder(10, 4, **enc_kw)
             enc.encode_file(dat, os.path.join(td, "1"))  # warm compile+pages
             best_dt, stats = float("inf"), None
             for _ in range(reps):
@@ -251,6 +251,24 @@ def _child(scratch_path: str, platform: str = "") -> None:
             kern = detail.get("cpu_simd_mbps")
             if kern and not on_tpu:
                 detail["e2e_tmpfs_vs_kernel"] = round(mbps / kern, 3)
+            if not on_tpu:
+                # the overlap-worker claim, MEASURED (round-3 verdict):
+                # staged pipeline with no worker vs with the process
+                # worker over shared memory (ec/overlap.py) — same
+                # mechanism a multicore host would use via threads.  On
+                # 1 core the processes timeslice, so ~1.0x is the honest
+                # expectation; >1.1x only appears with a second core.
+                ov_mb = min(size_mb, 128)
+                off_mbps, _ = _e2e_one(shm, ov_mb, reps=1,
+                                       zero_copy=False, overlap="none")
+                on_mbps, _ = _e2e_one(shm, ov_mb, reps=1,
+                                      overlap="process")
+                detail["overlap_worker"] = {
+                    "pipeline_off_mbps": off_mbps,
+                    "pipeline_process_mbps": on_mbps,
+                    "speedup": round(on_mbps / off_mbps, 3),
+                    "cores": os.cpu_count() or 1,
+                }
         disk_mb = size_mb if on_tpu else 32
         mbps, pipe = _e2e_one(None, disk_mb)
         pipe["size_mb"] = disk_mb

@@ -46,6 +46,21 @@ from .layout import (
 )
 
 
+def _shard_size(file_size: int, k: int, large: int, small: int) -> int:
+    """Bytes per shard for a file striped per encodeDatFile's row rules
+    (ec_encoder.go:194-231): whole large rows while more than k*large
+    remains, then zero-padded small rows."""
+    sz = 0
+    remaining = file_size
+    while remaining > large * k:
+        sz += large
+        remaining -= large * k
+    while remaining > 0:
+        sz += small
+        remaining -= small * k
+    return sz
+
+
 def _plan_entries(file_size: int, k: int, large: int, small: int,
                   max_n: int) -> Iterator[tuple[int, int, int, int]]:
     """Flatten the row structure of encodeDatFile (ec_encoder.go:194-231)
@@ -74,7 +89,8 @@ class StreamingEncoder:
                  parity_shards: int = PARITY_SHARDS_COUNT,
                  matrix_kind: str = "vandermonde",
                  dispatch_mb: int = 8, depth: int = 3,
-                 engine: str = "auto", mesh: Optional[bool] = None):
+                 engine: str = "auto", mesh: Optional[bool] = None,
+                 zero_copy: bool = True, overlap: str = "auto"):
         """engine: 'auto' uses the jax device path on a real accelerator
         and the host SIMD codec otherwise (jax-on-CPU is a correctness
         surface, ~200x slower than the AVX2 codec); 'device' forces the
@@ -100,23 +116,40 @@ class StreamingEncoder:
             # than silently taking the jax path
             raise ValueError(f"engine must be auto/host/device, got {engine!r}")
         self.engine = engine
+        # host mode prefers the mmap row-pointer path (no staging copies);
+        # False forces the staged pipeline (differential tests cover both)
+        # an EXPLICIT overlap worker request means the staged pipeline —
+        # zero-copy's synchronous mmap path would silently ignore it
+        self.zero_copy = zero_copy and overlap not in ("process", "thread")
         self._host_engine = None
+        self._host_pool = None
+        self._proc_worker = None
+        self._overlap = overlap
         self._mesh = None
         self._mesh_encode = None
         b = dispatch_mb << 20
         if engine == "host":
             self.on_tpu = False
             self._host_engine = best_cpu_engine()
-            # one worker thread gives the host codec the same overlap the
-            # device path gets for free: the SIMD matmul (a ctypes call,
-            # GIL released) computes dispatch d while the main thread
-            # fills and writes dispatch d+1.  ONE worker: dispatch order
-            # must match drain order, and the codec is already
-            # memory-bound so more threads would just thrash cache.  On a
-            # single core the thread only adds GIL convoying (measured
-            # ~7x WORSE than serial) — stay synchronous there.
-            self._host_pool = None
-            if (os.cpu_count() or 1) > 1:
+            # one worker gives the host codec the same overlap the device
+            # path gets for free: the SIMD matmul computes dispatch d
+            # while the main thread fills and writes dispatch d+1.  ONE
+            # worker: dispatch order must match drain order, and the
+            # codec is already memory-bound so more workers would just
+            # thrash cache.  overlap kinds:
+            #   "thread"  in-process worker (ctypes call releases the
+            #             GIL) — needs a second core or it GIL-convoys
+            #             (measured ~7x WORSE than serial on 1 core)
+            #   "process" separate process over shared memory
+            #             (ec/overlap.py) — the mechanism bench.py
+            #             measures on/off for the README overlap claim
+            #   "auto"    thread when >1 core, else none
+            #   "none"    synchronous
+            # (no pool when the zero-copy mmap path will serve encodes —
+            # it is synchronous and the idle thread would just leak)
+            if overlap == "thread" or (
+                    overlap == "auto" and (os.cpu_count() or 1) > 1
+                    and self._native_ptrs() is None):
                 import concurrent.futures
                 import weakref
 
@@ -248,6 +281,8 @@ class StreamingEncoder:
         """Blocking fetch + host-side unpack back to [R, dispatch-width] u8."""
         import concurrent.futures
 
+        if isinstance(out_dev, tuple) and out_dev[0] == "proc":
+            return self._proc_worker.fetch(out_dev[1])
         if isinstance(out_dev, concurrent.futures.Future):  # host worker
             return out_dev.result()
         if isinstance(out_dev, np.ndarray):  # host mode: already finished
@@ -266,11 +301,138 @@ class StreamingEncoder:
                       "bytes_in": 0}
         return self.stats
 
+    # --- zero-copy host path ----------------------------------------------
+    def _native_ptrs(self):
+        """The row-pointer native matmul, or None (no toolchain / forced
+        off / non-host engine)."""
+        if self.engine != "host" or not self.zero_copy:
+            return None
+        from .. import native
+
+        if native.load() is None:
+            return None
+        return native.gf_matmul_ptrs
+
+    def _encode_file_mmap(self, dat_path: str, out_base: str,
+                          large: int, small: int, matmul_ptrs) -> None:
+        """Zero-copy encode: the input volume is mmap'd and the SIMD
+        matmul reads it in place — no fill phase.  Parity is computed
+        into a small REUSED staging buffer (warm pages, no fault storm)
+        and pwritten; data shards are pwritten straight from the input
+        mapping (one kernel-side copy).  Measured on tmpfs this beats
+        both the staged pipeline (no read copies) and all-mmap outputs
+        (fresh-file mappings pay a minor fault per written page)."""
+        import mmap as mmap_mod
+
+        k, r = self.k, self.r
+        st = self._reset_stats()
+        clock = time.perf_counter
+        t_start = clock()
+        file_size = os.path.getsize(dat_path)
+        shard_size = _shard_size(file_size, k, large, small)
+        mat = np.ascontiguousarray(self.matrix[k:])
+        outs = [open(out_base + to_ext(i), "w+b") for i in range(k + r)]
+        out_fds = [f.fileno() for f in outs]
+        in_f = open(dat_path, "rb")
+        in_map = None
+        in_mv = None
+        tail_buf: Optional[np.ndarray] = None
+        stage = np.zeros((r, self.dispatch_b), dtype=np.uint8)
+        stage_addr = [stage.ctypes.data + j * stage.strides[0]
+                      for j in range(r)]
+        try:
+            for f in outs:
+                # full-size upfront: pwrite fills real bytes; anything a
+                # tail entry skips past EOF stays a correct zero
+                f.truncate(shard_size)
+            if shard_size == 0:
+                return
+            in_map = mmap_mod.mmap(in_f.fileno(), 0,
+                                   access=mmap_mod.ACCESS_READ)
+            if hasattr(in_map, "madvise"):
+                in_map.madvise(mmap_mod.MADV_SEQUENTIAL)
+            in_arr = np.frombuffer(in_map, dtype=np.uint8)
+            in_mv = memoryview(in_map)
+            in_addr = in_arr.ctypes.data
+            try:
+                out_off = 0
+                for n, row_start, block, off in _plan_entries(
+                        file_size, k, large, small, self.dispatch_b):
+                    base = row_start + off
+                    if base + (k - 1) * block + n <= file_size:
+                        # all k source rows fully inside the file: matmul
+                        # in place from the mapping into the parity stage
+                        t0 = clock()
+                        matmul_ptrs(
+                            mat,
+                            [in_addr + base + i * block for i in range(k)],
+                            stage_addr, n)
+                        st["dispatch_s"] += clock() - t0
+                        t0 = clock()
+                        for j in range(r):
+                            os.pwrite(out_fds[k + j],
+                                      memoryview(stage[j, :n]), out_off)
+                        for i in range(k):
+                            s = base + i * block
+                            os.pwrite(out_fds[i], in_mv[s:s + n], out_off)
+                        st["write_s"] += clock() - t0
+                    else:
+                        # tail entry: some rows cross EOF — stage through
+                        # a zero-padded buffer (ec_encoder.go:172-176)
+                        t0 = clock()
+                        if tail_buf is None or tail_buf.shape[1] < n:
+                            tail_buf = np.zeros((k, n), dtype=np.uint8)
+                        else:
+                            tail_buf[:, :n] = 0
+                        for i in range(k):
+                            s = base + i * block
+                            e = min(file_size, s + n)
+                            if e > s:
+                                tail_buf[i, :e - s] = in_arr[s:e]
+                        st["fill_s"] += clock() - t0
+                        t0 = clock()
+                        buf = tail_buf[:, :n]
+                        row = buf.strides[0]
+                        matmul_ptrs(
+                            mat,
+                            [buf.ctypes.data + i * row for i in range(k)],
+                            stage_addr, n)
+                        st["dispatch_s"] += clock() - t0
+                        t0 = clock()
+                        for j in range(r):
+                            os.pwrite(out_fds[k + j],
+                                      memoryview(stage[j, :n]), out_off)
+                        for i in range(k):
+                            os.pwrite(out_fds[i], memoryview(buf[i]),
+                                      out_off)
+                        st["write_s"] += clock() - t0
+                    st["dispatches"] += 1
+                    st["bytes_in"] += k * n
+                    out_off += n
+            finally:
+                # the view and exported memoryview must drop before the
+                # mmap closes or close() raises BufferError
+                if in_mv is not None:
+                    in_mv.release()
+                del in_arr
+        finally:
+            if in_map is not None:
+                in_map.close()
+            in_f.close()
+            for f in outs:
+                f.close()
+            st["wall_s"] = clock() - t_start
+
     def encode_file(self, dat_path: str, out_base: str,
                     large_block_size: int = LARGE_BLOCK_SIZE,
                     small_block_size: int = SMALL_BLOCK_SIZE) -> None:
         """dat_path -> out_base.ec00..ecNN, byte-identical to
         encoder.write_ec_files (WriteEcFiles, ec_encoder.go:57)."""
+        matmul_ptrs = self._native_ptrs()
+        if matmul_ptrs is not None:
+            return self._encode_file_mmap(
+                dat_path, out_base, large_block_size, small_block_size,
+                matmul_ptrs)
         k, r, b = self.k, self.r, self.dispatch_b
         st = self._reset_stats()
         clock = time.perf_counter
@@ -278,7 +440,19 @@ class StreamingEncoder:
         planes = self._planes(self.matrix[k:])
         file_size = os.path.getsize(dat_path)
         outputs = [open(out_base + to_ext(i), "wb") for i in range(k + r)]
-        bufs = [np.zeros((k, b), dtype=np.uint8) for _ in range(self.depth + 1)]
+        if self.engine == "host" and self._overlap == "process":
+            if self._proc_worker is not None and self._proc_worker.b != b:
+                self._proc_worker.close()  # dispatch width changed
+                self._proc_worker = None
+            if self._proc_worker is None:
+                from .overlap import ProcessOverlapWorker
+
+                self._proc_worker = ProcessOverlapWorker(
+                    k, r, b, self.matrix[k:], self.depth + 1)
+        # process overlap: dispatch buffers ARE the shared-memory pool
+        bufs = self._proc_worker.bufs if self._proc_worker is not None \
+            else [np.zeros((k, b), dtype=np.uint8)
+                  for _ in range(self.depth + 1)]
         free: deque[int] = deque(range(len(bufs)))
         # (device parity, packed width, buffer index)
         pending: deque[tuple[object, int, int]] = deque()
@@ -327,7 +501,11 @@ class StreamingEncoder:
                         buf[:, used:] = 0
                     st["fill_s"] += clock() - t0
                     t0 = clock()
-                    parity_dev = self._dispatch(planes, buf)
+                    if self._proc_worker is not None:
+                        parity_dev = ("proc",
+                                      self._proc_worker.submit(bi, used))
+                    else:
+                        parity_dev = self._dispatch(planes, buf)
                     st["dispatch_s"] += clock() - t0
                     st["dispatches"] += 1
                     st["bytes_in"] += k * used
@@ -360,6 +538,77 @@ class StreamingEncoder:
                 f.close()
             st["wall_s"] = clock() - t_start
 
+    def _rebuild_files_mmap(self, base: str, missing: list[int],
+                            survivors: list[int], rec: np.ndarray,
+                            matmul_ptrs) -> None:
+        """Zero-copy rebuild: survivors are mmap'd whole files read in
+        place by the matmul; regenerated shards are computed into a small
+        reused staging buffer and pwritten (warm pages beat fresh-file
+        mappings, which pay a minor fault per written page)."""
+        import mmap as mmap_mod
+
+        k, b = self.k, self.dispatch_b
+        st = self._reset_stats()
+        clock = time.perf_counter
+        t_start = clock()
+        rec = np.ascontiguousarray(rec)
+        nm = len(missing)
+        in_fs = [open(base + to_ext(i), "rb") for i in survivors]
+        in_maps: list = []
+        out_fs: list = []
+        ok = False
+        stage = np.zeros((nm, b), dtype=np.uint8)
+        stage_addr = [stage.ctypes.data + j * stage.strides[0]
+                      for j in range(nm)]
+        try:
+            shard_size = os.fstat(in_fs[0].fileno()).st_size
+            for f in in_fs:
+                if os.fstat(f.fileno()).st_size != shard_size:
+                    raise ValueError("ec shard size mismatch")
+            out_fs = [open(base + to_ext(m), "w+b") for m in missing]
+            out_fds = [f.fileno() for f in out_fs]
+            if shard_size == 0:
+                ok = True
+                return
+            in_maps = [mmap_mod.mmap(f.fileno(), 0,
+                                     access=mmap_mod.ACCESS_READ)
+                       for f in in_fs]
+            for m in in_maps:
+                if hasattr(m, "madvise"):
+                    m.madvise(mmap_mod.MADV_SEQUENTIAL)
+            in_arrs = [np.frombuffer(m, dtype=np.uint8) for m in in_maps]
+            in_addr = [a.ctypes.data for a in in_arrs]
+            try:
+                for offset in range(0, shard_size, b):
+                    n = min(b, shard_size - offset)
+                    t0 = clock()
+                    matmul_ptrs(rec,
+                                [a + offset for a in in_addr],
+                                stage_addr, n)
+                    st["dispatch_s"] += clock() - t0
+                    t0 = clock()
+                    for j in range(nm):
+                        os.pwrite(out_fds[j], memoryview(stage[j, :n]),
+                                  offset)
+                    st["write_s"] += clock() - t0
+                    st["dispatches"] += 1
+                    st["bytes_in"] += len(survivors) * n
+            finally:
+                del in_arrs
+            ok = True
+        finally:
+            for m in in_maps:
+                m.close()
+            for f in in_fs + out_fs:
+                f.close()
+            if not ok:
+                for m in missing:
+                    try:
+                        os.remove(base + to_ext(m))
+                    except OSError:
+                        pass
+            st["wall_s"] = clock() - t_start
+
     # --- rebuild ----------------------------------------------------------
     def rebuild_files(self, base_file_name: str) -> list[int]:
         """Streaming RebuildEcFiles (ec_encoder.go:61,:233-287): regenerate
@@ -388,6 +637,11 @@ class StreamingEncoder:
                 rows.append(mat_mul([[int(v) for v in self.matrix[m]]],
                                     decode)[0])
         rec = np.array(rows, dtype=np.uint8)
+        matmul_ptrs = self._native_ptrs()
+        if matmul_ptrs is not None:
+            self._rebuild_files_mmap(base_file_name, missing, survivors,
+                                     rec, matmul_ptrs)
+            return missing
         planes = self._planes(rec)
 
         inputs = {i: open(base_file_name + to_ext(i), "rb")

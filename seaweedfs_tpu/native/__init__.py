@@ -47,7 +47,13 @@ def load():
         ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_uint8),
         ctypes.c_long,
     ]
+    lib.gf_matmul_ptrs.argtypes = [
+        ctypes.POINTER(ctypes.c_uint8), ctypes.c_int, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_void_p),
+        ctypes.c_long,
+    ]
     lib.gf_has_avx2.restype = ctypes.c_int
+    lib.gf_has_gfni.restype = ctypes.c_int
 
     from ..ec.gf256 import MUL_TABLE
 
@@ -59,6 +65,11 @@ def load():
 def has_avx2() -> bool:
     lib = load()
     return bool(lib and lib.gf_has_avx2())
+
+
+def has_gfni() -> bool:
+    lib = load()
+    return bool(lib and lib.gf_has_gfni())
 
 
 # --- native LSM KV (lsmkv.cpp) ----------------------------------------------
@@ -162,6 +173,21 @@ class NativeKv:
         if self._db:
             self._lib.lsm_close(self._db)
             self._db = None
+
+
+def gf_matmul_ptrs(mat: np.ndarray, in_addrs, out_addrs, n: int) -> None:
+    """Row-pointer matmul: in_addrs/out_addrs are raw addresses (ints) of
+    K input and R output rows of n bytes each — typically straight into
+    mmap'd files, making the matmul itself the only data movement."""
+    lib = load()
+    if lib is None:
+        raise RuntimeError("native gf256 library unavailable")
+    mat = np.ascontiguousarray(mat, dtype=np.uint8)
+    r, k = mat.shape
+    ins = (ctypes.c_void_p * k)(*in_addrs)
+    outs = (ctypes.c_void_p * r)(*out_addrs)
+    lib.gf_matmul_ptrs(mat.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+                       r, k, ins, outs, ctypes.c_long(n))
 
 
 def gf_matmul(mat: np.ndarray, data: np.ndarray) -> np.ndarray:

@@ -21,6 +21,14 @@ from seaweedfs_tpu.ec.streaming import StreamingEncoder, _plan_entries
 RNG = np.random.default_rng(0x5EA)
 
 
+def make_enc(k, r, engine, **kw):
+    """ "host" = zero-copy mmap path, "host-pipeline" = staged host
+    pipeline (zero_copy off), "device" = jax path."""
+    if engine == "host-pipeline":
+        return StreamingEncoder(k, r, engine="host", zero_copy=False, **kw)
+    return StreamingEncoder(k, r, engine=engine, **kw)
+
+
 def _write_dat(tmp_path, size, name="v"):
     p = tmp_path / f"{name}.dat"
     p.write_bytes(RNG.integers(0, 256, size, dtype=np.uint8).tobytes())
@@ -45,7 +53,7 @@ def npchunk(small):
     return max(64, small // 3 * 2)
 
 
-@pytest.mark.parametrize("engine", ["host", "device"])
+@pytest.mark.parametrize("engine", ["host", "host-pipeline", "device"])
 @pytest.mark.parametrize("size,large,small", [
     (0, 10_000, 100),              # empty volume
     (999, 10_000, 100),            # sub-single-row tail
@@ -57,7 +65,7 @@ def npchunk(small):
 def test_streaming_encode_byte_identical(tmp_path, size, large, small, engine):
     base = _write_dat(tmp_path, size)
     ref = _cpu_reference(tmp_path, base, large, small)
-    enc = StreamingEncoder(10, 4, dispatch_mb=1, engine=engine)
+    enc = make_enc(10, 4, engine, dispatch_mb=1)
     enc.dispatch_b = 4096  # force multi-dispatch packing paths
     enc.encode_file(base + ".dat", base,
                     large_block_size=large, small_block_size=small)
@@ -76,7 +84,7 @@ def test_streaming_encode_default_geometry_small_dispatch(tmp_path):
     assert _shards(base, 14) == _shards(ref, 14)
 
 
-@pytest.mark.parametrize("engine", ["host", "device"])
+@pytest.mark.parametrize("engine", ["host", "host-pipeline", "device"])
 @pytest.mark.parametrize("kill", [
     [0],            # one data shard
     [11],           # one parity shard
@@ -90,7 +98,7 @@ def test_streaming_rebuild_byte_identical(tmp_path, kill, engine):
     want = _shards(base, 14)
     for i in kill:
         os.unlink(base + to_ext(i))
-    enc = StreamingEncoder(10, 4, engine=engine)
+    enc = make_enc(10, 4, engine)
     enc.dispatch_b = 4096
     got_ids = enc.rebuild_files(base)
     assert got_ids == sorted(kill)
@@ -107,7 +115,7 @@ def test_streaming_rebuild_unrepairable(tmp_path):
         StreamingEncoder(10, 4).rebuild_files(base)
 
 
-@pytest.mark.parametrize("engine", ["host", "device"])
+@pytest.mark.parametrize("engine", ["host", "host-pipeline", "device"])
 def test_streaming_alt_geometries(tmp_path, engine):
     for k, r in ((6, 3), (12, 4)):
         base = _write_dat(tmp_path, 77_777, name=f"g{k}{r}{engine[0]}")
@@ -116,11 +124,33 @@ def test_streaming_alt_geometries(tmp_path, engine):
         encoder.write_ec_files(ref, ReedSolomon(k, r),
                                large_block_size=10_000,
                                small_block_size=100, chunk=512)
-        enc = StreamingEncoder(k, r, engine=engine)
+        enc = make_enc(k, r, engine)
         enc.dispatch_b = 2048
         enc.encode_file(base + ".dat", base,
                         large_block_size=10_000, small_block_size=100)
         assert _shards(base, k + r) == _shards(ref, k + r)
+
+
+def test_process_overlap_worker_byte_identical(tmp_path):
+    """overlap="process" runs the codec in a separate process over
+    shared memory (ec/overlap.py) — same shards, worker reused across
+    encodes, clean shutdown."""
+    base = _write_dat(tmp_path, 123_457, name="ov")
+    ref = _cpu_reference(tmp_path, base, 10_000, 100)
+    enc = StreamingEncoder(10, 4, engine="host", overlap="process")
+    enc.dispatch_b = 4096
+    try:
+        enc.encode_file(base + ".dat", base,
+                        large_block_size=10_000, small_block_size=100)
+        assert _shards(base, 14) == _shards(ref, 14)
+        # worker survives a second encode (buffer pool reuse)
+        enc.encode_file(base + ".dat", base,
+                        large_block_size=10_000, small_block_size=100)
+        assert _shards(base, 14) == _shards(ref, 14)
+        assert enc._proc_worker is not None
+    finally:
+        if enc._proc_worker is not None:
+            enc._proc_worker.close()
 
 
 def test_plan_entries_covers_file_exactly():
