@@ -1,0 +1,367 @@
+"""Redis Cluster and Sentinel filer-store variants.
+
+Equivalent of weed/filer/redis_lua/redis_cluster_store.go +
+redis3/redis_cluster_store.go (cluster) and the go-redis FailoverClient
+wiring the reference gets for free from its client library (sentinel).
+The environment has no redis-py/go-redis, so both topologies are driven
+through the same pure-stdlib RESP2 client the single-node store uses
+(redis_store.RespClient):
+
+  - ClusterRespClient: key -> CRC16-XMODEM hash slot (mod 16384, with
+    {hash tag} extraction), slot -> node from CLUSTER SLOTS, transparent
+    -MOVED (refresh map + retry) and -ASK (one-shot ASKING redirect)
+    handling, and per-SLOT splitting of multi-key commands (real
+    clusters reject cross-slot MGET/DEL with CROSSSLOT; the reference
+    avoids them by looping single-key commands — splitting + per-node
+    pipelining preserves this store's batched round trips instead).
+  - SentinelRespClient: master discovery via
+    SENTINEL GET-MASTER-ADDR-BY-NAME against a sentinel list, with
+    rediscovery (failover follow) when the master connection dies.
+
+Data model is identical to redis_store.RedisStore — a cluster/sentinel
+deployment can be read by the single-node store pointed at any node
+holding the keys.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from .redis_store import RedisStore, RespClient, RespError
+
+SLOTS = 16384
+
+# CRC16-CCITT (XMODEM): poly 0x1021, init 0 — the Redis Cluster keyslot
+# function (cluster spec "Keys distribution model")
+_CRC16_TABLE = []
+for _i in range(256):
+    _c = _i << 8
+    for _ in range(8):
+        _c = ((_c << 1) ^ 0x1021) if (_c & 0x8000) else (_c << 1)
+    _CRC16_TABLE.append(_c & 0xFFFF)
+
+
+def crc16(data: bytes) -> int:
+    c = 0
+    for b in data:
+        c = ((c << 8) & 0xFFFF) ^ _CRC16_TABLE[((c >> 8) ^ b) & 0xFF]
+    return c
+
+
+def hash_slot(key: bytes) -> int:
+    """Hash-tag aware: only the first {...} segment is hashed when it is
+    non-empty (cluster spec "Hash tags")."""
+    lb = key.find(b"{")
+    if lb >= 0:
+        rb = key.find(b"}", lb + 1)
+        if rb > lb + 1:
+            key = key[lb + 1:rb]
+    return crc16(key) % SLOTS
+
+
+# commands whose every argument after the name is a key
+_MULTI_KEY = {b"MGET", b"DEL", b"UNLINK", b"EXISTS"}
+
+
+class ClusterRespClient:
+    """RESP2 client over a Redis Cluster topology (one RespClient per
+    node, slot-routed)."""
+
+    def __init__(self, nodes: list[tuple[str, int]], password: str = "",
+                 timeout: float = 30.0):
+        if not nodes:
+            raise ValueError("cluster needs at least one seed node")
+        self._seeds = list(nodes)
+        self._password = password
+        self._timeout = timeout
+        self._lock = threading.Lock()
+        self._conns: dict[tuple[str, int], RespClient] = {}
+        # slot map: sorted list of (start, end, (host, port))
+        self._ranges: list[tuple[int, int, tuple[str, int]]] = []
+        self._refresh_slots()
+
+    # -- topology ------------------------------------------------------------
+    def _conn(self, addr: tuple[str, int]) -> RespClient:
+        with self._lock:
+            c = self._conns.get(addr)
+            if c is None:
+                c = RespClient(addr[0], addr[1], password=self._password,
+                               timeout=self._timeout)
+                self._conns[addr] = c
+            return c
+
+    def _refresh_slots(self) -> None:
+        last_err: Optional[Exception] = None
+        for addr in self._seeds + [a for *_x, a in self._ranges]:
+            try:
+                raw = self._conn(addr).command("CLUSTER", "SLOTS")
+                ranges = []
+                for row in raw or []:
+                    start, end, master = int(row[0]), int(row[1]), row[2]
+                    host = master[0].decode()
+                    ranges.append((start, end, (host, int(master[1]))))
+                if ranges:
+                    ranges.sort()
+                    self._ranges = ranges
+                    return
+            except (RespError, OSError, ConnectionError) as e:
+                last_err = e
+        raise ConnectionError(f"no cluster node answered CLUSTER SLOTS: "
+                              f"{last_err}")
+
+    def _addr_for_slot(self, slot: int) -> tuple[str, int]:
+        for start, end, addr in self._ranges:
+            if start <= slot <= end:
+                return addr
+        # uncovered slot: stale map — refresh once
+        self._refresh_slots()
+        for start, end, addr in self._ranges:
+            if start <= slot <= end:
+                return addr
+        raise RespError(f"slot {slot} uncovered by cluster")
+
+    @staticmethod
+    def _key_of(parts: tuple) -> bytes:
+        k = parts[1]
+        return k if isinstance(k, bytes) else str(k).encode()
+
+    # -- routing -------------------------------------------------------------
+    def _run_at(self, addr: tuple[str, int], parts: tuple, asking=False):
+        conn = self._conn(addr)
+        if asking:
+            # ASKING + command in ONE pipeline: the flag is per-command
+            return conn.pipeline(("ASKING",), parts)[1]
+        return conn.pipeline(parts)[0]
+
+    def _routed(self, parts: tuple):
+        """Single-key command with MOVED/ASK handling."""
+        slot = hash_slot(self._key_of(parts))
+        addr = self._addr_for_slot(slot)
+        asking = False
+        for _ in range(5):
+            try:
+                return self._run_at(addr, parts, asking=asking)
+            except RespError as e:
+                msg = str(e)
+                if msg.startswith("MOVED "):
+                    # topology changed: refresh and retry at the new owner
+                    _, _, target = msg.split(" ", 2)
+                    host, _, port = target.rpartition(":")
+                    addr, asking = (host, int(port)), False
+                    self._refresh_slots()
+                    continue
+                if msg.startswith("ASK "):
+                    # mid-migration: one-shot redirect, no map refresh
+                    _, _, target = msg.split(" ", 2)
+                    host, _, port = target.rpartition(":")
+                    addr, asking = (host, int(port)), True
+                    continue
+                raise
+        raise RespError("redirect loop (MOVED/ASK > 5 hops)")
+
+    def command(self, *parts):
+        cmd = parts[0]
+        name = (cmd if isinstance(cmd, bytes) else str(cmd).encode()).upper()
+        if name in _MULTI_KEY and len(parts) > 2:
+            return self._multi_key(name, parts[1:])
+        if name in (b"PING", b"CLUSTER"):
+            return self._conn(self._ranges[0][2]).command(*parts)
+        return self._routed(parts)
+
+    def _multi_key(self, name: bytes, keys: tuple):
+        """Split a cross-slot MGET/DEL by slot, pipeline each node's
+        slot-groups in one batch, merge in order."""
+        groups: dict[int, list[int]] = {}
+        bkeys = [k if isinstance(k, bytes) else str(k).encode()
+                 for k in keys]
+        for i, k in enumerate(bkeys):
+            groups.setdefault(hash_slot(k), []).append(i)
+        by_node: dict[tuple[str, int], list[list[int]]] = {}
+        for slot, idxs in groups.items():
+            by_node.setdefault(self._addr_for_slot(slot), []).append(idxs)
+        if name == b"MGET":
+            out: list = [None] * len(bkeys)
+            for addr, slot_groups in by_node.items():
+                cmds = [tuple([b"MGET"] + [bkeys[i] for i in idxs])
+                        for idxs in slot_groups]
+                replies = self._pipeline_with_redirects(addr, cmds)
+                for idxs, rep in zip(slot_groups, replies):
+                    for i, v in zip(idxs, rep or []):
+                        out[i] = v
+            return out
+        # DEL/UNLINK/EXISTS return a count
+        total = 0
+        for addr, slot_groups in by_node.items():
+            cmds = [tuple([name] + [bkeys[i] for i in idxs])
+                    for idxs in slot_groups]
+            for rep in self._pipeline_with_redirects(addr, cmds):
+                total += int(rep or 0)
+        return total
+
+    def _pipeline_with_redirects(self, addr, cmds: list[tuple]) -> list:
+        """Send cmds as one pipeline; any reply that was a redirect error
+        is replayed individually through the routed path."""
+        conn = self._conn(addr)
+        try:
+            return conn.pipeline(*cmds)
+        except RespError:
+            # at least one command redirected/errored: replay each alone
+            # (the store's batches are small; correctness over round trips)
+            return [self._routed(c) for c in cmds]
+
+    def pipeline(self, *commands):
+        """Route each command by key, batch per node, restore order.
+        Cross-node pipelines lose all-or-nothing ordering (as in any
+        cluster client) — the store's usage is independent commands."""
+        by_node: dict[tuple[str, int], list[int]] = {}
+        for i, parts in enumerate(commands):
+            name = (parts[0] if isinstance(parts[0], bytes)
+                    else str(parts[0]).encode()).upper()
+            if name in _MULTI_KEY and len(parts) > 2:
+                # handled via command() below; mark with None node
+                by_node.setdefault(("", -1), []).append(i)
+                continue
+            slot = hash_slot(self._key_of(parts))
+            by_node.setdefault(self._addr_for_slot(slot), []).append(i)
+        out: list = [None] * len(commands)
+        for addr, idxs in by_node.items():
+            if addr == ("", -1):
+                for i in idxs:
+                    out[i] = self.command(*commands[i])
+                continue
+            replies = self._pipeline_with_redirects(
+                addr, [commands[i] for i in idxs])
+            for i, rep in zip(idxs, replies):
+                out[i] = rep
+        return out
+
+    def close(self) -> None:
+        with self._lock:
+            for c in self._conns.values():
+                c.close()
+            self._conns.clear()
+
+
+class SentinelRespClient:
+    """RespClient facade that discovers (and re-discovers, after
+    failover) the master through a sentinel list."""
+
+    def __init__(self, sentinels: list[tuple[str, int]], master_name: str,
+                 db: int = 0, password: str = "", timeout: float = 30.0):
+        if not sentinels:
+            raise ValueError("sentinel needs at least one address")
+        self._sentinels = list(sentinels)
+        self._master_name = master_name
+        self._db, self._password, self._timeout = db, password, timeout
+        self._lock = threading.Lock()
+        self._master: Optional[RespClient] = None
+        self._master_addr: Optional[tuple[str, int]] = None
+        self._discover()
+
+    def _discover(self) -> None:
+        last_err: Optional[Exception] = None
+        for host, port in self._sentinels:
+            try:
+                s = RespClient(host, port, timeout=self._timeout)
+                try:
+                    got = s.command("SENTINEL", "get-master-addr-by-name",
+                                    self._master_name)
+                finally:
+                    s.close()
+                if got:
+                    addr = (got[0].decode(), int(got[1]))
+                    if self._master is not None:
+                        self._master.close()
+                    self._master = RespClient(
+                        addr[0], addr[1], db=self._db,
+                        password=self._password, timeout=self._timeout)
+                    self._master_addr = addr
+                    return
+            except (RespError, OSError, ConnectionError) as e:
+                last_err = e
+        raise ConnectionError(
+            f"no sentinel knows master {self._master_name!r}: {last_err}")
+
+    def _with_failover(self, fn):
+        try:
+            return fn()
+        except (RespError, OSError, ConnectionError) as e:
+            if isinstance(e, RespError) and not str(e).startswith(
+                    ("READONLY", "MASTERDOWN", "LOADING")):
+                raise  # a data error, not a role change
+            # failover: the old master is gone or demoted — re-ask the
+            # sentinels and replay once
+            with self._lock:
+                self._discover()
+            return fn()
+
+    def command(self, *parts):
+        return self._with_failover(lambda: self._master.command(*parts))
+
+    def pipeline(self, *commands):
+        return self._with_failover(lambda: self._master.pipeline(*commands))
+
+    def close(self) -> None:
+        if self._master is not None:
+            self._master.close()
+
+
+def _parse_hosts(csv: str, default_port: int) -> list[tuple[str, int]]:
+    out = []
+    for part in csv.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        host, _, port_s = part.partition(":")
+        out.append((host, int(port_s or default_port)))
+    return out
+
+
+class RedisClusterStore(RedisStore):
+    """RedisStore over a Redis Cluster (redis_cluster_store.go analog)."""
+
+    name = "redis_cluster"
+
+    def __init__(self, nodes: list[tuple[str, int]], password: str = ""):
+        self.client = ClusterRespClient(nodes, password=password)
+        self.client.command("PING")
+
+    @classmethod
+    def from_url(cls, url: str) -> "RedisClusterStore":
+        """``redis-cluster://[:password@]h1:p1,h2:p2,...``"""
+        rest = url[len("redis-cluster://"):]
+        password = ""
+        if "@" in rest:
+            cred, rest = rest.rsplit("@", 1)
+            password = cred.lstrip(":")
+        return cls(_parse_hosts(rest, 6379), password=password)
+
+
+class RedisSentinelStore(RedisStore):
+    """RedisStore through sentinel master discovery (the reference uses
+    go-redis NewFailoverClient; ref: weed/filer/redis/redis_store.go
+    family wiring in weed/command/scaffold)."""
+
+    name = "redis_sentinel"
+
+    def __init__(self, sentinels: list[tuple[str, int]], master_name: str,
+                 db: int = 0, password: str = ""):
+        self.client = SentinelRespClient(sentinels, master_name, db=db,
+                                         password=password)
+        self.client.command("PING")
+
+    @classmethod
+    def from_url(cls, url: str) -> "RedisSentinelStore":
+        """``redis-sentinel://[:password@]h1:p1,h2:p2/master_name[/db]``"""
+        rest = url[len("redis-sentinel://"):]
+        password = ""
+        if "@" in rest:
+            cred, rest = rest.rsplit("@", 1)
+            password = cred.lstrip(":")
+        hosts_csv, _, tail = rest.partition("/")
+        master_name, _, db_s = tail.partition("/")
+        if not master_name:
+            raise ValueError("sentinel url needs /master_name")
+        return cls(_parse_hosts(hosts_csv, 26379), master_name,
+                   db=int(db_s or 0), password=password)

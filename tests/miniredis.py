@@ -13,26 +13,53 @@ import threading
 
 
 class MiniRedis:
-    def __init__(self, password: str = ""):
+    def __init__(self, password: str = "", cluster=None,
+                 slot_range=None):
         self.password = password
         self.kv: dict[bytes, bytes] = {}
         self.zsets: dict[bytes, set[bytes]] = {}
         self.lock = threading.Lock()
+        # cluster mode: (MiniRedisCluster, (slot_lo, slot_hi)) — keys
+        # outside the range answer -MOVED; migrating slots answer -ASK
+        self.cluster = cluster
+        self.slot_range = slot_range
         self._srv = socket.socket()
         self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._srv.bind(("127.0.0.1", 0))
         self._srv.listen(16)
         self.port = self._srv.getsockname()[1]
         self._stop = False
+        self._conns: set[socket.socket] = set()
         self._thread = threading.Thread(target=self._accept_loop, daemon=True)
         self._thread.start()
 
     def stop(self) -> None:
+        """Kill the listener AND every live connection — a stopped double
+        must look DEAD to clients (failover drills depend on in-flight
+        keep-alive connections breaking, not lingering)."""
         self._stop = True
+        try:
+            # wake the thread blocked in accept() (EINVAL) — a bare
+            # close() leaves the kernel LISTEN alive under it and the
+            # port keeps accepting
+            self._srv.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
         try:
             self._srv.close()
         except OSError:
             pass
+        for c in list(self._conns):
+            try:
+                # shutdown, not just close: a close()d fd held by a
+                # thread blocked in recv() never RSTs the peer
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                c.close()
+            except OSError:
+                pass
 
     # -- server loop --------------------------------------------------------
     def _accept_loop(self) -> None:
@@ -45,6 +72,7 @@ class MiniRedis:
                              daemon=True).start()
 
     def _serve(self, conn: socket.socket) -> None:
+        self._conns.add(conn)
         buf = b""
 
         def read_line():
@@ -68,6 +96,7 @@ class MiniRedis:
             return data
 
         authed = not self.password
+        ctx = {"asking": False}  # per-connection one-shot ASKING flag
         try:
             while True:
                 line = read_line()
@@ -89,11 +118,52 @@ class MiniRedis:
                 if not authed:
                     conn.sendall(b"-NOAUTH Authentication required.\r\n")
                     continue
+                if cmd == b"ASKING":
+                    ctx["asking"] = True
+                    conn.sendall(b"+OK\r\n")
+                    continue
+                if self.cluster is not None:
+                    redirect = self._cluster_check(cmd, parts[1:], ctx)
+                    ctx["asking"] = False
+                    if redirect is not None:
+                        conn.sendall(redirect)
+                        continue
                 conn.sendall(self._dispatch(cmd, parts[1:]))
         except (ConnectionError, OSError):
             pass
         finally:
+            self._conns.discard(conn)
             conn.close()
+
+    # -- cluster mode --------------------------------------------------------
+    _KEYLESS = (b"PING", b"SELECT", b"CLUSTER", b"SENTINEL")
+
+    def _cluster_check(self, cmd: bytes, args: list[bytes], ctx):
+        """None = serve locally; else the -MOVED/-ASK/-CROSSSLOT reply."""
+        from seaweedfs_tpu.filer.redis_cluster import hash_slot
+
+        if cmd in self._KEYLESS or not args:
+            return None
+        if cmd in (b"MGET", b"DEL", b"EXISTS", b"UNLINK"):
+            keys = args
+        else:
+            keys = args[:1]
+        slots = {hash_slot(k) for k in keys}
+        if len(slots) > 1:
+            return (b"-CROSSSLOT Keys in request don't hash to the "
+                    b"same slot\r\n")
+        slot = slots.pop()
+        migr = self.cluster.migrating.get(slot)
+        owner = self.cluster.owner_of(slot)
+        if migr is self and ctx["asking"]:
+            return None  # importing node serves ASKING clients
+        if owner is self:
+            if migr is not None and migr is not self:
+                # migrating out (simplified: always redirect — drills
+                # the client's one-shot ASKING path)
+                return b"-ASK %d 127.0.0.1:%d\r\n" % (slot, migr.port)
+            return None
+        return b"-MOVED %d 127.0.0.1:%d\r\n" % (slot, owner.port)
 
     # -- commands -----------------------------------------------------------
     @staticmethod
@@ -106,6 +176,10 @@ class MiniRedis:
         with self.lock:
             if cmd == b"PING":
                 return b"+PONG\r\n"
+            if cmd == b"CLUSTER" and args and args[0].upper() == b"SLOTS":
+                if self.cluster is None:
+                    return b"-ERR This instance has cluster support disabled\r\n"
+                return self.cluster.slots_reply()
             if cmd == b"SELECT":
                 return b"+OK\r\n"
             if cmd == b"SET":
@@ -164,3 +238,134 @@ class MiniRedis:
                 return b"*%d\r\n%s" % (
                     len(sel), b"".join(self._bulk(m) for m in sel))
             return b"-ERR unknown command '%s'\r\n" % cmd
+
+
+class MiniRedisCluster:
+    """N MiniRedis nodes with an even hash-slot split; supports MOVED
+    (ownership transfer) and ASK (mid-migration) drills."""
+
+    def __init__(self, n_nodes: int = 3, password: str = ""):
+        self.nodes: list[MiniRedis] = []
+        self.ranges: list[tuple[int, int]] = []
+        # slot -> destination node currently being MIGRATED to (ASK)
+        self.migrating: dict[int, MiniRedis] = {}
+        # slot -> node that took ownership (overrides the static ranges)
+        self.moved: dict[int, MiniRedis] = {}
+        per = 16384 // n_nodes
+        for i in range(n_nodes):
+            lo = i * per
+            hi = 16383 if i == n_nodes - 1 else (i + 1) * per - 1
+            node = MiniRedis(password=password, cluster=self,
+                             slot_range=(lo, hi))
+            self.nodes.append(node)
+            self.ranges.append((lo, hi))
+
+    def owner_of(self, slot: int) -> MiniRedis:
+        n = self.moved.get(slot)
+        if n is not None:
+            return n
+        for node, (lo, hi) in zip(self.nodes, self.ranges):
+            if lo <= slot <= hi:
+                return node
+        raise AssertionError(slot)
+
+    def slots_reply(self) -> bytes:
+        """CLUSTER SLOTS: contiguous owned ranges; a MOVED slot is carved
+        out as its own 1-slot range owned by the new node."""
+        rows = []
+        for node, (lo, hi) in zip(self.nodes, self.ranges):
+            cur = lo
+            for s in sorted(k for k in self.moved if lo <= k <= hi):
+                if cur <= s - 1:
+                    rows.append((cur, s - 1, node))
+                rows.append((s, s, self.moved[s]))
+                cur = s + 1
+            if cur <= hi:
+                rows.append((cur, hi, node))
+        out = [b"*%d\r\n" % len(rows)]
+        for lo, hi, node in rows:
+            ip = b"127.0.0.1"
+            out.append(b"*3\r\n:%d\r\n:%d\r\n*3\r\n$%d\r\n%s\r\n:%d\r\n"
+                       b"$5\r\nnid%02d\r\n"
+                       % (lo, hi, len(ip), ip, node.port,
+                          self.nodes.index(node)))
+        return b"".join(out)
+
+    def stop(self) -> None:
+        for n in self.nodes:
+            n.stop()
+
+
+class MiniSentinel:
+    """SENTINEL GET-MASTER-ADDR-BY-NAME server; the advertised master
+    can be swapped at runtime to drill failover."""
+
+    def __init__(self, masters: dict[str, tuple[str, int]]):
+        self.masters = dict(masters)
+        self._srv = socket.socket()
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind(("127.0.0.1", 0))
+        self._srv.listen(8)
+        self.port = self._srv.getsockname()[1]
+        self._stop = False
+        threading.Thread(target=self._loop, daemon=True).start()
+
+    def _loop(self) -> None:
+        while not self._stop:
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def _serve(self, conn: socket.socket) -> None:
+        buf = b""
+        try:
+            while True:
+                while b"\r\n" not in buf:
+                    chunk = conn.recv(65536)
+                    if not chunk:
+                        return
+                    buf += chunk
+                line, buf = buf.split(b"\r\n", 1)
+                if not line.startswith(b"*"):
+                    conn.sendall(b"-ERR protocol\r\n")
+                    return
+                parts = []
+                for _ in range(int(line[1:])):
+                    while b"\r\n" not in buf:
+                        buf += conn.recv(65536)
+                    hdr, buf = buf.split(b"\r\n", 1)
+                    n = int(hdr[1:])
+                    while len(buf) < n + 2:
+                        buf += conn.recv(65536)
+                    parts.append(buf[:n])
+                    buf = buf[n + 2:]
+                cmd = parts[0].upper()
+                if cmd == b"PING":
+                    conn.sendall(b"+PONG\r\n")
+                elif cmd == b"SENTINEL" and len(parts) >= 3 and \
+                        parts[1].lower() == b"get-master-addr-by-name":
+                    m = self.masters.get(parts[2].decode())
+                    if m is None:
+                        conn.sendall(b"*-1\r\n")
+                    else:
+                        host, port = m
+                        conn.sendall(
+                            b"*2\r\n$%d\r\n%s\r\n$%d\r\n%s\r\n"
+                            % (len(host), host.encode(),
+                               len(str(port)), str(port).encode()))
+                else:
+                    conn.sendall(b"-ERR unknown sentinel command\r\n")
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            conn.close()
+
+    def stop(self) -> None:
+        self._stop = True
+        try:
+            self._srv.close()
+        except OSError:
+            pass

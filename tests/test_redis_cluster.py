@@ -1,0 +1,233 @@
+"""Redis Cluster + Sentinel store variants against in-process doubles.
+
+Gates:
+- CRC16-XMODEM keyslot function matches the published Redis vectors
+  (cluster spec appendix A), including {hash tag} extraction
+- a client seeded with ONE node discovers the full slot map and routes
+  to all three; keys land on the node owning their slot
+- -MOVED after an ownership change refreshes the map and converges
+- -ASK mid-migration takes the one-shot ASKING path without poisoning
+  the slot map
+- cross-slot MGET/DEL are split per slot (the double enforces real
+  CROSSSLOT semantics)
+- RedisClusterStore is observably identical to MemoryStore under
+  randomized ops; a Filer runs end-to-end on it
+- sentinel: master discovery, and failover rediscovery when the master
+  dies mid-stream
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu.filer.entry import Attr, Entry, FileChunk
+from seaweedfs_tpu.filer.filer import Filer
+from seaweedfs_tpu.filer.filer_store import MemoryStore
+from seaweedfs_tpu.filer.redis_cluster import (
+    ClusterRespClient,
+    RedisClusterStore,
+    RedisSentinelStore,
+    crc16,
+    hash_slot,
+)
+from seaweedfs_tpu.filer.redis_store import RespError
+
+from .miniredis import MiniRedis, MiniRedisCluster, MiniSentinel
+
+RNG = np.random.default_rng(0xC1E5)
+
+
+def _file(path: str, n: int = 1) -> Entry:
+    chunks = [FileChunk(file_id=f"3,{i:02x}", offset=i * 10, size=10)
+              for i in range(n)]
+    return Entry(full_path=path, attr=Attr(mode=0o660), chunks=chunks)
+
+
+@pytest.fixture()
+def cluster():
+    c = MiniRedisCluster(3)
+    yield c
+    c.stop()
+
+
+@pytest.fixture()
+def store(cluster):
+    # seed with ONLY the first node: discovery must find the rest
+    return RedisClusterStore([("127.0.0.1", cluster.nodes[0].port)])
+
+
+# --- keyslot ----------------------------------------------------------------
+
+def test_crc16_published_vector():
+    # cluster spec appendix A: CRC16("123456789") == 0x31C3
+    assert crc16(b"123456789") == 0x31C3
+    assert hash_slot(b"123456789") == 0x31C3 % 16384
+
+
+def test_hash_tags():
+    assert hash_slot(b"{user1000}.following") == hash_slot(
+        b"{user1000}.followers")
+    # empty tag is NOT extracted
+    assert hash_slot(b"foo{}{bar}") == crc16(b"foo{}{bar}") % 16384
+    # only the FIRST tag counts
+    assert hash_slot(b"foo{{bar}}zap") == crc16(b"{bar") % 16384
+
+
+# --- routing ----------------------------------------------------------------
+
+def test_routes_to_owning_node(cluster, store):
+    for i in range(40):
+        store.insert_entry(_file(f"/d/f{i:03d}"))
+    # every node holds SOME of the keys (keys spread over slots)
+    counts = [len(n.kv) for n in cluster.nodes]
+    assert all(c > 0 for c in counts), counts
+    # and every key sits on the node owning its slot
+    for n, (lo, hi) in zip(cluster.nodes, cluster.ranges):
+        for k in n.kv:
+            if k.startswith(b"/d/"):
+                assert lo <= hash_slot(k) <= hi
+
+
+def test_moved_redirect_converges(cluster, store):
+    store.insert_entry(_file("/m/a"))
+    key = b"/m/a"
+    slot = hash_slot(key)
+    old = cluster.owner_of(slot)
+    new = next(n for n in cluster.nodes if n is not old)
+    # transfer ownership (data moves with it) — the stale client map
+    # now points at the wrong node, which answers -MOVED
+    new.kv.update({k: v for k, v in old.kv.items()
+                   if hash_slot(k) == slot})
+    new.zsets.update({k: v for k, v in old.zsets.items()
+                      if hash_slot(k) == slot})
+    cluster.moved[slot] = new
+    got = store.find_entry("/m/a")
+    assert got is not None
+    # the refreshed map routes straight there now (no second MOVED):
+    # drop the override and confirm the map itself was updated
+    assert store.client._addr_for_slot(slot) == ("127.0.0.1", new.port)
+
+
+def test_ask_redirect_one_shot(cluster, store):
+    store.insert_entry(_file("/ask/x"))
+    key = b"/ask/x"
+    slot = hash_slot(key)
+    owner = cluster.owner_of(slot)
+    target = next(n for n in cluster.nodes if n is not owner)
+    # move the data to the import target, mark the slot migrating
+    for k in [k for k in owner.kv if hash_slot(k) == slot]:
+        target.kv[k] = owner.kv.pop(k)
+    cluster.migrating[slot] = target
+    assert store.find_entry("/ask/x") is not None
+    # ASK must NOT rewrite the slot map (migration isn't final)
+    assert store.client._addr_for_slot(slot) == ("127.0.0.1", owner.port)
+    del cluster.migrating[slot]
+
+
+def test_cross_slot_mget_split(cluster, store):
+    paths = [f"/mg/f{i}" for i in range(12)]
+    for p in paths:
+        store.insert_entry(_file(p))
+    # listing uses MGET over many slots — the double would CROSSSLOT
+    # a naive client
+    got = [e.full_path for e in store.list_directory_entries("/mg")]
+    assert got == sorted(paths)
+    # delete_folder_children: multi-key DEL split the same way
+    store.delete_folder_children("/mg")
+    assert store.find_entry("/mg/f0") is None
+
+
+def test_crossslot_enforced_by_double(cluster):
+    c = ClusterRespClient([("127.0.0.1", cluster.nodes[0].port)])
+    k1, k2 = b"aaa", b"bbb"
+    assert hash_slot(k1) != hash_slot(k2)
+    node = cluster.owner_of(hash_slot(k1))
+    with pytest.raises(RespError, match="CROSSSLOT"):
+        c._conn(("127.0.0.1", node.port)).command("MGET", k1, k2)
+
+
+def test_differential_vs_memory_store(store):
+    mem = MemoryStore()
+    names = [f"f{i:02d}" for i in range(18)]
+    for op in range(120):
+        r = RNG.integers(0, 10)
+        name = names[RNG.integers(0, len(names))]
+        path = f"/diff/{name}"
+        if r < 5:
+            e = _file(path, int(RNG.integers(1, 4)))
+            store.insert_entry(e)
+            mem.insert_entry(e)
+        elif r < 7:
+            store.delete_entry(path)
+            mem.delete_entry(path)
+        else:
+            a = store.find_entry(path)
+            b = mem.find_entry(path)
+            assert (a is None) == (b is None)
+            if a is not None:
+                assert a.to_dict() == b.to_dict()
+        if r == 9:
+            assert [e.full_path for e in
+                    store.list_directory_entries("/diff", limit=100)] == \
+                [e.full_path for e in
+                 mem.list_directory_entries("/diff", limit=100)]
+
+
+def test_kv_family_and_filer_e2e(store):
+    store.kv_put(b"\x01\x02", b"v1")
+    store.kv_put(b"\x01\x03", b"v2")
+    store.kv_put(b"\x99", b"other")
+    assert store.kv_get(b"\x01\x02") == b"v1"
+    assert [(k, v) for k, v in store.kv_scan(b"\x01")] == [
+        (b"\x01\x02", b"v1"), (b"\x01\x03", b"v2")]
+    store.kv_delete(b"\x01\x02")
+    assert store.kv_get(b"\x01\x02") is None
+
+    f = Filer(store=store)
+    f.create_entry(_file("/top/doc.txt", 2))
+    assert f.find_entry("/top/doc.txt").chunks[1].offset == 10
+    f.delete_entry("/top", recursive=True)
+
+
+def test_cluster_url_parsing(cluster):
+    url = "redis-cluster://" + ",".join(
+        f"127.0.0.1:{n.port}" for n in cluster.nodes)
+    s = RedisClusterStore.from_url(url)
+    s.insert_entry(_file("/u/x"))
+    assert s.find_entry("/u/x") is not None
+
+
+# --- sentinel ---------------------------------------------------------------
+
+def test_sentinel_discovery_and_failover():
+    m1, m2 = MiniRedis(), MiniRedis()
+    sent = MiniSentinel({"mymaster": ("127.0.0.1", m1.port)})
+    try:
+        url = f"redis-sentinel://127.0.0.1:{sent.port}/mymaster"
+        store = RedisSentinelStore.from_url(url)
+        store.insert_entry(_file("/s/a"))
+        assert store.find_entry("/s/a") is not None
+        assert m1.kv  # data went to the advertised master
+        # failover: promote m2, kill m1 — next op must rediscover
+        m2.kv.update(m1.kv)
+        m2.zsets.update(m1.zsets)
+        sent.masters["mymaster"] = ("127.0.0.1", m2.port)
+        m1.stop()
+        assert store.find_entry("/s/a") is not None
+        store.insert_entry(_file("/s/b"))
+        assert b"/s/b" in m2.kv
+    finally:
+        sent.stop()
+        m1.stop()
+        m2.stop()
+
+
+def test_sentinel_unknown_master_fails():
+    sent = MiniSentinel({})
+    try:
+        with pytest.raises((ConnectionError, OSError)):
+            RedisSentinelStore.from_url(
+                f"redis-sentinel://127.0.0.1:{sent.port}/nope")
+    finally:
+        sent.stop()

@@ -85,6 +85,14 @@ def _make_filer_store(db: str):
         from seaweedfs_tpu.filer.redis_store import RedisStore
 
         return RedisStore.from_url(db)
+    if db.startswith("redis-cluster://"):
+        from seaweedfs_tpu.filer.redis_cluster import RedisClusterStore
+
+        return RedisClusterStore.from_url(db)
+    if db.startswith("redis-sentinel://"):
+        from seaweedfs_tpu.filer.redis_cluster import RedisSentinelStore
+
+        return RedisSentinelStore.from_url(db)
     if db.startswith("etcd://"):
         from seaweedfs_tpu.filer.etcd_store import EtcdStore
 
@@ -946,6 +954,8 @@ def main(argv=None) -> None:
     fl.add_argument("-port", type=int, default=8888)
     fl.add_argument("-db", default="",
                     help="store: redis://[:pw@]host:port[/db], "
+                         "redis-cluster://h1:p1,h2:p2, "
+                         "redis-sentinel://h1:p1,h2:p2/master, "
                          "etcd://host:port, postgres://user:pw@host:port/db, "
                          "sql:/path.db -> abstract-SQL sqlite, "
                          "elastic://host:port, mongodb://host:port/db, "
