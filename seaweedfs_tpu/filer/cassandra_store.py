@@ -8,6 +8,12 @@ one `filemeta` table partitioned by directory and clustered by name
 (so listings are a sorted partition slice and DeleteFolderChildren is
 ONE partition delete, ref cassandra_store.go:174); kv entries ride a
 reserved partition.
+
+CAVEAT: validated against the in-process double
+(tests/minicassandra.py) plus spec-assembled byte transcripts
+(tests/test_protocol_transcripts.py pins STARTUP/QUERY framing
+and RESULT-Rows parsing to the CQL v4 spec); no live Cassandra
+runs in CI — the live test skips unless one is reachable.
 """
 
 from __future__ import annotations

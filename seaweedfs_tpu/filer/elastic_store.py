@@ -9,6 +9,10 @@ ParentId = md5(parent dir), plus a dedicated KV index.  Listings are a
 term query on ParentId sorted by name with search_after paging — done
 server-side here (the reference marks prefixed listing unsupported and
 filters client-side; this store filters with a prefix query instead).
+
+CAVEAT: validated against the in-process double
+(tests/minielastic.py), which shares this client's reading of
+the REST API — no live Elasticsearch runs in CI.
 """
 
 from __future__ import annotations

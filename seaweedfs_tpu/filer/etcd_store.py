@@ -14,6 +14,10 @@ Keyspace layout (binary-sortable, same trick as lsm_store):
 
 Listing is a single sorted Range with ``limit``; delete_folder_children
 is one DeleteRange over the subtree's key interval.
+
+CAVEAT: protocol-validated against the in-process double
+(tests/minietcd.py), which shares this client's reading of the
+v3 gateway API — no live etcd runs in CI.
 """
 
 from __future__ import annotations

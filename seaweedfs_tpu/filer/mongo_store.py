@@ -5,7 +5,14 @@ OP_MSG (opcode 2013, MongoDB 3.6+) framing with the bson_lite codec,
 plus optional SCRAM-SHA-256 auth (saslStart/saslContinue).  Same
 document shape as the reference: {directory, name, meta} in one
 collection, upserted on (directory, name); kv entries ride the same
-collection under a reserved directory."""
+collection under a reserved directory.
+CAVEAT: validated against the in-process double
+(tests/minimongo.py) plus published byte vectors
+(tests/test_protocol_transcripts.py pins bson_lite to the
+bsonspec.org examples and the OP_MSG frame to the wire-protocol
+doc); no live mongod runs in CI — the live test skips unless
+one is reachable.
+"""
 
 from __future__ import annotations
 

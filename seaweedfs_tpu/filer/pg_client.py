@@ -9,6 +9,11 @@ client-side SQL escaping anywhere.
 
 Counterpart of the reference's database/sql + lib/pq layer behind
 weed/filer/postgres/postgres_store.go.
+
+CAVEAT: validated against the in-process double (tests/minipg.py)
+plus the RFC 7677 SCRAM-SHA-256 worked example replayed verbatim
+(tests/test_protocol_transcripts.py); no live postgres runs in
+CI — the live test skips unless one is reachable.
 """
 
 from __future__ import annotations
@@ -31,6 +36,31 @@ class PgError(Exception):
     @property
     def code(self) -> str:
         return self.fields.get("C", "")
+
+
+def scram_derive(password: str, first_bare: str, server_first: str,
+                 gs2_header: bytes = b"n,,") -> tuple[str, bytes]:
+    """Pure SCRAM-SHA-256 client derivation (RFC 5802/7677): given the
+    client-first-bare and server-first messages, returns
+    (client-final-message, expected server signature).  Factored out of
+    the socket path so the RFC 7677 worked example drives it verbatim
+    in tests/test_protocol_transcripts.py."""
+    parts = dict(p.split("=", 1) for p in server_first.split(","))
+    r, s, i = parts["r"], parts["s"], int(parts["i"])
+    salted = hashlib.pbkdf2_hmac("sha256", password.encode(),
+                                 base64.b64decode(s), i)
+    client_key = hmac.new(salted, b"Client Key", hashlib.sha256).digest()
+    stored_key = hashlib.sha256(client_key).digest()
+    without_proof = f"c={base64.b64encode(gs2_header).decode()},r={r}"
+    auth_message = f"{first_bare},{server_first},{without_proof}"
+    sig = hmac.new(stored_key, auth_message.encode(),
+                   hashlib.sha256).digest()
+    proof = bytes(a ^ b for a, b in zip(client_key, sig))
+    final = f"{without_proof},p={base64.b64encode(proof).decode()}"
+    server_key = hmac.new(salted, b"Server Key", hashlib.sha256).digest()
+    server_sig = hmac.new(server_key, auth_message.encode(),
+                          hashlib.sha256).digest()
+    return final, server_sig
 
 
 def _msg(tag: bytes, payload: bytes) -> bytes:
@@ -139,19 +169,10 @@ class PgConn:
             raise PgError({"M": f"unexpected SASL response {kind}"})
         server_first = payload[4:].decode()
         parts = dict(p.split("=", 1) for p in server_first.split(","))
-        r, s, i = parts["r"], parts["s"], int(parts["i"])
-        if not r.startswith(nonce):
+        if not parts["r"].startswith(nonce):
             raise PgError({"M": "SCRAM nonce mismatch"})
-        salted = hashlib.pbkdf2_hmac("sha256", self.password.encode(),
-                                     base64.b64decode(s), i)
-        client_key = hmac.new(salted, b"Client Key", hashlib.sha256).digest()
-        stored_key = hashlib.sha256(client_key).digest()
-        without_proof = f"c={base64.b64encode(b'n,,').decode()},r={r}"
-        auth_message = f"{first_bare},{server_first},{without_proof}"
-        sig = hmac.new(stored_key, auth_message.encode(),
-                       hashlib.sha256).digest()
-        proof = bytes(a ^ b for a, b in zip(client_key, sig))
-        final = f"{without_proof},p={base64.b64encode(proof).decode()}"
+        final, want_sig = scram_derive(self.password, first_bare,
+                                       server_first)
         self._sock.sendall(_msg(b"p", final.encode()))
         tag, payload = self._recv()
         if tag == b"E":
@@ -159,12 +180,9 @@ class PgConn:
         (kind,) = struct.unpack(">I", payload[:4])
         if kind != 12:  # SASLFinal
             raise PgError({"M": f"SCRAM did not complete ({kind})"})
-        server_key = hmac.new(salted, b"Server Key", hashlib.sha256).digest()
-        want = hmac.new(server_key, auth_message.encode(),
-                        hashlib.sha256).digest()
         got = dict(p.split("=", 1)
                    for p in payload[4:].decode().split(",")).get("v", "")
-        if base64.b64decode(got) != want:
+        if base64.b64decode(got) != want_sig:
             raise PgError({"M": "SCRAM server signature mismatch"})
 
     # --- queries ----------------------------------------------------------

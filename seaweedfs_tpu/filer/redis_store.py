@@ -15,6 +15,11 @@ Same data model as redis2:
 The client is a pure-stdlib RESP2 implementation (socket + parser): the
 environment has no redis-py, and the protocol is small.  Works against any
 real Redis; tests run it against tests/miniredis.py.
+
+CAVEAT: protocol-validated against the in-process double
+(tests/miniredis.py), which shares this client's reading of the
+RESP2 spec — no live Redis runs in CI.  A real-server CRUD test
+exists but skips unless one is reachable.
 """
 
 from __future__ import annotations

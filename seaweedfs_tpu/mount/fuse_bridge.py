@@ -225,7 +225,9 @@ class FuseMount:
             fill(buf, b".", None, 0)
             fill(buf, b"..", None, 0)
             for e in wfs.readdir(path.decode()):
-                fill(buf, e.name.encode(), None, 0)
+                name = e.name.encode()
+                if name:  # an empty dirent name EIOs the whole listing
+                    fill(buf, name, None, 0)
 
         @self._guard
         def op_mkdir(path, mode):

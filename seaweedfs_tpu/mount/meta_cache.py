@@ -55,9 +55,14 @@ class MetaCache:
     def list_cached(self, dir_path: str) -> list[Entry]:
         prefix = dir_path.rstrip("/") + "/"
         with self._lock:
+            # p != dir_path: "/" starts with its own prefix "/", so a
+            # cached ROOT entry would list itself as a nameless child —
+            # an empty dirent name makes the kernel fail getdents with
+            # EIO (found by the kernel-boundary mount test)
             return sorted(
                 (e for p, e in self._entries.items()
-                 if p.startswith(prefix) and "/" not in p[len(prefix):]),
+                 if p != dir_path and p.startswith(prefix)
+                 and "/" not in p[len(prefix):]),
                 key=lambda e: e.full_path)
 
     # --- subscription (meta_cache_subscribe.go) ---------------------------

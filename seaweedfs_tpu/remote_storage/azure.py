@@ -12,6 +12,10 @@ Delete Blob.  SharedKey signing follows the documented canonicalization:
 HMAC-SHA256 of the verb + standard headers + canonicalized x-ms-*
 headers + canonicalized resource, keyed by the base64-decoded account
 key.
+
+CAVEAT: protocol-validated against the in-process double
+(tests/miniazure.py), which shares this client's reading of the
+Blob REST + SharedKey signing docs — no live account in CI.
 """
 
 from __future__ import annotations

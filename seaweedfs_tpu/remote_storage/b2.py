@@ -6,7 +6,11 @@ b2_sink.go + weed/remote_storage) — this client speaks the documented
 wire protocol directly: b2_authorize_account (Basic auth), bucket CRUD,
 b2_list_file_names paging, the get-upload-url/upload two-step with
 X-Bz-Content-Sha1, ranged downloads and delete-by-file-version.
-Auth tokens refresh transparently on 401 (they expire server-side)."""
+Auth tokens refresh transparently on 401 (they expire server-side).
+CAVEAT: protocol-validated against the in-process double
+(tests/minib2.py), which shares this client's reading of the
+b2api/v2 docs — no live B2 account in CI.
+"""
 
 from __future__ import annotations
 

@@ -10,6 +10,10 @@ Operations: LISTSTATUS (recursive traverse), OPEN (with offset/length),
 CREATE (two-step redirect to the datanode, like the protocol requires),
 DELETE, MKDIRS.  "Buckets" map to top-level directories under the
 configured root path, mirroring the reference's hdfs mapping.
+
+CAVEAT: protocol-validated against the in-process double
+(tests/minihdfs.py), which shares this client's reading of the
+WebHDFS REST API — no live namenode runs in CI.
 """
 
 from __future__ import annotations

@@ -12,6 +12,10 @@ Works against any broker >= 0.11 (the RecordBatch v2 era).  Partitions
 are chosen by key hash; leader metadata is cached and refreshed on
 NOT_LEADER errors.  Tests run it against a CRC-verifying in-process
 broker double (tests/minikafka.py).
+
+CAVEAT: protocol-validated against the in-process double
+(tests/minikafka.py), which shares this client's reading of the
+Kafka protocol — no live broker runs in CI.
 """
 
 from __future__ import annotations

@@ -1,0 +1,95 @@
+"""Minimal protobuf wire-format codec (proto2/proto3 compatible subset).
+
+The HBase native RPC (filer/hbase_store.py) is protobuf-framed; the
+image has no protobuf runtime or HBase .proto files, so messages are
+built and parsed explicitly against their published field numbers with
+this ~100-line codec.  Only the wire types the HBase surface uses:
+varint (0), 64-bit (1), length-delimited (2), 32-bit (5).
+
+Encoding helpers return bytes; messages are just concatenations of
+encoded fields, which keeps each protocol message definition readable
+at its call site (field numbers visible, like a .proto)."""
+
+from __future__ import annotations
+
+
+def enc_varint(n: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def dec_varint(buf: bytes, i: int) -> tuple[int, int]:
+    shift = n = 0
+    while True:
+        b = buf[i]
+        i += 1
+        n |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return n, i
+        shift += 7
+        if shift > 70:
+            raise ValueError("varint too long")
+
+
+def f_varint(num: int, val: int) -> bytes:
+    return enc_varint(num << 3 | 0) + enc_varint(val)
+
+
+def f_bytes(num: int, data: bytes) -> bytes:
+    return enc_varint(num << 3 | 2) + enc_varint(len(data)) + data
+
+
+def f_string(num: int, s: str) -> bytes:
+    return f_bytes(num, s.encode())
+
+
+def f_msg(num: int, msg: bytes) -> bytes:
+    return f_bytes(num, msg)
+
+
+def delimited(msg: bytes) -> bytes:
+    """varint-length-prefixed message (protobuf writeDelimitedTo)."""
+    return enc_varint(len(msg)) + msg
+
+
+def read_delimited(buf: bytes, i: int) -> tuple[bytes, int]:
+    n, i = dec_varint(buf, i)
+    return buf[i:i + n], i + n
+
+
+def decode(buf: bytes) -> dict[int, list]:
+    """-> {field_number: [values in wire order]}; varints as int,
+    length-delimited as bytes, fixed32/64 as int (little-endian)."""
+    out: dict[int, list] = {}
+    i = 0
+    while i < len(buf):
+        tag, i = dec_varint(buf, i)
+        num, wire = tag >> 3, tag & 7
+        if wire == 0:
+            val, i = dec_varint(buf, i)
+        elif wire == 1:
+            val = int.from_bytes(buf[i:i + 8], "little")
+            i += 8
+        elif wire == 2:
+            n, i = dec_varint(buf, i)
+            val = buf[i:i + n]
+            i += n
+        elif wire == 5:
+            val = int.from_bytes(buf[i:i + 4], "little")
+            i += 4
+        else:
+            raise ValueError(f"unsupported wire type {wire}")
+        out.setdefault(num, []).append(val)
+    return out
+
+
+def first(fields: dict[int, list], num: int, default=None):
+    vals = fields.get(num)
+    return vals[0] if vals else default

@@ -1,0 +1,246 @@
+"""In-process HBase RegionServer double speaking the native RPC framing.
+
+Test double for a real HBase regionserver (the image has no HBase or
+protobuf runtime): validates the ``HBas`` preamble + SIMPLE auth code,
+parses the length-prefixed ConnectionHeader, then serves
+call_id-matched Get/Mutate/Scan over the same field numbers
+filer/hbase_store.py emits (utils/pb_lite both ends — the store's
+docstring carries the double-only caveat).
+
+Serves TWO regions: the well-known ``hbase:meta`` region (region rows
+with info:regioninfo + info:server cells, so the client's region
+discovery runs the real algorithm) and one user-table region.  Unknown
+regions/tables answer a NotServingRegionException through
+ResponseHeader.exception, wrong preambles drop the connection (what a
+kerberized cluster does to a SIMPLE client), and stop() kills live
+connections so reconnect drills see a dead server.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+
+from seaweedfs_tpu.utils import pb_lite as pb
+from seaweedfs_tpu.utils.pb_lite import f_bytes, f_msg, f_string, f_varint
+
+META_REGION = b"hbase:meta,,1"
+
+
+def _cell(row: bytes, fam: bytes, qual: bytes, value: bytes) -> bytes:
+    return (f_bytes(1, row) + f_bytes(2, fam) + f_bytes(3, qual) +
+            f_varint(4, 1) + f_varint(5, 4) + f_bytes(6, value))
+
+
+def _result(cells: list[bytes]) -> bytes:
+    return b"".join(f_msg(1, c) for c in cells)
+
+
+class MiniHBase:
+    def __init__(self, table: str = "seaweedfs", require_auth: int = 0x50):
+        self.table = table.encode()
+        self.require_auth = require_auth
+        # rows: {row: {family: {qualifier: value}}}, sorted on scan
+        self.rows: dict[bytes, dict[bytes, dict[bytes, bytes]]] = {}
+        self.lock = threading.Lock()
+        self._scanners: dict[int, list[tuple[bytes, bytes]]] = {}
+        self._next_scanner = 1
+        self._conns: set[socket.socket] = set()
+        self._srv = socket.socket()
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind(("127.0.0.1", 0))
+        self._srv.listen(16)
+        self.port = self._srv.getsockname()[1]
+        self._stop = False
+        threading.Thread(target=self._accept, daemon=True).start()
+
+    @property
+    def region(self) -> bytes:
+        return self.table + b",,1.0123456789abcdef0123456789abcdef."
+
+    def stop(self) -> None:
+        self._stop = True
+        for s in [self._srv] + list(self._conns):
+            try:
+                s.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                s.close()
+            except OSError:
+                pass
+
+    # -- server loop ---------------------------------------------------------
+    def _accept(self) -> None:
+        while not self._stop:
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def _serve(self, conn: socket.socket) -> None:
+        self._conns.add(conn)
+
+        def read_exact(n: int) -> bytes:
+            chunks = []
+            while n:
+                piece = conn.recv(min(n, 1 << 16))
+                if not piece:
+                    raise ConnectionError
+                chunks.append(piece)
+                n -= len(piece)
+            return b"".join(chunks)
+
+        try:
+            preamble = read_exact(6)
+            if preamble[:4] != b"HBas" or preamble[5] != self.require_auth:
+                return  # kerberized cluster: SIMPLE clients get dropped
+            (hlen,) = struct.unpack(">I", read_exact(4))
+            hdr = pb.decode(read_exact(hlen))
+            if pb.first(hdr, 2, b"") != b"ClientService":
+                return
+            while True:
+                (total,) = struct.unpack(">I", read_exact(4))
+                body = read_exact(total)
+                req_hdr, i = pb.read_delimited(body, 0)
+                param, _ = pb.read_delimited(body, i)
+                hf = pb.decode(req_hdr)
+                call_id = pb.first(hf, 1, 0)
+                method = pb.first(hf, 3, b"").decode()
+                try:
+                    resp = self._dispatch(method, pb.decode(param))
+                    out = pb.delimited(f_varint(1, call_id)) + \
+                        pb.delimited(resp)
+                except _Exc as e:
+                    exc = f_string(1, e.class_name) + f_string(2, str(e))
+                    out = pb.delimited(f_varint(1, call_id) + f_msg(2, exc))
+                conn.sendall(struct.pack(">I", len(out)) + out)
+        except (ConnectionError, OSError, struct.error):
+            pass
+        finally:
+            self._conns.discard(conn)
+            conn.close()
+
+    # -- dispatch ------------------------------------------------------------
+    def _check_region(self, param: dict) -> bytes:
+        spec = pb.first(param, 1)
+        if spec is None:
+            return b""
+        name = pb.first(pb.decode(spec), 2, b"")
+        if name not in (self.region, META_REGION):
+            raise _Exc("org.apache.hadoop.hbase.NotServingRegionException",
+                       name.decode(errors="replace"))
+        return name
+
+    def _dispatch(self, method: str, param: dict) -> bytes:
+        if method == "Get":
+            region = self._check_region(param)
+            get = pb.decode(pb.first(param, 2, b""))
+            row = pb.first(get, 1, b"")
+            fams = [pb.first(pb.decode(c), 1, b"")
+                    for c in get.get(2, [])]
+            with self.lock:
+                cells = []
+                for fam, quals in self.rows.get(row, {}).items():
+                    if fams and fam not in fams:
+                        continue
+                    for qual, val in quals.items():
+                        cells.append(_cell(row, fam, qual, val))
+            return f_msg(1, _result(cells)) if cells else b""
+        if method == "Mutate":
+            self._check_region(param)
+            mut = pb.decode(pb.first(param, 2, b""))
+            row = pb.first(mut, 1, b"")
+            mtype = pb.first(mut, 2, 2)
+            with self.lock:
+                for cv in mut.get(3, []):
+                    cvf = pb.decode(cv)
+                    fam = pb.first(cvf, 1, b"")
+                    for qv in cvf.get(2, []):
+                        qvf = pb.decode(qv)
+                        qual = pb.first(qvf, 1, b"")
+                        if mtype == 3:  # DELETE
+                            fammap = self.rows.get(row, {})
+                            fammap.get(fam, {}).pop(qual, None)
+                            if fammap.get(fam) == {}:
+                                fammap.pop(fam, None)
+                            if self.rows.get(row) == {}:
+                                self.rows.pop(row, None)
+                        else:  # PUT
+                            val = pb.first(qvf, 2, b"")
+                            self.rows.setdefault(row, {}).setdefault(
+                                fam, {})[qual] = val
+            return f_varint(2, 1)  # MutateResponse.processed
+        if method == "Scan":
+            return self._scan(param)
+        raise _Exc("org.apache.hadoop.hbase.DoNotRetryIOException",
+                   f"unknown method {method}")
+
+    def _scan(self, param: dict) -> bytes:
+        scanner_id = pb.first(param, 3)
+        batch = pb.first(param, 4, 64)
+        if pb.first(param, 5, 0):  # close_scanner
+            if scanner_id is not None:
+                self._scanners.pop(scanner_id, None)
+            return b""
+        if scanner_id is None:  # open: build the full result list
+            region = self._check_region(param)
+            scan = pb.decode(pb.first(param, 2, b""))
+            start = pb.first(scan, 3, b"")
+            fams = [pb.first(pb.decode(c), 1, b"")
+                    for c in scan.get(1, [])]
+            pending: list[tuple[bytes, bytes, bytes, bytes]] = []
+            if region == META_REGION:
+                pending = self._meta_rows(start)
+            else:
+                with self.lock:
+                    for row in sorted(self.rows):
+                        if row < start:
+                            continue
+                        for fam, quals in sorted(
+                                self.rows[row].items()):
+                            if fams and fam not in fams:
+                                continue
+                            for qual, val in sorted(quals.items()):
+                                pending.append((row, fam, qual, val))
+            scanner_id = self._next_scanner
+            self._next_scanner += 1
+            self._scanners[scanner_id] = pending
+        pending = self._scanners.get(scanner_id, [])
+        page, rest = pending[:batch], pending[batch:]
+        self._scanners[scanner_id] = rest
+        # real HBase groups a row's cells into ONE Result
+        grouped: list[list] = []
+        for c in page:
+            if grouped and grouped[-1][0][0] == c[0]:
+                grouped[-1].append(c)
+            else:
+                grouped.append([c])
+        results = b"".join(
+            f_msg(5, _result([_cell(*c) for c in cells]))
+            for cells in grouped)
+        more = 1 if rest else 0
+        if not more:
+            self._scanners.pop(scanner_id, None)
+        return (f_varint(2, scanner_id) + f_varint(3, more) + results)
+
+    def _meta_rows(self, start: bytes):
+        """hbase:meta content: one region row for the user table, with
+        info:regioninfo (RegionInfo proto) + info:server cells."""
+        # RegionInfo{region_id=1, table_name{namespace=1,qualifier=2}=2}
+        ri = (f_varint(1, 1) +
+              f_msg(2, f_bytes(1, b"default") + f_bytes(2, self.table)))
+        row = self.region
+        rows = [(row, b"info", b"regioninfo", ri),
+                (row, b"info", b"server",
+                 f"127.0.0.1:{self.port}".encode())]
+        return [c for c in rows if c[0] >= start]
+
+
+class _Exc(Exception):
+    def __init__(self, class_name: str, detail: str = ""):
+        super().__init__(detail)
+        self.class_name = class_name

@@ -1,0 +1,142 @@
+"""Kernel-boundary FUSE mount test (gated on /dev/fuse + libfuse2).
+
+Drives mount/fuse_bridge.py through the REAL kernel: `weed mount` runs
+as a subprocess against an in-process master/volume/filer trio, and the
+test then exercises the VFS — mkdir, create, write, read, stat,
+rename, listings, unlink, rmdir — via plain os calls on the
+mountpoint.  Skips cleanly when /dev/fuse, fusermount, or libfuse.so.2
+is absent (containers without --device /dev/fuse).
+
+This is the test round-3's review found missing: the ctypes ABI layer
+(struct layouts, callback signatures, dirent filling) only breaks at
+the kernel boundary — its first run found a real bug (a cached root
+entry listing itself as a nameless child, which EIO'd every subsequent
+root getdents).  ref: weed/mount/weedfs.go:57.
+"""
+
+from __future__ import annotations
+
+import ctypes.util
+import os
+import shutil
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from .conftest import free_port
+
+pytestmark = pytest.mark.skipif(
+    not os.path.exists("/dev/fuse")
+    or shutil.which("fusermount") is None
+    or ctypes.util.find_library("fuse") is None,
+    reason="kernel FUSE unavailable (/dev/fuse, fusermount, libfuse2)")
+
+
+@pytest.fixture()
+def kernel_mount(tmp_path):
+    from seaweedfs_tpu.filer.server import FilerServer
+    from seaweedfs_tpu.master.server import MasterServer
+    from seaweedfs_tpu.volume_server.server import VolumeServer
+
+    master = MasterServer(port=free_port(), pulse_seconds=0.3).start()
+    (tmp_path / "v").mkdir()
+    vol = VolumeServer([str(tmp_path / "v")], master.url, port=free_port(),
+                       pulse_seconds=0.3).start()
+    deadline = time.time() + 6
+    while time.time() < deadline and not master.topo.all_nodes():
+        time.sleep(0.05)
+    filer = FilerServer(master.url, port=free_port()).start()
+    mp = tmp_path / "mp"
+    mp.mkdir()
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(repo, "weed.py"), "mount",
+         "-filer", filer.url, "-dir", str(mp)],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    try:
+        deadline = time.time() + 15
+        mounted = False
+        while time.time() < deadline:
+            with open("/proc/mounts") as f:
+                if str(mp) in f.read():
+                    mounted = True
+                    break
+            if proc.poll() is not None:
+                break
+            time.sleep(0.2)
+        if not mounted:
+            pytest.skip("fuse mount did not come up "
+                        f"(mount rc={proc.poll()})")
+        yield str(mp)
+    finally:
+        subprocess.run(["fusermount", "-u", str(mp)],
+                       stdout=subprocess.DEVNULL,
+                       stderr=subprocess.DEVNULL)
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+        filer.stop()
+        vol.stop()
+        master.stop()
+
+
+def test_kernel_vfs_operations(kernel_mount):
+    mp = kernel_mount
+    # mkdir + create + small write/read
+    os.mkdir(f"{mp}/docs")
+    with open(f"{mp}/docs/a.txt", "w") as f:
+        f.write("hello kernel")
+    with open(f"{mp}/docs/a.txt") as f:
+        assert f.read() == "hello kernel"
+    # multi-chunk payload through the page_writer upload pipeline
+    rng = np.random.default_rng(0xF05E)
+    data = rng.integers(0, 256, 3 << 20, dtype=np.uint8).tobytes()
+    with open(f"{mp}/docs/big.bin", "wb") as f:
+        f.write(data)
+    with open(f"{mp}/docs/big.bin", "rb") as f:
+        assert f.read() == data
+    assert os.stat(f"{mp}/docs/big.bin").st_size == len(data)
+    # ranged read through the kernel page cache boundary
+    with open(f"{mp}/docs/big.bin", "rb") as f:
+        f.seek(1 << 20)
+        assert f.read(4096) == data[1 << 20:(1 << 20) + 4096]
+    # rename + listings (root listing REPEATEDLY: a cached-root bug made
+    # every getdents after the first fail with EIO)
+    os.rename(f"{mp}/docs/big.bin", f"{mp}/docs/renamed.bin")
+    assert sorted(os.listdir(f"{mp}/docs")) == ["a.txt", "renamed.bin"]
+    for _ in range(3):
+        assert os.listdir(mp) == ["docs"]
+    # unlink + rmdir
+    os.unlink(f"{mp}/docs/renamed.bin")
+    os.unlink(f"{mp}/docs/a.txt")
+    os.rmdir(f"{mp}/docs")
+    assert os.listdir(mp) == []
+
+
+def test_kernel_mount_survives_stat_of_missing(kernel_mount):
+    mp = kernel_mount
+    with pytest.raises(FileNotFoundError):
+        os.stat(f"{mp}/no-such-file")
+    # and the mount still works afterwards
+    os.mkdir(f"{mp}/ok")
+    assert os.path.isdir(f"{mp}/ok")
+    os.rmdir(f"{mp}/ok")
+
+
+def test_meta_cache_root_listing_excludes_root():
+    """In-process regression for the kernel-found bug: a cached root
+    entry must not appear in its own listing as a nameless child."""
+    from seaweedfs_tpu.filer.entry import Attr, Entry
+    from seaweedfs_tpu.mount.meta_cache import MetaCache
+
+    mc = MetaCache("unused:0")
+    mc.put(Entry(full_path="/", attr=Attr(mode=0o755)))
+    mc.put(Entry(full_path="/child", attr=Attr(mode=0o644)))
+    names = [e.name for e in mc.list_cached("/")]
+    assert names == ["child"]
+    assert "" not in names

@@ -1,8 +1,10 @@
 """Mount layer: inode map, page writer, meta cache, WFS op surface.
 
 Reference behaviors: weed/mount/inode_to_path.go, page_writer/,
-meta_cache/, weedfs_*.go op files.  Everything runs in-process — the
-kernel boundary is exercised separately (gated on /dev/fuse).
+meta_cache/, weedfs_*.go op files.  Everything here runs in-process —
+the kernel boundary (mount/fuse_bridge.py through a real /dev/fuse
+mount) is exercised by tests/test_fuse_kernel.py, which skips when the
+environment has no FUSE.
 """
 
 from __future__ import annotations

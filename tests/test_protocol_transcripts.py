@@ -1,0 +1,228 @@
+"""Canonical byte-level transcripts for the wire-protocol clients.
+
+Round-3 verdict: every wire store was validated only against doubles
+written by the same author — "consistent with my own assumptions".
+These tests pin the clients to bytes that did NOT originate here:
+
+- SCRAM-SHA-256: the RFC 7677 §3 worked example, replayed verbatim
+  through the pg client's extracted derivation (same function the
+  socket path calls) — proof and server signature must match the RFC's
+  published base64 exactly.
+- BSON: the two worked examples published on bsonspec.org ("hello
+  world" and the awesome/5.05/1986 array), byte-for-byte against
+  bson_lite in both directions.
+- MongoDB OP_MSG: the client's frame for a known command must equal a
+  hand-assembled frame built ONLY from the MongoDB wire-protocol doc
+  (msgHeader layout, opcode 2013, flagBits, kind-0 section).
+- CQL v4: the client's STARTUP and QUERY frames must equal frames
+  hand-assembled from the CQL binary protocol v4 spec (§2 frame
+  header, §4.1.1 STARTUP string map, §4.1.4 QUERY body), and a
+  RESULT/Rows frame assembled from §4.2.5.2 must parse to the right
+  tuples.
+
+Plus skip-if-unreachable LIVE tests: when a real postgres / mongo /
+cassandra answers on the standard localhost port (or WEED_TEST_PG /
+WEED_TEST_MONGO / WEED_TEST_CASSANDRA gives host:port), the store runs
+a CRUD cycle against the real server.
+"""
+
+from __future__ import annotations
+
+import base64
+import os
+import socket
+import struct
+
+import pytest
+
+from seaweedfs_tpu.filer import bson_lite as bson
+from seaweedfs_tpu.filer.pg_client import scram_derive
+
+# --- SCRAM-SHA-256: RFC 7677 §3 worked example ------------------------------
+
+RFC7677_FIRST_BARE = "n=user,r=rOprNGfwEbeRWgbNEkqO"
+RFC7677_SERVER_FIRST = ("r=rOprNGfwEbeRWgbNEkqO%hvYDpWUa2RaTCAfuxFIlj)hNlF$k0,"
+                        "s=W22ZaJ0SNY7soEsUEjb6gQ==,i=4096")
+RFC7677_CLIENT_FINAL = ("c=biws,"
+                        "r=rOprNGfwEbeRWgbNEkqO%hvYDpWUa2RaTCAfuxFIlj)hNlF$k0,"
+                        "p=dHzbZapWIk4jUhN+Ute9ytag9zjfMHgsqmmiz7AndVQ=")
+RFC7677_SERVER_SIG = "6rriTRBi23WpRR/wtup+mMhUZUn/dB5nLTJRsjl95G4="
+
+
+def test_scram_sha256_rfc7677_vector():
+    final, server_sig = scram_derive("pencil", RFC7677_FIRST_BARE,
+                                     RFC7677_SERVER_FIRST)
+    assert final == RFC7677_CLIENT_FINAL
+    assert base64.b64encode(server_sig).decode() == RFC7677_SERVER_SIG
+
+
+# --- BSON: bsonspec.org published examples ----------------------------------
+
+BSON_HELLO = (b"\x16\x00\x00\x00\x02hello\x00\x06\x00\x00\x00world\x00\x00")
+BSON_AWESOME = (b"1\x00\x00\x00\x04BSON\x00&\x00\x00\x00\x020\x00\x08\x00"
+                b"\x00\x00awesome\x00\x011\x00333333\x14@\x102\x00\xc2\x07"
+                b"\x00\x00\x00\x00")
+
+
+def test_bson_spec_examples():
+    assert bson.encode({"hello": "world"}) == BSON_HELLO
+    assert bson.decode(BSON_HELLO) == {"hello": "world"}
+    assert bson.encode({"BSON": ["awesome", 5.05, 1986]}) == BSON_AWESOME
+    assert bson.decode(BSON_AWESOME) == {"BSON": ["awesome", 5.05, 1986]}
+
+
+# --- MongoDB OP_MSG framing --------------------------------------------------
+
+class _RecorderSock:
+    """Captures sendall bytes; serves a canned receive stream."""
+
+    def __init__(self, reply: bytes = b""):
+        self.sent = b""
+        self._reply = reply
+
+    def sendall(self, data: bytes) -> None:
+        self.sent += bytes(data)
+
+    def recv(self, n: int) -> bytes:
+        piece, self._reply = self._reply[:n], self._reply[n:]
+        return piece
+
+    def close(self) -> None:
+        pass
+
+
+def test_mongo_op_msg_frame_matches_spec():
+    from seaweedfs_tpu.filer.mongo_store import MongoClient
+
+    doc = {"ping": 1, "$db": "admin"}
+    body = bson.encode(doc)
+    # hand-assembled per the MongoDB wire protocol doc: msgHeader
+    # {messageLength, requestID, responseTo, opCode=2013} then OP_MSG
+    # {flagBits u32=0, section kind byte 0, document}
+    payload = struct.pack("<I", 0) + b"\x00" + body
+    expect = struct.pack("<iiii", 16 + len(payload), 1, 0, 2013) + payload
+
+    reply_doc = bson.encode({"ok": 1})
+    reply_payload = struct.pack("<I", 0) + b"\x00" + reply_doc
+    reply = struct.pack("<iiii", 16 + len(reply_payload), 7, 1,
+                        2013) + reply_payload
+
+    c = MongoClient.__new__(MongoClient)
+    c._req_id = 0
+    c._sock = _RecorderSock(reply)
+    out = c._roundtrip_locked(doc)
+    assert c._sock.sent == expect
+    assert out == {"ok": 1}
+
+
+# --- CQL v4 framing -----------------------------------------------------------
+
+def test_cql_startup_and_query_frames_match_spec():
+    from seaweedfs_tpu.filer.cassandra_store import (
+        CONSISTENCY_ONE,
+        OP_QUERY,
+        OP_STARTUP,
+        CqlClient,
+        _string_map,
+    )
+
+    c = CqlClient.__new__(CqlClient)
+    c._sock = _RecorderSock()
+    # STARTUP (spec §4.1.1): string map {"CQL_VERSION": "3.0.0"}
+    c._send_frame(OP_STARTUP, _string_map({"CQL_VERSION": "3.0.0"}))
+    startup_body = (b"\x00\x01" +                      # map size [short]
+                    b"\x00\x0bCQL_VERSION" +           # [string] key
+                    b"\x00\x053.0.0")                  # [string] value
+    # frame header (§2): version 0x04 request, flags 0, stream i16 0,
+    # opcode, length u32
+    expect = struct.pack(">BBhBI", 0x04, 0, 0, OP_STARTUP,
+                         len(startup_body)) + startup_body
+    assert c._sock.sent == expect
+
+    # QUERY (§4.1.4): [long string] query, [consistency], [flags]
+    c._sock = _RecorderSock()
+    q = b"SELECT name FROM filemeta"
+    c._send_frame(OP_QUERY, struct.pack(">I", len(q)) + q +
+                  struct.pack(">H", CONSISTENCY_ONE) + b"\x00")
+    qbody = struct.pack(">I", len(q)) + q + b"\x00\x01" + b"\x00"
+    expect = struct.pack(">BBhBI", 0x04, 0, 0, OP_QUERY,
+                         len(qbody)) + qbody
+    assert c._sock.sent == expect
+
+
+def test_cql_result_rows_parse_from_spec_bytes():
+    from seaweedfs_tpu.filer.cassandra_store import CqlClient
+
+    # RESULT/Rows metadata (§4.2.5.2): flags=1 (global table spec),
+    # 2 columns, ks/table strings, per-column name + type; then rows
+    def s(x: bytes) -> bytes:  # [string]
+        return struct.pack(">H", len(x)) + x
+
+    meta = (struct.pack(">iI", 0x0001, 2) + s(b"ks") + s(b"filemeta") +
+            s(b"name") + struct.pack(">H", 0x000D) +   # varchar
+            s(b"meta") + struct.pack(">H", 0x0003))    # blob
+    rows = struct.pack(">I", 2)
+    for name, val in ((b"a.txt", b"\x01\x02"), (b"b.txt", b"\x03")):
+        rows += struct.pack(">i", len(name)) + name
+        rows += struct.pack(">i", len(val)) + val
+    got = CqlClient._parse_rows(meta + rows)
+    assert got == [(b"a.txt", b"\x01\x02"), (b"b.txt", b"\x03")]
+
+
+# --- live servers (skip-if-unreachable) --------------------------------------
+
+def _reachable(env: str, default_port: int) -> tuple[str, int] | None:
+    spec = os.environ.get(env, f"127.0.0.1:{default_port}")
+    host, _, port_s = spec.partition(":")
+    try:
+        with socket.create_connection((host, int(port_s)), timeout=0.5):
+            return host, int(port_s)
+    except OSError:
+        return None
+
+
+def _store_crud_cycle(store):
+    from seaweedfs_tpu.filer.entry import Attr, Entry
+
+    e = Entry(full_path="/live-test/x.txt", attr=Attr(mode=0o660))
+    store.insert_entry(e)
+    try:
+        got = store.find_entry("/live-test/x.txt")
+        assert got is not None and got.attr.mode == 0o660
+        assert "/live-test/x.txt" in [
+            x.full_path for x in store.list_directory_entries("/live-test")]
+    finally:
+        store.delete_entry("/live-test/x.txt")
+    assert store.find_entry("/live-test/x.txt") is None
+
+
+def test_live_postgres():
+    addr = _reachable("WEED_TEST_PG", 5432)
+    if addr is None:
+        pytest.skip("no postgres at WEED_TEST_PG/localhost:5432")
+    from seaweedfs_tpu.filer.pg_client import PgConn
+    from seaweedfs_tpu.filer.sql_store import AbstractSqlStore
+
+    conn = PgConn(addr[0], addr[1],
+                  user=os.environ.get("WEED_TEST_PG_USER", "postgres"),
+                  password=os.environ.get("WEED_TEST_PG_PASSWORD", ""),
+                  database=os.environ.get("WEED_TEST_PG_DB", "postgres"))
+    _store_crud_cycle(AbstractSqlStore(conn, "postgres"))
+
+
+def test_live_mongo():
+    addr = _reachable("WEED_TEST_MONGO", 27017)
+    if addr is None:
+        pytest.skip("no mongod at WEED_TEST_MONGO/localhost:27017")
+    from seaweedfs_tpu.filer.mongo_store import MongoClient, MongoStore
+
+    _store_crud_cycle(MongoStore(MongoClient(host=addr[0], port=addr[1])))
+
+
+def test_live_cassandra():
+    addr = _reachable("WEED_TEST_CASSANDRA", 9042)
+    if addr is None:
+        pytest.skip("no cassandra at WEED_TEST_CASSANDRA/localhost:9042")
+    from seaweedfs_tpu.filer.cassandra_store import CassandraStore, CqlClient
+
+    _store_crud_cycle(CassandraStore(CqlClient(host=addr[0], port=addr[1])))

@@ -129,6 +129,10 @@ def _make_filer_store(db: str):
         from seaweedfs_tpu.filer.cassandra_store import CassandraStore
 
         return CassandraStore.from_url(db)
+    if db.startswith("hbase://"):
+        from seaweedfs_tpu.filer.hbase_store import HbaseStore
+
+        return HbaseStore.from_url(db)
     if db.endswith(".lsm"):
         # prefer the native C++ engine; the Python engine shares the
         # on-disk format, so falling back never strands a directory
@@ -391,6 +395,9 @@ _SCAFFOLDS = {
 #   elastic://host:port              elasticsearch REST (index per top dir)
 #   mongodb://[user:pw@]host:port/db mongo OP_MSG wire protocol
 #   cassandra://[user:pw@]host:port  CQL v4 binary protocol
+#   hbase://host:port/table          HBase native RegionServer RPC
+#   redis-cluster://h1:p1,h2:p2      Redis Cluster (MOVED/ASK aware)
+#   redis-sentinel://h:p,h:p/master  Redis via Sentinel discovery
 # Per-path rules (collection, replication, ttl, fsync) live IN the
 # filesystem at /etc/seaweedfs/filer.conf — edit with `fs.configure`.
 ''',
@@ -959,7 +966,7 @@ def main(argv=None) -> None:
                          "etcd://host:port, postgres://user:pw@host:port/db, "
                          "sql:/path.db -> abstract-SQL sqlite, "
                          "elastic://host:port, mongodb://host:port/db, "
-                         "cassandra://host:port, "
+                         "cassandra://host:port, hbase://host:port/table, "
                          "*.lsm -> LSM store dir, else "
                          "sqlite path (default: memory)")
     fl.add_argument("-peers", default="",
