@@ -241,8 +241,13 @@ class HbaseStore:
                                      f_varint(5, 1))   # close_scanner
                 except (HBaseError, OSError, ConnectionError):
                     pass
+            if meta_client is not self.client:
+                meta_client.close()  # swapped to info:server's node
         raise HBaseError("TableNotFoundException",
                          f"no region for {self.table.decode()} in meta")
+
+    def close(self) -> None:
+        self.client.close()
 
     # -- low-level ops (doGet/doPut/doDelete analogs) ------------------------
     def _get(self, cf: bytes, key: bytes) -> Optional[bytes]:
@@ -285,22 +290,42 @@ class HbaseStore:
         req = f_msg(1, _region_specifier(self._region)) + f_msg(2, mutation)
         self.client.call("Mutate", req)
 
-    def _scan(self, cf: bytes, start: bytes,
-              batch: int = 128) -> Iterator[tuple[bytes, bytes]]:
-        """(row, value) pairs from start onward, in row order."""
+    def _open_scan(self, cf: bytes, start: bytes, batch: int) -> bytes:
         scan = (f_bytes(3, start) +
                 f_msg(1, f_bytes(1, cf) + f_bytes(2, COLUMN)))
-        req = (f_msg(1, _region_specifier(self._region)) +
-               f_msg(2, scan) + f_varint(4, batch))
+        return (f_msg(1, _region_specifier(self._region)) +
+                f_msg(2, scan) + f_varint(4, batch))
+
+    def _scan(self, cf: bytes, start: bytes,
+              batch: int = 128) -> Iterator[tuple[bytes, bytes]]:
+        """(row, value) pairs from start onward, in row order.  A
+        scanner that dies with its regionserver (UnknownScanner after
+        the transparent reconnect) is REOPENED just past the last
+        yielded row instead of silently truncating the scan."""
+        req = self._open_scan(cf, start, batch)
         scanner_id = None
+        last_row: Optional[bytes] = None
         try:
             while True:
-                resp = pb.decode(self.client.call("Scan", req))
+                try:
+                    resp = pb.decode(self.client.call("Scan", req))
+                except HBaseError as e:
+                    if scanner_id is None or \
+                            "UnknownScanner" not in e.class_name:
+                        raise
+                    # server restarted between pages: resume after the
+                    # last row this generator already produced
+                    resume = (last_row + b"\x00") if last_row is not None \
+                        else start
+                    req = self._open_scan(cf, resume, batch)
+                    scanner_id = None
+                    continue
                 scanner_id = pb.first(resp, 2, scanner_id)
                 for result in resp.get(5, []):
                     for cell in pb.decode(result).get(1, []):
                         row, fam, _qual, val = _cell_fields(cell)
                         if fam == cf:
+                            last_row = row
                             yield row, val
                 if not pb.first(resp, 3, 0):  # more_results false: done,
                     scanner_id = None         # server closed the scanner

@@ -1,0 +1,97 @@
+"""diff_volume_servers: compare one volume's replicas across servers.
+
+Equivalent of /root/reference/unmaintained/diff_volume_servers/
+diff_volume_servers.go: fetch each replica's needle index (the
+/admin/volume_download CopyFile analog, ext=.idx), reduce to the LIVE
+needle map (last write wins, tombstones drop), and report needles
+present on one server but not the other or disagreeing on size — the
+replica-divergence debugging view.
+
+Offset width: a .idx is 16-byte entries (4-byte offsets) or 17-byte
+(5-byte); with only the index in hand the width is inferred from
+divisibility, preferring 16 when ambiguous (both widths parse only for
+multiples of 272 bytes, where the 4-byte reading is overwhelmingly the
+real one).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from ..storage import idx as idx_mod
+from ..storage.types import TOMBSTONE_FILE_SIZE
+from ..utils.httpd import http_bytes, http_json
+
+
+def _live_map(idx_blob: bytes) -> dict[int, int]:
+    """key -> size of live needles after replaying the index log."""
+    if len(idx_blob) % 16 == 0:
+        width = 4
+    elif len(idx_blob) % 17 == 0:
+        width = 5
+    else:
+        raise ValueError(f"index length {len(idx_blob)} matches no "
+                         "entry width")
+    out: dict[int, int] = {}
+    entries = idx_mod.parse_entries(idx_blob, offset_size=width)
+    for i in range(len(entries)):
+        key = int(entries["key"][i])
+        size = int(entries["size"][i])
+        if size == TOMBSTONE_FILE_SIZE or int(entries["offset"][i]) == 0:
+            out.pop(key, None)
+        else:
+            out[key] = size
+    return out
+
+
+def diff_servers(urls: list[str], vid: int, out=sys.stdout) -> int:
+    """Prints divergences; returns the number found."""
+    maps = {}
+    for url in urls:
+        status, blob, _ = http_bytes(
+            "GET", f"http://{url}/admin/volume_download?volume_id={vid}"
+                   f"&ext=.idx")
+        if status != 200:
+            raise SystemExit(f"{url}: volume_download HTTP {status}")
+        maps[url] = _live_map(blob)
+    a_url, b_url = urls[0], urls[1]
+    a, b = maps[a_url], maps[b_url]
+    diffs = 0
+    for key in sorted(a.keys() - b.keys()):
+        print(f"needle {key} (size {a[key]}) only on {a_url}", file=out)
+        diffs += 1
+    for key in sorted(b.keys() - a.keys()):
+        print(f"needle {key} (size {b[key]}) only on {b_url}", file=out)
+        diffs += 1
+    for key in sorted(a.keys() & b.keys()):
+        if a[key] != b[key]:
+            print(f"needle {key} size differs: {a[key]} on {a_url} vs "
+                  f"{b[key]} on {b_url}", file=out)
+            diffs += 1
+    print(f"{len(a)} vs {len(b)} live needles, {diffs} differences",
+          file=out)
+    return diffs
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("-master", default="localhost:9333")
+    ap.add_argument("-volumeId", type=int, required=True)
+    ap.add_argument("-servers", default="",
+                    help="comma-separated volume server urls; default: "
+                         "all replica locations from the master")
+    args = ap.parse_args(argv)
+    if args.servers:
+        urls = [u for u in args.servers.split(",") if u]
+    else:
+        d = http_json("GET", f"http://{args.master}/dir/lookup"
+                             f"?volumeId={args.volumeId}")
+        urls = [loc["url"] for loc in d.get("locations", [])]
+    if len(urls) < 2:
+        raise SystemExit(f"need >=2 replicas to diff, found {urls}")
+    return 1 if diff_servers(urls, args.volumeId) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

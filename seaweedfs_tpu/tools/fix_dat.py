@@ -1,0 +1,81 @@
+"""fix_dat: rebuild a volume's .dat from its (trusted) .idx.
+
+Equivalent of /root/reference/unmaintained/fix_dat/fix_dat.go — the
+inverse of `weed fix`: when the .dat carries stale/corrupt regions but
+the .idx offsets are correct, re-emit a clean `.dat_fixed` containing
+the superblock plus exactly the LIVE needles the index points at.
+Workflow matches the reference's comment:
+
+    python -m seaweedfs_tpu.tools.fix_dat -dir d -volumeId 9
+    mv d/9.dat d/9.dat.bak && mv d/9.dat_fixed d/9.dat
+    python weed.py fix -dir d -volumeId 9     # regenerate the .idx
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from ..storage import idx as idx_mod
+from ..storage.needle import NEEDLE_HEADER_SIZE, Needle, needle_body_length
+from ..storage.super_block import SUPER_BLOCK_SIZE, SuperBlock
+from ..storage.types import TOMBSTONE_FILE_SIZE, size_is_valid
+from ..storage.volume import volume_file_prefix
+
+
+def fix_dat(directory: str, collection: str, volume_id: int) -> tuple[int, int]:
+    """-> (records copied, bytes written); writes <base>.dat_fixed."""
+    base = volume_file_prefix(directory, collection, volume_id)
+    with open(base + ".dat", "rb") as f:
+        blob = f.read()
+    sb = SuperBlock.from_bytes(blob[:SUPER_BLOCK_SIZE + 0xFFFF])
+    copied = 0
+    with open(base + ".dat_fixed", "wb") as out:
+        out.write(blob[:sb.block_size])
+        # the .idx is an append log: replay it so a later tombstone
+        # actually removes the earlier live entry (last write wins)
+        live: dict[int, tuple[int, int]] = {}
+        for key, offset, size in idx_mod.iter_index_file(
+                base + ".idx", offset_size=sb.offset_size):
+            if size == TOMBSTONE_FILE_SIZE or offset == 0:
+                live.pop(key, None)
+            else:
+                live[key] = (offset, size)
+        for key, (offset, size) in sorted(live.items(),
+                                          key=lambda kv: kv[1][0]):
+            n = Needle()
+            n.parse_header(blob[offset:offset + NEEDLE_HEADER_SIZE])
+            if n.id != key:
+                print(f"skip key {key}: .dat record at {offset} has id "
+                      f"{n.id}", file=sys.stderr)
+                continue
+            body_len = needle_body_length(
+                n.size if size_is_valid(n.size) else 0, sb.version)
+            rec = blob[offset:offset + NEEDLE_HEADER_SIZE + body_len]
+            if len(rec) < NEEDLE_HEADER_SIZE + body_len:
+                print(f"skip key {key}: torn record at {offset}",
+                      file=sys.stderr)
+                continue
+            out.write(rec)
+            copied += 1
+        written = out.tell()
+    return copied, written
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("-dir", default=".", help="volume data directory")
+    ap.add_argument("-collection", default="")
+    ap.add_argument("-volumeId", type=int, required=True)
+    args = ap.parse_args(argv)
+    copied, written = fix_dat(args.dir, args.collection, args.volumeId)
+    base = volume_file_prefix(args.dir, args.collection, args.volumeId)
+    print(f"wrote {base}.dat_fixed: {copied} needles, {written} bytes")
+    print(f"next: mv {base}.dat_fixed {base}.dat && "
+          f"weed fix -dir {args.dir} -volumeId {args.volumeId}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,99 @@
+"""load_test: sustained mixed read/write load against a cluster.
+
+Equivalent of /root/reference/unmaintained/load_test/load_test.go: N
+worker threads run a write-then-read-mix loop against the master's
+assign/lookup path for a fixed duration, reporting op rates and error
+counts.  Unlike `weed benchmark` (fixed op COUNT, separate phases),
+this runs mixed traffic for a fixed TIME — the shape used for soak
+tests.
+"""
+
+from __future__ import annotations
+
+import argparse
+import random
+import sys
+import threading
+import time
+
+from ..client.operation import WeedClient
+
+
+class _Stats:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.writes = self.reads = self.errors = 0
+
+    def add(self, writes=0, reads=0, errors=0):
+        with self.lock:
+            self.writes += writes
+            self.reads += reads
+            self.errors += errors
+
+
+def run_load(master: str, seconds: float, concurrency: int = 4,
+             size: int = 1024, read_ratio: float = 0.7,
+             collection: str = "") -> dict:
+    """-> {"writes", "reads", "errors", "seconds", "write_rps",
+    "read_rps"}"""
+    stats = _Stats()
+    stop = time.time() + seconds
+    payload = bytes(random.getrandbits(8) for _ in range(size))
+
+    def worker(wid: int):
+        client = WeedClient(master)
+        rng = random.Random(wid)
+        fids: list[str] = []
+        while time.time() < stop:
+            try:
+                if not fids or rng.random() > read_ratio:
+                    fid = client.upload(payload, name=f"lt{wid}.bin",
+                                        collection=collection)
+                    fids.append(fid)
+                    if len(fids) > 256:
+                        fids.pop(0)
+                    stats.add(writes=1)
+                else:
+                    got = client.download(rng.choice(fids))
+                    if got != payload:
+                        stats.add(errors=1)
+                    else:
+                        stats.add(reads=1)
+            except Exception:
+                stats.add(errors=1)
+
+    threads = [threading.Thread(target=worker, args=(i,), daemon=True)
+               for i in range(concurrency)]
+    t0 = time.time()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    dt = max(time.time() - t0, 1e-9)
+    return {"writes": stats.writes, "reads": stats.reads,
+            "errors": stats.errors, "seconds": round(dt, 2),
+            "write_rps": round(stats.writes / dt, 1),
+            "read_rps": round(stats.reads / dt, 1)}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("-master", default="localhost:9333")
+    ap.add_argument("-seconds", type=float, default=10.0)
+    ap.add_argument("-c", type=int, default=4, help="worker threads")
+    ap.add_argument("-size", type=int, default=1024)
+    ap.add_argument("-readRatio", type=float, default=0.7,
+                    help="fraction of ops that are reads once warmed")
+    ap.add_argument("-collection", default="")
+    args = ap.parse_args(argv)
+    out = run_load(args.master, args.seconds, concurrency=args.c,
+                   size=args.size, read_ratio=args.readRatio,
+                   collection=args.collection)
+    print(f"writes: {out['writes']} ({out['write_rps']}/s)  "
+          f"reads: {out['reads']} ({out['read_rps']}/s)  "
+          f"errors: {out['errors']}  in {out['seconds']}s")
+    return 1 if out["errors"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

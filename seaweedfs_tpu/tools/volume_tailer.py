@@ -1,0 +1,83 @@
+"""volume_tailer: follow a live volume's appended needles.
+
+Equivalent of /root/reference/unmaintained/volume_tailer/
+volume_tailer.go: locate the volume through the master, then poll the
+server's /admin/tail RPC (VolumeTailSender analog) printing every new
+needle — id, size, and optionally textual content.  -rewind -1 starts
+from the first record, 0 from now, N from N seconds ago.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+from ..utils.httpd import http_bytes, http_json
+
+
+def _locate(master: str, vid: int) -> str:
+    d = http_json("GET", f"http://{master}/dir/lookup?volumeId={vid}")
+    locs = d.get("locations") or []
+    if not locs:
+        raise SystemExit(f"volume {vid} not found via {master}")
+    return locs[0]["url"]
+
+
+def tail_volume(master: str, vid: int, since_ns: int,
+                timeout_s: float = 0.0, show_text: bool = False,
+                poll_s: float = 1.0, out=sys.stdout) -> int:
+    """Prints needles until idle for timeout_s (0 = forever); returns
+    the count printed."""
+    from ..storage.volume_backup import iter_records
+    from ..storage.types import TOMBSTONE_FILE_SIZE
+
+    url = _locate(master, vid)
+    seen = 0
+    last_activity = time.time()
+    while True:
+        status, blob, hdrs = http_bytes(
+            "GET", f"http://{url}/admin/tail?volume_id={vid}"
+                   f"&since_ns={since_ns}")
+        if status != 200:
+            raise SystemExit(f"tail {url}: HTTP {status}")
+        version = int(hdrs.get("X-Volume-Version", 3))
+        for n in iter_records(blob, version):
+            kind = "DELETE" if n.size == TOMBSTONE_FILE_SIZE else "PUT"
+            line = f"{kind} id={n.id} size={n.size} ts={n.append_at_ns}"
+            if show_text and kind == "PUT" and n.data and all(
+                    32 <= b < 127 or b in (9, 10, 13) for b in n.data[:256]):
+                line += f" text={n.data[:256].decode(errors='replace')!r}"
+            print(line, file=out)
+            seen += 1
+            last_activity = time.time()
+        since_ns = int(hdrs.get("X-Last-Append-At-Ns", since_ns)) or since_ns
+        if timeout_s and time.time() - last_activity >= timeout_s:
+            return seen
+        time.sleep(poll_s)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("-master", default="localhost:9333")
+    ap.add_argument("-volumeId", type=int, required=True)
+    ap.add_argument("-rewind", type=float, default=-1,
+                    help="-1 from first entry, 0 from now, N seconds back")
+    ap.add_argument("-timeoutSeconds", type=float, default=0,
+                    help="exit after this long with no activity (0: never)")
+    ap.add_argument("-showTextFile", action="store_true")
+    args = ap.parse_args(argv)
+    if args.rewind < 0:
+        since = 0
+    elif args.rewind == 0:
+        since = time.time_ns()
+    else:
+        since = time.time_ns() - int(args.rewind * 1e9)
+    tail_volume(args.master, args.volumeId, since,
+                timeout_s=args.timeoutSeconds,
+                show_text=args.showTextFile)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

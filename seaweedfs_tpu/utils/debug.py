@@ -103,27 +103,97 @@ def _heap_text(limit: int = 40) -> str:
     return "\n".join(lines) + "\n"
 
 
+def _human_bytes(n) -> str:
+    try:
+        n = int(n)
+    except (TypeError, ValueError):
+        return str(n)
+    for unit in ("B", "KB", "MB", "GB", "TB"):
+        if abs(n) < 1024 or unit == "TB":
+            return f"{n:.1f} {unit}".replace(".0 ", " ") if unit != "B" \
+                else f"{n} B"
+        n /= 1024
+    return str(n)
+
+
+_SIZE_HINTS = ("size", "bytes", "free_space")
+
+
+def _cell(key: str, v) -> str:
+    """One table cell: scalars inline (sizes humanized), short scalar
+    lists joined, anything deeper as compact JSON."""
+    if isinstance(v, bool) or v is None:
+        return html.escape(str(v))
+    if isinstance(v, (int, float)):
+        if any(h in key.lower() for h in _SIZE_HINTS):
+            return html.escape(_human_bytes(v))
+        return html.escape(str(v))
+    if isinstance(v, str):
+        return html.escape(v)
+    if isinstance(v, list) and all(
+            isinstance(x, (str, int, float, bool)) for x in v):
+        shown = ", ".join(str(x) for x in v[:24])
+        if len(v) > 24:
+            shown += f", … ({len(v)} total)"
+        return html.escape(shown)
+    return html.escape(json.dumps(v, default=str))
+
+
+def _render_value(key: str, v, depth: int) -> str:
+    """Recursive section renderer: dicts become key/value tables with
+    nested subsections, lists of dicts become striped column tables —
+    the reference's master/volume/filer UI table style
+    (ref: weed/server/master_ui/master.html:1,
+    weed/server/volume_server_ui/volume.html:1) without its static
+    bootstrap assets (this page is fully self-contained)."""
+    h = min(2 + depth, 5)
+    title = f"<h{h}>{html.escape(key)}</h{h}>" if key else ""
+    if isinstance(v, dict):
+        scalars = {k: x for k, x in v.items()
+                   if isinstance(x, (str, int, float, bool)) or x is None}
+        nested = {k: x for k, x in v.items() if k not in scalars}
+        rows = "".join(
+            f"<tr><th>{html.escape(str(k))}</th><td>{_cell(str(k), x)}</td>"
+            f"</tr>" for k, x in scalars.items())
+        out = title
+        if rows:
+            out += f"<table class='kv'>{rows}</table>"
+        for k, x in nested.items():
+            out += _render_value(str(k), x, depth + 1)
+        return out
+    if isinstance(v, list) and v and all(isinstance(x, dict) for x in v):
+        cols: list[str] = []
+        for x in v:
+            for k in x:
+                if k not in cols:
+                    cols.append(k)
+        head = "".join(f"<th>{html.escape(str(c))}</th>" for c in cols)
+        body = "".join(
+            "<tr>" + "".join(
+                f"<td>{_cell(str(c), x.get(c))}</td>" for c in cols)
+            + "</tr>" for x in v)
+        return (f"{title}<table class='grid'><thead><tr>{head}</tr>"
+                f"</thead><tbody>{body}</tbody></table>")
+    return f"{title}<p>{_cell(key, v)}</p>"
+
+
 def _render_status_html(name: str, status: dict) -> str:
-    """One dependency-free HTML page: every scalar becomes a stat row,
-    every list/dict a pretty-printed JSON block (the reference's server
-    UI templates show the same /status content)."""
-    rows, blocks = [], []
-    for k, v in status.items():
-        if isinstance(v, (str, int, float, bool)) or v is None:
-            rows.append(f"<tr><th>{html.escape(str(k))}</th>"
-                        f"<td>{html.escape(str(v))}</td></tr>")
-        else:
-            blocks.append(
-                f"<h2>{html.escape(str(k))}</h2>"
-                f"<pre>{html.escape(json.dumps(v, indent=2, default=str))}"
-                f"</pre>")
+    """One dependency-free single-page dashboard rendering the role's
+    /status document as real tables — topology, volumes, EC shards,
+    native-plane gauges — in the spirit of the reference's server UIs."""
+    body = _render_value("", status, 0)
     return f"""<!doctype html><html><head><title>{html.escape(name)}</title>
+<meta http-equiv="refresh" content="15">
 <style>
- body {{ font-family: sans-serif; margin: 2em; color: #222; }}
- table {{ border-collapse: collapse; }}
- th, td {{ text-align: left; padding: 4px 12px; border-bottom: 1px solid #ddd; }}
- pre {{ background: #f6f6f6; padding: 1em; overflow-x: auto; }}
- .links a {{ margin-right: 1em; }}
+ body {{ font-family: system-ui, sans-serif; margin: 2em; color: #1c2733; }}
+ h1 {{ border-bottom: 2px solid #2a6f4e; padding-bottom: .3em; }}
+ h2, h3, h4, h5 {{ margin: 1.2em 0 .4em; color: #2a6f4e; }}
+ table {{ border-collapse: collapse; margin: .4em 0 1em; }}
+ th, td {{ text-align: left; padding: 4px 12px; border-bottom: 1px solid #dfe5ea; }}
+ table.kv th {{ color: #50606e; font-weight: 600; }}
+ table.grid thead th {{ background: #f1f5f3; border-bottom: 2px solid #cfd9d3; }}
+ table.grid tbody tr:nth-child(even) {{ background: #fafbfb; }}
+ .links a {{ margin-right: 1em; color: #2a6f4e; }}
 </style></head><body>
 <h1>{html.escape(name)}</h1>
 <div class="links">
@@ -132,8 +202,7 @@ def _render_status_html(name: str, status: dict) -> str:
  <a href="/debug/pprof/goroutine">threads</a>
  <a href="/debug/pprof/heap">heap</a>
 </div>
-<table>{''.join(rows)}</table>
-{''.join(blocks)}
+{body}
 </body></html>"""
 
 

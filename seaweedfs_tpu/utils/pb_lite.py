@@ -14,6 +14,11 @@ from __future__ import annotations
 
 
 def enc_varint(n: int) -> bytes:
+    if n < 0:
+        # protobuf encodes negative int32/int64 as the 64-bit two's
+        # complement (always 10 bytes); without the mask the shift loop
+        # below never terminates on negative Python ints
+        n &= (1 << 64) - 1
     out = bytearray()
     while True:
         b = n & 0x7F

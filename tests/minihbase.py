@@ -186,6 +186,12 @@ class MiniHBase:
             if scanner_id is not None:
                 self._scanners.pop(scanner_id, None)
             return b""
+        if scanner_id is not None and scanner_id not in self._scanners:
+            # real HBase faults a continuation for a scanner it does not
+            # know (e.g. it restarted) — silently returning an empty
+            # page would hide truncated scans from clients
+            raise _Exc("org.apache.hadoop.hbase.UnknownScannerException",
+                       str(scanner_id))
         if scanner_id is None:  # open: build the full result list
             region = self._check_region(param)
             scan = pb.decode(pb.first(param, 2, b""))

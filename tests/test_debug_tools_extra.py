@@ -1,0 +1,195 @@
+"""Debug-tool long tail: fix_dat, volume_tailer, load_test,
+diff_volume_servers, and the `weed fuse` fstab entry point.
+
+References: unmaintained/fix_dat/fix_dat.go,
+unmaintained/volume_tailer/volume_tailer.go,
+unmaintained/load_test/load_test.go,
+unmaintained/diff_volume_servers/diff_volume_servers.go,
+weed/command/fuse.go.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu.storage.needle import Needle
+from seaweedfs_tpu.storage.volume import Volume
+
+from .conftest import free_port
+
+RNG = np.random.default_rng(0x700)
+
+
+@pytest.fixture()
+def cluster(tmp_path):
+    from seaweedfs_tpu.master.server import MasterServer
+    from seaweedfs_tpu.volume_server.server import VolumeServer
+
+    master = MasterServer(port=free_port(), pulse_seconds=0.3).start()
+    (tmp_path / "v").mkdir()
+    vol = VolumeServer([str(tmp_path / "v")], master.url, port=free_port(),
+                       pulse_seconds=0.3).start()
+    deadline = time.time() + 6
+    while time.time() < deadline and not master.topo.all_nodes():
+        time.sleep(0.05)
+    yield master, vol
+    vol.stop()
+    master.stop()
+
+
+# --- fix_dat -----------------------------------------------------------------
+
+def test_fix_dat_rebuilds_live_needles(tmp_path):
+    from seaweedfs_tpu.tools.fix_dat import fix_dat
+
+    v = Volume(str(tmp_path), "", 3)
+    v.write_needle(Needle(cookie=1, id=1, data=b"keep-one" * 16))
+    v.write_needle(Needle(cookie=2, id=2, data=b"doomed" * 16))
+    v.write_needle(Needle(cookie=3, id=3, data=b"keep-two" * 40))
+    v.delete_needle(Needle(cookie=2, id=2))
+    v.close()
+    copied, written = fix_dat(str(tmp_path), "", 3)
+    assert copied == 2  # the tombstoned needle is dropped
+    fixed = tmp_path / "3.dat_fixed"
+    assert fixed.exists() and written == fixed.stat().st_size
+    # the rebuilt dat + weed fix's idx reconstruction round-trips
+    os.replace(fixed, tmp_path / "3.dat")
+    os.unlink(tmp_path / "3.idx")
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    rc = subprocess.run(
+        [sys.executable, os.path.join(repo, "weed.py"), "fix",
+         "-dir", str(tmp_path), "-volumeId", "3"], env=env).returncode
+    assert rc == 0
+    v2 = Volume(str(tmp_path), "", 3)
+    assert v2.read_needle(1, cookie=1).data == b"keep-one" * 16
+    assert v2.read_needle(3, cookie=3).data == b"keep-two" * 40
+    with pytest.raises(Exception):
+        v2.read_needle(2, cookie=2)
+    v2.close()
+
+
+# --- volume_tailer -----------------------------------------------------------
+
+def test_volume_tailer_follows_appends(cluster):
+    from seaweedfs_tpu.client.operation import WeedClient
+    from seaweedfs_tpu.tools.volume_tailer import tail_volume
+
+    master, vol = cluster
+    client = WeedClient(master.url)
+    fid = client.upload(b"first payload", name="a.txt")
+    vid = int(fid.split(",")[0])
+    out = io.StringIO()
+    done = threading.Event()
+
+    def run():
+        tail_volume(master.url, vid, since_ns=0, timeout_s=2.5,
+                    show_text=True, poll_s=0.2, out=out)
+        done.set()
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    time.sleep(0.5)
+    client.upload(b"second textual payload", name="b.txt")
+    assert done.wait(timeout=15)
+    text = out.getvalue()
+    assert "PUT id=" in text
+    assert "second textual payload" in text  # -showTextFile content
+    assert text.count("PUT") >= 2
+
+
+# --- load_test ---------------------------------------------------------------
+
+def test_load_test_mixed_traffic(cluster):
+    from seaweedfs_tpu.tools.load_test import run_load
+
+    master, _ = cluster
+    out = run_load(master.url, seconds=2.0, concurrency=2, size=512,
+                   read_ratio=0.5)
+    assert out["errors"] == 0
+    assert out["writes"] > 0 and out["reads"] > 0
+    assert out["write_rps"] > 0
+
+
+# --- diff_volume_servers -----------------------------------------------------
+
+def test_diff_volume_servers_reports_divergence(tmp_path):
+    from seaweedfs_tpu.master.server import MasterServer
+    from seaweedfs_tpu.volume_server.server import VolumeServer
+    from seaweedfs_tpu.tools.diff_volume_servers import diff_servers
+    from seaweedfs_tpu.utils.httpd import http_json
+
+    master = MasterServer(port=free_port(), pulse_seconds=0.3,
+                          default_replication="001").start()
+    (tmp_path / "a").mkdir()
+    (tmp_path / "b").mkdir()
+    va = VolumeServer([str(tmp_path / "a")], master.url, port=free_port(),
+                      pulse_seconds=0.3).start()
+    vb = VolumeServer([str(tmp_path / "b")], master.url, port=free_port(),
+                      pulse_seconds=0.3).start()
+    try:
+        deadline = time.time() + 6
+        while time.time() < deadline and len(master.topo.all_nodes()) < 2:
+            time.sleep(0.05)
+        from seaweedfs_tpu.client.operation import WeedClient
+
+        client = WeedClient(master.url)
+        fid = client.upload(b"replicated-needle", name="r.bin",
+                            replication="001")
+        vid = int(fid.split(",")[0])
+        # in sync: no differences
+        out = io.StringIO()
+        assert diff_servers([va.url, vb.url], vid, out=out) == 0
+        # diverge one replica behind the master's back
+        v = va.store.get_volume(vid)
+        v.write_needle(Needle(cookie=9, id=999, data=b"only-on-a"))
+        out = io.StringIO()
+        assert diff_servers([va.url, vb.url], vid, out=out) == 1
+        assert "only on" in out.getvalue()
+        assert "999" in out.getvalue()
+    finally:
+        va.stop()
+        vb.stop()
+        master.stop()
+
+
+# --- weed fuse fstab entry ---------------------------------------------------
+
+def test_weed_fuse_option_translation(monkeypatch):
+    import weed as weed_cli  # repo root on sys.path via conftest
+
+    captured = {}
+
+    def fake_mount(args):
+        captured.update(vars(type(args)) if not isinstance(args, dict)
+                        else args)
+        captured["filer"] = args.filer
+        captured["dir"] = args.dir
+        captured["filerPath"] = args.filerPath
+        captured["collection"] = args.collection
+        captured["chunkSizeLimitMB"] = args.chunkSizeLimitMB
+        captured["allowOthers"] = args.allowOthers
+
+    monkeypatch.setattr(weed_cli, "cmd_mount", fake_mount)
+
+    class A:
+        mountpoint = "/mnt/weed"
+        o = ("filer=10.0.0.5:8888,filer.path=/data,collection=pics,"
+             "chunkSizeLimitMB=16,allow_other,rw,noatime,nonempty")
+
+    weed_cli.cmd_fuse(A())
+    assert captured["filer"] == "10.0.0.5:8888"
+    assert captured["dir"] == "/mnt/weed"
+    assert captured["filerPath"] == "/data"
+    assert captured["collection"] == "pics"
+    assert captured["chunkSizeLimitMB"] == 16
+    assert captured["allowOthers"] is True

@@ -175,6 +175,21 @@ def test_reconnect_after_server_restart(server):
         srv2.stop()
 
 
+def test_scan_survives_scanner_loss_without_truncation(server, store):
+    """A scanner that dies between pages (regionserver bounce) must be
+    REOPENED after the last yielded row — not silently truncate the
+    scan (the double faults unknown continuations like real HBase)."""
+    for i in range(40):
+        store.insert_entry(_file(f"/sv/f{i:03d}"))
+    rows = []
+    it = store._scan(b"meta", b"/sv/", batch=10)
+    for _ in range(10):  # consume the first page
+        rows.append(next(it)[0])
+    server._scanners.clear()  # the server "restarted": scanners gone
+    rows.extend(r for r, _ in it)  # continuation must reopen + resume
+    assert rows == [f"/sv/f{i:03d}".encode() for i in range(40)]
+
+
 def test_ttl_entries_carry_the_ttl_attribute(store):
     """A TTL'd entry must send the gohbase-style _ttl mutation
     attribute (ms, 8-byte BE) — ref doPut's hrpc.TTL option."""

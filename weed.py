@@ -749,6 +749,38 @@ def cmd_mount(args) -> None:
     raise SystemExit(code)
 
 
+def cmd_fuse(args) -> None:
+    """/etc/fstab entry point (command/fuse.go): `weed fuse <mountpoint>
+    -o "filer=host:port,filer.path=/,..."` — the mount(8) calling
+    convention, so a line like
+
+        fuse /mnt/weed fuse.weed filer=localhost:8888,filer.path=/ 0 0
+
+    works via mount.weed -> weed fuse.  Options map onto `weed mount`
+    flags; unknown fstab boilerplate (rw, noatime, nonempty, dev,
+    suid, _netdev, ...) is ignored the way the reference ignores it."""
+    opts: dict[str, str] = {}
+    for chunk in (args.o or "").split(","):
+        chunk = chunk.strip()
+        if not chunk:
+            continue
+        k, _, v = chunk.partition("=")
+        opts[k] = v or "true"
+
+    class MountArgs:
+        filer = opts.get("filer", "127.0.0.1:8888")
+        dir = args.mountpoint
+        filerPath = opts.get("filer.path", "/")
+        collection = opts.get("collection", "")
+        replication = opts.get("replication", "")
+        chunkSizeLimitMB = int(opts.get("chunkSizeLimitMB", "8"))
+        allowOthers = opts.get("allowOthers", "") == "true" or \
+            "allow_other" in opts
+        debug = opts.get("debug", "") == "true"
+
+    cmd_mount(MountArgs())
+
+
 def cmd_msg_broker(args) -> None:
     """Pub/sub message broker backed by the filer
     (command/msg_broker.go)."""
@@ -1134,6 +1166,13 @@ def main(argv=None) -> None:
     mt.add_argument("-allowOthers", action="store_true")
     mt.add_argument("-debug", action="store_true")
     mt.set_defaults(fn=cmd_mount)
+
+    fu = sub.add_parser("fuse", help="fstab/mount(8) entry point")
+    fu.add_argument("mountpoint")
+    fu.add_argument("-o", default="",
+                    help="comma-separated mount options "
+                         "(filer=, filer.path=, collection=, ...)")
+    fu.set_defaults(fn=cmd_fuse)
 
     mb = sub.add_parser("msgBroker")
     mb.add_argument("-filer", default="", help="filer host:port for persistence")
