@@ -16,7 +16,8 @@ import time
 from typing import Optional
 
 from ..client.operation import WeedClient
-from ..utils.httpd import HttpError, Request, Response, Router, http_bytes, serve
+from ..utils.httpd import (HttpError, Request, Response, Router,
+                           extract_upload, http_bytes, serve)
 from .entry import Attr, Entry, FileChunk
 from .filechunks import etag_of_chunks, read_plan, total_size
 from .filer import Filer, FilerError, NotEmptyError
@@ -662,16 +663,29 @@ class FilerServer:
             if err:
                 raise HttpError(401, err)
             path = req.match.group(1)
-            if path.endswith("/"):
-                self._check_writable(path.rstrip("/") or "/")
-                with self.filer.op_signatures(self._sigs(req)):
-                    self.filer._ensure_parents(path.rstrip("/") or "/")
-                return Response({"name": path}, status=201)
             mime = req.headers.get("Content-Type", "")
+            # curl -F / browser form uploads wrap the payload in
+            # multipart/form-data — unwrap the file part like the
+            # reference autochunk POST handler (doPostAutoChunk uses
+            # MultipartReader; doPutAutoChunk reads the raw body)
+            if req.handler.command == "POST":
+                data, fname, mime = extract_upload(req.body, mime)
+            else:
+                data, fname = req.body, ""
+            if path.endswith("/"):
+                if fname:
+                    # form upload targeting a directory: the part's
+                    # filename names the entry (PostHandler semantics)
+                    path = path + fname
+                else:
+                    self._check_writable(path.rstrip("/") or "/")
+                    with self.filer.op_signatures(self._sigs(req)):
+                        self.filer._ensure_parents(path.rstrip("/") or "/")
+                    return Response({"name": path}, status=201)
             if mime in ("application/x-www-form-urlencoded", ""):
                 mime = ""
             with self.filer.op_signatures(self._sigs(req)):
-                entry = self.put_file(path, req.body, mime=mime,
+                entry = self.put_file(path, data, mime=mime,
                                       collection=req.query.get("collection", ""),
                                       ttl=req.query.get("ttl", ""))
             return Response({"name": entry.name, "size": entry.file_size},

@@ -25,7 +25,8 @@ from ..filer.filechunks import etag_of_chunks, total_size
 from ..filer.filer import NotEmptyError
 from ..filer.filer import NotFoundError as FilerNotFound
 from ..filer.server import FilerServer
-from ..utils.httpd import HttpError, Request, Response, Router, serve
+from ..utils.httpd import (HttpError, Request, Response, Router,
+                           parse_form_data, serve)
 from .s3_auth import (ACTION_ADMIN, ACTION_LIST, ACTION_READ, ACTION_WRITE,
                       AuthError)
 
@@ -48,47 +49,6 @@ def _err(status: int, code: str, message: str) -> Response:
                     headers={"Content-Type": "application/xml"})
 
 
-def parse_form_data(body: bytes, content_type: str) -> dict:
-    """Minimal multipart/form-data parser for POST uploads: returns
-    {field: str} plus {"file": bytes, "file.name": str} for the file
-    part.  Per the S3 contract, fields after `file` are ignored."""
-    m = _re.search(r'boundary="?([^";]+)"?', content_type)
-    if not m:
-        raise ValueError("no multipart boundary")
-    # RFC 2046 delimiters are CRLF--boundary, NOT the bare boundary
-    # bytes — a file whose CONTENT contains the boundary string must
-    # survive.  Prefixing CRLF makes the first (dashless) delimiter
-    # uniform with the rest.
-    sep = b"\r\n--" + m.group(1).encode()
-    fields: dict = {}
-    for part in (b"\r\n" + body).split(sep)[1:]:
-        if part.startswith(b"--"):
-            break  # closing delimiter
-        part = part.lstrip(b" \t")  # transport padding after boundary
-        if part.startswith(b"\r\n"):
-            part = part[2:]
-        head, hsep, payload = part.partition(b"\r\n\r\n")
-        if not hsep and not head.strip():
-            continue
-        disp = ""
-        ptype = ""
-        for line in head.split(b"\r\n"):
-            low = line.lower()
-            if low.startswith(b"content-disposition:"):
-                disp = line.decode(errors="replace")
-            elif low.startswith(b"content-type:"):
-                ptype = line.split(b":", 1)[1].strip().decode(errors="replace")
-        nm = _re.search(r'name="([^"]*)"', disp)
-        name = nm.group(1) if nm else ""
-        if name.lower() == "file":
-            fn = _re.search(r'filename="([^"]*)"', disp)
-            fields["file"] = payload
-            fields["file.name"] = fn.group(1) if fn else ""
-            if ptype:
-                fields.setdefault("content-type", ptype)
-            break  # everything after the file part is ignored
-        fields[name.lower()] = payload.decode(errors="replace")
-    return fields
 
 
 class S3ApiServer:
@@ -201,7 +161,7 @@ class S3ApiServer:
         method = req.handler.command
         body = req.body if method in ("PUT", "POST") else b""
         ident, stream_ctx = self.iam.authenticate_with_context(
-            method, req.path, req.query, req.headers, body)
+            method, req.raw_path, req.query, req.headers, body)
         if stream_ctx is not None and \
                 not getattr(req, "_streaming_decoded", False):
             # signed streaming upload: verify EVERY chunk signature while

@@ -64,8 +64,8 @@ class WebDavServer:
 
     # --- helpers ----------------------------------------------------------
     def _fs_path(self, dav_path: str) -> str:
-        p = urllib.parse.unquote(dav_path)
-        return (self.root + "/" + p.strip("/")).rstrip("/") or "/"
+        # Request.path is already %-decoded by the HTTP layer
+        return (self.root + "/" + dav_path.strip("/")).rstrip("/") or "/"
 
     def _dav_href(self, fs_path: str, is_dir: bool) -> str:
         rel = fs_path[len(self.root):] if self.root else fs_path
@@ -234,7 +234,10 @@ class WebDavServer:
             dest_header = req.headers.get("Destination", "")
             if not dest_header:
                 raise HttpError(400, "Destination header required")
-            dst = self._fs_path(urllib.parse.urlparse(dest_header).path)
+            # the Destination header is still wire-encoded (only request
+            # targets are decoded by the HTTP layer)
+            dst = self._fs_path(urllib.parse.unquote(
+                urllib.parse.urlparse(dest_header).path))
             overwrite = req.headers.get("Overwrite", "T").upper() != "F"
             entry = self._find(src)
             existed = self.fs.filer.exists(dst)

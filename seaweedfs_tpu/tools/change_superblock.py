@@ -1,0 +1,67 @@
+"""change_superblock: edit a volume's replication / TTL in place.
+
+Equivalent of /root/reference/unmaintained/change_superblock/
+change_superblock.go: with the volume server STOPPED, rewrite the
+8-byte superblock header of a .dat — byte 1 is the xyz replica
+placement, bytes 2-3 the TTL — leaving every needle untouched.  With
+no -replication/-ttl flags it just prints the current settings.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from ..storage.super_block import SUPER_BLOCK_SIZE, SuperBlock
+from ..storage.super_block import ReplicaPlacement
+from ..storage.ttl import TTL
+from ..storage.volume import volume_file_prefix
+
+
+def change_superblock(directory: str, collection: str, volume_id: int,
+                      replication: str = "", ttl: str = "") -> SuperBlock:
+    """Prints current settings; applies any given changes; returns the
+    (possibly updated) superblock."""
+    path = volume_file_prefix(directory, collection, volume_id) + ".dat"
+    with open(path, "r+b") as f:
+        sb = SuperBlock.from_bytes(f.read(SUPER_BLOCK_SIZE + 0xFFFF))
+        print(f"{path}: version={int(sb.version)} "
+              f"replication={sb.replica_placement} "
+              f"ttl={sb.ttl or 'none'} "
+              f"compaction_revision={sb.compaction_revision}")
+        changed = False
+        if replication:
+            sb.replica_placement = ReplicaPlacement.parse(replication)
+            changed = True
+        if ttl:
+            sb.ttl = TTL.parse(ttl)
+            changed = True
+        if changed:
+            blob = sb.to_bytes()
+            f.seek(0)
+            f.write(blob)
+            f.flush()
+            os.fsync(f.fileno())
+            print(f"updated: replication={sb.replica_placement} "
+                  f"ttl={sb.ttl or 'none'}")
+    return sb
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("-dir", default=".")
+    ap.add_argument("-collection", default="")
+    ap.add_argument("-volumeId", type=int, required=True)
+    ap.add_argument("-replication", default="",
+                    help="new xyz replica placement (empty: print only)")
+    ap.add_argument("-ttl", default="",
+                    help="new ttl like 3m/4h/5d (empty: print only)")
+    args = ap.parse_args(argv)
+    change_superblock(args.dir, args.collection, args.volumeId,
+                      replication=args.replication, ttl=args.ttl)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

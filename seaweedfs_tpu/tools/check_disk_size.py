@@ -1,0 +1,65 @@
+"""check_disk_size: volume data usage vs the filesystem underneath.
+
+Equivalent of /root/reference/unmaintained/check_disk_size/
+check_disk_size.go: per volume directory, sum the .dat/.idx/.ec* file
+sizes and compare with statvfs capacity — the quick answer to "is the
+disk filling because of volumes or because of something else".
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import sys
+
+VOLUME_EXTS = (".dat", ".idx", ".vif", ".ecx", ".ecj")
+_EC_SHARD_RE = re.compile(r"\.ec\d{2}$")
+
+
+def check_dir(directory: str) -> dict:
+    vol_bytes = other_bytes = 0
+    files = 0
+    for name in os.listdir(directory):
+        p = os.path.join(directory, name)
+        if not os.path.isfile(p):
+            continue
+        sz = os.path.getsize(p)
+        files += 1
+        if name.endswith(VOLUME_EXTS) or _EC_SHARD_RE.search(name):
+            vol_bytes += sz
+        else:
+            other_bytes += sz
+    st = os.statvfs(directory)
+    total = st.f_frsize * st.f_blocks
+    free = st.f_frsize * st.f_bavail
+    return {"dir": directory, "volume_bytes": vol_bytes,
+            "other_bytes": other_bytes, "files": files,
+            "fs_total": total, "fs_free": free,
+            "fs_used": total - free}
+
+
+def _fmt(n: int) -> str:
+    for unit in ("B", "KB", "MB", "GB", "TB"):
+        if abs(n) < 1024 or unit == "TB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{n}B"
+        n /= 1024
+    return str(n)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("dirs", nargs="+", help="volume data directories")
+    args = ap.parse_args(argv)
+    for d in args.dirs:
+        r = check_dir(d)
+        pct = 100.0 * r["volume_bytes"] / max(r["fs_used"], 1)
+        print(f"{d}: volumes {_fmt(r['volume_bytes'])} "
+              f"other {_fmt(r['other_bytes'])} ({r['files']} files); "
+              f"fs used {_fmt(r['fs_used'])} of {_fmt(r['fs_total'])} "
+              f"({pct:.1f}% of used is volume data)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

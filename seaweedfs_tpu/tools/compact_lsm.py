@@ -1,0 +1,41 @@
+"""compact_lsm: force-merge an LSM filer-store directory offline.
+
+Equivalent of /root/reference/unmaintained/compact_leveldb/
+compact_leveldb.go (which calls leveldb's CompactRange on a closed
+store): open the directory with the Python LSM engine (byte-compatible
+with the native C++ one — they open each other's files), flush the WAL
+into the memtable, and merge every SSTable into one, dropping
+tombstones.  Run with the filer STOPPED.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import os
+import sys
+
+
+def compact(directory: str, out=sys.stdout) -> tuple[int, int]:
+    """-> (sstables before, sstables after)"""
+    from ..filer.lsm_store import LsmStore
+
+    before = len(glob.glob(os.path.join(directory, "*.sst")))
+    store = LsmStore(directory)
+    store.flush()
+    store._compact()
+    after = len(glob.glob(os.path.join(directory, "*.sst")))
+    print(f"{directory}: {before} sstables -> {after}", file=out)
+    return before, after
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("dir", help="LSM store directory (*.lsm)")
+    args = ap.parse_args(argv)
+    compact(args.dir)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,71 @@
+"""remove_duplicate_fids: drop older duplicates of repeated needle ids.
+
+Equivalent of /root/reference/unmaintained/remove_duplicate_fids/
+remove_duplicate_fids.go: a .dat written through buggy replication can
+carry the same needle id more than once; re-emit the volume with only
+the LAST occurrence of each id kept (append order wins, matching how
+the needle map would have resolved reads).  Writes <base>.dat_cleaned;
+run `weed fix` afterwards to rebuild the index.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from ..storage.needle import NEEDLE_HEADER_SIZE, needle_body_length
+from ..storage.super_block import SuperBlock
+from ..storage.types import size_is_valid
+from ..storage.volume import volume_file_prefix
+from .see_dat import walk_dat
+
+
+def remove_duplicates(directory: str, collection: str,
+                      volume_id: int) -> tuple[int, int]:
+    """-> (records kept, duplicates dropped); writes .dat_cleaned."""
+    base = volume_file_prefix(directory, collection, volume_id)
+    # pass 1: the last (offset, record length) for each id wins;
+    # O(records) memory so 30GB+ volumes are safe
+    survivor: dict[int, tuple[int, int]] = {}
+    dupes = 0
+    sb = None
+    for offset, rec in walk_dat(base + ".dat"):
+        if isinstance(rec, SuperBlock):
+            sb = rec
+            continue
+        if rec.id in survivor:
+            dupes += 1
+        body_len = needle_body_length(
+            rec.size if size_is_valid(rec.size) else 0, sb.version)
+        survivor[rec.id] = (offset, NEEDLE_HEADER_SIZE + body_len)
+    # pass 2: stream the survivors out in their original append order
+    kept = 0
+    with open(base + ".dat", "rb") as src, \
+            open(base + ".dat_cleaned", "wb") as out:
+        out.write(src.read(sb.block_size))
+        for offset, length in sorted(survivor.values()):
+            src.seek(offset)
+            out.write(src.read(length))
+            kept += 1
+    return kept, dupes
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("-dir", default=".")
+    ap.add_argument("-collection", default="")
+    ap.add_argument("-volumeId", type=int, required=True)
+    args = ap.parse_args(argv)
+    kept, dupes = remove_duplicates(args.dir, args.collection,
+                                    args.volumeId)
+    base = volume_file_prefix(args.dir, args.collection, args.volumeId)
+    print(f"wrote {base}.dat_cleaned: kept {kept}, dropped {dupes} "
+          f"duplicate records")
+    if dupes:
+        print(f"next: mv {base}.dat_cleaned {base}.dat && "
+              f"weed fix -dir {args.dir} -volumeId {args.volumeId}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

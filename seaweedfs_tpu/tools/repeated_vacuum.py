@@ -1,0 +1,66 @@
+"""repeated_vacuum: hammer the vacuum path for soak/chaos testing.
+
+Equivalent of /root/reference/unmaintained/repeated_vacuum/
+repeated_vacuum.go: in a loop, upload garbage, delete it, and trigger
+the master's vacuum with a low threshold — the fastest way to shake
+out compaction races (the reference used it to chase the
+vacuum-vs-write data races its commit history fixed).
+"""
+
+from __future__ import annotations
+
+import argparse
+import random
+import sys
+import time
+
+from ..client.operation import WeedClient
+from ..utils.httpd import http_json
+
+
+def repeated_vacuum(master: str, rounds: int = 10, per_round: int = 20,
+                    size: int = 4096, threshold: float = 0.0001,
+                    out=sys.stdout) -> int:
+    """-> number of vacuum rounds that reported compactions."""
+    client = WeedClient(master)
+    compacted_rounds = 0
+    payload = bytes(random.getrandbits(8) for _ in range(size))
+    for r in range(rounds):
+        fids = [client.upload(payload, name=f"rv{r}-{i}.bin")
+                for i in range(per_round)]
+        keep = fids[:2]  # a little live data so volumes survive vacuum
+        for fid in fids[2:]:
+            client.delete(fid)
+        resp = http_json(
+            "GET", f"http://{master}/vol/vacuum"
+                   f"?garbageThreshold={threshold}")
+        if resp.get("compacted"):
+            compacted_rounds += 1
+        # the kept needles must still read back after every compaction
+        for fid in keep:
+            got = client.download(fid)
+            if got != payload:
+                print(f"round {r}: CORRUPTION on {fid}", file=out)
+                raise SystemExit(2)
+        print(f"round {r}: vacuum={resp} live data verified", file=out)
+        time.sleep(0.1)
+    return compacted_rounds
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("-master", default="localhost:9333")
+    ap.add_argument("-rounds", type=int, default=10)
+    ap.add_argument("-perRound", type=int, default=20)
+    ap.add_argument("-size", type=int, default=4096)
+    ap.add_argument("-garbageThreshold", type=float, default=0.0001)
+    args = ap.parse_args(argv)
+    n = repeated_vacuum(args.master, rounds=args.rounds,
+                        per_round=args.perRound, size=args.size,
+                        threshold=args.garbageThreshold)
+    print(f"{n}/{args.rounds} rounds compacted")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,123 @@
+"""s3_bench: S3-gateway throughput benchmark + presigned-PUT demo.
+
+Equivalent of the two /root/reference/unmaintained/s3/ programs:
+benchmark/ (concurrent PUT then GET of N objects through the S3 API,
+reporting req/s and MB/s) and presigned_put/presigned_put.go (mint a
+presigned PUT URL, then upload through it with a plain HTTP client).
+Both run SDK-free against our own SigV4 signer (gateway/s3_auth.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import random
+import sys
+import threading
+import time
+
+from ..gateway.s3_auth import presign_v4, sign_v4
+from ..utils.httpd import http_bytes
+
+
+def bench(endpoint: str, access_key: str, secret_key: str,
+          bucket: str = "s3bench", count: int = 64, size: int = 8 << 10,
+          concurrency: int = 4, out=sys.stdout) -> dict:
+    """PUT `count` objects of `size` bytes with `concurrency` workers,
+    then GET them all back; -> stats dict (puts/gets/errors/MBps)."""
+    base = f"http://{endpoint}"
+
+    def req(method: str, path: str, body: bytes = b"") -> tuple[int, bytes]:
+        url = base + path
+        hdrs = sign_v4(method, url, access_key, secret_key, body)
+        st, got, _ = http_bytes(method, url, body or None, headers=hdrs)
+        return st, got
+
+    st, _ = req("PUT", f"/{bucket}")
+    if st not in (200, 409):
+        raise OSError(f"create bucket: HTTP {st}")
+    payloads = {i: random.Random(i).randbytes(size) for i in range(count)}
+    stats = {"puts": 0, "gets": 0, "errors": 0}
+    lock = threading.Lock()
+
+    def run_phase(method: str) -> float:
+        todo = list(range(count))
+
+        def worker():
+            while True:
+                with lock:
+                    if not todo:
+                        return
+                    i = todo.pop()
+                if method == "PUT":
+                    st, _ = req("PUT", f"/{bucket}/obj{i:05d}", payloads[i])
+                    ok = st == 200
+                else:
+                    st, got = req("GET", f"/{bucket}/obj{i:05d}")
+                    ok = st == 200 and got == payloads[i]
+                with lock:
+                    if ok:
+                        stats["puts" if method == "PUT" else "gets"] += 1
+                    else:
+                        stats["errors"] += 1
+
+        t0 = time.time()
+        threads = [threading.Thread(target=worker)
+                   for _ in range(concurrency)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return time.time() - t0
+
+    wall_put = run_phase("PUT")
+    wall_get = run_phase("GET")
+    stats["put_rps"] = round(count / max(wall_put, 1e-9), 1)
+    stats["get_rps"] = round(count / max(wall_get, 1e-9), 1)
+    stats["put_mbps"] = round(count * size / max(wall_put, 1e-9) / 1e6, 1)
+    stats["get_mbps"] = round(count * size / max(wall_get, 1e-9) / 1e6, 1)
+    print(f"puts: {stats['puts']} ({stats['put_rps']}/s, "
+          f"{stats['put_mbps']} MB/s)  gets: {stats['gets']} "
+          f"({stats['get_rps']}/s, {stats['get_mbps']} MB/s)  "
+          f"errors: {stats['errors']}", file=out)
+    return stats
+
+
+def presigned_put_demo(endpoint: str, access_key: str, secret_key: str,
+                       bucket: str, key: str, data: bytes,
+                       expires: int = 300, out=sys.stdout) -> str:
+    """Mint a presigned PUT URL and upload through it WITHOUT signing
+    headers (presigned_put.go's flow); -> the URL used."""
+    url = presign_v4("PUT", f"http://{endpoint}/{bucket}/{key}",
+                     access_key, secret_key, expires=expires)
+    st, _, _ = http_bytes("PUT", url, data)
+    if st != 200:
+        raise OSError(f"presigned PUT: HTTP {st}")
+    print(f"presigned PUT ok: {len(data)} bytes -> /{bucket}/{key}",
+          file=out)
+    return url
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("-endpoint", default="localhost:8333")
+    ap.add_argument("-accessKey", default="")
+    ap.add_argument("-secretKey", default="")
+    ap.add_argument("-bucket", default="s3bench")
+    ap.add_argument("-count", type=int, default=64)
+    ap.add_argument("-size", type=int, default=8 << 10)
+    ap.add_argument("-c", type=int, default=4, help="concurrency")
+    ap.add_argument("-presignedPut", metavar="KEY",
+                    help="demo mode: presign a PUT for KEY and use it")
+    args = ap.parse_args(argv)
+    if args.presignedPut:
+        presigned_put_demo(args.endpoint, args.accessKey, args.secretKey,
+                           args.bucket, args.presignedPut,
+                           b"presigned payload")
+        return 0
+    s = bench(args.endpoint, args.accessKey, args.secretKey, args.bucket,
+              count=args.count, size=args.size, concurrency=args.c)
+    return 1 if s["errors"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -10,6 +10,7 @@ timestamps) without needing a running server or an `.idx`.
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 
 from ..storage.needle import NEEDLE_HEADER_SIZE, Needle, needle_body_length
@@ -19,25 +20,26 @@ from ..storage.types import size_is_valid
 
 def walk_dat(path: str):
     """Yields (offset, needle) for every record; raises on a malformed
-    superblock, stops cleanly at a torn tail."""
+    superblock, stops cleanly at a torn tail.  Streams record by record
+    so production-sized (30GB+) volumes walk in O(record) memory."""
+    total = os.path.getsize(path)
     with open(path, "rb") as f:
-        blob = f.read()
-    sb = SuperBlock.from_bytes(blob[:SUPER_BLOCK_SIZE + 0xFFFF])
-    yield 0, sb
-    offset = sb.block_size
-    while offset + NEEDLE_HEADER_SIZE <= len(blob):
-        n = Needle()
-        n.parse_header(blob[offset:offset + NEEDLE_HEADER_SIZE])
-        size = n.size if size_is_valid(n.size) else 0
-        body_len = needle_body_length(size, sb.version)
-        body = blob[offset + NEEDLE_HEADER_SIZE:
-                    offset + NEEDLE_HEADER_SIZE + body_len]
-        if len(body) < body_len:
-            print(f"torn tail at offset {offset}", file=sys.stderr)
-            return
-        n.read_body_bytes(body, sb.version)
-        yield offset, n
-        offset += NEEDLE_HEADER_SIZE + body_len
+        sb = SuperBlock.from_bytes(f.read(SUPER_BLOCK_SIZE + 0xFFFF))
+        yield 0, sb
+        offset = sb.block_size
+        f.seek(offset)
+        while offset + NEEDLE_HEADER_SIZE <= total:
+            n = Needle()
+            n.parse_header(f.read(NEEDLE_HEADER_SIZE))
+            size = n.size if size_is_valid(n.size) else 0
+            body_len = needle_body_length(size, sb.version)
+            body = f.read(body_len)
+            if len(body) < body_len:
+                print(f"torn tail at offset {offset}", file=sys.stderr)
+                return
+            n.read_body_bytes(body, sb.version)
+            yield offset, n
+            offset += NEEDLE_HEADER_SIZE + body_len
 
 
 def main(argv=None) -> int:

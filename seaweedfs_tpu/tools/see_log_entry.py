@@ -1,0 +1,54 @@
+"""see_log_entry: print a filer's persisted meta-log events.
+
+Equivalent of /root/reference/unmaintained/see_log_entry/
+see_log_entry.go (which parses the filer's on-disk log-entry files):
+fetch the durable meta event stream over /api/meta/log and print each
+create/update/delete with its timestamp and signature — the audit view
+filer.sync and the mount invalidation ride on.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+from ..utils.httpd import http_json
+
+
+def see_log(filer: str, since_ns: int = 0, out=sys.stdout) -> int:
+    doc = http_json("GET",
+                    f"http://{filer}/api/meta/log?since_ns={since_ns}")
+    events = doc.get("events") or doc.get("Events") or []
+    for e in events:
+        ts = e.get("ts_ns", 0)
+        when = time.strftime("%Y-%m-%d %H:%M:%S",
+                             time.localtime(ts / 1e9)) if ts else "?"
+        old = (e.get("old_entry") or {}).get("full_path")
+        new = (e.get("new_entry") or {}).get("full_path")
+        if old and new:
+            kind, what = ("RENAME", f"{old} -> {new}") if old != new \
+                else ("UPDATE", new)
+        elif new:
+            kind, what = "CREATE", new
+        else:
+            kind, what = "DELETE", old
+        chunks = len((e.get("new_entry") or {}).get("chunks") or [])
+        sigs = e.get("signatures") or []
+        print(f"{when} ts={ts} sig={','.join(str(s) for s in sigs)} "
+              f"{kind} {what} chunks={chunks}", file=out)
+    print(f"{len(events)} events", file=out)
+    return len(events)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("-filer", default="localhost:8888")
+    ap.add_argument("-sinceNs", type=int, default=0)
+    args = ap.parse_args(argv)
+    see_log(args.filer, since_ns=args.sinceNs)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,63 @@
+"""see_meta: dump a live filer's metadata tree.
+
+Equivalent of /root/reference/unmaintained/see_meta/see_meta.go (which
+walks the filer's exported meta stream): recursively list every entry
+under a path with its size, chunk count, and mode — the whole-filer
+metadata view for debugging store contents.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from urllib.parse import quote
+
+from ..utils.httpd import http_json
+
+
+def walk(filer: str, path: str, out=sys.stdout) -> int:
+    """Prints the subtree rooted at path; returns entry count."""
+    count = 0
+    stack = [path.rstrip("/") or "/"]
+    while stack:
+        d = stack.pop()
+        last = ""
+        while True:
+            q = f"?limit=1000&lastFileName={quote(last)}"
+            doc = http_json("GET", f"http://{filer}{quote(d)}{q}",
+                            timeout=30.0)
+            entries = doc.get("Entries") or []
+            if not entries:
+                break
+            for e in entries:
+                # compact listing form (filer/server.py _entry_json):
+                # FullPath/IsDirectory/FileSize/chunks(count)
+                full = e["FullPath"]
+                is_dir = bool(e.get("IsDirectory"))
+                kind = "d" if is_dir else "-"
+                chunks = e.get("chunks", 0)
+                size = e.get("FileSize", 0)
+                print(f"{kind} {full}  size={size} chunks={chunks}",
+                      file=out)
+                count += 1
+                if is_dir:
+                    stack.append(full)
+            if not doc.get("ShouldDisplayLoadMore"):
+                break
+            last = (doc.get("LastFileName")
+                    or entries[-1]["FullPath"].rsplit("/", 1)[-1])
+    print(f"{count} entries", file=out)
+    return count
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("-filer", default="localhost:8888")
+    ap.add_argument("-path", default="/")
+    args = ap.parse_args(argv)
+    walk(args.filer, args.path)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

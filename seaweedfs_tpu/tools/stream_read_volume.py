@@ -1,0 +1,73 @@
+"""stream_read_volume: walk a REMOTE volume's needles over HTTP.
+
+Equivalent of /root/reference/unmaintained/stream_read_volume/
+stream_read_volume.go: pull a volume server's .dat through the
+streaming volume_download RPC and print every needle record — the
+network twin of see_dat for volumes you cannot reach on disk.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import tempfile
+
+from ..storage.super_block import SuperBlock
+from ..utils.httpd import http_download, http_json
+from .see_dat import walk_dat
+
+
+def stream_read(server: str, vid: int, verbose: bool = False,
+                out=sys.stdout) -> int:
+    """Downloads (to a temp file, streamed in bounded pieces) and walks
+    the remote .dat; returns the number of needle records."""
+    with tempfile.TemporaryDirectory() as td:
+        dat = os.path.join(td, f"{vid}.dat")
+        status = http_download(
+            "GET", f"http://{server}/admin/volume_download"
+                   f"?volume_id={vid}&ext=.dat", dat)
+        if status != 200:
+            raise SystemExit(f"volume_download {server} vol {vid}: "
+                             f"HTTP {status}")
+        count = 0
+        for offset, rec in walk_dat(dat):
+            if isinstance(rec, SuperBlock):
+                print(f"superblock: version={int(rec.version)} "
+                      f"replication={rec.replica_placement} "
+                      f"compaction_revision={rec.compaction_revision}",
+                      file=out)
+                continue
+            line = (f"offset {offset:>12} id {rec.id:>12} "
+                    f"cookie {rec.cookie:#010x} size {rec.size}")
+            if verbose and rec.name:
+                line += f" name={rec.name.decode(errors='replace')!r}"
+            print(line, file=out)
+            count += 1
+        print(f"{count} needle records", file=out)
+        return count
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("-master", default="localhost:9333")
+    ap.add_argument("-server", default="",
+                    help="volume server url; default: first location "
+                         "from the master")
+    ap.add_argument("-volumeId", type=int, required=True)
+    ap.add_argument("-v", action="store_true", help="print names too")
+    args = ap.parse_args(argv)
+    server = args.server
+    if not server:
+        d = http_json("GET", f"http://{args.master}/dir/lookup"
+                             f"?volumeId={args.volumeId}")
+        locs = d.get("locations") or []
+        if not locs:
+            raise SystemExit(f"volume {args.volumeId} not found")
+        server = locs[0]["url"]
+    stream_read(server, args.volumeId, verbose=args.v)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

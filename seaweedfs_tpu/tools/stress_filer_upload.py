@@ -1,0 +1,92 @@
+"""stress_filer_upload: concurrent uploads through the FILER path.
+
+Equivalent of /root/reference/unmaintained/stress_filer_upload/
+stress_filer_upload_actual.go: N workers PUT random-sized files to
+random paths under a filer prefix for a fixed duration, then read a
+sample back — exercising auto-chunking, the filer store, and the
+assign path together (load_test covers the master/volume path; this
+covers the filer's).
+"""
+
+from __future__ import annotations
+
+import argparse
+import random
+import sys
+import threading
+import time
+
+from ..utils.httpd import http_bytes
+
+
+def stress_filer(filer: str, seconds: float, concurrency: int = 4,
+                 min_size: int = 1 << 10, max_size: int = 64 << 10,
+                 prefix: str = "/stress") -> dict:
+    stop = time.time() + seconds
+    lock = threading.Lock()
+    stats = {"uploads": 0, "reads": 0, "errors": 0, "bytes": 0}
+
+    def worker(wid: int):
+        rng = random.Random(wid)
+        uploaded: list[tuple[str, int, int]] = []  # (path, size, seed)
+        while time.time() < stop:
+            try:
+                size = rng.randint(min_size, max_size)
+                seed = rng.getrandbits(32)
+                body = random.Random(seed).randbytes(size)
+                path = f"{prefix}/w{wid}/f{rng.getrandbits(48):012x}.bin"
+                st, _, _ = http_bytes(
+                    "PUT", f"http://{filer}{path}", body)
+                if st not in (200, 201):
+                    raise OSError(f"PUT {st}")
+                uploaded.append((path, size, seed))
+                with lock:
+                    stats["uploads"] += 1
+                    stats["bytes"] += size
+                if uploaded and rng.random() < 0.3:
+                    path, size, seed = rng.choice(uploaded)
+                    st, got, _ = http_bytes(
+                        "GET", f"http://{filer}{path}")
+                    want = random.Random(seed).randbytes(size)
+                    if st != 200 or got != want:
+                        raise OSError(f"GET {st} mismatch={got != want}")
+                    with lock:
+                        stats["reads"] += 1
+            except Exception:
+                with lock:
+                    stats["errors"] += 1
+
+    threads = [threading.Thread(target=worker, args=(i,), daemon=True)
+               for i in range(concurrency)]
+    t0 = time.time()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    dt = max(time.time() - t0, 1e-9)
+    stats["seconds"] = round(dt, 2)
+    stats["upload_rps"] = round(stats["uploads"] / dt, 1)
+    stats["mbps"] = round(stats["bytes"] / dt / 1e6, 2)
+    return stats
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("-filer", default="localhost:8888")
+    ap.add_argument("-seconds", type=float, default=10.0)
+    ap.add_argument("-c", type=int, default=4)
+    ap.add_argument("-minSize", type=int, default=1 << 10)
+    ap.add_argument("-maxSize", type=int, default=64 << 10)
+    ap.add_argument("-prefix", default="/stress")
+    args = ap.parse_args(argv)
+    out = stress_filer(args.filer, args.seconds, concurrency=args.c,
+                       min_size=args.minSize, max_size=args.maxSize,
+                       prefix=args.prefix)
+    print(f"uploads: {out['uploads']} ({out['upload_rps']}/s, "
+          f"{out['mbps']} MB/s)  reads: {out['reads']}  "
+          f"errors: {out['errors']}  in {out['seconds']}s")
+    return 1 if out["errors"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

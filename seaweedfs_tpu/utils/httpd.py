@@ -35,7 +35,13 @@ class Request:
         self.handler = handler
         self.match = match
         parsed = urllib.parse.urlparse(handler.path)
-        self.path = parsed.path
+        # %-escapes are decoded HERE, once, like Go's r.URL.Path (the
+        # reference handlers all consume the decoded form); handlers and
+        # route regexes see real names, clients re-quote when building URLs.
+        # raw_path keeps the wire form for signature canonicalization
+        # (SigV4 must see what the client signed, like Go's URL.RawPath)
+        self.raw_path = parsed.path
+        self.path = urllib.parse.unquote(parsed.path)
         self.query = {k: v[0] for k, v in urllib.parse.parse_qs(
             parsed.query, keep_blank_values=True).items()}
         self.headers = handler.headers
@@ -50,6 +56,68 @@ class Request:
 
     def json(self) -> dict:
         return json.loads(self.body or b"{}")
+
+
+def parse_form_data(body: bytes, content_type: str) -> dict:
+    """Minimal multipart/form-data parser for POST uploads: returns
+    {field: str} plus {"file": bytes, "file.name": str} for the file
+    part.  Per the S3 POST contract, fields after `file` are ignored."""
+    m = re.search(r'boundary="?([^";]+)"?', content_type)
+    if not m:
+        raise ValueError("no multipart boundary")
+    # RFC 2046 delimiters are CRLF--boundary, NOT the bare boundary
+    # bytes — a file whose CONTENT contains the boundary string must
+    # survive.  Prefixing CRLF makes the first (dashless) delimiter
+    # uniform with the rest.
+    sep = b"\r\n--" + m.group(1).encode()
+    fields: dict = {}
+    for part in (b"\r\n" + body).split(sep)[1:]:
+        if part.startswith(b"--"):
+            break  # closing delimiter
+        part = part.lstrip(b" \t")  # transport padding after boundary
+        if part.startswith(b"\r\n"):
+            part = part[2:]
+        head, hsep, payload = part.partition(b"\r\n\r\n")
+        if not hsep and not head.strip():
+            continue
+        disp = ""
+        ptype = ""
+        for line in head.split(b"\r\n"):
+            low = line.lower()
+            if low.startswith(b"content-disposition:"):
+                disp = line.decode(errors="replace")
+            elif low.startswith(b"content-type:"):
+                ptype = line.split(b":", 1)[1].strip().decode(errors="replace")
+        nm = re.search(r'name="([^"]*)"', disp)
+        name = nm.group(1) if nm else ""
+        if name.lower() == "file":
+            fn = re.search(r'filename="([^"]*)"', disp)
+            fields["file"] = payload
+            fields["file.name"] = fn.group(1) if fn else ""
+            if ptype:
+                fields.setdefault("content-type", ptype)
+            break  # everything after the file part is ignored
+        fields[name.lower()] = payload.decode(errors="replace")
+    return fields
+
+
+def extract_upload(body: bytes, content_type: str) -> tuple[bytes, str, str]:
+    """-> (data, filename, mime) for a write-request body: unwraps one
+    multipart/form-data file part the way the reference's needle
+    ParseUpload does (needle_parse_upload.go:37-76); raw bodies pass
+    through with the request Content-Type as the mime."""
+    if content_type and content_type.lower().startswith("multipart/form-data"):
+        try:
+            fields = parse_form_data(body, content_type)
+        except ValueError as e:
+            raise HttpError(400, str(e))  # client framing error, not a 500
+        if "file" in fields:
+            # basename only (needle_parse_upload.go:141 path.Base): a
+            # crafted filename must not escape the target directory
+            fname = fields.get("file.name", "")
+            fname = fname.replace("\\", "/").rsplit("/", 1)[-1]
+            return fields["file"], fname, fields.get("content-type", "")
+    return body, "", content_type
 
 
 class Response:
@@ -104,7 +172,7 @@ class Router:
                                          status=503,
                                          headers={"Connection": "close"}))
             return
-        path = urllib.parse.urlparse(handler.path).path
+        path = urllib.parse.unquote(urllib.parse.urlparse(handler.path).path)
         for m, pattern, fn in self.routes:
             if m != method:
                 continue

@@ -39,6 +39,7 @@ from ..utils.httpd import (
     Request,
     Response,
     Router,
+    extract_upload,
     http_bytes,
     http_json,
     serve,
@@ -540,9 +541,13 @@ class VolumeServer:
                 others = [u for u in replicas if u != self.url]
                 if not others:
                     raise HttpError(404, f"volume {vid} not found")
-                return Response(None, status=302,
-                                headers={"Location": f"http://{others[0]}{req.path}"},
-                                raw=b"")
+                import urllib.parse as _up
+
+                return Response(
+                    None, status=302,
+                    headers={"Location":
+                             f"http://{others[0]}{_up.quote(req.path, safe="/,")}"},
+                    raw=b"")
             etag = f'"{n.etag()}"'
             if req.headers.get("If-None-Match") == etag:
                 return Response(None, status=304, raw=b"")
@@ -607,18 +612,29 @@ class VolumeServer:
                 fid = FileId.parse(f"{req.match.group(1)},{req.match.group(2)}")
             except ValueError as e:
                 raise HttpError(400, str(e))
-            n = Needle(cookie=fid.cookie, id=fid.key, data=req.body)
+            # curl -F / form uploads arrive multipart-wrapped; unwrap the
+            # file part on POST only (needle_parse_upload.go:46-50 —
+            # PUT bodies are raw even when multipart-typed)
+            if req.handler.command == "POST":
+                data, part_name, part_mime = extract_upload(
+                    req.body, req.headers.get("Content-Type") or "")
+            else:
+                data, part_name, part_mime = req.body, "", ""
+            n = Needle(cookie=fid.cookie, id=fid.key, data=data)
             # client pre-gzipped the payload (upload_content.go:116):
             # remember it in the needle flags so reads can undo it
             if req.headers.get("Content-Encoding") == "gzip":
                 from ..storage.needle import FLAG_IS_COMPRESSED
 
                 n.set_flag(FLAG_IS_COMPRESSED)
-            name = req.query.get("name") or req.headers.get("X-File-Name")
+            name = (req.query.get("name") or req.headers.get("X-File-Name")
+                    or part_name)
             if name:
                 n.set_flag(FLAG_HAS_NAME)
                 n.name = name.encode()[:255]
             mime = req.headers.get("Content-Type")
+            if mime and mime.lower().startswith("multipart/form-data"):
+                mime = part_mime or None
             if mime in ("application/x-www-form-urlencoded",):  # client default
                 mime = None
             if mime and mime != "application/octet-stream":
@@ -649,6 +665,10 @@ class VolumeServer:
 
                 params = {k: v for k, v in req.query.items() if k != "type"}
                 params["type"] = "replicate"
+                if name and "name" not in params:
+                    # a multipart filename must survive the (unwrapped)
+                    # replica forward
+                    params["name"] = name
                 # forward the signed fid token so replicas pass their guard
                 from ..security import get_jwt
 
@@ -664,8 +684,9 @@ class VolumeServer:
                     if url == self.url:
                         continue
                     status, body, _ = http_bytes(
-                        "POST", f"http://{url}{req.path}?{qs}",
-                        req.body, headers=fwd_headers)
+                        "POST",
+                        f"http://{url}{urllib.parse.quote(req.path, safe="/,")}?{qs}",
+                        data, headers=fwd_headers)
                     if status != 200 and status != 201:
                         raise HttpError(500,
                                         f"replication to {url} failed: {status}")
@@ -698,10 +719,13 @@ class VolumeServer:
 
                 token = get_jwt(req.headers, req.query)
                 qs = "?type=replicate" + (f"&jwt={token}" if token else "")
+                import urllib.parse as _up
+
                 for url in self._lookup_replicas(vid):
                     if url == self.url:
                         continue
-                    http_bytes("DELETE", f"http://{url}{req.path}{qs}")
+                    http_bytes("DELETE",
+                               f"http://{url}{_up.quote(req.path, safe="/,")}{qs}")
             return Response({"size": size})
 
         # --- admin: volume lifecycle ---------------------------------

@@ -41,7 +41,7 @@ def test_fix_rebuilds_idx(tmp_path):
     idx.write_bytes(b"garbage!")  # corrupt the index
     r = _run("fix", "-dir", str(tmp_path), "-volumeId", str(vid))
     assert r.returncode == 0, r.stderr
-    assert "wrote 4 live entries" in r.stdout
+    assert "scanned 6 records (4 live)" in r.stdout
     # the volume opens and serves from the rebuilt index
     v = Volume(str(tmp_path), "", vid)
     try:
@@ -53,7 +53,10 @@ def test_fix_rebuilds_idx(tmp_path):
             v.read_needle(2)
     finally:
         v.close()
-    assert len(idx.read_bytes()) % 16 == 0 and idx.read_bytes() != original
+    # fix appends entries in .dat scan order with live-path tombstone
+    # shape, so the rebuilt index is byte-identical to the original
+    # live-written log
+    assert idx.read_bytes() == original
 
 
 def test_compact_command(tmp_path):
@@ -143,3 +146,16 @@ def test_autocomplete_emits_bash_completion(capsys):
     assert "complete -F _weed_complete" in out
     for cmd in ("master", "volume", "filer", "benchmark", "shell"):
         assert cmd in out
+
+
+def test_fix_preserves_idx_on_malformed_dat(tmp_path):
+    """A corrupt .dat superblock must not cost the operator the only
+    surviving index: fix builds to a temp file and renames on success."""
+    vid = _make_volume(tmp_path)
+    idx = tmp_path / f"{vid}.idx"
+    original = idx.read_bytes()
+    (tmp_path / f"{vid}.dat").write_bytes(b"\xde\xad")  # malformed
+    r = _run("fix", "-dir", str(tmp_path), "-volumeId", str(vid))
+    assert r.returncode != 0
+    assert idx.read_bytes() == original  # untouched
+    assert not (tmp_path / f"{vid}.idx_fix").exists()

@@ -163,3 +163,29 @@ def test_remote_gateway_maps_buckets(trio, tmp_path):
     finally:
         gw.stop()
         s3.stop()
+
+
+def test_s3_bench_and_presigned_put(trio):
+    """tools/s3_bench covers both /root/reference/unmaintained/s3/
+    programs: the PUT/GET benchmark and the presigned-PUT demo."""
+    from seaweedfs_tpu.gateway.s3 import S3ApiServer
+    from seaweedfs_tpu.tools.s3_bench import bench, presigned_put_demo
+
+    _, _, filer = trio
+    s3 = S3ApiServer(filer, port=free_port()).start()
+    try:
+        out = io.StringIO()
+        stats = bench(s3.url, "", "", bucket="benchb", count=12,
+                      size=2048, concurrency=3, out=out)
+        assert stats["errors"] == 0
+        assert stats["puts"] == 12 and stats["gets"] == 12
+        assert "MB/s" in out.getvalue()
+        out = io.StringIO()
+        presigned_put_demo(s3.url, "", "", "benchb", "pre signed.bin",
+                           b"presigned payload", out=out)
+        assert "presigned PUT ok" in out.getvalue()
+        st, body, _ = http_bytes(
+            "GET", f"http://{s3.url}/benchb/pre%20signed.bin")
+        assert (st, body) == (200, b"presigned payload")
+    finally:
+        s3.stop()

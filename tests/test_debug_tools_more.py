@@ -1,0 +1,209 @@
+"""Second debug-tool batch: change_superblock, check_disk_size,
+remove_duplicate_fids, repeated_vacuum, stress_filer_upload,
+stream_read_volume, see_meta, see_log_entry, compact_lsm.
+
+References: the corresponding /root/reference/unmaintained/ tools.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import time
+
+import pytest
+
+from seaweedfs_tpu.storage.needle import Needle
+from seaweedfs_tpu.storage.volume import Volume
+
+from .conftest import free_port
+
+
+@pytest.fixture()
+def trio(tmp_path):
+    from seaweedfs_tpu.filer.server import FilerServer
+    from seaweedfs_tpu.master.server import MasterServer
+    from seaweedfs_tpu.volume_server.server import VolumeServer
+
+    master = MasterServer(port=free_port(), pulse_seconds=0.3).start()
+    (tmp_path / "v").mkdir()
+    vol = VolumeServer([str(tmp_path / "v")], master.url, port=free_port(),
+                       pulse_seconds=0.3).start()
+    deadline = time.time() + 6
+    while time.time() < deadline and not master.topo.all_nodes():
+        time.sleep(0.05)
+    filer = FilerServer(master.url, port=free_port()).start()
+    yield master, vol, filer
+    filer.stop()
+    vol.stop()
+    master.stop()
+
+
+def test_change_superblock_roundtrip(tmp_path, capsys):
+    from seaweedfs_tpu.tools.change_superblock import change_superblock
+
+    v = Volume(str(tmp_path), "", 5)
+    v.write_needle(Needle(cookie=1, id=1, data=b"payload" * 10))
+    v.close()
+    # print-only first
+    sb = change_superblock(str(tmp_path), "", 5)
+    assert str(sb.replica_placement) == "000"
+    # change replication + ttl in place
+    change_superblock(str(tmp_path), "", 5, replication="010", ttl="3d")
+    v2 = Volume(str(tmp_path), "", 5)
+    assert str(v2.super_block.replica_placement) == "010"
+    assert str(v2.super_block.ttl) == "3d"
+    assert v2.read_needle(1, cookie=1).data == b"payload" * 10
+    v2.close()
+
+
+def test_check_disk_size(tmp_path, capsys):
+    from seaweedfs_tpu.tools.check_disk_size import check_dir, main
+
+    v = Volume(str(tmp_path), "", 6)
+    v.write_needle(Needle(cookie=1, id=1, data=b"x" * 4096))
+    v.close()
+    (tmp_path / "unrelated.txt").write_bytes(b"y" * 100)
+    r = check_dir(str(tmp_path))
+    assert r["volume_bytes"] > 4096
+    assert r["other_bytes"] == 100
+    assert r["fs_total"] > 0
+    assert main([str(tmp_path)]) == 0
+    assert "% of used is volume data" in capsys.readouterr().out
+
+
+def test_remove_duplicate_fids(tmp_path):
+    from seaweedfs_tpu.tools.remove_duplicate_fids import remove_duplicates
+
+    v = Volume(str(tmp_path), "", 7)
+    v.write_needle(Needle(cookie=1, id=1, data=b"old-version" * 8))
+    v.write_needle(Needle(cookie=2, id=2, data=b"unique" * 8))
+    v.write_needle(Needle(cookie=1, id=1, data=b"NEW-version" * 8))
+    v.close()
+    kept, dupes = remove_duplicates(str(tmp_path), "", 7)
+    assert (kept, dupes) == (2, 1)
+    # the cleaned volume keeps the LAST record for id 1
+    os.replace(tmp_path / "7.dat_cleaned", tmp_path / "7.dat")
+    os.unlink(tmp_path / "7.idx")
+    from seaweedfs_tpu.tools.see_dat import walk_dat
+    from seaweedfs_tpu.storage.super_block import SuperBlock
+
+    datas = [rec.data for _, rec in walk_dat(str(tmp_path / "7.dat"))
+             if not isinstance(rec, SuperBlock)]
+    assert datas == [b"unique" * 8, b"NEW-version" * 8]
+
+
+def test_remove_duplicate_fids_fix_reopen(tmp_path):
+    """The full repair recipe the tool prints: dedup -> weed fix ->
+    reopen.  Regression: fix used to write the .idx id-sorted, and the
+    open-time integrity check (which trusts the LAST idx entry to name
+    the .dat tail) truncated every record past the highest id."""
+    import subprocess
+    import sys
+
+    from seaweedfs_tpu.tools.remove_duplicate_fids import remove_duplicates
+
+    v = Volume(str(tmp_path), "", 7)
+    for i in range(1, 21):
+        v.write_needle(Needle(cookie=9, id=i, data=b"first-%d" % i))
+    for i in range(5, 10):  # ids 5..9 rewritten -> dups at the tail
+        v.write_needle(Needle(cookie=9, id=i, data=b"second-%d" % i))
+    v.close()
+    kept, dupes = remove_duplicates(str(tmp_path), "", 7)
+    assert (kept, dupes) == (20, 5)
+    os.replace(tmp_path / "7.dat", tmp_path / "7.dat_orig")
+    os.replace(tmp_path / "7.dat_cleaned", tmp_path / "7.dat")
+    os.unlink(tmp_path / "7.idx")
+    weed = os.path.join(os.path.dirname(os.path.dirname(__file__)),
+                        "weed.py")
+    r = subprocess.run(
+        [sys.executable, weed, "fix", "-dir", str(tmp_path),
+         "-volumeId", "7"], capture_output=True, text=True, timeout=120,
+        env={**os.environ, "PYTHONPATH": os.path.dirname(weed)})
+    assert r.returncode == 0, r.stderr
+    dat_size = (tmp_path / "7.dat").stat().st_size
+    v2 = Volume(str(tmp_path), "", 7)
+    try:
+        # open must NOT truncate the (valid) cleaned volume
+        assert (tmp_path / "7.dat").stat().st_size == dat_size
+        assert v2.read_needle(7, cookie=9).data == b"second-7"
+        assert v2.read_needle(15, cookie=9).data == b"first-15"
+    finally:
+        v2.close()
+
+
+def test_repeated_vacuum_keeps_live_data(trio):
+    from seaweedfs_tpu.tools.repeated_vacuum import repeated_vacuum
+
+    master, _, _ = trio
+    out = io.StringIO()
+    compacted = repeated_vacuum(master.url, rounds=2, per_round=8,
+                                size=2048, out=out)
+    assert compacted >= 1  # deletes made garbage, vacuum compacted
+    assert "CORRUPTION" not in out.getvalue()
+
+
+def test_stress_filer_upload(trio):
+    from seaweedfs_tpu.tools.stress_filer_upload import stress_filer
+
+    _, _, filer = trio
+    out = stress_filer(filer.url, seconds=1.5, concurrency=2,
+                       min_size=512, max_size=4096)
+    assert out["errors"] == 0
+    assert out["uploads"] > 0 and out["reads"] > 0
+
+
+def test_stream_read_volume(trio, capsys):
+    from seaweedfs_tpu.client.operation import WeedClient
+    from seaweedfs_tpu.tools.stream_read_volume import stream_read
+
+    master, vol, _ = trio
+    client = WeedClient(master.url)
+    fid = client.upload(b"streamed needle " * 16, name="s.bin")
+    vid = int(fid.split(",")[0])
+    out = io.StringIO()
+    count = stream_read(vol.url, vid, verbose=True, out=out)
+    assert count == 1
+    text = out.getvalue()
+    assert "superblock: version=3" in text
+    assert "s.bin" in text  # -v prints names
+
+
+def test_see_meta_and_see_log_entry(trio, capsys):
+    from seaweedfs_tpu.tools.see_log_entry import see_log
+    from seaweedfs_tpu.tools.see_meta import walk
+    from seaweedfs_tpu.utils.httpd import http_bytes
+
+    _, _, filer = trio
+    http_bytes("PUT", f"http://{filer.url}/docs/a.txt", b"alpha")
+    http_bytes("PUT", f"http://{filer.url}/docs/deep/b.txt", b"beta")
+    http_bytes("DELETE", f"http://{filer.url}/docs/a.txt")
+    out = io.StringIO()
+    n = walk(filer.url, "/", out=out)
+    text = out.getvalue()
+    assert "/docs/deep/b.txt" in text and n >= 2
+    out = io.StringIO()
+    events = see_log(filer.url, out=out)
+    text = out.getvalue()
+    assert events >= 3
+    assert "CREATE /docs/a.txt" in text
+    assert "DELETE /docs/a.txt" in text
+
+
+def test_compact_lsm(tmp_path):
+    from seaweedfs_tpu.filer.lsm_store import LsmStore
+    from seaweedfs_tpu.tools.compact_lsm import compact
+
+    d = str(tmp_path / "s.lsm")
+    store = LsmStore(d, memtable_limit=4)
+    for i in range(40):  # many flushes -> many sstables
+        store.kv_put(f"k{i:03d}".encode(), f"v{i}".encode())
+    store.kv_delete(b"k001")
+    store.flush()
+    del store
+    before, after = compact(d)
+    assert before > 1 and after == 1
+    reopened = LsmStore(d)
+    assert reopened.kv_get(b"k000") == b"v0"
+    assert reopened.kv_get(b"k001") is None
+    assert reopened.kv_get(b"k039") == b"v39"

@@ -217,3 +217,98 @@ def test_meta_notify_republishes(stack):
     r2 = http_json("GET", base + f"/api/meta/log?since_ns={t0}")
     assert any((e["new_entry"] or {}).get("full_path") == "/n/a.txt"
                for e in r2["events"])
+
+
+def test_percent_encoded_paths_roundtrip(stack):
+    """%-escapes in request targets are decoded once at the HTTP layer
+    (Go's r.URL.Path semantics — the reference handlers all consume the
+    decoded form): '/my docs/read me.md' uploaded via its encoded URL is
+    stored, listed, and served under its REAL name."""
+    _, _, filer = stack
+    base = f"http://{filer.url}"
+    enc = base + "/my%20docs/sub%25dir/read%20me.md"
+    status, _, _ = http_bytes("PUT", enc, b"spaced out")
+    assert status == 201
+    e = filer.filer.find_entry("/my docs/sub%dir/read me.md")
+    assert e.file_size == 10
+    status, body, _ = http_bytes("GET", enc)
+    assert (status, body) == (200, b"spaced out")
+    listing = http_json("GET", base + "/my%20docs/sub%25dir/")
+    assert [x["FullPath"] for x in listing["Entries"]] == \
+        ["/my docs/sub%dir/read me.md"]
+
+
+def _multipart(data: bytes, filename: str, ctype: str) -> tuple[bytes, str]:
+    boundary = "testboundary5309"
+    body = (f"--{boundary}\r\n"
+            f'Content-Disposition: form-data; name="file"; '
+            f'filename="{filename}"\r\n'
+            f"Content-Type: {ctype}\r\n\r\n").encode() + data + \
+        f"\r\n--{boundary}--\r\n".encode()
+    return body, f"multipart/form-data; boundary={boundary}"
+
+
+def test_multipart_upload_unwrapped(stack):
+    """curl -F style multipart/form-data bodies are unwrapped to the file
+    part on both the filer and the volume server, like the reference's
+    needle ParseUpload (needle_parse_upload.go:37-76)."""
+    master, vol, filer = stack
+    payload = b"\x00multi\xffpart payload" * 9
+    body, ctype = _multipart(payload, "a.bin", "application/x-custom")
+    # filer path
+    status, _, _ = http_bytes(
+        "POST", f"http://{filer.url}/mp/a.bin", body,
+        headers={"Content-Type": ctype})
+    assert status == 201
+    status, got, hdrs = http_bytes("GET", f"http://{filer.url}/mp/a.bin")
+    assert (status, got) == (200, payload)
+    assert hdrs.get("Content-Type") == "application/x-custom"
+    # direct volume path: the part filename lands in the needle name
+    a = http_json("GET", f"http://{master.url}/dir/assign")
+    status, _, _ = http_bytes(
+        "POST", f"http://{a['url']}/{a['fid']}", body,
+        headers={"Content-Type": ctype})
+    assert status == 201
+    status, got, hdrs = http_bytes("GET", f"http://{a['url']}/{a['fid']}")
+    assert (status, got) == (200, payload)
+    assert hdrs.get("Content-Type") == "application/x-custom"
+
+
+def test_multipart_to_directory_and_malformed(stack):
+    _, _, filer = stack
+    base = f"http://{filer.url}"
+    body, ctype = _multipart(b"form to dir", "from form.txt", "text/plain")
+    # form upload to a directory URL: the part filename names the entry
+    status, _, _ = http_bytes("POST", base + "/updir/", body,
+                              headers={"Content-Type": ctype})
+    assert status == 201
+    status, got, _ = http_bytes("GET", base + "/updir/from%20form.txt")
+    assert (status, got) == (200, b"form to dir")
+    # multipart content-type without a boundary is the CLIENT's error
+    status, _, _ = http_bytes(
+        "POST", base + "/updir/bad.bin", b"xx",
+        headers={"Content-Type": "multipart/form-data"})
+    assert status == 400
+
+
+def test_multipart_safety_gates(stack):
+    _, _, filer = stack
+    base = f"http://{filer.url}"
+    # a crafted part filename cannot escape the target directory
+    body, ctype = _multipart(b"contained", "../../evil.txt", "text/plain")
+    status, _, _ = http_bytes("POST", base + "/jail/", body,
+                              headers={"Content-Type": ctype})
+    assert status == 201
+    assert filer.filer.find_entry("/jail/evil.txt").file_size == 9
+    import pytest as _pytest
+
+    from seaweedfs_tpu.filer.filer import NotFoundError as FilerNotFound
+    with _pytest.raises(FilerNotFound):
+        filer.filer.find_entry("/evil.txt")
+    # PUT bodies are raw even when multipart-typed (doPutAutoChunk):
+    # a stored HTTP capture whose CONTENT is multipart survives verbatim
+    status, _, _ = http_bytes("PUT", base + "/jail/capture.bin", body,
+                              headers={"Content-Type": ctype})
+    assert status == 201
+    st, got, _ = http_bytes("GET", base + "/jail/capture.bin")
+    assert (st, got) == (200, body)

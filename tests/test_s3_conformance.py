@@ -785,3 +785,21 @@ def test_bucket_acl_get_and_put(s3):
     assert b"ListBucketResult" not in put_body
     st, body, _ = _req(s3, "PUT", "/nosuchacl?acl", body=b"<X/>")
     assert st == 404
+
+
+def test_percent_encoded_object_keys(s3):
+    """Keys with spaces and literal '%' round-trip through encoded URLs:
+    SigV4 canonicalizes the WIRE path (raw_path) while handlers see the
+    decoded key — a double-decode would 403 or mis-name these."""
+    st, _, _ = _req(s3, "PUT", "/enc")
+    assert st == 200
+    st, _, _ = _req(s3, "PUT", "/enc/my%20docs/a%2520b.txt", b"spaced")
+    assert st == 200
+    st, body, _ = _req(s3, "GET", "/enc/my%20docs/a%2520b.txt")
+    assert (st, body) == (200, b"spaced")
+    # the stored key is the decoded form
+    st, body, _ = _req(s3, "GET", "/enc?list-type=2")
+    assert st == 200
+    assert b"<Key>my docs/a%20b.txt</Key>" in body
+    st, _, _ = _req(s3, "DELETE", "/enc/my%20docs/a%2520b.txt")
+    assert st == 204

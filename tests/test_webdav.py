@@ -199,3 +199,22 @@ def test_move_overwrite_onto_directory_removes_children(dav_stack):
     # the directory's children are gone, not orphaned under a file path
     st, _, _ = http_bytes("GET", _url(dav, "/dir/child.txt"))
     assert st == 404
+
+
+def test_move_percent_encoded_destination(dav_stack):
+    """Destination headers arrive wire-encoded; the decoded name must be
+    the stored one (regression: the HTTP layer now pre-decodes request
+    targets, but headers still need their own decode)."""
+    base = f"http://{dav_stack.url}"
+    http_bytes("MKCOL", _url(dav_stack, "/mv"))
+    http_bytes("PUT", _url(dav_stack, "/mv/plain.txt"), b"payload")
+    status, _, _ = http_bytes(
+        "MOVE", _url(dav_stack, "/mv/plain.txt"),
+        headers={"Destination": f"{base}/mv/spaced%20name.txt"})
+    assert status == 201
+    st, body, _ = http_bytes("GET", base + "/mv/spaced%20name.txt")
+    assert (st, body) == (200, b"payload")
+    # PROPFIND lists the decoded name, href re-encoded
+    st, body, _ = http_bytes("PROPFIND", _url(dav_stack, "/mv/"),
+                             headers={"Depth": "1"})
+    assert b"spaced%20name.txt" in body

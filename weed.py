@@ -601,18 +601,38 @@ def cmd_fix(args) -> None:
     base = volume_file_prefix(args.dir, args.collection, args.volumeId)
     db = MemDb()
     count = 0
-    for offset, rec in walk_dat(base + ".dat"):
-        if isinstance(rec, SuperBlock):
-            continue
-        if size_is_valid(rec.size):
-            db.set(rec.id, offset, rec.size)
-        else:
-            db.unset(rec.id)
-        count += 1
-    with open(base + ".idx", "wb") as f:
-        for nv in db:
-            f.write(idx_mod.pack_entry(nv.key, nv.offset, nv.size))
-    print(f"fix: scanned {count} records, wrote {len(db)} live entries "
+    offset_size = 4
+    # The .idx is an append-order log: one entry per scanned record, in
+    # .dat order (fix.go streams entries the same way).  Writing it
+    # id-sorted would break the open-time integrity check, which trusts
+    # the LAST idx entry to name the .dat tail and truncates past it.
+    # Build to a temp file first: a malformed .dat must not destroy a
+    # surviving index.
+    import os as _os
+
+    tmp_idx = base + ".idx_fix"
+    try:
+        with open(tmp_idx, "wb") as f:
+            for offset, rec in walk_dat(base + ".dat"):
+                if isinstance(rec, SuperBlock):
+                    offset_size = rec.offset_size
+                    continue
+                count += 1
+                if size_is_valid(rec.size):
+                    db.set(rec.id, offset, rec.size)
+                    f.write(idx_mod.pack_entry(rec.id, offset, rec.size,
+                                               offset_size))
+                else:
+                    db.unset(rec.id)
+                    # same shape the live delete path appends:
+                    # (key, tombstone record offset, -1)
+                    f.write(idx_mod.pack_entry(rec.id, offset, -1,
+                                               offset_size))
+    except BaseException:
+        _os.unlink(tmp_idx)
+        raise
+    _os.replace(tmp_idx, base + ".idx")
+    print(f"fix: scanned {count} records ({len(db)} live) "
           f"to {base}.idx")
 
 
