@@ -232,18 +232,23 @@ class RedisStore:
             cmds.append(("ZREM", self._dir_key(d), name.encode()))
         self.client.pipeline(*cmds)
 
-    def delete_folder_children(self, path: str) -> None:
-        """Redis has no prefix-delete: resolve every descendant directory
-        from the d.index sorted set (lex prefix range), then drop each
-        directory's member entries and its set
-        (universal_redis_store.go DeleteFolderChildren)."""
+    def _descendant_dirs(self, path: str) -> list[bytes]:
+        """The directory itself + every descendant directory recorded in
+        the d.index sorted set (lex prefix range)."""
         base = path.rstrip("/") or "/"
         sub_prefix = (base.rstrip("/") or "") + "/"
         descendants = self.client.command(
             "ZRANGEBYLEX", b"d.index",
             b"[" + sub_prefix.encode(),
             b"(" + sub_prefix.encode() + b"\xff") or []
-        for d in [base.encode()] + list(descendants):
+        return [base.encode()] + list(descendants)
+
+    def delete_folder_children(self, path: str) -> None:
+        """Redis has no prefix-delete: resolve every descendant directory
+        from the d.index sorted set (lex prefix range), then drop each
+        directory's member entries and its set
+        (universal_redis_store.go DeleteFolderChildren)."""
+        for d in self._descendant_dirs(path):
             dir_path = d.decode()
             members = self.client.command(
                 "ZRANGEBYLEX", self._dir_key(dir_path), "-", "+") or []

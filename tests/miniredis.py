@@ -2,12 +2,14 @@
 
 Test double for a real Redis (the image has no redis server or redis-py);
 semantics follow the Redis docs for: PING, AUTH, SELECT, SET, GET, DEL,
-ZADD, ZREM, ZRANGEBYLEX (with LIMIT), MGET.  Single-threaded per connection,
+ZADD, ZREM, ZRANGEBYLEX (with LIMIT), MGET, SCRIPT LOAD /
+EVAL / EVALSHA (marker-matched stored procedures, see _run_script).  Single-threaded per connection,
 shared dict state under a lock — plenty for protocol-level store tests.
 """
 
 from __future__ import annotations
 
+import hashlib
 import socket
 import threading
 
@@ -18,6 +20,12 @@ class MiniRedis:
         self.password = password
         self.kv: dict[bytes, bytes] = {}
         self.zsets: dict[bytes, set[bytes]] = {}
+        # sha1 -> script body (SCRIPT LOAD / EVALSHA).  The double does
+        # not interpret Lua: it recognizes the seaweedfs_tpu:* marker
+        # comment and executes that procedure's semantics natively —
+        # validating wire framing, sha addressing, KEYS/ARGV counts and
+        # the NOSCRIPT fallback, not the Lua dialect.
+        self.scripts: dict[bytes, bytes] = {}
         self.lock = threading.Lock()
         # cluster mode: (MiniRedisCluster, (slot_lo, slot_hi)) — keys
         # outside the range answer -MOVED; migrating slots answer -ASK
@@ -210,6 +218,23 @@ class MiniRedis:
                     n += m in z
                     z.discard(m)
                 return b":%d\r\n" % n
+            if cmd == b"SCRIPT" and args and args[0].upper() == b"LOAD":
+                sha = hashlib.sha1(args[1]).hexdigest().encode()
+                self.scripts[sha] = args[1]
+                return self._bulk(sha)
+            if cmd in (b"EVAL", b"EVALSHA"):
+                if cmd == b"EVAL":
+                    script = args[0]
+                    self.scripts[
+                        hashlib.sha1(script).hexdigest().encode()] = script
+                else:
+                    script = self.scripts.get(args[0].lower())
+                    if script is None:
+                        return (b"-NOSCRIPT No matching script. "
+                                b"Please use EVAL.\r\n")
+                nkeys = int(args[1])
+                keys, argv = args[2:2 + nkeys], args[2 + nkeys:]
+                return self._run_script(script, keys, argv)
             if cmd == b"ZRANGEBYLEX":
                 members = sorted(self.zsets.get(args[0], set()))
                 lo, hi = args[1], args[2]
@@ -238,6 +263,33 @@ class MiniRedis:
                 return b"*%d\r\n%s" % (
                     len(sel), b"".join(self._bulk(m) for m in sel))
             return b"-ERR unknown command '%s'\r\n" % cmd
+
+    def _run_script(self, script: bytes, keys: list[bytes],
+                    argv: list[bytes]) -> bytes:
+        """Execute a known stored procedure's semantics (already under
+        self.lock via _dispatch)."""
+        if b"seaweedfs_tpu:insert_entry" in script:
+            full_path, dir_key = keys
+            blob, name, parent = argv
+            self.kv[full_path] = blob
+            if name != b"":
+                self.zsets.setdefault(dir_key, set()).add(name)
+                self.zsets.setdefault(b"d.index", set()).add(parent)
+            return b":0\r\n"
+        if b"seaweedfs_tpu:delete_entry" in script:
+            full_path, dir_key = keys
+            (name,) = argv
+            self.kv.pop(full_path, None)
+            if name != b"":
+                self.zsets.get(dir_key, set()).discard(name)
+            return b":0\r\n"
+        if b"seaweedfs_tpu:delete_folder_children" in script:
+            (dir_key,) = keys
+            (dir_path,) = argv
+            for name in self.zsets.pop(dir_key, set()):
+                self.kv.pop(dir_path + b"/" + name, None)
+            return b":0\r\n"
+        return b"-ERR unknown script\r\n"
 
 
 class MiniRedisCluster:

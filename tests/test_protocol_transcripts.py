@@ -226,3 +226,14 @@ def test_live_cassandra():
     from seaweedfs_tpu.filer.cassandra_store import CassandraStore, CqlClient
 
     _store_crud_cycle(CassandraStore(CqlClient(host=addr[0], port=addr[1])))
+
+
+def test_live_redis_lua():
+    addr = _reachable("WEED_TEST_REDIS", 6379)
+    if addr is None:
+        pytest.skip("no redis at WEED_TEST_REDIS/localhost:6379")
+    from seaweedfs_tpu.filer.redis_lua_store import RedisLuaStore
+
+    # a REAL redis interprets the Lua bodies themselves — the one gate
+    # the marker-matching double cannot provide
+    _store_crud_cycle(RedisLuaStore(host=addr[0], port=addr[1]))

@@ -32,8 +32,14 @@ def server():
     s.stop()
 
 
-@pytest.fixture()
-def store(server):
+@pytest.fixture(params=["plain", "lua"])
+def store(server, request):
+    # every behavioral gate runs against BOTH variants: the pipeline
+    # store and the Lua stored-procedure store share one data model
+    if request.param == "lua":
+        from seaweedfs_tpu.filer.redis_lua_store import RedisLuaStore
+
+        return RedisLuaStore(port=server.port)
     return RedisStore(port=server.port)
 
 
@@ -176,3 +182,35 @@ def test_filer_on_redis(server, store):
     with pytest.raises(NotFoundError):
         f.find_entry("/docs/readme.md")
     f.close()
+
+
+def test_lua_store_scripts_registered_and_noscript_fallback(server):
+    from seaweedfs_tpu.filer.redis_lua_store import RedisLuaStore
+
+    store = RedisLuaStore(port=server.port)
+    assert len(server.scripts) == 3  # preloaded via SCRIPT LOAD
+    # a restarted server loses its script cache: EVALSHA answers
+    # NOSCRIPT, the store falls back to EVAL which re-caches
+    server.scripts.clear()
+    store.insert_entry(_file("/lua/a.txt"))
+    assert server.scripts  # EVAL re-registered the script
+    got = store.find_entry("/lua/a.txt")
+    assert got is not None and got.full_path == "/lua/a.txt"
+    # the listing membership landed atomically with the entry key
+    assert [e.full_path for e in
+            store.list_directory_entries("/lua")] == ["/lua/a.txt"]
+    store.delete_entry("/lua/a.txt")
+    assert store.find_entry("/lua/a.txt") is None
+    assert list(store.list_directory_entries("/lua")) == []
+
+
+def test_lua_store_from_url_and_plain_interop(server):
+    from seaweedfs_tpu.filer.redis_lua_store import RedisLuaStore
+
+    lua = RedisLuaStore.from_url(f"redis-lua://127.0.0.1:{server.port}/0")
+    lua.insert_entry(_file("/shared/x.bin", n=2))
+    # identical key model: the plain store reads what the lua store wrote
+    plain = RedisStore(port=server.port)
+    assert plain.find_entry("/shared/x.bin").chunks[0].file_id == "3,00"
+    plain.delete_entry("/shared/x.bin")
+    assert lua.find_entry("/shared/x.bin") is None

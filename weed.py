@@ -85,6 +85,10 @@ def _make_filer_store(db: str):
         from seaweedfs_tpu.filer.redis_store import RedisStore
 
         return RedisStore.from_url(db)
+    if db.startswith("redis-lua://"):
+        from seaweedfs_tpu.filer.redis_lua_store import RedisLuaStore
+
+        return RedisLuaStore.from_url(db)
     if db.startswith("redis-cluster://"):
         from seaweedfs_tpu.filer.redis_cluster import RedisClusterStore
 
@@ -396,6 +400,7 @@ _SCAFFOLDS = {
 #   mongodb://[user:pw@]host:port/db mongo OP_MSG wire protocol
 #   cassandra://[user:pw@]host:port  CQL v4 binary protocol
 #   hbase://host:port/table          HBase native RegionServer RPC
+#   redis-lua://host:port            Redis w/ Lua atomic mutations
 #   redis-cluster://h1:p1,h2:p2      Redis Cluster (MOVED/ASK aware)
 #   redis-sentinel://h:p,h:p/master  Redis via Sentinel discovery
 # Per-path rules (collection, replication, ttl, fsync) live IN the
