@@ -1,0 +1,188 @@
+"""Shell maintenance logic driven by a checked-in topology snapshot —
+zero servers, pure planning math.
+
+The reference tests its balance/evacuate logic the same way: a
+serialized topology dump (ref: weed/shell/sample.topo.txt, consumed by
+command_ec_encode_test.go + command_ec_test.go) feeds the command and
+the test asserts on the planned operations.  Here SnapshotEnv replays
+tests/fixtures/sample_topo.json (8 nodes / 2 DCs / 2 racks each, an
+overloaded node, a duplicated EC shard, an EC-shard hoarder, an
+under-replicated volume, and an all-deleted volume) and records every
+admin RPC the command would have issued.
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+import os
+
+import pytest
+
+from seaweedfs_tpu.shell.commands import COMMANDS, CommandEnv
+
+FIXTURE = os.path.join(os.path.dirname(__file__), "fixtures",
+                       "sample_topo.json")
+
+OVERLOADED = "10.1.1.1:8080"
+HOARDER = "10.1.1.7:8080"
+DUP_HOLDER = "10.1.1.2:8080"  # second copy of EC shard 100.3
+EMPTY_NODE = "10.1.1.8:8080"
+
+
+class SnapshotEnv(CommandEnv):
+    """CommandEnv over a static topology snapshot: master reads answer
+    from the fixture, volume/master writes are recorded, not sent."""
+
+    def __init__(self, topo: dict):
+        self._topo = topo
+        self.calls: list[tuple[str, str, dict]] = []
+        self.admin_token = 1  # pre-locked
+        self.master_url = "snapshot"
+        self.filer_url = ""
+        self.master = self  # MasterClient surface (lookup/invalidate)
+
+    # -- MasterClient surface ----------------------------------------------
+    def invalidate(self, vid: int) -> None:
+        pass
+
+    def lookup(self, vid: int) -> list[str]:
+        return [n["Url"] for n in self._nodes() if vid in n["VolumeIds"]]
+
+    # -- CommandEnv surface -------------------------------------------------
+    def _nodes(self) -> list[dict]:
+        return [n for dc in self._topo["DataCenters"]
+                for rack in dc["Racks"] for n in rack["DataNodes"]]
+
+    def topology(self) -> dict:
+        return copy.deepcopy(self._topo)
+
+    def master_get(self, path: str) -> dict:
+        if path.startswith("/dir/lookup_ec"):
+            vid = path.split("volumeId=")[1]
+            shards = self._topo["EcVolumes"][vid]
+            return {"volumeId": int(vid),
+                    "collection": self._topo["EcCollections"].get(vid, ""),
+                    "shards": copy.deepcopy(shards)}
+        if path == "/dir/status":
+            return {"Topology": self.topology()}
+        if path == "/cluster/status":
+            return {"Leader": "snapshot:9333", "Peers": [],
+                    "IsLeader": True}
+        raise AssertionError(f"unexpected master_get {path}")
+
+    def master_post(self, path: str, payload: dict) -> dict:
+        self.calls.append(("master", path, payload))
+        return {}
+
+    def volume_post(self, server: str, path: str, payload: dict,
+                    timeout: float = 600.0) -> dict:
+        self.calls.append((server, path, payload))
+        if path == "/admin/volume_check":
+            return {"indexed": 10, "scanned_live": 10, "crc_errors": 0}
+        return {}
+
+    def of(self, path: str) -> list[tuple[str, str, dict]]:
+        return [c for c in self.calls if c[1] == path]
+
+
+@pytest.fixture()
+def env():
+    with open(FIXTURE) as f:
+        return SnapshotEnv(json.load(f))
+
+
+def test_volume_balance_plans_even_spread(env):
+    out = COMMANDS["volume.balance"](env, {})
+    assert "->" in out
+    # replay planned copies/deletes over the snapshot's counts
+    counts = {n["Url"]: len(n["VolumeIds"]) for n in env._nodes()}
+    held = {n["Url"]: set(n["VolumeIds"]) for n in env._nodes()}
+    for server, path, body in env.calls:
+        if path == "/admin/volume_copy":
+            vid = body["volume_id"]
+            # never copy to a server already holding a replica
+            assert vid not in held[server], (vid, server)
+            counts[server] += 1
+            held[server].add(vid)
+        elif path == "/admin/delete_volume":
+            counts[server] -= 1
+            held[server].discard(body["volume_id"])
+    # the overloaded node drained toward the mean; nobody overshot it
+    avg = sum(counts.values()) / len(counts)
+    assert counts[OVERLOADED] <= avg + 1
+    # the plan tightened the spread vs the snapshot's 15-to-0 skew
+    assert max(counts.values()) - min(counts.values()) <= 3
+    assert counts[EMPTY_NODE] > 0  # the empty server received work
+
+
+def test_fix_replication_targets_under_replicated_only(env):
+    out = COMMANDS["volume.fix.replication"](env, {})
+    copies = env.of("/admin/volume_copy")
+    # exactly one planned copy: vid 41 (010 wants 2 copies, has 1)
+    assert [c[2]["volume_id"] for c in copies] == [41]
+    target, _, body = copies[0]
+    assert target != "10.1.1.3:8080"  # not the existing holder
+    assert body["collection"] == "two"
+    assert body["source_data_node"] == "10.1.1.3:8080"
+    assert "replicated 41" in out
+    # vid 40 already has its 2 copies: untouched
+    assert all(c[2]["volume_id"] != 40 for c in copies)
+
+
+def test_ec_balance_dedupes_then_spreads(env):
+    out = COMMANDS["ec.balance"](env, {})
+    deletes = env.of("/admin/ec/delete")
+    # the duplicated shard 100.3 loses exactly one copy — on the
+    # hoarder (more loaded than the other holder)
+    dedupe = [d for d in deletes if d[2]["shard_ids"] == [3]]
+    assert len(dedupe) == 1 and dedupe[0][0] == HOARDER
+    # the surviving copy stays on the lighter holder
+    assert all(d[0] != DUP_HOLDER for d in dedupe)
+    assert f"dedupe 100.3 from {HOARDER}" in out
+    # spread: replay the plan and check the skew tightened
+    counts = {n["Url"]: n["EcShards"] for n in env._nodes()}
+    for server, path, body in env.calls:
+        if path == "/admin/ec/copy":
+            counts[server] += len(body["shard_ids"])
+        elif path == "/admin/ec/delete":
+            counts[server] -= len(body["shard_ids"])
+    assert counts[HOARDER] < 6  # started with 6 of 15
+    assert max(counts.values()) - min(counts.values()) <= 3
+    # every copy names the collection (a bare copy re-registers the
+    # shard under "" and scoped ops would miss it)
+    assert all(c[2]["collection"] == "ecc"
+               for c in env.of("/admin/ec/copy"))
+
+
+def test_evacuate_empties_the_node(env):
+    out = COMMANDS["volume.server.evacuate"](env, {"node": HOARDER})
+    moved_vids = {c[2]["volume_id"] for c in env.of("/admin/volume_copy")}
+    assert moved_vids == {33, 34, 35, 36}  # every replica it held
+    # each move also deletes from the source
+    deleted = {c[2]["volume_id"] for c in env.of("/admin/delete_volume")
+               if c[0] == HOARDER}
+    assert deleted == {33, 34, 35, 36}
+    # its EC shards (0-5 + dup 3) leave too, carrying the collection
+    ec_copies = env.of("/admin/ec/copy")
+    assert {tuple(c[2]["shard_ids"]) for c in ec_copies} == {
+        (0,), (1,), (2,), (3,), (4,), (5,)}
+    assert all(c[2]["source_data_node"] == HOARDER and
+               c[2]["collection"] == "ecc" for c in ec_copies)
+    assert "volume 33" in out
+
+
+def test_delete_empty_hits_only_the_dead_quiet_volume(env):
+    out = COMMANDS["volume.deleteEmpty"](env, {})
+    deletes = env.of("/admin/delete_volume")
+    # vid 22: file_count == delete_count, last modified decades ago
+    assert [(c[0], c[2]["volume_id"]) for c in deletes] == [
+        ("10.1.1.3:8080", 22)]
+    assert "22@10.1.1.3:8080" in out
+
+
+def test_volume_list_renders_snapshot(env):
+    out = COMMANDS["volume.list"](env, {})
+    assert OVERLOADED in out and "dc2" in out
+    out2 = COMMANDS["cluster.ps"](env, {})
+    assert "volume" in out2.lower() or OVERLOADED in out2
