@@ -57,6 +57,8 @@ DURABILITY_ASYNC_WAL = 2
 DELETE_MULTIPLE_VERSIONS = 1
 # RegionSpecifier.type
 REGION_NAME = 1
+# RPC.proto connection preamble: magic "HBas", version 0, auth SIMPLE=80
+RPC_PREAMBLE = b"HBas\x00\x50"
 
 
 class HBaseError(Exception):
@@ -87,8 +89,7 @@ class HBaseClient:
                                      timeout=self.timeout)
         s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         try:
-            # RPC.proto preamble: "HBas" + version 0 + auth SIMPLE (80)
-            s.sendall(b"HBas\x00\x50")
+            s.sendall(RPC_PREAMBLE)
             # ConnectionHeader{user_info{effective_user=1}, service_name=2}
             hdr = (f_msg(1, f_string(1, self.user)) +
                    f_string(2, "ClientService"))
