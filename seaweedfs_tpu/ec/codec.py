@@ -19,7 +19,8 @@ from typing import Optional, Protocol, Sequence
 
 import numpy as np
 
-from .gf256 import MUL_TABLE, build_cauchy_matrix, build_encoding_matrix, mat_invert
+from .gf256 import (MUL_TABLE, build_cauchy_matrix, build_encoding_matrix,
+                    mat_invert, mat_mul)
 
 
 class GfMatmulEngine(Protocol):
@@ -60,6 +61,25 @@ class NativeEngine:
 
     def matmul(self, m: np.ndarray, shards: np.ndarray) -> np.ndarray:
         return self._matmul(m, np.ascontiguousarray(shards))
+
+    def matmul_rows(self, m: np.ndarray,
+                    rows: list[np.ndarray]) -> np.ndarray:
+        """Same product, but over separately-allocated input rows via the
+        row-pointer kernel — no [k, B] stack copy of the inputs."""
+        from .. import native
+
+        m = np.ascontiguousarray(m, dtype=np.uint8)
+        rows = [np.ascontiguousarray(r, dtype=np.uint8) for r in rows]
+        n = len(rows[0])
+        if any(len(r) != n for r in rows):
+            # the C kernel reads n bytes from EVERY row pointer; a short
+            # row would be an out-of-bounds read, not a clean error
+            raise ValueError("inconsistent shard sizes")
+        out = np.empty((m.shape[0], n), dtype=np.uint8)
+        native.gf_matmul_ptrs(
+            m, [r.ctypes.data for r in rows],
+            [out[i].ctypes.data for i in range(m.shape[0])], n)
+        return out
 
 
 def best_cpu_engine() -> GfMatmulEngine:
@@ -118,10 +138,14 @@ class ReedSolomon:
                     data_only: bool = False) -> None:
         """Fill None entries in-place from >= data_shards survivors.
 
-        Mirrors klauspost Reconstruct/ReconstructData: build the decode
-        matrix from the first data_shards present shards' encoding-matrix
-        rows, invert, recover missing data, then (unless data_only)
-        recompute missing parity from the restored data rows.
+        Mirrors klauspost Reconstruct/ReconstructData semantics, fused
+        into ONE kernel pass: every shard obeys shard_i = matrix[i] @
+        data (identity top makes the matrix systematic), and data =
+        inv(matrix[sub]) @ survivors, so ALL missing shards — data and
+        parity alike — are (matrix[missing] @ inv(matrix[sub])) @
+        survivors.  One survivor stack, one matmul: the old two-pass
+        shape (decode data, re-stack, recompute parity) cost a second
+        160MB stack + matmul and ran ~6x below the encode kernel.
         """
         if len(shards) != self.total_shards:
             raise ValueError(f"expected {self.total_shards} shards")
@@ -133,25 +157,21 @@ class ReedSolomon:
         size = next(len(shards[i]) for i in present)
 
         sub_rows = present[: self.data_shards]
-        missing_data = [i for i in range(self.data_shards) if shards[i] is None]
-        if missing_data:
+        upto = self.data_shards if data_only else self.total_shards
+        missing = [i for i in range(upto) if shards[i] is None]
+        if missing:
             sub = [list(int(v) for v in self.matrix[i]) for i in sub_rows]
-            decode = np.array(mat_invert(sub), dtype=np.uint8)
-            survivors = np.stack([shards[i] for i in sub_rows])
-            rows = np.stack([decode[i] for i in missing_data])
-            restored = self.engine.matmul(rows, survivors)
-            for out_i, shard_i in enumerate(missing_data):
-                shards[shard_i] = restored[out_i]
-
-        if data_only:
-            return
-        missing_parity = [i for i in range(self.data_shards, self.total_shards)
-                          if shards[i] is None]
-        if missing_parity:
-            data = np.stack(shards[: self.data_shards])
-            rows = np.stack([self.matrix[i] for i in missing_parity])
-            restored = self.engine.matmul(rows, data)
-            for out_i, shard_i in enumerate(missing_parity):
+            decode = mat_invert(sub)
+            want = [list(int(v) for v in self.matrix[m]) for m in missing]
+            rows = np.array(mat_mul(want, decode), dtype=np.uint8)
+            if hasattr(self.engine, "matmul_rows"):
+                # row-pointer kernel: skips the [k, B] survivor stack copy
+                restored = self.engine.matmul_rows(
+                    rows, [shards[i] for i in sub_rows])
+            else:
+                survivors = np.stack([shards[i] for i in sub_rows])
+                restored = self.engine.matmul(rows, survivors)
+            for out_i, shard_i in enumerate(missing):
                 shards[shard_i] = restored[out_i]
         # keep sizes consistent
         for i in range(self.total_shards):

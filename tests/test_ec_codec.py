@@ -163,3 +163,36 @@ def test_cpu_tpu_byte_identical_reconstruct():
         rs.reconstruct(damaged)
         for i in range(14):
             assert np.array_equal(damaged[i], shards[i]), (eng.name, i)
+
+
+def test_native_matmul_rows_matches_stacked():
+    """The row-pointer kernel (no survivor stack copy) must produce the
+    same bytes as the contiguous matmul for every erasure pattern —
+    reconstruct() picks it automatically when the engine has it."""
+    from seaweedfs_tpu.ec.codec import CpuEngine, best_cpu_engine
+
+    eng = best_cpu_engine()
+    if not hasattr(eng, "matmul_rows"):
+        pytest.skip("native engine unavailable")
+    rng = np.random.default_rng(7)
+    m = rng.integers(1, 256, (4, 10), dtype=np.uint8)
+    rows = [rng.integers(0, 256, 8191, dtype=np.uint8) for _ in range(10)]
+    got = eng.matmul_rows(m, rows)
+    want = eng.matmul(m, np.stack(rows))
+    assert np.array_equal(got, want)
+    # and the pure-python reference agrees
+    ref = CpuEngine().matmul(m, np.stack(rows))
+    assert np.array_equal(got, ref)
+
+
+def test_matmul_rows_rejects_uneven_survivors():
+    from seaweedfs_tpu.ec.codec import best_cpu_engine
+
+    eng = best_cpu_engine()
+    if not hasattr(eng, "matmul_rows"):
+        pytest.skip("native engine unavailable")
+    m = np.ones((2, 3), dtype=np.uint8)
+    rows = [np.zeros(64, np.uint8), np.zeros(32, np.uint8),
+            np.zeros(64, np.uint8)]
+    with pytest.raises(ValueError):
+        eng.matmul_rows(m, rows)
