@@ -38,6 +38,13 @@ def _effective_size(entry: Entry) -> int:
     return entry.file_size
 
 
+def _canonical_tag(name: str) -> str:
+    """'Seaweed-' + Go-canonical header suffix ('owner-id' ->
+    'Seaweed-Owner-Id'): lowercased proxies and mixed-case clients must
+    land on ONE stored key, or deletes by tag name silently miss."""
+    return "Seaweed-" + "-".join(w.capitalize() for w in name.split("-"))
+
+
 def _trap(fn, *args):
     """Run fn, returning the exception instead of raising (executor.map
     would otherwise hide which view failed until iteration)."""
@@ -585,6 +592,36 @@ class FilerServer:
                     self.filer.create_entry(entry)
             return Response({"path": entry.full_path}, status=201)
 
+        @r.route("GET", "/api/kv")
+        def api_kv_get(req: Request) -> Response:
+            """KvGet (filer_grpc_server_kv.go): store-backed key lookup.
+            Missing keys answer an empty value, not an error — the
+            reference returns KvGetResponse{} for ErrKvNotFound."""
+            import base64
+
+            # '+' in query values parses as a space; undo before decode
+            key = base64.b64decode(req.query["key"].replace(" ", "+"))
+            value = self.filer.store.kv_get(key)
+            return Response({"value": base64.b64encode(value or b"").decode(),
+                             "found": value is not None})
+
+        @r.route("POST", "/api/kv")
+        def api_kv_put(req: Request) -> Response:
+            """KvPut: empty value deletes the entry, like the reference."""
+            import base64
+
+            err = self.guard.check_filer_jwt(req)
+            if err:
+                raise HttpError(401, err)
+            b = req.json()
+            key = base64.b64decode(b["key"])
+            value = base64.b64decode(b.get("value") or "")
+            if not value:
+                self.filer.store.kv_delete(key)
+            else:
+                self.filer.store.kv_put(key, value)
+            return Response({})
+
         @r.route("POST", "/api/mkdir")
         def api_mkdir(req: Request) -> Response:
             err = self.guard.check_filer_jwt(req)
@@ -600,6 +637,20 @@ class FilerServer:
         @r.route("HEAD", "(/.*)")
         def read(req: Request) -> Response:
             path = req.match.group(1) or "/"
+            if path == "/" and "proxyChunkId" in req.query \
+                    and req.handler.command == "GET":
+                # GET /?proxyChunkId=<fid>: proxy a raw chunk read to its
+                # volume server (filer_server_handlers_proxy.go) — lets a
+                # client reach chunks when volume servers aren't routable
+                fid = req.query["proxyChunkId"]
+                try:
+                    blob = self.client.download(fid)
+                except HttpError:
+                    raise
+                except Exception as e:
+                    raise HttpError(500, f"proxy {fid}: {e}")
+                return Response(raw=blob, headers={
+                    "Content-Type": "application/octet-stream"})
             try:
                 entry = self.filer.find_entry(path)
             except FilerNotFound:
@@ -647,6 +698,16 @@ class FilerServer:
                     "%a, %d %b %Y %H:%M:%S GMT", time.gmtime(entry.attr.mtime)),
                 "Accept-Ranges": "bytes",
             }
+            # tag attrs ride as response headers
+            # (filer_server_handlers_read.go:140-146).  Only the
+            # Seaweed-* tagging namespace is echoed: extended also holds
+            # internal bookkeeping (remote.entry JSON, S3 multipart
+            # bucket/key) that must not leak, and keys/values are
+            # CRLF-checked or they would split the response
+            for k, v in entry.extended.items():
+                if (k.startswith("Seaweed-") and isinstance(v, str)
+                        and not any(c in "\r\n" for c in k + v)):
+                    headers.setdefault(k, v)
             if is_head:
                 headers["Content-Length"] = str(size)
             if status == 206:
@@ -663,6 +724,20 @@ class FilerServer:
             if err:
                 raise HttpError(401, err)
             path = req.match.group(1)
+            if "tagging" in req.query:
+                # PUT /path?tagging with Seaweed-* headers: merge into the
+                # entry's extended attrs (filer_server_handlers_tagging.go
+                # PutTaggingHandler; 202 Accepted like the reference)
+                try:
+                    entry = self.filer.find_entry(path.rstrip("/") or "/")
+                except FilerNotFound:
+                    raise HttpError(404, f"{path} not found")
+                for header, value in req.headers.items():
+                    if header.lower().startswith("seaweed-"):
+                        entry.extended[_canonical_tag(header[8:])] = value
+                with self.filer.op_signatures(self._sigs(req)):
+                    self.filer.update_entry(entry)
+                return Response({"name": entry.name}, status=202)
             mime = req.headers.get("Content-Type", "")
             # curl -F / browser form uploads wrap the payload in
             # multipart/form-data — unwrap the file part like the
@@ -699,6 +774,23 @@ class FilerServer:
             if err:
                 raise HttpError(401, err)
             path = req.match.group(1)
+            if "tagging" in req.query:
+                # DELETE /path?tagging[=name1,name2]: drop all Seaweed-*
+                # extended attrs, or just the named tags
+                try:
+                    entry = self.filer.find_entry(path.rstrip("/") or "/")
+                except FilerNotFound:
+                    raise HttpError(404, f"{path} not found")
+                named = {_canonical_tag(t)
+                         for t in req.query["tagging"].split(",") if t}
+                for k in list(entry.extended):
+                    if not k.startswith("Seaweed-"):
+                        continue
+                    if not named or k in named:
+                        del entry.extended[k]
+                with self.filer.op_signatures(self._sigs(req)):
+                    self.filer.update_entry(entry)
+                return Response({"name": entry.name}, status=202)
             # deletes are NOT gated by read_only rules (reference filer
             # checks rules on writes only) — quota-marked buckets must
             # stay deletable so users can reclaim space

@@ -312,3 +312,107 @@ def test_multipart_safety_gates(stack):
     assert status == 201
     st, got, _ = http_bytes("GET", base + "/jail/capture.bin")
     assert (st, got) == (200, body)
+
+
+def test_filer_tagging_roundtrip(stack):
+    """PUT /path?tagging with Seaweed-* headers, headers echoed on GET,
+    DELETE ?tagging=name / ?tagging (all) — the reference's filer-level
+    tagging API (filer_server_handlers_tagging.go)."""
+    _, _, filer = stack
+    base = f"http://{filer.url}"
+    http_bytes("PUT", base + "/tagged/doc.txt", b"body")
+    st, _, _ = http_bytes(
+        "PUT", base + "/tagged/doc.txt?tagging",
+        headers={"Seaweed-Owner": "ops", "Seaweed-Tier": "hot",
+                 "Unrelated": "ignored"})
+    assert st == 202
+    st, body, hdrs = http_bytes("GET", base + "/tagged/doc.txt")
+    assert (st, body) == (200, b"body")
+    assert hdrs.get("Seaweed-Owner") == "ops"
+    assert hdrs.get("Seaweed-Tier") == "hot"
+    assert "Unrelated" not in hdrs
+    # delete ONE named tag
+    st, _, _ = http_bytes(
+        "DELETE", base + "/tagged/doc.txt?tagging=Tier")
+    assert st == 202
+    _, _, hdrs = http_bytes("GET", base + "/tagged/doc.txt")
+    assert hdrs.get("Seaweed-Owner") == "ops"
+    assert "Seaweed-Tier" not in hdrs
+    # delete ALL tags
+    st, _, _ = http_bytes("DELETE", base + "/tagged/doc.txt?tagging")
+    assert st == 202
+    _, _, hdrs = http_bytes("GET", base + "/tagged/doc.txt")
+    assert "Seaweed-Owner" not in hdrs
+    # tagging a missing path is a clean 404
+    st, _, _ = http_bytes("PUT", base + "/missing?tagging",
+                          headers={"Seaweed-X": "y"})
+    assert st == 404
+
+
+def test_proxy_chunk_id(stack):
+    """GET /?proxyChunkId=<fid> proxies the raw chunk from its volume
+    server through the filer (filer_server_handlers_proxy.go)."""
+    _, _, filer = stack
+    base = f"http://{filer.url}"
+    http_bytes("PUT", base + "/px/blob.bin", b"chunky payload")
+    e = filer.filer.find_entry("/px/blob.bin")
+    fid = e.chunks[0].file_id
+    st, body, _ = http_bytes("GET", base + f"/?proxyChunkId={fid}")
+    assert st == 200 and body == b"chunky payload"
+    st, _, _ = http_bytes("GET", base + "/?proxyChunkId=999,deadbeef00")
+    assert st in (404, 500)
+
+
+def test_filer_kv_api(stack):
+    """/api/kv mirrors the KvGet/KvPut RPC pair: empty value deletes,
+    missing keys answer found=false (filer_grpc_server_kv.go)."""
+    import base64
+
+    _, _, filer = stack
+    base = f"http://{filer.url}"
+
+    def b64(b):
+        return base64.b64encode(b).decode()
+
+    r = http_json("POST", base + "/api/kv",
+                  {"key": b64(b"cluster/owner"), "value": b64(b"ops-team")})
+    r = http_json("GET", base + "/api/kv?key=" + b64(b"cluster/owner"))
+    assert r["found"] and base64.b64decode(r["value"]) == b"ops-team"
+    # empty value = delete
+    http_json("POST", base + "/api/kv", {"key": b64(b"cluster/owner")})
+    r = http_json("GET", base + "/api/kv?key=" + b64(b"cluster/owner"))
+    assert r["found"] is False and r["value"] == ""
+
+
+def test_filer_kv_api_plus_in_key(stack):
+    import base64
+
+    _, _, filer = stack
+    base = f"http://{filer.url}"
+    key = b"\xfb\xef\xbe"  # b64-encodes to '++++'
+    k64 = base64.b64encode(key).decode()
+    assert "+" in k64
+    http_json("POST", base + "/api/kv",
+              {"key": k64, "value": base64.b64encode(b"v").decode()})
+    r = http_json("GET", base + "/api/kv?key=" + k64)
+    assert r["found"] and base64.b64decode(r["value"]) == b"v"
+
+
+def test_filer_tagging_case_canonicalization(stack):
+    """Lowercased headers (HTTP/2-style proxies) and mixed-case deletes
+    land on one canonical Seaweed-* key."""
+    _, _, filer = stack
+    base = f"http://{filer.url}"
+    http_bytes("PUT", base + "/tagged/c.txt", b"x")
+    http_bytes("PUT", base + "/tagged/c.txt?tagging",
+               headers={"seaweed-owner-id": "a"})
+    http_bytes("PUT", base + "/tagged/c.txt?tagging",
+               headers={"SEAWEED-OWNER-ID": "b"})
+    e = filer.filer.find_entry("/tagged/c.txt")
+    tags = {k: v for k, v in e.extended.items() if k.startswith("Seaweed-")}
+    assert tags == {"Seaweed-Owner-Id": "b"}  # one key, last write wins
+    st, _, _ = http_bytes("DELETE",
+                          base + "/tagged/c.txt?tagging=owner-id")
+    assert st == 202
+    e = filer.filer.find_entry("/tagged/c.txt")
+    assert not any(k.startswith("Seaweed-") for k in e.extended)
