@@ -84,6 +84,8 @@ class MasterServer:
         self._admin_token: Optional[int] = None
         self._admin_lock_ts = 0.0
         self._admin_client = ""
+        # lazy self-client for /submit (assign + upload in one call)
+        self._submit_client = None
 
     # --- lifecycle --------------------------------------------------------
     @property
@@ -465,6 +467,118 @@ class MasterServer:
             if grow_err:
                 result["error"] = grow_err
             return Response(result)
+
+        @r.route("GET", "/vol/status")
+        def vol_status(req: Request) -> Response:
+            """volumeStatusHandler: per-node volume inventory keyed
+            dc -> rack -> server (Topo.ToVolumeMap analog)."""
+            self._require_leader(req)
+            vols: dict = {}
+            with self.topo.lock:
+                for dc in self.topo.data_centers.values():
+                    d = vols.setdefault(dc.name, {})
+                    for rack in dc.racks.values():
+                        rk = d.setdefault(rack.name, {})
+                        for n in rack.nodes.values():
+                            rk[n.url] = n.to_map()["VolumeInfos"]
+            return Response({"Version": "seaweedfs-tpu 0.1",
+                             "Volumes": vols})
+
+        @r.route("GET", "/col/delete")
+        @r.route("POST", "/col/delete")
+        def col_delete(req: Request) -> Response:
+            """collectionDeleteHandler: drop every volume of a collection
+            on its servers, then forget its layouts."""
+            self._require_leader(req)
+            name = req.query.get("collection", "")
+            with self.topo.lock:
+                keys = [k for k in self.topo.layouts if k[0] == name]
+                vid_nodes = [
+                    (vid, [n.url for n in nodes])
+                    for k in keys
+                    for vid, nodes in self.topo.layouts[k].vid_to_nodes.items()]
+                # EC volumes of the collection: shards must go too, or
+                # "deleted" data survives on disk (an EC-only collection
+                # must also not 400 as nonexistent)
+                ec_vids = [vid for vid, c in self.topo.ec_collections.items()
+                           if c == name]
+                ec_holders = [
+                    (vid, sid, [n.url for n in nodes])
+                    for vid in ec_vids
+                    for sid, nodes in
+                    (self.topo.ec_shard_locations.get(vid) or {}).items()]
+            if not keys and not ec_vids:
+                raise HttpError(400,
+                                f"collection {name!r} does not exist")
+            for vid, urls in vid_nodes:
+                for url in urls:
+                    http_json("POST", f"http://{url}/admin/delete_volume",
+                              {"volume_id": vid})
+            for vid, sid, urls in ec_holders:
+                for url in urls:
+                    http_json("POST", f"http://{url}/admin/ec/delete",
+                              {"volume_id": vid, "collection": name,
+                               "shard_ids": [sid]})
+            with self.topo.lock:
+                for k in keys:
+                    self.topo.layouts.pop(k, None)
+                for vid in ec_vids:
+                    self.topo.ec_shard_locations.pop(vid, None)
+                    self.topo.ec_collections.pop(vid, None)
+            return Response(None, status=204, raw=b"")
+
+        @r.route("POST", "/submit")
+        @r.route("PUT", "/submit")
+        def submit(req: Request) -> Response:
+            """submitFromMasterServerHandler: assign + upload in one call
+            (the README quickstart's `curl -F file=@x master:9333/submit`).
+            Rides WeedClient.upload so the readonly-race reassign/retry
+            loop exists in exactly one place."""
+            self._require_leader(req)
+            from ..utils.httpd import extract_upload
+
+            data, fname, mime = extract_upload(
+                req.body, req.headers.get("Content-Type") or "")
+            if self._submit_client is None:
+                from ..client.operation import WeedClient
+
+                self._submit_client = WeedClient(self.url)
+            collection = req.query.get("collection", "")
+            fid = self._submit_client.upload(
+                data, name=fname, mime=mime, collection=collection,
+                replication=req.query.get("replication", ""),
+                ttl=req.query.get("ttl", ""))
+            nodes = self.topo.lookup(int(fid.split(",")[0]), collection)
+            public = nodes[0].public_url if nodes else ""
+            return Response({
+                "fid": fid,
+                "fileName": fname,
+                "fileUrl": f"{public}/{fid}",
+                "size": len(data),
+            }, status=201)
+
+        @r.route("GET", r"/(\d+),([0-9a-f]+)")
+        @r.route("HEAD", r"/(\d+),([0-9a-f]+)")
+        def redirect_to_volume(req: Request) -> Response:
+            """redirectHandler: GET master:9333/<fid> answers a permanent
+            redirect to a volume server holding the file."""
+            vid = int(req.match.group(1))
+            nodes = self.topo.lookup(vid, req.query.get("collection", ""))
+            if not nodes:
+                raise HttpError(404, f"volume id {vid} not found")
+            import random as _random
+            import urllib.parse as _up
+
+            n = _random.choice(nodes)
+            # the query string must survive the redirect: resize params,
+            # ?readDeleted, and ?jwt read tokens are consumed by the
+            # volume server (redirectHandler copies r.URL.Query())
+            raw_query = _up.urlparse(req.handler.path).query
+            loc = f"http://{n.public_url}{_up.quote(req.path, safe='/,')}"
+            if raw_query:
+                loc += "?" + raw_query
+            return Response(None, status=308, raw=b"",
+                            headers={"Location": loc})
 
         @r.route("GET", "/vol/vacuum")
         def vol_vacuum(req: Request) -> Response:

@@ -211,3 +211,96 @@ def test_shell_listing_commands(cluster):
     env = CommandEnv(master.url)
     assert "volume server" in run_command(env, "cluster.ps")
     assert "DataNode" in run_command(env, "volume.list")
+
+
+def test_master_submit_and_fid_redirect(cluster):
+    """POST /submit (assign + upload in one call) and GET master/<fid>
+    (permanent redirect to a volume server) — the README quickstart
+    flows (master_server_handlers.go submit/redirect)."""
+    master, servers = cluster
+    boundary = "subm1234"
+    body = (f"--{boundary}\r\n"
+            'Content-Disposition: form-data; name="file"; '
+            'filename="hello.txt"\r\n'
+            "Content-Type: text/plain\r\n\r\n").encode() + b"submitted!" + \
+        f"\r\n--{boundary}--\r\n".encode()
+    st, resp, _ = http_bytes(
+        "POST", f"http://{master.url}/submit", body,
+        headers={"Content-Type":
+                 f"multipart/form-data; boundary={boundary}"})
+    assert st == 201
+    import json as _json
+
+    r = _json.loads(resp)
+    assert r["fileName"] == "hello.txt" and r["size"] == 10
+    fid = r["fid"]
+    # the file is readable at fileUrl
+    st, got, _ = http_bytes("GET", "http://" + r["fileUrl"])
+    assert (st, got) == (200, b"submitted!")
+    # master/<fid> 308-redirects to a holder
+    st, _, hdrs = http_bytes("GET", f"http://{master.url}/{fid}",
+                             follow_redirects=False)
+    assert st == 308 and hdrs["Location"].endswith("/" + fid)
+    st, got, _ = http_bytes("GET", hdrs["Location"])
+    assert (st, got) == (200, b"submitted!")
+
+
+def test_master_vol_status_and_col_delete(cluster):
+    master, servers = cluster
+    client = WeedClient(master.url)
+    fid = client.upload(b"col data", collection="proj")
+    sync_heartbeats(servers)
+    st, body, _ = http_bytes("GET", f"http://{master.url}/vol/status")
+    assert st == 200
+    import json as _json
+
+    vols = _json.loads(body)["Volumes"]
+    infos = [v for dc in vols.values() for rack in dc.values()
+             for n in rack.values() for v in n]
+    assert any(v["collection"] == "proj" for v in infos)
+    # delete the collection: volumes disappear from the servers
+    st, _, _ = http_bytes("POST",
+                          f"http://{master.url}/col/delete?collection=proj")
+    assert st == 204
+    assert not any("proj" == v.collection
+                   for vs in servers for v in vs.store.volumes.values())
+    st, _, _ = http_bytes(
+        "POST", f"http://{master.url}/col/delete?collection=nope")
+    assert st == 400
+
+
+def test_fid_redirect_preserves_query(cluster):
+    master, _ = cluster
+    client = WeedClient(master.url)
+    fid = client.upload(b"q data")
+    st, _, hdrs = http_bytes(
+        "GET", f"http://{master.url}/{fid}?readDeleted=true&width=10",
+        follow_redirects=False)
+    assert st == 308
+    assert "readDeleted=true" in hdrs["Location"]
+    assert "width=10" in hdrs["Location"]
+
+
+def test_col_delete_includes_ec_volumes(cluster):
+    """An EC-encoded collection must be deletable — and deletion must
+    remove the shards, not orphan them (collectionDeleteHandler)."""
+    master, servers = cluster
+    client = WeedClient(master.url)
+    client.upload(b"ec payload " * 1000, collection="ecol")
+    sync_heartbeats(servers)
+    env = CommandEnv(master.url)
+    env.lock()
+    vid = next(vid for (c, _, _), lay in master.topo.layouts.items()
+               if c == "ecol" for vid in lay.vid_to_nodes)
+    run_command(env, f"ec.encode -volumeId {vid} -collection ecol")
+    sync_heartbeats(servers)
+    assert vid in master.topo.ec_collections
+    st, _, _ = http_bytes(
+        "POST", f"http://{master.url}/col/delete?collection=ecol")
+    assert st == 204
+    assert vid not in master.topo.ec_collections
+    # shards are gone from every server's disk
+    import glob as _glob
+    for vs in servers:
+        for loc in vs.store.locations:
+            assert not _glob.glob(f"{loc.directory}/*.ec[0-9][0-9]")
