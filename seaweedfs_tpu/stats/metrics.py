@@ -42,6 +42,11 @@ class Counter:
     def value(self, *label_values) -> float:
         return self._values.get(tuple(str(v) for v in label_values), 0.0)
 
+    def snapshot(self) -> dict[tuple, float]:
+        """Point-in-time copy, safe against concurrent inc()."""
+        with self._lock:
+            return dict(self._values)
+
     def expose(self) -> list[str]:
         out = [f"# HELP {self.name} {self.help}",
                f"# TYPE {self.name} counter"]

@@ -494,6 +494,47 @@ class VolumeServer:
         def status(req: Request) -> Response:
             return Response(status_doc())
 
+        @r.route("GET", "/stats/counter")
+        def stats_counter(req: Request) -> Response:
+            """statsCounterHandler (common.go:228): per-operation request
+            counts, rendered from the same collectors /metrics exposes."""
+            counters = {
+                labels[0] if labels else "": int(v)
+                for labels, v
+                in self.metrics.request_counter.snapshot().items()}
+            return Response({"Version": "seaweedfs-tpu 0.1",
+                             "Counters": counters})
+
+        @r.route("GET", "/stats/memory")
+        def stats_memory(req: Request) -> Response:
+            import resource
+            import sys as _sys
+
+            ru = resource.getrusage(resource.RUSAGE_SELF)
+            # ru_maxrss is KB on Linux but BYTES on macOS
+            rss_kb = (ru.ru_maxrss // 1024 if _sys.platform == "darwin"
+                      else ru.ru_maxrss)
+            return Response({"Version": "seaweedfs-tpu 0.1",
+                             "Memory": {"MaxRssKb": rss_kb,
+                                        "UserSeconds": ru.ru_utime,
+                                        "SystemSeconds": ru.ru_stime}})
+
+        @r.route("GET", "/stats/disk")
+        def stats_disk(req: Request) -> Response:
+            """statsDiskHandler: statvfs per volume directory."""
+            ds = []
+            for loc in self.store.locations:
+                st = os.statvfs(loc.directory)
+                total = st.f_frsize * st.f_blocks
+                free = st.f_frsize * st.f_bavail
+                ds.append({"dir": os.path.abspath(loc.directory),
+                           "all": total, "free": free,
+                           "used": total - free,
+                           "percent_free": round(100.0 * free /
+                                                 max(total, 1), 2)})
+            return Response({"Version": "seaweedfs-tpu 0.1",
+                             "DiskStatuses": ds})
+
         from ..utils.debug import register_debug_routes
 
         register_debug_routes(r, name=f"volume server {self.url}",

@@ -105,3 +105,32 @@ class TestServerMetricsEndpoints:
         assert 'SeaweedFS_volumeServer_request_total{type="write_object"}' in text
         assert 'SeaweedFS_volumeServer_request_seconds_bucket' in text
         assert 'SeaweedFS_volumeServer_volumes{collection="",type="volume"}' in text
+
+
+def test_volume_stats_endpoints(tmp_path):
+    """/stats/counter, /stats/memory, /stats/disk on the volume server
+    (volume_server.go:105-107, common.go statsCounter/MemoryHandler,
+    statsDiskHandler).  All three read only local process state, so no
+    topology registration is awaited."""
+    from seaweedfs_tpu.master.server import MasterServer
+    from seaweedfs_tpu.utils.httpd import http_json
+    from seaweedfs_tpu.volume_server.server import VolumeServer
+    from tests.conftest import free_port
+
+    master = MasterServer(port=free_port(), pulse_seconds=0.3).start()
+    d = tmp_path / "v"
+    d.mkdir()
+    vs = VolumeServer([str(d)], master.url, port=free_port(),
+                      pulse_seconds=0.3).start()
+    try:
+        http_json("GET", f"http://{vs.url}/status")  # bump a counter
+        c = http_json("GET", f"http://{vs.url}/stats/counter")
+        assert sum(c["Counters"].values()) >= 1
+        m = http_json("GET", f"http://{vs.url}/stats/memory")
+        assert m["Memory"]["MaxRssKb"] > 0
+        ds = http_json("GET", f"http://{vs.url}/stats/disk")
+        assert ds["DiskStatuses"][0]["all"] > 0
+        assert ds["DiskStatuses"][0]["dir"] == str(d)
+    finally:
+        vs.stop()
+        master.stop()
