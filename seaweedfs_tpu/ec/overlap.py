@@ -1,4 +1,4 @@
-"""Process-based overlap worker for the staged host EC pipeline.
+"""Process-based overlap workers for the host EC pipelines.
 
 The staged pipeline (streaming.py) overlaps host fill/write with codec
 compute.  In-process, that overlap rides a worker THREAD: fine when the
@@ -10,19 +10,26 @@ exercised and measurable on any core count (VERDICT r3 asked for the
 claim to be measured, not asserted; bench.py reports worker-on vs
 worker-off throughput from this worker).
 
-Protocol: single worker process, FIFO job queue.  Dispatch buffers and
-parity results live in two SharedMemory segments sized nbufs*(k|r)*b;
-tickets are buffer indices.  The parent writes a buffer, submits
-(buf, n); the worker runs the native GF(2^8) matmul straight out of and
-into shared memory (zero copies in either direction) and acks the same
-index.  FIFO submission order == completion order, which matches the
-pipeline's drain order.
+Two workers share one lifecycle base:
+
+- ProcessOverlapWorker: dispatch buffers AND parity live in shared
+  memory; the parent copies input rows in (the staged pipeline's model).
+- FileParityWorker: the worker mmaps the SAME input file the parent
+  mmap'd, so only parity crosses shared memory — the zero-copy mmap
+  encode's overlap half.
+
+Protocol: single worker process, FIFO job queue.  Tickets are buffer
+indices; FIFO submission order == completion order, which matches the
+pipelines' drain order.  Worker-side job failures ack ("err", detail)
+instead of dying silently, so the parent can fall back to serial
+compute and respawn.
 """
 
 from __future__ import annotations
 
 import multiprocessing as mp
 import os
+import queue as queue_mod
 from multiprocessing import shared_memory
 
 import numpy as np
@@ -49,34 +56,84 @@ def _worker_main(in_name: str, out_name: str, k: int, r: int, b: int,
             if msg is None:
                 break
             bi, n = msg
-            native.gf_matmul_ptrs(
-                mat,
-                [in0 + (bi * k + i) * b for i in range(k)],
-                [out0 + (bi * r + j) * b for j in range(r)], n)
-            acks.put(("done", bi))
+            try:
+                native.gf_matmul_ptrs(
+                    mat,
+                    [in0 + (bi * k + i) * b for i in range(k)],
+                    [out0 + (bi * r + j) * b for j in range(r)], n)
+                acks.put(("done", bi))
+            except Exception as e:  # pragma: no cover - native errors
+                acks.put(("err", f"{type(e).__name__}: {e}"))
         del ins, outs
     finally:
         shm_in.close()
         shm_out.close()
 
 
-class ProcessOverlapWorker:
-    """Owns the shared-memory dispatch pool and the compute process."""
+def _file_worker_main(out_name: str, r: int, b: int, nbufs: int,
+                      mat_bytes: bytes, k: int, jobs, acks) -> None:
+    import mmap as mmap_mod
 
-    def __init__(self, k: int, r: int, dispatch_b: int, matrix: np.ndarray,
-                 nbufs: int):
+    from .. import native
+
+    if native.load() is None:  # pragma: no cover - parent checked first
+        acks.put(("err", "native gf256 unavailable"))
+        return
+    mat = np.frombuffer(mat_bytes, dtype=np.uint8).reshape(r, k)
+    shm_out = shared_memory.SharedMemory(name=out_name)
+    in_map = None
+    in_addr = 0
+    try:
+        outs = np.frombuffer(shm_out.buf, dtype=np.uint8).reshape(nbufs, r, b)
+        out0 = outs.ctypes.data
+        acks.put(("ready", os.getpid()))
+        while True:
+            msg = jobs.get()
+            if msg is None:
+                break
+            try:
+                if msg[0] == "open":
+                    if in_map is not None:
+                        in_map.close()
+                        in_map = None
+                    f = open(msg[1], "rb")
+                    try:
+                        in_map = mmap_mod.mmap(f.fileno(), 0,
+                                               access=mmap_mod.ACCESS_READ)
+                    finally:
+                        f.close()
+                    in_addr = np.frombuffer(in_map,
+                                            dtype=np.uint8).ctypes.data
+                    acks.put(("opened", msg[1]))
+                    continue
+                slot, base, block, n = msg
+                native.gf_matmul_ptrs(
+                    mat,
+                    [in_addr + base + i * block for i in range(k)],
+                    [out0 + (slot * r + j) * b for j in range(r)], n)
+                acks.put(("done", slot))
+            except Exception as e:
+                # the file vanished under us (compaction/rename) or the
+                # job failed: report, don't die — the parent falls back
+                acks.put(("err", f"{type(e).__name__}: {e}"))
+    finally:
+        if in_map is not None:
+            in_map.close()
+        shm_out.close()
+
+
+class _ParityWorkerBase:
+    """Shared lifecycle: parity shm slots, spawn-context process,
+    ready handshake, bounded acks, close/terminate."""
+
+    _TIMEOUT = 30.0
+
+    def __init__(self, k: int, r: int, dispatch_b: int,
+                 matrix: np.ndarray, nbufs: int, target, extra_shm=None):
         self.k, self.r, self.b = k, r, dispatch_b
         self.nbufs = nbufs
-        self._shm_in = shared_memory.SharedMemory(
-            create=True, size=nbufs * k * dispatch_b)
         self._shm_out = shared_memory.SharedMemory(
             create=True, size=nbufs * r * dispatch_b)
-        self.bufs = [
-            np.frombuffer(self._shm_in.buf, dtype=np.uint8,
-                          count=k * dispatch_b,
-                          offset=i * k * dispatch_b).reshape(k, dispatch_b)
-            for i in range(nbufs)
-        ]
         self._outs = [
             np.frombuffer(self._shm_out.buf, dtype=np.uint8,
                           count=r * dispatch_b,
@@ -90,30 +147,44 @@ class ProcessOverlapWorker:
         self._jobs = ctx.Queue()
         self._acks = ctx.Queue()
         mat = np.ascontiguousarray(matrix, dtype=np.uint8)
-        self._proc = ctx.Process(
-            target=_worker_main,
-            args=(self._shm_in.name, self._shm_out.name, k, r, dispatch_b,
-                  nbufs, mat.tobytes(), self._jobs, self._acks),
-            daemon=True)
+        self._proc = ctx.Process(target=target,
+                                 args=self._spawn_args(mat, extra_shm),
+                                 daemon=True)
         self._proc.start()
-        kind, detail = self._acks.get(timeout=30)
+        kind, detail = self._ack()
         if kind != "ready":
             self.close()
-            raise RuntimeError(f"overlap worker failed: {detail}")
+            raise RuntimeError(f"parity worker failed: {detail}")
 
-    def submit(self, bi: int, n: int) -> int:
-        """Queue buffer bi (first n columns valid) for parity compute;
-        the ticket is bi itself (single FIFO worker)."""
-        self._jobs.put((bi, n))
-        return bi
+    def _spawn_args(self, mat, extra_shm):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def _ack(self):
+        """Bounded ack read: a dead worker surfaces as RuntimeError
+        within ~0.5s (liveness-polled), a stalled one within _TIMEOUT —
+        never an eternal hang."""
+        import time as _time
+
+        deadline = _time.monotonic() + self._TIMEOUT
+        while True:
+            try:
+                return self._acks.get(timeout=0.5)
+            except queue_mod.Empty:
+                if not self._proc.is_alive():
+                    raise RuntimeError("parity worker died")
+                if _time.monotonic() >= deadline:
+                    raise RuntimeError("parity worker stalled")
 
     def fetch(self, ticket: int) -> np.ndarray:
         """Block until the ticket's parity is ready; returns the [r, b]
         shared-memory view (valid until the buffer index is reused)."""
-        kind, bi = self._acks.get()
-        if kind != "done" or bi != ticket:  # pragma: no cover - protocol
-            raise RuntimeError(f"overlap worker protocol: {kind} {bi}")
+        kind, got = self._ack()
+        if kind != "done" or got != ticket:
+            raise RuntimeError(f"parity worker protocol: {kind} {got}")
         return self._outs[ticket]
+
+    def _close_extra(self) -> None:
+        pass
 
     def close(self) -> None:
         try:
@@ -123,18 +194,81 @@ class ProcessOverlapWorker:
                 if self._proc.is_alive():  # pragma: no cover
                     self._proc.terminate()
         finally:
-            # views hold buffer exports; drop before closing the segments
-            self.bufs = []
             self._outs = []
-            for shm in (self._shm_in, self._shm_out):
-                try:
-                    shm.close()
-                    shm.unlink()
-                except OSError:  # pragma: no cover
-                    pass
+            self._close_extra()
+            try:
+                self._shm_out.close()
+                self._shm_out.unlink()
+            except OSError:  # pragma: no cover
+                pass
 
     def __del__(self):  # pragma: no cover - best-effort cleanup
         try:
             self.close()
         except Exception:
             pass
+
+
+class ProcessOverlapWorker(_ParityWorkerBase):
+    """Staged-pipeline worker: dispatch buffers live in shared memory;
+    the parent fills buffer bi, submits (bi, n), the worker matmuls in
+    shared memory and acks bi."""
+
+    def __init__(self, k: int, r: int, dispatch_b: int, matrix: np.ndarray,
+                 nbufs: int):
+        self._shm_in = shared_memory.SharedMemory(
+            create=True, size=nbufs * k * dispatch_b)
+        self.bufs = [
+            np.frombuffer(self._shm_in.buf, dtype=np.uint8,
+                          count=k * dispatch_b,
+                          offset=i * k * dispatch_b).reshape(k, dispatch_b)
+            for i in range(nbufs)
+        ]
+        super().__init__(k, r, dispatch_b, matrix, nbufs, _worker_main)
+
+    def _spawn_args(self, mat, extra_shm):
+        return (self._shm_in.name, self._shm_out.name, self.k, self.r,
+                self.b, self.nbufs, mat.tobytes(), self._jobs, self._acks)
+
+    def submit(self, bi: int, n: int) -> int:
+        """Queue buffer bi (first n columns valid) for parity compute;
+        the ticket is bi itself (single FIFO worker)."""
+        self._jobs.put((bi, n))
+        return bi
+
+    def _close_extra(self) -> None:
+        self.bufs = []
+        try:
+            self._shm_in.close()
+            self._shm_in.unlink()
+        except OSError:  # pragma: no cover
+            pass
+
+
+class FileParityWorker(_ParityWorkerBase):
+    """Compute-side half of the zero-copy mmap encode: the worker mmaps
+    the SAME input file and writes parity for (base, block, n) spans
+    into a small shared-memory slot ring, so the parent overlaps its
+    pwrite syscall time with GF(2^8) compute on multicore hosts."""
+
+    def __init__(self, k: int, r: int, dispatch_b: int,
+                 matrix: np.ndarray, nbufs: int = 2):
+        super().__init__(k, r, dispatch_b, matrix, nbufs,
+                         _file_worker_main)
+
+    def _spawn_args(self, mat, extra_shm):
+        return (self._shm_out.name, self.r, self.b, self.nbufs,
+                mat.tobytes(), self.k, self._jobs, self._acks)
+
+    @property
+    def parity(self):
+        return self._outs
+
+    def open(self, path: str) -> None:
+        self._jobs.put(("open", path))
+        kind, got = self._ack()
+        if kind != "opened" or got != path:
+            raise RuntimeError(f"parity worker open: {kind} {got}")
+
+    def submit(self, slot: int, base: int, block: int, n: int) -> None:
+        self._jobs.put((slot, base, block, n))

@@ -124,6 +124,7 @@ class StreamingEncoder:
         self._host_engine = None
         self._host_pool = None
         self._proc_worker = None
+        self._file_worker = None  # mmap-path parity process (lazy)
         self._overlap = overlap
         self._mesh = None
         self._mesh_encode = None
@@ -143,7 +144,9 @@ class StreamingEncoder:
             #   "process" separate process over shared memory
             #             (ec/overlap.py) — the mechanism bench.py
             #             measures on/off for the README overlap claim
-            #   "auto"    thread when >1 core, else none
+            #   "auto"    thread when >1 core, else none; on the mmap
+            #             path, a FileParityWorker process when >1 core
+            #   "mmap-process"  force the mmap-path parity process
             #   "none"    synchronous
             # (no pool when the zero-copy mmap path will serve encodes —
             # it is synchronous and the idle thread would just leak)
@@ -313,6 +316,57 @@ class StreamingEncoder:
             return None
         return native.gf_matmul_ptrs
 
+    def _file_parity_worker(self, mat: np.ndarray, dat_path: str):
+        """Lazily-spawned FileParityWorker for the mmap encode, or None
+        (overlap off / spawn failed).  Cached across encodes — the
+        ~200ms spawn amortizes over a volume's many dispatches and over
+        repeated encodes; each file is re-opened in the worker."""
+        # MEASURED on a 1-core tmpfs host: no win (pwrite is kernel-mode
+        # memcpy, the core is busy during writes — 1118 serial vs 1038
+        # worker MB/s), so auto engages only with a second core, where
+        # compute genuinely runs beside the write syscalls.
+        # "mmap-process" forces it (differential tests).
+        if self._overlap == "mmap-process":
+            pass
+        elif self._overlap != "auto" or (os.cpu_count() or 1) <= 1:
+            return None
+        if self._file_worker is not None and self._file_worker and \
+                self._file_worker.b != self.dispatch_b:
+            # slot geometry is baked into the worker's shm ring: a stale
+            # b would silently truncate parity columns
+            self._drop_file_worker()
+        if self._file_worker is None:
+            try:
+                import weakref
+
+                from .overlap import FileParityWorker
+
+                self._file_worker = FileParityWorker(
+                    self.k, self.r, self.dispatch_b, mat)
+                weakref.finalize(self, FileParityWorker.close,
+                                 self._file_worker)
+            except Exception:
+                self._file_worker = False  # don't retry every encode
+        if not self._file_worker:
+            return None
+        try:
+            self._file_worker.open(dat_path)
+        except Exception:
+            # dead or desynced worker: drop it so the next encode
+            # respawns (~200ms) instead of stalling on a corpse
+            self._drop_file_worker()
+            return None
+        return self._file_worker
+
+    def _drop_file_worker(self) -> None:
+        w = self._file_worker
+        self._file_worker = None
+        if w:
+            try:
+                w.close()
+            except Exception:  # pragma: no cover - best-effort teardown
+                pass
+
     def _encode_file_mmap(self, dat_path: str, out_base: str,
                           large: int, small: int, matmul_ptrs) -> None:
         """Zero-copy encode: the input volume is mmap'd and the SIMD
@@ -354,12 +408,68 @@ class StreamingEncoder:
             in_arr = np.frombuffer(in_map, dtype=np.uint8)
             in_mv = memoryview(in_map)
             in_addr = in_arr.ctypes.data
+            # parity worker: a separate process mmaps the SAME file and
+            # computes dispatch d+1's parity while this process sits in
+            # pwrite for dispatch d — kernel-mode write time and SIMD
+            # compute overlap even on one core (bench.py measures the
+            # mechanism at ~1.5-1.8x there)
+            worker = self._file_parity_worker(mat, dat_path)
+            from collections import deque
+
+            pending: deque = deque()  # (slot, n, out_off, base, block)
+            slot_seq = 0
+
+            def drain_one():
+                nonlocal worker
+                slot, n, off, base, block = pending.popleft()
+                parity = None
+                if worker is not None:
+                    t0 = clock()
+                    try:
+                        parity = worker.fetch(slot)[:, :n]
+                    except Exception:
+                        # worker died mid-encode (OOM kill, segfault):
+                        # recompute the lost dispatches serially and
+                        # finish the encode without it
+                        self._drop_file_worker()
+                        worker = None
+                    st["drain_wait_s"] += clock() - t0
+                if parity is None:
+                    t0 = clock()
+                    matmul_ptrs(
+                        mat,
+                        [in_addr + base + i * block for i in range(k)],
+                        stage_addr, n)
+                    st["dispatch_s"] += clock() - t0
+                    parity = stage
+                t0 = clock()
+                for j in range(r):
+                    os.pwrite(out_fds[k + j],
+                              memoryview(parity[j, :n]), off)
+                for i in range(k):
+                    s = base + i * block
+                    os.pwrite(out_fds[i], in_mv[s:s + n], off)
+                st["write_s"] += clock() - t0
+
             try:
                 out_off = 0
                 for n, row_start, block, off in _plan_entries(
                         file_size, k, large, small, self.dispatch_b):
                     base = row_start + off
                     if base + (k - 1) * block + n <= file_size:
+                        if worker is not None:
+                            if len(pending) == worker.nbufs:
+                                drain_one()
+                            slot = slot_seq % worker.nbufs
+                            slot_seq += 1
+                            t0 = clock()
+                            worker.submit(slot, base, block, n)
+                            st["dispatch_s"] += clock() - t0
+                            pending.append((slot, n, out_off, base, block))
+                            st["dispatches"] += 1
+                            st["bytes_in"] += k * n
+                            out_off += n
+                            continue
                         # all k source rows fully inside the file: matmul
                         # in place from the mapping into the parity stage
                         t0 = clock()
@@ -409,7 +519,14 @@ class StreamingEncoder:
                     st["dispatches"] += 1
                     st["bytes_in"] += k * n
                     out_off += n
+                while pending:
+                    drain_one()
             finally:
+                if pending:
+                    # abnormal exit with submitted-but-undrained jobs:
+                    # their acks would desync the next encode's protocol
+                    # — drop the worker, a later encode respawns fresh
+                    self._drop_file_worker()
                 # the view and exported memoryview must drop before the
                 # mmap closes or close() raises BufferError
                 if in_mv is not None:

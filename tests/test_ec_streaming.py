@@ -189,3 +189,79 @@ def test_failed_rebuild_leaves_no_empty_shards(tmp_path, make):
         else:
             make().rebuild_files(base)
     assert not os.path.exists(base + to_ext(2))  # no empty ghost shard
+
+
+def test_file_parity_worker_byte_identical(tmp_path):
+    """overlap="mmap-process" keeps the zero-copy mmap read path but
+    computes parity in a separate process that mmaps the same file
+    (ec/overlap.py FileParityWorker) — byte-identical shards, worker
+    reused across two different files, tail entries still handled by
+    the parent."""
+    base = _write_dat(tmp_path, 123_457, name="fw")
+    ref = _cpu_reference(tmp_path, base, 10_000, 100)
+    enc = StreamingEncoder(10, 4, engine="host", overlap="mmap-process")
+    enc.dispatch_b = 4096
+    try:
+        enc.encode_file(base + ".dat", base,
+                        large_block_size=10_000, small_block_size=100)
+        assert _shards(base, 14) == _shards(ref, 14)
+        assert enc._file_worker  # actually engaged
+        # a SECOND file reuses the worker (re-opened in the child)
+        base2 = _write_dat(tmp_path, 3 * 10 * 10_000 + 7, name="fw2")
+        ref2 = str(tmp_path / "ref2")
+        os.link(base2 + ".dat", ref2 + ".dat")
+        encoder.write_ec_files(ref2, ReedSolomon(10, 4),
+                               large_block_size=10_000,
+                               small_block_size=100, chunk=npchunk(100))
+        enc.encode_file(base2 + ".dat", base2,
+                        large_block_size=10_000, small_block_size=100)
+        assert _shards(base2, 14) == _shards(ref2, 14)
+    finally:
+        if enc._file_worker:
+            enc._file_worker.close()
+
+
+def test_file_parity_worker_respawns_on_dispatch_change(tmp_path):
+    """dispatch_b is baked into the worker's shm slot ring: changing it
+    must respawn the worker, not silently truncate parity columns."""
+    base = _write_dat(tmp_path, 123_457, name="fwb")
+    ref = _cpu_reference(tmp_path, base, 10_000, 100)
+    enc = StreamingEncoder(10, 4, engine="host", overlap="mmap-process")
+    enc.dispatch_b = 2048
+    try:
+        enc.encode_file(base + ".dat", base,
+                        large_block_size=10_000, small_block_size=100)
+        assert _shards(base, 14) == _shards(ref, 14)
+        first = enc._file_worker
+        enc.dispatch_b = 8192  # grow: stale worker would truncate at 2048
+        enc.encode_file(base + ".dat", base,
+                        large_block_size=10_000, small_block_size=100)
+        assert _shards(base, 14) == _shards(ref, 14)
+        assert enc._file_worker is not first  # respawned
+    finally:
+        enc._drop_file_worker()
+
+
+def test_file_parity_worker_death_falls_back_serial(tmp_path):
+    """A dead worker must not hang or corrupt: the encode falls back to
+    serial compute and a later encode respawns a fresh worker."""
+    base = _write_dat(tmp_path, 123_457, name="fwd")
+    ref = _cpu_reference(tmp_path, base, 10_000, 100)
+    enc = StreamingEncoder(10, 4, engine="host", overlap="mmap-process")
+    enc.dispatch_b = 4096
+    try:
+        enc.encode_file(base + ".dat", base,
+                        large_block_size=10_000, small_block_size=100)
+        assert _shards(base, 14) == _shards(ref, 14)
+        # kill the worker out from under the encoder
+        enc._file_worker._proc.terminate()
+        enc._file_worker._proc.join(timeout=10)
+        enc.encode_file(base + ".dat", base,
+                        large_block_size=10_000, small_block_size=100)
+        assert _shards(base, 14) == _shards(ref, 14)  # still correct
+        # the corpse was dropped; the NEXT encode spawns fresh and works
+        enc.encode_file(base + ".dat", base,
+                        large_block_size=10_000, small_block_size=100)
+        assert _shards(base, 14) == _shards(ref, 14)
+    finally:
+        enc._drop_file_worker()
