@@ -1,0 +1,187 @@
+"""Path-specific filer store routing.
+
+Equivalent of weed/filer/filerstore_wrapper.go (FilerStoreWrapper's
+pathToStore trie + getActualStore) and filerstore_translate_path.go
+(FilerStorePathTranlator): the filer can mount DIFFERENT store backends
+under path prefixes — e.g. hot directories on redis, the rest on sqlite
+— with the longest matching prefix winning.  Entries under a mount live
+in that store under the TRANSLATED path (the mount prefix stripped), so
+a store can be detached and re-mounted elsewhere, like the reference.
+
+KV state (signatures, cursors) always rides the default store: it is
+filer-global, not path-scoped (filerstore_wrapper.go KvPut routes to
+defaultStore).
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Iterator, Optional
+
+from .entry import Entry
+
+
+class PathTranslatingStore:
+    """Wrap a store mounted at `root`: outer paths have the mount prefix
+    stripped before reaching it, results get it re-attached."""
+
+    def __init__(self, root: str, store):
+        self.root = root.rstrip("/") or "/"
+        self.store = store
+        self.name = f"{getattr(store, 'name', 'store')}@{self.root}"
+
+    # -- path mapping -------------------------------------------------------
+    def _to_inner(self, path: str) -> str:
+        if self.root == "/":
+            return path
+        inner = path[len(self.root):]
+        return inner or "/"
+
+    def _to_outer(self, path: str) -> str:
+        if self.root == "/":
+            return path
+        return self.root + (path if path != "/" else "")
+
+    def _translate_entry(self, e: Entry) -> Entry:
+        # copy: stores like MemoryStore hand out their OWN entry
+        # objects — mutating them would corrupt the stored path
+        out = copy.copy(e)
+        out.full_path = self._to_outer(e.full_path)
+        return out
+
+    # -- FilerStore surface -------------------------------------------------
+    def insert_entry(self, entry: Entry) -> None:
+        inner = copy.copy(entry)
+        inner.full_path = self._to_inner(entry.full_path)
+        self.store.insert_entry(inner)
+
+    def update_entry(self, entry: Entry) -> None:
+        inner = copy.copy(entry)
+        inner.full_path = self._to_inner(entry.full_path)
+        self.store.update_entry(inner)
+
+    def find_entry(self, path: str) -> Optional[Entry]:
+        e = self.store.find_entry(self._to_inner(path))
+        return self._translate_entry(e) if e is not None else None
+
+    def delete_entry(self, path: str) -> None:
+        self.store.delete_entry(self._to_inner(path))
+
+    def delete_folder_children(self, path: str) -> None:
+        self.store.delete_folder_children(self._to_inner(path))
+
+    def list_directory_entries(self, dir_path: str, start_file: str = "",
+                               include_start: bool = False,
+                               limit: int = 1000,
+                               prefix: str = "") -> Iterator[Entry]:
+        for e in self.store.list_directory_entries(
+                self._to_inner(dir_path), start_file=start_file,
+                include_start=include_start, limit=limit, prefix=prefix):
+            yield self._translate_entry(e)
+
+    # kv is never path-routed; present for interface completeness
+    def kv_put(self, key: bytes, value: bytes) -> None:
+        self.store.kv_put(key, value)
+
+    def kv_get(self, key: bytes) -> Optional[bytes]:
+        return self.store.kv_get(key)
+
+    def kv_delete(self, key: bytes) -> None:
+        self.store.kv_delete(key)
+
+    def kv_scan(self, prefix: bytes):
+        return self.store.kv_scan(prefix)
+
+
+class PathSpecificStoreRouter:
+    """Longest-prefix routing over a default store + path mounts
+    (FilerStoreWrapper.getActualStore).  Mount boundaries follow the
+    reference semantics: an operation on path P uses the store of the
+    longest mount prefix that is a path-component prefix of P."""
+
+    def __init__(self, default_store, mounts: Optional[dict] = None):
+        self.default = default_store
+        self.name = getattr(default_store, "name", "store")
+        # mount path -> PathTranslatingStore, longest first
+        self._mounts: list[tuple[str, PathTranslatingStore]] = []
+        for path, store in (mounts or {}).items():
+            self.add_path_store(path, store)
+
+    def add_path_store(self, path: str, store) -> None:
+        root = path.rstrip("/") or "/"
+        if root == "/":
+            # a "/" mount could never match store_for's strictly-inside
+            # rule — it would be a silent no-op losing the operator's
+            # data to the default store; configure it as -db instead
+            raise ValueError("mount prefix '/' is the default store")
+        if any(r == root for r, _ in self._mounts):
+            # last flag wins, loudly beats silently-dead config
+            self._mounts = [(r, t) for r, t in self._mounts if r != root]
+        self._mounts.append((root, PathTranslatingStore(root, store)))
+        self._mounts.sort(key=lambda m: len(m[0]), reverse=True)
+
+    def store_for(self, path: str):
+        """Store owning the ENTRY at `path`.  Strictly-inside matching:
+        the mount-root directory's own entry lives in the PARENT's
+        store, so parent listings still show the mount point (the
+        reference stores it in the mounted store as "/", which drops
+        the directory from parent listings — deliberate divergence,
+        kept observably identical to a single store instead)."""
+        if path != "/":
+            for root, ts in self._mounts:
+                if path.startswith(root + "/"):
+                    return ts
+        return self.default
+
+    def _store_for_children(self, dir_path: str):
+        """Store owning the CHILDREN of `dir_path`: a mount root's
+        children live in the mounted store."""
+        base = dir_path.rstrip("/") or "/"
+        for root, ts in self._mounts:
+            if base == root:
+                return ts
+        # otherwise children live wherever a child path would route
+        return self.store_for(base + "/." if base != "/" else "/.")
+
+    # -- FilerStore surface -------------------------------------------------
+    def insert_entry(self, entry: Entry) -> None:
+        self.store_for(entry.full_path).insert_entry(entry)
+
+    def update_entry(self, entry: Entry) -> None:
+        self.store_for(entry.full_path).update_entry(entry)
+
+    def find_entry(self, path: str) -> Optional[Entry]:
+        return self.store_for(path).find_entry(path)
+
+    def delete_entry(self, path: str) -> None:
+        self.store_for(path).delete_entry(path)
+
+    def delete_folder_children(self, path: str) -> None:
+        self._store_for_children(path).delete_folder_children(path)
+        # a delete AT or ABOVE a mount point must clear the mounted
+        # subtrees too, or "deleted" directories resurrect from a mount
+        base = path.rstrip("/") or "/"
+        for root, ts in self._mounts:
+            if base == "/" or root == base or root.startswith(base + "/"):
+                ts.store.delete_folder_children("/")
+
+    def list_directory_entries(self, dir_path: str, start_file: str = "",
+                               include_start: bool = False,
+                               limit: int = 1000,
+                               prefix: str = "") -> Iterator[Entry]:
+        return self._store_for_children(dir_path).list_directory_entries(
+            dir_path, start_file=start_file, include_start=include_start,
+            limit=limit, prefix=prefix)
+
+    # kv: filer-global, always the default store
+    def kv_put(self, key: bytes, value: bytes) -> None:
+        self.default.kv_put(key, value)
+
+    def kv_get(self, key: bytes) -> Optional[bytes]:
+        return self.default.kv_get(key)
+
+    def kv_delete(self, key: bytes) -> None:
+        self.default.kv_delete(key)
+
+    def kv_scan(self, prefix: bytes):
+        return self.default.kv_scan(prefix)

@@ -1,0 +1,180 @@
+"""Path-specific store routing: different backends under path prefixes.
+
+Reference: weed/filer/filerstore_wrapper.go (pathToStore trie,
+getActualStore) + filerstore_translate_path.go (mount-prefix
+translation).  Gates:
+- longest-prefix routing, translated storage paths
+- a Filer on the router is observably identical to a Filer on one store
+- entries land in (and only in) their mount's backend
+- deletes above a mount clear the mounted subtree
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu.filer.entry import Attr, Entry, FileChunk
+from seaweedfs_tpu.filer.filer import Filer, NotFoundError
+from seaweedfs_tpu.filer.filer_store import MemoryStore, SqliteStore
+from seaweedfs_tpu.filer.filerstore_path import (
+    PathSpecificStoreRouter,
+    PathTranslatingStore,
+)
+
+RNG = np.random.default_rng(0xBA7)
+
+
+def _file(path: str, n: int = 1) -> Entry:
+    chunks = [FileChunk(file_id=f"3,{i:02x}", offset=i * 10, size=10)
+              for i in range(n)]
+    return Entry(full_path=path, attr=Attr(mode=0o660), chunks=chunks)
+
+
+def _router(tmp_path):
+    fast = MemoryStore()
+    cold = SqliteStore(str(tmp_path / "cold.db"))
+    router = PathSpecificStoreRouter(
+        MemoryStore(), {"/hot": fast, "/hot/hotter": cold})
+    return router, fast, cold
+
+
+def test_longest_prefix_routing_and_translation(tmp_path):
+    router, fast, cold = _router(tmp_path)
+    router.insert_entry(_file("/plain/a.txt"))
+    router.insert_entry(_file("/hot/b.txt"))
+    router.insert_entry(_file("/hot/hotter/c.txt"))
+    # every path resolves through the router...
+    for p in ("/plain/a.txt", "/hot/b.txt", "/hot/hotter/c.txt"):
+        assert router.find_entry(p).full_path == p
+    # ...but physically lives in its mount's store, mount prefix STRIPPED
+    assert fast.find_entry("/b.txt") is not None
+    assert fast.find_entry("/hot/b.txt") is None
+    assert cold.find_entry("/c.txt") is not None
+    assert router.default.find_entry("/plain/a.txt") is not None
+    assert router.default.find_entry("/hot/b.txt") is None
+    # listing under a mount translates back to outer paths
+    assert [e.full_path for e in
+            router.list_directory_entries("/hot")] == ["/hot/b.txt"]
+    assert [e.full_path for e in
+            router.list_directory_entries("/hot/hotter")] == [
+        "/hot/hotter/c.txt"]
+    # the mount root's OWN entry lives in the parent store (parent
+    # listings must show the mount point); its CHILDREN in the mount
+    assert router.store_for("/hot") is router.default
+    assert isinstance(router._store_for_children("/hot"),
+                      PathTranslatingStore)
+    # a sibling with the mount as a string prefix routes to the default
+    assert router.store_for("/hotdog.txt") is router.default
+
+
+def test_filer_on_router_matches_single_store(tmp_path):
+    """Differential: a Filer over the router behaves like a Filer over
+    one memory store for a randomized op sequence crossing mounts."""
+    router, _, _ = _router(tmp_path)
+    a = Filer(store=router)
+    b = Filer(store=MemoryStore())
+    dirs = ["/plain", "/hot", "/hot/hotter", "/hot/sub"]
+    names = [f"f{i}" for i in range(8)]
+    for _ in range(300):
+        op = RNG.integers(0, 4)
+        path = f"{dirs[RNG.integers(0, 4)]}/{names[RNG.integers(0, 8)]}"
+        if op == 0:
+            e1, e2 = _file(path), _file(path)
+            a.create_entry(e1)
+            b.create_entry(e2)
+        elif op == 1:
+            for f in (a, b):
+                try:
+                    f.delete_entry(path)
+                except NotFoundError:
+                    pass
+        elif op == 2:
+            r1 = r2 = None
+            try:
+                r1 = a.find_entry(path).full_path
+            except NotFoundError:
+                pass
+            try:
+                r2 = b.find_entry(path).full_path
+            except NotFoundError:
+                pass
+            assert r1 == r2
+        else:
+            d = dirs[RNG.integers(0, 4)]
+            la = sorted(e.full_path for e in a.list_directory(d))
+            lb = sorted(e.full_path for e in b.list_directory(d))
+            assert la == lb
+    a.close()
+    b.close()
+
+
+def test_delete_above_mount_clears_subtree(tmp_path):
+    router, fast, _ = _router(tmp_path)
+    router.insert_entry(_file("/hot/x.txt"))
+    router.insert_entry(_file("/other/y.txt"))
+    router.delete_folder_children("/")
+    assert fast.find_entry("/x.txt") is None
+    assert router.find_entry("/hot/x.txt") is None
+    assert router.find_entry("/other/y.txt") is None
+
+
+def test_kv_rides_default_store(tmp_path):
+    router, fast, _ = _router(tmp_path)
+    router.kv_put(b"cursor", b"42")
+    assert router.default.kv_get(b"cursor") == b"42"
+    assert fast.kv_get(b"cursor") is None
+    assert router.kv_get(b"cursor") == b"42"
+
+
+def test_filer_server_with_path_store(tmp_path):
+    """End-to-end through the HTTP filer: entries under the mount are
+    served normally and land in the mounted backend."""
+    import time
+
+    from seaweedfs_tpu.filer.server import FilerServer
+    from seaweedfs_tpu.master.server import MasterServer
+    from seaweedfs_tpu.utils.httpd import http_bytes
+    from seaweedfs_tpu.volume_server.server import VolumeServer
+    from tests.conftest import free_port
+
+    master = MasterServer(port=free_port(), pulse_seconds=0.3).start()
+    d = tmp_path / "v"
+    d.mkdir()
+    vs = VolumeServer([str(d)], master.url, port=free_port(),
+                      pulse_seconds=0.3).start()
+    deadline = time.time() + 5
+    while time.time() < deadline and not master.topo.all_nodes():
+        time.sleep(0.05)
+    fast = MemoryStore()
+    router = PathSpecificStoreRouter(
+        SqliteStore(str(tmp_path / "main.db")), {"/hot": fast})
+    filer = FilerServer(master.url, router, port=free_port()).start()
+    try:
+        base = f"http://{filer.url}"
+        http_bytes("PUT", base + "/hot/h.bin", b"hot bytes")
+        http_bytes("PUT", base + "/cold/c.bin", b"cold bytes")
+        st, got, _ = http_bytes("GET", base + "/hot/h.bin")
+        assert (st, got) == (200, b"hot bytes")
+        st, got, _ = http_bytes("GET", base + "/cold/c.bin")
+        assert (st, got) == (200, b"cold bytes")
+        assert fast.find_entry("/h.bin") is not None  # routed backend
+        st, _, _ = http_bytes("DELETE", base + "/hot/h.bin")
+        assert st == 204
+        assert fast.find_entry("/h.bin") is None
+    finally:
+        filer.stop()
+        vs.stop()
+        master.stop()
+
+
+def test_root_mount_rejected_and_duplicate_replaced(tmp_path):
+    router = PathSpecificStoreRouter(MemoryStore())
+    with pytest.raises(ValueError):
+        router.add_path_store("/", MemoryStore())
+    first, second = MemoryStore(), MemoryStore()
+    router.add_path_store("/m", first)
+    router.add_path_store("/m", second)  # last flag wins
+    router.insert_entry(_file("/m/x"))
+    assert second.find_entry("/x") is not None
+    assert first.find_entry("/x") is None

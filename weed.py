@@ -169,6 +169,19 @@ def cmd_filer(args) -> None:
     from seaweedfs_tpu.security.config import filer_guard
 
     store = _make_filer_store(args.db)
+    if getattr(args, "pathStore", None):
+        from seaweedfs_tpu.filer.filer_store import MemoryStore
+        from seaweedfs_tpu.filer.filerstore_path import (
+            PathSpecificStoreRouter,
+        )
+
+        router = PathSpecificStoreRouter(store or MemoryStore())
+        for spec in args.pathStore:
+            prefix, _, db = spec.partition("=")
+            if not prefix.startswith("/") or not db:
+                raise SystemExit(f"-pathStore wants /prefix=DB, got {spec!r}")
+            router.add_path_store(prefix, _make_filer_store(db))
+        store = router
     f = FilerServer(args.master, store, host=args.ip, port=args.port,
                     max_chunk_mb=args.maxMB,
                     chunk_cache_dir=args.cacheDir,
@@ -1026,6 +1039,12 @@ def main(argv=None) -> None:
                          "cassandra://host:port, hbase://host:port/table, "
                          "*.lsm -> LSM store dir, else "
                          "sqlite path (default: memory)")
+    fl.add_argument("-pathStore", action="append", default=[],
+                    metavar="PREFIX=DB",
+                    help="mount a DIFFERENT store under a path prefix "
+                         "(repeatable; longest prefix wins), e.g. "
+                         "-pathStore /hot=redis://localhost:6379 "
+                         "(filerstore_wrapper.go path-specific stores)")
     fl.add_argument("-peers", default="",
                     help="other filer host:ports to aggregate meta from")
     fl.add_argument("-maxMB", type=int, default=8)
