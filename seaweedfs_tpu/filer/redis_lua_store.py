@@ -118,22 +118,26 @@ class RedisLuaStore(RedisStore):
     def insert_entry(self, entry: Entry) -> None:
         d, name = _split(entry.full_path)
         blob = json.dumps(entry.to_dict()).encode()
+        listed = bool(d) and not self._is_super_large(d)
         self._eval(INSERT_ENTRY_LUA,
                    [entry.full_path.encode(), self._dir_key(d or "/")],
-                   [blob, name.encode() if d else b"",
+                   [blob, name.encode() if listed else b"",
                     (d or "").encode()])
 
     update_entry = insert_entry
 
     def delete_entry(self, path: str) -> None:
         d, name = _split(path)
+        listed = bool(d) and not self._is_super_large(d)
         self._eval(DELETE_ENTRY_LUA,
                    [path.encode(), self._dir_key(d or "/")],
-                   [name.encode() if d else b""])
+                   [name.encode() if listed else b""])
 
     def delete_folder_children(self, path: str) -> None:
         """Same descendant walk as the base store, but each directory's
         member entries + listing set drop in one atomic script call."""
+        if self._is_super_large(path):
+            return
         for d in self._descendant_dirs(path):
             dir_path = d.decode()
             self._eval(DELETE_FOLDER_CHILDREN_LUA,

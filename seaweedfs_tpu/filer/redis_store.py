@@ -178,27 +178,51 @@ class RedisStore:
     """FilerStore over any RESP2 server (redis2 data model, see module doc)."""
 
     name = "redis"
+    # class-level default: cluster/sentinel variants construct their own
+    # clients without running this __init__
+    super_large_dirs: frozenset = frozenset()
 
     def __init__(self, host: str = "127.0.0.1", port: int = 6379,
-                 db: int = 0, password: str = ""):
+                 db: int = 0, password: str = "",
+                 super_large_dirs: tuple = ()):
         self.client = RespClient(host, port, db=db, password=password)
         self.client.command("PING")
+        # superLargeDirectories (universal_redis_store.go:25): configured
+        # dirs keep NO directory-listing zset — inserts skip the ZADD, a
+        # listing answers empty, recursive delete leaves children to TTL
+        # — so a hundred-million-entry dir costs O(1) per insert
+        self.super_large_dirs = {d.rstrip("/") or "/"
+                                 for d in super_large_dirs}
+
+    def _is_super_large(self, dir_path: str) -> bool:
+        return (dir_path.rstrip("/") or "/") in self.super_large_dirs
 
     @classmethod
     def from_url(cls, url: str) -> "RedisStore":
-        """Parse ``redis://[:password@]host:port[/db]``."""
+        """Parse ``redis://[:password@]host:port[/db]
+        [?superLargeDirs=/a,/b]``."""
         rest = url[len("redis://"):]
         password = ""
         if "@" in rest:
             cred, rest = rest.rsplit("@", 1)
             password = cred.lstrip(":")
+        # query parsing AFTER the credential split: '?' is legal inside
+        # a password
+        slds: tuple = ()
+        if "?" in rest:
+            rest, _, q = rest.partition("?")
+            from urllib.parse import parse_qs
+
+            params = parse_qs(q)
+            slds = tuple(d for v in params.get("superLargeDirs", [])
+                         for d in v.split(",") if d)
         db = 0
         if "/" in rest:
             rest, db_s = rest.split("/", 1)
             db = int(db_s or 0)
         host, _, port_s = rest.partition(":")
         return cls(host or "127.0.0.1", int(port_s or 6379),
-                   db=db, password=password)
+                   db=db, password=password, super_large_dirs=slds)
 
     # -- entries ------------------------------------------------------------
     @staticmethod
@@ -209,7 +233,7 @@ class RedisStore:
         d, name = _split(entry.full_path)
         blob = json.dumps(entry.to_dict()).encode()
         cmds = [("SET", entry.full_path.encode(), blob)]
-        if d:  # "/" itself has no parent listing
+        if d and not self._is_super_large(d):  # "/" has no parent listing
             cmds.append(("ZADD", self._dir_key(d), "0", name.encode()))
             # global directory index: lets delete_folder_children find
             # descendant directories even when intermediate directory
@@ -228,7 +252,7 @@ class RedisStore:
     def delete_entry(self, path: str) -> None:
         d, name = _split(path)
         cmds = [("DEL", path.encode())]
-        if d:
+        if d and not self._is_super_large(d):
             cmds.append(("ZREM", self._dir_key(d), name.encode()))
         self.client.pipeline(*cmds)
 
@@ -248,6 +272,12 @@ class RedisStore:
         from the d.index sorted set (lex prefix range), then drop each
         directory's member entries and its set
         (universal_redis_store.go DeleteFolderChildren)."""
+        if self._is_super_large(path):
+            # no listing exists to walk (universal_redis_store.go:132).
+            # NOTE: unlike the reference, entry keys here carry no redis
+            # TTL — children of a dropped super-large dir are reclaimed
+            # only by explicit per-path deletes
+            return
         for d in self._descendant_dirs(path):
             dir_path = d.decode()
             members = self.client.command(

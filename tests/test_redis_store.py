@@ -214,3 +214,47 @@ def test_lua_store_from_url_and_plain_interop(server):
     assert plain.find_entry("/shared/x.bin").chunks[0].file_id == "3,00"
     plain.delete_entry("/shared/x.bin")
     assert lua.find_entry("/shared/x.bin") is None
+
+
+@pytest.mark.parametrize("variant", ["plain", "lua"])
+def test_super_large_directories(server, variant):
+    """superLargeDirectories (universal_redis_store.go:25-27,64,117,132):
+    configured dirs keep no listing zset — O(1) inserts, empty listings,
+    full-path lookups still work."""
+    if variant == "lua":
+        from seaweedfs_tpu.filer.redis_lua_store import RedisLuaStore as S
+    else:
+        S = RedisStore
+    store = S.from_url(
+        f"redis://127.0.0.1:{server.port}/0?superLargeDirs=/huge,/logs")
+    assert store.super_large_dirs == {"/huge", "/logs"}
+    store.insert_entry(_file("/huge/a.bin"))
+    store.insert_entry(_file("/normal/b.bin"))
+    # full-path lookup works; the huge dir has NO listing
+    assert store.find_entry("/huge/a.bin") is not None
+    assert list(store.list_directory_entries("/huge")) == []
+    assert [e.full_path for e in
+            store.list_directory_entries("/normal")] == ["/normal/b.bin"]
+    # no zset was ever created for the huge dir
+    assert server.zsets.get(b"d:/huge") in (None, set())
+    # delete: entry gone, no stray ZREM bookkeeping needed
+    store.delete_entry("/huge/a.bin")
+    assert store.find_entry("/huge/a.bin") is None
+    # recursive delete of a super-large dir is a no-op by design
+    store.insert_entry(_file("/huge/keep.bin"))
+    store.delete_folder_children("/huge")
+    assert store.find_entry("/huge/keep.bin") is not None
+
+
+def test_url_password_with_question_mark():
+    s = MiniRedis(password="pa?ss")
+    try:
+        st = RedisStore.from_url(f"redis://:pa?ss@127.0.0.1:{s.port}/0")
+        st.insert_entry(_file("/q"))
+        assert st.find_entry("/q") is not None
+        # and a query AFTER credentials still parses
+        st2 = RedisStore.from_url(
+            f"redis://:pa?ss@127.0.0.1:{s.port}/0?superLargeDirs=/big")
+        assert st2.super_large_dirs == {"/big"}
+    finally:
+        s.stop()
