@@ -897,24 +897,44 @@ def cmd_benchmark(args) -> None:
     phase = getattr(args, "phase", "both")
     fids_file = getattr(args, "fidsFile", "")
 
+    collection = getattr(args, "collection", "benchmark")
+    replication = getattr(args, "replication", "000")
+    delete_pct = getattr(args, "deletePercent", 0)
+    del_rng = random.Random(0xDE1)
+    to_delete: list[str] = []
+
     def write_one(i: int) -> float:
         t0 = time.perf_counter()
         if use_tcp:
-            fid = client.upload_tcp(payload)
+            fid = client.upload_tcp(payload, collection=collection,
+                                    replication=replication)
         else:
-            fid = client.upload(payload, name=f"bench{i}")
-        fids.append(fid)
-        return time.perf_counter() - t0
+            fid = client.upload(payload, name=f"bench{i}",
+                                collection=collection,
+                                replication=replication)
+        dt = time.perf_counter() - t0
+        # benchmark.go -deletePercent: a slice of writes gets deleted,
+        # mixing tombstone traffic into the volume — the deletes run
+        # AFTER the pool joins (the reference uses a delayed background
+        # channel) so write latency stays comparable across runs
+        if delete_pct and del_rng.randrange(100) < delete_pct:
+            to_delete.append(fid)
+        else:
+            fids.append(fid)
+        return dt
 
     if phase in ("both", "write"):
         t0 = time.perf_counter()
         with concurrent.futures.ThreadPoolExecutor(args.c) as ex:
             lat = sorted(ex.map(write_one, range(args.n)))
         wall = time.perf_counter() - t0
+        for fid in to_delete:
+            client.delete(fid)
         print(f"write: {args.n} x {args.size}B in {wall:.2f}s = "
               f"{args.n / wall:.0f} req/s, "
               f"avg {sum(lat) / len(lat) * 1e3:.1f}ms "
-              f"p99 {lat[int(len(lat) * 0.99) - 1] * 1e3:.1f}ms")
+              f"p99 {lat[int(len(lat) * 0.99) - 1] * 1e3:.1f}ms"
+              + (f", {len(to_delete)} deleted" if to_delete else ""))
         if fids_file:
             with open(fids_file, "w") as f:
                 f.write("\n".join(fids))
@@ -934,7 +954,8 @@ def cmd_benchmark(args) -> None:
         return time.perf_counter() - t0
 
     if phase in ("both", "read") and fids:
-        random.shuffle(fids)
+        if not getattr(args, "readSequentially", False):
+            random.shuffle(fids)
         t0 = time.perf_counter()
         with concurrent.futures.ThreadPoolExecutor(args.c) as ex:
             lat = sorted(ex.map(read_one, fids))
@@ -1260,6 +1281,13 @@ def main(argv=None) -> None:
                    help="run only one phase (scaled multi-client benches)")
     b.add_argument("-fidsFile", default="",
                    help="write: save fids here; read: load fids from here")
+    b.add_argument("-collection", default="benchmark",
+                   help="write data to this collection")
+    b.add_argument("-replication", default="000")
+    b.add_argument("-deletePercent", type=int, default=0,
+                   help="percent of writes immediately followed by delete")
+    b.add_argument("-readSequentially", action="store_true",
+                   help="read fids in write order instead of shuffled")
     b.set_defaults(fn=cmd_benchmark)
 
     _SUBCOMMANDS[:] = list(sub.choices)
