@@ -343,7 +343,9 @@ class MasterServer:
         def dir_status(req: Request) -> Response:
             self._require_leader(req)
             return Response({"Topology": self.topo.to_map(),
-                             "Version": "seaweedfs-tpu 0.1"})
+                             "Version": "seaweedfs-tpu 0.1",
+                             "VolumeSizeLimitMB":
+                                 self.topo.volume_size_limit >> 20})
 
         from ..utils.debug import register_debug_routes
 

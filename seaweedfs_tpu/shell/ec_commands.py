@@ -62,16 +62,62 @@ def _refresh_heartbeats(env: CommandEnv, servers: set[str]) -> None:
             pass
 
 
+def _ec_encode_candidates(env: CommandEnv, collection: str,
+                          full_percent: float, quiet_seconds: float
+                          ) -> list[int]:
+    """vidsToEcEncode (command_ec_encode.go:267-298): volumes of the
+    collection that are ≥ fullPercent of the size limit AND have not
+    been written for quietFor — full and cold, the EC sweet spot."""
+    import time as _time
+
+    doc = env.master_get("/dir/status")
+    limit_b = doc.get("VolumeSizeLimitMB", 30 * 1024) * (1 << 20)
+    threshold = limit_b * full_percent / 100.0
+    now = _time.time()
+    vids: set[int] = set()
+    for dc in doc["Topology"]["DataCenters"]:
+        for rack in dc["Racks"]:
+            for n in rack["DataNodes"]:
+                for v in n.get("VolumeInfos", []):
+                    if (v.get("collection", "") == collection
+                            and v.get("size", 0) >= threshold
+                            and now - v.get("modified_at", 0)
+                            >= quiet_seconds):
+                        vids.add(v["id"])
+    return sorted(vids)
+
+
 @command("ec.encode")
 def cmd_ec_encode(env: CommandEnv, flags: dict) -> str:
-    """ec.encode -volumeId <id> [-collection c] [-engine cpu|tpu]
-    # erasure-code a volume: generate RS(10,4) shards, spread them across
-    # servers, delete the original replicas (command_ec_encode.go:95-184)"""
+    """ec.encode -volumeId <id> | -collection c [-fullPercent 95]
+    [-quietFor 3600] [-engine cpu|tpu]
+    # erasure-code a volume — or every full+quiet volume of a collection
+    # (command_ec_encode.go:95-184, candidate selection :267-298)"""
     env.confirm_is_locked()
-    vid = int(flags["volumeId"])
     collection = flags.get("collection", "")
     engine = flags.get("engine", "cpu")
+    if "volumeId" in flags:
+        vids = [int(flags["volumeId"])]
+    else:
+        vids = _ec_encode_candidates(
+            env, collection, float(flags.get("fullPercent", "95")),
+            float(flags.get("quietFor", "3600")))
+        if not vids:
+            return "no full+quiet volumes to encode"
+    # per-volume isolation: each encode is destructive (originals are
+    # deleted) — a mid-batch failure must not swallow the record of the
+    # volumes already converted
+    lines = []
+    for vid in vids:
+        try:
+            lines.append(_ec_encode_one(env, vid, collection, engine))
+        except Exception as e:  # noqa: BLE001 - keep the audit trail
+            lines.append(f"ec.encode volume {vid} FAILED: {e}")
+    return "\n".join(lines)
 
+
+def _ec_encode_one(env: CommandEnv, vid: int, collection: str,
+                   engine: str) -> str:
     locations = env.master.lookup(vid)
     if not locations:
         raise RuntimeError(f"volume {vid} not found")

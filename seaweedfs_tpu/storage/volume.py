@@ -153,6 +153,16 @@ class Volume:
                 self._dat = DiskFile(self.dat_path)
             if not exists or self._dat.size < SUPER_BLOCK_SIZE:
                 self._dat.write_at(self.super_block.to_bytes(), 0)
+            elif exists:
+                # a freshly-loaded volume is as old as its file, not 0
+                # (volume_loading.go:63) — a zero would read as
+                # "infinitely quiet" to ec.encode's quietFor guard and
+                # TTL expiry checks after every restart
+                try:
+                    self.last_modified_ts_seconds = int(
+                        os.path.getmtime(self.dat_path))
+                except OSError:  # pragma: no cover - raced unlink
+                    pass
         if self._dat.size >= SUPER_BLOCK_SIZE:
             self.super_block = SuperBlock.from_bytes(
                 self._dat.read_at(SUPER_BLOCK_SIZE + 0xFFFF, 0))

@@ -681,9 +681,12 @@ class VolumeServer:
             if mime and mime != "application/octet-stream":
                 n.set_flag(FLAG_HAS_MIME)
                 n.mime = mime.encode()[:255]
-            if req.query.get("ts"):
-                n.set_flag(FLAG_HAS_LAST_MODIFIED)
-                n.last_modified = int(req.query["ts"])
+            # every upload is stamped (needle.go:89-92 defaults to now):
+            # the volume's last-modified drives ec.encode's quietFor
+            # guard and TTL expiry, so an unstamped write would leave
+            # the volume looking idle
+            n.set_flag(FLAG_HAS_LAST_MODIFIED)
+            n.last_modified = int(req.query.get("ts") or time.time())
             if req.query.get("ttl"):
                 ttl = TTL.parse(req.query["ttl"])
                 if ttl.count:
@@ -710,6 +713,9 @@ class VolumeServer:
                     # a multipart filename must survive the (unwrapped)
                     # replica forward
                     params["name"] = name
+                # replicas must store the SAME timestamp, not their own
+                # clock (store_replicate.go forwards ts)
+                params.setdefault("ts", str(n.last_modified))
                 # forward the signed fid token so replicas pass their guard
                 from ..security import get_jwt
 

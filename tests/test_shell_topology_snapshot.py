@@ -65,7 +65,8 @@ class SnapshotEnv(CommandEnv):
                     "collection": self._topo["EcCollections"].get(vid, ""),
                     "shards": copy.deepcopy(shards)}
         if path == "/dir/status":
-            return {"Topology": self.topology()}
+            return {"Topology": self.topology(),
+                    "VolumeSizeLimitMB": 30000}
         if path == "/cluster/status":
             return {"Leader": "snapshot:9333", "Peers": [],
                     "IsLeader": True}
@@ -186,3 +187,34 @@ def test_volume_list_renders_snapshot(env):
     assert OVERLOADED in out and "dc2" in out
     out2 = COMMANDS["cluster.ps"](env, {})
     assert "volume" in out2.lower() or OVERLOADED in out2
+
+
+def test_ec_encode_candidate_selection(env):
+    """vidsToEcEncode (command_ec_encode.go:267-298): only full AND
+    quiet volumes of the collection are picked."""
+    import time
+
+    from seaweedfs_tpu.shell.ec_commands import _ec_encode_candidates
+
+    # craft three volumes in collection "enc": full+quiet (pick),
+    # full+hot (skip), small+quiet (skip)
+    node = env._topo["DataCenters"][0]["Racks"][0]["DataNodes"][0]
+    # derive from the served limit so a units bug in either side fails
+    limit_b = env.master_get("/dir/status")["VolumeSizeLimitMB"] << 20
+    now = time.time()
+    node["VolumeInfos"] = [
+        {"id": 201, "collection": "enc", "size": int(limit_b * 0.97),
+         "file_count": 10, "delete_count": 0,
+         "modified_at": now - 7200, "read_only": False},
+        {"id": 202, "collection": "enc", "size": int(limit_b * 0.97),
+         "file_count": 10, "delete_count": 0,
+         "modified_at": now - 60, "read_only": False},   # hot
+        {"id": 203, "collection": "enc", "size": int(limit_b * 0.10),
+         "file_count": 10, "delete_count": 0,
+         "modified_at": now - 7200, "read_only": False},  # small
+    ]
+    got = _ec_encode_candidates(env, "enc", 95.0, 3600.0)
+    assert got == [201]
+    # lowering the bar admits the small volume too
+    got = _ec_encode_candidates(env, "enc", 5.0, 3600.0)
+    assert got == [201, 203]

@@ -222,3 +222,31 @@ class TestTierEndpoint:
         finally:
             vs.stop()
             m.stop()
+
+
+def test_reopened_volume_reports_file_age_not_zero(tmp_path):
+    """A freshly-loaded volume's last-modified is the .dat file's mtime
+    (volume_loading.go:63), never 0 — a zero would read as "infinitely
+    quiet" to ec.encode's quietFor guard and TTL expiry after every
+    restart."""
+    import os
+    import time
+
+    from seaweedfs_tpu.storage.needle import Needle
+    from seaweedfs_tpu.storage.volume import Volume
+
+    v = Volume(str(tmp_path), "", 31)
+    v.write_needle(Needle(cookie=1, id=1, data=b"aging"))
+    v.close()
+    old = time.time() - 3000
+    os.utime(tmp_path / "31.dat", (old, old))
+    v2 = Volume(str(tmp_path), "", 31)
+    try:
+        assert abs(v2.last_modified_ts_seconds - old) < 5
+        # and a new write advances it again
+        n = Needle(cookie=1, id=2, data=b"fresh")
+        n.last_modified = int(time.time())
+        v2.write_needle(n)
+        assert v2.last_modified_ts_seconds >= int(time.time()) - 5
+    finally:
+        v2.close()
