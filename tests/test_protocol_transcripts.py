@@ -320,3 +320,32 @@ def test_kafka_record_batch_matches_hand_assembled_spec_frame():
     head = struct.pack(">ibI", -1, 2, crc32c(crc_span))
     expect = struct.pack(">qi", 0, len(head) + len(crc_span)) + head + crc_span
     assert record_batch([(key, value)], now_ms=ts) == expect
+
+
+def test_live_etcd():
+    addr = _reachable("WEED_TEST_ETCD", 2379)
+    if addr is None:
+        pytest.skip("no etcd at WEED_TEST_ETCD/localhost:2379")
+    from seaweedfs_tpu.filer.etcd_store import EtcdStore
+
+    _store_crud_cycle(EtcdStore.from_url(f"etcd://{addr[0]}:{addr[1]}"))
+
+
+def test_live_elastic():
+    addr = _reachable("WEED_TEST_ELASTIC", 9200)
+    if addr is None:
+        pytest.skip("no elasticsearch at WEED_TEST_ELASTIC/localhost:9200")
+    from seaweedfs_tpu.filer.elastic_store import ElasticStore
+
+    _store_crud_cycle(
+        ElasticStore.from_url(f"elastic://{addr[0]}:{addr[1]}"))
+
+
+def test_live_hbase():
+    addr = _reachable("WEED_TEST_HBASE", 16020)
+    if addr is None:
+        pytest.skip("no hbase regionserver at WEED_TEST_HBASE/localhost:16020")
+    from seaweedfs_tpu.filer.hbase_store import HbaseStore
+
+    _store_crud_cycle(
+        HbaseStore.from_url(f"hbase://{addr[0]}:{addr[1]}/seaweedfs"))
