@@ -16,6 +16,7 @@ defaultStore).
 from __future__ import annotations
 
 import copy
+import time
 from typing import Iterator, Optional
 
 from .entry import Entry
@@ -91,6 +92,72 @@ class PathTranslatingStore:
 
     def kv_scan(self, prefix: bytes):
         return self.store.kv_scan(prefix)
+
+
+class MeteredStore:
+    """Per-store-op Prometheus wrapper (FilerStoreWrapper's third role:
+    stats.FilerStoreCounter/Histogram labeled by store name + op)."""
+
+    _OPS = {"insert_entry": "insert", "update_entry": "update",
+            "find_entry": "find", "delete_entry": "delete",
+            "delete_folder_children": "deleteFolderChildren",
+            "list_directory_entries": "list", "kv_put": "kvPut",
+            "kv_get": "kvGet", "kv_delete": "kvDelete",
+            "kv_scan": "kvScan"}
+
+    def __init__(self, store, counter, histogram):
+        self._store = store
+        self.name = getattr(store, "name", "store")
+        self._counter = counter
+        self._histogram = histogram
+
+    def __getattr__(self, attr):
+        val = getattr(self._store, attr)
+        label = self._OPS.get(attr)
+        if label is None:
+            # non-op attribute (super_large_dirs, client, ...): pass
+            # through — but do NOT cache, it may be mutable state
+            return val
+        clock = time.perf_counter
+
+        if attr == "list_directory_entries":
+            def metered(*args, **kwargs):
+                self._counter.inc(self.name, label)
+                t0 = clock()
+                try:
+                    # bounded by `limit`: materialize so the histogram
+                    # times the store work, not generator construction
+                    return iter(list(val(*args, **kwargs)))
+                finally:
+                    self._histogram.observe(self.name, label,
+                                            clock() - t0)
+        elif attr == "kv_scan":
+            def metered(*args, **kwargs):
+                self._counter.inc(self.name, label)
+
+                def it():
+                    # unbounded scan: keep it lazy, observe at exhaust
+                    t0 = clock()
+                    try:
+                        yield from val(*args, **kwargs)
+                    finally:
+                        self._histogram.observe(self.name, label,
+                                                clock() - t0)
+
+                return it()
+        else:
+            def metered(*args, **kwargs):
+                self._counter.inc(self.name, label)
+                t0 = clock()
+                try:
+                    return val(*args, **kwargs)
+                finally:
+                    self._histogram.observe(self.name, label,
+                                            clock() - t0)
+
+        # cache: later calls bypass __getattr__ entirely
+        self.__dict__[attr] = metered
+        return metered
 
 
 class PathSpecificStoreRouter:

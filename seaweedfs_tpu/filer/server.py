@@ -87,15 +87,20 @@ class FilerServer:
         self.manifest_batch = MANIFEST_BATCH
         self.master_url = master_url
         self.client = WeedClient(master_url, keep_connected=True)
+        from ..stats import filer_metrics
+
+        self.metrics = filer_metrics()
+        if store is not None:
+            from .filerstore_path import MeteredStore
+
+            store = MeteredStore(store, self.metrics.store_counter,
+                                 self.metrics.store_histogram)
         self.filer = Filer(store, delete_chunks_fn=self._delete_chunks)
         self.filer.resolve_chunks_for_gc = self._resolve_for_gc
         self.host, self.port = host, port
         self.max_chunk_size = max_chunk_mb * 1024 * 1024
         self.collection = collection
         self.replication = replication
-        from ..stats import filer_metrics
-
-        self.metrics = filer_metrics()
         # hot-chunk cache (util/chunk_cache): mem tier always on, disk
         # tier when a cache dir is configured (-cacheDir)
         from ..utils.chunk_cache import TieredChunkCache

@@ -231,6 +231,15 @@ class VolumeServerMetrics(_ServerMetrics):
 class FilerMetrics(_ServerMetrics):
     def __init__(self, registry: Registry = REGISTRY):
         super().__init__("filer", registry)
+        # per-store-op collectors (stats.FilerStoreCounter/Histogram,
+        # observed by the MeteredStore wrapper around every backend)
+        self.store_counter = registry.counter(
+            "SeaweedFS_filerStore_request_total",
+            "Counter of filer store requests.", labels=("store", "type"))
+        self.store_histogram = registry.histogram(
+            "SeaweedFS_filerStore_request_seconds",
+            "Bucketed filer store request latency.",
+            labels=("store", "type"))
 
 
 class S3Metrics(_ServerMetrics):

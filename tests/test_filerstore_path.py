@@ -178,3 +178,58 @@ def test_root_mount_rejected_and_duplicate_replaced(tmp_path):
     router.insert_entry(_file("/m/x"))
     assert second.find_entry("/x") is not None
     assert first.find_entry("/x") is None
+
+
+def test_metered_store_counts_ops():
+    """MeteredStore (FilerStoreWrapper's per-store Prometheus role):
+    every op increments SeaweedFS_filerStore_request_total labeled by
+    store name + op, and latency lands in the histogram."""
+    from seaweedfs_tpu.filer.filerstore_path import MeteredStore
+    from seaweedfs_tpu.stats.metrics import Registry
+
+    reg = Registry()
+    c = reg.counter("t_total", labels=("store", "type"))
+    h = reg.histogram("t_seconds", labels=("store", "type"))
+    ms = MeteredStore(MemoryStore(), c, h)
+    ms.insert_entry(_file("/m/a"))
+    ms.find_entry("/m/a")
+    ms.find_entry("/m/missing")
+    list(ms.list_directory_entries("/m"))
+    ms.delete_entry("/m/a")
+    assert c.value("memory", "insert") == 1
+    assert c.value("memory", "find") == 2
+    assert c.value("memory", "list") == 1
+    assert c.value("memory", "delete") == 1
+    # non-op attributes pass through unmetered
+    assert ms.name == "memory"
+
+
+def test_filer_server_meters_store_ops(tmp_path):
+    """The HTTP filer wraps its store: /metrics shows per-op counts."""
+    import time
+
+    from seaweedfs_tpu.filer.server import FilerServer
+    from seaweedfs_tpu.master.server import MasterServer
+    from seaweedfs_tpu.utils.httpd import http_bytes
+    from seaweedfs_tpu.volume_server.server import VolumeServer
+    from tests.conftest import free_port
+
+    master = MasterServer(port=free_port(), pulse_seconds=0.3).start()
+    d = tmp_path / "v"
+    d.mkdir()
+    vs = VolumeServer([str(d)], master.url, port=free_port(),
+                      pulse_seconds=0.3).start()
+    deadline = time.time() + 5
+    while time.time() < deadline and not master.topo.all_nodes():
+        time.sleep(0.05)
+    filer = FilerServer(master.url, MemoryStore(), port=free_port()).start()
+    try:
+        http_bytes("PUT", f"http://{filer.url}/mm/a.txt", b"x")
+        st, body, _ = http_bytes("GET", f"http://{filer.url}/metrics")
+        assert st == 200
+        assert b"SeaweedFS_filerStore_request_total" in body
+        assert b'store="memory",type="insert"' in body
+    finally:
+        filer.stop()
+        vs.stop()
+        master.stop()
