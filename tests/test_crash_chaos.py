@@ -159,11 +159,13 @@ def test_kill9_midwrite_recovers(tmp_path, dataplane):
                 p.kill()
 
 
-@pytest.mark.parametrize("dbname", ["store.lsm", "meta.db"])
+@pytest.mark.parametrize("dbname", ["store.lsm", "meta.db", "pathstore"])
 def test_kill9_filer_midwrite_recovers(tmp_path, dbname):
     """SIGKILL the FILER mid-write (LSM WAL replay / sqlite journal):
     on restart every acknowledged file must read back byte-exact or be
-    cleanly absent — never corrupt — and the filer keeps serving."""
+    cleanly absent — never corrupt — and the filer keeps serving.  The
+    "pathstore" case mounts the chaos directory on a SEPARATE LSM store
+    (-pathStore): the router must not weaken crash recovery."""
     env = dict(os.environ, PYTHONPATH="/root/repo")
     mport, vport, fport = free_port(), free_port(), free_port()
     procs = []
@@ -181,8 +183,13 @@ def test_kill9_filer_midwrite_recovers(tmp_path, dbname):
            "-mserver", f"127.0.0.1:{mport}"])
 
     def spawn_filer():
+        if dbname == "pathstore":
+            db_args = ["-db", str(tmp_path / "main.db"), "-pathStore",
+                       f"/chaos={tmp_path / 'hot.lsm'}"]
+        else:
+            db_args = ["-db", str(tmp_path / dbname)]
         p = spawn(["filer", "-master", f"127.0.0.1:{mport}",
-                   "-port", str(fport), "-db", str(tmp_path / dbname)])
+                   "-port", str(fport)] + db_args)
         deadline = time.time() + 15
         while time.time() < deadline:
             try:
