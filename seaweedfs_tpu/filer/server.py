@@ -688,16 +688,34 @@ class FilerServer:
                 cache_remote_object(self, entry)
                 entry = self.filer.find_entry(path)
             file_size = _effective_size(entry)
+            is_head = req.handler.command == "HEAD"
+            mime = entry.attr.mime or "application/octet-stream"
+            wants_resize = (not is_head and (mime or "").startswith("image/")
+                            and (req.query.get("width")
+                                 or req.query.get("height")))
+            if wants_resize:
+                # resize FIRST, then apply the range over the resized
+                # representation — a 206 must be a slice of what a 200
+                # of the same URL serves (same order as the volume
+                # server; filer_server_handlers_read.go:186)
+                from ..images import resized_from_query
+
+                body_all, mime = resized_from_query(
+                    self.read_chunks(entry, 0, file_size), mime, req.query)
+                file_size = len(body_all)
             rng = parse_range(req.headers.get("Range", ""), file_size)
             if rng == UNSATISFIABLE_RANGE:
                 return Response(raw=b"", status=416,
                                 headers={"Content-Range": f"bytes */{file_size}"})
             offset, size = rng if rng else (0, file_size)
             status = 206 if rng else 200
-            is_head = req.handler.command == "HEAD"
-            body = b"" if is_head else self.read_chunks(entry, offset, size)
+            if wants_resize:
+                body = body_all[offset:offset + size]
+            else:
+                body = b"" if is_head else self.read_chunks(
+                    entry, offset, size)
             headers = {
-                "Content-Type": entry.attr.mime or "application/octet-stream",
+                "Content-Type": mime,
                 "ETag": f'"{etag_of_chunks(entry.chunks)}"' if entry.chunks else '""',
                 "Last-Modified": time.strftime(
                     "%a, %d %b %Y %H:%M:%S GMT", time.gmtime(entry.attr.mtime)),

@@ -8,6 +8,7 @@ image codec, so the volume server serves originals when unavailable
 non-image content).
 """
 
-from .resizing import resized, resizing_available
+from .resizing import (resized, resized_from_query,
+                       resizing_available)
 
-__all__ = ["resized", "resizing_available"]
+__all__ = ["resized", "resized_from_query", "resizing_available"]

@@ -86,3 +86,36 @@ def resized(data: bytes, mime: str, width: Optional[int],
            "image/gif": "GIF"}.get(mime, "PNG")
     img.save(out, format=fmt)
     return out.getvalue(), img.size[0], img.size[1]
+
+
+_FMT_MIME = {"JPEG": "image/jpeg", "PNG": "image/png", "GIF": "image/gif"}
+
+
+def resized_from_query(data: bytes, mime: str, query: dict
+                       ) -> Tuple[bytes, str]:
+    """-> (body, mime) for a read handler's ?width/?height/?mode hook,
+    shared by the volume and filer servers.  Any resize failure —
+    including a save-format mismatch like RGBA data labeled image/jpeg —
+    falls back to the original bytes, and the returned mime names the
+    bytes actually served (a PNG re-encode must not ride out labeled
+    image/webp)."""
+
+    def _dim(name: str) -> Optional[int]:
+        try:
+            return int(query.get(name) or 0) or None
+        except (TypeError, ValueError):
+            return None  # bad value: serve the original
+
+    width, height = _dim("width"), _dim("height")
+    if not (width or height):
+        return data, mime
+    try:
+        out, w, h = resized(data, mime, width, height,
+                            query.get("mode", ""))
+    except Exception:
+        return data, mime
+    if out is data or not w:
+        return data, mime
+    fmt = {"image/jpeg": "JPEG", "image/png": "PNG",
+           "image/gif": "GIF"}.get(mime, "PNG")
+    return out, _FMT_MIME[fmt]

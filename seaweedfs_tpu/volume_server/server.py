@@ -614,17 +614,10 @@ class VolumeServer:
             # ?width/?height hook -> images/resizing.go; no-op when
             # Pillow is absent or the content is not an image)
             if wants_resize:
-                from ..images import resized
+                from ..images import resized_from_query
 
-                def _dim(name: str):
-                    try:
-                        return int(req.query.get(name) or 0) or None
-                    except ValueError:
-                        return None  # bad value: serve the original
-
-                body, _, _ = resized(body, ctype, _dim("width"),
-                                     _dim("height"),
-                                     req.query.get("mode", ""))
+                body, new_mime = resized_from_query(body, ctype, req.query)
+                headers["Content-Type"] = new_mime
             if rng_hdr and "Content-Encoding" not in headers:
                 from ..utils.httpd import UNSATISFIABLE_RANGE, parse_range
 

@@ -304,3 +304,28 @@ def test_col_delete_includes_ec_volumes(cluster):
     for vs in servers:
         for loc in vs.store.locations:
             assert not _glob.glob(f"{loc.directory}/*.ec[0-9][0-9]")
+
+
+def test_volume_server_image_resize(cluster):
+    """?width on a volume GET serves the resized image with the mime of
+    the bytes actually sent (volume_server_handlers_read.go resize
+    hook via the shared resized_from_query helper)."""
+    import io
+
+    from seaweedfs_tpu.images import resizing_available
+    if not resizing_available():
+        pytest.skip("no pillow")
+    from PIL import Image
+
+    master, _ = cluster
+    a = http_json("GET", f"http://{master.url}/dir/assign")
+    buf = io.BytesIO()
+    Image.new("RGB", (40, 20), (0, 99, 0)).save(buf, format="PNG")
+    png = buf.getvalue()
+    st, _, _ = http_bytes("POST", f"http://{a['url']}/{a['fid']}", png,
+                          headers={"Content-Type": "image/png"})
+    assert st == 201
+    st, body, hdrs = http_bytes(
+        "GET", f"http://{a['url']}/{a['fid']}?width=10")
+    assert st == 200 and hdrs["Content-Type"] == "image/png"
+    assert Image.open(io.BytesIO(body)).size == (10, 5)

@@ -416,3 +416,47 @@ def test_filer_tagging_case_canonicalization(stack):
     assert st == 202
     e = filer.filer.find_entry("/tagged/c.txt")
     assert not any(k.startswith("Seaweed-") for k in e.extended)
+
+
+def test_filer_image_resize_on_get(stack):
+    """?width/?height on a full filer GET serves a resized image, like
+    the volume server (filer_server_handlers_read.go:186)."""
+    import io
+
+    import pytest as _pytest
+
+    from seaweedfs_tpu.images import resizing_available
+    if not resizing_available():
+        _pytest.skip("no pillow")
+    from PIL import Image
+
+    _, _, filer = stack
+    base = f"http://{filer.url}"
+    buf = io.BytesIO()
+    Image.new("RGB", (64, 32), (200, 10, 10)).save(buf, format="PNG")
+    png = buf.getvalue()
+    http_bytes("PUT", base + "/img/red.png", png,
+               headers={"Content-Type": "image/png"})
+    st, body, _ = http_bytes("GET", base + "/img/red.png?width=16")
+    assert st == 200
+    got = Image.open(io.BytesIO(body))
+    assert got.size == (16, 8)  # aspect kept
+    # no params -> original bytes
+    st, body, _ = http_bytes("GET", base + "/img/red.png")
+    assert body == png
+    # a 206 on a resize URL is a slice of the RESIZED representation
+    # (resize first, then range — a resumed download must stitch)
+    st, full, hdrs = http_bytes("GET", base + "/img/red.png?width=16")
+    assert hdrs.get("Content-Type") == "image/png"
+    st, part, _ = http_bytes("GET", base + "/img/red.png?width=16",
+                             headers={"Range": "bytes=0-3"})
+    assert st == 206 and part == full[:4]
+    # a resize failure serves the ORIGINAL bytes, not a 500: RGBA data
+    # labeled image/jpeg cannot be saved as JPEG
+    buf = io.BytesIO()
+    Image.new("RGBA", (8, 8), (1, 2, 3, 4)).save(buf, format="PNG")
+    rgba = buf.getvalue()
+    http_bytes("PUT", base + "/img/fake.jpg", rgba,
+               headers={"Content-Type": "image/jpeg"})
+    st, body, _ = http_bytes("GET", base + "/img/fake.jpg?width=4")
+    assert st == 200 and body == rgba
