@@ -159,3 +159,47 @@ def test_fix_preserves_idx_on_malformed_dat(tmp_path):
     assert r.returncode != 0
     assert idx.read_bytes() == original  # untouched
     assert not (tmp_path / f"{vid}.idx_fix").exists()
+
+
+def test_filer_copy_include_concurrency_checksize(tmp_path):
+    """filer.copy parity flags: -include glob, -c workers, -check.size
+    skip-unchanged (command/filer_copy.go:54-62)."""
+    import time
+
+    from seaweedfs_tpu.filer.filer_store import MemoryStore
+    from seaweedfs_tpu.filer.server import FilerServer
+    from seaweedfs_tpu.master.server import MasterServer
+    from seaweedfs_tpu.volume_server.server import VolumeServer
+    from tests.conftest import free_port
+
+    master = vs = filer = None
+    try:
+        master = MasterServer(port=free_port(), pulse_seconds=0.3).start()
+        d = tmp_path / "v"
+        d.mkdir()
+        vs = VolumeServer([str(d)], master.url, port=free_port(),
+                          pulse_seconds=0.3).start()
+        deadline = time.time() + 5
+        while time.time() < deadline and not master.topo.all_nodes():
+            time.sleep(0.05)
+        filer = FilerServer(master.url, MemoryStore(),
+                            port=free_port()).start()
+        src = tmp_path / "tree"
+        src.mkdir()
+        (src / "a.pdf").write_bytes(b"pdf-a")
+        (src / "b.txt").write_bytes(b"txt-b")
+        (src / "c.pdf").write_bytes(b"pdf-c")
+        r = _run("filer.copy", "-filer", filer.url, "-include", "*.pdf",
+                 "-c", "2", str(src), "/docs")
+        assert r.returncode == 0, r.stderr
+        names = [e.name for e in filer.filer.list_directory("/docs/tree")]
+        assert sorted(names) == ["a.pdf", "c.pdf"]  # b.txt filtered
+        # -check.size: second run skips unchanged files
+        r = _run("filer.copy", "-filer", filer.url, "-include", "*.pdf",
+                 "-check.size", str(src), "/docs")
+        assert r.returncode == 0, r.stderr
+        assert r.stdout.count("same size, skipped") == 2
+    finally:
+        for srv in (filer, vs, master):
+            if srv is not None:
+                srv.stop()

@@ -547,22 +547,42 @@ def cmd_filer_cat(args) -> None:
 
 def cmd_filer_copy(args) -> None:
     """Upload local files/directories into the filer
-    (command/filer_copy.go)."""
+    (command/filer_copy.go): -include glob filter, -c concurrency,
+    -check.size skip-unchanged, per-file collection/ttl."""
+    import concurrent.futures
+    import fnmatch
     import os
     import urllib.parse
 
     from seaweedfs_tpu.utils.httpd import http_bytes
 
-    def put(local: str, remote: str) -> None:
+    include = getattr(args, "include", "") or ""
+    check_size = getattr(args, "check_size", False)
+    q = {}
+    if getattr(args, "collection", ""):
+        q["collection"] = args.collection
+    if getattr(args, "ttl", ""):
+        q["ttl"] = args.ttl
+    qs = ("?" + urllib.parse.urlencode(q)) if q else ""
+
+    def put(local: str, remote: str) -> str:
         with open(local, "rb") as f:
             data = f.read()
-        status, body, _ = http_bytes(
-            "POST", f"http://{args.filer}" + urllib.parse.quote(remote),
-            data)
+        url = f"http://{args.filer}" + urllib.parse.quote(remote)
+        if check_size:
+            # copy only when the target size differs (filer_copy.go
+            # -check.size): a HEAD is one round trip vs re-uploading
+            st, _, hdrs = http_bytes("HEAD", url)
+            length = next((v for k, v in hdrs.items()
+                           if k.lower() == "content-length"), None)
+            if st == 200 and length == str(len(data)):
+                return f"{remote}: same size, skipped"
+        status, body, _ = http_bytes("POST", url + qs, data)
         if status not in (200, 201):
             raise SystemExit(f"{remote}: HTTP {status}")
-        print(f"{local} -> {remote} ({len(data)} bytes)")
+        return f"{local} -> {remote} ({len(data)} bytes)"
 
+    jobs: list[tuple[str, str]] = []
     dest = args.dest.rstrip("/")
     for src in args.src:
         if os.path.isdir(src):
@@ -570,12 +590,28 @@ def cmd_filer_copy(args) -> None:
             for dirpath, _, files in os.walk(src):
                 rel = os.path.relpath(dirpath, src)
                 for name in files:
+                    if include and not fnmatch.fnmatch(name, include):
+                        continue
                     remote = f"{dest}/{base}" + (
                         f"/{rel}" if rel != "." else "") + f"/{name}"
-                    put(os.path.join(dirpath, name),
-                        remote.replace("//", "/"))
+                    jobs.append((os.path.join(dirpath, name),
+                                 remote.replace("//", "/")))
         else:
-            put(src, f"{dest}/{os.path.basename(src)}")
+            if include and not fnmatch.fnmatch(os.path.basename(src),
+                                               include):
+                continue
+            jobs.append((src, f"{dest}/{os.path.basename(src)}"))
+    workers = max(1, getattr(args, "c", 8))
+    with concurrent.futures.ThreadPoolExecutor(workers) as ex:
+        futs = [ex.submit(put, *j) for j in jobs]
+        try:
+            for f in futs:
+                print(f.result())
+        except BaseException:
+            # fail fast: drop queued uploads, keep the printed record of
+            # what DID land accurate
+            ex.shutdown(wait=False, cancel_futures=True)
+            raise
 
 
 def cmd_filer_meta_tail(args) -> None:
@@ -1177,6 +1213,15 @@ def main(argv=None) -> None:
 
     fcp = sub.add_parser("filer.copy")
     fcp.add_argument("-filer", default="127.0.0.1:8888")
+    fcp.add_argument("-include", default="",
+                    help="glob of files to copy, e.g. *.pdf")
+    fcp.add_argument("-collection", default="")
+    fcp.add_argument("-ttl", default="")
+    fcp.add_argument("-c", type=int, default=8,
+                    help="concurrent file uploads")
+    fcp.add_argument("-check.size", dest="check_size",
+                    action="store_true",
+                    help="skip files whose target size already matches")
     fcp.add_argument("src", nargs="+")
     fcp.add_argument("dest", help="filer destination directory")
     fcp.set_defaults(fn=cmd_filer_copy)
