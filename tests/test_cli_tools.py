@@ -203,3 +203,44 @@ def test_filer_copy_include_concurrency_checksize(tmp_path):
         for srv in (filer, vs, master):
             if srv is not None:
                 srv.stop()
+
+
+def test_upload_dir_include_ttl(tmp_path):
+    """weed upload -dir -include -ttl (command/upload.go:39-45)."""
+    import json as _json
+    import time
+
+    from seaweedfs_tpu.master.server import MasterServer
+    from seaweedfs_tpu.volume_server.server import VolumeServer
+    from tests.conftest import free_port
+
+    master = vs = None
+    try:
+        master = MasterServer(port=free_port(), pulse_seconds=0.3).start()
+        d = tmp_path / "v"
+        d.mkdir()
+        vs = VolumeServer([str(d)], master.url, port=free_port(),
+                          pulse_seconds=0.3).start()
+        deadline = time.time() + 5
+        while time.time() < deadline and not master.topo.all_nodes():
+            time.sleep(0.05)
+        src = tmp_path / "up"
+        src.mkdir()
+        (src / "x.log").write_bytes(b"log")
+        (src / "y.dat").write_bytes(b"dat")
+        r = _run("upload", "-master", master.url, "-dir", str(src),
+                 "-include", "*.log", "-ttl", "1h")
+        assert r.returncode == 0, r.stderr
+        lines = [_json.loads(line) for line in r.stdout.splitlines()]
+        assert len(lines) == 1 and lines[0]["file"].endswith("x.log")
+        # the fid serves the bytes back
+        from seaweedfs_tpu.client.operation import WeedClient
+
+        assert WeedClient(master.url).download(lines[0]["fid"]) == b"log"
+        # no inputs at all is a clean error
+        r = _run("upload", "-master", master.url)
+        assert r.returncode != 0
+    finally:
+        for srv in (vs, master):
+            if srv is not None:
+                srv.stop()

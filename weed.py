@@ -587,15 +587,9 @@ def cmd_filer_copy(args) -> None:
     for src in args.src:
         if os.path.isdir(src):
             base = os.path.basename(src.rstrip("/"))
-            for dirpath, _, files in os.walk(src):
-                rel = os.path.relpath(dirpath, src)
-                for name in files:
-                    if include and not fnmatch.fnmatch(name, include):
-                        continue
-                    remote = f"{dest}/{base}" + (
-                        f"/{rel}" if rel != "." else "") + f"/{name}"
-                    jobs.append((os.path.join(dirpath, name),
-                                 remote.replace("//", "/")))
+            for local in _walk_matching_files(src, include):
+                rel = os.path.relpath(local, src)
+                jobs.append((local, f"{dest}/{base}/{rel}"))
         else:
             if include and not fnmatch.fnmatch(os.path.basename(src),
                                                include):
@@ -887,15 +881,41 @@ def cmd_shell(args) -> None:
         repl(args.master, args.filer)
 
 
+def _walk_matching_files(root: str, include: str):
+    """Recursive file walk with an optional basename glob — the -include
+    semantics shared by `weed upload -dir` and `weed filer.copy`."""
+    import fnmatch
+    import os
+
+    for dirpath, _, files in os.walk(root):
+        for name in sorted(files):
+            if include and not fnmatch.fnmatch(name, include):
+                continue
+            yield os.path.join(dirpath, name)
+
+
 def cmd_upload(args) -> None:
+    """weed upload (command/upload.go): files, or -dir recursively with
+    an -include glob; each upload may carry collection/replication/ttl."""
+    import os
+
     from seaweedfs_tpu.client.operation import WeedClient
 
     client = WeedClient(args.master)
-    for path in args.files:
+    paths = list(args.files)
+    if getattr(args, "dir", ""):
+        if not os.path.isdir(args.dir):
+            raise SystemExit(f"-dir {args.dir!r} is not a directory")
+        paths.extend(_walk_matching_files(
+            args.dir, getattr(args, "include", "") or ""))
+    if not paths:
+        raise SystemExit("nothing to upload: pass files or -dir")
+    for path in paths:
         with open(path, "rb") as f:
             fid = client.upload(f.read(), name=path.split("/")[-1],
                                 collection=args.collection,
-                                replication=args.replication)
+                                replication=args.replication,
+                                ttl=getattr(args, "ttl", ""))
         print(json.dumps({"file": path, "fid": fid}))
 
 
@@ -1306,7 +1326,13 @@ def main(argv=None) -> None:
     up.add_argument("-master", default="127.0.0.1:9333")
     up.add_argument("-collection", default="")
     up.add_argument("-replication", default="")
-    up.add_argument("files", nargs="+")
+    up.add_argument("-ttl", default="",
+                    help="time to live, e.g. 1m, 1h, 1d, 1M, 1y")
+    up.add_argument("-dir", default="",
+                    help="upload the whole folder recursively")
+    up.add_argument("-include", default="",
+                    help="glob of files to upload, works with -dir")
+    up.add_argument("files", nargs="*")
     up.set_defaults(fn=cmd_upload)
 
     dl = sub.add_parser("download")
