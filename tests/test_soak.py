@@ -25,7 +25,9 @@ from seaweedfs_tpu.utils.httpd import HttpError, http_json
 from seaweedfs_tpu.volume_server.server import VolumeServer
 from tests.conftest import free_port
 
-SOAK_SECONDS = 8.0
+# env-overridable so an extended soak (SOAK_SECONDS=120 pytest
+# tests/test_soak.py) needs no edit; CI default stays quick
+SOAK_SECONDS = float(os.environ.get("SOAK_SECONDS", "8.0"))
 
 
 @pytest.fixture(autouse=True)
