@@ -604,6 +604,9 @@ class FilerServer:
             reference returns KvGetResponse{} for ErrKvNotFound."""
             import base64
 
+            err = self.guard.check_filer_jwt(req)
+            if err:
+                raise HttpError(401, err)
             # '+' in query values parses as a space; undo before decode
             key = base64.b64decode(req.query["key"].replace(" ", "+"))
             value = self.filer.store.kv_get(key)
@@ -690,18 +693,23 @@ class FilerServer:
             file_size = _effective_size(entry)
             is_head = req.handler.command == "HEAD"
             mime = entry.attr.mime or "application/octet-stream"
-            wants_resize = (not is_head and (mime or "").startswith("image/")
+            resize_asked = ((mime or "").startswith("image/")
                             and (req.query.get("width")
                                  or req.query.get("height")))
+            wants_resize = resize_asked
+            resized_real = False
             if wants_resize:
                 # resize FIRST, then apply the range over the resized
                 # representation — a 206 must be a slice of what a 200
                 # of the same URL serves (same order as the volume
-                # server; filer_server_handlers_read.go:186)
+                # server; filer_server_handlers_read.go:186).  HEAD pays
+                # for the resize too: its Content-Length/Content-Range
+                # must describe the same entity the GET serves
                 from ..images import resized_from_query
 
-                body_all, mime = resized_from_query(
-                    self.read_chunks(entry, 0, file_size), mime, req.query)
+                original = self.read_chunks(entry, 0, file_size)
+                body_all, mime = resized_from_query(original, mime, req.query)
+                resized_real = body_all is not original
                 file_size = len(body_all)
             rng = parse_range(req.headers.get("Range", ""), file_size)
             if rng == UNSATISFIABLE_RANGE:
@@ -710,13 +718,23 @@ class FilerServer:
             offset, size = rng if rng else (0, file_size)
             status = 206 if rng else 200
             if wants_resize:
-                body = body_all[offset:offset + size]
+                body = b"" if is_head else body_all[offset:offset + size]
             else:
                 body = b"" if is_head else self.read_chunks(
                     entry, offset, size)
+            etag = etag_of_chunks(entry.chunks) if entry.chunks else ""
+            if resized_real:
+                # a resized representation must not share the original's
+                # cache key, or ETag-keyed caches conflate the two.  Only
+                # when a resize actually happened: bad params / no-Pillow
+                # fall back to the original bytes, which must keep the
+                # original ETag or If-None-Match revalidation breaks
+                etag += ("-%sx%s-%s" % (req.query.get("width", ""),
+                                        req.query.get("height", ""),
+                                        req.query.get("mode", "")))
             headers = {
                 "Content-Type": mime,
-                "ETag": f'"{etag_of_chunks(entry.chunks)}"' if entry.chunks else '""',
+                "ETag": f'"{etag}"',
                 "Last-Modified": time.strftime(
                     "%a, %d %b %Y %H:%M:%S GMT", time.gmtime(entry.attr.mtime)),
                 "Accept-Ranges": "bytes",

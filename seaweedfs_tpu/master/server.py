@@ -7,8 +7,9 @@ HTTP equivalent of weed/server/master_server*.go + master_grpc_server*.go:
   POST /heartbeat      — volume-server full sync (volumes + EC shards)
   GET  /vol/grow       — force growth
   GET  /vol/vacuum     — trigger cluster vacuum
-  GET  /cluster/status — leader info (single-master for now; the raft seam
-                         is MasterServer.is_leader/leader_url)
+  GET  /cluster/status — leader info (raft trio election/failover lives in
+                         master/consensus.py; MasterServer.is_leader/
+                         leader_url reflect the elected state)
   POST /admin/lease, /admin/release — exclusive shell lock
                          (master_grpc_server_admin.go:73-150)
 """

@@ -258,9 +258,9 @@ extern "C" void gf_matmul(const uint8_t *mat, int rows, int k,
 // out_rows[r][0..n) = mat[R, K] . in_rows[K][0..n) over GF(2^8), with every
 // row an independent pointer.  This is the zero-copy entry point: callers
 // hand pointers straight into mmap'd shard/volume files, so the matmul IS
-// the read and the write — no staging buffers, no user-space copies.  Same
-// 64KB n-tiling as gf_matmul so the K input tiles stay L2-resident across
-// all R output rows.
+// the read and the write — no staging buffers, no user-space copies.
+// Dispatches like gf_matmul: single-pass GFNI column-major kernel when the
+// CPU has it (R<=8), else the 16KB row-tiled AVX2/scalar fallback.
 extern "C" void gf_matmul_ptrs(const uint8_t *mat, int rows, int k,
                                const uint8_t *const *in_rows,
                                uint8_t *const *out_rows, long n) {

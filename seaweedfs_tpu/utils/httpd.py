@@ -207,20 +207,37 @@ class Router:
                 # client is still mid-upload resets the connection and the
                 # client never sees the (often 4xx) status. Discard in
                 # bounded chunks — never buffer a rejected upload.
-                try:
-                    if req._body is None:
-                        left = int(handler.headers.get("Content-Length") or 0)
-                        while left > 0:
-                            n = len(handler.rfile.read(min(left, 1 << 16)) or b"")
-                            if n == 0:
-                                break
-                            left -= n
-                        req._body = b""
-                except Exception:
-                    pass
+                if req._body is None:
+                    self._drain_body(handler)
+                    req._body = b""
                 self._send(handler, resp)
                 return
+        # 404 fallthrough: the body was never read, so drain it too or the
+        # keep-alive loop would parse the leftover bytes as the next request
+        # line (request-smuggling-shaped desync).
+        self._drain_body(handler)
         self._send(handler, Response({"error": f"no route {method} {path}"}, status=404))
+
+    @staticmethod
+    def _drain_body(handler: BaseHTTPRequestHandler) -> None:
+        try:
+            te = (handler.headers.get("Transfer-Encoding") or "").lower()
+            if "chunked" in te:
+                # Request.body only understands Content-Length; a chunked body
+                # can't be framed, so the connection must not be reused.
+                handler.close_connection = True
+                return
+            left = int(handler.headers.get("Content-Length") or 0)
+            while left > 0:
+                n = len(handler.rfile.read(min(left, 1 << 16)) or b"")
+                if n == 0:
+                    break
+                left -= n
+        except Exception:
+            try:
+                handler.close_connection = True
+            except Exception:
+                pass
 
     @staticmethod
     def _send(handler: BaseHTTPRequestHandler, resp: Response) -> None:
@@ -467,6 +484,13 @@ class FastHTTPServer:
                 line = rfile.readline(1 << 16)
                 if not line or line in (b"\r\n", b"\n"):
                     break
+                if not line.endswith(b"\n"):
+                    # a 64KB+ request line would otherwise be split and
+                    # parsed as two garbage requests
+                    conn.sendall(b"HTTP/1.1 414 URI Too Long\r\n"
+                                 b"Content-Length: 0\r\n"
+                                 b"Connection: close\r\n\r\n")
+                    break
                 try:
                     method, _, rest = line.rstrip(b"\r\n").partition(b" ")
                     target, _, version = rest.rpartition(b" ")
@@ -495,6 +519,14 @@ class FastHTTPServer:
                                  b"Connection: close\r\n\r\n")
                     break
                 h.headers = CIHeaders(pairs)
+                if "chunked" in (h.headers.get("Transfer-Encoding") or "").lower():
+                    # Request.body only frames Content-Length bodies; a
+                    # chunked body can't be skipped safely, so refuse and
+                    # close rather than desync the keep-alive stream
+                    conn.sendall(b"HTTP/1.1 501 Not Implemented\r\n"
+                                 b"Content-Length: 0\r\n"
+                                 b"Connection: close\r\n\r\n")
+                    break
                 # HTTP/1.1 defaults to keep-alive; 1.0 to close
                 conn_hdr = (h.headers.get("Connection") or "").lower()
                 h.close_connection = (

@@ -460,3 +460,19 @@ def test_filer_image_resize_on_get(stack):
                headers={"Content-Type": "image/jpeg"})
     st, body, _ = http_bytes("GET", base + "/img/fake.jpg?width=4")
     assert st == 200 and body == rgba
+    # a resized representation must not share the original's ETag, and
+    # a HEAD with resize params must describe the RESIZED entity (same
+    # ETag, resized Content-Length) — HEAD and GET of one URL must agree
+    _, rs_body, h_rs = http_bytes("GET", base + "/img/red.png?width=16")
+    _, _, h_orig = http_bytes("GET", base + "/img/red.png")
+    assert h_orig.get("ETag") != h_rs.get("ETag")
+    _, _, h_rs2 = http_bytes("GET", base + "/img/red.png?width=8")
+    assert h_rs.get("ETag") != h_rs2.get("ETag")
+    st, _, h_head = http_bytes("HEAD", base + "/img/red.png?width=16")
+    assert st == 200
+    assert h_head.get("ETag") == h_rs.get("ETag")
+    assert h_head.get("Content-Length") == str(len(rs_body))
+    # bad resize params fall back to the original bytes and must keep
+    # the original ETag (identical representation, one cache key)
+    _, fb_body, h_fb = http_bytes("GET", base + "/img/red.png?width=abc")
+    assert fb_body == png and h_fb.get("ETag") == h_orig.get("ETag")

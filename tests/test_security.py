@@ -146,6 +146,40 @@ class TestClusterJwtEnforcement:
             c.download(fid)
 
 
+class TestSecuredFilerKv:
+    def test_kv_get_requires_filer_jwt(self, tmp_path):
+        """GET /api/kv holds filer-global state (replication signatures,
+        subscriber cursors) — it must be guarded like POST /api/kv when
+        jwt signing is on."""
+        import base64
+
+        from seaweedfs_tpu.filer.filer_store import SqliteStore
+        from seaweedfs_tpu.filer.server import FilerServer
+        from seaweedfs_tpu.master.server import MasterServer
+        from seaweedfs_tpu.security.jwt import gen_jwt_for_filer_server
+        from seaweedfs_tpu.utils.httpd import http_bytes
+        from tests.conftest import free_port
+
+        m = MasterServer(port=free_port()).start()
+        f = FilerServer(m.url, SqliteStore(str(tmp_path / "f.db")),
+                        port=free_port(),
+                        guard=Guard(signing_key="fkey")).start()
+        try:
+            f.filer.store.kv_put(b"cluster/owner", b"me")
+            k = base64.b64encode(b"cluster/owner").decode()
+            status, _, _ = http_bytes(
+                "GET", f"http://{f.url}/api/kv?key={k}")
+            assert status == 401
+            tok = gen_jwt_for_filer_server("fkey", 30)
+            status, body, _ = http_bytes(
+                "GET", f"http://{f.url}/api/kv?key={k}",
+                headers={"Authorization": f"BEARER {tok}"})
+            assert status == 200 and b"found" in body
+        finally:
+            f.stop()
+            m.stop()
+
+
 class TestSecuredReads:
     def test_read_key_and_lookup_auth(self, tmp_path):
         """With jwt.signing.read set, bare GETs fail and the master's

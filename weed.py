@@ -518,6 +518,15 @@ _weed_complete() {{
 complete -F _weed_complete weed.py weed""")
 
 
+def cmd_autocomplete_uninstall(args) -> None:
+    """Remove the bash completion binding (command/autocomplete.go:57
+    uninstallAutoCompletion analog).  Our installer only ever prints to
+    stdout — it never edits shell rc files — so uninstall is the same
+    shape: `source <(python weed.py autocomplete.uninstall)` unbinds
+    what `source <(python weed.py autocomplete)` bound."""
+    print("complete -r weed.py 2>/dev/null\ncomplete -r weed 2>/dev/null")
+
+
 def cmd_scaffold(args) -> None:
     """Emit commented config templates (command/scaffold.go)."""
     conf = _SCAFFOLDS.get(args.config)
@@ -1315,6 +1324,9 @@ def main(argv=None) -> None:
     # bind the live choices dict: it reflects every parser registered
     # by dispatch time, with no reliance on the module-global side set
     ac.set_defaults(fn=lambda a: cmd_autocomplete(a, list(sub.choices)))
+
+    acu = sub.add_parser("autocomplete.uninstall")
+    acu.set_defaults(fn=cmd_autocomplete_uninstall)
 
     sh = sub.add_parser("shell")
     sh.add_argument("-master", default="127.0.0.1:9333")
