@@ -46,6 +46,23 @@ from .layout import (
 )
 
 
+def _fallocate(fd: int, size: int) -> None:
+    """Reserve blocks for an output that will be written through an
+    mmap'd store: a sparse file's blocks are otherwise allocated at
+    fault time, where ENOSPC arrives as an uncatchable SIGBUS instead
+    of an OSError.  tmpfs/ext4/xfs all support it; where unsupported
+    (EOPNOTSUPP) fall back to truncate and accept the pwrite-era risk."""
+    try:
+        os.posix_fallocate(fd, 0, size)
+    except OSError as e:
+        import errno
+
+        if e.errno in (errno.EOPNOTSUPP, errno.EINVAL):
+            os.ftruncate(fd, size)
+        else:
+            raise
+
+
 def _shard_size(file_size: int, k: int, large: int, small: int) -> int:
     """Bytes per shard for a file striped per encodeDatFile's row rules
     (ec_encoder.go:194-231): whole large rows while more than k*large
@@ -300,8 +317,8 @@ class StreamingEncoder:
     # --- encode -----------------------------------------------------------
     def _reset_stats(self) -> dict:
         self.stats = {"dispatches": 0, "fill_s": 0.0, "dispatch_s": 0.0,
-                      "write_s": 0.0, "drain_wait_s": 0.0, "wall_s": 0.0,
-                      "bytes_in": 0}
+                      "write_s": 0.0, "drain_wait_s": 0.0, "setup_s": 0.0,
+                      "close_s": 0.0, "wall_s": 0.0, "bytes_in": 0}
         return self.stats
 
     # --- zero-copy host path ----------------------------------------------
@@ -370,12 +387,13 @@ class StreamingEncoder:
     def _encode_file_mmap(self, dat_path: str, out_base: str,
                           large: int, small: int, matmul_ptrs) -> None:
         """Zero-copy encode: the input volume is mmap'd and the SIMD
-        matmul reads it in place — no fill phase.  Parity is computed
-        into a small REUSED staging buffer (warm pages, no fault storm)
-        and pwritten; data shards are pwritten straight from the input
-        mapping (one kernel-side copy).  Measured on tmpfs this beats
-        both the staged pipeline (no read copies) and all-mmap outputs
-        (fresh-file mappings pay a minor fault per written page)."""
+        matmul reads it in place — no fill phase.  Parity outputs are
+        mmap'd too (bulk pre-faulted via MADV_POPULATE_WRITE where the
+        kernel supports it) so the matmul's stores land straight in the
+        page cache: parity is written ONCE by the kernel instead of
+        staged + pwritten — r/k of the volume saved a full pass.  Data
+        shards are pwritten straight from the input mapping (the one
+        unavoidable kernel-side copy)."""
         import mmap as mmap_mod
 
         k, r = self.k, self.r
@@ -385,15 +403,23 @@ class StreamingEncoder:
         file_size = os.path.getsize(dat_path)
         shard_size = _shard_size(file_size, k, large, small)
         mat = np.ascontiguousarray(self.matrix[k:])
-        outs = [open(out_base + to_ext(i), "w+b") for i in range(k + r)]
+        # "r+b" when the shard file already exists: every byte of every
+        # output is written below (_plan_entries coverage is total, tail
+        # rows ride zero-padded buffers), so re-encode over old shards
+        # need not truncate-to-zero first — that frees every page cache
+        # page only for the pwrites/stores to re-allocate (and re-zero)
+        # them all
+        outs = []
+        for i in range(k + r):
+            p = out_base + to_ext(i)
+            outs.append(open(p, "r+b" if os.path.exists(p) else "w+b"))
         out_fds = [f.fileno() for f in outs]
         in_f = open(dat_path, "rb")
         in_map = None
         in_mv = None
         tail_buf: Optional[np.ndarray] = None
-        stage = np.zeros((r, self.dispatch_b), dtype=np.uint8)
-        stage_addr = [stage.ctypes.data + j * stage.strides[0]
-                      for j in range(r)]
+        parity_maps: list = []
+        parity_addrs: list[int] = []
         try:
             for f in outs:
                 # full-size upfront: pwrite fills real bytes; anything a
@@ -401,6 +427,37 @@ class StreamingEncoder:
                 f.truncate(shard_size)
             if shard_size == 0:
                 return
+            # parity outputs are mmap'd so the SIMD kernel stores parity
+            # STRAIGHT into the page cache — one pass instead of the old
+            # stage-buffer store + pwrite copy (a full extra traversal of
+            # r/k of the volume).  Data shards keep pwrite: their copy
+            # from the input mapping is unavoidable either way.  Created
+            # LAZILY: with the overlap worker active parity arrives via
+            # pwrite-from-shm, and populating r*shard_size of pages
+            # upfront would be a wasted serial pass.
+            def parity_mappings() -> list[int]:
+                if parity_addrs:
+                    return parity_addrs
+                for j in range(r):
+                    # reserve blocks NOW so disk-full is a catchable
+                    # OSError here, not a SIGBUS under the kernel's
+                    # stores into a sparse mapping
+                    _fallocate(out_fds[k + j], shard_size)
+                    pm = mmap_mod.mmap(out_fds[k + j], shard_size,
+                                       access=mmap_mod.ACCESS_WRITE)
+                    try:
+                        # bulk pre-fault (MADV_POPULATE_WRITE, Linux
+                        # 5.14+): one syscall instead of a per-page trap
+                        # under the kernel's stores; harmless to skip
+                        pm.madvise(getattr(mmap_mod,
+                                           "MADV_POPULATE_WRITE", 23))
+                    except (OSError, ValueError):
+                        pass
+                    parity_maps.append(pm)
+                    parity_addrs.append(
+                        np.frombuffer(pm, dtype=np.uint8).ctypes.data)
+                return parity_addrs
+
             in_map = mmap_mod.mmap(in_f.fileno(), 0,
                                    access=mmap_mod.ACCESS_READ)
             if hasattr(in_map, "madvise"):
@@ -408,6 +465,7 @@ class StreamingEncoder:
             in_arr = np.frombuffer(in_map, dtype=np.uint8)
             in_mv = memoryview(in_map)
             in_addr = in_arr.ctypes.data
+            st["setup_s"] = clock() - t_start
             # parity worker: a separate process mmaps the SAME file and
             # computes dispatch d+1's parity while this process sits in
             # pwrite for dispatch d — kernel-mode write time and SIMD
@@ -439,13 +497,15 @@ class StreamingEncoder:
                     matmul_ptrs(
                         mat,
                         [in_addr + base + i * block for i in range(k)],
-                        stage_addr, n)
+                        [a + off for a in parity_mappings()], n)
                     st["dispatch_s"] += clock() - t0
-                    parity = stage
+                else:
+                    t0 = clock()
+                    for j in range(r):
+                        os.pwrite(out_fds[k + j],
+                                  memoryview(parity[j, :n]), off)
+                    st["write_s"] += clock() - t0
                 t0 = clock()
-                for j in range(r):
-                    os.pwrite(out_fds[k + j],
-                              memoryview(parity[j, :n]), off)
                 for i in range(k):
                     s = base + i * block
                     os.pwrite(out_fds[i], in_mv[s:s + n], off)
@@ -471,17 +531,15 @@ class StreamingEncoder:
                             out_off += n
                             continue
                         # all k source rows fully inside the file: matmul
-                        # in place from the mapping into the parity stage
+                        # in place from the mapping, parity stored
+                        # straight into the output mappings
                         t0 = clock()
                         matmul_ptrs(
                             mat,
                             [in_addr + base + i * block for i in range(k)],
-                            stage_addr, n)
+                            [a + out_off for a in parity_mappings()], n)
                         st["dispatch_s"] += clock() - t0
                         t0 = clock()
-                        for j in range(r):
-                            os.pwrite(out_fds[k + j],
-                                      memoryview(stage[j, :n]), out_off)
                         for i in range(k):
                             s = base + i * block
                             os.pwrite(out_fds[i], in_mv[s:s + n], out_off)
@@ -506,12 +564,9 @@ class StreamingEncoder:
                         matmul_ptrs(
                             mat,
                             [buf.ctypes.data + i * row for i in range(k)],
-                            stage_addr, n)
+                            [a + out_off for a in parity_mappings()], n)
                         st["dispatch_s"] += clock() - t0
                         t0 = clock()
-                        for j in range(r):
-                            os.pwrite(out_fds[k + j],
-                                      memoryview(stage[j, :n]), out_off)
                         for i in range(k):
                             os.pwrite(out_fds[i], memoryview(buf[i]),
                                       out_off)
@@ -533,11 +588,18 @@ class StreamingEncoder:
                     in_mv.release()
                 del in_arr
         finally:
+            t0 = clock()
+            for pm in parity_maps:
+                try:
+                    pm.close()
+                except BufferError:
+                    pass
             if in_map is not None:
                 in_map.close()
             in_f.close()
             for f in outs:
                 f.close()
+            st["close_s"] = clock() - t0
             st["wall_s"] = clock() - t_start
 
     def encode_file(self, dat_path: str, out_base: str,
@@ -659,9 +721,9 @@ class StreamingEncoder:
                             survivors: list[int], rec: np.ndarray,
                             matmul_ptrs) -> None:
         """Zero-copy rebuild: survivors are mmap'd whole files read in
-        place by the matmul; regenerated shards are computed into a small
-        reused staging buffer and pwritten (warm pages beat fresh-file
-        mappings, which pay a minor fault per written page)."""
+        place by the matmul, and the rebuilt shards are mmap'd OUTPUTS —
+        the kernel's stores are the write (fallocate'd first so ENOSPC
+        is a catchable error, bulk pre-faulted where the kernel can)."""
         import mmap as mmap_mod
 
         k, b = self.k, self.dispatch_b
@@ -673,20 +735,31 @@ class StreamingEncoder:
         in_fs = [open(base + to_ext(i), "rb") for i in survivors]
         in_maps: list = []
         out_fs: list = []
+        out_maps: list = []
         ok = False
-        stage = np.zeros((nm, b), dtype=np.uint8)
-        stage_addr = [stage.ctypes.data + j * stage.strides[0]
-                      for j in range(nm)]
         try:
             shard_size = os.fstat(in_fs[0].fileno()).st_size
             for f in in_fs:
                 if os.fstat(f.fileno()).st_size != shard_size:
                     raise ValueError("ec shard size mismatch")
             out_fs = [open(base + to_ext(m), "w+b") for m in missing]
-            out_fds = [f.fileno() for f in out_fs]
             if shard_size == 0:
                 ok = True
                 return
+            # rebuilt shards are mmap'd outputs: the kernel's stores ARE
+            # the write — same single-pass discipline as the encode path
+            out_addrs: list[int] = []
+            for f in out_fs:
+                _fallocate(f.fileno(), shard_size)
+                om = mmap_mod.mmap(f.fileno(), shard_size,
+                                   access=mmap_mod.ACCESS_WRITE)
+                try:
+                    om.madvise(getattr(mmap_mod, "MADV_POPULATE_WRITE", 23))
+                except (OSError, ValueError):
+                    pass
+                out_maps.append(om)
+                out_addrs.append(
+                    np.frombuffer(om, dtype=np.uint8).ctypes.data)
             in_maps = [mmap_mod.mmap(f.fileno(), 0,
                                      access=mmap_mod.ACCESS_READ)
                        for f in in_fs]
@@ -695,29 +768,32 @@ class StreamingEncoder:
                     m.madvise(mmap_mod.MADV_SEQUENTIAL)
             in_arrs = [np.frombuffer(m, dtype=np.uint8) for m in in_maps]
             in_addr = [a.ctypes.data for a in in_arrs]
+            st["setup_s"] = clock() - t_start
             try:
                 for offset in range(0, shard_size, b):
                     n = min(b, shard_size - offset)
                     t0 = clock()
                     matmul_ptrs(rec,
                                 [a + offset for a in in_addr],
-                                stage_addr, n)
+                                [a + offset for a in out_addrs], n)
                     st["dispatch_s"] += clock() - t0
-                    t0 = clock()
-                    for j in range(nm):
-                        os.pwrite(out_fds[j], memoryview(stage[j, :n]),
-                                  offset)
-                    st["write_s"] += clock() - t0
                     st["dispatches"] += 1
                     st["bytes_in"] += len(survivors) * n
             finally:
                 del in_arrs
             ok = True
         finally:
+            t0 = clock()
+            for m in out_maps:
+                try:
+                    m.close()
+                except BufferError:
+                    pass
             for m in in_maps:
                 m.close()
             for f in in_fs + out_fs:
                 f.close()
+            st["close_s"] = clock() - t0
             if not ok:
                 for m in missing:
                     try:

@@ -683,9 +683,16 @@ class FilerServer:
             from ..utils.httpd import UNSATISFIABLE_RANGE, parse_range
 
             # uncached remote-mounted object: pull from the remote and
-            # persist as local chunks (filer/read_remote.go)
+            # persist as local chunks (filer/read_remote.go).  A plain
+            # HEAD answers from metadata alone, but a HEAD with resize
+            # params needs the bytes — its Content-Length/ETag must
+            # describe the resized entity the GET serves
+            _mime0 = entry.attr.mime or "application/octet-stream"
+            _resize_q = (_mime0.startswith("image/")
+                         and (req.query.get("width")
+                              or req.query.get("height")))
             if not entry.chunks and "remote.entry" in entry.extended \
-                    and req.handler.command != "HEAD":
+                    and (req.handler.command != "HEAD" or _resize_q):
                 from ..remote_storage.mounts import cache_remote_object
 
                 cache_remote_object(self, entry)

@@ -542,6 +542,17 @@ class FastHTTPServer:
         except (OSError, ValueError):
             pass
         finally:
+            # half-close + brief drain before close: closing with unread
+            # bytes in the receive queue sends RST, which can destroy an
+            # already-sent error response (414/431/501 paths reject
+            # requests whose remainder is still in flight)
+            try:
+                conn.shutdown(socket.SHUT_WR)
+                conn.settimeout(1.0)
+                while conn.recv(1 << 16):
+                    pass
+            except OSError:
+                pass
             try:
                 conn.close()
             except OSError:

@@ -590,7 +590,10 @@ class VolumeServer:
                              f"http://{others[0]}{_up.quote(req.path, safe="/,")}"},
                     raw=b"")
             etag = f'"{n.etag()}"'
-            if req.headers.get("If-None-Match") == etag:
+            if not wants_resize and req.headers.get("If-None-Match") == etag:
+                # with resize params the served entity differs from the
+                # stored one; the conditional is evaluated against the
+                # resize-suffixed tag after the resize below
                 return Response(None, status=304, raw=b"")
             headers = {"ETag": etag, "Accept-Ranges": "bytes"}
             if n.has(FLAG_HAS_NAME) and n.name:
@@ -616,8 +619,19 @@ class VolumeServer:
             if wants_resize:
                 from ..images import resized_from_query
 
+                orig_body = body
                 body, new_mime = resized_from_query(body, ctype, req.query)
                 headers["Content-Type"] = new_mime
+                if body is not orig_body:
+                    # a resized representation must not share the
+                    # original's cache key (same rule as the filer)
+                    etag = '"%s-%sx%s-%s"' % (
+                        n.etag(), req.query.get("width", ""),
+                        req.query.get("height", ""),
+                        req.query.get("mode", ""))
+                    headers["ETag"] = etag
+                if req.headers.get("If-None-Match") == etag:
+                    return Response(None, status=304, raw=b"")
             if rng_hdr and "Content-Encoding" not in headers:
                 from ..utils.httpd import UNSATISFIABLE_RANGE, parse_range
 

@@ -329,3 +329,16 @@ def test_volume_server_image_resize(cluster):
         "GET", f"http://{a['url']}/{a['fid']}?width=10")
     assert st == 200 and hdrs["Content-Type"] == "image/png"
     assert Image.open(io.BytesIO(body)).size == (10, 5)
+    # a resized representation carries its own ETag (no cache-key
+    # conflation with the original), and conditionals match against it
+    _, _, h_orig = http_bytes("GET", f"http://{a['url']}/{a['fid']}")
+    assert hdrs["ETag"] != h_orig["ETag"]
+    st, _, _ = http_bytes(
+        "GET", f"http://{a['url']}/{a['fid']}?width=10",
+        headers={"If-None-Match": hdrs["ETag"]})
+    assert st == 304
+    # the ORIGINAL's etag must not 304 a resize URL
+    st, _, _ = http_bytes(
+        "GET", f"http://{a['url']}/{a['fid']}?width=10",
+        headers={"If-None-Match": h_orig["ETag"]})
+    assert st == 200

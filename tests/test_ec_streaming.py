@@ -169,6 +169,26 @@ def test_plan_entries_covers_file_exactly():
     assert max(rs + k * b for rs, b in rows) >= size
 
 
+def test_reencode_over_stale_shards_byte_identical(tmp_path):
+    """The mmap path reuses existing shard files without truncating to
+    zero (page-cache preservation); every byte must still come from the
+    NEW encode — stale bytes from a previous, different, LARGER encode
+    must not leak through, including in zero-padded tail regions."""
+    enc = StreamingEncoder(10, 4)
+    big = _write_dat(tmp_path, 3 * 1024 * 1024 + 517, name="big")
+    enc.encode_file(big + ".dat", str(tmp_path / "out"), 1 << 20, 64 << 10)
+    small_size = 700 * 1024 + 13  # shrinks shard files, tail-heavy
+    small = _write_dat(tmp_path, small_size, name="small")
+    enc.encode_file(small + ".dat", str(tmp_path / "out"), 1 << 20, 64 << 10)
+    (tmp_path / "fresh.dat").write_bytes((tmp_path / "small.dat").read_bytes())
+    enc.encode_file(str(tmp_path / "fresh.dat"), str(tmp_path / "fresh"),
+                    1 << 20, 64 << 10)
+    for i in range(14):
+        reused = (tmp_path / ("out" + to_ext(i))).read_bytes()
+        clean = (tmp_path / ("fresh" + to_ext(i))).read_bytes()
+        assert reused == clean, f"shard {i} differs after reuse"
+
+
 @pytest.mark.parametrize("make", [
     lambda: StreamingEncoder(10, 4),
     None,  # CPU path exercised via encoder.rebuild_ec_files
