@@ -66,10 +66,13 @@ def _child(scratch_path: str, platform: str = "") -> None:
             json.dump(detail, f)
 
     def section(name, fn):
+        t0 = time.perf_counter()
         try:
             fn()
         except Exception as e:  # record and continue: partial > nothing
             detail[f"error_{name}"] = f"{type(e).__name__}: {e}"[:500]
+        detail.setdefault("section_s", {})[name] = round(
+            time.perf_counter() - t0, 1)
         checkpoint()
 
     import jax
@@ -320,11 +323,10 @@ def _child(scratch_path: str, platform: str = "") -> None:
     def _e2e_one(base_dir, size_mb, reps=2, **enc_kw):
         from seaweedfs_tpu.ec.streaming import StreamingEncoder
 
-        raw = rng.integers(0, 256, size_mb << 20, dtype=np.uint8).tobytes()
         with tempfile.TemporaryDirectory(dir=base_dir) as td:
             dat = os.path.join(td, "1.dat")
-            with open(dat, "wb") as f:
-                f.write(raw)
+            _write_big_random(dat, size_mb)
+            raw_len = size_mb << 20
             enc = StreamingEncoder(10, 4, **enc_kw)
             enc.encode_file(dat, os.path.join(td, "1"))  # warm compile+pages
             best_dt, stats = float("inf"), None
@@ -334,7 +336,7 @@ def _child(scratch_path: str, platform: str = "") -> None:
                 dt = time.perf_counter() - t0
                 if dt < best_dt:
                     best_dt, stats = dt, dict(enc.stats)
-            mbps = round(len(raw) / best_dt / 1e6, 1)
+            mbps = round(raw_len / best_dt / 1e6, 1)
             wall = stats.get("wall_s") or best_dt
             pipe = {k: round(v, 3) if isinstance(v, float) else v
                     for k, v in stats.items()}
@@ -342,6 +344,54 @@ def _child(scratch_path: str, platform: str = "") -> None:
             pipe["overlap_efficiency"] = round(
                 1.0 - stats.get("drain_wait_s", 0.0) / wall, 3)
             return mbps, pipe
+
+    def _tmpfs_free_mb() -> int:
+        import shutil as _sh
+
+        if not os.path.isdir("/dev/shm"):
+            return 0
+        return _sh.disk_usage("/dev/shm").free >> 20
+
+    _alloc_rate: list = []
+
+    def _tmpfs_alloc_mbps() -> float:
+        """Fresh-page allocation rate on tmpfs (512MB probe, cached).
+        Ballooned VMs grow their resident pool lazily — first-touch of
+        multi-GB files can run at ~150-250 MB/s on a host that serves
+        warm pages at 2-3 GB/s.  Flagship-size sections consult this so
+        a slow-balloon box reports an estimate instead of timing the
+        hypervisor."""
+        if _alloc_rate:
+            return _alloc_rate[0]
+        if not os.path.isdir("/dev/shm"):
+            _alloc_rate.append(0.0)
+            return 0.0
+        buf = bytes(1 << 20)
+        path = "/dev/shm/.bench_alloc_probe"
+        fd = os.open(path, os.O_CREAT | os.O_WRONLY | os.O_TRUNC)
+        t0 = time.perf_counter()
+        for off in range(0, 512 << 20, 1 << 20):
+            os.pwrite(fd, buf, off)
+        rate = 512 / (time.perf_counter() - t0)
+        os.close(fd)
+        os.unlink(path)
+        _alloc_rate.append(round(rate, 1))
+        detail["tmpfs_alloc_mbps"] = _alloc_rate[0]
+        return _alloc_rate[0]
+
+    def _write_big_random(path: str, size_mb: int) -> None:
+        """size_mb of data from one tiled 256MB random chunk: rng byte
+        generation runs ~70 MB/s on this class of box and would dominate
+        the section; GF timing is data-independent and every stripe
+        still differs (offsets shift per row)."""
+        chunk = rng.integers(0, 256, min(size_mb, 256) << 20,
+                             dtype=np.uint8).tobytes()
+        with open(path, "wb") as f:
+            left = size_mb << 20
+            while left > 0:
+                n = min(left, len(chunk))
+                f.write(chunk[:n])
+                left -= n
 
     def _io_floor(base_dir, size_mb, reps=3):
         """Zero-compute replay of the encode's exact data movement: mmap
@@ -356,20 +406,16 @@ def _child(scratch_path: str, platform: str = "") -> None:
         size_b = size_mb << 20
         shard = (size_b + 9) // 10
         hot = bytes(1 << 20)
-        raw = rng.integers(0, 256, size_b, dtype=np.uint8).tobytes()
         best = float("inf")
         with tempfile.TemporaryDirectory(dir=base_dir) as td:
             dat = os.path.join(td, "f.dat")
-            with open(dat, "wb") as f:
-                f.write(raw)
-            del raw
+            _write_big_random(dat, size_mb)
             # files persist across reps (no O_TRUNC): the e2e pipeline is
             # timed warm over existing shard files, so the floor must be
             # too — both regimes overwrite live page-cache pages
             fds_all = [os.open(os.path.join(td, f"s{i}"), os.O_CREAT | os.O_WRONLY)
                        for i in range(14)]
             for _ in range(reps):
-                fds = fds_all
                 t0 = time.perf_counter()
                 with open(dat, "rb") as f, \
                         mmap_mod.mmap(f.fileno(), 0,
@@ -380,11 +426,11 @@ def _child(scratch_path: str, platform: str = "") -> None:
                         base = i * shard
                         for off in range(0, shard, ch):
                             n = min(ch, shard - off)
-                            os.pwrite(fds[i], mv[base + off:base + off + n],
+                            os.pwrite(fds_all[i], mv[base + off:base + off + n],
                                       off)
                     for j in range(4):
                         for off in range(0, shard, ch):
-                            os.pwrite(fds[10 + j],
+                            os.pwrite(fds_all[10 + j],
                                       hot[:min(ch, shard - off)], off)
                     mv.release()
                 best = min(best, time.perf_counter() - t0)
@@ -420,6 +466,14 @@ def _child(scratch_path: str, platform: str = "") -> None:
                 fpk = round(size_mb * (1 << 20) / (floor_s + kern_s) / 1e6, 1)
                 detail["e2e_floor_plus_kernel_mbps"] = fpk
                 detail["e2e_vs_floor_plus_kernel"] = round(mbps / fpk, 3)
+            # BASELINE tracked config: the REAL 1GB encode when the box
+            # has tmpfs room (1GB .dat + 1.4GB shards, one timed rep)
+            if size_mb < 1024 and _tmpfs_free_mb() > 4096 \
+                    and _tmpfs_alloc_mbps() > 400:
+                mbps_1g, pipe_1g = _e2e_one(shm, 1024, reps=1)
+                pipe_1g["size_mb"] = 1024
+                detail["e2e_file_encode_1gb_mbps"] = mbps_1g
+                detail["e2e_pipeline_1gb"] = pipe_1g
             if not on_tpu:
                 # the overlap-worker claim, MEASURED (round-3 verdict):
                 # staged pipeline with no worker vs with the process
@@ -462,15 +516,15 @@ def _child(scratch_path: str, platform: str = "") -> None:
     def meas_e2e_rebuild():
         from seaweedfs_tpu.ec.streaming import StreamingEncoder
 
-        # 1GB volume -> 100MB shards on TPU hosts; scaled down on the
-        # 1-core CPU box (the per-byte rate is what transfers)
-        vol_mb = 1024 if on_tpu else 256
+        # the BASELINE tracked config is a REAL 1GB volume (1.4GB of
+        # shards + the .dat = ~2.5GB of tmpfs); measure it whenever the
+        # box has room and keep the scaled 256MB run as the cross-check
         shm = "/dev/shm" if os.path.isdir("/dev/shm") else None
-        raw = rng.integers(0, 256, vol_mb << 20, dtype=np.uint8).tobytes()
+        vol_mb = 1024 if (on_tpu or (_tmpfs_free_mb() > 4096
+                                     and _tmpfs_alloc_mbps() > 400)) else 256
         with tempfile.TemporaryDirectory(dir=shm) as td:
             dat = os.path.join(td, "1.dat")
-            with open(dat, "wb") as f:
-                f.write(raw)
+            _write_big_random(dat, vol_mb)
             enc = StreamingEncoder(10, 4)
             enc.encode_file(dat, os.path.join(td, "1"))
             shard0 = os.path.join(td, "1.ec00")
@@ -482,9 +536,77 @@ def _child(scratch_path: str, platform: str = "") -> None:
             dt = time.perf_counter() - t0
         detail["e2e_rebuild_volume_mb"] = vol_mb
         detail["e2e_rebuild_ms"] = round(dt * 1e3, 1)
-        detail["e2e_rebuild_1gb_est_ms"] = round(dt * 1e3 * 1024 / vol_mb, 1)
+        if vol_mb == 1024:
+            detail["e2e_rebuild_1gb_ms"] = round(dt * 1e3, 1)
+        else:
+            detail["e2e_rebuild_1gb_est_ms"] = round(
+                dt * 1e3 * 1024 / vol_mb, 1)
 
     section("e2e_rebuild", meas_e2e_rebuild)
+
+    # --- BASELINE tracked config: 4-erasure decode on an 8GB volume ------
+    def meas_e2e_decode_8gb():
+        """The flagship decode size, measured for REAL when tmpfs has
+        ~12GB to spare (8GB .dat is deleted before the timed rebuild;
+        peak is ~11.2GB of shards): erase 2 data + 2 parity shards of an
+        8GB RS(10,4) volume and reconstruct all four in one fused pass."""
+        from seaweedfs_tpu.ec.layout import to_ext
+        from seaweedfs_tpu.ec.streaming import StreamingEncoder
+
+        # the 2GB CPU-fallback shape peaks at ~7GB of tmpfs (probe +
+        # .dat + shards); the 8GB flagship needs ~24GB but only runs
+        # on_tpu (gated below), so don't let its requirement block the
+        # 2GB real measurement
+        if _tmpfs_free_mb() < 8 << 10 or _tmpfs_alloc_mbps() < 300:
+            # the microbench multi_decode_8gb_est_s stays the estimate;
+            # a slow-balloon box would time the hypervisor's page
+            # allocator, not the decode (see _tmpfs_alloc_mbps)
+            detail["multi_decode_file_skipped"] = (
+                f"tmpfs {_tmpfs_free_mb()}MB free, "
+                f"alloc {_tmpfs_alloc_mbps()} MB/s")
+            return
+        with tempfile.TemporaryDirectory(dir="/dev/shm") as td:
+            # the full 8GB config needs ~20GB of pool; a ballooned VM
+            # grows its resident set lazily, so the 512MB probe can pass
+            # while multi-GB growth still crawls at the hypervisor's
+            # page-supply rate.  Probe AT SIZE with 2GB of throwaway
+            # growth (it doubles as warm-up): a genuinely fast box runs
+            # the flagship 8GB; a slow-balloon box measures the same
+            # file-level decode at 2GB for real and keeps the microbench
+            # 8GB estimate.
+            probe = os.path.join(td, "grow")
+            t0 = time.perf_counter()
+            _write_big_random(probe, 2 << 10)
+            grow_mbps = (2 << 10) / (time.perf_counter() - t0)
+            os.unlink(probe)
+            detail["multi_decode_file_pool_mbps"] = round(grow_mbps, 1)
+            # ballooned-VM CPU boxes pass a 2GB probe and still crawl at
+            # 20GB (the fast window is a few GB) — the full 8GB config
+            # only runs on real-TPU hosts; CPU fallbacks measure the
+            # same file-level decode at 2GB for real
+            vol_mb = (8 << 10) if (on_tpu and grow_mbps > 1500
+                                   and _tmpfs_free_mb() > 24 << 10) \
+                else (2 << 10)
+            if grow_mbps < 300:
+                detail["multi_decode_file_skipped"] = (
+                    f"pool growth {grow_mbps:.0f} MB/s")
+                return
+            dat = os.path.join(td, "1.dat")
+            _write_big_random(dat, vol_mb)
+            enc = StreamingEncoder(10, 4)
+            enc.encode_file(dat, os.path.join(td, "1"))
+            os.remove(dat)  # make room: decode reads shards only
+            for i in (2, 7, 10, 13):
+                os.remove(os.path.join(td, "1" + to_ext(i)))
+            t0 = time.perf_counter()
+            rebuilt = enc.rebuild_files(os.path.join(td, "1"))
+            dt = time.perf_counter() - t0
+            assert sorted(rebuilt) == [2, 7, 10, 13]
+        key = "multi_decode_8gb" if vol_mb == 8 << 10 else "multi_decode_2gb"
+        detail[key + "_s"] = round(dt, 2)
+        detail[key + "_mbps"] = round(vol_mb * (1 << 20) / dt / 1e6, 1)
+
+    section("e2e_decode_8gb", meas_e2e_decode_8gb)
 
     # --- roofline: achieved vs memory-bandwidth ceiling -------------------
     # RS(10,4) encode is memory-bound: the kernel must move at least

@@ -84,8 +84,19 @@ class CqlClient:
 
     def _recv_frame(self) -> tuple[int, bytes]:
         hdr = recv_exact(self._sock, 9)
-        _, _, _, opcode, ln = struct.unpack(">BBhBI", hdr)
-        return opcode, recv_exact(self._sock, ln)
+        _, _, stream, opcode, ln = struct.unpack(">BBhBI", hdr)
+        body = recv_exact(self._sock, ln)
+        if stream != 0:
+            # this client runs one request at a time on stream 0; a reply
+            # for another stream means the connection is carrying crossed
+            # frames (proxy bug, desync) — kill it rather than hand the
+            # caller someone else's result rows
+            try:
+                self._sock.close()
+            finally:
+                self._sock = None
+            raise CqlError(f"stream id mismatch: got {stream}, expected 0")
+        return opcode, body
 
     # --- session ----------------------------------------------------------
     def _connect(self) -> None:

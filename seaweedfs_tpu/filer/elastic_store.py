@@ -70,6 +70,15 @@ class ElasticStore:
         body = json.dumps(doc).encode() if doc is not None else b""
         status, out, _ = http_bytes(method, self.base + path, body,
                                     headers=self._headers)
+        if status == 429:
+            # es_rejected_execution: the canonical transient backpressure
+            # answer — one bounded retry after a beat, like the official
+            # clients' retry_on_status default
+            import time as _t
+
+            _t.sleep(0.2)
+            status, out, _ = http_bytes(method, self.base + path, body,
+                                        headers=self._headers)
         return status, (json.loads(out) if out else {})
 
     # --- entries ----------------------------------------------------------
@@ -89,7 +98,13 @@ class ElasticStore:
     def find_entry(self, path: str) -> Optional[Entry]:
         status, out = self._req(
             "GET", f"/{_index_of(path)}/_doc/{_md5(path)}")
-        if status == 404 or not out.get("found"):
+        if status == 404:
+            return None
+        if status != 200:
+            # "not found" and "cluster unavailable" are different facts:
+            # a 5xx must not report a present entry as absent
+            raise OSError(f"elastic get {path}: {status} {out}")
+        if not out.get("found"):
             return None
         e = Entry.from_dict(out["_source"]["Meta"])
         e.full_path = path
@@ -140,6 +155,10 @@ class ElasticStore:
             status, out = self._req("POST", f"/{index}/_search", query)
             if status == 404:
                 return  # index never created: empty directory
+            if status != 200:
+                # a red/overloaded cluster must surface as an error, not
+                # an empty directory — callers delete "empty" dirs
+                raise OSError(f"elastic search {index}: {status} {out}")
             hits = out.get("hits", {}).get("hits", [])
             if not hits:
                 return
@@ -163,7 +182,11 @@ class ElasticStore:
 
     def kv_get(self, key: bytes) -> Optional[bytes]:
         status, out = self._req("GET", f"/{KV_INDEX}/_doc/{key.hex()}")
-        if status == 404 or not out.get("found"):
+        if status == 404:
+            return None
+        if status != 200:
+            raise OSError(f"elastic kv_get: {status} {out}")
+        if not out.get("found"):
             return None
         return bytes.fromhex(out["_source"]["Value"])
 

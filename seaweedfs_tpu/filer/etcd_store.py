@@ -76,12 +76,23 @@ class EtcdStore:
         return cls(url[len("etcd://"):].rstrip("/"))
 
     def _call(self, op: str, body: dict) -> dict:
-        status, payload, _ = http_bytes(
-            "POST", f"{self.base}/{op}", json.dumps(body).encode(),
-            headers={"Content-Type": "application/json"})
+        status, payload, _ = self._call_raw(op, body)
+        if status == 503:
+            # leader election in flight ("etcdserver: leader changed" /
+            # no leader): the canonical transient — one bounded retry
+            # like etcd's own clientv3 retry policy for unavailable
+            import time as _t
+
+            _t.sleep(0.2)
+            status, payload, _ = self._call_raw(op, body)
         if status != 200:
             raise HttpError(status, payload.decode(errors="replace"))
         return json.loads(payload or b"{}")
+
+    def _call_raw(self, op: str, body: dict):
+        return http_bytes(
+            "POST", f"{self.base}/{op}", json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"})
 
     # -- entries ------------------------------------------------------------
     def insert_entry(self, entry: Entry) -> None:

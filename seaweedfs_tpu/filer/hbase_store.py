@@ -251,11 +251,27 @@ class HbaseStore:
         self.client.close()
 
     # -- low-level ops (doGet/doPut/doDelete analogs) ------------------------
+    def _region_call(self, method: str, build_req) -> bytes:
+        """One region-relocation retry: a region split or move answers
+        NotServingRegionException for the old region name — rediscover
+        from hbase:meta and re-issue with the new region (the standard
+        client's region-cache invalidation), instead of failing every
+        op until a process restart."""
+        try:
+            return self.client.call(method, build_req())
+        except HBaseError as e:
+            if "NotServingRegion" not in e.class_name \
+                    and "RegionMoved" not in e.class_name:
+                raise
+            self._locate_region()
+            return self.client.call(method, build_req())
+
     def _get(self, cf: bytes, key: bytes) -> Optional[bytes]:
         get = (f_bytes(1, key) +                       # Get.row
                f_msg(2, f_bytes(1, cf) + f_bytes(2, COLUMN)))
-        req = f_msg(1, _region_specifier(self._region)) + f_msg(2, get)
-        resp = pb.decode(self.client.call("Get", req))
+        resp = pb.decode(self._region_call(
+            "Get", lambda: f_msg(1, _region_specifier(self._region))
+            + f_msg(2, get)))
         result = pb.first(resp, 1)
         if result is None:
             return None
@@ -278,8 +294,9 @@ class HbaseStore:
             # gohbase hrpc.TTL: attribute "_ttl" = ms as 8-byte BE
             ttl = struct.pack(">q", ttl_sec * 1000)
             mutation += f_msg(5, f_string(1, "_ttl") + f_bytes(2, ttl))
-        req = f_msg(1, _region_specifier(self._region)) + f_msg(2, mutation)
-        self.client.call("Mutate", req)
+        self._region_call(
+            "Mutate", lambda: f_msg(1, _region_specifier(self._region))
+            + f_msg(2, mutation))
 
     def _delete(self, cf: bytes, key: bytes) -> None:
         qv = (f_bytes(1, COLUMN) +
@@ -288,8 +305,9 @@ class HbaseStore:
                     f_varint(2, MUTATE_DELETE) +
                     f_msg(3, f_bytes(1, cf) + f_msg(2, qv)) +
                     f_varint(6, DURABILITY_ASYNC_WAL))
-        req = f_msg(1, _region_specifier(self._region)) + f_msg(2, mutation)
-        self.client.call("Mutate", req)
+        self._region_call(
+            "Mutate", lambda: f_msg(1, _region_specifier(self._region))
+            + f_msg(2, mutation))
 
     def _open_scan(self, cf: bytes, start: bytes, batch: int) -> bytes:
         scan = (f_bytes(3, start) +
@@ -306,16 +324,29 @@ class HbaseStore:
         req = self._open_scan(cf, start, batch)
         scanner_id = None
         last_row: Optional[bytes] = None
+        relocations = 0
         try:
             while True:
                 try:
                     resp = pb.decode(self.client.call("Scan", req))
                 except HBaseError as e:
-                    if scanner_id is None or \
+                    relocated = ("NotServingRegion" in e.class_name
+                                 or "RegionMoved" in e.class_name)
+                    if relocated:
+                        # region split/moved mid-scan: rediscover from
+                        # hbase:meta, then resume like a scanner death.
+                        # Bounded: a permanently unassigned region
+                        # (disabled table, stale meta) must raise, not
+                        # spin a hot relocate/reopen RPC loop
+                        relocations += 1
+                        if relocations > 3:
+                            raise
+                        self._locate_region()
+                    elif scanner_id is None or \
                             "UnknownScanner" not in e.class_name:
                         raise
-                    # server restarted between pages: resume after the
-                    # last row this generator already produced
+                    # resume after the last row this generator already
+                    # produced — never silently truncate the scan
                     resume = (last_row + b"\x00") if last_row is not None \
                         else start
                     req = self._open_scan(cf, resume, batch)

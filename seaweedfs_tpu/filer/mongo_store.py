@@ -64,15 +64,24 @@ class MongoClient:
         header = struct.pack("<iiii", 16 + len(payload), self._req_id,
                              0, OP_MSG)
         self._sock.sendall(header + payload)
-        raw = self._recv_exact(16)
-        (ln, _, _, opcode) = struct.unpack("<iiii", raw)
-        rest = self._recv_exact(ln - 16)
-        if opcode != OP_MSG:
-            raise MongoError(f"unexpected opcode {opcode}")
-        # flags u32, then one kind-0 section (the reply document)
-        if rest[4] != 0:
-            raise MongoError("unexpected section kind")
-        reply = bson.decode(rest[5:])
+        while True:
+            raw = self._recv_exact(16)
+            (ln, _, _, opcode) = struct.unpack("<iiii", raw)
+            rest = self._recv_exact(ln - 16)
+            if opcode != OP_MSG:
+                raise MongoError(f"unexpected opcode {opcode}")
+            # flags u32, then one kind-0 section (the reply document)
+            (flags,) = struct.unpack("<I", rest[:4])
+            if rest[4] != 0:
+                raise MongoError("unexpected section kind")
+            reply = bson.decode(rest[5:])
+            # moreToCome (0x2): further replies follow with no request.
+            # We never set exhaustAllowed, so a conforming server never
+            # sets this — but a nonconforming one would otherwise leave
+            # unread replies that desync every later command on this
+            # pooled connection.  Drain to the final message.
+            if not flags & 0x2:
+                break
         if reply.get("ok") != 1 and reply.get("ok") != 1.0:
             raise MongoError(reply.get("errmsg", str(reply)))
         return reply

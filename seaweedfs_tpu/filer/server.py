@@ -700,9 +700,7 @@ class FilerServer:
             file_size = _effective_size(entry)
             is_head = req.handler.command == "HEAD"
             mime = entry.attr.mime or "application/octet-stream"
-            resize_asked = ((mime or "").startswith("image/")
-                            and (req.query.get("width")
-                                 or req.query.get("height")))
+            resize_asked = _resize_q  # same entry/query as the pull above
             wants_resize = resize_asked
             resized_real = False
             if wants_resize:

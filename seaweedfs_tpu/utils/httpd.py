@@ -548,9 +548,14 @@ class FastHTTPServer:
             # requests whose remainder is still in flight)
             try:
                 conn.shutdown(socket.SHUT_WR)
-                conn.settimeout(1.0)
-                while conn.recv(1 << 16):
-                    pass
+                conn.settimeout(0.5)
+                deadline = _time.monotonic() + 2.0
+                drained = 0
+                while _time.monotonic() < deadline and drained < (1 << 22):
+                    piece = conn.recv(1 << 16)
+                    if not piece:
+                        break
+                    drained += len(piece)
             except OSError:
                 pass
             try:

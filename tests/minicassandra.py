@@ -45,10 +45,14 @@ def _rows_body(cols: list[str], rows: list[tuple]) -> bytes:
 
 
 class MiniCassandra:
+    # failure-injection drills consumed one per QUERY:
+    #   ("error", code, msg)  -> CQL ERROR frame (e.g. 0x1001 Overloaded)
+    #   ("stream", id)        -> well-formed RESULT on the WRONG stream id
     def __init__(self, username: str = "", password: str = ""):
         self.username, self.password = username, password
         # directory -> {name: meta bytes}
         self.parts: dict[str, dict[str, bytes]] = {}
+        self.fail_next: list = []
         self.lock = threading.Lock()
         self._sock = socket.socket()
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -125,6 +129,20 @@ class MiniCassandra:
                         if not authed:
                             err("not authenticated")
                             return
+                        if self.fail_next:
+                            drill = self.fail_next.pop(0)
+                            if drill[0] == "error":
+                                _, code, msg = drill
+                                b = msg.encode()
+                                send(OP_ERROR, struct.pack(">i", code) +
+                                     struct.pack(">H", len(b)) + b)
+                            else:  # ("stream", id): RESULT on wrong stream
+                                _, sid = drill
+                                rows = _rows_body([], [])
+                                conn.sendall(struct.pack(
+                                    ">BBhBI", 0x84, 0, sid, OP_RESULT,
+                                    len(rows)) + rows)
+                            continue
                         self._query(send, err, body)
                     else:
                         err(f"unsupported opcode {opcode}")

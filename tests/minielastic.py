@@ -43,6 +43,7 @@ class MiniElastic:
         # index -> {doc id -> source}
         self.indices: dict[str, dict[str, dict]] = {}
         self.lock = threading.Lock()
+        self.fail_next: list[int] = []  # statuses to answer before serving
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -50,6 +51,24 @@ class MiniElastic:
 
             def log_message(self, fmt, *args):
                 pass
+
+            def _maybe_fail(self) -> bool:
+                # failure-injection drills: answer this request with a
+                # canned status (429 backpressure, 503 red cluster)
+                # without touching the stored documents.  Checked AFTER
+                # the request is parsed — a keep-alive thread blocks in
+                # readline between requests, so any earlier check races
+                # the test's fail_next.append
+                if not outer.fail_next:
+                    return False
+                ln = int(self.headers.get("Content-Length") or 0)
+                if ln:
+                    self.rfile.read(ln)
+                status = outer.fail_next.pop(0)
+                self._json(status, {"error": {
+                    "type": "es_rejected_execution" if status == 429
+                    else "cluster_block_exception"}})
+                return True
 
             def _json(self, status: int, doc: dict) -> None:
                 body = json.dumps(doc).encode()
@@ -63,6 +82,8 @@ class MiniElastic:
                 return [p for p in path.split("/") if p]
 
             def do_PUT(self):
+                if self._maybe_fail():
+                    return
                 ln = int(self.headers.get("Content-Length", 0))
                 doc = json.loads(self.rfile.read(ln) or b"{}")
                 parts = self._parts()
@@ -81,6 +102,8 @@ class MiniElastic:
                 self._json(400, {"error": "bad put"})
 
             def do_GET(self):
+                if self._maybe_fail():
+                    return
                 parts = self._parts()
                 if len(parts) == 3 and parts[1] == "_doc":
                     with outer.lock:
@@ -92,6 +115,8 @@ class MiniElastic:
                 self._json(400, {"error": "bad get"})
 
             def do_DELETE(self):
+                if self._maybe_fail():
+                    return
                 parts = self._parts()
                 with outer.lock:
                     if len(parts) == 1:
@@ -109,6 +134,8 @@ class MiniElastic:
                 self._json(400, {"error": "bad delete"})
 
             def do_POST(self):
+                if self._maybe_fail():
+                    return
                 ln = int(self.headers.get("Content-Length", 0))
                 q = json.loads(self.rfile.read(ln) or b"{}")
                 parts = self._parts()

@@ -18,6 +18,9 @@ class MiniEtcd:
     def __init__(self):
         self.kv: dict[bytes, bytes] = {}
         self.lock = threading.Lock()
+        # (status, grpc-gateway error doc) answers popped per request —
+        # leader-loss (503) and compaction (400) drills
+        self.fail_next: list = []
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -29,6 +32,15 @@ class MiniEtcd:
             def do_POST(self):
                 n = int(self.headers.get("Content-Length") or 0)
                 body = json.loads(self.rfile.read(n) or b"{}")
+                if outer.fail_next:
+                    status, err = outer.fail_next.pop(0)
+                    payload = json.dumps(err).encode()
+                    self.send_response(status)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(payload)))
+                    self.end_headers()
+                    self.wfile.write(payload)
+                    return
                 if self.path == "/v3/kv/put":
                     resp = outer._put(body)
                 elif self.path == "/v3/kv/range":

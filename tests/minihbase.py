@@ -41,6 +41,7 @@ class MiniHBase:
     def __init__(self, table: str = "seaweedfs", require_auth: int = 0x50):
         self.table = table.encode()
         self.require_auth = require_auth
+        self.region_gen = 1  # bump to simulate a region split/move
         # rows: {row: {family: {qualifier: value}}}, sorted on scan
         self.rows: dict[bytes, dict[bytes, dict[bytes, bytes]]] = {}
         self.lock = threading.Lock()
@@ -57,7 +58,15 @@ class MiniHBase:
 
     @property
     def region(self) -> bytes:
-        return self.table + b",,1.0123456789abcdef0123456789abcdef."
+        gen = b"%031d" % self.region_gen
+        return self.table + b",," + b"%d" % self.region_gen + b"." + gen + b"a."
+
+    def split_region(self) -> None:
+        """Region split/move drill: the served region gets a NEW encoded
+        name; requests naming the old one answer
+        NotServingRegionException and hbase:meta serves the new name —
+        exactly what a client sees when a region splits mid-workload."""
+        self.region_gen += 1
 
     def stop(self) -> None:
         self._stop = True

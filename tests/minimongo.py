@@ -43,8 +43,12 @@ def _match(doc: dict, filt: dict) -> bool:
 
 
 class MiniMongo:
-    def __init__(self, username: str = "", password: str = ""):
+    def __init__(self, username: str = "", password: str = "",
+                 tamper: str = ""):
         self.username, self.password = username, password
+        self.tamper = tamper          # "" | "server_sig" (SCRAM drill)
+        self.kill_cursors = False     # getMore -> CursorNotFound drill
+        self.exhaust_once = False     # next find streams a moreToCome decoy
         self.colls: dict[tuple[str, str], list[dict]] = {}
         self.cursors: dict[int, list[dict]] = {}
         self._cursor_id = 0
@@ -97,6 +101,17 @@ class MiniMongo:
                         return
                     doc = bson.decode(payload[5:])
                     reply = self._handle(doc, state)
+                    if self.exhaust_once and next(iter(doc)) == "find":
+                        # nonconforming exhaust drill: stream a prelude
+                        # reply with moreToCome (0x2) set, then the real
+                        # one — the client never requested exhaustAllowed
+                        # and must drain to the final message or desync
+                        self.exhaust_once = False
+                        decoy = bson.encode({"ok": 1, "cursor": {
+                            "id": 0, "ns": "", "firstBatch": []}})
+                        out = struct.pack("<I", 0x2) + b"\x00" + decoy
+                        conn.sendall(struct.pack(
+                            "<iiii", 16 + len(out), 0, req_id, OP_MSG) + out)
                     body = bson.encode(reply)
                     out = struct.pack("<I", 0) + b"\x00" + body
                     conn.sendall(struct.pack(
@@ -137,6 +152,12 @@ class MiniMongo:
                 "firstBatch": first}}
         if op == "getMore":
             cid = doc["getMore"]
+            if self.kill_cursors:
+                # cursor-death drill (timeout/failover on a real mongod):
+                # the canonical CursorNotFound error document
+                self.cursors.pop(cid, None)
+                return {"ok": 0, "code": 43, "codeName": "CursorNotFound",
+                        "errmsg": f"cursor id {cid} not found"}
             with self.lock:
                 rest = self.cursors.get(cid, [])
                 batch, rest = rest[:self.batch_cap], rest[self.batch_cap:]
@@ -211,5 +232,8 @@ class MiniMongo:
         state["scram"] = None
         skey = hmac.new(salted, b"Server Key", hashlib.sha256).digest()
         v = hmac.new(skey, auth_msg.encode(), hashlib.sha256).digest()
+        if self.tamper == "server_sig":
+            # impersonator drill: correct flow, forged ServerSignature
+            v = bytes(32)
         return {"ok": 1, "conversationId": 1, "done": True,
                 "payload": b"v=" + base64.b64encode(v)}

@@ -41,10 +41,13 @@ def _sql_err(e: Exception) -> bytes:
 
 class MiniPg:
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 password: str = "", auth: str = "trust"):
-        """auth: trust | cleartext | md5 | scram"""
+                 password: str = "", auth: str = "trust",
+                 tamper: str = ""):
+        """auth: trust | cleartext | md5 | scram.
+        tamper: "" | "nonce" | "server_sig" — SCRAM adversary drills."""
         self.password = password
         self.auth = auth
+        self.tamper = tamper
         self._db = sqlite3.connect(":memory:", check_same_thread=False)
         self._db_lock = threading.Lock()
         self._sock = socket.socket()
@@ -144,7 +147,13 @@ class MiniPg:
         client_nonce = dict(p.split("=", 1)
                             for p in client_first_bare.split(","))["r"]
         salt, iters = os.urandom(16), 4096
-        server_nonce = client_nonce + base64.b64encode(os.urandom(9)).decode()
+        if self.tamper == "nonce":
+            # MITM shape: a fresh nonce NOT extending the client's —
+            # an honest server must echo-and-extend (RFC 5802 §5.1)
+            server_nonce = base64.b64encode(os.urandom(18)).decode()
+        else:
+            server_nonce = (client_nonce
+                            + base64.b64encode(os.urandom(9)).decode())
         server_first = (f"r={server_nonce},"
                         f"s={base64.b64encode(salt).decode()},i={iters}")
         conn.sendall(_msg(b"R", struct.pack(">I", 11) + server_first.encode()))
@@ -165,6 +174,10 @@ class MiniPg:
             return False
         skey = hmac.new(salted, b"Server Key", hashlib.sha256).digest()
         v = hmac.new(skey, auth_msg.encode(), hashlib.sha256).digest()
+        if self.tamper == "server_sig":
+            # impersonator shape: correct protocol, wrong ServerSignature
+            # (an attacker who doesn't know the password can't compute it)
+            v = bytes(32)
         conn.sendall(_msg(b"R", struct.pack(">I", 12) +
                           b"v=" + base64.b64encode(v)))
         return True

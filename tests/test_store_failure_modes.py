@@ -1,0 +1,283 @@
+"""Adversarial / failure-mode transcripts for the wire-protocol stores.
+
+Every wire store gets spec-derived FAILURE drills beyond CRUD: the happy
+paths are covered by each store's own suite; these pin down what the
+clients do when the server misbehaves — auth-layer tampering (SCRAM
+impersonation/MITM shapes), topology churn (region splits, leader loss),
+resource pressure (429/Overloaded), and protocol desync (wrong stream,
+unrequested exhaust streams).  Reference counterparts ride real client
+libraries that handle these; a hand-rolled wire client earns trust only
+by demonstrating the same behavior.
+
+ref: weed/filer/redis_cluster/redis_cluster_store.go:1 (the family whose
+MOVED/ASK drills live in test_redis_cluster.py), weed/filer/hbase/
+hbase_store.go:1, weed/filer/mongodb/mongodb_store.go:1.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from seaweedfs_tpu.filer.entry import Attr, Entry
+
+
+def _file(path: str, n: int = 1) -> Entry:
+    return Entry(full_path=path, attr=Attr(crtime=n, mtime=n, mode=0o644))
+
+
+# --- postgres: SCRAM adversary drills (RFC 5802 §9) -------------------------
+
+def test_pg_scram_rejects_forged_server_signature():
+    """An impersonator that doesn't know the password can run the whole
+    SCRAM flow but cannot compute ServerSignature — the client MUST
+    verify v= and refuse the session (server-authentication half of
+    SCRAM; losing it reduces SCRAM to client-only auth)."""
+    from seaweedfs_tpu.filer.pg_client import PgConn, PgError
+    from tests.minipg import MiniPg
+
+    srv = MiniPg(password="sekret", auth="scram", tamper="server_sig")
+    try:
+        with pytest.raises(PgError, match="server signature"):
+            PgConn("127.0.0.1", srv.port, password="sekret")
+    finally:
+        srv.stop()
+
+
+def test_pg_scram_rejects_nonce_substitution():
+    """The server's nonce must EXTEND the client's (RFC 5802 §5.1 r=);
+    a fresh nonce is the MITM-replay shape and must abort the exchange."""
+    from seaweedfs_tpu.filer.pg_client import PgConn, PgError
+    from tests.minipg import MiniPg
+
+    srv = MiniPg(password="sekret", auth="scram", tamper="nonce")
+    try:
+        with pytest.raises(PgError, match="nonce"):
+            PgConn("127.0.0.1", srv.port, password="sekret")
+    finally:
+        srv.stop()
+
+
+# --- mongo: OP_MSG failure drills -------------------------------------------
+
+def test_mongo_scram_rejects_forged_server_signature():
+    from seaweedfs_tpu.filer.mongo_store import MongoError, MongoStore
+    from tests.minimongo import MiniMongo
+
+    srv = MiniMongo(username="u", password="pw", tamper="server_sig")
+    try:
+        with pytest.raises((MongoError, OSError),
+                           match="signature|server"):
+            # auth runs at connect: the forged v= must abort the session
+            MongoStore.from_url(f"mongodb://u:pw@127.0.0.1:{srv.port}")
+    finally:
+        srv.stop()
+
+
+def test_mongo_cursor_death_mid_listing_raises_not_truncates():
+    """A cursor that dies between getMore pages (timeout, failover on a
+    real mongod) answers CursorNotFound (code 43).  The listing must
+    RAISE — returning the partial page as if complete is the
+    silent-data-loss shape (a caller deleting 'everything listed' would
+    miss entries)."""
+    from seaweedfs_tpu.filer.mongo_store import MongoError, MongoStore
+    from tests.minimongo import MiniMongo
+
+    srv = MiniMongo()
+    try:
+        store = MongoStore.from_url(f"mongodb://127.0.0.1:{srv.port}")
+        for i in range(10):  # > batch_cap: forces the getMore path
+            store.insert_entry(_file(f"/dir/f{i:02}.txt", i + 1))
+        srv.kill_cursors = True
+        with pytest.raises((MongoError, OSError), match="[Cc]ursor"):
+            list(store.list_directory_entries("/dir", "", True, 100))
+    finally:
+        srv.stop()
+
+
+def test_mongo_drains_unrequested_more_to_come_stream():
+    """This client never sets exhaustAllowed, but a nonconforming server
+    that streams a moreToCome (0x2) prelude must not desync the pooled
+    connection: the client drains to the final reply and later commands
+    still work."""
+    from seaweedfs_tpu.filer.mongo_store import MongoStore
+    from tests.minimongo import MiniMongo
+
+    srv = MiniMongo()
+    try:
+        store = MongoStore.from_url(f"mongodb://127.0.0.1:{srv.port}")
+        store.insert_entry(_file("/x.txt"))
+        srv.exhaust_once = True
+        assert store.find_entry("/x.txt") is not None
+        # the connection survived: a second command parses cleanly
+        assert store.find_entry("/x.txt") is not None
+        assert store.find_entry("/missing") is None
+    finally:
+        srv.stop()
+
+
+# --- cassandra: CQL error frames + stream integrity -------------------------
+
+def test_cassandra_overloaded_error_surfaces():
+    """ERROR 0x1001 (Overloaded) mid-CRUD must raise CqlError with the
+    server's message — not retry forever, not silently drop the write."""
+    from seaweedfs_tpu.filer.cassandra_store import CassandraStore, CqlError
+    from tests.minicassandra import MiniCassandra
+
+    srv = MiniCassandra()
+    try:
+        store = CassandraStore.from_url(f"cassandra://127.0.0.1:{srv.port}")
+        store.insert_entry(_file("/ok.txt"))
+        srv.fail_next.append(("error", 0x1001, "pool is overloaded"))
+        with pytest.raises(CqlError, match="overloaded"):
+            store.insert_entry(_file("/fails.txt"))
+        # transient: the connection still serves the next statement
+        store.insert_entry(_file("/after.txt"))
+        assert store.find_entry("/after.txt") is not None
+    finally:
+        srv.stop()
+
+
+def test_cassandra_wrong_stream_id_detected():
+    """A RESULT on the wrong stream id means crossed frames (proxy bug,
+    desync): the client must refuse the payload and drop the connection
+    rather than hand back someone else's rows."""
+    from seaweedfs_tpu.filer.cassandra_store import CassandraStore, CqlError
+    from tests.minicassandra import MiniCassandra
+
+    srv = MiniCassandra()
+    try:
+        store = CassandraStore.from_url(f"cassandra://127.0.0.1:{srv.port}")
+        store.insert_entry(_file("/ok.txt"))
+        srv.fail_next.append(("stream", 7))
+        with pytest.raises(CqlError, match="stream"):
+            store.find_entry("/ok.txt")
+        # the poisoned connection was dropped; a fresh one reconnects
+        assert store.find_entry("/ok.txt") is not None
+    finally:
+        srv.stop()
+
+
+# --- etcd: leader loss + compaction -----------------------------------------
+
+def test_etcd_leader_loss_retries_once():
+    """503 during a leader election is the canonical transient
+    (etcdserver: no leader); one bounded retry rides it out like
+    clientv3's unavailable retry policy."""
+    from seaweedfs_tpu.filer.etcd_store import EtcdStore
+    from tests.minietcd import MiniEtcd
+
+    srv = MiniEtcd()
+    try:
+        store = EtcdStore(f"127.0.0.1:{srv.port}")
+        store.insert_entry(_file("/a.txt"))
+        srv.fail_next.append((503, {"error": "etcdserver: no leader",
+                                    "code": 14}))
+        assert store.find_entry("/a.txt") is not None  # retried through
+    finally:
+        srv.stop()
+
+
+def test_etcd_persistent_error_raises():
+    """A non-transient error (compacted revision, 400) must surface,
+    and two consecutive 503s exhaust the single retry."""
+    from seaweedfs_tpu.filer.etcd_store import EtcdStore
+    from seaweedfs_tpu.utils.httpd import HttpError
+    from tests.minietcd import MiniEtcd
+
+    srv = MiniEtcd()
+    try:
+        store = EtcdStore(f"127.0.0.1:{srv.port}")
+        srv.fail_next.append(
+            (400, {"error": "etcdserver: mvcc: required revision has "
+                            "been compacted", "code": 11}))
+        with pytest.raises(HttpError, match="compacted"):
+            store.find_entry("/a.txt")
+        srv.fail_next.extend([(503, {"error": "no leader"})] * 2)
+        with pytest.raises(HttpError):
+            store.find_entry("/a.txt")
+    finally:
+        srv.stop()
+
+
+# --- elastic: backpressure + red cluster ------------------------------------
+
+def test_elastic_429_backpressure_retried_once():
+    from seaweedfs_tpu.filer.elastic_store import ElasticStore
+    from tests.minielastic import MiniElastic
+
+    srv = MiniElastic()
+    try:
+        store = ElasticStore(f"http://127.0.0.1:{srv.port}")
+        srv.fail_next.append(429)  # es_rejected_execution, then serves
+        store.insert_entry(_file("/a.txt"))
+        assert store.find_entry("/a.txt") is not None
+    finally:
+        srv.stop()
+
+
+def test_elastic_red_cluster_search_raises_not_empty():
+    """A 503 on _search must raise — answering an empty listing turns a
+    flaky cluster into silent data loss (callers treat empty as
+    deletable)."""
+    from seaweedfs_tpu.filer.elastic_store import ElasticStore
+    from tests.minielastic import MiniElastic
+
+    srv = MiniElastic()
+    try:
+        store = ElasticStore(f"http://127.0.0.1:{srv.port}")
+        store.insert_entry(_file("/dir/a.txt"))
+        srv.fail_next.append(503)
+        with pytest.raises(OSError, match="503|search"):
+            list(store.list_directory_entries("/dir", "", True, 10))
+        assert store.find_entry("/dir/a.txt") is not None  # recovered
+        # a 5xx on a point GET must raise too, not report "absent"
+        srv.fail_next.append(503)
+        with pytest.raises(OSError, match="503"):
+            store.find_entry("/dir/a.txt")
+    finally:
+        srv.stop()
+
+
+# --- hbase: region split ----------------------------------------------------
+
+def test_hbase_region_split_point_ops_relocate():
+    """A region split answers NotServingRegionException for the old
+    region name; the client must re-scan hbase:meta and retry with the
+    new region — the standard region-cache invalidation."""
+    from seaweedfs_tpu.filer.hbase_store import HbaseStore
+    from tests.minihbase import MiniHBase
+
+    srv = MiniHBase()
+    try:
+        store = HbaseStore(port=srv.port)
+        store.insert_entry(_file("/a.txt"))
+        srv.split_region()
+        store.insert_entry(_file("/b.txt"))        # put relocates
+        assert store.find_entry("/a.txt") is not None   # get relocates
+        srv.split_region()
+        store.delete_entry("/b.txt")               # delete relocates
+        assert store.find_entry("/b.txt") is None
+    finally:
+        srv.stop()
+
+
+def test_hbase_region_split_mid_scan_resumes_without_truncation():
+    """The split lands BETWEEN scan pages: the continuation call names
+    the dead region, and the scan must relocate + resume after the last
+    yielded row — every row exactly once, no silent truncation."""
+    from seaweedfs_tpu.filer.hbase_store import HbaseStore
+    from tests.minihbase import MiniHBase
+
+    srv = MiniHBase()
+    try:
+        store = HbaseStore(port=srv.port)
+        names = [f"f{i:03}.txt" for i in range(30)]
+        for i, nm in enumerate(names):
+            store.insert_entry(_file(f"/dir/{nm}", i + 1))
+        it = iter(store.list_directory_entries("/dir", "", True, 100))
+        got = [next(it).name for _ in range(5)]
+        srv.split_region()  # split mid-scan
+        got += [e.name for e in it]
+        assert got == names
+    finally:
+        srv.stop()
