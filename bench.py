@@ -45,6 +45,122 @@ CPU_BENCH_TIMEOUT_S = 900
 
 
 # --------------------------------------------------------------------------
+# shared e2e helpers (module-level so the trace smoke test can import them;
+# all heavy imports stay inside the functions — the parent process must
+# remain stdlib-only at import time)
+# --------------------------------------------------------------------------
+
+def _write_big_random(path: str, size_mb: int) -> None:
+    """size_mb of data from one tiled 256MB random chunk: rng byte
+    generation runs ~70 MB/s on this class of box and would dominate
+    the section; GF timing is data-independent and every stripe
+    still differs (offsets shift per row)."""
+    import numpy as np
+
+    rng = np.random.default_rng(0xBE)
+    chunk = rng.integers(0, 256, min(size_mb, 256) << 20,
+                         dtype=np.uint8).tobytes()
+    with open(path, "wb") as f:
+        left = size_mb << 20
+        while left > 0:
+            n = min(left, len(chunk))
+            f.write(chunk[:n])
+            left -= n
+
+
+def _span_summary(tracer, max_dispatches: int = 48) -> dict:
+    """Per-dispatch stage breakdown from the tracer's pipeline.*/worker.*
+    spans — the attributable timeline behind overlap_efficiency."""
+    stage_totals: dict = {}
+    per: dict = {}
+    n_spans = 0
+    for sp in tracer.snapshot():
+        parts = sp.name.split(".", 1)
+        if parts[0] not in ("pipeline", "worker") or len(parts) != 2:
+            continue
+        if parts[1] in ("encode_file", "rebuild_files"):
+            continue  # root spans measure the wall, not a stage
+        # worker.* spans keep their namespace: they run CONCURRENTLY with
+        # the pipeline stages, so folding them into the same 'compute'
+        # bucket would let per-dispatch sums exceed wall_s and misread
+        # overlapped compute as a serial stage
+        stage = parts[1] if parts[0] == "pipeline" else sp.name
+        n_spans += 1
+        dur = sp.t1 - sp.t0
+        stage_totals[stage] = stage_totals.get(stage, 0.0) + dur
+        d = sp.attrs.get("dispatch")
+        if d is not None:
+            row = per.setdefault(int(d), {})
+            row[stage] = row.get(stage, 0.0) + dur
+    dispatches = sorted(per)
+    out = {
+        "stage_totals_s": {k: round(v, 4)
+                           for k, v in sorted(stage_totals.items())},
+        "span_count": n_spans,
+        "dispatches": len(dispatches),
+        "per_dispatch_s": [
+            {"d": d, **{k: round(v, 5) for k, v in sorted(per[d].items())}}
+            for d in dispatches[:max_dispatches]],
+    }
+    if len(dispatches) > max_dispatches:
+        out["per_dispatch_truncated"] = len(dispatches) - max_dispatches
+    return out
+
+
+def _e2e_one(base_dir, size_mb, reps=2, tracer=None, **enc_kw):
+    """One e2e streaming-encode measurement -> (mbps, pipe, chrome_doc).
+    With a tracer, the ring is cleared per rep and the BEST rep's span
+    summary (pipe["spans"]) + Chrome trace document are returned."""
+    from seaweedfs_tpu.ec.streaming import StreamingEncoder
+
+    with tempfile.TemporaryDirectory(dir=base_dir) as td:
+        dat = os.path.join(td, "1.dat")
+        _write_big_random(dat, size_mb)
+        raw_len = size_mb << 20
+        enc = StreamingEncoder(10, 4, tracer=tracer, **enc_kw)
+        enc.encode_file(dat, os.path.join(td, "1"))  # warm compile+pages
+        best_dt, stats, spans, chrome = float("inf"), None, None, None
+        for _ in range(reps):
+            if tracer is not None:
+                tracer.clear()
+            t0 = time.perf_counter()
+            enc.encode_file(dat, os.path.join(td, "1"))
+            dt = time.perf_counter() - t0
+            if dt < best_dt:
+                best_dt, stats = dt, dict(enc.stats)
+                if tracer is not None:
+                    spans = _span_summary(tracer)
+                    chrome = tracer.to_chrome()
+        mbps = round(raw_len / best_dt / 1e6, 1)
+        wall = stats.get("wall_s") or best_dt
+        pipe = {k: round(v, 3) if isinstance(v, float) else v
+                for k, v in stats.items()}
+        # fraction of the wall the host was NOT blocked on the device
+        pipe["overlap_efficiency"] = round(
+            1.0 - stats.get("drain_wait_s", 0.0) / wall, 3)
+        if spans is not None:
+            pipe["spans"] = spans
+        return mbps, pipe, chrome
+
+
+def trace_smoke(trace_out=None, size_mb=2, base_dir=None):
+    """Tiny CPU-only traced encode — the --trace-out path in miniature,
+    exercised by a fast `not slow` test.  Returns (mbps, pipe) with
+    pipe["spans"] populated; writes the Chrome trace JSON to trace_out
+    when given."""
+    from seaweedfs_tpu.observability import Tracer
+
+    tracer = Tracer(capacity=1 << 14)
+    mbps, pipe, chrome = _e2e_one(base_dir, size_mb, reps=1, tracer=tracer,
+                                  engine="host", zero_copy=False,
+                                  overlap="none", dispatch_mb=1)
+    if trace_out:
+        with open(trace_out, "w") as f:
+            json.dump(chrome, f)
+    return mbps, pipe
+
+
+# --------------------------------------------------------------------------
 # child: the actual measurements (runs with jax importable, any backend)
 # --------------------------------------------------------------------------
 
@@ -319,31 +435,9 @@ def _child(scratch_path: str, platform: str = "") -> None:
     # --- e2e streaming file encode (overlapped pipeline) ------------------
     # run on BOTH a tmpfs and the default scratch disk: the delta
     # separates pipeline cost from storage-medium cost (round-2 verdict:
-    # "nothing separates disk-bound from pipeline-overhead-bound")
-    def _e2e_one(base_dir, size_mb, reps=2, **enc_kw):
-        from seaweedfs_tpu.ec.streaming import StreamingEncoder
-
-        with tempfile.TemporaryDirectory(dir=base_dir) as td:
-            dat = os.path.join(td, "1.dat")
-            _write_big_random(dat, size_mb)
-            raw_len = size_mb << 20
-            enc = StreamingEncoder(10, 4, **enc_kw)
-            enc.encode_file(dat, os.path.join(td, "1"))  # warm compile+pages
-            best_dt, stats = float("inf"), None
-            for _ in range(reps):
-                t0 = time.perf_counter()
-                enc.encode_file(dat, os.path.join(td, "1"))
-                dt = time.perf_counter() - t0
-                if dt < best_dt:
-                    best_dt, stats = dt, dict(enc.stats)
-            mbps = round(raw_len / best_dt / 1e6, 1)
-            wall = stats.get("wall_s") or best_dt
-            pipe = {k: round(v, 3) if isinstance(v, float) else v
-                    for k, v in stats.items()}
-            # fraction of the wall the host was NOT blocked on the device
-            pipe["overlap_efficiency"] = round(
-                1.0 - stats.get("drain_wait_s", 0.0) / wall, 3)
-            return mbps, pipe
+    # "nothing separates disk-bound from pipeline-overhead-bound").
+    # _e2e_one / _write_big_random are module-level (shared with the
+    # trace smoke path).
 
     def _tmpfs_free_mb() -> int:
         import shutil as _sh
@@ -378,20 +472,6 @@ def _child(scratch_path: str, platform: str = "") -> None:
         _alloc_rate.append(round(rate, 1))
         detail["tmpfs_alloc_mbps"] = _alloc_rate[0]
         return _alloc_rate[0]
-
-    def _write_big_random(path: str, size_mb: int) -> None:
-        """size_mb of data from one tiled 256MB random chunk: rng byte
-        generation runs ~70 MB/s on this class of box and would dominate
-        the section; GF timing is data-independent and every stripe
-        still differs (offsets shift per row)."""
-        chunk = rng.integers(0, 256, min(size_mb, 256) << 20,
-                             dtype=np.uint8).tobytes()
-        with open(path, "wb") as f:
-            left = size_mb << 20
-            while left > 0:
-                n = min(left, len(chunk))
-                f.write(chunk[:n])
-                left -= n
 
     def _io_floor(base_dir, size_mb, reps=3):
         """Zero-compute replay of the encode's exact data movement: mmap
@@ -439,10 +519,20 @@ def _child(scratch_path: str, platform: str = "") -> None:
         return best
 
     def meas_e2e():
+        # the e2e section runs under a span tracer: the per-dispatch
+        # stage breakdown (pipe["spans"]) rides the bench JSON so the
+        # overlap-efficiency number comes with an attributable timeline,
+        # and --trace-out persists the Chrome trace document
+        from seaweedfs_tpu.observability import Tracer
+
+        e2e_tracer = Tracer(capacity=1 << 16)
+        trace_out = os.environ.get("BENCH_TRACE_OUT")
+        chrome_doc = None
         size_mb = 512 if on_tpu else 256
         shm = "/dev/shm" if os.path.isdir("/dev/shm") else None
         if shm:
-            mbps, pipe = _e2e_one(shm, size_mb)
+            mbps, pipe, chrome_doc = _e2e_one(shm, size_mb,
+                                              tracer=e2e_tracer)
             pipe["size_mb"] = size_mb
             detail["e2e_file_encode_tmpfs_mbps"] = mbps
             detail["e2e_pipeline_tmpfs"] = pipe
@@ -470,7 +560,8 @@ def _child(scratch_path: str, platform: str = "") -> None:
             # has tmpfs room (1GB .dat + 1.4GB shards, one timed rep)
             if size_mb < 1024 and _tmpfs_free_mb() > 4096 \
                     and _tmpfs_alloc_mbps() > 400:
-                mbps_1g, pipe_1g = _e2e_one(shm, 1024, reps=1)
+                mbps_1g, pipe_1g, _ = _e2e_one(shm, 1024, reps=1,
+                                               tracer=e2e_tracer)
                 pipe_1g["size_mb"] = 1024
                 detail["e2e_file_encode_1gb_mbps"] = mbps_1g
                 detail["e2e_pipeline_1gb"] = pipe_1g
@@ -482,10 +573,10 @@ def _child(scratch_path: str, platform: str = "") -> None:
                 # 1 core the processes timeslice, so ~1.0x is the honest
                 # expectation; >1.1x only appears with a second core.
                 ov_mb = min(size_mb, 128)
-                off_mbps, _ = _e2e_one(shm, ov_mb, reps=1,
-                                       zero_copy=False, overlap="none")
-                on_mbps, _ = _e2e_one(shm, ov_mb, reps=1,
-                                      overlap="process")
+                off_mbps, _, _ = _e2e_one(shm, ov_mb, reps=1,
+                                          zero_copy=False, overlap="none")
+                on_mbps, _, _ = _e2e_one(shm, ov_mb, reps=1,
+                                         overlap="process")
                 detail["overlap_worker"] = {
                     "pipeline_off_mbps": off_mbps,
                     "pipeline_process_mbps": on_mbps,
@@ -493,11 +584,18 @@ def _child(scratch_path: str, platform: str = "") -> None:
                     "cores": os.cpu_count() or 1,
                 }
         disk_mb = size_mb if on_tpu else 32
-        mbps, pipe = _e2e_one(None, disk_mb)
+        # when there is no tmpfs the disk run is the traced one
+        mbps, pipe, disk_chrome = _e2e_one(
+            None, disk_mb, tracer=None if shm else e2e_tracer)
+        chrome_doc = chrome_doc or disk_chrome
         pipe["size_mb"] = disk_mb
         detail["e2e_file_encode_mbps"] = mbps
         detail["e2e_pipeline_disk"] = pipe
         detail["e2e_file_size_mb"] = disk_mb
+        if trace_out and chrome_doc is not None:
+            with open(trace_out, "w") as f:
+                json.dump(chrome_doc, f)
+            detail["trace_out"] = trace_out
         # On a tunneled remote TPU the e2e rate is bound by pulling parity
         # (r/k of the data) back over the link; report the ceiling so the
         # pipeline's efficiency is separable from the link it ran over.
@@ -1014,6 +1112,17 @@ def main() -> None:
 
 
 if __name__ == "__main__":
+    # --trace-out PATH: persist the e2e section's Chrome trace-event JSON
+    # (open in chrome://tracing or ui.perfetto.dev).  Carried to the
+    # measurement child via the environment so every fallback re-exec
+    # (TPU -> CPU) inherits it.
+    if "--trace-out" in sys.argv:
+        i = sys.argv.index("--trace-out")
+        if i + 1 >= len(sys.argv):
+            print("--trace-out requires a path", file=sys.stderr)
+            sys.exit(2)
+        os.environ["BENCH_TRACE_OUT"] = os.path.abspath(sys.argv[i + 1])
+        del sys.argv[i:i + 2]
     if len(sys.argv) >= 3 and sys.argv[1] == "--child":
         _child(sys.argv[2], sys.argv[3] if len(sys.argv) > 3 else "")
     else:

@@ -19,6 +19,7 @@ from typing import Optional, Protocol, Sequence
 
 import numpy as np
 
+from ..observability import get_tracer
 from .gf256 import (MUL_TABLE, build_cauchy_matrix, build_encoding_matrix,
                     mat_invert, mat_mul)
 
@@ -117,7 +118,14 @@ class ReedSolomon:
         """data[data_shards, B] -> parity[parity_shards, B]."""
         if data.shape[0] != self.data_shards:
             raise ValueError(f"expected {self.data_shards} data shards")
-        return self.engine.matmul(self.parity_matrix, np.ascontiguousarray(data))
+        # every engine materializes the result to host before returning
+        # (TpuEngine device_gets), so the span bounds real device time —
+        # the block_until_ready discipline without an explicit call
+        with get_tracer().span("ec.encode", k=self.data_shards,
+                               r=self.parity_shards, bytes=int(data.nbytes),
+                               backend=self.engine.name):
+            return self.engine.matmul(self.parity_matrix,
+                                      np.ascontiguousarray(data))
 
     def encode_shards(self, shards: list[np.ndarray]) -> None:
         """klauspost Encode: shards[0:data] in, shards[data:total] overwritten."""
@@ -160,19 +168,27 @@ class ReedSolomon:
         upto = self.data_shards if data_only else self.total_shards
         missing = [i for i in range(upto) if shards[i] is None]
         if missing:
-            sub = [list(int(v) for v in self.matrix[i]) for i in sub_rows]
-            decode = mat_invert(sub)
-            want = [list(int(v) for v in self.matrix[m]) for m in missing]
-            rows = np.array(mat_mul(want, decode), dtype=np.uint8)
-            if hasattr(self.engine, "matmul_rows"):
-                # row-pointer kernel: skips the [k, B] survivor stack copy
-                restored = self.engine.matmul_rows(
-                    rows, [shards[i] for i in sub_rows])
-            else:
-                survivors = np.stack([shards[i] for i in sub_rows])
-                restored = self.engine.matmul(rows, survivors)
-            for out_i, shard_i in enumerate(missing):
-                shards[shard_i] = restored[out_i]
+            with get_tracer().span(
+                    "ec.reconstruct", k=self.data_shards,
+                    r=self.parity_shards, missing=len(missing),
+                    bytes=size * self.data_shards,
+                    backend=self.engine.name):
+                sub = [list(int(v) for v in self.matrix[i])
+                       for i in sub_rows]
+                decode = mat_invert(sub)
+                want = [list(int(v) for v in self.matrix[m])
+                        for m in missing]
+                rows = np.array(mat_mul(want, decode), dtype=np.uint8)
+                if hasattr(self.engine, "matmul_rows"):
+                    # row-pointer kernel: skips the [k, B] survivor
+                    # stack copy
+                    restored = self.engine.matmul_rows(
+                        rows, [shards[i] for i in sub_rows])
+                else:
+                    survivors = np.stack([shards[i] for i in sub_rows])
+                    restored = self.engine.matmul(rows, survivors)
+                for out_i, shard_i in enumerate(missing):
+                    shards[shard_i] = restored[out_i]
         # keep sizes consistent
         for i in range(self.total_shards):
             if shards[i] is not None and len(shards[i]) != size:

@@ -24,6 +24,7 @@ from typing import Optional
 
 import numpy as np
 
+from ..observability import get_tracer
 from ..storage.needle_map import MemDb
 from ..storage.types import NEEDLE_ID_SIZE
 from ..utils.ioutil import pread_padded as _pread_padded
@@ -71,7 +72,11 @@ def write_ec_files(base_file_name: str, rs: Optional[ReedSolomon] = None,
     dat_path = base_file_name + ".dat"
     remaining = os.path.getsize(dat_path)
     processed = 0
-    with open(dat_path, "rb") as dat:
+    with get_tracer().span("ec.write_ec_files", path=dat_path,
+                           bytes=remaining, k=rs.data_shards,
+                           r=rs.parity_shards,
+                           backend=rs.engine.name), \
+            open(dat_path, "rb") as dat:
         outputs = [open(base_file_name + to_ext(i), "wb") for i in range(rs.total_shards)]
         try:
             while remaining > large_block_size * rs.data_shards:
@@ -117,16 +122,20 @@ def rebuild_ec_files(base_file_name: str, rs: Optional[ReedSolomon] = None,
     outputs = {i: open(base_file_name + to_ext(i), "wb") for i in generated}
     ok = False
     try:
-        offset = 0
-        while offset < shard_size:
-            n = min(chunk, shard_size - offset)
-            shards: list[Optional[np.ndarray]] = [None] * rs.total_shards
-            for i, f in inputs.items():
-                shards[i] = np.frombuffer(os.pread(f.fileno(), n, offset), dtype=np.uint8)
-            rs.reconstruct(shards)
-            for i in generated:
-                outputs[i].write(shards[i].tobytes())
-            offset += n
+        with get_tracer().span("ec.rebuild_ec_files", path=base_file_name,
+                               missing=len(generated), k=rs.data_shards,
+                               r=rs.parity_shards, backend=rs.engine.name):
+            offset = 0
+            while offset < shard_size:
+                n = min(chunk, shard_size - offset)
+                shards: list[Optional[np.ndarray]] = [None] * rs.total_shards
+                for i, f in inputs.items():
+                    shards[i] = np.frombuffer(
+                        os.pread(f.fileno(), n, offset), dtype=np.uint8)
+                rs.reconstruct(shards)
+                for i in generated:
+                    outputs[i].write(shards[i].tobytes())
+                offset += n
         ok = True
     finally:
         for f in inputs.values():

@@ -42,6 +42,8 @@ def _worker_main(in_name: str, out_name: str, k: int, r: int, b: int,
     if native.load() is None:  # pragma: no cover - parent checked first
         acks.put(("err", "native gf256 unavailable"))
         return
+    import time as _time
+
     mat = np.frombuffer(mat_bytes, dtype=np.uint8).reshape(r, k)
     shm_in = shared_memory.SharedMemory(name=in_name)
     shm_out = shared_memory.SharedMemory(name=out_name)
@@ -57,11 +59,14 @@ def _worker_main(in_name: str, out_name: str, k: int, r: int, b: int,
                 break
             bi, n = msg
             try:
+                # wall-clock compute window rides the ack: the parent's
+                # tracer merges it as a worker.compute span on drain
+                t0 = _time.time()
                 native.gf_matmul_ptrs(
                     mat,
                     [in0 + (bi * k + i) * b for i in range(k)],
                     [out0 + (bi * r + j) * b for j in range(r)], n)
-                acks.put(("done", bi))
+                acks.put(("done", bi, t0, _time.time()))
             except Exception as e:  # pragma: no cover - native errors
                 acks.put(("err", f"{type(e).__name__}: {e}"))
         del ins, outs
@@ -73,6 +78,7 @@ def _worker_main(in_name: str, out_name: str, k: int, r: int, b: int,
 def _file_worker_main(out_name: str, r: int, b: int, nbufs: int,
                       mat_bytes: bytes, k: int, jobs, acks) -> None:
     import mmap as mmap_mod
+    import time as _time
 
     from .. import native
 
@@ -107,19 +113,24 @@ def _file_worker_main(out_name: str, r: int, b: int, nbufs: int,
                     acks.put(("opened", msg[1]))
                     continue
                 slot, base, block, n = msg
+                t0 = _time.time()
                 native.gf_matmul_ptrs(
                     mat,
                     [in_addr + base + i * block for i in range(k)],
                     [out0 + (slot * r + j) * b for j in range(r)], n)
-                acks.put(("done", slot))
+                acks.put(("done", slot, t0, _time.time()))
             except Exception as e:
                 # the file vanished under us (compaction/rename) or the
                 # job failed: report, don't die — the parent falls back
                 acks.put(("err", f"{type(e).__name__}: {e}"))
+        del outs  # exported view must drop before the shm closes
     finally:
         if in_map is not None:
             in_map.close()
-        shm_out.close()
+        try:
+            shm_out.close()
+        except BufferError:  # pragma: no cover - abnormal exit w/ views
+            pass
 
 
 class _ParityWorkerBase:
@@ -151,10 +162,15 @@ class _ParityWorkerBase:
                                  args=self._spawn_args(mat, extra_shm),
                                  daemon=True)
         self._proc.start()
-        kind, detail = self._ack()
+        # wall-clock [t0, t1) of the most recent fetched job — the
+        # serializable span log the parent's tracer merges on drain
+        self.last_job_span: tuple[float, float] | None = None
+        self.worker_pid = 0
+        kind, detail, *_rest = self._ack()
         if kind != "ready":
             self.close()
             raise RuntimeError(f"parity worker failed: {detail}")
+        self.worker_pid = detail
 
     def _spawn_args(self, mat, extra_shm):  # pragma: no cover - abstract
         raise NotImplementedError
@@ -177,10 +193,13 @@ class _ParityWorkerBase:
 
     def fetch(self, ticket: int) -> np.ndarray:
         """Block until the ticket's parity is ready; returns the [r, b]
-        shared-memory view (valid until the buffer index is reused)."""
-        kind, got = self._ack()
+        shared-memory view (valid until the buffer index is reused).
+        The job's wall-clock compute window lands in last_job_span."""
+        kind, got, *timing = self._ack()
         if kind != "done" or got != ticket:
             raise RuntimeError(f"parity worker protocol: {kind} {got}")
+        self.last_job_span = (timing[0], timing[1]) if len(timing) == 2 \
+            else None
         return self._outs[ticket]
 
     def _close_extra(self) -> None:
@@ -266,7 +285,7 @@ class FileParityWorker(_ParityWorkerBase):
 
     def open(self, path: str) -> None:
         self._jobs.put(("open", path))
-        kind, got = self._ack()
+        kind, got, *_rest = self._ack()
         if kind != "opened" or got != path:
             raise RuntimeError(f"parity worker open: {kind} {got}")
 

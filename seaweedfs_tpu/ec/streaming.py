@@ -29,12 +29,14 @@ tests enforce byte-identical shards against the CPU path.
 from __future__ import annotations
 
 import os
+import sys
 import time
 from collections import OrderedDict, deque
 from typing import Iterator, Optional
 
 import numpy as np
 
+from ..observability import get_tracer
 from ..utils.ioutil import pread_padded, preadv_into
 from .gf256 import mat_invert, mat_mul
 from .layout import (
@@ -107,7 +109,8 @@ class StreamingEncoder:
                  matrix_kind: str = "vandermonde",
                  dispatch_mb: int = 8, depth: int = 3,
                  engine: str = "auto", mesh: Optional[bool] = None,
-                 zero_copy: bool = True, overlap: str = "auto"):
+                 zero_copy: bool = True, overlap: str = "auto",
+                 tracer=None):
         """engine: 'auto' uses the jax device path on a real accelerator
         and the host SIMD codec otherwise (jax-on-CPU is a correctness
         surface, ~200x slower than the AVX2 codec); 'device' forces the
@@ -230,6 +233,24 @@ class StreamingEncoder:
         #   wall_s       whole-call wall clock
         # overlap efficiency ~= 1 - drain_wait_s / wall_s
         self.stats: dict[str, float] = {}
+        # span tracer (observability/tracer.py): None follows the
+        # process-global tracer, which is a no-op until enabled — the
+        # per-dispatch spans below cost one attribute check when dormant
+        self.tracer = tracer
+
+    def _tracer(self):
+        return self.tracer if self.tracer is not None else get_tracer()
+
+    def _merge_worker_span(self, tr, worker, root_id, dispatch: int) -> None:
+        """Fold the worker process's compute window (shipped back in its
+        ack — a serializable span log) into the parent timeline, parented
+        under the pipeline's root span."""
+        job = getattr(worker, "last_job_span", None)
+        if job is not None:
+            tr.add_span("worker.compute", job[0], job[1], parent_id=root_id,
+                        thread=f"ec-worker-{worker.worker_pid}",
+                        tid=worker.worker_pid, dispatch=dispatch,
+                        worker_pid=worker.worker_pid)
 
     # --- kernel dispatch --------------------------------------------------
     def _planes(self, rows: np.ndarray):
@@ -401,6 +422,10 @@ class StreamingEncoder:
         clock = time.perf_counter
         t_start = clock()
         file_size = os.path.getsize(dat_path)
+        tr = self._tracer()
+        root = tr.span("pipeline.encode_file", path=dat_path,
+                       bytes=file_size, mode="mmap", engine=self.engine)
+        root.__enter__()
         shard_size = _shard_size(file_size, k, large, small)
         mat = np.ascontiguousarray(self.matrix[k:])
         # "r+b" when the shard file already exists: every byte of every
@@ -410,22 +435,33 @@ class StreamingEncoder:
         # page only for the pwrites/stores to re-allocate (and re-zero)
         # them all
         outs = []
-        for i in range(k + r):
-            p = out_base + to_ext(i)
-            outs.append(open(p, "r+b" if os.path.exists(p) else "w+b"))
-        out_fds = [f.fileno() for f in outs]
-        in_f = open(dat_path, "rb")
+        try:
+            for i in range(k + r):
+                p = out_base + to_ext(i)
+                outs.append(open(p, "r+b" if os.path.exists(p) else "w+b"))
+            out_fds = [f.fileno() for f in outs]
+            in_f = open(dat_path, "rb")
+        except BaseException:
+            # the finally below never runs if we die before its try:
+            # close what opened and unwind the span stack (tagging the
+            # root span with the real exception)
+            for f in outs:
+                f.close()
+            root.__exit__(*sys.exc_info())
+            raise
         in_map = None
         in_mv = None
         tail_buf: Optional[np.ndarray] = None
         parity_maps: list = []
         parity_addrs: list[int] = []
+        ok = False
         try:
             for f in outs:
                 # full-size upfront: pwrite fills real bytes; anything a
                 # tail entry skips past EOF stays a correct zero
                 f.truncate(shard_size)
             if shard_size == 0:
+                ok = True
                 return
             # parity outputs are mmap'd so the SIMD kernel stores parity
             # STRAIGHT into the page cache — one pass instead of the old
@@ -479,36 +515,45 @@ class StreamingEncoder:
 
             def drain_one():
                 nonlocal worker
-                slot, n, off, base, block = pending.popleft()
+                slot, n, off, base, block, d_idx = pending.popleft()
                 parity = None
                 if worker is not None:
                     t0 = clock()
-                    try:
-                        parity = worker.fetch(slot)[:, :n]
-                    except Exception:
-                        # worker died mid-encode (OOM kill, segfault):
-                        # recompute the lost dispatches serially and
-                        # finish the encode without it
-                        self._drop_file_worker()
-                        worker = None
+                    with tr.span("pipeline.drain", dispatch=d_idx):
+                        try:
+                            parity = worker.fetch(slot)[:, :n]
+                        except Exception:
+                            # worker died mid-encode (OOM kill, segfault):
+                            # recompute the lost dispatches serially and
+                            # finish the encode without it
+                            self._drop_file_worker()
+                            worker = None
                     st["drain_wait_s"] += clock() - t0
+                    if parity is not None:
+                        self._merge_worker_span(tr, worker, root.span_id,
+                                                d_idx)
                 if parity is None:
                     t0 = clock()
-                    matmul_ptrs(
-                        mat,
-                        [in_addr + base + i * block for i in range(k)],
-                        [a + off for a in parity_mappings()], n)
+                    with tr.span("pipeline.compute", dispatch=d_idx,
+                                 bytes=k * n):
+                        matmul_ptrs(
+                            mat,
+                            [in_addr + base + i * block for i in range(k)],
+                            [a + off for a in parity_mappings()], n)
                     st["dispatch_s"] += clock() - t0
                 else:
                     t0 = clock()
-                    for j in range(r):
-                        os.pwrite(out_fds[k + j],
-                                  memoryview(parity[j, :n]), off)
+                    with tr.span("pipeline.write", dispatch=d_idx,
+                                 kind="parity"):
+                        for j in range(r):
+                            os.pwrite(out_fds[k + j],
+                                      memoryview(parity[j, :n]), off)
                     st["write_s"] += clock() - t0
                 t0 = clock()
-                for i in range(k):
-                    s = base + i * block
-                    os.pwrite(out_fds[i], in_mv[s:s + n], off)
+                with tr.span("pipeline.write", dispatch=d_idx, kind="data"):
+                    for i in range(k):
+                        s = base + i * block
+                        os.pwrite(out_fds[i], in_mv[s:s + n], off)
                 st["write_s"] += clock() - t0
 
             try:
@@ -523,9 +568,13 @@ class StreamingEncoder:
                             slot = slot_seq % worker.nbufs
                             slot_seq += 1
                             t0 = clock()
-                            worker.submit(slot, base, block, n)
+                            with tr.span("pipeline.dispatch",
+                                         dispatch=st["dispatches"],
+                                         bytes=k * n):
+                                worker.submit(slot, base, block, n)
                             st["dispatch_s"] += clock() - t0
-                            pending.append((slot, n, out_off, base, block))
+                            pending.append((slot, n, out_off, base, block,
+                                            st["dispatches"]))
                             st["dispatches"] += 1
                             st["bytes_in"] += k * n
                             out_off += n
@@ -534,42 +583,55 @@ class StreamingEncoder:
                         # in place from the mapping, parity stored
                         # straight into the output mappings
                         t0 = clock()
-                        matmul_ptrs(
-                            mat,
-                            [in_addr + base + i * block for i in range(k)],
-                            [a + out_off for a in parity_mappings()], n)
+                        with tr.span("pipeline.compute",
+                                     dispatch=st["dispatches"], bytes=k * n):
+                            matmul_ptrs(
+                                mat,
+                                [in_addr + base + i * block
+                                 for i in range(k)],
+                                [a + out_off for a in parity_mappings()], n)
                         st["dispatch_s"] += clock() - t0
                         t0 = clock()
-                        for i in range(k):
-                            s = base + i * block
-                            os.pwrite(out_fds[i], in_mv[s:s + n], out_off)
+                        with tr.span("pipeline.write",
+                                     dispatch=st["dispatches"], kind="data"):
+                            for i in range(k):
+                                s = base + i * block
+                                os.pwrite(out_fds[i], in_mv[s:s + n],
+                                          out_off)
                         st["write_s"] += clock() - t0
                     else:
                         # tail entry: some rows cross EOF — stage through
                         # a zero-padded buffer (ec_encoder.go:172-176)
                         t0 = clock()
-                        if tail_buf is None or tail_buf.shape[1] < n:
-                            tail_buf = np.zeros((k, n), dtype=np.uint8)
-                        else:
-                            tail_buf[:, :n] = 0
-                        for i in range(k):
-                            s = base + i * block
-                            e = min(file_size, s + n)
-                            if e > s:
-                                tail_buf[i, :e - s] = in_arr[s:e]
+                        with tr.span("pipeline.fill",
+                                     dispatch=st["dispatches"], tail=True):
+                            if tail_buf is None or tail_buf.shape[1] < n:
+                                tail_buf = np.zeros((k, n), dtype=np.uint8)
+                            else:
+                                tail_buf[:, :n] = 0
+                            for i in range(k):
+                                s = base + i * block
+                                e = min(file_size, s + n)
+                                if e > s:
+                                    tail_buf[i, :e - s] = in_arr[s:e]
                         st["fill_s"] += clock() - t0
                         t0 = clock()
                         buf = tail_buf[:, :n]
                         row = buf.strides[0]
-                        matmul_ptrs(
-                            mat,
-                            [buf.ctypes.data + i * row for i in range(k)],
-                            [a + out_off for a in parity_mappings()], n)
+                        with tr.span("pipeline.compute",
+                                     dispatch=st["dispatches"], bytes=k * n):
+                            matmul_ptrs(
+                                mat,
+                                [buf.ctypes.data + i * row
+                                 for i in range(k)],
+                                [a + out_off for a in parity_mappings()], n)
                         st["dispatch_s"] += clock() - t0
                         t0 = clock()
-                        for i in range(k):
-                            os.pwrite(out_fds[i], memoryview(buf[i]),
-                                      out_off)
+                        with tr.span("pipeline.write",
+                                     dispatch=st["dispatches"], kind="data"):
+                            for i in range(k):
+                                os.pwrite(out_fds[i], memoryview(buf[i]),
+                                          out_off)
                         st["write_s"] += clock() - t0
                     st["dispatches"] += 1
                     st["bytes_in"] += k * n
@@ -587,6 +649,7 @@ class StreamingEncoder:
                 if in_mv is not None:
                     in_mv.release()
                 del in_arr
+            ok = True
         finally:
             t0 = clock()
             for pm in parity_maps:
@@ -601,6 +664,10 @@ class StreamingEncoder:
                 f.close()
             st["close_s"] = clock() - t0
             st["wall_s"] = clock() - t_start
+            # a failed encode tags the root span with the in-flight
+            # exception (ok gates against a stale caller-level exc_info)
+            root.__exit__(*(sys.exc_info() if not ok
+                            else (None, None, None)))
 
     def encode_file(self, dat_path: str, out_base: str,
                     large_block_size: int = LARGE_BLOCK_SIZE,
@@ -618,34 +685,64 @@ class StreamingEncoder:
         t_start = clock()
         planes = self._planes(self.matrix[k:])
         file_size = os.path.getsize(dat_path)
-        outputs = [open(out_base + to_ext(i), "wb") for i in range(k + r)]
-        if self.engine == "host" and self._overlap == "process":
-            if self._proc_worker is not None and self._proc_worker.b != b:
-                self._proc_worker.close()  # dispatch width changed
-                self._proc_worker = None
-            if self._proc_worker is None:
-                from .overlap import ProcessOverlapWorker
+        tr = self._tracer()
+        root = tr.span("pipeline.encode_file", path=dat_path,
+                       bytes=file_size, mode="staged", engine=self.engine)
+        root.__enter__()
+        # setup covers output opens (O_TRUNC over existing shards frees
+        # their page cache — real, attributable time), buffer allocation
+        # and worker spawn; ends when the first entry is planned
+        setup = tr.span("pipeline.setup")
+        setup.__enter__()
+        outputs: list = []
+        try:
+            for i in range(k + r):
+                outputs.append(open(out_base + to_ext(i), "wb"))
+            if self.engine == "host" and self._overlap == "process":
+                if self._proc_worker is not None \
+                        and self._proc_worker.b != b:
+                    self._proc_worker.close()  # dispatch width changed
+                    self._proc_worker = None
+                if self._proc_worker is None:
+                    from .overlap import ProcessOverlapWorker
 
-                self._proc_worker = ProcessOverlapWorker(
-                    k, r, b, self.matrix[k:], self.depth + 1)
-        # process overlap: dispatch buffers ARE the shared-memory pool
-        bufs = self._proc_worker.bufs if self._proc_worker is not None \
-            else [np.zeros((k, b), dtype=np.uint8)
-                  for _ in range(self.depth + 1)]
+                    self._proc_worker = ProcessOverlapWorker(
+                        k, r, b, self.matrix[k:], self.depth + 1)
+            # process overlap: dispatch buffers ARE the shared-memory pool
+            bufs = self._proc_worker.bufs \
+                if self._proc_worker is not None \
+                else [np.zeros((k, b), dtype=np.uint8)
+                      for _ in range(self.depth + 1)]
+        except BaseException:
+            # the main finally never runs if setup dies: close what
+            # opened and unwind the span stack
+            for f in outputs:
+                f.close()
+            exc = sys.exc_info()
+            setup.__exit__(*exc)
+            root.__exit__(*exc)
+            raise
         free: deque[int] = deque(range(len(bufs)))
-        # (device parity, packed width, buffer index)
-        pending: deque[tuple[object, int, int]] = deque()
+        # (device parity, packed width, buffer index, dispatch index)
+        pending: deque[tuple[object, int, int, int]] = deque()
+
+        ok = False
 
         def drain_one():
-            parity_dev, u, bi = pending.popleft()
+            parity_dev, u, bi, d_idx = pending.popleft()
             t0 = clock()
-            parity = self._fetch(parity_dev)
+            with tr.span("pipeline.drain", dispatch=d_idx, bytes=r * u):
+                parity = self._fetch(parity_dev)
             st["drain_wait_s"] += clock() - t0
+            if self._proc_worker is not None:
+                self._merge_worker_span(tr, self._proc_worker,
+                                        root.span_id, d_idx)
             t0 = clock()
             # entries pack side by side, so each parity row's bytes for
             # this dispatch are one contiguous slice
-            for j in range(r):
-                outputs[k + j].write(memoryview(parity[j, :u]))
+            with tr.span("pipeline.write", dispatch=d_idx, kind="parity"):
+                for j in range(r):
+                    outputs[k + j].write(memoryview(parity[j, :u]))
             st["write_s"] += clock() - t0
             free.append(bi)
 
@@ -659,32 +756,39 @@ class StreamingEncoder:
                     nonlocal bi, used, fills
                     if not used:
                         return
+                    d_idx = st["dispatches"]
                     buf = bufs[bi]
                     t0 = clock()
-                    for col, n, row_start, block, off in fills:
-                        if off == 0 and n == block:
-                            # whole-block entry: the k per-shard reads are
-                            # CONTIGUOUS in the file ([row_start, +k*block))
-                            # — one vectored read straight into the k
-                            # strided buffer slices, no intermediate copy
-                            # (small rows always take this path; chunked
-                            # 1GB rows fall through)
-                            preadv_into(
-                                dat, [buf[i, col:col + n] for i in range(k)],
-                                row_start)
-                        else:
-                            for i in range(k):
-                                buf[i, col:col + n] = pread_padded(
-                                    dat, n, row_start + i * block + off)
-                    if used < b:
-                        buf[:, used:] = 0
+                    with tr.span("pipeline.fill", dispatch=d_idx,
+                                 bytes=k * used):
+                        for col, n, row_start, block, off in fills:
+                            if off == 0 and n == block:
+                                # whole-block entry: the k per-shard reads
+                                # are CONTIGUOUS in the file
+                                # ([row_start, +k*block)) — one vectored
+                                # read straight into the k strided buffer
+                                # slices, no intermediate copy (small rows
+                                # always take this path; chunked 1GB rows
+                                # fall through)
+                                preadv_into(
+                                    dat,
+                                    [buf[i, col:col + n] for i in range(k)],
+                                    row_start)
+                            else:
+                                for i in range(k):
+                                    buf[i, col:col + n] = pread_padded(
+                                        dat, n, row_start + i * block + off)
+                        if used < b:
+                            buf[:, used:] = 0
                     st["fill_s"] += clock() - t0
                     t0 = clock()
-                    if self._proc_worker is not None:
-                        parity_dev = ("proc",
-                                      self._proc_worker.submit(bi, used))
-                    else:
-                        parity_dev = self._dispatch(planes, buf)
+                    with tr.span("pipeline.dispatch", dispatch=d_idx,
+                                 bytes=k * used):
+                        if self._proc_worker is not None:
+                            parity_dev = ("proc",
+                                          self._proc_worker.submit(bi, used))
+                        else:
+                            parity_dev = self._dispatch(planes, buf)
                     st["dispatch_s"] += clock() - t0
                     st["dispatches"] += 1
                     st["bytes_in"] += k * used
@@ -692,10 +796,12 @@ class StreamingEncoder:
                     # the device computes parity; packed entries make each
                     # shard's bytes one contiguous slice
                     t0 = clock()
-                    for i in range(k):
-                        outputs[i].write(memoryview(buf[i, :used]))
+                    with tr.span("pipeline.write", dispatch=d_idx,
+                                 kind="data"):
+                        for i in range(k):
+                            outputs[i].write(memoryview(buf[i, :used]))
                     st["write_s"] += clock() - t0
-                    pending.append((parity_dev, used, bi))
+                    pending.append((parity_dev, used, bi, d_idx))
                     fills, used = [], 0
                     if len(pending) > self.depth:
                         drain_one()
@@ -703,6 +809,9 @@ class StreamingEncoder:
                         drain_one()
                     bi = free.popleft()
 
+                st["setup_s"] = clock() - t_start
+                setup.__exit__(None, None, None)
+                setup = None
                 for n, row_start, block, off in _plan_entries(
                         file_size, k, large_block_size, small_block_size, b):
                     if used + n > b:
@@ -712,10 +821,18 @@ class StreamingEncoder:
                 flush()
                 while pending:
                     drain_one()
+            ok = True
         finally:
-            for f in outputs:
-                f.close()
+            exc = sys.exc_info() if not ok else (None, None, None)
+            if setup is not None:  # failed before the loop started
+                setup.__exit__(*exc)
+            t0 = clock()
+            with tr.span("pipeline.close"):
+                for f in outputs:
+                    f.close()
+            st["close_s"] = clock() - t0
             st["wall_s"] = clock() - t_start
+            root.__exit__(*exc)
 
     def _rebuild_files_mmap(self, base: str, missing: list[int],
                             survivors: list[int], rec: np.ndarray,
@@ -730,9 +847,21 @@ class StreamingEncoder:
         st = self._reset_stats()
         clock = time.perf_counter
         t_start = clock()
+        tr = self._tracer()
+        root = tr.span("pipeline.rebuild_files", path=base, mode="mmap",
+                       missing=len(missing), engine=self.engine)
+        root.__enter__()
         rec = np.ascontiguousarray(rec)
         nm = len(missing)
-        in_fs = [open(base + to_ext(i), "rb") for i in survivors]
+        in_fs = []
+        try:
+            for i in survivors:
+                in_fs.append(open(base + to_ext(i), "rb"))
+        except BaseException:
+            for f in in_fs:
+                f.close()
+            root.__exit__(*sys.exc_info())
+            raise
         in_maps: list = []
         out_fs: list = []
         out_maps: list = []
@@ -773,9 +902,12 @@ class StreamingEncoder:
                 for offset in range(0, shard_size, b):
                     n = min(b, shard_size - offset)
                     t0 = clock()
-                    matmul_ptrs(rec,
-                                [a + offset for a in in_addr],
-                                [a + offset for a in out_addrs], n)
+                    with tr.span("pipeline.compute",
+                                 dispatch=st["dispatches"],
+                                 bytes=len(survivors) * n):
+                        matmul_ptrs(rec,
+                                    [a + offset for a in in_addr],
+                                    [a + offset for a in out_addrs], n)
                     st["dispatch_s"] += clock() - t0
                     st["dispatches"] += 1
                     st["bytes_in"] += len(survivors) * n
@@ -801,6 +933,8 @@ class StreamingEncoder:
                     except OSError:
                         pass
             st["wall_s"] = clock() - t_start
+            root.__exit__(*(sys.exc_info() if not ok
+                            else (None, None, None)))
 
     # --- rebuild ----------------------------------------------------------
     def rebuild_files(self, base_file_name: str) -> list[int]:
@@ -861,15 +995,22 @@ class StreamingEncoder:
         st = self._reset_stats()
         clock = time.perf_counter
         t_start = clock()
+        tr = self._tracer()
+        root = tr.span("pipeline.rebuild_files", path=base_file_name,
+                       mode="staged", missing=len(missing),
+                       engine=self.engine)
+        root.__enter__()
 
         def drain_one():
-            out_dev, n, bi = pending.popleft()
+            out_dev, n, bi, d_idx = pending.popleft()
             t0 = clock()
-            out = self._fetch(out_dev)
+            with tr.span("pipeline.drain", dispatch=d_idx):
+                out = self._fetch(out_dev)
             st["drain_wait_s"] += clock() - t0
             t0 = clock()
-            for row_i, m in enumerate(missing):
-                outputs[m].write(out[row_i, :n])
+            with tr.span("pipeline.write", dispatch=d_idx, kind="rebuilt"):
+                for row_i, m in enumerate(missing):
+                    outputs[m].write(out[row_i, :n])
             st["write_s"] += clock() - t0
             free.append(bi)
 
@@ -881,14 +1022,20 @@ class StreamingEncoder:
                     drain_one()
                 bi = free.popleft()
                 buf = bufs[bi]
+                d_idx = st["dispatches"]
                 t0 = clock()
-                for row_i, s in enumerate(survivors):
-                    preadv_into(inputs[s], [buf[row_i, :n]], offset)
-                if n < b:
-                    buf[:, n:] = 0
+                with tr.span("pipeline.fill", dispatch=d_idx,
+                             bytes=len(survivors) * n):
+                    for row_i, s in enumerate(survivors):
+                        preadv_into(inputs[s], [buf[row_i, :n]], offset)
+                    if n < b:
+                        buf[:, n:] = 0
                 st["fill_s"] += clock() - t0
                 t0 = clock()
-                pending.append((self._dispatch(planes, buf), n, bi))
+                with tr.span("pipeline.dispatch", dispatch=d_idx,
+                             bytes=len(survivors) * n):
+                    pending.append((self._dispatch(planes, buf), n, bi,
+                                    d_idx))
                 st["dispatch_s"] += clock() - t0
                 st["dispatches"] += 1
                 st["bytes_in"] += len(survivors) * n
@@ -911,4 +1058,6 @@ class StreamingEncoder:
                     except OSError:
                         pass
             st["wall_s"] = clock() - t_start
+            root.__exit__(*(sys.exc_info() if not ok
+                            else (None, None, None)))
         return missing

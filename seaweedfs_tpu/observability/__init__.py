@@ -1,0 +1,19 @@
+"""Observability: span tracing for the EC pipeline and HTTP servers.
+
+See tracer.py for the model.  Quick use:
+
+    from seaweedfs_tpu.observability import enable_tracing, get_tracer
+    tracer = enable_tracing()
+    ...  # run the pipeline / serve requests
+    open("trace.json", "w").write(json.dumps(tracer.to_chrome()))
+
+Every server also exposes GET /debug/traces (the same Chrome trace JSON)
+and, with the Prometheus bridge attached, span latency histograms on
+/metrics as SeaweedFS_trace_span_seconds{name=...}.
+"""
+
+from .tracer import (Span, Tracer, disable_tracing, enable_tracing,
+                     get_tracer)
+
+__all__ = ["Span", "Tracer", "get_tracer", "enable_tracing",
+           "disable_tracing"]

@@ -1,0 +1,348 @@
+"""Thread-safe span tracer with Chrome trace-event + Prometheus exporters.
+
+The EC pipeline's four coarse counters (fill_s/dispatch_s/write_s/
+drain_wait_s in ec/streaming.py) say WHERE time went in aggregate but not
+WHEN: BENCH_r05 showed drain_wait_s eating ~90% of the e2e wall with no
+way to see which dispatch, stage, or host/device boundary it vanished
+into.  This module turns those counters into per-dispatch spans:
+
+  with tracer.span("pipeline.dispatch", dispatch=3, bytes=n):
+      ...
+
+Design constraints, in order:
+
+  - near-zero cost when disabled: span() on a disabled tracer returns a
+    shared no-op context manager (one attribute check, no allocation) so
+    the instrumentation can live permanently on hot paths;
+  - thread-safe: spans nest per-thread via threading.local; the ring
+    append takes one lock;
+  - bounded: spans land in a deque(maxlen=capacity) ring — a long-lived
+    server can trace forever without growing;
+  - mergeable across processes: span ids are namespaced (pid-derived by
+    default) and timestamps are wall-anchored monotonic clocks, so a
+    worker process's serializable span log (export_log/ingest_log, or
+    the overlap workers' timed acks fed through add_span) merges into
+    the parent's timeline without id collisions;
+  - exportable two ways: to_chrome() emits Chrome trace_event JSON
+    (load in chrome://tracing or https://ui.perfetto.dev), and an
+    optional Prometheus bridge observes every span's duration into a
+    stats.metrics Histogram so stage latencies appear on /metrics.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from typing import Optional
+
+# wall-anchored monotonic clock: perf_counter() gives monotonic intervals,
+# the captured offset maps them onto the unix epoch so timestamps from
+# different processes land on one comparable timeline
+_EPOCH_WALL = time.time() - time.perf_counter()
+
+
+def _now() -> float:
+    return _EPOCH_WALL + time.perf_counter()
+
+
+class Span:
+    """One finished span: wall-anchored [t0, t1) plus identity/attrs."""
+
+    __slots__ = ("name", "span_id", "parent_id", "pid", "tid",
+                 "thread", "t0", "t1", "attrs")
+
+    def __init__(self, name: str, span_id: str, parent_id: Optional[str],
+                 pid: str, tid: int, thread: str,
+                 t0: float, t1: float, attrs: dict):
+        self.name = name
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.pid = pid          # namespace string (process identity)
+        self.tid = tid          # thread ident within the namespace
+        self.thread = thread    # human thread name
+        self.t0 = t0
+        self.t1 = t1
+        self.attrs = attrs
+
+    @property
+    def duration(self) -> float:
+        return self.t1 - self.t0
+
+    def to_dict(self) -> dict:
+        """Serializable log entry (export_log/ingest_log wire format)."""
+        return {"name": self.name, "id": self.span_id,
+                "parent": self.parent_id, "pid": self.pid, "tid": self.tid,
+                "thread": self.thread, "t0": self.t0, "t1": self.t1,
+                "attrs": self.attrs}
+
+
+class _NoopSpan:
+    """Shared do-nothing context manager for disabled tracers."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    @property
+    def span_id(self):
+        return None
+
+
+_NOOP = _NoopSpan()
+# public alias: hot paths pre-guard on tracer.enabled and fall back to
+# this shared context manager to skip even the attrs-dict allocation
+NOOP_SPAN = _NOOP
+
+
+class _SpanCtx:
+    """Live span context manager: records on exit, nests via the
+    tracer's per-thread stack, tags the span with the exception type on
+    an abnormal exit."""
+
+    __slots__ = ("tracer", "name", "attrs", "span_id", "parent_id", "t0")
+
+    def __init__(self, tracer: "Tracer", name: str, attrs: dict):
+        self.tracer = tracer
+        self.name = name
+        self.attrs = attrs
+
+    def __enter__(self):
+        tr = self.tracer
+        stack = getattr(tr._stack, "ids", None)
+        if stack is None:
+            stack = tr._stack.ids = []
+        self.parent_id = stack[-1] if stack else None
+        self.span_id = tr._next_id()
+        stack.append(self.span_id)
+        self.t0 = _now()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        t1 = _now()
+        tr = self.tracer
+        stack = tr._stack.ids
+        if stack and stack[-1] == self.span_id:
+            stack.pop()
+        if exc_type is not None:
+            self.attrs["error"] = exc_type.__name__
+        ct = threading.current_thread()
+        sp = Span(self.name, self.span_id, self.parent_id, tr.namespace,
+                  ct.ident or 0, ct.name, self.t0, t1, self.attrs)
+        tr._record(sp)
+        return False
+
+
+class Tracer:
+    """Bounded in-memory span collector; see module docstring."""
+
+    def __init__(self, capacity: int = 8192, enabled: bool = True,
+                 namespace: Optional[str] = None, prometheus: bool = False):
+        self._spans: deque[Span] = deque(maxlen=capacity)
+        self._lock = threading.Lock()
+        self._seq = 0
+        self._stack = threading.local()
+        self.enabled = enabled
+        self.namespace = namespace or f"p{os.getpid():x}"
+        self._hist = _span_histogram() if prometheus else None
+
+    # --- recording --------------------------------------------------------
+    @property
+    def capacity(self) -> int:
+        return self._spans.maxlen or 0
+
+    def span(self, name: str, **attrs):
+        """Context manager for a timed span.  Disabled tracers hand back
+        a shared no-op — the hot-path cost of dormant instrumentation is
+        one attribute check."""
+        if not self.enabled:
+            return _NOOP
+        return _SpanCtx(self, name, attrs)
+
+    def add_span(self, name: str, t0: float, t1: float,
+                 parent_id: Optional[str] = None,
+                 thread: str = "", tid: Optional[int] = None,
+                 **attrs) -> Optional[str]:
+        """Record an externally timed span (wall-clock seconds — e.g.
+        the overlap worker's compute window shipped back in its ack).
+        `tid` places the span on its own thread track (defaults to the
+        calling thread)."""
+        if not self.enabled:
+            return None
+        span_id = self._next_id()
+        ct = threading.current_thread()
+        self._record(Span(name, span_id, parent_id, self.namespace,
+                          tid if tid is not None else (ct.ident or 0),
+                          thread or ct.name, t0, t1, attrs))
+        return span_id
+
+    def _next_id(self) -> str:
+        with self._lock:
+            self._seq += 1
+            return f"{self.namespace}.{self._seq:x}"
+
+    def _record(self, sp: Span) -> None:
+        with self._lock:
+            self._spans.append(sp)
+        if self._hist is not None:
+            self._hist.observe(sp.name, sp.t1 - sp.t0)
+
+    def attach_prometheus(self) -> None:
+        """Bridge span durations into the shared stats REGISTRY so stage
+        latencies appear on every server's /metrics."""
+        self._hist = _span_histogram()
+
+    # --- inspection -------------------------------------------------------
+    def snapshot(self, clear: bool = False) -> list[Span]:
+        """Point-in-time copy; clear=True drains ATOMICALLY so a
+        poll-and-clear capture loop never drops spans recorded between
+        the read and the clear."""
+        with self._lock:
+            spans = list(self._spans)
+            if clear:
+                self._spans.clear()
+            return spans
+
+    def clear(self) -> None:
+        with self._lock:
+            self._spans.clear()
+
+    # --- cross-process merge ----------------------------------------------
+    def export_log(self) -> list[dict]:
+        """Serializable span log (plain dicts; json/pickle/queue-safe)."""
+        return [sp.to_dict() for sp in self.snapshot()]
+
+    def ingest_log(self, log: list[dict], parent_id: Optional[str] = None,
+                   namespace: Optional[str] = None) -> None:
+        """Merge another tracer's export_log().  Ids keep their source
+        namespace (distinct per process by construction), or are
+        re-prefixed with `namespace` when the caller must disambiguate
+        same-namespace logs; entries without a parent are reparented
+        under `parent_id` so worker spans nest below the dispatching
+        span."""
+        if not self.enabled:
+            return
+        spans = []
+        for e in log:
+            sid, pid_ns = e["id"], e.get("pid", "?")
+            par = e.get("parent")
+            if namespace:
+                sid = f"{namespace}/{sid}"
+                par = f"{namespace}/{par}" if par else None
+                pid_ns = f"{namespace}/{pid_ns}"
+            spans.append(Span(e["name"], sid, par or parent_id, pid_ns,
+                              int(e.get("tid", 0)), e.get("thread", ""),
+                              float(e["t0"]), float(e["t1"]),
+                              dict(e.get("attrs") or {})))
+        with self._lock:
+            self._spans.extend(spans)
+        if self._hist is not None:
+            for sp in spans:
+                self._hist.observe(sp.name, sp.t1 - sp.t0)
+
+    # --- Chrome trace-event export ----------------------------------------
+    def to_chrome(self, clear: bool = False) -> dict:
+        """{"traceEvents": [...]} loadable in chrome://tracing/Perfetto.
+        Spans become "X" (complete) events; process/thread metadata rides
+        "M" events.  ts is strictly increasing per (pid, tid) — ties are
+        nudged by 1ns so downstream tooling never sees a zero-width
+        reordering ambiguity.  clear=True drains the ring atomically with
+        the read (the /debug/traces?clear=1 contract)."""
+        spans = self.snapshot(clear=clear)
+        if not spans:
+            return {"traceEvents": [], "displayTimeUnit": "ms"}
+        base = min(sp.t0 for sp in spans)
+        pid_map: dict[str, int] = {}
+        tid_map: dict[tuple, int] = {}
+        meta: list[dict] = []
+        events: list[dict] = []
+        for sp in spans:
+            pid = pid_map.get(sp.pid)
+            if pid is None:
+                pid = pid_map[sp.pid] = len(pid_map) + 1
+                meta.append({"ph": "M", "name": "process_name", "pid": pid,
+                             "args": {"name": sp.pid}})
+            tkey = (pid, sp.tid)
+            tid = tid_map.get(tkey)
+            if tid is None:
+                tid = tid_map[tkey] = len(
+                    [k for k in tid_map if k[0] == pid]) + 1
+                meta.append({"ph": "M", "name": "thread_name", "pid": pid,
+                             "tid": tid, "args": {"name": sp.thread
+                                                  or f"thread-{sp.tid}"}})
+            args = dict(sp.attrs)
+            args["span_id"] = sp.span_id
+            if sp.parent_id:
+                args["parent_id"] = sp.parent_id
+            events.append({"name": sp.name, "ph": "X",
+                           "ts": (sp.t0 - base) * 1e6,
+                           "dur": max((sp.t1 - sp.t0) * 1e6, 1e-3),
+                           "pid": pid, "tid": tid, "args": args})
+        # strictly increasing ts per thread track
+        events.sort(key=lambda e: (e["pid"], e["tid"], e["ts"]))
+        last: dict[tuple, float] = {}
+        for e in events:
+            key = (e["pid"], e["tid"])
+            prev = last.get(key)
+            if prev is not None and e["ts"] <= prev:
+                e["ts"] = prev + 1e-3
+            last[key] = e["ts"]
+        events.sort(key=lambda e: e["ts"])
+        return {"traceEvents": meta + events, "displayTimeUnit": "ms"}
+
+
+# --- Prometheus bridge -------------------------------------------------------
+
+_span_hist = None
+_span_hist_lock = threading.Lock()
+
+
+def _span_histogram():
+    """The shared SeaweedFS_trace_span_seconds family, registered once in
+    the global stats REGISTRY (imported lazily: stats must not become an
+    import-time dependency of every tracer user)."""
+    global _span_hist
+    with _span_hist_lock:
+        if _span_hist is None:
+            from ..stats import REGISTRY
+
+            _span_hist = REGISTRY.histogram(
+                "SeaweedFS_trace_span_seconds",
+                "Span durations from the observability tracer.",
+                labels=("name",))
+        return _span_hist
+
+
+# --- process-global tracer ---------------------------------------------------
+# Servers and instrumented modules record into ONE tracer per process
+# (disabled by default), so /debug/traces and /metrics see every layer's
+# spans without plumbing a tracer handle through each constructor.
+
+_GLOBAL = Tracer(enabled=False)
+
+
+def get_tracer() -> Tracer:
+    return _GLOBAL
+
+
+def enable_tracing(capacity: Optional[int] = None,
+                   prometheus: bool = True) -> Tracer:
+    """Turn on the process-global tracer (optionally resizing its ring)
+    and attach the /metrics histogram bridge.  Returns the tracer."""
+    if capacity is not None and capacity != _GLOBAL.capacity:
+        with _GLOBAL._lock:
+            _GLOBAL._spans = deque(_GLOBAL._spans, maxlen=capacity)
+    if prometheus:
+        _GLOBAL.attach_prometheus()
+    _GLOBAL.enabled = True
+    return _GLOBAL
+
+
+def disable_tracing() -> Tracer:
+    _GLOBAL.enabled = False
+    return _GLOBAL

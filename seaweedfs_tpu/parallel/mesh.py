@@ -30,6 +30,25 @@ from jax.sharding import PartitionSpec as P
 from ..ops.gf_matmul import _pack_bits, _unpack_bitplanes
 
 
+def _shard_map(f, *, mesh, in_specs, out_specs, check_vma=None):
+    """jax.shard_map moved out of jax.experimental in newer releases and
+    renamed check_rep -> check_vma (in DIFFERENT releases — a public
+    jax.shard_map may still only know check_rep).  Dispatch to whatever
+    this jax accepts."""
+    if hasattr(jax, "shard_map"):
+        sm = jax.shard_map
+    else:
+        from jax.experimental.shard_map import shard_map as sm
+    if check_vma is None:
+        return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+    try:
+        return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_vma=check_vma)
+    except TypeError:
+        return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_rep=check_vma)
+
+
 def factor_mesh(n_devices: int) -> tuple[int, int, int]:
     """Factor n into (dp, sp, tp), preferring all three axes real."""
     tp = 2 if n_devices % 2 == 0 else 1
@@ -75,7 +94,7 @@ def sharded_encode_fn(mesh: Mesh):
     -> parity [R, S, B], with S sharded over dp, B over sp, and the
     contraction over tp."""
 
-    shmap = jax.shard_map(
+    shmap = _shard_map(
         _local_gf_matmul,
         mesh=mesh,
         in_specs=(P(None, "tp"), P(None, "dp", "sp")),
@@ -128,7 +147,10 @@ def _ring_rebuild_local(planes_loc: jnp.ndarray,
     shards_loc [K/ring, B]   — this device's survivor shards
     returns    [M, B]        — rebuilt shards (replicated over the ring)
     """
-    ring = jax.lax.axis_size("ring")
+    # axis_size only exists on newer jax; psum(1, axis) is the portable
+    # spelling and folds to a compile-time constant under shard_map
+    ring = (jax.lax.axis_size("ring") if hasattr(jax.lax, "axis_size")
+            else jax.lax.psum(1, "ring"))
     bits = _unpack_bitplanes(shards_loc)  # [8*K/ring, B]
     partial = jnp.dot(planes_loc.astype(jnp.int8), bits.astype(jnp.int8),
                       preferred_element_type=jnp.int32)  # [8M, B] counts
@@ -165,7 +187,7 @@ def ring_rebuild_fn(mesh: Mesh):
     ring_axis = mesh.axis_names[-1]
     flat = Mesh(mesh.devices.reshape(-1), axis_names=("ring",)) \
         if ring_axis != "ring" else mesh
-    shmap = jax.shard_map(
+    shmap = _shard_map(
         _ring_rebuild_local,
         mesh=flat,
         in_specs=(P(None, "ring"), P("ring", None)),

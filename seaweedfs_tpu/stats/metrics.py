@@ -20,10 +20,18 @@ DEFAULT_BUCKETS = (0.0001, 0.0003, 0.001, 0.003, 0.01, 0.03,
                    0.1, 0.3, 1.0, 3.0, 10.0)
 
 
+def _escape_label_value(v: str) -> str:
+    """Prometheus text-format label escaping (backslash, quote, newline):
+    a label value carrying any of them would otherwise corrupt the whole
+    exposition for every scraper."""
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
 def _fmt_labels(label_names: tuple, label_values: tuple) -> str:
     if not label_names:
         return ""
-    pairs = ",".join(f'{k}="{v}"' for k, v in zip(label_names, label_values))
+    pairs = ",".join(f'{k}="{_escape_label_value(str(v))}"'
+                     for k, v in zip(label_names, label_values))
     return "{" + pairs + "}"
 
 
@@ -38,6 +46,15 @@ class Counter:
         key = tuple(str(v) for v in label_values)
         with self._lock:
             self._values[key] = self._values.get(key, 0.0) + amount
+
+    def labels(self, *label_values) -> "_BoundCounter":
+        """Pre-touch a label set (exposes a 0 sample immediately, like
+        prometheus client_golang's GetMetricWithLabelValues) and return
+        a bound child."""
+        key = tuple(str(v) for v in label_values)
+        with self._lock:
+            self._values.setdefault(key, 0.0)
+        return _BoundCounter(self, key)
 
     def value(self, *label_values) -> float:
         return self._values.get(tuple(str(v) for v in label_values), 0.0)
@@ -73,6 +90,12 @@ class Gauge:
         key = tuple(str(v) for v in label_values)
         with self._lock:
             self._values[key] = self._values.get(key, 0.0) + float(delta)
+
+    def labels(self, *label_values) -> "_BoundGauge":
+        key = tuple(str(v) for v in label_values)
+        with self._lock:
+            self._values.setdefault(key, 0.0)
+        return _BoundGauge(self, key)
 
     def value(self, *label_values) -> float:
         return self._values.get(tuple(str(v) for v in label_values), 0.0)
@@ -113,6 +136,17 @@ class Histogram:
             self._sums[key] = self._sums.get(key, 0.0) + obs
             self._totals[key] = self._totals.get(key, 0) + 1
 
+    def labels(self, *label_values) -> "_BoundHistogram":
+        """Pre-touch a label set: the exposition emits every bucket
+        (including +Inf) plus _sum/_count at 0 even before the first
+        observe() — scrapers see the series exists rather than a gap."""
+        key = tuple(str(v) for v in label_values)
+        with self._lock:
+            self._counts.setdefault(key, [0] * len(self.buckets))
+            self._sums.setdefault(key, 0.0)
+            self._totals.setdefault(key, 0)
+        return _BoundHistogram(self, key)
+
     def time(self, *label_values):
         """Context manager: observes elapsed seconds."""
         hist = self
@@ -137,11 +171,14 @@ class Histogram:
                 cumulative += self._counts[lv][i]
                 labels = dict(zip(self.label_names, lv))
                 labels["le"] = _num(bound)
-                pairs = ",".join(f'{k}="{v}"' for k, v in labels.items())
+                pairs = ",".join(
+                    f'{k}="{_escape_label_value(str(v))}"'
+                    for k, v in labels.items())
                 out.append(f"{self.name}_bucket{{{pairs}}} {cumulative}")
             labels = dict(zip(self.label_names, lv))
             labels["le"] = "+Inf"
-            pairs = ",".join(f'{k}="{v}"' for k, v in labels.items())
+            pairs = ",".join(f'{k}="{_escape_label_value(str(v))}"'
+                             for k, v in labels.items())
             out.append(f"{self.name}_bucket{{{pairs}}} {self._totals[lv]}")
             plain = _fmt_labels(self.label_names, lv)
             out.append(f"{self.name}_sum{plain} {_num(self._sums[lv])}")
@@ -151,6 +188,50 @@ class Histogram:
 
 def _num(v: float) -> str:
     return str(int(v)) if float(v).is_integer() else repr(float(v))
+
+
+class _BoundCounter:
+    """Counter child bound to one label set (labels() result)."""
+
+    __slots__ = ("_c", "_lv")
+
+    def __init__(self, c: Counter, lv: tuple):
+        self._c, self._lv = c, lv
+
+    def inc(self, amount: float = 1.0) -> None:
+        self._c.inc(*self._lv, amount=amount)
+
+    def value(self) -> float:
+        return self._c.value(*self._lv)
+
+
+class _BoundGauge:
+    __slots__ = ("_g", "_lv")
+
+    def __init__(self, g: Gauge, lv: tuple):
+        self._g, self._lv = g, lv
+
+    def set(self, value: float) -> None:
+        self._g.set(*self._lv, value)
+
+    def add(self, delta: float) -> None:
+        self._g.add(*self._lv, delta)
+
+    def value(self) -> float:
+        return self._g.value(*self._lv)
+
+
+class _BoundHistogram:
+    __slots__ = ("_h", "_lv")
+
+    def __init__(self, h: Histogram, lv: tuple):
+        self._h, self._lv = h, lv
+
+    def observe(self, obs: float) -> None:
+        self._h.observe(*self._lv, obs)
+
+    def time(self):
+        return self._h.time(*self._lv)
 
 
 class Registry:

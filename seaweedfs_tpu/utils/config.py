@@ -9,8 +9,16 @@ convention the reference's docker compose files rely on.
 from __future__ import annotations
 
 import os
-import tomllib
+import re
 from typing import Any, Optional
+
+try:  # stdlib since 3.11
+    import tomllib
+except ModuleNotFoundError:  # pragma: no cover - version-dependent
+    try:
+        import tomli as tomllib  # type: ignore[no-redef]
+    except ModuleNotFoundError:
+        tomllib = None  # fall back to the minimal parser below
 
 SEARCH_DIRS = [".", os.path.expanduser("~/.seaweedfs"), "/etc/seaweedfs"]
 
@@ -58,14 +66,142 @@ def _coerce(value: str, default: Any) -> Any:
     return value
 
 
+_ESCAPES = {"n": "\n", "t": "\t", "r": "\r", '"': '"', "\\": "\\"}
+
+
+def _unescape(s: str) -> str:
+    """Single left-to-right scan (chained str.replace misorders \\\\n)."""
+    out, i = [], 0
+    while i < len(s):
+        if s[i] == "\\" and i + 1 < len(s):
+            out.append(_ESCAPES.get(s[i + 1], "\\" + s[i + 1]))
+            i += 2
+        else:
+            out.append(s[i])
+            i += 1
+    return "".join(out)
+
+
+def _split_dotted(key: str) -> list[str]:
+    """Split a [table] header on dots OUTSIDE quotes: [sink.local] nests,
+    but ["sink.local"] is ONE flat key (what load_sink consumes)."""
+    parts: list[str] = []
+    cur: list[str] = []
+    quote = ""
+    for ch in key:
+        if quote:
+            if ch == quote:
+                quote = ""
+            else:
+                cur.append(ch)
+        elif ch in ('"', "'"):
+            quote = ch
+        elif ch == ".":
+            parts.append("".join(cur).strip())
+            cur = []
+        else:
+            cur.append(ch)
+    parts.append("".join(cur).strip())
+    return parts
+
+
+def _parse_toml_minimal(text: str) -> dict:
+    """Fallback TOML-subset parser for interpreters without tomllib
+    (stdlib only appeared in 3.11): [a.b] tables, string/int/float/bool
+    scalars and flat arrays — exactly the shapes the reference's
+    security.toml / notification.toml files use."""
+    root: dict = {}
+    node = root
+
+    def value(tok: str) -> Any:
+        tok = tok.strip()
+        if tok.startswith("[") and tok.endswith("]"):
+            inner = tok[1:-1].strip()
+            return [value(t) for t in
+                    re.findall(r'"[^"]*"|\'[^\']*\'|[^,\s]+', inner)] \
+                if inner else []
+        if tok.startswith('"') and tok.endswith('"'):
+            return _unescape(tok[1:-1])  # basic string: honor escapes
+        if tok.startswith("'") and tok.endswith("'"):
+            return tok[1:-1]  # literal string: no escapes in TOML
+        if tok in ("true", "false"):
+            return tok == "true"
+        try:
+            return int(tok)
+        except ValueError:
+            try:
+                return float(tok)
+            except ValueError:
+                return tok
+
+    def strip_comment(line: str) -> str:
+        # cut at the first '#' OUTSIDE quotes — a '#' inside a quoted
+        # value (e.g. a signing secret) is data, not a comment.  Inside
+        # basic (double-quoted) strings a backslash escapes the next
+        # char, so \" must not read as the closing quote.
+        quote = ""
+        i, n = 0, len(line)
+        while i < n:
+            ch = line[i]
+            if quote:
+                if ch == "\\" and quote == '"':
+                    i += 2
+                    continue
+                if ch == quote:
+                    quote = ""
+            elif ch in ('"', "'"):
+                quote = ch
+            elif ch == "#":
+                return line[:i]
+            i += 1
+        return line
+
+    for lineno, raw in enumerate(text.splitlines(), 1):
+        line = strip_comment(raw).strip()
+        if not line:
+            continue
+        if line.startswith("[") and line.endswith("]"):
+            node = root
+            for part in _split_dotted(line[1:-1].strip()):
+                node = node.setdefault(part, {})
+            continue
+        if "=" in line:
+            k, _, v = line.partition("=")
+            v = v.strip()
+            if v == "[" or (v.startswith("[") and not v.endswith("]")):
+                # multi-line arrays are beyond this subset: refuse loudly
+                # rather than feed a silently-truncated config downstream
+                raise ValueError(
+                    f"minimal TOML parser: multi-line array at line "
+                    f"{lineno} unsupported (install tomli or use a "
+                    f"single-line array)")
+            node[k.strip().strip('"').strip("'")] = value(v)
+            continue
+        # neither table header nor key=value: refusing keeps the
+        # fallback honest where stdlib tomllib would parse or raise
+        raise ValueError(
+            f"minimal TOML parser: unsupported syntax at line "
+            f"{lineno}: {line[:60]!r}")
+    return root
+
+
+def load_toml(path: str) -> dict:
+    """Parse one TOML file with whatever this interpreter has: stdlib
+    tomllib (3.11+), tomli, or the minimal fallback parser."""
+    if tomllib is not None:
+        with open(path, "rb") as f:
+            return tomllib.load(f)
+    with open(path, encoding="utf-8") as f:
+        return _parse_toml_minimal(f.read())
+
+
 def load_configuration(name: str, required: bool = False,
                        search_dirs: Optional[list[str]] = None) -> Configuration:
     """util/config.go LoadConfiguration: <name>.toml from the search path."""
     for d in (search_dirs if search_dirs is not None else SEARCH_DIRS):
         path = os.path.join(d, f"{name}.toml")
         if os.path.isfile(path):
-            with open(path, "rb") as f:
-                return Configuration(tomllib.load(f), source=path)
+            return Configuration(load_toml(path), source=path)
     if required:
         raise FileNotFoundError(
             f"{name}.toml not found in {search_dirs or SEARCH_DIRS}")

@@ -201,6 +201,7 @@ def _render_status_html(name: str, status: dict) -> str:
  <a href="/metrics">metrics</a>
  <a href="/debug/pprof/goroutine">threads</a>
  <a href="/debug/pprof/heap">heap</a>
+ <a href="/debug/traces">traces</a>
 </div>
 {body}
 </body></html>"""
@@ -226,6 +227,32 @@ def register_debug_routes(router: Router,
     def pprof_heap(req: Request) -> Response:
         return Response(raw=_heap_text().encode(),
                         headers={"Content-Type": "text/plain; charset=utf-8"})
+
+    @router.route("GET", "/debug/traces")
+    def debug_traces(req: Request) -> Response:
+        """Dump the process-global span ring as Chrome trace-event JSON
+        (load in chrome://tracing or ui.perfetto.dev).  ?enable=1 turns
+        the tracer on for live capture, ?disable=1 turns it off again,
+        ?clear=1 empties the ring after dumping."""
+        from ..observability import (disable_tracing, enable_tracing,
+                                     get_tracer)
+
+        def flag(name: str) -> bool:
+            # allowlist: only explicit affirmatives act — ?clear=off or
+            # ?enable=n must not drain the ring / flip the tracer
+            return req.query.get(name, "").lower() in \
+                ("1", "true", "yes", "on")
+
+        if flag("enable"):
+            enable_tracing()
+        tracer = get_tracer()
+        # clear rides the same lock as the read: spans recorded while
+        # this dump renders are never silently dropped
+        doc = tracer.to_chrome(clear=flag("clear"))
+        if flag("disable"):
+            disable_tracing()
+        return Response(raw=json.dumps(doc).encode(),
+                        headers={"Content-Type": "application/json"})
 
     if status_fn is not None:
         @router.route("GET", "/ui")

@@ -20,6 +20,8 @@ import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Optional
 
+from ..observability import get_tracer as _get_tracer
+
 
 class HttpError(Exception):
     def __init__(self, status: int, message: str = "",
@@ -157,6 +159,13 @@ class Router:
 
         def deco(fn):
             self.routes.append((method, compiled, fn))
+            if self.metrics is not None:
+                # pre-touch each handler's series at registration so
+                # /metrics exposes zero-valued counters and full (+Inf/
+                # _sum/_count) histograms before first traffic — absent
+                # series break rate() dashboards and alerts
+                self.metrics.request_counter.labels(fn.__name__)
+                self.metrics.request_histogram.labels(fn.__name__)
             return fn
 
         return deco
@@ -180,8 +189,19 @@ class Router:
             if match:
                 t0 = _time.perf_counter()
                 req = Request(handler, match)
+                # request span: the path carries the needle/volume id for
+                # object routes (/<vid>,<fid>), so a trace timeline can be
+                # joined back to specific keys.  Guarded on enabled so the
+                # dormant cost on this hottest path is one attribute check
+                # — no name f-string, no attrs dict.
+                tracer = _get_tracer()
                 try:
-                    resp = fn(req)
+                    if tracer.enabled:
+                        with tracer.span(f"http.{self.name}.{fn.__name__}",
+                                         method=method, path=path):
+                            resp = fn(req)
+                    else:
+                        resp = fn(req)
                 except Exception as e:  # noqa: BLE001 — server must not die
                     resp = None
                     if self.error_handler is not None:

@@ -17,6 +17,9 @@ import subprocess
 import threading
 from typing import Optional
 
+from ..observability import get_tracer
+from ..observability.tracer import NOOP_SPAN
+
 _DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
                     "native")
 _LIB_PATH = os.path.join(_DIR, "libdataplane.so")
@@ -169,25 +172,35 @@ class NativeDataPlane:
 
     def append(self, vid: int, key: int, cookie: int, record: bytes,
                size: int) -> None:
-        buf = (ctypes.c_ubyte * len(record)).from_buffer_copy(record)
-        rc = self._lib.dp_append(self._handle(), vid, key, cookie, buf,
-                                 len(record), size)
+        # per-needle hot path: attrs dicts only when the tracer is live
+        tr = get_tracer()
+        with (tr.span("dataplane.append", vid=vid, key=key,
+                      bytes=len(record)) if tr.enabled else NOOP_SPAN):
+            buf = (ctypes.c_ubyte * len(record)).from_buffer_copy(record)
+            rc = self._lib.dp_append(self._handle(), vid, key, cookie, buf,
+                                     len(record), size)
         if rc != DP_OK:
             _raise(rc, f"append {vid},{key:x}")
 
     def write(self, vid: int, key: int, cookie: int, data: bytes) -> int:
         out = ctypes.c_uint()
-        buf = (ctypes.c_ubyte * len(data)).from_buffer_copy(data)
-        rc = self._lib.dp_write(self._handle(), vid, key, cookie, buf, len(data),
-                                ctypes.byref(out))
+        tr = get_tracer()
+        with (tr.span("dataplane.write", vid=vid, key=key,
+                      bytes=len(data)) if tr.enabled else NOOP_SPAN):
+            buf = (ctypes.c_ubyte * len(data)).from_buffer_copy(data)
+            rc = self._lib.dp_write(self._handle(), vid, key, cookie, buf,
+                                    len(data), ctypes.byref(out))
         if rc != DP_OK:
             _raise(rc, f"write {vid},{key:x}")
         return out.value
 
     def delete(self, vid: int, key: int, cookie: int) -> int:
         out = ctypes.c_uint()
-        rc = self._lib.dp_delete(self._handle(), vid, key, cookie,
-                                 ctypes.byref(out))
+        tr = get_tracer()
+        with (tr.span("dataplane.delete", vid=vid, key=key)
+              if tr.enabled else NOOP_SPAN):
+            rc = self._lib.dp_delete(self._handle(), vid, key, cookie,
+                                     ctypes.byref(out))
         if rc != DP_OK:
             _raise(rc, f"delete {vid},{key:x}")
         return out.value
@@ -200,17 +213,21 @@ class NativeDataPlane:
         out = u8p()
         out_len = ctypes.c_ulonglong()
         out_size = ctypes.c_int()
-        rc = self._lib.dp_read_record(self._handle(), vid, key, cookie or 0,
-                                      0 if cookie is None else 1,
-                                      ctypes.byref(out),
-                                      ctypes.byref(out_len),
-                                      ctypes.byref(out_size))
-        if rc != DP_OK:
-            _raise(rc, f"read {vid},{key:x}")
-        try:
-            blob = ctypes.string_at(out, out_len.value)
-        finally:
-            self._lib.dp_free(out)
+        tr = get_tracer()
+        with (tr.span("dataplane.read", vid=vid, key=key)
+              if tr.enabled else NOOP_SPAN):
+            rc = self._lib.dp_read_record(self._handle(), vid, key,
+                                          cookie or 0,
+                                          0 if cookie is None else 1,
+                                          ctypes.byref(out),
+                                          ctypes.byref(out_len),
+                                          ctypes.byref(out_size))
+            if rc != DP_OK:
+                _raise(rc, f"read {vid},{key:x}")
+            try:
+                blob = ctypes.string_at(out, out_len.value)
+            finally:
+                self._lib.dp_free(out)
         return blob, out_size.value
 
     def stat(self, vid: int) -> Optional[tuple[int, int, int, int]]:

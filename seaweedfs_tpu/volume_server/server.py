@@ -586,8 +586,8 @@ class VolumeServer:
 
                 return Response(
                     None, status=302,
-                    headers={"Location":
-                             f"http://{others[0]}{_up.quote(req.path, safe="/,")}"},
+                    headers={"Location": "http://%s%s" % (
+                        others[0], _up.quote(req.path, safe="/,"))},
                     raw=b"")
             etag = f'"{n.etag()}"'
             if not wants_resize and req.headers.get("If-None-Match") == etag:
@@ -739,7 +739,9 @@ class VolumeServer:
                         continue
                     status, body, _ = http_bytes(
                         "POST",
-                        f"http://{url}{urllib.parse.quote(req.path, safe="/,")}?{qs}",
+                        "http://%s%s?%s" % (
+                            url, urllib.parse.quote(req.path, safe="/,"),
+                            qs),
                         data, headers=fwd_headers)
                     if status != 200 and status != 201:
                         raise HttpError(500,
@@ -778,8 +780,8 @@ class VolumeServer:
                 for url in self._lookup_replicas(vid):
                     if url == self.url:
                         continue
-                    http_bytes("DELETE",
-                               f"http://{url}{_up.quote(req.path, safe="/,")}{qs}")
+                    http_bytes("DELETE", "http://%s%s%s" % (
+                        url, _up.quote(req.path, safe="/,"), qs))
             return Response({"size": size})
 
         # --- admin: volume lifecycle ---------------------------------

@@ -62,6 +62,52 @@ class TestCollectors:
         text = reg.expose()
         assert "a_total 1" in text and "b 4" in text
 
+    def test_label_value_escaping(self):
+        """Prometheus text format: backslash, quote and newline in label
+        values must be escaped or the whole exposition is corrupt."""
+        c = Counter("esc_total", labels=("p",))
+        c.inc('a"b\\c\nd')
+        text = "\n".join(c.expose())
+        assert 'esc_total{p="a\\"b\\\\c\\nd"} 1' in text
+        assert "\nd" not in text.split("# TYPE")[1]  # no raw newline leaks
+        h = Histogram("esc_h", labels=("p",), buckets=(1.0,))
+        h.observe('x"y', 0.5)
+        text = "\n".join(h.expose())
+        assert 'esc_h_bucket{p="x\\"y",le="1"} 1' in text
+        assert 'esc_h_sum{p="x\\"y"} 0.5' in text
+
+    def test_histogram_labels_pretouch_emits_full_series(self):
+        """A label set touched via labels() but never observed still
+        exposes every bucket (including +Inf) plus _sum/_count at 0."""
+        h = Histogram("pre", labels=("op",), buckets=(0.5, 1.0))
+        h.labels("idle")
+        text = "\n".join(h.expose())
+        assert 'pre_bucket{op="idle",le="0.5"} 0' in text
+        assert 'pre_bucket{op="idle",le="+Inf"} 0' in text
+        assert 'pre_sum{op="idle"} 0' in text
+        assert 'pre_count{op="idle"} 0' in text
+        # the bound child observes into the same series
+        h.labels("busy").observe(0.7)
+        text = "\n".join(h.expose())
+        assert 'pre_bucket{op="busy",le="+Inf"} 1' in text
+        assert 'pre_count{op="busy"} 1' in text
+        with h.labels("busy").time():
+            pass
+        assert h._totals[("busy",)] == 2
+
+    def test_counter_gauge_labels_pretouch(self):
+        c = Counter("pt_total", labels=("t",))
+        c.labels("seen")
+        assert 'pt_total{t="seen"} 0' in "\n".join(c.expose())
+        c.labels("seen").inc()
+        assert c.value("seen") == 1
+        g = Gauge("pt_g", labels=("t",))
+        g.labels("x")
+        assert 'pt_g{t="x"} 0' in "\n".join(g.expose())
+        g.labels("x").add(2.5)
+        g.labels("x").set(7)
+        assert g.value("x") == 7
+
 
 class TestServerMetricsEndpoints:
     @pytest.fixture()

@@ -312,13 +312,12 @@ def cmd_filer_sync(args) -> None:
 def cmd_filer_replicate(args) -> None:
     """Consume filer notifications and apply to a sink
     (command/filer_replicate.go + replication/replicator.go)."""
-    import tomllib
-
     from seaweedfs_tpu.replication.filer_sync import make_backup_tailer
     from seaweedfs_tpu.replication.sink import load_sink
+    # gated loader: py3.10 has no stdlib tomllib
+    from seaweedfs_tpu.utils.config import load_toml
 
-    with open(args.config, "rb") as f:
-        conf = tomllib.load(f)
+    conf = load_toml(args.config)
     sink = load_sink(conf)
     tailer = make_backup_tailer(
         args.filer, sink, path_prefix=args.filerPath,
