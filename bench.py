@@ -961,6 +961,23 @@ def _child(scratch_path: str, platform: str = "") -> None:
 
     section("parity", meas_parity)
 
+    def meas_pipeline_health():
+        # self-healing pipeline counters for the WHOLE bench run: nonzero
+        # means some measurement above survived worker restarts or ran
+        # (partly) on the CPU fallback — its throughput number reflects a
+        # DEGRADED run and must not be read as the clean-path capability
+        # (per-run deltas also ride each e2e pipe dict as
+        # retries/fallbacks/worker_restarts)
+        from seaweedfs_tpu.stats import ec_pipeline_metrics
+
+        totals = ec_pipeline_metrics().totals()
+        detail["pipeline_health"] = {
+            "worker_restarts": totals["worker_restarts"],
+            "engine_fallbacks": totals["engine_fallbacks"],
+        }
+
+    section("pipeline_health", meas_pipeline_health)
+
     checkpoint()
     print("BENCH_CHILD_RESULT " + json.dumps(detail), flush=True)
 

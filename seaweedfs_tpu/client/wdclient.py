@@ -13,6 +13,7 @@ import random
 import threading
 from dataclasses import dataclass
 
+from ..utils.backoff import jittered_backoff
 from ..utils.httpd import HttpError, http_json
 
 
@@ -106,7 +107,16 @@ class WdClient:
     def wait_synced(self, timeout: float = 5.0) -> bool:
         return self._synced.wait(timeout)
 
+    # reconnect backoff: start fast (a restarting master is usually back
+    # within a second), cap around 15s so a long outage isn't hammered —
+    # and jitter every sleep so a fleet of clients that all lost the same
+    # master doesn't reconnect in lockstep and thundering-herd it back
+    # down (masterclient.go KeepConnected's sleepy backoff)
+    RECONNECT_BASE = 0.5
+    RECONNECT_CAP = 15.0
+
     def _keep_connected(self) -> None:
+        failures = 0
         while not self._stop.is_set():
             try:
                 r = http_json(
@@ -119,13 +129,17 @@ class WdClient:
                     self.vid_map.apply_event(e)
                 self._seq = r.get("seq", self._seq)
                 self._synced.set()
+                failures = 0
             except Exception:
                 # ANY failure (transport, malformed body, bad event) must
                 # not kill the loop with _synced set — that would freeze
                 # the map and serve stale locations forever
                 self._synced.clear()
                 self._seq = 0  # resync from snapshot on reconnect
-                self._stop.wait(1.0)
+                delay = jittered_backoff(self.RECONNECT_BASE,
+                                         self.RECONNECT_CAP, failures)
+                failures = min(failures + 1, 10)  # cap the exponent
+                self._stop.wait(delay)
 
     # --- lookups ----------------------------------------------------------
     def lookup(self, vid: int) -> list[str]:

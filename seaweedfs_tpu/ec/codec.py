@@ -92,6 +92,31 @@ def best_cpu_engine() -> GfMatmulEngine:
         return CpuEngine()
 
 
+_FALLBACK_ENGINE: Optional[GfMatmulEngine] = None
+
+
+def _fallback_matmul(m: np.ndarray, shards: np.ndarray,
+                     failed: GfMatmulEngine, err: BaseException) -> np.ndarray:
+    """Per-call engine fallback: when a non-CPU engine (device kernel,
+    native plane) raises mid-matmul, recompute on the numpy/SIMD CPU
+    path instead of failing the whole encode — output is byte-identical
+    by the differential-test contract.  Counted and traced so degraded
+    results never masquerade as clean ones."""
+    global _FALLBACK_ENGINE
+    if _FALLBACK_ENGINE is None:
+        _FALLBACK_ENGINE = best_cpu_engine()
+    if type(_FALLBACK_ENGINE) is type(failed):
+        # the CPU engine itself failed: nothing softer to fall to
+        raise err
+    from ..stats import ec_pipeline_metrics
+
+    ec_pipeline_metrics().engine_fallbacks.inc("codec")
+    get_tracer().event("pipeline.fallback", reason="codec",
+                       engine=getattr(failed, "name", "?"),
+                       error=type(err).__name__)
+    return _FALLBACK_ENGINE.matmul(m, shards)
+
+
 class ReedSolomon:
     """One (data, parity) geometry with its cached encoding matrix."""
 
@@ -124,8 +149,17 @@ class ReedSolomon:
         with get_tracer().span("ec.encode", k=self.data_shards,
                                r=self.parity_shards, bytes=int(data.nbytes),
                                backend=self.engine.name):
-            return self.engine.matmul(self.parity_matrix,
-                                      np.ascontiguousarray(data))
+            data = np.ascontiguousarray(data)
+            try:
+                return self.engine.matmul(self.parity_matrix, data)
+            except ValueError:
+                raise  # shape/size validation, not an engine fault
+            except Exception as e:
+                # engine choice is a per-call decision: a failing device
+                # or native engine degrades to the CPU codec instead of
+                # failing the encode (byte-identical output)
+                return _fallback_matmul(self.parity_matrix, data,
+                                        self.engine, e)
 
     def encode_shards(self, shards: list[np.ndarray]) -> None:
         """klauspost Encode: shards[0:data] in, shards[data:total] overwritten."""
@@ -179,14 +213,21 @@ class ReedSolomon:
                 want = [list(int(v) for v in self.matrix[m])
                         for m in missing]
                 rows = np.array(mat_mul(want, decode), dtype=np.uint8)
-                if hasattr(self.engine, "matmul_rows"):
-                    # row-pointer kernel: skips the [k, B] survivor
-                    # stack copy
-                    restored = self.engine.matmul_rows(
-                        rows, [shards[i] for i in sub_rows])
-                else:
-                    survivors = np.stack([shards[i] for i in sub_rows])
-                    restored = self.engine.matmul(rows, survivors)
+                try:
+                    if hasattr(self.engine, "matmul_rows"):
+                        # row-pointer kernel: skips the [k, B] survivor
+                        # stack copy
+                        restored = self.engine.matmul_rows(
+                            rows, [shards[i] for i in sub_rows])
+                    else:
+                        survivors = np.stack([shards[i] for i in sub_rows])
+                        restored = self.engine.matmul(rows, survivors)
+                except ValueError:
+                    raise  # shape/size validation, not an engine fault
+                except Exception as e:
+                    restored = _fallback_matmul(
+                        rows, np.stack([shards[i] for i in sub_rows]),
+                        self.engine, e)
                 for out_i, shard_i in enumerate(missing):
                     shards[shard_i] = restored[out_i]
         # keep sizes consistent

@@ -77,8 +77,14 @@ def write_ec_files(base_file_name: str, rs: Optional[ReedSolomon] = None,
                            r=rs.parity_shards,
                            backend=rs.engine.name), \
             open(dat_path, "rb") as dat:
-        outputs = [open(base_file_name + to_ext(i), "wb") for i in range(rs.total_shards)]
+        outputs = []
+        ok = False
         try:
+            # opened INSIDE the cleanup scope: a mid-loop open failure
+            # (EMFILE, ENOSPC) must not leak handles or leave the
+            # already-created 0-byte shards behind
+            for i in range(rs.total_shards):
+                outputs.append(open(base_file_name + to_ext(i), "wb"))
             while remaining > large_block_size * rs.data_shards:
                 _encode_row(dat, rs, processed, large_block_size, outputs, chunk)
                 remaining -= large_block_size * rs.data_shards
@@ -87,9 +93,19 @@ def write_ec_files(base_file_name: str, rs: Optional[ReedSolomon] = None,
                 _encode_row(dat, rs, processed, small_block_size, outputs, chunk)
                 remaining -= small_block_size * rs.data_shards
                 processed += small_block_size * rs.data_shards
+            ok = True
         finally:
             for f in outputs:
                 f.close()
+            if not ok:
+                # same discipline as rebuild_ec_files: a truncated .ecNN
+                # surviving a failed encode would satisfy existence checks
+                # and mask the missing bytes on the next mount/rebuild
+                for i in range(rs.total_shards):
+                    try:
+                        os.remove(base_file_name + to_ext(i))
+                    except OSError:
+                        pass
 
 
 def rebuild_ec_files(base_file_name: str, rs: Optional[ReedSolomon] = None,

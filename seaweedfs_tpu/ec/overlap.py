@@ -18,11 +18,32 @@ Two workers share one lifecycle base:
   mmap'd, so only parity crosses shared memory — the zero-copy mmap
   encode's overlap half.
 
-Protocol: single worker process, FIFO job queue.  Tickets are buffer
-indices; FIFO submission order == completion order, which matches the
-pipelines' drain order.  Worker-side job failures ack ("err", detail)
-instead of dying silently, so the parent can fall back to serial
-compute and respawn.
+Protocol: single worker process, FIFO job queue.  Every job carries a
+monotonically-increasing SEQUENCE NUMBER and its ack echoes it back, so
+the parent can tell a replayed result from a stale one.  FIFO submission
+order == completion order, which matches the pipelines' drain order.
+Worker-side job failures ack ("err", seq, detail) instead of dying
+silently, so the parent can fall back to serial compute for that one
+dispatch and keep the worker.
+
+SUPERVISION (the self-healing contract): the parent detects worker death
+or stall through its bounded ack reads and, instead of failing the
+encode, respawns the process with jittered exponential backoff (bounded
+by max_restarts) and REPLAYS the in-flight dispatches.  Replay is safe
+because every job's inputs are still live on the parent side when its
+ack is outstanding: the staged worker's input buffers are shared-memory
+slots the parent does not recycle until fetch, and the file worker
+re-reads the input file itself.  Results that the dead incarnation
+already acked are drained into a dedup buffer first, so a replay never
+produces a double-write.  When the restart budget is exhausted, fetch
+raises WorkerGaveUp and the pipeline degrades to the CPU codec
+mid-stream (streaming.py) — the encode still completes byte-identical.
+
+Fault points (utils/faultinject): `ec.worker.ack` injects a parent-side
+ack failure — the supervisor treats it exactly like worker death (kills
+the real process, respawns, replays), so chaos tests exercise the whole
+recovery path deterministically; `ec.shm` fires in spawn, so arming it
+makes respawns fail and drains the retry budget on demand.
 """
 
 from __future__ import annotations
@@ -30,9 +51,45 @@ from __future__ import annotations
 import multiprocessing as mp
 import os
 import queue as queue_mod
+import time
+from collections import OrderedDict
 from multiprocessing import shared_memory
 
 import numpy as np
+
+from ..observability import get_tracer
+from ..utils import faultinject
+from ..utils.backoff import jittered_backoff
+
+
+def _close_shm_quiet(shm) -> None:
+    """close() tolerating still-exported buffer views (the abandoned-
+    worker fallback keeps using input slots after the process dies):
+    release the fd now and defuse the SharedMemory destructor's retry —
+    the mapping itself is freed when the last numpy view drops (mmap
+    dealloc closes the map), and the caller already unlink()ed the
+    name, so nothing leaks."""
+    try:
+        shm.close()
+    except BufferError:
+        try:
+            if shm._fd >= 0:
+                os.close(shm._fd)
+                shm._fd = -1
+        except OSError:  # pragma: no cover - already closed
+            pass
+        shm._mmap = None
+        shm._buf = None
+
+
+class WorkerJobError(RuntimeError):
+    """One job failed inside a live worker (e.g. its input file vanished):
+    the dispatch needs a CPU recompute, the worker itself is fine."""
+
+
+class WorkerGaveUp(RuntimeError):
+    """The supervisor exhausted its restart budget: the worker path is
+    done for this encode and the caller must degrade to the CPU codec."""
 
 
 def _worker_main(in_name: str, out_name: str, k: int, r: int, b: int,
@@ -40,7 +97,7 @@ def _worker_main(in_name: str, out_name: str, k: int, r: int, b: int,
     from .. import native
 
     if native.load() is None:  # pragma: no cover - parent checked first
-        acks.put(("err", "native gf256 unavailable"))
+        acks.put(("err", -1, "native gf256 unavailable"))
         return
     import time as _time
 
@@ -57,7 +114,7 @@ def _worker_main(in_name: str, out_name: str, k: int, r: int, b: int,
             msg = jobs.get()
             if msg is None:
                 break
-            bi, n = msg
+            _, seq, (bi, n) = msg
             try:
                 # wall-clock compute window rides the ack: the parent's
                 # tracer merges it as a worker.compute span on drain
@@ -66,9 +123,9 @@ def _worker_main(in_name: str, out_name: str, k: int, r: int, b: int,
                     mat,
                     [in0 + (bi * k + i) * b for i in range(k)],
                     [out0 + (bi * r + j) * b for j in range(r)], n)
-                acks.put(("done", bi, t0, _time.time()))
+                acks.put(("done", seq, bi, t0, _time.time()))
             except Exception as e:  # pragma: no cover - native errors
-                acks.put(("err", f"{type(e).__name__}: {e}"))
+                acks.put(("err", seq, f"{type(e).__name__}: {e}"))
         del ins, outs
     finally:
         shm_in.close()
@@ -83,7 +140,7 @@ def _file_worker_main(out_name: str, r: int, b: int, nbufs: int,
     from .. import native
 
     if native.load() is None:  # pragma: no cover - parent checked first
-        acks.put(("err", "native gf256 unavailable"))
+        acks.put(("err", -1, "native gf256 unavailable"))
         return
     mat = np.frombuffer(mat_bytes, dtype=np.uint8).reshape(r, k)
     shm_out = shared_memory.SharedMemory(name=out_name)
@@ -112,17 +169,21 @@ def _file_worker_main(out_name: str, r: int, b: int, nbufs: int,
                                             dtype=np.uint8).ctypes.data
                     acks.put(("opened", msg[1]))
                     continue
-                slot, base, block, n = msg
+                _, seq, (slot, base, block, n) = msg
                 t0 = _time.time()
                 native.gf_matmul_ptrs(
                     mat,
                     [in_addr + base + i * block for i in range(k)],
                     [out0 + (slot * r + j) * b for j in range(r)], n)
-                acks.put(("done", slot, t0, _time.time()))
+                acks.put(("done", seq, slot, t0, _time.time()))
             except Exception as e:
                 # the file vanished under us (compaction/rename) or the
-                # job failed: report, don't die — the parent falls back
-                acks.put(("err", f"{type(e).__name__}: {e}"))
+                # job failed: report, don't die — the parent recomputes
+                # that one dispatch and keeps us
+                if msg[0] == "open":
+                    acks.put(("err", -1, f"{type(e).__name__}: {e}"))
+                else:
+                    acks.put(("err", msg[1], f"{type(e).__name__}: {e}"))
         del outs  # exported view must drop before the shm closes
     finally:
         if in_map is not None:
@@ -135,14 +196,25 @@ def _file_worker_main(out_name: str, r: int, b: int, nbufs: int,
 
 class _ParityWorkerBase:
     """Shared lifecycle: parity shm slots, spawn-context process,
-    ready handshake, bounded acks, close/terminate."""
+    ready handshake, bounded acks, supervised respawn + replay,
+    close/terminate."""
 
-    _TIMEOUT = 30.0
+    kind = "base"  # metrics label; subclasses override
 
     def __init__(self, k: int, r: int, dispatch_b: int,
-                 matrix: np.ndarray, nbufs: int, target, extra_shm=None):
+                 matrix: np.ndarray, nbufs: int, target,
+                 ack_timeout: float = 30.0, max_restarts: int = 3,
+                 restart_backoff: float = 0.05,
+                 restart_backoff_cap: float = 2.0):
         self.k, self.r, self.b = k, r, dispatch_b
         self.nbufs = nbufs
+        self.ack_timeout = ack_timeout
+        self.max_restarts = max_restarts
+        self.restart_backoff = restart_backoff
+        self.restart_backoff_cap = restart_backoff_cap
+        self.restarts = 0
+        self._target = target
+        self._mat = np.ascontiguousarray(matrix, dtype=np.uint8)
         self._shm_out = shared_memory.SharedMemory(
             create=True, size=nbufs * r * dispatch_b)
         self._outs = [
@@ -151,63 +223,240 @@ class _ParityWorkerBase:
                           offset=i * r * dispatch_b).reshape(r, dispatch_b)
             for i in range(nbufs)
         ]
+        # ticket/ack sequencing: _seq_submit numbers jobs, _seq_fetch is
+        # the next seq fetch() expects, _inflight maps seq -> replayable
+        # payload, _done buffers acks that arrived ahead of their fetch
+        # (drained from a dead incarnation, or read while waiting on an
+        # "opened" handshake)
+        self._seq_submit = 0
+        self._seq_fetch = 0
+        self._inflight: OrderedDict[int, tuple] = OrderedDict()
+        self._done: dict[int, tuple] = {}
+        self._path: str | None = None  # file worker: current open file
+        self._proc = None
+        self._jobs = None
+        self._acks = None
+        # wall-clock [t0, t1) of the most recent fetched job — the
+        # serializable span log the parent's tracer merges on drain
+        self.last_job_span: tuple[float, float] | None = None
+        self.worker_pid = 0
+        try:
+            self._spawn()
+        except BaseException:
+            self.close()
+            raise
+
+    def _spawn_args(self, mat):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def _spawn(self) -> None:
+        """Start a (fresh) worker incarnation: new queues — a corpse's
+        queues may hold garbage — then the ready handshake."""
+        if faultinject._points:
+            faultinject.hit("ec.shm")
         # spawn, not fork: the parent usually has jax (multithreaded)
         # loaded, and forking a multithreaded process can deadlock; the
         # child imports and initializes the native lib itself
         ctx = mp.get_context("spawn")
         self._jobs = ctx.Queue()
         self._acks = ctx.Queue()
-        mat = np.ascontiguousarray(matrix, dtype=np.uint8)
-        self._proc = ctx.Process(target=target,
-                                 args=self._spawn_args(mat, extra_shm),
+        self._proc = ctx.Process(target=self._target,
+                                 args=self._spawn_args(self._mat),
                                  daemon=True)
         self._proc.start()
-        # wall-clock [t0, t1) of the most recent fetched job — the
-        # serializable span log the parent's tracer merges on drain
-        self.last_job_span: tuple[float, float] | None = None
-        self.worker_pid = 0
-        kind, detail, *_rest = self._ack()
-        if kind != "ready":
-            self.close()
-            raise RuntimeError(f"parity worker failed: {detail}")
-        self.worker_pid = detail
+        msg = self._ack_raw()
+        if msg[0] != "ready":
+            # fatal init acks are ("err", -1, detail) — surface the
+            # human-readable detail, not the seq sentinel
+            raise RuntimeError(f"parity worker failed: {msg[-1]}")
+        self.worker_pid = msg[1]
 
-    def _spawn_args(self, mat, extra_shm):  # pragma: no cover - abstract
-        raise NotImplementedError
-
-    def _ack(self):
+    def _ack_raw(self):
         """Bounded ack read: a dead worker surfaces as RuntimeError
-        within ~0.5s (liveness-polled), a stalled one within _TIMEOUT —
-        never an eternal hang."""
-        import time as _time
-
-        deadline = _time.monotonic() + self._TIMEOUT
+        within ~0.5s (liveness-polled), a stalled one within ack_timeout
+        — never an eternal hang."""
+        deadline = time.monotonic() + self.ack_timeout
         while True:
             try:
                 return self._acks.get(timeout=0.5)
             except queue_mod.Empty:
                 if not self._proc.is_alive():
                     raise RuntimeError("parity worker died")
-                if _time.monotonic() >= deadline:
+                if time.monotonic() >= deadline:
                     raise RuntimeError("parity worker stalled")
 
+    # --- supervision ------------------------------------------------------
+    def _kill(self) -> None:
+        if self._proc is None:
+            return
+        try:
+            if self._proc.is_alive():
+                self._proc.terminate()
+                self._proc.join(timeout=2)
+                if self._proc.is_alive():  # pragma: no cover - stuck
+                    self._proc.kill()
+                    self._proc.join(timeout=2)
+        except Exception:  # pragma: no cover - already-reaped races
+            pass
+
+    def _drain_stale_acks(self) -> None:
+        """After killing an incarnation, salvage whatever results it
+        managed to ack: those jobs completed (the output slot was fully
+        written before the ack), so they must NOT be replayed — a replay
+        would recompute into a slot the parent may be reading."""
+        if self._acks is None:
+            return
+        while True:
+            try:
+                msg = self._acks.get(timeout=0.05)
+            except (queue_mod.Empty, OSError, EOFError):
+                return
+            except Exception:  # pragma: no cover - corrupt queue
+                return
+            if msg and msg[0] in ("done", "err") and msg[1] >= self._seq_fetch:
+                self._done.setdefault(msg[1], msg)
+
+    def _recover(self, cause: BaseException) -> None:
+        """Kill + respawn + replay, with jittered exponential backoff;
+        raises WorkerGaveUp when the restart budget is exhausted."""
+        t_rec0 = time.time()
+        err = cause
+        while True:
+            if self.restarts >= self.max_restarts:
+                self._kill()
+                raise WorkerGaveUp(
+                    f"parity worker gave up after {self.restarts} "
+                    f"restarts: {err}") from cause
+            self.restarts += 1
+            from ..stats import ec_pipeline_metrics
+
+            ec_pipeline_metrics().worker_restarts.inc(self.kind)
+            # jittered exponential backoff: a crash loop must not burn a
+            # core respawning, and co-scheduled encoders must not
+            # thundering-herd their respawns in lockstep
+            time.sleep(jittered_backoff(self.restart_backoff,
+                                        self.restart_backoff_cap,
+                                        self.restarts - 1))
+            self._kill()
+            self._drain_stale_acks()
+            try:
+                self._spawn()
+                if self._path is not None:
+                    self._open_in_worker(self._path)
+                replayed = 0
+                for seq, payload in self._inflight.items():
+                    if seq not in self._done and seq >= self._seq_fetch:
+                        self._jobs.put(("job", seq, payload))
+                        replayed += 1
+            except Exception as e:
+                err = e
+                continue
+            get_tracer().add_span(
+                "pipeline.retry", t_rec0, time.time(), kind=self.kind,
+                restart=self.restarts, replayed=replayed,
+                error=f"{type(cause).__name__}: {cause}")
+            return
+
+    # --- job flow ---------------------------------------------------------
+    def _submit_payload(self, payload: tuple) -> int:
+        seq = self._seq_submit
+        self._seq_submit += 1
+        self._inflight[seq] = payload
+        try:
+            self._jobs.put(("job", seq, payload))
+        except Exception as e:
+            # a broken jobs queue is a worker fault like any other: the
+            # respawn replays this job from _inflight
+            self._recover(e)
+        return seq
+
+    def _await_seq(self, seq: int):
+        while True:
+            msg = self._done.pop(seq, None)
+            if msg is not None:
+                return msg
+            try:
+                if faultinject._points:
+                    faultinject.hit("ec.worker.ack")
+                msg = self._ack_raw()
+            except Exception as e:
+                self._recover(e)
+                continue
+            kind = msg[0]
+            if kind not in ("done", "err"):
+                continue  # late ready/opened from a respawn: ignore
+            mseq = msg[1]
+            if mseq < self._seq_fetch or mseq in self._done:
+                continue  # duplicate of an already-consumed result
+            if mseq == seq:
+                return msg
+            self._done[mseq] = msg
+
     def fetch(self, ticket: int) -> np.ndarray:
-        """Block until the ticket's parity is ready; returns the [r, b]
-        shared-memory view (valid until the buffer index is reused).
-        The job's wall-clock compute window lands in last_job_span."""
-        kind, got, *timing = self._ack()
-        if kind != "done" or got != ticket:
-            raise RuntimeError(f"parity worker protocol: {kind} {got}")
-        self.last_job_span = (timing[0], timing[1]) if len(timing) == 2 \
-            else None
+        """Block until the next FIFO job's parity is ready; returns the
+        [r, b] shared-memory view (valid until the buffer index is
+        reused).  The job's wall-clock compute window lands in
+        last_job_span.  Raises WorkerJobError if the job failed inside a
+        live worker (seq consumed — recompute that dispatch and keep the
+        worker), WorkerGaveUp when supervision exhausted its budget."""
+        seq = self._seq_fetch
+        msg = self._await_seq(seq)
+        self._seq_fetch = seq + 1
+        self._inflight.pop(seq, None)
+        if msg[0] == "err":
+            self.last_job_span = None
+            raise WorkerJobError(msg[2])
+        _, _, got, t0, t1 = msg
+        if got != ticket:
+            raise RuntimeError(f"parity worker protocol: done {got}, "
+                               f"expected ticket {ticket}")
+        self.last_job_span = (t0, t1)
         return self._outs[ticket]
+
+    def skip_next(self) -> None:
+        """Abandon the next FIFO result without reading it (the caller
+        recomputed that dispatch itself): consume the seq so later
+        fetches stay aligned; the eventual ack is deduped as stale."""
+        self._inflight.pop(self._seq_fetch, None)
+        self._done.pop(self._seq_fetch, None)
+        self._seq_fetch += 1
+
+    def _open_in_worker(self, path: str) -> None:
+        self._jobs.put(("open", path))
+        while True:
+            msg = self._ack_raw()
+            if msg[0] == "opened":
+                if msg[1] != path:
+                    raise RuntimeError(f"parity worker open: {msg[1]}")
+                return
+            if msg[0] == "err" and msg[1] == -1:
+                # the LIVE worker reports the open itself failed (file
+                # vanished/ENOENT): deterministic — respawning cannot
+                # help, the caller should fall back, not burn restarts
+                raise WorkerJobError(f"open {path}: {msg[-1]}")
+            if msg[0] in ("done", "err"):
+                if msg[1] >= self._seq_fetch:
+                    self._done.setdefault(msg[1], msg)
+                # else: stale duplicate of a consumed/skipped result
+                # (e.g. the ack a skip_next() left unread) — drop it,
+                # do NOT treat a healthy worker as desynced
+                continue
+            raise RuntimeError(f"parity worker open: {msg[0]} {msg[1]}")
+
+    # --- teardown ---------------------------------------------------------
+    def abandon(self) -> None:
+        """Kill the worker process but keep the shared-memory slabs (and
+        any parent-side numpy views into them) alive: a mid-encode CPU
+        fallback keeps using the input slots as plain staging buffers;
+        close() runs later, after the views drop."""
+        self._kill()
 
     def _close_extra(self) -> None:
         pass
 
     def close(self) -> None:
         try:
-            if self._proc.is_alive():
+            if self._proc is not None and self._proc.is_alive():
                 self._jobs.put(None)
                 self._proc.join(timeout=10)
                 if self._proc.is_alive():  # pragma: no cover
@@ -215,11 +464,15 @@ class _ParityWorkerBase:
         finally:
             self._outs = []
             self._close_extra()
+            # unlink BEFORE close: close() can hit still-live caller
+            # views (abandoned-worker fallback), but the name must not
+            # leak in /dev/shm — the mapping itself is released when
+            # the views drop
             try:
-                self._shm_out.close()
                 self._shm_out.unlink()
             except OSError:  # pragma: no cover
                 pass
+            _close_shm_quiet(self._shm_out)
 
     def __del__(self):  # pragma: no cover - best-effort cleanup
         try:
@@ -233,8 +486,10 @@ class ProcessOverlapWorker(_ParityWorkerBase):
     the parent fills buffer bi, submits (bi, n), the worker matmuls in
     shared memory and acks bi."""
 
+    kind = "staged"
+
     def __init__(self, k: int, r: int, dispatch_b: int, matrix: np.ndarray,
-                 nbufs: int):
+                 nbufs: int, **supervise_kw):
         self._shm_in = shared_memory.SharedMemory(
             create=True, size=nbufs * k * dispatch_b)
         self.bufs = [
@@ -243,25 +498,28 @@ class ProcessOverlapWorker(_ParityWorkerBase):
                           offset=i * k * dispatch_b).reshape(k, dispatch_b)
             for i in range(nbufs)
         ]
-        super().__init__(k, r, dispatch_b, matrix, nbufs, _worker_main)
+        super().__init__(k, r, dispatch_b, matrix, nbufs, _worker_main,
+                         **supervise_kw)
 
-    def _spawn_args(self, mat, extra_shm):
+    def _spawn_args(self, mat):
         return (self._shm_in.name, self._shm_out.name, self.k, self.r,
                 self.b, self.nbufs, mat.tobytes(), self._jobs, self._acks)
 
     def submit(self, bi: int, n: int) -> int:
         """Queue buffer bi (first n columns valid) for parity compute;
-        the ticket is bi itself (single FIFO worker)."""
-        self._jobs.put((bi, n))
+        the ticket is bi itself (single FIFO worker).  The (bi, n)
+        payload is retained for replay until its result is fetched — the
+        shared-memory input slot stays unrecycled exactly as long."""
+        self._submit_payload((bi, n))
         return bi
 
     def _close_extra(self) -> None:
         self.bufs = []
         try:
-            self._shm_in.close()
             self._shm_in.unlink()
         except OSError:  # pragma: no cover
             pass
+        _close_shm_quiet(self._shm_in)
 
 
 class FileParityWorker(_ParityWorkerBase):
@@ -270,12 +528,14 @@ class FileParityWorker(_ParityWorkerBase):
     into a small shared-memory slot ring, so the parent overlaps its
     pwrite syscall time with GF(2^8) compute on multicore hosts."""
 
-    def __init__(self, k: int, r: int, dispatch_b: int,
-                 matrix: np.ndarray, nbufs: int = 2):
-        super().__init__(k, r, dispatch_b, matrix, nbufs,
-                         _file_worker_main)
+    kind = "mmap"
 
-    def _spawn_args(self, mat, extra_shm):
+    def __init__(self, k: int, r: int, dispatch_b: int,
+                 matrix: np.ndarray, nbufs: int = 2, **supervise_kw):
+        super().__init__(k, r, dispatch_b, matrix, nbufs,
+                         _file_worker_main, **supervise_kw)
+
+    def _spawn_args(self, mat):
         return (self._shm_out.name, self.r, self.b, self.nbufs,
                 mat.tobytes(), self.k, self._jobs, self._acks)
 
@@ -284,10 +544,18 @@ class FileParityWorker(_ParityWorkerBase):
         return self._outs
 
     def open(self, path: str) -> None:
-        self._jobs.put(("open", path))
-        kind, got, *_rest = self._ack()
-        if kind != "opened" or got != path:
-            raise RuntimeError(f"parity worker open: {kind} {got}")
+        """Point the worker at its input file; remembered so a respawn
+        re-opens it before replaying in-flight spans.  A worker-reported
+        open failure (WorkerJobError — the file itself is the problem)
+        propagates immediately so the caller falls back without burning
+        the restart budget; only worker death/stall triggers recovery."""
+        self._path = path
+        try:
+            self._open_in_worker(path)
+        except (WorkerGaveUp, WorkerJobError):
+            raise
+        except Exception as e:
+            self._recover(e)  # respawn re-opens self._path itself
 
     def submit(self, slot: int, base: int, block: int, n: int) -> None:
-        self._jobs.put((slot, base, block, n))
+        self._submit_payload((slot, base, block, n))

@@ -37,8 +37,10 @@ from typing import Iterator, Optional
 import numpy as np
 
 from ..observability import get_tracer
+from ..utils import faultinject
 from ..utils.ioutil import pread_padded, preadv_into
 from .gf256 import mat_invert, mat_mul
+from .overlap import WorkerGaveUp, WorkerJobError
 from .layout import (
     DATA_SHARDS_COUNT,
     LARGE_BLOCK_SIZE,
@@ -46,6 +48,17 @@ from .layout import (
     SMALL_BLOCK_SIZE,
     to_ext,
 )
+
+
+def _restart_total() -> int:
+    """Process-wide parity-worker restart count (all worker kinds);
+    encode calls snapshot it so stats["worker_restarts"] is a per-call
+    delta.  Best-effort under concurrency: parallel encodes in one
+    process can leak restarts into each other's deltas (a false
+    "degraded" flag at worst, never a false "clean")."""
+    from ..stats import ec_pipeline_metrics
+
+    return ec_pipeline_metrics().totals()["worker_restarts"]
 
 
 def _fallocate(fd: int, size: int) -> None:
@@ -110,7 +123,9 @@ class StreamingEncoder:
                  dispatch_mb: int = 8, depth: int = 3,
                  engine: str = "auto", mesh: Optional[bool] = None,
                  zero_copy: bool = True, overlap: str = "auto",
-                 tracer=None):
+                 tracer=None, drain_timeout_s: float = 30.0,
+                 max_worker_restarts: int = 3,
+                 max_encode_retries: int = 2):
         """engine: 'auto' uses the jax device path on a real accelerator
         and the host SIMD codec otherwise (jax-on-CPU is a correctness
         surface, ~200x slower than the AVX2 codec); 'device' forces the
@@ -120,7 +135,15 @@ class StreamingEncoder:
         mesh: None shards each dispatch over ALL visible devices
         (parallel/mesh.py dp x sp x tp shard_map) whenever more than one
         is present, so `-ec.engine=tpu` on a multi-chip host uses every
-        chip; True forces the mesh path, False forces single-device."""
+        chip; True forces the mesh path, False forces single-device.
+
+        Self-healing knobs: drain_timeout_s bounds every wait on a
+        parity worker ack (a stalled worker surfaces as a fault, never a
+        hang); max_worker_restarts is the supervisor's respawn budget
+        per worker before the encode degrades to the CPU codec;
+        max_encode_retries bounds whole-call retries of the staged
+        encode, each resuming from the last fully-drained-and-written
+        dispatch checkpoint instead of byte 0."""
         from .codec import ReedSolomon, best_cpu_engine
 
         self.k = data_shards
@@ -146,6 +169,13 @@ class StreamingEncoder:
         self._proc_worker = None
         self._file_worker = None  # mmap-path parity process (lazy)
         self._overlap = overlap
+        self.drain_timeout_s = drain_timeout_s
+        self.max_worker_restarts = max_worker_restarts
+        self.max_encode_retries = max_encode_retries
+        self._fb_engine = None  # lazy CPU codec for per-dispatch fallback
+        # abandoned (killed, shm kept) workers whose buffers may still
+        # back live views; fully closed once the encode call unwinds
+        self._stale_workers: list = []
         self._mesh = None
         self._mesh_encode = None
         b = dispatch_mb << 20
@@ -216,6 +246,7 @@ class StreamingEncoder:
         # same matrix family as ReedSolomon so shards are byte-identical
         self.matrix = ReedSolomon(data_shards, parity_shards,
                                   matrix_kind=matrix_kind).matrix
+        self._mat_rows = np.ascontiguousarray(self.matrix[data_shards:])
         # LRU: a long-lived volume server cycles geometries and rebuild
         # matrices (every distinct erasure pattern is a distinct key) —
         # unbounded growth would pin HBM-resident plane arrays forever
@@ -323,6 +354,11 @@ class StreamingEncoder:
         import concurrent.futures
 
         if isinstance(out_dev, tuple) and out_dev[0] == "proc":
+            if self._proc_worker is None:
+                # worker already abandoned mid-encode: the still-pending
+                # handles behind it surface uniformly as gave-up so the
+                # fallback accounting stays truthful
+                raise WorkerGaveUp("parity worker already abandoned")
             return self._proc_worker.fetch(out_dev[1])
         if isinstance(out_dev, concurrent.futures.Future):  # host worker
             return out_dev.result()
@@ -339,8 +375,61 @@ class StreamingEncoder:
     def _reset_stats(self) -> dict:
         self.stats = {"dispatches": 0, "fill_s": 0.0, "dispatch_s": 0.0,
                       "write_s": 0.0, "drain_wait_s": 0.0, "setup_s": 0.0,
-                      "close_s": 0.0, "wall_s": 0.0, "bytes_in": 0}
+                      "close_s": 0.0, "wall_s": 0.0, "bytes_in": 0,
+                      "retries": 0, "fallbacks": 0, "worker_restarts": 0}
+        self._restart_base = _restart_total()
         return self.stats
+
+    # --- self-healing helpers ---------------------------------------------
+    def _cpu_parity(self, data: np.ndarray) -> np.ndarray:
+        """Per-dispatch CPU fallback: parity for [k, n] data through the
+        host codec — byte-identical to every other engine by the
+        differential-test contract."""
+        if self._fb_engine is None:
+            from .codec import best_cpu_engine
+
+            self._fb_engine = (self._host_engine
+                               if self._host_engine is not None
+                               else best_cpu_engine())
+        return self._fb_engine.matmul(self._mat_rows,
+                                      np.ascontiguousarray(data))
+
+    def _note_fallback(self, st: dict, reason: str) -> None:
+        st["fallbacks"] += 1
+        from ..stats import ec_pipeline_metrics
+
+        ec_pipeline_metrics().engine_fallbacks.inc(reason)
+
+    def _abandon_proc_worker(self) -> None:
+        """Kill the staged worker but keep its shared memory alive: the
+        encode keeps using the input slots as plain staging buffers for
+        CPU-fallback compute; the worker is fully closed once the call's
+        views unwind (_reap_stale_workers)."""
+        w = self._proc_worker
+        self._proc_worker = None
+        if w is not None:
+            try:
+                w.abandon()
+            except Exception:  # pragma: no cover - already-dead races
+                pass
+            self._stale_workers.append(w)
+
+    def _reap_stale_workers(self) -> None:
+        if not self._stale_workers:
+            return
+        # the encode's flush/drain closures form reference cycles that
+        # keep shm-backed buffer views alive past the call's return;
+        # collect them now so close() can actually release the mappings
+        # (rare path: only runs after a mid-encode worker abandonment)
+        import gc
+
+        gc.collect()
+        for w in self._stale_workers:
+            try:
+                w.close()
+            except Exception:  # pragma: no cover - best-effort teardown
+                pass
+        self._stale_workers.clear()
 
     # --- zero-copy host path ----------------------------------------------
     def _native_ptrs(self):
@@ -380,7 +469,9 @@ class StreamingEncoder:
                 from .overlap import FileParityWorker
 
                 self._file_worker = FileParityWorker(
-                    self.k, self.r, self.dispatch_b, mat)
+                    self.k, self.r, self.dispatch_b, mat,
+                    ack_timeout=self.drain_timeout_s,
+                    max_restarts=self.max_worker_restarts)
                 weakref.finalize(self, FileParityWorker.close,
                                  self._file_worker)
             except Exception:
@@ -517,17 +608,51 @@ class StreamingEncoder:
                 nonlocal worker
                 slot, n, off, base, block, d_idx = pending.popleft()
                 parity = None
+                # injected drain fault: per-dispatch semantics, same as
+                # the staged path — THIS dispatch recomputes serially,
+                # the worker (which did the work) gets its FIFO
+                # realigned and keeps the rest of the encode
+                drain_fault = False
+                if faultinject._points:
+                    try:
+                        faultinject.hit("ec.drain")
+                    except Exception:
+                        drain_fault = True
                 if worker is not None:
                     t0 = clock()
                     with tr.span("pipeline.drain", dispatch=d_idx):
-                        try:
-                            parity = worker.fetch(slot)[:, :n]
-                        except Exception:
-                            # worker died mid-encode (OOM kill, segfault):
-                            # recompute the lost dispatches serially and
-                            # finish the encode without it
-                            self._drop_file_worker()
-                            worker = None
+                        if drain_fault:
+                            worker.skip_next()
+                            self._note_fallback(st, "drain_fault")
+                            tr.event("pipeline.fallback", dispatch=d_idx,
+                                     reason="drain_fault")
+                        else:
+                            try:
+                                parity = worker.fetch(slot)[:, :n]
+                            except WorkerJobError:
+                                # the job failed INSIDE a live worker
+                                # (input file vanished under it):
+                                # recompute this one dispatch serially,
+                                # keep the worker
+                                self._note_fallback(st, "worker_job")
+                                tr.event("pipeline.fallback",
+                                         dispatch=d_idx,
+                                         reason="worker_job")
+                            except (KeyboardInterrupt, SystemExit):
+                                raise
+                            except Exception as e:
+                                # supervision exhausted its respawn
+                                # budget (WorkerGaveUp) or desynced:
+                                # recompute the lost dispatches serially
+                                # and finish the encode without it
+                                self._drop_file_worker()
+                                worker = None
+                                reason = ("worker_gave_up"
+                                          if isinstance(e, WorkerGaveUp)
+                                          else "worker_error")
+                                self._note_fallback(st, reason)
+                                tr.event("pipeline.fallback",
+                                         dispatch=d_idx, reason=reason)
                     st["drain_wait_s"] += clock() - t0
                     if parity is not None:
                         self._merge_worker_span(tr, worker, root.span_id,
@@ -562,23 +687,57 @@ class StreamingEncoder:
                         file_size, k, large, small, self.dispatch_b):
                     base = row_start + off
                     if base + (k - 1) * block + n <= file_size:
-                        if worker is not None:
-                            if len(pending) == worker.nbufs:
-                                drain_one()
+                        if worker is not None and \
+                                len(pending) == worker.nbufs:
+                            drain_one()  # may drop a failed worker
+                        # injected dispatch fault: per-dispatch
+                        # semantics — THIS dispatch computes inline,
+                        # the worker keeps the rest of the encode
+                        dispatch_fault = False
+                        if faultinject._points:
+                            try:
+                                faultinject.hit("ec.dispatch")
+                            except Exception:
+                                dispatch_fault = True
+                        if worker is not None and dispatch_fault:
+                            self._note_fallback(st, "dispatch_fault")
+                            tr.event("pipeline.fallback",
+                                     dispatch=st["dispatches"],
+                                     reason="dispatch_fault")
+                        elif worker is not None:
                             slot = slot_seq % worker.nbufs
                             slot_seq += 1
                             t0 = clock()
+                            submitted = False
                             with tr.span("pipeline.dispatch",
                                          dispatch=st["dispatches"],
                                          bytes=k * n):
-                                worker.submit(slot, base, block, n)
+                                try:
+                                    worker.submit(slot, base, block, n)
+                                    submitted = True
+                                except (KeyboardInterrupt, SystemExit):
+                                    raise
+                                except Exception as e:
+                                    # submit path gave up: drain what's
+                                    # in flight serially, finish without
+                                    # the worker
+                                    self._drop_file_worker()
+                                    worker = None
+                                    reason = ("worker_gave_up"
+                                              if isinstance(e, WorkerGaveUp)
+                                              else "worker_error")
+                                    self._note_fallback(st, reason)
+                                    tr.event("pipeline.fallback",
+                                             dispatch=st["dispatches"],
+                                             reason=reason)
                             st["dispatch_s"] += clock() - t0
-                            pending.append((slot, n, out_off, base, block,
-                                            st["dispatches"]))
-                            st["dispatches"] += 1
-                            st["bytes_in"] += k * n
-                            out_off += n
-                            continue
+                            if submitted:
+                                pending.append((slot, n, out_off, base,
+                                                block, st["dispatches"]))
+                                st["dispatches"] += 1
+                                st["bytes_in"] += k * n
+                                out_off += n
+                                continue
                         # all k source rows fully inside the file: matmul
                         # in place from the mapping, parity stored
                         # straight into the output mappings
@@ -664,6 +823,8 @@ class StreamingEncoder:
                 f.close()
             st["close_s"] = clock() - t0
             st["wall_s"] = clock() - t_start
+            st["worker_restarts"] = int(_restart_total() -
+                                        self._restart_base)
             # a failed encode tags the root span with the in-flight
             # exception (ok gates against a stale caller-level exc_info)
             root.__exit__(*(sys.exc_info() if not ok
@@ -673,21 +834,81 @@ class StreamingEncoder:
                     large_block_size: int = LARGE_BLOCK_SIZE,
                     small_block_size: int = SMALL_BLOCK_SIZE) -> None:
         """dat_path -> out_base.ec00..ecNN, byte-identical to
-        encoder.write_ec_files (WriteEcFiles, ec_encoder.go:57)."""
+        encoder.write_ec_files (WriteEcFiles, ec_encoder.go:57).
+
+        Crash-safe: the staged pipeline checkpoints the last fully
+        drained-and-written dispatch, and a mid-encode failure retries
+        (up to max_encode_retries) RESUMING from that checkpoint — the
+        outputs are truncated back to the checkpoint byte and the entry
+        plan fast-forwards past the completed prefix, so a 30GB encode
+        that faults at byte 29G does not start over from byte 0.
+        Dispatch packing after a resume may differ from a clean run, but
+        the GF matmul is column-independent so the shard bytes cannot."""
         matmul_ptrs = self._native_ptrs()
         if matmul_ptrs is not None:
             return self._encode_file_mmap(
                 dat_path, out_base, large_block_size, small_block_size,
                 matmul_ptrs)
+        retries = 0
+        start_entry = start_byte = 0
+        try:
+            while True:
+                try:
+                    return self._encode_file_staged(
+                        dat_path, out_base, large_block_size,
+                        small_block_size, start_entry, start_byte, retries)
+                except (KeyboardInterrupt, SystemExit):
+                    raise
+                except Exception as e:
+                    ck_entry, ck_byte = self._ckpt
+                    if retries >= self.max_encode_retries:
+                        # same discipline as encoder.write_ec_files: a
+                        # truncated .ecNN surviving a failed encode would
+                        # satisfy existence checks and mask the missing
+                        # bytes on the next mount/rebuild
+                        for i in range(self.k + self.r):
+                            try:
+                                os.remove(out_base + to_ext(i))
+                            except OSError:
+                                pass
+                        raise
+                    retries += 1
+                    self._reap_stale_workers()  # attempt's views unwound
+                    start_entry, start_byte = ck_entry, ck_byte
+                    self._tracer().event(
+                        "pipeline.retry", scope="encode_file",
+                        attempt=retries, resume_entry=ck_entry,
+                        resume_byte=ck_byte,
+                        error=f"{type(e).__name__}: {e}")
+        finally:
+            self._reap_stale_workers()
+
+    def _encode_file_staged(self, dat_path: str, out_base: str,
+                            large_block_size: int, small_block_size: int,
+                            start_entry: int = 0, start_byte: int = 0,
+                            retries: int = 0) -> None:
+        """One attempt of the staged (non-mmap) pipeline, starting at
+        plan entry start_entry / shard byte start_byte.  Maintains
+        self._ckpt = (entries drained+written, bytes per shard) as the
+        contiguous-completion checkpoint (drain order is FIFO, so the
+        completed prefix is always contiguous).  Per-dispatch engine
+        decisions: a worker fault heals via supervision inside fetch();
+        a worker that gave up, or a failing device dispatch/fetch,
+        degrades THIS dispatch (and, for terminal faults, the rest of
+        the encode) to the CPU codec — byte-identical output either
+        way."""
         k, r, b = self.k, self.r, self.dispatch_b
         st = self._reset_stats()
+        st["retries"] = retries
+        self._ckpt = (start_entry, start_byte)
         clock = time.perf_counter
         t_start = clock()
         planes = self._planes(self.matrix[k:])
         file_size = os.path.getsize(dat_path)
         tr = self._tracer()
         root = tr.span("pipeline.encode_file", path=dat_path,
-                       bytes=file_size, mode="staged", engine=self.engine)
+                       bytes=file_size, mode="staged", engine=self.engine,
+                       resume_entry=start_entry)
         root.__enter__()
         # setup covers output opens (O_TRUNC over existing shards frees
         # their page cache — real, attributable time), buffer allocation
@@ -697,7 +918,16 @@ class StreamingEncoder:
         outputs: list = []
         try:
             for i in range(k + r):
-                outputs.append(open(out_base + to_ext(i), "wb"))
+                p = out_base + to_ext(i)
+                if start_byte and os.path.exists(p):
+                    # resume: drop torn bytes past the checkpoint, keep
+                    # the completed prefix
+                    f = open(p, "r+b")
+                    f.truncate(start_byte)
+                    f.seek(start_byte)
+                else:
+                    f = open(p, "wb")
+                outputs.append(f)
             if self.engine == "host" and self._overlap == "process":
                 if self._proc_worker is not None \
                         and self._proc_worker.b != b:
@@ -706,8 +936,17 @@ class StreamingEncoder:
                 if self._proc_worker is None:
                     from .overlap import ProcessOverlapWorker
 
-                    self._proc_worker = ProcessOverlapWorker(
-                        k, r, b, self.matrix[k:], self.depth + 1)
+                    try:
+                        self._proc_worker = ProcessOverlapWorker(
+                            k, r, b, self.matrix[k:], self.depth + 1,
+                            ack_timeout=self.drain_timeout_s,
+                            max_restarts=self.max_worker_restarts)
+                    except Exception as e:
+                        # no worker is a degraded mode, not a failure:
+                        # the encode runs synchronously on the CPU codec
+                        self._note_fallback(st, "worker_spawn")
+                        tr.event("pipeline.fallback", reason="worker_spawn",
+                                 error=f"{type(e).__name__}: {e}")
             # process overlap: dispatch buffers ARE the shared-memory pool
             bufs = self._proc_worker.bufs \
                 if self._proc_worker is not None \
@@ -723,20 +962,68 @@ class StreamingEncoder:
             root.__exit__(*exc)
             raise
         free: deque[int] = deque(range(len(bufs)))
-        # (device parity, packed width, buffer index, dispatch index)
-        pending: deque[tuple[object, int, int, int]] = deque()
+        # (parity handle, packed width, buffer index, dispatch index,
+        #  entries packed into the dispatch)
+        pending: deque[tuple[object, int, int, int, int]] = deque()
 
         ok = False
+        degraded = False  # terminal fault: rest of the encode goes CPU
 
         def drain_one():
-            parity_dev, u, bi, d_idx = pending.popleft()
+            nonlocal degraded
+            parity_dev, u, bi, d_idx, nfills = pending.popleft()
+            is_proc = isinstance(parity_dev, tuple) and \
+                parity_dev[0] == "proc"
+            parity = None
+            reason = None
+            # injected drain fault: the dispatch recomputes on the CPU,
+            # the worker (which did the work) gets its FIFO realigned
+            drain_fault = False
+            if faultinject._points:
+                try:
+                    faultinject.hit("ec.drain")
+                except Exception:
+                    drain_fault = True
             t0 = clock()
             with tr.span("pipeline.drain", dispatch=d_idx, bytes=r * u):
-                parity = self._fetch(parity_dev)
+                if drain_fault:
+                    reason = "drain_fault"
+                    if is_proc and self._proc_worker is not None:
+                        self._proc_worker.skip_next()
+                else:
+                    try:
+                        parity = self._fetch(parity_dev)
+                    except WorkerJobError:
+                        # failed inside a live worker: recompute this one
+                        # dispatch, keep the worker (seq already consumed)
+                        reason = "worker_job"
+                    except (KeyboardInterrupt, SystemExit):
+                        raise
+                    except Exception as e:
+                        if isinstance(e, WorkerGaveUp):
+                            reason = "worker_gave_up"
+                        elif is_proc:
+                            reason = "worker_error"  # protocol desync
+                        else:
+                            reason = "device_fetch"
+                        if is_proc:
+                            self._abandon_proc_worker()
+                        degraded = True
             st["drain_wait_s"] += clock() - t0
-            if self._proc_worker is not None:
+            if parity is not None and is_proc and \
+                    self._proc_worker is not None:
                 self._merge_worker_span(tr, self._proc_worker,
                                         root.span_id, d_idx)
+            if parity is None:
+                # the input buffer is still intact: buffers are only
+                # recycled (free.append below) after their dispatch
+                # drains, so the CPU codec can recompute losslessly
+                t0 = clock()
+                with tr.span("pipeline.fallback", dispatch=d_idx,
+                             reason=reason):
+                    parity = self._cpu_parity(bufs[bi][:, :u])
+                st["dispatch_s"] += clock() - t0
+                self._note_fallback(st, reason)
             t0 = clock()
             # entries pack side by side, so each parity row's bytes for
             # this dispatch are one contiguous slice
@@ -745,6 +1032,10 @@ class StreamingEncoder:
                     outputs[k + j].write(memoryview(parity[j, :u]))
             st["write_s"] += clock() - t0
             free.append(bi)
+            # dispatch d_idx is fully drained AND written on every shard:
+            # advance the resume checkpoint past its entries/bytes
+            ck_e, ck_b = self._ckpt
+            self._ckpt = (ck_e + nfills, ck_b + u)
 
         try:
             with open(dat_path, "rb") as dat:
@@ -753,7 +1044,7 @@ class StreamingEncoder:
                 bi = free.popleft()
 
                 def flush():
-                    nonlocal bi, used, fills
+                    nonlocal bi, used, fills, degraded
                     if not used:
                         return
                     d_idx = st["dispatches"]
@@ -781,14 +1072,56 @@ class StreamingEncoder:
                         if used < b:
                             buf[:, used:] = 0
                     st["fill_s"] += clock() - t0
+                    # injected dispatch fault: THIS dispatch goes CPU,
+                    # the pipeline stays on its engine
+                    dispatch_fault = False
+                    if faultinject._points:
+                        try:
+                            faultinject.hit("ec.dispatch")
+                        except Exception:
+                            dispatch_fault = True
                     t0 = clock()
                     with tr.span("pipeline.dispatch", dispatch=d_idx,
                                  bytes=k * used):
-                        if self._proc_worker is not None:
-                            parity_dev = ("proc",
-                                          self._proc_worker.submit(bi, used))
+                        if degraded or dispatch_fault:
+                            parity_dev = self._cpu_parity(buf[:, :used])
+                            self._note_fallback(
+                                st, "degraded" if degraded
+                                else "dispatch_fault")
+                        elif self._proc_worker is not None:
+                            try:
+                                parity_dev = (
+                                    "proc",
+                                    self._proc_worker.submit(bi, used))
+                            except (KeyboardInterrupt, SystemExit):
+                                raise
+                            except Exception as e:
+                                # submit gave up: this and all later
+                                # dispatches degrade to the CPU codec
+                                self._abandon_proc_worker()
+                                degraded = True
+                                reason = ("worker_gave_up"
+                                          if isinstance(e, WorkerGaveUp)
+                                          else "worker_error")
+                                self._note_fallback(st, reason)
+                                tr.event("pipeline.fallback",
+                                         dispatch=d_idx, reason=reason)
+                                parity_dev = self._cpu_parity(buf[:, :used])
                         else:
-                            parity_dev = self._dispatch(planes, buf)
+                            try:
+                                parity_dev = self._dispatch(planes, buf)
+                            except (KeyboardInterrupt, SystemExit):
+                                raise
+                            except Exception as e:
+                                # device dispatch failed: degrade the
+                                # rest of the encode to the CPU codec
+                                degraded = True
+                                self._note_fallback(st, "device_dispatch")
+                                tr.event("pipeline.fallback",
+                                         dispatch=d_idx,
+                                         reason="device_dispatch",
+                                         error=f"{type(e).__name__}: {e}")
+                                parity_dev = self._cpu_parity(buf[:, :used])
                     st["dispatch_s"] += clock() - t0
                     st["dispatches"] += 1
                     st["bytes_in"] += k * used
@@ -801,7 +1134,8 @@ class StreamingEncoder:
                         for i in range(k):
                             outputs[i].write(memoryview(buf[i, :used]))
                     st["write_s"] += clock() - t0
-                    pending.append((parity_dev, used, bi, d_idx))
+                    pending.append((parity_dev, used, bi, d_idx,
+                                    len(fills)))
                     fills, used = [], 0
                     if len(pending) > self.depth:
                         drain_one()
@@ -812,8 +1146,11 @@ class StreamingEncoder:
                 st["setup_s"] = clock() - t_start
                 setup.__exit__(None, None, None)
                 setup = None
-                for n, row_start, block, off in _plan_entries(
-                        file_size, k, large_block_size, small_block_size, b):
+                entries = _plan_entries(file_size, k, large_block_size,
+                                        small_block_size, b)
+                for _ in range(start_entry):  # resume: skip completed
+                    next(entries, None)
+                for n, row_start, block, off in entries:
                     if used + n > b:
                         flush()
                     fills.append((used, n, row_start, block, off))
@@ -826,12 +1163,20 @@ class StreamingEncoder:
             exc = sys.exc_info() if not ok else (None, None, None)
             if setup is not None:  # failed before the loop started
                 setup.__exit__(*exc)
+            if pending and self._proc_worker is not None:
+                # abnormal exit with submitted-but-undrained jobs: their
+                # acks would desync the retry attempt's (or a later
+                # encode's) seq stream — abandon the worker; the retry
+                # respawns fresh (mmap path does the same)
+                self._abandon_proc_worker()
             t0 = clock()
             with tr.span("pipeline.close"):
                 for f in outputs:
                     f.close()
             st["close_s"] = clock() - t0
             st["wall_s"] = clock() - t_start
+            st["worker_restarts"] = int(_restart_total() -
+                                        self._restart_base)
             root.__exit__(*exc)
 
     def _rebuild_files_mmap(self, base: str, missing: list[int],

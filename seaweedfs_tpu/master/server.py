@@ -52,9 +52,12 @@ class MasterServer:
         self.garbage_threshold = garbage_threshold
         self.seq = (SnowflakeSequencer() if sequencer == "snowflake"
                     else MemorySequencer())
-        from ..stats import master_metrics
+        from ..stats import ec_pipeline_metrics, master_metrics
 
         self.metrics = master_metrics()
+        # pre-register the degraded-bind/self-healing counter families
+        # so scrapers see the series at 0 before any incident
+        ec_pipeline_metrics()
         from .consensus import RaftNode
 
         self.raft = RaftNode(
@@ -123,6 +126,20 @@ class MasterServer:
             self._tcp_server = FramedServer(
                 _tcp_handle, self.host, tcp_port_for(self.port),
                 name="tcp-master").start()
+            if not self._tcp_server.alive:
+                # coming up without the TCP assign front is legal (HTTP
+                # serves everything) but must be OBSERVABLE, not silent:
+                # clients fall back per-request, which looks like a
+                # latency regression unless this event is on the record
+                from ..observability import get_tracer
+                from ..stats import ec_pipeline_metrics
+
+                ec_pipeline_metrics().degraded_binds.inc("master-tcp")
+                get_tracer().event(
+                    "server.degraded_bind", role="master-tcp",
+                    port=tcp_port_for(self.port),
+                    detail="framed-TCP assign front bind failed; "
+                           "HTTP assign still serves")
         self.raft.start()
         threading.Thread(target=self._janitor_loop, daemon=True,
                          name="master-janitor").start()

@@ -181,6 +181,14 @@ class Tracer:
                           thread or ct.name, t0, t1, attrs))
         return span_id
 
+    def event(self, name: str, **attrs) -> Optional[str]:
+        """Record an instant (zero-duration) span — for punctual facts
+        like a supervisor restart, an engine fallback decision, or a
+        degraded bind, where the interesting thing is THAT it happened
+        and its attrs, not how long it took."""
+        t = _now()
+        return self.add_span(name, t, t, **attrs)
+
     def _next_id(self) -> str:
         with self._lock:
             self._seq += 1

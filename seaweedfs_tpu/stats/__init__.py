@@ -1,9 +1,9 @@
 from .metrics import (Counter, Gauge, Histogram, Registry, REGISTRY,
                       master_metrics, volume_server_metrics, filer_metrics,
-                      s3_metrics, start_push_loop)
+                      s3_metrics, ec_pipeline_metrics, start_push_loop)
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "Registry", "REGISTRY",
     "master_metrics", "volume_server_metrics", "filer_metrics", "s3_metrics",
-    "start_push_loop",
+    "ec_pipeline_metrics", "start_push_loop",
 ]

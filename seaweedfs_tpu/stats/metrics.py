@@ -328,6 +328,41 @@ class S3Metrics(_ServerMetrics):
         super().__init__("s3", registry)
 
 
+class ECPipelineMetrics:
+    """Self-healing EC pipeline counters: worker restarts by the
+    supervisor (ec/overlap.py) and per-dispatch engine fallbacks to the
+    CPU codec (ec/streaming.py, ec/codec.py).  Separate from the
+    per-role bundles because the pipeline runs inside whatever process
+    invoked the encode — volume server, shell tool, or bench."""
+
+    def __init__(self, registry: Registry = REGISTRY):
+        self.worker_restarts = registry.counter(
+            "SeaweedFS_ec_worker_restarts_total",
+            "Parity worker processes respawned by the pipeline supervisor.",
+            labels=("kind",))
+        self.engine_fallbacks = registry.counter(
+            "SeaweedFS_ec_engine_fallbacks_total",
+            "EC dispatches that fell back to the CPU codec.",
+            labels=("reason",))
+        self.degraded_binds = registry.counter(
+            "SeaweedFS_server_degraded_binds_total",
+            "Servers that came up without their framed-TCP plane "
+            "(bind failed; HTTP still serves).",
+            labels=("role",))
+
+    def totals(self) -> dict[str, int]:
+        """Label-summed snapshot of every family — the one shape /status,
+        the EC admin routes, encode stats, and bench health all consume."""
+        return {
+            "worker_restarts":
+                int(sum(self.worker_restarts.snapshot().values())),
+            "engine_fallbacks":
+                int(sum(self.engine_fallbacks.snapshot().values())),
+            "degraded_binds":
+                int(sum(self.degraded_binds.snapshot().values())),
+        }
+
+
 _singletons: dict[str, object] = {}
 _singleton_lock = threading.Lock()
 
@@ -353,6 +388,10 @@ def filer_metrics() -> FilerMetrics:
 
 def s3_metrics() -> S3Metrics:
     return _singleton("s3", S3Metrics)
+
+
+def ec_pipeline_metrics() -> ECPipelineMetrics:
+    return _singleton("ec_pipeline", ECPipelineMetrics)
 
 
 def start_push_loop(gateway_url: str, job: str,

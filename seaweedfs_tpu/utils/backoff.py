@@ -1,0 +1,20 @@
+"""Jittered exponential backoff — the one retry-delay policy.
+
+Used by the EC parity-worker supervisor (ec/overlap.py) and the wdclient
+master-reconnect loop; any future retry site should use this instead of
+hand-rolling the formula, so cap/jitter semantics can't drift between
+subsystems.
+"""
+
+from __future__ import annotations
+
+import random
+
+
+def jittered_backoff(base: float, cap: float, attempt: int) -> float:
+    """Delay for the attempt-th retry (attempt counts from 0):
+    exponential base*2^attempt bounded by cap, with 50-100% jitter so a
+    fleet of clients (or a crash-looping supervisor) never retries in
+    lockstep.  The jitter is applied INSIDE the cap — the returned delay
+    never exceeds cap, and at saturation still spreads over [cap/2, cap]."""
+    return random.uniform(0.5, 1.0) * min(cap, base * (2 ** attempt))

@@ -16,6 +16,25 @@ Instrumented sites (grep for fi.hit to find them all):
     disk.read / disk.write / disk.sync   — DiskFile positional IO
     shard.read                           — EC shard pread
     net.request                          — pooled HTTP client sends
+    ec.worker.ack                        — parity worker ack read (parent
+                                           side); an injected error is
+                                           treated as worker death: the
+                                           supervisor SIGKILLs and
+                                           respawns the real process,
+                                           replaying in-flight dispatches
+    ec.shm                               — parity worker spawn/shm attach;
+                                           arming it makes respawns fail,
+                                           deterministically exhausting
+                                           the retry budget (CPU fallback
+                                           drills)
+    ec.dispatch / ec.drain               — streaming pipeline submit and
+                                           drain; an injected error forces
+                                           a per-dispatch CPU fallback
+
+The ec.* points fire in the ENCODING PARENT only: overlap workers are
+spawned processes with their own (empty) fault registry, so arming a
+point never corrupts worker-side compute — it exercises the parent's
+recovery paths deterministically.
 """
 
 from __future__ import annotations

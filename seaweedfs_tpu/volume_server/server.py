@@ -49,14 +49,19 @@ from .store import Store
 FID_PATTERN = r"/(\d+),([0-9a-f]+)"
 
 
-def _bind_with_retry(factory, timeout: float = 3.0, pause: float = 0.15):
+def _bind_with_retry(factory, timeout: float = 3.0, pause: float = 0.15,
+                     role: str = "volume"):
     """The TCP data plane binds the DERIVED port tcp_port_for(http_port),
     so a prior server instance draining its listener (restart, test
     teardown, TIME_WAIT without reuse) races the bind — retry briefly
     before giving up.  Only bind failures retry: OSError, or a degraded
     FramedServer (its start() swallows the bind error and comes back
     with alive=False).  Anything else — e.g. the native plane's
-    RuntimeError when there is no C++ toolchain — fails fast."""
+    RuntimeError when there is no C++ toolchain — fails fast.
+
+    Coming up degraded is an OBSERVABLE event, not a silent one: it
+    lands on the tracer as a server.degraded_bind span and on /metrics
+    as SeaweedFS_server_degraded_binds_total{role=...}."""
     deadline = time.monotonic() + timeout
     while True:
         exc, srv = None, None
@@ -69,6 +74,13 @@ def _bind_with_retry(factory, timeout: float = 3.0, pause: float = 0.15):
         if time.monotonic() >= deadline:
             if exc is not None:
                 raise exc
+            from ..observability import get_tracer
+            from ..stats import ec_pipeline_metrics
+
+            ec_pipeline_metrics().degraded_binds.inc(role)
+            get_tracer().event("server.degraded_bind", role=role,
+                               detail="tcp plane bind failed; "
+                                      "HTTP plane still serves")
             return srv  # degraded server: the HTTP plane still serves
         time.sleep(pause)
 
@@ -104,9 +116,13 @@ class VolumeServer:
         self.store = Store(directories, host, port, public_url,
                            max_volume_count, ec_engine=ec_engine,
                            use_mmap=use_mmap)
-        from ..stats import volume_server_metrics
+        from ..stats import ec_pipeline_metrics, volume_server_metrics
 
         self.metrics = volume_server_metrics()
+        # register the self-healing counter families up front so a
+        # scraper sees the series (at 0) before the first restart or
+        # fallback ever happens
+        ec_pipeline_metrics()
         self.metrics.max_volume_counter.set(max_volume_count)
         self.router = Router("volume", metrics=self.metrics)
         self._register_routes()
@@ -156,7 +172,8 @@ class VolumeServer:
                 tcp_port = (-1 if self.guard.white_list
                             else tcp_port_for(self.store.port))
                 self._native_plane = _bind_with_retry(
-                    lambda: NativeDataPlane(self.store.ip, tcp_port))
+                    lambda: NativeDataPlane(self.store.ip, tcp_port),
+                    role="volume-native")
                 self.store.attach_native_plane(self._native_plane)
             else:
                 from .tcp import TcpVolumeServer
@@ -167,7 +184,8 @@ class VolumeServer:
                         whitelist_ok=(self.guard.check_white_list
                                       if self.guard.is_write_active else None),
                         replicate_write=self._tcp_replicate_write,
-                        replicate_delete=self._tcp_replicate_delete).start())
+                        replicate_delete=self._tcp_replicate_delete).start(),
+                    role="volume-tcp")
         threading.Thread(target=self._heartbeat_loop, daemon=True,
                          name=f"heartbeat:{self.url}").start()
         return self
@@ -473,10 +491,18 @@ class VolumeServer:
                     volumes.append({"id": v.id, "collection": v.collection,
                                     "read_only": v.read_only,
                                     "mid_swap": True})
+            from ..stats import ec_pipeline_metrics
+
             doc = {
                 "Version": "seaweedfs-tpu 0.1",
                 "Volumes": volumes,
                 "EcVolumes": sorted(list(self.store.ec_volumes)),
+                # self-healing pipeline health: nonzero restarts mean the
+                # supervisor respawned parity workers, nonzero fallbacks
+                # mean dispatches degraded to the CPU codec — encodes
+                # still completed byte-identical, but perf numbers from
+                # this server may reflect degraded runs
+                "EcPipeline": ec_pipeline_metrics().totals(),
             }
             plane = self.store.native_plane
             if plane is not None:
@@ -1101,20 +1127,42 @@ class VolumeServer:
                              "crc_errors": crc_errors})
 
         # --- admin: EC (volume_grpc_erasure_coding.go) ----------------
+        def _ec_pipeline_snapshot() -> dict:
+            from ..stats import ec_pipeline_metrics
+
+            return ec_pipeline_metrics().totals()
+
+        def _ec_pipeline_health(before: dict) -> dict:
+            """Delta of the self-healing counters across one admin EC
+            operation: the caller (shell, maintenance script) can tell a
+            clean run from one that survived worker restarts or degraded
+            to the CPU codec.  Best-effort attribution — the counters
+            are process-global, so EC operations running concurrently on
+            OTHER volumes can leak into each other's deltas (a false
+            "degraded" flag, never a false "clean")."""
+            now = _ec_pipeline_snapshot()
+            return {"worker_restarts":
+                        now["worker_restarts"] - before["worker_restarts"],
+                    "engine_fallbacks":
+                        now["engine_fallbacks"] - before["engine_fallbacks"]}
+
         @r.route("POST", "/admin/ec/generate")
         def ec_generate(req: Request) -> Response:
             b = req.json()
+            before = _ec_pipeline_snapshot()
             self.store.ec_generate(int(b["volume_id"]), b.get("collection", ""),
                                    b.get("engine"))
-            return Response({})
+            return Response({"pipeline": _ec_pipeline_health(before)})
 
         @r.route("POST", "/admin/ec/rebuild")
         def ec_rebuild(req: Request) -> Response:
             b = req.json()
+            before = _ec_pipeline_snapshot()
             rebuilt = self.store.ec_rebuild(int(b["volume_id"]),
                                             b.get("collection", ""),
                                             b.get("engine"))
-            return Response({"rebuilt_shard_ids": rebuilt})
+            return Response({"rebuilt_shard_ids": rebuilt,
+                             "pipeline": _ec_pipeline_health(before)})
 
         @r.route("POST", "/admin/ec/copy")
         def ec_copy(req: Request) -> Response:

@@ -419,6 +419,24 @@ def test_hdfs_sink_end_to_end():
 # google pub/sub queue (REST + RS256 service-account grant)
 # --------------------------------------------------------------------------
 
+def _has_cryptography() -> bool:
+    try:
+        import cryptography  # noqa: F401
+
+        return True
+    except ImportError:
+        return False
+
+
+# environmental guard: the pub/sub double signs its OAuth grant with an
+# RSA key from `cryptography`, intentionally absent in this container —
+# the reason string keeps the tier-1 log distinguishing missing-lib
+# skips from real regressions
+requires_cryptography = pytest.mark.skipif(
+    not _has_cryptography(),
+    reason="environmental: cryptography not installed in this container")
+
+
 class _MiniPubSub:
     """Double acting as BOTH the OAuth token endpoint and the Pub/Sub
     publish endpoint; verifies the RS256 JWT grant with the service
@@ -507,6 +525,7 @@ class _MiniPubSub:
         self._srv.server_close()
 
 
+@requires_cryptography
 def test_google_pubsub_signed_grant_and_publish(tmp_path):
     import json as _json
 
@@ -554,6 +573,7 @@ def test_google_pubsub_signed_grant_and_publish(tmp_path):
         srv.stop()
 
 
+@requires_cryptography
 def test_google_pubsub_emulator_mode():
     import json as _json
     import time as _time
