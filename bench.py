@@ -43,6 +43,23 @@ PROBE_TIMEOUT_S = 240       # first TPU compile can take ~40s; tunnel flaps long
 BENCH_TIMEOUT_S = 1500
 CPU_BENCH_TIMEOUT_S = 900
 
+# Per-section wall-clock caps (seconds).  BENCH_r05 died at the GLOBAL
+# 1500s because e2e_stream ran 460s and the cluster sections behind it
+# starved into the parent's SIGKILL — rc=-9 and an "error" instead of a
+# JSON with whatever had completed.  Each section now runs under its own
+# deadline; a section that would bust the remaining child budget is
+# skipped upfront and recorded as {"skipped": "section_timeout"}.
+SECTION_CAPS = {
+    "cpu_baseline": 180, "inhbm": 300, "alt_geometries": 180,
+    "multi_decode": 240, "batched_needles": 120, "rebuild": 180,
+    "transfer": 90, "e2e_stream": 600, "e2e_rebuild": 300,
+    "e2e_decode_8gb": 420, "roofline": 90, "cluster": 360,
+    "cluster_native": 360, "cluster_scaled": 420, "parity": 120,
+    "pipeline_health": 15,
+}
+SECTION_CAP_DEFAULT = 300
+SECTION_MIN_S = 15          # least useful remaining budget to even start
+
 
 # --------------------------------------------------------------------------
 # shared e2e helpers (module-level so the trace smoke test can import them;
@@ -107,10 +124,24 @@ def _span_summary(tracer, max_dispatches: int = 48) -> dict:
     return out
 
 
+def _attribution(tracer, stats: dict) -> dict:
+    """Critical-path attribution for the rep just traced: per-stage
+    seconds, the critical-path stage, and the clean-vs-degraded verdict
+    (driven by this call's retry/fallback/restart deltas), computed by
+    observability/analysis.py from the same span ring."""
+    from seaweedfs_tpu.observability.analysis import (analyze,
+                                                      attribution_summary)
+
+    counters = {k: stats.get(k, 0)
+                for k in ("retries", "fallbacks", "worker_restarts")}
+    return attribution_summary(analyze(tracer, counters=counters))
+
+
 def _e2e_one(base_dir, size_mb, reps=2, tracer=None, **enc_kw):
     """One e2e streaming-encode measurement -> (mbps, pipe, chrome_doc).
     With a tracer, the ring is cleared per rep and the BEST rep's span
-    summary (pipe["spans"]) + Chrome trace document are returned."""
+    summary (pipe["spans"]) + attribution report (pipe["attribution"])
+    + Chrome trace document are returned."""
     from seaweedfs_tpu.ec.streaming import StreamingEncoder
 
     with tempfile.TemporaryDirectory(dir=base_dir) as td:
@@ -120,6 +151,7 @@ def _e2e_one(base_dir, size_mb, reps=2, tracer=None, **enc_kw):
         enc = StreamingEncoder(10, 4, tracer=tracer, **enc_kw)
         enc.encode_file(dat, os.path.join(td, "1"))  # warm compile+pages
         best_dt, stats, spans, chrome = float("inf"), None, None, None
+        attribution = None
         for _ in range(reps):
             if tracer is not None:
                 tracer.clear()
@@ -131,6 +163,7 @@ def _e2e_one(base_dir, size_mb, reps=2, tracer=None, **enc_kw):
                 if tracer is not None:
                     spans = _span_summary(tracer)
                     chrome = tracer.to_chrome()
+                    attribution = _attribution(tracer, stats)
         mbps = round(raw_len / best_dt / 1e6, 1)
         wall = stats.get("wall_s") or best_dt
         pipe = {k: round(v, 3) if isinstance(v, float) else v
@@ -140,6 +173,8 @@ def _e2e_one(base_dir, size_mb, reps=2, tracer=None, **enc_kw):
             1.0 - stats.get("drain_wait_s", 0.0) / wall, 3)
         if spans is not None:
             pipe["spans"] = spans
+        if attribution is not None:
+            pipe["attribution"] = attribution
         return mbps, pipe, chrome
 
 
@@ -177,16 +212,68 @@ def _child(scratch_path: str, platform: str = "") -> None:
 
     detail: dict = {}
 
+    def _dump_detail() -> str:
+        # an abandoned (timed-out) section thread may still be mutating
+        # detail: retry the serialize instead of dying on "dict changed
+        # size during iteration" — valid JSON always beats a stack trace
+        for _ in range(5):
+            try:
+                return json.dumps(detail)
+            except RuntimeError:
+                time.sleep(0.01)
+        return json.dumps({k: v for k, v in list(detail.items())
+                           if isinstance(v, (str, int, float, bool))
+                           or v is None})
+
     def checkpoint():
         with open(scratch_path, "w") as f:
-            json.dump(detail, f)
+            f.write(_dump_detail())
+
+    # the parent hands the child slightly less than its own subprocess
+    # timeout; sections spend from this shared budget so a long early
+    # section can no longer starve the rest into the parent's SIGKILL
+    t_child0 = time.perf_counter()
+    budget = float(os.environ.get("BENCH_CHILD_BUDGET_S", "0") or 0)
+
+    def remaining() -> float:
+        if not budget:
+            return float("inf")
+        return budget - (time.perf_counter() - t_child0)
 
     def section(name, fn):
+        import threading as _threading
+
+        cap = SECTION_CAPS.get(name, SECTION_CAP_DEFAULT)
+        left = remaining()
+        if left < SECTION_MIN_S:
+            # would bust the global budget: record the skip, keep the
+            # JSON (and every completed section) intact
+            detail.setdefault("sections_skipped", {})[name] = \
+                "section_timeout"
+            checkpoint()
+            return
+        cap = min(cap, max(left - 10.0, SECTION_MIN_S))
+        errs: list[str] = []
+
+        def runner():
+            try:
+                fn()
+            except Exception as e:  # record and continue: partial > nothing
+                errs.append(f"{type(e).__name__}: {e}"[:500])
+
         t0 = time.perf_counter()
-        try:
-            fn()
-        except Exception as e:  # record and continue: partial > nothing
-            detail[f"error_{name}"] = f"{type(e).__name__}: {e}"[:500]
+        th = _threading.Thread(target=runner, daemon=True,
+                               name=f"bench-{name}")
+        th.start()
+        th.join(cap)
+        if th.is_alive():
+            # the runaway thread cannot be killed — it is abandoned
+            # (daemon) and later sections run beside it; the parent's
+            # subprocess timeout stays the backstop
+            detail[f"error_{name}"] = \
+                f"section timeout after {int(cap)}s (budget)"
+        elif errs:
+            detail[f"error_{name}"] = errs[0]
         detail.setdefault("section_s", {})[name] = round(
             time.perf_counter() - t0, 1)
         checkpoint()
@@ -608,7 +695,28 @@ def _child(scratch_path: str, platform: str = "") -> None:
             detail["e2e_link_efficiency"] = round(
                 detail["e2e_file_encode_mbps"] / ceiling, 3)
 
-    section("e2e_stream", meas_e2e)
+    def meas_e2e_profiled():
+        # --profile-out: a wall-clock sampling profile of the e2e
+        # section, in collapsed-stack (flamegraph.pl) format — separates
+        # python overhead in the drain loop from device/kernel time.
+        # try/finally: the file is written (and the 200 Hz sampler
+        # stopped) even when the section dies mid-measurement
+        profile_out = os.environ.get("BENCH_PROFILE_OUT")
+        if not profile_out:
+            return meas_e2e()
+        from seaweedfs_tpu.observability import SamplingProfiler
+
+        profiler = SamplingProfiler(hz=200).start()
+        try:
+            meas_e2e()
+        finally:
+            profiler.stop()
+            with open(profile_out, "w") as f:
+                f.write(profiler.collapsed())
+            detail["profile_out"] = profile_out
+            detail["profile_samples"] = profiler.samples
+
+    section("e2e_stream", meas_e2e_profiled)
 
     # --- e2e rebuild latency (streaming, from files) ----------------------
     def meas_e2e_rebuild():
@@ -979,7 +1087,7 @@ def _child(scratch_path: str, platform: str = "") -> None:
     section("pipeline_health", meas_pipeline_health)
 
     checkpoint()
-    print("BENCH_CHILD_RESULT " + json.dumps(detail), flush=True)
+    print("BENCH_CHILD_RESULT " + _dump_detail(), flush=True)
 
 
 # --------------------------------------------------------------------------
@@ -1025,7 +1133,12 @@ def _run_child(timeout, platform=""):
                 scratch_path]
         if platform:
             argv.append(platform)
-        rc, out, err = _run_sub(argv, timeout)
+        # the child gets a slightly smaller budget than the subprocess
+        # timeout so IT decides what to skip and still prints its JSON,
+        # instead of dying rc=-9 mid-section
+        env = dict(os.environ,
+                   BENCH_CHILD_BUDGET_S=str(max(timeout - 60, 60)))
+        rc, out, err = _run_sub(argv, timeout, env=env)
         for line in out.splitlines():
             if line.startswith("BENCH_CHILD_RESULT "):
                 return json.loads(line[len("BENCH_CHILD_RESULT "):]), None
@@ -1130,16 +1243,19 @@ def main() -> None:
 
 if __name__ == "__main__":
     # --trace-out PATH: persist the e2e section's Chrome trace-event JSON
-    # (open in chrome://tracing or ui.perfetto.dev).  Carried to the
-    # measurement child via the environment so every fallback re-exec
-    # (TPU -> CPU) inherits it.
-    if "--trace-out" in sys.argv:
-        i = sys.argv.index("--trace-out")
-        if i + 1 >= len(sys.argv):
-            print("--trace-out requires a path", file=sys.stderr)
-            sys.exit(2)
-        os.environ["BENCH_TRACE_OUT"] = os.path.abspath(sys.argv[i + 1])
-        del sys.argv[i:i + 2]
+    # (open in chrome://tracing or ui.perfetto.dev).  --profile-out PATH:
+    # persist a collapsed-stack (flamegraph.pl) sampling profile of the
+    # same section.  Both carried to the measurement child via the
+    # environment so every fallback re-exec (TPU -> CPU) inherits them.
+    for flag, env_key in (("--trace-out", "BENCH_TRACE_OUT"),
+                          ("--profile-out", "BENCH_PROFILE_OUT")):
+        if flag in sys.argv:
+            i = sys.argv.index(flag)
+            if i + 1 >= len(sys.argv):
+                print(f"{flag} requires a path", file=sys.stderr)
+                sys.exit(2)
+            os.environ[env_key] = os.path.abspath(sys.argv[i + 1])
+            del sys.argv[i:i + 2]
     if len(sys.argv) >= 3 and sys.argv[1] == "--child":
         _child(sys.argv[2], sys.argv[3] if len(sys.argv) > 3 else "")
     else:

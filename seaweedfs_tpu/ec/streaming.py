@@ -608,19 +608,21 @@ class StreamingEncoder:
                 nonlocal worker
                 slot, n, off, base, block, d_idx = pending.popleft()
                 parity = None
-                # injected drain fault: per-dispatch semantics, same as
-                # the staged path — THIS dispatch recomputes serially,
-                # the worker (which did the work) gets its FIFO
-                # realigned and keeps the rest of the encode
-                drain_fault = False
-                if faultinject._points:
-                    try:
-                        faultinject.hit("ec.drain")
-                    except Exception:
-                        drain_fault = True
                 if worker is not None:
                     t0 = clock()
                     with tr.span("pipeline.drain", dispatch=d_idx):
+                        # injected drain fault: per-dispatch semantics,
+                        # same as the staged path — THIS dispatch
+                        # recomputes serially, the worker (which did
+                        # the work) gets its FIFO realigned and keeps
+                        # the rest of the encode.  Hit inside the span
+                        # so delay-only faults attribute to drain
+                        drain_fault = False
+                        if faultinject._points:
+                            try:
+                                faultinject.hit("ec.drain")
+                            except Exception:
+                                drain_fault = True
                         if drain_fault:
                             worker.skip_next()
                             self._note_fallback(st, "drain_fault")
@@ -976,16 +978,19 @@ class StreamingEncoder:
                 parity_dev[0] == "proc"
             parity = None
             reason = None
-            # injected drain fault: the dispatch recomputes on the CPU,
-            # the worker (which did the work) gets its FIFO realigned
-            drain_fault = False
-            if faultinject._points:
-                try:
-                    faultinject.hit("ec.drain")
-                except Exception:
-                    drain_fault = True
             t0 = clock()
             with tr.span("pipeline.drain", dispatch=d_idx, bytes=r * u):
+                # injected drain fault: the dispatch recomputes on the
+                # CPU, the worker (which did the work) gets its FIFO
+                # realigned.  Hit INSIDE the span so a delay-only fault
+                # (slow-drain drills) is attributed to drain, where a
+                # real slow fetch would land
+                drain_fault = False
+                if faultinject._points:
+                    try:
+                        faultinject.hit("ec.drain")
+                    except Exception:
+                        drain_fault = True
                 if drain_fault:
                     reason = "drain_fault"
                     if is_proc and self._proc_worker is not None:
@@ -1084,10 +1089,15 @@ class StreamingEncoder:
                     with tr.span("pipeline.dispatch", dispatch=d_idx,
                                  bytes=k * used):
                         if degraded or dispatch_fault:
+                            reason = ("degraded" if degraded
+                                      else "dispatch_fault")
                             parity_dev = self._cpu_parity(buf[:, :used])
-                            self._note_fallback(
-                                st, "degraded" if degraded
-                                else "dispatch_fault")
+                            self._note_fallback(st, reason)
+                            # on the trace too: a fallback decision that
+                            # leaves no span would let a degraded run
+                            # read as clean in the analyzer
+                            tr.event("pipeline.fallback", dispatch=d_idx,
+                                     reason=reason)
                         elif self._proc_worker is not None:
                             try:
                                 parity_dev = (

@@ -44,6 +44,7 @@ class MasterServer:
                  vacuum_scan_seconds: float = 900.0,
                  maintenance_scripts: str = "",
                  maintenance_interval_seconds: float = 900.0,
+                 metrics_aggregation_seconds: float = 0.0,
                  tls_context=None):
         self.host, self.port = host, port
         self.guard = guard or Guard()
@@ -58,6 +59,15 @@ class MasterServer:
         # pre-register the degraded-bind/self-healing counter families
         # so scrapers see the series at 0 before any incident
         ec_pipeline_metrics()
+        # cluster telemetry rollup over the heartbeat-registered volume
+        # servers: /cluster/metrics + /cluster/health scrape on demand
+        # (TTL-cached); metrics_aggregation_seconds > 0 adds a periodic
+        # background scrape so the cache is always warm
+        from ..stats.aggregate import ClusterAggregator
+
+        self.metrics_aggregation_seconds = metrics_aggregation_seconds
+        self.aggregator = ClusterAggregator(
+            peers_fn=lambda: [n.url for n in self.topo.all_nodes()])
         from .consensus import RaftNode
 
         self.raft = RaftNode(
@@ -149,10 +159,13 @@ class MasterServer:
         if self.maintenance_scripts:
             threading.Thread(target=self._maintenance_loop, daemon=True,
                              name="master-maintenance").start()
+        if self.metrics_aggregation_seconds > 0:
+            self.aggregator.start_loop(self.metrics_aggregation_seconds)
         return self
 
     def stop(self) -> None:
         self._stop.set()
+        self.aggregator.stop_loop()
         if self._tcp_server is not None:
             self._tcp_server.stop()
         self.raft.stop()
@@ -383,6 +396,26 @@ class MasterServer:
                              "Leader": self.leader_url,
                              "Peers": self.raft.peers,
                              "Term": self.raft.term})
+
+        @r.route("GET", "/cluster/metrics")
+        def cluster_metrics(req: Request) -> Response:
+            """Merged Prometheus exposition across every registered
+            volume server: counters/gauges summed per label set,
+            histograms merged bucket-by-bucket, unreachable peers
+            marked stale (last-good values + peer_up 0) rather than
+            erroring.  Works on any master — the scrape targets come
+            from this node's own heartbeat registry."""
+            self.aggregator.scrape()
+            return Response(raw=self.aggregator.expose().encode(), headers={
+                "Content-Type": "text/plain; version=0.0.4; charset=utf-8"})
+
+        @r.route("GET", "/cluster/health")
+        def cluster_health(req: Request) -> Response:
+            """Per-volume-server pipeline health (worker restarts,
+            engine fallbacks, degraded binds) + reachability, with
+            cluster totals and a rollup degraded flag."""
+            self.aggregator.scrape()
+            return Response(self.aggregator.health())
 
         @r.route("GET", "/cluster/watch")
         def cluster_watch(req: Request) -> Response:

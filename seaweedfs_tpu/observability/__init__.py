@@ -1,6 +1,6 @@
-"""Observability: span tracing for the EC pipeline and HTTP servers.
+"""Observability: span tracing, trace analysis, sampling profiler.
 
-See tracer.py for the model.  Quick use:
+See tracer.py for the span model.  Quick use:
 
     from seaweedfs_tpu.observability import enable_tracing, get_tracer
     tracer = enable_tracing()
@@ -10,10 +10,19 @@ See tracer.py for the model.  Quick use:
 Every server also exposes GET /debug/traces (the same Chrome trace JSON)
 and, with the Prometheus bridge attached, span latency histograms on
 /metrics as SeaweedFS_trace_span_seconds{name=...}.
+
+Answering "which stage bounds this run?" is analysis.analyze() — served
+as GET /debug/traces/analyze, the `weed shell` trace.analyze command,
+and the bench JSON attribution block.  Python-side overhead between
+spans is the sampling profiler's job (profiler.py, GET /debug/profile,
+bench --profile-out).
 """
 
+from .analysis import analyze, attribution_summary, render_report
+from .profiler import SamplingProfiler, profile_collapsed
 from .tracer import (Span, Tracer, disable_tracing, enable_tracing,
                      get_tracer)
 
 __all__ = ["Span", "Tracer", "get_tracer", "enable_tracing",
-           "disable_tracing"]
+           "disable_tracing", "analyze", "attribution_summary",
+           "render_report", "SamplingProfiler", "profile_collapsed"]

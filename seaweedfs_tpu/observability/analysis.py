@@ -1,0 +1,356 @@
+"""Critical-path analysis of EC pipeline traces.
+
+PR 1 made the drain-wait stall *recorded* (per-dispatch spans on a
+bounded ring, /debug/traces) and PR 3 made recovery *visible*
+(pipeline.retry / pipeline.fallback spans, restart counters) — but
+answering "which stage bounds throughput, and was this run clean or
+degraded?" still meant eyeballing raw span dumps.  This module computes
+that answer:
+
+  report = analyze(tracer_or_trace_doc, counters=ec_pipeline_totals)
+
+The input is anything a trace can arrive as: a live Tracer, a list of
+Span objects, a Tracer.to_dict() document, or the Chrome trace-event
+JSON that `bench.py --trace-out` / GET /debug/traces persist — offline
+analysis of a saved trace produces the same report as the live ring.
+
+Per pipeline run (each pipeline.encode_file / pipeline.rebuild_files
+root span) the report carries:
+
+  - stage occupancy: seconds and share-of-wall per pipeline stage
+    (setup/fill/dispatch/compute/drain/write/fallback/close), plus the
+    concurrent worker.compute track kept separate so overlapped compute
+    never reads as serial host time;
+  - an overlap_efficiency decomposition that ties every second of the
+    wall to a named stage (drain = host BLOCKED on results; anything
+    not inside a span is "unattributed" — python overhead the sampling
+    profiler can then break down);
+  - the critical path through the dispatch sequence: the dominant stage
+    of each dispatch, compressed into segments, and the overall
+    critical_path_stage (the argmax of the wall decomposition);
+  - gap analysis between consecutive worker.compute windows: each idle
+    gap on the worker track is classified by what the host was doing
+    meanwhile — input_starved (fill/dispatch), drain_blocked,
+    writer_blocked, or other;
+  - a degraded flag driven by pipeline.retry / pipeline.fallback spans,
+    resumed-attempt roots, and (when given) the restart/fallback
+    counters — so BENCH numbers self-label clean vs degraded.
+
+Everything is stdlib + already-recorded spans: no hardware, no new
+threads, nothing on the hot path.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+# stages that run ON the pipeline's host thread, in dispatch order;
+# "drain" is the one where the host is BLOCKED waiting for results
+HOST_STAGES = ("setup", "fill", "dispatch", "compute", "drain", "write",
+               "fallback", "close")
+ROOT_NAMES = ("pipeline.encode_file", "pipeline.rebuild_files")
+# span names that are evidence of a degraded (self-healed) run
+DEGRADE_EVENT_NAMES = ("pipeline.retry", "pipeline.fallback")
+# counter keys (ec_pipeline_metrics().totals() / per-call encode stats)
+# whose nonzero value marks the measured path degraded
+DEGRADE_COUNTER_KEYS = ("worker_restarts", "engine_fallbacks",
+                        "retries", "fallbacks")
+
+_EPS = 1e-6
+
+
+def _normalize(trace) -> list[dict]:
+    """Any trace shape -> list of plain span dicts
+    {name, t0, t1, id, parent, tid, attrs} sorted by t0."""
+    spans: list[dict] = []
+    if hasattr(trace, "snapshot"):  # live Tracer
+        trace = trace.snapshot()
+    if isinstance(trace, dict):
+        if "spans" in trace:        # Tracer.to_dict() document
+            trace = trace["spans"]
+        elif "traceEvents" in trace:
+            return _from_chrome(trace)
+        else:
+            raise ValueError("unrecognized trace document: expected "
+                             "'spans' or 'traceEvents'")
+    for sp in trace:
+        if hasattr(sp, "to_dict"):  # Span object
+            sp = sp.to_dict()
+        spans.append({"name": sp["name"], "t0": float(sp["t0"]),
+                      "t1": float(sp["t1"]), "id": sp.get("id"),
+                      "parent": sp.get("parent"),
+                      "tid": sp.get("tid", 0),
+                      "attrs": dict(sp.get("attrs") or {})})
+    spans.sort(key=lambda s: s["t0"])
+    return spans
+
+
+def _from_chrome(doc: dict) -> list[dict]:
+    """Chrome trace-event JSON (to_chrome() / --trace-out output) back to
+    span dicts.  ts/dur are µs on a run-relative axis; the analysis only
+    ever compares times within one document, so the lost absolute epoch
+    is irrelevant."""
+    spans = []
+    for e in doc.get("traceEvents", []):
+        if e.get("ph") != "X":
+            continue
+        args = dict(e.get("args") or {})
+        sid = args.pop("span_id", None)
+        parent = args.pop("parent_id", None)
+        t0 = float(e["ts"]) / 1e6
+        spans.append({"name": e["name"], "t0": t0,
+                      "t1": t0 + float(e.get("dur", 0)) / 1e6,
+                      "id": sid, "parent": parent,
+                      "tid": e.get("tid", 0), "attrs": args})
+    spans.sort(key=lambda s: s["t0"])
+    return spans
+
+
+def _overlap_s(a0: float, a1: float, b0: float, b1: float) -> float:
+    return max(0.0, min(a1, b1) - max(a0, b0))
+
+
+def _stage_of(span: dict) -> Optional[str]:
+    name = span["name"]
+    if name in ROOT_NAMES or not name.startswith("pipeline."):
+        return None
+    stage = name.split(".", 1)[1]
+    return stage if stage in HOST_STAGES else None
+
+
+def _gap_analysis(members: list[dict]) -> dict:
+    """Classify idle gaps between consecutive worker.compute windows by
+    what the HOST thread was doing during each gap: filling/dispatching
+    the next input (the worker is input-starved), blocked in drain, or
+    writing shards."""
+    windows = sorted((s for s in members
+                      if s["name"].startswith("worker.")),
+                     key=lambda s: s["t0"])
+    out = {"worker_windows": len(windows), "worker_busy_s": 0.0,
+           "gap_total_s": 0.0,
+           "classes": {"input_starved": 0.0, "drain_blocked": 0.0,
+                       "writer_blocked": 0.0, "other": 0.0}}
+    if not windows:
+        return out
+    out["worker_busy_s"] = round(
+        sum(s["t1"] - s["t0"] for s in windows), 4)
+    by_class = {
+        "input_starved": [s for s in members
+                          if _stage_of(s) in ("fill", "dispatch")],
+        "drain_blocked": [s for s in members if _stage_of(s) == "drain"],
+        "writer_blocked": [s for s in members if _stage_of(s) == "write"],
+    }
+    for prev, nxt in zip(windows, windows[1:]):
+        g0, g1 = prev["t1"], nxt["t0"]
+        gap = g1 - g0
+        if gap <= 0:
+            continue
+        out["gap_total_s"] += gap
+        covered = 0.0
+        for cls, stage_spans in by_class.items():
+            s = sum(_overlap_s(g0, g1, sp["t0"], sp["t1"])
+                    for sp in stage_spans)
+            out["classes"][cls] += s
+            covered += s
+        out["classes"]["other"] += max(0.0, gap - covered)
+    out["classes"] = {k: round(v, 4) for k, v in out["classes"].items()}
+    # the classes decompose gap_total_s: independent rounding could push
+    # their sum past the rounded total, so the total absorbs the rounding
+    out["gap_total_s"] = round(max(out["gap_total_s"],
+                                   sum(out["classes"].values())), 4)
+    return out
+
+
+def _analyze_run(root: dict, members: list[dict],
+                 max_path_items: int = 48) -> dict:
+    wall = max(root["t1"] - root["t0"], _EPS)
+    stage_s: dict[str, float] = {}
+    stage_n: dict[str, int] = {}
+    per_dispatch: dict[int, dict[str, float]] = {}
+    fallback_reasons: dict[str, int] = {}
+    retries = 0
+    for sp in members:
+        stage = _stage_of(sp)
+        if sp["name"] == "pipeline.retry":
+            retries += 1
+        if sp["name"] == "pipeline.fallback":
+            reason = str(sp["attrs"].get("reason", "unknown"))
+            fallback_reasons[reason] = fallback_reasons.get(reason, 0) + 1
+        if stage is None:
+            continue
+        dur = sp["t1"] - sp["t0"]
+        stage_s[stage] = stage_s.get(stage, 0.0) + dur
+        stage_n[stage] = stage_n.get(stage, 0) + 1
+        d = sp["attrs"].get("dispatch")
+        if d is not None:
+            row = per_dispatch.setdefault(int(d), {})
+            row[stage] = row.get(stage, 0.0) + dur
+
+    attributed = sum(stage_s.values())
+    unattributed = max(0.0, wall - attributed)
+    drain_s = stage_s.get("drain", 0.0)
+
+    # every second of the wall lands in a named bucket
+    attribution = {stage: {"s": round(s, 4),
+                           "share": round(s / wall, 4),
+                           "spans": stage_n.get(stage, 0)}
+                   for stage, s in sorted(stage_s.items())}
+    attribution["unattributed"] = {"s": round(unattributed, 4),
+                                   "share": round(unattributed / wall, 4),
+                                   "spans": 0}
+    critical_path_stage = max(attribution,
+                              key=lambda k: attribution[k]["s"])
+
+    # critical path through the dispatch sequence: dominant stage per
+    # dispatch, compressed into consecutive segments
+    segments: list[dict] = []
+    for d in sorted(per_dispatch):
+        row = per_dispatch[d]
+        dom = max(row, key=row.get)
+        if segments and segments[-1]["stage"] == dom:
+            seg = segments[-1]
+            seg["dispatches"][1] = d
+            seg["s"] += row[dom]
+        else:
+            segments.append({"stage": dom, "dispatches": [d, d],
+                             "s": row[dom]})
+    truncated = max(0, len(segments) - max_path_items)
+    segments = segments[:max_path_items]
+    for seg in segments:
+        seg["s"] = round(seg["s"], 4)
+
+    degraded = bool(retries or fallback_reasons
+                    or int(root["attrs"].get("resume_entry") or 0) > 0)
+    worker_s = sum(s["t1"] - s["t0"] for s in members
+                   if s["name"].startswith("worker."))
+    report = {
+        "name": root["name"],
+        "mode": root["attrs"].get("mode"),
+        "engine": root["attrs"].get("engine"),
+        "bytes": root["attrs"].get("bytes"),
+        "wall_s": round(wall, 4),
+        "dispatches": len(per_dispatch),
+        "stage_s": {k: round(v, 4) for k, v in sorted(stage_s.items())},
+        "worker_compute_s": round(worker_s, 4),  # concurrent track
+        "unattributed_s": round(unattributed, 4),
+        "overlap_efficiency": round(1.0 - drain_s / wall, 4),
+        "attribution": attribution,
+        "critical_path_stage": critical_path_stage,
+        "critical_path": segments,
+        "gap_analysis": _gap_analysis(members),
+        "degraded": degraded,
+        "retries": retries,
+        "fallbacks": sum(fallback_reasons.values()),
+        "fallback_reasons": fallback_reasons,
+    }
+    if truncated:
+        report["critical_path_truncated"] = truncated
+    blocked_pct = round(100.0 * drain_s / wall)
+    report["summary"] = (
+        f"{critical_path_stage}-bound: {critical_path_stage} holds "
+        f"{round(100.0 * attribution[critical_path_stage]['share'])}% of "
+        f"{report['wall_s']}s wall ({blocked_pct}% blocked in drain); "
+        f"{'DEGRADED' if degraded else 'clean'} run")
+    return report
+
+
+def analyze(trace, counters: Optional[dict] = None,
+            max_path_items: int = 48) -> dict:
+    """Trace (live Tracer, span list, to_dict() doc, or Chrome doc) ->
+    attribution report.  `counters` is an optional restart/fallback
+    totals dict (ec_pipeline_metrics().totals() or per-call encode
+    stats); nonzero values mark the report degraded even when the
+    ring has already rotated the retry spans out."""
+    spans = _normalize(trace)
+    roots = [s for s in spans if s["name"] in ROOT_NAMES]
+    runs = []
+    claimed: set[int] = set()
+    for root in roots:
+        members = []
+        for i, s in enumerate(spans):
+            if s is root or i in claimed:
+                continue
+            if s["t0"] >= root["t0"] - _EPS and s["t1"] <= root["t1"] + _EPS:
+                members.append(s)
+                claimed.add(i)
+        runs.append(_analyze_run(root, members, max_path_items))
+    if not roots and spans:
+        # no root captured (ring rotated / partial dump): synthesize one
+        # run over the whole span set so the report stays useful
+        synth = {"name": "pipeline.(partial)", "attrs": {},
+                 "t0": min(s["t0"] for s in spans),
+                 "t1": max(s["t1"] for s in spans)}
+        runs.append(_analyze_run(synth, spans, max_path_items))
+        runs[-1]["partial"] = True
+
+    retry_n = sum(1 for s in spans if s["name"] == "pipeline.retry")
+    fallback_n = sum(1 for s in spans if s["name"] == "pipeline.fallback")
+    degraded = bool(retry_n or fallback_n or any(r["degraded"]
+                                                for r in runs))
+    health = dict(counters or {})
+    if any(float(health.get(k) or 0) > 0 for k in DEGRADE_COUNTER_KEYS):
+        degraded = True
+    return {"span_count": len(spans), "runs": runs,
+            "degraded": degraded, "retry_spans": retry_n,
+            "fallback_spans": fallback_n, "health": health}
+
+
+def attribution_summary(report: dict) -> dict:
+    """The compact block bench.py embeds as e2e_pipeline_*.attribution:
+    per-stage seconds, the critical-path stage, and the degraded flag
+    for the report's LAST run (the measured rep)."""
+    if not report.get("runs"):
+        return {"degraded": report.get("degraded", False)}
+    run = report["runs"][-1]
+    return {
+        "stage_s": run["stage_s"],
+        "unattributed_s": run["unattributed_s"],
+        "wall_s": run["wall_s"],
+        "critical_path_stage": run["critical_path_stage"],
+        "overlap_efficiency": run["overlap_efficiency"],
+        "degraded": bool(report.get("degraded") or run["degraded"]),
+        "summary": run["summary"],
+    }
+
+
+def render_report(report: dict) -> str:
+    """Human-readable rendering (the `weed shell` trace.analyze view)."""
+    lines = [f"spans analyzed: {report['span_count']}  "
+             f"degraded: {report['degraded']}  "
+             f"(retry spans: {report['retry_spans']}, "
+             f"fallback spans: {report['fallback_spans']})"]
+    health = report.get("health") or {}
+    if health:
+        lines.append("health counters: " + ", ".join(
+            f"{k}={v}" for k, v in sorted(health.items())))
+    if not report["runs"]:
+        lines.append("no pipeline runs in the trace "
+                     "(enable tracing, run an encode, re-analyze)")
+    for i, run in enumerate(report["runs"]):
+        lines.append("")
+        lines.append(f"run {i}: {run['name']} mode={run['mode']} "
+                     f"engine={run['engine']} "
+                     f"dispatches={run['dispatches']} "
+                     f"wall={run['wall_s']}s")
+        lines.append(f"  {run['summary']}")
+        lines.append(f"  overlap_efficiency={run['overlap_efficiency']}")
+        width = max((len(k) for k in run["attribution"]), default=1)
+        for stage, row in sorted(run["attribution"].items(),
+                                 key=lambda kv: -kv[1]["s"]):
+            bar = "#" * int(round(40 * row["share"]))
+            lines.append(f"  {stage:<{width}} {row['s']:>9.3f}s "
+                         f"{100 * row['share']:5.1f}% {bar}")
+        ga = run["gap_analysis"]
+        if ga["worker_windows"]:
+            cls = ", ".join(f"{k}={v}s" for k, v in ga["classes"].items()
+                            if v > 0)
+            lines.append(f"  worker gaps: {ga['gap_total_s']}s over "
+                         f"{ga['worker_windows']} windows ({cls or 'none'})")
+        if run["critical_path"]:
+            path = " -> ".join(
+                f"{seg['stage']}[d{seg['dispatches'][0]}"
+                + (f"-{seg['dispatches'][1]}"
+                   if seg["dispatches"][1] != seg["dispatches"][0] else "")
+                + "]" for seg in run["critical_path"][:12])
+            lines.append(f"  critical path: {path}")
+    return "\n".join(lines) + "\n"

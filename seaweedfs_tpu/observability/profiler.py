@@ -1,0 +1,192 @@
+"""Stdlib-only sampling wall-clock profiler (collapsed-stack output).
+
+The critical-path analyzer names the pipeline stage a run is bound by;
+what it cannot see is time spent BETWEEN spans — python overhead in the
+drain loop, GIL convoys, a slow json encoder on a status route.  This
+sampler makes that visible without any dependency or interpreter switch:
+a daemon thread snapshots sys._current_frames() at a configurable rate
+and aggregates whole stacks, so python-side overhead is distinguishable
+from device time (the device never appears on a python stack; a hot
+`_fetch` frame does).
+
+Design constraints, matching the tracer's:
+
+  - zero cost unless running: nothing is installed globally, no
+    settrace/setprofile (those bias the measurement); start()/stop()
+    own the only thread;
+  - bounded memory: stacks aggregate into a counts dict capped at
+    max_stacks distinct stacks (overflow collapses into one bucket) and
+    stack depth is capped at max_depth frames;
+  - thread-safe: the counts dict is guarded by one lock; collapsed()
+    can run while sampling continues.
+
+Output is the collapsed-stack format flamegraph.pl / speedscope / any
+flamegraph viewer consumes: one line per distinct stack,
+``thread;root_frame;...;leaf_frame <count>``.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+from typing import Optional
+
+_MAX_SECONDS = 3600.0
+
+
+class SamplingProfiler:
+    """Wall-clock sampler over every thread but its own."""
+
+    def __init__(self, hz: float = 100.0, max_stacks: int = 10000,
+                 max_depth: int = 96):
+        self.interval = 1.0 / max(min(hz, 1000.0), 0.1)
+        self.max_stacks = max_stacks
+        self.max_depth = max_depth
+        self.samples = 0
+        self.dropped = 0  # samples folded into the overflow bucket
+        self._counts: dict[tuple, int] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._t0 = 0.0
+        self.elapsed = 0.0
+        # threads the capture must not observe (e.g. the HTTP handler
+        # thread that is just sleeping out a run_for window)
+        self._exclude: set[int] = set()
+
+    # --- lifecycle --------------------------------------------------------
+    def start(self) -> "SamplingProfiler":
+        if self._thread is not None:
+            raise RuntimeError("profiler already running")
+        self._stop.clear()
+        self._t0 = time.perf_counter()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="sampling-profiler")
+        self._thread.start()
+        return self
+
+    def stop(self) -> "SamplingProfiler":
+        if self._thread is None:
+            return self
+        self._stop.set()
+        self._thread.join(timeout=5.0)
+        self._thread = None
+        self.elapsed = time.perf_counter() - self._t0
+        return self
+
+    def run_for(self, seconds: float) -> "SamplingProfiler":
+        """Blocking capture: sample for `seconds`, then stop."""
+        seconds = max(0.0, min(seconds, _MAX_SECONDS))
+        self._exclude.add(threading.get_ident())
+        self.start()
+        try:
+            time.sleep(seconds)
+        finally:
+            self.stop()
+        return self
+
+    # --- sampling ---------------------------------------------------------
+    def _loop(self) -> None:
+        skip = {threading.get_ident()} | self._exclude
+        while not self._stop.wait(self.interval):
+            self._sample_once(skip)
+
+    def _sample_once(self, skip: set) -> None:
+        # thread names resolved per sample: threads come and go
+        names = {t.ident: t.name for t in threading.enumerate()}
+        frames = sys._current_frames()
+        stacks: list[tuple] = []
+        for ident, frame in frames.items():
+            if ident in skip:
+                continue
+            stack: list[tuple] = []
+            while frame is not None and len(stack) < self.max_depth:
+                code = frame.f_code
+                stack.append((code.co_filename, frame.f_lineno,
+                              code.co_name))
+                frame = frame.f_back
+            stack.reverse()  # root first (collapsed-stack order)
+            stacks.append((names.get(ident, f"thread-{ident}"),
+                           tuple(stack)))
+        with self._lock:
+            self.samples += 1
+            for key in stacks:
+                if key not in self._counts and \
+                        len(self._counts) >= self.max_stacks:
+                    key = ("(overflow)", ())
+                    self.dropped += 1
+                self._counts[key] = self._counts.get(key, 0) + 1
+
+    # --- reports ----------------------------------------------------------
+    def _snapshot(self) -> dict[tuple, int]:
+        with self._lock:
+            return dict(self._counts)
+
+    @staticmethod
+    def _frame_label(fr: tuple) -> str:
+        fname, lineno, func = fr
+        return f"{func} ({os.path.basename(fname)}:{lineno})"
+
+    def collapsed(self) -> str:
+        """flamegraph.pl input: `thread;frame;...;frame count` lines,
+        heaviest stacks first."""
+        lines = []
+        for (thread, stack), n in sorted(self._snapshot().items(),
+                                         key=lambda kv: -kv[1]):
+            # ';' is the collapsed-format separator: scrub it from labels
+            parts = [thread.replace(";", ":")]
+            parts.extend(self._frame_label(fr).replace(";", ":")
+                         for fr in stack)
+            lines.append(";".join(parts) + f" {n}")
+        return "\n".join(lines) + ("\n" if lines else "")
+
+    def hotspots(self, limit: int = 40) -> tuple[list, list]:
+        """(self_hits, cum_hits) aggregates for the text report:
+        self keyed (file, line, func) on leaf frames, cumulative keyed
+        (file, func) once per stack (recursion counts once)."""
+        self_hits: dict[tuple, int] = {}
+        cum_hits: dict[tuple, int] = {}
+        for (_thread, stack), n in self._snapshot().items():
+            if not stack:
+                continue
+            leaf = stack[-1]
+            self_hits[leaf] = self_hits.get(leaf, 0) + n
+            seen = set()
+            for fname, _lineno, func in stack:
+                ckey = (fname, func)
+                if ckey not in seen:
+                    cum_hits[ckey] = cum_hits.get(ckey, 0) + n
+                    seen.add(ckey)
+        top_self = sorted(self_hits.items(), key=lambda kv: -kv[1])[:limit]
+        top_cum = sorted(cum_hits.items(), key=lambda kv: -kv[1])[:limit]
+        return top_self, top_cum
+
+    def report_text(self) -> str:
+        """The /debug/pprof/profile view: self + cumulative hit tables."""
+        samples = max(self.samples, 1)
+        lines = [f"sampling profile: {self.samples} samples over "
+                 f"{self.elapsed:.1f}s "
+                 f"({self.interval * 1e3:.0f}ms interval), all threads",
+                 "", "-- self time (leaf frames) --"]
+        top_self, top_cum = self.hotspots()
+        for (fname, lineno, func), n in top_self:
+            lines.append(f"{n:>6} {100 * n / samples:5.1f}% "
+                         f"{func} ({fname}:{lineno})")
+        lines += ["", "-- cumulative (anywhere on stack) --"]
+        for (fname, func), n in top_cum:
+            lines.append(f"{n:>6} {100 * n / samples:5.1f}% "
+                         f"{func} ({fname})")
+        if self.dropped:
+            lines += ["", f"(overflow: {self.dropped} samples past the "
+                          f"{self.max_stacks}-stack bound)"]
+        return "\n".join(lines) + "\n"
+
+
+def profile_collapsed(seconds: float, hz: float = 100.0) -> str:
+    """One-call capture -> collapsed-stack text (the /debug/profile and
+    bench --profile-out entry point)."""
+    prof = SamplingProfiler(hz=hz)
+    prof.run_for(seconds)
+    return prof.collapsed()

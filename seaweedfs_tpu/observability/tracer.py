@@ -253,6 +253,29 @@ class Tracer:
             for sp in spans:
                 self._hist.observe(sp.name, sp.t1 - sp.t0)
 
+    # --- whole-ring serialization -----------------------------------------
+    def to_dict(self) -> dict:
+        """Whole-ring snapshot as one JSON-safe document — the offline
+        hand-off format for the critical-path analyzer: save it next to a
+        bench run, load it later with from_dict(), and analysis.analyze()
+        produces the SAME report it would against the live ring."""
+        return {"format": "seaweedfs-tpu-trace-v1",
+                "namespace": self.namespace,
+                "capacity": self.capacity,
+                "spans": self.export_log()}
+
+    @classmethod
+    def from_dict(cls, doc: dict) -> "Tracer":
+        """Rebuild a tracer from to_dict() output.  Ids keep their source
+        namespaces (already distinct per process), so a round-trip
+        preserves every parent/child edge and worker track."""
+        spans = doc.get("spans") or []
+        cap = int(doc.get("capacity") or 0) or max(len(spans), 1)
+        tr = cls(capacity=max(cap, len(spans)), enabled=True,
+                 namespace=doc.get("namespace"))
+        tr.ingest_log(spans)
+        return tr
+
     # --- Chrome trace-event export ----------------------------------------
     def to_chrome(self, clear: bool = False) -> dict:
         """{"traceEvents": [...]} loadable in chrome://tracing/Perfetto.

@@ -1,0 +1,76 @@
+"""Observability shell commands: trace analysis + cluster telemetry.
+
+    trace.analyze -server host:port       # analyze a live server's ring
+    trace.analyze -file trace.json        # analyze a saved trace offline
+    cluster.health                        # per-volume-server health rollup
+
+trace.analyze turns a span ring into the attribution report
+(observability/analysis.py): stage occupancy, the critical-path stage,
+gap classification, and the clean-vs-degraded verdict — the answer the
+next perf PR needs, without eyeballing raw span dumps.  -file accepts
+either a Tracer.to_dict() document or the Chrome trace JSON written by
+`bench.py --trace-out` / GET /debug/traces.
+"""
+
+from __future__ import annotations
+
+import json
+
+from ..utils.httpd import http_bytes
+from .commands import CommandEnv, command
+
+
+@command("trace.analyze")
+def cmd_trace_analyze(env: CommandEnv, flags: dict) -> str:
+    """trace.analyze [-server host:port] [-file trace.json] [-json]
+    # critical-path attribution report for a server's span ring (or a
+    # saved trace file); -json emits the raw report document"""
+    from ..observability.analysis import analyze, render_report
+
+    path = flags.get("file") or ""
+    server = flags.get("server") or ""
+    if path:
+        with open(path) as f:
+            doc = json.load(f)
+        report = analyze(doc)
+    elif server:
+        status, body, _ = http_bytes(
+            "GET", f"http://{server}/debug/traces/analyze")
+        if status != 200:
+            raise RuntimeError(
+                f"{server}/debug/traces/analyze: status {status}: "
+                f"{body[:200].decode(errors='replace')}")
+        report = json.loads(body)
+    else:
+        raise ValueError(
+            "trace.analyze needs -server host:port or -file trace.json")
+    if flags.get("json") == "true":
+        return json.dumps(report, indent=2)
+    return render_report(report).rstrip("\n")
+
+
+@command("cluster.health")
+def cmd_cluster_health(env: CommandEnv, flags: dict) -> str:
+    """cluster.health [-json]  # master's per-volume-server telemetry
+    rollup: reachability/staleness + pipeline health counters"""
+    doc = env.master_get("/cluster/health")
+    if flags.get("json") == "true":
+        return json.dumps(doc, indent=2)
+    lines = [f"peers: {doc['peer_count']}  "
+             f"degraded: {doc['degraded']}  "
+             f"stale: {', '.join(doc['stale_peers']) or 'none'}"]
+    t = doc["totals"]
+    lines.append(f"totals: worker_restarts={t['worker_restarts']} "
+                 f"engine_fallbacks={t['engine_fallbacks']} "
+                 f"degraded_binds={t['degraded_binds']}")
+    for url, p in sorted(doc["peers"].items()):
+        ph = p["pipeline_health"]
+        state = "up" if p["up"] else f"DOWN ({p.get('error', '')})"
+        if p["stale"]:
+            state += " STALE"
+        lines.append(
+            f"  {url}: {state} age={p.get('age_s')}s "
+            f"restarts={ph['worker_restarts']} "
+            f"fallbacks={ph['engine_fallbacks']} "
+            f"degraded_binds={ph['degraded_binds']}")
+    return "\n".join(lines)

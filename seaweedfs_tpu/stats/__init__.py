@@ -1,3 +1,5 @@
+from .aggregate import (ClusterAggregator, merge_families,
+                        parse_prometheus_text)
 from .metrics import (Counter, Gauge, Histogram, Registry, REGISTRY,
                       master_metrics, volume_server_metrics, filer_metrics,
                       s3_metrics, ec_pipeline_metrics, start_push_loop)
@@ -6,4 +8,5 @@ __all__ = [
     "Counter", "Gauge", "Histogram", "Registry", "REGISTRY",
     "master_metrics", "volume_server_metrics", "filer_metrics", "s3_metrics",
     "ec_pipeline_metrics", "start_push_loop",
+    "ClusterAggregator", "merge_families", "parse_prometheus_text",
 ]

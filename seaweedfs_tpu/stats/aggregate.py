@@ -1,0 +1,369 @@
+"""Cluster-wide telemetry aggregation: scrape, parse, merge, re-expose.
+
+The master already knows every volume server (heartbeat registration in
+master/topology.py); each of those serves a Prometheus exposition on
+/metrics.  Operators shouldn't need a sidecar Prometheus to answer
+"how many parity-worker restarts happened ACROSS the cluster?" — this
+module lets the master answer directly:
+
+  GET /cluster/metrics  — one merged Prometheus exposition: counters and
+                          gauges summed per label set, histograms merged
+                          bucket-by-bucket (stats.metrics merge()), plus
+                          per-peer up/staleness gauges;
+  GET /cluster/health   — JSON: per-volume-server pipeline health
+                          (worker restarts, engine fallbacks, degraded
+                          binds) and reachability, with cluster totals.
+
+Unreachable peers are marked STALE, not dropped and never an error: the
+merge keeps serving their last-scraped values with
+SeaweedFS_cluster_peer_up{peer=...} 0 and a rising scrape-age gauge, so
+a flapping server shows up as staleness instead of making cluster-wide
+counters dip.
+
+Off-by-default-cheap: no background thread unless a loop is started —
+the endpoints scrape on demand through a short TTL cache (min_interval)
+with one bounded-timeout HTTP GET per peer, in parallel.
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+import time
+from typing import Callable, Optional
+
+from .metrics import Counter, Gauge, Histogram
+
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:\\.|[^"\\])*)"')
+_SAMPLE_RE = re.compile(
+    r'^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{(.*)\})?\s+(\S+)\s*$')
+
+# the families /cluster/health summarizes per peer
+HEALTH_FAMILIES = {
+    "worker_restarts": "SeaweedFS_ec_worker_restarts_total",
+    "engine_fallbacks": "SeaweedFS_ec_engine_fallbacks_total",
+    "degraded_binds": "SeaweedFS_server_degraded_binds_total",
+}
+
+
+def _unescape(v: str) -> str:
+    return v.replace("\\n", "\n").replace('\\"', '"').replace("\\\\", "\\")
+
+
+def _parse_labels(raw: Optional[str]) -> dict[str, str]:
+    if not raw:
+        return {}
+    return {k: _unescape(v) for k, v in _LABEL_RE.findall(raw)}
+
+
+def parse_prometheus_text(text: str) -> dict[str, object]:
+    """Exposition text -> {family name: Counter|Gauge|Histogram}
+    (unregistered collectors, ready for merge()).  Histogram _bucket
+    series are de-cumulated back into per-bucket counts so the merge is
+    exact.  Unknown-typed samples are treated as gauges (untyped
+    exposition is legal Prometheus)."""
+    types: dict[str, str] = {}
+    helps: dict[str, str] = {}
+    # family -> list of (labels dict, suffix, value)
+    raw: dict[str, list] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("#"):
+            parts = line.split(None, 3)
+            if len(parts) >= 3 and parts[1] in ("TYPE", "HELP"):
+                (types if parts[1] == "TYPE" else helps)[parts[2]] = \
+                    parts[3] if len(parts) > 3 else ""
+            continue
+        mo = _SAMPLE_RE.match(line)
+        if not mo:
+            continue
+        name, _, raw_labels, raw_value = mo.groups()
+        try:
+            value = float(raw_value)
+        except ValueError:
+            continue
+        base, suffix = name, ""
+        for suf in ("_bucket", "_sum", "_count"):
+            cand = name[:-len(suf)]
+            if name.endswith(suf) and types.get(cand) == "histogram":
+                base, suffix = cand, suf
+                break
+        raw.setdefault(base, []).append(
+            (_parse_labels(raw_labels), suffix, value))
+
+    out: dict[str, object] = {}
+    for name, samples in raw.items():
+        kind = types.get(name, "gauge")
+        help_ = helps.get(name, "")
+        if kind == "histogram":
+            out[name] = _build_histogram(name, help_, samples)
+        else:
+            cls = Counter if kind == "counter" else Gauge
+            label_names: tuple = ()
+            for labels, _suf, _v in samples:
+                if labels:
+                    label_names = tuple(labels)
+                    break
+            coll = cls(name, help_, labels=label_names)
+            for labels, _suf, v in samples:
+                key = tuple(labels.get(ln, "") for ln in label_names)
+                coll._values[key] = coll._values.get(key, 0.0) + v
+            out[name] = coll
+    return out
+
+
+def _build_histogram(name: str, help_: str, samples: list) -> Histogram:
+    label_names: tuple = ()
+    les: set[float] = set()
+    for labels, suffix, _v in samples:
+        if suffix == "_bucket":
+            les.update(float(le) for le in [labels.get("le", "+Inf")]
+                       if le not in ("+Inf", "Inf", "inf"))
+        names = tuple(k for k in labels if k != "le")
+        if names and not label_names:
+            label_names = names
+    # empty grid is legal: a histogram whose every observation exceeded
+    # the largest bucket lives entirely in _sum/_count (+Inf)
+    hist = Histogram(name, help_, labels=label_names,
+                     buckets=tuple(sorted(les)))
+    # cumulative bucket values per label key, keyed in le order
+    cum: dict[tuple, dict[float, float]] = {}
+    for labels, suffix, v in samples:
+        key = tuple(labels.get(ln, "") for ln in label_names)
+        if suffix == "_bucket":
+            le = labels.get("le", "+Inf")
+            if le in ("+Inf", "Inf", "inf"):
+                continue  # _count carries the +Inf total
+            cum.setdefault(key, {})[float(le)] = v
+        elif suffix == "_sum":
+            hist._sums[key] = hist._sums.get(key, 0.0) + v
+        elif suffix == "_count":
+            hist._totals[key] = hist._totals.get(key, 0) + int(v)
+    for key, by_le in cum.items():
+        counts = [0] * len(hist.buckets)
+        prev = 0.0
+        for i, b in enumerate(hist.buckets):
+            c = by_le.get(b, prev)
+            counts[i] = max(0, int(c - prev))
+            prev = c
+        hist._counts[key] = counts
+        hist._sums.setdefault(key, 0.0)
+        hist._totals.setdefault(key, 0)
+    for key in hist._totals:
+        hist._counts.setdefault(key, [0] * len(hist.buckets))
+        hist._sums.setdefault(key, 0.0)
+    return hist
+
+
+def merge_families(into: dict[str, object],
+                   src: dict[str, object]) -> dict[str, object]:
+    """Merge one peer's parsed families into the accumulator.  Same-name
+    families combine via their collector's merge(); a histogram whose
+    bucket grid disagrees (mixed software versions mid-rolling-upgrade)
+    is kept under a `name` suffixed with `_mismatch` rather than
+    corrupting the merged series or failing the whole exposition."""
+    for name, coll in src.items():
+        mine = into.get(name)
+        if mine is None:
+            # fresh copy so later merges never mutate the peer cache
+            clone = type(coll)(coll.name, coll.help,
+                               labels=coll.label_names,
+                               **({"buckets": coll.buckets}
+                                  if isinstance(coll, Histogram) else {}))
+            clone.merge(coll)
+            into[name] = clone
+            continue
+        try:
+            mine.merge(coll)
+        except (ValueError, AttributeError):
+            alt = name + "_mismatch"
+            if alt not in into:
+                clone = type(coll)(alt, coll.help,
+                                   labels=coll.label_names,
+                                   **({"buckets": coll.buckets}
+                                      if isinstance(coll, Histogram)
+                                      else {}))
+                clone.merge(coll)
+                into[alt] = clone
+    return into
+
+
+class _PeerState:
+    __slots__ = ("families", "scraped_at", "up", "error")
+
+    def __init__(self):
+        self.families: Optional[dict] = None
+        self.scraped_at = 0.0
+        self.up = False
+        self.error = ""
+
+
+class ClusterAggregator:
+    """Scrape-and-merge over a dynamic peer list (the master's
+    registered volume servers)."""
+
+    def __init__(self, peers_fn: Callable[[], list[str]],
+                 fetch: Optional[Callable[[str], str]] = None,
+                 min_interval: float = 2.0, stale_after: float = 30.0,
+                 timeout: float = 2.0):
+        self.peers_fn = peers_fn
+        self.min_interval = min_interval
+        self.stale_after = stale_after
+        self.timeout = timeout
+        self._fetch = fetch or self._http_fetch
+        self._peers: dict[str, _PeerState] = {}
+        self._lock = threading.Lock()
+        self._last_scrape = 0.0
+        self._stop: Optional[threading.Event] = None
+
+    def _http_fetch(self, url: str) -> str:
+        from ..utils.httpd import http_bytes
+
+        status, body, _ = http_bytes("GET", f"http://{url}/metrics",
+                                     timeout=self.timeout)
+        if status != 200:
+            raise ConnectionError(
+                f"scrape {url}: status {status}: "
+                f"{body[:120].decode(errors='replace')}")
+        return body.decode(errors="replace")
+
+    # --- scraping ---------------------------------------------------------
+    def scrape(self, force: bool = False) -> None:
+        """Scrape every registered peer in parallel.  Rate-limited by
+        min_interval unless forced, so the on-demand endpoints cannot be
+        turned into a scrape amplifier."""
+        now = time.time()
+        with self._lock:
+            if not force and now - self._last_scrape < self.min_interval:
+                return
+            self._last_scrape = now
+        urls = list(dict.fromkeys(self.peers_fn()))
+        with self._lock:
+            # peers gone from the registry (unregistered/replaced) drop
+            # out of the merge entirely — they are not "stale", they left
+            for gone in set(self._peers) - set(urls):
+                del self._peers[gone]
+        if not urls:
+            return
+        import concurrent.futures
+
+        def one(url: str):
+            try:
+                return url, parse_prometheus_text(self._fetch(url)), ""
+            except Exception as e:
+                return url, None, f"{type(e).__name__}: {e}"[:200]
+
+        with concurrent.futures.ThreadPoolExecutor(
+                max_workers=min(8, len(urls)),
+                thread_name_prefix="metrics-scrape") as pool:
+            results = list(pool.map(one, urls))
+        with self._lock:
+            for url, families, err in results:
+                st = self._peers.setdefault(url, _PeerState())
+                if families is not None:
+                    st.families = families
+                    st.scraped_at = time.time()
+                    st.up, st.error = True, ""
+                else:
+                    # keep the last-good families: the merge serves them
+                    # marked stale instead of dipping cluster counters
+                    st.up, st.error = False, err
+
+    def start_loop(self, interval: float) -> threading.Thread:
+        """Optional periodic scraper (the `-metricsAggregationSeconds`
+        master flag); the on-demand path stays available without it."""
+        self._stop = threading.Event()
+
+        def loop():
+            while not self._stop.wait(interval):
+                try:
+                    self.scrape(force=True)
+                except Exception:
+                    pass
+
+        t = threading.Thread(target=loop, daemon=True,
+                             name="cluster-metrics-scrape")
+        t.start()
+        return t
+
+    def stop_loop(self) -> None:
+        if self._stop is not None:
+            self._stop.set()
+
+    # --- views ------------------------------------------------------------
+    def _snapshot(self) -> dict[str, _PeerState]:
+        with self._lock:
+            return dict(self._peers)
+
+    def peer_status(self) -> dict[str, dict]:
+        now = time.time()
+        out = {}
+        for url, st in sorted(self._snapshot().items()):
+            age = now - st.scraped_at if st.scraped_at else None
+            out[url] = {
+                "up": st.up,
+                "stale": (not st.up) or (age is not None
+                                         and age > self.stale_after),
+                "age_s": round(age, 1) if age is not None else None,
+                "error": st.error,
+                "has_data": st.families is not None,
+            }
+        return out
+
+    def merged(self) -> dict[str, object]:
+        merged: dict[str, object] = {}
+        for _url, st in sorted(self._snapshot().items()):
+            if st.families is not None:
+                merge_families(merged, st.families)
+        return merged
+
+    def expose(self) -> str:
+        """The /cluster/metrics body: merged families plus per-peer
+        up/staleness/age gauges (the machine-readable stale marking)."""
+        status = self.peer_status()
+        up = Gauge("SeaweedFS_cluster_peer_up",
+                   "1 if the peer's last /metrics scrape succeeded.",
+                   labels=("peer",))
+        stale = Gauge("SeaweedFS_cluster_peer_stale",
+                      "1 if the peer's merged series come from a stale "
+                      "scrape (peer unreachable; last-good values "
+                      "served).", labels=("peer",))
+        age = Gauge("SeaweedFS_cluster_peer_scrape_age_seconds",
+                    "Seconds since the peer's last successful scrape.",
+                    labels=("peer",))
+        for url, st in status.items():
+            up.set(url, 1.0 if st["up"] else 0.0)
+            stale.set(url, 1.0 if st["stale"] else 0.0)
+            if st["age_s"] is not None:
+                age.set(url, st["age_s"])
+        lines: list[str] = []
+        for g in (up, stale, age):
+            lines.extend(g.expose())
+        merged = self.merged()
+        for name in sorted(merged):
+            lines.extend(merged[name].expose())
+        return "\n".join(lines) + "\n"
+
+    def health(self) -> dict:
+        """The /cluster/health body: per-peer pipeline health + totals."""
+        status = self.peer_status()
+        peers: dict[str, dict] = {}
+        totals = {k: 0 for k in HEALTH_FAMILIES}
+        for url, st in self._snapshot().items():
+            entry = dict(status[url])
+            ph = {}
+            for key, family in HEALTH_FAMILIES.items():
+                coll = (st.families or {}).get(family)
+                v = int(sum(coll.snapshot().values())) if coll is not None \
+                    else 0
+                ph[key] = v
+                totals[key] += v
+            entry["pipeline_health"] = ph
+            peers[url] = entry
+        stale = sorted(u for u, s in status.items() if s["stale"])
+        return {"peers": peers, "totals": totals,
+                "stale_peers": stale,
+                "degraded": any(v for v in totals.values()),
+                "peer_count": len(peers)}

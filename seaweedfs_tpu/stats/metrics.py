@@ -64,6 +64,13 @@ class Counter:
         with self._lock:
             return dict(self._values)
 
+    def merge(self, other: "Counter") -> None:
+        """Fold another counter's samples in (per-label-set sum) — the
+        cluster aggregator's cross-peer combine."""
+        for key, v in other.snapshot().items():
+            with self._lock:
+                self._values[key] = self._values.get(key, 0.0) + v
+
     def expose(self) -> list[str]:
         out = [f"# HELP {self.name} {self.help}",
                f"# TYPE {self.name} counter"]
@@ -99,6 +106,17 @@ class Gauge:
 
     def value(self, *label_values) -> float:
         return self._values.get(tuple(str(v) for v in label_values), 0.0)
+
+    def snapshot(self) -> dict[tuple, float]:
+        with self._lock:
+            return dict(self._values)
+
+    def merge(self, other: "Gauge") -> None:
+        """Per-label-set SUM: cluster gauges (volume counts, disk bytes)
+        aggregate additively across peers."""
+        for key, v in other.snapshot().items():
+            with self._lock:
+                self._values[key] = self._values.get(key, 0.0) + v
 
     def clear(self) -> None:
         with self._lock:
@@ -146,6 +164,34 @@ class Histogram:
             self._sums.setdefault(key, 0.0)
             self._totals.setdefault(key, 0)
         return _BoundHistogram(self, key)
+
+    def snapshot(self) -> dict[tuple, tuple[list[int], float, int]]:
+        """Per-label-set (bucket_counts, sum, count) copy, safe against
+        concurrent observe()."""
+        with self._lock:
+            return {key: (list(self._counts[key]),
+                          self._sums.get(key, 0.0),
+                          self._totals.get(key, 0))
+                    for key in self._counts}
+
+    def merge(self, other: "Histogram") -> None:
+        """Fold another histogram in: per-label-set elementwise bucket
+        sums plus _sum/_count sums — by construction identical to having
+        observed the union of both sample streams (each observation
+        lands in exactly one bucket and contributes once to sum/count).
+        Requires identical bucket boundaries; merging mismatched grids
+        would silently misbin, so it raises instead."""
+        if tuple(other.buckets) != self.buckets:
+            raise ValueError(
+                f"bucket mismatch: {other.buckets} vs {self.buckets}")
+        for key, (counts, s, total) in other.snapshot().items():
+            with self._lock:
+                mine = self._counts.setdefault(key,
+                                               [0] * len(self.buckets))
+                for i, c in enumerate(counts):
+                    mine[i] += c
+                self._sums[key] = self._sums.get(key, 0.0) + s
+                self._totals[key] = self._totals.get(key, 0) + total
 
     def time(self, *label_values):
         """Context manager: observes elapsed seconds."""

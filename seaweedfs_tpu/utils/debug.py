@@ -23,7 +23,6 @@ import html
 import json
 import sys
 import threading
-import time
 import traceback
 from typing import Callable, Optional
 
@@ -31,48 +30,16 @@ from .httpd import Request, Response, Router
 
 
 def _profile_text(seconds: float, interval: float = 0.005) -> str:
-    """Sampling profiler across ALL threads (cProfile instruments only the
-    calling thread, which here would just be sleeping): sample
-    sys._current_frames() every `interval` and aggregate self/cumulative
-    hits per frame — a py-spy-style statistical profile of real server
-    work under load."""
-    self_hits: dict[tuple, int] = {}
-    cum_hits: dict[tuple, int] = {}
-    own = threading.get_ident()
-    samples = 0
-    deadline = time.monotonic() + seconds
-    while time.monotonic() < deadline:
-        for ident, frame in sys._current_frames().items():
-            if ident == own:
-                continue
-            leaf = True
-            seen_in_stack = set()
-            while frame is not None:
-                key = (frame.f_code.co_filename, frame.f_lineno,
-                       frame.f_code.co_name)
-                if leaf:
-                    self_hits[key] = self_hits.get(key, 0) + 1
-                    leaf = False
-                ckey = (frame.f_code.co_filename, frame.f_code.co_name)
-                if ckey not in seen_in_stack:  # recursion counts once
-                    cum_hits[ckey] = cum_hits.get(ckey, 0) + 1
-                    seen_in_stack.add(ckey)
-                frame = frame.f_back
-        samples += 1
-        time.sleep(interval)
-    lines = [f"sampling profile: {samples} samples over {seconds}s "
-             f"({interval * 1e3:.0f}ms interval), all threads",
-             "", "-- self time (leaf frames) --"]
-    for (fname, lineno, func), n in sorted(self_hits.items(),
-                                           key=lambda kv: -kv[1])[:40]:
-        lines.append(f"{n:>6} {100 * n / max(samples, 1):5.1f}% "
-                     f"{func} ({fname}:{lineno})")
-    lines += ["", "-- cumulative (anywhere on stack) --"]
-    for (fname, func), n in sorted(cum_hits.items(),
-                                   key=lambda kv: -kv[1])[:40]:
-        lines.append(f"{n:>6} {100 * n / max(samples, 1):5.1f}% "
-                     f"{func} ({fname})")
-    return "\n".join(lines) + "\n"
+    """Sampling profile across ALL threads (cProfile instruments only the
+    calling thread, which here would just be sleeping): the shared
+    observability.profiler sampler, rendered as the self/cumulative hit
+    tables — a py-spy-style statistical profile of real server work
+    under load."""
+    from ..observability.profiler import SamplingProfiler
+
+    prof = SamplingProfiler(hz=1.0 / interval)
+    prof.run_for(seconds)
+    return prof.report_text()
 
 
 def _thread_dump() -> str:
@@ -202,6 +169,8 @@ def _render_status_html(name: str, status: dict) -> str:
  <a href="/debug/pprof/goroutine">threads</a>
  <a href="/debug/pprof/heap">heap</a>
  <a href="/debug/traces">traces</a>
+ <a href="/debug/traces/analyze?format=text">analyze</a>
+ <a href="/debug/profile">profile</a>
 </div>
 {body}
 </body></html>"""
@@ -227,6 +196,39 @@ def register_debug_routes(router: Router,
     def pprof_heap(req: Request) -> Response:
         return Response(raw=_heap_text().encode(),
                         headers={"Content-Type": "text/plain; charset=utf-8"})
+
+    @router.route("GET", "/debug/profile")
+    def debug_profile(req: Request) -> Response:
+        """Wall-clock sampling profile of every server thread, in
+        collapsed-stack (flamegraph.pl) format.  ?seconds=N bounds the
+        capture window (default 2, max 60), ?hz=H the sampling rate
+        (default 100, max 250).  Paste the body into any flamegraph
+        viewer to see where python time goes — the piece of the drain
+        loop the span tracer cannot attribute."""
+        from ..observability.profiler import profile_collapsed
+
+        seconds = min(float(req.query.get("seconds", 2)), 60.0)
+        hz = min(float(req.query.get("hz", 100)), 250.0)
+        return Response(raw=profile_collapsed(seconds, hz=hz).encode(),
+                        headers={"Content-Type": "text/plain; charset=utf-8"})
+
+    @router.route("GET", "/debug/traces/analyze")
+    def debug_traces_analyze(req: Request) -> Response:
+        """Critical-path attribution report over the process-global span
+        ring: stage occupancy, gap analysis, overlap-efficiency
+        decomposition, and the clean-vs-degraded verdict (the pipeline
+        restart/fallback counters ride in as `health`).  ?format=text
+        renders the human view the shell's trace.analyze shows."""
+        from ..observability import analyze, get_tracer, render_report
+        from ..stats import ec_pipeline_metrics
+
+        report = analyze(get_tracer(),
+                         counters=ec_pipeline_metrics().totals())
+        if req.query.get("format", "").lower() == "text":
+            return Response(raw=render_report(report).encode(),
+                            headers={"Content-Type":
+                                     "text/plain; charset=utf-8"})
+        return Response(report)
 
     @router.route("GET", "/debug/traces")
     def debug_traces(req: Request) -> Response:

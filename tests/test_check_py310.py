@@ -1,0 +1,102 @@
+"""tools/check_py310.py as a tier-1 gate.
+
+The deployment runtime is Python 3.10: one 3.12-only construct in a
+widely-imported module silently collection-errors hundreds of tests (the
+seed's volume_server/server.py nested same-quote f-strings killed ~300
+until PR 1 fixed them by hand).  These tests (a) pin the checker's
+detection of that bug class on planted sources, and (b) run it over the
+WHOLE repo so a regression fails tier-1 loudly.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOL = os.path.join(REPO, "tools", "check_py310.py")
+
+
+def _load():
+    spec = importlib.util.spec_from_file_location("check_py310", TOOL)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+CHECK = _load()
+
+
+class TestPlantedViolations:
+    def test_nested_same_quote_fstring_rejected(self):
+        # the exact seed bug class: PEP-701 (3.12) nested same-quote
+        # f-string, assembled as data so THIS file stays 3.10-clean
+        bad = 'x = f"{"inner"}"\n'
+        problems = CHECK.check_source(bad, "bad.py")
+        assert len(problems) == 1 and "syntax" in problems[0]
+
+    def test_ungated_tomllib_rejected(self):
+        for src in ("import tomllib\n",
+                    "from tomllib import load\n",
+                    "import tomllib.decoder\n"):
+            problems = CHECK.check_source(src, "t.py")
+            assert problems and "tomllib" in problems[0], src
+
+    def test_gated_tomllib_accepted(self):
+        gated = ("try:\n"
+                 "    import tomllib\n"
+                 "except ImportError:\n"
+                 "    tomllib = None\n")
+        assert CHECK.check_source(gated, "t.py") == []
+        versioned = ("import sys\n"
+                     "if sys.version_info >= (3, 11):\n"
+                     "    import tomllib\n")
+        assert CHECK.check_source(versioned, "t.py") == []
+
+    def test_datetime_utc_rejected_and_gated_accepted(self):
+        assert CHECK.check_source("from datetime import UTC\n", "t.py")
+        assert CHECK.check_source(
+            "import datetime\nnow = datetime.datetime.now(datetime.UTC)\n",
+            "t.py")
+        gated = ("try:\n"
+                 "    from datetime import UTC\n"
+                 "except ImportError:\n"
+                 "    from datetime import timezone\n"
+                 "    UTC = timezone.utc\n")
+        assert CHECK.check_source(gated, "t.py") == []
+
+    def test_plain_310_code_accepted(self):
+        ok = ("from datetime import timezone\n"
+              "import json\n"
+              "x = f'{json.dumps({1: 2})}'\n"
+              "match_ = [i for i in range(3)]\n")
+        assert CHECK.check_source(ok, "t.py") == []
+
+    def test_check_tree_walks_and_skips_caches(self, tmp_path):
+        (tmp_path / "__pycache__").mkdir()
+        (tmp_path / "__pycache__" / "junk.py").write_text(
+            "import tomllib\n")
+        (tmp_path / "ok.py").write_text("x = 1\n")
+        (tmp_path / "bad.py").write_text("import tomllib\n")
+        problems = CHECK.check_tree(str(tmp_path))
+        assert len(problems) == 1 and "bad.py" in problems[0]
+
+
+class TestWholeRepo:
+    def test_repo_is_py310_clean(self):
+        """The tier-1 gate proper: every .py in the repo parses as 3.10
+        and gates its 3.11+-only imports."""
+        problems = CHECK.check_tree(REPO)
+        assert problems == [], "\n".join(problems)
+
+    def test_cli_entrypoint(self, tmp_path):
+        (tmp_path / "bad.py").write_text("from datetime import UTC\n")
+        p = subprocess.run([sys.executable, TOOL, str(tmp_path)],
+                           capture_output=True, text=True)
+        assert p.returncode == 1 and "UTC" in p.stdout
+        (tmp_path / "bad.py").write_text("x = 1\n")
+        p = subprocess.run([sys.executable, TOOL, str(tmp_path)],
+                           capture_output=True, text=True)
+        assert p.returncode == 0 and "0 problem(s)" in p.stderr

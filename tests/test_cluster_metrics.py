@@ -1,0 +1,224 @@
+"""Cluster telemetry aggregation: scrape, parse, merge, staleness.
+
+stats/aggregate.py + the master's /cluster/metrics and /cluster/health:
+the master scrapes every heartbeat-registered volume server's /metrics,
+merges the expositions (counters/gauges summed, histograms merged
+bucket-by-bucket), and serves the rollup — with unreachable peers
+marked stale (last-good values kept) rather than erroring.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import pytest
+
+from seaweedfs_tpu.master.server import MasterServer
+from seaweedfs_tpu.stats import (ClusterAggregator, ec_pipeline_metrics,
+                                 merge_families, parse_prometheus_text)
+from seaweedfs_tpu.stats.metrics import Registry
+from seaweedfs_tpu.utils.httpd import http_bytes, http_json
+from seaweedfs_tpu.volume_server.server import VolumeServer
+
+from tests.conftest import free_port
+
+
+# --- parser / merge units ----------------------------------------------------
+
+def _sample_registry() -> Registry:
+    reg = Registry()
+    c = reg.counter("t_requests_total", "reqs", labels=("type",))
+    c.inc("GET", amount=5)
+    c.inc("PUT", amount=2)
+    g = reg.gauge("t_volumes", "vols", labels=("collection",))
+    g.set("", 3)
+    h = reg.histogram("t_latency_seconds", "lat", labels=("op",))
+    for v in (0.0002, 0.002, 0.02, 0.2, 2.0, 20.0):
+        h.observe("read", v)
+    return reg
+
+
+class TestPrometheusParsing:
+    def test_round_trip_preserves_every_family(self):
+        reg = _sample_registry()
+        fams = parse_prometheus_text(reg.expose())
+        assert fams["t_requests_total"].value("GET") == 5
+        assert fams["t_requests_total"].value("PUT") == 2
+        assert fams["t_volumes"].value("") == 3
+        h = fams["t_latency_seconds"]
+        assert h._totals[("read",)] == 6
+        assert abs(h._sums[("read",)] - 22.2222) < 1e-6
+        # re-exposing the parsed family reproduces the original text
+        orig = "\n".join(
+            line for line in reg.expose().splitlines()
+            if line.startswith("t_latency_seconds"))
+        back = "\n".join(
+            line for line in h.expose() if not line.startswith("#"))
+        assert back == orig
+
+    def test_label_escaping_survives(self):
+        reg = Registry()
+        c = reg.counter("t_esc_total", "", labels=("path",))
+        weird = 'a"b\\c\nd'
+        c.inc(weird, amount=7)
+        fams = parse_prometheus_text(reg.expose())
+        assert fams["t_esc_total"].value(weird) == 7
+
+    def test_merge_families_sums_across_peers(self):
+        a = parse_prometheus_text(_sample_registry().expose())
+        b = parse_prometheus_text(_sample_registry().expose())
+        merged: dict = {}
+        merge_families(merged, a)
+        merge_families(merged, b)
+        assert merged["t_requests_total"].value("GET") == 10
+        assert merged["t_volumes"].value("") == 6
+        h = merged["t_latency_seconds"]
+        assert h._totals[("read",)] == 12
+        # merging never mutated the per-peer caches
+        assert a["t_requests_total"].value("GET") == 5
+
+    def test_untyped_samples_default_to_gauge(self):
+        fams = parse_prometheus_text("some_metric 4.5\n")
+        assert fams["some_metric"].value() == 4.5
+
+
+class TestAggregatorUnit:
+    def test_stale_peer_keeps_last_values(self):
+        texts = {"a:1": _sample_registry().expose(),
+                 "b:2": _sample_registry().expose()}
+
+        def fetch(url):
+            if url not in texts:
+                raise ConnectionError("down")
+            return texts[url]
+
+        agg = ClusterAggregator(lambda: ["a:1", "b:2"], fetch=fetch,
+                                min_interval=0.0)
+        agg.scrape(force=True)
+        assert 't_requests_total{type="GET"} 10' in agg.expose()
+        del texts["b:2"]  # peer dies
+        agg.scrape(force=True)
+        out = agg.expose()
+        # marked stale, NOT dropped and NOT an error: counters hold
+        assert 'SeaweedFS_cluster_peer_up{peer="b:2"} 0' in out
+        assert 'SeaweedFS_cluster_peer_stale{peer="b:2"} 1' in out
+        assert 'SeaweedFS_cluster_peer_up{peer="a:1"} 1' in out
+        assert 't_requests_total{type="GET"} 10' in out
+        assert agg.health()["stale_peers"] == ["b:2"]
+
+    def test_never_scraped_dead_peer(self):
+        agg = ClusterAggregator(
+            lambda: ["x:1"],
+            fetch=lambda u: (_ for _ in ()).throw(ConnectionError("no")),
+            min_interval=0.0)
+        agg.scrape(force=True)
+        st = agg.peer_status()["x:1"]
+        assert st["up"] is False and st["stale"] is True
+        assert st["has_data"] is False
+        assert agg.health()["peers"]["x:1"]["pipeline_health"] == {
+            "worker_restarts": 0, "engine_fallbacks": 0,
+            "degraded_binds": 0}
+
+    def test_unregistered_peer_drops_out(self):
+        peers = ["a:1", "b:2"]
+        agg = ClusterAggregator(lambda: list(peers),
+                                fetch=lambda u: "m_total 1\n",
+                                min_interval=0.0)
+        agg.scrape(force=True)
+        assert len(agg.peer_status()) == 2
+        peers.remove("b:2")  # left the topology: gone, not stale
+        agg.scrape(force=True)
+        assert list(agg.peer_status()) == ["a:1"]
+
+    def test_min_interval_rate_limits(self):
+        calls = []
+        agg = ClusterAggregator(lambda: ["a:1"],
+                                fetch=lambda u: calls.append(u) or "x 1\n",
+                                min_interval=60.0)
+        agg.scrape()
+        agg.scrape()
+        agg.scrape()
+        assert len(calls) == 1
+
+
+# --- live master + volume servers -------------------------------------------
+
+@pytest.fixture
+def cluster():
+    # long pulse so a stopped server stays REGISTERED (stale) instead of
+    # being janitor-unregistered mid-test
+    master = MasterServer(port=free_port(), pulse_seconds=5.0).start()
+    master.aggregator.min_interval = 0.0  # every GET rescapes
+    servers = []
+    for i in range(2):
+        servers.append(VolumeServer(
+            [], master.url, port=free_port(), pulse_seconds=5.0).start())
+    deadline = time.time() + 5
+    while time.time() < deadline and len(master.topo.all_nodes()) < 2:
+        time.sleep(0.05)
+    assert len(master.topo.all_nodes()) == 2
+    yield master, servers
+    for vs in servers:
+        vs.stop()
+    master.stop()
+
+
+class TestClusterEndpoints:
+    def test_cluster_metrics_merges_and_marks_stale(self, cluster):
+        master, servers = cluster
+        m = ec_pipeline_metrics()
+        m.worker_restarts.inc("staged", amount=3)
+        # in-process servers share one REGISTRY, so each peer's scrape
+        # reports the same process-wide total: the merged cluster value
+        # must be exactly peers x local — the cross-peer SUM contract
+        local = sum(m.worker_restarts.snapshot().values())
+        status, body, _ = http_bytes(
+            "GET", f"http://{master.url}/cluster/metrics")
+        assert status == 200
+        text = body.decode()
+        fams = parse_prometheus_text(text)
+        merged = sum(
+            fams["SeaweedFS_ec_worker_restarts_total"].snapshot().values())
+        assert merged == 2 * local
+        for vs in servers:
+            assert f'SeaweedFS_cluster_peer_up{{peer="{vs.url}"}} 1' \
+                in text
+        # request histograms merged bucket-by-bucket, still well-formed
+        assert "SeaweedFS_volumeServer_request_seconds_bucket" in text
+
+        # kill one peer: merged text still serves, peer marked stale
+        dead = servers[1]
+        dead.stop()
+        status, body, _ = http_bytes(
+            "GET", f"http://{master.url}/cluster/metrics")
+        assert status == 200
+        text = body.decode()
+        assert f'SeaweedFS_cluster_peer_up{{peer="{dead.url}"}} 0' in text
+        assert f'SeaweedFS_cluster_peer_stale{{peer="{dead.url}"}} 1' \
+            in text
+        # stale peer's last-good series still counted, not dipped
+        fams = parse_prometheus_text(text)
+        merged = sum(
+            fams["SeaweedFS_ec_worker_restarts_total"].snapshot().values())
+        assert merged >= 2 * local
+
+    def test_cluster_health_json_and_shell(self, cluster):
+        master, servers = cluster
+        doc = http_json("GET", f"http://{master.url}/cluster/health")
+        assert doc["peer_count"] == 2
+        assert set(doc["totals"]) == {"worker_restarts",
+                                      "engine_fallbacks",
+                                      "degraded_binds"}
+        for vs in servers:
+            peer = doc["peers"][vs.url]
+            assert peer["up"] is True and peer["stale"] is False
+            assert "pipeline_health" in peer
+        # the shell rollup command renders the same document
+        from seaweedfs_tpu.shell import CommandEnv, run_command
+
+        env = CommandEnv(master.url)
+        out = run_command(env, "cluster.health")
+        assert "peers: 2" in out and "worker_restarts=" in out
+        parsed = json.loads(run_command(env, "cluster.health -json"))
+        assert parsed["peer_count"] == 2

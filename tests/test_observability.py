@@ -230,3 +230,181 @@ class TestPrometheusBridge:
             disable_tracing()
             tr.clear()
         assert get_tracer().span("x") is _NOOP
+
+
+class TestConcurrentExport:
+    """Exporters racing span() writers on the bounded ring: the dump
+    endpoints (/debug/traces, /metrics) run on HTTP handler threads
+    while the pipeline keeps recording — no exception, monotonic
+    timestamps, no torn spans."""
+
+    def _hammer(self, tr, n_threads=4, spin=0.25):
+        stop = threading.Event()
+        errors: list = []
+
+        def writer(i):
+            j = 0
+            try:
+                while not stop.is_set():
+                    with tr.span("hot", worker=i, j=j):
+                        with tr.span("inner", worker=i):
+                            pass
+                    j += 1
+            except Exception as e:  # pragma: no cover - the failure mode
+                errors.append(e)
+
+        threads = [threading.Thread(target=writer, args=(i,))
+                   for i in range(n_threads)]
+        for t in threads:
+            t.start()
+        return stop, threads, errors
+
+    def test_chrome_export_races_writers(self):
+        tr = Tracer(capacity=512)
+        stop, threads, errors = self._hammer(tr)
+        try:
+            deadline = time.time() + 0.6
+            docs = 0
+            while time.time() < deadline:
+                doc = tr.to_chrome(clear=(docs % 3 == 0))
+                events = [e for e in doc["traceEvents"]
+                          if e.get("ph") == "X"]
+                last: dict = {}
+                for e in events:
+                    # no torn span: every field present and sane
+                    assert e["dur"] > 0 and e["name"] in ("hot", "inner")
+                    assert "span_id" in e["args"]
+                    key = (e["pid"], e["tid"])
+                    if key in last:
+                        assert e["ts"] > last[key]
+                    last[key] = e["ts"]
+                json.dumps(doc)  # serializable mid-race
+                docs += 1
+        finally:
+            stop.set()
+            for t in threads:
+                t.join()
+        assert not errors
+        assert docs > 0
+
+    def test_prometheus_bridge_races_writers(self):
+        from seaweedfs_tpu.stats import REGISTRY
+
+        tr = Tracer(capacity=256, prometheus=True)
+        stop, threads, errors = self._hammer(tr, n_threads=3)
+        try:
+            deadline = time.time() + 0.4
+            while time.time() < deadline:
+                text = REGISTRY.expose()
+                assert "SeaweedFS_trace_span_seconds" in text
+        finally:
+            stop.set()
+            for t in threads:
+                t.join()
+        assert not errors
+        # bucket counts never exceed totals (no torn histogram rows)
+        hist = next(c for c in REGISTRY._collectors
+                    if getattr(c, "name", "") ==
+                    "SeaweedFS_trace_span_seconds")
+        for key, (counts, _s, total) in hist.snapshot().items():
+            assert sum(counts) <= total
+
+    def test_export_log_and_snapshot_clear_race(self):
+        """poll-and-clear capture loop under writer load: every span is
+        seen at most once and none is torn."""
+        tr = Tracer(capacity=4096)
+        stop, threads, errors = self._hammer(tr, n_threads=3)
+        seen: set = set()
+        try:
+            deadline = time.time() + 0.4
+            while time.time() < deadline:
+                for e in tr.export_log():
+                    assert e["t1"] >= e["t0"]
+                for sp in tr.snapshot(clear=True):
+                    assert sp.span_id not in seen  # at-most-once
+                    seen.add(sp.span_id)
+        finally:
+            stop.set()
+            for t in threads:
+                t.join()
+        assert not errors and seen
+
+
+class TestToDictRoundTrip:
+    def test_round_trip_preserves_spans_exactly(self):
+        tr = Tracer(namespace="src", capacity=128)
+        with tr.span("outer", k=1):
+            with tr.span("inner"):
+                pass
+        tr.add_span("worker.compute", 10.0, 10.5, tid=777, dispatch=2)
+        doc = json.loads(json.dumps(tr.to_dict()))
+        back = Tracer.from_dict(doc)
+        orig = {s.span_id: s for s in tr.snapshot()}
+        got = {s.span_id: s for s in back.snapshot()}
+        assert set(got) == set(orig)
+        for sid, s in got.items():
+            o = orig[sid]
+            assert (s.name, s.parent_id, s.t0, s.t1, s.attrs, s.tid) == \
+                (o.name, o.parent_id, o.t0, o.t1, o.attrs, o.tid)
+        assert back.namespace == "src"
+        assert back.capacity >= 3
+
+    def test_from_dict_capacity_fits_spans(self):
+        tr = Tracer(capacity=8)
+        for i in range(8):
+            with tr.span("s", i=i):
+                pass
+        doc = tr.to_dict()
+        doc["capacity"] = 2  # hostile/old doc: must not drop spans
+        assert len(Tracer.from_dict(doc).snapshot()) == 8
+
+
+class TestSamplingProfiler:
+    def test_busy_thread_shows_in_collapsed_output(self):
+        from seaweedfs_tpu.observability import SamplingProfiler
+
+        stop = threading.Event()
+
+        def busy_loop_marker():
+            while not stop.is_set():
+                sum(i * i for i in range(500))
+
+        th = threading.Thread(target=busy_loop_marker,
+                              name="busy-marker")
+        th.start()
+        prof = SamplingProfiler(hz=250)
+        prof.run_for(0.4)
+        stop.set()
+        th.join()
+        assert prof.samples > 10
+        col = prof.collapsed()
+        assert "busy-marker" in col and "busy_loop_marker" in col
+        # collapsed-stack grammar: `frames... count` per line, root-first
+        for line in col.splitlines():
+            stack, _, count = line.rpartition(" ")
+            assert stack and count.isdigit()
+        # the text report renders the same data
+        rep = prof.report_text()
+        assert "self time" in rep and "cumulative" in rep
+
+    def test_bounded_unique_stacks(self):
+        from seaweedfs_tpu.observability import SamplingProfiler
+
+        prof = SamplingProfiler(hz=100, max_stacks=1)
+        # synthetic samples: distinct stacks past the bound collapse
+        # into the overflow bucket instead of growing memory
+        prof._counts[("t", (("f.py", 1, "a"),))] = 1
+        for i in range(50):
+            prof._sample_once(set())
+        assert len(prof._counts) <= 2  # bound + overflow bucket
+        assert prof.dropped > 0
+        assert "(overflow)" in prof.collapsed()
+
+    def test_run_for_excludes_caller_thread(self):
+        from seaweedfs_tpu.observability import SamplingProfiler
+
+        prof = SamplingProfiler(hz=200)
+        prof.run_for(0.2)
+        me = threading.current_thread().name
+        assert all(not line.startswith(me + ";") and "run_for" not in line
+                   for line in prof.collapsed().splitlines())

@@ -180,3 +180,102 @@ def test_volume_stats_endpoints(tmp_path):
     finally:
         vs.stop()
         master.stop()
+
+
+class TestHistogramMerge:
+    """Histogram.merge(other) — the cluster aggregator's cross-peer
+    combine.  The defining property: merging two histograms equals one
+    histogram that observed the UNION of both sample streams."""
+
+    @staticmethod
+    def _observe_all(h, labels, samples):
+        for s in samples:
+            h.observe(*labels, s)
+
+    def test_merge_equals_observing_union(self):
+        import random
+
+        rng = random.Random(0xBEEF)
+        # spans the whole default grid including past-the-last-bucket
+        pool = [rng.choice((0.00005, 0.0005, 0.002, 0.05, 0.7, 2.5,
+                            9.0, 42.0)) * rng.random() for _ in range(400)]
+        for split in (0, 1, 137, 399, 400):
+            a = Histogram("h", labels=("op",))
+            b = Histogram("h", labels=("op",))
+            union = Histogram("h", labels=("op",))
+            self._observe_all(a, ("x",), pool[:split])
+            self._observe_all(b, ("x",), pool[split:])
+            self._observe_all(union, ("x",), pool)
+            a.merge(b)
+            assert a._counts[("x",)] == union._counts[("x",)]
+            assert abs(a._sums[("x",)] - union._sums[("x",)]) < 1e-9
+            assert a._totals[("x",)] == union._totals[("x",)]
+            # exposition text identical too (cumulative form); _sum may
+            # differ by float summation order, checked by tolerance above
+            strip = lambda lines: [l for l in lines if "_sum" not in l]
+            assert strip(a.expose()) == strip(union.expose())
+
+    def test_merge_disjoint_and_overlapping_label_sets(self):
+        a = Histogram("h", labels=("op",))
+        b = Histogram("h", labels=("op",))
+        a.observe("read", 0.01)
+        b.observe("read", 0.02)
+        b.observe("write", 1.0)
+        a.merge(b)
+        assert a._totals[("read",)] == 2
+        assert a._totals[("write",)] == 1
+        assert abs(a._sums[("read",)] - 0.03) < 1e-12
+
+    def test_merge_rejects_bucket_mismatch(self):
+        a = Histogram("h", buckets=(0.1, 1.0))
+        b = Histogram("h", buckets=(0.2, 1.0))
+        with pytest.raises(ValueError):
+            a.merge(b)
+
+    def test_merge_empty_other_is_noop(self):
+        a = Histogram("h")
+        a.observe(0.5)
+        before = a.expose()
+        a.merge(Histogram("h"))
+        assert a.expose() == before
+
+    def test_merge_concurrent_with_observe(self):
+        """merge() racing observe() on the destination: totals add up,
+        no exception, no torn bucket rows."""
+        import threading
+
+        dst = Histogram("h")
+        src = Histogram("h")
+        for _ in range(500):
+            src.observe(0.005)
+        stop = threading.Event()
+        observed = [0]
+
+        def hammer():
+            while not stop.is_set():
+                dst.observe(0.005)
+                observed[0] += 1
+
+        th = threading.Thread(target=hammer)
+        th.start()
+        for _ in range(20):
+            dst.merge(src)
+        stop.set()
+        th.join()
+        assert dst._totals[()] == 20 * 500 + observed[0]
+        assert sum(dst._counts[()]) == dst._totals[()]
+
+    def test_counter_and_gauge_merge(self):
+        a = Counter("c", labels=("k",))
+        b = Counter("c", labels=("k",))
+        a.inc("x", amount=2)
+        b.inc("x", amount=3)
+        b.inc("y", amount=1)
+        a.merge(b)
+        assert a.value("x") == 5 and a.value("y") == 1
+        g1 = Gauge("g")
+        g2 = Gauge("g")
+        g1.set(4)
+        g2.set(6)
+        g1.merge(g2)
+        assert g1.value() == 10

@@ -49,6 +49,7 @@ def cmd_master(args) -> None:
                      volume_size_limit_mb=args.volumeSizeLimitMB,
                      default_replication=args.defaultReplication,
                      peers=peers, mdir=args.mdir,
+                     metrics_aggregation_seconds=args.metricsAggregationSeconds,
                      guard=master_guard(_security()),
                      tls_context=_cluster_tls()).start()
     print(f"master listening on {m.url}")
@@ -1067,6 +1068,10 @@ def main(argv=None) -> None:
                    help="comma-separated other master host:ports")
     m.add_argument("-mdir", default="",
                    help="dir for raft state persistence (-resumeState)")
+    m.add_argument("-metricsAggregationSeconds", type=float, default=0.0,
+                   help="scrape registered volume-server /metrics every N "
+                        "seconds for /cluster/metrics + /cluster/health "
+                        "(0 = scrape on demand only)")
     m.set_defaults(fn=cmd_master)
 
     v = sub.add_parser("volume")
