@@ -55,7 +55,7 @@ SECTION_CAPS = {
     "transfer": 90, "e2e_stream": 600, "e2e_rebuild": 300,
     "e2e_decode_8gb": 420, "roofline": 90, "cluster": 360,
     "cluster_native": 360, "cluster_scaled": 420, "parity": 120,
-    "pipeline_health": 15,
+    "integrity": 120, "pipeline_health": 15,
 }
 SECTION_CAP_DEFAULT = 300
 SECTION_MIN_S = 15          # least useful remaining budget to even start
@@ -1069,6 +1069,58 @@ def _child(scratch_path: str, platform: str = "") -> None:
 
     section("parity", meas_parity)
 
+    # --- integrity: sidecar overhead + scrub throughput --------------------
+    def meas_integrity():
+        import tempfile as _tempfile
+
+        from seaweedfs_tpu.ec.integrity import EciSidecar, verify_shard_file
+        from seaweedfs_tpu.ec.layout import to_ext as _to_ext
+        from seaweedfs_tpu.ec.streaming import StreamingEncoder
+
+        size_mb = 96
+        with _tempfile.TemporaryDirectory() as td:
+            dat = os.path.join(td, "1.dat")
+            _write_big_random(dat, size_mb)
+            base = os.path.join(td, "1")
+            # verify overhead on the encode path: same encoder, sidecar
+            # crc accumulation on vs off.  One untimed warm-up first so
+            # both timed runs see the same hot page cache / initialized
+            # codec — without it the second run's cache warmth would
+            # systematically understate the overhead
+            StreamingEncoder(10, 4, engine="host",
+                             sidecar=False).encode_file(dat, base)
+            enc_off = StreamingEncoder(10, 4, engine="host", sidecar=False)
+            t0 = time.perf_counter()
+            enc_off.encode_file(dat, base)
+            t_without = time.perf_counter() - t0
+            enc_on = StreamingEncoder(10, 4, engine="host")
+            t0 = time.perf_counter()
+            enc_on.encode_file(dat, base)
+            t_with = time.perf_counter() - t0
+            # scrub throughput: one pass over all 14 shards against the
+            # sidecar — the scrubber's block-verify hot loop, unpaced
+            sc = EciSidecar.load(base)
+            nbytes = 0
+            t0 = time.perf_counter()
+            for i in range(14):
+                if verify_shard_file(sc, base + _to_ext(i), i):
+                    detail["error_integrity_verify"] = \
+                        f"shard {i} failed crc on a clean encode"
+                nbytes += os.path.getsize(base + _to_ext(i))
+            scrub_s = time.perf_counter() - t0
+            detail["integrity"] = {
+                "volume_mb": size_mb,
+                "scrub_gbps": round(nbytes / max(scrub_s, 1e-9) / 1e9, 3),
+                "encode_with_sidecar_s": round(t_with, 3),
+                "encode_without_sidecar_s": round(t_without, 3),
+                "sidecar_overhead_pct": round(
+                    100.0 * max(t_with - t_without, 0.0)
+                    / max(t_without, 1e-9), 1),
+                "sidecar_s": round(enc_on.stats.get("sidecar_s", 0.0), 3),
+            }
+
+    section("integrity", meas_integrity)
+
     def meas_pipeline_health():
         # self-healing pipeline counters for the WHOLE bench run: nonzero
         # means some measurement above survived worker restarts or ran
@@ -1076,13 +1128,21 @@ def _child(scratch_path: str, platform: str = "") -> None:
         # DEGRADED run and must not be read as the clean-path capability
         # (per-run deltas also ride each e2e pipe dict as
         # retries/fallbacks/worker_restarts)
-        from seaweedfs_tpu.stats import ec_pipeline_metrics
+        from seaweedfs_tpu.stats import (ec_integrity_metrics,
+                                         ec_pipeline_metrics)
 
         totals = ec_pipeline_metrics().totals()
+        integrity = ec_integrity_metrics().totals()
         detail["pipeline_health"] = {
             "worker_restarts": totals["worker_restarts"],
             "engine_fallbacks": totals["engine_fallbacks"],
+            # nonzero corrupt_shards/scrub_repairs: some measurement ran
+            # against shards that rotted and were demoted or repaired
+            # mid-bench — the run is NOT clean even if it completed
+            "corrupt_shards": integrity["corrupt_shards"],
+            "scrub_repairs": integrity["scrub_repairs"],
         }
+        detail["scrub_health"] = integrity
 
     section("pipeline_health", meas_pipeline_health)
 

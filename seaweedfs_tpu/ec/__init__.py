@@ -1,0 +1,1 @@
+from .integrity import ShardCorruptError  # noqa: F401  (public error type)

@@ -16,7 +16,6 @@ import numpy as np
 
 from ..storage import idx as idx_mod
 from ..storage.needle import get_actual_size
-from ..utils.ioutil import pread_padded
 from ..storage.types import (
     NEEDLE_ID_SIZE,
     NEEDLE_MAP_ENTRY_SIZE,
@@ -26,6 +25,8 @@ from ..storage.types import (
     u64_to_bytes,
 )
 from .codec import ReedSolomon
+from .integrity import (EciSidecar, ShardCorruptError, note_corruption,
+                        sidecar_is_stale)
 from .layout import (
     DATA_SHARDS_COUNT,
     LARGE_BLOCK_SIZE,
@@ -78,7 +79,13 @@ class EcVolumeShard:
 
         if fi._points:
             fi.hit("shard.read")
-        return os.pread(self._f.fileno(), length, offset)
+        data = os.pread(self._f.fileno(), length, offset)
+        if fi._points:
+            # bit-rot drill: a deterministic flip the sidecar verify
+            # paths must catch and demote (utils/faultinject.py)
+            data = fi.corrupt_block("ec.shard.corrupt", self.shard_id,
+                                    data, offset)
+        return data
 
     def close(self) -> None:
         self._f.close()
@@ -113,6 +120,28 @@ class EcVolume:
         for i in range(self.total_shards):
             if os.path.exists(base_file_name + to_ext(i)):
                 self.shards[i] = EcVolumeShard(base_file_name, i)
+        # block-crc sidecar (ec/integrity.py): reads verify survivor
+        # blocks against it and demote mismatching shards to erasures;
+        # None (missing/rotted sidecar) means reads trust the bytes
+        self.sidecar = EciSidecar.load(base_file_name)
+        if sidecar_is_stale(self.sidecar,
+                            (sh.size for sh in self.shards.values())):
+            # a stale table (different encode's geometry) would demote
+            # the whole healthy volume; mismatching shards among
+            # size-agreeing peers instead demote in _verified_read
+            self.sidecar = None
+        # shards demoted by a crc mismatch this mount: excluded from
+        # reads AND from reconstruction survivor sets until remount
+        self.corrupt_shards: set[int] = set()
+        # per-mount verified-block cache: a block that passed its crc
+        # once serves later narrow reads without re-widening/re-hashing
+        # (detection stays: rot at rest is caught on first use or by
+        # the scrubber; rot landing mid-mount after a block was
+        # verified is the scrubber's job).  Armed fault points bypass
+        # the cache so corruption drills always re-verify.
+        self._verified = (np.zeros(
+            (self.total_shards, self.sidecar.block_count), dtype=bool)
+            if self.sidecar is not None else None)
 
     # --- index ---------------------------------------------------------
     def find_needle_from_ecx(self, needle_id: int) -> tuple[int, int]:
@@ -150,27 +179,153 @@ class EcVolume:
         self._ecj.flush()
 
     # --- interval reads ---------------------------------------------------
+    def _padded_read(self, shard_id: int, length: int,
+                     offset: int) -> np.ndarray:
+        """Zero-padded shard read through read_at (so fault points and
+        the shard.read instrumentation apply uniformly)."""
+        buf = self.shards[shard_id].read_at(length, offset)
+        arr = np.zeros(length, dtype=np.uint8)
+        if buf:
+            arr[: len(buf)] = np.frombuffer(buf, dtype=np.uint8)
+        return arr
+
+    def _verified_read(self, shard_id: int, offset: int,
+                       length: int) -> np.ndarray:
+        """Read [offset, offset+length) of one shard, verifying every
+        COVERING sidecar block (the read is widened to block boundaries,
+        then sliced back).  Raises ShardCorruptError on a crc mismatch
+        or a size mismatch (a truncated shard's missing tail would
+        otherwise read back as trusted zeros — silent garbage); without
+        a sidecar row for this shard it degrades to a trusting read."""
+        sc = self.sidecar
+        if sc is None or not sc.has_row(shard_id):
+            return self._padded_read(shard_id, length, offset)
+        if sc.shard_size != self.shards[shard_id].size:
+            # the mount-time check cleared sidecars that disagree with
+            # EVERY shard, so a lone divergent shard here is truncated/
+            # grown rot, not a stale table
+            raise ShardCorruptError(
+                f"ec volume {self.vid}: shard {shard_id} size "
+                f"{self.shards[shard_id].size} != sidecar "
+                f"{sc.shard_size}", (shard_id,))
+        bs = sc.block_size
+        b0 = offset // bs
+        b1 = -(-(offset + length) // bs)
+        from ..utils import faultinject as fi
+
+        if self._verified is not None and not fi._points \
+                and bool(self._verified[shard_id, b0:b1].all()):
+            # every covering block already passed its crc this mount:
+            # serve the narrow read without re-widening/re-hashing
+            return self._padded_read(shard_id, length, offset)
+        a0, a1 = b0 * bs, b1 * bs
+        arr = self._padded_read(shard_id, a1 - a0, a0)
+        bad = sc.verify_range(shard_id, a0, arr)
+        if bad is not None:
+            raise ShardCorruptError(
+                f"ec volume {self.vid}: shard {shard_id} block {bad} "
+                f"crc mismatch", (shard_id,))
+        if self._verified is not None and not fi._points:
+            self._verified[shard_id, b0:b1] = True
+        return arr[offset - a0: offset - a0 + length]
+
+    def _note_corrupt(self, shard_id: int) -> None:
+        if shard_id not in self.corrupt_shards:
+            self.corrupt_shards.add(shard_id)
+            note_corruption("read", shard_id, self.base_file_name)
+
     def read_interval(self, interval: Interval,
                       rs: Optional[ReedSolomon] = None) -> bytes:
         """Read one interval: local shard if present, else on-the-fly
         reconstruction from >= data_shards local shards
-        (store_ec.go:188-218 local branch + :328-382 recovery math)."""
+        (store_ec.go:188-218 local branch + :328-382 recovery math).
+        A crc-mismatching local shard is demoted to an erasure and the
+        interval reconstructs from the clean survivors instead."""
         shard_id, shard_offset = interval.to_shard_id_and_offset(
             self.large_block_size, self.small_block_size, self.data_shards)
-        if shard_id in self.shards:
-            return self.shards[shard_id].read_at(interval.size, shard_offset)
+        if shard_id in self.shards and shard_id not in self.corrupt_shards:
+            try:
+                return self._verified_read(
+                    shard_id, shard_offset, interval.size).tobytes()
+            except ShardCorruptError:
+                self._note_corrupt(shard_id)
+            except OSError:
+                # bad sector/dying disk on the direct read: same erasure
+                # treatment the store layer gives remote shard fetches —
+                # reconstruct from the other locals (not demoted: the
+                # next read retries the disk)
+                pass
         return self.reconstruct_interval(shard_id, shard_offset, interval.size, rs)
 
     def reconstruct_interval(self, missing_shard_id: int, shard_offset: int,
                              length: int, rs: Optional[ReedSolomon] = None) -> bytes:
-        if len(self.shards) < self.data_shards:
-            raise NeedleNotFoundError(
-                f"cannot reconstruct shard {missing_shard_id}: "
-                f"only {len(self.shards)} local shards")
+        """Rebuild one missing/corrupt interval from local survivors.
+        Survivors are sidecar-verified before use; one that fails its
+        crc — or errors at the IO layer (bad sector, dying disk) — is
+        skipped and the next local shard takes its place, so corruption
+        and read errors both become correctable erasures.  Raises
+        ShardCorruptError when corruption leaves fewer than data_shards
+        clean survivors (never silent garbage), NeedleNotFoundError when
+        there were simply never enough local shards."""
         rs = rs or ReedSolomon(self.data_shards, self.parity_shards)
         bufs: list[Optional[np.ndarray]] = [None] * self.total_shards
-        for i, shard in list(self.shards.items())[: self.data_shards]:
-            bufs[i] = pread_padded(shard._f, length, shard_offset)
+        clean = 0
+        errored: list[int] = []
+        for i in self.shards:
+            if clean >= self.data_shards:
+                break
+            if i == missing_shard_id or i in self.corrupt_shards:
+                continue
+            try:
+                bufs[i] = self._verified_read(i, shard_offset, length)
+            except ShardCorruptError:
+                self._note_corrupt(i)
+                continue
+            except OSError:
+                # bad sector: an alternate survivor takes this slot
+                errored.append(i)
+                continue
+            clean += 1
+        # alternates exhausted but shards errored: transient IO blips
+        # (EINTR, a loaded controller) get bounded second chances before
+        # the interval gives up — a persistent bad sector exhausts the
+        # retries, a transient one doesn't cost the read when there were
+        # no spare shards left to take its slot
+        for _ in range(3):
+            if clean >= self.data_shards or not errored:
+                break
+            still: list[int] = []
+            for i in errored:
+                if clean >= self.data_shards:
+                    break
+                try:
+                    bufs[i] = self._verified_read(i, shard_offset, length)
+                except ShardCorruptError:
+                    self._note_corrupt(i)
+                except OSError:
+                    still.append(i)
+                else:
+                    clean += 1
+            errored = still
+        if clean < self.data_shards:
+            # blame corruption only when it was the DECIDING factor:
+            # with the demoted shards counted back in we'd have had
+            # enough survivors.  A server that simply never held
+            # data_shards local shards keeps raising
+            # NeedleNotFoundError (the 404 / fall-through-to-remote
+            # path), demotions or not.
+            demoted_local = sum(1 for s in self.corrupt_shards
+                                if s in self.shards
+                                and s != missing_shard_id)
+            if demoted_local and clean + demoted_local >= self.data_shards:
+                raise ShardCorruptError(
+                    f"ec volume {self.vid}: only {clean} clean local "
+                    f"shards after demoting corrupt "
+                    f"{sorted(self.corrupt_shards)}",
+                    tuple(sorted(self.corrupt_shards)))
+            raise NeedleNotFoundError(
+                f"cannot reconstruct shard {missing_shard_id}: "
+                f"only {clean} readable local shards")
         rs.reconstruct(bufs)
         return bufs[missing_shard_id].tobytes()
 

@@ -27,8 +27,17 @@ import numpy as np
 from ..observability import get_tracer
 from ..storage.needle_map import MemDb
 from ..storage.types import NEEDLE_ID_SIZE
+from ..utils import faultinject
 from ..utils.ioutil import pread_padded as _pread_padded
 from .codec import ReedSolomon
+from .integrity import (
+    CorruptSurvivor,
+    EciSidecar,
+    ShardCorruptError,
+    SidecarBuilder,
+    note_corruption,
+    sidecar_path,
+)
 from .layout import (
     DATA_SHARDS_COUNT,
     LARGE_BLOCK_SIZE,
@@ -48,7 +57,8 @@ def write_sorted_file_from_idx(base_file_name: str, ext: str = ".ecx") -> None:
 
 
 def _encode_row(dat_file, rs: ReedSolomon, start_offset: int, block_size: int,
-                outputs, chunk: int) -> None:
+                outputs, chunk: int, builder: Optional[SidecarBuilder] = None
+                ) -> None:
     """Encode one row of data_shards blocks of block_size each
     (encodeData/encodeDataOneBatch, ec_encoder.go:120-192)."""
     for chunk_off in range(0, block_size, chunk):
@@ -59,19 +69,29 @@ def _encode_row(dat_file, rs: ReedSolomon, start_offset: int, block_size: int,
         parity = rs.encode(data)
         for i in range(rs.data_shards):
             outputs[i].write(data[i].tobytes())
+            if builder is not None:
+                builder.update(i, data[i])
         for i in range(rs.parity_shards):
             outputs[rs.data_shards + i].write(parity[i].tobytes())
+            if builder is not None:
+                builder.update(rs.data_shards + i, parity[i])
 
 
 def write_ec_files(base_file_name: str, rs: Optional[ReedSolomon] = None,
                    large_block_size: int = LARGE_BLOCK_SIZE,
                    small_block_size: int = SMALL_BLOCK_SIZE,
-                   chunk: int = DEFAULT_CHUNK) -> None:
-    """WriteEcFiles (ec_encoder.go:57): stripe `.dat` into `.ec00`..`.ecNN`."""
+                   chunk: int = DEFAULT_CHUNK, sidecar: bool = True,
+                   sidecar_block_size: Optional[int] = None) -> None:
+    """WriteEcFiles (ec_encoder.go:57): stripe `.dat` into `.ec00`..`.ecNN`.
+    Also writes the `.eci` block-crc sidecar (ec/integrity.py), built
+    incrementally as shard bytes stream out — all 14 shards including
+    parity get crc coverage at encode time, no second read pass."""
     rs = rs or ReedSolomon(DATA_SHARDS_COUNT, PARITY_SHARDS_COUNT)
     dat_path = base_file_name + ".dat"
     remaining = os.path.getsize(dat_path)
     processed = 0
+    builder = SidecarBuilder(rs.total_shards, sidecar_block_size) \
+        if sidecar else None
     with get_tracer().span("ec.write_ec_files", path=dat_path,
                            bytes=remaining, k=rs.data_shards,
                            r=rs.parity_shards,
@@ -86,13 +106,25 @@ def write_ec_files(base_file_name: str, rs: Optional[ReedSolomon] = None,
             for i in range(rs.total_shards):
                 outputs.append(open(base_file_name + to_ext(i), "wb"))
             while remaining > large_block_size * rs.data_shards:
-                _encode_row(dat, rs, processed, large_block_size, outputs, chunk)
+                _encode_row(dat, rs, processed, large_block_size, outputs,
+                            chunk, builder)
                 remaining -= large_block_size * rs.data_shards
                 processed += large_block_size * rs.data_shards
             while remaining > 0:
-                _encode_row(dat, rs, processed, small_block_size, outputs, chunk)
+                _encode_row(dat, rs, processed, small_block_size, outputs,
+                            chunk, builder)
                 remaining -= small_block_size * rs.data_shards
                 processed += small_block_size * rs.data_shards
+            if builder is not None:
+                builder.finalize().save(base_file_name)
+            else:
+                # sidecar=False over a previously-sidecar'd volume: the
+                # old table describes the OLD bytes and would mass-demote
+                # every freshly written shard
+                try:
+                    os.remove(sidecar_path(base_file_name))
+                except OSError:
+                    pass
             ok = True
         finally:
             for f in outputs:
@@ -101,9 +133,13 @@ def write_ec_files(base_file_name: str, rs: Optional[ReedSolomon] = None,
                 # same discipline as rebuild_ec_files: a truncated .ecNN
                 # surviving a failed encode would satisfy existence checks
                 # and mask the missing bytes on the next mount/rebuild
-                for i in range(rs.total_shards):
+                # (and a stale sidecar would mass-demote the next encode's
+                # shards, so it goes too)
+                for p in [base_file_name + to_ext(i)
+                          for i in range(rs.total_shards)] + \
+                         [sidecar_path(base_file_name)]:
                     try:
-                        os.remove(base_file_name + to_ext(i))
+                        os.remove(p)
                     except OSError:
                         pass
 
@@ -112,15 +148,50 @@ def rebuild_ec_files(base_file_name: str, rs: Optional[ReedSolomon] = None,
                      chunk: int = SMALL_BLOCK_SIZE) -> list[int]:
     """RebuildEcFiles (ec_encoder.go:61, :89-118, :233-287): regenerate every
     missing `.ecNN` from the >= data_shards present ones.  Returns generated
-    shard ids."""
+    shard ids.
+
+    Survivors are verified block-by-block against the `.eci` sidecar as
+    they stream in: a crc-mismatching survivor is DEMOTED to an erasure
+    and the rebuild restarts with an alternate survivor set, which also
+    regenerates the demoted shard (bit rot becomes a correctable
+    erasure); when demotions leave fewer than data_shards clean shards
+    the rebuild fails with ShardCorruptError instead of emitting silent
+    garbage.  Without a sidecar, survivors are trusted as before."""
     rs = rs or ReedSolomon(DATA_SHARDS_COUNT, PARITY_SHARDS_COUNT)
-    has_data = [os.path.exists(base_file_name + to_ext(i)) for i in range(rs.total_shards)]
+    sidecar = EciSidecar.load(base_file_name)
+    demoted: set[int] = set()
+    while True:
+        try:
+            return _rebuild_ec_attempt(base_file_name, rs, chunk, sidecar,
+                                       demoted)
+        except CorruptSurvivor as e:
+            # corruption is an erasure: retry with the shard excluded —
+            # it lands in the missing set and is regenerated clean
+            demoted.add(e.shard_id)
+            note_corruption("rebuild", e.shard_id, base_file_name,
+                            block=e.block)
+
+
+def _rebuild_ec_attempt(base_file_name: str, rs: ReedSolomon, chunk: int,
+                        sidecar: Optional[EciSidecar],
+                        demoted: set[int]) -> list[int]:
+    has_data = [os.path.exists(base_file_name + to_ext(i))
+                and i not in demoted for i in range(rs.total_shards)]
     if sum(has_data) < rs.data_shards:
+        if demoted:
+            raise ShardCorruptError(
+                f"unrepairable: only {sum(has_data)} clean shards after "
+                f"demoting corrupt {sorted(demoted)}", tuple(sorted(demoted)))
         raise ValueError(
             f"unrepairable: only {sum(has_data)} of {rs.total_shards} shards present")
     generated = [i for i in range(rs.total_shards) if not has_data[i]]
     if not generated:
         return []
+    if sidecar is not None:
+        # chunk reads must land on sidecar block boundaries so every
+        # block crc can be checked against exactly its covered bytes
+        bs = sidecar.block_size
+        chunk = max(bs, chunk - chunk % bs)
 
     inputs = {i: open(base_file_name + to_ext(i), "rb")
               for i in range(rs.total_shards) if has_data[i]}
@@ -135,19 +206,34 @@ def rebuild_ec_files(base_file_name: str, rs: Optional[ReedSolomon] = None,
         for f in inputs.values():
             f.close()
         raise
+    if sidecar is not None and sidecar.shard_size != shard_size:
+        # stale sidecar (written for a different geometry): its crcs
+        # describe other bytes — unverifiable, not evidence of rot
+        sidecar = None
     outputs = {i: open(base_file_name + to_ext(i), "wb") for i in generated}
     ok = False
     try:
         with get_tracer().span("ec.rebuild_ec_files", path=base_file_name,
                                missing=len(generated), k=rs.data_shards,
-                               r=rs.parity_shards, backend=rs.engine.name):
+                               r=rs.parity_shards, backend=rs.engine.name,
+                               demoted=len(demoted)):
             offset = 0
             while offset < shard_size:
                 n = min(chunk, shard_size - offset)
                 shards: list[Optional[np.ndarray]] = [None] * rs.total_shards
                 for i, f in inputs.items():
-                    shards[i] = np.frombuffer(
-                        os.pread(f.fileno(), n, offset), dtype=np.uint8)
+                    raw = os.pread(f.fileno(), n, offset)
+                    if len(raw) != n:
+                        raise IOError(
+                            f"short read on shard {i}: {len(raw)} < {n}")
+                    if faultinject._points:
+                        raw = faultinject.corrupt_block(
+                            "ec.shard.corrupt", i, raw, offset)
+                    if sidecar is not None:
+                        bad = sidecar.verify_range(i, offset, raw)
+                        if bad is not None:
+                            raise CorruptSurvivor(i, bad)
+                    shards[i] = np.frombuffer(raw, dtype=np.uint8)
                 rs.reconstruct(shards)
                 for i in generated:
                     outputs[i].write(shards[i].tobytes())
@@ -188,7 +274,14 @@ def write_dat_file(base_file_name: str, dat_file_size: int,
             # hit the exact multiple.
             while remaining >= data_shards * large_block_size:
                 for i in range(data_shards):
-                    dat.write(os.pread(inputs[i].fileno(), large_block_size, positions[i]))
+                    buf = os.pread(inputs[i].fileno(), large_block_size,
+                                   positions[i])
+                    if len(buf) != large_block_size:
+                        # same guard as the small-block loop below: a
+                        # truncated shard must not silently yield a short
+                        # .dat that parses as a smaller volume
+                        raise IOError(f"short read on shard {i}")
+                    dat.write(buf)
                     positions[i] += large_block_size
                     remaining -= large_block_size
             while remaining > 0:

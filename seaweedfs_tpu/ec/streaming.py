@@ -40,6 +40,16 @@ from ..observability import get_tracer
 from ..utils import faultinject
 from ..utils.ioutil import pread_padded, preadv_into
 from .gf256 import mat_invert, mat_mul
+from .integrity import (
+    CorruptSurvivor,
+    EciSidecar,
+    ShardCorruptError,
+    SidecarBuilder,
+    backfill_sidecar,
+    note_corruption,
+    sidecar_path,
+    verify_shard_file,
+)
 from .overlap import WorkerGaveUp, WorkerJobError
 from .layout import (
     DATA_SHARDS_COUNT,
@@ -125,7 +135,9 @@ class StreamingEncoder:
                  zero_copy: bool = True, overlap: str = "auto",
                  tracer=None, drain_timeout_s: float = 30.0,
                  max_worker_restarts: int = 3,
-                 max_encode_retries: int = 2):
+                 max_encode_retries: int = 2,
+                 sidecar: bool = True,
+                 sidecar_block_size: Optional[int] = None):
         """engine: 'auto' uses the jax device path on a real accelerator
         and the host SIMD codec otherwise (jax-on-CPU is a correctness
         surface, ~200x slower than the AVX2 codec); 'device' forces the
@@ -143,7 +155,12 @@ class StreamingEncoder:
         per worker before the encode degrades to the CPU codec;
         max_encode_retries bounds whole-call retries of the staged
         encode, each resuming from the last fully-drained-and-written
-        dispatch checkpoint instead of byte 0."""
+        dispatch checkpoint instead of byte 0.
+
+        sidecar: encodes also write the `.eci` block-crc sidecar
+        (ec/integrity.py) and rebuilds verify survivors against it,
+        demoting crc-mismatching shards to erasures; sidecar_block_size
+        overrides the crc block granularity (default 256KB)."""
         from .codec import ReedSolomon, best_cpu_engine
 
         self.k = data_shards
@@ -172,6 +189,8 @@ class StreamingEncoder:
         self.drain_timeout_s = drain_timeout_s
         self.max_worker_restarts = max_worker_restarts
         self.max_encode_retries = max_encode_retries
+        self._sidecar = sidecar
+        self._sidecar_bs = sidecar_block_size
         self._fb_engine = None  # lazy CPU codec for per-dispatch fallback
         # abandoned (killed, shm kept) workers whose buffers may still
         # back live views; fully closed once the encode call unwinds
@@ -376,7 +395,12 @@ class StreamingEncoder:
         self.stats = {"dispatches": 0, "fill_s": 0.0, "dispatch_s": 0.0,
                       "write_s": 0.0, "drain_wait_s": 0.0, "setup_s": 0.0,
                       "close_s": 0.0, "wall_s": 0.0, "bytes_in": 0,
-                      "retries": 0, "fallbacks": 0, "worker_restarts": 0}
+                      "retries": 0, "fallbacks": 0, "worker_restarts": 0,
+                      # integrity accounting: sidecar_s = crc build time
+                      # on encodes, verify_s = survivor verification on
+                      # rebuilds (bench reads these for the verify-
+                      # overhead figure)
+                      "sidecar_s": 0.0, "verify_s": 0.0}
         self._restart_base = _restart_total()
         return self.stats
 
@@ -413,6 +437,27 @@ class StreamingEncoder:
             except Exception:  # pragma: no cover - already-dead races
                 pass
             self._stale_workers.append(w)
+
+    def _finish_sidecar_backfill(self, out_base: str, st: dict,
+                                 clock) -> None:
+        """Write the `.eci` sidecar after a completed encode whose
+        parity never passed through host buffers (mmap path: the
+        kernel's stores went straight into the output mappings) — one
+        read-back pass over the page-cache-hot shard files.  With
+        sidecars disabled, drop any stale one instead: its table
+        describes the previous encode's bytes and would mass-demote the
+        fresh shards."""
+        t0 = clock()
+        if self._sidecar:
+            with self._tracer().span("ec.sidecar.backfill", path=out_base):
+                backfill_sidecar(out_base, self.k + self.r,
+                                 self._sidecar_bs)
+        else:
+            try:
+                os.remove(sidecar_path(out_base))
+            except OSError:
+                pass
+        st["sidecar_s"] += clock() - t0
 
     def _reap_stale_workers(self) -> None:
         if not self._stale_workers:
@@ -810,6 +855,7 @@ class StreamingEncoder:
                 if in_mv is not None:
                     in_mv.release()
                 del in_arr
+            self._finish_sidecar_backfill(out_base, st, clock)
             ok = True
         finally:
             t0 = clock()
@@ -867,10 +913,13 @@ class StreamingEncoder:
                         # same discipline as encoder.write_ec_files: a
                         # truncated .ecNN surviving a failed encode would
                         # satisfy existence checks and mask the missing
-                        # bytes on the next mount/rebuild
-                        for i in range(self.k + self.r):
+                        # bytes on the next mount/rebuild (the stale
+                        # sidecar goes with them)
+                        for p in [out_base + to_ext(i)
+                                  for i in range(self.k + self.r)] + \
+                                 [sidecar_path(out_base)]:
                             try:
-                                os.remove(out_base + to_ext(i))
+                                os.remove(p)
                             except OSError:
                                 pass
                         raise
@@ -918,6 +967,8 @@ class StreamingEncoder:
         setup = tr.span("pipeline.setup")
         setup.__enter__()
         outputs: list = []
+        sb = SidecarBuilder(k + r, self._sidecar_bs) if self._sidecar \
+            else None
         try:
             for i in range(k + r):
                 p = out_base + to_ext(i)
@@ -927,6 +978,10 @@ class StreamingEncoder:
                     f = open(p, "r+b")
                     f.truncate(start_byte)
                     f.seek(start_byte)
+                    if sb is not None:
+                        # crc state can't roll back through a partial
+                        # block: re-seed from the surviving prefix
+                        sb.seed_from_file(i, f, start_byte)
                 else:
                     f = open(p, "wb")
                 outputs.append(f)
@@ -1035,6 +1090,17 @@ class StreamingEncoder:
             with tr.span("pipeline.write", dispatch=d_idx, kind="parity"):
                 for j in range(r):
                     outputs[k + j].write(memoryview(parity[j, :u]))
+                if sb is not None:
+                    # drain order is FIFO == write order, so each parity
+                    # row's crc stream stays sequential; the crc time
+                    # counts as write stage (per-chunk output post-
+                    # processing — unattributed it would read as missing
+                    # wall in the trace) and is broken out in sidecar_s
+                    # for the bench overhead figure
+                    t1 = clock()
+                    for j in range(r):
+                        sb.update(k + j, parity[j, :u])
+                    st["sidecar_s"] += clock() - t1
             st["write_s"] += clock() - t0
             free.append(bi)
             # dispatch d_idx is fully drained AND written on every shard:
@@ -1143,6 +1209,13 @@ class StreamingEncoder:
                                  kind="data"):
                         for i in range(k):
                             outputs[i].write(memoryview(buf[i, :used]))
+                        if sb is not None:
+                            # crc time rides the write stage (see the
+                            # parity-side note), sidecar_s sub-counts it
+                            t1 = clock()
+                            for i in range(k):
+                                sb.update(i, buf[i, :used])
+                            st["sidecar_s"] += clock() - t1
                     st["write_s"] += clock() - t0
                     pending.append((parity_dev, used, bi, d_idx,
                                     len(fills)))
@@ -1168,6 +1241,15 @@ class StreamingEncoder:
                 flush()
                 while pending:
                     drain_one()
+            if sb is not None:
+                t0 = clock()
+                sb.finalize().save(out_base)
+                st["sidecar_s"] += clock() - t0
+            else:
+                try:  # stale sidecar would mass-demote the fresh shards
+                    os.remove(sidecar_path(out_base))
+                except OSError:
+                    pass
             ok = True
         finally:
             exc = sys.exc_info() if not ok else (None, None, None)
@@ -1191,7 +1273,8 @@ class StreamingEncoder:
 
     def _rebuild_files_mmap(self, base: str, missing: list[int],
                             survivors: list[int], rec: np.ndarray,
-                            matmul_ptrs) -> None:
+                            matmul_ptrs,
+                            sidecar: Optional[EciSidecar] = None) -> None:
         """Zero-copy rebuild: survivors are mmap'd whole files read in
         place by the matmul, and the rebuilt shards are mmap'd OUTPUTS —
         the kernel's stores are the write (fallocate'd first so ENOSPC
@@ -1226,6 +1309,8 @@ class StreamingEncoder:
             for f in in_fs:
                 if os.fstat(f.fileno()).st_size != shard_size:
                     raise ValueError("ec shard size mismatch")
+            if sidecar is not None and sidecar.shard_size != shard_size:
+                sidecar = None  # stale sidecar: unverifiable, not rot
             out_fs = [open(base + to_ext(m), "w+b") for m in missing]
             if shard_size == 0:
                 ok = True
@@ -1256,6 +1341,30 @@ class StreamingEncoder:
             try:
                 for offset in range(0, shard_size, b):
                     n = min(b, shard_size - offset)
+                    if sidecar is not None:
+                        # verify every survivor block BEFORE its bytes
+                        # feed the reconstruction matmul: a mismatch
+                        # aborts this attempt and the caller retries
+                        # with the corrupt shard demoted to an erasure.
+                        # `raw` views the input mapping — it must be
+                        # dropped before raising, or the exception
+                        # frame pins the buffer and in_map.close()
+                        # dies with BufferError in the cleanup path
+                        t0 = clock()
+                        corrupt = None
+                        for row_i, s in enumerate(survivors):
+                            raw = in_arrs[row_i][offset:offset + n]
+                            if faultinject._points:
+                                raw = faultinject.corrupt_block(
+                                    "ec.shard.corrupt", s, raw, offset)
+                            bad = sidecar.verify_range(s, offset, raw)
+                            del raw
+                            if bad is not None:
+                                corrupt = (s, bad)
+                                break
+                        st["verify_s"] += clock() - t0
+                        if corrupt is not None:
+                            raise CorruptSurvivor(*corrupt)
                     t0 = clock()
                     with tr.span("pipeline.compute",
                                  dispatch=st["dispatches"],
@@ -1296,17 +1405,72 @@ class StreamingEncoder:
         """Streaming RebuildEcFiles (ec_encoder.go:61,:233-287): regenerate
         every missing .ecNN from >= data_shards survivors with ONE composed
         [missing, k] reconstruction matmul per chunk (decode submatrix
-        inversion folded with parity re-encode rows)."""
+        inversion folded with parity re-encode rows).
+
+        Survivors are verified against the `.eci` sidecar before their
+        bytes feed the matmul (inline per dispatch when the dispatch
+        width is block-aligned, else one upfront scan); a crc-
+        mismatching survivor is DEMOTED to an erasure and the rebuild
+        retries with an alternate survivor set — which also regenerates
+        the demoted shard.  ShardCorruptError when demotions leave
+        fewer than data_shards clean shards."""
+        sidecar = EciSidecar.load(base_file_name)
+        demoted: set[int] = set()
+        while True:
+            try:
+                return self._rebuild_files_once(base_file_name, sidecar,
+                                                demoted)
+            except CorruptSurvivor as e:
+                demoted.add(e.shard_id)
+                note_corruption("rebuild", e.shard_id, base_file_name,
+                                block=e.block, tracer=self._tracer())
+
+    def _rebuild_files_once(self, base_file_name: str,
+                            sidecar: Optional[EciSidecar],
+                            demoted: set[int]) -> list[int]:
+        """One rebuild attempt against a fixed clean-survivor set."""
         k, r, b = self.k, self.r, self.dispatch_b
         total = k + r
-        has = [os.path.exists(base_file_name + to_ext(i)) for i in range(total)]
+        has = [os.path.exists(base_file_name + to_ext(i))
+               and i not in demoted for i in range(total)]
         if sum(has) < k:
+            if demoted:
+                raise ShardCorruptError(
+                    f"unrepairable: only {sum(has)} clean shards after "
+                    f"demoting corrupt {sorted(demoted)}",
+                    tuple(sorted(demoted)))
             raise ValueError(
                 f"unrepairable: only {sum(has)} of {total} shards present")
         missing = [i for i in range(total) if not has[i]]
         if not missing:
             return []
         survivors = [i for i in range(total) if has[i]][:k]
+        if sidecar is not None and sidecar.shard_size != \
+                os.path.getsize(base_file_name + to_ext(survivors[0])):
+            sidecar = None  # stale sidecar: unverifiable, not rot
+        if sidecar is not None:
+            # present-but-unchosen shards never feed the matmul, so the
+            # inline verify can't see them — scan them here (the CPU
+            # rebuild reads ALL present shards and gets this for free):
+            # a rotted spare is regenerated NOW instead of surfacing at
+            # the next degraded read
+            for s in range(total):
+                if has[s] and s not in survivors:
+                    bad = verify_shard_file(
+                        sidecar, base_file_name + to_ext(s), s)
+                    if bad:
+                        raise CorruptSurvivor(s, bad[0])
+        if sidecar is not None and b % sidecar.block_size:
+            # dispatch chunks don't land on crc-block boundaries, so the
+            # per-dispatch inline verify can't check them — fall back to
+            # one upfront scan of each chosen survivor (still before any
+            # byte is trusted), then rebuild without inline checks
+            for s in survivors:
+                bad = verify_shard_file(sidecar, base_file_name + to_ext(s),
+                                        s)
+                if bad:
+                    raise CorruptSurvivor(s, bad[0])
+            sidecar = None
 
         # decode[k,k]: chosen survivors -> original data shards
         sub = [[int(v) for v in self.matrix[i]] for i in survivors]
@@ -1322,7 +1486,7 @@ class StreamingEncoder:
         matmul_ptrs = self._native_ptrs()
         if matmul_ptrs is not None:
             self._rebuild_files_mmap(base_file_name, missing, survivors,
-                                     rec, matmul_ptrs)
+                                     rec, matmul_ptrs, sidecar)
             return missing
         planes = self._planes(rec)
 
@@ -1340,6 +1504,8 @@ class StreamingEncoder:
             for f in inputs.values():
                 f.close()
             raise
+        if sidecar is not None and sidecar.shard_size != shard_size:
+            sidecar = None  # stale sidecar: unverifiable, not rot
         outputs = {m: open(base_file_name + to_ext(m), "wb")
                    for m in missing}
         bufs = [np.zeros((k, b), dtype=np.uint8)
@@ -1386,6 +1552,21 @@ class StreamingEncoder:
                     if n < b:
                         buf[:, n:] = 0
                 st["fill_s"] += clock() - t0
+                if sidecar is not None:
+                    # verify before dispatch: corrupt bytes must never
+                    # reach the reconstruction matmul (the raised
+                    # CorruptSurvivor aborts the attempt; the caller
+                    # demotes and retries with an alternate survivor)
+                    t0 = clock()
+                    for row_i, s in enumerate(survivors):
+                        raw = buf[row_i, :n]
+                        if faultinject._points:
+                            raw = faultinject.corrupt_block(
+                                "ec.shard.corrupt", s, raw, offset)
+                        bad = sidecar.verify_range(s, offset, raw)
+                        if bad is not None:
+                            raise CorruptSurvivor(s, bad)
+                    st["verify_s"] += clock() - t0
                 t0 = clock()
                 with tr.span("pipeline.dispatch", dispatch=d_idx,
                              bytes=len(survivors) * n):

@@ -54,7 +54,11 @@ DEGRADE_EVENT_NAMES = ("pipeline.retry", "pipeline.fallback")
 # counter keys (ec_pipeline_metrics().totals() / per-call encode stats)
 # whose nonzero value marks the measured path degraded
 DEGRADE_COUNTER_KEYS = ("worker_restarts", "engine_fallbacks",
-                        "retries", "fallbacks")
+                        "retries", "fallbacks",
+                        # bit-rot defense (ec/integrity.py): nonzero
+                        # means some measurement read shards that rotted
+                        # and were demoted or repaired mid-run
+                        "corrupt_shards", "scrub_repairs")
 
 _EPS = 1e-6
 

@@ -9,6 +9,7 @@ server's TPU Pallas codec (the `-ec.engine=tpu` surface from BASELINE.json).
 from __future__ import annotations
 
 from ..ec.layout import TOTAL_SHARDS_COUNT
+from ..utils.httpd import http_json
 from .commands import CommandEnv, command
 
 
@@ -298,6 +299,63 @@ def cmd_ec_balance(env: CommandEnv, flags: dict) -> str:
     if touched:
         _refresh_heartbeats(env, touched)
     return "\n".join(moves) or "already balanced"
+
+
+@command("ec.scrub")
+def cmd_ec_scrub(env: CommandEnv, flags: dict) -> str:
+    """ec.scrub [-server host:port] [-action start|stop|status]
+    [-rate 64] [-interval 0] [-backfill]
+    # drive the volume servers' EC bit-rot scrubbers (/ec/scrub routes):
+    # start launches a paced sidecar-verification scan (rate MB/s,
+    # interval seconds between passes, -backfill adopts pre-sidecar
+    # volumes); corrupt shards are quarantined to .ecNN.bad and
+    # auto-repaired while >= 10 clean shards remain"""
+    action = flags.get("action", "status")
+    if action not in ("start", "stop", "status"):
+        raise ValueError(f"unknown -action {action!r}")
+    if "server" in flags:
+        servers = [flags["server"]]
+    else:
+        servers = [n["Url"] for dc in env.topology()["DataCenters"]
+                   for rack in dc["Racks"] for n in rack["DataNodes"]]
+    if not servers:
+        return "no volume servers registered"
+    lines = []
+    for url in sorted(servers):
+        try:
+            if action == "status":
+                st = http_json("GET", f"http://{url}/ec/scrub/status",
+                               timeout=30)
+            else:
+                body: dict = {}
+                if action == "start":
+                    if "rate" in flags:
+                        body["rate_mb_s"] = float(flags["rate"])
+                    if "interval" in flags:
+                        body["interval_s"] = float(flags["interval"])
+                    if flags.get("backfill") == "true":
+                        body["backfill"] = True
+                st = env.volume_post(url, f"/ec/scrub/{action}", body,
+                                     timeout=30)
+        except Exception as e:  # noqa: BLE001 - per-server audit trail
+            lines.append(f"{url}: ERROR {e}")
+            continue
+        verdicts = st.get("verdicts", {})
+        bad = {v: d for v, d in verdicts.items()
+               if d.get("status") not in ("clean", None)}
+        totals = st.get("totals", {})
+        lines.append(
+            f"{url}: running={st.get('running')} paused={st.get('paused')} "
+            f"passes={st.get('passes')} cursor={st.get('cursor')} "
+            f"volumes={len(verdicts)} "
+            f"blocks={totals.get('scrub_blocks', 0)} "
+            f"corrupt={totals.get('corrupt_shards', 0)} "
+            f"repairs={totals.get('scrub_repairs', 0)}")
+        for v, d in sorted(bad.items()):
+            lines.append(f"  volume {v}: {d.get('status')}"
+                         f" corrupt_shards={d.get('corrupt_shards', [])}"
+                         + (f" error={d['error']}" if d.get("error") else ""))
+    return "\n".join(lines)
 
 
 @command("ec.decode")

@@ -38,11 +38,16 @@ _LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:\\.|[^"\\])*)"')
 _SAMPLE_RE = re.compile(
     r'^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{(.*)\})?\s+(\S+)\s*$')
 
-# the families /cluster/health summarizes per peer
+# the families /cluster/health summarizes per peer; every key feeds the
+# rollup `degraded` flag, so only families whose nonzero value MEANS
+# something went wrong belong here (scrub_blocks, healthy activity,
+# deliberately does not — a scanning scrubber is not a degraded cluster)
 HEALTH_FAMILIES = {
     "worker_restarts": "SeaweedFS_ec_worker_restarts_total",
     "engine_fallbacks": "SeaweedFS_ec_engine_fallbacks_total",
     "degraded_binds": "SeaweedFS_server_degraded_binds_total",
+    "corrupt_shards": "SeaweedFS_ec_corrupt_shards_total",
+    "scrub_repairs": "SeaweedFS_ec_scrub_repairs_total",
 }
 
 

@@ -409,6 +409,42 @@ class ECPipelineMetrics:
         }
 
 
+class ECIntegrityMetrics:
+    """Shard bit-rot defense counters (ec/integrity.py sidecars + the
+    volume server scrubber).  corrupt_shards counts every detection,
+    labeled by WHERE the rot was caught (scrub pass, rebuild survivor
+    verify, or a read-path interval verify); repairs counts the
+    scrubber's quarantine+rebuild outcomes.  All three fold into the
+    master's /cluster/health (stats/aggregate.py HEALTH_FAMILIES) so a
+    repaired-during-bench run can never pass as clean."""
+
+    def __init__(self, registry: Registry = REGISTRY):
+        self.scrub_blocks = registry.counter(
+            "SeaweedFS_ec_scrub_blocks_total",
+            "EC shard blocks verified against .eci sidecars.",
+            labels=("verdict",))
+        self.corrupt_shards = registry.counter(
+            "SeaweedFS_ec_corrupt_shards_total",
+            "Corrupt EC shards detected (sidecar block crc mismatch).",
+            labels=("source",))
+        self.repairs = registry.counter(
+            "SeaweedFS_ec_scrub_repairs_total",
+            "Corrupt EC shards quarantined and rebuilt by the scrubber.",
+            labels=("outcome",))
+
+    def totals(self) -> dict[str, int]:
+        """Label-summed snapshot — the shape /status, the scrub routes,
+        and bench scrub_health consume."""
+        return {
+            "scrub_blocks":
+                int(sum(self.scrub_blocks.snapshot().values())),
+            "corrupt_shards":
+                int(sum(self.corrupt_shards.snapshot().values())),
+            "scrub_repairs":
+                int(sum(self.repairs.snapshot().values())),
+        }
+
+
 _singletons: dict[str, object] = {}
 _singleton_lock = threading.Lock()
 
@@ -438,6 +474,10 @@ def s3_metrics() -> S3Metrics:
 
 def ec_pipeline_metrics() -> ECPipelineMetrics:
     return _singleton("ec_pipeline", ECPipelineMetrics)
+
+
+def ec_integrity_metrics() -> ECIntegrityMetrics:
+    return _singleton("ec_integrity", ECIntegrityMetrics)
 
 
 def start_push_loop(gateway_url: str, job: str,

@@ -220,10 +220,14 @@ def register_debug_routes(router: Router,
         restart/fallback counters ride in as `health`).  ?format=text
         renders the human view the shell's trace.analyze shows."""
         from ..observability import analyze, get_tracer, render_report
-        from ..stats import ec_pipeline_metrics
+        from ..stats import ec_integrity_metrics, ec_pipeline_metrics
 
+        # integrity counters ride along so a run that met (and healed)
+        # shard rot reads DEGRADED even after the ring rotated the
+        # corrupt_shard retry events out
         report = analyze(get_tracer(),
-                         counters=ec_pipeline_metrics().totals())
+                         counters={**ec_pipeline_metrics().totals(),
+                                   **ec_integrity_metrics().totals()})
         if req.query.get("format", "").lower() == "text":
             return Response(raw=render_report(report).encode(),
                             headers={"Content-Type":

@@ -30,6 +30,15 @@ Instrumented sites (grep for fi.hit to find them all):
     ec.dispatch / ec.drain               — streaming pipeline submit and
                                            drain; an injected error forces
                                            a per-dispatch CPU fallback
+    ec.shard.corrupt                     — deterministic bit flip on EC
+                                           shard reads (corrupt_block):
+                                           armed with params
+                                           {"shard": id, "offset": byte,
+                                           "bit": 0-7}, any read of that
+                                           shard covering that byte comes
+                                           back flipped — the bit-rot
+                                           drill behind the sidecar
+                                           verify-on-use paths
 
 The ec.* points fire in the ENCODING PARENT only: overlap workers are
 spawned processes with their own (empty) fault registry, so arming a
@@ -52,9 +61,12 @@ _counts: dict[str, int] = {}
 
 def enable(name: str, error_rate: float = 0.0,
            error: Optional[BaseException] = None,
-           delay: float = 0.0, max_hits: int = 0) -> None:
+           delay: float = 0.0, max_hits: int = 0,
+           params: Optional[dict] = None) -> None:
     """Arm a fault point.  error_rate in [0,1]; max_hits>0 auto-disarms
-    after that many injected faults (deterministic crash tests)."""
+    after that many injected faults (deterministic crash tests).
+    params carries site-specific fault data for data-mutation points
+    (ec.shard.corrupt's shard/offset/bit targeting)."""
     with _lock:
         _points[name] = {
             "error_rate": error_rate,
@@ -62,6 +74,7 @@ def enable(name: str, error_rate: float = 0.0,
             "delay": delay,
             "max_hits": max_hits,
             "hits": 0,
+            "params": dict(params) if params else None,
         }
 
 
@@ -111,3 +124,38 @@ def hit(name: str) -> None:
         time.sleep(delay)
     if err is not None:
         raise err
+
+
+def corrupt_block(name: str, shard_id: int, data, file_offset: int = 0):
+    """Data-mutation fault (ec.shard.corrupt): deterministically flip
+    one bit in a shard read.  Armed with
+    ``enable(name, params={"shard": id, "offset": byte, "bit": 0-7})``,
+    any read of `shard_id` whose [file_offset, file_offset+len) range
+    covers `offset` comes back with that bit flipped — exactly what
+    on-media bit rot looks like to the reader.  Returns `data` untouched
+    when unarmed or out of range; counts a hit only when it flips.
+    Accepts bytes or a 1-D uint8 ndarray (flipped in place when
+    writable, else on a copy)."""
+    if not _points:
+        return data
+    with _lock:
+        p = _points.get(name)
+        prm = p.get("params") if p is not None else None
+        if not prm or int(prm.get("shard", -1)) != shard_id:
+            return data
+        if p["max_hits"] and p["hits"] >= p["max_hits"]:
+            return data
+        target = int(prm.get("offset", 0))
+        if not (file_offset <= target < file_offset + len(data)):
+            return data
+        p["hits"] += 1
+        _counts[name] = _counts.get(name, 0) + 1
+        bit = int(prm.get("bit", 0)) & 7
+    rel = target - file_offset
+    if isinstance(data, (bytes, bytearray, memoryview)):
+        buf = bytearray(data)
+        buf[rel] ^= 1 << bit
+        return bytes(buf)
+    arr = data if getattr(data.flags, "writeable", False) else data.copy()
+    arr[rel] ^= 1 << bit
+    return arr

@@ -123,6 +123,17 @@ class VolumeServer:
         # scraper sees the series (at 0) before the first restart or
         # fallback ever happens
         ec_pipeline_metrics()
+        from ..stats import ec_integrity_metrics
+
+        ec_integrity_metrics()
+        # EC bit-rot scrubber (scrubber.py): idle until /ec/scrub/start
+        # (or weed shell ec.scrub); pauses itself while request traffic
+        # is high
+        from .scrubber import EcScrubber
+
+        self._req_sample = (0.0, time.monotonic())
+        self._req_busy = False
+        self.scrubber = EcScrubber(self.store, busy_fn=self._scrub_busy)
         self.metrics.max_volume_counter.set(max_volume_count)
         self.router = Router("volume", metrics=self.metrics)
         self._register_routes()
@@ -142,6 +153,24 @@ class VolumeServer:
     @property
     def url(self) -> str:
         return f"{self.store.ip}:{self.store.port}"
+
+    def _scrub_busy(self) -> bool:
+        """Scrubber load gate: True while this server is taking real
+        request traffic (> ~50 req/s since the last sample), so scan IO
+        never competes with the serving path."""
+        prev_total, prev_t = self._req_sample
+        now = time.monotonic()
+        dt = now - prev_t
+        if dt < 0.5:
+            # the scrubber polls per 256KB block (every few ms at the
+            # default rate); a rate computed over a ms-scale window turns
+            # one stray request into ">250 req/s" — hold the last verdict
+            # until a meaningful sample window has elapsed
+            return self._req_busy
+        total = sum(self.metrics.request_counter.snapshot().values())
+        self._req_sample = (total, now)
+        self._req_busy = (total - prev_total) / dt > 50.0
+        return self._req_busy
 
     # --- lifecycle --------------------------------------------------------
     def start(self) -> "VolumeServer":
@@ -192,6 +221,7 @@ class VolumeServer:
 
     def stop(self) -> None:
         self._stop.set()
+        self.scrubber.stop(join_timeout=0.5)
         if self._tcp_server is not None:
             self._tcp_server.stop()
         if self._server:
@@ -503,6 +533,19 @@ class VolumeServer:
                 # still completed byte-identical, but perf numbers from
                 # this server may reflect degraded runs
                 "EcPipeline": ec_pipeline_metrics().totals(),
+            }
+            from ..stats import ec_integrity_metrics
+
+            # bit-rot defense: nonzero corrupt_shards means sidecar
+            # verification demoted shards somewhere on this server
+            doc["EcIntegrity"] = ec_integrity_metrics().totals()
+            scrub_st = self.scrubber.status()  # locked verdict snapshot
+            doc["EcScrub"] = {
+                "running": scrub_st["running"],
+                "passes": scrub_st["passes"],
+                "cursor": scrub_st["cursor"],
+                "verdicts": {v: d.get("status", "?")
+                             for v, d in scrub_st["verdicts"].items()},
             }
             plane = self.store.native_plane
             if plane is not None:
@@ -1146,6 +1189,36 @@ class VolumeServer:
                     "engine_fallbacks":
                         now["engine_fallbacks"] - before["engine_fallbacks"]}
 
+        # --- EC bit-rot scrubber (scrubber.py) -------------------------
+        @r.route("POST", "/ec/scrub/start")
+        def ec_scrub_start(req: Request) -> Response:
+            """Launch (or re-launch) the background scan.  Body knobs:
+            rate_mb_s (IO cap, 0 unthrottled), interval_s (0 = one
+            pass then stop, >0 = loop), backfill (compute sidecars for
+            pre-sidecar shard sets)."""
+            try:
+                b = req.json()
+            except Exception:
+                b = {}
+            started = self.scrubber.start(
+                rate_mb_s=(float(b["rate_mb_s"])
+                           if "rate_mb_s" in b else None),
+                interval_s=(float(b["interval_s"])
+                            if "interval_s" in b else None),
+                backfill=(bool(b["backfill"]) if "backfill" in b else None))
+            return Response({"started": started, **self.scrubber.status()})
+
+        @r.route("POST", "/ec/scrub/stop")
+        def ec_scrub_stop(req: Request) -> Response:
+            """Stop the scan; the cursor survives, so the next start
+            resumes from the same (volume, shard)."""
+            self.scrubber.stop()
+            return Response(self.scrubber.status())
+
+        @r.route("GET", "/ec/scrub/status")
+        def ec_scrub_status(req: Request) -> Response:
+            return Response(self.scrubber.status())
+
         @r.route("POST", "/admin/ec/generate")
         def ec_generate(req: Request) -> Response:
             b = req.json()
@@ -1178,6 +1251,10 @@ class VolumeServer:
                 exts.append(".ecx")
             if b.get("copy_ecj_file", True):
                 exts.append(".ecj")
+            # the block-crc sidecar travels with the shards so the
+            # destination can verify-on-use and scrub them; absence is
+            # fine (pre-sidecar volume — backfill can adopt it later)
+            exts.append(".eci")
             from ..utils.httpd import http_download
 
             for ext in exts:
@@ -1185,7 +1262,7 @@ class VolumeServer:
                     "GET", f"http://{source}/admin/ec/download?volume_id={vid}"
                            f"&collection={collection}&ext={ext}",
                     base + ext, timeout=3600)
-                if status != 200 and ext not in (".ecj",):  # no journal is ok
+                if status != 200 and ext not in (".ecj", ".eci"):
                     raise HttpError(500, f"copy {ext} from {source}: {status}")
             return Response({})
 

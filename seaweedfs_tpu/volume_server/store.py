@@ -19,6 +19,7 @@ import numpy as np
 
 from ..ec.codec import ReedSolomon, best_cpu_engine
 from ..ec.ec_volume import EcVolume, NeedleNotFoundError
+from ..ec.integrity import ShardCorruptError
 from ..ec.layout import to_ext
 from ..ec import encoder as ec_encoder
 from ..storage.needle import Needle
@@ -772,9 +773,12 @@ class Store:
             if os.path.exists(p):
                 os.remove(p)
         if not glob.glob(base + ".ec[0-9][0-9]"):
-            for ext in (".ecx", ".ecj"):
-                if os.path.exists(base + ext):
-                    os.remove(base + ext)
+            # last shard gone: drop the index, journal, crc sidecar, and
+            # any quarantined .bad evidence files with it
+            for path in [base + ext for ext in (".ecx", ".ecj", ".eci")] \
+                    + glob.glob(base + ".ec[0-9][0-9].bad"):
+                if os.path.exists(path):
+                    os.remove(path)
         elif was_mounted:
             self.ec_mount(vid, collection)
 
@@ -804,9 +808,17 @@ class Store:
             shard_id, shard_offset = iv.to_shard_id_and_offset(
                 ev.large_block_size, ev.small_block_size, ev.data_shards)
             piece = None
-            if shard_id in ev.shards:
+            if shard_id in ev.shards and shard_id not in ev.corrupt_shards:
                 try:
-                    piece = ev.shards[shard_id].read_at(iv.size, shard_offset)
+                    # sidecar-verified read (ec/integrity.py): a crc
+                    # mismatch demotes the shard for the whole mount and
+                    # self-heals below via remote fetch / reconstruction
+                    # instead of serving rotted bytes
+                    piece = ev._verified_read(shard_id, shard_offset,
+                                              iv.size).tobytes()
+                except ShardCorruptError:
+                    ev._note_corrupt(shard_id)
+                    piece = None
                 except OSError:
                     # bad sector/dying disk: treat the shard as absent and
                     # self-heal through the degraded-read paths below

@@ -118,7 +118,7 @@ class TestAggregatorUnit:
         assert st["has_data"] is False
         assert agg.health()["peers"]["x:1"]["pipeline_health"] == {
             "worker_restarts": 0, "engine_fallbacks": 0,
-            "degraded_binds": 0}
+            "degraded_binds": 0, "corrupt_shards": 0, "scrub_repairs": 0}
 
     def test_unregistered_peer_drops_out(self):
         peers = ["a:1", "b:2"]
@@ -209,7 +209,9 @@ class TestClusterEndpoints:
         assert doc["peer_count"] == 2
         assert set(doc["totals"]) == {"worker_restarts",
                                       "engine_fallbacks",
-                                      "degraded_binds"}
+                                      "degraded_binds",
+                                      "corrupt_shards",
+                                      "scrub_repairs"}
         for vs in servers:
             peer = doc["peers"][vs.url]
             assert peer["up"] is True and peer["stale"] is False
