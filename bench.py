@@ -54,6 +54,7 @@ SECTION_CAPS = {
     "multi_decode": 240, "batched_needles": 120, "rebuild": 180,
     "transfer": 90, "e2e_stream": 600, "e2e_rebuild": 300,
     "e2e_decode_8gb": 420, "roofline": 90, "cluster": 360,
+    "cluster_traced": 300,
     "cluster_native": 360, "cluster_scaled": 420, "parity": 120,
     "integrity": 120, "pipeline_health": 15,
 }
@@ -888,21 +889,26 @@ def _child(scratch_path: str, platform: str = "") -> None:
         return p
 
     @contextlib.contextmanager
-    def spawn_cluster(n_vols, extra_vol_args=()):
+    def spawn_cluster(n_vols, extra_vol_args=(), trace_sample=None):
         """Master + n_vols volume servers as separate processes; yields
-        (master_port, scratch_root) once an assign succeeds."""
+        (master_port, scratch_root) once an assign succeeds.
+        trace_sample enables distributed tracing in every server process
+        at that head-sampling rate (the -trace.sample global flag)."""
         import urllib.request
 
         root = _tempfile.mkdtemp()
         mport = _free_port()
+        globals_ = (["-trace.sample", str(trace_sample)]
+                    if trace_sample is not None else [])
         procs = [subprocess.Popen(
-            [sys.executable, weed_py, "master", "-port", str(mport)],
+            [sys.executable, weed_py, *globals_, "master",
+             "-port", str(mport)],
             env=cluster_env, stdout=subprocess.DEVNULL,
             stderr=subprocess.DEVNULL)]
         try:
             for i in range(n_vols):
                 procs.append(subprocess.Popen(
-                    [sys.executable, weed_py, "volume",
+                    [sys.executable, weed_py, *globals_, "volume",
                      "-dir", os.path.join(root, f"v{i}"),
                      "-port", str(_free_port()),
                      "-mserver", f"127.0.0.1:{mport}", "-max", "16",
@@ -970,6 +976,69 @@ def _child(scratch_path: str, platform: str = "") -> None:
             detail["cluster_tcp_read_rps"] = tcp_rates.get("read", 0.0)
 
     section("cluster", meas_cluster)
+
+    # --- distributed tracing: sampling cost + one stitched trace ----------
+    def meas_cluster_traced():
+        """Same single-server shape with distributed tracing ON at 1%
+        head sampling (PR 6): (a) HTTP read rps against the untraced
+        cluster section — the acceptance bar is < 3% regression — and
+        (b) one force-sampled cross-server write whose stitched trace is
+        fetched back from the master's collector and attributed
+        (bounding hop, network-vs-server split), embedded as proof the
+        pipeline works end to end in real multi-process clusters."""
+        import urllib.request
+
+        with spawn_cluster(1, trace_sample="0.01") as (mport, _root):
+            rates = run_bench(mport, 4000, use_tcp=False)
+            detail["cluster_traced_write_rps"] = rates.get("write", 0.0)
+            detail["cluster_traced_read_rps"] = rates.get("read", 0.0)
+            base = detail.get("cluster_read_rps") or 0.0
+            if base:
+                detail["trace_sampling_read_overhead_pct"] = round(
+                    100.0 * (1.0 - rates.get("read", 0.0) / base), 2)
+
+            # one forced-sample distributed write: master /submit fans
+            # out assign + volume upload, so the stitched trace crosses
+            # processes; poll the collector for it (shippers flush on a
+            # short interval)
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{mport}/submit",
+                data=b"trace-me" * 128, method="POST",
+                headers={"X-Force-Trace": "1",
+                         "Content-Type": "application/octet-stream"})
+            with urllib.request.urlopen(req, timeout=30) as r:
+                trace_id = r.headers.get("X-Trace-Id", "")
+            block = {"trace_id": trace_id}
+            deadline = time.time() + 8
+            while time.time() < deadline:
+                try:
+                    with urllib.request.urlopen(
+                            f"http://127.0.0.1:{mport}/cluster/traces/"
+                            f"{trace_id}", timeout=5) as r:
+                        doc = json.loads(r.read())
+                except OSError:
+                    doc = None
+                if doc and any(
+                        s["name"].startswith("http.volume.")
+                        for s in doc.get("spans", [])):
+                    an = doc["analysis"]
+                    block.update({
+                        "span_count": doc["span_count"],
+                        "servers": doc["servers"],
+                        "wall_s": an["wall_s"],
+                        "network_s": an["network_s"],
+                        "server_s": an["server_s"],
+                        "bounding_hop": an["bounding_hop"],
+                        "degraded": an["degraded"],
+                        "summary": an["summary"],
+                    })
+                    break
+                time.sleep(0.2)
+            else:
+                block["error"] = "stitched trace never reached collector"
+            detail["cluster_trace"] = block
+
+    section("cluster_traced", meas_cluster_traced)
 
     # --- native C++ data plane (GIL-free needle IO) -------------------------
     def meas_cluster_native():

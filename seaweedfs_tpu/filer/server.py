@@ -109,6 +109,7 @@ class FilerServer:
             mem_limit=chunk_cache_mem_mb * 1024 * 1024,
             disk_dir=chunk_cache_dir)
         self.router = Router("filer", metrics=self.metrics)
+        self.router.server_url = self.url
         self._tls_context = tls_context
         self._register_routes()
         self._server = None
@@ -185,10 +186,24 @@ class FilerServer:
     def start(self) -> "FilerServer":
         self._server = serve(self.router, self.host, self.port,
                              tls_context=self._tls_context)
+        # ship sampled spans to the master's trace collector so
+        # gateway -> filer -> volume fan-outs stitch into one trace;
+        # the whole configured master list goes in — the shipper
+        # rotates on failure and follower masters forward to the
+        # leader, so the filer needs no leader tracking of its own
+        from ..observability import get_tracer
+        from ..observability.collector import TraceShipper
+
+        self._trace_shipper = TraceShipper(
+            get_tracer(), server=self.url,
+            master_url_fn=lambda: self.master_url)
+        self._trace_shipper.attach()
         self.meta_aggregator.start()
         return self
 
     def stop(self) -> None:
+        if getattr(self, "_trace_shipper", None) is not None:
+            self._trace_shipper.detach()
         self.meta_aggregator.stop()
         if self._server:
             from ..utils.httpd import stop_server
@@ -378,12 +393,23 @@ class FilerServer:
         else:
             # chunks live on different volume servers: fetch them in
             # parallel (filer/stream.go drives ChunkViews concurrently);
-            # each worker writes a disjoint slice of `out`
+            # each worker writes a disjoint slice of `out`.  The request
+            # thread's trace context rides onto the pool threads (with
+            # the open request span as parent) so every chunk fetch
+            # shows as an rpc.client hop on the stitched trace.
             import concurrent.futures
+
+            from ..observability import context as _trace_context
+
+            ctx = _trace_context.fork_for_thread()
+
+            def traced_fill(view):
+                with _trace_context.scope(ctx):
+                    return _trap(fill, view)
 
             with concurrent.futures.ThreadPoolExecutor(
                     min(8, len(plan))) as ex:
-                for err in ex.map(lambda v: _trap(fill, v), plan):
+                for err in ex.map(traced_fill, plan):
                     if err is not None:
                         raise err
         return bytes(out)
@@ -441,7 +467,12 @@ class FilerServer:
         def metrics(req: Request) -> Response:
             from ..stats import REGISTRY
 
-            return Response(raw=REGISTRY.expose().encode(), headers={
+            from ..stats.metrics import exemplars_requested
+
+            return Response(
+                raw=REGISTRY.expose(
+                    exemplars=exemplars_requested(req)).encode(),
+                headers={
                 "Content-Type": "text/plain; version=0.0.4; charset=utf-8"})
 
         from ..utils.debug import register_debug_routes

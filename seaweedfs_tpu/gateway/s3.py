@@ -62,6 +62,7 @@ class S3ApiServer:
 
         self.metrics = s3_metrics()
         self.router = Router("s3", metrics=self.metrics)
+        self.router.server_url = self.url
         self.router.error_handler = self._map_error
         self._register_routes()
         self._server = None

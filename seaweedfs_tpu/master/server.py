@@ -68,6 +68,19 @@ class MasterServer:
         self.metrics_aggregation_seconds = metrics_aggregation_seconds
         self.aggregator = ClusterAggregator(
             peers_fn=lambda: [n.url for n in self.topo.all_nodes()])
+        # distributed-trace collector: volume servers / filers ship the
+        # spans of sampled traces here (observability/collector.py); the
+        # master stitches them into one cluster trace per trace id,
+        # served at GET /cluster/traces/<id>.  The master's own spans
+        # take the local short-circuit instead of HTTP-shipping to
+        # themselves.
+        from ..observability import get_tracer
+        from ..observability.collector import TraceCollector, TraceShipper
+
+        self.trace_collector = TraceCollector()
+        self._trace_shipper = TraceShipper(
+            get_tracer(), server=self.url,
+            local_collector=self.trace_collector)
         from .consensus import RaftNode
 
         self.raft = RaftNode(
@@ -79,6 +92,7 @@ class MasterServer:
         self.raft.on_role_change = lambda role: \
             self.metrics.leader_gauge.set(1 if role == "leader" else 0)
         self.router = Router("master", metrics=self.metrics)
+        self.router.server_url = self.url
         self._register_routes()
         self._server = None
         self._tcp_server = None
@@ -109,6 +123,7 @@ class MasterServer:
     def start(self) -> "MasterServer":
         self._server = serve(self.router, self.host, self.port,
                              tls_context=self._tls_context)
+        self._trace_shipper.attach()
         # framed-TCP assign front (op 'A'): the write hot loop does one
         # assign per file, and HTTP parsing caps it; leader-only — a
         # follower refuses so clients fall back to HTTP redirects
@@ -165,6 +180,7 @@ class MasterServer:
 
     def stop(self) -> None:
         self._stop.set()
+        self._trace_shipper.detach()
         self.aggregator.stop_loop()
         if self._tcp_server is not None:
             self._tcp_server.stop()
@@ -414,8 +430,74 @@ class MasterServer:
             """Per-volume-server pipeline health (worker restarts,
             engine fallbacks, degraded binds) + reachability, with
             cluster totals and a rollup degraded flag."""
-            self.aggregator.scrape()
+            self.aggregator.scrape(include_scrub=True)
             return Response(self.aggregator.health())
+
+        @r.route("POST", "/cluster/traces/ingest")
+        def cluster_traces_ingest(req: Request) -> Response:
+            """Span-shipping sink (observability/collector.py
+            TraceShipper): volume servers and filers batch-POST the
+            spans of sampled traces; the collector stitches them by
+            trace id.  Servers may ship to ANY reachable master —
+            convergence on one collector happens here: a follower
+            forwards to the raft leader (proxyToLeader), so a filer
+            pinned to a follower lands in the same stitched trace as
+            the volume servers following the heartbeat leader.  With
+            no leader elected the POST fails and the shipper's
+            per-trace loss accounting marks the trace truncated."""
+            if not self.is_leader:
+                if not self.raft.leader or self.raft.leader == self.url:
+                    raise HttpError(503, "no leader elected yet; retry")
+                return self._proxy_to_leader(req)
+            b = req.json()
+            accepted = self.trace_collector.ingest(
+                str(b.get("server") or ""), b.get("spans") or [],
+                lost=b.get("lost") or {})
+            return Response({"accepted": accepted})
+
+        @r.route("GET", "/cluster/traces")
+        def cluster_traces_index(req: Request) -> Response:
+            """Most-recent-first index of stitched traces: id, root span,
+            participating servers, wall seconds.  Leader-only (ingest
+            converges there); follower fetches redirect."""
+            self._require_leader(req)
+            limit = min(int(req.query.get("limit") or 64), 256)
+            return Response(
+                {"traces": self.trace_collector.summaries(limit=limit)})
+
+        @r.route("GET", r"/cluster/traces/([0-9a-f]{32})")
+        def cluster_trace_get(req: Request) -> Response:
+            """One stitched cluster trace + its cross-server analysis:
+            per-hop occupancy, network-vs-server split, the bounding
+            hop, and a degraded verdict folding in every participating
+            server's pipeline counters.  ?format=chrome renders the
+            Chrome trace-event view (per-server process tracks) for
+            ui.perfetto.dev instead.  Leader-only, like the index."""
+            self._require_leader(req)
+            trace_id = req.match.group(1)
+            if req.query.get("format", "").lower() == "chrome":
+                doc = self.trace_collector.chrome(trace_id)
+                if doc is None:
+                    raise HttpError(404, f"trace {trace_id} not collected")
+                return Response(doc)
+            doc = self.trace_collector.get(trace_id)
+            if doc is None:
+                raise HttpError(404, f"trace {trace_id} not collected")
+            # participating servers' health counters feed the verdict:
+            # a rebuild that healed corruption on a remote peer reads
+            # DEGRADED even though its spans look clean
+            health: dict = {}
+            try:
+                self.aggregator.scrape()
+                for url, peer in self.aggregator.health()["peers"].items():
+                    if url in doc["servers"]:
+                        health[url] = peer.get("pipeline_health") or {}
+            except Exception:
+                pass  # health is best-effort garnish, never a 500
+            from ..observability import analyze_cluster
+
+            doc["analysis"] = analyze_cluster(doc, health=health)
+            return Response(doc)
 
         @r.route("GET", "/cluster/watch")
         def cluster_watch(req: Request) -> Response:
@@ -432,7 +514,12 @@ class MasterServer:
         def metrics(req: Request) -> Response:
             from ..stats import REGISTRY
 
-            return Response(raw=REGISTRY.expose().encode(), headers={
+            from ..stats.metrics import exemplars_requested
+
+            return Response(
+                raw=REGISTRY.expose(
+                    exemplars=exemplars_requested(req)).encode(),
+                headers={
                 "Content-Type": "text/plain; version=0.0.4; charset=utf-8"})
 
         @r.route("POST", "/heartbeat")

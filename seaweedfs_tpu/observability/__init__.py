@@ -18,11 +18,18 @@ spans is the sampling profiler's job (profiler.py, GET /debug/profile,
 bench --profile-out).
 """
 
-from .analysis import analyze, attribution_summary, render_report
+from .analysis import (analyze, analyze_cluster, attribution_summary,
+                       render_cluster_report, render_report)
+from .collector import TraceCollector, TraceShipper
+from .context import (ingress_context, inject_trace_headers,
+                      sample_rate, set_sample_rate)
 from .profiler import SamplingProfiler, profile_collapsed
 from .tracer import (Span, Tracer, disable_tracing, enable_tracing,
                      get_tracer)
 
 __all__ = ["Span", "Tracer", "get_tracer", "enable_tracing",
-           "disable_tracing", "analyze", "attribution_summary",
-           "render_report", "SamplingProfiler", "profile_collapsed"]
+           "disable_tracing", "analyze", "analyze_cluster",
+           "attribution_summary", "render_report",
+           "render_cluster_report", "TraceCollector", "TraceShipper",
+           "ingress_context", "inject_trace_headers", "sample_rate",
+           "set_sample_rate", "SamplingProfiler", "profile_collapsed"]

@@ -84,6 +84,10 @@ def _normalize(trace) -> list[dict]:
                       "t1": float(sp["t1"]), "id": sp.get("id"),
                       "parent": sp.get("parent"),
                       "tid": sp.get("tid", 0),
+                      # cross-server identity: the shipping server's url
+                      # (collector-stitched docs), falling back to the
+                      # recording process's namespace
+                      "server": sp.get("server") or sp.get("pid"),
                       "attrs": dict(sp.get("attrs") or {})})
     spans.sort(key=lambda s: s["t0"])
     return spans
@@ -258,6 +262,22 @@ def _analyze_run(root: dict, members: list[dict],
     return report
 
 
+def _dropped_of(trace) -> int:
+    """Span-loss accounting for the input: a live Tracer's ring-eviction
+    counter, or the `dropped` field a to_dict()/collector document
+    carries.  Surfaced on every report so a truncated trace cannot
+    masquerade as a complete one."""
+    if hasattr(trace, "dropped"):
+        return int(trace.dropped)
+    if isinstance(trace, dict):
+        try:
+            return int(trace.get("dropped")
+                       or trace.get("spansDropped") or 0)
+        except (TypeError, ValueError):
+            return 0
+    return 0
+
+
 def analyze(trace, counters: Optional[dict] = None,
             max_path_items: int = 48) -> dict:
     """Trace (live Tracer, span list, to_dict() doc, or Chrome doc) ->
@@ -265,6 +285,7 @@ def analyze(trace, counters: Optional[dict] = None,
     totals dict (ec_pipeline_metrics().totals() or per-call encode
     stats); nonzero values mark the report degraded even when the
     ring has already rotated the retry spans out."""
+    spans_dropped = _dropped_of(trace)
     spans = _normalize(trace)
     roots = [s for s in spans if s["name"] in ROOT_NAMES]
     runs = []
@@ -296,7 +317,268 @@ def analyze(trace, counters: Optional[dict] = None,
         degraded = True
     return {"span_count": len(spans), "runs": runs,
             "degraded": degraded, "retry_spans": retry_n,
-            "fallback_spans": fallback_n, "health": health}
+            "fallback_spans": fallback_n, "health": health,
+            "spans_dropped": spans_dropped}
+
+
+# --- cross-server (cluster) analysis -----------------------------------------
+# Input: a stitched trace document from the master's TraceCollector
+# (observability/collector.py) — spans from every participating server,
+# joined by trace id, with parent edges crossing process boundaries via
+# the Traceparent header.  Output: per-hop occupancy, the network-vs-
+# server time split, the cluster critical path naming the bounding hop,
+# and a degraded verdict folding in every participating server's
+# pipeline counters.
+
+# outbound-hop span name (utils/httpd.py client helpers)
+RPC_CLIENT = "rpc.client"
+
+
+def _self_time(span: dict, children: list[dict]) -> float:
+    """Duration minus time covered by child spans (merged intervals,
+    clipped to the parent) — the seconds this span itself is
+    responsible for."""
+    t0, t1 = span["t0"], span["t1"]
+    ivs = sorted((max(c["t0"], t0), min(c["t1"], t1)) for c in children)
+    covered = 0.0
+    cur0 = cur1 = None
+    for a, b in ivs:
+        if b <= a:
+            continue
+        if cur0 is None:
+            cur0, cur1 = a, b
+        elif a <= cur1:
+            cur1 = max(cur1, b)
+        else:
+            covered += cur1 - cur0
+            cur0, cur1 = a, b
+    if cur0 is not None:
+        covered += cur1 - cur0
+    return max(0.0, (t1 - t0) - covered)
+
+
+def _resolve_hop(sp: dict, kids: list[dict]) -> tuple[list[dict], str, str]:
+    """Name an rpc.client span's far side: (remote children, to-server,
+    remote op).  Prefers child spans recorded on a DIFFERENT server (the
+    stitched request span); a hop whose remote never shipped its spans
+    falls back to the client-side peer/path attrs.  Single source of
+    truth for the hops table and the bounding-hop name — they must
+    never attribute the same span to different servers."""
+    remote = [c for c in kids if c.get("server") != sp.get("server")] \
+        or kids
+    attrs = sp.get("attrs") or {}
+    to = remote[0].get("server") if remote else attrs.get("peer", "?")
+    op = remote[0]["name"] if remote else str(attrs.get("path", "?"))
+    return remote, to, op
+
+
+def analyze_cluster(doc, health: Optional[dict] = None,
+                    max_path_items: int = 32) -> dict:
+    """Stitched cluster trace -> cross-server attribution report.
+
+    `health` maps participating server url -> its pipeline_health
+    counters (the master's aggregator view); any nonzero degrade
+    counter on a PARTICIPATING server flips the verdict, so a rebuild
+    that quietly demoted a corrupt survivor on a remote peer reads
+    DEGRADED even though every span looks clean."""
+    spans_dropped = _dropped_of(doc)
+    trace_id = doc.get("trace_id") if isinstance(doc, dict) else None
+    spans = _normalize(doc)
+    by_id = {s["id"]: s for s in spans if s.get("id")}
+    children: dict[str, list[dict]] = {}
+    roots: list[dict] = []
+    for s in spans:
+        par = s.get("parent")
+        if par and par in by_id:
+            children.setdefault(par, []).append(s)
+        else:
+            roots.append(s)
+    servers = sorted({s["server"] for s in spans if s.get("server")})
+
+    if not spans:
+        # keep every key render_cluster_report() indexes — a trace whose
+        # only content is a shipper loss ledger must still render (as a
+        # truncation warning), not KeyError
+        return {"trace_id": trace_id, "span_count": 0, "servers": [],
+                "wall_s": 0.0, "root": None, "per_server": {}, "hops": [],
+                "network_s": 0.0, "server_s": {}, "unattributed_s": 0.0,
+                "critical_path": [], "bounding_hop": None,
+                "degrade_events": 0, "error_spans": 0,
+                "degraded": False, "degraded_servers": [],
+                "health": dict(health or {}),
+                "spans_dropped": spans_dropped,
+                "summary": "empty trace"}
+
+    wall_t0 = min(s["t0"] for s in spans)
+    wall_t1 = max(s["t1"] for s in spans)
+    wall = max(wall_t1 - wall_t0, _EPS)
+
+    # per-server occupancy: each span's SELF time (children subtracted)
+    # summed by server, so nested spans never double-count and time
+    # spent waiting on a remote hop lands on the rpc.client span, not
+    # the server that was waiting
+    server_s: dict[str, float] = {}
+    network_s = 0.0
+    for s in spans:
+        own = _self_time(s, children.get(s.get("id"), []))
+        if s["name"] == RPC_CLIENT:
+            # the caller-side slice of a hop not covered by the remote
+            # server's recorded request span = wire + connect + queue
+            network_s += own
+        else:
+            key = s.get("server") or "?"
+            server_s[key] = server_s.get(key, 0.0) + own
+
+    per_server = {}
+    for srv in servers or ["?"]:
+        busy = server_s.get(srv, 0.0)
+        n = sum(1 for s in spans if s.get("server") == srv)
+        per_server[srv] = {"spans": n, "busy_s": round(busy, 4),
+                           "share": round(busy / wall, 4)}
+
+    # hops: every rpc.client span, aggregated by (from, to, remote op)
+    hops: dict[tuple, dict] = {}
+    for s in spans:
+        if s["name"] != RPC_CLIENT:
+            continue
+        remote, to, op = _resolve_hop(s, children.get(s.get("id"), []))
+        key = (s.get("server") or "?", to or "?", op)
+        row = hops.setdefault(key, {"from": key[0], "to": key[1],
+                                    "op": op, "calls": 0,
+                                    "client_s": 0.0, "server_s": 0.0,
+                                    "network_s": 0.0})
+        dur = s["t1"] - s["t0"]
+        srv_covered = sum(c["t1"] - c["t0"] for c in remote)
+        row["calls"] += 1
+        row["client_s"] += dur
+        row["server_s"] += min(srv_covered, dur)
+        row["network_s"] += max(0.0, dur - srv_covered)
+    hop_rows = sorted(hops.values(), key=lambda r: -r["client_s"])
+    for row in hop_rows:
+        for k in ("client_s", "server_s", "network_s"):
+            row[k] = round(row[k], 4)
+
+    # cluster critical path: from the earliest root, keep descending
+    # into the child subtree that ends last (the one the parent's exit
+    # actually waited for), recording each step's server + self time
+    root = min(roots, key=lambda s: s["t0"]) if roots else spans[0]
+    path: list[dict] = []
+    cur = root
+    seen: set[str] = set()
+    while cur is not None and len(path) < max_path_items:
+        sid = cur.get("id")
+        if sid in seen:
+            break  # defensive: a cyclic parent edge must not hang us
+        seen.add(sid or f"@{len(path)}")
+        kids = children.get(sid, [])
+        path.append({"server": cur.get("server") or "?",
+                     "name": cur["name"],
+                     "s": round(_self_time(cur, kids), 4),
+                     "span_id": sid})
+        cur = max(kids, key=lambda c: c["t1"]) if kids else None
+
+    # the bounding hop: the rpc.client on the critical path holding the
+    # most wall time; with no hop on the path, the path step with the
+    # largest self time bounds the trace
+    path_rpcs = [p for p in path if p["name"] == RPC_CLIENT]
+    if path_rpcs:
+        worst = max(path_rpcs, key=lambda p: p["s"])
+        sp = by_id.get(worst["span_id"]) or {}
+        _, to, op = _resolve_hop(sp, children.get(worst["span_id"], []))
+        dur = (sp.get("t1", 0.0) - sp.get("t0", 0.0)) if sp else worst["s"]
+        bounding = {"kind": "hop", "from": worst["server"], "to": to,
+                    "op": op, "s": round(dur, 4),
+                    "network_s": worst["s"]}
+    elif path:
+        worst = max(path, key=lambda p: p["s"])
+        bounding = {"kind": "local", "server": worst["server"],
+                    "op": worst["name"], "s": worst["s"]}
+    else:
+        bounding = None
+
+    # degraded verdict: in-trace recovery events, error-tagged spans,
+    # or nonzero degrade counters on ANY participating server
+    degrade_events = sum(1 for s in spans
+                         if s["name"] in DEGRADE_EVENT_NAMES)
+    errors = sum(1 for s in spans if s["attrs"].get("error"))
+    health = dict(health or {})
+    degraded_servers = sorted(
+        srv for srv, counters in health.items()
+        if any(float((counters or {}).get(k) or 0) > 0
+               for k in DEGRADE_COUNTER_KEYS))
+    degraded = bool(degrade_events or errors or degraded_servers)
+
+    total_attr = sum(server_s.values()) + network_s
+    summary_bits = []
+    if bounding is not None:
+        if bounding["kind"] == "hop":
+            summary_bits.append(
+                f"bounding hop {bounding['from']} -> {bounding['to']} "
+                f"{bounding['op']} ({bounding['s']}s, "
+                f"{bounding['network_s']}s network)")
+        else:
+            summary_bits.append(
+                f"bounded locally by {bounding['op']} on "
+                f"{bounding['server']} ({bounding['s']}s)")
+    summary_bits.append(
+        f"network {round(network_s, 4)}s vs server "
+        f"{round(sum(server_s.values()), 4)}s over {wall:.4f}s wall")
+    summary_bits.append("DEGRADED" if degraded else "clean")
+    return {
+        "trace_id": trace_id,
+        "span_count": len(spans),
+        "servers": servers,
+        "wall_s": round(wall, 4),
+        "root": {"name": root["name"],
+                 "server": root.get("server") or "?"},
+        "per_server": per_server,
+        "hops": hop_rows,
+        "network_s": round(network_s, 4),
+        "server_s": {k: round(v, 4) for k, v in sorted(server_s.items())},
+        "unattributed_s": round(max(0.0, wall - total_attr), 4),
+        "critical_path": path,
+        "bounding_hop": bounding,
+        "degrade_events": degrade_events,
+        "error_spans": errors,
+        "degraded": degraded,
+        "degraded_servers": degraded_servers,
+        "health": health,
+        "spans_dropped": spans_dropped,
+        "summary": "; ".join(summary_bits),
+    }
+
+
+def render_cluster_report(report: dict) -> str:
+    """Human rendering of analyze_cluster() (`weed shell trace.fetch`)."""
+    lines = [f"trace {report.get('trace_id')}: "
+             f"{report['span_count']} spans across "
+             f"{len(report['servers'])} server(s), "
+             f"wall {report['wall_s']}s — "
+             f"{'DEGRADED' if report['degraded'] else 'clean'}"]
+    if report.get("spans_dropped"):
+        lines.append(f"WARNING: {report['spans_dropped']} spans dropped — "
+                     "stitched trace is INCOMPLETE")
+    lines.append(f"  {report['summary']}")
+    for srv, row in sorted(report["per_server"].items(),
+                           key=lambda kv: -kv[1]["busy_s"]):
+        bar = "#" * int(round(40 * row["share"]))
+        lines.append(f"  {srv:<22} {row['busy_s']:>9.3f}s "
+                     f"{100 * row['share']:5.1f}% "
+                     f"({row['spans']} spans) {bar}")
+    if report["hops"]:
+        lines.append("  hops (client / server / network seconds):")
+        for h in report["hops"][:12]:
+            lines.append(f"    {h['from']} -> {h['to']} {h['op']} x"
+                         f"{h['calls']}: {h['client_s']} / "
+                         f"{h['server_s']} / {h['network_s']}")
+    if report["critical_path"]:
+        steps = " -> ".join(f"{p['server']}:{p['name']}"
+                            for p in report["critical_path"][:10])
+        lines.append(f"  critical path: {steps}")
+    if report["degraded_servers"]:
+        lines.append("  degraded servers: "
+                     + ", ".join(report["degraded_servers"]))
+    return "\n".join(lines) + "\n"
 
 
 def attribution_summary(report: dict) -> dict:
@@ -323,6 +605,10 @@ def render_report(report: dict) -> str:
              f"degraded: {report['degraded']}  "
              f"(retry spans: {report['retry_spans']}, "
              f"fallback spans: {report['fallback_spans']})"]
+    if report.get("spans_dropped"):
+        lines.append(f"WARNING: {report['spans_dropped']} spans dropped "
+                     "(ring eviction / ship loss) — this trace is "
+                     "TRUNCATED, attribution may under-count")
     health = report.get("health") or {}
     if health:
         lines.append("health counters: " + ", ".join(

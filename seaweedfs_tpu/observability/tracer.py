@@ -35,7 +35,9 @@ import os
 import threading
 import time
 from collections import deque
-from typing import Optional
+from typing import Callable, Optional
+
+from . import context as _trace_context
 
 # wall-anchored monotonic clock: perf_counter() gives monotonic intervals,
 # the captured offset maps them onto the unix epoch so timestamps from
@@ -48,14 +50,20 @@ def _now() -> float:
 
 
 class Span:
-    """One finished span: wall-anchored [t0, t1) plus identity/attrs."""
+    """One finished span: wall-anchored [t0, t1) plus identity/attrs.
+    `trace_id` is set when the span was recorded under a sampled
+    distributed-trace context (observability/context.py) — it is the
+    stitching key the master-side collector groups cross-server spans
+    by; locally minted spans outside any request carry None."""
 
     __slots__ = ("name", "span_id", "parent_id", "pid", "tid",
-                 "thread", "t0", "t1", "attrs")
+                 "thread", "t0", "t1", "attrs", "trace_id", "server")
 
     def __init__(self, name: str, span_id: str, parent_id: Optional[str],
                  pid: str, tid: int, thread: str,
-                 t0: float, t1: float, attrs: dict):
+                 t0: float, t1: float, attrs: dict,
+                 trace_id: Optional[str] = None,
+                 server: Optional[str] = None):
         self.name = name
         self.span_id = span_id
         self.parent_id = parent_id
@@ -65,6 +73,13 @@ class Span:
         self.t0 = t0
         self.t1 = t1
         self.attrs = attrs
+        self.trace_id = trace_id
+        # the server (host:port) whose request produced this span,
+        # stamped at record time from the Router's thread-local
+        # (context.swap_server) so co-located servers sharing one
+        # process tracer still attribute per-span; None = recorded
+        # outside any request (the shipper's identity stands in then)
+        self.server = server
 
     @property
     def duration(self) -> float:
@@ -72,10 +87,15 @@ class Span:
 
     def to_dict(self) -> dict:
         """Serializable log entry (export_log/ingest_log wire format)."""
-        return {"name": self.name, "id": self.span_id,
-                "parent": self.parent_id, "pid": self.pid, "tid": self.tid,
-                "thread": self.thread, "t0": self.t0, "t1": self.t1,
-                "attrs": self.attrs}
+        d = {"name": self.name, "id": self.span_id,
+             "parent": self.parent_id, "pid": self.pid, "tid": self.tid,
+             "thread": self.thread, "t0": self.t0, "t1": self.t1,
+             "attrs": self.attrs}
+        if self.trace_id:
+            d["trace"] = self.trace_id
+        if self.server:
+            d["server"] = self.server
+        return d
 
 
 class _NoopSpan:
@@ -117,7 +137,15 @@ class _SpanCtx:
         stack = getattr(tr._stack, "ids", None)
         if stack is None:
             stack = tr._stack.ids = []
-        self.parent_id = stack[-1] if stack else None
+        if stack:
+            self.parent_id = stack[-1]
+        else:
+            # first span of this thread's request: re-root under the
+            # caller's span id carried in by the trace context, so a
+            # downstream server's request span nests below the upstream
+            # rpc.client span when the collector stitches them
+            ctx = _trace_context.current_sampled()
+            self.parent_id = (ctx.span_id or None) if ctx else None
         self.span_id = tr._next_id()
         stack.append(self.span_id)
         self.t0 = _now()
@@ -132,8 +160,11 @@ class _SpanCtx:
         if exc_type is not None:
             self.attrs["error"] = exc_type.__name__
         ct = threading.current_thread()
+        ctx = _trace_context.current_sampled()
         sp = Span(self.name, self.span_id, self.parent_id, tr.namespace,
-                  ct.ident or 0, ct.name, self.t0, t1, self.attrs)
+                  ct.ident or 0, ct.name, self.t0, t1, self.attrs,
+                  trace_id=ctx.trace_id if ctx else None,
+                  server=_trace_context.current_server())
         tr._record(sp)
         return False
 
@@ -148,8 +179,22 @@ class Tracer:
         self._seq = 0
         self._stack = threading.local()
         self.enabled = enabled
-        self.namespace = namespace or f"p{os.getpid():x}"
+        # pid alone collides across hosts (containerized servers are all
+        # pid 1) and the collector dedups by span id, so a bare-pid
+        # namespace would silently discard one server's spans from every
+        # stitched trace — salt with random bytes to make ids unique
+        # cluster-wide while staying stable within this tracer.  No "-"
+        # in the salt: span ids ride the dash-delimited Traceparent
+        # header as the parent field
+        self.namespace = namespace or (
+            f"p{os.getpid():x}x{os.urandom(3).hex()}")
         self._hist = _span_histogram() if prometheus else None
+        # ring-eviction accounting: a bounded deque evicts silently, so a
+        # truncated trace would masquerade as a complete one without this
+        self.dropped = 0
+        # trace shipping hook (observability/collector.py TraceShipper):
+        # called with every recorded span that carries a trace_id
+        self.on_record: Optional[Callable[[Span], None]] = None
 
     # --- recording --------------------------------------------------------
     @property
@@ -159,8 +204,11 @@ class Tracer:
     def span(self, name: str, **attrs):
         """Context manager for a timed span.  Disabled tracers hand back
         a shared no-op — the hot-path cost of dormant instrumentation is
-        one attribute check."""
-        if not self.enabled:
+        one attribute check.  A thread whose ingress decided NOT to
+        sample (head-based sampling, observability/context.py) also gets
+        the no-op: at 1% sampling, 99% of requests pay one thread-local
+        read here instead of span allocation + ring append."""
+        if not self.enabled or _trace_context.is_not_sampled():
             return _NOOP
         return _SpanCtx(self, name, attrs)
 
@@ -172,13 +220,16 @@ class Tracer:
         the overlap worker's compute window shipped back in its ack).
         `tid` places the span on its own thread track (defaults to the
         calling thread)."""
-        if not self.enabled:
+        if not self.enabled or _trace_context.is_not_sampled():
             return None
         span_id = self._next_id()
         ct = threading.current_thread()
+        ctx = _trace_context.current_sampled()
         self._record(Span(name, span_id, parent_id, self.namespace,
                           tid if tid is not None else (ct.ident or 0),
-                          thread or ct.name, t0, t1, attrs))
+                          thread or ct.name, t0, t1, attrs,
+                          trace_id=ctx.trace_id if ctx else None,
+                          server=_trace_context.current_server()))
         return span_id
 
     def event(self, name: str, **attrs) -> Optional[str]:
@@ -189,6 +240,12 @@ class Tracer:
         t = _now()
         return self.add_span(name, t, t, **attrs)
 
+    def current_span_id(self) -> Optional[str]:
+        """The calling thread's innermost OPEN span id — the parent a
+        cross-server hop stamps into its outbound Traceparent header."""
+        stack = getattr(self._stack, "ids", None)
+        return stack[-1] if stack else None
+
     def _next_id(self) -> str:
         with self._lock:
             self._seq += 1
@@ -196,29 +253,56 @@ class Tracer:
 
     def _record(self, sp: Span) -> None:
         with self._lock:
+            if len(self._spans) == self._spans.maxlen:
+                self.dropped += 1
+                counted_drop = True
+            else:
+                counted_drop = False
             self._spans.append(sp)
+        if counted_drop:
+            # counted regardless of the Prometheus bridge: the shared
+            # registry counter must never diverge from Tracer.dropped
+            _dropped_counter().inc("ring_evict")
         if self._hist is not None:
             self._hist.observe(sp.name, sp.t1 - sp.t0)
+        hook = self.on_record
+        if hook is not None and sp.trace_id:
+            try:
+                hook(sp)
+            except Exception:
+                pass  # shipping must never break the traced operation
 
     def attach_prometheus(self) -> None:
         """Bridge span durations into the shared stats REGISTRY so stage
         latencies appear on every server's /metrics."""
         self._hist = _span_histogram()
+        # pre-touch EVERY drop reason so scrapers see all series at 0
+        # before the first loss — an absent series breaks rate()/absent()
+        # dashboards exactly when the first incident needs them
+        c = _dropped_counter()
+        for reason in ("ring_evict", "ship_buffer", "ship_error",
+                       "collector_cap", "collector_evict"):
+            c.labels(reason)
 
     # --- inspection -------------------------------------------------------
     def snapshot(self, clear: bool = False) -> list[Span]:
         """Point-in-time copy; clear=True drains ATOMICALLY so a
         poll-and-clear capture loop never drops spans recorded between
-        the read and the clear."""
+        the read and the clear.  Draining also re-baselines `dropped`:
+        it counts losses from the CURRENT ring contents, so a complete
+        capture taken after a clear must not inherit an old overflow's
+        TRUNCATED verdict (the Prometheus counter stays cumulative)."""
         with self._lock:
             spans = list(self._spans)
             if clear:
                 self._spans.clear()
+                self.dropped = 0
             return spans
 
     def clear(self) -> None:
         with self._lock:
             self._spans.clear()
+            self.dropped = 0
 
     # --- cross-process merge ----------------------------------------------
     def export_log(self) -> list[dict]:
@@ -246,8 +330,13 @@ class Tracer:
             spans.append(Span(e["name"], sid, par or parent_id, pid_ns,
                               int(e.get("tid", 0)), e.get("thread", ""),
                               float(e["t0"]), float(e["t1"]),
-                              dict(e.get("attrs") or {})))
+                              dict(e.get("attrs") or {}),
+                              trace_id=e.get("trace"),
+                              server=e.get("server")))
         with self._lock:
+            overflow = max(0, len(self._spans) + len(spans)
+                           - (self._spans.maxlen or 0))
+            self.dropped += overflow
             self._spans.extend(spans)
         if self._hist is not None:
             for sp in spans:
@@ -262,6 +351,7 @@ class Tracer:
         return {"format": "seaweedfs-tpu-trace-v1",
                 "namespace": self.namespace,
                 "capacity": self.capacity,
+                "dropped": self.dropped,
                 "spans": self.export_log()}
 
     @classmethod
@@ -277,14 +367,19 @@ class Tracer:
         return tr
 
     # --- Chrome trace-event export ----------------------------------------
-    def to_chrome(self, clear: bool = False) -> dict:
+    def to_chrome(self, clear: bool = False,
+                  spans: Optional[list[Span]] = None) -> dict:
         """{"traceEvents": [...]} loadable in chrome://tracing/Perfetto.
         Spans become "X" (complete) events; process/thread metadata rides
         "M" events.  ts is strictly increasing per (pid, tid) — ties are
         nudged by 1ns so downstream tooling never sees a zero-width
         reordering ambiguity.  clear=True drains the ring atomically with
-        the read (the /debug/traces?clear=1 contract)."""
-        spans = self.snapshot(clear=clear)
+        the read (the /debug/traces?clear=1 contract).  `spans` renders a
+        pre-filtered subset (the ?trace_id=/?root= debug filters) instead
+        of the whole ring; clear is ignored then — filtering must never
+        drain spans the caller did not see."""
+        if spans is None:
+            spans = self.snapshot(clear=clear)
         if not spans:
             return {"traceEvents": [], "displayTimeUnit": "ms"}
         base = min(sp.t0 for sp in spans)
@@ -310,6 +405,8 @@ class Tracer:
             args["span_id"] = sp.span_id
             if sp.parent_id:
                 args["parent_id"] = sp.parent_id
+            if sp.trace_id:
+                args["trace_id"] = sp.trace_id
             events.append({"name": sp.name, "ph": "X",
                            "ts": (sp.t0 - base) * 1e6,
                            "dur": max((sp.t1 - sp.t0) * 1e6, 1e-3),
@@ -331,6 +428,24 @@ class Tracer:
 
 _span_hist = None
 _span_hist_lock = threading.Lock()
+_dropped = None
+
+
+def _dropped_counter():
+    """SeaweedFS_trace_spans_dropped_total{reason}: spans lost to the
+    bounded ring (ring_evict) or the collector ship buffer
+    (ship_buffer/ship_error) — the accounting that keeps a truncated
+    trace from masquerading as a complete one."""
+    global _dropped
+    with _span_hist_lock:
+        if _dropped is None:
+            from ..stats import REGISTRY
+
+            _dropped = REGISTRY.counter(
+                "SeaweedFS_trace_spans_dropped_total",
+                "Trace spans dropped before analysis/shipping.",
+                labels=("reason",))
+        return _dropped
 
 
 def _span_histogram():

@@ -66,7 +66,20 @@ class Replicator:
                     and (self._in_scope(old["full_path"])
                          or self._in_scope(new["full_path"]))):
                 return False
-        return self.replicate_op(op, old, new)
+        # the replication tailer is a background loop with no HTTP
+        # ingress, so each applied event is its own distributed-trace
+        # ingress (rate-gated head sampling): the source-filer content
+        # fetch and every sink write ride ONE trace id, stitched on the
+        # master like any request fan-out
+        from ..observability import context as _trace_context
+        from ..observability import get_tracer
+
+        tracer = get_tracer()
+        if not tracer.enabled:
+            return self.replicate_op(op, old, new)
+        with _trace_context.scope(_trace_context.ingress_context(None)):
+            with tracer.span("replicate.event", op=op, path=path):
+                return self.replicate_op(op, old, new)
 
     def _content_or_none(self, entry: dict) -> tuple[Optional[bytes], bool]:
         """(data, gone): fetch file content; gone=True when the source

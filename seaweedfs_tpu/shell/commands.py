@@ -32,6 +32,14 @@ class CommandEnv:
         self.filer_url = filer_url
         self.master = MasterClient(master_url)
         self.admin_token: Optional[int] = None
+        # trace id of the last run_command invocation: every shell
+        # command is a force-sampled distributed-trace root, so the
+        # operator can trace.fetch what a command did across servers.
+        # prev_trace_id holds the command BEFORE that — trace.fetch's
+        # own ingress overwrites last_trace_id before its handler runs,
+        # so a bare `trace.fetch` defaults to prev_trace_id
+        self.last_trace_id = ""
+        self.prev_trace_id = ""
 
     # --- master helpers ---------------------------------------------------
     def master_get(self, path: str) -> dict:
@@ -68,7 +76,7 @@ class CommandEnv:
 
 # flags that never take a value (so `fs.rm -r /path` keeps /path positional)
 BOOL_FLAGS = {"r", "rf", "l", "f", "force", "writable", "readonly", "apply",
-              "recursive", "v", "json", "backfill"}
+              "recursive", "v", "json", "backfill", "all", "chrome"}
 
 
 def parse_flags(args: list[str]) -> dict[str, str]:
@@ -105,7 +113,26 @@ def run_command(env: CommandEnv, line: str) -> object:
     fn = COMMANDS.get(name)
     if fn is None:
         raise KeyError(f"unknown command {name!r}; try `help`")
-    return fn(env, parse_flags(args))
+    # every shell command is a distributed-trace ingress, FORCE-sampled:
+    # operator commands are rare, and the head decision propagates via
+    # the Traceparent header so every server the command fans out to
+    # records its spans — trace.fetch on env.last_trace_id shows the
+    # whole cross-server operation
+    from ..observability import context as _trace_context
+    from ..observability import get_tracer
+
+    ctx = _trace_context.TraceContext(_trace_context.new_trace_id())
+    prev = _trace_context.activate(ctx)
+    env.prev_trace_id = env.last_trace_id
+    env.last_trace_id = ctx.trace_id
+    try:
+        tracer = get_tracer()
+        if tracer.enabled:
+            with tracer.span(f"shell.{name}"):
+                return fn(env, parse_flags(args))
+        return fn(env, parse_flags(args))
+    finally:
+        _trace_context.activate(prev)
 
 
 def repl(master_url: str, filer_url: str = "") -> None:
@@ -122,6 +149,18 @@ def repl(master_url: str, filer_url: str = "") -> None:
             out = run_command(env, line)
             if out is not None:
                 print(out)
+            # surface the command's force-sampled trace id so the
+            # documented follow-up — `trace.fetch` (bare, or with this
+            # id) — is typable without guessing from trace.fetch -list.
+            # Gated on the shell's tracer being enabled (-trace.sample/
+            # WEED_TRACE_SAMPLE): with tracing off everywhere nothing is
+            # collected, and the hint would only advertise a 404
+            from ..observability import get_tracer as _get_tracer
+
+            if (env.last_trace_id and _get_tracer().enabled
+                    and not line.strip().startswith("trace.")):
+                print(f"[trace {env.last_trace_id} — `trace.fetch` "
+                      "shows the cluster view]")
         except (HttpError, RuntimeError, KeyError, ValueError) as e:
             print(f"error: {e}")
     env.unlock()

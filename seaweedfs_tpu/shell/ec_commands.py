@@ -301,15 +301,113 @@ def cmd_ec_balance(env: CommandEnv, flags: dict) -> str:
     return "\n".join(moves) or "already balanced"
 
 
+def _scrub_start_body(flags: dict) -> dict:
+    body: dict = {}
+    if "rate" in flags:
+        body["rate_mb_s"] = float(flags["rate"])
+    if "interval" in flags:
+        body["interval_s"] = float(flags["interval"])
+    if flags.get("backfill") == "true":
+        body["backfill"] = True
+    return body
+
+
+def _scrub_all(env: CommandEnv, flags: dict) -> str:
+    """ec.scrub -all: kick off ONE scrub pass on every heartbeat-
+    registered volume server, poll them to completion, and roll the
+    verdicts up — the cluster-wide answer PR 5 left as a per-server
+    chore.  The per-server verdict detail also lands in the master's
+    /cluster/health (scrub block per peer), which this rollup
+    cross-checks at the end."""
+    import time as _time
+
+    servers = sorted(n["Url"] for dc in env.topology()["DataCenters"]
+                     for rack in dc["Racks"] for n in rack["DataNodes"])
+    if not servers:
+        return "no volume servers registered"
+    body = _scrub_start_body(flags)
+    body.setdefault("interval_s", 0.0)  # one pass then stop
+    lines = []
+    started: list[str] = []
+    failed: list[str] = []
+    for url in servers:
+        try:
+            env.volume_post(url, "/ec/scrub/start", body, timeout=30)
+            started.append(url)
+        except Exception as e:  # noqa: BLE001 - per-server audit trail
+            failed.append(url)
+            lines.append(f"{url}: START FAILED {e}")
+    deadline = _time.monotonic() + float(flags.get("timeout", "300"))
+    pending = set(started)
+    statuses: dict[str, dict] = {}
+    while pending and _time.monotonic() < deadline:
+        for url in sorted(pending):
+            try:
+                st = http_json("GET", f"http://{url}/ec/scrub/status",
+                               timeout=30)
+            except Exception:
+                continue  # transient: poll again until the deadline
+            statuses[url] = st
+            if not st.get("running"):
+                pending.discard(url)
+        if pending:
+            _time.sleep(0.25)
+    totals = {"volumes": 0, "corrupt": 0, "repairs": 0, "unrepairable": 0}
+    for url in started:
+        st = statuses.get(url, {})
+        verdicts = st.get("verdicts", {})
+        t = st.get("totals", {})
+        unrep = sum(1 for d in verdicts.values()
+                    if (d or {}).get("status") == "unrepairable")
+        totals["volumes"] += len(verdicts)
+        totals["corrupt"] += int(t.get("corrupt_shards", 0))
+        totals["repairs"] += int(t.get("scrub_repairs", 0))
+        totals["unrepairable"] += unrep
+        state = "TIMED OUT (still running)" if url in pending else "done"
+        lines.append(f"{url}: {state} volumes={len(verdicts)} "
+                     f"corrupt={t.get('corrupt_shards', 0)} "
+                     f"repairs={t.get('scrub_repairs', 0)} "
+                     f"unrepairable={unrep}")
+        for v, d in sorted(verdicts.items()):
+            if (d or {}).get("status") not in ("clean", None):
+                lines.append(f"  volume {v}: {d.get('status')}"
+                             f" corrupt_shards={d.get('corrupt_shards', [])}")
+    # an unreachable server is UNVERIFIED — its shards may be rotting;
+    # a partial scrub must never read as a clean cluster
+    verdict = "DEGRADED" if (totals["unrepairable"] or pending
+                             or failed) else (
+        "repaired" if totals["repairs"] else "clean")
+    if failed:
+        verdict += f" (UNVERIFIED: {len(failed)} server(s) not scrubbed)"
+    lines.insert(0, f"cluster scrub: {verdict} — "
+                    f"{len(started)}/{len(servers)} servers, "
+                    f"{totals['volumes']} volumes, "
+                    f"corrupt={totals['corrupt']} "
+                    f"repairs={totals['repairs']} "
+                    f"unrepairable={totals['unrepairable']}")
+    try:
+        health = env.master_get("/cluster/health")
+        lines.append(f"/cluster/health: degraded={health['degraded']} "
+                     f"scrub_unrepairable="
+                     f"{health['totals'].get('scrub_unrepairable', 0)}")
+    except Exception as e:  # noqa: BLE001 - rollup still stands alone
+        lines.append(f"/cluster/health: unavailable ({e})")
+    return "\n".join(lines)
+
+
 @command("ec.scrub")
 def cmd_ec_scrub(env: CommandEnv, flags: dict) -> str:
-    """ec.scrub [-server host:port] [-action start|stop|status]
-    [-rate 64] [-interval 0] [-backfill]
+    """ec.scrub [-all [-timeout 300]] [-server host:port]
+    [-action start|stop|status] [-rate 64] [-interval 0] [-backfill]
     # drive the volume servers' EC bit-rot scrubbers (/ec/scrub routes):
     # start launches a paced sidecar-verification scan (rate MB/s,
     # interval seconds between passes, -backfill adopts pre-sidecar
     # volumes); corrupt shards are quarantined to .ecNN.bad and
-    # auto-repaired while >= 10 clean shards remain"""
+    # auto-repaired while >= 10 clean shards remain.  -all kicks off one
+    # pass on EVERY heartbeat-registered server, polls to completion,
+    # and rolls the verdicts up (cross-checked against /cluster/health)"""
+    if flags.get("all") == "true":
+        return _scrub_all(env, flags)
     action = flags.get("action", "status")
     if action not in ("start", "stop", "status"):
         raise ValueError(f"unknown -action {action!r}")

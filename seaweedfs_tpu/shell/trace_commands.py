@@ -49,6 +49,45 @@ def cmd_trace_analyze(env: CommandEnv, flags: dict) -> str:
     return render_report(report).rstrip("\n")
 
 
+@command("trace.fetch")
+def cmd_trace_fetch(env: CommandEnv, flags: dict) -> str:
+    """trace.fetch <trace_id> | -trace <trace_id> [-json]
+    [-chrome [-out file.json]] | -list
+    # fetch one stitched cluster trace from the master's collector and
+    # render the cross-server analysis (per-hop occupancy, network-vs-
+    # server split, bounding hop, degraded verdict); -chrome saves the
+    # Chrome trace-event view instead; -list shows recent trace ids.
+    # A bare `trace.fetch` defaults to the PREVIOUS command's trace id
+    # (env.prev_trace_id — the repl prints it after each command), so
+    # the "what did that command do across the cluster?" follow-up
+    # needs no copy-paste."""
+    if flags.get("list") == "true" or flags.get("") == "list":
+        doc = env.master_get("/cluster/traces")
+        lines = []
+        for t in doc.get("traces", []):
+            lines.append(f"{t['trace_id']}  root={t.get('root')} "
+                         f"spans={t['span_count']} wall={t['wall_s']}s "
+                         f"servers={','.join(t['servers'])}")
+        return "\n".join(lines) or "no traces collected"
+    trace_id = (flags.get("trace") or flags.get("")
+                or getattr(env, "prev_trace_id", "") or "")
+    if not trace_id:
+        raise ValueError("trace.fetch needs a trace id — run a command "
+                         "first, pass one, or -list recent ones")
+    if flags.get("chrome") == "true":
+        doc = env.master_get(f"/cluster/traces/{trace_id}?format=chrome")
+        out = flags.get("out") or f"trace_{trace_id[:8]}.json"
+        with open(out, "w") as f:
+            json.dump(doc, f)
+        return f"wrote {out} ({len(doc.get('traceEvents', []))} events)"
+    doc = env.master_get(f"/cluster/traces/{trace_id}")
+    if flags.get("json") == "true":
+        return json.dumps(doc, indent=2)
+    from ..observability.analysis import render_cluster_report
+
+    return render_cluster_report(doc["analysis"]).rstrip("\n")
+
+
 @command("cluster.health")
 def cmd_cluster_health(env: CommandEnv, flags: dict) -> str:
     """cluster.health [-json]  # master's per-volume-server telemetry
@@ -62,15 +101,23 @@ def cmd_cluster_health(env: CommandEnv, flags: dict) -> str:
     t = doc["totals"]
     lines.append(f"totals: worker_restarts={t['worker_restarts']} "
                  f"engine_fallbacks={t['engine_fallbacks']} "
-                 f"degraded_binds={t['degraded_binds']}")
+                 f"degraded_binds={t['degraded_binds']} "
+                 f"scrub_unrepairable={t.get('scrub_unrepairable', 0)}")
     for url, p in sorted(doc["peers"].items()):
         ph = p["pipeline_health"]
         state = "up" if p["up"] else f"DOWN ({p.get('error', '')})"
         if p["stale"]:
             state += " STALE"
-        lines.append(
-            f"  {url}: {state} age={p.get('age_s')}s "
-            f"restarts={ph['worker_restarts']} "
-            f"fallbacks={ph['engine_fallbacks']} "
-            f"degraded_binds={ph['degraded_binds']}")
+        line = (f"  {url}: {state} age={p.get('age_s')}s "
+                f"restarts={ph['worker_restarts']} "
+                f"fallbacks={ph['engine_fallbacks']} "
+                f"degraded_binds={ph['degraded_binds']}")
+        scrub = p.get("scrub")
+        if scrub:
+            verdicts = ",".join(f"{k}={v}" for k, v
+                                in sorted(scrub["verdicts"].items())) \
+                or "none"
+            line += (f" scrub[running={scrub['running']} "
+                     f"passes={scrub['passes']} {verdicts}]")
+        lines.append(line)
     return "\n".join(lines)

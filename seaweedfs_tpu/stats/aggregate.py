@@ -37,6 +37,10 @@ from .metrics import Counter, Gauge, Histogram
 _LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:\\.|[^"\\])*)"')
 _SAMPLE_RE = re.compile(
     r'^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{(.*)\})?\s+(\S+)\s*$')
+# OpenMetrics exemplar suffix (` # {trace_id="…"} value [ts]`) on bucket
+# lines: stripped before sample parsing so exemplar-bearing peers still
+# merge exactly
+_EXEMPLAR_RE = re.compile(r'\s+#\s+\{[^}]*\}\s+\S+(\s+\S+)?\s*$')
 
 # the families /cluster/health summarizes per peer; every key feeds the
 # rollup `degraded` flag, so only families whose nonzero value MEANS
@@ -83,7 +87,9 @@ def parse_prometheus_text(text: str) -> dict[str, object]:
             continue
         mo = _SAMPLE_RE.match(line)
         if not mo:
-            continue
+            mo = _SAMPLE_RE.match(_EXEMPLAR_RE.sub("", line))
+            if not mo:
+                continue
         name, _, raw_labels, raw_value = mo.groups()
         try:
             value = float(raw_value)
@@ -196,13 +202,17 @@ def merge_families(into: dict[str, object],
 
 
 class _PeerState:
-    __slots__ = ("families", "scraped_at", "up", "error")
+    __slots__ = ("families", "scraped_at", "up", "error", "scrub")
 
     def __init__(self):
         self.families: Optional[dict] = None
         self.scraped_at = 0.0
         self.up = False
         self.error = ""
+        # last /ec/scrub/status document (None = never fetched / peer
+        # has no scrubber) — the per-server verdict rollup for
+        # /cluster/health
+        self.scrub: Optional[dict] = None
 
 
 class ClusterAggregator:
@@ -211,6 +221,8 @@ class ClusterAggregator:
 
     def __init__(self, peers_fn: Callable[[], list[str]],
                  fetch: Optional[Callable[[str], str]] = None,
+                 scrub_fetch: Optional[Callable[[str],
+                                               Optional[dict]]] = None,
                  min_interval: float = 2.0, stale_after: float = 30.0,
                  timeout: float = 2.0):
         self.peers_fn = peers_fn
@@ -218,9 +230,18 @@ class ClusterAggregator:
         self.stale_after = stale_after
         self.timeout = timeout
         self._fetch = fetch or self._http_fetch
+        if scrub_fetch is not None:
+            self._scrub_fetch = scrub_fetch
+        elif fetch is not None:
+            # a custom metrics fetch (tests, embeddings) gets no implicit
+            # HTTP side channel for scrub state
+            self._scrub_fetch = lambda url: None
+        else:
+            self._scrub_fetch = self._http_scrub_fetch
         self._peers: dict[str, _PeerState] = {}
         self._lock = threading.Lock()
         self._last_scrape = 0.0
+        self._last_scrub_scrape = 0.0
         self._stop: Optional[threading.Event] = None
 
     def _http_fetch(self, url: str) -> str:
@@ -234,16 +255,43 @@ class ClusterAggregator:
                 f"{body[:120].decode(errors='replace')}")
         return body.decode(errors="replace")
 
+    def _http_scrub_fetch(self, url: str) -> Optional[dict]:
+        """Per-server scrub verdicts for the /cluster/health rollup.
+        Best-effort: a peer without the scrub surface (or mid-restart)
+        just reports no scrub state, never an error."""
+        import json as _json
+
+        from ..utils.httpd import http_bytes
+
+        status, body, _ = http_bytes("GET", f"http://{url}/ec/scrub/status",
+                                     timeout=self.timeout)
+        if status != 200:
+            return None
+        try:
+            return _json.loads(body)
+        except ValueError:
+            return None
+
     # --- scraping ---------------------------------------------------------
-    def scrape(self, force: bool = False) -> None:
+    def scrape(self, force: bool = False,
+               include_scrub: bool = False) -> None:
         """Scrape every registered peer in parallel.  Rate-limited by
         min_interval unless forced, so the on-demand endpoints cannot be
-        turned into a scrape amplifier."""
+        turned into a scrape amplifier.  `include_scrub` adds the
+        per-peer /ec/scrub/status round trip — only the health() path
+        (and the periodic loop) pays it; /cluster/metrics and trace
+        fetches, which never read scrub state, skip it."""
         now = time.time()
         with self._lock:
-            if not force and now - self._last_scrape < self.min_interval:
+            # a scrub-inclusive call must not be swallowed by the TTL of
+            # a plain metrics scrape that just ran without scrub state
+            fresh = now - self._last_scrape < self.min_interval
+            scrub_fresh = now - self._last_scrub_scrape < self.min_interval
+            if not force and fresh and (scrub_fresh or not include_scrub):
                 return
             self._last_scrape = now
+            if include_scrub:
+                self._last_scrub_scrape = now
         urls = list(dict.fromkeys(self.peers_fn()))
         with self._lock:
             # peers gone from the registry (unregistered/replaced) drop
@@ -254,23 +302,41 @@ class ClusterAggregator:
             return
         import concurrent.futures
 
+        from ..observability import context as _trace_context
+
+        # carry the triggering request's trace context onto the pool
+        # threads (with the request span as parent): a sampled GET
+        # /cluster/health shows its fan-out scrapes as rpc.client hops
+        # nested under the request on the stitched trace
+        ctx = _trace_context.fork_for_thread()
+
         def one(url: str):
-            try:
-                return url, parse_prometheus_text(self._fetch(url)), ""
-            except Exception as e:
-                return url, None, f"{type(e).__name__}: {e}"[:200]
+            with _trace_context.scope(ctx):
+                try:
+                    fams = parse_prometheus_text(self._fetch(url))
+                except Exception as e:
+                    return url, None, f"{type(e).__name__}: {e}"[:200], None
+                scrub = None
+                if include_scrub:
+                    try:
+                        scrub = self._scrub_fetch(url)
+                    except Exception:
+                        scrub = None
+                return url, fams, "", scrub
 
         with concurrent.futures.ThreadPoolExecutor(
                 max_workers=min(8, len(urls)),
                 thread_name_prefix="metrics-scrape") as pool:
             results = list(pool.map(one, urls))
         with self._lock:
-            for url, families, err in results:
+            for url, families, err, scrub in results:
                 st = self._peers.setdefault(url, _PeerState())
                 if families is not None:
                     st.families = families
                     st.scraped_at = time.time()
                     st.up, st.error = True, ""
+                    if scrub is not None:
+                        st.scrub = scrub
                 else:
                     # keep the last-good families: the merge serves them
                     # marked stale instead of dipping cluster counters
@@ -284,7 +350,7 @@ class ClusterAggregator:
         def loop():
             while not self._stop.wait(interval):
                 try:
-                    self.scrape(force=True)
+                    self.scrape(force=True, include_scrub=True)
                 except Exception:
                     pass
 
@@ -352,10 +418,15 @@ class ClusterAggregator:
         return "\n".join(lines) + "\n"
 
     def health(self) -> dict:
-        """The /cluster/health body: per-peer pipeline health + totals."""
+        """The /cluster/health body: per-peer pipeline health + per-peer
+        scrub verdict rollup + totals.  A volume whose scrub verdict is
+        `unrepairable` anywhere in the cluster marks the rollup
+        degraded — data is at risk even though every counter-driven
+        family may read clean."""
         status = self.peer_status()
         peers: dict[str, dict] = {}
         totals = {k: 0 for k in HEALTH_FAMILIES}
+        totals["scrub_unrepairable"] = 0
         for url, st in self._snapshot().items():
             entry = dict(status[url])
             ph = {}
@@ -366,6 +437,19 @@ class ClusterAggregator:
                 ph[key] = v
                 totals[key] += v
             entry["pipeline_health"] = ph
+            if st.scrub is not None:
+                verdict_counts: dict[str, int] = {}
+                for _vid, d in (st.scrub.get("verdicts") or {}).items():
+                    verdict = (d or {}).get("status") or "?"
+                    verdict_counts[verdict] = \
+                        verdict_counts.get(verdict, 0) + 1
+                entry["scrub"] = {
+                    "running": bool(st.scrub.get("running")),
+                    "passes": int(st.scrub.get("passes") or 0),
+                    "verdicts": verdict_counts,
+                }
+                totals["scrub_unrepairable"] += \
+                    verdict_counts.get("unrepairable", 0)
             peers[url] = entry
         stale = sorted(u for u, s in status.items() if s["stale"])
         return {"peers": peers, "totals": totals,

@@ -139,9 +139,15 @@ class Histogram:
         self._counts: dict[tuple, list[int]] = {}
         self._sums: dict[tuple, float] = {}
         self._totals: dict[tuple, int] = {}
+        # last exemplar per label set: (bucket index or None for +Inf,
+        # observed value, trace id, unix ts) — the OpenMetrics bridge
+        # from a latency bucket to the distributed trace that landed in it
+        self._exemplars: dict[tuple, tuple[Optional[int], float, str,
+                                           float]] = {}
         self._lock = threading.Lock()
 
-    def observe(self, *label_values_then_obs) -> None:
+    def observe(self, *label_values_then_obs,
+                exemplar: Optional[str] = None) -> None:
         *label_values, obs = label_values_then_obs
         key = tuple(str(v) for v in label_values)
         with self._lock:
@@ -153,6 +159,10 @@ class Histogram:
                 counts[i] += 1
             self._sums[key] = self._sums.get(key, 0.0) + obs
             self._totals[key] = self._totals.get(key, 0) + 1
+            if exemplar:
+                self._exemplars[key] = (
+                    i if i < len(self.buckets) else None,
+                    obs, exemplar, time.time())
 
     def labels(self, *label_values) -> "_BoundHistogram":
         """Pre-touch a label set: the exposition emits every bucket
@@ -208,10 +218,17 @@ class Histogram:
 
         return _Timer()
 
-    def expose(self) -> list[str]:
+    def expose(self, exemplars: bool = False) -> list[str]:
+        """`exemplars=True` appends OpenMetrics exemplar suffixes to the
+        owning bucket lines.  Off by default: exemplar syntax is ILLEGAL
+        in the classic text format 0.0.4 this exposition is served and
+        pushed as — a strict Prometheus/pushgateway parser would reject
+        the whole scrape.  Endpoints turn it on only when the scraper
+        asks (?exemplars=1 / an OpenMetrics Accept header)."""
         out = [f"# HELP {self.name} {self.help}",
                f"# TYPE {self.name} histogram"]
         for lv in sorted(self._counts):
+            ex = self._exemplars.get(lv) if exemplars else None
             cumulative = 0
             for i, bound in enumerate(self.buckets):
                 cumulative += self._counts[lv][i]
@@ -220,12 +237,18 @@ class Histogram:
                 pairs = ",".join(
                     f'{k}="{_escape_label_value(str(v))}"'
                     for k, v in labels.items())
-                out.append(f"{self.name}_bucket{{{pairs}}} {cumulative}")
+                line = f"{self.name}_bucket{{{pairs}}} {cumulative}"
+                if ex is not None and ex[0] == i:
+                    line += _fmt_exemplar(ex)
+                out.append(line)
             labels = dict(zip(self.label_names, lv))
             labels["le"] = "+Inf"
             pairs = ",".join(f'{k}="{_escape_label_value(str(v))}"'
                              for k, v in labels.items())
-            out.append(f"{self.name}_bucket{{{pairs}}} {self._totals[lv]}")
+            line = f"{self.name}_bucket{{{pairs}}} {self._totals[lv]}"
+            if ex is not None and ex[0] is None:
+                line += _fmt_exemplar(ex)
+            out.append(line)
             plain = _fmt_labels(self.label_names, lv)
             out.append(f"{self.name}_sum{plain} {_num(self._sums[lv])}")
             out.append(f"{self.name}_count{plain} {self._totals[lv]}")
@@ -234,6 +257,16 @@ class Histogram:
 
 def _num(v: float) -> str:
     return str(int(v)) if float(v).is_integer() else repr(float(v))
+
+
+def _fmt_exemplar(ex: tuple) -> str:
+    """OpenMetrics exemplar suffix on the owning bucket line:
+    ` # {trace_id="…"} value ts`.  Links the latency bucket to one
+    sampled distributed trace; our own exposition parser
+    (stats/aggregate.py) and Prometheus both tolerate/consume it."""
+    _i, value, trace_id, ts = ex
+    return (f' # {{trace_id="{_escape_label_value(trace_id)}"}} '
+            f"{_num(value)} {_num(round(ts, 3))}")
 
 
 class _BoundCounter:
@@ -299,16 +332,34 @@ class Registry:
     def histogram(self, name, help_="", labels=(), buckets=DEFAULT_BUCKETS):
         return self.register(Histogram(name, help_, labels, buckets))
 
-    def expose(self) -> str:
+    def expose(self, exemplars: bool = False) -> str:
         lines: list[str] = []
         with self._lock:
             collectors = list(self._collectors)
         for c in collectors:
-            lines.extend(c.expose())
+            if exemplars and isinstance(c, Histogram):
+                lines.extend(c.expose(exemplars=True))
+            else:
+                lines.extend(c.expose())
         return "\n".join(lines) + "\n"
 
 
 REGISTRY = Registry()
+
+
+def exemplars_requested(req) -> bool:
+    """Should this /metrics request get OpenMetrics exemplar suffixes?
+    Only on the explicit ?exemplars=1 opt-in.  NOT on an OpenMetrics
+    Accept header: modern Prometheus offers openmetrics-text by default,
+    and honoring it here without also switching the response to the full
+    OpenMetrics framing (content type + `# EOF` terminator) would hand a
+    strict parser exemplar syntax inside a text/plain 0.0.4 body and
+    fail the whole scrape."""
+    try:
+        return req.query.get("exemplars", "").lower() in ("1", "true",
+                                                          "yes", "on")
+    except Exception:
+        return False
 
 
 # --- the reference's collector families (stats/metrics.go:23-130) -----------

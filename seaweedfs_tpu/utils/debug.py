@@ -239,7 +239,12 @@ def register_debug_routes(router: Router,
         """Dump the process-global span ring as Chrome trace-event JSON
         (load in chrome://tracing or ui.perfetto.dev).  ?enable=1 turns
         the tracer on for live capture, ?disable=1 turns it off again,
-        ?clear=1 empties the ring after dumping."""
+        ?clear=1 empties the ring after dumping.  ?trace_id=<32-hex>
+        returns only one distributed trace's spans and ?root=<span_id>
+        only that span's subtree — a single request's tree without
+        downloading the whole ring (filters never drain the ring: clear
+        is ignored when a filter is active, because clearing spans the
+        caller did not see would silently lose them)."""
         from ..observability import (disable_tracing, enable_tracing,
                                      get_tracer)
 
@@ -252,9 +257,41 @@ def register_debug_routes(router: Router,
         if flag("enable"):
             enable_tracing()
         tracer = get_tracer()
+        trace_id = req.query.get("trace_id", "")
+        root_id = req.query.get("root", "")
+        spans = None
+        if trace_id or root_id:
+            spans = tracer.snapshot()
+            if trace_id:
+                spans = [sp for sp in spans if sp.trace_id == trace_id]
+            if root_id:
+                by_id = {sp.span_id: sp for sp in spans}
+                children: dict = {}
+                for sp in spans:
+                    if sp.parent_id:
+                        children.setdefault(sp.parent_id, []).append(sp)
+                keep, stack, seen = [], [root_id], set()
+                while stack:
+                    sid = stack.pop()
+                    if sid in seen:
+                        continue
+                    seen.add(sid)
+                    sp = by_id.get(sid)
+                    if sp is not None:
+                        keep.append(sp)
+                    stack.extend(c.span_id
+                                 for c in children.get(sid, []))
+                spans = keep
+        # loss accounting rides every dump: a truncated ring cannot
+        # masquerade as a complete trace.  Read BEFORE to_chrome — a
+        # clear=1 drain re-baselines tracer.dropped, and this dump must
+        # report the drops of the capture it returns, not the zeroed
+        # post-clear count
+        spans_dropped = tracer.dropped
         # clear rides the same lock as the read: spans recorded while
         # this dump renders are never silently dropped
-        doc = tracer.to_chrome(clear=flag("clear"))
+        doc = tracer.to_chrome(clear=flag("clear"), spans=spans)
+        doc["spansDropped"] = spans_dropped
         if flag("disable"):
             disable_tracing()
         return Response(raw=json.dumps(doc).encode(),

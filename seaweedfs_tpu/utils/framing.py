@@ -20,6 +20,9 @@ import struct
 import threading
 from typing import Callable, Optional
 
+from ..observability import context as _trace_context
+from ..observability import get_tracer as _get_tracer
+
 TCP_PORT_OFFSET = 20000
 U16 = struct.Struct(">H")
 U32 = struct.Struct(">I")
@@ -114,12 +117,33 @@ class FramedServer:
                 key = recv_exact(conn, key_len).decode()
                 body_len = U32.unpack(recv_exact(conn, 4))[0]
                 body = recv_exact(conn, body_len) if body_len else b""
+                # trace ingress for the headerless native plane: frames
+                # have no Traceparent slot, so every framed op is its own
+                # head-based sampling decision (rate-gated), minted fresh
+                # — the cross-server propagation story stays an HTTP-plane
+                # concern, mirroring how replication does
+                tracer = _get_tracer()
+                prev_ctx = sampled = None
+                traced = False
+                if tracer.enabled:
+                    sampled, prev_ctx = _trace_context.begin_request(None)
+                    traced = True
                 try:
-                    payload = self.handler(op, key, body)
+                    # gate on the sampled decision: the 21k-rps framed
+                    # path must not build span names for unsampled ops
+                    if sampled is not None:
+                        with tracer.span(f"tcp.{self.name}",
+                                         op=op.decode("latin-1"), key=key):
+                            payload = self.handler(op, key, body)
+                    else:
+                        payload = self.handler(op, key, body)
                     conn.sendall(b"\x00" + U32.pack(len(payload)) + payload)
                 except Exception as e:  # noqa: BLE001 - conn must survive
                     msg = f"{type(e).__name__}: {e}".encode()[:65536]
                     conn.sendall(b"\x01" + U32.pack(len(msg)) + msg)
+                finally:
+                    if traced:
+                        _trace_context.end_request(prev_ctx)
         finally:
             conn.close()
 

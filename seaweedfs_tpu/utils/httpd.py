@@ -20,7 +20,9 @@ import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Optional
 
+from ..observability import context as _trace_context
 from ..observability import get_tracer as _get_tracer
+from ..observability.tracer import NOOP_SPAN as _NOOP_SPAN
 
 
 class HttpError(Exception):
@@ -182,21 +184,48 @@ class Router:
                                          headers={"Connection": "close"}))
             return
         path = urllib.parse.unquote(urllib.parse.urlparse(handler.path).path)
-        for m, pattern, fn in self.routes:
-            if m != method:
-                continue
-            match = pattern.match(path)
-            if match:
+        # distributed-trace ingress (observability/context.py): adopt the
+        # caller's Traceparent (or make a fresh head-based sampling
+        # decision) for the duration of this request, restoring the
+        # thread-local afterwards — handler threads are pooled per
+        # connection, and a leaked context would bleed into the next
+        # request.  Gated on tracer.enabled so the dormant hot-path cost
+        # stays one attribute check; with tracing on, an unsampled
+        # request pays one header parse + one random() and every span
+        # call below degrades to the shared no-op.
+        tracer = _get_tracer()
+        tctx = _prev_ctx = _prev_srv = None
+        traced = False
+        if tracer.enabled:
+            tctx, _prev_ctx = _trace_context.begin_request(handler.headers)
+            traced = True
+            # stamp this thread with the OWNING server's identity so
+            # spans attribute per-server even when several servers share
+            # one process tracer (`weed server`, in-process fixtures);
+            # servers set router.server_url to their advertised url, the
+            # Host header stands in for routers that never did
+            _prev_srv = _trace_context.swap_server(
+                getattr(self, "server_url", None)
+                or handler.headers.get("Host"))
+        try:
+            for m, pattern, fn in self.routes:
+                if m != method:
+                    continue
+                match = pattern.match(path)
+                if not match:
+                    continue
                 t0 = _time.perf_counter()
                 req = Request(handler, match)
                 # request span: the path carries the needle/volume id for
                 # object routes (/<vid>,<fid>), so a trace timeline can be
-                # joined back to specific keys.  Guarded on enabled so the
-                # dormant cost on this hottest path is one attribute check
-                # — no name f-string, no attrs dict.
-                tracer = _get_tracer()
+                # joined back to specific keys.  The span re-roots under
+                # the caller's span id (the trace context's parent), which
+                # is the edge the master-side collector stitches on.
+                # gate on the SAMPLED context, not just tracer.enabled:
+                # at 1% sampling the other 99% of requests skip even the
+                # span-name f-string and attrs dict
                 try:
-                    if tracer.enabled:
+                    if tctx is not None:
                         with tracer.span(f"http.{self.name}.{fn.__name__}",
                                          method=method, path=path):
                             resp = fn(req)
@@ -221,8 +250,18 @@ class Router:
                                 {"error": f"{type(e).__name__}: {e}"}, status=500)
                 if self.metrics is not None:
                     self.metrics.request_counter.inc(fn.__name__)
+                    # RED histogram keyed by route; sampled requests
+                    # attach their trace id as an exemplar, so a latency
+                    # outlier on /metrics links straight to the stitched
+                    # trace that explains it
                     self.metrics.request_histogram.observe(
-                        fn.__name__, _time.perf_counter() - t0)
+                        fn.__name__, _time.perf_counter() - t0,
+                        exemplar=tctx.trace_id if tctx is not None
+                        else None)
+                if tctx is not None:
+                    # hand the trace id back so callers (bench, tests,
+                    # curl -v) can fetch the stitched cluster trace
+                    resp.headers.setdefault("X-Trace-Id", tctx.trace_id)
                 # drain any unread request body first: responding while the
                 # client is still mid-upload resets the connection and the
                 # client never sees the (often 4xx) status. Discard in
@@ -232,11 +271,16 @@ class Router:
                     req._body = b""
                 self._send(handler, resp)
                 return
-        # 404 fallthrough: the body was never read, so drain it too or the
-        # keep-alive loop would parse the leftover bytes as the next request
-        # line (request-smuggling-shaped desync).
-        self._drain_body(handler)
-        self._send(handler, Response({"error": f"no route {method} {path}"}, status=404))
+            # 404 fallthrough: the body was never read, so drain it too or
+            # the keep-alive loop would parse the leftover bytes as the next
+            # request line (request-smuggling-shaped desync).
+            self._drain_body(handler)
+            self._send(handler, Response(
+                {"error": f"no route {method} {path}"}, status=404))
+        finally:
+            if traced:
+                _trace_context.end_request(_prev_ctx)
+                _trace_context.swap_server(_prev_srv)
 
     @staticmethod
     def _drain_body(handler: BaseHTTPRequestHandler) -> None:
@@ -806,6 +850,28 @@ class _ConnPool(threading.local):
 _pool = _ConnPool()
 
 
+def _egress_span(method: str, parsed, **attrs):
+    """Distributed-trace egress gate, shared by _pooled_request and
+    http_download so EVERY outbound hop in the codebase (client SDK,
+    replication, gateways, EC copies/remote shard reads, master scrapes)
+    rides ONE copy of the sampling logic: open an rpc.client span iff
+    this thread holds a trace context AND its head decision sampled the
+    request — the open span's id becomes the downstream parent (the
+    stitching edge), so callers must inject the Traceparent INSIDE the
+    returned span.  An unsampled (or undecided) thread pays one
+    thread-local read.  Returns (span_cm, ctx); ctx None means "no
+    trace context at all" — skip injection entirely."""
+    ctx = _trace_context.current()
+    if ctx is None:
+        return _NOOP_SPAN, None
+    tracer = _get_tracer()
+    if tracer.enabled and _trace_context.current_sampled() is not None:
+        return tracer.span("rpc.client", method=method,
+                           peer=parsed.netloc, path=parsed.path,
+                           **attrs), ctx
+    return _NOOP_SPAN, ctx
+
+
 def _pooled_request(method: str, url: str, body: Optional[bytes],
                     headers: Optional[dict], timeout: float
                     ) -> tuple[int, bytes, dict]:
@@ -827,29 +893,36 @@ def _pooled_request(method: str, url: str, body: Optional[bytes],
 
     if fi._points:
         fi.hit("net.request")
-    for _ in range(2):
-        conn = _pool.conns.get(key)
-        reused = conn is not None
-        if conn is None:
-            conn = _RawConn(parsed.scheme, parsed.netloc, timeout, ssl_ctx)
-            _pool.conns[key] = conn
-        try:
-            conn.settimeout(timeout)
-            status, data, hdrs, will_close = conn.request(
-                method, target, body, headers or {})
-            if will_close:
+    span_cm, ctx = _egress_span(method, parsed)
+    if ctx is not None:
+        headers = dict(headers or {})
+    with span_cm:
+        if ctx is not None:
+            _trace_context.inject_trace_headers(headers)
+        for _ in range(2):
+            conn = _pool.conns.get(key)
+            reused = conn is not None
+            if conn is None:
+                conn = _RawConn(parsed.scheme, parsed.netloc, timeout,
+                                ssl_ctx)
+                _pool.conns[key] = conn
+            try:
+                conn.settimeout(timeout)
+                status, data, hdrs, will_close = conn.request(
+                    method, target, body, headers or {})
+                if will_close:
+                    conn.close()
+                    _pool.conns.pop(key, None)
+                return status, data, hdrs
+            except (TimeoutError, _socket.timeout):
                 conn.close()
                 _pool.conns.pop(key, None)
-            return status, data, hdrs
-        except (TimeoutError, _socket.timeout):
-            conn.close()
-            _pool.conns.pop(key, None)
-            raise
-        except Exception:
-            conn.close()
-            _pool.conns.pop(key, None)
-            if not reused:
                 raise
+            except Exception:
+                conn.close()
+                _pool.conns.pop(key, None)
+                if not reused:
+                    raise
     raise OSError("unreachable")  # pragma: no cover
 
 
@@ -955,7 +1028,22 @@ def http_download(method: str, url: str, dest_path: str,
     status (0 = unreachable)."""
     url, ssl_ctx = _prep_url(url)
     req = urllib.request.Request(url, method=method)
+    # same trace egress as _pooled_request: bulk transfers (volume copy,
+    # EC shard copy) appear on the stitched trace as rpc.client hops and
+    # carry the caller's Traceparent downstream
+    span_cm, ctx = _egress_span(method, urllib.parse.urlsplit(url),
+                                download=True)
     tmp = dest_path + ".part"
+    with span_cm:
+        if ctx is not None:
+            for k, v in _trace_context.inject_trace_headers({}).items():
+                req.add_header(k, v)
+        return _http_download_body(req, timeout, ssl_ctx, tmp,
+                                   dest_path, piece_bytes)
+
+
+def _http_download_body(req, timeout, ssl_ctx, tmp: str, dest_path: str,
+                        piece_bytes: int) -> int:
     try:
         with urllib.request.urlopen(req, timeout=timeout,
                                     context=ssl_ctx) as r:

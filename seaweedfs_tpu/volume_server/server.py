@@ -134,8 +134,18 @@ class VolumeServer:
         self._req_sample = (0.0, time.monotonic())
         self._req_busy = False
         self.scrubber = EcScrubber(self.store, busy_fn=self._scrub_busy)
+        # sampled-trace span shipping to the master's collector (follows
+        # the heartbeat's current leader); chained attach, so several
+        # servers sharing one process each ship and the collector dedups
+        from ..observability import get_tracer
+        from ..observability.collector import TraceShipper
+
+        self._trace_shipper = TraceShipper(
+            get_tracer(), server=self.url,
+            master_url_fn=lambda: self.master_url)
         self.metrics.max_volume_counter.set(max_volume_count)
         self.router = Router("volume", metrics=self.metrics)
+        self.router.server_url = self.url
         self._register_routes()
         self._server = None
         self._tls_context = tls_context
@@ -215,12 +225,14 @@ class VolumeServer:
                         replicate_write=self._tcp_replicate_write,
                         replicate_delete=self._tcp_replicate_delete).start(),
                     role="volume-tcp")
+        self._trace_shipper.attach()
         threading.Thread(target=self._heartbeat_loop, daemon=True,
                          name=f"heartbeat:{self.url}").start()
         return self
 
     def stop(self) -> None:
         self._stop.set()
+        self._trace_shipper.detach()
         self.scrubber.stop(join_timeout=0.5)
         if self._tcp_server is not None:
             self._tcp_server.stop()
@@ -505,7 +517,12 @@ class VolumeServer:
                     g.set(str(vid), "live_files", fc)
                     g.set(str(vid), "deleted_bytes", db)
                     g.set(str(vid), "fsync_passes", sp)
-            return Response(raw=REGISTRY.expose().encode(), headers={
+            from ..stats.metrics import exemplars_requested
+
+            return Response(
+                raw=REGISTRY.expose(
+                    exemplars=exemplars_requested(req)).encode(),
+                headers={
                 "Content-Type": "text/plain; version=0.0.4; charset=utf-8"})
 
         def status_doc() -> dict:

@@ -1,0 +1,86 @@
+"""tools/check_tracing.py as a tier-1 gate.
+
+Distributed tracing (PR 6) is enforced at two chokepoints: every HTTP
+handler runs under Router.dispatch's request span + trace context, and
+every outbound hop rides utils/httpd's injecting client helpers.  These
+tests (a) pin the checker's detection of bypasses on planted sources,
+and (b) run it over the WHOLE repo so a new endpoint or a hand-rolled
+HTTP call that would shatter cross-server traces fails tier-1 loudly.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOL = os.path.join(REPO, "tools", "check_tracing.py")
+
+
+def _load():
+    spec = importlib.util.spec_from_file_location("check_tracing", TOOL)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+CHECK = _load()
+
+
+class TestPlantedViolations:
+    def test_raw_urllib_request_rejected(self):
+        for src in ("import urllib.request\n",
+                    "from urllib import request\n",
+                    "import http.client\n",
+                    "from http import client\n"):
+            problems = CHECK.check_package_source(src, "pkg/x.py")
+            assert problems and "utils.httpd" in problems[0], src
+
+    def test_tracing_exempt_waiver_accepted(self):
+        src = ("import http.client  "
+               "# tracing-exempt: external endpoint\n")
+        assert CHECK.check_package_source(src, "pkg/x.py") == []
+
+    def test_plain_urllib_parse_is_fine(self):
+        assert CHECK.check_package_source(
+            "import urllib.parse\nimport urllib.error\n", "x.py") == []
+
+    def test_router_dispatch_override_rejected(self):
+        src = ("class MyRouter(Router):\n"
+               "    def dispatch(self, handler, method):\n"
+               "        pass\n")
+        problems = CHECK.check_package_source(src, "pkg/x.py")
+        assert problems and "dispatch" in problems[0]
+
+    def test_dispatch_without_context_rejected(self):
+        # a gutted Router.dispatch (no begin_request/end_request/span)
+        # must fail the chokepoint contract
+        src = ("class Router:\n"
+               "    def dispatch(self, handler, method):\n"
+               "        return None\n"
+               "def _pooled_request(m, u, b, h, t):\n"
+               "    return inject_trace_headers(h)\n"
+               "def http_download(m, u, d):\n"
+               "    return inject_trace_headers({})\n")
+        problems = CHECK.check_httpd_source(src, "httpd.py")
+        assert any("begin_request" in p for p in problems)
+
+    def test_outbound_helper_without_inject_rejected(self):
+        src = ("class Router:\n"
+               "    def dispatch(self, handler, method):\n"
+               "        begin_request(h)\n"
+               "        tracer.span('x')\n"
+               "        end_request(p)\n"
+               "def _pooled_request(m, u, b, h, t):\n"
+               "    return None\n"
+               "def http_download(m, u, d):\n"
+               "    return inject_trace_headers({})\n")
+        problems = CHECK.check_httpd_source(src, "httpd.py")
+        assert any("_pooled_request" in p
+                   and "inject_trace_headers" in p for p in problems)
+
+
+class TestWholeRepo:
+    def test_repo_is_clean(self):
+        problems = CHECK.check_repo(REPO)
+        assert problems == [], "\n".join(problems)

@@ -211,7 +211,13 @@ class TestClusterEndpoints:
                                       "engine_fallbacks",
                                       "degraded_binds",
                                       "corrupt_shards",
-                                      "scrub_repairs"}
+                                      "scrub_repairs",
+                                      "scrub_unrepairable"}
+        # the scrub verdict rollup rides the same scrape (PR 6): idle
+        # scrubbers report not-running with zero verdicts
+        for vs in servers:
+            scrub = doc["peers"][vs.url].get("scrub")
+            assert scrub is not None and scrub["running"] is False
         for vs in servers:
             peer = doc["peers"][vs.url]
             assert peer["up"] is True and peer["stale"] is False

@@ -1,0 +1,453 @@
+"""Cluster-wide distributed tracing, live (PR 6 tentpole).
+
+Real servers in one process: trace contexts minted at ingress, carried
+on every outbound hop, spans shipped to the master's collector, and the
+stitched trace served at GET /cluster/traces/<id> with cross-server
+analysis.  The contracts pinned here:
+
+  - master -> volume and gateway -> filer -> volume fan-outs each
+    produce ONE rooted tree (every span reachable from a single root
+    via parent edges that crossed process/server boundaries in the
+    Traceparent header);
+  - a malformed Traceparent mints a fresh decision — never a 500;
+  - an upstream decided-not-sampled header suppresses recording;
+  - /debug/traces?trace_id= and ?root= pull one request's tree without
+    the whole ring;
+  - `weed shell ec.scrub -all` starts+polls scrubs on every registered
+    server and rolls verdicts up (PR 5's per-server leftover);
+  - drop accounting is surfaced on every analysis surface.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import pytest
+
+from seaweedfs_tpu.master.server import MasterServer
+from seaweedfs_tpu.observability import context as tc
+from seaweedfs_tpu.observability import disable_tracing, enable_tracing
+from seaweedfs_tpu.utils.httpd import http_bytes, http_json
+from seaweedfs_tpu.volume_server.server import VolumeServer
+
+from tests.conftest import free_port
+
+FORCE = {tc.FORCE_HEADER: "1"}
+
+
+@pytest.fixture
+def traced():
+    """Process-global tracing ON with rate 0 — only forced or propagated
+    decisions record, so concurrent background work stays off the ring.
+    Always restored: other tests assume the tracer is off."""
+    tracer = enable_tracing()
+    tracer.clear()
+    tc.set_sample_rate(0.0)
+    yield tracer
+    disable_tracing()
+    tc.set_sample_rate(1.0)
+    tracer.clear()
+
+
+def _zero_degrade_counters():
+    """In-process fixture servers expose the TEST PROCESS's global
+    metrics registry, so degrade counters incremented by earlier suite
+    tests (pipeline chaos, scrub drills) would flip every stitched
+    trace's verdict to DEGRADED here.  Zero the health families the
+    analyzer folds in — label keys stay registered at 0 so exposition
+    shape is unchanged."""
+    from seaweedfs_tpu.stats import REGISTRY, Counter
+    from seaweedfs_tpu.stats.aggregate import HEALTH_FAMILIES
+
+    families = set(HEALTH_FAMILIES.values())
+    with REGISTRY._lock:
+        collectors = list(REGISTRY._collectors)
+    for c in collectors:
+        if isinstance(c, Counter) and c.name in families:
+            with c._lock:
+                for key in c._values:
+                    c._values[key] = 0.0
+
+
+@pytest.fixture
+def cluster(traced, tmp_path):
+    _zero_degrade_counters()
+    master = MasterServer(port=free_port(), pulse_seconds=0.4).start()
+    master.aggregator.min_interval = 0.0
+    servers = []
+    for i in range(2):
+        d = tmp_path / f"vs{i}"
+        d.mkdir()
+        servers.append(VolumeServer(
+            [str(d)], master.url, port=free_port(),
+            pulse_seconds=0.4).start())
+    deadline = time.time() + 5
+    while time.time() < deadline and len(master.topo.all_nodes()) < 2:
+        time.sleep(0.05)
+    assert len(master.topo.all_nodes()) == 2
+    yield master, servers
+    for vs in servers:
+        vs.stop()
+    master.stop()
+
+
+def _fetch_trace(master, trace_id, want=None, timeout=8.0):
+    """Poll the collector until the stitched trace satisfies `want`
+    (shippers flush on a short interval)."""
+    deadline = time.time() + timeout
+    last = None
+    while time.time() < deadline:
+        status, body, _ = http_bytes(
+            "GET", f"http://{master.url}/cluster/traces/{trace_id}")
+        if status == 200:
+            last = json.loads(body)
+            if want is None or want(last):
+                return last
+        time.sleep(0.15)
+    return last
+
+
+def _assert_one_rooted_tree(doc):
+    """Every span reaches a single root via parent edges."""
+    ids = {s["id"] for s in doc["spans"]}
+    roots = [s for s in doc["spans"]
+             if not s.get("parent") or s["parent"] not in ids]
+    assert len(roots) == 1, \
+        f"expected one root, got {[(r['name'], r['id']) for r in roots]}"
+    return roots[0]
+
+
+class TestMasterToVolume:
+    def test_vol_grow_produces_one_rooted_tree(self, cluster):
+        master, servers = cluster
+        status, body, hdrs = http_bytes(
+            "GET", f"http://{master.url}/vol/grow?count=1", headers=FORCE)
+        assert status == 200, body
+        trace_id = hdrs.get("X-Trace-Id")
+        assert trace_id and len(trace_id) == 32
+
+        doc = _fetch_trace(
+            master, trace_id,
+            want=lambda d: any(s["name"] == "http.volume.assign_volume"
+                               for s in d["spans"]))
+        assert doc is not None, "trace never reached the collector"
+        root = _assert_one_rooted_tree(doc)
+        assert root["name"] == "http.master.vol_grow"
+        names = {s["name"] for s in doc["spans"]}
+        assert "http.volume.assign_volume" in names
+        assert "rpc.client" in names
+        # the volume span's parent is the master's rpc.client span —
+        # the exact edge the Traceparent header carried across servers
+        by_id = {s["id"]: s for s in doc["spans"]}
+        vol = next(s for s in doc["spans"]
+                   if s["name"] == "http.volume.assign_volume")
+        assert by_id[vol["parent"]]["name"] == "rpc.client"
+
+        an = doc["analysis"]
+        assert an["bounding_hop"] is not None
+        assert an["network_s"] >= 0.0 and an["server_s"]
+        assert an["degraded"] is False
+        assert an["spans_dropped"] == 0
+
+    def test_trace_index_lists_it(self, cluster):
+        master, _ = cluster
+        _, _, hdrs = http_bytes(
+            "GET", f"http://{master.url}/cluster/status", headers=FORCE)
+        trace_id = hdrs["X-Trace-Id"]
+        assert _fetch_trace(master, trace_id) is not None
+        idx = http_json("GET", f"http://{master.url}/cluster/traces")
+        assert any(t["trace_id"] == trace_id for t in idx["traces"])
+
+    def test_unknown_trace_is_404(self, cluster):
+        master, _ = cluster
+        status, _, _ = http_bytes(
+            "GET", f"http://{master.url}/cluster/traces/{'0' * 32}")
+        assert status == 404
+
+
+class TestHeaderEdgeCases:
+    def test_malformed_traceparent_never_500s_and_mints_fresh(
+            self, cluster):
+        master, _ = cluster
+        tc.set_sample_rate(1.0)  # fresh mints must sample
+        for bad in ("garbage", "00-zz-xx-01", "01-" + "0" * 32 + "-x-01"):
+            status, _, hdrs = http_bytes(
+                "GET", f"http://{master.url}/cluster/status",
+                headers={tc.TRACEPARENT_HEADER: bad})
+            assert status == 200, bad
+            minted = hdrs.get("X-Trace-Id")
+            assert minted and len(minted) == 32, bad
+
+    def test_upstream_not_sampled_suppresses(self, cluster):
+        master, _ = cluster
+        tc.set_sample_rate(1.0)
+        status, _, hdrs = http_bytes(
+            "GET", f"http://{master.url}/cluster/status",
+            headers={tc.TRACEPARENT_HEADER: tc.NOT_SAMPLED_HEADER})
+        assert status == 200
+        assert "X-Trace-Id" not in hdrs
+
+    def test_rate_zero_unsampled_but_served(self, cluster):
+        master, _ = cluster  # fixture rate is 0.0
+        status, _, hdrs = http_bytes(
+            "GET", f"http://{master.url}/cluster/status")
+        assert status == 200
+        assert "X-Trace-Id" not in hdrs
+
+
+class TestDebugTraceFilters:
+    def _dump(self, url, query=""):
+        status, body, _ = http_bytes(
+            "GET", f"http://{url}/debug/traces{query}")
+        assert status == 200
+        return json.loads(body)
+
+    def test_trace_id_filter_pulls_one_request(self, cluster):
+        master, _ = cluster
+        tids = []
+        for _ in range(2):
+            _, _, hdrs = http_bytes(
+                "GET", f"http://{master.url}/cluster/status",
+                headers=FORCE)
+            tids.append(hdrs["X-Trace-Id"])
+        doc = self._dump(master.url, f"?trace_id={tids[0]}")
+        events = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+        assert events, "filter returned no spans"
+        assert all(e["args"].get("trace_id") == tids[0] for e in events)
+        # the OTHER trace's spans are on the ring but filtered out
+        full = [e for e in self._dump(master.url)["traceEvents"]
+                if e.get("ph") == "X"]
+        assert len(full) > len(events)
+        assert "spansDropped" in doc
+
+    def test_root_filter_pulls_one_subtree(self, cluster):
+        master, _ = cluster
+        _, _, hdrs = http_bytes(
+            "GET", f"http://{master.url}/cluster/health", headers=FORCE)
+        tid = hdrs["X-Trace-Id"]
+        by_trace = self._dump(master.url, f"?trace_id={tid}")
+        events = [e for e in by_trace["traceEvents"]
+                  if e.get("ph") == "X"]
+        root = next(e for e in events
+                    if e["name"].startswith("http.master."))
+        sub = self._dump(master.url, f"?root={root['args']['span_id']}")
+        sub_events = [e for e in sub["traceEvents"] if e.get("ph") == "X"]
+        assert sub_events
+        sub_ids = {e["args"]["span_id"] for e in sub_events}
+        assert root["args"]["span_id"] in sub_ids
+        # subtree only: every returned span is the root or parents into
+        # the returned set
+        for e in sub_events:
+            parent = e["args"].get("parent_id")
+            assert e["args"]["span_id"] == root["args"]["span_id"] \
+                or parent in sub_ids
+
+    def test_analyze_surfaces_drop_counter(self, cluster):
+        master, _ = cluster
+        status, body, _ = http_bytes(
+            "GET", f"http://{master.url}/debug/traces/analyze")
+        assert status == 200
+        assert "spans_dropped" in json.loads(body)
+
+
+class TestScrubAll:
+    def test_shell_scrub_all_rolls_up(self, cluster):
+        master, servers = cluster
+        from seaweedfs_tpu.shell import CommandEnv, run_command
+
+        env = CommandEnv(master.url)
+        out = run_command(env, "ec.scrub -all -timeout 30")
+        assert out.startswith("cluster scrub:")
+        for vs in servers:
+            assert f"{vs.url}: done" in out
+        assert "/cluster/health: degraded=" in out
+        # every shell command is a force-sampled trace root
+        assert len(env.last_trace_id) == 32
+        # the per-peer scrub verdict rollup reached /cluster/health
+        doc = http_json("GET", f"http://{master.url}/cluster/health")
+        assert doc["totals"]["scrub_unrepairable"] == 0
+        for vs in servers:
+            assert "scrub" in doc["peers"][vs.url]
+
+
+def _write_test_volume(dirpath, vid=1, n_needles=60):
+    import numpy as np
+
+    from seaweedfs_tpu.storage.needle import Needle
+    from seaweedfs_tpu.storage.volume import Volume
+
+    rng = np.random.default_rng(17)
+    v = Volume(str(dirpath), "", vid)
+    for i in range(1, n_needles + 1):
+        v.write_needle(Needle(cookie=i, id=i,
+                              data=rng.bytes(int(rng.integers(1, 700)))))
+    v.close()
+
+
+def _flip(path, offset, bit=0):
+    with open(path, "r+b") as f:
+        f.seek(offset)
+        c = f.read(1)
+        f.seek(offset)
+        f.write(bytes([c[0] ^ (1 << bit)]))
+
+
+class TestEcRebuildTrace:
+    """The flagship scenario at tier-1 scale: a multi-server EC rebuild
+    whose survivor copies cross servers yields ONE stitched trace whose
+    analysis names the bounding hop and splits network vs server time;
+    corrupting a survivor mid-rebuild flips the trace's verdict to
+    DEGRADED (in-trace pipeline.retry evidence, not just counters)."""
+
+    @pytest.fixture
+    def ec_cluster(self, traced, tmp_path):
+        _zero_degrade_counters()
+        d0 = tmp_path / "vs0"
+        d0.mkdir()
+        _write_test_volume(d0)
+        master = MasterServer(port=free_port(), pulse_seconds=0.4).start()
+        master.aggregator.min_interval = 0.0
+        d1 = tmp_path / "vs1"
+        d1.mkdir()
+        vs0 = VolumeServer([str(d0)], master.url, port=free_port(),
+                           pulse_seconds=0.4).start()
+        vs1 = VolumeServer([str(d1)], master.url, port=free_port(),
+                           pulse_seconds=0.4).start()
+        deadline = time.time() + 5
+        while time.time() < deadline and len(master.topo.all_nodes()) < 2:
+            time.sleep(0.05)
+        # generate 14 shards on vs0, spread 7..13 to vs1 (a REAL
+        # cross-server /admin/ec/copy), drop the volume
+        vs0.store.ec_generate(1)
+        http_json("POST", f"http://{vs1.url}/admin/ec/copy",
+                  {"volume_id": 1, "shard_ids": list(range(7, 14)),
+                   "source_data_node": vs0.url})
+        http_json("POST", f"http://{vs1.url}/admin/ec/mount",
+                  {"volume_id": 1})
+        http_json("POST", f"http://{vs0.url}/admin/ec/delete",
+                  {"volume_id": 1, "shard_ids": list(range(7, 14))})
+        http_json("POST", f"http://{vs0.url}/admin/ec/mount",
+                  {"volume_id": 1})
+        http_json("POST", f"http://{vs0.url}/admin/delete_volume",
+                  {"volume_id": 1})
+        # lose shard 13 (held only by vs1) so a rebuild has real work
+        http_json("POST", f"http://{vs1.url}/admin/ec/delete",
+                  {"volume_id": 1, "shard_ids": [13]})
+        vs0.heartbeat_now()
+        vs1.heartbeat_now()
+        yield master, vs0, vs1, str(d1)
+        vs0.stop()
+        vs1.stop()
+        master.stop()
+
+    def _rebuild_and_fetch(self, master):
+        from seaweedfs_tpu.shell import CommandEnv, run_command
+
+        env = CommandEnv(master.url)
+        run_command(env, "lock")
+        out = run_command(env, "ec.rebuild -volumeId 1")
+        # shard 13 is always rebuilt; a demoted corrupt survivor may be
+        # re-made alongside it (e.g. "rebuilt shards [8, 13]")
+        assert "rebuilt shards" in out and "13]" in out, out
+        trace_id = env.last_trace_id
+        run_command(env, "unlock")
+        doc = _fetch_trace(
+            master, trace_id,
+            want=lambda d: any(s["name"] == "http.volume.ec_rebuild"
+                               for s in d["spans"]))
+        assert doc is not None, "rebuild trace never reached collector"
+        return doc
+
+    def test_rebuild_stitches_and_names_bounding_hop(self, ec_cluster):
+        master, vs0, vs1, _d1 = ec_cluster
+        doc = self._rebuild_and_fetch(master)
+        names = {s["name"] for s in doc["spans"]}
+        # survivor copies crossed servers under ONE trace id
+        assert "http.volume.ec_copy" in names
+        assert "http.volume.ec_rebuild" in names
+        assert "rpc.client" in names
+        # the shell process records no spans (tracer ring is shared in
+        # this test process, so spans DO exist here) — the contract is
+        # that every server-side span parents into one tree per root
+        an = doc["analysis"]
+        assert an["bounding_hop"] is not None
+        assert an["network_s"] >= 0.0
+        assert an["server_s"], "no per-server occupancy computed"
+        assert an["hops"], "no cross-server hops extracted"
+        # clean run: no in-trace recovery events
+        assert an["degrade_events"] == 0
+
+    def test_corrupt_survivor_flips_verdict_degraded(self, ec_cluster):
+        import os
+
+        from seaweedfs_tpu.ec.layout import to_ext
+        from seaweedfs_tpu.storage.volume import volume_file_prefix
+
+        master, vs0, vs1, d1 = ec_cluster
+        # rot a survivor data shard on vs1 before the rebuild reads it
+        shard8 = volume_file_prefix(d1, "", 1) + to_ext(8)
+        assert os.path.exists(shard8)
+        _flip(shard8, 4096)
+        doc = self._rebuild_and_fetch(master)
+        an = doc["analysis"]
+        # verify-on-use demoted the rotted survivor mid-rebuild and the
+        # retry rode the SAME trace: the stitched verdict is DEGRADED
+        assert any(s["name"] == "pipeline.retry"
+                   and s["attrs"].get("reason") == "corrupt_shard"
+                   for s in doc["spans"])
+        assert an["degrade_events"] > 0
+        assert an["degraded"] is True
+
+
+class TestGatewayFilerVolume:
+    @pytest.fixture
+    def stack(self, cluster, tmp_path):
+        from seaweedfs_tpu.filer.server import FilerServer
+        from seaweedfs_tpu.gateway.s3 import S3ApiServer
+
+        master, servers = cluster
+        filer = FilerServer(master.url, port=free_port(),
+                            max_chunk_mb=1).start()
+        s3 = S3ApiServer(filer, port=free_port()).start()
+        yield master, filer, s3
+        s3.stop()
+        filer.stop()
+
+    def test_s3_write_read_one_rooted_tree(self, stack):
+        master, filer, s3 = stack
+        status, _, _ = http_bytes("PUT", f"http://{s3.url}/tb")
+        assert status == 200
+        payload = b"x" * (3 << 20)  # 3 chunks at max_chunk_mb=1
+        status, _, hdrs = http_bytes(
+            "PUT", f"http://{s3.url}/tb/obj", payload, headers=FORCE)
+        assert status == 200
+        put_tid = hdrs["X-Trace-Id"]
+        doc = _fetch_trace(
+            master, put_tid,
+            want=lambda d: any(s["name"].startswith("http.volume.")
+                               for s in d["spans"]))
+        assert doc is not None
+        root = _assert_one_rooted_tree(doc)
+        assert root["name"].startswith("http.s3.")
+        names = {s["name"] for s in doc["spans"]}
+        # gateway -> (filer in-process) -> master assign -> volume write:
+        # the whole fan-out rides ONE trace id
+        assert any(n.startswith("http.master.") for n in names)
+        assert any(n.startswith("http.volume.") for n in names)
+
+        status, body, hdrs = http_bytes(
+            "GET", f"http://{s3.url}/tb/obj", headers=FORCE)
+        assert status == 200 and body == payload
+        get_tid = hdrs["X-Trace-Id"]
+        assert get_tid != put_tid
+        doc = _fetch_trace(
+            master, get_tid,
+            want=lambda d: any(s["name"].startswith("http.volume.")
+                               for s in d["spans"]))
+        assert doc is not None
+        root = _assert_one_rooted_tree(doc)
+        assert root["name"].startswith("http.s3.")
+        # the parallel chunk reads kept the context (filer read pool)
+        assert sum(1 for s in doc["spans"]
+                   if s["name"].startswith("http.volume.")) >= 3

@@ -1,0 +1,446 @@
+"""Trace-context propagation units (observability/context.py + friends).
+
+The Dapper layer's contracts, pinned without any live server:
+traceparent parse/format round-trips, malformed headers mint fresh
+instead of erroring, head-based sampling decisions stick and propagate,
+span re-rooting under a remote parent, ring-eviction drop accounting,
+the collector's dedup/eviction, and the RED-histogram exemplar bridge.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import pytest
+
+from seaweedfs_tpu.observability import analyze, analyze_cluster
+from seaweedfs_tpu.observability import context as tc
+from seaweedfs_tpu.observability.collector import TraceCollector
+from seaweedfs_tpu.observability.tracer import Tracer
+from seaweedfs_tpu.stats.aggregate import parse_prometheus_text
+from seaweedfs_tpu.stats.metrics import Histogram
+
+
+@pytest.fixture(autouse=True)
+def _clean_context():
+    """Every test starts and ends with no active decision on this
+    thread and the default sampling rate."""
+    tc.activate(None)
+    tc.set_sample_rate(1.0)
+    yield
+    tc.activate(None)
+    tc.set_sample_rate(1.0)
+
+
+class TestTraceparentFormat:
+    def test_round_trip_sampled(self):
+        tid = tc.new_trace_id()
+        hdr = tc.format_traceparent(tid, "p3f2a.1c", sampled=True)
+        ctx = tc.parse_traceparent(hdr)
+        assert type(ctx) is tc.TraceContext
+        assert ctx.trace_id == tid and ctx.span_id == "p3f2a.1c"
+
+    def test_round_trip_root_parent(self):
+        tid = tc.new_trace_id()
+        ctx = tc.parse_traceparent(tc.format_traceparent(tid, ""))
+        assert ctx.trace_id == tid and ctx.span_id == ""
+
+    def test_not_sampled_flag_and_zero_trace(self):
+        tid = tc.new_trace_id()
+        assert tc.parse_traceparent(
+            tc.format_traceparent(tid, "x.1", sampled=False)) \
+            is tc.NOT_SAMPLED
+        assert tc.parse_traceparent(tc.NOT_SAMPLED_HEADER) \
+            is tc.NOT_SAMPLED
+
+    def test_malformed_headers_return_none(self):
+        for bad in ("", "garbage", "00-short-x-01",
+                    "00-" + "g" * 32 + "-x-01",          # non-hex trace
+                    "99-" + "0" * 31 + "1-x-01",          # bad version
+                    "00-" + "0" * 31 + "1-x-02",          # bad flags
+                    "00-" + "0" * 31 + "1--01",           # empty parent
+                    "00-" + "0" * 31 + "1-a b-01"):       # space in parent
+            assert tc.parse_traceparent(bad) is None, bad
+
+    def test_new_trace_ids_are_unique_32_hex(self):
+        ids = {tc.new_trace_id() for _ in range(64)}
+        assert len(ids) == 64
+        assert all(len(i) == 32 and int(i, 16) >= 0 for i in ids)
+
+
+class _Headers(dict):
+    def get(self, k, default=None):  # case-exact like our CIHeaders.get
+        return dict.get(self, k, default)
+
+
+class TestIngressDecision:
+    def test_valid_header_adopted(self):
+        tid = tc.new_trace_id()
+        ctx = tc.ingress_context(
+            _Headers({tc.TRACEPARENT_HEADER:
+                      tc.format_traceparent(tid, "up.9")}))
+        assert ctx.trace_id == tid and ctx.span_id == "up.9"
+
+    def test_malformed_header_mints_fresh_never_errors(self):
+        ctx = tc.ingress_context(
+            _Headers({tc.TRACEPARENT_HEADER: "total-garbage"}))
+        assert type(ctx) is tc.TraceContext and len(ctx.trace_id) == 32
+
+    def test_force_header_beats_rate(self):
+        tc.set_sample_rate(0.0)
+        ctx = tc.ingress_context(_Headers({tc.FORCE_HEADER: "1"}))
+        assert type(ctx) is tc.TraceContext
+
+    def test_force_header_falsey_values_do_not_force(self):
+        # 'X-Force-Trace: 0' is an opt-out, not a truthy string
+        tc.set_sample_rate(0.0)
+        for v in ("0", "false", "no", "off", "", "  "):
+            assert tc.ingress_context(_Headers({tc.FORCE_HEADER: v})) \
+                is tc.NOT_SAMPLED, v
+
+    def test_rate_zero_declines_rate_one_samples(self):
+        tc.set_sample_rate(0.0)
+        assert tc.ingress_context(None) is tc.NOT_SAMPLED
+        tc.set_sample_rate(1.0)
+        assert type(tc.ingress_context(None)) is tc.TraceContext
+
+    def test_upstream_negative_decision_wins_over_local_rate(self):
+        tc.set_sample_rate(1.0)
+        ctx = tc.ingress_context(
+            _Headers({tc.TRACEPARENT_HEADER: tc.NOT_SAMPLED_HEADER}))
+        assert ctx is tc.NOT_SAMPLED
+
+    def test_begin_end_request_restores(self):
+        sampled, prev = tc.begin_request(None)
+        assert sampled is not None and tc.current() is sampled
+        tc.end_request(prev)
+        assert tc.current() is None
+
+
+class TestPropagation:
+    def test_inject_carries_current_span_id(self):
+        tr = Tracer(capacity=16)
+        ctx = tc.TraceContext(tc.new_trace_id(), "remote.1")
+        tc.activate(ctx)
+        import seaweedfs_tpu.observability.tracer as tracer_mod
+        orig = tracer_mod._GLOBAL
+        tracer_mod._GLOBAL = tr
+        try:
+            with tr.span("outer"):
+                h = tc.inject_trace_headers({})
+                hdr = h[tc.TRACEPARENT_HEADER]
+                ctx2 = tc.parse_traceparent(hdr)
+                assert ctx2.trace_id == ctx.trace_id
+                assert ctx2.span_id == tr.current_span_id()
+        finally:
+            tracer_mod._GLOBAL = orig
+
+    def test_inject_not_sampled_and_no_decision(self):
+        tc.activate(tc.NOT_SAMPLED)
+        assert tc.inject_trace_headers({})[tc.TRACEPARENT_HEADER] \
+            == tc.NOT_SAMPLED_HEADER
+        tc.activate(None)
+        assert tc.inject_trace_headers({}) == {}
+
+    def test_span_rerooted_under_remote_parent_and_tagged(self):
+        tr = Tracer(capacity=16)
+        tid = tc.new_trace_id()
+        tc.activate(tc.TraceContext(tid, "caller.7"))
+        with tr.span("http.volume.read"):
+            pass
+        sp = tr.snapshot()[0]
+        assert sp.parent_id == "caller.7" and sp.trace_id == tid
+
+    def test_not_sampled_thread_records_nothing(self):
+        tr = Tracer(capacity=16)
+        tc.activate(tc.NOT_SAMPLED)
+        with tr.span("hot.path"):
+            pass
+        assert tr.add_span("x", 0.0, 1.0) is None
+        assert tr.snapshot() == []
+
+    def test_undecided_background_thread_still_records(self):
+        tr = Tracer(capacity=16)
+        with tr.span("pipeline.fill"):
+            pass
+        assert len(tr.snapshot()) == 1
+        assert tr.snapshot()[0].trace_id is None
+
+    def test_fork_for_thread_folds_open_span(self):
+        tr = Tracer(capacity=16)
+        import seaweedfs_tpu.observability.tracer as tracer_mod
+        orig = tracer_mod._GLOBAL
+        tracer_mod._GLOBAL = tr
+        try:
+            tc.activate(tc.TraceContext(tc.new_trace_id(), ""))
+            with tr.span("request"):
+                fork = tc.fork_for_thread()
+                assert fork.span_id == tr.current_span_id()
+                recorded = []
+
+                def worker():
+                    with tc.scope(fork):
+                        with tr.span("worker.op"):
+                            pass
+                        recorded.extend(tr.snapshot())
+
+                t = threading.Thread(target=worker)
+                t.start()
+                t.join()
+            assert any(sp.name == "worker.op"
+                       and sp.parent_id == fork.span_id
+                       for sp in recorded)
+        finally:
+            tracer_mod._GLOBAL = orig
+
+
+class TestDropAccounting:
+    def test_ring_eviction_counts(self):
+        tr = Tracer(capacity=4)
+        for i in range(7):
+            tr.add_span(f"s{i}", 0.0, 1.0)
+        assert tr.dropped == 3
+        assert len(tr.snapshot()) == 4
+        assert analyze(tr)["spans_dropped"] == 3
+        # the to_dict round trip carries the loss accounting
+        assert tr.to_dict()["dropped"] == 3
+
+    def test_render_report_warns_on_truncation(self):
+        from seaweedfs_tpu.observability import render_report
+
+        tr = Tracer(capacity=2)
+        for i in range(5):
+            tr.add_span(f"s{i}", 0.0, 1.0)
+        out = render_report(analyze(tr))
+        assert "TRUNCATED" in out and "3 spans dropped" in out
+
+    def test_clear_rebaselines_dropped(self):
+        # an old overflow must not flag every LATER complete capture as
+        # truncated: draining the ring re-baselines the per-ring count
+        tr = Tracer(capacity=2)
+        for i in range(5):
+            tr.add_span(f"s{i}", 0.0, 1.0)
+        assert tr.dropped == 3
+        tr.snapshot(clear=True)
+        assert tr.dropped == 0
+        tr.add_span("fresh", 0.0, 1.0)
+        assert analyze(tr)["spans_dropped"] == 0
+        for i in range(5):
+            tr.add_span(f"t{i}", 0.0, 1.0)
+        tr.clear()
+        assert tr.dropped == 0
+
+    def test_namespaces_unique_and_header_safe(self):
+        # the collector dedups by span id, so two tracers (think: two
+        # containerized servers both running as pid 1) must never mint
+        # colliding ids — and the salted id must survive the
+        # dash-delimited traceparent header as the parent field
+        a, b = Tracer(capacity=4), Tracer(capacity=4)
+        assert a.namespace != b.namespace
+        tid = tc.new_trace_id()
+        with a.span("x"):
+            sid = a.current_span_id()
+            hdr = tc.format_traceparent(tid, sid, True)
+        ctx = tc.parse_traceparent(hdr)
+        assert ctx is not None and ctx.trace_id == tid
+        assert ctx.span_id == sid
+
+
+class TestCollector:
+    def _span(self, tid, sid, parent=None, name="op", t0=0.0, t1=1.0):
+        return {"name": name, "id": sid, "parent": parent, "pid": "pX",
+                "tid": 1, "thread": "t", "t0": t0, "t1": t1,
+                "attrs": {}, "trace": tid}
+
+    def test_ingest_dedup_and_server_stamp(self):
+        c = TraceCollector()
+        tid = tc.new_trace_id()
+        spans = [self._span(tid, "a.1"), self._span(tid, "a.2", "a.1")]
+        assert c.ingest("vs1:8080", spans) == 2
+        # re-ship (chained shippers) dedups by span id
+        assert c.ingest("vs2:8080", spans) == 0
+        doc = c.get(tid)
+        assert doc["span_count"] == 2
+        assert doc["servers"] == ["vs1:8080"]
+        assert all(sp["server"] == "vs1:8080" for sp in doc["spans"])
+
+    def test_trace_eviction_bounded_and_counted(self):
+        c = TraceCollector(max_traces=2)
+        tids = [tc.new_trace_id() for _ in range(4)]
+        for i, tid in enumerate(tids):
+            c.ingest("s", [self._span(tid, f"a.{i}")])
+        assert c.evicted_traces == 2
+        assert c.get(tids[0]) is None and c.get(tids[3]) is not None
+
+    def test_per_trace_span_cap_marks_dropped(self):
+        c = TraceCollector(max_spans_per_trace=3)
+        tid = tc.new_trace_id()
+        c.ingest("s", [self._span(tid, f"a.{i}") for i in range(5)])
+        doc = c.get(tid)
+        assert doc["span_count"] == 3 and doc["dropped"] == 2
+        # the cluster analysis surfaces the truncation
+        assert analyze_cluster(doc)["spans_dropped"] == 2
+
+    def test_summaries_most_recent_first(self):
+        c = TraceCollector()
+        t1, t2 = tc.new_trace_id(), tc.new_trace_id()
+        c.ingest("s", [self._span(t1, "a.1", name="first")])
+        c.ingest("s", [self._span(t2, "b.1", name="second")])
+        summ = c.summaries()
+        assert [s["trace_id"] for s in summ] == [t2, t1]
+        assert summ[0]["root"] == "second"
+
+
+class TestClusterAnalysis:
+    def _doc(self):
+        tid = tc.new_trace_id()
+        mk = TestCollector()._span
+        spans = [
+            mk(tid, "m.1", None, "http.master.vol_grow", 0.0, 1.0),
+            mk(tid, "m.2", "m.1", "rpc.client", 0.1, 0.9),
+            mk(tid, "v.1", "m.2", "http.volume.assign_volume", 0.2, 0.7),
+        ]
+        spans[0]["server"] = spans[1]["server"] = "master:9333"
+        spans[2]["server"] = "vs:8080"
+        return {"trace_id": tid, "dropped": 0, "spans": spans}
+
+    def test_hop_split_and_bounding(self):
+        rep = analyze_cluster(self._doc())
+        assert rep["servers"] == ["master:9333", "vs:8080"]
+        (hop,) = rep["hops"]
+        assert hop["from"] == "master:9333" and hop["to"] == "vs:8080"
+        assert abs(hop["client_s"] - 0.8) < 1e-6
+        assert abs(hop["server_s"] - 0.5) < 1e-6
+        assert abs(hop["network_s"] - 0.3) < 1e-6
+        assert rep["bounding_hop"]["kind"] == "hop"
+        assert rep["bounding_hop"]["to"] == "vs:8080"
+        assert not rep["degraded"]
+        # one rooted tree: path walks master request -> hop -> volume
+        names = [p["name"] for p in rep["critical_path"]]
+        assert names == ["http.master.vol_grow", "rpc.client",
+                         "http.volume.assign_volume"]
+
+    def test_participant_health_flips_verdict(self):
+        rep = analyze_cluster(self._doc(),
+                              health={"vs:8080": {"corrupt_shards": 2}})
+        assert rep["degraded"] and rep["degraded_servers"] == ["vs:8080"]
+
+    def test_error_span_flips_verdict(self):
+        doc = self._doc()
+        doc["spans"][2]["attrs"]["error"] = "ValueError"
+        rep = analyze_cluster(doc)
+        assert rep["error_spans"] == 1 and rep["degraded"]
+        assert rep["summary"].endswith("DEGRADED")
+
+    def test_empty_trace_renders_as_truncation_warning(self):
+        # a shipper whose flush failed leaves a collector entry with
+        # only a loss ledger — trace.fetch must render the INCOMPLETE
+        # warning, not KeyError
+        from seaweedfs_tpu.observability.analysis import \
+            render_cluster_report
+
+        rep = analyze_cluster({"trace_id": tc.new_trace_id(),
+                               "dropped": 7, "spans": []})
+        assert rep["span_count"] == 0 and rep["spans_dropped"] == 7
+        out = render_cluster_report(rep)
+        assert "INCOMPLETE" in out
+
+
+class TestServerStamping:
+    def test_record_time_server_beats_shipper_fallback(self):
+        # several servers sharing one process tracer (`weed server`,
+        # in-process fixtures) chain shippers; the collector keeps the
+        # FIRST ship of each span id, so attribution must come from the
+        # span itself (stamped via swap_server at the Router
+        # chokepoint), not from whichever shipper's flush won the race
+        tr = Tracer(capacity=16)
+        tid = tc.new_trace_id()
+        tc.activate(tc.TraceContext(tid))
+        prev = tc.swap_server("volume:8080")
+        try:
+            with tr.span("http.volume.read"):
+                pass
+        finally:
+            tc.swap_server(prev)
+        with tr.span("background.work"):  # no request identity
+            pass
+        assert tc.current_server() is None
+        docs = [sp.to_dict() for sp in tr.snapshot()]
+        c = TraceCollector()
+        # the MASTER's chained shipper wins the race and ships both
+        c.ingest("master:9333", docs)
+        doc = c.get(tid)
+        by_name = {s["name"]: s for s in doc["spans"]}
+        assert by_name["http.volume.read"]["server"] == "volume:8080"
+        # spans recorded outside any request fall back to the shipper
+        assert by_name["background.work"]["server"] == "master:9333"
+        rep = analyze_cluster(doc)
+        assert "volume:8080" in rep["per_server"]
+
+
+class TestShellTraceIds:
+    def test_prev_trace_id_survives_next_command(self):
+        # trace.fetch's own force-sampled ingress overwrites
+        # last_trace_id before its handler runs, so the bare-
+        # `trace.fetch` default reads prev_trace_id — the command the
+        # operator actually wants to inspect
+        from seaweedfs_tpu.shell.commands import (COMMANDS, CommandEnv,
+                                                  run_command)
+
+        seen = {}
+        COMMANDS["_test.noop"] = lambda env, flags: seen.update(
+            prev=env.prev_trace_id)
+        try:
+            env = CommandEnv("http://master.invalid")
+            run_command(env, "_test.noop")
+            first = env.last_trace_id
+            assert first and seen["prev"] == ""
+            run_command(env, "_test.noop")
+            assert seen["prev"] == first
+            assert env.last_trace_id and env.last_trace_id != first
+        finally:
+            COMMANDS.pop("_test.noop", None)
+
+
+class TestExemplars:
+    def test_exemplar_on_owning_bucket_line(self):
+        h = Histogram("t_lat_seconds", "x", labels=("op",))
+        h.observe("read", 0.002, exemplar="ab" * 16)
+        text = "\n".join(h.expose(exemplars=True))
+        assert ' # {trace_id="' + "ab" * 16 + '"} 0.002' in text
+        # exemplar rides exactly one bucket line
+        assert text.count("# {trace_id=") == 1
+
+    def test_default_exposition_is_strict_text_format(self):
+        # plain Prometheus text-format 0.0.4 scrapers choke on exemplar
+        # suffixes — they must be opt-in, never in the default exposition
+        h = Histogram("t_lat_seconds", "x", labels=("op",))
+        h.observe("read", 0.002, exemplar="ab" * 16)
+        assert "# {trace_id=" not in "\n".join(h.expose())
+
+    def test_openmetrics_accept_header_does_not_opt_in(self):
+        # modern Prometheus offers openmetrics-text by DEFAULT; honoring
+        # the Accept header without the full OpenMetrics framing
+        # (content type + '# EOF') would fail its whole scrape — only
+        # the explicit ?exemplars=1 query opts in
+        from seaweedfs_tpu.stats.metrics import exemplars_requested
+
+        class _Req:
+            query = {}
+            headers = {"Accept": "application/openmetrics-text, "
+                                 "text/plain;q=0.5"}
+
+        assert exemplars_requested(_Req()) is False
+        _Req.query = {"exemplars": "1"}
+        assert exemplars_requested(_Req()) is True
+
+    def test_aggregator_parses_exemplar_lines_exactly(self):
+        h = Histogram("t_lat_seconds", "x", labels=("op",))
+        for v in (0.002, 0.02, 5.0):
+            h.observe("read", v, exemplar="cd" * 16)
+        fams = parse_prometheus_text(
+            "# TYPE t_lat_seconds histogram\n"
+            + "\n".join(h.expose(exemplars=True)))
+        parsed = fams["t_lat_seconds"]
+        assert parsed._totals[("read",)] == 3
+        assert abs(parsed._sums[("read",)] - 5.022) < 1e-9

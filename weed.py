@@ -32,6 +32,31 @@ def _maybe_push_metrics(args) -> None:
                                                  15.0))
 
 
+def _maybe_enable_tracing(args) -> None:
+    """-trace.sample R (or WEED_TRACE_SAMPLE=R): turn the span tracer on
+    with head-based sampling at rate R in [0,1] — the distributed-
+    tracing knob.  Unset/negative leaves the tracer off (it can still be
+    flipped live via /debug/traces?enable=1, and a propagated
+    Traceparent from an upstream that DID sample always records)."""
+    import os as _os
+
+    rate = getattr(args, "trace_sample", -1.0)
+    if rate < 0:
+        env = _os.environ.get("WEED_TRACE_SAMPLE", "")
+        if not env:
+            return
+        try:
+            rate = float(env)
+        except ValueError:
+            return
+        if rate < 0:
+            return
+    from seaweedfs_tpu.observability import enable_tracing, set_sample_rate
+
+    enable_tracing()
+    set_sample_rate(rate)
+
+
 def _cluster_tls():
     """security.toml [tls] -> server ssl context (also installs the
     process-wide mTLS client side); None when TLS is not configured."""
@@ -1054,6 +1079,11 @@ def main(argv=None) -> None:
                    help="glog verbosity level")
     p.add_argument("-cpuprofile", default="", help="write CPU profile here")
     p.add_argument("-memprofile", default="", help="write memory profile here")
+    p.add_argument("-trace.sample", dest="trace_sample", type=float,
+                   default=-1.0, metavar="RATE",
+                   help="enable distributed tracing with this head "
+                        "sampling rate (0..1); negative/unset = off "
+                        "(WEED_TRACE_SAMPLE env var also works)")
     p.add_argument("-metricsPushUrl", default="",
                    help="prometheus pushgateway base url (push mode)")
     p.add_argument("-metricsPushSeconds", type=float, default=15.0)
@@ -1384,6 +1414,7 @@ def main(argv=None) -> None:
     glog.init(args.v)
     if args.cpuprofile or args.memprofile:
         grace.setup_profiling(args.cpuprofile, args.memprofile)
+    _maybe_enable_tracing(args)
     _maybe_push_metrics(args)
     args.fn(args)
 
