@@ -38,8 +38,15 @@ class CpuEngine:
     name = "cpu"
 
     def matmul(self, m: np.ndarray, shards: np.ndarray) -> np.ndarray:
+        out = np.zeros((m.shape[0], shards.shape[1]), dtype=np.uint8)
+        return self.matmul_into(m, shards, out)
+
+    def matmul_into(self, m: np.ndarray, shards: np.ndarray,
+                    out: np.ndarray) -> np.ndarray:
+        """Parity-only in-place variant: out[R, B] is caller-owned (a
+        recycled scratch) — no fresh R*B allocation per call."""
         r, k = m.shape
-        out = np.zeros((r, shards.shape[1]), dtype=np.uint8)
+        out[:] = 0
         for j in range(k):
             # MUL_TABLE[m[:, j]] is [R, 256]; fancy-index by the data column
             out ^= MUL_TABLE[m[:, j][:, None], shards[j][None, :]]
@@ -62,6 +69,28 @@ class NativeEngine:
 
     def matmul(self, m: np.ndarray, shards: np.ndarray) -> np.ndarray:
         return self._matmul(m, np.ascontiguousarray(shards))
+
+    def matmul_into(self, m: np.ndarray, shards: np.ndarray,
+                    out: np.ndarray) -> np.ndarray:
+        """Parity-only in-place variant through the row-pointer kernel:
+        the product lands straight in the caller's recycled scratch
+        (each out row must be contiguous; rows may be strided apart)."""
+        from .. import native
+
+        m = np.ascontiguousarray(m, dtype=np.uint8)
+        shards = np.ascontiguousarray(shards)
+        r, k = m.shape
+        n = shards.shape[1]
+        if out.shape != (r, n) or out.dtype != np.uint8 \
+                or out.strides[1] != 1:
+            # the C kernel writes n bytes at every out-row pointer; a
+            # mis-shaped target would be an out-of-bounds write
+            raise ValueError("out must be uint8 [R, B] with contiguous rows")
+        row = shards.strides[0]
+        native.gf_matmul_ptrs(
+            m, [shards.ctypes.data + i * row for i in range(k)],
+            [out[i].ctypes.data for i in range(r)], n)
+        return out
 
     def matmul_rows(self, m: np.ndarray,
                     rows: list[np.ndarray]) -> np.ndarray:
@@ -160,6 +189,37 @@ class ReedSolomon:
                 # failing the encode (byte-identical output)
                 return _fallback_matmul(self.parity_matrix, data,
                                         self.engine, e)
+
+    def encode_into(self, data: np.ndarray, out: np.ndarray) -> np.ndarray:
+        """Parity-only output variant of encode(): the product lands in
+        the caller-provided out[parity_shards, B] scratch — the chunked
+        encoders recycle ONE buffer across all chunks instead of
+        allocating r*B per call, and nothing but parity is ever
+        materialized.  Engines without an in-place kernel fall back to
+        matmul + copy; byte-identical either way (same fallback
+        discipline as encode())."""
+        if data.shape[0] != self.data_shards:
+            raise ValueError(f"expected {self.data_shards} data shards")
+        if out.shape != (self.parity_shards, data.shape[1]) \
+                or out.dtype != np.uint8 or out.strides[1] != 1:
+            raise ValueError("out must be uint8 [parity_shards, B] "
+                             "with contiguous rows")
+        with get_tracer().span("ec.encode", k=self.data_shards,
+                               r=self.parity_shards, bytes=int(data.nbytes),
+                               backend=self.engine.name):
+            data = np.ascontiguousarray(data)
+            try:
+                if hasattr(self.engine, "matmul_into"):
+                    return self.engine.matmul_into(self.parity_matrix,
+                                                   data, out)
+                out[:] = self.engine.matmul(self.parity_matrix, data)
+                return out
+            except ValueError:
+                raise  # shape/size validation, not an engine fault
+            except Exception as e:
+                out[:] = _fallback_matmul(self.parity_matrix, data,
+                                          self.engine, e)
+                return out
 
     def encode_shards(self, shards: list[np.ndarray]) -> None:
         """klauspost Encode: shards[0:data] in, shards[data:total] overwritten."""

@@ -61,12 +61,16 @@ def _encode_row(dat_file, rs: ReedSolomon, start_offset: int, block_size: int,
                 ) -> None:
     """Encode one row of data_shards blocks of block_size each
     (encodeData/encodeDataOneBatch, ec_encoder.go:120-192)."""
+    scratch = np.empty((rs.parity_shards, min(chunk, block_size)),
+                       dtype=np.uint8)
     for chunk_off in range(0, block_size, chunk):
         n = min(chunk, block_size - chunk_off)
         data = np.empty((rs.data_shards, n), dtype=np.uint8)
         for i in range(rs.data_shards):
             data[i] = _pread_padded(dat_file, n, start_offset + i * block_size + chunk_off)
-        parity = rs.encode(data)
+        # parity-only in-place encode: one scratch recycled across all
+        # chunks instead of an r*n allocation per chunk
+        parity = rs.encode_into(data, scratch[:, :n])
         for i in range(rs.data_shards):
             outputs[i].write(data[i].tobytes())
             if builder is not None:

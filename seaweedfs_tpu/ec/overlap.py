@@ -44,13 +44,27 @@ ack failure — the supervisor treats it exactly like worker death (kills
 the real process, respawns, replays), so chaos tests exercise the whole
 recovery path deterministically; `ec.shm` fires in spawn, so arming it
 makes respawns fail and drains the retry budget on demand.
+
+ASYNC DRAIN (PR 7): the pipelines no longer block their critical thread
+in fetch.  AsyncDrainer runs the per-dispatch fetch on a small thread
+pool and hands completed parity to ONE writer thread through a bounded
+FIFO queue, so D2H transfers (and worker acks) overlap the producer's
+fill/dispatch/write work.  The worker protocol grew the per-slot drain
+state that makes this safe: submit() and fetch() may now run on
+DIFFERENT threads (producer submits dispatch d+1 while the drainer is
+blocked fetching dispatch d), serialized around the supervision state
+by an internal lock, and abandon() marks the worker so a drainer
+blocked mid-fetch fails fast with WorkerGaveUp instead of burning the
+restart budget respawning a worker the caller already tore down.
 """
 
 from __future__ import annotations
 
+import concurrent.futures
 import multiprocessing as mp
 import os
 import queue as queue_mod
+import threading
 import time
 from collections import OrderedDict
 from multiprocessing import shared_memory
@@ -236,6 +250,17 @@ class _ParityWorkerBase:
         self._proc = None
         self._jobs = None
         self._acks = None
+        # per-slot drain state is now touched from TWO threads — the
+        # producer submits dispatch d+1 while the async drainer fetches
+        # dispatch d — so seq/inflight mutations and the whole
+        # kill+respawn+replay sequence serialize on this lock (never
+        # held across a blocking ack read: submit must not stall behind
+        # an in-progress fetch)
+        self._sup_lock = threading.RLock()
+        # abandon() raced against a drainer blocked in fetch: the flag
+        # makes recovery fail fast instead of respawning a worker the
+        # caller already tore down
+        self._abandoned = False
         # wall-clock [t0, t1) of the most recent fetched job — the
         # serializable span log the parent's tracer merges on drain
         self.last_job_span: tuple[float, float] | None = None
@@ -318,10 +343,21 @@ class _ParityWorkerBase:
 
     def _recover(self, cause: BaseException) -> None:
         """Kill + respawn + replay, with jittered exponential backoff;
-        raises WorkerGaveUp when the restart budget is exhausted."""
+        raises WorkerGaveUp when the restart budget is exhausted.
+        Serialized with submit/fetch state mutations via _sup_lock."""
+        with self._sup_lock:
+            self._recover_locked(cause)
+
+    def _recover_locked(self, cause: BaseException) -> None:
         t_rec0 = time.time()
         err = cause
         while True:
+            if self._abandoned:
+                # the caller tore this worker down (mid-encode fallback
+                # or abort): a drainer that was blocked in fetch must
+                # not respawn the corpse
+                raise WorkerGaveUp(
+                    f"parity worker abandoned: {err}") from cause
             if self.restarts >= self.max_restarts:
                 self._kill()
                 raise WorkerGaveUp(
@@ -359,16 +395,17 @@ class _ParityWorkerBase:
 
     # --- job flow ---------------------------------------------------------
     def _submit_payload(self, payload: tuple) -> int:
-        seq = self._seq_submit
-        self._seq_submit += 1
-        self._inflight[seq] = payload
-        try:
-            self._jobs.put(("job", seq, payload))
-        except Exception as e:
-            # a broken jobs queue is a worker fault like any other: the
-            # respawn replays this job from _inflight
-            self._recover(e)
-        return seq
+        with self._sup_lock:
+            seq = self._seq_submit
+            self._seq_submit += 1
+            self._inflight[seq] = payload
+            try:
+                self._jobs.put(("job", seq, payload))
+            except Exception as e:
+                # a broken jobs queue is a worker fault like any other:
+                # the respawn replays this job from _inflight
+                self._recover_locked(e)
+            return seq
 
     def _await_seq(self, seq: int):
         while True:
@@ -399,10 +436,12 @@ class _ParityWorkerBase:
         last_job_span.  Raises WorkerJobError if the job failed inside a
         live worker (seq consumed — recompute that dispatch and keep the
         worker), WorkerGaveUp when supervision exhausted its budget."""
-        seq = self._seq_fetch
+        with self._sup_lock:
+            seq = self._seq_fetch
         msg = self._await_seq(seq)
-        self._seq_fetch = seq + 1
-        self._inflight.pop(seq, None)
+        with self._sup_lock:
+            self._seq_fetch = seq + 1
+            self._inflight.pop(seq, None)
         if msg[0] == "err":
             self.last_job_span = None
             raise WorkerJobError(msg[2])
@@ -417,9 +456,10 @@ class _ParityWorkerBase:
         """Abandon the next FIFO result without reading it (the caller
         recomputed that dispatch itself): consume the seq so later
         fetches stay aligned; the eventual ack is deduped as stale."""
-        self._inflight.pop(self._seq_fetch, None)
-        self._done.pop(self._seq_fetch, None)
-        self._seq_fetch += 1
+        with self._sup_lock:
+            self._inflight.pop(self._seq_fetch, None)
+            self._done.pop(self._seq_fetch, None)
+            self._seq_fetch += 1
 
     def _open_in_worker(self, path: str) -> None:
         self._jobs.put(("open", path))
@@ -448,13 +488,20 @@ class _ParityWorkerBase:
         """Kill the worker process but keep the shared-memory slabs (and
         any parent-side numpy views into them) alive: a mid-encode CPU
         fallback keeps using the input slots as plain staging buffers;
-        close() runs later, after the views drop."""
+        close() runs later, after the views drop.  Also marks the worker
+        abandoned so a drainer thread blocked in fetch fails fast
+        (WorkerGaveUp) instead of respawning the corpse."""
+        self._abandoned = True
         self._kill()
 
     def _close_extra(self) -> None:
         pass
 
     def close(self) -> None:
+        # a closed worker is discarded for good: a drainer thread still
+        # blocked in fetch must fail fast (WorkerGaveUp), not respawn a
+        # process whose shm is about to be unlinked
+        self._abandoned = True
         try:
             if self._proc is not None and self._proc.is_alive():
                 self._jobs.put(None)
@@ -559,3 +606,121 @@ class FileParityWorker(_ParityWorkerBase):
 
     def submit(self, slot: int, base: int, block: int, n: int) -> None:
         self._submit_payload((slot, base, block, n))
+
+
+class AsyncDrainer:
+    """FIFO-preserving asynchronous drain for the streaming pipelines.
+
+    The producer (the pipeline's critical thread) calls submit(meta) and
+    moves straight on to filling/dispatching the next dispatch; the
+    blocking work happens elsewhere:
+
+      - fetch(meta) runs on a small thread pool.  pool_size=1 keeps a
+        strict FIFO fetch order — required by the seq-numbered worker
+        ack protocol — while device-array handles (independent D2H
+        copies) may use more threads to keep several transfers in
+        flight on the wire.
+      - write(meta, result) runs on ONE dedicated writer thread, fed in
+        SUBMISSION order through a bounded queue, so shard append order
+        and the `.eci` write-order crc stream stay byte-identical to
+        the serial pipeline no matter how fetches complete.
+
+    Error model: the first fetch/write exception is captured (later
+    results are consumed and discarded, never written) and re-raised
+    from finish() — or surfaced through .error for the producer to poll
+    between dispatches — so the pipeline's existing retry-from-
+    checkpoint machinery sees the failure exactly where the old inline
+    drain would have raised it.  abort() is the abnormal-exit path: it
+    discards queued work and joins the threads; the caller tears down
+    (abandons) any seq-numbered worker FIRST so a fetch blocked on a
+    dead worker unblocks fast instead of respawning it.
+    """
+
+    def __init__(self, fetch, write, pool_size: int = 1,
+                 queue_depth: int = 8, name: str = "ec-drain"):
+        self._fetch_fn = fetch
+        self._write_fn = write
+        self.pool_size = max(1, int(pool_size))
+        self._pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=self.pool_size, thread_name_prefix=f"{name}-fetch")
+        # bounded hand-off: sized by the caller to its slot count, so a
+        # put never blocks in practice but the queue cannot grow without
+        # bound if the contract is violated
+        self._wq: queue_mod.Queue = queue_mod.Queue(
+            maxsize=max(2, int(queue_depth)))
+        self._error: BaseException | None = None
+        self.aborting = False
+        self._inflight = 0
+        self._lock = threading.Lock()
+        self._finished = False
+        self._writer = threading.Thread(target=self._write_loop,
+                                        daemon=True, name=f"{name}-writer")
+        self._writer.start()
+
+    @property
+    def error(self):
+        """First fetch/write exception, or None.  The producer polls
+        this between dispatches to fail fast instead of filling slots
+        for a drain that can no longer complete."""
+        return self._error
+
+    @property
+    def inflight(self) -> int:
+        """Dispatches submitted but not yet written (or discarded)."""
+        return self._inflight
+
+    def submit(self, meta) -> None:
+        if self._error is not None:
+            raise self._error
+        with self._lock:
+            self._inflight += 1
+        fut = self._pool.submit(self._fetch_fn, meta)
+        self._wq.put((meta, fut))
+
+    def _write_loop(self) -> None:
+        while True:
+            item = self._wq.get()
+            if item is None:
+                return
+            meta, fut = item
+            try:
+                result = fut.result()
+                if not self.aborting and self._error is None:
+                    self._write_fn(meta, result)
+            except (KeyboardInterrupt, SystemExit):  # pragma: no cover
+                raise
+            except BaseException as e:
+                if self._error is None and not self.aborting:
+                    self._error = e
+            finally:
+                with self._lock:
+                    self._inflight -= 1
+
+    def finish(self, timeout: float | None = None) -> None:
+        """Wait until every submitted dispatch is fetched AND written,
+        then re-raise the first captured error (if any)."""
+        if not self._finished:
+            self._finished = True
+            self._wq.put(None)
+        self._writer.join(timeout)
+        if self._writer.is_alive():
+            raise RuntimeError("async drain writer stalled")
+        self._pool.shutdown(wait=True)
+        if self._error is not None:
+            raise self._error
+
+    def abort(self) -> None:
+        """Abnormal-exit teardown: discard queued work, join threads.
+        Never raises; the caller is already unwinding an exception."""
+        self.aborting = True
+        if not self._finished:
+            self._finished = True
+            try:
+                self._wq.put(None, timeout=1.0)
+            except queue_mod.Full:  # pragma: no cover - contract breach
+                pass
+        try:
+            self._writer.join(timeout=30)
+            self._pool.shutdown(wait=True)
+        except Exception:  # pragma: no cover - best-effort teardown
+            pass

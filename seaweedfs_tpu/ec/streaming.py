@@ -17,9 +17,23 @@ runs a depth-N asynchronous pipeline over fixed-shape dispatches:
     a host-side pass-through) overlap the device compute of dispatch d:
     `device_put` + the jitted kernel return immediately, and the parity
     fetch lags `depth` dispatches behind;
+  - the DRAIN is asynchronous and multi-buffered (overlap.AsyncDrainer):
+    only the parity rows ever cross back over the link (r/k of the
+    input — the data shards are already host bytes), the blocking fetch
+    runs on a drainer thread (a small pool for device encodes, so
+    several D2H copies ride the wire together), and a dedicated writer
+    thread appends parity + its `.eci` crc stream in strict FIFO
+    submission order — so checkpoint-resume and sidecar bytes are
+    identical to the serial pipeline.  The critical thread only ever
+    blocks on the slot pool (`drain_wait_s`; `pipeline.drain_wait`
+    spans), while the wire time lands on the concurrent drain track
+    (`drain_s`; `pipeline.drain` spans off-thread) — the split the
+    trace analyzer uses to tell "link-bound" from "drain-blocked";
   - host buffers are recycled from a small pool once their parity has
     been fetched (fetch implies the kernel consumed the input, which
-    also makes the zero-copy CPU-backend aliasing safe).
+    also makes the zero-copy CPU-backend aliasing safe); shm-backed
+    worker slots recycle after the parity WRITE (the fetched view
+    aliases the slot).
 
 Striping semantics are identical to encoder.write_ec_files (strict-`>`
 large rows, zero-padded tails, ec_encoder.go:194-231) — differential
@@ -29,7 +43,9 @@ tests enforce byte-identical shards against the CPU path.
 from __future__ import annotations
 
 import os
+import queue as queue_mod
 import sys
+import threading
 import time
 from collections import OrderedDict, deque
 from typing import Iterator, Optional
@@ -50,7 +66,7 @@ from .integrity import (
     sidecar_path,
     verify_shard_file,
 )
-from .overlap import WorkerGaveUp, WorkerJobError
+from .overlap import AsyncDrainer, WorkerGaveUp, WorkerJobError
 from .layout import (
     DATA_SHARDS_COUNT,
     LARGE_BLOCK_SIZE,
@@ -58,6 +74,16 @@ from .layout import (
     SMALL_BLOCK_SIZE,
     to_ext,
 )
+
+
+def default_drain_pool(cores: Optional[int] = None) -> int:
+    """Drainer fetch-pool width: one thread per spare core, bounded to
+    [1, 4].  D2H fetches are I/O-bound (the GIL drops during the copy)
+    so a few threads keep several transfers in flight on the wire
+    without oversubscribing the host; seq-numbered worker protocols
+    always drain on one thread regardless (FIFO acks)."""
+    n = cores if cores is not None else (os.cpu_count() or 1)
+    return max(1, min(4, n - 1))
 
 
 def _restart_total() -> int:
@@ -137,7 +163,9 @@ class StreamingEncoder:
                  max_worker_restarts: int = 3,
                  max_encode_retries: int = 2,
                  sidecar: bool = True,
-                 sidecar_block_size: Optional[int] = None):
+                 sidecar_block_size: Optional[int] = None,
+                 async_drain: Optional[bool] = None,
+                 drain_pool: Optional[int] = None):
         """engine: 'auto' uses the jax device path on a real accelerator
         and the host SIMD codec otherwise (jax-on-CPU is a correctness
         surface, ~200x slower than the AVX2 codec); 'device' forces the
@@ -160,7 +188,20 @@ class StreamingEncoder:
         sidecar: encodes also write the `.eci` block-crc sidecar
         (ec/integrity.py) and rebuilds verify survivors against it,
         demoting crc-mismatching shards to erasures; sidecar_block_size
-        overrides the crc block granularity (default 256KB)."""
+        overrides the crc block granularity (default 256KB).
+
+        async_drain: None (auto) engages the multi-buffered async drain
+        (overlap.AsyncDrainer) whenever the pipeline has a REAL
+        asynchronous producer — device kernel D2H, host worker pool, or
+        parity worker process — keeping up to depth+1 dispatches in
+        flight while a drainer thread pulls parity back and a writer
+        thread appends it in FIFO order; True/False force it on/off.
+        The pure-serial host path keeps the inline drain (nothing
+        asynchronous to overlap, and its stage spans must sum to the
+        wall).  drain_pool overrides the drainer fetch-thread count
+        (default: default_drain_pool(), sized from os.cpu_count(),
+        bounded [1, 4]; worker-backed encodes always use 1 — the seq
+        ack protocol is FIFO)."""
         from .codec import ReedSolomon, best_cpu_engine
 
         self.k = data_shards
@@ -189,6 +230,11 @@ class StreamingEncoder:
         self.drain_timeout_s = drain_timeout_s
         self.max_worker_restarts = max_worker_restarts
         self.max_encode_retries = max_encode_retries
+        self._async_drain = async_drain
+        self._drain_pool = (max(1, int(drain_pool)) if drain_pool
+                            else default_drain_pool())
+        # stats counters are bumped from the drainer/writer threads too
+        self._st_lock = threading.Lock()
         self._sidecar = sidecar
         self._sidecar_bs = sidecar_block_size
         self._fb_engine = None  # lazy CPU codec for per-dispatch fallback
@@ -400,7 +446,17 @@ class StreamingEncoder:
                       # on encodes, verify_s = survivor verification on
                       # rebuilds (bench reads these for the verify-
                       # overhead figure)
-                      "sidecar_s": 0.0, "verify_s": 0.0}
+                      "sidecar_s": 0.0, "verify_s": 0.0,
+                      # async-drain accounting: drain_s = CONCURRENT
+                      # fetch time on the drainer track (drain_wait_s
+                      # stays "host thread BLOCKED"), parity_bytes_
+                      # drained = bytes actually pulled back across the
+                      # link (parity only — r/k of bytes_in, the proof
+                      # the drain never fetches data shards), drain_pool
+                      # = fetch threads the drainer ran with (0 = inline
+                      # serial drain)
+                      "drain_s": 0.0, "parity_bytes_drained": 0,
+                      "drain_pool": 0}
         self._restart_base = _restart_total()
         return self.stats
 
@@ -419,10 +475,25 @@ class StreamingEncoder:
                                       np.ascontiguousarray(data))
 
     def _note_fallback(self, st: dict, reason: str) -> None:
-        st["fallbacks"] += 1
+        # called from the pipeline thread AND the drainer's fetch
+        # threads: the read-modify-write must not lose counts
+        with self._st_lock:
+            st["fallbacks"] += 1
         from ..stats import ec_pipeline_metrics
 
         ec_pipeline_metrics().engine_fallbacks.inc(reason)
+
+    def _drain_async_enabled(self) -> bool:
+        """Async drain engages whenever the pipeline has a REAL
+        asynchronous producer whose results arrive later (device kernel
+        D2H, host worker-pool future, parity-worker ack).  The pure-
+        serial host path keeps the inline drain: there is nothing to
+        overlap, and its per-dispatch stage spans must still sum to the
+        wall (the tracing contract)."""
+        if self._async_drain is not None:
+            return self._async_drain
+        return (self.engine != "host" or self._host_pool is not None
+                or self._proc_worker is not None)
 
     def _abandon_proc_worker(self) -> None:
         """Kill the staged worker but keep its shared memory alive: the
@@ -607,7 +678,16 @@ class StreamingEncoder:
             # LAZILY: with the overlap worker active parity arrives via
             # pwrite-from-shm, and populating r*shard_size of pages
             # upfront would be a wasted serial pass.
+            map_lock = threading.Lock()
+
             def parity_mappings() -> list[int]:
+                # called from the main thread (inline compute) AND the
+                # drainer's fetch thread (fallback recompute): the lazy
+                # init must not run twice
+                with map_lock:
+                    return _parity_mappings_locked()
+
+            def _parity_mappings_locked() -> list[int]:
                 if parity_addrs:
                     return parity_addrs
                 for j in range(r):
@@ -644,89 +724,148 @@ class StreamingEncoder:
             # compute overlap even on one core (bench.py measures the
             # mechanism at ~1.5-1.8x there)
             worker = self._file_parity_worker(mat, dat_path)
-            from collections import deque
+            # async multi-buffered drain: the ONLY drain this path has
+            # is the parity worker's ack stream, so the drainer engages
+            # exactly when the worker does.  One fetch thread pulls acks
+            # FIFO (seq protocol), the writer thread pwrites parity from
+            # the shm slots, and the MAIN thread keeps submitting spans
+            # and pwriting data shards — compute, parity writeback and
+            # data writes all overlap.  wstate lets the fetch thread
+            # retire a gave-up worker so the main loop switches to
+            # inline compute without a lock.
+            wstate: dict = {"worker": worker}
+            slot_q: queue_mod.Queue = queue_mod.Queue()
+            ds = {"drain_s": 0.0, "write_s": 0.0, "fallback_s": 0.0,
+                  "parity_bytes": 0}
+            ds_lock = threading.Lock()
+            drainer: Optional[AsyncDrainer] = None
 
-            pending: deque = deque()  # (slot, n, out_off, base, block)
-            slot_seq = 0
-
-            def drain_one():
-                nonlocal worker
-                slot, n, off, base, block, d_idx = pending.popleft()
+            def drain_fetch(meta):
+                """Fetch ONE dispatch's parity from the worker (drainer
+                fetch thread) — fault/fallback recompute lands straight
+                in the parity mappings, exactly like the serial path."""
+                slot, n, off, base, block, d_idx = meta
+                w = wstate["worker"]
                 parity = None
-                if worker is not None:
-                    t0 = clock()
-                    with tr.span("pipeline.drain", dispatch=d_idx):
-                        # injected drain fault: per-dispatch semantics,
-                        # same as the staged path — THIS dispatch
-                        # recomputes serially, the worker (which did
-                        # the work) gets its FIFO realigned and keeps
-                        # the rest of the encode.  Hit inside the span
-                        # so delay-only faults attribute to drain
-                        drain_fault = False
-                        if faultinject._points:
-                            try:
-                                faultinject.hit("ec.drain")
-                            except Exception:
-                                drain_fault = True
-                        if drain_fault:
-                            worker.skip_next()
-                            self._note_fallback(st, "drain_fault")
-                            tr.event("pipeline.fallback", dispatch=d_idx,
-                                     reason="drain_fault")
-                        else:
-                            try:
-                                parity = worker.fetch(slot)[:, :n]
-                            except WorkerJobError:
-                                # the job failed INSIDE a live worker
-                                # (input file vanished under it):
-                                # recompute this one dispatch serially,
-                                # keep the worker
-                                self._note_fallback(st, "worker_job")
-                                tr.event("pipeline.fallback",
-                                         dispatch=d_idx,
-                                         reason="worker_job")
-                            except (KeyboardInterrupt, SystemExit):
-                                raise
-                            except Exception as e:
-                                # supervision exhausted its respawn
-                                # budget (WorkerGaveUp) or desynced:
-                                # recompute the lost dispatches serially
-                                # and finish the encode without it
-                                self._drop_file_worker()
-                                worker = None
-                                reason = ("worker_gave_up"
-                                          if isinstance(e, WorkerGaveUp)
-                                          else "worker_error")
-                                self._note_fallback(st, reason)
-                                tr.event("pipeline.fallback",
-                                         dispatch=d_idx, reason=reason)
-                    st["drain_wait_s"] += clock() - t0
-                    if parity is not None:
-                        self._merge_worker_span(tr, worker, root.span_id,
-                                                d_idx)
-                if parity is None:
-                    t0 = clock()
-                    with tr.span("pipeline.compute", dispatch=d_idx,
-                                 bytes=k * n):
-                        matmul_ptrs(
-                            mat,
-                            [in_addr + base + i * block for i in range(k)],
-                            [a + off for a in parity_mappings()], n)
-                    st["dispatch_s"] += clock() - t0
-                else:
-                    t0 = clock()
-                    with tr.span("pipeline.write", dispatch=d_idx,
-                                 kind="parity"):
-                        for j in range(r):
-                            os.pwrite(out_fds[k + j],
-                                      memoryview(parity[j, :n]), off)
-                    st["write_s"] += clock() - t0
                 t0 = clock()
-                with tr.span("pipeline.write", dispatch=d_idx, kind="data"):
-                    for i in range(k):
-                        s = base + i * block
-                        os.pwrite(out_fds[i], in_mv[s:s + n], off)
-                st["write_s"] += clock() - t0
+                with tr.span("pipeline.drain", dispatch=d_idx,
+                             bytes=r * n):
+                    # injected drain fault: per-dispatch semantics —
+                    # THIS dispatch recomputes serially, the worker
+                    # (which did the work) gets its FIFO realigned and
+                    # keeps the rest of the encode.  Hit inside the span
+                    # so delay-only faults attribute to drain
+                    drain_fault = False
+                    if faultinject._points:
+                        try:
+                            faultinject.hit("ec.drain")
+                        except Exception:
+                            drain_fault = True
+                    if drain_fault:
+                        if w is not None:
+                            w.skip_next()
+                        self._note_fallback(st, "drain_fault")
+                        tr.event("pipeline.fallback", dispatch=d_idx,
+                                 reason="drain_fault")
+                    else:
+                        try:
+                            if w is None:  # lost on an earlier dispatch
+                                raise WorkerGaveUp("parity worker lost")
+                            parity = w.fetch(slot)[:, :n]
+                        except WorkerJobError:
+                            # the job failed INSIDE a live worker
+                            # (input file vanished under it): recompute
+                            # this one dispatch, keep the worker
+                            self._note_fallback(st, "worker_job")
+                            tr.event("pipeline.fallback",
+                                     dispatch=d_idx, reason="worker_job")
+                        except (KeyboardInterrupt, SystemExit):
+                            raise
+                        except Exception as e:
+                            if drainer is not None and drainer.aborting:
+                                raise  # teardown race, not a fault
+                            # supervision exhausted its respawn budget
+                            # (WorkerGaveUp) or desynced: recompute the
+                            # lost dispatches serially, finish without it
+                            self._drop_file_worker()
+                            wstate["worker"] = None
+                            reason = ("worker_gave_up"
+                                      if isinstance(e, WorkerGaveUp)
+                                      else "worker_error")
+                            self._note_fallback(st, reason)
+                            tr.event("pipeline.fallback",
+                                     dispatch=d_idx, reason=reason)
+                fetch_s = clock() - t0
+                if parity is not None:
+                    self._merge_worker_span(tr, w, root.span_id, d_idx)
+                    with ds_lock:
+                        ds["drain_s"] += fetch_s
+                        ds["parity_bytes"] += int(parity.nbytes)
+                    return parity  # slot recycles after the pwrite
+                with ds_lock:
+                    ds["drain_s"] += fetch_s
+                t0 = clock()
+                with tr.span("pipeline.compute", dispatch=d_idx,
+                             bytes=k * n):
+                    matmul_ptrs(
+                        mat,
+                        [in_addr + base + i * block for i in range(k)],
+                        [a + off for a in parity_mappings()], n)
+                with ds_lock:
+                    ds["fallback_s"] += clock() - t0
+                slot_q.put(slot)
+                return None
+
+            def drain_write(meta, parity):
+                if parity is None:  # fallback already stored via mmap
+                    return
+                slot, n, off, base, block, d_idx = meta
+                t0 = clock()
+                with tr.span("pipeline.write", dispatch=d_idx,
+                             kind="parity"):
+                    for j in range(r):
+                        os.pwrite(out_fds[k + j],
+                                  memoryview(parity[j, :n]), off)
+                with ds_lock:
+                    ds["write_s"] += clock() - t0
+                # parity was pwritten straight from the shm out slot:
+                # only now may the worker compute into it again
+                slot_q.put(slot)
+
+            if worker is not None:
+                drainer = AsyncDrainer(drain_fetch, drain_write,
+                                       pool_size=1,
+                                       queue_depth=worker.nbufs + 2,
+                                       name="ec-mmap-drain")
+                for i in range(worker.nbufs):
+                    slot_q.put(i)
+                st["drain_pool"] = drainer.pool_size
+
+            def acquire_slot() -> int:
+                if drainer.error is not None:
+                    raise drainer.error
+                try:
+                    return slot_q.get_nowait()
+                except queue_mod.Empty:
+                    pass
+                # every shm slot is in flight: the residual drain stall
+                t0 = clock()
+                try:
+                    with tr.span("pipeline.drain_wait"):
+                        deadline = time.monotonic() + max(
+                            4 * self.drain_timeout_s, 120.0)
+                        while True:
+                            try:
+                                return slot_q.get(timeout=0.2)
+                            except queue_mod.Empty:
+                                if drainer.error is not None:
+                                    raise drainer.error
+                                if time.monotonic() >= deadline:
+                                    raise RuntimeError(
+                                        "async drain stalled: no free "
+                                        "parity slot")
+                finally:
+                    st["drain_wait_s"] += clock() - t0
 
             try:
                 out_off = 0
@@ -734,9 +873,7 @@ class StreamingEncoder:
                         file_size, k, large, small, self.dispatch_b):
                     base = row_start + off
                     if base + (k - 1) * block + n <= file_size:
-                        if worker is not None and \
-                                len(pending) == worker.nbufs:
-                            drain_one()  # may drop a failed worker
+                        w = wstate["worker"]
                         # injected dispatch fault: per-dispatch
                         # semantics — THIS dispatch computes inline,
                         # the worker keeps the rest of the encode
@@ -746,30 +883,29 @@ class StreamingEncoder:
                                 faultinject.hit("ec.dispatch")
                             except Exception:
                                 dispatch_fault = True
-                        if worker is not None and dispatch_fault:
+                        if w is not None and dispatch_fault:
                             self._note_fallback(st, "dispatch_fault")
                             tr.event("pipeline.fallback",
                                      dispatch=st["dispatches"],
                                      reason="dispatch_fault")
-                        elif worker is not None:
-                            slot = slot_seq % worker.nbufs
-                            slot_seq += 1
+                        elif w is not None:
+                            slot = acquire_slot()  # may block: backpressure
                             t0 = clock()
                             submitted = False
                             with tr.span("pipeline.dispatch",
                                          dispatch=st["dispatches"],
                                          bytes=k * n):
                                 try:
-                                    worker.submit(slot, base, block, n)
+                                    w.submit(slot, base, block, n)
                                     submitted = True
                                 except (KeyboardInterrupt, SystemExit):
                                     raise
                                 except Exception as e:
-                                    # submit path gave up: drain what's
-                                    # in flight serially, finish without
-                                    # the worker
+                                    # submit path gave up: the drainer
+                                    # recomputes what's in flight,
+                                    # finish without the worker
                                     self._drop_file_worker()
-                                    worker = None
+                                    wstate["worker"] = None
                                     reason = ("worker_gave_up"
                                               if isinstance(e, WorkerGaveUp)
                                               else "worker_error")
@@ -779,12 +915,29 @@ class StreamingEncoder:
                                              reason=reason)
                             st["dispatch_s"] += clock() - t0
                             if submitted:
-                                pending.append((slot, n, out_off, base,
-                                                block, st["dispatches"]))
+                                d_idx = st["dispatches"]
+                                # data shards pwrite NOW, from the input
+                                # mapping, while the worker computes the
+                                # parity this dispatch just submitted
+                                t0 = clock()
+                                with tr.span("pipeline.write",
+                                             dispatch=d_idx, kind="data"):
+                                    for i in range(k):
+                                        s = base + i * block
+                                        os.pwrite(out_fds[i],
+                                                  in_mv[s:s + n], out_off)
+                                st["write_s"] += clock() - t0
+                                # a blocking put on the bounded writer
+                                # queue is drain-stall time
+                                t0 = clock()
+                                drainer.submit((slot, n, out_off, base,
+                                                block, d_idx))
+                                st["drain_wait_s"] += clock() - t0
                                 st["dispatches"] += 1
                                 st["bytes_in"] += k * n
                                 out_off += n
                                 continue
+                            slot_q.put(slot)  # submit failed: slot unused
                         # all k source rows fully inside the file: matmul
                         # in place from the mapping, parity stored
                         # straight into the output mappings
@@ -842,14 +995,38 @@ class StreamingEncoder:
                     st["dispatches"] += 1
                     st["bytes_in"] += k * n
                     out_off += n
-                while pending:
-                    drain_one()
+                if drainer is not None:
+                    # tail stall: the last in-flight parity finishes
+                    # fetching + writing
+                    t0 = clock()
+                    with tr.span("pipeline.drain_wait", final=True):
+                        drainer.finish()
+                    st["drain_wait_s"] += clock() - t0
             finally:
-                if pending:
-                    # abnormal exit with submitted-but-undrained jobs:
-                    # their acks would desync the next encode's protocol
-                    # — drop the worker, a later encode respawns fresh
-                    self._drop_file_worker()
+                if drainer is not None:
+                    if drainer.inflight:
+                        # abnormal exit with submitted-but-undrained
+                        # jobs: their acks would desync the next
+                        # encode's protocol.  Flag the abort FIRST so
+                        # the fetch thread skips recovery/fallback, then
+                        # abandon+drop the worker so a blocked fetch
+                        # unwinds fast; a later encode respawns fresh
+                        drainer.aborting = True
+                        w = wstate["worker"]
+                        if w is not None:
+                            try:
+                                w.abandon()
+                            except Exception:  # pragma: no cover
+                                pass
+                        self._drop_file_worker()
+                        wstate["worker"] = None
+                    # join the drain threads BEFORE the input views are
+                    # released below (the fetch fallback reads in_addr)
+                    drainer.abort()
+                    st["drain_s"] += ds["drain_s"]
+                    st["write_s"] += ds["write_s"]
+                    st["dispatch_s"] += ds["fallback_s"]
+                    st["parity_bytes_drained"] += ds["parity_bytes"]
                 # the view and exported memoryview must drop before the
                 # mmap closes or close() raises BufferError
                 if in_mv is not None:
@@ -1024,15 +1201,27 @@ class StreamingEncoder:
         pending: deque[tuple[object, int, int, int, int]] = deque()
 
         ok = False
-        degraded = False  # terminal fault: rest of the encode goes CPU
+        flags = {"degraded": False}  # terminal fault: rest goes CPU
+        # concurrent-side accounting (drainer fetch threads + writer
+        # thread own these keys; folded into st once the threads join)
+        ds = {"drain_s": 0.0, "write_s": 0.0, "sidecar_s": 0.0,
+              "fallback_s": 0.0, "parity_bytes": 0}
+        ds_lock = threading.Lock()
+        slot_q: queue_mod.Queue = queue_mod.Queue()
+        drainer: Optional[AsyncDrainer] = None
 
-        def drain_one():
-            nonlocal degraded
-            parity_dev, u, bi, d_idx, nfills = pending.popleft()
+        def drain_fetch_core(meta):
+            """Fetch (or fault/fallback-recompute) ONE dispatch's parity
+            — the only place kernel output crosses back to the host.
+            Runs on the drainer's fetch pool in async mode, inline on
+            the pipeline thread in serial mode.  Returns
+            (parity[:, :u], fetch_s, fallback_s, fetched_bytes)."""
+            parity_dev, u, bi, d_idx, nfills = meta
             is_proc = isinstance(parity_dev, tuple) and \
                 parity_dev[0] == "proc"
             parity = None
             reason = None
+            nbytes = 0
             t0 = clock()
             with tr.span("pipeline.drain", dispatch=d_idx, bytes=r * u):
                 # injected drain fault: the dispatch recomputes on the
@@ -1053,6 +1242,10 @@ class StreamingEncoder:
                 else:
                     try:
                         parity = self._fetch(parity_dev)
+                        # parity-only accounting: what actually crossed
+                        # the link (r/k of bytes_in — data shards never
+                        # transfer back)
+                        nbytes = int(parity.nbytes)
                     except WorkerJobError:
                         # failed inside a live worker: recompute this one
                         # dispatch, keep the worker (seq already consumed)
@@ -1060,6 +1253,8 @@ class StreamingEncoder:
                     except (KeyboardInterrupt, SystemExit):
                         raise
                     except Exception as e:
+                        if drainer is not None and drainer.aborting:
+                            raise  # teardown race, not a pipeline fault
                         if isinstance(e, WorkerGaveUp):
                             reason = "worker_gave_up"
                         elif is_proc:
@@ -1068,54 +1263,142 @@ class StreamingEncoder:
                             reason = "device_fetch"
                         if is_proc:
                             self._abandon_proc_worker()
-                        degraded = True
-            st["drain_wait_s"] += clock() - t0
+                        flags["degraded"] = True
+            fetch_s = clock() - t0
             if parity is not None and is_proc and \
                     self._proc_worker is not None:
                 self._merge_worker_span(tr, self._proc_worker,
                                         root.span_id, d_idx)
+            fb_s = 0.0
             if parity is None:
-                # the input buffer is still intact: buffers are only
-                # recycled (free.append below) after their dispatch
-                # drains, so the CPU codec can recompute losslessly
+                # the input buffer is still intact: slots recycle only
+                # after their dispatch is fetched (or recomputed here),
+                # so the CPU codec can recompute losslessly
                 t0 = clock()
                 with tr.span("pipeline.fallback", dispatch=d_idx,
                              reason=reason):
                     parity = self._cpu_parity(bufs[bi][:, :u])
-                st["dispatch_s"] += clock() - t0
+                fb_s = clock() - t0
                 self._note_fallback(st, reason)
+            return parity[:, :u], fetch_s, fb_s, nbytes
+
+        def drain_write_core(meta, parity):
+            """Write ONE dispatch's parity + crc stream and advance the
+            FIFO checkpoint; runs on the writer thread in async mode.
+            Returns (write_s, sidecar_s)."""
+            parity_dev, u, bi, d_idx, nfills = meta
             t0 = clock()
+            sc = 0.0
             # entries pack side by side, so each parity row's bytes for
             # this dispatch are one contiguous slice
             with tr.span("pipeline.write", dispatch=d_idx, kind="parity"):
                 for j in range(r):
                     outputs[k + j].write(memoryview(parity[j, :u]))
                 if sb is not None:
-                    # drain order is FIFO == write order, so each parity
-                    # row's crc stream stays sequential; the crc time
-                    # counts as write stage (per-chunk output post-
-                    # processing — unattributed it would read as missing
-                    # wall in the trace) and is broken out in sidecar_s
-                    # for the bench overhead figure
+                    # drain order is FIFO == write order (the async
+                    # writer consumes in submission order), so each
+                    # parity row's crc stream stays sequential; the crc
+                    # time counts as write stage and is broken out in
+                    # sidecar_s for the bench overhead figure
                     t1 = clock()
                     for j in range(r):
                         sb.update(k + j, parity[j, :u])
-                    st["sidecar_s"] += clock() - t1
-            st["write_s"] += clock() - t0
-            free.append(bi)
+                    sc = clock() - t1
+            w_s = clock() - t0
             # dispatch d_idx is fully drained AND written on every shard:
             # advance the resume checkpoint past its entries/bytes
             ck_e, ck_b = self._ckpt
             self._ckpt = (ck_e + nfills, ck_b + u)
+            return w_s, sc
+
+        def drain_one():
+            """Serial drain: fetch + write inline on the pipeline thread
+            (fetch time IS host-blocked time here)."""
+            meta = pending.popleft()
+            parity, fetch_s, fb_s, nbytes = drain_fetch_core(meta)
+            st["drain_wait_s"] += fetch_s
+            st["dispatch_s"] += fb_s
+            st["parity_bytes_drained"] += nbytes
+            w_s, sc = drain_write_core(meta, parity)
+            st["write_s"] += w_s
+            st["sidecar_s"] += sc
+            free.append(meta[2])
+
+        def drain_fetch_async(meta):
+            parity, fetch_s, fb_s, nbytes = drain_fetch_core(meta)
+            with ds_lock:
+                ds["drain_s"] += fetch_s
+                ds["fallback_s"] += fb_s
+                ds["parity_bytes"] += nbytes
+            if not (isinstance(meta[0], tuple) and meta[0][0] == "proc"):
+                # device/host handles: the fetched parity is an
+                # independent host array and fetch completion proves the
+                # kernel consumed the input slot — recycle NOW so the
+                # producer refills while this parity queues for writing
+                slot_q.put(meta[2])
+            return parity
+
+        def drain_write_async(meta, parity):
+            w_s, sc = drain_write_core(meta, parity)
+            with ds_lock:
+                ds["write_s"] += w_s
+                ds["sidecar_s"] += sc
+            if isinstance(meta[0], tuple) and meta[0][0] == "proc":
+                # proc parity is a VIEW into the shm out slot (same
+                # index as the input slot): recycle only once written
+                slot_q.put(meta[2])
+
+        def acquire_slot() -> int:
+            if drainer is None:
+                return free.popleft()
+            if drainer.error is not None:
+                raise drainer.error
+            try:
+                return slot_q.get_nowait()
+            except queue_mod.Empty:
+                pass
+            # every slot is in flight: THIS is the pipeline's residual
+            # drain stall — the one the async drain exists to shrink
+            t0 = clock()
+            try:
+                with tr.span("pipeline.drain_wait"):
+                    deadline = time.monotonic() + max(
+                        4 * self.drain_timeout_s, 120.0)
+                    while True:
+                        try:
+                            return slot_q.get(timeout=0.2)
+                        except queue_mod.Empty:
+                            if drainer.error is not None:
+                                raise drainer.error
+                            if time.monotonic() >= deadline:
+                                raise RuntimeError(
+                                    "async drain stalled: no free "
+                                    "dispatch slot")
+            finally:
+                st["drain_wait_s"] += clock() - t0
+
+        if self._drain_async_enabled():
+            # multi-buffered async drain: worker-backed encodes fetch on
+            # ONE thread (FIFO ack protocol); device encodes may keep
+            # several D2H copies in flight
+            pool = 1 if (self.engine == "host"
+                         or self._proc_worker is not None) \
+                else self._drain_pool
+            drainer = AsyncDrainer(drain_fetch_async, drain_write_async,
+                                   pool_size=pool,
+                                   queue_depth=len(bufs) + 2)
+            for i in range(len(bufs)):
+                slot_q.put(i)
+            st["drain_pool"] = drainer.pool_size
 
         try:
             with open(dat_path, "rb") as dat:
                 fills: list[tuple[int, int, int, int, int]] = []
                 used = 0
-                bi = free.popleft()
+                bi = acquire_slot()
 
                 def flush():
-                    nonlocal bi, used, fills, degraded
+                    nonlocal bi, used, fills
                     if not used:
                         return
                     d_idx = st["dispatches"]
@@ -1154,8 +1437,8 @@ class StreamingEncoder:
                     t0 = clock()
                     with tr.span("pipeline.dispatch", dispatch=d_idx,
                                  bytes=k * used):
-                        if degraded or dispatch_fault:
-                            reason = ("degraded" if degraded
+                        if flags["degraded"] or dispatch_fault:
+                            reason = ("degraded" if flags["degraded"]
                                       else "dispatch_fault")
                             parity_dev = self._cpu_parity(buf[:, :used])
                             self._note_fallback(st, reason)
@@ -1175,7 +1458,7 @@ class StreamingEncoder:
                                 # submit gave up: this and all later
                                 # dispatches degrade to the CPU codec
                                 self._abandon_proc_worker()
-                                degraded = True
+                                flags["degraded"] = True
                                 reason = ("worker_gave_up"
                                           if isinstance(e, WorkerGaveUp)
                                           else "worker_error")
@@ -1191,7 +1474,7 @@ class StreamingEncoder:
                             except Exception as e:
                                 # device dispatch failed: degrade the
                                 # rest of the encode to the CPU codec
-                                degraded = True
+                                flags["degraded"] = True
                                 self._note_fallback(st, "device_dispatch")
                                 tr.event("pipeline.fallback",
                                          dispatch=d_idx,
@@ -1217,14 +1500,27 @@ class StreamingEncoder:
                                 sb.update(i, buf[i, :used])
                             st["sidecar_s"] += clock() - t1
                     st["write_s"] += clock() - t0
-                    pending.append((parity_dev, used, bi, d_idx,
-                                    len(fills)))
+                    meta = (parity_dev, used, bi, d_idx, len(fills))
                     fills, used = [], 0
-                    if len(pending) > self.depth:
-                        drain_one()
-                    if not free:
-                        drain_one()
-                    bi = free.popleft()
+                    if drainer is not None:
+                        # async: hand the dispatch to the drainer and
+                        # move straight on to filling the next slot —
+                        # the fetch + parity write overlap everything
+                        # below.  Backpressure is normally the slot
+                        # pool, but the bounded writer queue can also
+                        # push back (fast fetch over a slow shard
+                        # disk recycles device slots before the write):
+                        # that block is drain-stall time too
+                        t0 = clock()
+                        drainer.submit(meta)
+                        st["drain_wait_s"] += clock() - t0
+                    else:
+                        pending.append(meta)
+                        if len(pending) > self.depth:
+                            drain_one()
+                        if not free:
+                            drain_one()
+                    bi = acquire_slot()
 
                 st["setup_s"] = clock() - t_start
                 setup.__exit__(None, None, None)
@@ -1239,8 +1535,17 @@ class StreamingEncoder:
                     fills.append((used, n, row_start, block, off))
                     used += n
                 flush()
-                while pending:
-                    drain_one()
+                if drainer is not None:
+                    # tail stall: the last in-flight dispatches finish
+                    # fetching + writing; host-blocked time lands in
+                    # drain_wait_s like any other drain stall
+                    t0 = clock()
+                    with tr.span("pipeline.drain_wait", final=True):
+                        drainer.finish()
+                    st["drain_wait_s"] += clock() - t0
+                else:
+                    while pending:
+                        drain_one()
             if sb is not None:
                 t0 = clock()
                 sb.finalize().save(out_base)
@@ -1255,6 +1560,23 @@ class StreamingEncoder:
             exc = sys.exc_info() if not ok else (None, None, None)
             if setup is not None:  # failed before the loop started
                 setup.__exit__(*exc)
+            if drainer is not None:
+                if not ok:
+                    if drainer.inflight and self._proc_worker is not None:
+                        # flag the abort FIRST (the fetch thread skips
+                        # recovery/fallback), then abandon so a fetch
+                        # blocked on the worker fails fast (WorkerGaveUp)
+                        # instead of the teardown waiting out a respawn
+                        drainer.aborting = True
+                        self._abandon_proc_worker()
+                    drainer.abort()
+                # fold the concurrent drain/writer accounting into the
+                # call stats now that the threads have joined
+                st["drain_s"] += ds["drain_s"]
+                st["write_s"] += ds["write_s"]
+                st["sidecar_s"] += ds["sidecar_s"]
+                st["dispatch_s"] += ds["fallback_s"]
+                st["parity_bytes_drained"] += ds["parity_bytes"]
             if pending and self._proc_worker is not None:
                 # abnormal exit with submitted-but-undrained jobs: their
                 # acks would desync the retry attempt's (or a later

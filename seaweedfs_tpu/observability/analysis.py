@@ -31,7 +31,16 @@ root span) the report carries:
   - gap analysis between consecutive worker.compute windows: each idle
     gap on the worker track is classified by what the host was doing
     meanwhile — input_starved (fill/dispatch), drain_blocked,
-    writer_blocked, or other;
+    link_bound (blocked while the async drainer was actively pulling
+    parity off the wire), writer_blocked, or other;
+  - drain-track awareness (PR 7): pipeline.drain spans recorded on a
+    DIFFERENT thread than the run root are the async drainer's
+    concurrent fetch track — reported as drain_track_s + a
+    drain_profile classifying the run as none / overlapped /
+    link_bound / drain_blocked — while pipeline.drain_wait (the
+    producer blocked on the slot pool) folds into the host "drain"
+    bucket, so overlap_efficiency keeps meaning "1 - host-blocked
+    share" across old and new traces;
   - a degraded flag driven by pipeline.retry / pipeline.fallback spans,
     resumed-attempt roots, and (when given) the restart/fallback
     counters — so BENCH numbers self-label clean vs degraded.
@@ -123,31 +132,76 @@ def _stage_of(span: dict) -> Optional[str]:
     if name in ROOT_NAMES or not name.startswith("pipeline."):
         return None
     stage = name.split(".", 1)[1]
+    if stage == "drain_wait":
+        # async drain (PR 7): the producer thread blocked on the slot
+        # pool / final join — host-blocked time, the same bucket the
+        # old inline fetch landed in
+        return "drain"
     return stage if stage in HOST_STAGES else None
 
 
-def _gap_analysis(members: list[dict]) -> dict:
+def _merged_intervals(spans: list[dict]) -> list[tuple[float, float]]:
+    ivs = sorted((s["t0"], s["t1"]) for s in spans)
+    out: list[tuple[float, float]] = []
+    for a, b in ivs:
+        if b <= a:
+            continue
+        if out and a <= out[-1][1]:
+            out[-1] = (out[-1][0], max(out[-1][1], b))
+        else:
+            out.append((a, b))
+    return out
+
+
+def _cover_len(a: float, b: float,
+               ivs: list[tuple[float, float]]) -> float:
+    """Length of [a, b] covered by the merged intervals."""
+    total = 0.0
+    for i0, i1 in ivs:
+        if i1 <= a:
+            continue
+        if i0 >= b:
+            break
+        total += min(b, i1) - max(a, i0)
+    return total
+
+
+def _gap_analysis(members: list[dict],
+                  drain_track: Optional[list[dict]] = None,
+                  offthread: Optional[list[dict]] = None) -> dict:
     """Classify idle gaps between consecutive worker.compute windows by
     what the HOST thread was doing during each gap: filling/dispatching
     the next input (the worker is input-starved), blocked in drain, or
-    writing shards."""
+    writing shards.  Host drain time that coincides with an ACTIVE
+    fetch on the concurrent drainer track is split out as `link_bound`
+    (the host waits because the wire is still moving parity) vs
+    `drain_blocked` (the host waits on drain machinery that is not
+    actually transferring)."""
+    drain_track = drain_track or []
+    track_ivs = _merged_intervals(drain_track)
     windows = sorted((s for s in members
                       if s["name"].startswith("worker.")),
                      key=lambda s: s["t0"])
     out = {"worker_windows": len(windows), "worker_busy_s": 0.0,
            "gap_total_s": 0.0,
            "classes": {"input_starved": 0.0, "drain_blocked": 0.0,
+                       "link_bound": 0.0,
                        "writer_blocked": 0.0, "other": 0.0}}
     if not windows:
         return out
     out["worker_busy_s"] = round(
         sum(s["t1"] - s["t0"] for s in windows), 4)
+    # identity-based exclusion of the concurrent tracks: value equality
+    # would be O(n*m) dict compares on 10^4-span bench traces
+    excl = {id(s) for s in drain_track}
+    excl.update(id(s) for s in (offthread or []))
+    host = [s for s in members if id(s) not in excl]
     by_class = {
-        "input_starved": [s for s in members
+        "input_starved": [s for s in host
                           if _stage_of(s) in ("fill", "dispatch")],
-        "drain_blocked": [s for s in members if _stage_of(s) == "drain"],
-        "writer_blocked": [s for s in members if _stage_of(s) == "write"],
+        "writer_blocked": [s for s in host if _stage_of(s) == "write"],
     }
+    drain_spans = [s for s in host if _stage_of(s) == "drain"]
     for prev, nxt in zip(windows, windows[1:]):
         g0, g1 = prev["t1"], nxt["t0"]
         gap = g1 - g0
@@ -160,6 +214,15 @@ def _gap_analysis(members: list[dict]) -> dict:
                     for sp in stage_spans)
             out["classes"][cls] += s
             covered += s
+        for sp in drain_spans:
+            a = max(g0, sp["t0"])
+            b = min(g1, sp["t1"])
+            if b <= a:
+                continue
+            lb = _cover_len(a, b, track_ivs)
+            out["classes"]["link_bound"] += lb
+            out["classes"]["drain_blocked"] += (b - a) - lb
+            covered += b - a
         out["classes"]["other"] += max(0.0, gap - covered)
     out["classes"] = {k: round(v, 4) for k, v in out["classes"].items()}
     # the classes decompose gap_total_s: independent rounding could push
@@ -172,10 +235,21 @@ def _gap_analysis(members: list[dict]) -> dict:
 def _analyze_run(root: dict, members: list[dict],
                  max_path_items: int = 48) -> dict:
     wall = max(root["t1"] - root["t0"], _EPS)
+    # the async drain (PR 7) fetches on a DIFFERENT thread than the
+    # pipeline root: those pipeline.drain spans are a concurrent track
+    # (like worker.compute) — counting them as host time would let the
+    # wall decomposition exceed 1.0 and misread an overlapped link
+    # transfer as a stall.  Old (inline-drain) traces record drain on
+    # the root's thread and keep the host-blocked semantics.
+    host_tid = root.get("tid")
     stage_s: dict[str, float] = {}
     stage_n: dict[str, int] = {}
     per_dispatch: dict[int, dict[str, float]] = {}
     fallback_reasons: dict[str, int] = {}
+    drain_track: list[dict] = []
+    offthread: list[dict] = []           # writer/fallback threads
+    offthread_s: dict[str, float] = {}
+    drain_host_spans: list[dict] = []
     retries = 0
     for sp in members:
         stage = _stage_of(sp)
@@ -187,9 +261,28 @@ def _analyze_run(root: dict, members: list[dict],
         if stage is None:
             continue
         dur = sp["t1"] - sp["t0"]
+        d = sp["attrs"].get("dispatch")
+        if host_tid is not None and sp.get("tid") != host_tid:
+            # async-drain tracks: the fetch (pipeline.drain), the
+            # writer's parity writes, and fallback recomputes all ride
+            # other threads — CONCURRENT with the host stages, so they
+            # leave the wall decomposition (shares would sum past 1.0
+            # and an overlapped transfer would read as a stall).  They
+            # still vote in the per-dispatch critical path: they ARE
+            # the dominant cost of a link- or writer-bound dispatch.
+            if sp["name"] == "pipeline.drain":
+                drain_track.append(sp)
+            else:
+                offthread.append(sp)
+                offthread_s[stage] = offthread_s.get(stage, 0.0) + dur
+            if d is not None:
+                row = per_dispatch.setdefault(int(d), {})
+                row[stage] = row.get(stage, 0.0) + dur
+            continue
+        if stage == "drain":
+            drain_host_spans.append(sp)
         stage_s[stage] = stage_s.get(stage, 0.0) + dur
         stage_n[stage] = stage_n.get(stage, 0) + 1
-        d = sp["attrs"].get("dispatch")
         if d is not None:
             row = per_dispatch.setdefault(int(d), {})
             row[stage] = row.get(stage, 0.0) + dur
@@ -197,6 +290,21 @@ def _analyze_run(root: dict, members: list[dict],
     attributed = sum(stage_s.values())
     unattributed = max(0.0, wall - attributed)
     drain_s = stage_s.get("drain", 0.0)
+    track_s = sum(s["t1"] - s["t0"] for s in drain_track)
+    track_ivs = _merged_intervals(drain_track)
+    # host-blocked drain seconds coinciding with an ACTIVE fetch on the
+    # drainer track: the host waited on the WIRE (link-bound); the rest
+    # of the blocked time is drain machinery (drain-blocked)
+    link_covered_s = sum(_cover_len(s["t0"], s["t1"], track_ivs)
+                         for s in drain_host_spans)
+    if drain_s + track_s < 0.02 * wall:
+        drain_cls = "none"
+    elif drain_s < 0.15 * wall:
+        drain_cls = "overlapped"
+    elif link_covered_s >= 0.5 * drain_s:
+        drain_cls = "link_bound"
+    else:
+        drain_cls = "drain_blocked"
 
     # every second of the wall lands in a named bucket
     attribution = {stage: {"s": round(s, 4),
@@ -240,12 +348,22 @@ def _analyze_run(root: dict, members: list[dict],
         "dispatches": len(per_dispatch),
         "stage_s": {k: round(v, 4) for k, v in sorted(stage_s.items())},
         "worker_compute_s": round(worker_s, 4),  # concurrent track
+        "drain_track_s": round(track_s, 4),      # concurrent fetch track
+        # writer/fallback work on the drainer's threads, per stage
+        "concurrent_stage_s": {k: round(v, 4)
+                               for k, v in sorted(offthread_s.items())},
         "unattributed_s": round(unattributed, 4),
         "overlap_efficiency": round(1.0 - drain_s / wall, 4),
+        "drain_profile": {
+            "host_blocked_s": round(drain_s, 4),
+            "fetch_s": round(track_s if drain_track else drain_s, 4),
+            "link_bound_s": round(link_covered_s, 4),
+            "classification": drain_cls,
+        },
         "attribution": attribution,
         "critical_path_stage": critical_path_stage,
         "critical_path": segments,
-        "gap_analysis": _gap_analysis(members),
+        "gap_analysis": _gap_analysis(members, drain_track, offthread),
         "degraded": degraded,
         "retries": retries,
         "fallbacks": sum(fallback_reasons.values()),
@@ -594,6 +712,7 @@ def attribution_summary(report: dict) -> dict:
         "wall_s": run["wall_s"],
         "critical_path_stage": run["critical_path_stage"],
         "overlap_efficiency": run["overlap_efficiency"],
+        "drain_profile": run.get("drain_profile"),
         "degraded": bool(report.get("degraded") or run["degraded"]),
         "summary": run["summary"],
     }
@@ -624,6 +743,12 @@ def render_report(report: dict) -> str:
                      f"wall={run['wall_s']}s")
         lines.append(f"  {run['summary']}")
         lines.append(f"  overlap_efficiency={run['overlap_efficiency']}")
+        dp = run.get("drain_profile") or {}
+        if dp.get("classification") and dp["classification"] != "none":
+            lines.append(
+                f"  drain: {dp['classification']} (host blocked "
+                f"{dp['host_blocked_s']}s, concurrent fetch "
+                f"{dp['fetch_s']}s, link-covered {dp['link_bound_s']}s)")
         width = max((len(k) for k in run["attribution"]), default=1)
         for stage, row in sorted(run["attribution"].items(),
                                  key=lambda kv: -kv[1]["s"]):

@@ -192,4 +192,9 @@ class TpuEngine:
             pad = (-b) % LANE
             padded = np.pad(shards, ((0, 0), (0, pad))) if pad else shards
             out = gf_matmul_xla(a, jnp.asarray(padded))
-        return np.asarray(jax.device_get(out))[:, :b]
+        if pad:
+            # device-side slice BEFORE the fetch: only the b valid
+            # parity columns cross the (possibly tunneled, ~MB/s-class)
+            # D2H link — the tile padding never leaves the device
+            out = jax.lax.slice(out, (0, 0), (out.shape[0], b))
+        return np.asarray(jax.device_get(out))

@@ -349,6 +349,91 @@ def test_staged_resume_entrypoint_is_byte_exact(tmp_path):
     assert _shards(out) == ref
 
 
+def test_async_drain_deep_buffers_byte_identical(tmp_path):
+    """The async multi-buffered drain at depth=4 (5 slots in flight),
+    staged-process AND mmap-process: FIFO writer order must keep shards
+    and the write-order-crc `.eci` sidecar byte-identical to the CPU
+    reference while fetch/write run off the critical thread."""
+    from seaweedfs_tpu.ec.integrity import sidecar_path
+
+    base = str(tmp_path / "v")
+    rng = np.random.default_rng(12)
+    open(base + ".dat", "wb").write(
+        rng.integers(0, 256, 6_000_000, dtype=np.uint8).tobytes())
+    encoder.write_ec_files(base, ReedSolomon(K, R, engine=CpuEngine()),
+                           large_block_size=200_000, small_block_size=20_000)
+    ref = _shards(base)
+    ref_eci = open(sidecar_path(base), "rb").read()
+    for name, overlap in (("st", "process"), ("mm", "mmap-process")):
+        enc = StreamingEncoder(K, R, engine="host", overlap=overlap,
+                               dispatch_mb=1, depth=4)
+        enc.dispatch_b = 65536
+        out = str(tmp_path / name)
+        try:
+            enc.encode_file(base + ".dat", out,
+                            large_block_size=200_000,
+                            small_block_size=20_000)
+        finally:
+            _close(enc)
+            enc._drop_file_worker()
+        assert _shards(out) == ref, overlap
+        assert open(sidecar_path(out), "rb").read() == ref_eci, overlap
+        assert enc.stats["drain_pool"] >= 1, overlap
+        assert enc.stats["parity_bytes_drained"] > 0, overlap
+        assert enc.stats["fallbacks"] == 0, overlap
+
+
+def test_worker_kill_while_drain_queue_full(volume):
+    """SIGKILL the parity worker while the async drain queue is FULL
+    (slow drainer via ec.drain delay keeps every slot in flight): the
+    drainer-side supervisor respawns, replays the whole in-flight
+    window, and the FIFO writer keeps the output byte-identical."""
+    td, base, ref = volume
+    m = ec_pipeline_metrics()
+    r0 = m.worker_restarts.value("staged")
+    enc = _staged_encoder(depth=3, max_worker_restarts=5)
+    out = str(td / "killfull")
+    err: list = []
+    done = threading.Event()
+
+    def run():
+        try:
+            # the throttled drainer keeps every slot in flight, so the
+            # producer is still being paced by slot backpressure (jobs
+            # still outstanding past the worker) when the kill lands
+            fi.enable("ec.drain", delay=0.08)
+            enc.encode_file(base + ".dat", out,
+                            large_block_size=LARGE, small_block_size=SMALL)
+        except Exception as e:  # pragma: no cover - the drill's failure
+            err.append(e)
+        finally:
+            fi.clear()
+            done.set()
+
+    t = threading.Thread(target=run)
+    t.start()
+    try:
+        deadline = time.monotonic() + 30
+        pid = 0
+        while time.monotonic() < deadline and not pid:
+            w = enc._proc_worker
+            pid = getattr(w, "worker_pid", 0) if w is not None else 0
+            time.sleep(0.005)
+        assert pid, "worker never came up"
+        time.sleep(0.12)  # queue full, later submissions still pending
+        try:
+            os.kill(pid, signal.SIGKILL)
+        except ProcessLookupError:  # pragma: no cover - already respawned
+            pass
+        t.join(180)
+    finally:
+        fi.clear()
+        _close(enc)
+    assert done.is_set() and not err, err
+    assert _shards(out) == ref
+    assert m.worker_restarts.value("staged") - r0 >= 1
+
+
 def test_worker_err_ack_recomputes_without_killing_worker(tmp_path):
     """A job that fails INSIDE a live worker is acked ("err", seq) and
     surfaces as WorkerJobError: that dispatch recomputes serially, the
