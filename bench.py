@@ -54,7 +54,7 @@ SECTION_CAPS = {
     "multi_decode": 240, "batched_needles": 120, "rebuild": 180,
     "transfer": 90, "e2e_stream": 600, "e2e_rebuild": 300,
     "e2e_decode_8gb": 420, "roofline": 90, "cluster": 360,
-    "cluster_traced": 300,
+    "cluster_traced": 300, "alerts": 420,
     "cluster_native": 360, "cluster_scaled": 420, "parity": 120,
     "integrity": 120, "pipeline_health": 15,
 }
@@ -939,7 +939,8 @@ def _child(scratch_path: str, platform: str = "") -> None:
         return p
 
     @contextlib.contextmanager
-    def spawn_cluster(n_vols, extra_vol_args=(), trace_sample=None):
+    def spawn_cluster(n_vols, extra_vol_args=(), trace_sample=None,
+                      extra_master_args=()):
         """Master + n_vols volume servers as separate processes; yields
         (master_port, scratch_root) once an assign succeeds.
         trace_sample enables distributed tracing in every server process
@@ -952,7 +953,7 @@ def _child(scratch_path: str, platform: str = "") -> None:
                     if trace_sample is not None else [])
         procs = [subprocess.Popen(
             [sys.executable, weed_py, *globals_, "master",
-             "-port", str(mport)],
+             "-port", str(mport), *extra_master_args],
             env=cluster_env, stdout=subprocess.DEVNULL,
             stderr=subprocess.DEVNULL)]
         try:
@@ -1089,6 +1090,132 @@ def _child(scratch_path: str, platform: str = "") -> None:
             detail["cluster_trace"] = block
 
     section("cluster_traced", meas_cluster_traced)
+
+    # --- alerting engine: evaluator overhead + forced e2e drill ------------
+    def _alerts_drill():
+        """In-process forced drill (the PR-9 acceptance chain): inject
+        ec.shard.corrupt -> scrub detects -> counter rises -> rule
+        fires autonomously -> event journaled with the scrub's trace id
+        -> flight-recorder bundle captured.  Returns what each link of
+        the chain produced so the bench JSON PROVES the pipeline, not
+        just that code exists."""
+        import tempfile as _tf
+
+        from seaweedfs_tpu.master.server import MasterServer
+        from seaweedfs_tpu.observability import (disable_tracing,
+                                                 enable_tracing)
+        from seaweedfs_tpu.storage.needle import Needle
+        from seaweedfs_tpu.storage.volume import Volume
+        from seaweedfs_tpu.utils import faultinject as fi
+        from seaweedfs_tpu.utils.httpd import http_json
+        from seaweedfs_tpu.volume_server.server import VolumeServer
+
+        out = {"alert_fired": False, "event_trace": "", "bundle_id": "",
+               "bundle_has_trace": False, "bundle_has_metrics": False}
+        root = _tf.mkdtemp()
+        v = Volume(root, "", 1)
+        data = rng.integers(0, 256, 500, dtype=np.uint8).tobytes()
+        for i in range(1, 60):
+            v.write_needle(Needle(cookie=i, id=i, data=data))
+        v.close()
+        enable_tracing()
+        master = MasterServer(port=_free_port(), pulse_seconds=0.4,
+                              metrics_aggregation_seconds=0.25).start()
+        master.aggregator.min_interval = 0.0
+        master.alert_engine.min_interval = 0.0
+        vs = VolumeServer([root], master.url, port=_free_port(),
+                          pulse_seconds=0.4).start()
+        try:
+            deadline = time.time() + 5
+            while time.time() < deadline and not master.topo.all_nodes():
+                time.sleep(0.05)
+            vs.store.ec_generate(1)
+            vs.store.ec_mount(1)
+            deadline = time.time() + 5
+            while time.time() < deadline and \
+                    not master.alert_engine.evaluations:
+                time.sleep(0.05)
+            fi.enable("ec.shard.corrupt",
+                      params={"shard": 11, "offset": 4096, "bit": 0},
+                      max_hits=1)
+            http_json("POST", f"http://{vs.url}/ec/scrub/start",
+                      {"rate_mb_s": 0})
+            deadline = time.time() + 30
+            while time.time() < deadline:
+                alerts = {a["name"]: a for a in
+                          master.alert_engine.to_dict()["alerts"]}
+                a = alerts.get("corrupt_shards_increase") or {}
+                if a.get("state") == "firing":
+                    out["alert_fired"] = True
+                    bundles = [b for b in a.get("bundles", [])
+                               if b.get("id")]
+                    if bundles and out["event_trace"]:
+                        out["bundle_id"] = bundles[0]["id"]
+                        bdoc = http_json(
+                            "GET", f"http://{bundles[0]['server']}"
+                            f"/debug/flightrecorder/{bundles[0]['id']}")
+                        out["bundle_has_trace"] = bool(
+                            bdoc.get("trace", {}).get("spans"))
+                        out["bundle_has_metrics"] = \
+                            "SeaweedFS" in bdoc.get("metrics", "")
+                        break
+                if not out["event_trace"]:
+                    evs = http_json(
+                        "GET", f"http://{master.url}/cluster/events"
+                               "?type=shard_corrupt&limit=5")
+                    if evs["events"]:
+                        out["event_trace"] = \
+                            evs["events"][-1].get("trace", "")
+                time.sleep(0.2)
+        finally:
+            fi.clear()
+            vs.stop()
+            master.stop()
+            disable_tracing()
+        return out
+
+    def meas_alerts():
+        """Read rps with the alert evaluator LIVE on the master
+        (-metricsAggregationSeconds 1: scrape + rule evaluation every
+        second while the bench hammers reads) — acceptance: < 1%
+        overhead, because evaluation runs on the master's aggregation
+        loop and the volume-server hot path pays nothing.  The
+        evaluator-OFF baseline is measured back-to-back in THIS section
+        (a fresh spawn each, seconds apart) — comparing against the
+        cluster section minutes earlier would put the acceptance figure
+        below run-to-run spawn/cache noise.  Plus the forced
+        end-to-end drill."""
+        import urllib.request
+
+        with spawn_cluster(1) as (mport, _root):
+            base_rates = run_bench(mport, 4000, use_tcp=False)
+        block = {"baseline_read_rps": base_rates.get("read", 0.0)}
+        with spawn_cluster(
+                1, extra_master_args=("-metricsAggregationSeconds",
+                                      "1")) as (mport, _root):
+            rates = run_bench(mport, 4000, use_tcp=False)
+            block.update({"write_rps": rates.get("write", 0.0),
+                          "read_rps": rates.get("read", 0.0)})
+            base = block["baseline_read_rps"]
+            if base:
+                block["eval_read_overhead_pct"] = round(
+                    100.0 * (1.0 - rates.get("read", 0.0) / base), 2)
+            # the evaluator really ran during the load: rules present
+            # and evaluations advancing
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{mport}/cluster/alerts",
+                        timeout=5) as r:
+                    doc = json.loads(r.read())
+                block["rules"] = len(doc.get("rules", []))
+                block["evaluations"] = doc.get("evaluations", 0)
+                block["firing"] = doc.get("firing", 0)
+            except OSError:
+                block["error_alerts_endpoint"] = "unreachable"
+        block["drill"] = _alerts_drill()
+        detail["alerts"] = block
+
+    section("alerts", meas_alerts)
 
     # --- native C++ data plane (GIL-free needle IO) -------------------------
     def meas_cluster_native():

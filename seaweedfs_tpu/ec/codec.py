@@ -143,6 +143,11 @@ def _fallback_matmul(m: np.ndarray, shards: np.ndarray,
     get_tracer().event("pipeline.fallback", reason="codec",
                        engine=getattr(failed, "name", "?"),
                        error=type(err).__name__)
+    from ..observability import events as _events
+
+    _events.emit("engine_fallback", reason="codec",
+                 engine=getattr(failed, "name", "?"),
+                 error=type(err).__name__)
     return _FALLBACK_ENGINE.matmul(m, shards)
 
 

@@ -106,6 +106,10 @@ def note_corruption(source: str, shard_id: int, base: str = "",
     (tracer or get_tracer()).event(
         "pipeline.retry", reason="corrupt_shard", source=source,
         shard=shard_id, path=base, block=block)
+    from ..observability import events as _events
+
+    _events.emit("shard_corrupt", source=source, shard=shard_id,
+                 path=base, block=block)
 
 
 def sidecar_is_stale(sidecar: Optional["EciSidecar"],

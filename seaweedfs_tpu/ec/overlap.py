@@ -364,9 +364,13 @@ class _ParityWorkerBase:
                     f"parity worker gave up after {self.restarts} "
                     f"restarts: {err}") from cause
             self.restarts += 1
+            from ..observability import events as _events
             from ..stats import ec_pipeline_metrics
 
             ec_pipeline_metrics().worker_restarts.inc(self.kind)
+            _events.emit("worker_restart", kind=self.kind,
+                         restarts=self.restarts,
+                         cause=type(cause).__name__)
             # jittered exponential backoff: a crash loop must not burn a
             # core respawning, and co-scheduled encoders must not
             # thundering-herd their respawns in lockstep

@@ -479,9 +479,12 @@ class StreamingEncoder:
         # threads: the read-modify-write must not lose counts
         with self._st_lock:
             st["fallbacks"] += 1
+        from ..observability import events as _events
         from ..stats import ec_pipeline_metrics
 
         ec_pipeline_metrics().engine_fallbacks.inc(reason)
+        _events.emit("engine_fallback", reason=reason,
+                     engine=str(self.engine))
 
     def _drain_async_enabled(self) -> bool:
         """Async drain engages whenever the pipeline has a REAL

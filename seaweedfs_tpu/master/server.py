@@ -81,6 +81,29 @@ class MasterServer:
         self._trace_shipper = TraceShipper(
             get_tracer(), server=self.url,
             local_collector=self.trace_collector)
+        # cluster event journal + alerting engine (the ACTIVE third of
+        # the observability stack): per-server journals ship typed
+        # events here (observability/events.py, TraceShipper transport
+        # pattern), and the alert engine evaluates declarative rules
+        # against the aggregator's merged health/metrics on the same
+        # -metricsAggregationSeconds cadence — the hot path pays
+        # nothing.  A rule's firing transition fans flight-recorder
+        # captures out to the implicated servers.
+        from ..observability.alerts import AlertEngine, default_rules
+        from ..observability.events import (ClusterEventJournal,
+                                            EventShipper, get_journal)
+
+        self.event_journal = ClusterEventJournal()
+        self._event_shipper = EventShipper(
+            get_journal(), server=self.url,
+            local_journal=self.event_journal)
+        self.alert_engine = AlertEngine(
+            default_rules(),
+            source_fn=lambda: (self.aggregator.health(),
+                               self.aggregator.merged()),
+            server=self.url,
+            on_fire=self._on_alert_fire,
+            exemplar_fn=self._alert_exemplar)
         from .consensus import RaftNode
 
         self.raft = RaftNode(
@@ -124,6 +147,10 @@ class MasterServer:
         self._server = serve(self.router, self.host, self.port,
                              tls_context=self._tls_context)
         self._trace_shipper.attach()
+        # BEFORE the TCP front binds: a degraded_bind event emitted
+        # during startup must find the shipper hooked (attach has no
+        # backfill — an event emitted before it never ships)
+        self._event_shipper.attach()
         # framed-TCP assign front (op 'A'): the write hot loop does one
         # assign per file, and HTTP parsing caps it; leader-only — a
         # follower refuses so clients fall back to HTTP redirects
@@ -156,6 +183,7 @@ class MasterServer:
                 # serves everything) but must be OBSERVABLE, not silent:
                 # clients fall back per-request, which looks like a
                 # latency regression unless this event is on the record
+                from ..observability import events as _events
                 from ..observability import get_tracer
                 from ..stats import ec_pipeline_metrics
 
@@ -165,6 +193,11 @@ class MasterServer:
                     port=tcp_port_for(self.port),
                     detail="framed-TCP assign front bind failed; "
                            "HTTP assign still serves")
+                _events.emit("degraded_bind", role="master-tcp",
+                             server=self.url,
+                             port=tcp_port_for(self.port),
+                             detail="framed-TCP assign front bind "
+                                    "failed; HTTP assign still serves")
         self.raft.start()
         threading.Thread(target=self._janitor_loop, daemon=True,
                          name="master-janitor").start()
@@ -175,12 +208,17 @@ class MasterServer:
             threading.Thread(target=self._maintenance_loop, daemon=True,
                              name="master-maintenance").start()
         if self.metrics_aggregation_seconds > 0:
-            self.aggregator.start_loop(self.metrics_aggregation_seconds)
+            # one combined cadence: scrape the peers, then evaluate the
+            # alert rules against the fresh rollup — the evaluator rides
+            # the aggregation loop instead of adding its own
+            threading.Thread(target=self._telemetry_loop, daemon=True,
+                             name="master-telemetry").start()
         return self
 
     def stop(self) -> None:
         self._stop.set()
         self._trace_shipper.detach()
+        self._event_shipper.detach()
         self.aggregator.stop_loop()
         if self._tcp_server is not None:
             self._tcp_server.stop()
@@ -318,6 +356,81 @@ class MasterServer:
         self.maintenance_errors.append(msg)
         del self.maintenance_errors[:-20]  # keep the most recent few
 
+    # --- alerting ---------------------------------------------------------
+    def _telemetry_loop(self) -> None:
+        """The -metricsAggregationSeconds cadence: keep the cluster
+        rollup warm AND run the alert evaluator over it — alerts fire
+        autonomously, nobody has to poll /cluster/health by hand."""
+        while not self._stop.wait(self.metrics_aggregation_seconds):
+            if not self.is_leader:
+                continue
+            try:
+                self.aggregator.scrape(force=True, include_scrub=True)
+                self.alert_engine.evaluate(force=True)
+            except Exception:
+                pass  # keep evaluating; rules carry their own errors
+
+    def _alert_exemplar(self, rule) -> str:
+        """The most recent cluster-journal event correlated with this
+        rule's subject — its trace id is the alert's exemplar, so the
+        operator can trace.fetch the exact operation that degraded."""
+        from ..observability.events import HEALTH_EVENT_TYPES
+
+        etype = HEALTH_EVENT_TYPES.get(
+            (rule.params or {}).get("key", ""))
+        if not etype:
+            return ""
+        evs = self.event_journal.query(type_=etype, limit=1)
+        return (evs[-1].get("trace") or "") if evs else ""
+
+    def _on_alert_fire(self, rule, state_doc: dict,
+                       servers: list) -> None:
+        """Firing transition -> flight-recorder capture fan-out: ask
+        each implicated server (bounded) to freeze its diagnostic
+        bundle, and capture the master's own view too.  Runs on a
+        background thread — a 0.25s profile per server must not stall
+        the evaluation loop — and lands the bundle ids back on the
+        alert (`bundles` in /cluster/alerts)."""
+
+        def worker():
+            # let the peers' event shippers flush the transition's
+            # correlated events before freezing them into bundles
+            time.sleep(0.6)
+            bundles: list[dict] = []
+            for url in list(dict.fromkeys(servers))[:8]:
+                try:
+                    r = http_json(
+                        "POST",
+                        f"http://{url}/debug/flightrecorder/capture",
+                        {"reason": f"alert:{rule.name}",
+                         "alert": rule.name,
+                         "trace_id": state_doc.get("exemplar_trace",
+                                                   "")},
+                        timeout=15)
+                    bundles.append({"server": url, "id": r.get("id")})
+                except Exception as e:
+                    bundles.append({"server": url,
+                                    "error": f"{type(e).__name__}: {e}"
+                                    [:200]})
+            try:
+                from ..observability.flightrecorder import \
+                    get_flightrecorder
+
+                # the master's bundle freezes the CLUSTER journal (its
+                # local journal only sees alert transitions)
+                meta = get_flightrecorder().capture(
+                    reason=f"alert:{rule.name}", alert=rule.name,
+                    server=self.url,
+                    trace_id=state_doc.get("exemplar_trace", ""),
+                    events=self.event_journal.query(limit=256))
+                bundles.append({"server": self.url, "id": meta["id"]})
+            except Exception:
+                pass
+            self.alert_engine.note_bundles(rule.name, bundles)
+
+        threading.Thread(target=worker, daemon=True,
+                         name="flight-capture").start()
+
     # --- routes -----------------------------------------------------------
     def _register_routes(self) -> None:
         r = self.router
@@ -432,6 +545,65 @@ class MasterServer:
             cluster totals and a rollup degraded flag."""
             self.aggregator.scrape(include_scrub=True)
             return Response(self.aggregator.health())
+
+        @r.route("GET", "/cluster/alerts")
+        def cluster_alerts(req: Request) -> Response:
+            """The alerting engine's state: every rule's alert
+            (inactive/pending/firing/resolved) with value, detail,
+            implicated servers, exemplar trace id, and attached
+            flight-recorder bundle ids, plus the declarative rule
+            table.  Evaluates on demand through the same TTL guards as
+            the metrics scrape, so polling cannot amplify; the
+            -metricsAggregationSeconds loop keeps it firing
+            autonomously.  ?state=firing filters."""
+            self._require_leader(req)
+            self.aggregator.scrape(include_scrub=True)
+            doc = self.alert_engine.evaluate()
+            want = req.query.get("state", "").strip().lower()
+            if want:
+                doc = dict(doc)
+                doc["alerts"] = [a for a in doc["alerts"]
+                                 if a["state"] == want]
+            return Response(doc)
+
+        @r.route("GET", "/cluster/events")
+        def cluster_events(req: Request) -> Response:
+            """The cluster-wide structured event journal: per-server
+            journals ship here (dedup'd, bounded).  Filters: ?type=,
+            ?severity= (exact), ?min_severity=, ?server=,
+            ?since=<unix ts>, ?limit=N."""
+            self._require_leader(req)
+            try:
+                since_ts = float(req.query.get("since") or 0.0)
+                limit = min(int(req.query.get("limit") or 256), 2048)
+            except ValueError as e:
+                # client typo: 400, never a 500 that burns the
+                # error-ratio SLO budget
+                raise HttpError(400, f"bad query parameter: {e}")
+            events = self.event_journal.query(
+                type_=req.query.get("type") or None,
+                severity=req.query.get("severity") or None,
+                min_severity=req.query.get("min_severity") or None,
+                server=req.query.get("server") or None,
+                since_ts=since_ts, limit=limit)
+            return Response({"events": events, "count": len(events),
+                             "total": len(self.event_journal),
+                             "dropped": self.event_journal.dropped})
+
+        @r.route("POST", "/cluster/events/ingest")
+        def cluster_events_ingest(req: Request) -> Response:
+            """Event-shipping sink (observability/events.py
+            EventShipper) — same convergence rule as trace ingest: any
+            reachable master accepts, a follower forwards to the raft
+            leader so every shipper lands in ONE cluster journal."""
+            if not self.is_leader:
+                if not self.raft.leader or self.raft.leader == self.url:
+                    raise HttpError(503, "no leader elected yet; retry")
+                return self._proxy_to_leader(req)
+            b = req.json()
+            accepted = self.event_journal.ingest(
+                str(b.get("server") or ""), b.get("events") or [])
+            return Response({"accepted": accepted})
 
         @r.route("POST", "/cluster/traces/ingest")
         def cluster_traces_ingest(req: Request) -> Response:

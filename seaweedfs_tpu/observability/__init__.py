@@ -18,11 +18,15 @@ spans is the sampling profiler's job (profiler.py, GET /debug/profile,
 bench --profile-out).
 """
 
+from .alerts import AlertEngine, Rule, default_rules
 from .analysis import (analyze, analyze_cluster, attribution_summary,
                        render_cluster_report, render_report)
 from .collector import TraceCollector, TraceShipper
 from .context import (ingress_context, inject_trace_headers,
                       sample_rate, set_sample_rate)
+from .events import (ClusterEventJournal, Event, EventJournal,
+                     EventShipper, get_journal)
+from .flightrecorder import FlightRecorder, get_flightrecorder
 from .profiler import SamplingProfiler, profile_collapsed
 from .tracer import (Span, Tracer, disable_tracing, enable_tracing,
                      get_tracer)
@@ -32,4 +36,7 @@ __all__ = ["Span", "Tracer", "get_tracer", "enable_tracing",
            "attribution_summary", "render_report",
            "render_cluster_report", "TraceCollector", "TraceShipper",
            "ingress_context", "inject_trace_headers", "sample_rate",
-           "set_sample_rate", "SamplingProfiler", "profile_collapsed"]
+           "set_sample_rate", "SamplingProfiler", "profile_collapsed",
+           "Event", "EventJournal", "ClusterEventJournal",
+           "EventShipper", "get_journal", "AlertEngine", "Rule",
+           "default_rules", "FlightRecorder", "get_flightrecorder"]

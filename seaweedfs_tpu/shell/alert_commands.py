@@ -1,0 +1,127 @@
+"""Alerting + event-journal shell commands.
+
+    alerts.list [-firing] [-json]      # the master's alert table
+    alerts.capture [-server h:p]       # force flight-recorder bundles
+    events.tail [-n 20] [-type t] [-severity s] [-json]
+
+Output is STABLE line-per-record text (fixed field order, key=value
+details) so scripts can grep/cut it; -json emits the raw documents.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+from ..utils.httpd import http_json
+from .commands import CommandEnv, command
+
+
+def _fmt_ts(ts: float) -> str:
+    return time.strftime("%H:%M:%S", time.localtime(ts)) if ts else "-"
+
+
+@command("alerts.list")
+def cmd_alerts_list(env: CommandEnv, flags: dict) -> str:
+    """alerts.list [-firing] [-json]
+    # the master's alerting-engine state: one line per rule with
+    # state/severity/value/detail (+ bundle ids once the flight
+    # recorder captured); -firing keeps only firing alerts"""
+    doc = env.master_get("/cluster/alerts")
+    alerts = doc.get("alerts", [])
+    if flags.get("firing") == "true":
+        alerts = [a for a in alerts if a["state"] == "firing"]
+    if flags.get("json") == "true":
+        return json.dumps({"firing": doc.get("firing", 0),
+                           "evaluated_at": doc.get("evaluated_at"),
+                           "alerts": alerts}, indent=2)
+    lines = [f"alerts: {doc.get('firing', 0)} firing "
+             f"(evaluated {_fmt_ts(doc.get('evaluated_at', 0))}, "
+             f"{len(doc.get('rules', []))} rules)"]
+    for a in alerts:
+        line = (f"  {a['state']:<8} {a['severity']:<8} {a['name']}"
+                f"  value={a.get('value', 0):g}")
+        if a.get("fired_at"):
+            line += f" fired={_fmt_ts(a['fired_at'])}"
+        if a.get("detail"):
+            line += f"  {a['detail']}"
+        if a.get("exemplar_trace"):
+            line += f" [trace {a['exemplar_trace']}]"
+        lines.append(line)
+        for b in a.get("bundles", []):
+            lines.append(f"           bundle {b.get('id') or '-'} "
+                         f"@ {b.get('server')}"
+                         + (f" error={b['error']}" if b.get("error")
+                            else ""))
+    return "\n".join(lines)
+
+
+@command("alerts.capture")
+def cmd_alerts_capture(env: CommandEnv, flags: dict) -> str:
+    """alerts.capture [-server host:port] [-reason text]
+    # freeze flight-recorder bundles by hand: POSTs
+    # /debug/flightrecorder/capture on the named server, or on the
+    # master plus every registered volume server"""
+    reason = flags.get("reason") or "shell"
+    if flags.get("server"):
+        targets = [flags["server"]]
+    else:
+        targets = [env.master_url]
+        topo = env.topology()
+        for dc in topo.get("DataCenters", []):
+            for rack in dc.get("Racks", []):
+                for n in rack.get("DataNodes", []):
+                    targets.append(n["Url"])
+    lines = []
+    for url in targets:
+        try:
+            meta = http_json(
+                "POST", f"http://{url}/debug/flightrecorder/capture",
+                {"reason": reason}, timeout=30)
+            lines.append(f"{url}: bundle {meta['id']} "
+                         f"({meta.get('bytes', 0)} bytes, "
+                         f"{meta.get('span_count', 0)} spans, "
+                         f"{meta.get('event_count', 0)} events)")
+        except Exception as e:
+            lines.append(f"{url}: capture failed: "
+                         f"{type(e).__name__}: {e}")
+    return "\n".join(lines)
+
+
+@command("events.tail")
+def cmd_events_tail(env: CommandEnv, flags: dict) -> str:
+    """events.tail [-n 20] [-type t] [-severity s] [-min_severity s]
+    [-server host:port] [-json]
+    # the most recent cluster events (master journal), or one server's
+    # local journal with -server.  One event per line:
+    # <time> <severity> <type> <server> key=value... [trace <id>]"""
+    n = int(flags.get("n") or 20)
+    params = []
+    for flag, qk in (("type", "type"), ("severity", "severity"),
+                     ("min_severity", "min_severity")):
+        if flags.get(flag):
+            params.append(f"{qk}={flags[flag]}")
+    params.append(f"limit={n}")
+    qs = "&".join(params)
+    if flags.get("server"):
+        doc = http_json(
+            "GET", f"http://{flags['server']}/debug/events?{qs}")
+    else:
+        doc = env.master_get(f"/cluster/events?{qs}")
+    events = doc.get("events", [])
+    if flags.get("json") == "true":
+        return json.dumps(events, indent=2)
+    if not events:
+        return "no events"
+    lines = []
+    for e in events:
+        details = " ".join(f"{k}={v}" for k, v
+                           in sorted((e.get("details") or {}).items())
+                           if v not in ("", None, []))
+        line = (f"{_fmt_ts(e.get('ts', 0))} {e.get('severity', '?'):<8} "
+                f"{e.get('type', '?'):<18} {e.get('server') or '-':<21} "
+                f"{details}")
+        if e.get("trace"):
+            line += f" [trace {e['trace']}]"
+        lines.append(line.rstrip())
+    return "\n".join(lines)

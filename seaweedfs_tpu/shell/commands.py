@@ -76,7 +76,8 @@ class CommandEnv:
 
 # flags that never take a value (so `fs.rm -r /path` keeps /path positional)
 BOOL_FLAGS = {"r", "rf", "l", "f", "force", "writable", "readonly", "apply",
-              "recursive", "v", "json", "backfill", "all", "chrome"}
+              "recursive", "v", "json", "backfill", "all", "chrome",
+              "firing"}
 
 
 def parse_flags(args: list[str]) -> dict[str, str]:

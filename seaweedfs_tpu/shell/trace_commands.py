@@ -98,6 +98,16 @@ def cmd_cluster_health(env: CommandEnv, flags: dict) -> str:
     lines = [f"peers: {doc['peer_count']}  "
              f"degraded: {doc['degraded']}  "
              f"stale: {', '.join(doc['stale_peers']) or 'none'}"]
+    # one-line alerting rollup (best-effort: an old master without the
+    # engine must not break the health view)
+    try:
+        al = env.master_get("/cluster/alerts")
+        firing = [a["name"] for a in al.get("alerts", [])
+                  if a["state"] == "firing"]
+        lines.append(f"alerts: {al.get('firing', 0)} firing"
+                     + (f" ({', '.join(firing)})" if firing else ""))
+    except Exception:
+        pass
     t = doc["totals"]
     lines.append(f"totals: worker_restarts={t['worker_restarts']} "
                  f"engine_fallbacks={t['engine_fallbacks']} "

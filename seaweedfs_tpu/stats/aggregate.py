@@ -328,6 +328,7 @@ class ClusterAggregator:
                 max_workers=min(8, len(urls)),
                 thread_name_prefix="metrics-scrape") as pool:
             results = list(pool.map(one, urls))
+        lost: list[tuple[str, str]] = []
         with self._lock:
             for url, families, err, scrub in results:
                 st = self._peers.setdefault(url, _PeerState())
@@ -340,7 +341,16 @@ class ClusterAggregator:
                 else:
                     # keep the last-good families: the merge serves them
                     # marked stale instead of dipping cluster counters
+                    if st.up:
+                        # up -> down TRANSITION: journal it (once per
+                        # loss, not per scrape — flapping stays readable)
+                        lost.append((url, err))
                     st.up, st.error = False, err
+        if lost:
+            from ..observability import events as _events
+
+            for url, err in lost:
+                _events.emit("peer_stale", peer=url, error=err)
 
     def start_loop(self, interval: float) -> threading.Thread:
         """Optional periodic scraper (the `-metricsAggregationSeconds`

@@ -375,6 +375,13 @@ class _ServerMetrics:
         self.request_histogram = registry.histogram(
             f"{ns}_request_seconds", f"Bucketed {subsystem} request latency.",
             labels=("type",))
+        # 5xx responses per route: the numerator of the alerting
+        # engine's error-ratio burn-rate SLO (observability/alerts.py) —
+        # 4xx are client mistakes and never count against the budget
+        self.request_errors = registry.counter(
+            f"{ns}_request_errors_total",
+            f"Counter of {subsystem} requests answered 5xx.",
+            labels=("type",))
 
 
 class MasterMetrics(_ServerMetrics):

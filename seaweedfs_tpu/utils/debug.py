@@ -26,7 +26,7 @@ import threading
 import traceback
 from typing import Callable, Optional
 
-from .httpd import Request, Response, Router
+from .httpd import HttpError, Request, Response, Router
 
 
 def _profile_text(seconds: float, interval: float = 0.005) -> str:
@@ -171,6 +171,8 @@ def _render_status_html(name: str, status: dict) -> str:
  <a href="/debug/traces">traces</a>
  <a href="/debug/traces/analyze?format=text">analyze</a>
  <a href="/debug/profile">profile</a>
+ <a href="/debug/events">events</a>
+ <a href="/debug/flightrecorder">flight recorder</a>
 </div>
 {body}
 </body></html>"""
@@ -296,6 +298,81 @@ def register_debug_routes(router: Router,
             disable_tracing()
         return Response(raw=json.dumps(doc).encode(),
                         headers={"Content-Type": "application/json"})
+
+    @router.route("GET", "/debug/events")
+    def debug_events(req: Request) -> Response:
+        """This process's structured event journal
+        (observability/events.py): the typed record of every degraded
+        moment (worker restarts, engine fallbacks, shard corruption,
+        scrub verdicts, degraded binds) with severity, timestamp, and
+        the distributed-trace id active when it happened.  Filters:
+        ?type=, ?severity= (exact), ?min_severity=, ?since_seq=,
+        ?since=<unix ts>, ?limit=N."""
+        from ..observability.events import get_journal
+
+        j = get_journal()
+        try:
+            since_seq = int(req.query.get("since_seq") or 0)
+            since_ts = float(req.query.get("since") or 0.0)
+            limit = min(int(req.query.get("limit") or 256), 2048)
+        except ValueError as e:
+            # a typo'd query param is the CLIENT's mistake: 400, never
+            # a 500 that burns the error-ratio SLO budget
+            raise HttpError(400, f"bad query parameter: {e}")
+        events = j.query(
+            type_=req.query.get("type") or None,
+            severity=req.query.get("severity") or None,
+            min_severity=req.query.get("min_severity") or None,
+            since_seq=since_seq, since_ts=since_ts, limit=limit)
+        return Response({"events": events, "count": len(events),
+                         "namespace": j.namespace,
+                         "dropped": j.dropped})
+
+    @router.route("POST", "/debug/flightrecorder/capture")
+    def flightrecorder_capture(req: Request) -> Response:
+        """Freeze this process's diagnostics into one spooled bundle
+        (trace-ring dump + short sampling profile + /metrics exposition
+        + recent events) — what the master's alert engine POSTs when a
+        rule fires, and what `weed shell alerts.capture` drives by
+        hand.  Body knobs: reason, alert, trace_id, profile_s."""
+        from ..observability.flightrecorder import get_flightrecorder
+
+        try:
+            b = req.json()
+        except Exception:
+            b = {}
+        try:
+            profile_s = min(float(b.get("profile_s", 0.25)), 5.0)
+        except (TypeError, ValueError):
+            raise HttpError(400, "bad profile_s")
+        meta = get_flightrecorder().capture(
+            reason=str(b.get("reason") or "manual"),
+            alert=(str(b.get("alert")) if b.get("alert") else None),
+            trace_id=(str(b.get("trace_id"))
+                      if b.get("trace_id") else None),
+            profile_s=profile_s)
+        return Response(meta, status=201)
+
+    @router.route("GET", "/debug/flightrecorder")
+    def flightrecorder_list(req: Request) -> Response:
+        from ..observability.flightrecorder import get_flightrecorder
+
+        fr = get_flightrecorder()
+        return Response({"bundles": fr.list(),
+                         "spool_dir": fr.spool_dir or "",
+                         "total_bytes": fr.total_bytes(),
+                         "captures": fr.captures,
+                         "evicted": fr.evicted})
+
+    @router.route("GET", r"/debug/flightrecorder/([A-Za-z0-9][A-Za-z0-9._-]*)")
+    def flightrecorder_get(req: Request) -> Response:
+        from ..observability.flightrecorder import get_flightrecorder
+
+        doc = get_flightrecorder().get(req.match.group(1))
+        if doc is None:
+            raise HttpError(404,
+                            f"no bundle {req.match.group(1)!r} spooled")
+        return Response(doc)
 
     if status_fn is not None:
         @router.route("GET", "/ui")

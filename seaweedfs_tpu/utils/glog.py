@@ -4,6 +4,13 @@ Equivalent of weed/glog: `V(level)` gates verbose logs on the process-wide
 verbosity (set by the -v flag, weed/weed.go:46 wires MaxSize etc.);
 Infof/Warningf/Errorf always emit. Output goes through the stdlib logging
 root so tests can capture it and services can add file rotation handlers.
+
+Log lines emitted while the calling thread holds a SAMPLED
+distributed-trace decision (observability/context.py) are prefixed with
+`[trace <id>]`, so a grep of stderr joins the stitched cluster trace the
+master collected for the same operation.  Off the sampled path the cost
+is one thread-local read per emitted record — and records are only
+formatted when actually logged.
 """
 
 from __future__ import annotations
@@ -16,17 +23,40 @@ _logger = logging.getLogger("weed")
 _verbosity = 0
 _lock = threading.Lock()
 
+# lazily bound observability.context.current_sampled (None = not yet
+# tried, False = import failed — stripped-down deployments keep logging)
+_current_sampled = None
 
-def init(verbosity: int = 0, to_stderr: bool = True) -> None:
+
+def _trace_prefix_filter(record: logging.LogRecord) -> bool:
+    """Handler filter: stamp `record.trace` with `[trace <id>] ` when
+    the emitting thread's trace-context decision is sampled."""
+    global _current_sampled
+    if _current_sampled is None:
+        try:
+            from ..observability.context import current_sampled
+            _current_sampled = current_sampled
+        except Exception:
+            _current_sampled = False
+    ctx = _current_sampled() if _current_sampled else None
+    record.trace = f"[trace {ctx.trace_id}] " if ctx is not None else ""
+    return True
+
+
+def init(verbosity: int = 0, to_stderr: bool = True,
+         level: int = logging.DEBUG) -> None:
+    """`level` gates the stdlib logger (a service embedding this can run
+    at WARNING without touching verbosity, which only gates V(n))."""
     global _verbosity
     _verbosity = verbosity
     if to_stderr and not _logger.handlers:
         h = logging.StreamHandler(sys.stderr)
         h.setFormatter(logging.Formatter(
-            "%(levelname).1s%(asctime)s %(threadName)s %(message)s",
+            "%(levelname).1s%(asctime)s %(threadName)s %(trace)s%(message)s",
             datefmt="%m%d %H:%M:%S"))
+        h.addFilter(_trace_prefix_filter)
         _logger.addHandler(h)
-        _logger.setLevel(logging.DEBUG)
+    _logger.setLevel(level)
 
 
 def set_verbosity(v: int) -> None:
@@ -35,7 +65,9 @@ def set_verbosity(v: int) -> None:
 
 
 class _V:
-    """glog.V(n).Infof(...) — emits only when n <= verbosity."""
+    """glog.V(n).Infof(...) — emits only when n <= verbosity.  Carries
+    the full warning/error surface: `V(n).warningf(...)` call sites must
+    gate on verbosity exactly like infof, not crash."""
 
     def __init__(self, enabled: bool):
         self.enabled = enabled
@@ -43,6 +75,14 @@ class _V:
     def infof(self, fmt: str, *args) -> None:
         if self.enabled:
             _logger.info(fmt % args if args else fmt)
+
+    def warningf(self, fmt: str, *args) -> None:
+        if self.enabled:
+            _logger.warning(fmt % args if args else fmt)
+
+    def errorf(self, fmt: str, *args) -> None:
+        if self.enabled:
+            _logger.error(fmt % args if args else fmt)
 
 
 def V(level: int) -> _V:  # noqa: N802 — matches glog.V

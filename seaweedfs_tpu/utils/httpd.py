@@ -168,6 +168,9 @@ class Router:
                 # series break rate() dashboards and alerts
                 self.metrics.request_counter.labels(fn.__name__)
                 self.metrics.request_histogram.labels(fn.__name__)
+                errs = getattr(self.metrics, "request_errors", None)
+                if errs is not None:
+                    errs.labels(fn.__name__)
             return fn
 
         return deco
@@ -250,6 +253,14 @@ class Router:
                                 {"error": f"{type(e).__name__}: {e}"}, status=500)
                 if self.metrics is not None:
                     self.metrics.request_counter.inc(fn.__name__)
+                    if resp.status >= 500:
+                        # per-route 5xx counter: the burn-rate SLO's
+                        # numerator (guarded: custom metrics bundles
+                        # may predate the family)
+                        errs = getattr(self.metrics, "request_errors",
+                                       None)
+                        if errs is not None:
+                            errs.inc(fn.__name__)
                     # RED histogram keyed by route; sampled requests
                     # attach their trace id as an exemplar, so a latency
                     # outlier on /metrics links straight to the stitched

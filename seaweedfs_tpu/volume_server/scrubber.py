@@ -131,8 +131,36 @@ class EcScrubber:
     def run_pass(self) -> dict:
         """One full scan over every mounted EC volume, resuming from the
         cursor.  Synchronous — tests and the one-shot mode call it
-        directly."""
+        directly.
+
+        Each pass is a FORCE-SAMPLED distributed-trace root (passes are
+        rare and cheap to record): the pass's spans ship to the master's
+        collector and every event the scan emits (shard_corrupt,
+        scrub_repair, ...) carries the pass's trace id — the join key
+        the alert that fires on this scan hands the operator."""
         tr = get_tracer()
+        from ..observability import context as _trace_context
+
+        ctx = prev = None
+        if tr.enabled and _trace_context.current() is None:
+            ctx = _trace_context.TraceContext(_trace_context.new_trace_id())
+            prev = _trace_context.activate(ctx)
+        # stamp the scan thread with the owning server's identity: spans
+        # and journal events emitted here attribute to THIS volume
+        # server even when several servers share the process (the same
+        # fix the Router applies per request)
+        ip = getattr(self.store, "ip", None)
+        port = getattr(self.store, "port", None)
+        prev_srv = _trace_context.swap_server(
+            f"{ip}:{port}" if ip and port else None)
+        try:
+            return self._run_pass_inner(tr)
+        finally:
+            _trace_context.swap_server(prev_srv)
+            if ctx is not None:
+                _trace_context.activate(prev)
+
+    def _run_pass_inner(self, tr) -> dict:
         with tr.span("ec.scrub.pass", cursor_vid=self.cursor[0]):
             vids = sorted(self.store.ec_volumes)
             cv = self.cursor[0]
@@ -326,3 +354,14 @@ class EcScrubber:
             verdict["error"] = error[:300]
         with self._lock:
             self.verdicts[vid] = verdict
+        # journal the outcome (observability/events.py): the alert that
+        # fires on the scrub counters points here, and the event carries
+        # this pass's force-sampled trace id
+        from ..observability import events as _events
+
+        _events.emit(
+            {"repaired": "scrub_repair",
+             "unrepairable": "scrub_unrepairable",
+             "repair_failed": "scrub_repair_failed"}[verdict["status"]],
+            vid=vid, shards=sorted(corrupt), blocks=blocks,
+            error=error[:200] if error else "")

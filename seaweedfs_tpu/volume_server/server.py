@@ -50,7 +50,7 @@ FID_PATTERN = r"/(\d+),([0-9a-f]+)"
 
 
 def _bind_with_retry(factory, timeout: float = 3.0, pause: float = 0.15,
-                     role: str = "volume"):
+                     role: str = "volume", server: str = ""):
     """The TCP data plane binds the DERIVED port tcp_port_for(http_port),
     so a prior server instance draining its listener (restart, test
     teardown, TIME_WAIT without reuse) races the bind — retry briefly
@@ -74,6 +74,7 @@ def _bind_with_retry(factory, timeout: float = 3.0, pause: float = 0.15,
         if time.monotonic() >= deadline:
             if exc is not None:
                 raise exc
+            from ..observability import events as _events
             from ..observability import get_tracer
             from ..stats import ec_pipeline_metrics
 
@@ -81,6 +82,10 @@ def _bind_with_retry(factory, timeout: float = 3.0, pause: float = 0.15,
             get_tracer().event("server.degraded_bind", role=role,
                                detail="tcp plane bind failed; "
                                       "HTTP plane still serves")
+            _events.emit("degraded_bind", role=role,
+                         server=server or None,
+                         detail="tcp plane bind failed; "
+                                "HTTP plane still serves")
             return srv  # degraded server: the HTTP plane still serves
         time.sleep(pause)
 
@@ -143,6 +148,19 @@ class VolumeServer:
         self._trace_shipper = TraceShipper(
             get_tracer(), server=self.url,
             master_url_fn=lambda: self.master_url)
+        # structured-event shipping to the master's cluster journal
+        # (same follow-the-leader transport as the trace shipper), and
+        # the flight-recorder spool on this server's first data dir so
+        # captured bundles survive restarts with the data they explain
+        from ..observability.events import EventShipper, get_journal
+        from ..observability.flightrecorder import get_flightrecorder
+
+        self._event_shipper = EventShipper(
+            get_journal(), server=self.url,
+            master_url_fn=lambda: self.master_url)
+        if directories:
+            get_flightrecorder().configure(
+                spool_dir=os.path.join(directories[0], "flightrecorder"))
         self.metrics.max_volume_counter.set(max_volume_count)
         self.router = Router("volume", metrics=self.metrics)
         self.router.server_url = self.url
@@ -186,6 +204,10 @@ class VolumeServer:
     def start(self) -> "VolumeServer":
         self._server = serve(self.router, self.store.ip, self.store.port,
                              tls_context=self._tls_context)
+        # BEFORE the TCP plane binds: a degraded_bind event emitted by
+        # _bind_with_retry must find the shipper hooked (attach has no
+        # backfill — an event emitted before it never ships)
+        self._event_shipper.attach()
         # the framed-TCP path has no JWT or TLS slot, so it must never
         # open an unauthenticated side door: it stays closed when write
         # OR read JWTs are configured, and when cluster mTLS is on
@@ -212,7 +234,7 @@ class VolumeServer:
                             else tcp_port_for(self.store.port))
                 self._native_plane = _bind_with_retry(
                     lambda: NativeDataPlane(self.store.ip, tcp_port),
-                    role="volume-native")
+                    role="volume-native", server=self.url)
                 self.store.attach_native_plane(self._native_plane)
             else:
                 from .tcp import TcpVolumeServer
@@ -224,7 +246,7 @@ class VolumeServer:
                                       if self.guard.is_write_active else None),
                         replicate_write=self._tcp_replicate_write,
                         replicate_delete=self._tcp_replicate_delete).start(),
-                    role="volume-tcp")
+                    role="volume-tcp", server=self.url)
         self._trace_shipper.attach()
         threading.Thread(target=self._heartbeat_loop, daemon=True,
                          name=f"heartbeat:{self.url}").start()
@@ -233,6 +255,7 @@ class VolumeServer:
     def stop(self) -> None:
         self._stop.set()
         self._trace_shipper.detach()
+        self._event_shipper.detach()
         self.scrubber.stop(join_timeout=0.5)
         if self._tcp_server is not None:
             self._tcp_server.stop()

@@ -1,0 +1,799 @@
+"""Alerting engine + event journal + flight recorder (the ACTIVE third
+of the observability stack).
+
+Units drive synthetic metric sequences through the alert state machine
+(pending -> firing -> resolved, hold-downs, burn-rate fast/slow window
+matrix, counter-reset tolerance) and the event journal / flight
+recorder in isolation; the live drill runs a real master + volume
+server, injects `ec.shard.corrupt`, and asserts the whole chain fires
+WITHOUT manual polling: scrub detects -> counters rise -> rule fires ->
+events journaled with the scrub's trace id -> flight-recorder bundles
+captured and fetchable.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu.observability import context as trace_context
+from seaweedfs_tpu.observability.alerts import (AlertEngine, Rule,
+                                                default_rules)
+from seaweedfs_tpu.observability.events import (ClusterEventJournal,
+                                                EVENT_TYPES,
+                                                EventJournal,
+                                                EventShipper)
+from seaweedfs_tpu.observability.flightrecorder import FlightRecorder
+from seaweedfs_tpu.stats.metrics import Counter, Histogram
+
+rng = np.random.default_rng(23)
+
+
+# --- event journal ---------------------------------------------------------
+
+class TestEventJournal:
+    def test_emit_defaults_and_filters(self):
+        j = EventJournal(capacity=16)
+        j.emit("worker_restart", kind="staged")
+        j.emit("shard_corrupt", shard=3)
+        j.emit("alert_fired", severity="critical", alert="x")
+        assert [e["type"] for e in j.query()] == [
+            "worker_restart", "shard_corrupt", "alert_fired"]
+        # severity defaults ride the registry
+        assert j.query(type_="worker_restart")[0]["severity"] == \
+            EVENT_TYPES["worker_restart"]
+        assert [e["type"] for e in j.query(min_severity="error")] == \
+            ["shard_corrupt", "alert_fired"]
+        assert j.query(severity="critical")[0]["details"]["alert"] == "x"
+        seq = j.query(type_="worker_restart")[0]["seq"]
+        assert all(e["seq"] > seq for e in j.query(since_seq=seq))
+
+    def test_bounded_ring_counts_drops(self):
+        j = EventJournal(capacity=4)
+        for i in range(10):
+            j.emit("worker_restart", i=i)
+        assert len(j.query(limit=0)) == 4
+        assert j.dropped == 6
+        # the tail keeps the most RECENT events
+        assert j.query(limit=2)[-1]["details"]["i"] == 9
+
+    def test_trace_and_server_ride_thread_locals(self):
+        j = EventJournal()
+        ctx = trace_context.TraceContext("ab" * 16)
+        prev = trace_context.activate(ctx)
+        prev_srv = trace_context.swap_server("vs:8080")
+        try:
+            e = j.emit("shard_corrupt", shard=1).to_dict()
+        finally:
+            trace_context.swap_server(prev_srv)
+            trace_context.activate(prev)
+        assert e["trace"] == "ab" * 16
+        assert e["server"] == "vs:8080"
+        # outside any decision: no trace, no server
+        e2 = j.emit("shard_corrupt", shard=2).to_dict()
+        assert "trace" not in e2 and "server" not in e2
+
+    def test_cluster_journal_dedups_and_bounds(self):
+        src = EventJournal(namespace="n1")
+        docs = [src.emit("worker_restart", server="vs:1",
+                         i=i).to_dict() for i in range(3)]
+        cj = ClusterEventJournal(capacity=4)
+        assert cj.ingest("vs:1", docs) == 3
+        # re-ship (chained shippers / retries) is a no-op
+        assert cj.ingest("vs:1", docs) == 0
+        assert len(cj) == 3
+        assert all(e["server"] == "vs:1" for e in cj.query())
+        other = EventJournal(namespace="n2")
+        more = [other.emit("shard_corrupt", server="vs:2",
+                           i=i).to_dict() for i in range(3)]
+        cj.ingest("vs:2", more)
+        assert len(cj) == 4 and cj.dropped == 2  # oldest evicted
+        assert cj.query(type_="shard_corrupt", server="vs:2")
+
+    def test_transport_labels_but_never_claims_attribution(self):
+        """The shipping hop records itself as `via`; an event that
+        arrives unattributed STAYS unattributed — the transport must
+        not claim emission (co-located shippers would otherwise race
+        their conflicting stamps through the dedup)."""
+        src = EventJournal(namespace="nx")
+        doc = src.emit("worker_restart", kind="staged").to_dict()
+        cj = ClusterEventJournal()
+        cj.ingest("m:1", [doc])
+        (e,) = cj.query()
+        assert "server" not in e and e["via"] == "m:1"
+
+    def test_sole_shipper_default_stamps_background_emits(self):
+        """With exactly ONE shipper attached (the production
+        one-server-per-process shape), events emitted on background
+        threads with no request thread-local still attribute to that
+        server; a second co-located shipper makes the default
+        AMBIGUOUS and emits go unattributed instead of guessing."""
+        j = EventJournal()
+        cj = ClusterEventJournal()
+        s1 = EventShipper(j, server="vs:1", local_journal=cj,
+                          flush_interval=0.05).attach()
+        try:
+            assert j.emit("worker_restart").to_dict()["server"] == "vs:1"
+            s2 = EventShipper(j, server="m:2", local_journal=cj,
+                              flush_interval=0.05).attach()
+            try:
+                assert "server" not in j.emit("worker_restart").to_dict()
+                # explicit identity always wins over the default
+                assert j.emit("worker_restart", server="vs:1") \
+                    .to_dict()["server"] == "vs:1"
+            finally:
+                s2.detach()
+            # back to one shipper: the default is unambiguous again
+            assert j.emit("worker_restart").to_dict()["server"] == "vs:1"
+        finally:
+            s1.detach()
+
+    def test_emit_before_attach_never_ships(self):
+        """attach() has no backfill — which is why the servers hook
+        their shipper BEFORE any bind attempt can emit degraded_bind."""
+        j = EventJournal()
+        cj = ClusterEventJournal()
+        j.emit("degraded_bind", role="early")
+        sh = EventShipper(j, server="m:1", local_journal=cj,
+                          flush_interval=0.05).attach()
+        try:
+            j.emit("degraded_bind", role="late")
+            deadline = time.time() + 3
+            while time.time() < deadline and not len(cj):
+                time.sleep(0.02)
+            roles = {e["details"]["role"] for e in cj.query()}
+            assert roles == {"late"}
+        finally:
+            sh.detach()
+
+    def test_shipper_local_short_circuit(self):
+        j = EventJournal()
+        cj = ClusterEventJournal()
+        sh = EventShipper(j, server="m:1", local_journal=cj,
+                          flush_interval=0.05).attach()
+        try:
+            j.emit("degraded_bind", role="tcp")
+            deadline = time.time() + 3
+            while time.time() < deadline and not len(cj):
+                time.sleep(0.02)
+            assert cj.query(type_="degraded_bind")
+        finally:
+            sh.detach()
+
+
+# --- alert state machine ---------------------------------------------------
+
+def _health(peers: dict, totals: dict, stale=()):
+    return {"peers": {u: {"pipeline_health": ph} for u, ph in
+                      peers.items()},
+            "totals": totals, "stale_peers": list(stale),
+            "degraded": any(totals.values()), "peer_count": len(peers)}
+
+
+class TestStateMachine:
+    def _engine(self, rules, source, **kw):
+        return AlertEngine(rules, source_fn=source, min_interval=0.0,
+                           journal=EventJournal(), **kw)
+
+    def test_counter_increase_full_lifecycle(self):
+        state = {"v": 0}
+        rule = Rule("r", "counter_increase", "error", for_s=0.0,
+                    keep_firing_s=10.0, params={"key": "corrupt_shards"})
+        eng = self._engine([rule], lambda: (_health(
+            {"vs:1": {"corrupt_shards": state["v"]}},
+            {"corrupt_shards": state["v"]}), {}))
+        # first sight = baseline, never a fire
+        assert eng.evaluate(now=1.0, force=True)["alerts"][0]["state"] \
+            == "inactive"
+        state["v"] = 2
+        d = eng.evaluate(now=2.0, force=True)["alerts"][0]
+        assert d["state"] == "firing" and d["value"] == 2
+        assert d["servers"] == ["vs:1"]
+        # still firing while quiet < keep_firing_s
+        assert eng.evaluate(now=5.0, force=True)["alerts"][0]["state"] \
+            == "firing"
+        # resolves after sustained quiet
+        d = eng.evaluate(now=13.0, force=True)["alerts"][0]
+        assert d["state"] == "resolved"
+        # journal recorded the transitions
+        types = [e["type"] for e in eng.journal.query()]
+        assert types == ["alert_pending", "alert_fired",
+                         "alert_resolved"]
+        # reactivation starts a fresh cycle
+        state["v"] = 3
+        assert eng.evaluate(now=14.0, force=True)["alerts"][0]["state"] \
+            == "firing"
+
+    def test_hold_down_respected(self):
+        """A condition shorter than for_s never fires."""
+        state = {"v": 0}
+        rule = Rule("r", "counter_increase", for_s=5.0,
+                    params={"key": "worker_restarts"})
+        eng = self._engine([rule], lambda: (_health(
+            {"vs:1": {"worker_restarts": state["v"]}},
+            {"worker_restarts": state["v"]}), {}))
+        eng.evaluate(now=1.0, force=True)
+        state["v"] = 1
+        assert eng.evaluate(now=2.0, force=True)["alerts"][0]["state"] \
+            == "pending"
+        # condition clears before the hold-down elapses: back to
+        # inactive, alert_fired never journaled
+        assert eng.evaluate(now=3.0, force=True)["alerts"][0]["state"] \
+            == "inactive"
+        assert not eng.journal.query(type_="alert_fired")
+        # sustained condition crosses the hold-down and fires
+        state["v"] = 2
+        eng.evaluate(now=4.0, force=True)
+        state["v"] = 3
+        eng.evaluate(now=6.0, force=True)
+        state["v"] = 4
+        d = eng.evaluate(now=9.5, force=True)["alerts"][0]
+        assert d["state"] == "firing"
+
+    def test_counter_reset_tolerated(self):
+        """A peer restart drops its counter to 0: re-baseline, never
+        fire, and the next REAL increase still fires."""
+        state = {"v": 7}
+        rule = Rule("r", "counter_increase",
+                    params={"key": "engine_fallbacks"})
+        eng = self._engine([rule], lambda: (_health(
+            {"vs:1": {"engine_fallbacks": state["v"]}},
+            {"engine_fallbacks": state["v"]}), {}))
+        eng.evaluate(now=1.0, force=True)
+        state["v"] = 0  # restart
+        assert eng.evaluate(now=2.0, force=True)["alerts"][0]["state"] \
+            == "inactive"
+        state["v"] = 1
+        assert eng.evaluate(now=3.0, force=True)["alerts"][0]["state"] \
+            == "firing"
+
+    def test_threshold_and_peer_down(self):
+        totals = {"scrub_unrepairable": 0}
+        stale: list = []
+        rules = [Rule("unrep", "threshold", "critical",
+                      params={"key": "scrub_unrepairable", "min": 1}),
+                 Rule("peer", "peer_down", keep_firing_s=0.0)]
+        eng = self._engine(rules, lambda: (_health({}, totals, stale),
+                                           {}))
+        d = {a["name"]: a for a in
+             eng.evaluate(now=1.0, force=True)["alerts"]}
+        assert d["unrep"]["state"] == "inactive"
+        assert d["peer"]["state"] == "inactive"
+        totals["scrub_unrepairable"] = 2
+        stale.append("vs:9")
+        d = {a["name"]: a for a in
+             eng.evaluate(now=2.0, force=True)["alerts"]}
+        assert d["unrep"]["state"] == "firing"
+        assert d["peer"]["state"] == "firing"
+        assert "vs:9" in d["peer"]["detail"]
+        totals["scrub_unrepairable"] = 0
+        stale.clear()
+        d = {a["name"]: a for a in
+             eng.evaluate(now=3.0, force=True)["alerts"]}
+        # keep_firing_s=0 resolves on the first clean evaluation
+        assert d["peer"]["state"] == "resolved"
+
+    def test_on_fire_called_once_with_servers(self):
+        fired = []
+        state = {"v": 0}
+        rule = Rule("r", "counter_increase",
+                    params={"key": "corrupt_shards"})
+        eng = self._engine(
+            [rule], lambda: (_health(
+                {"vs:1": {"corrupt_shards": state["v"]}},
+                {"corrupt_shards": state["v"]}), {}),
+            on_fire=lambda r, st, servers: fired.append(
+                (r.name, servers)))
+        eng.evaluate(now=1.0, force=True)
+        state["v"] = 1
+        eng.evaluate(now=2.0, force=True)
+        state["v"] = 2
+        eng.evaluate(now=3.0, force=True)  # still firing: no re-fire
+        assert fired == [("r", ["vs:1"])]
+
+    def test_ttl_early_return_serves_last_state(self):
+        """An unforced evaluate inside min_interval returns the last
+        round's state WITHOUT re-evaluating (and without deadlocking —
+        the early return re-takes the engine lock for the snapshot)."""
+        calls = []
+        rule = Rule("r", "counter_increase",
+                    params={"key": "corrupt_shards"})
+        eng = AlertEngine(
+            [rule], lambda: (calls.append(1) or _health(
+                {"vs:1": {"corrupt_shards": 0}},
+                {"corrupt_shards": 0}), {}),
+            min_interval=60.0, journal=EventJournal())
+        eng.evaluate(now=100.0, force=True)
+        d = eng.evaluate(now=101.0)  # inside the TTL, not forced
+        assert d["evaluations"] == 1 and len(calls) == 1
+        d = eng.evaluate(now=200.0)  # TTL elapsed
+        assert d["evaluations"] == 2 and len(calls) == 2
+
+    def test_broken_rule_isolated(self):
+        """One rule raising must not stop the others evaluating."""
+        state = {"v": 0}
+        rules = [Rule("bad", "counter_increase", params={}),  # no key
+                 Rule("good", "counter_increase",
+                      params={"key": "corrupt_shards"})]
+        eng = self._engine(rules, lambda: (_health(
+            {"vs:1": {"corrupt_shards": state["v"]}},
+            {"corrupt_shards": state["v"]}), {}))
+        eng.evaluate(now=1.0, force=True)
+        state["v"] = 1
+        d = {a["name"]: a for a in
+             eng.evaluate(now=2.0, force=True)["alerts"]}
+        assert d["good"]["state"] == "firing"
+        assert "rule error" in d["bad"]["detail"]
+
+
+# --- burn-rate windows -----------------------------------------------------
+
+def _error_rule(**over):
+    params = {"mode": "error_ratio", "errors": "E", "requests": "R",
+              "max_ratio": 0.01, "fast_s": 10.0, "slow_s": 60.0,
+              "min_requests": 10}
+    params.update(over)
+    return Rule("burn", "burn_rate", "critical", keep_firing_s=0.0,
+                params=params)
+
+
+class _Red:
+    """Synthetic per-route RED counters the burn rules read."""
+
+    def __init__(self):
+        self.req = Counter("R", labels=("type",))
+        self.err = Counter("E", labels=("type",))
+        self.hist = Histogram("H", labels=("type",),
+                              buckets=(0.01, 0.1, 0.5, 1.0))
+
+    @property
+    def families(self):
+        return {"R": self.req, "E": self.err, "H": self.hist}
+
+
+class TestBurnRate:
+    def _engine(self, rule, red):
+        return AlertEngine([rule], lambda: ({"peers": {}, "totals": {},
+                                             "stale_peers": []},
+                                            red.families),
+                           min_interval=0.0, journal=EventJournal())
+
+    def test_fast_blip_does_not_fire_slow_burn_does(self):
+        red = _Red()
+        eng = self._engine(_error_rule(max_ratio=0.05), red)
+        now = 1000.0
+        # 60s of clean history: 600 requests, 0 errors
+        for i in range(7):
+            red.req.inc("read", amount=100)
+            eng.evaluate(now=now + i * 10, force=True)
+        # one fast window with 8% errors — but over the slow window the
+        # ratio is 8/700 ~ 1.1% < 5%: fast breaches, slow doesn't
+        red.req.inc("read", amount=100)
+        red.err.inc("read", amount=8)
+        d = eng.evaluate(now=now + 70, force=True)["alerts"][0]
+        assert d["state"] == "inactive"
+        # sustain the burn: every subsequent window runs at 8% errors,
+        # so the slow ratio climbs past 5% too -> fires
+        state = "inactive"
+        for i in range(8, 15):
+            red.req.inc("read", amount=100)
+            red.err.inc("read", amount=8)
+            state = eng.evaluate(
+                now=now + i * 10, force=True)["alerts"][0]["state"]
+            if state == "firing":
+                break
+        assert state == "firing"
+
+    def test_windows_need_history(self):
+        """No base sample older than the window yet -> never fires (a
+        fresh engine must not page on startup)."""
+        red = _Red()
+        eng = self._engine(_error_rule(), red)
+        red.req.inc("read", amount=100)
+        red.err.inc("read", amount=50)
+        d = eng.evaluate(now=1000.0, force=True)["alerts"][0]
+        assert d["state"] == "inactive"
+        red.req.inc("read", amount=100)
+        red.err.inc("read", amount=50)
+        # 15s later: fast window evaluable, slow (60s) still not
+        d = eng.evaluate(now=1015.0, force=True)["alerts"][0]
+        assert d["state"] == "inactive"
+
+    def test_min_requests_guards_noise(self):
+        red = _Red()
+        eng = self._engine(_error_rule(min_requests=50), red)
+        now = 1000.0
+        for i in range(7):
+            red.req.inc("read", amount=5)
+            eng.evaluate(now=now + i * 10, force=True)
+        red.req.inc("read", amount=5)
+        red.err.inc("read", amount=5)  # 100% errors but 5 < 50 reqs
+        d = eng.evaluate(now=now + 70, force=True)["alerts"][0]
+        assert d["state"] == "inactive"
+
+    def test_counter_reset_skips_route(self):
+        red = _Red()
+        eng = self._engine(_error_rule(), red)
+        now = 1000.0
+        for i in range(7):
+            red.req.inc("read", amount=100)
+            eng.evaluate(now=now + i * 10, force=True)
+        # "restart": replace counters with smaller values
+        red.req = Counter("R", labels=("type",))
+        red.err = Counter("E", labels=("type",))
+        red.req.inc("read", amount=10)
+        red.err.inc("read", amount=10)
+        d = eng.evaluate(now=now + 70, force=True)["alerts"][0]
+        assert d["state"] == "inactive"  # negative delta: re-baseline
+
+    def test_p99_latency_burn(self):
+        rule = Rule("lat", "burn_rate", "critical", keep_firing_s=0.0,
+                    params={"mode": "p99", "family": "H",
+                            "max_p99_s": 0.3, "fast_s": 10.0,
+                            "slow_s": 60.0, "min_requests": 10})
+        red = _Red()
+        eng = self._engine(rule, red)
+        now = 1000.0
+        for i in range(7):
+            for _ in range(50):
+                red.hist.observe("read", 0.005)  # all fast
+            eng.evaluate(now=now + i * 10, force=True)
+        d = eng.evaluate(now=now + 69, force=True)["alerts"][0]
+        assert d["state"] == "inactive"
+        # sustained slowness: p99 lands in the 0.5s bucket > 0.3s SLO
+        state = "inactive"
+        for i in range(7, 15):
+            for _ in range(50):
+                red.hist.observe("read", 0.4)
+            state = eng.evaluate(
+                now=now + i * 10, force=True)["alerts"][0]["state"]
+            if state == "firing":
+                break
+        assert state == "firing"
+        assert "p99" in eng.to_dict()["alerts"][0]["detail"]
+
+
+# --- flight recorder -------------------------------------------------------
+
+class TestFlightRecorder:
+    def test_capture_list_get_roundtrip(self, tmp_path):
+        from seaweedfs_tpu.stats import ec_pipeline_metrics
+
+        ec_pipeline_metrics()  # ensure the exposition has families
+        fr = FlightRecorder(spool_dir=str(tmp_path / "spool"))
+        meta = fr.capture(reason="unit", alert="r1", server="vs:1",
+                          profile_s=0.0)
+        assert meta["id"].startswith("fr-") and meta["bytes"] > 0
+        ids = [b["id"] for b in fr.list()]
+        assert meta["id"] in ids
+        doc = fr.get(meta["id"])
+        assert doc["format"] == "seaweedfs-tpu-flightrecorder-v1"
+        assert doc["meta"]["alert"] == "r1"
+        assert set(doc) >= {"trace", "profile", "metrics", "events"}
+        assert "SeaweedFS" in doc["metrics"]
+        # the capture itself journals a flight_capture event
+        from seaweedfs_tpu.observability.events import get_journal
+
+        assert any(e["type"] == "flight_capture"
+                   and e["details"]["id"] == meta["id"]
+                   for e in get_journal().query(type_="flight_capture"))
+
+    def test_bad_ids_rejected(self, tmp_path):
+        fr = FlightRecorder(spool_dir=str(tmp_path / "spool"))
+        assert fr.get("../../etc/passwd") is None
+        assert fr.get("") is None
+        assert fr.get("nope") is None
+
+    def test_oldest_bundle_eviction(self, tmp_path):
+        fr = FlightRecorder(spool_dir=str(tmp_path / "spool"),
+                            max_bundles=3)
+        ids = [fr.capture(reason=f"n{i}", profile_s=0.0)["id"]
+               for i in range(5)]
+        kept = {b["id"] for b in fr.list()}
+        assert len(kept) == 3
+        assert ids[-1] in kept and ids[0] not in kept
+        assert fr.evicted == 2
+        assert fr.get(ids[0]) is None
+
+    def test_byte_cap_eviction(self, tmp_path):
+        fr = FlightRecorder(spool_dir=str(tmp_path / "spool"),
+                            max_bytes=1)  # everything over budget
+        fr.capture(reason="a", profile_s=0.0)
+        fr.capture(reason="b", profile_s=0.0)
+        assert len(fr.list()) <= 1
+
+
+# --- satellites ------------------------------------------------------------
+
+class TestGlogSatellites:
+    def test_v_warningf_errorf_exist_and_gate(self, caplog):
+        from seaweedfs_tpu.utils import glog
+
+        glog.set_verbosity(1)
+        with caplog.at_level(logging.DEBUG, logger="weed"):
+            glog.V(1).warningf("w %d", 1)
+            glog.V(1).errorf("e %d", 2)
+            glog.V(3).warningf("hidden")
+            glog.V(3).errorf("hidden")
+            glog.V(3).infof("hidden")
+        glog.set_verbosity(0)
+        msgs = [r.getMessage() for r in caplog.records]
+        assert "w 1" in msgs and "e 2" in msgs
+        assert "hidden" not in msgs
+        levels = {r.getMessage(): r.levelno for r in caplog.records}
+        assert levels["w 1"] == logging.WARNING
+        assert levels["e 2"] == logging.ERROR
+
+    def test_init_honors_level(self):
+        from seaweedfs_tpu.utils import glog
+
+        logger = logging.getLogger("weed")
+        old_level, old_handlers = logger.level, list(logger.handlers)
+        try:
+            glog.init(level=logging.WARNING)
+            assert logger.level == logging.WARNING
+            glog.init(level=logging.DEBUG)
+            assert logger.level == logging.DEBUG
+        finally:
+            logger.setLevel(old_level)
+            logger.handlers[:] = old_handlers
+
+    def test_trace_prefix_when_sampled(self):
+        from seaweedfs_tpu.utils.glog import _trace_prefix_filter
+
+        rec = logging.LogRecord("weed", logging.INFO, "f", 1, "m", (),
+                                None)
+        ctx = trace_context.TraceContext("cd" * 16)
+        prev = trace_context.activate(ctx)
+        try:
+            _trace_prefix_filter(rec)
+            assert rec.trace == f"[trace {'cd' * 16}] "
+        finally:
+            trace_context.activate(prev)
+        # unsampled / no decision: empty prefix, never an error
+        _trace_prefix_filter(rec)
+        assert rec.trace == ""
+        prev = trace_context.activate(trace_context.NOT_SAMPLED)
+        try:
+            _trace_prefix_filter(rec)
+            assert rec.trace == ""
+        finally:
+            trace_context.activate(prev)
+
+
+def test_default_rules_cover_health_families():
+    from seaweedfs_tpu.stats.aggregate import HEALTH_FAMILIES
+
+    watched = {r.params.get("key") for r in default_rules()
+               if r.kind == "counter_increase"}
+    assert watched == set(HEALTH_FAMILIES)
+    kinds = {r.kind for r in default_rules()}
+    assert kinds == {"counter_increase", "threshold", "peer_down",
+                     "burn_rate"}
+
+
+def test_degraded_bind_event_reaches_cluster_journal(tmp_path):
+    """A degraded TCP bind happens DURING server startup — the event
+    shipper must already be hooked (attach before the bind attempts,
+    no backfill exists) and the event must carry the server's own
+    identity even with co-located shippers."""
+    import socket
+
+    from seaweedfs_tpu.master.server import MasterServer
+    from seaweedfs_tpu.utils.framing import tcp_port_for
+    from seaweedfs_tpu.utils.httpd import http_json
+    from seaweedfs_tpu.volume_server.server import VolumeServer
+
+    from tests.conftest import free_port
+
+    master = MasterServer(port=free_port(), pulse_seconds=0.4).start()
+    vport = free_port()
+    blocker = socket.socket()
+    blocker.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    blocker.bind(("127.0.0.1", tcp_port_for(vport)))
+    blocker.listen(1)
+    vs = VolumeServer([], master.url, port=vport,
+                      pulse_seconds=0.4).start()
+    try:
+        deadline = time.time() + 10
+        ev = None
+        while time.time() < deadline:
+            evs = http_json(
+                "GET", f"http://{master.url}/cluster/events"
+                       "?type=degraded_bind")
+            if evs["count"]:
+                ev = evs["events"][-1]
+                break
+            time.sleep(0.2)
+        assert ev is not None, "degraded_bind never shipped"
+        assert ev["details"]["role"] == "volume-tcp"
+        assert ev["server"] == vs.url
+    finally:
+        vs.stop()
+        master.stop()
+        blocker.close()
+
+
+# --- live drill ------------------------------------------------------------
+
+@pytest.fixture()
+def tracer():
+    from seaweedfs_tpu.observability import (disable_tracing,
+                                             enable_tracing)
+
+    tr = enable_tracing()
+    tr.clear()
+    try:
+        yield tr
+    finally:
+        disable_tracing()
+        tr.clear()
+
+
+def test_live_corrupt_shard_drill(tmp_path, tracer):
+    """The acceptance drill: inject ec.shard.corrupt on a live volume
+    server; WITHOUT manual polling the master's telemetry loop must
+    produce a firing /cluster/alerts entry, correlated /cluster/events
+    records carrying the scrub pass's trace id, and fetchable
+    flight-recorder bundles containing the trace dump and metrics
+    snapshot."""
+    from seaweedfs_tpu.master.server import MasterServer
+    from seaweedfs_tpu.shell import CommandEnv, run_command
+    from seaweedfs_tpu.storage.needle import Needle
+    from seaweedfs_tpu.storage.volume import Volume
+    from seaweedfs_tpu.utils import faultinject as fi
+    from seaweedfs_tpu.utils.httpd import http_bytes, http_json
+    from seaweedfs_tpu.volume_server.server import VolumeServer
+
+    from tests.conftest import free_port
+
+    d = tmp_path / "vs0"
+    d.mkdir()
+    v = Volume(str(d), "", 1)
+    for i in range(1, 60):
+        v.write_needle(Needle(cookie=i, id=i, data=rng.bytes(500)))
+    v.close()
+    master = MasterServer(port=free_port(), pulse_seconds=0.4,
+                          metrics_aggregation_seconds=0.25).start()
+    master.aggregator.min_interval = 0.0
+    master.alert_engine.min_interval = 0.0
+    vs = VolumeServer([str(d)], master.url, port=free_port(),
+                      pulse_seconds=0.4).start()
+    try:
+        deadline = time.time() + 5
+        while time.time() < deadline and not master.topo.all_nodes():
+            time.sleep(0.05)
+        vs.store.ec_generate(1)
+        vs.store.ec_mount(1)
+        # let the loop establish counter baselines BEFORE the injection
+        # (the engine never fires on first sight of a nonzero counter)
+        deadline = time.time() + 5
+        while time.time() < deadline and \
+                not master.alert_engine.evaluations:
+            time.sleep(0.05)
+        # the injected bit rot: scrub's verify reads shard 11 flipped
+        fi.enable("ec.shard.corrupt",
+                  params={"shard": 11, "offset": 4096, "bit": 0},
+                  max_hits=1)
+        r = http_json("POST", f"http://{vs.url}/ec/scrub/start",
+                      {"rate_mb_s": 0})
+        assert r["started"] is True
+
+        # 1. the alert fires AUTONOMOUSLY (nobody calls evaluate here)
+        deadline = time.time() + 20
+        firing = {}
+        while time.time() < deadline:
+            firing = {a["name"]: a for a in
+                      master.alert_engine.to_dict()["alerts"]
+                      if a["state"] == "firing"}
+            if "corrupt_shards_increase" in firing:
+                break
+            time.sleep(0.1)
+        assert "corrupt_shards_increase" in firing, firing
+        assert firing["corrupt_shards_increase"]["servers"] == [vs.url]
+
+        # 2. correlated journal entries carry the scrub's trace id —
+        #    shard_corrupt and scrub_repair share ONE trace (the pass),
+        #    attributed to the volume server
+        deadline = time.time() + 10
+        corrupt_ev = repair_ev = None
+        while time.time() < deadline:
+            evs = http_json(
+                "GET", f"http://{master.url}/cluster/events?limit=100")
+            by_type = {}
+            for e in evs["events"]:
+                by_type.setdefault(e["type"], e)
+            corrupt_ev = by_type.get("shard_corrupt")
+            repair_ev = by_type.get("scrub_repair")
+            if corrupt_ev and repair_ev:
+                break
+            time.sleep(0.1)
+        assert corrupt_ev and repair_ev, "events never reached master"
+        scrub_trace = corrupt_ev.get("trace", "")
+        assert len(scrub_trace) == 32
+        assert repair_ev.get("trace") == scrub_trace
+        assert corrupt_ev.get("server") == vs.url
+        assert corrupt_ev["details"]["shard"] == 11
+        # the firing alert self-heals its exemplar to that trace
+        deadline = time.time() + 10
+        exemplar = ""
+        while time.time() < deadline:
+            a = {x["name"]: x for x in
+                 master.alert_engine.to_dict()["alerts"]}
+            exemplar = a["corrupt_shards_increase"].get(
+                "exemplar_trace", "")
+            if exemplar:
+                break
+            time.sleep(0.1)
+        assert exemplar == scrub_trace
+
+        # 3. flight-recorder bundles captured and fetchable
+        deadline = time.time() + 15
+        bundles = []
+        while time.time() < deadline:
+            doc = http_json("GET", f"http://{master.url}/cluster/alerts"
+                                   "?state=firing")
+            for a in doc["alerts"]:
+                if a["name"] == "corrupt_shards_increase" and \
+                        a.get("bundles"):
+                    bundles = a["bundles"]
+            if bundles:
+                break
+            time.sleep(0.2)
+        ok = [b for b in bundles if b.get("id")]
+        assert ok, bundles
+        bid, bsrv = ok[0]["id"], ok[0]["server"]
+        listing = http_json("GET",
+                            f"http://{bsrv}/debug/flightrecorder")
+        assert any(b["id"] == bid for b in listing["bundles"])
+        bdoc = http_json("GET",
+                         f"http://{bsrv}/debug/flightrecorder/{bid}")
+        assert bdoc["meta"]["alert"] == "corrupt_shards_increase"
+        # the bundle freezes the evidence: trace dump with the scrub's
+        # spans, a metrics exposition, and the event tail
+        span_names = {s["name"] for s in bdoc["trace"]["spans"]}
+        assert "ec.scrub.pass" in span_names
+        assert "SeaweedFS_ec_corrupt_shards_total" in bdoc["metrics"]
+        assert any(e["type"] == "shard_corrupt"
+                   for e in bdoc["events"])
+
+        # 4. per-server journal serves the same story locally
+        local = http_json("GET", f"http://{vs.url}/debug/events"
+                                 "?type=shard_corrupt")
+        assert local["count"] >= 1
+
+        # 5. shell ergonomics: stable text + json, and cluster.health
+        #    carries the one-line alerts rollup
+        env = CommandEnv(master.url)
+        out = run_command(env, "alerts.list -firing")
+        assert "corrupt_shards_increase" in out and "firing" in out
+        parsed = json.loads(run_command(env, "alerts.list -json"))
+        assert parsed["firing"] >= 1
+        out = run_command(env, "events.tail -n 50 -type shard_corrupt")
+        assert "shard_corrupt" in out and scrub_trace in out
+        out = run_command(env, "cluster.health")
+        assert any(line.startswith("alerts:") and "firing" in line
+                   for line in out.splitlines())
+        cap = run_command(env, f"alerts.capture -server {vs.url} "
+                               "-reason drill")
+        assert "bundle fr-" in cap
+
+        # 6. a 5xx bumps the per-route error counter (burn-rate
+        #    numerator): garbage JSON into an ingest route
+        status, _, _ = http_bytes(
+            "POST", f"http://{master.url}/cluster/events/ingest",
+            b"not json", headers={"Content-Type": "application/json"})
+        assert status == 500
+        from seaweedfs_tpu.stats import master_metrics
+
+        errs = master_metrics().request_errors.snapshot()
+        assert errs.get(("cluster_events_ingest",), 0) >= 1
+    finally:
+        fi.clear()
+        vs.stop()
+        master.stop()

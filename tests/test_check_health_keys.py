@@ -1,0 +1,138 @@
+"""Tier-1 gate: the degraded-signal tables stay mutually consistent.
+
+tools/check_health_keys.py lints stats/aggregate.py HEALTH_FAMILIES,
+analysis.py DEGRADE_COUNTER_KEYS, the events.py type registry, and the
+default alert rule set against each other — a degraded counter added to
+one table but not the others was previously silent drift.  The planted
+tests feed the checker synthetically drifted tables and assert each
+rule actually catches.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+
+from check_health_keys import check_repo, check_tables  # noqa: E402
+
+from seaweedfs_tpu.observability.alerts import Rule  # noqa: E402
+
+
+def _consistent_tables():
+    """A minimal mutually-consistent table set the planted tests
+    perturb one piece at a time."""
+    health = {"worker_restarts": "F_restarts", "corrupt_shards": "F_rot"}
+    degrade = ("worker_restarts", "corrupt_shards", "retries",
+               "fallbacks")
+    event_types = {"worker_restart": "warning", "shard_corrupt": "error",
+                   "alert_pending": "info", "alert_fired": "error",
+                   "alert_resolved": "info"}
+    mapping = {"worker_restarts": "worker_restart",
+               "corrupt_shards": "shard_corrupt"}
+    rules = [
+        Rule("worker_restarts_increase", "counter_increase",
+             severity="warning", params={"key": "worker_restarts"}),
+        Rule("corrupt_shards_increase", "counter_increase",
+             severity="error", params={"key": "corrupt_shards"}),
+    ]
+    return health, degrade, rules, event_types, mapping
+
+
+def _check(health, degrade, rules, event_types, mapping):
+    return check_tables(health, degrade, rules, event_types, mapping,
+                        allowlist=(), per_run_only=("retries",
+                                                    "fallbacks"))
+
+
+def test_consistent_tables_pass():
+    assert _check(*_consistent_tables()) == []
+
+
+def test_repo_tables_are_consistent():
+    """THE tier-1 gate: the real tables, imported live."""
+    assert check_repo() == []
+
+
+def test_health_key_without_event_type_caught():
+    health, degrade, rules, event_types, mapping = _consistent_tables()
+    health["scrub_repairs"] = "F_repairs"
+    degrade = degrade + ("scrub_repairs",)
+    rules.append(Rule("scrub_repairs_increase", "counter_increase",
+                      params={"key": "scrub_repairs"}))
+    out = _check(health, degrade, rules, event_types, mapping)
+    assert any("no event type" in m for m in out)
+
+
+def test_mapping_to_unregistered_event_type_caught():
+    health, degrade, rules, event_types, mapping = _consistent_tables()
+    mapping["worker_restarts"] = "worker_reborn"  # not in EVENT_TYPES
+    out = _check(health, degrade, rules, event_types, mapping)
+    assert any("not registered" in m for m in out)
+
+
+def test_stale_mapping_caught():
+    health, degrade, rules, event_types, mapping = _consistent_tables()
+    mapping["engine_fallbacks"] = "worker_restart"  # key left the table
+    out = _check(health, degrade, rules, event_types, mapping)
+    assert any("stale mapping" in m for m in out)
+
+
+def test_health_key_missing_from_degrade_keys_caught():
+    health, degrade, rules, event_types, mapping = _consistent_tables()
+    degrade = ("worker_restarts", "retries", "fallbacks")  # lost rot
+    out = _check(health, degrade, rules, event_types, mapping)
+    assert any("DEGRADE_COUNTER_KEYS" in m and "corrupt_shards" in m
+               for m in out)
+
+
+def test_unknown_degrade_key_caught():
+    health, degrade, rules, event_types, mapping = _consistent_tables()
+    degrade = degrade + ("gamma_rays",)
+    out = _check(health, degrade, rules, event_types, mapping)
+    assert any("gamma_rays" in m for m in out)
+
+
+def test_unwatched_health_key_caught():
+    health, degrade, rules, event_types, mapping = _consistent_tables()
+    rules = [r for r in rules if r.params["key"] != "corrupt_shards"]
+    out = _check(health, degrade, rules, event_types, mapping)
+    assert any("no default" in m and "corrupt_shards" in m for m in out)
+
+
+def test_rule_watching_unknown_key_caught():
+    health, degrade, rules, event_types, mapping = _consistent_tables()
+    rules.append(Rule("bogus", "counter_increase",
+                      params={"key": "does_not_exist"}))
+    out = _check(health, degrade, rules, event_types, mapping)
+    assert any("unknown health key" in m for m in out)
+
+
+def test_rule_severity_disagreeing_with_event_type_caught():
+    health, degrade, rules, event_types, mapping = _consistent_tables()
+    rules[1] = Rule("corrupt_shards_increase", "counter_increase",
+                    severity="info",  # EVENT_TYPES says error
+                    params={"key": "corrupt_shards"})
+    out = _check(health, degrade, rules, event_types, mapping)
+    assert any("disagrees with EVENT_TYPES" in m for m in out)
+
+
+def test_missing_alert_lifecycle_type_caught():
+    health, degrade, rules, event_types, mapping = _consistent_tables()
+    del event_types["alert_resolved"]
+    out = _check(health, degrade, rules, event_types, mapping)
+    assert any("alert_resolved" in m for m in out)
+
+
+def test_standalone_main_runs_clean():
+    import subprocess
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    p = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools",
+                                      "check_health_keys.py")],
+        capture_output=True, text=True, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert p.returncode == 0, p.stdout + p.stderr
+    assert "consistent" in p.stdout

@@ -372,8 +372,14 @@ class TestSamplingProfiler:
         th = threading.Thread(target=busy_loop_marker,
                               name="busy-marker")
         th.start()
+        # a loaded CI box can stretch each sampling iteration past the
+        # 4ms period (sys._current_frames walks every thread): widen the
+        # window until enough samples landed instead of flaking
         prof = SamplingProfiler(hz=250)
-        prof.run_for(0.4)
+        for _ in range(4):
+            prof.run_for(0.4)
+            if prof.samples > 10:
+                break
         stop.set()
         th.join()
         assert prof.samples > 10

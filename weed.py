@@ -1100,8 +1100,10 @@ def main(argv=None) -> None:
                    help="dir for raft state persistence (-resumeState)")
     m.add_argument("-metricsAggregationSeconds", type=float, default=0.0,
                    help="scrape registered volume-server /metrics every N "
-                        "seconds for /cluster/metrics + /cluster/health "
-                        "(0 = scrape on demand only)")
+                        "seconds for /cluster/metrics + /cluster/health, "
+                        "and evaluate the /cluster/alerts rules on the "
+                        "same cadence (0 = on demand only: alerts only "
+                        "evaluate when /cluster/alerts is fetched)")
     m.set_defaults(fn=cmd_master)
 
     v = sub.add_parser("volume")
