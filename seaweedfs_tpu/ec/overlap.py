@@ -226,7 +226,7 @@ class _ParityWorkerBase:
         self.max_restarts = max_restarts
         self.restart_backoff = restart_backoff
         self.restart_backoff_cap = restart_backoff_cap
-        self.restarts = 0
+        self.restarts = 0  # guarded-by: _sup_lock
         self._target = target
         self._mat = np.ascontiguousarray(matrix, dtype=np.uint8)
         self._shm_out = shared_memory.SharedMemory(
@@ -242,11 +242,12 @@ class _ParityWorkerBase:
         # payload, _done buffers acks that arrived ahead of their fetch
         # (drained from a dead incarnation, or read while waiting on an
         # "opened" handshake)
-        self._seq_submit = 0
-        self._seq_fetch = 0
-        self._inflight: OrderedDict[int, tuple] = OrderedDict()
-        self._done: dict[int, tuple] = {}
-        self._path: str | None = None  # file worker: current open file
+        self._seq_submit = 0  # guarded-by: _sup_lock
+        self._seq_fetch = 0  # guarded-by: _sup_lock
+        self._inflight: OrderedDict[int, tuple] = OrderedDict()  # guarded-by: _sup_lock
+        self._done: dict[int, tuple] = {}  # guarded-by: _sup_lock
+        # file worker: current open file
+        self._path: str | None = None  # guarded-by: _sup_lock
         self._proc = None
         self._jobs = None
         self._acks = None
@@ -263,7 +264,7 @@ class _ParityWorkerBase:
         self._abandoned = False
         # wall-clock [t0, t1) of the most recent fetched job — the
         # serializable span log the parent's tracer merges on drain
-        self.last_job_span: tuple[float, float] | None = None
+        self.last_job_span: tuple[float, float] | None = None  # guarded-by: _sup_lock
         self.worker_pid = 0
         try:
             self._spawn()
@@ -274,9 +275,11 @@ class _ParityWorkerBase:
     def _spawn_args(self, mat):  # pragma: no cover - abstract
         raise NotImplementedError
 
-    def _spawn(self) -> None:
+    def _spawn(self) -> None:  # holds: _sup_lock
         """Start a (fresh) worker incarnation: new queues — a corpse's
-        queues may hold garbage — then the ready handshake."""
+        queues may hold garbage — then the ready handshake.  Callers:
+        __init__ (before any drain thread exists) and _recover_locked
+        (holding _sup_lock) — never concurrent."""
         if faultinject._points:
             faultinject.hit("ec.shm")
         # spawn, not fork: the parent usually has jax (multithreaded)
@@ -324,7 +327,7 @@ class _ParityWorkerBase:
         except Exception:  # pragma: no cover - already-reaped races
             pass
 
-    def _drain_stale_acks(self) -> None:
+    def _drain_stale_acks(self) -> None:  # holds: _sup_lock
         """After killing an incarnation, salvage whatever results it
         managed to ack: those jobs completed (the output slot was fully
         written before the ack), so they must NOT be replayed — a replay
@@ -413,7 +416,11 @@ class _ParityWorkerBase:
 
     def _await_seq(self, seq: int):
         while True:
-            msg = self._done.pop(seq, None)
+            # the dedup buffer is shared with skip_next()/recovery on
+            # the producer side: every touch rides _sup_lock (never
+            # held across the blocking _ack_raw read below)
+            with self._sup_lock:
+                msg = self._done.pop(seq, None)
             if msg is not None:
                 return msg
             try:
@@ -427,14 +434,20 @@ class _ParityWorkerBase:
             if kind not in ("done", "err"):
                 continue  # late ready/opened from a respawn: ignore
             mseq = msg[1]
-            if mseq < self._seq_fetch or mseq in self._done:
-                continue  # duplicate of an already-consumed result
-            if mseq == seq:
-                return msg
-            self._done[mseq] = msg
+            with self._sup_lock:
+                if mseq < self._seq_fetch or mseq in self._done:
+                    continue  # duplicate of an already-consumed result
+                if mseq != seq:
+                    self._done[mseq] = msg
+                    continue
+            return msg
 
-    def fetch(self, ticket: int) -> np.ndarray:
-        """Block until the next FIFO job's parity is ready; returns the
+    def fetch(self, ticket: int) -> np.ndarray:  # thread-entry
+        """Runs on the ASYNC DRAINER's fetch thread while the producer
+        keeps submitting (the weedlint thread-entry annotation above is
+        what makes the lockset checker model that).
+
+        Block until the next FIFO job's parity is ready; returns the
         [r, b] shared-memory view (valid until the buffer index is
         reused).  The job's wall-clock compute window lands in
         last_job_span.  Raises WorkerJobError if the job failed inside a
@@ -446,18 +459,22 @@ class _ParityWorkerBase:
         with self._sup_lock:
             self._seq_fetch = seq + 1
             self._inflight.pop(seq, None)
+            if msg[0] == "err":
+                self.last_job_span = None
+            else:
+                _, _, got, t0, t1 = msg
+                self.last_job_span = (t0, t1)
         if msg[0] == "err":
-            self.last_job_span = None
             raise WorkerJobError(msg[2])
-        _, _, got, t0, t1 = msg
         if got != ticket:
             raise RuntimeError(f"parity worker protocol: done {got}, "
                                f"expected ticket {ticket}")
-        self.last_job_span = (t0, t1)
         return self._outs[ticket]
 
-    def skip_next(self) -> None:
-        """Abandon the next FIFO result without reading it (the caller
+    def skip_next(self) -> None:  # thread-entry
+        """Runs on the drainer thread too (fault-fallback realignment).
+
+        Abandon the next FIFO result without reading it (the caller
         recomputed that dispatch itself): consume the seq so later
         fetches stay aligned; the eventual ack is deduped as stale."""
         with self._sup_lock:
@@ -479,8 +496,9 @@ class _ParityWorkerBase:
                 # help, the caller should fall back, not burn restarts
                 raise WorkerJobError(f"open {path}: {msg[-1]}")
             if msg[0] in ("done", "err"):
-                if msg[1] >= self._seq_fetch:
-                    self._done.setdefault(msg[1], msg)
+                with self._sup_lock:  # RLock: _recover_locked re-enters
+                    if msg[1] >= self._seq_fetch:
+                        self._done.setdefault(msg[1], msg)
                 # else: stale duplicate of a consumed/skipped result
                 # (e.g. the ack a skip_next() left unread) — drop it,
                 # do NOT treat a healthy worker as desynced
@@ -495,7 +513,11 @@ class _ParityWorkerBase:
         close() runs later, after the views drop.  Also marks the worker
         abandoned so a drainer thread blocked in fetch fails fast
         (WorkerGaveUp) instead of respawning the corpse."""
-        self._abandoned = True
+        # DELIBERATELY lock-free: _recover_locked holds _sup_lock
+        # through its backoff sleeps, and abandon() must not block
+        # behind a recovery in progress — the flag is a monotonic bool
+        # the recovery loop re-reads each iteration
+        self._abandoned = True  # weedlint: disable=W502 lock-free abort flag; _sup_lock is held across recovery backoff sleeps
         self._kill()
 
     def _close_extra(self) -> None:
@@ -505,7 +527,7 @@ class _ParityWorkerBase:
         # a closed worker is discarded for good: a drainer thread still
         # blocked in fetch must fail fast (WorkerGaveUp), not respawn a
         # process whose shm is about to be unlinked
-        self._abandoned = True
+        self._abandoned = True  # weedlint: disable=W502 lock-free abort flag (see abandon)
         try:
             if self._proc is not None and self._proc.is_alive():
                 self._jobs.put(None)
@@ -513,7 +535,7 @@ class _ParityWorkerBase:
                 if self._proc.is_alive():  # pragma: no cover
                     self._proc.terminate()
         finally:
-            self._outs = []
+            self._outs = []  # weedlint: disable=W502 teardown: close() runs after the drainer is joined or abandoned (fetch fails fast on _abandoned)
             self._close_extra()
             # unlink BEFORE close: close() can hit still-live caller
             # views (abandoned-worker fallback), but the name must not
@@ -600,7 +622,8 @@ class FileParityWorker(_ParityWorkerBase):
         open failure (WorkerJobError — the file itself is the problem)
         propagates immediately so the caller falls back without burning
         the restart budget; only worker death/stall triggers recovery."""
-        self._path = path
+        with self._sup_lock:  # a respawn re-reads it mid-recovery
+            self._path = path
         try:
             self._open_in_worker(path)
         except (WorkerGaveUp, WorkerJobError):
@@ -652,11 +675,13 @@ class AsyncDrainer:
         # bound if the contract is violated
         self._wq: queue_mod.Queue = queue_mod.Queue(
             maxsize=max(2, int(queue_depth)))
-        self._error: BaseException | None = None
+        self._error: BaseException | None = None  # guarded-by: _lock
+        # DELIBERATELY lock-free: a monotonic abort flag the fetch/write
+        # paths re-read; the unwinding caller must never block on _lock
         self.aborting = False
-        self._inflight = 0
+        self._inflight = 0  # guarded-by: _lock
         self._lock = threading.Lock()
-        self._finished = False
+        self._finished = False  # guarded-by: _lock
         self._writer = threading.Thread(target=self._write_loop,
                                         daemon=True, name=f"{name}-writer")
         self._writer.start()
@@ -666,18 +691,22 @@ class AsyncDrainer:
         """First fetch/write exception, or None.  The producer polls
         this between dispatches to fail fast instead of filling slots
         for a drain that can no longer complete."""
-        return self._error
+        with self._lock:
+            return self._error
 
     @property
     def inflight(self) -> int:
         """Dispatches submitted but not yet written (or discarded)."""
-        return self._inflight
+        with self._lock:
+            return self._inflight
 
     def submit(self, meta) -> None:
-        if self._error is not None:
-            raise self._error
         with self._lock:
-            self._inflight += 1
+            err = self._error
+            if err is None:
+                self._inflight += 1
+        if err is not None:
+            raise err
         fut = self._pool.submit(self._fetch_fn, meta)
         self._wq.put((meta, fut))
 
@@ -689,13 +718,16 @@ class AsyncDrainer:
             meta, fut = item
             try:
                 result = fut.result()
-                if not self.aborting and self._error is None:
+                with self._lock:
+                    err = self._error
+                if not self.aborting and err is None:
                     self._write_fn(meta, result)
             except (KeyboardInterrupt, SystemExit):  # pragma: no cover
                 raise
             except BaseException as e:
-                if self._error is None and not self.aborting:
-                    self._error = e
+                with self._lock:
+                    if self._error is None and not self.aborting:
+                        self._error = e
             finally:
                 with self._lock:
                     self._inflight -= 1
@@ -703,22 +735,26 @@ class AsyncDrainer:
     def finish(self, timeout: float | None = None) -> None:
         """Wait until every submitted dispatch is fetched AND written,
         then re-raise the first captured error (if any)."""
-        if not self._finished:
-            self._finished = True
+        with self._lock:
+            finished, self._finished = self._finished, True
+        if not finished:
             self._wq.put(None)
         self._writer.join(timeout)
         if self._writer.is_alive():
             raise RuntimeError("async drain writer stalled")
         self._pool.shutdown(wait=True)
-        if self._error is not None:
-            raise self._error
+        with self._lock:
+            err = self._error
+        if err is not None:
+            raise err
 
     def abort(self) -> None:
         """Abnormal-exit teardown: discard queued work, join threads.
         Never raises; the caller is already unwinding an exception."""
-        self.aborting = True
-        if not self._finished:
-            self._finished = True
+        self.aborting = True  # weedlint: disable=W502 lock-free abort flag: the unwinding caller must never block on _lock
+        with self._lock:
+            finished, self._finished = self._finished, True
+        if not finished:
             try:
                 self._wq.put(None, timeout=1.0)
             except queue_mod.Full:  # pragma: no cover - contract breach

@@ -233,14 +233,19 @@ class StreamingEncoder:
         self._async_drain = async_drain
         self._drain_pool = (max(1, int(drain_pool)) if drain_pool
                             else default_drain_pool())
-        # stats counters are bumped from the drainer/writer threads too
+        # stats counters are bumped from the drainer/writer threads
+        # too — and _st_lock also serializes worker-handle claims
+        # (_drop_file_worker/_abandon_proc_worker run on the drainer
+        # thread AND the producer) plus the stale-worker list and the
+        # lazy fallback-engine init
         self._st_lock = threading.Lock()
         self._sidecar = sidecar
         self._sidecar_bs = sidecar_block_size
-        self._fb_engine = None  # lazy CPU codec for per-dispatch fallback
+        # lazy CPU codec for per-dispatch fallback
+        self._fb_engine = None  # guarded-by: _st_lock
         # abandoned (killed, shm kept) workers whose buffers may still
         # back live views; fully closed once the encode call unwinds
-        self._stale_workers: list = []
+        self._stale_workers: list = []  # guarded-by: _st_lock
         self._mesh = None
         self._mesh_encode = None
         b = dispatch_mb << 20
@@ -371,7 +376,7 @@ class StreamingEncoder:
                     NamedSharding(self._mesh, P(None, "tp")))
             else:
                 p = jnp.asarray(self._expand(rows))
-            self._plane_cache[key] = p
+            self._plane_cache[key] = p  # weedlint: disable=W502 producer-only LRU: _planes runs on the critical thread, never on drain threads
             if len(self._plane_cache) > self._plane_cache_max:
                 self._plane_cache.popitem(last=False)
         else:
@@ -414,8 +419,9 @@ class StreamingEncoder:
             pass
         return out
 
-    def _fetch(self, out_dev) -> np.ndarray:
-        """Blocking fetch + host-side unpack back to [R, dispatch-width] u8."""
+    def _fetch(self, out_dev) -> np.ndarray:  # thread-entry
+        """Blocking fetch + host-side unpack back to [R, dispatch-width]
+        u8.  Runs on the async drainer's fetch thread."""
         import concurrent.futures
 
         if isinstance(out_dev, tuple) and out_dev[0] == "proc":
@@ -438,7 +444,11 @@ class StreamingEncoder:
 
     # --- encode -----------------------------------------------------------
     def _reset_stats(self) -> dict:
-        self.stats = {"dispatches": 0, "fill_s": 0.0, "dispatch_s": 0.0,
+        # producer-only rebind at encode start, before any drain
+        # thread exists; drain threads mutate the DICT via the st
+        # alias under _st_lock, never rebind the attribute
+        self.stats = {  # weedlint: disable=W502 rebound before the drain threads exist
+                      "dispatches": 0, "fill_s": 0.0, "dispatch_s": 0.0,
                       "write_s": 0.0, "drain_wait_s": 0.0, "setup_s": 0.0,
                       "close_s": 0.0, "wall_s": 0.0, "bytes_in": 0,
                       "retries": 0, "fallbacks": 0, "worker_restarts": 0,
@@ -457,7 +467,7 @@ class StreamingEncoder:
                       # serial drain)
                       "drain_s": 0.0, "parity_bytes_drained": 0,
                       "drain_pool": 0}
-        self._restart_base = _restart_total()
+        self._restart_base = _restart_total()  # weedlint: disable=W502 rebound before the drain threads exist
         return self.stats
 
     # --- self-healing helpers ---------------------------------------------
@@ -465,16 +475,18 @@ class StreamingEncoder:
         """Per-dispatch CPU fallback: parity for [k, n] data through the
         host codec — byte-identical to every other engine by the
         differential-test contract."""
-        if self._fb_engine is None:
-            from .codec import best_cpu_engine
+        with self._st_lock:
+            if self._fb_engine is None:
+                from .codec import best_cpu_engine
 
-            self._fb_engine = (self._host_engine
-                               if self._host_engine is not None
-                               else best_cpu_engine())
-        return self._fb_engine.matmul(self._mat_rows,
-                                      np.ascontiguousarray(data))
+                self._fb_engine = (self._host_engine
+                                   if self._host_engine is not None
+                                   else best_cpu_engine())
+            fb = self._fb_engine
+        return fb.matmul(self._mat_rows,
+                         np.ascontiguousarray(data))
 
-    def _note_fallback(self, st: dict, reason: str) -> None:
+    def _note_fallback(self, st: dict, reason: str) -> None:  # thread-entry
         # called from the pipeline thread AND the drainer's fetch
         # threads: the read-modify-write must not lose counts
         with self._st_lock:
@@ -503,14 +515,20 @@ class StreamingEncoder:
         encode keeps using the input slots as plain staging buffers for
         CPU-fallback compute; the worker is fully closed once the call's
         views unwind (_reap_stale_workers)."""
-        w = self._proc_worker
-        self._proc_worker = None
+        # atomic claim: the producer's submit-failure path and the
+        # drainer's fetch-failure path can race here — exactly one
+        # caller may own the abandon+stash, or the worker is torn down
+        # twice
+        with self._st_lock:
+            w = self._proc_worker
+            self._proc_worker = None
         if w is not None:
             try:
                 w.abandon()
             except Exception:  # pragma: no cover - already-dead races
                 pass
-            self._stale_workers.append(w)
+            with self._st_lock:
+                self._stale_workers.append(w)
 
     def _finish_sidecar_backfill(self, out_base: str, st: dict,
                                  clock) -> None:
@@ -534,7 +552,9 @@ class StreamingEncoder:
         st["sidecar_s"] += clock() - t0
 
     def _reap_stale_workers(self) -> None:
-        if not self._stale_workers:
+        with self._st_lock:
+            stale, self._stale_workers = self._stale_workers, []
+        if not stale:
             return
         # the encode's flush/drain closures form reference cycles that
         # keep shm-backed buffer views alive past the call's return;
@@ -543,12 +563,11 @@ class StreamingEncoder:
         import gc
 
         gc.collect()
-        for w in self._stale_workers:
+        for w in stale:
             try:
                 w.close()
             except Exception:  # pragma: no cover - best-effort teardown
                 pass
-        self._stale_workers.clear()
 
     # --- zero-copy host path ----------------------------------------------
     def _native_ptrs(self):
@@ -576,39 +595,46 @@ class StreamingEncoder:
             pass
         elif self._overlap != "auto" or (os.cpu_count() or 1) <= 1:
             return None
-        if self._file_worker is not None and self._file_worker and \
-                self._file_worker.b != self.dispatch_b:
+        with self._st_lock:
+            w = self._file_worker
+        if w is not None and w and w.b != self.dispatch_b:
             # slot geometry is baked into the worker's shm ring: a stale
             # b would silently truncate parity columns
             self._drop_file_worker()
-        if self._file_worker is None:
+            w = None
+        if w is None:
             try:
                 import weakref
 
                 from .overlap import FileParityWorker
 
-                self._file_worker = FileParityWorker(
+                w = FileParityWorker(
                     self.k, self.r, self.dispatch_b, mat,
                     ack_timeout=self.drain_timeout_s,
                     max_restarts=self.max_worker_restarts)
-                weakref.finalize(self, FileParityWorker.close,
-                                 self._file_worker)
+                weakref.finalize(self, FileParityWorker.close, w)
             except Exception:
-                self._file_worker = False  # don't retry every encode
-        if not self._file_worker:
+                w = False  # don't retry every encode
+            with self._st_lock:
+                self._file_worker = w
+        if not w:
             return None
         try:
-            self._file_worker.open(dat_path)
+            w.open(dat_path)
         except Exception:
             # dead or desynced worker: drop it so the next encode
             # respawns (~200ms) instead of stalling on a corpse
             self._drop_file_worker()
             return None
-        return self._file_worker
+        return w
 
-    def _drop_file_worker(self) -> None:
-        w = self._file_worker
-        self._file_worker = None
+    def _drop_file_worker(self) -> None:  # thread-entry
+        """Runs on the drainer's fetch thread (gave-up fallback) AND
+        the producer (submit failure): the claim must be atomic or a
+        race tears the same worker down twice."""
+        with self._st_lock:
+            w = self._file_worker
+            self._file_worker = None
         if w:
             try:
                 w.close()
@@ -1131,7 +1157,7 @@ class StreamingEncoder:
         k, r, b = self.k, self.r, self.dispatch_b
         st = self._reset_stats()
         st["retries"] = retries
-        self._ckpt = (start_entry, start_byte)
+        self._ckpt = (start_entry, start_byte)  # weedlint: disable=W502 producer writes it before the drainer starts; the writer thread advances it and the producer re-reads only after abort() joins
         clock = time.perf_counter
         t_start = clock()
         planes = self._planes(self.matrix[k:])
@@ -1169,12 +1195,12 @@ class StreamingEncoder:
                 if self._proc_worker is not None \
                         and self._proc_worker.b != b:
                     self._proc_worker.close()  # dispatch width changed
-                    self._proc_worker = None
+                    self._proc_worker = None  # weedlint: disable=W502 encode setup: the previous encode's drain threads were joined in its finally
                 if self._proc_worker is None:
                     from .overlap import ProcessOverlapWorker
 
                     try:
-                        self._proc_worker = ProcessOverlapWorker(
+                        self._proc_worker = ProcessOverlapWorker(  # weedlint: disable=W502 encode setup: no drain thread exists yet
                             k, r, b, self.matrix[k:], self.depth + 1,
                             ack_timeout=self.drain_timeout_s,
                             max_restarts=self.max_worker_restarts)
@@ -1311,7 +1337,7 @@ class StreamingEncoder:
             # dispatch d_idx is fully drained AND written on every shard:
             # advance the resume checkpoint past its entries/bytes
             ck_e, ck_b = self._ckpt
-            self._ckpt = (ck_e + nfills, ck_b + u)
+            self._ckpt = (ck_e + nfills, ck_b + u)  # weedlint: disable=W502 writer thread owns the checkpoint while draining; the producer reads it only after the drainer is joined (happens-before)
             return w_s, sc
 
         def drain_one():

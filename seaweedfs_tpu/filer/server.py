@@ -17,7 +17,7 @@ from typing import Optional
 
 from ..client.operation import WeedClient
 from ..utils.httpd import (HttpError, Request, Response, Router,
-                           extract_upload, http_bytes, serve)
+                           extract_upload, http_bytes, qint, serve)
 from .entry import Attr, Entry, FileChunk
 from .filechunks import etag_of_chunks, read_plan, total_size
 from .filer import Filer, FilerError, NotEmptyError
@@ -533,9 +533,9 @@ class FilerServer:
             """Persisted meta-event tail (SubscribeMetadata poll form:
             filer_grpc_server_sub_meta.go). Returns events >= since_ns,
             plus a cursor for the next poll."""
-            since = int(req.query.get("since_ns") or 0)
+            since = qint(req.query, "since_ns", 0)
             prefix = req.query.get("path_prefix", "")
-            limit = int(req.query.get("limit") or 10_000)
+            limit = qint(req.query, "limit", 10_000)
             # page BEFORE filtering so the cursor always advances past
             # examined events — a quiet prefix must not re-scan the log
             events = self.filer.read_persisted_log(since)[:limit]
@@ -695,7 +695,7 @@ class FilerServer:
             except FilerNotFound:
                 raise HttpError(404, f"{path} not found")
             if entry.is_directory:
-                limit = int(req.query.get("limit") or 1000)
+                limit = qint(req.query, "limit", 1000)
                 listing = self.filer.list_directory(
                     path, start_file=req.query.get("lastFileName", ""),
                     limit=limit, prefix=req.query.get("prefix", ""))

@@ -26,7 +26,7 @@ from ..filer.filer import NotEmptyError
 from ..filer.filer import NotFoundError as FilerNotFound
 from ..filer.server import FilerServer
 from ..utils.httpd import (HttpError, Request, Response, Router,
-                           parse_form_data, serve)
+                           parse_form_data, qint, serve)
 from .s3_auth import (ACTION_ADMIN, ACTION_LIST, ACTION_READ, ACTION_WRITE,
                       AuthError)
 
@@ -308,7 +308,7 @@ class S3ApiServer:
                 return self._list_multipart_uploads(bucket)
             prefix = req.query.get("prefix", "")
             delimiter = req.query.get("delimiter", "")
-            max_keys = int(req.query.get("max-keys", 1000))
+            max_keys = qint(req.query, "max-keys", 1000)
             if req.query.get("list-type") == "2":
                 start_after = req.query.get("start-after", "")
                 token = req.query.get("continuation-token", "")
@@ -584,7 +584,12 @@ class S3ApiServer:
     def _upload_part(self, req: Request, bucket: str, key: str) -> Response:
         self._upload_meta(req)
         upload_id = req.query["uploadId"]
-        part = int(req.query["partNumber"])
+        try:
+            part = int(req.query["partNumber"])
+        except ValueError:
+            # S3 answers InvalidArgument, not a 500, to garbage part
+            # numbers (weedlint W601)
+            raise HttpError(400, "InvalidArgument")
         entry = self.fs.put_file(f"{UPLOADS_PATH}/{upload_id}/{part:05d}.part",
                                  req.body)
         return Response(raw=b"", headers={"ETag": f'"{entry.attr.md5}"'})
@@ -614,7 +619,10 @@ class S3ApiServer:
         existing object, optionally a byte range."""
         self._upload_meta(req)
         upload_id = req.query["uploadId"]
-        part = int(req.query["partNumber"])
+        try:
+            part = int(req.query["partNumber"])
+        except ValueError:
+            raise HttpError(400, "InvalidArgument")
         _, _, src_entry = self._resolve_copy_source(req, copy_source)
         rng = req.headers.get("X-Amz-Copy-Source-Range", "")
         if rng:

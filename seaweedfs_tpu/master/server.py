@@ -26,7 +26,8 @@ from ..security import Guard, gen_jwt_for_volume_server
 from ..storage.file_id import format_needle_id_cookie
 from ..storage.super_block import ReplicaPlacement
 from ..storage.ttl import TTL
-from ..utils.httpd import HttpError, Request, Response, Router, http_json, serve
+from ..utils.httpd import (HttpError, Request, Response, Router,
+                           http_json, qfloat, qint, serve)
 from .sequence import MemorySequencer, SnowflakeSequencer
 from .topology import EcVolumeInfo, ShardBits, Topology, VolumeInfo
 from .volume_growth import grow_volume
@@ -454,7 +455,7 @@ class MasterServer:
         def assign(req: Request) -> Response:
             self._require_leader(req)
             return Response(self.assign_fid(
-                count=int(req.query.get("count", 1)),
+                count=qint(req.query, "count", 1),
                 collection=req.query.get("collection", ""),
                 replication=req.query.get("replication", ""),
                 ttl_str=req.query.get("ttl", ""),
@@ -488,7 +489,7 @@ class MasterServer:
         @r.route("GET", "/dir/lookup_ec")
         def lookup_ec(req: Request) -> Response:
             self._require_leader(req)
-            vid = int(req.query["volumeId"])
+            vid = qint(req.query, "volumeId")
             locs = self.topo.lookup_ec_shards(vid)
             if locs is None:
                 raise HttpError(404, f"ec volume {vid} not found")
@@ -633,7 +634,7 @@ class MasterServer:
             participating servers, wall seconds.  Leader-only (ingest
             converges there); follower fetches redirect."""
             self._require_leader(req)
-            limit = min(int(req.query.get("limit") or 64), 256)
+            limit = min(qint(req.query, "limit", 64), 256)
             return Response(
                 {"traces": self.trace_collector.summaries(limit=limit)})
 
@@ -678,8 +679,8 @@ class MasterServer:
             topologies are empty, so watchers redirect (urllib follows
             GET 307s transparently)."""
             self._require_leader(req)
-            since = int(req.query.get("since_seq") or 0)
-            timeout = min(float(req.query.get("timeout") or 14.0), 55.0)
+            since = qint(req.query, "since_seq", 0)
+            timeout = min(qfloat(req.query, "timeout", 14.0), 55.0)
             return Response(self.topo.watch_locations(since, timeout))
 
         @r.route("GET", "/metrics")
@@ -759,7 +760,7 @@ class MasterServer:
             replication = req.query.get("replication") or self.default_replication
             rp = ReplicaPlacement.parse(replication)
             ttl = TTL.parse(req.query.get("ttl", ""))
-            count = int(req.query.get("count", 1))
+            count = qint(req.query, "count", 1)
             # grow one at a time so a mid-batch quorum failure still
             # reports the volumes that DID grow (they are live on the
             # volume servers; losing the ids would over-provision on retry)
@@ -895,8 +896,8 @@ class MasterServer:
         @r.route("GET", "/vol/vacuum")
         def vol_vacuum(req: Request) -> Response:
             self._require_leader(req)
-            threshold = float(req.query.get("garbageThreshold",
-                                            self.garbage_threshold))
+            threshold = qfloat(req.query, "garbageThreshold",
+                               float(self.garbage_threshold))
             return Response({"compacted": self.vacuum(threshold)})
 
         @r.route("POST", "/admin/lease")

@@ -23,7 +23,7 @@ import time
 from typing import Optional
 
 from ..utils.httpd import (HttpError, Request, Response, Router, http_bytes,
-                           http_json, serve)
+                           http_json, qfloat, qint, serve)
 from .consistent import ConsistentDistribution
 
 TOPICS_ROOT = "/topics"
@@ -304,9 +304,9 @@ class BrokerServer:
             topic = req.query.get("topic", "")
             if not topic:
                 raise HttpError(400, "topic required")
-            p = int(req.query.get("partition") or 0)
-            offset = int(req.query.get("offset") or 0)
-            timeout = min(float(req.query.get("timeout") or 0), 55.0)
+            p = qint(req.query, "partition", 0)
+            offset = qint(req.query, "offset", 0)
+            timeout = min(qfloat(req.query, "timeout", 0.0), 55.0)
             if not 0 <= p < self.partition_count:
                 raise HttpError(400, f"partition {p} out of range "
                                 f"[0, {self.partition_count})")

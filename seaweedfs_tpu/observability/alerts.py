@@ -174,8 +174,10 @@ class AlertState:
         return d
 
 
-class AlertEngine:
-    """Evaluate rules against (health, families) snapshots.
+class AlertEngine:  # weedlint: concurrent-class
+    """Evaluate rules against (health, families) snapshots.  Reached
+    concurrently: the master's telemetry loop evaluates on a timer
+    while HTTP threads serve on-demand GET /cluster/alerts.
 
     `source_fn()` returns the pair the master already computes:
     aggregator.health() and aggregator.merged().  `on_fire(rule,
@@ -199,14 +201,14 @@ class AlertEngine:
         self.on_fire = on_fire
         self.exemplar_fn = exemplar_fn
         self.min_interval = min_interval
-        self._states = {r.name: AlertState(r) for r in self.rules}
+        self._states = {r.name: AlertState(r) for r in self.rules}  # guarded-by: _lock
         # counter_increase baselines: rule name -> {peer|__total__: val}
-        self._baselines: dict[str, dict] = {}
+        self._baselines: dict[str, dict] = {}  # guarded-by: _lock
         # burn_rate sample history: rule name -> deque[(ts, digest)]
-        self._history: dict[str, deque] = {}
+        self._history: dict[str, deque] = {}  # guarded-by: _lock
         self._lock = threading.Lock()
-        self.evaluated_at = 0.0
-        self.evaluations = 0
+        self.evaluated_at = 0.0  # guarded-by: _lock
+        self.evaluations = 0  # guarded-by: _lock
 
     # --- evaluation -------------------------------------------------------
     def evaluate(self, now: Optional[float] = None,
@@ -254,10 +256,11 @@ class AlertEngine:
                     pass
         return doc
 
-    def _transition(self, rule: Rule, active: bool, value: float,
+    def _transition(self, rule: Rule, active: bool, value: float,  # holds: _lock
                     detail: str, servers: list[str], now: float):
         """Advance one rule's state machine; returns (rule, state_doc,
-        servers) when this round crossed into firing, else None."""
+        servers) when this round crossed into firing, else None.
+        Called by evaluate() with _lock held."""
         st = self._states[rule.name]
         if active:
             st.last_active = now
@@ -312,7 +315,7 @@ class AlertEngine:
         return None
 
     # --- rule kinds -------------------------------------------------------
-    def _eval_rule(self, rule: Rule, health: dict, families: dict,
+    def _eval_rule(self, rule: Rule, health: dict, families: dict,  # holds: _lock
                    now: float):
         if rule.kind == "counter_increase":
             return self._eval_counter_increase(rule, health)
@@ -324,7 +327,7 @@ class AlertEngine:
             return self._eval_burn_rate(rule, families, now)
         raise ValueError(f"unknown rule kind {rule.kind!r}")
 
-    def _eval_counter_increase(self, rule: Rule, health: dict):
+    def _eval_counter_increase(self, rule: Rule, health: dict):  # holds: _lock
         key = rule.params["key"]
         cur: dict[str, float] = {}
         for url, peer in (health.get("peers") or {}).items():
@@ -375,7 +378,7 @@ class AlertEngine:
             f"unreachable/stale peers: {', '.join(stale)}", []
 
     # --- burn rate --------------------------------------------------------
-    def _eval_burn_rate(self, rule: Rule, families: dict, now: float):
+    def _eval_burn_rate(self, rule: Rule, families: dict, now: float):  # holds: _lock
         p = rule.params
         digest = self._burn_digest(rule, families)
         hist = self._history.setdefault(rule.name, deque())

@@ -70,7 +70,7 @@ class TraceShipper:
         self.batch_size = batch_size
         self.flush_interval = flush_interval
         self.buffer_cap = buffer_cap
-        self._buf: deque[Span] = deque()
+        self._buf: deque[Span] = deque()  # guarded-by: _lock
         # per-trace loss ledger: spans this shipper failed to deliver,
         # keyed by trace id, reported to the collector on the next
         # successful flush so a truncated stitched trace SAYS so
@@ -78,21 +78,25 @@ class TraceShipper:
         # re-reported — dropped counts only ever over-warn, never
         # under-warn).  Bounded: past _LOST_CAP distinct traces only the
         # global counter keeps counting.
-        self._lost: dict[str, int] = {}
+        self._lost: dict[str, int] = {}  # guarded-by: _lock
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._wake = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # hook-chain handoff: written by attach()/detach() on the
+        # server's lifecycle thread before the flush thread starts /
+        # after it stops; read lock-free on every recorded span
         self._prev_hook: Optional[Callable[[Span], None]] = None
-        self._master_i = 0  # rotates through master_url_fn candidates
-        self.shipped = 0
-        self.dropped = 0
+        # rotates through master_url_fn candidates
+        self._master_i = 0  # guarded-by: _lock
+        self.shipped = 0  # guarded-by: _lock
+        self.dropped = 0  # guarded-by: _lock
 
     _LOST_CAP = 1024
 
     # --- lifecycle --------------------------------------------------------
     def attach(self) -> "TraceShipper":
-        self._prev_hook = self.tracer.on_record
+        self._prev_hook = self.tracer.on_record  # weedlint: disable=W502 lifecycle handoff: runs before the flush thread starts
         self.tracer.on_record = self._on_span
         self._thread = threading.Thread(target=self._flush_loop, daemon=True,
                                         name=f"trace-ship:{self.server}")
@@ -114,7 +118,8 @@ class TraceShipper:
         self._flush(timeout=0.5)
 
     # --- hot path ---------------------------------------------------------
-    def _on_span(self, sp: Span) -> None:
+    def _on_span(self, sp: Span) -> None:  # thread-entry
+        # called on whatever thread recorded the span;
         # a detached shipper may still sit mid-chain (another shipper
         # attached after it and holds the head of the hook chain): it
         # must degrade to a pure pass-through, not a buffer that fills
@@ -159,17 +164,20 @@ class TraceShipper:
         docs = [sp.to_dict() for sp in batch]
         if self.local_collector is not None:
             self.local_collector.ingest(self.server, docs, lost=lost)
-            self.shipped += len(docs)
+            with self._lock:
+                self.shipped += len(docs)
             return
         urls = [u.strip()
                 for u in (self.master_url_fn() or "").split(",")
                 if u.strip()] if self.master_url_fn else []
         from ..utils.httpd import http_json
 
+        with self._lock:
+            master_i = self._master_i
         try:
             if not urls:
                 raise ConnectionError("no master url to ship to")
-            master = urls[self._master_i % len(urls)]
+            master = urls[master_i % len(urls)]
             # explicit negative decision: the ship POST must not be
             # sampled downstream (it would ship spans about shipping
             # spans, forever)
@@ -178,7 +186,8 @@ class TraceShipper:
                           {"server": self.server, "spans": docs,
                            "lost": lost},
                           timeout=timeout)
-            self.shipped += len(docs)
+            with self._lock:
+                self.shipped += len(docs)
         except Exception:
             # master down / not yet elected: the batch is LOST and
             # counted — and remembered per trace id, so when the master
@@ -186,11 +195,13 @@ class TraceShipper:
             # truncated instead of silently reading complete.  Next
             # flush tries the next configured master (followers forward
             # to the leader, so any live one works).
-            self._master_i += 1
-            self.dropped += len(docs)
             if docs:
                 _dropped_counter().inc("ship_error", amount=len(docs))
+            # counter updates ride _lock: the flush thread and the
+            # detach()-time final flush race these read-modify-writes
             with self._lock:
+                self._master_i += 1
+                self.dropped += len(docs)
                 for d in docs:
                     self._note_lost_locked(d.get("trace"))
                 for tid, n in lost.items():
@@ -208,17 +219,19 @@ class _TraceEntry:
         self.dropped = 0
 
 
-class TraceCollector:
-    """Bounded trace store keyed by trace id (the master's side)."""
+class TraceCollector:  # weedlint: concurrent-class
+    """Bounded trace store keyed by trace id (the master's side).
+    Reached concurrently from the threaded HTTP router (ingest POSTs +
+    trace GETs)."""
 
     def __init__(self, max_traces: int = 512,
                  max_spans_per_trace: int = 8192, ttl_s: float = 900.0):
         self.max_traces = max_traces
         self.max_spans_per_trace = max_spans_per_trace
         self.ttl_s = ttl_s
-        self._traces: "OrderedDict[str, _TraceEntry]" = OrderedDict()
+        self._traces: "OrderedDict[str, _TraceEntry]" = OrderedDict()  # guarded-by: _lock
         self._lock = threading.Lock()
-        self.evicted_traces = 0
+        self.evicted_traces = 0  # guarded-by: _lock
 
     def ingest(self, server: str, spans: list[dict],
                lost: Optional[dict] = None) -> int:
@@ -269,7 +282,7 @@ class TraceCollector:
             self._evict(now)
         return accepted
 
-    def _evict(self, now: float) -> None:
+    def _evict(self, now: float) -> None:  # holds: _lock
         while len(self._traces) > self.max_traces:
             self._traces.popitem(last=False)
             self.evicted_traces += 1

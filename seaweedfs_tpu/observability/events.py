@@ -135,14 +135,15 @@ class EventJournal:
 
     def __init__(self, capacity: int = 2048,
                  namespace: Optional[str] = None):
-        self._events: deque[Event] = deque(maxlen=capacity)
+        self._events: deque[Event] = deque(maxlen=capacity)  # guarded-by: _lock
         self._lock = threading.Lock()
-        self._seq = 0
+        self._seq = 0  # guarded-by: _lock
         # same salting rationale as the tracer: bare pids collide across
         # containerized hosts and the master journal dedups by event id
         self.namespace = namespace or (
             f"e{os.getpid():x}x{os.urandom(3).hex()}")
-        self.dropped = 0  # ring evictions — a truncated journal says so
+        # ring evictions — a truncated journal says so
+        self.dropped = 0  # guarded-by: _lock
         # shipping hook (EventShipper): called with every emitted Event
         self.on_emit: Optional[Callable[[Event], None]] = None
         # server identities of the attached shippers: when exactly ONE
@@ -152,11 +153,11 @@ class EventJournal:
         # co-located servers the stamp is AMBIGUOUS and the event ships
         # unattributed rather than letting whichever shipper's copy
         # wins the collector's dedup claim it
-        self._servers: list[str] = []
+        self._servers: list[str] = []  # guarded-by: _lock
 
     @property
     def capacity(self) -> int:
-        return self._events.maxlen or 0
+        return self._events.maxlen or 0  # weedlint: disable=W501 maxlen is immutable configuration, not ring state
 
     def register_server(self, server: str) -> None:
         with self._lock:
@@ -172,10 +173,12 @@ class EventJournal:
             unique = set(self._servers)
             return next(iter(unique)) if len(unique) == 1 else None
 
-    def emit(self, type_: str, severity: Optional[str] = None,
+    def emit(self, type_: str, severity: Optional[str] = None,  # thread-entry
              server: Optional[str] = None,
              trace_id: Optional[str] = None, **details) -> Event:
-        """Journal one event.  Severity defaults from EVENT_TYPES; the
+        """Journal one event — from ANY thread (drainers, supervisors,
+        scan loops; the thread-entry annotation makes the lockset
+        checker model that).  Severity defaults from EVENT_TYPES; the
         trace id defaults to the calling thread's ACTIVE sampled trace
         context and the server to the request's owning-server identity
         (both thread-local reads — emit sites never plumb identity)."""
@@ -227,16 +230,17 @@ class EventJournal:
         return out[-max(int(limit), 0):] if limit else out
 
 
-class ClusterEventJournal:
+class ClusterEventJournal:  # weedlint: concurrent-class
     """The master's merged journal: per-server journals ship here
     (EventShipper), dedup'd by event id, bounded by oldest-first
-    eviction — the /cluster/events store."""
+    eviction — the /cluster/events store.  Reached concurrently from
+    the threaded HTTP router (ingest POSTs + query GETs)."""
 
     def __init__(self, capacity: int = 8192):
         self.capacity = capacity
-        self._events: "OrderedDict[str, dict]" = OrderedDict()
+        self._events: "OrderedDict[str, dict]" = OrderedDict()  # guarded-by: _lock
         self._lock = threading.Lock()
-        self.dropped = 0
+        self.dropped = 0  # guarded-by: _lock
 
     def ingest(self, server: str, events: list[dict]) -> int:
         accepted = 0
@@ -298,18 +302,21 @@ class EventShipper:
         self.batch_size = batch_size
         self.flush_interval = flush_interval
         self.buffer_cap = buffer_cap
-        self._buf: deque[Event] = deque()
+        self._buf: deque[Event] = deque()  # guarded-by: _lock
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._wake = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # hook-chain handoff: written by attach()/detach() on the
+        # server's lifecycle thread before the flush thread starts /
+        # after it stops; read lock-free on every emit
         self._prev_hook: Optional[Callable[[Event], None]] = None
-        self._master_i = 0
-        self.shipped = 0
-        self.dropped = 0
+        self._master_i = 0  # guarded-by: _lock
+        self.shipped = 0  # guarded-by: _lock
+        self.dropped = 0  # guarded-by: _lock
 
     def attach(self) -> "EventShipper":
-        self._prev_hook = self.journal.on_emit
+        self._prev_hook = self.journal.on_emit  # weedlint: disable=W502 lifecycle handoff: runs before the flush thread starts
         self.journal.on_emit = self._on_event
         self.journal.register_server(self.server)
         self._thread = threading.Thread(target=self._flush_loop,
@@ -330,7 +337,8 @@ class EventShipper:
         # master is often already gone and stop() must not hang
         self._flush(timeout=0.5)
 
-    def _on_event(self, ev: Event) -> None:
+    def _on_event(self, ev: Event) -> None:  # thread-entry
+        # called on whatever thread emitted (drainers, scan loops);
         # a detached shipper left mid-chain degrades to a pass-through
         if not self._stop.is_set():
             with self._lock:
@@ -364,29 +372,36 @@ class EventShipper:
         docs = [ev.to_dict() for ev in batch]
         if self.local_journal is not None:
             self.local_journal.ingest(self.server, docs)
-            self.shipped += len(docs)
+            with self._lock:
+                self.shipped += len(docs)
             return
         urls = [u.strip()
                 for u in (self.master_url_fn() or "").split(",")
                 if u.strip()] if self.master_url_fn else []
         from ..utils.httpd import http_json
 
+        with self._lock:
+            master_i = self._master_i
         try:
             if not urls:
                 raise ConnectionError("no master url to ship to")
-            master = urls[self._master_i % len(urls)]
+            master = urls[master_i % len(urls)]
             # shipping must never trace itself (same rule as spans)
             with _trace_context.scope(_trace_context.NOT_SAMPLED):
                 http_json("POST",
                           f"http://{master}/cluster/events/ingest",
                           {"server": self.server, "events": docs},
                           timeout=timeout)
-            self.shipped += len(docs)
+            with self._lock:
+                self.shipped += len(docs)
         except Exception:
             # master down / not elected: the batch is LOST and counted;
-            # the next flush rotates to the next configured master
-            self._master_i += 1
-            self.dropped += len(docs)
+            # the next flush rotates to the next configured master.
+            # Counter updates ride _lock: the flush thread and the
+            # detach()-time final flush race these read-modify-writes
+            with self._lock:
+                self._master_i += 1
+                self.dropped += len(docs)
 
 
 # --- process-global journal --------------------------------------------------

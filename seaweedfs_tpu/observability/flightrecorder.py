@@ -40,18 +40,21 @@ from . import events as _events
 _ID_RE = re.compile(r"^[A-Za-z0-9][A-Za-z0-9._-]*$")
 
 
-class FlightRecorder:
-    """Size-capped on-disk spool of diagnostic bundles."""
+class FlightRecorder:  # weedlint: concurrent-class
+    """Size-capped on-disk spool of diagnostic bundles.  Reached
+    concurrently: alert-engine capture fan-outs and HTTP threads
+    serving /debug/flightrecorder."""
 
     def __init__(self, spool_dir: Optional[str] = None,
                  max_bytes: int = 64 << 20, max_bundles: int = 32):
-        self.spool_dir = spool_dir
-        self.max_bytes = max_bytes
-        self.max_bundles = max_bundles
-        self._lock = threading.Lock()
-        self._seq = 0
-        self.captures = 0
-        self.evicted = 0
+        self.spool_dir = spool_dir  # guarded-by: _lock
+        self.max_bytes = max_bytes  # guarded-by: _lock
+        self.max_bundles = max_bundles  # guarded-by: _lock
+        # RLock: _evict -> _scan -> _dir re-enters while holding it
+        self._lock = threading.RLock()
+        self._seq = 0  # guarded-by: _lock
+        self.captures = 0  # guarded-by: _lock
+        self.evicted = 0  # guarded-by: _lock
 
     def configure(self, spool_dir: Optional[str] = None,
                   max_bytes: Optional[int] = None,
@@ -69,13 +72,14 @@ class FlightRecorder:
         return self
 
     def _dir(self) -> str:
-        d = self.spool_dir
-        if not d:
-            # unconfigured (bare tools, tests): a per-process tempdir
-            # spool — bounded and disposable
-            d = self.spool_dir = os.path.join(
-                tempfile.gettempdir(),
-                f"weed-flightrecorder-{os.getpid()}")
+        with self._lock:  # two first-captures must agree on the spool
+            d = self.spool_dir
+            if not d:
+                # unconfigured (bare tools, tests): a per-process
+                # tempdir spool — bounded and disposable
+                d = self.spool_dir = os.path.join(
+                    tempfile.gettempdir(),
+                    f"weed-flightrecorder-{os.getpid()}")
         os.makedirs(d, exist_ok=True)
         return d
 
@@ -146,7 +150,8 @@ class FlightRecorder:
         with open(tmp, "w") as f:
             json.dump(doc, f)
         os.replace(tmp, path)
-        self.captures += 1
+        with self._lock:  # capture fan-outs race on the counter
+            self.captures += 1
         meta = dict(doc["meta"])
         meta["bytes"] = os.path.getsize(path)
         self._evict()
@@ -163,10 +168,11 @@ class FlightRecorder:
                 entries = self._scan()
             except OSError:
                 return
+            max_bundles, max_bytes = self.max_bundles, self.max_bytes
             total = sum(e["bytes"] for e in entries)
             # entries is newest-first; trim from the tail
-            while entries and (len(entries) > self.max_bundles
-                               or total > self.max_bytes):
+            while entries and (len(entries) > max_bundles
+                               or total > max_bytes):
                 victim = entries.pop()
                 try:
                     os.remove(victim["path"])

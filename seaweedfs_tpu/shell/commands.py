@@ -7,6 +7,7 @@ lock (shell/command_lock_unlock.go semantics via env.confirm_is_locked).
 
 from __future__ import annotations
 
+import json
 import shlex
 from typing import Callable, Optional
 
@@ -232,6 +233,23 @@ def cmd_collection_list(env: CommandEnv, flags: dict) -> str:
     topo = env.topology()
     names = sorted({l["collection"] for l in topo.get("Layouts", [])})
     return "\n".join(n or "(default)" for n in names) or "(none)"
+
+
+@command("fault.list")
+def cmd_fault_list(env: CommandEnv, flags: dict) -> str:
+    """fault.list [-json]  # the central fault-injection registry:
+    # every armable fault point with a one-line description.  The
+    # weedlint W701 rule keeps this table consistent with the
+    # instrumented hit() sites and requires a test exercising each."""
+    from ..utils import faultinject as fi
+
+    if flags.get("json") == "true":
+        return json.dumps(dict(fi.list_points()), indent=2)
+    lines = [f"fault points: {len(fi.FAULT_POINTS)} registered "
+             "(arm via seaweedfs_tpu.utils.faultinject.enable/scoped)"]
+    for name, desc in fi.list_points():
+        lines.append(f"  {name:<18} {desc}")
+    return "\n".join(lines)
 
 
 @command("volume.grow")

@@ -215,9 +215,10 @@ class _PeerState:
         self.scrub: Optional[dict] = None
 
 
-class ClusterAggregator:
+class ClusterAggregator:  # weedlint: concurrent-class
     """Scrape-and-merge over a dynamic peer list (the master's
-    registered volume servers)."""
+    registered volume servers).  Reached concurrently: the periodic
+    scrape loop and on-demand /cluster/* HTTP threads."""
 
     def __init__(self, peers_fn: Callable[[], list[str]],
                  fetch: Optional[Callable[[str], str]] = None,
@@ -238,10 +239,10 @@ class ClusterAggregator:
             self._scrub_fetch = lambda url: None
         else:
             self._scrub_fetch = self._http_scrub_fetch
-        self._peers: dict[str, _PeerState] = {}
+        self._peers: dict[str, _PeerState] = {}  # guarded-by: _lock
         self._lock = threading.Lock()
-        self._last_scrape = 0.0
-        self._last_scrub_scrape = 0.0
+        self._last_scrape = 0.0  # guarded-by: _lock
+        self._last_scrub_scrape = 0.0  # guarded-by: _lock
         self._stop: Optional[threading.Event] = None
 
     def _http_fetch(self, url: str) -> str:

@@ -26,7 +26,7 @@ import threading
 import traceback
 from typing import Callable, Optional
 
-from .httpd import HttpError, Request, Response, Router
+from .httpd import HttpError, Request, Response, Router, qfloat
 
 
 def _profile_text(seconds: float, interval: float = 0.005) -> str:
@@ -185,7 +185,7 @@ def register_debug_routes(router: Router,
 
     @router.route("GET", "/debug/pprof/profile")
     def pprof_profile(req: Request) -> Response:
-        seconds = min(float(req.query.get("seconds", 2)), 60.0)
+        seconds = min(qfloat(req.query, "seconds", 2.0), 60.0)
         return Response(raw=_profile_text(seconds).encode(),
                         headers={"Content-Type": "text/plain; charset=utf-8"})
 
@@ -209,8 +209,8 @@ def register_debug_routes(router: Router,
         loop the span tracer cannot attribute."""
         from ..observability.profiler import profile_collapsed
 
-        seconds = min(float(req.query.get("seconds", 2)), 60.0)
-        hz = min(float(req.query.get("hz", 100)), 250.0)
+        seconds = min(qfloat(req.query, "seconds", 2.0), 60.0)
+        hz = min(qfloat(req.query, "hz", 100.0), 250.0)
         return Response(raw=profile_collapsed(seconds, hz=hz).encode(),
                         headers={"Content-Type": "text/plain; charset=utf-8"})
 

@@ -58,6 +58,41 @@ _lock = threading.Lock()
 _points: dict[str, dict] = {}
 _counts: dict[str, int] = {}
 
+# The CENTRAL fault-point registry: every hit()/corrupt_block() site in
+# the package names one of these, and every entry is exercised by at
+# least one test — both directions machine-checked by weedlint rule
+# W701 (tools/weedlint/rules_faults.py), so a typo'd name can't
+# silently never fire and a registered point can't silently never run
+# its recovery path.  `weed shell fault.list` prints this table.
+FAULT_POINTS: dict[str, str] = {
+    "disk.read": "DiskFile positional read (storage/backend.py)",
+    "disk.write": "DiskFile positional write (storage/backend.py)",
+    "disk.sync": "DiskFile fsync (storage/backend.py)",
+    "shard.read": "EC shard pread (ec/ec_volume.py)",
+    "net.request": "pooled HTTP client send (utils/httpd.py)",
+    "ec.worker.ack": "parity-worker ack read, parent side — injected "
+                     "error is treated as worker death: SIGKILL + "
+                     "respawn + in-flight replay (ec/overlap.py)",
+    "ec.shm": "parity-worker spawn / shm attach — arming makes "
+              "respawns fail, draining the restart budget for CPU-"
+              "fallback drills (ec/overlap.py)",
+    "ec.dispatch": "streaming pipeline submit — injected error forces "
+                   "a per-dispatch CPU fallback (ec/streaming.py)",
+    "ec.drain": "streaming pipeline drain — injected error forces a "
+                "per-dispatch CPU fallback; delay-only arming drives "
+                "the slow-drain attribution drills (ec/streaming.py)",
+    "ec.shard.corrupt": "deterministic bit flip on EC shard reads "
+                        "(corrupt_block): params {shard, offset, bit} "
+                        "— the bit-rot drill behind verify-on-use "
+                        "(ec/integrity.py paths)",
+}
+
+
+def list_points() -> list[tuple[str, str]]:
+    """The registry as sorted (name, description) pairs — what
+    `weed shell fault.list` prints."""
+    return sorted(FAULT_POINTS.items())
+
 
 def enable(name: str, error_rate: float = 0.0,
            error: Optional[BaseException] = None,

@@ -34,6 +34,41 @@ class HttpError(Exception):
         self.headers = headers or {}
 
 
+def qint(query: dict, name: str, default: Optional[int] = None) -> int:
+    """Parse an int query param, answering 400 (not 500) to garbage —
+    a typo'd ?limit=abc is the CLIENT's mistake and must not burn the
+    error-ratio SLO budget.  With no `default` the parameter is
+    REQUIRED: absence answers 400 too, never a silent zero.  The
+    weedlint W601 rule enforces that every route handler parses params
+    this way (or with its own try/ValueError -> HttpError(400))."""
+    raw = query.get(name)
+    if raw is None or raw == "":
+        if default is None:
+            raise HttpError(400, f"missing query parameter {name}")
+        return default
+    try:
+        return int(raw)
+    except (TypeError, ValueError):
+        raise HttpError(400, f"bad query parameter {name}={raw!r}: "
+                             f"expected an integer")
+
+
+def qfloat(query: dict, name: str,
+           default: Optional[float] = None) -> float:
+    """Float twin of qint: malformed or missing-required input answers
+    400, never 500."""
+    raw = query.get(name)
+    if raw is None or raw == "":
+        if default is None:
+            raise HttpError(400, f"missing query parameter {name}")
+        return default
+    try:
+        return float(raw)
+    except (TypeError, ValueError):
+        raise HttpError(400, f"bad query parameter {name}={raw!r}: "
+                             f"expected a number")
+
+
 class Request:
     def __init__(self, handler: BaseHTTPRequestHandler, match: re.Match):
         self.handler = handler

@@ -48,23 +48,29 @@ class EcScrubber:
         (recording CURRENT bytes as the baseline); busy_fn returning True
         pauses the scan in pause_s steps until the server quiets down."""
         self.store = store
-        self.rate_mb_s = rate_mb_s
-        self.interval_s = interval_s
-        self.backfill = backfill
+        # live-tunable knobs: start() rewrites them under _lock while a
+        # scan is running; the scan thread reads them under _lock
+        self.rate_mb_s = rate_mb_s  # guarded-by: _lock
+        self.interval_s = interval_s  # guarded-by: _lock
+        self.backfill = backfill  # guarded-by: _lock
         self.busy_fn = busy_fn
         self.pause_s = pause_s
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self._lock = threading.Lock()
         # resumable scan position: the next (volume id, shard id) to
-        # verify; survives stop()/start() cycles within the process
-        self.cursor: tuple[int, int] = (0, 0)
-        self.verdicts: dict[int, dict] = {}
-        self.passes = 0
-        self.running = False
-        self.paused = False
-        self._debt = 0.0      # rate limiter: seconds of IO time owed
-        self._t0: Optional[float] = None
+        # verify; survives stop()/start() cycles within the process.
+        # Everything below is written by the scan thread and read by
+        # status() on HTTP threads — all access rides _lock (weedlint
+        # W501 enforces the discipline via these annotations)
+        self.cursor: tuple[int, int] = (0, 0)  # guarded-by: _lock
+        self.verdicts: dict[int, dict] = {}  # guarded-by: _lock
+        self.passes = 0  # guarded-by: _lock
+        self.running = False  # guarded-by: _lock
+        self.paused = False  # guarded-by: _lock
+        # rate limiter: seconds of IO time owed
+        self._debt = 0.0  # guarded-by: _lock
+        self._t0: Optional[float] = None  # guarded-by: _lock
 
     # --- lifecycle --------------------------------------------------------
     def start(self, rate_mb_s: Optional[float] = None,
@@ -97,35 +103,42 @@ class EcScrubber:
             t.join(join_timeout)
 
     def status(self) -> dict:
-        with self._lock:  # scan thread inserts verdicts concurrently
-            verdicts = {str(vid): dict(v)
-                        for vid, v in sorted(self.verdicts.items())}
-        return {
-            "running": self.running,
-            "paused": self.paused,
-            "passes": self.passes,
-            "cursor": list(self.cursor),
-            "rate_mb_s": self.rate_mb_s,
-            "interval_s": self.interval_s,
-            "backfill": self.backfill,
-            "verdicts": verdicts,
-            "totals": ec_integrity_metrics().totals(),
-        }
+        # one consistent snapshot: the scan thread mutates verdicts,
+        # cursor and the running/paused flags concurrently (it used to
+        # lock only the verdicts copy — the cursor/flag reads raced;
+        # caught by weedlint W501 once the fields were annotated)
+        with self._lock:
+            return {
+                "running": self.running,
+                "paused": self.paused,
+                "passes": self.passes,
+                "cursor": list(self.cursor),
+                "rate_mb_s": self.rate_mb_s,
+                "interval_s": self.interval_s,
+                "backfill": self.backfill,
+                "verdicts": {str(vid): dict(v)
+                             for vid, v in sorted(self.verdicts.items())},
+                "totals": ec_integrity_metrics().totals(),
+            }
 
     def _loop(self) -> None:
-        self.running = True
+        with self._lock:
+            self.running = True
         try:
             while not self._stop.is_set():
                 self.run_pass()
-                if not self._stop.is_set():
-                    self.passes += 1  # one-shot passes count too
-                if self._stop.is_set() or not self.interval_s:
+                with self._lock:
+                    if not self._stop.is_set():
+                        self.passes += 1  # one-shot passes count too
+                    interval = self.interval_s
+                if self._stop.is_set() or not interval:
                     break
-                if self._stop.wait(self.interval_s):
+                if self._stop.wait(interval):
                     break
         finally:
-            self.running = False
-            self.paused = False
+            with self._lock:
+                self.running = False
+                self.paused = False
 
     # --- scanning ---------------------------------------------------------
     def run_pass(self) -> dict:
@@ -161,9 +174,10 @@ class EcScrubber:
                 _trace_context.activate(prev)
 
     def _run_pass_inner(self, tr) -> dict:
-        with tr.span("ec.scrub.pass", cursor_vid=self.cursor[0]):
-            vids = sorted(self.store.ec_volumes)
+        with self._lock:
             cv = self.cursor[0]
+        with tr.span("ec.scrub.pass", cursor_vid=cv):
+            vids = sorted(self.store.ec_volumes)
             # rotate so the pass resumes at the cursor, then wraps
             vids = [v for v in vids if v >= cv] + [v for v in vids if v < cv]
             for vid in vids:
@@ -173,12 +187,16 @@ class EcScrubber:
             if not self._stop.is_set():
                 # clean wrap: next pass starts fresh (a stop mid-scan
                 # keeps the mid-volume cursor _scrub_volume left)
-                self.cursor = (0, 0)
+                with self._lock:
+                    self.cursor = (0, 0)
         return self.status()
 
     def _pace(self, nbytes: int) -> None:
         """Token-bucket rate limit + busy pause, called before each
-        block read."""
+        block read.  The pacing state is mutated under _lock (status()
+        and a live-retune via start() read it concurrently); the waits
+        themselves run on LOCALS so the lock is never held through a
+        sleep."""
         while self.busy_fn is not None and not self._stop.is_set():
             try:
                 busy = bool(self.busy_fn())
@@ -186,18 +204,25 @@ class EcScrubber:
                 busy = False
             if not busy:
                 break
-            self.paused = True
+            with self._lock:
+                self.paused = True
             self._stop.wait(self.pause_s)
-        self.paused = False
-        if self.rate_mb_s and self.rate_mb_s > 0:
-            if self._t0 is None:
-                self._t0 = time.perf_counter()
-            self._debt += nbytes / (self.rate_mb_s * 1e6)
+        with self._lock:
+            self.paused = False
+            rate = self.rate_mb_s
+            if rate and rate > 0:
+                if self._t0 is None:
+                    self._t0 = time.perf_counter()
+                self._debt += nbytes / (rate * 1e6)
+                debt, t0 = self._debt, self._t0
+        if rate and rate > 0:
             # sleep until the debt is repaid, in short slices so stop()
             # stays responsive — a single capped wait would let sub-MB/s
-            # rates run ~4x over the configured cap
+            # rates run ~4x over the configured cap.  A start() that
+            # retunes rate_mb_s mid-wait only affects the NEXT block;
+            # this wait finishes against its snapshot.
             while not self._stop.is_set():
-                ahead = self._debt - (time.perf_counter() - self._t0)
+                ahead = debt - (time.perf_counter() - t0)
                 if ahead <= 0.002:
                     break
                 self._stop.wait(min(ahead, 0.25))
@@ -226,7 +251,9 @@ class EcScrubber:
             # truncation rot
             sc = None
             ev.sidecar = None
-        if sc is None and self.backfill:
+        with self._lock:
+            backfill = self.backfill
+        if sc is None and backfill:
             try:
                 sc = backfill_sidecar(base)
             except (OSError, ValueError):
@@ -240,9 +267,10 @@ class EcScrubber:
                 self.verdicts[vid] = {
                     "status": "stale_sidecar" if stale else "no_sidecar",
                     "at": round(time.time(), 3)}
-            self.cursor = (vid + 1, 0)
+                self.cursor = (vid + 1, 0)
             return
-        start_shard = self.cursor[1] if vid == self.cursor[0] else 0
+        with self._lock:
+            start_shard = self.cursor[1] if vid == self.cursor[0] else 0
         corrupt: dict[int, list[int]] = {}
         blocks = 0
         interrupted = False
@@ -255,10 +283,12 @@ class EcScrubber:
                     # in the scanned prefix is ACTED ON below, not
                     # dropped (the next start may be a long time away —
                     # or never, in one-shot mode)
-                    self.cursor = (vid, sid)
+                    with self._lock:
+                        self.cursor = (vid, sid)
                     interrupted = True
                     break
-                self.cursor = (vid, sid)
+                with self._lock:
+                    self.cursor = (vid, sid)
                 counted = [0]
 
                 def on_block(ok, _c=counted):
@@ -275,7 +305,8 @@ class EcScrubber:
                 if bad:
                     corrupt[sid] = bad
         if not interrupted:
-            self.cursor = (vid + 1, 0)
+            with self._lock:
+                self.cursor = (vid + 1, 0)
         if not corrupt:
             if not interrupted:  # a partial scan is not a clean verdict
                 with self._lock:

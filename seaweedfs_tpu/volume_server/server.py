@@ -40,6 +40,7 @@ from ..utils.httpd import (
     Response,
     Router,
     extract_upload,
+    qint,
     http_bytes,
     http_json,
     serve,
@@ -802,7 +803,7 @@ class VolumeServer:
             # guard and TTL expiry, so an unstamped write would leave
             # the volume looking idle
             n.set_flag(FLAG_HAS_LAST_MODIFIED)
-            n.last_modified = int(req.query.get("ts") or time.time())
+            n.last_modified = qint(req.query, "ts", int(time.time()))
             if req.query.get("ttl"):
                 ttl = TTL.parse(req.query["ttl"])
                 if ttl.count:
@@ -985,7 +986,7 @@ class VolumeServer:
         # --- admin: volume copy/move (volume_grpc_copy.go) -------------
         @r.route("GET", "/admin/volume_download")
         def volume_download(req: Request) -> Response:
-            vid = int(req.query["volume_id"])
+            vid = qint(req.query, "volume_id")
             ext = req.query["ext"]
             if ext not in (".dat", ".idx", ".vif"):
                 raise HttpError(400, f"bad ext {ext}")
@@ -1092,15 +1093,15 @@ class VolumeServer:
             re-requests with the returned X-Last-Append-At-Ns until empty)."""
             from ..storage.volume_backup import records_since
 
-            vid = int(req.query["volume_id"])
-            since_ns = int(req.query.get("since_ns", 0))
+            vid = qint(req.query, "volume_id")
+            since_ns = qint(req.query, "since_ns", 0)
             try:
                 v = self.store.get_volume(vid)
             except KeyError:
                 raise HttpError(404, f"volume {vid} not found")
             blob, last_ts = records_since(
                 v, since_ns,
-                max_bytes=int(req.query.get("max_bytes", 64 << 20)))
+                max_bytes=qint(req.query, "max_bytes", 64 << 20))
             return Response(raw=blob, headers={
                 "X-Last-Append-At-Ns": str(last_ts),
                 "X-Volume-Version": str(int(v.version))})
@@ -1308,7 +1309,7 @@ class VolumeServer:
 
         @r.route("GET", "/admin/ec/download")
         def ec_download(req: Request) -> Response:
-            vid = int(req.query["volume_id"])
+            vid = qint(req.query, "volume_id")
             base = self.store._ec_base(vid, req.query.get("collection", ""))
             path = base + req.query["ext"]
             if not os.path.exists(path):
@@ -1340,8 +1341,8 @@ class VolumeServer:
         def ec_shard_read(req: Request) -> Response:
             try:
                 data = self.store.ec_shard_read(
-                    int(req.query["volume_id"]), int(req.query["shard"]),
-                    int(req.query["offset"]), int(req.query["size"]))
+                    qint(req.query, "volume_id"), qint(req.query, "shard"),
+                    qint(req.query, "offset"), qint(req.query, "size"))
             except NeedleNotFoundError as e:
                 raise HttpError(404, str(e))
             return Response(raw=data)

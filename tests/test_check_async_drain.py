@@ -1,4 +1,5 @@
-"""tools/check_async_drain.py as a tier-1 gate.
+"""tools/check_async_drain.py (now a shim over weedlint rule W301)
+as a tier-1 gate.
 
 The async multi-buffered drain (PR 7) only pays off while nothing
 reintroduces a blocking full-block fetch on the streaming hot loop —

@@ -1,4 +1,5 @@
-"""Tier-1 gate: the degraded-signal tables stay mutually consistent.
+"""Tier-1 gate (via the weedlint W401 shim): the degraded-signal
+tables stay mutually consistent.
 
 tools/check_health_keys.py lints stats/aggregate.py HEALTH_FAMILIES,
 analysis.py DEGRADE_COUNTER_KEYS, the events.py type registry, and the

@@ -1,4 +1,5 @@
-"""tools/check_py310.py as a tier-1 gate.
+"""tools/check_py310.py (now a shim over weedlint rule W101) as a
+tier-1 gate.
 
 The deployment runtime is Python 3.10: one 3.12-only construct in a
 widely-imported module silently collection-errors hundreds of tests (the

@@ -1,4 +1,5 @@
-"""tools/check_tracing.py as a tier-1 gate.
+"""tools/check_tracing.py (now a shim over weedlint rule W201) as a
+tier-1 gate.
 
 Distributed tracing (PR 6) is enforced at two chokepoints: every HTTP
 handler runs under Router.dispatch's request span + trace context, and

@@ -1,0 +1,456 @@
+"""weedlint (tools/weedlint) as THE tier-1 static-analysis gate.
+
+One engine now carries every repo lint: the four ported rules
+(W101 py310 / W201 tracing / W301 async-drain / W401 health-keys), the
+lockset thread-safety checker (W501/W502), and the route-param (W601),
+fault-registry (W701) and ec-resource (W801) rules.  This suite:
+
+  - proves EVERY rule fires on a planted violation and stays quiet on
+    the matching clean source (parametrized, one case per rule);
+  - unit-tests the lockset checker on synthetic classes (guarded-ok,
+    unguarded-read, waived, stale-waiver, two-lock, holds-contract);
+  - pins the engine machinery (waivers, baseline, JSON output, CLI);
+  - asserts the REPO-WIDE run is clean modulo the committed baseline —
+    the regression gate that replaces four per-lint whole-repo tests.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from tools.weedlint import engine  # noqa: E402
+from tools.weedlint import rules_py310, rules_tracing  # noqa: E402
+from tools.weedlint.rules_async_drain import \
+    check_drain_fault_source  # noqa: E402
+from tools.weedlint.rules_faults import (check_registry,  # noqa: E402
+                                         hit_sites, load_registry)
+from tools.weedlint.rules_lockset import check_class_source  # noqa: E402
+from tools.weedlint.rules_resources import \
+    check_module_source as check_resources  # noqa: E402
+from tools.weedlint.rules_routes import \
+    check_module_source as check_routes  # noqa: E402
+
+# --- planted sources, one clean/bad pair per single-module rule -------------
+
+W301_CLEAN = (
+    "def f():\n"
+    "    with tr.span('pipeline.drain'):\n"
+    "        faultinject.hit('ec.drain')\n")
+W301_BAD = (
+    "def f():\n"
+    "    faultinject.hit('ec.drain')\n")
+
+W501_CLEAN = (
+    "import threading\n"
+    "class C:\n"
+    "    def __init__(self):\n"
+    "        self._lock = threading.Lock()\n"
+    "        self._n = 0  # guarded-by: _lock\n"
+    "        self._t = threading.Thread(target=self._loop)\n"
+    "    def _loop(self):\n"
+    "        with self._lock:\n"
+    "            self._n += 1\n"
+    "    def read(self):\n"
+    "        with self._lock:\n"
+    "            return self._n\n")
+W501_BAD = W501_CLEAN.replace(
+    "    def read(self):\n"
+    "        with self._lock:\n"
+    "            return self._n\n",
+    "    def read(self):\n"
+    "        return self._n\n")
+
+W502_CLEAN = W501_CLEAN
+W502_BAD = (
+    "import threading\n"
+    "class C:\n"
+    "    def __init__(self):\n"
+    "        self.hits = 0\n"
+    "        self._t = threading.Thread(target=self._loop)\n"
+    "    def _loop(self):\n"
+    "        self.hits += 1\n")
+
+W601_CLEAN = (
+    "def install(router):\n"
+    "    @router.route('GET', '/x')\n"
+    "    def handler(req):\n"
+    "        try:\n"
+    "            limit = int(req.query.get('limit') or 0)\n"
+    "        except ValueError:\n"
+    "            raise HttpError(400, 'bad limit')\n"
+    "        return limit\n")
+W601_BAD = (
+    "def install(router):\n"
+    "    @router.route('GET', '/x')\n"
+    "    def handler(req):\n"
+    "        return int(req.query.get('limit') or 0)\n")
+
+W801_CLEAN = (
+    "def f(path):\n"
+    "    with open(path, 'rb') as fh:\n"
+    "        return fh.read()\n")
+W801_BAD = (
+    "def f(path):\n"
+    "    fh = open(path, 'rb')\n"
+    "    return fh.read()\n")
+
+CASES = [
+    ("W101", "x = 1\n", "import tomllib\n",
+     lambda src: rules_py310.check_source(src, "t.py")),
+    ("W201", "import urllib.parse\n", "import urllib.request\n",
+     lambda src: rules_tracing.check_package_source(src, "pkg/t.py")),
+    ("W301", W301_CLEAN, W301_BAD,
+     lambda src: check_drain_fault_source(src, "t.py")),
+    ("W501", W501_CLEAN, W501_BAD,
+     lambda src: check_class_source(src, "t.py")),
+    ("W502", W502_CLEAN, W502_BAD,
+     lambda src: check_class_source(src, "t.py")),
+    ("W601", W601_CLEAN, W601_BAD,
+     lambda src: check_routes(src, "t.py")),
+    ("W801", W801_CLEAN, W801_BAD,
+     lambda src: check_resources(src, "t.py")),
+]
+
+
+@pytest.mark.parametrize("rule_id,clean,bad,checker", CASES,
+                         ids=[c[0] for c in CASES])
+def test_rule_fires_on_planted_violation_and_passes_clean(
+        rule_id, clean, bad, checker):
+    assert [f for f in checker(clean) if f.rule == rule_id] == [], rule_id
+    hits = [f for f in checker(bad) if f.rule == rule_id]
+    assert hits, f"{rule_id} did not fire on its planted violation"
+    assert all(f.line > 0 for f in hits)
+
+
+# --- W701: fault-registry consistency (tables as arguments) -----------------
+
+class TestFaultRegistry:
+    REG = {"a.b": 3, "c.d": 4}
+
+    def test_consistent_tables_pass(self):
+        sites = [("a.b", 10, "m.py"), ("c.d", 11, "m.py")]
+        assert check_registry(self.REG, 1, sites, '"a.b" "c.d"') == []
+
+    def test_unregistered_site_caught(self):
+        sites = [("a.b", 10, "m.py"), ("c.d", 11, "m.py"),
+                 ("typo.name", 12, "m.py")]
+        out = check_registry(self.REG, 1, sites, '"a.b" "c.d"')
+        assert any("typo.name" in f.message and f.path == "m.py"
+                   for f in out)
+
+    def test_registered_without_site_caught(self):
+        out = check_registry(self.REG, 1, [("a.b", 10, "m.py")],
+                             '"a.b" "c.d"')
+        assert any("c.d" in f.message and "never inject" in f.message
+                   for f in out)
+
+    def test_untested_point_caught(self):
+        sites = [("a.b", 10, "m.py"), ("c.d", 11, "m.py")]
+        out = check_registry(self.REG, 1, sites, '"a.b" only')
+        assert any("c.d" in f.message and "not exercised" in f.message
+                   for f in out)
+
+    def test_live_registry_parses_and_matches_sites(self):
+        fi_path = os.path.join(REPO, "seaweedfs_tpu", "utils",
+                               "faultinject.py")
+        with open(fi_path, encoding="utf-8") as f:
+            src = f.read()
+        registry, _line = load_registry(src)
+        assert "ec.drain" in registry and "ec.shard.corrupt" in registry
+        # the module's own hit() implementation is not a SITE
+        assert all(n for n, _ln in hit_sites(src, fi_path))
+
+
+# --- lockset checker on synthetic classes -----------------------------------
+
+class TestLockset:
+    def test_two_lock_class_wrong_lock_caught(self):
+        src = (
+            "import threading\n"
+            "class C:\n"
+            "    def __init__(self):\n"
+            "        self._a_lock = threading.Lock()\n"
+            "        self._b_lock = threading.Lock()\n"
+            "        self._a = 0  # guarded-by: _a_lock\n"
+            "        self._b = 0  # guarded-by: _b_lock\n"
+            "        self._t = threading.Thread(target=self._loop)\n"
+            "    def _loop(self):\n"
+            "        with self._a_lock:\n"
+            "            self._a += 1\n"
+            "            self._b += 1\n"  # wrong lock held
+            "    def read(self):\n"
+            "        with self._b_lock:\n"
+            "            return self._b\n"
+            "        with self._a_lock:\n"
+            "            return self._a\n")
+        out = [f for f in check_class_source(src, "t.py")
+               if f.rule == "W501"]
+        assert len(out) == 1 and "self._b" in out[0].message \
+            and "_b_lock" in out[0].message
+
+    def test_holds_annotation_honored(self):
+        src = (
+            "import threading\n"
+            "class C:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._n = 0  # guarded-by: _lock\n"
+            "        self._t = threading.Thread(target=self._loop)\n"
+            "    def _loop(self):\n"
+            "        with self._lock:\n"
+            "            self._bump()\n"
+            "    def _bump(self):  # holds: _lock\n"
+            "        self._n += 1\n"
+            "    def bump(self):\n"
+            "        with self._lock:\n"
+            "            self._bump()\n")
+        assert check_class_source(src, "t.py") == []
+
+    def test_locked_suffix_honored(self):
+        src = (
+            "import threading\n"
+            "class C:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._n = 0  # guarded-by: _lock\n"
+            "        self._t = threading.Thread(target=self._loop)\n"
+            "    def _loop(self):\n"
+            "        with self._lock:\n"
+            "            self._bump_locked()\n"
+            "    def _bump_locked(self):\n"
+            "        self._n += 1\n"
+            "    def bump(self):\n"
+            "        with self._lock:\n"
+            "            self._bump_locked()\n")
+        assert check_class_source(src, "t.py") == []
+
+    def test_thread_entry_annotation_creates_root(self):
+        # no lexical Thread() construction: the annotation alone must
+        # make the hook method a root so the naked access is caught
+        src = (
+            "import threading\n"
+            "class C:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._n = 0  # guarded-by: _lock\n"
+            "    def on_event(self, ev):  # thread-entry\n"
+            "        self._n += 1\n")
+        out = check_class_source(src, "t.py")
+        assert any(f.rule == "W501" and "on_event" in f.message
+                   for f in out)
+
+    def test_concurrent_class_marks_public_methods_as_roots(self):
+        src = (
+            "import threading\n"
+            "class C:  # weedlint: concurrent-class\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._n = 0  # guarded-by: _lock\n"
+            "    def bump(self):\n"
+            "        self._n += 1\n"
+            "    def read(self):\n"
+            "        return self._n\n")
+        out = [f for f in check_class_source(src, "t.py")
+               if f.rule == "W501"]
+        assert len(out) == 2  # both naked accesses race each other
+
+    def test_closure_does_not_inherit_lock(self):
+        # a nested function may run on another thread after the with
+        # released the lock: the access inside it counts as unlocked
+        src = (
+            "import threading\n"
+            "class C:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._n = 0  # guarded-by: _lock\n"
+            "        self._t = threading.Thread(target=self._loop)\n"
+            "    def _loop(self):\n"
+            "        with self._lock:\n"
+            "            self._n += 1\n"
+            "    def make(self):\n"
+            "        with self._lock:\n"
+            "            def cb():\n"
+            "                return self._n\n"
+            "            return cb\n")
+        out = [f for f in check_class_source(src, "t.py")
+               if f.rule == "W501"]
+        assert len(out) == 1 and out[0].rule == "W501"
+
+    def test_init_is_exempt(self):
+        src = (
+            "import threading\n"
+            "class C:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._n = 0  # guarded-by: _lock\n"
+            "        self._n = 1\n"  # naked in __init__: fine
+            "        self._t = threading.Thread(target=self._loop)\n"
+            "    def _loop(self):\n"
+            "        with self._lock:\n"
+            "            self._n += 1\n")
+        assert check_class_source(src, "t.py") == []
+
+
+# --- engine: waivers, baseline, run -----------------------------------------
+
+def _mini_repo(tmp_path, body: str) -> str:
+    """A throwaway repo: one package module + empty baseline."""
+    pkg = tmp_path / "seaweedfs_tpu"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "mod.py").write_text(body)
+    (tmp_path / "tools").mkdir()
+    (tmp_path / "tools" / "weedlint_baseline.json").write_text(
+        '{"version": 1, "findings": {}}')
+    return str(tmp_path)
+
+
+# rules that judge a tiny synthetic tree on its own terms (no live
+# package import, no this-repo-specific file contracts)
+FAST_RULES = ["W101", "W501", "W502", "W601", "W801"]
+
+
+class TestEngine:
+    def test_waiver_suppresses_with_reason(self, tmp_path):
+        body = (
+            "import threading\n"
+            "class C:\n"
+            "    def __init__(self):\n"
+            "        self.hits = 0\n"
+            "        self._t = threading.Thread(target=self._loop)\n"
+            "    def _loop(self):\n"
+            "        self.hits += 1  "
+            "# weedlint: disable=W502 single scan thread owns it\n")
+        root = _mini_repo(tmp_path, body)
+        res = engine.run(root, rule_ids=FAST_RULES)
+        assert [f.rule for f in res.findings] == []
+        assert len(res.waived) == 1
+
+    def test_waiver_without_reason_is_flagged(self, tmp_path):
+        body = (
+            "import threading\n"
+            "class C:\n"
+            "    def __init__(self):\n"
+            "        self.hits = 0\n"
+            "        self._t = threading.Thread(target=self._loop)\n"
+            "    def _loop(self):\n"
+            "        self.hits += 1  # weedlint: disable=W502\n")
+        root = _mini_repo(tmp_path, body)
+        res = engine.run(root)
+        assert any(f.rule == "W001" and "no reason" in f.message
+                   for f in res.findings)
+
+    def test_stale_waiver_is_flagged(self, tmp_path):
+        body = "x = 1  # weedlint: disable=W801 leftover excuse\n"
+        root = _mini_repo(tmp_path, body)
+        res = engine.run(root)
+        assert any(f.rule == "W001" and "stale waiver" in f.message
+                   for f in res.findings)
+
+    def test_docstring_quoting_waiver_syntax_is_not_a_waiver(self,
+                                                            tmp_path):
+        body = ('"""Docs: waive with  # weedlint: disable=W501 why"""\n'
+                "x = 1\n")
+        root = _mini_repo(tmp_path, body)
+        res = engine.run(root)
+        assert [f for f in res.findings if f.rule == "W001"] == []
+
+    def test_baseline_grandfathers_exact_count(self, tmp_path):
+        body = (
+            "import threading\n"
+            "class C:\n"
+            "    def __init__(self):\n"
+            "        self.hits = 0\n"
+            "        self._t = threading.Thread(target=self._loop)\n"
+            "    def _loop(self):\n"
+            "        self.hits += 1\n"
+            "        self.hits += 2\n")
+        root = _mini_repo(tmp_path, body)
+        res = engine.run(root, rule_ids=FAST_RULES)
+        assert len(res.findings) == 2
+        bl = str(tmp_path / "bl.json")
+        engine.save_baseline(bl, res.findings)
+        res2 = engine.run(root, rule_ids=FAST_RULES, baseline_path=bl)
+        assert res2.findings == [] and len(res2.baselined) == 2
+        # a THIRD identical violation exceeds the grandfathered count
+        mod = tmp_path / "seaweedfs_tpu" / "mod.py"
+        mod.write_text(mod.read_text() + "        self.hits += 3\n")
+        res3 = engine.run(root, rule_ids=FAST_RULES, baseline_path=bl)
+        assert len(res3.findings) == 1
+
+    def test_unknown_rule_id_raises(self, tmp_path):
+        root = _mini_repo(tmp_path, "x = 1\n")
+        with pytest.raises(KeyError):
+            engine.run(root, rule_ids=["W999"])
+
+    def test_json_output_schema(self, tmp_path):
+        root = _mini_repo(tmp_path, "import tomllib\n")
+        p = subprocess.run(
+            [sys.executable, "-m", "tools.weedlint", "--json",
+             "--rule", "W101", root],
+            capture_output=True, text=True, cwd=REPO, timeout=120)
+        assert p.returncode == 1
+        doc = json.loads(p.stdout)
+        assert doc["version"] == 1
+        assert doc["rules"] == ["W101"]
+        assert doc["counts"]["reported"] == len(doc["findings"]) == 1
+        f = doc["findings"][0]
+        assert set(f) >= {"rule", "path", "line", "message",
+                          "fingerprint"}
+        assert f["path"].endswith("mod.py")
+
+    def test_cli_list_rules(self):
+        p = subprocess.run(
+            [sys.executable, "-m", "tools.weedlint", "--list-rules"],
+            capture_output=True, text=True, cwd=REPO, timeout=120)
+        assert p.returncode == 0
+        for rid in ("W101", "W201", "W301", "W401", "W501", "W502",
+                    "W601", "W701", "W801"):
+            assert rid in p.stdout
+
+    def test_cli_unknown_rule_exits_2(self):
+        p = subprocess.run(
+            [sys.executable, "-m", "tools.weedlint", "--rule", "W999"],
+            capture_output=True, text=True, cwd=REPO, timeout=120)
+        assert p.returncode == 2
+
+
+# --- the repo-wide tier-1 gate ----------------------------------------------
+
+class TestWholeRepo:
+    def test_repo_is_clean_modulo_baseline(self):
+        """THE gate: every rule over the whole repo, zero findings
+        beyond waivers and the committed baseline."""
+        res = engine.run(REPO)
+        assert res.findings == [], "\n".join(
+            f.render() for f in res.findings)
+
+    def test_baseline_carries_only_grandfathered_lockset_findings(self):
+        """The committed baseline is a W502 grandfather list for
+        pre-weedlint modules — route-param, resource and registry
+        findings were all FIXED, not baselined, and new-rule findings
+        must never be added here (fix or waive instead)."""
+        with open(os.path.join(REPO, "tools",
+                               "weedlint_baseline.json")) as f:
+            doc = json.load(f)
+        kinds = {e["rule"] for e in doc["findings"].values()}
+        assert kinds <= {"W502"}, kinds
+
+    def test_shell_fault_list_prints_registry(self):
+        from seaweedfs_tpu.shell.commands import COMMANDS
+        from seaweedfs_tpu.utils import faultinject as fi
+
+        out = COMMANDS["fault.list"](None, {})
+        for name in fi.FAULT_POINTS:
+            assert name in out
+        doc = json.loads(COMMANDS["fault.list"](None, {"json": "true"}))
+        assert doc == dict(fi.list_points())

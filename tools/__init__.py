@@ -1,0 +1,3 @@
+# tools/ is a package so `python -m tools.weedlint` works from the repo
+# root (the tier-1 invocation); the check_*.py shims also run as plain
+# scripts.

@@ -1,180 +1,40 @@
 #!/usr/bin/env python
-"""Async-drain lint: the streaming hot loop must never block on fetch.
+"""Shim over weedlint rule W301 (tools/weedlint/rules_async_drain.py).
 
-PR 7 rebuilt the drain side of ec/streaming.py as an asynchronous,
-multi-buffered, parity-only writeback path (ec/overlap.py AsyncDrainer):
-the pipeline's critical thread fills/dispatches/writes while a drainer
-thread pulls parity back and a writer thread appends it FIFO.  The
-whole point dies quietly if a later change reintroduces a blocking
-full-block fetch (`np.asarray` / `jax.device_get` / `worker.fetch`) on
-the critical thread — the encode still produces correct bytes, it just
-stalls again, and nothing but a slow bench run would notice.  This lint
-makes the regression loud:
+The async-drain hot-loop lint moved onto the unified weedlint engine
+(PR 10); this entry point and its helper names survive so existing
+invocations and tests keep working:
 
-  1. `_encode_file_staged` and `_encode_file_mmap` must both construct
-     the AsyncDrainer (the async path exists and is wired).
-  2. Inside those two functions, blocking-fetch calls (`_fetch`,
-     `fetch`, `asarray`, `device_get`, `block_until_ready`) may appear
-     ONLY within nested drain helpers (functions named `drain*`) — the
-     hot loop (flush / the entry loop) never blocks on kernel output.
-  3. Every `faultinject.hit("ec.drain")` in the package must sit
-     lexically inside a `with ... span("pipeline.drain", ...)` block,
-     so delay-only slow-drain drills keep attributing to the drain
-     stage wherever the drain loop runs (PR-4 contract, now enforced).
-
-  python tools/check_async_drain.py [repo_root]
-
-Exit status 0 = clean, 1 = violations (one per line on stdout).
-Stdlib-only — runs as a tier-1 test (tests/test_check_async_drain.py).
+    python tools/check_async_drain.py [repo_root]
+    python -m tools.weedlint --rule W301
 """
 
 from __future__ import annotations
 
-import ast
 import os
 import sys
 
-PACKAGE = "seaweedfs_tpu"
-STREAMING_REL = os.path.join(PACKAGE, "ec", "streaming.py")
-SKIP_DIRS = {".git", "__pycache__", ".claude", ".pytest_cache",
-             "node_modules", ".venv", "venv"}
-# the encode hot-loop functions the async-drain contract covers
-HOT_FUNCS = ("_encode_file_staged", "_encode_file_mmap")
-# calls that block the calling thread on kernel/worker output
-BLOCKING_CALLS = {"_fetch", "fetch", "asarray", "device_get",
-                  "block_until_ready"}
-# nested helpers allowed to block: the drain side itself
-DRAIN_PREFIXES = ("drain", "_drain")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tools.weedlint import Repo, get_rule  # noqa: E402
+from tools.weedlint.rules_async_drain import (  # noqa: E402
+    check_drain_fault_source as _fault, check_streaming_source as _streaming)
 
 
-def _call_name(node: ast.Call) -> str:
-    f = node.func
-    if isinstance(f, ast.Name):
-        return f.id
-    if isinstance(f, ast.Attribute):
-        return f.attr
-    return ""
-
-
-def _is_drain_helper(name: str) -> bool:
-    return name.startswith(DRAIN_PREFIXES)
-
-
-def _check_hot_func(fn: ast.AST, path: str) -> list[str]:
-    """Rule 2 on one encode function: blocking calls only inside
-    drain* helpers."""
-    problems: list[str] = []
-
-    def walk(node: ast.AST, inside_drain: bool) -> None:
-        for child in ast.iter_child_nodes(node):
-            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                walk(child, inside_drain or _is_drain_helper(child.name))
-                continue
-            if isinstance(child, ast.Call) and not inside_drain:
-                name = _call_name(child)
-                if name in BLOCKING_CALLS:
-                    problems.append(
-                        f"{path}:{child.lineno}: blocking `{name}()` on "
-                        f"the streaming hot loop (inside {fn.name}) — "
-                        f"kernel output must come back through the "
-                        f"async drainer (a drain* helper), not block "
-                        f"the critical thread")
-            walk(child, inside_drain)
-
-    walk(fn, False)
-    return problems
+def _strs(findings) -> list[str]:
+    return [f"{f.path}:{f.line}: {f.message}" for f in findings]
 
 
 def check_streaming_source(src: str, path: str) -> list[str]:
-    """Rules 1+2 on ec/streaming.py."""
-    try:
-        tree = ast.parse(src, filename=path)
-    except SyntaxError as e:
-        return [f"{path}:{e.lineno or 0}: does not parse: {e.msg}"]
-    problems: list[str] = []
-    fns = {node.name: node for node in ast.walk(tree)
-           if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))}
-    for name in HOT_FUNCS:
-        fn = fns.get(name)
-        if fn is None:
-            problems.append(f"{path}:0: {name} not found — the async-"
-                            f"drain contract covers it by name")
-            continue
-        calls = {_call_name(c) for c in ast.walk(fn)
-                 if isinstance(c, ast.Call)}
-        if "AsyncDrainer" not in calls:
-            problems.append(
-                f"{path}:{fn.lineno}: {name} no longer constructs "
-                f"AsyncDrainer — the drain would run inline on the "
-                f"critical thread and the drain-wait stall returns")
-        problems.extend(_check_hot_func(fn, path))
-    return problems
+    return _strs(_streaming(src, path))
 
 
 def check_drain_fault_source(src: str, path: str) -> list[str]:
-    """Rule 3 on any package module: hit("ec.drain") must be inside a
-    `with ... span("pipeline.drain", ...)` block."""
-    try:
-        tree = ast.parse(src, filename=path)
-    except SyntaxError as e:
-        return [f"{path}:{e.lineno or 0}: does not parse: {e.msg}"]
-    problems: list[str] = []
-
-    def span_names(with_node: ast.With) -> set[str]:
-        names: set[str] = set()
-        for item in with_node.items:
-            ctx = item.context_expr
-            if isinstance(ctx, ast.Call) and _call_name(ctx) == "span" \
-                    and ctx.args \
-                    and isinstance(ctx.args[0], ast.Constant):
-                names.add(str(ctx.args[0].value))
-        return names
-
-    def walk(node: ast.AST, spans: frozenset) -> None:
-        for child in ast.iter_child_nodes(node):
-            child_spans = spans
-            if isinstance(child, ast.With):
-                child_spans = spans | span_names(child)
-            if isinstance(child, ast.Call) \
-                    and _call_name(child) == "hit" \
-                    and child.args \
-                    and isinstance(child.args[0], ast.Constant) \
-                    and child.args[0].value == "ec.drain" \
-                    and "pipeline.drain" not in spans:
-                problems.append(
-                    f"{path}:{child.lineno}: faultinject.hit(\"ec.drain\") "
-                    f"outside a `with span(\"pipeline.drain\")` block — "
-                    f"delay-only slow-drain drills would stop "
-                    f"attributing to the drain stage")
-            walk(child, child_spans)
-
-    walk(tree, frozenset())
-    return problems
-
-
-def _read(path: str) -> str:
-    with open(path, encoding="utf-8", errors="replace") as f:
-        return f.read()
+    return _strs(_fault(src, path))
 
 
 def check_repo(root: str) -> list[str]:
-    problems: list[str] = []
-    streaming = os.path.join(root, STREAMING_REL)
-    if os.path.exists(streaming):
-        problems.extend(
-            check_streaming_source(_read(streaming), STREAMING_REL))
-    else:
-        problems.append(f"{STREAMING_REL}:0: missing")
-    pkg_root = os.path.join(root, PACKAGE)
-    for dirpath, dirnames, filenames in os.walk(pkg_root):
-        dirnames[:] = sorted(d for d in dirnames if d not in SKIP_DIRS)
-        for name in sorted(filenames):
-            if not name.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, name)
-            rel = os.path.relpath(path, root)
-            problems.extend(check_drain_fault_source(_read(path), rel))
-    return problems
+    return _strs(get_rule("W301").check(Repo(root)))
 
 
 def main(argv: list[str]) -> int:

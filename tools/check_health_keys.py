@@ -1,151 +1,28 @@
 #!/usr/bin/env python3
-"""Tier-1 consistency lint for the degraded-signal tables.
+"""Shim over weedlint rule W401 (tools/weedlint/rules_health_keys.py).
 
-Four tables describe "what counts as degraded" and they MUST agree:
+The degraded-signal table-consistency lint moved onto the unified
+weedlint engine (PR 10); this entry point and `check_tables` /
+`check_repo` survive so existing invocations and tests keep working:
 
-  stats/aggregate.py   HEALTH_FAMILIES      — the /cluster/health keys
-  observability/analysis.py DEGRADE_COUNTER_KEYS — the analyzer verdict
-  observability/events.py   EVENT_TYPES + HEALTH_EVENT_TYPES — journal
-  observability/alerts.py   default_rules()  — what actually pages
-
-Before this lint, adding a degraded counter to one table but not the
-others was silent drift: a counter could degrade /cluster/health yet
-never fire an alert, or an event type could exist with no counter
-backing it.  Run as a tier-1 test (tests/test_check_health_keys.py) and
-standalone:
-
-    python tools/check_health_keys.py   # exit 1 + report on drift
-
-The check functions take the tables as ARGUMENTS so the test can feed
-synthetically drifted tables and prove each rule actually catches.
+    python tools/check_health_keys.py         # exit 1 + report on drift
+    python -m tools.weedlint --rule W401
 """
 
 from __future__ import annotations
 
+import os
 import sys
 
-# HEALTH_FAMILIES keys that legitimately stay OUT of
-# DEGRADE_COUNTER_KEYS: a degraded TCP bind means a server came up
-# without its fast plane — operationally alertable, but it does not
-# make a pipeline MEASUREMENT degraded (the analyzer's verdict is about
-# the measured run, not the serving posture).
-DEGRADE_KEY_ALLOWLIST = ("degraded_binds",)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-# DEGRADE_COUNTER_KEYS entries that are per-run encode stats rather
-# than cluster counter families (they ride encode() stats dicts, not
-# /metrics): the health table legitimately does not carry them.
-PER_RUN_ONLY_KEYS = ("retries", "fallbacks")
-
-
-def check_tables(health_families: dict, degrade_keys: tuple,
-                 rules: list, event_types: dict,
-                 health_event_types: dict,
-                 extra_health_keys: tuple = ("scrub_unrepairable",),
-                 allowlist: tuple = DEGRADE_KEY_ALLOWLIST,
-                 per_run_only: tuple = PER_RUN_ONLY_KEYS) -> list[str]:
-    """Returns human-readable violations (empty = consistent).
-    `rules` is a list of alert Rule objects (or anything with .kind and
-    .params)."""
-    v: list[str] = []
-    health_keys = set(health_families)
-
-    # 1. every health key maps to a journal event type, and that type
-    #    is registered with a severity
-    for key in sorted(health_keys):
-        etype = health_event_types.get(key)
-        if not etype:
-            v.append(f"HEALTH_FAMILIES key {key!r} has no event type in "
-                     "events.HEALTH_EVENT_TYPES — its degraded moments "
-                     "would never reach the journal")
-        elif etype not in event_types:
-            v.append(f"HEALTH_EVENT_TYPES maps {key!r} -> {etype!r} "
-                     "which is not registered in events.EVENT_TYPES")
-    # ... and no mapping points at a key that left the health table
-    for key in sorted(health_event_types):
-        if key not in health_keys:
-            v.append(f"HEALTH_EVENT_TYPES covers {key!r} which is not "
-                     "a HEALTH_FAMILIES key (stale mapping)")
-
-    # 2. every health key (minus the documented allowlist) marks
-    #    analyzer runs degraded
-    for key in sorted(health_keys - set(allowlist)):
-        if key not in degrade_keys:
-            v.append(f"HEALTH_FAMILIES key {key!r} missing from "
-                     "analysis.DEGRADE_COUNTER_KEYS — a run that "
-                     "tripped it would still read clean")
-    # ... and every degrade key that claims to be a cluster family is
-    for key in degrade_keys:
-        if key in per_run_only:
-            continue
-        if key not in health_keys:
-            v.append(f"DEGRADE_COUNTER_KEYS entry {key!r} is not a "
-                     "HEALTH_FAMILIES key (and not a documented "
-                     "per-run stat) — /cluster/health would never "
-                     "carry it")
-
-    # 3. every health key is watched by a default counter_increase rule
-    watched = {r.params.get("key") for r in rules
-               if getattr(r, "kind", "") == "counter_increase"}
-    for key in sorted(health_keys):
-        if key not in watched:
-            v.append(f"HEALTH_FAMILIES key {key!r} has no default "
-                     "counter_increase alert rule — it would degrade "
-                     "/cluster/health without ever paging")
-
-    # 4. every rule that names a health key names a REAL one
-    legal = health_keys | set(extra_health_keys)
-    for r in rules:
-        kind = getattr(r, "kind", "")
-        key = (getattr(r, "params", None) or {}).get("key")
-        if kind in ("counter_increase", "threshold") and key not in legal:
-            v.append(f"alert rule {getattr(r, 'name', '?')!r} watches "
-                     f"unknown health key {key!r}")
-
-    # 5. the alert lifecycle's own event types exist (the journal is
-    #    where transitions are recorded; losing one loses the audit
-    #    trail)
-    for etype in ("alert_pending", "alert_fired", "alert_resolved"):
-        if etype not in event_types:
-            v.append(f"event type {etype!r} missing from EVENT_TYPES — "
-                     "alert transitions would journal as unregistered "
-                     "types")
-
-    # 6. a counter rule's severity must match its event type's —
-    #    EVENT_TYPES is the ONE severity table; a rule hand-overriding
-    #    it would page at a different level than the journal records
-    for r in rules:
-        if getattr(r, "kind", "") != "counter_increase":
-            continue
-        key = (getattr(r, "params", None) or {}).get("key")
-        etype = health_event_types.get(key or "")
-        want = event_types.get(etype or "")
-        got = getattr(r, "severity", None)
-        if want and got != want:
-            v.append(f"alert rule {getattr(r, 'name', '?')!r} severity "
-                     f"{got!r} disagrees with EVENT_TYPES[{etype!r}] = "
-                     f"{want!r}")
-    return v
-
-
-def check_repo() -> list[str]:
-    """The real tables, imported live — what tier-1 runs."""
-    from seaweedfs_tpu.observability.alerts import (EXTRA_HEALTH_KEYS,
-                                                    default_rules)
-    from seaweedfs_tpu.observability.analysis import DEGRADE_COUNTER_KEYS
-    from seaweedfs_tpu.observability.events import (EVENT_TYPES,
-                                                    HEALTH_EVENT_TYPES)
-    from seaweedfs_tpu.stats.aggregate import HEALTH_FAMILIES
-
-    return check_tables(HEALTH_FAMILIES, DEGRADE_COUNTER_KEYS,
-                        default_rules(), EVENT_TYPES,
-                        HEALTH_EVENT_TYPES,
-                        extra_health_keys=EXTRA_HEALTH_KEYS)
+from tools.weedlint.rules_health_keys import (  # noqa: E402,F401
+    DEGRADE_KEY_ALLOWLIST, PER_RUN_ONLY_KEYS, check_tables)
+from tools.weedlint.rules_health_keys import \
+    check_live_tables as check_repo  # noqa: E402,F401
 
 
 def main() -> int:
-    import os
-    sys.path.insert(0, os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))))
     violations = check_repo()
     for msg in violations:
         print(f"check_health_keys: {msg}")

@@ -1,157 +1,47 @@
 #!/usr/bin/env python
-"""AST lint: reject code the py3.10 runtime cannot import.
+"""Shim over weedlint rule W101 (tools/weedlint/rules_py310.py).
 
-The deployment container runs Python 3.10 — no PEP-701 nested
-same-quote f-strings, no tomllib, no datetime.UTC.  Code written
-against 3.12 does not fail loudly: a single 3.12-only f-string in a
-widely-imported module silently collection-errors every test that
-imports it (the seed shipped exactly that in volume_server/server.py
-and it killed ~300 tests until PR 1 found it by hand).  This lint makes
-that class of bug a tier-1 failure instead of a silent one:
+The standalone py3.10-compat AST lint moved onto the unified weedlint
+engine (PR 10); this entry point and its helper names survive so
+existing invocations and tests keep working:
 
-  python tools/check_py310.py [root ...]    # default: the repo root
-
-Checks, per .py file:
-  - the file parses as py3.10 syntax (ast.parse with
-    feature_version=(3, 10); under a 3.10 interpreter the parse itself
-    also rejects 3.12-only constructs like nested same-quote f-strings);
-  - `import tomllib` / `from tomllib import ...` only inside an
-    ImportError-catching try (the utils/config.py gating pattern) or a
-    sys.version_info guard;
-  - `from datetime import UTC` / `datetime.UTC` under the same gating
-    rule (py3.11+ only).
-
-Exit status 0 = clean, 1 = violations (one per line on stdout).
-Stdlib-only, no third-party deps — safe to run anywhere, including as
-a tier-1 test (tests/test_check_py310.py).
+    python tools/check_py310.py [root]        # exit 1 on violations
+    python -m tools.weedlint --rule W101      # the same check
 """
 
 from __future__ import annotations
 
-import ast
 import os
 import sys
 
-TARGET = (3, 10)
-SKIP_DIRS = {".git", "__pycache__", ".claude", ".pytest_cache",
-             "node_modules", ".venv", "venv"}
-# modules that do not exist on the target runtime
-BANNED_MODULES = {"tomllib"}
-_IMPORT_ERRORS = {"ImportError", "ModuleNotFoundError", "Exception",
-                  "BaseException"}
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-
-def _is_gate(node: ast.AST) -> bool:
-    """A node whose body may legally contain target-incompatible
-    imports: a try with an except arm catching ImportError (or wider),
-    or an `if` test mentioning sys.version_info."""
-    if isinstance(node, ast.Try):
-        for h in node.handlers:
-            if h.type is None:
-                return True
-            names = []
-            t = h.type
-            for el in (t.elts if isinstance(t, ast.Tuple) else [t]):
-                if isinstance(el, ast.Name):
-                    names.append(el.id)
-                elif isinstance(el, ast.Attribute):
-                    names.append(el.attr)
-            if _IMPORT_ERRORS & set(names):
-                return True
-        return False
-    if isinstance(node, ast.If):
-        for sub in ast.walk(node.test):
-            if isinstance(sub, ast.Attribute) and \
-                    sub.attr == "version_info":
-                return True
-    return False
+from tools.weedlint import Repo, get_rule  # noqa: E402
+from tools.weedlint.rules_py310 import check_source as _check  # noqa: E402
 
 
 def check_source(src: str, path: str) -> list[str]:
-    """Problems found in one file's source, as `path:line: message`."""
-    try:
-        tree = ast.parse(src, filename=path, feature_version=TARGET)
-    except SyntaxError as e:
-        return [f"{path}:{e.lineno or 0}: does not parse as "
-                f"py{TARGET[0]}.{TARGET[1]} syntax: {e.msg}"]
-    problems: list[str] = []
-
-    def visit(node: ast.AST, gated: bool) -> None:
-        gated = gated or _is_gate(node)
-        if isinstance(node, ast.Import):
-            for alias in node.names:
-                root = alias.name.split(".")[0]
-                if root in BANNED_MODULES and not gated:
-                    problems.append(
-                        f"{path}:{node.lineno}: ungated `import "
-                        f"{alias.name}` ({root} does not exist on "
-                        f"py{TARGET[0]}.{TARGET[1]})")
-        elif isinstance(node, ast.ImportFrom):
-            mod = (node.module or "").split(".")[0]
-            if mod in BANNED_MODULES and not gated:
-                problems.append(
-                    f"{path}:{node.lineno}: ungated `from {node.module} "
-                    f"import ...` ({mod} does not exist on "
-                    f"py{TARGET[0]}.{TARGET[1]})")
-            if mod == "datetime" and not gated and \
-                    any(a.name == "UTC" for a in node.names):
-                problems.append(
-                    f"{path}:{node.lineno}: ungated `from datetime "
-                    f"import UTC` (py3.11+ only; use timezone.utc)")
-        elif isinstance(node, ast.Attribute):
-            if node.attr == "UTC" and not gated and \
-                    isinstance(node.value, ast.Name) and \
-                    node.value.id == "datetime":
-                problems.append(
-                    f"{path}:{node.lineno}: ungated `datetime.UTC` "
-                    f"(py3.11+ only; use datetime.timezone.utc)")
-        for child in ast.iter_child_nodes(node):
-            visit(child, gated)
-
-    visit(tree, False)
-    return problems
-
-
-def check_file(path: str) -> list[str]:
-    try:
-        with open(path, encoding="utf-8", errors="replace") as f:
-            return check_source(f.read(), path)
-    except OSError as e:
-        return [f"{path}:0: unreadable: {e}"]
-
-
-def iter_py_files(root: str):
-    for dirpath, dirnames, filenames in os.walk(root):
-        dirnames[:] = sorted(d for d in dirnames if d not in SKIP_DIRS)
-        for name in sorted(filenames):
-            if name.endswith(".py"):
-                yield os.path.join(dirpath, name)
+    return [f"{f.path}:{f.line}: {f.message}" for f in _check(src, path)]
 
 
 def check_tree(root: str) -> list[str]:
-    problems: list[str] = []
-    for path in iter_py_files(root):
-        problems.extend(check_file(path))
-    return problems
+    findings = get_rule("W101").check(Repo(root))
+    return [f"{f.path}:{f.line}: {f.message}" for f in findings]
 
 
 def main(argv: list[str]) -> int:
-    roots = argv or [os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__)))]
+    roots = argv or [os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))]
     problems: list[str] = []
-    checked = 0
-    for root in roots:
+    for root in roots:  # the old CLI took several roots/files: keep it
         if os.path.isfile(root):
-            problems.extend(check_file(root))
-            checked += 1
+            problems.extend(
+                check_source(open(root, encoding="utf-8").read(), root))
         else:
-            for path in iter_py_files(root):
-                problems.extend(check_file(path))
-                checked += 1
+            problems.extend(check_tree(root))
     for p in problems:
         print(p)
-    print(f"check_py310: {checked} files, {len(problems)} problem(s)",
-          file=sys.stderr)
+    print(f"check_py310: {len(problems)} problem(s)", file=sys.stderr)
     return 1 if problems else 0
 
 

@@ -1,219 +1,40 @@
 #!/usr/bin/env python
-"""Tracing-coverage lint: new code cannot silently opt out of tracing.
+"""Shim over weedlint rule W201 (tools/weedlint/rules_tracing.py).
 
-PR 6's distributed tracing is enforced at two chokepoints, not at every
-call site: `utils/httpd.py` Router.dispatch is the ONE ingress every
-HTTP handler runs under (trace-context adoption + request span), and
-`utils/httpd.py`'s pooled client helpers are the ONE egress every
-outbound hop rides (Traceparent injection + rpc.client span).  That
-design only holds if nothing routes around the chokepoints — which is
-exactly what this lint asserts:
+The tracing-chokepoint lint moved onto the unified weedlint engine
+(PR 10); this entry point and its helper names survive so existing
+invocations and tests keep working:
 
-  1. Router.dispatch still adopts/restores the trace context
-     (begin_request/end_request) and opens the request span; the framed
-     TCP front (_serve_conn) still mints its headerless ingress.
-  2. The outbound helpers (_pooled_request, http_download) still call
-     inject_trace_headers.
-  3. No module inside the seaweedfs_tpu package performs raw outbound
-     HTTP (urllib.request / http.client) — that would bypass header
-     injection, so the hop would shatter the trace.  utils/httpd.py
-     itself is the sole allowed user.
-  4. No Router subclass overrides dispatch outside utils/httpd.py
-     (an override could drop the request span / context restore).
-
-  python tools/check_tracing.py [repo_root]
-
-Exit status 0 = clean, 1 = violations (one per line on stdout).
-Stdlib-only — runs as a tier-1 test (tests/test_check_tracing.py).
+    python tools/check_tracing.py [repo_root]
+    python -m tools.weedlint --rule W201
 """
 
 from __future__ import annotations
 
-import ast
 import os
 import sys
 
-PACKAGE = "seaweedfs_tpu"
-HTTPD_REL = os.path.join(PACKAGE, "utils", "httpd.py")
-FRAMING_REL = os.path.join(PACKAGE, "utils", "framing.py")
-SKIP_DIRS = {".git", "__pycache__", ".claude", ".pytest_cache",
-             "node_modules", ".venv", "venv"}
-# modules whose presence in package code means a hand-rolled HTTP hop
-# that would skip Traceparent injection
-RAW_HTTP_MODULES = {"urllib.request", "http.client"}
-# the egress helpers that must inject the trace header
-OUTBOUND_HELPERS = ("_pooled_request", "http_download")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tools.weedlint import Repo, get_rule  # noqa: E402
+from tools.weedlint.rules_tracing import (check_httpd_source as _httpd,  # noqa: E402
+                                          check_package_source as _pkg)
 
 
-def _calls_in(node: ast.AST) -> set[str]:
-    """Names of everything called inside `node` (bare and attribute
-    calls both reduce to their final name)."""
-    names: set[str] = set()
-    for sub in ast.walk(node):
-        if isinstance(sub, ast.Call):
-            f = sub.func
-            if isinstance(f, ast.Name):
-                names.add(f.id)
-            elif isinstance(f, ast.Attribute):
-                names.add(f.attr)
-    return names
-
-
-def _functions(tree: ast.AST) -> dict[str, ast.AST]:
-    """Every function/method in the module, by name (methods shadow
-    module-level functions of the same name only if later — good enough
-    for this lint's unique names)."""
-    out: dict[str, ast.AST] = {}
-    for node in ast.walk(tree):
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            out.setdefault(node.name, node)
-    return out
+def _strs(findings) -> list[str]:
+    return [f"{f.path}:{f.line}: {f.message}" for f in findings]
 
 
 def check_httpd_source(src: str, path: str) -> list[str]:
-    """The ingress/egress chokepoint contract on utils/httpd.py."""
-    try:
-        tree = ast.parse(src, filename=path)
-    except SyntaxError as e:
-        return [f"{path}:{e.lineno or 0}: does not parse: {e.msg}"]
-    problems: list[str] = []
-    fns = _functions(tree)
-    dispatch = fns.get("dispatch")
-    if dispatch is None:
-        problems.append(f"{path}:0: Router.dispatch not found")
-    else:
-        calls = _calls_in(dispatch)
-        for required in ("begin_request", "end_request", "span"):
-            if required not in calls:
-                problems.append(
-                    f"{path}:{dispatch.lineno}: Router.dispatch no longer "
-                    f"calls {required}() — HTTP handlers would run "
-                    f"without a request span / trace context")
-    for helper in OUTBOUND_HELPERS:
-        fn = fns.get(helper)
-        if fn is None:
-            problems.append(f"{path}:0: outbound helper {helper}() "
-                            f"not found")
-        elif "inject_trace_headers" not in _calls_in(fn):
-            problems.append(
-                f"{path}:{fn.lineno}: {helper}() no longer calls "
-                f"inject_trace_headers() — outbound hops would drop "
-                f"the Traceparent and shatter cross-server traces")
-    return problems
-
-
-def check_framing_source(src: str, path: str) -> list[str]:
-    """The framed-TCP ingress contract on utils/framing.py."""
-    try:
-        tree = ast.parse(src, filename=path)
-    except SyntaxError as e:
-        return [f"{path}:{e.lineno or 0}: does not parse: {e.msg}"]
-    fns = _functions(tree)
-    serve = fns.get("_serve_conn")
-    if serve is None:
-        return [f"{path}:0: FramedServer._serve_conn not found"]
-    calls = _calls_in(serve)
-    missing = [c for c in ("begin_request", "end_request", "span")
-               if c not in calls]
-    if missing:
-        return [f"{path}:{serve.lineno}: _serve_conn no longer calls "
-                f"{'/'.join(missing)} — the native TCP ingress would "
-                f"run untraced"]
-    return []
+    return _strs(_httpd(src, path))
 
 
 def check_package_source(src: str, path: str) -> list[str]:
-    """Per-module rules for every other file in the package.
-
-    A raw-HTTP import may carry an explicit inline waiver —
-    ``# tracing-exempt: <reason>`` on the import line — for hops where
-    Traceparent injection is genuinely wrong (e.g. streaming uploads to
-    EXTERNAL third-party services, which must not receive our internal
-    trace headers).  The waiver makes the exception deliberate and
-    greppable instead of silent."""
-    try:
-        tree = ast.parse(src, filename=path)
-    except SyntaxError as e:
-        return [f"{path}:{e.lineno or 0}: does not parse: {e.msg}"]
-    lines = src.splitlines()
-
-    def waived(lineno: int) -> bool:
-        line = lines[lineno - 1] if 0 < lineno <= len(lines) else ""
-        return "tracing-exempt" in line
-
-    problems: list[str] = []
-    for node in ast.walk(tree):
-        if isinstance(node, (ast.Import, ast.ImportFrom)) \
-                and waived(node.lineno):
-            continue
-        if isinstance(node, ast.Import):
-            for alias in node.names:
-                if alias.name in RAW_HTTP_MODULES:
-                    problems.append(
-                        f"{path}:{node.lineno}: raw `import "
-                        f"{alias.name}` — outbound HTTP must go "
-                        f"through utils.httpd helpers so the "
-                        f"Traceparent header propagates")
-        elif isinstance(node, ast.ImportFrom):
-            mod = node.module or ""
-            if mod in RAW_HTTP_MODULES or \
-                    (mod == "urllib"
-                     and any(a.name == "request" for a in node.names)) or \
-                    (mod == "http"
-                     and any(a.name == "client" for a in node.names)):
-                problems.append(
-                    f"{path}:{node.lineno}: raw HTTP client import "
-                    f"(`from {mod} import ...`) — outbound HTTP must "
-                    f"go through utils.httpd helpers so the "
-                    f"Traceparent header propagates")
-        elif isinstance(node, ast.ClassDef):
-            router_base = any(
-                (isinstance(b, ast.Name) and b.id == "Router")
-                or (isinstance(b, ast.Attribute) and b.attr == "Router")
-                for b in node.bases)
-            if not router_base:
-                continue
-            for item in node.body:
-                if isinstance(item, (ast.FunctionDef,
-                                     ast.AsyncFunctionDef)) \
-                        and item.name == "dispatch":
-                    problems.append(
-                        f"{path}:{item.lineno}: Router subclass "
-                        f"overrides dispatch() — the request span and "
-                        f"trace-context restore live there; override "
-                        f"hooks instead")
-    return problems
-
-
-def _read(path: str) -> str:
-    with open(path, encoding="utf-8", errors="replace") as f:
-        return f.read()
+    return _strs(_pkg(src, path))
 
 
 def check_repo(root: str) -> list[str]:
-    problems: list[str] = []
-    httpd = os.path.join(root, HTTPD_REL)
-    framing = os.path.join(root, FRAMING_REL)
-    if os.path.exists(httpd):
-        problems.extend(check_httpd_source(_read(httpd), HTTPD_REL))
-    else:
-        problems.append(f"{HTTPD_REL}:0: missing")
-    if os.path.exists(framing):
-        problems.extend(check_framing_source(_read(framing), FRAMING_REL))
-    else:
-        problems.append(f"{FRAMING_REL}:0: missing")
-    pkg_root = os.path.join(root, PACKAGE)
-    for dirpath, dirnames, filenames in os.walk(pkg_root):
-        dirnames[:] = sorted(d for d in dirnames if d not in SKIP_DIRS)
-        for name in sorted(filenames):
-            if not name.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, name)
-            rel = os.path.relpath(path, root)
-            if rel in (HTTPD_REL,):  # the sole allowed raw-HTTP user
-                continue
-            problems.extend(check_package_source(_read(path), rel))
-    return problems
+    return _strs(get_rule("W201").check(Repo(root)))
 
 
 def main(argv: list[str]) -> int:

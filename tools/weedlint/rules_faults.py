@@ -1,0 +1,132 @@
+"""W701: fault-point names live in ONE registry, and each is tested.
+
+The fault-injection framework (utils/faultinject.py) is only as good
+as its names: a `hit("ec.dran")` typo silently never fires, an armed
+point nobody instruments silently never injects, and a registered
+point no chaos drill exercises is recovery code that has never once
+run.  This rule pins all three directions against the central
+FAULT_POINTS registry:
+
+  1. every `faultinject.hit("name")` / `corrupt_block("name", ...)`
+     site in the package names a registered fault point;
+  2. every registered fault point has at least one instrumented site;
+  3. every registered fault point is exercised by at least one test
+     (its quoted name appears in tests/ — arming via enable/scoped or
+     asserting via fired()).
+
+The registry is read from the AST (no package import needed), so the
+rule also works on a checkout whose heavy deps are absent.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from .engine import Finding, Repo, Rule, register
+
+PACKAGE = "seaweedfs_tpu"
+FAULTINJECT_REL = os.path.join(PACKAGE, "utils", "faultinject.py")
+
+
+def load_registry(src: str) -> tuple[dict[str, int], int]:
+    """FAULT_POINTS from faultinject.py source -> ({name: lineno},
+    dict lineno).  Empty when the registry is missing (finding)."""
+    try:
+        tree = ast.parse(src)
+    except SyntaxError:
+        return {}, 0
+    for node in ast.walk(tree):
+        targets = node.targets if isinstance(node, ast.Assign) else (
+            [node.target] if isinstance(node, ast.AnnAssign) else [])
+        if any(isinstance(t, ast.Name) and t.id == "FAULT_POINTS"
+               for t in targets):
+            if isinstance(node.value, ast.Dict):
+                out = {}
+                for k in node.value.keys:
+                    if isinstance(k, ast.Constant) and \
+                            isinstance(k.value, str):
+                        out[k.value] = k.lineno
+                return out, node.lineno
+    return {}, 0
+
+
+def hit_sites(src: str, path: str, tree=None) -> list[tuple[str, int]]:
+    """(fault name, lineno) for every hit()/corrupt_block() literal."""
+    if tree is None:
+        try:
+            tree = ast.parse(src, filename=path)
+        except SyntaxError:
+            return []
+    out: list[tuple[str, int]] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        name = f.id if isinstance(f, ast.Name) else (
+            f.attr if isinstance(f, ast.Attribute) else "")
+        if name in ("hit", "corrupt_block") and node.args and \
+                isinstance(node.args[0], ast.Constant) and \
+                isinstance(node.args[0].value, str):
+            out.append((node.args[0].value, node.lineno))
+    return out
+
+
+def check_registry(registry: dict[str, int], registry_line: int,
+                   sites: list[tuple[str, int, str]],
+                   tests_text: str) -> list[Finding]:
+    """The three-direction consistency check, tables-as-arguments so
+    tests can feed planted drift.  `sites` is (name, lineno, path)."""
+    findings: list[Finding] = []
+    if not registry:
+        return [Finding(
+            "W701", FAULTINJECT_REL, registry_line,
+            "FAULT_POINTS registry missing or empty — every fault "
+            "point must be centrally registered with a description")]
+    site_names = {name for name, _ln, _p in sites}
+    for name, lineno, path in sites:
+        if name not in registry:
+            findings.append(Finding(
+                "W701", path, lineno,
+                f"fault point {name!r} is not in the FAULT_POINTS "
+                f"registry (utils/faultinject.py) — a typo here would "
+                f"silently never fire",
+                "register it with a one-line description"))
+    for name in sorted(registry):
+        if name not in site_names:
+            findings.append(Finding(
+                "W701", FAULTINJECT_REL, registry[name],
+                f"registered fault point {name!r} has no "
+                f"hit()/corrupt_block() site in the package — it can "
+                f"never inject",
+                "instrument the site or delete the registry entry"))
+        if f'"{name}"' not in tests_text and \
+                f"'{name}'" not in tests_text:
+            findings.append(Finding(
+                "W701", FAULTINJECT_REL, registry[name],
+                f"registered fault point {name!r} is not exercised by "
+                f"any test under tests/ — its recovery path has never "
+                f"run",
+                "add a chaos drill arming it (faultinject.enable/"
+                "scoped)"))
+    return findings
+
+
+@register
+class FaultRegistryRule(Rule):
+    id = "W701"
+    name = "fault-registry"
+    summary = ("faultinject.hit() names must be registered in "
+               "FAULT_POINTS and each registered point test-exercised")
+
+    def check(self, repo: Repo) -> list[Finding]:
+        fi = repo.get(FAULTINJECT_REL)
+        if fi is None:
+            return [Finding("W701", FAULTINJECT_REL, 0, "missing")]
+        registry, reg_line = load_registry(fi.source)
+        sites: list[tuple[str, int, str]] = []
+        for ctx in repo.package_files(PACKAGE):
+            for name, lineno in hit_sites(ctx.source, ctx.rel, ctx.tree):
+                sites.append((name, lineno, ctx.rel))
+        tests_text = "\n".join(t.source for t in repo.test_files())
+        return check_registry(registry, reg_line, sites, tests_text)

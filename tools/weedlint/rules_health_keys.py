@@ -1,0 +1,153 @@
+"""W401: the four degraded-signal tables must agree.
+
+Ported from tools/check_health_keys.py (PR 9).  Four tables describe
+"what counts as degraded" and they MUST stay consistent:
+
+  stats/aggregate.py        HEALTH_FAMILIES       /cluster/health keys
+  observability/analysis.py DEGRADE_COUNTER_KEYS  analyzer verdict
+  observability/events.py   EVENT_TYPES + HEALTH_EVENT_TYPES
+  observability/alerts.py   default_rules()       what actually pages
+
+check_tables() takes the tables as ARGUMENTS so tests can feed
+synthetically drifted tables and prove each consistency rule catches.
+The repo rule imports the live tables (the lint runs in-process, like
+the tier-1 test always has).
+"""
+
+from __future__ import annotations
+
+import os
+
+from .engine import Finding, Repo, Rule, register
+
+EVENTS_REL = os.path.join("seaweedfs_tpu", "observability", "events.py")
+
+# HEALTH_FAMILIES keys that legitimately stay OUT of
+# DEGRADE_COUNTER_KEYS: a degraded TCP bind means a server came up
+# without its fast plane — operationally alertable, but it does not
+# make a pipeline MEASUREMENT degraded.
+DEGRADE_KEY_ALLOWLIST = ("degraded_binds",)
+
+# DEGRADE_COUNTER_KEYS entries that are per-run encode stats rather
+# than cluster counter families.
+PER_RUN_ONLY_KEYS = ("retries", "fallbacks")
+
+
+def check_tables(health_families: dict, degrade_keys: tuple,
+                 rules: list, event_types: dict,
+                 health_event_types: dict,
+                 extra_health_keys: tuple = ("scrub_unrepairable",),
+                 allowlist: tuple = DEGRADE_KEY_ALLOWLIST,
+                 per_run_only: tuple = PER_RUN_ONLY_KEYS) -> list[str]:
+    """Human-readable violations (empty = consistent)."""
+    v: list[str] = []
+    health_keys = set(health_families)
+
+    # 1. every health key maps to a registered journal event type
+    for key in sorted(health_keys):
+        etype = health_event_types.get(key)
+        if not etype:
+            v.append(f"HEALTH_FAMILIES key {key!r} has no event type in "
+                     "events.HEALTH_EVENT_TYPES — its degraded moments "
+                     "would never reach the journal")
+        elif etype not in event_types:
+            v.append(f"HEALTH_EVENT_TYPES maps {key!r} -> {etype!r} "
+                     "which is not registered in events.EVENT_TYPES")
+    for key in sorted(health_event_types):
+        if key not in health_keys:
+            v.append(f"HEALTH_EVENT_TYPES covers {key!r} which is not "
+                     "a HEALTH_FAMILIES key (stale mapping)")
+
+    # 2. every health key (minus the allowlist) degrades analyzer runs
+    for key in sorted(health_keys - set(allowlist)):
+        if key not in degrade_keys:
+            v.append(f"HEALTH_FAMILIES key {key!r} missing from "
+                     "analysis.DEGRADE_COUNTER_KEYS — a run that "
+                     "tripped it would still read clean")
+    for key in degrade_keys:
+        if key in per_run_only:
+            continue
+        if key not in health_keys:
+            v.append(f"DEGRADE_COUNTER_KEYS entry {key!r} is not a "
+                     "HEALTH_FAMILIES key (and not a documented "
+                     "per-run stat) — /cluster/health would never "
+                     "carry it")
+
+    # 3. every health key is watched by a default counter rule
+    watched = {r.params.get("key") for r in rules
+               if getattr(r, "kind", "") == "counter_increase"}
+    for key in sorted(health_keys):
+        if key not in watched:
+            v.append(f"HEALTH_FAMILIES key {key!r} has no default "
+                     "counter_increase alert rule — it would degrade "
+                     "/cluster/health without ever paging")
+
+    # 4. every rule that names a health key names a REAL one
+    legal = health_keys | set(extra_health_keys)
+    for r in rules:
+        kind = getattr(r, "kind", "")
+        key = (getattr(r, "params", None) or {}).get("key")
+        if kind in ("counter_increase", "threshold") and key not in legal:
+            v.append(f"alert rule {getattr(r, 'name', '?')!r} watches "
+                     f"unknown health key {key!r}")
+
+    # 5. the alert lifecycle's own event types exist
+    for etype in ("alert_pending", "alert_fired", "alert_resolved"):
+        if etype not in event_types:
+            v.append(f"event type {etype!r} missing from EVENT_TYPES — "
+                     "alert transitions would journal as unregistered "
+                     "types")
+
+    # 6. a counter rule's severity must match its event type's —
+    #    EVENT_TYPES is the ONE severity table
+    for r in rules:
+        if getattr(r, "kind", "") != "counter_increase":
+            continue
+        key = (getattr(r, "params", None) or {}).get("key")
+        etype = health_event_types.get(key or "")
+        want = event_types.get(etype or "")
+        got = getattr(r, "severity", None)
+        if want and got != want:
+            v.append(f"alert rule {getattr(r, 'name', '?')!r} severity "
+                     f"{got!r} disagrees with EVENT_TYPES[{etype!r}] = "
+                     f"{want!r}")
+    return v
+
+
+def check_live_tables() -> list[str]:
+    """The real tables, imported live."""
+    from seaweedfs_tpu.observability.alerts import (EXTRA_HEALTH_KEYS,
+                                                    default_rules)
+    from seaweedfs_tpu.observability.analysis import DEGRADE_COUNTER_KEYS
+    from seaweedfs_tpu.observability.events import (EVENT_TYPES,
+                                                    HEALTH_EVENT_TYPES)
+    from seaweedfs_tpu.stats.aggregate import HEALTH_FAMILIES
+
+    return check_tables(HEALTH_FAMILIES, DEGRADE_COUNTER_KEYS,
+                        default_rules(), EVENT_TYPES,
+                        HEALTH_EVENT_TYPES,
+                        extra_health_keys=EXTRA_HEALTH_KEYS)
+
+
+@register
+class HealthKeysRule(Rule):
+    id = "W401"
+    name = "health-keys"
+    summary = ("HEALTH_FAMILIES / DEGRADE_COUNTER_KEYS / EVENT_TYPES / "
+               "default alert rules must stay mutually consistent")
+    hint = ("add the key to every table (aggregate.py, analysis.py, "
+            "events.py, alerts.default_rules) or to the documented "
+            "allowlists")
+
+    def check(self, repo: Repo) -> list[Finding]:
+        if repo.get(EVENTS_REL) is None:
+            # a tree without the observability stack (mini test repos,
+            # partial checkouts) has no tables to cross-check — and
+            # importing a foreign `seaweedfs_tpu` from such a root
+            # would poison sys.modules for the whole process
+            return []
+        import sys
+        if repo.root not in sys.path:  # the repo under lint must win
+            sys.path.insert(0, repo.root)
+        return [self.finding(EVENTS_REL, 0, msg)
+                for msg in check_live_tables()]

@@ -1,0 +1,470 @@
+"""W501/W502: lockset thread-safety checking for annotated classes.
+
+Every lock-discipline bug this repo has shipped (`_sup_lock`
+serialization in PR 7, scrubber verdict locking in PR 5, emit-time
+server stamping in PR 9) was caught by manual review AFTER the fact.
+This rule machine-checks the discipline, Go-race-detector style but
+static and annotation-driven:
+
+Annotations (plain comments, greppable, zero runtime cost):
+
+  self._x = {}        # guarded-by: _lock
+      declares that attribute `_x` of this class is protected by
+      `self._lock`.  Put it on an assignment to the attribute
+      (conventionally the __init__ site).
+
+  def _helper(self):  # holds: _lock
+      declares the method's CONTRACT is "called with self._lock held"
+      (the `*_locked` name suffix declares the same thing).  Its body
+      is checked as if the lock were held.
+
+  def _on_event(...):  # thread-entry
+      declares the method is invoked on other threads (hook callbacks,
+      executor jobs the checker cannot see).  Methods passed to
+      `threading.Thread(target=...)` / `Timer` / `.submit(...)` inside
+      the class are discovered automatically.
+
+  class Foo:  # weedlint: concurrent-class
+      declares every public method may be called concurrently (server
+      state reached from the threaded HTTP router).  Each public
+      method becomes its own thread root.
+
+Model: each thread entry is a ROOT; all public methods form one
+synthetic "external caller" root (unless concurrent-class splits them).
+The per-class call graph (self.m() calls and `self.m` references)
+gives which roots reach which methods.  `__init__`/`__del__` are
+exempt (happens-before construction / teardown).
+
+W501 fires on a read or write of a guarded attribute that is not
+lexically inside `with self.<lock>` (and not in a holds:-annotated
+method), when the access can actually race: its method is reachable
+from ≥ 2 roots, or the attribute is also touched from a different
+root.  Code inside nested functions is checked WITHOUT the enclosing
+`with` (a closure may run on another thread after the lock is
+dropped).
+
+W502 fires when a class that has thread entries at all performs a
+NAKED mutation — no lock held lexically or by holds:/`*_locked`
+contract — of an attribute that carries no `guarded-by:` annotation
+(outside __init__, in a root-reachable method).  Self-synchronizing
+attributes (Lock/Event/Queue/Thread/... constructions) are exempt.
+The point is to force every shared mutable field to either name its
+lock or carry an explicit waiver saying why it needs none.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Optional
+
+from .engine import Finding, Repo, Rule, register
+
+PACKAGE = "seaweedfs_tpu"
+
+_GUARDED_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z_][A-Za-z0-9_]*)")
+_HOLDS_RE = re.compile(r"#\s*holds:\s*([A-Za-z_][A-Za-z0-9_]*)")
+_THREAD_ENTRY_RE = re.compile(r"#\s*thread-entry\b")
+_CONCURRENT_RE = re.compile(r"#\s*weedlint:\s*concurrent-class\b")
+
+# constructions whose instances synchronize themselves — mutating
+# THROUGH them is safe, and rebinding them outside __init__ is rare
+# enough to exempt.  Thread/Timer cover the conventional `self._thread
+# = Thread(...)` management attribute itself.
+_SYNC_PRIMITIVES = {
+    "Lock", "RLock", "Event", "Condition", "Semaphore",
+    "BoundedSemaphore", "Barrier", "Queue", "LifoQueue",
+    "PriorityQueue", "SimpleQueue", "ThreadPoolExecutor", "local",
+    "Thread", "Timer",
+}
+
+EXTERNAL_ROOT = "<external>"
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    """`self.X` -> "X", else None."""
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+class _ClassModel:
+    """Everything the lockset needs about one class."""
+
+    def __init__(self, node: ast.ClassDef, lines: list[str]):
+        self.node = node
+        self.lines = lines
+        self.name = node.name
+        self.methods: dict[str, ast.AST] = {}
+        for item in node.body:
+            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.methods[item.name] = item
+        self.concurrent = self._line_has(_CONCURRENT_RE, node.lineno)
+        self.guards = self._collect_guards()     # attr -> lock name
+        self.sync_attrs = self._collect_sync_attrs()
+        self.thread_entries = self._collect_thread_entries()
+        self.edges = self._call_graph()
+        self.roots = self._compute_roots()
+        self.method_roots = self._reachability()
+        self.attr_roots = self._attr_root_spans()
+
+    # --- annotation parsing ----------------------------------------------
+    def _line_has(self, rx: re.Pattern, lineno: int) -> bool:
+        line = self.lines[lineno - 1] if 0 < lineno <= len(self.lines) \
+            else ""
+        return rx.search(line) is not None
+
+    def _collect_guards(self) -> dict[str, str]:
+        guards: dict[str, str] = {}
+        for sub in ast.walk(self.node):
+            if not isinstance(sub, (ast.Assign, ast.AnnAssign,
+                                    ast.AugAssign)):
+                continue
+            line = self.lines[sub.lineno - 1] \
+                if 0 < sub.lineno <= len(self.lines) else ""
+            m = _GUARDED_RE.search(line)
+            if m is None:
+                continue
+            targets = sub.targets if isinstance(sub, ast.Assign) \
+                else [sub.target]
+            for t in targets:
+                attr = _self_attr(t)
+                if attr is not None:
+                    guards[attr] = m.group(1)
+        return guards
+
+    def _collect_sync_attrs(self) -> set[str]:
+        out: set[str] = set()
+        for sub in ast.walk(self.node):
+            if not isinstance(sub, (ast.Assign, ast.AnnAssign)):
+                continue
+            value = sub.value
+            if not isinstance(value, ast.Call):
+                continue
+            f = value.func
+            name = f.id if isinstance(f, ast.Name) else (
+                f.attr if isinstance(f, ast.Attribute) else "")
+            if name not in _SYNC_PRIMITIVES:
+                continue
+            targets = sub.targets if isinstance(sub, ast.Assign) \
+                else [sub.target]
+            for t in targets:
+                attr = _self_attr(t)
+                if attr is not None:
+                    out.add(attr)
+        return out
+
+    def _collect_thread_entries(self) -> set[str]:
+        """Methods that run on other threads: Thread/Timer targets,
+        executor submissions, and `# thread-entry` annotations."""
+        entries: set[str] = set()
+        for sub in ast.walk(self.node):
+            if not isinstance(sub, ast.Call):
+                continue
+            f = sub.func
+            fname = f.id if isinstance(f, ast.Name) else (
+                f.attr if isinstance(f, ast.Attribute) else "")
+            if fname in ("Thread", "Timer"):
+                for kw in sub.keywords:
+                    if kw.arg == "target":
+                        attr = _self_attr(kw.value)
+                        if attr in self.methods:
+                            entries.add(attr)
+                # Timer(interval, self.m)
+                for a in sub.args:
+                    attr = _self_attr(a)
+                    if attr in self.methods:
+                        entries.add(attr)
+            elif fname == "submit" and sub.args:
+                attr = _self_attr(sub.args[0])
+                if attr in self.methods:
+                    entries.add(attr)
+        for name, fn in self.methods.items():
+            if self._line_has(_THREAD_ENTRY_RE, fn.lineno):
+                entries.add(name)
+        return entries
+
+    # --- graph ------------------------------------------------------------
+    def _call_graph(self) -> dict[str, set[str]]:
+        """method -> other class methods it calls or references."""
+        edges: dict[str, set[str]] = {}
+        for name, fn in self.methods.items():
+            out: set[str] = set()
+            for sub in ast.walk(fn):
+                attr = _self_attr(sub)
+                if attr is not None and attr in self.methods \
+                        and attr != name:
+                    out.add(attr)
+            edges[name] = out
+        return edges
+
+    def _compute_roots(self) -> dict[str, set[str]]:
+        """root label -> the methods it enters at."""
+        roots: dict[str, set[str]] = {}
+        for m in self.thread_entries:
+            roots[f"thread:{m}"] = {m}
+        # a PUBLIC thread-entry method stays externally callable too
+        # (e.g. a journal emit() that is both the API and the hook), so
+        # it belongs to the caller root as well as its own thread root
+        public = {m for m in self.methods if not m.startswith("_")}
+        if self.concurrent:
+            for m in public:
+                roots[f"caller:{m}"] = {m}
+        elif public:
+            roots[EXTERNAL_ROOT] = public
+        return roots
+
+    def _reachability(self) -> dict[str, set[str]]:
+        """method -> set of root labels that can reach it."""
+        reach: dict[str, set[str]] = {m: set() for m in self.methods}
+        for label, starts in self.roots.items():
+            seen: set[str] = set()
+            stack = [s for s in starts if s in self.methods]
+            while stack:
+                m = stack.pop()
+                if m in seen:
+                    continue
+                seen.add(m)
+                stack.extend(self.edges.get(m, ()))
+            for m in seen:
+                reach[m].add(label)
+        return reach
+
+    def _attr_root_spans(self) -> dict[str, set[str]]:
+        """guarded attr -> union of roots over every method touching
+        it (the "can this access race with ANOTHER thread" test)."""
+        spans: dict[str, set[str]] = {a: set() for a in self.guards}
+        for name, fn in self.methods.items():
+            if name in ("__init__", "__del__"):
+                continue
+            for sub in ast.walk(fn):
+                attr = _self_attr(sub)
+                if attr in spans:
+                    spans[attr] |= self.method_roots.get(name, set())
+        return spans
+
+    # --- lock context -----------------------------------------------------
+    def held_at_entry(self, fn: ast.AST) -> set[str]:
+        held: set[str] = set()
+        line = self.lines[fn.lineno - 1] \
+            if 0 < fn.lineno <= len(self.lines) else ""
+        for m in _HOLDS_RE.finditer(line):
+            held.add(m.group(1))
+        if fn.name.endswith("_locked"):
+            # the repo's naming convention for called-with-lock-held
+            # helpers: treat as holding every lock the class guards
+            # with (plus a sentinel so the contract counts even before
+            # any attribute is annotated)
+            held.update(self.guards.values())
+            held.add("<locked-suffix>")
+        return held
+
+
+def _with_locks(node: ast.With) -> set[str]:
+    """Lock names acquired by `with self.<lock>:` items."""
+    out: set[str] = set()
+    for item in node.items:
+        attr = _self_attr(item.context_expr)
+        if attr is not None:
+            out.add(attr)
+    return out
+
+
+class _MethodChecker:
+    """Walk one method body tracking lexically-held locks."""
+
+    def __init__(self, model: _ClassModel, mname: str, path: str):
+        self.model = model
+        self.mname = mname
+        self.path = path
+        self.reads: list[tuple[str, int, frozenset]] = []
+        self.writes: list[tuple[str, int, frozenset]] = []
+
+    def run(self) -> None:
+        fn = self.model.methods[self.mname]
+        held = frozenset(self.model.held_at_entry(fn))
+        for stmt in getattr(fn, "body", []):
+            self._walk(stmt, held, top=True)
+
+    def _walk(self, node: ast.AST, held: frozenset, top: bool) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)) and not top:
+            # a nested function may execute on another thread after the
+            # enclosing `with` released the lock: check it lock-free
+            for child in ast.iter_child_nodes(node):
+                self._walk(child, frozenset(), top=False)
+            return
+        if isinstance(node, ast.With):
+            inner = held | _with_locks(node)
+            for item in node.items:
+                self._walk(item.context_expr, held, top=False)
+                if item.optional_vars is not None:
+                    self._walk(item.optional_vars, held, top=False)
+            for stmt in node.body:
+                self._walk(stmt, inner, top=False)
+            return
+        # record attribute touches; store-vs-load from ctx
+        attr = _self_attr(node)
+        if attr is not None:
+            if isinstance(node.ctx, (ast.Store, ast.Del)):
+                self.writes.append((attr, node.lineno, held))
+            else:
+                # a Load that feeds a Subscript-store or mutating call
+                # is still an access; reads and writes are flagged the
+                # same way by W501, so Load is enough here
+                self.reads.append((attr, node.lineno, held))
+        for child in ast.iter_child_nodes(node):
+            self._walk(child, held, top=False)
+
+    def mutation_lines(self) -> list[tuple[str, int, frozenset]]:
+        """Writes PLUS container mutations (`self.x[k] = v`,
+        `self.x += 1` already lands in writes via Store ctx on the
+        attribute for AugAssign? no — AugAssign target has Store ctx,
+        so it is in writes; subscript stores show the attribute as a
+        Load, handled here)."""
+        fn = self.model.methods[self.mname]
+        out = list(self.writes)
+        held_map = {(a, ln): h for a, ln, h in self.reads}
+        for sub in ast.walk(fn):
+            target = None
+            if isinstance(sub, (ast.Assign,)):
+                targets = sub.targets
+            elif isinstance(sub, (ast.AugAssign,)):
+                targets = [sub.target]
+            else:
+                continue
+            for t in targets:
+                if isinstance(t, ast.Subscript):
+                    target = _self_attr(t.value)
+                    if target is not None:
+                        held = held_map.get((target, t.value.lineno),
+                                            frozenset())
+                        out.append((target, sub.lineno, held))
+        return out
+
+
+def check_class_source(src: str, path: str,
+                       tree: Optional[ast.AST] = None) -> list[Finding]:
+    """Both lockset rules over every class in one module's source (the
+    unit the synthetic-class tests drive)."""
+    if tree is None:
+        try:
+            tree = ast.parse(src, filename=path)
+        except SyntaxError:
+            return []  # W101 owns parse errors
+    lines = src.splitlines()
+    findings: list[Finding] = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            findings.extend(_check_class(node, lines, path))
+    return findings
+
+
+def _check_class(node: ast.ClassDef, lines: list[str],
+                 path: str) -> list[Finding]:
+    model = _ClassModel(node, lines)
+    multi_threaded = bool(model.thread_entries) or model.concurrent
+    if not model.guards and not multi_threaded:
+        return []
+    findings: list[Finding] = []
+    for mname in model.methods:
+        if mname in ("__init__", "__del__"):
+            continue
+        roots = model.method_roots.get(mname, set())
+        if not roots:
+            continue  # dead/never-reached helper: nothing to race with
+        checker = _MethodChecker(model, mname, path)
+        checker.run()
+        # --- W501: guarded attr touched without its lock ---------------
+        seen_lines: set[tuple[str, int]] = set()
+        for attr, lineno, held in checker.reads + checker.writes:
+            lock = model.guards.get(attr)
+            if lock is None or lock in held:
+                continue
+            # can it actually race?  method reachable from 2+ roots, or
+            # the attribute is also touched from some OTHER root
+            other = model.attr_roots.get(attr, set()) - roots
+            if len(roots) < 2 and not other:
+                continue
+            if (attr, lineno) in seen_lines:
+                continue
+            seen_lines.add((attr, lineno))
+            findings.append(Finding(
+                "W501", path, lineno,
+                f"{model.name}.{mname} touches self.{attr} "
+                f"(guarded-by: {lock}) outside `with self.{lock}` — "
+                f"reachable from {_fmt_roots(roots)}",
+                f"wrap in `with self.{lock}:`, or mark the method "
+                f"`# holds: {lock}` if every caller already holds it"))
+        # --- W502: unannotated NAKED mutation in a threaded class ------
+        # a mutation under SOME self.<lock> (lexically, or via a
+        # holds:/’_locked’ contract) is at least deliberate — the rule
+        # hunts naked writes to fields nobody has claimed a lock for
+        if not multi_threaded:
+            continue
+        seen_w2: set[tuple[str, int]] = set()
+        for attr, lineno, held in checker.mutation_lines():
+            if attr in model.guards or attr in model.sync_attrs:
+                continue
+            if held:
+                continue
+            if (attr, lineno) in seen_w2:
+                continue
+            seen_w2.add((attr, lineno))
+            findings.append(Finding(
+                "W502", path, lineno,
+                f"{model.name}.{mname} mutates self.{attr} but the "
+                f"class has thread entries "
+                f"({', '.join(sorted(model.thread_entries)) or 'concurrent-class'}) "
+                f"and self.{attr} carries no `# guarded-by:` annotation",
+                "annotate the attribute with its lock, or waive with "
+                "a reason if it is genuinely single-threaded"))
+    return findings
+
+
+def _fmt_roots(roots: set[str]) -> str:
+    return " + ".join(sorted(roots))
+
+
+def _cached_findings(ctx) -> list[Finding]:
+    """Both lockset rules share one pass per file (the engine's cached
+    parse, one class-model build)."""
+    cache = getattr(ctx, "_lockset_findings", None)
+    if cache is None:
+        tree = ctx.tree
+        cache = [] if tree is None else \
+            check_class_source(ctx.source, ctx.rel, tree=tree)
+        ctx._lockset_findings = cache
+    return cache
+
+
+@register
+class LocksetRule(Rule):
+    id = "W501"
+    name = "lockset-guarded"
+    summary = ("`# guarded-by: <lock>` attributes must be accessed "
+               "inside `with self.<lock>` in multi-thread-reachable "
+               "methods")
+
+    def check(self, repo: Repo) -> list[Finding]:
+        out: list[Finding] = []
+        for ctx in repo.package_files(PACKAGE):
+            out.extend(f for f in _cached_findings(ctx)
+                       if f.rule == "W501")
+        return out
+
+
+@register
+class UnannotatedMutationRule(Rule):
+    id = "W502"
+    name = "lockset-unannotated"
+    summary = ("classes with thread entries must annotate every "
+               "mutated attribute with `# guarded-by:` (or waive)")
+
+    def check(self, repo: Repo) -> list[Finding]:
+        out: list[Finding] = []
+        for ctx in repo.package_files(PACKAGE):
+            out.extend(f for f in _cached_findings(ctx)
+                       if f.rule == "W502")
+        return out

@@ -1,0 +1,118 @@
+"""W101: reject code the py3.10 deployment runtime cannot import.
+
+Ported from the standalone tools/check_py310.py (PR 4).  The deployment
+container runs Python 3.10 — no PEP-701 nested same-quote f-strings, no
+tomllib, no datetime.UTC.  A single 3.12-only construct in a widely-
+imported module silently collection-errors every test that imports it
+(the seed shipped exactly that in volume_server/server.py).
+
+Checks, per .py file in the repo:
+  - parses as py3.10 syntax (ast.parse feature_version=(3, 10));
+  - `import tomllib` only inside an ImportError-catching try or a
+    sys.version_info gate;
+  - `from datetime import UTC` / `datetime.UTC` under the same rule.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .engine import Finding, Repo, Rule, register
+
+TARGET = (3, 10)
+BANNED_MODULES = {"tomllib"}
+_IMPORT_ERRORS = {"ImportError", "ModuleNotFoundError", "Exception",
+                  "BaseException"}
+
+
+def _is_gate(node: ast.AST) -> bool:
+    """A node whose body may legally contain target-incompatible
+    imports: a try with an except arm catching ImportError (or wider),
+    or an `if` test mentioning sys.version_info."""
+    if isinstance(node, ast.Try):
+        for h in node.handlers:
+            if h.type is None:
+                return True
+            names = []
+            t = h.type
+            for el in (t.elts if isinstance(t, ast.Tuple) else [t]):
+                if isinstance(el, ast.Name):
+                    names.append(el.id)
+                elif isinstance(el, ast.Attribute):
+                    names.append(el.attr)
+            if _IMPORT_ERRORS & set(names):
+                return True
+        return False
+    if isinstance(node, ast.If):
+        for sub in ast.walk(node.test):
+            if isinstance(sub, ast.Attribute) and \
+                    sub.attr == "version_info":
+                return True
+    return False
+
+
+def check_source(src: str, path: str) -> list[Finding]:
+    """Problems found in one file's source (the unit the planted-
+    violation tests drive)."""
+    try:
+        tree = ast.parse(src, filename=path, feature_version=TARGET)
+    except SyntaxError as e:
+        return [Finding("W101", path, e.lineno or 0,
+                        f"does not parse as py{TARGET[0]}.{TARGET[1]} "
+                        f"syntax: {e.msg}")]
+    problems: list[Finding] = []
+
+    def visit(node: ast.AST, gated: bool) -> None:
+        gated = gated or _is_gate(node)
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                root = alias.name.split(".")[0]
+                if root in BANNED_MODULES and not gated:
+                    problems.append(Finding(
+                        "W101", path, node.lineno,
+                        f"ungated `import {alias.name}` ({root} does "
+                        f"not exist on py{TARGET[0]}.{TARGET[1]})",
+                        "wrap in try/except ImportError or a "
+                        "sys.version_info gate"))
+        elif isinstance(node, ast.ImportFrom):
+            mod = (node.module or "").split(".")[0]
+            if mod in BANNED_MODULES and not gated:
+                problems.append(Finding(
+                    "W101", path, node.lineno,
+                    f"ungated `from {node.module} import ...` ({mod} "
+                    f"does not exist on py{TARGET[0]}.{TARGET[1]})",
+                    "wrap in try/except ImportError or a "
+                    "sys.version_info gate"))
+            if mod == "datetime" and not gated and \
+                    any(a.name == "UTC" for a in node.names):
+                problems.append(Finding(
+                    "W101", path, node.lineno,
+                    "ungated `from datetime import UTC` (py3.11+ "
+                    "only)", "use timezone.utc"))
+        elif isinstance(node, ast.Attribute):
+            if node.attr == "UTC" and not gated and \
+                    isinstance(node.value, ast.Name) and \
+                    node.value.id == "datetime":
+                problems.append(Finding(
+                    "W101", path, node.lineno,
+                    "ungated `datetime.UTC` (py3.11+ only)",
+                    "use datetime.timezone.utc"))
+        for child in ast.iter_child_nodes(node):
+            visit(child, gated)
+
+    visit(tree, False)
+    return problems
+
+
+@register
+class Py310Rule(Rule):
+    id = "W101"
+    name = "py310-compat"
+    summary = ("code must import on the py3.10 runtime (syntax, "
+               "tomllib, datetime.UTC)")
+
+    def check(self, repo: Repo) -> list[Finding]:
+        out: list[Finding] = []
+        for ctx in repo.files():
+            out.extend(check_source(ctx.source, ctx.rel))
+        return out

@@ -1,0 +1,183 @@
+"""W801: ec/ resources must be released on every path.
+
+The EC pipelines juggle SharedMemory slabs, mmap views and shard file
+handles across threads and processes.  A leaked /dev/shm slab survives
+the process; a leaked mmap keeps a BufferError landmine armed; a
+leaked fd on a 14-shard encode multiplies fast.  The discipline the
+code review keeps re-enforcing by hand:
+
+    every `open(...)` / `mmap.mmap(...)` / `SharedMemory(...)` in
+    seaweedfs_tpu/ec/ must either
+      - be the context expression of a `with` statement, or
+      - be assigned to `self.<attr>` (object-lifetime managed: the
+        owning class's close() is responsible), or
+      - flow into a name (or a list the call's result is append()ed
+        to) that is referenced inside a `finally:` or `except:` block
+        of the same function — a release that runs on the failure
+        path, not just the happy one.
+
+Module-level and test code is out of scope; only ec/ is checked (the
+resource-density there earns the strictness).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Optional
+
+from .engine import Finding, Repo, Rule, register
+
+EC_PREFIX = os.path.join("seaweedfs_tpu", "ec") + os.sep
+
+
+def _acquire_kind(node: ast.Call) -> Optional[str]:
+    f = node.func
+    if isinstance(f, ast.Name) and f.id in ("open", "SharedMemory"):
+        return f.id
+    if isinstance(f, ast.Attribute) and f.attr in ("mmap", "SharedMemory"):
+        # mmap_mod.mmap(...) / shared_memory.SharedMemory(...); method
+        # calls like worker.open(...) are NOT builtin open and are
+        # excluded by the Name check above
+        return f.attr
+    return None
+
+
+def _cleanup_names(fn: ast.AST) -> set[str]:
+    """Names referenced anywhere inside a finally: or except: block of
+    this function (its release-on-failure surface)."""
+    names: set[str] = set()
+
+    def collect(stmts) -> None:
+        for stmt in stmts:
+            for sub in ast.walk(stmt):
+                if isinstance(sub, ast.Name):
+                    names.add(sub.id)
+                elif isinstance(sub, ast.Attribute):
+                    names.add(sub.attr)
+
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Try):
+            collect(node.finalbody)
+            for h in node.handlers:
+                collect(h.body)
+    return names
+
+
+def _outermost_functions(tree: ast.AST):
+    """Module-level functions and class methods — NOT nested closures:
+    a nested helper's acquires are judged against the whole enclosing
+    function (its finally blocks release what the closures acquire,
+    e.g. the mmap-encode's lazy parity mappings)."""
+    stack = [tree]
+    while stack:
+        node = stack.pop()
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield child
+            elif isinstance(child, (ast.Module, ast.ClassDef)):
+                stack.append(child)
+            elif isinstance(child, (ast.If, ast.Try)):
+                stack.append(child)  # conditionally-defined functions
+
+
+def check_module_source(src: str, path: str, tree=None) -> list[Finding]:
+    if tree is None:
+        try:
+            tree = ast.parse(src, filename=path)
+        except SyntaxError:
+            return []  # W101 owns parse errors
+    findings: list[Finding] = []
+    for fn in _outermost_functions(tree):
+        findings.extend(_check_function(fn, path))
+    return findings
+
+
+def _check_function(fn: ast.AST, path: str) -> list[Finding]:
+    cleanup = _cleanup_names(fn)
+    findings: list[Finding] = []
+    # contexts where an acquire call is fine without further analysis
+    with_exprs: set[int] = set()
+    bound_to: dict[int, Optional[str]] = {}  # id(call) -> bound name
+    aliases: dict[str, set[str]] = {}  # name -> lists it is append()ed to
+    for node in ast.walk(fn):
+        if isinstance(node, ast.With):
+            for item in node.items:
+                for sub in ast.walk(item.context_expr):
+                    if isinstance(sub, ast.Call):
+                        with_exprs.add(id(sub))
+        elif isinstance(node, ast.Assign):
+            target = node.targets[0]
+            calls: list[ast.Call] = []
+            if isinstance(node.value, ast.Call):
+                calls = [node.value]
+            elif isinstance(node.value, (ast.ListComp, ast.DictComp,
+                                         ast.SetComp, ast.GeneratorExp)):
+                # inputs = {i: open(...) for ...}: the handles live in
+                # the comp result, so the TARGET name is the handle
+                calls = [sub for sub in ast.walk(node.value)
+                         if isinstance(sub, ast.Call)]
+            for call in calls:
+                if isinstance(target, ast.Name):
+                    bound_to[id(call)] = target.id
+                elif isinstance(target, ast.Attribute) and \
+                        isinstance(target.value, ast.Name) and \
+                        target.value.id == "self":
+                    bound_to[id(call)] = None  # self.X: exempt
+        elif isinstance(node, ast.Call):
+            # list.append(open(...)) / list.append(handle_name) — the
+            # list carries the handle from then on
+            f = node.func
+            if isinstance(f, ast.Attribute) and f.attr == "append" \
+                    and isinstance(f.value, ast.Name) and node.args:
+                arg = node.args[0]
+                if isinstance(arg, ast.Call):
+                    bound_to[id(arg)] = f.value.id
+                elif isinstance(arg, ast.Name):
+                    aliases.setdefault(arg.id, set()).add(f.value.id)
+
+    for node in ast.walk(fn):
+        # nested functions are walked as part of the outer function
+        # too; that is fine — their cleanup blocks were collected the
+        # same way
+        if not isinstance(node, ast.Call):
+            continue
+        kind = _acquire_kind(node)
+        if kind is None:
+            continue
+        if id(node) in with_exprs:
+            continue
+        if id(node) in bound_to:
+            name = bound_to[id(node)]
+            if name is None:  # self.<attr>: the class owns the release
+                continue
+            if name in cleanup or aliases.get(name, set()) & cleanup:
+                continue
+        findings.append(Finding(
+            "W801", path, node.lineno,
+            f"`{kind}(...)` acquired without a release on all paths — "
+            f"not a `with` context, not owned by self, and its handle "
+            f"is never touched in a finally/except block of this "
+            f"function",
+            "use `with ...:`, or close/unlink the handle in a "
+            "finally: block"))
+    return findings
+
+
+@register
+class ResourceReleaseRule(Rule):
+    id = "W801"
+    name = "ec-resource-release"
+    summary = ("SharedMemory/mmap/open in ec/ must be released on all "
+               "paths (with-block, self-owned, or finally/except)")
+
+    def check(self, repo: Repo) -> list[Finding]:
+        out: list[Finding] = []
+        for ctx in repo.files():
+            if not ctx.rel.startswith(EC_PREFIX):
+                continue
+            tree = ctx.tree
+            if tree is None:
+                continue
+            out.extend(check_module_source(ctx.source, ctx.rel, tree))
+        return out

@@ -1,0 +1,125 @@
+"""W601: route handlers must answer 400, not 500, to malformed params.
+
+PR 9's hardening, now machine-checked across every Router subclass: a
+typo'd query parameter (`?limit=abc`) is the CLIENT's mistake.  An
+`int()` / `float()` over `req.query` that lets ValueError escape turns
+it into a 500 — which burns the error-ratio SLO budget the burn-rate
+alerts watch, so a curious operator with a bad curl line can page the
+on-call.
+
+The rule: inside any `@<router>.route(...)`-decorated handler, a call
+to `int(...)` or `float(...)` whose argument expression reads
+`.query` must be protected — lexically inside a `try` whose handlers
+catch ValueError/TypeError (or wider) — or replaced with the
+`utils.httpd.qint` / `qfloat` helpers, which raise HttpError(400)
+themselves.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .engine import Finding, Repo, Rule, register
+
+PACKAGE = "seaweedfs_tpu"
+
+_CATCHING = {"ValueError", "TypeError", "Exception", "BaseException",
+             "HttpError"}
+
+
+def _is_route_handler(fn: ast.AST) -> bool:
+    for dec in getattr(fn, "decorator_list", []):
+        call = dec if isinstance(dec, ast.Call) else None
+        target = call.func if call is not None else dec
+        if isinstance(target, ast.Attribute) and target.attr == "route":
+            return True
+    return False
+
+
+def _reads_query(node: ast.Call) -> bool:
+    for arg in list(node.args) + [kw.value for kw in node.keywords]:
+        for sub in ast.walk(arg):
+            if isinstance(sub, ast.Attribute) and sub.attr == "query":
+                return True
+    return False
+
+
+def _try_catches_value_error(node: ast.Try) -> bool:
+    for h in node.handlers:
+        if h.type is None:
+            return True
+        t = h.type
+        for el in (t.elts if isinstance(t, ast.Tuple) else [t]):
+            name = el.id if isinstance(el, ast.Name) else (
+                el.attr if isinstance(el, ast.Attribute) else "")
+            if name in _CATCHING:
+                return True
+    return False
+
+
+def check_module_source(src: str, path: str,
+                        tree=None) -> list[Finding]:
+    if tree is None:
+        try:
+            tree = ast.parse(src, filename=path)
+        except SyntaxError:
+            return []  # W101 owns parse errors
+    findings: list[Finding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if not _is_route_handler(node):
+            continue
+        findings.extend(_check_handler(node, path))
+    return findings
+
+
+def _check_handler(fn: ast.AST, path: str) -> list[Finding]:
+    findings: list[Finding] = []
+
+    def walk(node: ast.AST, protected: bool) -> None:
+        if isinstance(node, ast.Try):
+            body_protected = protected or _try_catches_value_error(node)
+            for stmt in node.body:
+                walk(stmt, body_protected)
+            for h in node.handlers:
+                for stmt in h.body:
+                    walk(stmt, protected)
+            for stmt in node.orelse + node.finalbody:
+                walk(stmt, protected)
+            return
+        if isinstance(node, ast.Call) and not protected:
+            f = node.func
+            name = f.id if isinstance(f, ast.Name) else ""
+            if name in ("int", "float") and _reads_query(node):
+                findings.append(Finding(
+                    "W601", path, node.lineno,
+                    f"route handler {fn.name} parses a query param "
+                    f"with bare {name}() — a malformed value raises "
+                    f"ValueError and answers 500, burning the "
+                    f"error-ratio SLO for a client typo",
+                    "use utils.httpd.qint/qfloat, or wrap in "
+                    "try/except ValueError -> HttpError(400)"))
+        for child in ast.iter_child_nodes(node):
+            walk(child, protected)
+
+    for stmt in fn.body:
+        walk(stmt, False)
+    return findings
+
+
+@register
+class RouteParamRule(Rule):
+    id = "W601"
+    name = "route-param-400"
+    summary = ("query-param int()/float() in route handlers must "
+               "answer 400 on garbage, never escape as a 500")
+
+    def check(self, repo: Repo) -> list[Finding]:
+        out: list[Finding] = []
+        for ctx in repo.package_files(PACKAGE):
+            tree = ctx.tree
+            if tree is None:
+                continue
+            out.extend(check_module_source(ctx.source, ctx.rel, tree))
+        return out

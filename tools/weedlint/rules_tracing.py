@@ -1,0 +1,198 @@
+"""W201: new code cannot silently opt out of distributed tracing.
+
+Ported from tools/check_tracing.py (PR 6).  Tracing is enforced at two
+chokepoints, not at every call site: utils/httpd.py Router.dispatch is
+the ONE ingress every HTTP handler runs under, and the pooled client
+helpers are the ONE egress every outbound hop rides.  That design only
+holds if nothing routes around the chokepoints:
+
+  1. Router.dispatch still calls begin_request/end_request/span; the
+     framed-TCP front (_serve_conn) still mints its headerless ingress.
+  2. _pooled_request / http_download still call inject_trace_headers.
+  3. No package module imports urllib.request / http.client directly
+     (a raw outbound hop would drop the Traceparent) — utils/httpd.py
+     is the sole allowed user; `# tracing-exempt: <reason>` waives a
+     genuinely-external hop (kept for backward compatibility with the
+     PR-6 waiver; `# weedlint: disable=W201 <reason>` works too).
+  4. No Router subclass overrides dispatch outside utils/httpd.py.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from .engine import Finding, Repo, Rule, register
+
+PACKAGE = "seaweedfs_tpu"
+HTTPD_REL = os.path.join(PACKAGE, "utils", "httpd.py")
+FRAMING_REL = os.path.join(PACKAGE, "utils", "framing.py")
+RAW_HTTP_MODULES = {"urllib.request", "http.client"}
+OUTBOUND_HELPERS = ("_pooled_request", "http_download")
+
+
+def _calls_in(node: ast.AST) -> set[str]:
+    names: set[str] = set()
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call):
+            f = sub.func
+            if isinstance(f, ast.Name):
+                names.add(f.id)
+            elif isinstance(f, ast.Attribute):
+                names.add(f.attr)
+    return names
+
+
+def _functions(tree: ast.AST) -> dict[str, ast.AST]:
+    out: dict[str, ast.AST] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out.setdefault(node.name, node)
+    return out
+
+
+def check_httpd_source(src: str, path: str) -> list[Finding]:
+    """The ingress/egress chokepoint contract on utils/httpd.py."""
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:
+        return [Finding("W201", path, e.lineno or 0,
+                        f"does not parse: {e.msg}")]
+    problems: list[Finding] = []
+    fns = _functions(tree)
+    dispatch = fns.get("dispatch")
+    if dispatch is None:
+        problems.append(Finding("W201", path, 0,
+                                "Router.dispatch not found"))
+    else:
+        calls = _calls_in(dispatch)
+        for required in ("begin_request", "end_request", "span"):
+            if required not in calls:
+                problems.append(Finding(
+                    "W201", path, dispatch.lineno,
+                    f"Router.dispatch no longer calls {required}() — "
+                    f"HTTP handlers would run without a request span / "
+                    f"trace context"))
+    for helper in OUTBOUND_HELPERS:
+        fn = fns.get(helper)
+        if fn is None:
+            problems.append(Finding(
+                "W201", path, 0, f"outbound helper {helper}() not found"))
+        elif "inject_trace_headers" not in _calls_in(fn):
+            problems.append(Finding(
+                "W201", path, fn.lineno,
+                f"{helper}() no longer calls inject_trace_headers() — "
+                f"outbound hops would drop the Traceparent and shatter "
+                f"cross-server traces"))
+    return problems
+
+
+def check_framing_source(src: str, path: str) -> list[Finding]:
+    """The framed-TCP ingress contract on utils/framing.py."""
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:
+        return [Finding("W201", path, e.lineno or 0,
+                        f"does not parse: {e.msg}")]
+    fns = _functions(tree)
+    serve = fns.get("_serve_conn")
+    if serve is None:
+        return [Finding("W201", path, 0,
+                        "FramedServer._serve_conn not found")]
+    calls = _calls_in(serve)
+    missing = [c for c in ("begin_request", "end_request", "span")
+               if c not in calls]
+    if missing:
+        return [Finding(
+            "W201", path, serve.lineno,
+            f"_serve_conn no longer calls {'/'.join(missing)} — the "
+            f"native TCP ingress would run untraced")]
+    return []
+
+
+def check_package_source(src: str, path: str,
+                         tree=None) -> list[Finding]:
+    """Raw-HTTP imports + Router-dispatch overrides in one package
+    module."""
+    if tree is None:
+        try:
+            tree = ast.parse(src, filename=path)
+        except SyntaxError as e:
+            return [Finding("W201", path, e.lineno or 0,
+                            f"does not parse: {e.msg}")]
+    lines = src.splitlines()
+
+    def waived(lineno: int) -> bool:
+        line = lines[lineno - 1] if 0 < lineno <= len(lines) else ""
+        return "tracing-exempt" in line
+
+    problems: list[Finding] = []
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Import, ast.ImportFrom)) \
+                and waived(node.lineno):
+            continue
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name in RAW_HTTP_MODULES:
+                    problems.append(Finding(
+                        "W201", path, node.lineno,
+                        f"raw `import {alias.name}` — outbound HTTP "
+                        f"must go through utils.httpd helpers so the "
+                        f"Traceparent header propagates"))
+        elif isinstance(node, ast.ImportFrom):
+            mod = node.module or ""
+            if mod in RAW_HTTP_MODULES or \
+                    (mod == "urllib"
+                     and any(a.name == "request" for a in node.names)) or \
+                    (mod == "http"
+                     and any(a.name == "client" for a in node.names)):
+                problems.append(Finding(
+                    "W201", path, node.lineno,
+                    f"raw HTTP client import (`from {mod} import ...`) "
+                    f"— outbound HTTP must go through utils.httpd "
+                    f"helpers so the Traceparent header propagates"))
+        elif isinstance(node, ast.ClassDef):
+            router_base = any(
+                (isinstance(b, ast.Name) and b.id == "Router")
+                or (isinstance(b, ast.Attribute) and b.attr == "Router")
+                for b in node.bases)
+            if not router_base:
+                continue
+            for item in node.body:
+                if isinstance(item, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)) \
+                        and item.name == "dispatch":
+                    problems.append(Finding(
+                        "W201", path, item.lineno,
+                        "Router subclass overrides dispatch() — the "
+                        "request span and trace-context restore live "
+                        "there; override hooks instead"))
+    return problems
+
+
+@register
+class TracingRule(Rule):
+    id = "W201"
+    name = "tracing-chokepoints"
+    summary = ("HTTP ingress/egress must ride the traced chokepoints; "
+               "no raw urllib/http.client in the package")
+
+    def check(self, repo: Repo) -> list[Finding]:
+        problems: list[Finding] = []
+        httpd = repo.get(HTTPD_REL)
+        if httpd is not None:
+            problems.extend(check_httpd_source(httpd.source, HTTPD_REL))
+        else:
+            problems.append(Finding("W201", HTTPD_REL, 0, "missing"))
+        framing = repo.get(FRAMING_REL)
+        if framing is not None:
+            problems.extend(
+                check_framing_source(framing.source, FRAMING_REL))
+        else:
+            problems.append(Finding("W201", FRAMING_REL, 0, "missing"))
+        for ctx in repo.package_files(PACKAGE):
+            if ctx.rel == HTTPD_REL:  # the sole allowed raw-HTTP user
+                continue
+            problems.extend(
+                check_package_source(ctx.source, ctx.rel, ctx.tree))
+        return problems
