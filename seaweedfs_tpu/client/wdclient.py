@@ -83,7 +83,6 @@ class WdClient:
         self.master_url = master_url
         self.vid_map = VidMap(data_center)
         self.poll_timeout = poll_timeout
-        self._seq = 0
         self._stop = threading.Event()
         self._synced = threading.Event()
         self._thread: threading.Thread | None = None
@@ -116,18 +115,21 @@ class WdClient:
     RECONNECT_CAP = 15.0
 
     def _keep_connected(self) -> None:
+        # the watch cursor lives on this thread's stack: nothing else
+        # ever needs it, so there is no shared field to race on
+        seq = 0
         failures = 0
         while not self._stop.is_set():
             try:
                 r = http_json(
                     "GET", f"http://{self.master_url}/cluster/watch?"
-                    f"since_seq={self._seq}&timeout={self.poll_timeout}",
+                    f"since_seq={seq}&timeout={self.poll_timeout}",
                     timeout=self.poll_timeout + 10)
                 if "volumes" in r:
                     self.vid_map.apply_snapshot(r)
                 for e in r.get("events", []):
                     self.vid_map.apply_event(e)
-                self._seq = r.get("seq", self._seq)
+                seq = r.get("seq", seq)
                 self._synced.set()
                 failures = 0
             except Exception:
@@ -135,7 +137,7 @@ class WdClient:
                 # not kill the loop with _synced set — that would freeze
                 # the map and serve stale locations forever
                 self._synced.clear()
-                self._seq = 0  # resync from snapshot on reconnect
+                seq = 0  # resync from snapshot on reconnect
                 delay = jittered_backoff(self.RECONNECT_BASE,
                                          self.RECONNECT_CAP, failures)
                 failures = min(failures + 1, 10)  # cap the exponent

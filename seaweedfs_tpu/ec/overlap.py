@@ -377,9 +377,9 @@ class _ParityWorkerBase:
             # jittered exponential backoff: a crash loop must not burn a
             # core respawning, and co-scheduled encoders must not
             # thundering-herd their respawns in lockstep
-            time.sleep(jittered_backoff(self.restart_backoff,
-                                        self.restart_backoff_cap,
-                                        self.restarts - 1))
+            time.sleep(jittered_backoff(  # weedlint: lock-io recovery is deliberately exclusive: submit/fetch must stall until the respawned worker is consistent, and the backoff is bounded by restart_backoff_cap
+                self.restart_backoff, self.restart_backoff_cap,
+                self.restarts - 1))
             self._kill()
             self._drain_stale_acks()
             try:

@@ -30,9 +30,11 @@ class MetaAggregator:
         self.on_event = on_event
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
-        # visible counters for status/debugging
-        self.applied = 0
-        self.skipped_own = 0
+        # visible counters for status/debugging — one tail thread PER
+        # PEER increments them, so the += rides a lock
+        self._counter_lock = threading.Lock()
+        self.applied = 0  # guarded-by: _counter_lock
+        self.skipped_own = 0  # guarded-by: _counter_lock
 
     def start(self) -> "MetaAggregator":
         for peer in self.peers:
@@ -70,7 +72,8 @@ class MetaAggregator:
             events = r.get("events", [])
             for event in events:
                 if self.filer.signature in event.get("signatures", []):
-                    self.skipped_own += 1
+                    with self._counter_lock:
+                        self.skipped_own += 1
                     continue
                 self.filer.publish_peer_event(peer, event)
                 if self.on_event is not None:
@@ -78,7 +81,8 @@ class MetaAggregator:
                         self.on_event(peer, event)
                     except Exception:
                         pass
-                self.applied += 1
+                with self._counter_lock:
+                    self.applied += 1
             new_cursor = int(r.get("next_ns", cursor))
             if new_cursor != cursor:
                 cursor = new_cursor

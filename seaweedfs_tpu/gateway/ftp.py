@@ -101,10 +101,10 @@ class FtpServer:
             return None
 
     def start(self) -> "FtpServer":
-        self._sock = socket.socket()
+        self._sock = socket.socket()  # weedlint: disable=W502 lifecycle handoff: written on the start() thread before the accept thread exists
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((self.host, self.port))
-        self.port = self._sock.getsockname()[1]
+        self.port = self._sock.getsockname()[1]  # weedlint: disable=W502 lifecycle handoff: ephemeral-port resolution on the start() thread before the accept thread exists
         self._sock.listen(8)
         threading.Thread(target=self._accept_loop, daemon=True,
                          name="ftpd").start()

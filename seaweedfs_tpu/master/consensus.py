@@ -40,13 +40,13 @@ class RaftNode:
         self.lock = threading.RLock()
         self.term = 0
         self.voted_for: Optional[str] = None
-        self.role = "follower" if self.peers else "leader"
-        self.leader: Optional[str] = None if self.peers else me
+        self.role = "follower" if self.peers else "leader"  # guarded-by: lock
+        self.leader: Optional[str] = None if self.peers else me  # guarded-by: lock
         self._last_heard = time.time()
         self._timeout = random.uniform(*ELECTION_TIMEOUT)
         self._stop = threading.Event()
         self.on_role_change: Optional[Callable[[str], None]] = None
-        self._last_persisted: Optional[str] = None
+        self._last_persisted: Optional[str] = None  # guarded-by: lock
         if state_dir:
             os.makedirs(state_dir, exist_ok=True)
             self._load()
@@ -66,7 +66,7 @@ class RaftNode:
         except (FileNotFoundError, ValueError):
             pass
 
-    def persist(self) -> None:
+    def persist(self) -> None:  # holds: lock
         if not self.state_dir:
             return
         doc = json.dumps({"term": self.term, "voted_for": self.voted_for,
@@ -134,19 +134,22 @@ class RaftNode:
             self.persist()
             return {"term": self.term, "ok": True}
 
-    def _become_follower(self, leader: Optional[str]) -> None:
+    def _become_follower(self, leader: Optional[str]) -> None:  # holds: lock
         was = self.role
         if self.role != "follower" or (leader and self.leader != leader):
             self.role = "follower"
         if leader:
             self.leader = leader
         if was != self.role:
-            self._notify_role()
+            self._notify_role(self.role)
 
-    def _notify_role(self) -> None:
+    def _notify_role(self, role: str) -> None:
+        """Takes the just-committed role as an argument so it never
+        reads shared state lock-free — callers outside the lock (the
+        hook must not run under it) stay outside it."""
         if self.on_role_change is not None:
             try:
-                self.on_role_change(self.role)
+                self.on_role_change(role)
             except Exception:
                 pass
 
@@ -159,7 +162,8 @@ class RaftNode:
 
     def stop(self) -> None:
         self._stop.set()
-        self.persist()
+        with self.lock:  # persist's contract: called with lock held
+            self.persist()
 
     def _run(self) -> None:
         hb_misses = 0
@@ -239,7 +243,7 @@ class RaftNode:
                 self.leader = self.me
                 won = True
         if won:
-            self._notify_role()
+            self._notify_role("leader")
             self._broadcast_append()
 
     def commit_state(self) -> bool:
@@ -268,7 +272,7 @@ class RaftNode:
                 self.role = "follower"
                 changed = True
         if changed:
-            self._notify_role()
+            self._notify_role("follower")
 
     def _broadcast_append(self, rpc_timeout: float = 1.0,
                           join_timeout: float = 1.5) -> int:

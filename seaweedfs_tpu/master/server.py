@@ -145,7 +145,7 @@ class MasterServer:
         return f"{self.host}:{self.port}"
 
     def start(self) -> "MasterServer":
-        self._server = serve(self.router, self.host, self.port,
+        self._server = serve(self.router, self.host, self.port,  # weedlint: disable=W502 lifecycle handoff: written on the start() thread before any background loop exists
                              tls_context=self._tls_context)
         self._trace_shipper.attach()
         # BEFORE the TCP front binds: a degraded_bind event emitted
@@ -176,7 +176,7 @@ class MasterServer:
         # secured clusters (mTLS or JWT-minting masters); clients fall
         # back to the HTTPS/JWT HTTP assign transparently
         if self._tls_context is None and not self.guard.signing_key:
-            self._tcp_server = FramedServer(
+            self._tcp_server = FramedServer(  # weedlint: disable=W502 lifecycle handoff: written on the start() thread before any background loop exists
                 _tcp_handle, self.host, tcp_port_for(self.port),
                 name="tcp-master").start()
             if not self._tcp_server.alive:
@@ -344,7 +344,7 @@ class MasterServer:
                         run_command(env, line)
                     except Exception as e:
                         self._note_maintenance_error(f"{line!r}: {e}")
-                self.maintenance_runs += 1
+                self.maintenance_runs += 1  # weedlint: disable=W502 single-writer counter: only the maintenance thread increments; status readers tolerate staleness
             except Exception as e:
                 self._note_maintenance_error(f"lock: {e}")
             finally:

@@ -170,7 +170,7 @@ class BrokerServer:
         return f"{self.host}:{self.port}"
 
     def start(self) -> "BrokerServer":
-        self._server = serve(self.router, self.host, self.port)
+        self._server = serve(self.router, self.host, self.port)  # weedlint: disable=W502 lifecycle handoff: written on the start() thread before the flush thread exists
         threading.Thread(target=self._flush_loop, daemon=True,
                          name="broker-flush").start()
         return self

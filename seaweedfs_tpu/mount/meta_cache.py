@@ -87,14 +87,17 @@ class MetaCache:
                     pass
 
     def _tail_loop(self) -> None:
+        # the tail cursor lives on this thread's stack after start():
+        # no other thread needs it, so there is no shared field to race
+        since_ns = self._since_ns
         while not self._stop.is_set():
             try:
                 r = http_json(
                     "GET", f"http://{self.filer_url}/api/meta/log?"
-                    f"since_ns={self._since_ns}")
+                    f"since_ns={since_ns}")
                 for ev in r["events"]:
                     self.apply_event(ev)
-                self._since_ns = r["next_ns"]
+                since_ns = r["next_ns"]
             except Exception:
                 pass
             self._stop.wait(self.poll_interval)

@@ -61,7 +61,7 @@ class SamplingProfiler:
         if self._thread is not None:
             raise RuntimeError("profiler already running")
         self._stop.clear()
-        self._t0 = time.perf_counter()
+        self._t0 = time.perf_counter()  # weedlint: disable=W502 lifecycle: only the controlling thread writes (start/stop); the sampler thread never touches it
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="sampling-profiler")
         self._thread.start()
@@ -73,7 +73,7 @@ class SamplingProfiler:
         self._stop.set()
         self._thread.join(timeout=5.0)
         self._thread = None
-        self.elapsed = time.perf_counter() - self._t0
+        self.elapsed = time.perf_counter() - self._t0  # weedlint: disable=W502 lifecycle: only the controlling thread writes (start/stop); the sampler thread never touches it
         return self
 
     def run_for(self, seconds: float) -> "SamplingProfiler":

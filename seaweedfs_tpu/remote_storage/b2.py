@@ -57,11 +57,20 @@ class B2RemoteStorage(RemoteStorageClient):
         return json.loads(body)
 
     def _auth_state(self, refresh: bool = False) -> dict:
+        # the authorize round trip runs OUTSIDE the lock (weedlint W504:
+        # holding _lock across B2 egress would stall every concurrent
+        # caller behind one slow auth); two racing refreshes both hit
+        # b2_authorize_account, which is idempotent — last writer wins
+        # and both tokens are valid
         with self._lock:
-            if self._auth is None or refresh:
-                self._auth = self._authorize()
-                self._bucket_ids.clear()
-            return self._auth
+            auth = self._auth
+            if auth is not None and not refresh:
+                return auth
+        auth = self._authorize()
+        with self._lock:
+            self._auth = auth
+            self._bucket_ids.clear()
+        return auth
 
     def _call(self, op: str, payload: dict) -> dict:
         """POST an api operation; one token refresh on 401."""

@@ -160,9 +160,12 @@ class AsyncPublisher(NotificationQueue):
 
         self.inner = inner
         self._q: "_queue.Queue" = _queue.Queue(maxsize)
-        self.dropped = 0
-        self.errors = 0
-        self._closed = False
+        # counters race otherwise: every filer mutation thread can hit
+        # the overflow path in send_message concurrently with close()
+        self._stats_lock = threading.Lock()
+        self.dropped = 0  # guarded-by: _stats_lock
+        self.errors = 0  # guarded-by: _stats_lock
+        self._closed = False  # guarded-by: _stats_lock
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="notify-publisher")
         self._thread.start()
@@ -177,12 +180,14 @@ class AsyncPublisher(NotificationQueue):
             except _queue.Full:
                 try:  # drop the oldest so fresh events keep flowing
                     self._q.get_nowait()
-                    self.dropped += 1
-                    if self.dropped in (1, 100) or self.dropped % 1000 == 0:
+                    with self._stats_lock:
+                        self.dropped += 1
+                        dropped = self.dropped
+                    if dropped in (1, 100) or dropped % 1000 == 0:
                         from ..utils.glog import V
 
                         V(0).infof("notification queue overflow: "
-                                   "%d events dropped", self.dropped)
+                                   "%d events dropped", dropped)
                 except _queue.Empty:
                     pass
 
@@ -195,19 +200,22 @@ class AsyncPublisher(NotificationQueue):
             try:
                 self.inner.send_message(key, event)
             except Exception as e:  # noqa: BLE001 - keep publishing
-                self.errors += 1
-                if self.errors in (1, 10) or self.errors % 1000 == 0:
+                with self._stats_lock:
+                    self.errors += 1
+                    errors = self.errors
+                if errors in (1, 10) or errors % 1000 == 0:
                     from ..utils.glog import V
 
                     V(0).infof("notification publish failed (%d so far): "
-                               "%s: %s", self.errors, type(e).__name__, e)
+                               "%s: %s", errors, type(e).__name__, e)
 
     def close(self, timeout: float = 10.0) -> None:
         """Drain pending events (bounded) so a clean filer shutdown does
         not silently lose the tail of accepted notifications."""
-        if self._closed:
-            return
-        self._closed = True
+        with self._stats_lock:
+            if self._closed:
+                return
+            self._closed = True
         import queue as _queue
 
         try:  # non-blocking: a full queue must not stall shutdown
@@ -215,7 +223,8 @@ class AsyncPublisher(NotificationQueue):
         except _queue.Full:
             try:  # drop the oldest so the sentinel fits
                 self._q.get_nowait()
-                self.dropped += 1
+                with self._stats_lock:
+                    self.dropped += 1
             except _queue.Empty:
                 pass
             try:

@@ -349,7 +349,7 @@ class Volume:
         w = self.group_commit_worker()
         if w is None:  # parked (compaction commit / tiering in progress)
             with self.write_lock:
-                res = self._do_write(n, check_cookie)
+                res = self._do_write(n, check_cookie)  # weedlint: lock-io _read_at's swap-window retry sleeps at most 2s, and a write DURING the handle swap must wait for the new handle anyway
                 self._dat.sync()
                 return res
         return w.submit_write(n, check_cookie).wait()
@@ -370,7 +370,7 @@ class Volume:
         """doWriteRequest (volume_write.go:130-178).
         Returns (offset, size, is_unchanged)."""
         with self.write_lock:
-            return self._do_write(n, check_cookie)
+            return self._do_write(n, check_cookie)  # weedlint: lock-io _read_at's swap-window retry sleeps at most 2s, and a write DURING the handle swap must wait for the new handle anyway
 
     def _do_write(self, n: Needle, check_cookie: bool) -> tuple[int, int, bool]:
         if self.read_only:
@@ -649,7 +649,8 @@ class Volume:
         self._park_worker()
         try:
             with self.write_lock:
-                self._makeup_diff(cpd, cpx)
+                self._makeup_diff(cpd, cpx)  # weedlint: lock-io commit-time catch-up reads ride _read_at's bounded (2s) swap retry; writers are already parked, the lock exists to fence them
+
                 self.close()
                 os.replace(cpd, self.dat_path)
                 os.replace(cpx, self.idx_path)

@@ -189,7 +189,7 @@ class GroupCommitWorker:
                         req.fail(e)
                         failed_early.append(req)
                 v._dat.sync()
-                self.fsync_count += 1
+                self.fsync_count += 1  # weedlint: disable=W502 single-writer counter: only the commit thread (_run) increments; metrics readers tolerate staleness
             except Exception as e:
                 # broad on purpose: ANY unexpected failure (e.g. the .dat
                 # handle mid-swap during tiering) must roll back and fail
@@ -200,8 +200,8 @@ class GroupCommitWorker:
                     if req not in failed_early:
                         req.fail(e)
                 return
-        self.batch_count += 1
-        self.request_count += len(batch)
+        self.batch_count += 1  # weedlint: disable=W502 single-writer counter: only the commit thread (_run) increments; metrics readers tolerate staleness
+        self.request_count += len(batch)  # weedlint: disable=W502 single-writer counter: only the commit thread (_run) increments; metrics readers tolerate staleness
         for req, (offset, size, unchanged) in applied:
             if req.is_write:
                 req.complete(offset, size, unchanged)
@@ -218,7 +218,7 @@ class GroupCommitWorker:
     def _rollback(self, dat_start: int, idx_start: int) -> None:
         """Truncate-on-sync-failure (volume_write.go:284-290), extended to
         roll the index log + in-memory map back too."""
-        self.rollback_count += 1
+        self.rollback_count += 1  # weedlint: disable=W502 single-writer counter: only the commit thread (_run) increments; metrics readers tolerate staleness
         v = self.volume
         try:
             v._dat.truncate(dat_start)

@@ -68,7 +68,7 @@ class FramedServer:
         return self._sock is not None
 
     def start(self) -> "FramedServer":
-        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)  # weedlint: disable=W502 lifecycle handoff: written on the start() thread before the accept thread exists
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         try:
             self._sock.bind((self.host, self.port))
@@ -76,7 +76,7 @@ class FramedServer:
             # conventional port taken (ephemeral-port test clusters can
             # collide): the HTTP plane still serves everything
             self._sock.close()
-            self._sock = None
+            self._sock = None  # weedlint: disable=W502 lifecycle handoff: bind failed, no accept thread was ever started
             return self
         self._sock.listen(64)
         threading.Thread(target=self._accept_loop, daemon=True,

@@ -676,7 +676,7 @@ class FastHTTPServer:
     def shutdown(self) -> None:
         """Stop accepting and RELEASE the port before returning (callers
         immediately rebind on master restart)."""
-        self._stopping = True
+        self._stopping = True  # weedlint: disable=W502 monotonic shutdown latch: single atomic bool store, the accept loop reads it once per iteration and either value is safe
         self._done.wait(timeout=5.0)
 
     def server_close(self) -> None:

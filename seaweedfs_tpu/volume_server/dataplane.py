@@ -50,7 +50,7 @@ def load_dataplane():
                 os.path.exists(src)
                 and os.path.getmtime(src) > os.path.getmtime(_LIB_PATH)):
             try:
-                subprocess.run(["make", "-s", "-C", _DIR], check=True,
+                subprocess.run(["make", "-s", "-C", _DIR], check=True,  # weedlint: lock-io one-time native build at first load; the lock exists precisely to serialize concurrent builders, and the make is timeout-bounded
                                capture_output=True, timeout=120)
             except Exception:
                 return None

@@ -169,8 +169,11 @@ class VolumeServer:
         self._server = None
         self._tls_context = tls_context
         self._stop = threading.Event()
-        # vid -> (replica urls, expiry); see _lookup_replicas
-        self._vid_cache: dict[int, tuple[list, float]] = {}
+        # vid -> (replica urls, expiry); see _lookup_replicas.  Request
+        # threads fill it concurrently and the TTL prune rebinds the
+        # whole dict — iteration during an unlocked insert would raise
+        self._vid_lock = threading.Lock()
+        self._vid_cache: dict[int, tuple[list, float]] = {}  # guarded-by: _vid_lock
         self.vid_cache_ttl = 10.0
         self._tcp_enabled = tcp
         self._tcp_server = None
@@ -203,7 +206,7 @@ class VolumeServer:
 
     # --- lifecycle --------------------------------------------------------
     def start(self) -> "VolumeServer":
-        self._server = serve(self.router, self.store.ip, self.store.port,
+        self._server = serve(self.router, self.store.ip, self.store.port,  # weedlint: disable=W502 lifecycle handoff: written on the start() thread before the heartbeat thread exists
                              tls_context=self._tls_context)
         # BEFORE the TCP plane binds: a degraded_bind event emitted by
         # _bind_with_retry must find the shipper hooked (attach has no
@@ -233,14 +236,14 @@ class VolumeServer:
                 self.store.native_tcp_writes_ok = not self.guard.white_list
                 tcp_port = (-1 if self.guard.white_list
                             else tcp_port_for(self.store.port))
-                self._native_plane = _bind_with_retry(
+                self._native_plane = _bind_with_retry(  # weedlint: disable=W502 lifecycle handoff: written on the start() thread before the heartbeat thread exists
                     lambda: NativeDataPlane(self.store.ip, tcp_port),
                     role="volume-native", server=self.url)
                 self.store.attach_native_plane(self._native_plane)
             else:
                 from .tcp import TcpVolumeServer
 
-                self._tcp_server = _bind_with_retry(
+                self._tcp_server = _bind_with_retry(  # weedlint: disable=W502 lifecycle handoff: written on the start() thread before the heartbeat thread exists
                     lambda: TcpVolumeServer(
                         self.store, self.store.ip,
                         whitelist_ok=(self.guard.check_white_list
@@ -270,7 +273,7 @@ class VolumeServer:
             # C++ server and falls back to the Python engine
             self.store.native_plane = None
             self._native_plane.stop()
-            self._native_plane = None
+            self._native_plane = None  # weedlint: disable=W502 lifecycle teardown: runs after _stop is set and the servers are down
         self.store.close()
 
     def _heartbeat_loop(self) -> None:
@@ -307,14 +310,14 @@ class VolumeServer:
                     if leader and leader != self.master_url:
                         # follower redirect: re-target without waiting, and
                         # open with a full sync (the new leader may be fresh)
-                        self.master_url = leader
+                        self.master_url = leader  # weedlint: disable=W502 atomic str rebind: heartbeat loop and heartbeat_now converge on the same leader, readers tolerate one stale retry
                         pulse = 0
                         continue
                     # leaderless cluster: rotate and wait out the pulse
                     if len(self.masters) > 1:
                         i = (self.masters.index(self.master_url) + 1) \
                             if self.master_url in self.masters else 0
-                        self.master_url = self.masters[i % len(self.masters)]
+                        self.master_url = self.masters[i % len(self.masters)]  # weedlint: disable=W502 atomic str rebind: heartbeat loop and heartbeat_now converge on the same leader, readers tolerate one stale retry
                     pulse = 0
                     self._stop.wait(self.pulse_seconds)
                     continue
@@ -333,7 +336,7 @@ class VolumeServer:
                 if len(self.masters) > 1:
                     i = (self.masters.index(self.master_url) + 1) \
                         if self.master_url in self.masters else 0
-                    self.master_url = self.masters[i % len(self.masters)]
+                    self.master_url = self.masters[i % len(self.masters)]  # weedlint: disable=W502 atomic str rebind: heartbeat loop and heartbeat_now converge on the same leader, readers tolerate one stale retry
                 pulse = 0
                 self._stop.wait(self.pulse_seconds)
                 continue
@@ -350,7 +353,7 @@ class VolumeServer:
         resp = http_json("POST", f"http://{self.master_url}/heartbeat",
                          self.heartbeat_payload())
         if resp.get("not_leader") and resp.get("leader"):
-            self.master_url = resp["leader"]
+            self.master_url = resp["leader"]  # weedlint: disable=W502 atomic str rebind: heartbeat loop and heartbeat_now converge on the same leader, readers tolerate one stale retry
             http_json("POST", f"http://{self.master_url}/heartbeat",
                       self.heartbeat_payload())
 
@@ -381,19 +384,24 @@ class VolumeServer:
         pulses).  Without the cache EVERY replicated write pays a master
         round trip, which caps cluster write throughput at the master."""
         now = time.monotonic()
-        hit = self._vid_cache.get(vid)
+        with self._vid_lock:
+            hit = self._vid_cache.get(vid)
         if hit is not None and hit[1] > now:
             return hit[0]
         try:
+            # the master round trip runs OUTSIDE _vid_lock (W504: a
+            # slow master would stall every replicated write behind one
+            # lookup); racing fills for the same vid are both correct
             r = http_json("GET",
                           f"http://{self.master_url}/dir/lookup?volumeId={vid}")
             locs = [loc["url"] for loc in r.get("locations", [])]
         except HttpError:
             return []
-        self._vid_cache[vid] = (locs, now + self.vid_cache_ttl)
-        if len(self._vid_cache) > 10_000:  # bound growth on churny clusters
-            self._vid_cache = {k: v for k, v in self._vid_cache.items()
-                               if v[1] > now}
+        with self._vid_lock:
+            self._vid_cache[vid] = (locs, now + self.vid_cache_ttl)
+            if len(self._vid_cache) > 10_000:  # bound growth on churn
+                self._vid_cache = {k: v for k, v in self._vid_cache.items()
+                                   if v[1] > now}
         return locs
 
     def _fetch_remote_shard(self, vid: int, shard_id: int, offset: int,

@@ -1,0 +1,657 @@
+"""Cached whole-program call graph for interprocedural weedlint rules.
+
+The per-class lockset checker (rules_lockset) proved lock discipline is
+machine-checkable, but its view ends at the class boundary: the two
+bugs that actually take clusters down — lock-acquisition CYCLES across
+classes (deadlock) and slow I/O performed while a lock is held — are
+only visible to a pass that can follow a call from ``with self._lock``
+in one class into a method of another.  This module builds that pass's
+substrate once per lint run and caches it on the engine's ``Repo`` so
+every interprocedural rule (W503 lock-order, W504 blocking-under-lock,
+and whatever comes next) shares one graph.
+
+Resolution rules (documented in README "Static analysis"):
+
+  - ``self.method(...)`` -> the method on the same class, searching
+    lexical base classes by name when the class itself lacks it;
+  - ``self.attr.method(...)`` -> ``Cls.method`` for every class ``Cls``
+    the attribute was ever assigned from a constructor call
+    (``self.attr = Cls(...)`` anywhere in the class, conventionally
+    ``__init__``) — multiple candidate classes all get edges
+    (conservative over-approximation);
+  - ``local = Cls(...); local.method(...)`` -> ``Cls.method`` via
+    single-pass local type seeding inside one function body;
+  - bare ``fn(...)`` -> the module-level function in the same module,
+    else any same-named module-level function elsewhere in the package
+    when the name was imported (``from x import fn``);
+  - ``Cls(...)`` -> ``Cls.__init__``;
+  - ``Thread(target=X)`` / ``Timer(t, X)`` / ``pool.submit(X, ...)``
+    and callable arguments (``f(cb)`` / ``f(pace=self._pace)``) add an
+    edge to ``X`` from the function RECEIVING the callable (when
+    resolved) — the callback runs in the callee's context, which is
+    what lock propagation needs — else from the caller.
+
+Known blind spots (counted, never silently dropped): calls through
+attributes never assigned a constructor (hook fields like ``on_emit``),
+``super()`` dispatch, calls on function parameters, and duck-typed
+dispatch generally.  ``stats()`` reports resolved / external /
+unresolved call-site counts so a resolution regression is visible in
+test logs (test_weedlint pins the unresolved ratio).
+
+Lock modelling: a lock is identified at CLASS granularity
+(``ClassName._lock``) or module granularity (``mod.py:GLOBAL_LOCK``) —
+the standard static approximation (two instances of one class are not
+distinguished).  ``with self.X`` counts as an acquisition when ``X``
+is assigned a ``Lock/RLock/Condition`` in the class or its name says
+lock-ish things; ``# holds: X`` on a def line and the ``*_locked``
+name suffix seed the entry-held set the walker starts from.
+"""
+
+from __future__ import annotations
+
+import ast
+import builtins
+import re
+from typing import Iterable, Optional
+
+PACKAGE = "seaweedfs_tpu"
+
+_HOLDS_RE = re.compile(r"#\s*holds:\s*([A-Za-z_][A-Za-z0-9_]*)")
+_GUARDED_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z_][A-Za-z0-9_]*)")
+
+# constructions that make an attribute a LOCK for ordering purposes
+_LOCK_CTORS = {"Lock", "RLock", "Condition"}
+_RLOCK_CTORS = {"RLock"}
+# attribute-name fallback when no constructor is visible in the class
+_LOCKISH_NAME = re.compile(r"(^|_)(lock|mu|mutex|cv)$|_lock\b")
+
+_QUEUE_CTORS = {"Queue", "LifoQueue", "PriorityQueue", "SimpleQueue"}
+_EVENT_CTORS = {"Event"}
+
+_BUILTINS = set(dir(builtins))
+
+
+def _call_name(func: ast.AST) -> str:
+    """Dotted text of a call target (best effort, for classification)."""
+    parts: list[str] = []
+    node = func
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    elif isinstance(node, ast.Call):
+        parts.append(_call_name(node.func) + "()")
+    else:
+        parts.append("?")
+    return ".".join(reversed(parts))
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _queue_is_bounded(call: ast.Call) -> bool:
+    """Queue(maxsize) / Queue(maxsize=N) with anything that is not a
+    literal 0 counts as bounded (a variable capacity is presumed
+    bounded — that is the conservative direction for put())."""
+    for a in call.args[:1]:
+        if isinstance(a, ast.Constant) and a.value == 0:
+            return False
+        return True
+    for kw in call.keywords:
+        if kw.arg == "maxsize":
+            if isinstance(kw.value, ast.Constant) and kw.value.value == 0:
+                return False
+            return True
+    return False
+
+
+def _ctor_class_name(value: ast.AST) -> Optional[str]:
+    """``Cls(...)`` or ``mod.Cls(...)`` -> "Cls" (capitalized names
+    only: lowercase calls are overwhelmingly factory functions whose
+    return type this pass does not track)."""
+    if not isinstance(value, ast.Call):
+        return None
+    f = value.func
+    name = f.id if isinstance(f, ast.Name) else (
+        f.attr if isinstance(f, ast.Attribute) else "")
+    if name and name[0].isupper():
+        return name
+    return None
+
+
+class ClassInfo:
+    """Per-class facts the resolver and the lock walker need."""
+
+    __slots__ = ("name", "rel", "node", "bases", "methods", "attr_types",
+                 "lock_attrs", "rlock_attrs", "queue_attrs",
+                 "bounded_queue_attrs", "event_attrs", "guards")
+
+    def __init__(self, name: str, rel: str, node: ast.ClassDef,
+                 lines: Optional[list[str]] = None):
+        self.name = name
+        self.rel = rel
+        self.node = node
+        self.bases: list[str] = []
+        for b in node.bases:
+            if isinstance(b, ast.Name):
+                self.bases.append(b.id)
+            elif isinstance(b, ast.Attribute):
+                self.bases.append(b.attr)
+        self.methods: dict[str, ast.AST] = {}
+        for item in node.body:
+            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.methods[item.name] = item
+        # attr -> candidate class names (self.x = Cls(...) anywhere)
+        self.attr_types: dict[str, set[str]] = {}
+        self.lock_attrs: set[str] = set()
+        self.rlock_attrs: set[str] = set()
+        self.queue_attrs: set[str] = set()
+        # bounded queues only: put() on an unbounded Queue() never
+        # blocks, so only maxsize-constructed queues matter to W504
+        self.bounded_queue_attrs: set[str] = set()
+        self.event_attrs: set[str] = set()
+        self.guards: dict[str, str] = {}
+        self._collect_attrs()
+        if lines:
+            self._collect_guards(lines)
+
+    def _collect_guards(self, lines: list[str]) -> None:
+        """`# guarded-by:` annotations (the lockset rules' convention)
+        feed the *_locked entry-hold seeding."""
+        for sub in ast.walk(self.node):
+            if not isinstance(sub, (ast.Assign, ast.AnnAssign,
+                                    ast.AugAssign)):
+                continue
+            line = lines[sub.lineno - 1] \
+                if 0 < sub.lineno <= len(lines) else ""
+            m = _GUARDED_RE.search(line)
+            if m is None:
+                continue
+            targets = sub.targets if isinstance(sub, ast.Assign) \
+                else [sub.target]
+            for t in targets:
+                attr = _self_attr(t)
+                if attr is not None:
+                    self.guards[attr] = m.group(1)
+
+    def _collect_attrs(self) -> None:
+        for sub in ast.walk(self.node):
+            if not isinstance(sub, (ast.Assign, ast.AnnAssign)):
+                continue
+            value = sub.value
+            targets = sub.targets if isinstance(sub, ast.Assign) \
+                else [sub.target]
+            attrs = [a for a in (_self_attr(t) for t in targets) if a]
+            if not attrs or not isinstance(value, ast.Call):
+                continue
+            f = value.func
+            ctor = f.id if isinstance(f, ast.Name) else (
+                f.attr if isinstance(f, ast.Attribute) else "")
+            for attr in attrs:
+                if ctor in _LOCK_CTORS:
+                    self.lock_attrs.add(attr)
+                    if ctor in _RLOCK_CTORS:
+                        self.rlock_attrs.add(attr)
+                elif ctor in _QUEUE_CTORS:
+                    self.queue_attrs.add(attr)
+                    if _queue_is_bounded(value):
+                        self.bounded_queue_attrs.add(attr)
+                elif ctor in _EVENT_CTORS:
+                    self.event_attrs.add(attr)
+                cname = _ctor_class_name(value)
+                if cname:
+                    self.attr_types.setdefault(attr, set()).add(cname)
+
+    def is_lock_attr(self, attr: str) -> bool:
+        return attr in self.lock_attrs or \
+            _LOCKISH_NAME.search(attr) is not None
+
+    def lock_id(self, attr: str) -> str:
+        return f"{self.name}.{attr}"
+
+
+class CallSite:
+    """One call expression with the lock context it executes under.
+    ``callees`` holds resolved node qnames (possibly several for
+    ambiguous names, possibly none); ``kind`` is resolved / external /
+    unresolved for the stats block."""
+
+    __slots__ = ("callees", "lineno", "held", "desc", "kind", "node",
+                 "spawn")
+
+    def __init__(self, callees: list[str], lineno: int,
+                 held: frozenset, desc: str, kind: str, node: ast.Call,
+                 spawn: bool = False):
+        self.callees = callees
+        self.lineno = lineno
+        self.held = held
+        self.desc = desc
+        self.kind = kind
+        self.node = node
+        # True for Thread/Timer/submit callback edges: the target runs
+        # on ANOTHER thread, so the caller's held locks do not carry
+        # and lock propagation must not follow this edge
+        self.spawn = spawn
+
+
+class Acquire:
+    """One ``with self.X`` lock acquisition and what was held going in."""
+
+    __slots__ = ("lock", "lineno", "held", "reentrant")
+
+    def __init__(self, lock: str, lineno: int, held: frozenset,
+                 reentrant: bool):
+        self.lock = lock
+        self.lineno = lineno
+        self.held = held
+        self.reentrant = reentrant
+
+
+class Node:
+    """One function or method in the graph."""
+
+    __slots__ = ("qname", "rel", "cls", "name", "fn", "lineno",
+                 "entry_holds", "acquires", "calls")
+
+    def __init__(self, qname: str, rel: str, cls: Optional[str],
+                 name: str, fn: ast.AST):
+        self.qname = qname
+        self.rel = rel
+        self.cls = cls
+        self.name = name
+        self.fn = fn
+        self.lineno = fn.lineno
+        self.entry_holds: frozenset = frozenset()
+        self.acquires: list[Acquire] = []
+        self.calls: list[CallSite] = []
+
+
+class CallGraph:
+    def __init__(self):
+        self.nodes: dict[str, Node] = {}
+        self.classes: dict[str, list[ClassInfo]] = {}
+        self.functions: dict[str, list[str]] = {}   # name -> qnames
+        self.module_locks: dict[str, set[str]] = {}  # rel -> names
+        self.lines: dict[str, list[str]] = {}
+        self.calls_total = 0
+        self.calls_resolved = 0
+        self.calls_external = 0
+        self.calls_unresolved = 0
+
+    # --- queries ----------------------------------------------------------
+    def edges(self) -> dict[str, set[str]]:
+        out: dict[str, set[str]] = {q: set() for q in self.nodes}
+        for node in self.nodes.values():
+            for cs in node.calls:
+                out[node.qname].update(cs.callees)
+        return out
+
+    def sync_edges(self) -> dict[str, set[str]]:
+        """Edges excluding Thread/Timer/submit spawn callbacks — the
+        graph lock propagation walks (a spawned thread does not
+        inherit the spawner's held locks)."""
+        out: dict[str, set[str]] = {q: set() for q in self.nodes}
+        for node in self.nodes.values():
+            for cs in node.calls:
+                if not cs.spawn:
+                    out[node.qname].update(cs.callees)
+        return out
+
+    def stats(self) -> dict:
+        edge_count = sum(len(v) for v in self.edges().values())
+        total = max(self.calls_total, 1)
+        return {
+            "nodes": len(self.nodes),
+            "edges": edge_count,
+            "calls_total": self.calls_total,
+            "calls_resolved": self.calls_resolved,
+            "calls_external": self.calls_external,
+            "calls_unresolved": self.calls_unresolved,
+            "unresolved_ratio": round(self.calls_unresolved / total, 4),
+        }
+
+    def line(self, rel: str, lineno: int) -> str:
+        lines = self.lines.get(rel) or []
+        return lines[lineno - 1] if 0 < lineno <= len(lines) else ""
+
+    def class_of(self, cname: str) -> Optional[ClassInfo]:
+        infos = self.classes.get(cname)
+        return infos[0] if infos else None
+
+    def resolve_method(self, cname: str,
+                       mname: str) -> Optional[str]:
+        """``Cls.m`` qname, following lexical bases by name."""
+        seen: set[str] = set()
+        stack = [cname]
+        while stack:
+            c = stack.pop()
+            if c in seen:
+                continue
+            seen.add(c)
+            for info in self.classes.get(c, []):
+                if mname in info.methods:
+                    return f"{info.rel}::{info.name}.{mname}"
+                stack.extend(info.bases)
+        return None
+
+
+class _ModuleIndex:
+    """First pass over one file: classes, functions, imports, locks."""
+
+    def __init__(self, rel: str, tree: ast.AST, lines: list[str]):
+        self.rel = rel
+        self.tree = tree
+        self.lines = lines
+        self.classes: list[ClassInfo] = []
+        self.functions: dict[str, ast.AST] = {}
+        self.imported: set[str] = set()      # from x import NAME
+        self.import_modules: set[str] = set()  # import NAME / as NAME
+        self.locks: set[str] = set()         # module-level lock names
+        for item in tree.body:
+            if isinstance(item, ast.ClassDef):
+                self.classes.append(ClassInfo(item.name, rel, item,
+                                              lines=lines))
+            elif isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.functions[item.name] = item
+            elif isinstance(item, ast.Assign):
+                value = item.value
+                if isinstance(value, ast.Call):
+                    f = value.func
+                    ctor = f.id if isinstance(f, ast.Name) else (
+                        f.attr if isinstance(f, ast.Attribute) else "")
+                    if ctor in _LOCK_CTORS:
+                        for t in item.targets:
+                            if isinstance(t, ast.Name):
+                                self.locks.add(t.id)
+        for item in ast.walk(tree):
+            if isinstance(item, ast.ImportFrom):
+                for alias in item.names:
+                    self.imported.add(alias.asname or alias.name)
+            elif isinstance(item, ast.Import):
+                for alias in item.names:
+                    self.import_modules.add(
+                        (alias.asname or alias.name).split(".")[0])
+
+
+class _FunctionWalker:
+    """Second pass: one function body -> acquisitions + call sites,
+    tracking the lexically-held lock set.  Nested function bodies are
+    walked with an EMPTY held set (a closure may run after the lock was
+    released) but their calls still belong to this node."""
+
+    def __init__(self, graph: CallGraph, mod: _ModuleIndex,
+                 node: Node, cls: Optional[ClassInfo]):
+        self.graph = graph
+        self.mod = mod
+        self.node = node
+        self.cls = cls
+        self.local_types: dict[str, set[str]] = {}
+
+    def run(self) -> None:
+        fn = self.node.fn
+        self._seed_entry_holds(fn)
+        # local constructor types first (single forward pass is enough
+        # for the `x = Cls(...); x.m()` idiom)
+        for sub in ast.walk(fn):
+            if isinstance(sub, ast.Assign) and len(sub.targets) == 1 \
+                    and isinstance(sub.targets[0], ast.Name):
+                cname = _ctor_class_name(sub.value)
+                if cname and cname in self.graph.classes:
+                    self.local_types.setdefault(
+                        sub.targets[0].id, set()).add(cname)
+        for stmt in getattr(fn, "body", []):
+            self._walk(stmt, self.node.entry_holds)
+
+    def _seed_entry_holds(self, fn: ast.AST) -> None:
+        held: set[str] = set()
+        line = self.mod.lines[fn.lineno - 1] \
+            if 0 < fn.lineno <= len(self.mod.lines) else ""
+        for m in _HOLDS_RE.finditer(line):
+            if self.cls is not None:
+                held.add(self.cls.lock_id(m.group(1)))
+            else:
+                held.add(f"{self.mod.rel}:{m.group(1)}")
+        if self.cls is not None and fn.name.endswith("_locked"):
+            named = set(self.cls.guards.values())
+            if len(self.cls.lock_attrs) == 1:
+                named |= self.cls.lock_attrs
+            held.update(self.cls.lock_id(a) for a in named)
+        self.node.entry_holds = frozenset(held)
+
+    def _lock_of(self, expr: ast.AST) -> Optional[tuple[str, bool]]:
+        """(lock id, reentrant) for a with-item context expr, if it is
+        a lock acquisition this pass models."""
+        attr = _self_attr(expr)
+        if attr is not None and self.cls is not None:
+            if self.cls.is_lock_attr(attr):
+                return (self.cls.lock_id(attr),
+                        attr in self.cls.rlock_attrs)
+            return None
+        if isinstance(expr, ast.Name) and expr.id in self.mod.locks:
+            return (f"{self.mod.rel}:{expr.id}", False)
+        return None
+
+    def _walk(self, node: ast.AST, held: frozenset,
+              in_nested: bool = False) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)) and node is not self.node.fn:
+            for child in ast.iter_child_nodes(node):
+                self._walk(child, frozenset(), in_nested=True)
+            return
+        if isinstance(node, ast.With):
+            inner = set(held)
+            for item in node.items:
+                self._walk(item.context_expr, frozenset(inner),
+                           in_nested=in_nested)
+                got = self._lock_of(item.context_expr)
+                if got is not None:
+                    lock, reentrant = got
+                    self.node.acquires.append(
+                        Acquire(lock, item.context_expr.lineno,
+                                frozenset(inner), reentrant))
+                    inner.add(lock)
+            for stmt in node.body:
+                self._walk(stmt, frozenset(inner), in_nested=in_nested)
+            return
+        if isinstance(node, ast.Call):
+            self._record_call(node, held)
+        for child in ast.iter_child_nodes(node):
+            self._walk(child, held, in_nested=in_nested)
+
+    # --- resolution -------------------------------------------------------
+    def _record_call(self, call: ast.Call, held: frozenset) -> None:
+        desc = _call_name(call.func)
+        callees, kind = self._resolve(call.func)
+        self.graph.calls_total += 1
+        if callees:
+            self.graph.calls_resolved += 1
+        elif kind == "external":
+            self.graph.calls_external += 1
+        else:
+            self.graph.calls_unresolved += 1
+        cs = CallSite(callees, call.lineno, held, desc,
+                      "resolved" if callees else kind, call)
+        self.node.calls.append(cs)
+        self._record_callback_targets(call, cs, held)
+
+    def _resolve(self, func: ast.AST) -> tuple[list[str], str]:
+        """-> (callee qnames, kind-if-empty)."""
+        if isinstance(func, ast.Name):
+            return self._resolve_name(func.id)
+        if isinstance(func, ast.Attribute):
+            base = func.value
+            mname = func.attr
+            # self.m()
+            if isinstance(base, ast.Name) and base.id == "self" \
+                    and self.cls is not None:
+                q = self.graph.resolve_method(self.cls.name, mname)
+                return ([q], "resolved") if q else ([], "unresolved")
+            # self.attr.m()
+            battr = _self_attr(base)
+            if battr is not None and self.cls is not None:
+                return self._resolve_typed(
+                    self.cls.attr_types.get(battr, ()), mname)
+            # local.m()
+            if isinstance(base, ast.Name):
+                if base.id in self.local_types:
+                    return self._resolve_typed(
+                        self.local_types[base.id], mname)
+                if base.id in self.mod.import_modules:
+                    return [], "external"
+                if base.id in self.graph.classes:   # Cls.static_style()
+                    q = self.graph.resolve_method(base.id, mname)
+                    return ([q], "resolved") if q else ([], "unresolved")
+                return [], "unresolved"
+            # os.path.join style: imported module at the root
+            root = base
+            while isinstance(root, ast.Attribute):
+                root = root.value
+            if isinstance(root, ast.Name) and \
+                    root.id in self.mod.import_modules:
+                return [], "external"
+            return [], "unresolved"
+        return [], "unresolved"
+
+    def _resolve_typed(self, cnames: Iterable[str],
+                       mname: str) -> tuple[list[str], str]:
+        out = []
+        for cname in cnames:
+            q = self.graph.resolve_method(cname, mname)
+            if q:
+                out.append(q)
+        return (out, "resolved") if out else ([], "unresolved")
+
+    def _resolve_name(self, name: str) -> tuple[list[str], str]:
+        if name in self.mod.functions:
+            return [f"{self.mod.rel}::{name}"], "resolved"
+        if name in self.graph.classes:
+            q = self.graph.resolve_method(name, "__init__")
+            return ([q], "resolved") if q else ([], "external")
+        if name in self.mod.imported:
+            qs = self.graph.functions.get(name)
+            if qs:
+                return list(qs), "resolved"
+            return [], "external"   # stdlib / gated import
+        if name in _BUILTINS:
+            return [], "external"
+        return [], "unresolved"
+
+    def _callable_target(self, expr: ast.AST) -> Optional[str]:
+        """A callable ARGUMENT (`self._m`, bare function name,
+        `self.attr.m`) -> node qname when resolvable."""
+        attr = _self_attr(expr)
+        if attr is not None and self.cls is not None:
+            return self.graph.resolve_method(self.cls.name, attr)
+        if isinstance(expr, ast.Name):
+            if expr.id in self.mod.functions:
+                return f"{self.mod.rel}::{expr.id}"
+            return None
+        if isinstance(expr, ast.Attribute):
+            battr = _self_attr(expr.value)
+            if battr is not None and self.cls is not None:
+                for cname in self.cls.attr_types.get(battr, ()):
+                    q = self.graph.resolve_method(cname, expr.attr)
+                    if q:
+                        return q
+        return None
+
+    def _record_callback_targets(self, call: ast.Call, cs: CallSite,
+                                 held: frozenset) -> None:
+        """Thread/Timer/submit targets and callable args become edges:
+        attached to the RESOLVED callee when there is one (the callback
+        runs in its context), else to this node."""
+        targets: list[str] = []
+        for kw in call.keywords:
+            if kw.arg is None:
+                continue
+            t = self._callable_target(kw.value)
+            if t is not None:
+                targets.append(t)
+        for a in call.args:
+            t = self._callable_target(a)
+            if t is not None:
+                targets.append(t)
+        if not targets:
+            return
+        fname = _call_name(call.func).rsplit(".", 1)[-1]
+        if fname in ("Thread", "Timer", "submit"):
+            # runs on another thread: caller's held locks do NOT carry
+            for t in targets:
+                self.node.calls.append(CallSite(
+                    [t], call.lineno, frozenset(),
+                    f"{cs.desc}->callback", "resolved", call,
+                    spawn=True))
+            return
+        if cs.callees:
+            # synchronous callback: charge it to the receiving callee,
+            # whose lock context the propagation pass computes
+            for callee in cs.callees:
+                target_node = self.graph.nodes.get(callee)
+                if target_node is not None:
+                    for t in targets:
+                        target_node.calls.append(CallSite(
+                            [t], call.lineno, frozenset(),
+                            f"callback-from:{self.node.qname}",
+                            "resolved", call))
+        else:
+            for t in targets:
+                self.node.calls.append(CallSite(
+                    [t], call.lineno, held,
+                    f"{cs.desc}->callback", "resolved", call))
+
+
+def build_from_sources(sources: list[tuple[str, str]]) -> CallGraph:
+    """Build a graph from (rel_path, source) pairs — the unit tests'
+    entry point and the engine's (via ``get_callgraph``)."""
+    graph = CallGraph()
+    mods: list[_ModuleIndex] = []
+    for rel, src in sources:
+        try:
+            tree = ast.parse(src, filename=rel)
+        except SyntaxError:
+            continue   # W101 owns parse errors
+        lines = src.splitlines()
+        graph.lines[rel] = lines
+        mod = _ModuleIndex(rel, tree, lines)
+        mods.append(mod)
+        for info in mod.classes:
+            graph.classes.setdefault(info.name, []).append(info)
+        for fname in mod.functions:
+            graph.functions.setdefault(fname, []).append(
+                f"{rel}::{fname}")
+        graph.module_locks[rel] = mod.locks
+    # register nodes before the body walk so callback attachment can
+    # find callee nodes across modules
+    walk_plan: list[tuple[_ModuleIndex, Node, Optional[ClassInfo]]] = []
+    for mod in mods:
+        for fname, fn in mod.functions.items():
+            node = Node(f"{mod.rel}::{fname}", mod.rel, None, fname, fn)
+            graph.nodes[node.qname] = node
+            walk_plan.append((mod, node, None))
+        for info in mod.classes:
+            for mname, fn in info.methods.items():
+                q = f"{mod.rel}::{info.name}.{mname}"
+                node = Node(q, mod.rel, info.name, mname, fn)
+                graph.nodes[q] = node
+                walk_plan.append((mod, node, info))
+    for mod, node, cls in walk_plan:
+        _FunctionWalker(graph, mod, node, cls).run()
+    return graph
+
+
+def get_callgraph(repo) -> CallGraph:
+    """The per-run graph, built once and cached on the Repo ctx —
+    every interprocedural rule reuses it."""
+    cached = getattr(repo, "_weedlint_callgraph", None)
+    if cached is not None:
+        return cached
+    sources = [(ctx.rel, ctx.source)
+               for ctx in repo.package_files(PACKAGE)]
+    graph = build_from_sources(sources)
+    repo._weedlint_callgraph = graph
+    return graph

@@ -24,7 +24,13 @@ CLI (python -m tools.weedlint):
 
     python -m tools.weedlint [root] [--rule W501[,W502]] [--json]
                              [--update-baseline] [--baseline PATH]
-                             [--list-rules]
+                             [--list-rules] [--changed-only [REF]]
+
+``--changed-only`` (the pre-commit fast path) restricts REPORTED
+findings to files changed vs the git ref (default HEAD, worktree diff
+plus untracked); analysis still covers the whole repo, because the
+interprocedural rules (W503/W504 over the cached call graph in
+callgraph.py) need the whole program to be right.
 
 Exit 0 = clean (after waivers + baseline), 1 = findings, 2 = usage.
 The ``--json`` document is stable and documented (README "Static
@@ -263,9 +269,10 @@ def _load_builtin_rules() -> None:
     if _loaded:
         return
     _loaded = True
-    from . import (rules_async_drain, rules_faults,  # noqa: F401
-                   rules_health_keys, rules_lockset, rules_py310,
-                   rules_resources, rules_routes, rules_tracing)
+    from . import (rules_async_drain, rules_blocking,  # noqa: F401
+                   rules_faults, rules_health_keys, rules_lockorder,
+                   rules_lockset, rules_py310, rules_resources,
+                   rules_routes, rules_tracing)
 
 
 # --- waivers -----------------------------------------------------------------
@@ -407,16 +414,18 @@ def apply_baseline(findings: list[Finding],
 class RunResult:
     def __init__(self, root: str, rules: list[Rule],
                  findings: list[Finding], waived: list[Finding],
-                 baselined: list[Finding], files_checked: int):
+                 baselined: list[Finding], files_checked: int,
+                 callgraph_stats: Optional[dict] = None):
         self.root = root
         self.rules = rules
         self.findings = findings
         self.waived = waived
         self.baselined = baselined
         self.files_checked = files_checked
+        self.callgraph_stats = callgraph_stats
 
     def to_dict(self) -> dict:
-        return {
+        doc = {
             "version": 1,
             "root": self.root,
             "files_checked": self.files_checked,
@@ -426,18 +435,56 @@ class RunResult:
                        "waived": len(self.waived),
                        "baselined": len(self.baselined)},
         }
+        if self.callgraph_stats is not None:
+            # interprocedural-rule health: a resolution regression
+            # (unresolved ratio creeping up) silently blinds W503/W504,
+            # so the stats ride every JSON document for test logs to
+            # diff (test_weedlint pins the ratio)
+            doc["callgraph_stats"] = self.callgraph_stats
+        return doc
+
+
+def changed_files(root: str, ref: str) -> set[str]:
+    """ROOT-relative paths changed vs `ref` (worktree diff + untracked)
+    — the --changed-only pre-commit fast path's file set.  `--relative`
+    matters: findings carry root-relative paths, and when the lint root
+    is a subdirectory of the git toplevel a plain `git diff` would emit
+    toplevel-relative paths that never intersect them (every finding
+    silently filtered away).  `ls-files` is cwd-relative already."""
+    import subprocess
+
+    out: set[str] = set()
+    for args in (["git", "-C", root, "diff", "--relative",
+                  "--name-only", ref],
+                 ["git", "-C", root, "ls-files", "--others",
+                  "--exclude-standard"]):
+        p = subprocess.run(args, capture_output=True, text=True,
+                           timeout=60)
+        if p.returncode != 0:
+            raise RuntimeError(
+                f"git failed for --changed-only ({ref}): "
+                f"{p.stderr.strip() or p.stdout.strip()}")
+        out.update(line.strip() for line in p.stdout.splitlines()
+                   if line.strip())
+    return out
 
 
 def run(root: str, rule_ids: Optional[list[str]] = None,
         baseline_path: Optional[str] = None,
         on_rule_error: Optional[Callable[[Rule, Exception], None]] = None,
-        ignore_baseline: bool = False) -> RunResult:
+        ignore_baseline: bool = False,
+        paths_filter: Optional[set[str]] = None) -> RunResult:
     """One full lint pass.  `rule_ids` restricts which rules run
     (waiver hygiene always runs); a rule that crashes surfaces as a
     finding against itself instead of killing the run.
     `ignore_baseline` reports the grandfathered findings too — the
     --update-baseline path needs the FULL set, or regenerating on a
-    clean repo would wipe every entry and fail the next run."""
+    clean repo would wipe every entry and fail the next run.
+    `paths_filter` (--changed-only) restricts REPORTED findings to
+    those paths; every rule still analyzes the whole repo (the call
+    graph and cross-file contracts need the whole program) — only the
+    reporting is scoped, so the fast path can never let a cross-file
+    regression through into a later full run silently."""
     repo = Repo(root)
     rules = all_rules()
     if rule_ids:
@@ -464,9 +511,14 @@ def run(root: str, rule_ids: Optional[list[str]] = None,
     bl_path = baseline_path or os.path.join(repo.root, BASELINE_REL)
     baseline = {} if ignore_baseline else load_baseline(bl_path)
     findings, baselined = apply_baseline(findings, baseline)
+    if paths_filter is not None:
+        findings = [f for f in findings if f.path in paths_filter]
     findings.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
+    graph = getattr(repo, "_weedlint_callgraph", None)
     return RunResult(repo.root, rules, findings, waived, baselined,
-                     len(repo.files()))
+                     len(repo.files()),
+                     callgraph_stats=(graph.stats()
+                                      if graph is not None else None))
 
 
 # --- CLI ---------------------------------------------------------------------
@@ -483,6 +535,7 @@ def main(argv: Optional[list[str]] = None) -> int:
     as_json = False
     update_baseline = False
     baseline_path = None
+    changed_ref: Optional[str] = None
     i = 0
     while i < len(argv):
         a = argv[i]
@@ -490,6 +543,14 @@ def main(argv: Optional[list[str]] = None) -> int:
             as_json = True
         elif a == "--update-baseline":
             update_baseline = True
+        elif a == "--changed-only":
+            # optional ref argument (defaults to HEAD); a following
+            # token that is an existing directory is the ROOT, not a ref
+            changed_ref = "HEAD"
+            if i + 1 < len(argv) and not argv[i + 1].startswith("-") \
+                    and not os.path.isdir(argv[i + 1]):
+                i += 1
+                changed_ref = argv[i]
         elif a == "--list-rules":
             for r in all_rules():
                 print(f"{r.id}  {r.name:<22} {r.summary}")
@@ -499,7 +560,9 @@ def main(argv: Optional[list[str]] = None) -> int:
             if i >= len(argv):
                 print("--rule needs an argument", file=sys.stderr)
                 return 2
-            rule_ids = [s.strip() for s in argv[i].split(",") if s.strip()]
+            # repeated --rule flags accumulate (--rule W503 --rule W504)
+            rule_ids = (rule_ids or []) + [
+                s.strip() for s in argv[i].split(",") if s.strip()]
         elif a == "--baseline":
             i += 1
             if i >= len(argv):
@@ -520,9 +583,28 @@ def main(argv: Optional[list[str]] = None) -> int:
     # must win over any installed copy
     if root not in sys.path:
         sys.path.insert(0, root)
+    paths_filter = None
+    if changed_ref is not None and update_baseline:
+        # a baseline regenerated from a FILTERED finding set would
+        # silently delete every other grandfathered entry
+        print("--update-baseline cannot be combined with "
+              "--changed-only: the baseline must be regenerated from "
+              "the full finding set", file=sys.stderr)
+        return 2
+    if changed_ref is not None:
+        try:
+            paths_filter = changed_files(root, changed_ref)
+        except (RuntimeError, OSError) as e:
+            print(str(e), file=sys.stderr)
+            return 2
+        if not paths_filter:
+            print(f"weedlint: no files changed vs {changed_ref}",
+                  file=sys.stderr)
+            return 0
     try:
         result = run(root, rule_ids, baseline_path,
-                     ignore_baseline=update_baseline)
+                     ignore_baseline=update_baseline,
+                     paths_filter=paths_filter)
     except KeyError as e:
         print(str(e), file=sys.stderr)
         return 2
@@ -537,9 +619,17 @@ def main(argv: Optional[list[str]] = None) -> int:
     else:
         for f in result.findings:
             print(f.render())
+    scope = f", changed vs {changed_ref} only" if changed_ref else ""
     print(f"weedlint: {result.files_checked} files, "
           f"{len(result.rules)} rule(s), "
           f"{len(result.findings)} finding(s) "
           f"({len(result.waived)} waived, "
-          f"{len(result.baselined)} baselined)", file=sys.stderr)
+          f"{len(result.baselined)} baselined{scope})", file=sys.stderr)
+    if result.callgraph_stats:
+        s = result.callgraph_stats
+        print(f"weedlint: callgraph {s['nodes']} nodes, "
+              f"{s['edges']} edges, "
+              f"{s['calls_unresolved']}/{s['calls_total']} calls "
+              f"unresolved ({s['unresolved_ratio']:.0%})",
+              file=sys.stderr)
     return 1 if result.findings else 0

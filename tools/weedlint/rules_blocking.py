@@ -1,0 +1,298 @@
+"""W504: blocking calls reachable while a lock is held.
+
+The stall signature our own alerting stack keeps attributing to
+"drain_blocked" is almost never the drain: it is a hot lock held
+across something slow — a shipper flushing a batch POST with its
+buffer lock held, a scrubber reading a shard file inside ``_lock``, a
+``Queue.get()`` with no timeout under a supervisor lock.  Every thread
+that wants the lock then stalls behind one slow syscall, and at
+production concurrency that reads as a cluster-wide latency cliff.
+
+The rule classifies BLOCKING-CAPABLE call sites:
+
+  - ``http-egress``: the repo's socket/HTTP chokepoints
+    (``_pooled_request`` / ``http_json`` / ``http_bytes`` /
+    ``http_download`` / ``http_post_file`` / ``urlopen``) — network
+    round trips with multi-second timeouts;
+  - ``sleep``: ``time.sleep(...)``;
+  - ``queue``: ``.get()`` on a ``queue.Queue``-typed attribute
+    without a timeout, and ``.put()`` likewise but only on BOUNDED
+    queues — an unbounded ``Queue()`` put never blocks (``*_nowait``
+    is exempt throughout);
+  - ``event-wait``: ``.wait()`` with no timeout on an
+    ``threading.Event``-typed attribute;
+  - ``subprocess``: any ``subprocess.*`` invocation;
+  - ``file-read``: an unbounded ``.read()`` on a handle opened in the
+    same function (no size argument — the static stand-in for "over
+    the size threshold").
+
+and fires when such a site executes while a lock is held — lexically
+inside ``with self._lock``, under a ``# holds:`` / ``*_locked`` entry
+contract, or in a function REACHABLE through the call graph from a
+call made with a lock held (the interprocedural case; the hint prints
+the lock and the call chain).
+
+Audited exceptions are waived AT THE BLOCKING LINE with::
+
+    # weedlint: lock-io <why this blocking call is safe under the lock>
+
+A ``lock-io`` waiver without a reason is itself a finding — the whole
+point is a greppable audit trail of every place the repo blocks under
+a lock on purpose.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Optional
+
+from .callgraph import CallGraph, CallSite, Node, get_callgraph
+from .engine import Finding, Repo, Rule, register
+
+_LOCK_IO_RE = re.compile(r"#\s*weedlint:\s*lock-io(?:\s+(.*))?$")
+
+EGRESS_CALLS = {"_pooled_request", "http_json", "http_bytes",
+                "http_download", "http_post_file", "http_delete",
+                "urlopen"}
+
+_SUBPROCESS_FNS = {"run", "call", "check_call", "check_output",
+                   "Popen", "communicate"}
+
+
+def _last(desc: str) -> str:
+    return desc.rsplit(".", 1)[-1]
+
+
+def _queue_blocking(call: ast.Call, last: str) -> bool:
+    """True when a Queue ``get``/``put`` can block forever: no
+    ``timeout=``, no positional timeout, no ``block=False``.  ``get``
+    signature is (block, timeout); ``put`` is (item, block, timeout)."""
+    if any(kw.arg == "timeout" for kw in call.keywords):
+        return False
+    if any(kw.arg == "block" and isinstance(kw.value, ast.Constant)
+           and kw.value.value is False for kw in call.keywords):
+        return False
+    first_flag = 0 if last == "get" else 1
+    args = call.args
+    if len(args) > first_flag + 1:
+        return False   # positional timeout given
+    if len(args) == first_flag + 1 and \
+            isinstance(args[first_flag], ast.Constant) and \
+            args[first_flag].value is False:
+        return False   # block=False positionally
+    return True
+
+
+def _queue_event_receiver(call: ast.Call, node: Node,
+                          graph: CallGraph,
+                          kinds: str) -> bool:
+    """Is the receiver of ``X.get()`` / ``X.put()`` / ``X.wait()`` a
+    Queue/Event-typed self attribute?  For ``put``, only BOUNDED queues
+    count — an unbounded ``Queue()`` put never blocks."""
+    f = call.func
+    if not isinstance(f, ast.Attribute):
+        return False
+    base = f.value
+    if isinstance(base, ast.Attribute) and \
+            isinstance(base.value, ast.Name) and base.value.id == "self":
+        info = graph.class_of(node.cls) if node.cls else None
+        if info is None:
+            return False
+        if kinds == "queue-get":
+            attrs = info.queue_attrs
+        elif kinds == "queue-put":
+            attrs = info.bounded_queue_attrs
+        else:
+            attrs = info.event_attrs
+        return base.attr in attrs
+    return False
+
+
+def classify_blocking(cs: CallSite, node: Node,
+                      graph: CallGraph) -> Optional[str]:
+    """Blocking category for one call site, or None."""
+    desc = cs.desc
+    last = _last(desc)
+    call = cs.node
+    if desc in ("time.sleep", "sleep"):
+        return "sleep"
+    if last in EGRESS_CALLS:
+        return "http-egress"
+    if desc.startswith("subprocess.") and last in _SUBPROCESS_FNS:
+        return "subprocess"
+    if last in ("get", "put") and _queue_blocking(call, last) \
+            and _queue_event_receiver(call, node, graph,
+                                      f"queue-{last}"):
+        return "queue"
+    if last == "wait" and not call.args and not call.keywords \
+            and _queue_event_receiver(call, node, graph, "event"):
+        return "event-wait"
+    if last == "read" and not call.args and not call.keywords \
+            and _reads_opened_handle(call, node):
+        return "file-read"
+    return None
+
+
+def _reads_opened_handle(call: ast.Call, node: Node) -> bool:
+    """``fh.read()`` where fh was bound from open(...) in this
+    function (incl. ``with open(...) as fh``)."""
+    f = call.func
+    if not (isinstance(f, ast.Attribute) and
+            isinstance(f.value, ast.Name)):
+        return False
+    name = f.value.id
+    for sub in ast.walk(node.fn):
+        if isinstance(sub, ast.Assign) and len(sub.targets) == 1 \
+                and isinstance(sub.targets[0], ast.Name) \
+                and sub.targets[0].id == name \
+                and isinstance(sub.value, ast.Call) \
+                and isinstance(sub.value.func, ast.Name) \
+                and sub.value.func.id == "open":
+            return True
+        if isinstance(sub, ast.withitem) \
+                and isinstance(sub.optional_vars, ast.Name) \
+                and sub.optional_vars.id == name \
+                and isinstance(sub.context_expr, ast.Call) \
+                and isinstance(sub.context_expr.func, ast.Name) \
+                and sub.context_expr.func.id == "open":
+            return True
+    return False
+
+
+class _Origin:
+    """One call site executed with a lock held — the root a
+    reachability finding anchors to (that is where the fix or the
+    waiver belongs, not the shared utility at the end of the chain)."""
+
+    __slots__ = ("qname", "rel", "lineno", "lock")
+
+    def __init__(self, qname: str, rel: str, lineno: int, lock: str):
+        self.qname = qname
+        self.rel = rel
+        self.lineno = lineno
+        self.lock = lock
+
+
+def _lock_reachable(
+        graph: CallGraph) -> dict[str, list[tuple[_Origin, list[str]]]]:
+    """qname -> [(origin, shortest chain from origin)] for every
+    function reachable from a call made with a lock held.  One BFS per
+    origin so EVERY under-lock entry point is witnessed — fixing one
+    origin must not hide the next."""
+    edges = graph.sync_edges()
+    reach: dict[str, list[tuple[_Origin, list[str]]]] = {}
+    for q, node in graph.nodes.items():
+        for cs in node.calls:
+            if not cs.held or cs.spawn:
+                continue
+            origin = _Origin(q, node.rel, cs.lineno, sorted(cs.held)[0])
+            seen: set[str] = set()
+            queue: list[tuple[str, list[str]]] = [
+                (callee, [callee]) for callee in sorted(cs.callees)]
+            seen.update(c for c, _ in queue)
+            while queue:
+                cur, chain = queue.pop(0)
+                reach.setdefault(cur, []).append((origin, chain))
+                for callee in sorted(edges.get(cur, ())):
+                    if callee not in seen:
+                        seen.add(callee)
+                        queue.append((callee, chain + [callee]))
+    return reach
+
+
+_HINT = ("move the call outside the lock (snapshot under the lock, do "
+         "I/O after), or waive with `# weedlint: lock-io <reason>` if "
+         "the block is audited and deliberate")
+
+
+def check_blocking(graph: CallGraph) -> list[Finding]:
+    reach = _lock_reachable(graph)
+    findings: list[Finding] = []
+    seen: set[tuple] = set()
+
+    def report(rel: str, lineno: int, message: str, desc: str,
+               hint: str) -> None:
+        waiver = _lock_io_waiver(graph, rel, lineno)
+        if waiver is not None:
+            if waiver:
+                return   # audited, reasoned: suppressed
+            key = (rel, lineno, "no-reason")
+            if key not in seen:
+                seen.add(key)
+                findings.append(Finding(
+                    "W504", rel, lineno,
+                    f"lock-io waiver on `{desc}` has no reason",
+                    "# weedlint: lock-io <why blocking under this "
+                    "lock is safe>"))
+            return
+        key = (rel, lineno, message)
+        if key not in seen:
+            seen.add(key)
+            findings.append(Finding("W504", rel, lineno, message, hint))
+
+    for q, node in graph.nodes.items():
+        entry = node.entry_holds
+        for cs in node.calls:
+            cat = classify_blocking(cs, node, graph)
+            if cat is None:
+                continue
+            # 1) a lock is held HERE — lexically (`with self.<lock>`),
+            # or for the whole method via a `# holds:`/`*_locked`
+            # entry contract; either way this line is the anchor
+            if cs.held:
+                lexical = cs.held - entry
+                if lexical:
+                    lock = sorted(lexical)[0]
+                    if ".py:" in lock:   # module-level lock
+                        how = f"under `with {lock.rsplit(':', 1)[-1]}`"
+                    else:
+                        how = ("under `with "
+                               f"self.{lock.rsplit('.', 1)[-1]}`")
+                else:
+                    lock = sorted(cs.held)[0]
+                    how = ("declared `# holds:`/`*_locked` — every "
+                           "caller holds the lock")
+                report(node.rel, cs.lineno,
+                       f"{q} performs blocking {cat} call `{cs.desc}` "
+                       f"while {lock} is held ({how})",
+                       cs.desc, _HINT)
+                continue
+            # 2) reachable through the call graph from an under-lock
+            # call — anchor at THAT call (the origin is where the fix
+            # or waiver belongs, not the shared utility at the end of
+            # the chain); every distinct origin is reported
+            for origin, chain in reach.get(q, ()):
+                report(origin.rel, origin.lineno,
+                       f"{origin.qname} calls into "
+                       f"{chain[0].split('::')[-1]} while holding "
+                       f"{origin.lock}; {q.split('::')[-1]} performs "
+                       f"blocking {cat} call `{cs.desc}` "
+                       f"({node.rel}:{cs.lineno}) on that path",
+                       cs.desc,
+                       f"{_HINT}.  call chain: {origin.qname} -> "
+                       + " -> ".join(c.split("::")[-1] for c in chain))
+    findings.sort(key=lambda f: (f.path, f.line, f.message))
+    return findings
+
+
+def _lock_io_waiver(graph: CallGraph, rel: str,
+                    lineno: int) -> Optional[str]:
+    """The lock-io waiver on this line: None = no waiver, "" = waiver
+    without a reason, else the reason text."""
+    m = _LOCK_IO_RE.search(graph.line(rel, lineno))
+    if m is None:
+        return None
+    return (m.group(1) or "").strip()
+
+
+@register
+class BlockingUnderLockRule(Rule):
+    id = "W504"
+    name = "blocking-under-lock"
+    summary = ("blocking calls (HTTP egress, sleep, timeout-less "
+               "queue/event waits, subprocess, unbounded reads) must "
+               "not be reachable while a lock is held")
+
+    def check(self, repo: Repo) -> list[Finding]:
+        return check_blocking(get_callgraph(repo))

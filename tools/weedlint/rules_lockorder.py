@@ -1,0 +1,246 @@
+"""W503: interprocedural lock-ordering (deadlock) analysis.
+
+A data race corrupts state; a lock-order cycle takes the whole server
+down.  The per-class lockset checker cannot see this bug because it is
+interprocedural by nature: thread 1 runs ``A.push`` (``with
+self._lock`` then calls ``B.notify`` which takes ``B._lock``) while
+thread 2 runs ``B.drain`` (``with self._lock`` then calls ``A.stats``
+which takes ``A._lock``) — classic ABBA, invisible to any pass that
+stops at the class boundary.
+
+The rule builds a global LOCK-ACQUISITION graph over the shared call
+graph (callgraph.py):
+
+  - node: a lock at class granularity (``EventShipper._lock``) or
+    module granularity (``mod.py:GLOBAL_LOCK``);
+  - edge L1 -> L2: somewhere, L2 is (or can transitively be) acquired
+    while L1 is held — from lexical ``with`` nesting, from a
+    ``# holds:`` / ``*_locked`` entry contract followed by a ``with``,
+    or from a call made under L1 into code whose transitive
+    acquisition set contains L2.
+
+Every cycle in that graph is a potential deadlock and is reported ONCE
+(per strongly connected component) with the full acquisition path in
+the finding hint — each hop names the function, file and line that
+creates the edge, which is exactly the evidence needed to pick a
+global order and fix it.
+
+Self-cycles (re-acquiring a lock already held) are reported only for
+non-reentrant locks and only from an explicit ``# holds:`` contract or
+lexical nesting — the ``*_locked`` suffix seed is deliberately
+excluded from self-cycle evidence because it over-approximates which
+lock is held.
+
+False-cycle caveat (documented blind spot): class-granular lock
+identity merges all instances of a class, so two DIFFERENT instances
+locking in opposite orders report as a cycle even when the runtime
+objects are distinct.  That report is still actionable (instance
+disambiguation is exactly what a reviewer must prove), and a reviewed
+exception is waived on the acquisition line with
+``# weedlint: disable=W503 <why the cycle cannot happen>``.
+"""
+
+from __future__ import annotations
+
+from .callgraph import CallGraph, get_callgraph
+from .engine import Finding, Repo, Rule, register
+
+
+class _Edge:
+    __slots__ = ("src", "dst", "rel", "lineno", "why")
+
+    def __init__(self, src: str, dst: str, rel: str, lineno: int,
+                 why: str):
+        self.src = src
+        self.dst = dst
+        self.rel = rel
+        self.lineno = lineno
+        self.why = why
+
+
+def _transitive_acquires(graph: CallGraph) -> dict[str, dict[str, tuple]]:
+    """qname -> {lock id: (rel, lineno, via)} for every lock the
+    function may acquire itself or through any resolvable callee.
+    Fixpoint iteration (the graph has cycles: supervisors respawn
+    workers that call back into the supervisor).  Spawn edges
+    (Thread/Timer/submit) are excluded: a lock taken on the spawned
+    thread never nests under the spawner's held locks."""
+    edges = graph.sync_edges()
+    acq: dict[str, dict[str, tuple]] = {}
+    for q, node in graph.nodes.items():
+        acq[q] = {a.lock: (node.rel, a.lineno, q)
+                  for a in node.acquires}
+    changed = True
+    while changed:
+        changed = False
+        for q in graph.nodes:
+            mine = acq[q]
+            for callee in edges.get(q, ()):
+                for lock, wit in acq.get(callee, {}).items():
+                    if lock not in mine:
+                        mine[lock] = wit
+                        changed = True
+    return acq
+
+
+def build_lock_graph(graph: CallGraph) -> dict[str, dict[str, _Edge]]:
+    """src lock -> {dst lock: witness edge}."""
+    acq_star = _transitive_acquires(graph)
+    out: dict[str, dict[str, _Edge]] = {}
+
+    def add(src: str, dst: str, rel: str, lineno: int, why: str,
+            allow_self: bool = False) -> None:
+        if src == dst and not allow_self:
+            return
+        out.setdefault(src, {})
+        if dst not in out[src]:
+            out[src][dst] = _Edge(src, dst, rel, lineno, why)
+
+    for q, node in graph.nodes.items():
+        explicit_holds = "holds:" in graph.line(node.rel, node.lineno)
+        # lexical + contract-entry nesting
+        for a in node.acquires:
+            for held in a.held:
+                # self-cycle (re-acquiring a held non-reentrant lock)
+                # only counts when the held set is trustworthy: lexical
+                # nesting, or an explicit `# holds:` on the def line —
+                # never the *_locked suffix's over-approximation
+                held_is_lexical = held not in node.entry_holds
+                allow_self = not a.reentrant and \
+                    (held_is_lexical or explicit_holds)
+                add(held, a.lock, node.rel, a.lineno,
+                    f"{q} acquires {a.lock} at {node.rel}:{a.lineno} "
+                    f"while holding {held}",
+                    allow_self=allow_self)
+        # interprocedural: a call under L1 reaches code acquiring L2
+        for cs in node.calls:
+            if not cs.held or cs.spawn:
+                continue
+            for callee in cs.callees:
+                for lock, (wrel, wline, wq) in \
+                        acq_star.get(callee, {}).items():
+                    for held in cs.held:
+                        add(held, lock, node.rel, cs.lineno,
+                            f"{q} calls {cs.desc} at "
+                            f"{node.rel}:{cs.lineno} holding {held}; "
+                            f"{wq} acquires {lock} at {wrel}:{wline}")
+    return out
+
+
+def _sccs(adj: dict[str, dict[str, _Edge]]) -> list[list[str]]:
+    """Tarjan, iterative.  Returns components with a cycle (size > 1,
+    or a self-edge)."""
+    index: dict[str, int] = {}
+    low: dict[str, int] = {}
+    on_stack: set[str] = set()
+    stack: list[str] = []
+    out: list[list[str]] = []
+    counter = [0]
+    nodes = sorted(set(adj) | {d for m in adj.values() for d in m})
+
+    for root in nodes:
+        if root in index:
+            continue
+        work = [(root, iter(sorted(adj.get(root, {}))))]
+        index[root] = low[root] = counter[0]
+        counter[0] += 1
+        stack.append(root)
+        on_stack.add(root)
+        while work:
+            v, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    on_stack.add(w)
+                    work.append((w, iter(sorted(adj.get(w, {})))))
+                    advanced = True
+                    break
+                if w in on_stack:
+                    low[v] = min(low[v], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                pv = work[-1][0]
+                low[pv] = min(low[pv], low[v])
+            if low[v] == index[v]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    comp.append(w)
+                    if w == v:
+                        break
+                if len(comp) > 1 or v in adj.get(v, {}):
+                    out.append(sorted(comp))
+    return out
+
+
+def _cycle_path(adj: dict[str, dict[str, _Edge]],
+                comp: list[str]) -> list[_Edge]:
+    """One concrete simple cycle through the SCC, as witness edges."""
+    comp_set = set(comp)
+    start = comp[0]
+    if len(comp) == 1:
+        return [adj[start][start]]
+    # BFS back to start constrained to the component
+    parent: dict[str, tuple[str, _Edge]] = {}
+    queue = [start]
+    seen = {start}
+    while queue:
+        v = queue.pop(0)
+        for w, e in sorted(adj.get(v, {}).items()):
+            if w not in comp_set:
+                continue
+            if w == start and v != start:
+                path = [e]
+                cur = v
+                while cur != start:
+                    p, pe = parent[cur]
+                    path.append(pe)
+                    cur = p
+                return list(reversed(path))
+            if w not in seen:
+                seen.add(w)
+                parent[w] = (v, e)
+                queue.append(w)
+    return []  # pragma: no cover - SCC guarantees a cycle exists
+
+
+def check_lock_order(graph: CallGraph) -> list[Finding]:
+    adj = build_lock_graph(graph)
+    findings: list[Finding] = []
+    for comp in _sccs(adj):
+        path = _cycle_path(adj, comp)
+        if not path:
+            continue
+        cycle = " -> ".join([e.src for e in path] + [path[0].src])
+        anchor = path[0]
+        hint = "; ".join(e.why for e in path)
+        # the whole SCC is the deadlock-entangled lock SET (transitive
+        # edges can make the shortest witness cycle skip members) —
+        # name all of it, then give one concrete interleaving
+        members = ", ".join(comp)
+        findings.append(Finding(
+            "W503", anchor.rel, anchor.lineno,
+            f"lock-order cycle (potential deadlock) among "
+            f"{{{members}}}; witness cycle {cycle}",
+            f"acquisition path: {hint}.  Pick one global order (or "
+            f"drop a lock before the cross-class call); waive on this "
+            f"line only with proof the instances cannot interleave"))
+    findings.sort(key=lambda f: (f.path, f.line, f.message))
+    return findings
+
+
+@register
+class LockOrderRule(Rule):
+    id = "W503"
+    name = "lock-order-cycle"
+    summary = ("lock-acquisition cycles across the whole-program call "
+               "graph are potential deadlocks")
+
+    def check(self, repo: Repo) -> list[Finding]:
+        return check_lock_order(get_callgraph(repo))
