@@ -54,7 +54,7 @@ SECTION_CAPS = {
     "multi_decode": 240, "batched_needles": 120, "rebuild": 180,
     "transfer": 90, "e2e_stream": 600, "e2e_rebuild": 300,
     "e2e_decode_8gb": 420, "roofline": 90, "cluster": 360,
-    "cluster_traced": 300, "alerts": 420,
+    "cluster_traced": 300, "alerts": 420, "coordinator": 420,
     "cluster_native": 360, "cluster_scaled": 420, "parity": 120,
     "integrity": 120, "pipeline_health": 15,
 }
@@ -1216,6 +1216,208 @@ def _child(scratch_path: str, platform: str = "") -> None:
         detail["alerts"] = block
 
     section("alerts", meas_alerts)
+
+    # --- rebuild/rebalance coordinator: MTTR + convergence + idle cost -----
+    def _coordinator_drill(size_mb=64):
+        """The acceptance chain with a clock on it: inject
+        ec.shard.corrupt on a 64MB EC volume spread over three racks ->
+        the scrubber quarantines (locally unrepairable) -> the alert
+        fires -> the ENABLED coordinator repairs cross-server with no
+        manual intervention.  mttr_s = injection to the registry
+        showing 14 clean shards again.  Then a fresh server joins a
+        fourth rack and the continuous rebalance pass runs to
+        convergence (rebalance_moves, skew before/after)."""
+        import tempfile as _tf
+
+        from seaweedfs_tpu.master.server import MasterServer
+        from seaweedfs_tpu.observability import (disable_tracing,
+                                                 enable_tracing)
+        from seaweedfs_tpu.storage.needle import Needle
+        from seaweedfs_tpu.storage.volume import Volume
+        from seaweedfs_tpu.utils import faultinject as fi
+        from seaweedfs_tpu.utils.httpd import http_json
+        from seaweedfs_tpu.volume_server.server import VolumeServer
+
+        out = {"alert_fired": False, "mttr_s": None,
+               "rebalance_moves": None}
+        roots = [_tf.mkdtemp() for _ in range(4)]
+        v = Volume(roots[0], "", 1)
+        chunk = rng.integers(0, 256, 1 << 20, dtype=np.uint8).tobytes()
+        for i in range(1, size_mb + 1):
+            v.write_needle(Needle(cookie=i, id=i, data=chunk))
+        v.close()
+        enable_tracing()
+        master = MasterServer(port=_free_port(), pulse_seconds=0.3,
+                              metrics_aggregation_seconds=0.25,
+                              coordinator_seconds=0.3).start()
+        master.aggregator.min_interval = 0.0
+        master.alert_engine.min_interval = 0.0
+        master.coordinator.pause("setup")
+        master.coordinator.move_rate = 100.0
+        servers = [VolumeServer([roots[i]], master.url,
+                                port=_free_port(), rack=f"r{i}",
+                                data_center="dc1",
+                                pulse_seconds=0.3).start()
+                   for i in range(3)]
+
+        def registry():
+            with master.topo.lock:
+                locs = master.topo.ec_shard_locations.get(1, {})
+                return {sid: [n.url for n in ns]
+                        for sid, ns in locs.items() if ns}
+
+        def wait_for(cond, timeout):
+            deadline = time.time() + timeout
+            while time.time() < deadline:
+                if cond():
+                    return True
+                time.sleep(0.1)
+            return False
+
+        try:
+            wait_for(lambda: len(master.topo.all_nodes()) == 3, 10)
+            servers[0].store.ec_generate(1)
+            servers[0].store.ec_mount(1)
+            # spread 14 shards over the three racks
+            layout = {1: [5, 6, 7, 8, 9], 2: [10, 11, 12, 13]}
+            for i, sids in layout.items():
+                http_json("POST",
+                          f"http://{servers[i].url}/admin/ec/copy",
+                          {"volume_id": 1, "shard_ids": sids,
+                           "source_data_node": servers[0].url},
+                          timeout=600)
+                http_json("POST",
+                          f"http://{servers[i].url}/admin/ec/mount",
+                          {"volume_id": 1})
+            http_json("POST",
+                      f"http://{servers[0].url}/admin/ec/delete",
+                      {"volume_id": 1,
+                       "shard_ids": [s for ss in layout.values()
+                                     for s in ss]})
+            http_json("POST",
+                      f"http://{servers[0].url}/admin/ec/mount",
+                      {"volume_id": 1})
+            http_json("POST",
+                      f"http://{servers[0].url}/admin/delete_volume",
+                      {"volume_id": 1})
+            for vs in servers:
+                vs.heartbeat_now()
+            wait_for(lambda: len(registry()) == 14, 10)
+            wait_for(lambda: master.alert_engine.evaluations > 0, 10)
+            master.coordinator.resume()
+
+            # inject: shard 7 rots on rack r1 — the clock starts HERE
+            fi.enable("ec.shard.corrupt",
+                      params={"shard": 7, "offset": 4096, "bit": 0},
+                      max_hits=1)
+            t0 = time.perf_counter()
+            http_json("POST",
+                      f"http://{servers[1].url}/ec/scrub/start",
+                      {"rate_mb_s": 0, "interval_s": 0})
+            # detection first: the quarantined shard leaves the
+            # registry (a full registry BEFORE detection must not read
+            # as already-healed)
+            detected = wait_for(lambda: 7 not in registry(), 60)
+            fi.clear()
+            healed = detected and wait_for(
+                lambda: set(registry()) == set(range(14)), 120)
+            if healed:
+                out["mttr_s"] = round(time.perf_counter() - t0, 2)
+            else:
+                out["error"] = ("corruption never detected"
+                                if not detected
+                                else "repair never converged")
+            firing = {a["name"] for a in
+                      master.alert_engine.to_dict()["alerts"]
+                      if a["state"] == "firing"}
+            out["alert_fired"] = bool(
+                firing & {"corrupt_shards_increase",
+                          "scrub_unrepairable",
+                          "ec_under_replicated_increase"})
+            # the repair_done event rides the shipper's flush cadence
+            wait_for(lambda: master.event_journal.query(
+                type_="repair_done", limit=5), 10)
+            done = master.event_journal.query(type_="repair_done",
+                                              limit=5)
+            if done:
+                out["repair_alert"] = done[-1]["details"].get(
+                    "alert", "")
+                out["repair_trace"] = done[-1].get("trace", "")
+
+            # rebalance convergence: a fresh server joins rack r3
+            def skew():
+                counts = {}
+                for sid, urls in registry().items():
+                    for u in urls:
+                        counts[u] = counts.get(u, 0) + 1
+                for vs in servers:
+                    counts.setdefault(vs.url, 0)
+                return max(counts.values()) - min(counts.values())
+
+            out["rebalance_skew_before"] = skew()
+            moves0 = master.coordinator.status()["moves"]
+            servers.append(VolumeServer(
+                [roots[3]], master.url, port=_free_port(), rack="r3",
+                data_center="dc1", pulse_seconds=0.3).start())
+            wait_for(lambda:
+                     master.coordinator.status()["moves"] > moves0, 30)
+
+            def settled():
+                a = master.coordinator.status()["moves"]
+                time.sleep(1.0)
+                return a == master.coordinator.status()["moves"]
+
+            wait_for(settled, 60)
+            out["rebalance_moves"] = \
+                master.coordinator.status()["moves"] - moves0
+            out["rebalance_skew_after"] = skew()
+            out["repairs"] = master.coordinator.status()["repairs"]
+        finally:
+            fi.clear()
+            for vs in servers:
+                vs.stop()
+            master.stop()
+            disable_tracing()
+        return out
+
+    def meas_coordinator():
+        """Idle-cost acceptance first: read rps with the coordinator +
+        evaluator BOTH live on the master vs a back-to-back plain
+        baseline (< 1% overhead — the coordinator plans on the master's
+        cadence; the volume-server hot path pays nothing).  Then the
+        in-process MTTR + rebalance drill."""
+        with spawn_cluster(1) as (mport, _root):
+            base_rates = run_bench(mport, 4000, use_tcp=False)
+        block = {"baseline_read_rps": base_rates.get("read", 0.0)}
+        with spawn_cluster(
+                1, extra_master_args=(
+                    "-metricsAggregationSeconds", "1",
+                    "-coordinatorSeconds", "1")) as (mport, _root):
+            rates = run_bench(mport, 4000, use_tcp=False)
+            block.update({"read_rps": rates.get("read", 0.0),
+                          "write_rps": rates.get("write", 0.0)})
+            base = block["baseline_read_rps"]
+            if base:
+                block["idle_overhead_pct"] = round(
+                    100.0 * (1.0 - rates.get("read", 0.0) / base), 2)
+            import urllib.request
+
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{mport}/cluster/coordinator",
+                        timeout=5) as r:
+                    doc = json.loads(r.read())
+                block["cycles"] = doc.get("cycles", 0)
+                block["enabled"] = doc.get("enabled", False)
+            except OSError:
+                block["error_coordinator_endpoint"] = "unreachable"
+        drill = _coordinator_drill()
+        block["mttr_s"] = drill.pop("mttr_s", None)
+        block["rebalance_moves"] = drill.pop("rebalance_moves", None)
+        block["drill"] = drill
+        detail["coordinator"] = block
+
+    section("coordinator", meas_coordinator)
 
     # --- native C++ data plane (GIL-free needle IO) -------------------------
     def meas_cluster_native():

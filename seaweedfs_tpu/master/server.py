@@ -46,6 +46,7 @@ class MasterServer:
                  maintenance_scripts: str = "",
                  maintenance_interval_seconds: float = 900.0,
                  metrics_aggregation_seconds: float = 0.0,
+                 coordinator_seconds: float = 0.0,
                  tls_context=None):
         self.host, self.port = host, port
         self.guard = guard or Guard()
@@ -105,6 +106,26 @@ class MasterServer:
             server=self.url,
             on_fire=self._on_alert_fire,
             exemplar_fn=self._alert_exemplar)
+        # autonomous EC rebuild/rebalance coordinator
+        # (ops/coordinator.py): subscribes to the cluster journal's
+        # ingest stream for its wake signal — the alert plane built in
+        # PR 9 is its input, not a parallel state derivation — and its
+        # master-local health contribution (ec_under_replicated,
+        # coordinator_repair_failures) folds into /cluster/health via
+        # the aggregator's local_fn hook.  The loop only runs when
+        # -coordinatorSeconds > 0; the routes and status doc exist
+        # regardless.
+        from ..ops.coordinator import EcCoordinator
+
+        self.coordinator_seconds = coordinator_seconds
+        self.coordinator = EcCoordinator(
+            topo=self.topo, server=self.url,
+            stale_peers_fn=self._stale_peers,
+            is_leader_fn=lambda: self.is_leader,
+            admin_locked_fn=self._admin_locked,
+            interval_s=coordinator_seconds or 15.0)
+        self.aggregator.local_fn = self.coordinator.health_contribution
+        self.event_journal.on_ingest = self.coordinator.on_events
         from .consensus import RaftNode
 
         self.raft = RaftNode(
@@ -214,10 +235,13 @@ class MasterServer:
             # the aggregation loop instead of adding its own
             threading.Thread(target=self._telemetry_loop, daemon=True,
                              name="master-telemetry").start()
+        if self.coordinator_seconds > 0:
+            self.coordinator.start()
         return self
 
     def stop(self) -> None:
         self._stop.set()
+        self.coordinator.stop()
         self._trace_shipper.detach()
         self._event_shipper.detach()
         self.aggregator.stop_loop()
@@ -370,6 +394,22 @@ class MasterServer:
                 self.alert_engine.evaluate(force=True)
             except Exception:
                 pass  # keep evaluating; rules carry their own errors
+
+    def _stale_peers(self) -> list[str]:
+        """Registered-but-unreachable volume servers (no scrape HTTP —
+        reads the aggregator's last-scrape bookkeeping): the
+        coordinator must not count their shards as clean or pick them
+        as repair sources/targets."""
+        return [u for u, s in self.aggregator.peer_status().items()
+                if s["stale"]]
+
+    def _admin_locked(self) -> bool:
+        """True while the shell's exclusive admin lock is validly held:
+        the coordinator pauses so an operator's manual ec.balance /
+        ec.rebuild never duels the autonomous one."""
+        with self.topo.lock:
+            return self._admin_token is not None and \
+                time.time() - self._admin_lock_ts <= 60
 
     def _alert_exemplar(self, rule) -> str:
         """The most recent cluster-journal event correlated with this
@@ -590,6 +630,31 @@ class MasterServer:
             return Response({"events": events, "count": len(events),
                              "total": len(self.event_journal),
                              "dropped": self.event_journal.dropped})
+
+        @r.route("GET", "/cluster/coordinator")
+        def cluster_coordinator(req: Request) -> Response:
+            """The rebuild/rebalance coordinator's state machine:
+            enabled/paused, the priority queue of degraded EC volumes
+            (clean-shard deficit, criticality, causing alert + trace),
+            repair/move totals, the token-bucket move budget, and the
+            most recent actions."""
+            self._require_leader(req)
+            return Response(self.coordinator.status())
+
+        @r.route("POST", "/cluster/coordinator/pause")
+        def cluster_coordinator_pause(req: Request) -> Response:
+            """Operator hold: no new repair or rebalance plans execute
+            until resume (in-flight plan steps finish).  The shell's
+            admin lock pauses implicitly; this survives the lock."""
+            self._require_leader(req)
+            self.coordinator.pause("api")
+            return Response(self.coordinator.status())
+
+        @r.route("POST", "/cluster/coordinator/resume")
+        def cluster_coordinator_resume(req: Request) -> Response:
+            self._require_leader(req)
+            self.coordinator.resume()
+            return Response(self.coordinator.status())
 
         @r.route("POST", "/cluster/events/ingest")
         def cluster_events_ingest(req: Request) -> Response:

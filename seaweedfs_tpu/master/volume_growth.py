@@ -36,6 +36,23 @@ def find_empty_slots(topo: Topology, rp: ReplicaPlacement,
             f"cannot satisfy replica placement {rp} with available nodes")
 
 
+def diversity_pools(main: DataNode, candidates: list[DataNode]
+                    ) -> tuple[list[DataNode], list[DataNode],
+                               list[DataNode]]:
+    """The three placement pools the xyz replica digits draw from,
+    relative to `main`: same rack, other racks of the same DC, other
+    DCs.  This rack/DC distance model is the ONE placement semantics in
+    the codebase — the EC coordinator's shard scorer
+    (ops/coordinator.py placement_rank) ranks candidate racks/DCs by
+    exactly these tiers, so replica growth and autonomous shard spread
+    agree on what "diverse" means."""
+    same_rack = list(main.rack.nodes.values())
+    diff_rack = [n for r in main.dc.racks.values() if r is not main.rack
+                 for n in r.nodes.values()]
+    diff_dc = [n for n in candidates if n.dc is not main.dc]
+    return same_rack, diff_rack, diff_dc
+
+
 def _pick_replicas(main: DataNode, candidates: list[DataNode],
                    rp: ReplicaPlacement) -> list[DataNode] | None:
     picked = [main]
@@ -51,20 +68,16 @@ def _pick_replicas(main: DataNode, candidates: list[DataNode],
             used.add(n.url)
         return True
 
+    same_rack, diff_rack, diff_dc = diversity_pools(main, candidates)
     # same rack copies (digit 3)
-    if rp.same_rack and not take(list(main.rack.nodes.values()), rp.same_rack):
+    if rp.same_rack and not take(same_rack, rp.same_rack):
         return None
     # other racks, same DC (digit 2)
-    if rp.diff_rack:
-        pool = [n for r in main.dc.racks.values() if r is not main.rack
-                for n in r.nodes.values()]
-        if not take(pool, rp.diff_rack):
-            return None
+    if rp.diff_rack and not take(diff_rack, rp.diff_rack):
+        return None
     # other DCs (digit 1)
-    if rp.diff_dc:
-        pool = [n for n in candidates if n.dc is not main.dc]
-        if not take(pool, rp.diff_dc):
-            return None
+    if rp.diff_dc and not take(diff_dc, rp.diff_dc):
+        return None
     return picked
 
 

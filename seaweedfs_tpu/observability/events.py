@@ -65,6 +65,12 @@ EVENT_TYPES = {
     "alert_resolved": "info",
     # flight recorder captures (observability/flightrecorder.py)
     "flight_capture": "info",
+    # rebuild/rebalance coordinator (ops/coordinator.py, master-side)
+    "ec_under_replicated": "error",  # volume dropped below k+1 clean
+    "repair_planned": "info",        # coordinator queued + started one
+    "repair_done": "info",           # volume back to full shard set
+    "repair_failed": "error",        # plan step failed; will re-plan
+    "rebalance_move": "info",        # one budgeted shard move executed
 }
 
 # HEALTH_FAMILIES key (stats/aggregate.py) -> the event type emitted at
@@ -76,6 +82,8 @@ HEALTH_EVENT_TYPES = {
     "degraded_binds": "degraded_bind",
     "corrupt_shards": "shard_corrupt",
     "scrub_repairs": "scrub_repair",
+    "ec_under_replicated": "ec_under_replicated",
+    "coordinator_repair_failures": "repair_failed",
 }
 
 
@@ -241,9 +249,13 @@ class ClusterEventJournal:  # weedlint: concurrent-class
         self._events: "OrderedDict[str, dict]" = OrderedDict()  # guarded-by: _lock
         self._lock = threading.Lock()
         self.dropped = 0  # guarded-by: _lock
+        # consumer hook: called OUTSIDE the lock with each batch of
+        # newly-accepted (non-duplicate) event dicts — the rebuild
+        # coordinator subscribes here instead of polling the journal
+        self.on_ingest: Optional[Callable[[list[dict]], None]] = None
 
     def ingest(self, server: str, events: list[dict]) -> int:
-        accepted = 0
+        accepted: list[dict] = []
         with self._lock:
             for e in events:
                 eid = e.get("id")
@@ -256,11 +268,17 @@ class ClusterEventJournal:  # weedlint: concurrent-class
                 # stays unattributed
                 e["via"] = server
                 self._events[eid] = e
-                accepted += 1
+                accepted.append(e)
             while len(self._events) > self.capacity:
                 self._events.popitem(last=False)
                 self.dropped += 1
-        return accepted
+        hook = self.on_ingest
+        if hook is not None and accepted:
+            try:
+                hook(list(accepted))
+            except Exception:
+                pass  # a broken consumer must never break ingest
+        return len(accepted)
 
     def query(self, type_: Optional[str] = None,
               severity: Optional[str] = None,

@@ -1,0 +1,1119 @@
+"""Autonomous EC rebuild & rebalance coordinator (master-side).
+
+PAPER.md's L4 control plane was reproduced as passive bookkeeping: the
+master knows every shard location (topology.py `ec_shard_locations`),
+the signal plane reports every degraded moment (/cluster/events,
+/cluster/alerts, /cluster/health), and nothing ACTS on any of it — a
+rack dying at 3am pages a human who then types `ec.rebuild` by hand.
+This module closes that loop with three separable layers:
+
+  ClusterView + planner   pure functions over a neutral topology
+                          snapshot: clean-shard deficits, a rack/DC-
+                          aware placement scorer (volume_growth.py's
+                          same-rack / other-rack / other-DC semantics
+                          turned into a ranking), rebuild-host choice,
+                          and dedupe/rack-diversity/skew rebalance
+                          plans.  No HTTP, no locks — unit-testable and
+                          shared verbatim by `weed shell` ec.rebuild /
+                          ec.balance, so manual and autonomous moves
+                          agree by construction.
+  PlanExecutor            the HTTP legs (/admin/ec/copy -> mount ->
+                          delete, /admin/ec/rebuild), transport
+                          injected so the shell drives it through
+                          CommandEnv and tests through fakes.  Every
+                          step passes the `coord.exec` fault point.
+  EcCoordinator           the master-side loop: subscribes to the
+                          cluster event journal (shard_corrupt,
+                          scrub_unrepairable, peer_stale, alert_fired)
+                          instead of re-deriving state, keeps a
+                          priority queue of degraded EC volumes keyed
+                          by clean-shard deficit (below k+1 first,
+                          below k critical), runs bounded-concurrency
+                          repairs and a token-bucket-budgeted rebalance
+                          pass on membership change.  Every action is
+                          journaled with the alert id and trace id that
+                          caused it, under a force-sampled trace root
+                          of its own (the repair's cross-server hops
+                          stitch at GET /cluster/traces/<id>).
+
+The coordinator pauses itself while the shell's admin lock is held (no
+dueling migrations) and via POST /cluster/coordinator/pause.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from ..ec.layout import DATA_SHARDS_COUNT, TOTAL_SHARDS_COUNT
+from ..utils import faultinject
+
+
+# --------------------------------------------------------------------------
+# cluster view: one neutral snapshot both the master loop and the shell
+# commands plan against
+# --------------------------------------------------------------------------
+
+@dataclass
+class NodeView:
+    url: str
+    rack: str = "DefaultRack"
+    dc: str = "DefaultDataCenter"
+    free: float = 0.0
+    ec_shards: int = 0
+    alive: bool = True
+
+    @property
+    def rack_key(self) -> tuple[str, str]:
+        # racks are only unique within a DC (two DCs may both have
+        # a "rack1"): placement diversity keys on the (dc, rack) pair
+        return (self.dc, self.rack)
+
+
+@dataclass
+class ClusterView:
+    """vid -> shard id -> holder urls, plus per-node rack/DC/load."""
+    nodes: dict[str, NodeView] = field(default_factory=dict)
+    shards: dict[int, dict[int, list[str]]] = field(default_factory=dict)
+    collections: dict[int, str] = field(default_factory=dict)
+
+    def alive_holders(self, vid: int, sid: int) -> list[str]:
+        return [u for u in self.shards.get(vid, {}).get(sid, [])
+                if self.nodes.get(u) and self.nodes[u].alive]
+
+    def present_shards(self, vid: int) -> set[int]:
+        """Shard ids with at least one ALIVE holder — the clean-shard
+        set the deficit math runs on (a shard only reachable on a
+        stale peer cannot serve reads or feed a rebuild)."""
+        return {sid for sid in self.shards.get(vid, {})
+                if self.alive_holders(vid, sid)}
+
+    def rack_counts(self, vid: int) -> dict[tuple, int]:
+        """(dc, rack) -> how many of this volume's shards it holds."""
+        out: dict[tuple, int] = {}
+        for sid in self.shards.get(vid, {}):
+            for url in self.alive_holders(vid, sid):
+                key = self.nodes[url].rack_key
+                out[key] = out.get(key, 0) + 1
+        return out
+
+    def racks(self) -> set[tuple]:
+        return {n.rack_key for n in self.nodes.values() if n.alive}
+
+
+def view_from_status(topology_doc: dict,
+                     stale: tuple = ()) -> ClusterView:
+    """Build a view from the master's /dir/status Topology document —
+    the shell-side constructor (EcVolumes + EcCollections ride it)."""
+    view = ClusterView()
+    for dc in topology_doc.get("DataCenters", []):
+        for rack in dc.get("Racks", []):
+            for n in rack.get("DataNodes", []):
+                view.nodes[n["Url"]] = NodeView(
+                    url=n["Url"], rack=rack.get("Id", "DefaultRack"),
+                    dc=dc.get("Id", "DefaultDataCenter"),
+                    free=float(n.get("Free", 0)),
+                    ec_shards=int(n.get("EcShards", 0)),
+                    alive=n["Url"] not in stale)
+    for vid_str, shard_map in topology_doc.get("EcVolumes", {}).items():
+        view.shards[int(vid_str)] = {
+            int(sid): list(urls) for sid, urls in shard_map.items()}
+    for vid_str, coll in topology_doc.get("EcCollections", {}).items():
+        view.collections[int(vid_str)] = coll
+    return view
+
+
+def clone_view(view: ClusterView) -> ClusterView:
+    """Deep-enough copy for planning: plan_rebalance simulates moves
+    forward on the view it plans over, so execution needs the original
+    (pre-plan) holder state to drive the real mount/unmount decisions."""
+    return ClusterView(
+        nodes={u: NodeView(**vars(n)) for u, n in view.nodes.items()},
+        shards={vid: {s: list(us) for s, us in m.items()}
+                for vid, m in view.shards.items()},
+        collections=dict(view.collections))
+
+
+def view_from_topology(topo, stale: tuple = ()) -> ClusterView:
+    """Build a view straight off the master's live Topology (under its
+    lock, no HTTP) — the coordinator-side constructor.  `stale` names
+    peers the aggregator could not scrape: registered but unreachable,
+    so they must not count as clean-shard holders or repair targets."""
+    with topo.lock:
+        return view_from_status(topo.to_map(), stale=stale)
+
+
+# --------------------------------------------------------------------------
+# planner: deficits, placement scoring, rebuild-host choice, rebalance
+# --------------------------------------------------------------------------
+
+@dataclass
+class Move:
+    """One planned shard movement.  kind: 'move' relocates src -> dst,
+    'dedupe' drops a duplicate copy from src (dst empty)."""
+    vid: int
+    sid: int
+    src: str
+    dst: str = ""
+    kind: str = "move"
+    reason: str = "skew"
+
+
+def clean_deficits(view: ClusterView,
+                   total: int = TOTAL_SHARDS_COUNT,
+                   k: int = DATA_SHARDS_COUNT) -> dict[int, dict]:
+    """Per-volume repair need: {vid: {clean, deficit, critical,
+    under_replicated}}.  A volume is under-replicated below k+1 clean
+    shards (one more loss starts costing data), critical below k
+    (reads already need every survivor); any volume short of `total`
+    distinct shards carries a deficit worth repairing."""
+    out: dict[int, dict] = {}
+    for vid in view.shards:
+        clean = len(view.present_shards(vid))
+        if clean >= total:
+            continue
+        out[vid] = {"clean": clean, "deficit": total - clean,
+                    "critical": clean < k,
+                    "under_replicated": clean < k + 1}
+    return out
+
+
+def placement_rank(view: ClusterView, vid: int, sid: int,
+                   exclude: tuple = ()) -> list[str]:
+    """Candidate targets for one shard, best first.  The scorer reuses
+    volume_growth.py's placement semantics as a ranking: a rack not yet
+    holding this volume's shards beats one that does (the 'other rack'
+    pool), a fresh DC breaks ties (the 'other DC' pool), then fewest EC
+    shards and most free slots — so spreads converge toward the same
+    layout find_empty_slots would have chosen for replicas."""
+    holders = set(view.shards.get(vid, {}).get(sid, []))
+    rack_counts = view.rack_counts(vid)
+    dc_counts: dict[str, int] = {}
+    for key, c in rack_counts.items():
+        dc_counts[key[0]] = dc_counts.get(key[0], 0) + c
+    cands = [n for n in view.nodes.values()
+             if n.alive and n.url not in holders
+             and n.url not in exclude]
+    cands.sort(key=lambda n: (
+        rack_counts.get(n.rack_key, 0),    # rack diversity first
+        dc_counts.get(n.dc, 0),            # then DC diversity
+        n.free <= 0,                       # full nodes last
+        n.ec_shards,                       # then least-loaded
+        -n.free,
+        n.url))                            # deterministic tie-break
+    return [n.url for n in cands]
+
+
+def choose_rebuild_host(view: ClusterView, vid: int) -> Optional[str]:
+    """The server to rebuild on: already holds the most clean shards of
+    this volume (fewest survivor copies over the wire), then most free
+    slots, then least loaded.  None when no alive node exists."""
+    local_counts: dict[str, int] = {}
+    for sid in view.shards.get(vid, {}):
+        for url in view.alive_holders(vid, sid):
+            local_counts[url] = local_counts.get(url, 0) + 1
+    cands = [n for n in view.nodes.values() if n.alive]
+    if not cands:
+        return None
+    cands.sort(key=lambda n: (-local_counts.get(n.url, 0),
+                              -n.free, n.ec_shards, n.url))
+    return cands[0].url
+
+
+def rack_ceiling(view: ClusterView,
+                 total: int = TOTAL_SHARDS_COUNT,
+                 k: int = DATA_SHARDS_COUNT) -> int:
+    """Max shards of one volume a single rack may hold: losing any one
+    rack must leave >= k shards, so the target is total - k — relaxed
+    to an even split when the cluster has too few racks to afford it."""
+    n_racks = max(1, len(view.racks()))
+    return max(total - k, -(-total // n_racks))
+
+
+def plan_rebalance(view: ClusterView, max_moves: int = 0,
+                   total: int = TOTAL_SHARDS_COUNT,
+                   k: int = DATA_SHARDS_COUNT) -> list[Move]:
+    """Dedupe duplicate shard copies, fix rack-diversity violations,
+    then tighten server shard-count skew.  Pure planning over the view
+    (node counters are simulated forward as moves are planned);
+    max_moves > 0 bounds the move/dedupe total (the coordinator's
+    token-bucket budget caps the EXECUTION anyway, but a bounded plan
+    keeps the status doc honest about what this cycle will attempt)."""
+    moves: list[Move] = []
+    counts = {u: n.ec_shards for u, n in view.nodes.items()}
+
+    def budget_left() -> bool:
+        return not max_moves or len(moves) < max_moves
+
+    # per-node ceiling for one volume's shards: rack-diversity moves may
+    # concentrate a few shards per node (unavoidable when shards
+    # outnumber nodes) but never more than an even split's share
+    alive_n = max(1, sum(1 for n in view.nodes.values() if n.alive))
+    node_vid_ceiling = -(-total // alive_n)
+
+    def _vid_held(vid: int, url: str) -> int:
+        return sum(1 for us in view.shards.get(vid, {}).values()
+                   if url in us)
+
+    # 1. dedupe: keep the copy on the least-loaded holder
+    for vid in sorted(view.shards):
+        for sid, holders in sorted(view.shards[vid].items()):
+            alive = [u for u in holders
+                     if view.nodes.get(u) and view.nodes[u].alive]
+            if len(alive) <= 1:
+                continue
+            keep = min(alive, key=lambda u: (counts.get(u, 0), u))
+            for url in alive:
+                if url == keep or not budget_left():
+                    continue
+                moves.append(Move(vid, sid, url, kind="dedupe",
+                                  reason="dedupe"))
+                counts[url] = counts.get(url, 1) - 1
+                view.nodes[url].ec_shards = max(
+                    0, view.nodes[url].ec_shards - 1)
+                view.shards[vid][sid] = [keep]
+
+    # 2. rack diversity: drain racks holding more of a volume than the
+    #    ceiling allows toward the least-represented racks
+    ceiling = rack_ceiling(view, total, k)
+    for vid in sorted(view.shards):
+        rack_counts = view.rack_counts(vid)
+        for key in sorted(rack_counts, key=lambda kk: -rack_counts[kk]):
+            while rack_counts[key] > ceiling and budget_left():
+                src_sid, src_url = _pick_rack_excess(view, vid, key)
+                if src_sid is None:
+                    break
+                dst = next(
+                    (u for u in placement_rank(view, vid, src_sid)
+                     if view.nodes[u].rack_key != key
+                     and rack_counts.get(view.nodes[u].rack_key, 0)
+                     < ceiling
+                     and _vid_held(vid, u) < node_vid_ceiling), None)
+                if dst is None:
+                    break
+                moves.append(Move(vid, src_sid, src_url, dst,
+                                  reason="rack"))
+                _apply_move(view, counts, rack_counts, vid, src_sid,
+                            src_url, dst)
+
+    # 3. skew: move shards off servers holding more than their share.
+    #    Targets must hold NOTHING of the moved volume (concentrating a
+    #    volume to fix server skew would trade durability for tidiness)
+    #    and must not push their rack over the diversity ceiling.
+    urls = sorted(u for u, n in view.nodes.items() if n.alive)
+    if not urls:
+        return moves
+    avg = -(-sum(counts.get(u, 0) for u in urls) // len(urls))
+    for src in sorted(urls, key=lambda u: -counts.get(u, 0)):
+        while counts.get(src, 0) > avg and budget_left():
+            picked = _pick_any_shard(view, src)
+            if picked is None:
+                break
+            vid, sid = picked
+            rack_counts = view.rack_counts(vid)
+            src_rack = view.nodes[src].rack_key
+            dst = next(
+                (u for u in placement_rank(view, vid, sid)
+                 if counts.get(u, 0) < avg
+                 and _vid_held(vid, u) == 0
+                 # same-rack moves leave the rack count unchanged;
+                 # cross-rack ones must not push dst over the ceiling
+                 and (view.nodes[u].rack_key == src_rack
+                      or rack_counts.get(view.nodes[u].rack_key, 0)
+                      < ceiling)), None)
+            if dst is None:
+                break
+            moves.append(Move(vid, sid, src, dst, reason="skew"))
+            _apply_move(view, counts, rack_counts, vid, sid, src, dst)
+    return moves
+
+
+def _pick_rack_excess(view: ClusterView, vid: int, rack_key):
+    """A (sid, url) of this volume held in the over-full rack, taken
+    from the rack's most-loaded holder first."""
+    best = None
+    for sid in sorted(view.shards.get(vid, {})):
+        for url in view.alive_holders(vid, sid):
+            if view.nodes[url].rack_key != rack_key:
+                continue
+            load = view.nodes[url].ec_shards
+            if best is None or load > best[2]:
+                best = (sid, url, load)
+    return (best[0], best[1]) if best else (None, None)
+
+
+def _pick_any_shard(view: ClusterView, src: str):
+    """Any (vid, sid) held by src whose volume is most concentrated on
+    it (moving those improves per-volume spread too)."""
+    best = None
+    for vid in sorted(view.shards):
+        held = [sid for sid in sorted(view.shards[vid])
+                if src in view.shards[vid][sid]]
+        if held and (best is None or len(held) > best[0]):
+            best = (len(held), vid, held[0])
+    return (best[1], best[2]) if best else None
+
+
+def _apply_move(view: ClusterView, counts: dict, rack_counts: dict,
+                vid: int, sid: int, src: str, dst: str) -> None:
+    """Simulate one move forward so later planning sees it."""
+    holders = view.shards[vid][sid]
+    if src in holders:
+        holders.remove(src)
+    holders.append(dst)
+    counts[src] = counts.get(src, 1) - 1
+    counts[dst] = counts.get(dst, 0) + 1
+    view.nodes[src].ec_shards = max(0, view.nodes[src].ec_shards - 1)
+    view.nodes[dst].ec_shards += 1
+    src_key = view.nodes[src].rack_key
+    rack_counts[src_key] = max(0, rack_counts.get(src_key, 1) - 1)
+    dst_key = view.nodes[dst].rack_key
+    rack_counts[dst_key] = rack_counts.get(dst_key, 0) + 1
+
+
+# --------------------------------------------------------------------------
+# executor: the HTTP legs, transport-injected
+# --------------------------------------------------------------------------
+
+class UnrepairableError(RuntimeError):
+    """Fewer than k clean shards reachable: no rebuild host can be
+    given enough survivors — repair is impossible until a holder
+    returns or an operator restores shards."""
+
+
+def _default_post(server: str, path: str, payload: dict,
+                  timeout: float = 600.0) -> dict:
+    from ..utils.httpd import http_json
+
+    return http_json("POST", f"http://{server}{path}", payload,
+                     timeout=timeout)
+
+
+class PlanExecutor:
+    """Execute repair and move plans over HTTP.  `post_fn(server, path,
+    payload, timeout)` is the transport — CommandEnv.volume_post for the
+    shell, the pooled http_json for the coordinator, fakes for tests —
+    so the manual and autonomous paths share one implementation.  Every
+    admin call passes the `coord.exec` fault point first (chaos drills
+    fail any step deterministically).  Stateless: safe to share across
+    concurrent repairs."""
+
+    def __init__(self, post_fn: Optional[Callable] = None,
+                 timeout: float = 600.0):
+        self._post_fn = post_fn or _default_post
+        self.timeout = timeout
+
+    def _post(self, server: str, path: str, payload: dict,
+              timeout: Optional[float] = None) -> dict:
+        faultinject.hit("coord.exec")
+        return self._post_fn(server, path, payload,
+                             timeout or self.timeout)
+
+    def refresh_heartbeats(self, servers) -> None:
+        """Nudge touched servers to re-heartbeat so the master registry
+        converges now instead of on the next pulse (best-effort)."""
+        for url in sorted(set(servers)):
+            try:
+                self._post_fn(url, "/admin/heartbeat_now", {}, 30.0)
+            except Exception:
+                pass
+
+    # --- moves ------------------------------------------------------------
+    def execute_move(self, view: ClusterView, mv: Move) -> None:
+        """One planned move against the real cluster; view holder lists
+        are updated to match (plan_rebalance already simulated them for
+        planning — execute on a FRESH view)."""
+        collection = view.collections.get(mv.vid, "")
+        if mv.kind == "dedupe":
+            self._drop_shard(view, mv.vid, collection, mv.sid, mv.src)
+            return
+        self._post(mv.dst, "/admin/ec/copy", {
+            "volume_id": mv.vid, "collection": collection,
+            "shard_ids": [mv.sid], "source_data_node": mv.src})
+        try:
+            self._post(mv.dst, "/admin/ec/mount",
+                       {"volume_id": mv.vid, "collection": collection})
+        except Exception:
+            # a copied-but-never-mounted shard file would be invisible
+            # to heartbeats AND to the scrubber (which only scans
+            # mounted shards) — an orphan forever; drop it before
+            # surfacing the failure
+            try:
+                self._post(mv.dst, "/admin/ec/delete",
+                           {"volume_id": mv.vid,
+                            "collection": collection,
+                            "shard_ids": [mv.sid]})
+            except Exception:
+                pass
+            raise
+        self._drop_shard(view, mv.vid, collection, mv.sid, mv.src)
+        view.shards.setdefault(mv.vid, {}).setdefault(
+            mv.sid, []).append(mv.dst)
+
+    def _drop_shard(self, view: ClusterView, vid: int, collection: str,
+                    sid: int, url: str) -> None:
+        """Delete one shard copy, keeping the holder mounted iff it
+        still holds other shards of the volume (deleting the last one
+        also removes the .ecx/.ecj/.eci set)."""
+        self._post(url, "/admin/ec/delete",
+                   {"volume_id": vid, "collection": collection,
+                    "shard_ids": [sid]})
+        holders = view.shards.get(vid, {}).get(sid, [])
+        if url in holders:
+            holders.remove(url)
+        still_holds = any(url in us
+                          for s2, us in view.shards.get(vid, {}).items()
+                          if s2 != sid)
+        if still_holds:
+            self._post(url, "/admin/ec/mount",
+                       {"volume_id": vid, "collection": collection})
+        else:
+            self._post(url, "/admin/ec/unmount", {"volume_id": vid})
+
+    # --- repair -----------------------------------------------------------
+    def execute_repair(self, view: ClusterView, vid: int,
+                       engine: Optional[str] = None,
+                       spread: bool = True,
+                       total: int = TOTAL_SHARDS_COUNT,
+                       k: int = DATA_SHARDS_COUNT) -> dict:
+        """Rebuild a volume's missing shards on the best host, then
+        spread the rebuilt shards rack/zone-aware.  Returns {host,
+        rebuilt, moves, copied}.  On a mid-plan failure the temp
+        survivor copies are best-effort cleaned off the host (no orphan
+        shards) and the error re-raised — the coordinator re-plans on a
+        fresh view next cycle."""
+        shard_map = view.shards.get(vid, {})
+        collection = view.collections.get(vid, "")
+        present = view.present_shards(vid)
+        missing = sorted(set(range(total)) - present)
+        if not missing:
+            return {"host": "", "rebuilt": [], "moves": [], "copied": []}
+        if len(present) < k:
+            raise UnrepairableError(
+                f"volume {vid}: only {len(present)} clean shards "
+                f"reachable, need {k}")
+        host = choose_rebuild_host(view, vid)
+        if host is None:
+            raise UnrepairableError(f"volume {vid}: no alive servers")
+        # copy every survivor the host lacks — the rebuild regenerates
+        # ALL locally-missing shards, so any survivor not copied first
+        # would be regenerated into a duplicate of a remote copy.  A
+        # copy the receiver REJECTS on .eci sidecar verification (rot
+        # at the source / mangled wire) retries the next holder; with
+        # every holder bad the shard is skipped and REGENERATED instead
+        # — detection upgrades the plan, it never bricks it — and the
+        # rotted source copies are dropped once the rebuild lands.
+        copied: list[int] = []
+        local = sum(1 for sid in present
+                    if host in view.alive_holders(vid, sid))
+        bad_sources: list[tuple[int, str]] = []
+        last_err: Optional[Exception] = None
+        try:
+            for sid in sorted(present):
+                holders = view.alive_holders(vid, sid)
+                if host in holders:
+                    continue
+                for source in holders:
+                    try:
+                        self._post(host, "/admin/ec/copy", {
+                            "volume_id": vid, "collection": collection,
+                            "shard_ids": [sid],
+                            "source_data_node": source,
+                            "copy_ecx_file": True,
+                            "copy_ecj_file": True})
+                        copied.append(sid)
+                        break
+                    except Exception as e:
+                        last_err = e
+                        if "sidecar verification" in str(e):
+                            bad_sources.append((sid, source))
+                # a shard whose every holder failed is simply not
+                # copied: the rebuild regenerates it below, provided
+                # enough clean survivors did land
+            if local + len(copied) < k:
+                raise last_err or UnrepairableError(
+                    f"volume {vid}: only {local + len(copied)} clean "
+                    f"survivors reached {host}, need {k}")
+            r = self._post(host, "/admin/ec/rebuild",
+                           {"volume_id": vid, "collection": collection,
+                            "engine": engine or "cpu"})
+            rebuilt = [int(s) for s in r.get("rebuilt_shard_ids", [])]
+            if copied:
+                self._post(host, "/admin/ec/delete",
+                           {"volume_id": vid, "collection": collection,
+                            "shard_ids": copied})
+            self._post(host, "/admin/ec/mount",
+                       {"volume_id": vid, "collection": collection})
+        except Exception:
+            # leave no orphan survivor copies behind the failed attempt
+            if copied:
+                try:
+                    self._post(host, "/admin/ec/delete",
+                               {"volume_id": vid,
+                                "collection": collection,
+                                "shard_ids": copied})
+                    self._post(host, "/admin/ec/mount",
+                               {"volume_id": vid,
+                                "collection": collection})
+                except Exception:
+                    pass
+            raise
+        view.nodes[host].ec_shards += len(rebuilt)
+        # sources whose copy failed sidecar verification still hold the
+        # rotted bytes; a clean replacement now exists — regenerated by
+        # the rebuild OR copied from an alternate holder — so drop the
+        # bad copies, else a later dedupe may keep the rotted one and
+        # delete the clean one
+        for sid, url in bad_sources:
+            if sid not in rebuilt and sid not in copied:
+                continue
+            try:
+                self._drop_shard(view, vid, collection, sid, url)
+            except Exception:
+                pass  # the scrubber will quarantine it eventually
+        # spread: each rebuilt shard goes where the scorer says; the
+        # host keeps those it is itself the best placement for.  A
+        # failed spread move is NON-fatal: the rebuild already landed
+        # (the data is safe and registered), and placement left
+        # imperfect here converges through the rebalance pass — failing
+        # the whole repair over it would journal a healed volume as
+        # repair_failed and strand its cause attribution.
+        moves: list[tuple[int, str]] = []
+        move_errors: list[str] = []
+        for sid in rebuilt:
+            # rank BEFORE registering the host as this shard's holder,
+            # so the host competes like any other candidate and keeps
+            # the shards it is itself the best placement for
+            target = next(iter(placement_rank(view, vid, sid)), None) \
+                if spread else None
+            shard_map.setdefault(sid, []).append(host)
+            if target is None or target == host:
+                continue
+            try:
+                self.execute_move(
+                    view, Move(vid, sid, host, target,
+                               reason="spread"))
+            except Exception as e:
+                move_errors.append(
+                    f"{sid}->{target}: "
+                    f"{type(e).__name__}: {e}"[:160])
+                continue
+            moves.append((sid, target))
+        self.refresh_heartbeats([host] + [t for _s, t in moves])
+        return {"host": host, "rebuilt": rebuilt, "moves": moves,
+                "copied": copied, "move_errors": move_errors}
+
+
+# --------------------------------------------------------------------------
+# the coordinator loop
+# --------------------------------------------------------------------------
+
+# journal event types that wake the planner immediately (everything else
+# rides the periodic safety-net scan)
+_WAKE_EVENT_TYPES = ("shard_corrupt", "scrub_unrepairable",
+                     "scrub_repair_failed", "peer_stale", "alert_fired",
+                     "degraded_bind")
+# alert rule name -> the event type whose moments it watches; used to
+# attach the FIRING alert id to the repairs it caused
+_ALERT_FOR_TYPE = {
+    "shard_corrupt": "corrupt_shards_increase",
+    "scrub_unrepairable": "scrub_unrepairable",
+    "scrub_repair_failed": "scrub_unrepairable",
+    "ec_under_replicated": "ec_under_replicated_increase",
+}
+
+
+class EcCoordinator:  # weedlint: concurrent-class
+    """Master-side repair/rebalance loop.  Reached concurrently: its
+    own cycle thread, the repair pool threads, HTTP router threads
+    (status/pause/resume), and whatever thread ships events into the
+    cluster journal (on_events).  All mutable state rides _lock; the
+    HTTP legs run strictly outside it."""
+
+    def __init__(self, topo, server: str = "",
+                 stale_peers_fn: Optional[Callable[[], list]] = None,
+                 is_leader_fn: Optional[Callable[[], bool]] = None,
+                 admin_locked_fn: Optional[Callable[[], bool]] = None,
+                 interval_s: float = 15.0, max_concurrent: int = 2,
+                 move_rate: float = 1.0, move_burst: float = 8.0,
+                 max_moves_per_cycle: int = 16,
+                 max_repairs_per_cycle: int = 4,
+                 post_fn: Optional[Callable] = None,
+                 engine: Optional[str] = None):
+        self.topo = topo
+        self.server = server
+        self.stale_peers_fn = stale_peers_fn or (lambda: [])
+        self.is_leader_fn = is_leader_fn or (lambda: True)
+        self.admin_locked_fn = admin_locked_fn or (lambda: False)
+        self.interval_s = float(interval_s)
+        self.max_concurrent = max(1, int(max_concurrent))
+        self.move_rate = float(move_rate)
+        self.move_burst = float(move_burst)
+        self.max_moves_per_cycle = int(max_moves_per_cycle)
+        self.max_repairs_per_cycle = int(max_repairs_per_cycle)
+        self.engine = engine
+        self.executor = PlanExecutor(post_fn=post_fn)
+        from ..stats import coordinator_metrics
+
+        self.metrics = coordinator_metrics()
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._wake = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # repair queue: vid -> {deficit, critical, attempts, cause...}
+        self._queue: dict[int, dict] = {}  # guarded-by: _lock
+        # degraded-event causes by vid, + currently-firing alert names
+        self._causes: dict[int, dict] = {}  # guarded-by: _lock
+        self._alerts: dict[str, dict] = {}  # guarded-by: _lock
+        # volumes already journaled as under-replicated (one event per
+        # transition, not one per scan)
+        self._under_notified: set[int] = set()  # guarded-by: _lock
+        self.paused = False  # guarded-by: _lock
+        self.pause_reason = ""  # guarded-by: _lock
+        self.cycles = 0  # guarded-by: _lock
+        self.last_cycle_at = 0.0  # guarded-by: _lock
+        self.last_error = ""  # guarded-by: _lock
+        self.repairs_done = 0  # guarded-by: _lock
+        self.repairs_failed = 0  # guarded-by: _lock
+        self.moves_done = 0  # guarded-by: _lock
+        self.recent: deque = deque(maxlen=64)  # guarded-by: _lock
+        # token-bucket move budget
+        self._tokens = float(move_burst)  # guarded-by: _lock
+        self._tokens_at = time.monotonic()  # guarded-by: _lock
+
+    # --- lifecycle --------------------------------------------------------
+    def start(self) -> "EcCoordinator":
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="ec-coordinator")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._wake.set()
+        t = self._thread
+        if t is not None and t.is_alive():
+            t.join(timeout=2.0)
+
+    @property
+    def enabled(self) -> bool:
+        t = self._thread
+        return t is not None and t.is_alive()
+
+    def pause(self, reason: str = "api") -> None:
+        with self._lock:
+            self.paused = True
+            self.pause_reason = reason
+
+    def resume(self) -> None:
+        with self._lock:
+            self.paused = False
+            self.pause_reason = ""
+        self._wake.set()
+
+    # --- event subscription ----------------------------------------------
+    def on_events(self, events: list[dict]) -> None:  # thread-entry
+        """Cluster-journal ingest hook: record causes (which alert /
+        event / trace made each volume urgent) and wake the planner.
+        Called on whatever thread shipped the batch — cheap, lock-only,
+        never HTTP."""
+        wake = False
+        with self._lock:
+            for e in events:
+                etype = e.get("type") or ""
+                det = e.get("details") or {}
+                if etype == "alert_fired":
+                    self._alerts[str(det.get("alert") or "")] = {
+                        "event": e.get("id", ""),
+                        "trace": det.get("exemplar_trace")
+                        or e.get("trace") or ""}
+                    wake = True
+                elif etype == "alert_resolved":
+                    self._alerts.pop(str(det.get("alert") or ""), None)
+                elif etype in _WAKE_EVENT_TYPES:
+                    vid = _vid_from_event(det)
+                    if vid is not None:
+                        self._causes[vid] = {
+                            "event": e.get("id", ""), "type": etype,
+                            "trace": e.get("trace") or "",
+                            "alert": _ALERT_FOR_TYPE.get(etype, "")}
+                    wake = True
+        if wake:
+            self._wake.set()
+
+    # --- the loop ---------------------------------------------------------
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            self._wake.wait(self.interval_s)
+            self._wake.clear()
+            if self._stop.is_set():
+                break
+            if not self.is_leader_fn():
+                continue
+            with self._lock:
+                paused, reason = self.paused, self.pause_reason
+            if paused:
+                continue
+            if self.admin_locked_fn():
+                # an operator holds the shell's exclusive admin lock:
+                # their migrations must not duel with ours
+                continue
+            try:
+                self.run_cycle()
+                with self._lock:
+                    self.last_error = ""
+            except Exception as e:  # keep the loop alive; surface it
+                self.metrics.cycles.inc("error")
+                with self._lock:
+                    self.last_error = f"{type(e).__name__}: {e}"[:300]
+
+    def run_cycle(self) -> dict:
+        """One planning+execution round (synchronous — tests and the
+        bench drill call it directly)."""
+        faultinject.hit("coord.plan")
+        view = self._snapshot_view()
+        deficits = clean_deficits(view)
+        self._update_queue(view, deficits)
+        repaired = self._run_repairs()
+        moved = self._run_rebalance()
+        with self._lock:
+            self.cycles += 1
+            self.last_cycle_at = time.time()
+        self.metrics.cycles.inc("ok")
+        return {"deficits": len(deficits), "repaired": repaired,
+                "moved": moved}
+
+    def _snapshot_view(self) -> ClusterView:
+        try:
+            stale = tuple(self.stale_peers_fn() or ())
+        except Exception:
+            stale = ()
+        return view_from_topology(self.topo, stale=stale)
+
+    def _update_queue(self, view: ClusterView,
+                      deficits: dict[int, dict]) -> None:
+        """Refresh the priority queue + the under-replication gauge
+        from this cycle's deficits, and journal newly-under-replicated
+        volumes (the ec_under_replicated health signal)."""
+        newly_under: list[tuple[int, int]] = []
+        with self._lock:
+            for vid in list(self._queue):
+                if vid not in deficits:
+                    self._queue.pop(vid)  # healed (by us or otherwise)
+                    self._causes.pop(vid, None)
+                    self._under_notified.discard(vid)
+            for vid, d in deficits.items():
+                entry = self._queue.setdefault(
+                    vid, {"attempts": 0, "queued_at": time.time()})
+                entry.update(d)
+                cause = self._causes.get(vid, {})
+                entry["cause_trace"] = cause.get("trace", "")
+                entry["cause_event"] = cause.get("event", "")
+                entry["alert"] = self._cause_alert_locked(vid)
+                if d["under_replicated"] and \
+                        vid not in self._under_notified:
+                    self._under_notified.add(vid)
+                    newly_under.append((vid, d["clean"]))
+            under = sum(1 for d in deficits.values()
+                        if d["under_replicated"])
+            self.metrics.under_replicated.set(float(under))
+            self.metrics.queue_depth.set(float(len(self._queue)))
+        from ..observability import events as _events
+
+        for vid, clean in newly_under:
+            _events.emit("ec_under_replicated", server=self.server
+                         or None, vid=vid, clean_shards=clean,
+                         threshold=DATA_SHARDS_COUNT + 1)
+
+    def _cause_alert_locked(self, vid: int) -> str:  # holds: _lock
+        """The firing alert id this volume's repair answers: the
+        cause event's mapped rule when that alert is firing, else any
+        relevant firing alert, else the cause's static mapping."""
+        cause = self._causes.get(vid, {})
+        mapped = cause.get("alert", "")
+        if mapped and mapped in self._alerts:
+            return mapped
+        for name in self._alerts:
+            if name in _ALERT_FOR_TYPE.values() or name == "peer_down":
+                return name
+        return mapped
+
+    # --- repairs ----------------------------------------------------------
+    def _run_repairs(self) -> int:
+        now = time.time()
+        with self._lock:
+            ready = []
+            for vid, e in self._queue.items():
+                attempts = e.get("attempts", 0)
+                if attempts:
+                    # exponential backoff per volume: a persistently
+                    # failing repair re-copies up to k survivor shards
+                    # per attempt — retrying every cycle would saturate
+                    # the wire and spam the journal
+                    hold = min(self.interval_s * (2 ** attempts), 600.0)
+                    if now - e.get("last_attempt_at", 0.0) < hold:
+                        continue
+                ready.append((vid, e))
+            batch = sorted(
+                ready,
+                key=lambda kv: (not kv[1].get("critical", False),
+                                -kv[1].get("deficit", 0), kv[0]))
+            batch = [(vid, dict(e)) for vid, e in
+                     batch[:self.max_repairs_per_cycle]]
+            for vid, _e in batch:
+                self._queue[vid]["last_attempt_at"] = now
+        if not batch:
+            return 0
+        import concurrent.futures
+
+        done = 0
+        with concurrent.futures.ThreadPoolExecutor(
+                max_workers=self.max_concurrent,
+                thread_name_prefix="coord-repair") as pool:
+            for ok in pool.map(lambda kv: self._run_repair(*kv), batch):
+                done += 1 if ok else 0
+        return done
+
+    def _run_repair(self, vid: int, entry: dict) -> bool:
+        """One repair under its own force-sampled trace root: the
+        copy/rebuild/mount hops stitch into a cluster trace, and every
+        journaled event carries BOTH this trace (what we did) and the
+        causing alert/trace (why)."""
+        from ..observability import context as _trace_context
+        from ..observability import events as _events
+        from ..observability import get_tracer
+
+        tr = get_tracer()
+        ctx = prev = None
+        if tr.enabled and _trace_context.current() is None:
+            ctx = _trace_context.TraceContext(
+                _trace_context.new_trace_id())
+            prev = _trace_context.activate(ctx)
+        prev_srv = _trace_context.swap_server(self.server or None)
+        cause = {"alert": entry.get("alert", ""),
+                 "cause_trace": entry.get("cause_trace", ""),
+                 "cause_event": entry.get("cause_event", "")}
+        try:
+            with tr.span("coord.repair", vid=vid,
+                         deficit=entry.get("deficit", 0),
+                         alert=cause["alert"]):
+                view = self._snapshot_view()
+                if len(view.present_shards(vid)) >= TOTAL_SHARDS_COUNT:
+                    # healed between queueing and execution (another
+                    # repair, a returning holder): drop the entry
+                    # without journaling a repair that never ran
+                    with self._lock:
+                        self._queue.pop(vid, None)
+                        self._causes.pop(vid, None)
+                        self._under_notified.discard(vid)
+                    return True
+                _events.emit("repair_planned", server=self.server
+                             or None, vid=vid,
+                             deficit=entry.get("deficit", 0),
+                             critical=entry.get("critical", False),
+                             **cause)
+                try:
+                    res = self.executor.execute_repair(
+                        view, vid, engine=self.engine)
+                except Exception as e:
+                    self.metrics.repairs.inc("failed")
+                    self.metrics.repair_failures.inc(
+                        type(e).__name__[:40])
+                    with self._lock:
+                        self.repairs_failed += 1
+                        q = self._queue.get(vid)
+                        if q is not None:
+                            q["attempts"] = q.get("attempts", 0) + 1
+                        self.recent.appendleft({
+                            "at": round(time.time(), 3), "vid": vid,
+                            "action": "repair_failed",
+                            "error": f"{type(e).__name__}: {e}"[:200],
+                            **cause})
+                    _events.emit("repair_failed", server=self.server
+                                 or None, vid=vid,
+                                 error=f"{type(e).__name__}: {e}"[:200],
+                                 **cause)
+                    return False
+                if not res["host"] and not res["rebuilt"]:
+                    # healed between queueing and execution (another
+                    # repair, a returning holder): not OUR repair —
+                    # drop the queue entry without claiming credit
+                    with self._lock:
+                        self._queue.pop(vid, None)
+                        self._causes.pop(vid, None)
+                        self._under_notified.discard(vid)
+                    return True
+                self.metrics.repairs.inc("done")
+                with self._lock:
+                    self.repairs_done += 1
+                    self._queue.pop(vid, None)
+                    self._causes.pop(vid, None)
+                    self._under_notified.discard(vid)
+                    self.recent.appendleft({
+                        "at": round(time.time(), 3), "vid": vid,
+                        "action": "repair_done", "host": res["host"],
+                        "rebuilt": res["rebuilt"],
+                        "spread": [list(m) for m in res["moves"]],
+                        **cause})
+                _events.emit("repair_done", server=self.server or None,
+                             vid=vid, host=res["host"],
+                             rebuilt=res["rebuilt"],
+                             moves=len(res["moves"]),
+                             move_errors=res.get("move_errors") or [],
+                             **cause)
+                return True
+        finally:
+            _trace_context.swap_server(prev_srv)
+            if ctx is not None:
+                _trace_context.activate(prev)
+
+    # --- rebalance --------------------------------------------------------
+    def _take_move_token(self) -> bool:
+        with self._lock:
+            now = time.monotonic()
+            self._tokens = min(
+                self.move_burst,
+                self._tokens + (now - self._tokens_at) * self.move_rate)
+            self._tokens_at = now
+            if self._tokens >= 1.0:
+                self._tokens -= 1.0
+                return True
+            return False
+
+    def _run_rebalance(self) -> int:
+        """Token-budgeted continuous rebalance: dedupe + rack-diversity
+        + skew moves, run every cycle (plans are cheap; execution is
+        what the bucket bounds).  A membership change (join/leave)
+        needs no edge detection — it simply yields a non-empty plan
+        the next time this runs."""
+        view = self._snapshot_view()
+        plan = plan_rebalance(clone_view(view),
+                              max_moves=self.max_moves_per_cycle)
+        if not plan:
+            return 0
+        from ..observability import context as _trace_context
+        from ..observability import events as _events
+        from ..observability import get_tracer
+
+        tr = get_tracer()
+        ctx = prev = None
+        if tr.enabled and _trace_context.current() is None:
+            ctx = _trace_context.TraceContext(
+                _trace_context.new_trace_id())
+            prev = _trace_context.activate(ctx)
+        prev_srv = _trace_context.swap_server(self.server or None)
+        executed = 0
+        touched: set[str] = set()
+        try:
+            with tr.span("coord.rebalance", planned=len(plan)):
+                for mv in plan:
+                    if self._stop.is_set():
+                        break
+                    if not self._take_move_token():
+                        break  # budget spent; the rest keeps next cycle
+                    try:
+                        self.executor.execute_move(view, mv)
+                    except Exception as e:
+                        with self._lock:
+                            self.recent.appendleft({
+                                "at": round(time.time(), 3),
+                                "vid": mv.vid, "sid": mv.sid,
+                                "action": "move_failed",
+                                "error":
+                                    f"{type(e).__name__}: {e}"[:200]})
+                        continue
+                    executed += 1
+                    touched.update((mv.src, mv.dst) if mv.dst
+                                   else (mv.src,))
+                    self.metrics.moves.inc(mv.reason)
+                    with self._lock:
+                        self.moves_done += 1
+                        self.recent.appendleft({
+                            "at": round(time.time(), 3),
+                            "vid": mv.vid, "sid": mv.sid,
+                            "action": mv.kind, "reason": mv.reason,
+                            "src": mv.src, "dst": mv.dst})
+                    _events.emit("rebalance_move", server=self.server
+                                 or None, vid=mv.vid, sid=mv.sid,
+                                 src=mv.src, dst=mv.dst,
+                                 reason=mv.reason)
+                if touched:
+                    self.executor.refresh_heartbeats(touched)
+        finally:
+            _trace_context.swap_server(prev_srv)
+            if ctx is not None:
+                _trace_context.activate(prev)
+        return executed
+
+    # --- views ------------------------------------------------------------
+    def health_contribution(self) -> dict:
+        """Master-local additions to /cluster/health totals: the
+        under-replication gauge and the repair-failure counter live on
+        the master (volume servers cannot know cluster-wide shard
+        counts), so the aggregator folds them in through this hook."""
+        m = self.metrics
+        return {
+            "ec_under_replicated":
+                int(m.under_replicated.value()),
+            "coordinator_repair_failures":
+                int(sum(m.repair_failures.snapshot().values())),
+        }
+
+    def status(self) -> dict:
+        admin_locked = False
+        try:
+            admin_locked = bool(self.admin_locked_fn())
+        except Exception:
+            pass
+        with self._lock:
+            queue = [
+                {"vid": vid, **{k: v for k, v in e.items()}}
+                for vid, e in sorted(
+                    self._queue.items(),
+                    key=lambda kv: (not kv[1].get("critical", False),
+                                    -kv[1].get("deficit", 0), kv[0]))]
+            doc = {
+                "enabled": self.enabled,
+                "paused": self.paused or admin_locked,
+                "pause_reason": self.pause_reason or (
+                    "admin_lock" if admin_locked else ""),
+                "interval_s": self.interval_s,
+                "cycles": self.cycles,
+                "last_cycle_at": round(self.last_cycle_at, 3),
+                "last_error": self.last_error,
+                "queue": queue,
+                "under_replicated":
+                    int(self.metrics.under_replicated.value()),
+                "repairs": {"done": self.repairs_done,
+                            "failed": self.repairs_failed},
+                "moves": self.moves_done,
+                "move_budget": {"rate_per_s": self.move_rate,
+                                "burst": self.move_burst,
+                                "tokens": round(self._tokens, 2)},
+                "recent": list(self.recent),
+            }
+        return doc
+
+
+def _vid_from_event(details: dict) -> Optional[int]:
+    """Volume id out of a journal event's details: explicit `vid`
+    (scrub verdict events), else parsed from the shard base `path`
+    (shard_corrupt events carry the file prefix `.../[coll_]vid`)."""
+    if "vid" in details:
+        try:
+            return int(details["vid"])
+        except (TypeError, ValueError):
+            return None
+    path = str(details.get("path") or "")
+    if not path:
+        return None
+    name = path.replace("\\", "/").rsplit("/", 1)[-1]
+    tail = name.rsplit("_", 1)[-1]
+    try:
+        return int(tail)
+    except ValueError:
+        return None

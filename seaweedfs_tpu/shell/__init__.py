@@ -2,6 +2,7 @@
 
 from . import alert_commands as alert_commands  # noqa: F401
 from . import commands as commands  # noqa: F401
+from . import coordinator_commands as coordinator_commands  # noqa: F401
 from . import ec_commands as ec_commands  # noqa: F401
 from . import fs_commands as fs_commands  # noqa: F401
 from . import remote_commands as remote_commands  # noqa: F401

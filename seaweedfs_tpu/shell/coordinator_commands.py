@@ -1,0 +1,88 @@
+"""Rebuild/rebalance coordinator shell commands.
+
+    coordinator.status [-json]   # queue, budget, recent actions
+    coordinator.pause            # hold autonomous plans (survives locks)
+    coordinator.resume
+
+The shell's admin `lock` already pauses the coordinator implicitly (no
+dueling migrations); pause/resume is the explicit operator hold that
+outlives a lock session.  Output is stable line-per-record text like
+alerts.list, so scripts can grep it; -json emits the raw document.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+from .commands import CommandEnv, command
+
+
+def _fmt_ts(ts: float) -> str:
+    return time.strftime("%H:%M:%S", time.localtime(ts)) if ts else "-"
+
+
+def _render_status(doc: dict) -> str:
+    state = "paused" if doc.get("paused") else (
+        "running" if doc.get("enabled") else "disabled")
+    reason = doc.get("pause_reason") or ""
+    head = (f"coordinator: {state}"
+            + (f" ({reason})" if reason else "")
+            + f"  cycles={doc.get('cycles', 0)}"
+            f" last={_fmt_ts(doc.get('last_cycle_at', 0))}"
+            f" under_replicated={doc.get('under_replicated', 0)}")
+    lines = [head]
+    rep = doc.get("repairs") or {}
+    budget = doc.get("move_budget") or {}
+    lines.append(f"  repairs: done={rep.get('done', 0)} "
+                 f"failed={rep.get('failed', 0)}  "
+                 f"moves={doc.get('moves', 0)} "
+                 f"(budget {budget.get('tokens', 0)}/"
+                 f"{budget.get('burst', 0)} tokens, "
+                 f"{budget.get('rate_per_s', 0)}/s)")
+    if doc.get("last_error"):
+        lines.append(f"  last_error: {doc['last_error']}")
+    for q in doc.get("queue", []):
+        lines.append(
+            f"  queued volume {q.get('vid')}: clean={q.get('clean')}"
+            f" deficit={q.get('deficit')}"
+            + (" CRITICAL" if q.get("critical") else "")
+            + (f" alert={q['alert']}" if q.get("alert") else "")
+            + (f" [trace {q['cause_trace']}]"
+               if q.get("cause_trace") else ""))
+    for a in list(doc.get("recent", []))[:10]:
+        extra = {k: v for k, v in a.items()
+                 if k not in ("at", "action") and v not in ("", [], None)}
+        detail = " ".join(f"{k}={v}" for k, v in sorted(extra.items()))
+        lines.append(f"  {_fmt_ts(a.get('at', 0))} {a.get('action'):<14}"
+                     f" {detail}")
+    return "\n".join(lines)
+
+
+@command("coordinator.status")
+def cmd_coordinator_status(env: CommandEnv, flags: dict) -> str:
+    """coordinator.status [-json]
+    # the autonomous EC rebuild/rebalance coordinator's state: repair
+    # queue (clean-shard deficit, causing alert + trace id), repair and
+    # move totals, token-bucket budget, recent actions"""
+    doc = env.master_get("/cluster/coordinator")
+    if flags.get("json") == "true":
+        return json.dumps(doc, indent=2)
+    return _render_status(doc)
+
+
+@command("coordinator.pause")
+def cmd_coordinator_pause(env: CommandEnv, flags: dict) -> str:
+    """coordinator.pause
+    # hold all autonomous repair/rebalance plans until resume (the
+    # admin lock pauses implicitly; this survives unlock)"""
+    doc = env.master_post("/cluster/coordinator/pause", {})
+    return _render_status(doc)
+
+
+@command("coordinator.resume")
+def cmd_coordinator_resume(env: CommandEnv, flags: dict) -> str:
+    """coordinator.resume
+    # lift a coordinator.pause hold and wake the planner"""
+    doc = env.master_post("/cluster/coordinator/resume", {})
+    return _render_status(doc)

@@ -22,11 +22,6 @@ def _ec_nodes(env: CommandEnv) -> list[dict]:
     return sorted(nodes, key=lambda n: -n["Free"])
 
 
-def _shard_map(env: CommandEnv, vid: int) -> dict[int, list[str]]:
-    r = env.master_get(f"/dir/lookup_ec?volumeId={vid}")
-    return {int(sid): urls for sid, urls in r.get("shards", {}).items()}
-
-
 def _balanced_distribution(nodes: list[dict], n_shards: int) -> dict[str, list[int]]:
     """balancedEcDistribution (command_ec_encode.go:249-265): round-robin
     shards onto the nodes with the most free slots."""
@@ -163,142 +158,80 @@ def _ec_encode_one(env: CommandEnv, vid: int, collection: str,
 @command("ec.rebuild")
 def cmd_ec_rebuild(env: CommandEnv, flags: dict) -> str:
     """ec.rebuild [-volumeId <id>] [-collection c] [-engine cpu|tpu]
-    # regenerate missing EC shards (command_ec_rebuild.go)"""
+    # regenerate missing EC shards on the best-placed host and spread
+    # them rack-aware — the SAME planner/executor the master's
+    # autonomous coordinator runs (ops/coordinator.py), so manual and
+    # autonomous repairs place shards identically
+    # (command_ec_rebuild.go)"""
     env.confirm_is_locked()
+    from ..ops import coordinator as coord
+
     engine = flags.get("engine", "cpu")
-    topo = env.topology()
+    view = coord.view_from_status(env.topology())
     vids = ([int(flags["volumeId"])] if "volumeId" in flags
-            else [int(v) for v in topo.get("EcVolumes", {})])
+            else sorted(view.shards))
+    ex = coord.PlanExecutor(post_fn=env.volume_post)
     results = []
     for vid in vids:
-        shard_map = _shard_map(env, vid)
-        collection = env.master_get(
-            f"/dir/lookup_ec?volumeId={vid}").get("collection", "")
-        present = {sid for sid, urls in shard_map.items() if urls}
-        missing = [s for s in range(TOTAL_SHARDS_COUNT) if s not in present]
-        if not missing:
+        present = view.present_shards(vid)
+        if len(present) >= TOTAL_SHARDS_COUNT:
             results.append(f"volume {vid}: all shards present")
             continue
         if len(present) < 10:
             results.append(f"volume {vid}: unrepairable, only "
                            f"{len(present)} shards")
             continue
-        rebuilder = _ec_nodes(env)[0]["Url"]
-        # copy survivors the rebuilder lacks (prepareDataToRecover)
-        copied = []
-        for sid in sorted(present):
-            holders = shard_map[sid]
-            if rebuilder in holders:
-                continue
-            env.volume_post(rebuilder, "/admin/ec/copy", {
-                "volume_id": vid, "collection": collection,
-                "shard_ids": [sid], "source_data_node": holders[0],
-                "copy_ecx_file": True, "copy_ecj_file": True,
-            })
-            copied.append(sid)
-        r = env.volume_post(rebuilder, "/admin/ec/rebuild",
-                            {"volume_id": vid, "collection": collection,
-                             "engine": engine})
-        rebuilt = r.get("rebuilt_shard_ids", [])
-        # drop the temporarily copied survivors, keep + mount the rebuilt
-        if copied:
-            env.volume_post(rebuilder, "/admin/ec/delete",
-                            {"volume_id": vid, "collection": collection,
-                             "shard_ids": copied})
-        env.volume_post(rebuilder, "/admin/ec/mount",
-                        {"volume_id": vid, "collection": collection})
-        _refresh_heartbeats(env, {rebuilder})
-        results.append(f"volume {vid}: rebuilt shards {rebuilt} on {rebuilder}")
+        try:
+            res = ex.execute_repair(view, vid, engine=engine)
+        except Exception as e:  # noqa: BLE001 - per-volume audit trail
+            results.append(f"volume {vid}: rebuild FAILED: {e}")
+            continue
+        env.master.invalidate(vid)
+        line = (f"volume {vid}: rebuilt shards {res['rebuilt']} "
+                f"on {res['host']}")
+        if res["moves"]:
+            line += "; spread " + ", ".join(
+                f"{sid}->{dst}" for sid, dst in res["moves"])
+        results.append(line)
     return "\n".join(results)
 
 
 @command("ec.balance")
 def cmd_ec_balance(env: CommandEnv, flags: dict) -> str:
-    """ec.balance [-collection c]
-    # dedupe shard copies and spread shards evenly (command_ec_balance.go)"""
+    """ec.balance [-maxMoves N]
+    # dedupe duplicate shard copies, fix rack-diversity violations, and
+    # spread shards evenly — the coordinator's shared rebalance planner
+    # (ops/coordinator.py), so a manual balance and the autonomous one
+    # compute identical plans (command_ec_balance.go)"""
     env.confirm_is_locked()
-    topo = env.topology()
-    moves = []
-    counts: dict[str, int] = {}
-    for dc in topo["DataCenters"]:
-        for rack in dc["Racks"]:
-            for n in rack["DataNodes"]:
-                counts[n["Url"]] = n["EcShards"]
+    from ..ops import coordinator as coord
 
+    view = coord.view_from_status(env.topology())
+    plan = coord.plan_rebalance(coord.clone_view(view),
+                                max_moves=int(flags.get("maxMoves", 0)))
+    ex = coord.PlanExecutor(post_fn=env.volume_post)
+    lines = []
     touched: set[str] = set()
-    for vid_str in topo.get("EcVolumes", {}):
-        vid = int(vid_str)
-        info = env.master_get(f"/dir/lookup_ec?volumeId={vid}")
-        collection = info.get("collection", "")
-        shard_map = {int(s): urls for s, urls in info.get("shards", {}).items()}
-
-        # 1. dedupe: keep the copy on the least-loaded holder
-        for sid, holders in shard_map.items():
-            if len(holders) <= 1:
-                continue
-            keep = min(holders, key=lambda u: counts.get(u, 0))
-            for url in holders:
-                if url == keep:
-                    continue
-                env.volume_post(url, "/admin/ec/delete",
-                                {"volume_id": vid, "collection": collection,
-                                 "shard_ids": [sid]})
-                # only remount if the node still holds other shards of this
-                # volume (deleting the last one also removes its .ecx)
-                still_holds = any(url in us for s2, us in shard_map.items()
-                                  if s2 != sid)
-                if still_holds:
-                    env.volume_post(url, "/admin/ec/mount",
-                                    {"volume_id": vid, "collection": collection})
-                else:
-                    env.volume_post(url, "/admin/ec/unmount",
-                                    {"volume_id": vid})
-                counts[url] = counts.get(url, 1) - 1
-                touched.add(url)
-                moves.append(f"dedupe {vid}.{sid} from {url}")
-            shard_map[sid] = [keep]
-
-        # 2. spread: move shards from overloaded to underloaded servers
-        all_urls = sorted(counts)
-        if not all_urls:
+    for mv in plan:
+        try:
+            ex.execute_move(view, mv)
+        except Exception as e:  # noqa: BLE001 - per-move audit trail
+            lines.append(f"move {mv.vid}.{mv.sid} {mv.src} -> "
+                         f"{mv.dst} FAILED: {e}")
             continue
-        avg = (sum(counts.values()) + len(all_urls) - 1) // len(all_urls)
-        for sid, holders in sorted(shard_map.items()):
-            if not holders:
-                continue
-            src = holders[0]
-            if counts.get(src, 0) <= avg:
-                continue
-            per_vid = {u for s, us in shard_map.items() for u in us}
-            targets = [u for u in all_urls
-                       if counts.get(u, 0) < avg and u not in per_vid]
-            if not targets:
-                continue
-            dst = min(targets, key=lambda u: counts.get(u, 0))
-            env.volume_post(dst, "/admin/ec/copy", {
-                "volume_id": vid, "collection": collection,
-                "shard_ids": [sid], "source_data_node": src})
-            env.volume_post(dst, "/admin/ec/mount",
-                            {"volume_id": vid, "collection": collection})
-            env.volume_post(src, "/admin/ec/delete",
-                            {"volume_id": vid, "collection": collection,
-                             "shard_ids": [sid]})
-            if any(src in us for s2, us in shard_map.items() if s2 != sid):
-                env.volume_post(src, "/admin/ec/mount",
-                                {"volume_id": vid, "collection": collection})
-            else:
-                env.volume_post(src, "/admin/ec/unmount", {"volume_id": vid})
-            counts[src] -= 1
-            counts[dst] = counts.get(dst, 0) + 1
-            shard_map[sid] = [dst]
-            moves.append(f"move {vid}.{sid} {src} -> {dst}")
-            touched.update((src, dst))
-    # one refresh after the whole pass, and only for servers that actually
-    # moved shards: refreshing every server per volume is O(volumes x
-    # servers) heartbeat RPCs for clusters that are already balanced
+        if mv.kind == "dedupe":
+            lines.append(f"dedupe {mv.vid}.{mv.sid} from {mv.src}")
+            touched.add(mv.src)
+        else:
+            lines.append(f"move {mv.vid}.{mv.sid} {mv.src} -> {mv.dst}"
+                         f" ({mv.reason})")
+            touched.update((mv.src, mv.dst))
+    # one refresh after the whole pass, and only for servers that
+    # actually moved shards: refreshing every server per volume is
+    # O(volumes x servers) heartbeat RPCs on balanced clusters
     if touched:
         _refresh_heartbeats(env, touched)
-    return "\n".join(moves) or "already balanced"
+    return "\n".join(lines) or "already balanced"
 
 
 def _scrub_start_body(flags: dict) -> dict:

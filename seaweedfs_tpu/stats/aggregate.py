@@ -52,7 +52,22 @@ HEALTH_FAMILIES = {
     "degraded_binds": "SeaweedFS_server_degraded_binds_total",
     "corrupt_shards": "SeaweedFS_ec_corrupt_shards_total",
     "scrub_repairs": "SeaweedFS_ec_scrub_repairs_total",
+    # master-resident families (ops/coordinator.py): volume servers
+    # cannot know cluster-wide shard counts, so the totals come from
+    # the aggregator's local_fn hook (the coordinator's
+    # health_contribution), never from peer scrapes
+    "ec_under_replicated": "SeaweedFS_ec_under_replicated",
+    "coordinator_repair_failures":
+        "SeaweedFS_coordinator_repair_failures_total",
 }
+
+# keys whose truth lives on the MASTER: the per-peer rollup reports 0
+# and the totals come only from local_fn.  Summing peer scrapes would
+# double-count whenever servers share a process registry (in-process
+# fixtures, `weed server` co-location) — each peer's /metrics would
+# expose the master's own gauge.
+MASTER_LOCAL_HEALTH_KEYS = ("ec_under_replicated",
+                            "coordinator_repair_failures")
 
 
 def _unescape(v: str) -> str:
@@ -225,8 +240,13 @@ class ClusterAggregator:  # weedlint: concurrent-class
                  scrub_fetch: Optional[Callable[[str],
                                                Optional[dict]]] = None,
                  min_interval: float = 2.0, stale_after: float = 30.0,
-                 timeout: float = 2.0):
+                 timeout: float = 2.0,
+                 local_fn: Optional[Callable[[], dict]] = None):
         self.peers_fn = peers_fn
+        # master-local health additions (keys must already be totals
+        # keys): the coordinator's under-replication gauge and repair-
+        # failure counter live on the master, not on any scraped peer
+        self.local_fn = local_fn
         self.min_interval = min_interval
         self.stale_after = stale_after
         self.timeout = timeout
@@ -442,6 +462,9 @@ class ClusterAggregator:  # weedlint: concurrent-class
             entry = dict(status[url])
             ph = {}
             for key, family in HEALTH_FAMILIES.items():
+                if key in MASTER_LOCAL_HEALTH_KEYS:
+                    ph[key] = 0
+                    continue
                 coll = (st.families or {}).get(family)
                 v = int(sum(coll.snapshot().values())) if coll is not None \
                     else 0
@@ -462,6 +485,14 @@ class ClusterAggregator:  # weedlint: concurrent-class
                 totals["scrub_unrepairable"] += \
                     verdict_counts.get("unrepairable", 0)
             peers[url] = entry
+        if self.local_fn is not None:
+            try:
+                extra = self.local_fn() or {}
+            except Exception:
+                extra = {}
+            for key, val in extra.items():
+                if key in totals:
+                    totals[key] += int(val)
         stale = sorted(u for u, s in status.items() if s["stale"])
         return {"peers": peers, "totals": totals,
                 "stale_peers": stale,

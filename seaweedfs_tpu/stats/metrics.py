@@ -503,6 +503,49 @@ class ECIntegrityMetrics:
         }
 
 
+class CoordinatorMetrics:
+    """Autonomous EC rebuild/rebalance coordinator counters
+    (ops/coordinator.py, master-side).  `under_replicated` is the gauge
+    behind the ec_under_replicated health family — volumes below k+1
+    clean shards, which only the master (who holds the shard registry)
+    can count; `repair_failures` is its coordinator_repair_failures
+    companion.  Both fold into /cluster/health through the aggregator's
+    local_fn hook, since no volume-server scrape can carry them."""
+
+    def __init__(self, registry: Registry = REGISTRY):
+        self.repairs = registry.counter(
+            "SeaweedFS_coordinator_repairs_total",
+            "EC volume repairs the coordinator executed.",
+            labels=("outcome",))
+        self.repair_failures = registry.counter(
+            "SeaweedFS_coordinator_repair_failures_total",
+            "Coordinator repair attempts that failed (by error type).",
+            labels=("reason",))
+        self.moves = registry.counter(
+            "SeaweedFS_coordinator_moves_total",
+            "EC shard moves the coordinator executed "
+            "(dedupe/rack/skew/spread).",
+            labels=("reason",))
+        self.cycles = registry.counter(
+            "SeaweedFS_coordinator_cycles_total",
+            "Coordinator planning cycles.", labels=("outcome",))
+        self.under_replicated = registry.gauge(
+            "SeaweedFS_ec_under_replicated",
+            "EC volumes below k+1 clean reachable shards.")
+        self.queue_depth = registry.gauge(
+            "SeaweedFS_coordinator_queue_depth",
+            "EC volumes queued for repair.")
+
+    def totals(self) -> dict[str, int]:
+        return {
+            "repairs": int(sum(self.repairs.snapshot().values())),
+            "repair_failures":
+                int(sum(self.repair_failures.snapshot().values())),
+            "moves": int(sum(self.moves.snapshot().values())),
+            "under_replicated": int(self.under_replicated.value()),
+        }
+
+
 _singletons: dict[str, object] = {}
 _singleton_lock = threading.Lock()
 
@@ -536,6 +579,10 @@ def ec_pipeline_metrics() -> ECPipelineMetrics:
 
 def ec_integrity_metrics() -> ECIntegrityMetrics:
     return _singleton("ec_integrity", ECIntegrityMetrics)
+
+
+def coordinator_metrics() -> CoordinatorMetrics:
+    return _singleton("coordinator", CoordinatorMetrics)
 
 
 def start_push_loop(gateway_url: str, job: str,

@@ -85,6 +85,14 @@ FAULT_POINTS: dict[str, str] = {
                         "(corrupt_block): params {shard, offset, bit} "
                         "— the bit-rot drill behind verify-on-use "
                         "(ec/integrity.py paths)",
+    "coord.plan": "rebuild/rebalance coordinator planning cycle — an "
+                  "injected error must be contained (loop survives, "
+                  "last_error surfaces, next cycle re-plans) "
+                  "(ops/coordinator.py)",
+    "coord.exec": "coordinator plan-execution step (every "
+                  "/admin/ec/* leg) — injected error fails the "
+                  "current repair/move so re-plan + no-orphan "
+                  "cleanup paths run (ops/coordinator.py)",
 }
 
 

@@ -404,6 +404,53 @@ class VolumeServer:
                                    if v[1] > now}
         return locs
 
+    def _verify_copied_shards(self, vid: int, collection: str,
+                              base: str, shard_ids: list[int]) -> None:
+        """Sidecar-aware cross-server transfer (/admin/ec/copy): check
+        every fetched shard's blocks against the `.eci` that rode along
+        before the copy is acknowledged.  Remote reads used to trust
+        the wire — rot at the source or a mangled transfer became a
+        trusted local replica.  A mismatching shard is deleted, counted
+        as SeaweedFS_ec_corrupt_shards_total{source="wire"}, journaled
+        as a shard_corrupt event, and the whole copy rejected so the
+        caller retries from another holder.  No sidecar (pre-sidecar
+        volume) or no row for a shard: verification is unavailable,
+        the copy proceeds as before."""
+        from ..ec.integrity import (EciSidecar, note_corruption,
+                                    verify_shard_file)
+
+        sc = EciSidecar.load(base)
+        if sc is None:
+            return
+        bad: list[int] = []
+        for sid in shard_ids:
+            path = base + to_ext(sid)
+            if not os.path.exists(path):
+                continue
+            try:
+                blocks = verify_shard_file(sc, path, sid)
+            except OSError:
+                continue  # unreadable local disk: not wire corruption
+            if blocks:
+                note_corruption("wire", sid, base, block=blocks[0])
+                bad.append(sid)
+        if bad:
+            # the whole request is rejected, so clean siblings fetched
+            # by it must not be stranded either: the caller treats the
+            # copy as failed, nothing will mount them, and an unmounted
+            # shard file is invisible to heartbeats AND the scrubber —
+            # an orphan forever.  Shards this server already serves
+            # (mounted before the request) stay: their overwritten
+            # bytes just verified clean.
+            ev = self.store.ec_volumes.get(vid)
+            mounted = set(ev.shards) if ev is not None else set()
+            drop = [s for s in shard_ids
+                    if s in bad or s not in mounted]
+            self.store.ec_delete_shards(vid, drop, collection)
+            raise HttpError(
+                502, f"shards {bad} of volume {vid} failed .eci "
+                     f"sidecar verification after copy; rejected")
+
     def _fetch_remote_shard(self, vid: int, shard_id: int, offset: int,
                             length: int) -> bytes:
         """store_ec.go:188-218: remote shard read, falling back to remote
@@ -1288,21 +1335,28 @@ class VolumeServer:
 
         @r.route("POST", "/admin/ec/copy")
         def ec_copy(req: Request) -> Response:
-            """VolumeEcShardsCopy: pull shard files from source server."""
+            """VolumeEcShardsCopy: pull shard files from source server.
+            Each fetched shard is verified block-by-block against the
+            `.eci` sidecar it ships with BEFORE anything can mount it —
+            a mismatch (rot at the source, bytes mangled on the wire)
+            rejects the copy instead of laundering bad bytes into a
+            fresh replica."""
             b = req.json()
             vid = int(b["volume_id"])
             collection = b.get("collection", "")
             source = b["source_data_node"]
             base = volume_file_prefix(self.store.locations[0].directory,
                                       collection, vid)
-            exts = [to_ext(int(s)) for s in b.get("shard_ids", [])]
+            shard_ids = [int(s) for s in b.get("shard_ids", [])]
+            exts = [to_ext(s) for s in shard_ids]
             if b.get("copy_ecx_file", True):
                 exts.append(".ecx")
             if b.get("copy_ecj_file", True):
                 exts.append(".ecj")
             # the block-crc sidecar travels with the shards so the
-            # destination can verify-on-use and scrub them; absence is
-            # fine (pre-sidecar volume — backfill can adopt it later)
+            # destination can verify-on-arrival, verify-on-use and
+            # scrub them; absence is fine (pre-sidecar volume —
+            # backfill can adopt it later)
             exts.append(".eci")
             from ..utils.httpd import http_download
 
@@ -1313,6 +1367,7 @@ class VolumeServer:
                     base + ext, timeout=3600)
                 if status != 200 and ext not in (".ecj", ".eci"):
                     raise HttpError(500, f"copy {ext} from {source}: {status}")
+            self._verify_copied_shards(vid, collection, base, shard_ids)
             return Response({})
 
         @r.route("GET", "/admin/ec/download")

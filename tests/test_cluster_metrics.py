@@ -118,7 +118,8 @@ class TestAggregatorUnit:
         assert st["has_data"] is False
         assert agg.health()["peers"]["x:1"]["pipeline_health"] == {
             "worker_restarts": 0, "engine_fallbacks": 0,
-            "degraded_binds": 0, "corrupt_shards": 0, "scrub_repairs": 0}
+            "degraded_binds": 0, "corrupt_shards": 0, "scrub_repairs": 0,
+            "ec_under_replicated": 0, "coordinator_repair_failures": 0}
 
     def test_unregistered_peer_drops_out(self):
         peers = ["a:1", "b:2"]
@@ -212,6 +213,8 @@ class TestClusterEndpoints:
                                       "degraded_binds",
                                       "corrupt_shards",
                                       "scrub_repairs",
+                                      "ec_under_replicated",
+                                      "coordinator_repair_failures",
                                       "scrub_unrepairable"}
         # the scrub verdict rollup rides the same scrape (PR 6): idle
         # scrubbers report not-running with zero verdicts

@@ -119,3 +119,52 @@ def test_ec_shm_fault_fails_worker_spawn():
     with pytest.raises(OSError):
         ProcessOverlapWorker(8, 4, 1 << 12, matrix, nbufs=2)
     assert fi.fired("ec.shm") == 1
+
+
+def test_coord_exec_fault_fails_plan_step():
+    """Arming `coord.exec` makes coordinator plan-execution steps fail
+    deterministically — the lever the mid-rebuild chaos drills pull.
+    The executor surfaces the fault to its caller (a failed move here);
+    recovery (re-plan, no-orphan cleanup) is the coordinator's job and
+    is drilled in test_pipeline_chaos."""
+    from seaweedfs_tpu.ops.coordinator import (ClusterView, Move,
+                                               NodeView, PlanExecutor)
+
+    calls = []
+    view = ClusterView(
+        nodes={"a:1": NodeView("a:1"), "b:1": NodeView("b:1")},
+        shards={1: {0: ["a:1"]}})
+    ex = PlanExecutor(post_fn=lambda *a: calls.append(a) or {})
+    fi.enable("coord.exec", error_rate=1.0, max_hits=1)
+    with pytest.raises(OSError):
+        ex.execute_move(view, Move(1, 0, "a:1", "b:1"))
+    assert fi.fired("coord.exec") == 1
+    assert not calls  # the fault fired BEFORE the wire was touched
+    # disarmed: the same step now goes through
+    ex.execute_move(view, Move(1, 0, "a:1", "b:1"))
+    assert calls
+
+
+def test_coord_plan_fault_is_contained_by_the_loop():
+    """Arming `coord.plan` fails a planning cycle; the coordinator loop
+    must contain it (surface last_error, keep cycling) instead of
+    dying — the next cycle re-plans."""
+    import time as _time
+
+    from seaweedfs_tpu.master.topology import Topology
+    from seaweedfs_tpu.ops.coordinator import EcCoordinator
+
+    c = EcCoordinator(topo=Topology(), post_fn=lambda *a: {},
+                      interval_s=0.05)
+    fi.enable("coord.plan", error_rate=1.0, max_hits=1)
+    c.start()
+    try:
+        deadline = _time.time() + 5
+        while _time.time() < deadline and not c.status()["cycles"]:
+            _time.sleep(0.05)
+        st = c.status()
+        assert st["cycles"] > 0  # loop survived the injected fault
+        assert fi.fired("coord.plan") == 1
+    finally:
+        fi.clear()
+        c.stop()

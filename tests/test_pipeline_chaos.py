@@ -1,6 +1,7 @@
-"""Chaos drills for the self-healing EC encode pipeline.
+"""Chaos drills for the self-healing EC pipeline AND the cluster-level
+rebuild/rebalance coordinator.
 
-The contract under test (ec/overlap.py supervision + ec/streaming.py
+Process-level contract (ec/overlap.py supervision + ec/streaming.py
 per-dispatch retry/fallback): a parity worker dying, stalling, or
 faulting mid-encode must NEVER surface as a caller-visible error — the
 supervisor respawns the worker and replays in-flight dispatches, and
@@ -8,6 +9,17 @@ when the restart budget is exhausted the encode degrades per-dispatch to
 the CPU codec and still completes with byte-identical parity.  Faults
 are driven two ways: deterministically through the ec.* fault points
 (utils/faultinject), and with a real SIGKILL of the worker process.
+These drills need the native gf256 engine (overlap workers) and skip
+without it.
+
+Cluster-level contract (ops/coordinator.py, TestCoordinatorChaos): with
+the coordinator enabled and NO manual intervention, corrupting shards
+on two racks, killing a volume server mid-rebuild, or joining a fresh
+server must each converge autonomously — every EC volume back to a full
+clean shard set, rack diversity respected, no orphan shards — and the
+journaled repair events must prove the coordinator reacted to the fired
+alert (alert id + causing trace id on every action), not to a test
+back-channel.  These drills run on the CPU codec everywhere.
 
 Health is observable: SeaweedFS_ec_worker_restarts_total and
 SeaweedFS_ec_engine_fallbacks_total counters, pipeline.retry /
@@ -35,9 +47,11 @@ from seaweedfs_tpu.utils import faultinject as fi
 
 from seaweedfs_tpu import native
 
-if native.load() is None:  # pragma: no cover - toolchain-less hosts
-    pytest.skip("native gf256 engine unavailable: no overlap workers",
-                allow_module_level=True)
+# the worker drills need the native engine; the coordinator cluster
+# drills below run everywhere (CPU codec)
+needs_native = pytest.mark.skipif(
+    native.load() is None,
+    reason="native gf256 engine unavailable: no overlap workers")
 
 K, R, TOTAL = 10, 4, 14
 LARGE, SMALL = 100 << 20, 1 << 20  # default small rows for a 64MB volume
@@ -87,6 +101,7 @@ def _close(enc: StreamingEncoder) -> None:
         enc._proc_worker = None
 
 
+@needs_native
 def test_ack_fault_respawns_worker_byte_identical(volume, tracer):
     """ec.worker.ack armed: the supervisor SIGKILLs and respawns the
     real worker process, replays in-flight dispatches, and the encode
@@ -116,6 +131,7 @@ def test_ack_fault_respawns_worker_byte_identical(volume, tracer):
     assert "SeaweedFS_ec_worker_restarts_total" in REGISTRY.expose()
 
 
+@needs_native
 def test_sigkill_worker_mid_encode_completes(volume):
     """A real os.kill(SIGKILL) of the parity worker mid-encode: the
     bounded ack read detects the death, the supervisor respawns and
@@ -164,6 +180,7 @@ def test_sigkill_worker_mid_encode_completes(volume):
     assert m.worker_restarts.value("staged") - r0 >= 1
 
 
+@needs_native
 def test_budget_exhausted_finishes_via_cpu_fallback(volume, tracer):
     """Restart budget 0 + one injected ack fault: the worker path gives
     up immediately and the encode finishes mid-stream on the CPU codec —
@@ -190,6 +207,7 @@ def test_budget_exhausted_finishes_via_cpu_fallback(volume, tracer):
     assert "SeaweedFS_ec_engine_fallbacks_total" in REGISTRY.expose()
 
 
+@needs_native
 def test_dispatch_and_drain_faults_fall_back_per_dispatch(tmp_path):
     """One-shot ec.dispatch / ec.drain faults degrade exactly the hit
     dispatches to the CPU codec; the worker stays alive and keeps the
@@ -218,6 +236,7 @@ def test_dispatch_and_drain_faults_fall_back_per_dispatch(tmp_path):
     assert alive  # per-dispatch fallback, not whole-pipeline degradation
 
 
+@needs_native
 def test_mmap_worker_sigkill_respawns_and_replays(tmp_path):
     """The zero-copy mmap path's FileParityWorker: a real SIGKILL mid-
     encode respawns the worker (which re-opens the input file) and the
@@ -274,6 +293,7 @@ def test_mmap_worker_sigkill_respawns_and_replays(tmp_path):
     assert m.worker_restarts.value("mmap") - r0 >= 1
 
 
+@needs_native
 def test_mid_encode_failure_resumes_from_checkpoint(tmp_path, tracer,
                                                     monkeypatch):
     """A fill-phase IO error mid-encode retries the call, RESUMING from
@@ -316,6 +336,7 @@ def test_mid_encode_failure_resumes_from_checkpoint(tmp_path, tracer,
     assert retries and retries[0].attrs["resume_byte"] > 0
 
 
+@needs_native
 def test_staged_resume_entrypoint_is_byte_exact(tmp_path):
     """The resume machinery itself: corrupt every shard past a dispatch
     boundary, re-enter _encode_file_staged at that checkpoint, and the
@@ -349,6 +370,7 @@ def test_staged_resume_entrypoint_is_byte_exact(tmp_path):
     assert _shards(out) == ref
 
 
+@needs_native
 def test_async_drain_deep_buffers_byte_identical(tmp_path):
     """The async multi-buffered drain at depth=4 (5 slots in flight),
     staged-process AND mmap-process: FIFO writer order must keep shards
@@ -383,6 +405,7 @@ def test_async_drain_deep_buffers_byte_identical(tmp_path):
         assert enc.stats["fallbacks"] == 0, overlap
 
 
+@needs_native
 def test_worker_kill_while_drain_queue_full(volume):
     """SIGKILL the parity worker while the async drain queue is FULL
     (slow drainer via ec.drain delay keeps every slot in flight): the
@@ -434,6 +457,7 @@ def test_worker_kill_while_drain_queue_full(volume):
     assert m.worker_restarts.value("staged") - r0 >= 1
 
 
+@needs_native
 def test_worker_err_ack_recomputes_without_killing_worker(tmp_path):
     """A job that fails INSIDE a live worker is acked ("err", seq) and
     surfaces as WorkerJobError: that dispatch recomputes serially, the
@@ -464,3 +488,337 @@ def test_worker_err_ack_recomputes_without_killing_worker(tmp_path):
         assert w.worker_pid == pid and w.restarts == 0
     finally:
         w.close()
+
+
+# --- cluster-level coordinator chaos drills --------------------------------
+# (ops/coordinator.py; CPU codec — no native engine needed)
+
+def _mk_coord_cluster(tmp_path, racks):
+    """Master with the coordinator ENABLED (fast cadences, paused for
+    deterministic setup) + one volume server per rack name."""
+    from seaweedfs_tpu.master.server import MasterServer
+    from seaweedfs_tpu.volume_server.server import VolumeServer
+    from tests.conftest import free_port
+
+    master = MasterServer(port=free_port(), pulse_seconds=0.3,
+                          metrics_aggregation_seconds=0.2,
+                          coordinator_seconds=0.3).start()
+    master.aggregator.min_interval = 0.0
+    master.alert_engine.min_interval = 0.0
+    master.coordinator.pause("setup")
+    master.coordinator.move_rate = 100.0  # tests: budget never the wall
+    servers = []
+    for i, rack in enumerate(racks):
+        d = tmp_path / f"vs{i}"
+        d.mkdir()
+        servers.append(VolumeServer(
+            [str(d)], master.url, port=free_port(), rack=rack,
+            data_center="dc1", pulse_seconds=0.3).start())
+    deadline = time.time() + 10
+    while time.time() < deadline and \
+            len(master.topo.all_nodes()) < len(servers):
+        time.sleep(0.05)
+    assert len(master.topo.all_nodes()) == len(servers)
+    return master, servers
+
+
+def _make_ec_volume(vs, needles=40):
+    from seaweedfs_tpu.storage.needle import Needle
+
+    v = vs.store.add_volume(1)
+    rng = np.random.default_rng(0xEC)
+    for i in range(1, needles + 1):
+        v.write_needle(Needle(cookie=i, id=i,
+                              data=rng.bytes(400 + i * 13)))
+    vs.store.ec_generate(1)
+    vs.store.ec_mount(1)
+
+
+def _spread_shards(servers, layout):
+    """Place volume 1's shards per {server index: [shard ids]} with real
+    cross-server /admin/ec/copy legs (sidecar rides along)."""
+    from seaweedfs_tpu.utils.httpd import http_json
+
+    src = servers[0]
+    for i, sids in layout.items():
+        if i == 0:
+            continue
+        http_json("POST", f"http://{servers[i].url}/admin/ec/copy",
+                  {"volume_id": 1, "shard_ids": sids,
+                   "source_data_node": src.url})
+        http_json("POST", f"http://{servers[i].url}/admin/ec/mount",
+                  {"volume_id": 1})
+    keep = layout.get(0, [])
+    drop = [s for s in range(TOTAL) if s not in keep]
+    if drop:
+        http_json("POST", f"http://{src.url}/admin/ec/delete",
+                  {"volume_id": 1, "shard_ids": drop})
+        if keep:
+            http_json("POST", f"http://{src.url}/admin/ec/mount",
+                      {"volume_id": 1})
+    http_json("POST", f"http://{src.url}/admin/delete_volume",
+              {"volume_id": 1})
+    for vs in servers:
+        vs.heartbeat_now()
+
+
+def _registry_shards(master):
+    with master.topo.lock:
+        locs = master.topo.ec_shard_locations.get(1, {})
+        return {sid: [n.url for n in nodes]
+                for sid, nodes in locs.items() if nodes}
+
+
+def _wait(cond, timeout, what):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        v = cond()
+        if v:
+            return v
+        time.sleep(0.1)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def _scrub_once(vs):
+    from seaweedfs_tpu.utils.httpd import http_json
+
+    http_json("POST", f"http://{vs.url}/ec/scrub/start",
+              {"rate_mb_s": 0, "interval_s": 0})
+    _wait(lambda: not http_json(
+        "GET", f"http://{vs.url}/ec/scrub/status")["running"],
+        20, f"scrub on {vs.url}")
+
+
+def test_coordinator_heals_corruption_on_two_racks(tmp_path, tracer):
+    """The acceptance drill: rot shards on TWO racks, let the scrubbers
+    quarantine them (locally unrepairable — each holder has < k local
+    shards), and assert the coordinator — triggered by the FIRED alert,
+    with no manual intervention — rebuilds cross-server until every
+    shard has a clean holder again, journaling the alert id and causing
+    trace id on the repair."""
+    from seaweedfs_tpu.utils.httpd import http_json
+
+    master, servers = _mk_coord_cluster(
+        tmp_path, ["r0", "r0", "r1", "r1"])
+    try:
+        _make_ec_volume(servers[0])
+        _spread_shards(servers, {0: [0, 1, 2, 3], 1: [4, 5, 6],
+                                 2: [7, 8, 9, 10], 3: [11, 12, 13]})
+        _wait(lambda: len(_registry_shards(master)) == TOTAL, 10,
+              "registry to see the spread")
+        # counter baselines established before the injection
+        _wait(lambda: master.alert_engine.evaluations > 0, 10,
+              "first alert evaluation")
+        # shard 2 rots on rack r0, shard 8 on rack r1
+        for vs, sid in ((servers[0], 2), (servers[2], 8)):
+            fi.enable("ec.shard.corrupt",
+                      params={"shard": sid, "offset": 0, "bit": 3},
+                      max_hits=1)
+            _scrub_once(vs)
+        fi.clear()
+        _wait(lambda: set(_registry_shards(master)) ==
+              set(range(TOTAL)) - {2, 8}, 15,
+              "quarantined shards to leave the registry")
+        # the alert fires autonomously BEFORE the coordinator may act
+        firing = _wait(lambda: {
+            a["name"] for a in master.alert_engine.to_dict()["alerts"]
+            if a["state"] == "firing"} or None, 20, "a firing alert")
+        assert firing & {"scrub_unrepairable",
+                         "corrupt_shards_increase"}, firing
+        master.coordinator.resume()
+        # autonomous convergence: all 14 shards, exactly one holder each
+        _wait(lambda: set(_registry_shards(master)) ==
+              set(range(TOTAL)), 30, "repair to restore all shards")
+        _wait(lambda: all(len(u) == 1
+                          for u in _registry_shards(master).values()),
+              15, "single holder per shard (no orphans)")
+        # rack diversity respected — the repair's spread aims for it,
+        # and the continuous rebalance pass mops up any placement the
+        # spread made against a lagging registry view, so poll
+        from seaweedfs_tpu.ops.coordinator import (rack_ceiling,
+                                                   view_from_topology)
+
+        def racks_ok():
+            view = view_from_topology(master.topo)
+            return all(c <= rack_ceiling(view)
+                       for c in view.rack_counts(1).values())
+        _wait(racks_ok, 20, "rack diversity to converge")
+        # the journaled repair carries the alert id and the causing
+        # trace id — the proof it reacted to the signal plane, not a
+        # test back-channel (the event rides the shipper's flush)
+        try:
+            evs = _wait(lambda: http_json(
+                "GET", f"http://{master.url}/cluster/events"
+                       "?type=repair_done&limit=10")["events"] or None,
+                10, "repair_done to reach the cluster journal")
+        except AssertionError:
+            from seaweedfs_tpu.observability import events as _ev
+
+            raise AssertionError(
+                "repair_done never reached the cluster journal; "
+                f"coordinator={master.coordinator.status()!r} "
+                f"global_journal_repairs="
+                f"{_ev.get_journal().query(type_='repair_done')!r}")
+        d = evs[-1]["details"]
+        assert d["vid"] == 1
+        assert d["alert"] in firing, d
+        unrep = http_json(
+            "GET", f"http://{master.url}/cluster/events"
+                   "?type=scrub_unrepairable&limit=10")["events"]
+        scrub_traces = {e.get("trace", "") for e in unrep}
+        assert d["cause_trace"] in scrub_traces and d["cause_trace"]
+        # the repair itself ran under its own (stitchable) trace
+        assert len(evs[-1].get("trace", "")) == 32
+        # and the fired alert auto-captured flight-recorder evidence
+        alerts = {a["name"]: a
+                  for a in master.alert_engine.to_dict()["alerts"]}
+        fired = [alerts[n] for n in firing
+                 if alerts[n].get("fired_at")]
+        assert fired and all(a["fired_at"] <= evs[-1]["ts"]
+                             for a in fired)
+    finally:
+        fi.clear()
+        for vs in servers:
+            vs.stop()
+        master.stop()
+
+
+def test_coordinator_replans_after_server_death_mid_rebuild(tmp_path,
+                                                            tracer):
+    """Kill a volume server mid-rebuild: the first repair attempt fails
+    (injected coord.exec fault) and is re-queued; the server holding
+    three survivors then dies; the re-planned repair works around the
+    dead holder (skips its survivors, regenerates them) and converges
+    with no orphan shards on any live server's disk."""
+    master, servers = _mk_coord_cluster(
+        tmp_path, ["r0", "r0", "r1", "r1", "r2"])
+    try:
+        _make_ec_volume(servers[0])
+        _spread_shards(servers, {0: [0, 1, 2], 1: [3, 4, 5],
+                                 2: [6, 7, 8], 3: [9, 10, 11],
+                                 4: [12, 13]})
+        _wait(lambda: len(_registry_shards(master)) == TOTAL, 10,
+              "registry to see the spread")
+        # zero the move budget: this drill asserts exact disk layouts,
+        # so background rebalance churn is held off
+        master.coordinator.move_rate = 0.0
+        master.coordinator.move_burst = 0.0
+        master.coordinator._tokens = 0.0
+        # lose shard 13 so the coordinator has a repair to run, and
+        # arm the execution fault across the whole first attempt: all
+        # 10 survivor copies to the rebuild host fail (a single
+        # injected step failure is absorbed by the per-holder fallback
+        # — by design), so the attempt dies mid-plan and is re-queued
+        from seaweedfs_tpu.utils.httpd import http_json
+
+        http_json("POST", f"http://{servers[4].url}/admin/ec/delete",
+                  {"volume_id": 1, "shard_ids": [13]})
+        servers[4].heartbeat_now()
+        fi.enable("coord.exec", error_rate=1.0, max_hits=10)
+        master.coordinator.resume()
+        _wait(lambda: http_json(
+            "GET", f"http://{master.url}/cluster/events"
+                   "?type=repair_failed&limit=5")["events"], 20,
+            "the injected mid-rebuild failure")
+        assert fi.fired("coord.exec") >= 1
+        # the server holding survivors 3,4,5 dies before the re-plan
+        servers[1].stop()
+        _wait(lambda: set(_registry_shards(master)) ==
+              set(range(TOTAL)), 60,
+              "re-planned repair to restore all shards")
+        _wait(lambda: all(len(u) == 1
+                          for u in _registry_shards(master).values()),
+              20, "single holder per shard")
+        reg = _registry_shards(master)
+        assert not any(servers[1].url in urls for urls in reg.values())
+        # no orphan shard files: every live server's disk holds exactly
+        # what the registry says it holds (poll — a snapshot taken
+        # while a move is mid-flight may transiently disagree)
+        import glob as _glob
+
+        from seaweedfs_tpu.storage.volume import volume_file_prefix
+
+        def _disk_matches_registry():
+            r = _registry_shards(master)
+            for i, vs in enumerate(servers):
+                if i == 1:
+                    continue
+                base = volume_file_prefix(
+                    vs.store.locations[0].directory, "", 1)
+                on_disk = {int(p[-2:]) for p in
+                           _glob.glob(base + ".ec[0-9][0-9]")}
+                in_reg = {sid for sid, urls in r.items()
+                          if vs.url in urls}
+                if on_disk != in_reg:
+                    return None
+            return True
+        try:
+            _wait(_disk_matches_registry, 15, "disk == registry")
+        except AssertionError:
+            raise AssertionError(
+                "orphan shards: disk != registry; recent="
+                f"{master.coordinator.status()['recent']!r}")
+    finally:
+        fi.clear()
+        for i, vs in enumerate(servers):
+            if i != 1:
+                vs.stop()
+        master.stop()
+
+
+def test_fresh_server_join_triggers_rack_aware_rebalance(tmp_path):
+    """Join a fresh server on a NEW rack: the running coordinator's
+    continuous rebalance pass notices (shard-count skew + rack
+    diversity now improvable), moves shards within the token budget,
+    and CONVERGES — repeated cycles stop producing moves."""
+    master, servers = _mk_coord_cluster(tmp_path, ["r0", "r1"])
+    try:
+        _make_ec_volume(servers[0])
+        _spread_shards(servers, {0: [0, 1, 2, 3, 4, 5, 6],
+                                 1: [7, 8, 9, 10, 11, 12, 13]})
+        _wait(lambda: len(_registry_shards(master)) == TOTAL, 10,
+              "registry to see the spread")
+        master.coordinator.resume()
+        # 7/7 over two racks is stable: no spurious churn
+        time.sleep(1.5)
+        assert master.coordinator.status()["moves"] == 0
+        # a fresh server joins on a third rack
+        from seaweedfs_tpu.volume_server.server import VolumeServer
+        from tests.conftest import free_port
+
+        d = tmp_path / "vs-new"
+        d.mkdir()
+        fresh = VolumeServer([str(d)], master.url, port=free_port(),
+                             rack="r2", data_center="dc1",
+                             pulse_seconds=0.3).start()
+        servers.append(fresh)
+        _wait(lambda: master.coordinator.status()["moves"] > 0, 30,
+              "rebalance moves after the join")
+        # convergence: the move count stops growing
+        def settled():
+            a = master.coordinator.status()["moves"]
+            time.sleep(1.2)
+            return a == master.coordinator.status()["moves"]
+        _wait(settled, 45, "rebalance to converge")
+        reg = _registry_shards(master)
+        assert set(reg) == set(range(TOTAL))
+        assert all(len(u) == 1 for u in reg.values())
+        # the fresh rack carries real load now, within the ceiling
+        from seaweedfs_tpu.ops.coordinator import (rack_ceiling,
+                                                   view_from_topology)
+
+        view = view_from_topology(master.topo)
+        counts = view.rack_counts(1)
+        assert counts.get(("dc1", "r2"), 0) >= 2
+        assert all(c <= rack_ceiling(view) for c in counts.values())
+        # journaled, attributed moves
+        from seaweedfs_tpu.utils.httpd import http_json
+
+        evs = http_json("GET", f"http://{master.url}/cluster/events"
+                               "?type=rebalance_move&limit=50")["events"]
+        assert evs and all(e["details"]["reason"] in
+                           ("rack", "skew", "dedupe") for e in evs)
+    finally:
+        for vs in servers:
+            vs.stop()
+        master.stop()

@@ -25,8 +25,12 @@ EVENTS_REL = os.path.join("seaweedfs_tpu", "observability", "events.py")
 # HEALTH_FAMILIES keys that legitimately stay OUT of
 # DEGRADE_COUNTER_KEYS: a degraded TCP bind means a server came up
 # without its fast plane — operationally alertable, but it does not
-# make a pipeline MEASUREMENT degraded.
-DEGRADE_KEY_ALLOWLIST = ("degraded_binds",)
+# make a pipeline MEASUREMENT degraded.  The coordinator keys are
+# cluster-topology conditions (volumes short of k+1 clean shards,
+# master-side repair plans failing): alertable, never an attribute of
+# one encode/read run's measurement.
+DEGRADE_KEY_ALLOWLIST = ("degraded_binds", "ec_under_replicated",
+                         "coordinator_repair_failures")
 
 # DEGRADE_COUNTER_KEYS entries that are per-run encode stats rather
 # than cluster counter families.

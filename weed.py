@@ -75,6 +75,7 @@ def cmd_master(args) -> None:
                      default_replication=args.defaultReplication,
                      peers=peers, mdir=args.mdir,
                      metrics_aggregation_seconds=args.metricsAggregationSeconds,
+                     coordinator_seconds=args.coordinatorSeconds,
                      guard=master_guard(_security()),
                      tls_context=_cluster_tls()).start()
     print(f"master listening on {m.url}")
@@ -1104,6 +1105,13 @@ def main(argv=None) -> None:
                         "and evaluate the /cluster/alerts rules on the "
                         "same cadence (0 = on demand only: alerts only "
                         "evaluate when /cluster/alerts is fetched)")
+    m.add_argument("-coordinatorSeconds", type=float, default=0.0,
+                   help="run the autonomous EC rebuild/rebalance "
+                        "coordinator with this planning interval: "
+                        "repair volumes short of clean shards (below "
+                        "k+1 first) and rebalance shard placement "
+                        "rack-aware on server join/leave (0 = off; "
+                        "status at GET /cluster/coordinator)")
     m.set_defaults(fn=cmd_master)
 
     v = sub.add_parser("volume")
