@@ -56,7 +56,7 @@ SECTION_CAPS = {
     "e2e_decode_8gb": 420, "roofline": 90, "cluster": 360,
     "cluster_traced": 300, "alerts": 420, "coordinator": 420,
     "cluster_native": 360, "cluster_scaled": 420, "parity": 120,
-    "integrity": 120, "pipeline_health": 15,
+    "integrity": 120, "scenarios": 300, "pipeline_health": 15,
 }
 SECTION_CAP_DEFAULT = 300
 SECTION_MIN_S = 15          # least useful remaining budget to even start
@@ -1436,6 +1436,37 @@ def _child(scratch_path: str, platform: str = "") -> None:
             detail["cluster_native_tcp_read_rps"] = rates.get("read", 0.0)
 
     section("cluster_native", meas_cluster_native)
+
+    # --- production-shaped scenario suite (seaweedfs_tpu/scenarios) --------
+    def meas_scenarios():
+        """The failure-under-load proof (ROADMAP item 4): three
+        declarative scenarios — Zipfian hot-set read storm, mixed-size
+        write+churn+vacuum, and a rack-loss-shaped failure-under-load
+        drill — run against in-process clusters with the deadline
+        plane, admission control, retry budgets, and the alert engine
+        ALL live.  Each result embeds per-route RED stats, per-phase
+        p99s, shed/deadline/retry counters, the fault + alert
+        timelines, one stitched trace, and a verdicted checks list;
+        the failure scenario's checks ARE the acceptance criteria
+        (healthy-fraction rps >= 60% of baseline under the fault,
+        accepted p99 < 5x healthy, zero deadline overruns > 250ms,
+        burn-rate alert fired during the fault and resolved after)."""
+        from seaweedfs_tpu.scenarios import default_scenarios, run_scenario
+
+        block: dict = {}
+        for spec in default_scenarios():
+            try:
+                block[spec.name] = run_scenario(spec)
+            except Exception as e:  # one broken scenario must not
+                block[spec.name] = {  # hide the others' verdicts
+                    "error": f"{type(e).__name__}: {e}"[:300],
+                    "verdict": "error"}
+        block["degraded"] = any(
+            s.get("verdict") != "pass" for s in block.values()
+            if isinstance(s, dict))
+        detail["scenarios"] = block
+
+    section("scenarios", meas_scenarios)
 
     # --- scaled cluster: N volume servers, M client procs ------------------
     def meas_cluster_scaled():

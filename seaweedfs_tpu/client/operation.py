@@ -41,7 +41,8 @@ class MasterClient:
             "count": count, "collection": collection,
             "replication": replication, "ttl": ttl,
             "dataCenter": data_center})
-        r = http_json("GET", f"http://{self.master_url}/dir/assign?{q}")
+        r = http_json("GET", f"http://{self.master_url}/dir/assign?{q}",
+            timeout=30.0)
         if "error" in r and r["error"]:
             raise HttpError(500, r["error"])
         return Assignment(r["fid"], r["url"], r.get("publicUrl", r["url"]),
@@ -57,7 +58,8 @@ class MasterClient:
         if cached and now - cached[0] < self.cache_seconds:
             return cached[1], cached[2]
         r = http_json("GET",
-                      f"http://{self.master_url}/dir/lookup?volumeId={vid}")
+                      f"http://{self.master_url}/dir/lookup?volumeId={vid}",
+                          timeout=30.0)
         urls = [loc["url"] for loc in r.get("locations", [])]
         auth = r.get("auth", "")
         self._cache[vid] = (now, urls, auth)
@@ -69,7 +71,7 @@ class MasterClient:
         vid = int(fid.split(",")[0])
         r = http_json(
             "GET", f"http://{self.master_url}/dir/lookup?"
-            f"volumeId={vid}&fileId={fid}")
+            f"volumeId={vid}&fileId={fid}", timeout=30.0)
         urls = [loc["url"] for loc in r.get("locations", [])]
         return urls, r.get("auth", ""), r.get("writeAuth", "")
 
@@ -158,7 +160,7 @@ class WeedClient:
                 hdrs["Authorization"] = f"BEARER {a.auth}"
             status, body, _ = http_bytes(
                 "POST", f"http://{a.url}/{a.fid}{q}", data,
-                headers=hdrs or None)
+                headers=hdrs or None, timeout=60.0)
             if status in (200, 201):
                 return a.fid
             last_err = HttpError(status, body.decode(errors="replace"))
@@ -224,7 +226,8 @@ class WeedClient:
                 headers = {"Authorization": f"BEARER {a.auth}"} if a.auth \
                     else None
                 status, body, _ = http_bytes(
-                    "POST", f"http://{a.url}/{a.fid}", data, headers=headers)
+                    "POST", f"http://{a.url}/{a.fid}", data, headers=headers,
+                        timeout=60.0)
                 if status in (200, 201):
                     return a.fid
                 if (status == 409 or b"read only" in body) and attempt < 4:
@@ -308,7 +311,8 @@ class WeedClient:
         last_err = None
         for url in random.sample(urls, len(urls)):
             status, body, rhdrs = http_bytes("GET", f"http://{url}/{fid}",
-                                             headers=headers or None)
+                                             headers=headers or None,
+                                                 timeout=60.0)
             if status in ok:
                 return body, rhdrs
             if status == 302:
@@ -333,7 +337,8 @@ class WeedClient:
         headers = ({"Authorization": f"BEARER {write_auth}"}
                    if write_auth else None)
         for url in urls:
-            http_bytes("DELETE", f"http://{url}/{fid}", headers=headers)
+            http_bytes("DELETE", f"http://{url}/{fid}", headers=headers,
+                timeout=60.0)
             return
         raise HttpError(404,
                         f"volume {fid.split(',')[0]} has no locations")

@@ -13,8 +13,8 @@ import random
 import threading
 from dataclasses import dataclass
 
-from ..utils.backoff import jittered_backoff
-from ..utils.httpd import HttpError, http_json
+from ..utils.backoff import jittered_backoff, retry_allowed
+from ..utils.httpd import HttpError, http_json, http_json_retry
 
 
 @dataclass(frozen=True)
@@ -138,8 +138,19 @@ class WdClient:
                 # the map and serve stale locations forever
                 self._synced.clear()
                 seq = 0  # resync from snapshot on reconnect
-                delay = jittered_backoff(self.RECONNECT_BASE,
-                                         self.RECONNECT_CAP, failures)
+                # each reconnect is a RETRY against the master: it draws
+                # from the per-destination retry budget
+                # (utils/backoff.py), so a fleet of clients that all
+                # lost the same master degrades to one probe per
+                # budget-refill instead of an exponential-backoff storm
+                # — a drained bucket holds the full cap and the denial
+                # is counted + journaled (retry_budget_exhausted)
+                if retry_allowed(self.master_url, "wdclient"):
+                    delay = jittered_backoff(self.RECONNECT_BASE,
+                                             self.RECONNECT_CAP,
+                                             failures)
+                else:
+                    delay = self.RECONNECT_CAP
                 failures = min(failures + 1, 10)  # cap the exponent
                 self._stop.wait(delay)
 
@@ -148,9 +159,15 @@ class WdClient:
         urls = [l.url for l in self.vid_map.lookup(vid)]
         if urls:
             return urls
-        # miss: the volume may predate our snapshot or be EC-only
-        r = http_json("GET", f"http://{self.master_url}/dir/lookup?"
-                      f"volumeId={vid}")
+        # miss: the volume may predate our snapshot or be EC-only.
+        # An idempotent GET against a possibly-restarting master:
+        # bounded retries through the per-destination retry budget
+        # (a down master denies them and the lookup degrades to one
+        # attempt instead of joining the reconnect storm)
+        r = http_json_retry(
+            "GET", f"http://{self.master_url}/dir/lookup?"
+            f"volumeId={vid}", timeout=30.0, attempts=3,
+            budget_kind="wdclient")
         return [loc["url"] for loc in r.get("locations", [])]
 
     def lookup_file_id(self, fid: str) -> list[str]:

@@ -69,7 +69,7 @@ class ElasticStore:
              doc: Optional[dict] = None) -> tuple[int, dict]:
         body = json.dumps(doc).encode() if doc is not None else b""
         status, out, _ = http_bytes(method, self.base + path, body,
-                                    headers=self._headers)
+                                    headers=self._headers, timeout=60.0)
         if status == 429:
             # es_rejected_execution: the canonical transient backpressure
             # answer — one bounded retry after a beat, like the official
@@ -78,7 +78,7 @@ class ElasticStore:
 
             _t.sleep(0.2)
             status, out, _ = http_bytes(method, self.base + path, body,
-                                        headers=self._headers)
+                                        headers=self._headers, timeout=60.0)
         return status, (json.loads(out) if out else {})
 
     # --- entries ----------------------------------------------------------

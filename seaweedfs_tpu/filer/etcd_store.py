@@ -92,7 +92,7 @@ class EtcdStore:
     def _call_raw(self, op: str, body: dict):
         return http_bytes(
             "POST", f"{self.base}/{op}", json.dumps(body).encode(),
-            headers={"Content-Type": "application/json"})
+            headers={"Content-Type": "application/json"}, timeout=60.0)
 
     # -- entries ------------------------------------------------------------
     def insert_entry(self, entry: Entry) -> None:

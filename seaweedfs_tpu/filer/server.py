@@ -75,6 +75,7 @@ class FilerServer:
                  chunk_cache_mem_mb: int = 64, cipher: bool = False,
                  peers: Optional[list[str]] = None,
                  peer_poll_seconds: float = 1.0,
+                 max_inflight: int = 0,
                  tls_context=None):
         from ..security import Guard
 
@@ -110,6 +111,11 @@ class FilerServer:
             disk_dir=chunk_cache_dir)
         self.router = Router("filer", metrics=self.metrics)
         self.router.server_url = self.url
+        # admission control (utils/admission.py): -maxInflight > 0
+        # sheds excess load early with a fast 503
+        from ..utils.admission import maybe_controller
+
+        self.router.admission = maybe_controller(max_inflight, "filer")
         self._tls_context = tls_context
         self._register_routes()
         self._server = None
@@ -248,7 +254,8 @@ class FilerServer:
                 payload = {"fids": batch}
                 if jwts:
                     payload["jwts"] = {f: jwts[f] for f in batch if f in jwts}
-                http_json("POST", f"http://{url}/admin/batch_delete", payload)
+                http_json("POST", f"http://{url}/admin/batch_delete", payload,
+                    timeout=30.0)
             except Exception:
                 pass  # best-effort; orphans are re-collectable
 

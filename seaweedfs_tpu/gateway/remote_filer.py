@@ -45,13 +45,14 @@ class RemoteFiler:
         self.filer_url = filer_url
         self.poll_seconds = poll_seconds
         self._subs: list[tuple[Callable, threading.Event]] = []
-        info = http_json("GET", f"http://{filer_url}/api/info")
+        info = http_json("GET", f"http://{filer_url}/api/info", timeout=30.0)
         self.signature = int(info.get("signature", 0))
 
     # --- entry CRUD -------------------------------------------------------
     def find_entry(self, path: str) -> Entry:
         status, body, _ = http_bytes(
-            "GET", f"http://{self.filer_url}/api/stat" + _q(path))
+            "GET", f"http://{self.filer_url}/api/stat" + _q(path),
+                timeout=60.0)
         if status == 404:
             raise NotFoundError(path)
         if status != 200:
@@ -71,7 +72,7 @@ class RemoteFiler:
         status, body, _ = http_bytes(
             "POST", f"http://{self.filer_url}/api/entry",
             json.dumps(entry.to_dict()).encode(),
-            headers={"Content-Type": "application/json"})
+            headers={"Content-Type": "application/json"}, timeout=60.0)
         if status not in (200, 201):
             raise HttpError(status, body.decode(errors="replace"))
         return entry
@@ -80,7 +81,7 @@ class RemoteFiler:
         status, body, _ = http_bytes(
             "POST", f"http://{self.filer_url}/api/entry?update_only=true",
             json.dumps(entry.to_dict()).encode(),
-            headers={"Content-Type": "application/json"})
+            headers={"Content-Type": "application/json"}, timeout=60.0)
         if status == 404:
             raise NotFoundError(entry.full_path)
         if status not in (200, 201):
@@ -91,7 +92,8 @@ class RemoteFiler:
                      ignore_recursive_error: bool = False) -> None:
         status, body, _ = http_bytes(
             "DELETE", f"http://{self.filer_url}{_q(path)}"
-                      f"?recursive={'true' if recursive else 'false'}")
+                      f"?recursive={'true' if recursive else 'false'}",
+                          timeout=60.0)
         if status == 404:
             raise NotFoundError(path)
         if status == 409:
@@ -101,7 +103,7 @@ class RemoteFiler:
 
     def mkdir(self, path: str, mode: int = 0o770) -> Entry:
         http_json("POST", f"http://{self.filer_url}/api/mkdir",
-                  {"path": path})
+                  {"path": path}, timeout=30.0)
         return self.find_entry(path)
 
     def _ensure_parents(self, dir_path: str) -> None:
@@ -109,7 +111,7 @@ class RemoteFiler:
 
     def rename(self, old_path: str, new_path: str) -> Entry:
         http_json("POST", f"http://{self.filer_url}/api/rename",
-                  {"from": old_path, "to": new_path})
+                  {"from": old_path, "to": new_path}, timeout=30.0)
         return self.find_entry(new_path)
 
     # --- listing ----------------------------------------------------------
@@ -121,7 +123,7 @@ class RemoteFiler:
             "full": "true"})
         status, body, _ = http_bytes(
             "GET", f"http://{self.filer_url}{_q(path or '/')}?{q}",
-            headers={"Accept": "application/json"})
+            headers={"Accept": "application/json"}, timeout=60.0)
         if status == 404:
             raise NotFoundError(path)
         if status != 200:
@@ -152,7 +154,7 @@ class RemoteFiler:
                 try:
                     r = http_json(
                         "GET", f"http://{self.filer_url}/api/meta/log"
-                               f"?since_ns={cursor}")
+                               f"?since_ns={cursor}", timeout=30.0)
                     for event in r.get("events", []):
                         try:
                             fn(event)
@@ -186,7 +188,7 @@ class RemoteFilerFacade:
         q = urllib.parse.urlencode({"collection": collection, "ttl": ttl})
         status, body, _ = http_bytes(
             "POST", f"http://{self.filer_url}{_q(path)}?{q}", data,
-            headers={"Content-Type": mime} if mime else None)
+            headers={"Content-Type": mime} if mime else None, timeout=60.0)
         if status not in (200, 201):
             raise HttpError(status, body.decode(errors="replace"))
         entry = self.filer.find_entry(path)
@@ -209,7 +211,7 @@ class RemoteFilerFacade:
             headers = {"Range": f"bytes={offset}-{end}"}
         status, body, _ = http_bytes(
             "GET", f"http://{self.filer_url}{_q(entry.full_path)}",
-            headers=headers)
+            headers=headers, timeout=60.0)
         if status not in (200, 206):
             raise HttpError(status, body.decode(errors="replace"))
         return body

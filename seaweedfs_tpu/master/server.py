@@ -47,6 +47,7 @@ class MasterServer:
                  maintenance_interval_seconds: float = 900.0,
                  metrics_aggregation_seconds: float = 0.0,
                  coordinator_seconds: float = 0.0,
+                 max_inflight: int = 0,
                  tls_context=None):
         self.host, self.port = host, port
         self.guard = guard or Guard()
@@ -138,6 +139,12 @@ class MasterServer:
             self.metrics.leader_gauge.set(1 if role == "leader" else 0)
         self.router = Router("master", metrics=self.metrics)
         self.router.server_url = self.url
+        # admission control (utils/admission.py): -maxInflight > 0
+        # sheds excess requests early with a fast 503 instead of
+        # queueing everyone into late timeouts
+        from ..utils.admission import maybe_controller
+
+        self.router.admission = maybe_controller(max_inflight, "master")
         self._register_routes()
         self._server = None
         self._tcp_server = None
@@ -316,7 +323,7 @@ class MasterServer:
         and relay the answer (master_server.go proxyToLeader)."""
         r = http_json("POST",
                       f"http://{self.leader_url}{req.handler.path}",
-                      req.json() if req.body else None)
+                      req.json() if req.body else None, timeout=30.0)
         return Response(r)
 
     def _require_leader(self, req: Optional[Request] = None) -> None:
@@ -891,12 +898,12 @@ class MasterServer:
             for vid, urls in vid_nodes:
                 for url in urls:
                     http_json("POST", f"http://{url}/admin/delete_volume",
-                              {"volume_id": vid})
+                              {"volume_id": vid}, timeout=30.0)
             for vid, sid, urls in ec_holders:
                 for url in urls:
                     http_json("POST", f"http://{url}/admin/ec/delete",
                               {"volume_id": vid, "collection": name,
-                               "shard_ids": [sid]})
+                               "shard_ids": [sid]}, timeout=30.0)
             with self.topo.lock:
                 for k in keys:
                     self.topo.layouts.pop(k, None)
@@ -997,7 +1004,7 @@ class MasterServer:
         http_json("POST", f"http://{node.url}/admin/assign_volume", {
             "volume_id": vid, "collection": collection,
             "replication": replication, "ttl": ttl,
-        })
+        }, timeout=30.0)
 
     def vacuum(self, threshold: float) -> list[int]:
         """topology_vacuum.go: ask each replica its garbage ratio, then
@@ -1010,7 +1017,8 @@ class MasterServer:
                 try:
                     ratios = [
                         http_json("POST", f"http://{n.url}/admin/vacuum_check",
-                                  {"volume_id": vid})["garbage_ratio"]
+                                  {"volume_id": vid},
+                                      timeout=30.0)["garbage_ratio"]
                         for n in nodes
                     ]
                     if not ratios or min(ratios) < threshold:

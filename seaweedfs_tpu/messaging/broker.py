@@ -198,7 +198,7 @@ class BrokerServer:
             q = f"?limit=1000&lastFileName={urllib.parse.quote(last)}"
             status, body, _ = http_bytes(
                 "GET", f"http://{self.filer_url}"
-                f"{self._segment_dir(ns, topic, p)}{q}")
+                f"{self._segment_dir(ns, topic, p)}{q}", timeout=60.0)
             if status == 404:
                 return sorted(names)  # no history yet
             if status != 200:
@@ -224,7 +224,7 @@ class BrokerServer:
         path = (f"{self._segment_dir(ns, topic, p)}/"
                 f"{start_offset:012d}.seg")
         status, out, _ = http_bytes(
-            "PUT", f"http://{self.filer_url}{path}", body)
+            "PUT", f"http://{self.filer_url}{path}", body, timeout=60.0)
         if status not in (200, 201):
             raise HttpError(status, out.decode(errors="replace"))
 
@@ -245,7 +245,8 @@ class BrokerServer:
             replayed: list[dict] = []
             for seg in self._list_segments(ns, topic, p):
                 s, blob, _ = http_bytes("GET",
-                                        f"http://{self.filer_url}{seg}")
+                                        f"http://{self.filer_url}{seg}",
+                                            timeout=60.0)
                 if s != 200:
                     # skipping would shift every later offset and let a
                     # future flush OVERWRITE this segment; fail the load
@@ -355,7 +356,7 @@ class MessagingClient:
         for _ in range(3):
             status, body, hdrs = http_bytes(
                 "POST", url, json.dumps(payload).encode(),
-                follow_redirects=False)
+                follow_redirects=False, timeout=60.0)
             if status == 307:
                 url = hdrs.get("Location", url)
                 continue
@@ -376,7 +377,8 @@ class MessagingClient:
         url = f"http://{self.broker_url}/subscribe?{q}"
         for _ in range(3):
             status, body, hdrs = http_bytes("GET", url,
-                                            follow_redirects=False)
+                                            follow_redirects=False,
+                                                timeout=60.0)
             if status == 307:
                 # the Location already carries the full query string
                 url = hdrs.get("Location", url)

@@ -94,7 +94,7 @@ class MetaCache:
             try:
                 r = http_json(
                     "GET", f"http://{self.filer_url}/api/meta/log?"
-                    f"since_ns={since_ns}")
+                    f"since_ns={since_ns}", timeout=30.0)
                 for ev in r["events"]:
                     self.apply_event(ev)
                 since_ns = r["next_ns"]

@@ -68,7 +68,7 @@ class WFS:
         self.chunk_size = chunk_size_mb * 1024 * 1024
         self.collection = collection
         self.replication = replication
-        info = http_json("GET", f"http://{filer_url}/api/info")
+        info = http_json("GET", f"http://{filer_url}/api/info", timeout=30.0)
         self.client = WeedClient(master_url or info["master"])
         self.inodes = InodeToPath()
         self.meta = MetaCache(filer_url).start()
@@ -102,7 +102,7 @@ class WFS:
             return cached
         status, body, _ = http_bytes(
             "GET", f"http://{self.filer_url}/api/stat"
-            + urllib.parse.quote(apath))
+            + urllib.parse.quote(apath), timeout=60.0)
         if status == 404:
             raise FuseError(errno.ENOENT, path)
         if status != 200:
@@ -152,7 +152,7 @@ class WFS:
                  f"&lastFileName={urllib.parse.quote(last)}")
             status, body, _ = http_bytes(
                 "GET", f"http://{self.filer_url}"
-                + urllib.parse.quote(apath or "/") + q)
+                + urllib.parse.quote(apath or "/") + q, timeout=60.0)
             if status != 200:
                 raise FuseError(errno.ENOENT, path)
             import json
@@ -172,13 +172,13 @@ class WFS:
 
     def mkdir(self, path: str, mode: int = 0o755) -> None:
         http_json("POST", f"http://{self.filer_url}/api/mkdir",
-                  {"path": self._abs(path)})
+                  {"path": self._abs(path)}, timeout=30.0)
 
     def _put_entry(self, entry: Entry) -> None:
         status, body, _ = http_bytes(
             "POST", f"http://{self.filer_url}/api/entry",
             __import__("json").dumps(entry.to_dict()).encode(),
-            headers={"Content-Type": "application/json"})
+            headers={"Content-Type": "application/json"}, timeout=60.0)
         if status not in (200, 201):
             raise FuseError(errno.EIO, body.decode(errors="replace"))
         self.meta.put(entry)
@@ -229,7 +229,8 @@ class WFS:
             self.flush(fh)
         status, body, _ = http_bytes(
             "GET", f"http://{self.filer_url}" + self._quote(h.path),
-            headers={"Range": f"bytes={offset}-{offset + size - 1}"})
+            headers={"Range": f"bytes={offset}-{offset + size - 1}"},
+                timeout=60.0)
         if status in (200, 206):
             return body
         if status == 416:
@@ -264,7 +265,8 @@ class WFS:
 
     def unlink(self, path: str) -> None:
         status, body, _ = http_bytes(
-            "DELETE", f"http://{self.filer_url}" + self._quote(path))
+            "DELETE", f"http://{self.filer_url}" + self._quote(path),
+                timeout=60.0)
         if status == 404:
             raise FuseError(errno.ENOENT, path)
         if status not in (200, 204):
@@ -287,7 +289,7 @@ class WFS:
             __import__("json").dumps(
                 {"target": self._abs(target),
                  "link": self._abs(link)}).encode(),
-            headers={"Content-Type": "application/json"})
+            headers={"Content-Type": "application/json"}, timeout=60.0)
         if status != 200:
             raise FuseError(errno.EIO, body.decode(errors="replace"))
         self.meta.delete(self._abs(target))
@@ -298,7 +300,7 @@ class WFS:
             "POST", f"http://{self.filer_url}/api/rename",
             __import__("json").dumps(
                 {"from": self._abs(old), "to": self._abs(new)}).encode(),
-            headers={"Content-Type": "application/json"})
+            headers={"Content-Type": "application/json"}, timeout=60.0)
         if status != 200:
             raise FuseError(errno.EIO, body.decode(errors="replace"))
         self.meta.delete(self._abs(old))

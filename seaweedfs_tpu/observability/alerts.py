@@ -490,6 +490,14 @@ class AlertEngine:  # weedlint: concurrent-class
                          f"route {route} p99 ~{shown} > {max_p99:g}s")
         return worst
 
+    def add_rule(self, rule: Rule) -> None:
+        """Install one more rule at runtime — the scenario engine
+        (seaweedfs_tpu/scenarios) registers run-scoped SLO rules with
+        windows short enough to breach and resolve inside a drill."""
+        with self._lock:
+            self.rules.append(rule)
+            self._states[rule.name] = AlertState(rule)
+
     # --- views ------------------------------------------------------------
     def note_bundles(self, rule_name: str, bundles: list[dict]) -> None:
         """Attach flight-recorder capture results to the alert that

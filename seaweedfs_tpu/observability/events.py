@@ -71,6 +71,11 @@ EVENT_TYPES = {
     "repair_done": "info",           # volume back to full shard set
     "repair_failed": "error",        # plan step failed; will re-plan
     "rebalance_move": "info",        # one budgeted shard move executed
+    # request-plane graceful degradation (utils/deadline.py,
+    # utils/admission.py, utils/backoff.py)
+    "load_shed": "warning",          # admission control answered 503
+    "deadline_exceeded": "warning",  # X-Weed-Deadline budget spent: 504
+    "retry_budget_exhausted": "warning",  # token bucket denied a retry
 }
 
 # HEALTH_FAMILIES key (stats/aggregate.py) -> the event type emitted at
@@ -84,6 +89,9 @@ HEALTH_EVENT_TYPES = {
     "scrub_repairs": "scrub_repair",
     "ec_under_replicated": "ec_under_replicated",
     "coordinator_repair_failures": "repair_failed",
+    "requests_shed": "load_shed",
+    "deadline_exceeded": "deadline_exceeded",
+    "retry_budget_exhausted": "retry_budget_exhausted",
 }
 
 

@@ -49,7 +49,9 @@ from dataclasses import dataclass, field
 from typing import Callable, Optional
 
 from ..ec.layout import DATA_SHARDS_COUNT, TOTAL_SHARDS_COUNT
+from ..utils import deadline as _deadline
 from ..utils import faultinject
+from ..utils.backoff import retry_allowed
 
 
 # --------------------------------------------------------------------------
@@ -421,6 +423,32 @@ class PlanExecutor:
             except Exception:
                 pass
 
+    def rescrub(self, view: ClusterView, vid: int) -> list[str]:
+        """Post-repair targeted re-scrub: every holder of the healed
+        volume re-verifies it against its sidecar NOW, so a stale
+        `unrepairable` scrub verdict (recorded while < k clean shards
+        were reachable) clears immediately instead of waiting for the
+        next full pass.  Runs inside the repair's trace/deadline scope
+        — each holder's targeted pass adopts the request's trace
+        context, so the verdict flip journals under the repair.
+        Best-effort: a holder mid-scan converges on its own schedule."""
+        holders = sorted({
+            u for us in view.shards.get(vid, {}).values() for u in us
+            if view.nodes.get(u) and view.nodes[u].alive})
+        started: list[str] = []
+        for url in holders:
+            try:
+                # NO knob overrides in the payload: start() persists
+                # any rate/interval it receives onto the LIVE scrubber,
+                # so a re-scrub passing rate_mb_s=0 would silently
+                # unthrottle the operator's configured IO cap forever
+                self._post(url, "/ec/scrub/start",
+                           {"volume_id": vid}, 30.0)
+                started.append(url)
+            except Exception:
+                pass
+        return started
+
     # --- moves ------------------------------------------------------------
     def execute_move(self, view: ClusterView, mv: Move) -> None:
         """One planned move against the real cluster; view holder lists
@@ -642,7 +670,8 @@ class EcCoordinator:  # weedlint: concurrent-class
                  max_moves_per_cycle: int = 16,
                  max_repairs_per_cycle: int = 4,
                  post_fn: Optional[Callable] = None,
-                 engine: Optional[str] = None):
+                 engine: Optional[str] = None,
+                 repair_deadline_s: float = 900.0):
         self.topo = topo
         self.server = server
         self.stale_peers_fn = stale_peers_fn or (lambda: [])
@@ -655,6 +684,11 @@ class EcCoordinator:  # weedlint: concurrent-class
         self.max_moves_per_cycle = int(max_moves_per_cycle)
         self.max_repairs_per_cycle = int(max_repairs_per_cycle)
         self.engine = engine
+        # per-repair wall budget: every HTTP leg of one repair draws
+        # from ONE propagated deadline (utils/deadline.py), so a
+        # wedged peer cannot pin a repair slot for the sum of every
+        # leg's individual timeout
+        self.repair_deadline_s = float(repair_deadline_s)
         self.executor = PlanExecutor(post_fn=post_fn)
         from ..stats import coordinator_metrics
 
@@ -846,26 +880,38 @@ class EcCoordinator:  # weedlint: concurrent-class
     def _run_repairs(self) -> int:
         now = time.time()
         with self._lock:
-            ready = []
-            for vid, e in self._queue.items():
-                attempts = e.get("attempts", 0)
-                if attempts:
-                    # exponential backoff per volume: a persistently
-                    # failing repair re-copies up to k survivor shards
-                    # per attempt — retrying every cycle would saturate
-                    # the wire and spam the journal
-                    hold = min(self.interval_s * (2 ** attempts), 600.0)
-                    if now - e.get("last_attempt_at", 0.0) < hold:
-                        continue
-                ready.append((vid, e))
+            snapshot = [(vid, dict(e)) for vid, e in self._queue.items()]
+        ready = []
+        for vid, e in snapshot:
+            attempts = e.get("attempts", 0)
+            if attempts:
+                # exponential backoff per volume: a persistently
+                # failing repair re-copies up to k survivor shards
+                # per attempt — retrying every cycle would saturate
+                # the wire and spam the journal
+                hold = min(self.interval_s * (2 ** attempts), 600.0)
+                if now - e.get("last_attempt_at", 0.0) < hold:
+                    continue
+                # a re-attempt is a RETRY and draws from the
+                # per-destination retry budget (utils/backoff.py): a
+                # repair that keeps failing degrades to one attempt
+                # per budget refill — belt on top of the exponential
+                # hold, and the denial is counted + journaled
+                # (retry_budget_exhausted) so a repair storm that
+                # DIDN'T happen still shows up on the record
+                if not retry_allowed(f"repair:{vid}", "coordinator"):
+                    continue
+            ready.append((vid, e))
+        with self._lock:
             batch = sorted(
                 ready,
                 key=lambda kv: (not kv[1].get("critical", False),
                                 -kv[1].get("deficit", 0), kv[0]))
-            batch = [(vid, dict(e)) for vid, e in
-                     batch[:self.max_repairs_per_cycle]]
+            batch = batch[:self.max_repairs_per_cycle]
             for vid, _e in batch:
-                self._queue[vid]["last_attempt_at"] = now
+                q = self._queue.get(vid)
+                if q is not None:
+                    q["last_attempt_at"] = now
         if not batch:
             return 0
         import concurrent.futures
@@ -917,8 +963,15 @@ class EcCoordinator:  # weedlint: concurrent-class
                              critical=entry.get("critical", False),
                              **cause)
                 try:
-                    res = self.executor.execute_repair(
-                        view, vid, engine=self.engine)
+                    # ONE deadline for the whole repair: every leg
+                    # (copies, rebuild, mounts, spread, re-scrub)
+                    # draws from the same propagated budget, so a
+                    # wedged peer fails the repair at the budget
+                    # instead of pinning a repair slot for the sum of
+                    # every leg's timeout
+                    with _deadline.scope(self.repair_deadline_s):
+                        res = self.executor.execute_repair(
+                            view, vid, engine=self.engine)
                 except Exception as e:
                     self.metrics.repairs.inc("failed")
                     self.metrics.repair_failures.inc(
@@ -947,6 +1000,18 @@ class EcCoordinator:  # weedlint: concurrent-class
                         self._causes.pop(vid, None)
                         self._under_notified.discard(vid)
                     return True
+                # post-repair targeted re-scrub (best-effort, its own
+                # slice of the repair deadline): holders re-verify the
+                # healed volume NOW so stale unrepairable verdicts
+                # clear immediately — journaled under this repair's
+                # trace via the scrub route's context adoption
+                rescrubbed: list[str] = []
+                try:
+                    with _deadline.scope(min(60.0,
+                                             self.repair_deadline_s)):
+                        rescrubbed = self.executor.rescrub(view, vid)
+                except Exception:
+                    pass
                 self.metrics.repairs.inc("done")
                 with self._lock:
                     self.repairs_done += 1
@@ -958,12 +1023,14 @@ class EcCoordinator:  # weedlint: concurrent-class
                         "action": "repair_done", "host": res["host"],
                         "rebuilt": res["rebuilt"],
                         "spread": [list(m) for m in res["moves"]],
+                        "rescrubbed": rescrubbed,
                         **cause})
                 _events.emit("repair_done", server=self.server or None,
                              vid=vid, host=res["host"],
                              rebuilt=res["rebuilt"],
                              moves=len(res["moves"]),
                              move_errors=res.get("move_errors") or [],
+                             rescrubbed=rescrubbed,
                              **cause)
                 return True
         finally:

@@ -110,7 +110,8 @@ class AzureRemoteStorage(RemoteStorageClient):
         url = (f"{self.scheme}://{self.endpoint}"
                f"{urllib.parse.quote(url_path)}") + (
             f"?{q}" if q else "")
-        return http_bytes(method, url, body or None, headers=headers)
+        return http_bytes(method, url, body or None, headers=headers,
+            timeout=60.0)
 
     # -- helpers ------------------------------------------------------------
     @staticmethod

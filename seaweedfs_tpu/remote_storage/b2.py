@@ -50,7 +50,7 @@ class B2RemoteStorage(RemoteStorageClient):
         basic = b64encode(f"{self.key_id}:{self.app_key}".encode()).decode()
         status, body, _ = http_bytes(
             "GET", f"{self.auth_base}/b2api/v2/b2_authorize_account",
-            headers={"Authorization": f"Basic {basic}"})
+            headers={"Authorization": f"Basic {basic}"}, timeout=60.0)
         if status != 200:
             raise PermissionError(f"b2 authorize failed: {status} "
                                   f"{body[:200].decode(errors='replace')}")
@@ -79,7 +79,8 @@ class B2RemoteStorage(RemoteStorageClient):
             status, body, _ = http_bytes(
                 "POST", f"{auth['apiUrl']}/b2api/v2/{op}",
                 json.dumps(payload).encode(),
-                headers={"Authorization": auth["authorizationToken"]})
+                headers={"Authorization": auth["authorizationToken"]},
+                    timeout=60.0)
             if status == 401 and attempt == 0:
                 continue
             if status != 200:
@@ -135,7 +136,7 @@ class B2RemoteStorage(RemoteStorageClient):
             headers["Range"] = f"bytes={offset}-{end}"
         status, body, _ = http_bytes(
             "GET", f"{auth['downloadUrl']}/file/{loc.bucket}/{name}",
-            headers=headers)
+            headers=headers, timeout=60.0)
         if status not in (200, 206):
             raise FileNotFoundError(f"b2 read {key}: {status}")
         return body
@@ -152,7 +153,7 @@ class B2RemoteStorage(RemoteStorageClient):
                 "X-Bz-File-Name": urllib.parse.quote(key.lstrip("/")),
                 "Content-Type": "b2/x-auto",
                 "X-Bz-Content-Sha1": sha1,
-            })
+            }, timeout=60.0)
         if status != 200:
             raise OSError(f"b2 upload {key}: {status} "
                           f"{body[:200].decode(errors='replace')}")

@@ -203,7 +203,8 @@ class S3RemoteStorage(RemoteStorageClient):
             if token:
                 q += "&continuation-token=" + urllib.parse.quote(token)
             status, body, _ = http_bytes(
-                "GET", self._signed("GET", self._url(loc, query=q)))
+                "GET", self._signed("GET", self._url(loc, query=q)),
+                    timeout=60.0)
             if status != 200:
                 raise HttpError(status, body.decode(errors="replace"))
             ns = {"s3": body.split(b"xmlns=", 1)[1].split(b'"')[1].decode()} \
@@ -247,7 +248,7 @@ class S3RemoteStorage(RemoteStorageClient):
             headers["Range"] = f"bytes={offset}-{end}"
         status, body, _ = http_bytes(
             "GET", self._signed("GET", self._url(loc, key)),
-            headers=headers or None)
+            headers=headers or None, timeout=60.0)
         if status not in (200, 206):
             raise HttpError(status, body.decode(errors="replace"))
         return body
@@ -257,19 +258,22 @@ class S3RemoteStorage(RemoteStorageClient):
         import time
 
         status, body, _ = http_bytes(
-            "PUT", self._signed("PUT", self._url(loc, key)), data)
+            "PUT", self._signed("PUT", self._url(loc, key)), data,
+                timeout=60.0)
         if status not in (200, 201):
             raise HttpError(status, body.decode(errors="replace"))
         return RemoteObject(key, len(data), time.time())
 
     def delete_file(self, loc: RemoteLocation, key: str) -> None:
-        http_bytes("DELETE", self._signed("DELETE", self._url(loc, key)))
+        http_bytes("DELETE", self._signed("DELETE", self._url(loc, key)),
+            timeout=60.0)
 
     def list_buckets(self) -> list[str]:
         import xml.etree.ElementTree as ET
 
         status, body, _ = http_bytes(
-            "GET", self._signed("GET", f"http://{self.endpoint}/"))
+            "GET", self._signed("GET", f"http://{self.endpoint}/"),
+                timeout=60.0)
         if status != 200:
             raise HttpError(status, body.decode(errors="replace"))
         root = ET.fromstring(body)
@@ -279,13 +283,15 @@ class S3RemoteStorage(RemoteStorageClient):
 
     def create_bucket(self, bucket: str) -> None:
         url = f"http://{self.endpoint}/{bucket}"
-        status, body, _ = http_bytes("PUT", self._signed("PUT", url))
+        status, body, _ = http_bytes("PUT", self._signed("PUT", url),
+            timeout=60.0)
         if status not in (200, 409):  # 409 = already exists
             raise HttpError(status, body.decode(errors="replace"))
 
     def delete_bucket(self, bucket: str) -> None:
         url = f"http://{self.endpoint}/{bucket}"
-        status, body, _ = http_bytes("DELETE", self._signed("DELETE", url))
+        status, body, _ = http_bytes("DELETE", self._signed("DELETE", url),
+            timeout=60.0)
         if status not in (204, 404):
             raise HttpError(status, body.decode(errors="replace"))
 

@@ -97,7 +97,8 @@ class RemoteGateway:
     def poll_once(self) -> int:
         r = http_json(
             "GET", f"http://{self.filer_url}/api/meta/log"
-                   f"?since_ns={self.since_ns}&path_prefix={BUCKETS_DIR}")
+                   f"?since_ns={self.since_ns}&path_prefix={BUCKETS_DIR}",
+                       timeout=30.0)
         n = 0
         for event in r.get("events", []):
             entry = event.get("new_entry") or event.get("old_entry") or {}

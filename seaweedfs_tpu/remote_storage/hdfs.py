@@ -61,7 +61,7 @@ class HdfsRemoteStorage(RemoteStorageClient):
 
         def walk(rel: str) -> Iterator[RemoteObject]:
             status, body, _ = http_bytes(
-                "GET", self._url(rel, "LISTSTATUS"))
+                "GET", self._url(rel, "LISTSTATUS"), timeout=60.0)
             if status == 404:
                 return
             doc = self._check(status, body)
@@ -92,7 +92,7 @@ class HdfsRemoteStorage(RemoteStorageClient):
             params["length"] = size
         status, body, _ = http_bytes(
             "GET", self._url(f"{loc.bucket}/{key.lstrip('/')}",
-                             "OPEN", **params))
+                             "OPEN", **params), timeout=60.0)
         if status not in (200,):
             raise HttpError(status, body.decode(errors="replace"))
         return body
@@ -104,25 +104,27 @@ class HdfsRemoteStorage(RemoteStorageClient):
         # two-step CREATE: the namenode 307-redirects to a datanode URL
         url = self._url(f"{loc.bucket}/{key.lstrip('/')}", "CREATE",
                         overwrite="true")
-        status, body, hdrs = http_bytes("PUT", url, follow_redirects=False)
+        status, body, hdrs = http_bytes("PUT", url, follow_redirects=False,
+            timeout=60.0)
         if status == 307:
             url = hdrs.get("Location", url)
-            status, body, _ = http_bytes("PUT", url, data)
+            status, body, _ = http_bytes("PUT", url, data, timeout=60.0)
         elif status in (200, 201):
             # single-step servers (gateways) accept the body directly
-            status, body, _ = http_bytes("PUT", url, data)
+            status, body, _ = http_bytes("PUT", url, data, timeout=60.0)
         self._check(status, body, ok=(200, 201))
         return RemoteObject(key, len(data), time.time())
 
     def delete_file(self, loc: RemoteLocation, key: str) -> None:
         status, body, _ = http_bytes(
             "DELETE", self._url(f"{loc.bucket}/{key.lstrip('/')}",
-                                "DELETE"))
+                                "DELETE"), timeout=60.0)
         if status not in (200, 404):
             raise HttpError(status, body.decode(errors="replace"))
 
     def list_buckets(self) -> list[str]:
-        status, body, _ = http_bytes("GET", self._url("", "LISTSTATUS"))
+        status, body, _ = http_bytes("GET", self._url("", "LISTSTATUS"),
+            timeout=60.0)
         doc = self._check(status, body)
         return sorted(
             st.get("pathSuffix", "")
@@ -131,11 +133,12 @@ class HdfsRemoteStorage(RemoteStorageClient):
 
     def create_bucket(self, bucket: str) -> None:
         status, body, _ = http_bytes(
-            "PUT", self._url(bucket, "MKDIRS"))
+            "PUT", self._url(bucket, "MKDIRS"), timeout=60.0)
         self._check(status, body)
 
     def delete_bucket(self, bucket: str) -> None:
         status, body, _ = http_bytes(
-            "DELETE", self._url(bucket, "DELETE", recursive="true"))
+            "DELETE", self._url(bucket, "DELETE", recursive="true"),
+                timeout=60.0)
         if status not in (200, 404):
             raise HttpError(status, body.decode(errors="replace"))

@@ -80,7 +80,7 @@ def sync_metadata(filer_url: str, mount_dir: str, loc: RemoteLocation,
         stamp = obj.to_extended()["remote.entry"]
         status, body, _ = http_bytes(
             "GET", f"http://{filer_url}/api/stat"
-            + urllib.parse.quote(fpath))
+            + urllib.parse.quote(fpath), timeout=60.0)
         if status == 200:
             existing = json.loads(body)
             marker = existing.get("extended", {}).get("remote.entry")
@@ -100,7 +100,7 @@ def sync_metadata(filer_url: str, mount_dir: str, loc: RemoteLocation,
         status, body, _ = http_bytes(
             "POST", f"http://{filer_url}/api/entry",
             json.dumps(entry).encode(),
-            headers={"Content-Type": "application/json"})
+            headers={"Content-Type": "application/json"}, timeout=60.0)
         if status not in (200, 201):
             raise HttpError(status, body.decode(errors="replace"))
         count += 1

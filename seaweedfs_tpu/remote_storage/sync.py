@@ -123,7 +123,8 @@ class RemoteSyncer:
 
     def _fetch(self, path: str) -> Optional[bytes]:
         status, body, _ = http_bytes(
-            "GET", f"http://{self.filer_url}" + urllib.parse.quote(path))
+            "GET", f"http://{self.filer_url}" + urllib.parse.quote(path),
+                timeout=60.0)
         if status == 404:
             return None
         if status != 200:
@@ -138,7 +139,7 @@ class RemoteSyncer:
         path = entry_dict["full_path"]
         status, body, _ = http_bytes(
             "GET", f"http://{self.filer_url}/api/stat"
-            + urllib.parse.quote(path))
+            + urllib.parse.quote(path), timeout=60.0)
         if status != 200:
             return  # entry vanished; nothing to stamp
         current = json.loads(body)
@@ -154,14 +155,14 @@ class RemoteSyncer:
         http_bytes("POST",
                    f"http://{self.filer_url}/api/entry?update_only=true",
                    json.dumps(current).encode(),
-                   headers={"Content-Type": "application/json"})
+                   headers={"Content-Type": "application/json"}, timeout=60.0)
 
     # --- loop -------------------------------------------------------------
     def poll_once(self) -> int:
         r = http_json(
             "GET", f"http://{self.filer_url}/api/meta/log?"
             f"since_ns={self.since_ns}&path_prefix="
-            + urllib.parse.quote(self.mount_dir))
+            + urllib.parse.quote(self.mount_dir), timeout=30.0)
         n = 0
         for ev in r["events"]:
             if self.apply(ev):

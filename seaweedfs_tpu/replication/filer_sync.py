@@ -58,7 +58,8 @@ class MetaTailer:
         if self.path_prefix:
             q += "&path_prefix=" + urllib.parse.quote(self.path_prefix)
         r = http_json("GET",
-                      f"http://{self.source_url}/api/meta/log?{q}")
+                      f"http://{self.source_url}/api/meta/log?{q}",
+                          timeout=30.0)
         n = 0
         for event in r["events"]:
             try:
@@ -104,7 +105,8 @@ class MetaTailer:
 
 
 def _filer_signature(url: str) -> int:
-    return int(http_json("GET", f"http://{url}/api/info")["signature"])
+    return int(http_json("GET", f"http://{url}/api/info",
+        timeout=30.0)["signature"])
 
 
 def make_sync_tailer(source_url: str, target_url: str,
@@ -182,7 +184,7 @@ class MetaBackup:
         start_ns = time.time_ns()
         r = http_json(
             "GET", f"http://{self.source_url}/api/meta/tree?path="
-            + urllib.parse.quote(self.path_prefix))
+            + urllib.parse.quote(self.path_prefix), timeout=30.0)
         self.entries = {e["full_path"]: e for e in r["entries"]}
         self.since_ns = start_ns
         self._save()
@@ -196,7 +198,7 @@ class MetaBackup:
             q += ("&path_prefix="
                   + urllib.parse.quote(self.path_prefix.rstrip("/")))
         r = http_json(
-            "GET", f"http://{self.source_url}/api/meta/log?{q}")
+            "GET", f"http://{self.source_url}/api/meta/log?{q}", timeout=30.0)
         n = 0
         for ev in r["events"]:
             old, new = ev.get("old_entry"), ev.get("new_entry")

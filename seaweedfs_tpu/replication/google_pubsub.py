@@ -90,7 +90,8 @@ class GooglePubSubQueue:
             "assertion": assertion}).encode()
         status, resp, _ = http_bytes(
             "POST", self.creds.get("token_uri", TOKEN_URL), body,
-            headers={"Content-Type": "application/x-www-form-urlencoded"})
+            headers={"Content-Type": "application/x-www-form-urlencoded"},
+                timeout=60.0)
         if status != 200:
             raise HttpError(status, resp.decode(errors="replace"))
         tok = json.loads(resp)
@@ -114,6 +115,7 @@ class GooglePubSubQueue:
                    f"/topics/{self.topic}:publish")
             headers = {"Content-Type": "application/json",
                        "Authorization": f"Bearer {self._bearer()}"}
-        status, resp, _ = http_bytes("POST", url, body, headers=headers)
+        status, resp, _ = http_bytes("POST", url, body, headers=headers,
+            timeout=60.0)
         if status != 200:
             raise HttpError(status, resp.decode(errors="replace"))

@@ -143,7 +143,8 @@ class SqsQueue(NotificationQueue):
             headers = sign_v4(
                 "POST", url, self.access_key, self.secret_key, body=body,
                 region=self.region, service="sqs", extra_headers=headers)
-        status, resp, _ = http_bytes("POST", url, body, headers=headers)
+        status, resp, _ = http_bytes("POST", url, body, headers=headers,
+            timeout=60.0)
         if status != 200:
             raise HttpError(status, resp.decode(errors="replace"))
 

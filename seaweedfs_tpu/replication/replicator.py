@@ -48,7 +48,7 @@ class Replicator:
             return self._fetch(path)
         status, body, _ = http_bytes(
             "GET", f"http://{self.source_filer_url}"
-            + urllib.parse.quote(path))
+            + urllib.parse.quote(path), timeout=60.0)
         if status != 200:
             raise HttpError(status, body.decode(errors="replace"))
         return body

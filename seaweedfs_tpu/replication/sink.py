@@ -97,20 +97,23 @@ class FilerSink(ReplicationSink):
                      data: Optional[bytes]) -> None:
         if _is_dir(entry):
             status, body, _ = http_bytes(
-                "PUT", self._url(key) + "/", b"", headers=self._headers())
+                "PUT", self._url(key) + "/", b"", headers=self._headers(),
+                    timeout=60.0)
         else:
             headers = self._headers() or {}
             mime = entry.get("attr", {}).get("mime", "")
             if mime:
                 headers["Content-Type"] = mime
             status, body, _ = http_bytes(
-                "PUT", self._url(key), data or b"", headers=headers or None)
+                "PUT", self._url(key), data or b"", headers=headers or None,
+                    timeout=60.0)
         if status not in (200, 201):
             raise HttpError(status, body.decode(errors="replace"))
 
     def delete_entry(self, key: str, is_directory: bool) -> None:
         url = self._url(key) + "?recursive=true"
-        status, body, _ = http_bytes("DELETE", url, headers=self._headers())
+        status, body, _ = http_bytes("DELETE", url, headers=self._headers(),
+            timeout=60.0)
         if status not in (200, 204, 404):
             raise HttpError(status, body.decode(errors="replace"))
 
@@ -143,7 +146,7 @@ class S3Sink(ReplicationSink):
         if _is_dir(entry):
             return  # S3 has no directories
         url = self._signed("PUT", self._url(key))
-        status, body, _ = http_bytes("PUT", url, data or b"")
+        status, body, _ = http_bytes("PUT", url, data or b"", timeout=60.0)
         if status not in (200, 201):
             raise HttpError(status, body.decode(errors="replace"))
 
@@ -151,7 +154,7 @@ class S3Sink(ReplicationSink):
         if is_directory:
             return
         url = self._signed("DELETE", self._url(key))
-        http_bytes("DELETE", url)
+        http_bytes("DELETE", url, timeout=60.0)
 
 
 class RemoteStorageSink(ReplicationSink):

@@ -1,0 +1,32 @@
+"""Production-shaped scenario harness (ROADMAP open item 4).
+
+bench.py's cluster sections are uniform-random RPS loops — nothing like
+millions of users.  This package drives a spawned cluster with the
+shapes production actually has — Zipfian object popularity over a hot
+set, a mixed size distribution, delete churn, and MID-LOAD fault
+injection from the W701-checked FAULT_POINTS registry — with the
+graceful-degradation plane (deadlines, retry budgets, admission
+control) and the alert engine live, and emits per-route RED
+measurements, per-phase p99s, shed/retry/deadline counters, the alert
+timeline, a sampled stitched trace, and a degraded VERDICT against the
+spec's expectations.
+
+    from seaweedfs_tpu.scenarios import default_scenarios, run_scenario
+    for spec in default_scenarios():
+        result = run_scenario(spec)
+
+The bench `scenarios` section runs the three canonical specs (Zipfian
+hot-set read storm, mixed-size write+churn, failure-under-load) and
+stamps each verdict into the bench JSON.
+"""
+
+from .engine import run_scenario
+from .spec import (FaultSpec, ScenarioSpec, default_scenarios,
+                   failure_under_load, read_storm, write_churn)
+from .workload import SizeSampler, ZipfSampler
+
+__all__ = [
+    "FaultSpec", "ScenarioSpec", "default_scenarios", "run_scenario",
+    "read_storm", "write_churn", "failure_under_load",
+    "ZipfSampler", "SizeSampler",
+]

@@ -105,7 +105,7 @@ def cmd_events_tail(env: CommandEnv, flags: dict) -> str:
     qs = "&".join(params)
     if flags.get("server"):
         doc = http_json(
-            "GET", f"http://{flags['server']}/debug/events?{qs}")
+            "GET", f"http://{flags['server']}/debug/events?{qs}", timeout=30.0)
     else:
         doc = env.master_get(f"/cluster/events?{qs}")
     events = doc.get("events", [])

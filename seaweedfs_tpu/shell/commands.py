@@ -44,10 +44,12 @@ class CommandEnv:
 
     # --- master helpers ---------------------------------------------------
     def master_get(self, path: str) -> dict:
-        return http_json("GET", f"http://{self.master_url}{path}")
+        return http_json("GET", f"http://{self.master_url}{path}",
+            timeout=30.0)
 
     def master_post(self, path: str, payload: dict) -> dict:
-        return http_json("POST", f"http://{self.master_url}{path}", payload)
+        return http_json("POST", f"http://{self.master_url}{path}", payload,
+            timeout=30.0)
 
     def volume_post(self, server: str, path: str, payload: dict,
                     timeout: float = 600.0) -> dict:

@@ -41,7 +41,8 @@ def _listing(env: CommandEnv, path: str) -> list[dict]:
     last = ""
     while True:
         q = f"?lastFileName={urllib.parse.quote(last)}" if last else ""
-        status, body, _ = http_bytes("GET", f"http://{_filer(env)}{path}{q}")
+        status, body, _ = http_bytes("GET", f"http://{_filer(env)}{path}{q}",
+            timeout=60.0)
         if status != 200:
             raise HttpError(status, body.decode(errors="replace"))
         data = json.loads(body)
@@ -70,7 +71,8 @@ def cmd_fs_ls(env: CommandEnv, flags: dict) -> str:
 def cmd_fs_cat(env: CommandEnv, flags: dict) -> str:
     """fs.cat /path/to/file  # print file content"""
     path = _resolve(env, flags.get("", ""))
-    status, body, _ = http_bytes("GET", f"http://{_filer(env)}{path}")
+    status, body, _ = http_bytes("GET", f"http://{_filer(env)}{path}",
+        timeout=60.0)
     if status != 200:
         raise HttpError(status, body.decode(errors="replace"))
     return body.decode(errors="replace")
@@ -117,7 +119,8 @@ def cmd_fs_tree(env: CommandEnv, flags: dict) -> str:
 def cmd_fs_mkdir(env: CommandEnv, flags: dict) -> str:
     """fs.mkdir /dir"""
     path = _resolve(env, flags.get("", ""))
-    http_json("POST", f"http://{_filer(env)}/api/mkdir", {"path": path})
+    http_json("POST", f"http://{_filer(env)}/api/mkdir", {"path": path},
+        timeout=30.0)
     return path
 
 
@@ -127,7 +130,8 @@ def cmd_fs_rm(env: CommandEnv, flags: dict) -> str:
     path = _resolve(env, flags.get("", ""))
     recursive = "true" if "r" in flags or "rf" in flags else "false"
     status, body, _ = http_bytes(
-        "DELETE", f"http://{_filer(env)}{path}?recursive={recursive}")
+        "DELETE", f"http://{_filer(env)}{path}?recursive={recursive}",
+            timeout=60.0)
     if status not in (204, 200):
         raise HttpError(status, body.decode(errors="replace"))
     return f"removed {path}"
@@ -141,7 +145,7 @@ def cmd_fs_mv(env: CommandEnv, flags: dict) -> str:
         raise RuntimeError("usage: fs.mv /src -to /dst")
     dst = _resolve(env, flags["to"])
     http_json("POST", f"http://{_filer(env)}/api/rename",
-              {"from": src, "to": dst})
+              {"from": src, "to": dst}, timeout=30.0)
     return f"moved {src} -> {dst}"
 
 
@@ -171,7 +175,7 @@ def cmd_fs_configure(env: CommandEnv, flags: dict) -> str:
     from ..filer.filer_conf import FILER_CONF_PATH, FilerConf, PathConf
 
     url = f"http://{_filer(env)}{FILER_CONF_PATH}"
-    status, body, _ = http_bytes("GET", url)
+    status, body, _ = http_bytes("GET", url, timeout=60.0)
     conf = FilerConf.from_bytes(body if status == 200 else b"")
     prefix = flags.get("locationPrefix", "")
     if prefix:
@@ -191,7 +195,8 @@ def cmd_fs_configure(env: CommandEnv, flags: dict) -> str:
                 data_center=flags.get("dataCenter", ""),
                 rack=flags.get("rack", "")))
         if "apply" in flags:
-            status, body, _ = http_bytes("PUT", url, conf.to_bytes())
+            status, body, _ = http_bytes("PUT", url, conf.to_bytes(),
+                timeout=60.0)
             if status not in (200, 201):
                 raise HttpError(status, body.decode(errors="replace"))
     return conf.to_bytes().decode()
@@ -204,7 +209,8 @@ def cmd_fs_meta_cat(env: CommandEnv, flags: dict) -> str:
     """fs.meta.cat /path  # print an entry's full metadata"""
     path = _resolve(env, flags.get("", ""))
     return json.dumps(
-        http_json("GET", f"http://{_filer(env)}/api/stat{path}"), indent=2)
+        http_json("GET", f"http://{_filer(env)}/api/stat{path}",
+            timeout=30.0), indent=2)
 
 
 @command("fs.meta.save")
@@ -215,7 +221,7 @@ def cmd_fs_meta_save(env: CommandEnv, flags: dict) -> str:
     out_file = flags.get("o", "filer_meta.jsonl")
     tree = http_json(
         "GET", f"http://{_filer(env)}/api/meta/tree?path="
-        + urllib.parse.quote(path))
+        + urllib.parse.quote(path), timeout=30.0)
     with open(out_file, "w") as f:
         for d in tree["entries"]:
             f.write(json.dumps(d) + "\n")
@@ -232,7 +238,7 @@ def cmd_fs_meta_load(env: CommandEnv, flags: dict) -> str:
             if not line.strip():
                 continue
             http_json("POST", f"http://{_filer(env)}/api/entry",
-                      json.loads(line))
+                      json.loads(line), timeout=30.0)
             n += 1
     return f"loaded {n} entries"
 
@@ -243,5 +249,5 @@ def cmd_fs_meta_notify(env: CommandEnv, flags: dict) -> str:
     events into the meta log / notification queue"""
     path = _resolve(env, flags.get("", ""))
     r = http_json("POST", f"http://{_filer(env)}/api/meta/notify",
-                  {"path": path})
+                  {"path": path}, timeout=30.0)
     return f"notified {r['count']} entries"

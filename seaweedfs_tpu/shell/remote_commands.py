@@ -72,7 +72,7 @@ def cmd_remote_mount(env: CommandEnv, flags: dict) -> str:
                            " run remote.configure first")
     client = make_client(conf)
     http_json("POST", f"http://{_filer(env)}/api/mkdir",
-              {"path": dir_path})
+              {"path": dir_path}, timeout=30.0)
     mounts = RemoteMounts.read(_filer(env))
     mounts.mounts[dir_path] = loc
     mounts.write(_filer(env))
@@ -144,7 +144,7 @@ def cmd_remote_cache(env: CommandEnv, flags: dict) -> str:
             continue
         # a plain GET triggers CacheRemoteObjectToLocalCluster
         status, body, _ = http_bytes(
-            "GET", f"http://{_filer(env)}{e['FullPath']}")
+            "GET", f"http://{_filer(env)}{e['FullPath']}", timeout=60.0)
         if status == 200:
             cached += 1
     return f"cached {cached} objects under {dir_path}"
@@ -167,7 +167,7 @@ def cmd_remote_uncache(env: CommandEnv, flags: dict) -> str:
         if not e.get("Remote") or not e.get("chunks"):
             continue
         r = http_json("POST", f"http://{_filer(env)}/api/remote/uncache",
-                      {"path": e["FullPath"]})
+                      {"path": e["FullPath"]}, timeout=30.0)
         n += 1 if r.get("uncached") else 0
     return f"uncached {n} objects under {dir_path}"
 
@@ -184,7 +184,8 @@ def cmd_remote_unmount(env: CommandEnv, flags: dict) -> str:
     del mounts.mounts[dir_path]
     mounts.write(_filer(env))
     status, body, _ = http_bytes(
-        "DELETE", f"http://{_filer(env)}{dir_path}?recursive=true")
+        "DELETE", f"http://{_filer(env)}{dir_path}?recursive=true",
+            timeout=60.0)
     if status not in (200, 204, 404):
         raise HttpError(status, body.decode(errors="replace"))
     return f"unmounted {dir_path}"

@@ -61,7 +61,7 @@ def cmd_s3_bucket_create(env: CommandEnv, flags: dict) -> str:
         raise ValueError("usage: s3.bucket.create -name <bucket>")
     env.confirm_is_locked()
     http_json("POST", f"http://{_filer(env)}/api/mkdir",
-              {"path": f"{BUCKETS_PATH}/{name}"})
+              {"path": f"{BUCKETS_PATH}/{name}"}, timeout=30.0)
     return f"created bucket {name}"
 
 
@@ -73,7 +73,8 @@ def cmd_s3_bucket_delete(env: CommandEnv, flags: dict) -> str:
         raise ValueError("usage: s3.bucket.delete -name <bucket>")
     env.confirm_is_locked()
     status, body, _ = http_bytes(
-        "DELETE", f"http://{_filer(env)}{BUCKETS_PATH}/{name}?recursive=true")
+        "DELETE", f"http://{_filer(env)}{BUCKETS_PATH}/{name}?recursive=true",
+            timeout=60.0)
     if status not in (204, 200):
         raise HttpError(status, body.decode(errors="replace"))
     return f"deleted bucket {name}"
@@ -91,7 +92,8 @@ def cmd_s3_clean_uploads(env: CommandEnv, flags: dict) -> str:
     doomed = [u for u in uploads if u.get("Mtime", 0) < cutoff]
     for u in doomed:
         path = u["FullPath"]
-        http_bytes("DELETE", f"http://{_filer(env)}{path}?recursive=true")
+        http_bytes("DELETE", f"http://{_filer(env)}{path}?recursive=true",
+            timeout=60.0)
     return f"removed {len(doomed)} stale multipart uploads"
 
 
@@ -181,7 +183,7 @@ def cmd_s3_bucket_quota(env: CommandEnv, flags: dict) -> str:
             from ..filer.filer_conf import FILER_CONF_PATH, FilerConf
 
             status, body, _ = http_bytes(
-                "GET", f"http://{_filer(env)}{FILER_CONF_PATH}")
+                "GET", f"http://{_filer(env)}{FILER_CONF_PATH}", timeout=60.0)
             conf = FilerConf.from_bytes(body if status == 200 else b"")
             prefix = f"{BUCKETS_PATH}/{name}"
             rule = conf.rules.get(prefix)
@@ -216,7 +218,7 @@ def cmd_s3_bucket_quota_check(env: CommandEnv, flags: dict) -> str:
     if not quotas:
         return "no bucket quotas configured"
     status, body, _ = http_bytes(
-        "GET", f"http://{_filer(env)}{FILER_CONF_PATH}")
+        "GET", f"http://{_filer(env)}{FILER_CONF_PATH}", timeout=60.0)
     conf = FilerConf.from_bytes(body if status == 200 else b"")
     lines, changed = [], False
     marked_by_us = set(qc.get("marked", []))
@@ -262,7 +264,7 @@ def cmd_s3_bucket_quota_check(env: CommandEnv, flags: dict) -> str:
         _write_quota_conf(env, qc)
         status, body, _ = http_bytes(
             "PUT", f"http://{_filer(env)}{FILER_CONF_PATH}",
-            conf.to_bytes())
+            conf.to_bytes(), timeout=60.0)
         if status not in (200, 201):
             raise HttpError(status, body.decode(errors="replace"))
     return "\n".join(lines)

@@ -35,7 +35,7 @@ def cmd_trace_analyze(env: CommandEnv, flags: dict) -> str:
         report = analyze(doc)
     elif server:
         status, body, _ = http_bytes(
-            "GET", f"http://{server}/debug/traces/analyze")
+            "GET", f"http://{server}/debug/traces/analyze", timeout=60.0)
         if status != 200:
             raise RuntimeError(
                 f"{server}/debug/traces/analyze: status {status}: "

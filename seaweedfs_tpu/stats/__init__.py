@@ -3,12 +3,13 @@ from .aggregate import (ClusterAggregator, merge_families,
 from .metrics import (Counter, Gauge, Histogram, Registry, REGISTRY,
                       master_metrics, volume_server_metrics, filer_metrics,
                       s3_metrics, ec_pipeline_metrics, ec_integrity_metrics,
-                      coordinator_metrics, start_push_loop)
+                      coordinator_metrics, request_plane_metrics,
+                      start_push_loop)
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "Registry", "REGISTRY",
     "master_metrics", "volume_server_metrics", "filer_metrics", "s3_metrics",
     "ec_pipeline_metrics", "ec_integrity_metrics", "coordinator_metrics",
-    "start_push_loop",
+    "request_plane_metrics", "start_push_loop",
     "ClusterAggregator", "merge_families", "parse_prometheus_text",
 ]

@@ -59,6 +59,13 @@ HEALTH_FAMILIES = {
     "ec_under_replicated": "SeaweedFS_ec_under_replicated",
     "coordinator_repair_failures":
         "SeaweedFS_coordinator_repair_failures_total",
+    # request-plane graceful-degradation counters (utils/deadline.py,
+    # utils/admission.py, utils/backoff.py): a cluster that is shedding
+    # load, exhausting propagated deadlines, or denying retries is
+    # degraded even while every process is up
+    "requests_shed": "SeaweedFS_requests_shed_total",
+    "deadline_exceeded": "SeaweedFS_deadline_exceeded_total",
+    "retry_budget_exhausted": "SeaweedFS_retry_budget_exhausted_total",
 }
 
 # keys whose truth lives on the MASTER: the per-peer rollup reports 0

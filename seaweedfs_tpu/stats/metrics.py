@@ -546,6 +546,43 @@ class CoordinatorMetrics:
         }
 
 
+class RequestPlaneMetrics:
+    """Deadline / retry-budget / load-shedding counters — the graceful-
+    degradation plane (utils/deadline.py, utils/admission.py,
+    utils/backoff.py).  shed counts requests the admission controller
+    answered 503 without running the handler; deadline_exceeded counts
+    requests answered 504 because their X-Weed-Deadline budget was
+    spent; retry_budget_exhausted counts retries a drained
+    per-destination token bucket denied.  All three fold into the
+    master's /cluster/health (stats/aggregate.py HEALTH_FAMILIES) so a
+    cluster that is shedding or timing out pages instead of quietly
+    failing its callers."""
+
+    def __init__(self, registry: Registry = REGISTRY):
+        self.shed = registry.counter(
+            "SeaweedFS_requests_shed_total",
+            "Requests shed by admission control (answered 503 early).",
+            labels=("role",))
+        self.deadline_exceeded = registry.counter(
+            "SeaweedFS_deadline_exceeded_total",
+            "Requests answered 504 because the propagated "
+            "X-Weed-Deadline budget was exhausted.",
+            labels=("role",))
+        self.retry_budget_exhausted = registry.counter(
+            "SeaweedFS_retry_budget_exhausted_total",
+            "Retries denied by a drained per-destination retry budget.",
+            labels=("kind",))
+
+    def totals(self) -> dict[str, int]:
+        return {
+            "requests_shed": int(sum(self.shed.snapshot().values())),
+            "deadline_exceeded":
+                int(sum(self.deadline_exceeded.snapshot().values())),
+            "retry_budget_exhausted":
+                int(sum(self.retry_budget_exhausted.snapshot().values())),
+        }
+
+
 _singletons: dict[str, object] = {}
 _singleton_lock = threading.Lock()
 
@@ -585,6 +622,10 @@ def coordinator_metrics() -> CoordinatorMetrics:
     return _singleton("coordinator", CoordinatorMetrics)
 
 
+def request_plane_metrics() -> RequestPlaneMetrics:
+    return _singleton("request_plane", RequestPlaneMetrics)
+
+
 def start_push_loop(gateway_url: str, job: str,
                     interval_seconds: float = 15.0,
                     registry: Registry = REGISTRY,
@@ -599,7 +640,8 @@ def start_push_loop(gateway_url: str, job: str,
             try:
                 http_bytes("PUT", f"{gateway_url}/metrics/job/{job}",
                            registry.expose().encode(),
-                           headers={"Content-Type": "text/plain"})
+                           headers={"Content-Type": "text/plain"},
+                               timeout=60.0)
             except Exception:
                 pass
 

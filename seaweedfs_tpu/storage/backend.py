@@ -314,7 +314,8 @@ class S3BackendStorage:
     def download_file(self, key: str, local_path: str) -> int:
         from ..utils.httpd import http_download
 
-        status = http_download("GET", self._signed("GET", key), local_path)
+        status = http_download("GET", self._signed("GET", key), local_path,
+            timeout=3600.0)
         if status != 200:
             raise OSError(f"s3 download {key}: HTTP {status}")
         return os.path.getsize(local_path)
@@ -324,7 +325,8 @@ class S3BackendStorage:
 
         status, body, _ = http_bytes(
             "GET", self._signed("GET", key),
-            headers={"Range": f"bytes={offset}-{offset + length - 1}"})
+            headers={"Range": f"bytes={offset}-{offset + length - 1}"},
+                timeout=60.0)
         if status not in (200, 206):
             raise OSError(f"s3 range read {key}: HTTP {status}")
         return body if status == 206 else body[offset:offset + length]
@@ -332,14 +334,16 @@ class S3BackendStorage:
     def delete_file(self, key: str) -> None:
         from ..utils.httpd import http_bytes
 
-        status, body, _ = http_bytes("DELETE", self._signed("DELETE", key))
+        status, body, _ = http_bytes("DELETE", self._signed("DELETE", key),
+            timeout=60.0)
         if status not in (200, 204, 404):
             raise OSError(f"s3 delete {key}: HTTP {status}")
 
     def object_size(self, key: str) -> int:
         from ..utils.httpd import http_bytes
 
-        status, _, headers = http_bytes("HEAD", self._signed("HEAD", key))
+        status, _, headers = http_bytes("HEAD", self._signed("HEAD", key),
+            timeout=60.0)
         if status != 200:
             raise OSError(f"s3 head {key}: HTTP {status}")
         return int(headers.get("Content-Length", 0))

@@ -51,7 +51,7 @@ def diff_servers(urls: list[str], vid: int, out=sys.stdout) -> int:
     for url in urls:
         status, blob, _ = http_bytes(
             "GET", f"http://{url}/admin/volume_download?volume_id={vid}"
-                   f"&ext=.idx")
+                   f"&ext=.idx", timeout=60.0)
         if status != 200:
             raise SystemExit(f"{url}: volume_download HTTP {status}")
         maps[url] = _live_map(blob)
@@ -86,7 +86,7 @@ def main(argv=None) -> int:
         urls = [u for u in args.servers.split(",") if u]
     else:
         d = http_json("GET", f"http://{args.master}/dir/lookup"
-                             f"?volumeId={args.volumeId}")
+                             f"?volumeId={args.volumeId}", timeout=30.0)
         urls = [loc["url"] for loc in d.get("locations", [])]
     if len(urls) < 2:
         raise SystemExit(f"need >=2 replicas to diff, found {urls}")

@@ -33,7 +33,7 @@ def repeated_vacuum(master: str, rounds: int = 10, per_round: int = 20,
             client.delete(fid)
         resp = http_json(
             "GET", f"http://{master}/vol/vacuum"
-                   f"?garbageThreshold={threshold}")
+                   f"?garbageThreshold={threshold}", timeout=30.0)
         if resp.get("compacted"):
             compacted_rounds += 1
         # the kept needles must still read back after every compaction

@@ -29,7 +29,8 @@ def bench(endpoint: str, access_key: str, secret_key: str,
     def req(method: str, path: str, body: bytes = b"") -> tuple[int, bytes]:
         url = base + path
         hdrs = sign_v4(method, url, access_key, secret_key, body)
-        st, got, _ = http_bytes(method, url, body or None, headers=hdrs)
+        st, got, _ = http_bytes(method, url, body or None, headers=hdrs,
+            timeout=60.0)
         return st, got
 
     st, _ = req("PUT", f"/{bucket}")
@@ -89,7 +90,7 @@ def presigned_put_demo(endpoint: str, access_key: str, secret_key: str,
     headers (presigned_put.go's flow); -> the URL used."""
     url = presign_v4("PUT", f"http://{endpoint}/{bucket}/{key}",
                      access_key, secret_key, expires=expires)
-    st, _, _ = http_bytes("PUT", url, data)
+    st, _, _ = http_bytes("PUT", url, data, timeout=60.0)
     if st != 200:
         raise OSError(f"presigned PUT: HTTP {st}")
     print(f"presigned PUT ok: {len(data)} bytes -> /{bucket}/{key}",

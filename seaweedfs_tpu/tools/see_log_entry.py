@@ -18,7 +18,8 @@ from ..utils.httpd import http_json
 
 def see_log(filer: str, since_ns: int = 0, out=sys.stdout) -> int:
     doc = http_json("GET",
-                    f"http://{filer}/api/meta/log?since_ns={since_ns}")
+                    f"http://{filer}/api/meta/log?since_ns={since_ns}",
+                        timeout=30.0)
     events = doc.get("events") or doc.get("Events") or []
     for e in events:
         ts = e.get("ts_ns", 0)

@@ -26,7 +26,7 @@ def stream_read(server: str, vid: int, verbose: bool = False,
         dat = os.path.join(td, f"{vid}.dat")
         status = http_download(
             "GET", f"http://{server}/admin/volume_download"
-                   f"?volume_id={vid}&ext=.dat", dat)
+                   f"?volume_id={vid}&ext=.dat", dat, timeout=3600.0)
         if status != 200:
             raise SystemExit(f"volume_download {server} vol {vid}: "
                              f"HTTP {status}")
@@ -60,7 +60,7 @@ def main(argv=None) -> int:
     server = args.server
     if not server:
         d = http_json("GET", f"http://{args.master}/dir/lookup"
-                             f"?volumeId={args.volumeId}")
+                             f"?volumeId={args.volumeId}", timeout=30.0)
         locs = d.get("locations") or []
         if not locs:
             raise SystemExit(f"volume {args.volumeId} not found")

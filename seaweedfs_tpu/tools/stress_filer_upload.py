@@ -36,7 +36,7 @@ def stress_filer(filer: str, seconds: float, concurrency: int = 4,
                 body = random.Random(seed).randbytes(size)
                 path = f"{prefix}/w{wid}/f{rng.getrandbits(48):012x}.bin"
                 st, _, _ = http_bytes(
-                    "PUT", f"http://{filer}{path}", body)
+                    "PUT", f"http://{filer}{path}", body, timeout=60.0)
                 if st not in (200, 201):
                     raise OSError(f"PUT {st}")
                 uploaded.append((path, size, seed))
@@ -46,7 +46,7 @@ def stress_filer(filer: str, seconds: float, concurrency: int = 4,
                 if uploaded and rng.random() < 0.3:
                     path, size, seed = rng.choice(uploaded)
                     st, got, _ = http_bytes(
-                        "GET", f"http://{filer}{path}")
+                        "GET", f"http://{filer}{path}", timeout=60.0)
                     want = random.Random(seed).randbytes(size)
                     if st != 200 or got != want:
                         raise OSError(f"GET {st} mismatch={got != want}")

@@ -17,7 +17,8 @@ from ..utils.httpd import http_bytes, http_json
 
 
 def _locate(master: str, vid: int) -> str:
-    d = http_json("GET", f"http://{master}/dir/lookup?volumeId={vid}")
+    d = http_json("GET", f"http://{master}/dir/lookup?volumeId={vid}",
+        timeout=30.0)
     locs = d.get("locations") or []
     if not locs:
         raise SystemExit(f"volume {vid} not found via {master}")
@@ -38,7 +39,7 @@ def tail_volume(master: str, vid: int, since_ns: int,
     while True:
         status, blob, hdrs = http_bytes(
             "GET", f"http://{url}/admin/tail?volume_id={vid}"
-                   f"&since_ns={since_ns}")
+                   f"&since_ns={since_ns}", timeout=60.0)
         if status != 200:
             raise SystemExit(f"tail {url}: HTTP {status}")
         version = int(hdrs.get("X-Volume-Version", 3))

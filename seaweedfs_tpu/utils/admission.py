@@ -1,0 +1,107 @@
+"""Admission control: shed excess load EARLY instead of timing out late.
+
+A thread-per-connection server under more load than it can serve does
+the worst possible thing by default: it accepts everything, every
+request queues behind every other, and EVERY caller times out late —
+goodput collapses to zero exactly when demand peaks.  The admission
+controller bounds the number of requests in flight per server; a
+request over the bound is answered 503 + Retry-After in microseconds
+(a "fast no"), the shed is counted (SeaweedFS_requests_shed_total) and
+journaled (`load_shed`, rate-limited), and the requests that WERE
+admitted keep meeting their latency targets.  Because sheds answer 5xx
+they also feed the per-route error-ratio burn-rate SLO — a sustained
+shed storm pages through the existing alert plane.
+
+Operator/diagnostic routes are exempt by prefix: an operator must be
+able to look at a melting server (/metrics, /debug, /cluster, scrub
+and admin surfaces), and shedding heartbeats would cascade a load
+problem into a false topology collapse.
+
+Wired at the Router.dispatch chokepoint (utils/httpd.py); servers
+enable it with max_inflight > 0 (`weed master/volume/filer
+-maxInflight N`).  Disabled (the default) it costs one attribute
+check per request.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+# prefixes never shed: operator visibility + control plane liveness.
+# Shedding /heartbeat would make an overloaded volume server look DEAD
+# to the master (peer_down, repairs kicking off) when it is merely
+# busy — load problems must not masquerade as topology problems.
+DEFAULT_EXEMPT_PREFIXES = (
+    "/metrics", "/debug", "/cluster", "/ec/scrub", "/admin",
+    "/heartbeat", "/dir/status", "/status", "/stats",
+)
+
+# one load_shed journal event per server per window; the counter still
+# counts every shed (the journal is a bounded ring — a shed storm must
+# not evict the events that explain it)
+_EVENT_MIN_INTERVAL_S = 1.0
+
+
+class AdmissionController:
+    """Bounded-inflight gate for one server's router."""
+
+    def __init__(self, max_inflight: int, role: str = "server",
+                 exempt_prefixes: tuple = DEFAULT_EXEMPT_PREFIXES,
+                 retry_after_s: float = 1.0):
+        self.max_inflight = max(1, int(max_inflight))
+        self.role = role
+        self.exempt_prefixes = tuple(exempt_prefixes)
+        self.retry_after_s = retry_after_s
+        self._lock = threading.Lock()
+        self._inflight = 0  # guarded-by: _lock
+        self.shed_total = 0  # guarded-by: _lock
+        self._last_event = 0.0  # guarded-by: _lock
+
+    def exempt(self, path: str) -> bool:
+        return path.startswith(self.exempt_prefixes)
+
+    def try_acquire(self) -> bool:
+        """Admit (True) or shed (False) one request.  On shed, the
+        counter is bumped and a rate-limited load_shed event journaled
+        — the caller answers 503 without running the handler."""
+        with self._lock:
+            if self._inflight < self.max_inflight:
+                self._inflight += 1
+                return True
+            self.shed_total += 1
+            inflight = self._inflight
+            now = time.monotonic()
+            emit = now - self._last_event >= _EVENT_MIN_INTERVAL_S
+            if emit:
+                self._last_event = now
+        from ..stats import request_plane_metrics
+
+        request_plane_metrics().shed.inc(self.role)
+        if emit:
+            from ..observability import events as _events
+
+            _events.emit("load_shed", role=self.role,
+                         inflight=inflight,
+                         max_inflight=self.max_inflight)
+        return False
+
+    def release(self) -> None:
+        with self._lock:
+            self._inflight = max(0, self._inflight - 1)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"max_inflight": self.max_inflight,
+                    "inflight": self._inflight,
+                    "shed_total": self.shed_total}
+
+
+def maybe_controller(max_inflight: int,
+                     role: str) -> Optional[AdmissionController]:
+    """The constructor servers call: 0/negative = admission disabled
+    (None), matching the -maxInflight CLI default."""
+    if max_inflight and int(max_inflight) > 0:
+        return AdmissionController(int(max_inflight), role=role)
+    return None

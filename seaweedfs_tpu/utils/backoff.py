@@ -1,14 +1,26 @@
-"""Jittered exponential backoff — the one retry-delay policy.
+"""Jittered exponential backoff + per-destination retry budgets.
 
-Used by the EC parity-worker supervisor (ec/overlap.py) and the wdclient
-master-reconnect loop; any future retry site should use this instead of
-hand-rolling the formula, so cap/jitter semantics can't drift between
-subsystems.
+jittered_backoff is the one retry-DELAY policy (EC parity-worker
+supervisor, wdclient reconnect, http_json_retry); RetryBudget is the
+one retry-VOLUME policy: a token bucket per destination that bounds
+how many RETRIES (never first attempts) a process sends at a peer.
+When a peer goes down, every caller's retries otherwise multiply the
+offered load exactly when the peer can least absorb it — the classic
+retry storm.  With a budget, a healthy peer absorbs occasional retries
+for free (the bucket refills faster than transient blips drain it),
+while a down peer drains the bucket once and every further retry is
+DENIED: callers degrade to a single attempt and the denial is counted
+(SeaweedFS_retry_budget_exhausted_total) and journaled
+(`retry_budget_exhausted`) so the storm that didn't happen is still an
+observable, alertable moment.
 """
 
 from __future__ import annotations
 
 import random
+import threading
+import time
+from typing import Optional
 
 
 def jittered_backoff(base: float, cap: float, attempt: int) -> float:
@@ -18,3 +30,104 @@ def jittered_backoff(base: float, cap: float, attempt: int) -> float:
     lockstep.  The jitter is applied INSIDE the cap — the returned delay
     never exceeds cap, and at saturation still spreads over [cap/2, cap]."""
     return random.uniform(0.5, 1.0) * min(cap, base * (2 ** attempt))
+
+
+class RetryBudget:
+    """Per-destination token bucket over RETRIES.  Each destination
+    (peer url, repair key, ...) gets its own bucket of `burst` tokens
+    refilled at `rate` tokens/second; allow(dest) takes one token, and
+    an empty bucket denies.  Buckets are created on first sight and
+    pruned once full again and idle (bounded memory across churning
+    destinations)."""
+
+    def __init__(self, rate: float = 0.5, burst: float = 10.0):
+        self.rate = float(rate)
+        self.burst = float(burst)
+        self._lock = threading.Lock()
+        # dest -> [tokens, monotonic_of_last_refill]
+        self._buckets: dict[str, list] = {}  # guarded-by: _lock
+
+    def allow(self, dest: str) -> bool:
+        """Take one retry token for `dest`; False = budget exhausted
+        (degrade to a single attempt, do NOT retry)."""
+        now = time.monotonic()
+        with self._lock:
+            b = self._buckets.get(dest)
+            if b is None:
+                b = self._buckets[dest] = [self.burst, now]
+            b[0] = min(self.burst, b[0] + (now - b[1]) * self.rate)
+            b[1] = now
+            if b[0] >= 1.0:
+                b[0] -= 1.0
+                return True
+            return False
+
+    def remaining(self, dest: str) -> float:
+        """Current token count (refilled to now) — status surfaces."""
+        now = time.monotonic()
+        with self._lock:
+            b = self._buckets.get(dest)
+            if b is None:
+                return self.burst
+            return min(self.burst, b[0] + (now - b[1]) * self.rate)
+
+    def snapshot(self) -> dict[str, float]:
+        with self._lock:
+            dests = list(self._buckets)
+        return {d: round(self.remaining(d), 2) for d in dests}
+
+    def prune(self, max_destinations: int = 1024) -> None:
+        """Drop the oldest buckets beyond the cap (destinations churn
+        in test clusters; the budget must not grow without bound)."""
+        with self._lock:
+            while len(self._buckets) > max_destinations:
+                self._buckets.pop(next(iter(self._buckets)))
+
+    def reset(self) -> None:
+        with self._lock:
+            self._buckets.clear()
+
+
+# --- process-global budget ---------------------------------------------------
+# One budget per process (like the tracer and the event journal): every
+# retry site draws from the same per-destination buckets, so a peer
+# hammered by one subsystem denies retries to all of them.
+
+_GLOBAL: Optional[RetryBudget] = None
+_global_lock = threading.Lock()
+# event emission rate limit: one retry_budget_exhausted event per
+# destination per window (the counter still counts every denial)
+_EVENT_MIN_INTERVAL_S = 5.0
+_last_event: dict[str, float] = {}  # guarded-by: _global_lock
+
+
+def get_retry_budget() -> RetryBudget:
+    global _GLOBAL
+    with _global_lock:
+        if _GLOBAL is None:
+            _GLOBAL = RetryBudget()
+        return _GLOBAL
+
+
+def retry_allowed(dest: str, kind: str = "http") -> bool:
+    """The one call every retry site makes before re-attempting: draw
+    from the process-global budget; on denial, bump the
+    retry_budget_exhausted counter (labeled by subsystem `kind`) and
+    journal a rate-limited `retry_budget_exhausted` event naming the
+    destination — then the caller degrades to what it already did."""
+    if get_retry_budget().allow(dest):
+        return True
+    from ..stats import request_plane_metrics
+
+    request_plane_metrics().retry_budget_exhausted.inc(kind)
+    now = time.monotonic()
+    emit = False
+    with _global_lock:
+        if now - _last_event.get(dest, 0.0) >= _EVENT_MIN_INTERVAL_S:
+            _last_event[dest] = now
+            emit = True
+    if emit:
+        from ..observability import events as _events
+
+        _events.emit("retry_budget_exhausted", dest=dest, kind=kind)
+    return False

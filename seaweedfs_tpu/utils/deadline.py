@@ -1,0 +1,200 @@
+"""Cluster-wide request-deadline propagation (the X-Weed-Deadline plane).
+
+PAPER.md's layer map shows every hop (client -> master -> volume,
+filer -> volume, coordinator -> peers) riding the same HTTP/framed
+chokepoints, yet until this module a slow peer could pin a caller for
+the full per-call timeout: a client willing to wait 2 seconds could
+trigger 30+ seconds of downstream work that nobody would ever read.
+This module closes that gap with a deadline that travels WITH the
+request:
+
+    X-Weed-Deadline: <remaining seconds, decimal>
+
+The header carries the REMAINING budget (a duration), never an absolute
+wall time — processes on different hosts do not share a clock, but a
+duration re-anchored to the receiver's monotonic clock only ever loses
+the (sub-millisecond) wire time.  Rules, mirroring the trace-context
+plane (observability/context.py):
+
+  - INGRESS (utils/httpd.py Router.dispatch): a valid header installs a
+    thread-local deadline for the request; an already-expired budget is
+    answered 504 BEFORE the handler runs (the caller has given up —
+    doing the work anyway is pure waste).  Malformed headers are
+    ignored, never 500.  The thread-local is restored afterwards:
+    handler threads are pooled per connection and a leaked deadline
+    would starve the next request.
+  - EGRESS (utils/httpd.py _pooled_request / http_download, the framed
+    client): the per-call timeout is clamped to the remaining budget
+    and the header re-emitted with what is left — a 2s client deadline
+    can never become 30s of downstream work.  A budget already spent
+    raises DeadlineExceeded without sending anything.
+
+Servers map DeadlineExceeded to 504 (gateway-timeout-style: "the
+upstream budget ran out here"), bump
+SeaweedFS_deadline_exceeded_total and journal a `deadline_exceeded`
+event — so budget exhaustion is a measured, alertable signal instead
+of a mystery timeout.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+DEADLINE_HEADER = "X-Weed-Deadline"
+
+# budgets below this are treated as already expired: a sub-millisecond
+# remainder cannot survive even a loopback round trip
+MIN_BUDGET_S = 0.001
+
+_tls = threading.local()
+
+
+class DeadlineExceeded(Exception):
+    """The request's deadline budget is spent.  Deliberately NOT an
+    OSError subclass: the http helpers' blanket transport-error
+    handling must not swallow it (a spent budget is the CALLER's
+    signal, not a peer failure), and Router.dispatch maps it to 504."""
+
+
+class Deadline:
+    """An absolute point on THIS process's monotonic clock by which the
+    request must be answered."""
+
+    __slots__ = ("at",)
+
+    def __init__(self, at: float):
+        self.at = at
+
+    @classmethod
+    def after(cls, seconds: float) -> "Deadline":
+        return cls(time.monotonic() + float(seconds))
+
+    def remaining(self) -> float:
+        return self.at - time.monotonic()
+
+    def expired(self) -> bool:
+        return self.remaining() < MIN_BUDGET_S
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"Deadline(remaining={self.remaining():.3f}s)"
+
+
+def parse_deadline(value) -> Optional[Deadline]:
+    """Header value -> Deadline re-anchored to the local monotonic
+    clock, or None for absent/malformed input (a bad client must not
+    500 a server).  A non-positive budget parses to an ALREADY-EXPIRED
+    deadline — the caller decided; the ingress answers 504."""
+    if not value:
+        return None
+    try:
+        budget = float(str(value).strip())
+    except (TypeError, ValueError):
+        return None
+    if budget != budget or budget in (float("inf"), float("-inf")):
+        return None
+    return Deadline.after(budget)
+
+
+def current() -> Optional[Deadline]:
+    """The thread's active deadline, or None (no budgeted request)."""
+    return getattr(_tls, "deadline", None)
+
+
+def activate(deadline: Optional[Deadline]):
+    """Install `deadline` on this thread; returns the previous value
+    for symmetric restore."""
+    prev = getattr(_tls, "deadline", None)
+    _tls.deadline = deadline
+    return prev
+
+
+def begin_request(headers):
+    """Ingress helper: parse + activate in one step.  `headers` is any
+    .get()-able (or None for headerless ingresses like the framed-TCP
+    fronts — those CLEAR the slot so a pooled connection thread cannot
+    leak a previous request's budget).  Returns (deadline_or_None,
+    previous) — pass `previous` to end_request() in a finally block."""
+    prev = getattr(_tls, "deadline", None)
+    ddl = parse_deadline(headers.get(DEADLINE_HEADER)) \
+        if headers is not None else None
+    _tls.deadline = ddl
+    return ddl, prev
+
+
+def end_request(prev) -> None:
+    _tls.deadline = prev
+
+
+class scope:
+    """``with scope(seconds_or_deadline):`` — run a block under a
+    deadline (client entry points, the coordinator's per-repair budget,
+    scenario drivers).  Accepts seconds, an existing Deadline (carrying
+    a caller's budget onto a helper thread), or None (explicitly no
+    deadline)."""
+
+    __slots__ = ("deadline", "prev")
+
+    def __init__(self, seconds_or_deadline):
+        if seconds_or_deadline is None or \
+                isinstance(seconds_or_deadline, Deadline):
+            self.deadline = seconds_or_deadline
+        else:
+            self.deadline = Deadline.after(float(seconds_or_deadline))
+
+    def __enter__(self) -> Optional[Deadline]:
+        self.prev = activate(self.deadline)
+        return self.deadline
+
+    def __exit__(self, *exc) -> bool:
+        _tls.deadline = self.prev
+        return False
+
+
+def clamp(timeout: float) -> float:
+    """The effective timeout for one outbound call: min(timeout,
+    remaining budget).  Raises DeadlineExceeded when the budget is
+    already spent — the egress must not send a request whose answer
+    nobody will wait for.  No active deadline passes `timeout`
+    through untouched."""
+    ddl = getattr(_tls, "deadline", None)
+    if ddl is None:
+        return timeout
+    rem = ddl.remaining()
+    if rem < MIN_BUDGET_S:
+        raise DeadlineExceeded(
+            f"deadline exceeded before send ({rem:.3f}s remaining)")
+    return min(float(timeout), rem)
+
+
+def inject_deadline_headers(headers: dict) -> dict:
+    """Stamp the remaining budget onto an outbound request's headers
+    (called INSIDE the egress chokepoints, next to the Traceparent
+    injection).  No active deadline: untouched."""
+    ddl = getattr(_tls, "deadline", None)
+    if ddl is not None:
+        headers.setdefault(DEADLINE_HEADER,
+                           f"{max(ddl.remaining(), 0.0):.3f}")
+    return headers
+
+
+def sleep_within(seconds: float) -> None:
+    """Sleep up to `seconds`, clipped by the active deadline; raises
+    DeadlineExceeded when the budget runs out first.  The net.delay
+    fault point rides this at the egress: a slow wire delays the
+    request, but the caller's clock keeps running and the call still
+    returns within its budget — exactly how a real socket timeout
+    behaves under a slow network."""
+    ddl = getattr(_tls, "deadline", None)
+    if ddl is None:
+        time.sleep(seconds)
+        return
+    rem = ddl.remaining()
+    if rem < MIN_BUDGET_S:
+        raise DeadlineExceeded("deadline exceeded before network delay")
+    if seconds >= rem:
+        time.sleep(max(rem, 0.0))
+        raise DeadlineExceeded(
+            f"deadline expired during {seconds:.3f}s network delay")
+    time.sleep(seconds)

@@ -93,6 +93,22 @@ FAULT_POINTS: dict[str, str] = {
                   "/admin/ec/* leg) — injected error fails the "
                   "current repair/move so re-plan + no-orphan "
                   "cleanup paths run (ops/coordinator.py)",
+    "net.delay": "peer-scoped wire slowdown at the pooled-HTTP and "
+                 "framed egress: params={'peer': 'host:port'} (absent "
+                 "= all peers) + delay=<s>.  Applied deadline-aware "
+                 "(deadline.sleep_within), so a caller with an "
+                 "X-Weed-Deadline budget still returns on time — the "
+                 "scenario engine's slow-network drill "
+                 "(utils/httpd.py, utils/framing.py)",
+    "net.drop": "peer-scoped probabilistic request loss at the same "
+                "egress points: params={'peer': ...}, error_rate<1 "
+                "models packet loss / connection resets "
+                "(utils/httpd.py, utils/framing.py)",
+    "net.partition": "peer-scoped total partition: arm with "
+                     "error_rate=1.0 + params={'peer': ...} and every "
+                     "send to that peer fails instantly — the "
+                     "failure-under-load scenario's rack-loss stand-in "
+                     "(utils/httpd.py, utils/framing.py)",
 }
 
 
@@ -167,6 +183,52 @@ def hit(name: str) -> None:
         time.sleep(delay)
     if err is not None:
         raise err
+
+
+def _peer_matches(p: Optional[dict], peer: str) -> bool:
+    """Does this armed point's scope cover `peer`?  No params or no
+    'peer' key = every peer; otherwise exact netloc match."""
+    if p is None:
+        return False
+    prm = p.get("params")
+    if not prm or prm.get("peer") is None:
+        return True
+    return str(prm.get("peer")) == peer
+
+
+def hit_peer(name: str, peer: str) -> None:
+    """Peer-scoped twin of hit(): fires only when the armed point's
+    params name this destination (params absent = all peers).  The
+    net.drop / net.partition egress sites ride this so a scenario can
+    partition ONE peer while the rest of the cluster serves."""
+    if not _points:
+        return
+    with _lock:
+        if not _peer_matches(_points.get(name), peer):
+            return
+    hit(name)
+
+
+def peer_delay(name: str, peer: str) -> float:
+    """Peer-scoped delay QUERY: returns the armed delay (counting a
+    hit) instead of sleeping, so the egress can apply it deadline-aware
+    (deadline.sleep_within) — a slow wire must not stall a caller past
+    its budget, exactly like a real socket timeout firing during a slow
+    network.  0.0 when unarmed / out of scope / out of hits."""
+    if not _points:
+        return 0.0
+    with _lock:
+        p = _points.get(name)
+        if not _peer_matches(p, peer):
+            return 0.0
+        delay = p["delay"]
+        if not delay:
+            return 0.0
+        if p["max_hits"] and p["hits"] >= p["max_hits"]:
+            return 0.0
+        p["hits"] += 1
+        _counts[name] = _counts.get(name, 0) + 1
+        return delay
 
 
 def corrupt_block(name: str, shard_id: int, data, file_offset: int = 0):

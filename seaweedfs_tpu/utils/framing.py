@@ -22,6 +22,7 @@ from typing import Callable, Optional
 
 from ..observability import context as _trace_context
 from ..observability import get_tracer as _get_tracer
+from . import deadline as _deadline
 
 TCP_PORT_OFFSET = 20000
 U16 = struct.Struct(">H")
@@ -128,6 +129,12 @@ class FramedServer:
                 if tracer.enabled:
                     sampled, prev_ctx = _trace_context.begin_request(None)
                     traced = True
+                # deadline hygiene for the headerless plane: frames
+                # carry no X-Weed-Deadline slot, so each op runs
+                # budget-free — but the slot must be CLEARED (and
+                # restored), or a pooled connection thread would leak a
+                # previous request's budget into this frame
+                _ddl, _prev_ddl = _deadline.begin_request(None)
                 try:
                     # gate on the sampled decision: the 21k-rps framed
                     # path must not build span names for unsampled ops
@@ -142,6 +149,7 @@ class FramedServer:
                     msg = f"{type(e).__name__}: {e}".encode()[:65536]
                     conn.sendall(b"\x01" + U32.pack(len(msg)) + msg)
                 finally:
+                    _deadline.end_request(_prev_ddl)
                     if traced:
                         _trace_context.end_request(prev_ctx)
         finally:
@@ -154,11 +162,16 @@ class FramedClient(threading.local):
     def __init__(self):
         self._conns: dict[str, socket.socket] = {}
 
-    def _conn(self, addr: str) -> socket.socket:
+    def _conn(self, addr: str,
+              timeout: float = 30.0) -> socket.socket:
         sock = self._conns.get(addr)
         if sock is None:
             host, _, port = addr.partition(":")
-            sock = socket.create_connection((host, int(port)), timeout=30)
+            # the CONNECT timeout is the caller's clamped budget too: a
+            # SYN-blackholed peer must not pin a budgeted caller for a
+            # fixed 30s when its deadline allows 2
+            sock = socket.create_connection((host, int(port)),
+                                            timeout=timeout)
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             self._conns[addr] = sock
         return sock
@@ -173,20 +186,39 @@ class FramedClient(threading.local):
 
     def request(self, addr: str, op: bytes, key: str,
                 body: bytes = b"") -> bytes:
-        """One framed op; retries once on a stale pooled connection."""
+        """One framed op; retries once on a stale pooled connection.
+        The egress is deadline-aware (the per-op socket timeout is
+        clamped to the caller's remaining budget) and rides the same
+        peer-scoped net.* fault points as the pooled HTTP client."""
+        from . import faultinject as fi
+
+        if fi._points:
+            fi.hit_peer("net.partition", addr)
+            fi.hit_peer("net.drop", addr)
+            _net_delay = fi.peer_delay("net.delay", addr)
+            if _net_delay:
+                _deadline.sleep_within(_net_delay)
+        op_timeout = _deadline.clamp(30.0)
         key_b = key.encode()
         frame = (op + U16.pack(len(key_b)) + key_b
                  + U32.pack(len(body)) + body)
         for attempt in (0, 1):
             reused = addr in self._conns
-            sock = self._conn(addr)
+            sock = self._conn(addr, op_timeout)
             try:
+                sock.settimeout(op_timeout)
                 sock.sendall(frame)
                 status = recv_exact(sock, 1)
                 n = U32.unpack(recv_exact(sock, 4))[0]
                 payload = recv_exact(sock, n) if n else b""
             except (ConnectionError, OSError):
                 self._drop(addr)
+                ddl = _deadline.current()
+                if ddl is not None and ddl.expired():
+                    # the budget was the binding constraint, not the
+                    # wire: surface it as such (callers answer 504)
+                    raise _deadline.DeadlineExceeded(
+                        f"deadline exceeded awaiting {addr}") from None
                 if not reused:
                     raise
                 continue

@@ -23,6 +23,8 @@ from typing import Callable, Optional
 from ..observability import context as _trace_context
 from ..observability import get_tracer as _get_tracer
 from ..observability.tracer import NOOP_SPAN as _NOOP_SPAN
+from . import deadline as _deadline
+from .deadline import DeadlineExceeded
 
 
 class HttpError(Exception):
@@ -190,6 +192,26 @@ class Router:
         # default JSON error mapping (the S3 gateway uses it to emit
         # protocol-correct XML errors)
         self.error_handler: Optional[Callable[[Exception], Optional[Response]]] = None
+        # optional admission controller (utils/admission.py): servers
+        # started with -maxInflight > 0 install one; None costs a
+        # single attribute check per request
+        self.admission = None
+        # deadline_exceeded journal rate limit (the counter counts every
+        # 504; the ring must not churn under a deadline storm).  A lost
+        # write race costs at most one extra journal event.
+        self._last_ddl_event = 0.0
+
+    def _note_deadline_exceeded(self) -> None:
+        """Count + journal (rate-limited) one budget-spent 504."""
+        from ..stats import request_plane_metrics
+
+        request_plane_metrics().deadline_exceeded.inc(self.name)
+        now = _time.monotonic()
+        if now - self._last_ddl_event >= 1.0:
+            self._last_ddl_event = now
+            from ..observability import events as _events
+
+            _events.emit("deadline_exceeded", role=self.name)
 
     def route(self, method: str, pattern: str):
         compiled = re.compile("^" + pattern + "$")
@@ -245,6 +267,12 @@ class Router:
             _prev_srv = _trace_context.swap_server(
                 getattr(self, "server_url", None)
                 or handler.headers.get("Host"))
+        # deadline ingress (utils/deadline.py): adopt the caller's
+        # X-Weed-Deadline (re-anchored to the local monotonic clock)
+        # for the duration of this request, restored in the finally —
+        # the same pooled-thread hygiene as the trace context.  Costs
+        # one header get when absent.
+        ddl, _prev_ddl = _deadline.begin_request(handler.headers)
         try:
             for m, pattern, fn in self.routes:
                 if m != method:
@@ -254,6 +282,9 @@ class Router:
                     continue
                 t0 = _time.perf_counter()
                 req = Request(handler, match)
+                admission = self.admission
+                admitted = False
+                shed = False
                 # request span: the path carries the needle/volume id for
                 # object routes (/<vid>,<fid>), so a trace timeline can be
                 # joined back to specific keys.  The span re-roots under
@@ -263,12 +294,41 @@ class Router:
                 # at 1% sampling the other 99% of requests skip even the
                 # span-name f-string and attrs dict
                 try:
-                    if tctx is not None:
-                        with tracer.span(f"http.{self.name}.{fn.__name__}",
-                                         method=method, path=path):
-                            resp = fn(req)
+                    if ddl is not None and ddl.expired():
+                        # the caller's budget is already spent: a
+                        # 504-style answer NOW beats doing work nobody
+                        # will read — and the moment is counted +
+                        # journaled, so budget exhaustion pages instead
+                        # of hiding inside generic timeouts
+                        self._note_deadline_exceeded()
+                        resp = Response(
+                            {"error": "deadline exceeded before "
+                                      "dispatch"}, status=504)
+                    elif admission is not None \
+                            and not admission.exempt(path) \
+                            and not admission.try_acquire():
+                        # over the inflight bound: shed with a fast 503
+                        # + Retry-After instead of queueing into a late
+                        # timeout (try_acquire counted + journaled it).
+                        # Close the connection so the unread body is
+                        # the accept loop's bounded-drain problem, not
+                        # a keep-alive desync.
+                        handler.close_connection = True
+                        shed = True
+                        resp = Response(
+                            {"error": "overloaded: request shed"},
+                            status=503,
+                            headers={"Retry-After": "1",
+                                     "Connection": "close"})
                     else:
-                        resp = fn(req)
+                        admitted = admission is not None \
+                            and not admission.exempt(path)
+                        if tctx is not None:
+                            with tracer.span(f"http.{self.name}.{fn.__name__}",
+                                             method=method, path=path):
+                                resp = fn(req)
+                        else:
+                            resp = fn(req)
                 except Exception as e:  # noqa: BLE001 — server must not die
                     resp = None
                     if self.error_handler is not None:
@@ -277,45 +337,78 @@ class Router:
                         except Exception:
                             resp = None
                     if resp is None:
-                        if isinstance(e, HttpError):
+                        if isinstance(e, DeadlineExceeded):
+                            # the budget ran out DURING the handler
+                            # (usually at a downstream egress whose
+                            # clamp fired): 504, same accounting as the
+                            # pre-dispatch check
+                            self._note_deadline_exceeded()
+                            resp = Response(
+                                {"error": str(e) or "deadline exceeded"},
+                                status=504)
+                        elif isinstance(e, HttpError):
+                            # http_bytes signals an UNREACHABLE peer as
+                            # synthetic status 0; a handler re-raising
+                            # it must answer 502, not emit an invalid
+                            # "HTTP/1.1 0" status line — clients parse
+                            # sub-200 as an interim response and hang
+                            # waiting for the real one (found by the
+                            # scenario engine's partition drill)
                             resp = Response({"error": e.message or str(e)},
-                                            status=e.status,
+                                            status=e.status
+                                            if e.status >= 100 else 502,
                                             headers=e.headers or None)
                         elif isinstance(e, (KeyError, LookupError)):
                             resp = Response({"error": str(e)}, status=404)
                         else:
                             resp = Response(
                                 {"error": f"{type(e).__name__}: {e}"}, status=500)
-                if self.metrics is not None:
-                    self.metrics.request_counter.inc(fn.__name__)
-                    if resp.status >= 500:
-                        # per-route 5xx counter: the burn-rate SLO's
-                        # numerator (guarded: custom metrics bundles
-                        # may predate the family)
-                        errs = getattr(self.metrics, "request_errors",
-                                       None)
-                        if errs is not None:
-                            errs.inc(fn.__name__)
-                    # RED histogram keyed by route; sampled requests
-                    # attach their trace id as an exemplar, so a latency
-                    # outlier on /metrics links straight to the stitched
-                    # trace that explains it
-                    self.metrics.request_histogram.observe(
-                        fn.__name__, _time.perf_counter() - t0,
-                        exemplar=tctx.trace_id if tctx is not None
-                        else None)
-                if tctx is not None:
-                    # hand the trace id back so callers (bench, tests,
-                    # curl -v) can fetch the stitched cluster trace
-                    resp.headers.setdefault("X-Trace-Id", tctx.trace_id)
-                # drain any unread request body first: responding while the
-                # client is still mid-upload resets the connection and the
-                # client never sees the (often 4xx) status. Discard in
-                # bounded chunks — never buffer a rejected upload.
-                if req._body is None:
-                    self._drain_body(handler)
-                    req._body = b""
-                self._send(handler, resp)
+                try:
+                    if self.metrics is not None:
+                        self.metrics.request_counter.inc(fn.__name__)
+                        if resp.status >= 500:
+                            # per-route 5xx counter: the burn-rate SLO's
+                            # numerator (guarded: custom metrics bundles
+                            # may predate the family)
+                            errs = getattr(self.metrics, "request_errors",
+                                           None)
+                            if errs is not None:
+                                errs.inc(fn.__name__)
+                        # RED histogram keyed by route; sampled requests
+                        # attach their trace id as an exemplar, so a latency
+                        # outlier on /metrics links straight to the stitched
+                        # trace that explains it
+                        self.metrics.request_histogram.observe(
+                            fn.__name__, _time.perf_counter() - t0,
+                            exemplar=tctx.trace_id if tctx is not None
+                            else None)
+                    if tctx is not None:
+                        # hand the trace id back so callers (bench, tests,
+                        # curl -v) can fetch the stitched cluster trace
+                        resp.headers.setdefault("X-Trace-Id", tctx.trace_id)
+                    # drain any unread request body first: responding while
+                    # the client is still mid-upload resets the connection
+                    # and the client never sees the (often 4xx) status.
+                    # Discard in bounded chunks — never buffer a rejected
+                    # upload.  ONLY a shed skips this (it already marked
+                    # the connection closing; the accept loop's bounded
+                    # pre-close drain protects the 503) — shedding must
+                    # stay a microseconds-fast "no", but an ordinary
+                    # Connection: close client's rejected upload still
+                    # needs the full drain or the close RSTs its error
+                    # response away.
+                    if req._body is None and not shed:
+                        self._drain_body(handler)
+                        req._body = b""
+                    self._send(handler, resp)
+                finally:
+                    # release only after the RESPONSE left: for large
+                    # streamed reads (Response(file_path=...)) the send
+                    # IS the work — releasing at handler return would
+                    # let unbounded concurrent transmissions pile up
+                    # behind an "empty" admission gate
+                    if admitted:
+                        admission.release()
                 return
             # 404 fallthrough: the body was never read, so drain it too or
             # the keep-alive loop would parse the leftover bytes as the next
@@ -324,6 +417,7 @@ class Router:
             self._send(handler, Response(
                 {"error": f"no route {method} {path}"}, status=404))
         finally:
+            _deadline.end_request(_prev_ddl)
             if traced:
                 _trace_context.end_request(_prev_ctx)
                 _trace_context.swap_server(_prev_srv)
@@ -939,12 +1033,27 @@ def _pooled_request(method: str, url: str, body: Optional[bytes],
 
     if fi._points:
         fi.hit("net.request")
+        # peer-scoped network faults (the scenario engine's wire): a
+        # partition/drop fails the send instantly; a delay is applied
+        # deadline-aware, so a slow wire stalls the request but never
+        # the caller past its budget — like a real socket timeout
+        fi.hit_peer("net.partition", parsed.netloc)
+        fi.hit_peer("net.drop", parsed.netloc)
+        _net_delay = fi.peer_delay("net.delay", parsed.netloc)
+        if _net_delay:
+            _deadline.sleep_within(_net_delay)
+    # deadline clamp: the per-call timeout never exceeds the remaining
+    # propagated budget (a 2s client deadline must not become 30s of
+    # downstream waiting); a spent budget raises before sending
+    timeout = _deadline.clamp(timeout)
     span_cm, ctx = _egress_span(method, parsed)
-    if ctx is not None:
+    if ctx is not None or _deadline.current() is not None:
         headers = dict(headers or {})
     with span_cm:
         if ctx is not None:
             _trace_context.inject_trace_headers(headers)
+        if _deadline.current() is not None:
+            _deadline.inject_deadline_headers(headers)
         for _ in range(2):
             conn = _pool.conns.get(key)
             reused = conn is not None
@@ -963,6 +1072,14 @@ def _pooled_request(method: str, url: str, body: Optional[bytes],
             except (TimeoutError, _socket.timeout):
                 conn.close()
                 _pool.conns.pop(key, None)
+                ddl = _deadline.current()
+                if ddl is not None and ddl.expired():
+                    # the deadline was the binding constraint: surface
+                    # it as a budget exhaustion (servers answer 504),
+                    # not a generic transport timeout
+                    raise DeadlineExceeded(
+                        f"deadline exceeded awaiting "
+                        f"{parsed.netloc}") from None
                 raise
             except Exception:
                 conn.close()
@@ -1020,6 +1137,39 @@ def http_json(method: str, url: str, payload: Optional[dict] = None,
     return json.loads(body) if body else {}
 
 
+def http_json_retry(method: str, url: str, payload: Optional[dict] = None,
+                    timeout: float = 30.0, attempts: int = 3,
+                    budget_kind: str = "http") -> dict:
+    """http_json with bounded transient-failure retries that draw from
+    the per-destination retry budget (utils/backoff.py): each RETRY
+    (never the first attempt) takes a token for the peer; a drained
+    bucket degrades the call to what it already did and journals
+    `retry_budget_exhausted` — retries must not multiply load onto a
+    peer that is already down.  Only unreachable/503 answers retry
+    (anything else is a real server answer); only idempotent methods
+    may retry (a timed-out POST may have executed — resending would
+    run it twice).  Retries never extend past an active deadline:
+    http_json's egress clamp raises DeadlineExceeded the moment the
+    budget is spent."""
+    from .backoff import jittered_backoff, retry_allowed
+
+    dest = urllib.parse.urlsplit(url).netloc
+    retriable = method.upper() in ("GET", "HEAD")
+    last: Optional[HttpError] = None
+    for i in range(max(1, int(attempts))):
+        if i:
+            if not retriable or not retry_allowed(dest, budget_kind):
+                break
+            _deadline.sleep_within(jittered_backoff(0.05, 1.0, i - 1))
+        try:
+            return http_json(method, url, payload, timeout=timeout)
+        except HttpError as e:
+            last = e
+            if e.status != 503:
+                raise
+    raise last  # type: ignore[misc]
+
+
 UNSATISFIABLE_RANGE = (-1, 0)
 
 
@@ -1074,16 +1224,31 @@ def http_download(method: str, url: str, dest_path: str,
     status (0 = unreachable)."""
     url, ssl_ctx = _prep_url(url)
     req = urllib.request.Request(url, method=method)
+    parsed = urllib.parse.urlsplit(url)
+    from . import faultinject as fi
+
+    if fi._points:
+        # same peer-scoped network faults as _pooled_request: bulk
+        # transfers ride the same simulated wire
+        fi.hit_peer("net.partition", parsed.netloc)
+        fi.hit_peer("net.drop", parsed.netloc)
+        _net_delay = fi.peer_delay("net.delay", parsed.netloc)
+        if _net_delay:
+            _deadline.sleep_within(_net_delay)
+    # deadline clamp + header: a budgeted caller's bulk fetch inherits
+    # the remaining budget, never the 1h default
+    timeout = _deadline.clamp(timeout)
     # same trace egress as _pooled_request: bulk transfers (volume copy,
     # EC shard copy) appear on the stitched trace as rpc.client hops and
     # carry the caller's Traceparent downstream
-    span_cm, ctx = _egress_span(method, urllib.parse.urlsplit(url),
-                                download=True)
+    span_cm, ctx = _egress_span(method, parsed, download=True)
     tmp = dest_path + ".part"
     with span_cm:
         if ctx is not None:
             for k, v in _trace_context.inject_trace_headers({}).items():
                 req.add_header(k, v)
+        for k, v in _deadline.inject_deadline_headers({}).items():
+            req.add_header(k, v)
         return _http_download_body(req, timeout, ssl_ctx, tmp,
                                    dest_path, piece_bytes)
 

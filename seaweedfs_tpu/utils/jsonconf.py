@@ -14,7 +14,8 @@ from .httpd import HttpError, http_bytes
 
 
 def read_json_conf(filer_url: str, path: str, default):
-    status, body, _ = http_bytes("GET", f"http://{filer_url}{path}")
+    status, body, _ = http_bytes("GET", f"http://{filer_url}{path}",
+        timeout=60.0)
     if status == 404:
         return default
     if status != 200:
@@ -26,6 +27,6 @@ def write_json_conf(filer_url: str, path: str, obj) -> None:
     status, body, _ = http_bytes(
         "PUT", f"http://{filer_url}{path}",
         json.dumps(obj, indent=2).encode(),
-        headers={"Content-Type": "application/json"})
+        headers={"Content-Type": "application/json"}, timeout=60.0)
     if status not in (200, 201):
         raise HttpError(status, body.decode(errors="replace"))

@@ -65,6 +65,14 @@ class EcScrubber:
         # W501 enforces the discipline via these annotations)
         self.cursor: tuple[int, int] = (0, 0)  # guarded-by: _lock
         self.verdicts: dict[int, dict] = {}  # guarded-by: _lock
+        # targeted scan (one volume, one pass): set by start(volume_id=)
+        # — the coordinator's post-repair re-scrub, clearing a stale
+        # unrepairable verdict without waiting for the next full pass
+        self.only_vid: Optional[int] = None  # guarded-by: _lock
+        # trace context the targeted pass adopts (the repair's trace,
+        # carried via the /ec/scrub/start request) instead of minting
+        # its own root — the verdict flip journals under the repair
+        self._ctx = None  # guarded-by: _lock
         self.passes = 0  # guarded-by: _lock
         self.running = False  # guarded-by: _lock
         self.paused = False  # guarded-by: _lock
@@ -75,11 +83,21 @@ class EcScrubber:
     # --- lifecycle --------------------------------------------------------
     def start(self, rate_mb_s: Optional[float] = None,
               interval_s: Optional[float] = None,
-              backfill: Optional[bool] = None) -> bool:
+              backfill: Optional[bool] = None,
+              volume_id: Optional[int] = None,
+              ctx=None) -> bool:
         """Launch the scan thread (False when one is already running —
         the knobs still apply to the LIVE scan: _pace reads rate_mb_s
         per block, so re-POSTing /ec/scrub/start with a lower rate
-        throttles a running scan instead of being silently ignored)."""
+        throttles a running scan instead of being silently ignored).
+
+        volume_id requests a TARGETED one-pass scan of just that
+        volume (the coordinator's post-repair re-scrub); its verdict
+        replaces whatever stale verdict the volume carried.  `ctx` is
+        a trace context the targeted pass adopts, so the re-scrub
+        journals under the repair that requested it.  Targeted
+        requests are best-effort: with a scan already running they
+        return False and the running pass converges on its own."""
         with self._lock:
             if rate_mb_s is not None:
                 self.rate_mb_s = float(rate_mb_s)
@@ -89,6 +107,16 @@ class EcScrubber:
                 self.backfill = bool(backfill)
             if self._thread is not None and self._thread.is_alive():
                 return False
+            if volume_id is not None:
+                self.only_vid = int(volume_id)
+                self._ctx = ctx
+                # aim the cursor at shard 0 of the target: a cursor
+                # left mid-volume by an interrupted full scan must not
+                # make the verification skip the first shards
+                self.cursor = (int(volume_id), 0)
+            else:
+                self.only_vid = None
+                self._ctx = None
             self._stop.clear()
             self._debt, self._t0 = 0.0, None
             self._thread = threading.Thread(target=self._loop, daemon=True,
@@ -131,8 +159,11 @@ class EcScrubber:
                     if not self._stop.is_set():
                         self.passes += 1  # one-shot passes count too
                     interval = self.interval_s
-                if self._stop.is_set() or not interval:
-                    break
+                    targeted = self.only_vid is not None
+                    self.only_vid = None
+                    self._ctx = None
+                if targeted or self._stop.is_set() or not interval:
+                    break  # targeted scans are always one pass
                 if self._stop.wait(interval):
                     break
         finally:
@@ -154,8 +185,16 @@ class EcScrubber:
         tr = get_tracer()
         from ..observability import context as _trace_context
 
+        with self._lock:
+            inherit = self._ctx
         ctx = prev = None
-        if tr.enabled and _trace_context.current() is None:
+        if inherit is not None and _trace_context.current() is None:
+            # targeted re-scrub: adopt the requesting repair's context
+            # (honoring an explicit not-sampled decision) so the
+            # verdict flip journals under the repair's trace
+            ctx = inherit
+            prev = _trace_context.activate(ctx)
+        elif tr.enabled and _trace_context.current() is None:
             ctx = _trace_context.TraceContext(_trace_context.new_trace_id())
             prev = _trace_context.activate(ctx)
         # stamp the scan thread with the owning server's identity: spans
@@ -176,17 +215,27 @@ class EcScrubber:
     def _run_pass_inner(self, tr) -> dict:
         with self._lock:
             cv = self.cursor[0]
-        with tr.span("ec.scrub.pass", cursor_vid=cv):
+            only = self.only_vid
+        with tr.span("ec.scrub.pass", cursor_vid=cv,
+                     targeted=-1 if only is None else only):
             vids = sorted(self.store.ec_volumes)
-            # rotate so the pass resumes at the cursor, then wraps
-            vids = [v for v in vids if v >= cv] + [v for v in vids if v < cv]
+            if only is not None:
+                # targeted post-repair verification: just that volume
+                vids = [v for v in vids if v == only]
+            else:
+                # rotate so the pass resumes at the cursor, then wraps
+                vids = [v for v in vids if v >= cv] + \
+                    [v for v in vids if v < cv]
             for vid in vids:
                 if self._stop.is_set():
                     return self.status()
                 self._scrub_volume(vid)
-            if not self._stop.is_set():
+            if not self._stop.is_set() and only is None:
                 # clean wrap: next pass starts fresh (a stop mid-scan
-                # keeps the mid-volume cursor _scrub_volume left)
+                # keeps the mid-volume cursor _scrub_volume left; a
+                # targeted pass leaves the full-scan cursor where its
+                # one volume put it — the next full pass rotates from
+                # there and still covers everything)
                 with self._lock:
                     self.cursor = (0, 0)
         return self.status()

@@ -102,7 +102,7 @@ class VolumeServer:
                  full_sync_every: int = 12,
                  tls_context=None,
                  tcp: bool = True, use_mmap: bool = False,
-                 dataplane: str = "python"):
+                 dataplane: str = "python", max_inflight: int = 0):
         from ..security import Guard
 
         if backends:
@@ -165,6 +165,12 @@ class VolumeServer:
         self.metrics.max_volume_counter.set(max_volume_count)
         self.router = Router("volume", metrics=self.metrics)
         self.router.server_url = self.url
+        # admission control (utils/admission.py): -maxInflight > 0
+        # sheds excess object-route load early with a fast 503 instead
+        # of letting every caller time out late
+        from ..utils.admission import maybe_controller
+
+        self.router.admission = maybe_controller(max_inflight, "volume")
         self._register_routes()
         self._server = None
         self._tls_context = tls_context
@@ -351,11 +357,11 @@ class VolumeServer:
 
     def heartbeat_now(self) -> None:
         resp = http_json("POST", f"http://{self.master_url}/heartbeat",
-                         self.heartbeat_payload())
+                         self.heartbeat_payload(), timeout=30.0)
         if resp.get("not_leader") and resp.get("leader"):
             self.master_url = resp["leader"]  # weedlint: disable=W502 atomic str rebind: heartbeat loop and heartbeat_now converge on the same leader, readers tolerate one stale retry
             http_json("POST", f"http://{self.master_url}/heartbeat",
-                      self.heartbeat_payload())
+                      self.heartbeat_payload(), timeout=30.0)
 
     # --- helpers ----------------------------------------------------------
     def _tcp_replicate_write(self, fid_str: str, data: bytes) -> None:
@@ -366,7 +372,8 @@ class VolumeServer:
             if url == self.url:
                 continue
             status, body, _ = http_bytes(
-                "POST", f"http://{url}/{fid_str}?type=replicate", data)
+                "POST", f"http://{url}/{fid_str}?type=replicate", data,
+                    timeout=60.0)
             if status not in (200, 201):
                 raise OSError(f"replication to {url} failed: {status}")
 
@@ -375,7 +382,8 @@ class VolumeServer:
         for url in self._lookup_replicas(vid):
             if url == self.url:
                 continue
-            http_bytes("DELETE", f"http://{url}/{fid_str}?type=replicate")
+            http_bytes("DELETE", f"http://{url}/{fid_str}?type=replicate",
+                timeout=60.0)
 
     def _lookup_replicas(self, vid: int) -> list[str]:
         """Replica locations with a short TTL cache
@@ -393,7 +401,8 @@ class VolumeServer:
             # slow master would stall every replicated write behind one
             # lookup); racing fills for the same vid are both correct
             r = http_json("GET",
-                          f"http://{self.master_url}/dir/lookup?volumeId={vid}")
+                          f"http://{self.master_url}/dir/lookup?volumeId={vid}",
+                              timeout=30.0)
             locs = [loc["url"] for loc in r.get("locations", [])]
         except HttpError:
             return []
@@ -456,7 +465,8 @@ class VolumeServer:
         """store_ec.go:188-218: remote shard read, falling back to remote
         reconstruction inputs."""
         r = http_json("GET",
-                      f"http://{self.master_url}/dir/lookup_ec?volumeId={vid}")
+                      f"http://{self.master_url}/dir/lookup_ec?volumeId={vid}",
+                          timeout=30.0)
         holders = r.get("shards", {}).get(str(shard_id), [])
         for url in holders:
             if url == self.url:
@@ -464,7 +474,8 @@ class VolumeServer:
             status, body, _ = http_bytes(
                 "GET",
                 f"http://{url}/admin/ec/shard_read?volume_id={vid}"
-                f"&shard={shard_id}&offset={offset}&size={length}")
+                f"&shard={shard_id}&offset={offset}&size={length}",
+                    timeout=60.0)
             if status == 200:
                 return body
         # reconstruct from any data_shards distinct shards, local or remote
@@ -487,7 +498,8 @@ class VolumeServer:
                 status, body, _ = http_bytes(
                     "GET",
                     f"http://{url}/admin/ec/shard_read?volume_id={vid}"
-                    f"&shard={sid}&offset={offset}&size={length}")
+                    f"&shard={sid}&offset={offset}&size={length}",
+                        timeout=60.0)
                 if status == 200:
                     import numpy as np
 
@@ -907,7 +919,7 @@ class VolumeServer:
                         "http://%s%s?%s" % (
                             url, urllib.parse.quote(req.path, safe="/,"),
                             qs),
-                        data, headers=fwd_headers)
+                        data, headers=fwd_headers, timeout=60.0)
                     if status != 200 and status != 201:
                         raise HttpError(500,
                                         f"replication to {url} failed: {status}")
@@ -946,7 +958,7 @@ class VolumeServer:
                     if url == self.url:
                         continue
                     http_bytes("DELETE", "http://%s%s%s" % (
-                        url, _up.quote(req.path, safe="/,"), qs))
+                        url, _up.quote(req.path, safe="/,"), qs), timeout=60.0)
             return Response({"size": size})
 
         # --- admin: volume lifecycle ---------------------------------
@@ -1067,11 +1079,12 @@ class VolumeServer:
                 raise HttpError(409, f"volume {vid} already here")
             # remember the source's current readonly state and restore it —
             # an operator-fenced volume must stay fenced after the copy
-            src_status = http_json("GET", f"http://{source}/status")
+            src_status = http_json("GET", f"http://{source}/status",
+                timeout=30.0)
             was_readonly = any(v["id"] == vid and v["read_only"]
                                for v in src_status.get("Volumes", []))
             http_json("POST", f"http://{source}/admin/readonly",
-                      {"volume_id": vid, "readonly": True})
+                      {"volume_id": vid, "readonly": True}, timeout=30.0)
             try:
                 from ..utils.httpd import http_download
 
@@ -1088,7 +1101,8 @@ class VolumeServer:
                     os.path.dirname(base), collection, vid)
             finally:
                 http_json("POST", f"http://{source}/admin/readonly",
-                          {"volume_id": vid, "readonly": was_readonly})
+                          {"volume_id": vid, "readonly": was_readonly},
+                              timeout=30.0)
             return Response({})
 
         @r.route("POST", "/admin/batch_delete")
@@ -1138,7 +1152,8 @@ class VolumeServer:
             for url, fids in fanned.items():
                 http_json("POST", f"http://{url}/admin/batch_delete",
                           {"fids": fids, "replicate": True,
-                           "jwts": {f: jwts[f] for f in fids if f in jwts}})
+                           "jwts": {f: jwts[f] for f in fids if f in jwts}},
+                               timeout=30.0)
             return Response({"results": results})
 
         @r.route("GET", "/admin/tail")
@@ -1291,17 +1306,31 @@ class VolumeServer:
             """Launch (or re-launch) the background scan.  Body knobs:
             rate_mb_s (IO cap, 0 unthrottled), interval_s (0 = one
             pass then stop, >0 = loop), backfill (compute sidecars for
-            pre-sidecar shard sets)."""
+            pre-sidecar shard sets), volume_id (targeted one-pass
+            verification of just that volume — the coordinator's
+            post-repair re-scrub; the pass adopts THIS request's trace
+            context, so the verdict flip journals under the repair)."""
             try:
                 b = req.json()
             except Exception:
                 b = {}
+            vid = b.get("volume_id")
+            try:
+                vid = int(vid) if vid is not None else None
+            except (TypeError, ValueError):
+                raise HttpError(400, f"bad volume_id {vid!r}")
+            ctx = None
+            if vid is not None:
+                from ..observability import context as _trace_context
+
+                ctx = _trace_context.fork_for_thread()
             started = self.scrubber.start(
                 rate_mb_s=(float(b["rate_mb_s"])
                            if "rate_mb_s" in b else None),
                 interval_s=(float(b["interval_s"])
                             if "interval_s" in b else None),
-                backfill=(bool(b["backfill"]) if "backfill" in b else None))
+                backfill=(bool(b["backfill"]) if "backfill" in b else None),
+                volume_id=vid, ctx=ctx)
             return Response({"started": started, **self.scrubber.status()})
 
         @r.route("POST", "/ec/scrub/stop")

@@ -119,7 +119,9 @@ class TestAggregatorUnit:
         assert agg.health()["peers"]["x:1"]["pipeline_health"] == {
             "worker_restarts": 0, "engine_fallbacks": 0,
             "degraded_binds": 0, "corrupt_shards": 0, "scrub_repairs": 0,
-            "ec_under_replicated": 0, "coordinator_repair_failures": 0}
+            "ec_under_replicated": 0, "coordinator_repair_failures": 0,
+            "requests_shed": 0, "deadline_exceeded": 0,
+            "retry_budget_exhausted": 0}
 
     def test_unregistered_peer_drops_out(self):
         peers = ["a:1", "b:2"]
@@ -215,6 +217,9 @@ class TestClusterEndpoints:
                                       "scrub_repairs",
                                       "ec_under_replicated",
                                       "coordinator_repair_failures",
+                                      "requests_shed",
+                                      "deadline_exceeded",
+                                      "retry_budget_exhausted",
                                       "scrub_unrepairable"}
         # the scrub verdict rollup rides the same scrape (PR 6): idle
         # scrubbers report not-running with zero verdicts

@@ -576,3 +576,94 @@ def test_shell_commands_registered():
     for name in ("coordinator.status", "coordinator.pause",
                  "coordinator.resume"):
         assert name in COMMANDS
+
+
+class TestPostRepairRescrub:
+    def test_executor_rescrub_posts_targeted_scan_to_holders(self):
+        v = _spread_view(n_nodes=4, racks=4, missing=(13,))
+        t = FakeTransport()
+        started = PlanExecutor(post_fn=t).rescrub(v, 1)
+        posts = t.of("/ec/scrub/start")
+        holders = {u for us in v.shards[1].values() for u in us}
+        assert set(started) == holders
+        assert {p[0] for p in posts} == holders
+        for _srv, _path, payload in posts:
+            assert payload["volume_id"] == 1
+            # NO knob overrides: start() persists any rate/interval it
+            # receives, and a 0 here would unthrottle the holder's
+            # configured scrub IO cap permanently
+            assert "rate_mb_s" not in payload
+
+    def test_repair_done_carries_rescrubbed_holders(self):
+        """The coordinator's post-repair re-scrub: a successful repair
+        immediately targets every holder of the healed volume, so a
+        stale `unrepairable` verdict clears without waiting for the
+        next full pass — and the repair_done event records who was
+        asked."""
+        topo, _ = _topo_with_volume(missing=(13,))
+        t = FakeTransport()
+        t.rebuilt = [13]
+        c = EcCoordinator(topo=topo, server="m:1", post_fn=t,
+                          interval_s=999.0)
+        c.run_cycle()
+        assert c.status()["repairs"]["done"] == 1
+        posts = t.of("/ec/scrub/start")
+        assert posts, "no targeted re-scrub after a successful repair"
+        assert all(p[2]["volume_id"] == 1 for p in posts)
+        from seaweedfs_tpu.observability import events as _events
+
+        done = _events.get_journal().query(type_="repair_done", limit=5)
+        assert done and done[-1]["details"]["rescrubbed"]
+
+    def test_rescrub_failure_never_fails_the_repair(self):
+        topo, _ = _topo_with_volume(missing=(13,))
+        t = FakeTransport()
+        t.rebuilt = [13]
+
+        class Flaky(FakeTransport):
+            def __call__(self, server, path, payload, timeout=600.0):
+                if path == "/ec/scrub/start":
+                    raise OSError("scrubber busy")
+                return FakeTransport.__call__(self, server, path,
+                                              payload, timeout)
+
+        f = Flaky()
+        f.rebuilt = [13]
+        c = EcCoordinator(topo=topo, server="m:1", post_fn=f,
+                          interval_s=999.0)
+        c.run_cycle()
+        st = c.status()
+        assert st["repairs"]["done"] == 1 and not st["repairs"]["failed"]
+
+
+class TestRepairRetryBudget:
+    def test_reattempts_draw_from_retry_budget(self):
+        """With the per-destination budget drained, a failing repair's
+        RE-attempts are denied (single attempt total until the bucket
+        refills) and the denial is journaled."""
+        from seaweedfs_tpu.utils import backoff as _backoff
+
+        topo, urls = _topo_with_volume(missing=(13,))
+        t = FakeTransport()
+        for u in urls:
+            t.fail[(u, "/admin/ec/rebuild")] = OSError("wedged")
+        prev = _backoff._GLOBAL
+        _backoff._GLOBAL = _backoff.RetryBudget(rate=0.0, burst=0.0)
+        try:
+            c = EcCoordinator(topo=topo, server="m:1", post_fn=t,
+                              interval_s=0.0)
+            c.run_cycle()  # first attempt: not a retry, always allowed
+            assert c.status()["repairs"]["failed"] == 1
+            # backoff hold is interval_s*2^attempts = 0 — only the
+            # budget stands between us and a retry storm
+            c.run_cycle()
+            c.run_cycle()
+            assert c.status()["repairs"]["failed"] == 1, \
+                "drained budget did not stop repair re-attempts"
+            from seaweedfs_tpu.observability import events as _events
+
+            evs = _events.get_journal().query(
+                type_="retry_budget_exhausted", limit=5)
+            assert evs and evs[-1]["details"]["kind"] == "coordinator"
+        finally:
+            _backoff._GLOBAL = prev

@@ -1,0 +1,336 @@
+"""Deadline propagation, retry budgets & load shedding (tier-1).
+
+Gates the graceful-degradation plane this PR introduces:
+
+  - X-Weed-Deadline parse/inject/clamp semantics (unit);
+  - a server answers an exhausted budget 504 BEFORE dispatch, counts
+    it, and journals a deadline_exceeded event;
+  - the budget propagates across a proxy hop and the end-to-end call
+    NEVER outlives it — probed with the net.delay fault point, whose
+    deadline-aware egress sleep returns the caller on time;
+  - retry budgets: a drained per-destination token bucket degrades
+    http_json_retry to a single attempt with a retry_budget_exhausted
+    event + counter;
+  - load shedding: over-the-bound requests are answered 503 FAST while
+    admitted ones complete, sheds are counted + journaled, and
+    operator routes stay exempt.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from seaweedfs_tpu.observability import events as _events
+from seaweedfs_tpu.stats import request_plane_metrics
+from seaweedfs_tpu.utils import backoff as _backoff
+from seaweedfs_tpu.utils import deadline
+from seaweedfs_tpu.utils import faultinject as fi
+from seaweedfs_tpu.utils.admission import AdmissionController
+from seaweedfs_tpu.utils.httpd import (HttpError, Response, Router,
+                                       http_bytes, http_json,
+                                       http_json_retry, serve,
+                                       stop_server)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    fi.clear()
+    yield
+    fi.clear()
+
+
+@pytest.fixture()
+def server():
+    """One Router server with a slow route, a fast route, and a proxy
+    route that calls another URL through the pooled egress."""
+    router = Router("volume")
+    calls = {"n": 0}
+
+    @router.route("GET", "/fast")
+    def fast(req):
+        calls["n"] += 1
+        return Response({"ok": True})
+
+    @router.route("GET", "/slow")
+    def slow(req):
+        time.sleep(float(req.query.get("s", "0.3")))
+        return Response({"ok": True})
+
+    @router.route("GET", "/proxy")
+    def proxy(req):
+        # downstream hop through the traced+budgeted egress
+        return Response(http_json("GET", req.query["url"], timeout=30.0))
+
+    @router.route("GET", "/flaky503")
+    def flaky(req):
+        calls["n"] += 1
+        raise HttpError(503, "try again")
+
+    @router.route("GET", "/status")
+    def status(req):
+        return Response({"up": True})
+
+    srv = serve(router, "127.0.0.1", 0)
+    url = f"127.0.0.1:{srv.server_address[1]}"
+    yield router, url, calls
+    stop_server(srv)
+
+
+# --- unit: header + clamp semantics -----------------------------------------
+
+class TestDeadlineUnit:
+    def test_parse_round_trip(self):
+        with deadline.scope(1.5):
+            hdrs = deadline.inject_deadline_headers({})
+            budget = float(hdrs[deadline.DEADLINE_HEADER])
+            assert 1.0 < budget <= 1.5
+            ddl = deadline.parse_deadline(hdrs[deadline.DEADLINE_HEADER])
+            assert 0.5 < ddl.remaining() <= 1.5
+
+    @pytest.mark.parametrize("raw", ["", None, "abc", "nan", "inf",
+                                     "-inf", "1.2.3"])
+    def test_malformed_headers_ignored(self, raw):
+        assert deadline.parse_deadline(raw) is None
+
+    def test_non_positive_budget_parses_expired(self):
+        ddl = deadline.parse_deadline("-2")
+        assert ddl is not None and ddl.expired()
+
+    def test_clamp_and_expiry(self):
+        assert deadline.clamp(30.0) == 30.0  # no deadline: untouched
+        with deadline.scope(0.5):
+            assert deadline.clamp(30.0) <= 0.5
+            assert deadline.clamp(0.1) <= 0.1
+        with deadline.scope(0.001):
+            time.sleep(0.01)
+            with pytest.raises(deadline.DeadlineExceeded):
+                deadline.clamp(30.0)
+
+    def test_scope_restores_and_nests(self):
+        assert deadline.current() is None
+        with deadline.scope(5.0) as outer:
+            assert deadline.current() is outer
+            with deadline.scope(1.0):
+                assert deadline.current() is not outer
+            assert deadline.current() is outer
+        assert deadline.current() is None
+
+    def test_sleep_within_clips_to_budget(self):
+        with deadline.scope(0.15):
+            t0 = time.monotonic()
+            with pytest.raises(deadline.DeadlineExceeded):
+                deadline.sleep_within(5.0)
+            assert time.monotonic() - t0 < 1.0
+
+
+# --- server-side: 504 before dispatch + during handler ----------------------
+
+class TestDeadline504:
+    def test_expired_budget_answers_504_before_dispatch(self, server):
+        _router, url, calls = server
+        before = calls["n"]
+        c0 = sum(request_plane_metrics()
+                 .deadline_exceeded.snapshot().values())
+        st, body, _ = http_bytes(
+            "GET", f"http://{url}/fast",
+            headers={deadline.DEADLINE_HEADER: "-1"}, timeout=5.0)
+        assert st == 504
+        assert calls["n"] == before  # the handler never ran
+        assert sum(request_plane_metrics()
+                   .deadline_exceeded.snapshot().values()) == c0 + 1
+        evs = _events.get_journal().query(type_="deadline_exceeded",
+                                          limit=5)
+        assert evs and evs[-1]["details"]["role"] == "volume"
+
+    def test_proxy_hop_maps_downstream_exhaustion_to_504(self, server):
+        """Client budget 0.5s -> proxy -> 2s-slow downstream: the
+        proxy's egress clamp fires and the caller gets 504 within the
+        budget, not after the downstream's 2 seconds."""
+        _router, url, _calls = server
+        t0 = time.monotonic()
+        st, _body, _ = http_bytes(
+            "GET", f"http://{url}/proxy?url="
+                   f"http://{url}/slow%3Fs%3D2",
+            headers={deadline.DEADLINE_HEADER: "0.5"}, timeout=10.0)
+        wall = time.monotonic() - t0
+        assert st == 504
+        assert wall < 1.5, f"504 took {wall:.2f}s — outlived the budget"
+
+    def test_never_hangs_past_budget_with_net_delay(self, server):
+        """The issue's probe: a 3s net.delay on the wire, a 0.4s
+        budget — the call returns (DeadlineExceeded) within the
+        budget, never after the full delay."""
+        _router, url, _calls = server
+        fi.enable("net.delay", delay=3.0, params={"peer": url})
+        t0 = time.monotonic()
+        with deadline.scope(0.4):
+            with pytest.raises(deadline.DeadlineExceeded):
+                http_json("GET", f"http://{url}/fast", timeout=10.0)
+        wall = time.monotonic() - t0
+        assert wall < 1.0, f"returned after {wall:.2f}s > budget"
+        assert fi.fired("net.delay") == 1
+
+
+# --- peer-scoped network fault points ---------------------------------------
+
+class TestNetFaultPoints:
+    def test_net_partition_scoped_to_one_peer(self, server):
+        _router, url, _calls = server
+        fi.enable("net.partition", error_rate=1.0,
+                  params={"peer": "10.9.9.9:1"})
+        # other peers unaffected
+        assert http_json("GET", f"http://{url}/fast",
+                         timeout=5.0)["ok"] is True
+        fi.enable("net.partition", error_rate=1.0, params={"peer": url})
+        with pytest.raises(HttpError) as ei:
+            http_json("GET", f"http://{url}/fast", timeout=5.0)
+        assert ei.value.status == 503  # unreachable
+        assert fi.fired("net.partition") == 1
+
+    def test_net_drop_probabilistic_loss(self, server):
+        _router, url, _calls = server
+        fi.enable("net.drop", error_rate=1.0, params={"peer": url})
+        st, _b, _h = http_bytes("GET", f"http://{url}/fast",
+                                timeout=5.0)
+        assert st == 0 and fi.fired("net.drop") == 1
+        fi.disable("net.drop")
+        st, _b, _h = http_bytes("GET", f"http://{url}/fast",
+                                timeout=5.0)
+        assert st == 200
+
+    def test_net_delay_unscoped_applies_to_all_peers(self, server):
+        _router, url, _calls = server
+        fi.enable("net.delay", delay=0.15)  # no peer param = every peer
+        t0 = time.monotonic()
+        assert http_json("GET", f"http://{url}/fast",
+                         timeout=5.0)["ok"] is True
+        assert time.monotonic() - t0 >= 0.15
+        assert fi.fired("net.delay") == 1
+
+
+# --- retry budgets ----------------------------------------------------------
+
+class TestRetryBudget:
+    def test_token_bucket_drains_and_refills(self):
+        b = _backoff.RetryBudget(rate=10.0, burst=2.0)
+        assert b.allow("peer") and b.allow("peer")
+        assert not b.allow("peer")  # burst spent
+        time.sleep(0.12)  # rate 10/s refills >1 token
+        assert b.allow("peer")
+        # destinations are independent buckets
+        assert b.allow("other")
+
+    def test_exhaustion_degrades_to_single_attempt_with_event(
+            self, server):
+        _router, url, calls = server
+        prev = _backoff._GLOBAL
+        _backoff._GLOBAL = _backoff.RetryBudget(rate=0.0, burst=2.0)
+        try:
+            c0 = sum(request_plane_metrics()
+                     .retry_budget_exhausted.snapshot().values())
+            # first call: 1 attempt + 2 budgeted retries
+            calls["n"] = 0
+            with pytest.raises(HttpError):
+                http_json_retry("GET", f"http://{url}/flaky503",
+                                timeout=5.0, attempts=3)
+            assert calls["n"] == 3
+            # bucket empty: the next call degrades to ONE attempt
+            calls["n"] = 0
+            with pytest.raises(HttpError):
+                http_json_retry("GET", f"http://{url}/flaky503",
+                                timeout=5.0, attempts=3)
+            assert calls["n"] == 1
+            assert sum(request_plane_metrics()
+                       .retry_budget_exhausted.snapshot().values()) > c0
+            evs = _events.get_journal().query(
+                type_="retry_budget_exhausted", limit=5)
+            assert evs and evs[-1]["details"]["dest"] == url
+        finally:
+            _backoff._GLOBAL = prev
+
+    def test_non_idempotent_methods_never_retry(self, server):
+        _router, url, calls = server
+        calls["n"] = 0
+        with pytest.raises(HttpError):
+            http_json_retry("POST", f"http://{url}/flaky503",
+                            timeout=5.0, attempts=3)
+        # POST /flaky503 is a 404 (route is GET) — but even a 503'ing
+        # POST must not resend: probe via GET-registered route name
+        assert calls["n"] == 0
+
+    def test_non_503_answers_never_retry(self, server):
+        _router, url, calls = server
+        calls["n"] = 0
+        with pytest.raises(HttpError) as ei:
+            http_json_retry("GET", f"http://{url}/nope", timeout=5.0,
+                            attempts=3)
+        assert ei.value.status == 404 and calls["n"] == 0
+
+
+# --- load shedding ----------------------------------------------------------
+
+class TestLoadShed:
+    def test_shed_answers_fast_while_admitted_complete(self, server):
+        """The drill: bound 2 in flight, 8 concurrent 0.4s requests.
+        Sheds come back in milliseconds with 503 + Retry-After;
+        admitted ones succeed; the shed is counted and journaled."""
+        router, url, _calls = server
+        router.admission = AdmissionController(2, role="volume")
+        s0 = sum(request_plane_metrics().shed.snapshot().values())
+        results: list[tuple[int, float]] = []
+        lock = threading.Lock()
+
+        def call():
+            t0 = time.monotonic()
+            st, _b, h = http_bytes("GET", f"http://{url}/slow?s=0.4",
+                                   timeout=10.0)
+            with lock:
+                results.append((st, time.monotonic() - t0,
+                                h.get("Retry-After")))
+
+        threads = [threading.Thread(target=call) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=15)
+        statuses = sorted(st for st, _w, _r in results)
+        assert statuses.count(200) >= 2
+        assert statuses.count(503) >= 1
+        for st, wall, retry_after in results:
+            if st == 503:
+                assert wall < 0.25, f"shed took {wall:.2f}s — not fast"
+                assert retry_after == "1"
+            elif st == 200:
+                assert wall >= 0.35  # really did the work
+        shed = sum(request_plane_metrics().shed.snapshot().values())
+        assert shed - s0 == statuses.count(503)
+        evs = _events.get_journal().query(type_="load_shed", limit=5)
+        assert evs and evs[-1]["details"]["max_inflight"] == 2
+        router.admission = None
+
+    def test_exempt_routes_never_shed(self, server):
+        router, url, _calls = server
+        ctl = AdmissionController(1, role="volume")
+        router.admission = ctl
+        # saturate the one slot
+        t = threading.Thread(target=lambda: http_bytes(
+            "GET", f"http://{url}/slow?s=0.5", timeout=10.0))
+        t.start()
+        time.sleep(0.1)
+        # /status is exempt by prefix: still answered 200 while full
+        st, _b, _h = http_bytes("GET", f"http://{url}/status",
+                                timeout=5.0)
+        assert st == 200
+        t.join(timeout=10)
+        assert ctl.snapshot()["inflight"] == 0  # released
+        router.admission = None
+
+    def test_disabled_admission_costs_nothing(self, server):
+        router, url, _calls = server
+        assert router.admission is None
+        st, _b, _h = http_bytes("GET", f"http://{url}/fast",
+                                timeout=5.0)
+        assert st == 200

@@ -637,3 +637,55 @@ def test_scrub_routes_and_cluster_health(tmp_path):
     finally:
         vs.stop()
         master.stop()
+
+
+def test_targeted_rescrub_clears_stale_unrepairable_verdict(tmp_path):
+    """The coordinator's post-repair follow-up: a volume scrubbed
+    UNREPAIRABLE (> r rotted shards), then healed out of band (the
+    cross-server repair restoring clean shards), re-verifies via a
+    TARGETED one-pass scan — start(volume_id=vid) — and the stale
+    verdict flips to clean immediately instead of waiting for the
+    next full pass."""
+    import shutil
+    import time as _time
+
+    from seaweedfs_tpu.volume_server.scrubber import EcScrubber
+
+    store, base = _store_with_ec_volume(tmp_path)
+    try:
+        clean_copies = {sid: open(base + to_ext(sid), "rb").read()
+                        for sid in range(14)}
+        for sid in (0, 1, 2, 3, 4):
+            _flip(base + to_ext(sid), 300)
+        scrub = EcScrubber(store, rate_mb_s=0)
+        st = scrub.run_pass()
+        assert st["verdicts"]["1"]["status"] == "unrepairable"
+        # out-of-band heal (what the coordinator's cross-server repair
+        # does): clean shard files land back on disk, remount
+        for sid in (0, 1, 2, 3, 4):
+            bad = base + to_ext(sid) + ".bad"
+            if os.path.exists(bad):
+                os.remove(bad)
+            with open(base + to_ext(sid), "wb") as f:
+                f.write(clean_copies[sid])
+        store.ec_unmount(1)
+        store.ec_mount(1)
+        # targeted re-scrub: one pass over JUST volume 1.  Wait on the
+        # PASS COUNTER, not the running flag — the scan thread sets
+        # running=True asynchronously, so polling the flag right after
+        # start() can observe the pre-start False and read the stale
+        # verdict before the scan ever ran.
+        p0 = scrub.status()["passes"]
+        assert scrub.start(volume_id=1) is True
+        deadline = _time.time() + 10
+        while _time.time() < deadline and \
+                scrub.status()["passes"] == p0:
+            _time.sleep(0.05)
+        st = scrub.status()
+        assert st["passes"] == p0 + 1
+        assert st["verdicts"]["1"]["status"] == "clean"
+        # the targeted marker cleared: the next start is a full scan
+        assert scrub.only_vid is None
+        shutil.rmtree(str(tmp_path), ignore_errors=True)
+    finally:
+        store.close()

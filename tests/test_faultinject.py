@@ -168,3 +168,43 @@ def test_coord_plan_fault_is_contained_by_the_loop():
     finally:
         fi.clear()
         c.stop()
+
+
+# --- peer-scoped network fault points (net.delay / net.drop / ---------------
+# net.partition): the scenario engine's wire
+
+
+def test_net_partition_peer_scoping_unit():
+    """hit_peer fires only for the armed peer; params-less arming
+    covers every peer."""
+    fi.enable("net.partition", error_rate=1.0,
+              params={"peer": "h1:80"})
+    fi.hit_peer("net.partition", "h2:80")  # other peer: no-op
+    assert fi.fired("net.partition") == 0
+    with pytest.raises(OSError):
+        fi.hit_peer("net.partition", "h1:80")
+    assert fi.fired("net.partition") == 1
+    fi.enable("net.partition", error_rate=1.0)  # unscoped
+    with pytest.raises(OSError):
+        fi.hit_peer("net.partition", "anyone:1")
+
+
+def test_net_drop_error_rate_and_max_hits():
+    fi.enable("net.drop", error_rate=1.0, max_hits=1,
+              params={"peer": "h1:80"})
+    with pytest.raises(OSError):
+        fi.hit_peer("net.drop", "h1:80")
+    fi.hit_peer("net.drop", "h1:80")  # max_hits spent: passes
+    assert fi.fired("net.drop") == 1
+
+
+def test_net_delay_query_counts_without_sleeping():
+    """peer_delay returns the armed delay (counting the hit) instead
+    of sleeping, so the egress can apply it deadline-aware."""
+    fi.enable("net.delay", delay=7.5, params={"peer": "h1:80"})
+    assert fi.peer_delay("net.delay", "h2:80") == 0.0
+    assert fi.fired("net.delay") == 0
+    assert fi.peer_delay("net.delay", "h1:80") == 7.5
+    assert fi.fired("net.delay") == 1
+    fi.disable("net.delay")
+    assert fi.peer_delay("net.delay", "h1:80") == 0.0

@@ -1,0 +1,133 @@
+"""Scenario harness (seaweedfs_tpu/scenarios) — tier-1.
+
+Gates: the workload samplers have the distributions they claim, specs
+round-trip, a live read scenario produces the full verdicted result
+document with zero deadline violations, and a live failure-under-load
+mini-drill degrades the partitioned fraction while the healthy
+fraction keeps serving — the bench `scenarios` section's contract in
+miniature.
+"""
+
+from __future__ import annotations
+
+import random
+
+import pytest
+
+from seaweedfs_tpu.scenarios import (FaultSpec, ScenarioSpec, SizeSampler,
+                                     ZipfSampler, default_scenarios,
+                                     run_scenario)
+from seaweedfs_tpu.scenarios.workload import payload_for, pick_op
+from seaweedfs_tpu.utils import faultinject as fi
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    fi.clear()
+    yield
+    fi.clear()
+
+
+class TestWorkload:
+    def test_zipf_rank0_hottest_and_skew_orders(self):
+        rng = random.Random(7)
+        z = ZipfSampler(64, 1.2)
+        counts = [0] * 64
+        for _ in range(20000):
+            counts[z.sample(rng)] += 1
+        assert counts[0] == max(counts)
+        assert counts[0] > 4 * counts[32]
+        # pmf is monotone non-increasing in rank
+        pmf = [z.pmf(r) for r in range(64)]
+        assert all(a >= b for a, b in zip(pmf, pmf[1:]))
+        assert abs(sum(pmf) - 1.0) < 1e-9
+
+    def test_zipf_never_out_of_range(self):
+        rng = random.Random(1)
+        z = ZipfSampler(5, 1.0)
+        assert all(0 <= z.sample(rng) < 5 for _ in range(2000))
+
+    def test_size_sampler_respects_weights(self):
+        rng = random.Random(3)
+        s = SizeSampler(((4096, 0.9), (1 << 20, 0.1)))
+        got = [s.sample(rng) for _ in range(5000)]
+        small = sum(1 for b in got if b == 4096)
+        assert 0.82 < small / len(got) < 0.97
+
+    def test_pick_op_mix(self):
+        rng = random.Random(5)
+        ops = [pick_op(rng, 0.7, 0.5) for _ in range(8000)]
+        reads = ops.count("read") / len(ops)
+        assert 0.65 < reads < 0.75
+        writes, deletes = ops.count("write"), ops.count("delete")
+        assert writes and deletes
+
+    def test_payload_distinct_and_sized(self):
+        assert len(payload_for(4096, 3)) == 4096
+        assert payload_for(16, 1) != payload_for(16, 2)
+
+
+class TestSpec:
+    def test_round_trip(self):
+        spec = default_scenarios()[-1]
+        doc = spec.to_dict()
+        back = ScenarioSpec.from_dict(doc)
+        assert back == spec
+
+    def test_defaults_cover_the_three_canonical_shapes(self):
+        names = [s.name for s in default_scenarios()]
+        assert names == ["read_storm", "write_churn",
+                         "failure_under_load"]
+        fail = default_scenarios()[-1]
+        assert fail.faults and fail.faults[0].point == "net.partition"
+        assert fail.expectations["fault_rps_ratio_min"] >= 0.6
+
+
+class TestLiveScenario:
+    def test_read_scenario_result_document(self, tmp_path):
+        spec = ScenarioSpec(name="mini_read", duration_s=2.5, clients=4,
+                            hot_set=16, zipf_s=1.1, deadline_s=2.0,
+                            expectations={
+                                "max_error_ratio": 0.02,
+                                "deadline_overrun_max_ms": 250.0})
+        res = run_scenario(spec, base_dir=str(tmp_path))
+        assert res["verdict"] == "pass", res["checks"]
+        r = res["routes"]["read"]
+        assert r["ops"] > 50 and r["error_ratio"] <= 0.02
+        assert r["p99_ms"] > 0
+        assert res["deadline"]["violations"] == 0
+        assert res["phases"]["healthy"]["ok_rps"] > 0
+        assert set(res["counters"]) == {"requests_shed",
+                                        "deadline_exceeded",
+                                        "retry_budget_exhausted"}
+        # spec echo rides the document so bench JSON is self-describing
+        assert res["spec"]["name"] == "mini_read"
+
+    def test_failure_under_load_mini_drill(self, tmp_path):
+        """3 servers, the middle third partitioned: the partitioned
+        fraction fails FAST (errors, not stalls), the healthy fraction
+        keeps serving, nothing outlives its deadline, and the fault
+        timeline + alert record land in the document."""
+        spec = ScenarioSpec(
+            name="mini_fail", duration_s=7.5, clients=4,
+            n_volume_servers=3, read_fraction=0.85,
+            submit_fraction=0.5, hot_set=36, zipf_s=1.0,
+            deadline_s=2.0, max_inflight=64,
+            faults=(FaultSpec(point="net.partition", at_frac=1 / 3,
+                              clear_frac=2 / 3, peer="vs0"),),
+            expectations={"deadline_overrun_max_ms": 250.0})
+        res = run_scenario(spec, base_dir=str(tmp_path))
+        actions = [f["action"] for f in res["faults"]]
+        assert actions == ["arm", "clear"]
+        ph = res["phases"]
+        assert set(ph) == {"healthy", "fault", "recovery"}
+        # the partition hurt: mid-run errors appeared...
+        assert ph["fault"]["error_ratio"] > 0.02
+        # ...but the healthy fraction kept serving at real throughput
+        assert ph["fault"]["ok_rps"] > 0.3 * ph["healthy"]["ok_rps"]
+        # and recovered after the clear
+        assert ph["recovery"]["error_ratio"] < ph["fault"]["error_ratio"]
+        # fail-fast, never hang: nothing outlived deadline + 250ms
+        assert res["deadline"]["violations"] == 0
+        assert res["verdict"] == "pass", res["checks"]
+        assert "alerts" in res and "timeline" in res["alerts"]

@@ -50,6 +50,8 @@ from tools.weedlint.rules_resources import \
     check_module_source as check_resources  # noqa: E402
 from tools.weedlint.rules_routes import \
     check_module_source as check_routes  # noqa: E402
+from tools.weedlint.rules_timeouts import \
+    check_source as check_timeouts  # noqa: E402
 
 # --- planted sources, one clean/bad pair per single-module rule -------------
 
@@ -162,6 +164,17 @@ W801_BAD = (
     "    fh = open(path, 'rb')\n"
     "    return fh.read()\n")
 
+W901_CLEAN = (
+    "def f(url):\n"
+    "    a = http_json('GET', url, timeout=10.0)\n"
+    "    b = http_bytes('GET', url, None, None, 5.0)\n"
+    "    c = urlopen(url, timeout=3.0)\n"
+    "    d = socket.create_connection(('h', 1), 2.0)\n"
+    "    return a, b, c, d\n")
+W901_BAD = (
+    "def f(url):\n"
+    "    return http_json('GET', url)\n")
+
 CASES = [
     ("W101", "x = 1\n", "import tomllib\n",
      lambda src: rules_py310.check_source(src, "t.py")),
@@ -181,6 +194,8 @@ CASES = [
      lambda src: check_routes(src, "t.py")),
     ("W801", W801_CLEAN, W801_BAD,
      lambda src: check_resources(src, "t.py")),
+    ("W901", W901_CLEAN, W901_BAD,
+     lambda src: check_timeouts(src, "t.py")),
 ]
 
 
@@ -783,7 +798,7 @@ class TestEngine:
             capture_output=True, text=True, cwd=REPO, timeout=120)
         assert p.returncode == 0
         for rid in ("W101", "W201", "W301", "W401", "W501", "W502",
-                    "W601", "W701", "W801"):
+                    "W601", "W701", "W801", "W901"):
             assert rid in p.stdout
 
     def test_cli_unknown_rule_exits_2(self):

@@ -272,7 +272,7 @@ def _load_builtin_rules() -> None:
     from . import (rules_async_drain, rules_blocking,  # noqa: F401
                    rules_faults, rules_health_keys, rules_lockorder,
                    rules_lockset, rules_py310, rules_resources,
-                   rules_routes, rules_tracing)
+                   rules_routes, rules_timeouts, rules_tracing)
 
 
 # --- waivers -----------------------------------------------------------------

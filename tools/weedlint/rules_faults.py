@@ -52,7 +52,10 @@ def load_registry(src: str) -> tuple[dict[str, int], int]:
 
 
 def hit_sites(src: str, path: str, tree=None) -> list[tuple[str, int]]:
-    """(fault name, lineno) for every hit()/corrupt_block() literal."""
+    """(fault name, lineno) for every hit()/hit_peer()/peer_delay()/
+    corrupt_block() literal — the full instrumented-site API of
+    utils/faultinject.py (hit_peer and peer_delay are the peer-scoped
+    net.* variants)."""
     if tree is None:
         try:
             tree = ast.parse(src, filename=path)
@@ -65,7 +68,8 @@ def hit_sites(src: str, path: str, tree=None) -> list[tuple[str, int]]:
         f = node.func
         name = f.id if isinstance(f, ast.Name) else (
             f.attr if isinstance(f, ast.Attribute) else "")
-        if name in ("hit", "corrupt_block") and node.args and \
+        if name in ("hit", "hit_peer", "peer_delay", "corrupt_block") \
+                and node.args and \
                 isinstance(node.args[0], ast.Constant) and \
                 isinstance(node.args[0].value, str):
             out.append((node.args[0].value, node.lineno))

@@ -28,9 +28,15 @@ EVENTS_REL = os.path.join("seaweedfs_tpu", "observability", "events.py")
 # make a pipeline MEASUREMENT degraded.  The coordinator keys are
 # cluster-topology conditions (volumes short of k+1 clean shards,
 # master-side repair plans failing): alertable, never an attribute of
-# one encode/read run's measurement.
+# one encode/read run's measurement.  The request-plane keys
+# (requests_shed / deadline_exceeded / retry_budget_exhausted) are
+# load conditions on the serving plane — they page through their
+# counter rules and the burn-rate SLOs, but an encode run does not
+# become a degraded MEASUREMENT because some other client got shed.
 DEGRADE_KEY_ALLOWLIST = ("degraded_binds", "ec_under_replicated",
-                         "coordinator_repair_failures")
+                         "coordinator_repair_failures",
+                         "requests_shed", "deadline_exceeded",
+                         "retry_budget_exhausted")
 
 # DEGRADE_COUNTER_KEYS entries that are per-run encode stats rather
 # than cluster counter families.

@@ -1,0 +1,105 @@
+"""W901: every outbound call must carry an explicit timeout/deadline.
+
+The deadline plane (utils/deadline.py) clamps every egress to the
+caller's remaining budget — but only requests that HAVE a budget.  A
+call site that leans on a helper's implicit default is a site where
+nobody decided how long a hung peer may pin this thread: the default
+silently changes under it, and the one call that mattered during an
+incident turns out to have been willing to wait an hour.  This rule
+makes the bound a visible, reviewed decision at EVERY egress site.
+
+Checked callables (the same egress-site tables W201/W504 enforce
+tracing and lock discipline against):
+
+  http_json / http_json_retry / http_bytes / http_download /
+  _pooled_request    — the pooled-HTTP chokepoint helpers
+                       (utils/httpd.py);
+  urlopen            — the one raw-HTTP user (W201-waived sites);
+  create_connection  — raw sockets (the framed-TCP plane).
+
+A call passes when it supplies `timeout=` (keyword) or fills the
+helper's positional timeout slot.  Genuinely unbounded calls carry a
+reasoned `# weedlint: disable=W901 <why>` waiver; the baseline stays
+empty — new egress sites must decide their bound on day one.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .engine import Finding, Repo, Rule, register
+
+PACKAGE = "seaweedfs_tpu"
+
+# callable name -> 0-based index of its positional timeout slot
+TIMEOUT_SLOTS = {
+    "http_json": 3,
+    "http_json_retry": 3,
+    "http_bytes": 4,
+    "http_download": 3,
+    "_pooled_request": 4,
+    "urlopen": 1,
+    "create_connection": 1,
+}
+
+
+def _call_name(node: ast.Call) -> str:
+    f = node.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return ""
+
+
+def call_has_timeout(node: ast.Call, slot: int) -> bool:
+    if any(kw.arg == "timeout" for kw in node.keywords):
+        return True
+    if len(node.args) > slot:
+        # the slot is filled positionally — unless by *args, which
+        # cannot be verified statically (treated as missing so the
+        # author writes timeout= explicitly or waives)
+        return not any(isinstance(a, ast.Starred)
+                       for a in node.args[:slot + 1])
+    return False
+
+
+def check_source(src: str, path: str, tree=None) -> list[Finding]:
+    """Timeout-less egress calls in one module."""
+    if tree is None:
+        try:
+            tree = ast.parse(src, filename=path)
+        except SyntaxError:
+            return []  # W101 reports unparseable files
+    out: list[Finding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _call_name(node)
+        slot = TIMEOUT_SLOTS.get(name)
+        if slot is None:
+            continue
+        if not call_has_timeout(node, slot):
+            out.append(Finding(
+                "W901", path, node.lineno,
+                f"outbound call {name}() passes no explicit timeout — "
+                f"nobody decided how long a hung peer may pin this "
+                f"call site",
+                "pass timeout=<seconds> (the deadline plane still "
+                "clamps it to the caller's remaining budget), or "
+                "waive with `# weedlint: disable=W901 <reason>`"))
+    return out
+
+
+@register
+class TimeoutRequiredRule(Rule):
+    id = "W901"
+    name = "timeout-required"
+    summary = ("every outbound call (http helpers, urlopen, raw "
+               "sockets) must pass an explicit timeout or deadline")
+
+    def check(self, repo: Repo) -> list[Finding]:
+        problems: list[Finding] = []
+        for ctx in repo.package_files(PACKAGE):
+            problems.extend(check_source(ctx.source, ctx.rel, ctx.tree))
+        return problems

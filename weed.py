@@ -76,6 +76,7 @@ def cmd_master(args) -> None:
                      peers=peers, mdir=args.mdir,
                      metrics_aggregation_seconds=args.metricsAggregationSeconds,
                      coordinator_seconds=args.coordinatorSeconds,
+                     max_inflight=args.maxInflight,
                      guard=master_guard(_security()),
                      tls_context=_cluster_tls()).start()
     print(f"master listening on {m.url}")
@@ -94,7 +95,8 @@ def cmd_volume(args) -> None:
                       guard=volume_guard(_security()),
                       tls_context=_cluster_tls(),
                       use_mmap=args.mmap,
-                      dataplane=args.dataplane).start()
+                      dataplane=args.dataplane,
+                      max_inflight=args.maxInflight).start()
     print(f"volume server listening on {vs.url}, dirs {args.dir}")
     _on_interrupt(vs.stop)
     _wait_forever()
@@ -216,6 +218,7 @@ def cmd_filer(args) -> None:
                     guard=filer_guard(_security()),
                     peers=[p for p in args.peers.split(",") if p],
                     notification_queue=_notification_queue(),
+                    max_inflight=args.maxInflight,
                     tls_context=_cluster_tls()).start()
     print(f"filer listening on {f.url}")
     if args.s3:
@@ -250,7 +253,8 @@ def cmd_server(args) -> None:
     vs = VolumeServer(args.dir.split(","), m.url, host=args.ip,
                       port=args.port, ec_engine=args.ec_engine,
                       use_mmap=args.mmap,
-                      dataplane=args.dataplane).start()
+                      dataplane=args.dataplane,
+                      max_inflight=args.maxInflight).start()
     print(f"master on {m.url}, volume server on {vs.url}")
     if args.filer:
         store = SqliteStore(args.dir.split(",")[0] + "/filer.db")
@@ -1099,6 +1103,10 @@ def main(argv=None) -> None:
                    help="comma-separated other master host:ports")
     m.add_argument("-mdir", default="",
                    help="dir for raft state persistence (-resumeState)")
+    m.add_argument("-maxInflight", type=int, default=0,
+                   help="admission control: shed requests early (503 + "
+                        "Retry-After) beyond this many in flight "
+                        "(0 = off; operator/debug routes exempt)")
     m.add_argument("-metricsAggregationSeconds", type=float, default=0.0,
                    help="scrape registered volume-server /metrics every N "
                         "seconds for /cluster/metrics + /cluster/health, "
@@ -1129,6 +1137,10 @@ def main(argv=None) -> None:
     v.add_argument("-dataplane", default="python",
                    choices=["python", "native"],
                    help="native: C++ GIL-free framed-TCP needle IO")
+    v.add_argument("-maxInflight", type=int, default=0,
+                   help="admission control: shed object requests early "
+                        "(503 + Retry-After) beyond this many in "
+                        "flight (0 = off)")
     v.set_defaults(fn=cmd_volume)
 
     s = sub.add_parser("server")
@@ -1153,6 +1165,10 @@ def main(argv=None) -> None:
     s.add_argument("-dataplane", default="python",
                    choices=["python", "native"],
                    help="native: C++ GIL-free framed-TCP needle IO")
+    s.add_argument("-maxInflight", type=int, default=0,
+                   help="admission control on the volume server: shed "
+                        "object requests early beyond this many in "
+                        "flight (0 = off)")
     s.set_defaults(fn=cmd_server)
 
     fl = sub.add_parser("filer")
@@ -1178,6 +1194,10 @@ def main(argv=None) -> None:
     fl.add_argument("-peers", default="",
                     help="other filer host:ports to aggregate meta from")
     fl.add_argument("-maxMB", type=int, default=8)
+    fl.add_argument("-maxInflight", type=int, default=0,
+                    help="admission control: shed requests early (503 "
+                         "+ Retry-After) beyond this many in flight "
+                         "(0 = off)")
     fl.add_argument("-cacheDir", default="",
                     help="directory for the on-disk chunk cache tier")
     fl.add_argument("-cacheSizeMB", type=int, default=64,
