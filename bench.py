@@ -56,10 +56,30 @@ SECTION_CAPS = {
     "e2e_decode_8gb": 420, "roofline": 90, "cluster": 360,
     "cluster_traced": 300, "alerts": 420, "coordinator": 420,
     "cluster_native": 360, "cluster_scaled": 420, "parity": 120,
-    "integrity": 120, "scenarios": 300, "pipeline_health": 15,
+    "integrity": 120, "scenarios": 300, "capacity": 420,
+    "pipeline_health": 15,
 }
 SECTION_CAP_DEFAULT = 300
 SECTION_MIN_S = 15          # least useful remaining budget to even start
+
+# bumped whenever the emitted JSON's keys change shape incompatibly;
+# tools/bench_diff.py refuses to compare documents across versions
+# instead of misreporting a schema change as a perf regression
+BENCH_SCHEMA_VERSION = 2
+
+
+def _git_revision() -> str:
+    """Short git revision of the tree this bench ran from (stamped into
+    the JSON so bench_diff can name what it compared); empty when git
+    is unavailable."""
+    try:
+        p = subprocess.run(
+            ["git", "-C", os.path.dirname(os.path.abspath(__file__)),
+             "rev-parse", "--short", "HEAD"],
+            capture_output=True, text=True, timeout=10)
+        return p.stdout.strip() if p.returncode == 0 else ""
+    except Exception:
+        return ""
 
 
 # --------------------------------------------------------------------------
@@ -940,17 +960,21 @@ def _child(scratch_path: str, platform: str = "") -> None:
 
     @contextlib.contextmanager
     def spawn_cluster(n_vols, extra_vol_args=(), trace_sample=None,
-                      extra_master_args=()):
+                      extra_master_args=(), reqlog_sample=None):
         """Master + n_vols volume servers as separate processes; yields
         (master_port, scratch_root) once an assign succeeds.
         trace_sample enables distributed tracing in every server process
-        at that head-sampling rate (the -trace.sample global flag)."""
+        at that head-sampling rate (the -trace.sample global flag);
+        reqlog_sample likewise enables the workload flight recorder
+        (-reqlog.sample) in every server."""
         import urllib.request
 
         root = _tempfile.mkdtemp()
         mport = _free_port()
         globals_ = (["-trace.sample", str(trace_sample)]
                     if trace_sample is not None else [])
+        if reqlog_sample is not None:
+            globals_ += ["-reqlog.sample", str(reqlog_sample)]
         procs = [subprocess.Popen(
             [sys.executable, weed_py, *globals_, "master",
              "-port", str(mport), *extra_master_args],
@@ -1468,6 +1492,87 @@ def _child(scratch_path: str, platform: str = "") -> None:
 
     section("scenarios", meas_scenarios)
 
+    # --- workload recorder overhead + SLO capacity probe -------------------
+    def meas_capacity():
+        """The workload flight-deck numbers (ISSUE 14 acceptance):
+        (a) recorder overhead — read rps with -reqlog.sample 1.0
+        (every request recorded: the worst case) against a
+        recorder-OFF baseline spawned back-to-back in THIS section
+        (the PR-9 alerts-section methodology: a minutes-old baseline
+        sits below spawn noise) — acceptance < 1%; (b) proof the
+        recording pipeline ran end to end (records reached the
+        master's /cluster/workload and spec_from_recording fits them);
+        (c) the SLO capacity probe: binary-searched max sustainable
+        rps for http_read / native_read / http_write under p99 < 5ms
+        and error ratio < 0.1%, with knee point and bounding-resource
+        attribution from a forced stitched trace — the dataplane
+        refactor's acceptance baseline."""
+        import urllib.request
+
+        from seaweedfs_tpu.scenarios.capacity import (CapacitySLO,
+                                                      probe_cluster)
+        from seaweedfs_tpu.scenarios.replay import (recording_profile,
+                                                    spec_from_recording)
+
+        block: dict = {}
+        with spawn_cluster(1) as (mport, _root):
+            base_rates = run_bench(mport, 4000, use_tcp=False)
+        block["baseline_read_rps"] = base_rates.get("read", 0.0)
+        with spawn_cluster(1, reqlog_sample="1.0") as (mport, _root):
+            rates = run_bench(mport, 4000, use_tcp=False)
+            block["reqlog_read_rps"] = rates.get("read", 0.0)
+            base = block["baseline_read_rps"]
+            if base:
+                block["reqlog_read_overhead_pct"] = round(
+                    100.0 * (1.0 - rates.get("read", 0.0) / base), 2)
+            # the recording really flowed: shippers land on the master
+            deadline = time.time() + 8
+            rec = None
+            while time.time() < deadline:
+                try:
+                    with urllib.request.urlopen(
+                            f"http://127.0.0.1:{mport}"
+                            "/cluster/workload/export", timeout=5) as r:
+                        rec = json.loads(r.read())
+                except OSError:
+                    rec = None
+                if rec and rec.get("summary", {}).get("records", 0) > 100:
+                    break
+                time.sleep(0.3)
+            if rec and rec.get("records"):
+                prof = recording_profile(rec)
+                spec = spec_from_recording(rec, name="bench_replay")
+                block["recording"] = {
+                    "records": rec["summary"]["records"],
+                    "read_fraction": prof["read_fraction"],
+                    "zipf_s": prof["zipf_s"],
+                    "sizes": [list(s) for s in prof["sizes"]],
+                    "observed_rps": prof["observed_rps"],
+                    "fitted_target_rps": spec.target_rps,
+                }
+            else:
+                block["error_recording"] = \
+                    "no records reached /cluster/workload"
+        # the probe cluster runs with tracing on (tiny rate: the
+        # forced-sample attribution trace needs a live collector) and
+        # the recorder at a production-shaped 10% sample
+        with spawn_cluster(1, trace_sample="0.001",
+                           reqlog_sample="0.1") as (mport, _root):
+            cap = probe_cluster(
+                f"127.0.0.1:{mport}",
+                routes=("http_read", "native_read", "http_write"),
+                slo=CapacitySLO(max_p99_ms=5.0, max_error_ratio=0.001),
+                start_rps=200.0, max_rps=60000.0, step_s=1.5,
+                preload=64, write_size=1024)
+            for route, res in cap["routes"].items():
+                res.pop("samples", None)  # the curve is bulky; keep
+                # the answer + knee (BASELINE tracks capacity_rps)
+            block["slo"] = cap["slo"]
+            block.update(cap["routes"])
+        detail["capacity"] = block
+
+    section("capacity", meas_capacity)
+
     # --- scaled cluster: N volume servers, M client procs ------------------
     def meas_cluster_scaled():
         """Horizontal capacity on a many-core host: several volume-server
@@ -1757,6 +1862,10 @@ def main() -> None:
             errors.append(f"numpy fallback failed: {type(e).__name__}: {e}")
 
     detail.update(result_detail)
+    # provenance stamp: bench_diff refuses cross-schema comparisons and
+    # names the revisions it compared instead of misreporting
+    detail["schema_version"] = BENCH_SCHEMA_VERSION
+    detail["git_revision"] = _git_revision()
     if errors:
         detail["error"] = "; ".join(errors)[:1000]
 

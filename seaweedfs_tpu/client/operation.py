@@ -121,13 +121,18 @@ class WeedClient:
 
     def upload(self, data: bytes, name: str = "", mime: str = "",
                collection: str = "", replication: str = "",
-               ttl: str = "", compress: Optional[bool] = None) -> str:
+               ttl: str = "", compress: Optional[bool] = None,
+               internal: bool = False) -> str:
         """Assign + PUT; returns the fid.
 
         compress=None sniffs the name/mime the way the reference client
         does (upload_content.go:116, IsCompressableFileType); a gzip win
         is conveyed via Content-Encoding so the volume server sets
-        FLAG_IS_COMPRESSED on the needle."""
+        FLAG_IS_COMPRESSED on the needle.  `internal` marks the PUT as
+        a server-side proxied hop (?type=proxied) so the workload
+        recorder does not double-count it as client traffic — the
+        master's /submit handler (which already recorded the client's
+        request) sets it."""
         import urllib.parse
 
         a = self.master.assign(collection=collection, replication=replication,
@@ -137,6 +142,8 @@ class WeedClient:
             params["name"] = name
         if ttl:
             params["ttl"] = ttl
+        if internal:
+            params["type"] = "proxied"
         q = "?" + urllib.parse.urlencode(params) if params else ""
         headers = {"Content-Type": mime} if mime else {}
         if compress is None and (name or mime):

@@ -204,12 +204,23 @@ class FilerServer:
             get_tracer(), server=self.url,
             master_url_fn=lambda: self.master_url)
         self._trace_shipper.attach()
+        # workload access records ride the same follow-the-masters
+        # transport (observability/reqlog.py): filer requests join the
+        # cluster recording when `workload.record` turns sampling on
+        from ..observability.reqlog import ReqlogShipper, get_recorder
+
+        self._reqlog_shipper = ReqlogShipper(
+            get_recorder(), server=self.url,
+            master_url_fn=lambda: self.master_url)
+        self._reqlog_shipper.attach()
         self.meta_aggregator.start()
         return self
 
     def stop(self) -> None:
         if getattr(self, "_trace_shipper", None) is not None:
             self._trace_shipper.detach()
+        if getattr(self, "_reqlog_shipper", None) is not None:
+            self._reqlog_shipper.detach()
         self.meta_aggregator.stop()
         if self._server:
             from ..utils.httpd import stop_server

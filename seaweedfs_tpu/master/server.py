@@ -100,6 +100,22 @@ class MasterServer:
         self._event_shipper = EventShipper(
             get_journal(), server=self.url,
             local_journal=self.event_journal)
+        # workload flight recorder (observability/reqlog.py): sampled
+        # access records from every server's ingress chokepoints land
+        # in this journal (GET /cluster/workload); its /export view is
+        # the recording document scenarios/replay fits into a
+        # replayable ScenarioSpec.  The master's own records take the
+        # local short-circuit.  The last capacity-probe result
+        # (scenarios/capacity.py, `weed shell capacity.probe`) is
+        # parked here too so cluster.health can hint at it.
+        from ..observability.reqlog import (ReqlogShipper,
+                                            WorkloadJournal, get_recorder)
+
+        self.workload_journal = WorkloadJournal()
+        self._reqlog_shipper = ReqlogShipper(
+            get_recorder(), server=self.url,
+            local_journal=self.workload_journal)
+        self._capacity_doc: Optional[dict] = None
         self.alert_engine = AlertEngine(
             default_rules(),
             source_fn=lambda: (self.aggregator.health(),
@@ -125,7 +141,7 @@ class MasterServer:
             is_leader_fn=lambda: self.is_leader,
             admin_locked_fn=self._admin_locked,
             interval_s=coordinator_seconds or 15.0)
-        self.aggregator.local_fn = self.coordinator.health_contribution
+        self.aggregator.local_fn = self._local_health_contribution
         self.event_journal.on_ingest = self.coordinator.on_events
         from .consensus import RaftNode
 
@@ -180,6 +196,7 @@ class MasterServer:
         # during startup must find the shipper hooked (attach has no
         # backfill — an event emitted before it never ships)
         self._event_shipper.attach()
+        self._reqlog_shipper.attach()
         # framed-TCP assign front (op 'A'): the write hot loop does one
         # assign per file, and HTTP parsing caps it; leader-only — a
         # follower refuses so clients fall back to HTTP redirects
@@ -251,6 +268,7 @@ class MasterServer:
         self.coordinator.stop()
         self._trace_shipper.detach()
         self._event_shipper.detach()
+        self._reqlog_shipper.detach()
         self.aggregator.stop_loop()
         if self._tcp_server is not None:
             self._tcp_server.stop()
@@ -259,6 +277,23 @@ class MasterServer:
             from ..utils.httpd import stop_server
 
             stop_server(self._server)
+
+    def _local_health_contribution(self) -> dict:
+        """Master-resident totals folded into /cluster/health via the
+        aggregator's local_fn: the coordinator's gauges, plus this
+        process's lost access records — the master's registry is never
+        peer-scraped, so WorkloadJournal evictions (and the master's
+        own ring/ship drops) would otherwise never reach the
+        reqlog_records_dropped alert.  Caveat shared with every
+        local_fn source: in co-located fixtures (master + VS in one
+        process registry) the reqlog total can be counted once per
+        side — an over-warn, never an under-warn."""
+        from ..observability.reqlog import dropped_total
+
+        extra = dict(self.coordinator.health_contribution() or {})
+        extra["reqlog_records_dropped"] = \
+            extra.get("reqlog_records_dropped", 0) + dropped_total()
+        return extra
 
     # --- consensus (raft_server.go; state machine = MaxVolumeId) ----------
     def _apply_raft_state(self, state: dict) -> None:
@@ -678,6 +713,90 @@ class MasterServer:
                 str(b.get("server") or ""), b.get("events") or [])
             return Response({"accepted": accepted})
 
+        @r.route("GET", "/cluster/workload")
+        def cluster_workload(req: Request) -> Response:
+            """The cluster-wide workload recording: sampled access
+            records shipped from every server's ingress chokepoints
+            (observability/reqlog.py), dedup'd and bounded.  Filters:
+            ?route=, ?server=, ?since=<unix ts>, ?limit=N.  The
+            summary block carries per-route op/byte/error rollups."""
+            self._require_leader(req)
+            try:
+                since_ts = float(req.query.get("since") or 0.0)
+                # clamp BOTH ways: a negative limit would slice as
+                # [-0:] downstream and return the whole journal,
+                # bypassing the response cap
+                limit = min(max(int(req.query.get("limit") or 256), 1),
+                            8192)
+            except ValueError as e:
+                raise HttpError(400, f"bad query parameter: {e}")
+            from ..observability.reqlog import summarize_records
+
+            records = self.workload_journal.query(
+                route=req.query.get("route") or None,
+                server=req.query.get("server") or None,
+                since_ts=since_ts, limit=limit)
+            return Response({"records": records, "count": len(records),
+                             "total": len(self.workload_journal),
+                             "dropped": self.workload_journal.dropped,
+                             "summary": summarize_records(records)})
+
+        @r.route("GET", "/cluster/workload/export")
+        def cluster_workload_export(req: Request) -> Response:
+            """The full recording document (format-versioned,
+            loss-annotated, time-ordered) — what `weed shell
+            workload.export` saves and spec_from_recording() fits into
+            a replayable ScenarioSpec.  ?route= and ?since= scope the
+            window."""
+            self._require_leader(req)
+            try:
+                since_ts = float(req.query.get("since") or 0.0)
+            except ValueError as e:
+                raise HttpError(400, f"bad query parameter: {e}")
+            return Response(self.workload_journal.export(
+                route=req.query.get("route") or None,
+                since_ts=since_ts))
+
+        @r.route("POST", "/cluster/workload/ingest")
+        def cluster_workload_ingest(req: Request) -> Response:
+            """Access-record shipping sink (observability/reqlog.py
+            ReqlogShipper) — same convergence rule as trace/event
+            ingest: any reachable master accepts, a follower forwards
+            to the raft leader so every recorder lands in ONE
+            recording."""
+            if not self.is_leader:
+                if not self.raft.leader or self.raft.leader == self.url:
+                    raise HttpError(503, "no leader elected yet; retry")
+                return self._proxy_to_leader(req)
+            b = req.json()
+            accepted = self.workload_journal.ingest(
+                str(b.get("server") or ""), b.get("records") or [])
+            return Response({"accepted": accepted})
+
+        @r.route("GET", "/cluster/capacity")
+        def cluster_capacity(req: Request) -> Response:
+            """The most recent capacity-probe result parked on this
+            master (scenarios/capacity.py via `weed shell
+            capacity.probe` or the bench capacity section) — the
+            per-route-class rps a declared SLO sustains.  404 until a
+            probe has run; cluster.health hints from this."""
+            if self._capacity_doc is None:
+                raise HttpError(404, "no capacity probe result; run "
+                                     "`weed shell capacity.probe`")
+            return Response(self._capacity_doc)
+
+        @r.route("POST", "/cluster/capacity")
+        def cluster_capacity_post(req: Request) -> Response:
+            doc = req.json()
+            if not isinstance(doc, dict) or not doc:
+                raise HttpError(400, "capacity document required")
+            doc = dict(doc)
+            doc.setdefault("posted_at", round(time.time(), 3))
+            # atomic dict rebind: last-writer-wins probe result,
+            # readers take the whole doc or None
+            self._capacity_doc = doc
+            return Response({"stored": True}, status=201)
+
         @r.route("POST", "/cluster/traces/ingest")
         def cluster_traces_ingest(req: Request) -> Response:
             """Span-shipping sink (observability/collector.py
@@ -929,10 +1048,13 @@ class MasterServer:
 
                 self._submit_client = WeedClient(self.url)
             collection = req.query.get("collection", "")
+            # internal=True: the proxied volume PUT is marked
+            # ?type=proxied so the workload recorder attributes this
+            # write ONCE (to the client's /submit), not twice
             fid = self._submit_client.upload(
                 data, name=fname, mime=mime, collection=collection,
                 replication=req.query.get("replication", ""),
-                ttl=req.query.get("ttl", ""))
+                ttl=req.query.get("ttl", ""), internal=True)
             nodes = self.topo.lookup(int(fid.split(",")[0]), collection)
             public = nodes[0].public_url if nodes else ""
             return Response({

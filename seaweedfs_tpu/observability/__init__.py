@@ -28,6 +28,9 @@ from .events import (ClusterEventJournal, Event, EventJournal,
                      EventShipper, get_journal)
 from .flightrecorder import FlightRecorder, get_flightrecorder
 from .profiler import SamplingProfiler, profile_collapsed
+from .reqlog import (AccessRecord, ReqlogRecorder, ReqlogShipper,
+                     WorkloadJournal, disable_reqlog, enable_reqlog,
+                     get_recorder)
 from .tracer import (Span, Tracer, disable_tracing, enable_tracing,
                      get_tracer)
 
@@ -39,4 +42,7 @@ __all__ = ["Span", "Tracer", "get_tracer", "enable_tracing",
            "set_sample_rate", "SamplingProfiler", "profile_collapsed",
            "Event", "EventJournal", "ClusterEventJournal",
            "EventShipper", "get_journal", "AlertEngine", "Rule",
-           "default_rules", "FlightRecorder", "get_flightrecorder"]
+           "default_rules", "FlightRecorder", "get_flightrecorder",
+           "AccessRecord", "ReqlogRecorder", "ReqlogShipper",
+           "WorkloadJournal", "get_recorder", "enable_reqlog",
+           "disable_reqlog"]

@@ -76,6 +76,8 @@ EVENT_TYPES = {
     "load_shed": "warning",          # admission control answered 503
     "deadline_exceeded": "warning",  # X-Weed-Deadline budget spent: 504
     "retry_budget_exhausted": "warning",  # token bucket denied a retry
+    # workload flight recorder (observability/reqlog.py)
+    "reqlog_dropped": "warning",     # access records lost (ring/ship)
 }
 
 # HEALTH_FAMILIES key (stats/aggregate.py) -> the event type emitted at
@@ -92,6 +94,7 @@ HEALTH_EVENT_TYPES = {
     "requests_shed": "load_shed",
     "deadline_exceeded": "deadline_exceeded",
     "retry_budget_exhausted": "retry_budget_exhausted",
+    "reqlog_records_dropped": "reqlog_dropped",
 }
 
 

@@ -1,0 +1,641 @@
+"""Workload flight recorder: a bounded, sampled ring of access records.
+
+The observability stack answers "what went WRONG?" (events, alerts,
+traces) but not "what does this cluster's TRAFFIC look like?" — and
+without that record, every perf claim stays anchored to synthetic RPS
+loops.  This module records the live request stream at the two ingress
+chokepoints that see every operation:
+
+  - ``Router.dispatch`` (utils/httpd.py) — the HTTP plane, every role;
+  - ``FramedServer._serve_conn`` (utils/framing.py) — the native TCP
+    plane (op R/W/D frames).
+
+Each sampled request becomes one AccessRecord: route class (http_read /
+http_write / http_delete / native_* / ops / other), method, status,
+bytes in/out, duration, the remaining deadline budget at ingress, the
+shed/degraded/deadline flags, the active sampled trace id, and the
+peer address.  Secrets are redacted AT RECORD TIME (``redact_query``):
+a ``?jwt=...`` query credential must never land in a recording that an
+operator will export, attach to a ticket, or replay on a staging
+cluster.
+
+The recorder is a process-global singleton (like the tracer and the
+event journal) so both chokepoints and every co-located server share
+one ring.  Cost discipline: OFF is one attribute check per request;
+ON pays one seeded-RNG draw per request and the record dict only for
+the sampled fraction.  The ring is bounded and every loss is counted
+(SeaweedFS_reqlog_records_dropped_total{reason}).
+
+ReqlogShipper ships sampled records master-ward on the established
+TraceShipper/EventShipper transport (chained hook, bounded buffer,
+batch POST, loss counted never backpressured) into the master's
+WorkloadJournal at GET /cluster/workload — whose ``/export`` view is
+the recording document ``scenarios/replay.spec_from_recording`` fits
+into a replayable ScenarioSpec.
+
+Knobs: ``weed -reqlog.sample R -reqlog.size N <role>`` or
+WEED_REQLOG_SAMPLE / WEED_REQLOG_SIZE, and live via
+POST /debug/reqlog/start|stop (what ``weed shell workload.record``
+drives cluster-wide).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import threading
+import time
+import urllib.parse
+from collections import OrderedDict, deque
+from random import Random
+from typing import Callable, Optional
+
+from . import context as _trace_context
+
+# query parameters whose VALUES are credentials: redacted at record
+# time, before the record can reach the ring, the wire, or an export.
+# Matching is case-insensitive and substring-free (exact key match) —
+# ?keys=... is data, ?key=... is a credential.
+SENSITIVE_PARAMS = frozenset((
+    "jwt", "token", "auth", "authorization", "sig", "signature",
+    "secret", "password", "accesskey", "secretkey", "key",
+    "x-amz-signature", "x-amz-credential", "x-amz-security-token",
+))
+REDACTED = "REDACTED"
+
+# request paths that are operator/telemetry plumbing, not workload:
+# recorded only when the recorder is configured with include_ops=True
+# (a recording meant for replay must not learn to replay its own
+# metrics scrapes and shipper POSTs)
+OPS_PREFIXES = ("/metrics", "/debug", "/cluster", "/admin", "/heartbeat",
+                "/raft", "/stats", "/status", "/ui", "/dir/status",
+                "/vol/", "/col/", "/ec/")
+
+_OBJECT_PATH_RE = re.compile(r"^/\d+,[0-9a-f]+", re.IGNORECASE)
+
+
+def redact_query(path: str) -> str:
+    """Strip credential values from a ``path?query`` string.  The path
+    itself and benign parameter values survive (replay fidelity needs
+    them); any SENSITIVE_PARAMS value becomes ``REDACTED``.  The query
+    is re-encoded with urlencode so percent/plus-encoded values
+    round-trip intact (a manual join would turn an encoded ``%26``
+    into a bare ``&`` and corrupt the recorded path).  Malformed query
+    strings degrade to dropping the whole query — never to recording
+    it unredacted."""
+    base, sep, qs = path.partition("?")
+    if not sep:
+        return path
+    try:
+        pairs = urllib.parse.parse_qsl(qs, keep_blank_values=True)
+    except ValueError:
+        return base
+    out = [(k, REDACTED if k.lower() in SENSITIVE_PARAMS else v)
+           for k, v in pairs]
+    return base + "?" + urllib.parse.urlencode(out) if out else base
+
+
+def classify_route(method: str, path: str, handler: str = "",
+                   query: Optional[dict] = None) -> str:
+    """Route class for one HTTP request: the axis capacity numbers and
+    replayed workloads are keyed by.  Object routes (``/<vid>,<fid>``)
+    and master-proxied writes (``/submit``) are workload; the
+    operator/telemetry surface is ``ops``; server-to-server hops
+    (replication fan-out ``?type=replicate``, the master's /submit
+    upload proxy ``?type=proxied``) are ``internal`` — recording them
+    as client workload would double-count every proxied/replicated
+    write and skew the fitted replay spec; everything else keeps a
+    conservative ``other`` so an unknown route never masquerades as
+    servable read capacity."""
+    if query and query.get("type") in ("replicate", "proxied"):
+        return "internal"
+    if _OBJECT_PATH_RE.match(path):
+        if method in ("GET", "HEAD"):
+            return "http_read"
+        if method == "DELETE":
+            return "http_delete"
+        return "http_write"
+    if path.startswith("/submit"):
+        return "http_write"
+    if path.startswith("/dir/assign"):
+        return "assign"
+    if path == "/dir/lookup" or path.startswith("/dir/lookup"):
+        return "lookup"
+    if any(path.startswith(p) for p in OPS_PREFIXES):
+        return "ops"
+    return "other"
+
+
+NATIVE_ROUTES = {b"R": "native_read", b"W": "native_write",
+                 b"D": "native_delete"}
+
+
+def _dropped_counter():
+    """SeaweedFS_reqlog_records_dropped_total{reason}: access records
+    lost to the bounded ring (ring_evict) or the shipper
+    (ship_buffer/ship_error).  A recording whose window lost records
+    says so — fidelity math must not trust a silently truncated
+    sample."""
+    global _dropped
+    with _reqlog_lock:
+        if _dropped is None:
+            from ..stats import REGISTRY
+
+            _dropped = REGISTRY.counter(
+                "SeaweedFS_reqlog_records_dropped_total",
+                "Workload access records dropped before export/shipping.",
+                labels=("reason",))
+        return _dropped
+
+
+_dropped = None
+_reqlog_lock = threading.Lock()
+
+
+def dropped_total() -> int:
+    """This process's total lost access records across every reason
+    (ring/journal evictions, ship buffer/transport) — the master folds
+    its own value into /cluster/health via the aggregator's local_fn
+    (its registry is never peer-scraped, so journal evictions would
+    otherwise be invisible to the reqlog_records_dropped alert)."""
+    return int(sum(_dropped_counter().snapshot().values()))
+
+# reqlog_dropped journal events are rate-limited: the counter counts
+# every loss, the journal must not churn under a sustained overflow
+_EVENT_MIN_INTERVAL_S = 10.0
+
+
+class AccessRecord:
+    """One sampled request, already redacted."""
+
+    __slots__ = ("route", "method", "path", "status", "bytes_in",
+                 "bytes_out", "duration_ms", "deadline_s", "shed",
+                 "degraded", "trace_id", "peer", "server", "handler",
+                 "ts", "seq", "id", "sample")
+
+    def __init__(self, route: str, method: str, path: str, status: int,
+                 bytes_in: int, bytes_out: int, duration_ms: float,
+                 deadline_s: Optional[float], shed: bool, degraded: bool,
+                 trace_id: Optional[str], peer: str, server: Optional[str],
+                 handler: str, ts: float, seq: int, id_: str,
+                 sample: float = 1.0):
+        self.route = route
+        self.method = method
+        self.path = path
+        self.status = status
+        self.bytes_in = bytes_in
+        self.bytes_out = bytes_out
+        self.duration_ms = duration_ms
+        self.deadline_s = deadline_s
+        self.shed = shed
+        self.degraded = degraded
+        self.trace_id = trace_id
+        self.peer = peer
+        self.server = server
+        self.handler = handler
+        self.ts = ts
+        self.seq = seq
+        self.id = id_
+        self.sample = sample
+
+    def to_dict(self) -> dict:
+        d = {"id": self.id, "seq": self.seq, "ts": round(self.ts, 3),
+             "route": self.route, "method": self.method,
+             "path": self.path, "status": self.status,
+             "in": self.bytes_in, "out": self.bytes_out,
+             "ms": round(self.duration_ms, 3)}
+        if self.sample < 1.0:
+            # each sampled record stands for ~1/sample real requests:
+            # the fit corrects arrival rates by this, so a -sample 0.1
+            # recording replays at PRODUCTION intensity, not a tenth
+            d["sample"] = self.sample
+        if self.handler:
+            d["handler"] = self.handler
+        if self.deadline_s is not None:
+            d["ddl_s"] = round(self.deadline_s, 3)
+        if self.shed:
+            d["shed"] = True
+        if self.degraded:
+            d["degraded"] = True
+        if self.trace_id:
+            d["trace"] = self.trace_id
+        if self.peer:
+            d["peer"] = self.peer
+        if self.server:
+            d["server"] = self.server
+        return d
+
+
+class ReqlogRecorder:
+    """Bounded sampled ring of AccessRecords (one per process).
+
+    Sampling is a seeded RNG draw per request — deterministic under a
+    fixed seed, so a recording taken with the same seed over the same
+    request sequence admits the same subset (the property the fidelity
+    tests pin).  ``enabled`` is the one-attribute-check fast-path gate
+    the chokepoints read; start()/stop() flip it live."""
+
+    def __init__(self, capacity: int = 8192, sample: float = 0.1,
+                 seed: int = 0x5EED, include_ops: bool = False,
+                 namespace: Optional[str] = None):
+        self.enabled = False
+        self.sample = float(sample)
+        self.include_ops = include_ops
+        self._records: deque[AccessRecord] = deque(maxlen=max(int(capacity), 16))  # guarded-by: _lock
+        self._rng = Random(seed)  # guarded-by: _lock
+        self._seed = seed
+        self._lock = threading.Lock()
+        self._seq = 0  # guarded-by: _lock
+        self.seen = 0  # guarded-by: _lock
+        self.recorded = 0  # guarded-by: _lock
+        self.dropped = 0  # guarded-by: _lock
+        self.started_at = 0.0  # guarded-by: _lock
+        self._last_drop_event = 0.0  # guarded-by: _lock
+        # same salting rationale as spans/events: bare pids collide
+        # across containerized hosts and the master journal dedups by id
+        self.namespace = namespace or (
+            f"r{os.getpid():x}x{os.urandom(3).hex()}")
+        # shipping hook (ReqlogShipper): called with every record
+        self.on_record: Optional[Callable[[AccessRecord], None]] = None
+
+    @property
+    def capacity(self) -> int:
+        return self._records.maxlen or 0  # weedlint: disable=W501 maxlen is immutable configuration, not ring state
+
+    def configure(self, sample: Optional[float] = None,
+                  capacity: Optional[int] = None,
+                  seed: Optional[int] = None,
+                  include_ops: Optional[bool] = None) -> None:
+        """Apply knobs (live: a running recording re-sizes/re-rates
+        without losing what it already holds unless the ring shrinks)."""
+        with self._lock:
+            if sample is not None:
+                self.sample = max(0.0, min(float(sample), 1.0))
+            if include_ops is not None:
+                self.include_ops = bool(include_ops)
+            if seed is not None:
+                self._seed = int(seed)
+                self._rng = Random(self._seed)
+            if capacity is not None:
+                # clamp BEFORE the compare/slice: capacity=0 would hit
+                # the [-0:] falsy-zero slice (keep everything, count
+                # nothing) and then silently truncate to the floor —
+                # a loss the "every loss is counted" invariant forbids
+                capacity = max(int(capacity), 16)
+                if capacity != self._records.maxlen:
+                    keep = list(self._records)[-capacity:]
+                    lost = len(self._records) - len(keep)
+                    self._records = deque(keep, maxlen=capacity)
+                    if lost > 0:
+                        self.dropped += lost
+                        _dropped_counter().inc("ring_evict", amount=lost)
+
+    def start(self, sample: Optional[float] = None,
+              capacity: Optional[int] = None,
+              seed: Optional[int] = None,
+              include_ops: Optional[bool] = None,
+              reset: bool = True) -> None:
+        self.configure(sample=sample, capacity=capacity, seed=seed,
+                       include_ops=include_ops)
+        with self._lock:
+            if reset:
+                self._records.clear()
+                self.seen = 0
+                self.recorded = 0
+                self._rng = Random(self._seed)
+            self.started_at = time.time()
+        self.enabled = True  # weedlint: disable=W502 monotonic on/off gate: single atomic bool store, chokepoints read it once per request and either value is safe
+
+    def stop(self) -> None:
+        self.enabled = False  # weedlint: disable=W502 monotonic on/off gate: single atomic bool store
+
+    def record(self, route: str, method: str, path: str, status: int,  # thread-entry
+               bytes_in: int = 0, bytes_out: int = 0,
+               duration_ms: float = 0.0,
+               deadline_s: Optional[float] = None, shed: bool = False,
+               degraded: bool = False, peer: str = "",
+               handler: str = "") -> Optional[AccessRecord]:
+        """Sample-and-record one request — called from the ingress
+        chokepoints on whatever thread served it.  Returns None when
+        the sample draw rejected (the common case at low rates).  The
+        path MUST arrive pre-redacted (the chokepoints call
+        redact_query before this)."""
+        if route in ("ops", "internal") and not self.include_ops:
+            return None
+        note_drop = False
+        with self._lock:
+            self.seen += 1
+            if self.sample < 1.0 and self._rng.random() >= self.sample:
+                return None
+            self._seq += 1
+            trace_ctx = _trace_context.current_sampled()
+            rec = AccessRecord(
+                route, method, path, int(status), int(bytes_in),
+                int(bytes_out), float(duration_ms), deadline_s,
+                bool(shed), bool(degraded),
+                trace_ctx.trace_id if trace_ctx is not None else None,
+                peer, _trace_context.current_server(), handler,
+                time.time(), self._seq,
+                f"{self.namespace}.{self._seq:x}",
+                sample=self.sample)
+            if len(self._records) == self._records.maxlen:
+                self.dropped += 1
+                _dropped_counter().inc("ring_evict")
+                now = time.monotonic()
+                if now - self._last_drop_event >= _EVENT_MIN_INTERVAL_S:
+                    self._last_drop_event = now
+                    note_drop = True
+            self._records.append(rec)
+            self.recorded += 1
+        if note_drop:
+            # journal the loss (rate-limited) OUTSIDE the ring lock —
+            # the events module takes its own lock and its shipper hook
+            # does real work
+            _emit_drop_event("ring_evict")
+        hook = self.on_record
+        if hook is not None:
+            try:
+                hook(rec)
+            except Exception:
+                pass  # shipping must never break the serving path
+        return rec
+
+    def snapshot(self) -> list[AccessRecord]:
+        with self._lock:
+            return list(self._records)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._records.clear()
+            self.seen = 0
+            self.recorded = 0
+            self.dropped = 0
+
+    def query(self, route: Optional[str] = None, since_ts: float = 0.0,
+              limit: int = 512) -> list[dict]:
+        """Filtered record dicts, newest `limit` (<= 0 = unlimited —
+        the export path; the HTTP routes clamp their own caps)."""
+        out = [r.to_dict() for r in self.snapshot()
+               if (not route or r.route == route)
+               and (not since_ts or r.ts > since_ts)]
+        limit = max(int(limit), 0)
+        return out[-limit:] if limit else out
+
+    def status(self) -> dict:
+        with self._lock:
+            return {"enabled": self.enabled, "sample": self.sample,
+                    "capacity": self._records.maxlen,
+                    "records": len(self._records),
+                    "seen": self.seen, "recorded": self.recorded,
+                    "dropped": self.dropped,
+                    "include_ops": self.include_ops,
+                    "seed": self._seed,
+                    "started_at": round(self.started_at, 3),
+                    "namespace": self.namespace}
+
+
+def _emit_drop_event(reason: str) -> None:
+    from . import events as _events
+
+    try:
+        _events.emit("reqlog_dropped", reason=reason)
+    except Exception:
+        pass
+
+
+def summarize_records(records: list[dict]) -> dict:
+    """Shared recording rollup (the /cluster/workload summary block and
+    the shell's one-line view): per-route counts, byte totals, error
+    counts, observed window."""
+    routes: dict[str, dict] = {}
+    t0 = t1 = 0.0
+    for r in records:
+        row = routes.setdefault(r.get("route", "other"), {
+            "ops": 0, "errors": 0, "bytes_in": 0, "bytes_out": 0})
+        row["ops"] += 1
+        if int(r.get("status") or 0) >= 400:
+            row["errors"] += 1
+        row["bytes_in"] += int(r.get("in") or 0)
+        row["bytes_out"] += int(r.get("out") or 0)
+        ts = float(r.get("ts") or 0.0)
+        if ts:
+            t0 = ts if not t0 else min(t0, ts)
+            t1 = max(t1, ts)
+    return {"records": len(records), "routes": routes,
+            "window_s": round(max(t1 - t0, 0.0), 3),
+            "t0": round(t0, 3), "t1": round(t1, 3)}
+
+
+class WorkloadJournal:  # weedlint: concurrent-class
+    """The master's merged workload recording: per-server recorders
+    ship here, dedup'd by record id, bounded by oldest-first eviction —
+    the /cluster/workload store and the source of the exportable
+    recording document.  Reached concurrently from the threaded HTTP
+    router (ingest POSTs + query/export GETs)."""
+
+    FORMAT = "seaweedfs-tpu-workload-recording-v1"
+
+    def __init__(self, capacity: int = 65536):
+        self.capacity = capacity
+        self._records: "OrderedDict[str, dict]" = OrderedDict()  # guarded-by: _lock
+        self._lock = threading.Lock()
+        self.dropped = 0  # guarded-by: _lock
+
+    def ingest(self, server: str, records: list[dict]) -> int:
+        accepted = 0
+        with self._lock:
+            for r in records:
+                rid = r.get("id")
+                if not rid or rid in self._records:
+                    continue  # duplicate ship (chained shippers, retry)
+                r = dict(r)
+                r["via"] = server
+                self._records[rid] = r
+                accepted += 1
+            while len(self._records) > self.capacity:
+                self._records.popitem(last=False)
+                self.dropped += 1
+                _dropped_counter().inc("journal_evict")
+        return accepted
+
+    def query(self, route: Optional[str] = None, server: Optional[str] = None,
+              since_ts: float = 0.0, limit: int = 512) -> list[dict]:
+        with self._lock:
+            records = list(self._records.values())
+        out = [r for r in records
+               if (not route or r.get("route") == route)
+               and (not server or r.get("server") == server
+                    or r.get("via") == server)
+               and (not since_ts or float(r.get("ts") or 0.0) > since_ts)]
+        out.sort(key=lambda r: (float(r.get("ts") or 0.0),
+                                str(r.get("id"))))
+        limit = max(int(limit), 0)
+        return out[-limit:] if limit else out
+
+    def export(self, route: Optional[str] = None,
+               since_ts: float = 0.0) -> dict:
+        """The recording document — what ``weed shell workload.export``
+        writes and ``scenarios/replay.spec_from_recording`` consumes.
+        Time-ordered, loss-annotated, format-versioned."""
+        records = self.query(route=route, since_ts=since_ts, limit=0)
+        with self._lock:
+            dropped = self.dropped
+        return {"format": self.FORMAT,
+                "exported_at": round(time.time(), 3),
+                "dropped": dropped,
+                "summary": summarize_records(records),
+                "records": records}
+
+    def clear(self) -> None:
+        with self._lock:
+            self._records.clear()
+            self.dropped = 0
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._records)
+
+
+class ReqlogShipper:
+    """Ship this process's sampled access records to the master's
+    workload journal — the TraceShipper/EventShipper transport pattern
+    (chained on_record hook, bounded buffer, batch POST on a flush
+    thread, loss COUNTED never backpressured, ``local_journal``
+    short-circuit for the master's own records)."""
+
+    def __init__(self, recorder: ReqlogRecorder, server: str,
+                 master_url_fn: Optional[Callable[[], str]] = None,
+                 local_journal: Optional[WorkloadJournal] = None,
+                 batch_size: int = 128, flush_interval: float = 0.5,
+                 buffer_cap: int = 4096):
+        self.recorder = recorder
+        self.server = server
+        self.master_url_fn = master_url_fn
+        self.local_journal = local_journal
+        self.batch_size = batch_size
+        self.flush_interval = flush_interval
+        self.buffer_cap = buffer_cap
+        self._buf: deque[AccessRecord] = deque()  # guarded-by: _lock
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._wake = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # hook-chain handoff: written by attach()/detach() on the
+        # server's lifecycle thread before the flush thread starts /
+        # after it stops; read lock-free on every record
+        self._prev_hook: Optional[Callable[[AccessRecord], None]] = None
+        self._master_i = 0  # guarded-by: _lock
+        self.shipped = 0  # guarded-by: _lock
+        self.dropped = 0  # guarded-by: _lock
+
+    def attach(self) -> "ReqlogShipper":
+        self._prev_hook = self.recorder.on_record  # weedlint: disable=W502 lifecycle handoff: runs before the flush thread starts
+        self.recorder.on_record = self._on_record
+        self._thread = threading.Thread(target=self._flush_loop,
+                                        daemon=True,
+                                        name=f"reqlog-ship:{self.server}")
+        self._thread.start()
+        return self
+
+    def detach(self) -> None:
+        self._stop.set()
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+        if self.recorder.on_record is self._on_record:
+            self.recorder.on_record = self._prev_hook
+        # final flush with a sub-second timeout: at cluster teardown the
+        # master is often already gone and stop() must not hang
+        self._flush(timeout=0.5)
+
+    def _on_record(self, rec: AccessRecord) -> None:  # thread-entry
+        # called on whatever request thread recorded; a detached
+        # shipper left mid-chain degrades to a pass-through
+        if not self._stop.is_set():
+            with self._lock:
+                if len(self._buf) >= self.buffer_cap:
+                    self.dropped += 1
+                    _dropped_counter().inc("ship_buffer")
+                else:
+                    self._buf.append(rec)
+                    if len(self._buf) >= self.batch_size:
+                        self._wake.set()
+        prev = self._prev_hook
+        if prev is not None:
+            prev(rec)
+
+    def _flush_loop(self) -> None:
+        while not self._stop.is_set():
+            self._wake.wait(self.flush_interval)
+            self._wake.clear()
+            self._flush()
+
+    def _flush(self, timeout: float = 5.0) -> None:
+        with self._lock:
+            if not self._buf:
+                return
+            batch = list(self._buf)
+            self._buf.clear()
+        docs = [rec.to_dict() for rec in batch]
+        if self.local_journal is not None:
+            self.local_journal.ingest(self.server, docs)
+            with self._lock:
+                self.shipped += len(docs)
+            return
+        urls = [u.strip()
+                for u in (self.master_url_fn() or "").split(",")
+                if u.strip()] if self.master_url_fn else []
+        from ..utils.httpd import http_json
+
+        with self._lock:
+            master_i = self._master_i
+        try:
+            if not urls:
+                raise ConnectionError("no master url to ship to")
+            master = urls[master_i % len(urls)]
+            # shipping must never trace (or record) itself: the POST
+            # runs NOT_SAMPLED, and its ingress on the master classifies
+            # as `ops` which the recorder skips by default
+            with _trace_context.scope(_trace_context.NOT_SAMPLED):
+                http_json("POST",
+                          f"http://{master}/cluster/workload/ingest",
+                          {"server": self.server, "records": docs},
+                          timeout=timeout)
+            with self._lock:
+                self.shipped += len(docs)
+        except Exception:
+            # master down / not elected: the batch is LOST and counted;
+            # the next flush rotates to the next configured master.
+            # Counter updates ride _lock: the flush thread and the
+            # detach()-time final flush race these read-modify-writes
+            _dropped_counter().inc("ship_error", amount=len(docs))
+            with self._lock:
+                self._master_i += 1
+                self.dropped += len(docs)
+
+
+# --- process-global recorder -------------------------------------------------
+# Both ingress chokepoints record into ONE recorder per process (like
+# the tracer and the event journal), so /debug/reqlog and the shipper
+# see the HTTP and native planes in one stream without plumbing a
+# handle through every server constructor.
+
+_GLOBAL = ReqlogRecorder()
+
+
+def get_recorder() -> ReqlogRecorder:
+    return _GLOBAL
+
+
+def enable_reqlog(sample: float = 0.1, capacity: Optional[int] = None,
+                  seed: Optional[int] = None,
+                  include_ops: Optional[bool] = None) -> ReqlogRecorder:
+    """Turn the process-global recorder on (the -reqlog.sample /
+    WEED_REQLOG_SAMPLE entry point)."""
+    _GLOBAL.start(sample=sample, capacity=capacity, seed=seed,
+                  include_ops=include_ops, reset=False)
+    return _GLOBAL
+
+
+def disable_reqlog() -> None:
+    _GLOBAL.stop()

@@ -18,9 +18,25 @@ spec's expectations.
 The bench `scenarios` section runs the three canonical specs (Zipfian
 hot-set read storm, mixed-size write+churn, failure-under-load) and
 stamps each verdict into the bench JSON.
+
+Two more entry points close the loop with the observability stack:
+
+  - replay.spec_from_recording fits a workload recording
+    (observability/reqlog.py, `weed shell workload.export`) into a
+    replayable spec — recorded production traffic becomes a
+    repeatable scenario, open-loop paced at recorded (or -speed
+    scaled) rate, with replay_fidelity machine-checking the
+    reproduction;
+  - capacity.find_capacity / probe_cluster binary-search the max
+    sustainable rps per route class under a declared SLO — the bench
+    `capacity` section's numbers and the dataplane refactor's
+    acceptance baseline.
 """
 
+from .capacity import CapacitySLO, find_capacity, measure_rate
 from .engine import run_scenario
+from .replay import (recording_profile, replay_fidelity,
+                     spec_from_recording)
 from .spec import (FaultSpec, ScenarioSpec, default_scenarios,
                    failure_under_load, read_storm, write_churn)
 from .workload import SizeSampler, ZipfSampler
@@ -29,4 +45,6 @@ __all__ = [
     "FaultSpec", "ScenarioSpec", "default_scenarios", "run_scenario",
     "read_storm", "write_churn", "failure_under_load",
     "ZipfSampler", "SizeSampler",
+    "spec_from_recording", "recording_profile", "replay_fidelity",
+    "CapacitySLO", "find_capacity", "measure_rate",
 ]

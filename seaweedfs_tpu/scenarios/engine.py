@@ -33,7 +33,8 @@ from ..utils import faultinject as fi
 from ..utils.backoff import get_retry_budget
 from ..utils.httpd import HttpError, http_bytes, http_json
 from .spec import ScenarioSpec
-from .workload import SizeSampler, ZipfSampler, payload_for, pick_op
+from .workload import (SizeSampler, ZipfSampler, payload_for,
+                       percentile as _percentile, pick_op)
 
 
 def _free_port() -> int:
@@ -42,13 +43,6 @@ def _free_port() -> int:
     port = s.getsockname()[1]
     s.close()
     return port
-
-
-def _percentile(sorted_vals: list, q: float) -> float:
-    if not sorted_vals:
-        return 0.0
-    i = min(len(sorted_vals) - 1, int(q * len(sorted_vals)))
-    return sorted_vals[i]
 
 
 class _Op:
@@ -165,7 +159,21 @@ def _client_loop(ci: int, spec: ScenarioSpec, master_url: str,
     sizes = SizeSampler(spec.sizes)
     written: list[tuple[str, str]] = []  # this client's own objects
     seq = 0
+    # open-loop pacing (replayed recordings): each client owns an
+    # interleaved slice of a fixed global schedule — slot k of client
+    # ci fires at (ci + k*clients)/target_rps.  The schedule never
+    # slips: an op that ran long makes the NEXT slot fire immediately
+    # (catch-up), so a degraded server faces the recorded arrival
+    # rate instead of quietly back-pressuring its own load.
+    pace = spec.target_rps > 0
+    interval = spec.clients / spec.target_rps if pace else 0.0
+    next_t = t0 + (ci / spec.target_rps) if pace else 0.0
     while not stop.is_set():
+        if pace:
+            delay = next_t - time.monotonic()
+            if delay > 0 and stop.wait(delay):
+                break
+            next_t += interval
         op = pick_op(rng, spec.read_fraction, spec.churn_fraction)
         if op == "delete" and not written:
             op = "write"

@@ -45,6 +45,11 @@ class ScenarioSpec:
     # (size_bytes, weight) mix; 4KB needles dominate, with a heavy tail
     sizes: tuple = ((4096, 0.90), (65536, 0.08), (1 << 20, 0.02))
     deadline_s: float = 2.0           # per-request client budget
+    # open-loop pacing: > 0 schedules ops at this aggregate rate on a
+    # fixed clock (replayed recordings arrive at recorded speed; a slow
+    # server gets catch-up bursts, not a slower workload).  0 = closed
+    # loop: every client hammers as fast as responses return.
+    target_rps: float = 0.0
     max_inflight: int = 0             # server admission bound (0 = off)
     vacuum_every_s: float = 0.0       # >0: periodic /vol/vacuum churn
     faults: tuple = ()                # FaultSpec entries

@@ -59,6 +59,16 @@ class SizeSampler:
         return self._cdf[-1][1]
 
 
+def percentile(sorted_vals: list, q: float) -> float:
+    """Nearest-rank percentile over an ASCENDING-sorted list — the one
+    quantile convention the engine's route stats and the capacity
+    probe share (a fix to the index rule must change both at once)."""
+    if not sorted_vals:
+        return 0.0
+    i = min(len(sorted_vals) - 1, int(q * len(sorted_vals)))
+    return sorted_vals[i]
+
+
 def pick_op(rng: random.Random, read_fraction: float,
             churn_fraction: float) -> str:
     """'read' | 'write' | 'delete' per the spec's mix: churn_fraction

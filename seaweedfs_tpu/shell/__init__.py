@@ -9,4 +9,5 @@ from . import remote_commands as remote_commands  # noqa: F401
 from . import s3_commands as s3_commands  # noqa: F401
 from . import trace_commands as trace_commands  # noqa: F401
 from . import volume_commands as volume_commands  # noqa: F401
+from . import workload_commands as workload_commands  # noqa: F401
 from .commands import COMMANDS, CommandEnv, repl, run_command  # noqa: F401

@@ -15,6 +15,7 @@ either a Tracer.to_dict() document or the Chrome trace JSON written by
 from __future__ import annotations
 
 import json
+import time
 
 from ..utils.httpd import http_bytes
 from .commands import CommandEnv, command
@@ -106,6 +107,24 @@ def cmd_cluster_health(env: CommandEnv, flags: dict) -> str:
                   if a["state"] == "firing"]
         lines.append(f"alerts: {al.get('firing', 0)} firing"
                      + (f" ({', '.join(firing)})" if firing else ""))
+    except Exception:
+        pass
+    # one-line capacity hint when a probe result is parked on the
+    # master (weed shell capacity.probe / the bench capacity section);
+    # best-effort — 404 just means nobody probed yet
+    try:
+        cap = env.master_get("/cluster/capacity")
+        slo = cap.get("slo") or {}
+        parts = [f"{route}~{res['capacity_rps']:g}rps"
+                 for route, res in sorted((cap.get("routes") or {}).items())
+                 if isinstance(res, dict) and res.get("capacity_rps")]
+        if parts:
+            age = int(time.time() - float(cap.get("posted_at")
+                                          or cap.get("probed_at") or 0))
+            lines.append(
+                f"capacity: {' '.join(parts)} "
+                f"(SLO p99<{slo.get('max_p99_ms', '?')}ms, "
+                f"probed {age}s ago)")
     except Exception:
         pass
     t = doc["totals"]

@@ -1,0 +1,208 @@
+"""Workload recording, replay & capacity shell commands.
+
+    workload.record [-sample 1.0] [-size 8192] [-seed N]  # start, cluster-wide
+    workload.stop                                         # stop everywhere
+    workload.export [-out recording.json] [-route r]      # save the recording
+    workload.replay [-file recording.json] [-speed 2] [-duration s] [-json]
+    capacity.probe [-routes http_read,native_read] [-p99 5] [-step 2]
+
+workload.record fans POST /debug/reqlog/start to the master and every
+heartbeat-registered volume server (the recorder is per-process; the
+shippers stream sampled records to the master's /cluster/workload
+journal continuously).  workload.export saves the master's recording
+document; workload.replay fits it into a ScenarioSpec
+(scenarios/replay.spec_from_recording) and drives it with the scenario
+engine — alerting live, open-loop paced at recorded (or -speed scaled)
+rate — then prints the verdict AND the replay-fidelity checks.
+
+capacity.probe runs the SLO capacity search (scenarios/capacity.py)
+against the connected cluster and posts the result to the master
+(POST /cluster/capacity), where cluster.health picks it up as a
+one-line hint.  The probe WRITES load objects and drives the cluster
+to its knee — hold the admin lock.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+from ..utils.httpd import http_json
+from .commands import CommandEnv, command
+
+
+def _all_servers(env: CommandEnv) -> list[str]:
+    """Every server whose recorder a cluster-wide record/stop must
+    reach: the master, every heartbeat-registered volume server, and
+    the connected filer (filers are not in /dir/status topology — a
+    fan-out built from it alone would silently omit the whole filer
+    workload from the recording; further filers need -server)."""
+    targets = [env.master_url]
+    topo = env.topology()
+    for dc in topo.get("DataCenters", []):
+        for rack in dc.get("Racks", []):
+            for n in rack.get("DataNodes", []):
+                targets.append(n["Url"])
+    if env.filer_url:
+        targets.append(env.filer_url)
+    return targets
+
+
+@command("workload.record")
+def cmd_workload_record(env: CommandEnv, flags: dict) -> str:
+    """workload.record [-sample 1.0] [-size 8192] [-seed N]
+    [-include_ops] [-server host:port]
+    # start the workload flight recorder on the master, every
+    # registered volume server, and the connected filer (or one
+    # -server); sampled, redacted access records stream to the
+    # master's /cluster/workload journal"""
+    body: dict = {"reset": True}
+    try:
+        if flags.get("sample"):
+            body["sample"] = float(flags["sample"])
+        if flags.get("size"):
+            body["size"] = int(flags["size"])
+        if flags.get("seed"):
+            body["seed"] = int(flags["seed"])
+    except ValueError as e:
+        raise ValueError(f"bad -sample/-size/-seed: {e}")
+    if flags.get("include_ops") == "true":
+        body["include_ops"] = True
+    targets = [flags["server"]] if flags.get("server") \
+        else _all_servers(env)
+    lines = []
+    for url in targets:
+        try:
+            st = http_json("POST", f"http://{url}/debug/reqlog/start",
+                           body, timeout=15.0)
+            lines.append(f"{url}: recording sample={st['sample']:g} "
+                         f"capacity={st['capacity']}")
+        except Exception as e:
+            lines.append(f"{url}: start failed: "
+                         f"{type(e).__name__}: {e}")
+    return "\n".join(lines)
+
+
+@command("workload.stop")
+def cmd_workload_stop(env: CommandEnv, flags: dict) -> str:
+    """workload.stop [-server host:port]
+    # stop recording (rings keep their records for export)"""
+    targets = [flags["server"]] if flags.get("server") \
+        else _all_servers(env)
+    lines = []
+    for url in targets:
+        try:
+            st = http_json("POST", f"http://{url}/debug/reqlog/stop",
+                           {}, timeout=15.0)
+            lines.append(f"{url}: stopped "
+                         f"(recorded={st['recorded']} "
+                         f"dropped={st['dropped']})")
+        except Exception as e:
+            lines.append(f"{url}: stop failed: "
+                         f"{type(e).__name__}: {e}")
+    return "\n".join(lines)
+
+
+@command("workload.export")
+def cmd_workload_export(env: CommandEnv, flags: dict) -> str:
+    """workload.export [-out recording.json] [-route r] [-since ts]
+    # save the master's merged workload recording (the replayable
+    # document); prints the per-route summary"""
+    params = []
+    if flags.get("route"):
+        params.append(f"route={flags['route']}")
+    if flags.get("since"):
+        params.append(f"since={flags['since']}")
+    qs = ("?" + "&".join(params)) if params else ""
+    doc = env.master_get(f"/cluster/workload/export{qs}")
+    out = flags.get("out") or f"recording_{int(time.time())}.json"
+    with open(out, "w", encoding="utf-8") as f:
+        json.dump(doc, f)
+    s = doc.get("summary") or {}
+    lines = [f"wrote {out}: {s.get('records', 0)} records over "
+             f"{s.get('window_s', 0)}s (dropped={doc.get('dropped', 0)})"]
+    for route, row in sorted((s.get("routes") or {}).items()):
+        lines.append(f"  {route:<14} ops={row['ops']} "
+                     f"errors={row['errors']} "
+                     f"in={row['bytes_in']} out={row['bytes_out']}")
+    return "\n".join(lines)
+
+
+@command("workload.replay")
+def cmd_workload_replay(env: CommandEnv, flags: dict) -> str:
+    """workload.replay [-file recording.json] [-speed 1.0]
+    [-duration s] [-clients 8] [-json]
+    # fit a recording (a -file, or the master's current journal) into
+    # a ScenarioSpec and replay it with the scenario engine — fresh
+    # in-process cluster, alerting live, open-loop paced.  Prints the
+    # scenario verdict and the machine-checked replay-fidelity list"""
+    from ..scenarios import run_scenario
+    from ..scenarios.replay import replay_fidelity, spec_from_recording
+
+    if flags.get("file"):
+        with open(flags["file"], encoding="utf-8") as f:
+            recording = json.load(f)
+    else:
+        recording = env.master_get("/cluster/workload/export")
+    try:
+        speed = float(flags.get("speed") or 1.0)
+        duration = float(flags["duration"]) if flags.get("duration") \
+            else None
+        clients = int(flags.get("clients") or 8)
+    except ValueError as e:
+        raise ValueError(f"bad -speed/-duration/-clients: {e}")
+    spec = spec_from_recording(recording, speed=speed,
+                               duration_s=duration, clients=clients)
+    result = run_scenario(spec)
+    fidelity = replay_fidelity(recording, spec, result=result)
+    result["fidelity"] = fidelity
+    if flags.get("json") == "true":
+        return json.dumps(result, indent=2)
+    lines = [f"replayed {spec.name}: verdict={result['verdict']} "
+             f"({result['total_ops']} ops over {result['wall_s']}s, "
+             f"target_rps={spec.target_rps:g})"]
+    for c in result.get("checks", []) + fidelity:
+        mark = "ok " if c["ok"] else "FAIL"
+        lines.append(f"  {mark} {c['check']}: value={c['value']} "
+                     f"bound={c['bound']}")
+    return "\n".join(lines)
+
+
+@command("capacity.probe")
+def cmd_capacity_probe(env: CommandEnv, flags: dict) -> str:
+    """capacity.probe [-routes http_read,native_read,http_write]
+    [-p99 5.0] [-errors 0.001] [-start 100] [-max 50000] [-step 2.0]
+    [-json]
+    # binary-search the max sustainable rps per route class under the
+    # SLO against the LIVE cluster (writes load objects; drives the
+    # cluster to its knee — hold the admin lock), then post the result
+    # to the master so cluster.health can hint at it"""
+    from ..scenarios.capacity import (CapacitySLO, probe_cluster,
+                                      render_capacity)
+
+    env.confirm_is_locked()
+    routes = tuple(s.strip() for s in
+                   (flags.get("routes")
+                    or "http_read,native_read,http_write").split(",")
+                   if s.strip())
+    try:
+        slo = CapacitySLO(
+            max_p99_ms=float(flags.get("p99") or 5.0),
+            max_error_ratio=float(flags.get("errors") or 0.001))
+        start = float(flags.get("start") or 100.0)
+        max_rps = float(flags.get("max") or 50000.0)
+        step_s = float(flags.get("step") or 2.0)
+    except ValueError as e:
+        raise ValueError(f"bad probe knobs: {e}")
+    doc = probe_cluster(env.master_url, routes=routes, slo=slo,
+                        start_rps=start, max_rps=max_rps, step_s=step_s)
+    for res in doc["routes"].values():
+        res.pop("samples", None)
+    try:
+        env.master_post("/cluster/capacity", doc)
+        posted = "posted to master /cluster/capacity"
+    except Exception as e:
+        posted = f"post to master failed: {type(e).__name__}: {e}"
+    if flags.get("json") == "true":
+        return json.dumps(doc, indent=2)
+    return render_capacity(doc) + f"\n{posted}"

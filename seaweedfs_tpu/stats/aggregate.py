@@ -66,6 +66,11 @@ HEALTH_FAMILIES = {
     "requests_shed": "SeaweedFS_requests_shed_total",
     "deadline_exceeded": "SeaweedFS_deadline_exceeded_total",
     "retry_budget_exhausted": "SeaweedFS_retry_budget_exhausted_total",
+    # workload flight recorder (observability/reqlog.py): lost access
+    # records mean the recording a capacity baseline or replay is fit
+    # from under-represents the real stream — an observability-health
+    # condition worth paging on, never a degraded measurement
+    "reqlog_records_dropped": "SeaweedFS_reqlog_records_dropped_total",
 }
 
 # keys whose truth lives on the MASTER: the per-peer rollup reports 0

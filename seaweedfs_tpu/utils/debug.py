@@ -172,6 +172,7 @@ def _render_status_html(name: str, status: dict) -> str:
  <a href="/debug/traces/analyze?format=text">analyze</a>
  <a href="/debug/profile">profile</a>
  <a href="/debug/events">events</a>
+ <a href="/debug/reqlog">reqlog</a>
  <a href="/debug/flightrecorder">flight recorder</a>
 </div>
 {body}
@@ -327,6 +328,69 @@ def register_debug_routes(router: Router,
         return Response({"events": events, "count": len(events),
                          "namespace": j.namespace,
                          "dropped": j.dropped})
+
+    @router.route("GET", "/debug/reqlog")
+    def debug_reqlog(req: Request) -> Response:
+        """This process's workload flight recorder (observability/
+        reqlog.py): the sampled, redacted access-record ring both
+        ingress chokepoints feed.  Filters: ?route=, ?since=<unix ts>,
+        ?limit=N.  The `config` block carries the live knobs and loss
+        accounting."""
+        from ..observability.reqlog import get_recorder
+
+        rl = get_recorder()
+        try:
+            since_ts = float(req.query.get("since") or 0.0)
+            # clamp BOTH ways: a negative limit would slice as [-0:]
+            # downstream and return the whole ring, bypassing the cap
+            limit = min(max(int(req.query.get("limit") or 512), 1),
+                        8192)
+        except ValueError as e:
+            raise HttpError(400, f"bad query parameter: {e}")
+        records = rl.query(route=req.query.get("route") or None,
+                           since_ts=since_ts, limit=limit)
+        return Response({"records": records, "count": len(records),
+                         "config": rl.status()})
+
+    @router.route("POST", "/debug/reqlog/start")
+    def debug_reqlog_start(req: Request) -> Response:
+        """Start (or re-knob) workload recording on this server.  Body
+        knobs: sample (0..1], size (ring capacity), seed, include_ops,
+        reset (default true: a fresh recording window).  What
+        `weed shell workload.record` fans out cluster-wide."""
+        from ..observability.reqlog import get_recorder
+
+        try:
+            b = req.json()
+        except Exception:
+            b = {}
+        try:
+            sample = float(b["sample"]) if "sample" in b else None
+            size = int(b["size"]) if "size" in b else None
+            seed = int(b["seed"]) if "seed" in b else None
+        except (TypeError, ValueError):
+            raise HttpError(400, "bad sample/size/seed")
+        # out-of-range knobs answer 400 (the W601 convention), never a
+        # 200 that silently starts a recorder recording nothing
+        if sample is not None and not 0.0 < sample <= 1.0:
+            raise HttpError(400, f"sample={sample:g} out of (0, 1]")
+        if size is not None and size <= 0:
+            raise HttpError(400, f"size={size} must be positive")
+        rl = get_recorder()
+        rl.start(sample=sample, capacity=size, seed=seed,
+                 include_ops=(bool(b["include_ops"])
+                              if "include_ops" in b else None),
+                 reset=bool(b.get("reset", True)))
+        return Response(rl.status())
+
+    @router.route("POST", "/debug/reqlog/stop")
+    def debug_reqlog_stop(req: Request) -> Response:
+        """Stop recording; the ring keeps its records for export."""
+        from ..observability.reqlog import get_recorder
+
+        rl = get_recorder()
+        rl.stop()
+        return Response(rl.status())
 
     @router.route("POST", "/debug/flightrecorder/capture")
     def flightrecorder_capture(req: Request) -> Response:

@@ -18,11 +18,17 @@ from __future__ import annotations
 import socket
 import struct
 import threading
+import time as _time
 from typing import Callable, Optional
 
 from ..observability import context as _trace_context
 from ..observability import get_tracer as _get_tracer
+from ..observability import reqlog as _reqlog
 from . import deadline as _deadline
+
+# the process-global workload recorder (observability/reqlog.py): the
+# framed ingress reads ONE attribute per frame while recording is off
+_RECORDER = _reqlog.get_recorder()
 
 TCP_PORT_OFFSET = 20000
 U16 = struct.Struct(">H")
@@ -109,6 +115,11 @@ class FramedServer:
 
     def _serve_conn(self, conn: socket.socket) -> None:
         try:
+            peer = ""
+            try:
+                peer = conn.getpeername()[0]
+            except OSError:
+                pass
             while not self._stop.is_set():
                 try:
                     op = recv_exact(conn, 1)
@@ -118,6 +129,8 @@ class FramedServer:
                 key = recv_exact(conn, key_len).decode()
                 body_len = U32.unpack(recv_exact(conn, 4))[0]
                 body = recv_exact(conn, body_len) if body_len else b""
+                t_frame0 = _time.perf_counter() if _RECORDER.enabled \
+                    else 0.0
                 # trace ingress for the headerless native plane: frames
                 # have no Traceparent slot, so every framed op is its own
                 # head-based sampling decision (rate-gated), minted fresh
@@ -135,6 +148,7 @@ class FramedServer:
                 # restored), or a pooled connection thread would leak a
                 # previous request's budget into this frame
                 _ddl, _prev_ddl = _deadline.begin_request(None)
+                frame_status, out_len = 200, 0
                 try:
                     # gate on the sampled decision: the 21k-rps framed
                     # path must not build span names for unsampled ops
@@ -144,14 +158,34 @@ class FramedServer:
                             payload = self.handler(op, key, body)
                     else:
                         payload = self.handler(op, key, body)
+                    out_len = len(payload)
                     conn.sendall(b"\x00" + U32.pack(len(payload)) + payload)
                 except Exception as e:  # noqa: BLE001 - conn must survive
+                    frame_status = 500
                     msg = f"{type(e).__name__}: {e}".encode()[:65536]
+                    out_len = len(msg)
                     conn.sendall(b"\x01" + U32.pack(len(msg)) + msg)
                 finally:
                     _deadline.end_request(_prev_ddl)
                     if traced:
                         _trace_context.end_request(prev_ctx)
+                    if _RECORDER.enabled and t_frame0:
+                        # workload flight recorder (observability/
+                        # reqlog.py): the native plane's half of the
+                        # access record stream.  Frames carry no query
+                        # strings, so the key needs no redaction; the
+                        # route class comes from the op byte.
+                        try:
+                            _RECORDER.record(
+                                _reqlog.NATIVE_ROUTES.get(
+                                    op, f"native_{op.decode('latin-1')}"),
+                                "TCP", "/" + key, frame_status,
+                                bytes_in=len(body), bytes_out=out_len,
+                                duration_ms=(_time.perf_counter()
+                                             - t_frame0) * 1e3,
+                                peer=peer, handler=self.name)
+                        except Exception:
+                            pass  # recording never breaks the plane
         finally:
             conn.close()
 

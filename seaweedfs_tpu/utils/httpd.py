@@ -22,9 +22,15 @@ from typing import Callable, Optional
 
 from ..observability import context as _trace_context
 from ..observability import get_tracer as _get_tracer
+from ..observability import reqlog as _reqlog
 from ..observability.tracer import NOOP_SPAN as _NOOP_SPAN
 from . import deadline as _deadline
 from .deadline import DeadlineExceeded
+
+# the process-global workload recorder (observability/reqlog.py): the
+# dispatch chokepoint reads ONE attribute per request while recording
+# is off
+_RECORDER = _reqlog.get_recorder()
 
 
 class HttpError(Exception):
@@ -401,6 +407,15 @@ class Router:
                         self._drain_body(handler)
                         req._body = b""
                     self._send(handler, resp)
+                    if _RECORDER.enabled:
+                        # workload flight recorder (observability/
+                        # reqlog.py): one sampled access record per
+                        # dispatched request, redacted BEFORE it can
+                        # reach the ring.  Sits after _send so the
+                        # duration covers the transmission (for
+                        # streamed reads the send IS the work).
+                        self._record_access(handler, method, fn.__name__,
+                                            req, resp, shed, ddl, t0)
                 finally:
                     # release only after the RESPONSE left: for large
                     # streamed reads (Response(file_path=...)) the send
@@ -421,6 +436,52 @@ class Router:
             if traced:
                 _trace_context.end_request(_prev_ctx)
                 _trace_context.swap_server(_prev_srv)
+
+    @staticmethod
+    def _record_access(handler, method: str, handler_name: str,
+                       req: Request, resp: Response, shed: bool,
+                       ddl, t0: float) -> None:
+        """One sampled workload access record (observability/reqlog.py).
+        Only runs when the recorder is enabled; everything costly
+        (redaction, byte accounting) happens here, after the cheap
+        gate.  Never raises into the serving path."""
+        try:
+            if resp.raw is not None:
+                out = len(resp.raw)
+            elif resp.file_path is not None:
+                _off, length = resp.file_range or (0, -1)
+                out = length if length >= 0 else \
+                    os.path.getsize(resp.file_path)
+            elif resp.data is not None:
+                # cheap size estimate without re-serializing the body
+                out = len(str(resp.data))
+            else:
+                out = 0
+            try:
+                bytes_in = int(handler.headers.get("Content-Length") or 0)
+            except (TypeError, ValueError):
+                bytes_in = 0
+            peer = ""
+            addr = getattr(handler, "client_address", None)
+            if addr:
+                peer = str(addr[0])
+            path = _reqlog.redact_query(handler.path)
+            dur_s = _time.perf_counter() - t0
+            # the recorded budget is the caller's budget at INGRESS
+            # (what a replay spec's deadline_s should reproduce), not
+            # what was left after the handler ran
+            _RECORDER.record(
+                _reqlog.classify_route(method, req.path, handler_name,
+                                       query=req.query),
+                method, path, resp.status,
+                bytes_in=bytes_in, bytes_out=out,
+                duration_ms=dur_s * 1e3,
+                deadline_s=(ddl.remaining() + dur_s
+                            if ddl is not None else None),
+                shed=shed, degraded=resp.status >= 500, peer=peer,
+                handler=handler_name)
+        except Exception:
+            pass  # recording must never break the serving path
 
     @staticmethod
     def _drain_body(handler: BaseHTTPRequestHandler) -> None:

@@ -159,6 +159,15 @@ class VolumeServer:
         self._event_shipper = EventShipper(
             get_journal(), server=self.url,
             master_url_fn=lambda: self.master_url)
+        # workload access-record shipping to the master's /cluster/
+        # workload journal (observability/reqlog.py, same transport):
+        # the recorder itself is process-global and off by default —
+        # the shipper just stands ready for `workload.record`
+        from ..observability.reqlog import ReqlogShipper, get_recorder
+
+        self._reqlog_shipper = ReqlogShipper(
+            get_recorder(), server=self.url,
+            master_url_fn=lambda: self.master_url)
         if directories:
             get_flightrecorder().configure(
                 spool_dir=os.path.join(directories[0], "flightrecorder"))
@@ -258,6 +267,7 @@ class VolumeServer:
                         replicate_delete=self._tcp_replicate_delete).start(),
                     role="volume-tcp", server=self.url)
         self._trace_shipper.attach()
+        self._reqlog_shipper.attach()
         threading.Thread(target=self._heartbeat_loop, daemon=True,
                          name=f"heartbeat:{self.url}").start()
         return self
@@ -266,6 +276,7 @@ class VolumeServer:
         self._stop.set()
         self._trace_shipper.detach()
         self._event_shipper.detach()
+        self._reqlog_shipper.detach()
         self.scrubber.stop(join_timeout=0.5)
         if self._tcp_server is not None:
             self._tcp_server.stop()

@@ -1,0 +1,209 @@
+"""tools/bench_diff.py — tier-1.
+
+Gates: planted regression/improvement pairs produce the right verdict
+and exit code, direction-aware metrics (mttr_s: lower is better) are
+scored correctly, schema-version mismatches REFUSE to compare (exit 2)
+instead of misreporting, both accepted document shapes load, and a
+metric that silently vanished from the new round is reported.
+"""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from tools.bench_diff import (
+    KEY_METRICS,
+    compare,
+    load_document,
+    lookup,
+    main,
+    render,
+    schema_version,
+)
+
+
+def bench_line(detail: dict, schema: int = 2) -> dict:
+    d = dict(detail)
+    d.setdefault("schema_version", schema)
+    d.setdefault("git_revision", "abc1234")
+    return {"metric": "ec.encode MB/s", "value": 1.0, "unit": "MB/s",
+            "vs_baseline": 1.0, "detail": d}
+
+
+def round_doc(detail: dict, schema: int = 2, n: int = 7) -> dict:
+    return {"n": n, "cmd": "python bench.py", "rc": 0,
+            "tail": "...", "parsed": bench_line(detail, schema)}
+
+
+BASE = {
+    "cluster_read_rps": 4000.0,
+    "cpu_simd_mbps": 6600.0,
+    "capacity": {"http_read": {"capacity_rps": 4200.0},
+                 "native_read": {"capacity_rps": 21000.0}},
+    "e2e_pipeline_disk": {"overlap_efficiency": 0.96},
+    "coordinator": {"mttr_s": 2.0},
+}
+
+
+class TestCompare:
+    def test_clean_when_nothing_moved(self):
+        rep = compare(bench_line(BASE), bench_line(BASE))
+        assert rep["regressions"] == [] and rep["improvements"] == []
+
+    def test_planted_regression_flagged(self):
+        new = json.loads(json.dumps(BASE))
+        new["cluster_read_rps"] = 3200.0  # -20%
+        rep = compare(bench_line(BASE), bench_line(new))
+        assert [r["metric"] for r in rep["regressions"]] == \
+            ["cluster_read_rps"]
+        assert rep["regressions"][0]["change_pct"] == -20.0
+
+    def test_planted_improvement_flagged_not_failing(self):
+        new = json.loads(json.dumps(BASE))
+        new["capacity"]["http_read"]["capacity_rps"] = 8400.0
+        rep = compare(bench_line(BASE), bench_line(new))
+        assert rep["regressions"] == []
+        assert [r["metric"] for r in rep["improvements"]] == \
+            ["capacity.http_read.capacity_rps"]
+
+    def test_small_move_inside_threshold_is_ok(self):
+        new = json.loads(json.dumps(BASE))
+        new["cluster_read_rps"] = 3650.0  # -8.75%
+        rep = compare(bench_line(BASE), bench_line(new))
+        assert rep["regressions"] == []
+
+    def test_down_direction_metric_scored_inverted(self):
+        worse = json.loads(json.dumps(BASE))
+        worse["coordinator"]["mttr_s"] = 3.0  # +50% recovery time
+        rep = compare(bench_line(BASE), bench_line(worse))
+        assert [r["metric"] for r in rep["regressions"]] == \
+            ["coordinator.mttr_s"]
+        better = json.loads(json.dumps(BASE))
+        better["coordinator"]["mttr_s"] = 1.0
+        rep = compare(bench_line(BASE), bench_line(better))
+        assert rep["regressions"] == []
+        assert [r["metric"] for r in rep["improvements"]] == \
+            ["coordinator.mttr_s"]
+
+    def test_absolute_floor_tames_near_zero_pct_metrics(self):
+        # overhead pcts live near 0: 0.2 -> 0.5 is +150% relative but
+        # both sit inside the <1% acceptance bar — noise, not a
+        # regression.  A move past the floor still flags.
+        old = json.loads(json.dumps(BASE))
+        old["capacity"] = dict(old["capacity"],
+                               reqlog_read_overhead_pct=0.2)
+        new = json.loads(json.dumps(old))
+        new["capacity"]["reqlog_read_overhead_pct"] = 0.5
+        rep = compare(bench_line(old), bench_line(new))
+        assert rep["regressions"] == []
+        # old == 0 must not read as an infinite regression either
+        old["capacity"]["reqlog_read_overhead_pct"] = 0.0
+        rep = compare(bench_line(old), bench_line(new))
+        assert rep["regressions"] == []
+        new["capacity"]["reqlog_read_overhead_pct"] = 2.5
+        rep = compare(bench_line(old), bench_line(new))
+        assert [r["metric"] for r in rep["regressions"]] == \
+            ["capacity.reqlog_read_overhead_pct"]
+
+    def test_schema_mismatch_refused(self):
+        with pytest.raises(ValueError, match="schema mismatch"):
+            compare(bench_line(BASE, schema=1), bench_line(BASE,
+                                                          schema=2))
+
+    def test_prestamp_documents_read_as_v1_and_compare(self):
+        old = {"detail": dict(BASE)}  # rounds 1-5: no stamp at all
+        new = {"detail": dict(BASE)}
+        assert schema_version(old) == 1
+        rep = compare(old, new)
+        assert rep["schema_version"] == 1
+        assert rep["regressions"] == []
+
+    def test_metric_vanishing_from_new_is_reported(self):
+        new = json.loads(json.dumps(BASE))
+        del new["coordinator"]
+        rep = compare(bench_line(BASE), bench_line(new))
+        assert "coordinator.mttr_s" in rep["missing_in_new"]
+
+    def test_revisions_ride_the_report(self):
+        old = bench_line(dict(BASE))
+        old["detail"]["git_revision"] = "old1234"
+        rep = compare(old, bench_line(BASE))
+        assert rep["old_revision"] == "old1234"
+        assert rep["new_revision"] == "abc1234"
+
+
+class TestLoadAndLookup:
+    def test_round_shape_and_bare_line_both_load(self, tmp_path):
+        p1 = tmp_path / "round.json"
+        p1.write_text(json.dumps(round_doc(BASE)))
+        p2 = tmp_path / "line.json"
+        p2.write_text(json.dumps(bench_line(BASE)))
+        assert load_document(str(p1))["detail"]["cluster_read_rps"] \
+            == 4000.0
+        assert load_document(str(p2))["detail"]["cluster_read_rps"] \
+            == 4000.0
+
+    def test_round_with_null_parsed_refused(self, tmp_path):
+        p = tmp_path / "dead.json"
+        p.write_text(json.dumps({"n": 5, "cmd": "x", "rc": -9,
+                                 "tail": "boom", "parsed": None}))
+        with pytest.raises(ValueError, match="no parsed bench line"):
+            load_document(str(p))
+
+    def test_lookup_dotted_paths(self):
+        assert lookup(BASE, "capacity.http_read.capacity_rps") == 4200.0
+        assert lookup(BASE, "capacity.missing.x") is None
+        assert lookup({"flag": True}, "flag") is None  # bools excluded
+
+    def test_registered_metrics_have_directions(self):
+        for entry in KEY_METRICS:
+            assert entry[1] in ("up", "down"), entry
+            if len(entry) > 2:
+                assert float(entry[2]) > 0, entry
+
+
+class TestCli:
+    def _write(self, tmp_path, name, detail, schema=2):
+        p = tmp_path / name
+        p.write_text(json.dumps(round_doc(detail, schema)))
+        return str(p)
+
+    def test_exit_codes(self, tmp_path, capsys):
+        old = self._write(tmp_path, "old.json", BASE)
+        worse = json.loads(json.dumps(BASE))
+        worse["cluster_read_rps"] = 2000.0
+        new_bad = self._write(tmp_path, "bad.json", worse)
+        assert main([old, old]) == 0
+        assert main([old, new_bad]) == 1
+        out = capsys.readouterr().out
+        assert "REGRESSION" in out and "cluster_read_rps" in out
+        # schema mismatch and usage errors are 2, not 1
+        cross = self._write(tmp_path, "v1.json", BASE, schema=1)
+        assert main([old, cross]) == 2
+        assert main([old]) == 2
+        assert main([old, new_bad, "--threshold", "abc"]) == 2
+
+    def test_json_output_stable(self, tmp_path, capsys):
+        old = self._write(tmp_path, "old.json", BASE)
+        assert main([old, old, "--json"]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert {"rows", "regressions", "improvements",
+                "missing_in_new", "threshold_pct"} <= set(doc)
+
+    def test_custom_threshold(self, tmp_path):
+        old = self._write(tmp_path, "old.json", BASE)
+        mild = json.loads(json.dumps(BASE))
+        mild["cluster_read_rps"] = 3650.0  # -8.75%
+        new = self._write(tmp_path, "mild.json", mild)
+        assert main([old, new]) == 0
+        assert main([old, new, "--threshold", "0.05"]) == 1
+
+    def test_render_marks_missing(self):
+        rep = {"threshold_pct": 10.0, "schema_version": 2,
+               "old_revision": "a", "new_revision": "b",
+               "rows": [], "regressions": [], "improvements": [],
+               "missing_in_new": ["coordinator.mttr_s"]}
+        out = render(rep)
+        assert "MISSING" in out and "coordinator.mttr_s" in out

@@ -1,0 +1,197 @@
+"""SLO capacity probe (scenarios/capacity.py) — tier-1.
+
+Gates: one open-loop measurement actually delivers its scheduled rate
+(and reports lag when it cannot), the ramp + binary search brackets a
+synthetic service's KNOWN capacity, the knee names the bound that
+broke, error-bound breaches are their own knee reason, and the
+rendered view is stable.  The live cluster probe is exercised by the
+bench `capacity` section; here a deterministic lock-bound fake keeps
+the tier-1 clock honest.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from seaweedfs_tpu.scenarios.capacity import (
+    CapacitySLO,
+    find_capacity,
+    measure_rate,
+    render_capacity,
+)
+
+
+def lock_bound_service(capacity_rps: float):
+    """A service that can do exactly capacity_rps ops/s: each op holds
+    one lock for 1/C seconds — beyond C the convoy grows and p99 /
+    schedule lag blow up, exactly like a saturated single-threaded
+    server."""
+    lock = threading.Lock()
+    hold = 1.0 / capacity_rps
+
+    def op() -> bool:
+        with lock:
+            time.sleep(hold)
+        return True
+
+    return op
+
+
+class TestMeasureRate:
+    def test_open_loop_hits_target_when_service_is_fast(self):
+        step = measure_rate(lambda: True, rps=400, duration_s=1.0)
+        assert step["achieved_rps"] >= 0.92 * 400
+        assert step["errors"] == 0
+        assert step["error_ratio"] == 0.0
+        assert step["ops"] == 400
+
+    def test_saturation_shows_as_lag_not_a_slower_schedule(self):
+        # a 100 rps service offered 800 rps: open-loop means the
+        # schedule does NOT stretch — achieved collapses toward the
+        # service rate and lag grows
+        step = measure_rate(lock_bound_service(100.0), rps=800,
+                            duration_s=1.0)
+        assert step["achieved_rps"] < 0.5 * 800
+        assert step["max_lag_ms"] > 100.0
+
+    def test_errors_counted(self):
+        calls = [0]
+
+        def op() -> bool:
+            calls[0] += 1
+            return calls[0] % 2 == 0
+
+        step = measure_rate(op, rps=200, duration_s=0.5)
+        assert step["error_ratio"] == pytest.approx(0.5, abs=0.1)
+
+    def test_exceptions_count_as_errors(self):
+        def op() -> bool:
+            raise OSError("wire gone")
+
+        step = measure_rate(op, rps=100, duration_s=0.3)
+        assert step["error_ratio"] == 1.0
+
+
+class TestFindCapacity:
+    def test_brackets_known_capacity_and_names_the_knee(self):
+        C = 400.0
+        res = find_capacity(lock_bound_service(C),
+                            CapacitySLO(max_p99_ms=40.0),
+                            start_rps=50, max_rps=4000, step_s=0.7,
+                            search_steps=3)
+        assert res["knee_rps"] is not None
+        assert res["knee"]["reason"]
+        # capacity within the honest band: above half the service
+        # rate (the convoy starts biting before C) and never above it
+        assert 0.3 * C <= res["capacity_rps"] <= 1.15 * C
+        # the curve is on the document
+        assert len(res["samples"]) >= 3
+        assert res["samples"][0]["sustainable"] is True
+
+    def test_error_bound_is_its_own_knee_reason(self):
+        # the fake starts failing once the offered rate passes 400:
+        # a deterministic error-bound knee with latency always fine
+        calls = {"rate": 0.0}
+        orig = measure_rate
+
+        def op2() -> bool:
+            return calls["rate"] <= 400
+
+        def patched(op_fn, rps, duration_s, workers=0):
+            calls["rate"] = rps
+            return orig(op_fn, rps, duration_s, workers)
+
+        import seaweedfs_tpu.scenarios.capacity as cap_mod
+
+        cap_mod_measure = cap_mod.measure_rate
+        cap_mod.measure_rate = patched
+        try:
+            res = cap_mod.find_capacity(
+                op2, CapacitySLO(), start_rps=100, max_rps=3200,
+                step_s=0.2, search_steps=2)
+        finally:
+            cap_mod.measure_rate = cap_mod_measure
+        assert res["knee"] is not None
+        assert "error_ratio" in res["knee"]["reason"]
+        assert res["capacity_rps"] > 0
+
+    def test_searches_below_a_breaching_start_rps(self):
+        # a ~40rps service probed with start_rps=200 must report its
+        # real capacity, not 0.0 — the parked/bench baseline would
+        # otherwise anchor every future comparison to a bogus zero
+        C = 40.0
+        res = find_capacity(lock_bound_service(C),
+                            CapacitySLO(max_p99_ms=60.0),
+                            start_rps=200, max_rps=800, step_s=0.6,
+                            search_steps=2)
+        assert res["capacity_rps"] > 0.0
+        assert 0.3 * C <= res["capacity_rps"] <= 1.2 * C
+        assert res["knee"] is not None
+
+    def test_no_knee_when_cap_never_breaks(self):
+        res = find_capacity(lambda: True,
+                            CapacitySLO(max_p99_ms=1000.0),
+                            start_rps=100, max_rps=400, step_s=0.3)
+        assert res["knee"] is None and res["knee_rps"] is None
+        assert res["capacity_rps"] >= 0.9 * 400
+
+
+class TestRender:
+    def test_render_one_line_per_route(self):
+        doc = {"slo": {"max_p99_ms": 5.0, "max_error_ratio": 0.001},
+               "routes": {
+                   "http_read": {"capacity_rps": 4200.0,
+                                 "capacity_p99_ms": 3.1,
+                                 "knee_rps": 4800.0,
+                                 "knee": {"reason": "p99 7.0ms > 5ms"},
+                                 "bounding": {"resource": "server",
+                                              "bounding_hop":
+                                                  "volume 127.0.0.1"}},
+                   "native_read": {"capacity_rps": 21000.0,
+                                   "capacity_p99_ms": 1.0,
+                                   "knee_rps": None, "knee": None,
+                                   "bounding": {"resource": "unknown"}},
+                   "broken": {"error": "unknown route"}}}
+        out = render_capacity(doc)
+        assert "http_read" in out and "capacity=4200 rps" in out
+        assert "knee@4800rps" in out and "bound=server" in out
+        assert "no knee found" in out
+        assert "error: unknown route" in out
+
+    def test_slo_dataclass_dict(self):
+        assert CapacitySLO().to_dict() == {"max_p99_ms": 5.0,
+                                           "max_error_ratio": 0.001}
+
+
+class TestShellSurface:
+    def test_workload_and_capacity_commands_registered(self):
+        from seaweedfs_tpu.shell import COMMANDS
+
+        for name in ("workload.record", "workload.stop",
+                     "workload.export", "workload.replay",
+                     "capacity.probe"):
+            assert name in COMMANDS, name
+
+    def test_workload_record_fanout_includes_filer(self):
+        # filers are absent from /dir/status topology: a fan-out built
+        # from it alone would silently omit the whole filer workload
+        from seaweedfs_tpu.shell import CommandEnv
+        from seaweedfs_tpu.shell.workload_commands import _all_servers
+
+        env = CommandEnv("m:1", filer_url="f:2")
+        env.topology = lambda: {"DataCenters": [
+            {"Racks": [{"DataNodes": [{"Url": "v:3"}]}]}]}
+        assert _all_servers(env) == ["m:1", "v:3", "f:2"]
+
+    def test_capacity_probe_requires_admin_lock(self):
+        # the probe drives a live cluster to its knee and writes load
+        # objects: it must refuse without the exclusive lock, before
+        # touching any server
+        from seaweedfs_tpu.shell import CommandEnv, run_command
+
+        env = CommandEnv("127.0.0.1:1")  # never contacted
+        with pytest.raises(RuntimeError, match="lock is needed"):
+            run_command(env, "capacity.probe")

@@ -1,0 +1,659 @@
+"""Workload flight recorder + trace-driven replay (ISSUE 14) — tier-1.
+
+Gates, unit side: the ring is bounded and loss-counted, sampling is
+deterministic under a fixed seed, redaction strips credential query
+values at record time, the shipper counts what it could not deliver,
+and the recording->spec fit (Zipf skew, size mix, op mix) lands within
+tolerance on synthetic recordings.
+
+Gates, live side (the ISSUE acceptance drill): a real master + volume
+server record a mixed workload driven over BOTH planes (HTTP + framed
+TCP) with a ``?jwt=`` credential in flight; the records ship to the
+master's /cluster/workload journal; the exported recording carries no
+secret; ``spec_from_recording`` fits it; ``run_scenario`` replays it
+open-loop; and the replay's verdict AND the machine-checked fidelity
+list (op mix / size mix / hot-set head) are green.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import tempfile
+import time
+
+import pytest
+
+from seaweedfs_tpu.observability.reqlog import (
+    ReqlogRecorder,
+    ReqlogShipper,
+    WorkloadJournal,
+    classify_route,
+    get_recorder,
+    redact_query,
+    summarize_records,
+)
+from seaweedfs_tpu.scenarios.replay import (
+    estimate_zipf_s,
+    fit_size_mix,
+    recording_profile,
+    replay_fidelity,
+    spec_from_recording,
+)
+
+from tests.conftest import free_port
+
+
+@pytest.fixture(autouse=True)
+def _recorder_off():
+    """The process-global recorder must never leak an enabled state
+    (or records) between tests — other suites drive HTTP traffic."""
+    rl = get_recorder()
+    yield
+    rl.stop()
+    rl.clear()
+
+
+# --- redaction ---------------------------------------------------------------
+
+class TestRedaction:
+    def test_jwt_value_redacted_benign_params_survive(self):
+        out = redact_query("/3,01ab?jwt=eyJSECRET&count=2&ttl=3m")
+        assert "eyJSECRET" not in out
+        assert "jwt=REDACTED" in out
+        assert "count=2" in out and "ttl=3m" in out
+
+    @pytest.mark.parametrize("param", [
+        "token", "auth", "Authorization", "sig", "Signature", "secret",
+        "password", "key", "X-Amz-Signature", "X-Amz-Security-Token"])
+    def test_credential_params_redacted_case_insensitive(self, param):
+        out = redact_query(f"/x?{param}=HUSH123")
+        assert "HUSH123" not in out and "REDACTED" in out
+
+    def test_plain_path_untouched(self):
+        assert redact_query("/3,01ab") == "/3,01ab"
+
+    def test_keys_param_is_data_not_credential(self):
+        # exact-key matching: `keys` must not be mistaken for `key`
+        assert redact_query("/l?keys=a%2Cb") == "/l?keys=a%2Cb"
+
+    def test_encoded_values_round_trip_intact(self):
+        # percent/plus-encoded values must survive redaction: a
+        # decoded-then-bare-joined '%26' would split one parameter
+        # into two and corrupt the recorded path
+        import urllib.parse
+
+        out = redact_query("/3,01ab?filename=a%26b%3Dc&n=x+y")
+        pairs = dict(urllib.parse.parse_qsl(out.partition("?")[2]))
+        assert pairs == {"filename": "a&b=c", "n": "x y"}
+
+
+class TestClassify:
+    @pytest.mark.parametrize("method,path,want", [
+        ("GET", "/3,01ab", "http_read"),
+        ("HEAD", "/3,01ab", "http_read"),
+        ("POST", "/3,01ab", "http_write"),
+        ("PUT", "/3,01ab", "http_write"),
+        ("DELETE", "/3,01ab", "http_delete"),
+        ("POST", "/submit", "http_write"),
+        ("GET", "/dir/assign", "assign"),
+        ("GET", "/dir/lookup", "lookup"),
+        ("GET", "/metrics", "ops"),
+        ("GET", "/cluster/workload/ingest", "ops"),
+        ("GET", "/debug/reqlog", "ops"),
+        ("GET", "/some/unknown", "other"),
+    ])
+    def test_route_classes(self, method, path, want):
+        assert classify_route(method, path) == want
+
+    def test_server_to_server_hops_classify_internal(self):
+        # replication fan-out and the master's /submit upload proxy
+        # are NOT client workload: recording them would double-count
+        # every proxied/replicated write in the fitted replay spec
+        assert classify_route("POST", "/3,01ab",
+                              query={"type": "replicate"}) == "internal"
+        assert classify_route("POST", "/3,01ab",
+                              query={"type": "proxied"}) == "internal"
+        assert classify_route("DELETE", "/3,01ab",
+                              query={"type": "replicate"}) == "internal"
+
+    def test_internal_skipped_like_ops(self):
+        rl = ReqlogRecorder(capacity=8, sample=1.0)
+        rl.start()
+        assert rl.record("internal", "POST", "/3,01ab", 200) is None
+
+
+# --- recorder ring -----------------------------------------------------------
+
+class TestRecorder:
+    def test_ring_bounds_and_eviction_counted(self):
+        rl = ReqlogRecorder(capacity=16, sample=1.0)
+        rl.start()
+        for i in range(40):
+            rl.record("http_read", "GET", f"/1,{i:02x}", 200)
+        st = rl.status()
+        assert st["records"] == 16
+        assert st["recorded"] == 40
+        assert st["dropped"] == 24
+        # the ring keeps the NEWEST records
+        kept = [r["path"] for r in rl.query(limit=0)]
+        assert kept[-1] == "/1,27" and len(kept) == 16
+
+    def test_sampling_deterministic_under_fixed_seed(self):
+        def run(seed):
+            rl = ReqlogRecorder(capacity=256, sample=0.5, seed=seed)
+            rl.start()
+            return [rl.record("http_read", "GET", "/1,aa", 200)
+                    is not None for _ in range(200)]
+
+        a, b = run(1234), run(1234)
+        assert a == b
+        assert 40 < sum(a) < 160  # it actually samples, not all/none
+        assert run(99) != a  # and the seed matters
+
+    def test_start_resets_window_and_rng(self):
+        rl = ReqlogRecorder(capacity=64, sample=0.5, seed=7)
+        rl.start()
+        first = [rl.record("http_read", "GET", "/1,aa", 200)
+                 is not None for _ in range(50)]
+        rl.start()  # fresh window: same seed -> same decisions again
+        again = [rl.record("http_read", "GET", "/1,aa", 200)
+                 is not None for _ in range(50)]
+        assert first == again
+
+    def test_ops_routes_skipped_unless_opted_in(self):
+        rl = ReqlogRecorder(capacity=64, sample=1.0)
+        rl.start()
+        assert rl.record("ops", "GET", "/metrics", 200) is None
+        rl.configure(include_ops=True)
+        assert rl.record("ops", "GET", "/metrics", 200) is not None
+
+    def test_configure_shrink_counts_lost_records(self):
+        rl = ReqlogRecorder(capacity=32, sample=1.0)
+        rl.start()
+        for i in range(32):
+            rl.record("http_read", "GET", f"/1,{i:02x}", 200)
+        rl.configure(capacity=16)
+        assert rl.status()["records"] == 16
+        assert rl.status()["dropped"] == 16
+
+    def test_configure_capacity_zero_clamps_and_counts(self):
+        # capacity=0 must not hit the [-0:] falsy slice (truncate to
+        # the floor while counting NOTHING): it clamps to the floor
+        # and every lost record is counted
+        rl = ReqlogRecorder(capacity=64, sample=1.0)
+        rl.start()
+        for i in range(64):
+            rl.record("http_read", "GET", f"/1,{i:02x}", 200)
+        rl.configure(capacity=0)
+        st = rl.status()
+        assert st["capacity"] == 16
+        assert st["records"] == 16
+        assert st["dropped"] == 48
+
+    def test_sample_rate_stamped_on_records(self):
+        rl = ReqlogRecorder(capacity=64, sample=0.5, seed=3)
+        rl.start()
+        recs = [rl.record("http_read", "GET", "/1,aa", 200)
+                for _ in range(40)]
+        recs = [r for r in recs if r is not None]
+        assert recs and all(r.to_dict()["sample"] == 0.5 for r in recs)
+        # full-rate records omit the key (the compact default)
+        rl2 = ReqlogRecorder(capacity=8, sample=1.0)
+        rl2.start()
+        d = rl2.record("http_read", "GET", "/1,aa", 200).to_dict()
+        assert "sample" not in d
+
+    def test_record_flags_and_fields(self):
+        rl = ReqlogRecorder(capacity=8, sample=1.0)
+        rl.start()
+        rec = rl.record("http_read", "GET", "/1,aa", 503,
+                        bytes_in=10, bytes_out=20, duration_ms=1.5,
+                        deadline_s=2.0, shed=True, degraded=True,
+                        peer="10.0.0.9", handler="volume_download")
+        d = rec.to_dict()
+        assert d["shed"] is True and d["degraded"] is True
+        assert d["ddl_s"] == 2.0 and d["peer"] == "10.0.0.9"
+        assert d["in"] == 10 and d["out"] == 20
+        assert d["id"].startswith(rl.namespace)
+
+
+# --- journal + shipper -------------------------------------------------------
+
+class TestWorkloadJournal:
+    def _rec(self, i, route="http_read"):
+        return {"id": f"t.{i:x}", "seq": i, "ts": 1000.0 + i,
+                "route": route, "method": "GET", "path": f"/1,{i:x}",
+                "status": 200, "in": 0, "out": 4096, "ms": 1.0}
+
+    def test_dedup_and_bounded_eviction(self):
+        j = WorkloadJournal(capacity=8)
+        batch = [self._rec(i) for i in range(6)]
+        assert j.ingest("vs1", batch) == 6
+        assert j.ingest("vs2", batch) == 0  # chained-shipper dedup
+        j.ingest("vs1", [self._rec(i) for i in range(6, 16)])
+        assert len(j) == 8
+        assert j.dropped == 8
+
+    def test_export_document_shape(self):
+        j = WorkloadJournal()
+        j.ingest("vs1", [self._rec(i) for i in range(5)]
+                 + [self._rec(10, route="http_write")])
+        doc = j.export()
+        assert doc["format"].startswith("seaweedfs-tpu-workload")
+        assert doc["summary"]["records"] == 6
+        assert doc["summary"]["routes"]["http_read"]["ops"] == 5
+        # time-ordered
+        ts = [r["ts"] for r in doc["records"]]
+        assert ts == sorted(ts)
+
+    def test_query_filters(self):
+        j = WorkloadJournal()
+        j.ingest("vs1", [self._rec(1), self._rec(2, route="http_write")])
+        assert [r["route"] for r in j.query(route="http_write")] == \
+            ["http_write"]
+        assert j.query(since_ts=1001.5)[0]["route"] == "http_write"
+
+
+class TestShipper:
+    def test_local_short_circuit(self):
+        rl = ReqlogRecorder(capacity=64, sample=1.0)
+        rl.start()
+        j = WorkloadJournal()
+        sh = ReqlogShipper(rl, server="m:1", local_journal=j,
+                           flush_interval=0.05).attach()
+        try:
+            for i in range(10):
+                rl.record("http_read", "GET", f"/1,{i:x}", 200)
+            deadline = time.time() + 5
+            while time.time() < deadline and len(j) < 10:
+                time.sleep(0.05)
+            assert len(j) == 10
+            assert sh.shipped == 10 and sh.dropped == 0
+        finally:
+            sh.detach()
+
+    def test_transport_loss_counted_never_raises(self):
+        from seaweedfs_tpu.observability.reqlog import _dropped_counter
+
+        rl = ReqlogRecorder(capacity=64, sample=1.0)
+        rl.start()
+        before = _dropped_counter().snapshot().get(("ship_error",), 0)
+        # nothing listens on this port: every flush must fail, count,
+        # and leave the recording path unharmed
+        sh = ReqlogShipper(rl, server="vs:1",
+                           master_url_fn=lambda: f"127.0.0.1:{free_port()}",
+                           flush_interval=0.05).attach()
+        try:
+            for i in range(8):
+                rl.record("http_read", "GET", f"/1,{i:x}", 200)
+            deadline = time.time() + 8
+            while time.time() < deadline and sh.dropped < 8:
+                time.sleep(0.05)
+            assert sh.dropped >= 8
+            after = _dropped_counter().snapshot().get(("ship_error",), 0)
+            assert after - before >= 8
+        finally:
+            sh.detach()
+
+    def test_buffer_overflow_counted(self):
+        rl = ReqlogRecorder(capacity=512, sample=1.0)
+        rl.start()
+        sh = ReqlogShipper(rl, server="vs:1", buffer_cap=4,
+                           flush_interval=60.0,  # never flushes in test
+                           master_url_fn=lambda: "")
+        sh._prev_hook = rl.on_record
+        rl.on_record = sh._on_record  # attach without the flush thread
+        try:
+            for i in range(10):
+                rl.record("http_read", "GET", f"/1,{i:x}", 200)
+            assert sh.dropped == 6  # cap 4, 10 offered
+        finally:
+            rl.on_record = sh._prev_hook
+
+
+# --- fit ---------------------------------------------------------------------
+
+class TestFit:
+    def test_zipf_estimate_recovers_known_skew(self):
+        from seaweedfs_tpu.scenarios import ZipfSampler
+
+        rng = random.Random(11)
+        for s in (0.8, 1.2):
+            z = ZipfSampler(128, s)
+            counts: dict[int, int] = {}
+            for _ in range(30000):
+                r = z.sample(rng)
+                counts[r] = counts.get(r, 0) + 1
+            est = estimate_zipf_s(list(counts.values()))
+            assert abs(est - s) < 0.35, (s, est)
+
+    def test_zipf_degenerate_inputs(self):
+        assert estimate_zipf_s([]) == 0.0
+        assert estimate_zipf_s([100]) == 0.0
+        # uniform counts -> no skew
+        assert estimate_zipf_s([50] * 20) < 0.1
+
+    def test_size_mix_buckets_by_magnitude(self):
+        sizes = [4096] * 90 + [65536] * 8 + [1 << 20] * 2
+        mix = fit_size_mix(sizes)
+        assert [b for b, _w in mix] == [4096, 65536, 1 << 20]
+        weights = dict(mix)
+        assert weights[4096] == pytest.approx(0.9, abs=0.01)
+
+    def test_size_mix_empty_falls_back(self):
+        assert fit_size_mix([]) == ((4096, 1.0),)
+
+    def _recording(self, n_reads=120, n_writes=30, n_deletes=10,
+                   zipf_s=1.2, keys=24):
+        from seaweedfs_tpu.scenarios import ZipfSampler
+
+        rng = random.Random(5)
+        z = ZipfSampler(keys, zipf_s)
+        records = []
+        ts = 1000.0
+        seq = 0
+        for _ in range(n_reads):
+            seq += 1
+            ts += 0.01
+            records.append({"id": f"s.{seq:x}", "seq": seq, "ts": ts,
+                            "route": "http_read", "method": "GET",
+                            "path": f"/1,{z.sample(rng):04x}",
+                            "status": 200, "in": 0, "out": 4096,
+                            "ms": 1.0, "ddl_s": 2.0})
+        for i in range(n_writes):
+            seq += 1
+            ts += 0.01
+            records.append({"id": f"s.{seq:x}", "seq": seq, "ts": ts,
+                            "route": "http_write", "method": "POST",
+                            "path": f"/2,{i:04x}", "status": 201,
+                            "in": 4096 if i % 5 else 65536, "out": 30,
+                            "ms": 2.0, "ddl_s": 2.0,
+                            "handler": "submit" if i % 2 else "upload"})
+        for i in range(n_deletes):
+            seq += 1
+            ts += 0.01
+            records.append({"id": f"s.{seq:x}", "seq": seq, "ts": ts,
+                            "route": "http_delete", "method": "DELETE",
+                            "path": f"/2,{i:04x}", "status": 200,
+                            "in": 0, "out": 10, "ms": 0.5})
+        # ops noise that must NOT replay
+        records.append({"id": "s.ops", "seq": seq + 1, "ts": ts,
+                        "route": "ops", "method": "GET",
+                        "path": "/metrics", "status": 200, "in": 0,
+                        "out": 9000, "ms": 1.0})
+        return {"format": "seaweedfs-tpu-workload-recording-v1",
+                "records": records}
+
+    def test_profile_and_spec_fit(self):
+        rec = self._recording()
+        prof = recording_profile(rec)
+        assert prof["records"] == 160  # ops excluded
+        assert prof["read_fraction"] == pytest.approx(0.75, abs=0.01)
+        assert prof["churn_fraction"] == pytest.approx(0.25, abs=0.01)
+        assert prof["submit_fraction"] == pytest.approx(0.5, abs=0.05)
+        spec = spec_from_recording(rec, duration_s=5)
+        assert spec.read_fraction == prof["read_fraction"]
+        assert spec.target_rps > 0
+        assert spec.hot_set == prof["distinct_keys"]
+        assert 0.5 < spec.zipf_s < 2.0
+        # spec round-trips through the ScenarioSpec dict shape
+        from seaweedfs_tpu.scenarios import ScenarioSpec
+
+        assert ScenarioSpec.from_dict(spec.to_dict()) == spec
+
+    def test_fidelity_green_on_faithful_fit(self):
+        rec = self._recording()
+        spec = spec_from_recording(rec, duration_s=5)
+        checks = replay_fidelity(rec, spec)
+        assert checks and all(c["ok"] for c in checks), checks
+
+    def test_fidelity_flags_a_wrong_fit(self):
+        rec = self._recording()
+        spec = spec_from_recording(rec, duration_s=5)
+        # sabotage the op mix: a read-only spec replaying a mixed
+        # recording must FAIL the machine check
+        spec.read_fraction = 1.0
+        checks = replay_fidelity(rec, spec)
+        assert any(c["check"] == "fidelity_op_mix" and not c["ok"]
+                   for c in checks)
+
+    def test_empty_recording_refused(self):
+        with pytest.raises(ValueError):
+            spec_from_recording({"records": []})
+        with pytest.raises(ValueError):
+            # ops-only traffic is not a workload
+            spec_from_recording({"records": [
+                {"id": "x", "route": "ops", "ts": 1.0}]})
+
+    def test_sampled_recording_corrects_arrival_rate(self):
+        """A -sample 0.1 recording stands for 10x its record count:
+        the fitted target_rps must reproduce PRODUCTION arrivals, not
+        a tenth of them (the degraded-build-hides-behind-light-load
+        failure open-loop replay exists to prevent)."""
+        rec_full = self._recording()
+        prof_full = recording_profile(rec_full)
+        rec_sampled = json.loads(json.dumps(rec_full))
+        # same stream recorded at 10%: keep every 10th record, each
+        # stamped with the rate it was captured at
+        kept = [dict(r, sample=0.1)
+                for i, r in enumerate(rec_sampled["records"])
+                if i % 10 == 0]
+        rec_sampled["records"] = kept
+        prof = recording_profile(rec_sampled)
+        assert prof["observed_rps"] == pytest.approx(
+            prof_full["observed_rps"], rel=0.25)
+        spec = spec_from_recording(rec_sampled, duration_s=5)
+        assert spec.target_rps == pytest.approx(
+            prof_full["observed_rps"], rel=0.25)
+
+    def test_fidelity_pacing_flags_underdelivered_replay(self):
+        rec = self._recording()
+        spec = spec_from_recording(rec, duration_s=5)
+        assert spec.target_rps > 0
+        ops_at = lambda frac: {  # noqa: E731
+            "wall_s": spec.duration_s,
+            "routes": {"read": {"ops": int(
+                spec.target_rps * spec.duration_s * frac)}}}
+        good = replay_fidelity(rec, spec, result=ops_at(1.0))
+        pacing = [c for c in good if c["check"] == "fidelity_pacing"]
+        assert pacing and pacing[0]["ok"]
+        # a build that only managed 40% of the recorded arrivals must
+        # NOT read as a faithful reproduction
+        bad = replay_fidelity(rec, spec, result=ops_at(0.4))
+        pacing = [c for c in bad if c["check"] == "fidelity_pacing"]
+        assert pacing and not pacing[0]["ok"]
+
+    def test_summarize_records_rollup(self):
+        s = summarize_records([
+            {"route": "http_read", "status": 200, "in": 0, "out": 10,
+             "ts": 1.0},
+            {"route": "http_read", "status": 500, "in": 0, "out": 0,
+             "ts": 3.0}])
+        assert s["routes"]["http_read"]["errors"] == 1
+        assert s["window_s"] == 2.0
+
+
+# --- the live acceptance drill ----------------------------------------------
+
+class TestLiveDrill:
+    def test_record_both_planes_export_replay(self, tmp_path):
+        """The ISSUE 14 tier-1 drill: record a mixed workload over the
+        HTTP AND native planes (with a credential in flight), export
+        from the master, fit, replay via the scenario engine, and
+        machine-check fidelity."""
+        from seaweedfs_tpu.master.server import MasterServer
+        from seaweedfs_tpu.scenarios import run_scenario
+        from seaweedfs_tpu.shell import CommandEnv, run_command
+        from seaweedfs_tpu.utils.framing import tcp_address
+        from seaweedfs_tpu.utils.httpd import http_bytes, http_json
+        from seaweedfs_tpu.volume_server.server import VolumeServer
+        from seaweedfs_tpu.volume_server.tcp import TcpVolumeClient
+
+        root = tempfile.mkdtemp(dir=str(tmp_path))
+        m = MasterServer(port=free_port(), pulse_seconds=0.3).start()
+        vs = VolumeServer([root], m.url, port=free_port(),
+                          pulse_seconds=0.3).start()
+        try:
+            deadline = time.time() + 10
+            while time.time() < deadline and not m.topo.all_nodes():
+                time.sleep(0.05)
+
+            env = CommandEnv(m.url)
+            out = run_command(env, "workload.record -sample 1.0")
+            assert "recording" in out
+
+            # mixed workload: HTTP writes (one carrying a jwt), Zipf
+            # reads over HTTP, native reads+writes over framed TCP,
+            # a few deletes
+            rng = random.Random(3)
+            fids = []
+            for i in range(24):
+                r = http_json("GET",
+                              f"http://{m.url}/dir/assign?count=1",
+                              timeout=10.0)
+                st, _b, _h = http_bytes(
+                    "POST",
+                    f"http://{r['url']}/{r['fid']}?jwt=HUSHSECRET42",
+                    b"x" * (4096 if i % 6 else 65536), timeout=10.0)
+                assert st in (200, 201)
+                fids.append((r["fid"], r["url"]))
+            for _ in range(150):
+                fid, url = fids[min(int(rng.paretovariate(1.1)) - 1,
+                                    len(fids) - 1)]
+                st, _b, _h = http_bytes("GET", f"http://{url}/{fid}",
+                                        timeout=10.0)
+                assert st == 200
+            tcp = TcpVolumeClient()
+            if vs._tcp_server is not None and vs._tcp_server.alive:
+                for _ in range(30):
+                    fid, url = fids[min(int(rng.paretovariate(1.1)) - 1,
+                                        len(fids) - 1)]
+                    assert tcp.read(tcp_address(url), fid)
+            for i in range(6):
+                fid, url = fids.pop()
+                http_bytes("DELETE", f"http://{url}/{fid}",
+                           timeout=10.0)
+            # master-proxied writes: each must record ONCE (the
+            # client's /submit), never again as the proxied volume PUT
+            for i in range(4):
+                st, _b, _h = http_bytes(
+                    "POST", f"http://{m.url}/submit",
+                    b"proxy-me" * 64, timeout=10.0)
+                assert st == 201
+
+            out = run_command(env, "workload.stop")
+            assert "stopped" in out
+
+            # shipper flush: the master journal converges
+            deadline = time.time() + 8
+            while time.time() < deadline:
+                doc = http_json(
+                    "GET", f"http://{m.url}/cluster/workload/export",
+                    timeout=10.0)
+                if doc["summary"]["records"] >= 180:
+                    break
+                time.sleep(0.2)
+            prof = recording_profile(doc)
+            assert prof["records"] >= 180
+
+            # the credential NEVER reached the recording
+            blob = json.dumps(doc)
+            assert "HUSHSECRET42" not in blob
+            assert "REDACTED" in blob
+
+            # both planes landed
+            routes = doc["summary"]["routes"]
+            assert routes["http_read"]["ops"] >= 140
+            assert routes["http_write"]["ops"] >= 20
+            if vs._tcp_server is not None and vs._tcp_server.alive:
+                assert routes["native_read"]["ops"] >= 25
+            # proxied/replicated hops never recorded as workload: the
+            # 4 /submit writes appear exactly once each (the submit
+            # handler), and no internal-hop marker reached the journal
+            submits = [r for r in doc["records"]
+                       if r.get("handler") == "submit"]
+            assert len(submits) == 4
+            assert "internal" not in routes
+            assert not any("type=proxied" in (r.get("path") or "")
+                           or "type=replicate" in (r.get("path") or "")
+                           for r in doc["records"])
+
+            # shell export writes the same document to disk
+            out_path = str(tmp_path / "recording.json")
+            out = run_command(env,
+                              f"workload.export -out {out_path}")
+            assert "records" in out
+            with open(out_path, encoding="utf-8") as f:
+                saved = json.load(f)
+            assert "HUSHSECRET42" not in json.dumps(saved)
+
+            # /debug/reqlog serves the local ring with filters, and a
+            # typo'd param answers 400 not 500
+            local = http_json(
+                "GET", f"http://{vs.url}/debug/reqlog?route=http_read"
+                       "&limit=5", timeout=10.0)
+            assert local["count"] <= 5
+            assert all(r["route"] == "http_read"
+                       for r in local["records"])
+            st, _b, _h = http_bytes(
+                "GET", f"http://{vs.url}/debug/reqlog?limit=abc",
+                timeout=10.0)
+            assert st == 400
+            # a negative limit must not bypass the response cap and
+            # dump the whole ring ([-0:] slicing bug class)
+            neg = http_json(
+                "GET", f"http://{vs.url}/debug/reqlog?limit=-1",
+                timeout=10.0)
+            assert neg["count"] == 1
+            # out-of-range knobs answer 400, never a 200 that starts
+            # a recorder recording nothing
+            for bad in ({"sample": 0}, {"sample": 1.5}, {"size": 0}):
+                st, _b, _h = http_bytes(
+                    "POST", f"http://{vs.url}/debug/reqlog/start",
+                    json.dumps(bad).encode(), timeout=10.0)
+                assert st == 400, bad
+        finally:
+            vs.stop()
+            m.stop()
+
+        # replay OUTSIDE the recording cluster (the engine spawns its
+        # own): open-loop at a speed that fits a short drill
+        spec = spec_from_recording(saved, name="drill_replay",
+                                   duration_s=3.0, clients=4)
+        assert spec.target_rps > 0  # open-loop pacing engaged
+        result = run_scenario(spec, base_dir=str(tmp_path))
+        assert result["verdict"] == "pass", result["checks"]
+        checks = replay_fidelity(saved, spec, result=result)
+        assert checks and all(c["ok"] for c in checks), checks
+
+    def test_capacity_doc_roundtrip_and_health_hint(self, tmp_path):
+        """POST /cluster/capacity parks a probe result; cluster.health
+        renders the one-line hint from it."""
+        from seaweedfs_tpu.master.server import MasterServer
+        from seaweedfs_tpu.shell import CommandEnv, run_command
+        from seaweedfs_tpu.utils.httpd import HttpError, http_json
+
+        m = MasterServer(port=free_port(), pulse_seconds=0.3).start()
+        try:
+            env = CommandEnv(m.url)
+            with pytest.raises(HttpError) as ei:
+                http_json("GET", f"http://{m.url}/cluster/capacity",
+                          timeout=10.0)
+            assert ei.value.status == 404
+            out = run_command(env, "cluster.health")
+            assert "capacity:" not in out
+            http_json("POST", f"http://{m.url}/cluster/capacity",
+                      {"slo": {"max_p99_ms": 5.0,
+                               "max_error_ratio": 0.001},
+                       "probed_at": time.time(),
+                       "routes": {"http_read": {"capacity_rps": 4200.0},
+                                  "native_read":
+                                      {"capacity_rps": 21000.0}}},
+                      timeout=10.0)
+            out = run_command(env, "cluster.health")
+            assert "capacity:" in out
+            assert "http_read~4200rps" in out
+            assert "native_read~21000rps" in out
+        finally:
+            m.stop()

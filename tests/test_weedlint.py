@@ -50,6 +50,8 @@ from tools.weedlint.rules_resources import \
     check_module_source as check_resources  # noqa: E402
 from tools.weedlint.rules_routes import \
     check_module_source as check_routes  # noqa: E402
+from tools.weedlint.rules_bench import \
+    check_source as check_bench_caps  # noqa: E402
 from tools.weedlint.rules_timeouts import \
     check_source as check_timeouts  # noqa: E402
 
@@ -175,6 +177,18 @@ W901_BAD = (
     "def f(url):\n"
     "    return http_json('GET', url)\n")
 
+W1001_CLEAN = (
+    "SECTION_CAPS = {'alpha': 60, 'beta': 120}\n"
+    "def run():\n"
+    "    section('alpha', lambda: None)\n"
+    "    cap = SECTION_CAPS.get('beta', 300)\n")
+W1001_BAD = (
+    "SECTION_CAPS = {'alpha': 60}\n"
+    "def run():\n"
+    "    section('alpha', lambda: None)\n"
+    "    section('gamma', lambda: None)\n"
+    "    cap = SECTION_CAPS.get('delta', 300)\n")
+
 CASES = [
     ("W101", "x = 1\n", "import tomllib\n",
      lambda src: rules_py310.check_source(src, "t.py")),
@@ -196,6 +210,8 @@ CASES = [
      lambda src: check_resources(src, "t.py")),
     ("W901", W901_CLEAN, W901_BAD,
      lambda src: check_timeouts(src, "t.py")),
+    ("W1001", W1001_CLEAN, W1001_BAD,
+     lambda src: check_bench_caps(src, "bench.py")),
 ]
 
 

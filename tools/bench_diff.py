@@ -1,0 +1,246 @@
+#!/usr/bin/env python3
+"""bench_diff: compare two bench JSON documents, flag regressions.
+
+The BENCH_r*.json trajectory is how this repo proves perf PRs — but
+"compare round N against round N-1" has been an eyeball job, and
+eyeballs miss 12% regressions hiding in a 40-key detail dict.  This
+tool makes the comparison a machine verdict:
+
+    python tools/bench_diff.py OLD.json NEW.json [--threshold 0.10]
+                               [--json] [--all]
+
+Inputs are the driver's round documents ``{n, cmd, rc, tail, parsed}``
+(``parsed`` holds the bench line ``{metric, value, detail: {...}}``);
+a bare bench line document is accepted too.  Comparison runs over the
+REGISTERED key-metric list below — dotted paths into ``detail`` with
+an explicit direction, because "read rps went down" and "mttr went
+down" are opposite verdicts.  A metric moving against its direction by
+more than ``--threshold`` (default 10%) is a REGRESSION and the exit
+code is 1; improvements and small moves report informationally.
+
+Schema discipline: bench.py stamps ``schema_version`` (and the git
+revision) into every document.  Documents with different schema
+versions do not compare — the tool exits 2 and says so, instead of
+misreporting a shape change as a perf move.  Pre-stamp documents
+(BENCH_r01..r05) read as version 1 and compare among themselves.
+
+Exit codes: 0 clean, 1 regression(s), 2 usage / not comparable.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import Optional
+
+# the registered key-metric list: (dotted path into parsed.detail,
+# direction[, min_abs]).  "up" = bigger is better (throughput), "down"
+# = smaller is better (latency, recovery time).  min_abs is an
+# ABSOLUTE-move floor: near-zero metrics (the *_overhead_pct family
+# lives around 0.1-1.0) turn sub-noise absolute moves into huge
+# relative ones — 0.2% -> 0.5% is +150% "regression" on numbers both
+# comfortably inside their acceptance bar, and an old value of exactly
+# 0 makes any move read as infinite.  Paths absent from either
+# document are skipped — rounds run on different hardware/sections all
+# the time — but a path present in OLD and missing in NEW is reported
+# (a silently vanished metric is how regressions hide).
+KEY_METRICS: list[tuple] = [
+    ("cluster_read_rps", "up"),
+    ("cluster_write_rps", "up"),
+    ("cluster_tcp_read_rps", "up"),
+    ("cluster_native_tcp_read_rps", "up"),
+    ("capacity.http_read.capacity_rps", "up"),
+    ("capacity.native_read.capacity_rps", "up"),
+    ("capacity.http_write.capacity_rps", "up"),
+    ("capacity.reqlog_read_overhead_pct", "down", 1.0),
+    ("cpu_simd_mbps", "up"),
+    ("tpu_inhbm_pallas_mbps", "up"),
+    ("e2e_file_encode_mbps", "up"),
+    ("e2e_pipeline_disk.overlap_efficiency", "up", 0.05),
+    ("e2e_pipeline_tmpfs.overlap_efficiency", "up", 0.05),
+    ("coordinator.mttr_s", "down", 1.0),
+    ("alerts.eval_read_overhead_pct", "down", 1.0),
+    ("trace_sampling_read_overhead_pct", "down", 1.0),
+]
+
+
+def load_document(path: str) -> dict:
+    """-> the bench-line dict {metric, value, detail} from either the
+    round shape {n, cmd, rc, tail, parsed} or a bare bench line.
+    Raises ValueError when the document has nothing to compare."""
+    with open(path, encoding="utf-8") as f:
+        doc = json.load(f)
+    if not isinstance(doc, dict):
+        raise ValueError(f"{path}: not a JSON object")
+    if "parsed" in doc or "tail" in doc:
+        parsed = doc.get("parsed")
+        if not isinstance(parsed, dict):
+            raise ValueError(
+                f"{path}: round document carries no parsed bench line "
+                f"(rc={doc.get('rc')}) — nothing to compare")
+        return parsed
+    if "detail" in doc:
+        return doc
+    raise ValueError(f"{path}: neither a round document nor a bench line")
+
+
+def schema_version(parsed: dict) -> int:
+    """Pre-stamp documents (rounds 1-5) are version 1."""
+    try:
+        return int((parsed.get("detail") or {}).get("schema_version", 1))
+    except (TypeError, ValueError):
+        return 1
+
+
+def lookup(detail: dict, dotted: str) -> Optional[float]:
+    cur: object = detail
+    for part in dotted.split("."):
+        if not isinstance(cur, dict) or part not in cur:
+            return None
+        cur = cur[part]
+    if isinstance(cur, bool) or not isinstance(cur, (int, float)):
+        return None
+    return float(cur)
+
+
+def compare(old: dict, new: dict, threshold: float = 0.10,
+            metrics: Optional[list[tuple]] = None) -> dict:
+    """-> {comparable, rows, regressions, improvements, missing}.
+    Raises ValueError on a schema mismatch (the caller exits 2)."""
+    v_old, v_new = schema_version(old), schema_version(new)
+    if v_old != v_new:
+        raise ValueError(
+            f"schema mismatch: old is v{v_old}, new is v{v_new} — "
+            f"re-run the older side on the current tree instead of "
+            f"comparing across schemas")
+    d_old = old.get("detail") or {}
+    d_new = new.get("detail") or {}
+    rows: list[dict] = []
+    regressions: list[dict] = []
+    improvements: list[dict] = []
+    missing: list[str] = []
+    for entry in (metrics or KEY_METRICS):
+        path, direction = entry[0], entry[1]
+        min_abs = float(entry[2]) if len(entry) > 2 else 0.0
+        a, b = lookup(d_old, path), lookup(d_new, path)
+        if a is None and b is None:
+            continue
+        if a is not None and b is None:
+            missing.append(path)
+            continue
+        if a is None:
+            rows.append({"metric": path, "old": None, "new": b,
+                         "verdict": "new"})
+            continue
+        if a == 0:
+            change = 0.0 if b == 0 else float("inf")
+        else:
+            change = (b - a) / abs(a)
+        # a move WITH the direction is good, against it is bad
+        signed = change if direction == "up" else -change
+        verdict = "ok"
+        if abs(b - a) < min_abs:
+            # sub-floor absolute move: relative % on a near-zero
+            # metric is noise, never a verdict (also tames a==0 ->
+            # "infinite" change)
+            pass
+        elif signed <= -threshold:
+            verdict = "regression"
+        elif signed >= threshold:
+            verdict = "improvement"
+        row = {"metric": path, "direction": direction,
+               "old": a, "new": b,
+               "change_pct": round(change * 100.0, 2)
+               if change != float("inf") else None,
+               "verdict": verdict}
+        rows.append(row)
+        if verdict == "regression":
+            regressions.append(row)
+        elif verdict == "improvement":
+            improvements.append(row)
+    return {
+        "schema_version": v_old,
+        "old_revision": (d_old.get("git_revision") or ""),
+        "new_revision": (d_new.get("git_revision") or ""),
+        "threshold_pct": round(threshold * 100.0, 1),
+        "rows": rows,
+        "regressions": regressions,
+        "improvements": improvements,
+        "missing_in_new": missing,
+    }
+
+
+def render(report: dict, show_all: bool = False) -> str:
+    lines = [f"bench_diff (threshold {report['threshold_pct']}%, "
+             f"schema v{report['schema_version']}"
+             + (f", {report['old_revision'] or '?'} -> "
+                f"{report['new_revision'] or '?'}"
+                if report["old_revision"] or report["new_revision"]
+                else "") + ")"]
+    for row in report["rows"]:
+        if not show_all and row["verdict"] == "ok":
+            continue
+        ch = row.get("change_pct")
+        lines.append(
+            f"  {row['verdict'].upper():<12} {row['metric']:<44} "
+            f"{row['old']} -> {row['new']}"
+            + (f" ({ch:+.1f}%)" if ch is not None else ""))
+    for path in report["missing_in_new"]:
+        lines.append(f"  MISSING      {path:<44} present in old, "
+                     f"absent in new")
+    n_reg = len(report["regressions"])
+    lines.append(f"verdict: {n_reg} regression(s), "
+                 f"{len(report['improvements'])} improvement(s), "
+                 f"{len(report['missing_in_new'])} missing")
+    return "\n".join(lines)
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    threshold = 0.10
+    as_json = False
+    show_all = False
+    paths: list[str] = []
+    i = 0
+    while i < len(argv):
+        a = argv[i]
+        if a == "--threshold":
+            i += 1
+            if i >= len(argv):
+                print("--threshold needs a value", file=sys.stderr)
+                return 2
+            try:
+                threshold = float(argv[i])
+            except ValueError:
+                print(f"bad threshold {argv[i]!r}", file=sys.stderr)
+                return 2
+        elif a == "--json":
+            as_json = True
+        elif a == "--all":
+            show_all = True
+        elif a.startswith("-"):
+            print(f"unknown flag {a}", file=sys.stderr)
+            return 2
+        else:
+            paths.append(a)
+        i += 1
+    if len(paths) != 2:
+        print("usage: bench_diff.py OLD.json NEW.json "
+              "[--threshold 0.10] [--json] [--all]", file=sys.stderr)
+        return 2
+    try:
+        old = load_document(paths[0])
+        new = load_document(paths[1])
+        report = compare(old, new, threshold=threshold)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"bench_diff: {e}", file=sys.stderr)
+        return 2
+    if as_json:
+        print(json.dumps(report, indent=2, sort_keys=True))
+    else:
+        print(render(report, show_all=show_all))
+    return 1 if report["regressions"] else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -17,9 +17,13 @@ Rules (see README "Static analysis" for the full table):
     W401  degraded-signal table consistency               [ported]
     W501  lockset: guarded attribute outside its lock     [new]
     W502  lockset: unannotated mutation in threaded class [new]
+    W503  lock-order cycles over the call graph
+    W504  blocking call reachable under a held lock
     W601  route query-param parsing must 400, not 500     [new]
     W701  fault-point registry consistency + test cover   [new]
     W801  ec/ resource acquire without release-on-all-paths [new]
+    W901  outbound calls must carry an explicit timeout
+    W1001 bench.py sections must have SECTION_CAPS entries
 
 Waive a finding inline with a reason:
 

@@ -269,10 +269,11 @@ def _load_builtin_rules() -> None:
     if _loaded:
         return
     _loaded = True
-    from . import (rules_async_drain, rules_blocking,  # noqa: F401
-                   rules_faults, rules_health_keys, rules_lockorder,
-                   rules_lockset, rules_py310, rules_resources,
-                   rules_routes, rules_timeouts, rules_tracing)
+    from . import (rules_async_drain, rules_bench,  # noqa: F401
+                   rules_blocking, rules_faults, rules_health_keys,
+                   rules_lockorder, rules_lockset, rules_py310,
+                   rules_resources, rules_routes, rules_timeouts,
+                   rules_tracing)
 
 
 # --- waivers -----------------------------------------------------------------

@@ -1,0 +1,120 @@
+"""W1001: every bench section must have an explicit SECTION_CAPS entry.
+
+bench.py runs each section under a wall-clock cap; a ``section(name,
+fn)`` whose name is missing from SECTION_CAPS silently falls to
+SECTION_CAP_DEFAULT — which is how a new 8-minute section ends up
+budgeted 300s and killed mid-measurement, or a cheap one squats 300s
+of the shared child budget.  The cap is a reviewed decision per
+section, so this rule makes omission a lint failure instead of a
+runtime surprise.
+
+Checked in ``bench.py`` at the repo root (absent in mini test repos —
+the rule returns nothing there):
+
+  - every ``section("<name>", ...)`` call's literal name;
+  - every ``SECTION_CAPS.get("<name>", ...)`` literal key (the
+    special-cased budget lookups, e.g. the e2e_stream per-leg gate)
+
+must appear as a key of the module-level SECTION_CAPS dict.  Names
+built at runtime (non-literal first arguments) cannot be verified
+statically and are flagged too — a section whose cap nobody can read
+off the table is the same review problem.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from .engine import Finding, Repo, Rule, register
+
+BENCH_REL = "bench.py"
+
+
+def _literal_str(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def section_caps_keys(tree: ast.AST) -> Optional[set]:
+    """Keys of the module-level ``SECTION_CAPS = {...}`` dict; None
+    when the table is missing entirely."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        if not any(isinstance(t, ast.Name) and t.id == "SECTION_CAPS"
+                   for t in node.targets):
+            continue
+        if isinstance(node.value, ast.Dict):
+            return {k for k in (_literal_str(key)
+                                for key in node.value.keys)
+                    if k is not None}
+    return None
+
+
+def check_source(src: str, path: str = BENCH_REL,
+                 tree: Optional[ast.AST] = None) -> list[Finding]:
+    """Findings for one bench module's section/cap drift."""
+    if tree is None:
+        try:
+            tree = ast.parse(src, filename=path)
+        except SyntaxError:
+            return []  # W101 reports unparseable files
+    caps = section_caps_keys(tree)
+    if caps is None:
+        return [Finding(
+            "W1001", path, 1,
+            "no module-level SECTION_CAPS dict found — per-section "
+            "budgets are undeclared",
+            "declare SECTION_CAPS = {\"<section>\": seconds, ...}")]
+    out: list[Finding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        # section("<name>", fn)
+        if isinstance(f, ast.Name) and f.id == "section" and node.args:
+            name = _literal_str(node.args[0])
+            if name is None:
+                out.append(Finding(
+                    "W1001", path, node.lineno,
+                    "section(...) called with a non-literal name — its "
+                    "cap cannot be read off SECTION_CAPS in review",
+                    "pass the section name as a string literal"))
+            elif name not in caps:
+                out.append(Finding(
+                    "W1001", path, node.lineno,
+                    f"section {name!r} has no SECTION_CAPS entry — it "
+                    f"silently falls to SECTION_CAP_DEFAULT",
+                    f"add \"{name}\": <seconds> to SECTION_CAPS"))
+        # SECTION_CAPS.get("<name>", default) budget lookups
+        if isinstance(f, ast.Attribute) and f.attr == "get" \
+                and isinstance(f.value, ast.Name) \
+                and f.value.id == "SECTION_CAPS" and node.args:
+            name = _literal_str(node.args[0])
+            if name is not None and name not in caps:
+                out.append(Finding(
+                    "W1001", path, node.lineno,
+                    f"SECTION_CAPS.get({name!r}, ...) falls through to "
+                    f"the default — {name!r} is not a registered "
+                    f"section",
+                    f"add \"{name}\": <seconds> to SECTION_CAPS"))
+    return out
+
+
+@register
+class BenchSectionCapsRule(Rule):
+    id = "W1001"
+    name = "bench-section-caps"
+    summary = ("every bench.py section(name, ...) must carry an "
+               "explicit SECTION_CAPS budget entry")
+    hint = "add the section to bench.py SECTION_CAPS"
+
+    def check(self, repo: Repo) -> list[Finding]:
+        ctx = repo.get(BENCH_REL)
+        if ctx is None or ctx.tree is None:
+            # a tree without the bench harness (mini test repos,
+            # partial checkouts) has no section table to check
+            return []
+        return check_source(ctx.source, ctx.rel, ctx.tree)

@@ -33,10 +33,14 @@ EVENTS_REL = os.path.join("seaweedfs_tpu", "observability", "events.py")
 # load conditions on the serving plane — they page through their
 # counter rules and the burn-rate SLOs, but an encode run does not
 # become a degraded MEASUREMENT because some other client got shed.
+# reqlog_records_dropped is observability loss (the workload recording
+# under-represents the stream): alertable, but it never makes the
+# measured run itself degraded.
 DEGRADE_KEY_ALLOWLIST = ("degraded_binds", "ec_under_replicated",
                          "coordinator_repair_failures",
                          "requests_shed", "deadline_exceeded",
-                         "retry_budget_exhausted")
+                         "retry_budget_exhausted",
+                         "reqlog_records_dropped")
 
 # DEGRADE_COUNTER_KEYS entries that are per-run encode stats rather
 # than cluster counter families.

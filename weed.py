@@ -57,6 +57,37 @@ def _maybe_enable_tracing(args) -> None:
     set_sample_rate(rate)
 
 
+def _maybe_enable_reqlog(args) -> None:
+    """-reqlog.sample R (or WEED_REQLOG_SAMPLE=R): turn the workload
+    flight recorder on with per-request sampling at rate R in (0,1] —
+    the recording knob the bench capacity section and `weed shell
+    workload.record` build on.  -reqlog.size N (WEED_REQLOG_SIZE)
+    bounds the ring.  Unset/zero leaves the recorder off (it can still
+    be flipped live via POST /debug/reqlog/start)."""
+    import os as _os
+
+    rate = getattr(args, "reqlog_sample", 0.0)
+    if rate <= 0:
+        env = _os.environ.get("WEED_REQLOG_SAMPLE", "")
+        if not env:
+            return
+        try:
+            rate = float(env)
+        except ValueError:
+            return
+        if rate <= 0:
+            return
+    size = getattr(args, "reqlog_size", 0)
+    if size <= 0:
+        try:
+            size = int(_os.environ.get("WEED_REQLOG_SIZE", "") or 0)
+        except ValueError:
+            size = 0
+    from seaweedfs_tpu.observability.reqlog import enable_reqlog
+
+    enable_reqlog(sample=min(rate, 1.0), capacity=size or None)
+
+
 def _cluster_tls():
     """security.toml [tls] -> server ssl context (also installs the
     process-wide mTLS client side); None when TLS is not configured."""
@@ -1089,6 +1120,15 @@ def main(argv=None) -> None:
                    help="enable distributed tracing with this head "
                         "sampling rate (0..1); negative/unset = off "
                         "(WEED_TRACE_SAMPLE env var also works)")
+    p.add_argument("-reqlog.sample", dest="reqlog_sample", type=float,
+                   default=0.0, metavar="RATE",
+                   help="enable the workload flight recorder with this "
+                        "per-request sampling rate (0..1]; zero/unset = "
+                        "off (WEED_REQLOG_SAMPLE env var also works)")
+    p.add_argument("-reqlog.size", dest="reqlog_size", type=int,
+                   default=0, metavar="N",
+                   help="workload recorder ring capacity (records); "
+                        "0 = default 8192 (WEED_REQLOG_SIZE)")
     p.add_argument("-metricsPushUrl", default="",
                    help="prometheus pushgateway base url (push mode)")
     p.add_argument("-metricsPushSeconds", type=float, default=15.0)
@@ -1445,6 +1485,7 @@ def main(argv=None) -> None:
     if args.cpuprofile or args.memprofile:
         grace.setup_profiling(args.cpuprofile, args.memprofile)
     _maybe_enable_tracing(args)
+    _maybe_enable_reqlog(args)
     _maybe_push_metrics(args)
     args.fn(args)
 
