@@ -1569,6 +1569,30 @@ def _child(scratch_path: str, platform: str = "") -> None:
                 # the answer + knee (BASELINE tracks capacity_rps)
             block["slo"] = cap["slo"]
             block.update(cap["routes"])
+            # needle-cache effectiveness under the probe's Zipf-shaped
+            # read mix (volume /status NeedleCache block; bench_diff
+            # watches capacity.needle_cache_hit_ratio)
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{mport}/dir/status",
+                        timeout=5) as r:
+                    topo = json.loads(r.read())
+                vs_url = topo["Topology"]["DataCenters"][0]["Racks"][0][
+                    "DataNodes"][0]["Url"]
+                with urllib.request.urlopen(
+                        f"http://{vs_url}/status", timeout=5) as r:
+                    st = json.loads(r.read())
+                nc = st.get("NeedleCache") or {}
+                block["needle_cache_hit_ratio"] = nc.get("hit_ratio",
+                                                         0.0)
+                block["needle_cache"] = {
+                    k: nc.get(k) for k in ("hits", "misses",
+                                           "admissions", "evictions",
+                                           "bytes")}
+                dp = st.get("Dataplane") or {}
+                block["dataplane"] = dp
+            except Exception as e:
+                block["needle_cache_error"] = f"{type(e).__name__}: {e}"
         detail["capacity"] = block
 
     section("capacity", meas_capacity)

@@ -78,6 +78,8 @@ EVENT_TYPES = {
     "retry_budget_exhausted": "warning",  # token bucket denied a retry
     # workload flight recorder (observability/reqlog.py)
     "reqlog_dropped": "warning",     # access records lost (ring/ship)
+    # event-loop serving dataplane (utils/eventloop.py)
+    "dataplane_conn_abort": "warning",  # conn torn down mid-flight
 }
 
 # HEALTH_FAMILIES key (stats/aggregate.py) -> the event type emitted at
@@ -95,6 +97,7 @@ HEALTH_EVENT_TYPES = {
     "deadline_exceeded": "deadline_exceeded",
     "retry_budget_exhausted": "retry_budget_exhausted",
     "reqlog_records_dropped": "reqlog_dropped",
+    "dataplane_conn_aborts": "dataplane_conn_abort",
 }
 
 

@@ -115,6 +115,12 @@ def classify_route(method: str, path: str, handler: str = "",
         if method == "DELETE":
             return "http_delete"
         return "http_write"
+    if path.startswith("/batch/read"):
+        # batched object IO (one request, N needles) is workload, not
+        # ops — replays and capacity baselines must see it
+        return "http_read"
+    if path.startswith("/batch/write"):
+        return "http_write"
     if path.startswith("/submit"):
         return "http_write"
     if path.startswith("/dir/assign"):
@@ -127,7 +133,10 @@ def classify_route(method: str, path: str, handler: str = "",
 
 
 NATIVE_ROUTES = {b"R": "native_read", b"W": "native_write",
-                 b"D": "native_delete"}
+                 b"D": "native_delete",
+                 # batched frames carry N needles each but are still
+                 # read/write workload for replay purposes
+                 b"B": "native_read", b"P": "native_write"}
 
 
 def _dropped_counter():

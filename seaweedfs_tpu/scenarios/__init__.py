@@ -34,7 +34,7 @@ Two more entry points close the loop with the observability stack:
 """
 
 from .capacity import CapacitySLO, find_capacity, measure_rate
-from .engine import run_scenario
+from .engine import run_against, run_scenario
 from .replay import (recording_profile, replay_fidelity,
                      spec_from_recording)
 from .spec import (FaultSpec, ScenarioSpec, default_scenarios,
@@ -43,6 +43,7 @@ from .workload import SizeSampler, ZipfSampler
 
 __all__ = [
     "FaultSpec", "ScenarioSpec", "default_scenarios", "run_scenario",
+    "run_against",
     "read_storm", "write_churn", "failure_under_load",
     "ZipfSampler", "SizeSampler",
     "spec_from_recording", "recording_profile", "replay_fidelity",

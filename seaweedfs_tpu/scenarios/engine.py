@@ -314,6 +314,72 @@ def _evaluate(spec: ScenarioSpec, result: dict,
     return checks
 
 
+def run_against(spec: ScenarioSpec, master_url: str,
+                log=None) -> dict:
+    """Drive a ScenarioSpec against a LIVE cluster (the
+    ``workload.replay -against host:port`` mode): same client loops,
+    same open-loop pacing, same result/checks document — but the
+    servers are whoever answers at ``master_url`` instead of an
+    in-process cluster spawned for the run.  This is how a recorded
+    workload proves a refactor on real before/after builds: record on
+    the old build, replay -against both, bench_diff the numbers.
+
+    No faults are armed and no alert engine is sampled (the live
+    cluster's own alert plane keeps running); the hot set is PRELOADED
+    onto the target (it writes load objects, like capacity.probe —
+    hold the admin lock).  Checks cover the spec's error-ratio and
+    deadline expectations; fault-phase expectations are skipped."""
+    say = log or (lambda _m: None)
+    rng = random.Random(spec.seed)
+    say(f"{spec.name}: preloading {spec.hot_set} objects onto "
+        f"{master_url}")
+    ranks = _preload(master_url, spec, rng)
+    zipf = ZipfSampler(len(ranks), spec.zipf_s)
+    result: dict = {"name": spec.name, "spec": spec.to_dict(),
+                    "against": master_url}
+    stop = threading.Event()
+    t0 = time.monotonic()
+    per_client_ops: list[list] = [[] for _ in range(spec.clients)]
+    threads = [threading.Thread(
+        target=_client_loop,
+        args=(ci, spec, master_url, ranks, zipf, t0, stop,
+              per_client_ops[ci]),
+        daemon=True, name=f"replay-{spec.name}-c{ci}")
+        for ci in range(spec.clients)]
+    say(f"{spec.name}: driving {spec.clients} clients for "
+        f"{spec.duration_s:.0f}s against {master_url}")
+    for t in threads:
+        t.start()
+    try:
+        time.sleep(spec.duration_s)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=10.0)
+    ops = [o for lst in per_client_ops for o in lst]
+    ops.sort(key=lambda o: o.t)
+    wall = spec.duration_s
+    overruns = [max(0.0, o.lat - spec.deadline_s) for o in ops]
+    result.update({
+        "wall_s": round(wall, 1),
+        "total_ops": len(ops),
+        "routes": _route_stats(ops, wall),
+        "phases": _phase_stats(ops, {"healthy": (0.0, wall + 1e9)},
+                               wall),
+        "deadline": {
+            "budget_s": spec.deadline_s,
+            "violations": sum(1 for ov in overruns if ov > 0.25),
+            "max_overrun_ms": round(max(overruns, default=0.0) * 1e3,
+                                    1),
+        },
+    })
+    checks = _evaluate(spec, result, None, None)
+    result["checks"] = checks
+    result["degraded"] = any(not c["ok"] for c in checks)
+    result["verdict"] = "degraded" if result["degraded"] else "pass"
+    return result
+
+
 def run_scenario(spec: ScenarioSpec, base_dir: Optional[str] = None,
                  log=None) -> dict:
     """Run one scenario end to end; returns the result document.

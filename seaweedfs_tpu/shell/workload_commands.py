@@ -131,12 +131,16 @@ def cmd_workload_export(env: CommandEnv, flags: dict) -> str:
 @command("workload.replay")
 def cmd_workload_replay(env: CommandEnv, flags: dict) -> str:
     """workload.replay [-file recording.json] [-speed 1.0]
-    [-duration s] [-clients 8] [-json]
+    [-duration s] [-clients 8] [-against host:port] [-json]
     # fit a recording (a -file, or the master's current journal) into
     # a ScenarioSpec and replay it with the scenario engine — fresh
     # in-process cluster, alerting live, open-loop paced.  Prints the
-    # scenario verdict and the machine-checked replay-fidelity list"""
-    from ..scenarios import run_scenario
+    # scenario verdict and the machine-checked replay-fidelity list.
+    # -against drives the recorded workload at a LIVE cluster's master
+    # instead of spawning one (writes load objects; hold the admin
+    # lock) — how a recorded workload proves a refactor on real
+    # before/after servers"""
+    from ..scenarios import run_against, run_scenario
     from ..scenarios.replay import replay_fidelity, spec_from_recording
 
     if flags.get("file"):
@@ -153,12 +157,21 @@ def cmd_workload_replay(env: CommandEnv, flags: dict) -> str:
         raise ValueError(f"bad -speed/-duration/-clients: {e}")
     spec = spec_from_recording(recording, speed=speed,
                                duration_s=duration, clients=clients)
-    result = run_scenario(spec)
+    against = (flags.get("against") or "").strip()
+    if against:
+        # replaying INTO a live cluster mutates it (hot-set preload +
+        # recorded write mix): same admin-lock bar as capacity.probe
+        env.confirm_is_locked()
+        result = run_against(spec, against)
+    else:
+        result = run_scenario(spec)
     fidelity = replay_fidelity(recording, spec, result=result)
     result["fidelity"] = fidelity
     if flags.get("json") == "true":
         return json.dumps(result, indent=2)
-    lines = [f"replayed {spec.name}: verdict={result['verdict']} "
+    where = f" against {against}" if against else ""
+    lines = [f"replayed {spec.name}{where}: "
+             f"verdict={result['verdict']} "
              f"({result['total_ops']} ops over {result['wall_s']}s, "
              f"target_rps={spec.target_rps:g})"]
     for c in result.get("checks", []) + fidelity:

@@ -71,6 +71,12 @@ HEALTH_FAMILIES = {
     # from under-represents the real stream — an observability-health
     # condition worth paging on, never a degraded measurement
     "reqlog_records_dropped": "SeaweedFS_reqlog_records_dropped_total",
+    # event-loop serving dataplane (utils/eventloop.py): a connection
+    # aborted with work still in flight (slow-client outbox overflow,
+    # input flood, send error, bounded stop teardown) lost a client a
+    # response it was owed — sustained aborts mean the dataplane is
+    # shedding connections, not requests
+    "dataplane_conn_aborts": "SeaweedFS_dataplane_conn_aborts_total",
 }
 
 # keys whose truth lives on the MASTER: the per-peer rollup reports 0

@@ -583,6 +583,93 @@ class RequestPlaneMetrics:
         }
 
 
+class DataplaneMetrics:
+    """Event-loop serving dataplane (utils/eventloop.py): connection
+    and dispatch accounting for the shared reactor.  conn_aborts counts
+    connections the loop tore down abnormally (slow_client = outbox
+    overflow, overflow = unframed input flood, send_error, stop =
+    bounded-deadline teardown with work still in flight) — it feeds the
+    `dataplane_conn_aborts` HEALTH_FAMILIES key, because a sustained
+    abort rate means clients are losing in-flight responses."""
+
+    def __init__(self, registry: Registry = REGISTRY):
+        self.conn_aborts = registry.counter(
+            "SeaweedFS_dataplane_conn_aborts_total",
+            "Connections the reactor aborted with work in flight.",
+            labels=("reason",))
+        self.connections = registry.gauge(
+            "SeaweedFS_dataplane_connections",
+            "Connections currently owned by the reactor loop.")
+        self.workers = registry.gauge(
+            "SeaweedFS_dataplane_workers",
+            "Dispatch worker pool size (-dataplane.workers).")
+        self.pool_dispatches = registry.counter(
+            "SeaweedFS_dataplane_pool_dispatches_total",
+            "Requests dispatched onto the worker pool.")
+        self.fast_dispatches = registry.counter(
+            "SeaweedFS_dataplane_fast_dispatches_total",
+            "Cache-probed reads dispatched inline on the loop.")
+
+    def totals(self) -> dict[str, int]:
+        return {
+            "dataplane_conn_aborts":
+                int(sum(self.conn_aborts.snapshot().values())),
+            "pool_dispatches":
+                int(sum(self.pool_dispatches.snapshot().values())),
+            "fast_dispatches":
+                int(sum(self.fast_dispatches.snapshot().values())),
+        }
+
+
+class NeedleCacheMetrics:
+    """Popularity-aware needle read cache
+    (volume_server/needle_cache.py): admission/eviction/invalidation
+    accounting plus the resident-bytes gauge.  hit_ratio() is the
+    bench `capacity` section's needle_cache_hit_ratio key."""
+
+    def __init__(self, registry: Registry = REGISTRY):
+        self.hits = registry.counter(
+            "SeaweedFS_needle_cache_hits_total",
+            "Needle reads served from the popularity cache.")
+        self.misses = registry.counter(
+            "SeaweedFS_needle_cache_misses_total",
+            "Needle reads that went to the store.")
+        self.admissions = registry.counter(
+            "SeaweedFS_needle_cache_admissions_total",
+            "Needles admitted after clearing the frequency bar.")
+        self.rejections = registry.counter(
+            "SeaweedFS_needle_cache_rejections_total",
+            "Needle offers rejected by the admission policy.")
+        self.evictions = registry.counter(
+            "SeaweedFS_needle_cache_evictions_total",
+            "Needles evicted to honor the byte bound.")
+        self.invalidations = registry.counter(
+            "SeaweedFS_needle_cache_invalidations_total",
+            "Cache entries dropped by write/delete/vacuum.",
+            labels=("reason",))
+        self.bytes = registry.gauge(
+            "SeaweedFS_needle_cache_bytes",
+            "Resident cached needle bytes.")
+
+    def hit_ratio(self) -> float:
+        hits = sum(self.hits.snapshot().values())
+        misses = sum(self.misses.snapshot().values())
+        total = hits + misses
+        return round(hits / total, 4) if total else 0.0
+
+    def totals(self) -> dict:
+        return {
+            "hits": int(sum(self.hits.snapshot().values())),
+            "misses": int(sum(self.misses.snapshot().values())),
+            "admissions": int(sum(self.admissions.snapshot().values())),
+            "evictions": int(sum(self.evictions.snapshot().values())),
+            "invalidations":
+                int(sum(self.invalidations.snapshot().values())),
+            "bytes": int(self.bytes.value()),
+            "hit_ratio": self.hit_ratio(),
+        }
+
+
 _singletons: dict[str, object] = {}
 _singleton_lock = threading.Lock()
 
@@ -624,6 +711,14 @@ def coordinator_metrics() -> CoordinatorMetrics:
 
 def request_plane_metrics() -> RequestPlaneMetrics:
     return _singleton("request_plane", RequestPlaneMetrics)
+
+
+def dataplane_metrics() -> DataplaneMetrics:
+    return _singleton("dataplane", DataplaneMetrics)
+
+
+def needle_cache_metrics() -> NeedleCacheMetrics:
+    return _singleton("needle_cache", NeedleCacheMetrics)
 
 
 def start_push_loop(gateway_url: str, job: str,

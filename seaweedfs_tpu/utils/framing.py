@@ -48,6 +48,53 @@ def tcp_address(http_url: str) -> str:
     return f"{host}:{tcp_port_for(int(port))}"
 
 
+def pack_fid_frames(items, with_data: bool) -> bytes:
+    """Encode the shared batch record stream: ``u16 fid_len | fid``
+    (+ ``u32 data_len | data`` when with_data) repeated.  One encoder
+    for every producer — the HTTP /batch/write body, the framed 'B'/'P'
+    ops, and both client builders."""
+    out = []
+    for item in items:
+        fid = item[0] if with_data else item
+        f = fid.encode()
+        out.append(U16.pack(len(f)) + f)
+        if with_data:
+            data = item[1]
+            out.append(U32.pack(len(data)))
+            out.append(data)
+    return b"".join(out)
+
+
+def unpack_fid_frames(body: bytes, with_data: bool) -> list:
+    """Decode pack_fid_frames; raises ValueError on ANY truncation so
+    a torn batch is rejected whole before a single record is acted on.
+    Returns [fid] or [(fid, data)]."""
+    out: list = []
+    i = 0
+    n = len(body)
+    while i < n:
+        if i + 2 > n:
+            raise ValueError("truncated batch frame")
+        flen = U16.unpack_from(body, i)[0]
+        i += 2
+        if i + flen > n:
+            raise ValueError("truncated batch frame")
+        fid = body[i:i + flen].decode(errors="replace")
+        i += flen
+        if not with_data:
+            out.append(fid)
+            continue
+        if i + 4 > n:
+            raise ValueError("truncated batch frame")
+        dlen = U32.unpack_from(body, i)[0]
+        i += 4
+        if i + dlen > n:
+            raise ValueError("truncated batch frame")
+        out.append((fid, body[i:i + dlen]))
+        i += dlen
+    return out
+
+
 def recv_exact(sock: socket.socket, n: int) -> bytes:
     buf = bytearray()
     while len(buf) < n:
@@ -56,6 +103,80 @@ def recv_exact(sock: socket.socket, n: int) -> bytes:
             raise ConnectionError("peer closed")
         buf += piece
     return bytes(buf)
+
+
+def serve_frame(handler: Callable[[bytes, str, bytes], bytes],
+                name: str, op: bytes, key: str, body: bytes,
+                peer: str = "", send=None) -> bytes:
+    """Serve ONE framed op through the native plane's ingress
+    chokepoint — trace mint, deadline-slot hygiene, and the workload
+    recorder all happen here, so the thread-per-connection server and
+    the reactor dataplane share exactly one copy of the contract.
+    Returns the complete response frame (status + length + payload);
+    exceptions become a status-1 frame and never escape.
+
+    `send` (the threaded path passes conn.sendall) transmits the frame
+    INSIDE the recording window, keeping the recorded duration's
+    the-send-is-the-work semantics for synchronous transports; the
+    reactor passes None and enqueues the returned frame (its writeback
+    is asynchronous, so transmission time is not attributable to one
+    op)."""
+    t_frame0 = _time.perf_counter() if _RECORDER.enabled else 0.0
+    # trace ingress for the headerless native plane: frames have no
+    # Traceparent slot, so every framed op is its own head-based
+    # sampling decision (rate-gated), minted fresh — the cross-server
+    # propagation story stays an HTTP-plane concern
+    tracer = _get_tracer()
+    prev_ctx = sampled = None
+    traced = False
+    if tracer.enabled:
+        sampled, prev_ctx = _trace_context.begin_request(None)
+        traced = True
+    # deadline hygiene for the headerless plane: frames carry no
+    # X-Weed-Deadline slot, so each op runs budget-free — but the slot
+    # must be CLEARED (and restored), or a pooled thread would leak a
+    # previous request's budget into this frame
+    _ddl, _prev_ddl = _deadline.begin_request(None)
+    frame_status, out_len = 200, 0
+    try:
+        try:
+            # gate on the sampled decision: the hot framed path must
+            # not build span names for unsampled ops
+            if sampled is not None:
+                with tracer.span(f"tcp.{name}",
+                                 op=op.decode("latin-1"), key=key):
+                    payload = handler(op, key, body)
+            else:
+                payload = handler(op, key, body)
+            out_len = len(payload)
+            frame = b"\x00" + U32.pack(len(payload)) + payload
+        except Exception as e:  # noqa: BLE001 - conn must survive
+            frame_status = 500
+            msg = f"{type(e).__name__}: {e}".encode()[:65536]
+            out_len = len(msg)
+            frame = b"\x01" + U32.pack(len(msg)) + msg
+        if send is not None:
+            send(frame)
+    finally:
+        _deadline.end_request(_prev_ddl)
+        if traced:
+            _trace_context.end_request(prev_ctx)
+        if _RECORDER.enabled and t_frame0:
+            # workload flight recorder (observability/reqlog.py): the
+            # native plane's half of the access record stream.  Frames
+            # carry no query strings, so the key needs no redaction;
+            # the route class comes from the op byte.
+            try:
+                _RECORDER.record(
+                    _reqlog.NATIVE_ROUTES.get(
+                        op, f"native_{op.decode('latin-1')}"),
+                    "TCP", "/" + key, frame_status,
+                    bytes_in=len(body), bytes_out=out_len,
+                    duration_ms=(_time.perf_counter() - t_frame0) * 1e3,
+                    peer=peer, handler=name)
+            except Exception:
+                pass  # recording never breaks the plane
+    return frame
 
 
 class FramedServer:
@@ -69,6 +190,7 @@ class FramedServer:
         self.name = name
         self._sock: Optional[socket.socket] = None
         self._stop = threading.Event()
+        self._reactor = None
 
     @property
     def alive(self) -> bool:
@@ -86,12 +208,27 @@ class FramedServer:
             self._sock = None  # weedlint: disable=W502 lifecycle handoff: bind failed, no accept thread was ever started
             return self
         self._sock.listen(64)
+        from . import eventloop
+
+        if eventloop.reactor_enabled():
+            # the shared dataplane owns accept + readiness; frames
+            # dispatch onto its bounded pool through serve_frame (the
+            # same ingress chokepoint the threaded path runs)
+            self._reactor = eventloop.get_reactor()  # weedlint: disable=W502 lifecycle handoff: written on the start() thread before any conn exists
+            self._reactor.add_framed_listener(self._sock, self.handler,
+                                              self.name, self)
+            return self
         threading.Thread(target=self._accept_loop, daemon=True,
                          name=f"{self.name}:{self.port}").start()
         return self
 
     def stop(self) -> None:
         self._stop.set()
+        if self._reactor is not None:
+            self._reactor.remove_listener(self)
+            self._reactor = None  # weedlint: disable=W502 lifecycle teardown: runs after remove_listener drained the loop side
+            self._sock = None  # weedlint: disable=W502 lifecycle teardown: the reactor closed the listener socket
+            return
         if self._sock is not None:
             try:
                 self._sock.close()
@@ -129,63 +266,11 @@ class FramedServer:
                 key = recv_exact(conn, key_len).decode()
                 body_len = U32.unpack(recv_exact(conn, 4))[0]
                 body = recv_exact(conn, body_len) if body_len else b""
-                t_frame0 = _time.perf_counter() if _RECORDER.enabled \
-                    else 0.0
-                # trace ingress for the headerless native plane: frames
-                # have no Traceparent slot, so every framed op is its own
-                # head-based sampling decision (rate-gated), minted fresh
-                # — the cross-server propagation story stays an HTTP-plane
-                # concern, mirroring how replication does
-                tracer = _get_tracer()
-                prev_ctx = sampled = None
-                traced = False
-                if tracer.enabled:
-                    sampled, prev_ctx = _trace_context.begin_request(None)
-                    traced = True
-                # deadline hygiene for the headerless plane: frames
-                # carry no X-Weed-Deadline slot, so each op runs
-                # budget-free — but the slot must be CLEARED (and
-                # restored), or a pooled connection thread would leak a
-                # previous request's budget into this frame
-                _ddl, _prev_ddl = _deadline.begin_request(None)
-                frame_status, out_len = 200, 0
                 try:
-                    # gate on the sampled decision: the 21k-rps framed
-                    # path must not build span names for unsampled ops
-                    if sampled is not None:
-                        with tracer.span(f"tcp.{self.name}",
-                                         op=op.decode("latin-1"), key=key):
-                            payload = self.handler(op, key, body)
-                    else:
-                        payload = self.handler(op, key, body)
-                    out_len = len(payload)
-                    conn.sendall(b"\x00" + U32.pack(len(payload)) + payload)
-                except Exception as e:  # noqa: BLE001 - conn must survive
-                    frame_status = 500
-                    msg = f"{type(e).__name__}: {e}".encode()[:65536]
-                    out_len = len(msg)
-                    conn.sendall(b"\x01" + U32.pack(len(msg)) + msg)
-                finally:
-                    _deadline.end_request(_prev_ddl)
-                    if traced:
-                        _trace_context.end_request(prev_ctx)
-                    if _RECORDER.enabled and t_frame0:
-                        # workload flight recorder (observability/
-                        # reqlog.py): the native plane's half of the
-                        # access record stream.  Frames carry no query
-                        # strings, so the key needs no redaction; the
-                        # route class comes from the op byte.
-                        try:
-                            _RECORDER.record(
-                                _reqlog.NATIVE_ROUTES.get(
-                                    op, f"native_{op.decode('latin-1')}"),
-                                "TCP", "/" + key, frame_status,
-                                bytes_in=len(body), bytes_out=out_len,
-                                duration_ms=(_time.perf_counter()
-                                             - t_frame0) * 1e3,
-                                peer=peer, handler=self.name)
-                        except Exception:
-                            pass  # recording never breaks the plane
+                    serve_frame(self.handler, self.name, op, key, body,
+                                peer, send=conn.sendall)
+                except OSError:
+                    return  # peer went away mid-send: drop the conn
         finally:
             conn.close()
 

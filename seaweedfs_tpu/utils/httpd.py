@@ -202,6 +202,12 @@ class Router:
         # started with -maxInflight > 0 install one; None costs a
         # single attribute check per request
         self.admission = None
+        # optional loop fast-path probe (utils/eventloop.py): when set,
+        # the reactor asks `probe(method, path) -> bool` whether a
+        # GET/HEAD can dispatch INLINE on the event loop (the volume
+        # server answers True only for needle-cache-resident objects).
+        # None = every request dispatches on the worker pool.
+        self.loop_fast_probe = None
         # deadline_exceeded journal rate limit (the counter counts every
         # 504; the ring must not churn under a deadline storm).  A lost
         # write race costs at most one extra journal event.
@@ -522,6 +528,13 @@ class Router:
                     handler.send_header(k, v)
                 handler.end_headers()
                 if handler.command != "HEAD":
+                    # reactor connections take the zero-copy road: the
+                    # loop streams the region with os.sendfile and a
+                    # slow client costs an outbox entry, not a thread
+                    sendfile = getattr(handler, "sendfile", None)
+                    if sendfile is not None and sendfile(
+                            resp.file_path, off, length):
+                        return
                     with open(resp.file_path, "rb") as f:
                         f.seek(off)
                         left = length
@@ -884,12 +897,24 @@ def _serve_stdlib(router: Router, host: str, port: int,
 
 
 def serve(router: Router, host: str, port: int, tls_context=None):
-    """Start the threaded server; with tls_context (an ssl.SSLContext from
+    """Start the HTTP front; with tls_context (an ssl.SSLContext from
     security.tls.server_context) the listening socket speaks HTTPS and —
-    when the context demands client certs — enforces mTLS.  Uses the
-    hand-rolled FastHTTPServer unless WEED_HTTPD=stdlib."""
+    when the context demands client certs — enforces mTLS.
+
+    Default: register the listener on the shared event-loop dataplane
+    (utils/eventloop.py) — keep-alive/pipelined parsing on the reactor,
+    dispatch on its bounded worker pool, zero-copy writeback.  TLS
+    sockets stay on the threaded server (the reactor's non-blocking
+    parse has no handshake state machine).  WEED_DATAPLANE=threaded or
+    WEED_HTTPD=threaded force the thread-per-connection FastHTTPServer;
+    WEED_HTTPD=stdlib falls all the way back to http.server."""
     if os.environ.get("WEED_HTTPD") == "stdlib":
         return _serve_stdlib(router, host, port, tls_context)
+    from . import eventloop
+
+    if tls_context is None and eventloop.reactor_enabled() \
+            and os.environ.get("WEED_HTTPD") != "threaded":
+        return eventloop.ReactorHTTPServer((host, port), router)
     server = FastHTTPServer((host, port), router, tls_context)
     thread = threading.Thread(target=server.serve_forever, daemon=True,
                               name=f"{router.name}:{server.server_address[1]}")

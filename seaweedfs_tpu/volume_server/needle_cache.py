@@ -1,0 +1,207 @@
+"""Popularity-aware needle read cache for the volume-server hot path.
+
+The Zipfian read workloads the bench and the recorded traces both show
+concentrate most traffic on a small hot set — the Haystack observation
+this whole store exists for.  This cache keeps those needles resident
+so a hot read costs a dict lookup instead of a pread + CRC pass, and
+the event-loop dataplane (utils/eventloop.py) can serve a cache-probed
+GET entirely on the loop.
+
+Design (the PR-5 verified-block cache is the precedent for a bounded,
+invalidate-on-write read cache in this tree):
+
+  - admission by observed frequency, not first touch: a needle enters
+    the cache only on its ``admit_after``-th read within the sketch's
+    horizon (default 2) — one-shot scans (vacuum checks, backups,
+    scrubber traffic) cannot wash the hot set out, the TinyLFU idea
+    with a bounded Counter standing in for the sketch;
+  - bounded BYTES with LRU eviction (an OrderedDict move-to-end), so a
+    handful of megabyte needles cannot silently evict the whole 4KB
+    hot set unnoticed: every eviction is counted;
+  - invalidated on write, delete, vacuum/compaction commit, and
+    volume unmount/delete (Store calls the hooks; a vacuum drops the
+    whole volume's entries because compaction renumbers nothing but
+    may have dropped TTL-expired needles the per-key hooks never saw);
+  - TTL'd needles are never cached (expiry is evaluated at read time
+    by the store; a cached copy would outlive it) and neither is any
+    needle bigger than 1/8 of the bound (one object must not own the
+    cache).
+
+Metrics ride stats.needle_cache_metrics() (hits/misses/admissions/
+evictions/invalidations + resident bytes); the bench ``capacity``
+section emits ``needle_cache_hit_ratio`` and tools/bench_diff.py
+watches it.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Optional
+
+from ..storage.needle import FLAG_HAS_TTL, Needle
+
+# overhead charged per entry on top of the data bytes (needle object,
+# dict slots) so a million tiny needles cannot blow the bound
+ENTRY_OVERHEAD = 256
+
+
+def _metrics():
+    from ..stats import needle_cache_metrics
+
+    return needle_cache_metrics()
+
+
+class NeedleCache:
+    """Bounded, frequency-admitted, write-invalidated needle cache.
+    Thread-safe; reached from request threads, the reactor loop's fast
+    path, and maintenance paths concurrently."""
+
+    def __init__(self, max_bytes: int = 64 << 20, admit_after: int = 2,
+                 sketch_cap: int = 65536):
+        self.max_bytes = int(max_bytes)
+        self.admit_after = max(1, int(admit_after))
+        self.sketch_cap = max(1024, int(sketch_cap))
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[tuple, Needle]" = OrderedDict()  # guarded-by: _lock
+        self._bytes = 0  # guarded-by: _lock
+        self._freq: dict[tuple, int] = {}  # guarded-by: _lock
+        self._vols: dict[int, set] = {}  # vid -> cached keys  # guarded-by: _lock
+        # per-volume write epoch: offer() rejects a needle read before
+        # the last invalidation for its volume (the read-repopulates-
+        # after-write race: disk read starts, a write invalidates, the
+        # stale read's offer lands — without the epoch it would serve
+        # the OLD bytes until the next write)
+        self._epochs: dict[int, int] = {}  # guarded-by: _lock
+
+    @property
+    def enabled(self) -> bool:
+        return self.max_bytes > 0
+
+    # --- read side ---------------------------------------------------------
+    def contains(self, vid: int, key: int) -> bool:
+        """Membership probe (no LRU touch, no counters) — what the
+        reactor's loop fast path asks before dispatching inline."""
+        with self._lock:
+            return (vid, key) in self._entries
+
+    def get(self, vid: int, key: int) -> Optional[Needle]:
+        with self._lock:
+            n = self._entries.get((vid, key))
+            if n is not None:
+                self._entries.move_to_end((vid, key))
+        if n is not None:
+            _metrics().hits.inc()
+        else:
+            _metrics().misses.inc()
+        return n
+
+    def epoch(self, vid: int) -> int:
+        """Snapshot the volume's write epoch BEFORE a disk read; pass
+        it back to offer() so a stale read cannot repopulate over a
+        concurrent invalidation."""
+        with self._lock:
+            return self._epochs.get(vid, 0)
+
+    def offer(self, vid: int, key: int, n: Needle,
+              epoch: Optional[int] = None) -> bool:
+        """Offer a just-read needle for admission.  Admits only once
+        the key's observed read frequency clears the bar; returns
+        whether the needle was admitted."""
+        if not self.enabled:
+            return False
+        size = len(n.data or b"") + ENTRY_OVERHEAD
+        if size > self.max_bytes // 8 or n.has(FLAG_HAS_TTL):
+            _metrics().rejections.inc()
+            return False
+        evicted = 0
+        with self._lock:
+            if epoch is not None and \
+                    self._epochs.get(vid, 0) != epoch:
+                return False  # invalidated since the read started
+            k = (vid, key)
+            if k in self._entries:
+                return True
+            freq = self._freq.get(k, 0) + 1
+            if len(self._freq) >= self.sketch_cap and k not in self._freq:
+                # sketch full: age it by halving instead of refusing new
+                # keys — recency matters more than exact old counts
+                self._freq = {fk: c // 2 for fk, c in self._freq.items()
+                              if c // 2 > 0}
+            self._freq[k] = freq
+            if freq < self.admit_after:
+                admitted = False
+            else:
+                while self._bytes + size > self.max_bytes and self._entries:
+                    old_k, old_n = self._entries.popitem(last=False)
+                    self._bytes -= len(old_n.data or b"") + ENTRY_OVERHEAD
+                    self._vols.get(old_k[0], set()).discard(old_k[1])
+                    evicted += 1
+                self._entries[k] = n
+                self._bytes += size
+                self._vols.setdefault(vid, set()).add(key)
+                admitted = True
+            resident = self._bytes
+        m = _metrics()
+        if evicted:
+            m.evictions.inc(amount=evicted)
+        if admitted:
+            m.admissions.inc()
+            m.bytes.set(resident)
+        else:
+            m.rejections.inc()
+        return admitted
+
+    # --- invalidation ------------------------------------------------------
+    def invalidate(self, vid: int, key: int,
+                   reason: str = "write") -> None:
+        with self._lock:
+            self._epochs[vid] = self._epochs.get(vid, 0) + 1
+            n = self._entries.pop((vid, key), None)
+            if n is not None:
+                self._bytes -= len(n.data or b"") + ENTRY_OVERHEAD
+                self._vols.get(vid, set()).discard(key)
+            self._freq.pop((vid, key), None)
+            resident = self._bytes
+        if n is not None:
+            m = _metrics()
+            m.invalidations.inc(reason)
+            m.bytes.set(resident)
+
+    def invalidate_volume(self, vid: int,
+                          reason: str = "vacuum") -> None:
+        dropped = 0
+        with self._lock:
+            self._epochs[vid] = self._epochs.get(vid, 0) + 1
+            keys = self._vols.pop(vid, set())
+            for key in keys:
+                n = self._entries.pop((vid, key), None)
+                if n is not None:
+                    self._bytes -= len(n.data or b"") + ENTRY_OVERHEAD
+                    dropped += 1
+            if keys:
+                self._freq = {k: c for k, c in self._freq.items()
+                              if k[0] != vid}
+            resident = self._bytes
+        if dropped:
+            m = _metrics()
+            m.invalidations.inc(reason, amount=dropped)
+            m.bytes.set(resident)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self._vols.clear()
+            self._freq.clear()
+            self._bytes = 0
+        _metrics().bytes.set(0)
+
+    # --- introspection -----------------------------------------------------
+    def status(self) -> dict:
+        with self._lock:
+            entries = len(self._entries)
+            resident = self._bytes
+        return {"enabled": self.enabled, "entries": entries,
+                "bytes": resident, "max_bytes": self.max_bytes,
+                "admit_after": self.admit_after,
+                **_metrics().totals()}

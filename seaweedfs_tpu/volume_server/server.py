@@ -48,6 +48,11 @@ from ..utils.httpd import (
 from .store import Store
 
 FID_PATTERN = r"/(\d+),([0-9a-f]+)"
+# the loop fast path only takes bare object paths: any query string
+# (resize, readDeleted, ...) or trailing segment stays on the pool
+import re as _re
+
+_FAST_FID_RE = _re.compile(r"^/(\d+),([0-9a-f]+)$", _re.IGNORECASE)
 
 
 def _bind_with_retry(factory, timeout: float = 3.0, pause: float = 0.15,
@@ -102,7 +107,8 @@ class VolumeServer:
                  full_sync_every: int = 12,
                  tls_context=None,
                  tcp: bool = True, use_mmap: bool = False,
-                 dataplane: str = "python", max_inflight: int = 0):
+                 dataplane: str = "python", max_inflight: int = 0,
+                 needle_cache_mb: int = 64):
         from ..security import Guard
 
         if backends:
@@ -121,7 +127,8 @@ class VolumeServer:
         self.guard = guard or Guard()
         self.store = Store(directories, host, port, public_url,
                            max_volume_count, ec_engine=ec_engine,
-                           use_mmap=use_mmap)
+                           use_mmap=use_mmap,
+                           needle_cache_mb=needle_cache_mb)
         from ..stats import ec_pipeline_metrics, volume_server_metrics
 
         self.metrics = volume_server_metrics()
@@ -129,9 +136,15 @@ class VolumeServer:
         # scraper sees the series (at 0) before the first restart or
         # fallback ever happens
         ec_pipeline_metrics()
-        from ..stats import ec_integrity_metrics
+        from ..stats import (dataplane_metrics, ec_integrity_metrics,
+                             needle_cache_metrics)
 
         ec_integrity_metrics()
+        # same up-front registration for the serving-dataplane and
+        # needle-cache families: a scraper must see the zero-valued
+        # series before first traffic, not a gap
+        dataplane_metrics()
+        needle_cache_metrics()
         # EC bit-rot scrubber (scrubber.py): idle until /ec/scrub/start
         # (or weed shell ec.scrub); pauses itself while request traffic
         # is high
@@ -180,6 +193,10 @@ class VolumeServer:
         from ..utils.admission import maybe_controller
 
         self.router.admission = maybe_controller(max_inflight, "volume")
+        # event-loop fast path (utils/eventloop.py): GET/HEAD object
+        # reads whose needle the popularity cache holds dispatch inline
+        # on the reactor loop — zero thread handoffs for the Zipf head
+        self.router.loop_fast_probe = self._loop_fast_probe
         self._register_routes()
         self._server = None
         self._tls_context = tls_context
@@ -200,6 +217,26 @@ class VolumeServer:
     @property
     def url(self) -> str:
         return f"{self.store.ip}:{self.store.port}"
+
+    def _loop_fast_probe(self, method: str, path: str) -> bool:
+        """Loop-safe membership probe for the reactor's inline fast
+        path: True only for plain object GET/HEADs (no query — resize
+        and friends stay on the pool) whose needle the popularity
+        cache is currently holding.  A True answer means the dispatch
+        will complete without touching disk (a raced invalidation
+        degrades to one bounded pread).  Must never block: one regex,
+        one fid parse, one dict lookup."""
+        m = _FAST_FID_RE.match(path)
+        if m is None:
+            return False
+        cache = self.store.needle_cache
+        if not cache.enabled or self.store.native_plane is not None:
+            return False
+        try:
+            fid = FileId.parse(f"{m.group(1)},{m.group(2)}")
+        except ValueError:
+            return False
+        return cache.contains(fid.volume_id, fid.key)
 
     def _scrub_busy(self) -> bool:
         """Scrubber load gate: True while this server is taking real
@@ -407,6 +444,16 @@ class VolumeServer:
             hit = self._vid_cache.get(vid)
         if hit is not None and hit[1] > now:
             return hit[0]
+        from ..utils import eventloop as _eventloop
+
+        if _eventloop.reactor_enabled() \
+                and _eventloop.get_reactor().on_loop_thread():
+            # a cache-probed fast-path read can race a volume unmount
+            # into the replica-redirect branch; the master round trip
+            # below must NEVER run on the reactor loop (it would stall
+            # every connection) — answer from the cache only, and let
+            # the caller 404 so the client re-looks-up
+            return []
         try:
             # the master round trip runs OUTSIDE _vid_lock (W504: a
             # slow master would stall every replicated write behind one
@@ -580,159 +627,9 @@ class VolumeServer:
     def _register_routes(self) -> None:
         r = self.router
 
-        @r.route("POST", "/admin/leave")
-        def leave(req: Request) -> Response:
-            """volume.server.leave: stop heartbeating so the master's
-            janitor unregisters this node; data and the HTTP surface stay
-            up until the process exits (VolumeServerLeave RPC)."""
-            self._stop.set()
-            return Response({"left": True})
-
-        @r.route("POST", "/admin/heartbeat_now")
-        def heartbeat_now(req: Request) -> Response:
-            self.heartbeat_now()
-            return Response({})
-
-        @r.route("GET", "/metrics")
-        def metrics(req: Request) -> Response:
-            from ..stats import REGISTRY
-
-            # refresh gauges from the live store (volume + EC-shard counts,
-            # disk usage per collection — stats/metrics.go gauge family)
-            self.metrics.volume_counter.clear()
-            self.metrics.disk_size_gauge.clear()
-            for v in list(self.store.volumes.values()):
-                self.metrics.volume_counter.add(v.collection, "volume", 1)
-                self.metrics.disk_size_gauge.add(
-                    v.collection, "volume", v.data_size)
-            for vid, ev in list(self.store.ec_volumes.items()):
-                self.metrics.volume_counter.add(
-                    self.store.ec_collections.get(vid, ""), "ec_shards",
-                    len(ev.shards))
-            plane = self.store.native_plane
-            self.metrics.native_plane_gauge.clear()
-            if plane is not None:
-                for vid, (ds, fc, _mk, db, sp) in \
-                        plane.stats_all().items():
-                    g = self.metrics.native_plane_gauge
-                    g.set(str(vid), "size_bytes", ds)
-                    g.set(str(vid), "live_files", fc)
-                    g.set(str(vid), "deleted_bytes", db)
-                    g.set(str(vid), "fsync_passes", sp)
-            from ..stats.metrics import exemplars_requested
-
-            return Response(
-                raw=REGISTRY.expose(
-                    exemplars=exemplars_requested(req)).encode(),
-                headers={
-                "Content-Type": "text/plain; version=0.0.4; charset=utf-8"})
-
-        def status_doc() -> dict:
-            volumes = []
-            for v in list(self.store.volumes.values()):  # snapshot: races
-                try:                                     # assign/delete
-                    volumes.append(self.store._volume_info(v))
-                except Exception:
-                    # mid-swap (compaction/tier commit): report the plain
-                    # attributes rather than dropping the volume — the
-                    # copy protocol's was_readonly probe must still see
-                    # an operator fence
-                    volumes.append({"id": v.id, "collection": v.collection,
-                                    "read_only": v.read_only,
-                                    "mid_swap": True})
-            from ..stats import ec_pipeline_metrics
-
-            doc = {
-                "Version": "seaweedfs-tpu 0.1",
-                "Volumes": volumes,
-                "EcVolumes": sorted(list(self.store.ec_volumes)),
-                # self-healing pipeline health: nonzero restarts mean the
-                # supervisor respawned parity workers, nonzero fallbacks
-                # mean dispatches degraded to the CPU codec — encodes
-                # still completed byte-identical, but perf numbers from
-                # this server may reflect degraded runs
-                "EcPipeline": ec_pipeline_metrics().totals(),
-            }
-            from ..stats import ec_integrity_metrics
-
-            # bit-rot defense: nonzero corrupt_shards means sidecar
-            # verification demoted shards somewhere on this server
-            doc["EcIntegrity"] = ec_integrity_metrics().totals()
-            scrub_st = self.scrubber.status()  # locked verdict snapshot
-            doc["EcScrub"] = {
-                "running": scrub_st["running"],
-                "passes": scrub_st["passes"],
-                "cursor": scrub_st["cursor"],
-                "verdicts": {v: d.get("status", "?")
-                             for v, d in scrub_st["verdicts"].items()},
-            }
-            plane = self.store.native_plane
-            if plane is not None:
-                doc["NativeDataPlane"] = {
-                    "tcp_port": plane.port,
-                    "volumes": {
-                        vid: {"size": ds, "file_count": fc,
-                              "deleted_bytes": db, "fsync_passes": sp}
-                        for vid, (ds, fc, _mk, db, sp)
-                        in plane.stats_all().items()},
-                }
-            return doc
-
-        @r.route("GET", "/status")
-        def status(req: Request) -> Response:
-            return Response(status_doc())
-
-        @r.route("GET", "/stats/counter")
-        def stats_counter(req: Request) -> Response:
-            """statsCounterHandler (common.go:228): per-operation request
-            counts, rendered from the same collectors /metrics exposes."""
-            counters = {
-                labels[0] if labels else "": int(v)
-                for labels, v
-                in self.metrics.request_counter.snapshot().items()}
-            return Response({"Version": "seaweedfs-tpu 0.1",
-                             "Counters": counters})
-
-        @r.route("GET", "/stats/memory")
-        def stats_memory(req: Request) -> Response:
-            import resource
-            import sys as _sys
-
-            ru = resource.getrusage(resource.RUSAGE_SELF)
-            # ru_maxrss is KB on Linux but BYTES on macOS
-            rss_kb = (ru.ru_maxrss // 1024 if _sys.platform == "darwin"
-                      else ru.ru_maxrss)
-            return Response({"Version": "seaweedfs-tpu 0.1",
-                             "Memory": {"MaxRssKb": rss_kb,
-                                        "UserSeconds": ru.ru_utime,
-                                        "SystemSeconds": ru.ru_stime}})
-
-        @r.route("GET", "/stats/disk")
-        def stats_disk(req: Request) -> Response:
-            """statsDiskHandler: statvfs per volume directory."""
-            ds = []
-            for loc in self.store.locations:
-                st = os.statvfs(loc.directory)
-                total = st.f_frsize * st.f_blocks
-                free = st.f_frsize * st.f_bavail
-                ds.append({"dir": os.path.abspath(loc.directory),
-                           "all": total, "free": free,
-                           "used": total - free,
-                           "percent_free": round(100.0 * free /
-                                                 max(total, 1), 2)})
-            return Response({"Version": "seaweedfs-tpu 0.1",
-                             "DiskStatuses": ds})
-
-        from ..utils.debug import register_debug_routes
-
-        register_debug_routes(r, name=f"volume server {self.url}",
-                              status_fn=lambda: {
-                                  **status_doc(),
-                                  "Master": self.master_url,
-                                  "DataCenter": self.data_center,
-                                  "Rack": self.rack,
-                              })
-
+        # object + batch routes FIRST: Router.dispatch matches the
+        # route table in registration order, and the hot read path
+        # must not pay a failed regex per admin route before its own
         @r.route("GET", FID_PATTERN)
         @r.route("HEAD", FID_PATTERN)
         def read_object(req: Request) -> Response:
@@ -834,6 +731,113 @@ class VolumeServer:
                     return Response(raw=body[off:off + sz], status=206,
                                     headers=headers)
             return Response(raw=body, headers=headers)
+
+        @r.route("POST", "/batch/read")
+        def batch_read(req: Request) -> Response:
+            """Batched GET: one request carries N fids, the response is
+            length-prefixed binary — status(1, 0=ok) | u32 len |
+            payload per fid, in request order.  The store's ~930k
+            ops/s batched read throughput is unreachable one HTTP
+            round trip at a time; this amortizes the framing/dispatch
+            cost over the whole batch.  Secured clusters (read JWTs)
+            refuse: the batch has no per-fid token slot."""
+            from ..utils.framing import U32 as _U32
+
+            if self.guard.read_signing_key:
+                raise HttpError(401, "batch read unavailable with "
+                                     "read JWTs configured")
+            fids = req.json().get("fids", [])
+            if not isinstance(fids, list) or len(fids) > 10000:
+                raise HttpError(400, "fids must be a list of <= 10000")
+            out = []
+            for fid_str in fids:
+                try:
+                    fid = FileId.parse(str(fid_str))
+                    n = self.store.read_needle(fid.volume_id, fid.key,
+                                               fid.cookie)
+                    data = n.data
+                    if n.is_compressed:
+                        from ..utils.compression import ungzip_data
+
+                        data = ungzip_data(data)
+                    out.append(b"\x00" + _U32.pack(len(data)))
+                    out.append(data)
+                except Exception as e:
+                    msg = f"{type(e).__name__}: {e}".encode()[:4096]
+                    out.append(b"\x01" + _U32.pack(len(msg)) + msg)
+            return Response(raw=b"".join(out), headers={
+                "X-Batch-Count": str(len(fids))})
+
+        @r.route("POST", "/batch/write")
+        def batch_write(req: Request) -> Response:
+            """Batched PUT: body is u16 fid_len | fid | u32 data_len |
+            data, repeated; the response lists per-fid results.  Writes
+            fan out to replicas volume-by-volume on the same batch
+            framing.  Secured clusters (write JWTs) refuse — no per-fid
+            token slot."""
+            import json as _json
+
+            from ..utils.framing import pack_fid_frames, unpack_fid_frames
+
+            if not self.guard.white_list_ok(req):
+                raise HttpError(401, "not in whitelist")
+            if self.guard.signing_key:
+                raise HttpError(401, "batch write unavailable with "
+                                     "write JWTs configured")
+            # unpack the WHOLE batch before touching the store: a torn
+            # frame must answer 400 with ZERO items applied, never
+            # leave hidden local writes the replication loop below
+            # would also skip
+            try:
+                items = unpack_fid_frames(req.body, with_data=True)
+            except ValueError as e:
+                raise HttpError(400, str(e))
+            results = []
+            by_vid: dict[int, list[tuple[str, bytes]]] = {}
+            for fid_str, data in items:
+                try:
+                    fid = FileId.parse(fid_str)
+                    n = Needle(cookie=fid.cookie, id=fid.key, data=data)
+                    n.set_flag(FLAG_HAS_LAST_MODIFIED)
+                    n.last_modified = int(time.time())
+                    size, _unchanged = self.store.write_needle(
+                        fid.volume_id, n)
+                    results.append({"fid": fid_str, "status": 201,
+                                    "size": len(data)})
+                    if req.query.get("type") != "replicate":
+                        by_vid.setdefault(fid.volume_id, []).append(
+                            (fid_str, data))
+                except Exception as e:
+                    results.append({"fid": fid_str, "status": 500,
+                                    "error": f"{type(e).__name__}: {e}"})
+            for vid, vitems in by_vid.items():
+                for url in self._lookup_replicas(vid):
+                    if url == self.url:
+                        continue
+                    status, rbody, _h = http_bytes(
+                        "POST",
+                        f"http://{url}/batch/write?type=replicate",
+                        pack_fid_frames(vitems, with_data=True),
+                        timeout=60.0)
+                    if status != 200:
+                        raise HttpError(
+                            500, f"batch replication to {url} failed: "
+                                 f"{status}")
+                    # the replica answers 200 even with per-fid
+                    # failures inside: a diverged replica must fail
+                    # the batch loudly, not launder through transport
+                    # success
+                    try:
+                        rres = _json.loads(rbody).get("results", [])
+                    except Exception:
+                        rres = []
+                    bad = [r for r in rres if r.get("status") != 201]
+                    if bad or len(rres) != len(vitems):
+                        raise HttpError(
+                            500, f"batch replication to {url}: "
+                                 f"{len(bad) or 'missing'} item(s) "
+                                 f"failed on the replica")
+            return Response({"results": results})
 
         @r.route("POST", FID_PATTERN)
         @r.route("PUT", FID_PATTERN)
@@ -972,6 +976,172 @@ class VolumeServer:
                         url, _up.quote(req.path, safe="/,"), qs), timeout=60.0)
             return Response({"size": size})
 
+
+        @r.route("POST", "/admin/leave")
+        def leave(req: Request) -> Response:
+            """volume.server.leave: stop heartbeating so the master's
+            janitor unregisters this node; data and the HTTP surface stay
+            up until the process exits (VolumeServerLeave RPC)."""
+            self._stop.set()
+            return Response({"left": True})
+
+        @r.route("POST", "/admin/heartbeat_now")
+        def heartbeat_now(req: Request) -> Response:
+            self.heartbeat_now()
+            return Response({})
+
+        @r.route("GET", "/metrics")
+        def metrics(req: Request) -> Response:
+            from ..stats import REGISTRY
+
+            # refresh gauges from the live store (volume + EC-shard counts,
+            # disk usage per collection — stats/metrics.go gauge family)
+            self.metrics.volume_counter.clear()
+            self.metrics.disk_size_gauge.clear()
+            for v in list(self.store.volumes.values()):
+                self.metrics.volume_counter.add(v.collection, "volume", 1)
+                try:
+                    size = v.data_size
+                except Exception:
+                    continue  # mid-compaction-commit swap (closed .dat):
+                    # skip this scrape's sample rather than 500 the
+                    # whole exposition (same guard as status_doc)
+                self.metrics.disk_size_gauge.add(
+                    v.collection, "volume", size)
+            for vid, ev in list(self.store.ec_volumes.items()):
+                self.metrics.volume_counter.add(
+                    self.store.ec_collections.get(vid, ""), "ec_shards",
+                    len(ev.shards))
+            plane = self.store.native_plane
+            self.metrics.native_plane_gauge.clear()
+            if plane is not None:
+                for vid, (ds, fc, _mk, db, sp) in \
+                        plane.stats_all().items():
+                    g = self.metrics.native_plane_gauge
+                    g.set(str(vid), "size_bytes", ds)
+                    g.set(str(vid), "live_files", fc)
+                    g.set(str(vid), "deleted_bytes", db)
+                    g.set(str(vid), "fsync_passes", sp)
+            from ..stats.metrics import exemplars_requested
+
+            return Response(
+                raw=REGISTRY.expose(
+                    exemplars=exemplars_requested(req)).encode(),
+                headers={
+                "Content-Type": "text/plain; version=0.0.4; charset=utf-8"})
+
+        def status_doc() -> dict:
+            volumes = []
+            for v in list(self.store.volumes.values()):  # snapshot: races
+                try:                                     # assign/delete
+                    volumes.append(self.store._volume_info(v))
+                except Exception:
+                    # mid-swap (compaction/tier commit): report the plain
+                    # attributes rather than dropping the volume — the
+                    # copy protocol's was_readonly probe must still see
+                    # an operator fence
+                    volumes.append({"id": v.id, "collection": v.collection,
+                                    "read_only": v.read_only,
+                                    "mid_swap": True})
+            from ..stats import ec_pipeline_metrics
+
+            doc = {
+                "Version": "seaweedfs-tpu 0.1",
+                "Volumes": volumes,
+                "EcVolumes": sorted(list(self.store.ec_volumes)),
+                # self-healing pipeline health: nonzero restarts mean the
+                # supervisor respawned parity workers, nonzero fallbacks
+                # mean dispatches degraded to the CPU codec — encodes
+                # still completed byte-identical, but perf numbers from
+                # this server may reflect degraded runs
+                "EcPipeline": ec_pipeline_metrics().totals(),
+            }
+            from ..stats import ec_integrity_metrics
+
+            # bit-rot defense: nonzero corrupt_shards means sidecar
+            # verification demoted shards somewhere on this server
+            doc["EcIntegrity"] = ec_integrity_metrics().totals()
+            # serving dataplane: popularity-cache occupancy/hit ratio
+            # and reactor dispatch/abort accounting
+            doc["NeedleCache"] = self.store.needle_cache.status()
+            from ..stats import dataplane_metrics
+
+            doc["Dataplane"] = dataplane_metrics().totals()
+            scrub_st = self.scrubber.status()  # locked verdict snapshot
+            doc["EcScrub"] = {
+                "running": scrub_st["running"],
+                "passes": scrub_st["passes"],
+                "cursor": scrub_st["cursor"],
+                "verdicts": {v: d.get("status", "?")
+                             for v, d in scrub_st["verdicts"].items()},
+            }
+            plane = self.store.native_plane
+            if plane is not None:
+                doc["NativeDataPlane"] = {
+                    "tcp_port": plane.port,
+                    "volumes": {
+                        vid: {"size": ds, "file_count": fc,
+                              "deleted_bytes": db, "fsync_passes": sp}
+                        for vid, (ds, fc, _mk, db, sp)
+                        in plane.stats_all().items()},
+                }
+            return doc
+
+        @r.route("GET", "/status")
+        def status(req: Request) -> Response:
+            return Response(status_doc())
+
+        @r.route("GET", "/stats/counter")
+        def stats_counter(req: Request) -> Response:
+            """statsCounterHandler (common.go:228): per-operation request
+            counts, rendered from the same collectors /metrics exposes."""
+            counters = {
+                labels[0] if labels else "": int(v)
+                for labels, v
+                in self.metrics.request_counter.snapshot().items()}
+            return Response({"Version": "seaweedfs-tpu 0.1",
+                             "Counters": counters})
+
+        @r.route("GET", "/stats/memory")
+        def stats_memory(req: Request) -> Response:
+            import resource
+            import sys as _sys
+
+            ru = resource.getrusage(resource.RUSAGE_SELF)
+            # ru_maxrss is KB on Linux but BYTES on macOS
+            rss_kb = (ru.ru_maxrss // 1024 if _sys.platform == "darwin"
+                      else ru.ru_maxrss)
+            return Response({"Version": "seaweedfs-tpu 0.1",
+                             "Memory": {"MaxRssKb": rss_kb,
+                                        "UserSeconds": ru.ru_utime,
+                                        "SystemSeconds": ru.ru_stime}})
+
+        @r.route("GET", "/stats/disk")
+        def stats_disk(req: Request) -> Response:
+            """statsDiskHandler: statvfs per volume directory."""
+            ds = []
+            for loc in self.store.locations:
+                st = os.statvfs(loc.directory)
+                total = st.f_frsize * st.f_blocks
+                free = st.f_frsize * st.f_bavail
+                ds.append({"dir": os.path.abspath(loc.directory),
+                           "all": total, "free": free,
+                           "used": total - free,
+                           "percent_free": round(100.0 * free /
+                                                 max(total, 1), 2)})
+            return Response({"Version": "seaweedfs-tpu 0.1",
+                             "DiskStatuses": ds})
+
+        from ..utils.debug import register_debug_routes
+
+        register_debug_routes(r, name=f"volume server {self.url}",
+                              status_fn=lambda: {
+                                  **status_doc(),
+                                  "Master": self.master_url,
+                                  "DataCenter": self.data_center,
+                                  "Rack": self.rack,
+                              })
+
         # --- admin: volume lifecycle ---------------------------------
         @r.route("POST", "/admin/assign_volume")
         def assign_volume(req: Request) -> Response:
@@ -1051,6 +1221,9 @@ class VolumeServer:
             vid = int(req.json()["volume_id"])
             with self.store.volume_locks[vid]:
                 self.store.get_volume(vid).commit_compact()
+            # compaction dropped deleted/expired needles the per-key
+            # hooks never saw: the whole volume leaves the read cache
+            self.store.needle_cache.invalidate_volume(vid, "vacuum")
             self.store.native_reattach(vid)
             return Response({})
 

@@ -150,8 +150,16 @@ class Store:
     def __init__(self, directories: list[str], ip: str = "127.0.0.1",
                  port: int = 8080, public_url: str = "",
                  max_volume_count: int = 8,
-                 ec_engine: str = "cpu", use_mmap: bool = False):
+                 ec_engine: str = "cpu", use_mmap: bool = False,
+                 needle_cache_mb: int = 64):
+        from .needle_cache import NeedleCache
+
         self.ip, self.port = ip, port
+        # popularity-aware needle read cache (needle_cache.py): hot
+        # Zipf-head reads skip the pread+CRC pass; write/delete/vacuum
+        # invalidate below.  0 disables (-dataplane.cacheMB)
+        self.needle_cache = NeedleCache(
+            max_bytes=max(0, int(needle_cache_mb)) << 20)
         self.public_url = public_url or f"{ip}:{port}"
         self.locations = [DiskLocation(d) for d in directories]
         self.max_volume_count = max_volume_count
@@ -328,6 +336,7 @@ class Store:
                 self._native_holds.pop(vid, None)
         v = self.volumes.pop(vid, None)
         self.volume_locks.pop(vid, None)
+        self.needle_cache.invalidate_volume(vid, "unmount")
         if v is not None:
             v.destroy()
             self.note_volume_change(vid, gone=True)
@@ -339,6 +348,7 @@ class Store:
                 self._native_holds.pop(vid, None)
         v = self.volumes.pop(vid, None)
         self.volume_locks.pop(vid, None)
+        self.needle_cache.invalidate_volume(vid, "unmount")
         if v is not None:
             v.close()
             self.note_volume_change(vid, gone=True)
@@ -579,6 +589,7 @@ class Store:
                     _, size, unchanged = v.write_needle2(n, fsync=True)
                 else:
                     _, size, unchanged = v.write_needle(n)
+            self.needle_cache.invalidate(vid, n.id, "write")
             self.note_volume_change(vid)
             return size, unchanged
         if fsync:
@@ -592,6 +603,10 @@ class Store:
                 # refetch under the lock: compaction commit swaps the
                 # volume object under this same lock
                 _, size, unchanged = self.get_volume(vid).write_needle(n)
+        # overwrites must not serve yesterday's bytes from the read
+        # cache (AFTER the disk write: the epoch also fences racing
+        # read-side repopulation)
+        self.needle_cache.invalidate(vid, n.id, "write")
         # stats changed: the next delta pulse refreshes this volume's
         # counters on the master (idle volumes cost nothing)
         self.note_volume_change(vid)
@@ -629,6 +644,7 @@ class Store:
                 v = self.get_volume(vid)
                 size = v.delete_needle2(n, fsync=True) if fsync \
                     else v.delete_needle(n)
+            self.needle_cache.invalidate(vid, n.id, "delete")
             self.note_volume_change(vid)
             return size
         if fsync:
@@ -636,12 +652,34 @@ class Store:
         else:
             with self.volume_locks[vid]:
                 size = self.get_volume(vid).delete_needle(n)
+        self.needle_cache.invalidate(vid, n.id, "delete")
         self.note_volume_change(vid)
         return size
+
+    def _cache_check(self, vid: int, key: int,
+                     cookie: Optional[int]) -> Optional[Needle]:
+        """Popularity-cache hit, with the same handler-level cookie
+        check the disk path applies — a cached hit must be
+        indistinguishable from a pread."""
+        n = self.needle_cache.get(vid, key)
+        if n is None:
+            return None
+        if cookie is not None and n.cookie != cookie:
+            raise CookieMismatchError(f"cookie mismatch for {key}")
+        return n
 
     def read_needle(self, vid: int, key: int, cookie: Optional[int] = None) -> Needle:
         plane = self.native_plane
         if plane is None:
+            cache = self.needle_cache
+            if cache.enabled:
+                n = self._cache_check(vid, key, cookie)
+                if n is not None:
+                    return n
+                ep = cache.epoch(vid)
+                n = self.get_volume(vid).read_needle(key, cookie)
+                cache.offer(vid, key, n, epoch=ep)
+                return n
             return self.get_volume(vid).read_needle(key, cookie)
         # two rounds: a plane_gone in round 1 may mean "mid-reattach";
         # round 2 re-checks has() so a just-re-registered plane serves the

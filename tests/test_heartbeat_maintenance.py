@@ -132,9 +132,15 @@ def test_vacuum_scan_loop_compacts_garbage(tmp_path):
         vid = next(iter(vs.store.volumes))
         v = vs.store.volumes[vid]
         before = v.data_size
-        # the scan loop (no operator trigger!) must compact within ~2 ticks
-        assert _wait(lambda: vs.store.volumes[vid].data_size < before,
-                     timeout=6.0)
+        # the scan loop (no operator trigger!) must compact within ~2
+        # ticks.  The poll is lock-free and can land INSIDE the
+        # commit's close-swap-reopen window (volume._dat briefly None)
+        # — skip that tick instead of crashing on it
+        def _compacted() -> bool:
+            vol = vs.store.volumes[vid]
+            return vol._dat is not None and vol.data_size < before
+
+        assert _wait(_compacted, timeout=6.0)
     finally:
         vs.stop()
         master.stop()

@@ -19,6 +19,7 @@ Rules (see README "Static analysis" for the full table):
     W502  lockset: unannotated mutation in threaded class [new]
     W503  lock-order cycles over the call graph
     W504  blocking call reachable under a held lock
+    W505  blocking call reachable from an event-loop callback
     W601  route query-param parsing must 400, not 500     [new]
     W701  fault-point registry consistency + test cover   [new]
     W801  ec/ resource acquire without release-on-all-paths [new]

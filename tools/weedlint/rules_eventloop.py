@@ -1,0 +1,182 @@
+"""W505: no blocking calls reachable from event-loop callbacks.
+
+The serving dataplane (seaweedfs_tpu/utils/eventloop.py) multiplexes
+EVERY connection of a process onto one selector loop.  One blocking
+call on that loop — a disk pread, a ``time.sleep``, a timeout-less
+queue wait — stalls every connection at once: the exact failure class
+the thread-per-connection design never had, and the reason the loop's
+code discipline must be machine-checked, not review-checked.
+
+Loop entry points are marked with a ``# loop-callback`` comment on the
+``def`` line (the ``# thread-entry`` convention's sibling).  From each
+such root this rule walks the call graph (sync edges only — a
+``submit``/``Thread`` spawn target runs on another thread) and fires
+when any reachable call is classified blocking by the W504 tables
+(HTTP egress, sleep, timeout-less queue/event waits, subprocess,
+unbounded reads) or by the loop-specific disk-helper table
+(``os.pread``/``os.open``/``os.fsync``/...).
+
+Two scoping rules keep the findings honest:
+
+  - calls lexically inside a NESTED def/lambda are skipped — the
+    dataplane's dispatch closures are built on the loop but run on the
+    worker pool, and the call graph attributes their bodies to the
+    enclosing function;
+  - a callee that is itself a ``# loop-callback`` root is not
+    re-walked from an outer root — it gets its own findings, anchored
+    where the fix belongs.
+
+Findings anchor at the loop-side origin (the direct blocking call, or
+the call site whose transitive callee blocks).  Audited exceptions are
+waived AT THAT LINE with::
+
+    # weedlint: loop-io <why this cannot actually block the loop>
+
+(the eventloop's cache-probed inline dispatch is the one shipped
+waiver).  A reason-less loop-io waiver is itself a finding.  The
+baseline stays EMPTY.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Optional
+
+from .callgraph import CallGraph, get_callgraph
+from .engine import Finding, Repo, Rule, register
+from .rules_blocking import classify_blocking
+
+_LOOP_CB_RE = re.compile(r"#\s*loop-callback\b")
+_LOOP_IO_RE = re.compile(r"#\s*weedlint:\s*loop-io(?:\s+(.*))?$")
+
+# disk-touching helpers the W504 lock tables deliberately ignore (a
+# lock held across one pread is merely slow) but the LOOP must never
+# reach: one rotational-disk seek is ~10ms of every connection's time
+LOOP_DISK_CALLS = {
+    "os.pread", "os.pwrite", "os.read", "os.write", "os.open",
+    "os.fsync", "os.fdatasync", "os.replace", "os.remove",
+    "os.listdir", "os.stat", "open", "pread_padded",
+}
+
+
+def classify_loop_blocking(cs, node, graph: CallGraph) -> Optional[str]:
+    cat = classify_blocking(cs, node, graph)
+    if cat is not None:
+        return cat
+    if cs.desc in LOOP_DISK_CALLS:
+        return "disk"
+    return None
+
+
+def _nested_lines(fn: ast.AST) -> list[tuple[int, int]]:
+    """Line ranges of defs/lambdas nested inside fn — their bodies run
+    wherever the closure is handed (the worker pool, here), not on the
+    loop, so their call sites are out of scope."""
+    out = []
+    for sub in ast.walk(fn):
+        if sub is fn:
+            continue
+        if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.Lambda)):
+            out.append((sub.lineno, getattr(sub, "end_lineno",
+                                            sub.lineno)))
+    return out
+
+
+def _in_ranges(lineno: int, ranges: list[tuple[int, int]]) -> bool:
+    return any(lo <= lineno <= hi for lo, hi in ranges)
+
+
+_HINT = ("move the blocking work onto the dispatch worker pool "
+         "(reactor.submit) and hand the loop only ready bytes, or "
+         "waive with `# weedlint: loop-io <reason>` if the call "
+         "provably cannot block")
+
+
+def check_eventloop(graph: CallGraph) -> list[Finding]:
+    findings: list[Finding] = []
+    seen: set[tuple] = set()
+    edges = graph.sync_edges()
+    roots = {q: node for q, node in graph.nodes.items()
+             if _LOOP_CB_RE.search(graph.line(node.rel, node.lineno))}
+
+    def report(rel: str, lineno: int, message: str, desc: str) -> None:
+        m = _LOOP_IO_RE.search(graph.line(rel, lineno))
+        if m is not None:
+            reason = (m.group(1) or "").strip()
+            if reason:
+                return  # audited, reasoned: suppressed
+            key = (rel, lineno, "no-reason")
+            if key not in seen:
+                seen.add(key)
+                findings.append(Finding(
+                    "W505", rel, lineno,
+                    f"loop-io waiver on `{desc}` has no reason",
+                    "# weedlint: loop-io <why this cannot block the "
+                    "loop>"))
+            return
+        key = (rel, lineno, message)
+        if key not in seen:
+            seen.add(key)
+            findings.append(Finding("W505", rel, lineno, message,
+                                    _HINT))
+
+    for q, root in roots.items():
+        skip = _nested_lines(root.fn)
+        for cs in root.calls:
+            if _in_ranges(cs.lineno, skip):
+                continue  # closure body: runs off-loop
+            # 1) the root itself blocks
+            cat = classify_loop_blocking(cs, root, graph)
+            if cat is not None and not cs.spawn:
+                report(root.rel, cs.lineno,
+                       f"loop callback {q} performs blocking {cat} "
+                       f"call `{cs.desc}` on the event loop", cs.desc)
+                continue
+            # 2) something it (transitively) calls blocks — anchored
+            # HERE, where the fix or the waiver belongs
+            if cs.spawn or not cs.callees:
+                continue
+            visited: set[str] = set(cs.callees)
+            queue: list[tuple[str, list[str]]] = [
+                (c, [c]) for c in sorted(cs.callees)]
+            while queue:
+                cur, chain = queue.pop(0)
+                if cur in roots and cur != q:
+                    continue  # its own root: anchored there instead
+                node = graph.nodes.get(cur)
+                if node is None:
+                    continue
+                inner_skip = _nested_lines(node.fn)
+                for inner in node.calls:
+                    if inner.spawn or _in_ranges(inner.lineno,
+                                                 inner_skip):
+                        continue
+                    cat = classify_loop_blocking(inner, node, graph)
+                    if cat is not None:
+                        report(root.rel, cs.lineno,
+                               f"loop callback {q} reaches blocking "
+                               f"{cat} call `{inner.desc}` "
+                               f"({node.rel}:{inner.lineno}) via "
+                               + " -> ".join(
+                                   c.split("::")[-1] for c in chain),
+                               cs.desc)
+                for callee in sorted(edges.get(cur, ())):
+                    if callee not in visited:
+                        visited.add(callee)
+                        queue.append((callee, chain + [callee]))
+    findings.sort(key=lambda f: (f.path, f.line, f.message))
+    return findings
+
+
+@register
+class EventLoopBlockingRule(Rule):
+    id = "W505"
+    name = "no-blocking-on-event-loop"
+    summary = ("calls classified blocking (W504 tables + disk helpers) "
+               "must not be reachable from `# loop-callback` reactor "
+               "methods")
+
+    def check(self, repo: Repo) -> list[Finding]:
+        return check_eventloop(get_callgraph(repo))

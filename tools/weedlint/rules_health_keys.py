@@ -35,12 +35,16 @@ EVENTS_REL = os.path.join("seaweedfs_tpu", "observability", "events.py")
 # become a degraded MEASUREMENT because some other client got shed.
 # reqlog_records_dropped is observability loss (the workload recording
 # under-represents the stream): alertable, but it never makes the
-# measured run itself degraded.
+# measured run itself degraded.  dataplane_conn_aborts is a serving-
+# plane load/teardown condition (a slow client lost its connection, a
+# stop aborted in-flight work) — it pages through its counter rule but
+# does not make an encode/read MEASUREMENT degraded.
 DEGRADE_KEY_ALLOWLIST = ("degraded_binds", "ec_under_replicated",
                          "coordinator_repair_failures",
                          "requests_shed", "deadline_exceeded",
                          "retry_budget_exhausted",
-                         "reqlog_records_dropped")
+                         "reqlog_records_dropped",
+                         "dataplane_conn_aborts")
 
 # DEGRADE_COUNTER_KEYS entries that are per-run encode stats rather
 # than cluster counter families.

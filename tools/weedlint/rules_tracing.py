@@ -95,18 +95,31 @@ def check_framing_source(src: str, path: str) -> list[Finding]:
         return [Finding("W201", path, e.lineno or 0,
                         f"does not parse: {e.msg}")]
     fns = _functions(tree)
-    serve = fns.get("_serve_conn")
-    if serve is None:
+    # the per-frame ingress contract lives in serve_frame (trace mint
+    # + deadline-slot hygiene + recorder), shared by the threaded
+    # accept loop AND the reactor dataplane
+    frame_fn = fns.get("serve_frame")
+    if frame_fn is None:
         return [Finding("W201", path, 0,
-                        "FramedServer._serve_conn not found")]
-    calls = _calls_in(serve)
+                        "framing.serve_frame not found")]
+    calls = _calls_in(frame_fn)
     missing = [c for c in ("begin_request", "end_request", "span")
                if c not in calls]
     if missing:
         return [Finding(
-            "W201", path, serve.lineno,
-            f"_serve_conn no longer calls {'/'.join(missing)} — the "
+            "W201", path, frame_fn.lineno,
+            f"serve_frame no longer calls {'/'.join(missing)} — the "
             f"native TCP ingress would run untraced")]
+    serve = fns.get("_serve_conn")
+    if serve is None:
+        return [Finding("W201", path, 0,
+                        "FramedServer._serve_conn not found")]
+    if "serve_frame" not in _calls_in(serve):
+        return [Finding(
+            "W201", path, serve.lineno,
+            "_serve_conn no longer routes frames through serve_frame "
+            "— the threaded native ingress would bypass the "
+            "trace/deadline/recorder chokepoint")]
     return []
 
 

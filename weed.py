@@ -88,6 +88,17 @@ def _maybe_enable_reqlog(args) -> None:
     enable_reqlog(sample=min(rate, 1.0), capacity=size or None)
 
 
+def _maybe_configure_dataplane(args) -> None:
+    """-dataplane.workers N: size the shared reactor's dispatch pool
+    (utils/eventloop.py) before any server front starts.  0 keeps the
+    auto size (or WEED_DATAPLANE_WORKERS)."""
+    workers = getattr(args, "dataplane_workers", 0)
+    if workers and workers > 0:
+        from seaweedfs_tpu.utils.eventloop import configure
+
+        configure(workers=workers)
+
+
 def _cluster_tls():
     """security.toml [tls] -> server ssl context (also installs the
     process-wide mTLS client side); None when TLS is not configured."""
@@ -127,7 +138,8 @@ def cmd_volume(args) -> None:
                       tls_context=_cluster_tls(),
                       use_mmap=args.mmap,
                       dataplane=args.dataplane,
-                      max_inflight=args.maxInflight).start()
+                      max_inflight=args.maxInflight,
+                      needle_cache_mb=args.dataplane_cache_mb).start()
     print(f"volume server listening on {vs.url}, dirs {args.dir}")
     _on_interrupt(vs.stop)
     _wait_forever()
@@ -285,7 +297,8 @@ def cmd_server(args) -> None:
                       port=args.port, ec_engine=args.ec_engine,
                       use_mmap=args.mmap,
                       dataplane=args.dataplane,
-                      max_inflight=args.maxInflight).start()
+                      max_inflight=args.maxInflight,
+                      needle_cache_mb=args.dataplane_cache_mb).start()
     print(f"master on {m.url}, volume server on {vs.url}")
     if args.filer:
         store = SqliteStore(args.dir.split(",")[0] + "/filer.db")
@@ -1129,6 +1142,12 @@ def main(argv=None) -> None:
                    default=0, metavar="N",
                    help="workload recorder ring capacity (records); "
                         "0 = default 8192 (WEED_REQLOG_SIZE)")
+    p.add_argument("-dataplane.workers", dest="dataplane_workers",
+                   type=int, default=0, metavar="N",
+                   help="event-loop dataplane dispatch worker pool "
+                        "size; 0 = auto (WEED_DATAPLANE_WORKERS; "
+                        "WEED_DATAPLANE=threaded disables the reactor "
+                        "entirely)")
     p.add_argument("-metricsPushUrl", default="",
                    help="prometheus pushgateway base url (push mode)")
     p.add_argument("-metricsPushSeconds", type=float, default=15.0)
@@ -1181,6 +1200,10 @@ def main(argv=None) -> None:
                    help="admission control: shed object requests early "
                         "(503 + Retry-After) beyond this many in "
                         "flight (0 = off)")
+    v.add_argument("-dataplane.cacheMB", dest="dataplane_cache_mb",
+                   type=int, default=64,
+                   help="popularity-aware needle read cache size in MB "
+                        "(0 disables)")
     v.set_defaults(fn=cmd_volume)
 
     s = sub.add_parser("server")
@@ -1209,6 +1232,10 @@ def main(argv=None) -> None:
                    help="admission control on the volume server: shed "
                         "object requests early beyond this many in "
                         "flight (0 = off)")
+    s.add_argument("-dataplane.cacheMB", dest="dataplane_cache_mb",
+                   type=int, default=64,
+                   help="popularity-aware needle read cache size in MB "
+                        "(0 disables)")
     s.set_defaults(fn=cmd_server)
 
     fl = sub.add_parser("filer")
@@ -1486,6 +1513,7 @@ def main(argv=None) -> None:
         grace.setup_profiling(args.cpuprofile, args.memprofile)
     _maybe_enable_tracing(args)
     _maybe_enable_reqlog(args)
+    _maybe_configure_dataplane(args)
     _maybe_push_metrics(args)
     args.fn(args)
 
