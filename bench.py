@@ -57,7 +57,7 @@ SECTION_CAPS = {
     "cluster_traced": 300, "alerts": 420, "coordinator": 420,
     "cluster_native": 360, "cluster_scaled": 420, "parity": 120,
     "integrity": 120, "scenarios": 300, "capacity": 420,
-    "pipeline_health": 15,
+    "heat": 420, "pipeline_health": 15,
 }
 SECTION_CAP_DEFAULT = 300
 SECTION_MIN_S = 15          # least useful remaining budget to even start
@@ -1596,6 +1596,97 @@ def _child(scratch_path: str, platform: str = "") -> None:
         detail["capacity"] = block
 
     section("capacity", meas_capacity)
+
+    # --- heat-telemetry plane: accounting cost + flash-crowd proof ---------
+    def meas_heat():
+        """Heat-plane acceptance (ISSUE 16): (a) accounting overhead —
+        read rps with heat accounting ON (the default) against an
+        accounting-off (-heat.off) baseline spawned back-to-back in
+        THIS section — acceptance < 1%; (b) proof the snapshot
+        pipeline flowed end to end (per-volume heat + a live Zipf fit
+        reached the master's /cluster/heat); (c) space-saving sketch
+        head recall vs exact counts on a seeded Zipf stream —
+        bench_diff floors heat.sketch_head_recall at 0.9; (d) the
+        flash-crowd drill: mid-run the Zipf head jumps to a cold
+        volume and the heat_shift/flash_crowd alert must fire within
+        5s naming the newly hot volume, carrying an exemplar trace."""
+        import random as _random
+        import urllib.request
+        from collections import Counter as _Counter
+
+        from seaweedfs_tpu.observability.heat import SpaceSavingSketch
+        from seaweedfs_tpu.scenarios import (ZipfSampler, flash_crowd,
+                                             run_scenario)
+
+        block: dict = {}
+        with spawn_cluster(1, ("-heat.off",)) as (mport, _root):
+            base = run_bench(mport, 4000, use_tcp=False)
+        block["baseline_read_rps"] = base.get("read", 0.0)
+        with spawn_cluster(1) as (mport, _root):
+            rates = run_bench(mport, 4000, use_tcp=False)
+            block["heat_read_rps"] = rates.get("read", 0.0)
+            if block["baseline_read_rps"]:
+                block["accounting_overhead_pct"] = round(
+                    100.0 * (1.0 - rates.get("read", 0.0)
+                             / block["baseline_read_rps"]), 2)
+            # the snapshots really flowed: shippers land on the master
+            doc = None
+            deadline = time.time() + 8
+            while time.time() < deadline:
+                try:
+                    with urllib.request.urlopen(
+                            f"http://127.0.0.1:{mport}"
+                            "/cluster/heat?top=4", timeout=5) as r:
+                        doc = json.loads(r.read())
+                except OSError:
+                    doc = None
+                if doc and doc.get("volumes"):
+                    break
+                time.sleep(0.3)
+            if doc and doc.get("volumes"):
+                block["cluster_heat"] = {
+                    "ingested": doc.get("ingested", 0),
+                    "volumes": len(doc.get("volumes") or []),
+                    "hottest": (doc["volumes"][0] or {}).get("volume"),
+                    "zipf_s": (doc.get("zipf") or {}).get("s", 0.0),
+                    "server_imbalance": (doc.get("imbalance")
+                                         or {}).get("server", 0.0),
+                }
+            else:
+                block["error_cluster_heat"] = \
+                    "no heat snapshots reached /cluster/heat"
+        # sketch head recall: a 512-entry sketch over a 20k-key Zipf
+        # stream must still name >= 90% of the exact top-50
+        rng = _random.Random(0x4EA7)
+        z = ZipfSampler(20000, 1.2)
+        sk = SpaceSavingSketch(capacity=512, half_life=3600.0)
+        exact: _Counter = _Counter()
+        for i in range(120000):
+            key = z.sample(rng)
+            exact[key] += 1
+            sk.touch(str(key), now=i * 1e-5)
+        now = 120000 * 1e-5
+        top = {row["key"] for row in sk.top(now, k=50)}
+        head = [str(k) for k, _ in exact.most_common(50)]
+        block["sketch_head_recall"] = round(
+            sum(1 for k in head if k in top) / len(head), 3)
+        # the flash-crowd drill (scenarios/spec.flash_crowd): the
+        # drill's own checks carry the acceptance verdict
+        res = run_scenario(flash_crowd())
+        heat = res.get("heat") or {}
+        block["flash_crowd"] = {
+            "verdict": res.get("verdict"),
+            "checks": res.get("checks"),
+            "shift_t": heat.get("shift_t"),
+            "alerts_fired": heat.get("alerts_fired"),
+            "alert_latency_s": heat.get("alert_latency_s"),
+            "named_volume": heat.get("named_volume"),
+            "exemplar_trace": heat.get("exemplar_trace"),
+            "cluster": heat.get("cluster"),
+        }
+        detail["heat"] = block
+
+    section("heat", meas_heat)
 
     # --- scaled cluster: N volume servers, M client procs ------------------
     def meas_cluster_scaled():

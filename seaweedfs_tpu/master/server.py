@@ -116,6 +116,17 @@ class MasterServer:
             get_recorder(), server=self.url,
             local_journal=self.workload_journal)
         self._capacity_doc: Optional[dict] = None
+        # cluster heat journal (observability/heat.py): volume servers
+        # ship decayed per-volume/per-needle heat snapshots here (POST
+        # /cluster/heat/ingest); the merged view (/cluster/heat) ranks
+        # volumes, fits the live Zipf skew, tracks head membership, and
+        # the shift detector emits heat_shift / flash_crowd journal
+        # events the default journal_event alert rules relay.
+        from ..observability.heat import ClusterHeatJournal
+        from ..stats import heat_metrics
+
+        heat_metrics()  # register the gauge families before first ship
+        self.heat_journal = ClusterHeatJournal(rack_fn=self._rack_of)
         self.alert_engine = AlertEngine(
             default_rules(),
             source_fn=lambda: (self.aggregator.health(),
@@ -459,12 +470,27 @@ class MasterServer:
         operator can trace.fetch the exact operation that degraded."""
         from ..observability.events import HEALTH_EVENT_TYPES
 
-        etype = HEALTH_EVENT_TYPES.get(
-            (rule.params or {}).get("key", ""))
+        if getattr(rule, "kind", "") == "journal_event":
+            # the detector's event IS the subject (heat_shift /
+            # flash_crowd carry the trace that touched the hot volume)
+            etype = (rule.params or {}).get("event", "")
+        else:
+            etype = HEALTH_EVENT_TYPES.get(
+                (rule.params or {}).get("key", ""))
         if not etype:
             return ""
         evs = self.event_journal.query(type_=etype, limit=1)
         return (evs[-1].get("trace") or "") if evs else ""
+
+    def _rack_of(self, server_url: str) -> str:
+        """Topology lookup for the heat journal's rack-imbalance gauge:
+        the rack the heartbeat registered this volume server under.
+        (all_nodes snapshots, same unlocked read the aggregator's
+        peers_fn does.)"""
+        for node in self.topo.all_nodes():
+            if node.url == server_url:
+                return node.rack.name if node.rack else ""
+        return ""
 
     def _on_alert_fire(self, rule, state_doc: dict,
                        servers: list) -> None:
@@ -772,6 +798,36 @@ class MasterServer:
             accepted = self.workload_journal.ingest(
                 str(b.get("server") or ""), b.get("records") or [])
             return Response({"accepted": accepted})
+
+        @r.route("POST", "/cluster/heat/ingest")
+        def cluster_heat_ingest(req: Request) -> Response:
+            """Heat-snapshot shipping sink (observability/heat.py
+            HeatShipper): volume servers POST decayed per-volume/
+            per-needle snapshots on a ~1s cadence.  Same convergence
+            rule as event/trace ingest — any reachable master accepts,
+            a follower forwards to the raft leader so ONE journal
+            merges the cluster and the shift detector sees every
+            peer."""
+            if not self.is_leader:
+                if not self.raft.leader or self.raft.leader == self.url:
+                    raise HttpError(503, "no leader elected yet; retry")
+                return self._proxy_to_leader(req)
+            b = req.json()
+            accepted = self.heat_journal.ingest(
+                str(b.get("server") or ""), b.get("snapshots") or [])
+            return Response({"accepted": accepted})
+
+        @r.route("GET", "/cluster/heat")
+        def cluster_heat(req: Request) -> Response:
+            """The merged cluster heat view: per-volume heat ranks
+            (read/byte/cache-hit/error rates + share), head-set
+            membership, the live Zipf fit over the merged needle
+            sketch, server/rack imbalance, per-peer snapshot staleness
+            and the recent heat_shift / flash_crowd events.  Leader-
+            only (ingest converges there)."""
+            self._require_leader(req)
+            top = min(qint(req.query, "top", 20), 256)
+            return Response(self.heat_journal.to_doc(top_needles=top))
 
         @r.route("GET", "/cluster/capacity")
         def cluster_capacity(req: Request) -> Response:

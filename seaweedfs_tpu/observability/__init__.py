@@ -27,6 +27,8 @@ from .context import (ingress_context, inject_trace_headers,
 from .events import (ClusterEventJournal, Event, EventJournal,
                      EventShipper, get_journal)
 from .flightrecorder import FlightRecorder, get_flightrecorder
+from .heat import (ClusterHeatJournal, DecayedCounter, HeatAccumulator,
+                   HeatShipper, SpaceSavingSketch)
 from .profiler import SamplingProfiler, profile_collapsed
 from .reqlog import (AccessRecord, ReqlogRecorder, ReqlogShipper,
                      WorkloadJournal, disable_reqlog, enable_reqlog,
@@ -45,4 +47,5 @@ __all__ = ["Span", "Tracer", "get_tracer", "enable_tracing",
            "default_rules", "FlightRecorder", "get_flightrecorder",
            "AccessRecord", "ReqlogRecorder", "ReqlogShipper",
            "WorkloadJournal", "get_recorder", "enable_reqlog",
-           "disable_reqlog"]
+           "disable_reqlog", "DecayedCounter", "SpaceSavingSketch",
+           "HeatAccumulator", "HeatShipper", "ClusterHeatJournal"]

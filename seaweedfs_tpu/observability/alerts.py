@@ -24,6 +24,14 @@ and /cluster/alerts serves the full table):
       p99-latency, each evaluated over a fast (5m) AND a slow (1h)
       window and active only when BOTH breach — a blip doesn't page,
       a sustained burn does (the SRE-workbook multi-window pattern).
+  journal_event    — a typed event of params["event"] landed in the
+      process journal within params["window_s"] (and after this
+      engine started — stale events from a previous run never fire a
+      fresh engine).  This is how DETECTORS page: the heat-telemetry
+      shift detector (observability/heat.py) emits heat_shift /
+      flash_crowd events that already carry the verdict (the hot
+      volume, its share, holders, an exemplar trace), so the rule
+      relays rather than re-derives.
 
 State machine per rule:  inactive -> pending -> firing -> resolved.
 `for_s` is the pending hold-down (condition must hold that long before
@@ -131,6 +139,24 @@ def default_rules() -> list[Rule]:
                 "min_requests": 10},
         description="volume-server per-route p99 latency > 500ms over "
                     "BOTH the 5m and 1h windows"))
+    # heat-telemetry shift detector relays (observability/heat.py):
+    # one journal_event rule per HEAT_EVENT_TYPES entry, severity from
+    # EVENT_TYPES — W401 walks the tuple, the rules and the event
+    # table against each other
+    heat_descriptions = {
+        "heat_shift": "the Zipf head moved: a volume newly entered "
+                      "the cluster heat head set",
+        "flash_crowd": "a previously-cold volume took the heat head "
+                       "outright (flash crowd): replicate/cache it NOW",
+    }
+    from .heat import HEAT_EVENT_TYPES
+    for etype in HEAT_EVENT_TYPES:
+        rules.append(Rule(
+            etype, "journal_event",
+            severity=_events.EVENT_TYPES.get(etype, "warning"),
+            for_s=0.0, keep_firing_s=120.0,
+            params={"event": etype, "window_s": 30.0},
+            description=heat_descriptions.get(etype, "")))
     return rules
 
 
@@ -209,6 +235,9 @@ class AlertEngine:  # weedlint: concurrent-class
         self._lock = threading.Lock()
         self.evaluated_at = 0.0  # guarded-by: _lock
         self.evaluations = 0  # guarded-by: _lock
+        # journal_event floor: events emitted before this engine
+        # existed (a previous drill in the same process) never fire it
+        self._created = time.time()
 
     # --- evaluation -------------------------------------------------------
     def evaluate(self, now: Optional[float] = None,
@@ -325,7 +354,28 @@ class AlertEngine:  # weedlint: concurrent-class
             return self._eval_peer_down(health)
         if rule.kind == "burn_rate":
             return self._eval_burn_rate(rule, families, now)
+        if rule.kind == "journal_event":
+            return self._eval_journal_event(rule, now)
         raise ValueError(f"unknown rule kind {rule.kind!r}")
+
+    def _eval_journal_event(self, rule: Rule, now: float):
+        """Active while a matching typed event sits inside the window.
+        The event already carries the detector's verdict: surface its
+        details (volume, share, servers) instead of re-deriving."""
+        p = rule.params
+        window = float(p.get("window_s", 30.0))
+        events = self.journal.query(
+            type_=p["event"],
+            since_ts=max(now - window, self._created), limit=8)
+        if not events:
+            return False, 0.0, "", []
+        latest = events[-1]
+        d = latest.get("details") or {}
+        servers = [s for s in (d.get("servers") or []) if s]
+        detail = ", ".join(f"{k}={d[k]}" for k in
+                           ("volume", "share", "prev_share")
+                           if k in d) or latest.get("type", "")
+        return True, float(len(events)), detail, servers
 
     def _eval_counter_increase(self, rule: Rule, health: dict):  # holds: _lock
         key = rule.params["key"]

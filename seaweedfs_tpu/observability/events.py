@@ -80,6 +80,12 @@ EVENT_TYPES = {
     "reqlog_dropped": "warning",     # access records lost (ring/ship)
     # event-loop serving dataplane (utils/eventloop.py)
     "dataplane_conn_abort": "warning",  # conn torn down mid-flight
+    # heat-telemetry shift detector (observability/heat.py, master):
+    # both are watched by default `journal_event` alert rules — the
+    # heat.HEAT_EVENT_TYPES tuple is W401-linted against this table
+    # and default_rules() so neither side can drift
+    "heat_shift": "warning",   # a volume newly entered the Zipf head
+    "flash_crowd": "error",    # a COLD volume took the head outright
 }
 
 # HEALTH_FAMILIES key (stats/aggregate.py) -> the event type emitted at

@@ -38,13 +38,14 @@ from .engine import run_against, run_scenario
 from .replay import (recording_profile, replay_fidelity,
                      spec_from_recording)
 from .spec import (FaultSpec, ScenarioSpec, default_scenarios,
-                   failure_under_load, read_storm, write_churn)
+                   failure_under_load, flash_crowd, read_storm,
+                   write_churn)
 from .workload import SizeSampler, ZipfSampler
 
 __all__ = [
     "FaultSpec", "ScenarioSpec", "default_scenarios", "run_scenario",
     "run_against",
-    "read_storm", "write_churn", "failure_under_load",
+    "read_storm", "write_churn", "failure_under_load", "flash_crowd",
     "ZipfSampler", "SizeSampler",
     "spec_from_recording", "recording_profile", "replay_fidelity",
     "CapacitySLO", "find_capacity", "measure_rate",

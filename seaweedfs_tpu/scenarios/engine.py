@@ -145,6 +145,14 @@ def _preload(master_url: str, spec: ScenarioSpec,
         by_url.setdefault(url, []).append((fid, url))
     ranks: list[tuple[str, str]] = []
     buckets = [list(v) for _u, v in sorted(by_url.items())]
+    if spec.preload_locality:
+        # keep placement: consecutive ranks stay on the server the
+        # master chose, so the Zipf head lives on one server/volume
+        # and a mid-run head shift moves heat BETWEEN volumes (what
+        # the flash-crowd drill proves) instead of pre-smearing it
+        for b in buckets:
+            ranks.extend(b)
+        return ranks
     while any(buckets):
         for b in buckets:
             if b:
@@ -154,7 +162,8 @@ def _preload(master_url: str, spec: ScenarioSpec,
 
 def _client_loop(ci: int, spec: ScenarioSpec, master_url: str,
                  ranks: list, zipf: ZipfSampler, t0: float,
-                 stop: threading.Event, out: list) -> None:
+                 stop: threading.Event, out: list,
+                 shift: dict) -> None:
     rng = random.Random(spec.seed * 1000003 + ci)
     sizes = SizeSampler(spec.sizes)
     written: list[tuple[str, str]] = []  # this client's own objects
@@ -182,7 +191,12 @@ def _client_loop(ci: int, spec: ScenarioSpec, master_url: str,
         try:
             with _deadline.scope(spec.deadline_s):
                 if op == "read":
-                    fid, url = ranks[zipf.sample(rng)]
+                    # shift["off"] rotates the popularity ranking: rank
+                    # r's traffic lands on object (r + off) % n, so a
+                    # mid-run off jump moves the WHOLE Zipf head to
+                    # previously cold objects (the flash-crowd drill)
+                    fid, url = ranks[(zipf.sample(rng) + shift["off"])
+                                     % len(ranks)]
                     status, _b, _h = http_bytes(
                         "GET", f"http://{url}/{fid}", timeout=30.0)
                 elif op == "write":
@@ -303,14 +317,23 @@ def _evaluate(spec: ScenarioSpec, result: dict,
             check("fault_p99_factor",
                   factor <= exp["fault_p99_factor_max"], factor,
                   exp["fault_p99_factor_max"])
-        if "alert_fired_any" in exp:
-            names = exp["alert_fired_any"]
-            fired = [n for n in names if n in watch.fired_at]
-            check("alert_fired", bool(fired), fired, names)
-            if exp.get("alert_resolved"):
-                unresolved = sorted(set(fired) & watch.firing_now())
-                check("alert_resolved", not unresolved,
-                      unresolved, [])
+    if "alert_fired_any" in exp and watch is not None:
+        names = exp["alert_fired_any"]
+        fired = [n for n in names if n in watch.fired_at]
+        check("alert_fired", bool(fired), fired, names)
+        if exp.get("alert_resolved"):
+            unresolved = sorted(set(fired) & watch.firing_now())
+            check("alert_resolved", not unresolved,
+                  unresolved, [])
+    if "heat_alert_within_s" in exp:
+        heat = result.get("heat") or {}
+        lat = heat.get("alert_latency_s")
+        check("heat_alert_within_s",
+              lat is not None and lat <= exp["heat_alert_within_s"],
+              lat, exp["heat_alert_within_s"])
+        check("heat_alert_named_volume",
+              bool(heat.get("named_volume")),
+              heat.get("named_volume"), "nonempty")
     return checks
 
 
@@ -339,11 +362,12 @@ def run_against(spec: ScenarioSpec, master_url: str,
                     "against": master_url}
     stop = threading.Event()
     t0 = time.monotonic()
+    shift = {"off": 0}  # replay mode never shifts the head
     per_client_ops: list[list] = [[] for _ in range(spec.clients)]
     threads = [threading.Thread(
         target=_client_loop,
         args=(ci, spec, master_url, ranks, zipf, t0, stop,
-              per_client_ops[ci]),
+              per_client_ops[ci], shift),
         daemon=True, name=f"replay-{spec.name}-c{ci}")
         for ci in range(spec.clients)]
     say(f"{spec.name}: driving {spec.clients} clients for "
@@ -401,7 +425,11 @@ def run_scenario(spec: ScenarioSpec, base_dir: Optional[str] = None,
     prev_rate = sample_rate()
     if not tracing_was_on:
         enable_tracing()
-    set_sample_rate(0.0)  # only forced requests trace: zero hot-path cost
+    # only forced requests trace (zero hot-path cost) — except heat
+    # drills, where a small head rate gives the shift detector's event
+    # an exemplar trace to carry (the accumulator keeps the freshest
+    # sampled trace id per volume)
+    set_sample_rate(0.02 if spec.head_shift_frac > 0 else 0.0)
     result: dict = {"name": spec.name, "spec": spec.to_dict()}
     stop = threading.Event()
     threads: list[threading.Thread] = []
@@ -440,12 +468,25 @@ def run_scenario(spec: ScenarioSpec, base_dir: Optional[str] = None,
                       timeout=30.0)
         except HttpError:
             pass  # assign-triggered growth still works
+        if spec.head_shift_frac > 0:
+            # heat drill scale: second-scale decay so the shifted head
+            # dominates the merged ranking within a couple of shipper
+            # flushes, a trailing window short enough that the
+            # PRE-shift head is still what "trailing" means when the
+            # detector compares, and per-volume event rate limiting
+            # that cannot swallow the one shift this run proves
+            for vs in servers:
+                vs.heat.set_half_life(2.0)
+            master.heat_journal.trail_s = max(
+                3.0, 0.2 * spec.duration_s)
+            master.heat_journal.min_event_interval = 2.0
         rng = random.Random(spec.seed)
         say(f"{spec.name}: preloading {spec.hot_set} objects")
         ranks = _preload(master.url, spec, rng)
         zipf = ZipfSampler(len(ranks), spec.zipf_s)
 
         t0 = time.monotonic()
+        t0_wall = time.time()  # event timestamps are wall-clock
         watch = _AlertWatch(master, t0)
         fault_window = None
         if spec.faults:
@@ -488,6 +529,33 @@ def run_scenario(spec: ScenarioSpec, base_dir: Optional[str] = None,
                     "t": round(time.monotonic() - t0, 2),
                     "action": action, "point": f.point, "peer": peer})
 
+        shift = {"off": 0}  # read-index rotation shared with clients
+        shift_t = [0.0]     # when the head actually moved (t0-relative)
+
+        def head_shifter():
+            at = spec.head_shift_frac * spec.duration_s
+            while not stop.is_set() and time.monotonic() - t0 < at:
+                time.sleep(0.05)
+            if stop.is_set():
+                return
+            # aim the new Zipf head at the COLDEST volume's ranks, not
+            # a blind half-rotation: the master's placement can stack
+            # most fids onto the already-hot volume, and a rotation
+            # that lands back on it moves no heat at all — the drill
+            # would then (correctly!) see no head-set shift
+            vol_of = [fid.partition(",")[0] for fid, _ in ranks]
+            warm: dict = {}
+            for i, v in enumerate(vol_of):
+                warm[v] = warm.get(v, 0.0) + zipf.pmf(i % zipf.n)
+            cold = min(warm, key=lambda v: warm[v])
+            shift["off"] = next(
+                (i for i, v in enumerate(vol_of) if v == cold),
+                len(ranks) // 2)
+            shift_t[0] = round(time.monotonic() - t0, 2)
+            say(f"{spec.name}: Zipf head shifted by {shift['off']} "
+                "ranks onto cold volume {} at t={:.1f}s".format(
+                    cold, shift_t[0]))
+
         def alert_poller():
             while not stop.is_set():
                 watch.sample()
@@ -508,13 +576,17 @@ def run_scenario(spec: ScenarioSpec, base_dir: Optional[str] = None,
         threads = [threading.Thread(
             target=_client_loop,
             args=(ci, spec, master.url, ranks, zipf, t0, stop,
-                  per_client_ops[ci]),
+                  per_client_ops[ci], shift),
             daemon=True, name=f"scn-{spec.name}-c{ci}")
             for ci in range(spec.clients)]
         threads.append(threading.Thread(target=fault_timeline,
                                         daemon=True, name="scn-faults"))
         threads.append(threading.Thread(target=alert_poller,
                                         daemon=True, name="scn-alerts"))
+        if spec.head_shift_frac > 0:
+            threads.append(threading.Thread(target=head_shifter,
+                                            daemon=True,
+                                            name="scn-shift"))
         if spec.vacuum_every_s > 0:
             threads.append(threading.Thread(target=vacuum_loop,
                                             daemon=True,
@@ -597,6 +669,61 @@ def run_scenario(spec: ScenarioSpec, base_dir: Optional[str] = None,
                 }
         except Exception:
             pass
+
+        if spec.head_shift_frac > 0:
+            # capture the heat plane's verdict BEFORE teardown.  The
+            # latency measure uses the shift EVENT stream, not the
+            # alert state machine: reads ramping from zero at run
+            # start can legitimately read as a heat shift (they are
+            # one), so the proof is the first event emitted AT/AFTER
+            # the head move — it must name the newly hot volume and
+            # carry an exemplar trace, and the journal_event alert
+            # must be firing on it
+            fired = {n: t for n, t in watch.fired_at.items()
+                     if n in ("heat_shift", "flash_crowd")}
+            heat_block: dict = {"shift_t": shift_t[0],
+                                "alerts_fired": fired}
+            try:
+                doc = http_json(
+                    "GET", f"http://{master.url}/cluster/heat?top=8",
+                    timeout=10.0)
+                heat_block["cluster"] = {
+                    "volumes": doc.get("volumes", [])[:6],
+                    "head": doc.get("head", {}),
+                    "zipf": doc.get("zipf", {}),
+                    "imbalance": doc.get("imbalance", {}),
+                    "shifts": doc.get("shifts", [])[-6:],
+                }
+                post = [ev for ev in doc.get("shifts", [])
+                        if shift_t[0] and float(ev.get("ts") or 0.0)
+                        >= t0_wall + shift_t[0]]
+                if post:
+                    ev = post[0]
+                    d = ev.get("details") or {}
+                    heat_block.update({
+                        "event": ev.get("type"),
+                        "alert_latency_s": round(
+                            float(ev["ts"]) - t0_wall - shift_t[0], 2),
+                        "named_volume": str(d.get("volume", "")),
+                        "share": d.get("share"),
+                        "prev_share": d.get("prev_share"),
+                        "servers": d.get("servers", []),
+                        "exemplar_trace": ev.get("trace") or "",
+                    })
+            except Exception:
+                pass
+            try:
+                for a in master.alert_engine.to_dict()["alerts"]:
+                    if a["name"] in ("heat_shift", "flash_crowd") \
+                            and a.get("detail"):
+                        heat_block["alert_detail"] = a["detail"]
+                        heat_block.setdefault(
+                            "exemplar_trace",
+                            a.get("exemplar_trace", ""))
+                        break
+            except Exception:
+                pass
+            result["heat"] = heat_block
 
         checks = _evaluate(spec, result, watch, fault_window)
         result["checks"] = checks

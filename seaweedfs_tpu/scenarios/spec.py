@@ -52,6 +52,14 @@ class ScenarioSpec:
     target_rps: float = 0.0
     max_inflight: int = 0             # server admission bound (0 = off)
     vacuum_every_s: float = 0.0       # >0: periodic /vol/vacuum churn
+    # mid-run popularity shift: at this fraction of the run the Zipf
+    # head jumps to the cold half of the rank list (the flash-crowd
+    # shape the heat plane's shift detector exists to catch); 0 = off
+    head_shift_frac: float = 0.0
+    # keep the hot set where the master placed it instead of round-
+    # robin interleaving ranks across servers — a shift drill needs
+    # the head's move to change WHICH VOLUME is hot
+    preload_locality: bool = False
     faults: tuple = ()                # FaultSpec entries
     fast_alerts: bool = True          # shrink SLO windows to drill scale
     # verdict bounds; absent keys are not checked
@@ -121,6 +129,24 @@ def failure_under_load(duration_s: float = 21.0) -> ScenarioSpec:
                                           "requests_shed_increase",
                                           "deadline_exceeded_increase"],
                       "alert_resolved": True})
+
+
+def flash_crowd(duration_s: float = 14.0) -> ScenarioSpec:
+    """The heat-telemetry proof (observability/heat.py): Zipfian reads
+    over two volume servers with locality-preserving preload, then
+    mid-run the Zipf head jumps to the cold half of the rank list.
+    The master's heat journal must notice — the head-set shift
+    detector fires heat_shift/flash_crowd naming the newly hot volume
+    within seconds, carrying an exemplar trace id — while the serving
+    plane itself stays healthy."""
+    return ScenarioSpec(
+        name="flash_crowd", duration_s=duration_s, clients=8,
+        n_volume_servers=2, read_fraction=1.0, zipf_s=1.3, hot_set=128,
+        deadline_s=2.0, preload_locality=True, head_shift_frac=0.45,
+        expectations={"max_error_ratio": 0.02,
+                      "deadline_overrun_max_ms": 250.0,
+                      "alert_fired_any": ["heat_shift", "flash_crowd"],
+                      "heat_alert_within_s": 5.0})
 
 
 def default_scenarios() -> list[ScenarioSpec]:

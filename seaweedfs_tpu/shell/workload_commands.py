@@ -128,6 +128,58 @@ def cmd_workload_export(env: CommandEnv, flags: dict) -> str:
     return "\n".join(lines)
 
 
+@command("workload.profile")
+def cmd_workload_profile(env: CommandEnv, flags: dict) -> str:
+    """workload.profile [-file recording.json] [-route r] [-json]
+    # fit the recorded workload's measured shape LIVE (the
+    # recording_profile document spec_from_recording fits from):
+    # mix fractions, observed rps, size buckets, and the Zipf skew —
+    # cross-checked against the heat plane's own live fit
+    # (/cluster/heat) when heat snapshots are flowing"""
+    from ..scenarios.replay import recording_profile
+
+    if flags.get("file"):
+        with open(flags["file"], encoding="utf-8") as f:
+            recording = json.load(f)
+    else:
+        qs = f"?route={flags['route']}" if flags.get("route") else ""
+        recording = env.master_get(f"/cluster/workload/export{qs}")
+    profile = recording_profile(recording)
+    heat_zipf = None
+    try:
+        heat = env.master_get("/cluster/heat?top=1")
+        z = heat.get("zipf") or {}
+        if z.get("distinct", 0) >= 3:
+            heat_zipf = z
+    except Exception:
+        pass  # heat plane off or no snapshots yet: profile still prints
+    if flags.get("json") == "true":
+        doc = dict(profile)
+        if heat_zipf is not None:
+            doc["heat_zipf"] = heat_zipf
+        return json.dumps(doc, indent=2)
+    lines = [
+        f"records={profile['records']} over {profile['window_s']}s "
+        f"(observed_rps={profile['observed_rps']:g})",
+        f"mix: read={profile['read_fraction']:g} "
+        f"churn={profile['churn_fraction']:g} "
+        f"submit={profile['submit_fraction']:g}",
+        f"popularity: zipf_s={profile['zipf_s']:g} over "
+        f"{profile['distinct_keys']} distinct keys",
+        "sizes: " + ", ".join(f"{b}B x{w:g}"
+                              for b, w in profile["sizes"]),
+        f"deadline_p50_s={profile['deadline_p50_s']:g}",
+    ]
+    if heat_zipf is not None:
+        lines.append(f"heat plane agrees: live zipf_s="
+                     f"{heat_zipf.get('s', 0.0):g} over "
+                     f"{heat_zipf.get('distinct', 0)} needles "
+                     f"(/cluster/heat)")
+    for key, count in profile["top_keys"][:5]:
+        lines.append(f"  top key {key}: {count} reads")
+    return "\n".join(lines)
+
+
 @command("workload.replay")
 def cmd_workload_replay(env: CommandEnv, flags: dict) -> str:
     """workload.replay [-file recording.json] [-speed 1.0]

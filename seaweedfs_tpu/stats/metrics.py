@@ -650,6 +650,14 @@ class NeedleCacheMetrics:
         self.bytes = registry.gauge(
             "SeaweedFS_needle_cache_bytes",
             "Resident cached needle bytes.")
+        # per-volume split (heat attribution: the aggregate ratio
+        # cannot say WHICH volume's working set the cache absorbs)
+        self.volume_hits = registry.counter(
+            "SeaweedFS_needle_cache_volume_hits_total",
+            "Needle cache hits per volume.", labels=("volume",))
+        self.volume_misses = registry.counter(
+            "SeaweedFS_needle_cache_volume_misses_total",
+            "Needle cache misses per volume.", labels=("volume",))
 
     def hit_ratio(self) -> float:
         hits = sum(self.hits.snapshot().values())
@@ -668,6 +676,27 @@ class NeedleCacheMetrics:
             "bytes": int(self.bytes.value()),
             "hit_ratio": self.hit_ratio(),
         }
+
+
+class HeatMetrics:
+    """Cluster heat-telemetry plane (observability/heat.py).  The two
+    gauge families are master-side (set on /cluster/heat/ingest); the
+    drop counter is volume-side shipper loss.  Family names live in
+    heat.HEAT_METRIC_FAMILIES and W401 checks they stay registered."""
+
+    def __init__(self, registry: Registry = REGISTRY):
+        self.volume_heat = registry.gauge(
+            "SeaweedFS_volume_heat",
+            "Merged decayed read+cache-hit heat per volume (1/s).",
+            labels=("volume",))
+        self.imbalance = registry.gauge(
+            "SeaweedFS_heat_imbalance_ratio",
+            "max/mean heat ratio across a scope (server, rack).",
+            labels=("scope",))
+        self.snapshots_dropped = registry.counter(
+            "SeaweedFS_heat_snapshots_dropped_total",
+            "Heat snapshots lost by the shipper (master unreachable "
+            "or buffer superseded).")
 
 
 _singletons: dict[str, object] = {}
@@ -719,6 +748,10 @@ def dataplane_metrics() -> DataplaneMetrics:
 
 def needle_cache_metrics() -> NeedleCacheMetrics:
     return _singleton("needle_cache", NeedleCacheMetrics)
+
+
+def heat_metrics() -> HeatMetrics:
+    return _singleton("heat", HeatMetrics)
 
 
 def start_push_loop(gateway_url: str, job: str,

@@ -208,6 +208,11 @@ class Router:
         # server answers True only for needle-cache-resident objects).
         # None = every request dispatches on the worker pool.
         self.loop_fast_probe = None
+        # optional heat accumulator (observability/heat.py): the volume
+        # server installs its per-server HeatAccumulator so every
+        # object-route response feeds decayed per-volume/per-needle
+        # heat.  None costs a single attribute check per request.
+        self.heat = None
         # deadline_exceeded journal rate limit (the counter counts every
         # 504; the ring must not churn under a deadline storm).  A lost
         # write race costs at most one extra journal event.
@@ -422,6 +427,20 @@ class Router:
                         # streamed reads the send IS the work).
                         self._record_access(handler, method, fn.__name__,
                                             req, resp, shed, ddl, t0)
+                    heat = self.heat
+                    if heat is not None:
+                        # heat accounting (observability/heat.py): the
+                        # fid regex inside note_http gates before any
+                        # locking, so control-plane routes cost one
+                        # attribute check + one failed regex match
+                        try:
+                            heat.note_http(
+                                method, path, resp.status,
+                                self._resp_bytes(resp),
+                                tctx.trace_id if tctx is not None
+                                else "")
+                        except Exception:
+                            pass  # accounting never breaks serving
                 finally:
                     # release only after the RESPONSE left: for large
                     # streamed reads (Response(file_path=...)) the send
@@ -442,6 +461,21 @@ class Router:
             if traced:
                 _trace_context.end_request(_prev_ctx)
                 _trace_context.swap_server(_prev_srv)
+
+    @staticmethod
+    def _resp_bytes(resp: Response) -> int:
+        """Cheap out-byte estimate for heat accounting — attribute
+        checks only, never a syscall (reqlog's getsize fallback is too
+        expensive for every response): an unranged streamed file reads
+        as 0, so byte rates are a floor, not an exact meter."""
+        if resp.raw is not None:
+            return len(resp.raw)
+        if resp.file_range is not None:
+            _off, length = resp.file_range
+            return length if length >= 0 else 0
+        if resp.data is not None:
+            return len(str(resp.data))
+        return 0
 
     @staticmethod
     def _record_access(handler, method: str, handler_name: str,

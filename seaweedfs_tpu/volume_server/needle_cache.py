@@ -73,6 +73,13 @@ class NeedleCache:
         # stale read's offer lands — without the epoch it would serve
         # the OLD bytes until the next write)
         self._epochs: dict[int, int] = {}  # guarded-by: _lock
+        # heat-telemetry callbacks (observability/heat.py): the volume
+        # server wires its HeatAccumulator here so cache-absorbed reads
+        # and admission verdicts still feed per-volume/needle heat.
+        # Set once at server construction, invoked OUTSIDE the lock,
+        # exceptions swallowed — accounting never breaks a read.
+        self.on_hit = None       # fn(vid, key, nbytes)
+        self.on_admit = None     # fn(vid, key)
 
     @property
     def enabled(self) -> bool:
@@ -90,10 +97,19 @@ class NeedleCache:
             n = self._entries.get((vid, key))
             if n is not None:
                 self._entries.move_to_end((vid, key))
+        m = _metrics()
         if n is not None:
-            _metrics().hits.inc()
+            m.hits.inc()
+            m.volume_hits.inc(str(vid))
+            hook = self.on_hit
+            if hook is not None:
+                try:
+                    hook(vid, key, len(n.data or b""))
+                except Exception:
+                    pass
         else:
-            _metrics().misses.inc()
+            m.misses.inc()
+            m.volume_misses.inc(str(vid))
         return n
 
     def epoch(self, vid: int) -> int:
@@ -148,6 +164,12 @@ class NeedleCache:
         if admitted:
             m.admissions.inc()
             m.bytes.set(resident)
+            hook = self.on_admit
+            if hook is not None:
+                try:
+                    hook(vid, key)
+                except Exception:
+                    pass
         else:
             m.rejections.inc()
         return admitted

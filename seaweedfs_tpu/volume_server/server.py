@@ -108,7 +108,8 @@ class VolumeServer:
                  tls_context=None,
                  tcp: bool = True, use_mmap: bool = False,
                  dataplane: str = "python", max_inflight: int = 0,
-                 needle_cache_mb: int = 64):
+                 needle_cache_mb: int = 64, heat: bool = True,
+                 heat_halflife_s: float = 30.0, heat_topk: int = 512):
         from ..security import Guard
 
         if backends:
@@ -181,6 +182,25 @@ class VolumeServer:
         self._reqlog_shipper = ReqlogShipper(
             get_recorder(), server=self.url,
             master_url_fn=lambda: self.master_url)
+        # heat telemetry (observability/heat.py): per-SERVER accumulator
+        # (never process-global — co-located fixtures must not pool
+        # heat and the master attributes per peer) + snapshot shipper.
+        # heat=False leaves router.heat/tcp.heat None: accounting off
+        # is one attribute check per request at each chokepoint.
+        from ..observability.heat import HeatAccumulator, HeatShipper
+        from ..stats import heat_metrics
+
+        heat_metrics()  # register the drop-counter family up front
+        self.heat = HeatAccumulator(server=self.url,
+                                    half_life=heat_halflife_s,
+                                    top_k=heat_topk, enabled=heat)
+        self._heat_shipper = HeatShipper(
+            self.heat, server=self.url,
+            master_url_fn=lambda: self.master_url) if heat else None
+        if heat:
+            cache = self.store.needle_cache
+            cache.on_hit = self.heat.note_cache_hit
+            cache.on_admit = self.heat.note_cache_admit
         if directories:
             get_flightrecorder().configure(
                 spool_dir=os.path.join(directories[0], "flightrecorder"))
@@ -193,6 +213,9 @@ class VolumeServer:
         from ..utils.admission import maybe_controller
 
         self.router.admission = maybe_controller(max_inflight, "volume")
+        # HTTP-plane heat feed: object-route responses note into the
+        # per-server accumulator (None when -heat.off)
+        self.router.heat = self.heat if heat else None
         # event-loop fast path (utils/eventloop.py): GET/HEAD object
         # reads whose needle the popularity cache holds dispatch inline
         # on the reactor loop — zero thread handoffs for the Zipf head
@@ -301,10 +324,14 @@ class VolumeServer:
                         whitelist_ok=(self.guard.check_white_list
                                       if self.guard.is_write_active else None),
                         replicate_write=self._tcp_replicate_write,
-                        replicate_delete=self._tcp_replicate_delete).start(),
+                        replicate_delete=self._tcp_replicate_delete,
+                        heat=self.heat if self.heat.enabled
+                        else None).start(),
                     role="volume-tcp", server=self.url)
         self._trace_shipper.attach()
         self._reqlog_shipper.attach()
+        if self._heat_shipper is not None:
+            self._heat_shipper.attach()
         threading.Thread(target=self._heartbeat_loop, daemon=True,
                          name=f"heartbeat:{self.url}").start()
         return self
@@ -314,6 +341,8 @@ class VolumeServer:
         self._trace_shipper.detach()
         self._event_shipper.detach()
         self._reqlog_shipper.detach()
+        if self._heat_shipper is not None:
+            self._heat_shipper.detach()
         self.scrubber.stop(join_timeout=0.5)
         if self._tcp_server is not None:
             self._tcp_server.stop()
@@ -749,6 +778,7 @@ class VolumeServer:
             fids = req.json().get("fids", [])
             if not isinstance(fids, list) or len(fids) > 10000:
                 raise HttpError(400, "fids must be a list of <= 10000")
+            heat = self.router.heat
             out = []
             for fid_str in fids:
                 try:
@@ -762,6 +792,11 @@ class VolumeServer:
                         data = ungzip_data(data)
                     out.append(b"\x00" + _U32.pack(len(data)))
                     out.append(data)
+                    if heat is not None:
+                        # the /batch/* paths never match the router
+                        # hook's fid regex: feed per fid here
+                        heat.note_read(fid.volume_id, len(data),
+                                       fid=str(fid_str))
                 except Exception as e:
                     msg = f"{type(e).__name__}: {e}".encode()[:4096]
                     out.append(b"\x01" + _U32.pack(len(msg)) + msg)
@@ -792,6 +827,7 @@ class VolumeServer:
                 items = unpack_fid_frames(req.body, with_data=True)
             except ValueError as e:
                 raise HttpError(400, str(e))
+            heat = self.router.heat
             results = []
             by_vid: dict[int, list[tuple[str, bytes]]] = {}
             for fid_str, data in items:
@@ -804,6 +840,8 @@ class VolumeServer:
                         fid.volume_id, n)
                     results.append({"fid": fid_str, "status": 201,
                                     "size": len(data)})
+                    if heat is not None:
+                        heat.note_write(fid.volume_id, len(data))
                     if req.query.get("type") != "replicate":
                         by_vid.setdefault(fid.volume_id, []).append(
                             (fid_str, data))
@@ -1067,6 +1105,14 @@ class VolumeServer:
             from ..stats import dataplane_metrics
 
             doc["Dataplane"] = dataplane_metrics().totals()
+            # heat telemetry: accumulator occupancy + shipper loss
+            doc["Heat"] = {
+                **self.heat.status(),
+                "shipped": self._heat_shipper.shipped
+                if self._heat_shipper is not None else 0,
+                "dropped": self._heat_shipper.dropped
+                if self._heat_shipper is not None else 0,
+            }
             scrub_st = self.scrubber.status()  # locked verdict snapshot
             doc["EcScrub"] = {
                 "running": scrub_st["running"],
@@ -1090,6 +1136,24 @@ class VolumeServer:
         @r.route("GET", "/status")
         def status(req: Request) -> Response:
             return Response(status_doc())
+
+        @r.route("GET", "/debug/heat")
+        def debug_heat(req: Request) -> Response:
+            """This server's decayed heat snapshot: per-volume rates,
+            the top-K needle sketch, accumulator/shipper accounting —
+            the per-peer view the master merges at /cluster/heat."""
+            try:
+                top = int(req.query.get("top", "64"))
+            except (TypeError, ValueError):
+                top = 64
+            doc = self.heat.snapshot(top_k=max(0, min(top, 1024)))
+            doc["status"] = self.heat.status()
+            if self._heat_shipper is not None:
+                doc["shipper"] = {
+                    "shipped": self._heat_shipper.shipped,
+                    "dropped": self._heat_shipper.dropped,
+                    "interval_s": self._heat_shipper.interval}
+            return Response(doc)
 
         @r.route("GET", "/stats/counter")
         def stats_counter(req: Request) -> Response:

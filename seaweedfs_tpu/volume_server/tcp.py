@@ -53,16 +53,43 @@ class TcpVolumeServer(FramedServer):
 
     def __init__(self, store, host: str = "127.0.0.1", port: int = 0,
                  whitelist_ok=None, replicate_write=None,
-                 replicate_delete=None):
+                 replicate_delete=None, heat=None):
         super().__init__(self._handle, host,
                          port or tcp_port_for(store.port),
                          whitelist_ok=whitelist_ok, name="tcp-volume")
         self.store = store
         self.replicate_write = replicate_write
         self.replicate_delete = replicate_delete
+        # per-server HeatAccumulator (observability/heat.py) — the
+        # framed plane bypasses the HTTP router hook, so it feeds heat
+        # itself.  None costs one attribute check per op.
+        self.heat = heat
 
     def _handle_one(self, op: bytes, fid_str: str, body: bytes) -> bytes:
+        if self.heat is None:
+            return self._op(op, fid_str, body)
         fid = FileId.parse(fid_str)
+        try:
+            out = self._op(op, fid_str, body, fid=fid)
+        except Exception:
+            try:
+                self.heat.note_native(op.decode(), fid.volume_id, 0,
+                                      error=True)
+            except Exception:
+                pass
+            raise
+        try:
+            self.heat.note_native(op.decode(), fid.volume_id,
+                                  len(out) if op == b"R" else len(body),
+                                  fid=fid_str)
+        except Exception:
+            pass  # accounting never breaks the frame path
+        return out
+
+    def _op(self, op: bytes, fid_str: str, body: bytes,
+            fid=None) -> bytes:
+        if fid is None:
+            fid = FileId.parse(fid_str)
         if op == b"W":
             n = Needle(cookie=fid.cookie, id=fid.key, data=body)
             size, _ = self.store.write_needle(fid.volume_id, n)

@@ -574,7 +574,7 @@ def test_default_rules_cover_health_families():
     assert watched == set(HEALTH_FAMILIES)
     kinds = {r.kind for r in default_rules()}
     assert kinds == {"counter_increase", "threshold", "peer_down",
-                     "burn_rate"}
+                     "burn_rate", "journal_event"}
 
 
 def test_degraded_bind_event_reaches_cluster_journal(tmp_path):

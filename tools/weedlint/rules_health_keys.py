@@ -56,7 +56,10 @@ def check_tables(health_families: dict, degrade_keys: tuple,
                  health_event_types: dict,
                  extra_health_keys: tuple = ("scrub_unrepairable",),
                  allowlist: tuple = DEGRADE_KEY_ALLOWLIST,
-                 per_run_only: tuple = PER_RUN_ONLY_KEYS) -> list[str]:
+                 per_run_only: tuple = PER_RUN_ONLY_KEYS,
+                 journal_event_types: tuple = (),
+                 heat_metric_families: tuple = (),
+                 registered_metrics=None) -> list[str]:
     """Human-readable violations (empty = consistent)."""
     v: list[str] = []
     health_keys = set(health_families)
@@ -129,6 +132,43 @@ def check_tables(health_families: dict, degrade_keys: tuple,
             v.append(f"alert rule {getattr(r, 'name', '?')!r} severity "
                      f"{got!r} disagrees with EVENT_TYPES[{etype!r}] = "
                      f"{want!r}")
+
+    # 7. detector-relay consistency: every declared journal-event type
+    #    (heat.HEAT_EVENT_TYPES) is a registered event type AND has a
+    #    default journal_event rule whose severity matches EVENT_TYPES;
+    #    every journal_event rule watches a declared, registered type
+    je_rules = {(getattr(r, "params", None) or {}).get("event"): r
+                for r in rules
+                if getattr(r, "kind", "") == "journal_event"}
+    for etype in journal_event_types:
+        if etype not in event_types:
+            v.append(f"journal-event type {etype!r} is not registered "
+                     "in events.EVENT_TYPES — its emits would journal "
+                     "as an unregistered type")
+        r = je_rules.get(etype)
+        if r is None:
+            v.append(f"journal-event type {etype!r} has no default "
+                     "journal_event alert rule — the detector would "
+                     "emit without ever paging")
+        elif etype in event_types and \
+                getattr(r, "severity", None) != event_types[etype]:
+            v.append(f"alert rule {getattr(r, 'name', '?')!r} severity "
+                     f"{getattr(r, 'severity', None)!r} disagrees with "
+                     f"EVENT_TYPES[{etype!r}] = {event_types[etype]!r}")
+    for etype, r in je_rules.items():
+        if journal_event_types and etype not in journal_event_types:
+            v.append(f"journal_event rule {getattr(r, 'name', '?')!r} "
+                     f"watches {etype!r} which is not a declared "
+                     "journal-event type (heat.HEAT_EVENT_TYPES)")
+
+    # 8. the heat plane's declared metric families exist in the live
+    #    registry — a renamed gauge must not silently detach dashboards
+    if registered_metrics is not None:
+        for fam in heat_metric_families:
+            if fam not in registered_metrics:
+                v.append(f"heat metric family {fam!r} "
+                         "(heat.HEAT_METRIC_FAMILIES) is not "
+                         "registered in the stats registry")
     return v
 
 
@@ -139,12 +179,20 @@ def check_live_tables() -> list[str]:
     from seaweedfs_tpu.observability.analysis import DEGRADE_COUNTER_KEYS
     from seaweedfs_tpu.observability.events import (EVENT_TYPES,
                                                     HEALTH_EVENT_TYPES)
+    from seaweedfs_tpu.observability.heat import (HEAT_EVENT_TYPES,
+                                                  HEAT_METRIC_FAMILIES)
     from seaweedfs_tpu.stats.aggregate import HEALTH_FAMILIES
+    from seaweedfs_tpu.stats.metrics import REGISTRY, heat_metrics
 
+    heat_metrics()  # force-register the heat families (lazy singleton)
+    registered = {getattr(c, "name", "") for c in REGISTRY._collectors}
     return check_tables(HEALTH_FAMILIES, DEGRADE_COUNTER_KEYS,
                         default_rules(), EVENT_TYPES,
                         HEALTH_EVENT_TYPES,
-                        extra_health_keys=EXTRA_HEALTH_KEYS)
+                        extra_health_keys=EXTRA_HEALTH_KEYS,
+                        journal_event_types=HEAT_EVENT_TYPES,
+                        heat_metric_families=HEAT_METRIC_FAMILIES,
+                        registered_metrics=registered)
 
 
 @register

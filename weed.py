@@ -139,7 +139,10 @@ def cmd_volume(args) -> None:
                       use_mmap=args.mmap,
                       dataplane=args.dataplane,
                       max_inflight=args.maxInflight,
-                      needle_cache_mb=args.dataplane_cache_mb).start()
+                      needle_cache_mb=args.dataplane_cache_mb,
+                      heat=not args.heat_off,
+                      heat_halflife_s=args.heat_halflife,
+                      heat_topk=args.heat_topk).start()
     print(f"volume server listening on {vs.url}, dirs {args.dir}")
     _on_interrupt(vs.stop)
     _wait_forever()
@@ -298,7 +301,10 @@ def cmd_server(args) -> None:
                       use_mmap=args.mmap,
                       dataplane=args.dataplane,
                       max_inflight=args.maxInflight,
-                      needle_cache_mb=args.dataplane_cache_mb).start()
+                      needle_cache_mb=args.dataplane_cache_mb,
+                      heat=not args.heat_off,
+                      heat_halflife_s=args.heat_halflife,
+                      heat_topk=args.heat_topk).start()
     print(f"master on {m.url}, volume server on {vs.url}")
     if args.filer:
         store = SqliteStore(args.dir.split(",")[0] + "/filer.db")
@@ -1204,6 +1210,17 @@ def main(argv=None) -> None:
                    type=int, default=64,
                    help="popularity-aware needle read cache size in MB "
                         "(0 disables)")
+    v.add_argument("-heat.off", dest="heat_off", action="store_true",
+                   help="disable per-volume/per-needle access-heat "
+                        "accounting (GET /debug/heat, master "
+                        "/cluster/heat feed)")
+    v.add_argument("-heat.halflife", dest="heat_halflife", type=float,
+                   default=30.0, metavar="SECONDS",
+                   help="EWMA half-life for heat decay (seconds)")
+    v.add_argument("-heat.topk", dest="heat_topk", type=int,
+                   default=512, metavar="K",
+                   help="per-needle heat sketch capacity (space-saving "
+                        "top-K)")
     v.set_defaults(fn=cmd_volume)
 
     s = sub.add_parser("server")
@@ -1236,6 +1253,16 @@ def main(argv=None) -> None:
                    type=int, default=64,
                    help="popularity-aware needle read cache size in MB "
                         "(0 disables)")
+    s.add_argument("-heat.off", dest="heat_off", action="store_true",
+                   help="disable per-volume/per-needle access-heat "
+                        "accounting on the volume server")
+    s.add_argument("-heat.halflife", dest="heat_halflife", type=float,
+                   default=30.0, metavar="SECONDS",
+                   help="EWMA half-life for heat decay (seconds)")
+    s.add_argument("-heat.topk", dest="heat_topk", type=int,
+                   default=512, metavar="K",
+                   help="per-needle heat sketch capacity (space-saving "
+                        "top-K)")
     s.set_defaults(fn=cmd_server)
 
     fl = sub.add_parser("filer")
