@@ -57,7 +57,7 @@ SECTION_CAPS = {
     "cluster_traced": 300, "alerts": 420, "coordinator": 420,
     "cluster_native": 360, "cluster_scaled": 420, "parity": 120,
     "integrity": 120, "scenarios": 300, "capacity": 420,
-    "heat": 420, "pipeline_health": 15,
+    "heat": 420, "pipeline_health": 15, "multichip_encode": 420,
 }
 SECTION_CAP_DEFAULT = 300
 SECTION_MIN_S = 15          # least useful remaining budget to even start
@@ -66,6 +66,25 @@ SECTION_MIN_S = 15          # least useful remaining budget to even start
 # tools/bench_diff.py refuses to compare documents across versions
 # instead of misreporting a schema change as a perf regression
 BENCH_SCHEMA_VERSION = 2
+
+
+def _join_bounded(th, cap: float, remaining, grace: float = 8.0) -> bool:
+    """Join `th` for at most `cap` seconds, waking each second to check
+    the shared child budget — True when the thread finished, False when
+    it was abandoned (cap hit, or the budget within `grace` seconds).
+    A single th.join(cap) could sleep straight through the CHILD budget
+    when the cap was carved from a nearly-spent budget — the parent then
+    SIGKILLs mid-join and the JSON (with every completed section) is
+    lost.  Waking each second lets an overrun be abandoned ~grace
+    seconds before the budget line, early enough to checkpoint and
+    print BENCH_CHILD_RESULT."""
+    t0 = time.perf_counter()
+    while th.is_alive():
+        elapsed = time.perf_counter() - t0
+        if elapsed >= cap or remaining() <= grace:
+            break
+        th.join(min(1.0, cap - elapsed))
+    return not th.is_alive()
 
 
 def _git_revision() -> str:
@@ -286,13 +305,14 @@ def _child(scratch_path: str, platform: str = "") -> None:
         th = _threading.Thread(target=runner, daemon=True,
                                name=f"bench-{name}")
         th.start()
-        th.join(cap)
-        if th.is_alive():
+        if not _join_bounded(th, cap, remaining):
             # the runaway thread cannot be killed — it is abandoned
             # (daemon) and later sections run beside it; the parent's
             # subprocess timeout stays the backstop
             detail[f"error_{name}"] = \
-                f"section timeout after {int(cap)}s (budget)"
+                f"section timeout after {int(time.perf_counter() - t0)}s (budget)"
+            detail.setdefault("sections_skipped", {})[name] = \
+                "section_timeout"
         elif errs:
             detail[f"error_{name}"] = errs[0]
         detail.setdefault("section_s", {})[name] = round(
@@ -788,6 +808,88 @@ def _child(scratch_path: str, platform: str = "") -> None:
             detail["profile_samples"] = profiler.samples
 
     section("e2e_stream", meas_e2e_profiled)
+
+    # --- multichip: per-device dispatch queues across the mesh ------------
+    def meas_multichip():
+        """Aggregate mesh-engine throughput: whole dispatches round-robin
+        across per-device queues, each queue draining through its own
+        AsyncDrainer lane (ec/streaming._encode_file_mesh).  Measured at
+        1/2/4/8 devices so the scaling curve (and where it flattens) is
+        visible; the widest width that ran carries aggregate_mbps, the
+        overlap/link-efficiency verdict and the per-device drain_profile
+        attribution."""
+        import jax as _jax
+
+        from seaweedfs_tpu.ec.layout import (DATA_SHARDS_COUNT,
+                                             PARITY_SHARDS_COUNT)
+        from seaweedfs_tpu.ec.streaming import StreamingEncoder
+        from seaweedfs_tpu.observability import Tracer
+
+        ndev = len(_jax.devices())
+        widths = [n for n in (1, 2, 4, 8) if n <= ndev]
+        if not widths:
+            return
+        t_sec0 = time.perf_counter()
+
+        def _sec_left() -> float:
+            cap = SECTION_CAPS.get("multichip_encode", SECTION_CAP_DEFAULT)
+            return min(cap - (time.perf_counter() - t_sec0), remaining())
+
+        size_mb = 512 if on_tpu else 96
+        shm = "/dev/shm" if os.path.isdir("/dev/shm") else None
+        mc: dict = {"devices_available": ndev, "size_mb": size_mb,
+                    "per_width": {}}
+        detail["multichip_encode"] = mc
+        mc_tracer = Tracer(capacity=1 << 16)
+        with tempfile.TemporaryDirectory(dir=shm) as td:
+            dat = os.path.join(td, "1.dat")
+            _write_big_random(dat, size_mb)
+            raw_len = size_mb << 20
+            base_mbps = None
+            for n in widths:
+                # a leg is warm + one timed rep; don't start one the
+                # section budget can't finish
+                if _sec_left() < 45.0:
+                    detail.setdefault("sections_skipped", {})[
+                        f"multichip_encode_{n}dev"] = "section_timeout"
+                    continue
+                enc = StreamingEncoder(10, 4, engine="mesh",
+                                       devices=str(n), tracer=mc_tracer)
+                out = os.path.join(td, f"m{n}")
+                enc.encode_file(dat, out)          # warm compile + pages
+                mc_tracer.clear()
+                t0 = time.perf_counter()
+                enc.encode_file(dat, out)
+                dt = time.perf_counter() - t0
+                stats = dict(enc.stats)
+                mbps = round(raw_len / dt / 1e6, 1)
+                wall = stats.get("wall_s") or dt
+                overlap = round(
+                    1.0 - stats.get("drain_wait_s", 0.0) / wall, 3)
+                entry = {"encode_mbps": mbps,
+                         "dispatches": stats.get("dispatches"),
+                         "overlap_efficiency": overlap}
+                if base_mbps is None:
+                    base_mbps = mbps
+                else:
+                    entry["scaling_vs_1dev"] = round(mbps / base_mbps, 3)
+                mc["per_width"][str(n)] = entry
+                # the widest width that actually ran carries the headline
+                # keys bench_diff floors
+                mc["devices"] = n
+                mc["aggregate_mbps"] = mbps
+                mc["overlap_efficiency"] = overlap
+                d2h = detail.get("d2h_mbps")
+                if d2h:
+                    # same ceiling as _stamp_link: only parity (r/k of
+                    # bytes_in) crosses back over the measured d2h link
+                    ceiling = d2h * DATA_SHARDS_COUNT / PARITY_SHARDS_COUNT
+                    mc["link_ceiling_mbps"] = round(ceiling, 1)
+                    mc["e2e_link_efficiency"] = round(mbps / ceiling, 3)
+                mc["attribution"] = _attribution(mc_tracer, stats)
+                mc["per_device"] = stats.get("per_device")
+
+    section("multichip_encode", meas_multichip)
 
     # --- e2e rebuild latency (streaming, from files) ----------------------
     def meas_e2e_rebuild():

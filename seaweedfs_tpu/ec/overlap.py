@@ -764,3 +764,74 @@ class AsyncDrainer:
             self._pool.shutdown(wait=True)
         except Exception:  # pragma: no cover - best-effort teardown
             pass
+
+
+class DrainerGroup:
+    """One AsyncDrainer per device queue — the `-ec.engine=mesh`
+    per-device drain lanes.  Lane i fetches device i's D2H transfers on
+    its own thread and writes through its own writer, so a slow device
+    (or a congested per-device link) back-pressures only its own
+    dispatch queue instead of stalling the whole slice.
+
+    FIFO is per-lane; cross-lane ordering is the CALLER's contract —
+    the mesh encode plane pwrites parity at known shard offsets
+    (order-free) and retires the crc sidecar + resume checkpoint
+    through an ordered completion tracker keyed by dispatch index.
+
+    The error/abort surface mirrors AsyncDrainer so the pipeline's
+    retry-from-checkpoint machinery treats N lanes as one drain:
+    `.error` is the first captured lane error, finish() joins every
+    lane then re-raises it, abort() tears all lanes down, and the
+    lock-free `aborting` flag fans out to every lane."""
+
+    def __init__(self, lanes: int, fetch, write, queue_depth: int = 8,
+                 name: str = "ec-mesh-drain"):
+        self.drainers = [
+            AsyncDrainer(fetch, write, pool_size=1,
+                         queue_depth=queue_depth, name=f"{name}-{i}")
+            for i in range(max(1, int(lanes)))]
+        self.pool_size = len(self.drainers)
+
+    @property
+    def error(self):
+        for d in self.drainers:
+            err = d.error
+            if err is not None:
+                return err
+        return None
+
+    @property
+    def inflight(self) -> int:
+        return sum(d.inflight for d in self.drainers)
+
+    @property
+    def aborting(self) -> bool:
+        return any(d.aborting for d in self.drainers)
+
+    @aborting.setter
+    def aborting(self, value: bool) -> None:
+        for d in self.drainers:
+            d.aborting = value  # lock-free flag fan-out, same contract as AsyncDrainer.abort
+
+    def submit(self, lane: int, meta) -> None:
+        self.drainers[lane].submit(meta)
+
+    def finish(self, timeout: float | None = None) -> None:
+        """Join every lane, then re-raise the FIRST lane error — one
+        failing device fails the encode exactly where a single-lane
+        drain would have."""
+        first: BaseException | None = None
+        for d in self.drainers:
+            try:
+                d.finish(timeout)
+            except (KeyboardInterrupt, SystemExit):  # pragma: no cover
+                raise
+            except BaseException as e:
+                if first is None:
+                    first = e
+        if first is not None:
+            raise first
+
+    def abort(self) -> None:
+        for d in self.drainers:
+            d.abort()

@@ -66,7 +66,8 @@ from .integrity import (
     sidecar_path,
     verify_shard_file,
 )
-from .overlap import AsyncDrainer, WorkerGaveUp, WorkerJobError
+from .overlap import (AsyncDrainer, DrainerGroup, WorkerGaveUp,
+                      WorkerJobError)
 from .layout import (
     DATA_SHARDS_COUNT,
     LARGE_BLOCK_SIZE,
@@ -158,6 +159,7 @@ class StreamingEncoder:
                  matrix_kind: str = "vandermonde",
                  dispatch_mb: int = 8, depth: int = 3,
                  engine: str = "auto", mesh: Optional[bool] = None,
+                 devices: Optional[str] = None,
                  zero_copy: bool = True, overlap: str = "auto",
                  tracer=None, drain_timeout_s: float = 30.0,
                  max_worker_restarts: int = 3,
@@ -170,12 +172,24 @@ class StreamingEncoder:
         and the host SIMD codec otherwise (jax-on-CPU is a correctness
         surface, ~200x slower than the AVX2 codec); 'device' forces the
         jax path (tests exercise the XLA kernels with it); 'host' forces
-        the SIMD codec.
+        the SIMD codec; 'mesh' is the per-device dispatch-queue plane
+        (`-ec.engine=mesh`): whole dispatches round-robin across the
+        device slice, each device with its own dispatch queue, slot
+        pool and drain lane (overlap.DrainerGroup) — N concurrent
+        dispatches in flight instead of serializing on device 0.
 
         mesh: None shards each dispatch over ALL visible devices
         (parallel/mesh.py dp x sp x tp shard_map) whenever more than one
         is present, so `-ec.engine=tpu` on a multi-chip host uses every
         chip; True forces the mesh path, False forces single-device.
+        (Only meaningful for engine='device'; the 'mesh' engine's
+        per-device queues ignore it.)
+
+        devices: engine='mesh' device selection, the `-ec.mesh.devices`
+        vocabulary (parallel.mesh.parse_device_spec): ''/None/'all' =
+        every visible device, 'N' = the first N, 'i,j,k' = exactly
+        those indices.  Validated here so a bad flag fails at server
+        start, not at first encode.
 
         Self-healing knobs: drain_timeout_s bounds every wait on a
         parity worker ack (a stalled worker surfaces as a fault, never a
@@ -212,10 +226,11 @@ class StreamingEncoder:
 
             on_tpu = jax.default_backend() not in ("cpu", "gpu")
             engine = "device" if on_tpu else "host"
-        if engine not in ("host", "device"):
+        if engine not in ("host", "device", "mesh"):
             # catch the -ec.engine vocabulary ("cpu"/"tpu") early rather
             # than silently taking the jax path
-            raise ValueError(f"engine must be auto/host/device, got {engine!r}")
+            raise ValueError(
+                f"engine must be auto/host/device/mesh, got {engine!r}")
         self.engine = engine
         # host mode prefers the mmap row-pointer path (no staging copies);
         # False forces the staged pipeline (differential tests cover both)
@@ -248,6 +263,9 @@ class StreamingEncoder:
         self._stale_workers: list = []  # guarded-by: _st_lock
         self._mesh = None
         self._mesh_encode = None
+        # per-device dispatch-queue plane (engine="mesh")
+        self._queue_devs = None
+        self._dev_encode = None
         b = dispatch_mb << 20
         if engine == "host":
             self.on_tpu = False
@@ -294,23 +312,41 @@ class StreamingEncoder:
             # one fixed dispatch width: multiple of the pallas tile on TPU
             if self.on_tpu:
                 b = max(DEFAULT_TILE_B, (b // DEFAULT_TILE_B) * DEFAULT_TILE_B)
-            # multi-chip: shard every dispatch over the full device mesh
-            # (dp over stripe rows, sp over byte columns, psum over the
-            # tp contraction) — `-ec.engine=tpu` must use every chip
-            ndev = len(jax.devices())
-            if mesh is None:
-                mesh = ndev > 1
-            if mesh:
-                from ..parallel.mesh import (factor_mesh, make_mesh,
-                                             sharded_encode_fn)
+            if engine == "mesh":
+                # per-device dispatch queues: each device computes WHOLE
+                # dispatches (single-device kernel geometry — a multiple
+                # of 64 keeps the u32 transfer packing and the XLA
+                # layouts happy), so the throughput lever is N dispatches
+                # in flight across the slice, not a sharded matmul
+                from ..parallel.mesh import (device_encode_fn,
+                                             parse_device_spec)
 
-                dp, sp, tp = factor_mesh(ndev)
-                self._mesh = make_mesh(dp, sp, tp)
-                self._mesh_dims = (dp, sp, tp)
-                self._mesh_encode = sharded_encode_fn(self._mesh)
-                # the dispatch width must split evenly over dp*sp
-                q = dp * sp * (DEFAULT_TILE_B if self.on_tpu else 64)
-                b = max(q, (b // q) * q)
+                self._queue_devs = parse_device_spec(devices)
+                self._dev_encode = device_encode_fn(on_tpu=self.on_tpu)
+                if not self.on_tpu:
+                    b = max(64, (b // 64) * 64)
+                # one plane copy per device stays cached
+                self._plane_cache_max_override = max(
+                    8, 2 * len(self._queue_devs))
+            else:
+                # multi-chip: shard every dispatch over the full device
+                # mesh (dp over stripe rows, sp over byte columns, psum
+                # over the tp contraction) — `-ec.engine=tpu` must use
+                # every chip
+                ndev = len(jax.devices())
+                if mesh is None:
+                    mesh = ndev > 1
+                if mesh:
+                    from ..parallel.mesh import (factor_mesh, make_mesh,
+                                                 sharded_encode_fn)
+
+                    dp, sp, tp = factor_mesh(ndev)
+                    self._mesh = make_mesh(dp, sp, tp)
+                    self._mesh_dims = (dp, sp, tp)
+                    self._mesh_encode = sharded_encode_fn(self._mesh)
+                    # the dispatch width must split evenly over dp*sp
+                    q = dp * sp * (DEFAULT_TILE_B if self.on_tpu else 64)
+                    b = max(q, (b // q) * q)
         self.dispatch_b = b
         self.depth = depth
         # same matrix family as ReedSolomon so shards are byte-identical
@@ -321,7 +357,7 @@ class StreamingEncoder:
         # matrices (every distinct erasure pattern is a distinct key) —
         # unbounded growth would pin HBM-resident plane arrays forever
         self._plane_cache: OrderedDict[bytes, object] = OrderedDict()
-        self._plane_cache_max = 8
+        self._plane_cache_max = getattr(self, "_plane_cache_max_override", 8)
         # per-call pipeline counters (read by bench.py's roofline section):
         #   fill_s       host time filling input buffers from disk
         #   write_s      host time writing shard outputs
@@ -377,6 +413,25 @@ class StreamingEncoder:
             else:
                 p = jnp.asarray(self._expand(rows))
             self._plane_cache[key] = p  # weedlint: disable=W502 producer-only LRU: _planes runs on the critical thread, never on drain threads
+            if len(self._plane_cache) > self._plane_cache_max:
+                self._plane_cache.popitem(last=False)
+        else:
+            self._plane_cache.move_to_end(key)
+        return p
+
+    def _planes_dev(self, rows: np.ndarray, dev_index: int):
+        """engine="mesh": the bit-plane expansion committed to ONE
+        device of the slice — each dispatch queue computes against its
+        own resident copy, so no queue ever waits on a cross-device
+        plane transfer.  Same LRU as _planes (the cache cap is raised
+        to 2x the slice size at construction)."""
+        rows = np.ascontiguousarray(rows)
+        key = rows.tobytes() + bytes([rows.shape[0], dev_index & 0xFF])
+        p = self._plane_cache.get(key)
+        if p is None:
+            p = self._jax.device_put(self._expand(rows),
+                                     self._queue_devs[dev_index])
+            self._plane_cache[key] = p  # weedlint: disable=W502 producer-only LRU: _planes_dev runs on the critical thread, never on drain threads
             if len(self._plane_cache) > self._plane_cache_max:
                 self._plane_cache.popitem(last=False)
         else:
@@ -1105,10 +1160,14 @@ class StreamingEncoder:
                 matmul_ptrs)
         retries = 0
         start_entry = start_byte = 0
+        # the mesh plane shares the staged pipeline's checkpoint-resume
+        # contract (self._ckpt) so the retry loop below serves both
+        attempt = (self._encode_file_mesh if self.engine == "mesh"
+                   else self._encode_file_staged)
         try:
             while True:
                 try:
-                    return self._encode_file_staged(
+                    return attempt(
                         dat_path, out_base, large_block_size,
                         small_block_size, start_entry, start_byte, retries)
                 except (KeyboardInterrupt, SystemExit):
@@ -1612,6 +1671,397 @@ class StreamingEncoder:
                 # encode's) seq stream — abandon the worker; the retry
                 # respawns fresh (mmap path does the same)
                 self._abandon_proc_worker()
+            t0 = clock()
+            with tr.span("pipeline.close"):
+                for f in outputs:
+                    f.close()
+            st["close_s"] = clock() - t0
+            st["wall_s"] = clock() - t_start
+            st["worker_restarts"] = int(_restart_total() -
+                                        self._restart_base)
+            root.__exit__(*exc)
+
+    def _encode_file_mesh(self, dat_path: str, out_base: str,
+                          large_block_size: int, small_block_size: int,
+                          start_entry: int = 0, start_byte: int = 0,
+                          retries: int = 0) -> None:
+        """One attempt of the per-device dispatch-queue plane
+        (`-ec.engine=mesh`): whole dispatches round-robin across the
+        device slice, so N dispatches compute and transfer concurrently
+        instead of serializing on device 0.
+
+        Per device: a slot pool of donated host staging buffers (one
+        committed device_put batches the whole [k, b] H2D), a dispatch
+        queue, and its own drain lane (overlap.DrainerGroup) — a slow
+        device back-pressures only its own queue.  Up to `coalesce`
+        dispatches ride one drain call per device, so several D2H
+        transfers amortize one wire turnaround when the link is the
+        ceiling.
+
+        Output discipline: data shards append on the producer thread in
+        dispatch order (exactly the staged pipeline); parity rows are
+        PWRITTEN at their known shard offsets by whichever lane finishes
+        first (order-free), while the `.eci` crc stream and the resume
+        checkpoint advance through an ordered completion tracker keyed
+        by dispatch index — shard bytes and sidecar stay byte-identical
+        to the CPU codec, and self._ckpt keeps the staged pipeline's
+        retry-from-checkpoint contract.  Per-dispatch faults degrade to
+        the CPU codec exactly like the staged path (the CPU parity rides
+        the same lane as a plain ndarray handle)."""
+        k, r, b = self.k, self.r, self.dispatch_b
+        devs = self._queue_devs
+        nd = len(devs)
+        # several dispatches per drain call when a thin link dominates;
+        # on CPU/GPU backends the "transfer" is a memcpy — keep latency
+        coalesce = 2 if self.on_tpu else 1
+        slots_per_dev = coalesce + 1
+        st = self._reset_stats()
+        st["retries"] = retries
+        st["devices"] = nd
+        self._ckpt = (start_entry, start_byte)  # weedlint: disable=W502 producer writes it before the drain lanes start; the writer lanes advance it under comp_lock and the producer re-reads only after the group is joined
+        clock = time.perf_counter
+        t_start = clock()
+        planes_dev = [self._planes_dev(self.matrix[k:], i)
+                      for i in range(nd)]
+        file_size = os.path.getsize(dat_path)
+        tr = self._tracer()
+        root = tr.span("pipeline.encode_file", path=dat_path,
+                       bytes=file_size, mode="mesh", engine=self.engine,
+                       devices=nd, resume_entry=start_entry)
+        root.__enter__()
+        setup = tr.span("pipeline.setup")
+        setup.__enter__()
+        outputs: list = []
+        sb = SidecarBuilder(k + r, self._sidecar_bs) if self._sidecar \
+            else None
+        try:
+            for i in range(k + r):
+                p = out_base + to_ext(i)
+                if start_byte and os.path.exists(p):
+                    f = open(p, "r+b")
+                    f.truncate(start_byte)
+                    f.seek(start_byte)
+                    if sb is not None:
+                        sb.seed_from_file(i, f, start_byte)
+                else:
+                    f = open(p, "wb")
+                outputs.append(f)
+            out_fds = [f.fileno() for f in outputs]
+            dev_bufs = [[np.zeros((k, b), dtype=np.uint8)
+                         for _ in range(slots_per_dev)]
+                        for _ in range(nd)]
+        except BaseException:
+            for f in outputs:
+                f.close()
+            exc = sys.exc_info()
+            setup.__exit__(*exc)
+            root.__exit__(*exc)
+            raise
+        ok = False
+        flags = {"degraded": False}  # terminal fault: rest goes CPU
+        ds = {"drain_s": 0.0, "write_s": 0.0, "sidecar_s": 0.0,
+              "fallback_s": 0.0, "parity_bytes": 0}
+        ds_lock = threading.Lock()
+        dev_drain_s = [0.0] * nd   # guarded-by: ds_lock
+        dev_dispatches = [0] * nd  # producer-only
+        slot_qs = [queue_mod.Queue() for _ in range(nd)]
+        for q in slot_qs:
+            for s in range(slots_per_dev):
+                q.put(s)
+        # ordered completion tracker: parity pwrites land out of order
+        # across lanes, but the crc sidecar and the resume checkpoint
+        # must advance in dispatch order — buffer completions and retire
+        # the contiguous prefix
+        comp_lock = threading.Lock()
+        comp: dict[int, tuple] = {}
+        nxt = [0]
+
+        def _retire_locked():
+            # holds comp_lock; sb parity crc streams stay sequential
+            # because only the contiguous prefix ever retires
+            sc = 0.0
+            while nxt[0] in comp:
+                parity, u, nfills = comp.pop(nxt[0])
+                if sb is not None:
+                    t1 = clock()
+                    for j in range(r):
+                        sb.update(k + j, parity[j, :u])
+                    sc += clock() - t1
+                ck_e, ck_b = self._ckpt
+                self._ckpt = (ck_e + nfills, ck_b + u)  # weedlint: disable=W502 writer lanes advance it under comp_lock while draining; the producer reads it only after the group is joined (happens-before)
+                nxt[0] += 1
+            if sc:
+                with ds_lock:
+                    ds["sidecar_s"] += sc
+
+        def drain_fetch_dev(meta):
+            """Fetch ONE device's batched D2H transfers (this lane's
+            thread) — failures recompute on the CPU codec from the
+            still-held slot buffers, then every slot recycles."""
+            dev_i, jobs = meta
+            parities: list = [None] * len(jobs)
+            reasons: list = [None] * len(jobs)
+            nbytes = 0
+            t0 = clock()
+            with tr.span("pipeline.drain", device=dev_i,
+                         dispatch=jobs[0][3], n=len(jobs),
+                         bytes=sum(r * j[1] for j in jobs)):
+                drain_fault = False
+                if faultinject._points:
+                    try:
+                        faultinject.hit("ec.drain")
+                    except Exception:
+                        drain_fault = True
+                for ji, (handle, u, slot, d_idx, nfills, off) \
+                        in enumerate(jobs):
+                    if drain_fault:
+                        reasons[ji] = "drain_fault"
+                        continue
+                    try:
+                        parities[ji] = self._fetch(handle)
+                        nbytes += int(parities[ji].nbytes)
+                    except (KeyboardInterrupt, SystemExit):
+                        raise
+                    except Exception:
+                        if drainers is not None and drainers.aborting:
+                            raise  # teardown race, not a pipeline fault
+                        reasons[ji] = "device_fetch"
+                        flags["degraded"] = True
+            fetch_s = clock() - t0
+            fb_s = 0.0
+            for ji, (handle, u, slot, d_idx, nfills, off) \
+                    in enumerate(jobs):
+                if parities[ji] is None:
+                    # slot buffer still intact (slots recycle below,
+                    # after fetch-or-recompute): lossless CPU recompute
+                    t1 = clock()
+                    with tr.span("pipeline.fallback", dispatch=d_idx,
+                                 device=dev_i, reason=reasons[ji]):
+                        parities[ji] = self._cpu_parity(
+                            dev_bufs[dev_i][slot][:, :u])
+                    fb_s += clock() - t1
+                    self._note_fallback(st, reasons[ji])
+                    tr.event("pipeline.fallback", dispatch=d_idx,
+                             device=dev_i, reason=reasons[ji])
+                parities[ji] = parities[ji][:, :u]
+                slot_qs[dev_i].put(slot)
+            with ds_lock:
+                ds["drain_s"] += fetch_s
+                ds["fallback_s"] += fb_s
+                ds["parity_bytes"] += nbytes
+                dev_drain_s[dev_i] += fetch_s
+            return parities
+
+        def drain_write_dev(meta, parities):
+            """This lane's writer thread: parity rows pwrite at their
+            known shard offsets (cross-lane order-free), then the
+            ordered tracker retires crc + checkpoint."""
+            dev_i, jobs = meta
+            t0 = clock()
+            with tr.span("pipeline.write", device=dev_i,
+                         dispatch=jobs[0][3], kind="parity"):
+                for (handle, u, slot, d_idx, nfills, off), parity \
+                        in zip(jobs, parities):
+                    for j in range(r):
+                        os.pwrite(out_fds[k + j],
+                                  memoryview(parity[j, :u]), off)
+                    with comp_lock:
+                        comp[d_idx] = (parity, u, nfills)
+                        _retire_locked()
+            with ds_lock:
+                ds["write_s"] += clock() - t0
+
+        drainers = DrainerGroup(nd, drain_fetch_dev, drain_write_dev,
+                                queue_depth=slots_per_dev + 2)
+        st["drain_pool"] = nd
+        batches: list[list] = [[] for _ in range(nd)]
+
+        def submit_batch(dev_i: int) -> None:
+            jobs, batches[dev_i] = batches[dev_i], []
+            if not jobs:
+                return
+            # a blocking put on a lane's bounded writer queue is
+            # drain-stall time, same as the staged pipeline
+            t0 = clock()
+            drainers.submit(dev_i, (dev_i, jobs))
+            st["drain_wait_s"] += clock() - t0
+
+        def acquire_slot(dev_i: int) -> int:
+            err = drainers.error
+            if err is not None:
+                raise err
+            try:
+                return slot_qs[dev_i].get_nowait()
+            except queue_mod.Empty:
+                pass
+            # every slot of THIS device is in flight: the residual
+            # drain stall, attributed to the lane that back-pressured
+            t0 = clock()
+            try:
+                with tr.span("pipeline.drain_wait", device=dev_i):
+                    deadline = time.monotonic() + max(
+                        4 * self.drain_timeout_s, 120.0)
+                    while True:
+                        try:
+                            return slot_qs[dev_i].get(timeout=0.2)
+                        except queue_mod.Empty:
+                            err = drainers.error
+                            if err is not None:
+                                raise err
+                            if time.monotonic() >= deadline:
+                                raise RuntimeError(
+                                    "mesh drain stalled: no free slot "
+                                    f"on device {dev_i}")
+            finally:
+                st["drain_wait_s"] += clock() - t0
+
+        try:
+            with open(dat_path, "rb") as dat:
+                fills: list[tuple[int, int, int, int, int]] = []
+                used = 0
+                out_off = start_byte
+
+                def flush():
+                    nonlocal used, fills, out_off
+                    if not used:
+                        return
+                    d_idx = st["dispatches"]
+                    dev_i = d_idx % nd  # round-robin across the slice
+                    slot = acquire_slot(dev_i)
+                    buf = dev_bufs[dev_i][slot]
+                    t0 = clock()
+                    with tr.span("pipeline.fill", dispatch=d_idx,
+                                 device=dev_i, bytes=k * used):
+                        for col, n, row_start, block, off in fills:
+                            if off == 0 and n == block:
+                                preadv_into(
+                                    dat,
+                                    [buf[i, col:col + n]
+                                     for i in range(k)],
+                                    row_start)
+                            else:
+                                for i in range(k):
+                                    buf[i, col:col + n] = pread_padded(
+                                        dat, n,
+                                        row_start + i * block + off)
+                        if used < b:
+                            buf[:, used:] = 0
+                    st["fill_s"] += clock() - t0
+                    dispatch_fault = False
+                    if faultinject._points:
+                        try:
+                            faultinject.hit("ec.dispatch")
+                        except Exception:
+                            dispatch_fault = True
+                    t0 = clock()
+                    with tr.span("pipeline.dispatch", dispatch=d_idx,
+                                 device=dev_i, bytes=k * used):
+                        if flags["degraded"] or dispatch_fault:
+                            # the CPU parity rides the same lane as a
+                            # plain ndarray handle: ordering, slot
+                            # recycling and accounting stay uniform
+                            reason = ("degraded" if flags["degraded"]
+                                      else "dispatch_fault")
+                            handle = self._cpu_parity(buf[:, :used])
+                            self._note_fallback(st, reason)
+                            tr.event("pipeline.fallback", dispatch=d_idx,
+                                     device=dev_i, reason=reason)
+                        else:
+                            try:
+                                # committed device_put batches the whole
+                                # [k, b] H2D to THIS device; the jitted
+                                # kernel (donated input on TPU) leaves a
+                                # packed u32 handle with its D2H queued
+                                darr = self._jax.device_put(
+                                    buf, devs[dev_i])
+                                handle = self._dev_encode(
+                                    planes_dev[dev_i], darr)
+                                try:
+                                    handle.copy_to_host_async()
+                                except Exception:  # pragma: no cover
+                                    pass
+                            except (KeyboardInterrupt, SystemExit):
+                                raise
+                            except Exception as e:
+                                flags["degraded"] = True
+                                self._note_fallback(st, "device_dispatch")
+                                tr.event("pipeline.fallback",
+                                         dispatch=d_idx, device=dev_i,
+                                         reason="device_dispatch",
+                                         error=f"{type(e).__name__}: {e}")
+                                handle = self._cpu_parity(buf[:, :used])
+                    st["dispatch_s"] += clock() - t0
+                    st["dispatches"] += 1
+                    st["bytes_in"] += k * used
+                    dev_dispatches[dev_i] += 1
+                    t0 = clock()
+                    with tr.span("pipeline.write", dispatch=d_idx,
+                                 kind="data"):
+                        for i in range(k):
+                            outputs[i].write(memoryview(buf[i, :used]))
+                        if sb is not None:
+                            t1 = clock()
+                            for i in range(k):
+                                sb.update(i, buf[i, :used])
+                            st["sidecar_s"] += clock() - t1
+                    st["write_s"] += clock() - t0
+                    batches[dev_i].append(
+                        (handle, used, slot, d_idx, len(fills), out_off))
+                    out_off += used
+                    fills, used = [], 0
+                    if len(batches[dev_i]) >= coalesce:
+                        submit_batch(dev_i)
+
+                st["setup_s"] = clock() - t_start
+                setup.__exit__(None, None, None)
+                setup = None
+                entries = _plan_entries(file_size, k, large_block_size,
+                                        small_block_size, b)
+                for _ in range(start_entry):  # resume: skip completed
+                    next(entries, None)
+                for n, row_start, block, off in entries:
+                    if used + n > b:
+                        flush()
+                    fills.append((used, n, row_start, block, off))
+                    used += n
+                flush()
+                for dev_i in range(nd):
+                    submit_batch(dev_i)
+                # tail stall: every lane's in-flight dispatches finish
+                # fetching + writing
+                t0 = clock()
+                with tr.span("pipeline.drain_wait", final=True):
+                    drainers.finish()
+                st["drain_wait_s"] += clock() - t0
+                if nxt[0] != st["dispatches"]:
+                    raise RuntimeError(
+                        f"mesh completion tracker retired {nxt[0]} of "
+                        f"{st['dispatches']} dispatches")
+            if sb is not None:
+                t0 = clock()
+                sb.finalize().save(out_base)
+                st["sidecar_s"] += clock() - t0
+            else:
+                try:  # stale sidecar would mass-demote the fresh shards
+                    os.remove(sidecar_path(out_base))
+                except OSError:
+                    pass
+            ok = True
+        finally:
+            exc = sys.exc_info() if not ok else (None, None, None)
+            if setup is not None:  # failed before the loop started
+                setup.__exit__(*exc)
+            if not ok:
+                drainers.abort()
+            st["drain_s"] += ds["drain_s"]
+            st["write_s"] += ds["write_s"]
+            st["sidecar_s"] += ds["sidecar_s"]
+            st["dispatch_s"] += ds["fallback_s"]
+            st["parity_bytes_drained"] += ds["parity_bytes"]
+            st["per_device"] = {
+                str(i): {"dispatches": dev_dispatches[i],
+                         "drain_s": round(dev_drain_s[i], 4)}
+                for i in range(nd)}
             t0 = clock()
             with tr.span("pipeline.close"):
                 for f in outputs:

@@ -291,6 +291,28 @@ def _analyze_run(root: dict, members: list[dict],
     unattributed = max(0.0, wall - attributed)
     drain_s = stage_s.get("drain", 0.0)
     track_s = sum(s["t1"] - s["t0"] for s in drain_track)
+    # per-device breakdown (the -ec.engine=mesh plane tags its
+    # dispatch/drain spans with a `device` attr): occupancy per device
+    # on the dispatch side, fetch seconds per drain lane — how the
+    # profile says WHICH device queue is the straggler
+    per_device: dict[str, dict] = {}
+    for sp in drain_track:
+        dev = sp["attrs"].get("device")
+        if dev is None:
+            continue
+        row = per_device.setdefault(
+            str(dev), {"fetch_s": 0.0, "drain_spans": 0, "dispatches": 0})
+        row["fetch_s"] += sp["t1"] - sp["t0"]
+        row["drain_spans"] += 1
+    for sp in members:
+        if sp["name"] != "pipeline.dispatch":
+            continue
+        dev = sp["attrs"].get("device")
+        if dev is None:
+            continue
+        row = per_device.setdefault(
+            str(dev), {"fetch_s": 0.0, "drain_spans": 0, "dispatches": 0})
+        row["dispatches"] += 1
     track_ivs = _merged_intervals(drain_track)
     # host-blocked drain seconds coinciding with an ACTIVE fetch on the
     # drainer track: the host waited on the WIRE (link-bound); the rest
@@ -335,6 +357,22 @@ def _analyze_run(root: dict, members: list[dict],
     for seg in segments:
         seg["s"] = round(seg["s"], 4)
 
+    drain_profile = {
+        "host_blocked_s": round(drain_s, 4),
+        "fetch_s": round(track_s if drain_track else drain_s, 4),
+        "link_bound_s": round(link_covered_s, 4),
+        "classification": drain_cls,
+    }
+    if per_device:
+        for row in per_device.values():
+            row["fetch_s"] = round(row["fetch_s"], 4)
+            row["fetch_share"] = round(
+                row["fetch_s"] / max(track_s, _EPS), 4)
+        drain_profile["per_device"] = {
+            k: per_device[k]
+            for k in sorted(per_device,
+                            key=lambda d: int(d) if d.isdigit() else -1)}
+
     degraded = bool(retries or fallback_reasons
                     or int(root["attrs"].get("resume_entry") or 0) > 0)
     worker_s = sum(s["t1"] - s["t0"] for s in members
@@ -354,12 +392,7 @@ def _analyze_run(root: dict, members: list[dict],
                                for k, v in sorted(offthread_s.items())},
         "unattributed_s": round(unattributed, 4),
         "overlap_efficiency": round(1.0 - drain_s / wall, 4),
-        "drain_profile": {
-            "host_blocked_s": round(drain_s, 4),
-            "fetch_s": round(track_s if drain_track else drain_s, 4),
-            "link_bound_s": round(link_covered_s, 4),
-            "classification": drain_cls,
-        },
+        "drain_profile": drain_profile,
         "attribution": attribution,
         "critical_path_stage": critical_path_stage,
         "critical_path": segments,

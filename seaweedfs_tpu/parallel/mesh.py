@@ -49,6 +49,84 @@ def _shard_map(f, *, mesh, in_specs, out_specs, check_vma=None):
                   check_rep=check_vma)
 
 
+def parse_device_spec(spec=None, devices: list | None = None) -> list:
+    """The `-ec.mesh.devices` flag vocabulary -> a concrete device list:
+
+      ''/None/'all'  every visible device
+      'N'            the first N devices (a bare integer is a COUNT)
+      'i,j,k'        exactly those jax.devices() indices ('3,' selects
+                     index 3 — the trailing comma forces index form)
+
+    ValueError on empty selections, non-integers, out-of-range or
+    duplicate indices — the flag should fail loudly at server start,
+    not at first encode."""
+    devices = devices if devices is not None else jax.devices()
+    if spec is None:
+        return list(devices)
+    if isinstance(spec, int):
+        spec = str(spec)
+    s = str(spec).strip()
+    if s in ("", "all"):
+        return list(devices)
+    if "," not in s:
+        try:
+            n = int(s)
+        except ValueError:
+            raise ValueError(
+                f"bad -ec.mesh.devices {spec!r}: expected '', 'all', a "
+                f"device count, or comma-separated indices") from None
+        if not 1 <= n <= len(devices):
+            raise ValueError(
+                f"-ec.mesh.devices={n} out of range: have "
+                f"{len(devices)} device(s)")
+        return list(devices[:n])
+    try:
+        idxs = [int(t) for t in s.split(",") if t.strip() != ""]
+    except ValueError:
+        raise ValueError(
+            f"bad -ec.mesh.devices {spec!r}: indices must be "
+            f"integers") from None
+    if not idxs:
+        raise ValueError(f"bad -ec.mesh.devices {spec!r}: empty selection")
+    if len(set(idxs)) != len(idxs):
+        raise ValueError(
+            f"bad -ec.mesh.devices {spec!r}: duplicate indices")
+    bad = [i for i in idxs if not 0 <= i < len(devices)]
+    if bad:
+        raise ValueError(
+            f"-ec.mesh.devices indices {bad} out of range: have "
+            f"{len(devices)} device(s)")
+    return [devices[i] for i in idxs]
+
+
+def device_encode_fn(on_tpu: bool = False, tile_b: int = 0,
+                     donate: bool | None = None):
+    """Single-device jitted packed encode for the per-device dispatch
+    queues (`-ec.engine=mesh`): (planes [8R, 8K], data [K, B]) ->
+    [R, B//4] u32 transfer-packed parity.
+
+    The data buffer is DONATED on real accelerators so XLA reuses the
+    dispatch's H2D staging block instead of holding both copies in HBM;
+    donation is skipped on cpu backends (unsupported there — jax warns
+    and ignores it).  One returned callable serves every device in the
+    slice: jit specializes per input placement, so committed
+    device_put inputs pin the compute to their device."""
+    from ..ops.gf_matmul import (DEFAULT_TILE_B, _pack_u32_lanes,
+                                 gf_matmul_pallas, gf_matmul_xla)
+    if donate is None:
+        donate = on_tpu
+    if on_tpu:
+        tb = int(tile_b) or DEFAULT_TILE_B
+
+        def _enc(a_planes, data):
+            return _pack_u32_lanes(gf_matmul_pallas(a_planes, data,
+                                                    tile_b=tb))
+    else:
+        def _enc(a_planes, data):
+            return _pack_u32_lanes(gf_matmul_xla(a_planes, data))
+    return jax.jit(_enc, donate_argnums=(1,) if donate else ())
+
+
 def factor_mesh(n_devices: int) -> tuple[int, int, int]:
     """Factor n into (dp, sp, tp), preferring all three axes real."""
     tp = 2 if n_devices % 2 == 0 else 1

@@ -102,6 +102,7 @@ class VolumeServer:
                  public_url: str = "", data_center: str = "",
                  rack: str = "", max_volume_count: int = 8,
                  pulse_seconds: float = 5.0, ec_engine: str = "cpu",
+                 ec_mesh_devices: str = "",
                  guard: Optional["Guard"] = None,
                  backends: Optional[dict] = None,
                  full_sync_every: int = 12,
@@ -128,6 +129,7 @@ class VolumeServer:
         self.guard = guard or Guard()
         self.store = Store(directories, host, port, public_url,
                            max_volume_count, ec_engine=ec_engine,
+                           ec_mesh_devices=ec_mesh_devices,
                            use_mmap=use_mmap,
                            needle_cache_mb=needle_cache_mb)
         from ..stats import ec_pipeline_metrics, volume_server_metrics

@@ -150,7 +150,8 @@ class Store:
     def __init__(self, directories: list[str], ip: str = "127.0.0.1",
                  port: int = 8080, public_url: str = "",
                  max_volume_count: int = 8,
-                 ec_engine: str = "cpu", use_mmap: bool = False,
+                 ec_engine: str = "cpu", ec_mesh_devices: str = "",
+                 use_mmap: bool = False,
                  needle_cache_mb: int = 64):
         from .needle_cache import NeedleCache
 
@@ -169,6 +170,18 @@ class Store:
         self.ec_collections: dict[int, str] = {}
         self.volume_size_limit = 30 * 1000 * 1000 * 1000
         self.ec_engine_name = ec_engine
+        # -ec.mesh.devices: device selection for the mesh encode plane
+        # (parallel.mesh.parse_device_spec vocabulary); "" = all devices.
+        # Validated EAGERLY when the mesh engine is selected so a bad
+        # spec fails at server start, not at first encode — the jax
+        # backend init this forces is intended: the operator explicitly
+        # asked for a device engine (same rationale as
+        # _streaming_encoder's).
+        self.ec_mesh_devices = ec_mesh_devices
+        if ec_engine == "mesh":
+            from ..parallel.mesh import parse_device_spec
+
+            parse_device_spec(ec_mesh_devices)
         # mmap-backed .dat files (-memoryMapSizeMB analog, backend/memory_map)
         self.use_mmap = use_mmap
         # native C++ data plane (native/dataplane.cpp): when attached, it
@@ -196,7 +209,7 @@ class Store:
         self._gone_ec_vids: set[int] = set()
         self.load_existing()
 
-    # --- engine selection (-ec.engine={cpu,tpu}) --------------------------
+    # --- engine selection (-ec.engine={cpu,tpu,mesh}) ---------------------
     def rs(self, engine: Optional[str] = None) -> ReedSolomon:
         name = engine or self.ec_engine_name
         rs = self._rs_cache.get(name)
@@ -205,6 +218,11 @@ class Store:
                 from ..ops.gf_matmul import TpuEngine
 
                 rs = ReedSolomon(10, 4, engine=TpuEngine())
+            elif name == "mesh":
+                from ..ec.codec import MeshEngine
+
+                rs = ReedSolomon(
+                    10, 4, engine=MeshEngine(devices=self.ec_mesh_devices))
             else:
                 rs = ReedSolomon(10, 4, engine=best_cpu_engine())
             self._rs_cache[name] = rs
@@ -747,10 +765,14 @@ class Store:
         base = v.file_prefix
         with self.volume_locks[vid]:
             v.read_only = True
-            if (engine or self.ec_engine_name) == "tpu":
+            name = engine or self.ec_engine_name
+            if name in ("tpu", "mesh"):
                 # overlapped device pipeline (ec/streaming.py), not the
-                # serial read->matmul->write loop
-                self._streaming_encoder().encode_file(base + ".dat", base)
+                # serial read->matmul->write loop; "mesh" spreads whole
+                # dispatches across per-device queues instead of
+                # sharding each one
+                self._streaming_encoder(name).encode_file(
+                    base + ".dat", base)
             else:
                 ec_encoder.write_ec_files(base, self.rs(engine))
             ec_encoder.write_sorted_file_from_idx(base)
@@ -759,19 +781,29 @@ class Store:
                    engine: Optional[str] = None) -> list[int]:
         """VolumeEcShardsRebuild: regenerate missing local shards."""
         base = self._ec_base(vid, collection)
-        if (engine or self.ec_engine_name) == "tpu":
-            return self._streaming_encoder().rebuild_files(base)
+        name = engine or self.ec_engine_name
+        if name in ("tpu", "mesh"):
+            return self._streaming_encoder(name).rebuild_files(base)
         return ec_encoder.rebuild_ec_files(base, self.rs(engine))
 
-    def _streaming_encoder(self):
-        enc = getattr(self, "_stream_enc", None)
+    def _streaming_encoder(self, engine: str = "tpu"):
+        # explicit device engines: this path is only reached when the
+        # operator selected -ec.engine=tpu/mesh, so jax backend init is
+        # intended (auto-detection could hang on a downed TPU tunnel)
+        stream = "mesh" if engine == "mesh" else "device"
+        cache = getattr(self, "_stream_encs", None)
+        if cache is None:
+            cache = self._stream_encs = {}
+        enc = cache.get(stream)
         if enc is None:
             from ..ec.streaming import StreamingEncoder
 
-            # explicit device engine: this path is only reached when the
-            # operator selected -ec.engine=tpu, so jax backend init is
-            # intended (auto-detection could hang on a downed TPU tunnel)
-            enc = self._stream_enc = StreamingEncoder(engine="device")
+            enc = cache[stream] = StreamingEncoder(
+                engine=stream,
+                devices=self.ec_mesh_devices if stream == "mesh" else None)
+            if stream == "device":
+                # long-standing probe point (tests, driver smoke runs)
+                self._stream_enc = enc
         return enc
 
     def _ec_base(self, vid: int, collection: str = "") -> str:

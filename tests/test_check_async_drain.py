@@ -47,6 +47,16 @@ def _encode_file_mmap(self):
         return parity
     drainer = AsyncDrainer(drain_fetch, lambda m, p: None)
     drainer.finish()
+
+def _encode_file_mesh(self):
+    def drain_fetch_dev(meta):
+        with tr.span("pipeline.drain", device=0):
+            if faultinject._points:
+                faultinject.hit("ec.drain")
+            parity = self._fetch(meta)
+        return parity
+    drainers = DrainerGroup(2, drain_fetch_dev, lambda m, p: None)
+    drainers.finish()
 """
 
 
@@ -81,8 +91,19 @@ class TestPlantedViolations:
 
     def test_missing_hot_func_rejected(self):
         problems = CHECK.check_streaming_source("x = 1\n", "x.py")
-        assert len(problems) == 2
+        assert len(problems) == 3
         assert all("not found" in p for p in problems)
+
+    def test_mesh_without_any_drainer_rejected(self):
+        # the per-device plane must construct AsyncDrainer lanes through
+        # a DrainerGroup (or AsyncDrainer directly) — neither = finding
+        src = CLEAN.replace(
+            "    drainers = DrainerGroup(2, drain_fetch_dev, "
+            "lambda m, p: None)\n"
+            "    drainers.finish()", "    pass")
+        problems = CHECK.check_streaming_source(src, "x.py")
+        assert any("_encode_file_mesh" in p and "DrainerGroup" in p
+                   for p in problems)
 
     def test_drain_fault_outside_span_rejected(self):
         src = ("def f():\n"

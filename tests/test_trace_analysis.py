@@ -330,3 +330,33 @@ class TestBenchSectionBudget:
         # parent salvages after a SIGKILL)
         with open(scratch) as f:
             assert json.load(f)["sections_skipped"]
+
+    def test_join_bounded_abandons_before_budget_line(self):
+        """A section that started under a healthy cap but whose shared
+        child budget ran low mid-run must be abandoned ~grace seconds
+        before the budget line (not slept through to the parent's
+        SIGKILL), and a finished thread must report True."""
+        import threading
+        import time as _time
+
+        from bench import _join_bounded
+
+        stop = threading.Event()
+        th = threading.Thread(target=stop.wait, daemon=True)
+        th.start()
+        try:
+            # cap far away, budget nearly spent: give up immediately,
+            # leaving slack to checkpoint and emit BENCH_CHILD_RESULT
+            t0 = _time.perf_counter()
+            assert _join_bounded(th, cap=60.0, remaining=lambda: 5.0,
+                                 grace=8.0) is False
+            assert _time.perf_counter() - t0 < 3.0
+            # budget plentiful, tiny cap: abandoned at the cap instead
+            t0 = _time.perf_counter()
+            assert _join_bounded(th, cap=0.2,
+                                 remaining=lambda: 1e9) is False
+            assert _time.perf_counter() - t0 < 3.0
+        finally:
+            stop.set()
+        th.join(5)
+        assert _join_bounded(th, cap=1.0, remaining=lambda: 1e9) is True

@@ -66,6 +66,15 @@ KEY_METRICS: list[tuple] = [
     ("e2e_file_encode_mbps", "up"),
     ("e2e_pipeline_disk.overlap_efficiency", "up", 0.05),
     ("e2e_pipeline_tmpfs.overlap_efficiency", "up", 0.05),
+    ("e2e_pipeline_disk.e2e_link_efficiency", "up", 0.05),
+    ("e2e_pipeline_tmpfs.e2e_link_efficiency", "up", 0.05),
+    # mesh-sharded encode plane (ec/streaming._encode_file_mesh):
+    # aggregate throughput across per-device dispatch queues at the
+    # widest width, and the overlap/link verdicts that certify the
+    # queues actually hid drain time behind host work
+    ("multichip_encode.aggregate_mbps", "up", 5.0),
+    ("multichip_encode.overlap_efficiency", "up", 0.05),
+    ("multichip_encode.e2e_link_efficiency", "up", 0.05),
     ("coordinator.mttr_s", "down", 1.0),
     ("alerts.eval_read_overhead_pct", "down", 1.0),
     ("trace_sampling_read_overhead_pct", "down", 1.0),

@@ -5,11 +5,13 @@ buffered drain only pays off while nothing reintroduces a blocking
 full-block fetch on the critical thread — a regression that stays
 byte-correct and therefore invisible to every differential test:
 
-  1. `_encode_file_staged` and `_encode_file_mmap` must both construct
-     the AsyncDrainer.
+  1. `_encode_file_staged`, `_encode_file_mmap` and `_encode_file_mesh`
+     must each construct the AsyncDrainer (directly, or as per-device
+     lanes through a DrainerGroup).
   2. Inside them, blocking-fetch calls (`_fetch`, `fetch`, `asarray`,
      `device_get`, `block_until_ready`) may appear ONLY within nested
-     drain helpers (functions named `drain*`).
+     drain helpers (functions named `drain*`) — including the
+     per-device `drain_fetch_dev`/`drain_write_dev` lane callbacks.
   3. Every `faultinject.hit("ec.drain")` in the package must sit
      lexically inside `with ... span("pipeline.drain", ...)` so
      delay-only slow-drain drills keep attributing to the drain stage.
@@ -24,7 +26,9 @@ from .engine import Finding, Repo, Rule, register
 
 PACKAGE = "seaweedfs_tpu"
 STREAMING_REL = os.path.join(PACKAGE, "ec", "streaming.py")
-HOT_FUNCS = ("_encode_file_staged", "_encode_file_mmap")
+HOT_FUNCS = ("_encode_file_staged", "_encode_file_mmap",
+             "_encode_file_mesh")
+DRAINER_CTORS = {"AsyncDrainer", "DrainerGroup"}
 BLOCKING_CALLS = {"_fetch", "fetch", "asarray", "device_get",
                   "block_until_ready"}
 DRAIN_PREFIXES = ("drain", "_drain")
@@ -87,12 +91,13 @@ def check_streaming_source(src: str, path: str) -> list[Finding]:
             continue
         calls = {_call_name(c) for c in ast.walk(fn)
                  if isinstance(c, ast.Call)}
-        if "AsyncDrainer" not in calls:
+        if not (DRAINER_CTORS & calls):
             problems.append(Finding(
                 "W301", path, fn.lineno,
-                f"{name} no longer constructs AsyncDrainer — the drain "
-                f"would run inline on the critical thread and the "
-                f"drain-wait stall returns"))
+                f"{name} no longer constructs AsyncDrainer (or a "
+                f"DrainerGroup of per-device lanes) — the drain would "
+                f"run inline on the critical thread and the drain-wait "
+                f"stall returns"))
         problems.extend(_check_hot_func(fn, path))
     return problems
 

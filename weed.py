@@ -134,6 +134,7 @@ def cmd_volume(args) -> None:
                       port=args.port, data_center=args.dataCenter,
                       rack=args.rack, max_volume_count=args.max,
                       ec_engine=args.ec_engine,
+                      ec_mesh_devices=args.ec_mesh_devices,
                       guard=volume_guard(_security()),
                       tls_context=_cluster_tls(),
                       use_mmap=args.mmap,
@@ -298,6 +299,7 @@ def cmd_server(args) -> None:
     m = MasterServer(host=args.ip, port=args.masterPort).start()
     vs = VolumeServer(args.dir.split(","), m.url, host=args.ip,
                       port=args.port, ec_engine=args.ec_engine,
+                      ec_mesh_devices=args.ec_mesh_devices,
                       use_mmap=args.mmap,
                       dataplane=args.dataplane,
                       max_inflight=args.maxInflight,
@@ -1196,7 +1198,10 @@ def main(argv=None) -> None:
     v.add_argument("-rack", default="")
     v.add_argument("-max", type=int, default=8)
     v.add_argument("-ec.engine", dest="ec_engine", default="cpu",
-                   choices=["cpu", "tpu"])
+                   choices=["cpu", "tpu", "mesh"])
+    v.add_argument("-ec.mesh.devices", dest="ec_mesh_devices", default="",
+                   help="mesh engine device spec: '' or 'all' = every device,"
+                        " 'N' = first N, 'i,j,...' = exact device indices")
     v.add_argument("-mmap", action="store_true",
                    help="mmap-backed .dat files (backend/memory_map analog)")
     v.add_argument("-dataplane", default="python",
@@ -1239,7 +1244,10 @@ def main(argv=None) -> None:
     s.add_argument("-ftp", action="store_true")
     s.add_argument("-ftpPort", type=int, default=8021)
     s.add_argument("-ec.engine", dest="ec_engine", default="cpu",
-                   choices=["cpu", "tpu"])
+                   choices=["cpu", "tpu", "mesh"])
+    s.add_argument("-ec.mesh.devices", dest="ec_mesh_devices", default="",
+                   help="mesh engine device spec: '' or 'all' = every device,"
+                        " 'N' = first N, 'i,j,...' = exact device indices")
     s.add_argument("-mmap", action="store_true",
                    help="mmap-backed .dat files (backend/memory_map analog)")
     s.add_argument("-dataplane", default="python",
