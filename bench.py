@@ -58,6 +58,7 @@ SECTION_CAPS = {
     "cluster_native": 360, "cluster_scaled": 420, "parity": 120,
     "integrity": 120, "scenarios": 300, "capacity": 420,
     "heat": 420, "pipeline_health": 15, "multichip_encode": 420,
+    "master_failover": 180,
 }
 SECTION_CAP_DEFAULT = 300
 SECTION_MIN_S = 15          # least useful remaining budget to even start
@@ -1593,6 +1594,40 @@ def _child(scratch_path: str, platform: str = "") -> None:
         detail["scenarios"] = block
 
     section("scenarios", meas_scenarios)
+
+    # --- master HA: leader-failover drill (scenarios/failover.py) ----------
+    def meas_master_failover():
+        """The control-plane HA proof (master/consensus.py raft log):
+        a 3-master quorum under a write storm loses its leader mid EC
+        repair.  The drill measures election time, /dir/assign
+        recovery latency on the new leader, pre-kill journaled-event
+        loss across the failover (the raft contract demands exactly
+        zero), and how long the new leader takes to re-plan the
+        orphaned repair with its original alert/trace cause
+        attribution.  bench_diff floors journal_loss_count at zero and
+        watches the two latencies."""
+        from seaweedfs_tpu.scenarios import master_failover, run_failover
+
+        try:
+            res = run_failover(master_failover())
+        except Exception as e:
+            detail["master_failover"] = {
+                "error": f"{type(e).__name__}: {e}"[:300],
+                "verdict": "error"}
+            return
+        detail["master_failover"] = {
+            "election_time_s": res.get("election_time_s"),
+            "assign_after_kill_s": res.get("assign_after_kill_s"),
+            "journal_loss_count": res.get("journal_loss_count"),
+            "pre_kill_events": res.get("pre_kill_events"),
+            "repair_replan_s": res.get("repair_replan_s"),
+            "repair_attribution": res.get("repair_attribution"),
+            "total_ops": res.get("total_ops"),
+            "checks": res.get("checks"),
+            "verdict": res.get("verdict"),
+        }
+
+    section("master_failover", meas_master_failover)
 
     # --- workload recorder overhead + SLO capacity probe -------------------
     def meas_capacity():

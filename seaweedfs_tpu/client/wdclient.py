@@ -15,6 +15,7 @@ from dataclasses import dataclass
 
 from ..utils.backoff import jittered_backoff, retry_allowed
 from ..utils.httpd import HttpError, http_json, http_json_retry
+from ..utils.leader import LeaderFollowingTransport
 
 
 @dataclass(frozen=True)
@@ -76,13 +77,21 @@ class VidMap:
 
 class WdClient:
     """Maintains a live VidMap via the master watch long-poll; falls back
-    to /dir/lookup for vids not (yet) in the map."""
+    to /dir/lookup for vids not (yet) in the map.
+
+    `master_url` may be a comma-separated candidate list (an HA master
+    quorum): the shared LeaderFollowingTransport rotates candidates on
+    failure and short-circuits straight to the leader learned from the
+    watch response, so an election costs at most one failed poll plus
+    rotation — not poll_timeout worth of redirect loops."""
 
     def __init__(self, master_url: str, data_center: str = "",
                  poll_timeout: float = 14.0):
         self.master_url = master_url
         self.vid_map = VidMap(data_center)
         self.poll_timeout = poll_timeout
+        self.transport = LeaderFollowingTransport(lambda: self.master_url,
+                                                  name="wdclient")
         self._stop = threading.Event()
         self._synced = threading.Event()
         self._thread: threading.Thread | None = None
@@ -120,11 +129,16 @@ class WdClient:
         seq = 0
         failures = 0
         while not self._stop.is_set():
+            target = ""
             try:
+                target = self.transport.target()
                 r = http_json(
-                    "GET", f"http://{self.master_url}/cluster/watch?"
+                    "GET", f"http://{target}/cluster/watch?"
                     f"since_seq={seq}&timeout={self.poll_timeout}",
                     timeout=self.poll_timeout + 10)
+                # the body is stamped by the leader even when a follower
+                # 307-redirected us there: poll it directly next time
+                self.transport.learn(str(r.get("leader") or ""))
                 if "volumes" in r:
                     self.vid_map.apply_snapshot(r)
                 for e in r.get("events", []):
@@ -133,6 +147,7 @@ class WdClient:
                 self._synced.set()
                 failures = 0
             except Exception:
+                self.transport.note_failure()
                 # ANY failure (transport, malformed body, bad event) must
                 # not kill the loop with _synced set — that would freeze
                 # the map and serve stale locations forever
@@ -145,7 +160,7 @@ class WdClient:
                 # budget-refill instead of an exponential-backoff storm
                 # — a drained bucket holds the full cap and the denial
                 # is counted + journaled (retry_budget_exhausted)
-                if retry_allowed(self.master_url, "wdclient"):
+                if retry_allowed(target or self.master_url, "wdclient"):
                     delay = jittered_backoff(self.RECONNECT_BASE,
                                              self.RECONNECT_CAP,
                                              failures)
@@ -163,11 +178,19 @@ class WdClient:
         # An idempotent GET against a possibly-restarting master:
         # bounded retries through the per-destination retry budget
         # (a down master denies them and the lookup degrades to one
-        # attempt instead of joining the reconnect storm)
-        r = http_json_retry(
-            "GET", f"http://{self.master_url}/dir/lookup?"
-            f"volumeId={vid}", timeout=30.0, attempts=3,
-            budget_kind="wdclient")
+        # attempt instead of joining the reconnect storm).  The target
+        # comes from the shared transport (learned leader, else
+        # rotation); a failure rotates so the NEXT lookup/poll tries a
+        # different master.
+        target = self.transport.target()
+        try:
+            r = http_json_retry(
+                "GET", f"http://{target}/dir/lookup?"
+                f"volumeId={vid}", timeout=30.0, attempts=3,
+                budget_kind="wdclient")
+        except Exception:
+            self.transport.note_failure()
+            raise
         return [loc["url"] for loc in r.get("locations", [])]
 
     def lookup_file_id(self, fid: str) -> list[str]:

@@ -151,19 +151,34 @@ class MasterServer:
             stale_peers_fn=self._stale_peers,
             is_leader_fn=lambda: self.is_leader,
             admin_locked_fn=self._admin_locked,
-            interval_s=coordinator_seconds or 15.0)
+            interval_s=coordinator_seconds or 15.0,
+            replicate_fn=self._replicate_coordinator_record)
         self.aggregator.local_fn = self._local_health_contribution
-        self.event_journal.on_ingest = self.coordinator.on_events
+        # ONE replication chokepoint per journal: the on_ingest hook
+        # sees every accepted record — shipped batches AND the master's
+        # own local-shipper short-circuit — so the leader replicates
+        # them as raft log entries without per-route append calls
+        self.event_journal.on_ingest = self._on_cluster_events
+        self.workload_journal.on_ingest = self._on_workload_records
+        # EC registry shadow: followers apply the leader's ec_registry
+        # log entries here (plain urls — real DataNode wiring rebuilds
+        # from volume-server heartbeats after promotion)
+        self._ec_registry_shadow: dict = {}  # guarded-by: topo.lock
+        self._ec_registry_hash = ""  # guarded-by: topo.lock
+        # last replicated alert-state fingerprint (telemetry loop only)
+        self._alert_state_hash = ""
         from .consensus import RaftNode
 
         self.raft = RaftNode(
             f"{host}:{port}", peers or [], state_dir=mdir,
             apply_state=self._apply_raft_state,
             read_state=lambda: {"max_volume_id": self.topo.max_volume_id,
-                                "max_file_key": self.seq.peek()})
+                                "max_file_key": self.seq.peek()},
+            apply_entry=self._apply_raft_entry,
+            read_snapshot=self._raft_read_snapshot,
+            apply_snapshot=self._raft_apply_snapshot)
         self.metrics.leader_gauge.set(1 if self.raft.is_leader else 0)
-        self.raft.on_role_change = lambda role: \
-            self.metrics.leader_gauge.set(1 if role == "leader" else 0)
+        self.raft.on_role_change = self._on_role_change
         self.router = Router("master", metrics=self.metrics)
         self.router.server_url = self.url
         # admission control (utils/admission.py): -maxInflight > 0
@@ -306,7 +321,7 @@ class MasterServer:
             extra.get("reqlog_records_dropped", 0) + dropped_total()
         return extra
 
-    # --- consensus (raft_server.go; state machine = MaxVolumeId) ----------
+    # --- consensus (raft_server.go; state machine = the control plane) ----
     def _apply_raft_state(self, state: dict) -> None:
         vid = int(state.get("max_volume_id", 0))
         with self.topo.lock:
@@ -314,6 +329,170 @@ class MasterServer:
         key = int(state.get("max_file_key", 0))
         if key:
             self.seq.set_max(key)
+
+    def _ingest_preserving_via(self, journal, docs: list) -> None:  # raft-apply
+        """Replay shipped records into a merged journal keeping each
+        record's original `via` label (the transport identity the
+        LEADER stamped) — the state-hash equality contract: a caught-up
+        follower's journal must be byte-identical to the leader's."""
+        by_via: dict[str, list] = {}
+        for d in docs or []:
+            by_via.setdefault(str(d.get("via") or "raft"), []).append(d)
+        for via, batch in by_via.items():
+            journal.ingest(via, batch)
+
+    def _apply_raft_entry(self, kind: str, data: dict) -> None:  # raft-apply
+        """Follower apply-loop: committed log entries drive the SAME
+        state machines the leader runs (consensus.py apply_entry).
+        Every branch is idempotent — journals dedup by record id, the
+        counters max-merge — so replays across snapshot/entry overlap
+        and restart recovery are harmless."""
+        if kind == "vid_alloc":
+            self._apply_raft_state(data)
+        elif kind == "event":
+            self._ingest_preserving_via(self.event_journal,
+                                        data.get("events") or [])
+        elif kind == "workload":
+            self._ingest_preserving_via(self.workload_journal,
+                                        data.get("records") or [])
+        elif kind == "alert":
+            self.alert_engine.import_state(data.get("alerts") or {})
+        elif kind == "coordinator":
+            self.coordinator.apply_replicated(data)
+        elif kind == "ec_registry":
+            with self.topo.lock:
+                self._ec_registry_shadow = data.get("registry") or {}
+                self._ec_registry_hash = data.get("hash") or ""
+
+    def _raft_read_snapshot(self) -> dict:
+        """The full control-plane image for log compaction and
+        InstallSnapshot catch-up: the meta counters plus every
+        replicated state machine's exportable state."""
+        return {
+            "max_volume_id": self.topo.max_volume_id,
+            "max_file_key": self.seq.peek(),
+            "events": self.event_journal.query(limit=0),
+            "workload": self.workload_journal.query(limit=0),
+            "alerts": self.alert_engine.export_state(),
+            "coordinator": self.coordinator.export_replicated(),
+            "ec_registry": self._ec_registry_doc(),
+        }
+
+    def _raft_apply_snapshot(self, state: dict) -> None:  # raft-apply
+        """InstallSnapshot / restart recovery: replay the leader's
+        full image through the local state machines (idempotent)."""
+        self._apply_raft_state(state)
+        self._ingest_preserving_via(self.event_journal,
+                                    state.get("events") or [])
+        self._ingest_preserving_via(self.workload_journal,
+                                    state.get("workload") or [])
+        self.alert_engine.import_state(state.get("alerts") or {})
+        self.coordinator.import_replicated(
+            state.get("coordinator") or {})
+        reg = state.get("ec_registry") or {}
+        if reg:
+            with self.topo.lock:
+                self._ec_registry_shadow = reg.get("registry") or {}
+                self._ec_registry_hash = reg.get("hash") or ""
+
+    def _on_cluster_events(self, accepted: list[dict]) -> None:  # thread-entry
+        """ClusterEventJournal ingest hook: feed the coordinator's wake
+        signal (as before) AND replicate the accepted batch through the
+        raft log so a follower's journal tracks the leader's.  Runs on
+        whatever thread shipped the batch — append() is a lock-guarded
+        local log write; replication rides the heartbeat."""
+        self.coordinator.on_events(accepted)
+        # getattr: restart recovery replays the log DURING RaftNode
+        # construction, before self.raft is bound
+        raft = getattr(self, "raft", None)
+        if raft is not None and raft.peers and raft.is_leader:
+            raft.append("event", {"events": accepted})
+
+    def _on_workload_records(self, accepted: list[dict]) -> None:  # thread-entry
+        """WorkloadJournal ingest hook: replicate accepted access
+        records (same contract as _on_cluster_events)."""
+        raft = getattr(self, "raft", None)
+        if raft is not None and raft.peers and raft.is_leader:
+            raft.append("workload", {"records": accepted})
+
+    def _replicate_coordinator_record(self, record: dict) -> None:
+        """EcCoordinator replicate_fn: plan/done/failed records enter
+        the raft log synchronously — a leader killed mid-repair must
+        leave the planned record on a quorum so the next leader
+        re-plans it with the original cause attribution."""
+        raft = getattr(self, "raft", None)
+        if raft is not None and raft.peers and raft.is_leader:
+            raft.append("coordinator", record, sync=True)
+
+    def _ec_registry_doc(self) -> dict:
+        """The EC registry as plain urls (what ec_registry log entries
+        carry): on the leader, derived live from the topology; on a
+        follower, the applied shadow."""
+        with self.topo.lock:
+            if self.topo.ec_shard_locations:
+                reg = {
+                    str(vid): {
+                        "collection": self.topo.ec_collections.get(vid,
+                                                                   ""),
+                        "shards": {str(sid): [n.url for n in nodes]
+                                   for sid, nodes in shards.items()}}
+                    for vid, shards in
+                    self.topo.ec_shard_locations.items()}
+            else:
+                reg = dict(self._ec_registry_shadow)
+        import hashlib
+        import json as _json
+
+        h = hashlib.sha1(_json.dumps(reg, sort_keys=True)
+                         .encode()).hexdigest()[:16]
+        return {"registry": reg, "hash": h}
+
+    def _replicate_ec_registry(self) -> None:
+        """Leader heartbeat path: when the EC shard map changed since
+        the last replication, append one coarse ec_registry entry (the
+        full mapping — small, and a follower needs no delta replay)."""
+        if not self.raft.peers or not self.raft.is_leader:
+            return
+        doc = self._ec_registry_doc()
+        with self.topo.lock:
+            if doc["hash"] == self._ec_registry_hash:
+                return
+            self._ec_registry_hash = doc["hash"]
+        self.raft.append("ec_registry", doc)
+
+    def _replicate_alert_state(self) -> None:
+        """Telemetry-loop cadence: replicate the alert engine's state
+        machines when they changed, so a promoted follower resumes
+        firing/pending alerts instead of re-learning them from scratch
+        (they would otherwise re-run their full for_s pending windows
+        mid-incident)."""
+        if not self.raft.peers or not self.raft.is_leader:
+            return
+        doc = self.alert_engine.export_state()
+        import hashlib
+        import json as _json
+
+        h = hashlib.sha1(_json.dumps(doc, sort_keys=True)
+                         .encode()).hexdigest()
+        if h == self._alert_state_hash:
+            return
+        self._alert_state_hash = h  # weedlint: disable=W502 single-writer: only the telemetry loop replicates alert state
+        self.raft.append("alert", {"alerts": doc})
+
+    def _on_role_change(self, role: str) -> None:
+        """Raft role transition hook (runs OUTSIDE the raft lock).
+        Demotion pauses the leader-only singletons implicitly — every
+        loop (telemetry, coordinator, vacuum, maintenance) gates on
+        is_leader per tick.  Promotion resumes them FROM REPLICATED
+        STATE: the coordinator re-arms planned-but-unfinished repairs
+        with their original cause attribution and the alert engine
+        carries its imported transitions forward."""
+        self.metrics.leader_gauge.set(1 if role == "leader" else 0)
+        if role == "leader":
+            try:
+                self.coordinator.resume_replicated()
+            except Exception:
+                pass
 
     @property
     def is_leader(self) -> bool:
@@ -357,9 +536,11 @@ class MasterServer:
                 self.guard.signing_key, self.guard.expires_after_sec, fid)
         return result
 
-    def _commit_volume_ids(self) -> None:
+    def _commit_volume_ids(self) -> None:  # leader-only
         """Quorum-replicate MaxVolumeId BEFORE acking an allocation
-        (raft log commit in the reference)."""
+        (raft log commit in the reference).  Reached only from
+        _require_leader-gated handlers; commit_state itself fails
+        closed on a follower (returns False -> 500 here)."""
         if not self.raft.commit_state():
             raise HttpError(500, "cannot replicate volume id allocation "
                             "to a quorum; retry")
@@ -445,6 +626,7 @@ class MasterServer:
             try:
                 self.aggregator.scrape(force=True, include_scrub=True)
                 self.alert_engine.evaluate(force=True)
+                self._replicate_alert_state()
             except Exception:
                 pass  # keep evaluating; rules carry their own errors
 
@@ -547,15 +729,34 @@ class MasterServer:
         @r.route("POST", "/raft/vote")
         def raft_vote(req: Request) -> Response:
             b = req.json()
-            return Response(self.raft.handle_vote(int(b["term"]),
-                                                  b["candidate"],
-                                                  b.get("state")))
+            return Response(self.raft.handle_vote(
+                int(b["term"]), b["candidate"], b.get("state"),
+                last_index=b.get("last_index"),
+                last_term=b.get("last_term")))
 
         @r.route("POST", "/raft/append")
         def raft_append(req: Request) -> Response:
             b = req.json()
-            r_ = self.raft.handle_append(int(b["term"]), b["leader"],
-                                         b.get("state") or {})
+            r_ = self.raft.handle_append(
+                int(b["term"]), b["leader"], b.get("state") or {},
+                prev_index=b.get("prev_index"),
+                prev_term=int(b.get("prev_term") or 0),
+                entries=b.get("entries"), commit=b.get("commit"))
+            self.metrics.leader_gauge.set(1 if self.raft.is_leader else 0)
+            return Response(r_)
+
+        @r.route("POST", "/raft/snapshot")
+        def raft_snapshot(req: Request) -> Response:
+            """InstallSnapshot: a restarted or long-partitioned master
+            whose needed log entries were compacted away receives the
+            leader's full control-plane image + the entry tail."""
+            b = req.json()
+            r_ = self.raft.handle_snapshot(
+                int(b["term"]), b["leader"],
+                int(b.get("last_index") or 0),
+                int(b.get("last_term") or 0),
+                b.get("state") or {}, entries=b.get("entries"),
+                commit=b.get("commit"))
             self.metrics.leader_gauge.set(1 if self.raft.is_leader else 0)
             return Response(r_)
 
@@ -630,10 +831,21 @@ class MasterServer:
 
         @r.route("GET", "/cluster/status")
         def cluster_status(req: Request) -> Response:
+            st = self.raft.status()
             return Response({"IsLeader": self.is_leader,
                              "Leader": self.leader_url,
                              "Peers": self.raft.peers,
-                             "Term": self.raft.term})
+                             "Term": st["term"],
+                             "Role": st["role"],
+                             "CommitIndex": st["commit_index"],
+                             "LastApplied": st["last_applied"],
+                             "LogLength": st["log_length"],
+                             "LogFirstIndex": st["log_first_index"],
+                             "LastIndex": st["last_index"],
+                             "SnapshotIndex": st["snapshot_index"],
+                             "SnapshotsInstalled":
+                                 st["snapshots_installed"],
+                             "SnapshotsSent": st["snapshots_sent"]})
 
         @r.route("GET", "/cluster/metrics")
         def cluster_metrics(req: Request) -> Response:
@@ -737,7 +949,10 @@ class MasterServer:
             b = req.json()
             accepted = self.event_journal.ingest(
                 str(b.get("server") or ""), b.get("events") or [])
-            return Response({"accepted": accepted})
+            # the leader hint teaches LeaderFollowingTransport callers
+            # the direct address (a follower-proxied batch still pays
+            # the extra hop only once)
+            return Response({"accepted": accepted, "leader": self.url})
 
         @r.route("GET", "/cluster/workload")
         def cluster_workload(req: Request) -> Response:
@@ -797,7 +1012,7 @@ class MasterServer:
             b = req.json()
             accepted = self.workload_journal.ingest(
                 str(b.get("server") or ""), b.get("records") or [])
-            return Response({"accepted": accepted})
+            return Response({"accepted": accepted, "leader": self.url})
 
         @r.route("POST", "/cluster/heat/ingest")
         def cluster_heat_ingest(req: Request) -> Response:
@@ -815,7 +1030,7 @@ class MasterServer:
             b = req.json()
             accepted = self.heat_journal.ingest(
                 str(b.get("server") or ""), b.get("snapshots") or [])
-            return Response({"accepted": accepted})
+            return Response({"accepted": accepted, "leader": self.url})
 
         @r.route("GET", "/cluster/heat")
         def cluster_heat(req: Request) -> Response:
@@ -873,7 +1088,7 @@ class MasterServer:
             accepted = self.trace_collector.ingest(
                 str(b.get("server") or ""), b.get("spans") or [],
                 lost=b.get("lost") or {})
-            return Response({"accepted": accepted})
+            return Response({"accepted": accepted, "leader": self.url})
 
         @r.route("GET", "/cluster/traces")
         def cluster_traces_index(req: Request) -> Response:
@@ -928,7 +1143,11 @@ class MasterServer:
             self._require_leader(req)
             since = qint(req.query, "since_seq", 0)
             timeout = min(qfloat(req.query, "timeout", 14.0), 55.0)
-            return Response(self.topo.watch_locations(since, timeout))
+            doc = self.topo.watch_locations(since, timeout)
+            # stamp the answering leader so a client that reached us
+            # through a follower 307 learns where to poll directly
+            doc["leader"] = self.url
+            return Response(doc)
 
         @r.route("GET", "/metrics")
         def metrics(req: Request) -> Response:
@@ -975,6 +1194,8 @@ class MasterServer:
                                for v in hb.get("new_volumes", [])), default=0)
                 if max_key:
                     self.seq.set_max(max_key)
+                if hb.get("new_ec_shards") or hb.get("deleted_ec_shards"):
+                    self._replicate_ec_registry()
                 return Response({
                     "volumeSizeLimit": self.topo.volume_size_limit,
                     "leader": self.url})
@@ -991,6 +1212,8 @@ class MasterServer:
                 for e in hb.get("ec_shards", [])
             ]
             self.topo.sync_node_ec_shards(node, ec_infos)
+            if ec_infos:
+                self._replicate_ec_registry()
             # re-seed the key sequencer from the largest needle key seen, so
             # a master restart never re-issues existing keys (data loss)
             max_key = max((int(v.get("max_file_key", 0))

@@ -285,6 +285,53 @@ class AlertEngine:  # weedlint: concurrent-class
                     pass
         return doc
 
+    # --- replication (master HA) ------------------------------------------
+    def export_state(self) -> dict:
+        """The per-rule state machines as a plain replicable document
+        (what the leader appends as `alert` raft-log entries): every
+        field a promoted follower needs to CONTINUE a firing alert —
+        pending windows, fire timestamps, exemplar traces — rather
+        than re-learn it from scratch mid-incident.  Evaluation
+        internals (counter baselines, burn-rate history) stay local:
+        a new leader re-seeds them from its own first scrape."""
+        with self._lock:
+            return {name: {
+                "state": st.state,
+                "pending_since": st.pending_since,
+                "fired_at": st.fired_at,
+                "resolved_at": st.resolved_at,
+                "last_active": st.last_active,
+                "value": st.value,
+                "detail": st.detail,
+                "servers": list(st.servers),
+                "fires": st.fires,
+                "bundles": list(st.bundles),
+                "exemplar_trace": st.exemplar_trace,
+            } for name, st in self._states.items()}
+
+    def import_state(self, doc: dict) -> None:  # raft-apply
+        """Replay a replicated alert-state document into the local
+        state machines (follower apply-loop / snapshot install).
+        Unknown rule names are skipped — rule tables are configuration,
+        not replicated state.  Idempotent: applying the same document
+        twice is a no-op."""
+        with self._lock:
+            for name, d in (doc or {}).items():
+                st = self._states.get(name)
+                if st is None or not isinstance(d, dict):
+                    continue
+                st.state = str(d.get("state") or "inactive")
+                st.pending_since = float(d.get("pending_since") or 0.0)
+                st.fired_at = float(d.get("fired_at") or 0.0)
+                st.resolved_at = float(d.get("resolved_at") or 0.0)
+                st.last_active = float(d.get("last_active") or 0.0)
+                st.value = float(d.get("value") or 0.0)
+                st.detail = str(d.get("detail") or "")
+                st.servers = [str(s) for s in (d.get("servers") or [])]
+                st.fires = int(d.get("fires") or 0)
+                st.bundles = list(d.get("bundles") or [])
+                st.exemplar_trace = str(d.get("exemplar_trace") or "")
+
     def _transition(self, rule: Rule, active: bool, value: float,  # holds: _lock
                     detail: str, servers: list[str], now: float):
         """Advance one rule's state machine; returns (rule, state_doc,

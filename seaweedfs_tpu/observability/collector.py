@@ -87,8 +87,11 @@ class TraceShipper:
         # server's lifecycle thread before the flush thread starts /
         # after it stops; read lock-free on every recorded span
         self._prev_hook: Optional[Callable[[Span], None]] = None
-        # rotates through master_url_fn candidates
-        self._master_i = 0  # guarded-by: _lock
+        # shared leader-follow policy: candidate rotation + learned
+        # leader hint (utils/leader.py) — internally locked
+        from ..utils.leader import LeaderFollowingTransport
+        self.transport = LeaderFollowingTransport(master_url_fn,
+                                                  name=f"traces:{server}")
         self.shipped = 0  # guarded-by: _lock
         self.dropped = 0  # guarded-by: _lock
 
@@ -167,40 +170,30 @@ class TraceShipper:
             with self._lock:
                 self.shipped += len(docs)
             return
-        urls = [u.strip()
-                for u in (self.master_url_fn() or "").split(",")
-                if u.strip()] if self.master_url_fn else []
-        from ..utils.httpd import http_json
-
-        with self._lock:
-            master_i = self._master_i
         try:
-            if not urls:
-                raise ConnectionError("no master url to ship to")
-            master = urls[master_i % len(urls)]
             # explicit negative decision: the ship POST must not be
             # sampled downstream (it would ship spans about shipping
             # spans, forever)
             with _trace_context.scope(_trace_context.NOT_SAMPLED):
-                http_json("POST", f"http://{master}/cluster/traces/ingest",
-                          {"server": self.server, "spans": docs,
-                           "lost": lost},
-                          timeout=timeout)
+                self.transport.post("/cluster/traces/ingest",
+                                    {"server": self.server, "spans": docs,
+                                     "lost": lost},
+                                    timeout=timeout)
             with self._lock:
                 self.shipped += len(docs)
         except Exception:
             # master down / not yet elected: the batch is LOST and
             # counted — and remembered per trace id, so when the master
             # is reachable again the affected stitched traces are marked
-            # truncated instead of silently reading complete.  Next
-            # flush tries the next configured master (followers forward
-            # to the leader, so any live one works).
+            # truncated instead of silently reading complete.  The
+            # transport rotated to the next configured master (followers
+            # forward to the leader, so any live one works) and learns
+            # the leader address from ingest replies after an election.
             if docs:
                 _dropped_counter().inc("ship_error", amount=len(docs))
             # counter updates ride _lock: the flush thread and the
             # detach()-time final flush race these read-modify-writes
             with self._lock:
-                self._master_i += 1
                 self.dropped += len(docs)
                 for d in docs:
                     self._note_lost_locked(d.get("trace"))

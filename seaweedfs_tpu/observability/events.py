@@ -349,7 +349,10 @@ class EventShipper:
         # server's lifecycle thread before the flush thread starts /
         # after it stops; read lock-free on every emit
         self._prev_hook: Optional[Callable[[Event], None]] = None
-        self._master_i = 0  # guarded-by: _lock
+        # shared leader-follow policy (utils/leader.py) — internally locked
+        from ..utils.leader import LeaderFollowingTransport
+        self.transport = LeaderFollowingTransport(master_url_fn,
+                                                  name=f"events:{server}")
         self.shipped = 0  # guarded-by: _lock
         self.dropped = 0  # guarded-by: _lock
 
@@ -413,32 +416,21 @@ class EventShipper:
             with self._lock:
                 self.shipped += len(docs)
             return
-        urls = [u.strip()
-                for u in (self.master_url_fn() or "").split(",")
-                if u.strip()] if self.master_url_fn else []
-        from ..utils.httpd import http_json
-
-        with self._lock:
-            master_i = self._master_i
         try:
-            if not urls:
-                raise ConnectionError("no master url to ship to")
-            master = urls[master_i % len(urls)]
             # shipping must never trace itself (same rule as spans)
             with _trace_context.scope(_trace_context.NOT_SAMPLED):
-                http_json("POST",
-                          f"http://{master}/cluster/events/ingest",
-                          {"server": self.server, "events": docs},
-                          timeout=timeout)
+                self.transport.post("/cluster/events/ingest",
+                                    {"server": self.server, "events": docs},
+                                    timeout=timeout)
             with self._lock:
                 self.shipped += len(docs)
         except Exception:
             # master down / not elected: the batch is LOST and counted;
-            # the next flush rotates to the next configured master.
+            # the transport rotated to the next configured master and
+            # re-learns the leader from ingest replies post-election.
             # Counter updates ride _lock: the flush thread and the
             # detach()-time final flush race these read-modify-writes
             with self._lock:
-                self._master_i += 1
                 self.dropped += len(docs)
 
 

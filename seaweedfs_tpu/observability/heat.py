@@ -418,7 +418,10 @@ class HeatShipper:
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
-        self._master_i = 0  # guarded-by: _lock
+        # shared leader-follow policy (utils/leader.py) — internally locked
+        from ..utils.leader import LeaderFollowingTransport
+        self.transport = LeaderFollowingTransport(master_url_fn,
+                                                  name=f"heat:{server}")
         self.shipped = 0  # guarded-by: _lock
         self.dropped = 0  # guarded-by: _lock
 
@@ -470,30 +473,20 @@ class HeatShipper:
             with self._lock:
                 self.shipped += len(batch)
             return
-        urls = [u.strip()
-                for u in (self.master_url_fn() or "").split(",")
-                if u.strip()] if self.master_url_fn else []
-        from ..utils.httpd import http_json
-
-        with self._lock:
-            master_i = self._master_i
         try:
-            if not urls:
-                raise ConnectionError("no master url to ship to")
-            master = urls[master_i % len(urls)]
             # telemetry must never trace itself (same rule as spans)
             with _trace_context.scope(_trace_context.NOT_SAMPLED):
-                http_json("POST",
-                          f"http://{master}/cluster/heat/ingest",
-                          {"server": self.server, "snapshots": batch},
-                          timeout=timeout)
+                self.transport.post("/cluster/heat/ingest",
+                                    {"server": self.server,
+                                     "snapshots": batch},
+                                    timeout=timeout)
             with self._lock:
                 self.shipped += len(batch)
         except Exception:
             # master down / not elected: stale heat is worthless — the
-            # batch is LOST and counted; rotate to the next master
+            # batch is LOST and counted; the transport rotated to the
+            # next master and re-learns the leader from ingest replies
             with self._lock:
-                self._master_i += 1
                 self.dropped += len(batch)
                 self._count_drop()
 

@@ -449,9 +449,13 @@ class WorkloadJournal:  # weedlint: concurrent-class
         self._records: "OrderedDict[str, dict]" = OrderedDict()  # guarded-by: _lock
         self._lock = threading.Lock()
         self.dropped = 0  # guarded-by: _lock
+        # consumer hook (same contract as ClusterEventJournal): called
+        # OUTSIDE the lock with each batch of newly-accepted records —
+        # the master's raft replication chokepoint subscribes here
+        self.on_ingest: Optional[Callable[[list[dict]], None]] = None
 
     def ingest(self, server: str, records: list[dict]) -> int:
-        accepted = 0
+        accepted: list[dict] = []
         with self._lock:
             for r in records:
                 rid = r.get("id")
@@ -460,12 +464,18 @@ class WorkloadJournal:  # weedlint: concurrent-class
                 r = dict(r)
                 r["via"] = server
                 self._records[rid] = r
-                accepted += 1
+                accepted.append(r)
             while len(self._records) > self.capacity:
                 self._records.popitem(last=False)
                 self.dropped += 1
                 _dropped_counter().inc("journal_evict")
-        return accepted
+        hook = self.on_ingest
+        if hook is not None and accepted:
+            try:
+                hook(list(accepted))
+            except Exception:
+                pass  # a broken consumer must never break ingest
+        return len(accepted)
 
     def query(self, route: Optional[str] = None, server: Optional[str] = None,
               since_ts: float = 0.0, limit: int = 512) -> list[dict]:
@@ -533,7 +543,10 @@ class ReqlogShipper:
         # server's lifecycle thread before the flush thread starts /
         # after it stops; read lock-free on every record
         self._prev_hook: Optional[Callable[[AccessRecord], None]] = None
-        self._master_i = 0  # guarded-by: _lock
+        # shared leader-follow policy (utils/leader.py) — internally locked
+        from ..utils.leader import LeaderFollowingTransport
+        self.transport = LeaderFollowingTransport(master_url_fn,
+                                                  name=f"workload:{server}")
         self.shipped = 0  # guarded-by: _lock
         self.dropped = 0  # guarded-by: _lock
 
@@ -591,35 +604,24 @@ class ReqlogShipper:
             with self._lock:
                 self.shipped += len(docs)
             return
-        urls = [u.strip()
-                for u in (self.master_url_fn() or "").split(",")
-                if u.strip()] if self.master_url_fn else []
-        from ..utils.httpd import http_json
-
-        with self._lock:
-            master_i = self._master_i
         try:
-            if not urls:
-                raise ConnectionError("no master url to ship to")
-            master = urls[master_i % len(urls)]
             # shipping must never trace (or record) itself: the POST
             # runs NOT_SAMPLED, and its ingress on the master classifies
             # as `ops` which the recorder skips by default
             with _trace_context.scope(_trace_context.NOT_SAMPLED):
-                http_json("POST",
-                          f"http://{master}/cluster/workload/ingest",
-                          {"server": self.server, "records": docs},
-                          timeout=timeout)
+                self.transport.post("/cluster/workload/ingest",
+                                    {"server": self.server, "records": docs},
+                                    timeout=timeout)
             with self._lock:
                 self.shipped += len(docs)
         except Exception:
             # master down / not elected: the batch is LOST and counted;
-            # the next flush rotates to the next configured master.
+            # the transport rotated to the next configured master and
+            # re-learns the leader from ingest replies post-election.
             # Counter updates ride _lock: the flush thread and the
             # detach()-time final flush race these read-modify-writes
             _dropped_counter().inc("ship_error", amount=len(docs))
             with self._lock:
-                self._master_i += 1
                 self.dropped += len(docs)
 
 

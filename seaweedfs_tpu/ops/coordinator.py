@@ -44,7 +44,7 @@ from __future__ import annotations
 
 import threading
 import time
-from collections import deque
+from collections import OrderedDict, deque
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
@@ -671,7 +671,8 @@ class EcCoordinator:  # weedlint: concurrent-class
                  max_repairs_per_cycle: int = 4,
                  post_fn: Optional[Callable] = None,
                  engine: Optional[str] = None,
-                 repair_deadline_s: float = 900.0):
+                 repair_deadline_s: float = 900.0,
+                 replicate_fn: Optional[Callable[[dict], None]] = None):
         self.topo = topo
         self.server = server
         self.stale_peers_fn = stale_peers_fn or (lambda: [])
@@ -717,6 +718,18 @@ class EcCoordinator:  # weedlint: concurrent-class
         # token-bucket move budget
         self._tokens = float(move_burst)  # guarded-by: _lock
         self._tokens_at = time.monotonic()  # guarded-by: _lock
+        # --- replicated repair records (master HA) -----------------
+        # plan/done/failed records replicate through the raft log
+        # (replicate_fn -> leader append; followers land in
+        # apply_replicated): a leader killed mid-repair leaves its
+        # planned record on a quorum, and resume_replicated() on the
+        # NEW leader re-arms the orphaned repair with the ORIGINAL
+        # alert/trace cause attribution.
+        self.replicate_fn = replicate_fn
+        # vid -> latest unfinished record (planned / failed)
+        self._replicated: dict[int, dict] = {}  # guarded-by: _lock
+        # ordered record history, dedup'd by record id
+        self._replog: "OrderedDict[str, dict]" = OrderedDict()  # guarded-by: _lock
 
     # --- lifecycle --------------------------------------------------------
     def start(self) -> "EcCoordinator":
@@ -778,6 +791,80 @@ class EcCoordinator:  # weedlint: concurrent-class
                     wake = True
         if wake:
             self._wake.set()
+
+    # --- replicated repair records (master HA) ----------------------------
+    def _record(self, op: str, vid: int, entry: dict,  # leader-only
+                **extra) -> None:
+        """Journal one repair lifecycle record: apply it to the local
+        replicated view, then hand it to replicate_fn (the master's
+        synchronous raft append) so it survives this leader.  Called
+        OUTSIDE _lock — replication does quorum HTTP."""
+        at = round(time.time(), 3)
+        rec = {"id": f"{vid}:{op}:{at:.3f}", "op": op, "vid": vid,
+               "at": at, "alert": entry.get("alert", ""),
+               "cause_trace": entry.get("cause_trace", ""),
+               "cause_event": entry.get("cause_event", ""), **extra}
+        self.apply_replicated(rec)
+        if self.replicate_fn is not None:
+            try:
+                self.replicate_fn(rec)
+            except Exception:
+                pass  # replication loss must never fail the repair
+
+    def apply_replicated(self, rec: dict) -> None:  # raft-apply, thread-entry
+        """Land one plan/done/failed record (leader's local write or a
+        follower's apply-loop).  Idempotent: records dedup by id and
+        the pending map is last-write-wins per volume."""
+        try:
+            vid = int(rec.get("vid"))
+        except (TypeError, ValueError):
+            return
+        op = str(rec.get("op") or "")
+        with self._lock:
+            rid = str(rec.get("id") or f"{vid}:{op}:{rec.get('at')}")
+            self._replog[rid] = dict(rec)
+            while len(self._replog) > 256:
+                self._replog.popitem(last=False)
+            if op == "done":
+                self._replicated.pop(vid, None)
+            elif op in ("planned", "failed"):
+                self._replicated[vid] = dict(rec)
+
+    def export_replicated(self) -> dict:
+        """The replicable repair-record state (raft snapshot leg)."""
+        with self._lock:
+            return {"pending": {str(vid): dict(r)
+                                for vid, r in self._replicated.items()},
+                    "log": [dict(r) for r in self._replog.values()]}
+
+    def import_replicated(self, doc: dict) -> None:  # raft-apply
+        """Install a snapshot of the repair-record state (idempotent:
+        replays merge by record id / volume id)."""
+        for rec in (doc or {}).get("log") or []:
+            self.apply_replicated(rec)
+        with self._lock:
+            for vid_s, rec in ((doc or {}).get("pending") or {}).items():
+                try:
+                    self._replicated[int(vid_s)] = dict(rec)
+                except (TypeError, ValueError):
+                    continue
+
+    def resume_replicated(self) -> None:
+        """Promotion hook: re-arm every planned-but-unfinished repair
+        from the replicated records — the orphaned repair's cause
+        attribution (alert + trace + event) survives the election, so
+        the new leader's repair_planned/repair_done events carry the
+        ORIGINAL why, not a blank one.  The deficits themselves
+        re-derive from volume-server heartbeats; this seeds the cause
+        map and wakes the planner early."""
+        with self._lock:
+            for vid, rec in self._replicated.items():
+                self._causes.setdefault(vid, {
+                    "event": rec.get("cause_event", ""),
+                    "type": "replicated_plan",
+                    "trace": rec.get("cause_trace", ""),
+                    "alert": rec.get("alert", "")})
+        self._wake.set()
 
     # --- the loop ---------------------------------------------------------
     def _loop(self) -> None:
@@ -956,12 +1043,25 @@ class EcCoordinator:  # weedlint: concurrent-class
                         self._queue.pop(vid, None)
                         self._causes.pop(vid, None)
                         self._under_notified.discard(vid)
+                        stale_plan = vid in self._replicated
+                    if stale_plan:
+                        # an inherited planned record for a volume that
+                        # healed: close it out so followers stop
+                        # carrying it as pending
+                        self._record("done", vid, entry, host="",
+                                     rebuilt=[], healed_elsewhere=True)
                     return True
                 _events.emit("repair_planned", server=self.server
                              or None, vid=vid,
                              deficit=entry.get("deficit", 0),
                              critical=entry.get("critical", False),
                              **cause)
+                # quorum-replicate the plan BEFORE executing: a leader
+                # killed mid-repair leaves this record for its
+                # successor to re-plan from (with the cause intact)
+                self._record("planned", vid, entry,
+                             deficit=entry.get("deficit", 0),
+                             critical=entry.get("critical", False))
                 try:
                     # ONE deadline for the whole repair: every leg
                     # (copies, rebuild, mounts, spread, re-scrub)
@@ -990,6 +1090,8 @@ class EcCoordinator:  # weedlint: concurrent-class
                                  or None, vid=vid,
                                  error=f"{type(e).__name__}: {e}"[:200],
                                  **cause)
+                    self._record("failed", vid, entry,
+                                 error=f"{type(e).__name__}: {e}"[:200])
                     return False
                 if not res["host"] and not res["rebuilt"]:
                     # healed between queueing and execution (another
@@ -999,6 +1101,8 @@ class EcCoordinator:  # weedlint: concurrent-class
                         self._queue.pop(vid, None)
                         self._causes.pop(vid, None)
                         self._under_notified.discard(vid)
+                    self._record("done", vid, entry, host="",
+                                 rebuilt=[], healed_elsewhere=True)
                     return True
                 # post-repair targeted re-scrub (best-effort, its own
                 # slice of the repair deadline): holders re-verify the
@@ -1032,6 +1136,8 @@ class EcCoordinator:  # weedlint: concurrent-class
                              move_errors=res.get("move_errors") or [],
                              rescrubbed=rescrubbed,
                              **cause)
+                self._record("done", vid, entry, host=res["host"],
+                             rebuilt=res["rebuilt"])
                 return True
         finally:
             _trace_context.swap_server(prev_srv)
@@ -1162,6 +1268,13 @@ class EcCoordinator:  # weedlint: concurrent-class
                                 "burst": self.move_burst,
                                 "tokens": round(self._tokens, 2)},
                 "recent": list(self.recent),
+                # the raft-replicated repair records: identical on the
+                # leader and a caught-up follower (the state-hash
+                # equality surface tests compare)
+                "replicated": {
+                    "pending": {str(v): dict(r)
+                                for v, r in self._replicated.items()},
+                    "log": [dict(r) for r in self._replog.values()]},
             }
         return doc
 

@@ -35,17 +35,19 @@ Two more entry points close the loop with the observability stack:
 
 from .capacity import CapacitySLO, find_capacity, measure_rate
 from .engine import run_against, run_scenario
+from .failover import run_failover
 from .replay import (recording_profile, replay_fidelity,
                      spec_from_recording)
 from .spec import (FaultSpec, ScenarioSpec, default_scenarios,
-                   failure_under_load, flash_crowd, read_storm,
-                   write_churn)
+                   failure_under_load, flash_crowd, master_failover,
+                   read_storm, write_churn)
 from .workload import SizeSampler, ZipfSampler
 
 __all__ = [
     "FaultSpec", "ScenarioSpec", "default_scenarios", "run_scenario",
-    "run_against",
+    "run_against", "run_failover",
     "read_storm", "write_churn", "failure_under_load", "flash_crowd",
+    "master_failover",
     "ZipfSampler", "SizeSampler",
     "spec_from_recording", "recording_profile", "replay_fidelity",
     "CapacitySLO", "find_capacity", "measure_rate",

@@ -37,6 +37,7 @@ class ScenarioSpec:
     duration_s: float = 12.0
     clients: int = 8
     n_volume_servers: int = 1
+    n_masters: int = 1                # >1: HA quorum (failover drills)
     read_fraction: float = 1.0        # remainder is writes (incl. churn)
     churn_fraction: float = 0.0       # fraction of WRITE ops that delete
     submit_fraction: float = 0.0      # fraction of writes via master /submit
@@ -147,6 +148,25 @@ def flash_crowd(duration_s: float = 14.0) -> ScenarioSpec:
                       "deadline_overrun_max_ms": 250.0,
                       "alert_fired_any": ["heat_shift", "flash_crowd"],
                       "heat_alert_within_s": 5.0})
+
+
+def master_failover(duration_s: float = 16.0) -> ScenarioSpec:
+    """The control-plane HA proof (master/consensus.py raft log +
+    scenarios/failover.py runner): a 3-master quorum under a write
+    storm loses its leader mid EC repair.  The verdict demands a new
+    leader within the election budget, /dir/assign serving again
+    inside one client deadline, ZERO loss of pre-kill journaled events
+    (the raft-replicated journal contract), and the orphaned repair
+    re-planned by the new leader with its original alert/trace cause
+    attribution intact."""
+    return ScenarioSpec(
+        name="master_failover", duration_s=duration_s, clients=6,
+        n_masters=3, n_volume_servers=4, read_fraction=0.0,
+        zipf_s=1.0, hot_set=48, deadline_s=3.0,
+        expectations={"election_max_s": 8.0,
+                      "journal_loss_max": 0,
+                      "assign_after_kill_max_s": 5.0,
+                      "repair_replan_max_s": 45.0})
 
 
 def default_scenarios() -> list[ScenarioSpec]:

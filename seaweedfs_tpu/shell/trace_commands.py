@@ -3,6 +3,7 @@
     trace.analyze -server host:port       # analyze a live server's ring
     trace.analyze -file trace.json        # analyze a saved trace offline
     cluster.health                        # per-volume-server health rollup
+    cluster.raft                          # per-master raft quorum view
 
 trace.analyze turns a span ring into the attribution report
 (observability/analysis.py): stage occupancy, the critical-path stage,
@@ -17,7 +18,7 @@ from __future__ import annotations
 import json
 import time
 
-from ..utils.httpd import http_bytes
+from ..utils.httpd import http_bytes, http_json
 from .commands import CommandEnv, command
 
 
@@ -89,6 +90,68 @@ def cmd_trace_fetch(env: CommandEnv, flags: dict) -> str:
     return render_cluster_report(doc["analysis"]).rstrip("\n")
 
 
+def _raft_views(env: CommandEnv) -> dict[str, dict]:
+    """/cluster/status from every reachable master: the configured
+    candidates (env.master_url may be a comma-separated HA list) plus
+    every peer any of them names.  Unreachable masters stay in the map
+    with an `error` key — a failover drill wants to SEE the dead one."""
+    queue = [u for u in env.master_url.split(",") if u]
+    views: dict[str, dict] = {}
+    while queue:
+        url = queue.pop(0)
+        if url in views:
+            continue
+        try:
+            views[url] = http_json(
+                "GET", f"http://{url}/cluster/status", timeout=5.0)
+        except Exception as e:  # dead master: report, keep walking
+            views[url] = {"error": str(e) or e.__class__.__name__}
+            continue
+        for p in views[url].get("Peers", []):
+            if p not in views and p not in queue:
+                queue.append(p)
+    return views
+
+
+def _quorum_line(views: dict[str, dict]) -> str:
+    """`masters: 3 (leader host:port, term 7)` — the one-line quorum
+    summary cluster.health and cluster.raft share."""
+    up = {u: v for u, v in views.items() if "error" not in v}
+    leaders = sorted({v.get("Leader") or "" for v in up.values()} - {""})
+    term = max((int(v.get("Term") or 0) for v in up.values()), default=0)
+    leader = leaders[0] if len(leaders) == 1 else \
+        (f"DISPUTED {'/'.join(leaders)}" if leaders else "none")
+    line = f"masters: {len(views)} (leader {leader}, term {term})"
+    down = sorted(u for u, v in views.items() if "error" in v)
+    if down:
+        line += f"  down: {', '.join(down)}"
+    return line
+
+
+@command("cluster.raft")
+def cmd_cluster_raft(env: CommandEnv, flags: dict) -> str:
+    """cluster.raft [-json]  # per-master raft view: role, term, known
+    leader, commit/applied indexes, log span, snapshot transfers —
+    the quorum's replication progress at a glance"""
+    views = _raft_views(env)
+    if flags.get("json") == "true":
+        return json.dumps({"masters": views}, indent=2)
+    lines = [_quorum_line(views)]
+    for url, v in sorted(views.items()):
+        if "error" in v:
+            lines.append(f"  {url}: unreachable ({v['error']})")
+            continue
+        lines.append(
+            f"  {url}: {v.get('Role', '?')} term={v.get('Term', 0)} "
+            f"commit={v.get('CommitIndex', 0)} "
+            f"applied={v.get('LastApplied', 0)} "
+            f"log[{v.get('LogFirstIndex', 1)}..{v.get('LastIndex', 0)}] "
+            f"snap@{v.get('SnapshotIndex', 0)} "
+            f"installed={v.get('SnapshotsInstalled', 0)} "
+            f"sent={v.get('SnapshotsSent', 0)}")
+    return "\n".join(lines)
+
+
 @command("cluster.health")
 def cmd_cluster_health(env: CommandEnv, flags: dict) -> str:
     """cluster.health [-json]  # master's per-volume-server telemetry
@@ -99,6 +162,12 @@ def cmd_cluster_health(env: CommandEnv, flags: dict) -> str:
     lines = [f"peers: {doc['peer_count']}  "
              f"degraded: {doc['degraded']}  "
              f"stale: {', '.join(doc['stale_peers']) or 'none'}"]
+    # one-line master-quorum rollup (best-effort: a single-master
+    # deployment has no peers and still renders `masters: 1`)
+    try:
+        lines.append(_quorum_line(_raft_views(env)))
+    except Exception:
+        pass
     # one-line alerting rollup (best-effort: an old master without the
     # engine must not break the health view)
     try:

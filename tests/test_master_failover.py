@@ -1,0 +1,298 @@
+"""Master HA acceptance: raft election-safety properties, snapshot
+catch-up with state-hash equality, and the live 3-master failover drill.
+
+Three layers, cheapest first:
+
+  * property-style unit tests drive RaftNode.handle_vote /
+    handle_append directly — term monotonicity, single-vote-per-term,
+    stale-term append rejection, the log up-to-dateness election
+    restriction, split-vote re-campaigning, and at-most-one-leader-
+    per-term under randomized vote traffic;
+  * a restarted third master whose needed entries were compacted away
+    catches up via InstallSnapshot and then serves an IDENTICAL
+    /cluster/events + /cluster/coordinator view (sha256 state-hash
+    equality over the journal and replicated repair records);
+  * the scenarios/failover.py drill kills the leader mid write-storm
+    and mid EC repair and machine-checks election time, zero journal
+    loss, post-failover assign latency, and re-planned repair cause
+    attribution (the spec's expectations -> verdict).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import random
+import time
+
+from seaweedfs_tpu.master.consensus import RaftNode
+from seaweedfs_tpu.master.server import MasterServer
+from seaweedfs_tpu.utils.httpd import http_json
+from tests.conftest import free_port
+from tests.test_consensus import _wait_one_leader
+
+
+# --- election-safety properties (no servers, direct RPC handlers) ---------
+
+def _voter(me: str = "127.0.0.1:9001",
+           peers: tuple = ("127.0.0.1:9002", "127.0.0.1:9003")) -> RaftNode:
+    return RaftNode(me, list(peers), read_state=lambda: {})
+
+
+def _entry(index: int, term: int) -> dict:
+    return {"index": index, "term": term, "kind": "event", "data": {}}
+
+
+class TestElectionSafety:
+    def test_term_monotonic_under_random_rpcs(self):
+        """A node's current term (and every response term) never
+        decreases, whatever interleaving of vote/append RPCs arrives."""
+        rng = random.Random(0x5AFE)
+        node = _voter()
+        prev = node.term
+        for _ in range(300):
+            term = rng.randrange(0, 40)
+            if rng.random() < 0.5:
+                r = node.handle_vote(
+                    term, rng.choice(["127.0.0.1:9002", "127.0.0.1:9003"]),
+                    None, rng.randrange(0, 4), rng.randrange(0, 4))
+            else:
+                r = node.handle_append(term, "127.0.0.1:9002", state=None,
+                                       prev_index=0, prev_term=0,
+                                       entries=[], commit=0)
+            assert r["term"] >= prev
+            assert node.term >= prev
+            assert r["term"] == node.term
+            prev = node.term
+
+    def test_single_vote_per_term(self):
+        node = _voter()
+        assert node.handle_vote(4, "127.0.0.1:9002")["granted"] is True
+        # same term, different candidate: denied (vote already cast)
+        assert node.handle_vote(4, "127.0.0.1:9003")["granted"] is False
+        # same term, same candidate (retransmitted request): re-granted
+        assert node.handle_vote(4, "127.0.0.1:9002")["granted"] is True
+        # stale term: denied outright, current term echoed back
+        r = node.handle_vote(3, "127.0.0.1:9003")
+        assert r["granted"] is False and r["term"] == 4
+
+    def test_stale_term_append_rejected(self):
+        node = _voter()
+        r = node.handle_append(5, "127.0.0.1:9002", prev_index=0,
+                               prev_term=0, entries=[_entry(1, 5)],
+                               commit=1)
+        assert r["ok"] is True
+        assert node.term == 5 and node.leader == "127.0.0.1:9002"
+        # a deposed leader's append from an older term must not mutate
+        # the log, the commit index, or the known-leader pointer
+        stale = node.handle_append(3, "127.0.0.1:9003", prev_index=1,
+                                   prev_term=3, entries=[_entry(2, 3)],
+                                   commit=2)
+        assert stale["ok"] is False and stale["term"] == 5
+        assert node.leader == "127.0.0.1:9002"
+        assert node.log.last_index == 1 and node.commit_index == 1
+
+    def test_vote_denied_to_candidate_with_stale_log(self):
+        """Raft's election restriction: the winner must hold every
+        committed entry, so votes compare (last_term, last_index)."""
+        node = _voter()
+        node.handle_append(2, "127.0.0.1:9002", prev_index=0, prev_term=0,
+                           entries=[_entry(1, 2), _entry(2, 2)], commit=2)
+        # older last term loses regardless of log length
+        assert node.handle_vote(5, "127.0.0.1:9003",
+                                None, 9, 1)["granted"] is False
+        # same last term but shorter log loses
+        assert node.handle_vote(6, "127.0.0.1:9003",
+                                None, 1, 2)["granted"] is False
+        # same last term, same length: at least as up-to-date, granted
+        assert node.handle_vote(7, "127.0.0.1:9003",
+                                None, 2, 2)["granted"] is True
+
+    def test_split_vote_recampaigns_with_fresh_term(self):
+        """A candidate that cannot assemble a quorum (peers down /
+        votes split) stays a candidate and re-campaigns under a NEW
+        term — it never declares itself leader on a partial tally."""
+        node = RaftNode("127.0.0.1:9201",
+                        [f"127.0.0.1:{free_port()}",
+                         f"127.0.0.1:{free_port()}"],
+                        read_state=lambda: {})
+        t0 = node.term
+        node._campaign()  # both peers unreachable: self-vote only
+        assert node.role == "candidate" and node.term == t0 + 1
+        node._campaign()
+        assert node.role == "candidate" and node.term == t0 + 2
+        assert node.voted_for == node.me
+
+    def test_at_most_one_leader_per_term_randomized(self):
+        """Randomized split-vote traffic over a 5-node electorate:
+        whenever a candidate assembles a quorum of grants for a term,
+        no other candidate can for the SAME term (vote stickiness +
+        term monotonicity make grant quorums exclusive)."""
+        rng = random.Random(0xE1EC7)
+        names = [f"127.0.0.1:{9100 + i}" for i in range(5)]
+        voters = {n: RaftNode(n, [p for p in names if p != n],
+                              read_state=lambda: {})
+                  for n in names}
+        quorum = len(names) // 2 + 1
+        winners: dict[int, set] = {}
+        for _ in range(400):
+            term = rng.randrange(1, 30)
+            cand = rng.choice(names)
+            granted = sum(
+                1 for v in voters.values()
+                if v.handle_vote(term, cand, None, 0, 0)["granted"])
+            if granted >= quorum:
+                winners.setdefault(term, set()).add(cand)
+        assert winners, "no term ever reached quorum — test is inert"
+        for term, who in winners.items():
+            assert len(who) == 1, \
+                f"two leaders elected in term {term}: {sorted(who)}"
+
+
+# --- snapshot catch-up: the state-hash equality contract ------------------
+
+def _view(m: MasterServer) -> dict:
+    """What /cluster/events + /cluster/coordinator serve, read off the
+    Python objects (the HTTP routes are leader-gated on followers)."""
+    return {"events": m.event_journal.query(limit=0),
+            "coordinator": m.coordinator.export_replicated()}
+
+
+def _state_hash(view: dict) -> str:
+    return hashlib.sha256(
+        json.dumps(view, sort_keys=True).encode()).hexdigest()
+
+
+def test_restarted_master_catches_up_via_snapshot(tmp_path):
+    """Stop one of three masters, push the replicated journal past the
+    compaction threshold so its needed entries no longer exist as log
+    entries, then restart it: the leader must bring it back with an
+    InstallSnapshot (snapshots_installed > 0) and its /cluster/events
+    + /cluster/coordinator views must be byte-identical to the
+    leader's (and to the never-restarted follower's)."""
+    ports = [free_port() for _ in range(3)]
+    urls = [f"127.0.0.1:{p}" for p in ports]
+    masters = []
+    for i, p in enumerate(ports):
+        m = MasterServer(port=p,
+                         peers=[u for j, u in enumerate(urls) if j != i],
+                         mdir=str(tmp_path / f"m{i}"), pulse_seconds=0.3)
+        m.raft.snapshot_threshold = 8  # compact early: drill scale
+        masters.append(m.start())
+    try:
+        leader = _wait_one_leader(masters)
+        followers = [m for m in masters if m is not leader]
+        # detach follower event shippers: with co-located masters every
+        # master's shipper short-circuits process events into its OWN
+        # journal (via=itself); detaching makes raft apply the ONLY
+        # fill path on followers, so the preserved `via` labels — and
+        # therefore the state hashes — can match exactly
+        for f in followers:
+            f._event_shipper.detach()
+        victim = followers[-1]
+        vi = masters.index(victim)
+        victim_last = victim.raft.log.last_index
+        victim.stop()
+        masters.remove(victim)
+
+        # journal traffic while the third master is down: one raft
+        # entry per batch, far past the snapshot threshold
+        want = {f"catchup-{i}" for i in range(40)}
+        for i in range(40):
+            http_json("POST",
+                      f"http://{leader.url}/cluster/events/ingest",
+                      {"server": "drill",
+                       "events": [{"id": f"catchup-{i}",
+                                   "type": "drill_marker",
+                                   "severity": "info", "server": "drill",
+                                   "ts": round(time.time(), 3),
+                                   "details": {"i": i}}]})
+        # and one replicated repair record (the coordinator leg)
+        rec = {"id": "77:planned:1.000", "op": "planned", "vid": 77,
+               "at": 1.0, "alert": "ec_under_replicated",
+               "cause_trace": "ab" * 16, "cause_event": "catchup-0"}
+        leader.coordinator.apply_replicated(rec)
+        leader._replicate_coordinator_record(rec)
+
+        deadline = time.time() + 20
+        while time.time() < deadline and \
+                leader.raft.log.base_index <= victim_last:
+            time.sleep(0.1)
+        assert leader.raft.log.base_index > victim_last, \
+            f"log never compacted past the stopped master " \
+            f"(base={leader.raft.log.base_index}, victim={victim_last})"
+
+        # restart on the SAME address + mdir (a rebooted process)
+        m3 = MasterServer(port=ports[vi],
+                          peers=[u for u in urls if u != urls[vi]],
+                          mdir=str(tmp_path / f"m{vi}"),
+                          pulse_seconds=0.3)
+        m3.raft.snapshot_threshold = 8
+        m3.start()
+        m3._event_shipper.detach()
+        masters.append(m3)
+
+        deadline = time.time() + 25
+        while time.time() < deadline:
+            ids = {e["id"] for e in m3.event_journal.query(limit=0)}
+            if m3.raft.snapshots_installed > 0 and want <= ids:
+                break
+            time.sleep(0.1)
+        assert m3.raft.snapshots_installed > 0, \
+            f"no InstallSnapshot received; raft={m3.raft.status()}"
+        ids = {e["id"] for e in m3.event_journal.query(limit=0)}
+        assert want <= ids, f"missing events: {sorted(want - ids)[:5]}"
+
+        # state-hash equality: all three masters serve the same views
+        leader_view = _view(leader)
+        for m in masters:
+            if m is leader:
+                continue
+            v = _view(m)
+            if _state_hash(v) != _state_hash(leader_view):
+                mine = {e["id"]: e for e in v["events"]}
+                theirs = {e["id"]: e for e in leader_view["events"]}
+                diff = [eid for eid in theirs
+                        if mine.get(eid) != theirs[eid]]
+                raise AssertionError(
+                    f"state hash mismatch on {m.url}: "
+                    f"missing/differing events {diff[:5]}, "
+                    f"extra {sorted(set(mine) - set(theirs))[:5]}, "
+                    f"coordinator mine={v['coordinator']} "
+                    f"theirs={leader_view['coordinator']}")
+        assert leader.coordinator.export_replicated()["pending"] \
+            .get("77", {}).get("cause_trace") == "ab" * 16
+
+        # the operator surface over the same facts: cluster.raft walks
+        # every master and cluster.health carries the quorum line
+        from seaweedfs_tpu.shell import CommandEnv, run_command
+
+        out = run_command(CommandEnv(",".join(urls)), "cluster.raft")
+        assert out.splitlines()[0].startswith("masters: 3 (leader ")
+        assert f"leader {leader.url}" in out
+        assert out.count("term=") == 3  # one row per master
+        assert "installed=1" in out  # m3's InstallSnapshot is visible
+        doc = json.loads(run_command(CommandEnv(urls[0]),
+                                     "cluster.raft -json"))
+        assert set(doc["masters"]) == set(urls)
+        health = run_command(CommandEnv(leader.url), "cluster.health")
+        assert f"masters: 3 (leader {leader.url}, term " in health
+    finally:
+        for m in masters:
+            m.stop()
+
+
+# --- the live failover drill (scenarios/failover.py) ----------------------
+
+def test_leader_failover_drill(tmp_path):
+    """Kill the raft leader of a 3-master quorum mid write-storm and
+    mid EC repair: a new leader takes over within the election budget,
+    /dir/assign serves again inside one client deadline, every
+    pre-kill journaled event survives (journal_loss_count == 0), and
+    the orphaned repair is re-planned by the new leader with its
+    ORIGINAL alert + trace cause attribution."""
+    from seaweedfs_tpu.scenarios import master_failover, run_failover
+
+    result = run_failover(master_failover(), base_dir=str(tmp_path))
+    assert result["verdict"] == "pass", \
+        json.dumps(result, indent=2, default=str)

@@ -53,6 +53,8 @@ from tools.weedlint.rules_routes import \
 from tools.weedlint.rules_bench import \
     check_source as check_bench_caps  # noqa: E402
 from tools.weedlint.rules_eventloop import check_eventloop  # noqa: E402
+from tools.weedlint.rules_leader import \
+    check_source as check_leader_gated  # noqa: E402
 from tools.weedlint.rules_timeouts import \
     check_source as check_timeouts  # noqa: E402
 
@@ -199,6 +201,28 @@ W901_BAD = (
     "def f(url):\n"
     "    return http_json('GET', url)\n")
 
+W902_CLEAN = (
+    "class M:\n"
+    "    def _apply(self, data):  # raft-apply\n"
+    "        self.coordinator.apply_replicated(data)\n"
+    "    def _replicate(self, doc):\n"
+    "        if not self.raft.is_leader:\n"
+    "            return\n"
+    "        self.raft.append('alert', doc)\n"
+    "    def _promote(self, role):\n"
+    "        if role == 'leader':\n"
+    "            self.coordinator.resume_replicated()\n"
+    "    def _journal(self, rec,  # leader-only\n"
+    "                 sync=False):\n"
+    "        self.replicate_fn(rec)\n"
+    "    def harmless(self, items):\n"
+    "        items.append(1)\n")  # list .append never matches
+W902_BAD = (
+    "class M:\n"
+    "    def handle(self, req):\n"
+    "        self.raft.append('event', {'events': []})\n"
+    "        self.alert_engine.import_state({})\n")
+
 W1001_CLEAN = (
     "SECTION_CAPS = {'alpha': 60, 'beta': 120}\n"
     "def run():\n"
@@ -234,6 +258,8 @@ CASES = [
      lambda src: check_resources(src, "t.py")),
     ("W901", W901_CLEAN, W901_BAD,
      lambda src: check_timeouts(src, "t.py")),
+    ("W902", W902_CLEAN, W902_BAD,
+     lambda src: check_leader_gated(src, "seaweedfs_tpu/master/t.py")),
     ("W1001", W1001_CLEAN, W1001_BAD,
      lambda src: check_bench_caps(src, "bench.py")),
 ]

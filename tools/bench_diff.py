@@ -83,6 +83,14 @@ KEY_METRICS: list[tuple] = [
     # the space-saving sketch must keep finding the Zipf head
     ("heat.accounting_overhead_pct", "down", 1.0),
     ("heat.sketch_head_recall", "up", 0.05),
+    # master HA failover drill (scenarios/failover.py): the raft
+    # journal contract is ZERO pre-kill events lost across an election
+    # (any increase is a regression — the 0.5 floor only absorbs float
+    # noise, not a lost event), and the election + repair re-plan
+    # latencies stay inside their drill budgets
+    ("master_failover.journal_loss_count", "down", 0.5),
+    ("master_failover.election_time_s", "down", 1.0),
+    ("master_failover.repair_replan_s", "down", 5.0),
 ]
 
 

@@ -1,0 +1,156 @@
+"""W902: replicated control-plane state mutates only on guarded paths.
+
+The master HA plane (master/consensus.py) replicates control state —
+journals, alert transitions, coordinator repair records, the EC
+registry, vid allocations — through a raft log.  That contract has two
+legal mutation contexts and NOTHING else:
+
+  - the LEADER, behind an ``is_leader`` check (a follower that appends
+    gets a silent ``False`` back and the data evaporates; a follower
+    that mutates a replicated state machine directly diverges from the
+    log and breaks the state-hash equality guarantee);
+  - the raft APPLY path, where followers re-drive committed entries
+    and snapshots through the same state machines.
+
+This rule makes the contract lexical.  In ``seaweedfs_tpu/master/``
+and ``seaweedfs_tpu/ops/``, every call to a replication-sensitive
+mutator —
+
+  - ``raft.append(...)`` / ``self.raft.append(...)`` (log append),
+  - ``commit_state()`` (the synchronous vid_alloc append),
+  - ``replicate_fn(...)`` (the coordinator's injected append),
+  - ``apply_replicated`` / ``import_replicated`` / ``import_state`` /
+    ``resume_replicated`` (the replicated state machines' write API)
+
+— must sit inside a function that satisfies one of:
+
+  - a ``# raft-apply`` marker on its def line(s): the follower apply
+    loop and its helpers (idempotent by contract);
+  - a lexical leader guard: any ``is_leader`` / ``is_leader_fn``
+    reference, or a comparison against the literal ``"leader"`` (the
+    role-change hook's shape);
+  - a ``# leader-only`` marker on its def line(s): functions reachable
+    only beneath the coordinator/telemetry loops, whose per-tick
+    ``is_leader_fn()`` gate this rule cannot see interprocedurally.
+
+Everything else is a finding: either the call site needs the guard, or
+the function needs the marker that DOCUMENTS why it is exempt.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from .engine import Finding, Repo, Rule, register
+
+# attribute/name calls that write replicated state
+MUTATORS = {"apply_replicated", "import_replicated", "import_state",
+            "resume_replicated", "commit_state", "replicate_fn"}
+# def-line markers that exempt a function (documented contracts)
+MARKERS = ("# raft-apply", "# leader-only")
+# directories the replicated control plane lives in
+SCOPES = ("seaweedfs_tpu/master/", "seaweedfs_tpu/ops/")
+
+
+def _is_raft_append(func: ast.AST) -> bool:
+    """``raft.append(...)`` / ``<x>.raft.append(...)`` — the log-append
+    spelling; list/deque ``.append`` receivers never match."""
+    if not (isinstance(func, ast.Attribute) and func.attr == "append"):
+        return False
+    v = func.value
+    return (isinstance(v, ast.Name) and v.id == "raft") or \
+        (isinstance(v, ast.Attribute) and v.attr == "raft")
+
+
+def _mutator_name(func: ast.AST) -> Optional[str]:
+    if isinstance(func, ast.Attribute) and func.attr in MUTATORS:
+        return func.attr
+    if isinstance(func, ast.Name) and func.id in MUTATORS:
+        return func.id
+    if _is_raft_append(func):
+        return "raft.append"
+    return None
+
+
+def _lexically_guarded(fn: ast.AST) -> bool:
+    """Any ``is_leader``-ish reference or a ``== "leader"`` comparison
+    anywhere in the function body (nested defs included — a closure
+    under the guard inherits it)."""
+    for n in ast.walk(fn):
+        if isinstance(n, ast.Attribute) and "is_leader" in n.attr:
+            return True
+        if isinstance(n, ast.Name) and "is_leader" in n.id:
+            return True
+        if isinstance(n, ast.Compare):
+            for c in [n.left, *n.comparators]:
+                if isinstance(c, ast.Constant) and c.value == "leader":
+                    return True
+    return False
+
+
+def _marked(lines: list[str], fn: ast.AST) -> bool:
+    """A MARKERS comment anywhere on the (possibly multi-line) def
+    signature, before the first body statement."""
+    end = fn.body[0].lineno if getattr(fn, "body", None) else fn.lineno
+    for ln in range(fn.lineno, end + 1):
+        text = lines[ln - 1] if 0 < ln <= len(lines) else ""
+        if any(m in text for m in MARKERS):
+            return True
+    return False
+
+
+def check_source(src: str, path: str,
+                 tree: Optional[ast.AST] = None) -> list[Finding]:
+    """Findings for one module (planted-pair tests drive this
+    directly; the Rule below feeds it every in-scope repo file)."""
+    if tree is None:
+        try:
+            tree = ast.parse(src, filename=path)
+        except SyntaxError:
+            return []  # W101 reports unparseable files
+    lines = src.splitlines()
+    out: list[Finding] = []
+
+    def visit(node: ast.AST, exempt: bool) -> None:
+        """DFS carrying whether any enclosing def is marked/guarded."""
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            exempt = exempt or _marked(lines, node) \
+                or _lexically_guarded(node)
+        if isinstance(node, ast.Call) and not exempt:
+            name = _mutator_name(node.func)
+            if name is not None:
+                out.append(Finding(
+                    "W902", path, node.lineno,
+                    f"replicated-state mutation {name}(...) outside an "
+                    f"is_leader-guarded or raft-apply path — a "
+                    f"follower reaching this diverges from the "
+                    f"replicated log (or silently drops the append)",
+                    "guard with is_leader, or mark the def line "
+                    "# raft-apply (apply loop) / # leader-only "
+                    "(reached only beneath the leader-gated loop)"))
+        for child in ast.iter_child_nodes(node):
+            visit(child, exempt)
+
+    visit(tree, False)
+    return out
+
+
+@register
+class LeaderGatedMutationRule(Rule):
+    id = "W902"
+    name = "leader-gated-mutation"
+    summary = ("replicated control-plane state (raft log appends, "
+               "journal/alert/coordinator imports) mutates only on "
+               "is_leader-guarded or raft-apply paths")
+    hint = ("guard with is_leader or mark the def line # raft-apply / "
+            "# leader-only")
+
+    def check(self, repo: Repo) -> list[Finding]:
+        out: list[Finding] = []
+        for ctx in repo.files():
+            rel = ctx.rel.replace("\\", "/")
+            if not rel.startswith(SCOPES) or ctx.tree is None:
+                continue
+            out.extend(check_source(ctx.source, ctx.rel, ctx.tree))
+        return out
