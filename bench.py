@@ -58,7 +58,7 @@ SECTION_CAPS = {
     "cluster_native": 360, "cluster_scaled": 420, "parity": 120,
     "integrity": 120, "scenarios": 300, "capacity": 420,
     "heat": 420, "pipeline_health": 15, "multichip_encode": 420,
-    "master_failover": 180,
+    "master_failover": 180, "resource_ledger": 420,
 }
 SECTION_CAP_DEFAULT = 300
 SECTION_MIN_S = 15          # least useful remaining budget to even start
@@ -1824,6 +1824,76 @@ def _child(scratch_path: str, platform: str = "") -> None:
         detail["heat"] = block
 
     section("heat", meas_heat)
+
+    # --- resource-ledger plane: accounting + profiler cost -----------------
+    def meas_resource_ledger():
+        """Resource-ledger acceptance (ISSUE 19): (a) accounting
+        overhead — read rps with the per-request ledger AND the
+        always-on windowed profiler (the defaults) against an
+        accounting-off (-ledger.off) baseline spawned back-to-back in
+        THIS section — acceptance < 1% (bench_diff floors
+        resource_ledger.ledger_overhead_pct at 1.0); (b) proof the
+        snapshot pipeline flowed end to end: per-route CPU/queue-wait
+        rates, loop-lag stats and profiler windows reached the
+        master's /cluster/ledger, with http_read attributed; (c) the
+        serving loop stayed healthy under the bench load — bench_diff
+        floors resource_ledger.loop_lag_p99_ms at 5ms."""
+        import urllib.request
+
+        block: dict = {}
+        with spawn_cluster(1, ("-ledger.off",)) as (mport, _root):
+            base = run_bench(mport, 4000, use_tcp=False)
+        block["baseline_read_rps"] = base.get("read", 0.0)
+        with spawn_cluster(1) as (mport, _root):
+            rates = run_bench(mport, 4000, use_tcp=False)
+            block["ledger_read_rps"] = rates.get("read", 0.0)
+            if block["baseline_read_rps"]:
+                block["ledger_overhead_pct"] = round(
+                    100.0 * (1.0 - rates.get("read", 0.0)
+                             / block["baseline_read_rps"]), 2)
+            # the snapshots really flowed: every server's ledger (and
+            # its loop stats + profiler windows) lands on the master
+            doc = None
+            deadline = time.time() + 8
+            while time.time() < deadline:
+                try:
+                    with urllib.request.urlopen(
+                            f"http://127.0.0.1:{mport}"
+                            "/cluster/ledger?top=8", timeout=5) as r:
+                        doc = json.loads(r.read())
+                except OSError:
+                    doc = None
+                if doc and doc.get("routes"):
+                    break
+                time.sleep(0.3)
+            if doc and doc.get("routes"):
+                routes = {row["route"]: row for row in doc["routes"]}
+                rr = routes.get("http_read") or {}
+                block["cluster_ledger"] = {
+                    "peers": len(doc.get("peers") or {}),
+                    "routes": sorted(routes),
+                    "top_route": doc["routes"][0]["route"],
+                    "http_read_cpu_share": rr.get("cpu_share", 0.0),
+                    "http_read_cpu_rate": rr.get("cpu_rate", 0.0),
+                    "http_read_queue_wait_rate":
+                        rr.get("queue_wait_rate", 0.0),
+                    "total_cpu_rate":
+                        (doc.get("totals") or {}).get("cpu_rate", 0.0),
+                    "profiled_servers":
+                        len(doc.get("profiles") or {}),
+                }
+                block["loop_lag_p99_ms"] = max(
+                    (s.get("loop_lag_p99_ms", 0.0)
+                     for s in doc.get("servers") or []), default=0.0)
+                block["loop_stalls"] = sum(
+                    s.get("stalls", 0)
+                    for s in doc.get("servers") or [])
+            else:
+                block["error_cluster_ledger"] = \
+                    "no ledger snapshots reached /cluster/ledger"
+        detail["resource_ledger"] = block
+
+    section("resource_ledger", meas_resource_ledger)
 
     # --- scaled cluster: N volume servers, M client procs ------------------
     def meas_cluster_scaled():

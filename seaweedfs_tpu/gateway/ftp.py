@@ -118,11 +118,12 @@ class FtpServer:
     def _accept_loop(self) -> None:
         while not self._stop.is_set():
             try:
-                conn, _ = self._sock.accept()
+                conn, addr = self._sock.accept()
             except OSError:
                 return
             threading.Thread(target=self._serve, args=(conn,),
-                             daemon=True).start()
+                             daemon=True,
+                             name=f"ftp-conn:{addr[1]}").start()
 
     # --- session ----------------------------------------------------------
     def _serve(self, conn: socket.socket) -> None:

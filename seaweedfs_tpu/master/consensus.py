@@ -623,7 +623,8 @@ class RaftNode:
 
         # parallel like _broadcast_append: serial 1s timeouts to dead
         # peers would outlast the election timeout and churn terms
-        threads = [threading.Thread(target=ask, args=(p,), daemon=True)
+        threads = [threading.Thread(target=ask, args=(p,), daemon=True,
+                                   name=f"raft-vote:{p}")
                    for p in self.peers]
         for t in threads:
             t.start()
@@ -712,7 +713,8 @@ class RaftNode:
 
         # parallel: one dead peer must not delay the live ones past the
         # election timeout (serial 1s timeouts would cause flapping)
-        threads = [threading.Thread(target=send, args=plan, daemon=True)
+        threads = [threading.Thread(target=send, args=plan, daemon=True,
+                                   name=f"raft-append:{plan[0]}")
                    for plan in plans]
         for t in threads:
             t.start()

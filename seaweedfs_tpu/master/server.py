@@ -127,6 +127,26 @@ class MasterServer:
 
         heat_metrics()  # register the gauge families before first ship
         self.heat_journal = ClusterHeatJournal(rack_fn=self._rack_of)
+        # cluster resource ledger (observability/ledger.py): every
+        # server ships decayed per-route/per-client CPU/bytes/
+        # queue-wait ledgers plus its loop-lag stats and profiler
+        # windows here (POST /cluster/ledger/ingest); the merged view
+        # (/cluster/ledger) ranks routes/clients/servers by CPU share
+        # — what `weed shell cluster.top` renders — and the stall
+        # detector relays peer loop_stall records as journal events
+        # the default journal_event alert rule pages on.
+        from ..observability.ledger import (ClusterLedgerJournal,
+                                            LedgerShipper, RequestLedger)
+        from ..stats import ledger_metrics
+
+        ledger_metrics()  # register the gauge families before first ship
+        self.ledger_journal = ClusterLedgerJournal()
+        # the master accounts its own requests too; its shipper
+        # short-circuits into the local journal (no HTTP self-post)
+        self.ledger = RequestLedger(server=self.url)
+        self._ledger_shipper = LedgerShipper(
+            self.ledger, server=self.url,
+            local_journal=self.ledger_journal)
         self.alert_engine = AlertEngine(
             default_rules(),
             source_fn=lambda: (self.aggregator.health(),
@@ -187,6 +207,7 @@ class MasterServer:
         from ..utils.admission import maybe_controller
 
         self.router.admission = maybe_controller(max_inflight, "master")
+        self.router.ledger = self.ledger  # per-request resource ledger
         self._register_routes()
         self._server = None
         self._tcp_server = None
@@ -223,6 +244,7 @@ class MasterServer:
         # backfill — an event emitted before it never ships)
         self._event_shipper.attach()
         self._reqlog_shipper.attach()
+        self._ledger_shipper.attach()
         # framed-TCP assign front (op 'A'): the write hot loop does one
         # assign per file, and HTTP parsing caps it; leader-only — a
         # follower refuses so clients fall back to HTTP redirects
@@ -295,6 +317,7 @@ class MasterServer:
         self._trace_shipper.detach()
         self._event_shipper.detach()
         self._reqlog_shipper.detach()
+        self._ledger_shipper.detach()
         self.aggregator.stop_loop()
         if self._tcp_server is not None:
             self._tcp_server.stop()
@@ -689,6 +712,8 @@ class MasterServer:
             time.sleep(0.6)
             bundles: list[dict] = []
             for url in list(dict.fromkeys(servers))[:8]:
+                if self._stop.is_set():
+                    return
                 try:
                     r = http_json(
                         "POST",
@@ -703,6 +728,10 @@ class MasterServer:
                     bundles.append({"server": url,
                                     "error": f"{type(e).__name__}: {e}"
                                     [:200]})
+            if self._stop.is_set():
+                # a stopped master must not emit through the
+                # process-global recorder on a straggling thread
+                return
             try:
                 from ..observability.flightrecorder import \
                     get_flightrecorder
@@ -1043,6 +1072,37 @@ class MasterServer:
             self._require_leader(req)
             top = min(qint(req.query, "top", 20), 256)
             return Response(self.heat_journal.to_doc(top_needles=top))
+
+        @r.route("POST", "/cluster/ledger/ingest")
+        def cluster_ledger_ingest(req: Request) -> Response:
+            """Resource-ledger shipping sink (observability/ledger.py
+            LedgerShipper): every server POSTs its decayed per-route/
+            per-client CPU/bytes/queue-wait ledger plus loop-lag stats
+            and profiler windows on a ~1s cadence.  Same convergence
+            rule as heat ingest — a follower forwards to the raft
+            leader so ONE journal merges the cluster and the stall
+            relay sees every peer."""
+            if not self.is_leader:
+                if not self.raft.leader or self.raft.leader == self.url:
+                    raise HttpError(503, "no leader elected yet; retry")
+                return self._proxy_to_leader(req)
+            b = req.json()
+            accepted = self.ledger_journal.ingest(
+                str(b.get("server") or ""), b.get("snapshots") or [])
+            return Response({"accepted": accepted, "leader": self.url})
+
+        @r.route("GET", "/cluster/ledger")
+        def cluster_ledger(req: Request) -> Response:
+            """The merged cluster resource view: routes/clients/servers
+            ranked by CPU share (with queue-wait, byte and cache-hit
+            rates), per-peer loop-lag percentiles, recent loop_stall
+            events and the per-server profiler windows — what
+            `weed shell cluster.top` renders and what the capacity
+            probe cites for its http_read attribution.  Leader-only
+            (ingest converges there)."""
+            self._require_leader(req)
+            top = min(qint(req.query, "top", 20), 256)
+            return Response(self.ledger_journal.to_doc(top=top))
 
         @r.route("GET", "/cluster/capacity")
         def cluster_capacity(req: Request) -> Response:

@@ -29,7 +29,9 @@ from .events import (ClusterEventJournal, Event, EventJournal,
 from .flightrecorder import FlightRecorder, get_flightrecorder
 from .heat import (ClusterHeatJournal, DecayedCounter, HeatAccumulator,
                    HeatShipper, SpaceSavingSketch)
-from .profiler import SamplingProfiler, profile_collapsed
+from .ledger import (ClusterLedgerJournal, LedgerShipper, RequestLedger)
+from .profiler import (SamplingProfiler, WindowedProfiler,
+                       profile_collapsed)
 from .reqlog import (AccessRecord, ReqlogRecorder, ReqlogShipper,
                      WorkloadJournal, disable_reqlog, enable_reqlog,
                      get_recorder)
@@ -48,4 +50,6 @@ __all__ = ["Span", "Tracer", "get_tracer", "enable_tracing",
            "AccessRecord", "ReqlogRecorder", "ReqlogShipper",
            "WorkloadJournal", "get_recorder", "enable_reqlog",
            "disable_reqlog", "DecayedCounter", "SpaceSavingSketch",
-           "HeatAccumulator", "HeatShipper", "ClusterHeatJournal"]
+           "HeatAccumulator", "HeatShipper", "ClusterHeatJournal",
+           "RequestLedger", "LedgerShipper", "ClusterLedgerJournal",
+           "WindowedProfiler"]

@@ -157,6 +157,23 @@ def default_rules() -> list[Rule]:
             for_s=0.0, keep_firing_s=120.0,
             params={"event": etype, "window_s": 30.0},
             description=heat_descriptions.get(etype, "")))
+    # resource-ledger loop-stall relay (observability/ledger.py): the
+    # master's ClusterLedgerJournal emits one loop_stall event per
+    # peer-reported stall, carrying the offending route + exemplar
+    # trace — same journal_event contract as the heat detectors
+    ledger_descriptions = {
+        "loop_stall": "a reactor event loop was blocked past the "
+                      "stall threshold: every connection on that "
+                      "server froze (the event names the route)",
+    }
+    from .ledger import LEDGER_EVENT_TYPES
+    for etype in LEDGER_EVENT_TYPES:
+        rules.append(Rule(
+            etype, "journal_event",
+            severity=_events.EVENT_TYPES.get(etype, "warning"),
+            for_s=0.0, keep_firing_s=120.0,
+            params={"event": etype, "window_s": 30.0},
+            description=ledger_descriptions.get(etype, "")))
     return rules
 
 
@@ -420,7 +437,8 @@ class AlertEngine:  # weedlint: concurrent-class
         d = latest.get("details") or {}
         servers = [s for s in (d.get("servers") or []) if s]
         detail = ", ".join(f"{k}={d[k]}" for k in
-                           ("volume", "share", "prev_share")
+                           ("volume", "share", "prev_share",
+                            "route", "lag_ms")
                            if k in d) or latest.get("type", "")
         return True, float(len(events)), detail, servers
 

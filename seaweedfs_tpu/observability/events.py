@@ -86,6 +86,10 @@ EVENT_TYPES = {
     # and default_rules() so neither side can drift
     "heat_shift": "warning",   # a volume newly entered the Zipf head
     "flash_crowd": "error",    # a COLD volume took the head outright
+    # resource-ledger loop-stall relay (observability/ledger.py,
+    # master): the ledger.LEDGER_EVENT_TYPES tuple is W401-linted the
+    # same way HEAT_EVENT_TYPES is
+    "loop_stall": "error",     # reactor loop blocked past threshold
 }
 
 # HEALTH_FAMILIES key (stats/aggregate.py) -> the event type emitted at
@@ -104,6 +108,7 @@ HEALTH_EVENT_TYPES = {
     "retry_budget_exhausted": "retry_budget_exhausted",
     "reqlog_records_dropped": "reqlog_dropped",
     "dataplane_conn_aborts": "dataplane_conn_abort",
+    "loop_lag": "loop_stall",
 }
 
 

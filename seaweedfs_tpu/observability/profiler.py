@@ -31,6 +31,7 @@ import os
 import sys
 import threading
 import time
+from collections import deque
 from typing import Optional
 
 _MAX_SECONDS = 3600.0
@@ -124,6 +125,15 @@ class SamplingProfiler:
         with self._lock:
             return dict(self._counts)
 
+    def drain_counts(self) -> tuple[dict[tuple, int], int]:
+        """-> (counts, samples) accumulated since the last drain, and
+        reset both — the windowed mode's rotation primitive.  Sampling
+        continues across the drain (the lock covers the swap only)."""
+        with self._lock:
+            counts, self._counts = self._counts, {}
+            samples, self.samples = self.samples, 0
+            return counts, samples
+
     @staticmethod
     def _frame_label(fr: tuple) -> str:
         fname, lineno, func = fr
@@ -190,3 +200,123 @@ def profile_collapsed(seconds: float, hz: float = 100.0) -> str:
     prof = SamplingProfiler(hz=hz)
     prof.run_for(seconds)
     return prof.collapsed()
+
+
+class WindowedProfiler:
+    """Continuous profiling: the sampling profiler, always on at a low
+    rate, rotated into bounded collapsed-stack WINDOWS.
+
+    The one-shot profiler answers "where is time going right now, for
+    the 10s I asked"; production regressions ask the opposite question
+    — "what CHANGED in the last minute".  This mode keeps a rotating
+    spool of per-window stack counts (window_s each, max_windows deep,
+    so memory is bounded by construction) and `diff()` ranks the
+    stacks RISING between the two most recent complete windows: the
+    flamegraph delta that names a creeping hot path without anyone
+    having been watching.
+
+    Cost model: the sampler thread wakes `hz` times a second and walks
+    sys._current_frames(); at the default 7hz that is ~2 orders below
+    the one-shot profiler and is covered by the bench
+    `resource_ledger` overhead gate (the ledger snapshot ships each
+    window's top/rising stacks to the master, so cluster-wide profile
+    windows cost no extra thread anywhere)."""
+
+    def __init__(self, hz: float = 7.0, window_s: float = 10.0,
+                 max_windows: int = 12, top_k: int = 10):
+        self.hz = hz
+        self.window_s = max(window_s, 1.0)
+        self.top_k = top_k
+        self._prof = SamplingProfiler(hz=hz)
+        self._windows: deque = deque(maxlen=max_windows)  # guarded-by: _lock
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.rotations = 0  # guarded-by: _lock
+
+    def start(self) -> "WindowedProfiler":
+        if self._thread is not None:
+            return self
+        self._prof.start()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="windowed-profiler")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+        try:
+            self._prof.stop()
+        except Exception:
+            pass
+        self._rotate()  # keep the partial tail window
+
+    def _loop(self) -> None:  # thread-entry
+        while not self._stop.wait(self.window_s):
+            self._rotate()
+
+    def _rotate(self) -> None:
+        counts, samples = self._prof.drain_counts()
+        if not samples:
+            return
+        with self._lock:
+            self._windows.append({"ts": time.time(),
+                                  "samples": samples,
+                                  "counts": counts})
+            self.rotations += 1
+
+    @staticmethod
+    def _label(key: tuple) -> str:
+        thread, stack = key
+        parts = [thread.replace(";", ":")]
+        parts.extend(
+            SamplingProfiler._frame_label(fr).replace(";", ":")
+            for fr in stack)
+        return ";".join(parts)
+
+    def top(self, k: Optional[int] = None) -> list[dict]:
+        """Heaviest stacks of the most recent window, share-of-window
+        normalized: [{stack, hits, share}]."""
+        with self._lock:
+            win = self._windows[-1] if self._windows else None
+        if win is None:
+            return []
+        total = max(win["samples"], 1)
+        rows = sorted(win["counts"].items(), key=lambda kv: -kv[1])
+        return [{"stack": self._label(key), "hits": n,
+                 "share": round(n / total, 4)}
+                for key, n in rows[:k or self.top_k]]
+
+    def diff(self, k: Optional[int] = None) -> list[dict]:
+        """Stacks RISING between the two most recent windows, ranked
+        by share delta (sample counts normalize per window, so an hz
+        hiccup does not read as a regression): [{stack, delta,
+        share, prev_share}]."""
+        with self._lock:
+            if len(self._windows) < 2:
+                return []
+            prev, cur = self._windows[-2], self._windows[-1]
+        pt, ct = max(prev["samples"], 1), max(cur["samples"], 1)
+        deltas: list[tuple] = []
+        for key, n in cur["counts"].items():
+            share = n / ct
+            prev_share = prev["counts"].get(key, 0) / pt
+            if share > prev_share:
+                deltas.append((share - prev_share, share, prev_share,
+                               key))
+        deltas.sort(key=lambda row: -row[0])
+        return [{"stack": self._label(key),
+                 "delta": round(d, 4), "share": round(s, 4),
+                 "prev_share": round(ps, 4)}
+                for d, s, ps, key in deltas[:k or self.top_k]]
+
+    def summary(self) -> dict:
+        """The ledger snapshot's `profile` section."""
+        with self._lock:
+            windows = len(self._windows)
+        return {"hz": self.hz, "window_s": self.window_s,
+                "windows": windows, "top": self.top(),
+                "rising": self.diff()}

@@ -283,10 +283,32 @@ def _attribute_bound(master_url: str, probe_url: str,
             hop = f"{bounding.get('op')} on {bounding.get('server')}"
         else:
             hop = ""
-        return {"resource": resource, "bounding_hop": hop,
-                "server_s": round(server_s, 4),
-                "network_s": round(network_s, 4),
-                "trace_id": trace_id}
+        out = {"resource": resource, "bounding_hop": hop,
+               "server_s": round(server_s, 4),
+               "network_s": round(network_s, 4),
+               "trace_id": trace_id}
+        # resource-ledger citation (best-effort): the merged ledger's
+        # row for the probed route class says what the route costs
+        # cluster-wide RIGHT NOW — CPU share and queue-wait back the
+        # single-trace attribution with the population it came from
+        try:
+            led = http_json(
+                "GET", f"http://{master_url}/cluster/ledger?top=64",
+                timeout=5.0)
+            for row in led.get("routes") or []:
+                if row.get("route") == "http_read":
+                    out["ledger"] = {
+                        "route": "http_read",
+                        "cpu_rate_s_per_s": row.get("cpu_rate", 0.0),
+                        "cpu_share": row.get("cpu_share", 0.0),
+                        "queue_wait_s_per_s":
+                            row.get("queue_wait_rate", 0.0),
+                        "req_rate": row.get("req_rate", 0.0),
+                    }
+                    break
+        except Exception:
+            pass
+        return out
     except Exception as e:
         return {"resource": "unknown",
                 "detail": f"{type(e).__name__}: {e}"[:200]}
@@ -384,4 +406,13 @@ def render_capacity(doc: dict) -> str:
             f" p99={res.get('capacity_p99_ms', 0):g}ms"
             f"{knee_s} bound={bound}"
             + (f" [{hop}]" if hop else ""))
+        led = (res.get("bounding") or {}).get("ledger")
+        if led:
+            lines.append(
+                f"  {'':<12} ledger: {led['route']} at "
+                f"{led.get('cpu_rate_s_per_s', 0) * 1000:.1f} cpu-ms/s "
+                f"({led.get('cpu_share', 0):.0%} of cluster), "
+                f"queue-wait "
+                f"{led.get('queue_wait_s_per_s', 0) * 1000:.1f} ms/s "
+                f"over {led.get('req_rate', 0):g} req/s")
     return "\n".join(lines)

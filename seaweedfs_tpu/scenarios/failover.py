@@ -125,12 +125,21 @@ def _registry_shards(master, vid: int) -> dict:
 
 
 def _scrub_once(vs) -> None:
-    http_json("POST", f"http://{vs.url}/ec/scrub/start",
-              {"rate_mb_s": 0, "interval_s": 0}, timeout=30.0)
-    _wait(lambda: not http_json(
-        "GET", f"http://{vs.url}/ec/scrub/status",
-        timeout=10.0)["running"],
-        20, f"scrub on {vs.url}")
+    # suspend the scrubber's busy gate for the forced pass: it exists
+    # to defer scan IO behind live traffic, but this drill scans MID
+    # write storm on purpose — gated, the pass can pause for as long
+    # as the storm keeps the holder above the busy threshold
+    prev_busy = vs.scrubber.busy_fn
+    vs.scrubber.busy_fn = None
+    try:
+        http_json("POST", f"http://{vs.url}/ec/scrub/start",
+                  {"rate_mb_s": 0, "interval_s": 0}, timeout=30.0)
+        _wait(lambda: not http_json(
+            "GET", f"http://{vs.url}/ec/scrub/status",
+            timeout=10.0)["running"],
+            45, f"scrub on {vs.url}")
+    finally:
+        vs.scrubber.busy_fn = prev_busy
 
 
 def _storm_loop(ci: int, spec: ScenarioSpec,
@@ -252,9 +261,19 @@ def run_failover(spec: Optional[ScenarioSpec] = None,
                   max_hits=1)
         _scrub_once(holder)
         fi.disable("ec.shard.corrupt")
-        firing = _wait(lambda: {
-            a["name"] for a in leader.alert_engine.to_dict()["alerts"]
-            if a["state"] == "firing"} or None, 25, "a firing alert")
+        # the corruption signal, specifically: under storm load the
+        # plane also pages infrastructure alerts (loop_stall,
+        # loop_lag_increase, reqlog drops) that are orthogonal to the
+        # rot this drill plants — capturing those as `firing` would
+        # break the attribution contract below even though the repair
+        # cites its scrub cause correctly
+        def _rot_alerts():
+            return {a["name"]
+                    for a in leader.alert_engine.to_dict()["alerts"]
+                    if a["state"] == "firing"
+                    and ("scrub" in a["name"] or "corrupt" in a["name"]
+                         or a["name"].startswith("ec_"))} or None
+        firing = _wait(_rot_alerts, 25, "a firing corruption alert")
         say(f"{spec.name}: firing={sorted(firing)}")
 
         # --- repair starts, slowed; plan quorum-replicates ------------
@@ -269,6 +288,11 @@ def run_failover(spec: Optional[ScenarioSpec] = None,
             f.coordinator.status()["replicated"]["pending"]
             for f in followers), 25,
             "the repair plan to replicate to a follower")
+        # the plan exists, so the alert that seeded it is firing NOW —
+        # fold the current rot set into the pre-kill capture (the first
+        # counter_increase alert can reach firing a beat before the
+        # threshold rule the coordinator actually cites)
+        firing |= _rot_alerts() or set()
         # pre-kill zero-loss snapshot: what a follower already holds is
         # what raft promises survives the election
         pre_ids = {e["id"] for e in leader.event_journal.query(limit=0)}

@@ -6,6 +6,7 @@ from . import coordinator_commands as coordinator_commands  # noqa: F401
 from . import ec_commands as ec_commands  # noqa: F401
 from . import fs_commands as fs_commands  # noqa: F401
 from . import heat_commands as heat_commands  # noqa: F401
+from . import ledger_commands as ledger_commands  # noqa: F401
 from . import remote_commands as remote_commands  # noqa: F401
 from . import s3_commands as s3_commands  # noqa: F401
 from . import trace_commands as trace_commands  # noqa: F401

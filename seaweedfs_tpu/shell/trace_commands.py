@@ -196,6 +196,25 @@ def cmd_cluster_health(env: CommandEnv, flags: dict) -> str:
                 f"probed {age}s ago)")
     except Exception:
         pass
+    # one-line resource-ledger hint (best-effort): worst loop-lag p99
+    # across the peers plus the route currently carrying the most CPU
+    # — `cluster.top` is the drill-down
+    try:
+        led = env.master_get("/cluster/ledger?top=1")
+        worst = max((s.get("loop_lag_p99_ms", 0.0)
+                     for s in led.get("servers") or []), default=0.0)
+        stalls = sum(s.get("stalls", 0)
+                     for s in led.get("servers") or [])
+        routes = led.get("routes") or []
+        if routes:
+            r = routes[0]
+            lines.append(
+                f"ledger: loop_lag_p99={worst:g}ms stalls={stalls} "
+                f"top_route={r['route']} "
+                f"({r.get('cpu_share', 0.0):.0%} cpu, "
+                f"{r.get('req_rate', 0.0):g} req/s) — cluster.top")
+    except Exception:
+        pass
     t = doc["totals"]
     lines.append(f"totals: worker_restarts={t['worker_restarts']} "
                  f"engine_fallbacks={t['engine_fallbacks']} "

@@ -5,13 +5,13 @@ from .metrics import (Counter, Gauge, Histogram, Registry, REGISTRY,
                       s3_metrics, ec_pipeline_metrics, ec_integrity_metrics,
                       coordinator_metrics, request_plane_metrics,
                       dataplane_metrics, needle_cache_metrics,
-                      heat_metrics, start_push_loop)
+                      heat_metrics, ledger_metrics, start_push_loop)
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "Registry", "REGISTRY",
     "master_metrics", "volume_server_metrics", "filer_metrics", "s3_metrics",
     "ec_pipeline_metrics", "ec_integrity_metrics", "coordinator_metrics",
     "request_plane_metrics", "dataplane_metrics", "needle_cache_metrics",
-    "heat_metrics", "start_push_loop",
+    "heat_metrics", "ledger_metrics", "start_push_loop",
     "ClusterAggregator", "merge_families", "parse_prometheus_text",
 ]

@@ -77,6 +77,13 @@ HEALTH_FAMILIES = {
     # response it was owed — sustained aborts mean the dataplane is
     # shedding connections, not requests
     "dataplane_conn_aborts": "SeaweedFS_dataplane_conn_aborts_total",
+    # reactor saturation (utils/eventloop.py watchdog + the resource
+    # ledger's settle-side detector): a loop-blocked moment past the
+    # stall threshold froze EVERY connection on that server for the
+    # duration — the canonical "one blocking call on the inline fast
+    # path" regression, and it pages with the offending route via the
+    # loop_stall journal-event relay
+    "loop_lag": "SeaweedFS_dataplane_loop_stalls_total",
 }
 
 # keys whose truth lives on the MASTER: the per-peer rollup reports 0

@@ -609,6 +609,27 @@ class DataplaneMetrics:
         self.fast_dispatches = registry.counter(
             "SeaweedFS_dataplane_fast_dispatches_total",
             "Cache-probed reads dispatched inline on the loop.")
+        # loop saturation telemetry (the resource-ledger plane): how
+        # long each loop iteration held every connection hostage, and
+        # the stall counter behind the `loop_lag` HEALTH_FAMILIES key
+        self.loop_lag = registry.histogram(
+            "SeaweedFS_dataplane_loop_lag_seconds",
+            "Reactor loop iteration busy time (every connection waits "
+            "this long).",
+            buckets=(0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                     1.0, 2.5))
+        self.loop_stalls = registry.counter(
+            "SeaweedFS_dataplane_loop_stalls_total",
+            "Loop-blocked moments past the stall threshold (the "
+            "loop_lag health key — a blocked loop pages).")
+        self.queue_depth = registry.gauge(
+            "SeaweedFS_dataplane_queue_depth",
+            "Dispatch queue depth per lane (watchdog-sampled).",
+            labels=("lane",))
+        self.workers_busy = registry.gauge(
+            "SeaweedFS_dataplane_workers_busy",
+            "Dispatch workers currently running handlers "
+            "(watchdog-sampled).")
 
     def totals(self) -> dict[str, int]:
         return {
@@ -618,6 +639,8 @@ class DataplaneMetrics:
                 int(sum(self.pool_dispatches.snapshot().values())),
             "fast_dispatches":
                 int(sum(self.fast_dispatches.snapshot().values())),
+            "loop_stalls":
+                int(sum(self.loop_stalls.snapshot().values())),
         }
 
 
@@ -699,6 +722,33 @@ class HeatMetrics:
             "or buffer superseded).")
 
 
+class LedgerMetrics:
+    """Cluster resource-ledger plane (observability/ledger.py).  The
+    per-route gauge families are refreshed by the LedgerShipper at
+    ship cadence (never on the request path); the drop counter is
+    shipper loss.  Family names live in ledger.LEDGER_METRIC_FAMILIES
+    and W401 checks they stay registered."""
+
+    def __init__(self, registry: Registry = REGISTRY):
+        self.route_cpu = registry.gauge(
+            "SeaweedFS_ledger_route_cpu_rate",
+            "Decayed thread-CPU seconds/second per route class.",
+            labels=("route",))
+        self.route_qwait = registry.gauge(
+            "SeaweedFS_ledger_route_queue_wait_rate",
+            "Decayed dispatch-queue-wait seconds/second per route "
+            "class.",
+            labels=("route",))
+        self.route_bytes = registry.gauge(
+            "SeaweedFS_ledger_route_bytes_rate",
+            "Decayed bytes/second per route class and direction.",
+            labels=("route", "dir"))
+        self.snapshots_dropped = registry.counter(
+            "SeaweedFS_ledger_snapshots_dropped_total",
+            "Ledger snapshots lost by the shipper (master unreachable "
+            "or buffer superseded).")
+
+
 _singletons: dict[str, object] = {}
 _singleton_lock = threading.Lock()
 
@@ -752,6 +802,10 @@ def needle_cache_metrics() -> NeedleCacheMetrics:
 
 def heat_metrics() -> HeatMetrics:
     return _singleton("heat", HeatMetrics)
+
+
+def ledger_metrics() -> LedgerMetrics:
+    return _singleton("ledger", LedgerMetrics)
 
 
 def start_push_loop(gateway_url: str, job: str,

@@ -58,6 +58,7 @@ import selectors
 import socket
 import threading
 import time
+from collections import deque
 from typing import Callable, Optional
 
 # per-connection bounds, mirroring the threaded servers' guards
@@ -141,11 +142,16 @@ class _LoopHandler:
     `sendfile` support for Response(file_path=...) streams."""
 
     __slots__ = ("server", "rfile", "wfile", "client_address", "command",
-                 "path", "headers", "close_connection", "_out", "_conn")
+                 "path", "headers", "close_connection", "_out", "_conn",
+                 "queue_wait_s")
 
     def __init__(self, server, conn: "_Conn", body: bytes, peer):
         self.server = server
         self._conn = conn
+        # dispatch-queue wait: stamped by the worker-handoff closure
+        # (loop-enqueue -> worker pickup); the inline fast path leaves
+        # it 0.  Router.dispatch feeds it to the resource ledger.
+        self.queue_wait_s = 0.0
         self.rfile = io.BytesIO(body)
         self.wfile = _ConnWriter(conn)
         self.client_address = peer
@@ -377,6 +383,21 @@ class Reactor:
         # hook-style handoff: written once in start() before the loop
         # thread runs, read lock-free by on_loop_thread()
         self._loop_thread: Optional[threading.Thread] = None
+        # --- loop saturation telemetry (the resource-ledger plane) ---
+        # pre-select tick stamp: the watchdog reads it to detect a loop
+        # blocked mid-iteration (a torn read of a float is impossible
+        # in CPython; staleness of one tick is the measurement)
+        self._tick_ts = time.monotonic()
+        # the inline fast-path request currently holding the loop
+        # (path str), so a watchdog-detected stall can NAME the route
+        self._loop_busy: Optional[str] = None
+        # per-iteration loop busy time samples: (monotonic ts, busy_s),
+        # appended by the loop, read by loop_lag_stats()
+        self._lag_samples: deque = deque(maxlen=512)  # guarded-by: _lock
+        self._last_stall_note = 0.0   # watchdog fallback rate limit
+        # servers wire their RequestLedger.note_stall here so a stall
+        # is recorded with route + trace; None = count-only fallback
+        self.stall_hook = None
 
     # --- lifecycle ---------------------------------------------------------
     def start(self) -> "Reactor":
@@ -388,6 +409,12 @@ class Reactor:
                                  name="dataplane-loop")
             self._loop_thread = t
             self._threads.append(t)
+            # saturation watchdog: pages (via the ledger stall hook or
+            # the loop_stalls counter) when the LOOP ITSELF is blocked
+            # — the loop cannot report its own hang
+            self._threads.append(threading.Thread(
+                target=self._watch, daemon=True,
+                name="dataplane-watchdog"))
             for i in range(self.workers):
                 w = threading.Thread(target=self._work, daemon=True,
                                      name=f"dataplane-worker-{i}")
@@ -499,12 +526,20 @@ class Reactor:
     # --- the loop ----------------------------------------------------------
     def _run(self) -> None:  # thread-entry
         self._sel.register(self._wake_r, selectors.EVENT_READ, "wake")
+        # brand the thread object so observability/ledger can answer
+        # "am I ON the loop?" with one attribute read, no singleton
+        threading.current_thread()._weed_loop = True
         while True:
             self._apply_pending()
+            # sentinel-timer drift: the tick stamp freshens every
+            # iteration; the watchdog reads (now - tick - select
+            # timeout) as the loop's current lag while blocked
+            self._tick_ts = time.monotonic()  # weedlint: disable=W502 single writer (the loop thread); the watchdog only READS this float, and a stale read just delays one lag check by a tick
             try:
                 events = self._sel.select(timeout=1.0)
             except OSError:
                 continue
+            t_busy0 = time.monotonic()
             for key, mask in events:
                 data = key.data
                 try:
@@ -530,6 +565,83 @@ class Reactor:
                                              abort_reason="loop_error")
                         except Exception:
                             pass
+            busy_s = time.monotonic() - t_busy0
+            if busy_s >= 0.001:
+                # loop-lag sample: how long THIS iteration held the
+                # loop (inline dispatches included) — every connection
+                # waited that long.  Sub-ms iterations are free and
+                # not worth a lock + histogram touch.
+                with self._lock:
+                    self._lag_samples.append((t_busy0, busy_s))
+                try:
+                    _metrics().loop_lag.observe(busy_s)
+                except Exception:
+                    pass
+
+    def loop_lag_stats(self, window_s: float = 60.0) -> dict:
+        """Loop saturation snapshot for /debug/ledger and the shipped
+        ledger snapshots: lag percentiles over the recent window plus
+        dispatch-queue depth and worker-pool occupancy."""
+        now = time.monotonic()
+        with self._lock:
+            samples = sorted(b for (t, b) in self._lag_samples
+                             if now - t <= window_s)
+        with self._qcond:
+            qdepth = len(self._q_ops) + len(self._q_data)
+            alive, idle = self._alive, self._idle
+
+        def pct(p: float) -> float:
+            if not samples:
+                return 0.0
+            return samples[min(int(p * len(samples)),
+                               len(samples) - 1)]
+
+        return {
+            "lag_p50_ms": round(pct(0.50) * 1000.0, 2),
+            "lag_p99_ms": round(pct(0.99) * 1000.0, 2),
+            "lag_max_ms": round(samples[-1] * 1000.0, 2)
+            if samples else 0.0,
+            "samples": len(samples),
+            "queue_depth": qdepth,
+            "workers": alive,
+            "workers_busy": max(alive - idle, 0),
+        }
+
+    def _watch(self) -> None:  # thread-entry
+        """Saturation watchdog: refreshes the queue-depth / occupancy
+        gauges and detects a BLOCKED loop from outside it — the tick
+        stamp going stale past the select timeout plus the stall
+        threshold means nothing (accepts, parses, flushes) is moving."""
+        from ..observability.ledger import LOOP_STALL_THRESHOLD_S
+
+        while True:
+            time.sleep(0.25)
+            try:
+                m = _metrics()
+                with self._qcond:
+                    qo, qd = len(self._q_ops), len(self._q_data)
+                    alive, idle = self._alive, self._idle
+                m.queue_depth.set("ops", float(qo))
+                m.queue_depth.set("data", float(qd))
+                m.workers_busy.set(float(max(alive - idle, 0)))
+                # 1.0 = the select timeout: an IDLE loop's stamp is
+                # legitimately that old
+                lag = time.monotonic() - self._tick_ts - 1.0
+                if lag < LOOP_STALL_THRESHOLD_S:
+                    continue
+                route = self._loop_busy or "(loop)"
+                hook = self.stall_hook
+                if hook is not None:
+                    # the ledger records route + exemplar, counts the
+                    # loop_stalls family, and rate-limits repeats
+                    hook(route, lag, "")
+                    continue
+                now = time.monotonic()
+                if now - self._last_stall_note >= _EVENT_MIN_INTERVAL_S:
+                    self._last_stall_note = now  # weedlint: disable=W502 only the watchdog thread ever touches this rate-limit stamp
+                    m.loop_stalls.inc()
+            except Exception:
+                pass  # the watchdog must never die
 
     def _apply_pending(self) -> None:  # loop-callback
         with self._lock:
@@ -771,16 +883,30 @@ class Reactor:
             # thread handoff.  Lexically Router.dispatch reaches disk
             # helpers, hence the audited waiver: a raced invalidation
             # degrades to ONE bounded needle pread, never unbounded IO.
+            self._loop_busy = path  # weedlint: disable=W502 loop-thread-only write; the watchdog's racy read is the point
+            from . import faultinject as fi
+
+            if fi._points:
+                # the loop-stall drill's injection site: a delay here
+                # blocks the WHOLE dataplane, exactly like a handler
+                # that sneaks blocking IO onto the inline fast path
+                fi.hit("loop.block")  # weedlint: loop-io fault-injection drill point, inert outside tests
             try:
                 router.dispatch(h, command)  # weedlint: loop-io cache-probed fast path: needle cache holds the object; a raced invalidation costs one bounded pread
             except Exception:
                 with conn._lock:
                     conn.closing = True
+            self._loop_busy = None  # weedlint: disable=W502 loop-thread-only write; the watchdog's racy read is the point
             _metrics().fast_dispatches.inc()
             conn.request_done(close=h.close_connection)
             return True
 
+        t_submit = time.monotonic()
+
         def run():
+            # queue wait = loop enqueue -> worker pickup; the ledger
+            # reads it off the handler at settle
+            h.queue_wait_s = time.monotonic() - t_submit
             try:
                 router.dispatch(h, command)
             except Exception:
@@ -818,11 +944,17 @@ class Reactor:
         with conn._lock:
             conn.busy = True
 
+        t_submit = time.monotonic()
+
         def run():
             from .framing import serve_frame
 
             frame = serve_frame(lst.handler, lst.name, op, key, body,
-                                conn.peer[0])
+                                conn.peer[0],
+                                ledger=getattr(lst.owner, "ledger",
+                                               None),
+                                queue_wait_s=time.monotonic()
+                                - t_submit)
             conn.enqueue(frame)
             conn.request_done(close=False)
 

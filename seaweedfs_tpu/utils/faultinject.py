@@ -109,6 +109,11 @@ FAULT_POINTS: dict[str, str] = {
                      "send to that peer fails instantly — the "
                      "failure-under-load scenario's rack-loss stand-in "
                      "(utils/httpd.py, utils/framing.py)",
+    "loop.block": "reactor inline fast path, ON the event-loop thread "
+                  "— delay-only arming blocks the WHOLE dataplane for "
+                  "the duration, the loop-stall drill behind the "
+                  "loop_lag health key and the loop_stall alert relay "
+                  "(utils/eventloop.py)",
 }
 
 

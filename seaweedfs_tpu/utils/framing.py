@@ -107,7 +107,8 @@ def recv_exact(sock: socket.socket, n: int) -> bytes:
 
 def serve_frame(handler: Callable[[bytes, str, bytes], bytes],
                 name: str, op: bytes, key: str, body: bytes,
-                peer: str = "", send=None) -> bytes:
+                peer: str = "", send=None, ledger=None,
+                queue_wait_s: float = 0.0) -> bytes:
     """Serve ONE framed op through the native plane's ingress
     chokepoint — trace mint, deadline-slot hygiene, and the workload
     recorder all happen here, so the thread-per-connection server and
@@ -120,8 +121,16 @@ def serve_frame(handler: Callable[[bytes, str, bytes], bytes],
     the-send-is-the-work semantics for synchronous transports; the
     reactor passes None and enqueues the returned frame (its writeback
     is asynchronous, so transmission time is not attributable to one
-    op)."""
+    op).
+
+    `ledger` (observability/ledger.py RequestLedger, or None) settles
+    this op's thread-CPU / bytes / queue-wait into the native plane's
+    cost tables; `queue_wait_s` is the reactor's parse-to-worker
+    handoff wait (the threaded path runs inline and passes 0)."""
     t_frame0 = _time.perf_counter() if _RECORDER.enabled else 0.0
+    # resource-ledger entry stamp: ON the executing thread (worker or
+    # per-conn thread), same per-thread-CPU-clock rule as dispatch
+    ltok = ledger.begin() if ledger is not None else None
     # trace ingress for the headerless native plane: frames have no
     # Traceparent slot, so every framed op is its own head-based
     # sampling decision (rate-gated), minted fresh — the cross-server
@@ -176,6 +185,17 @@ def serve_frame(handler: Callable[[bytes, str, bytes], bytes],
                     peer=peer, handler=name)
             except Exception:
                 pass  # recording never breaks the plane
+        if ledger is not None:
+            # resource ledger settle: the native plane's half of the
+            # cost stream (route class from the op byte, client key
+            # from the peer)
+            try:
+                ledger.settle_native(
+                    ltok, op, frame_status, len(body), out_len, peer,
+                    sampled.trace_id if sampled is not None else "",
+                    queue_wait_s)
+            except Exception:
+                pass  # accounting never breaks the plane
     return frame
 
 
@@ -191,6 +211,11 @@ class FramedServer:
         self._sock: Optional[socket.socket] = None
         self._stop = threading.Event()
         self._reactor = None
+        # optional resource ledger (observability/ledger.py): the
+        # owning server installs its RequestLedger so framed ops settle
+        # cost like HTTP dispatches do — both the threaded per-conn
+        # loop and the reactor (via listener.owner) read it from here
+        self.ledger = None
 
     @property
     def alive(self) -> bool:
@@ -268,7 +293,8 @@ class FramedServer:
                 body = recv_exact(conn, body_len) if body_len else b""
                 try:
                     serve_frame(self.handler, self.name, op, key, body,
-                                peer, send=conn.sendall)
+                                peer, send=conn.sendall,
+                                ledger=self.ledger)
                 except OSError:
                     return  # peer went away mid-send: drop the conn
         finally:

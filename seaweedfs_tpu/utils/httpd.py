@@ -213,6 +213,12 @@ class Router:
         # object-route response feeds decayed per-volume/per-needle
         # heat.  None costs a single attribute check per request.
         self.heat = None
+        # optional resource ledger (observability/ledger.py): servers
+        # install their per-server RequestLedger so every dispatched
+        # request settles its thread-CPU / bytes / queue-wait into the
+        # per-route and per-client cost tables.  None costs a single
+        # attribute check per request.
+        self.ledger = None
         # deadline_exceeded journal rate limit (the counter counts every
         # 504; the ring must not churn under a deadline storm).  A lost
         # write race costs at most one extra journal event.
@@ -261,6 +267,13 @@ class Router:
                                          headers={"Connection": "close"}))
             return
         path = urllib.parse.unquote(urllib.parse.urlparse(handler.path).path)
+        # resource-ledger entry stamp (observability/ledger.py): minted
+        # ON the executing thread — thread-CPU clocks are per-thread,
+        # so the reactor's worker handoff needs the stamp here, not at
+        # parse time (queue wait rides separately in
+        # handler.queue_wait_s, stamped by the reactor at handoff)
+        ledger = self.ledger
+        ltok = ledger.begin() if ledger is not None else None
         # distributed-trace ingress (observability/context.py): adopt the
         # caller's Traceparent (or make a fresh head-based sampling
         # decision) for the duration of this request, restoring the
@@ -439,6 +452,25 @@ class Router:
                                 self._resp_bytes(resp),
                                 tctx.trace_id if tctx is not None
                                 else "")
+                        except Exception:
+                            pass  # accounting never breaks serving
+                    if ledger is not None:
+                        # resource ledger settle (observability/
+                        # ledger.py): CPU delta + bytes + queue wait
+                        # into the route/client cost tables.  Sits
+                        # after _send like the recorder, so on-loop
+                        # fast-path stalls measure the whole hold.
+                        try:
+                            ledger.settle_http(
+                                ltok, method, path, fn.__name__,
+                                resp.status, len(req._body or b""),
+                                self._resp_bytes(resp),
+                                handler.client_address[0]
+                                if handler.client_address else "",
+                                tctx.trace_id if tctx is not None
+                                else "",
+                                getattr(handler, "queue_wait_s", 0.0),
+                                query=req.query)
                         except Exception:
                             pass  # accounting never breaks serving
                 finally:

@@ -80,6 +80,7 @@ class NeedleCache:
         # exceptions swallowed — accounting never breaks a read.
         self.on_hit = None       # fn(vid, key, nbytes)
         self.on_admit = None     # fn(vid, key)
+        self.on_miss = None      # fn(vid, key) — resource ledger
 
     @property
     def enabled(self) -> bool:
@@ -110,6 +111,12 @@ class NeedleCache:
         else:
             m.misses.inc()
             m.volume_misses.inc(str(vid))
+            hook = self.on_miss
+            if hook is not None:
+                try:
+                    hook(vid, key)
+                except Exception:
+                    pass
         return n
 
     def epoch(self, vid: int) -> int:

@@ -110,7 +110,8 @@ class VolumeServer:
                  tcp: bool = True, use_mmap: bool = False,
                  dataplane: str = "python", max_inflight: int = 0,
                  needle_cache_mb: int = 64, heat: bool = True,
-                 heat_halflife_s: float = 30.0, heat_topk: int = 512):
+                 heat_halflife_s: float = 30.0, heat_topk: int = 512,
+                 ledger: bool = True, ledger_halflife_s: float = 60.0):
         from ..security import Guard
 
         if backends:
@@ -203,6 +204,37 @@ class VolumeServer:
             cache = self.store.needle_cache
             cache.on_hit = self.heat.note_cache_hit
             cache.on_admit = self.heat.note_cache_admit
+        # resource ledger (observability/ledger.py): per-SERVER request
+        # cost tables + continuous profiler, shipped like heat.
+        # ledger=False leaves router.ledger/tcp.ledger None — the
+        # accounting-off cost is one attribute check per request.
+        from ..observability.ledger import LedgerShipper, RequestLedger
+        from ..observability.profiler import WindowedProfiler
+        from ..stats import ledger_metrics
+
+        ledger_metrics()  # register the families up front
+        self.ledger = RequestLedger(
+            server=self.url, half_life=ledger_halflife_s) \
+            if ledger else None
+        self._ledger_shipper = LedgerShipper(
+            self.ledger, server=self.url,
+            master_url_fn=lambda: self.master_url) if ledger else None
+        self._profiler = WindowedProfiler() if ledger else None
+        if self.ledger is not None:
+            self.ledger.profile_fn = self._profiler.summary
+            cache = self.store.needle_cache
+            # compose with the heat hook: one callable slot, both
+            # accumulators fed (heat wants per-volume attribution, the
+            # ledger wants the per-request hit/miss stamp)
+            prev_hit = cache.on_hit
+            if prev_hit is None:
+                cache.on_hit = RequestLedger.note_cache_hit
+            else:
+                def _on_hit(vid, key, nbytes, _heat_hook=prev_hit):
+                    _heat_hook(vid, key, nbytes)
+                    RequestLedger.note_cache_hit(vid, key, nbytes)
+                cache.on_hit = _on_hit
+            cache.on_miss = RequestLedger.note_cache_miss
         if directories:
             get_flightrecorder().configure(
                 spool_dir=os.path.join(directories[0], "flightrecorder"))
@@ -218,6 +250,8 @@ class VolumeServer:
         # HTTP-plane heat feed: object-route responses note into the
         # per-server accumulator (None when -heat.off)
         self.router.heat = self.heat if heat else None
+        # HTTP-plane ledger feed (None when -ledger.off)
+        self.router.ledger = self.ledger
         # event-loop fast path (utils/eventloop.py): GET/HEAD object
         # reads whose needle the popularity cache holds dispatch inline
         # on the reactor loop — zero thread handoffs for the Zipf head
@@ -330,10 +364,28 @@ class VolumeServer:
                         heat=self.heat if self.heat.enabled
                         else None).start(),
                     role="volume-tcp", server=self.url)
+                if self._tcp_server is not None:
+                    # framed-plane ledger feed: serve_frame reads it
+                    # off the FramedServer (threaded) or listener
+                    # owner (reactor)
+                    self._tcp_server.ledger = self.ledger
+        if self.ledger is not None:
+            # loop saturation stats ride every ledger snapshot, and
+            # the reactor watchdog records stalls THROUGH the ledger
+            # (route + exemplar attribution lives there)
+            from ..utils import eventloop
+
+            if eventloop.reactor_enabled():
+                reactor = eventloop.get_reactor()
+                self.ledger.loop_stats_fn = reactor.loop_lag_stats
+                reactor.stall_hook = self.ledger.note_stall
+            self._profiler.start()
         self._trace_shipper.attach()
         self._reqlog_shipper.attach()
         if self._heat_shipper is not None:
             self._heat_shipper.attach()
+        if self._ledger_shipper is not None:
+            self._ledger_shipper.attach()
         threading.Thread(target=self._heartbeat_loop, daemon=True,
                          name=f"heartbeat:{self.url}").start()
         return self
@@ -345,6 +397,22 @@ class VolumeServer:
         self._reqlog_shipper.detach()
         if self._heat_shipper is not None:
             self._heat_shipper.detach()
+        if self._ledger_shipper is not None:
+            self._ledger_shipper.detach()
+        if self._profiler is not None:
+            self._profiler.stop()
+        if self.ledger is not None:
+            # unhook the shared reactor so a stopped server's ledger no
+            # longer receives stall callbacks (the process-wide reactor
+            # outlives any one server in embedded/test topologies)
+            from ..utils import eventloop
+
+            if eventloop.reactor_enabled():
+                reactor = eventloop.get_reactor()
+                if reactor.stall_hook == self.ledger.note_stall:
+                    reactor.stall_hook = None
+                if self.ledger.loop_stats_fn == reactor.loop_lag_stats:
+                    self.ledger.loop_stats_fn = None
         self.scrubber.stop(join_timeout=0.5)
         if self._tcp_server is not None:
             self._tcp_server.stop()
@@ -1115,6 +1183,15 @@ class VolumeServer:
                 "dropped": self._heat_shipper.dropped
                 if self._heat_shipper is not None else 0,
             }
+            # resource ledger: cost-table occupancy + shipper loss
+            if self.ledger is not None:
+                doc["Ledger"] = {
+                    **self.ledger.status(),
+                    "shipped": self._ledger_shipper.shipped
+                    if self._ledger_shipper is not None else 0,
+                    "dropped": self._ledger_shipper.dropped
+                    if self._ledger_shipper is not None else 0,
+                }
             scrub_st = self.scrubber.status()  # locked verdict snapshot
             doc["EcScrub"] = {
                 "running": scrub_st["running"],
@@ -1155,6 +1232,24 @@ class VolumeServer:
                     "shipped": self._heat_shipper.shipped,
                     "dropped": self._heat_shipper.dropped,
                     "interval_s": self._heat_shipper.interval}
+            return Response(doc)
+
+        @r.route("GET", "/debug/ledger")
+        def debug_ledger(req: Request) -> Response:
+            """This server's resource-ledger snapshot: decayed
+            per-route / per-client CPU, byte and queue-wait rates,
+            loop saturation stats, and the continuous profiler's
+            current top/rising stacks — the per-peer view the master
+            merges at /cluster/ledger."""
+            if self.ledger is None:
+                return Response({"error": "ledger disabled"},
+                                status=404)
+            doc = self.ledger.snapshot()
+            if self._ledger_shipper is not None:
+                doc["shipper"] = {
+                    "shipped": self._ledger_shipper.shipped,
+                    "dropped": self._ledger_shipper.dropped,
+                    "interval_s": self._ledger_shipper.interval}
             return Response(doc)
 
         @r.route("GET", "/stats/counter")

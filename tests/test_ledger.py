@@ -1,0 +1,728 @@
+"""Resource-ledger plane (observability/ledger.py) — tier-1.
+
+Gates: the decayed-cell math matches the DecayedCounter identity
+(rate = mass * ln2 / h), the chokepoint accounting attributes
+thread-CPU (not wall), bytes, queue wait and needle-cache verdicts to
+the right route/client cells, the bounded tables evict the coldest
+row, the stall recorder classifies raw watchdog paths and borrows the
+route's exemplar trace, the shipper's local-journal short-circuit and
+bounded buffer behave, the master-side journal merges per-peer rates /
+ranks by CPU share / relays loop stalls as journal events exactly
+once, the default alert rules page on `loop_stall` (and resolve), the
+W401/W1101 drift checks hold, the windowed profiler rotates and
+reports, a LIVE cluster carries the ledger end to end (/debug/ledger,
+/cluster/ledger, ledger gauges on /metrics, `cluster.top`), and the
+loop-stall DRILL pages within 5s naming the offending route with an
+exemplar trace.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from seaweedfs_tpu.observability import events as _events
+from seaweedfs_tpu.observability.alerts import (AlertEngine, Rule,
+                                                default_rules)
+from seaweedfs_tpu.observability.ledger import (LEDGER_EVENT_TYPES,
+                                                LEDGER_METRIC_FAMILIES,
+                                                LOOP_STALL_THRESHOLD_S,
+                                                ClusterLedgerJournal,
+                                                LedgerShipper,
+                                                RequestLedger, _Cell,
+                                                _client_key)
+from seaweedfs_tpu.observability.profiler import WindowedProfiler
+
+H = 10.0  # test half-life, seconds
+
+
+def _burn_cpu(seconds: float) -> float:
+    """Spin THIS thread for ~seconds of thread-CPU; returns the burn
+    actually measured by the same clock the ledger uses."""
+    t0 = time.thread_time_ns()
+    x = 0
+    while (time.thread_time_ns() - t0) / 1e9 < seconds:
+        x += 1
+    return (time.thread_time_ns() - t0) / 1e9
+
+
+# --- cell / key math ---------------------------------------------------------
+
+class TestClientKey:
+    def test_ipv4_collapses_to_slash24(self):
+        assert _client_key("10.1.2.3") == "10.1.2.*"
+        assert _client_key("192.168.0.77") == "192.168.0.*"
+
+    def test_non_ipv4_keys_as_itself(self):
+        assert _client_key("::1") == "::1"
+        assert _client_key("") == "?"
+
+
+class TestCell:
+    def test_mass_halves_per_half_life(self):
+        c = _Cell(0.0)
+        c.add(0.0, H, 0.5, 100.0, 200.0, 0.25, 1.0, 2.0, "t1")
+        c.decay(H, H)
+        assert c.req == pytest.approx(0.5)
+        assert c.cpu == pytest.approx(0.25)
+        assert c.bin == pytest.approx(50.0)
+        assert c.miss == pytest.approx(1.0)
+
+    def test_constant_feed_converges_to_rate(self):
+        # one request/second at 1ms CPU and 10 bytes in: after many
+        # half-lives the rate estimate mass*ln2/h converges on the
+        # true per-second rates (the DecayedCounter identity)
+        c = _Cell(0.0)
+        for t in range(200):
+            c.add(float(t), H, 0.001, 10.0, 20.0, 0.0, 1.0, 0.0, "")
+        d = c.doc(200.0, H)
+        assert d["req_rate"] == pytest.approx(1.0, rel=0.1)
+        assert d["cpu_rate"] == pytest.approx(0.001, rel=0.1)
+        assert d["bytes_in_rate"] == pytest.approx(10.0, rel=0.1)
+        assert d["cache_hit_rate"] == pytest.approx(1.0, rel=0.1)
+
+    def test_exemplar_trace_keeps_freshest(self):
+        c = _Cell(0.0)
+        c.add(0.0, H, 0.0, 0.0, 0.0, 0.0, 0.0, 0.0, "old")
+        c.add(1.0, H, 0.0, 0.0, 0.0, 0.0, 0.0, 0.0, "new")
+        c.add(2.0, H, 0.0, 0.0, 0.0, 0.0, 0.0, 0.0, "")  # no trace
+        assert c.trace == "new"
+
+
+# --- the per-server accumulator ----------------------------------------------
+
+class TestRequestLedger:
+    def test_settle_http_lands_in_route_and_client_cells(self):
+        led = RequestLedger(server="vs-a", half_life=H)
+        tok = RequestLedger.begin()
+        burned = _burn_cpu(0.01)
+        led.settle_http(tok, "GET", "/3,01c0ffee", "read_object", 200,
+                        0, 4096, "10.1.2.3", trace_id="tr-1",
+                        queue_wait_s=0.02)
+        snap = led.snapshot()
+        assert set(snap) >= {"server", "ts", "half_life_s", "noted",
+                             "evicted", "routes", "clients", "stall"}
+        row = snap["routes"]["http_read"]
+        assert row["cpu_mass"] >= burned * 0.5
+        assert row["bytes_out_rate"] > 0
+        assert row["queue_wait_rate"] > 0
+        assert row["trace"] == "tr-1"
+        assert "10.1.2.*" in snap["clients"]
+        assert snap["noted"] == 1
+
+    def test_cpu_is_thread_time_not_wall(self):
+        # a request that SLEEPS between begin and settle burned no
+        # thread-CPU: the ledger must not charge the wall clock
+        led = RequestLedger(server="vs-a", half_life=H)
+        tok = RequestLedger.begin()
+        time.sleep(0.05)
+        led.settle_http(tok, "GET", "/3,01aa", "read_object", 200,
+                        0, 10, "10.0.0.1")
+        row = led.snapshot()["routes"]["http_read"]
+        assert row["cpu_mass"] < 0.02
+
+    def test_cpu_delta_is_measured_on_the_executing_thread(self):
+        # the reactor mints the token ON the worker (begin at dispatch
+        # entry): CPU burned by the worker between begin and settle is
+        # attributed even while the spawning thread sleeps
+        led = RequestLedger(server="vs-a", half_life=H)
+        burned = []
+
+        def work():
+            tok = RequestLedger.begin()
+            burned.append(_burn_cpu(0.02))
+            led.settle_native(tok, b"R", 0, 24, 4096, "10.9.8.7")
+
+        t = threading.Thread(target=work)
+        t.start()
+        time.sleep(0.01)  # main thread idles; its CPU is irrelevant
+        t.join()
+        row = led.snapshot()["routes"]["native_read"]
+        assert row["cpu_mass"] >= burned[0] * 0.5
+
+    def test_cache_verdicts_settle_per_request_and_reset(self):
+        led = RequestLedger(server="vs-a", half_life=H)
+        tok = RequestLedger.begin()
+        RequestLedger.note_cache_hit(1, 2, 64)
+        RequestLedger.note_cache_hit(1, 3, 64)
+        RequestLedger.note_cache_miss(1, 4)
+        led.settle_http(tok, "GET", "/3,01aa", "read_object", 200,
+                        0, 64, "10.0.0.1")
+        with led._lock:
+            cell = led._routes["http_read"]
+            assert cell.hit == pytest.approx(2.0, rel=0.01)
+            assert cell.miss == pytest.approx(1.0, rel=0.01)
+        # begin() resets the thread-local tally for the NEXT request
+        tok = RequestLedger.begin()
+        led.settle_http(tok, "GET", "/3,01aa", "read_object", 200,
+                        0, 64, "10.0.0.1")
+        with led._lock:
+            assert led._routes["http_read"].hit == \
+                pytest.approx(2.0, rel=0.01)
+
+    def test_bounded_tables_evict_the_coldest_route(self):
+        led = RequestLedger(server="vs-a", half_life=H, max_routes=2)
+        for op, n in ((b"A", 5), (b"B", 3), (b"C", 1)):
+            for _ in range(n):
+                tok = RequestLedger.begin()
+                led.settle_native(tok, op, 0, 10, 10, "10.0.0.1")
+        st = led.status()
+        assert st["routes"] == 2
+        assert st["evicted"] == 1
+        with led._lock:
+            # native_B was the coldest row at the third insert
+            assert set(led._routes) == {"native_A", "native_C"}
+
+    def test_note_stall_rate_limits_and_refreshes(self):
+        led = RequestLedger(server="vs-a", half_life=H)
+        led.note_stall("http_read", 0.5, "t1")
+        # the watchdog observing the SAME block: no new stall, and a
+        # routeless "(loop)" observation never clobbers the route
+        led.note_stall("(loop)", 0.9)
+        assert led.status()["stalls"] == 1
+        last = led.snapshot()["stall"]["last"]
+        assert last["route"] == "http_read"
+        assert last["lag_ms"] == pytest.approx(500.0)
+        # a routed re-observation refreshes lag and trace in place
+        led.note_stall("http_read", 1.2, "t2")
+        assert led.status()["stalls"] == 1
+        last = led.snapshot()["stall"]["last"]
+        assert last["lag_ms"] == pytest.approx(1200.0)
+        assert last["trace"] == "t2"
+
+    def test_note_stall_classifies_raw_paths_and_borrows_trace(self):
+        # the reactor watchdog only knows the RAW path the loop was
+        # busy on: note_stall speaks route classes and digs the
+        # route's freshest exemplar trace out of the ledger
+        led = RequestLedger(server="vs-a", half_life=H)
+        tok = RequestLedger.begin()
+        led.settle_http(tok, "GET", "/3,01aa", "read_object", 200,
+                        0, 64, "10.0.0.1", trace_id="abc123")
+        led.note_stall("/3,01bb", 2.0)
+        last = led.snapshot()["stall"]["last"]
+        assert last["route"] == "http_read"
+        assert last["trace"] == "abc123"
+
+    def test_settle_detects_on_loop_stall(self):
+        # a request settled ON a reactor loop thread past the
+        # threshold is a stall; the same hold on a worker is not
+        led = RequestLedger(server="vs-a", half_life=H)
+        tok = RequestLedger.begin()
+        time.sleep(LOOP_STALL_THRESHOLD_S + 0.05)
+        led.settle_http(tok, "GET", "/3,01aa", "read_object", 200,
+                        0, 10, "10.0.0.1")
+        assert led.status()["stalls"] == 0  # worker thread: no stall
+        threading.current_thread()._weed_loop = True
+        try:
+            tok = RequestLedger.begin()
+            time.sleep(LOOP_STALL_THRESHOLD_S + 0.05)
+            led.settle_http(tok, "GET", "/3,01aa", "read_object", 200,
+                            0, 10, "10.0.0.1", trace_id="tr-stall")
+        finally:
+            del threading.current_thread()._weed_loop
+        assert led.status()["stalls"] == 1
+        assert led.snapshot()["stall"]["last"]["trace"] == "tr-stall"
+
+    def test_snapshot_carries_loop_and_profile_hooks(self):
+        led = RequestLedger(server="vs-a", half_life=H)
+        led.loop_stats_fn = lambda: {"lag_p99_ms": 1.5}
+        led.profile_fn = lambda: {"top": [], "hz": 7.0}
+        snap = led.snapshot()
+        assert snap["loop"]["lag_p99_ms"] == 1.5
+        assert snap["profile"]["hz"] == 7.0
+        # a raising hook never breaks the snapshot
+        led.loop_stats_fn = lambda: 1 / 0
+        snap = led.snapshot()
+        assert "loop" not in snap
+
+
+# --- shipper -----------------------------------------------------------------
+
+class TestLedgerShipper:
+    def _ledger_with_traffic(self):
+        led = RequestLedger(server="vs-a", half_life=H)
+        tok = RequestLedger.begin()
+        led.settle_http(tok, "GET", "/3,01aa", "read_object", 200,
+                        0, 64, "10.0.0.1")
+        return led
+
+    def test_local_journal_short_circuit(self):
+        j = ClusterLedgerJournal()
+        sh = LedgerShipper(self._ledger_with_traffic(), server="vs-a",
+                           local_journal=j)
+        sh._snap()
+        sh._flush()
+        assert sh.shipped == 1 and sh.dropped == 0
+        doc = j.to_doc()
+        assert "vs-a" in doc["peers"]
+        assert any(r["route"] == "http_read" for r in doc["routes"])
+
+    def test_buffer_full_drops_oldest_and_counts(self):
+        sh = LedgerShipper(self._ledger_with_traffic(), server="vs-a",
+                           local_journal=ClusterLedgerJournal(),
+                           buffer_cap=2)
+        for _ in range(3):
+            sh._snap()
+        assert sh.dropped == 1
+        with sh._lock:
+            assert len(sh._buf) == 2
+
+    def test_detach_flushes_a_final_snapshot(self):
+        j = ClusterLedgerJournal()
+        sh = LedgerShipper(self._ledger_with_traffic(), server="vs-a",
+                           local_journal=j)
+        sh.detach()  # never attached: still snaps + flushes
+        assert "vs-a" in j.to_doc()["peers"]
+
+
+# --- master-side journal -----------------------------------------------------
+
+def _row(cpu, req=1.0, trace=""):
+    return {"req_rate": req, "cpu_rate": cpu, "bytes_in_rate": 10.0,
+            "bytes_out_rate": 20.0, "queue_wait_rate": 0.001,
+            "cache_hit_rate": 0.5, "cache_miss_rate": 0.1,
+            "cpu_mass": cpu * 10.0, "trace": trace}
+
+
+def _snap(server, ts, routes=None, stall=None, loop=None):
+    doc = {"server": server, "ts": ts, "half_life_s": 60.0,
+           "noted": 1, "evicted": 0, "routes": routes or {},
+           "clients": {}, "stall": stall or {"count": 0, "last": None}}
+    if loop is not None:
+        doc["loop"] = loop
+    return doc
+
+
+class TestClusterLedgerJournal:
+    def test_merge_sums_rates_and_excludes_stale_peers(self):
+        j = ClusterLedgerJournal(stale_s=15.0)
+        now = time.time()
+        j.ingest("vs-a", [_snap("vs-a", now,
+                                {"http_read": _row(0.2, trace="tA")},
+                                loop={"lag_p99_ms": 2.0})])
+        j.ingest("vs-b", [_snap("vs-b", now,
+                                {"http_read": _row(0.3)})])
+        j.ingest("vs-c", [_snap("vs-c", now - 100.0,
+                                {"http_read": _row(9.9)})])
+        m = j.merged(now)
+        row = m["routes"]["http_read"]
+        assert row["cpu_rate"] == pytest.approx(0.5)
+        assert sorted(row["servers"]) == ["vs-a", "vs-b"]
+        assert "vs-c" not in m["servers"]
+        assert m["servers"]["vs-a"]["loop_lag_p99_ms"] == 2.0
+
+    def test_ingest_keeps_the_freshest_snapshot(self):
+        j = ClusterLedgerJournal()
+        now = time.time()
+        j.ingest("vs-a", [_snap("vs-a", now - 1.0),
+                          _snap("vs-a", now,
+                                {"assign": _row(0.1)}),
+                          _snap("vs-a", now - 2.0)])
+        assert "assign" in j.merged(now)["routes"]
+
+    def test_to_doc_ranks_by_cpu_and_stamps_share(self):
+        j = ClusterLedgerJournal()
+        now = time.time()
+        j.ingest("vs-a", [_snap("vs-a", now, {
+            "http_read": _row(0.3), "ops": _row(0.1)})])
+        doc = j.to_doc(top=5)
+        assert doc["routes"][0]["route"] == "http_read"
+        assert doc["routes"][0]["cpu_share"] == pytest.approx(0.75)
+        assert doc["totals"]["cpu_rate"] == pytest.approx(0.4)
+        assert doc["servers"][0]["server"] == "vs-a"
+        assert doc["peers"]["vs-a"]["stale"] is False
+
+    def test_stall_relay_emits_once_per_new_count(self):
+        j = ClusterLedgerJournal(min_event_interval=0.0)
+        now = time.time()
+        stall = {"count": 1, "last": {"ts": now, "route": "http_read",
+                                      "lag_ms": 800.0, "trace": "abc"}}
+        j.ingest("vs-a", [_snap("vs-a", now, stall=dict(stall))])
+        doc = j.to_doc()
+        assert len(doc["stalls"]) == 1
+        ev = doc["stalls"][0]
+        assert ev["type"] == "loop_stall"
+        assert ev["details"]["route"] == "http_read"
+        assert ev["details"]["lag_ms"] == 800.0
+        assert ev["trace"] == "abc"
+        # same count again: already seen, no re-fire
+        j.ingest("vs-a", [_snap("vs-a", time.time(),
+                                stall=dict(stall))])
+        assert len(j.to_doc()["stalls"]) == 1
+        # a NEW stall (count grew) fires again
+        stall["count"] = 2
+        j.ingest("vs-a", [_snap("vs-a", time.time(),
+                                stall=dict(stall))])
+        assert len(j.to_doc()["stalls"]) == 2
+
+    def test_stall_relay_rate_limit_floor(self):
+        j = ClusterLedgerJournal(min_event_interval=3600.0)
+        now = time.time()
+
+        def st(count):
+            return {"count": count,
+                    "last": {"ts": now, "route": "http_read",
+                             "lag_ms": 500.0, "trace": ""}}
+
+        j.ingest("vs-a", [_snap("vs-a", now, stall=st(1))])
+        j.ingest("vs-a", [_snap("vs-a", time.time(), stall=st(2))])
+        assert len(j.to_doc()["stalls"]) == 1  # inside the floor
+
+
+# --- alert rules -------------------------------------------------------------
+
+class TestLedgerAlertRules:
+    def test_default_rules_cover_every_ledger_event_type(self):
+        rules = {r.name: r for r in default_rules()}
+        for etype in LEDGER_EVENT_TYPES:
+            r = rules[etype]
+            assert r.kind == "journal_event"
+            assert r.params["event"] == etype
+            assert r.severity == _events.EVENT_TYPES[etype]
+
+    def test_loop_stall_rule_fires_and_resolves(self):
+        engine = AlertEngine(
+            [Rule("loop_stall", "journal_event", severity="error",
+                  keep_firing_s=0.0,
+                  params={"event": "loop_stall", "window_s": 5.0})],
+            source_fn=lambda: ({}, {}), min_interval=0.0)
+        doc = engine.evaluate(now=time.time(), force=True)
+        assert doc["alerts"][0]["state"] == "inactive"
+        time.sleep(0.005)  # clear the ms rounding on the event ts
+        _events.emit("loop_stall", server="vs-a", route="http_read",
+                     lag_ms=812.0, stalls=1, servers=["vs-a"],
+                     trace_id="feedface")
+        doc = engine.evaluate(now=time.time(), force=True)
+        a = doc["alerts"][0]
+        assert a["state"] == "firing"
+        assert "route=http_read" in a["detail"]
+        assert a["servers"] == ["vs-a"]
+        doc = engine.evaluate(now=time.time() + 300.0, force=True)
+        assert doc["alerts"][0]["state"] == "resolved"
+
+
+# --- W401 / W1101 drift checks -----------------------------------------------
+
+class TestW401LedgerChecks:
+    def test_live_tables_are_consistent(self):
+        from tools.weedlint.rules_health_keys import check_live_tables
+        assert check_live_tables() == []
+        assert set(LEDGER_EVENT_TYPES) <= set(_events.EVENT_TYPES)
+        assert len(LEDGER_METRIC_FAMILIES) == 7
+
+    def test_metric_families_are_registered(self):
+        # touching the live accessors registers the families; W401's
+        # live check walks the same registry
+        from seaweedfs_tpu.stats.metrics import (REGISTRY,
+                                                 dataplane_metrics,
+                                                 ledger_metrics)
+        ledger_metrics()
+        dataplane_metrics()
+        text = REGISTRY.expose()
+        for family in LEDGER_METRIC_FAMILIES:
+            assert family in text
+
+
+class TestW1101Rule:
+    def test_missing_settle_is_caught(self):
+        from tools.weedlint.rules_ledger import check_dispatch_source
+        src = ("class Router:\n"
+               "    def dispatch(self, handler, command):\n"
+               "        tok = self.ledger.begin()\n"
+               "        return tok\n")
+        msgs = [f.message for f in check_dispatch_source(src, "x.py")]
+        assert any("settle_http" in m for m in msgs)
+        assert not any("begin" in m for m in msgs)
+
+    def test_missing_begin_is_caught_on_framing(self):
+        from tools.weedlint.rules_ledger import check_framing_source
+        src = ("def serve_frame(sock, ledger=None):\n"
+               "    ledger.settle_native(None, b'R', 0, 0, 0, '')\n")
+        msgs = [f.message for f in check_framing_source(src, "x.py")]
+        assert any("begin" in m for m in msgs)
+
+    def test_missing_chokepoint_function_is_caught(self):
+        from tools.weedlint.rules_ledger import check_dispatch_source
+        v = check_dispatch_source("x = 1\n", "x.py")
+        assert v and "not found" in v[0].message
+
+    def test_real_chokepoints_pass(self):
+        import os
+
+        from tools.weedlint.rules_ledger import (FRAMING_REL,
+                                                 HTTPD_REL,
+                                                 check_dispatch_source,
+                                                 check_framing_source)
+        root = os.path.dirname(os.path.dirname(os.path.abspath(
+            __file__)))
+        with open(os.path.join(root, HTTPD_REL)) as f:
+            assert check_dispatch_source(f.read(), HTTPD_REL) == []
+        with open(os.path.join(root, FRAMING_REL)) as f:
+            assert check_framing_source(f.read(), FRAMING_REL) == []
+
+
+# --- windowed profiler -------------------------------------------------------
+
+class TestWindowedProfiler:
+    def test_window_floor_is_clamped(self):
+        assert WindowedProfiler(window_s=0.01).window_s == 1.0
+
+    def test_rotates_and_reports_top_stacks(self):
+        p = WindowedProfiler(hz=50.0, window_s=1.0, max_windows=4,
+                             top_k=5)
+        p.start()
+        try:
+            _burn_cpu(1.3)  # span a rotation with real samples
+        finally:
+            p.stop()
+        assert p.rotations >= 1
+        s = p.summary()
+        assert set(s) == {"hz", "window_s", "windows", "top", "rising"}
+        assert s["windows"] >= 1
+        assert s["top"], "profiler saw no stacks while a thread spun"
+        row = s["top"][0]
+        # share normalizes by window SAMPLES, not total hits: N idle
+        # threads parked on the same Event.wait share one collapsed
+        # stack, so a full process legitimately reads share > 1.0
+        assert row["hits"] >= 1 and row["share"] > 0.0
+        assert isinstance(p.diff(), list)
+
+    def test_bounded_window_history(self):
+        p = WindowedProfiler(hz=20.0, window_s=1.0, max_windows=2)
+        p.start()
+        try:
+            time.sleep(3.3)
+        finally:
+            p.stop()
+        assert p.summary()["windows"] <= 2
+
+
+# --- live plane --------------------------------------------------------------
+
+@pytest.fixture
+def ledger_cluster(tmp_path):
+    from seaweedfs_tpu.master.server import MasterServer
+    from seaweedfs_tpu.volume_server.server import VolumeServer
+    from tests.conftest import free_port
+
+    master = MasterServer(port=free_port(), pulse_seconds=0.3).start()
+    vols = []
+    for i in range(2):
+        d = tmp_path / f"vs{i}"
+        d.mkdir()
+        vols.append(VolumeServer([str(d)], master.url,
+                                 port=free_port(), pulse_seconds=0.3,
+                                 ledger_halflife_s=30.0).start())
+    deadline = time.time() + 5
+    while time.time() < deadline and len(master.topo.all_nodes()) < 2:
+        time.sleep(0.05)
+    yield master, vols
+    for v in vols:
+        v.stop()
+    master.stop()
+
+
+class TestLiveLedgerPlane:
+    def test_ledger_flows_end_to_end(self, ledger_cluster, tmp_path):
+        from seaweedfs_tpu.client.operation import WeedClient
+        from seaweedfs_tpu.shell.commands import CommandEnv, run_command
+        from seaweedfs_tpu.utils.httpd import http_bytes, http_json
+
+        master, vols = ledger_cluster
+        client = WeedClient(master.url)
+        payload = b"cost-object" * 600
+        fid = client.upload(payload)
+        vid = int(fid.split(",")[0])
+        holder = next(vs for vs in vols if vid in vs.store.volumes)
+        for _ in range(12):
+            st, body, _ = http_bytes("GET",
+                                     f"http://{holder.url}/{fid}")
+            assert st == 200 and body == payload
+
+        # the holder's own accumulator saw the reads
+        snap = http_json("GET", f"http://{holder.url}/debug/ledger")
+        assert snap["server"] == holder.url
+        row = snap["routes"]["http_read"]
+        assert row["req_rate"] > 0 and row["bytes_out_rate"] > 0
+        assert "profile" in snap  # always-on windowed profiler
+        # writes settled too (the upload's replicated POST)
+        assert any(r.startswith("http_write") or r == "internal"
+                   for r in snap["routes"])
+
+        # the shipper (1s cadence) lands it in the master's journal
+        doc, row = None, None
+        deadline = time.time() + 8
+        while time.time() < deadline and row is None:
+            doc = http_json("GET",
+                            f"http://{master.url}/cluster/ledger?top=8")
+            row = next((r for r in doc.get("routes") or []
+                        if r["route"] == "http_read"), None)
+            if row is None:
+                time.sleep(0.2)
+        assert row is not None, "ledger never reached the master"
+        assert holder.url in row["servers"]
+        assert 0.0 <= row["cpu_share"] <= 1.0
+        assert doc["totals"]["req_rate"] > 0
+        # the master accounts its OWN requests via the local journal
+        assert master.url in doc["peers"]
+        assert holder.url in doc["peers"]
+        # per-client table keys by /24 (loopback traffic -> 127.0.0.*)
+        assert any(c["client"].endswith(".*")
+                   for c in doc.get("clients") or [])
+
+        # ship cadence refreshes the per-route Prometheus gauges
+        deadline = time.time() + 8
+        text = ""
+        while time.time() < deadline and \
+                "SeaweedFS_ledger_route_cpu_rate" not in text:
+            st, body, _ = http_bytes("GET",
+                                     f"http://{holder.url}/metrics")
+            text = body.decode()
+            if "SeaweedFS_ledger_route_cpu_rate" not in text:
+                time.sleep(0.3)
+        assert 'route="http_read"' in text
+
+        # cluster.top renders both axes off the same document
+        env = CommandEnv(master.url)
+        out = run_command(env, "cluster.top")
+        assert "http_read" in out and "cpu" in out
+        out = run_command(env, "cluster.top -by server")
+        assert holder.url in out
+        out = run_command(env, "cluster.top -by client")
+        assert ".*" in out
+
+    def test_ledger_off_disables_the_plane(self, ledger_cluster,
+                                           tmp_path):
+        from seaweedfs_tpu.utils.httpd import http_bytes, http_json
+        from seaweedfs_tpu.volume_server.server import VolumeServer
+        from tests.conftest import free_port
+
+        master, _ = ledger_cluster
+        d = tmp_path / "vs-off"
+        d.mkdir()
+        vs = VolumeServer([str(d)], master.url, port=free_port(),
+                          pulse_seconds=0.3, ledger=False).start()
+        try:
+            assert vs.router.ledger is None
+            st, _, _ = http_bytes("GET",
+                                  f"http://{vs.url}/debug/ledger")
+            assert st == 404
+            # serving still works unaccounted
+            doc = http_json("GET", f"http://{vs.url}/status")
+            assert "Ledger" not in doc
+        finally:
+            vs.stop()
+
+
+# --- loop-stall drill --------------------------------------------------------
+
+@pytest.mark.skipif(
+    __import__("os").environ.get("WEED_DATAPLANE") == "threaded",
+    reason="the drill blocks the reactor loop; threaded fallback "
+           "has no loop to stall")
+class TestLoopStallDrill:
+    def test_blocked_loop_pages_within_5s_naming_the_route(
+            self, ledger_cluster):
+        from seaweedfs_tpu.shell.commands import CommandEnv, run_command
+        from seaweedfs_tpu.utils import faultinject as fi
+        from seaweedfs_tpu.utils.httpd import http_bytes, http_json
+
+        master, vols = ledger_cluster
+        from seaweedfs_tpu.client.operation import WeedClient
+        from seaweedfs_tpu.observability.context import (sample_rate,
+                                                         set_sample_rate)
+        from seaweedfs_tpu.observability.tracer import (disable_tracing,
+                                                        enable_tracing,
+                                                        get_tracer)
+
+        # the drill's acceptance bar includes an exemplar trace on the
+        # page: sample every request so the http_read cell carries one
+        tracing_was_on = get_tracer().enabled
+        prev_rate = sample_rate()
+        enable_tracing()
+        set_sample_rate(1.0)
+        client = WeedClient(master.url)
+        payload = b"stall-object" * 5000  # ~60 KiB
+        fid = client.upload(payload)
+        vid = int(fid.split(",")[0])
+        holder = next(vs for vs in vols if vid in vs.store.volumes)
+        url = f"http://{holder.url}/{fid}"
+        # the in-process fixture shares ONE reactor between both
+        # volume servers, so the watchdog's stall_hook points at
+        # whichever server wired it LAST; aim it at the server the
+        # drill stalls (in production every server owns its reactor)
+        from seaweedfs_tpu.utils.eventloop import get_reactor
+        get_reactor().stall_hook = holder.ledger.note_stall
+        # pump http_read: admits the needle to the cache (so the NEXT
+        # read takes the inline ON-LOOP fast path — the drill's
+        # injection site) and builds CPU mass + exemplar traces that
+        # make http_read the top route
+        for _ in range(150):
+            st, body, _ = http_bytes("GET", url)
+            assert st == 200 and body == payload
+
+        try:
+            # inject a 2s block ON the loop: must exceed the
+            # watchdog's 1.0s select-timeout allowance + the 0.25s
+            # stall threshold so the lag verdict trips mid-block
+            fi.enable("loop.block", delay=2.0, max_hits=1)
+            t0 = time.time()
+            blocked = threading.Thread(
+                target=lambda: http_bytes("GET", url, timeout=30.0),
+                daemon=True)
+            blocked.start()
+
+            # the page: watchdog (out-of-band thread) records the
+            # stall against the raw path -> classified http_read with
+            # a borrowed exemplar trace; the shipper lands it on the
+            # master once the loop unblocks; the master relays it as
+            # a loop_stall journal event; the default rule fires
+            fired, latency = None, None
+            while time.time() - t0 < 10.0:
+                doc = master.alert_engine.evaluate(force=True)
+                a = next((x for x in doc["alerts"]
+                          if x["name"] == "loop_stall"), None)
+                if a is not None and a["state"] == "firing":
+                    fired, latency = a, time.time() - t0
+                    break
+                time.sleep(0.2)
+            assert fired is not None, "loop_stall never fired"
+            assert latency <= 5.0, f"paged too late: {latency:.1f}s"
+            assert "route=http_read" in fired["detail"]
+            assert holder.url in fired["servers"]
+            blocked.join(timeout=10.0)
+
+            # the relayed journal event names the route AND carries
+            # the exemplar trace borrowed from the http_read cell
+            ldoc = http_json(
+                "GET", f"http://{master.url}/cluster/ledger?top=8")
+            assert ldoc["stalls"], "stall event missing from journal"
+            ev = ldoc["stalls"][-1]
+            assert ev["details"]["route"] == "http_read"
+            assert ev["details"]["lag_ms"] >= 250.0
+            assert ev.get("trace"), "stall event lost its exemplar"
+            # the offender tops the CPU ranking
+            assert ldoc["routes"][0]["route"] == "http_read"
+            env = CommandEnv(master.url)
+            out = run_command(env, "cluster.top")
+            assert "http_read" in out and "loop_stall" in out
+        finally:
+            fi.clear()
+            set_sample_rate(prev_rate)
+            if not tracing_was_on:
+                disable_tracing()
+
+        # unblocked + outside the event window: the page resolves
+        doc = master.alert_engine.evaluate(now=time.time() + 300.0,
+                                           force=True)
+        a = next(x for x in doc["alerts"] if x["name"] == "loop_stall")
+        assert a["state"] == "resolved"
+
+        # drain the firing transition's flight-capture fan-out thread
+        # before leaving: a straggler emitting flight_capture into the
+        # process-global journal would bleed into the NEXT test
+        for t in threading.enumerate():
+            if t.name == "flight-capture":
+                t.join(timeout=20)

@@ -182,13 +182,15 @@ def test_restarted_master_catches_up_via_snapshot(tmp_path):
     try:
         leader = _wait_one_leader(masters)
         followers = [m for m in masters if m is not leader]
-        # detach follower event shippers: with co-located masters every
+        # detach ALL event shippers: with co-located masters every
         # master's shipper short-circuits process events into its OWN
-        # journal (via=itself); detaching makes raft apply the ONLY
-        # fill path on followers, so the preserved `via` labels — and
-        # therefore the state hashes — can match exactly
-        for f in followers:
-            f._event_shipper.detach()
+        # journal (via=itself), so any background emission in this
+        # process (including stragglers from earlier tests) lands with
+        # three different `via` labels and the id-dedup'd journals can
+        # never reconverge.  With no shippers, the POST ingest below is
+        # the only fill path and the state hashes are deterministic.
+        for m in masters:
+            m._event_shipper.detach()
         victim = followers[-1]
         vi = masters.index(victim)
         victim_last = victim.raft.log.last_index
@@ -243,23 +245,33 @@ def test_restarted_master_catches_up_via_snapshot(tmp_path):
         ids = {e["id"] for e in m3.event_journal.query(limit=0)}
         assert want <= ids, f"missing events: {sorted(want - ids)[:5]}"
 
-        # state-hash equality: all three masters serve the same views
-        leader_view = _view(leader)
+        # state-hash equality: all three masters serve the same views.
+        # Background emissions (alert transitions, shipped snapshots) may
+        # still be replicating when we get here, so poll both sides until
+        # they converge instead of comparing a single racy instant.
         for m in masters:
             if m is leader:
                 continue
-            v = _view(m)
-            if _state_hash(v) != _state_hash(leader_view):
-                mine = {e["id"]: e for e in v["events"]}
-                theirs = {e["id"]: e for e in leader_view["events"]}
-                diff = [eid for eid in theirs
-                        if mine.get(eid) != theirs[eid]]
-                raise AssertionError(
-                    f"state hash mismatch on {m.url}: "
-                    f"missing/differing events {diff[:5]}, "
-                    f"extra {sorted(set(mine) - set(theirs))[:5]}, "
-                    f"coordinator mine={v['coordinator']} "
-                    f"theirs={leader_view['coordinator']}")
+            conv_deadline = time.time() + 10
+            while True:
+                leader_view = _view(leader)
+                v = _view(m)
+                if _state_hash(v) == _state_hash(leader_view):
+                    break
+                if time.time() >= conv_deadline:
+                    mine = {e["id"]: e for e in v["events"]}
+                    theirs = {e["id"]: e for e in leader_view["events"]}
+                    diff = [eid for eid in theirs
+                            if mine.get(eid) != theirs[eid]]
+                    raise AssertionError(
+                        f"state hash mismatch on {m.url}: "
+                        f"missing/differing events {diff[:5]}, "
+                        f"extra {sorted(set(mine) - set(theirs))[:5]}, "
+                        f"first diff: mine={mine.get(diff[0]) if diff else None} "
+                        f"theirs={theirs[diff[0]] if diff else None}, "
+                        f"coordinator mine={v['coordinator']} "
+                        f"theirs={leader_view['coordinator']}")
+                time.sleep(0.2)
         assert leader.coordinator.export_replicated()["pending"] \
             .get("77", {}).get("cause_trace") == "ab" * 16
 

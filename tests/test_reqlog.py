@@ -545,13 +545,17 @@ class TestLiveDrill:
             out = run_command(env, "workload.stop")
             assert "stopped" in out
 
-            # shipper flush: the master journal converges
+            # shipper flush: the master journal converges — including
+            # the master's OWN submit records, which ride a different
+            # shipper cadence than the volume server's bulk
             deadline = time.time() + 8
             while time.time() < deadline:
                 doc = http_json(
                     "GET", f"http://{m.url}/cluster/workload/export",
                     timeout=10.0)
-                if doc["summary"]["records"] >= 180:
+                if doc["summary"]["records"] >= 180 and len(
+                        [r for r in doc["records"]
+                         if r.get("handler") == "submit"]) >= 4:
                     break
                 time.sleep(0.2)
             prof = recording_profile(doc)

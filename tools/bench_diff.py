@@ -83,6 +83,13 @@ KEY_METRICS: list[tuple] = [
     # the space-saving sketch must keep finding the Zipf head
     ("heat.accounting_overhead_pct", "down", 1.0),
     ("heat.sketch_head_recall", "up", 0.05),
+    # resource-ledger plane (observability/ledger.py): per-request
+    # CPU/bytes/queue-wait accounting PLUS the always-on windowed
+    # profiler must stay under 1% of read rps vs the -ledger.off
+    # baseline, and the serving loop's lag p99 must stay inside the
+    # interactive budget under the bench read mix
+    ("resource_ledger.ledger_overhead_pct", "down", 1.0),
+    ("resource_ledger.loop_lag_p99_ms", "down", 5.0),
     # master HA failover drill (scenarios/failover.py): the raft
     # journal contract is ZERO pre-kill events lost across an election
     # (any increase is a regression — the 0.5 floor only absorbs float

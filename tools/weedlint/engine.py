@@ -271,9 +271,10 @@ def _load_builtin_rules() -> None:
     _loaded = True
     from . import (rules_async_drain, rules_bench,  # noqa: F401
                    rules_blocking, rules_eventloop, rules_faults,
-                   rules_health_keys, rules_leader, rules_lockorder,
-                   rules_lockset, rules_py310, rules_resources,
-                   rules_routes, rules_timeouts, rules_tracing)
+                   rules_health_keys, rules_leader, rules_ledger,
+                   rules_lockorder, rules_lockset, rules_py310,
+                   rules_resources, rules_routes, rules_timeouts,
+                   rules_tracing)
 
 
 # --- waivers -----------------------------------------------------------------

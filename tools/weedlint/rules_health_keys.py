@@ -38,13 +38,19 @@ EVENTS_REL = os.path.join("seaweedfs_tpu", "observability", "events.py")
 # measured run itself degraded.  dataplane_conn_aborts is a serving-
 # plane load/teardown condition (a slow client lost its connection, a
 # stop aborted in-flight work) — it pages through its counter rule but
-# does not make an encode/read MEASUREMENT degraded.
+# does not make an encode/read MEASUREMENT degraded.  loop_lag is the
+# same kind of serving-plane saturation condition (the reactor loop
+# was blocked; requests waited) — it pages through its counter rule
+# and the loop_stall journal-event relay, but an encode/read run's
+# MEASUREMENT is not retroactively degraded because the serving loop
+# hiccuped.
 DEGRADE_KEY_ALLOWLIST = ("degraded_binds", "ec_under_replicated",
                          "coordinator_repair_failures",
                          "requests_shed", "deadline_exceeded",
                          "retry_budget_exhausted",
                          "reqlog_records_dropped",
-                         "dataplane_conn_aborts")
+                         "dataplane_conn_aborts",
+                         "loop_lag")
 
 # DEGRADE_COUNTER_KEYS entries that are per-run encode stats rather
 # than cluster counter families.
@@ -181,17 +187,26 @@ def check_live_tables() -> list[str]:
                                                     HEALTH_EVENT_TYPES)
     from seaweedfs_tpu.observability.heat import (HEAT_EVENT_TYPES,
                                                   HEAT_METRIC_FAMILIES)
+    from seaweedfs_tpu.observability.ledger import (LEDGER_EVENT_TYPES,
+                                                    LEDGER_METRIC_FAMILIES)
     from seaweedfs_tpu.stats.aggregate import HEALTH_FAMILIES
-    from seaweedfs_tpu.stats.metrics import REGISTRY, heat_metrics
+    from seaweedfs_tpu.stats.metrics import (REGISTRY, dataplane_metrics,
+                                             heat_metrics, ledger_metrics)
 
-    heat_metrics()  # force-register the heat families (lazy singleton)
+    # force-register the lazily-created singletons whose families the
+    # declared tuples promise
+    heat_metrics()
+    ledger_metrics()
+    dataplane_metrics()
     registered = {getattr(c, "name", "") for c in REGISTRY._collectors}
     return check_tables(HEALTH_FAMILIES, DEGRADE_COUNTER_KEYS,
                         default_rules(), EVENT_TYPES,
                         HEALTH_EVENT_TYPES,
                         extra_health_keys=EXTRA_HEALTH_KEYS,
-                        journal_event_types=HEAT_EVENT_TYPES,
-                        heat_metric_families=HEAT_METRIC_FAMILIES,
+                        journal_event_types=HEAT_EVENT_TYPES
+                        + LEDGER_EVENT_TYPES,
+                        heat_metric_families=HEAT_METRIC_FAMILIES
+                        + LEDGER_METRIC_FAMILIES,
                         registered_metrics=registered)
 
 

@@ -1,0 +1,110 @@
+"""W1101: new code cannot silently opt out of resource accounting.
+
+The resource ledger (observability/ledger.py) is enforced at the same
+two chokepoints tracing is (W201): utils/httpd.py Router.dispatch is
+the ONE ingress every HTTP handler runs under, and
+utils/framing.serve_frame is the ONE per-frame path both native-TCP
+fronts (threaded accept loop and reactor dataplane) share.  Each must
+stamp the request with RequestLedger.begin() on entry and settle it
+(settle_http / settle_native) on the way out — otherwise a whole
+ingress class runs unaccounted and `cluster.top` silently lies about
+who is consuming the serving CPU.
+
+A genuinely-unaccountable path is waived per line with
+`# weedlint: disable=W1101 <reason>`; the checked-in baseline stays
+EMPTY — both chokepoints are wired, so a violation here is a
+regression, never legacy debt.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from .engine import Finding, Repo, Rule, register
+from .rules_tracing import _calls_in, _functions
+
+PACKAGE = "seaweedfs_tpu"
+HTTPD_REL = os.path.join(PACKAGE, "utils", "httpd.py")
+FRAMING_REL = os.path.join(PACKAGE, "utils", "framing.py")
+
+
+def check_dispatch_source(src: str, path: str) -> list[Finding]:
+    """The HTTP-ingress accounting contract on utils/httpd.py."""
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:
+        return [Finding("W1101", path, e.lineno or 0,
+                        f"does not parse: {e.msg}")]
+    fns = _functions(tree)
+    dispatch = fns.get("dispatch")
+    if dispatch is None:
+        return [Finding("W1101", path, 0, "Router.dispatch not found")]
+    problems: list[Finding] = []
+    calls = _calls_in(dispatch)
+    if "begin" not in calls:
+        problems.append(Finding(
+            "W1101", path, dispatch.lineno,
+            "Router.dispatch no longer calls ledger.begin() — HTTP "
+            "requests would run with no thread-CPU baseline and the "
+            "resource ledger would attribute nothing"))
+    if "settle_http" not in calls:
+        problems.append(Finding(
+            "W1101", path, dispatch.lineno,
+            "Router.dispatch no longer calls ledger.settle_http() — "
+            "HTTP requests would never land in the per-route/per-"
+            "client ledgers and cluster.top would miss the whole "
+            "HTTP ingress"))
+    return problems
+
+
+def check_framing_source(src: str, path: str) -> list[Finding]:
+    """The framed-TCP accounting contract on utils/framing.py."""
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:
+        return [Finding("W1101", path, e.lineno or 0,
+                        f"does not parse: {e.msg}")]
+    fns = _functions(tree)
+    frame_fn = fns.get("serve_frame")
+    if frame_fn is None:
+        return [Finding("W1101", path, 0,
+                        "framing.serve_frame not found")]
+    problems: list[Finding] = []
+    calls = _calls_in(frame_fn)
+    if "begin" not in calls:
+        problems.append(Finding(
+            "W1101", path, frame_fn.lineno,
+            "serve_frame no longer calls ledger.begin() — native "
+            "frames would run with no thread-CPU baseline"))
+    if "settle_native" not in calls:
+        problems.append(Finding(
+            "W1101", path, frame_fn.lineno,
+            "serve_frame no longer calls ledger.settle_native() — "
+            "the native TCP ingress would run unaccounted and "
+            "cluster.top would miss the fast plane entirely"))
+    return problems
+
+
+@register
+class LedgerChokepointRule(Rule):
+    id = "W1101"
+    name = "ledger-chokepoint"
+    summary = ("both ingress chokepoints must stamp and settle the "
+               "per-request resource ledger (begin/settle_http in "
+               "Router.dispatch, begin/settle_native in serve_frame)")
+    hint = ("keep the ledger.begin()/settle_*() pair at the "
+            "chokepoint, or waive a genuinely-unaccountable path with "
+            "`# weedlint: disable=W1101 <reason>`")
+
+    def check(self, repo: Repo) -> list[Finding]:
+        problems: list[Finding] = []
+        httpd = repo.get(HTTPD_REL)
+        if httpd is not None:
+            problems.extend(
+                check_dispatch_source(httpd.source, HTTPD_REL))
+        framing = repo.get(FRAMING_REL)
+        if framing is not None:
+            problems.extend(
+                check_framing_source(framing.source, FRAMING_REL))
+        return problems

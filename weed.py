@@ -143,7 +143,9 @@ def cmd_volume(args) -> None:
                       needle_cache_mb=args.dataplane_cache_mb,
                       heat=not args.heat_off,
                       heat_halflife_s=args.heat_halflife,
-                      heat_topk=args.heat_topk).start()
+                      heat_topk=args.heat_topk,
+                      ledger=not args.ledger_off,
+                      ledger_halflife_s=args.ledger_halflife).start()
     print(f"volume server listening on {vs.url}, dirs {args.dir}")
     _on_interrupt(vs.stop)
     _wait_forever()
@@ -306,7 +308,9 @@ def cmd_server(args) -> None:
                       needle_cache_mb=args.dataplane_cache_mb,
                       heat=not args.heat_off,
                       heat_halflife_s=args.heat_halflife,
-                      heat_topk=args.heat_topk).start()
+                      heat_topk=args.heat_topk,
+                      ledger=not args.ledger_off,
+                      ledger_halflife_s=args.ledger_halflife).start()
     print(f"master on {m.url}, volume server on {vs.url}")
     if args.filer:
         store = SqliteStore(args.dir.split(",")[0] + "/filer.db")
@@ -1226,6 +1230,13 @@ def main(argv=None) -> None:
                    default=512, metavar="K",
                    help="per-needle heat sketch capacity (space-saving "
                         "top-K)")
+    v.add_argument("-ledger.off", dest="ledger_off", action="store_true",
+                   help="disable per-request resource-ledger accounting "
+                        "and continuous profiling (GET /debug/ledger, "
+                        "master /cluster/ledger feed, cluster.top)")
+    v.add_argument("-ledger.halflife", dest="ledger_halflife",
+                   type=float, default=60.0, metavar="SECONDS",
+                   help="EWMA half-life for ledger rate decay (seconds)")
     v.set_defaults(fn=cmd_volume)
 
     s = sub.add_parser("server")
@@ -1271,6 +1282,12 @@ def main(argv=None) -> None:
                    default=512, metavar="K",
                    help="per-needle heat sketch capacity (space-saving "
                         "top-K)")
+    s.add_argument("-ledger.off", dest="ledger_off", action="store_true",
+                   help="disable per-request resource-ledger accounting "
+                        "and continuous profiling on the volume server")
+    s.add_argument("-ledger.halflife", dest="ledger_halflife",
+                   type=float, default=60.0, metavar="SECONDS",
+                   help="EWMA half-life for ledger rate decay (seconds)")
     s.set_defaults(fn=cmd_server)
 
     fl = sub.add_parser("filer")
