@@ -58,7 +58,7 @@ SECTION_CAPS = {
     "cluster_native": 360, "cluster_scaled": 420, "parity": 120,
     "integrity": 120, "scenarios": 300, "capacity": 420,
     "heat": 420, "pipeline_health": 15, "multichip_encode": 420,
-    "master_failover": 180, "resource_ledger": 420,
+    "master_failover": 180, "resource_ledger": 420, "autoscale": 420,
 }
 SECTION_CAP_DEFAULT = 300
 SECTION_MIN_S = 15          # least useful remaining budget to even start
@@ -1894,6 +1894,107 @@ def _child(scratch_path: str, platform: str = "") -> None:
         detail["resource_ledger"] = block
 
     section("resource_ledger", meas_resource_ledger)
+
+    # --- heat autoscaler: closed-loop grow + cold tiering ------------------
+    def meas_autoscale():
+        """Heat-autoscaler acceptance (ISSUE 20): (a) the closed-loop
+        flash-crowd drill (scenarios/spec.flash_crowd_autoscale) with
+        the autoscaler ON against the SAME drill with it OFF —
+        recovery-time-to-SLO (bench_diff floors
+        autoscale.recovery_to_slo_s), post-shift hot-set serving-rate
+        uplift (floors autoscale.hot_rps_uplift_pct at >= 0), grow
+        attribution and the <=1-cycle thrash guard, all from the
+        drill's machine-checked verdict; (b) idle overhead — read rps
+        with the leader loop ticking at -autoscaleSeconds 1 against a
+        loop-off baseline spawned back-to-back, acceptance < 1%
+        (floors autoscale.idle_overhead_pct); (c) cold tiering at the
+        storage layer: median tiered READ-THROUGH latency and the
+        wall-clock to RECALL a 64MB volume from the remote backend
+        (stamps autoscale.tier_recall_s)."""
+        import dataclasses as _dc
+        import tempfile as _tf
+
+        from seaweedfs_tpu.scenarios import (flash_crowd_autoscale,
+                                             run_scenario)
+
+        block: dict = {}
+        on_spec = flash_crowd_autoscale()
+        res_on = run_scenario(on_spec)
+        off_exp = {k: v for k, v in on_spec.expectations.items()
+                   if not k.startswith("autoscale_")}
+        res_off = run_scenario(_dc.replace(
+            on_spec, name="flash_crowd_autoscale_off",
+            autoscale=False, expectations=off_exp))
+        auto = res_on.get("autoscale") or {}
+        on_rps = (res_on.get("heat") or {}).get(
+            "post_shift_read_rps", 0.0)
+        off_rps = (res_off.get("heat") or {}).get(
+            "post_shift_read_rps", 0.0)
+        block["flash_crowd_on"] = {
+            "verdict": res_on.get("verdict"),
+            "checks": res_on.get("checks"),
+            "first_grow_after_shift_s":
+                auto.get("first_grow_after_shift_s"),
+            "grow_events": auto.get("grow_events"),
+            "attributed": auto.get("attributed"),
+            "max_cycles_per_volume": auto.get("max_cycles_per_volume"),
+            "post_shift_read_rps": on_rps,
+        }
+        block["flash_crowd_off"] = {
+            "verdict": res_off.get("verdict"),
+            "post_shift_read_rps": off_rps,
+        }
+        if auto.get("slo_recovery_s") is not None:
+            block["recovery_to_slo_s"] = auto["slo_recovery_s"]
+        if off_rps:
+            block["hot_rps_uplift_pct"] = round(
+                100.0 * (on_rps / off_rps - 1.0), 1)
+        # idle overhead: the leader loop must cost nothing while the
+        # cluster is quiet (no heat above grow_share, nothing tiered)
+        with spawn_cluster(1) as (mport, _root):
+            base = run_bench(mport, 4000, use_tcp=False)
+        block["baseline_read_rps"] = base.get("read", 0.0)
+        with spawn_cluster(1, extra_master_args=(
+                "-autoscaleSeconds", "1.0")) as (mport, _root):
+            rates = run_bench(mport, 4000, use_tcp=False)
+            block["autoscale_read_rps"] = rates.get("read", 0.0)
+        if block["baseline_read_rps"]:
+            block["idle_overhead_pct"] = round(
+                100.0 * (1.0 - block["autoscale_read_rps"]
+                         / block["baseline_read_rps"]), 2)
+        # cold tiering, storage level: 64MB volume -> dir backend,
+        # read THROUGH the tier, then recall it back wholesale
+        from seaweedfs_tpu.storage.backend import configure_backends
+        from seaweedfs_tpu.storage.needle import Needle
+        from seaweedfs_tpu.storage.volume import Volume
+
+        troot = _tf.mkdtemp()
+        remote = os.path.join(troot, "remote")
+        os.makedirs(remote)
+        configure_backends({"bench": {"type": "dir", "root": remote}})
+        v = Volume(troot, "", 9)
+        payload = os.urandom(4 << 20)
+        for i in range(16):  # 64MB across 16 needles
+            v.write_needle(Needle(id=i + 1, cookie=0xB0, data=payload),
+                           check_cookie=False)
+        v.tier_upload_begin("bench")
+        v.tier_commit()
+        lats = []
+        for i in range(8):
+            t0 = time.perf_counter()
+            got = v.read_needle(1 + (i % 16), cookie=0xB0).data
+            lats.append(time.perf_counter() - t0)
+            if len(got) != len(payload):
+                raise RuntimeError("tiered read-through truncated")
+        lats.sort()
+        block["tiered_read_ms"] = round(1e3 * lats[len(lats) // 2], 2)
+        t0 = time.perf_counter()
+        v.tier_download()
+        block["tier_recall_s"] = round(time.perf_counter() - t0, 3)
+        v.close()
+        detail["autoscale"] = block
+
+    section("autoscale", meas_autoscale)
 
     # --- scaled cluster: N volume servers, M client procs ------------------
     def meas_cluster_scaled():

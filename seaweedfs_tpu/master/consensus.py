@@ -495,7 +495,15 @@ class RaftNode:
                 return False
             e = self.log.append(self.term, kind, data)
             idx = e["index"]
-            self.last_applied = max(self.last_applied, idx)
+            # the caller pre-applied THIS entry, but a freshly promoted
+            # leader may still hold an unapplied prior-term tail (its
+            # predecessor's records, committed only once an own-term
+            # entry commits).  Jumping last_applied over that gap would
+            # skip those entries on this node forever; leave the gap to
+            # _apply_committed, which delivers in order (re-delivering
+            # this entry too — every apply branch is idempotent).
+            if self.last_applied == idx - 1:
+                self.last_applied = idx
         if not sync:
             return True
         acked = self._broadcast_append()
@@ -654,6 +662,17 @@ class RaftNode:
                 self._match = {p: 0 for p in self.peers}
                 won = True
         if won:
+            # raft's commit rule never counts prior-term entries, so a
+            # dead leader's log tail (e.g. an autoscaler grow_planned /
+            # tier_pending record appended moments before the crash)
+            # would stay uncommitted until organic traffic appends
+            # something.  A no-op entry in OUR term commits the whole
+            # tail transitively on the first replication round.
+            with self.lock:
+                if self.role == "leader":
+                    e = self.log.append(self.term, "noop", {})
+                    if self.last_applied == e["index"] - 1:
+                        self.last_applied = e["index"]
             self._notify_role("leader")
             self._broadcast_append()
 

@@ -47,6 +47,9 @@ class MasterServer:
                  maintenance_interval_seconds: float = 900.0,
                  metrics_aggregation_seconds: float = 0.0,
                  coordinator_seconds: float = 0.0,
+                 autoscale_seconds: float = 0.0,
+                 autoscale_tier_backend: str = "",
+                 autoscale_opts: Optional[dict] = None,
                  max_inflight: int = 0,
                  tls_context=None):
         self.host, self.port = host, port
@@ -173,6 +176,28 @@ class MasterServer:
             admin_locked_fn=self._admin_locked,
             interval_s=coordinator_seconds or 15.0,
             replicate_fn=self._replicate_coordinator_record)
+        # heat autoscaler (ops/autoscaler.py): the closed loop from the
+        # heat journal's signal to replica-grow / cold-tier actuation.
+        # Wakes event-driven off heat ingest (on_ingest hook) exactly
+        # like the coordinator wakes off the event journal; its
+        # actuation records ride the raft log as the "autoscale" entry
+        # kind.  The loop only runs when -autoscaleSeconds > 0; the
+        # routes and status doc exist regardless.
+        from ..ops.autoscaler import HeatAutoscaler
+
+        self.autoscale_seconds = autoscale_seconds
+        self.autoscaler = HeatAutoscaler(
+            topo=self.topo, server=self.url,
+            heat_fn=lambda: self.heat_journal.to_doc(top_needles=0),
+            stale_peers_fn=self._stale_peers,
+            is_leader_fn=lambda: self.is_leader,
+            admin_locked_fn=self._admin_locked,
+            interval_s=autoscale_seconds or 5.0,
+            tier_backend=autoscale_tier_backend,
+            volume_size_limit=volume_size_limit_mb * 1024 * 1024,
+            replicate_fn=self._replicate_autoscale_record,
+            **dict(autoscale_opts or {}))
+        self.heat_journal.on_ingest = self.autoscaler.on_heat
         self.aggregator.local_fn = self._local_health_contribution
         # ONE replication chokepoint per journal: the on_ingest hook
         # sees every accepted record — shipped batches AND the master's
@@ -309,11 +334,14 @@ class MasterServer:
                              name="master-telemetry").start()
         if self.coordinator_seconds > 0:
             self.coordinator.start()
+        if self.autoscale_seconds > 0:
+            self.autoscaler.start()
         return self
 
     def stop(self) -> None:
         self._stop.set()
         self.coordinator.stop()
+        self.autoscaler.stop()
         self._trace_shipper.detach()
         self._event_shipper.detach()
         self._reqlog_shipper.detach()
@@ -340,6 +368,7 @@ class MasterServer:
         from ..observability.reqlog import dropped_total
 
         extra = dict(self.coordinator.health_contribution() or {})
+        extra.update(self.autoscaler.health_contribution() or {})
         extra["reqlog_records_dropped"] = \
             extra.get("reqlog_records_dropped", 0) + dropped_total()
         return extra
@@ -382,6 +411,8 @@ class MasterServer:
             self.alert_engine.import_state(data.get("alerts") or {})
         elif kind == "coordinator":
             self.coordinator.apply_replicated(data)
+        elif kind == "autoscale":
+            self.autoscaler.apply_replicated(data)
         elif kind == "ec_registry":
             with self.topo.lock:
                 self._ec_registry_shadow = data.get("registry") or {}
@@ -398,6 +429,7 @@ class MasterServer:
             "workload": self.workload_journal.query(limit=0),
             "alerts": self.alert_engine.export_state(),
             "coordinator": self.coordinator.export_replicated(),
+            "autoscale": self.autoscaler.export_replicated(),
             "ec_registry": self._ec_registry_doc(),
         }
 
@@ -412,6 +444,8 @@ class MasterServer:
         self.alert_engine.import_state(state.get("alerts") or {})
         self.coordinator.import_replicated(
             state.get("coordinator") or {})
+        self.autoscaler.import_replicated(
+            state.get("autoscale") or {})
         reg = state.get("ec_registry") or {}
         if reg:
             with self.topo.lock:
@@ -425,6 +459,7 @@ class MasterServer:
         whatever thread shipped the batch — append() is a lock-guarded
         local log write; replication rides the heartbeat."""
         self.coordinator.on_events(accepted)
+        self.autoscaler.on_events(accepted)
         # getattr: restart recovery replays the log DURING RaftNode
         # construction, before self.raft is bound
         raft = getattr(self, "raft", None)
@@ -446,6 +481,16 @@ class MasterServer:
         raft = getattr(self, "raft", None)
         if raft is not None and raft.peers and raft.is_leader:
             raft.append("coordinator", record, sync=True)
+
+    def _replicate_autoscale_record(self, record: dict) -> None:
+        """HeatAutoscaler replicate_fn: grow/shrink/tier lifecycle
+        records enter the raft log synchronously — the tier_pending
+        record IS the tiering commit point, and a leader killed
+        mid-replica-add must leave its grow_planned record on a quorum
+        so the next leader RESUMES (never duplicates) the add."""
+        raft = getattr(self, "raft", None)
+        if raft is not None and raft.peers and raft.is_leader:
+            raft.append("autoscale", record, sync=True)
 
     def _ec_registry_doc(self) -> dict:
         """The EC registry as plain urls (what ec_registry log entries
@@ -514,6 +559,10 @@ class MasterServer:
         if role == "leader":
             try:
                 self.coordinator.resume_replicated()
+            except Exception:
+                pass
+            try:
+                self.autoscaler.resume_replicated()
             except Exception:
                 pass
 
@@ -964,6 +1013,53 @@ class MasterServer:
             self._require_leader(req)
             self.coordinator.resume()
             return Response(self.coordinator.status())
+
+        @r.route("GET", "/cluster/autoscale")
+        def cluster_autoscale(req: Request) -> Response:
+            """The heat autoscaler's state machine: enabled/paused,
+            per-volume replica targets and added-replica ledger, the
+            tiered-volume registry, grow/shrink/tier/recall totals,
+            the token-bucket budget, hysteresis knobs, and the
+            raft-replicated actuation records."""
+            self._require_leader(req)
+            return Response(self.autoscaler.status())
+
+        @r.route("POST", "/cluster/autoscale/pause")
+        def cluster_autoscale_pause(req: Request) -> Response:
+            """Operator hold: no new grow/shrink/tier/recall plans
+            execute until resume (in-flight actuation legs finish).
+            The shell's admin lock pauses implicitly."""
+            self._require_leader(req)
+            self.autoscaler.pause("api")
+            return Response(self.autoscaler.status())
+
+        @r.route("POST", "/cluster/autoscale/resume")
+        def cluster_autoscale_resume(req: Request) -> Response:
+            self._require_leader(req)
+            self.autoscaler.resume()
+            return Response(self.autoscaler.status())
+
+        @r.route("POST", "/cluster/autoscale/tier")
+        def cluster_autoscale_tier(req: Request) -> Response:
+            """Manual tier/recall (shell `volume.tier`) through the
+            autoscaler's own two-phase legs, so the operator action is
+            journaled, raft-replicated, and registered for automatic
+            recall exactly like an autonomous one."""
+            self._require_leader(req)
+            b = req.json() or {}
+            try:
+                vid = int(b.get("volume_id"))
+            except (TypeError, ValueError):
+                raise HttpError(400, "volume_id required")
+            try:
+                out = self.autoscaler.tier_volume(
+                    vid, backend=str(b.get("backend") or ""),
+                    recall=bool(b.get("recall")))
+            except ValueError as e:
+                raise HttpError(400, str(e))
+            except Exception as e:
+                raise HttpError(502, f"{type(e).__name__}: {e}")
+            return Response(out)
 
         @r.route("POST", "/cluster/events/ingest")
         def cluster_events_ingest(req: Request) -> Response:

@@ -90,6 +90,14 @@ EVENT_TYPES = {
     # master): the ledger.LEDGER_EVENT_TYPES tuple is W401-linted the
     # same way HEAT_EVENT_TYPES is
     "loop_stall": "error",     # reactor loop blocked past threshold
+    # heat autoscaler actuations (ops/autoscaler.py, master): every
+    # replica_grow carries the causing heat alert id + exemplar trace;
+    # tier_committed is journaled only after the raft commit record
+    "replica_grow": "info",    # read replica added for a hot volume
+    "replica_shrink": "info",  # hold-down elapsed: added replica drops
+    "tier_committed": "info",  # cold .dat committed to remote backend
+    "tier_recall": "info",     # heat returned: tiered .dat recalled
+    "autoscale_failed": "error",  # a grow/shrink/tier/recall leg failed
 }
 
 # HEALTH_FAMILIES key (stats/aggregate.py) -> the event type emitted at
@@ -109,6 +117,7 @@ HEALTH_EVENT_TYPES = {
     "reqlog_records_dropped": "reqlog_dropped",
     "dataplane_conn_aborts": "dataplane_conn_abort",
     "loop_lag": "loop_stall",
+    "autoscale_failures": "autoscale_failed",
 }
 
 

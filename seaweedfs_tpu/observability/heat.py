@@ -520,6 +520,10 @@ class ClusterHeatJournal:  # weedlint: concurrent-class
         self._last_event: dict[int, float] = {}  # guarded-by: _lock
         self._shifts: deque = deque(maxlen=32)  # guarded-by: _lock
         self.ingested = 0  # guarded-by: _lock
+        # post-ingest hook: called OUTSIDE _lock with each merged view
+        # — the heat autoscaler's event-driven wake (set by the master,
+        # mirroring ClusterEventJournal.on_ingest)
+        self.on_ingest: Optional[Callable[[dict], None]] = None
 
     # --- ingest --------------------------------------------------------
 
@@ -539,6 +543,12 @@ class ClusterHeatJournal:  # weedlint: concurrent-class
         merged = self.merged(now)
         self._update_gauges(merged)
         self._detect_shift(merged, now)
+        hook = self.on_ingest
+        if hook is not None:
+            try:
+                hook(merged)
+            except Exception:
+                pass  # a consumer bug must never fail heat ingest
 
     # --- merge ---------------------------------------------------------
 

@@ -414,6 +414,15 @@ class PlanExecutor:
         return self._post_fn(server, path, payload,
                              timeout or self.timeout)
 
+    def admin_post(self, server: str, path: str, payload: dict,
+                   timeout: Optional[float] = None) -> dict:
+        """Generic admin leg for the OTHER master control loops (the
+        heat autoscaler's replica copy / tier / recall calls): same
+        injected transport, same explicit timeout, same `coord.exec`
+        fault point — so chaos drills fail autoscaler actuations with
+        the exact lever they fail repairs with."""
+        return self._post(server, path, payload, timeout)
+
     def refresh_heartbeats(self, servers) -> None:
         """Nudge touched servers to re-heartbeat so the master registry
         converges now instead of on the next pulse (best-effort)."""

@@ -39,7 +39,8 @@ from .failover import run_failover
 from .replay import (recording_profile, replay_fidelity,
                      spec_from_recording)
 from .spec import (FaultSpec, ScenarioSpec, default_scenarios,
-                   failure_under_load, flash_crowd, master_failover,
+                   failure_under_load, flash_crowd,
+                   flash_crowd_autoscale, master_failover,
                    read_storm, write_churn)
 from .workload import SizeSampler, ZipfSampler
 
@@ -47,7 +48,7 @@ __all__ = [
     "FaultSpec", "ScenarioSpec", "default_scenarios", "run_scenario",
     "run_against", "run_failover",
     "read_storm", "write_churn", "failure_under_load", "flash_crowd",
-    "master_failover",
+    "flash_crowd_autoscale", "master_failover",
     "ZipfSampler", "SizeSampler",
     "spec_from_recording", "recording_profile", "replay_fidelity",
     "CapacitySLO", "find_capacity", "measure_rate",

@@ -334,6 +334,24 @@ def _evaluate(spec: ScenarioSpec, result: dict,
         check("heat_alert_named_volume",
               bool(heat.get("named_volume")),
               heat.get("named_volume"), "nonempty")
+    auto = result.get("autoscale") or {}
+    if "autoscale_grow_within_s" in exp:
+        g = auto.get("first_grow_after_shift_s")
+        check("autoscale_grow_within_s",
+              g is not None and g <= exp["autoscale_grow_within_s"],
+              g, exp["autoscale_grow_within_s"])
+    if exp.get("autoscale_attribution"):
+        check("autoscale_attribution", bool(auto.get("attributed")),
+              auto.get("attributed"), True)
+    if "autoscale_recover_within_s" in exp:
+        r = auto.get("slo_recovery_s")
+        check("autoscale_recover_within_s",
+              r is not None and r <= exp["autoscale_recover_within_s"],
+              r, exp["autoscale_recover_within_s"])
+    if "autoscale_max_cycles" in exp:
+        c = auto.get("max_cycles_per_volume", 0)
+        check("autoscale_max_cycles", c <= exp["autoscale_max_cycles"],
+              c, exp["autoscale_max_cycles"])
     return checks
 
 
@@ -441,8 +459,21 @@ def run_scenario(spec: ScenarioSpec, base_dir: Optional[str] = None,
         # started must still stop whatever came up — scenarios run
         # back-to-back in one bench process, and a leaked telemetry
         # loop would skew the next one's counters
+        # drill-scale autoscaler knobs: second-scale planning, a grow
+        # threshold the shifted Zipf head clears within one decay
+        # half-life, short hold-down/cooldown so a shrink could
+        # physically happen inside the run (the thrash guard must
+        # hold by HYSTERESIS, not by the run being too short to flap)
+        auto_opts = {"grow_share": 0.30, "cold_share": 0.02,
+                     "hold_down_s": 6.0, "regrow_cooldown_s": 6.0,
+                     "max_replicas": 3, "move_rate": 2.0,
+                     "move_burst": 4.0, "actuation_deadline_s": 30.0} \
+            if spec.autoscale else None
         master = MasterServer(port=_free_port(), pulse_seconds=0.3,
-                              metrics_aggregation_seconds=0.25).start()
+                              metrics_aggregation_seconds=0.25,
+                              autoscale_seconds=(
+                                  1.0 if spec.autoscale else 0.0),
+                              autoscale_opts=auto_opts).start()
         master.aggregator.min_interval = 0.0
         master.alert_engine.min_interval = 0.0
         if spec.fast_alerts:
@@ -561,6 +592,32 @@ def run_scenario(spec: ScenarioSpec, base_dir: Optional[str] = None,
                 watch.sample()
                 time.sleep(0.25)
 
+        def replica_refresher():
+            # the autoscaler's grows only help if clients FIND the new
+            # replicas: re-lookup every distinct volume in the rank
+            # list and spread consecutive ranks round-robin across the
+            # current locations (tuple swaps are atomic under the GIL,
+            # so the client loops never see a torn entry)
+            while not stop.is_set():
+                if stop.wait(0.5):
+                    break
+                vols: dict[str, list[int]] = {}
+                for i, (fid, _u) in enumerate(ranks):
+                    vols.setdefault(fid.partition(",")[0], []).append(i)
+                for vol, idxs in vols.items():
+                    try:
+                        doc = http_json(
+                            "GET", f"http://{master.url}/dir/lookup"
+                                   f"?volumeId={vol}", timeout=5.0)
+                    except Exception:
+                        continue
+                    urls = [loc["url"]
+                            for loc in doc.get("locations") or []]
+                    if not urls:
+                        continue
+                    for k, i in enumerate(idxs):
+                        ranks[i] = (ranks[i][0], urls[k % len(urls)])
+
         def vacuum_loop():
             while not stop.is_set():
                 if stop.wait(spec.vacuum_every_s):
@@ -587,6 +644,10 @@ def run_scenario(spec: ScenarioSpec, base_dir: Optional[str] = None,
             threads.append(threading.Thread(target=head_shifter,
                                             daemon=True,
                                             name="scn-shift"))
+        if spec.autoscale:
+            threads.append(threading.Thread(target=replica_refresher,
+                                            daemon=True,
+                                            name="scn-replicas"))
         if spec.vacuum_every_s > 0:
             threads.append(threading.Thread(target=vacuum_loop,
                                             daemon=True,
@@ -683,6 +744,16 @@ def run_scenario(spec: ScenarioSpec, base_dir: Optional[str] = None,
                      if n in ("heat_shift", "flash_crowd")}
             heat_block: dict = {"shift_t": shift_t[0],
                                 "alerts_fired": fired}
+            # post-shift serving rate over the NEW hot set: the number
+            # the autoscale bench compares ON vs OFF (replica grows
+            # should lift it; without them the shifted head stays
+            # pinned to one server)
+            if shift_t[0] and wall > shift_t[0] + 1.0:
+                post_ok = sum(1 for o in ops if o.ok
+                              and o.route == "read"
+                              and o.t >= shift_t[0])
+                heat_block["post_shift_read_rps"] = round(
+                    post_ok / (wall - shift_t[0]), 1)
             try:
                 doc = http_json(
                     "GET", f"http://{master.url}/cluster/heat?top=8",
@@ -724,6 +795,54 @@ def run_scenario(spec: ScenarioSpec, base_dir: Optional[str] = None,
             except Exception:
                 pass
             result["heat"] = heat_block
+
+        if spec.autoscale:
+            # the closed-loop verdict, captured BEFORE teardown: did
+            # the autoscaler react to the shift, with attribution, and
+            # did the hot set's p99 come back inside the SLO?
+            st = master.autoscaler.status()
+            grows = [r for r in st.get("recent", ())
+                     if r.get("action") == "replica_grow"]
+            shift_wall = t0_wall + shift_t[0] if shift_t[0] else None
+            first_grow_s = None
+            if grows and shift_wall:
+                after = [r["at"] - shift_wall for r in grows
+                         if r.get("at", 0.0) >= shift_wall - 0.5]
+                if after:
+                    first_grow_s = round(min(after), 2)
+            cycles = [int(t.get("cycles") or 0)
+                      for t in st.get("targets", {}).values()]
+            auto_block = {
+                "status": {k: st.get(k) for k in
+                           ("cycles", "grows", "shrinks", "tiers",
+                            "recalls", "failures", "targets",
+                            "last_error")},
+                "grow_events": [{k: r.get(k) for k in
+                                 ("at", "vid", "src", "dst", "alert",
+                                  "cause_trace", "cause_event")}
+                                for r in grows],
+                "first_grow_after_shift_s": first_grow_s,
+                "attributed": any(r.get("alert") and r.get("cause_trace")
+                                  for r in grows),
+                "max_cycles_per_volume": max(cycles, default=0),
+            }
+            # SLO recovery: walk 1s windows of accepted read latency
+            # from the shift on; recovery is the end of the first
+            # window whose p99 is back inside the bound
+            slo_ms = spec.expectations.get("autoscale_slo_p99_ms")
+            if slo_ms and shift_t[0]:
+                rec_s = None
+                w = shift_t[0]
+                while w < wall:
+                    lat = sorted(o.lat for o in ops if o.ok
+                                 and o.route == "read"
+                                 and w <= o.t < w + 1.0)
+                    if lat and _percentile(lat, 0.99) * 1e3 <= slo_ms:
+                        rec_s = round(w + 1.0 - shift_t[0], 2)
+                        break
+                    w += 1.0
+                auto_block["slo_recovery_s"] = rec_s
+            result["autoscale"] = auto_block
 
         checks = _evaluate(spec, result, watch, fault_window)
         result["checks"] = checks

@@ -61,6 +61,11 @@ class ScenarioSpec:
     # robin interleaving ranks across servers — a shift drill needs
     # the head's move to change WHICH VOLUME is hot
     preload_locality: bool = False
+    # run the master's heat autoscaler (ops/autoscaler.py) at drill
+    # scale: grows answer the Zipf head live, clients re-discover
+    # replica locations mid-run, and the result carries an
+    # `autoscale` block (grow latency, SLO recovery, thrash count)
+    autoscale: bool = False
     faults: tuple = ()                # FaultSpec entries
     fast_alerts: bool = True          # shrink SLO windows to drill scale
     # verdict bounds; absent keys are not checked
@@ -148,6 +153,31 @@ def flash_crowd(duration_s: float = 14.0) -> ScenarioSpec:
                       "deadline_overrun_max_ms": 250.0,
                       "alert_fired_any": ["heat_shift", "flash_crowd"],
                       "heat_alert_within_s": 5.0})
+
+
+def flash_crowd_autoscale(duration_s: float = 18.0) -> ScenarioSpec:
+    """The closed-loop acceptance drill (ops/autoscaler.py): the
+    flash_crowd shape — Zipf head jumps onto one volume mid-run — but
+    with the heat autoscaler ON over three rack-diverse servers.  The
+    verdict demands the loop actually closes: a replica-add lands
+    within seconds of the shift, the journaled replica_grow carries
+    the causing heat alert id and an exemplar trace, the hot set's
+    p99 is back inside the SLO within the recovery budget, and the
+    thrash guard held (at most one grow/shrink cycle per volume)."""
+    return ScenarioSpec(
+        name="flash_crowd_autoscale", duration_s=duration_s, clients=8,
+        n_volume_servers=3, read_fraction=1.0, zipf_s=1.3, hot_set=128,
+        deadline_s=2.0, preload_locality=True, head_shift_frac=0.40,
+        autoscale=True,
+        expectations={"max_error_ratio": 0.02,
+                      "deadline_overrun_max_ms": 500.0,
+                      "alert_fired_any": ["heat_shift", "flash_crowd"],
+                      "heat_alert_within_s": 5.0,
+                      "autoscale_grow_within_s": 8.0,
+                      "autoscale_attribution": True,
+                      "autoscale_slo_p99_ms": 250.0,
+                      "autoscale_recover_within_s": 10.0,
+                      "autoscale_max_cycles": 1})
 
 
 def master_failover(duration_s: float = 16.0) -> ScenarioSpec:

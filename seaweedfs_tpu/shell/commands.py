@@ -80,7 +80,7 @@ class CommandEnv:
 # flags that never take a value (so `fs.rm -r /path` keeps /path positional)
 BOOL_FLAGS = {"r", "rf", "l", "f", "force", "writable", "readonly", "apply",
               "recursive", "v", "json", "backfill", "all", "chrome",
-              "firing", "include_ops"}
+              "firing", "include_ops", "recall"}
 
 
 def parse_flags(args: list[str]) -> dict[str, str]:

@@ -84,6 +84,10 @@ HEALTH_FAMILIES = {
     # path" regression, and it pages with the offending route via the
     # loop_stall journal-event relay
     "loop_lag": "SeaweedFS_dataplane_loop_stalls_total",
+    # heat autoscaler (ops/autoscaler.py, master-resident like the
+    # coordinator keys): failed actuation legs — a loop that keeps
+    # failing to grow/shrink/tier is a cluster not absorbing its load
+    "autoscale_failures": "SeaweedFS_autoscale_failures_total",
 }
 
 # keys whose truth lives on the MASTER: the per-peer rollup reports 0
@@ -92,7 +96,8 @@ HEALTH_FAMILIES = {
 # fixtures, `weed server` co-location) — each peer's /metrics would
 # expose the master's own gauge.
 MASTER_LOCAL_HEALTH_KEYS = ("ec_under_replicated",
-                            "coordinator_repair_failures")
+                            "coordinator_repair_failures",
+                            "autoscale_failures")
 
 
 def _unescape(v: str) -> str:

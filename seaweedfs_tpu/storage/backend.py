@@ -401,6 +401,36 @@ def get_backend(name: str) -> BackendStorage:
         return _registry[name]
 
 
+def crc32_of_file(path: str, chunk: int = 1 << 20) -> int:
+    """Streaming crc32 of a local file (tier upload/recall verification)."""
+    import zlib
+
+    crc = 0
+    with open(path, "rb") as f:
+        while True:
+            buf = f.read(chunk)
+            if not buf:
+                break
+            crc = zlib.crc32(buf, crc)
+    return crc & 0xFFFFFFFF
+
+
+def crc32_of_remote(backend: "BackendStorage", key: str, size: int,
+                    chunk: int = 1 << 20) -> int:
+    """Streaming crc32 of a remote object, read back through the backend
+    in bounded ranges — the tier protocol's upload verification reads the
+    bytes the store actually persisted, not the bytes it was sent."""
+    import zlib
+
+    crc = 0
+    off = 0
+    while off < size:
+        n = min(chunk, size - off)
+        crc = zlib.crc32(backend.read_range(key, off, n), crc)
+        off += n
+    return crc & 0xFFFFFFFF
+
+
 def configure_backends(conf: dict) -> None:
     """Build backends from config: {name: {"type": "dir", "root": ...}}."""
     for name, spec in conf.items():

@@ -17,7 +17,8 @@ import threading
 import time
 from typing import Callable, Optional
 
-from .backend import DiskFile, RemoteFile, get_backend
+from .backend import (DiskFile, RemoteFile, crc32_of_file, crc32_of_remote,
+                      get_backend)
 from .needle import (
     CRCError,
     Needle,
@@ -112,6 +113,10 @@ class Volume:
         self.write_lock = threading.RLock()
         self._group_commit = None
         self._worker_parked = False
+        # finish/roll back any tier transition a crash interrupted BEFORE
+        # opening files: recovery decides whether the authoritative .dat
+        # is the local file or the committed remote copy
+        self.tier_recover()
         self._load_or_create()
 
     # --- naming -------------------------------------------------------
@@ -281,7 +286,7 @@ class Volume:
             pass
         self.close()
         for ext in (".dat", ".idx", ".vif", ".cpd", ".cpx", ".note",
-                    ".ldb", ".sdx"):
+                    ".ldb", ".sdx", ".tier", ".tier.tmp", ".dat.tierdl"):
             p = self.file_prefix + ext
             if os.path.exists(p):
                 os.remove(p)
@@ -671,50 +676,295 @@ class Volume:
                 os.remove(p)
 
     # --- tiering (volume_grpc_tier_upload.go / _download.go) -------------
-    def tier_upload(self, backend_id: str, keep_local: bool = False) -> dict:
-        """Move the `.dat` into an object store: upload, record it in the
-        `.vif` sidecar, drop the local copy, and reopen tiered (read-only).
-        The `.idx`/needle map stay local so lookups remain in-memory."""
+    #
+    # Crash-safe two-phase protocol.  The `.tier` manifest sidecar is the
+    # write-ahead record of every tier transition; its `state` field
+    # orders the steps so a SIGKILL at ANY point leaves either the local
+    # `.dat` or a committed (verified) remote copy — never neither:
+    #
+    #   uploading  manifest written BEFORE the first remote byte: a crash
+    #              here leaves the local .dat authoritative and the
+    #              manifest names the (possibly partial) remote key so
+    #              recovery can garbage-collect it.
+    #   pending    upload finished AND verified (size + crc32 read back
+    #              from the remote).  Local .dat retained, writes frozen.
+    #              Still uncommitted: recovery GCs the remote copy.
+    #   committed  the control plane journaled tier_committed (a raft
+    #              entry on the master).  Only now may the local .dat be
+    #              deleted; recovery FINISHES the commit instead of
+    #              rolling it back.
+    #   recalling  verified download in flight (to a temp file).  A crash
+    #              leaves the volume tiered; a completed+verified .dat
+    #              lets recovery finish the recall.
+
+    @property
+    def tier_manifest_path(self) -> str:
+        return self.file_prefix + ".tier"
+
+    def tier_manifest(self) -> Optional[dict]:
+        try:
+            with open(self.tier_manifest_path) as f:
+                import json as _json
+
+                return _json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def _save_tier_manifest(self, doc: dict) -> None:
+        import json as _json
+
+        doc["updated_at"] = round(time.time(), 3)
+        tmp = self.tier_manifest_path + ".tmp"
+        with open(tmp, "w") as f:
+            _json.dump(doc, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.tier_manifest_path)
+
+    def _remove_tier_manifest(self) -> None:
+        for p in (self.tier_manifest_path,
+                  self.tier_manifest_path + ".tmp"):
+            if os.path.exists(p):
+                os.remove(p)
+
+    def _tier_key(self) -> str:
+        # same naming scheme as local files ("5.dat" / "photos_5.dat") —
+        # volume ids are cluster-unique, and a collection named
+        # "default" must not collide with the empty collection
+        return f"{self.collection}_{self.id}.dat" if self.collection \
+            else f"{self.id}.dat"
+
+    def tier_upload_begin(self, backend_id: str) -> dict:
+        """Phase 1: upload + verify, local `.dat` RETAINED.  Writes the
+        manifest before the first remote byte (crash -> GC the partial
+        object), streams the `.dat` up, then reads the remote copy back
+        through the backend and compares size AND crc32 against the
+        local file.  On success the volume is frozen read-only with the
+        manifest in `pending` — committable, abortable, crash-safe."""
+        from ..utils import faultinject
+
         if self.tiered:
             raise PermissionError(f"volume {self.id} is already tiered")
+        m = self.tier_manifest()
+        if m and m.get("state") == "pending":
+            return m  # idempotent retry: already uploaded + verified
         # drain + park the group-commit worker BEFORE taking write_lock
-        # (close() joins the worker thread, which may be waiting on it),
-        # then hold the lock for the whole snapshot->upload->swap so an
-        # acked fsync write can never land between snapshot and close.
-        # Stays parked: the volume reopens tiered (read-only .dat).
+        # (close() joins the worker thread, which may be waiting on it);
+        # the lock spans snapshot->upload->verify so an acked fsync write
+        # can never land after the crc was computed
+        self._park_worker()
+        try:
+            with self.write_lock:
+                backend = get_backend(backend_id)
+                self._dat.sync()
+                key = self._tier_key()
+                size = os.path.getsize(self.dat_path)
+                crc = crc32_of_file(self.dat_path)
+                manifest = {
+                    "state": "uploading",
+                    "version": int(self.version),
+                    "backend_type": backend.kind,
+                    "backend_id": backend_id, "key": key,
+                    "file_size": size, "crc32": crc,
+                    "modified_time": int(time.time()),
+                    "started_at": round(time.time(), 3),
+                }
+                self._save_tier_manifest(manifest)
+                # chaos hook: a delay armed here stalls with the
+                # manifest on disk and the remote object absent/partial
+                # — exactly the mid-upload SIGKILL window the recovery
+                # drill proves survivable
+                faultinject.hit("tier.upload")  # weedlint: lock-io deliberate chaos hook: the whole upload runs under write_lock by design (writes are fenced)
+                backend.upload_file(self.dat_path, key)  # weedlint: lock-io upload IS the locked critical section: the crc above is only valid while writers stay fenced
+                remote_size = backend.object_size(key)
+                remote_crc = crc32_of_remote(backend, key, remote_size)  # weedlint: lock-io read-back verify must see the same frozen bytes
+                if remote_size != size or remote_crc != crc:
+                    try:
+                        backend.delete_file(key)
+                    except Exception:
+                        pass
+                    self._remove_tier_manifest()
+                    raise IOError(
+                        f"tier upload verify failed for volume "
+                        f"{self.id}: size {remote_size}!={size} or "
+                        f"crc {remote_crc:#x}!={crc:#x}")
+                manifest["state"] = "pending"
+                self._save_tier_manifest(manifest)
+                # both copies exist; freeze writes so the remote object
+                # (and the manifest's crc) can never go stale vs local
+                self.read_only = True
+                return manifest
+        finally:
+            # writes are rejected by read_only; reads need no worker
+            self._unpark_worker()
+
+    def tier_commit(self) -> dict:
+        """Phase 2 (after the control plane journaled tier_committed):
+        persist `committed`, write the `.vif`, drop the local `.dat` and
+        reopen tiered.  Idempotent — recovery re-runs it after a crash
+        at any interior step."""
+        m = self.tier_manifest()
+        if m is None:
+            if self.tiered:
+                return {"state": "committed"}  # legacy tiered volume
+            raise FileNotFoundError(
+                f"volume {self.id} has no pending tier manifest")
+        if m.get("state") not in ("pending", "committed"):
+            raise PermissionError(
+                f"volume {self.id} tier manifest is {m.get('state')!r}, "
+                "not committable")
+        m["state"] = "committed"
+        self._save_tier_manifest(m)  # the local commit point
+        info = VolumeInfo(version=int(self.version), files=[RemoteFileInfo(
+            backend_type=m["backend_type"], backend_id=m["backend_id"],
+            key=m["key"], file_size=int(m["file_size"]),
+            modified_time=int(m.get("modified_time") or time.time()))])
+        save_volume_info(self.file_prefix, info)
         self._park_worker()
         with self.write_lock:
-            backend = get_backend(backend_id)
-            self._dat.sync()
-            # same naming scheme as local files ("5.dat" / "photos_5.dat") —
-            # volume ids are cluster-unique, and a collection named
-            # "default" must not collide with the empty collection
-            key = f"{self.collection}_{self.id}.dat" if self.collection \
-                else f"{self.id}.dat"
-            size = backend.upload_file(self.dat_path, key)
-            info = VolumeInfo(version=int(self.version), files=[RemoteFileInfo(
-                backend_type=backend.kind, backend_id=backend_id, key=key,
-                file_size=size, modified_time=int(time.time()))])
-            save_volume_info(self.file_prefix, info)
-            self.close()
-            if not keep_local:
+            if not self.tiered:
+                self.close()
+                if os.path.exists(self.dat_path):
+                    os.remove(self.dat_path)
+                self._load_or_create()
+        return m
+
+    def tier_abort(self) -> None:
+        """Roll back an uncommitted upload: delete the remote object
+        (the manifest is its only record), drop the manifest, thaw
+        writes.  Safe on a crash-recovered `uploading` manifest whose
+        remote object never fully landed."""
+        m = self.tier_manifest()
+        if m is None:
+            return
+        if m.get("state") == "committed":
+            raise PermissionError(
+                f"volume {self.id} tier is committed; recall instead")
+        try:
+            get_backend(m["backend_id"]).delete_file(m["key"])
+        except Exception:
+            pass  # a partial object that never landed has no key to GC
+        self._remove_tier_manifest()
+        self.read_only = False
+
+    def tier_recover(self) -> Optional[str]:
+        """Startup recovery (called before the volume opens): finish or
+        roll back whatever tier transition a crash interrupted.  Returns
+        the action taken ("gc_partial_upload" / "finish_commit" /
+        "finish_recall" / "revert_recall") or None."""
+        m = self.tier_manifest()
+        if m is None:
+            return None
+        state = m.get("state")
+        tmp = self.dat_path + ".tierdl"
+        if state in ("uploading", "pending"):
+            # uncommitted: the local .dat is authoritative.  GC the
+            # partial (or verified-but-never-committed) remote object.
+            try:
+                get_backend(m["backend_id"]).delete_file(m["key"])
+            except Exception:
+                pass
+            self._remove_tier_manifest()
+            return "gc_partial_upload"
+        if state == "committed":
+            # the control plane committed: the remote copy is the
+            # volume.  Finish the commit (idempotent): .vif + no .dat.
+            info = VolumeInfo(
+                version=int(m.get("version") or 3),
+                files=[RemoteFileInfo(
+                    backend_type=m["backend_type"],
+                    backend_id=m["backend_id"], key=m["key"],
+                    file_size=int(m["file_size"]),
+                    modified_time=int(m.get("modified_time") or 0))])
+            if maybe_load_volume_info(self.file_prefix) is None:
+                save_volume_info(self.file_prefix, info)
+            if os.path.exists(self.dat_path):
                 os.remove(self.dat_path)
-            self._load_or_create()
-            if keep_local:
-                # both copies exist; freeze writes so the remote object (and
-                # the .vif's file_size) can never go stale vs the local .dat
-                self.read_only = True
-            return info.files[0].to_dict()
+            return "finish_commit"
+        if state == "recalling":
+            if os.path.exists(tmp):
+                os.remove(tmp)  # partial download: the remote copy stays
+            if os.path.exists(self.dat_path) and \
+                    os.path.getsize(self.dat_path) == \
+                    int(m.get("file_size") or -1) and \
+                    crc32_of_file(self.dat_path) == int(m.get("crc32") or -1):
+                # the swap landed: finish the recall (delete remote
+                # BEFORE the .vif — the .vif is the key's only record)
+                try:
+                    get_backend(m["backend_id"]).delete_file(m["key"])
+                except Exception:
+                    pass
+                if os.path.exists(vif_path(self.file_prefix)):
+                    os.remove(vif_path(self.file_prefix))
+                self._remove_tier_manifest()
+                return "finish_recall"
+            # no complete local copy: stay tiered (remote still serves)
+            m["state"] = "committed"
+            self._save_tier_manifest(m)
+            return "revert_recall"
+        return None
+
+    def tier_upload(self, backend_id: str, keep_local: bool = False) -> dict:
+        """One-shot tier move (the legacy VolumeTierMoveDatToRemote
+        surface): phase 1 then — unless keep_local — an immediate local
+        phase 2.  Control planes that journal the commit call
+        tier_upload_begin / tier_commit themselves."""
+        manifest = self.tier_upload_begin(backend_id)
+        if not keep_local:
+            self.tier_commit()
+        return {"backend_type": manifest["backend_type"],
+                "backend_id": manifest["backend_id"],
+                "key": manifest["key"],
+                "file_size": manifest["file_size"],
+                "modified_time": manifest["modified_time"]}
 
     def tier_download(self) -> None:
-        """Bring a tiered `.dat` back to local disk and drop the sidecar."""
+        """Verified recall: bring a tiered `.dat` back to local disk.
+        Downloads to a temp file, verifies size + crc32 against the
+        manifest (when one exists — legacy `.vif`-only volumes verify
+        size alone), atomically swaps it in, deletes the remote copy
+        and drops the sidecars.  Crash-safe: until the verified swap,
+        the volume stays tiered and every read serves remote."""
+        from ..utils import faultinject
+
         info = maybe_load_volume_info(self.file_prefix)
         remote = info.remote_file if info else None
         if remote is None:
             raise FileNotFoundError(f"volume {self.id} is not tiered")
         backend = get_backend(remote.backend_id)
+        m = self.tier_manifest()
+        if m is None:
+            m = {"backend_type": remote.backend_type,
+                 "backend_id": remote.backend_id, "key": remote.key,
+                 "file_size": remote.file_size, "crc32": None,
+                 "modified_time": remote.modified_time}
+        m["state"] = "recalling"
+        self._save_tier_manifest(m)
         self.close()  # parks the worker
-        backend.download_file(remote.key, self.dat_path)
+        tmp = self.dat_path + ".tierdl"
+        try:
+            # chaos hook: a delay armed here stalls mid-recall with the
+            # remote copy intact and only the temp file partial
+            faultinject.hit("tier.recall")
+            backend.download_file(remote.key, tmp)
+            got = os.path.getsize(tmp)
+            if got != int(m["file_size"]):
+                raise IOError(f"tier recall verify failed for volume "
+                              f"{self.id}: size {got} != {m['file_size']}")
+            if m.get("crc32") is not None:
+                crc = crc32_of_file(tmp)
+                if crc != int(m["crc32"]):
+                    raise IOError(
+                        f"tier recall verify failed for volume "
+                        f"{self.id}: crc {crc:#x} != {int(m['crc32']):#x}")
+            os.replace(tmp, self.dat_path)
+        except BaseException:
+            if os.path.exists(tmp):
+                os.remove(tmp)
+            m["state"] = "committed"  # still tiered; remote still serves
+            self._save_tier_manifest(m)
+            self._load_or_create()  # reopen remote handle
+            raise
         # the remote object is deleted while the .vif still records it —
         # removing the .vif first would orphan the (billed) remote copy
         # forever, since the key exists nowhere else
@@ -723,6 +973,7 @@ class Volume:
         except Exception:
             pass  # remote copy stays; .vif removal below still un-tiers
         os.remove(vif_path(self.file_prefix))
+        self._remove_tier_manifest()
         self.read_only = False
         self._load_or_create()
         self._unpark_worker()  # writable again -> group commit allowed
@@ -733,6 +984,13 @@ class Volume:
         remote = info.remote_file if info else None
         if remote is not None:
             get_backend(remote.backend_id).delete_file(remote.key)
+        m = self.tier_manifest()
+        if m is not None and m.get("key") and remote is None:
+            # an uncommitted manifest is the only record of the key
+            try:
+                get_backend(m["backend_id"]).delete_file(m["key"])
+            except Exception:
+                pass
 
     # --- info -----------------------------------------------------------
     def to_volume_information(self) -> dict:

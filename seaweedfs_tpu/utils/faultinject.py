@@ -114,6 +114,17 @@ FAULT_POINTS: dict[str, str] = {
                   "the duration, the loop-stall drill behind the "
                   "loop_lag health key and the loop_stall alert relay "
                   "(utils/eventloop.py)",
+    "tier.upload": "tier phase-1 upload, fired with the .tier manifest "
+                   "on disk and no remote byte sent yet — delay-only "
+                   "arming opens the mid-upload SIGKILL window the "
+                   "crash drill proves survivable: local .dat stays "
+                   "authoritative, partial remote object is GC'd "
+                   "(storage/volume.py tier_upload_begin)",
+    "tier.recall": "tier recall download, fired with the manifest in "
+                   "'recalling' and only a temp file partial — the "
+                   "mid-recall SIGKILL window: remote copy stays "
+                   "authoritative, partial temp is dropped "
+                   "(storage/volume.py tier_download)",
 }
 
 
@@ -188,6 +199,43 @@ def hit(name: str) -> None:
         time.sleep(delay)
     if err is not None:
         raise err
+
+
+def arm_from_env(spec: Optional[str] = None) -> int:
+    """Arm fault points from a WEED_FAULTS-style spec string so chaos
+    drills can inject faults into SUBPROCESS servers (spawned via
+    weed.py) that they cannot reach through in-process enable() calls.
+
+    Format: ``name:key=val,key=val;name2:...`` — e.g.
+    ``WEED_FAULTS="tier.upload:delay=5,max_hits=1"``.  Keys: error_rate
+    (float), delay (float, seconds), max_hits (int).  Unknown point
+    names still arm (the registry check is weedlint's job, and a drill
+    may target a point added in the same change).  Returns the number
+    of points armed."""
+    import os as _os
+
+    if spec is None:
+        spec = _os.environ.get("WEED_FAULTS", "")
+    armed = 0
+    for part in (spec or "").split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        name, _, kvs = part.partition(":")
+        kwargs: dict = {}
+        for kv in kvs.split(","):
+            kv = kv.strip()
+            if not kv:
+                continue
+            k, _, v = kv.partition("=")
+            k = k.strip()
+            if k == "max_hits":
+                kwargs[k] = int(v)
+            elif k in ("error_rate", "delay"):
+                kwargs[k] = float(v)
+        enable(name.strip(), **kwargs)
+        armed += 1
+    return armed
 
 
 def _peer_matches(p: Optional[dict], peer: str) -> bool:

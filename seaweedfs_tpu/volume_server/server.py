@@ -1523,9 +1523,22 @@ class VolumeServer:
 
         @r.route("POST", "/admin/tier_upload")
         def tier_upload(req: Request) -> Response:
-            """VolumeTierMoveDatToRemote (volume_grpc_tier_upload.go)."""
+            """VolumeTierMoveDatToRemote (volume_grpc_tier_upload.go).
+            With ``two_phase`` the call stops after the verified upload
+            (manifest `pending`, local .dat retained, writes frozen):
+            the control plane journals its tier_committed raft record
+            and then POSTs /admin/tier_commit — the crash-safe
+            autoscaler protocol.  Without it, the legacy one-shot."""
             b = req.json()
             vid = int(b["volume_id"])
+            if bool(b.get("two_phase")):
+                try:
+                    v = self.store.get_volume(vid)
+                except KeyError:
+                    raise HttpError(404, f"volume {vid} not found")
+                with self.store.volume_locks[vid]:
+                    manifest = v.tier_upload_begin(b["backend"])
+                return Response({"manifest": manifest})
             self.store.native_detach(vid)  # tiered .dat leaves the plane
             try:
                 try:
@@ -1541,9 +1554,53 @@ class VolumeServer:
                 self.store.native_reattach(vid)
             return Response({"remote": remote})
 
+        @r.route("POST", "/admin/tier_commit")
+        def tier_commit(req: Request) -> Response:
+            """Phase 2 of the two-phase tier move: the control plane
+            already journaled tier_committed on the raft log — persist
+            `committed` locally, write the .vif, drop the local .dat
+            and reopen tiered.  Idempotent (safe to re-issue after a
+            master failover); 404s when no manifest is pending (a
+            crash-recovered volume GC'd an uncommitted upload)."""
+            vid = int(req.json()["volume_id"])
+            self.store.native_detach(vid)  # tiered .dat leaves the plane
+            try:
+                try:
+                    v = self.store.get_volume(vid)
+                except KeyError:
+                    raise HttpError(404, f"volume {vid} not found")
+                try:
+                    with self.store.volume_locks[vid]:
+                        manifest = v.tier_commit()
+                except FileNotFoundError as e:
+                    raise HttpError(404, str(e))
+                except PermissionError as e:
+                    raise HttpError(409, str(e))
+            finally:
+                self.store.native_reattach(vid)
+            return Response({"manifest": manifest})
+
+        @r.route("POST", "/admin/tier_abort")
+        def tier_abort(req: Request) -> Response:
+            """Roll back an uncommitted two-phase upload: delete the
+            remote object, drop the manifest, thaw writes."""
+            vid = int(req.json()["volume_id"])
+            try:
+                v = self.store.get_volume(vid)
+            except KeyError:
+                raise HttpError(404, f"volume {vid} not found")
+            try:
+                with self.store.volume_locks[vid]:
+                    v.tier_abort()
+            except PermissionError as e:
+                raise HttpError(409, str(e))
+            return Response({})
+
         @r.route("POST", "/admin/tier_download")
         def tier_download(req: Request) -> Response:
-            """VolumeTierMoveDatFromRemote (volume_grpc_tier_download.go)."""
+            """VolumeTierMoveDatFromRemote (volume_grpc_tier_download.go):
+            the verified recall — downloads to a temp file, checks size
+            + crc32 against the tier manifest, atomically swaps."""
             vid = int(req.json()["volume_id"])
             try:
                 v = self.store.get_volume(vid)

@@ -1,0 +1,621 @@
+"""Heat autoscaler (ops/autoscaler.py) + two-phase tier protocol
+(storage/volume.py) unit drills.
+
+The autoscaler half runs the planner against a fake topology and a
+recording post_fn transport, proving: grows answer the Zipf head and
+place rack-diverse, shrinks wait out the sustained-cold hold-down
+(hysteresis), a shrunk volume cannot re-grow inside the cooldown, the
+per-volume cycle cap backstops both (the thrash guard), the move
+budget is a token bucket, and actuation records replicate/resume with
+zero duplicate replica adds after a leader change.
+
+The storage half exercises every crash window of the two-phase tier
+protocol at the Volume level: upload+verify leaves `pending` with the
+local .dat retained, commit is the only step that deletes it, every
+recovery path (uploading / pending / committed / recalling) converges
+to "local file or committed remote copy, never neither", recalls are
+size+crc verified, and the tier.upload / tier.recall fault points
+inject exactly where the SIGKILL drills need them.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+import pytest
+
+from seaweedfs_tpu.ops.autoscaler import HeatAutoscaler
+from seaweedfs_tpu.storage.backend import (configure_backends,
+                                           crc32_of_file, get_backend)
+from seaweedfs_tpu.storage.needle import Needle
+from seaweedfs_tpu.storage.volume import Volume
+from seaweedfs_tpu.utils import faultinject as fi
+
+
+# --- fake topology ----------------------------------------------------------
+
+class _Named:
+    def __init__(self, name):
+        self.name = name
+
+
+class _FakeVol:
+    def __init__(self, size=0, read_only=False, collection=""):
+        self.size = size
+        self.read_only = read_only
+        self.collection = collection
+
+
+class _FakeNode:
+    def __init__(self, url, rack, dc="dc1"):
+        self.url = url
+        self.public_url = url
+        self.rack = _Named(rack)
+        self._dc = _Named(dc)
+        self.volumes: dict[int, _FakeVol] = {}
+
+    @property
+    def dc(self):
+        return self._dc
+
+    def free_space(self):
+        return 8.0
+
+    def ec_shard_count(self):
+        return 0
+
+
+class _FakeTopo:
+    def __init__(self, nodes):
+        self.lock = threading.Lock()
+        self._nodes = nodes
+
+    def all_nodes(self):
+        return list(self._nodes)
+
+
+def _heat_doc(shares: dict[int, float], head=None, trace="t" * 32):
+    return {"volumes": [{"volume": vid, "share": s, "trace": trace}
+                        for vid, s in shares.items()],
+            "head": {"volumes": list(shares if head is None else head)}}
+
+
+class _Transport:
+    """Recording post_fn; per-path canned responses / errors."""
+
+    def __init__(self):
+        self.calls: list[tuple[str, str, dict]] = []
+        self.errors: dict[str, Exception] = {}
+        self.replies: dict[str, dict] = {}
+        self.on_post = None
+
+    def __call__(self, server, path, payload, timeout):
+        self.calls.append((server, path, dict(payload)))
+        if self.on_post:
+            self.on_post(server, path, payload)
+        if path in self.errors:
+            raise self.errors[path]
+        return dict(self.replies.get(path, {}))
+
+    def of(self, path):
+        return [c for c in self.calls if c[1] == path]
+
+
+def _mk(topo, transport, **kw):
+    kw.setdefault("interval_s", 999.0)
+    kw.setdefault("grow_share", 0.3)
+    kw.setdefault("max_replicas", 3)
+    kw.setdefault("hold_down_s", 0.05)
+    kw.setdefault("regrow_cooldown_s", 0.05)
+    kw.setdefault("move_rate", 100.0)
+    kw.setdefault("move_burst", 100.0)
+    kw.setdefault("actuation_deadline_s", 10.0)
+    return HeatAutoscaler(topo, server="m1", post_fn=transport, **kw)
+
+
+def _three_rack_topo(vid=5, size=1000):
+    nodes = [_FakeNode("vs0:80", "r0"), _FakeNode("vs1:80", "r1"),
+             _FakeNode("vs2:80", "r2")]
+    nodes[0].volumes[vid] = _FakeVol(size=size)
+    return _FakeTopo(nodes), nodes
+
+
+class TestGrow:
+    def test_hot_volume_grows_rack_diverse(self):
+        topo, nodes = _three_rack_topo()
+        tr = _Transport()
+        a = _mk(topo, tr, heat_fn=lambda: _heat_doc({5: 0.9}))
+        out = a.run_cycle()
+        assert out["grown"] == 1
+        copies = tr.of("/admin/volume_copy")
+        assert len(copies) == 1
+        dst, _path, payload = copies[0]
+        assert dst in ("vs1:80", "vs2:80")  # a DIFFERENT rack
+        assert payload["source_data_node"] == "vs0:80"
+        assert payload["volume_id"] == 5
+        st = a.status()
+        assert st["grows"] == 1
+        assert st["targets"]["5"]["added"] == [dst]
+        # the lifecycle records rode the replication surface
+        ops = [r["op"] for r in st["replicated"]["log"]]
+        assert ops == ["grow_planned", "grow_done"]
+
+    def test_grow_carries_cause_attribution(self):
+        topo, _ = _three_rack_topo()
+        tr = _Transport()
+        a = _mk(topo, tr, heat_fn=lambda: _heat_doc({5: 0.9}))
+        # a flash_crowd event named the volume, and its alert fired
+        a.on_events([
+            {"type": "alert_fired", "id": "e1",
+             "details": {"alert": "flash_crowd",
+                         "exemplar_trace": "a" * 32}},
+            {"type": "flash_crowd", "id": "e2", "trace": "b" * 32,
+             "details": {"volume": 5}},
+        ])
+        a.run_cycle()
+        rec = a.status()["replicated"]["log"][-1]
+        assert rec["op"] == "grow_done"
+        assert rec["alert"] == "flash_crowd"
+        assert rec["cause_trace"] == "b" * 32
+        assert rec["cause_event"] == "e2"
+
+    def test_cold_volume_does_not_grow(self):
+        topo, _ = _three_rack_topo()
+        tr = _Transport()
+        a = _mk(topo, tr, heat_fn=lambda: _heat_doc({5: 0.1}, head=[]))
+        assert a.run_cycle()["grown"] == 0
+        assert not tr.of("/admin/volume_copy")
+
+    def test_max_replicas_caps_growth(self):
+        topo, nodes = _three_rack_topo()
+        nodes[1].volumes[5] = _FakeVol(size=1000)
+        tr = _Transport()
+        a = _mk(topo, tr, heat_fn=lambda: _heat_doc({5: 0.9}),
+                max_replicas=2)
+        assert a.run_cycle()["grown"] == 0
+        assert not tr.of("/admin/volume_copy")
+
+    def test_already_here_409_is_not_a_failure(self):
+        topo, _ = _three_rack_topo()
+        tr = _Transport()
+        tr.errors["/admin/volume_copy"] = RuntimeError(
+            "409: volume 5 already here")
+        a = _mk(topo, tr, heat_fn=lambda: _heat_doc({5: 0.9}))
+        assert a.run_cycle()["grown"] == 1
+        assert a.status()["failures"] == 0
+
+    def test_grow_failure_counts_and_records(self):
+        topo, _ = _three_rack_topo()
+        tr = _Transport()
+        tr.errors["/admin/volume_copy"] = RuntimeError("boom")
+        a = _mk(topo, tr, heat_fn=lambda: _heat_doc({5: 0.9}))
+        assert a.run_cycle()["grown"] == 0
+        st = a.status()
+        assert st["failures"] == 1
+        assert a.health_contribution() == {"autoscale_failures": 1}
+        ops = [r["op"] for r in st["replicated"]["log"]]
+        assert ops == ["grow_planned", "grow_failed"]
+
+    def test_move_budget_is_a_token_bucket(self):
+        nodes = [_FakeNode("vs0:80", "r0"), _FakeNode("vs1:80", "r1"),
+                 _FakeNode("vs2:80", "r2")]
+        nodes[0].volumes[5] = _FakeVol(size=1000)
+        nodes[1].volumes[6] = _FakeVol(size=1000)
+        topo = _FakeTopo(nodes)
+        tr = _Transport()
+        a = _mk(topo, tr, heat_fn=lambda: _heat_doc({5: 0.5, 6: 0.5}),
+                move_rate=0.0, move_burst=1.0)
+        assert a.run_cycle()["grown"] == 1  # one token, two candidates
+        assert len(tr.of("/admin/volume_copy")) == 1
+
+
+class TestShrinkHysteresis:
+    def _grown(self, tr=None, **kw):
+        topo, nodes = _three_rack_topo()
+        tr = tr or _Transport()
+        heat = {"doc": _heat_doc({5: 0.9})}
+        a = _mk(topo, tr, heat_fn=lambda: heat["doc"], **kw)
+        a.run_cycle()
+        dst = tr.of("/admin/volume_copy")[0][0]
+        # the copy landed: the dst now holds the volume
+        next(n for n in nodes if n.url == dst).volumes[5] = \
+            _FakeVol(size=1000)
+        return a, tr, heat, nodes
+
+    def test_shrink_waits_out_hold_down(self):
+        a, tr, heat, _ = self._grown(hold_down_s=5.0)
+        heat["doc"] = _heat_doc({5: 0.0}, head=[])
+        assert a.run_cycle()["shrunk"] == 0  # cold, but hold-down runs
+        assert not tr.of("/admin/delete_volume")
+
+    def test_sustained_cold_shrinks_one_replica(self):
+        a, tr, heat, _ = self._grown(hold_down_s=0.05)
+        heat["doc"] = _heat_doc({5: 0.0}, head=[])
+        a.run_cycle()          # starts the cold clock
+        time.sleep(0.08)
+        assert a.run_cycle()["shrunk"] == 1
+        dels = tr.of("/admin/delete_volume")
+        assert len(dels) == 1 and dels[0][2]["volume_id"] == 5
+        st = a.status()
+        assert st["shrinks"] == 1
+        assert st["targets"]["5"]["added"] == []
+        assert st["targets"]["5"]["cycles"] == 1
+
+    def test_heat_blip_resets_the_cold_clock(self):
+        a, tr, heat, _ = self._grown(hold_down_s=0.15)
+        heat["doc"] = _heat_doc({5: 0.0}, head=[])
+        a.run_cycle()
+        time.sleep(0.08)
+        heat["doc"] = _heat_doc({5: 0.9})  # blip: hot again
+        a.run_cycle()
+        heat["doc"] = _heat_doc({5: 0.0}, head=[])
+        a.run_cycle()
+        time.sleep(0.08)      # past the ORIGINAL deadline, not the new
+        assert a.run_cycle()["shrunk"] == 0
+        assert not tr.of("/admin/delete_volume")
+
+    def test_regrow_cooldown_blocks_flapback(self):
+        a, tr, heat, nodes = self._grown(hold_down_s=0.01,
+                                         regrow_cooldown_s=5.0)
+        heat["doc"] = _heat_doc({5: 0.0}, head=[])
+        a.run_cycle()
+        time.sleep(0.03)
+        assert a.run_cycle()["shrunk"] == 1
+        # the replica deletion converged in the topology too
+        for n in nodes[1:]:
+            n.volumes.pop(5, None)
+        heat["doc"] = _heat_doc({5: 0.9})  # instantly hot again
+        assert a.run_cycle()["grown"] == 0  # cooldown holds
+        assert len(tr.of("/admin/volume_copy")) == 1
+
+    def test_cycle_cap_is_the_thrash_guard(self):
+        a, tr, heat, nodes = self._grown(hold_down_s=0.01,
+                                         regrow_cooldown_s=0.01,
+                                         max_cycles_per_volume=1)
+        heat["doc"] = _heat_doc({5: 0.0}, head=[])
+        a.run_cycle()
+        time.sleep(0.03)
+        assert a.run_cycle()["shrunk"] == 1
+        for n in nodes[1:]:
+            n.volumes.pop(5, None)
+        time.sleep(0.03)      # cooldown over — only the cap holds now
+        heat["doc"] = _heat_doc({5: 0.9})
+        assert a.run_cycle()["grown"] == 0
+        assert len(tr.of("/admin/volume_copy")) == 1
+
+
+class TestReplicatedResume:
+    """Leader-failover semantics: planned records resume, never rerun."""
+
+    def test_landed_grow_closes_without_recopy(self):
+        # the old leader's copy LANDED (vs1 holds the volume); the new
+        # leader inherits the planned record and must close it with
+        # zero /admin/volume_copy calls — zero duplicate replica adds
+        topo, nodes = _three_rack_topo()
+        nodes[1].volumes[5] = _FakeVol(size=1000)
+        tr = _Transport()
+        a = _mk(topo, tr, heat_fn=lambda: _heat_doc({5: 0.9}),
+                max_replicas=2)
+        a.apply_replicated({"id": "5:grow_planned:1", "op": "grow_planned",
+                            "vid": 5, "at": time.time(), "dst": "vs1:80",
+                            "src": "vs0:80", "alert": "flash_crowd",
+                            "cause_trace": "c" * 32, "cause_event": "e9"})
+        a.resume_replicated()
+        out = a.run_cycle()
+        assert out["resumed"] == 1
+        assert not tr.of("/admin/volume_copy")
+        st = a.status()
+        assert st["replicated"]["pending"] == {}
+        done = [r for r in st["replicated"]["log"]
+                if r["op"] == "grow_done"]
+        assert done and done[0]["alert"] == "flash_crowd"
+        assert done[0]["cause_trace"] == "c" * 32
+        assert st["targets"]["5"]["added"] == ["vs1:80"]
+
+    def test_unlanded_grow_reexecutes_to_same_dst(self):
+        topo, _ = _three_rack_topo()
+        tr = _Transport()
+        a = _mk(topo, tr, heat_fn=lambda: _heat_doc({5: 0.9}),
+                max_replicas=2)
+        a.apply_replicated({"id": "5:grow_planned:1", "op": "grow_planned",
+                            "vid": 5, "at": time.time(), "dst": "vs2:80",
+                            "src": "vs0:80", "alert": "", "cause_trace": "",
+                            "cause_event": ""})
+        out = a.run_cycle()
+        assert out["resumed"] == 1
+        copies = tr.of("/admin/volume_copy")
+        assert len(copies) == 1 and copies[0][0] == "vs2:80"
+
+    def test_export_import_round_trips(self):
+        topo, _ = _three_rack_topo()
+        tr = _Transport()
+        a = _mk(topo, tr, heat_fn=lambda: _heat_doc({5: 0.9}))
+        a.run_cycle()
+        b = _mk(_FakeTopo([]), _Transport())
+        b.import_replicated(a.export_replicated())
+        assert b.status()["targets"] == a.status()["targets"]
+        assert [r["id"] for r in b.status()["replicated"]["log"]] == \
+            [r["id"] for r in a.status()["replicated"]["log"]]
+
+
+class TestTierLoop:
+    def _cold_full_topo(self, vid=9):
+        nodes = [_FakeNode("vs0:80", "r0"), _FakeNode("vs1:80", "r1")]
+        nodes[0].volumes[vid] = _FakeVol(size=900, read_only=True)
+        return _FakeTopo(nodes), nodes
+
+    def test_cold_full_volume_tiers_two_phase(self):
+        topo, _ = self._cold_full_topo()
+        tr = _Transport()
+        tr.replies["/admin/tier_upload"] = {
+            "manifest": {"key": "_9.dat", "file_size": 900,
+                         "crc32": 0xAB}}
+        a = _mk(topo, tr, heat_fn=lambda: _heat_doc({}, head=[]),
+                tier_backend="t1", tier_after_s=0.0,
+                volume_size_limit=1000)
+        out = a.run_cycle()
+        assert out["tiered"] == 1
+        up = tr.of("/admin/tier_upload")
+        assert len(up) == 1 and up[0][2]["two_phase"] is True
+        assert up[0][2]["backend"] == "t1"
+        assert len(tr.of("/admin/tier_commit")) == 1
+        st = a.status()
+        assert st["tiers"] == 1 and "9" in st["tiered"]
+        ops = [r["op"] for r in st["replicated"]["log"]]
+        # the raft-borne commit decision precedes the commit leg
+        assert ops == ["tier_pending", "tier_done"]
+
+    def test_commit_failure_is_replanned_not_stuck(self):
+        topo, _ = self._cold_full_topo()
+        tr = _Transport()
+        tr.replies["/admin/tier_upload"] = {"manifest": {"key": "k"}}
+        tr.errors["/admin/tier_commit"] = RuntimeError(
+            "404: no manifest pending")
+        a = _mk(topo, tr, heat_fn=lambda: _heat_doc({}, head=[]),
+                tier_backend="t1", tier_after_s=0.0,
+                volume_size_limit=1000)
+        assert a.run_cycle()["tiered"] == 0
+        st = a.status()
+        assert st["failures"] == 1
+        assert st["replicated"]["log"][-1]["op"] == "tier_failed"
+        assert st["replicated"]["pending"] == {}  # re-plannable
+
+    def test_pending_tier_resumes_idempotent_commit(self):
+        topo, _ = self._cold_full_topo()
+        tr = _Transport()
+        a = _mk(topo, tr, heat_fn=lambda: _heat_doc({}, head=[]),
+                tier_backend="t1")
+        a.apply_replicated({"id": "9:tier_pending:1", "op": "tier_pending",
+                            "vid": 9, "at": time.time(),
+                            "server": "vs0:80", "backend": "t1",
+                            "key": "_9.dat", "alert": "",
+                            "cause_trace": "", "cause_event": ""})
+        out = a.run_cycle()
+        assert out["resumed"] == 1
+        assert not tr.of("/admin/tier_upload")  # upload NOT redone
+        assert len(tr.of("/admin/tier_commit")) == 1
+        assert a.status()["tiered"].get("9")
+
+    def test_heat_return_recalls(self):
+        topo, _ = self._cold_full_topo()
+        tr = _Transport()
+        a = _mk(topo, tr, heat_fn=lambda: _heat_doc({9: 0.9}),
+                tier_backend="t1")
+        a.apply_replicated({"id": "9:tier_done:1", "op": "tier_done",
+                            "vid": 9, "at": time.time(),
+                            "server": "vs0:80", "backend": "t1",
+                            "key": "_9.dat", "alert": "",
+                            "cause_trace": "", "cause_event": ""})
+        out = a.run_cycle()
+        assert out["recalled"] == 1
+        dl = tr.of("/admin/tier_download")
+        assert len(dl) == 1 and dl[0][0] == "vs0:80"
+        st = a.status()
+        assert st["recalls"] == 1 and st["tiered"] == {}
+
+    def test_hot_or_replicated_volume_never_tiers(self):
+        topo, nodes = self._cold_full_topo()
+        tr = _Transport()
+        a = _mk(topo, tr, heat_fn=lambda: _heat_doc({9: 0.5}),
+                tier_backend="t1", tier_after_s=0.0,
+                volume_size_limit=1000, max_replicas=1)
+        assert a.run_cycle()["tiered"] == 0  # hot
+        nodes[1].volumes[9] = _FakeVol(size=900)  # 2 holders
+        a2 = _mk(topo, tr, heat_fn=lambda: _heat_doc({}, head=[]),
+                 tier_backend="t1", tier_after_s=0.0,
+                 volume_size_limit=1000)
+        assert a2.run_cycle()["tiered"] == 0  # replicated
+        assert not tr.of("/admin/tier_upload")
+
+
+class TestPauseAndViews:
+    def test_pause_resume_status(self):
+        topo, _ = _three_rack_topo()
+        a = _mk(topo, _Transport(), heat_fn=lambda: _heat_doc({5: 0.9}))
+        a.pause("drill")
+        st = a.status()
+        assert st["paused"] and st["pause_reason"] == "drill"
+        a.resume()
+        assert not a.status()["paused"]
+
+    def test_on_heat_wakes_only_when_actionable(self):
+        topo, _ = _three_rack_topo()
+        a = _mk(topo, _Transport())
+        a._wake.clear()
+        a.on_heat({"volumes": {5: {"heat": 1.0, "trace": ""},
+                               6: {"heat": 99.0, "trace": ""}}})
+        assert a._wake.is_set()  # 6 has ~99% share
+        a._wake.clear()
+        a.on_heat({"volumes": {v: {"heat": 1.0}
+                               for v in (5, 6, 7, 8)}})
+        assert not a._wake.is_set()  # 25% each: nobody near grow_share
+
+
+# --- two-phase tier protocol at the Volume level ---------------------------
+
+@pytest.fixture()
+def tiered_setup(tmp_path):
+    remote = tmp_path / "remote"
+    remote.mkdir()
+    configure_backends({"tt": {"type": "dir", "root": str(remote)}})
+    v = Volume(str(tmp_path), "", 3)
+    data = os.urandom(200_000)
+    v.write_needle(Needle(id=1, cookie=0x77, data=data),
+                   check_cookie=False)
+    try:
+        yield v, data, str(remote), str(tmp_path)
+    finally:
+        fi.clear()
+        try:
+            v.close()
+        except Exception:
+            pass
+
+
+def _remote_files(remote):
+    return sorted(f for f in os.listdir(remote)
+                  if os.path.isfile(os.path.join(remote, f)))
+
+
+class TestTierTwoPhase:
+    def test_begin_keeps_local_until_commit(self, tiered_setup):
+        v, data, remote, _root = tiered_setup
+        m = v.tier_upload_begin("tt")
+        assert m["state"] == "pending"
+        assert m["crc32"] == crc32_of_file(v.dat_path)
+        assert os.path.exists(v.dat_path)     # local retained
+        assert _remote_files(remote)          # verified upload landed
+        assert v.read_only                    # writers fenced
+        m2 = v.tier_commit()
+        assert m2["state"] == "committed"
+        assert not os.path.exists(v.dat_path)  # only NOW deleted
+        assert v.read_needle(1, cookie=0x77).data == data  # read-through
+
+    def test_commit_is_idempotent(self, tiered_setup):
+        v, data, _remote, _root = tiered_setup
+        v.tier_upload_begin("tt")
+        v.tier_commit()
+        assert v.tier_commit()["state"] == "committed"
+        assert v.read_needle(1, cookie=0x77).data == data
+
+    def test_abort_rolls_back_cleanly(self, tiered_setup):
+        v, data, remote, _root = tiered_setup
+        v.tier_upload_begin("tt")
+        v.tier_abort()
+        assert not _remote_files(remote)      # remote GC'd
+        assert v.tier_manifest() is None
+        assert not v.read_only
+        assert v.read_needle(1, cookie=0x77).data == data
+
+    def test_recover_gcs_uncommitted_upload(self, tiered_setup):
+        v, data, remote, root = tiered_setup
+        v.tier_upload_begin("tt")  # pending: remote copy + local .dat
+        v.close()                  # "crash" before the commit decision
+        v2 = Volume(str(root), "", 3)
+        assert v2.tier_manifest() is None
+        assert not _remote_files(remote)      # no orphan remote object
+        assert os.path.exists(v2.dat_path)    # local is authoritative
+        assert v2.read_needle(1, cookie=0x77).data == data
+        v2.close()
+
+    def test_recover_finishes_interrupted_commit(self, tiered_setup):
+        import json
+
+        v, data, remote, root = tiered_setup
+        v.tier_upload_begin("tt")
+        # crash AFTER the commit decision persisted, BEFORE the local
+        # delete: manifest says committed, .dat still on disk
+        m = v.tier_manifest()
+        m["state"] = "committed"
+        v._save_tier_manifest(m)
+        v.close()
+        v2 = Volume(str(root), "", 3)
+        assert not os.path.exists(v2.dat_path)  # commit finished
+        assert v2.tier_manifest()["state"] == "committed"
+        assert v2.read_needle(1, cookie=0x77).data == data
+        assert len(_remote_files(remote)) == 1
+        v2.close()
+
+    def test_recover_drops_partial_recall(self, tiered_setup):
+        v, data, remote, root = tiered_setup
+        v.tier_upload_begin("tt")
+        v.tier_commit()
+        # crash mid-recall: manifest `recalling`, a partial temp file
+        m = v.tier_manifest()
+        m["state"] = "recalling"
+        v._save_tier_manifest(m)
+        with open(v.dat_path + ".tierdl", "wb") as f:
+            f.write(b"partial")
+        v.close()
+        v2 = Volume(str(root), "", 3)
+        assert not os.path.exists(v2.dat_path + ".tierdl")
+        assert v2.tier_manifest()["state"] == "committed"  # still tiered
+        assert v2.read_needle(1, cookie=0x77).data == data
+        v2.close()
+
+    def test_recall_verified_and_remote_gcd(self, tiered_setup):
+        v, data, remote, _root = tiered_setup
+        v.tier_upload_begin("tt")
+        v.tier_commit()
+        v.tier_download()
+        assert os.path.exists(v.dat_path)
+        assert v.tier_manifest() is None
+        assert not _remote_files(remote)      # remote deleted post-swap
+        assert not v.read_only
+        assert v.read_needle(1, cookie=0x77).data == data
+
+    def test_upload_fault_point_aborts_cleanly(self, tiered_setup):
+        # the SIGKILL drills' window: "tier.upload" fires with the
+        # manifest on disk and zero remote bytes sent
+        v, data, remote, _root = tiered_setup
+        fi.enable("tier.upload", error_rate=1.0, max_hits=1)
+        with pytest.raises(Exception):
+            v.tier_upload_begin("tt")
+        assert fi.fired("tier.upload") == 1
+        fi.clear()
+        assert os.path.exists(v.dat_path)
+        # the manifest may remain ("uploading") — recovery GCs it
+        v.tier_recover()
+        assert v.tier_manifest() is None
+        assert not _remote_files(remote)
+        # and a clean retry succeeds
+        assert v.tier_upload_begin("tt")["state"] == "pending"
+
+    def test_recall_fault_point_stays_tiered(self, tiered_setup):
+        v, data, remote, _root = tiered_setup
+        v.tier_upload_begin("tt")
+        v.tier_commit()
+        fi.enable("tier.recall", error_rate=1.0, max_hits=1)
+        with pytest.raises(Exception):
+            v.tier_download()
+        assert fi.fired("tier.recall") == 1
+        fi.clear()
+        assert v.tier_manifest()["state"] == "committed"
+        assert not os.path.exists(v.dat_path + ".tierdl")
+        assert v.read_needle(1, cookie=0x77).data == data  # read-through
+        v.tier_download()          # retry succeeds
+        assert v.read_needle(1, cookie=0x77).data == data
+
+    def test_crc_mismatch_fails_the_upload(self, tiered_setup, monkeypatch):
+        v, data, remote, _root = tiered_setup
+        backend = get_backend("tt")
+        real = backend.upload_file
+
+        def corrupting(local_path, key):
+            n = real(local_path, key)
+            p = os.path.join(remote, key)
+            with open(p, "r+b") as f:
+                f.seek(0)
+                b = f.read(1)
+                f.seek(0)
+                f.write(bytes([b[0] ^ 0xFF]))
+            return n
+
+        monkeypatch.setattr(backend, "upload_file", corrupting)
+        with pytest.raises(IOError):
+            v.tier_upload_begin("tt")
+        assert os.path.exists(v.dat_path)     # local untouched
+        assert v.tier_manifest() is None      # rolled back
+        assert not _remote_files(remote)      # bad object GC'd

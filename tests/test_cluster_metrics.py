@@ -122,7 +122,8 @@ class TestAggregatorUnit:
             "ec_under_replicated": 0, "coordinator_repair_failures": 0,
             "requests_shed": 0, "deadline_exceeded": 0,
             "retry_budget_exhausted": 0, "reqlog_records_dropped": 0,
-            "dataplane_conn_aborts": 0, "loop_lag": 0}
+            "dataplane_conn_aborts": 0, "loop_lag": 0,
+            "autoscale_failures": 0}
 
     def test_unregistered_peer_drops_out(self):
         peers = ["a:1", "b:2"]
@@ -224,6 +225,7 @@ class TestClusterEndpoints:
                                       "reqlog_records_dropped",
                                       "dataplane_conn_aborts",
                                       "loop_lag",
+                                      "autoscale_failures",
                                       "scrub_unrepairable"}
         # the scrub verdict rollup rides the same scrape (PR 6): idle
         # scrubbers report not-running with zero verdicts

@@ -294,6 +294,155 @@ def test_restarted_master_catches_up_via_snapshot(tmp_path):
             m.stop()
 
 
+# --- autoscaler HA: kill-during-replica-add --------------------------------
+
+def test_leader_kill_during_replica_add_no_duplicate(tmp_path):
+    """The heat autoscaler's grow_planned record rides the raft log
+    BEFORE the copy executes, so a leader killed mid-replica-add leaves
+    its plan on a quorum and the promoted leader RESUMES it — never
+    duplicates it.  Both kill windows, against real volume servers:
+
+      * vid 1: the old leader's copy already LANDED (the dst holds the
+        volume) but grow_done was never recorded — the new leader must
+        close the plan with ZERO further volume_copy calls;
+      * vid 2: the copy never started — the new leader re-executes it
+        exactly once, to the SAME raft-recorded destination.
+
+    In both cases the original flash-crowd cause attribution (alert id
+    + exemplar trace + causing event) survives the election."""
+    import os as _os
+
+    from seaweedfs_tpu.volume_server.server import VolumeServer
+
+    ports = [free_port() for _ in range(3)]
+    urls = [f"127.0.0.1:{p}" for p in ports]
+    masters = []
+    for i, p in enumerate(ports):
+        masters.append(MasterServer(
+            port=p, peers=[u for j, u in enumerate(urls) if j != i],
+            mdir=str(tmp_path / f"m{i}"), pulse_seconds=0.3).start())
+    servers = []
+    try:
+        leader = _wait_one_leader(masters)
+        master_list = ",".join(urls)
+        for i in range(2):
+            root = str(tmp_path / f"v{i}")
+            _os.makedirs(root, exist_ok=True)
+            servers.append(VolumeServer(
+                [root], master_list, port=free_port(), rack=f"r{i}",
+                data_center="dc1", pulse_seconds=0.3,
+                max_volume_count=8).start())
+        src, dst = servers[0].url, servers[1].url
+        for vid in (1, 2):
+            http_json("POST", f"http://{src}/admin/assign_volume",
+                      {"volume_id": vid})
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            with leader.topo.lock:
+                nodes = {n.url: set(n.volumes)
+                         for n in leader.topo.all_nodes()}
+            if len(nodes) == 2 and {1, 2} <= nodes.get(src, set()):
+                break
+            time.sleep(0.1)
+        else:
+            raise AssertionError(f"topology never converged: {nodes}")
+
+        # the flash crowd names both volumes (cause attribution source)
+        trace = "cd" * 16
+        leader.autoscaler.on_events([
+            {"id": f"evt-fc-{vid}", "type": "flash_crowd",
+             "trace": trace, "details": {"volume": vid}}
+            for vid in (1, 2)])
+
+        # the old leader plans both grows (quorum-replicated), then
+        # dies mid-actuation: vid 1 AFTER its copy landed, vid 2 before
+        auto = leader.autoscaler
+        auto._record("grow_planned", 1, auto._cause(1), dst=dst,
+                     src=src, share=0.9)
+        auto.executor.admin_post(dst, "/admin/volume_copy", {
+            "volume_id": 1, "collection": "",
+            "source_data_node": src})
+        auto.executor.refresh_heartbeats([dst])
+        auto._record("grow_planned", 2, auto._cause(2), dst=dst,
+                     src=src, share=0.4)
+        leader.stop()
+        masters.remove(leader)
+
+        new_leader = _wait_one_leader(masters, timeout=20.0)
+        # the promoted leader must SEE the landed copy before resuming
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            with new_leader.topo.lock:
+                holders = {vid: [n.url for n in new_leader.topo
+                                 .all_nodes() if vid in n.volumes]
+                           for vid in (1, 2)}
+            if sorted(holders[1]) == sorted([src, dst]) \
+                    and holders[2] == [src]:
+                break
+            time.sleep(0.1)
+        else:
+            raise AssertionError(f"post-failover topology: {holders}")
+
+        copies = []
+        real_post = new_leader.autoscaler.executor._post_fn
+
+        def counting_post(server, path, payload, timeout):
+            if path == "/admin/volume_copy":
+                copies.append((server, payload.get("volume_id")))
+            return real_post(server, path, payload, timeout)
+
+        new_leader.autoscaler.executor._post_fn = counting_post
+        out = new_leader.autoscaler.run_cycle()
+        assert out["resumed"] == 2, out
+
+        # vid 1: closed without re-copying — zero duplicate adds
+        assert [c for c in copies if c[1] == 1] == []
+        # vid 2: exactly one copy, to the raft-recorded destination
+        assert [c for c in copies if c[1] == 2] == [(dst, 2)]
+        doc = new_leader.autoscaler.export_replicated()
+        assert doc["pending"] == {}  # both plans closed
+        done = {r["vid"]: r for r in doc["log"]
+                if r["op"] == "grow_done"}
+        for vid in (1, 2):
+            assert done[vid]["resumed_from"], done[vid]
+            assert done[vid]["cause_trace"] == trace
+            assert done[vid]["alert"] == "flash_crowd"
+            assert done[vid]["cause_event"] == f"evt-fc-{vid}"
+            assert done[vid]["dst"] == dst
+        # exactly two holders each — nothing grew twice anywhere
+        new_leader.autoscaler.executor.refresh_heartbeats([src, dst])
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            with new_leader.topo.lock:
+                holders = {vid: sorted(n.url for n in new_leader.topo
+                                       .all_nodes() if vid in n.volumes)
+                           for vid in (1, 2)}
+            if all(holders[vid] == sorted([src, dst])
+                   for vid in (1, 2)):
+                break
+            time.sleep(0.1)
+        assert all(holders[vid] == sorted([src, dst])
+                   for vid in (1, 2)), holders
+
+        # the resumed grow_done records reached the surviving follower
+        follower = next(m for m in masters if m is not new_leader)
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            fdoc = follower.autoscaler.export_replicated()
+            if fdoc["pending"] == {} and \
+                    set(fdoc["targets"]) == {"1", "2"}:
+                break
+            time.sleep(0.1)
+        assert fdoc["pending"] == {}, fdoc
+        assert fdoc["targets"]["1"]["added"] == [dst]
+        assert fdoc["targets"]["2"]["added"] == [dst]
+    finally:
+        for vs in servers:
+            vs.stop()
+        for m in masters:
+            m.stop()
+
+
 # --- the live failover drill (scenarios/failover.py) ----------------------
 
 def test_leader_failover_drill(tmp_path):

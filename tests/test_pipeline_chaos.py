@@ -822,3 +822,264 @@ def test_fresh_server_join_triggers_rack_aware_rebalance(tmp_path):
         for vs in servers:
             vs.stop()
         master.stop()
+
+
+# --- tier two-phase SIGKILL chaos drills -----------------------------------
+# (storage/volume.py tier protocol; real subprocess volume servers so
+# the kill -9 exercises the on-disk manifest recovery, not a mock)
+
+def _tier_http(method, url, data=None, timeout=10):
+    import urllib.error
+    import urllib.request
+
+    req = urllib.request.Request(url, data=data, method=method)
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def _spawn_tier_vs(vdir, port, mport, remote, faults=""):
+    import subprocess
+    import sys
+
+    env = dict(os.environ, PYTHONPATH="/root/repo")
+    if faults:
+        env["WEED_FAULTS"] = faults
+    else:
+        env.pop("WEED_FAULTS", None)
+    return subprocess.Popen(
+        [sys.executable, "/root/repo/weed.py", "volume",
+         "-dir", vdir, "-port", str(port),
+         "-mserver", f"127.0.0.1:{mport}",
+         "-tier.backends", f"chaos={remote}"],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT)
+
+
+def _wait_vs_up(port, deadline_s=20):
+    deadline = time.time() + deadline_s
+    while time.time() < deadline:
+        try:
+            st, _ = _tier_http(
+                "GET", f"http://127.0.0.1:{port}/status", timeout=2)
+            if st == 200:
+                return
+        except OSError:
+            time.sleep(0.1)
+    raise RuntimeError("volume server did not come up")
+
+
+def _remote_objects(remote):
+    return sorted(f for f in os.listdir(remote)
+                  if os.path.isfile(os.path.join(remote, f)))
+
+
+@pytest.fixture()
+def tier_chaos_cluster(tmp_path):
+    """Subprocess master + volume server with a dir tier backend rooted
+    in tmp, volume 1 preloaded with verifiable needles."""
+    import json
+    import subprocess
+    import sys
+
+    from tests.conftest import free_port
+
+    env = dict(os.environ, PYTHONPATH="/root/repo")
+    mport, vport = free_port(), free_port()
+    remote = str(tmp_path / "remote")
+    os.mkdir(remote)
+    vdir = str(tmp_path / "v")
+    master = subprocess.Popen(
+        [sys.executable, "/root/repo/weed.py", "master",
+         "-port", str(mport), "-mdir", str(tmp_path / "m")],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT)
+    vs = _spawn_tier_vs(vdir, vport, mport, remote)
+    state = {"vs": vs}
+    payloads: dict[str, bytes] = {}
+    try:
+        _wait_vs_up(vport)
+        st, _ = _tier_http(
+            "POST", f"http://127.0.0.1:{vport}/admin/assign_volume",
+            json.dumps({"volume_id": 1}).encode())
+        assert st == 200
+        rng = np.random.default_rng(0x71E4)
+        for i in range(1, 41):
+            fid = f"1,{i:08x}000000aa"
+            payloads[fid] = rng.bytes(500 + i * 37)
+            st, _ = _tier_http(
+                "POST", f"http://127.0.0.1:{vport}/{fid}",
+                payloads[fid])
+            assert st in (200, 201)
+        yield state, vport, mport, vdir, remote, payloads
+    finally:
+        for p in (state["vs"], master):
+            p.terminate()
+        for p in (state["vs"], master):
+            try:
+                p.wait(timeout=5)
+            except Exception:
+                p.kill()
+
+
+def _assert_byte_identical(vport, payloads):
+    for fid, payload in payloads.items():
+        st, body = _tier_http("GET", f"http://127.0.0.1:{vport}/{fid}")
+        assert st == 200, f"{fid} lost: {st}"
+        assert body == payload, f"{fid} corrupt"
+
+
+def test_sigkill_mid_tier_upload_no_needle_loss(tier_chaos_cluster):
+    """kill -9 in BOTH pre-commit windows of the two-phase upload:
+    (a) mid-upload — the tier.upload fault (armed via WEED_FAULTS in
+    the child) holds the server inside the upload with the manifest on
+    disk; (b) uploaded-but-uncommitted — the verified remote copy
+    exists, the commit was never issued.  After each restart the local
+    .dat is still authoritative (every read byte-identical), the
+    manifest is GC'd, and no orphan remote object survives."""
+    import glob as _glob
+    import json
+    import threading as _threading
+
+    state, vport, mport, vdir, remote, payloads = tier_chaos_cluster
+
+    # (a) respawn with the fault armed: upload stalls AT the fault,
+    # manifest `uploading` on disk, zero remote bytes sent
+    state["vs"].send_signal(signal.SIGKILL)
+    state["vs"].wait(timeout=5)
+    state["vs"] = _spawn_tier_vs(vdir, vport, mport, remote,
+                                 faults="tier.upload:delay=20")
+    _wait_vs_up(vport)
+    _tier_http("POST", f"http://127.0.0.1:{vport}/admin/mount",
+               json.dumps({"volume_id": 1}).encode())
+
+    def begin_upload():
+        try:
+            _tier_http("POST",
+                       f"http://127.0.0.1:{vport}/admin/tier_upload",
+                       json.dumps({"volume_id": 1, "backend": "chaos",
+                                   "two_phase": True}).encode(),
+                       timeout=30)
+        except OSError:
+            pass  # the kill lands mid-request
+
+    t = _threading.Thread(target=begin_upload, daemon=True)
+    t.start()
+    deadline = time.time() + 10
+    while time.time() < deadline and \
+            not _glob.glob(os.path.join(vdir, "*.tier")):
+        time.sleep(0.05)
+    assert _glob.glob(os.path.join(vdir, "*.tier")), \
+        "upload never reached the manifest write"
+    state["vs"].send_signal(signal.SIGKILL)  # mid-upload
+    state["vs"].wait(timeout=5)
+    t.join(timeout=10)
+
+    state["vs"] = _spawn_tier_vs(vdir, vport, mport, remote)
+    _wait_vs_up(vport)
+    st, _ = _tier_http("POST", f"http://127.0.0.1:{vport}/admin/mount",
+                       json.dumps({"volume_id": 1}).encode())
+    assert st == 200
+    assert not _glob.glob(os.path.join(vdir, "*.tier"))  # manifest GC'd
+    assert _glob.glob(os.path.join(vdir, "*.dat"))  # local authoritative
+    assert not _remote_objects(remote)              # no orphan remote
+    _assert_byte_identical(vport, payloads)
+
+    # (b) a CLEAN two-phase upload (verified remote copy, manifest
+    # `pending`, local retained) killed before the commit decision
+    st, body = _tier_http(
+        "POST", f"http://127.0.0.1:{vport}/admin/tier_upload",
+        json.dumps({"volume_id": 1, "backend": "chaos",
+                    "two_phase": True}).encode(), timeout=60)
+    assert st == 200, body
+    manifest = json.loads(body)["manifest"]
+    assert manifest["state"] == "pending"
+    assert _remote_objects(remote)                  # upload landed
+    assert _glob.glob(os.path.join(vdir, "*.dat"))  # local RETAINED
+    state["vs"].send_signal(signal.SIGKILL)         # pre-commit
+    state["vs"].wait(timeout=5)
+
+    state["vs"] = _spawn_tier_vs(vdir, vport, mport, remote)
+    _wait_vs_up(vport)
+    st, _ = _tier_http("POST", f"http://127.0.0.1:{vport}/admin/mount",
+                       json.dumps({"volume_id": 1}).encode())
+    assert st == 200
+    assert not _remote_objects(remote)   # uncommitted upload GC'd
+    assert not _glob.glob(os.path.join(vdir, "*.tier"))
+    _assert_byte_identical(vport, payloads)
+    # the thawed volume takes writes again
+    st, _ = _tier_http("POST",
+                       f"http://127.0.0.1:{vport}/1,deadbeef000000aa",
+                       b"post-recovery write")
+    assert st in (200, 201)
+
+
+def test_sigkill_mid_tier_recall_no_needle_loss(tier_chaos_cluster):
+    """Tier volume 1 fully (upload + verify + commit: local .dat gone,
+    reads read-through the remote), then kill -9 mid-RECALL while the
+    tier.recall fault holds the server with only a partial temp file.
+    After restart the volume is still cleanly tiered (temp dropped,
+    reads byte-identical through the remote), and a clean recall then
+    restores the local .dat byte-identically and GCs the remote."""
+    import glob as _glob
+    import json
+    import threading as _threading
+
+    state, vport, mport, vdir, remote, payloads = tier_chaos_cluster
+
+    st, body = _tier_http(
+        "POST", f"http://127.0.0.1:{vport}/admin/tier_upload",
+        json.dumps({"volume_id": 1, "backend": "chaos",
+                    "two_phase": True}).encode(), timeout=60)
+    assert st == 200, body
+    st, body = _tier_http(
+        "POST", f"http://127.0.0.1:{vport}/admin/tier_commit",
+        json.dumps({"volume_id": 1}).encode(), timeout=60)
+    assert st == 200, body
+    assert not _glob.glob(os.path.join(vdir, "*.dat"))
+    _assert_byte_identical(vport, payloads)  # read-through serves
+
+    # respawn with the recall fault armed: the download stalls with
+    # the manifest `recalling` and (at most) a partial .tierdl temp
+    state["vs"].send_signal(signal.SIGKILL)
+    state["vs"].wait(timeout=5)
+    state["vs"] = _spawn_tier_vs(vdir, vport, mport, remote,
+                                 faults="tier.recall:delay=20")
+    _wait_vs_up(vport)
+    _tier_http("POST", f"http://127.0.0.1:{vport}/admin/mount",
+               json.dumps({"volume_id": 1}).encode())
+
+    def recall():
+        try:
+            _tier_http("POST",
+                       f"http://127.0.0.1:{vport}/admin/tier_download",
+                       json.dumps({"volume_id": 1}).encode(),
+                       timeout=30)
+        except OSError:
+            pass
+
+    t = _threading.Thread(target=recall, daemon=True)
+    t.start()
+    time.sleep(1.5)  # inside the recall window
+    state["vs"].send_signal(signal.SIGKILL)
+    state["vs"].wait(timeout=5)
+    t.join(timeout=10)
+
+    state["vs"] = _spawn_tier_vs(vdir, vport, mport, remote)
+    _wait_vs_up(vport)
+    st, _ = _tier_http("POST", f"http://127.0.0.1:{vport}/admin/mount",
+                       json.dumps({"volume_id": 1}).encode())
+    assert st == 200
+    assert not _glob.glob(os.path.join(vdir, "*.tierdl"))  # temp dropped
+    assert len(_remote_objects(remote)) == 1  # committed copy intact
+    _assert_byte_identical(vport, payloads)   # still read-through
+
+    # the retried recall completes: local restored, remote GC'd
+    st, body = _tier_http(
+        "POST", f"http://127.0.0.1:{vport}/admin/tier_download",
+        json.dumps({"volume_id": 1}).encode(), timeout=60)
+    assert st == 200, body
+    assert _glob.glob(os.path.join(vdir, "*.dat"))
+    assert not _glob.glob(os.path.join(vdir, "*.tier"))
+    assert not _remote_objects(remote)
+    _assert_byte_identical(vport, payloads)

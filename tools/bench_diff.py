@@ -98,6 +98,16 @@ KEY_METRICS: list[tuple] = [
     ("master_failover.journal_loss_count", "down", 0.5),
     ("master_failover.election_time_s", "down", 1.0),
     ("master_failover.repair_replan_s", "down", 5.0),
+    # heat autoscaler + cold tiering (ops/autoscaler.py): the closed
+    # loop must pull the flash-crowd hot set back inside the SLO, lift
+    # the post-shift serving rate over the autoscale-off baseline, and
+    # cost nothing while idle; tiered reads and the 64MB recall bound
+    # the cold path's read-through and un-tier latencies
+    ("autoscale.recovery_to_slo_s", "down", 2.0),
+    ("autoscale.hot_rps_uplift_pct", "up", 10.0),
+    ("autoscale.idle_overhead_pct", "down", 1.0),
+    ("autoscale.tiered_read_ms", "down", 5.0),
+    ("autoscale.tier_recall_s", "down", 1.0),
 ]
 
 

@@ -43,14 +43,17 @@ EVENTS_REL = os.path.join("seaweedfs_tpu", "observability", "events.py")
 # was blocked; requests waited) — it pages through its counter rule
 # and the loop_stall journal-event relay, but an encode/read run's
 # MEASUREMENT is not retroactively degraded because the serving loop
-# hiccuped.
+# hiccuped.  autoscale_failures is the same cluster-topology class as
+# the coordinator keys: a failed replica-grow/tier leg pages through
+# its counter rule, it never degrades one measured run.
 DEGRADE_KEY_ALLOWLIST = ("degraded_binds", "ec_under_replicated",
                          "coordinator_repair_failures",
                          "requests_shed", "deadline_exceeded",
                          "retry_budget_exhausted",
                          "reqlog_records_dropped",
                          "dataplane_conn_aborts",
-                         "loop_lag")
+                         "loop_lag",
+                         "autoscale_failures")
 
 # DEGRADE_COUNTER_KEYS entries that are per-run encode stats rather
 # than cluster counter families.

@@ -118,12 +118,25 @@ def cmd_master(args) -> None:
                      peers=peers, mdir=args.mdir,
                      metrics_aggregation_seconds=args.metricsAggregationSeconds,
                      coordinator_seconds=args.coordinatorSeconds,
+                     autoscale_seconds=args.autoscaleSeconds,
+                     autoscale_tier_backend=args.autoscale_tier_backend,
                      max_inflight=args.maxInflight,
                      guard=master_guard(_security()),
                      tls_context=_cluster_tls()).start()
     print(f"master listening on {m.url}")
     _on_interrupt(m.stop)
     _wait_forever()
+
+
+def _tier_backends(specs) -> dict:
+    """-tier.backends NAME=DIR (repeatable) -> configure_backends conf."""
+    conf = {}
+    for spec in specs or []:
+        name, _, root = spec.partition("=")
+        if not name or not root:
+            raise SystemExit(f"-tier.backends wants NAME=DIR, got {spec!r}")
+        conf[name] = {"type": "dir", "root": root}
+    return conf
 
 
 def cmd_volume(args) -> None:
@@ -133,6 +146,7 @@ def cmd_volume(args) -> None:
     vs = VolumeServer(args.dir.split(","), args.mserver, host=args.ip,
                       port=args.port, data_center=args.dataCenter,
                       rack=args.rack, max_volume_count=args.max,
+                      backends=_tier_backends(args.tier_backends) or None,
                       ec_engine=args.ec_engine,
                       ec_mesh_devices=args.ec_mesh_devices,
                       guard=volume_guard(_security()),
@@ -1191,6 +1205,21 @@ def main(argv=None) -> None:
                         "k+1 first) and rebalance shard placement "
                         "rack-aware on server join/leave (0 = off; "
                         "status at GET /cluster/coordinator)")
+    m.add_argument("-autoscaleSeconds", type=float, default=0.0,
+                   help="run the heat autoscaler with this planning "
+                        "interval: grow read replicas for Zipf-head / "
+                        "flash-crowd volumes, shrink them after a "
+                        "sustained-cold hold-down, and (with "
+                        "-autoscale.tierBackend) tier full cold "
+                        "volumes to remote storage with automatic "
+                        "recall (0 = off; status at GET "
+                        "/cluster/autoscale)")
+    m.add_argument("-autoscale.tierBackend", dest="autoscale_tier_backend",
+                   default="",
+                   help="backend storage name the autoscaler tiers "
+                        "full cold volumes to (must be configured on "
+                        "the volume servers, e.g. -tier.backends); "
+                        "empty = no cold tiering")
     m.set_defaults(fn=cmd_master)
 
     v = sub.add_parser("volume")
@@ -1237,6 +1266,11 @@ def main(argv=None) -> None:
     v.add_argument("-ledger.halflife", dest="ledger_halflife",
                    type=float, default=60.0, metavar="SECONDS",
                    help="EWMA half-life for ledger rate decay (seconds)")
+    v.add_argument("-tier.backends", dest="tier_backends", action="append",
+                   default=[], metavar="NAME=DIR",
+                   help="register a dir-type tier backend (repeatable): "
+                        "the remote storage target for volume.tier / "
+                        "the heat autoscaler's cold tiering")
     v.set_defaults(fn=cmd_volume)
 
     s = sub.add_parser("server")
@@ -1563,6 +1597,12 @@ def main(argv=None) -> None:
     glog.init(args.v)
     if args.cpuprofile or args.memprofile:
         grace.setup_profiling(args.cpuprofile, args.memprofile)
+    # WEED_FAULTS="tier.upload:delay=5;coord.exec:error_rate=1" arms
+    # fault points in THIS process — the lever the SIGKILL chaos drills
+    # use to freeze a subprocess mid-tier-upload before killing it
+    from seaweedfs_tpu.utils import faultinject
+
+    faultinject.arm_from_env()
     _maybe_enable_tracing(args)
     _maybe_enable_reqlog(args)
     _maybe_configure_dataplane(args)
